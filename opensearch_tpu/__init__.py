@@ -1,0 +1,21 @@
+"""opensearch_tpu — a TPU-native distributed search & analytics engine.
+
+A from-scratch re-design of the capabilities of OpenSearch (reference:
+anasalkouz/OpenSearch, surveyed in /root/repo/SURVEY.md) for TPU hardware:
+
+- Immutable columnar segments resident in HBM (blocked postings, dense
+  doc-value columns, quantized norms) replace Lucene's file formats
+  (reference: server/src/main/java/org/opensearch/index/engine/Engine.java).
+- The query hot path — BM25 scoring over postings, boolean combination,
+  aggregation bucket collection, k-NN distance — runs as jitted JAX/XLA
+  (and Pallas) kernels instead of Lucene's BulkScorer loop
+  (reference: search/internal/ContextIndexSearcher.java:260).
+- Shard scatter-gather and the aggregation partial reduce become a
+  `shard_map` over a `jax.sharding.Mesh` with ICI collectives
+  (reference: action/search/SearchPhaseController.java:453).
+- The control plane (mapping, routing, cluster state, translog, REST API)
+  stays host-side Python, mirroring OpenSearch's layering
+  (reference: server/src/main/java/org/opensearch/node/Node.java:372).
+"""
+
+from opensearch_tpu.version import __version__  # noqa: F401

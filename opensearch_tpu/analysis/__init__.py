@@ -1,0 +1,1 @@
+from opensearch_tpu.analysis.registry import AnalysisRegistry, Analyzer, get_default_registry  # noqa: F401

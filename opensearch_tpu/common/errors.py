@@ -1,0 +1,105 @@
+"""Exception hierarchy mirroring OpenSearch's REST-visible error contract.
+
+Reference: server/src/main/java/org/opensearch/OpenSearchException.java and the
+per-action exceptions it wraps. Every exception carries an HTTP status and a
+`type` string matching what the reference renders in its JSON error body, so
+the REST layer can produce compatible responses.
+"""
+
+from __future__ import annotations
+
+
+class OpenSearchTpuError(Exception):
+    status = 500
+    error_type = "exception"
+
+    def __init__(self, reason: str = "", **metadata):
+        super().__init__(reason)
+        self.reason = reason
+        self.metadata = metadata
+
+    def to_xcontent(self) -> dict:
+        body = {"type": self.error_type, "reason": self.reason}
+        body.update(self.metadata)
+        return body
+
+
+class IndexNotFoundError(OpenSearchTpuError):
+    status = 404
+    error_type = "index_not_found_exception"
+
+    def __init__(self, index: str):
+        super().__init__(f"no such index [{index}]", index=index,
+                         **{"resource.type": "index_or_alias", "resource.id": index})
+        self.index = index
+
+
+class ResourceAlreadyExistsError(OpenSearchTpuError):
+    status = 400
+    error_type = "resource_already_exists_exception"
+
+
+class DocumentMissingError(OpenSearchTpuError):
+    status = 404
+    error_type = "document_missing_exception"
+
+
+class VersionConflictError(OpenSearchTpuError):
+    status = 409
+    error_type = "version_conflict_engine_exception"
+
+
+class MapperParsingError(OpenSearchTpuError):
+    status = 400
+    error_type = "mapper_parsing_exception"
+
+
+class IllegalArgumentError(OpenSearchTpuError):
+    status = 400
+    error_type = "illegal_argument_exception"
+
+
+class ParsingError(OpenSearchTpuError):
+    status = 400
+    error_type = "parsing_exception"
+
+
+class QueryShardError(OpenSearchTpuError):
+    status = 400
+    error_type = "query_shard_exception"
+
+
+class SearchPhaseExecutionError(OpenSearchTpuError):
+    status = 503
+    error_type = "search_phase_execution_exception"
+
+
+class CircuitBreakingError(OpenSearchTpuError):
+    """Reference: common/breaker/CircuitBreakingException.java."""
+    status = 429
+    error_type = "circuit_breaking_exception"
+
+
+class TaskCancelledError(OpenSearchTpuError):
+    status = 400
+    error_type = "task_cancelled_exception"
+
+
+class SettingsError(OpenSearchTpuError):
+    status = 400
+    error_type = "settings_exception"
+
+
+class ShardNotFoundError(OpenSearchTpuError):
+    status = 404
+    error_type = "shard_not_found_exception"
+
+
+class NodeNotConnectedError(OpenSearchTpuError):
+    status = 503
+    error_type = "node_not_connected_exception"
+
+
+class ClusterBlockError(OpenSearchTpuError):
+    status = 503
+    error_type = "cluster_block_exception"

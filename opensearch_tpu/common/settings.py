@@ -1,0 +1,348 @@
+"""Typed, validated, layered settings system.
+
+Re-designs the reference's config system (server/src/main/java/org/opensearch/
+common/settings/Setting.java:106, Settings.java, ClusterSettings.java:228,
+IndexScopedSettings.java:79) in Python: a `Setting` is a typed key with a
+default, parser, validator and scope properties; `Settings` is an immutable
+flat key→string map with typed accessors; `ScopedSettings` registries hold the
+known settings for a scope (cluster / index / node) and apply dynamic updates.
+"""
+
+from __future__ import annotations
+
+import enum
+import re
+from typing import Any, Callable, Dict, Iterable, Mapping, Optional
+
+from opensearch_tpu.common.errors import IllegalArgumentError, SettingsError
+
+
+class Property(enum.Flag):
+    """Reference: Setting.Property (Setting.java:117)."""
+    NODE_SCOPE = enum.auto()
+    INDEX_SCOPE = enum.auto()
+    DYNAMIC = enum.auto()
+    FINAL = enum.auto()
+    FILTERED = enum.auto()
+    DEPRECATED = enum.auto()
+
+
+_TIME_UNITS = {"nanos": 1e-9, "micros": 1e-6, "ms": 1e-3, "s": 1.0,
+               "m": 60.0, "h": 3600.0, "d": 86400.0}
+_BYTE_UNITS = {"b": 1, "kb": 1024, "mb": 1024 ** 2, "gb": 1024 ** 3,
+               "tb": 1024 ** 4, "pb": 1024 ** 5, "k": 1024, "m": 1024 ** 2, "g": 1024 ** 3}
+
+
+def parse_time_value(value: Any, key: str = "") -> float:
+    """Parse '30s' / '5m' / '100ms' into seconds (reference: common/unit/TimeValue.java)."""
+    if isinstance(value, (int, float)):
+        return float(value) / 1000.0  # bare numbers are milliseconds in the reference
+    text = str(value).strip().lower()
+    if text in ("-1", "0"):
+        return float(text)
+    m = re.fullmatch(r"(-?\d+(?:\.\d+)?)\s*(nanos|micros|ms|s|m|h|d)", text)
+    if not m:
+        raise SettingsError(f"failed to parse setting [{key}] with value [{value}] as a time value")
+    return float(m.group(1)) * _TIME_UNITS[m.group(2)]
+
+
+def parse_byte_size(value: Any, key: str = "") -> int:
+    """Parse '512mb' / '1gb' into bytes (reference: common/unit/ByteSizeValue.java)."""
+    if isinstance(value, (int, float)):
+        return int(value)
+    text = str(value).strip().lower()
+    if text == "-1":
+        return -1
+    m = re.fullmatch(r"(-?\d+(?:\.\d+)?)\s*(b|kb|mb|gb|tb|pb|k|m|g)?", text)
+    if not m:
+        raise SettingsError(f"failed to parse setting [{key}] with value [{value}] as a byte size")
+    return int(float(m.group(1)) * _BYTE_UNITS.get(m.group(2) or "b", 1))
+
+
+def _parse_bool(value: Any, key: str = "") -> bool:
+    if isinstance(value, bool):
+        return value
+    text = str(value).strip().lower()
+    if text == "true":
+        return True
+    if text == "false":
+        return False
+    raise SettingsError(f"Failed to parse value [{value}] as only [true] or [false] are allowed "
+                        f"for setting [{key}]")
+
+
+class Setting:
+    """A typed setting definition.
+
+    Reference: common/settings/Setting.java:106. `default` may be a constant or
+    a callable of the full Settings (for derived defaults like
+    `index.number_of_replicas` fallbacks).
+    """
+
+    def __init__(self, key: str, default: Any, parser: Callable[[Any], Any] = str,
+                 validator: Optional[Callable[[Any], None]] = None,
+                 properties: Property = Property.NODE_SCOPE):
+        self.key = key
+        self._default = default
+        self._parser = parser
+        self._validator = validator
+        self.properties = properties
+
+    def __repr__(self):
+        return f"Setting({self.key!r})"
+
+    @property
+    def dynamic(self) -> bool:
+        return bool(self.properties & Property.DYNAMIC)
+
+    @property
+    def final(self) -> bool:
+        return bool(self.properties & Property.FINAL)
+
+    def default(self, settings: "Settings") -> Any:
+        raw = self._default(settings) if callable(self._default) else self._default
+        return raw
+
+    def get(self, settings: "Settings") -> Any:
+        raw = settings.raw(self.key)
+        if raw is None:
+            raw = self.default(settings)
+            if raw is None:
+                return None
+        try:
+            value = self._parser(raw) if not (isinstance(raw, str) and self._parser is str) else raw
+        except SettingsError:
+            raise
+        except Exception as e:  # parser error → settings error like the reference
+            raise SettingsError(
+                f"Failed to parse value [{raw}] for setting [{self.key}]: {e}")
+        if self._validator is not None:
+            self._validator(value)
+        return value
+
+    def exists(self, settings: "Settings") -> bool:
+        return settings.raw(self.key) is not None
+
+    # -- factory helpers matching the reference's Setting.intSetting / boolSetting etc.
+    @staticmethod
+    def int_setting(key, default, min_value=None, max_value=None,
+                    properties=Property.NODE_SCOPE):
+        def validate(v):
+            if min_value is not None and v < min_value:
+                raise SettingsError(f"Failed to parse value [{v}] for setting [{key}] "
+                                    f"must be >= {min_value}")
+            if max_value is not None and v > max_value:
+                raise SettingsError(f"Failed to parse value [{v}] for setting [{key}] "
+                                    f"must be <= {max_value}")
+        return Setting(key, default, int, validate, properties)
+
+    @staticmethod
+    def float_setting(key, default, min_value=None, properties=Property.NODE_SCOPE):
+        def validate(v):
+            if min_value is not None and v < min_value:
+                raise SettingsError(f"Failed to parse value [{v}] for setting [{key}] "
+                                    f"must be >= {min_value}")
+        return Setting(key, default, float, validate, properties)
+
+    @staticmethod
+    def bool_setting(key, default, properties=Property.NODE_SCOPE):
+        return Setting(key, default, lambda v: _parse_bool(v, key), None, properties)
+
+    @staticmethod
+    def time_setting(key, default, properties=Property.NODE_SCOPE):
+        return Setting(key, default, lambda v: parse_time_value(v, key), None, properties)
+
+    @staticmethod
+    def byte_size_setting(key, default, properties=Property.NODE_SCOPE):
+        return Setting(key, default, lambda v: parse_byte_size(v, key), None, properties)
+
+    @staticmethod
+    def str_setting(key, default, validator=None, properties=Property.NODE_SCOPE):
+        return Setting(key, default, str, validator, properties)
+
+    @staticmethod
+    def enum_setting(key, default, choices, properties=Property.NODE_SCOPE):
+        choices = tuple(choices)
+
+        def validate(v):
+            if v not in choices:
+                raise SettingsError(f"unknown value [{v}] for setting [{key}], "
+                                    f"must be one of {list(choices)}")
+        return Setting(key, default, str, validate, properties)
+
+
+class Settings(Mapping):
+    """Immutable flat key → value map with typed access.
+
+    Reference: common/settings/Settings.java. Nested dicts are flattened with
+    '.'-joined keys on construction, matching the reference's builder.
+    """
+
+    EMPTY: "Settings"
+
+    def __init__(self, values: Optional[Mapping[str, Any]] = None):
+        flat: Dict[str, Any] = {}
+        if values:
+            _flatten("", dict(values), flat)
+        self._values = flat
+
+    # Mapping interface
+    def __getitem__(self, key):
+        return self._values[key]
+
+    def __iter__(self):
+        return iter(self._values)
+
+    def __len__(self):
+        return len(self._values)
+
+    def __eq__(self, other):
+        return isinstance(other, Settings) and self._values == other._values
+
+    def __hash__(self):
+        return hash(tuple(sorted((k, str(v)) for k, v in self._values.items())))
+
+    def raw(self, key: str) -> Any:
+        return self._values.get(key)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._values.get(key, default)
+
+    def get_as_int(self, key: str, default: Optional[int] = None) -> Optional[int]:
+        raw = self._values.get(key)
+        return default if raw is None else int(raw)
+
+    def get_as_bool(self, key: str, default: Optional[bool] = None) -> Optional[bool]:
+        raw = self._values.get(key)
+        return default if raw is None else _parse_bool(raw, key)
+
+    def get_as_float(self, key: str, default: Optional[float] = None) -> Optional[float]:
+        raw = self._values.get(key)
+        return default if raw is None else float(raw)
+
+    def get_as_list(self, key: str, default=None):
+        raw = self._values.get(key)
+        if raw is None:
+            return list(default) if default is not None else []
+        if isinstance(raw, (list, tuple)):
+            return list(raw)
+        return [s.strip() for s in str(raw).split(",") if s.strip()]
+
+    def by_prefix(self, prefix: str) -> "Settings":
+        out = Settings()
+        out._values = {k[len(prefix):]: v for k, v in self._values.items()
+                       if k.startswith(prefix)}
+        return out
+
+    def filtered(self, predicate: Callable[[str], bool]) -> "Settings":
+        out = Settings()
+        out._values = {k: v for k, v in self._values.items() if predicate(k)}
+        return out
+
+    def merge(self, other: "Settings | Mapping[str, Any]") -> "Settings":
+        """Build a new Settings with `other` overriding this (builder.put semantics)."""
+        out = Settings()
+        out._values = dict(self._values)
+        other_items = other._values if isinstance(other, Settings) else Settings(other)._values
+        for k, v in other_items.items():
+            if v is None:
+                out._values.pop(k, None)  # null value removes the key (dynamic-settings reset)
+            else:
+                out._values[k] = v
+        return out
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+    def as_nested_dict(self) -> Dict[str, Any]:
+        """Re-nest flattened keys for JSON rendering (GET _settings contract)."""
+        root: Dict[str, Any] = {}
+        for key, value in sorted(self._values.items()):
+            parts = key.split(".")
+            node = root
+            ok = True
+            for p in parts[:-1]:
+                nxt = node.setdefault(p, {})
+                if not isinstance(nxt, dict):
+                    ok = False
+                    break
+                node = nxt
+            if ok and isinstance(node, dict):
+                node[parts[-1]] = value
+            else:
+                root[key] = value
+        return root
+
+
+def _flatten(prefix: str, value: Any, out: Dict[str, Any]):
+    if isinstance(value, Mapping):
+        for k, v in value.items():
+            _flatten(f"{prefix}.{k}" if prefix else str(k), v, out)
+    else:
+        out[prefix] = value
+
+
+Settings.EMPTY = Settings()
+
+
+class ScopedSettings:
+    """Registry of known settings for one scope + dynamic-update application.
+
+    Reference: common/settings/AbstractScopedSettings.java, ClusterSettings.java:228.
+    """
+
+    def __init__(self, settings: Settings, registered: Iterable[Setting]):
+        self.registered: Dict[str, Setting] = {}
+        for s in registered:
+            self.register(s)
+        self._current = settings
+        self._update_consumers = []  # (setting, callback)
+
+    def register(self, setting: Setting):
+        if setting.key in self.registered:
+            raise IllegalArgumentError(f"duplicate setting registration [{setting.key}]")
+        self.registered[setting.key] = setting
+
+    @property
+    def current(self) -> Settings:
+        return self._current
+
+    def get(self, setting: Setting):
+        return setting.get(self._current)
+
+    def add_settings_update_consumer(self, setting: Setting, consumer: Callable[[Any], None]):
+        if not setting.dynamic:
+            raise IllegalArgumentError(f"setting [{setting.key}] is not dynamic")
+        self._update_consumers.append((setting, consumer))
+
+    def validate(self, settings: Settings, for_update: bool = False):
+        for key in settings:
+            setting = self.registered.get(key)
+            if setting is None:
+                # allow group wildcards like `logger.*`
+                if any(key.startswith(k[:-1]) for k in self.registered if k.endswith("*")):
+                    continue
+                raise IllegalArgumentError(
+                    f"unknown setting [{key}] please check that any required plugins are "
+                    f"installed, or check the breaking changes documentation for removed settings")
+            if for_update and not setting.dynamic:
+                kind = "final" if setting.final else "non-dynamic"
+                raise IllegalArgumentError(
+                    f"{kind} setting [{key}], not updateable")
+            if settings.raw(key) is not None:
+                setting.get(settings)  # parse+validate
+
+    def apply_update(self, update: Settings) -> Settings:
+        """Validate and apply a dynamic settings update, firing consumers.
+
+        Null values reset a key to its default — still subject to the same
+        known-setting and dynamic checks as explicit values.
+        """
+        self.validate(update, for_update=True)
+        new = self._current.merge(update)
+        old = self._current
+        self._current = new
+        for setting, consumer in self._update_consumers:
+            if setting.get(new) != setting.get(old):
+                consumer(setting.get(new))
+        return new

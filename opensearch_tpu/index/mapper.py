@@ -1,0 +1,509 @@
+"""Schema layer: mappings, field types, and JSON document parsing.
+
+Re-designs the reference's mapper package (server/src/main/java/org/opensearch/
+index/mapper/MapperService.java, DocumentParser.java, the ~30 FieldMapper
+types) for a columnar TPU segment model:
+
+- text fields    → analyzed terms feeding blocked postings (+ field length for norms)
+- keyword fields → exact values feeding both postings (term queries) and an
+                   ordinal doc-value column (terms aggs, sorting)
+- numeric/date/boolean/ip → dense f64/i64 doc-value columns; range/term queries
+                   compile to vectorized compares on the column, not postings
+- dense/knn vectors → [dims] f32 rows in a matrix column
+- metadata fields _id/_source/_routing/_seq_no/_version handled explicitly
+  (reference: index/mapper/SourceFieldMapper.java, SeqNoFieldMapper.java)
+
+Dynamic mapping inference mirrors the reference's DocumentParser defaults:
+JSON string → text + `.keyword` subfield, integer → long, float → float,
+bool → boolean, object → dotted subfields, array → per-element.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import ipaddress
+import math
+import re
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional, Tuple
+
+from opensearch_tpu.common.errors import IllegalArgumentError, MapperParsingError
+from opensearch_tpu.analysis import AnalysisRegistry, get_default_registry
+
+TEXT_TYPES = {"text", "match_only_text", "search_as_you_type"}
+KEYWORD_TYPES = {"keyword", "constant_keyword", "wildcard"}
+NUMERIC_TYPES = {"long", "integer", "short", "byte", "double", "float", "half_float",
+                 "scaled_float", "unsigned_long"}
+DATE_TYPES = {"date", "date_nanos"}
+VECTOR_TYPES = {"knn_vector", "dense_vector"}
+BOOL_TYPES = {"boolean"}
+IP_TYPES = {"ip"}
+RANGE_TYPES = {"integer_range", "long_range", "float_range", "double_range", "date_range"}
+GEO_TYPES = {"geo_point"}
+
+_INT_BOUNDS = {
+    "byte": (-2 ** 7, 2 ** 7 - 1),
+    "short": (-2 ** 15, 2 ** 15 - 1),
+    "integer": (-2 ** 31, 2 ** 31 - 1),
+    "long": (-2 ** 63, 2 ** 63 - 1),
+    "unsigned_long": (0, 2 ** 64 - 1),
+}
+
+
+def parse_date_millis(value: Any, fmt: Optional[str] = None) -> int:
+    """Parse a date into epoch milliseconds.
+
+    Covers the reference's default `strict_date_optional_time||epoch_millis`
+    (index/mapper/DateFieldMapper.java DEFAULT_DATE_TIME_FORMATTER).
+    """
+    if isinstance(value, bool):
+        raise MapperParsingError(f"failed to parse date field [{value}]")
+    if isinstance(value, (int, float)):
+        n = int(value)
+        return n * 1000 if fmt == "epoch_second" else n
+    text = str(value).strip()
+    if fmt in ("epoch_millis", "epoch_second") or re.fullmatch(r"-?\d{10,}", text):
+        try:
+            n = int(text)
+            return n * 1000 if fmt == "epoch_second" else n
+        except ValueError:
+            pass
+    # ISO-8601 family: yyyy, yyyy-MM, yyyy-MM-dd, with optional time and zone
+    t = text.replace("Z", "+00:00")
+    for pattern in (None, "%Y-%m", "%Y"):
+        try:
+            if pattern is None:
+                dt = _dt.datetime.fromisoformat(t)
+            else:
+                dt = _dt.datetime.strptime(t, pattern)
+            if dt.tzinfo is None:
+                dt = dt.replace(tzinfo=_dt.timezone.utc)
+            return int(dt.timestamp() * 1000)
+        except ValueError:
+            continue
+    raise MapperParsingError(f"failed to parse date field [{value}] with format "
+                             f"[{fmt or 'strict_date_optional_time||epoch_millis'}]")
+
+
+def format_date_millis(millis: int) -> str:
+    dt = _dt.datetime.fromtimestamp(millis / 1000.0, tz=_dt.timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"
+
+
+def ip_to_long(value: str) -> int:
+    """Encode an IP as a sortable integer (v4 mapped into v6 space)."""
+    try:
+        addr = ipaddress.ip_address(str(value))
+    except ValueError as e:
+        raise MapperParsingError(f"'{value}' is not an IP string literal.") from e
+    if isinstance(addr, ipaddress.IPv4Address):
+        addr = ipaddress.IPv6Address(b"\x00" * 10 + b"\xff\xff" + addr.packed)
+    return int(addr)
+
+
+@dataclass
+class MappedFieldType:
+    """Per-field schema record answering query/agg/fielddata questions.
+
+    Reference: index/mapper/MappedFieldType.java.
+    """
+    name: str
+    type: str
+    analyzer: str = "standard"
+    search_analyzer: Optional[str] = None
+    index: bool = True
+    doc_values: bool = True
+    store: bool = False
+    fmt: Optional[str] = None            # date format
+    scaling_factor: float = 100.0        # scaled_float
+    dims: int = 0                        # vectors
+    similarity_space: str = "l2"         # vectors: l2 | cosinesimil | innerproduct
+    ignore_above: Optional[int] = None   # keyword
+    null_value: Any = None
+    boost: float = 1.0
+    meta: dict = dc_field(default_factory=dict)
+
+    @property
+    def is_text(self):
+        return self.type in TEXT_TYPES
+
+    @property
+    def is_keyword(self):
+        return self.type in KEYWORD_TYPES
+
+    @property
+    def is_numeric(self):
+        return self.type in NUMERIC_TYPES
+
+    @property
+    def is_date(self):
+        return self.type in DATE_TYPES
+
+    @property
+    def is_bool(self):
+        return self.type in BOOL_TYPES
+
+    @property
+    def is_ip(self):
+        return self.type in IP_TYPES
+
+    @property
+    def is_vector(self):
+        return self.type in VECTOR_TYPES
+
+    @property
+    def has_ordinals(self):
+        """Fields whose doc values are ordinal-encoded strings."""
+        return self.is_keyword or self.is_ip or self.is_bool
+
+    def parse_numeric(self, value: Any) -> float:
+        """Note: doc-value columns are float64, so integer fields keep exact
+        values only up to 2**53 (a documented deviation from Lucene's int64
+        doc values); bounds checks below are exact regardless."""
+        if isinstance(value, bool):
+            raise MapperParsingError(
+                f"failed to parse field [{self.name}] of type [{self.type}]: "
+                f"boolean value not allowed")
+        if self.type in _INT_BOUNDS:
+            if isinstance(value, int):
+                n = value
+            elif isinstance(value, str) and re.fullmatch(r"-?\d+", value.strip()):
+                n = int(value.strip())
+            else:
+                try:
+                    num = float(value)
+                except (TypeError, ValueError) as e:
+                    raise MapperParsingError(
+                        f"failed to parse field [{self.name}] of type [{self.type}] "
+                        f"value [{value}]") from e
+                if math.isnan(num) or math.isinf(num):
+                    raise MapperParsingError(
+                        f"[{self.type}] supports only finite values, but got [{value}]")
+                n = int(num)  # coerce: truncate decimals, matching coerce=true default
+            lo, hi = _INT_BOUNDS[self.type]
+            if not (lo <= n <= hi):
+                raise MapperParsingError(
+                    f"Value [{value}] is out of range for a {self.type}")
+            return float(n)
+        try:
+            num = float(value)
+        except (TypeError, ValueError) as e:
+            raise MapperParsingError(
+                f"failed to parse field [{self.name}] of type [{self.type}] "
+                f"value [{value}]") from e
+        if math.isnan(num) or math.isinf(num):
+            raise MapperParsingError(f"[{self.type}] supports only finite values, "
+                                     f"but got [{value}]")
+        if self.type == "scaled_float":
+            return float(round(num * self.scaling_factor)) / self.scaling_factor
+        return num
+
+    def to_comparable(self, value: Any) -> float:
+        """Convert a user-supplied query value to the doc-value column domain."""
+        if self.is_date:
+            return float(parse_date_millis(value, self.fmt))
+        if self.is_ip:
+            return float(ip_to_long(value))
+        if self.is_bool:
+            return 1.0 if _parse_boolish(value) else 0.0
+        return self.parse_numeric(value)
+
+
+def _parse_boolish(value: Any) -> bool:
+    if isinstance(value, bool):
+        return value
+    text = str(value).strip().lower()
+    if text in ("true",):
+        return True
+    if text in ("false", ""):
+        return False
+    raise MapperParsingError(f"Failed to parse value [{value}] as only [true] or [false] "
+                             f"are allowed.")
+
+
+@dataclass
+class ParsedField:
+    """One field's contribution of a parsed document."""
+    terms: Optional[List[Tuple[str, int]]] = None  # analyzed (term, position) for text
+    length: int = 0                                 # token count for norms
+    exact_values: Optional[List[str]] = None        # keyword-style exact terms
+    numeric_values: Optional[List[float]] = None    # numeric/date/bool/ip doc values
+    vector: Optional[List[float]] = None
+
+
+@dataclass
+class ParsedDocument:
+    """Reference: index/mapper/ParsedDocument.java."""
+    doc_id: str
+    source: dict
+    routing: Optional[str]
+    fields: Dict[str, ParsedField]
+
+
+DEFAULT_MAPPING_LIMIT = 1000  # index.mapping.total_fields.limit default
+
+
+class MapperService:
+    """Holds the mapping for one index; parses documents and merges mapping updates.
+
+    Reference: index/mapper/MapperService.java:725-file. Mapping dict uses the
+    REST shape: {"properties": {"f": {"type": "text", "fields": {...}}}}.
+    """
+
+    def __init__(self, mapping: Optional[dict] = None,
+                 analysis_registry: Optional[AnalysisRegistry] = None,
+                 dynamic: Any = True, total_fields_limit: int = DEFAULT_MAPPING_LIMIT):
+        self.analysis = analysis_registry or get_default_registry()
+        self.field_types: Dict[str, MappedFieldType] = {}
+        self._multi_children: Dict[str, List[str]] = {}  # parent → direct sub-fields
+        self.dynamic = dynamic
+        self.total_fields_limit = total_fields_limit
+        self._source_enabled = True
+        if mapping:
+            self.merge(mapping)
+
+    # ------------------------------------------------------------- mapping
+    def merge(self, mapping: dict):
+        mapping = mapping.get("mappings", mapping)
+        if "dynamic" in mapping:
+            self.dynamic = mapping["dynamic"]
+        src = mapping.get("_source")
+        if isinstance(src, dict) and "enabled" in src:
+            self._source_enabled = bool(src["enabled"])
+        self._merge_properties("", mapping.get("properties", {}))
+
+    def _merge_properties(self, prefix: str, properties: dict):
+        for name, spec in properties.items():
+            if not isinstance(spec, dict):
+                raise MapperParsingError(f"Expected map for property [{prefix}{name}]")
+            full = f"{prefix}{name}"
+            sub_properties = spec.get("properties")
+            if sub_properties is not None or spec.get("type") == "object":
+                self._merge_properties(f"{full}.", sub_properties or {})
+                continue
+            ftype = spec.get("type")
+            if ftype is None:
+                raise MapperParsingError(
+                    f"No type specified for field [{full}]")
+            self._put_field(full, spec)
+
+    def _put_field(self, full_name: str, spec: dict):
+        ftype = spec.get("type")
+        known = (TEXT_TYPES | KEYWORD_TYPES | NUMERIC_TYPES | DATE_TYPES | VECTOR_TYPES
+                 | BOOL_TYPES | IP_TYPES | GEO_TYPES | {"object", "binary"})
+        if ftype not in known:
+            raise MapperParsingError(
+                f"No handler for type [{ftype}] declared on field [{full_name.split('.')[-1]}]")
+        existing = self.field_types.get(full_name)
+        if existing is not None and existing.type != ftype:
+            raise IllegalArgumentError(
+                f"mapper [{full_name}] cannot be changed from type [{existing.type}] "
+                f"to [{ftype}]")
+        if len(self.field_types) >= self.total_fields_limit and existing is None:
+            raise IllegalArgumentError(
+                f"Limit of total fields [{self.total_fields_limit}] has been exceeded")
+        dims = 0
+        if ftype in VECTOR_TYPES:
+            dims = int(spec.get("dimension", spec.get("dims", 0)))
+            if dims <= 0:
+                raise MapperParsingError(
+                    f"dimension must be set for vector field [{full_name}]")
+        analyzer = spec.get("analyzer", "standard")
+        if not self.analysis.has(analyzer):
+            raise MapperParsingError(
+                f"analyzer [{analyzer}] has not been configured in mappings")
+        space = spec.get("method", {}).get("space_type", spec.get("space_type", "l2"))
+        self.field_types[full_name] = MappedFieldType(
+            name=full_name, type=ftype,
+            analyzer=analyzer,
+            search_analyzer=spec.get("search_analyzer"),
+            index=bool(spec.get("index", True)),
+            doc_values=bool(spec.get("doc_values", True)),
+            store=bool(spec.get("store", False)),
+            fmt=spec.get("format"),
+            scaling_factor=float(spec.get("scaling_factor", 100.0)),
+            dims=dims,
+            similarity_space=space,
+            ignore_above=spec.get("ignore_above"),
+            null_value=spec.get("null_value"),
+            boost=float(spec.get("boost", 1.0)),
+            meta=spec.get("meta", {}),
+        )
+        for sub_name, sub_spec in spec.get("fields", {}).items():
+            sub_full = f"{full_name}.{sub_name}"
+            self._put_field(sub_full, sub_spec)
+            children = self._multi_children.setdefault(full_name, [])
+            if sub_full not in children:
+                children.append(sub_full)
+
+    def mapping_dict(self) -> dict:
+        """Render back the REST mapping shape (GET _mapping contract)."""
+        properties: dict = {}
+        multi_fields = [n for n in self.field_types if "." in n
+                        and n.rsplit(".", 1)[0] in self.field_types]
+        for name, ft in self.field_types.items():
+            if name in multi_fields:
+                continue
+            spec: dict = {"type": ft.type}
+            if ft.is_vector:
+                spec["dimension"] = ft.dims
+            if ft.fmt:
+                spec["format"] = ft.fmt
+            if ft.analyzer != "standard" and ft.is_text:
+                spec["analyzer"] = ft.analyzer
+            subs = {m.rsplit(".", 1)[1]: {"type": self.field_types[m].type}
+                    for m in multi_fields if m.rsplit(".", 1)[0] == name}
+            for sub_name, sub_spec in subs.items():
+                if self.field_types[f"{name}.{sub_name}"].ignore_above is not None:
+                    sub_spec["ignore_above"] = self.field_types[f"{name}.{sub_name}"].ignore_above
+            if subs:
+                spec["fields"] = subs
+            node = properties
+            parts = name.split(".")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {}).setdefault("properties", {})
+            node[parts[-1]] = spec
+        return {"properties": properties}
+
+    # ------------------------------------------------------------ documents
+    def parse_document(self, doc_id: str, source: dict,
+                       routing: Optional[str] = None) -> ParsedDocument:
+        if not isinstance(source, dict):
+            raise MapperParsingError("failed to parse: document must be an object")
+        fields: Dict[str, ParsedField] = {}
+        self._parse_object("", source, fields)
+        return ParsedDocument(doc_id=doc_id, source=source, routing=routing, fields=fields)
+
+    def _parse_object(self, prefix: str, obj: dict, out: Dict[str, ParsedField]):
+        for key, value in obj.items():
+            full = f"{prefix}{key}"
+            if isinstance(value, dict):
+                self._parse_object(f"{full}.", value, out)
+            elif isinstance(value, list) and value and all(
+                    isinstance(v, dict) for v in value):
+                for v in value:
+                    self._parse_object(f"{full}.", v, out)
+            else:
+                self._parse_value(full, value, out)
+
+    def _dynamic_map(self, name: str, value: Any):
+        if self.dynamic in (False, "false", "strict"):
+            if self.dynamic == "strict":
+                raise MapperParsingError(
+                    f"mapping set to strict, dynamic introduction of [{name}] "
+                    f"within [_doc] is not allowed")
+            return  # dynamic:false — ignore unmapped fields
+        sample = value[0] if isinstance(value, list) and value else value
+        if isinstance(sample, bool):
+            self._put_field(name, {"type": "boolean"})
+        elif isinstance(sample, int):
+            self._put_field(name, {"type": "long"})
+        elif isinstance(sample, float):
+            self._put_field(name, {"type": "float"})
+        elif isinstance(sample, str):
+            try:
+                parse_date_millis(sample)
+                looks_like_date = bool(re.match(r"^\d{4}-\d{2}-\d{2}", sample))
+            except MapperParsingError:
+                looks_like_date = False
+            if looks_like_date:
+                self._put_field(name, {"type": "date"})
+            else:
+                self._put_field(name, {"type": "text",
+                                       "fields": {"keyword": {"type": "keyword",
+                                                              "ignore_above": 256}}})
+        else:
+            return
+
+    def _parse_value(self, name: str, value: Any, out: Dict[str, ParsedField],
+                     into_multi_fields: bool = True):
+        if name not in self.field_types:
+            if value is None:
+                return
+            self._dynamic_map(name, value)
+            if name not in self.field_types:
+                return
+        if into_multi_fields:
+            # fan the same raw value into multi-fields (title → title.keyword)
+            for sub in self._multi_children.get(name, ()):
+                self._parse_value(sub, value, out, into_multi_fields=False)
+        ft = self.field_types[name]
+        values = value if isinstance(value, list) else [value]
+        values = [v for v in values if v is not None]
+        if ft.null_value is not None and not values:
+            values = [ft.null_value]
+        if not values:
+            return
+        pf = out.setdefault(name, ParsedField())
+        if ft.is_text:
+            analyzer = self.analysis.get(ft.analyzer)
+            terms: List[Tuple[str, int]] = pf.terms or []
+            # continue positions past the last emitted one, with the standard
+            # 100-position gap between values (Lucene position_increment_gap)
+            base = (terms[-1][1] + 1 + 100) if terms else 0
+            for v in values:
+                toks = analyzer.analyze(str(v))
+                terms.extend((t, base + p) for t, p in toks)
+                if toks:
+                    base += toks[-1][1] + 1 + 100
+            pf.terms = terms
+            pf.length = len(terms)
+        elif ft.is_keyword:
+            vals = pf.exact_values or []
+            for v in values:
+                s = str(v)
+                if ft.ignore_above is not None and len(s) > int(ft.ignore_above):
+                    continue
+                vals.append(s)
+            pf.exact_values = vals
+        elif ft.is_numeric:
+            nums = pf.numeric_values or []
+            nums.extend(ft.parse_numeric(v) for v in values)
+            pf.numeric_values = nums
+        elif ft.is_date:
+            nums = pf.numeric_values or []
+            nums.extend(float(parse_date_millis(v, ft.fmt)) for v in values)
+            pf.numeric_values = nums
+        elif ft.is_bool:
+            nums = pf.numeric_values or []
+            bools = [_parse_boolish(v) for v in values]
+            nums.extend(1.0 if b else 0.0 for b in bools)
+            pf.numeric_values = nums
+            pf.exact_values = (pf.exact_values or []) + [
+                "true" if b else "false" for b in bools]
+        elif ft.is_ip:
+            nums = pf.numeric_values or []
+            nums.extend(float(ip_to_long(v)) for v in values)
+            pf.numeric_values = nums
+            pf.exact_values = (pf.exact_values or []) + [str(v) for v in values]
+        elif ft.is_vector:
+            if isinstance(value, list) and all(isinstance(v, (int, float)) for v in value):
+                vec = [float(v) for v in value]
+            else:
+                raise MapperParsingError(
+                    f"failed to parse vector field [{name}]: expected array of numbers")
+            if len(vec) != ft.dims:
+                raise MapperParsingError(
+                    f"Vector dimension mismatch for field [{name}]: expected {ft.dims}, "
+                    f"got {len(vec)}")
+            pf.vector = vec
+        elif ft.type == "geo_point":
+            nums = pf.numeric_values or []
+            lat, lon = _parse_geo_point(value)
+            nums.extend([lat, lon])
+            pf.numeric_values = nums
+        # binary/object: stored in _source only
+
+    def get_field(self, name: str) -> Optional[MappedFieldType]:
+        return self.field_types.get(name)
+
+
+def _parse_geo_point(value: Any) -> Tuple[float, float]:
+    if isinstance(value, dict):
+        return float(value["lat"]), float(value["lon"])
+    if isinstance(value, (list, tuple)) and len(value) == 2:
+        return float(value[1]), float(value[0])  # GeoJSON order [lon, lat]
+    if isinstance(value, str) and "," in value:
+        lat, lon = value.split(",", 1)
+        return float(lat), float(lon)
+    raise MapperParsingError(f"failed to parse geo_point [{value}]")

@@ -1,0 +1,7 @@
+"""Version constants (reference: server/src/main/java/org/opensearch/Version.java:101)."""
+
+__version__ = "0.1.0"
+
+# Wire/index compatibility version, bumped when the segment format changes.
+SEGMENT_FORMAT_VERSION = 1
+CLUSTER_STATE_VERSION = 1

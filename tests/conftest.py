@@ -1,0 +1,27 @@
+"""Test harness configuration.
+
+Forces JAX onto 8 virtual CPU devices so sharding/collective code paths run
+without TPU hardware — the analog of the reference booting multiple nodes in
+one JVM via InternalTestCluster (test/framework/.../test/InternalTestCluster.java:195).
+Must run before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected >=8 virtual devices, got {devices}"
+    return devices

@@ -1,0 +1,87 @@
+"""Analysis chain tests (reference contract: modules/analysis-common test suites)."""
+
+import pytest
+
+from opensearch_tpu.analysis.registry import (
+    AnalysisRegistry, get_default_registry)
+from opensearch_tpu.analysis.porter import porter_stem
+from opensearch_tpu.common.errors import IllegalArgumentError
+
+
+def test_standard_analyzer():
+    a = get_default_registry().get("standard")
+    assert a.terms("The QUICK Brown-Foxes jumped!") == ["the", "quick", "brown", "foxes", "jumped"]
+    assert a.terms("don't stop 3.14 v2") == ["don't", "stop", "3.14", "v2"]
+
+
+def test_whitespace_and_keyword():
+    reg = get_default_registry()
+    assert reg.get("whitespace").terms("Foo  Bar-baz") == ["Foo", "Bar-baz"]
+    assert reg.get("keyword").terms("New York") == ["New York"]
+    assert reg.get("simple").terms("a1b2") == ["a", "b"]
+
+
+def test_stop_and_english():
+    reg = get_default_registry()
+    assert reg.get("stop").terms("the quick and the dead") == ["quick", "dead"]
+    assert reg.get("english").terms("the running foxes") == ["run", "fox"]
+
+
+@pytest.mark.parametrize("word,stem", [
+    ("caresses", "caress"), ("ponies", "poni"), ("cats", "cat"),
+    ("feed", "feed"), ("agreed", "agre"), ("plastered", "plaster"),
+    ("motoring", "motor"), ("sing", "sing"), ("conflated", "conflat"),
+    ("troubling", "troubl"), ("sized", "size"), ("hopping", "hop"),
+    ("falling", "fall"), ("hissing", "hiss"), ("happy", "happi"),
+    ("relational", "relat"), ("conditional", "condit"), ("vietnamization", "vietnam"),
+    ("predication", "predic"), ("operator", "oper"), ("feudalism", "feudal"),
+    ("decisiveness", "decis"), ("hopefulness", "hope"), ("formaliti", "formal"),
+    ("triplicate", "triplic"), ("formative", "form"), ("formalize", "formal"),
+    ("electrical", "electr"), ("hopeful", "hope"), ("goodness", "good"),
+    ("revival", "reviv"), ("allowance", "allow"), ("inference", "infer"),
+    ("adjustment", "adjust"), ("dependent", "depend"), ("adoption", "adopt"),
+    ("probate", "probat"), ("rate", "rate"), ("cease", "ceas"),
+    ("controll", "control"), ("roll", "roll"),
+])
+def test_porter_stemmer_published_examples(word, stem):
+    assert porter_stem(word) == stem
+
+
+def test_custom_analyzer_from_settings():
+    reg = AnalysisRegistry({
+        "analyzer": {
+            "my_ngram": {"tokenizer": "my_edge", "filter": ["lowercase"]},
+            "folded": {"tokenizer": "standard", "filter": ["lowercase", "asciifolding"]},
+            "html": {"tokenizer": "standard", "char_filter": ["html_strip"], "filter": ["lowercase"]},
+        },
+        "tokenizer": {
+            "my_edge": {"type": "edge_ngram", "min_gram": 2, "max_gram": 4},
+        },
+    })
+    assert reg.get("my_ngram").terms("Quick") == ["qu", "qui", "quic"]
+    assert reg.get("folded").terms("Café") == ["cafe"]
+    assert reg.get("html").terms("<b>Bold</b> move") == ["bold", "move"]
+
+
+def test_synonym_filter():
+    reg = AnalysisRegistry({
+        "analyzer": {"syn": {"tokenizer": "whitespace", "filter": ["lowercase", "my_syn"]}},
+        "filter": {"my_syn": {"type": "synonym",
+                              "synonyms": ["quick, fast => rapid", "ny, new_york"]}},
+    })
+    assert reg.get("syn").terms("quick trip") == ["rapid", "trip"]
+    assert reg.get("syn").terms("ny") == ["ny", "new_york"]
+
+
+def test_shingle_filter():
+    reg = AnalysisRegistry({
+        "analyzer": {"sh": {"tokenizer": "whitespace", "filter": ["shingle"]}},
+    })
+    assert reg.get("sh").terms("a b c") == ["a", "b", "c", "a b", "b c"]
+
+
+def test_unknown_analyzer_raises():
+    with pytest.raises(IllegalArgumentError):
+        get_default_registry().get("nope")
+    with pytest.raises(IllegalArgumentError):
+        AnalysisRegistry({"analyzer": {"x": {"tokenizer": "missing_tok"}}})

@@ -1,0 +1,189 @@
+"""Mapper + segment format tests.
+
+Contract model: reference mapper tests (index/mapper/*Tests.java) and the
+Lucene norm encoding (SmallFloat) used by BM25Similarity.
+"""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.common.errors import MapperParsingError
+from opensearch_tpu.index.mapper import (
+    MapperService, parse_date_millis, ip_to_long)
+from opensearch_tpu.index.segment import (
+    BLOCK, LENGTH_TABLE, SegmentBuilder, merge_segments,
+    smallfloat_byte4_to_int, smallfloat_int_to_byte4)
+
+MAPPING = {
+    "properties": {
+        "title": {"type": "text", "fields": {"keyword": {"type": "keyword"}}},
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "views": {"type": "integer"},
+        "price": {"type": "double"},
+        "published": {"type": "date"},
+        "active": {"type": "boolean"},
+        "addr": {"type": "ip"},
+        "embedding": {"type": "knn_vector", "dimension": 4},
+    }
+}
+
+
+def build(docs, mapping=MAPPING):
+    m = MapperService(mapping)
+    b = SegmentBuilder(m)
+    for i, src in enumerate(docs):
+        b.add(m.parse_document(str(i), src))
+    return m, b.seal()
+
+
+def test_smallfloat_matches_lucene_semantics():
+    # exact below 16
+    for i in range(16):
+        assert smallfloat_int_to_byte4(i) == i
+        assert smallfloat_byte4_to_int(i) == i
+    # monotone non-decreasing decode∘encode, idempotent on bucket lower bounds
+    prev = -1
+    for i in [0, 1, 5, 15, 16, 17, 31, 32, 100, 255, 1000, 10 ** 6, 2 ** 30]:
+        enc = smallfloat_int_to_byte4(i)
+        dec = smallfloat_byte4_to_int(enc)
+        assert dec <= i
+        assert dec >= prev
+        prev = dec
+        # re-encoding the decoded value is stable
+        assert smallfloat_int_to_byte4(dec) == enc
+    assert LENGTH_TABLE.shape == (256,)
+    assert LENGTH_TABLE[255] == smallfloat_byte4_to_int(255)
+
+
+def test_date_parsing():
+    assert parse_date_millis("2023-01-01") == 1672531200000
+    assert parse_date_millis("2023-01-01T00:00:01Z") == 1672531201000
+    assert parse_date_millis(1672531200000) == 1672531200000
+    assert parse_date_millis("1672531200000") == 1672531200000
+    with pytest.raises(MapperParsingError):
+        parse_date_millis("not a date")
+
+
+def test_ip_encoding_orders():
+    assert ip_to_long("10.0.0.1") < ip_to_long("10.0.0.2") < ip_to_long("192.168.0.1")
+
+
+def test_dynamic_mapping_inference():
+    m = MapperService()
+    m.parse_document("1", {"name": "bob", "age": 3, "score": 1.5, "ok": True,
+                           "when": "2020-05-01", "nested": {"deep": "x"}})
+    assert m.get_field("name").type == "text"
+    assert m.get_field("name.keyword").type == "keyword"
+    assert m.get_field("age").type == "long"
+    assert m.get_field("score").type == "float"
+    assert m.get_field("ok").type == "boolean"
+    assert m.get_field("when").type == "date"
+    assert m.get_field("nested.deep").type == "text"
+
+
+def test_strict_dynamic_raises():
+    m = MapperService({"dynamic": "strict", "properties": {"a": {"type": "keyword"}}})
+    with pytest.raises(MapperParsingError, match="strict"):
+        m.parse_document("1", {"b": "x"})
+
+
+def test_segment_postings_layout():
+    _, seg = build([
+        {"title": "red fox", "body": "the red fox jumped", "views": 10},
+        {"title": "blue fox", "body": "lazy dog", "views": 20},
+        {"title": "red dog", "views": 5},
+    ])
+    meta = seg.get_term("body", "fox")
+    assert meta.doc_freq == 1
+    meta = seg.get_term("title", "fox")
+    assert meta.doc_freq == 2
+    docs = seg.post_docs[meta.start_block:meta.start_block + meta.num_blocks].ravel()
+    assert list(docs[:2]) == [0, 1]
+    assert all(d == -1 for d in docs[2:])
+    # keyword multi-field indexed exact
+    assert seg.get_term("title.keyword", "red fox").doc_freq == 1
+    # postings rows are BLOCK wide
+    assert seg.post_docs.shape[1] == BLOCK
+
+
+def test_segment_norms_and_stats():
+    _, seg = build([
+        {"body": "one two three"},
+        {"body": "one"},
+    ])
+    stats = seg.field_stats["body"]
+    assert stats.doc_count == 2
+    assert stats.sum_total_term_freq == 4
+    assert seg.norms["body"][0] == smallfloat_int_to_byte4(3)
+    assert seg.norms["body"][1] == smallfloat_int_to_byte4(1)
+
+
+def test_segment_doc_values():
+    _, seg = build([
+        {"views": 10, "price": 1.5, "published": "2020-01-01", "active": True,
+         "tag": "b", "addr": "10.0.0.1"},
+        {"views": 20, "tag": "a"},
+        {"price": 9.0, "tag": "a", "active": False},
+    ])
+    col = seg.numeric_dv["views"]
+    assert list(col.doc_ids) == [0, 1]
+    assert list(col.values) == [10.0, 20.0]
+    assert list(col.exists) == [True, True, False]
+    tags = seg.ordinal_dv["tag"]
+    assert tags.dictionary == ["a", "b"]
+    assert list(tags.doc_ids) == [0, 1, 2]
+    assert list(tags.ords) == [1, 0, 0]
+    assert seg.numeric_dv["active"].values[0] == 1.0
+    assert seg.numeric_dv["active"].values[1] == 0.0
+
+
+def test_segment_vectors():
+    _, seg = build([
+        {"embedding": [1, 2, 3, 4]},
+        {"title": "no vector"},
+        {"embedding": [5, 6, 7, 8]},
+    ])
+    col = seg.vector_dv["embedding"]
+    assert col.vectors.shape == (3, 4)
+    assert list(col.exists) == [True, False, True]
+    np.testing.assert_array_equal(col.vectors[2], [5, 6, 7, 8])
+
+
+def test_vector_dim_mismatch():
+    m = MapperService(MAPPING)
+    with pytest.raises(MapperParsingError, match="dimension"):
+        m.parse_document("1", {"embedding": [1, 2]})
+
+
+def test_deletes_and_merge():
+    m, seg = build([
+        {"body": "alpha"}, {"body": "beta"}, {"body": "gamma"},
+    ])
+    assert seg.delete("1")
+    assert not seg.delete("1")
+    assert seg.live_doc_count == 2
+    merged = merge_segments(m, [seg], "m0")
+    assert merged.num_docs == 2
+    assert merged.get_term("body", "beta") is None
+    assert merged.get_term("body", "alpha").doc_freq == 1
+
+
+def test_multi_value_and_arrays():
+    _, seg = build([
+        {"tag": ["x", "y", "x"], "views": [1, 2]},
+    ])
+    tags = seg.ordinal_dv["tag"]
+    assert len(tags.ords) == 3          # all values kept for aggs
+    views = seg.numeric_dv["views"]
+    assert list(views.values) == [1.0, 2.0]
+    assert views.counts[0] == 2
+
+
+def test_mapping_dict_roundtrip():
+    m = MapperService(MAPPING)
+    rendered = m.mapping_dict()
+    assert rendered["properties"]["title"]["type"] == "text"
+    assert rendered["properties"]["title"]["fields"]["keyword"]["type"] == "keyword"
+    m2 = MapperService({"mappings": rendered})
+    assert m2.get_field("embedding").dims == 4

@@ -1,0 +1,75 @@
+"""Settings system tests (reference contract: common/settings/SettingTests.java style)."""
+
+import pytest
+
+from opensearch_tpu.common.errors import IllegalArgumentError, SettingsError
+from opensearch_tpu.common.settings import (
+    Property, ScopedSettings, Setting, Settings, parse_byte_size, parse_time_value)
+
+
+def test_flattening_and_nested_roundtrip():
+    s = Settings({"index": {"number_of_shards": 4, "analysis": {"analyzer": {"a": {"type": "standard"}}}}})
+    assert s.raw("index.number_of_shards") == 4
+    nested = s.as_nested_dict()
+    assert nested["index"]["number_of_shards"] == 4
+    assert nested["index"]["analysis"]["analyzer"]["a"]["type"] == "standard"
+
+
+def test_typed_accessors():
+    s = Settings({"a": "5", "b": "true", "c": "1.5", "d": "x,y , z"})
+    assert s.get_as_int("a") == 5
+    assert s.get_as_bool("b") is True
+    assert s.get_as_float("c") == 1.5
+    assert s.get_as_list("d") == ["x", "y", "z"]
+    assert s.get_as_int("missing", 7) == 7
+
+
+def test_time_and_byte_parsing():
+    assert parse_time_value("30s") == 30.0
+    assert parse_time_value("5m") == 300.0
+    assert parse_time_value("100ms") == pytest.approx(0.1)
+    assert parse_byte_size("1kb") == 1024
+    assert parse_byte_size("2mb") == 2 * 1024 ** 2
+    with pytest.raises(SettingsError):
+        parse_time_value("5 parsecs", "k")
+
+
+def test_int_setting_bounds():
+    shards = Setting.int_setting("index.number_of_shards", 1, min_value=1, max_value=1024)
+    assert shards.get(Settings({"index.number_of_shards": "8"})) == 8
+    assert shards.get(Settings.EMPTY) == 1
+    with pytest.raises(SettingsError):
+        shards.get(Settings({"index.number_of_shards": "0"}))
+
+
+def test_derived_default():
+    a = Setting.int_setting("a", 2)
+    b = Setting("b", lambda s: a.get(s) * 2, int)
+    assert b.get(Settings.EMPTY) == 4
+    assert b.get(Settings({"a": 5})) == 10
+    assert b.get(Settings({"b": 3})) == 3
+
+
+def test_scoped_settings_rejects_unknown_and_applies_dynamic():
+    dyn = Setting.int_setting("cluster.max_x", 10, properties=Property.NODE_SCOPE | Property.DYNAMIC)
+    static = Setting.int_setting("cluster.static_y", 1)
+    scoped = ScopedSettings(Settings.EMPTY, [dyn, static])
+    with pytest.raises(IllegalArgumentError, match="unknown setting"):
+        scoped.validate(Settings({"cluster.nope": 1}))
+    seen = []
+    scoped.add_settings_update_consumer(dyn, seen.append)
+    scoped.apply_update(Settings({"cluster.max_x": 42}))
+    assert seen == [42]
+    assert dyn.get(scoped.current) == 42
+    with pytest.raises(IllegalArgumentError):
+        scoped.apply_update(Settings({"cluster.static_y": 9}))
+    with pytest.raises(IllegalArgumentError):
+        scoped.add_settings_update_consumer(static, seen.append)
+
+
+def test_merge_and_null_removal():
+    base = Settings({"a": 1, "b": 2})
+    merged = base.merge({"b": None, "c": 3})
+    assert merged.raw("a") == 1
+    assert merged.raw("b") is None
+    assert merged.raw("c") == 3
