@@ -107,11 +107,20 @@ class FieldStats:
 
 @dataclass
 class DocValuesColumn:
-    """Value-pair doc values for one field: sorted (doc, value) pairs."""
+    """Value-pair doc values for one field: sorted (doc, value) pairs.
+
+    `value_ords` rank-encodes each value into `unique` (sorted distinct f64s).
+    Device kernels only ever see int32 ranks — range bounds are converted to
+    rank space host-side via searchsorted, keeping comparisons exact without
+    f64 emulation on TPU. `unique` stays host-side; a float32 copy is uploaded
+    for metric aggregations.
+    """
     doc_ids: np.ndarray      # int32 [NV]
-    values: np.ndarray       # float64 [NV] (numeric domain or ordinal as float? no - ords separate)
-    exists: np.ndarray       # bool [D]
+    values: np.ndarray       # float64 [NV] exact values (host only)
+    exists: np.ndarray      # bool [D]
     counts: np.ndarray       # int32 [D] values per doc
+    value_ords: np.ndarray   # int32 [NV] rank into `unique`
+    unique: np.ndarray       # float64 [U] sorted distinct values (host)
 
 
 @dataclass
@@ -141,7 +150,8 @@ class Segment:
                  field_stats: Dict[str, FieldStats],
                  numeric_dv: Dict[str, DocValuesColumn],
                  ordinal_dv: Dict[str, OrdinalsColumn],
-                 vector_dv: Dict[str, VectorColumn]):
+                 vector_dv: Dict[str, VectorColumn],
+                 positions: Optional[Dict[Tuple[str, str], List[np.ndarray]]] = None):
         self.seg_id = seg_id
         self.num_docs = num_docs
         self.doc_ids = doc_ids              # _id per local doc ord
@@ -154,6 +164,10 @@ class Segment:
         self.numeric_dv = numeric_dv
         self.ordinal_dv = ordinal_dv
         self.vector_dv = vector_dv
+        # host-only term positions per (field, term), lists parallel to the
+        # postings entries — consumed by the phrase-query host verifier
+        # (reference: Lucene's .pos files feeding PhraseQuery's ExactPhraseMatcher)
+        self.positions = positions or {}
         self.live = np.ones(num_docs, dtype=bool)  # deletes bitmap
         self._id_to_ord = {d: i for i, d in enumerate(doc_ids)}
 
@@ -177,6 +191,23 @@ class Segment:
     def get_term(self, field: str, term: str) -> Optional[TermMeta]:
         return self.term_dict.get((field, term))
 
+    def _positions_for(self, field: str, term: str) -> Optional[Dict[int, np.ndarray]]:
+        """doc ord → positions array for one term (host phrase matching)."""
+        key = (field, term)
+        pos_lists = self.positions.get(key)
+        meta = self.term_dict.get(key)
+        if pos_lists is None or meta is None:
+            return None
+        cache = getattr(self, "_pos_cache", None)
+        if cache is None:
+            cache = self._pos_cache = {}
+        if key not in cache:
+            docs = self.post_docs[
+                meta.start_block:meta.start_block + meta.num_blocks].ravel()
+            docs = docs[docs >= 0]
+            cache[key] = {int(d): pos_lists[i] for i, d in enumerate(docs)}
+        return cache[key]
+
     def terms_for_field(self, field: str) -> List[str]:
         return [t for (f, t) in self.term_dict if f == field]
 
@@ -185,11 +216,16 @@ class Segment:
         for arr in self.norms.values():
             total += arr.nbytes
         for col in self.numeric_dv.values():
-            total += col.doc_ids.nbytes + col.values.nbytes + col.exists.nbytes
+            total += (col.doc_ids.nbytes + col.values.nbytes + col.exists.nbytes
+                      + col.counts.nbytes + col.value_ords.nbytes
+                      + col.unique.nbytes)
         for col in self.ordinal_dv.values():
-            total += col.doc_ids.nbytes + col.ords.nbytes + col.exists.nbytes
+            total += (col.doc_ids.nbytes + col.ords.nbytes + col.exists.nbytes
+                      + col.ord_hashes.nbytes)
         for col in self.vector_dv.values():
-            total += col.vectors.nbytes
+            total += col.vectors.nbytes + col.exists.nbytes
+        for pos_lists in self.positions.values():
+            total += sum(p.nbytes for p in pos_lists)
         return total
 
 
@@ -214,8 +250,9 @@ class SegmentBuilder:
         self.seg_id = seg_id
         self.doc_ids: List[str] = []
         self.sources: List[Optional[dict]] = []
-        # (field, term) → {doc_ord: tf} accumulated in insertion doc order
+        # (field, term) → [(doc_ord, tf)] accumulated in insertion doc order
         self._postings: Dict[Tuple[str, str], List[Tuple[int, int]]] = {}
+        self._positions: Dict[Tuple[str, str], List[np.ndarray]] = {}
         self._field_lengths: Dict[str, Dict[int, int]] = {}
         self._numeric: Dict[str, List[Tuple[int, float]]] = {}
         self._ordinal_raw: Dict[str, List[Tuple[int, str]]] = {}
@@ -239,10 +276,14 @@ class SegmentBuilder:
                 continue
             if pf.terms is not None and ft.index:
                 tf_map: Dict[str, int] = {}
-                for term, _pos in pf.terms:
+                pos_map: Dict[str, List[int]] = {}
+                for term, pos in pf.terms:
                     tf_map[term] = tf_map.get(term, 0) + 1
+                    pos_map.setdefault(term, []).append(pos)
                 for term, tf in tf_map.items():
                     self._postings.setdefault((field, term), []).append((ord_, tf))
+                    self._positions.setdefault((field, term), []).append(
+                        np.asarray(sorted(pos_map[term]), dtype=np.int32))
                 self._field_lengths.setdefault(field, {})[ord_] = pf.length
                 stats = self._field_stats.setdefault(field, FieldStats())
                 stats.doc_count += 1
@@ -315,9 +356,12 @@ class SegmentBuilder:
             doc_arr = np.fromiter((d for d, _ in pairs), dtype=np.int32, count=len(pairs))
             val_arr = np.fromiter((v for _, v in pairs), dtype=np.float64, count=len(pairs))
             exists = np.zeros(n_docs, dtype=bool)
-            exists[doc_arr] = True
+            if len(doc_arr):
+                exists[doc_arr] = True
             counts = np.bincount(doc_arr, minlength=n_docs).astype(np.int32)
-            numeric_dv[field] = DocValuesColumn(doc_arr, val_arr, exists, counts)
+            unique, value_ords = np.unique(val_arr, return_inverse=True)
+            numeric_dv[field] = DocValuesColumn(doc_arr, val_arr, exists, counts,
+                                                value_ords.astype(np.int32), unique)
 
         # ---- ordinal doc values: sorted dictionary, (doc, ord) pairs
         ordinal_dv: Dict[str, OrdinalsColumn] = {}
@@ -348,7 +392,8 @@ class SegmentBuilder:
 
         return Segment(self.seg_id, n_docs, list(self.doc_ids), list(self.sources),
                        term_dict, post_docs, post_tf, norms, self._field_stats,
-                       numeric_dv, ordinal_dv, vector_dv)
+                       numeric_dv, ordinal_dv, vector_dv,
+                       positions=dict(self._positions))
 
 
 def merge_segments(mapper: MapperService, segments: List[Segment],

@@ -1,0 +1,156 @@
+"""Device-resident segment: the HBM image of a sealed columnar segment.
+
+This is the TPU analog of Lucene's on-heap/off-heap segment readers
+(reference: the SegmentReader/LeafReaderContext machinery consumed by
+search/internal/ContextIndexSearcher.java). All arrays are padded to
+power-of-two buckets so differently-sized segments reuse the same compiled
+executable (XLA recompiles per shape — bucketing bounds the compile count).
+
+Layout:
+- `post_docs`/`post_tf`: the global blocked postings matrices `[NBp, 128]`.
+- `norms`: stacked `[F, Dp]` uint8 SmallFloat norms, one row per indexed text
+  field (row index assigned in `DeviceSegmentMeta.norm_rows`).
+- numeric doc values per field: `(doc_ids, val_ords, values_f32)` value-pair
+  arrays (pad doc_id = -1) + dense `exists`, `min_rank`/`max_rank` per doc for
+  sorting and can-match pruning.
+- ordinal (keyword) doc values per field: `(doc_ids, ords)` pairs + `exists`.
+- vectors per field: dense `[Dp, dims]` float32.
+- `live`: deletion bitmap, AND-ed into every match mask.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from opensearch_tpu.index.segment import LENGTH_TABLE, Segment, pad_bucket
+
+INT32_MAX = np.int32(2 ** 31 - 1)
+
+
+@dataclass(frozen=True)
+class DeviceSegmentMeta:
+    """Static (hashable) shape/layout info — safe to close over in jit."""
+    seg_id: str
+    num_docs: int
+    d_pad: int
+    nb_pad: int
+    norm_rows: Tuple[Tuple[str, int], ...]   # field → row in norms stack
+    numeric_fields: Tuple[str, ...]
+    ordinal_fields: Tuple[str, ...]
+    vector_fields: Tuple[str, ...]
+
+    def norm_row(self, field: str) -> Optional[int]:
+        for f, r in self.norm_rows:
+            if f == field:
+                return r
+        return None
+
+
+def upload_segment(seg: Segment, to_device: bool = True):
+    """Build the device pytree (dict of jnp arrays) + static meta for a segment."""
+    d_pad = pad_bucket(max(seg.num_docs, 1))
+    nb = seg.post_docs.shape[0]
+    nb_pad = pad_bucket(nb, minimum=8)
+
+    post_docs = np.full((nb_pad, seg.post_docs.shape[1]), -1, dtype=np.int32)
+    post_docs[:nb] = seg.post_docs
+    post_tf = np.zeros((nb_pad, seg.post_tf.shape[1]), dtype=np.float32)
+    post_tf[:nb] = seg.post_tf
+
+    norm_fields = sorted(seg.norms.keys())
+    norms = np.zeros((max(len(norm_fields), 1), d_pad), dtype=np.int32)
+    for row, fname in enumerate(norm_fields):
+        norms[row, :seg.num_docs] = seg.norms[fname]
+
+    live = np.zeros(d_pad, dtype=bool)
+    live[:seg.num_docs] = seg.live
+
+    arrays: Dict = {
+        "post_docs": post_docs,
+        "post_tf": post_tf,
+        "norms": norms,
+        "length_table": LENGTH_TABLE,
+        "live": live,
+        "numeric": {},
+        "ordinal": {},
+        "vector": {},
+    }
+
+    for fname, col in seg.numeric_dv.items():
+        nv_pad = pad_bucket(max(len(col.doc_ids), 1))
+        doc_ids = np.full(nv_pad, -1, dtype=np.int32)
+        doc_ids[:len(col.doc_ids)] = col.doc_ids
+        val_ords = np.zeros(nv_pad, dtype=np.int32)
+        val_ords[:len(col.doc_ids)] = col.value_ords
+        values_f32 = np.zeros(nv_pad, dtype=np.float32)
+        values_f32[:len(col.doc_ids)] = col.values.astype(np.float32)
+        exists = np.zeros(d_pad, dtype=bool)
+        exists[:seg.num_docs] = col.exists
+        min_rank = np.full(d_pad, INT32_MAX, dtype=np.int32)
+        max_rank = np.full(d_pad, -1, dtype=np.int32)
+        if len(col.doc_ids):
+            np.minimum.at(min_rank, col.doc_ids, col.value_ords)
+            np.maximum.at(max_rank, col.doc_ids, col.value_ords)
+        # rank → value decode table (f32) for device-side metric aggregations
+        u_pad = pad_bucket(max(len(col.unique), 1), minimum=8)
+        unique_f32 = np.zeros(u_pad, dtype=np.float32)
+        unique_f32[:len(col.unique)] = col.unique.astype(np.float32)
+        arrays["numeric"][fname] = {
+            "doc_ids": doc_ids, "val_ords": val_ords, "values_f32": values_f32,
+            "exists": exists, "min_rank": min_rank, "max_rank": max_rank,
+            "unique_f32": unique_f32,
+        }
+
+    for fname, col in seg.ordinal_dv.items():
+        nv_pad = pad_bucket(max(len(col.doc_ids), 1))
+        doc_ids = np.full(nv_pad, -1, dtype=np.int32)
+        doc_ids[:len(col.doc_ids)] = col.doc_ids
+        ords = np.zeros(nv_pad, dtype=np.int32)
+        ords[:len(col.doc_ids)] = col.ords
+        exists = np.zeros(d_pad, dtype=bool)
+        exists[:seg.num_docs] = col.exists
+        arrays["ordinal"][fname] = {
+            "doc_ids": doc_ids, "ords": ords, "exists": exists,
+        }
+
+    for fname, col in seg.vector_dv.items():
+        vecs = np.zeros((d_pad, col.vectors.shape[1]), dtype=np.float32)
+        vecs[:seg.num_docs] = col.vectors
+        exists = np.zeros(d_pad, dtype=bool)
+        exists[:seg.num_docs] = col.exists
+        arrays["vector"][fname] = {"vectors": vecs, "exists": exists}
+
+    if to_device:
+        arrays = _tree_to_jnp(arrays)
+
+    meta = DeviceSegmentMeta(
+        seg_id=seg.seg_id,
+        num_docs=seg.num_docs,
+        d_pad=d_pad,
+        nb_pad=nb_pad,
+        norm_rows=tuple((f, i) for i, f in enumerate(norm_fields)),
+        numeric_fields=tuple(sorted(seg.numeric_dv.keys())),
+        ordinal_fields=tuple(sorted(seg.ordinal_dv.keys())),
+        vector_fields=tuple(sorted(seg.vector_dv.keys())),
+    )
+    return arrays, meta
+
+
+def _tree_to_jnp(tree):
+    if isinstance(tree, dict):
+        return {k: _tree_to_jnp(v) for k, v in tree.items()}
+    return jnp.asarray(tree)
+
+
+def refresh_live(arrays: Dict, seg: Segment):
+    """Re-upload just the liveness bitmap after deletes."""
+    d_pad = arrays["live"].shape[0]
+    live = np.zeros(d_pad, dtype=bool)
+    live[:seg.num_docs] = seg.live
+    arrays["live"] = jnp.asarray(live) if isinstance(arrays["post_docs"], jnp.ndarray) \
+        else live
+    return arrays

@@ -1,0 +1,11 @@
+"""Top-k collection constants and helpers.
+
+Replaces Lucene's TopScoreDocCollector / priority-queue per segment
+(reference: search/query/TopDocsCollectorContext.java) with `lax.top_k` over a
+dense masked key vector — the selection itself lives in the executor's jitted
+program (search/executor.py _runner) so it fuses with plan evaluation.
+Lucene's tie-break contract (score desc, then doc id asc) is finished on the
+host over the over-fetched candidate set.
+"""
+
+NEG_INF = float("-inf")

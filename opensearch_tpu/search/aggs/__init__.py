@@ -1,0 +1,1 @@
+from opensearch_tpu.search.aggs.parse import parse_aggs  # noqa: F401

@@ -1,0 +1,612 @@
+"""Aggregation engine: builder tree → device collection program → partials.
+
+The TPU re-design of the reference's Aggregator/LeafBucketCollector machinery
+(search/aggregations/Aggregator.java:60, BucketsAggregator.java:70
+collectBucket, 491 files of per-doc collector loops): instead of walking docs
+one at a time, every bucket aggregation becomes
+
+    bucket_of_rank (host lookup table over the field's sorted unique values)
+    → device gather over the (doc, value-rank) pairs
+    → masked scatter-add (segment-sum) into flat [parent_card * own_card] bins
+
+and every metric aggregation a set of masked scatter reductions (sum / count /
+min / max / sum-of-squares) keyed by the parent's bucket ordinal. Nesting uses
+the classic flattened-ordinal trick (parent_ord * child_card + child_ord),
+like the reference's bucketOrd composition.
+
+Approximation policy: the reference uses TDigest percentiles and HLL++
+cardinality; here both are EXACT, computed from per-bucket value-rank
+histograms / presence bitmaps (feasible because doc values are rank-encoded
+per segment), merged on the host by value.
+
+The compiled structure is static per (agg tree, segment); partial arrays are
+merged across segments/shards host-side by bucket key (reference analog:
+InternalAggregation.reduce, search/aggregations/InternalAggregation.java:64).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from opensearch_tpu.common.errors import (
+    IllegalArgumentError, ParsingError, QueryShardError)
+from opensearch_tpu.index.mapper import MapperService, format_date_millis, parse_date_millis
+from opensearch_tpu.index.segment import Segment, pad_bucket
+from opensearch_tpu.search import dsl
+from opensearch_tpu.search.aggs.parse import AggNode
+from opensearch_tpu.search.compile import Compiler, Plan, _resolve_date_math
+from opensearch_tpu.search.plan_eval import _eval_plan
+
+MAX_AGG_BINS = 1 << 24  # guard for presence/histogram bitmaps
+POS_INF = np.float32(np.inf)
+NEG_INF = np.float32(-np.inf)
+
+# calendar interval lengths used for fixed bucketing (calendar-aware month/
+# year boundaries are generated host-side as explicit boundary arrays)
+_FIXED_MS = {"ms": 1, "1ms": 1, "s": 1000, "1s": 1000, "second": 1000,
+             "m": 60000, "1m": 60000, "minute": 60000,
+             "h": 3600000, "1h": 3600000, "hour": 3600000,
+             "d": 86400000, "1d": 86400000, "day": 86400000,
+             "w": 604800000, "1w": 604800000, "week": 604800000}
+
+
+@dataclass
+class AggPlan:
+    """Compiled aggregation node for one segment."""
+    name: str
+    kind: str
+    static: tuple = ()
+    inputs: Dict[str, np.ndarray] = dc_field(default_factory=dict)
+    children: List["AggPlan"] = dc_field(default_factory=list)
+    query_plan: Optional[Plan] = None      # filter aggs
+    render: Dict[str, Any] = dc_field(default_factory=dict)  # host-only
+
+    def sig(self):
+        return (self.kind, self.static,
+                tuple(sorted((k, v.shape, str(v.dtype))
+                             for k, v in self.inputs.items())),
+                self.query_plan.sig() if self.query_plan is not None else None,
+                tuple(c.sig() for c in self.children))
+
+    def flatten_inputs(self, out):
+        out.append(self.inputs)
+        if self.query_plan is not None:
+            self.query_plan.flatten_inputs(out)
+        for c in self.children:
+            c.flatten_inputs(out)
+        return out
+
+
+@dataclass(frozen=True)
+class _Ctx:
+    mapper: MapperService
+    seg: Segment
+    meta: Any
+    compiler: Compiler
+    d_pad: int
+
+
+def compile_aggs(nodes: List[AggNode], mapper: MapperService, seg: Segment,
+                 meta, compiler: Compiler) -> List[AggPlan]:
+    ctx = _Ctx(mapper, seg, meta, compiler, pad_bucket(max(seg.num_docs, 1)))
+    return [_compile_node(n, ctx) for n in nodes]
+
+
+def _num_col(ctx: _Ctx, field: str):
+    return ctx.seg.numeric_dv.get(field)
+
+
+def _bucket_lookup_plan(node: AggNode, ctx: _Ctx, kind: str,
+                        bucket_of_rank: np.ndarray, card: int,
+                        render: dict, children_card_mult: bool = True) -> AggPlan:
+    u_pad = pad_bucket(max(len(bucket_of_rank), 1), minimum=8)
+    table = np.full(u_pad, -1, dtype=np.int32)
+    table[:len(bucket_of_rank)] = bucket_of_rank
+    children = [_compile_node(c, ctx) for c in node.children]
+    return AggPlan(name=node.name, kind=kind,
+                   static=(node.field, card),
+                   inputs={"table": table},
+                   children=children, render=render)
+
+
+def _compile_node(node: AggNode, ctx: _Ctx) -> AggPlan:
+    fn = _COMPILERS.get(node.type)
+    if fn is None:
+        raise QueryShardError(f"aggregation type [{node.type}] is not supported")
+    return fn(node, ctx)
+
+
+# ----------------------------------------------------------------- buckets
+
+def _c_terms(node: AggNode, ctx: _Ctx) -> AggPlan:
+    field = node.field
+    if field is None:
+        raise ParsingError(f"[terms] aggregation [{node.name}] requires a field")
+    ocol = ctx.seg.ordinal_dv.get(field)
+    if ocol is not None:
+        card = max(len(ocol.dictionary), 1)
+        children = [_compile_node(c, ctx) for c in node.children]
+        return AggPlan(node.name, "bucket_ord", static=(field, card),
+                       children=children,
+                       render={"keys": list(ocol.dictionary), "body": node.body,
+                               "kind": "terms"})
+    col = _num_col(ctx, field)
+    if col is None:
+        return AggPlan(node.name, "empty", render={"body": node.body,
+                                                   "kind": "terms", "keys": []})
+    card = max(len(col.unique), 1)
+    bucket_of_rank = np.arange(len(col.unique), dtype=np.int32)
+    ft = ctx.mapper.get_field(field)
+    keys = [_render_numeric_key(v, ft) for v in col.unique]
+    return _bucket_lookup_plan(node, ctx, "bucket_num", bucket_of_rank, card,
+                               render={"keys": keys, "body": node.body,
+                                       "kind": "terms"})
+
+
+def _render_numeric_key(v: float, ft) -> Any:
+    if ft is not None and ft.is_bool:
+        return bool(v)
+    if ft is not None and ft.is_date:
+        return int(v)
+    return int(v) if float(v).is_integer() else float(v)
+
+
+def _c_histogram(node: AggNode, ctx: _Ctx) -> AggPlan:
+    field = node.field
+    interval = node.body.get("interval")
+    if not field or not interval:
+        raise ParsingError("[histogram] requires [field] and [interval]")
+    interval = float(interval)
+    if interval <= 0:
+        raise ParsingError("[interval] must be > 0")
+    offset = float(node.body.get("offset", 0.0))
+    col = _num_col(ctx, field)
+    if col is None or len(col.unique) == 0:
+        return AggPlan(node.name, "empty",
+                       render={"body": node.body, "kind": "histogram",
+                               "interval": interval, "offset": offset, "keys": []})
+    lo_key = np.floor((col.unique[0] - offset) / interval)
+    buckets = np.floor((col.unique - offset) / interval) - lo_key
+    card = int(buckets[-1]) + 1
+    keys = [float(lo_key + i) * interval + offset for i in range(card)]
+    return _bucket_lookup_plan(node, ctx, "bucket_num",
+                               buckets.astype(np.int32), card,
+                               render={"keys": keys, "body": node.body,
+                                       "kind": "histogram"})
+
+
+def _calendar_boundaries(lo_ms: float, hi_ms: float, unit: str) -> List[int]:
+    """Host-generated calendar-aware bucket boundaries (month/quarter/year)."""
+    import datetime as _dt
+    start = _dt.datetime.fromtimestamp(lo_ms / 1000.0, tz=_dt.timezone.utc)
+    out = []
+    if unit in ("M", "1M", "month"):
+        cur = start.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
+        step_months = 1
+    elif unit in ("q", "1q", "quarter"):
+        cur = start.replace(month=((start.month - 1) // 3) * 3 + 1, day=1,
+                            hour=0, minute=0, second=0, microsecond=0)
+        step_months = 3
+    else:  # year
+        cur = start.replace(month=1, day=1, hour=0, minute=0, second=0,
+                            microsecond=0)
+        step_months = 12
+    while cur.timestamp() * 1000 <= hi_ms:
+        out.append(int(cur.timestamp() * 1000))
+        month = cur.month - 1 + step_months
+        cur = cur.replace(year=cur.year + month // 12, month=month % 12 + 1)
+    out.append(int(cur.timestamp() * 1000))
+    return out
+
+
+def _c_date_histogram(node: AggNode, ctx: _Ctx) -> AggPlan:
+    field = node.field
+    interval = (node.body.get("calendar_interval")
+                or node.body.get("fixed_interval")
+                or node.body.get("interval"))
+    if not field or not interval:
+        raise ParsingError("[date_histogram] requires [field] and an interval")
+    col = _num_col(ctx, field)
+    empty_render = {"body": node.body, "kind": "date_histogram",
+                    "keys": [], "interval": interval}
+    if col is None or len(col.unique) == 0:
+        return AggPlan(node.name, "empty", render=empty_render)
+    unit = str(interval)
+    if unit in _FIXED_MS or (unit[:-1].isdigit() and unit[-1] in "smhdw"):
+        if unit in _FIXED_MS:
+            step = _FIXED_MS[unit]
+        else:
+            step = int(unit[:-1]) * _FIXED_MS[unit[-1]]
+        lo_key = int(col.unique[0] // step)
+        buckets = (col.unique // step).astype(np.int64) - lo_key
+        card = int(buckets[-1]) + 1
+        keys = [(lo_key + i) * step for i in range(card)]
+    else:
+        bounds = _calendar_boundaries(float(col.unique[0]), float(col.unique[-1]),
+                                      unit)
+        buckets = np.searchsorted(np.asarray(bounds, dtype=np.float64),
+                                  col.unique, side="right") - 1
+        card = len(bounds) - 1
+        keys = bounds[:-1]
+        return _bucket_lookup_plan(node, ctx, "bucket_num",
+                                   buckets.astype(np.int32), card,
+                                   render={"keys": keys, "body": node.body,
+                                           "kind": "date_histogram",
+                                           "calendar": True})
+    return _bucket_lookup_plan(node, ctx, "bucket_num",
+                               buckets.astype(np.int32), card,
+                               render={"keys": keys, "body": node.body,
+                                       "kind": "date_histogram"})
+
+
+def _c_range(node: AggNode, ctx: _Ctx) -> AggPlan:
+    field = node.field
+    ranges = node.body.get("ranges")
+    if not field or not ranges:
+        raise ParsingError("[range] aggregation requires [field] and [ranges]")
+    ft = ctx.mapper.get_field(field)
+    col = _num_col(ctx, field)
+    is_date = node.type == "date_range" or (ft is not None and ft.is_date)
+
+    def conv(v):
+        if v is None:
+            return None
+        if is_date and isinstance(v, str):
+            v = _resolve_date_math(v)
+            return float(parse_date_millis(v) if isinstance(v, str) else v)
+        return float(ft.to_comparable(v)) if ft is not None else float(v)
+
+    specs = []
+    for r in ranges:
+        frm, to = conv(r.get("from")), conv(r.get("to"))
+        key = r.get("key")
+        if key is None:
+            f_str = "*" if frm is None else (
+                format_date_millis(int(frm)) if is_date else _fmt_num(frm))
+            t_str = "*" if to is None else (
+                format_date_millis(int(to)) if is_date else _fmt_num(to))
+            key = f"{f_str}-{t_str}"
+        specs.append((key, frm, to))
+    render = {"kind": node.type, "specs": specs, "body": node.body,
+              "is_date": is_date}
+    if col is None or len(col.unique) == 0:
+        return AggPlan(node.name, "empty", render=render)
+    # ranges can overlap → one sub-plan slot per range (card = len ranges),
+    # membership computed per range via rank-interval table
+    u = col.unique
+    sub_plans = []
+    for i, (_, frm, to) in enumerate(specs):
+        lo = 0 if frm is None else int(np.searchsorted(u, frm, "left"))
+        hi = len(u) if to is None else int(np.searchsorted(u, to, "left"))
+        u_pad = pad_bucket(max(len(u), 1), minimum=8)
+        table = np.full(u_pad, -1, dtype=np.int32)
+        table[lo:hi] = 0
+        sub_plans.append(AggPlan(f"{node.name}#{i}", "bucket_num",
+                                 static=(field, 1), inputs={"table": table},
+                                 children=[_compile_node(c, ctx)
+                                           for c in node.children]))
+    return AggPlan(node.name, "multi", static=(len(sub_plans),),
+                   children=sub_plans, render=render)
+
+
+def _fmt_num(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else str(v)
+
+
+def _c_filter(node: AggNode, ctx: _Ctx) -> AggPlan:
+    qnode = dsl.parse_query(node.body if node.body else {"match_all": {}})
+    qplan = ctx.compiler.compile(qnode, ctx.seg, ctx.meta)
+    children = [_compile_node(c, ctx) for c in node.children]
+    return AggPlan(node.name, "filter", query_plan=qplan, children=children,
+                   render={"kind": "filter"})
+
+
+def _c_filters(node: AggNode, ctx: _Ctx) -> AggPlan:
+    filters = node.body.get("filters")
+    if filters is None:
+        raise ParsingError("[filters] aggregation requires [filters]")
+    if isinstance(filters, dict):
+        names = list(filters.keys())
+        queries = [filters[n] for n in names]
+        keyed = True
+    else:
+        names = [str(i) for i in range(len(filters))]
+        queries = list(filters)
+        keyed = False
+    subs = []
+    for n, q in zip(names, queries):
+        qplan = ctx.compiler.compile(dsl.parse_query(q), ctx.seg, ctx.meta)
+        subs.append(AggPlan(n, "filter", query_plan=qplan,
+                            children=[_compile_node(c, ctx)
+                                      for c in node.children]))
+    return AggPlan(node.name, "multi", static=(len(subs),), children=subs,
+                   render={"kind": "filters", "names": names, "keyed": keyed})
+
+
+def _c_global(node: AggNode, ctx: _Ctx) -> AggPlan:
+    children = [_compile_node(c, ctx) for c in node.children]
+    return AggPlan(node.name, "global", children=children,
+                   render={"kind": "global"})
+
+
+def _c_missing(node: AggNode, ctx: _Ctx) -> AggPlan:
+    field = node.field
+    if field is None:
+        raise ParsingError("[missing] aggregation requires a field")
+    if field in ctx.seg.numeric_dv:
+        static = ("numeric", field)
+    elif field in ctx.seg.ordinal_dv:
+        static = ("ordinal", field)
+    elif field in ctx.seg.vector_dv:
+        static = ("vector", field)
+    else:
+        static = ("none", field)
+    children = [_compile_node(c, ctx) for c in node.children]
+    return AggPlan(node.name, "missing", static=static, children=children,
+                   render={"kind": "missing"})
+
+
+# ----------------------------------------------------------------- metrics
+
+def _c_metric(node: AggNode, ctx: _Ctx) -> AggPlan:
+    field = node.field
+    if field is None:
+        raise ParsingError(f"[{node.type}] aggregation [{node.name}] requires "
+                           f"a field")
+    render = {"kind": node.type, "body": node.body}
+    if field in ctx.seg.numeric_dv:
+        ft = ctx.mapper.get_field(field)
+        render["is_date"] = bool(ft is not None and ft.is_date)
+        return AggPlan(node.name, "metric_num", static=(field,), render=render)
+    if field in ctx.seg.ordinal_dv and node.type == "value_count":
+        return AggPlan(node.name, "count_ord", static=(field,), render=render)
+    return AggPlan(node.name, "empty", render=render)
+
+
+def _c_cardinality(node: AggNode, ctx: _Ctx) -> AggPlan:
+    field = node.field
+    if field is None:
+        raise ParsingError("[cardinality] aggregation requires a field")
+    render = {"kind": "cardinality", "body": node.body}
+    if field in ctx.seg.ordinal_dv:
+        card = len(ctx.seg.ordinal_dv[field].dictionary)
+        render["keys"] = list(ctx.seg.ordinal_dv[field].dictionary)
+        return AggPlan(node.name, "presence_ord", static=(field, max(card, 1)),
+                       render=render)
+    if field in ctx.seg.numeric_dv:
+        u = ctx.seg.numeric_dv[field].unique
+        render["values"] = u
+        return AggPlan(node.name, "presence_num", static=(field, max(len(u), 1)),
+                       render=render)
+    return AggPlan(node.name, "empty", render=render)
+
+
+def _c_percentiles(node: AggNode, ctx: _Ctx) -> AggPlan:
+    field = node.field
+    if field is None:
+        raise ParsingError(f"[{node.type}] aggregation requires a field")
+    render = {"kind": node.type, "body": node.body}
+    if field in ctx.seg.numeric_dv:
+        u = ctx.seg.numeric_dv[field].unique
+        render["values"] = u
+        return AggPlan(node.name, "value_hist", static=(field, max(len(u), 1)),
+                       render=render)
+    return AggPlan(node.name, "empty", render=render)
+
+
+def _c_weighted_avg(node: AggNode, ctx: _Ctx) -> AggPlan:
+    vspec = node.body.get("value", {})
+    wspec = node.body.get("weight", {})
+    vf, wf = vspec.get("field"), wspec.get("field")
+    if not vf or not wf:
+        raise ParsingError("[weighted_avg] requires value.field and weight.field")
+    render = {"kind": "weighted_avg", "body": node.body}
+    if vf in ctx.seg.numeric_dv and wf in ctx.seg.numeric_dv:
+        return AggPlan(node.name, "weighted_avg", static=(vf, wf), render=render)
+    return AggPlan(node.name, "empty", render=render)
+
+
+_COMPILERS = {
+    "terms": _c_terms,
+    "histogram": _c_histogram,
+    "date_histogram": _c_date_histogram,
+    "range": _c_range,
+    "date_range": _c_range,
+    "ip_range": _c_range,
+    "filter": _c_filter,
+    "filters": _c_filters,
+    "global": _c_global,
+    "missing": _c_missing,
+    "min": _c_metric, "max": _c_metric, "sum": _c_metric, "avg": _c_metric,
+    "value_count": _c_metric, "stats": _c_metric, "extended_stats": _c_metric,
+    "median_absolute_deviation": _c_percentiles,
+    "cardinality": _c_cardinality,
+    "percentiles": _c_percentiles,
+    "percentile_ranks": _c_percentiles,
+    "weighted_avg": _c_weighted_avg,
+}
+
+
+# ---------------------------------------------------------------- device eval
+
+def eval_aggs(plans: List[AggPlan], seg: Dict, inputs: List[Dict],
+              cursor: List[int], mask, parent_eff, parent_card: int,
+              outs: List):
+    """Trace the collection program. mask: eligible docs [Dp] bool.
+    parent_eff: [Dp] int32 doc → parent bucket ordinal (-1 = none).
+    Appends each node's partial arrays dict to `outs` in traversal order."""
+    for plan in plans:
+        _eval_agg(plan, seg, inputs, cursor, mask, parent_eff, parent_card, outs)
+
+
+def _pairs_context(seg, col, mask, parent_eff, d_pad):
+    doc_ids = col["doc_ids"]
+    valid = doc_ids >= 0
+    safe_doc = jnp.where(valid, doc_ids, 0)
+    ok = valid & mask[safe_doc]
+    parent = parent_eff[safe_doc]
+    return safe_doc, ok & (parent >= 0), parent
+
+
+def _eval_agg(plan: AggPlan, seg: Dict, inputs: List[Dict], cursor: List[int],
+              mask, parent_eff, parent_card: int, outs: List):
+    my = inputs[cursor[0]]
+    cursor[0] += 1
+    d_pad = seg["live"].shape[0]
+    kind = plan.kind
+
+    if kind == "empty":
+        outs.append({})
+        for c in plan.children:
+            _eval_agg(c, seg, inputs, cursor, mask,
+                      jnp.full(d_pad, -1, jnp.int32), parent_card, outs)
+        return
+
+    if kind == "multi":
+        outs.append({})
+        for c in plan.children:
+            _eval_agg(c, seg, inputs, cursor, mask, parent_eff, parent_card, outs)
+        return
+
+    if kind in ("bucket_ord", "bucket_num"):
+        field, card = plan.static
+        col = seg["ordinal" if kind == "bucket_ord" else "numeric"][field]
+        ords = col["ords"] if kind == "bucket_ord" else col["val_ords"]
+        safe_doc, ok, parent = _pairs_context(seg, col, mask, parent_eff, d_pad)
+        if kind == "bucket_num":
+            b = my["table"][ords]
+            ok = ok & (b >= 0)
+        else:
+            b = ords
+        total = parent_card * card
+        eff = jnp.where(ok, parent * card + b, total)
+        counts = jnp.zeros(total, jnp.int32).at[eff].add(
+            ok.astype(jnp.int32), mode="drop")
+        outs.append({"counts": counts})
+        if plan.children:
+            child_eff = jnp.full(d_pad, -1, jnp.int32).at[
+                jnp.where(ok, safe_doc, d_pad)].max(
+                jnp.where(ok, eff, -1), mode="drop")
+            for c in plan.children:
+                _eval_agg(c, seg, inputs, cursor, mask, child_eff, total, outs)
+        return
+
+    if kind == "filter":
+        scores, matches = _eval_plan(plan.query_plan, seg, inputs, cursor)
+        own = matches & mask & (parent_eff >= 0)
+        eff = jnp.where(own, parent_eff, parent_card)
+        counts = jnp.zeros(parent_card, jnp.int32).at[eff].add(
+            own.astype(jnp.int32), mode="drop")
+        outs.append({"counts": counts})
+        child_eff = jnp.where(own, parent_eff, -1)
+        for c in plan.children:
+            _eval_agg(c, seg, inputs, cursor, mask, child_eff, parent_card, outs)
+        return
+
+    if kind == "global":
+        gmask = seg["live"] & (jnp.arange(d_pad, dtype=jnp.int32)
+                               < seg["live"].shape[0])
+        # num_docs bound is enforced by live padding (padding rows are dead)
+        own = gmask & (parent_eff >= 0)
+        eff = jnp.where(own, parent_eff, parent_card)
+        counts = jnp.zeros(parent_card, jnp.int32).at[eff].add(
+            own.astype(jnp.int32), mode="drop")
+        outs.append({"counts": counts})
+        child_eff = jnp.where(own, parent_eff, -1)
+        for c in plan.children:
+            _eval_agg(c, seg, inputs, cursor, gmask, child_eff, parent_card, outs)
+        return
+
+    if kind == "missing":
+        ctype, field = plan.static
+        if ctype == "numeric":
+            exists = seg["numeric"][field]["exists"]
+        elif ctype == "ordinal":
+            exists = seg["ordinal"][field]["exists"]
+        elif ctype == "vector":
+            exists = seg["vector"][field]["exists"]
+        else:
+            exists = jnp.zeros(d_pad, jnp.bool_)
+        own = mask & ~exists & (parent_eff >= 0)
+        eff = jnp.where(own, parent_eff, parent_card)
+        counts = jnp.zeros(parent_card, jnp.int32).at[eff].add(
+            own.astype(jnp.int32), mode="drop")
+        outs.append({"counts": counts})
+        child_eff = jnp.where(own, parent_eff, -1)
+        for c in plan.children:
+            _eval_agg(c, seg, inputs, cursor, mask, child_eff, parent_card, outs)
+        return
+
+    if kind == "metric_num":
+        field, = plan.static
+        col = seg["numeric"][field]
+        safe_doc, ok, parent = _pairs_context(seg, col, mask, parent_eff, d_pad)
+        eff = jnp.where(ok, parent, parent_card)
+        v = col["values_f32"]
+        outs.append({
+            "sum": jnp.zeros(parent_card, jnp.float32).at[eff].add(
+                jnp.where(ok, v, 0.0), mode="drop"),
+            "cnt": jnp.zeros(parent_card, jnp.int32).at[eff].add(
+                ok.astype(jnp.int32), mode="drop"),
+            "min": jnp.full(parent_card, POS_INF, jnp.float32).at[eff].min(
+                jnp.where(ok, v, POS_INF), mode="drop"),
+            "max": jnp.full(parent_card, NEG_INF, jnp.float32).at[eff].max(
+                jnp.where(ok, v, NEG_INF), mode="drop"),
+            "sumsq": jnp.zeros(parent_card, jnp.float32).at[eff].add(
+                jnp.where(ok, v * v, 0.0), mode="drop"),
+        })
+        return
+
+    if kind == "count_ord":
+        field, = plan.static
+        col = seg["ordinal"][field]
+        _, ok, parent = _pairs_context(seg, col, mask, parent_eff, d_pad)
+        eff = jnp.where(ok, parent, parent_card)
+        outs.append({"cnt": jnp.zeros(parent_card, jnp.int32).at[eff].add(
+            ok.astype(jnp.int32), mode="drop")})
+        return
+
+    if kind in ("presence_ord", "presence_num", "value_hist"):
+        field, card = plan.static
+        col = seg["ordinal" if kind == "presence_ord" else "numeric"][field]
+        ords = col["ords"] if kind == "presence_ord" else col["val_ords"]
+        total = parent_card * card
+        if total > MAX_AGG_BINS:
+            raise IllegalArgumentError(
+                f"aggregation [{plan.name}] needs {total} bins "
+                f"(> {MAX_AGG_BINS}); reduce bucket count or cardinality")
+        _, ok, parent = _pairs_context(seg, col, mask, parent_eff, d_pad)
+        eff = jnp.where(ok, parent * card + ords, total)
+        if kind == "value_hist":
+            outs.append({"hist": jnp.zeros(total, jnp.int32).at[eff].add(
+                ok.astype(jnp.int32), mode="drop")})
+        else:
+            outs.append({"present": jnp.zeros(total, jnp.bool_).at[eff].max(
+                ok, mode="drop")})
+        return
+
+    if kind == "weighted_avg":
+        vf, wf = plan.static
+        vcol = seg["numeric"][vf]
+        wcol = seg["numeric"][wf]
+        safe_doc, ok, parent = _pairs_context(seg, vcol, mask, parent_eff, d_pad)
+        # dense single-value weight per doc via min_rank decode
+        w_dense = wcol["unique_f32"][jnp.clip(wcol["min_rank"], 0,
+                                              wcol["unique_f32"].shape[0] - 1)]
+        w = jnp.where(wcol["exists"][safe_doc], w_dense[safe_doc], 0.0)
+        ok = ok & wcol["exists"][safe_doc]
+        eff = jnp.where(ok, parent, parent_card)
+        v = vcol["values_f32"]
+        outs.append({
+            "sum_wv": jnp.zeros(parent_card, jnp.float32).at[eff].add(
+                jnp.where(ok, v * w, 0.0), mode="drop"),
+            "sum_w": jnp.zeros(parent_card, jnp.float32).at[eff].add(
+                jnp.where(ok, w, 0.0), mode="drop"),
+        })
+        return
+
+    raise QueryShardError(f"unknown aggregation plan kind [{plan.kind}]")

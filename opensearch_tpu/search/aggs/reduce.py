@@ -1,0 +1,474 @@
+"""Host-side aggregation reduce + response rendering.
+
+Reference: the two-level reduce in search/aggregations/InternalAggregation.java:64
+(per-shard partial trees merged by SearchPhaseController → final rendering) and
+the per-type InternalAggregations. Device partials arrive as flat numpy arrays
+per (segment, node); this module merges them by bucket key across segments
+(shards merge the same way at the coordinator) and renders the REST
+"aggregations" response shapes. Pipeline aggregations run on the reduced tree
+(reference: PipelineAggregator.reduce), implemented in pipeline.py.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from opensearch_tpu.common.errors import IllegalArgumentError, ParsingError
+from opensearch_tpu.index.mapper import format_date_millis
+from opensearch_tpu.search.aggs.engine import AggPlan
+
+DEFAULT_PERCENTS = [1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0]
+
+
+class Decoded:
+    """One segment's decoded partials for one plan node."""
+    __slots__ = ("plan", "out", "children")
+
+    def __init__(self, plan: AggPlan, out: dict, children: List["Decoded"]):
+        self.plan = plan
+        self.out = out
+        self.children = children
+
+
+def decode_outputs(plans: List[AggPlan], outs: List[dict]) -> List[Decoded]:
+    cursor = [0]
+
+    def walk(plan: AggPlan) -> Decoded:
+        out = {k: np.asarray(v) for k, v in outs[cursor[0]].items()}
+        cursor[0] += 1
+        if plan.query_plan is not None:
+            pass  # query plan consumed no output slots (inputs only)
+        children = [walk(c) for c in plan.children]
+        return Decoded(plan, out, children)
+
+    return [walk(p) for p in plans]
+
+
+def reduce_aggs(per_segment: List[List[Decoded]]) -> Dict[str, Any]:
+    """per_segment: one decoded top-level list per segment, same node order."""
+    if not per_segment:
+        return {}
+    n_top = len(per_segment[0])
+    result: Dict[str, Any] = {}
+    for i in range(n_top):
+        entries = [(seg_nodes[i], 0) for seg_nodes in per_segment]
+        name = per_segment[0][i].plan.name
+        result[name] = _merge_node(entries)
+    return result
+
+
+def _merge_node(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
+    """entries: (decoded node, parent bucket index within that segment)."""
+    plan = entries[0][0].plan
+    kind = plan.kind
+    render = plan.render
+
+    if kind == "empty":
+        return _render_empty(render)
+
+    if kind in ("bucket_ord", "bucket_num"):
+        rkind = render.get("kind", "terms")
+        if rkind == "terms":
+            return _merge_terms(entries)
+        return _merge_histogram(entries)
+
+    if kind == "multi":
+        rkind = render.get("kind")
+        if rkind == "filters":
+            return _merge_filters(entries)
+        return _merge_ranges(entries)
+
+    if kind in ("filter", "global", "missing"):
+        count = sum(int(d.out["counts"][p]) for d, p in entries
+                    if "counts" in d.out)
+        result = {"doc_count": count}
+        result.update(_merge_children(entries, lambda p: p))
+        return result
+
+    if kind == "metric_num":
+        return _merge_metric(entries)
+
+    if kind == "count_ord":
+        cnt = sum(int(d.out["cnt"][p]) for d, p in entries if "cnt" in d.out)
+        return {"value": cnt}
+
+    if kind in ("presence_ord", "presence_num"):
+        return _merge_cardinality(entries)
+
+    if kind == "value_hist":
+        return _merge_value_hist(entries)
+
+    if kind == "weighted_avg":
+        sum_wv = sum(float(d.out["sum_wv"][p]) for d, p in entries
+                     if "sum_wv" in d.out)
+        sum_w = sum(float(d.out["sum_w"][p]) for d, p in entries
+                    if "sum_w" in d.out)
+        return {"value": (sum_wv / sum_w) if sum_w else None}
+
+    raise IllegalArgumentError(f"cannot reduce aggregation kind [{kind}]")
+
+
+def _merge_children(entries: List[Tuple[Decoded, int]], child_index_fn
+                    ) -> Dict[str, Any]:
+    """Merge each child slot across segments; child_index_fn maps this node's
+    parent index to the child's flattened parent index."""
+    first = entries[0][0]
+    out: Dict[str, Any] = {}
+    for j, child in enumerate(first.children):
+        child_entries = [(d.children[j], child_index_fn(p)) for d, p in entries]
+        out[child.plan.name] = _merge_node(child_entries)
+    return out
+
+
+def _render_empty(render: dict) -> Dict[str, Any]:
+    rkind = render.get("kind", "")
+    if rkind in ("terms",):
+        return {"doc_count_error_upper_bound": 0, "sum_other_doc_count": 0,
+                "buckets": []}
+    if rkind in ("histogram", "date_histogram"):
+        return {"buckets": []}
+    if rkind in ("range", "date_range", "ip_range"):
+        specs = render.get("specs", [])
+        buckets = []
+        for key, frm, to in specs:
+            b = {"key": key, "doc_count": 0}
+            if frm is not None:
+                b["from"] = frm
+            if to is not None:
+                b["to"] = to
+            buckets.append(b)
+        return {"buckets": buckets}
+    if rkind in ("min", "max", "avg", "median_absolute_deviation"):
+        return {"value": None}
+    if rkind in ("sum", "value_count", "cardinality"):
+        return {"value": 0}
+    if rkind == "stats":
+        return {"count": 0, "min": None, "max": None, "avg": None, "sum": 0.0}
+    if rkind == "extended_stats":
+        return {"count": 0, "min": None, "max": None, "avg": None, "sum": 0.0,
+                "sum_of_squares": None, "variance": None, "std_deviation": None}
+    if rkind in ("percentiles", "percentile_ranks"):
+        return {"values": {}}
+    if rkind == "weighted_avg":
+        return {"value": None}
+    return {"doc_count": 0}
+
+
+# ------------------------------------------------------------------ buckets
+
+def _merge_terms(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
+    plan = entries[0][0].plan
+    body = plan.render.get("body", {})
+    size = int(body.get("size", 10))
+    min_doc_count = int(body.get("min_doc_count", 1))
+    order = body.get("order", {"_count": "desc"})
+    if isinstance(order, list):
+        order = order[0] if order else {"_count": "desc"}
+    (order_key, order_dir), = order.items() if order else (("_count", "desc"),)
+
+    acc: Dict[Any, Dict[str, Any]] = {}
+    for d, p in entries:
+        if "counts" not in d.out:
+            continue
+        keys = d.plan.render["keys"]
+        card = d.plan.static[1]
+        counts = d.out["counts"]
+        base = p * card
+        for c in range(min(card, len(keys))):
+            n = int(counts[base + c])
+            if n <= 0:
+                continue
+            slot = acc.setdefault(keys[c], {"doc_count": 0, "segments": []})
+            slot["doc_count"] += n
+            slot["segments"].append((d, p, c))
+
+    total = sum(v["doc_count"] for v in acc.values())
+
+    def sort_key(item):
+        key, slot = item
+        if order_key == "_key":
+            return key
+        return slot["doc_count"]
+    reverse = (order_dir == "desc")
+    items = sorted(acc.items(), key=sort_key, reverse=reverse)
+    if order_key == "_count":  # secondary: key ascending (reference contract)
+        items = sorted(items, key=lambda kv: _orderable(kv[0]))
+        items = sorted(items, key=lambda kv: kv[1]["doc_count"],
+                       reverse=reverse)
+
+    buckets = []
+    taken = 0
+    for key, slot in items:
+        if slot["doc_count"] < min_doc_count:
+            continue
+        if taken >= size:
+            break
+        taken += 1
+        bucket: Dict[str, Any] = {"key": key, "doc_count": slot["doc_count"]}
+        first = entries[0][0]
+        for j, child in enumerate(first.children):
+            child_entries = [(d.children[j], p * d.plan.static[1] + c)
+                             for d, p, c in slot["segments"]]
+            bucket[child.plan.name] = _merge_node(child_entries)
+        buckets.append(bucket)
+    shown = sum(b["doc_count"] for b in buckets)
+    return {"doc_count_error_upper_bound": 0,
+            "sum_other_doc_count": total - shown,
+            "buckets": buckets}
+
+
+def _orderable(key):
+    return (0, key) if isinstance(key, (int, float, bool)) else (1, str(key))
+
+
+def _merge_histogram(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
+    plan = entries[0][0].plan
+    render = plan.render
+    body = render.get("body", {})
+    min_doc_count = int(body.get("min_doc_count", 0))
+    is_date = render.get("kind") == "date_histogram"
+
+    acc: Dict[float, Dict[str, Any]] = {}
+    for d, p in entries:
+        if "counts" not in d.out:
+            continue
+        keys = d.plan.render["keys"]
+        card = d.plan.static[1]
+        counts = d.out["counts"]
+        base = p * card
+        for c in range(min(card, len(keys))):
+            n = int(counts[base + c])
+            slot = acc.setdefault(keys[c], {"doc_count": 0, "segments": []})
+            slot["doc_count"] += n
+            if n > 0 or True:
+                slot["segments"].append((d, p, c))
+
+    if not acc:
+        return {"buckets": []}
+    all_keys = sorted(acc.keys())
+    # fill gaps for min_doc_count == 0 between observed bounds (fixed step only)
+    if min_doc_count == 0 and len(all_keys) >= 2 and not render.get("calendar"):
+        steps = sorted({round(b - a, 9) for a, b in zip(all_keys, all_keys[1:])})
+        step = steps[0] if steps else None
+        if step and step > 0:
+            filled = []
+            k = all_keys[0]
+            while k <= all_keys[-1] + step / 2:
+                filled.append(k)
+                k += step
+            for k in filled:
+                match = next((ak for ak in all_keys
+                              if abs(ak - k) < (step / 1e6 + 1e-9)), None)
+                if match is None:
+                    acc[k] = {"doc_count": 0, "segments": []}
+            all_keys = sorted(acc.keys())
+
+    first = entries[0][0]
+    buckets = []
+    for key in all_keys:
+        slot = acc[key]
+        if slot["doc_count"] < min_doc_count:
+            continue
+        bucket: Dict[str, Any] = {"key": int(key) if is_date else key,
+                                  "doc_count": slot["doc_count"]}
+        if is_date:
+            bucket["key_as_string"] = format_date_millis(int(key))
+        for j, child in enumerate(first.children):
+            child_entries = [(d.children[j], p * d.plan.static[1] + c)
+                             for d, p, c in slot["segments"]]
+            if child_entries:
+                bucket[child.plan.name] = _merge_node(child_entries)
+            else:
+                bucket[child.plan.name] = _render_empty(child.plan.render)
+        buckets.append(bucket)
+    return {"buckets": buckets}
+
+
+def _merge_ranges(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
+    plan = entries[0][0].plan
+    render = plan.render
+    specs = render.get("specs", [])
+    is_date = render.get("is_date", False)
+    buckets = []
+    for i, (key, frm, to) in enumerate(specs):
+        sub_entries = [(d.children[i], p) for d, p in entries
+                       if i < len(d.children)]
+        count = sum(int(d.out["counts"][p]) for d, p in sub_entries
+                    if "counts" in d.out)
+        bucket: Dict[str, Any] = {"key": key, "doc_count": count}
+        if frm is not None:
+            bucket["from"] = frm
+            if is_date:
+                bucket["from_as_string"] = format_date_millis(int(frm))
+        if to is not None:
+            bucket["to"] = to
+            if is_date:
+                bucket["to_as_string"] = format_date_millis(int(to))
+        bucket.update(_merge_children(sub_entries, lambda p: p))
+        buckets.append(bucket)
+    return {"buckets": buckets}
+
+
+def _merge_filters(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
+    plan = entries[0][0].plan
+    names = plan.render["names"]
+    keyed = plan.render["keyed"]
+    results = []
+    for i, name in enumerate(names):
+        sub_entries = [(d.children[i], p) for d, p in entries]
+        results.append(_merge_node(sub_entries))
+    if keyed:
+        return {"buckets": {n: r for n, r in zip(names, results)}}
+    return {"buckets": results}
+
+
+# ------------------------------------------------------------------ metrics
+
+def _merge_metric(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
+    plan = entries[0][0].plan
+    mtype = plan.render.get("kind", "stats")
+    is_date = plan.render.get("is_date", False)
+    total_sum = 0.0
+    total_cnt = 0
+    total_sumsq = 0.0
+    vmin, vmax = math.inf, -math.inf
+    for d, p in entries:
+        if "sum" not in d.out:
+            continue
+        total_sum += float(d.out["sum"][p])
+        total_cnt += int(d.out["cnt"][p])
+        total_sumsq += float(d.out["sumsq"][p])
+        vmin = min(vmin, float(d.out["min"][p]))
+        vmax = max(vmax, float(d.out["max"][p]))
+    has = total_cnt > 0
+
+    def dateify(v):
+        return v
+
+    if mtype == "min":
+        out = {"value": vmin if has else None}
+    elif mtype == "max":
+        out = {"value": vmax if has else None}
+    elif mtype == "sum":
+        out = {"value": total_sum}
+    elif mtype == "avg":
+        out = {"value": (total_sum / total_cnt) if has else None}
+    elif mtype == "value_count":
+        out = {"value": total_cnt}
+    elif mtype in ("stats", "extended_stats"):
+        out = {"count": total_cnt,
+               "min": vmin if has else None,
+               "max": vmax if has else None,
+               "avg": (total_sum / total_cnt) if has else None,
+               "sum": total_sum}
+        if mtype == "extended_stats":
+            if has:
+                mean = total_sum / total_cnt
+                variance = max(total_sumsq / total_cnt - mean * mean, 0.0)
+                std = math.sqrt(variance)
+                out.update({
+                    "sum_of_squares": total_sumsq,
+                    "variance": variance,
+                    "std_deviation": std,
+                    "std_deviation_bounds": {"upper": mean + 2 * std,
+                                             "lower": mean - 2 * std},
+                })
+            else:
+                out.update({"sum_of_squares": None, "variance": None,
+                            "std_deviation": None,
+                            "std_deviation_bounds": {"upper": None,
+                                                     "lower": None}})
+    else:
+        raise IllegalArgumentError(f"unknown metric type [{mtype}]")
+    if is_date and mtype in ("min", "max") and out.get("value") is not None:
+        out["value_as_string"] = format_date_millis(int(out["value"]))
+    return out
+
+
+def _merge_cardinality(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
+    distinct = set()
+    for d, p in entries:
+        if "present" not in d.out:
+            continue
+        card = d.plan.static[1]
+        present = d.out["present"][p * card:(p + 1) * card]
+        if d.plan.kind == "presence_ord":
+            keys = d.plan.render["keys"]
+            for c in np.nonzero(present)[0]:
+                if c < len(keys):
+                    distinct.add(keys[int(c)])
+        else:
+            values = d.plan.render["values"]
+            for c in np.nonzero(present)[0]:
+                if c < len(values):
+                    distinct.add(float(values[int(c)]))
+    return {"value": len(distinct)}
+
+
+def _value_counts(entries: List[Tuple[Decoded, int]]) -> Tuple[np.ndarray, np.ndarray]:
+    acc: Dict[float, int] = {}
+    for d, p in entries:
+        if "hist" not in d.out:
+            continue
+        card = d.plan.static[1]
+        hist = d.out["hist"][p * card:(p + 1) * card]
+        values = d.plan.render["values"]
+        for c in np.nonzero(hist)[0]:
+            if c < len(values):
+                v = float(values[int(c)])
+                acc[v] = acc.get(v, 0) + int(hist[int(c)])
+    if not acc:
+        return np.zeros(0), np.zeros(0, dtype=np.int64)
+    vals = np.array(sorted(acc.keys()))
+    counts = np.array([acc[v] for v in vals], dtype=np.int64)
+    return vals, counts
+
+
+def percentile_from_counts(vals: np.ndarray, counts: np.ndarray,
+                           q: float) -> Optional[float]:
+    """Exact linear-interpolated percentile over a weighted multiset
+    (numpy 'linear' method; replaces the reference's TDigest approximation)."""
+    n = int(counts.sum())
+    if n == 0:
+        return None
+    pos = (q / 100.0) * (n - 1)
+    lo_i = int(math.floor(pos))
+    hi_i = min(lo_i + 1, n - 1)
+    frac = pos - lo_i
+    cum = np.cumsum(counts)
+    lo_v = float(vals[np.searchsorted(cum, lo_i + 1)])
+    hi_v = float(vals[np.searchsorted(cum, hi_i + 1)])
+    return lo_v + (hi_v - lo_v) * frac
+
+
+def _merge_value_hist(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
+    plan = entries[0][0].plan
+    kind = plan.render.get("kind", "percentiles")
+    body = plan.render.get("body", {})
+    vals, counts = _value_counts(entries)
+    if kind == "percentiles":
+        percents = body.get("percents", DEFAULT_PERCENTS)
+        return {"values": {f"{float(q)}": percentile_from_counts(vals, counts, q)
+                           for q in percents}}
+    if kind == "percentile_ranks":
+        targets = body.get("values", [])
+        n = int(counts.sum())
+        out = {}
+        for t in targets:
+            if n == 0:
+                out[f"{float(t)}"] = None
+            else:
+                below = int(counts[vals <= float(t)].sum())
+                out[f"{float(t)}"] = 100.0 * below / n
+        return {"values": out}
+    if kind == "median_absolute_deviation":
+        if counts.sum() == 0:
+            return {"value": None}
+        median = percentile_from_counts(vals, counts, 50.0)
+        dev = np.abs(vals - median)
+        order = np.argsort(dev)
+        return {"value": percentile_from_counts(dev[order], counts[order], 50.0)}
+    raise IllegalArgumentError(f"unknown value-hist agg [{kind}]")

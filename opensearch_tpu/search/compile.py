@@ -1,0 +1,700 @@
+"""Query compilation: DSL tree → per-segment device execution plan.
+
+The TPU re-design of the reference's QueryShardContext.toQuery() pipeline
+(index/query/QueryShardContext.java compiles QueryBuilders to Lucene Queries).
+Here a query compiles to a `Plan` tree whose leaves carry gathered numpy
+inputs (postings block ids, idf weights, rank bounds, ordinal masks, dense
+masks) and whose structure — the part XLA compiles — is a hashable signature.
+Same-structure queries with different constants reuse the compiled executable.
+
+Scoring invariant: every node's evaluated `scores` are already zeroed where
+its `matches` is false, so combinators compose by plain arithmetic.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from opensearch_tpu.common.errors import (
+    IllegalArgumentError, ParsingError, QueryShardError)
+from opensearch_tpu.index.mapper import MapperService, MappedFieldType
+from opensearch_tpu.index.segment import LENGTH_TABLE, Segment, pad_bucket
+from opensearch_tpu.ops.bm25 import idf as bm25_idf
+from opensearch_tpu.ops.device_segment import DeviceSegmentMeta
+from opensearch_tpu.search import dsl
+from opensearch_tpu.search.dsl import parse_minimum_should_match
+
+DEFAULT_K1 = 1.2
+DEFAULT_B = 0.75
+MAX_EXPANSIONS = 1024  # indices.query.bool.max_clause_count analog
+
+
+@dataclass
+class Plan:
+    """One node of the compiled device program."""
+    kind: str
+    static: tuple = ()
+    inputs: Dict[str, np.ndarray] = dc_field(default_factory=dict)
+    children: List["Plan"] = dc_field(default_factory=list)
+
+    def sig(self):
+        return (self.kind, self.static,
+                tuple(sorted((k, v.shape, str(v.dtype))
+                             for k, v in self.inputs.items())),
+                tuple(c.sig() for c in self.children))
+
+    def flatten_inputs(self, out: List[Dict[str, np.ndarray]]):
+        out.append(self.inputs)
+        for c in self.children:
+            c.flatten_inputs(out)
+        return out
+
+
+def _f32(x) -> np.ndarray:
+    return np.asarray(x, dtype=np.float32)
+
+
+def _i32(x) -> np.ndarray:
+    return np.asarray(x, dtype=np.int32)
+
+
+class ShardStats:
+    """Shard-level (cross-segment) term/field statistics so every segment
+    scores with the same idf/avgdl — matching Lucene's per-shard
+    CollectionStatistics/TermStatistics."""
+
+    def __init__(self, segments: Sequence[Segment]):
+        self.segments = list(segments)
+        self._field: Dict[str, Tuple[int, int]] = {}
+        for seg in segments:
+            for fname, st in seg.field_stats.items():
+                dc, ttf = self._field.get(fname, (0, 0))
+                self._field[fname] = (dc + st.doc_count, ttf + st.sum_total_term_freq)
+
+    def field_stats(self, field: str) -> Tuple[int, int]:
+        return self._field.get(field, (0, 0))
+
+    def avgdl(self, field: str) -> float:
+        dc, ttf = self.field_stats(field)
+        return (ttf / dc) if dc > 0 else 1.0
+
+    def df(self, field: str, term: str) -> int:
+        return sum(m.doc_freq for seg in self.segments
+                   if (m := seg.get_term(field, term)) is not None)
+
+    def idf(self, field: str, term: str) -> float:
+        dc, _ = self.field_stats(field)
+        df = self.df(field, term)
+        if df == 0:
+            return 0.0
+        return bm25_idf(dc, df)
+
+
+MATCH_NONE = Plan("match_none")
+
+
+def _match_all(boost: float) -> Plan:
+    return Plan("match_all", inputs={"boost": _f32(boost)})
+
+
+class Compiler:
+    """Compiles one parsed query for one segment of a shard."""
+
+    def __init__(self, mapper: MapperService, stats: ShardStats):
+        self.mapper = mapper
+        self.stats = stats
+
+    # ------------------------------------------------------------ entry
+    def compile(self, node: dsl.QueryNode, seg: Segment,
+                meta: DeviceSegmentMeta) -> Plan:
+        method = getattr(self, f"_c_{type(node).__name__}", None)
+        if method is None:
+            raise QueryShardError(f"query type [{type(node).__name__}] "
+                                  f"is not supported")
+        return method(node, seg, meta)
+
+    # ------------------------------------------------------- text leaves
+    def _text_clause(self, seg: Segment, meta: DeviceSegmentMeta, field: str,
+                     weighted_terms: List[Tuple[str, float]], min_hits: int,
+                     boost: float, constant: bool, k1: float = DEFAULT_K1,
+                     b: float = DEFAULT_B) -> Plan:
+        """weighted_terms: (term, weight) where weight already folds idf, query
+        boost and term multiplicity. min_hits: required distinct term matches."""
+        ft = self.mapper.get_field(field)
+        row = meta.norm_row(field)
+        has_norms = ft is not None and ft.is_text and row is not None
+        b_eff = b if has_norms else 0.0
+        avgdl = self.stats.avgdl(field)
+        ids, ws, rows, avs, bs, hits = [], [], [], [], [], []
+        for term, w in weighted_terms:
+            tm = seg.get_term(field, term)
+            if tm is None:
+                continue
+            for blk_i in range(tm.start_block, tm.start_block + tm.num_blocks):
+                ids.append(blk_i)
+                ws.append(w)
+                rows.append(row if has_norms else 0)
+                avs.append(avgdl if avgdl > 0 else 1.0)
+                bs.append(b_eff)
+                hits.append(1)
+        qb = pad_bucket(max(len(ids), 1), minimum=8)
+        pad = qb - len(ids)
+        inputs = {
+            "ids": _i32(ids + [0] * pad),
+            "w": _f32(ws + [0.0] * pad),
+            "row": _i32(rows + [0] * pad),
+            "avgdl": _f32(avs + [1.0] * pad),
+            "b": _f32(bs + [0.0] * pad),
+            "hit": _i32(hits + [0] * pad),
+            "k1": _f32(k1),
+            "min_hits": _i32(min_hits),
+            "boost": _f32(boost),
+        }
+        return Plan("text", static=(bool(constant),), inputs=inputs)
+
+    def _analyze_query_terms(self, ft: MappedFieldType, text: Any,
+                             analyzer_override: Optional[str] = None) -> List[str]:
+        if ft.is_text:
+            name = analyzer_override or ft.search_analyzer or ft.analyzer
+            return self.mapper.analysis.get(name).terms(str(text))
+        return [str(text)]
+
+    def _weighted(self, field: str, terms: Sequence[str],
+                  boost: float) -> Tuple[List[Tuple[str, float]], int]:
+        """Fold duplicate terms into multiplicity-weighted idf entries."""
+        counts: Dict[str, int] = {}
+        for t in terms:
+            counts[t] = counts.get(t, 0) + 1
+        weighted = [(t, self.stats.idf(field, t) * boost * mult)
+                    for t, mult in counts.items()]
+        return weighted, len(counts)
+
+    def _c_MatchQuery(self, node: dsl.MatchQuery, seg, meta) -> Plan:
+        ft = self.mapper.get_field(node.field)
+        if ft is None:
+            return MATCH_NONE
+        if ft.is_numeric or ft.is_date or ft.is_bool or ft.is_ip:
+            # match on a numeric-ish field degrades to an exact term match
+            return self._numeric_term(seg, node.field, ft, [node.query], node.boost)
+        terms = self._analyze_query_terms(ft, node.query, node.analyzer)
+        if not terms:
+            return MATCH_NONE
+        if node.fuzziness is not None:
+            # Lucene: match with fuzziness builds one FuzzyQuery per token
+            children = [self._c_FuzzyQuery(
+                dsl.FuzzyQuery(field=node.field, value=t,
+                               fuzziness=str(node.fuzziness)), seg, meta)
+                for t in terms]
+            if node.operator == "and":
+                return self._bool_plan(children, [], [], [], 0, node.boost)
+            msm = max(1, parse_minimum_should_match(node.minimum_should_match,
+                                                    len(children)))
+            return self._bool_plan([], [], children, [], msm, node.boost)
+        weighted, n_distinct = self._weighted(node.field, terms, node.boost)
+        if node.operator == "and":
+            min_hits = n_distinct
+        else:
+            min_hits = parse_minimum_should_match(node.minimum_should_match,
+                                                  n_distinct)
+            min_hits = max(1, min_hits)
+        return self._text_clause(seg, meta, node.field, weighted, min_hits,
+                                 node.boost, constant=False)
+
+    def _c_TermQuery(self, node: dsl.TermQuery, seg, meta) -> Plan:
+        ft = self.mapper.get_field(node.field)
+        if ft is None:
+            return MATCH_NONE
+        if ft.is_numeric or ft.is_date:
+            return self._numeric_term(seg, node.field, ft, [node.value], node.boost)
+        value = str(node.value)
+        if ft.is_bool:
+            value = "true" if node.value in (True, "true") else "false"
+        if node.case_insensitive:
+            return self._expand_terms(
+                seg, meta, node.field,
+                lambda t: t.lower() == value.lower(), node.boost)
+        weighted, _ = self._weighted(node.field, [value], node.boost)
+        return self._text_clause(seg, meta, node.field, weighted, 1, node.boost,
+                                 constant=False)
+
+    def _c_TermsQuery(self, node: dsl.TermsQuery, seg, meta) -> Plan:
+        ft = self.mapper.get_field(node.field)
+        if ft is None:
+            return MATCH_NONE
+        if ft.is_numeric or ft.is_date:
+            return self._numeric_term(seg, node.field, ft, list(node.values),
+                                      node.boost)
+        values = [("true" if v in (True, "true") else "false") if ft.is_bool
+                  else str(v) for v in node.values]
+        # terms query is constant-score in the reference
+        weighted = [(v, 1.0) for v in dict.fromkeys(values)]
+        return self._text_clause(seg, meta, node.field, weighted, 1, node.boost,
+                                 constant=True)
+
+    def _numeric_term(self, seg: Segment, field: str, ft: MappedFieldType,
+                      values: List[Any], boost: float) -> Plan:
+        """Exact numeric/date/bool/ip match via rank mask over unique values.
+
+        The f64 → rank conversion happens host-side so the device only ever
+        sees an int32-indexed bool mask (no f64 emulation on TPU).
+        """
+        col = seg.numeric_dv.get(field)
+        if col is None or len(col.unique) == 0:
+            return MATCH_NONE
+        mask = np.zeros(pad_bucket(len(col.unique), 8), dtype=bool)
+        for v in values:
+            target = ft.to_comparable(v)
+            i = int(np.searchsorted(col.unique, target))
+            if i < len(col.unique) and col.unique[i] == target:
+                mask[i] = True
+        return Plan("num_terms", static=(field,),
+                    inputs={"mask": mask, "boost": _f32(boost)})
+
+    # --------------------------------------------------------- range
+    def _c_RangeQuery(self, node: dsl.RangeQuery, seg, meta) -> Plan:
+        ft = self.mapper.get_field(node.field)
+        if ft is None:
+            return MATCH_NONE
+        if ft.is_keyword:
+            col = seg.ordinal_dv.get(node.field)
+            if col is None:
+                return MATCH_NONE
+            import bisect
+            lo = 0 if node.gte is None and node.gt is None else (
+                bisect.bisect_left(col.dictionary, str(node.gte))
+                if node.gte is not None
+                else bisect.bisect_right(col.dictionary, str(node.gt)))
+            hi = len(col.dictionary) if node.lte is None and node.lt is None else (
+                bisect.bisect_right(col.dictionary, str(node.lte))
+                if node.lte is not None
+                else bisect.bisect_left(col.dictionary, str(node.lt)))
+            return Plan("range_ord", static=(node.field,), inputs={
+                "lo": _i32(lo), "hi": _i32(hi), "boost": _f32(node.boost)})
+        col = seg.numeric_dv.get(node.field)
+        if col is None:
+            return MATCH_NONE
+
+        def bound(value, is_date_math_upper=False):
+            if ft.is_date and isinstance(value, str) and ("now" in value or "||" in value):
+                value = _resolve_date_math(value)
+            return ft.to_comparable(value)
+
+        lo_rank = 0
+        hi_rank = len(col.unique)
+        if node.gte is not None:
+            lo_rank = int(np.searchsorted(col.unique, bound(node.gte), "left"))
+        elif node.gt is not None:
+            lo_rank = int(np.searchsorted(col.unique, bound(node.gt), "right"))
+        if node.lte is not None:
+            hi_rank = int(np.searchsorted(col.unique, bound(node.lte), "right"))
+        elif node.lt is not None:
+            hi_rank = int(np.searchsorted(col.unique, bound(node.lt), "left"))
+        return Plan("range_num", static=(node.field,), inputs={
+            "lo": _i32(lo_rank), "hi": _i32(hi_rank), "boost": _f32(node.boost)})
+
+    # --------------------------------------------------------- misc leaves
+    def _c_MatchAllQuery(self, node, seg, meta) -> Plan:
+        return _match_all(node.boost)
+
+    def _c_MatchNoneQuery(self, node, seg, meta) -> Plan:
+        return MATCH_NONE
+
+    def _c_ExistsQuery(self, node: dsl.ExistsQuery, seg, meta) -> Plan:
+        field = node.field
+        if field in seg.numeric_dv:
+            return Plan("exists", static=("numeric", field),
+                        inputs={"boost": _f32(node.boost)})
+        if field in seg.ordinal_dv:
+            return Plan("exists", static=("ordinal", field),
+                        inputs={"boost": _f32(node.boost)})
+        if field in seg.vector_dv:
+            return Plan("exists", static=("vector", field),
+                        inputs={"boost": _f32(node.boost)})
+        row = meta.norm_row(field)
+        if row is not None:
+            return Plan("exists", static=("norms", row),
+                        inputs={"boost": _f32(node.boost)})
+        return MATCH_NONE
+
+    def _c_IdsQuery(self, node: dsl.IdsQuery, seg, meta) -> Plan:
+        d_pad = pad_bucket(max(seg.num_docs, 1))
+        mask = np.zeros(d_pad, dtype=bool)
+        for doc_id in node.values:
+            ord_ = seg._id_to_ord.get(str(doc_id))
+            if ord_ is not None:
+                mask[ord_] = True
+        return Plan("precomputed", inputs={
+            "scores": np.where(mask, np.float32(node.boost), np.float32(0.0)),
+            "matches": mask})
+
+    # ------------------------------------------------- multi-term expansion
+    def _expand_terms(self, seg, meta, field: str, predicate, boost: float) -> Plan:
+        """Constant-score rewrite of prefix/wildcard/regexp/fuzzy, expanding
+        against this segment's term dictionary (reference:
+        MultiTermQuery.CONSTANT_SCORE_REWRITE)."""
+        terms = [t for t in seg.terms_for_field(field) if predicate(t)]
+        if len(terms) > MAX_EXPANSIONS:
+            raise QueryShardError(
+                f"field [{field}] expansion matches too many terms "
+                f"(> {MAX_EXPANSIONS})")
+        if not terms:
+            return MATCH_NONE
+        weighted = [(t, 1.0) for t in terms]
+        return self._text_clause(seg, meta, field, weighted, 1, boost,
+                                 constant=True)
+
+    def _c_PrefixQuery(self, node: dsl.PrefixQuery, seg, meta) -> Plan:
+        value = node.value.lower() if node.case_insensitive else node.value
+        return self._expand_terms(
+            seg, meta, node.field,
+            (lambda t: t.lower().startswith(value)) if node.case_insensitive
+            else (lambda t: t.startswith(value)), node.boost)
+
+    def _c_WildcardQuery(self, node: dsl.WildcardQuery, seg, meta) -> Plan:
+        pattern = node.value
+        if node.case_insensitive:
+            pattern = pattern.lower()
+            return self._expand_terms(
+                seg, meta, node.field,
+                lambda t: fnmatch.fnmatchcase(t.lower(), pattern), node.boost)
+        return self._expand_terms(
+            seg, meta, node.field,
+            lambda t: fnmatch.fnmatchcase(t, pattern), node.boost)
+
+    def _c_RegexpQuery(self, node: dsl.RegexpQuery, seg, meta) -> Plan:
+        try:
+            rx = re.compile(node.value, re.IGNORECASE if node.case_insensitive else 0)
+        except re.error as e:
+            raise ParsingError(f"invalid regexp [{node.value}]: {e}")
+        return self._expand_terms(seg, meta, node.field,
+                                  lambda t: rx.fullmatch(t) is not None, node.boost)
+
+    def _c_FuzzyQuery(self, node: dsl.FuzzyQuery, seg, meta) -> Plan:
+        value = node.value
+        max_edits = _fuzziness_to_edits(node.fuzziness, value)
+        prefix = value[:node.prefix_length]
+
+        def predicate(t):
+            return (t.startswith(prefix)
+                    and _levenshtein_le(t, value, max_edits))
+        return self._expand_terms(seg, meta, node.field, predicate, node.boost)
+
+    # --------------------------------------------------------- phrase (host)
+    def _c_MatchPhraseQuery(self, node: dsl.MatchPhraseQuery, seg, meta) -> Plan:
+        ft = self.mapper.get_field(node.field)
+        if ft is None:
+            return MATCH_NONE
+        terms = self._analyze_query_terms(ft, node.query, node.analyzer)
+        if not terms:
+            return MATCH_NONE
+        if len(terms) == 1:
+            weighted, _ = self._weighted(node.field, terms, node.boost)
+            return self._text_clause(seg, meta, node.field, weighted, 1,
+                                     node.boost, constant=False)
+        scores, matches = phrase_eval(seg, self.stats, node.field, terms,
+                                      node.slop, node.boost)
+        d_pad = pad_bucket(max(seg.num_docs, 1))
+        sc = np.zeros(d_pad, dtype=np.float32)
+        mk = np.zeros(d_pad, dtype=bool)
+        sc[:seg.num_docs] = scores
+        mk[:seg.num_docs] = matches
+        return Plan("precomputed", inputs={"scores": sc, "matches": mk})
+
+    def _c_MatchBoolPrefixQuery(self, node, seg, meta) -> Plan:
+        ft = self.mapper.get_field(node.field)
+        if ft is None:
+            return MATCH_NONE
+        terms = self._analyze_query_terms(ft, node.query, node.analyzer)
+        if not terms:
+            return MATCH_NONE
+        children: List[Plan] = []
+        for t in terms[:-1]:
+            weighted, _ = self._weighted(node.field, [t], 1.0)
+            children.append(self._text_clause(seg, meta, node.field, weighted, 1,
+                                              1.0, constant=False))
+        children.append(self._c_PrefixQuery(
+            dsl.PrefixQuery(field=node.field, value=terms[-1]), seg, meta))
+        return self._bool_plan(must=[], filter=[], should=children, must_not=[],
+                               msm=1, boost=node.boost)
+
+    # --------------------------------------------------------- compounds
+    def _c_MultiMatchQuery(self, node: dsl.MultiMatchQuery, seg, meta) -> Plan:
+        fields = list(node.fields)
+        if not fields:
+            raise ParsingError("[multi_match] requires fields")
+        subs = []
+        for fspec in fields:
+            fname, _, fboost = fspec.partition("^")
+            boost = float(fboost) if fboost else 1.0
+            if node.type == "phrase":
+                q = dsl.MatchPhraseQuery(field=fname, query=node.query, boost=boost)
+            else:
+                q = dsl.MatchQuery(field=fname, query=node.query,
+                                   operator=node.operator,
+                                   minimum_should_match=node.minimum_should_match,
+                                   boost=boost)
+            subs.append(self.compile(q, seg, meta))
+        if node.type in ("most_fields", "cross_fields"):
+            return self._bool_plan([], [], subs, [], msm=1, boost=node.boost)
+        tie = node.tie_breaker
+        return Plan("dis_max", inputs={"tie": _f32(tie), "boost": _f32(node.boost)},
+                    children=subs)
+
+    def _bool_plan(self, must, filter, should, must_not, msm: int,
+                   boost: float) -> Plan:
+        return Plan("bool",
+                    static=(len(must), len(filter), len(should), len(must_not)),
+                    inputs={"msm": _i32(msm), "boost": _f32(boost)},
+                    children=list(must) + list(filter) + list(should) + list(must_not))
+
+    def _c_BoolQuery(self, node: dsl.BoolQuery, seg, meta) -> Plan:
+        must = [self.compile(c, seg, meta) for c in node.must]
+        filt = [self.compile(c, seg, meta) for c in node.filter]
+        should = [self.compile(c, seg, meta) for c in node.should]
+        must_not = [self.compile(c, seg, meta) for c in node.must_not]
+        if node.minimum_should_match is not None:
+            msm = parse_minimum_should_match(node.minimum_should_match, len(should))
+        elif should and not (node.must or node.filter):
+            msm = 1
+        else:
+            msm = 0
+        return self._bool_plan(must, filt, should, must_not, msm, node.boost)
+
+    def _c_ConstantScoreQuery(self, node: dsl.ConstantScoreQuery, seg, meta) -> Plan:
+        child = self.compile(node.filter, seg, meta)
+        return Plan("const_score", inputs={"boost": _f32(node.boost)},
+                    children=[child])
+
+    def _c_DisMaxQuery(self, node: dsl.DisMaxQuery, seg, meta) -> Plan:
+        children = [self.compile(c, seg, meta) for c in node.queries]
+        if not children:
+            return MATCH_NONE
+        return Plan("dis_max", inputs={"tie": _f32(node.tie_breaker),
+                                       "boost": _f32(node.boost)},
+                    children=children)
+
+    def _c_BoostingQuery(self, node: dsl.BoostingQuery, seg, meta) -> Plan:
+        pos = self.compile(node.positive, seg, meta)
+        neg = self.compile(node.negative, seg, meta)
+        return Plan("boosting", inputs={"nb": _f32(node.negative_boost),
+                                        "boost": _f32(node.boost)},
+                    children=[pos, neg])
+
+    # ------------------------------------------------- query_string family
+    def _c_QueryStringQuery(self, node: dsl.QueryStringQuery, seg, meta) -> Plan:
+        parsed = _parse_query_string(node.query, node.default_field or "*",
+                                     list(node.fields), node.default_operator,
+                                     self.mapper)
+        parsed.boost = node.boost
+        return self.compile(parsed, seg, meta)
+
+    def _c_SimpleQueryStringQuery(self, node, seg, meta) -> Plan:
+        parsed = _parse_query_string(node.query, "*", list(node.fields),
+                                     node.default_operator, self.mapper,
+                                     simple=True)
+        parsed.boost = node.boost
+        return self.compile(parsed, seg, meta)
+
+
+# ------------------------------------------------------------------ helpers
+
+def _resolve_date_math(expr: str) -> Any:
+    """Minimal date-math: 'now', 'now-7d', 'now/d', '<date>||-1M/d'."""
+    import datetime as _dt
+    from opensearch_tpu.index.mapper import parse_date_millis
+    if "||" in expr:
+        base_str, math = expr.split("||", 1)
+        base = parse_date_millis(base_str)
+    elif expr.startswith("now"):
+        base = int(_dt.datetime.now(_dt.timezone.utc).timestamp() * 1000)
+        math = expr[3:]
+    else:
+        return expr
+    units_ms = {"s": 1000, "m": 60000, "h": 3600000, "H": 3600000,
+                "d": 86400000, "w": 7 * 86400000, "M": 30 * 86400000,
+                "y": 365 * 86400000}
+    for m in re.finditer(r"([+\-/])(\d*)([smhHdwMy])", math):
+        op, num, unit = m.groups()
+        if op == "/":
+            base = (base // units_ms[unit]) * units_ms[unit]
+        else:
+            delta = int(num or 1) * units_ms[unit]
+            base = base + delta if op == "+" else base - delta
+    return base
+
+
+def _fuzziness_to_edits(fuzziness: str, term: str) -> int:
+    f = str(fuzziness).upper()
+    if f == "AUTO":
+        n = len(term)
+        return 0 if n <= 2 else (1 if n <= 5 else 2)
+    return int(float(f))
+
+
+def _levenshtein_le(a: str, b: str, limit: int) -> bool:
+    """Damerau (restricted transposition) edit distance ≤ limit, matching
+    Lucene's FuzzyQuery default transpositions=true."""
+    if abs(len(a) - len(b)) > limit:
+        return False
+    prev2 = None
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i] + [0] * len(b)
+        row_min = i
+        for j, cb in enumerate(b, 1):
+            cost = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (ca != cb))
+            if (prev2 is not None and i > 1 and j > 1
+                    and ca == b[j - 2] and a[i - 2] == cb):
+                cost = min(cost, prev2[j - 2] + 1)
+            cur[j] = cost
+            row_min = min(row_min, cost)
+        if row_min > limit:
+            return False
+        prev2, prev = prev, cur
+    return prev[-1] <= limit
+
+
+def phrase_eval(seg: Segment, stats: ShardStats, field: str, terms: List[str],
+                slop: int, boost: float) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side exact phrase matching over stored positions.
+
+    Reference: Lucene ExactPhraseMatcher / SloppyPhraseMatcher driven by
+    PhraseQuery. Device kernels pre-filter nothing here (segment postings are
+    host-visible too); the result enters the device plan as a precomputed
+    dense (scores, matches) pair. Sloppy matching uses a minimal-window
+    approximation of Lucene's edit-distance semantics.
+    """
+    n = seg.num_docs
+    scores = np.zeros(n, dtype=np.float32)
+    matches = np.zeros(n, dtype=bool)
+    per_term: List[Dict[int, np.ndarray]] = []
+    for t in terms:
+        plist = seg._positions_for(field, t)
+        if plist is None:
+            return scores, matches
+        per_term.append(plist)
+    candidates = set(per_term[0].keys())
+    for plist in per_term[1:]:
+        candidates &= set(plist.keys())
+    if not candidates:
+        return scores, matches
+    sum_idf = sum(stats.idf(field, t) for t in set(terms))
+    dc, ttf = stats.field_stats(field)
+    avgdl = (ttf / dc) if dc else 1.0
+    norms = seg.norms.get(field)
+    for doc in candidates:
+        freq = _phrase_freq([per_term[i][doc] for i in range(len(terms))], slop)
+        if freq <= 0:
+            continue
+        dl = float(LENGTH_TABLE[norms[doc]]) if norms is not None else 1.0
+        b_eff = DEFAULT_B if norms is not None else 0.0
+        denom = freq + DEFAULT_K1 * (1 - b_eff + b_eff * dl / avgdl)
+        scores[doc] = boost * sum_idf * freq * (DEFAULT_K1 + 1) / denom
+        matches[doc] = True
+    return scores, matches
+
+
+def _phrase_freq(pos_lists: List[np.ndarray], slop: int) -> float:
+    if slop == 0:
+        # exact: count start positions p where term i appears at p + i
+        base = set(int(p) for p in pos_lists[0])
+        for i, plist in enumerate(pos_lists[1:], 1):
+            base &= set(int(p) - i for p in plist)
+        return float(len(base))
+    # sloppy approximation: minimal windows containing all terms in order
+    # within slop extra positions, weighted 1/(1+distance) like sloppyFreq
+    freq = 0.0
+    starts = [int(p) for p in pos_lists[0]]
+    for s in starts:
+        pos = s
+        total_disp = 0
+        ok = True
+        for i, plist in enumerate(pos_lists[1:], 1):
+            target = s + i
+            later = plist[plist >= pos + 1] if len(plist) else plist
+            if len(later) == 0:
+                ok = False
+                break
+            nxt = int(later[0])
+            total_disp += abs(nxt - target)
+            pos = nxt
+        if ok and total_disp <= slop:
+            freq += 1.0 / (1.0 + total_disp)
+    return freq
+
+
+def _parse_query_string(query: str, default_field: str, fields: List[str],
+                        default_operator: str, mapper: MapperService,
+                        simple: bool = False) -> dsl.QueryNode:
+    """Minimal Lucene-syntax parser: terms, "phrases", field:term, +req, -not,
+    AND/OR/NOT. Reference: lang in index/query/QueryStringQueryBuilder.java."""
+    tokens = re.findall(r'"[^"]*"|\S+', query or "")
+    must: List[dsl.QueryNode] = []
+    should: List[dsl.QueryNode] = []
+    must_not: List[dsl.QueryNode] = []
+    conj = default_operator
+    pending_and = False
+    pending_not = False
+
+    def target_fields() -> List[str]:
+        if fields:
+            return list(fields)
+        if default_field and default_field != "*":
+            return [default_field]
+        return [name for name, ft in mapper.field_types.items() if ft.is_text]
+
+    def leaf(text: str) -> dsl.QueryNode:
+        phrase = text.startswith('"') and text.endswith('"') and len(text) >= 2
+        body = text[1:-1] if phrase else text
+        fnames = target_fields()
+        subs: List[dsl.QueryNode] = []
+        for f in fnames:
+            if phrase:
+                subs.append(dsl.MatchPhraseQuery(field=f, query=body))
+            else:
+                subs.append(dsl.MatchQuery(field=f, query=body))
+        if len(subs) == 1:
+            return subs[0]
+        return dsl.DisMaxQuery(queries=subs)
+
+    for raw in tokens:
+        upper = raw.upper()
+        if not simple and upper in ("AND", "&&"):
+            pending_and = True
+            continue
+        if not simple and upper in ("OR", "||"):
+            pending_and = False
+            continue
+        if not simple and upper == "NOT":
+            pending_not = True
+            continue
+        neg = pending_not
+        req = False
+        text = raw
+        if text.startswith("-"):
+            neg, text = True, text[1:]
+        elif text.startswith("+"):
+            req, text = True, text[1:]
+        if ":" in text and not text.startswith('"'):
+            fname, _, rest = text.partition(":")
+            node = (dsl.MatchPhraseQuery(field=fname, query=rest[1:-1])
+                    if rest.startswith('"') else
+                    dsl.MatchQuery(field=fname, query=rest))
+        else:
+            node = leaf(text)
+        if neg:
+            must_not.append(node)
+        elif req or pending_and or default_operator == "and":
+            must.append(node)
+        else:
+            should.append(node)
+        pending_not = False
+        pending_and = False
+    if not must and not should and not must_not:
+        return dsl.MatchAllQuery()
+    return dsl.BoolQuery(must=must, should=should, must_not=must_not)

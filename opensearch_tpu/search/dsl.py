@@ -1,0 +1,411 @@
+"""Query DSL: parse the OpenSearch JSON query language into a typed tree.
+
+Reference: the ~48 QueryBuilders in server/src/main/java/org/opensearch/index/
+query/*QueryBuilder.java registered by search/SearchModule.java. Parsing keeps
+the reference's REST wire shapes (short forms like {"term": {"f": "v"}} and
+long forms like {"term": {"f": {"value": "v", "boost": 2}}}) and its error
+types. Compilation to device plans lives in search/compile.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional, Sequence
+
+from opensearch_tpu.common.errors import ParsingError
+
+
+@dataclass
+class QueryNode:
+    boost: float = 1.0
+
+
+@dataclass
+class MatchAllQuery(QueryNode):
+    pass
+
+
+@dataclass
+class MatchNoneQuery(QueryNode):
+    pass
+
+
+@dataclass
+class MatchQuery(QueryNode):
+    field: str = ""
+    query: Any = None
+    operator: str = "or"              # or | and
+    minimum_should_match: Optional[str] = None
+    analyzer: Optional[str] = None
+    fuzziness: Optional[str] = None
+
+
+@dataclass
+class MatchPhraseQuery(QueryNode):
+    field: str = ""
+    query: Any = None
+    slop: int = 0
+    analyzer: Optional[str] = None
+
+
+@dataclass
+class MatchBoolPrefixQuery(QueryNode):
+    field: str = ""
+    query: Any = None
+    analyzer: Optional[str] = None
+
+
+@dataclass
+class MultiMatchQuery(QueryNode):
+    fields: Sequence[str] = ()
+    query: Any = None
+    type: str = "best_fields"         # best_fields | most_fields | cross_fields | phrase
+    operator: str = "or"
+    tie_breaker: float = 0.0
+    minimum_should_match: Optional[str] = None
+
+
+@dataclass
+class TermQuery(QueryNode):
+    field: str = ""
+    value: Any = None
+    case_insensitive: bool = False
+
+
+@dataclass
+class TermsQuery(QueryNode):
+    field: str = ""
+    values: Sequence[Any] = ()
+
+
+@dataclass
+class RangeQuery(QueryNode):
+    field: str = ""
+    gte: Any = None
+    gt: Any = None
+    lte: Any = None
+    lt: Any = None
+    fmt: Optional[str] = None
+    time_zone: Optional[str] = None
+
+
+@dataclass
+class ExistsQuery(QueryNode):
+    field: str = ""
+
+
+@dataclass
+class IdsQuery(QueryNode):
+    values: Sequence[str] = ()
+
+
+@dataclass
+class PrefixQuery(QueryNode):
+    field: str = ""
+    value: str = ""
+    case_insensitive: bool = False
+
+
+@dataclass
+class WildcardQuery(QueryNode):
+    field: str = ""
+    value: str = ""
+    case_insensitive: bool = False
+
+
+@dataclass
+class RegexpQuery(QueryNode):
+    field: str = ""
+    value: str = ""
+    case_insensitive: bool = False
+
+
+@dataclass
+class FuzzyQuery(QueryNode):
+    field: str = ""
+    value: str = ""
+    fuzziness: str = "AUTO"
+    prefix_length: int = 0
+    max_expansions: int = 50
+
+
+@dataclass
+class BoolQuery(QueryNode):
+    must: List[QueryNode] = dc_field(default_factory=list)
+    filter: List[QueryNode] = dc_field(default_factory=list)
+    should: List[QueryNode] = dc_field(default_factory=list)
+    must_not: List[QueryNode] = dc_field(default_factory=list)
+    minimum_should_match: Optional[Any] = None
+
+
+@dataclass
+class ConstantScoreQuery(QueryNode):
+    filter: Optional[QueryNode] = None
+
+
+@dataclass
+class DisMaxQuery(QueryNode):
+    queries: List[QueryNode] = dc_field(default_factory=list)
+    tie_breaker: float = 0.0
+
+
+@dataclass
+class BoostingQuery(QueryNode):
+    positive: Optional[QueryNode] = None
+    negative: Optional[QueryNode] = None
+    negative_boost: float = 0.0
+
+
+@dataclass
+class QueryStringQuery(QueryNode):
+    query: str = ""
+    default_field: Optional[str] = None
+    fields: Sequence[str] = ()
+    default_operator: str = "or"
+
+
+@dataclass
+class SimpleQueryStringQuery(QueryNode):
+    query: str = ""
+    fields: Sequence[str] = ()
+    default_operator: str = "or"
+
+
+@dataclass
+class KnnQuery(QueryNode):
+    field: str = ""
+    vector: Sequence[float] = ()
+    k: int = 10
+    filter: Optional[QueryNode] = None
+
+
+@dataclass
+class ScriptScoreQuery(QueryNode):
+    query: Optional[QueryNode] = None
+    script_source: str = ""
+    script_params: dict = dc_field(default_factory=dict)
+
+
+@dataclass
+class NestedStub(QueryNode):
+    """Placeholder for not-yet-supported compound types; compile raises."""
+    type_name: str = ""
+    body: dict = dc_field(default_factory=dict)
+
+
+def _field_body(body: dict, query_name: str):
+    if not isinstance(body, dict) or len(body) != 1:
+        raise ParsingError(f"[{query_name}] query malformed, no field specified"
+                           if not body else f"[{query_name}] query doesn't support "
+                           f"multiple fields")
+    return next(iter(body.items()))
+
+
+def _as_list(nodes) -> list:
+    if nodes is None:
+        return []
+    if isinstance(nodes, list):
+        return [parse_query(n) for n in nodes]
+    return [parse_query(nodes)]
+
+
+def parse_query(q: Any) -> QueryNode:
+    if q is None:
+        return MatchAllQuery()
+    if not isinstance(q, dict) or len(q) != 1:
+        raise ParsingError("[_na] query malformed, must have exactly one query clause")
+    name, body = next(iter(q.items()))
+
+    if name == "match_all":
+        return MatchAllQuery(boost=float((body or {}).get("boost", 1.0)))
+    if name == "match_none":
+        return MatchNoneQuery()
+
+    if name == "match":
+        field, spec = _field_body(body, "match")
+        if not isinstance(spec, dict):
+            spec = {"query": spec}
+        return MatchQuery(field=field, query=spec.get("query"),
+                          operator=str(spec.get("operator", "or")).lower(),
+                          minimum_should_match=spec.get("minimum_should_match"),
+                          analyzer=spec.get("analyzer"),
+                          fuzziness=spec.get("fuzziness"),
+                          boost=float(spec.get("boost", 1.0)))
+
+    if name == "match_phrase":
+        field, spec = _field_body(body, "match_phrase")
+        if not isinstance(spec, dict):
+            spec = {"query": spec}
+        return MatchPhraseQuery(field=field, query=spec.get("query"),
+                                slop=int(spec.get("slop", 0)),
+                                analyzer=spec.get("analyzer"),
+                                boost=float(spec.get("boost", 1.0)))
+
+    if name == "match_bool_prefix":
+        field, spec = _field_body(body, "match_bool_prefix")
+        if not isinstance(spec, dict):
+            spec = {"query": spec}
+        return MatchBoolPrefixQuery(field=field, query=spec.get("query"),
+                                    analyzer=spec.get("analyzer"),
+                                    boost=float(spec.get("boost", 1.0)))
+
+    if name == "multi_match":
+        return MultiMatchQuery(fields=tuple(body.get("fields", [])),
+                               query=body.get("query"),
+                               type=body.get("type", "best_fields"),
+                               operator=str(body.get("operator", "or")).lower(),
+                               tie_breaker=float(body.get("tie_breaker", 0.0)),
+                               minimum_should_match=body.get("minimum_should_match"),
+                               boost=float(body.get("boost", 1.0)))
+
+    if name == "term":
+        field, spec = _field_body(body, "term")
+        if isinstance(spec, dict):
+            return TermQuery(field=field, value=spec.get("value"),
+                             case_insensitive=bool(spec.get("case_insensitive", False)),
+                             boost=float(spec.get("boost", 1.0)))
+        return TermQuery(field=field, value=spec)
+
+    if name == "terms":
+        body = dict(body)
+        boost = float(body.pop("boost", 1.0))
+        if len(body) != 1:
+            raise ParsingError("[terms] query requires exactly one field")
+        field, values = next(iter(body.items()))
+        if not isinstance(values, (list, tuple)):
+            raise ParsingError("[terms] query requires an array of terms")
+        return TermsQuery(field=field, values=list(values), boost=boost)
+
+    if name == "range":
+        field, spec = _field_body(body, "range")
+        if not isinstance(spec, dict):
+            raise ParsingError("[range] query malformed")
+        known = {"gte", "gt", "lte", "lt", "boost", "format", "time_zone",
+                 "from", "to", "include_lower", "include_upper", "relation"}
+        unknown = set(spec) - known
+        if unknown:
+            raise ParsingError(f"[range] query does not support [{sorted(unknown)[0]}]")
+        gte, gt, lte, lt = spec.get("gte"), spec.get("gt"), spec.get("lte"), spec.get("lt")
+        if "from" in spec:  # legacy shape
+            if spec.get("include_lower", True):
+                gte = spec["from"]
+            else:
+                gt = spec["from"]
+        if "to" in spec:
+            if spec.get("include_upper", True):
+                lte = spec["to"]
+            else:
+                lt = spec["to"]
+        return RangeQuery(field=field, gte=gte, gt=gt, lte=lte, lt=lt,
+                          fmt=spec.get("format"), time_zone=spec.get("time_zone"),
+                          boost=float(spec.get("boost", 1.0)))
+
+    if name == "exists":
+        if "field" not in body:
+            raise ParsingError("[exists] must be provided with a [field]")
+        return ExistsQuery(field=body["field"], boost=float(body.get("boost", 1.0)))
+
+    if name == "ids":
+        return IdsQuery(values=list(body.get("values", [])),
+                        boost=float(body.get("boost", 1.0)))
+
+    if name in ("prefix", "wildcard", "regexp"):
+        field, spec = _field_body(body, name)
+        cls = {"prefix": PrefixQuery, "wildcard": WildcardQuery,
+               "regexp": RegexpQuery}[name]
+        if isinstance(spec, dict):
+            value = spec.get("value", spec.get(name))
+            return cls(field=field, value=str(value),
+                       case_insensitive=bool(spec.get("case_insensitive", False)),
+                       boost=float(spec.get("boost", 1.0)))
+        return cls(field=field, value=str(spec))
+
+    if name == "fuzzy":
+        field, spec = _field_body(body, "fuzzy")
+        if isinstance(spec, dict):
+            return FuzzyQuery(field=field, value=str(spec.get("value")),
+                              fuzziness=str(spec.get("fuzziness", "AUTO")),
+                              prefix_length=int(spec.get("prefix_length", 0)),
+                              max_expansions=int(spec.get("max_expansions", 50)),
+                              boost=float(spec.get("boost", 1.0)))
+        return FuzzyQuery(field=field, value=str(spec))
+
+    if name == "bool":
+        return BoolQuery(
+            must=_as_list(body.get("must")),
+            filter=_as_list(body.get("filter")),
+            should=_as_list(body.get("should")),
+            must_not=_as_list(body.get("must_not")),
+            minimum_should_match=body.get("minimum_should_match"),
+            boost=float(body.get("boost", 1.0)))
+
+    if name == "constant_score":
+        if "filter" not in body:
+            raise ParsingError("[constant_score] requires a filter element")
+        return ConstantScoreQuery(filter=parse_query(body["filter"]),
+                                  boost=float(body.get("boost", 1.0)))
+
+    if name == "dis_max":
+        return DisMaxQuery(queries=_as_list(body.get("queries")),
+                           tie_breaker=float(body.get("tie_breaker", 0.0)),
+                           boost=float(body.get("boost", 1.0)))
+
+    if name == "boosting":
+        return BoostingQuery(positive=parse_query(body.get("positive")),
+                             negative=parse_query(body.get("negative")),
+                             negative_boost=float(body.get("negative_boost", 0.0)),
+                             boost=float(body.get("boost", 1.0)))
+
+    if name == "query_string":
+        return QueryStringQuery(query=body.get("query", ""),
+                                default_field=body.get("default_field"),
+                                fields=tuple(body.get("fields", [])),
+                                default_operator=str(body.get("default_operator",
+                                                              "or")).lower(),
+                                boost=float(body.get("boost", 1.0)))
+
+    if name == "simple_query_string":
+        return SimpleQueryStringQuery(query=body.get("query", ""),
+                                      fields=tuple(body.get("fields", [])),
+                                      default_operator=str(body.get(
+                                          "default_operator", "or")).lower(),
+                                      boost=float(body.get("boost", 1.0)))
+
+    if name == "knn":
+        field, spec = _field_body(body, "knn")
+        return KnnQuery(field=field, vector=list(spec.get("vector", [])),
+                        k=int(spec.get("k", 10)),
+                        filter=parse_query(spec["filter"]) if "filter" in spec else None,
+                        boost=float(spec.get("boost", 1.0)))
+
+    if name == "script_score":
+        script = body.get("script", {})
+        if isinstance(script, str):
+            script = {"source": script}
+        return ScriptScoreQuery(query=parse_query(body.get("query")),
+                                script_source=script.get("source", ""),
+                                script_params=script.get("params", {}),
+                                boost=float(body.get("boost", 1.0)))
+
+    raise ParsingError(f"unknown query [{name}]")
+
+
+def parse_minimum_should_match(msm: Any, n_optional: int) -> int:
+    """Reference: common/lucene/search/Queries.java calculateMinShouldMatch —
+    supports integers, negative integers, and percentages ('75%', '-25%')."""
+    if msm is None:
+        return 1 if n_optional > 0 else 0
+    text = str(msm).strip()
+    try:
+        if text.endswith("%"):
+            pct = float(text[:-1])
+            if pct < 0:
+                result = n_optional - int(-pct / 100.0 * n_optional)
+            else:
+                result = int(pct / 100.0 * n_optional)
+        else:
+            val = int(text)
+            result = n_optional + val if val < 0 else val
+    except ValueError:
+        raise ParsingError(f"Invalid minimum_should_match [{msm}]")
+    return max(0, min(result, n_optional))

@@ -1,0 +1,408 @@
+"""Shard-level search execution: the TPU QueryPhase + FetchPhase.
+
+Reference flow being re-designed (SURVEY.md §3.2): SearchService.executeQueryPhase
+(search/SearchService.java:529) builds a collector chain and runs Lucene's
+BulkScorer leaf-by-leaf; FetchPhase (search/fetch/FetchPhase.java:106) then
+loads _source for the top hits. Here the whole query phase for a segment is ONE
+jitted XLA program: evaluate the plan tree → dense (scores, matches) → masked
+top-k + total-hit count on device; the host merges per-segment candidates
+(stable score-desc/doc-asc, Lucene's tie-break) and runs the fetch phase from
+the host-side _source store.
+
+Field sort: the device selects per-segment top-k by segment-local value rank
+(correct within a segment); the host then re-keys candidates with the real
+values (exact f64 / dictionary strings) for the cross-segment merge, since
+ranks from different segments are not comparable. Docs missing the sort field
+get a sentinel key so they are fetched and sorted last, per the reference's
+missing:_last default.
+
+Compiled executables are cached by (plan signature, segment meta, k) — the
+analog of Lucene's per-(query,reader) Weight caching, but at XLA level.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from opensearch_tpu.common.errors import IllegalArgumentError, QueryShardError
+from opensearch_tpu.index.mapper import MapperService
+from opensearch_tpu.index.segment import Segment, pad_bucket
+from opensearch_tpu.ops.bm25 import (
+    ordinal_terms_match, range_match_on_ranks, score_text_clause)
+from opensearch_tpu.ops.device_segment import (
+    DeviceSegmentMeta, refresh_live, upload_segment)
+from opensearch_tpu.ops.topk import NEG_INF
+from opensearch_tpu.search import dsl
+from opensearch_tpu.search.compile import Compiler, Plan, ShardStats
+from opensearch_tpu.search.plan_eval import _eval_plan
+from opensearch_tpu.search.aggs.engine import compile_aggs, eval_aggs
+from opensearch_tpu.search.aggs.parse import parse_aggs
+from opensearch_tpu.search.aggs.reduce import decode_outputs, reduce_aggs
+
+# sort key for eligible docs that lack the sort field: far below any real
+# rank key, far above NEG_INF (which marks ineligible docs) → fetched last
+MISSING_KEY = np.float32(-1e30)
+
+
+# --------------------------------------------------------------- shard reader
+
+class ShardReader:
+    """Holds a shard's sealed segments + their device images.
+
+    Reference: the Engine.Searcher / ReaderContext pair pinned by
+    search/SearchService.java:585 createContext.
+    """
+
+    def __init__(self, mapper: MapperService, segments: Optional[List[Segment]] = None,
+                 index_name: str = "_index"):
+        self.mapper = mapper
+        self.index_name = index_name
+        self.segments: List[Segment] = []
+        self.device: List[Tuple[Dict, DeviceSegmentMeta]] = []
+        for seg in (segments or []):
+            self.add_segment(seg)
+
+    def add_segment(self, seg: Segment):
+        arrays, meta = upload_segment(seg)
+        self.segments.append(seg)
+        self.device.append((arrays, meta))
+
+    def remove_segment(self, seg_id: str):
+        for i, seg in enumerate(self.segments):
+            if seg.seg_id == seg_id:
+                del self.segments[i]
+                del self.device[i]
+                return
+
+    def notify_deletes(self, seg: Segment):
+        for i, s in enumerate(self.segments):
+            if s is seg:
+                arrays, meta = self.device[i]
+                self.device[i] = (refresh_live(arrays, seg), meta)
+
+    @property
+    def num_docs(self) -> int:
+        return sum(s.live_doc_count for s in self.segments)
+
+    def stats(self) -> ShardStats:
+        return ShardStats(self.segments)
+
+
+# ------------------------------------------------------------------ execution
+
+_JIT_CACHE: Dict[Any, Any] = {}
+
+
+def _runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int, sort_mode: str,
+            agg_plans=()):
+    key = (plan_sig, meta, k, sort_mode, tuple(a.sig() for a in agg_plans))
+    fn = _JIT_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    def run(seg, flat_inputs, sort_key_arr, min_score):
+        cursor = [0]
+        scores, matches = _eval_plan(plan, seg, flat_inputs, cursor)
+        d_pad = seg["live"].shape[0]
+        in_seg = jnp.arange(d_pad, dtype=jnp.int32) < meta.num_docs
+        eligible = matches & seg["live"] & in_seg & (scores >= min_score)
+        total = jnp.sum(eligible.astype(jnp.int32))
+        keys = scores if sort_mode == "score" else sort_key_arr
+        masked = jnp.where(eligible, keys, NEG_INF)
+        k_eff = min(k, d_pad)
+        top_keys, top_idx = jax.lax.top_k(masked, k_eff)
+        top_scores = scores[top_idx]
+        agg_outs = []
+        if agg_plans:
+            root_eff = jnp.zeros(d_pad, jnp.int32)
+            eval_aggs(list(agg_plans), seg, flat_inputs, cursor, eligible,
+                      root_eff, 1, agg_outs)
+        return top_keys, top_scores, top_idx.astype(jnp.int32), total, agg_outs
+
+    fn = jax.jit(run)
+    _JIT_CACHE[key] = fn
+    return fn
+
+
+def _build_sort_key(arrays, primary_sort) -> jnp.ndarray:
+    """Dense per-doc f32 key for the device's per-segment top-k selection
+    (segment-local value ranks; higher sorts first; missing → MISSING_KEY)."""
+    d_pad = arrays["live"].shape[0]
+    if primary_sort is None:
+        return jnp.zeros(d_pad, jnp.float32)
+    field, order = primary_sort
+    col = arrays["numeric"].get(field)
+    if col is not None:
+        if order == "asc":
+            key = -col["min_rank"].astype(jnp.float32)
+        else:
+            key = col["max_rank"].astype(jnp.float32)
+        return jnp.where(col["exists"], key, MISSING_KEY)
+    col = arrays["ordinal"].get(field)
+    if col is not None:
+        pair_valid = col["doc_ids"] >= 0
+        idx = jnp.where(pair_valid, col["doc_ids"], d_pad)
+        if order == "asc":
+            dense = jnp.full(d_pad, 2 ** 30, jnp.int32).at[idx].min(
+                jnp.where(pair_valid, col["ords"], 2 ** 30), mode="drop")
+            key = -dense.astype(jnp.float32)
+        else:
+            dense = jnp.full(d_pad, -1, jnp.int32).at[idx].max(
+                jnp.where(pair_valid, col["ords"], -1), mode="drop")
+            key = dense.astype(jnp.float32)
+        return jnp.where(col["exists"], key, MISSING_KEY)
+    return jnp.full(d_pad, MISSING_KEY, jnp.float32)
+
+
+class _Candidate:
+    __slots__ = ("score", "seg_i", "ord", "sort_values")
+
+    def __init__(self, score, seg_i, ord_, sort_values):
+        self.score = score
+        self.seg_i = seg_i
+        self.ord = ord_
+        self.sort_values = sort_values  # list parallel to sort specs; None = missing
+
+
+def _compare_candidates(specs):
+    """Multi-key comparator with missing-last semantics (reference default)."""
+    def cmp(a: _Candidate, b: _Candidate) -> int:
+        for i, (field, order) in enumerate(specs):
+            va, vb = a.sort_values[i], b.sort_values[i]
+            if va is None and vb is None:
+                continue
+            if va is None:
+                return 1   # missing sorts last
+            if vb is None:
+                return -1
+            if va != vb:
+                lt = va < vb
+                if order == "desc":
+                    lt = not lt
+                return -1 if lt else 1
+        if a.seg_i != b.seg_i:
+            return -1 if a.seg_i < b.seg_i else 1
+        return -1 if a.ord < b.ord else 1
+    return functools.cmp_to_key(cmp)
+
+
+class SearchExecutor:
+    """Executes a parsed search request against one shard (query + fetch)."""
+
+    def __init__(self, reader: ShardReader):
+        self.reader = reader
+
+    def search(self, body: Optional[dict] = None) -> dict:
+        body = body or {}
+        start = time.monotonic()
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        if size < 0 or from_ < 0:
+            raise IllegalArgumentError("[from] and [size] must be non-negative")
+        node = dsl.parse_query(body.get("query"))
+        min_score = float(body["min_score"]) if body.get("min_score") is not None \
+            else NEG_INF
+
+        sort_specs = _parse_sort(body.get("sort"))
+        score_sorted = sort_specs[0][0] == "_score"
+        primary = None if score_sorted else sort_specs[0]
+        wants_score = score_sorted or any(f == "_score" for f, _ in sort_specs) \
+            or bool(body.get("track_scores", False))
+
+        stats = self.reader.stats()
+        compiler = Compiler(self.reader.mapper, stats)
+        agg_nodes = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        from opensearch_tpu.search.aggs.parse import PIPELINE_TYPES
+        device_agg_nodes = [n for n in agg_nodes
+                            if n.type not in PIPELINE_TYPES]
+        k = max(from_ + size, 10)
+        k_fetch = min(k + 128, 1 << 16)  # over-fetch for ties & cross-seg merge
+
+        candidates: List[_Candidate] = []
+        per_segment_decoded = []
+        total = 0
+        for seg_i, (seg, (arrays, meta)) in enumerate(
+                zip(self.reader.segments, self.reader.device)):
+            if seg.num_docs == 0:
+                continue
+            plan = compiler.compile(node, seg, meta)
+            agg_plans = compile_aggs(device_agg_nodes, self.reader.mapper, seg,
+                                     meta, compiler) if agg_nodes else []
+            sort_key = _build_sort_key(arrays, primary)
+            fn = _runner(plan.sig(), plan, meta,
+                         min(k_fetch, pad_bucket(max(seg.num_docs, 1))),
+                         "score" if score_sorted else "field",
+                         tuple(agg_plans))
+            flat = plan.flatten_inputs([])
+            for ap in agg_plans:
+                ap.flatten_inputs(flat)
+            flat = jax.tree_util.tree_map(jnp.asarray, flat)
+            top_keys, top_scores, top_idx, seg_total, agg_outs = fn(
+                arrays, flat, sort_key, jnp.float32(min_score))
+            if agg_nodes:
+                agg_outs = jax.tree_util.tree_map(np.asarray, agg_outs)
+                per_segment_decoded.append(decode_outputs(agg_plans, agg_outs))
+            top_keys = np.asarray(top_keys)
+            top_scores = np.asarray(top_scores)
+            top_idx = np.asarray(top_idx)
+            total += int(seg_total)
+            for key_val, score, ord_ in zip(top_keys, top_scores, top_idx):
+                if key_val == NEG_INF:
+                    continue  # ineligible / padding
+                sort_values = [
+                    float(score) if f == "_score" else _sort_value(seg, f, o, int(ord_))
+                    for f, o in sort_specs]
+                candidates.append(_Candidate(float(score), seg_i, int(ord_),
+                                             sort_values))
+
+        candidates.sort(key=_compare_candidates(sort_specs))
+        page = candidates[from_:from_ + size]
+
+        max_score = None
+        if score_sorted or wants_score:
+            for c in candidates:
+                if max_score is None or c.score > max_score:
+                    max_score = c.score
+
+        hits = []
+        for c in page:
+            seg = self.reader.segments[c.seg_i]
+            hit = {
+                "_index": self.reader.index_name,
+                "_id": seg.doc_ids[c.ord],
+                "_score": c.score if wants_score else None,
+            }
+            src = _filter_source(seg.sources[c.ord], body.get("_source", True))
+            if src is not None:
+                hit["_source"] = src
+            if not score_sorted:
+                hit["sort"] = c.sort_values
+            hits.append(hit)
+
+        took_ms = int((time.monotonic() - start) * 1000)
+        resp = {
+            "took": took_ms,
+            "timed_out": False,
+            "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
+            "hits": {
+                "total": {"value": total, "relation": "eq"},
+                "max_score": max_score,
+                "hits": hits,
+            },
+        }
+        if agg_nodes:
+            from opensearch_tpu.search.aggs.pipeline import apply_pipelines
+            aggregations = reduce_aggs(per_segment_decoded)
+            apply_pipelines(agg_nodes, aggregations)
+            resp["aggregations"] = aggregations
+        return resp
+
+    def count(self, body: Optional[dict] = None) -> int:
+        body = dict(body or {})
+        body["size"] = 0
+        body.pop("from", None)
+        return self.search(body)["hits"]["total"]["value"]
+
+
+def _parse_sort(sort_body) -> List[Tuple[str, str]]:
+    """Normalize the sort body to [(field | '_score', order), ...].
+    Default (None / empty / '_score') is score-descending."""
+    if sort_body is None:
+        return [("_score", "desc")]
+    specs = sort_body if isinstance(sort_body, list) else [sort_body]
+    out: List[Tuple[str, str]] = []
+    for spec in specs:
+        if isinstance(spec, str):
+            if spec == "_score":
+                out.append(("_score", "desc"))
+            elif spec == "_doc":
+                continue  # doc order is the built-in final tie-break
+            else:
+                out.append((spec, "asc"))
+        elif isinstance(spec, dict):
+            field, opts = next(iter(spec.items()))
+            if field == "_score":
+                order = opts.get("order", "desc") if isinstance(opts, dict) \
+                    else str(opts)
+                out.append(("_score", order))
+            else:
+                order = opts.get("order", "asc") if isinstance(opts, dict) \
+                    else str(opts)
+                out.append((field, order))
+    if not out:
+        return [("_score", "desc")]
+    return out
+
+
+def _sort_value(seg: Segment, field: str, order: str, ord_: int):
+    """Real (host, exact) sort value for the cross-segment merge + response."""
+    col = seg.numeric_dv.get(field)
+    if col is not None:
+        vals = col.values[col.doc_ids == ord_]
+        if len(vals) == 0:
+            return None
+        v = float(vals.min() if order == "asc" else vals.max())
+        return int(v) if v.is_integer() else v
+    ocol = seg.ordinal_dv.get(field)
+    if ocol is not None:
+        ords = ocol.ords[ocol.doc_ids == ord_]
+        if len(ords) == 0:
+            return None
+        o = int(ords.min() if order == "asc" else ords.max())
+        return ocol.dictionary[o]
+    return None
+
+
+def _filter_source(source: Optional[dict], source_spec) -> Optional[dict]:
+    """_source filtering per the reference's FetchSourceContext: an include
+    pattern selects its whole subtree; excludes override includes."""
+    if source is None or source_spec is True or source_spec is None:
+        return source
+    if source_spec is False:
+        return None
+    import fnmatch as _fn
+
+    if isinstance(source_spec, str):
+        includes, excludes = [source_spec], []
+    elif isinstance(source_spec, list):
+        includes, excludes = list(source_spec), []
+    elif isinstance(source_spec, dict):
+        includes = source_spec.get("includes", source_spec.get("include", []))
+        excludes = source_spec.get("excludes", source_spec.get("exclude", []))
+        if isinstance(includes, str):
+            includes = [includes]
+        if isinstance(excludes, str):
+            excludes = [excludes]
+    else:
+        return source
+
+    def matches_any(path: str, patterns) -> bool:
+        # a pattern matches the leaf itself or any ancestor object path
+        parts = path.split(".")
+        prefixes = [".".join(parts[:i + 1]) for i in range(len(parts))]
+        return any(_fn.fnmatchcase(prefix, p)
+                   for prefix in prefixes for p in patterns)
+
+    def walk(obj, path=""):
+        if not isinstance(obj, dict):
+            return obj
+        out = {}
+        for k, v in obj.items():
+            full = f"{path}{k}"
+            if isinstance(v, dict):
+                sub = walk(v, f"{full}.")
+                if sub:
+                    out[k] = sub
+                continue
+            if matches_any(full, includes) if includes else True:
+                if not matches_any(full, excludes):
+                    out[k] = v
+        return out
+
+    return walk(source)

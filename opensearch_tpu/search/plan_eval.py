@@ -1,0 +1,122 @@
+"""Device-side evaluation of compiled query plans.
+
+Shared by the search executor (search/executor.py) and the aggregation engine
+(search/aggs/engine.py — filter/filters aggs embed query plans). The traced
+structure is static per plan signature; only the numpy inputs vary.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax.numpy as jnp
+
+from opensearch_tpu.common.errors import QueryShardError
+from opensearch_tpu.ops.bm25 import (
+    ordinal_terms_match, range_match_on_ranks, score_text_clause)
+from opensearch_tpu.search.compile import Plan
+
+def _eval_plan(plan: Plan, seg: Dict, inputs: List[Dict], cursor: List[int]):
+    my = inputs[cursor[0]]
+    cursor[0] += 1
+    d_pad = seg["live"].shape[0]
+    kind = plan.kind
+
+    if kind == "match_all":
+        return (jnp.full(d_pad, my["boost"], jnp.float32),
+                jnp.ones(d_pad, jnp.bool_))
+
+    if kind == "match_none":
+        return (jnp.zeros(d_pad, jnp.float32), jnp.zeros(d_pad, jnp.bool_))
+
+    if kind == "text":
+        constant = plan.static[0]
+        scores, hits = score_text_clause(seg, my, my["k1"])
+        matches = hits >= my["min_hits"]
+        if constant:
+            scores = jnp.where(matches, my["boost"], 0.0)
+        else:
+            scores = jnp.where(matches, scores, 0.0)
+        return scores, matches
+
+    if kind == "precomputed":
+        return my["scores"], my["matches"]
+
+    if kind == "num_terms":
+        col = seg["numeric"][plan.static[0]]
+        matches = ordinal_terms_match(col["doc_ids"], col["val_ords"],
+                                      my["mask"], d_pad)
+        return jnp.where(matches, my["boost"], 0.0), matches
+
+    if kind == "range_num":
+        col = seg["numeric"][plan.static[0]]
+        matches = range_match_on_ranks(col["doc_ids"], col["val_ords"],
+                                       my["lo"], my["hi"], d_pad)
+        return jnp.where(matches, my["boost"], 0.0), matches
+
+    if kind == "range_ord":
+        col = seg["ordinal"][plan.static[0]]
+        matches = range_match_on_ranks(col["doc_ids"], col["ords"],
+                                       my["lo"], my["hi"], d_pad)
+        return jnp.where(matches, my["boost"], 0.0), matches
+
+    if kind == "exists":
+        ctype, key = plan.static
+        if ctype == "numeric":
+            matches = seg["numeric"][key]["exists"]
+        elif ctype == "ordinal":
+            matches = seg["ordinal"][key]["exists"]
+        elif ctype == "vector":
+            matches = seg["vector"][key]["exists"]
+        else:  # norms row
+            matches = seg["norms"][key] > 0
+        return jnp.where(matches, my["boost"], 0.0), matches
+
+    if kind == "bool":
+        n_must, n_filter, n_should, n_must_not = plan.static
+        child_results = [_eval_plan(c, seg, inputs, cursor) for c in plan.children]
+        must = child_results[:n_must]
+        filt = child_results[n_must:n_must + n_filter]
+        should = child_results[n_must + n_filter:n_must + n_filter + n_should]
+        must_not = child_results[n_must + n_filter + n_should:]
+        matches = jnp.ones(d_pad, jnp.bool_)
+        scores = jnp.zeros(d_pad, jnp.float32)
+        for s, m in must:
+            matches &= m
+            scores += s
+        for _, m in filt:
+            matches &= m
+        if should:
+            should_count = jnp.zeros(d_pad, jnp.int32)
+            for s, m in should:
+                should_count += m.astype(jnp.int32)
+                scores += s
+            matches &= should_count >= my["msm"]
+        for _, m in must_not:
+            matches &= ~m
+        scores = jnp.where(matches, scores * my["boost"], 0.0)
+        return scores, matches
+
+    if kind == "const_score":
+        _, m = _eval_plan(plan.children[0], seg, inputs, cursor)
+        return jnp.where(m, my["boost"], 0.0), m
+
+    if kind == "dis_max":
+        child_results = [_eval_plan(c, seg, inputs, cursor) for c in plan.children]
+        matches = jnp.zeros(d_pad, jnp.bool_)
+        best = jnp.zeros(d_pad, jnp.float32)
+        total = jnp.zeros(d_pad, jnp.float32)
+        for s, m in child_results:
+            matches |= m
+            best = jnp.maximum(best, s)
+            total += s
+        scores = best + my["tie"] * (total - best)
+        return jnp.where(matches, scores * my["boost"], 0.0), matches
+
+    if kind == "boosting":
+        pos_s, pos_m = _eval_plan(plan.children[0], seg, inputs, cursor)
+        neg_s, neg_m = _eval_plan(plan.children[1], seg, inputs, cursor)
+        scores = pos_s * jnp.where(neg_m, my["nb"], 1.0)
+        return jnp.where(pos_m, scores * my["boost"], 0.0), pos_m
+
+    raise QueryShardError(f"unknown plan kind [{kind}]")

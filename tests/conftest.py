@@ -9,10 +9,19 @@ Must run before jax is imported anywhere.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu: the ambient environment pins JAX_PLATFORMS=axon (the real TPU
+# tunnel) and sitecustomize imports jax at interpreter startup, latching that
+# value — setting os.environ here is too late. Tests must never touch the
+# axon tunnel (it serializes all clients and wedges under concurrent test
+# processes), so override via jax.config, which works post-import.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,78 @@
+"""Pure-numpy reference implementation of Lucene/OpenSearch BM25 semantics.
+
+Used as the parity oracle for the device kernels: idf and length-norm math
+follow LegacyBM25Similarity (the reference's default similarity,
+index/similarity/SimilarityService.java:85) including SmallFloat norm
+quantization of doc length.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from opensearch_tpu.index.segment import (
+    smallfloat_byte4_to_int, smallfloat_int_to_byte4)
+
+K1 = 1.2
+B = 0.75
+
+
+class RefField:
+    """One text field over a corpus of already-analyzed docs."""
+
+    def __init__(self, docs_terms: Sequence[Sequence[str]]):
+        # docs with no value for the field are represented by None
+        self.docs = [list(d) if d is not None else None for d in docs_terms]
+        self.doc_count = sum(1 for d in self.docs if d is not None)
+        self.sum_ttf = sum(len(d) for d in self.docs if d is not None)
+        self.avgdl = self.sum_ttf / self.doc_count if self.doc_count else 1.0
+        self.df: Dict[str, int] = {}
+        for d in self.docs:
+            if d is None:
+                continue
+            for t in set(d):
+                self.df[t] = self.df.get(t, 0) + 1
+
+    def idf(self, term: str) -> float:
+        df = self.df.get(term, 0)
+        if df == 0:
+            return 0.0
+        return math.log(1.0 + (self.doc_count - df + 0.5) / (df + 0.5))
+
+    def norm_dl(self, doc_i: int) -> float:
+        d = self.docs[doc_i]
+        if d is None:
+            return 0.0
+        return float(smallfloat_byte4_to_int(smallfloat_int_to_byte4(len(d))))
+
+    def bm25(self, doc_i: int, term: str, boost: float = 1.0) -> float:
+        d = self.docs[doc_i]
+        if d is None:
+            return 0.0
+        tf = d.count(term)
+        if tf == 0:
+            return 0.0
+        dl = self.norm_dl(doc_i)
+        denom = tf + K1 * (1 - B + B * dl / self.avgdl)
+        return boost * self.idf(term) * tf * (K1 + 1) / denom
+
+    def match_scores(self, terms: Sequence[str], operator: str = "or",
+                     boost: float = 1.0) -> np.ndarray:
+        """Per-doc scores of a match query; 0 where the doc doesn't match."""
+        n = len(self.docs)
+        out = np.zeros(n, dtype=np.float64)
+        for i in range(n):
+            if self.docs[i] is None:
+                continue
+            hit_terms = [t for t in set(terms) if t in self.docs[i]]
+            if operator == "and" and len(hit_terms) != len(set(terms)):
+                continue
+            if not hit_terms:
+                continue
+            # duplicate query terms score multiple times (Lucene sums clauses)
+            score = sum(self.bm25(i, t, boost) for t in terms if t in self.docs[i])
+            out[i] = score
+        return out

@@ -1,0 +1,330 @@
+"""Aggregations framework tests.
+
+Contract model: reference agg semantics (search/aggregations/) — bucket
+counts, metric values, nesting via bucketOrd composition, two-level reduce
+across segments, pipeline aggs on the reduced tree.
+"""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.mapper import MapperService
+from opensearch_tpu.index.segment import SegmentBuilder
+from opensearch_tpu.search.executor import SearchExecutor, ShardReader
+
+MAPPING = {"properties": {
+    "cat": {"type": "keyword"},
+    "brand": {"type": "keyword"},
+    "price": {"type": "double"},
+    "qty": {"type": "integer"},
+    "day": {"type": "date"},
+    "desc": {"type": "text"},
+}}
+
+DOCS = [
+    {"cat": "a", "brand": "x", "price": 10.0, "qty": 1, "day": "2024-01-05", "desc": "red fox"},
+    {"cat": "a", "brand": "y", "price": 20.0, "qty": 2, "day": "2024-01-15", "desc": "blue fox"},
+    {"cat": "b", "brand": "x", "price": 30.0, "qty": 3, "day": "2024-02-10", "desc": "red dog"},
+    {"cat": "b", "brand": "y", "price": 40.0, "qty": 4, "day": "2024-02-20", "desc": "lazy dog"},
+    {"cat": "b", "brand": "x", "price": 50.0, "qty": 5, "day": "2024-03-01", "desc": "red cat"},
+    {"cat": "c", "price": 60.0, "qty": 6, "day": "2024-03-15", "desc": "gray cat"},
+    {"qty": 7, "desc": "no cat field"},
+]
+
+
+def build_executor(split=None):
+    mapper = MapperService(MAPPING)
+    if split is None:
+        split = [len(DOCS)]
+    segs = []
+    i = 0
+    for si, n in enumerate(split):
+        b = SegmentBuilder(mapper, f"s{si}")
+        for d in DOCS[i:i + n]:
+            b.add(mapper.parse_document(f"d{i}", d))
+            i += 1
+        segs.append(b.seal())
+    return SearchExecutor(ShardReader(mapper, segs))
+
+
+@pytest.fixture(scope="module", params=[(7,), (3, 4), (2, 2, 3)],
+                ids=["1seg", "2seg", "3seg"])
+def executor(request):
+    return build_executor(list(request.param))
+
+
+def agg(executor, aggs, query=None, **kw):
+    body = {"size": 0, "aggs": aggs}
+    if query is not None:
+        body["query"] = query
+    body.update(kw)
+    return executor.search(body)["aggregations"]
+
+
+def test_terms_basic(executor):
+    out = agg(executor, {"cats": {"terms": {"field": "cat"}}})
+    buckets = out["cats"]["buckets"]
+    assert [(b["key"], b["doc_count"]) for b in buckets] == [
+        ("b", 3), ("a", 2), ("c", 1)]
+    assert out["cats"]["sum_other_doc_count"] == 0
+    assert out["cats"]["doc_count_error_upper_bound"] == 0
+
+
+def test_terms_size_and_order(executor):
+    out = agg(executor, {"cats": {"terms": {"field": "cat", "size": 1}}})
+    assert [b["key"] for b in out["cats"]["buckets"]] == ["b"]
+    assert out["cats"]["sum_other_doc_count"] == 3
+    out = agg(executor, {"cats": {"terms": {"field": "cat",
+                                            "order": {"_key": "asc"}}}})
+    assert [b["key"] for b in out["cats"]["buckets"]] == ["a", "b", "c"]
+
+
+def test_terms_with_query_filter(executor):
+    out = agg(executor, {"cats": {"terms": {"field": "cat"}}},
+              query={"match": {"desc": "red"}})
+    assert {b["key"]: b["doc_count"] for b in out["cats"]["buckets"]} == {
+        "a": 1, "b": 2}
+
+
+def test_terms_numeric(executor):
+    out = agg(executor, {"qtys": {"terms": {"field": "qty", "size": 20}}})
+    assert {b["key"]: b["doc_count"] for b in out["qtys"]["buckets"]} == {
+        i: 1 for i in range(1, 8)}
+
+
+def test_terms_nested_sub_metric(executor):
+    out = agg(executor, {"cats": {"terms": {"field": "cat"},
+                                  "aggs": {"avg_price": {"avg": {"field": "price"}}}}})
+    by_key = {b["key"]: b for b in out["cats"]["buckets"]}
+    assert by_key["a"]["avg_price"]["value"] == pytest.approx(15.0)
+    assert by_key["b"]["avg_price"]["value"] == pytest.approx(40.0)
+    assert by_key["c"]["avg_price"]["value"] == pytest.approx(60.0)
+
+
+def test_terms_nested_terms(executor):
+    out = agg(executor, {"cats": {"terms": {"field": "cat"},
+                                  "aggs": {"brands": {"terms": {"field": "brand"}}}}})
+    by_key = {b["key"]: b for b in out["cats"]["buckets"]}
+    assert {b["key"]: b["doc_count"] for b in by_key["b"]["brands"]["buckets"]} \
+        == {"x": 2, "y": 1}
+    assert {b["key"]: b["doc_count"] for b in by_key["a"]["brands"]["buckets"]} \
+        == {"x": 1, "y": 1}
+
+
+def test_metrics(executor):
+    out = agg(executor, {
+        "mn": {"min": {"field": "price"}}, "mx": {"max": {"field": "price"}},
+        "sm": {"sum": {"field": "price"}}, "av": {"avg": {"field": "price"}},
+        "vc": {"value_count": {"field": "price"}},
+        "st": {"stats": {"field": "price"}},
+        "xs": {"extended_stats": {"field": "price"}},
+    })
+    prices = [10, 20, 30, 40, 50, 60]
+    assert out["mn"]["value"] == 10.0
+    assert out["mx"]["value"] == 60.0
+    assert out["sm"]["value"] == pytest.approx(sum(prices))
+    assert out["av"]["value"] == pytest.approx(np.mean(prices))
+    assert out["vc"]["value"] == 6
+    assert out["st"]["count"] == 6
+    assert out["st"]["avg"] == pytest.approx(35.0)
+    assert out["xs"]["variance"] == pytest.approx(np.var(prices))
+    assert out["xs"]["std_deviation"] == pytest.approx(np.std(prices))
+
+
+def test_histogram(executor):
+    out = agg(executor, {"h": {"histogram": {"field": "price", "interval": 25}}})
+    assert [(b["key"], b["doc_count"]) for b in out["h"]["buckets"]] == [
+        (0.0, 2), (25.0, 2), (50.0, 2)]
+
+
+def test_histogram_empty_buckets_filled(executor):
+    out = agg(executor, {"h": {"histogram": {"field": "qty", "interval": 2}}},
+              query={"terms": {"qty": [1, 7]}})
+    keys = [(b["key"], b["doc_count"]) for b in out["h"]["buckets"]]
+    assert keys == [(0.0, 1), (2.0, 0), (4.0, 0), (6.0, 1)]
+
+
+def test_date_histogram_month(executor):
+    out = agg(executor, {"m": {"date_histogram": {"field": "day",
+                                                  "calendar_interval": "month"}}})
+    buckets = out["m"]["buckets"]
+    assert [b["doc_count"] for b in buckets] == [2, 2, 2]
+    assert buckets[0]["key_as_string"].startswith("2024-01-01")
+    assert buckets[1]["key_as_string"].startswith("2024-02-01")
+
+
+def test_date_histogram_fixed(executor):
+    out = agg(executor, {"w": {"date_histogram": {"field": "day",
+                                                  "fixed_interval": "30d"}}})
+    total = sum(b["doc_count"] for b in out["w"]["buckets"])
+    assert total == 6
+
+
+def test_range_agg(executor):
+    out = agg(executor, {"r": {"range": {"field": "price", "ranges": [
+        {"to": 25}, {"from": 25, "to": 45}, {"from": 45}]}}})
+    buckets = out["r"]["buckets"]
+    assert [b["doc_count"] for b in buckets] == [2, 2, 2]
+    assert buckets[0]["key"] == "*-25"
+    assert buckets[1]["from"] == 25.0 and buckets[1]["to"] == 45.0
+
+
+def test_range_agg_with_sub(executor):
+    out = agg(executor, {"r": {"range": {"field": "price",
+                                         "ranges": [{"from": 25}]},
+                               "aggs": {"s": {"sum": {"field": "qty"}}}}})
+    assert out["r"]["buckets"][0]["s"]["value"] == pytest.approx(3 + 4 + 5 + 6)
+
+
+def test_filter_agg(executor):
+    out = agg(executor, {"red": {"filter": {"match": {"desc": "red"}},
+                                 "aggs": {"mx": {"max": {"field": "price"}}}}})
+    assert out["red"]["doc_count"] == 3
+    assert out["red"]["mx"]["value"] == 50.0
+
+
+def test_filters_agg(executor):
+    out = agg(executor, {"f": {"filters": {"filters": {
+        "cheap": {"range": {"price": {"lt": 25}}},
+        "foxy": {"match": {"desc": "fox"}}}}}})
+    assert out["f"]["buckets"]["cheap"]["doc_count"] == 2
+    assert out["f"]["buckets"]["foxy"]["doc_count"] == 2
+
+
+def test_global_agg(executor):
+    out = agg(executor, {"all": {"global": {},
+                                 "aggs": {"c": {"value_count": {"field": "qty"}}}},
+                         "local": {"value_count": {"field": "qty"}}},
+              query={"term": {"cat": "a"}})
+    assert out["all"]["doc_count"] == 7
+    assert out["all"]["c"]["value"] == 7
+    assert out["local"]["value"] == 2
+
+
+def test_missing_agg(executor):
+    out = agg(executor, {"nocat": {"missing": {"field": "cat"}}})
+    assert out["nocat"]["doc_count"] == 1
+    out = agg(executor, {"noprice": {"missing": {"field": "price"}}})
+    assert out["noprice"]["doc_count"] == 1
+
+
+def test_cardinality(executor):
+    out = agg(executor, {"c": {"cardinality": {"field": "cat"}},
+                         "n": {"cardinality": {"field": "qty"}}})
+    assert out["c"]["value"] == 3
+    assert out["n"]["value"] == 7
+
+
+def test_cardinality_under_terms(executor):
+    out = agg(executor, {"cats": {"terms": {"field": "cat"},
+                                  "aggs": {"brands": {"cardinality": {"field": "brand"}}}}})
+    by_key = {b["key"]: b["brands"]["value"] for b in out["cats"]["buckets"]}
+    assert by_key == {"a": 2, "b": 2, "c": 0}
+
+
+def test_percentiles_exact(executor):
+    out = agg(executor, {"p": {"percentiles": {"field": "price",
+                                               "percents": [50, 90]}}})
+    prices = np.array([10, 20, 30, 40, 50, 60], dtype=float)
+    assert out["p"]["values"]["50.0"] == pytest.approx(np.percentile(prices, 50))
+    assert out["p"]["values"]["90.0"] == pytest.approx(np.percentile(prices, 90))
+
+
+def test_percentile_ranks(executor):
+    out = agg(executor, {"p": {"percentile_ranks": {"field": "price",
+                                                    "values": [30, 60]}}})
+    assert out["p"]["values"]["30.0"] == pytest.approx(100 * 3 / 6)
+    assert out["p"]["values"]["60.0"] == pytest.approx(100.0)
+
+
+def test_weighted_avg(executor):
+    out = agg(executor, {"w": {"weighted_avg": {"value": {"field": "price"},
+                                                "weight": {"field": "qty"}}}})
+    prices = np.array([10, 20, 30, 40, 50, 60], dtype=float)
+    qtys = np.array([1, 2, 3, 4, 5, 6], dtype=float)
+    assert out["w"]["value"] == pytest.approx(float((prices * qtys).sum() / qtys.sum()))
+
+
+def test_median_absolute_deviation(executor):
+    out = agg(executor, {"m": {"median_absolute_deviation": {"field": "price"}}})
+    prices = np.array([10, 20, 30, 40, 50, 60], dtype=float)
+    med = np.median(prices)
+    assert out["m"]["value"] == pytest.approx(np.median(np.abs(prices - med)))
+
+
+def test_stats_under_date_histogram(executor):
+    out = agg(executor, {"m": {"date_histogram": {"field": "day",
+                                                  "calendar_interval": "month"},
+                               "aggs": {"s": {"stats": {"field": "price"}}}}})
+    first = out["m"]["buckets"][0]["s"]
+    assert first["count"] == 2 and first["sum"] == pytest.approx(30.0)
+
+
+# ----------------------------------------------------------------- pipelines
+
+def test_cumulative_sum_and_derivative(executor):
+    out = agg(executor, {"m": {
+        "date_histogram": {"field": "day", "calendar_interval": "month"},
+        "aggs": {
+            "sales": {"sum": {"field": "price"}},
+            "cum": {"cumulative_sum": {"buckets_path": "sales"}},
+            "diff": {"derivative": {"buckets_path": "sales"}},
+        }}})
+    buckets = out["m"]["buckets"]
+    sales = [b["sales"]["value"] for b in buckets]
+    assert sales == [30.0, 70.0, 110.0]
+    assert [b["cum"]["value"] for b in buckets] == [30.0, 100.0, 210.0]
+    assert "diff" not in buckets[0]
+    assert buckets[1]["diff"]["value"] == pytest.approx(40.0)
+    assert buckets[2]["diff"]["value"] == pytest.approx(40.0)
+
+
+def test_sibling_pipelines(executor):
+    out = agg(executor, {
+        "m": {"date_histogram": {"field": "day", "calendar_interval": "month"},
+              "aggs": {"sales": {"sum": {"field": "price"}}}},
+        "avg_sales": {"avg_bucket": {"buckets_path": "m>sales"}},
+        "max_sales": {"max_bucket": {"buckets_path": "m>sales"}},
+        "total": {"sum_bucket": {"buckets_path": "m>sales"}},
+    })
+    assert out["avg_sales"]["value"] == pytest.approx(70.0)
+    assert out["max_sales"]["value"] == pytest.approx(110.0)
+    assert out["total"]["value"] == pytest.approx(210.0)
+
+
+def test_bucket_script_and_selector(executor):
+    out = agg(executor, {"cats": {
+        "terms": {"field": "cat"},
+        "aggs": {
+            "p": {"sum": {"field": "price"}},
+            "q": {"sum": {"field": "qty"}},
+            "ratio": {"bucket_script": {"buckets_path": {"p": "p", "q": "q"},
+                                        "script": "p / q"}},
+            "keep": {"bucket_selector": {"buckets_path": {"c": "_count"},
+                                         "script": "c >= 2"}},
+        }}})
+    buckets = out["cats"]["buckets"]
+    assert all(b["doc_count"] >= 2 for b in buckets)
+    keys = {b["key"] for b in buckets}
+    assert keys == {"a", "b"}
+    by_key = {b["key"]: b for b in buckets}
+    assert by_key["a"]["ratio"]["value"] == pytest.approx(30.0 / 3.0)
+
+
+def test_bucket_sort(executor):
+    out = agg(executor, {"cats": {
+        "terms": {"field": "cat", "order": {"_key": "asc"}},
+        "aggs": {
+            "p": {"sum": {"field": "price"}},
+            "srt": {"bucket_sort": {"sort": [{"p": {"order": "desc"}}],
+                                    "size": 2}},
+        }}})
+    buckets = out["cats"]["buckets"]
+    assert [b["key"] for b in buckets] == ["b", "c"]
+
+
+def test_agg_on_unmapped_field(executor):
+    out = agg(executor, {"x": {"terms": {"field": "ghost"}},
+                         "y": {"sum": {"field": "ghost"}}})
+    assert out["x"]["buckets"] == []
+    assert out["y"]["value"] == 0

@@ -1,0 +1,344 @@
+"""End-to-end single-shard search tests: DSL → compile → jit → top-k → fetch.
+
+Contract model: the reference's query DSL semantics (index/query/*QueryBuilder
+toQuery behavior) and BM25 score parity with Lucene via the numpy oracle in
+reference_impl.py.
+"""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.analysis import get_default_registry
+from opensearch_tpu.index.mapper import MapperService
+from opensearch_tpu.index.segment import SegmentBuilder
+from opensearch_tpu.search.executor import SearchExecutor, ShardReader
+
+from reference_impl import RefField
+
+MAPPING = {
+    "properties": {
+        "title": {"type": "text", "fields": {"keyword": {"type": "keyword"}}},
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "views": {"type": "integer"},
+        "price": {"type": "double"},
+        "published": {"type": "date"},
+        "active": {"type": "boolean"},
+    }
+}
+
+DOCS = [
+    {"title": "red fox", "body": "the quick brown fox jumps over the lazy dog",
+     "tag": "animal", "views": 100, "price": 5.0, "published": "2024-01-01",
+     "active": True},
+    {"title": "lazy dog", "body": "the dog sleeps all day the dog is lazy",
+     "tag": "animal", "views": 50, "price": 15.5, "published": "2024-02-01",
+     "active": False},
+    {"title": "quick start guide", "body": "a quick guide to get started quick",
+     "tag": "docs", "views": 200, "price": 0.0, "published": "2024-03-15",
+     "active": True},
+    {"title": "fox hunting", "body": "fox fox fox everywhere a fox",
+     "tag": "sport", "views": 10, "price": 99.99, "published": "2023-06-01",
+     "active": False},
+    {"title": "empty one", "views": 1, "published": "2024-01-15"},
+]
+
+
+@pytest.fixture(scope="module")
+def reader():
+    mapper = MapperService(MAPPING)
+    builder = SegmentBuilder(mapper, "s0")
+    for i, d in enumerate(DOCS):
+        builder.add(mapper.parse_document(f"d{i}", d))
+    return ShardReader(mapper, [builder.seal()])
+
+
+@pytest.fixture(scope="module")
+def executor(reader):
+    return SearchExecutor(reader)
+
+
+def search_ids(executor, query, **kw):
+    body = {"query": query, **kw}
+    resp = executor.search(body)
+    return [h["_id"] for h in resp["hits"]["hits"]], resp
+
+
+def analyzed(field):
+    std = get_default_registry().get("standard")
+    return [std.terms(d.get(field)) if d.get(field) is not None else None
+            for d in DOCS]
+
+
+def test_match_all(executor):
+    ids, resp = search_ids(executor, {"match_all": {}})
+    assert resp["hits"]["total"]["value"] == 5
+    assert resp["hits"]["max_score"] == 1.0
+    assert len(ids) == 5
+
+
+def test_match_bm25_score_parity(executor):
+    ref = RefField(analyzed("body"))
+    expected = ref.match_scores(["fox"])
+    ids, resp = search_ids(executor, {"match": {"body": "fox"}})
+    got = {h["_id"]: h["_score"] for h in resp["hits"]["hits"]}
+    assert set(got) == {"d0", "d3"}
+    for i in (0, 3):
+        assert got[f"d{i}"] == pytest.approx(expected[i], rel=1e-5)
+    # ranking: d3 has tf=4 in shorter doc → higher score
+    assert ids[0] == "d3"
+
+
+def test_match_multi_term_or_and(executor):
+    ref = RefField(analyzed("body"))
+    exp_or = ref.match_scores(["quick", "dog"], "or")
+    _, resp = search_ids(executor, {"match": {"body": "quick dog"}})
+    got = {h["_id"]: h["_score"] for h in resp["hits"]["hits"]}
+    assert set(got) == {f"d{i}" for i in np.nonzero(exp_or)[0]}
+    for d, s in got.items():
+        assert s == pytest.approx(exp_or[int(d[1:])], rel=1e-5)
+
+    exp_and = ref.match_scores(["quick", "dog"], "and")
+    _, resp = search_ids(executor, {"match": {"body": {"query": "quick dog",
+                                                       "operator": "and"}}})
+    got = {h["_id"] for h in resp["hits"]["hits"]}
+    assert got == {f"d{i}" for i in np.nonzero(exp_and)[0]} == {"d0"}
+
+
+def test_match_duplicate_terms_score_double(executor):
+    ref = RefField(analyzed("body"))
+    expected = ref.match_scores(["fox", "fox"])
+    _, resp = search_ids(executor, {"match": {"body": "fox fox"}})
+    got = {h["_id"]: h["_score"] for h in resp["hits"]["hits"]}
+    assert got["d3"] == pytest.approx(expected[3], rel=1e-5)
+
+
+def test_term_on_keyword(executor):
+    ids, resp = search_ids(executor, {"term": {"tag": "animal"}})
+    assert set(ids) == {"d0", "d1"}
+    # keyword scoring: idf-based, no length norm — both docs same score
+    scores = [h["_score"] for h in resp["hits"]["hits"]]
+    assert scores[0] == scores[1] > 0
+
+
+def test_term_on_title_keyword_multi_field(executor):
+    ids, _ = search_ids(executor, {"term": {"title.keyword": "red fox"}})
+    assert ids == ["d0"]
+
+
+def test_terms_query_constant_score(executor):
+    ids, resp = search_ids(executor, {"terms": {"tag": ["docs", "sport"]}})
+    assert set(ids) == {"d3", "d2"}
+    assert all(h["_score"] == 1.0 for h in resp["hits"]["hits"])
+
+
+def test_term_numeric_and_bool(executor):
+    ids, _ = search_ids(executor, {"term": {"views": 100}})
+    assert ids == ["d0"]
+    ids, _ = search_ids(executor, {"term": {"active": True}})
+    assert set(ids) == {"d0", "d2"}
+
+
+def test_range_numeric(executor):
+    ids, _ = search_ids(executor, {"range": {"views": {"gte": 50, "lt": 200}}})
+    assert set(ids) == {"d0", "d1"}
+    ids, _ = search_ids(executor, {"range": {"price": {"gt": 5.0}}})
+    assert set(ids) == {"d1", "d3"}
+
+
+def test_range_date(executor):
+    ids, _ = search_ids(executor, {"range": {"published": {"gte": "2024-01-01",
+                                                           "lte": "2024-02-28"}}})
+    assert set(ids) == {"d0", "d1", "d4"}
+
+
+def test_range_keyword_lexical(executor):
+    ids, _ = search_ids(executor, {"range": {"tag": {"gte": "animal", "lt": "docs"}}})
+    assert set(ids) == {"d0", "d1"}
+
+
+def test_exists(executor):
+    ids, _ = search_ids(executor, {"exists": {"field": "price"}})
+    assert set(ids) == {"d0", "d1", "d2", "d3"}
+    ids, _ = search_ids(executor, {"exists": {"field": "body"}})
+    assert set(ids) == {"d0", "d1", "d2", "d3"}
+
+
+def test_ids_query(executor):
+    ids, _ = search_ids(executor, {"ids": {"values": ["d1", "d4", "nope"]}})
+    assert set(ids) == {"d1", "d4"}
+
+
+def test_bool_query(executor):
+    q = {"bool": {
+        "must": [{"match": {"body": "fox"}}],
+        "filter": [{"range": {"views": {"gte": 50}}}],
+        "must_not": [{"term": {"tag": "sport"}}],
+    }}
+    ids, resp = search_ids(executor, q)
+    assert ids == ["d0"]
+    # score = must score only (filter contributes none)
+    ref = RefField(analyzed("body"))
+    assert resp["hits"]["hits"][0]["_score"] == pytest.approx(
+        ref.match_scores(["fox"])[0], rel=1e-5)
+
+
+def test_bool_should_msm(executor):
+    q = {"bool": {
+        "should": [{"term": {"tag": "animal"}}, {"term": {"active": True}},
+                   {"range": {"views": {"gte": 100}}}],
+        "minimum_should_match": 2,
+    }}
+    ids, _ = search_ids(executor, q)
+    # d0: animal+active+views → 3; d1: animal; d2: active+views → 2
+    assert set(ids) == {"d0", "d2"}
+
+
+def test_bool_empty_matches_all(executor):
+    ids, _ = search_ids(executor, {"bool": {}})
+    assert len(ids) == 5
+
+
+def test_constant_score(executor):
+    q = {"constant_score": {"filter": {"match": {"body": "fox"}}, "boost": 2.5}}
+    _, resp = search_ids(executor, q)
+    assert all(h["_score"] == 2.5 for h in resp["hits"]["hits"])
+
+
+def test_dis_max(executor):
+    q = {"dis_max": {"queries": [{"match": {"body": "fox"}},
+                                 {"match": {"title": "fox"}}],
+                     "tie_breaker": 0.3}}
+    ids, resp = search_ids(executor, q)
+    assert "d0" in ids and "d3" in ids
+
+
+def test_match_phrase(executor):
+    ids, _ = search_ids(executor, {"match_phrase": {"body": "lazy dog"}})
+    assert ids == ["d0"]  # "the lazy dog" in d0; d1 has "dog is lazy" (not adjacent)
+    ids, _ = search_ids(executor, {"match_phrase": {"body": "quick brown fox"}})
+    assert ids == ["d0"]
+    ids, _ = search_ids(executor, {"match_phrase": {"body": "fox brown"}})
+    assert ids == []
+
+
+def test_match_phrase_slop(executor):
+    ids, _ = search_ids(executor,
+                        {"match_phrase": {"body": {"query": "quick fox", "slop": 1}}})
+    assert "d0" in ids
+
+
+def test_prefix_wildcard_regexp_fuzzy(executor):
+    ids, _ = search_ids(executor, {"prefix": {"body": "qui"}})
+    assert set(ids) == {"d0", "d2"}
+    ids, _ = search_ids(executor, {"wildcard": {"body": "d*g"}})
+    assert set(ids) == {"d0", "d1"}
+    ids, _ = search_ids(executor, {"regexp": {"body": "fo[xn]"}})
+    assert set(ids) == {"d0", "d3"}
+    ids, _ = search_ids(executor, {"fuzzy": {"body": "foxs"}})
+    assert set(ids) == {"d0", "d3"}
+
+
+def test_multi_match(executor):
+    q = {"multi_match": {"query": "fox", "fields": ["title", "body"]}}
+    ids, _ = search_ids(executor, q)
+    assert set(ids) == {"d0", "d3"}
+    q = {"multi_match": {"query": "fox", "fields": ["title^3", "body"],
+                         "type": "most_fields"}}
+    _, resp = search_ids(executor, q)
+    assert resp["hits"]["total"]["value"] == 2
+
+
+def test_query_string(executor):
+    ids, _ = search_ids(executor, {"query_string": {"query": "fox -sport",
+                                                    "fields": ["body"]}})
+    assert set(ids) == {"d0", "d3"}  # -sport only excludes body matches
+    ids, _ = search_ids(executor, {"query_string": {
+        "query": "tag:animal AND body:dog"}})
+    assert set(ids) == {"d0", "d1"}
+    ids, _ = search_ids(executor, {"query_string": {"query": '"lazy dog"',
+                                                    "fields": ["body"]}})
+    assert ids == ["d0"]
+
+
+def test_paging_and_size(executor):
+    _, resp = search_ids(executor, {"match_all": {}}, size=2)
+    assert len(resp["hits"]["hits"]) == 2
+    assert resp["hits"]["total"]["value"] == 5
+    _, resp2 = search_ids(executor, {"match_all": {}}, size=2, **{"from": 4})
+    assert len(resp2["hits"]["hits"]) == 1
+
+
+def test_sort_by_field(executor):
+    ids, resp = search_ids(executor, {"match_all": {}},
+                           sort=[{"views": {"order": "desc"}}])
+    assert ids == ["d2", "d0", "d1", "d3", "d4"]
+    assert resp["hits"]["hits"][0]["sort"] == [200]
+    ids, _ = search_ids(executor, {"match_all": {}},
+                        sort=[{"views": {"order": "asc"}}])
+    assert ids == ["d4", "d3", "d1", "d0", "d2"]
+
+
+def test_sort_by_keyword(executor):
+    ids, resp = search_ids(executor, {"exists": {"field": "tag"}},
+                           sort=[{"tag": {"order": "asc"}}])
+    assert ids[0] in ("d0", "d1")  # 'animal' first
+    assert resp["hits"]["hits"][0]["sort"] == ["animal"]
+
+
+def test_source_filtering(executor):
+    _, resp = search_ids(executor, {"ids": {"values": ["d0"]}},
+                         _source=["title", "views"])
+    src = resp["hits"]["hits"][0]["_source"]
+    assert set(src) == {"title", "views"}
+    _, resp = search_ids(executor, {"ids": {"values": ["d0"]}}, _source=False)
+    assert "_source" not in resp["hits"]["hits"][0]
+
+
+def test_boost_multiplies(executor):
+    _, r1 = search_ids(executor, {"match": {"body": "fox"}})
+    _, r2 = search_ids(executor, {"match": {"body": {"query": "fox", "boost": 2.0}}})
+    s1 = {h["_id"]: h["_score"] for h in r1["hits"]["hits"]}
+    s2 = {h["_id"]: h["_score"] for h in r2["hits"]["hits"]}
+    for d in s1:
+        assert s2[d] == pytest.approx(2 * s1[d], rel=1e-5)
+
+
+def test_deletes_are_invisible():
+    mapper = MapperService(MAPPING)
+    b = SegmentBuilder(mapper, "s0")
+    for i, d in enumerate(DOCS):
+        b.add(mapper.parse_document(f"d{i}", d))
+    seg = b.seal()
+    reader = ShardReader(mapper, [seg])
+    ex = SearchExecutor(reader)
+    assert ex.count({"query": {"match": {"body": "fox"}}}) == 2
+    seg.delete("d3")
+    reader.notify_deletes(seg)
+    resp = ex.search({"query": {"match": {"body": "fox"}}})
+    assert resp["hits"]["total"]["value"] == 1
+    assert [h["_id"] for h in resp["hits"]["hits"]] == ["d0"]
+
+
+def test_multi_segment_shard_scores_match_single_segment():
+    mapper = MapperService(MAPPING)
+    b1 = SegmentBuilder(mapper, "s0")
+    b2 = SegmentBuilder(mapper, "s1")
+    for i, d in enumerate(DOCS):
+        (b1 if i < 3 else b2).add(mapper.parse_document(f"d{i}", d))
+    reader = ShardReader(mapper, [b1.seal(), b2.seal()])
+    ex = SearchExecutor(reader)
+    resp = ex.search({"query": {"match": {"body": "fox"}}})
+    got = {h["_id"]: h["_score"] for h in resp["hits"]["hits"]}
+    # idf/avgdl must be shard-level: same scores as the single-segment shard
+    ref = RefField(analyzed("body"))
+    expected = ref.match_scores(["fox"])
+    assert set(got) == {"d0", "d3"}
+    for d in got:
+        assert got[d] == pytest.approx(expected[int(d[1:])], rel=1e-5)
+
+
+def test_unknown_query_type_raises(executor):
+    from opensearch_tpu.common.errors import ParsingError
+    with pytest.raises(ParsingError):
+        executor.search({"query": {"flux_capacitor": {}}})

@@ -1,0 +1,104 @@
+"""Regression tests for cross-segment sort, missing-field sort, min_score,
+multi-key sort, fuzzy match, and _source subtree filtering."""
+
+import pytest
+
+from opensearch_tpu.index.mapper import MapperService
+from opensearch_tpu.index.segment import SegmentBuilder
+from opensearch_tpu.search.executor import SearchExecutor, ShardReader, _filter_source
+
+MAPPING = {"properties": {
+    "name": {"type": "keyword"},
+    "views": {"type": "integer"},
+    "grp": {"type": "keyword"},
+    "body": {"type": "text"},
+}}
+
+
+def make_executor(segment_docs):
+    """segment_docs: list of lists — one inner list per segment."""
+    mapper = MapperService(MAPPING)
+    segs = []
+    n = 0
+    for si, docs in enumerate(segment_docs):
+        b = SegmentBuilder(mapper, f"s{si}")
+        for d in docs:
+            b.add(mapper.parse_document(f"d{n}", d))
+            n += 1
+        segs.append(b.seal())
+    return SearchExecutor(ShardReader(mapper, segs))
+
+
+def test_cross_segment_numeric_sort_uses_real_values():
+    # seg A ranks: 100→0, 200→1; seg B: 50→0. Rank merge would be wrong.
+    ex = make_executor([
+        [{"views": 100}, {"views": 200}],
+        [{"views": 50}, {"views": 150}],
+    ])
+    r = ex.search({"query": {"match_all": {}}, "sort": [{"views": "asc"}]})
+    assert [h["sort"][0] for h in r["hits"]["hits"]] == [50, 100, 150, 200]
+    r = ex.search({"query": {"match_all": {}}, "sort": [{"views": "desc"}]})
+    assert [h["sort"][0] for h in r["hits"]["hits"]] == [200, 150, 100, 50]
+
+
+def test_cross_segment_keyword_sort_uses_real_values():
+    ex = make_executor([
+        [{"name": "cherry"}, {"name": "apple"}],
+        [{"name": "banana"}],
+    ])
+    r = ex.search({"query": {"match_all": {}}, "sort": [{"name": "asc"}]})
+    assert [h["sort"][0] for h in r["hits"]["hits"]] == ["apple", "banana", "cherry"]
+
+
+def test_missing_sort_field_docs_sort_last_not_dropped():
+    ex = make_executor([[{"views": 10}, {"name": "noviews"}, {"views": 5}]])
+    r = ex.search({"query": {"match_all": {}}, "sort": [{"views": "asc"}]})
+    hits = r["hits"]["hits"]
+    assert r["hits"]["total"]["value"] == 3
+    assert len(hits) == 3
+    assert [h["sort"][0] for h in hits] == [5, 10, None]
+    r = ex.search({"query": {"match_all": {}}, "sort": [{"views": "desc"}]})
+    assert [h["sort"][0] for h in r["hits"]["hits"]] == [10, 5, None]
+
+
+def test_multi_key_sort():
+    ex = make_executor([[
+        {"grp": "a", "views": 1}, {"grp": "b", "views": 9},
+        {"grp": "a", "views": 7}, {"grp": "b", "views": 3},
+    ]])
+    r = ex.search({"query": {"match_all": {}},
+                   "sort": [{"grp": "asc"}, {"views": "desc"}]})
+    assert [h["sort"] for h in r["hits"]["hits"]] == [
+        ["a", 7], ["a", 1], ["b", 9], ["b", 3]]
+
+
+def test_min_score_exact_total():
+    ex = make_executor([[{"body": "fox fox fox"}, {"body": "fox"},
+                         {"body": "dog"}]])
+    r_all = ex.search({"query": {"match": {"body": "fox"}}})
+    scores = sorted((h["_score"] for h in r_all["hits"]["hits"]), reverse=True)
+    assert len(scores) == 2
+    cutoff = (scores[0] + scores[1]) / 2
+    r = ex.search({"query": {"match": {"body": "fox"}}, "min_score": cutoff})
+    assert r["hits"]["total"]["value"] == 1
+    assert len(r["hits"]["hits"]) == 1
+
+
+def test_match_with_fuzziness():
+    ex = make_executor([[{"body": "the quick fox"}, {"body": "a slow dog"}]])
+    r = ex.search({"query": {"match": {"body": {"query": "foxs", "fuzziness": "AUTO"}}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["d0"]
+    r = ex.search({"query": {"match": {"body": {"query": "quikc foxs",
+                                                "operator": "and",
+                                                "fuzziness": "1"}}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["d0"]
+
+
+def test_source_subtree_include():
+    src = {"user": {"name": "x", "age": 3}, "other": 1}
+    assert _filter_source(src, ["user"]) == {"user": {"name": "x", "age": 3}}
+    assert _filter_source(src, ["user.name"]) == {"user": {"name": "x"}}
+    assert _filter_source({"a": 1}, ["a.b"]) == {}
+    assert _filter_source(src, {"includes": ["user"], "excludes": ["user.age"]}) \
+        == {"user": {"name": "x"}}
+    assert _filter_source(src, ["us*"]) == {"user": {"name": "x", "age": 3}}
