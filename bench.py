@@ -1,0 +1,114 @@
+"""Benchmark harness: BM25 match-query throughput (BASELINE.json config 1).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Corpus: synthetic msmarco-passage-shaped (zipf vocabulary, ~60-token
+passages) — the reference points at external corpora it does not ship
+(client/benchmark/README.md:25), so the workload is synthesized with a fixed
+seed for reproducibility.
+
+vs_baseline: BASELINE.md's denominator is "measure Lucene-CPU in-situ"; the
+stand-in measured here in the same process is an optimized numpy CSR scorer
+(vectorized postings gather + BM25 + argpartition top-k on host CPU), i.e.
+the same work the TPU path does, executed the CPU-array way.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_DOCS = int(os.environ.get("BENCH_DOCS", "100000"))
+VOCAB = int(os.environ.get("BENCH_VOCAB", "20000"))
+N_QUERIES = int(os.environ.get("BENCH_QUERIES", "256"))
+TOP_K = 10
+
+
+def build_index():
+    from opensearch_tpu.search.executor import SearchExecutor, ShardReader
+    from opensearch_tpu.utils.demo import build_shards
+
+    mapper, segments = build_shards(N_DOCS, n_shards=1, vocab_size=VOCAB,
+                                    avg_len=60, seed=42)
+    reader = ShardReader(mapper, segments)
+    return SearchExecutor(reader), segments[0]
+
+
+def numpy_baseline(seg, queries, k1=1.2, b=0.75):
+    """CPU stand-in scorer over the same postings blocks: per query, gather
+    matched blocks, BM25, dense accumulate, argpartition top-k."""
+    import numpy as np
+
+    from opensearch_tpu.index.segment import LENGTH_TABLE
+    from opensearch_tpu.ops.bm25 import idf as bm25_idf
+
+    field = "body"
+    norms = seg.norms[field]
+    dl = LENGTH_TABLE[norms]
+    st = seg.field_stats[field]
+    avgdl = st.sum_total_term_freq / max(st.doc_count, 1)
+    n = seg.num_docs
+
+    def run_one(qterms):
+        scores = np.zeros(n, dtype=np.float32)
+        for t in qterms:
+            tm = seg.get_term(field, t)
+            if tm is None:
+                continue
+            w = bm25_idf(st.doc_count, tm.doc_freq)
+            blocks = slice(tm.start_block, tm.start_block + tm.num_blocks)
+            docs = seg.post_docs[blocks].ravel()
+            tfs = seg.post_tf[blocks].ravel()
+            valid = docs >= 0
+            docs, tfs = docs[valid], tfs[valid]
+            d = dl[docs]
+            s = w * tfs * (k1 + 1.0) / (tfs + k1 * (1.0 - b + b * d / avgdl))
+            np.add.at(scores, docs, s.astype(np.float32))
+        kk = min(TOP_K, n)
+        top = np.argpartition(-scores, kk - 1)[:kk]
+        return top[np.argsort(-scores[top], kind="stable")]
+
+    t0 = time.perf_counter()
+    for q in queries:
+        run_one(q.split())
+    dt = time.perf_counter() - t0
+    return len(queries) / dt
+
+
+def main():
+    import jax
+
+    from opensearch_tpu.utils.demo import query_terms
+
+    platform = jax.devices()[0].platform
+    executor, seg = build_index()
+    queries = query_terms(N_QUERIES, VOCAB, seed=7, terms_per_query=2)
+    bodies = [{"query": {"match": {"body": q}}, "size": TOP_K}
+              for q in queries]
+
+    # warm-up: compile every shape bucket once (the analog of Lucene JVM
+    # warm-up; XLA executables are cached per plan signature). Queries run
+    # batched via _msearch — one vmapped device program per signature group.
+    executor.multi_search(bodies)
+
+    t0 = time.perf_counter()
+    executor.multi_search(bodies)
+    dt = time.perf_counter() - t0
+    qps = len(bodies) / dt
+
+    base_qps = numpy_baseline(seg, queries)
+
+    print(json.dumps({
+        "metric": f"bm25_match_qps_{N_DOCS // 1000}k_docs_{platform}",
+        "value": round(qps, 2),
+        "unit": "queries/s",
+        "vs_baseline": round(qps / base_qps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

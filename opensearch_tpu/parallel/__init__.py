@@ -1,0 +1,15 @@
+"""Shard parallelism over a TPU device mesh.
+
+The reference scatters a query over shards via transport RPCs and reduces on
+the coordinator (action/search/AbstractSearchAsyncAction.java:264,
+SearchPhaseController.java:453). Here the same scatter-gather is ONE SPMD
+program: one shard per device along a `shards` mesh axis, per-shard scoring in
+shard_map, partial top-k merged with `all_gather` + `top_k`, totals with
+`psum` — collectives ride ICI instead of TCP.
+"""
+
+from opensearch_tpu.parallel.distributed import (
+    DistributedSearcher, align_agg_plans, make_mesh, pad_stack_trees)
+
+__all__ = ["DistributedSearcher", "align_agg_plans", "make_mesh",
+           "pad_stack_trees"]

@@ -1,0 +1,310 @@
+"""SPMD scatter-gather search: one shard per device on a `Mesh`.
+
+Re-design of the reference's coordinator fan-out + incremental reduce
+(action/search/TransportSearchAction.java:284 scatters the query phase to one
+copy of every shard; action/search/QueryPhaseResultConsumer.java:72 and
+SearchPhaseController.java:228 mergeTopDocs reduce partial top-docs; 453
+reducedQueryPhase merges agg trees). On TPU the fan-out is a mesh axis: every
+device holds one shard's columnar segment image in HBM, shard_map evaluates
+the compiled plan locally, then the partial reduce happens on-chip —
+`all_gather` of per-shard top-k candidates over ICI followed by a replicated
+`top_k` merge, and `psum` for total-hit counts. Aggregation partials stay
+sharded on the way out; the host runs the existing cross-segment reduce
+(search/aggs/reduce.py), mirroring the reference's coordinator-side
+InternalAggregations.topLevelReduce.
+
+Shape discipline: all shards must share one padded bucket shape (the segment
+uploader's power-of-two bucketing — ops/device_segment.py — makes unequal
+shards stackable) and one plan signature; the compiler guarantees equal
+signatures for the same query because plan structure depends only on the
+query and mapper, while per-shard constants live in the stacked inputs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+try:
+    from jax import shard_map as _shard_map_impl
+
+    def _shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_vma=False)
+except ImportError:  # older jax: jax.experimental + check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    def _shard_map(f, mesh, in_specs, out_specs):
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_rep=False)
+from jax.sharding import Mesh, PartitionSpec as P
+
+import dataclasses
+
+from opensearch_tpu.ops.topk import NEG_INF
+from opensearch_tpu.search.compile import Plan
+from opensearch_tpu.search.plan_eval import _eval_plan
+from opensearch_tpu.search.aggs.engine import eval_aggs
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "shards") -> Mesh:
+    """A 1-D mesh over the first n devices; the `shards` axis is the DP axis
+    of SURVEY.md §2.2 (one index shard per device)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis,))
+
+
+# Fill values that keep padding semantically inert when leaves are grown to
+# the cross-shard shape envelope. Names are leaf dict keys from
+# ops/device_segment.py (segment arrays) and search/compile.py (plan inputs);
+# anything unlisted pads with 0/False, which those layouts treat as "absent"
+# (w=0, hit=0, live=False, mask=False, matches=False, ...).
+_PAD_FILL: Dict[str, Any] = {
+    "post_docs": -1,    # -1 = empty postings lane
+    "doc_ids": -1,      # -1 = padding value-pair
+    "min_rank": np.int32(2 ** 31 - 1),
+    "max_rank": -1,
+    "avgdl": 1.0,       # divisor — must stay nonzero
+}
+
+
+def _grow(arr: np.ndarray, shape: Tuple[int, ...], name: str) -> np.ndarray:
+    arr = np.asarray(arr)
+    if arr.shape == tuple(shape):
+        return arr
+    fill = _PAD_FILL.get(name, False if arr.dtype == np.bool_ else 0)
+    out = np.full(shape, fill, dtype=arr.dtype)
+    out[tuple(slice(0, s) for s in arr.shape)] = arr
+    return out
+
+
+def pad_stack_trees(trees: Sequence[Any]):
+    """Stack per-shard pytrees, growing each leaf to the max shape across
+    shards first (trailing padding, per-name inert fill values).
+
+    This is the cross-shard shape envelope: shards whose segments landed in
+    different power-of-two buckets (ops/device_segment.py) still execute as
+    one SPMD program — the device-side masks treat the grown region as dead
+    (live=False, postings lane -1, hit 0)."""
+    paths_and_leaves = [jax.tree_util.tree_flatten_with_path(t)
+                        for t in trees]
+    treedef = paths_and_leaves[0][1]
+    for _, td in paths_and_leaves[1:]:
+        if td != treedef:
+            raise ValueError("shard trees must share structure for SPMD")
+    n_leaves = len(paths_and_leaves[0][0])
+    stacked = []
+    for i in range(n_leaves):
+        path = paths_and_leaves[0][0][i][0]
+        name = ""
+        for p in reversed(path):
+            if hasattr(p, "key"):
+                name = str(p.key)
+                break
+        leaves = [np.asarray(pl[0][i][1]) for pl in paths_and_leaves]
+        ndim = leaves[0].ndim
+        if any(l.ndim != ndim for l in leaves):
+            raise ValueError(f"leaf {path} rank mismatch across shards")
+        shape = tuple(max(l.shape[d] for l in leaves) for d in range(ndim))
+        stacked.append(np.stack([_grow(l, shape, name) for l in leaves]))
+    return jax.tree_util.tree_unflatten(treedef, stacked)
+
+
+# agg plan kinds whose static[1] is a bucket cardinality that sizes the
+# output arrays and the flattened-ordinal stride (parent_ord * card + ord)
+_CARD_KINDS = frozenset(
+    {"bucket_ord", "bucket_num", "presence_ord", "presence_num", "value_hist"})
+
+
+def align_agg_plans(per_shard: Sequence[Sequence[Any]]) -> None:
+    """Raise every shard's card statics to the cross-shard max, in place.
+
+    One SPMD program traces a single agg-plan structure, so output bins and
+    ordinal strides must agree across shards; per-shard cardinalities (terms
+    dictionary size, histogram bucket count) differ, and the max is safe:
+    shard-local bucket ordinals are always < their own card ≤ max. Decoding
+    each shard's slice with its own (aligned) plans keeps keys segment-local.
+    Raises ValueError when plan structures genuinely diverge (e.g. a field
+    with no values in one shard compiled to an `empty` node) — callers fall
+    back to per-shard host execution then."""
+
+    def walk(nodes: Sequence[Any]):
+        for group in zip(*nodes):
+            kinds = {p.kind for p in group}
+            if len(kinds) != 1:
+                raise ValueError(
+                    f"agg plan kinds diverge across shards: {kinds}")
+            kind = kinds.pop()
+            if kind in _CARD_KINDS:
+                card = max(p.static[1] for p in group)
+                for p in group:
+                    p.static = (p.static[0], card) + tuple(p.static[2:])
+            elif any(p.static != group[0].static for p in group):
+                raise ValueError(
+                    f"agg statics diverge across shards for kind {kind}")
+            walk([p.children for p in group])
+            qps = [p.query_plan for p in group]
+            if any((q is None) != (qps[0] is None) for q in qps):
+                raise ValueError("filter-agg query plans diverge across shards")
+
+    walk(list(per_shard))
+
+
+def _count_agg_nodes(p) -> int:
+    return 1 + sum(_count_agg_nodes(c) for c in p.children)
+
+
+def plan_struct(p) -> tuple:
+    """Shape-free structural signature (kind/static/children) shared by query
+    Plans and AggPlans — the cross-shard compatibility check. Input shapes are
+    intentionally excluded: the shape envelope aligns them."""
+    qp = getattr(p, "query_plan", None)
+    return (p.kind, p.static,
+            plan_struct(qp) if qp is not None else None,
+            tuple(plan_struct(c) for c in p.children))
+
+
+def _tree_shapes(tree) -> tuple:
+    # NB: v.dtype directly — np.asarray on a device array would fetch it
+    return tuple((jax.tree_util.keystr(kp), tuple(v.shape), str(v.dtype))
+                 for kp, v in jax.tree_util.tree_flatten_with_path(tree)[0])
+
+
+def _squeeze0(tree):
+    return jax.tree_util.tree_map(lambda x: x[0], tree)
+
+
+class DistributedSearcher:
+    """Compiles and caches the one-program distributed query phase.
+
+    Per (plan signature, meta, k, n_aggs) a single jitted shard_map program:
+      in:  stacked segment arrays [N, ...] (sharded over `shards`),
+           stacked flat plan inputs [N, ...] (sharded), min_score (replicated)
+      out: merged (keys, scores, global_doc_ids) [k] replicated,
+           total hits (psum), agg partials still sharded [N, ...]
+    Global doc id = shard_index * d_pad + local ordinal, decoded by the host.
+    Tie-break on equal scores follows gather order (shard asc, then local
+    score rank), matching the reference's shard-index tie-break in
+    SearchPhaseController.mergeTopDocs.
+    """
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0]
+        self.n_shards = int(np.prod(mesh.devices.shape))
+        self._cache: Dict[Any, Any] = {}
+
+    def runner(self, cache_key, plan: Plan, meta, k: int,
+               agg_plans: Tuple = ()):
+        key = (cache_key, meta, k)
+        fn = self._cache.get(key)
+        if fn is not None:
+            return fn
+
+        axis = self.axis
+        d_pad = meta.d_pad
+        k_eff = min(k, d_pad)
+
+        def local_query_phase(seg, flat_inputs, min_score):
+            seg = _squeeze0(seg)
+            flat_inputs = _squeeze0(flat_inputs)
+            cursor = [0]
+            scores, matches = _eval_plan(plan, seg, flat_inputs, cursor)
+            # `live` is False on padding rows (ops/device_segment.py), so no
+            # per-shard num_docs mask is needed — metas stay shape-only here.
+            eligible = matches & seg["live"] & (scores >= min_score)
+            local_total = jnp.sum(eligible.astype(jnp.int32))
+            masked = jnp.where(eligible, scores, NEG_INF)
+            top_keys, top_idx = jax.lax.top_k(masked, k_eff)
+            shard_i = jax.lax.axis_index(axis)
+            gids = shard_i * d_pad + top_idx.astype(jnp.int32)
+
+            agg_outs = []
+            if agg_plans:
+                root_ord = jnp.zeros(d_pad, jnp.int32)
+                eval_aggs(list(agg_plans), seg, flat_inputs, cursor, eligible,
+                          root_ord, 1, agg_outs)
+
+            # partial reduce on ICI: gather every shard's candidates,
+            # replicated top-k merge — SearchPhaseController.mergeTopDocs
+            # as one collective + one sort instead of a coordinator RPC round
+            gk = jax.lax.all_gather(top_keys, axis, tiled=True)
+            gg = jax.lax.all_gather(gids, axis, tiled=True)
+            mk, mi = jax.lax.top_k(gk, k_eff)
+            mg = gg[mi]
+            total = jax.lax.psum(local_total, axis)
+            agg_outs = jax.tree_util.tree_map(
+                lambda o: jnp.expand_dims(o, 0), agg_outs)
+            return mk, mg, total, agg_outs
+
+        in_specs = (P(axis), P(axis), P())
+        # eval_aggs appends one output dict per node in traversal order
+        # (children included), not one per top-level plan
+        n_agg_outs = sum(_count_agg_nodes(a) for a in agg_plans)
+        out_specs = (P(), P(), P(), [P(axis)] * n_agg_outs)
+        fn = jax.jit(_shard_map(
+            local_query_phase, mesh=self.mesh,
+            in_specs=in_specs, out_specs=out_specs))
+        self._cache[key] = fn
+        return fn
+
+    def search(self, shard_payloads: List[Tuple[Dict, List[Dict], Any]],
+               plan: Plan, k: int, min_score: float = float(NEG_INF),
+               agg_plans: Tuple = ()):
+        """Run the distributed query phase over per-shard
+        (arrays, flat_inputs, meta) payloads.
+
+        Returns (merged_scores [k], shard_idx [k], local_ords [k], total,
+        per-shard agg partial outputs). Agg partials keep a leading shard
+        dimension; the caller decodes each shard's slice with that shard's
+        own agg plans (ordinal spaces are segment-local)."""
+        if len(shard_payloads) != self.n_shards:
+            raise ValueError(
+                f"{len(shard_payloads)} shard payloads for "
+                f"{self.n_shards}-device mesh")
+        meta = canonical_meta([p[2] for p in shard_payloads])
+        seg_stack = pad_stack_trees([p[0] for p in shard_payloads])
+        flat_stack = pad_stack_trees([p[1] for p in shard_payloads])
+        cache_key = (plan_struct(plan),
+                     tuple(plan_struct(a) for a in agg_plans),
+                     _tree_shapes(seg_stack), _tree_shapes(flat_stack))
+        fn = self.runner(cache_key, plan, meta, k, agg_plans)
+        keys, gids, total, agg_outs = fn(seg_stack, flat_stack,
+                                         jnp.float32(min_score))
+        keys = np.asarray(keys)
+        gids = np.asarray(gids)
+        shard_idx = gids // meta.d_pad
+        ords = gids % meta.d_pad
+        valid = keys > NEG_INF / 2
+        return (keys[valid], shard_idx[valid], ords[valid], int(total),
+                jax.tree_util.tree_map(np.asarray, agg_outs))
+
+
+def canonical_meta(metas: Sequence[Any]):
+    """Collapse per-shard DeviceSegmentMeta into the shape envelope meta.
+
+    Field layout (norm rows, doc-value field sets) must match across shards —
+    it is mapper-derived, so same-index shards agree. Bucket sizes may differ;
+    the envelope takes the max (pad_stack_trees grows the arrays to match).
+    num_docs is unused by the distributed runner — the live mask covers
+    padding."""
+    base = metas[0]
+    for m in metas[1:]:
+        if (m.norm_rows != base.norm_rows
+                or m.numeric_fields != base.numeric_fields
+                or m.ordinal_fields != base.ordinal_fields
+                or m.vector_fields != base.vector_fields):
+            raise ValueError(
+                "shards have mismatched field layouts; SPMD search requires "
+                f"same-index shards: {base} vs {m}")
+    return dataclasses.replace(
+        base, seg_id="<spmd>", num_docs=0,
+        d_pad=max(m.d_pad for m in metas),
+        nb_pad=max(m.nb_pad for m in metas))

@@ -99,12 +99,12 @@ class ShardReader:
 _JIT_CACHE: Dict[Any, Any] = {}
 
 
-def _runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int, sort_mode: str,
-            agg_plans=()):
-    key = (plan_sig, meta, k, sort_mode, tuple(a.sig() for a in agg_plans))
-    fn = _JIT_CACHE.get(key)
-    if fn is not None:
-        return fn
+def build_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
+                      sort_mode: str, agg_plans=()):
+    """The single-segment query phase as a pure jittable function — the TPU
+    program that replaces one ContextIndexSearcher.searchLeaf pass
+    (search/internal/ContextIndexSearcher.java:260). Exposed unjitted so the
+    graft entry can hand it to the driver's compile check."""
 
     def run(seg, flat_inputs, sort_key_arr, min_score):
         cursor = [0]
@@ -125,7 +125,69 @@ def _runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int, sort_mode: st
                       root_eff, 1, agg_outs)
         return top_keys, top_scores, top_idx.astype(jnp.int32), total, agg_outs
 
-    fn = jax.jit(run)
+    return run
+
+
+def build_batched_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int):
+    """B same-shaped queries against one segment as ONE device program.
+
+    The TPU answer to per-query launch latency: where the reference executes
+    queries one at a time per shard (SearchService.executeQueryPhase), here a
+    whole _msearch batch vmaps over a leading query axis — gathers, BM25 and
+    top-k all batch cleanly, so one host↔device round trip serves B queries.
+    Score-sorted, agg-free queries only (the common high-QPS shape)."""
+
+    def one(seg, flat_inputs, min_score):
+        cursor = [0]
+        scores, matches = _eval_plan(plan, seg, flat_inputs, cursor)
+        in_seg = jnp.arange(seg["live"].shape[0], dtype=jnp.int32) < meta.num_docs
+        eligible = matches & seg["live"] & in_seg & (scores >= min_score)
+        total = jnp.sum(eligible.astype(jnp.int32))
+        masked = jnp.where(eligible, scores, NEG_INF)
+        k_eff = min(k, seg["live"].shape[0])
+        top_scores, top_idx = jax.lax.top_k(masked, k_eff)
+        # pack into ONE f32 row [k | k | 1] (ints bitcast) so the host fetches
+        # a single array — each fetch is a full round trip on remote devices
+        return jnp.concatenate([
+            top_scores,
+            jax.lax.bitcast_convert_type(top_idx.astype(jnp.int32),
+                                         jnp.float32),
+            jax.lax.bitcast_convert_type(total[None].astype(jnp.int32),
+                                         jnp.float32)])
+
+    def run(seg, batched_flat, min_scores):
+        return jax.vmap(one, in_axes=(None, 0, 0))(seg, batched_flat,
+                                                   min_scores)
+
+    return run
+
+
+def unpack_batched_result(packed: np.ndarray, k_eff: int):
+    """Inverse of the packed [B, 2k+1] row layout from
+    build_batched_query_phase."""
+    scores = packed[:, :k_eff]
+    idx = packed[:, k_eff:2 * k_eff].view(np.int32)
+    totals = packed[:, 2 * k_eff:].view(np.int32)[:, 0]
+    return scores, idx, totals
+
+
+def _batched_runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int,
+                    batch: int):
+    key = ("batched", plan_sig, meta, k, batch)
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(build_batched_query_phase(plan, meta, k))
+        _JIT_CACHE[key] = fn
+    return fn
+
+
+def _runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int, sort_mode: str,
+            agg_plans=()):
+    key = (plan_sig, meta, k, sort_mode, tuple(a.sig() for a in agg_plans))
+    fn = _JIT_CACHE.get(key)
+    if fn is not None:
+        return fn
+    fn = jax.jit(build_query_phase(plan, meta, k, sort_mode, agg_plans))
     _JIT_CACHE[key] = fn
     return fn
 
@@ -272,15 +334,8 @@ class SearchExecutor:
 
         hits = []
         for c in page:
-            seg = self.reader.segments[c.seg_i]
-            hit = {
-                "_index": self.reader.index_name,
-                "_id": seg.doc_ids[c.ord],
-                "_score": c.score if wants_score else None,
-            }
-            src = _filter_source(seg.sources[c.ord], body.get("_source", True))
-            if src is not None:
-                hit["_source"] = src
+            hit = self._hit_dict(c.seg_i, c.ord,
+                                 c.score if wants_score else None, body)
             if not score_sorted:
                 hit["sort"] = c.sort_values
             hits.append(hit)
@@ -302,6 +357,149 @@ class SearchExecutor:
             apply_pipelines(agg_nodes, aggregations)
             resp["aggregations"] = aggregations
         return resp
+
+    def _hit_dict(self, seg_i: int, ord_: int, score: Optional[float],
+                  body: dict) -> dict:
+        """One search hit (fetch phase for a single doc) — shared by search()
+        and multi_search()."""
+        seg = self.reader.segments[seg_i]
+        hit = {"_index": self.reader.index_name,
+               "_id": seg.doc_ids[ord_],
+               "_score": score}
+        src = _filter_source(seg.sources[ord_], body.get("_source", True))
+        if src is not None:
+            hit["_source"] = src
+        return hit
+
+    def multi_search(self, bodies: List[dict]) -> dict:
+        """_msearch: execute many search bodies, batching same-shaped
+        score-sorted queries into single vmapped device programs per segment
+        (reference: action/search/TransportMultiSearchAction fans bodies out
+        concurrently; here concurrency is a batch axis on the MXU/VPU)."""
+        start = time.monotonic()
+        responses: List[Optional[dict]] = [None] * len(bodies)
+
+        batchable: List[Tuple[int, dict, Any, int, int, float]] = []
+        for i, body in enumerate(bodies):
+            body = body or {}
+            simple = (not (body.get("aggs") or body.get("aggregations"))
+                      and body.get("sort") in (None, "_score", ["_score"])
+                      and not body.get("search_after"))
+            if not simple:
+                responses[i] = self.search(body)
+                continue
+            try:
+                node = dsl.parse_query(body.get("query"))
+            except Exception:
+                responses[i] = self.search(body)  # surface the error uniformly
+                continue
+            size = int(body.get("size", 10))
+            from_ = int(body.get("from", 0))
+            if size < 0 or from_ < 0:
+                raise IllegalArgumentError(
+                    "[from] and [size] must be non-negative")
+            min_score = float(body["min_score"]) \
+                if body.get("min_score") is not None else NEG_INF
+            batchable.append((i, body, node, size, from_, min_score))
+
+        # group by plan STRUCTURE (shape-free): the cross-query shape envelope
+        # (pad_stack_trees) grows every query's inputs to the group max, so
+        # queries whose terms landed in different postings buckets still share
+        # one vmapped program — one device round trip per group
+        from opensearch_tpu.parallel.distributed import (
+            _tree_shapes, pad_stack_trees, plan_struct)
+
+        groups: Dict[Any, List[int]] = {}
+        compiled: Dict[int, List[Plan]] = {}
+        stats = self.reader.stats()
+        compiler = Compiler(self.reader.mapper, stats)
+        for entry in batchable:
+            i, body, node, size, from_, min_score = entry
+            plans = []
+            for seg, (arrays, meta) in zip(self.reader.segments,
+                                           self.reader.device):
+                if seg.num_docs == 0:
+                    plans.append(None)
+                    continue
+                plans.append(compiler.compile(node, seg, meta))
+            compiled[i] = plans
+            # no tie overfetch needed: per-segment top-k by score with
+            # doc-asc tie-break (lax.top_k picks the lowest index) merges to
+            # the exact global page for score-sorted queries
+            k = max(from_ + size, 10)
+            struct = tuple(plan_struct(p) if p is not None else None
+                           for p in plans)
+            groups.setdefault((struct, min(k, 1 << 16)), []).append(i)
+
+        entry_by_i = {e[0]: e for e in batchable}
+        # phase 1: dispatch every group × segment program without blocking —
+        # jax dispatch is async, so device work and tunnel transfers overlap
+        pending = []
+        for (struct, k_fetch), idxs in groups.items():
+            for seg_i, (seg, (arrays, meta)) in enumerate(
+                    zip(self.reader.segments, self.reader.device)):
+                if seg.num_docs == 0:
+                    continue
+                flats = [compiled[i][seg_i].flatten_inputs([]) for i in idxs]
+                batched = jax.tree_util.tree_map(
+                    jnp.asarray, pad_stack_trees(flats))
+                min_scores = jnp.asarray(np.asarray(
+                    [entry_by_i[i][5] for i in idxs], dtype=np.float32))
+                k_seg = min(k_fetch, pad_bucket(max(seg.num_docs, 1)))
+                plan0 = compiled[idxs[0]][seg_i]
+                fn = _batched_runner(
+                    (plan_struct(plan0), _tree_shapes(batched)),
+                    plan0, meta, k_seg, len(idxs))
+                pending.append((idxs, seg_i, k_seg,
+                                fn(arrays, batched, min_scores)))
+
+        # phase 2: collect (vectorized — no per-candidate python objects)
+        per_query_segs: Dict[int, List[Tuple[int, np.ndarray, np.ndarray]]] = \
+            {e[0]: [] for e in batchable}
+        per_query_total: Dict[int, int] = {e[0]: 0 for e in batchable}
+        for idxs, seg_i, k_seg, packed in pending:
+            scores_b, idx_b, total_b = unpack_batched_result(
+                np.asarray(packed), k_seg)
+            for row, i in enumerate(idxs):
+                per_query_total[i] += int(total_b[row])
+                per_query_segs[i].append((seg_i, scores_b[row], idx_b[row]))
+
+        for i, seg_results in per_query_segs.items():
+            _, body, _, size, from_, _ = entry_by_i[i]
+            if seg_results:
+                all_scores = np.concatenate([s for _, s, _ in seg_results])
+                all_ords = np.concatenate([o for _, _, o in seg_results])
+                all_segs = np.concatenate(
+                    [np.full(len(s), si, np.int32) for si, s, _ in seg_results])
+                valid = all_scores > NEG_INF
+                all_scores, all_ords, all_segs = (
+                    all_scores[valid], all_ords[valid], all_segs[valid])
+                # score desc, then seg asc, then doc asc — mergeTopDocs order
+                order = np.lexsort((all_ords, all_segs, -all_scores))
+                page = order[from_:from_ + size]
+                max_score = float(all_scores.max()) if len(all_scores) else None
+            else:
+                page = np.array([], dtype=np.int64)
+                all_scores = all_ords = all_segs = np.array([])
+                max_score = None
+            hits = [self._hit_dict(int(all_segs[j]), int(all_ords[j]),
+                                   float(all_scores[j]), body)
+                    for j in page]
+            responses[i] = {
+                "took": int((time.monotonic() - start) * 1000),
+                "timed_out": False,
+                "_shards": {"total": 1, "successful": 1, "skipped": 0,
+                            "failed": 0},
+                "hits": {
+                    "total": {"value": per_query_total[i],
+                              "relation": "eq"},
+                    "max_score": max_score,
+                    "hits": hits,
+                },
+            }
+
+        return {"took": int((time.monotonic() - start) * 1000),
+                "responses": responses}
 
     def count(self, body: Optional[dict] = None) -> int:
         body = dict(body or {})

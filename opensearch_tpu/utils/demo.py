@@ -1,0 +1,79 @@
+"""Deterministic synthetic corpora for the graft entry, bench.py and tests.
+
+Generates an msmarco-passage-shaped workload (zipfian vocabulary, ~60-token
+passages) without shipping data: the reference's macro benchmarks point at
+external corpora (client/benchmark/README.md:25) that are unavailable here,
+so the bench harness synthesizes an equivalent distribution with a fixed
+seed — same shape, reproducible numbers.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from opensearch_tpu.index.mapper import MapperService
+from opensearch_tpu.index.segment import Segment, SegmentBuilder
+
+DEMO_MAPPING = {
+    "properties": {
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "views": {"type": "integer"},
+        "ts": {"type": "date"},
+    }
+}
+
+
+def _vocab(size: int) -> List[str]:
+    return [f"w{i:05d}" for i in range(size)]
+
+
+def synth_docs(n_docs: int, vocab_size: int = 5000, avg_len: int = 60,
+               seed: int = 42) -> List[dict]:
+    """Zipf-distributed token stream chunked into passages + structured fields."""
+    rng = np.random.default_rng(seed)
+    vocab = np.array(_vocab(vocab_size))
+    ranks = np.arange(1, vocab_size + 1, dtype=np.float64)
+    probs = (1.0 / ranks) / np.sum(1.0 / ranks)
+    lens = np.maximum(8, rng.poisson(avg_len, n_docs))
+    tags = [f"cat{i}" for i in range(16)]
+    docs = []
+    base_ts = 1700000000000  # 2023-11-14T22:13:20Z
+    for i in range(n_docs):
+        toks = rng.choice(vocab, size=int(lens[i]), p=probs)
+        docs.append({
+            "body": " ".join(toks.tolist()),
+            "tag": tags[int(rng.integers(0, len(tags)))],
+            "views": int(rng.integers(0, 10000)),
+            "ts": int(base_ts + rng.integers(0, 90 * 86400_000)),
+        })
+    return docs
+
+
+def build_shards(n_docs: int, n_shards: int = 1, vocab_size: int = 5000,
+                 avg_len: int = 60, seed: int = 42,
+                 mapper: Optional[MapperService] = None,
+                 ) -> Tuple[MapperService, List[Segment]]:
+    """Route synthetic docs round-robin into n_shards sealed segments."""
+    mapper = mapper or MapperService(DEMO_MAPPING)
+    docs = synth_docs(n_docs, vocab_size, avg_len, seed)
+    builders = [SegmentBuilder(mapper, f"s{i}") for i in range(n_shards)]
+    for i, d in enumerate(docs):
+        b = builders[i % n_shards]
+        b.add(mapper.parse_document(f"d{i}", d))
+    return mapper, [b.seal() for b in builders]
+
+
+def query_terms(n_queries: int, vocab_size: int = 5000, seed: int = 7,
+                terms_per_query: int = 2) -> List[str]:
+    """Query strings drawn from the mid-frequency band of the zipf vocab
+    (head terms match ~everything, tail terms match ~nothing)."""
+    rng = np.random.default_rng(seed)
+    lo, hi = vocab_size // 50, vocab_size // 2
+    out = []
+    for _ in range(n_queries):
+        ids = rng.integers(lo, hi, size=terms_per_query)
+        out.append(" ".join(f"w{i:05d}" for i in ids))
+    return out

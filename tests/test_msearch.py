@@ -1,0 +1,69 @@
+"""_msearch batched execution: must agree exactly with per-query search().
+
+Reference contract: action/search/TransportMultiSearchAction — N independent
+bodies, N independent responses; the batching is an implementation detail.
+"""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.search.executor import SearchExecutor, ShardReader
+from opensearch_tpu.utils.demo import build_shards, query_terms
+
+
+@pytest.fixture(scope="module")
+def executor():
+    mapper, segments = build_shards(300, n_shards=2, vocab_size=200,
+                                    avg_len=25, seed=5)
+    # two segments in one shard reader
+    return SearchExecutor(ShardReader(mapper, segments))
+
+
+def test_msearch_matches_search(executor):
+    bodies = [{"query": {"match": {"body": q}}, "size": 7}
+              for q in query_terms(12, 200, seed=9)]
+    # heterogeneous extras: a filtered bool, a match_all, an agg body
+    bodies.append({"query": {"bool": {
+        "must": [{"match": {"body": "w00004"}}],
+        "filter": [{"range": {"views": {"gte": 100}}}]}}, "size": 5})
+    bodies.append({"query": {"match_all": {}}, "size": 3})
+    bodies.append({"query": {"match_all": {}}, "size": 0,
+                   "aggs": {"t": {"terms": {"field": "tag"}}}})
+
+    multi = executor.multi_search(bodies)
+    assert len(multi["responses"]) == len(bodies)
+    for body, got in zip(bodies, multi["responses"]):
+        want = executor.search(body)
+        assert got["hits"]["total"] == want["hits"]["total"], body
+        got_hits = [(h["_id"], round(h["_score"], 5) if h["_score"] else None)
+                    for h in got["hits"]["hits"]]
+        want_hits = [(h["_id"], round(h["_score"], 5) if h["_score"] else None)
+                     for h in want["hits"]["hits"]]
+        assert got_hits == want_hits, body
+        if "aggs" in body:
+            assert got["aggregations"] == want["aggregations"]
+
+
+def test_msearch_rejects_negative_size(executor):
+    from opensearch_tpu.common.errors import IllegalArgumentError
+    with pytest.raises(IllegalArgumentError):
+        executor.multi_search([{"query": {"match_all": {}}, "size": -1}])
+    with pytest.raises(IllegalArgumentError):
+        executor.multi_search([{"query": {"match_all": {}}, "from": -2}])
+
+
+def test_msearch_min_score_and_from(executor):
+    bodies = [
+        {"query": {"match": {"body": "w00002 w00005"}}, "size": 4, "from": 2},
+        {"query": {"match": {"body": "w00002 w00005"}}, "size": 4,
+         "min_score": 1.0},
+    ]
+    multi = executor.multi_search(bodies)
+    for body, got in zip(bodies, multi["responses"]):
+        want = executor.search(body)
+        assert got["hits"]["total"] == want["hits"]["total"]
+        assert [h["_id"] for h in got["hits"]["hits"]] == \
+               [h["_id"] for h in want["hits"]["hits"]]
+        for h in got["hits"]["hits"]:
+            if body.get("min_score"):
+                assert h["_score"] >= 1.0
