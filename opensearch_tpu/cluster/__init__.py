@@ -1,0 +1,1 @@
+"""Cluster layer: routing, state, allocation, coordination (SURVEY.md §2.1 L3)."""

@@ -1,0 +1,454 @@
+"""The per-shard write engine: versioned indexing over immutable columnar segments.
+
+Re-design of the reference InternalEngine (index/engine/InternalEngine.java):
+- `index()` (:845) runs a versioning plan against the live version map
+  (LiveVersionMap.java) — internal version increments, optimistic-concurrency
+  via if_seq_no/if_primary_term, op_type=create conflict — assigns a seq_no
+  (:823, via LocalCheckpointTracker), buffers the doc in the in-memory
+  SegmentBuilder (the IndexWriter-RAM-buffer analog, :1098/:1177), and appends
+  to the translog (Translog.java:540) before acking.
+- `refresh()` seals the RAM buffer into an immutable columnar segment and
+  uploads it to HBM — Lucene's refresh → new-segment-visible semantics:
+  writes/deletes become searchable only at refresh.
+- `flush()` = refresh + persist segments + commit point + translog roll/trim
+  (the Lucene-commit analog via Store commit points).
+- deletes/updates against sealed segments are buffered and applied to the
+  liveness bitmaps at refresh (Lucene buffers deletes in the writer the same
+  way); within one RAM buffer, later versions of a doc supersede earlier ords
+  at seal.
+- reopen after crash: load latest commit point, replay translog ops above the
+  committed local checkpoint (recoverFromTranslog analog).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from opensearch_tpu.common.errors import VersionConflictError
+from opensearch_tpu.index.mapper import MapperService
+from opensearch_tpu.index.segment import Segment, SegmentBuilder, merge_segments
+from opensearch_tpu.index.seqno import (
+    NO_OPS_PERFORMED, LocalCheckpointTracker, ReplicationTracker)
+from opensearch_tpu.index.store import Store
+from opensearch_tpu.index.translog import Translog, TranslogOp
+
+
+@dataclass
+class VersionValue:
+    """LiveVersionMap entry: last known version/seqno/term for a doc id."""
+    version: int
+    seq_no: int
+    primary_term: int
+    deleted: bool = False
+
+
+@dataclass
+class EngineResult:
+    """Result of an index/delete op (reference Engine.IndexResult/DeleteResult)."""
+    doc_id: str
+    version: int
+    seq_no: int
+    primary_term: int
+    created: bool = False
+    found: bool = True
+
+
+@dataclass
+class GetResult:
+    doc_id: str
+    source: dict
+    version: int
+    seq_no: int
+    primary_term: int
+
+
+class InternalEngine:
+    """Single-shard versioned write engine over columnar segments."""
+
+    def __init__(self, mapper: MapperService, data_path: Optional[str] = None,
+                 durability: str = "request", primary_term: int = 1,
+                 allocation_id: str = "alloc_0",
+                 merge_max_segments: int = 8):
+        self.mapper = mapper
+        self.primary_term = primary_term
+        self.merge_max_segments = merge_max_segments
+        self._lock = threading.RLock()
+        self._seg_counter = 0
+        self._persisted: Set[str] = set()
+        self.segments: List[Segment] = []          # sealed, search-visible
+        self.builder = SegmentBuilder(mapper, self._next_seg_id())
+        self._builder_ords: Dict[str, int] = {}    # doc_id → last builder ord
+        self.version_map: Dict[str, VersionValue] = {}
+        self.local_checkpoint_tracker = LocalCheckpointTracker()
+        self.replication_tracker = ReplicationTracker(allocation_id,
+                                                      primary_term)
+        # sealed-segment deletes buffered until refresh (Lucene buffered deletes)
+        self._pending_seal_deletes: List[str] = []
+        self._refresh_listeners: List = []
+        self.store: Optional[Store] = None
+        self.translog: Optional[Translog] = None
+        if data_path is not None:
+            self.store = Store(os.path.join(data_path, "store"))
+            self.translog = Translog(os.path.join(data_path, "translog"),
+                                     durability=durability)
+            self._recover_from_store()
+
+    # ------------------------------------------------------------- plumbing
+
+    def _next_seg_id(self) -> str:
+        sid = f"s{self._seg_counter:06d}"
+        self._seg_counter += 1
+        return sid
+
+    def add_refresh_listener(self, fn):
+        """fn(new_segment | None, deleted_from: List[Segment]) on each refresh."""
+        self._refresh_listeners.append(fn)
+
+    @property
+    def max_seq_no(self) -> int:
+        return self.local_checkpoint_tracker.max_seq_no
+
+    @property
+    def local_checkpoint(self) -> int:
+        return self.local_checkpoint_tracker.checkpoint
+
+    # ------------------------------------------------------- versioning plan
+
+    def _current_version(self, doc_id: str) -> Optional[VersionValue]:
+        vv = self.version_map.get(doc_id)
+        if vv is not None:
+            return vv
+        # fall back to sealed segments: doc_meta carries the persisted
+        # (version, seq_no, term), so CAS keeps working after reopen
+        for seg in reversed(self.segments):
+            ord_ = seg.ord_of(doc_id)
+            if ord_ is not None:
+                meta = seg.doc_meta.get(doc_id)
+                if meta is not None:
+                    return VersionValue(*meta)
+                return VersionValue(version=1, seq_no=NO_OPS_PERFORMED,
+                                    primary_term=self.primary_term)
+        return None
+
+    def _plan_versioning(self, doc_id: str, op_type: str,
+                         if_seq_no: Optional[int],
+                         if_primary_term: Optional[int],
+                         external_version: Optional[int]) -> Tuple[int, bool]:
+        """Returns (new_version, created). Raises VersionConflictError."""
+        cur = self._current_version(doc_id)
+        exists = cur is not None and not cur.deleted
+        if if_seq_no is not None or if_primary_term is not None:
+            if not exists:
+                raise VersionConflictError(
+                    f"[{doc_id}]: version conflict, document does not exist")
+            if ((if_seq_no is not None and cur.seq_no != if_seq_no) or
+                    (if_primary_term is not None
+                     and cur.primary_term != if_primary_term)):
+                raise VersionConflictError(
+                    f"[{doc_id}]: version conflict, required seqNo "
+                    f"[{if_seq_no}], primary term [{if_primary_term}], "
+                    f"current document has seqNo [{cur.seq_no}] and primary "
+                    f"term [{cur.primary_term}]")
+        if op_type == "create" and exists:
+            raise VersionConflictError(
+                f"[{doc_id}]: version conflict, document already exists "
+                f"(current version [{cur.version}])")
+        if external_version is not None:
+            cur_v = cur.version if exists else 0
+            if external_version <= cur_v:
+                raise VersionConflictError(
+                    f"[{doc_id}]: version conflict, current version [{cur_v}] "
+                    f"is higher or equal to the one provided "
+                    f"[{external_version}]")
+            return external_version, not exists
+        # a delete tombstone keeps the version chain alive (LiveVersionMap
+        # retains tombstones for index.gc_deletes): re-create continues it
+        return (cur.version + 1 if cur is not None else 1), not exists
+
+    # ------------------------------------------------------------ operations
+
+    def index(self, doc_id: str, source: dict, op_type: str = "index",
+              if_seq_no: Optional[int] = None,
+              if_primary_term: Optional[int] = None,
+              version: Optional[int] = None) -> EngineResult:
+        """Primary-path indexing (InternalEngine.index :845)."""
+        with self._lock:
+            new_version, created = self._plan_versioning(
+                doc_id, op_type, if_seq_no, if_primary_term, version)
+            seq_no = self.local_checkpoint_tracker.generate_seq_no()
+            self._do_index(doc_id, source, seq_no, new_version)
+            self._log_op(TranslogOp("index", seq_no, self.primary_term,
+                                    doc_id=doc_id, source=source,
+                                    version=new_version))
+            self.local_checkpoint_tracker.mark_processed(seq_no)
+            self._sync_own_checkpoint()
+            return EngineResult(doc_id, new_version, seq_no,
+                                self.primary_term, created=created)
+
+    def index_on_replica(self, doc_id: str, source: dict, seq_no: int,
+                         primary_term: int, version: int) -> EngineResult:
+        """Replica path: seq_no/version pre-assigned, no conflict checks
+        (IndexShard.applyIndexOperationOnReplica → same engine, no versioning)."""
+        with self._lock:
+            self.local_checkpoint_tracker.advance_max_seq_no(seq_no)
+            cur = self.version_map.get(doc_id)
+            # out-of-order delivery: ignore ops older than what we've applied
+            if cur is not None and cur.seq_no >= seq_no:
+                self.local_checkpoint_tracker.mark_processed(seq_no)
+                self._sync_own_checkpoint()
+                return EngineResult(doc_id, cur.version, seq_no, primary_term)
+            self._do_index(doc_id, source, seq_no, version)
+            self._log_op(TranslogOp("index", seq_no, primary_term,
+                                    doc_id=doc_id, source=source,
+                                    version=version))
+            self.local_checkpoint_tracker.mark_processed(seq_no)
+            self._sync_own_checkpoint()
+            return EngineResult(doc_id, version, seq_no, primary_term)
+
+    def _do_index(self, doc_id: str, source: dict, seq_no: int, version: int):
+        doc = self.mapper.parse_document(doc_id, source)
+        ord_ = self.builder.add(doc)
+        self._builder_ords[doc_id] = ord_
+        # supersede any sealed copy at next refresh
+        self._pending_seal_deletes.append(doc_id)
+        self.version_map[doc_id] = VersionValue(version, seq_no,
+                                                self.primary_term)
+
+    def delete(self, doc_id: str, if_seq_no: Optional[int] = None,
+               if_primary_term: Optional[int] = None,
+               version: Optional[int] = None) -> EngineResult:
+        with self._lock:
+            cur = self._current_version(doc_id)
+            found = cur is not None and not cur.deleted
+            # same versioning plan as index (op_type "delete" never
+            # create-conflicts); shares CAS + external-version checks
+            new_version, _ = self._plan_versioning(
+                doc_id, "delete", if_seq_no, if_primary_term, version)
+            seq_no = self.local_checkpoint_tracker.generate_seq_no()
+            self._do_delete(doc_id, seq_no, new_version)
+            self._log_op(TranslogOp("delete", seq_no, self.primary_term,
+                                    doc_id=doc_id, version=new_version))
+            self.local_checkpoint_tracker.mark_processed(seq_no)
+            self._sync_own_checkpoint()
+            return EngineResult(doc_id, new_version, seq_no, self.primary_term,
+                                found=found)
+
+    def delete_on_replica(self, doc_id: str, seq_no: int, primary_term: int,
+                          version: int) -> EngineResult:
+        with self._lock:
+            self.local_checkpoint_tracker.advance_max_seq_no(seq_no)
+            cur = self.version_map.get(doc_id)
+            if cur is not None and cur.seq_no >= seq_no:
+                self.local_checkpoint_tracker.mark_processed(seq_no)
+                self._sync_own_checkpoint()
+                return EngineResult(doc_id, cur.version, seq_no, primary_term)
+            self._do_delete(doc_id, seq_no, version)
+            self._log_op(TranslogOp("delete", seq_no, primary_term,
+                                    doc_id=doc_id, version=version))
+            self.local_checkpoint_tracker.mark_processed(seq_no)
+            self._sync_own_checkpoint()
+            return EngineResult(doc_id, version, seq_no, primary_term)
+
+    def _do_delete(self, doc_id: str, seq_no: int, version: int):
+        self._builder_ords.pop(doc_id, None)
+        self._pending_seal_deletes.append(doc_id)
+        self.version_map[doc_id] = VersionValue(version, seq_no,
+                                                self.primary_term, deleted=True)
+
+    def noop(self, seq_no: int, primary_term: int, reason: str):
+        """Seq-no gap filler (reference Engine.NoOp)."""
+        with self._lock:
+            self.local_checkpoint_tracker.advance_max_seq_no(seq_no)
+            self._log_op(TranslogOp("noop", seq_no, primary_term,
+                                    reason=reason))
+            self.local_checkpoint_tracker.mark_processed(seq_no)
+            self._sync_own_checkpoint()
+
+    def _log_op(self, op: TranslogOp):
+        if self.translog is not None:
+            self.translog.add(op)
+
+    def _sync_own_checkpoint(self):
+        self.replication_tracker.update_local_checkpoint(
+            self.replication_tracker.shard_allocation_id,
+            self.local_checkpoint_tracker.checkpoint)
+
+    # --------------------------------------------------------- realtime GET
+
+    def get(self, doc_id: str, realtime: bool = True) -> Optional[GetResult]:
+        """Realtime GET (reference index/get/ShardGetService.java): reads the
+        version map + RAM buffer so un-refreshed writes are visible."""
+        with self._lock:
+            if realtime:
+                vv = self.version_map.get(doc_id)
+                if vv is not None:
+                    if vv.deleted:
+                        return None
+                    ord_ = self._builder_ords.get(doc_id)
+                    if ord_ is not None:
+                        return GetResult(doc_id, self.builder.sources[ord_],
+                                         vv.version, vv.seq_no, vv.primary_term)
+                    # refreshed already: fall through to segments with known vv
+                    for seg in reversed(self.segments):
+                        o = seg.ord_of(doc_id)
+                        if o is not None:
+                            return GetResult(doc_id, seg.sources[o] or {},
+                                             vv.version, vv.seq_no,
+                                             vv.primary_term)
+                    return None
+            for seg in reversed(self.segments):
+                o = seg.ord_of(doc_id)
+                if o is not None:
+                    version, seq_no, term = seg.doc_meta.get(
+                        doc_id, (1, NO_OPS_PERFORMED, self.primary_term))
+                    return GetResult(doc_id, seg.sources[o] or {}, version,
+                                     seq_no, term)
+            return None
+
+    # ------------------------------------------------------- refresh / flush
+
+    def refresh(self) -> Optional[Segment]:
+        """Seal the RAM buffer; make buffered writes+deletes searchable."""
+        with self._lock:
+            deleted_from: List[Segment] = []
+            # apply buffered deletes/updates to sealed segments' live bitmaps
+            if self._pending_seal_deletes:
+                pending = set(self._pending_seal_deletes)
+                for seg in self.segments:
+                    hit = False
+                    for did in pending:
+                        if seg.delete(did):
+                            hit = True
+                    if hit:
+                        deleted_from.append(seg)
+                self._pending_seal_deletes = []
+            new_seg: Optional[Segment] = None
+            if len(self.builder):
+                new_seg = self.builder.seal()
+                # within-buffer supersession: keep only the last ord per id,
+                # and ids deleted after their last index
+                for ord_ in range(new_seg.num_docs):
+                    did = new_seg.doc_ids[ord_]
+                    vv = self.version_map.get(did)
+                    last = self._builder_ords.get(did)
+                    if last != ord_ or (vv is not None and vv.deleted):
+                        new_seg.live[ord_] = False
+                    elif vv is not None:
+                        new_seg.doc_meta[did] = (vv.version, vv.seq_no,
+                                                 vv.primary_term)
+                self.segments.append(new_seg)
+                self.builder = SegmentBuilder(self.mapper, self._next_seg_id())
+                self._builder_ords = {}
+            if new_seg is not None or deleted_from:
+                for fn in self._refresh_listeners:
+                    fn(new_seg, deleted_from)
+            return new_seg
+
+    def flush(self) -> None:
+        """Refresh + durable commit point + translog roll/trim
+        (InternalEngine.flush → Lucene commit analog)."""
+        with self._lock:
+            self.refresh()
+            if self.store is None:
+                return
+            for seg in self.segments:
+                if seg.seg_id not in self._persisted:
+                    self.store.write_segment(seg)
+                    self._persisted.add(seg.seg_id)
+                else:
+                    self.store.write_live_mask(seg)
+            tl_gen = (self.translog.roll_generation()
+                      if self.translog is not None else 0)
+            self.store.write_commit(
+                generation=tl_gen,
+                seg_ids=[s.seg_id for s in self.segments],
+                local_checkpoint=self.local_checkpoint,
+                max_seq_no=self.max_seq_no,
+                translog_gen=tl_gen,
+                extra={"seg_counter": self._seg_counter,
+                       "primary_term": self.primary_term})
+            if self.translog is not None:
+                # ops ≤ committed checkpoint are recoverable from the store —
+                # but retention leases pin older ops for ops-based peer
+                # recovery (ReplicationTracker.min_retained_seq_no)
+                self.translog.trim_below_seqno(
+                    self.replication_tracker.min_retained_seq_no(),
+                    max_gen=tl_gen)
+            self.store.cleanup_unreferenced()
+
+    def maybe_merge(self) -> Optional[Segment]:
+        """Tiered-merge-lite (MergePolicyConfig/OpenSearchTieredMergePolicy
+        analog): when sealed segments exceed the cap, merge the smallest half
+        into one. Host-side rebuild; the merged segment replaces its inputs."""
+        with self._lock:
+            if len(self.segments) <= self.merge_max_segments:
+                return None
+            ranked = sorted(self.segments, key=lambda s: s.num_docs)
+            victims = ranked[:max(2, len(ranked) // 2)]
+            merged = merge_segments(self.mapper, victims, self._next_seg_id())
+            victim_ids = {s.seg_id for s in victims}
+            self.segments = [s for s in self.segments
+                             if s.seg_id not in victim_ids]
+            self.segments.append(merged)
+            self._persisted -= victim_ids
+            for fn in self._refresh_listeners:
+                fn(merged, [])
+            return merged
+
+    # --------------------------------------------------------------- reopen
+
+    def _recover_from_store(self):
+        commit = self.store.read_latest_commit()
+        replay_from = 0
+        if commit is not None:
+            for sid in commit["segments"]:
+                seg = self.store.read_segment(sid)
+                self.segments.append(seg)
+                self._persisted.add(sid)
+            self._seg_counter = commit["extra"].get("seg_counter",
+                                                    len(self.segments))
+            self.builder = SegmentBuilder(self.mapper, self._next_seg_id())
+            ckpt = commit["local_checkpoint"]
+            # restore max_seq_no too: a gap above the checkpoint must not
+            # cause reissued seq_nos colliding with committed ops
+            self.local_checkpoint_tracker = LocalCheckpointTracker(
+                max_seq_no=max(commit.get("max_seq_no", ckpt), ckpt),
+                local_checkpoint=ckpt)
+            replay_from = ckpt + 1
+        if self.translog is not None:
+            for op in self.translog.read_ops(from_seq_no=replay_from):
+                self.local_checkpoint_tracker.advance_max_seq_no(op.seq_no)
+                if op.op_type == "index":
+                    self._do_index(op.doc_id, op.source, op.seq_no, op.version)
+                elif op.op_type == "delete":
+                    self._do_delete(op.doc_id, op.seq_no, op.version)
+                self.local_checkpoint_tracker.mark_processed(op.seq_no)
+        self._sync_own_checkpoint()
+
+    def close(self):
+        if self.translog is not None:
+            self.translog.close()
+
+    # ----------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        with self._lock:
+            live = sum(s.live_doc_count for s in self.segments)
+            return {
+                "docs": {"count": live + len(self.builder),
+                         "deleted": sum(s.num_docs - s.live_doc_count
+                                        for s in self.segments)},
+                "segments": {"count": len(self.segments),
+                             "memory_bytes": sum(s.memory_bytes()
+                                                 for s in self.segments)},
+                "seq_no": {"max_seq_no": self.max_seq_no,
+                           "local_checkpoint": self.local_checkpoint,
+                           "global_checkpoint":
+                               self.replication_tracker.global_checkpoint},
+                "translog": {"operations":
+                             (self.translog.total_operations()
+                              if self.translog else 0)},
+            }

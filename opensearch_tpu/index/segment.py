@@ -170,6 +170,10 @@ class Segment:
         self.positions = positions or {}
         self.live = np.ones(num_docs, dtype=bool)  # deletes bitmap
         self._id_to_ord = {d: i for i, d in enumerate(doc_ids)}
+        # doc_id → (version, seq_no, primary_term) — Lucene stores these as
+        # per-doc fields (_version docvalue, _seq_no); here a host-side map
+        # attached by the engine at seal/merge time
+        self.doc_meta: Dict[str, Tuple[int, int, int]] = {}
 
     @property
     def live_doc_count(self) -> int:
@@ -406,10 +410,15 @@ def merge_segments(mapper: MapperService, segments: List[Segment],
     optimization.
     """
     builder = SegmentBuilder(mapper, seg_id=seg_id)
+    doc_meta = {}
     for seg in segments:
         for ord_ in range(seg.num_docs):
             if not seg.live[ord_]:
                 continue
             doc = mapper.parse_document(seg.doc_ids[ord_], seg.sources[ord_] or {})
             builder.add(doc)
-    return builder.seal()
+            if seg.doc_ids[ord_] in seg.doc_meta:
+                doc_meta[seg.doc_ids[ord_]] = seg.doc_meta[seg.doc_ids[ord_]]
+    merged = builder.seal()
+    merged.doc_meta = doc_meta
+    return merged

@@ -1,0 +1,154 @@
+"""Sequence-number machinery: local checkpoints, replication tracking, leases.
+
+Re-design of the reference seqno subsystem:
+- `LocalCheckpointTracker` (index/seqno/LocalCheckpointTracker.java): assigns
+  monotonically increasing seq_nos on the primary and tracks the max
+  contiguous processed/persisted seq_no (the local checkpoint) as ops complete
+  possibly out of order.
+- `ReplicationTracker` (index/seqno/ReplicationTracker.java:103): on the
+  primary, tracks every in-sync copy's local checkpoint; the **global
+  checkpoint** is the minimum over in-sync copies — everything at or below it
+  is durable on every in-sync copy. Retention leases
+  (RetentionLease*.java) pin translog ops above a peer's checkpoint so
+  ops-based recovery stays possible.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set
+
+NO_OPS_PERFORMED = -1
+UNASSIGNED_SEQ_NO = -2
+
+
+class LocalCheckpointTracker:
+    """Max contiguous completed seq_no; ops may complete out of order."""
+
+    def __init__(self, max_seq_no: int = NO_OPS_PERFORMED,
+                 local_checkpoint: int = NO_OPS_PERFORMED):
+        self._max_seq_no = max_seq_no
+        self._checkpoint = local_checkpoint
+        self._pending: Set[int] = set()  # completed above the checkpoint
+
+    def generate_seq_no(self) -> int:
+        self._max_seq_no += 1
+        return self._max_seq_no
+
+    def advance_max_seq_no(self, seq_no: int):
+        """Replica path: seq_nos arrive pre-assigned by the primary."""
+        if seq_no > self._max_seq_no:
+            self._max_seq_no = seq_no
+
+    def mark_processed(self, seq_no: int):
+        if seq_no <= self._checkpoint:
+            return
+        self._pending.add(seq_no)
+        while (self._checkpoint + 1) in self._pending:
+            self._checkpoint += 1
+            self._pending.discard(self._checkpoint)
+
+    @property
+    def max_seq_no(self) -> int:
+        return self._max_seq_no
+
+    @property
+    def checkpoint(self) -> int:
+        return self._checkpoint
+
+    def has_processed(self, seq_no: int) -> bool:
+        return seq_no <= self._checkpoint or seq_no in self._pending
+
+
+@dataclass
+class RetentionLease:
+    """Pins translog retention for a peer (index/seqno/RetentionLease.java)."""
+    lease_id: str
+    retaining_seq_no: int
+    timestamp_ms: int
+    source: str
+
+
+@dataclass
+class CheckpointState:
+    """Per-copy tracking entry (ReplicationTracker.CheckpointState :681)."""
+    local_checkpoint: int = UNASSIGNED_SEQ_NO
+    in_sync: bool = False
+    tracked: bool = False
+
+
+class ReplicationTracker:
+    """Primary-side global-checkpoint computation over in-sync copies."""
+
+    def __init__(self, shard_allocation_id: str, primary_term: int = 1):
+        self.shard_allocation_id = shard_allocation_id
+        self.primary_term = primary_term
+        self.checkpoints: Dict[str, CheckpointState] = {
+            shard_allocation_id: CheckpointState(in_sync=True, tracked=True)
+        }
+        self.global_checkpoint = NO_OPS_PERFORMED
+        self.retention_leases: Dict[str, RetentionLease] = {}
+
+    # ------------------------------------------------------------ membership
+
+    def init_tracking(self, allocation_id: str):
+        """Start tracking a recovering copy (not yet in-sync)."""
+        self.checkpoints.setdefault(allocation_id, CheckpointState(tracked=True))
+
+    def mark_in_sync(self, allocation_id: str, local_checkpoint: int):
+        st = self.checkpoints.setdefault(allocation_id, CheckpointState())
+        st.tracked = True
+        st.in_sync = True
+        st.local_checkpoint = max(st.local_checkpoint, local_checkpoint)
+        self._recompute()
+
+    def remove_copy(self, allocation_id: str):
+        if allocation_id != self.shard_allocation_id:
+            self.checkpoints.pop(allocation_id, None)
+            self._recompute()
+
+    # ----------------------------------------------------------- checkpoints
+
+    def update_local_checkpoint(self, allocation_id: str, local_checkpoint: int):
+        st = self.checkpoints.get(allocation_id)
+        if st is None:
+            return
+        if local_checkpoint > st.local_checkpoint:
+            st.local_checkpoint = local_checkpoint
+        self._recompute()
+
+    def _recompute(self):
+        in_sync = [st.local_checkpoint for st in self.checkpoints.values()
+                   if st.in_sync]
+        if in_sync:
+            new_gcp = min(in_sync)
+            if new_gcp > self.global_checkpoint:
+                self.global_checkpoint = new_gcp
+
+    def in_sync_ids(self) -> Set[str]:
+        return {aid for aid, st in self.checkpoints.items() if st.in_sync}
+
+    # ---------------------------------------------------------------- leases
+
+    def add_lease(self, lease_id: str, retaining_seq_no: int, source: str,
+                  timestamp_ms: int = 0) -> RetentionLease:
+        lease = RetentionLease(lease_id, retaining_seq_no, timestamp_ms, source)
+        self.retention_leases[lease_id] = lease
+        return lease
+
+    def renew_lease(self, lease_id: str, retaining_seq_no: int,
+                    timestamp_ms: int = 0):
+        lease = self.retention_leases.get(lease_id)
+        if lease is None:
+            raise KeyError(lease_id)
+        lease.retaining_seq_no = max(lease.retaining_seq_no, retaining_seq_no)
+        lease.timestamp_ms = timestamp_ms
+
+    def remove_lease(self, lease_id: str):
+        self.retention_leases.pop(lease_id, None)
+
+    def min_retained_seq_no(self) -> int:
+        """Lowest seq_no that must stay replayable from the translog."""
+        floors = [l.retaining_seq_no for l in self.retention_leases.values()]
+        floors.append(self.global_checkpoint + 1)
+        return min(floors)

@@ -1,0 +1,262 @@
+"""IndexService: one index = N shards + mapper + settings; document-level API.
+
+Re-design of the reference IndexService (index/IndexService.java:133) plus the
+document-action layer that sits above it: murmur3 doc→shard routing
+(cluster/routing/OperationRouting.java:412), the update API's
+get-merge-reindex loop (action/update/UpdateHelper.java), _bulk grouping by
+shard (action/bulk/TransportBulkAction.java:484), and multi-shard search via
+the coordinator reduce (search/controller.py).
+"""
+
+from __future__ import annotations
+
+import secrets
+import time
+from typing import Any, Dict, List, Optional
+
+from opensearch_tpu.cluster.routing import generate_shard_id
+from opensearch_tpu.common.errors import (
+    DocumentMissingError, IllegalArgumentError, OpenSearchTpuError)
+from opensearch_tpu.index.mapper import MapperService
+from opensearch_tpu.index.shard import IndexShard
+
+
+def _auto_id() -> str:
+    """Auto-generated doc id (reference: time-based UUID, 20 url-safe chars)."""
+    return secrets.token_urlsafe(15)
+
+
+def deep_merge(base: dict, patch: dict) -> dict:
+    """Recursive map merge used by partial-doc updates (UpdateHelper)."""
+    out = dict(base)
+    for k, v in patch.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+class IndexService:
+    def __init__(self, index_name: str, mapping: Optional[dict] = None,
+                 settings: Optional[dict] = None,
+                 data_path: Optional[str] = None):
+        settings = settings or {}
+        self.index_name = index_name
+        self.settings = settings
+        self.num_shards = int(settings.get("number_of_shards", 1))
+        self.num_replicas = int(settings.get("number_of_replicas", 0))
+        self.routing_partition_size = int(
+            settings.get("routing_partition_size", 1))
+        self.routing_num_shards = int(
+            settings.get("number_of_routing_shards", self.num_shards))
+        if self.num_shards < 1:
+            raise IllegalArgumentError("number_of_shards must be >= 1")
+        # reference (IndexMetadata.java:784): routingNumShards must be a
+        # positive multiple of numberOfShards or routing goes out of range
+        if (self.routing_num_shards < self.num_shards
+                or self.routing_num_shards % self.num_shards != 0):
+            raise IllegalArgumentError(
+                f"number_of_routing_shards [{self.routing_num_shards}] must "
+                f"be a multiple of number_of_shards [{self.num_shards}]")
+        if self.routing_partition_size < 1 or (
+                self.routing_partition_size > 1
+                and self.routing_partition_size >= self.num_shards):
+            raise IllegalArgumentError(
+                f"routing_partition_size [{self.routing_partition_size}] "
+                f"should be a positive number less than number_of_shards "
+                f"[{self.num_shards}]")
+        self.mapper = MapperService(mapping)
+        durability = settings.get("translog.durability", "request")
+        self.shards: List[IndexShard] = [
+            IndexShard(i, self.mapper, index_name=index_name,
+                       data_path=data_path, durability=durability)
+            for i in range(self.num_shards)
+        ]
+        self.creation_date = int(time.time() * 1000)
+
+    # --------------------------------------------------------------- routing
+
+    def shard_for(self, doc_id: str, routing: Optional[str] = None) -> IndexShard:
+        sid = generate_shard_id(
+            doc_id, self.num_shards, routing=routing,
+            routing_num_shards=self.routing_num_shards,
+            routing_partition_size=self.routing_partition_size)
+        return self.shards[sid]
+
+    # ------------------------------------------------------------- doc CRUD
+
+    def index_doc(self, doc_id: Optional[str], source: dict,
+                  routing: Optional[str] = None, op_type: str = "index",
+                  **kw) -> dict:
+        if doc_id is None:
+            doc_id = _auto_id()
+            op_type = "create"
+        shard = self.shard_for(doc_id, routing)
+        res = shard.index_doc(doc_id, source, op_type=op_type, **kw)
+        return self._write_response(res, shard,
+                                    "created" if res.created else "updated")
+
+    def get_doc(self, doc_id: str, routing: Optional[str] = None,
+                realtime: bool = True) -> dict:
+        shard = self.shard_for(doc_id, routing)
+        res = shard.get_doc(doc_id, realtime=realtime)
+        if res is None:
+            return {"_index": self.index_name, "_id": doc_id, "found": False}
+        return {"_index": self.index_name, "_id": doc_id, "found": True,
+                "_version": res.version, "_seq_no": res.seq_no,
+                "_primary_term": res.primary_term, "_source": res.source}
+
+    def delete_doc(self, doc_id: str, routing: Optional[str] = None,
+                   **kw) -> dict:
+        shard = self.shard_for(doc_id, routing)
+        res = shard.delete_doc(doc_id, **kw)
+        return self._write_response(res, shard,
+                                    "deleted" if res.found else "not_found")
+
+    def update_doc(self, doc_id: str, body: dict,
+                   routing: Optional[str] = None) -> dict:
+        """Partial update: realtime GET → merge → reindex with seq-no CAS
+        (UpdateHelper semantics: detect_noop default true, upsert,
+        doc_as_upsert, retry left to the caller)."""
+        shard = self.shard_for(doc_id, routing)
+        cur = shard.get_doc(doc_id)
+        doc_patch = body.get("doc")
+        if cur is None:
+            if body.get("doc_as_upsert") and doc_patch is not None:
+                new_source = doc_patch
+            elif "upsert" in body:
+                new_source = body["upsert"]
+            else:
+                raise DocumentMissingError(
+                    f"[{doc_id}]: document missing")
+            res = shard.index_doc(doc_id, new_source, op_type="create")
+            return self._write_response(res, shard, "created")
+        if doc_patch is None:
+            raise IllegalArgumentError("update requires [doc] or [upsert]")
+        merged = deep_merge(cur.source, doc_patch)
+        if body.get("detect_noop", True) and merged == cur.source:
+            return {"_index": self.index_name, "_id": doc_id,
+                    "_version": cur.version, "result": "noop",
+                    "_seq_no": cur.seq_no, "_primary_term": cur.primary_term,
+                    "_shards": {"total": 0, "successful": 0, "failed": 0}}
+        res = shard.index_doc(doc_id, merged, if_seq_no=cur.seq_no,
+                              if_primary_term=cur.primary_term)
+        return self._write_response(res, shard, "updated")
+
+    def mget(self, ids: List[Any]) -> dict:
+        docs = []
+        for item in ids:
+            if isinstance(item, dict):
+                docs.append(self.get_doc(item["_id"],
+                                         routing=item.get("routing")))
+            else:
+                docs.append(self.get_doc(item))
+        return {"docs": docs}
+
+    def _write_response(self, res, shard: IndexShard, result: str) -> dict:
+        return {
+            "_index": self.index_name,
+            "_id": res.doc_id,
+            "_version": res.version,
+            "result": result,
+            "_shards": {"total": 1 + self.num_replicas,
+                        "successful": 1, "failed": 0},
+            "_seq_no": res.seq_no,
+            "_primary_term": res.primary_term,
+        }
+
+    # ------------------------------------------------------------------ bulk
+
+    def bulk(self, operations: List[dict]) -> dict:
+        """Execute parsed bulk items: [{action, id, source, routing, ...}].
+        Items are routed per doc and executed in order per shard
+        (TransportShardBulkAction.performOnPrimary runs items serially)."""
+        start = time.monotonic()
+        items = []
+        errors = False
+        for op in operations:
+            action = op["action"]
+            try:
+                if action in ("index", "create"):
+                    resp = self.index_doc(op.get("id"), op["source"],
+                                          routing=op.get("routing"),
+                                          op_type=("create"
+                                                   if action == "create"
+                                                   else "index"))
+                    status = 201 if resp["result"] == "created" else 200
+                elif action == "delete":
+                    resp = self.delete_doc(op["id"], routing=op.get("routing"))
+                    status = 200 if resp["result"] == "deleted" else 404
+                elif action == "update":
+                    resp = self.update_doc(op["id"], op["source"],
+                                           routing=op.get("routing"))
+                    status = 200
+                else:
+                    raise IllegalArgumentError(
+                        f"unknown bulk action [{action}]")
+                resp["status"] = status
+                items.append({action: resp})
+            except OpenSearchTpuError as e:
+                errors = True
+                items.append({action: {
+                    "_index": self.index_name, "_id": op.get("id"),
+                    "status": e.status,
+                    "error": e.to_xcontent(),
+                }})
+        return {"took": int((time.monotonic() - start) * 1000),
+                "errors": errors, "items": items}
+
+    # ---------------------------------------------------------------- search
+
+    def search(self, body: Optional[dict] = None) -> dict:
+        from opensearch_tpu.search.controller import execute_search
+        return execute_search([s.executor for s in self.shards], body)
+
+    def multi_search(self, bodies: List[dict]) -> dict:
+        if self.num_shards == 1:
+            return self.shards[0].executor.multi_search(bodies)
+        return {"took": 0,
+                "responses": [self.search(b) for b in bodies]}
+
+    def count(self, body: Optional[dict] = None) -> int:
+        body = dict(body or {})
+        body["size"] = 0
+        body.pop("from", None)
+        return self.search(body)["hits"]["total"]["value"]
+
+    # ------------------------------------------------------------- lifecycle
+
+    def refresh(self):
+        for s in self.shards:
+            s.refresh()
+
+    def flush(self):
+        for s in self.shards:
+            s.flush()
+
+    def force_merge(self):
+        for s in self.shards:
+            s.force_merge()
+
+    def close(self):
+        for s in self.shards:
+            s.close()
+
+    def stats(self) -> dict:
+        shard_stats = [s.stats() for s in self.shards]
+        return {
+            "index": self.index_name,
+            "docs": {"count": sum(s["docs"]["count"] for s in shard_stats),
+                     "deleted": sum(s["docs"]["deleted"]
+                                    for s in shard_stats)},
+            "segments": {"count": sum(s["segments"]["count"]
+                                      for s in shard_stats)},
+            "shards": shard_stats,
+        }
+
+    def mapping_dict(self) -> dict:
+        return self.mapper.mapping_dict()
+
+    def put_mapping(self, mapping: dict):
+        self.mapper.merge(mapping)

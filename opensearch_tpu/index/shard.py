@@ -1,0 +1,109 @@
+"""IndexShard: the per-shard state machine gluing engine, store, and search.
+
+Re-design of the reference IndexShard (index/shard/IndexShard.java:231):
+holds the engine, exposes the primary/replica operation entry points
+(applyIndexOperationOnPrimary :881 / applyIndexOperationOnReplica :906),
+tracks the primary term, and keeps the search reader (ShardReader — the
+acquireSearcher analog) in sync with the engine's sealed segments: refresh
+seals the RAM buffer and uploads the new columnar segment to device HBM,
+deletes propagate to device liveness masks.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from opensearch_tpu.index.engine import EngineResult, GetResult, InternalEngine
+from opensearch_tpu.index.mapper import MapperService
+from opensearch_tpu.search.executor import SearchExecutor, ShardReader
+
+
+class IndexShard:
+    def __init__(self, shard_id: int, mapper: MapperService,
+                 index_name: str = "_index",
+                 data_path: Optional[str] = None,
+                 durability: str = "request", primary_term: int = 1,
+                 primary: bool = True):
+        self.shard_id = shard_id
+        self.index_name = index_name
+        self.primary = primary
+        shard_path = (os.path.join(data_path, str(shard_id))
+                      if data_path is not None else None)
+        self.engine = InternalEngine(
+            mapper, data_path=shard_path, durability=durability,
+            primary_term=primary_term,
+            allocation_id=f"{index_name}_{shard_id}_alloc")
+        self.reader = ShardReader(mapper, index_name=index_name)
+        self.executor = SearchExecutor(self.reader)
+        self._sync_reader()
+
+    # --------------------------------------------------------------- writes
+
+    def index_doc(self, doc_id: str, source: dict, **kw) -> EngineResult:
+        return self.engine.index(doc_id, source, **kw)
+
+    def index_on_replica(self, doc_id: str, source: dict, seq_no: int,
+                         primary_term: int, version: int) -> EngineResult:
+        return self.engine.index_on_replica(doc_id, source, seq_no,
+                                            primary_term, version)
+
+    def delete_doc(self, doc_id: str, **kw) -> EngineResult:
+        return self.engine.delete(doc_id, **kw)
+
+    def delete_on_replica(self, doc_id: str, seq_no: int, primary_term: int,
+                          version: int) -> EngineResult:
+        return self.engine.delete_on_replica(doc_id, seq_no, primary_term,
+                                             version)
+
+    def get_doc(self, doc_id: str, realtime: bool = True) -> Optional[GetResult]:
+        return self.engine.get(doc_id, realtime=realtime)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def refresh(self):
+        self.engine.refresh()
+        self._sync_reader()
+
+    def flush(self):
+        self.engine.flush()
+        self._sync_reader()
+
+    def force_merge(self):
+        """Merge down to one segment (_forcemerge analog)."""
+        prev = self.engine.merge_max_segments
+        self.engine.merge_max_segments = 1
+        try:
+            while self.engine.maybe_merge() is not None:
+                pass
+        finally:
+            self.engine.merge_max_segments = prev
+        self._sync_reader()
+
+    def maybe_merge(self):
+        merged = self.engine.maybe_merge()
+        if merged is not None:
+            self._sync_reader()
+        return merged
+
+    def _sync_reader(self):
+        """Reconcile the device-resident reader with engine segments."""
+        engine_ids = {s.seg_id for s in self.engine.segments}
+        for seg in list(self.reader.segments):
+            if seg.seg_id not in engine_ids:
+                self.reader.remove_segment(seg.seg_id)
+        reader_ids = {s.seg_id for s in self.reader.segments}
+        for seg in self.engine.segments:
+            if seg.seg_id not in reader_ids:
+                self.reader.add_segment(seg)
+            else:
+                self.reader.notify_deletes(seg)
+
+    def close(self):
+        self.engine.close()
+
+    def stats(self) -> dict:
+        st = self.engine.stats()
+        st["shard_id"] = self.shard_id
+        st["primary"] = self.primary
+        return st

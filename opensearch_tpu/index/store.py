@@ -1,0 +1,244 @@
+"""On-disk shard store: segment persistence, commit points, checksums.
+
+Re-design of the reference Store (index/store/Store.java) + commit-point
+handling (index/engine/CombinedDeletionPolicy.java): sealed columnar segments
+are written as `.npz` array bundles plus a JSON sidecar for dictionaries, a
+`.liv` numpy file mirrors Lucene's live-docs files (deletes applied after
+seal), and a `segments_N.json` commit point lists the referenced files with
+content checksums — the metadata-snapshot diffing that powers file-based peer
+recovery (indices/recovery/RecoverySourceHandler.java:349 phase1) compares
+exactly these checksums.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from opensearch_tpu.index.mapper import MapperService
+from opensearch_tpu.index.segment import (
+    DocValuesColumn, FieldStats, OrdinalsColumn, Segment, TermMeta, VectorColumn)
+
+
+def _fsync_path(path: str):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _file_checksum(path: str) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+@dataclass
+class StoreFileMetadata:
+    """Name + length + checksum (reference StoreFileMetadata)."""
+    name: str
+    length: int
+    checksum: str
+
+
+class Store:
+    """Directory of segment files + commit points for one shard."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    # ---------------------------------------------------------- segment io
+
+    def _seg_paths(self, seg_id: str) -> Tuple[str, str, str]:
+        base = os.path.join(self.directory, f"seg_{seg_id}")
+        return base + ".npz", base + ".meta.json", base + ".liv.npy"
+
+    def write_segment(self, seg: Segment):
+        npz_path, meta_path, liv_path = self._seg_paths(seg.seg_id)
+        arrays: Dict[str, np.ndarray] = {
+            "post_docs": seg.post_docs, "post_tf": seg.post_tf,
+        }
+        for f, arr in seg.norms.items():
+            arrays[f"norms::{f}"] = arr
+        for f, col in seg.numeric_dv.items():
+            arrays[f"ndv_docs::{f}"] = col.doc_ids
+            arrays[f"ndv_vals::{f}"] = col.values
+            arrays[f"ndv_exists::{f}"] = col.exists
+            arrays[f"ndv_counts::{f}"] = col.counts
+            arrays[f"ndv_ords::{f}"] = col.value_ords
+            arrays[f"ndv_unique::{f}"] = col.unique
+        for f, col in seg.ordinal_dv.items():
+            arrays[f"odv_docs::{f}"] = col.doc_ids
+            arrays[f"odv_ords::{f}"] = col.ords
+            arrays[f"odv_exists::{f}"] = col.exists
+            arrays[f"odv_hashes::{f}"] = col.ord_hashes
+        for f, col in seg.vector_dv.items():
+            arrays[f"vec::{f}"] = col.vectors
+            arrays[f"vec_exists::{f}"] = col.exists
+        # ragged positions → flat + offsets per (field, term)
+        pos_keys: List[List[str]] = []
+        pos_flat: List[np.ndarray] = []
+        pos_offsets: List[int] = [0]
+        pos_counts: List[int] = []
+        for (f, t), plists in seg.positions.items():
+            pos_keys.append([f, t])
+            pos_counts.append(len(plists))
+            for p in plists:
+                pos_flat.append(p)
+                pos_offsets.append(pos_offsets[-1] + len(p))
+        arrays["pos_flat"] = (np.concatenate(pos_flat)
+                              if pos_flat else np.zeros(0, np.int32))
+        arrays["pos_offsets"] = np.asarray(pos_offsets, np.int64)
+        np.savez_compressed(npz_path + ".tmp.npz", **arrays)
+        _fsync_path(npz_path + ".tmp.npz")
+        os.replace(npz_path + ".tmp.npz", npz_path)
+
+        meta = {
+            "seg_id": seg.seg_id,
+            "num_docs": seg.num_docs,
+            "doc_ids": seg.doc_ids,
+            "sources": seg.sources,
+            "term_dict": [[f, t, m.doc_freq, m.total_term_freq, m.start_block,
+                           m.num_blocks] for (f, t), m in seg.term_dict.items()],
+            "field_stats": {f: [s.doc_count, s.sum_total_term_freq, s.sum_doc_freq]
+                            for f, s in seg.field_stats.items()},
+            "ordinal_dicts": {f: col.dictionary
+                              for f, col in seg.ordinal_dv.items()},
+            "pos_keys": pos_keys,
+            "pos_counts": pos_counts,
+            "doc_meta": {d: list(m) for d, m in seg.doc_meta.items()},
+        }
+        tmp = meta_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(meta, fh, separators=(",", ":"))
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, meta_path)
+        self.write_live_mask(seg)
+
+    def write_live_mask(self, seg: Segment):
+        _, _, liv_path = self._seg_paths(seg.seg_id)
+        np.save(liv_path + ".tmp.npy", seg.live)
+        _fsync_path(liv_path + ".tmp.npy")
+        os.replace(liv_path + ".tmp.npy", liv_path)
+
+    def read_segment(self, seg_id: str) -> Segment:
+        npz_path, meta_path, liv_path = self._seg_paths(seg_id)
+        with open(meta_path, "r", encoding="utf-8") as fh:
+            meta = json.load(fh)
+        z = np.load(npz_path, allow_pickle=False)
+        norms, numeric_dv, ordinal_dv, vector_dv = {}, {}, {}, {}
+        for key in z.files:
+            if key.startswith("norms::"):
+                norms[key.split("::", 1)[1]] = z[key]
+        ndv_fields = {k.split("::", 1)[1] for k in z.files
+                      if k.startswith("ndv_docs::")}
+        for f in ndv_fields:
+            numeric_dv[f] = DocValuesColumn(
+                z[f"ndv_docs::{f}"], z[f"ndv_vals::{f}"], z[f"ndv_exists::{f}"],
+                z[f"ndv_counts::{f}"], z[f"ndv_ords::{f}"], z[f"ndv_unique::{f}"])
+        for f, dictionary in meta["ordinal_dicts"].items():
+            ordinal_dv[f] = OrdinalsColumn(
+                z[f"odv_docs::{f}"], z[f"odv_ords::{f}"], z[f"odv_exists::{f}"],
+                dictionary, z[f"odv_hashes::{f}"])
+        vec_fields = {k.split("::", 1)[1] for k in z.files if k.startswith("vec::")}
+        for f in vec_fields:
+            vector_dv[f] = VectorColumn(z[f"vec::{f}"], z[f"vec_exists::{f}"])
+        term_dict = {(f, t): TermMeta(df, ttf, sb, nb)
+                     for f, t, df, ttf, sb, nb in meta["term_dict"]}
+        field_stats = {f: FieldStats(*vals)
+                       for f, vals in meta["field_stats"].items()}
+        positions: Dict[Tuple[str, str], List[np.ndarray]] = {}
+        flat, offsets = z["pos_flat"], z["pos_offsets"]
+        i = 0
+        for (f, t), cnt in zip(meta["pos_keys"], meta["pos_counts"]):
+            lists = [flat[offsets[i + j]:offsets[i + j + 1]] for j in range(cnt)]
+            positions[(f, t)] = lists
+            i += cnt
+        seg = Segment(meta["seg_id"], meta["num_docs"], meta["doc_ids"],
+                      meta["sources"], term_dict, z["post_docs"], z["post_tf"],
+                      norms, field_stats, numeric_dv, ordinal_dv, vector_dv,
+                      positions=positions)
+        seg.doc_meta = {d: tuple(m)
+                        for d, m in meta.get("doc_meta", {}).items()}
+        if os.path.exists(liv_path):
+            seg.live = np.load(liv_path)
+        return seg
+
+    def delete_segment_files(self, seg_id: str):
+        for path in self._seg_paths(seg_id):
+            if os.path.exists(path):
+                os.remove(path)
+
+    # -------------------------------------------------------- commit points
+
+    def _commit_path(self, generation: int) -> str:
+        return os.path.join(self.directory, f"segments_{generation}.json")
+
+    def write_commit(self, generation: int, seg_ids: List[str],
+                     local_checkpoint: int, max_seq_no: int,
+                     translog_gen: int, extra: Optional[dict] = None):
+        files: List[dict] = []
+        for sid in seg_ids:
+            for path in self._seg_paths(sid):
+                if os.path.exists(path):
+                    files.append({"name": os.path.basename(path),
+                                  "length": os.path.getsize(path),
+                                  "checksum": _file_checksum(path)})
+        commit = {
+            "generation": generation, "segments": seg_ids,
+            "local_checkpoint": local_checkpoint, "max_seq_no": max_seq_no,
+            "translog_generation": translog_gen,
+            "files": files, "extra": extra or {},
+        }
+        tmp = self._commit_path(generation) + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(commit, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self._commit_path(generation))
+        # drop older commit points (CombinedDeletionPolicy keeps the latest)
+        for name in os.listdir(self.directory):
+            if name.startswith("segments_") and name.endswith(".json"):
+                gen = int(name[len("segments_"):-len(".json")])
+                if gen < generation:
+                    os.remove(os.path.join(self.directory, name))
+
+    def read_latest_commit(self) -> Optional[dict]:
+        best: Optional[Tuple[int, str]] = None
+        for name in os.listdir(self.directory):
+            if name.startswith("segments_") and name.endswith(".json"):
+                gen = int(name[len("segments_"):-len(".json")])
+                if best is None or gen > best[0]:
+                    best = (gen, name)
+        if best is None:
+            return None
+        with open(os.path.join(self.directory, best[1]), "r",
+                  encoding="utf-8") as fh:
+            return json.load(fh)
+
+    def metadata_snapshot(self) -> Dict[str, StoreFileMetadata]:
+        """Checksummed file listing of the latest commit (recovery diffing)."""
+        commit = self.read_latest_commit()
+        if commit is None:
+            return {}
+        return {f["name"]: StoreFileMetadata(f["name"], f["length"], f["checksum"])
+                for f in commit["files"]}
+
+    def cleanup_unreferenced(self):
+        commit = self.read_latest_commit()
+        if commit is None:
+            return
+        live = {f["name"] for f in commit["files"]}
+        for name in os.listdir(self.directory):
+            if name.startswith("seg_") and name not in live:
+                os.remove(os.path.join(self.directory, name))

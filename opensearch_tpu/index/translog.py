@@ -1,0 +1,204 @@
+"""Per-shard durable write-ahead log.
+
+TPU-native re-design of the reference translog (index/translog/Translog.java:115;
+`add()` at :540): every accepted operation is appended to the current
+generation file before it is acknowledged; the fsync policy is configurable
+(`request` = fsync per op batch, `async` = fsync on interval/explicit sync,
+matching `index.translog.durability`). Generations roll on flush
+(`rollGeneration`), old generations are trimmed once their ops are safely in a
+commit point. On engine reopen the translog is replayed above the commit
+point's persisted local checkpoint (reference recovery path:
+index/engine/InternalEngine.java recoverFromTranslog).
+
+Frame format per op (binary, little-endian):
+    u32 length | u32 crc32(payload) | payload (JSON utf-8)
+A torn tail (partial frame / checksum mismatch) is truncated on open, the
+reference's behavior for a crash mid-write.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional
+
+_HEADER = struct.Struct("<II")
+CHECKPOINT_FILE = "translog.ckp"
+
+
+@dataclass
+class TranslogOp:
+    """One logged operation: index / delete / no-op (reference Translog.Operation)."""
+    op_type: str              # "index" | "delete" | "noop"
+    seq_no: int
+    primary_term: int
+    doc_id: Optional[str] = None
+    source: Optional[dict] = None
+    version: int = 1
+    reason: Optional[str] = None   # for no-ops
+
+    def to_payload(self) -> bytes:
+        return json.dumps({
+            "op": self.op_type, "seq_no": self.seq_no,
+            "primary_term": self.primary_term, "id": self.doc_id,
+            "source": self.source, "version": self.version,
+            "reason": self.reason,
+        }, separators=(",", ":")).encode("utf-8")
+
+    @staticmethod
+    def from_payload(raw: bytes) -> "TranslogOp":
+        d = json.loads(raw.decode("utf-8"))
+        return TranslogOp(op_type=d["op"], seq_no=d["seq_no"],
+                          primary_term=d["primary_term"], doc_id=d.get("id"),
+                          source=d.get("source"), version=d.get("version", 1),
+                          reason=d.get("reason"))
+
+
+def _gen_path(directory: str, gen: int) -> str:
+    return os.path.join(directory, f"translog-{gen}.tlog")
+
+
+def _read_gen_file(path: str, truncate_torn: bool = True) -> List[TranslogOp]:
+    ops: List[TranslogOp] = []
+    with open(path, "rb") as f:
+        data = f.read()
+    pos = 0
+    good_end = 0
+    while pos + _HEADER.size <= len(data):
+        length, crc = _HEADER.unpack_from(data, pos)
+        start = pos + _HEADER.size
+        end = start + length
+        if end > len(data):
+            break  # torn tail
+        payload = data[start:end]
+        if zlib.crc32(payload) != crc:
+            break  # corrupt tail — stop, keep prefix
+        ops.append(TranslogOp.from_payload(payload))
+        pos = end
+        good_end = end
+    if truncate_torn and good_end < len(data):
+        with open(path, "r+b") as f:
+            f.truncate(good_end)
+    return ops
+
+
+class Translog:
+    """Generational WAL for one shard."""
+
+    def __init__(self, directory: str, durability: str = "request"):
+        self.directory = directory
+        self.durability = durability  # "request" | "async"
+        os.makedirs(directory, exist_ok=True)
+        self._ckp_path = os.path.join(directory, CHECKPOINT_FILE)
+        ckp = self._read_checkpoint()
+        self.current_gen: int = ckp.get("gen", 1)
+        self.min_retained_gen: int = ckp.get("min_gen", self.current_gen)
+        # retained ops per generation (loaded lazily for replay)
+        self._fh = open(_gen_path(directory, self.current_gen), "ab")
+        self._unsynced = 0
+        self._op_count: Optional[int] = None  # lazy cache for stats
+
+    # ------------------------------------------------------------ checkpoint
+
+    def _read_checkpoint(self) -> dict:
+        if os.path.exists(self._ckp_path):
+            with open(self._ckp_path, "r", encoding="utf-8") as f:
+                return json.load(f)
+        return {}
+
+    def _write_checkpoint(self):
+        tmp = self._ckp_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"gen": self.current_gen,
+                       "min_gen": self.min_retained_gen}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._ckp_path)
+
+    # ------------------------------------------------------------ write path
+
+    def add(self, op: TranslogOp):
+        """Append one op to the current generation (Translog.java:540)."""
+        payload = op.to_payload()
+        frame = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+        self._fh.write(frame)
+        self._unsynced += 1
+        if self._op_count is not None:
+            self._op_count += 1
+        if self.durability == "request":
+            self.sync()
+
+    def sync(self):
+        if self._unsynced:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._unsynced = 0
+
+    def roll_generation(self) -> int:
+        """Seal the current generation and start a new one (flush path)."""
+        self.sync()
+        self._fh.close()
+        self.current_gen += 1
+        self._fh = open(_gen_path(self.directory, self.current_gen), "ab")
+        self._write_checkpoint()
+        return self.current_gen
+
+    def trim_unreferenced(self, keep_from_gen: int):
+        """Delete generations below `keep_from_gen` whose ops are committed."""
+        for gen in range(self.min_retained_gen, keep_from_gen):
+            path = _gen_path(self.directory, gen)
+            if os.path.exists(path):
+                os.remove(path)
+        self.min_retained_gen = max(self.min_retained_gen, keep_from_gen)
+        self._op_count = None
+        self._write_checkpoint()
+
+    def trim_below_seqno(self, min_retained_seq_no: int, max_gen: int):
+        """Drop whole generations whose every op is below the retention floor
+        (retention leases / global checkpoint), never past `max_gen`."""
+        keep_from = self.min_retained_gen
+        for gen in range(self.min_retained_gen, max_gen):
+            path = _gen_path(self.directory, gen)
+            if os.path.exists(path):
+                ops = _read_gen_file(path, truncate_torn=False)
+                if any(op.seq_no >= min_retained_seq_no for op in ops):
+                    break
+            keep_from = gen + 1
+        self.trim_unreferenced(keep_from)
+
+    # ------------------------------------------------------------- read path
+
+    def read_ops(self, from_seq_no: int = 0) -> List[TranslogOp]:
+        """All retained ops with seq_no >= from_seq_no, generation order.
+
+        Used for (a) engine reopen replay, (b) peer-recovery phase2 op
+        shipping (RecoverySourceHandler phase2 analog).
+        """
+        self.sync()
+        out: List[TranslogOp] = []
+        for gen in range(self.min_retained_gen, self.current_gen + 1):
+            path = _gen_path(self.directory, gen)
+            if not os.path.exists(path):
+                continue
+            for op in _read_gen_file(path, truncate_torn=(gen == self.current_gen)):
+                if op.seq_no >= from_seq_no:
+                    out.append(op)
+        return out
+
+    def total_operations(self) -> int:
+        if self._op_count is None:
+            self._op_count = len(self.read_ops())
+        return self._op_count
+
+    def close(self):
+        self.sync()
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,91 @@
+"""Coordinator-side search reduce: the SearchPhaseController analog.
+
+Reference (SURVEY.md §3.2 coordinator half): TransportSearchAction fans the
+query phase out to one copy of every shard, QueryPhaseResultConsumer
+incrementally reduces (mergeTopDocs SearchPhaseController.java:228 +
+InternalAggregations.topLevelReduce :453), then the fetch phase loads _source
+only for the global top hits. Here each shard executes its jitted query phase
+(device work across shards overlaps because jax dispatch is async), and the
+host merges candidates with the reference's exact tie-break
+(sort keys, then shard/segment/doc order) and reduces agg partials once.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from opensearch_tpu.common.errors import IllegalArgumentError
+from opensearch_tpu.search.aggs.parse import PIPELINE_TYPES, parse_aggs
+from opensearch_tpu.search.aggs.pipeline import apply_pipelines
+from opensearch_tpu.search.aggs.reduce import reduce_aggs
+from opensearch_tpu.search.executor import (
+    _compare_candidates, _parse_sort)
+
+
+def execute_search(executors: List, body: Optional[dict],
+                   total_shards: Optional[int] = None,
+                   failed_shards: int = 0) -> dict:
+    """Run the full query-then-fetch flow over shard executors and render
+    the search response. `executors` are per-shard SearchExecutors."""
+    body = body or {}
+    start = time.monotonic()
+    size = int(body.get("size", 10))
+    from_ = int(body.get("from", 0))
+    if size < 0 or from_ < 0:
+        raise IllegalArgumentError("[from] and [size] must be non-negative")
+
+    sort_specs = _parse_sort(body.get("sort"))
+    score_sorted = sort_specs[0][0] == "_score"
+    wants_score = score_sorted or any(f == "_score" for f, _ in sort_specs) \
+        or bool(body.get("track_scores", False))
+    agg_nodes = parse_aggs(body.get("aggs") or body.get("aggregations"))
+
+    k = max(from_ + size, 10)
+    candidates = []
+    decoded_partials = []
+    total = 0
+    for shard_i, ex in enumerate(executors):
+        cands, decoded, shard_total = ex.execute_query_phase(body, k)
+        for c in cands:
+            c.shard_i = shard_i
+        candidates.extend(cands)
+        decoded_partials.extend(decoded)
+        total += shard_total
+
+    candidates.sort(key=_compare_candidates(sort_specs))
+    page = candidates[from_:from_ + size]
+
+    max_score = None
+    if wants_score:
+        for c in candidates:
+            if max_score is None or c.score > max_score:
+                max_score = c.score
+
+    hits = []
+    for c in page:
+        ex = executors[c.shard_i]
+        hit = ex._hit_dict(c.seg_i, c.ord,
+                           c.score if wants_score else None, body)
+        if not score_sorted:
+            hit["sort"] = c.sort_values
+        hits.append(hit)
+
+    n_shards = total_shards if total_shards is not None else len(executors)
+    resp = {
+        "took": int((time.monotonic() - start) * 1000),
+        "timed_out": False,
+        "_shards": {"total": n_shards,
+                    "successful": n_shards - failed_shards,
+                    "skipped": 0, "failed": failed_shards},
+        "hits": {
+            "total": {"value": total, "relation": "eq"},
+            "max_score": max_score,
+            "hits": hits,
+        },
+    }
+    if agg_nodes:
+        aggregations = reduce_aggs(decoded_partials)
+        apply_pipelines(agg_nodes, aggregations)
+        resp["aggregations"] = aggregations
+    return resp

@@ -223,17 +223,20 @@ def _build_sort_key(arrays, primary_sort) -> jnp.ndarray:
 
 
 class _Candidate:
-    __slots__ = ("score", "seg_i", "ord", "sort_values")
+    __slots__ = ("score", "seg_i", "ord", "sort_values", "shard_i")
 
-    def __init__(self, score, seg_i, ord_, sort_values):
+    def __init__(self, score, seg_i, ord_, sort_values, shard_i=0):
         self.score = score
         self.seg_i = seg_i
         self.ord = ord_
         self.sort_values = sort_values  # list parallel to sort specs; None = missing
+        self.shard_i = shard_i          # coordinator-side shard index
 
 
 def _compare_candidates(specs):
-    """Multi-key comparator with missing-last semantics (reference default)."""
+    """Multi-key comparator with missing-last semantics (reference default).
+    Final tie-break (shard, segment, doc) asc — mergeTopDocs order
+    (action/search/SearchPhaseController.java:228)."""
     def cmp(a: _Candidate, b: _Candidate) -> int:
         for i, (field, order) in enumerate(specs):
             va, vb = a.sort_values[i], b.sort_values[i]
@@ -248,6 +251,8 @@ def _compare_candidates(specs):
                 if order == "desc":
                     lt = not lt
                 return -1 if lt else 1
+        if a.shard_i != b.shard_i:
+            return -1 if a.shard_i < b.shard_i else 1
         if a.seg_i != b.seg_i:
             return -1 if a.seg_i < b.seg_i else 1
         return -1 if a.ord < b.ord else 1
@@ -261,12 +266,14 @@ class SearchExecutor:
         self.reader = reader
 
     def search(self, body: Optional[dict] = None) -> dict:
+        from opensearch_tpu.search.controller import execute_search
+        return execute_search([self], body)
+
+    def execute_query_phase(self, body: dict, k: int):
+        """Per-shard query phase (SearchService.executeQueryPhase analog):
+        returns (candidates, per-segment decoded agg partials, total hits)
+        for the coordinator to merge. `k` = from+size requested globally."""
         body = body or {}
-        start = time.monotonic()
-        size = int(body.get("size", 10))
-        from_ = int(body.get("from", 0))
-        if size < 0 or from_ < 0:
-            raise IllegalArgumentError("[from] and [size] must be non-negative")
         node = dsl.parse_query(body.get("query"))
         min_score = float(body["min_score"]) if body.get("min_score") is not None \
             else NEG_INF
@@ -274,8 +281,6 @@ class SearchExecutor:
         sort_specs = _parse_sort(body.get("sort"))
         score_sorted = sort_specs[0][0] == "_score"
         primary = None if score_sorted else sort_specs[0]
-        wants_score = score_sorted or any(f == "_score" for f, _ in sort_specs) \
-            or bool(body.get("track_scores", False))
 
         stats = self.reader.stats()
         compiler = Compiler(self.reader.mapper, stats)
@@ -283,7 +288,6 @@ class SearchExecutor:
         from opensearch_tpu.search.aggs.parse import PIPELINE_TYPES
         device_agg_nodes = [n for n in agg_nodes
                             if n.type not in PIPELINE_TYPES]
-        k = max(from_ + size, 10)
         k_fetch = min(k + 128, 1 << 16)  # over-fetch for ties & cross-seg merge
 
         candidates: List[_Candidate] = []
@@ -323,40 +327,7 @@ class SearchExecutor:
                 candidates.append(_Candidate(float(score), seg_i, int(ord_),
                                              sort_values))
 
-        candidates.sort(key=_compare_candidates(sort_specs))
-        page = candidates[from_:from_ + size]
-
-        max_score = None
-        if score_sorted or wants_score:
-            for c in candidates:
-                if max_score is None or c.score > max_score:
-                    max_score = c.score
-
-        hits = []
-        for c in page:
-            hit = self._hit_dict(c.seg_i, c.ord,
-                                 c.score if wants_score else None, body)
-            if not score_sorted:
-                hit["sort"] = c.sort_values
-            hits.append(hit)
-
-        took_ms = int((time.monotonic() - start) * 1000)
-        resp = {
-            "took": took_ms,
-            "timed_out": False,
-            "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
-            "hits": {
-                "total": {"value": total, "relation": "eq"},
-                "max_score": max_score,
-                "hits": hits,
-            },
-        }
-        if agg_nodes:
-            from opensearch_tpu.search.aggs.pipeline import apply_pipelines
-            aggregations = reduce_aggs(per_segment_decoded)
-            apply_pipelines(agg_nodes, aggregations)
-            resp["aggregations"] = aggregations
-        return resp
+        return candidates, per_segment_decoded, total
 
     def _hit_dict(self, seg_i: int, ord_: int, score: Optional[float],
                   body: dict) -> dict:

@@ -1,0 +1,321 @@
+"""Write-path tests: engine versioning, translog durability, recovery, merges.
+
+Models the reference's engine test strategy (InternalEngineTests,
+TranslogTests in server/src/test — seeded randomized op sequences, crash and
+reopen, checkpoint invariants)."""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.engine import InternalEngine, VersionConflictError
+from opensearch_tpu.index.mapper import MapperService
+from opensearch_tpu.index.seqno import (
+    LocalCheckpointTracker, ReplicationTracker)
+from opensearch_tpu.index.translog import Translog, TranslogOp
+
+MAPPING = {"properties": {
+    "title": {"type": "text"},
+    "views": {"type": "integer"},
+    "tag": {"type": "keyword"},
+}}
+
+
+def make_engine(tmp_path=None, **kw):
+    return InternalEngine(MapperService(MAPPING),
+                          data_path=str(tmp_path) if tmp_path else None, **kw)
+
+
+# ------------------------------------------------------------------ seqno ---
+
+class TestLocalCheckpointTracker:
+    def test_contiguous(self):
+        t = LocalCheckpointTracker()
+        for i in range(5):
+            assert t.generate_seq_no() == i
+            t.mark_processed(i)
+        assert t.checkpoint == 4
+
+    def test_out_of_order(self):
+        t = LocalCheckpointTracker()
+        for _ in range(4):
+            t.generate_seq_no()
+        t.mark_processed(2)
+        t.mark_processed(3)
+        assert t.checkpoint == -1
+        t.mark_processed(0)
+        assert t.checkpoint == 0
+        t.mark_processed(1)
+        assert t.checkpoint == 3
+
+
+class TestReplicationTracker:
+    def test_global_checkpoint_is_min_in_sync(self):
+        rt = ReplicationTracker("primary")
+        rt.update_local_checkpoint("primary", 10)
+        assert rt.global_checkpoint == 10
+        rt.init_tracking("replica1")
+        # tracked-but-not-in-sync copies don't hold back the checkpoint
+        rt.update_local_checkpoint("primary", 12)
+        assert rt.global_checkpoint == 12
+        rt.mark_in_sync("replica1", 5)
+        rt.update_local_checkpoint("primary", 20)
+        assert rt.global_checkpoint == 12  # min(20, 5) but monotone: stays 12
+        rt.update_local_checkpoint("replica1", 18)
+        assert rt.global_checkpoint == 18
+
+    def test_leases(self):
+        rt = ReplicationTracker("primary")
+        rt.update_local_checkpoint("primary", 50)
+        rt.add_lease("peer1", 30, "recovery")
+        assert rt.min_retained_seq_no() == 30
+        rt.remove_lease("peer1")
+        assert rt.min_retained_seq_no() == 51
+
+
+# --------------------------------------------------------------- translog ---
+
+class TestTranslog:
+    def test_roundtrip_and_replay(self, tmp_path):
+        with Translog(str(tmp_path)) as tl:
+            for i in range(10):
+                tl.add(TranslogOp("index", i, 1, doc_id=f"d{i}",
+                                  source={"n": i}))
+        tl2 = Translog(str(tmp_path))
+        ops = tl2.read_ops()
+        assert [o.seq_no for o in ops] == list(range(10))
+        assert ops[3].source == {"n": 3}
+        assert tl2.read_ops(from_seq_no=7)[0].seq_no == 7
+        tl2.close()
+
+    def test_torn_tail_truncated(self, tmp_path):
+        tl = Translog(str(tmp_path))
+        tl.add(TranslogOp("index", 0, 1, doc_id="a", source={}))
+        tl.add(TranslogOp("index", 1, 1, doc_id="b", source={}))
+        tl.close()
+        # corrupt: append garbage partial frame
+        import os
+        path = os.path.join(str(tmp_path), "translog-1.tlog")
+        with open(path, "ab") as f:
+            f.write(b"\xff\x01garbage")
+        tl2 = Translog(str(tmp_path))
+        assert len(tl2.read_ops()) == 2
+        tl2.close()
+
+    def test_generations_roll_and_trim(self, tmp_path):
+        tl = Translog(str(tmp_path))
+        tl.add(TranslogOp("index", 0, 1, doc_id="a", source={}))
+        gen2 = tl.roll_generation()
+        tl.add(TranslogOp("index", 1, 1, doc_id="b", source={}))
+        assert len(tl.read_ops()) == 2
+        tl.trim_unreferenced(gen2)
+        ops = tl.read_ops()
+        assert [o.seq_no for o in ops] == [1]
+        tl.close()
+
+
+# ----------------------------------------------------------------- engine ---
+
+class TestEngineBasics:
+    def test_index_get_delete(self):
+        e = make_engine()
+        r = e.index("d1", {"title": "hello world", "views": 3})
+        assert (r.version, r.seq_no, r.created) == (1, 0, True)
+        g = e.get("d1")
+        assert g.source["views"] == 3
+        r2 = e.index("d1", {"title": "hello again", "views": 4})
+        assert (r2.version, r2.created) == (2, False)
+        assert e.get("d1").source["views"] == 4  # realtime, pre-refresh
+        d = e.delete("d1")
+        assert d.version == 3 and d.found
+        assert e.get("d1") is None
+        assert e.local_checkpoint == 2
+
+    def test_create_conflict(self):
+        e = make_engine()
+        e.index("d1", {"title": "x"}, op_type="create")
+        with pytest.raises(VersionConflictError):
+            e.index("d1", {"title": "y"}, op_type="create")
+        # delete frees the id for create
+        e.delete("d1")
+        r = e.index("d1", {"title": "z"}, op_type="create")
+        assert r.version == 3
+
+    def test_cas_if_seq_no(self):
+        e = make_engine()
+        r = e.index("d1", {"title": "v1"})
+        with pytest.raises(VersionConflictError):
+            e.index("d1", {"title": "bad"}, if_seq_no=99, if_primary_term=1)
+        ok = e.index("d1", {"title": "v2"}, if_seq_no=r.seq_no,
+                     if_primary_term=r.primary_term)
+        assert ok.version == 2
+        with pytest.raises(VersionConflictError):
+            e.delete("d1", if_seq_no=r.seq_no, if_primary_term=1)
+
+    def test_external_versioning(self):
+        e = make_engine()
+        e.index("d1", {"title": "a"}, version=5)
+        with pytest.raises(VersionConflictError):
+            e.index("d1", {"title": "b"}, version=5)
+        r = e.index("d1", {"title": "c"}, version=9)
+        assert r.version == 9
+
+    def test_refresh_visibility_and_supersession(self):
+        e = make_engine()
+        e.index("d1", {"title": "one"})
+        e.index("d2", {"title": "two"})
+        e.index("d1", {"title": "one-v2"})   # supersedes in same buffer
+        seg = e.refresh()
+        assert seg.num_docs == 3
+        assert seg.live_doc_count == 2      # old d1 ord is dead
+        # update after refresh: old sealed copy deleted at next refresh
+        e.index("d2", {"title": "two-v2"})
+        assert seg.live[1]                  # not yet visible
+        seg2 = e.refresh()
+        assert not seg.live[seg.doc_ids.index("d2")]
+        assert seg2.live_doc_count == 1
+
+    def test_delete_in_buffer_then_refresh(self):
+        e = make_engine()
+        e.index("d1", {"title": "x"})
+        e.delete("d1")
+        seg = e.refresh()
+        assert seg is not None and seg.live_doc_count == 0
+
+    def test_replica_out_of_order_ignored(self):
+        e = make_engine()
+        e.index_on_replica("d1", {"title": "new"}, seq_no=5, primary_term=1,
+                           version=2)
+        # stale op for same doc arrives late
+        e.index_on_replica("d1", {"title": "old"}, seq_no=3, primary_term=1,
+                           version=1)
+        assert e.get("d1").source["title"] == "new"
+        assert e.max_seq_no == 5
+
+
+class TestEnginePersistence:
+    def test_translog_replay_after_crash(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("d1", {"title": "one", "views": 1})
+        e.index("d2", {"title": "two", "views": 2})
+        e.delete("d1")
+        e.close()   # no flush — simulate crash; translog has everything
+        e2 = make_engine(tmp_path)
+        assert e2.get("d1") is None
+        assert e2.get("d2").source["views"] == 2
+        assert e2.max_seq_no == 2
+        assert e2.local_checkpoint == 2
+        e2.close()
+
+    def test_flush_commit_and_reopen(self, tmp_path):
+        e = make_engine(tmp_path)
+        for i in range(20):
+            e.index(f"d{i}", {"title": f"doc {i}", "views": i})
+        e.flush()
+        e.index("d20", {"title": "post-flush", "views": 20})
+        e.close()
+        e2 = make_engine(tmp_path)
+        assert len(e2.segments) == 1            # from commit point
+        assert e2.get("d5").source["views"] == 5
+        assert e2.get("d20").source["views"] == 20   # replayed from translog
+        st = e2.stats()
+        assert st["docs"]["count"] == 21
+        e2.close()
+
+    def test_flush_trims_translog(self, tmp_path):
+        e = make_engine(tmp_path)
+        for i in range(5):
+            e.index(f"d{i}", {"views": i})
+        e.flush()
+        assert e.translog.total_operations() == 0
+        e.index("d5", {"views": 5})
+        assert e.translog.total_operations() == 1
+        e.close()
+
+    def test_deletes_survive_reopen(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("d1", {"views": 1})
+        e.index("d2", {"views": 2})
+        e.flush()
+        e.delete("d1")
+        e.flush()   # live mask persisted
+        e.close()
+        e2 = make_engine(tmp_path)
+        assert e2.get("d1") is None
+        assert e2.get("d2") is not None
+        e2.close()
+
+
+class TestMerge:
+    def test_maybe_merge_reduces_segments(self):
+        e = make_engine(merge_max_segments=3)
+        for i in range(12):
+            e.index(f"d{i}", {"title": f"t {i}", "views": i})
+            if i % 2:
+                e.refresh()
+        e.refresh()
+        assert len(e.segments) > 3
+        e.maybe_merge()
+        assert len(e.segments) <= 4
+        for i in range(12):
+            assert e.get(f"d{i}", realtime=False) is not None
+
+    def test_merge_drops_deleted_docs(self):
+        e = make_engine(merge_max_segments=1)
+        e.index("d1", {"views": 1})
+        e.refresh()
+        e.index("d2", {"views": 2})
+        e.delete("d1")
+        e.refresh()
+        merged = e.maybe_merge()
+        assert merged is not None
+        assert sum(s.live_doc_count for s in e.segments) == 1
+        assert e.get("d1", realtime=False) is None
+        assert e.get("d2", realtime=False) is not None
+
+
+class TestReviewRegressions:
+    """Pins for bugs found in review: seqno reissue, CAS-after-reopen, leases."""
+
+    def test_max_seq_no_restored_with_gap(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index_on_replica("a", {"views": 0}, seq_no=0, primary_term=1, version=1)
+        e.index_on_replica("b", {"views": 1}, seq_no=1, primary_term=1, version=1)
+        e.index_on_replica("c", {"views": 3}, seq_no=3, primary_term=1, version=1)
+        assert e.local_checkpoint == 1 and e.max_seq_no == 3
+        e.flush()
+        e.close()
+        e2 = make_engine(tmp_path)
+        assert e2.max_seq_no >= 3
+        r = e2.index("d", {"views": 4})
+        assert r.seq_no > 3  # must not collide with committed op 3
+        e2.close()
+
+    def test_cas_and_version_survive_reopen(self, tmp_path):
+        e = make_engine(tmp_path)
+        e.index("d1", {"views": 1})
+        r = e.index("d1", {"views": 2})
+        e.flush()
+        e.close()
+        e2 = make_engine(tmp_path)
+        g = e2.get("d1", realtime=False)
+        assert (g.version, g.seq_no) == (2, r.seq_no)
+        with pytest.raises(VersionConflictError):
+            e2.index("d1", {"views": 9}, if_seq_no=0, if_primary_term=1)
+        ok = e2.index("d1", {"views": 3}, if_seq_no=r.seq_no,
+                      if_primary_term=r.primary_term)
+        assert ok.version == 3
+        e2.close()
+
+    def test_retention_lease_pins_translog(self, tmp_path):
+        e = make_engine(tmp_path)
+        for i in range(6):
+            e.index(f"d{i}", {"views": i})
+        e.replication_tracker.add_lease("peer1", retaining_seq_no=2,
+                                        source="recovery")
+        e.flush()
+        ops = e.translog.read_ops(from_seq_no=2)
+        assert [o.seq_no for o in ops] == [2, 3, 4, 5]
+        e.replication_tracker.remove_lease("peer1")
+        e.flush()
+        assert e.translog.total_operations() == 0
+        e.close()

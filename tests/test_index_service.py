@@ -1,0 +1,193 @@
+"""IndexService tests: routing parity, multi-shard CRUD/search, bulk, update."""
+
+import pytest
+
+from opensearch_tpu.cluster.routing import (
+    generate_shard_id, hash_routing, murmurhash3_x86_32)
+from opensearch_tpu.common.errors import DocumentMissingError
+from opensearch_tpu.index.service import IndexService
+
+MAPPING = {"properties": {
+    "title": {"type": "text"},
+    "views": {"type": "integer"},
+    "tag": {"type": "keyword"},
+}}
+
+
+# ---------------------------------------------------------------- routing ---
+
+class TestMurmur3:
+    def test_public_vector(self):
+        # public murmur3_x86_32 test vector: "hello" (utf-8) seed 0
+        assert murmurhash3_x86_32(b"hello") == 0x248BFA47
+
+    def test_reference_test_vectors(self):
+        # pinned by the reference's Murmur3HashFunctionTests.java:41-47
+        # (UTF-16LE code units, seed 0, signed int result)
+        def as_signed(x):
+            return x - (1 << 32) if x >= (1 << 31) else x
+        assert hash_routing("hell") == as_signed(0x5A0CB7C3)
+        assert hash_routing("hello") == as_signed(0xD7C31989)
+        assert hash_routing("hello w") == as_signed(0x22AB2984)
+        assert hash_routing("hello wo") == as_signed(0xDF0CA123)
+        assert hash_routing("hello wor") == as_signed(0xE7744D61)
+        assert hash_routing(
+            "The quick brown fox jumps over the lazy dog") \
+            == as_signed(0xE07DB09C)
+        assert hash_routing(
+            "The quick brown fox jumps over the lazy cog") \
+            == as_signed(0x4E63D2AD)
+
+    def test_shard_stability(self):
+        for i in range(200):
+            sid = generate_shard_id(f"doc_{i}", 5)
+            assert 0 <= sid < 5
+        # explicit routing overrides id
+        a = generate_shard_id("x", 5, routing="fixed")
+        b = generate_shard_id("y", 5, routing="fixed")
+        assert a == b
+
+    def test_routing_num_shards_scaling(self):
+        # shrunk index: same routing_num_shards keeps doc placement stable
+        # across factor-of-2 shard counts (docs in shard s of the 4-shard
+        # index land in shard s//2 of the 2-shard index)
+        for i in range(100):
+            s4 = generate_shard_id(f"d{i}", 4, routing_num_shards=8)
+            s2 = generate_shard_id(f"d{i}", 2, routing_num_shards=8)
+            assert s2 == s4 // 2
+
+
+# ------------------------------------------------------------ the service ---
+
+@pytest.fixture()
+def svc():
+    s = IndexService("test-idx", mapping=MAPPING,
+                     settings={"number_of_shards": 3})
+    yield s
+    s.close()
+
+
+class TestIndexServiceCrud:
+    def test_crud_across_shards(self, svc):
+        for i in range(30):
+            r = svc.index_doc(f"d{i}", {"title": f"doc number {i}",
+                                        "views": i, "tag": f"t{i % 3}"})
+            assert r["result"] == "created" and r["_version"] == 1
+        used_shards = {svc.shard_for(f"d{i}").shard_id for i in range(30)}
+        assert len(used_shards) > 1        # docs actually spread
+        g = svc.get_doc("d7")
+        assert g["found"] and g["_source"]["views"] == 7
+        d = svc.delete_doc("d7")
+        assert d["result"] == "deleted"
+        assert not svc.get_doc("d7")["found"]
+
+    def test_auto_id(self, svc):
+        r = svc.index_doc(None, {"title": "anon"})
+        assert r["result"] == "created" and len(r["_id"]) >= 16
+        assert svc.get_doc(r["_id"])["found"]
+
+    def test_update_merge_noop_upsert(self, svc):
+        svc.index_doc("u1", {"title": "t", "views": 1})
+        r = svc.update_doc("u1", {"doc": {"views": 2}})
+        assert r["result"] == "updated"
+        assert svc.get_doc("u1")["_source"] == {"title": "t", "views": 2}
+        r2 = svc.update_doc("u1", {"doc": {"views": 2}})
+        assert r2["result"] == "noop"
+        with pytest.raises(DocumentMissingError):
+            svc.update_doc("nope", {"doc": {"views": 1}})
+        r3 = svc.update_doc("nope", {"doc": {"views": 1},
+                                     "doc_as_upsert": True})
+        assert r3["result"] == "created"
+        r4 = svc.update_doc("nope2", {"doc": {"views": 9},
+                                      "upsert": {"title": "fresh"}})
+        assert svc.get_doc("nope2")["_source"] == {"title": "fresh"}
+        assert r4["result"] == "created"
+
+    def test_mget(self, svc):
+        svc.index_doc("a", {"views": 1})
+        svc.index_doc("b", {"views": 2})
+        out = svc.mget(["a", "b", "missing"])
+        assert [d["found"] for d in out["docs"]] == [True, True, False]
+
+
+class TestBulk:
+    def test_bulk_mixed(self, svc):
+        resp = svc.bulk([
+            {"action": "index", "id": "b1", "source": {"views": 1}},
+            {"action": "create", "id": "b2", "source": {"views": 2}},
+            {"action": "create", "id": "b2", "source": {"views": 3}},  # dup
+            {"action": "update", "id": "b1",
+             "source": {"doc": {"views": 10}}},
+            {"action": "delete", "id": "b2"},
+        ])
+        assert resp["errors"] is True
+        stats = [list(i.values())[0]["status"] for i in resp["items"]]
+        assert stats == [201, 201, 409, 200, 200]
+        assert svc.get_doc("b1")["_source"]["views"] == 10
+        assert not svc.get_doc("b2")["found"]
+
+
+class TestMultiShardSearch:
+    def test_search_after_refresh(self, svc):
+        for i in range(40):
+            svc.index_doc(f"d{i}", {"title": "common term" if i % 2
+                                    else "other text",
+                                    "views": i, "tag": f"t{i % 4}"})
+        svc.refresh()
+        resp = svc.search({"query": {"match": {"title": "common"}},
+                           "size": 50})
+        assert resp["hits"]["total"]["value"] == 20
+        assert resp["_shards"]["total"] == 3
+        # sort across shards by numeric field
+        resp = svc.search({"query": {"match_all": {}},
+                           "sort": [{"views": {"order": "desc"}}],
+                           "size": 5})
+        assert [h["sort"][0] for h in resp["hits"]["hits"]] == \
+            [39, 38, 37, 36, 35]
+
+    def test_aggs_reduce_across_shards(self, svc):
+        for i in range(60):
+            svc.index_doc(f"d{i}", {"views": i, "tag": f"t{i % 3}"})
+        svc.refresh()
+        resp = svc.search({"size": 0, "aggs": {
+            "tags": {"terms": {"field": "tag"}},
+            "v": {"avg": {"field": "views"}},
+        }})
+        buckets = resp["aggregations"]["tags"]["buckets"]
+        assert sorted(b["key"] for b in buckets) == ["t0", "t1", "t2"]
+        assert all(b["doc_count"] == 20 for b in buckets)
+        assert abs(resp["aggregations"]["v"]["value"] - 29.5) < 1e-6
+
+    def test_count_and_update_visibility(self, svc):
+        for i in range(10):
+            svc.index_doc(f"d{i}", {"tag": "old"})
+        svc.refresh()
+        assert svc.count({"query": {"term": {"tag": "old"}}}) == 10
+        for i in range(5):
+            svc.index_doc(f"d{i}", {"tag": "new"})
+        # pre-refresh: updates not yet searchable
+        assert svc.count({"query": {"term": {"tag": "old"}}}) == 10
+        svc.refresh()
+        assert svc.count({"query": {"term": {"tag": "old"}}}) == 5
+        assert svc.count({"query": {"term": {"tag": "new"}}}) == 5
+
+
+class TestServicePersistence:
+    def test_reopen_from_disk(self, tmp_path):
+        svc = IndexService("persist-idx", mapping=MAPPING,
+                           settings={"number_of_shards": 2},
+                           data_path=str(tmp_path))
+        for i in range(20):
+            svc.index_doc(f"d{i}", {"title": f"doc {i}", "views": i})
+        svc.flush()
+        for i in range(20, 25):
+            svc.index_doc(f"d{i}", {"title": f"doc {i}", "views": i})
+        svc.close()   # crash: last 5 docs only in translog
+        svc2 = IndexService("persist-idx", mapping=MAPPING,
+                            settings={"number_of_shards": 2},
+                            data_path=str(tmp_path))
+        for i in range(25):
+            assert svc2.get_doc(f"d{i}")["found"], f"d{i} lost"
+        svc2.refresh()
+        assert svc2.count({"query": {"match_all": {}}}) == 25
+        svc2.close()
