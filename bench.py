@@ -79,10 +79,91 @@ def numpy_baseline(seg, queries, k1=1.2, b=0.75):
     return len(queries) / dt
 
 
+def bench_knn(mode: str):
+    """BASELINE configs 4/5: exact (SIFT-shaped 128-d L2) and IVF ANN
+    (GloVe-shaped cosine) k-NN QPS, with recall@10 vs host brute force."""
+    import jax
+    import numpy as np
+
+    from opensearch_tpu.index.mapper import MapperService
+    from opensearch_tpu.index.segment import SegmentBuilder
+    from opensearch_tpu.search.executor import SearchExecutor, ShardReader
+
+    platform = jax.devices()[0].platform
+    n = int(os.environ.get("BENCH_KNN_DOCS", "100000"))
+    dims = int(os.environ.get("BENCH_KNN_DIMS", "128"))
+    n_q = int(os.environ.get("BENCH_KNN_QUERIES", "128"))
+    space = "l2" if mode == "knn_exact" else "cosinesimil"
+    method = ({"space_type": space} if mode == "knn_exact" else
+              {"name": "ivf", "space_type": space,
+               "parameters": {"nlist": 256, "nprobes": 32}})
+    mapper = MapperService({"properties": {"vec": {
+        "type": "knn_vector", "dimension": dims, "method": method}}})
+    rng = np.random.RandomState(11)
+    # clustered corpus (SIFT/GloVe-like local structure)
+    centers = rng.randn(256, dims).astype(np.float32) * 4
+    assign = rng.randint(0, 256, size=n)
+    vectors = centers[assign] + rng.randn(n, dims).astype(np.float32)
+    builder = SegmentBuilder(mapper, "knn0")
+    for i in range(n):
+        builder.add(mapper.parse_document(
+            f"d{i}", {"vec": vectors[i].tolist()}))
+    reader = ShardReader(mapper, [builder.seal()])
+    ex = SearchExecutor(reader)
+
+    queries = (centers[rng.randint(0, 256, size=n_q)]
+               + rng.randn(n_q, dims).astype(np.float32))
+    bodies = [{"query": {"knn": {"vec": {"vector": q.tolist(), "k": 10}}},
+               "size": 10} for q in queries]
+    # exact: batched _msearch turns per-query matvecs into one
+    # [D,dims]×[dims,Q] MXU matmul. IVF: per-query dispatch — vmapping the
+    # probe gather materializes a [Q, nprobe·list_len, dims] intermediate
+    # that defeats the point of probing (measured slower).
+    batched = os.environ.get(
+        "BENCH_KNN_BATCH", "1" if mode == "knn_exact" else "0") == "1"
+    if batched:
+        ex.multi_search(bodies)  # compile warm-up
+        t0 = time.perf_counter()
+        results = ex.multi_search(bodies)["responses"]
+    else:
+        for b in bodies[:2]:
+            ex.search(b)
+        t0 = time.perf_counter()
+        results = [ex.search(b) for b in bodies]
+    qps = n_q / (time.perf_counter() - t0)
+
+    # recall + CPU baseline (numpy brute force, the Lucene-CPU stand-in)
+    t0 = time.perf_counter()
+    recalls = []
+    for q, r in zip(queries, results):
+        if space == "l2":
+            ref = -((vectors - q) ** 2).sum(axis=1)
+        else:
+            ref = (vectors @ q) / (np.linalg.norm(vectors, axis=1)
+                                   * np.linalg.norm(q) + 1e-30)
+        want = set(np.argpartition(-ref, 10)[:10].tolist())
+        got = {int(h["_id"][1:]) for h in r["hits"]["hits"]}
+        recalls.append(len(got & want) / 10)
+    base_qps = n_q / (time.perf_counter() - t0)
+
+    print(json.dumps({
+        "metric": f"{mode}_qps_{n // 1000}k_{dims}d_{platform}",
+        "value": round(qps, 2),
+        "unit": "queries/s",
+        "vs_baseline": round(qps / base_qps, 3),
+        "recall_at_10": round(float(np.mean(recalls)), 4),
+    }))
+
+
 def main():
     import jax
 
     from opensearch_tpu.utils.demo import query_terms
+
+    mode = os.environ.get("BENCH_MODE", "bm25")
+    if mode in ("knn_exact", "knn_ivf"):
+        bench_knn(mode)
+        return
 
     platform = jax.devices()[0].platform
     executor, seg = build_index()

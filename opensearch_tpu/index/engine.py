@@ -87,6 +87,7 @@ class InternalEngine:
                                                       primary_term)
         # sealed-segment deletes buffered until refresh (Lucene buffered deletes)
         self._pending_seal_deletes: List[str] = []
+        self._dirty_live: Set[str] = set()  # segs whose live mask changed
         self._refresh_listeners: List = []
         self.store: Optional[Store] = None
         self.translog: Optional[Translog] = None
@@ -324,6 +325,7 @@ class InternalEngine:
                             hit = True
                     if hit:
                         deleted_from.append(seg)
+                        self._dirty_live.add(seg.seg_id)
                 self._pending_seal_deletes = []
             new_seg: Optional[Segment] = None
             if len(self.builder):
@@ -358,8 +360,9 @@ class InternalEngine:
                 if seg.seg_id not in self._persisted:
                     self.store.write_segment(seg)
                     self._persisted.add(seg.seg_id)
-                else:
+                elif seg.seg_id in self._dirty_live:
                     self.store.write_live_mask(seg)
+            self._dirty_live.clear()
             tl_gen = (self.translog.roll_generation()
                       if self.translog is not None else 0)
             self.store.write_commit(

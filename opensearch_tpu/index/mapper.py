@@ -118,6 +118,9 @@ class MappedFieldType:
     scaling_factor: float = 100.0        # scaled_float
     dims: int = 0                        # vectors
     similarity_space: str = "l2"         # vectors: l2 | cosinesimil | innerproduct
+    knn_method: str = "exact"            # vectors: exact | ivf (HNSW → IVF on TPU)
+    knn_nlist: int = 128                 # ivf: number of centroids
+    knn_nprobe: int = 0                  # ivf: default probes (0 → nlist/8)
     ignore_above: Optional[int] = None   # keyword
     null_value: Any = None
     boost: float = 1.0
@@ -312,7 +315,14 @@ class MapperService:
         if not self.analysis.has(analyzer):
             raise MapperParsingError(
                 f"analyzer [{analyzer}] has not been configured in mappings")
-        space = spec.get("method", {}).get("space_type", spec.get("space_type", "l2"))
+        method_spec = spec.get("method", {}) or {}
+        space = method_spec.get("space_type", spec.get("space_type", "l2"))
+        # HNSW has no TPU-friendly equivalent (pointer-chasing graph walk);
+        # map it to IVF, the dense ANN structure (BASELINE.md config 5)
+        method_name = method_spec.get("name", "exact")
+        if method_name in ("hnsw", "ivf"):
+            method_name = "ivf"
+        method_params = method_spec.get("parameters", {}) or {}
         self.field_types[full_name] = MappedFieldType(
             name=full_name, type=ftype,
             analyzer=analyzer,
@@ -324,6 +334,10 @@ class MapperService:
             scaling_factor=float(spec.get("scaling_factor", 100.0)),
             dims=dims,
             similarity_space=space,
+            knn_method=method_name,
+            knn_nlist=int(method_params.get("nlist", 128)),
+            knn_nprobe=int(method_params.get("nprobes",
+                                             method_params.get("nprobe", 0))),
             ignore_above=spec.get("ignore_above"),
             null_value=spec.get("null_value"),
             boost=float(spec.get("boost", 1.0)),

@@ -137,6 +137,7 @@ class OrdinalsColumn:
 class VectorColumn:
     vectors: np.ndarray      # float32 [D, dims]
     exists: np.ndarray       # bool [D]
+    ivf: Any = None          # Optional[opensearch_tpu.ops.knn.IVFIndex]
 
 
 class Segment:
@@ -383,7 +384,7 @@ class SegmentBuilder:
                 if dictionary else np.zeros(0, dtype=np.uint64)
             ordinal_dv[field] = OrdinalsColumn(doc_arr, ords, exists, dictionary, hashes)
 
-        # ---- vectors: dense [D, dims]
+        # ---- vectors: dense [D, dims]; IVF built at seal for ANN mappings
         vector_dv: Dict[str, VectorColumn] = {}
         for field, rows in self._vectors.items():
             ft = self.mapper.get_field(field)
@@ -392,7 +393,12 @@ class SegmentBuilder:
             for ord_, vec in rows.items():
                 mat[ord_] = np.asarray(vec, dtype=np.float32)
                 exists[ord_] = True
-            vector_dv[field] = VectorColumn(mat, exists)
+            col = VectorColumn(mat, exists)
+            if ft.knn_method == "ivf" and int(exists.sum()) >= 256:
+                from opensearch_tpu.ops.knn import build_ivf
+                col.ivf = build_ivf(mat, exists, nlist=ft.knn_nlist,
+                                    nprobe=ft.knn_nprobe)
+            vector_dv[field] = col
 
         return Segment(self.seg_id, n_docs, list(self.doc_ids), list(self.sources),
                        term_dict, post_docs, post_tf, norms, self._field_stats,

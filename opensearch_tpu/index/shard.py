@@ -28,7 +28,10 @@ class IndexShard:
         self.shard_id = shard_id
         self.index_name = index_name
         self.primary = primary
-        shard_path = (os.path.join(data_path, str(shard_id))
+        # node-level data path → per-index per-shard directory (reference
+        # layout: nodes/0/indices/<index-uuid>/<shard>); without index_name
+        # two indices sharing a data path would corrupt each other
+        shard_path = (os.path.join(data_path, index_name, str(shard_id))
                       if data_path is not None else None)
         self.engine = InternalEngine(
             mapper, data_path=shard_path, durability=durability,

@@ -55,6 +55,10 @@ class Store:
     def __init__(self, directory: str):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
+        # file-metadata cache: .npz/.meta.json are immutable once written,
+        # .liv entries are refreshed by write_live_mask — so commits avoid
+        # re-checksumming the whole store (O(delta), not O(store))
+        self._file_cache: Dict[str, dict] = {}
 
     # ---------------------------------------------------------- segment io
 
@@ -81,9 +85,15 @@ class Store:
             arrays[f"odv_ords::{f}"] = col.ords
             arrays[f"odv_exists::{f}"] = col.exists
             arrays[f"odv_hashes::{f}"] = col.ord_hashes
+        ivf_meta = {}
         for f, col in seg.vector_dv.items():
             arrays[f"vec::{f}"] = col.vectors
             arrays[f"vec_exists::{f}"] = col.exists
+            if col.ivf is not None:
+                arrays[f"ivf_c::{f}"] = col.ivf.centroids
+                arrays[f"ivf_l::{f}"] = col.ivf.lists
+                ivf_meta[f] = {"nlist": col.ivf.nlist,
+                               "nprobe": col.ivf.nprobe}
         # ragged positions → flat + offsets per (field, term)
         pos_keys: List[List[str]] = []
         pos_flat: List[np.ndarray] = []
@@ -116,6 +126,7 @@ class Store:
             "pos_keys": pos_keys,
             "pos_counts": pos_counts,
             "doc_meta": {d: list(m) for d, m in seg.doc_meta.items()},
+            "ivf": ivf_meta,
         }
         tmp = meta_path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as fh:
@@ -123,6 +134,8 @@ class Store:
             fh.flush()
             os.fsync(fh.fileno())
         os.replace(tmp, meta_path)
+        for path in (npz_path, meta_path):
+            self._cache_file(path)
         self.write_live_mask(seg)
 
     def write_live_mask(self, seg: Segment):
@@ -130,6 +143,13 @@ class Store:
         np.save(liv_path + ".tmp.npy", seg.live)
         _fsync_path(liv_path + ".tmp.npy")
         os.replace(liv_path + ".tmp.npy", liv_path)
+        self._cache_file(liv_path)
+
+    def _cache_file(self, path: str):
+        name = os.path.basename(path)
+        self._file_cache[name] = {"name": name,
+                                  "length": os.path.getsize(path),
+                                  "checksum": _file_checksum(path)}
 
     def read_segment(self, seg_id: str) -> Segment:
         npz_path, meta_path, liv_path = self._seg_paths(seg_id)
@@ -152,7 +172,13 @@ class Store:
                 dictionary, z[f"odv_hashes::{f}"])
         vec_fields = {k.split("::", 1)[1] for k in z.files if k.startswith("vec::")}
         for f in vec_fields:
-            vector_dv[f] = VectorColumn(z[f"vec::{f}"], z[f"vec_exists::{f}"])
+            col = VectorColumn(z[f"vec::{f}"], z[f"vec_exists::{f}"])
+            if f in meta.get("ivf", {}):
+                from opensearch_tpu.ops.knn import IVFIndex
+                im = meta["ivf"][f]
+                col.ivf = IVFIndex(z[f"ivf_c::{f}"], z[f"ivf_l::{f}"],
+                                   nlist=im["nlist"], nprobe=im["nprobe"])
+            vector_dv[f] = col
         term_dict = {(f, t): TermMeta(df, ttf, sb, nb)
                      for f, t, df, ttf, sb, nb in meta["term_dict"]}
         field_stats = {f: FieldStats(*vals)
@@ -187,13 +213,23 @@ class Store:
     def write_commit(self, generation: int, seg_ids: List[str],
                      local_checkpoint: int, max_seq_no: int,
                      translog_gen: int, extra: Optional[dict] = None):
+        prev_commit = self.read_latest_commit()
+        prev = {f["name"]: f for f in (prev_commit or {}).get("files", [])}
         files: List[dict] = []
         for sid in seg_ids:
             for path in self._seg_paths(sid):
-                if os.path.exists(path):
-                    files.append({"name": os.path.basename(path),
-                                  "length": os.path.getsize(path),
-                                  "checksum": _file_checksum(path)})
+                if not os.path.exists(path):
+                    continue
+                name = os.path.basename(path)
+                entry = self._file_cache.get(name)
+                if entry is None and not name.endswith(".liv.npy"):
+                    # immutable segment file carried over from a previous
+                    # commit (engine reopened): reuse its recorded checksum
+                    entry = prev.get(name)
+                if entry is None:
+                    self._cache_file(path)
+                    entry = self._file_cache[name]
+                files.append(entry)
         commit = {
             "generation": generation, "segments": seg_ids,
             "local_checkpoint": local_checkpoint, "max_seq_no": max_seq_no,

@@ -122,7 +122,11 @@ def upload_segment(seg: Segment, to_device: bool = True):
         vecs[:seg.num_docs] = col.vectors
         exists = np.zeros(d_pad, dtype=bool)
         exists[:seg.num_docs] = col.exists
-        arrays["vector"][fname] = {"vectors": vecs, "exists": exists}
+        entry = {"vectors": vecs, "exists": exists}
+        if col.ivf is not None:
+            entry["ivf_centroids"] = col.ivf.centroids
+            entry["ivf_lists"] = col.ivf.lists
+        arrays["vector"][fname] = entry
 
     if to_device:
         arrays = _tree_to_jnp(arrays)

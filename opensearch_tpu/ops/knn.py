@@ -1,0 +1,176 @@
+"""k-NN kernels: exact brute-force distances and IVF approximate search.
+
+The reference ships dense_vector storage only (modules/mapper-extras
+DenseVectorFieldMapper) with brute-force painless `script_score`; the k-NN
+plugin (opensearch-project/k-NN, out-of-repo — SURVEY.md §2.3 note) adds
+HNSW/IVF via native faiss/nmslib. Here both are TPU-native:
+
+- **Exact**: one [D, dims] × [dims] matmul on the MXU per (segment, query) —
+  with msearch batching it becomes [D, dims] × [dims, Q]. L2 uses the
+  ||x||² - 2x·q + ||q||² expansion so document norms are precomputed once.
+- **IVF**: k-means centroids (built at seal time, Lloyd's on device),
+  inverted lists as a padded [nlist, max_len] int32 matrix. A query scores
+  centroids, takes the top-nprobe lists, gathers their candidates, and
+  scores only those — graph walks (HNSW) are TPU-hostile; IVF reaches the
+  recall targets with dense, statically-shaped compute (BASELINE.md config 5).
+
+Score conventions follow the k-NN plugin's spaces:
+  l2: 1/(1+d²), cosinesimil: (1+cos)/2, innerproduct: ip≥0 → ip+1 else 1/(1-ip).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+SPACES = ("l2", "cosinesimil", "innerproduct")
+
+
+def _check_space(space: str):
+    if space not in SPACES:
+        raise ValueError(f"unknown knn space [{space}]")
+
+
+def raw_similarity(vectors: jnp.ndarray, query: jnp.ndarray,
+                   space: str) -> jnp.ndarray:
+    """Higher-is-closer raw similarity per doc ([D, dims] × [dims] → [D])."""
+    dots = vectors @ query                       # MXU matvec
+    if space == "l2":
+        dn = jnp.sum(vectors * vectors, axis=1)
+        qn = jnp.sum(query * query)
+        return -(dn - 2.0 * dots + qn)           # negative squared distance
+    if space == "cosinesimil":
+        dn = jnp.sqrt(jnp.sum(vectors * vectors, axis=1))
+        qn = jnp.sqrt(jnp.sum(query * query))
+        return dots / jnp.maximum(dn * qn, 1e-30)
+    return dots                                  # innerproduct
+
+
+def space_score(raw: jnp.ndarray, space: str) -> jnp.ndarray:
+    """Raw similarity → k-NN plugin score (rank-monotone per space)."""
+    if space == "l2":
+        return 1.0 / (1.0 + jnp.maximum(-raw, 0.0))
+    if space == "cosinesimil":
+        return (1.0 + jnp.clip(raw, -1.0, 1.0)) / 2.0
+    return jnp.where(raw >= 0, raw + 1.0, 1.0 / (1.0 - raw))
+
+
+def exact_knn_scores(vectors: jnp.ndarray, query: jnp.ndarray,
+                     space: str) -> jnp.ndarray:
+    _check_space(space)
+    return space_score(raw_similarity(vectors, query, space), space)
+
+
+def knn_match_topk(scores: jnp.ndarray, eligible: jnp.ndarray,
+                   k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Restrict a dense score vector to its top-k eligible docs.
+
+    Returns (scores, matches): matches true only for the k best eligible
+    docs (score-desc, doc-asc tie-break via top_k's lowest-index rule)."""
+    d = scores.shape[0]
+    masked = jnp.where(eligible, scores, -jnp.inf)
+    k_eff = min(int(k), int(d))
+    top_vals, top_idx = jax.lax.top_k(masked, k_eff)
+    valid = top_vals > -jnp.inf
+    # invalid slots scatter out of bounds and are dropped — routing them to
+    # index 0 would clobber a real winner at doc ord 0
+    matches = jnp.zeros(d, jnp.bool_).at[
+        jnp.where(valid, top_idx, d)].set(True, mode="drop")
+    matches = matches & eligible
+    return jnp.where(matches, scores, 0.0), matches
+
+
+# ------------------------------------------------------------------- IVF ----
+
+@dataclass
+class IVFIndex:
+    """Host-side IVF structure attached to a VectorColumn at seal time."""
+    centroids: np.ndarray    # [nlist, dims] float32
+    lists: np.ndarray        # [nlist, max_len] int32 doc ords, -1 padded
+    nlist: int
+    nprobe: int              # default probe count from the mapping
+
+
+def _kmeans(vectors: np.ndarray, nlist: int, iters: int = 10,
+            seed: int = 17) -> np.ndarray:
+    """Lloyd's k-means on device (jit per (shape, nlist)); returns centroids."""
+    n = vectors.shape[0]
+    rng = np.random.RandomState(seed)
+    init = vectors[rng.choice(n, size=nlist, replace=False)]
+
+    @jax.jit
+    def step(data, centroids):
+        # assign: [n, nlist] distances via the same matmul expansion
+        dots = data @ centroids.T
+        dn = jnp.sum(data * data, axis=1, keepdims=True)
+        cn = jnp.sum(centroids * centroids, axis=1)
+        assign = jnp.argmin(dn - 2 * dots + cn, axis=1)
+        # update: segment mean
+        one_hot = jax.nn.one_hot(assign, nlist, dtype=jnp.float32)
+        sums = one_hot.T @ data
+        counts = one_hot.sum(axis=0)[:, None]
+        return jnp.where(counts > 0, sums / jnp.maximum(counts, 1), centroids)
+
+    data = jnp.asarray(vectors, dtype=jnp.float32)
+    centroids = jnp.asarray(init, dtype=jnp.float32)
+    for _ in range(iters):
+        centroids = step(data, centroids)
+    return np.asarray(centroids)
+
+
+def build_ivf(vectors: np.ndarray, exists: np.ndarray, nlist: int,
+              nprobe: int = 0, iters: int = 10, seed: int = 17) -> IVFIndex:
+    """Cluster present vectors; inverted lists hold doc ords per centroid."""
+    present = np.nonzero(exists)[0].astype(np.int32)
+    nlist = max(1, min(nlist, len(present)))
+    data = vectors[present].astype(np.float32)
+    centroids = _kmeans(data, nlist, iters=iters, seed=seed)
+    dots = data @ centroids.T
+    dn = (data ** 2).sum(axis=1, keepdims=True)
+    cn = (centroids ** 2).sum(axis=1)
+    assign = np.argmin(dn - 2 * dots + cn, axis=1)
+    max_len = max(int(np.bincount(assign, minlength=nlist).max()), 1)
+    # pad to a lane-friendly width
+    max_len = ((max_len + 127) // 128) * 128
+    lists = np.full((nlist, max_len), -1, dtype=np.int32)
+    for c in range(nlist):
+        members = present[assign == c]
+        lists[c, :len(members)] = members
+    if nprobe <= 0:
+        nprobe = max(1, nlist // 8)
+    return IVFIndex(centroids=centroids, lists=lists, nlist=nlist,
+                    nprobe=nprobe)
+
+
+def ivf_knn_scores(vectors: jnp.ndarray, centroids: jnp.ndarray,
+                   lists: jnp.ndarray, query: jnp.ndarray, space: str,
+                   nprobe: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """IVF probe: returns (dense scores [D], candidate mask [D]).
+
+    Scores are exact for candidate docs (the nprobe nearest lists);
+    non-candidates are masked out — the standard IVF recall/compute trade."""
+    _check_space(space)
+    # centroid ranking always by L2 (clusters were built in L2 space); for
+    # innerproduct/cosine the probe order still correlates (faiss does the
+    # same for IVF+IP via L2-clustered coarse quantizers)
+    cd = jnp.sum(centroids * centroids, axis=1) - 2.0 * (centroids @ query)
+    nprobe_eff = min(int(nprobe), int(centroids.shape[0]))
+    _, probe_ids = jax.lax.top_k(-cd, nprobe_eff)
+    cand = lists[probe_ids].reshape(-1)              # [nprobe * max_len]
+    valid = cand >= 0
+    d = vectors.shape[0]
+    cand_gather = jnp.where(valid, cand, 0)          # safe gather index
+    cand_vecs = vectors[cand_gather]                 # gather [C, dims]
+    raw = raw_similarity(cand_vecs, query, space)
+    scores01 = space_score(raw, space)
+    # padding slots scatter out of bounds (dropped) — using index 0 would
+    # overwrite doc ord 0's entries
+    cand_scatter = jnp.where(valid, cand, d)
+    dense = jnp.zeros(d, jnp.float32).at[cand_scatter].max(
+        scores01, mode="drop")
+    mask = jnp.zeros(d, jnp.bool_).at[cand_scatter].set(True, mode="drop")
+    return dense, mask

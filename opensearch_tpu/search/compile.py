@@ -297,6 +297,40 @@ class Compiler:
         return Plan("range_num", static=(node.field,), inputs={
             "lo": _i32(lo_rank), "hi": _i32(hi_rank), "boost": _f32(node.boost)})
 
+    # ---------------------------------------------------------------- knn
+    def _c_KnnQuery(self, node: dsl.KnnQuery, seg, meta) -> Plan:
+        """k-NN query → exact MXU matmul scan or IVF probe (ops/knn.py).
+
+        Reference behavior: the k-NN plugin's KNNQuery returns the k nearest
+        docs per segment as matches with space-converted scores; a `filter`
+        restricts eligibility BEFORE top-k selection (exact pre-filtering —
+        the plugin's "efficient filtering" path). Filtered queries always use
+        the exact kernel so the filtered top-k stays exact."""
+        ft = self.mapper.get_field(node.field)
+        if ft is None or not ft.is_vector:
+            raise QueryShardError(
+                f"field [{node.field}] is not a knn_vector field")
+        col = seg.vector_dv.get(node.field)
+        if col is None:
+            return MATCH_NONE
+        q = np.asarray(list(node.vector), dtype=np.float32)
+        if q.shape != (ft.dims,):
+            raise IllegalArgumentError(
+                f"query vector has dimension {q.shape[0]} but field "
+                f"[{node.field}] expects {ft.dims}")
+        use_ivf = col.ivf is not None and node.filter is None
+        nprobe = 0
+        if use_ivf:
+            nprobe = node.nprobe or col.ivf.nprobe
+        children = []
+        if node.filter is not None:
+            children.append(self.compile(node.filter, seg, meta))
+        return Plan("knn",
+                    static=(node.field, int(node.k), ft.similarity_space,
+                            "ivf" if use_ivf else "exact", int(nprobe)),
+                    inputs={"query": q, "boost": _f32(node.boost)},
+                    children=children)
+
     # --------------------------------------------------------- misc leaves
     def _c_MatchAllQuery(self, node, seg, meta) -> Plan:
         return _match_all(node.boost)

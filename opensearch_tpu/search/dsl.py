@@ -177,6 +177,7 @@ class KnnQuery(QueryNode):
     vector: Sequence[float] = ()
     k: int = 10
     filter: Optional[QueryNode] = None
+    nprobe: int = 0          # IVF probe override (method_parameters.nprobe)
 
 
 @dataclass
@@ -373,9 +374,11 @@ def parse_query(q: Any) -> QueryNode:
 
     if name == "knn":
         field, spec = _field_body(body, "knn")
+        mp = spec.get("method_parameters", {}) or {}
         return KnnQuery(field=field, vector=list(spec.get("vector", [])),
                         k=int(spec.get("k", 10)),
                         filter=parse_query(spec["filter"]) if "filter" in spec else None,
+                        nprobe=int(mp.get("nprobes", mp.get("nprobe", 0))),
                         boost=float(spec.get("boost", 1.0)))
 
     if name == "script_score":

@@ -72,6 +72,25 @@ def _eval_plan(plan: Plan, seg: Dict, inputs: List[Dict], cursor: List[int]):
             matches = seg["norms"][key] > 0
         return jnp.where(matches, my["boost"], 0.0), matches
 
+    if kind == "knn":
+        from opensearch_tpu.ops.knn import (
+            exact_knn_scores, ivf_knn_scores, knn_match_topk)
+        field, k, space, method, nprobe = plan.static
+        col = seg["vector"][field]
+        eligible = col["exists"] & seg["live"]
+        if plan.children:
+            _, fmatches = _eval_plan(plan.children[0], seg, inputs, cursor)
+            eligible = eligible & fmatches
+        if method == "ivf":
+            scores, cand = ivf_knn_scores(
+                col["vectors"], col["ivf_centroids"], col["ivf_lists"],
+                my["query"], space, nprobe)
+            eligible = eligible & cand
+        else:
+            scores = exact_knn_scores(col["vectors"], my["query"], space)
+        scores, matches = knn_match_topk(scores, eligible, k)
+        return scores * my["boost"], matches
+
     if kind == "bool":
         n_must, n_filter, n_should, n_must_not = plan.static
         child_results = [_eval_plan(c, seg, inputs, cursor) for c in plan.children]

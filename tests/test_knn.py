@@ -1,0 +1,209 @@
+"""k-NN tests: exact parity vs numpy, spaces, filtering, IVF recall, persistence.
+
+Models the k-NN plugin's test strategy (recall-at-k against brute force);
+BASELINE.md configs 4 (exact) and 5 (ANN)."""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.service import IndexService
+
+DIMS = 16
+
+
+def np_scores(vectors, q, space):
+    if space == "l2":
+        d2 = ((vectors - q) ** 2).sum(axis=1)
+        return 1.0 / (1.0 + d2)
+    if space == "cosinesimil":
+        cos = (vectors @ q) / (np.linalg.norm(vectors, axis=1)
+                               * np.linalg.norm(q) + 1e-30)
+        return (1.0 + np.clip(cos, -1, 1)) / 2.0
+    ip = vectors @ q
+    return np.where(ip >= 0, ip + 1.0, 1.0 / (1.0 - ip))
+
+
+def make_service(space="l2", method=None, n=300, seed=0, shards=1):
+    mapping = {"properties": {
+        "vec": {"type": "knn_vector", "dimension": DIMS,
+                "method": ({"name": method, "space_type": space,
+                            "parameters": {"nlist": 8}} if method
+                           else {"space_type": space})},
+        "tag": {"type": "keyword"},
+    }}
+    svc = IndexService("knn-idx", mapping=mapping,
+                       settings={"number_of_shards": shards})
+    rng = np.random.RandomState(seed)
+    vectors = rng.randn(n, DIMS).astype(np.float32)
+    for i in range(n):
+        svc.index_doc(f"d{i}", {"vec": vectors[i].tolist(),
+                                "tag": "even" if i % 2 == 0 else "odd"})
+    svc.refresh()
+    return svc, vectors
+
+
+class TestExactKnn:
+    @pytest.mark.parametrize("space", ["l2", "cosinesimil", "innerproduct"])
+    def test_parity_with_numpy(self, space):
+        svc, vectors = make_service(space)
+        rng = np.random.RandomState(1)
+        for _ in range(3):
+            q = rng.randn(DIMS).astype(np.float32)
+            resp = svc.search({"query": {"knn": {"vec": {
+                "vector": q.tolist(), "k": 10}}}, "size": 10})
+            got = [h["_id"] for h in resp["hits"]["hits"]]
+            ref = np_scores(vectors, q, space)
+            want = [f"d{i}" for i in np.argsort(-ref, kind="stable")[:10]]
+            assert got == want
+            top = resp["hits"]["hits"][0]
+            assert abs(top["_score"]
+                       - ref[int(top["_id"][1:])]) < 1e-4
+        svc.close()
+
+    def test_k_limits_matches(self):
+        svc, _ = make_service()
+        resp = svc.search({"query": {"knn": {"vec": {
+            "vector": [0.0] * DIMS, "k": 7}}}, "size": 20})
+        assert resp["hits"]["total"]["value"] == 7
+        svc.close()
+
+    def test_filtered_knn_exact(self):
+        svc, vectors = make_service()
+        q = np.zeros(DIMS, dtype=np.float32)
+        resp = svc.search({"query": {"knn": {"vec": {
+            "vector": q.tolist(), "k": 5,
+            "filter": {"term": {"tag": "even"}}}}}, "size": 5})
+        got = [h["_id"] for h in resp["hits"]["hits"]]
+        ref = np_scores(vectors, q, "l2")
+        even = [i for i in range(len(vectors)) if i % 2 == 0]
+        want = [f"d{i}" for i in sorted(even, key=lambda i: -ref[i])[:5]]
+        assert got == want
+        assert all(int(h["_id"][1:]) % 2 == 0 for h in resp["hits"]["hits"])
+        svc.close()
+
+    def test_deleted_docs_excluded(self):
+        svc, vectors = make_service()
+        q = vectors[17]  # exact match → d17 would be top-1
+        svc.delete_doc("d17")
+        svc.refresh()
+        resp = svc.search({"query": {"knn": {"vec": {
+            "vector": q.tolist(), "k": 3}}}})
+        assert "d17" not in [h["_id"] for h in resp["hits"]["hits"]]
+        svc.close()
+
+    def test_multi_shard_merge(self):
+        svc, vectors = make_service(shards=3)
+        q = np.zeros(DIMS, dtype=np.float32)
+        resp = svc.search({"query": {"knn": {"vec": {
+            "vector": q.tolist(), "k": 10}}}, "size": 10})
+        ref = np_scores(vectors, q, "l2")
+        want = [f"d{i}" for i in np.argsort(-ref, kind="stable")[:10]]
+        assert [h["_id"] for h in resp["hits"]["hits"]] == want
+        svc.close()
+
+    def test_knn_in_bool_hybrid(self):
+        svc, _ = make_service()
+        resp = svc.search({"query": {"bool": {
+            "must": [{"knn": {"vec": {"vector": [0.1] * DIMS, "k": 20}}}],
+            "filter": [{"term": {"tag": "odd"}}],
+        }}, "size": 30})
+        assert 0 < resp["hits"]["total"]["value"] <= 20
+        assert all(int(h["_id"][1:]) % 2 == 1 for h in resp["hits"]["hits"])
+        svc.close()
+
+
+class TestIvfKnn:
+    def test_recall_on_clustered_data(self):
+        # clustered corpus (IVF's favorable + realistic case)
+        rng = np.random.RandomState(3)
+        centers = rng.randn(8, DIMS).astype(np.float32) * 5
+        n = 800
+        assign = rng.randint(0, 8, size=n)
+        vectors = (centers[assign]
+                   + rng.randn(n, DIMS).astype(np.float32) * 0.5)
+        mapping = {"properties": {"vec": {
+            "type": "knn_vector", "dimension": DIMS,
+            "method": {"name": "ivf", "space_type": "l2",
+                       "parameters": {"nlist": 8, "nprobes": 4}}}}}
+        svc = IndexService("ivf-idx", mapping=mapping)
+        svc.bulk([{"action": "index", "id": f"d{i}",
+                   "source": {"vec": vectors[i].tolist()}}
+                  for i in range(n)])
+        svc.refresh()
+        # IVF actually built (>=256 vectors, method ivf)
+        seg = svc.shards[0].engine.segments[0]
+        assert seg.vector_dv["vec"].ivf is not None
+        recalls = []
+        for _ in range(10):
+            q = (centers[rng.randint(0, 8)]
+                 + rng.randn(DIMS).astype(np.float32) * 0.5)
+            resp = svc.search({"query": {"knn": {"vec": {
+                "vector": q.tolist(), "k": 10}}}, "size": 10})
+            got = {h["_id"] for h in resp["hits"]["hits"]}
+            ref = np_scores(vectors, q, "l2")
+            want = {f"d{i}" for i in np.argsort(-ref)[:10]}
+            recalls.append(len(got & want) / 10)
+        assert np.mean(recalls) >= 0.9, f"IVF recall@10 {np.mean(recalls)}"
+        svc.close()
+
+    def test_hnsw_mapping_maps_to_ivf(self):
+        from opensearch_tpu.index.mapper import MapperService
+        m = MapperService({"properties": {"v": {
+            "type": "knn_vector", "dimension": 4,
+            "method": {"name": "hnsw", "space_type": "cosinesimil"}}}})
+        ft = m.get_field("v")
+        assert ft.knn_method == "ivf"
+        assert ft.similarity_space == "cosinesimil"
+
+    def test_ivf_persists_across_reopen(self, tmp_path):
+        rng = np.random.RandomState(5)
+        vectors = rng.randn(300, DIMS).astype(np.float32)
+        mapping = {"properties": {"vec": {
+            "type": "knn_vector", "dimension": DIMS,
+            "method": {"name": "ivf", "parameters": {"nlist": 4}}}}}
+        svc = IndexService("pivf", mapping=mapping, data_path=str(tmp_path))
+        svc.bulk([{"action": "index", "id": f"d{i}",
+                   "source": {"vec": vectors[i].tolist()}}
+                  for i in range(300)])
+        svc.flush()
+        svc.close()
+        svc2 = IndexService("pivf", mapping=mapping, data_path=str(tmp_path))
+        seg = svc2.shards[0].engine.segments[0]
+        assert seg.vector_dv["vec"].ivf is not None
+        q = vectors[42]
+        resp = svc2.search({"query": {"knn": {"vec": {
+            "vector": q.tolist(), "k": 5}}}})
+        assert resp["hits"]["hits"][0]["_id"] == "d42"
+        svc2.close()
+
+
+class TestScatterRegressions:
+    """Pins for review findings: -1 padding / invalid top-k slots must not
+    clobber doc ord 0's scatter entries."""
+
+    def test_doc_zero_wins_exact_fewer_than_k(self):
+        svc, vectors = make_service(n=5)
+        q = vectors[0]  # doc ord 0 is the best hit; k > eligible count
+        resp = svc.search({"query": {"knn": {"vec": {
+            "vector": q.tolist(), "k": 10}}}})
+        assert resp["hits"]["hits"][0]["_id"] == "d0"
+        assert resp["hits"]["total"]["value"] == 5
+        svc.close()
+
+    def test_doc_zero_wins_ivf(self):
+        rng = np.random.RandomState(9)
+        vectors = rng.randn(400, DIMS).astype(np.float32)
+        mapping = {"properties": {"vec": {
+            "type": "knn_vector", "dimension": DIMS,
+            "method": {"name": "ivf", "parameters": {"nlist": 4,
+                                                     "nprobes": 4}}}}}
+        svc = IndexService("z-ivf", mapping=mapping)
+        svc.bulk([{"action": "index", "id": f"d{i}",
+                   "source": {"vec": vectors[i].tolist()}}
+                  for i in range(400)])
+        svc.refresh()
+        assert svc.shards[0].engine.segments[0].vector_dv["vec"].ivf is not None
+        resp = svc.search({"query": {"knn": {"vec": {
+            "vector": vectors[0].tolist(), "k": 5}}}})
+        assert resp["hits"]["hits"][0]["_id"] == "d0"
+        svc.close()
