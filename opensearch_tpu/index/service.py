@@ -16,7 +16,8 @@ from typing import Any, Dict, List, Optional
 
 from opensearch_tpu.cluster.routing import generate_shard_id
 from opensearch_tpu.common.errors import (
-    DocumentMissingError, IllegalArgumentError, OpenSearchTpuError)
+    DocumentMissingError, IllegalArgumentError, OpenSearchTpuError,
+    VersionConflictError)
 from opensearch_tpu.index.mapper import MapperService
 from opensearch_tpu.index.shard import IndexShard
 
@@ -115,12 +116,27 @@ class IndexService:
                                     "deleted" if res.found else "not_found")
 
     def update_doc(self, doc_id: str, body: dict,
-                   routing: Optional[str] = None) -> dict:
+                   routing: Optional[str] = None,
+                   if_seq_no: Optional[int] = None,
+                   if_primary_term: Optional[int] = None) -> dict:
         """Partial update: realtime GET → merge → reindex with seq-no CAS
         (UpdateHelper semantics: detect_noop default true, upsert,
-        doc_as_upsert, retry left to the caller)."""
+        doc_as_upsert, retry left to the caller). A caller-supplied
+        if_seq_no/if_primary_term CAS is checked against the current doc."""
         shard = self.shard_for(doc_id, routing)
         cur = shard.get_doc(doc_id)
+        if if_seq_no is not None or if_primary_term is not None:
+            if cur is None:
+                raise VersionConflictError(
+                    f"[{doc_id}]: version conflict, document does not exist")
+            if ((if_seq_no is not None and cur.seq_no != if_seq_no)
+                    or (if_primary_term is not None
+                        and cur.primary_term != if_primary_term)):
+                raise VersionConflictError(
+                    f"[{doc_id}]: version conflict, required seqNo "
+                    f"[{if_seq_no}], primary term [{if_primary_term}]. "
+                    f"current document has seqNo [{cur.seq_no}] and primary "
+                    f"term [{cur.primary_term}]")
         doc_patch = body.get("doc")
         if cur is None:
             if body.get("doc_as_upsert") and doc_patch is not None:
@@ -177,20 +193,23 @@ class IndexService:
         errors = False
         for op in operations:
             action = op["action"]
+            cas = {k: op[k] for k in ("if_seq_no", "if_primary_term")
+                   if op.get(k) is not None}
             try:
                 if action in ("index", "create"):
                     resp = self.index_doc(op.get("id"), op["source"],
                                           routing=op.get("routing"),
                                           op_type=("create"
                                                    if action == "create"
-                                                   else "index"))
+                                                   else "index"), **cas)
                     status = 201 if resp["result"] == "created" else 200
                 elif action == "delete":
-                    resp = self.delete_doc(op["id"], routing=op.get("routing"))
+                    resp = self.delete_doc(op["id"], routing=op.get("routing"),
+                                           **cas)
                     status = 200 if resp["result"] == "deleted" else 404
                 elif action == "update":
                     resp = self.update_doc(op["id"], op["source"],
-                                           routing=op.get("routing"))
+                                           routing=op.get("routing"), **cas)
                     status = 200
                 else:
                     raise IllegalArgumentError(

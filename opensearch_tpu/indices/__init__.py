@@ -1,0 +1,3 @@
+from opensearch_tpu.indices.service import IndicesService
+
+__all__ = ["IndicesService"]

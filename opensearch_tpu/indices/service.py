@@ -1,0 +1,430 @@
+"""IndicesService: node-level container of named indices.
+
+Re-design of the reference's indices layer (indices/IndicesService.java:208)
+plus the metadata services that live cluster-side in the reference:
+index creation with template application
+(cluster/metadata/MetadataCreateIndexService.java), alias management
+(cluster/metadata/MetadataIndexAliasesService.java), legacy + composable
+index templates (cluster/metadata/MetadataIndexTemplateService.java), and
+index-name expression resolution with wildcards/exclusions
+(cluster/metadata/IndexNameExpressionResolver.java).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+from opensearch_tpu.common.errors import (
+    IllegalArgumentError, IndexNotFoundError, ResourceAlreadyExistsError)
+from opensearch_tpu.index.service import IndexService, deep_merge
+
+# reference: MetadataCreateIndexService.validateIndexOrAliasName
+_INVALID_CHARS = set(' "*\\<|,>/?')
+
+
+def validate_index_name(name: str):
+    if not name:
+        raise IllegalArgumentError("index name must not be empty")
+    if name != name.lower():
+        raise IllegalArgumentError(f"index name [{name}] must be lowercase")
+    if name.startswith(("-", "_", "+")) and name not in ():
+        raise IllegalArgumentError(
+            f"index name [{name}] must not start with '_', '-', or '+'")
+    bad = _INVALID_CHARS & set(name)
+    if bad or "#" in name or ":" in name:
+        raise IllegalArgumentError(
+            f"index name [{name}] must not contain the following characters "
+            f"{sorted(_INVALID_CHARS | set('#:'))}")
+    if name in (".", ".."):
+        raise IllegalArgumentError(f"index name [{name}] is invalid")
+    if len(name.encode("utf-8")) > 255:
+        raise IllegalArgumentError(f"index name [{name}] is too long")
+
+
+def _normalize_settings(settings: Optional[dict]) -> dict:
+    """Flatten {"index": {...}} nesting and strip the "index." prefix."""
+    out: Dict[str, Any] = {}
+
+    def walk(prefix: str, obj: Any):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                walk(f"{prefix}{k}.", v)
+        else:
+            out[prefix[:-1]] = obj
+
+    walk("", settings or {})
+    return {k[len("index."):] if k.startswith("index.") else k: v
+            for k, v in out.items()}
+
+
+class AliasMetadata:
+    __slots__ = ("name", "filter", "routing", "index_routing",
+                 "search_routing", "is_write_index")
+
+    def __init__(self, name: str, body: Optional[dict] = None):
+        body = body or {}
+        self.name = name
+        self.filter = body.get("filter")
+        self.routing = body.get("routing")
+        self.index_routing = body.get("index_routing", self.routing)
+        self.search_routing = body.get("search_routing", self.routing)
+        self.is_write_index = bool(body.get("is_write_index", False))
+
+    def to_dict(self) -> dict:
+        out: Dict[str, Any] = {}
+        if self.filter is not None:
+            out["filter"] = self.filter
+        if self.index_routing is not None:
+            out["index_routing"] = self.index_routing
+        if self.search_routing is not None:
+            out["search_routing"] = self.search_routing
+        if self.is_write_index:
+            out["is_write_index"] = True
+        return out
+
+
+class IndexTemplate:
+    """Composable index template (reference: ComposableIndexTemplate).
+
+    Legacy `_template` templates are modeled as priority-ordered composable
+    templates with `legacy=True` (legacy templates all merge, highest order
+    wins per-key; composable: single highest-priority template applies).
+    """
+
+    def __init__(self, name: str, body: dict, legacy: bool = False):
+        self.name = name
+        self.legacy = legacy
+        patterns = body.get("index_patterns", [])
+        if isinstance(patterns, str):
+            patterns = [patterns]
+        if not patterns:
+            raise IllegalArgumentError(
+                f"index template [{name}] must have index_patterns")
+        self.index_patterns = list(patterns)
+        tmpl = body.get("template", body if legacy else {}) or {}
+        self.settings = _normalize_settings(tmpl.get("settings"))
+        self.mappings = tmpl.get("mappings") or {}
+        self.aliases = tmpl.get("aliases") or {}
+        self.priority = int(body.get("priority", body.get("order", 0)))
+        self.version = body.get("version")
+        self.data_stream = body.get("data_stream")
+        self.composed_of = body.get("composed_of", [])
+
+    def matches(self, index_name: str) -> bool:
+        return any(fnmatch.fnmatchcase(index_name, p)
+                   for p in self.index_patterns)
+
+    def to_dict(self) -> dict:
+        out: Dict[str, Any] = {"index_patterns": self.index_patterns}
+        tmpl: Dict[str, Any] = {}
+        if self.settings:
+            tmpl["settings"] = self.settings
+        if self.mappings:
+            tmpl["mappings"] = self.mappings
+        if self.aliases:
+            tmpl["aliases"] = self.aliases
+        if self.legacy:
+            out.update(tmpl)
+            out["order"] = self.priority
+        else:
+            out["template"] = tmpl
+            out["priority"] = self.priority
+            if self.data_stream is not None:
+                out["data_stream"] = self.data_stream
+        if self.version is not None:
+            out["version"] = self.version
+        return out
+
+
+class IndicesService:
+    """All named indices on this node + aliases + templates."""
+
+    def __init__(self, data_path: Optional[str] = None):
+        self.indices: Dict[str, IndexService] = {}
+        # alias name -> {index name -> AliasMetadata}
+        self.aliases: Dict[str, Dict[str, AliasMetadata]] = {}
+        self.templates: Dict[str, IndexTemplate] = {}
+        self.legacy_templates: Dict[str, IndexTemplate] = {}
+        self.component_templates: Dict[str, dict] = {}
+        self.data_path = data_path
+
+    # ----------------------------------------------------------- templates
+
+    def put_template(self, name: str, body: dict, legacy: bool = False):
+        tmpl = IndexTemplate(name, body, legacy=legacy)
+        if legacy:
+            self.legacy_templates[name] = tmpl
+        else:
+            if not tmpl.legacy and tmpl.composed_of:
+                for comp in tmpl.composed_of:
+                    if comp not in self.component_templates:
+                        raise IllegalArgumentError(
+                            f"component template [{comp}] missing")
+            self.templates[name] = tmpl
+        return tmpl
+
+    def delete_template(self, name: str, legacy: bool = False):
+        store = self.legacy_templates if legacy else self.templates
+        if name not in store:
+            raise IndexNotFoundError(f"index template [{name}]")
+        del store[name]
+
+    def put_component_template(self, name: str, body: dict):
+        self.component_templates[name] = body
+
+    def _template_for(self, index_name: str):
+        """Merged (settings, mappings, aliases) from matching templates."""
+        settings: Dict[str, Any] = {}
+        mappings: Dict[str, Any] = {}
+        aliases: Dict[str, Any] = {}
+        # legacy: all matching templates compose, ascending order
+        for tmpl in sorted((t for t in self.legacy_templates.values()
+                            if t.matches(index_name)),
+                           key=lambda t: t.priority):
+            settings.update(tmpl.settings)
+            mappings = deep_merge(mappings, tmpl.mappings)
+            aliases.update(tmpl.aliases)
+        # composable: the single highest-priority match wins outright
+        matches = [t for t in self.templates.values() if t.matches(index_name)]
+        if matches:
+            best = max(matches, key=lambda t: t.priority)
+            for comp in best.composed_of:
+                body = self.component_templates.get(comp, {})
+                tmpl = (body.get("template") or {})
+                settings.update(_normalize_settings(tmpl.get("settings")))
+                mappings = deep_merge(mappings, tmpl.get("mappings") or {})
+                aliases.update(tmpl.get("aliases") or {})
+            settings.update(best.settings)
+            mappings = deep_merge(mappings, best.mappings)
+            aliases.update(best.aliases)
+            return settings, mappings, aliases, best
+        return settings, mappings, aliases, None
+
+    # -------------------------------------------------------------- CRUD
+
+    def create_index(self, name: str, body: Optional[dict] = None,
+                     apply_templates: bool = True) -> IndexService:
+        validate_index_name(name)
+        if name in self.indices:
+            raise ResourceAlreadyExistsError(
+                f"index [{name}/] already exists")
+        if name in self.aliases:
+            raise IllegalArgumentError(
+                f"an alias with the name [{name}] already exists")
+        body = body or {}
+        settings = _normalize_settings(body.get("settings"))
+        mappings = body.get("mappings") or {}
+        alias_bodies = dict(body.get("aliases") or {})
+        if apply_templates:
+            t_settings, t_mappings, t_aliases, _ = self._template_for(name)
+            settings = {**t_settings, **settings}
+            mappings = deep_merge(t_mappings, mappings)
+            for aname, abody in t_aliases.items():
+                alias_bodies.setdefault(aname, abody)
+        svc = IndexService(name, mapping=mappings or None, settings=settings,
+                           data_path=self.data_path)
+        self.indices[name] = svc
+        for aname, abody in alias_bodies.items():
+            self.put_alias(name, aname, abody)
+        return svc
+
+    def delete_index(self, expression: str):
+        names = self.resolve(expression, allow_aliases=False)
+        if not names:
+            raise IndexNotFoundError(expression)
+        for name in names:
+            svc = self.indices.pop(name)
+            svc.close()
+            for alias_map in list(self.aliases.values()):
+                alias_map.pop(name, None)
+            self.aliases = {a: m for a, m in self.aliases.items() if m}
+        return names
+
+    def get(self, name: str) -> IndexService:
+        if name in self.indices:
+            return self.indices[name]
+        raise IndexNotFoundError(name)
+
+    def has_index(self, name: str) -> bool:
+        return name in self.indices
+
+    # ------------------------------------------------------------- aliases
+
+    def put_alias(self, index: str, alias: str, body: Optional[dict] = None):
+        if index not in self.indices:
+            raise IndexNotFoundError(index)
+        if alias in self.indices:
+            raise IllegalArgumentError(
+                f"an index exists with the same name as the alias [{alias}]")
+        validate_index_name(alias)
+        self.aliases.setdefault(alias, {})[index] = AliasMetadata(alias, body)
+
+    def remove_alias(self, index_expr: str, alias_expr: str,
+                     must_exist: bool = True):
+        indices = self.resolve(index_expr, allow_aliases=False)
+        removed = False
+        for alias in list(self.aliases):
+            if not fnmatch.fnmatchcase(alias, alias_expr):
+                continue
+            for idx in indices:
+                if idx in self.aliases[alias]:
+                    del self.aliases[alias][idx]
+                    removed = True
+            if not self.aliases[alias]:
+                del self.aliases[alias]
+        if must_exist and not removed:
+            raise IndexNotFoundError(alias_expr)
+
+    def update_aliases(self, actions: List[dict]):
+        """The _aliases API: atomic-ish batch of add/remove/remove_index."""
+        for action in actions:
+            if len(action) != 1:
+                raise IllegalArgumentError(
+                    "[aliases] action must be one of [add, remove, remove_index]")
+            op, body = next(iter(action.items()))
+            idx_exprs = body.get("indices", body.get("index"))
+            aliases = body.get("aliases", body.get("alias"))
+            if isinstance(idx_exprs, str):
+                idx_exprs = [idx_exprs]
+            if isinstance(aliases, str):
+                aliases = [aliases]
+            if op == "add":
+                props = {k: v for k, v in body.items()
+                         if k in ("filter", "routing", "index_routing",
+                                  "search_routing", "is_write_index")}
+                for expr in idx_exprs:
+                    for idx in self.resolve(expr, allow_aliases=False):
+                        for alias in aliases:
+                            self.put_alias(idx, alias, props)
+            elif op == "remove":
+                for expr in idx_exprs or ["*"]:
+                    for alias in aliases:
+                        self.remove_alias(expr, alias,
+                                          must_exist=not body.get(
+                                              "must_exist") is False)
+            elif op == "remove_index":
+                for expr in idx_exprs:
+                    self.delete_index(expr)
+            else:
+                raise IllegalArgumentError(
+                    f"[aliases] unknown action [{op}]")
+
+    def alias_metadata(self, index: str) -> Dict[str, AliasMetadata]:
+        return {alias: m[index] for alias, m in self.aliases.items()
+                if index in m}
+
+    def write_index(self, name: str) -> str:
+        """Resolve a name used as a write target (index or alias)."""
+        if name in self.indices:
+            return name
+        if name in self.aliases:
+            members = self.aliases[name]
+            writers = [i for i, m in members.items() if m.is_write_index]
+            if len(writers) == 1:
+                return writers[0]
+            if len(members) == 1 and not writers:
+                return next(iter(members))
+            raise IllegalArgumentError(
+                f"no write index is defined for alias [{name}]. The write "
+                f"index may be explicitly disabled using is_write_index=false "
+                f"or the alias points to multiple indices without one being "
+                f"designated as a write index")
+        raise IndexNotFoundError(name)
+
+    # ----------------------------------------------------------- resolution
+
+    def resolve(self, expression: Optional[str], allow_aliases: bool = True,
+                ignore_unavailable: bool = False,
+                allow_no_indices: bool = True) -> List[str]:
+        """IndexNameExpressionResolver: wildcards, _all, commas, -exclusions,
+        alias expansion. Returns concrete index names in insertion order."""
+        if expression is None or expression in ("_all", "*", ""):
+            return list(self.indices)
+        parts = (expression if isinstance(expression, list)
+                 else expression.split(","))
+        selected: List[str] = []
+
+        def add(name):
+            if name not in selected:
+                selected.append(name)
+
+        def remove(name):
+            if name in selected:
+                selected.remove(name)
+
+        for i, part in enumerate(parts):
+            part = part.strip()
+            exclude = part.startswith("-") and i > 0
+            if exclude:
+                part = part[1:]
+            if part == "_all":
+                names = list(self.indices)
+            elif "*" in part or "?" in part:
+                names = [n for n in self.indices
+                         if fnmatch.fnmatchcase(n, part)]
+                if allow_aliases:
+                    for alias, members in self.aliases.items():
+                        if fnmatch.fnmatchcase(alias, part):
+                            names.extend(members)
+            elif part in self.indices:
+                names = [part]
+            elif allow_aliases and part in self.aliases:
+                names = list(self.aliases[part])
+            elif ignore_unavailable or exclude:
+                names = []
+            else:
+                raise IndexNotFoundError(part)
+            for n in names:
+                remove(n) if exclude else add(n)
+        if not selected and not allow_no_indices:
+            raise IndexNotFoundError(expression)
+        return selected
+
+    def alias_filter(self, expression: Optional[str],
+                     index: str) -> Optional[dict]:
+        """The alias filter for `index` under this search expression.
+
+        Reference rule (IndexNameExpressionResolver / AliasFilter): if any
+        route in the expression reaches the index unfiltered — the concrete
+        name, a wildcard matching the concrete name, `_all`, or an alias
+        without a filter — no filter applies. Otherwise the filters of every
+        alias route are OR-combined."""
+        parts = [p.strip() for p in (expression or "").split(",") if p.strip()]
+        if not parts:
+            return None  # empty/_all search: unfiltered
+        filters = []
+        for i, part in enumerate(parts):
+            if part.startswith("-") and i > 0:
+                continue  # exclusions never add a route
+            if part in ("_all", index):
+                return None
+            if "*" in part or "?" in part:
+                if fnmatch.fnmatchcase(index, part):
+                    return None
+                for alias, members in self.aliases.items():
+                    if fnmatch.fnmatchcase(alias, part) and index in members:
+                        meta = members[index]
+                        if meta.filter is None:
+                            return None
+                        filters.append(meta.filter)
+            elif part in self.aliases and index in self.aliases[part]:
+                meta = self.aliases[part][index]
+                if meta.filter is None:
+                    return None
+                filters.append(meta.filter)
+        if not filters:
+            return None
+        if len(filters) == 1:
+            return filters[0]
+        return {"bool": {"should": filters, "minimum_should_match": 1}}
+
+    # --------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        out = {}
+        for name, svc in self.indices.items():
+            out[name] = svc.stats()
+        return out

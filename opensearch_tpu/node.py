@@ -1,0 +1,114 @@
+"""Node: the top-level container wiring services + REST dispatch.
+
+Re-design of the reference Node (node/Node.java:372): constructs the
+IndicesService, cluster-level settings, and the RestController with the full
+route table (rest/action/*), and exposes `handle()` — the analog of
+RestController.dispatchRequest — plus a programmatic client facade.
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import time
+from typing import Any, Dict, List, Optional
+
+from opensearch_tpu.common.errors import IllegalArgumentError
+from opensearch_tpu.indices.service import IndicesService
+from opensearch_tpu.rest.controller import (
+    RestController, RestRequest, RestResponse)
+from opensearch_tpu.version import __version__ as VERSION
+
+
+class Node:
+    def __init__(self, node_name: str = "node-0",
+                 cluster_name: str = "opensearch-tpu",
+                 data_path: Optional[str] = None,
+                 settings: Optional[dict] = None):
+        self.node_name = node_name
+        self.node_id = secrets.token_urlsafe(16)
+        self.cluster_name = cluster_name
+        self.settings = settings or {}
+        self.start_time_ms = int(time.time() * 1000)
+        self.indices = IndicesService(data_path=data_path)
+        self.cluster_settings: Dict[str, Any] = {"persistent": {},
+                                                 "transient": {}}
+        self.scroll_contexts: Dict[str, Any] = {}
+        self.pit_contexts: Dict[str, Any] = {}
+        self.controller = RestController()
+        from opensearch_tpu.rest.actions import register_all
+        register_all(self)
+
+    # ------------------------------------------------------------- dispatch
+
+    def handle(self, method: str, path: str,
+               params: Optional[Dict[str, str]] = None,
+               body: Any = None,
+               raw_body: Optional[bytes] = None) -> RestResponse:
+        """Entry point for both the HTTP server and in-process tests."""
+        if isinstance(body, (str, bytes)) and body:
+            raw_body = body if isinstance(body, bytes) else body.encode()
+            try:
+                body = json.loads(body)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                body = None
+        req = RestRequest(method=method.upper(), path=path,
+                          params=dict(params or {}), body=body,
+                          raw_body=raw_body)
+        return self.controller.dispatch(req)
+
+    # -------------------------------------------------- convenience client
+
+    def request(self, method: str, path: str, body: Any = None,
+                **params) -> dict:
+        """Like handle() but raises nothing and returns the parsed body —
+        the shape tests use."""
+        resp = self.handle(method, path, params={k: str(v)
+                                                 for k, v in params.items()},
+                           body=body)
+        if isinstance(resp.body, str):
+            return {"_raw": resp.body, "_status": resp.status}
+        out = resp.body if isinstance(resp.body, dict) else {"_body": resp.body}
+        out = dict(out)
+        out["_status"] = resp.status
+        return out
+
+    # ----------------------------------------------------------- cluster info
+
+    def root_info(self) -> dict:
+        return {
+            "name": self.node_name,
+            "cluster_name": self.cluster_name,
+            "cluster_uuid": self.node_id,
+            "version": {
+                "distribution": "opensearch-tpu",
+                "number": VERSION,
+                "build_type": "source",
+                "minimum_wire_compatibility_version": VERSION,
+                "minimum_index_compatibility_version": VERSION,
+            },
+            "tagline": "The OpenSearch-TPU Project: search at MXU speed",
+        }
+
+    def cluster_health(self, index: Optional[str] = None) -> dict:
+        names = (self.indices.resolve(index) if index
+                 else list(self.indices.indices))
+        total_shards = sum(self.indices.indices[n].num_shards for n in names)
+        return {
+            "cluster_name": self.cluster_name,
+            "status": "green",
+            "timed_out": False,
+            "number_of_nodes": 1,
+            "number_of_data_nodes": 1,
+            "discovered_cluster_manager": True,
+            "active_primary_shards": total_shards,
+            "active_shards": total_shards,
+            "relocating_shards": 0,
+            "initializing_shards": 0,
+            "unassigned_shards": 0,
+            "delayed_unassigned_shards": 0,
+            "number_of_pending_tasks": 0,
+            "number_of_in_flight_fetch": 0,
+            "task_max_waiting_in_queue_millis": 0,
+            "active_shards_percent_as_number": 100.0,
+        }

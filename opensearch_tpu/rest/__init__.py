@@ -1,0 +1,3 @@
+from opensearch_tpu.rest.controller import RestController, RestRequest, RestResponse
+
+__all__ = ["RestController", "RestRequest", "RestResponse"]

@@ -1,0 +1,847 @@
+"""The REST route table: every handler the node serves.
+
+Re-design of the reference's rest/action/* handlers + the TransportActions
+behind them (action/ActionModule.java:733 registrations). Handlers are thin:
+they parse request params and delegate to IndicesService / IndexService,
+which own the actual behavior. NDJSON endpoints (_bulk, _msearch) parse the
+raw body. _cat handlers render fixed-width text tables like the reference's
+AbstractCatAction.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from opensearch_tpu.common.errors import (
+    IllegalArgumentError, IndexNotFoundError, OpenSearchTpuError)
+from opensearch_tpu.rest.controller import RestRequest, RestResponse
+
+
+# --------------------------------------------------------------------- utils
+
+def _ndjson_lines(request: RestRequest) -> List[Any]:
+    raw = request.raw_body
+    if raw is None:
+        raise IllegalArgumentError("request body is required")
+    text = raw.decode("utf-8") if isinstance(raw, bytes) else raw
+    out = []
+    for line in text.split("\n"):
+        line = line.strip()
+        if line:
+            out.append(json.loads(line))
+    return out
+
+
+def _search_targets(node, index_expr: Optional[str]):
+    """Resolve an index expression to (executors, alias_filters) pairs for
+    a cross-index search, honoring alias filters per concrete index."""
+    names = node.indices.resolve(index_expr, ignore_unavailable=False,
+                                 allow_no_indices=True)
+    executors, filters = [], []
+    for name in names:
+        svc = node.indices.get(name)
+        alias_filter = node.indices.alias_filter(index_expr or "", name)
+        for shard in svc.shards:
+            executors.append(shard.executor)
+            filters.append(alias_filter)
+    return executors, filters
+
+
+def _run_search(node, index_expr: Optional[str], body: Optional[dict]) -> dict:
+    from opensearch_tpu.search.controller import execute_search
+    executors, filters = _search_targets(node, index_expr)
+    return execute_search(executors, body, extra_filters=filters)
+
+
+# ---------------------------------------------------------------- documents
+
+def register_document_actions(node, c):
+    def write_params(req):
+        kw = {}
+        if req.param("if_seq_no") is not None:
+            kw["if_seq_no"] = req.int_param("if_seq_no")
+        if req.param("if_primary_term") is not None:
+            kw["if_primary_term"] = req.int_param("if_primary_term")
+        if req.param("version") is not None and \
+                req.param("version_type") == "external":
+            kw["external_version"] = req.int_param("version")
+        return kw
+
+    def maybe_refresh(req, svc):
+        if req.param("refresh") in ("true", "", "wait_for"):
+            svc.refresh()
+
+    def do_index(req):
+        idx = node.indices.write_index(req.param("index"))
+        svc = node.indices.get(idx)
+        doc_id = req.param("id")
+        op_type = req.param("op_type", "index")
+        res = svc.index_doc(doc_id, req.body or {},
+                            routing=req.param("routing"),
+                            op_type=op_type, **write_params(req))
+        maybe_refresh(req, svc)
+        status = 201 if res.get("result") == "created" else 200
+        return status, res
+
+    def do_create(req):
+        req.params["op_type"] = "create"
+        return do_index(req)
+
+    def do_get(req):
+        svc = node.indices.get(
+            node.indices.write_index(req.param("index")))
+        res = svc.get_doc(req.param("id"), routing=req.param("routing"),
+                          realtime=req.bool_param("realtime", True))
+        return (200 if res.get("found") else 404), res
+
+    def do_get_source(req):
+        svc = node.indices.get(node.indices.write_index(req.param("index")))
+        res = svc.get_doc(req.param("id"), routing=req.param("routing"))
+        if not res.get("found"):
+            return 404, {"error": f"document [{req.param('id')}] missing"}
+        return 200, res.get("_source")
+
+    def do_delete(req):
+        idx = node.indices.write_index(req.param("index"))
+        svc = node.indices.get(idx)
+        res = svc.delete_doc(req.param("id"), routing=req.param("routing"),
+                             **write_params(req))
+        maybe_refresh(req, svc)
+        return (200 if res.get("result") == "deleted" else 404), res
+
+    def do_update(req):
+        idx = node.indices.write_index(req.param("index"))
+        svc = node.indices.get(idx)
+        res = svc.update_doc(req.param("id"), req.body or {},
+                             routing=req.param("routing"), **write_params(req))
+        maybe_refresh(req, svc)
+        return res
+
+    def do_mget(req):
+        body = req.body or {}
+        default_index = req.param("index")
+        docs_spec = body.get("docs")
+        if docs_spec is None and "ids" in body:
+            docs_spec = [{"_id": i} for i in body["ids"]]
+        if docs_spec is None:
+            raise IllegalArgumentError("unexpected content, expected [docs] or [ids]")
+        docs = []
+        for spec in docs_spec:
+            idx = spec.get("_index", default_index)
+            if idx is None:
+                raise IllegalArgumentError("index is missing for doc")
+            try:
+                svc = node.indices.get(node.indices.write_index(idx))
+                docs.append(svc.get_doc(spec["_id"],
+                                        routing=spec.get("routing")))
+            except IndexNotFoundError:
+                docs.append({"_index": idx, "_id": spec.get("_id"),
+                             "error": {"type": "index_not_found_exception",
+                                       "reason": f"no such index [{idx}]"}})
+        return {"docs": docs}
+
+    def do_bulk(req):
+        ops = _ndjson_lines(req)
+        default_index = req.param("index")
+        # regroup NDJSON action/source pairs into the ops shape the
+        # index-service bulk API takes, resolving per-item indices
+        items: List[dict] = []
+        i = 0
+        while i < len(ops):
+            action_line = ops[i]
+            i += 1
+            if len(action_line) != 1:
+                raise IllegalArgumentError(
+                    "Malformed action/metadata line, expected one action")
+            op, meta = next(iter(action_line.items()))
+            if op not in ("index", "create", "update", "delete"):
+                raise IllegalArgumentError(
+                    f"Unknown action [{op}], expected one of "
+                    f"[create, delete, index, update]")
+            entry = {"action": op,
+                     **{k.lstrip("_"): v for k, v in meta.items()
+                        if k in ("_index", "_id", "routing", "_routing",
+                                 "if_seq_no", "if_primary_term")}}
+            entry.setdefault("index", default_index)
+            if entry.get("index") is None:
+                raise IllegalArgumentError("bulk item missing _index")
+            if op != "delete":
+                if i >= len(ops):
+                    raise IllegalArgumentError(
+                        f"bulk [{op}] action missing source line")
+                entry["source"] = ops[i]
+                i += 1
+            items.append(entry)
+
+        # group by concrete index, preserving order within each index;
+        # responses keep the original item order (reference: BulkResponse)
+        by_index: Dict[str, List[int]] = {}
+        for pos, item in enumerate(items):
+            concrete = node.indices.write_index(item["index"])
+            item["index"] = concrete
+            by_index.setdefault(concrete, []).append(pos)
+        responses: List[Optional[dict]] = [None] * len(items)
+        errors = False
+        took = 0
+        for concrete, positions in by_index.items():
+            svc = node.indices.get(concrete)
+            sub_ops = [items[p] for p in positions]
+            res = svc.bulk(sub_ops)
+            took = max(took, res.get("took", 0))
+            errors = errors or res.get("errors", False)
+            for p, item_res in zip(positions, res["items"]):
+                responses[p] = item_res
+        if req.param("refresh") in ("true", "", "wait_for"):
+            for concrete in by_index:
+                node.indices.get(concrete).refresh()
+        return {"took": took, "errors": errors, "items": responses}
+
+    c.register("PUT", "/{index}/_doc/{id}", do_index)
+    c.register("POST", "/{index}/_doc/{id}", do_index)
+    c.register("POST", "/{index}/_doc", do_index)
+    c.register("PUT", "/{index}/_create/{id}", do_create)
+    c.register("POST", "/{index}/_create/{id}", do_create)
+    c.register("GET", "/{index}/_doc/{id}", do_get)
+    c.register("GET", "/{index}/_source/{id}", do_get_source)
+    c.register("DELETE", "/{index}/_doc/{id}", do_delete)
+    c.register("POST", "/{index}/_update/{id}", do_update)
+    c.register("GET", "/_mget", do_mget)
+    c.register("POST", "/_mget", do_mget)
+    c.register("GET", "/{index}/_mget", do_mget)
+    c.register("POST", "/{index}/_mget", do_mget)
+    c.register("POST", "/_bulk", do_bulk)
+    c.register("PUT", "/_bulk", do_bulk)
+    c.register("POST", "/{index}/_bulk", do_bulk)
+    c.register("PUT", "/{index}/_bulk", do_bulk)
+
+
+# ------------------------------------------------------------------- search
+
+def register_search_actions(node, c):
+    def do_search(req):
+        body = req.body if isinstance(req.body, dict) else {}
+        body = dict(body)
+        # URI-search params override/augment the body
+        if req.param("q") is not None:
+            body["query"] = {"query_string": {"query": req.param("q")}}
+        for p in ("from", "size"):
+            if req.param(p) is not None:
+                body[p] = req.int_param(p)
+        if req.param("sort") is not None:
+            body["sort"] = [
+                ({s.split(":")[0]: s.split(":")[1]} if ":" in s else s)
+                for s in req.param("sort").split(",")]
+        if req.param("_source") is not None:
+            v = req.param("_source")
+            body["_source"] = (v.split(",") if "," in v
+                               else (v if v not in ("true", "false")
+                                     else v == "true"))
+        return _run_search(node, req.param("index"), body)
+
+    def do_count(req):
+        body = dict(req.body or {})
+        if req.param("q") is not None:
+            body["query"] = {"query_string": {"query": req.param("q")}}
+        body["size"] = 0
+        body.pop("from", None)
+        body.pop("aggs", None)
+        body.pop("aggregations", None)
+        res = _run_search(node, req.param("index"), body)
+        return {"count": res["hits"]["total"]["value"],
+                "_shards": res["_shards"]}
+
+    def do_msearch(req):
+        lines = _ndjson_lines(req)
+        if len(lines) % 2 != 0:
+            raise IllegalArgumentError(
+                "msearch request must have an even number of lines "
+                "(header, body pairs)")
+        pairs = []
+        for i in range(0, len(lines), 2):
+            header, body = lines[i], lines[i + 1]
+            index_expr = header.get("index", req.param("index"))
+            if isinstance(index_expr, list):
+                index_expr = ",".join(index_expr)
+            pairs.append((index_expr, body))
+
+        # fast path: every search hits the same single unfiltered index →
+        # IndexService.multi_search vmaps same-shaped queries into one
+        # batched device program (capability from the SPMD _msearch work)
+        exprs = {e for e, _ in pairs}
+        if len(exprs) == 1:
+            expr = next(iter(exprs))
+            try:
+                names = node.indices.resolve(expr)
+            except OpenSearchTpuError:
+                names = []
+            if len(names) == 1 and \
+                    node.indices.alias_filter(expr, names[0]) is None:
+                res = node.indices.get(names[0]).multi_search(
+                    [b for _, b in pairs])
+                for r in res["responses"]:
+                    r.setdefault("status", 200)
+                return res
+
+        responses = []
+        took = 0
+        for index_expr, body in pairs:
+            try:
+                res = _run_search(node, index_expr, body)
+                res["status"] = 200
+                took = max(took, res.get("took", 0))
+                responses.append(res)
+            except OpenSearchTpuError as e:
+                responses.append({"error": e.to_xcontent(),
+                                  "status": e.status})
+        return {"took": took, "responses": responses}
+
+    c.register("GET", "/_search", do_search)
+    c.register("POST", "/_search", do_search)
+    c.register("GET", "/{index}/_search", do_search)
+    c.register("POST", "/{index}/_search", do_search)
+    c.register("GET", "/_count", do_count)
+    c.register("POST", "/_count", do_count)
+    c.register("GET", "/{index}/_count", do_count)
+    c.register("POST", "/{index}/_count", do_count)
+    c.register("GET", "/_msearch", do_msearch)
+    c.register("POST", "/_msearch", do_msearch)
+    c.register("GET", "/{index}/_msearch", do_msearch)
+    c.register("POST", "/{index}/_msearch", do_msearch)
+
+
+# ------------------------------------------------------------ index admin
+
+def register_indices_actions(node, c):
+    def do_create_index(req):
+        name = req.param("index")
+        node.indices.create_index(name, req.body)
+        return {"acknowledged": True, "shards_acknowledged": True,
+                "index": name}
+
+    def do_delete_index(req):
+        node.indices.delete_index(req.param("index"))
+        return {"acknowledged": True}
+
+    def index_info(name):
+        svc = node.indices.get(name)
+        return {
+            "aliases": {a: m.to_dict() for a, m in
+                        node.indices.alias_metadata(name).items()},
+            "mappings": svc.mapping_dict(),
+            "settings": {"index": {
+                "number_of_shards": str(svc.num_shards),
+                "number_of_replicas": str(svc.num_replicas),
+                "creation_date": str(svc.creation_date),
+                "uuid": name,
+                "provided_name": name,
+                **{k: v for k, v in svc.settings.items()
+                   if k not in ("number_of_shards", "number_of_replicas")},
+            }},
+        }
+
+    def do_get_index(req):
+        names = node.indices.resolve(req.param("index"),
+                                     allow_no_indices=False)
+        return {n: index_info(n) for n in names}
+
+    def do_index_exists(req):
+        try:
+            names = node.indices.resolve(req.param("index"),
+                                         allow_no_indices=False)
+        except IndexNotFoundError:
+            return 404, ""
+        return (200 if names else 404), ""
+
+    def do_get_mapping(req):
+        names = node.indices.resolve(req.param("index"))
+        return {n: {"mappings": node.indices.get(n).mapping_dict()}
+                for n in names}
+
+    def do_put_mapping(req):
+        for n in node.indices.resolve(req.param("index"),
+                                      allow_no_indices=False):
+            node.indices.get(n).put_mapping(req.body or {})
+        return {"acknowledged": True}
+
+    def do_get_settings(req):
+        names = node.indices.resolve(req.param("index"))
+        return {n: {"settings": index_info(n)["settings"]} for n in names}
+
+    def do_put_settings(req):
+        from opensearch_tpu.indices.service import _normalize_settings
+        updates = _normalize_settings(req.body or {})
+        static = {"number_of_shards", "routing_partition_size",
+                  "number_of_routing_shards"}
+        bad = static & set(updates)
+        if bad:
+            raise IllegalArgumentError(
+                f"Can't update non dynamic settings [{sorted(bad)}] for "
+                f"open indices")
+        for n in node.indices.resolve(req.param("index"),
+                                      allow_no_indices=False):
+            svc = node.indices.get(n)
+            svc.settings.update(updates)
+            if "number_of_replicas" in updates:
+                svc.num_replicas = int(updates["number_of_replicas"])
+        return {"acknowledged": True}
+
+    def do_refresh(req):
+        names = node.indices.resolve(req.param("index"))
+        for n in names:
+            node.indices.get(n).refresh()
+        return {"_shards": _shards_header(node, names)}
+
+    def do_flush(req):
+        names = node.indices.resolve(req.param("index"))
+        for n in names:
+            node.indices.get(n).flush()
+        return {"_shards": _shards_header(node, names)}
+
+    def do_forcemerge(req):
+        names = node.indices.resolve(req.param("index"))
+        for n in names:
+            node.indices.get(n).force_merge()
+        return {"_shards": _shards_header(node, names)}
+
+    def do_stats(req):
+        names = node.indices.resolve(req.param("index"))
+        out_indices = {}
+        total_docs = total_del = 0
+        for n in names:
+            st = node.indices.get(n).stats()
+            total_docs += st["docs"]["count"]
+            total_del += st["docs"]["deleted"]
+            out_indices[n] = {
+                "primaries": {"docs": st["docs"],
+                              "segments": st["segments"]},
+                "total": {"docs": st["docs"], "segments": st["segments"]},
+            }
+        return {
+            "_shards": _shards_header(node, names),
+            "_all": {"primaries": {"docs": {"count": total_docs,
+                                            "deleted": total_del}},
+                     "total": {"docs": {"count": total_docs,
+                                        "deleted": total_del}}},
+            "indices": out_indices,
+        }
+
+    def do_analyze(req):
+        from opensearch_tpu.analysis.registry import get_default_registry
+        body = req.body or {}
+        text = body.get("text")
+        if text is None:
+            raise IllegalArgumentError("text is missing")
+        texts = text if isinstance(text, list) else [text]
+        analyzer = get_default_registry().get(body.get("analyzer", "standard"))
+        tokens = []
+        pos_offset = 0
+        for t in texts:
+            last_pos = 0
+            for term, pos in analyzer.analyze(t):
+                tokens.append({"token": term, "type": "<ALPHANUM>",
+                               "position": pos + pos_offset})
+                last_pos = pos
+            pos_offset += last_pos + 100  # position gap between array items
+        return {"tokens": tokens}
+
+    c.register("PUT", "/{index}", do_create_index)
+    c.register("DELETE", "/{index}", do_delete_index)
+    c.register("GET", "/{index}", do_get_index)
+    c.register("HEAD", "/{index}", do_index_exists)
+    c.register("GET", "/_mapping", do_get_mapping)
+    c.register("GET", "/{index}/_mapping", do_get_mapping)
+    c.register("PUT", "/{index}/_mapping", do_put_mapping)
+    c.register("POST", "/{index}/_mapping", do_put_mapping)
+    c.register("GET", "/_settings", do_get_settings)
+    c.register("GET", "/{index}/_settings", do_get_settings)
+    c.register("PUT", "/{index}/_settings", do_put_settings)
+    c.register("PUT", "/_settings", do_put_settings)
+    c.register("POST", "/_refresh", do_refresh)
+    c.register("GET", "/_refresh", do_refresh)
+    c.register("POST", "/{index}/_refresh", do_refresh)
+    c.register("POST", "/_flush", do_flush)
+    c.register("POST", "/{index}/_flush", do_flush)
+    c.register("POST", "/_forcemerge", do_forcemerge)
+    c.register("POST", "/{index}/_forcemerge", do_forcemerge)
+    c.register("GET", "/_stats", do_stats)
+    c.register("GET", "/{index}/_stats", do_stats)
+    c.register("GET", "/_analyze", do_analyze)
+    c.register("POST", "/_analyze", do_analyze)
+    c.register("GET", "/{index}/_analyze", do_analyze)
+    c.register("POST", "/{index}/_analyze", do_analyze)
+
+
+def _shards_header(node, names):
+    total = sum(node.indices.get(n).num_shards for n in names)
+    return {"total": total, "successful": total, "failed": 0}
+
+
+# ------------------------------------------------------- aliases/templates
+
+def register_alias_template_actions(node, c):
+    def do_update_aliases(req):
+        body = req.body or {}
+        actions = body.get("actions")
+        if not actions:
+            raise IllegalArgumentError("No action specified")
+        node.indices.update_aliases(actions)
+        return {"acknowledged": True}
+
+    def do_put_alias(req):
+        for n in node.indices.resolve(req.param("index"),
+                                      allow_aliases=False,
+                                      allow_no_indices=False):
+            node.indices.put_alias(n, req.param("name"), req.body)
+        return {"acknowledged": True}
+
+    def do_delete_alias(req):
+        node.indices.remove_alias(req.param("index"), req.param("name"))
+        return {"acknowledged": True}
+
+    def do_get_alias(req):
+        name_filter = req.param("name")
+        index_filter = req.param("index")
+        names = node.indices.resolve(index_filter, allow_aliases=True) \
+            if index_filter else list(node.indices.indices)
+        out: Dict[str, dict] = {}
+        import fnmatch as _fn
+        for n in names:
+            aliases = {}
+            for alias, meta in node.indices.alias_metadata(n).items():
+                if name_filter and not any(
+                        _fn.fnmatchcase(alias, p)
+                        for p in name_filter.split(",")):
+                    continue
+                aliases[alias] = meta.to_dict()
+            if aliases or not name_filter:
+                out[n] = {"aliases": aliases}
+        if name_filter and not any(v["aliases"] for v in out.values()):
+            return 404, {"error": f"alias [{name_filter}] missing",
+                         "status": 404}
+        return out
+
+    def do_alias_exists(req):
+        resp = do_get_alias(req)
+        if isinstance(resp, tuple):
+            return 404, ""
+        return 200, ""
+
+    def do_put_template(req, legacy):
+        node.indices.put_template(req.param("name"), req.body or {},
+                                  legacy=legacy)
+        return {"acknowledged": True}
+
+    def do_get_template(req, legacy):
+        store = (node.indices.legacy_templates if legacy
+                 else node.indices.templates)
+        name = req.param("name")
+        if name:
+            import fnmatch as _fn
+            matched = {k: v for k, v in store.items()
+                       if _fn.fnmatchcase(k, name)}
+            if not matched:
+                raise IndexNotFoundError(f"index template [{name}]")
+        else:
+            matched = store
+        if legacy:
+            return {k: v.to_dict() for k, v in matched.items()}
+        return {"index_templates": [{"name": k, "index_template": v.to_dict()}
+                                    for k, v in matched.items()]}
+
+    def do_delete_template(req, legacy):
+        node.indices.delete_template(req.param("name"), legacy=legacy)
+        return {"acknowledged": True}
+
+    def do_put_component(req):
+        node.indices.put_component_template(req.param("name"), req.body or {})
+        return {"acknowledged": True}
+
+    def do_get_component(req):
+        name = req.param("name")
+        store = node.indices.component_templates
+        matched = ({name: store[name]} if name and name in store
+                   else {} if name else store)
+        if name and not matched:
+            raise IndexNotFoundError(f"component template [{name}]")
+        return {"component_templates": [
+            {"name": k, "component_template": v} for k, v in matched.items()]}
+
+    c.register("POST", "/_aliases", do_update_aliases)
+    c.register("PUT", "/{index}/_alias/{name}", do_put_alias)
+    c.register("POST", "/{index}/_alias/{name}", do_put_alias)
+    c.register("PUT", "/{index}/_aliases/{name}", do_put_alias)
+    c.register("DELETE", "/{index}/_alias/{name}", do_delete_alias)
+    c.register("DELETE", "/{index}/_aliases/{name}", do_delete_alias)
+    c.register("GET", "/_alias", do_get_alias)
+    c.register("GET", "/_alias/{name}", do_get_alias)
+    c.register("GET", "/{index}/_alias", do_get_alias)
+    c.register("GET", "/{index}/_alias/{name}", do_get_alias)
+    c.register("HEAD", "/_alias/{name}", do_alias_exists)
+    c.register("PUT", "/_template/{name}",
+               lambda r: do_put_template(r, True))
+    c.register("POST", "/_template/{name}",
+               lambda r: do_put_template(r, True))
+    c.register("GET", "/_template",
+               lambda r: do_get_template(r, True))
+    c.register("GET", "/_template/{name}",
+               lambda r: do_get_template(r, True))
+    c.register("DELETE", "/_template/{name}",
+               lambda r: do_delete_template(r, True))
+    c.register("PUT", "/_index_template/{name}",
+               lambda r: do_put_template(r, False))
+    c.register("POST", "/_index_template/{name}",
+               lambda r: do_put_template(r, False))
+    c.register("GET", "/_index_template",
+               lambda r: do_get_template(r, False))
+    c.register("GET", "/_index_template/{name}",
+               lambda r: do_get_template(r, False))
+    c.register("DELETE", "/_index_template/{name}",
+               lambda r: do_delete_template(r, False))
+    c.register("PUT", "/_component_template/{name}", do_put_component)
+    c.register("GET", "/_component_template", do_get_component)
+    c.register("GET", "/_component_template/{name}", do_get_component)
+
+
+# ------------------------------------------------------------------ cluster
+
+def register_cluster_actions(node, c):
+    def do_root(req):
+        return node.root_info()
+
+    def do_health(req):
+        return node.cluster_health(req.param("index"))
+
+    def do_cluster_settings_get(req):
+        out = dict(node.cluster_settings)
+        if req.bool_param("include_defaults"):
+            out["defaults"] = dict(node.settings)
+        return out
+
+    def do_cluster_settings_put(req):
+        body = req.body or {}
+        for scope in ("persistent", "transient"):
+            updates = body.get(scope) or {}
+            store = node.cluster_settings[scope]
+            for k, v in updates.items():
+                if v is None:
+                    store.pop(k, None)
+                else:
+                    store[k] = v
+        return {"acknowledged": True,
+                "persistent": node.cluster_settings["persistent"],
+                "transient": node.cluster_settings["transient"]}
+
+    def do_cluster_stats(req):
+        total_docs = sum(svc.stats()["docs"]["count"]
+                         for svc in node.indices.indices.values())
+        total_shards = sum(svc.num_shards
+                           for svc in node.indices.indices.values())
+        import jax
+        return {
+            "cluster_name": node.cluster_name,
+            "status": "green",
+            "indices": {
+                "count": len(node.indices.indices),
+                "shards": {"total": total_shards},
+                "docs": {"count": total_docs},
+            },
+            "nodes": {
+                "count": {"total": 1, "data": 1, "cluster_manager": 1},
+                "versions": [node.root_info()["version"]["number"]],
+                "devices": {"count": jax.device_count(),
+                            "platform": jax.devices()[0].platform},
+            },
+        }
+
+    def do_cluster_state(req):
+        return {
+            "cluster_name": node.cluster_name,
+            "cluster_uuid": node.node_id,
+            "metadata": {
+                "indices": {n: {
+                    "state": "open",
+                    "settings": {"index": {
+                        "number_of_shards": str(svc.num_shards),
+                        "number_of_replicas": str(svc.num_replicas)}},
+                    "mappings": svc.mapping_dict(),
+                    "aliases": list(node.indices.alias_metadata(n)),
+                } for n, svc in node.indices.indices.items()},
+                "templates": {k: v.to_dict()
+                              for k, v in node.indices.legacy_templates.items()},
+            },
+        }
+
+    def do_nodes_info(req):
+        import jax
+        return {
+            "_nodes": {"total": 1, "successful": 1, "failed": 0},
+            "cluster_name": node.cluster_name,
+            "nodes": {node.node_id: {
+                "name": node.node_name,
+                "version": node.root_info()["version"]["number"],
+                "roles": ["cluster_manager", "data", "ingest"],
+                "tpu": {"devices": jax.device_count(),
+                        "platform": jax.devices()[0].platform},
+            }},
+        }
+
+    def do_nodes_stats(req):
+        idx_stats = {n: svc.stats()
+                     for n, svc in node.indices.indices.items()}
+        return {
+            "_nodes": {"total": 1, "successful": 1, "failed": 0},
+            "cluster_name": node.cluster_name,
+            "nodes": {node.node_id: {
+                "name": node.node_name,
+                "indices": {
+                    "docs": {"count": sum(s["docs"]["count"]
+                                          for s in idx_stats.values()),
+                             "deleted": sum(s["docs"]["deleted"]
+                                            for s in idx_stats.values())},
+                    "segments": {"count": sum(s["segments"]["count"]
+                                              for s in idx_stats.values())},
+                },
+            }},
+        }
+
+    c.register("GET", "/", do_root)
+    c.register("GET", "/_cluster/health", do_health)
+    c.register("GET", "/_cluster/health/{index}", do_health)
+    c.register("GET", "/_cluster/settings", do_cluster_settings_get)
+    c.register("PUT", "/_cluster/settings", do_cluster_settings_put)
+    c.register("GET", "/_cluster/stats", do_cluster_stats)
+    c.register("GET", "/_cluster/state", do_cluster_state)
+    c.register("GET", "/_nodes", do_nodes_info)
+    c.register("GET", "/_nodes/stats", do_nodes_stats)
+
+
+# --------------------------------------------------------------------- _cat
+
+def _cat_table(req: RestRequest, headers: List[str],
+               rows: List[List[Any]]) -> RestResponse:
+    """Fixed-width text table like the reference's _cat output; ?v adds the
+    header row, ?h=a,b selects columns, format=json renders JSON."""
+    selected = req.param("h")
+    if selected:
+        names = [n.strip() for n in selected.split(",")]
+        idxs = [headers.index(n) for n in names if n in headers]
+        headers = [headers[i] for i in idxs]
+        rows = [[r[i] for i in idxs] for r in rows]
+    if req.param("format") == "json":
+        return RestResponse(200, [dict(zip(headers, map(str, r)))
+                                  for r in rows])
+    str_rows = [[("" if v is None else str(v)) for v in r] for r in rows]
+    display = ([headers] if req.bool_param("v") else []) + str_rows
+    if not display:
+        return RestResponse(200, "", content_type="text/plain")
+    widths = [max(len(r[i]) for r in display)
+              for i in range(len(display[0]))]
+    lines = [" ".join(v.ljust(w) for v, w in zip(r, widths)).rstrip()
+             for r in display]
+    return RestResponse(200, "\n".join(lines) + "\n",
+                        content_type="text/plain")
+
+
+def register_cat_actions(node, c):
+    def cat_indices(req):
+        rows = []
+        names = (node.indices.resolve(req.param("index"))
+                 if req.param("index") else list(node.indices.indices))
+        for n in names:
+            svc = node.indices.get(n)
+            st = svc.stats()
+            rows.append(["green", "open", n, n, svc.num_shards,
+                         svc.num_replicas, st["docs"]["count"],
+                         st["docs"]["deleted"]])
+        return _cat_table(req, ["health", "status", "index", "uuid", "pri",
+                                "rep", "docs.count", "docs.deleted"], rows)
+
+    def cat_health(req):
+        h = node.cluster_health()
+        return _cat_table(req, ["cluster", "status", "node.total",
+                                "node.data", "shards", "pri", "relo", "init",
+                                "unassign"],
+                          [[node.cluster_name, h["status"],
+                            h["number_of_nodes"], h["number_of_data_nodes"],
+                            h["active_shards"], h["active_primary_shards"],
+                            0, 0, 0]])
+
+    def cat_count(req):
+        expr = req.param("index")
+        total = sum(node.indices.get(n).count()
+                    for n in node.indices.resolve(expr))
+        import time as _t
+        now = int(_t.time())
+        return _cat_table(req, ["epoch", "timestamp", "count"],
+                          [[now, _t.strftime("%H:%M:%S", _t.gmtime(now)),
+                            total]])
+
+    def cat_shards(req):
+        rows = []
+        names = (node.indices.resolve(req.param("index"))
+                 if req.param("index") else list(node.indices.indices))
+        for n in names:
+            svc = node.indices.get(n)
+            for shard in svc.shards:
+                st = shard.stats()
+                rows.append([n, shard.shard_id, "p", "STARTED",
+                             st["docs"]["count"], node.node_name])
+        return _cat_table(req, ["index", "shard", "prirep", "state", "docs",
+                                "node"], rows)
+
+    def cat_aliases(req):
+        rows = []
+        for alias, members in node.indices.aliases.items():
+            for idx, meta in members.items():
+                rows.append([alias, idx,
+                             "*" if meta.filter is not None else "-",
+                             meta.index_routing or "-",
+                             meta.search_routing or "-",
+                             str(meta.is_write_index).lower()])
+        return _cat_table(req, ["alias", "index", "filter", "routing.index",
+                                "routing.search", "is_write_index"], rows)
+
+    def cat_templates(req):
+        rows = []
+        for name, t in node.indices.legacy_templates.items():
+            rows.append([name, str(t.index_patterns), t.priority,
+                         t.version or "", ""])
+        for name, t in node.indices.templates.items():
+            rows.append([name, str(t.index_patterns), t.priority,
+                         t.version or "", ""])
+        return _cat_table(req, ["name", "index_patterns", "order", "version",
+                                "composed_of"], rows)
+
+    def cat_nodes(req):
+        return _cat_table(req, ["ip", "node.role", "cluster_manager", "name"],
+                          [["127.0.0.1", "dim", "*", node.node_name]])
+
+    def cat_root(req):
+        paths = ["/_cat/indices", "/_cat/health", "/_cat/count",
+                 "/_cat/shards", "/_cat/aliases", "/_cat/templates",
+                 "/_cat/nodes"]
+        return RestResponse(200, "=^.^=\n" + "\n".join(paths) + "\n",
+                            content_type="text/plain")
+
+    c.register("GET", "/_cat", cat_root)
+    c.register("GET", "/_cat/indices", cat_indices)
+    c.register("GET", "/_cat/indices/{index}", cat_indices)
+    c.register("GET", "/_cat/health", cat_health)
+    c.register("GET", "/_cat/count", cat_count)
+    c.register("GET", "/_cat/count/{index}", cat_count)
+    c.register("GET", "/_cat/shards", cat_shards)
+    c.register("GET", "/_cat/shards/{index}", cat_shards)
+    c.register("GET", "/_cat/aliases", cat_aliases)
+    c.register("GET", "/_cat/templates", cat_templates)
+    c.register("GET", "/_cat/nodes", cat_nodes)
+
+
+def register_all(node):
+    c = node.controller
+    register_cluster_actions(node, c)
+    register_document_actions(node, c)
+    register_search_actions(node, c)
+    register_indices_actions(node, c)
+    register_alias_template_actions(node, c)
+    register_cat_actions(node, c)

@@ -1,0 +1,168 @@
+"""REST dispatch: method+path trie routing onto registered handlers.
+
+Re-design of the reference RestController (rest/RestController.java:239):
+routes are registered as `METHOD /path/{param}/_suffix` patterns; dispatch
+walks a path trie where literal segments beat `{param}` captures, binds the
+captured params, and invokes the handler. Errors are rendered in the
+reference's JSON error contract ({"error": {...}, "status": N}).
+"""
+
+from __future__ import annotations
+
+import json
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+
+
+@dataclass
+class RestRequest:
+    method: str
+    path: str
+    params: Dict[str, str] = field(default_factory=dict)
+    body: Any = None          # parsed JSON (dict/list) or None
+    raw_body: Optional[bytes] = None
+
+    def param(self, name: str, default=None):
+        return self.params.get(name, default)
+
+    def bool_param(self, name: str, default: bool = False) -> bool:
+        """A present-but-blank flag (`?v`, `?include_defaults`) means true,
+        matching the reference's RestRequest.paramAsBoolean."""
+        v = self.params.get(name)
+        if v is None:
+            return default
+        return str(v).lower() not in ("false", "0", "no")
+
+    def int_param(self, name: str, default: int = 0) -> int:
+        v = self.params.get(name)
+        return default if v is None else int(v)
+
+
+@dataclass
+class RestResponse:
+    status: int = 200
+    body: Any = None
+    content_type: str = "application/json"
+
+    def json(self) -> str:
+        if isinstance(self.body, str):
+            return self.body
+        return json.dumps(self.body, default=str)
+
+
+class _TrieNode:
+    __slots__ = ("children", "param_child", "param_name", "handlers")
+
+    def __init__(self):
+        self.children: Dict[str, _TrieNode] = {}
+        self.param_child: Optional[_TrieNode] = None
+        self.param_name: Optional[str] = None
+        self.handlers: Dict[str, Callable] = {}
+
+
+class RestController:
+    def __init__(self):
+        self._root = _TrieNode()
+        self._routes: List[Tuple[str, str]] = []
+
+    # ---------------------------------------------------------- registration
+
+    def register(self, method: str, path: str, handler: Callable):
+        """handler(request) -> dict | RestResponse | (status, dict)."""
+        node = self._root
+        for seg in [s for s in path.split("/") if s]:
+            if seg.startswith("{") and seg.endswith("}"):
+                if node.param_child is None:
+                    node.param_child = _TrieNode()
+                    node.param_name = seg[1:-1]
+                node = node.param_child
+            else:
+                node = node.children.setdefault(seg, _TrieNode())
+        node.handlers[method.upper()] = handler
+        self._routes.append((method.upper(), path))
+
+    def register_many(self, routes):
+        for method, path, handler in routes:
+            self.register(method, path, handler)
+
+    # -------------------------------------------------------------- dispatch
+
+    def _resolve(self, path: str) -> Tuple[Optional[_TrieNode], Dict[str, str]]:
+        segments = [s for s in path.split("/") if s]
+        params: Dict[str, str] = {}
+
+        def walk(node: _TrieNode, i: int) -> Optional[_TrieNode]:
+            if i == len(segments):
+                return node if node.handlers else None
+            seg = segments[i]
+            child = node.children.get(seg)
+            if child is not None:
+                found = walk(child, i + 1)
+                if found is not None:
+                    return found
+            if node.param_child is not None:
+                found = walk(node.param_child, i + 1)
+                if found is not None:
+                    params.setdefault(node.param_name, seg)
+                    return found
+            return None
+
+        found = walk(self._root, 0)
+        return found, params
+
+    def dispatch(self, request: RestRequest) -> RestResponse:
+        try:
+            node, params = self._resolve(request.path)
+            if node is None:
+                return _error_response(
+                    400, "illegal_argument_exception",
+                    f"no handler found for uri [{request.path}] and method "
+                    f"[{request.method}]")
+            handler = node.handlers.get(request.method.upper())
+            if handler is None:
+                if request.method.upper() == "HEAD" and "GET" in node.handlers:
+                    handler = node.handlers["GET"]
+                else:
+                    return _error_response(
+                        405, "method_not_allowed_exception",
+                        f"Incorrect HTTP method for uri [{request.path}] and "
+                        f"method [{request.method}], allowed: "
+                        f"{sorted(node.handlers)}")
+            # path params don't override explicit query params
+            merged = dict(params)
+            merged.update(request.params)
+            request.params = merged
+            result = handler(request)
+            if isinstance(result, RestResponse):
+                return result
+            if isinstance(result, tuple):
+                status, body = result
+                return RestResponse(status=status, body=body)
+            return RestResponse(status=200, body=result)
+        except OpenSearchTpuError as e:
+            return RestResponse(status=e.status, body={
+                "error": {"root_cause": [e.to_xcontent()], **e.to_xcontent()},
+                "status": e.status,
+            })
+        except Exception as e:  # unexpected: 500 with the exception chain
+            return RestResponse(status=500, body={
+                "error": {
+                    "root_cause": [{"type": type(e).__name__,
+                                    "reason": str(e)}],
+                    "type": type(e).__name__,
+                    "reason": str(e),
+                    "stack_trace": traceback.format_exc(),
+                },
+                "status": 500,
+            })
+
+
+def _error_response(status: int, err_type: str, reason: str) -> RestResponse:
+    return RestResponse(status=status, body={
+        "error": {"root_cause": [{"type": err_type, "reason": reason}],
+                  "type": err_type, "reason": reason},
+        "status": status,
+    })

@@ -1,0 +1,108 @@
+"""HTTP front-end: serves a Node's RestController over real sockets.
+
+Re-design of the reference's HTTP layer (http/AbstractHttpServerTransport.java
++ modules/transport-netty4 Netty4HttpServerTransport): a threaded stdlib
+HTTP server is the bind/dispatch boundary; all routing and error rendering
+live in RestController so in-process tests and real HTTP share one path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from opensearch_tpu.node import Node
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    node: Node = None  # set by server factory
+
+    def _do(self, method: str):
+        parsed = urllib.parse.urlsplit(self.path)
+        params = {k: v[-1] for k, v in
+                  urllib.parse.parse_qs(parsed.query,
+                                        keep_blank_values=True).items()}
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else None
+        body = None
+        if raw:
+            try:
+                body = json.loads(raw)
+            except json.JSONDecodeError:
+                body = None
+        resp = self.node.handle(method, parsed.path, params=params,
+                                body=body, raw_body=raw)
+        payload = resp.json().encode("utf-8") \
+            if resp.content_type == "application/json" \
+            else (resp.body or "").encode("utf-8")
+        self.send_response(resp.status)
+        self.send_header("Content-Type", resp.content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        if method != "HEAD":
+            self.wfile.write(payload)
+
+    def do_GET(self):
+        self._do("GET")
+
+    def do_POST(self):
+        self._do("POST")
+
+    def do_PUT(self):
+        self._do("PUT")
+
+    def do_DELETE(self):
+        self._do("DELETE")
+
+    def do_HEAD(self):
+        self._do("HEAD")
+
+    def log_message(self, fmt, *args):  # quiet; the reference logs to file
+        pass
+
+
+class HttpServer:
+    """REST port 9200 analog. start() binds; close() shuts down."""
+
+    def __init__(self, node: Node, host: str = "127.0.0.1", port: int = 9200):
+        handler = type("BoundHandler", (_Handler,), {"node": node})
+        self.server = ThreadingHTTPServer((host, port), handler)
+        self.port = self.server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        name="http-server", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def main():  # pragma: no cover - manual entry point (bin/opensearch analog)
+    import argparse
+    p = argparse.ArgumentParser(description="opensearch-tpu node")
+    p.add_argument("--port", type=int, default=9200)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--data-path", default=None)
+    args = p.parse_args()
+    node = Node(data_path=args.data_path)
+    server = HttpServer(node, host=args.host, port=args.port)
+    server.start()
+    print(f"opensearch-tpu listening on {args.host}:{server.port}")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.close()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

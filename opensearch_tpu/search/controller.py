@@ -25,9 +25,11 @@ from opensearch_tpu.search.executor import (
 
 def execute_search(executors: List, body: Optional[dict],
                    total_shards: Optional[int] = None,
-                   failed_shards: int = 0) -> dict:
+                   failed_shards: int = 0,
+                   extra_filters: Optional[List[Optional[dict]]] = None) -> dict:
     """Run the full query-then-fetch flow over shard executors and render
-    the search response. `executors` are per-shard SearchExecutors."""
+    the search response. `executors` are per-shard SearchExecutors;
+    `extra_filters` (aligned with executors) carry per-index alias filters."""
     body = body or {}
     start = time.monotonic()
     size = int(body.get("size", 10))
@@ -46,7 +48,9 @@ def execute_search(executors: List, body: Optional[dict],
     decoded_partials = []
     total = 0
     for shard_i, ex in enumerate(executors):
-        cands, decoded, shard_total = ex.execute_query_phase(body, k)
+        extra = extra_filters[shard_i] if extra_filters else None
+        cands, decoded, shard_total = ex.execute_query_phase(body, k,
+                                                             extra_filter=extra)
         for c in cands:
             c.shard_i = shard_i
         candidates.extend(cands)

@@ -269,12 +269,18 @@ class SearchExecutor:
         from opensearch_tpu.search.controller import execute_search
         return execute_search([self], body)
 
-    def execute_query_phase(self, body: dict, k: int):
+    def execute_query_phase(self, body: dict, k: int,
+                            extra_filter: Optional[dict] = None):
         """Per-shard query phase (SearchService.executeQueryPhase analog):
         returns (candidates, per-segment decoded agg partials, total hits)
-        for the coordinator to merge. `k` = from+size requested globally."""
+        for the coordinator to merge. `k` = from+size requested globally.
+        `extra_filter` is an alias filter applied as a non-scoring clause
+        (reference: QueryShardContext filter from AliasFilter)."""
         body = body or {}
         node = dsl.parse_query(body.get("query"))
+        if extra_filter is not None:
+            node = dsl.BoolQuery(must=[node],
+                                 filter=[dsl.parse_query(extra_filter)])
         min_score = float(body["min_score"]) if body.get("min_score") is not None \
             else NEG_INF
 

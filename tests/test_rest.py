@@ -1,0 +1,514 @@
+"""REST API contract tests: the YAML-REST-test analog, in-process.
+
+Modeled on the reference's rest-api-spec YAML suites (do/match assertions)
+— each test drives the Node through the same method/path/body surface the
+real HTTP API exposes and asserts on the rendered JSON."""
+
+import json
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node():
+    return Node()
+
+
+def seed(node, index="logs", n=6):
+    node.request("PUT", f"/{index}", {
+        "settings": {"number_of_shards": 2},
+        "mappings": {"properties": {
+            "msg": {"type": "text"},
+            "level": {"type": "keyword"},
+            "code": {"type": "integer"},
+        }},
+    })
+    for i in range(n):
+        node.request("PUT", f"/{index}/_doc/{i}", {
+            "msg": f"error in module {i}" if i % 2 else f"ok module {i}",
+            "level": "error" if i % 2 else "info",
+            "code": i * 100,
+        })
+    node.request("POST", f"/{index}/_refresh")
+
+
+class TestRoot:
+    def test_root_info(self, node):
+        res = node.request("GET", "/")
+        assert res["version"]["distribution"] == "opensearch-tpu"
+        assert res["tagline"].startswith("The OpenSearch-TPU")
+
+    def test_unknown_route_400(self, node):
+        res = node.request("GET", "/_nope_such_endpoint_x/_sub")
+        assert res["_status"] == 400
+        assert "no handler found" in res["error"]["reason"]
+
+    def test_wrong_method_405(self, node):
+        res = node.request("DELETE", "/_cluster/health")
+        assert res["_status"] == 405
+
+
+class TestIndexAdmin:
+    def test_create_get_delete(self, node):
+        res = node.request("PUT", "/idx1", {"settings": {"number_of_shards": 3}})
+        assert res["acknowledged"] is True and res["index"] == "idx1"
+        res = node.request("GET", "/idx1")
+        assert res["idx1"]["settings"]["index"]["number_of_shards"] == "3"
+        assert node.request("HEAD", "/idx1")["_status"] == 200
+        res = node.request("DELETE", "/idx1")
+        assert res["acknowledged"] is True
+        assert node.request("HEAD", "/idx1")["_status"] == 404
+
+    def test_create_duplicate_conflict(self, node):
+        node.request("PUT", "/idx1")
+        res = node.request("PUT", "/idx1")
+        assert res["_status"] == 400
+        assert res["error"]["type"] == "resource_already_exists_exception"
+
+    def test_invalid_name(self, node):
+        res = node.request("PUT", "/Bad*Name")
+        assert res["_status"] == 400
+
+    def test_delete_missing_404(self, node):
+        res = node.request("DELETE", "/ghost")
+        assert res["_status"] == 404
+        assert res["error"]["type"] == "index_not_found_exception"
+
+    def test_mappings_roundtrip(self, node):
+        node.request("PUT", "/idx1", {
+            "mappings": {"properties": {"title": {"type": "text"}}}})
+        node.request("PUT", "/idx1/_mapping",
+                     {"properties": {"views": {"type": "long"}}})
+        res = node.request("GET", "/idx1/_mapping")
+        props = res["idx1"]["mappings"]["properties"]
+        assert props["title"]["type"] == "text"
+        assert props["views"]["type"] == "long"
+
+    def test_settings_dynamic_update(self, node):
+        node.request("PUT", "/idx1")
+        res = node.request("PUT", "/idx1/_settings",
+                           {"index": {"number_of_replicas": 2}})
+        assert res["acknowledged"] is True
+        res = node.request("GET", "/idx1/_settings")
+        assert res["idx1"]["settings"]["index"]["number_of_replicas"] == "2"
+
+    def test_settings_static_rejected(self, node):
+        node.request("PUT", "/idx1")
+        res = node.request("PUT", "/idx1/_settings",
+                           {"index": {"number_of_shards": 5}})
+        assert res["_status"] == 400
+
+    def test_stats(self, node):
+        seed(node)
+        res = node.request("GET", "/logs/_stats")
+        assert res["_all"]["primaries"]["docs"]["count"] == 6
+        assert "logs" in res["indices"]
+
+    def test_analyze(self, node):
+        res = node.request("POST", "/_analyze",
+                           {"text": "The Quick Fox", "analyzer": "standard"})
+        assert [t["token"] for t in res["tokens"]] == ["the", "quick", "fox"]
+
+
+class TestDocuments:
+    def test_crud_lifecycle(self, node):
+        node.request("PUT", "/idx1")
+        res = node.request("PUT", "/idx1/_doc/1", {"a": 1})
+        assert res["_status"] == 201 and res["result"] == "created"
+        res = node.request("PUT", "/idx1/_doc/1", {"a": 2})
+        assert res["_status"] == 200 and res["result"] == "updated"
+        assert res["_version"] == 2
+        res = node.request("GET", "/idx1/_doc/1")
+        assert res["found"] is True and res["_source"] == {"a": 2}
+        res = node.request("GET", "/idx1/_source/1")
+        assert res == {"a": 2, "_status": 200}
+        res = node.request("DELETE", "/idx1/_doc/1")
+        assert res["result"] == "deleted"
+        assert node.request("GET", "/idx1/_doc/1")["_status"] == 404
+
+    def test_create_op_conflict(self, node):
+        node.request("PUT", "/idx1")
+        node.request("PUT", "/idx1/_create/1", {"a": 1})
+        res = node.request("PUT", "/idx1/_create/1", {"a": 2})
+        assert res["_status"] == 409
+
+    def test_auto_id(self, node):
+        node.request("PUT", "/idx1")
+        res = node.request("POST", "/idx1/_doc", {"a": 1})
+        assert res["_status"] == 201
+        assert len(res["_id"]) >= 10
+
+    def test_optimistic_concurrency(self, node):
+        node.request("PUT", "/idx1")
+        res = node.request("PUT", "/idx1/_doc/1", {"a": 1})
+        seq, term = res["_seq_no"], res["_primary_term"]
+        ok = node.request("PUT", "/idx1/_doc/1", {"a": 2},
+                          if_seq_no=seq, if_primary_term=term)
+        assert ok["_status"] == 200
+        stale = node.request("PUT", "/idx1/_doc/1", {"a": 3},
+                             if_seq_no=seq, if_primary_term=term)
+        assert stale["_status"] == 409
+
+    def test_update_partial_doc(self, node):
+        node.request("PUT", "/idx1")
+        node.request("PUT", "/idx1/_doc/1", {"a": 1, "b": {"x": 1}})
+        res = node.request("POST", "/idx1/_update/1",
+                           {"doc": {"b": {"y": 2}}})
+        assert res["result"] == "updated"
+        src = node.request("GET", "/idx1/_doc/1")["_source"]
+        assert src == {"a": 1, "b": {"x": 1, "y": 2}}
+
+    def test_update_cas_params(self, node):
+        node.request("PUT", "/idx1")
+        node.request("PUT", "/idx1/_doc/1", {"a": 1})
+        ok = node.request("POST", "/idx1/_update/1", {"doc": {"a": 2}},
+                          if_seq_no=0, if_primary_term=1)
+        assert ok["_status"] == 200 and ok["result"] == "updated"
+        stale = node.request("POST", "/idx1/_update/1", {"doc": {"a": 3}},
+                             if_seq_no=0, if_primary_term=1)
+        assert stale["_status"] == 409
+
+    def test_bulk_cas_conflict(self, node):
+        node.request("PUT", "/idx1")
+        node.request("PUT", "/idx1/_doc/1", {"a": 1})
+        node.request("PUT", "/idx1/_doc/1", {"a": 2})  # seq_no now 1
+        payload = "\n".join([
+            json.dumps({"index": {"_index": "idx1", "_id": "1",
+                                  "if_seq_no": 0, "if_primary_term": 1}}),
+            json.dumps({"a": 99}),
+        ]) + "\n"
+        res = node.request("POST", "/_bulk", payload)
+        assert res["errors"] is True
+        assert res["items"][0]["index"]["status"] == 409
+        assert node.request("GET", "/idx1/_doc/1")["_source"] == {"a": 2}
+
+    def test_mget(self, node):
+        seed(node)
+        res = node.request("POST", "/logs/_mget", {"ids": ["0", "1", "99"]})
+        found = [d["found"] for d in res["docs"]]
+        assert found == [True, True, False]
+
+    def test_bulk_ndjson(self, node):
+        node.request("PUT", "/idx1")
+        payload = "\n".join([
+            json.dumps({"index": {"_index": "idx1", "_id": "1"}}),
+            json.dumps({"f": 1}),
+            json.dumps({"create": {"_index": "idx1", "_id": "2"}}),
+            json.dumps({"f": 2}),
+            json.dumps({"update": {"_index": "idx1", "_id": "1"}}),
+            json.dumps({"doc": {"g": 9}}),
+            json.dumps({"delete": {"_index": "idx1", "_id": "2"}}),
+        ]) + "\n"
+        res = node.request("POST", "/_bulk", payload, refresh="true")
+        assert res["errors"] is False
+        ops = [next(iter(item)) for item in res["items"]]
+        assert ops == ["index", "create", "update", "delete"]
+        src = node.request("GET", "/idx1/_doc/1")["_source"]
+        assert src == {"f": 1, "g": 9}
+
+    def test_bulk_partial_failure(self, node):
+        node.request("PUT", "/idx1")
+        payload = "\n".join([
+            json.dumps({"create": {"_index": "idx1", "_id": "1"}}),
+            json.dumps({"f": 1}),
+            json.dumps({"create": {"_index": "idx1", "_id": "1"}}),
+            json.dumps({"f": 2}),
+        ]) + "\n"
+        res = node.request("POST", "/_bulk", payload)
+        assert res["errors"] is True
+        assert res["items"][0]["create"]["status"] == 201
+        assert res["items"][1]["create"]["status"] == 409
+
+
+class TestSearchRest:
+    def test_match_search(self, node):
+        seed(node)
+        res = node.request("POST", "/logs/_search",
+                           {"query": {"match": {"msg": "error"}}})
+        assert res["hits"]["total"]["value"] == 3
+        assert all("error" in h["_source"]["msg"]
+                   for h in res["hits"]["hits"])
+
+    def test_uri_search(self, node):
+        seed(node)
+        res = node.request("GET", "/logs/_search", q="msg:error", size=2)
+        assert res["hits"]["total"]["value"] == 3
+        assert len(res["hits"]["hits"]) == 2
+
+    def test_sort_param(self, node):
+        seed(node)
+        res = node.request("GET", "/logs/_search", sort="code:desc")
+        codes = [h["_source"]["code"] for h in res["hits"]["hits"]]
+        assert codes == sorted(codes, reverse=True)
+
+    def test_search_all_indices(self, node):
+        seed(node, "logs-a", 2)
+        seed(node, "logs-b", 3)
+        res = node.request("POST", "/_search", {"query": {"match_all": {}}})
+        assert res["hits"]["total"]["value"] == 5
+        res = node.request("POST", "/logs-*/_search",
+                           {"query": {"match_all": {}}})
+        assert res["hits"]["total"]["value"] == 5
+        indices = {h["_index"] for h in res["hits"]["hits"]}
+        assert indices == {"logs-a", "logs-b"}
+
+    def test_count(self, node):
+        seed(node)
+        res = node.request("GET", "/logs/_count",
+                           {"query": {"term": {"level": "error"}}})
+        assert res["count"] == 3
+
+    def test_msearch(self, node):
+        seed(node)
+        payload = "\n".join([
+            json.dumps({"index": "logs"}),
+            json.dumps({"query": {"term": {"level": "info"}}}),
+            json.dumps({}),
+            json.dumps({"query": {"match_all": {}}, "size": 1}),
+        ]) + "\n"
+        res = node.request("POST", "/_msearch", payload)
+        assert len(res["responses"]) == 2
+        assert res["responses"][0]["hits"]["total"]["value"] == 3
+        assert res["responses"][1]["hits"]["total"]["value"] == 6
+
+    def test_aggs_via_rest(self, node):
+        seed(node)
+        res = node.request("POST", "/logs/_search", {
+            "size": 0,
+            "aggs": {"levels": {"terms": {"field": "level"}},
+                     "max_code": {"max": {"field": "code"}}},
+        })
+        buckets = {b["key"]: b["doc_count"]
+                   for b in res["aggregations"]["levels"]["buckets"]}
+        assert buckets == {"info": 3, "error": 3}
+        assert res["aggregations"]["max_code"]["value"] == 500.0
+
+    def test_search_missing_index_404(self, node):
+        res = node.request("POST", "/ghost/_search", {})
+        assert res["_status"] == 404
+
+
+class TestAliases:
+    def test_alias_add_search_remove(self, node):
+        seed(node)
+        res = node.request("PUT", "/logs/_alias/l-alias")
+        assert res["acknowledged"] is True
+        res = node.request("POST", "/l-alias/_search",
+                           {"query": {"match_all": {}}})
+        assert res["hits"]["total"]["value"] == 6
+        res = node.request("GET", "/_alias/l-alias")
+        assert "l-alias" in res["logs"]["aliases"]
+        node.request("DELETE", "/logs/_alias/l-alias")
+        assert node.request("POST", "/l-alias/_search", {})["_status"] == 404
+
+    def test_filtered_alias(self, node):
+        seed(node)
+        node.request("POST", "/_aliases", {"actions": [
+            {"add": {"index": "logs", "alias": "errors-only",
+                     "filter": {"term": {"level": "error"}}}},
+        ]})
+        res = node.request("POST", "/errors-only/_search",
+                           {"query": {"match_all": {}}})
+        assert res["hits"]["total"]["value"] == 3
+        assert all(h["_source"]["level"] == "error"
+                   for h in res["hits"]["hits"])
+
+    def test_write_alias(self, node):
+        node.request("PUT", "/w1")
+        node.request("PUT", "/w2")
+        node.request("POST", "/_aliases", {"actions": [
+            {"add": {"index": "w1", "alias": "w", "is_write_index": True}},
+            {"add": {"index": "w2", "alias": "w"}},
+        ]})
+        res = node.request("PUT", "/w/_doc/1", {"a": 1})
+        assert res["_index"] == "w1"
+        # search through the alias sees both indices
+        node.request("POST", "/_refresh")
+        res = node.request("POST", "/w/_search", {})
+        assert res["_shards"]["total"] == 2
+
+    def test_filtered_alias_nullified_by_unfiltered_route(self, node):
+        # reference AliasFilter rule: any unfiltered route to the concrete
+        # index disables the alias filter for that index
+        seed(node)
+        node.request("POST", "/_aliases", {"actions": [
+            {"add": {"index": "logs", "alias": "errs",
+                     "filter": {"term": {"level": "error"}}}}]})
+        res = node.request("POST", "/logs,errs/_search",
+                           {"query": {"match_all": {}}})
+        assert res["hits"]["total"]["value"] == 6  # direct name wins
+        res = node.request("POST", "/errs/_search",
+                           {"query": {"match_all": {}}})
+        assert res["hits"]["total"]["value"] == 3  # only the alias route
+
+    def test_filtered_alias_applies_through_wildcard(self, node):
+        seed(node)
+        node.request("POST", "/_aliases", {"actions": [
+            {"add": {"index": "logs", "alias": "errs-w",
+                     "filter": {"term": {"level": "error"}}}}]})
+        res = node.request("POST", "/errs-*/_search",
+                           {"query": {"match_all": {}}})
+        assert res["hits"]["total"]["value"] == 3
+
+    def test_aliases_batch_remove_index(self, node):
+        node.request("PUT", "/tmp-1")
+        res = node.request("POST", "/_aliases", {"actions": [
+            {"remove_index": {"index": "tmp-1"}}]})
+        assert res["acknowledged"] is True
+        assert node.request("HEAD", "/tmp-1")["_status"] == 404
+
+
+class TestTemplates:
+    def test_legacy_template_applies(self, node):
+        node.request("PUT", "/_template/logs-t", {
+            "index_patterns": ["tlogs-*"],
+            "settings": {"number_of_shards": 2},
+            "mappings": {"properties": {"level": {"type": "keyword"}}},
+            "aliases": {"all-tlogs": {}},
+        })
+        node.request("PUT", "/tlogs-2026")
+        info = node.request("GET", "/tlogs-2026")["tlogs-2026"]
+        assert info["settings"]["index"]["number_of_shards"] == "2"
+        assert info["mappings"]["properties"]["level"]["type"] == "keyword"
+        assert "all-tlogs" in info["aliases"]
+
+    def test_composable_template_priority(self, node):
+        node.request("PUT", "/_index_template/low", {
+            "index_patterns": ["ct-*"], "priority": 1,
+            "template": {"settings": {"number_of_shards": 1}}})
+        node.request("PUT", "/_index_template/high", {
+            "index_patterns": ["ct-*"], "priority": 10,
+            "template": {"settings": {"number_of_shards": 4}}})
+        node.request("PUT", "/ct-x")
+        info = node.request("GET", "/ct-x")["ct-x"]
+        assert info["settings"]["index"]["number_of_shards"] == "4"
+
+    def test_component_template_compose(self, node):
+        node.request("PUT", "/_component_template/base-map", {
+            "template": {"mappings": {"properties":
+                                      {"host": {"type": "keyword"}}}}})
+        node.request("PUT", "/_index_template/with-comp", {
+            "index_patterns": ["comp-*"], "composed_of": ["base-map"],
+            "template": {"settings": {"number_of_shards": 2}}})
+        node.request("PUT", "/comp-1")
+        info = node.request("GET", "/comp-1")["comp-1"]
+        assert info["mappings"]["properties"]["host"]["type"] == "keyword"
+        assert info["settings"]["index"]["number_of_shards"] == "2"
+
+    def test_get_delete_template(self, node):
+        node.request("PUT", "/_template/t1", {"index_patterns": ["t1-*"]})
+        assert "t1" in node.request("GET", "/_template/t1")
+        node.request("DELETE", "/_template/t1")
+        assert node.request("GET", "/_template/t1")["_status"] == 404
+
+
+class TestCluster:
+    def test_health(self, node):
+        seed(node)
+        res = node.request("GET", "/_cluster/health")
+        assert res["status"] == "green"
+        assert res["active_primary_shards"] == 2
+
+    def test_cluster_settings_roundtrip(self, node):
+        res = node.request("PUT", "/_cluster/settings", {
+            "persistent": {"search.default_keep_alive": "10m"}})
+        assert res["persistent"]["search.default_keep_alive"] == "10m"
+        res = node.request("GET", "/_cluster/settings")
+        assert res["persistent"]["search.default_keep_alive"] == "10m"
+
+    def test_cluster_stats(self, node):
+        seed(node)
+        res = node.request("GET", "/_cluster/stats")
+        assert res["indices"]["count"] == 1
+        assert res["indices"]["docs"]["count"] == 6
+
+    def test_nodes_stats(self, node):
+        seed(node)
+        res = node.request("GET", "/_nodes/stats")
+        node_stats = next(iter(res["nodes"].values()))
+        assert node_stats["indices"]["docs"]["count"] == 6
+
+
+class TestCat:
+    def test_cat_indices(self, node):
+        seed(node)
+        res = node.handle("GET", "/_cat/indices", params={"v": "true"})
+        assert res.content_type == "text/plain"
+        lines = res.body.strip().split("\n")
+        assert lines[0].split()[:3] == ["health", "status", "index"]
+        assert any("logs" in line for line in lines[1:])
+
+    def test_cat_blank_v_flag_shows_header(self, node):
+        # curl's `?v` arrives as a blank-valued param and must mean true
+        seed(node)
+        res = node.handle("GET", "/_cat/indices", params={"v": ""})
+        assert res.body.split("\n")[0].split()[:2] == ["health", "status"]
+
+    def test_cat_json_format(self, node):
+        seed(node)
+        res = node.handle("GET", "/_cat/indices",
+                          params={"format": "json"})
+        assert isinstance(res.body, list)
+        assert res.body[0]["index"] == "logs"
+        assert res.body[0]["docs.count"] == "6"
+
+    def test_cat_column_selection(self, node):
+        seed(node)
+        res = node.handle("GET", "/_cat/indices",
+                          params={"h": "index,docs.count"})
+        assert res.body.strip().split() == ["logs", "6"]
+
+    def test_cat_health_count_shards(self, node):
+        seed(node)
+        assert "green" in node.handle("GET", "/_cat/health").body
+        assert node.handle("GET", "/_cat/count").body.strip().endswith("6")
+        shards = node.handle("GET", "/_cat/shards").body
+        assert shards.count("logs") == 2  # two shards
+
+
+class TestHttpSocket:
+    def test_real_http_roundtrip(self, node):
+        import urllib.request
+        from opensearch_tpu.rest.http import HttpServer
+        server = HttpServer(node, port=0).start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            with urllib.request.urlopen(base + "/") as r:
+                info = json.loads(r.read())
+            assert info["version"]["distribution"] == "opensearch-tpu"
+
+            req = urllib.request.Request(
+                base + "/docs", method="PUT",
+                data=json.dumps({"mappings": {"properties": {
+                    "t": {"type": "text"}}}}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as r:
+                assert json.loads(r.read())["acknowledged"] is True
+
+            req = urllib.request.Request(
+                base + "/docs/_doc/1?refresh=true", method="PUT",
+                data=json.dumps({"t": "hello tpu world"}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as r:
+                assert r.status == 201
+
+            req = urllib.request.Request(
+                base + "/docs/_search", method="POST",
+                data=json.dumps({"query": {"match": {"t": "tpu"}}}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as r:
+                hits = json.loads(r.read())["hits"]
+            assert hits["total"]["value"] == 1
+
+            # error path renders the error contract over HTTP too
+            try:
+                urllib.request.urlopen(base + "/ghost/_doc/1")
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+                assert json.loads(e.read())["error"]["type"] == \
+                    "index_not_found_exception"
+        finally:
+            server.close()
