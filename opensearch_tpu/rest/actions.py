@@ -51,7 +51,9 @@ def _search_targets(node, index_expr: Optional[str]):
 def _run_search(node, index_expr: Optional[str], body: Optional[dict]) -> dict:
     from opensearch_tpu.search.controller import execute_search
     executors, filters = _search_targets(node, index_expr)
-    return execute_search(executors, body, extra_filters=filters)
+    res = execute_search(executors, body, extra_filters=filters)
+    res.pop("_page_cursor", None)
+    return res
 
 
 # ---------------------------------------------------------------- documents
@@ -219,6 +221,10 @@ def register_document_actions(node, c):
 # ------------------------------------------------------------------- search
 
 def register_search_actions(node, c):
+    from opensearch_tpu.search.scroll import (
+        continue_scroll, create_pit, delete_pits, delete_scrolls,
+        search_with_pit, start_scroll)
+
     def do_search(req):
         body = req.body if isinstance(req.body, dict) else {}
         body = dict(body)
@@ -237,7 +243,45 @@ def register_search_actions(node, c):
             body["_source"] = (v.split(",") if "," in v
                                else (v if v not in ("true", "false")
                                      else v == "true"))
+        if req.param("scroll"):
+            return start_scroll(node, req.param("index"), body,
+                                req.param("scroll"))
+        if isinstance(body.get("pit"), dict):
+            return search_with_pit(node, body)
         return _run_search(node, req.param("index"), body)
+
+    def do_scroll(req):
+        body = req.body or {}
+        scroll_id = body.get("scroll_id", req.param("scroll_id"))
+        if not scroll_id:
+            raise IllegalArgumentError("scroll_id is missing")
+        return continue_scroll(node, scroll_id, body.get("scroll",
+                                                         req.param("scroll")))
+
+    def do_delete_scroll(req):
+        body = req.body or {}
+        ids = body.get("scroll_id", req.param("scroll_id"))
+        if ids == "_all" or req.path.endswith("/_all"):
+            ids = None
+        elif isinstance(ids, str):
+            ids = [ids]
+        return delete_scrolls(node, ids)
+
+    def do_create_pit(req):
+        keep_alive = req.param("keep_alive")
+        if not keep_alive:
+            raise IllegalArgumentError("[keep_alive] is required")
+        return create_pit(node, req.param("index"), keep_alive)
+
+    def do_delete_pit(req):
+        body = req.body or {}
+        ids = body.get("pit_id")
+        if isinstance(ids, str):
+            ids = [ids]
+        return delete_pits(node, ids)
+
+    def do_delete_all_pits(req):
+        return delete_pits(node, None)
 
     def do_count(req):
         body = dict(req.body or {})
@@ -308,6 +352,15 @@ def register_search_actions(node, c):
     c.register("POST", "/_msearch", do_msearch)
     c.register("GET", "/{index}/_msearch", do_msearch)
     c.register("POST", "/{index}/_msearch", do_msearch)
+    c.register("GET", "/_search/scroll", do_scroll)
+    c.register("POST", "/_search/scroll", do_scroll)
+    c.register("POST", "/_search/scroll/{scroll_id}", do_scroll)
+    c.register("DELETE", "/_search/scroll", do_delete_scroll)
+    c.register("DELETE", "/_search/scroll/{scroll_id}", do_delete_scroll)
+    c.register("DELETE", "/_search/scroll/_all", do_delete_scroll)
+    c.register("POST", "/{index}/_search/point_in_time", do_create_pit)
+    c.register("DELETE", "/_search/point_in_time", do_delete_pit)
+    c.register("DELETE", "/_search/point_in_time/_all", do_delete_all_pits)
 
 
 # ------------------------------------------------------------ index admin

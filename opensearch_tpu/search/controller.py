@@ -8,28 +8,156 @@ only for the global top hits. Here each shard executes its jitted query phase
 (device work across shards overlaps because jax dispatch is async), and the
 host merges candidates with the reference's exact tie-break
 (sort keys, then shard/segment/doc order) and reduces agg partials once.
+
+Also implemented here (reference analogs in parentheses):
+  - search_after / internal scroll cursors (SearchAfterBuilder,
+    scroll keep-alive contexts) with a host-driven k-doubling retry when the
+    cursor reaches past the device top-k window;
+  - track_total_hits true/false/threshold (TotalHitCountCollector);
+  - field collapse (CollapsingTopDocsCollector);
+  - rescore (QueryRescorer) re-ranking the top window with a second query;
+  - fetch sub-phases per page hit (FetchPhase.java:106 → highlight, explain,
+    docvalue_fields in search/fetch.py).
 """
 
 from __future__ import annotations
 
 import time
-from typing import List, Optional
+from typing import Any, List, Optional, Tuple
 
 from opensearch_tpu.common.errors import IllegalArgumentError
+from opensearch_tpu.search import dsl
 from opensearch_tpu.search.aggs.parse import PIPELINE_TYPES, parse_aggs
 from opensearch_tpu.search.aggs.pipeline import apply_pipelines
 from opensearch_tpu.search.aggs.reduce import reduce_aggs
 from opensearch_tpu.search.executor import (
-    _compare_candidates, _parse_sort)
+    _compare_candidates, _parse_sort, _sort_value)
+
+
+def _cmp_values(a: Any, b: Any, order: str) -> int:
+    """Compare two sort values in page order (-1: a first)."""
+    if a is None and b is None:
+        return 0
+    if a is None:
+        return 1
+    if b is None:
+        return -1
+    try:
+        lt = a < b
+        gt = b < a
+    except TypeError:
+        a, b = str(a), str(b)
+        lt, gt = a < b, b < a
+    if not lt and not gt:
+        return 0
+    if order == "desc":
+        return -1 if gt else 1
+    return -1 if lt else 1
+
+
+def _after_cursor(candidates, sort_specs, after_values,
+                  tiebreak: Optional[Tuple[int, int, int]] = None):
+    """Drop candidates at or before the cursor position. `after_values`
+    aligns with sort_specs; `tiebreak` is the internal (shard, seg, ord) of
+    the last returned hit for fully-tied scroll continuation."""
+    if len(after_values) != len(sort_specs):
+        raise IllegalArgumentError(
+            f"search_after has {len(after_values)} value(s) but sort has "
+            f"{len(sort_specs)} field(s)")
+    out = []
+    for c in candidates:
+        rel = 0
+        for i, ((field, order), av) in enumerate(zip(sort_specs,
+                                                     after_values)):
+            cv = c.score if field == "_score" else c.sort_values[i]
+            rel = _cmp_values(cv, av, order)
+            if rel != 0:
+                break
+        if rel > 0:
+            out.append(c)
+        elif rel == 0 and tiebreak is not None and \
+                (c.shard_i, c.seg_i, c.ord) > tiebreak:
+            out.append(c)
+    return out
+
+
+def _apply_collapse(candidates, executors, collapse_field: str):
+    """Keep the best candidate per collapse-field value (first in sort
+    order); None-valued docs collapse into one group per the reference's
+    CollapsingTopDocsCollector null policy (each null is its own group)."""
+    seen = set()
+    out = []
+    for c in candidates:
+        ex = executors[c.shard_i]
+        seg = ex.reader.segments[c.seg_i]
+        val = _sort_value(seg, collapse_field, "asc", c.ord)
+        if val is None:
+            out.append(c)
+            continue
+        if val in seen:
+            continue
+        seen.add(val)
+        out.append(c)
+        c.collapse_value = val
+    return out
+
+
+def _apply_rescore(executors, rescore_body, candidates, extra_filters):
+    """QueryRescorer: re-rank the top window_size hits by combining the
+    original score with a secondary query's score. Runs the rescore query
+    as its own device pass per shard (k capped — see below) and combines
+    host-side."""
+    entries = rescore_body if isinstance(rescore_body, list) else [rescore_body]
+    for entry in entries:
+        window = int(entry.get("window_size", 10))
+        spec = entry.get("query")
+        if not spec or "rescore_query" not in spec:
+            raise IllegalArgumentError("rescore malformed: missing rescore_query")
+        qw = float(spec.get("query_weight", 1.0))
+        rqw = float(spec.get("rescore_query_weight", 1.0))
+        mode = spec.get("score_mode", "total")
+        window_cands = candidates[:window]
+        shard_ids = {c.shard_i for c in window_cands}
+        # device pass must cover every window doc: k scales with the window
+        # (docs the rescore query doesn't match at all contribute 0)
+        k = max(512, window * 8)
+        score_map = {}
+        for shard_i in shard_ids:
+            extra = extra_filters[shard_i] if extra_filters else None
+            cands, _, _ = executors[shard_i].execute_query_phase(
+                {"query": spec["rescore_query"]}, k, extra_filter=extra)
+            for c in cands:
+                score_map[(shard_i, c.seg_i, c.ord)] = c.score
+        for c in window_cands:
+            rs = score_map.get((c.shard_i, c.seg_i, c.ord))
+            if rs is None:
+                c.score = c.score * qw
+                continue
+            combined = {
+                "total": c.score * qw + rs * rqw,
+                "multiply": c.score * qw * (rs * rqw),
+                "avg": (c.score * qw + rs * rqw) / 2.0,
+                "max": max(c.score * qw, rs * rqw),
+                "min": min(c.score * qw, rs * rqw),
+            }.get(mode)
+            if combined is None:
+                raise IllegalArgumentError(
+                    f"[rescore] illegal score_mode [{mode}]")
+            c.score = combined
+        window_cands.sort(key=lambda c: (-c.score, c.shard_i, c.seg_i, c.ord))
+        candidates[:window] = window_cands
+    return candidates
 
 
 def execute_search(executors: List, body: Optional[dict],
                    total_shards: Optional[int] = None,
                    failed_shards: int = 0,
-                   extra_filters: Optional[List[Optional[dict]]] = None) -> dict:
+                   extra_filters: Optional[List[Optional[dict]]] = None,
+                   cursor_tiebreak: Optional[Tuple[int, int, int]] = None) -> dict:
     """Run the full query-then-fetch flow over shard executors and render
     the search response. `executors` are per-shard SearchExecutors;
-    `extra_filters` (aligned with executors) carry per-index alias filters."""
+    `extra_filters` (aligned with executors) carry per-index alias filters;
+    `cursor_tiebreak` is the internal scroll cursor position."""
     body = body or {}
     start = time.monotonic()
     size = int(body.get("size", 10))
@@ -42,22 +170,56 @@ def execute_search(executors: List, body: Optional[dict],
     wants_score = score_sorted or any(f == "_score" for f, _ in sort_specs) \
         or bool(body.get("track_scores", False))
     agg_nodes = parse_aggs(body.get("aggs") or body.get("aggregations"))
+    after_values = body.get("search_after")
+    if after_values is not None and from_ > 0:
+        raise IllegalArgumentError(
+            "`from` parameter must be set to 0 when `search_after` is used")
+    collapse_field = (body.get("collapse") or {}).get("field")
+    track_total = body.get("track_total_hits", True)
 
     k = max(from_ + size, 10)
-    candidates = []
-    decoded_partials = []
-    total = 0
-    for shard_i, ex in enumerate(executors):
-        extra = extra_filters[shard_i] if extra_filters else None
-        cands, decoded, shard_total = ex.execute_query_phase(body, k,
-                                                             extra_filter=extra)
-        for c in cands:
-            c.shard_i = shard_i
-        candidates.extend(cands)
-        decoded_partials.extend(decoded)
-        total += shard_total
+    max_k = 1 << 16
 
-    candidates.sort(key=_compare_candidates(sort_specs))
+    def run_query_phase(k_eff):
+        candidates = []
+        decoded_partials = []
+        total = 0
+        for shard_i, ex in enumerate(executors):
+            extra = extra_filters[shard_i] if extra_filters else None
+            cands, decoded, shard_total = ex.execute_query_phase(
+                body, k_eff, extra_filter=extra)
+            for c in cands:
+                c.shard_i = shard_i
+            candidates.extend(cands)
+            decoded_partials.extend(decoded)
+            total += shard_total
+        candidates.sort(key=_compare_candidates(sort_specs))
+        return candidates, decoded_partials, total
+
+    candidates, decoded_partials, total = run_query_phase(k)
+    raw_count = len(candidates)
+    if after_values is not None:
+        cursor_values = after_values
+        filtered = _after_cursor(candidates, sort_specs, cursor_values,
+                                 tiebreak=cursor_tiebreak)
+        # the cursor may reach past the device top-k window: grow k until
+        # the page is full or every match is on host (reference avoids this
+        # by filtering inside the collector; here the host drives a retry)
+        while len(filtered) < from_ + size and raw_count >= k and k < max_k \
+                and k < total:
+            k = min(max_k, k * 4)
+            candidates, decoded_partials, total = run_query_phase(k)
+            raw_count = len(candidates)
+            filtered = _after_cursor(candidates, sort_specs, cursor_values,
+                                     tiebreak=cursor_tiebreak)
+        candidates = filtered
+
+    if body.get("rescore") and score_sorted:
+        candidates = _apply_rescore(executors, body["rescore"], candidates,
+                                    extra_filters)
+    if collapse_field:
+        candidates = _apply_collapse(candidates, executors, collapse_field)
+
     page = candidates[from_:from_ + size]
 
     max_score = None
@@ -66,30 +228,78 @@ def execute_search(executors: List, body: Optional[dict],
             if max_score is None or c.score > max_score:
                 max_score = c.score
 
+    query_node = dsl.parse_query(body.get("query"))
     hits = []
     for c in page:
         ex = executors[c.shard_i]
-        hit = ex._hit_dict(c.seg_i, c.ord,
-                           c.score if wants_score else None, body)
-        if not score_sorted:
-            hit["sort"] = c.sort_values
+        hit = _build_hit(ex, c, body, c.score if wants_score else None,
+                         query_node, sort_specs, score_sorted)
         hits.append(hit)
 
     n_shards = total_shards if total_shards is not None else len(executors)
+    hits_block: dict = {"max_score": max_score, "hits": hits}
+    if track_total is False:
+        pass  # total omitted entirely
+    elif track_total is True:
+        hits_block = {"total": {"value": total, "relation": "eq"},
+                      **hits_block}
+    else:
+        threshold = int(track_total)
+        if total > threshold:
+            hits_block = {"total": {"value": threshold, "relation": "gte"},
+                          **hits_block}
+        else:
+            hits_block = {"total": {"value": total, "relation": "eq"},
+                          **hits_block}
+
     resp = {
         "took": int((time.monotonic() - start) * 1000),
         "timed_out": False,
         "_shards": {"total": n_shards,
                     "successful": n_shards - failed_shards,
                     "skipped": 0, "failed": failed_shards},
-        "hits": {
-            "total": {"value": total, "relation": "eq"},
-            "max_score": max_score,
-            "hits": hits,
-        },
+        "hits": hits_block,
     }
     if agg_nodes:
         aggregations = reduce_aggs(decoded_partials)
         apply_pipelines(agg_nodes, aggregations)
         resp["aggregations"] = aggregations
+    if page:
+        last = page[-1]
+        resp["_page_cursor"] = {
+            "values": [last.score if f == "_score" else last.sort_values[i]
+                       for i, (f, _) in enumerate(sort_specs)],
+            "tiebreak": (last.shard_i, last.seg_i, last.ord),
+        }
     return resp
+
+
+def _build_hit(ex, c, body, score, query_node, sort_specs,
+               score_sorted) -> dict:
+    from opensearch_tpu.search import fetch as fetch_phase
+
+    hit = ex._hit_dict(c.seg_i, c.ord, score, body)
+    if not score_sorted or body.get("search_after") is not None:
+        hit["sort"] = c.sort_values
+    seg = ex.reader.segments[c.seg_i]
+    mapper = ex.reader.mapper
+    if body.get("highlight"):
+        field_terms = fetch_phase.collect_field_terms(query_node, mapper)
+        hl = fetch_phase.build_highlights(hit.get("_source"),
+                                          body["highlight"], field_terms,
+                                          mapper)
+        if hl:
+            hit["highlight"] = hl
+    if body.get("explain"):
+        hit["_explanation"] = fetch_phase.explain_hit(
+            seg, c.ord, query_node, mapper, ex.reader.stats(),
+            score if score is not None else c.score)
+    if body.get("docvalue_fields"):
+        fields = fetch_phase.docvalue_fields(seg, c.ord,
+                                             body["docvalue_fields"], mapper)
+        if fields:
+            hit["fields"] = fields
+    if body.get("version"):
+        hit["_version"] = getattr(seg, "versions", {}).get(c.ord, 1) \
+            if hasattr(seg, "versions") else 1
+    return hit

@@ -94,6 +94,27 @@ class ShardReader:
         return ShardStats(self.segments)
 
 
+class PinnedReader:
+    """Point-in-time snapshot of a ShardReader: segments are immutable, so
+    pinning is just holding references to the current segment list + device
+    images (reference: ReaderContext / PitReaderContext keeping the Lucene
+    searcher open across requests, search/internal/PitReaderContext.java)."""
+
+    def __init__(self, reader: ShardReader):
+        self.mapper = reader.mapper
+        self.index_name = reader.index_name
+        self.segments = list(reader.segments)
+        self.device = list(reader.device)
+        self._stats = ShardStats(self.segments)
+
+    @property
+    def num_docs(self) -> int:
+        return sum(s.live_doc_count for s in self.segments)
+
+    def stats(self) -> ShardStats:
+        return self._stats
+
+
 # ------------------------------------------------------------------ execution
 
 _JIT_CACHE: Dict[Any, Any] = {}
@@ -223,7 +244,8 @@ def _build_sort_key(arrays, primary_sort) -> jnp.ndarray:
 
 
 class _Candidate:
-    __slots__ = ("score", "seg_i", "ord", "sort_values", "shard_i")
+    __slots__ = ("score", "seg_i", "ord", "sort_values", "shard_i",
+                 "collapse_value")
 
     def __init__(self, score, seg_i, ord_, sort_values, shard_i=0):
         self.score = score

@@ -1,0 +1,171 @@
+"""Scroll + point-in-time search contexts.
+
+Re-design of the reference's keep-alive reader contexts: scroll
+(search/internal/LegacyReaderContext + SearchScrollAsyncAction) and PIT
+(CreatePitController, search/internal/PitReaderContext.java). A context pins
+per-shard `PinnedReader` snapshots — segments are immutable arrays, so a pin
+is reference-holding, no file leases needed. Scroll pagination rides the
+search_after cursor machinery in controller.execute_search with the internal
+(shard, seg, ord) tiebreak, matching the reference's scroll-by-last-doc
+semantics.
+"""
+
+from __future__ import annotations
+
+import secrets
+import time
+from typing import Dict, List, Optional
+
+from opensearch_tpu.common.errors import IllegalArgumentError, ParsingError
+from opensearch_tpu.common.settings import parse_time_value
+from opensearch_tpu.search.controller import execute_search
+from opensearch_tpu.search.executor import PinnedReader, SearchExecutor
+
+
+class _Context:
+    __slots__ = ("executors", "filters", "body", "expiry_s", "keep_alive_s",
+                 "cursor_values", "cursor_tiebreak")
+
+    def __init__(self, executors, filters, body, keep_alive_s):
+        self.executors = executors
+        self.filters = filters
+        self.body = body
+        self.keep_alive_s = keep_alive_s
+        self.expiry_s = time.monotonic() + keep_alive_s
+        self.cursor_values = None
+        self.cursor_tiebreak = None
+
+    def touch(self, keep_alive: Optional[str]):
+        if keep_alive:
+            self.keep_alive_s = parse_time_value(keep_alive, "keep_alive")
+        self.expiry_s = time.monotonic() + self.keep_alive_s
+
+
+def _pin_executors(node, index_expr):
+    names = node.indices.resolve(index_expr, allow_no_indices=True)
+    executors, filters = [], []
+    for name in names:
+        svc = node.indices.get(name)
+        alias_filter = node.indices.alias_filter(index_expr or "", name)
+        for shard in svc.shards:
+            executors.append(SearchExecutor(PinnedReader(shard.executor.reader)))
+            filters.append(alias_filter)
+    return executors, filters
+
+
+def _purge_expired(store: Dict[str, _Context]):
+    now = time.monotonic()
+    for key in [k for k, ctx in store.items() if ctx.expiry_s < now]:
+        del store[key]
+
+
+# -------------------------------------------------------------------- scroll
+
+def start_scroll(node, index_expr, body, keep_alive: str) -> dict:
+    _purge_expired(node.scroll_contexts)
+    keep_alive_s = parse_time_value(keep_alive or "1m", "scroll")
+    body = dict(body or {})
+    body.pop("from", None)
+    executors, filters = _pin_executors(node, index_expr)
+    ctx = _Context(executors, filters, body, keep_alive_s)
+    scroll_id = secrets.token_urlsafe(24)
+    node.scroll_contexts[scroll_id] = ctx
+    res = execute_search(executors, body, extra_filters=filters)
+    _advance(ctx, res)
+    res["_scroll_id"] = scroll_id
+    return res
+
+
+def continue_scroll(node, scroll_id: str, keep_alive: Optional[str]) -> dict:
+    _purge_expired(node.scroll_contexts)
+    ctx = node.scroll_contexts.get(scroll_id)
+    if ctx is None:
+        raise SearchContextMissingError(scroll_id)
+    ctx.touch(keep_alive)
+    if ctx.cursor_values is None:
+        # previous page was empty: stay empty
+        res = execute_search(ctx.executors, {**ctx.body, "size": 0},
+                             extra_filters=ctx.filters)
+        res["hits"]["hits"] = []
+    else:
+        body = dict(ctx.body)
+        body["search_after"] = ctx.cursor_values
+        res = execute_search(ctx.executors, body, extra_filters=ctx.filters,
+                             cursor_tiebreak=ctx.cursor_tiebreak)
+        _advance(ctx, res)
+    res["_scroll_id"] = scroll_id
+    return res
+
+
+def delete_scrolls(node, ids: Optional[List[str]]) -> dict:
+    if ids is None:
+        n = len(node.scroll_contexts)
+        node.scroll_contexts.clear()
+        return {"succeeded": True, "num_freed": n}
+    n = 0
+    for sid in ids:
+        if node.scroll_contexts.pop(sid, None) is not None:
+            n += 1
+    return {"succeeded": True, "num_freed": n}
+
+
+def _advance(ctx: _Context, res: dict):
+    cursor = res.pop("_page_cursor", None)
+    if cursor is not None:
+        ctx.cursor_values = cursor["values"]
+        ctx.cursor_tiebreak = tuple(cursor["tiebreak"])
+    else:
+        ctx.cursor_values = None
+        ctx.cursor_tiebreak = None
+
+
+# ----------------------------------------------------------------------- PIT
+
+def create_pit(node, index_expr, keep_alive: str) -> dict:
+    _purge_expired(node.pit_contexts)
+    keep_alive_s = parse_time_value(keep_alive, "keep_alive")
+    executors, filters = _pin_executors(node, index_expr)
+    ctx = _Context(executors, filters, {}, keep_alive_s)
+    pit_id = secrets.token_urlsafe(24)
+    node.pit_contexts[pit_id] = ctx
+    return {"pit_id": pit_id,
+            "_shards": {"total": len(executors),
+                        "successful": len(executors), "skipped": 0,
+                        "failed": 0},
+            "creation_time": int(time.time() * 1000)}
+
+
+def search_with_pit(node, body: dict) -> dict:
+    _purge_expired(node.pit_contexts)
+    pit = body.get("pit") or {}
+    pit_id = pit.get("id")
+    ctx = node.pit_contexts.get(pit_id)
+    if ctx is None:
+        raise SearchContextMissingError(pit_id)
+    ctx.touch(pit.get("keep_alive"))
+    body = {k: v for k, v in body.items() if k != "pit"}
+    res = execute_search(ctx.executors, body, extra_filters=ctx.filters)
+    res.pop("_page_cursor", None)
+    res["pit_id"] = pit_id
+    return res
+
+
+def delete_pits(node, ids: Optional[List[str]]) -> dict:
+    if ids is None:
+        freed = [{"pit_id": pid, "successful": True}
+                 for pid in node.pit_contexts]
+        node.pit_contexts.clear()
+        return {"pits": freed}
+    freed = []
+    for pid in ids:
+        ok = node.pit_contexts.pop(pid, None) is not None
+        freed.append({"pit_id": pid, "successful": ok})
+    return {"pits": freed}
+
+
+class SearchContextMissingError(IllegalArgumentError):
+    status = 404
+    error_type = "search_context_missing_exception"
+
+    def __init__(self, context_id):
+        super().__init__(f"No search context found for id [{context_id}]")

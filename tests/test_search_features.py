@@ -1,0 +1,291 @@
+"""Search feature tests: scroll, PIT, search_after, highlight, explain,
+rescore, collapse, track_total_hits, docvalue_fields.
+
+Modeled on the reference suites: SearchScrollIT, PointInTimeIT,
+SearchAfterIT, HighlighterSearchIT, QueryRescorerIT, CollapseSearchIT."""
+
+import json
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    n.request("PUT", "/items", {
+        "settings": {"number_of_shards": 2},
+        "mappings": {"properties": {
+            "title": {"type": "text"},
+            "brand": {"type": "keyword"},
+            "price": {"type": "double"},
+            "stock": {"type": "integer"},
+        }},
+    })
+    brands = ["acme", "globex", "initech"]
+    for i in range(30):
+        n.request("PUT", f"/items/_doc/{i}", {
+            "title": f"wireless headphone model {i}" if i % 3 == 0
+                     else f"wired speaker unit {i}",
+            "brand": brands[i % 3],
+            "price": float(100 - i),
+            "stock": i,
+        })
+    n.request("POST", "/items/_refresh")
+    return n
+
+
+class TestSearchAfter:
+    def test_search_after_pagination_field_sort(self, node):
+        body = {"query": {"match_all": {}}, "size": 7,
+                "sort": [{"price": "asc"}]}
+        seen = []
+        after = None
+        for _ in range(6):
+            b = dict(body)
+            if after is not None:
+                b["search_after"] = after
+            res = node.request("POST", "/items/_search", b)
+            hits = res["hits"]["hits"]
+            if not hits:
+                break
+            seen.extend(h["_source"]["stock"] for h in hits)
+            after = hits[-1]["sort"]
+        assert sorted(seen) == list(range(30))
+        assert len(seen) == 30  # no dup, no loss
+
+    def test_search_after_with_from_rejected(self, node):
+        res = node.request("POST", "/items/_search", {
+            "from": 5, "search_after": [1], "sort": [{"price": "asc"}]})
+        assert res["_status"] == 400
+
+    def test_search_after_wrong_arity(self, node):
+        res = node.request("POST", "/items/_search", {
+            "search_after": [1, 2], "sort": [{"price": "asc"}]})
+        assert res["_status"] == 400
+
+
+class TestScroll:
+    def test_scroll_full_iteration(self, node):
+        res = node.request("POST", "/items/_search",
+                           {"query": {"match_all": {}}, "size": 8,
+                            "sort": [{"stock": "asc"}]},
+                           scroll="1m")
+        sid = res["_scroll_id"]
+        collected = [h["_source"]["stock"] for h in res["hits"]["hits"]]
+        while True:
+            res = node.request("POST", "/_search/scroll",
+                               {"scroll": "1m", "scroll_id": sid})
+            hits = res["hits"]["hits"]
+            if not hits:
+                break
+            collected.extend(h["_source"]["stock"] for h in hits)
+        assert collected == list(range(30))
+
+    def test_scroll_score_sorted(self, node):
+        res = node.request("POST", "/items/_search",
+                           {"query": {"match": {"title": "wireless"}},
+                            "size": 4}, scroll="1m")
+        sid = res["_scroll_id"]
+        total = res["hits"]["total"]["value"]
+        n_hits = len(res["hits"]["hits"])
+        scores = [h["_score"] for h in res["hits"]["hits"]]
+        while True:
+            res = node.request("POST", "/_search/scroll",
+                               {"scroll_id": sid})
+            if not res["hits"]["hits"]:
+                break
+            scores.extend(h["_score"] for h in res["hits"]["hits"])
+            n_hits += len(res["hits"]["hits"])
+        assert n_hits == total == 10
+        assert scores == sorted(scores, reverse=True)
+
+    def test_scroll_isolated_from_writes(self, node):
+        res = node.request("POST", "/items/_search",
+                           {"query": {"match_all": {}}, "size": 5,
+                            "sort": [{"stock": "asc"}]}, scroll="1m")
+        sid = res["_scroll_id"]
+        # new doc indexed + refreshed mid-scroll must not appear
+        node.request("PUT", "/items/_doc/999", {"title": "late arrival",
+                                                "stock": 999},
+                     refresh="true")
+        seen = [h["_id"] for h in res["hits"]["hits"]]
+        while True:
+            res = node.request("POST", "/_search/scroll",
+                               {"scroll_id": sid})
+            if not res["hits"]["hits"]:
+                break
+            seen.extend(h["_id"] for h in res["hits"]["hits"])
+        assert "999" not in seen
+        assert len(seen) == 30
+
+    def test_clear_scroll(self, node):
+        res = node.request("POST", "/items/_search",
+                           {"size": 1}, scroll="1m")
+        sid = res["_scroll_id"]
+        res = node.request("DELETE", "/_search/scroll", {"scroll_id": sid})
+        assert res["num_freed"] == 1
+        res = node.request("POST", "/_search/scroll", {"scroll_id": sid})
+        assert res["_status"] == 404
+        assert res["error"]["type"] == "search_context_missing_exception"
+
+
+class TestPit:
+    def test_pit_lifecycle(self, node):
+        res = node.request("POST", "/items/_search/point_in_time",
+                           keep_alive="1m")
+        pid = res["pit_id"]
+        node.request("PUT", "/items/_doc/999", {"title": "late", "stock": 9},
+                     refresh="true")
+        res = node.request("POST", "/_search",
+                           {"pit": {"id": pid},
+                            "query": {"match_all": {}}, "size": 50})
+        assert res["hits"]["total"]["value"] == 30  # pinned view
+        assert res["pit_id"] == pid
+        res = node.request("POST", "/_search", {"query": {"match_all": {}},
+                                                "size": 50})
+        assert res["hits"]["total"]["value"] == 31  # live view sees the write
+        res = node.request("DELETE", "/_search/point_in_time",
+                           {"pit_id": [pid]})
+        assert res["pits"][0]["successful"] is True
+        res = node.request("POST", "/_search", {"pit": {"id": pid}})
+        assert res["_status"] == 404
+
+
+class TestTrackTotalHits:
+    def test_false_omits_total(self, node):
+        res = node.request("POST", "/items/_search",
+                           {"track_total_hits": False, "size": 3})
+        assert "total" not in res["hits"]
+
+    def test_threshold_gte(self, node):
+        res = node.request("POST", "/items/_search",
+                           {"track_total_hits": 10, "size": 1})
+        assert res["hits"]["total"] == {"value": 10, "relation": "gte"}
+
+    def test_threshold_exact_when_below(self, node):
+        res = node.request("POST", "/items/_search",
+                           {"query": {"match": {"title": "wireless"}},
+                            "track_total_hits": 100})
+        assert res["hits"]["total"] == {"value": 10, "relation": "eq"}
+
+
+class TestHighlight:
+    def test_basic_highlight(self, node):
+        res = node.request("POST", "/items/_search", {
+            "query": {"match": {"title": "wireless"}},
+            "highlight": {"fields": {"title": {}}},
+            "size": 3,
+        })
+        for h in res["hits"]["hits"]:
+            assert "<em>wireless</em>" in h["highlight"]["title"][0]
+
+    def test_custom_tags_and_fragments(self, node):
+        node.request("PUT", "/hl", {"mappings": {"properties": {
+            "body": {"type": "text"}}}})
+        long_text = ("filler words here. " * 20 + "the needle appears. "
+                     + "more filler content. " * 20 + "needle again at end.")
+        node.request("PUT", "/hl/_doc/1", {"body": long_text},
+                     refresh="true")
+        res = node.request("POST", "/hl/_search", {
+            "query": {"match": {"body": "needle"}},
+            "highlight": {"fields": {"body": {
+                "pre_tags": ["<b>"], "post_tags": ["</b>"],
+                "fragment_size": 60, "number_of_fragments": 2}}},
+        })
+        frags = res["hits"]["hits"][0]["highlight"]["body"]
+        assert len(frags) == 2
+        assert all("<b>needle</b>" in f for f in frags)
+        assert all(len(f) < 120 for f in frags)
+
+    def test_bool_query_highlights_all_clauses(self, node):
+        res = node.request("POST", "/items/_search", {
+            "query": {"bool": {
+                "must": [{"match": {"title": "headphone"}}],
+                "should": [{"match": {"title": "model"}}]}},
+            "highlight": {"fields": {"title": {}}}, "size": 1,
+        })
+        frag = res["hits"]["hits"][0]["highlight"]["title"][0]
+        assert "<em>headphone</em>" in frag and "<em>model</em>" in frag
+
+
+class TestExplain:
+    def test_explain_structure_and_score_parity(self, node):
+        res = node.request("POST", "/items/_search", {
+            "query": {"match": {"title": "wireless"}},
+            "explain": True, "size": 2,
+        })
+        for h in res["hits"]["hits"]:
+            exp = h["_explanation"]
+            assert abs(exp["value"] - h["_score"]) < 1e-3
+            weight = exp["details"][0]
+            assert "BM25Similarity" in weight["description"]
+            idf_node = weight["details"][0]
+            tf_node = weight["details"][1]
+            assert "idf" in idf_node["description"]
+            assert "tf" in tf_node["description"]
+            assert abs(weight["value"]
+                       - idf_node["value"] * tf_node["value"]) < 1e-6
+
+
+class TestRescore:
+    def test_rescore_reranks_window(self, node):
+        base = node.request("POST", "/items/_search", {
+            "query": {"match": {"title": "wireless headphone"}}, "size": 5})
+        res = node.request("POST", "/items/_search", {
+            "query": {"match": {"title": "wireless headphone"}},
+            "rescore": {
+                "window_size": 10,
+                "query": {
+                    "rescore_query": {"range": {"stock": {"gte": 20}}},
+                    "query_weight": 0.1,
+                    "rescore_query_weight": 10.0,
+                },
+            },
+            "size": 5,
+        })
+        # high-stock docs must now lead
+        top = res["hits"]["hits"][0]["_source"]["stock"]
+        assert top >= 20
+        assert res["hits"]["total"] == base["hits"]["total"]
+
+    def test_rescore_score_mode_max(self, node):
+        res = node.request("POST", "/items/_search", {
+            "query": {"match": {"title": "wireless"}},
+            "rescore": {"window_size": 5, "query": {
+                "rescore_query": {"match": {"title": "model"}},
+                "score_mode": "max"}},
+            "size": 3,
+        })
+        assert res["_status"] == 200
+        scores = [h["_score"] for h in res["hits"]["hits"]]
+        assert scores == sorted(scores, reverse=True)
+
+
+class TestCollapse:
+    def test_collapse_by_keyword(self, node):
+        res = node.request("POST", "/items/_search", {
+            "query": {"match_all": {}},
+            "collapse": {"field": "brand"},
+            "sort": [{"price": "desc"}],
+            "size": 10,
+        })
+        hits = res["hits"]["hits"]
+        brands = [h["_source"]["brand"] for h in hits]
+        assert len(brands) == 3
+        assert len(set(brands)) == 3
+        # each collapsed hit is the best (highest price) of its brand
+        assert hits[0]["_source"]["price"] == 100.0
+
+
+class TestDocvalueFields:
+    def test_docvalue_fields(self, node):
+        res = node.request("POST", "/items/_search", {
+            "query": {"term": {"brand": "acme"}},
+            "docvalue_fields": ["price", "brand"],
+            "size": 2,
+        })
+        for h in res["hits"]["hits"]:
+            assert h["fields"]["price"] == [h["_source"]["price"]]
+            assert h["fields"]["brand"] == ["acme"]
