@@ -41,10 +41,12 @@ def deep_merge(base: dict, patch: dict) -> dict:
 class IndexService:
     def __init__(self, index_name: str, mapping: Optional[dict] = None,
                  settings: Optional[dict] = None,
-                 data_path: Optional[str] = None):
+                 data_path: Optional[str] = None,
+                 script_service=None):
         settings = settings or {}
         self.index_name = index_name
         self.settings = settings
+        self._script_service = script_service
         self.num_shards = int(settings.get("number_of_shards", 1))
         self.num_replicas = int(settings.get("number_of_replicas", 0))
         self.routing_partition_size = int(
@@ -125,6 +127,8 @@ class IndexService:
         if_seq_no/if_primary_term CAS is checked against the current doc."""
         shard = self.shard_for(doc_id, routing)
         cur = shard.get_doc(doc_id)
+        if "script" in body:
+            return self._update_with_script(shard, doc_id, body, cur)
         if if_seq_no is not None or if_primary_term is not None:
             if cur is None:
                 raise VersionConflictError(
@@ -157,6 +161,51 @@ class IndexService:
                     "_seq_no": cur.seq_no, "_primary_term": cur.primary_term,
                     "_shards": {"total": 0, "successful": 0, "failed": 0}}
         res = shard.index_doc(doc_id, merged, if_seq_no=cur.seq_no,
+                              if_primary_term=cur.primary_term)
+        return self._write_response(res, shard, "updated")
+
+    def _update_with_script(self, shard, doc_id: str, body: dict, cur) -> dict:
+        """Scripted update (reference: UpdateHelper.prepareUpdateScriptRequest
+        — ctx._source mutation, ctx.op = index|delete|none)."""
+        if self._script_service is None:
+            from opensearch_tpu.script.service import ScriptService
+            self._script_service = ScriptService()
+        script = self._script_service.compile(body["script"], "update")
+        if cur is None:
+            if "upsert" in body:
+                if body.get("scripted_upsert", False):
+                    ctx = {"_source": dict(body["upsert"]), "op": "create",
+                           "_index": self.index_name, "_id": doc_id}
+                    script.execute(ctx)
+                    if ctx.get("op") in ("none", "noop"):
+                        return {"_index": self.index_name, "_id": doc_id,
+                                "result": "noop",
+                                "_shards": {"total": 0, "successful": 0,
+                                            "failed": 0}}
+                    new_source = ctx["_source"]
+                else:
+                    new_source = body["upsert"]
+                res = shard.index_doc(doc_id, new_source, op_type="create")
+                return self._write_response(res, shard, "created")
+            raise DocumentMissingError(f"[{doc_id}]: document missing")
+        ctx = {"_source": dict(cur.source), "op": "index",
+               "_index": self.index_name, "_id": doc_id,
+               "_version": cur.version, "_now": int(time.time() * 1000)}
+        script.execute(ctx)
+        op = ctx.get("op", "index")
+        if op in ("none", "noop"):
+            return {"_index": self.index_name, "_id": doc_id,
+                    "_version": cur.version, "result": "noop",
+                    "_seq_no": cur.seq_no, "_primary_term": cur.primary_term,
+                    "_shards": {"total": 0, "successful": 0, "failed": 0}}
+        if op == "delete":
+            res = shard.delete_doc(doc_id)
+            return self._write_response(res, shard, "deleted")
+        if op != "index" and op != "create":
+            raise IllegalArgumentError(
+                f"Operation type [{op}] not allowed, only [noop, index, "
+                f"delete] are allowed")
+        res = shard.index_doc(doc_id, ctx["_source"], if_seq_no=cur.seq_no,
                               if_primary_term=cur.primary_term)
         return self._write_response(res, shard, "updated")
 

@@ -142,8 +142,10 @@ class IndexTemplate:
 class IndicesService:
     """All named indices on this node + aliases + templates."""
 
-    def __init__(self, data_path: Optional[str] = None):
+    def __init__(self, data_path: Optional[str] = None,
+                 script_service=None):
         self.indices: Dict[str, IndexService] = {}
+        self.script_service = script_service
         # alias name -> {index name -> AliasMetadata}
         self.aliases: Dict[str, Dict[str, AliasMetadata]] = {}
         self.templates: Dict[str, IndexTemplate] = {}
@@ -225,7 +227,8 @@ class IndicesService:
             for aname, abody in t_aliases.items():
                 alias_bodies.setdefault(aname, abody)
         svc = IndexService(name, mapping=mappings or None, settings=settings,
-                           data_path=self.data_path)
+                           data_path=self.data_path,
+                           script_service=self.script_service)
         self.indices[name] = svc
         for aname, abody in alias_bodies.items():
             self.put_alias(name, aname, abody)

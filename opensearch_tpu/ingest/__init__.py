@@ -1,0 +1,3 @@
+from opensearch_tpu.ingest.service import IngestService, Pipeline
+
+__all__ = ["IngestService", "Pipeline"]

@@ -1,0 +1,180 @@
+"""Grok + dissect pattern engines for ingest processors.
+
+Re-design of libs/grok (Grok.java — pattern-bank %{NAME:field} expansion to
+regex) and libs/dissect (DissectParser.java — delimiter-based splitting).
+A core pattern bank covers the patterns the reference's ingest-common tests
+exercise most; custom patterns come from the processor definition.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional
+
+from opensearch_tpu.common.errors import IllegalArgumentError
+
+BUILTIN_PATTERNS: Dict[str, str] = {
+    "WORD": r"\b\w+\b",
+    "NOTSPACE": r"\S+",
+    "SPACE": r"\s*",
+    "DATA": r".*?",
+    "GREEDYDATA": r".*",
+    "INT": r"(?:[+-]?(?:[0-9]+))",
+    "NUMBER": r"(?:[+-]?(?:[0-9]+(?:\.[0-9]+)?))",
+    "BASE10NUM": r"(?:[+-]?(?:[0-9]+(?:\.[0-9]+)?))",
+    "POSINT": r"\b(?:[1-9][0-9]*)\b",
+    "NONNEGINT": r"\b(?:[0-9]+)\b",
+    "BOOLEAN": r"(?:true|false|TRUE|FALSE|True|False)",
+    "USERNAME": r"[a-zA-Z0-9._-]+",
+    "USER": r"[a-zA-Z0-9._-]+",
+    "EMAILADDRESS": r"[a-zA-Z0-9_.+-=:]+@[0-9A-Za-z][0-9A-Za-z-]{0,62}"
+                    r"(?:\.[0-9A-Za-z][0-9A-Za-z-]{0,62})*",
+    "IPV4": r"(?:(?:25[0-5]|2[0-4][0-9]|[01]?[0-9][0-9]?)\.){3}"
+            r"(?:25[0-5]|2[0-4][0-9]|[01]?[0-9][0-9]?)",
+    "IPV6": r"(?:[0-9A-Fa-f]{1,4}:){1,7}[0-9A-Fa-f:]{1,4}",
+    "IP": r"(?:(?:(?:25[0-5]|2[0-4][0-9]|[01]?[0-9][0-9]?)\.){3}"
+          r"(?:25[0-5]|2[0-4][0-9]|[01]?[0-9][0-9]?))"
+          r"|(?:(?:[0-9A-Fa-f]{1,4}:){1,7}[0-9A-Fa-f:]{1,4})",
+    "HOSTNAME": r"\b[0-9A-Za-z][0-9A-Za-z-]{0,62}"
+                r"(?:\.[0-9A-Za-z][0-9A-Za-z-]{0,62})*\.?\b",
+    "IPORHOST": r"(?:(?:(?:25[0-5]|2[0-4][0-9]|[01]?[0-9][0-9]?)\.){3}"
+                r"(?:25[0-5]|2[0-4][0-9]|[01]?[0-9][0-9]?))"
+                r"|(?:\b[0-9A-Za-z][0-9A-Za-z-]{0,62}"
+                r"(?:\.[0-9A-Za-z][0-9A-Za-z-]{0,62})*\.?\b)",
+    "HOSTPORT": r"\S+:\b(?:[1-9][0-9]*)\b",
+    "PATH": r"(?:/[^\s?*]*)+",
+    "URIPATH": r"(?:/[A-Za-z0-9$.+!*'(){},~:;=@#%&_\-]*)+",
+    "URIPARAM": r"\?[A-Za-z0-9$.+!*'|(){},~@#%&/=:;_?\-\[\]<>]*",
+    "LOGLEVEL": r"(?:[Aa]lert|ALERT|[Tt]race|TRACE|[Dd]ebug|DEBUG|"
+                r"[Nn]otice|NOTICE|[Ii]nfo(?:rmation)?|INFO(?:RMATION)?|"
+                r"[Ww]arn(?:ing)?|WARN(?:ING)?|[Ee]rr(?:or)?|ERR(?:OR)?|"
+                r"[Cc]rit(?:ical)?|CRIT(?:ICAL)?|[Ff]atal|FATAL|"
+                r"[Ss]evere|SEVERE|EMERG(?:ENCY)?|[Ee]merg(?:ency)?)",
+    "TIMESTAMP_ISO8601": r"(?:\d{4})-(?:0[1-9]|1[0-2])-"
+                         r"(?:[0-2][0-9]|3[01])[T ]"
+                         r"(?:2[0123]|[01]?[0-9]):?(?:[0-5][0-9])"
+                         r"(?::?(?:[0-5][0-9]|60)(?:[:.,][0-9]+)?)?"
+                         r"(?:Z|[+-](?:2[0123]|[01]?[0-9])(?::?[0-5][0-9])?)?",
+    "HTTPDATE": r"(?:[0-2][0-9]|3[01])/\w{3}/\d{4}:"
+                r"(?:2[0123]|[01][0-9]):(?:[0-5][0-9]):(?:[0-5][0-9])"
+                r" [+-][0-9]{4}",
+    "QS": r'(?:"(?:[^"\\]|\\.)*")',
+    "QUOTEDSTRING": r'(?:"(?:[^"\\]|\\.)*")',
+    "UUID": r"[A-Fa-f0-9]{8}-(?:[A-Fa-f0-9]{4}-){3}[A-Fa-f0-9]{12}",
+    "MONTHDAY": r"(?:(?:0[1-9])|(?:[12][0-9])|(?:3[01])|[1-9])",
+    "YEAR": r"(?:\d\d){1,2}",
+}
+
+_GROK_REF = re.compile(r"%\{(\w+)(?::([\w.\[\]@-]+))?(?::(\w+))?\}")
+
+_TYPE_CONVERT = {"int": int, "long": int, "float": float, "double": float,
+                 "boolean": lambda v: str(v).lower() == "true",
+                 "string": str}
+
+
+class Grok:
+    def __init__(self, pattern: str,
+                 custom_patterns: Optional[Dict[str, str]] = None):
+        self.bank = dict(BUILTIN_PATTERNS)
+        if custom_patterns:
+            self.bank.update(custom_patterns)
+        self.types: Dict[str, str] = {}
+        self._group_fields: Dict[str, str] = {}
+        regex = self._expand(pattern, depth=0)
+        try:
+            self.regex = re.compile(regex)
+        except re.error as e:
+            raise IllegalArgumentError(f"invalid grok pattern: {e}")
+
+    def _expand(self, pattern: str, depth: int) -> str:
+        if depth > 20:
+            raise IllegalArgumentError("circular grok pattern reference")
+
+        def sub(m):
+            name, field, type_name = m.group(1), m.group(2), m.group(3)
+            if name not in self.bank:
+                raise IllegalArgumentError(
+                    f"Unable to find pattern [{name}] in Grok's pattern "
+                    f"dictionary")
+            inner = self._expand(self.bank[name], depth + 1)
+            if field:
+                group = f"g{len(self._group_fields)}"
+                self._group_fields[group] = field
+                if type_name:
+                    self.types[field] = type_name
+                return f"(?P<{group}>{inner})"
+            return f"(?:{inner})"
+
+        return _GROK_REF.sub(sub, pattern)
+
+    def match(self, text: str) -> Optional[Dict[str, object]]:
+        m = self.regex.search(text)
+        if m is None:
+            return None
+        out: Dict[str, object] = {}
+        for group, field in self._group_fields.items():
+            val = m.group(group)
+            if val is None:
+                continue
+            conv = _TYPE_CONVERT.get(self.types.get(field, ""), None)
+            out[field] = conv(val) if conv else val
+        return out
+
+
+class Dissect:
+    """%{key} delimiter-split parser (libs/dissect DissectParser.java).
+    Supports append (`%{+key}`), skip (`%{}` / `%{?key}`) and right padding
+    (`%{key->}`)."""
+
+    _KEY = re.compile(r"%\{([^}]*)\}")
+
+    def __init__(self, pattern: str, append_separator: str = ""):
+        self.append_separator = append_separator
+        self.keys: List[str] = []
+        parts = self._KEY.split(pattern)
+        # parts: [prefix, key1, delim1, key2, delim2, ..., suffix]
+        self.prefix = parts[0]
+        self.pairs: List[tuple] = []  # (key, following delimiter)
+        for i in range(1, len(parts), 2):
+            self.pairs.append((parts[i], parts[i + 1] if i + 1 < len(parts)
+                               else ""))
+        if not self.pairs:
+            raise IllegalArgumentError(
+                "Unable to parse pattern: no dissect keys found")
+
+    def match(self, text: str) -> Optional[Dict[str, str]]:
+        if not text.startswith(self.prefix):
+            return None
+        pos = len(self.prefix)
+        out: Dict[str, str] = {}
+        appends: Dict[str, List[str]] = {}
+        for i, (key, delim) in enumerate(self.pairs):
+            pad = key.endswith("->")
+            if pad:
+                key = key[:-2]
+            if delim == "":
+                value = text[pos:]
+                pos = len(text)
+            else:
+                idx = text.find(delim, pos)
+                if idx < 0:
+                    return None
+                value = text[pos:idx]
+                pos = idx + len(delim)
+                if pad:
+                    while text[pos - 1:pos] == delim[-1] and \
+                            text[pos:pos + len(delim)] == delim:
+                        pos += len(delim)
+                    while delim.strip() == "" and pos < len(text) \
+                            and text[pos] == delim[0]:
+                        pos += 1
+            if key == "" or key.startswith("?"):
+                continue
+            if key.startswith("+"):
+                appends.setdefault(key[1:], []).append(value)
+            else:
+                out[key] = value
+        for key, values in appends.items():
+            joined = self.append_separator.join(values)
+            out[key] = out.get(key, "") + joined
+        return out

@@ -1,0 +1,713 @@
+"""Ingest pipelines: document transforms before indexing.
+
+Re-design of ingest/IngestService.java, Pipeline.java, CompoundProcessor.java
+and the 33 processors of modules/ingest-common. A pipeline is a list of
+processors with per-processor `if` conditionals (painless over `ctx`),
+`ignore_failure`, `on_failure` chains, and a pipeline-level on_failure.
+`DropSignal` implements the drop processor's skip-indexing semantics.
+
+Field paths are dotted ("a.b.c") and navigate nested maps like the
+reference's IngestDocument.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import re
+import urllib.parse
+from typing import Any, Callable, Dict, List, Optional
+
+from opensearch_tpu.common.errors import IllegalArgumentError, OpenSearchTpuError
+from opensearch_tpu.ingest.grok import Dissect, Grok
+from opensearch_tpu.script.painless import HostEvaluator, parse
+
+
+class IngestProcessorError(OpenSearchTpuError):
+    status = 400
+    error_type = "ingest_processor_exception"
+
+
+class DropSignal(Exception):
+    """Raised by the drop processor: do not index this document."""
+
+
+# -------------------------------------------------------------- field paths
+
+def path_get(doc: dict, path: str, default=None):
+    cur: Any = doc
+    for part in path.split("."):
+        if isinstance(cur, dict) and part in cur:
+            cur = cur[part]
+        elif isinstance(cur, list) and part.isdigit() and int(part) < len(cur):
+            cur = cur[int(part)]
+        else:
+            return default
+    return cur
+
+
+def path_exists(doc: dict, path: str) -> bool:
+    sentinel = object()
+    return path_get(doc, path, sentinel) is not sentinel
+
+
+def path_set(doc: dict, path: str, value):
+    parts = path.split(".")
+    cur = doc
+    for part in parts[:-1]:
+        nxt = cur.get(part) if isinstance(cur, dict) else None
+        if not isinstance(nxt, (dict, list)):
+            nxt = {}
+            cur[part] = nxt
+        cur = nxt
+    cur[parts[-1]] = value
+
+
+def path_remove(doc: dict, path: str) -> bool:
+    parts = path.split(".")
+    cur = doc
+    for part in parts[:-1]:
+        if isinstance(cur, dict) and part in cur:
+            cur = cur[part]
+        else:
+            return False
+    if isinstance(cur, dict) and parts[-1] in cur:
+        del cur[parts[-1]]
+        return True
+    return False
+
+
+_TEMPLATE_RE = re.compile(r"\{\{\{?([^}]+?)\}?\}\}")
+
+
+def render_template(value: Any, ctx: dict) -> Any:
+    """Mustache-lite `{{field}}` substitution (reference: lang-mustache
+    powering template snippets in processor configs)."""
+    if not isinstance(value, str) or "{{" not in value:
+        return value
+    full = _TEMPLATE_RE.fullmatch(value)
+    if full:  # whole-value template keeps the native type
+        return path_get(ctx, full.group(1).strip())
+    return _TEMPLATE_RE.sub(
+        lambda m: str(path_get(ctx, m.group(1).strip(), "")), value)
+
+
+# --------------------------------------------------------------- processors
+
+class Processor:
+    def __init__(self, type_name: str, config: dict):
+        self.type = type_name
+        self.tag = config.pop("tag", None)
+        self.description = config.pop("description", None)
+        self.ignore_failure = bool(config.pop("ignore_failure", False))
+        cond = config.pop("if", None)
+        self._cond = parse(cond) if cond else None
+        on_failure = config.pop("on_failure", None)
+        self.on_failure: List[Processor] = \
+            [build_processor(p) for p in on_failure] if on_failure else []
+        self.config = config
+
+    def should_run(self, ctx: dict) -> bool:
+        if self._cond is None:
+            return True
+        result = HostEvaluator({"ctx": ctx}).run(self._cond)
+        return bool(result)
+
+    def run(self, ctx: dict):
+        raise NotImplementedError
+
+    def execute(self, ctx: dict):
+        if not self.should_run(ctx):
+            return
+        try:
+            self.run(ctx)
+        except DropSignal:
+            raise
+        except Exception as e:
+            if self.ignore_failure:
+                return
+            if self.on_failure:
+                ctx.setdefault("_ingest", {})["on_failure_message"] = str(e)
+                ctx["_ingest"]["on_failure_processor_type"] = self.type
+                for p in self.on_failure:
+                    p.execute(ctx)
+                return
+            raise IngestProcessorError(
+                f"[{self.type}] {e}") from e
+
+
+def _field(config, key="field"):
+    v = config.get(key)
+    if v is None:
+        raise IllegalArgumentError(f"[{key}] required property is missing")
+    return v
+
+
+class SetProcessor(Processor):
+    def run(self, ctx):
+        field = render_template(_field(self.config), ctx)
+        if self.config.get("override", True) or not path_exists(ctx, field):
+            path_set(ctx, field, render_template(self.config.get("value"),
+                                                 ctx)
+                     if "value" in self.config
+                     else path_get(ctx, self.config["copy_from"]))
+
+
+class RemoveProcessor(Processor):
+    def run(self, ctx):
+        fields = _field(self.config)
+        if isinstance(fields, str):
+            fields = [fields]
+        for f in fields:
+            f = render_template(f, ctx)
+            if not path_remove(ctx, f) and \
+                    not self.config.get("ignore_missing", False):
+                raise IllegalArgumentError(f"field [{f}] not present as part "
+                                           f"of path [{f}]")
+
+
+class RenameProcessor(Processor):
+    def run(self, ctx):
+        src = render_template(_field(self.config), ctx)
+        dst = render_template(_field(self.config, "target_field"), ctx)
+        if not path_exists(ctx, src):
+            if self.config.get("ignore_missing", False):
+                return
+            raise IllegalArgumentError(f"field [{src}] doesn't exist")
+        if path_exists(ctx, dst):
+            raise IllegalArgumentError(f"field [{dst}] already exists")
+        path_set(ctx, dst, path_get(ctx, src))
+        path_remove(ctx, src)
+
+
+class ConvertProcessor(Processor):
+    _CONVERTERS: Dict[str, Callable] = {
+        "integer": lambda v: int(str(v), 0) if isinstance(v, str) else int(v),
+        "long": lambda v: int(str(v), 0) if isinstance(v, str) else int(v),
+        "float": float,
+        "double": float,
+        "boolean": lambda v: {"true": True, "false": False}[str(v).lower()],
+        "string": str,
+        "ip": str,
+        "auto": None,
+    }
+
+    def run(self, ctx):
+        field = _field(self.config)
+        target = self.config.get("target_field", field)
+        type_name = self.config.get("type")
+        if type_name not in self._CONVERTERS:
+            raise IllegalArgumentError(
+                f"type [{type_name}] not supported, cannot convert field")
+        value = path_get(ctx, field)
+        if value is None:
+            if self.config.get("ignore_missing", False):
+                return
+            raise IllegalArgumentError(f"Field [{field}] is null, cannot be "
+                                       f"converted to type [{type_name}]")
+
+        def convert_one(v):
+            if type_name == "auto":
+                for attempt in (lambda: int(str(v)), lambda: float(str(v))):
+                    try:
+                        return attempt()
+                    except (ValueError, TypeError):
+                        pass
+                if str(v).lower() in ("true", "false"):
+                    return str(v).lower() == "true"
+                return str(v)
+            try:
+                return self._CONVERTERS[type_name](v)
+            except (ValueError, KeyError, TypeError) as e:
+                raise IllegalArgumentError(
+                    f"unable to convert [{v}] to {type_name}") from e
+
+        if isinstance(value, list):
+            path_set(ctx, target, [convert_one(v) for v in value])
+        else:
+            path_set(ctx, target, convert_one(value))
+
+
+_DATE_JAVA2PY = [("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"), ("HH", "%H"),
+                 ("mm", "%M"), ("ss", "%S"), ("SSS", "%f"), ("XXX", "%z"),
+                 ("XX", "%z"), ("X", "%z"), ("Z", "%z"), ("EEE", "%a"),
+                 ("MMM", "%b")]
+
+
+def _java_fmt(fmt: str) -> str:
+    for java, py in _DATE_JAVA2PY:
+        fmt = fmt.replace(java, py)
+    return fmt
+
+
+class DateProcessor(Processor):
+    def run(self, ctx):
+        field = _field(self.config)
+        target = self.config.get("target_field", "@timestamp")
+        formats = self.config.get("formats") or ["ISO8601"]
+        value = path_get(ctx, field)
+        for fmt in formats:
+            try:
+                if fmt in ("ISO8601", "iso8601"):
+                    dt = _dt.datetime.fromisoformat(
+                        str(value).replace("Z", "+00:00"))
+                elif fmt in ("UNIX", "unix"):
+                    dt = _dt.datetime.fromtimestamp(float(value),
+                                                    _dt.timezone.utc)
+                elif fmt in ("UNIX_MS", "unix_ms"):
+                    dt = _dt.datetime.fromtimestamp(float(value) / 1000.0,
+                                                    _dt.timezone.utc)
+                else:
+                    dt = _dt.datetime.strptime(str(value), _java_fmt(fmt))
+            except (ValueError, TypeError):
+                continue
+            if dt.tzinfo is None:
+                dt = dt.replace(tzinfo=_dt.timezone.utc)
+            path_set(ctx, target,
+                     dt.astimezone(_dt.timezone.utc)
+                     .strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z")
+            return
+        raise IllegalArgumentError(
+            f"unable to parse date [{value}] using formats {formats}")
+
+
+class _StringTransform(Processor):
+    fn: Callable[[str], str] = staticmethod(lambda s: s)
+
+    def run(self, ctx):
+        field = _field(self.config)
+        target = self.config.get("target_field", field)
+        value = path_get(ctx, field)
+        if value is None:
+            if self.config.get("ignore_missing", False):
+                return
+            raise IllegalArgumentError(f"field [{field}] is null, cannot be "
+                                       f"processed")
+        if isinstance(value, list):
+            path_set(ctx, target, [self.fn(str(v)) for v in value])
+        else:
+            path_set(ctx, target, self.fn(str(value)))
+
+
+class LowercaseProcessor(_StringTransform):
+    fn = staticmethod(str.lower)
+
+
+class UppercaseProcessor(_StringTransform):
+    fn = staticmethod(str.upper)
+
+
+class TrimProcessor(_StringTransform):
+    fn = staticmethod(str.strip)
+
+
+class HtmlStripProcessor(_StringTransform):
+    fn = staticmethod(lambda s: re.sub(r"<[^>]*>", "", s))
+
+
+class UrlDecodeProcessor(_StringTransform):
+    fn = staticmethod(urllib.parse.unquote)
+
+
+class BytesProcessor(_StringTransform):
+    @staticmethod
+    def fn(s: str):
+        m = re.fullmatch(r"\s*([\d.]+)\s*(b|kb|mb|gb|tb|pb)\s*", s.lower())
+        if not m:
+            raise IllegalArgumentError(
+                f"failed to parse setting as a size in bytes: [{s}]")
+        mult = {"b": 1, "kb": 1 << 10, "mb": 1 << 20, "gb": 1 << 30,
+                "tb": 1 << 40, "pb": 1 << 50}[m.group(2)]
+        return int(float(m.group(1)) * mult)
+
+
+class SplitProcessor(Processor):
+    def run(self, ctx):
+        field = _field(self.config)
+        value = path_get(ctx, field)
+        if value is None:
+            if self.config.get("ignore_missing", False):
+                return
+            raise IllegalArgumentError(f"field [{field}] is null")
+        parts = re.split(self.config.get("separator", " "), str(value))
+        if not self.config.get("preserve_trailing", False):
+            while parts and parts[-1] == "":
+                parts.pop()
+        path_set(ctx, self.config.get("target_field", field), parts)
+
+
+class JoinProcessor(Processor):
+    def run(self, ctx):
+        field = _field(self.config)
+        value = path_get(ctx, field)
+        if not isinstance(value, list):
+            raise IllegalArgumentError(
+                f"field [{field}] of type "
+                f"[{type(value).__name__}] cannot be cast to a list")
+        path_set(ctx, self.config.get("target_field", field),
+                 str(self.config.get("separator", "")).join(
+                     str(v) for v in value))
+
+
+class GsubProcessor(Processor):
+    def run(self, ctx):
+        field = _field(self.config)
+        value = path_get(ctx, field)
+        if value is None:
+            if self.config.get("ignore_missing", False):
+                return
+            raise IllegalArgumentError(f"field [{field}] is null")
+        path_set(ctx, self.config.get("target_field", field),
+                 re.sub(self.config["pattern"], self.config["replacement"],
+                        str(value)))
+
+
+class AppendProcessor(Processor):
+    def run(self, ctx):
+        field = render_template(_field(self.config), ctx)
+        value = self.config.get("value")
+        values = value if isinstance(value, list) else [value]
+        values = [render_template(v, ctx) for v in values]
+        cur = path_get(ctx, field)
+        if cur is None:
+            path_set(ctx, field, list(values))
+        elif isinstance(cur, list):
+            if self.config.get("allow_duplicates", True):
+                cur.extend(values)
+            else:
+                cur.extend(v for v in values if v not in cur)
+        else:
+            path_set(ctx, field, [cur, *values])
+
+
+class KvProcessor(Processor):
+    def run(self, ctx):
+        field = _field(self.config)
+        value = path_get(ctx, field)
+        if value is None:
+            if self.config.get("ignore_missing", False):
+                return
+            raise IllegalArgumentError(f"field [{field}] is null")
+        field_split = self.config.get("field_split", " ")
+        value_split = self.config.get("value_split", "=")
+        target = self.config.get("target_field")
+        include = self.config.get("include_keys")
+        exclude = set(self.config.get("exclude_keys") or [])
+        prefix = self.config.get("prefix", "")
+        out_base = path_get(ctx, target) if target and \
+            isinstance(path_get(ctx, target), dict) else None
+        for pair in re.split(field_split, str(value)):
+            if value_split not in pair:
+                if self.config.get("strip_brackets") or not pair:
+                    continue
+                continue
+            k, v = re.split(value_split, pair, maxsplit=1)
+            if self.config.get("strip_brackets", False):
+                v = v.strip("()<>[]\"'")
+            if include is not None and k not in include:
+                continue
+            if k in exclude:
+                continue
+            key = prefix + k
+            if target:
+                if out_base is None:
+                    out_base = {}
+                    path_set(ctx, target, out_base)
+                out_base[key] = v
+            else:
+                path_set(ctx, key, v)
+
+
+class JsonProcessor(Processor):
+    def run(self, ctx):
+        field = _field(self.config)
+        value = path_get(ctx, field)
+        try:
+            parsed = json.loads(value)
+        except (json.JSONDecodeError, TypeError) as e:
+            raise IllegalArgumentError(f"unable to parse [{value}] as JSON") \
+                from e
+        if self.config.get("add_to_root", False):
+            if not isinstance(parsed, dict):
+                raise IllegalArgumentError(
+                    "cannot add non-map fields to root of document")
+            ctx.update(parsed)
+        else:
+            path_set(ctx, self.config.get("target_field", field), parsed)
+
+
+class ScriptProcessor(Processor):
+    def __init__(self, type_name, config):
+        super().__init__(type_name, config)
+        spec = self.config.get("script", self.config)
+        source = spec.get("source") if isinstance(spec, dict) else spec
+        if not source:
+            raise IllegalArgumentError("[script] required property 'source'")
+        self.stmts = parse(source)
+        self.params = (spec.get("params") or {}) if isinstance(spec, dict) \
+            else {}
+
+    def run(self, ctx):
+        HostEvaluator({"ctx": ctx,
+                       "params": dict(self.params)}).run(self.stmts)
+
+
+class GrokProcessor(Processor):
+    def __init__(self, type_name, config):
+        super().__init__(type_name, config)
+        patterns = self.config.get("patterns")
+        if not patterns:
+            raise IllegalArgumentError("[patterns] required property is missing")
+        custom = self.config.get("pattern_definitions")
+        self.groks = [Grok(p, custom) for p in patterns]
+
+    def run(self, ctx):
+        field = _field(self.config)
+        value = path_get(ctx, field)
+        if value is None:
+            if self.config.get("ignore_missing", False):
+                return
+            raise IllegalArgumentError(f"field [{field}] is null")
+        for grok in self.groks:
+            m = grok.match(str(value))
+            if m is not None:
+                for k, v in m.items():
+                    path_set(ctx, k, v)
+                return
+        raise IllegalArgumentError("Provided Grok expressions do not match "
+                                   f"field value: [{value}]")
+
+
+class DissectProcessor(Processor):
+    def __init__(self, type_name, config):
+        super().__init__(type_name, config)
+        self.dissect = Dissect(_field(self.config, "pattern"),
+                               self.config.get("append_separator", ""))
+
+    def run(self, ctx):
+        field = _field(self.config)
+        value = path_get(ctx, field)
+        if value is None:
+            if self.config.get("ignore_missing", False):
+                return
+            raise IllegalArgumentError(f"field [{field}] is null")
+        m = self.dissect.match(str(value))
+        if m is None:
+            raise IllegalArgumentError(
+                f"Unable to find match for dissect pattern against source: "
+                f"[{value}]")
+        for k, v in m.items():
+            path_set(ctx, k, v)
+
+
+class ForeachProcessor(Processor):
+    def __init__(self, type_name, config):
+        super().__init__(type_name, config)
+        self.inner = build_processor(self.config.get("processor"))
+
+    def run(self, ctx):
+        field = _field(self.config)
+        values = path_get(ctx, field)
+        if values is None:
+            if self.config.get("ignore_missing", False):
+                return
+            raise IllegalArgumentError(f"field [{field}] is null")
+        out = []
+        for v in list(values):
+            ctx.setdefault("_ingest", {})["_value"] = v
+            self.inner.execute(ctx)
+            out.append(ctx["_ingest"]["_value"])
+        ctx.get("_ingest", {}).pop("_value", None)
+        path_set(ctx, field, out)
+
+
+class FailProcessor(Processor):
+    def run(self, ctx):
+        raise IngestProcessorError(
+            str(render_template(self.config.get("message", "Fail processor "
+                                                "executed"), ctx)))
+
+
+class DropProcessor(Processor):
+    def run(self, ctx):
+        raise DropSignal()
+
+
+class PipelineProcessor(Processor):
+    def __init__(self, type_name, config, service: "IngestService" = None):
+        super().__init__(type_name, config)
+        self.service = service
+
+    def run(self, ctx):
+        name = _field(self.config, "name")
+        pipeline = self.service.pipelines.get(name) if self.service else None
+        if pipeline is None:
+            if self.config.get("ignore_missing_pipeline", False):
+                return
+            raise IllegalArgumentError(
+                f"Pipeline processor configured for non-existent pipeline "
+                f"[{name}]")
+        pipeline.run(ctx)
+
+
+class DotExpanderProcessor(Processor):
+    def run(self, ctx):
+        field = _field(self.config)
+        if field == "*":
+            for key in [k for k in list(ctx) if "." in k]:
+                val = ctx.pop(key)
+                path_set(ctx, key, val)
+            return
+        if field in ctx:
+            val = ctx.pop(field)
+            path_set(ctx, field, val)
+
+
+class CsvProcessor(Processor):
+    def run(self, ctx):
+        import csv as _csv
+        import io
+        field = _field(self.config)
+        value = path_get(ctx, field)
+        if value is None:
+            if self.config.get("ignore_missing", False):
+                return
+            raise IllegalArgumentError(f"field [{field}] is null")
+        targets = self.config.get("target_fields") or []
+        row = next(_csv.reader(io.StringIO(str(value)),
+                               delimiter=self.config.get("separator", ","),
+                               quotechar=self.config.get("quote", '"')))
+        for name, val in zip(targets, row):
+            if val != "" or not self.config.get("empty_value"):
+                path_set(ctx, name, val if val != ""
+                         else self.config.get("empty_value", ""))
+
+
+PROCESSOR_TYPES: Dict[str, Callable] = {
+    "set": SetProcessor, "remove": RemoveProcessor, "rename": RenameProcessor,
+    "convert": ConvertProcessor, "date": DateProcessor,
+    "lowercase": LowercaseProcessor, "uppercase": UppercaseProcessor,
+    "trim": TrimProcessor, "html_strip": HtmlStripProcessor,
+    "urldecode": UrlDecodeProcessor, "bytes": BytesProcessor,
+    "split": SplitProcessor, "join": JoinProcessor, "gsub": GsubProcessor,
+    "append": AppendProcessor, "kv": KvProcessor, "json": JsonProcessor,
+    "script": ScriptProcessor, "grok": GrokProcessor,
+    "dissect": DissectProcessor, "foreach": ForeachProcessor,
+    "fail": FailProcessor, "drop": DropProcessor,
+    "dot_expander": DotExpanderProcessor, "csv": CsvProcessor,
+}
+
+
+def build_processor(spec: dict, service: "IngestService" = None) -> Processor:
+    if not isinstance(spec, dict) or len(spec) != 1:
+        raise IllegalArgumentError(
+            "processor must be an object with exactly one key (its type)")
+    type_name, config = next(iter(spec.items()))
+    if type_name == "pipeline":
+        return PipelineProcessor(type_name, dict(config or {}), service)
+    cls = PROCESSOR_TYPES.get(type_name)
+    if cls is None:
+        raise IllegalArgumentError(
+            f"No processor type exists with name [{type_name}]")
+    return cls(type_name, dict(config or {}))
+
+
+# ----------------------------------------------------------------- pipeline
+
+class Pipeline:
+    def __init__(self, pipeline_id: str, body: dict,
+                 service: "IngestService" = None):
+        self.pipeline_id = pipeline_id
+        self.description = body.get("description")
+        self.version = body.get("version")
+        procs = body.get("processors")
+        if procs is None:
+            raise IllegalArgumentError(
+                "[processors] required property is missing")
+        self.processors = [build_processor(p, service) for p in procs]
+        self.on_failure = [build_processor(p, service)
+                           for p in (body.get("on_failure") or [])]
+        self.body = body
+
+    def run(self, ctx: dict) -> dict:
+        try:
+            for p in self.processors:
+                p.execute(ctx)
+        except DropSignal:
+            raise
+        except Exception as e:
+            if self.on_failure:
+                ctx.setdefault("_ingest", {})["on_failure_message"] = str(e)
+                for p in self.on_failure:
+                    p.execute(ctx)
+            else:
+                raise
+        return ctx
+
+
+class IngestService:
+    def __init__(self):
+        self.pipelines: Dict[str, Pipeline] = {}
+
+    def put_pipeline(self, pipeline_id: str, body: dict):
+        self.pipelines[pipeline_id] = Pipeline(pipeline_id, body, self)
+
+    def get_pipeline(self, pipeline_id: str) -> Optional[Pipeline]:
+        return self.pipelines.get(pipeline_id)
+
+    def delete_pipeline(self, pipeline_id: str) -> bool:
+        return self.pipelines.pop(pipeline_id, None) is not None
+
+    def execute(self, pipeline_id: str, source: dict,
+                meta: Optional[dict] = None) -> Optional[dict]:
+        """Run a doc through a pipeline. Returns the transformed source, or
+        None if the doc was dropped. `meta` (_index/_id/...) is visible to
+        scripts as ctx fields, like the reference's IngestDocument
+        metadata."""
+        pipeline = self.pipelines.get(pipeline_id)
+        if pipeline is None:
+            raise IllegalArgumentError(
+                f"pipeline with id [{pipeline_id}] does not exist")
+        ctx = dict(source)
+        ctx["_ingest"] = {"timestamp":
+                          _dt.datetime.now(_dt.timezone.utc).isoformat()}
+        for k, v in (meta or {}).items():
+            ctx[k] = v
+        try:
+            pipeline.run(ctx)
+        except DropSignal:
+            return None
+        ctx.pop("_ingest", None)
+        for k in list(meta or {}):
+            ctx.pop(k, None)
+        return ctx
+
+    def simulate(self, body: dict, pipeline_id: Optional[str] = None) -> dict:
+        if pipeline_id:
+            pipeline = self.pipelines.get(pipeline_id)
+            if pipeline is None:
+                raise IllegalArgumentError(
+                    f"pipeline with id [{pipeline_id}] does not exist")
+        else:
+            pipeline = Pipeline("_simulate_pipeline",
+                                body.get("pipeline") or {}, self)
+        docs = []
+        for doc_spec in body.get("docs") or []:
+            src = dict(doc_spec.get("_source") or {})
+            ctx = dict(src)
+            ctx["_ingest"] = {"timestamp":
+                              _dt.datetime.now(_dt.timezone.utc).isoformat()}
+            try:
+                pipeline.run(ctx)
+                ts = ctx.pop("_ingest", {}).get("timestamp")
+                docs.append({"doc": {
+                    "_index": doc_spec.get("_index", "_index"),
+                    "_id": doc_spec.get("_id", "_id"),
+                    "_source": ctx,
+                    "_ingest": {"timestamp": ts},
+                }})
+            except DropSignal:
+                docs.append({"doc": None})
+            except OpenSearchTpuError as e:
+                docs.append({"error": e.to_xcontent()})
+        return {"docs": docs}

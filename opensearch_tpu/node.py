@@ -30,7 +30,12 @@ class Node:
         self.cluster_name = cluster_name
         self.settings = settings or {}
         self.start_time_ms = int(time.time() * 1000)
-        self.indices = IndicesService(data_path=data_path)
+        from opensearch_tpu.ingest.service import IngestService
+        from opensearch_tpu.script.service import ScriptService
+        self.script_service = ScriptService()
+        self.ingest = IngestService()
+        self.indices = IndicesService(data_path=data_path,
+                                      script_service=self.script_service)
         self.cluster_settings: Dict[str, Any] = {"persistent": {},
                                                  "transient": {}}
         self.scroll_contexts: Dict[str, Any] = {}

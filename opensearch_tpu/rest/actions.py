@@ -74,12 +74,33 @@ def register_document_actions(node, c):
         if req.param("refresh") in ("true", "", "wait_for"):
             svc.refresh()
 
+    def run_pipelines(svc, idx, doc_id, source, pipeline_param):
+        """default_pipeline / request pipeline / final_pipeline chain
+        (reference: TransportBulkAction ingest reroute + IngestService).
+        Returns None when a drop processor dropped the doc."""
+        pipeline = pipeline_param or svc.settings.get("default_pipeline")
+        meta = {"_index": idx, "_id": doc_id}
+        if pipeline and pipeline != "_none":
+            source = node.ingest.execute(pipeline, source, meta)
+            if source is None:
+                return None
+        final = svc.settings.get("final_pipeline")
+        if final and final != "_none":
+            source = node.ingest.execute(final, source, meta)
+        return source
+
     def do_index(req):
         idx = node.indices.write_index(req.param("index"))
         svc = node.indices.get(idx)
         doc_id = req.param("id")
         op_type = req.param("op_type", "index")
-        res = svc.index_doc(doc_id, req.body or {},
+        source = run_pipelines(svc, idx, doc_id, req.body or {},
+                               req.param("pipeline"))
+        if source is None:
+            return 200, {"_index": idx, "_id": doc_id, "result": "noop",
+                         "_shards": {"total": 0, "successful": 0,
+                                     "failed": 0}}
+        res = svc.index_doc(doc_id, source,
                             routing=req.param("routing"),
                             op_type=op_type, **write_params(req))
         maybe_refresh(req, svc)
@@ -188,8 +209,24 @@ def register_document_actions(node, c):
         took = 0
         for concrete, positions in by_index.items():
             svc = node.indices.get(concrete)
-            sub_ops = [items[p] for p in positions]
-            res = svc.bulk(sub_ops)
+            sub_ops = []
+            for p in positions:
+                item = items[p]
+                if item["action"] in ("index", "create"):
+                    source = run_pipelines(svc, concrete, item.get("id"),
+                                           item["source"],
+                                           req.param("pipeline"))
+                    if source is None:  # dropped by a pipeline
+                        responses[p] = {item["action"]: {
+                            "_index": concrete, "_id": item.get("id"),
+                            "result": "noop", "status": 200}}
+                        continue
+                    item = {**item, "source": source}
+                sub_ops.append((p, item))
+            if not sub_ops:
+                continue
+            res = svc.bulk([it for _, it in sub_ops])
+            positions = [p for p, _ in sub_ops]
             took = max(took, res.get("took", 0))
             errors = errors or res.get("errors", False)
             for p, item_res in zip(positions, res["items"]):
@@ -890,6 +927,62 @@ def register_cat_actions(node, c):
     c.register("GET", "/_cat/nodes", cat_nodes)
 
 
+# ------------------------------------------------------- scripts & ingest
+
+def register_script_ingest_actions(node, c):
+    def do_put_script(req):
+        node.script_service.put_stored(req.param("id"), req.body or {})
+        return {"acknowledged": True}
+
+    def do_get_script(req):
+        ss = node.script_service.get_stored(req.param("id"))
+        if ss is None:
+            return 404, {"_id": req.param("id"), "found": False}
+        return {"_id": req.param("id"), "found": True,
+                "script": ss.to_dict()}
+
+    def do_delete_script(req):
+        if not node.script_service.delete_stored(req.param("id")):
+            return 404, {"acknowledged": False}
+        return {"acknowledged": True}
+
+    def do_put_pipeline(req):
+        node.ingest.put_pipeline(req.param("id"), req.body or {})
+        return {"acknowledged": True}
+
+    def do_get_pipeline(req):
+        pid = req.param("id")
+        if pid:
+            p = node.ingest.get_pipeline(pid)
+            if p is None:
+                return 404, {}
+            return {pid: p.body}
+        return {pid: p.body for pid, p in node.ingest.pipelines.items()}
+
+    def do_delete_pipeline(req):
+        from opensearch_tpu.common.errors import IndexNotFoundError as _INF
+        if not node.ingest.delete_pipeline(req.param("id")):
+            raise IllegalArgumentError(
+                f"pipeline [{req.param('id')}] is missing")
+        return {"acknowledged": True}
+
+    def do_simulate(req):
+        return node.ingest.simulate(req.body or {}, req.param("id"))
+
+    c.register("PUT", "/_scripts/{id}", do_put_script)
+    c.register("POST", "/_scripts/{id}", do_put_script)
+    c.register("GET", "/_scripts/{id}", do_get_script)
+    c.register("DELETE", "/_scripts/{id}", do_delete_script)
+    c.register("PUT", "/_ingest/pipeline/{id}", do_put_pipeline)
+    c.register("GET", "/_ingest/pipeline", do_get_pipeline)
+    c.register("GET", "/_ingest/pipeline/{id}", do_get_pipeline)
+    c.register("DELETE", "/_ingest/pipeline/{id}", do_delete_pipeline)
+    c.register("POST", "/_ingest/pipeline/_simulate", do_simulate)
+    c.register("GET", "/_ingest/pipeline/_simulate", do_simulate)
+    c.register("POST", "/_ingest/pipeline/{id}/_simulate", do_simulate)
+    c.register("GET", "/_ingest/pipeline/{id}/_simulate", do_simulate)
+
+
 def register_all(node):
     c = node.controller
     register_cluster_actions(node, c)
@@ -898,3 +991,4 @@ def register_all(node):
     register_indices_actions(node, c)
     register_alias_template_actions(node, c)
     register_cat_actions(node, c)
+    register_script_ingest_actions(node, c)

@@ -1,0 +1,3 @@
+from opensearch_tpu.script.service import ScriptService
+
+__all__ = ["ScriptService"]

@@ -1,0 +1,1034 @@
+"""A painless-subset script language: lexer, parser, and two back-ends.
+
+Re-design of modules/lang-painless (the reference compiles painless through
+ANTLR → AST → IR → JVM bytecode, painless/Compiler.java:69). Here the same
+surface syntax compiles to:
+
+  - a **host evaluator** for mutation contexts (update scripts' `ctx._source`,
+    ingest processors' `ctx`, field scripts) — a tree-walking interpreter
+    over Python values with a whitelisted method table (no attribute access
+    to anything outside the script environment: this is the sandboxing
+    analog of painless's allowlist `lookup/`);
+  - a **JAX compiler** for score/filter contexts: the expression is compiled
+    to vectorized jnp ops over dense doc-value columns, so a script_score
+    runs as ONE fused XLA program over the whole segment instead of the
+    reference's per-document interpreted call — the TPU-native answer to
+    script scoring.
+
+Supported syntax: arithmetic/comparison/logic/ternary/elvis, method calls on
+strings/lists/maps/Math, `doc['field'].value`, `params.x`, `_score`, local
+`def` variables, assignment (incl. compound), if/else, for/while loops,
+return. No classes, no imports, no reflection — anything else raises.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field as dc_field
+from functools import lru_cache
+from typing import Any, Dict, List, Optional, Tuple
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+
+
+class ScriptError(OpenSearchTpuError):
+    status = 400
+    error_type = "script_exception"
+
+
+# ------------------------------------------------------------------- lexer
+
+_TOKEN_SPEC = [
+    ("NUM", r"\d+\.\d+[fFdD]?|\d+[lLfFdD]?|\.\d+[fFdD]?"),
+    ("STR", r"'(?:[^'\\]|\\.)*'|\"(?:[^\"\\]|\\.)*\""),
+    ("ID", r"[A-Za-z_][A-Za-z0-9_]*"),
+    ("OP", r"\?\:|\+\+|--|\+=|-=|\*=|/=|%=|==|!=|<=|>=|&&|\|\||[-+*/%<>=!?:.,;()\[\]{}]"),
+    ("WS", r"\s+|//[^\n]*"),
+]
+_TOKEN_RE = re.compile("|".join(f"(?P<{n}>{p})" for n, p in _TOKEN_SPEC))
+
+_KEYWORDS = {"if", "else", "for", "while", "def", "return", "true", "false",
+             "null", "in", "new"}
+_TYPE_NAMES = {"int", "long", "float", "double", "boolean", "String", "Map",
+               "List", "Object", "byte", "short", "char"}
+
+
+def tokenize(src: str) -> List[Tuple[str, str]]:
+    out = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if m is None:
+            raise ScriptError(f"unexpected character [{src[pos]}] at "
+                              f"offset [{pos}]")
+        kind = m.lastgroup
+        text = m.group(0)
+        pos = m.end()
+        if kind == "WS":
+            continue
+        if kind == "ID" and text in _KEYWORDS:
+            kind = text.upper()
+        out.append((kind, text))
+    out.append(("EOF", ""))
+    return out
+
+
+# --------------------------------------------------------------------- AST
+
+@dataclass
+class Node:
+    pass
+
+
+@dataclass
+class Num(Node):
+    value: float
+    is_int: bool
+
+
+@dataclass
+class Str(Node):
+    value: str
+
+
+@dataclass
+class Bool(Node):
+    value: bool
+
+
+@dataclass
+class Null(Node):
+    pass
+
+
+@dataclass
+class Var(Node):
+    name: str
+
+
+@dataclass
+class Attr(Node):
+    obj: Node
+    name: str
+
+
+@dataclass
+class Index(Node):
+    obj: Node
+    key: Node
+
+
+@dataclass
+class Call(Node):
+    obj: Optional[Node]     # None = free function (unused today)
+    name: str
+    args: List[Node]
+
+
+@dataclass
+class Bin(Node):
+    op: str
+    left: Node
+    right: Node
+
+
+@dataclass
+class Un(Node):
+    op: str
+    value: Node
+
+
+@dataclass
+class Ternary(Node):
+    cond: Node
+    then: Node
+    other: Node
+
+
+@dataclass
+class Elvis(Node):
+    value: Node
+    fallback: Node
+
+
+@dataclass
+class ListLit(Node):
+    items: List[Node]
+
+
+@dataclass
+class MapLit(Node):
+    pairs: List[Tuple[Node, Node]]
+
+
+@dataclass
+class Assign(Node):
+    target: Node       # Var | Attr | Index
+    op: str            # "=", "+=", ...
+    value: Node
+
+
+@dataclass
+class If(Node):
+    cond: Node
+    then: List[Node]
+    other: List[Node] = dc_field(default_factory=list)
+
+
+@dataclass
+class For(Node):
+    init: Optional[Node]
+    cond: Optional[Node]
+    step: Optional[Node]
+    body: List[Node] = dc_field(default_factory=list)
+
+
+@dataclass
+class ForIn(Node):
+    var: str
+    iterable: Node
+    body: List[Node] = dc_field(default_factory=list)
+
+
+@dataclass
+class While(Node):
+    cond: Node
+    body: List[Node] = dc_field(default_factory=list)
+
+
+@dataclass
+class Decl(Node):
+    name: str
+    value: Optional[Node]
+
+
+@dataclass
+class Return(Node):
+    value: Optional[Node]
+
+
+@dataclass
+class ExprStmt(Node):
+    expr: Node
+
+
+# ------------------------------------------------------------------ parser
+
+class Parser:
+    def __init__(self, tokens):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self, offset=0):
+        return self.toks[min(self.i + offset, len(self.toks) - 1)]
+
+    def next(self):
+        tok = self.toks[self.i]
+        self.i += 1
+        return tok
+
+    def accept(self, kind, text=None):
+        k, t = self.peek()
+        if k == kind and (text is None or t == text):
+            return self.next()
+        return None
+
+    def expect(self, kind, text=None):
+        tok = self.accept(kind, text)
+        if tok is None:
+            k, t = self.peek()
+            raise ScriptError(f"unexpected token [{t or k}], expected "
+                              f"[{text or kind}]")
+        return tok
+
+    # statements
+
+    def parse_program(self) -> List[Node]:
+        stmts = []
+        while self.peek()[0] != "EOF":
+            stmts.append(self.statement())
+        return stmts
+
+    def block(self) -> List[Node]:
+        if self.accept("OP", "{"):
+            stmts = []
+            while not self.accept("OP", "}"):
+                stmts.append(self.statement())
+            return stmts
+        return [self.statement()]
+
+    def statement(self) -> Node:
+        k, t = self.peek()
+        if k == "IF":
+            self.next()
+            self.expect("OP", "(")
+            cond = self.expression()
+            self.expect("OP", ")")
+            then = self.block()
+            other = []
+            if self.accept("ELSE"):
+                other = self.block()
+            return If(cond, then, other)
+        if k == "FOR":
+            self.next()
+            self.expect("OP", "(")
+            # for-in:  for (def x : list)  /  for (x in list)
+            if (self.peek()[0] in ("DEF", "ID")
+                    and (self.peek(1)[1] == ":" or self.peek(2)[1] == ":"
+                         or self.peek(1)[0] == "IN" or self.peek(2)[0] == "IN")):
+                save = self.i
+                self.accept("DEF") or (self.peek()[0] == "ID"
+                                       and self.peek()[1] in _TYPE_NAMES
+                                       and self.next())
+                name_tok = self.accept("ID")
+                if name_tok and (self.accept("OP", ":") or self.accept("IN")):
+                    iterable = self.expression()
+                    self.expect("OP", ")")
+                    return ForIn(name_tok[1], iterable, self.block())
+                self.i = save
+            init = None if self.peek()[1] == ";" else self.simple_statement()
+            self.expect("OP", ";")
+            cond = None if self.peek()[1] == ";" else self.expression()
+            self.expect("OP", ";")
+            step = None if self.peek()[1] == ")" else self.simple_statement()
+            self.expect("OP", ")")
+            return For(init, cond, step, self.block())
+        if k == "WHILE":
+            self.next()
+            self.expect("OP", "(")
+            cond = self.expression()
+            self.expect("OP", ")")
+            return While(cond, self.block())
+        if k == "RETURN":
+            self.next()
+            value = None if self.peek()[1] == ";" or self.peek()[0] == "EOF" \
+                else self.expression()
+            self.accept("OP", ";")
+            return Return(value)
+        stmt = self.simple_statement()
+        self.accept("OP", ";")
+        return stmt
+
+    def simple_statement(self) -> Node:
+        k, t = self.peek()
+        if k == "OP" and t in ("++", "--"):  # prefix increment statement
+            self.next()
+            target = self.postfix()
+            if not isinstance(target, (Var, Attr, Index)):
+                raise ScriptError("invalid increment target")
+            return Assign(target, "+=" if t == "++" else "-=", Num(1, True))
+        if k == "DEF" or (k == "ID" and t in _TYPE_NAMES
+                          and self.peek(1)[0] == "ID"):
+            self.next()
+            name = self.expect("ID")[1]
+            value = None
+            if self.accept("OP", "="):
+                value = self.expression()
+            return Decl(name, value)
+        expr = self.expression()
+        k, t = self.peek()
+        if k == "OP" and t in ("=", "+=", "-=", "*=", "/=", "%="):
+            self.next()
+            if not isinstance(expr, (Var, Attr, Index)):
+                raise ScriptError("invalid assignment target")
+            return Assign(expr, t, self.expression())
+        if k == "OP" and t in ("++", "--"):
+            self.next()
+            if not isinstance(expr, (Var, Attr, Index)):
+                raise ScriptError("invalid increment target")
+            return Assign(expr, "+=" if t == "++" else "-=",
+                          Num(1, True))
+        return ExprStmt(expr)
+
+    # expressions (precedence climbing)
+
+    def expression(self) -> Node:
+        return self.ternary()
+
+    def ternary(self) -> Node:
+        cond = self.elvis()
+        if self.accept("OP", "?"):
+            then = self.expression()
+            self.expect("OP", ":")
+            other = self.expression()
+            return Ternary(cond, then, other)
+        return cond
+
+    def elvis(self) -> Node:
+        left = self.logic_or()
+        if self.accept("OP", "?:"):
+            return Elvis(left, self.elvis())
+        return left
+
+    def logic_or(self) -> Node:
+        left = self.logic_and()
+        while self.accept("OP", "||"):
+            left = Bin("||", left, self.logic_and())
+        return left
+
+    def logic_and(self) -> Node:
+        left = self.equality()
+        while self.accept("OP", "&&"):
+            left = Bin("&&", left, self.equality())
+        return left
+
+    def equality(self) -> Node:
+        left = self.relational()
+        while self.peek()[1] in ("==", "!=") and self.peek()[0] == "OP":
+            op = self.next()[1]
+            left = Bin(op, left, self.relational())
+        return left
+
+    def relational(self) -> Node:
+        left = self.additive()
+        while self.peek()[1] in ("<", "<=", ">", ">=") and self.peek()[0] == "OP":
+            op = self.next()[1]
+            left = Bin(op, left, self.additive())
+        return left
+
+    def additive(self) -> Node:
+        left = self.multiplicative()
+        while self.peek()[1] in ("+", "-") and self.peek()[0] == "OP":
+            op = self.next()[1]
+            left = Bin(op, left, self.multiplicative())
+        return left
+
+    def multiplicative(self) -> Node:
+        left = self.unary()
+        while self.peek()[1] in ("*", "/", "%") and self.peek()[0] == "OP":
+            op = self.next()[1]
+            left = Bin(op, left, self.unary())
+        return left
+
+    def unary(self) -> Node:
+        if self.accept("OP", "-"):
+            return Un("-", self.unary())
+        if self.accept("OP", "!"):
+            return Un("!", self.unary())
+        if self.accept("OP", "+"):
+            return self.unary()
+        return self.postfix()
+
+    def postfix(self) -> Node:
+        node = self.primary()
+        while True:
+            if self.accept("OP", "."):
+                name = self.expect("ID")[1]
+                if self.accept("OP", "("):
+                    args = self.call_args()
+                    node = Call(node, name, args)
+                else:
+                    node = Attr(node, name)
+            elif self.accept("OP", "["):
+                key = self.expression()
+                self.expect("OP", "]")
+                node = Index(node, key)
+            else:
+                return node
+
+    def call_args(self) -> List[Node]:
+        args = []
+        if self.accept("OP", ")"):
+            return args
+        args.append(self.expression())
+        while self.accept("OP", ","):
+            args.append(self.expression())
+        self.expect("OP", ")")
+        return args
+
+    def primary(self) -> Node:
+        k, t = self.peek()
+        if k == "NUM":
+            self.next()
+            text = t.rstrip("lLfFdD")
+            if "." in text or t[-1] in "fFdD":
+                return Num(float(text), False)
+            return Num(float(int(text)), True)
+        if k == "STR":
+            self.next()
+            body = t[1:-1]
+            body = body.replace("\\'", "'").replace('\\"', '"') \
+                       .replace("\\n", "\n").replace("\\t", "\t") \
+                       .replace("\\\\", "\\")
+            return Str(body)
+        if k == "TRUE":
+            self.next()
+            return Bool(True)
+        if k == "FALSE":
+            self.next()
+            return Bool(False)
+        if k == "NULL":
+            self.next()
+            return Null()
+        if k == "NEW":  # new ArrayList() / new HashMap()
+            self.next()
+            name = self.expect("ID")[1]
+            self.expect("OP", "(")
+            self.expect("OP", ")")
+            if "List" in name:
+                return ListLit([])
+            if "Map" in name:
+                return MapLit([])
+            raise ScriptError(f"cannot construct [{name}]")
+        if k == "ID":
+            self.next()
+            return Var(t)
+        if k == "OP" and t == "(":
+            self.next()
+            expr = self.expression()
+            self.expect("OP", ")")
+            return expr
+        if k == "OP" and t == "[":  # [1, 2] list / [:] map literal
+            self.next()
+            if self.accept("OP", ":"):
+                self.expect("OP", "]")
+                return MapLit([])
+            items = []
+            if not self.accept("OP", "]"):
+                items.append(self.expression())
+                while self.accept("OP", ","):
+                    items.append(self.expression())
+                self.expect("OP", "]")
+            if items and all(isinstance(i, Bin) and i.op == ":" for i in items):
+                return MapLit([(i.left, i.right) for i in items])
+            return ListLit(items)
+        raise ScriptError(f"unexpected token [{t or k}]")
+
+
+@lru_cache(maxsize=512)
+def parse(source: str) -> Tuple[Node, ...]:
+    return tuple(Parser(tokenize(source)).parse_program())
+
+
+def collect_doc_fields(stmts) -> List[str]:
+    """Fields the script reads through doc['...'] — what the JAX back-end
+    must materialize as dense columns."""
+    fields: List[str] = []
+
+    def walk(n):
+        if isinstance(n, Index) and isinstance(n.obj, Var) \
+                and n.obj.name == "doc" and isinstance(n.key, Str):
+            if n.key.value not in fields:
+                fields.append(n.key.value)
+        for f in getattr(n, "__dataclass_fields__", {}):
+            v = getattr(n, f)
+            if isinstance(v, Node):
+                walk(v)
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    if isinstance(item, Node):
+                        walk(item)
+                    elif isinstance(item, tuple):
+                        for sub in item:
+                            if isinstance(sub, Node):
+                                walk(sub)
+
+    for s in stmts:
+        walk(s)
+    return fields
+
+
+# ---------------------------------------------------------- host evaluator
+
+class _ReturnSignal(Exception):
+    def __init__(self, value):
+        self.value = value
+
+
+_MATH_FNS = {
+    "log": math.log, "log10": math.log10, "exp": math.exp,
+    "sqrt": math.sqrt, "abs": abs, "max": max, "min": min,
+    "pow": math.pow, "floor": math.floor, "ceil": math.ceil,
+    "round": round, "sin": math.sin, "cos": math.cos, "tan": math.tan,
+}
+_MATH_CONSTS = {"PI": math.pi, "E": math.e}
+
+_MAX_LOOP_ITERS = 100_000  # runaway-loop guard (painless has a loop counter)
+
+
+class HostEvaluator:
+    """Tree-walking interpreter for mutation/field contexts."""
+
+    def __init__(self, env: Dict[str, Any]):
+        self.scopes = [dict(env)]
+
+    def run(self, stmts) -> Any:
+        try:
+            result = None
+            for s in stmts:
+                result = self.exec_stmt(s)
+            return result
+        except _ReturnSignal as r:
+            return r.value
+
+    # statements
+
+    def exec_stmt(self, n) -> Any:
+        if isinstance(n, ExprStmt):
+            return self.eval(n.expr)
+        if isinstance(n, Decl):
+            self.scopes[-1][n.name] = self.eval(n.value) if n.value else None
+            return None
+        if isinstance(n, Assign):
+            value = self.eval(n.value)
+            if n.op != "=":
+                value = self._binop(n.op[0], self.eval(n.target), value)
+            self._store(n.target, value)
+            return None
+        if isinstance(n, If):
+            branch = n.then if _truthy(self.eval(n.cond)) else n.other
+            for s in branch:
+                self.exec_stmt(s)
+            return None
+        if isinstance(n, While):
+            iters = 0
+            while _truthy(self.eval(n.cond)):
+                iters += 1
+                if iters > _MAX_LOOP_ITERS:
+                    raise ScriptError("script loop iteration limit reached")
+                for s in n.body:
+                    self.exec_stmt(s)
+            return None
+        if isinstance(n, For):
+            if n.init is not None:
+                self.exec_stmt(n.init)
+            iters = 0
+            while n.cond is None or _truthy(self.eval(n.cond)):
+                iters += 1
+                if iters > _MAX_LOOP_ITERS:
+                    raise ScriptError("script loop iteration limit reached")
+                for s in n.body:
+                    self.exec_stmt(s)
+                if n.step is not None:
+                    self.exec_stmt(n.step)
+            return None
+        if isinstance(n, ForIn):
+            iterable = self.eval(n.iterable)
+            for item in list(iterable or []):
+                self.scopes[-1][n.var] = item
+                for s in n.body:
+                    self.exec_stmt(s)
+            return None
+        if isinstance(n, Return):
+            raise _ReturnSignal(self.eval(n.value) if n.value else None)
+        raise ScriptError(f"unsupported statement [{type(n).__name__}]")
+
+    def _store(self, target, value):
+        if isinstance(target, Var):
+            for scope in reversed(self.scopes):
+                if target.name in scope:
+                    scope[target.name] = value
+                    return
+            self.scopes[-1][target.name] = value
+            return
+        if isinstance(target, Attr):
+            obj = self.eval(target.obj)
+            if isinstance(obj, dict):
+                obj[target.name] = value
+                return
+            raise ScriptError(f"cannot assign field [{target.name}]")
+        if isinstance(target, Index):
+            obj = self.eval(target.obj)
+            key = self.eval(target.key)
+            if isinstance(obj, list):
+                obj[int(key)] = value
+            elif isinstance(obj, dict):
+                obj[key] = value
+            else:
+                raise ScriptError("cannot index-assign this value")
+            return
+        raise ScriptError("invalid assignment target")
+
+    # expressions
+
+    def eval(self, n) -> Any:
+        if isinstance(n, Num):
+            return int(n.value) if n.is_int else n.value
+        if isinstance(n, Str):
+            return n.value
+        if isinstance(n, Bool):
+            return n.value
+        if isinstance(n, Null):
+            return None
+        if isinstance(n, ListLit):
+            return [self.eval(i) for i in n.items]
+        if isinstance(n, MapLit):
+            return {self.eval(k): self.eval(v) for k, v in n.pairs}
+        if isinstance(n, Var):
+            for scope in reversed(self.scopes):
+                if n.name in scope:
+                    return scope[n.name]
+            if n.name == "Math":
+                return _MATH_MARKER
+            raise ScriptError(f"variable [{n.name}] is not defined")
+        if isinstance(n, Attr):
+            obj = self.eval(n.obj)
+            return self._getattr(obj, n.name)
+        if isinstance(n, Index):
+            obj = self.eval(n.obj)
+            key = self.eval(n.key)
+            if isinstance(obj, list):
+                idx = int(key)
+                return obj[idx] if -len(obj) <= idx < len(obj) else None
+            if isinstance(obj, dict):
+                return obj.get(key)
+            if isinstance(obj, str):
+                return obj[int(key)]
+            if obj is None:
+                raise ScriptError("cannot index null")
+            raise ScriptError(f"cannot index [{type(obj).__name__}]")
+        if isinstance(n, Call):
+            return self._call(n)
+        if isinstance(n, Bin):
+            if n.op == "&&":
+                return _truthy(self.eval(n.left)) and _truthy(self.eval(n.right))
+            if n.op == "||":
+                return _truthy(self.eval(n.left)) or _truthy(self.eval(n.right))
+            return self._binop(n.op, self.eval(n.left), self.eval(n.right))
+        if isinstance(n, Un):
+            v = self.eval(n.value)
+            if n.op == "-":
+                return -v
+            return not _truthy(v)
+        if isinstance(n, Ternary):
+            return self.eval(n.then) if _truthy(self.eval(n.cond)) \
+                else self.eval(n.other)
+        if isinstance(n, Elvis):
+            v = self.eval(n.value)
+            return v if v is not None else self.eval(n.fallback)
+        raise ScriptError(f"unsupported expression [{type(n).__name__}]")
+
+    def _binop(self, op, a, b):
+        if op == "+":
+            if isinstance(a, str) or isinstance(b, str):
+                return _to_str(a) + _to_str(b)
+            return a + b
+        if op == "-":
+            return a - b
+        if op == "*":
+            return a * b
+        if op == "/":
+            if isinstance(a, int) and isinstance(b, int):
+                q = a // b
+                if q < 0 and a % b != 0:
+                    q += 1  # Java integer division truncates toward zero
+                return q
+            return a / b
+        if op == "%":
+            r = abs(a) % abs(b)
+            return r if a >= 0 else -r  # Java remainder semantics
+        if op == "==":
+            return a == b
+        if op == "!=":
+            return a != b
+        if op == "<":
+            return a < b
+        if op == "<=":
+            return a <= b
+        if op == ">":
+            return a > b
+        if op == ">=":
+            return a >= b
+        raise ScriptError(f"unsupported operator [{op}]")
+
+    def _getattr(self, obj, name):
+        if obj is _MATH_MARKER:
+            if name in _MATH_CONSTS:
+                return _MATH_CONSTS[name]
+            raise ScriptError(f"unknown Math constant [{name}]")
+        if isinstance(obj, dict):
+            return obj.get(name)
+        if isinstance(obj, DocField):
+            if name == "value":
+                return obj.value
+            if name == "values":
+                return obj.values
+            if name == "empty":
+                return len(obj.values) == 0
+            if name == "length":
+                return len(obj.values)
+        if isinstance(obj, str) and name == "length":
+            return len(obj)
+        if obj is None:
+            raise ScriptError(f"cannot access [{name}] on null")
+        raise ScriptError(f"cannot access field [{name}] on "
+                          f"[{type(obj).__name__}]")
+
+    def _call(self, n: Call):
+        args = [self.eval(a) for a in n.args]
+        obj = self.eval(n.obj) if n.obj is not None else None
+        name = n.name
+        if obj is _MATH_MARKER:
+            fn = _MATH_FNS.get(name)
+            if fn is None:
+                raise ScriptError(f"unknown Math method [{name}]")
+            return fn(*args)
+        if isinstance(obj, str):
+            return _string_method(obj, name, args)
+        if isinstance(obj, list):
+            return _list_method(obj, name, args)
+        if isinstance(obj, dict):
+            return _map_method(obj, name, args)
+        if isinstance(obj, DocField):
+            if name == "size":
+                return len(obj.values)
+            if name == "contains":
+                return args[0] in obj.values
+        if isinstance(obj, (int, float)):
+            if name == "intValue":
+                return int(obj)
+            if name == "doubleValue" or name == "floatValue":
+                return float(obj)
+            if name == "longValue":
+                return int(obj)
+            if name == "toString":
+                return _to_str(obj)
+        if obj is None:
+            raise ScriptError(f"cannot call [{name}] on null")
+        raise ScriptError(f"unknown method [{name}] on "
+                          f"[{type(obj).__name__}]")
+
+
+class _MathMarker:
+    pass
+
+
+_MATH_MARKER = _MathMarker()
+
+
+def _truthy(v) -> bool:
+    if isinstance(v, bool) or v is None:
+        return bool(v)
+    if isinstance(v, (int, float, str, list, dict)):
+        return bool(v)
+    return True
+
+
+def _to_str(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return "null"
+    if isinstance(v, float) and v.is_integer():
+        return f"{v:.1f}"
+    return str(v)
+
+
+def _string_method(s: str, name: str, args):
+    table = {
+        "contains": lambda: args[0] in s,
+        "startsWith": lambda: s.startswith(args[0]),
+        "endsWith": lambda: s.endswith(args[0]),
+        "toLowerCase": lambda: s.lower(),
+        "toUpperCase": lambda: s.upper(),
+        "trim": lambda: s.strip(),
+        "length": lambda: len(s),
+        "isEmpty": lambda: len(s) == 0,
+        "indexOf": lambda: s.find(*args),
+        "substring": lambda: s[int(args[0]):int(args[1])] if len(args) > 1
+                             else s[int(args[0]):],
+        "replace": lambda: s.replace(args[0], args[1]),
+        "splitOnToken": lambda: s.split(args[0]),
+        "equals": lambda: s == args[0],
+        "equalsIgnoreCase": lambda: s.lower() == str(args[0]).lower(),
+        "charAt": lambda: s[int(args[0])],
+        "toString": lambda: s,
+        "compareTo": lambda: (s > args[0]) - (s < args[0]),
+        "concat": lambda: s + args[0],
+    }
+    fn = table.get(name)
+    if fn is None:
+        raise ScriptError(f"unknown String method [{name}]")
+    return fn()
+
+
+def _list_method(lst: list, name: str, args):
+    table = {
+        "add": lambda: lst.append(args[0]),
+        "addAll": lambda: lst.extend(args[0]),
+        "remove": lambda: lst.pop(int(args[0])) if isinstance(args[0], int)
+                          else (lst.remove(args[0]) or True
+                                if args[0] in lst else False),
+        "removeIf": None,
+        "contains": lambda: args[0] in lst,
+        "indexOf": lambda: lst.index(args[0]) if args[0] in lst else -1,
+        "size": lambda: len(lst),
+        "isEmpty": lambda: len(lst) == 0,
+        "get": lambda: lst[int(args[0])],
+        "set": lambda: lst.__setitem__(int(args[0]), args[1]),
+        "clear": lambda: lst.clear(),
+        "sort": lambda: lst.sort(),
+    }
+    fn = table.get(name)
+    if fn is None:
+        raise ScriptError(f"unknown List method [{name}]")
+    return fn()
+
+
+def _map_method(m: dict, name: str, args):
+    table = {
+        "containsKey": lambda: args[0] in m,
+        "containsValue": lambda: args[0] in m.values(),
+        "get": lambda: m.get(args[0]),
+        "getOrDefault": lambda: m.get(args[0], args[1]),
+        "put": lambda: m.__setitem__(args[0], args[1]),
+        "putAll": lambda: m.update(args[0]),
+        "remove": lambda: m.pop(args[0], None),
+        "keySet": lambda: list(m.keys()),
+        "values": lambda: list(m.values()),
+        "entrySet": lambda: [{"key": k, "value": v} for k, v in m.items()],
+        "size": lambda: len(m),
+        "isEmpty": lambda: len(m) == 0,
+        "clear": lambda: m.clear(),
+    }
+    fn = table.get(name)
+    if fn is None:
+        raise ScriptError(f"unknown Map method [{name}]")
+    return fn()
+
+
+class DocField:
+    """The `doc['field']` accessor for host contexts: sorted doc values."""
+
+    __slots__ = ("values",)
+
+    def __init__(self, values: List[Any]):
+        self.values = values
+
+    @property
+    def value(self):
+        if not self.values:
+            raise ScriptError(
+                "A document doesn't have a value for a field! Use "
+                "doc[<field>].size()==0 to check if a document is missing "
+                "a field!")
+        return self.values[0]
+
+
+# ------------------------------------------------------------ JAX back-end
+
+_JAX_MATH = None
+
+
+def _jax_math():
+    global _JAX_MATH
+    if _JAX_MATH is None:
+        import jax.numpy as jnp
+        _JAX_MATH = {
+            "log": jnp.log, "log10": jnp.log10, "exp": jnp.exp,
+            "sqrt": jnp.sqrt, "abs": jnp.abs, "max": jnp.maximum,
+            "min": jnp.minimum, "pow": jnp.power, "floor": jnp.floor,
+            "ceil": jnp.ceil, "round": jnp.round,
+            "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+        }
+    return _JAX_MATH
+
+
+class JaxScoreScript:
+    """A score/filter script compiled to vectorized jnp ops.
+
+    `doc['f'].value` reads a dense [D] column; `_score` is the child query's
+    score vector; `params.x` are traced scalars. The whole expression fuses
+    into the surrounding query-phase XLA program."""
+
+    def __init__(self, source: str):
+        stmts = parse(source)
+        # a score script is one expression (possibly with a return)
+        if len(stmts) == 1 and isinstance(stmts[0], ExprStmt):
+            self.expr = stmts[0].expr
+        elif len(stmts) == 1 and isinstance(stmts[0], Return) \
+                and stmts[0].value is not None:
+            self.expr = stmts[0].value
+        else:
+            raise ScriptError(
+                "score scripts must be a single expression (the device "
+                "back-end compiles expressions; use update/ingest contexts "
+                "for statement scripts)")
+        self.fields = collect_doc_fields(stmts)
+        self.source = source
+
+    def __call__(self, columns: Dict[str, Any], score, params: Dict[str, Any]):
+        """columns: field → (dense_values [D], exists [D], counts [D])."""
+        import jax.numpy as jnp
+        jm = _jax_math()
+
+        def ev(n):
+            if isinstance(n, Num):
+                return n.value
+            if isinstance(n, Bool):
+                return n.value
+            if isinstance(n, Var):
+                if n.name == "_score":
+                    return score
+                raise ScriptError(f"variable [{n.name}] is not available in "
+                                  f"device score scripts")
+            if isinstance(n, Attr):
+                if isinstance(n.obj, Var) and n.obj.name == "params":
+                    if n.name not in params:
+                        raise ScriptError(f"missing script param [{n.name}]")
+                    return params[n.name]
+                if isinstance(n.obj, Var) and n.obj.name == "Math":
+                    if n.name in _MATH_CONSTS:
+                        return _MATH_CONSTS[n.name]
+                if n.name in ("value", "empty"):
+                    col = self._column(n.obj, columns)
+                    if n.name == "value":
+                        return col[0]
+                    return ~col[1]
+                raise ScriptError(f"unsupported attribute [{n.name}] in "
+                                  f"device score scripts")
+            if isinstance(n, Index):
+                if isinstance(n.obj, Var) and n.obj.name == "params" \
+                        and isinstance(n.key, Str):
+                    if n.key.value not in params:
+                        raise ScriptError(
+                            f"missing script param [{n.key.value}]")
+                    return params[n.key.value]
+                raise ScriptError("unsupported indexing in device score "
+                                  "scripts")
+            if isinstance(n, Call):
+                if isinstance(n.obj, Var) and n.obj.name == "Math":
+                    fn = jm.get(n.name)
+                    if fn is None:
+                        raise ScriptError(f"unknown Math method [{n.name}]")
+                    return fn(*[ev(a) for a in n.args])
+                if n.name == "size":
+                    col = self._column(n.obj, columns)
+                    return col[2]
+                raise ScriptError(f"unsupported method [{n.name}] in device "
+                                  f"score scripts")
+            if isinstance(n, Bin):
+                a, b = ev(n.left), ev(n.right)
+                return {
+                    "+": lambda: a + b, "-": lambda: a - b,
+                    "*": lambda: a * b, "/": lambda: a / b,
+                    "%": lambda: a % b,
+                    "==": lambda: a == b, "!=": lambda: a != b,
+                    "<": lambda: a < b, "<=": lambda: a <= b,
+                    ">": lambda: a > b, ">=": lambda: a >= b,
+                    "&&": lambda: a & b, "||": lambda: a | b,
+                }[n.op]()
+            if isinstance(n, Un):
+                v = ev(n.value)
+                return -v if n.op == "-" else ~v
+            if isinstance(n, Ternary):
+                return jnp.where(ev(n.cond), ev(n.then), ev(n.other))
+            raise ScriptError(f"unsupported expression "
+                              f"[{type(n).__name__}] in device score scripts")
+
+        return ev(self.expr)
+
+    def _column(self, node, columns):
+        if isinstance(node, Index) and isinstance(node.obj, Var) \
+                and node.obj.name == "doc" and isinstance(node.key, Str):
+            field = node.key.value
+            if field not in columns:
+                raise ScriptError(f"No field found for [{field}] in mapping")
+            return columns[field]
+        raise ScriptError("doc access must be doc['field']")
+
+
+@lru_cache(maxsize=256)
+def compile_score_script(source: str) -> JaxScoreScript:
+    return JaxScoreScript(source)

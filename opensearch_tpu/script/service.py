@@ -1,0 +1,180 @@
+"""ScriptService: stored scripts, compile cache, typed contexts.
+
+Re-design of script/ScriptService.java + ScriptModule.java: scripts are
+compiled per context (score, filter, field, update, ingest) with a bounded
+compile cache and rate guard. The default (and only) language is the
+painless subset in script/painless.py.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from opensearch_tpu.common.errors import IllegalArgumentError
+from opensearch_tpu.script.painless import (
+    DocField, HostEvaluator, ScriptError, parse)
+
+MAX_COMPILE_RATE = 150   # compilations per minute (script.max_compilations_rate)
+
+
+class StoredScript:
+    __slots__ = ("lang", "source", "options")
+
+    def __init__(self, lang: str, source: str, options: Optional[dict] = None):
+        self.lang = lang
+        self.source = source
+        self.options = options or {}
+
+    def to_dict(self) -> dict:
+        return {"lang": self.lang, "source": self.source}
+
+
+def _resolve(script_spec: Any, stored: Dict[str, StoredScript]) -> tuple:
+    """Normalize a REST script spec to (source, params, lang)."""
+    if isinstance(script_spec, str):
+        return script_spec, {}, "painless"
+    if not isinstance(script_spec, dict):
+        raise IllegalArgumentError("script malformed, expected [source] or [id]")
+    params = script_spec.get("params") or {}
+    lang = script_spec.get("lang", "painless")
+    if "source" in script_spec:
+        return script_spec["source"], params, lang
+    if "id" in script_spec:
+        ss = stored.get(script_spec["id"])
+        if ss is None:
+            raise IllegalArgumentError(
+                f"unable to find script [{script_spec['id']}]")
+        return ss.source, params, ss.lang
+    raise IllegalArgumentError("must specify either [source] for an inline "
+                               "script or [id] for a stored script")
+
+
+class UpdateScript:
+    """`ctx._source` mutation context (ScriptContext UPDATE)."""
+
+    def __init__(self, source: str, params: dict):
+        self.stmts = parse(source)
+        self.params = params
+
+    def execute(self, ctx: dict):
+        HostEvaluator({"ctx": ctx, "params": dict(self.params)}).run(self.stmts)
+        return ctx
+
+
+class IngestScript:
+    """Ingest processor context: ctx is the flat document."""
+
+    def __init__(self, source: str, params: dict):
+        self.stmts = parse(source)
+        self.params = params
+
+    def execute(self, ctx: dict):
+        HostEvaluator({"ctx": ctx, "params": dict(self.params)}).run(self.stmts)
+        return ctx
+
+
+class FieldScript:
+    """script_fields context: returns a value per document."""
+
+    def __init__(self, source: str, params: dict):
+        self.stmts = parse(source)
+        self.params = params
+
+    def execute(self, doc: Dict[str, DocField],
+                source: Optional[dict] = None) -> Any:
+        env = {"doc": doc, "params": dict(self.params)}
+        if source is not None:
+            env["_source"] = source
+        return HostEvaluator(env).run(self.stmts)
+
+
+class HostScoreScript:
+    """Host-side score context (used by functions the device can't run)."""
+
+    def __init__(self, source: str, params: dict):
+        self.stmts = parse(source)
+        self.params = params
+
+    def execute(self, doc: Dict[str, DocField], score: float) -> float:
+        env = {"doc": doc, "params": dict(self.params), "_score": score}
+        out = HostEvaluator(env).run(self.stmts)
+        return float(out)
+
+
+_CONTEXTS = {
+    "update": UpdateScript,
+    "ingest": IngestScript,
+    "field": FieldScript,
+    "score": HostScoreScript,
+}
+
+
+class ScriptService:
+    def __init__(self):
+        self.stored: Dict[str, StoredScript] = {}
+        self._compile_times: List[float] = []
+
+    # ------------------------------------------------------- stored scripts
+
+    def put_stored(self, script_id: str, body: dict):
+        spec = body.get("script")
+        if not isinstance(spec, dict) or "source" not in spec:
+            raise IllegalArgumentError("must specify [script] with [source]")
+        lang = spec.get("lang", "painless")
+        if lang != "painless":
+            raise IllegalArgumentError(f"script_lang not supported [{lang}]")
+        parse(spec["source"])  # compile-check at store time, like the reference
+        self.stored[script_id] = StoredScript(lang, spec["source"])
+
+    def get_stored(self, script_id: str) -> Optional[StoredScript]:
+        return self.stored.get(script_id)
+
+    def delete_stored(self, script_id: str) -> bool:
+        return self.stored.pop(script_id, None) is not None
+
+    # ------------------------------------------------------------- compile
+
+    def compile(self, script_spec: Any, context: str):
+        source, params, lang = _resolve(script_spec, self.stored)
+        if lang not in ("painless", "expression"):
+            raise IllegalArgumentError(f"script_lang not supported [{lang}]")
+        cls = _CONTEXTS.get(context)
+        if cls is None:
+            raise IllegalArgumentError(f"unknown script context [{context}]")
+        self._rate_guard()
+        return cls(source, params)
+
+    def _rate_guard(self):
+        # parse() is lru-cached, so this guards pathological unique-source
+        # storms like the reference's compile-rate circuit breaker
+        now = time.monotonic()
+        self._compile_times = [t for t in self._compile_times if now - t < 60]
+        if len(self._compile_times) >= MAX_COMPILE_RATE:
+            from opensearch_tpu.common.errors import CircuitBreakingError
+            raise CircuitBreakingError(
+                "[script] Too many dynamic script compilations within, max: "
+                f"[{MAX_COMPILE_RATE}/min]")
+        self._compile_times.append(now)
+
+
+def doc_view(seg, ord_: int, fields: Optional[List[str]] = None
+             ) -> Dict[str, DocField]:
+    """Build the host `doc` map for one document from segment columns."""
+    out: Dict[str, DocField] = {}
+    names = fields if fields is not None else \
+        list(seg.numeric_dv) + list(seg.ordinal_dv)
+    for f in names:
+        col = seg.numeric_dv.get(f)
+        if col is not None:
+            mask = col.doc_ids == ord_
+            vals = [float(v) for v in col.values[mask]]
+            out[f] = DocField(vals)
+            continue
+        ocol = seg.ordinal_dv.get(f)
+        if ocol is not None:
+            mask = ocol.doc_ids == ord_
+            out[f] = DocField([ocol.dictionary[o] for o in ocol.ords[mask]])
+        else:
+            out[f] = DocField([])
+    return out

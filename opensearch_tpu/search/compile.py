@@ -519,6 +519,35 @@ class Compiler:
                                         "boost": _f32(node.boost)},
                     children=[pos, neg])
 
+    def _c_ScriptScoreQuery(self, node: dsl.ScriptScoreQuery, seg, meta) -> Plan:
+        """script_score compiles the script to vectorized jnp ops fused into
+        the query program (script/painless.py JaxScoreScript) — the
+        TPU-native replacement for per-doc painless interpretation."""
+        from opensearch_tpu.script.painless import compile_score_script
+        script = compile_score_script(node.script_source)
+        for f in script.fields:
+            if f not in seg.numeric_dv:
+                ft = self.mapper.get_field(f)
+                kind = "missing from mapping" if ft is None else \
+                    f"of type [{ft.type}] (device score scripts support " \
+                    f"numeric doc values)"
+                raise QueryShardError(
+                    f"script_score field [{f}] {kind}")
+        child = self.compile(node.query, seg, meta)
+        num_params = {k: v for k, v in (node.script_params or {}).items()
+                      if isinstance(v, (int, float))
+                      and not isinstance(v, bool)}
+        static_params = tuple(sorted(
+            (k, v) for k, v in (node.script_params or {}).items()
+            if k not in num_params))
+        pkeys = tuple(sorted(num_params))
+        inputs = {"boost": _f32(node.boost)}
+        for k in pkeys:
+            inputs[f"p_{k}"] = _f32(num_params[k])
+        return Plan("script_score",
+                    static=(node.script_source, pkeys, static_params),
+                    inputs=inputs, children=[child])
+
     # ------------------------------------------------- query_string family
     def _c_QueryStringQuery(self, node: dsl.QueryStringQuery, seg, meta) -> Plan:
         parsed = _parse_query_string(node.query, node.default_field or "*",

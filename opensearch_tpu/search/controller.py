@@ -274,6 +274,19 @@ def execute_search(executors: List, body: Optional[dict],
     return resp
 
 
+_SCRIPT_SERVICE = None
+
+
+def _default_script_service():
+    """Inline-script service for fetch-phase script_fields (stored-script
+    lookup goes through the node's service at the REST layer)."""
+    global _SCRIPT_SERVICE
+    if _SCRIPT_SERVICE is None:
+        from opensearch_tpu.script.service import ScriptService
+        _SCRIPT_SERVICE = ScriptService()
+    return _SCRIPT_SERVICE
+
+
 def _build_hit(ex, c, body, score, query_node, sort_specs,
                score_sorted) -> dict:
     from opensearch_tpu.search import fetch as fetch_phase
@@ -299,6 +312,16 @@ def _build_hit(ex, c, body, score, query_node, sort_specs,
                                              body["docvalue_fields"], mapper)
         if fields:
             hit["fields"] = fields
+    if body.get("script_fields"):
+        from opensearch_tpu.script.painless import collect_doc_fields
+        from opensearch_tpu.script.service import doc_view
+        svc = _default_script_service()
+        for name, spec in body["script_fields"].items():
+            fs = svc.compile((spec or {}).get("script"), "field")
+            dv = doc_view(seg, c.ord, collect_doc_fields(fs.stmts) or None)
+            value = fs.execute(dv, seg.sources[c.ord])
+            hit.setdefault("fields", {})[name] = \
+                value if isinstance(value, list) else [value]
     if body.get("version"):
         hit["_version"] = getattr(seg, "versions", {}).get(c.ord, 1) \
             if hasattr(seg, "versions") else 1

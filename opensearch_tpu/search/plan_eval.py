@@ -132,6 +132,31 @@ def _eval_plan(plan: Plan, seg: Dict, inputs: List[Dict], cursor: List[int]):
         scores = best + my["tie"] * (total - best)
         return jnp.where(matches, scores * my["boost"], 0.0), matches
 
+    if kind == "script_score":
+        from opensearch_tpu.script.painless import compile_score_script
+        source, pkeys, static_params = plan.static
+        script = compile_score_script(source)
+        child_s, child_m = _eval_plan(plan.children[0], seg, inputs, cursor)
+        columns = {}
+        for f in script.fields:
+            col = seg["numeric"][f]
+            valid = col["doc_ids"] >= 0
+            idx = jnp.where(valid, col["doc_ids"], d_pad)
+            # first (smallest) value per doc = painless doc[f].value
+            dense = jnp.full(d_pad + 1, jnp.inf, jnp.float32) \
+                .at[idx].min(jnp.where(valid, col["values_f32"], jnp.inf))
+            value = jnp.where(jnp.isfinite(dense[:d_pad]), dense[:d_pad], 0.0)
+            counts = jnp.zeros(d_pad + 1, jnp.int32) \
+                .at[idx].add(valid.astype(jnp.int32))[:d_pad]
+            columns[f] = (value, col["exists"], counts)
+        params = {k: my[f"p_{k}"] for k in pkeys}
+        params.update(dict(static_params))
+        new_scores = script(columns, child_s, params)
+        scores = jnp.where(child_m,
+                           jnp.asarray(new_scores, jnp.float32) * my["boost"],
+                           0.0)
+        return scores, child_m
+
     if kind == "boosting":
         pos_s, pos_m = _eval_plan(plan.children[0], seg, inputs, cursor)
         neg_s, neg_m = _eval_plan(plan.children[1], seg, inputs, cursor)

@@ -1,0 +1,335 @@
+"""Script system + ingest pipeline tests.
+
+Modeled on the reference's lang-painless unit tests (expression semantics),
+ScriptScoreQueryIT (device script scoring), UpdateIT (ctx._source scripts),
+and ingest-common processor tests (IngestClientIT, per-processor units)."""
+
+import json
+
+import pytest
+
+from opensearch_tpu.node import Node
+from opensearch_tpu.script.painless import (
+    HostEvaluator, ScriptError, compile_score_script, parse)
+
+
+def run_expr(src, **env):
+    return HostEvaluator(env).run(parse(src))
+
+
+class TestPainlessLanguage:
+    def test_arithmetic_java_semantics(self):
+        assert run_expr("7 / 2") == 3          # int division truncates
+        assert run_expr("-7 / 2") == -3        # toward zero, not floor
+        assert run_expr("7.0 / 2") == 3.5
+        assert run_expr("-7 % 3") == -1        # Java remainder sign
+        assert run_expr("2 + 3 * 4") == 14
+
+    def test_string_concat_and_methods(self):
+        assert run_expr("'a' + 1") == "a1"
+        assert run_expr("'Hello'.toLowerCase()") == "hello"
+        assert run_expr("'hello world'.contains('wor')") is True
+        assert run_expr("'a,b,c'.splitOnToken(',')") == ["a", "b", "c"]
+        assert run_expr("'hello'.substring(1, 3)") == "el"
+
+    def test_ternary_elvis_logic(self):
+        assert run_expr("true ? 1 : 2") == 1
+        assert run_expr("null ?: 'fallback'") == "fallback"
+        assert run_expr("'x' ?: 'fallback'") == "x"
+        assert run_expr("true && !false") is True
+        assert run_expr("1 < 2 || 5 < 3") is True
+
+    def test_variables_and_control_flow(self):
+        src = """
+        def total = 0;
+        for (def i = 0; i < 5; ++i) { total += i; }
+        return total;
+        """
+        assert run_expr(src) == 10
+
+    def test_for_in_and_lists(self):
+        src = """
+        def out = [];
+        for (x in values) { if (x % 2 == 0) { out.add(x * 10) } }
+        return out;
+        """
+        assert run_expr(src, values=[1, 2, 3, 4]) == [20, 40]
+
+    def test_maps(self):
+        src = """
+        def m = [:];
+        m.put('a', 1);
+        m['b'] = 2;
+        return m.containsKey('a') ? m.size() : -1;
+        """
+        assert run_expr(src) == 2
+
+    def test_math(self):
+        assert abs(run_expr("Math.log(Math.E)") - 1.0) < 1e-9
+        assert run_expr("Math.max(3, 9)") == 9
+        assert run_expr("Math.pow(2, 10)") == 1024
+
+    def test_sandbox_rejects_unknown(self):
+        with pytest.raises(ScriptError):
+            run_expr("System.exit(0)")
+        with pytest.raises(ScriptError):
+            run_expr("'x'.getClass()")
+        with pytest.raises(ScriptError):
+            run_expr("while (true) { }")  # loop limit
+
+    def test_ctx_mutation(self):
+        ctx = {"_source": {"counter": 1, "tags": ["a"]}}
+        run_expr("ctx._source.counter += 4; ctx._source.tags.add('b')",
+                 ctx=ctx, params={})
+        assert ctx["_source"]["counter"] == 5
+        assert ctx["_source"]["tags"] == ["a", "b"]
+
+    def test_device_script_field_collection(self):
+        s = compile_score_script(
+            "doc['a'].value * 2 + doc['b'].value + params.w")
+        assert s.fields == ["a", "b"]
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    n.request("PUT", "/prod", {"mappings": {"properties": {
+        "name": {"type": "text"},
+        "views": {"type": "long"},
+        "rating": {"type": "double"},
+    }}})
+    for i in range(10):
+        n.request("PUT", f"/prod/_doc/{i}", {
+            "name": f"product {i}", "views": i * 10,
+            "rating": 5.0 - i * 0.4})
+    n.request("POST", "/prod/_refresh")
+    return n
+
+
+class TestScriptScoreDevice:
+    def test_script_score_numeric_field(self, node):
+        res = node.request("POST", "/prod/_search", {
+            "query": {"script_score": {
+                "query": {"match_all": {}},
+                "script": {"source": "doc['views'].value * params.f",
+                           "params": {"f": 2.0}},
+            }}, "size": 3})
+        hits = res["hits"]["hits"]
+        assert [h["_source"]["views"] for h in hits] == [90, 80, 70]
+        assert hits[0]["_score"] == pytest.approx(180.0)
+
+    def test_script_score_with_score_and_math(self, node):
+        res = node.request("POST", "/prod/_search", {
+            "query": {"script_score": {
+                "query": {"match": {"name": "product"}},
+                "script": {"source":
+                           "_score + Math.log(doc['views'].value + 1)"},
+            }}, "size": 10})
+        assert res["hits"]["total"]["value"] == 10
+        scores = [h["_score"] for h in res["hits"]["hits"]]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_script_score_ternary(self, node):
+        res = node.request("POST", "/prod/_search", {
+            "query": {"script_score": {
+                "query": {"match_all": {}},
+                "script": {"source":
+                           "doc['views'].value > 50 ? 100.0 : 1.0"},
+            }}, "size": 10})
+        top = [h["_source"]["views"] for h in res["hits"]["hits"][:4]]
+        assert all(v > 50 for v in top)
+
+    def test_script_score_unknown_field_400(self, node):
+        res = node.request("POST", "/prod/_search", {
+            "query": {"script_score": {
+                "query": {"match_all": {}},
+                "script": {"source": "doc['nope'].value"}}}})
+        assert res["_status"] == 400
+
+
+class TestScriptFields:
+    def test_script_fields(self, node):
+        res = node.request("POST", "/prod/_search", {
+            "query": {"term": {"views": 40}},
+            "script_fields": {"double_views": {"script": {
+                "source": "doc['views'].value * 2"}}},
+        })
+        assert res["hits"]["hits"][0]["fields"]["double_views"] == [80.0]
+
+
+class TestScriptedUpdate:
+    def test_update_with_script(self, node):
+        node.request("POST", "/prod/_update/1", {
+            "script": {"source": "ctx._source.views += params.n",
+                       "params": {"n": 5}}})
+        assert node.request("GET", "/prod/_doc/1")["_source"]["views"] == 15
+
+    def test_update_script_noop_and_delete(self, node):
+        res = node.request("POST", "/prod/_update/2", {
+            "script": {"source": "ctx.op = 'none'"}})
+        assert res["result"] == "noop"
+        res = node.request("POST", "/prod/_update/2", {
+            "script": {"source": "ctx.op = 'delete'"}})
+        assert res["result"] == "deleted"
+        assert node.request("GET", "/prod/_doc/2")["_status"] == 404
+
+    def test_scripted_upsert(self, node):
+        res = node.request("POST", "/prod/_update/newdoc", {
+            "scripted_upsert": True,
+            "script": {"source": "ctx._source.views = 42"},
+            "upsert": {}})
+        assert res["result"] == "created"
+        assert node.request("GET",
+                            "/prod/_doc/newdoc")["_source"]["views"] == 42
+
+
+class TestStoredScripts:
+    def test_stored_script_roundtrip(self, node):
+        res = node.request("PUT", "/_scripts/my-inc", {
+            "script": {"lang": "painless",
+                       "source": "ctx._source.views += params.n"}})
+        assert res["acknowledged"] is True
+        res = node.request("GET", "/_scripts/my-inc")
+        assert res["found"] is True
+        node.request("POST", "/prod/_update/3", {
+            "script": {"id": "my-inc", "params": {"n": 100}}})
+        assert node.request("GET", "/prod/_doc/3")["_source"]["views"] == 130
+        node.request("DELETE", "/_scripts/my-inc")
+        assert node.request("GET", "/_scripts/my-inc")["_status"] == 404
+
+    def test_stored_script_compile_error(self, node):
+        res = node.request("PUT", "/_scripts/bad", {
+            "script": {"source": "ctx. ??? broken"}})
+        assert res["_status"] == 400
+
+
+class TestIngestPipelines:
+    def test_pipeline_crud_and_execution(self, node):
+        node.request("PUT", "/_ingest/pipeline/clean", {
+            "description": "normalize",
+            "processors": [
+                {"set": {"field": "env", "value": "prod"}},
+                {"lowercase": {"field": "level"}},
+                {"convert": {"field": "code", "type": "integer"}},
+                {"rename": {"field": "msg", "target_field": "message"}},
+            ]})
+        node.request("PUT", "/logs2", {"mappings": {"properties": {
+            "message": {"type": "text"}, "level": {"type": "keyword"},
+            "code": {"type": "integer"}, "env": {"type": "keyword"}}}})
+        node.request("PUT", "/logs2/_doc/1",
+                     {"msg": "Boot OK", "level": "INFO", "code": "200"},
+                     pipeline="clean", refresh="true")
+        src = node.request("GET", "/logs2/_doc/1")["_source"]
+        assert src == {"message": "Boot OK", "level": "info", "code": 200,
+                       "env": "prod"}
+
+    def test_default_pipeline_setting(self, node):
+        node.request("PUT", "/_ingest/pipeline/stamp", {
+            "processors": [{"set": {"field": "stamped", "value": True}}]})
+        node.request("PUT", "/auto", {"settings": {
+            "default_pipeline": "stamp"}})
+        node.request("PUT", "/auto/_doc/1", {"a": 1}, refresh="true")
+        assert node.request("GET", "/auto/_doc/1")["_source"]["stamped"] is True
+
+    def test_drop_processor(self, node):
+        node.request("PUT", "/_ingest/pipeline/dropper", {
+            "processors": [
+                {"drop": {"if": "ctx.level == 'debug'"}}]})
+        node.request("PUT", "/d1")
+        res = node.request("PUT", "/d1/_doc/1", {"level": "debug"},
+                           pipeline="dropper")
+        assert res["result"] == "noop"
+        assert node.request("GET", "/d1/_doc/1")["_status"] == 404
+        res = node.request("PUT", "/d1/_doc/2", {"level": "error"},
+                           pipeline="dropper")
+        assert res["result"] == "created"
+
+    def test_on_failure_chain(self, node):
+        node.request("PUT", "/_ingest/pipeline/risky", {
+            "processors": [{"convert": {
+                "field": "n", "type": "integer",
+                "on_failure": [{"set": {"field": "error_flag",
+                                        "value": True}}]}}]})
+        node.request("PUT", "/f1")
+        node.request("PUT", "/f1/_doc/1", {"n": "not-a-number"},
+                     pipeline="risky", refresh="true")
+        src = node.request("GET", "/f1/_doc/1")["_source"]
+        assert src["error_flag"] is True
+
+    def test_grok_processor(self, node):
+        res = node.request("POST", "/_ingest/pipeline/_simulate", {
+            "pipeline": {"processors": [{"grok": {
+                "field": "message",
+                "patterns": ["%{IP:client} %{WORD:method} %{URIPATH:path} "
+                             "%{NUMBER:bytes:int}"]}}]},
+            "docs": [{"_source": {
+                "message": "55.3.244.1 GET /index.html 15824"}}]})
+        src = res["docs"][0]["doc"]["_source"]
+        assert src["client"] == "55.3.244.1"
+        assert src["method"] == "GET"
+        assert src["path"] == "/index.html"
+        assert src["bytes"] == 15824
+
+    def test_dissect_processor(self, node):
+        res = node.request("POST", "/_ingest/pipeline/_simulate", {
+            "pipeline": {"processors": [{"dissect": {
+                "field": "message",
+                "pattern": "%{clientip} - - [%{ts}] \"%{verb} %{url}\""}}]},
+            "docs": [{"_source": {"message":
+                      '1.2.3.4 - - [30/Apr/1998] "GET /en/index.html"'}}]})
+        src = res["docs"][0]["doc"]["_source"]
+        assert src["clientip"] == "1.2.3.4"
+        assert src["verb"] == "GET"
+
+    def test_script_processor_and_foreach(self, node):
+        res = node.request("POST", "/_ingest/pipeline/_simulate", {
+            "pipeline": {"processors": [
+                {"script": {"source":
+                            "ctx.total = ctx.a + ctx.b"}},
+                {"foreach": {"field": "tags", "processor": {
+                    "uppercase": {"field": "_ingest._value"}}}},
+            ]},
+            "docs": [{"_source": {"a": 2, "b": 3, "tags": ["x", "y"]}}]})
+        src = res["docs"][0]["doc"]["_source"]
+        assert src["total"] == 5
+        assert src["tags"] == ["X", "Y"]
+
+    def test_simulate_error_reported(self, node):
+        res = node.request("POST", "/_ingest/pipeline/_simulate", {
+            "pipeline": {"processors": [
+                {"fail": {"message": "boom {{reason}}"}}]},
+            "docs": [{"_source": {"reason": "bad-doc"}}]})
+        assert "boom bad-doc" in res["docs"][0]["error"]["reason"]
+
+    def test_kv_json_append(self, node):
+        res = node.request("POST", "/_ingest/pipeline/_simulate", {
+            "pipeline": {"processors": [
+                {"kv": {"field": "raw", "field_split": " ",
+                        "value_split": "="}},
+                {"json": {"field": "payload"}},
+                {"append": {"field": "tags", "value": ["new"]}},
+            ]},
+            "docs": [{"_source": {"raw": "ip=1.2.3.4 code=200",
+                                  "payload": "{\"k\": 1}",
+                                  "tags": ["old"]}}]})
+        src = res["docs"][0]["doc"]["_source"]
+        assert src["ip"] == "1.2.3.4" and src["code"] == "200"
+        assert src["payload"] == {"k": 1}
+        assert src["tags"] == ["old", "new"]
+
+    def test_bulk_with_pipeline(self, node):
+        node.request("PUT", "/_ingest/pipeline/tag-it", {
+            "processors": [{"set": {"field": "tagged", "value": 1}}]})
+        node.request("PUT", "/b2")
+        payload = "\n".join([
+            json.dumps({"index": {"_index": "b2", "_id": "1"}}),
+            json.dumps({"v": 1}),
+            json.dumps({"index": {"_index": "b2", "_id": "2"}}),
+            json.dumps({"v": 2}),
+        ]) + "\n"
+        res = node.request("POST", "/_bulk", payload, pipeline="tag-it",
+                           refresh="true")
+        assert res["errors"] is False
+        for i in ("1", "2"):
+            assert node.request("GET",
+                                f"/b2/_doc/{i}")["_source"]["tagged"] == 1
