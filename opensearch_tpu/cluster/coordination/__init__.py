@@ -1,0 +1,11 @@
+from opensearch_tpu.cluster.coordination.core import (
+    ClusterState, CoordinationState, VotingConfiguration)
+from opensearch_tpu.cluster.coordination.coordinator import Coordinator, Mode
+from opensearch_tpu.cluster.coordination.deterministic import (
+    DeterministicTaskQueue)
+from opensearch_tpu.cluster.coordination.mock_transport import (
+    DisruptableMockTransport)
+
+__all__ = ["ClusterState", "CoordinationState", "VotingConfiguration",
+           "Coordinator", "Mode", "DeterministicTaskQueue",
+           "DisruptableMockTransport"]

@@ -1,0 +1,562 @@
+"""Coordinator: the CANDIDATE/LEADER/FOLLOWER election + publication driver.
+
+Re-design of cluster/coordination/Coordinator.java:119 over the safety core
+in core.py. All IO goes through a transport with `send(sender, target,
+action, payload, on_response, on_failure)` and all timing through a
+scheduler with `schedule_delayed(ms, fn, desc)` + `current_time_ms` —
+satisfied by the deterministic harness in tests and by a real clock/socket
+pair in production.
+
+Mechanisms ported (reference anchors):
+  - randomized election scheduling with linear backoff
+    (ElectionSchedulerFactory);
+  - pre-vote round before term bump (PreVoteCollector) so partitioned
+    nodes don't inflate terms;
+  - join accumulation → become leader on quorum (JoinHelper,
+    Coordinator.handleJoinRequest:574);
+  - two-phase publish (Publication.java / Coordinator.publish:1245) with
+    the node-join fast path (leader publishes state incl. new node);
+  - leader-side FollowersChecker + follower-side LeaderChecker with
+    3-strike removal (FollowersChecker.java / LeaderChecker.java);
+  - auto-reconfiguration of the voting config toward an odd-sized majority
+    of live master-eligible nodes (Reconfigurator.java).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from opensearch_tpu.cluster.coordination.core import (
+    ApplyCommitRequest, ClusterState, CoordinationState,
+    CoordinationStateRejectedError, Join, PublishRequest, PublishResponse,
+    StartJoinRequest, VotingConfiguration)
+
+# action names (reference: Coordinator's registered transport actions)
+JOIN_ACTION = "internal:cluster/coordination/join"
+PUBLISH_ACTION = "internal:cluster/coordination/publish_state"
+COMMIT_ACTION = "internal:cluster/coordination/commit_state"
+PRE_VOTE_ACTION = "internal:cluster/request_pre_vote"
+FOLLOWER_CHECK_ACTION = "internal:coordination/fault_detection/follower_check"
+LEADER_CHECK_ACTION = "internal:coordination/fault_detection/leader_check"
+
+ELECTION_INITIAL_TIMEOUT_MS = 100      # cluster.election.initial_timeout
+ELECTION_BACKOFF_MS = 100              # cluster.election.back_off_time
+ELECTION_MAX_TIMEOUT_MS = 10_000       # cluster.election.max_timeout
+FOLLOWER_CHECK_INTERVAL_MS = 1_000     # follower_check.interval
+LEADER_CHECK_INTERVAL_MS = 1_000       # leader_check.interval
+CHECK_RETRY_COUNT = 3                  # *_check.retry_count
+
+
+class Mode(enum.Enum):
+    CANDIDATE = "CANDIDATE"
+    LEADER = "LEADER"
+    FOLLOWER = "FOLLOWER"
+
+
+class Coordinator:
+    def __init__(self, node_id: str, transport, scheduler,
+                 initial_state: ClusterState,
+                 on_state_applied: Optional[Callable[[ClusterState], None]]
+                 = None):
+        self.node_id = node_id
+        self.transport = transport
+        self.scheduler = scheduler
+        self.coord_state = CoordinationState(node_id, initial_state)
+        self.mode = Mode.CANDIDATE
+        self.leader: Optional[str] = None
+        self.applied_state: ClusterState = initial_state
+        self.on_state_applied = on_state_applied
+        self.known_peers: Set[str] = set(initial_state.nodes) | {node_id}
+        self._election_round = 0
+        self._election_epoch = 0           # invalidates scheduled elections
+        self._check_failures: Dict[str, int] = {}
+        self._leader_check_failures = 0
+        self._stopped = False
+        self._publish_in_flight = False
+        self._pending_values: List[Callable[[ClusterState], ClusterState]] = []
+        self._pending_joins: Set[str] = set()
+
+        t = transport
+        t.register_handler(node_id, PRE_VOTE_ACTION, self._on_pre_vote)
+        t.register_handler(node_id, JOIN_ACTION, self._on_join)
+        t.register_handler(node_id, PUBLISH_ACTION, self._on_publish)
+        t.register_handler(node_id, COMMIT_ACTION, self._on_commit)
+        t.register_handler(node_id, FOLLOWER_CHECK_ACTION,
+                           self._on_follower_check)
+        t.register_handler(node_id, LEADER_CHECK_ACTION,
+                           self._on_leader_check)
+
+    # ---------------------------------------------------------------- start
+
+    def start(self):
+        self._become_candidate("started")
+
+    def stop(self):
+        self._stopped = True
+
+    # ------------------------------------------------------- mode switches
+
+    def _become_candidate(self, reason: str):
+        self.mode = Mode.CANDIDATE
+        self.leader = None
+        self._leader_check_failures = 0
+        self._election_epoch += 1
+        self._schedule_election()
+
+    def _become_leader(self):
+        self.mode = Mode.LEADER
+        self.leader = self.node_id
+        self._election_epoch += 1
+        self._check_failures = {}
+        self._schedule_follower_checks()
+        # first publication of the new term: pick up joined nodes + reconfig
+        self._publish_next()
+
+    def _become_follower(self, leader: str):
+        if self.mode == Mode.FOLLOWER and self.leader == leader:
+            return
+        self.mode = Mode.FOLLOWER
+        self.leader = leader
+        self._leader_check_failures = 0
+        self._election_epoch += 1
+        self._schedule_leader_check()
+
+    # ------------------------------------------------------------ elections
+
+    def _schedule_election(self):
+        if self._stopped:
+            return
+        epoch = self._election_epoch
+        self._election_round += 1
+        max_delay = min(ELECTION_INITIAL_TIMEOUT_MS
+                        + ELECTION_BACKOFF_MS * self._election_round,
+                        ELECTION_MAX_TIMEOUT_MS)
+        delay = self.scheduler.random.randrange(max_delay) + 1 \
+            if hasattr(self.scheduler, "random") else max_delay // 2
+
+        def maybe_run():
+            if self._stopped or self.mode != Mode.CANDIDATE \
+                    or epoch != self._election_epoch:
+                return
+            self._start_pre_vote()
+            self._schedule_election()  # retry with backoff until leader known
+
+        self.scheduler.schedule_delayed(delay, maybe_run,
+                                        f"election on {self.node_id}")
+
+    def _start_pre_vote(self):
+        """PreVoteCollector: ask peers whether they'd vote for us in
+        term+1 before actually disrupting the term."""
+        votes: Set[str] = set()
+        responded: Set[str] = set()
+        proposed_term = self.coord_state.current_term + 1
+        me = self.node_id
+
+        def on_response(peer):
+            def handle(resp):
+                if self.mode != Mode.CANDIDATE:
+                    return
+                responded.add(peer)
+                if resp.get("leader") and resp["leader"] != me:
+                    # a healthy leader exists: rejoin it instead of electing
+                    self.join_cluster(resp["leader"])
+                    return
+                if resp.get("would_vote"):
+                    votes.add(peer)
+                config = self.coord_state.last_accepted.last_committed_config
+                if config.has_quorum(votes | {me}) and \
+                        self.mode == Mode.CANDIDATE:
+                    self._start_election(proposed_term)
+            return handle
+
+        payload = {"term": proposed_term,
+                   "last_accepted_term": self.coord_state.last_accepted_term,
+                   "last_accepted_version":
+                       self.coord_state.last_accepted_version}
+        config = self.coord_state.last_accepted.last_committed_config
+        if config.has_quorum({me}):
+            self._start_election(proposed_term)
+            return
+        for peer in self.known_peers - {me}:
+            self.transport.send(me, peer, PRE_VOTE_ACTION, payload,
+                                on_response(peer), lambda e: None)
+
+    def _on_pre_vote(self, sender: str, payload: dict):
+        self.known_peers.add(sender)
+        would_vote = (
+            payload["term"] > self.coord_state.current_term
+            and (payload["last_accepted_term"],
+                 payload["last_accepted_version"])
+            >= (self.coord_state.last_accepted_term,
+                self.coord_state.last_accepted_version)
+            # a live leader vetoes pre-votes so healthy clusters stay stable
+            and not (self.mode == Mode.LEADER
+                     or (self.mode == Mode.FOLLOWER
+                         and self._leader_check_failures == 0
+                         and self.leader is not None)))
+        healthy_leader = self.leader if (
+            self.mode == Mode.LEADER
+            or (self.mode == Mode.FOLLOWER
+                and self._leader_check_failures == 0)) else None
+        return {"would_vote": would_vote, "leader": healthy_leader}
+
+    def _start_election(self, term: int):
+        """Send StartJoin(term) to every peer incl. ourselves — votes come
+        back as joins (Coordinator.startElection:498)."""
+        if term <= self.coord_state.current_term:
+            term = self.coord_state.current_term + 1
+        start = StartJoinRequest(source_node=self.node_id, term=term)
+        for peer in sorted(self.known_peers):
+            if peer == self.node_id:
+                self._request_join_from_self(start)
+            else:
+                # the voter computes its Join against the StartJoin and
+                # returns it as the RPC response (JoinHelper's round trip)
+                self.transport.send(
+                    self.node_id, peer, JOIN_ACTION,
+                    {"start_join": (start.source_node, start.term)},
+                    self._on_join_response, lambda e: None)
+
+    def _request_join_from_self(self, start: StartJoinRequest):
+        try:
+            join = self.coord_state.handle_start_join(start)
+            self._handle_incoming_join(join)
+        except CoordinationStateRejectedError:
+            pass
+
+    def _on_join(self, sender: str, payload: dict):
+        """A candidate solicits our vote (or a node asks to join the
+        cluster when payload has no start_join)."""
+        self.known_peers.add(sender)
+        if "start_join" in payload:
+            source, term = payload["start_join"]
+            start = StartJoinRequest(source_node=source, term=term)
+            join = self.coord_state.handle_start_join(start)
+            if self.mode != Mode.CANDIDATE and source != self.leader:
+                # accepting a newer term deposes us
+                self._become_candidate(f"start_join from {source}")
+            return {"join": (join.source_node, join.target_node, join.term,
+                             join.last_accepted_term,
+                             join.last_accepted_version)}
+        # plain join request: node wants into the cluster (leader side)
+        if self.mode == Mode.LEADER:
+            self._pending_joins.add(sender)
+            self._publish_next()
+            return {"accepted": True}
+        return {"accepted": False, "leader": self.leader}
+
+    def _on_join_response(self, resp):
+        if not resp or "join" not in resp:
+            return
+        source, target, term, la_term, la_version = resp["join"]
+        self._handle_incoming_join(Join(source, target, term, la_term,
+                                        la_version))
+
+    def _handle_incoming_join(self, join: Join):
+        if join.target_node != self.node_id:
+            return
+        try:
+            won = self.coord_state.handle_join(join)
+        except CoordinationStateRejectedError:
+            return
+        self._pending_joins.add(join.source_node)
+        if won and self.mode == Mode.CANDIDATE:
+            self._become_leader()
+
+    # ---------------------------------------------------------- publication
+
+    def submit_state_update(self, update: Callable[[ClusterState],
+                                                   ClusterState],
+                            ) -> bool:
+        """MasterService.submitStateUpdateTask analog: leader-only, updates
+        are queued and published in order (single-threaded batch)."""
+        if self.mode != Mode.LEADER:
+            return False
+        self._pending_values.append(update)
+        self._publish_next()
+        return True
+
+    def _publish_next(self):
+        if self.mode != Mode.LEADER or self._publish_in_flight \
+                or self._stopped:
+            return
+        base = self.coord_state.last_accepted
+        # fold in queued client updates + joined nodes + reconfiguration
+        new_nodes = frozenset(set(base.nodes) | self._pending_joins
+                              | {self.node_id})
+        data = base.data
+        for update in self._pending_values:
+            tmp = update(base.with_(nodes=new_nodes, data=data))
+            data = tmp.data
+            new_nodes = tmp.nodes
+        self._pending_values = []
+        self._pending_joins = set()
+        new_config = self._reconfigure(new_nodes,
+                                       base.last_committed_config)
+        if (new_nodes == base.nodes and data is base.data
+                and new_config == base.last_accepted_config
+                and base.term == self.coord_state.current_term
+                and base.master_node == self.node_id):
+            return  # nothing to publish
+        state = base.with_(
+            term=self.coord_state.current_term,
+            version=max(base.version,
+                        self.coord_state.last_published_version) + 1,
+            nodes=new_nodes,
+            master_node=self.node_id,
+            last_accepted_config=new_config,
+            data=data)
+        try:
+            request = self.coord_state.handle_client_value(state)
+        except CoordinationStateRejectedError:
+            return
+        self._publish_in_flight = True
+        self._publish(request)
+
+    def _reconfigure(self, nodes: frozenset,
+                     current: VotingConfiguration) -> VotingConfiguration:
+        """Reconfigurator: voting config = all master-eligible live nodes,
+        trimmed to an odd count (every node is master-eligible here)."""
+        members = sorted(nodes)
+        if len(members) % 2 == 0 and len(members) > 1:
+            # drop one (prefer dropping a non-leader) to keep quorum odd
+            droppable = [n for n in members if n != self.node_id]
+            members.remove(droppable[-1])
+        return VotingConfiguration(frozenset(members))
+
+    def _publish(self, request: PublishRequest):
+        state = request.state
+        acks_needed = state.nodes
+
+        def on_response(peer):
+            def handle(resp):
+                if resp is None or self.mode != Mode.LEADER:
+                    return
+                try:
+                    commit = self.coord_state.handle_publish_response(
+                        peer, PublishResponse(term=resp["term"],
+                                              version=resp["version"]))
+                except CoordinationStateRejectedError:
+                    return
+                if commit is not None:
+                    self._broadcast_commit(commit, state)
+            return handle
+
+        payload = {"state": state}
+        for peer in sorted(acks_needed):
+            if peer == self.node_id:
+                try:
+                    resp = self.coord_state.handle_publish_request(request)
+                    on_response(peer)({"term": resp.term,
+                                       "version": resp.version})
+                except CoordinationStateRejectedError:
+                    pass
+            else:
+                self.transport.send(self.node_id, peer, PUBLISH_ACTION,
+                                    payload, on_response(peer),
+                                    lambda e: None)
+        # publication timeout: if no commit in 30s, give up leadership is
+        # handled by leader/follower checks; here just clear in-flight
+        self.scheduler.schedule_delayed(
+            30_000, self._publish_timeout, "publish timeout")
+
+    def _publish_timeout(self):
+        """Publication.java onTimeout: a publication that cannot reach a
+        commit quorum within the timeout deposes the leader — this is how a
+        minority-side leader stands down after a partition."""
+        if self._publish_in_flight:
+            self._publish_in_flight = False
+            if self.mode == Mode.LEADER:
+                self._become_candidate("publication failed to commit")
+
+    def _broadcast_commit(self, commit: ApplyCommitRequest,
+                          state: ClusterState):
+        if not self._publish_in_flight:
+            return  # already committed this publication
+        self._publish_in_flight = False
+        payload = {"term": commit.term, "version": commit.version}
+        for peer in sorted(state.nodes):
+            if peer == self.node_id:
+                self._apply_commit(commit)
+            else:
+                self.transport.send(self.node_id, peer, COMMIT_ACTION,
+                                    payload, None, lambda e: None)
+        # more queued work?
+        if self._pending_values or self._pending_joins:
+            self.scheduler.schedule_now(self._publish_next,
+                                        "publish queued updates")
+
+    def _on_publish(self, sender: str, payload: dict):
+        state: ClusterState = payload["state"]
+        self.known_peers |= set(state.nodes)
+        if state.term > self.coord_state.current_term:
+            # accept the newer term implicitly (like handling a StartJoin)
+            self.coord_state.handle_start_join(
+                StartJoinRequest(source_node=sender, term=state.term))
+        resp = self.coord_state.handle_publish_request(
+            PublishRequest(state))
+        if sender != self.node_id:
+            self._become_follower(sender)
+        return {"term": resp.term, "version": resp.version}
+
+    def _on_commit(self, sender: str, payload: dict):
+        commit = ApplyCommitRequest(source_node=sender,
+                                    term=payload["term"],
+                                    version=payload["version"])
+        self._apply_commit(commit)
+        return {"ok": True}
+
+    def _apply_commit(self, commit: ApplyCommitRequest):
+        try:
+            state = self.coord_state.handle_commit(commit)
+        except CoordinationStateRejectedError:
+            return
+        self.applied_state = state
+        self.known_peers |= set(state.nodes)
+        if self.on_state_applied is not None:
+            self.on_state_applied(state)
+
+    # ------------------------------------------------------ fault detection
+
+    CHECK_TIMEOUT_MS = 10_000   # follower_check.timeout / leader_check.timeout
+
+    def _send_with_timeout(self, target: str, action: str, payload,
+                           on_ok, on_fail):
+        """Fault-detection RPCs fail on timeout too (blackholed links drop
+        messages silently — the reference's checks have explicit timeouts)."""
+        settled = [False]
+
+        def ok(resp):
+            if not settled[0]:
+                settled[0] = True
+                on_ok(resp)
+
+        def fail(exc):
+            if not settled[0]:
+                settled[0] = True
+                on_fail(exc)
+
+        self.transport.send(self.node_id, target, action, payload, ok, fail)
+        self.scheduler.schedule_delayed(
+            self.CHECK_TIMEOUT_MS,
+            lambda: fail(TimeoutError(f"[{action}] to [{target}] timed out")),
+            f"timeout of {action} to {target}")
+
+    def _schedule_follower_checks(self):
+        if self._stopped or self.mode != Mode.LEADER:
+            return
+        epoch = self._election_epoch
+
+        def run():
+            if self._stopped or self.mode != Mode.LEADER \
+                    or epoch != self._election_epoch:
+                return
+            for peer in sorted(self.applied_state.nodes):
+                if peer == self.node_id:
+                    continue
+                self._check_follower(peer)
+            self.scheduler.schedule_delayed(
+                FOLLOWER_CHECK_INTERVAL_MS, run, "follower checks")
+
+        self.scheduler.schedule_delayed(FOLLOWER_CHECK_INTERVAL_MS, run,
+                                        "follower checks")
+
+    def _check_follower(self, peer: str):
+        def on_ok(resp):
+            self._check_failures[peer] = 0
+
+        def on_fail(exc):
+            if self.mode != Mode.LEADER:
+                return
+            self._check_failures[peer] = self._check_failures.get(peer, 0) + 1
+            if self._check_failures[peer] >= CHECK_RETRY_COUNT:
+                self._remove_node(peer, "followers check retry count "
+                                        "exceeded")
+
+        self._send_with_timeout(peer, FOLLOWER_CHECK_ACTION,
+                                {"term": self.coord_state.current_term},
+                                on_ok, on_fail)
+
+    def _on_follower_check(self, sender: str, payload: dict):
+        """FollowersChecker.handleFollowerCheck: a check from a leader with
+        a current term makes us its follower."""
+        term = payload["term"]
+        if term < self.coord_state.current_term:
+            raise CoordinationStateRejectedError(
+                f"rejecting check from leader in term {term}, current term "
+                f"is {self.coord_state.current_term}")
+        if term > self.coord_state.current_term:
+            self.coord_state.handle_start_join(
+                StartJoinRequest(source_node=sender, term=term))
+        if self.mode != Mode.FOLLOWER or self.leader != sender:
+            self._become_follower(sender)
+        return {"ok": True}
+
+    def _remove_node(self, peer: str, reason: str):
+        """NodeRemovalClusterStateTaskExecutor analog."""
+        self._check_failures.pop(peer, None)
+
+        def update(state: ClusterState) -> ClusterState:
+            return state.with_(nodes=frozenset(set(state.nodes) - {peer}))
+
+        self.submit_state_update(update)
+
+    def _schedule_leader_check(self):
+        if self._stopped or self.mode != Mode.FOLLOWER:
+            return
+        epoch = self._election_epoch
+
+        def run():
+            if self._stopped or self.mode != Mode.FOLLOWER \
+                    or epoch != self._election_epoch:
+                return
+            leader = self.leader
+
+            def on_ok(resp):
+                self._leader_check_failures = 0
+
+            def on_fail(exc):
+                if self.mode != Mode.FOLLOWER or self.leader != leader:
+                    return
+                self._leader_check_failures += 1
+                if self._leader_check_failures >= CHECK_RETRY_COUNT:
+                    self._become_candidate("leader check retry count "
+                                           "exceeded")
+
+            self._send_with_timeout(leader, LEADER_CHECK_ACTION,
+                                    {}, on_ok, on_fail)
+            self.scheduler.schedule_delayed(LEADER_CHECK_INTERVAL_MS, run,
+                                            "leader check")
+
+        self.scheduler.schedule_delayed(LEADER_CHECK_INTERVAL_MS, run,
+                                        "leader check")
+
+    def _on_leader_check(self, sender: str, payload: dict):
+        if self.mode != Mode.LEADER:
+            raise CoordinationStateRejectedError(
+                f"rejecting leader check while mode is {self.mode.value}")
+        return {"ok": True}
+
+    # -------------------------------------------------------------- joining
+
+    def join_cluster(self, via: str):
+        """A fresh node asks `via` (any known node) to admit it."""
+        def on_response(resp):
+            if resp and not resp.get("accepted") and resp.get("leader"):
+                self.join_cluster(resp["leader"])
+
+        self.known_peers.add(via)
+        self.transport.send(self.node_id, via, JOIN_ACTION, {},
+                            on_response, lambda e: None)
+
+
+def bootstrap_state(node_ids: List[str]) -> ClusterState:
+    """ClusterBootstrapService analog: the initial voting configuration is
+    the explicit list of master-eligible nodes (initial_cluster_manager_nodes)."""
+    config = VotingConfiguration(frozenset(node_ids))
+    return ClusterState(term=0, version=0, nodes=frozenset(node_ids),
+                        master_node=None,
+                        last_committed_config=config,
+                        last_accepted_config=config,
+                        data=None)

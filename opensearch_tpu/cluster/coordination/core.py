@@ -1,0 +1,267 @@
+"""The consensus safety core: terms, joins, two-phase publish+commit.
+
+Re-design of cluster/coordination/CoordinationState.java — the pure state
+machine the reference keeps free of IO so its invariants can be checked in
+deterministic simulation. The same separation here: this module has NO
+scheduling and NO transport; the Coordinator drives it.
+
+Model (matching the reference's terms):
+  - a **term** is an election epoch; StartJoin(term) invites a vote, a Join
+    is a vote bound to that term carrying the voter's last-accepted
+    (term, version) so stale candidates are rejected by voters comparing
+    freshness at vote time;
+  - election quorum needs joins from a majority of BOTH the last-committed
+    and the last-accepted voting configurations (joint consensus during
+    reconfiguration — CoordinationState.isElectionQuorum);
+  - publish is two-phase: PublishRequest(state) → quorum of
+    PublishResponse → ApplyCommit broadcast (Publication.java semantics).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field, replace
+from typing import Any, Dict, FrozenSet, Optional, Set
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+
+
+class CoordinationStateRejectedError(OpenSearchTpuError):
+    status = 400
+    error_type = "coordination_state_rejected_exception"
+
+
+@dataclass(frozen=True)
+class VotingConfiguration:
+    """The node ids whose majority decides elections and commits
+    (reference: CoordinationMetadata.VotingConfiguration)."""
+    node_ids: FrozenSet[str] = frozenset()
+
+    def has_quorum(self, votes: Set[str]) -> bool:
+        if not self.node_ids:
+            return False
+        return len(votes & self.node_ids) * 2 > len(self.node_ids)
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.node_ids
+
+    @staticmethod
+    def of(*ids: str) -> "VotingConfiguration":
+        return VotingConfiguration(frozenset(ids))
+
+
+@dataclass(frozen=True)
+class ClusterState:
+    """Immutable committed-state snapshot (cluster/ClusterState.java:167).
+    `data` carries the application payload (metadata, routing table, ...);
+    the coordination layer only reads term/version/configs/nodes."""
+    term: int = 0
+    version: int = 0
+    nodes: FrozenSet[str] = frozenset()
+    master_node: Optional[str] = None
+    last_committed_config: VotingConfiguration = VotingConfiguration()
+    last_accepted_config: VotingConfiguration = VotingConfiguration()
+    data: Any = None
+
+    def with_(self, **kw) -> "ClusterState":
+        return replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class StartJoinRequest:
+    source_node: str     # the candidate soliciting the vote
+    term: int
+
+
+@dataclass(frozen=True)
+class Join:
+    source_node: str     # the voter
+    target_node: str     # the candidate voted for
+    term: int
+    last_accepted_term: int
+    last_accepted_version: int
+
+
+@dataclass(frozen=True)
+class PublishRequest:
+    state: ClusterState
+
+
+@dataclass(frozen=True)
+class PublishResponse:
+    term: int
+    version: int
+
+
+@dataclass(frozen=True)
+class ApplyCommitRequest:
+    source_node: str
+    term: int
+    version: int
+
+
+class CoordinationState:
+    """Per-node consensus state. Persisted pieces (the reference persists
+    them via GatewayMetaState): current_term, last_accepted state."""
+
+    def __init__(self, node_id: str, initial_state: ClusterState):
+        self.node_id = node_id
+        self.current_term = initial_state.term
+        self.last_accepted: ClusterState = initial_state
+        self.join_votes: Dict[str, Join] = {}
+        self.election_won = False
+        self.publish_votes: Set[str] = set()
+        self.last_published_version = 0
+        self.last_published_config = initial_state.last_accepted_config
+        self.last_commit_version = initial_state.version
+
+    # ------------------------------------------------------------ accessors
+
+    @property
+    def last_accepted_term(self) -> int:
+        return self.last_accepted.term
+
+    @property
+    def last_accepted_version(self) -> int:
+        return self.last_accepted.version
+
+    def is_electable(self) -> bool:
+        """A node can only win elections if it's in a voting config
+        (reference: locally-elected requirement)."""
+        return (self.last_accepted.last_committed_config.is_empty is False)
+
+    # ----------------------------------------------------------- start join
+
+    def handle_start_join(self, request: StartJoinRequest) -> Join:
+        """A candidate asked for our vote in a newer term."""
+        if request.term <= self.current_term:
+            raise CoordinationStateRejectedError(
+                f"incoming term {request.term} not greater than current "
+                f"term {self.current_term}")
+        join = Join(source_node=self.node_id,
+                    target_node=request.source_node,
+                    term=request.term,
+                    last_accepted_term=self.last_accepted_term,
+                    last_accepted_version=self.last_accepted_version)
+        self.current_term = request.term
+        self.join_votes = {}
+        self.election_won = False
+        self.publish_votes = set()
+        self.last_published_version = 0
+        return join
+
+    # ----------------------------------------------------------------- join
+
+    def handle_join(self, join: Join) -> bool:
+        """Candidate side: count a vote. Returns True when this join wins
+        the election."""
+        if join.term != self.current_term:
+            raise CoordinationStateRejectedError(
+                f"incoming term {join.term} does not match current term "
+                f"{self.current_term}")
+        if join.last_accepted_term > self.last_accepted_term:
+            raise CoordinationStateRejectedError(
+                "incoming last accepted term "
+                f"{join.last_accepted_term} of join higher than current "
+                f"last accepted term {self.last_accepted_term}")
+        if (join.last_accepted_term == self.last_accepted_term
+                and join.last_accepted_version > self.last_accepted_version):
+            raise CoordinationStateRejectedError(
+                "incoming last accepted version "
+                f"{join.last_accepted_version} of join higher than current "
+                f"last accepted version {self.last_accepted_version}")
+        if self.last_accepted.version == 0 and \
+                self.last_accepted.last_accepted_config.is_empty:
+            raise CoordinationStateRejectedError(
+                "cannot win election before bootstrapping")
+        prev_won = self.election_won
+        self.join_votes[join.source_node] = join
+        self.election_won = self._is_election_quorum(set(self.join_votes))
+        return self.election_won and not prev_won
+
+    def _is_election_quorum(self, votes: Set[str]) -> bool:
+        return (self.last_accepted.last_committed_config.has_quorum(votes)
+                and self.last_accepted.last_accepted_config.has_quorum(votes))
+
+    # -------------------------------------------------------------- publish
+
+    def handle_client_value(self, state: ClusterState) -> PublishRequest:
+        """Leader side: start publishing a new state."""
+        if not self.election_won:
+            raise CoordinationStateRejectedError(
+                "only the leader can publish")
+        if state.term != self.current_term:
+            raise CoordinationStateRejectedError(
+                f"incoming term {state.term} does not match current term "
+                f"{self.current_term}")
+        if self.last_published_version != 0 and \
+                state.version != self.last_published_version + 1:
+            raise CoordinationStateRejectedError(
+                f"incoming version {state.version} does not follow last "
+                f"published version {self.last_published_version}")
+        if state.version <= self.last_accepted_version and \
+                state.term == self.last_accepted_term:
+            raise CoordinationStateRejectedError(
+                f"incoming version {state.version} not newer than accepted "
+                f"{self.last_accepted_version}")
+        self.last_published_version = state.version
+        self.last_published_config = state.last_accepted_config
+        self.publish_votes = set()
+        return PublishRequest(state)
+
+    def handle_publish_request(self, request: PublishRequest
+                               ) -> PublishResponse:
+        """Any node: accept a published state (phase 1)."""
+        state = request.state
+        if state.term != self.current_term:
+            raise CoordinationStateRejectedError(
+                f"incoming term {state.term} does not match current term "
+                f"{self.current_term}")
+        if state.term == self.last_accepted_term and \
+                state.version <= self.last_accepted_version:
+            raise CoordinationStateRejectedError(
+                f"incoming version {state.version} lower or equal to "
+                f"accepted version {self.last_accepted_version} in term "
+                f"{state.term}")
+        self.last_accepted = state
+        return PublishResponse(term=state.term, version=state.version)
+
+    def handle_publish_response(self, source_node: str,
+                                response: PublishResponse
+                                ) -> Optional[ApplyCommitRequest]:
+        """Leader: collect acks; on quorum return the commit to broadcast."""
+        if response.term != self.current_term:
+            raise CoordinationStateRejectedError(
+                f"incoming term {response.term} does not match current "
+                f"term {self.current_term}")
+        if response.version != self.last_published_version:
+            raise CoordinationStateRejectedError(
+                f"incoming version {response.version} does not match "
+                f"published version {self.last_published_version}")
+        self.publish_votes.add(source_node)
+        if self._is_publish_quorum(self.publish_votes):
+            return ApplyCommitRequest(source_node=self.node_id,
+                                      term=response.term,
+                                      version=response.version)
+        return None
+
+    def _is_publish_quorum(self, votes: Set[str]) -> bool:
+        return (self.last_accepted.last_committed_config.has_quorum(votes)
+                and self.last_published_config.has_quorum(votes))
+
+    def handle_commit(self, commit: ApplyCommitRequest) -> ClusterState:
+        """Any node: mark the accepted state committed (phase 2)."""
+        if commit.term != self.current_term:
+            raise CoordinationStateRejectedError(
+                f"incoming term {commit.term} does not match current term "
+                f"{self.current_term}")
+        if commit.term != self.last_accepted_term:
+            raise CoordinationStateRejectedError(
+                f"incoming term {commit.term} does not match last accepted "
+                f"term {self.last_accepted_term}")
+        if commit.version != self.last_accepted_version:
+            raise CoordinationStateRejectedError(
+                f"incoming version {commit.version} does not match last "
+                f"accepted version {self.last_accepted_version}")
+        self.last_commit_version = commit.version
+        return self.last_accepted

@@ -1,0 +1,94 @@
+"""DeterministicTaskQueue: seeded virtual-time scheduler for simulation.
+
+Re-design of the reference's test-framework
+cluster/coordination/DeterministicTaskQueue.java:61 — the engine under
+AbstractCoordinatorTestCase: no threads, no wall clock. Runnable tasks
+execute in seeded-random order; deferred tasks fire when virtual time is
+advanced to their deadline. Every run with the same seed replays exactly,
+which is the race-detection story for the consensus layer (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, List, Optional, Tuple
+
+
+class DeterministicTaskQueue:
+    def __init__(self, seed: int = 0):
+        self.random = random.Random(seed)
+        self.current_time_ms = 0
+        self._runnable: List[Tuple[str, Callable]] = []
+        self._deferred: List[Tuple[int, int, str, Callable]] = []
+        self._counter = 0
+
+    # ---------------------------------------------------------- scheduling
+
+    def schedule_now(self, fn: Callable, description: str = ""):
+        self._runnable.append((description, fn))
+
+    def schedule_at(self, execution_time_ms: int, fn: Callable,
+                    description: str = ""):
+        if execution_time_ms <= self.current_time_ms:
+            self.schedule_now(fn, description)
+            return
+        self._counter += 1
+        self._deferred.append((execution_time_ms, self._counter,
+                               description, fn))
+
+    def schedule_delayed(self, delay_ms: int, fn: Callable,
+                         description: str = ""):
+        self.schedule_at(self.current_time_ms + delay_ms, fn, description)
+
+    # ----------------------------------------------------------- execution
+
+    @property
+    def has_runnable_tasks(self) -> bool:
+        return bool(self._runnable)
+
+    @property
+    def has_deferred_tasks(self) -> bool:
+        return bool(self._deferred)
+
+    def run_random_task(self):
+        """Run one runnable task, chosen by the seeded random — the
+        reordering that shakes out ordering assumptions."""
+        i = self.random.randrange(len(self._runnable))
+        _, fn = self._runnable.pop(i)
+        fn()
+
+    def run_all_runnable_tasks(self):
+        while self._runnable:
+            self.run_random_task()
+
+    def advance_time(self):
+        """Jump virtual time to the next deferred deadline and promote all
+        tasks due by then."""
+        if not self._deferred:
+            return
+        self._deferred.sort()
+        next_time = self._deferred[0][0]
+        self.current_time_ms = next_time
+        due = [t for t in self._deferred if t[0] <= next_time]
+        self._deferred = [t for t in self._deferred if t[0] > next_time]
+        for _, _, description, fn in due:
+            self.schedule_now(fn, description)
+
+    def run_until(self, end_time_ms: int):
+        """Drive the queue (tasks + time) until virtual `end_time_ms`."""
+        while self.current_time_ms < end_time_ms and (
+                self._runnable or self._deferred):
+            if self._runnable:
+                self.run_random_task()
+            else:
+                self.advance_time()
+        self.run_all_runnable_tasks()
+
+    def run_to_quiescence(self, max_time_ms: int = 10 ** 9):
+        """Run until no tasks remain (bounded by max_time_ms)."""
+        while (self._runnable or self._deferred) and \
+                self.current_time_ms < max_time_ms:
+            if self._runnable:
+                self.run_random_task()
+            else:
+                self.advance_time()

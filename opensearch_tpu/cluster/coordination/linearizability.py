@@ -1,0 +1,100 @@
+"""Linearizability checker for concurrent histories.
+
+Re-design of the reference test-framework's LinearizabilityChecker.java:66
+(Wing & Gong / Lowe's algorithm): given a sequential specification and a
+concurrent history of [invoke, respond] intervals, search for a linear order
+of operations consistent with real-time ordering whose sequential execution
+matches every response. Used by the coordination simulation to assert that
+cluster-state reads/writes behave like an atomic register (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Tuple
+
+
+class SequentialSpec:
+    """A deterministic state machine: initial_state + apply()."""
+
+    def initial_state(self) -> Any:
+        raise NotImplementedError
+
+    def apply(self, state: Any, op_input: Any) -> Tuple[Any, Any]:
+        """Returns (next_state, expected_output)."""
+        raise NotImplementedError
+
+
+class RegisterSpec(SequentialSpec):
+    """Atomic read/write register (the reference's spec for cluster state):
+    input ("write", v) → output None; input ("read", None) → output value."""
+
+    def initial_state(self):
+        return None
+
+    def apply(self, state, op_input):
+        kind, value = op_input
+        if kind == "write":
+            return value, None
+        if kind == "read":
+            return state, state
+        raise ValueError(f"unknown op {kind}")
+
+
+@dataclass
+class Operation:
+    op_input: Any
+    output: Any          # None allowed; compared by ==
+    invoke_time: int
+    response_time: Optional[int]   # None = never returned (crashed client)
+    op_id: int = 0
+
+
+class LinearizabilityChecker:
+    def __init__(self, spec: SequentialSpec):
+        self.spec = spec
+
+    def is_linearizable(self, history: List[Operation],
+                        max_steps: int = 2_000_000) -> bool:
+        """Unreturned ops (response_time None) may linearize anywhere after
+        their invocation or not at all, per the reference's handling of
+        crashed clients."""
+        ops = sorted(history, key=lambda o: o.invoke_time)
+        for i, op in enumerate(ops):
+            op.op_id = i
+        n = len(ops)
+        steps = [0]
+
+        completed = frozenset(o.op_id for o in ops
+                              if o.response_time is not None)
+
+        def search(done: frozenset, state_key, state) -> bool:
+            steps[0] += 1
+            if steps[0] > max_steps:
+                raise RuntimeError("linearizability search budget exceeded")
+            if completed <= done:
+                return True  # crashed ops may simply never take effect
+            # earliest response among not-done ops bounds which ops are
+            # candidates: an op can only go next if it was invoked before
+            # every not-done op responded (real-time order preserved)
+            min_response = min(
+                (o.response_time for o in ops
+                 if o.op_id not in done and o.response_time is not None),
+                default=None)
+            for op in ops:
+                if op.op_id in done:
+                    continue
+                if min_response is not None and op.invoke_time > min_response:
+                    break  # sorted by invoke_time: no later op qualifies
+                next_state, expected = self.spec.apply(state, op.op_input)
+                if op.response_time is None:
+                    # crashed op: try linearizing it AND try skipping it
+                    if search(done | {op.op_id}, None, next_state):
+                        return True
+                    continue
+                if expected == op.output:
+                    if search(done | {op.op_id}, None, next_state):
+                        return True
+            return False
+
+        return search(frozenset(), None, self.spec.initial_state())

@@ -1,0 +1,106 @@
+"""DisruptableMockTransport: rule-based simulated network for coordination.
+
+Re-design of test/framework disruption machinery
+(test/disruption/DisruptableMockTransport.java + NetworkDisruption.java:61):
+messages between simulated nodes route through the DeterministicTaskQueue
+with per-link rules — blackhole (drop silently), disconnect (fail fast),
+delay. Partitions are sets of one-way blocked links; heal() clears them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Set, Tuple
+
+from opensearch_tpu.common.errors import NodeNotConnectedError
+
+
+class DisruptableMockTransport:
+    def __init__(self, task_queue, delivery_delay_ms: int = 10):
+        self.task_queue = task_queue
+        self.handlers: Dict[str, Dict[str, Callable]] = {}  # node → action → fn
+        self.blackholed: Set[Tuple[str, str]] = set()
+        self.disconnected: Set[Tuple[str, str]] = set()
+        self.delay_ms = delivery_delay_ms
+        self.alive: Set[str] = set()
+
+    # ------------------------------------------------------------- registry
+
+    def register_node(self, node_id: str):
+        self.handlers.setdefault(node_id, {})
+        self.alive.add(node_id)
+
+    def register_handler(self, node_id: str, action: str, handler: Callable):
+        self.handlers.setdefault(node_id, {})[action] = handler
+
+    def kill_node(self, node_id: str):
+        self.alive.discard(node_id)
+
+    def restart_node(self, node_id: str):
+        self.alive.add(node_id)
+
+    # ----------------------------------------------------------- disruption
+
+    def partition(self, side_a: Set[str], side_b: Set[str]):
+        for a in side_a:
+            for b in side_b:
+                self.blackholed.add((a, b))
+                self.blackholed.add((b, a))
+
+    def blackhole_link(self, sender: str, target: str):
+        self.blackholed.add((sender, target))
+
+    def disconnect_node(self, node_id: str):
+        for other in self.handlers:
+            if other != node_id:
+                self.disconnected.add((node_id, other))
+                self.disconnected.add((other, node_id))
+
+    def heal(self):
+        self.blackholed.clear()
+        self.disconnected.clear()
+
+    # ------------------------------------------------------------- delivery
+
+    def send(self, sender: str, target: str, action: str, payload: Any,
+             on_response: Optional[Callable[[Any], None]] = None,
+             on_failure: Optional[Callable[[Exception], None]] = None):
+        """Asynchronous request/response through virtual time. Responses
+        travel back over the same (possibly disrupted) link."""
+
+        def fail(exc):
+            if on_failure is not None:
+                self.task_queue.schedule_now(
+                    lambda: on_failure(exc),
+                    f"failure of {action} from {sender} to {target}")
+
+        if (sender, target) in self.blackholed:
+            return  # silently dropped; sender's own timeouts must handle it
+        if (sender, target) in self.disconnected or target not in self.alive:
+            fail(NodeNotConnectedError(f"[{target}] disconnected"))
+            return
+
+        def deliver():
+            if target not in self.alive:
+                fail(NodeNotConnectedError(f"[{target}] disconnected"))
+                return
+            handler = self.handlers.get(target, {}).get(action)
+            if handler is None:
+                fail(NodeNotConnectedError(
+                    f"no handler for [{action}] on [{target}]"))
+                return
+            try:
+                response = handler(sender, payload)
+            except Exception as e:  # handler exception → remote failure
+                if (target, sender) not in self.blackholed:
+                    fail(e)
+                return
+            if on_response is not None:
+                if (target, sender) in self.blackholed:
+                    return  # response lost
+                self.task_queue.schedule_delayed(
+                    self.delay_ms, lambda: on_response(response),
+                    f"response to {action} from {target} to {sender}")
+
+        self.task_queue.schedule_delayed(
+            self.delay_ms, deliver, f"delivery of {action} from {sender} "
+            f"to {target}")

@@ -1,0 +1,350 @@
+"""Deterministic-simulation tests of the coordination layer.
+
+The analog of the reference's AbstractCoordinatorTestCase suites
+(CoordinatorTests.java): N coordinators run over DisruptableMockTransport
+on a seeded DeterministicTaskQueue — no threads, no sockets, fully
+replayable. Safety properties checked across seeds: at most one leader per
+term, committed states agree, convergence after partitions/kills, and
+linearizability of the cluster-state register."""
+
+import pytest
+
+from opensearch_tpu.cluster.coordination import (
+    Coordinator, DeterministicTaskQueue, DisruptableMockTransport, Mode)
+from opensearch_tpu.cluster.coordination.coordinator import bootstrap_state
+from opensearch_tpu.cluster.coordination.core import (
+    ClusterState, CoordinationState, CoordinationStateRejectedError,
+    PublishRequest, StartJoinRequest, VotingConfiguration)
+from opensearch_tpu.cluster.coordination.linearizability import (
+    LinearizabilityChecker, Operation, RegisterSpec)
+
+
+class Cluster:
+    """Simulation cluster (AbstractCoordinatorTestCase.Cluster analog)."""
+
+    def __init__(self, n_nodes: int, seed: int = 0):
+        self.queue = DeterministicTaskQueue(seed)
+        self.transport = DisruptableMockTransport(self.queue)
+        self.node_ids = [f"node-{i}" for i in range(n_nodes)]
+        initial = bootstrap_state(self.node_ids)
+        self.coordinators = {}
+        self.applied = {n: [] for n in self.node_ids}
+        for node_id in self.node_ids:
+            self.transport.register_node(node_id)
+            coord = Coordinator(
+                node_id, self.transport, self.queue, initial,
+                on_state_applied=self._applier(node_id))
+            self.coordinators[node_id] = coord
+        for coord in self.coordinators.values():
+            coord.start()
+
+    def _applier(self, node_id):
+        def apply(state):
+            self.applied[node_id].append(state)
+        return apply
+
+    def stabilise(self, time_ms: int = 60_000):
+        self.queue.run_until(self.queue.current_time_ms + time_ms)
+
+    def leaders(self):
+        return [c for c in self.coordinators.values()
+                if c.mode == Mode.LEADER
+                and self.transport_alive(c.node_id)]
+
+    def transport_alive(self, node_id):
+        return node_id in self.transport.alive
+
+    def the_leader(self):
+        leaders = self.leaders()
+        assert len(leaders) == 1, \
+            f"expected one leader, got {[c.node_id for c in leaders]}"
+        return leaders[0]
+
+
+SEEDS = [0, 1, 2, 7, 42]
+
+
+class TestElection:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_leader_elected_and_unique(self, seed):
+        cluster = Cluster(3, seed)
+        cluster.stabilise()
+        leader = cluster.the_leader()
+        # every live node agrees on the applied master
+        for c in cluster.coordinators.values():
+            assert c.applied_state.master_node == leader.node_id
+            assert c.mode in (Mode.LEADER, Mode.FOLLOWER)
+
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_single_node_cluster(self, seed):
+        cluster = Cluster(1, seed)
+        cluster.stabilise(30_000)
+        leader = cluster.the_leader()
+        assert leader.applied_state.master_node == leader.node_id
+
+    @pytest.mark.parametrize("seed", SEEDS[:3])
+    def test_five_node_cluster(self, seed):
+        cluster = Cluster(5, seed)
+        cluster.stabilise()
+        cluster.the_leader()
+
+    @pytest.mark.parametrize("seed", SEEDS[:3])
+    def test_at_most_one_leader_per_term(self, seed):
+        cluster = Cluster(3, seed)
+        cluster.stabilise()
+        # collect every applied state from every node: per term, the master
+        # must be unique (the core safety property)
+        masters_by_term = {}
+        for states in cluster.applied.values():
+            for s in states:
+                if s.master_node is None:
+                    continue
+                masters_by_term.setdefault(s.term, set()).add(s.master_node)
+        for term, masters in masters_by_term.items():
+            assert len(masters) == 1, \
+                f"term {term} had multiple masters {masters}"
+
+
+class TestPublication:
+    @pytest.mark.parametrize("seed", SEEDS[:3])
+    def test_state_update_reaches_all_nodes(self, seed):
+        cluster = Cluster(3, seed)
+        cluster.stabilise()
+        leader = cluster.the_leader()
+        ok = leader.submit_state_update(
+            lambda s: s.with_(data={"setting": "x"}))
+        assert ok
+        cluster.stabilise(10_000)
+        for c in cluster.coordinators.values():
+            assert c.applied_state.data == {"setting": "x"}
+
+    @pytest.mark.parametrize("seed", SEEDS[:3])
+    def test_sequential_updates_ordered(self, seed):
+        cluster = Cluster(3, seed)
+        cluster.stabilise()
+        leader = cluster.the_leader()
+        for i in range(5):
+            leader.submit_state_update(
+                lambda s, i=i: s.with_(data=i))
+            cluster.stabilise(5_000)
+        for c in cluster.coordinators.values():
+            assert c.applied_state.data == 4
+        # versions strictly increase in every applied stream
+        for states in cluster.applied.values():
+            versions = [s.version for s in states]
+            assert versions == sorted(set(versions))
+
+    def test_follower_cannot_publish(self):
+        cluster = Cluster(3, 0)
+        cluster.stabilise()
+        leader = cluster.the_leader()
+        follower = next(c for c in cluster.coordinators.values()
+                        if c is not leader)
+        assert follower.submit_state_update(lambda s: s.with_(data=1)) is False
+
+
+class TestFailureRecovery:
+    @pytest.mark.parametrize("seed", SEEDS[:3])
+    def test_leader_death_triggers_reelection(self, seed):
+        cluster = Cluster(3, seed)
+        cluster.stabilise()
+        old_leader = cluster.the_leader()
+        cluster.transport.kill_node(old_leader.node_id)
+        old_leader.stop()
+        cluster.stabilise(120_000)
+        survivors = [c for c in cluster.coordinators.values()
+                     if c is not old_leader]
+        new_leaders = [c for c in survivors if c.mode == Mode.LEADER]
+        assert len(new_leaders) == 1
+        new_leader = new_leaders[0]
+        assert new_leader.coord_state.current_term > \
+            old_leader.coord_state.current_term
+        # dead node removed from the applied cluster membership
+        assert old_leader.node_id not in new_leader.applied_state.nodes
+
+    @pytest.mark.parametrize("seed", SEEDS[:3])
+    def test_follower_death_detected_and_removed(self, seed):
+        cluster = Cluster(3, seed)
+        cluster.stabilise()
+        leader = cluster.the_leader()
+        victim = next(c for c in cluster.coordinators.values()
+                      if c is not leader)
+        cluster.transport.kill_node(victim.node_id)
+        victim.stop()
+        cluster.stabilise(120_000)
+        assert victim.node_id not in leader.applied_state.nodes
+        assert leader.mode == Mode.LEADER
+
+    @pytest.mark.parametrize("seed", SEEDS[:3])
+    def test_partition_minority_leader_stands_down(self, seed):
+        cluster = Cluster(3, seed)
+        cluster.stabilise()
+        leader = cluster.the_leader()
+        others = [c.node_id for c in cluster.coordinators.values()
+                  if c is not leader]
+        # isolate the leader from the majority
+        cluster.transport.partition({leader.node_id}, set(others))
+        cluster.stabilise(180_000)
+        # majority side elected a new leader
+        majority_leaders = [c for c in cluster.coordinators.values()
+                            if c.node_id in others
+                            and c.mode == Mode.LEADER]
+        assert len(majority_leaders) == 1
+        # old leader can no longer commit anything
+        isolated = cluster.coordinators[leader.node_id]
+        isolated.submit_state_update(lambda s: s.with_(data="lost"))
+        cluster.stabilise(30_000)
+        assert majority_leaders[0].applied_state.data != "lost"
+        # heal: everyone converges on one leader and one state
+        cluster.transport.heal()
+        cluster.stabilise(180_000)
+        final = cluster.the_leader()
+        cluster.stabilise(60_000)
+        for c in cluster.coordinators.values():
+            assert c.applied_state.version == final.applied_state.version
+            assert c.applied_state.master_node == final.node_id
+
+    @pytest.mark.parametrize("seed", SEEDS[:2])
+    def test_committed_states_never_diverge(self, seed):
+        """Agreement: any two nodes' applied states at the same (term,
+        version) are identical — even across partitions."""
+        cluster = Cluster(5, seed)
+        cluster.stabilise()
+        leader = cluster.the_leader()
+        side_a = set(cluster.node_ids[:2])
+        side_b = set(cluster.node_ids[2:])
+        leader.submit_state_update(lambda s: s.with_(data="before"))
+        cluster.stabilise(10_000)
+        cluster.transport.partition(side_a, side_b)
+        for c in cluster.coordinators.values():
+            c.submit_state_update(lambda s: s.with_(data=f"from-{c.node_id}"))
+        cluster.stabilise(120_000)
+        cluster.transport.heal()
+        cluster.stabilise(120_000)
+        by_key = {}
+        for states in cluster.applied.values():
+            for s in states:
+                key = (s.term, s.version)
+                if key in by_key:
+                    assert by_key[key].data == s.data, \
+                        f"divergent committed state at {key}"
+                else:
+                    by_key[key] = s
+
+
+class TestSafetyCore:
+    def make_state(self, *nodes):
+        config = VotingConfiguration(frozenset(nodes))
+        return ClusterState(term=0, version=0, nodes=frozenset(nodes),
+                            last_committed_config=config,
+                            last_accepted_config=config)
+
+    def test_join_term_must_match(self):
+        cs = CoordinationState("n1", self.make_state("n1", "n2", "n3"))
+        join = cs.handle_start_join(StartJoinRequest("n1", 1))
+        assert join.term == 1
+        with pytest.raises(CoordinationStateRejectedError):
+            cs.handle_start_join(StartJoinRequest("n1", 1))  # not greater
+
+    def test_election_needs_quorum(self):
+        cs = CoordinationState("n1", self.make_state("n1", "n2", "n3"))
+        j1 = cs.handle_start_join(StartJoinRequest("n1", 1))
+        assert cs.handle_join(j1) is False         # 1/3 votes
+        from opensearch_tpu.cluster.coordination.core import Join
+        j2 = Join("n2", "n1", 1, 0, 0)
+        assert cs.handle_join(j2) is True          # 2/3 votes → won
+        assert cs.election_won
+
+    def test_stale_candidate_rejected_by_voter(self):
+        """A voter with newer accepted state refuses to vote for a stale
+        candidate (the log-freshness check)."""
+        cs = CoordinationState("n1", self.make_state("n1", "n2", "n3"))
+        cs.handle_start_join(StartJoinRequest("n2", 1))
+        # n1 accepts a state at term 1 version 5
+        state = self.make_state("n1", "n2", "n3").with_(term=1, version=5)
+        cs.handle_publish_request(PublishRequest(state))
+        # now an election in term 2; a join claiming older accepted state
+        # than ours is fine, but OUR candidate state must reject joins
+        # claiming NEWER accepted state than we have
+        cs.handle_start_join(StartJoinRequest("n1", 2))
+        from opensearch_tpu.cluster.coordination.core import Join
+        with pytest.raises(CoordinationStateRejectedError):
+            cs.handle_join(Join("n3", "n1", 2, 1, 9))  # fresher than ours
+
+    def test_commit_requires_matching_accept(self):
+        from opensearch_tpu.cluster.coordination.core import (
+            ApplyCommitRequest)
+        cs = CoordinationState("n1", self.make_state("n1", "n2", "n3"))
+        cs.handle_start_join(StartJoinRequest("n2", 1))
+        with pytest.raises(CoordinationStateRejectedError):
+            cs.handle_commit(ApplyCommitRequest("n2", 1, 7))  # nothing accepted
+
+
+class TestLinearizability:
+    def test_sequential_history_ok(self):
+        checker = LinearizabilityChecker(RegisterSpec())
+        history = [
+            Operation(("write", 1), None, 0, 1),
+            Operation(("read", None), 1, 2, 3),
+            Operation(("write", 2), None, 4, 5),
+            Operation(("read", None), 2, 6, 7),
+        ]
+        assert checker.is_linearizable(history)
+
+    def test_concurrent_overlap_ok(self):
+        checker = LinearizabilityChecker(RegisterSpec())
+        # read overlaps the write and may see either value
+        history = [
+            Operation(("write", 1), None, 0, 10),
+            Operation(("read", None), None, 1, 2),   # before write took effect
+            Operation(("read", None), 1, 5, 12),     # after
+        ]
+        assert checker.is_linearizable(history)
+
+    def test_stale_read_rejected(self):
+        checker = LinearizabilityChecker(RegisterSpec())
+        history = [
+            Operation(("write", 1), None, 0, 1),
+            Operation(("read", None), None, 2, 3),   # STALE: must see 1
+        ]
+        assert not checker.is_linearizable(history)
+
+    def test_crashed_write_may_or_may_not_apply(self):
+        checker = LinearizabilityChecker(RegisterSpec())
+        history = [
+            Operation(("write", 1), None, 0, 1),
+            Operation(("write", 2), None, 2, None),  # crashed client
+            Operation(("read", None), 2, 4, 5),      # observed it anyway
+        ]
+        assert checker.is_linearizable(history)
+        history2 = [
+            Operation(("write", 1), None, 0, 1),
+            Operation(("write", 2), None, 2, None),
+            Operation(("read", None), 1, 4, 5),      # or never applied
+        ]
+        assert checker.is_linearizable(history2)
+
+    def test_cluster_state_register_linearizable(self):
+        """End-to-end: drive the simulated cluster with writes+reads of
+        state.data and check the observed history against the register
+        spec — the reference's signature coordination test."""
+        cluster = Cluster(3, seed=3)
+        cluster.stabilise()
+        leader = cluster.the_leader()
+        history = []
+        t = [0]
+
+        def now():
+            t[0] += 1
+            return t[0]
+
+        for i in range(4):
+            inv = now()
+            leader.submit_state_update(lambda s, i=i: s.with_(data=i))
+            cluster.stabilise(10_000)
+            history.append(Operation(("write", i), None, inv, now()))
+            inv = now()
+            seen = leader.applied_state.data
+            history.append(Operation(("read", None), seen, inv, now()))
+        checker = LinearizabilityChecker(RegisterSpec())
+        assert checker.is_linearizable(history)
