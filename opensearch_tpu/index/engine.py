@@ -401,6 +401,29 @@ class InternalEngine:
                 fn(merged, [])
             return merged
 
+    def install_segments(self, segments: List[Segment], max_seq_no: int,
+                         local_checkpoint: int):
+        """Adopt a copied segment set (recovery phase1 / segment-replication
+        checkpoint sync). Segments are immutable; sharing references is the
+        in-process equivalent of the reference's file copy
+        (RecoverySourceHandler.phase1 / SegmentReplicationTarget)."""
+        with self._lock:
+            # columns are immutable and safely shared; liveness (deletes
+            # bitmap) and doc_meta are per-copy mutable state — clone them
+            # so a later delete on this copy can't corrupt the source
+            self.segments = [seg.clone_for_copy() for seg in segments]
+            self.builder = SegmentBuilder(self.mapper, self._next_seg_id())
+            self._builder_ords = {}
+            self.version_map = {}
+            # buffered ops/deletes predate the copied checkpoint: the
+            # installed segments already reflect them
+            self._pending_seal_deletes = []
+            self.local_checkpoint_tracker = LocalCheckpointTracker(
+                max_seq_no=max_seq_no, local_checkpoint=local_checkpoint)
+            self._sync_own_checkpoint()
+            for fn in self._refresh_listeners:
+                fn(None, [])
+
     # --------------------------------------------------------------- reopen
 
     def _recover_from_store(self):

@@ -1,0 +1,271 @@
+"""Shard replication: primary-backup writes, peer recovery, segment copy.
+
+Re-design of three reference subsystems (SURVEY.md §2.1/§3.3/§3.5):
+  - **write replication** — ReplicationOperation
+    (action/support/replication/ReplicationOperation.java:175,221): the
+    primary executes, fans the op with its assigned seq_no to every in-sync
+    copy, piggybacks the global checkpoint, and fails slow/broken copies out
+    of the in-sync set;
+  - **peer recovery** — RecoverySourceHandler
+    (indices/recovery/RecoverySourceHandler.java:164): retention-lease
+    ops-only recovery when the primary's translog still has the replica's
+    missing ops, else phase1 segment copy + phase2 translog replay, then
+    finalize (mark in-sync);
+  - **segment replication** — SegmentReplicationTargetService
+    (indices/replication/SegmentReplicationTargetService.java:192): replicas
+    adopt the primary's sealed segments at each refresh checkpoint instead
+    of re-indexing (the NRTReplicationEngine model — a natural fit here
+    since segments are immutable arrays).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from opensearch_tpu.common.errors import OpenSearchTpuError
+from opensearch_tpu.index.shard import IndexShard
+
+
+class ReplicationFailedError(OpenSearchTpuError):
+    status = 500
+    error_type = "replication_failed_exception"
+
+
+@dataclass
+class ReplicationCheckpoint:
+    """Segment-replication checkpoint published after primary refresh
+    (indices/replication/checkpoint/ReplicationCheckpoint.java)."""
+    primary_term: int
+    segment_infos_version: int
+    max_seq_no: int
+    local_checkpoint: int
+
+
+class ShardReplicationGroup:
+    """One logical shard: a primary plus replica copies on the same host
+    boundary (cross-node placement rides the transport layer; the protocol
+    below is identical either way)."""
+
+    def __init__(self, primary: IndexShard, replicas: List[IndexShard],
+                 replication_mode: str = "document"):
+        if replication_mode not in ("document", "segment"):
+            raise ValueError(f"unknown replication mode {replication_mode}")
+        self.primary = primary
+        self.replicas: Dict[str, IndexShard] = {}
+        self.failed: Dict[str, str] = {}  # alloc id → failure reason
+        self.mode = replication_mode
+        self._ckpt_version = 0
+        for replica in replicas:
+            self.add_replica(replica, recover=False)
+            # pristine empty replicas start in-sync (allocation of a new
+            # index); later joiners must go through recover_replica
+            self._tracker().mark_in_sync(self._alloc(replica),
+                                         replica.engine.local_checkpoint)
+        if self.mode == "segment":
+            self.primary.engine.add_refresh_listener(
+                lambda seg, deleted: self.publish_checkpoint())
+
+    # ------------------------------------------------------------- plumbing
+
+    def _tracker(self):
+        return self.primary.engine.replication_tracker
+
+    @staticmethod
+    def _alloc(shard: IndexShard) -> str:
+        return shard.engine.replication_tracker.shard_allocation_id \
+            if hasattr(shard.engine.replication_tracker,
+                       "shard_allocation_id") else str(id(shard))
+
+    def add_replica(self, replica: IndexShard, recover: bool = True):
+        alloc = self._alloc(replica)
+        self.replicas[alloc] = replica
+        self._tracker().init_tracking(alloc)
+        if recover:
+            self.recover_replica(replica)
+
+    def fail_replica(self, replica: IndexShard, reason: str):
+        """ReplicationOperation.onNoLongerPrimary path: a copy that failed
+        an op is removed from the in-sync set (the cluster manager would
+        reallocate it; here it must re-recover to return)."""
+        alloc = self._alloc(replica)
+        self.replicas.pop(alloc, None)
+        self.failed[alloc] = reason
+        self._tracker().remove_copy(alloc)
+
+    @property
+    def global_checkpoint(self) -> int:
+        return self._tracker().global_checkpoint
+
+    def in_sync_replicas(self) -> List[IndexShard]:
+        in_sync = self._tracker().in_sync_ids()
+        return [s for a, s in self.replicas.items() if a in in_sync]
+
+    # ------------------------------------------------------ replicated write
+
+    def index(self, doc_id: str, source: dict, **kw) -> dict:
+        res = self.primary.index_doc(doc_id, source, **kw)
+        self._replicate("index", doc_id, source, res)
+        return {"result": "updated" if not res.created else "created",
+                "_id": doc_id, "_seq_no": res.seq_no,
+                "_version": res.version,
+                "_shards": self._shards_header()}
+
+    def delete(self, doc_id: str, **kw) -> dict:
+        res = self.primary.delete_doc(doc_id, **kw)
+        if res.found:
+            self._replicate("delete", doc_id, None, res)
+        return {"result": "deleted" if res.found else "not_found",
+                "_id": doc_id, "_seq_no": res.seq_no,
+                "_shards": self._shards_header()}
+
+    def _replicate(self, op: str, doc_id: str, source: Optional[dict], res):
+        term = self.primary.engine.primary_term
+        tracker = self._tracker()
+        if self.mode == "segment":
+            # segment mode: replicas get data via checkpoint copy; the
+            # replica translog still records the op for durability — modeled
+            # by advancing its checkpoint state only
+            self._advance_checkpoints()
+            return
+        for alloc, replica in list(self.replicas.items()):
+            if alloc not in tracker.in_sync_ids():
+                continue
+            try:
+                if op == "index":
+                    replica.index_on_replica(doc_id, source, res.seq_no,
+                                             term, res.version)
+                else:
+                    replica.delete_on_replica(doc_id, res.seq_no, term,
+                                              res.version)
+                # piggyback the global checkpoint (ReplicationOperation
+                # sends globalCheckpoint with every replica request)
+                replica.engine.replication_tracker.global_checkpoint = \
+                    max(replica.engine.replication_tracker.global_checkpoint,
+                        tracker.global_checkpoint)
+            except Exception as e:
+                self.fail_replica(replica, f"{op} failed: {e}")
+        self._advance_checkpoints()
+
+    def _advance_checkpoints(self):
+        tracker = self._tracker()
+        tracker.update_local_checkpoint(
+            tracker.shard_allocation_id
+            if hasattr(tracker, "shard_allocation_id") else "primary",
+            self.primary.engine.local_checkpoint)
+        for alloc, replica in self.replicas.items():
+            tracker.update_local_checkpoint(
+                alloc, replica.engine.local_checkpoint)
+
+    def _shards_header(self) -> dict:
+        total = 1 + len(self.replicas)
+        return {"total": total, "successful": 1 + len(self.in_sync_replicas()),
+                "failed": len(self.failed)}
+
+    # --------------------------------------------------------- peer recovery
+
+    def recover_replica(self, replica: IndexShard) -> dict:
+        """Bring a (re)joining copy in sync. Returns recovery stats with the
+        strategy used, mirroring the recovery API's output."""
+        alloc = self._alloc(replica)
+        self.replicas[alloc] = replica
+        self.failed.pop(alloc, None)
+        tracker = self._tracker()
+        tracker.init_tracking(alloc)
+        primary_engine = self.primary.engine
+        # retention lease pins ops from the replica's checkpoint
+        # (RecoverySourceHandler tries ops-only recovery under a lease)
+        replica_ckpt = replica.engine.local_checkpoint
+        tracker.add_lease(f"peer_recovery/{alloc}", replica_ckpt + 1,
+                          "peer recovery")
+        ops = (primary_engine.translog.read_ops(from_seq_no=replica_ckpt + 1)
+               if primary_engine.translog is not None else None)
+        # ops-based recovery requires the translog to still hold EVERY op
+        # in (replica_ckpt, primary max_seq_no] — else fall back to files
+        expected = set(range(replica_ckpt + 1, primary_engine.max_seq_no + 1))
+        have_all_ops = ops is not None and \
+            expected <= {o.seq_no for o in ops}
+        phase = "ops" if have_all_ops else "file"
+        if not have_all_ops:
+            # phase1: copy the primary's sealed segments (flush first so the
+            # RAM buffer is included in the copy)
+            primary_engine.refresh()
+            segs = list(primary_engine.segments)
+            copied_ckpt = primary_engine.local_checkpoint
+            replica.engine.install_segments(
+                segs, max_seq_no=primary_engine.max_seq_no,
+                local_checkpoint=copied_ckpt)
+            replica._sync_reader()
+            ops = (primary_engine.translog.read_ops(
+                from_seq_no=copied_ckpt + 1)
+                if primary_engine.translog is not None else [])
+        # phase2: replay missing ops through the normal replica path
+        term = primary_engine.primary_term
+        for op in ops or []:
+            if op.op_type == "index":
+                replica.index_on_replica(op.doc_id, op.source, op.seq_no,
+                                         term, op.version)
+            elif op.op_type == "delete":
+                replica.delete_on_replica(op.doc_id, op.seq_no, term,
+                                          op.version)
+        # finalize: mark in-sync, release the lease
+        tracker.mark_in_sync(alloc, replica.engine.local_checkpoint)
+        tracker.remove_lease(f"peer_recovery/{alloc}")
+        self._advance_checkpoints()
+        replica.refresh()
+        return {"type": phase, "ops_replayed": len(ops or []),
+                "global_checkpoint": self.global_checkpoint}
+
+    # ---------------------------------------------------- segment replication
+
+    def publish_checkpoint(self):
+        """Primary refresh → push the new segment set to every replica
+        (SegmentReplicationTargetService.onNewCheckpoint:192)."""
+        if self.mode != "segment":
+            return
+        self._ckpt_version += 1
+        engine = self.primary.engine
+        ckpt = ReplicationCheckpoint(
+            primary_term=engine.primary_term,
+            segment_infos_version=self._ckpt_version,
+            max_seq_no=engine.max_seq_no,
+            local_checkpoint=engine.local_checkpoint)
+        segs = list(engine.segments)
+        for alloc, replica in list(self.replicas.items()):
+            try:
+                replica.engine.install_segments(
+                    segs, max_seq_no=ckpt.max_seq_no,
+                    local_checkpoint=ckpt.local_checkpoint)
+                replica._sync_reader()
+                self._tracker().update_local_checkpoint(
+                    alloc, replica.engine.local_checkpoint)
+            except Exception as e:
+                self.fail_replica(replica, f"segment replication failed: {e}")
+        self._advance_checkpoints()
+
+    # ------------------------------------------------------ primary failover
+
+    def promote_replica(self) -> IndexShard:
+        """Primary failed: promote an in-sync replica (reference: replica
+        promoted via in-sync allocation ids; new primary term; ops above the
+        global checkpoint are rolled back/refilled on other copies)."""
+        candidates = self.in_sync_replicas()
+        if not candidates:
+            raise ReplicationFailedError(
+                "no in-sync copy available for promotion")
+        new_primary = candidates[0]
+        alloc = self._alloc(new_primary)
+        old = self.primary
+        self.primary = new_primary
+        new_primary.primary = True
+        new_primary.engine.primary_term += 1
+        del self.replicas[alloc]
+        self.failed[self._alloc(old)] = "primary failed"
+        # rebuild tracker state on the new primary
+        tracker = self._tracker()
+        tracker.global_checkpoint = max(tracker.global_checkpoint,
+                                        new_primary.engine.local_checkpoint)
+        for a, replica in self.replicas.items():
+            tracker.init_tracking(a)
+            self.recover_replica(replica)
+        return new_primary

@@ -193,6 +193,17 @@ class Segment:
         self.live[ord_] = False
         return True
 
+    def clone_for_copy(self) -> "Segment":
+        """Shallow copy for recovery/segment-replication installs: immutable
+        columns shared, mutable per-copy state (live bitmap, doc_meta)
+        cloned — the in-memory analog of copying segment files while each
+        copy keeps its own .liv deletes file."""
+        import copy as _copy
+        clone = _copy.copy(self)
+        clone.live = self.live.copy()
+        clone.doc_meta = dict(self.doc_meta)
+        return clone
+
     def get_term(self, field: str, term: str) -> Optional[TermMeta]:
         return self.term_dict.get((field, term))
 

@@ -24,7 +24,7 @@ class IndexShard:
                  index_name: str = "_index",
                  data_path: Optional[str] = None,
                  durability: str = "request", primary_term: int = 1,
-                 primary: bool = True):
+                 primary: bool = True, allocation_id: Optional[str] = None):
         self.shard_id = shard_id
         self.index_name = index_name
         self.primary = primary
@@ -36,7 +36,8 @@ class IndexShard:
         self.engine = InternalEngine(
             mapper, data_path=shard_path, durability=durability,
             primary_term=primary_term,
-            allocation_id=f"{index_name}_{shard_id}_alloc")
+            allocation_id=allocation_id
+            or f"{index_name}_{shard_id}_alloc")
         self.reader = ShardReader(mapper, index_name=index_name)
         self.executor = SearchExecutor(self.reader)
         self._sync_reader()
@@ -100,7 +101,7 @@ class IndexShard:
             if seg.seg_id not in reader_ids:
                 self.reader.add_segment(seg)
             else:
-                self.reader.notify_deletes(seg)
+                self.reader.update_segment(seg)
 
     def close(self):
         self.engine.close()

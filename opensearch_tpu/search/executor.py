@@ -86,6 +86,24 @@ class ShardReader:
                 arrays, meta = self.device[i]
                 self.device[i] = (refresh_live(arrays, seg), meta)
 
+    def update_segment(self, seg: Segment):
+        """Adopt a possibly-replaced segment object with the same id
+        (recovery/segment-replication installs clone_for_copy objects):
+        shared immutable columns keep their device image, only the live
+        mask re-uploads; a genuinely different segment re-uploads fully."""
+        for i, s in enumerate(self.segments):
+            if s.seg_id != seg.seg_id:
+                continue
+            if s is seg or s.post_docs is seg.post_docs:
+                self.segments[i] = seg
+                arrays, meta = self.device[i]
+                self.device[i] = (refresh_live(arrays, seg), meta)
+            else:
+                self.segments[i] = seg
+                self.device[i] = upload_segment(seg)
+            return
+        self.add_segment(seg)
+
     @property
     def num_docs(self) -> int:
         return sum(s.live_doc_count for s in self.segments)

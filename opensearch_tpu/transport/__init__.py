@@ -1,0 +1,3 @@
+from opensearch_tpu.transport.tcp import TcpTransport, ThreadedScheduler
+
+__all__ = ["TcpTransport", "ThreadedScheduler"]

@@ -1,0 +1,82 @@
+"""Wire serialization for transport payloads.
+
+Re-design of the reference's StreamInput/StreamOutput + NamedWriteableRegistry
+(common/io/stream/): polymorphic payloads are JSON with a `__type__` tag per
+registered dataclass — the registry plays NamedWriteableRegistry's role of
+mapping type names to readers. JSON keeps the wire debuggable; the frame
+around it (tcp.py) is binary."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict
+
+from opensearch_tpu.cluster.coordination.core import (
+    ClusterState, VotingConfiguration)
+
+_WRITERS: Dict[type, Callable[[Any], dict]] = {}
+_READERS: Dict[str, Callable[[dict], Any]] = {}
+
+
+def register(type_name: str, cls: type, writer: Callable[[Any], dict],
+             reader: Callable[[dict], Any]):
+    _WRITERS[cls] = lambda v: {"__type__": type_name, **writer(v)}
+    _READERS[type_name] = reader
+
+
+register(
+    "voting_config", VotingConfiguration,
+    lambda v: {"node_ids": sorted(v.node_ids)},
+    lambda d: VotingConfiguration(frozenset(d["node_ids"])))
+
+register(
+    "cluster_state", ClusterState,
+    lambda s: {
+        "term": s.term, "version": s.version, "nodes": sorted(s.nodes),
+        "master_node": s.master_node,
+        "last_committed_config": to_wire(s.last_committed_config),
+        "last_accepted_config": to_wire(s.last_accepted_config),
+        "data": s.data,
+    },
+    lambda d: ClusterState(
+        term=d["term"], version=d["version"],
+        nodes=frozenset(d["nodes"]), master_node=d["master_node"],
+        last_committed_config=from_wire(d["last_committed_config"]),
+        last_accepted_config=from_wire(d["last_accepted_config"]),
+        data=d["data"]))
+
+
+def to_wire(value: Any) -> Any:
+    writer = _WRITERS.get(type(value))
+    if writer is not None:
+        return writer(value)
+    if isinstance(value, dict):
+        return {k: to_wire(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [to_wire(v) for v in value]
+    if isinstance(value, frozenset):
+        return sorted(value)
+    return value
+
+
+def from_wire(value: Any) -> Any:
+    if isinstance(value, dict):
+        type_name = value.get("__type__")
+        if type_name is not None:
+            reader = _READERS.get(type_name)
+            if reader is None:
+                raise ValueError(f"unknown wire type [{type_name}]")
+            return reader({k: v for k, v in value.items()
+                           if k != "__type__"})
+        return {k: from_wire(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [from_wire(v) for v in value]
+    return value
+
+
+def encode(payload: Any) -> bytes:
+    return json.dumps(to_wire(payload), separators=(",", ":")).encode("utf-8")
+
+
+def decode(raw: bytes) -> Any:
+    return from_wire(json.loads(raw.decode("utf-8")))

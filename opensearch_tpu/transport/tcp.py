@@ -1,0 +1,304 @@
+"""Framed TCP transport: the node-to-node RPC layer.
+
+Re-design of the reference's custom TCP protocol (transport/TcpTransport.java
+:117, TcpHeader.java:47, InboundPipeline.java:122, OutboundHandler.java,
+TransportHandshaker.java:57):
+
+frame = magic "OT" | u8 version | u8 flags | u64 request_id
+      | u16 action_len | action | u32 payload_len | payload(JSON, serde.py)
+
+flags: bit0 = response, bit1 = error, bit2 = zlib-compressed payload.
+
+Each transport hosts ONE local node. All handler invocations and response
+callbacks run on a single event-loop thread per transport — the analog of
+the reference's transport-thread discipline (transport/Transports.java
+asserts), which keeps the Coordinator single-threaded without locks.
+Version negotiation happens in a handshake request on connect
+(action "internal:tcp/handshake").
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+import zlib
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from opensearch_tpu.common.errors import NodeNotConnectedError
+from opensearch_tpu.transport import serde
+from opensearch_tpu.version import __version__
+
+MAGIC = b"OT"
+WIRE_VERSION = 1
+FLAG_RESPONSE = 1
+FLAG_ERROR = 2
+FLAG_COMPRESSED = 4
+COMPRESS_THRESHOLD = 1024
+HEADER = struct.Struct(">2sBBQH")   # magic, version, flags, request_id, action_len
+HANDSHAKE_ACTION = "internal:tcp/handshake"
+
+
+def _write_frame(sock: socket.socket, flags: int, request_id: int,
+                 action: str, payload: Any):
+    body = serde.encode(payload)
+    if len(body) >= COMPRESS_THRESHOLD:
+        body = zlib.compress(body)
+        flags |= FLAG_COMPRESSED
+    action_b = action.encode("utf-8")
+    frame = HEADER.pack(MAGIC, WIRE_VERSION, flags, request_id,
+                        len(action_b)) + action_b + \
+        struct.pack(">I", len(body)) + body
+    sock.sendall(frame)
+
+
+def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _read_frame(sock: socket.socket):
+    head = _read_exact(sock, HEADER.size)
+    if head is None:
+        return None
+    magic, version, flags, request_id, action_len = HEADER.unpack(head)
+    if magic != MAGIC:
+        raise ValueError("invalid frame magic (not an opensearch-tpu node?)")
+    if version != WIRE_VERSION:
+        raise ValueError(f"incompatible wire version [{version}]")
+    action = _read_exact(sock, action_len).decode("utf-8")
+    (payload_len,) = struct.unpack(">I", _read_exact(sock, 4))
+    body = _read_exact(sock, payload_len)
+    if body is None:
+        return None
+    if flags & FLAG_COMPRESSED:
+        body = zlib.decompress(body)
+    return flags, request_id, action, serde.decode(body)
+
+
+class ThreadedScheduler:
+    """Real-clock scheduler satisfying the Coordinator's scheduler protocol
+    (schedule_delayed/schedule_now/current_time_ms); tasks are posted to the
+    transport's event loop so everything stays single-threaded."""
+
+    def __init__(self, post: Callable[[Callable], None]):
+        import random as _random
+        import time as _time
+        self._post = post
+        self._time = _time
+        self.random = _random.Random()
+        self._timers = []
+        self._closed = False
+
+    @property
+    def current_time_ms(self) -> int:
+        return int(self._time.monotonic() * 1000)
+
+    def schedule_now(self, fn, description=""):
+        self._post(fn)
+
+    def schedule_delayed(self, delay_ms: int, fn, description=""):
+        if self._closed:
+            return
+        t = threading.Timer(delay_ms / 1000.0, lambda: self._post(fn))
+        t.daemon = True
+        t.start()
+        self._timers.append(t)
+
+    def close(self):
+        self._closed = True
+        for t in self._timers:
+            t.cancel()
+
+
+class TcpTransport:
+    """One node's transport: server socket + outbound connections + event
+    loop. Satisfies the same send/register_handler interface as the
+    simulation transport, so the Coordinator runs on either."""
+
+    def __init__(self, node_id: str, host: str = "127.0.0.1", port: int = 0):
+        self.node_id = node_id
+        self.handlers: Dict[str, Callable] = {}
+        self._addresses: Dict[str, Tuple[str, int]] = {}
+        self._connections: Dict[str, socket.socket] = {}
+        self._pending: Dict[int, Tuple[Callable, Callable]] = {}
+        self._request_counter = 0
+        self._lock = threading.Lock()
+        self._closed = False
+
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, port))
+        self._server.listen(32)
+        self.address = self._server.getsockname()
+
+        self._loop_queue: "queue.Queue[Optional[Callable]]" = queue.Queue()
+        self._loop_thread = threading.Thread(
+            target=self._event_loop, name=f"transport-{node_id}", daemon=True)
+        self._loop_thread.start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"accept-{node_id}", daemon=True)
+        self._accept_thread.start()
+
+        self.scheduler = ThreadedScheduler(self.post)
+        self.register_handler(node_id, HANDSHAKE_ACTION, self._on_handshake)
+
+    # -------------------------------------------------------------- registry
+
+    def register_handler(self, node_id: str, action: str, handler: Callable):
+        assert node_id == self.node_id, "TcpTransport hosts one node"
+        self.handlers[action] = handler
+
+    def register_node(self, node_id: str):  # interface parity with the mock
+        pass
+
+    def add_address(self, node_id: str, host: str, port: int):
+        self._addresses[node_id] = (host, port)
+
+    # ------------------------------------------------------------ event loop
+
+    def post(self, fn: Callable):
+        if not self._closed:
+            self._loop_queue.put(fn)
+
+    def _event_loop(self):
+        while True:
+            fn = self._loop_queue.get()
+            if fn is None:
+                return
+            try:
+                fn()
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+    # -------------------------------------------------------------- inbound
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._read_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _read_loop(self, conn: socket.socket):
+        try:
+            while not self._closed:
+                frame = _read_frame(conn)
+                if frame is None:
+                    return
+                flags, request_id, action, payload = frame
+                if flags & FLAG_RESPONSE:
+                    self.post(lambda f=flags, r=request_id, p=payload:
+                              self._handle_response(f, r, p))
+                else:
+                    self.post(lambda c=conn, r=request_id, a=action,
+                              p=payload: self._handle_request(c, r, a, p))
+        except (OSError, ValueError):
+            return
+
+    def _handle_request(self, conn, request_id, action, payload):
+        handler = self.handlers.get(action)
+        try:
+            if handler is None:
+                raise NodeNotConnectedError(
+                    f"no handler for [{action}] on [{self.node_id}]")
+            sender = (payload or {}).get("__sender__", "?") \
+                if isinstance(payload, dict) else "?"
+            body = payload.get("__body__") if isinstance(payload, dict) \
+                and "__body__" in payload else payload
+            response = handler(sender, body)
+            _write_frame(conn, FLAG_RESPONSE, request_id, action,
+                         response)
+        except Exception as e:
+            try:
+                _write_frame(conn, FLAG_RESPONSE | FLAG_ERROR, request_id,
+                             action, {"error": type(e).__name__,
+                                      "reason": str(e)})
+            except OSError:
+                pass
+
+    def _handle_response(self, flags, request_id, payload):
+        with self._lock:
+            callbacks = self._pending.pop(request_id, None)
+        if callbacks is None:
+            return
+        on_response, on_failure = callbacks
+        if flags & FLAG_ERROR:
+            if on_failure is not None:
+                on_failure(NodeNotConnectedError(
+                    f"remote error: {payload.get('reason', payload)}"))
+        elif on_response is not None:
+            on_response(payload)
+
+    # ------------------------------------------------------------- outbound
+
+    def _connection_to(self, target: str) -> socket.socket:
+        sock = self._connections.get(target)
+        if sock is not None:
+            return sock
+        addr = self._addresses.get(target)
+        if addr is None:
+            raise NodeNotConnectedError(f"unknown node [{target}]")
+        sock = socket.create_connection(addr, timeout=5)
+        sock.settimeout(None)
+        self._connections[target] = sock
+        threading.Thread(target=self._read_loop, args=(sock,),
+                         daemon=True).start()
+        return sock
+
+    def send(self, sender: str, target: str, action: str, payload: Any,
+             on_response: Optional[Callable] = None,
+             on_failure: Optional[Callable] = None):
+        def do_send():
+            try:
+                sock = self._connection_to(target)
+                with self._lock:
+                    self._request_counter += 1
+                    request_id = self._request_counter
+                    if on_response or on_failure:
+                        self._pending[request_id] = (on_response, on_failure)
+                wrapped = {"__sender__": sender, "__body__": payload}
+                _write_frame(sock, 0, request_id, action, wrapped)
+            except Exception as e:
+                self._connections.pop(target, None)
+                if on_failure is not None:
+                    on_failure(e)
+
+        self.post(do_send)
+
+    # ------------------------------------------------------------ handshake
+
+    def _on_handshake(self, sender: str, payload: dict):
+        return {"node_id": self.node_id, "version": __version__,
+                "wire_version": WIRE_VERSION}
+
+    def handshake(self, target: str, on_response: Callable,
+                  on_failure: Optional[Callable] = None):
+        self.send(self.node_id, target, HANDSHAKE_ACTION,
+                  {"version": __version__}, on_response,
+                  on_failure or (lambda e: None))
+
+    # --------------------------------------------------------------- close
+
+    def close(self):
+        self._closed = True
+        self.scheduler.close()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        for sock in self._connections.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._loop_queue.put(None)
