@@ -1,0 +1,111 @@
+"""Gateway: durable node metadata + startup recovery.
+
+Re-design of gateway/GatewayMetaState.java:96 + PersistedClusterStateService
+(the reference persists cluster/index metadata in a local Lucene index; here
+it's an atomically-replaced JSON document — the payload is small and the
+segment data itself is already durable in each shard's Store). On startup
+the node reloads index metadata and each shard engine replays its commit
+point + translog (engine._recover_from_store). Index directories on disk
+that no metadata references are reported as dangling
+(gateway/DanglingIndicesState.java) and can be imported.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+
+class Gateway:
+    STATE_DIR = "_state"
+
+    def __init__(self, data_path: str):
+        self.data_path = data_path
+        os.makedirs(os.path.join(data_path, self.STATE_DIR), exist_ok=True)
+
+    def _meta_path(self) -> str:
+        return os.path.join(self.data_path, self.STATE_DIR, "metadata.json")
+
+    # --------------------------------------------------------------- persist
+
+    def persist(self, indices_svc, cluster_settings: Optional[dict] = None):
+        meta = {
+            "indices": {
+                name: {
+                    "settings": {"number_of_shards": svc.num_shards,
+                                 "number_of_replicas": svc.num_replicas,
+                                 **svc.settings},
+                    "mappings": svc.mapping_dict(),
+                }
+                for name, svc in indices_svc.indices.items()
+            },
+            "aliases": {
+                alias: {idx: m.to_dict() for idx, m in members.items()}
+                for alias, members in indices_svc.aliases.items()
+            },
+            "templates": {name: t.to_dict()
+                          for name, t in indices_svc.legacy_templates.items()},
+            "index_templates": {name: t.to_dict()
+                                for name, t in indices_svc.templates.items()},
+            "component_templates": dict(indices_svc.component_templates),
+            "cluster_settings": cluster_settings or {},
+        }
+        tmp = self._meta_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, self._meta_path())
+
+    # ------------------------------------------------------------------ load
+
+    def load(self, indices_svc) -> Optional[dict]:
+        """Recreate indices from persisted metadata; shard engines recover
+        their data from each shard Store + translog replay."""
+        if not os.path.exists(self._meta_path()):
+            return None
+        with open(self._meta_path()) as f:
+            meta = json.load(f)
+        for name, entry in meta.get("indices", {}).items():
+            indices_svc.create_index(name, {
+                "settings": entry["settings"],
+                "mappings": entry["mappings"],
+            }, apply_templates=False)
+            # make recovered docs searchable (reference: shards move to
+            # STARTED and refresh after store recovery)
+            indices_svc.get(name).refresh()
+        for alias, members in meta.get("aliases", {}).items():
+            for idx, body in members.items():
+                if indices_svc.has_index(idx):
+                    indices_svc.put_alias(idx, alias, body)
+        for name, body in meta.get("templates", {}).items():
+            indices_svc.put_template(name, body, legacy=True)
+        for name, body in meta.get("component_templates", {}).items():
+            indices_svc.put_component_template(name, body)
+        for name, body in meta.get("index_templates", {}).items():
+            indices_svc.put_template(name, body, legacy=False)
+        return meta
+
+    # -------------------------------------------------------------- dangling
+
+    def dangling_indices(self, indices_svc) -> List[str]:
+        """Index directories on disk that current metadata doesn't know."""
+        out = []
+        for name in os.listdir(self.data_path):
+            path = os.path.join(self.data_path, name)
+            if name == self.STATE_DIR or not os.path.isdir(path):
+                continue
+            if not indices_svc.has_index(name):
+                out.append(name)
+        return sorted(out)
+
+    def import_dangling(self, indices_svc, index_name: str):
+        """Best-effort import: recreate with dynamic mappings; segment data
+        recovers from the shard stores."""
+        shard_dirs = [d for d in os.listdir(
+            os.path.join(self.data_path, index_name)) if d.isdigit()]
+        svc = indices_svc.create_index(index_name, {
+            "settings": {"number_of_shards": max(1, len(shard_dirs))}},
+            apply_templates=False)
+        svc.refresh()
+        self.persist(indices_svc)
+        return svc

@@ -40,9 +40,24 @@ class Node:
                                                  "transient": {}}
         self.scroll_contexts: Dict[str, Any] = {}
         self.pit_contexts: Dict[str, Any] = {}
+        from opensearch_tpu.repositories import RepositoriesService
+        self.repositories = RepositoriesService()
+        self.gateway = None
+        if data_path is not None:
+            from opensearch_tpu.gateway import Gateway
+            self.gateway = Gateway(data_path)
+            loaded = self.gateway.load(self.indices)
+            if loaded and loaded.get("cluster_settings"):
+                self.cluster_settings.update(loaded["cluster_settings"])
         self.controller = RestController()
         from opensearch_tpu.rest.actions import register_all
         register_all(self)
+
+    def persist_metadata(self):
+        """Write node metadata through the gateway (no-op without a data
+        path — pure in-memory node)."""
+        if self.gateway is not None:
+            self.gateway.persist(self.indices, self.cluster_settings)
 
     # ------------------------------------------------------------- dispatch
 

@@ -1,0 +1,4 @@
+from opensearch_tpu.repositories.blobstore import (
+    FsRepository, RepositoriesService)
+
+__all__ = ["FsRepository", "RepositoriesService"]

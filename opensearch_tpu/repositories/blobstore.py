@@ -1,0 +1,302 @@
+"""Snapshot repositories: incremental segment-file backup + restore.
+
+Re-design of snapshots/SnapshotsService.java:144 +
+repositories/blobstore/BlobStoreRepository.java (incremental file-level
+dedup against RepositoryData, shard generations) with the filesystem
+repository (`fs` type, repository-url's local cousin). Layout:
+
+  repo_root/
+    index.json                      ← RepositoryData: snapshot list
+    snapshots/<name>.json           ← per-snapshot manifest (indices, shard
+                                      segment ids, live masks, mappings)
+    indices/<index>/<shard>/seg_*   ← segment blobs, shared across
+                                      snapshots, deduplicated by
+                                      name+checksum (segments are immutable)
+    indices/<index>/<shard>/liv_<snap>_<seg>.npy ← per-snapshot deletes
+
+Segments being immutable makes incrementality trivial and exact: a segment
+blob is written once, ever; only liveness masks are per-snapshot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from opensearch_tpu.common.errors import (
+    IllegalArgumentError, OpenSearchTpuError, ResourceAlreadyExistsError)
+from opensearch_tpu.index.store import Store
+
+
+class SnapshotMissingError(OpenSearchTpuError):
+    status = 404
+    error_type = "snapshot_missing_exception"
+
+
+class SnapshotInProgressError(OpenSearchTpuError):
+    status = 400
+    error_type = "concurrent_snapshot_execution_exception"
+
+
+_NAME_RE = re.compile(r"[a-z0-9][a-z0-9_.-]*")
+
+
+def _validate_snapshot_name(name: str):
+    if not name or not _NAME_RE.fullmatch(name):
+        raise IllegalArgumentError(
+            f"Invalid snapshot name [{name}]: must be lowercase alphanumeric")
+
+
+class FsRepository:
+    def __init__(self, name: str, location: str):
+        self.name = name
+        self.location = location
+        os.makedirs(os.path.join(location, "snapshots"), exist_ok=True)
+        os.makedirs(os.path.join(location, "indices"), exist_ok=True)
+
+    # ------------------------------------------------------- repository data
+
+    def _index_path(self) -> str:
+        return os.path.join(self.location, "index.json")
+
+    def repository_data(self) -> dict:
+        if not os.path.exists(self._index_path()):
+            return {"snapshots": [], "gen": 0}
+        with open(self._index_path()) as f:
+            return json.load(f)
+
+    def _write_repository_data(self, data: dict):
+        data["gen"] = data.get("gen", 0) + 1
+        tmp = self._index_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self._index_path())
+
+    def _manifest_path(self, snapshot: str) -> str:
+        return os.path.join(self.location, "snapshots", f"{snapshot}.json")
+
+    def snapshot_names(self) -> List[str]:
+        return [s["snapshot"] for s in self.repository_data()["snapshots"]]
+
+    def get_manifest(self, snapshot: str) -> dict:
+        path = self._manifest_path(snapshot)
+        if not os.path.exists(path):
+            raise SnapshotMissingError(
+                f"[{self.name}:{snapshot}] is missing")
+        with open(path) as f:
+            return json.load(f)
+
+    # -------------------------------------------------------------- snapshot
+
+    def create_snapshot(self, snapshot: str, indices_svc,
+                        index_names: List[str]) -> dict:
+        _validate_snapshot_name(snapshot)
+        if snapshot in self.snapshot_names():
+            raise ResourceAlreadyExistsError(
+                f"snapshot with the same name [{snapshot}] already exists")
+        start_ms = int(time.time() * 1000)
+        manifest = {"snapshot": snapshot, "state": "IN_PROGRESS",
+                    "start_time_in_millis": start_ms, "indices": {}}
+        total_shards = 0
+        for index_name in index_names:
+            svc = indices_svc.get(index_name)
+            index_entry = {
+                "mappings": svc.mapping_dict(),
+                "settings": {"number_of_shards": svc.num_shards,
+                             "number_of_replicas": svc.num_replicas,
+                             **{k: v for k, v in svc.settings.items()}},
+                "shards": [],
+            }
+            for shard in svc.shards:
+                total_shards += 1
+                index_entry["shards"].append(
+                    self._snapshot_shard(snapshot, index_name, shard))
+            manifest["indices"][index_name] = index_entry
+        manifest["state"] = "SUCCESS"
+        manifest["end_time_in_millis"] = int(time.time() * 1000)
+        manifest["shards"] = {"total": total_shards,
+                              "successful": total_shards, "failed": 0}
+        with open(self._manifest_path(snapshot), "w") as f:
+            json.dump(manifest, f)
+        data = self.repository_data()
+        data["snapshots"].append({"snapshot": snapshot,
+                                  "state": "SUCCESS",
+                                  "start_time_in_millis": start_ms,
+                                  "indices": index_names})
+        self._write_repository_data(data)
+        return manifest
+
+    def _shard_dir(self, index_name: str, shard_id: int) -> str:
+        return os.path.join(self.location, "indices", index_name,
+                            str(shard_id))
+
+    def _snapshot_shard(self, snapshot: str, index_name: str, shard) -> dict:
+        """Upload one shard: write missing segment blobs (dedup — a blob is
+        keyed by its immutable seg_id), plus this snapshot's live masks."""
+        shard.engine.refresh()
+        shard_dir = self._shard_dir(index_name, shard.shard_id)
+        blob_store = Store(shard_dir)
+        seg_ids = []
+        new_files = 0
+        for seg in shard.engine.segments:
+            seg_ids.append(seg.seg_id)
+            npz_path, _, _ = blob_store._seg_paths(seg.seg_id)
+            if not os.path.exists(npz_path):
+                blob_store.write_segment(seg)
+                new_files += 1
+            liv = os.path.join(shard_dir,
+                               f"liv_{snapshot}_{seg.seg_id}.npy")
+            np.save(liv, seg.live)
+        engine = shard.engine
+        return {"shard_id": shard.shard_id, "segments": seg_ids,
+                "max_seq_no": engine.max_seq_no,
+                "local_checkpoint": engine.local_checkpoint,
+                "new_segments": new_files}
+
+    # --------------------------------------------------------------- restore
+
+    def restore_snapshot(self, snapshot: str, indices_svc,
+                         index_names: Optional[List[str]] = None,
+                         rename_pattern: Optional[str] = None,
+                         rename_replacement: Optional[str] = None) -> dict:
+        manifest = self.get_manifest(snapshot)
+        targets = index_names or list(manifest["indices"])
+        restored = []
+        for index_name in targets:
+            if index_name not in manifest["indices"]:
+                raise SnapshotMissingError(
+                    f"[{self.name}:{snapshot}] index [{index_name}] missing")
+            entry = manifest["indices"][index_name]
+            new_name = index_name
+            if rename_pattern and rename_replacement is not None:
+                new_name = re.sub(rename_pattern, rename_replacement,
+                                  index_name)
+            if indices_svc.has_index(new_name):
+                raise ResourceAlreadyExistsError(
+                    f"cannot restore index [{new_name}] because an open "
+                    f"index with same name already exists in the cluster")
+            settings = dict(entry["settings"])
+            svc = indices_svc.create_index(new_name, {
+                "settings": settings, "mappings": entry["mappings"]},
+                apply_templates=False)
+            for shard_entry in entry["shards"]:
+                shard = svc.shards[shard_entry["shard_id"]]
+                shard_dir = self._shard_dir(index_name,
+                                            shard_entry["shard_id"])
+                blob_store = Store(shard_dir)
+                segments = []
+                for seg_id in shard_entry["segments"]:
+                    seg = blob_store.read_segment(seg_id)
+                    liv = os.path.join(shard_dir,
+                                       f"liv_{snapshot}_{seg_id}.npy")
+                    if os.path.exists(liv):
+                        seg.live = np.load(liv)
+                    segments.append(seg)
+                shard.engine.install_segments(
+                    segments, max_seq_no=shard_entry["max_seq_no"],
+                    local_checkpoint=shard_entry["local_checkpoint"])
+                shard._sync_reader()
+            restored.append(new_name)
+        return {"snapshot": {"snapshot": snapshot, "indices": restored,
+                             "shards": manifest.get("shards", {})}}
+
+    # ---------------------------------------------------------------- delete
+
+    def delete_snapshot(self, snapshot: str):
+        data = self.repository_data()
+        before = len(data["snapshots"])
+        data["snapshots"] = [s for s in data["snapshots"]
+                             if s["snapshot"] != snapshot]
+        if len(data["snapshots"]) == before:
+            raise SnapshotMissingError(f"[{self.name}:{snapshot}] is missing")
+        manifest = self.get_manifest(snapshot)
+        os.remove(self._manifest_path(snapshot))
+        self._write_repository_data(data)
+        # GC: remove blobs referenced only by the deleted snapshot
+        referenced: Dict[str, set] = {}
+        for name in self.snapshot_names():
+            m = self.get_manifest(name)
+            for idx, entry in m["indices"].items():
+                for shard_entry in entry["shards"]:
+                    key = (idx, shard_entry["shard_id"])
+                    referenced.setdefault(key, set()).update(
+                        shard_entry["segments"])
+        for idx, entry in manifest["indices"].items():
+            for shard_entry in entry["shards"]:
+                key = (idx, shard_entry["shard_id"])
+                keep = referenced.get(key, set())
+                shard_dir = self._shard_dir(idx, shard_entry["shard_id"])
+                if not os.path.isdir(shard_dir):
+                    continue
+                for seg_id in shard_entry["segments"]:
+                    if seg_id in keep:
+                        continue
+                    for suffix in (".npz", ".meta.json", ".liv.npy"):
+                        p = os.path.join(shard_dir, f"seg_{seg_id}{suffix}")
+                        if os.path.exists(p):
+                            os.remove(p)
+                for f in os.listdir(shard_dir):
+                    if f.startswith(f"liv_{snapshot}_"):
+                        os.remove(os.path.join(shard_dir, f))
+
+    # ----------------------------------------------------------------- info
+
+    def snapshot_info(self, snapshot: str) -> dict:
+        manifest = self.get_manifest(snapshot)
+        return {"snapshot": snapshot,
+                "uuid": snapshot,
+                "state": manifest["state"],
+                "indices": list(manifest["indices"]),
+                "shards": manifest.get("shards", {}),
+                "start_time_in_millis":
+                    manifest.get("start_time_in_millis", 0),
+                "end_time_in_millis": manifest.get("end_time_in_millis", 0)}
+
+    def status(self, snapshot: str) -> dict:
+        manifest = self.get_manifest(snapshot)
+        shards_stats = []
+        for idx, entry in manifest["indices"].items():
+            for shard_entry in entry["shards"]:
+                shards_stats.append({
+                    "index": idx, "shard_id": shard_entry["shard_id"],
+                    "stage": "DONE",
+                    "segments": len(shard_entry["segments"]),
+                    "new_segments": shard_entry.get("new_segments", 0)})
+        return {"snapshot": snapshot, "repository": self.name,
+                "state": manifest["state"], "shards": shards_stats}
+
+
+class RepositoriesService:
+    """Registry of named repositories (repositories/RepositoriesService.java)."""
+
+    def __init__(self):
+        self.repositories: Dict[str, FsRepository] = {}
+
+    def put_repository(self, name: str, body: dict) -> FsRepository:
+        repo_type = (body or {}).get("type")
+        if repo_type != "fs":
+            raise IllegalArgumentError(
+                f"repository type [{repo_type}] does not exist "
+                f"(supported: [fs])")
+        location = (body.get("settings") or {}).get("location")
+        if not location:
+            raise IllegalArgumentError(
+                "[fs] missing location setting")
+        repo = FsRepository(name, location)
+        self.repositories[name] = repo
+        return repo
+
+    def get(self, name: str) -> FsRepository:
+        repo = self.repositories.get(name)
+        if repo is None:
+            raise SnapshotMissingError(f"[{name}] missing")
+        return repo
+
+    def delete_repository(self, name: str) -> bool:
+        return self.repositories.pop(name, None) is not None

@@ -406,11 +406,13 @@ def register_indices_actions(node, c):
     def do_create_index(req):
         name = req.param("index")
         node.indices.create_index(name, req.body)
+        node.persist_metadata()
         return {"acknowledged": True, "shards_acknowledged": True,
                 "index": name}
 
     def do_delete_index(req):
         node.indices.delete_index(req.param("index"))
+        node.persist_metadata()
         return {"acknowledged": True}
 
     def index_info(name):
@@ -452,6 +454,7 @@ def register_indices_actions(node, c):
         for n in node.indices.resolve(req.param("index"),
                                       allow_no_indices=False):
             node.indices.get(n).put_mapping(req.body or {})
+        node.persist_metadata()
         return {"acknowledged": True}
 
     def do_get_settings(req):
@@ -576,6 +579,7 @@ def register_alias_template_actions(node, c):
         if not actions:
             raise IllegalArgumentError("No action specified")
         node.indices.update_aliases(actions)
+        node.persist_metadata()
         return {"acknowledged": True}
 
     def do_put_alias(req):
@@ -583,10 +587,12 @@ def register_alias_template_actions(node, c):
                                       allow_aliases=False,
                                       allow_no_indices=False):
             node.indices.put_alias(n, req.param("name"), req.body)
+        node.persist_metadata()
         return {"acknowledged": True}
 
     def do_delete_alias(req):
         node.indices.remove_alias(req.param("index"), req.param("name"))
+        node.persist_metadata()
         return {"acknowledged": True}
 
     def do_get_alias(req):
@@ -620,6 +626,7 @@ def register_alias_template_actions(node, c):
     def do_put_template(req, legacy):
         node.indices.put_template(req.param("name"), req.body or {},
                                   legacy=legacy)
+        node.persist_metadata()
         return {"acknowledged": True}
 
     def do_get_template(req, legacy):
@@ -983,6 +990,119 @@ def register_script_ingest_actions(node, c):
     c.register("GET", "/_ingest/pipeline/{id}/_simulate", do_simulate)
 
 
+# ----------------------------------------------------------------- snapshots
+
+def register_snapshot_actions(node, c):
+    def do_put_repo(req):
+        node.repositories.put_repository(req.param("repository"),
+                                         req.body or {})
+        return {"acknowledged": True}
+
+    def do_get_repo(req):
+        name = req.param("repository")
+        if name and name != "_all":
+            repo = node.repositories.get(name)
+            return {name: {"type": "fs",
+                           "settings": {"location": repo.location}}}
+        return {n: {"type": "fs", "settings": {"location": r.location}}
+                for n, r in node.repositories.repositories.items()}
+
+    def do_delete_repo(req):
+        from opensearch_tpu.repositories.blobstore import SnapshotMissingError
+        if not node.repositories.delete_repository(req.param("repository")):
+            raise SnapshotMissingError(f"[{req.param('repository')}] missing")
+        return {"acknowledged": True}
+
+    def do_create_snapshot(req):
+        repo = node.repositories.get(req.param("repository"))
+        body = req.body or {}
+        indices_expr = body.get("indices", "_all")
+        if isinstance(indices_expr, list):
+            indices_expr = ",".join(indices_expr)
+        names = node.indices.resolve(indices_expr)
+        manifest = repo.create_snapshot(req.param("snapshot"), node.indices,
+                                        names)
+        if req.bool_param("wait_for_completion", False):
+            return 200, {"snapshot": repo.snapshot_info(
+                req.param("snapshot"))}
+        return 202, {"accepted": True}
+
+    def do_get_snapshot(req):
+        repo = node.repositories.get(req.param("repository"))
+        name = req.param("snapshot")
+        if name in ("_all", "*", None):
+            return {"snapshots": [repo.snapshot_info(s)
+                                  for s in repo.snapshot_names()]}
+        return {"snapshots": [repo.snapshot_info(name)]}
+
+    def do_delete_snapshot(req):
+        repo = node.repositories.get(req.param("repository"))
+        repo.delete_snapshot(req.param("snapshot"))
+        return {"acknowledged": True}
+
+    def do_restore(req):
+        repo = node.repositories.get(req.param("repository"))
+        body = req.body or {}
+        indices_expr = body.get("indices")
+        if isinstance(indices_expr, str):
+            indices_expr = indices_expr.split(",")
+        res = repo.restore_snapshot(
+            req.param("snapshot"), node.indices,
+            index_names=indices_expr,
+            rename_pattern=body.get("rename_pattern"),
+            rename_replacement=body.get("rename_replacement"))
+        node.persist_metadata()
+        return res
+
+    def do_status(req):
+        repo = node.repositories.get(req.param("repository"))
+        return {"snapshots": [repo.status(req.param("snapshot"))]}
+
+    def cat_snapshots(req):
+        repo = node.repositories.get(req.param("repository"))
+        rows = []
+        for name in repo.snapshot_names():
+            info = repo.snapshot_info(name)
+            rows.append([name, info["state"],
+                         info["start_time_in_millis"],
+                         info["end_time_in_millis"],
+                         len(info["indices"])])
+        return _cat_table(req, ["id", "status", "start_epoch", "end_epoch",
+                                "indices"], rows)
+
+    def do_dangling(req):
+        if node.gateway is None:
+            return {"dangling_indices": []}
+        return {"dangling_indices": [
+            {"index_name": n}
+            for n in node.gateway.dangling_indices(node.indices)]}
+
+    def do_import_dangling(req):
+        if node.gateway is None:
+            raise IllegalArgumentError("node has no data path")
+        node.gateway.import_dangling(node.indices, req.param("index"))
+        return {"acknowledged": True}
+
+    c.register("PUT", "/_snapshot/{repository}", do_put_repo)
+    c.register("POST", "/_snapshot/{repository}", do_put_repo)
+    c.register("GET", "/_snapshot", do_get_repo)
+    c.register("GET", "/_snapshot/{repository}", do_get_repo)
+    c.register("DELETE", "/_snapshot/{repository}", do_delete_repo)
+    c.register("PUT", "/_snapshot/{repository}/{snapshot}",
+               do_create_snapshot)
+    c.register("POST", "/_snapshot/{repository}/{snapshot}",
+               do_create_snapshot)
+    c.register("GET", "/_snapshot/{repository}/{snapshot}", do_get_snapshot)
+    c.register("DELETE", "/_snapshot/{repository}/{snapshot}",
+               do_delete_snapshot)
+    c.register("POST", "/_snapshot/{repository}/{snapshot}/_restore",
+               do_restore)
+    c.register("GET", "/_snapshot/{repository}/{snapshot}/_status", do_status)
+    c.register("GET", "/_cat/snapshots/{repository}", cat_snapshots)
+    c.register("GET", "/_dangling", do_dangling)
+    c.register("POST", "/_dangling/{index}", do_import_dangling)
+
+
 def register_all(node):
     c = node.controller
     register_cluster_actions(node, c)
@@ -992,3 +1112,4 @@ def register_all(node):
     register_alias_template_actions(node, c)
     register_cat_actions(node, c)
     register_script_ingest_actions(node, c)
+    register_snapshot_actions(node, c)

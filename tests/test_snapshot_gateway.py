@@ -1,0 +1,207 @@
+"""Snapshot/restore + gateway persistence tests.
+
+Modeled on the reference suites: SharedClusterSnapshotRestoreIT (snapshot
+lifecycle, incremental segments, restore + rename), DedicatedClusterSnapshot
+RestoreIT (repo management), GatewayIndexStateIT / DanglingIndicesIT
+(metadata survives restart, dangling detection)."""
+
+import json
+import os
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+def seed(node, index="snap-src", n=8):
+    node.request("PUT", f"/{index}", {
+        "settings": {"number_of_shards": 2},
+        "mappings": {"properties": {"msg": {"type": "text"},
+                                    "n": {"type": "integer"}}}})
+    for i in range(n):
+        node.request("PUT", f"/{index}/_doc/{i}",
+                     {"msg": f"event number {i}", "n": i})
+    node.request("POST", f"/{index}/_refresh")
+
+
+@pytest.fixture()
+def node():
+    return Node()
+
+
+@pytest.fixture()
+def repo(node, tmp_path):
+    node.request("PUT", "/_snapshot/backup", {
+        "type": "fs", "settings": {"location": str(tmp_path / "repo")}})
+    return "backup"
+
+
+class TestRepositories:
+    def test_repo_crud(self, node, tmp_path):
+        res = node.request("PUT", "/_snapshot/r1", {
+            "type": "fs", "settings": {"location": str(tmp_path / "r1")}})
+        assert res["acknowledged"] is True
+        res = node.request("GET", "/_snapshot/r1")
+        assert res["r1"]["type"] == "fs"
+        assert node.request("DELETE", "/_snapshot/r1")["acknowledged"]
+        assert node.request("GET", "/_snapshot/r1")["_status"] == 404
+
+    def test_unsupported_type_rejected(self, node):
+        res = node.request("PUT", "/_snapshot/bad", {"type": "s3"})
+        assert res["_status"] == 400
+
+
+class TestSnapshotRestore:
+    def test_snapshot_restore_roundtrip(self, node, repo):
+        seed(node)
+        res = node.request("PUT", "/_snapshot/backup/snap1",
+                           wait_for_completion="true")
+        assert res["snapshot"]["state"] == "SUCCESS"
+        assert res["snapshot"]["indices"] == ["snap-src"]
+        # destroy and restore
+        node.request("DELETE", "/snap-src")
+        res = node.request("POST", "/_snapshot/backup/snap1/_restore", {})
+        assert res["snapshot"]["indices"] == ["snap-src"]
+        node.request("POST", "/snap-src/_refresh")
+        res = node.request("POST", "/snap-src/_search",
+                           {"query": {"match": {"msg": "event"}}, "size": 20})
+        assert res["hits"]["total"]["value"] == 8
+        # mapping survived
+        m = node.request("GET", "/snap-src/_mapping")
+        assert m["snap-src"]["mappings"]["properties"]["n"]["type"] == \
+            "integer"
+
+    def test_restore_with_rename(self, node, repo):
+        seed(node)
+        node.request("PUT", "/_snapshot/backup/snap1",
+                     wait_for_completion="true")
+        res = node.request("POST", "/_snapshot/backup/snap1/_restore", {
+            "rename_pattern": "snap-src", "rename_replacement": "restored"})
+        assert res["snapshot"]["indices"] == ["restored"]
+        assert node.request("GET", "/restored/_count")["count"] == 8
+        assert node.request("GET", "/snap-src/_count")["count"] == 8
+
+    def test_restore_existing_index_conflict(self, node, repo):
+        seed(node)
+        node.request("PUT", "/_snapshot/backup/snap1",
+                     wait_for_completion="true")
+        res = node.request("POST", "/_snapshot/backup/snap1/_restore", {})
+        assert res["_status"] == 400
+
+    def test_incremental_snapshots_dedup_segments(self, node, repo):
+        seed(node)
+        node.request("PUT", "/_snapshot/backup/snap1",
+                     wait_for_completion="true")
+        st1 = node.request("GET", "/_snapshot/backup/snap1/_status")
+        new1 = sum(s["new_segments"]
+                   for s in st1["snapshots"][0]["shards"])
+        assert new1 > 0
+        # no changes → second snapshot writes zero new segment blobs
+        node.request("PUT", "/_snapshot/backup/snap2",
+                     wait_for_completion="true")
+        st2 = node.request("GET", "/_snapshot/backup/snap2/_status")
+        new2 = sum(s["new_segments"]
+                   for s in st2["snapshots"][0]["shards"])
+        assert new2 == 0
+        # add docs → only the delta is uploaded
+        node.request("PUT", "/snap-src/_doc/100", {"msg": "late", "n": 100},
+                     refresh="true")
+        node.request("PUT", "/_snapshot/backup/snap3",
+                     wait_for_completion="true")
+        st3 = node.request("GET", "/_snapshot/backup/snap3/_status")
+        new3 = sum(s["new_segments"]
+                   for s in st3["snapshots"][0]["shards"])
+        assert new3 == 1
+
+    def test_delete_snapshot_gc(self, node, repo, tmp_path):
+        seed(node)
+        node.request("PUT", "/_snapshot/backup/snap1",
+                     wait_for_completion="true")
+        node.request("DELETE", "/_snapshot/backup/snap1")
+        res = node.request("GET", "/_snapshot/backup/snap1")
+        assert res["_status"] == 404
+        # all segment blobs GC'd (no other snapshot references them)
+        repo_dir = tmp_path / "repo" / "indices"
+        remaining = [f for root, _, files in os.walk(repo_dir)
+                     for f in files if f.startswith("seg_")]
+        assert remaining == []
+
+    def test_snapshot_subset_of_indices(self, node, repo):
+        seed(node, "idx-a", 3)
+        seed(node, "idx-b", 4)
+        node.request("PUT", "/_snapshot/backup/partial",
+                     {"indices": "idx-a"}, wait_for_completion="true")
+        info = node.request("GET", "/_snapshot/backup/partial")
+        assert info["snapshots"][0]["indices"] == ["idx-a"]
+
+    def test_duplicate_snapshot_name_conflict(self, node, repo):
+        seed(node)
+        node.request("PUT", "/_snapshot/backup/snap1",
+                     wait_for_completion="true")
+        res = node.request("PUT", "/_snapshot/backup/snap1",
+                           wait_for_completion="true")
+        assert res["_status"] == 400
+
+    def test_cat_snapshots(self, node, repo):
+        seed(node)
+        node.request("PUT", "/_snapshot/backup/s1",
+                     wait_for_completion="true")
+        out = node.handle("GET", "/_cat/snapshots/backup").body
+        assert "s1" in out and "SUCCESS" in out
+
+
+class TestGateway:
+    def test_metadata_survives_restart(self, tmp_path):
+        data = str(tmp_path / "data")
+        node1 = Node(data_path=data)
+        node1.request("PUT", "/persisted", {
+            "settings": {"number_of_shards": 2},
+            "mappings": {"properties": {"msg": {"type": "text"}}}})
+        node1.request("PUT", "/persisted/_alias/p-alias")
+        node1.request("PUT", "/_template/t-persist",
+                      {"index_patterns": ["tp-*"], "order": 3})
+        for i in range(5):
+            node1.request("PUT", f"/persisted/_doc/{i}",
+                          {"msg": f"durable doc {i}"})
+        node1.request("POST", "/persisted/_flush")
+
+        # "restart": a brand-new node over the same data path
+        node2 = Node(data_path=data)
+        info = node2.request("GET", "/persisted")
+        assert info["persisted"]["settings"]["index"]["number_of_shards"] \
+            == "2"
+        assert "p-alias" in info["persisted"]["aliases"]
+        assert "t-persist" in node2.request("GET", "/_template/t-persist")
+        res = node2.request("POST", "/p-alias/_search",
+                            {"query": {"match": {"msg": "durable"}}})
+        assert res["hits"]["total"]["value"] == 5
+
+    def test_unflushed_ops_replay_from_translog(self, tmp_path):
+        data = str(tmp_path / "data")
+        node1 = Node(data_path=data)
+        node1.request("PUT", "/wal", {"mappings": {"properties": {
+            "n": {"type": "integer"}}}})
+        node1.request("POST", "/wal/_flush")
+        # indexed but never flushed: only the translog has these
+        for i in range(3):
+            node1.request("PUT", f"/wal/_doc/{i}", {"n": i})
+
+        node2 = Node(data_path=data)
+        node2.request("POST", "/wal/_refresh")
+        assert node2.request("GET", "/wal/_count")["count"] == 3
+        assert node2.request("GET", "/wal/_doc/1")["_source"] == {"n": 1}
+
+    def test_dangling_index_detection_and_import(self, tmp_path):
+        data = str(tmp_path / "data")
+        node1 = Node(data_path=data)
+        node1.request("PUT", "/ghost-idx")
+        node1.request("PUT", "/ghost-idx/_doc/1", {"x": 1})
+        node1.request("POST", "/ghost-idx/_flush")
+        # wipe the metadata file but keep the index data → dangling
+        os.remove(os.path.join(data, "_state", "metadata.json"))
+        node2 = Node(data_path=data)
+        res = node2.request("GET", "/_dangling")
+        assert res["dangling_indices"] == [{"index_name": "ghost-idx"}]
+        node2.request("POST", "/_dangling/ghost-idx")
+        node2.request("POST", "/ghost-idx/_refresh")
+        assert node2.request("GET", "/ghost-idx/_count")["count"] == 1
