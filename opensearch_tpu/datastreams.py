@@ -1,0 +1,222 @@
+"""Data streams, rollover, and the resize family (shrink/split/clone).
+
+Re-design of cluster/metadata/DataStream.java + MetadataRolloverService +
+MetadataCreateIndexService resize paths:
+  - a data stream owns generation-numbered backing indices
+    (`.ds-<name>-NNNNNN`); writes route to the newest generation, searches
+    fan out to all;
+  - rollover (data stream or write alias) evaluates conditions
+    (max_docs / max_age / max_size) and cuts a new write index;
+  - shrink/split/clone rebuild an index with a different shard count by
+    re-routing every doc (the array-engine equivalent of Lucene hard-link
+    resharding — data is columnar, so a rebuild IS the resize).
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import Dict, List, Optional
+
+from opensearch_tpu.common.errors import (
+    IllegalArgumentError, IndexNotFoundError, ResourceAlreadyExistsError)
+
+
+def backing_index_name(stream: str, generation: int) -> str:
+    return f".ds-{stream}-{generation:06d}"
+
+
+class DataStream:
+    def __init__(self, name: str, timestamp_field: str = "@timestamp"):
+        self.name = name
+        self.timestamp_field = timestamp_field
+        self.generation = 0
+        self.backing_indices: List[str] = []
+
+    @property
+    def write_index(self) -> str:
+        return self.backing_indices[-1]
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "timestamp_field": {"name": self.timestamp_field},
+            "generation": self.generation,
+            "indices": [{"index_name": n} for n in self.backing_indices],
+            "status": "GREEN",
+        }
+
+
+class DataStreamService:
+    def __init__(self, node):
+        self.node = node
+        self.streams: Dict[str, DataStream] = {}
+
+    def _matching_template(self, name: str):
+        matches = [t for t in self.node.indices.templates.values()
+                   if t.matches(name) and t.data_stream is not None]
+        if not matches:
+            raise IllegalArgumentError(
+                f"no matching index template found for data stream [{name}]")
+        return max(matches, key=lambda t: t.priority)
+
+    def create(self, name: str) -> DataStream:
+        if name in self.streams:
+            raise ResourceAlreadyExistsError(
+                f"data_stream [{name}] already exists")
+        tmpl = self._matching_template(name)
+        ts_field = (tmpl.data_stream or {}).get(
+            "timestamp_field", {}).get("name", "@timestamp")
+        stream = DataStream(name, ts_field)
+        self._roll(stream)
+        self.streams[name] = stream
+        return stream
+
+    def _roll(self, stream: DataStream):
+        stream.generation += 1
+        backing = backing_index_name(stream.name, stream.generation)
+        self.node.indices.create_index(backing)
+        svc = self.node.indices.get(backing)
+        if svc.mapper.get_field(stream.timestamp_field) is None:
+            svc.put_mapping({"properties": {
+                stream.timestamp_field: {"type": "date"}}})
+        stream.backing_indices.append(backing)
+
+    def get(self, name: str) -> DataStream:
+        stream = self.streams.get(name)
+        if stream is None:
+            raise IndexNotFoundError(name)
+        return stream
+
+    def delete(self, name: str):
+        stream = self.get(name)
+        for backing in stream.backing_indices:
+            if self.node.indices.has_index(backing):
+                self.node.indices.delete_index(backing)
+        del self.streams[name]
+
+    def resolve_write_index(self, name: str) -> Optional[str]:
+        stream = self.streams.get(name)
+        return stream.write_index if stream else None
+
+    def resolve_search(self, name: str) -> Optional[List[str]]:
+        stream = self.streams.get(name)
+        return list(stream.backing_indices) if stream else None
+
+    def rollover(self, name: str, conditions: Optional[dict]) -> dict:
+        stream = self.get(name)
+        old = stream.write_index
+        met = evaluate_conditions(self.node.indices.get(old), conditions)
+        rolled = not conditions or any(met.values())
+        if rolled:
+            self._roll(stream)
+        return {"acknowledged": True, "rolled_over": rolled,
+                "old_index": old,
+                "new_index": stream.write_index if rolled else old,
+                "conditions": met, "dry_run": False, "shards_acknowledged":
+                rolled}
+
+
+def evaluate_conditions(svc, conditions: Optional[dict]) -> Dict[str, bool]:
+    met: Dict[str, bool] = {}
+    if not conditions:
+        return met
+    stats = svc.stats()
+    for key, value in conditions.items():
+        if key == "max_docs":
+            met[f"[max_docs: {value}]"] = \
+                stats["docs"]["count"] >= int(value)
+        elif key == "max_age":
+            from opensearch_tpu.common.settings import parse_time_value
+            age_s = time.time() - svc.creation_date / 1000.0
+            met[f"[max_age: {value}]"] = \
+                age_s >= parse_time_value(value, "max_age")
+        elif key == "max_size":
+            from opensearch_tpu.common.settings import parse_byte_size
+            size = sum(seg.memory_bytes() for shard in svc.shards
+                       for seg in shard.engine.segments)
+            met[f"[max_size: {value}]"] = \
+                size >= parse_byte_size(value, "max_size")
+        else:
+            raise IllegalArgumentError(f"unknown rollover condition [{key}]")
+    return met
+
+
+def rollover_alias(node, alias: str, body: Optional[dict]) -> dict:
+    """Classic rollover on a write alias with `<name>-NNNNNN` naming."""
+    body = body or {}
+    if alias in node.data_streams.streams:
+        return node.data_streams.rollover(alias, body.get("conditions"))
+    old_index = node.indices.write_index(alias)
+    met = evaluate_conditions(node.indices.get(old_index),
+                              body.get("conditions"))
+    rolled = not body.get("conditions") or any(met.values())
+    new_index = old_index
+    if rolled:
+        m = re.search(r"^(.*?)-(\d+)$", old_index)
+        if m:
+            new_index = f"{m.group(1)}-{int(m.group(2)) + 1:06d}"
+        else:
+            new_index = f"{old_index}-000002"
+        node.indices.create_index(new_index, body.get("settings") and
+                                  {"settings": body["settings"]} or None)
+        if "mappings" in body:
+            node.indices.get(new_index).put_mapping(body["mappings"])
+        # move the write flag: old index keeps the alias for searches
+        node.indices.put_alias(old_index, alias, {"is_write_index": False})
+        node.indices.put_alias(new_index, alias, {"is_write_index": True})
+    return {"acknowledged": rolled, "shards_acknowledged": rolled,
+            "old_index": old_index, "new_index": new_index,
+            "rolled_over": rolled, "dry_run": bool(body.get("dry_run")),
+            "conditions": met}
+
+
+# ------------------------------------------------------------------- resize
+
+def resize_index(node, source_name: str, target_name: str,
+                 body: Optional[dict], kind: str) -> dict:
+    """shrink / split / clone: rebuild with the target shard count.
+    Reference constraints preserved: split factor must be a multiple,
+    shrink target must evenly divide the source shard count."""
+    body = body or {}
+    src = node.indices.get(source_name)
+    settings = {k: v for k, v in
+                (body.get("settings") or {}).items()}
+    settings = {**{k[len("index."):] if k.startswith("index.") else k: v
+                   for k, v in settings.items()}}
+    target_shards = int(settings.get("number_of_shards",
+                                     src.num_shards if kind == "clone"
+                                     else (1 if kind == "shrink"
+                                           else src.num_shards * 2)))
+    if kind == "shrink":
+        if src.num_shards % target_shards != 0:
+            raise IllegalArgumentError(
+                f"the number of source shards [{src.num_shards}] must be a "
+                f"multiple of [{target_shards}]")
+    elif kind == "split":
+        if target_shards % src.num_shards != 0:
+            raise IllegalArgumentError(
+                f"the number of source shards [{src.num_shards}] must be a "
+                f"factor of [{target_shards}]")
+    elif kind == "clone":
+        if target_shards != src.num_shards:
+            raise IllegalArgumentError(
+                "cannot clone to a different number of shards")
+    settings["number_of_shards"] = target_shards
+    node.indices.create_index(target_name, {
+        "settings": settings, "mappings": src.mapping_dict(),
+        "aliases": body.get("aliases") or {}})
+    target = node.indices.get(target_name)
+    # re-route every live doc (docs keep ids; seqnos restart — the copy is
+    # a fresh history, like the reference's recovery-from-local-shards)
+    for shard in src.shards:
+        shard.refresh()
+        for seg in shard.engine.segments:
+            for ord_ in range(seg.num_docs):
+                if not seg.live[ord_]:
+                    continue
+                target.index_doc(seg.doc_ids[ord_], seg.sources[ord_])
+    target.refresh()
+    node.persist_metadata()
+    return {"acknowledged": True, "shards_acknowledged": True,
+            "index": target_name}

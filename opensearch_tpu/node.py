@@ -41,7 +41,9 @@ class Node:
         self.scroll_contexts: Dict[str, Any] = {}
         self.pit_contexts: Dict[str, Any] = {}
         from opensearch_tpu.repositories import RepositoriesService
+        from opensearch_tpu.datastreams import DataStreamService
         self.repositories = RepositoriesService()
+        self.data_streams = DataStreamService(self)
         self.gateway = None
         if data_path is not None:
             from opensearch_tpu.gateway import Gateway

@@ -1,0 +1,112 @@
+"""Ranking evaluation API (_rank_eval).
+
+Re-design of modules/rank-eval: run each templated/raw request, join hits
+with the rated documents, and compute a ranking-quality metric —
+precision@k, recall@k, mean reciprocal rank, or (normalized) discounted
+cumulative gain — per query and averaged (RankEvalRequest/
+RankEvalResponse shapes preserved).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+from opensearch_tpu.common.errors import IllegalArgumentError
+
+
+def _rated_map(ratings: List[dict]) -> Dict[tuple, int]:
+    return {(r["_index"], str(r["_id"])): int(r["rating"])
+            for r in ratings or []}
+
+
+def _hit_keys(hits: List[dict]) -> List[tuple]:
+    return [(h["_index"], str(h["_id"])) for h in hits]
+
+
+def precision_at_k(hits, rated, k, relevant_threshold=1):
+    top = _hit_keys(hits)[:k]
+    if not top:
+        return 0.0, []
+    relevant = sum(1 for key in top
+                   if rated.get(key, 0) >= relevant_threshold)
+    return relevant / len(top), top
+
+
+def recall_at_k(hits, rated, k, relevant_threshold=1):
+    top = _hit_keys(hits)[:k]
+    total_relevant = sum(1 for v in rated.values()
+                         if v >= relevant_threshold)
+    if total_relevant == 0:
+        return 0.0, top
+    found = sum(1 for key in top if rated.get(key, 0) >= relevant_threshold)
+    return found / total_relevant, top
+
+
+def mean_reciprocal_rank(hits, rated, k, relevant_threshold=1):
+    top = _hit_keys(hits)[:k]
+    for i, key in enumerate(top):
+        if rated.get(key, 0) >= relevant_threshold:
+            return 1.0 / (i + 1), top
+    return 0.0, top
+
+
+def dcg_at_k(hits, rated, k, normalize=False):
+    top = _hit_keys(hits)[:k]
+    dcg = sum((2 ** rated.get(key, 0) - 1) / math.log2(i + 2)
+              for i, key in enumerate(top))
+    if not normalize:
+        return dcg, top
+    ideal = sorted(rated.values(), reverse=True)[:k]
+    idcg = sum((2 ** r - 1) / math.log2(i + 2)
+               for i, r in enumerate(ideal))
+    return (dcg / idcg if idcg > 0 else 0.0), top
+
+
+def rank_eval(node, index_expr: Optional[str], body: dict) -> dict:
+    from opensearch_tpu.rest.actions import _run_search
+    requests = body.get("requests")
+    if not requests:
+        raise IllegalArgumentError("rank_eval requires [requests]")
+    metric_spec = body.get("metric") or {"precision": {}}
+    if len(metric_spec) != 1:
+        raise IllegalArgumentError("exactly one metric is required")
+    metric_name, mbody = next(iter(metric_spec.items()))
+    mbody = mbody or {}
+    k = int(mbody.get("k", 10))
+    threshold = int(mbody.get("relevant_rating_threshold", 1))
+
+    details = {}
+    scores = []
+    for request in requests:
+        rid = request.get("id")
+        if rid is None:
+            raise IllegalArgumentError("evaluation request is missing [id]")
+        search_body = dict(request.get("request") or {})
+        search_body.setdefault("size", max(k, 10))
+        res = _run_search(node, index_expr, search_body)
+        hits = res["hits"]["hits"]
+        rated = _rated_map(request.get("ratings"))
+        if metric_name == "precision":
+            score, top = precision_at_k(hits, rated, k, threshold)
+        elif metric_name == "recall":
+            score, top = recall_at_k(hits, rated, k, threshold)
+        elif metric_name == "mean_reciprocal_rank":
+            score, top = mean_reciprocal_rank(hits, rated, k, threshold)
+        elif metric_name == "dcg":
+            score, top = dcg_at_k(hits, rated, k,
+                                  normalize=bool(mbody.get("normalize")))
+        else:
+            raise IllegalArgumentError(
+                f"unknown metric [{metric_name}]")
+        scores.append(score)
+        details[rid] = {
+            "metric_score": score,
+            "unrated_docs": [{"_index": i, "_id": d}
+                             for (i, d) in top if (i, d) not in rated],
+            "hits": [{"hit": {"_index": i, "_id": d},
+                      "rating": rated.get((i, d))}
+                     for (i, d) in top],
+        }
+    return {"metric_score": sum(scores) / len(scores) if scores else 0.0,
+            "details": details, "failures": {}}

@@ -1,0 +1,210 @@
+"""Reindex family: _reindex, _update_by_query, _delete_by_query.
+
+Re-design of modules/reindex (AbstractAsyncBulkByScrollAction and friends):
+scroll over the source with a point-in-time view, transform (script /
+pipeline), and bulk into the destination in batches, tracking the same
+counters the reference reports (total/created/updated/deleted/batches/
+version_conflicts/noops). Conflicts: "abort" (default) stops on version
+conflict, "proceed" counts and continues.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from opensearch_tpu.common.errors import (
+    IllegalArgumentError, VersionConflictError)
+
+BATCH_SIZE = 1000
+
+
+def _scan_source(node, index_expr: str, query: Optional[dict],
+                 batch_size: int):
+    """Yield batches of hits from a pinned snapshot of the source
+    (the reference scrolls; PinnedReader gives the same isolation)."""
+    from opensearch_tpu.search.scroll import _pin_executors
+    from opensearch_tpu.search.controller import execute_search
+    executors, filters = _pin_executors(node, index_expr)
+    body: Dict[str, Any] = {"query": query or {"match_all": {}},
+                            "size": batch_size}
+    # deterministic full scan: score sort + the internal (shard, seg, ord)
+    # tiebreak cursor covers ties (match_all scores are uniform)
+    cursor_values = None
+    cursor_tiebreak = None
+    while True:
+        b = dict(body)
+        if cursor_values is not None:
+            b["search_after"] = cursor_values
+        res = execute_search(executors, b, extra_filters=filters,
+                             cursor_tiebreak=cursor_tiebreak)
+        cursor = res.pop("_page_cursor", None)
+        hits = res["hits"]["hits"]
+        if not hits:
+            return
+        yield hits
+        if cursor is None:
+            return
+        cursor_values = cursor["values"]
+        cursor_tiebreak = tuple(cursor["tiebreak"])
+
+
+def reindex(node, body: dict) -> dict:
+    start = time.monotonic()
+    source = body.get("source") or {}
+    dest = body.get("dest") or {}
+    src_index = source.get("index")
+    dest_index = dest.get("index")
+    if not src_index or not dest_index:
+        raise IllegalArgumentError("reindex requires source.index and "
+                                   "dest.index")
+    if isinstance(src_index, list):
+        src_index = ",".join(src_index)
+    max_docs = body.get("max_docs", source.get("size"))
+    script_spec = body.get("script")
+    script = node.script_service.compile(script_spec, "update") \
+        if script_spec else None
+    op_type = dest.get("op_type", "index")
+    pipeline = dest.get("pipeline")
+    if dest_index not in node.indices.aliases and \
+            not node.indices.has_index(dest_index):
+        node.indices.create_index(dest_index)  # auto-create like the bulk path
+    dest_svc = node.indices.get(node.indices.write_index(dest_index))
+
+    created = updated = noops = conflicts = batches = total = 0
+    done = False
+    for hits in _scan_source(node, src_index, source.get("query"),
+                             int(source.get("size", BATCH_SIZE))
+                             if source.get("size") else BATCH_SIZE):
+        batches += 1
+        for h in hits:
+            if max_docs is not None and total >= int(max_docs):
+                done = True
+                break
+            total += 1
+            doc_id = h["_id"]
+            src_doc = dict(h.get("_source") or {})
+            if script is not None:
+                ctx = {"_source": src_doc, "_id": doc_id,
+                       "_index": h["_index"], "op": "index"}
+                script.execute(ctx)
+                if ctx.get("op") in ("none", "noop"):
+                    noops += 1
+                    continue
+                if ctx.get("op") == "delete":
+                    continue
+                src_doc = ctx["_source"]
+                doc_id = ctx.get("_id", doc_id)
+            if pipeline:
+                src_doc = node.ingest.execute(pipeline, src_doc,
+                                              {"_index": dest_index,
+                                               "_id": doc_id})
+                if src_doc is None:
+                    noops += 1
+                    continue
+            try:
+                res = dest_svc.index_doc(doc_id, src_doc, op_type=op_type)
+                if res.get("result") == "created":
+                    created += 1
+                else:
+                    updated += 1
+            except VersionConflictError:
+                conflicts += 1
+                if body.get("conflicts") != "proceed":
+                    raise
+        if done:
+            break
+    dest_svc.refresh()
+    return {
+        "took": int((time.monotonic() - start) * 1000),
+        "timed_out": False, "total": total, "created": created,
+        "updated": updated, "deleted": 0, "batches": batches,
+        "noops": noops, "version_conflicts": conflicts,
+        "retries": {"bulk": 0, "search": 0},
+        "failures": [],
+    }
+
+
+def update_by_query(node, index_expr: str, body: dict,
+                    refresh: bool = False) -> dict:
+    start = time.monotonic()
+    body = body or {}
+    script_spec = body.get("script")
+    script = node.script_service.compile(script_spec, "update") \
+        if script_spec else None
+    max_docs = body.get("max_docs")
+    updated = noops = conflicts = batches = total = 0
+    done = False
+    for hits in _scan_source(node, index_expr, body.get("query"),
+                             BATCH_SIZE):
+        batches += 1
+        for h in hits:
+            if max_docs is not None and total >= int(max_docs):
+                done = True
+                break
+            total += 1
+            svc = node.indices.get(h["_index"])
+            try:
+                if script is not None:
+                    res = svc.update_doc(h["_id"],
+                                         {"script": script_spec})
+                else:
+                    # no script: reindex the doc as-is (bumps version,
+                    # picks up mapping changes)
+                    res = svc.index_doc(h["_id"], h["_source"])
+                if res.get("result") == "noop":
+                    noops += 1
+                else:
+                    updated += 1
+            except VersionConflictError:
+                conflicts += 1
+                if body.get("conflicts") != "proceed":
+                    raise
+        if done:
+            break
+    if refresh:
+        for name in node.indices.resolve(index_expr):
+            node.indices.get(name).refresh()
+    return {"took": int((time.monotonic() - start) * 1000),
+            "timed_out": False, "total": total, "updated": updated,
+            "deleted": 0, "batches": batches, "noops": noops,
+            "version_conflicts": conflicts,
+            "retries": {"bulk": 0, "search": 0}, "failures": []}
+
+
+def delete_by_query(node, index_expr: str, body: dict,
+                    refresh: bool = False) -> dict:
+    start = time.monotonic()
+    body = body or {}
+    if "query" not in body:
+        raise IllegalArgumentError("query is missing")
+    max_docs = body.get("max_docs")
+    deleted = conflicts = batches = total = 0
+    done = False
+    for hits in _scan_source(node, index_expr, body.get("query"),
+                             BATCH_SIZE):
+        batches += 1
+        for h in hits:
+            if max_docs is not None and total >= int(max_docs):
+                done = True
+                break
+            total += 1
+            svc = node.indices.get(h["_index"])
+            try:
+                res = svc.delete_doc(h["_id"])
+                if res.get("result") == "deleted":
+                    deleted += 1
+            except VersionConflictError:
+                conflicts += 1
+                if body.get("conflicts") != "proceed":
+                    raise
+        if done:
+            break
+    if refresh:
+        for name in node.indices.resolve(index_expr):
+            node.indices.get(name).refresh()
+    return {"took": int((time.monotonic() - start) * 1000),
+            "timed_out": False, "total": total, "deleted": deleted,
+            "batches": batches, "version_conflicts": conflicts,
+            "noops": 0, "retries": {"bulk": 0, "search": 0},
+            "failures": []}

@@ -36,6 +36,7 @@ def _ndjson_lines(request: RestRequest) -> List[Any]:
 def _search_targets(node, index_expr: Optional[str]):
     """Resolve an index expression to (executors, alias_filters) pairs for
     a cross-index search, honoring alias filters per concrete index."""
+    index_expr = _expand_data_streams(node, index_expr)
     names = node.indices.resolve(index_expr, ignore_unavailable=False,
                                  allow_no_indices=True)
     executors, filters = [], []
@@ -46,6 +47,25 @@ def _search_targets(node, index_expr: Optional[str]):
             executors.append(shard.executor)
             filters.append(alias_filter)
     return executors, filters
+
+
+def _write_index(node, name: str) -> str:
+    """Write-target resolution incl. data streams (stream → newest backing
+    index, reference: IndexAbstraction.DataStream.getWriteIndex)."""
+    ds = node.data_streams.resolve_write_index(name)
+    if ds is not None:
+        return ds
+    return node.indices.write_index(name)
+
+
+def _expand_data_streams(node, index_expr: Optional[str]) -> Optional[str]:
+    if not index_expr:
+        return index_expr
+    parts = []
+    for part in index_expr.split(","):
+        backing = node.data_streams.resolve_search(part.strip())
+        parts.extend(backing if backing is not None else [part])
+    return ",".join(parts)
 
 
 def _run_search(node, index_expr: Optional[str], body: Optional[dict]) -> dict:
@@ -90,7 +110,7 @@ def register_document_actions(node, c):
         return source
 
     def do_index(req):
-        idx = node.indices.write_index(req.param("index"))
+        idx = _write_index(node, req.param("index"))
         svc = node.indices.get(idx)
         doc_id = req.param("id")
         op_type = req.param("op_type", "index")
@@ -201,7 +221,7 @@ def register_document_actions(node, c):
         # responses keep the original item order (reference: BulkResponse)
         by_index: Dict[str, List[int]] = {}
         for pos, item in enumerate(items):
-            concrete = node.indices.write_index(item["index"])
+            concrete = _write_index(node, item["index"])
             item["index"] = concrete
             by_index.setdefault(concrete, []).append(pos)
         responses: List[Optional[dict]] = [None] * len(items)
@@ -1103,6 +1123,77 @@ def register_snapshot_actions(node, c):
     c.register("POST", "/_dangling/{index}", do_import_dangling)
 
 
+# -------------------------------------- reindex family / rank-eval / resize
+
+def register_module_actions(node, c):
+    from opensearch_tpu.datastreams import resize_index, rollover_alias
+    from opensearch_tpu.rankeval import rank_eval
+    from opensearch_tpu.reindex import (
+        delete_by_query, reindex, update_by_query)
+
+    def do_reindex(req):
+        return reindex(node, req.body or {})
+
+    def do_update_by_query(req):
+        res = update_by_query(node, req.param("index"), req.body,
+                              refresh=req.bool_param("refresh"))
+        return res
+
+    def do_delete_by_query(req):
+        return delete_by_query(node, req.param("index"), req.body,
+                               refresh=req.bool_param("refresh"))
+
+    def do_rank_eval(req):
+        return rank_eval(node, req.param("index"), req.body or {})
+
+    def do_create_data_stream(req):
+        node.data_streams.create(req.param("name"))
+        return {"acknowledged": True}
+
+    def do_get_data_stream(req):
+        name = req.param("name")
+        if name:
+            return {"data_streams": [node.data_streams.get(name).to_dict()]}
+        return {"data_streams": [s.to_dict() for s in
+                                 node.data_streams.streams.values()]}
+
+    def do_delete_data_stream(req):
+        node.data_streams.delete(req.param("name"))
+        return {"acknowledged": True}
+
+    def do_rollover(req):
+        # the path trie binds the first-registered param name at this
+        # level ({index}); accept either spelling
+        target = req.param("alias") or req.param("index")
+        return rollover_alias(node, target, req.body)
+
+    def make_resize(kind):
+        def handler(req):
+            return resize_index(node, req.param("index"),
+                                req.param("target"), req.body, kind)
+        return handler
+
+    c.register("POST", "/_reindex", do_reindex)
+    c.register("POST", "/{index}/_update_by_query", do_update_by_query)
+    c.register("POST", "/{index}/_delete_by_query", do_delete_by_query)
+    c.register("GET", "/_rank_eval", do_rank_eval)
+    c.register("POST", "/_rank_eval", do_rank_eval)
+    c.register("GET", "/{index}/_rank_eval", do_rank_eval)
+    c.register("POST", "/{index}/_rank_eval", do_rank_eval)
+    c.register("PUT", "/_data_stream/{name}", do_create_data_stream)
+    c.register("GET", "/_data_stream", do_get_data_stream)
+    c.register("GET", "/_data_stream/{name}", do_get_data_stream)
+    c.register("DELETE", "/_data_stream/{name}", do_delete_data_stream)
+    c.register("POST", "/{alias}/_rollover", do_rollover)
+    c.register("POST", "/{alias}/_rollover/{new_index}", do_rollover)
+    c.register("POST", "/{index}/_shrink/{target}", make_resize("shrink"))
+    c.register("PUT", "/{index}/_shrink/{target}", make_resize("shrink"))
+    c.register("POST", "/{index}/_split/{target}", make_resize("split"))
+    c.register("PUT", "/{index}/_split/{target}", make_resize("split"))
+    c.register("POST", "/{index}/_clone/{target}", make_resize("clone"))
+    c.register("PUT", "/{index}/_clone/{target}", make_resize("clone"))
+
+
 def register_all(node):
     c = node.controller
     register_cluster_actions(node, c)
@@ -1113,3 +1204,4 @@ def register_all(node):
     register_cat_actions(node, c)
     register_script_ingest_actions(node, c)
     register_snapshot_actions(node, c)
+    register_module_actions(node, c)

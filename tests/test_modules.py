@@ -1,0 +1,248 @@
+"""Module-feature tests: reindex family, rank-eval, data streams,
+rollover, shrink/split/clone.
+
+Modeled on the reference suites: ReindexBasicTests / UpdateByQueryBasicTests
+/ DeleteByQueryBasicTests (modules/reindex), RankEvalRequestIT
+(modules/rank-eval), DataStreamIT, RolloverIT, ShrinkIndexIT/SplitIndexIT."""
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    n.request("PUT", "/src", {
+        "settings": {"number_of_shards": 2},
+        "mappings": {"properties": {"tag": {"type": "keyword"},
+                                    "n": {"type": "integer"}}}})
+    for i in range(25):
+        n.request("PUT", f"/src/_doc/{i}",
+                  {"tag": "even" if i % 2 == 0 else "odd", "n": i})
+    n.request("POST", "/src/_refresh")
+    return n
+
+
+class TestReindex:
+    def test_basic_reindex(self, node):
+        res = node.request("POST", "/_reindex", {
+            "source": {"index": "src"}, "dest": {"index": "dst"}})
+        assert res["created"] == 25
+        assert res["total"] == 25
+        assert node.request("GET", "/dst/_count")["count"] == 25
+
+    def test_reindex_with_query_filter(self, node):
+        res = node.request("POST", "/_reindex", {
+            "source": {"index": "src", "query": {"term": {"tag": "even"}}},
+            "dest": {"index": "dst"}})
+        assert res["created"] == 13
+        assert node.request("GET", "/dst/_count")["count"] == 13
+
+    def test_reindex_with_script(self, node):
+        node.request("POST", "/_reindex", {
+            "source": {"index": "src"},
+            "dest": {"index": "dst"},
+            "script": {"source": "ctx._source.n += 1000"}})
+        res = node.request("POST", "/dst/_search", {
+            "query": {"range": {"n": {"gte": 1000}}}, "size": 0})
+        assert res["hits"]["total"]["value"] == 25
+
+    def test_reindex_max_docs(self, node):
+        res = node.request("POST", "/_reindex", {
+            "max_docs": 7,
+            "source": {"index": "src"}, "dest": {"index": "dst"}})
+        assert res["created"] == 7
+
+    def test_reindex_op_type_create_conflicts(self, node):
+        node.request("POST", "/_reindex", {
+            "source": {"index": "src"}, "dest": {"index": "dst"}})
+        res = node.request("POST", "/_reindex", {
+            "conflicts": "proceed",
+            "source": {"index": "src"},
+            "dest": {"index": "dst", "op_type": "create"}})
+        assert res["version_conflicts"] == 25
+        assert res["created"] == 0
+
+
+class TestUpdateDeleteByQuery:
+    def test_update_by_query_with_script(self, node):
+        res = node.request("POST", "/src/_update_by_query", {
+            "query": {"term": {"tag": "odd"}},
+            "script": {"source": "ctx._source.n = ctx._source.n * -1"}},
+            refresh="true")
+        assert res["updated"] == 12
+        out = node.request("POST", "/src/_search", {
+            "query": {"range": {"n": {"lt": 0}}}, "size": 0})
+        assert out["hits"]["total"]["value"] == 12
+
+    def test_delete_by_query(self, node):
+        res = node.request("POST", "/src/_delete_by_query", {
+            "query": {"term": {"tag": "even"}}}, refresh="true")
+        assert res["deleted"] == 13
+        assert node.request("GET", "/src/_count")["count"] == 12
+
+    def test_delete_by_query_requires_query(self, node):
+        res = node.request("POST", "/src/_delete_by_query", {})
+        assert res["_status"] == 400
+
+
+class TestRankEval:
+    def test_precision_at_k(self, node):
+        res = node.request("POST", "/src/_rank_eval", {
+            "requests": [{
+                "id": "q1",
+                "request": {"query": {"term": {"tag": "even"}}},
+                "ratings": [
+                    {"_index": "src", "_id": "0", "rating": 1},
+                    {"_index": "src", "_id": "2", "rating": 1},
+                    {"_index": "src", "_id": "1", "rating": 0},
+                ],
+            }],
+            "metric": {"precision": {"k": 5}},
+        })
+        assert 0.0 <= res["metric_score"] <= 1.0
+        d = res["details"]["q1"]
+        assert d["metric_score"] == res["metric_score"]
+        assert len(d["hits"]) == 5
+        # unrated docs reported (the reference surfaces them for triage)
+        assert any(u["_id"] not in ("0", "1", "2")
+                   for u in d["unrated_docs"])
+
+    def test_mrr(self, node):
+        res = node.request("POST", "/src/_rank_eval", {
+            "requests": [{
+                "id": "q1",
+                "request": {"query": {"match_all": {}},
+                            "sort": [{"n": "asc"}]},
+                "ratings": [{"_index": "src", "_id": "2", "rating": 1}],
+            }],
+            "metric": {"mean_reciprocal_rank": {"k": 10}},
+        })
+        # doc 2 ranks third under n asc → RR = 1/3
+        assert res["metric_score"] == pytest.approx(1 / 3)
+
+    def test_dcg(self, node):
+        res = node.request("POST", "/src/_rank_eval", {
+            "requests": [{
+                "id": "q1",
+                "request": {"query": {"match_all": {}},
+                            "sort": [{"n": "asc"}]},
+                "ratings": [{"_index": "src", "_id": str(i), "rating": 2}
+                            for i in range(3)],
+            }],
+            "metric": {"dcg": {"k": 3, "normalize": True}},
+        })
+        assert res["metric_score"] == pytest.approx(1.0)
+
+
+class TestDataStreams:
+    def make_template(self, node):
+        node.request("PUT", "/_index_template/logs-template", {
+            "index_patterns": ["logs-*"],
+            "data_stream": {},
+            "template": {"mappings": {"properties": {
+                "level": {"type": "keyword"}}}},
+            "priority": 100})
+
+    def test_create_write_search_rollover(self, node):
+        self.make_template(node)
+        res = node.request("PUT", "/_data_stream/logs-app")
+        assert res["acknowledged"] is True
+        info = node.request("GET", "/_data_stream/logs-app")
+        ds = info["data_streams"][0]
+        assert ds["generation"] == 1
+        assert ds["indices"][0]["index_name"] == ".ds-logs-app-000001"
+        # writes land in the backing index
+        node.request("POST", "/logs-app/_doc",
+                     {"@timestamp": "2026-01-01T00:00:00Z",
+                      "level": "info"}, refresh="true")
+        res = node.request("POST", "/logs-app/_search", {})
+        assert res["hits"]["total"]["value"] == 1
+        assert res["hits"]["hits"][0]["_index"] == ".ds-logs-app-000001"
+        # rollover
+        res = node.request("POST", "/logs-app/_rollover", {})
+        assert res["rolled_over"] is True
+        assert res["new_index"] == ".ds-logs-app-000002"
+        node.request("POST", "/logs-app/_doc",
+                     {"@timestamp": "2026-01-02T00:00:00Z",
+                      "level": "warn"}, refresh="true")
+        res = node.request("POST", "/logs-app/_search", {"size": 10})
+        assert res["hits"]["total"]["value"] == 2
+        assert {h["_index"] for h in res["hits"]["hits"]} == {
+            ".ds-logs-app-000001", ".ds-logs-app-000002"}
+
+    def test_conditional_rollover(self, node):
+        self.make_template(node)
+        node.request("PUT", "/_data_stream/logs-c")
+        for i in range(5):
+            node.request("POST", "/logs-c/_doc",
+                         {"@timestamp": "2026-01-01T00:00:00Z"},
+                         refresh="true")
+        res = node.request("POST", "/logs-c/_rollover",
+                           {"conditions": {"max_docs": 10}})
+        assert res["rolled_over"] is False
+        res = node.request("POST", "/logs-c/_rollover",
+                           {"conditions": {"max_docs": 3}})
+        assert res["rolled_over"] is True
+
+    def test_delete_data_stream_removes_backing(self, node):
+        self.make_template(node)
+        node.request("PUT", "/_data_stream/logs-del")
+        node.request("DELETE", "/_data_stream/logs-del")
+        assert node.request("HEAD", "/.ds-logs-del-000001")["_status"] == 404
+        assert node.request("GET",
+                            "/_data_stream/logs-del")["_status"] == 404
+
+    def test_requires_matching_template(self, node):
+        res = node.request("PUT", "/_data_stream/no-template")
+        assert res["_status"] == 400
+
+
+class TestAliasRollover:
+    def test_write_alias_rollover(self, node):
+        node.request("PUT", "/app-000001")
+        node.request("PUT", "/app-000001/_alias/app",
+                     {"is_write_index": True})
+        for i in range(4):
+            node.request("PUT", f"/app/_doc/{i}", {"n": i}, refresh="true")
+        res = node.request("POST", "/app/_rollover",
+                           {"conditions": {"max_docs": 3}})
+        assert res["rolled_over"] is True
+        assert res["new_index"] == "app-000002"
+        # new writes land in the new index, search sees both
+        node.request("PUT", "/app/_doc/new", {"n": 99}, refresh="true")
+        assert node.request("GET",
+                            "/app-000002/_count")["count"] == 1
+        assert node.request("GET", "/app/_count")["count"] == 5
+
+
+class TestResize:
+    def test_shrink(self, node):
+        res = node.request("POST", "/src/_shrink/src-small", {
+            "settings": {"index.number_of_shards": 1}})
+        assert res["acknowledged"] is True
+        assert node.request("GET", "/src-small/_count")["count"] == 25
+        info = node.request("GET", "/src-small")
+        assert info["src-small"]["settings"]["index"]["number_of_shards"] \
+            == "1"
+
+    def test_split(self, node):
+        node.request("POST", "/src/_split/src-big", {
+            "settings": {"index.number_of_shards": 4}})
+        assert node.request("GET", "/src-big/_count")["count"] == 25
+        shards = node.handle("GET", "/_cat/shards/src-big").body
+        assert shards.count("src-big") == 4
+
+    def test_split_invalid_factor(self, node):
+        res = node.request("POST", "/src/_split/bad", {
+            "settings": {"index.number_of_shards": 3}})
+        assert res["_status"] == 400
+
+    def test_clone(self, node):
+        node.request("POST", "/src/_clone/src-copy", {})
+        assert node.request("GET", "/src-copy/_count")["count"] == 25
+        # mapping carried over
+        m = node.request("GET", "/src-copy/_mapping")
+        assert m["src-copy"]["mappings"]["properties"]["tag"]["type"] == \
+            "keyword"
