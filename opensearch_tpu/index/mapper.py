@@ -293,7 +293,8 @@ class MapperService:
     def _put_field(self, full_name: str, spec: dict):
         ftype = spec.get("type")
         known = (TEXT_TYPES | KEYWORD_TYPES | NUMERIC_TYPES | DATE_TYPES | VECTOR_TYPES
-                 | BOOL_TYPES | IP_TYPES | GEO_TYPES | {"object", "binary"})
+                 | BOOL_TYPES | IP_TYPES | GEO_TYPES
+                 | {"object", "binary", "percolator"})
         if ftype not in known:
             raise MapperParsingError(
                 f"No handler for type [{ftype}] declared on field [{full_name.split('.')[-1]}]")
@@ -391,6 +392,11 @@ class MapperService:
     def _parse_object(self, prefix: str, obj: dict, out: Dict[str, ParsedField]):
         for key, value in obj.items():
             full = f"{prefix}{key}"
+            ft = self.field_types.get(full)
+            if ft is not None and ft.type == "percolator":
+                # stored-query field: kept in _source only, matched at
+                # percolate time (modules/percolator PercolatorFieldMapper)
+                continue
             if isinstance(value, dict):
                 self._parse_object(f"{full}.", value, out)
             elif isinstance(value, list) and value and all(

@@ -69,8 +69,14 @@ def _expand_data_streams(node, index_expr: Optional[str]) -> Optional[str]:
 
 
 def _run_search(node, index_expr: Optional[str], body: Optional[dict]) -> dict:
+    from opensearch_tpu.search import dsl
     from opensearch_tpu.search.controller import execute_search
     executors, filters = _search_targets(node, index_expr)
+    parsed = dsl.parse_query((body or {}).get("query"))
+    if isinstance(parsed, dsl.PercolateQuery):
+        from opensearch_tpu.search.percolator import execute_percolate
+        k = int((body or {}).get("size", 10)) + int((body or {}).get("from", 0))
+        return execute_percolate(executors, parsed, max(k, 10), body or {})
     res = execute_search(executors, body, extra_filters=filters)
     res.pop("_page_cursor", None)
     return res

@@ -188,6 +188,12 @@ class ScriptScoreQuery(QueryNode):
 
 
 @dataclass
+class PercolateQuery(QueryNode):
+    field: str = ""
+    documents: List[dict] = dc_field(default_factory=list)
+
+
+@dataclass
 class NestedStub(QueryNode):
     """Placeholder for not-yet-supported compound types; compile raises."""
     type_name: str = ""
@@ -380,6 +386,19 @@ def parse_query(q: Any) -> QueryNode:
                         filter=parse_query(spec["filter"]) if "filter" in spec else None,
                         nprobe=int(mp.get("nprobes", mp.get("nprobe", 0))),
                         boost=float(spec.get("boost", 1.0)))
+
+    if name == "percolate":
+        docs = body.get("documents")
+        if docs is None and "document" in body:
+            docs = [body["document"]]
+        if not body.get("field"):
+            raise ParsingError("[percolate] query is missing required "
+                               "[field] parameter")
+        if docs is None:
+            raise ParsingError("[percolate] query is missing required "
+                               "[document] parameter")
+        return PercolateQuery(field=body["field"], documents=list(docs),
+                              boost=float(body.get("boost", 1.0)))
 
     if name == "script_score":
         script = body.get("script", {})

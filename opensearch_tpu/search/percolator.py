@@ -1,0 +1,227 @@
+"""Percolator: reverse search — match documents against stored queries.
+
+Re-design of modules/percolator (PercolatorFieldMapper + PercolateQuery
+Builder): queries are indexed as documents with a `percolator`-typed field;
+a `percolate` query takes candidate document(s), and matches the stored
+queries that would have matched them. The candidate doc set is tiny (1..n),
+so matching runs host-side with a direct query evaluator over a one-doc
+parsed view — no device round trip (the reference similarly builds an
+in-memory single-doc index per percolation).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from opensearch_tpu.common.errors import QueryShardError
+from opensearch_tpu.search import dsl
+
+
+class DocView:
+    """Parsed candidate document: analyzed terms + raw values per field."""
+
+    def __init__(self, mapper, source: dict):
+        self.mapper = mapper
+        parsed = mapper.parse_document("_percolate", source)
+        self.fields = parsed.fields
+        self.source = source
+
+    def terms(self, field: str) -> List[str]:
+        pf = self.fields.get(field)
+        if pf is None:
+            return []
+        if pf.terms:
+            return [t for t, _ in pf.terms]
+        if pf.exact_values:
+            return [str(v) for v in pf.exact_values]
+        return []
+
+    def positions(self, field: str) -> List[Tuple[str, int]]:
+        pf = self.fields.get(field)
+        return list(pf.terms or []) if pf is not None else []
+
+    def numeric(self, field: str) -> List[float]:
+        pf = self.fields.get(field)
+        if pf is None:
+            return []
+        return [float(v) for v in (pf.numeric_values or [])]
+
+    def exists(self, field: str) -> bool:
+        return field in self.fields
+
+
+def matches(node: dsl.QueryNode, doc: DocView) -> bool:
+    """Host evaluation of a parsed query against one document — the
+    MemoryIndex-equivalent match path. Scoring-free (percolate hits score
+    constant like the reference's verified-candidate path)."""
+    m = _MATCHERS.get(type(node))
+    if m is None:
+        raise QueryShardError(
+            f"query [{type(node).__name__}] is not supported in a "
+            f"percolator context")
+    return m(node, doc)
+
+
+def _match_terms(node, doc) -> bool:
+    ft = doc.mapper.get_field(node.field)
+    if ft is not None and ft.is_text:
+        analyzer = doc.mapper.analysis.get(ft.search_analyzer or ft.analyzer)
+        wanted = [t for t, _ in analyzer.analyze(str(node.query))]
+    else:
+        wanted = [str(node.query)]
+    have = set(doc.terms(node.field))
+    if not wanted:
+        return False
+    hits = [t in have for t in wanted]
+    if node.operator == "and":
+        return all(hits)
+    from opensearch_tpu.search.dsl import parse_minimum_should_match
+    msm = parse_minimum_should_match(node.minimum_should_match,
+                                     len(wanted)) \
+        if node.minimum_should_match is not None else 1
+    return sum(hits) >= max(1, msm)
+
+
+def _match_phrase(node, doc) -> bool:
+    ft = doc.mapper.get_field(node.field)
+    if ft is None:
+        return False
+    analyzer = doc.mapper.analysis.get(ft.search_analyzer or ft.analyzer)
+    wanted = [t for t, _ in analyzer.analyze(str(node.query))]
+    if not wanted:
+        return False
+    pos = doc.positions(node.field)
+    index: Dict[str, List[int]] = {}
+    for term, p in pos:
+        index.setdefault(term, []).append(p)
+    if any(t not in index for t in wanted):
+        return False
+    slop = node.slop
+    for start in index[wanted[0]]:
+        ok = True
+        prev = start
+        for t in wanted[1:]:
+            nxt = [p for p in index[t] if prev < p <= prev + 1 + slop]
+            if not nxt:
+                ok = False
+                break
+            prev = min(nxt)
+        if ok:
+            return True
+    return False
+
+
+def _match_term(node, doc) -> bool:
+    value = str(node.value)
+    if getattr(node, "case_insensitive", False):
+        return value.lower() in {t.lower() for t in doc.terms(node.field)}
+    return value in doc.terms(node.field)
+
+
+def _match_range(node, doc) -> bool:
+    ft = doc.mapper.get_field(node.field)
+    values = doc.numeric(node.field)
+    if not values:
+        return False
+    conv = (lambda v: ft.to_comparable(v)) if ft is not None else float
+    for v in values:
+        ok = True
+        if node.gte is not None and v < conv(node.gte):
+            ok = False
+        if node.gt is not None and v <= conv(node.gt):
+            ok = False
+        if node.lte is not None and v > conv(node.lte):
+            ok = False
+        if node.lt is not None and v >= conv(node.lt):
+            ok = False
+        if ok:
+            return True
+    return False
+
+
+def _match_bool(node, doc) -> bool:
+    for clause in list(node.must) + list(node.filter):
+        if not matches(clause, doc):
+            return False
+    for clause in node.must_not:
+        if matches(clause, doc):
+            return False
+    if node.should:
+        hits = sum(1 for c in node.should if matches(c, doc))
+        from opensearch_tpu.search.dsl import parse_minimum_should_match
+        if node.minimum_should_match is not None:
+            msm = parse_minimum_should_match(node.minimum_should_match,
+                                             len(node.should))
+        else:
+            msm = 1 if not (node.must or node.filter) else 0
+        return hits >= msm
+    return True
+
+
+_MATCHERS = {
+    dsl.MatchAllQuery: lambda n, d: True,
+    dsl.MatchNoneQuery: lambda n, d: False,
+    dsl.MatchQuery: _match_terms,
+    dsl.MatchPhraseQuery: _match_phrase,
+    dsl.TermQuery: _match_term,
+    dsl.TermsQuery: lambda n, d: any(str(v) in d.terms(n.field)
+                                     for v in n.values),
+    dsl.RangeQuery: _match_range,
+    dsl.ExistsQuery: lambda n, d: d.exists(n.field),
+    dsl.PrefixQuery: lambda n, d: any(t.startswith(str(n.value))
+                                      for t in d.terms(n.field)),
+    dsl.WildcardQuery: lambda n, d: any(
+        fnmatch.fnmatchcase(t, str(n.value)) for t in d.terms(n.field)),
+    dsl.RegexpQuery: lambda n, d: any(
+        re.fullmatch(str(n.value), t) for t in d.terms(n.field)),
+    dsl.BoolQuery: _match_bool,
+    dsl.ConstantScoreQuery: lambda n, d: matches(n.filter, d),
+    dsl.DisMaxQuery: lambda n, d: any(matches(c, d) for c in n.queries),
+    dsl.IdsQuery: lambda n, d: False,
+}
+
+
+def execute_percolate(executors, node: "dsl.PercolateQuery", k: int,
+                      body: dict) -> dict:
+    """Run a standalone percolate search: scan stored-query docs, keep
+    those whose query matches any candidate document."""
+    import time
+    start = time.monotonic()
+    hits = []
+    total = 0
+    for ex in executors:
+        mapper = ex.reader.mapper
+        docs = [DocView(mapper, d) for d in node.documents]
+        for seg in ex.reader.segments:
+            for ord_ in range(seg.num_docs):
+                if not seg.live[ord_]:
+                    continue
+                source = seg.sources[ord_]
+                query_body = source.get(node.field)
+                if query_body is None:
+                    continue
+                stored = dsl.parse_query(query_body)
+                slots = [i for i, d in enumerate(docs)
+                         if matches(stored, d)]
+                if slots:
+                    total += 1
+                    if len(hits) < k:
+                        hit = {"_index": ex.reader.index_name,
+                               "_id": seg.doc_ids[ord_], "_score": 1.0,
+                               "_source": source}
+                        if len(docs) > 1:
+                            hit["fields"] = {
+                                "_percolator_document_slot": slots}
+                        hits.append(hit)
+    size = int(body.get("size", 10))
+    return {
+        "took": int((time.monotonic() - start) * 1000),
+        "timed_out": False,
+        "_shards": {"total": len(executors), "successful": len(executors),
+                    "skipped": 0, "failed": 0},
+        "hits": {"total": {"value": total, "relation": "eq"},
+                 "max_score": 1.0 if hits else None,
+                 "hits": hits[:size]},
+    }

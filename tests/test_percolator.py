@@ -1,0 +1,86 @@
+"""Percolator tests (modeled on modules/percolator PercolatorQuerySearchIT)."""
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    n.request("PUT", "/alerts", {"mappings": {"properties": {
+        "query": {"type": "percolator"},
+        "message": {"type": "text"},
+        "severity": {"type": "integer"},
+        "channel": {"type": "keyword"},
+    }}})
+    n.request("PUT", "/alerts/_doc/q-err",
+              {"query": {"match": {"message": "error"}}})
+    n.request("PUT", "/alerts/_doc/q-sev",
+              {"query": {"bool": {
+                  "must": [{"match": {"message": "disk"}}],
+                  "filter": [{"range": {"severity": {"gte": 5}}}]}}})
+    n.request("PUT", "/alerts/_doc/q-chan",
+              {"query": {"term": {"channel": "ops"}}})
+    n.request("PUT", "/alerts/_doc/q-phrase",
+              {"query": {"match_phrase": {"message": "out of memory"}}})
+    n.request("POST", "/alerts/_refresh")
+    return n
+
+
+class TestPercolate:
+    def test_single_document_match(self, node):
+        res = node.request("POST", "/alerts/_search", {"query": {
+            "percolate": {"field": "query",
+                          "document": {"message": "an error occurred"}}}})
+        assert res["hits"]["total"]["value"] == 1
+        assert res["hits"]["hits"][0]["_id"] == "q-err"
+
+    def test_bool_with_range_condition(self, node):
+        res = node.request("POST", "/alerts/_search", {"query": {
+            "percolate": {"field": "query", "document": {
+                "message": "disk full", "severity": 7}}}})
+        ids = {h["_id"] for h in res["hits"]["hits"]}
+        assert ids == {"q-sev"}
+        # below the severity threshold → no match
+        res = node.request("POST", "/alerts/_search", {"query": {
+            "percolate": {"field": "query", "document": {
+                "message": "disk full", "severity": 2}}}})
+        assert res["hits"]["total"]["value"] == 0
+
+    def test_phrase_and_keyword(self, node):
+        res = node.request("POST", "/alerts/_search", {"query": {
+            "percolate": {"field": "query", "document": {
+                "message": "process died: out of memory",
+                "channel": "ops"}}}})
+        ids = {h["_id"] for h in res["hits"]["hits"]}
+        assert ids == {"q-phrase", "q-chan"}
+        # phrase must be contiguous
+        res = node.request("POST", "/alerts/_search", {"query": {
+            "percolate": {"field": "query", "document": {
+                "message": "out of available memory"}}}})
+        assert res["hits"]["total"]["value"] == 0
+
+    def test_multiple_documents_slots(self, node):
+        res = node.request("POST", "/alerts/_search", {"query": {
+            "percolate": {"field": "query", "documents": [
+                {"message": "all fine"},
+                {"message": "error in module"},
+                {"message": "another error"},
+            ]}}})
+        assert res["hits"]["total"]["value"] == 1
+        hit = res["hits"]["hits"][0]
+        assert hit["_id"] == "q-err"
+        assert hit["fields"]["_percolator_document_slot"] == [1, 2]
+
+    def test_missing_field_param_rejected(self, node):
+        res = node.request("POST", "/alerts/_search", {"query": {
+            "percolate": {"document": {"message": "x"}}}})
+        assert res["_status"] == 400
+
+    def test_percolator_field_not_indexed_as_object(self, node):
+        # the stored query body must not leak dynamic mappings
+        m = node.request("GET", "/alerts/_mapping")
+        props = m["alerts"]["mappings"]["properties"]
+        assert props["query"]["type"] == "percolator"
+        assert "query.match" not in str(props.keys())
