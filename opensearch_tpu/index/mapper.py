@@ -33,7 +33,10 @@ from opensearch_tpu.analysis import AnalysisRegistry, get_default_registry
 TEXT_TYPES = {"text", "match_only_text", "search_as_you_type"}
 KEYWORD_TYPES = {"keyword", "constant_keyword", "wildcard"}
 NUMERIC_TYPES = {"long", "integer", "short", "byte", "double", "float", "half_float",
-                 "scaled_float", "unsigned_long"}
+                 "scaled_float", "unsigned_long",
+                 # mapper-extras rank features are positive floats with doc
+                 # values; scoring behavior lives in rank_feature queries
+                 "rank_feature"}
 DATE_TYPES = {"date", "date_nanos"}
 VECTOR_TYPES = {"knn_vector", "dense_vector"}
 BOOL_TYPES = {"boolean"}
@@ -324,6 +327,10 @@ class MapperService:
         if method_name in ("hnsw", "ivf"):
             method_name = "ivf"
         method_params = method_spec.get("parameters", {}) or {}
+        if ftype == "geo_point":
+            for axis in ("lat", "lon"):
+                self.field_types[f"{full_name}.{axis}"] = MappedFieldType(
+                    name=f"{full_name}.{axis}", type="double")
         self.field_types[full_name] = MappedFieldType(
             name=full_name, type=ftype,
             analyzer=analyzer,
@@ -508,9 +515,26 @@ class MapperService:
                     f"got {len(vec)}")
             pf.vector = vec
         elif ft.type == "geo_point":
+            # store as two aligned numeric columns (.lat/.lon) — a sorted
+            # value-pair column would scramble which value is which axis;
+            # the parent field keeps lat for exists checks
+            if isinstance(value, (list, tuple)) and len(value) == 2 \
+                    and all(isinstance(v, (int, float)) for v in value):
+                points = [list(value)]  # bare GeoJSON [lon, lat] point
+            elif isinstance(value, list):
+                points = value
+            else:
+                points = [value]
             nums = pf.numeric_values or []
-            lat, lon = _parse_geo_point(value)
-            nums.extend([lat, lon])
+            lat_pf = out.setdefault(f"{name}.lat", ParsedField())
+            lon_pf = out.setdefault(f"{name}.lon", ParsedField())
+            lat_pf.numeric_values = lat_pf.numeric_values or []
+            lon_pf.numeric_values = lon_pf.numeric_values or []
+            for v in points:
+                lat, lon = _parse_geo_point(v)
+                nums.append(lat)
+                lat_pf.numeric_values.append(lat)
+                lon_pf.numeric_values.append(lon)
             pf.numeric_values = nums
         # binary/object: stored in _source only
 

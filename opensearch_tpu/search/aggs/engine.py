@@ -63,6 +63,7 @@ class AggPlan:
     inputs: Dict[str, np.ndarray] = dc_field(default_factory=dict)
     children: List["AggPlan"] = dc_field(default_factory=list)
     query_plan: Optional[Plan] = None      # filter aggs
+    query_plans: List[Plan] = dc_field(default_factory=list)  # adjacency
     render: Dict[str, Any] = dc_field(default_factory=dict)  # host-only
 
     def sig(self):
@@ -70,12 +71,15 @@ class AggPlan:
                 tuple(sorted((k, v.shape, str(v.dtype))
                              for k, v in self.inputs.items())),
                 self.query_plan.sig() if self.query_plan is not None else None,
+                tuple(q.sig() for q in self.query_plans),
                 tuple(c.sig() for c in self.children))
 
     def flatten_inputs(self, out):
         out.append(self.inputs)
         if self.query_plan is not None:
             self.query_plan.flatten_inputs(out)
+        for q in self.query_plans:
+            q.flatten_inputs(out)
         for c in self.children:
             c.flatten_inputs(out)
         return out
@@ -201,6 +205,30 @@ def _calendar_boundaries(lo_ms: float, hi_ms: float, unit: str) -> List[int]:
         cur = cur.replace(year=cur.year + month // 12, month=month % 12 + 1)
     out.append(int(cur.timestamp() * 1000))
     return out
+
+
+_CALENDAR_APPROX_MS = {"M": 2_592_000_000, "1M": 2_592_000_000,
+                       "month": 2_592_000_000,
+                       "q": 7_776_000_000, "1q": 7_776_000_000,
+                       "quarter": 7_776_000_000,
+                       "y": 31_536_000_000, "1y": 31_536_000_000,
+                       "year": 31_536_000_000}
+
+
+def _interval_ms(body: dict) -> int:
+    """Interval in ms for fixed bucketing. Calendar month/quarter/year use
+    fixed approximations (30/90/365 days) — composite sources bucket on
+    fixed widths; the standalone date_histogram path uses true calendar
+    boundaries via _calendar_boundaries."""
+    unit = str(body.get("calendar_interval") or body.get("fixed_interval")
+               or body.get("interval") or "1d")
+    if unit in _FIXED_MS:
+        return _FIXED_MS[unit]
+    if unit in _CALENDAR_APPROX_MS:
+        return _CALENDAR_APPROX_MS[unit]
+    if unit[:-1].isdigit() and unit[-1] in "smhdw":
+        return int(unit[:-1]) * _FIXED_MS[unit[-1]]
+    raise ParsingError(f"unknown date interval [{unit}]")
 
 
 def _c_date_histogram(node: AggNode, ctx: _Ctx) -> AggPlan:
@@ -410,6 +438,276 @@ def _c_weighted_avg(node: AggNode, ctx: _Ctx) -> AggPlan:
     return AggPlan(node.name, "empty", render=render)
 
 
+# ---------------------------------------------------- dense-bucket family
+#
+# A host-precomputed per-doc bucket id (int32[d_pad], -1 = no bucket) feeds
+# one generic device kind ("bucket_dense"): the host does the irregular
+# string/tuple work once per (agg, segment) compile, the device does the
+# massively-regular scatter-count. geohash grids, composite tuples,
+# multi_terms and auto intervals all ride this path.
+
+def _dense_first_value(ctx: _Ctx, field: str):
+    """Per-doc first numeric value + exists (host numpy)."""
+    col = _num_col(ctx, field)
+    d = ctx.seg.num_docs
+    if col is None:
+        return None, np.zeros(d, dtype=bool)
+    value = np.zeros(d, dtype=np.float64)
+    # doc_ids are grouped ascending: first occurrence = smallest value
+    docs, first_idx = np.unique(col.doc_ids, return_index=True)
+    value[docs] = col.values[first_idx]
+    return value, col.exists.copy()
+
+
+def _dense_first_ord(ctx: _Ctx, field: str):
+    col = ctx.seg.ordinal_dv.get(field)
+    d = ctx.seg.num_docs
+    if col is None:
+        return None, np.zeros(d, dtype=bool), []
+    ords = np.zeros(d, dtype=np.int64)
+    docs, first_idx = np.unique(col.doc_ids, return_index=True)
+    ords[docs] = col.ords[first_idx]
+    return ords, col.exists.copy(), list(col.dictionary)
+
+
+def _bucket_dense_plan(node: AggNode, ctx: _Ctx, doc_bucket: np.ndarray,
+                       card: int, render: dict) -> AggPlan:
+    padded = np.full(ctx.d_pad, -1, dtype=np.int32)
+    padded[:len(doc_bucket)] = doc_bucket
+    children = [_compile_node(c, ctx) for c in node.children]
+    return AggPlan(node.name, "bucket_dense", static=(card,),
+                   inputs={"doc_bucket": padded}, children=children,
+                   render=render)
+
+
+def _source_encoding(ctx: _Ctx, name: str, spec: dict):
+    """One composite/multi_terms source → (per-doc code, exists, keys)."""
+    stype, body = next(iter(spec.items())) if len(spec) == 1 \
+        else ("terms", spec)
+    field = body.get("field")
+    ocol = ctx.seg.ordinal_dv.get(field)
+    if ocol is not None:
+        ords, exists, keys = _dense_first_ord(ctx, field)
+        return ords, exists, keys
+    value, exists = _dense_first_value(ctx, field)
+    if value is None:
+        return None, exists, []
+    ft = ctx.mapper.get_field(field)
+    if stype == "histogram":
+        interval = float(body["interval"])
+        codes_raw = np.floor(value / interval) * interval
+    elif stype == "date_histogram":
+        iv = _interval_ms(body)
+        codes_raw = np.floor(value / iv) * iv
+    else:
+        codes_raw = value
+    uniq = np.unique(codes_raw[exists]) if exists.any() else np.array([])
+    code_of = {v: i for i, v in enumerate(uniq)}
+    codes = np.array([code_of.get(v, -1) for v in codes_raw], dtype=np.int64)
+    keys = [_render_numeric_key(v, ft) for v in uniq]
+    return codes, exists, keys
+
+
+def _c_composite(node: AggNode, ctx: _Ctx) -> AggPlan:
+    sources = node.body.get("sources")
+    if not sources:
+        raise ParsingError(f"[composite] aggregation [{node.name}] requires "
+                           f"[sources]")
+    source_specs = []
+    for s in sources:
+        if len(s) != 1:
+            raise ParsingError("[composite] source must have one name")
+        sname, sbody = next(iter(s.items()))
+        source_specs.append((sname, sbody))
+    d = ctx.seg.num_docs
+    combined = np.zeros(d, dtype=np.int64)
+    all_exist = np.ones(d, dtype=bool)
+    key_lists = []
+    names = []
+    for sname, sbody in source_specs:
+        codes, exists, keys = _source_encoding(ctx, sname, sbody)
+        names.append(sname)
+        key_lists.append(keys)
+        if codes is None or not keys:
+            all_exist[:] = False
+            combined[:] = -1
+            continue
+        combined = combined * len(keys) + np.where(exists, codes, 0)
+        all_exist &= exists
+    card = max(int(np.prod([max(len(k), 1) for k in key_lists])), 1)
+    doc_bucket = np.where(all_exist, combined, -1).astype(np.int32)
+    render = {"kind": node.type, "body": node.body, "sources": names,
+              "key_lists": key_lists}
+    return _bucket_dense_plan(node, ctx, doc_bucket, card, render)
+
+
+def _c_multi_terms(node: AggNode, ctx: _Ctx) -> AggPlan:
+    terms = node.body.get("terms")
+    if not terms or len(terms) < 2:
+        raise ParsingError(f"[multi_terms] aggregation [{node.name}] "
+                           f"requires at least 2 [terms]")
+    synthetic = AggNode(node.name, "multi_terms",
+                        {"sources": [{f"t{i}": {"terms": t}}
+                                     for i, t in enumerate(terms)],
+                         **node.body},
+                        children=node.children)
+    plan = _c_composite(synthetic, ctx)
+    plan.render["kind"] = "multi_terms"
+    return plan
+
+
+def _c_auto_date_histogram(node: AggNode, ctx: _Ctx) -> AggPlan:
+    """Pick the smallest calendar interval that keeps bucket count under
+    `buckets` (AutoDateHistogramAggregationBuilder.RoundingInfos)."""
+    target = int(node.body.get("buckets", 10))
+    col = _num_col(ctx, node.field)
+    if col is None or not len(col.unique):
+        return AggPlan(node.name, "empty",
+                       render={"kind": "auto_date_histogram", "keys": [],
+                               "body": node.body})
+    lo, hi = float(col.unique[0]), float(col.unique[-1])
+    candidates = [("1s", 1000), ("1m", 60_000), ("1h", 3_600_000),
+                  ("1d", 86_400_000), ("7d", 7 * 86_400_000),
+                  ("1M", 30 * 86_400_000), ("3M", 90 * 86_400_000),
+                  ("1y", 365 * 86_400_000)]
+    chosen_label, chosen_ms = candidates[-1]
+    for label, ms in candidates:
+        if (hi - lo) / ms + 1 <= target:
+            chosen_label, chosen_ms = label, ms
+            break
+    clone = AggNode(node.name, "date_histogram",
+                    {**node.body,
+                     "fixed_interval": f"{chosen_ms // 1000}s"},
+                    children=node.children)
+    plan = _c_date_histogram(clone, ctx)
+    plan.render["kind"] = "auto_date_histogram"
+    plan.render["interval"] = chosen_label
+    return plan
+
+
+def _c_significant_terms(node: AggNode, ctx: _Ctx) -> AggPlan:
+    """Foreground counts on device; background (index-wide) doc counts
+    gathered host-side at compile. Scores reduce with the JLH heuristic.
+    Exact for single-valued fields (subset size = Σ fg counts)."""
+    field = node.field
+    ocol = ctx.seg.ordinal_dv.get(field)
+    if ocol is None:
+        return AggPlan(node.name, "empty",
+                       render={"kind": "significant_terms", "keys": [],
+                               "body": node.body})
+    plan = _c_terms(node, ctx)
+    bg = np.zeros(len(ocol.dictionary), dtype=np.int64)
+    seen_pairs = set()
+    for doc, o in zip(ocol.doc_ids, ocol.ords):
+        if (doc, o) not in seen_pairs:
+            seen_pairs.add((doc, o))
+            bg[o] += 1
+    plan.render = {"kind": "significant_terms", "keys": list(ocol.dictionary),
+                   "body": node.body, "bg": bg.tolist(),
+                   "bg_total": int(ctx.seg.num_docs)}
+    return plan
+
+
+def _c_adjacency_matrix(node: AggNode, ctx: _Ctx) -> AggPlan:
+    filters = node.body.get("filters")
+    if not isinstance(filters, dict) or not filters:
+        raise ParsingError(f"[adjacency_matrix] aggregation [{node.name}] "
+                           f"requires [filters]")
+    names = sorted(filters)
+    children = []
+    for name in names:
+        qnode = dsl.parse_query(filters[name])
+        children.append(ctx.compiler.compile(qnode, ctx.seg, ctx.meta))
+    return AggPlan(node.name, "adjacency", static=(len(names),),
+                   query_plans=children,
+                   render={"kind": "adjacency_matrix", "names": names,
+                           "body": node.body})
+
+
+def _c_geo_bounds(node: AggNode, ctx: _Ctx) -> AggPlan:
+    return AggPlan(node.name, "geo_metric",
+                   static=(node.field,),
+                   render={"kind": node.type, "body": node.body})
+
+
+def _c_geohash_grid(node: AggNode, ctx: _Ctx) -> AggPlan:
+    precision = int(node.body.get("precision", 5))
+    lat, lat_exists = _dense_first_value(ctx, f"{node.field}.lat")
+    lon, _ = _dense_first_value(ctx, f"{node.field}.lon")
+    if lat is None or lon is None:
+        return AggPlan(node.name, "empty",
+                       render={"kind": "grid", "keys": [], "body": node.body})
+    if node.type == "geotile_grid":
+        keys_raw = [_geotile(la, lo, precision) if e else None
+                    for la, lo, e in zip(lat, lon, lat_exists)]
+    else:
+        keys_raw = [_geohash(la, lo, precision) if e else None
+                    for la, lo, e in zip(lat, lon, lat_exists)]
+    uniq = sorted({k for k in keys_raw if k is not None})
+    code_of = {k: i for i, k in enumerate(uniq)}
+    doc_bucket = np.array([code_of.get(k, -1) for k in keys_raw],
+                          dtype=np.int32)
+    return _bucket_dense_plan(node, ctx, doc_bucket, max(len(uniq), 1),
+                              render={"kind": "grid", "keys": uniq,
+                                      "body": node.body})
+
+
+_BASE32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+
+
+def _geohash(lat: float, lon: float, precision: int) -> str:
+    lat_lo, lat_hi = -90.0, 90.0
+    lon_lo, lon_hi = -180.0, 180.0
+    out = []
+    bit = 0
+    ch = 0
+    even = True
+    while len(out) < precision:
+        if even:
+            mid = (lon_lo + lon_hi) / 2
+            if lon >= mid:
+                ch = ch * 2 + 1
+                lon_lo = mid
+            else:
+                ch = ch * 2
+                lon_hi = mid
+        else:
+            mid = (lat_lo + lat_hi) / 2
+            if lat >= mid:
+                ch = ch * 2 + 1
+                lat_lo = mid
+            else:
+                ch = ch * 2
+                lat_hi = mid
+        even = not even
+        bit += 1
+        if bit == 5:
+            out.append(_BASE32[ch])
+            bit = 0
+            ch = 0
+    return "".join(out)
+
+
+def _geotile(lat: float, lon: float, zoom: int) -> str:
+    import math
+    n = 2 ** zoom
+    x = int((lon + 180.0) / 360.0 * n)
+    lat_r = math.radians(max(min(lat, 85.0511), -85.0511))
+    y = int((1.0 - math.log(math.tan(lat_r) + 1 / math.cos(lat_r))
+             / math.pi) / 2.0 * n)
+    return f"{zoom}/{min(x, n - 1)}/{min(y, n - 1)}"
+
+
+def _c_matrix_stats(node: AggNode, ctx: _Ctx) -> AggPlan:
+    fields = node.body.get("fields")
+    if not fields:
+        raise ParsingError(f"[matrix_stats] aggregation [{node.name}] "
+                           f"requires [fields]")
+    return AggPlan(node.name, "matrix_stats", static=(tuple(fields),),
+                   render={"kind": "matrix_stats", "fields": list(fields),
+                           "body": node.body})
+
+
 _COMPILERS = {
     "terms": _c_terms,
     "histogram": _c_histogram,
@@ -428,6 +726,16 @@ _COMPILERS = {
     "percentiles": _c_percentiles,
     "percentile_ranks": _c_percentiles,
     "weighted_avg": _c_weighted_avg,
+    "composite": _c_composite,
+    "multi_terms": _c_multi_terms,
+    "auto_date_histogram": _c_auto_date_histogram,
+    "significant_terms": _c_significant_terms,
+    "adjacency_matrix": _c_adjacency_matrix,
+    "geohash_grid": _c_geohash_grid,
+    "geotile_grid": _c_geohash_grid,
+    "geo_bounds": _c_geo_bounds,
+    "geo_centroid": _c_geo_bounds,
+    "matrix_stats": _c_matrix_stats,
 }
 
 
@@ -539,6 +847,115 @@ def _eval_agg(plan: AggPlan, seg: Dict, inputs: List[Dict], cursor: List[int],
         child_eff = jnp.where(own, parent_eff, -1)
         for c in plan.children:
             _eval_agg(c, seg, inputs, cursor, mask, child_eff, parent_card, outs)
+        return
+
+    if kind == "bucket_dense":
+        card, = plan.static
+        b = my["doc_bucket"]
+        own = mask & (parent_eff >= 0) & (b >= 0)
+        total = parent_card * card
+        parent = jnp.where(parent_eff >= 0, parent_eff, 0)
+        eff = jnp.where(own, parent * card + b, total)
+        counts = jnp.zeros(total, jnp.int32).at[eff].add(
+            own.astype(jnp.int32), mode="drop")
+        outs.append({"counts": counts})
+        child_eff = jnp.where(own, eff, -1)
+        for c in plan.children:
+            _eval_agg(c, seg, inputs, cursor, mask, child_eff, total, outs)
+        return
+
+    if kind == "adjacency":
+        n_filters, = plan.static
+        masks = []
+        for qp in plan.query_plans:
+            _, m = _eval_plan(qp, seg, inputs, cursor)
+            masks.append(m & mask & (parent_eff >= 0))
+        parent = jnp.where(parent_eff >= 0, parent_eff, 0)
+        out: Dict[str, Any] = {}
+        for i in range(n_filters):
+            for j in range(i, n_filters):
+                own = masks[i] & masks[j]
+                eff = jnp.where(own, parent, parent_card)
+                out[f"c_{i}_{j}"] = jnp.zeros(
+                    parent_card, jnp.int32).at[eff].add(
+                    own.astype(jnp.int32), mode="drop")
+        outs.append(out)
+        return
+
+    if kind == "matrix_stats":
+        from opensearch_tpu.search.plan_eval import dense_numeric
+        fields = plan.static[0]
+        dense = {}
+        for f in fields:
+            if f in seg["numeric"]:
+                dense[f] = dense_numeric(seg, f, d_pad)
+        out = {}
+        parent = jnp.where(parent_eff >= 0, parent_eff, 0)
+        for f in fields:
+            if f not in dense:
+                continue
+            v, exists, _ = dense[f]
+            own = mask & (parent_eff >= 0) & exists
+            eff = jnp.where(own, parent, parent_card)
+            zeros = lambda: jnp.zeros(parent_card, jnp.float32)  # noqa: E731
+            vv = jnp.where(own, v, 0.0)
+            out[f"{f}::cnt"] = jnp.zeros(parent_card, jnp.int32).at[eff].add(
+                own.astype(jnp.int32), mode="drop")
+            out[f"{f}::sum"] = zeros().at[eff].add(vv, mode="drop")
+            out[f"{f}::sum2"] = zeros().at[eff].add(vv * vv, mode="drop")
+            out[f"{f}::sum3"] = zeros().at[eff].add(vv ** 3, mode="drop")
+            out[f"{f}::sum4"] = zeros().at[eff].add(vv ** 4, mode="drop")
+        for i, fa in enumerate(fields):
+            for fb in fields[i + 1:]:
+                if fa not in dense or fb not in dense:
+                    continue
+                va, ea, _ = dense[fa]
+                vb, eb, _ = dense[fb]
+                own = mask & (parent_eff >= 0) & ea & eb
+                eff = jnp.where(own, parent, parent_card)
+                out[f"{fa}*{fb}::sumxy"] = jnp.zeros(
+                    parent_card, jnp.float32).at[eff].add(
+                    jnp.where(own, va * vb, 0.0), mode="drop")
+                out[f"{fa}*{fb}::cnt"] = jnp.zeros(
+                    parent_card, jnp.int32).at[eff].add(
+                    own.astype(jnp.int32), mode="drop")
+                out[f"{fa}*{fb}::sumx"] = jnp.zeros(
+                    parent_card, jnp.float32).at[eff].add(
+                    jnp.where(own, va, 0.0), mode="drop")
+                out[f"{fa}*{fb}::sumy"] = jnp.zeros(
+                    parent_card, jnp.float32).at[eff].add(
+                    jnp.where(own, vb, 0.0), mode="drop")
+        outs.append(out)
+        return
+
+    if kind == "geo_metric":
+        from opensearch_tpu.search.plan_eval import dense_numeric
+        field = plan.static[0]
+        lat_key, lon_key = f"{field}.lat", f"{field}.lon"
+        if lat_key not in seg["numeric"]:
+            outs.append({})
+            return
+        lat, exists, _ = dense_numeric(seg, lat_key, d_pad)
+        lon, _, _ = dense_numeric(seg, lon_key, d_pad)
+        own = mask & (parent_eff >= 0) & exists
+        parent = jnp.where(parent_eff >= 0, parent_eff, 0)
+        eff = jnp.where(own, parent, parent_card)
+        outs.append({
+            "cnt": jnp.zeros(parent_card, jnp.int32).at[eff].add(
+                own.astype(jnp.int32), mode="drop"),
+            "sum_lat": jnp.zeros(parent_card, jnp.float32).at[eff].add(
+                jnp.where(own, lat, 0.0), mode="drop"),
+            "sum_lon": jnp.zeros(parent_card, jnp.float32).at[eff].add(
+                jnp.where(own, lon, 0.0), mode="drop"),
+            "min_lat": jnp.full(parent_card, POS_INF, jnp.float32)
+                .at[eff].min(jnp.where(own, lat, POS_INF), mode="drop"),
+            "max_lat": jnp.full(parent_card, NEG_INF, jnp.float32)
+                .at[eff].max(jnp.where(own, lat, NEG_INF), mode="drop"),
+            "min_lon": jnp.full(parent_card, POS_INF, jnp.float32)
+                .at[eff].min(jnp.where(own, lon, POS_INF), mode="drop"),
+            "max_lon": jnp.full(parent_card, NEG_INF, jnp.float32)
+                .at[eff].max(jnp.where(own, lon, NEG_INF), mode="drop"),
+        })
         return
 
     if kind == "metric_num":

@@ -13,11 +13,15 @@ from typing import Any, Dict, List, Optional
 from opensearch_tpu.common.errors import ParsingError
 
 BUCKET_TYPES = {"terms", "histogram", "date_histogram", "range", "date_range",
-                "filter", "filters", "global", "missing", "ip_range"}
+                "filter", "filters", "global", "missing", "ip_range",
+                "composite", "multi_terms", "significant_terms",
+                "auto_date_histogram", "adjacency_matrix", "geohash_grid",
+                "geotile_grid"}
 METRIC_TYPES = {"min", "max", "sum", "avg", "value_count", "stats",
                 "extended_stats", "cardinality", "percentiles",
                 "percentile_ranks", "weighted_avg", "median_absolute_deviation",
-                "top_hits", "geo_centroid", "scripted_metric"}
+                "top_hits", "geo_centroid", "scripted_metric", "matrix_stats",
+                "geo_bounds"}
 PIPELINE_TYPES = {"derivative", "cumulative_sum", "bucket_script",
                   "bucket_selector", "bucket_sort", "avg_bucket", "max_bucket",
                   "min_bucket", "sum_bucket", "stats_bucket",

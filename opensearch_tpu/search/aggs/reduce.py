@@ -73,7 +73,27 @@ def _merge_node(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
         rkind = render.get("kind", "terms")
         if rkind == "terms":
             return _merge_terms(entries)
-        return _merge_histogram(entries)
+        if rkind == "significant_terms":
+            return _merge_significant_terms(entries)
+        out = _merge_histogram(entries)
+        if rkind == "auto_date_histogram":
+            out["interval"] = render.get("interval")
+        return out
+
+    if kind == "bucket_dense":
+        rkind = render.get("kind")
+        if rkind in ("composite", "multi_terms"):
+            return _merge_composite(entries, multi=rkind == "multi_terms")
+        return _merge_grid(entries)
+
+    if kind == "adjacency":
+        return _merge_adjacency(entries)
+
+    if kind == "matrix_stats":
+        return _merge_matrix_stats(entries)
+
+    if kind == "geo_metric":
+        return _merge_geo(entries)
 
     if kind == "multi":
         rkind = render.get("kind")
@@ -472,3 +492,250 @@ def _merge_value_hist(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
         order = np.argsort(dev)
         return {"value": percentile_from_counts(dev[order], counts[order], 50.0)}
     raise IllegalArgumentError(f"unknown value-hist agg [{kind}]")
+
+
+# ------------------------------------------------- extended bucket mergers
+
+def _merge_composite(entries: List[Tuple[Decoded, int]],
+                     multi: bool) -> Dict[str, Any]:
+    """Composite (paginated multi-source tuples) and multi_terms share the
+    mixed-radix bucket layout; they differ only in rendering/sort/paging."""
+    plan = entries[0][0].plan
+    render = plan.render
+    sources = render["sources"]
+    merged: Dict[tuple, Tuple[int, List[Tuple[Decoded, int, int]]]] = {}
+    for d, p in entries:
+        counts = d.out.get("counts")
+        if counts is None:
+            continue
+        # key lists are PER SEGMENT (each segment has its own dictionary)
+        key_lists = d.plan.render["key_lists"]
+        radices = [max(len(k), 1) for k in key_lists]
+        card = int(np.prod(radices))
+        base = p * card
+        nz = np.nonzero(np.asarray(counts[base:base + card]))[0]
+        for flat in nz:
+            rest = int(flat)
+            digits = []
+            for r in reversed(radices):
+                digits.append(rest % r)
+                rest //= r
+            digits.reverse()
+            key = tuple(key_lists[i][digit]
+                        for i, digit in enumerate(digits))
+            cnt, members = merged.setdefault(key, (0, []))
+            merged[key] = (cnt + int(counts[base + flat]),
+                           members + [(d, p, int(flat))])
+    body = render.get("body", {})
+    size = int(body.get("size", 10))
+    if multi:
+        items = sorted(merged.items(), key=lambda kv: (-kv[1][0], kv[0]))
+        buckets = []
+        for key, (cnt, members) in items[:size]:
+            b = {"key": list(key),
+                 "key_as_string": "|".join(str(k) for k in key),
+                 "doc_count": cnt}
+            b.update(_merge_composite_children(plan, members))
+            buckets.append(b)
+        return {"doc_count_error_upper_bound": 0, "sum_other_doc_count":
+                sum(c for _, (c, _) in items[size:]),
+                "buckets": buckets}
+    # composite: key-ordered pagination with after_key
+    after = body.get("after")
+    items = sorted(merged.items(), key=lambda kv: _tuple_sort_key(kv[0]))
+    if after is not None:
+        after_tuple = tuple(after[s] for s in sources)
+        items = [kv for kv in items
+                 if _tuple_sort_key(kv[0]) > _tuple_sort_key(after_tuple)]
+    page = items[:size]
+    buckets = []
+    for key, (cnt, members) in page:
+        b = {"key": dict(zip(sources, key)), "doc_count": cnt}
+        b.update(_merge_composite_children(plan, members))
+        buckets.append(b)
+    out: Dict[str, Any] = {"buckets": buckets}
+    if page:
+        out["after_key"] = dict(zip(sources, page[-1][0]))
+    return out
+
+
+def _tuple_sort_key(key: tuple):
+    return tuple((0, v) if isinstance(v, (int, float, bool))
+                 else (1, str(v)) for v in key)
+
+
+def _merge_composite_children(plan, members) -> Dict[str, Any]:
+    if not plan.children:
+        return {}
+    out: Dict[str, Any] = {}
+    for j, child in enumerate(plan.children):
+        child_entries = []
+        for d, p, flat in members:
+            total_card = int(np.prod([max(len(k), 1)
+                                      for k in d.plan.render["key_lists"]]))
+            child_entries.append((d.children[j], p * total_card + flat))
+        out[child.name] = _merge_node(child_entries)
+    return out
+
+
+def _merge_grid(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
+    plan = entries[0][0].plan
+    body = plan.render.get("body", {})
+    totals: Dict[str, int] = {}
+    for d, p in entries:
+        counts = d.out.get("counts")
+        if counts is None:
+            continue
+        keys = d.plan.render.get("keys", [])  # per-segment key table
+        card = max(len(keys), 1)
+        base = p * card
+        arr = np.asarray(counts[base:base + card])
+        for i in np.nonzero(arr)[0]:
+            if i < len(keys):
+                totals[keys[i]] = totals.get(keys[i], 0) + int(arr[i])
+    size = int(body.get("size", 10000))
+    buckets = sorted(totals.items(), key=lambda kv: (-kv[1], kv[0]))[:size]
+    return {"buckets": [{"key": k, "doc_count": c} for k, c in buckets]}
+
+
+def _merge_significant_terms(entries: List[Tuple[Decoded, int]]
+                             ) -> Dict[str, Any]:
+    """JLH significance scoring (reference default heuristic:
+    (fg% - bg%) * (fg% / bg%))."""
+    plan = entries[0][0].plan
+    # fg and bg accumulate by KEY across segments (per-segment dictionaries)
+    fg_by_key: Dict[Any, int] = {}
+    bg_by_key: Dict[Any, int] = {}
+    bg_total = 0
+    for d, p in entries:
+        keys = d.plan.render.get("keys", [])
+        bg = d.plan.render.get("bg", [])
+        bg_total += max(d.plan.render.get("bg_total", 0), 0)
+        card = max(len(keys), 1)
+        counts = d.out.get("counts")
+        for i, key in enumerate(keys):
+            bg_by_key[key] = bg_by_key.get(key, 0) +                 (int(bg[i]) if i < len(bg) else 0)
+            if counts is not None:
+                fg_by_key[key] = fg_by_key.get(key, 0) +                     int(counts[p * card + i])
+    bg_total = max(bg_total, 1)
+    subset_size = max(sum(fg_by_key.values()), 1)
+    body = plan.render.get("body", {})
+    min_doc_count = int(body.get("min_doc_count", 3))
+    size = int(body.get("size", 10))
+    scored = []
+    for key, fg_count in fg_by_key.items():
+        if fg_count < min_doc_count:
+            continue
+        fg_pct = fg_count / subset_size
+        bg_pct = max(bg_by_key.get(key, 0), 1) / bg_total
+        if fg_pct <= bg_pct:
+            continue
+        score = (fg_pct - bg_pct) * (fg_pct / bg_pct)
+        scored.append({"key": key, "doc_count": int(fg_count),
+                       "score": float(score),
+                       "bg_count": int(bg_by_key.get(key, 0))})
+    scored.sort(key=lambda b: -b["score"])
+    return {"doc_count": subset_size, "bg_count": bg_total,
+            "buckets": scored[:size]}
+
+
+def _merge_adjacency(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
+    plan = entries[0][0].plan
+    names = plan.render["names"]
+    totals: Dict[str, int] = {}
+    for d, p in entries:
+        for i in range(len(names)):
+            for j in range(i, len(names)):
+                arr = d.out.get(f"c_{i}_{j}")
+                if arr is None:
+                    continue
+                key = names[i] if i == j else f"{names[i]}&{names[j]}"
+                totals[key] = totals.get(key, 0) + int(arr[p])
+    buckets = [{"key": k, "doc_count": c}
+               for k, c in sorted(totals.items()) if c > 0]
+    return {"buckets": buckets}
+
+
+def _merge_matrix_stats(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
+    plan = entries[0][0].plan
+    fields = plan.render["fields"]
+
+    def total(key):
+        return sum(float(d.out[key][p]) for d, p in entries
+                   if key in d.out)
+
+    out_fields = []
+    moments = {}
+    for f in fields:
+        cnt = int(total(f"{f}::cnt"))
+        if cnt == 0:
+            continue
+        s1 = total(f"{f}::sum")
+        s2 = total(f"{f}::sum2")
+        s3 = total(f"{f}::sum3")
+        s4 = total(f"{f}::sum4")
+        mean = s1 / cnt
+        var = max(s2 / cnt - mean ** 2, 0.0)
+        std = var ** 0.5
+        # central moments from raw moments
+        m3 = s3 / cnt - 3 * mean * s2 / cnt + 2 * mean ** 3
+        m4 = (s4 / cnt - 4 * mean * s3 / cnt + 6 * mean ** 2 * s2 / cnt
+              - 3 * mean ** 4)
+        moments[f] = (cnt, mean, var)
+        entry = {
+            "name": f, "count": cnt, "mean": mean,
+            "variance": var * cnt / max(cnt - 1, 1),  # sample variance
+            "skewness": (m3 / std ** 3) if std > 0 else 0.0,
+            "kurtosis": (m4 / var ** 2) if var > 0 else 0.0,
+            "covariance": {}, "correlation": {},
+        }
+        out_fields.append(entry)
+    by_name = {e["name"]: e for e in out_fields}
+    for i, fa in enumerate(fields):
+        for fb in fields[i + 1:]:
+            key = f"{fa}*{fb}"
+            if fa not in by_name or fb not in by_name:
+                continue
+            n = int(total(f"{key}::cnt"))
+            if n == 0:
+                continue
+            sxy = total(f"{key}::sumxy")
+            sx = total(f"{key}::sumx")
+            sy = total(f"{key}::sumy")
+            cov = sxy / n - (sx / n) * (sy / n)
+            cov_sample = cov * n / max(n - 1, 1)
+            _, _, var_a = moments[fa]
+            _, _, var_b = moments[fb]
+            corr = cov / ((var_a ** 0.5) * (var_b ** 0.5)) \
+                if var_a > 0 and var_b > 0 else 0.0
+            for a, b in ((fa, fb), (fb, fa)):
+                by_name[a]["covariance"][b] = cov_sample
+                by_name[a]["correlation"][b] = corr
+    for e in out_fields:
+        e["covariance"][e["name"]] = e["variance"]
+        e["correlation"][e["name"]] = 1.0
+    return {"doc_count": max((e["count"] for e in out_fields), default=0),
+            "fields": out_fields}
+
+
+def _merge_geo(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
+    plan = entries[0][0].plan
+    kind = plan.render.get("kind", "geo_bounds")
+    cnt = sum(int(d.out["cnt"][p]) for d, p in entries if "cnt" in d.out)
+    if cnt == 0:
+        return {"doc_count": 0} if kind == "geo_centroid" else {}
+    if kind == "geo_centroid":
+        sum_lat = sum(float(d.out["sum_lat"][p]) for d, p in entries
+                      if "sum_lat" in d.out)
+        sum_lon = sum(float(d.out["sum_lon"][p]) for d, p in entries
+                      if "sum_lon" in d.out)
+        return {"location": {"lat": sum_lat / cnt, "lon": sum_lon / cnt},
+                "count": cnt}
+    agg = lambda key, fn, init: fn(  # noqa: E731
+        [float(d.out[key][p]) for d, p in entries if key in d.out] or [init])
+    return {"bounds": {
+        "top_left": {"lat": agg("max_lat", max, 0.0),
+                     "lon": agg("min_lon", min, 0.0)},
+        "bottom_right": {"lat": agg("min_lat", min, 0.0),
+                         "lon": agg("max_lon", max, 0.0)},
+    }}

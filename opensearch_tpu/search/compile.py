@@ -548,6 +548,264 @@ class Compiler:
                     static=(node.script_source, pkeys, static_params),
                     inputs=inputs, children=[child])
 
+    def _c_FunctionScoreQuery(self, node: dsl.FunctionScoreQuery, seg,
+                              meta) -> Plan:
+        child = self.compile(node.query, seg, meta)
+        children = [child]
+        fn_specs = []
+        inputs: Dict[str, np.ndarray] = {"boost": _f32(node.boost),
+                                         "max_boost": _f32(node.max_boost)}
+        if node.min_score is not None:
+            inputs["min_score"] = _f32(node.min_score)
+        for i, fn in enumerate(node.functions):
+            has_filter = fn.get("filter") is not None
+            if has_filter:
+                children.append(self.compile(fn["filter"], seg, meta))
+            if "weight" in fn:
+                inputs[f"f{i}_weight"] = _f32(fn["weight"])
+            if "field_value_factor" in fn:
+                fvf = fn["field_value_factor"]
+                field = fvf.get("field")
+                if field not in seg.numeric_dv and \
+                        self.mapper.get_field(field) is None:
+                    raise QueryShardError(
+                        f"Unable to find a field mapper for field [{field}]")
+                fn_specs.append(("fvf",
+                                 field if field in seg.numeric_dv else None,
+                                 str(fvf.get("modifier", "none")).lower(),
+                                 has_filter))
+                inputs[f"f{i}_factor"] = _f32(fvf.get("factor", 1.0))
+                inputs[f"f{i}_missing"] = _f32(fvf.get("missing", 1.0))
+            elif "random_score" in fn:
+                seed = (fn["random_score"] or {}).get("seed", 42)
+                fn_specs.append(("random", int(seed) & 0xFFFFFFFF,
+                                 has_filter))
+            elif "script_score" in fn:
+                from opensearch_tpu.script.painless import (
+                    compile_score_script)
+                spec = fn["script_score"].get("script", {})
+                if isinstance(spec, str):
+                    spec = {"source": spec}
+                source = spec.get("source", "")
+                compile_score_script(source)  # validate early
+                params = spec.get("params") or {}
+                num_params = {k: v for k, v in params.items()
+                              if isinstance(v, (int, float))
+                              and not isinstance(v, bool)}
+                pkeys = tuple(sorted(num_params))
+                static_params = tuple(sorted(
+                    (k, v) for k, v in params.items() if k not in num_params))
+                for k in pkeys:
+                    inputs[f"f{i}_p_{k}"] = _f32(num_params[k])
+                fn_specs.append(("script", source, pkeys, static_params,
+                                 has_filter))
+            elif any(k in fn for k in ("gauss", "exp", "linear")):
+                decay_kind = next(k for k in ("gauss", "exp", "linear")
+                                  if k in fn)
+                decay_body = fn[decay_kind]
+                if len([k for k in decay_body]) != 1:
+                    raise QueryShardError(
+                        f"[{decay_kind}] must have exactly one field")
+                field, spec = next(iter(decay_body.items()))
+                ft = self.mapper.get_field(field)
+                origin = spec.get("origin")
+                scale = spec.get("scale")
+                if ft is not None and ft.is_date:
+                    from opensearch_tpu.index.mapper import parse_date_millis
+                    origin_v = float(parse_date_millis(origin)) \
+                        if origin is not None else 0.0
+                    from opensearch_tpu.common.settings import (
+                        parse_time_value)
+                    scale_v = parse_time_value(scale, "scale") * 1000.0
+                    offset_v = parse_time_value(
+                        spec.get("offset", 0), "offset") * 1000.0
+                else:
+                    origin_v = float(origin)
+                    scale_v = float(scale)
+                    offset_v = float(spec.get("offset", 0.0))
+                fn_specs.append(("decay", decay_kind,
+                                 field if field in seg.numeric_dv else None,
+                                 has_filter))
+                inputs[f"f{i}_origin"] = _f32(origin_v)
+                inputs[f"f{i}_scale"] = _f32(scale_v)
+                inputs[f"f{i}_offset"] = _f32(offset_v)
+                inputs[f"f{i}_decay"] = _f32(spec.get("decay", 0.5))
+            elif "weight" in fn:
+                fn_specs.append(("weight_only", has_filter))
+            else:
+                fn_specs.append(("weight_only", has_filter))
+                inputs.setdefault(f"f{i}_weight", _f32(1.0))
+        return Plan("function_score",
+                    static=(node.score_mode, node.boost_mode,
+                            tuple(fn_specs)),
+                    inputs=inputs, children=children)
+
+    def _c_MatchPhrasePrefixQuery(self, node, seg, meta) -> Plan:
+        """Expand the trailing prefix against the segment vocabulary and
+        compile a dis_max of full phrases (MatchPhrasePrefixQuery's
+        MultiPhraseQuery analog)."""
+        ft = self.mapper.get_field(node.field)
+        if ft is None or not ft.is_text:
+            return MATCH_NONE
+        terms = self._analyze_query_terms(ft, node.query, node.analyzer)
+        if not terms:
+            return MATCH_NONE
+        prefix = terms[-1]
+        expansions = sorted(
+            t for t in seg.terms_for_field(node.field)
+            if t.startswith(prefix))[:node.max_expansions]
+        if not expansions:
+            return MATCH_NONE
+        phrases = [dsl.MatchPhraseQuery(field=node.field,
+                                        query=" ".join(terms[:-1] + [t]),
+                                        slop=node.slop,
+                                        analyzer=node.analyzer)
+                   for t in expansions]
+        return self.compile(dsl.DisMaxQuery(queries=phrases,
+                                            boost=node.boost), seg, meta)
+
+    def _c_TermsSetQuery(self, node: dsl.TermsSetQuery, seg, meta) -> Plan:
+        children = [self.compile(
+            dsl.TermQuery(field=node.field, value=v), seg, meta)
+            for v in node.terms]
+        msm_field = node.minimum_should_match_field
+        if msm_field is not None:
+            if msm_field not in seg.numeric_dv:
+                if self.mapper.get_field(msm_field) is None:
+                    raise QueryShardError(
+                        f"Unable to find a field mapper for field "
+                        f"[{msm_field}]")
+                return MATCH_NONE  # no doc in this segment has the field
+        inputs = {"boost": _f32(node.boost)}
+        if msm_field is None:
+            script = node.minimum_should_match_script
+            if script is not None:
+                # evaluate num_terms scripts host-side with params.num_terms
+                from opensearch_tpu.script.painless import HostEvaluator, parse
+                out = HostEvaluator({"params": {
+                    "num_terms": len(node.terms)}}).run(
+                        parse(script.get("source", "")))
+                inputs["msm"] = _i32(int(out))
+            else:
+                inputs["msm"] = _i32(len(node.terms))
+        return Plan("terms_set", static=(msm_field,), inputs=inputs,
+                    children=children)
+
+    def _c_MoreLikeThisQuery(self, node: dsl.MoreLikeThisQuery, seg,
+                             meta) -> Plan:
+        """Select the highest-TFIDF terms from the `like` inputs, compile a
+        should-of-terms bool (MoreLikeThisQuery → XMoreLikeThis term
+        selection)."""
+        fields = list(node.fields)
+        if not fields:
+            fields = [f for f, ft in self.mapper.field_types.items()
+                      if ft.is_text]
+        texts: List[Tuple[str, str]] = []  # (field, text)
+        for text in node.like_texts:
+            for f in fields:
+                texts.append((f, text))
+        for doc_spec in node.like_docs:
+            doc = doc_spec.get("doc")
+            if doc is None and "_id" in doc_spec:
+                # like an existing doc: pull its source from the segment
+                ord_ = seg.ord_of(str(doc_spec["_id"]))
+                doc = seg.sources[ord_] if ord_ is not None else None
+            for f in fields:
+                value = (doc or {}).get(f)
+                if value is not None:
+                    texts.append((f, str(value)))
+        tf: Dict[Tuple[str, str], int] = {}
+        for f, text in texts:
+            ft = self.mapper.get_field(f)
+            if ft is None or not ft.is_text:
+                continue
+            analyzer = self.mapper.analysis.get(ft.search_analyzer
+                                                or ft.analyzer)
+            for term, _pos in analyzer.analyze(text):
+                tf[(f, term)] = tf.get((f, term), 0) + 1
+        scored = []
+        for (f, term), freq in tf.items():
+            if freq < node.min_term_freq:
+                continue
+            df = self.stats.df(f, term)
+            if df < node.min_doc_freq:
+                continue
+            scored.append((freq * self.stats.idf(f, term), f, term))
+        scored.sort(reverse=True)
+        top = scored[:node.max_query_terms]
+        if not top:
+            return MATCH_NONE
+        shoulds = [dsl.TermQuery(field=f, value=t) for _, f, t in top]
+        return self.compile(
+            dsl.BoolQuery(should=shoulds,
+                          minimum_should_match=node.minimum_should_match,
+                          boost=node.boost), seg, meta)
+
+    def _c_DistanceFeatureQuery(self, node: dsl.DistanceFeatureQuery, seg,
+                                meta) -> Plan:
+        ft = self.mapper.get_field(node.field)
+        if ft is None:
+            raise QueryShardError(
+                f"Can't load fielddata on [{node.field}] because the field "
+                f"does not exist")
+        if node.field not in seg.numeric_dv:
+            return MATCH_NONE
+        if ft.is_date:
+            from opensearch_tpu.index.mapper import parse_date_millis
+            origin = float(parse_date_millis(node.origin))
+            from opensearch_tpu.common.settings import parse_time_value
+            pivot = parse_time_value(node.pivot, "pivot") * 1000.0
+        else:
+            origin = float(node.origin)
+            pivot = float(node.pivot)
+        return Plan("distance_feature", static=(node.field,),
+                    inputs={"origin": _f32(origin), "pivot": _f32(pivot),
+                            "boost": _f32(node.boost)})
+
+    def _c_RankFeatureQuery(self, node: dsl.RankFeatureQuery, seg,
+                            meta) -> Plan:
+        if node.field not in seg.numeric_dv:
+            return MATCH_NONE
+        pivot = node.pivot
+        if pivot is None:
+            col = seg.numeric_dv.get(node.field)
+            # default pivot ≈ the field's mean value (the reference computes
+            # a per-index default from the feature distribution)
+            pivot = float(np.mean(col.values)) if col is not None \
+                and len(col.values) else 1.0
+        return Plan("rank_feature", static=(node.field, node.function),
+                    inputs={"pivot": _f32(max(pivot, 1e-9)),
+                            "scaling_factor": _f32(node.scaling_factor),
+                            "exponent": _f32(node.exponent),
+                            "boost": _f32(node.boost)})
+
+    def _c_GeoDistanceQuery(self, node: dsl.GeoDistanceQuery, seg,
+                            meta) -> Plan:
+        self._require_geo(node.field)
+        if f"{node.field}.lat" not in seg.numeric_dv:
+            return MATCH_NONE
+        return Plan("geo_distance", static=(node.field,),
+                    inputs={"lat": _f32(node.lat), "lon": _f32(node.lon),
+                            "dist": _f32(node.distance_m),
+                            "boost": _f32(node.boost)})
+
+    def _c_GeoBoundingBoxQuery(self, node: dsl.GeoBoundingBoxQuery, seg,
+                               meta) -> Plan:
+        self._require_geo(node.field)
+        if f"{node.field}.lat" not in seg.numeric_dv:
+            return MATCH_NONE
+        return Plan("geo_bbox", static=(node.field,),
+                    inputs={"top": _f32(node.top), "left": _f32(node.left),
+                            "bottom": _f32(node.bottom),
+                            "right": _f32(node.right),
+                            "boost": _f32(node.boost)})
+
+    def _require_geo(self, field: str):
+        ft = self.mapper.get_field(field)
+        if ft is None or ft.type != "geo_point":
+            raise QueryShardError(
+                f"failed to find geo_point field [{field}]")
+
     # ------------------------------------------------- query_string family
     def _c_QueryStringQuery(self, node: dsl.QueryStringQuery, seg, meta) -> Plan:
         parsed = _parse_query_string(node.query, node.default_field or "*",

@@ -9,8 +9,10 @@ types. Compilation to device plans lives in search/compile.py.
 
 from __future__ import annotations
 
+import re
+
 from dataclasses import dataclass, field as dc_field
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from opensearch_tpu.common.errors import ParsingError
 
@@ -191,6 +193,97 @@ class ScriptScoreQuery(QueryNode):
 class PercolateQuery(QueryNode):
     field: str = ""
     documents: List[dict] = dc_field(default_factory=list)
+
+
+@dataclass
+class FunctionScoreQuery(QueryNode):
+    query: Optional[QueryNode] = None
+    functions: List[dict] = dc_field(default_factory=list)
+    score_mode: str = "multiply"     # multiply|sum|avg|first|max|min
+    boost_mode: str = "multiply"     # multiply|replace|sum|avg|max|min
+    max_boost: float = 3.4e38
+    min_score: Optional[float] = None
+
+
+@dataclass
+class MatchPhrasePrefixQuery(QueryNode):
+    field: str = ""
+    query: Any = None
+    slop: int = 0
+    max_expansions: int = 50
+    analyzer: Optional[str] = None
+
+
+@dataclass
+class TermsSetQuery(QueryNode):
+    field: str = ""
+    terms: List[Any] = dc_field(default_factory=list)
+    minimum_should_match_field: Optional[str] = None
+    minimum_should_match_script: Optional[dict] = None
+
+
+@dataclass
+class MoreLikeThisQuery(QueryNode):
+    fields: Tuple[str, ...] = ()
+    like_texts: List[str] = dc_field(default_factory=list)
+    like_docs: List[dict] = dc_field(default_factory=list)
+    max_query_terms: int = 25
+    min_term_freq: int = 2
+    min_doc_freq: int = 5
+    minimum_should_match: Any = "30%"
+
+
+@dataclass
+class DistanceFeatureQuery(QueryNode):
+    field: str = ""
+    origin: Any = None
+    pivot: Any = None
+
+
+@dataclass
+class RankFeatureQuery(QueryNode):
+    field: str = ""
+    function: str = "saturation"     # saturation|log|sigmoid|linear
+    pivot: Optional[float] = None
+    scaling_factor: float = 1.0
+    exponent: float = 1.0
+
+
+@dataclass
+class GeoDistanceQuery(QueryNode):
+    field: str = ""
+    lat: float = 0.0
+    lon: float = 0.0
+    distance_m: float = 0.0
+
+
+@dataclass
+class GeoBoundingBoxQuery(QueryNode):
+    field: str = ""
+    top: float = 90.0
+    left: float = -180.0
+    bottom: float = -90.0
+    right: float = 180.0
+
+
+_DISTANCE_UNITS_M = {
+    "mm": 0.001, "cm": 0.01, "m": 1.0, "km": 1000.0, "mi": 1609.344,
+    "miles": 1609.344, "yd": 0.9144, "ft": 0.3048, "in": 0.0254,
+    "nm": 1852.0, "nmi": 1852.0, "nauticalmiles": 1852.0,
+}
+
+
+def parse_distance(value: Any) -> float:
+    """'12km' / '500m' / bare meters → meters (common/unit/DistanceUnit)."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    m = re.fullmatch(r"\s*([\d.]+)\s*([a-zA-Z]*)\s*", str(value))
+    if not m:
+        raise ParsingError(f"failed to parse distance [{value}]")
+    unit = m.group(2).lower() or "m"
+    if unit not in _DISTANCE_UNITS_M:
+        raise ParsingError(f"unknown distance unit [{unit}]")
+    return float(m.group(1)) * _DISTANCE_UNITS_M[unit]
 
 
 @dataclass
@@ -386,6 +479,127 @@ def parse_query(q: Any) -> QueryNode:
                         filter=parse_query(spec["filter"]) if "filter" in spec else None,
                         nprobe=int(mp.get("nprobes", mp.get("nprobe", 0))),
                         boost=float(spec.get("boost", 1.0)))
+
+    if name == "function_score":
+        functions = body.get("functions")
+        if functions is None:
+            # single-function short form
+            functions = [{k: v for k, v in body.items()
+                          if k in ("weight", "field_value_factor",
+                                   "script_score", "random_score", "gauss",
+                                   "exp", "linear", "filter")}]
+        parsed_fns = []
+        for fn in functions:
+            fn = dict(fn)
+            if "filter" in fn:
+                fn["filter"] = parse_query(fn["filter"])
+            parsed_fns.append(fn)
+        return FunctionScoreQuery(
+            query=parse_query(body.get("query")),
+            functions=parsed_fns,
+            score_mode=str(body.get("score_mode", "multiply")).lower(),
+            boost_mode=str(body.get("boost_mode", "multiply")).lower(),
+            max_boost=float(body.get("max_boost", 3.4e38)),
+            min_score=(float(body["min_score"])
+                       if body.get("min_score") is not None else None),
+            boost=float(body.get("boost", 1.0)))
+
+    if name == "match_phrase_prefix":
+        field, spec = _field_body(body, "match_phrase_prefix")
+        if not isinstance(spec, dict):
+            spec = {"query": spec}
+        return MatchPhrasePrefixQuery(
+            field=field, query=spec.get("query"),
+            slop=int(spec.get("slop", 0)),
+            max_expansions=int(spec.get("max_expansions", 50)),
+            analyzer=spec.get("analyzer"),
+            boost=float(spec.get("boost", 1.0)))
+
+    if name == "terms_set":
+        field, spec = _field_body(body, "terms_set")
+        if not isinstance(spec, dict) or "terms" not in spec:
+            raise ParsingError("[terms_set] requires a [terms] array")
+        return TermsSetQuery(
+            field=field, terms=list(spec["terms"]),
+            minimum_should_match_field=spec.get(
+                "minimum_should_match_field"),
+            minimum_should_match_script=spec.get(
+                "minimum_should_match_script"),
+            boost=float(spec.get("boost", 1.0)))
+
+    if name == "more_like_this":
+        like = body.get("like", [])
+        if not isinstance(like, list):
+            like = [like]
+        texts = [l for l in like if isinstance(l, str)]
+        docs = [l for l in like if isinstance(l, dict)]
+        return MoreLikeThisQuery(
+            fields=tuple(body.get("fields", [])),
+            like_texts=texts, like_docs=docs,
+            max_query_terms=int(body.get("max_query_terms", 25)),
+            min_term_freq=int(body.get("min_term_freq", 2)),
+            min_doc_freq=int(body.get("min_doc_freq", 5)),
+            minimum_should_match=body.get("minimum_should_match", "30%"),
+            boost=float(body.get("boost", 1.0)))
+
+    if name == "distance_feature":
+        if "field" not in body or "origin" not in body \
+                or "pivot" not in body:
+            raise ParsingError("[distance_feature] requires [field], "
+                               "[origin] and [pivot]")
+        return DistanceFeatureQuery(field=body["field"],
+                                    origin=body["origin"],
+                                    pivot=body["pivot"],
+                                    boost=float(body.get("boost", 1.0)))
+
+    if name == "rank_feature":
+        if "field" not in body:
+            raise ParsingError("[rank_feature] requires a [field]")
+        fn, params = "saturation", {}
+        for candidate in ("saturation", "log", "sigmoid", "linear"):
+            if candidate in body:
+                fn, params = candidate, body[candidate] or {}
+        return RankFeatureQuery(
+            field=body["field"], function=fn,
+            pivot=(float(params["pivot"]) if params.get("pivot") is not None
+                   else None),
+            scaling_factor=float(params.get("scaling_factor", 1.0)),
+            exponent=float(params.get("exponent", 1.0)),
+            boost=float(body.get("boost", 1.0)))
+
+    if name == "geo_distance":
+        body = dict(body)
+        boost = float(body.pop("boost", 1.0))
+        distance = body.pop("distance", None)
+        body.pop("distance_type", None)
+        body.pop("validation_method", None)
+        if distance is None or len(body) != 1:
+            raise ParsingError("[geo_distance] requires [distance] and "
+                               "exactly one field")
+        field, point = next(iter(body.items()))
+        from opensearch_tpu.index.mapper import _parse_geo_point
+        lat, lon = _parse_geo_point(point)
+        return GeoDistanceQuery(field=field, lat=lat, lon=lon,
+                                distance_m=parse_distance(distance),
+                                boost=boost)
+
+    if name == "geo_bounding_box":
+        body = dict(body)
+        boost = float(body.pop("boost", 1.0))
+        body.pop("validation_method", None)
+        if len(body) != 1:
+            raise ParsingError("[geo_bounding_box] requires exactly one "
+                               "field")
+        field, spec = next(iter(body.items()))
+        from opensearch_tpu.index.mapper import _parse_geo_point
+        if "top_left" in spec:
+            top, left = _parse_geo_point(spec["top_left"])
+            bottom, right = _parse_geo_point(spec["bottom_right"])
+        else:
+            top, left = float(spec["top"]), float(spec["left"])
+            bottom, right = float(spec["bottom"]), float(spec["right"])
+        return GeoBoundingBoxQuery(field=field, top=top, left=left,
+                                   bottom=bottom, right=right, boost=boost)
 
     if name == "percolate":
         docs = body.get("documents")

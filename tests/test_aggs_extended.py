@@ -1,0 +1,214 @@
+"""Extended aggregation tests: composite, multi_terms, significant_terms,
+auto_date_histogram, adjacency_matrix, matrix_stats, geo aggs.
+
+Modeled on the reference suites: CompositeAggregatorTests,
+MultiTermsAggregatorTests, SignificantTermsAggregatorTests (JLH),
+AutoDateHistogramAggregatorTests, AdjacencyMatrixIT,
+MatrixStatsAggregatorTests, GeoBoundsIT / GeoCentroidIT / GeoHashGridIT."""
+
+import math
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    n.request("PUT", "/events", {
+        "settings": {"number_of_shards": 2},
+        "mappings": {"properties": {
+            "kind": {"type": "keyword"},
+            "region": {"type": "keyword"},
+            "value": {"type": "double"},
+            "load": {"type": "double"},
+            "ts": {"type": "date"},
+            "spot": {"type": "geo_point"},
+        }}})
+    rows = [
+        # kind, region, value, load, ts, (lat, lon)
+        ("click", "eu", 1.0, 2.0, "2026-01-01T00:00:00Z", (52.5, 13.4)),
+        ("click", "eu", 2.0, 4.0, "2026-01-01T06:00:00Z", (48.8, 2.3)),
+        ("click", "us", 3.0, 6.0, "2026-01-02T00:00:00Z", (40.7, -74.0)),
+        ("view", "eu", 4.0, 8.0, "2026-01-02T12:00:00Z", (51.5, -0.1)),
+        ("view", "us", 5.0, 10.0, "2026-01-03T00:00:00Z", (34.0, -118.2)),
+        ("view", "us", 6.0, 12.0, "2026-01-03T08:00:00Z", (37.7, -122.4)),
+        ("buy", "eu", 7.0, 14.0, "2026-01-04T00:00:00Z", (52.5, 13.4)),
+    ]
+    for i, (kind, region, value, load, ts, (lat, lon)) in enumerate(rows):
+        n.request("PUT", f"/events/_doc/{i}", {
+            "kind": kind, "region": region, "value": value, "load": load,
+            "ts": ts, "spot": {"lat": lat, "lon": lon}})
+    n.request("POST", "/events/_refresh")
+    return n
+
+
+def agg(node, body):
+    res = node.request("POST", "/events/_search", {"size": 0, "aggs": body})
+    assert res.get("aggregations"), res
+    return res["aggregations"]
+
+
+class TestComposite:
+    def test_two_source_tuples(self, node):
+        out = agg(node, {"pairs": {"composite": {
+            "size": 100,
+            "sources": [{"k": {"terms": {"field": "kind"}}},
+                        {"r": {"terms": {"field": "region"}}}]}}})
+        buckets = {(b["key"]["k"], b["key"]["r"]): b["doc_count"]
+                   for b in out["pairs"]["buckets"]}
+        assert buckets == {("buy", "eu"): 1, ("click", "eu"): 2,
+                           ("click", "us"): 1, ("view", "eu"): 1,
+                           ("view", "us"): 2}
+
+    def test_pagination_with_after(self, node):
+        body = {"pairs": {"composite": {
+            "size": 2,
+            "sources": [{"k": {"terms": {"field": "kind"}}},
+                        {"r": {"terms": {"field": "region"}}}]}}}
+        out = agg(node, body)
+        first = out["pairs"]["buckets"]
+        assert len(first) == 2
+        after = out["pairs"]["after_key"]
+        body["pairs"]["composite"]["after"] = after
+        out2 = agg(node, body)
+        second = out2["pairs"]["buckets"]
+        keys1 = [(b["key"]["k"], b["key"]["r"]) for b in first]
+        keys2 = [(b["key"]["k"], b["key"]["r"]) for b in second]
+        assert not set(keys1) & set(keys2)
+        assert keys1 + keys2 == sorted(keys1 + keys2)
+
+    def test_composite_with_sub_agg(self, node):
+        out = agg(node, {"pairs": {
+            "composite": {"size": 100, "sources": [
+                {"k": {"terms": {"field": "kind"}}}]},
+            "aggs": {"v": {"sum": {"field": "value"}}}}})
+        by_key = {b["key"]["k"]: b["v"]["value"]
+                  for b in out["pairs"]["buckets"]}
+        assert by_key == {"buy": 7.0, "click": 6.0, "view": 15.0}
+
+    def test_composite_histogram_source(self, node):
+        out = agg(node, {"h": {"composite": {
+            "size": 100,
+            "sources": [{"v": {"histogram": {"field": "value",
+                                             "interval": 3}}}]}}})
+        buckets = {b["key"]["v"]: b["doc_count"]
+                   for b in out["h"]["buckets"]}
+        assert buckets == {0.0: 2, 3.0: 3, 6.0: 2}
+
+
+class TestMultiTerms:
+    def test_multi_terms_ordered_by_count(self, node):
+        out = agg(node, {"mt": {"multi_terms": {"terms": [
+            {"field": "kind"}, {"field": "region"}]}}})
+        buckets = out["mt"]["buckets"]
+        assert buckets[0]["key"] in (["click", "eu"], ["view", "us"])
+        assert buckets[0]["doc_count"] == 2
+        assert buckets[0]["key_as_string"] in ("click|eu", "view|us")
+        counts = [b["doc_count"] for b in buckets]
+        assert counts == sorted(counts, reverse=True)
+
+
+class TestSignificantTerms:
+    def test_jlh_scoring(self, node):
+        # within value>=4 docs, "view"/"buy" are over-represented vs index
+        out = agg(node, {"sig": {"filter": {
+            "range": {"value": {"gte": 4}}},
+            "aggs": {"s": {"significant_terms": {
+                "field": "kind", "min_doc_count": 1}}}}})
+        buckets = out["sig"]["s"]["buckets"]
+        keys = [b["key"] for b in buckets]
+        assert "view" in keys
+        assert "click" not in keys  # under-represented in foreground
+        view = next(b for b in buckets if b["key"] == "view")
+        assert view["doc_count"] == 3
+        assert view["bg_count"] == 3
+        assert view["score"] > 0
+
+
+class TestAutoDateHistogram:
+    def test_interval_chosen(self, node):
+        out = agg(node, {"adh": {"auto_date_histogram": {
+            "field": "ts", "buckets": 5}}})
+        buckets = out["adh"]["buckets"]
+        assert out["adh"]["interval"] == "1d"
+        assert len(buckets) <= 5
+        assert sum(b["doc_count"] for b in buckets) == 7
+
+    def test_fine_interval_for_tight_range(self, node):
+        out = agg(node, {"adh": {"auto_date_histogram": {
+            "field": "ts", "buckets": 200}}})
+        assert out["adh"]["interval"] == "1h"
+
+
+class TestAdjacencyMatrix:
+    def test_pairwise_intersections(self, node):
+        out = agg(node, {"adj": {"adjacency_matrix": {"filters": {
+            "eu": {"term": {"region": "eu"}},
+            "clicks": {"term": {"kind": "click"}},
+            "big": {"range": {"value": {"gte": 5}}}}}}})
+        buckets = {b["key"]: b["doc_count"] for b in out["adj"]["buckets"]}
+        assert buckets["eu"] == 4
+        assert buckets["clicks"] == 3
+        assert buckets["big"] == 3
+        assert buckets["clicks&eu"] == 2
+        assert buckets["big&eu"] == 1      # the buy in eu with value 7
+        assert "big&clicks" not in buckets  # empty intersection omitted
+
+
+class TestMatrixStats:
+    def test_correlated_fields(self, node):
+        out = agg(node, {"ms": {"matrix_stats": {
+            "fields": ["value", "load"]}}})
+        fields = {f["name"]: f for f in out["ms"]["fields"]}
+        assert fields["value"]["count"] == 7
+        assert fields["value"]["mean"] == pytest.approx(4.0)
+        assert fields["load"]["mean"] == pytest.approx(8.0)
+        # load = 2*value exactly → perfect correlation
+        assert fields["value"]["correlation"]["load"] == pytest.approx(1.0)
+        assert fields["value"]["covariance"]["load"] == pytest.approx(
+            2 * fields["value"]["variance"], rel=1e-6)
+
+
+class TestGeoAggs:
+    def test_geo_bounds(self, node):
+        out = agg(node, {"gb": {"geo_bounds": {"field": "spot"}}})
+        b = out["gb"]["bounds"]
+        assert b["top_left"]["lat"] == pytest.approx(52.5, abs=0.01)
+        assert b["top_left"]["lon"] == pytest.approx(-122.4, abs=0.01)
+        assert b["bottom_right"]["lat"] == pytest.approx(34.0, abs=0.01)
+        assert b["bottom_right"]["lon"] == pytest.approx(13.4, abs=0.01)
+
+    def test_geo_centroid(self, node):
+        out = agg(node, {"gc": {"geo_centroid": {"field": "spot"}}})
+        assert out["gc"]["count"] == 7
+        lats = [52.5, 48.8, 40.7, 51.5, 34.0, 37.7, 52.5]
+        assert out["gc"]["location"]["lat"] == pytest.approx(
+            sum(lats) / 7, abs=0.01)
+
+    def test_geohash_grid(self, node):
+        out = agg(node, {"gh": {"geohash_grid": {"field": "spot",
+                                                 "precision": 2}}})
+        buckets = {b["key"]: b["doc_count"] for b in out["gh"]["buckets"]}
+        assert sum(buckets.values()) == 7
+        assert all(len(k) == 2 for k in buckets)
+        # Berlin appears twice → its cell has ≥ 2
+        assert max(buckets.values()) >= 2
+
+    def test_geotile_grid(self, node):
+        out = agg(node, {"gt": {"geotile_grid": {"field": "spot",
+                                                 "precision": 4}}})
+        buckets = out["gt"]["buckets"]
+        assert sum(b["doc_count"] for b in buckets) == 7
+        assert all(b["key"].startswith("4/") for b in buckets)
+
+    def test_grid_under_terms(self, node):
+        out = agg(node, {"by_region": {
+            "terms": {"field": "region"},
+            "aggs": {"cells": {"geohash_grid": {"field": "spot",
+                                                "precision": 1}}}}})
+        regions = {b["key"]: b for b in out["by_region"]["buckets"]}
+        eu_cells = sum(c["doc_count"]
+                       for c in regions["eu"]["cells"]["buckets"])
+        assert eu_cells == 4

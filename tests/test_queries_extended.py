@@ -1,0 +1,259 @@
+"""Extended query DSL tests: function_score, match_phrase_prefix,
+terms_set, more_like_this, distance_feature, rank_feature, geo queries.
+
+Modeled on the reference suites: FunctionScoreIT / DecayFunctionScoreIT,
+MatchPhrasePrefixQueryBuilderTests, TermsSetQueryIT, MoreLikeThisIT,
+DistanceFeatureQueryBuilderTests, RankFeatureQueryBuilderTests,
+GeoDistanceIT / GeoBoundingBoxQueryBuilderTests."""
+
+import math
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    n.request("PUT", "/shop", {"mappings": {"properties": {
+        "name": {"type": "text"},
+        "tags": {"type": "keyword"},
+        "sales": {"type": "integer"},
+        "price": {"type": "double"},
+        "released": {"type": "date"},
+        "popularity": {"type": "rank_feature"},
+        "location": {"type": "geo_point"},
+    }}})
+    docs = [
+        ("1", "cheap usb cable", ["usb", "cable"], 50, 3.0,
+         "2026-07-01", 10.0, {"lat": 52.52, "lon": 13.405}),    # Berlin
+        ("2", "usb hub premium", ["usb", "hub"], 10, 25.0,
+         "2026-06-01", 50.0, {"lat": 48.8566, "lon": 2.3522}),  # Paris
+        ("3", "hdmi cable gold", ["hdmi", "cable"], 200, 8.0,
+         "2026-01-01", 2.0, {"lat": 40.7128, "lon": -74.006}),  # NYC
+        ("4", "usb charger fast", ["usb", "charger"], 120, 12.0,
+         "2026-07-20", 30.0, {"lat": 52.4, "lon": 13.1}),       # near Berlin
+    ]
+    for (i, name, tags, sales, price, released, pop, loc) in docs:
+        n.request("PUT", f"/shop/_doc/{i}", {
+            "name": name, "tags": tags, "sales": sales, "price": price,
+            "released": released, "popularity": pop, "location": loc})
+    n.request("POST", "/shop/_refresh")
+    return n
+
+
+class TestFunctionScore:
+    def test_field_value_factor(self, node):
+        res = node.request("POST", "/shop/_search", {"query": {
+            "function_score": {
+                "query": {"match_all": {}},
+                "field_value_factor": {"field": "sales", "factor": 2.0},
+                "boost_mode": "replace"}}})
+        hits = res["hits"]["hits"]
+        assert [h["_id"] for h in hits] == ["3", "4", "1", "2"]
+        assert hits[0]["_score"] == pytest.approx(400.0)
+
+    def test_fvf_modifier_log1p(self, node):
+        res = node.request("POST", "/shop/_search", {"query": {
+            "function_score": {
+                "query": {"term": {"tags": "hdmi"}},
+                "field_value_factor": {"field": "sales",
+                                       "modifier": "log1p"},
+                "boost_mode": "replace"}}})
+        assert res["hits"]["hits"][0]["_score"] == \
+            pytest.approx(math.log10(201), rel=1e-4)
+
+    def test_weight_with_filter_and_score_mode_sum(self, node):
+        res = node.request("POST", "/shop/_search", {"query": {
+            "function_score": {
+                "query": {"match_all": {}},
+                "functions": [
+                    {"filter": {"term": {"tags": "usb"}}, "weight": 10},
+                    {"filter": {"range": {"price": {"lte": 5}}},
+                     "weight": 100},
+                ],
+                "score_mode": "sum", "boost_mode": "replace"}}})
+        by_id = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+        assert by_id["1"] == pytest.approx(110.0)   # usb + cheap
+        assert by_id["2"] == pytest.approx(10.0)    # usb only
+        assert by_id["3"] == pytest.approx(1.0)     # no function applies
+
+    def test_gauss_decay_on_date(self, node):
+        res = node.request("POST", "/shop/_search", {"query": {
+            "function_score": {
+                "query": {"match_all": {}},
+                "gauss": {"released": {"origin": "2026-07-20",
+                                       "scale": "30d", "decay": 0.5}},
+                "boost_mode": "replace"}}})
+        scores = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+        assert scores["4"] == pytest.approx(1.0, abs=1e-3)   # at origin
+        assert scores["4"] > scores["1"] > scores["2"] > scores["3"]
+        # 2026-06-01 is ~49 days out: decay^(49/30)^2 ≈ 0.155
+        assert scores["2"] == pytest.approx(0.5 ** ((49 / 30) ** 2),
+                                            rel=0.05)
+
+    def test_random_score_deterministic(self, node):
+        body = {"query": {"function_score": {
+            "query": {"match_all": {}},
+            "random_score": {"seed": 7}, "boost_mode": "replace"}}}
+        r1 = node.request("POST", "/shop/_search", body)
+        r2 = node.request("POST", "/shop/_search", body)
+        s1 = [h["_score"] for h in r1["hits"]["hits"]]
+        s2 = [h["_score"] for h in r2["hits"]["hits"]]
+        assert s1 == s2
+        assert len(set(s1)) == 4  # actually random-looking
+
+    def test_script_score_function(self, node):
+        res = node.request("POST", "/shop/_search", {"query": {
+            "function_score": {
+                "query": {"match_all": {}},
+                "functions": [{"script_score": {"script": {
+                    "source": "doc['price'].value * 10"}}}],
+                "boost_mode": "replace"}}})
+        top = res["hits"]["hits"][0]
+        assert top["_id"] == "2"
+        assert top["_score"] == pytest.approx(250.0)
+
+    def test_min_score_filters(self, node):
+        res = node.request("POST", "/shop/_search", {"query": {
+            "function_score": {
+                "query": {"match_all": {}},
+                "field_value_factor": {"field": "sales"},
+                "boost_mode": "replace", "min_score": 100}}})
+        assert res["hits"]["total"]["value"] == 2  # sales 200 & 120
+
+
+class TestMatchPhrasePrefix:
+    def test_prefix_completes_phrase(self, node):
+        res = node.request("POST", "/shop/_search", {"query": {
+            "match_phrase_prefix": {"name": "usb hu"}}})
+        assert res["hits"]["total"]["value"] == 1
+        assert res["hits"]["hits"][0]["_id"] == "2"
+
+    def test_full_last_term_still_matches(self, node):
+        res = node.request("POST", "/shop/_search", {"query": {
+            "match_phrase_prefix": {"name": "usb hub"}}})
+        assert res["hits"]["total"]["value"] == 1
+
+    def test_no_expansion_no_match(self, node):
+        res = node.request("POST", "/shop/_search", {"query": {
+            "match_phrase_prefix": {"name": "usb zz"}}})
+        assert res["hits"]["total"]["value"] == 0
+
+
+class TestTermsSet:
+    def test_constant_msm_via_script(self, node):
+        res = node.request("POST", "/shop/_search", {"query": {
+            "terms_set": {"tags": {
+                "terms": ["usb", "cable", "hub"],
+                "minimum_should_match_script": {
+                    "source": "2"}}}}})
+        ids = {h["_id"] for h in res["hits"]["hits"]}
+        assert ids == {"1", "2"}  # usb+cable, usb+hub
+
+    def test_msm_field(self, node):
+        node.request("PUT", "/ts", {"mappings": {"properties": {
+            "codes": {"type": "keyword"},
+            "required": {"type": "integer"}}}})
+        node.request("PUT", "/ts/_doc/a",
+                     {"codes": ["x", "y"], "required": 2})
+        node.request("PUT", "/ts/_doc/b",
+                     {"codes": ["x"], "required": 1})
+        node.request("PUT", "/ts/_doc/c",
+                     {"codes": ["x", "y", "z"], "required": 3})
+        node.request("POST", "/ts/_refresh")
+        res = node.request("POST", "/ts/_search", {"query": {
+            "terms_set": {"codes": {
+                "terms": ["x", "y"],
+                "minimum_should_match_field": "required"}}}})
+        ids = {h["_id"] for h in res["hits"]["hits"]}
+        # a: needs 2, has x+y → match; b: needs 1, has x → match;
+        # c: needs 3 but the query only supplies 2 terms → cannot match
+        # (Lucene CoveringQuery does NOT clamp the requirement down)
+        assert ids == {"a", "b"}
+
+
+class TestMoreLikeThis:
+    def test_mlt_by_text(self, node):
+        n = Node()
+        n.request("PUT", "/docs", {"mappings": {"properties": {
+            "body": {"type": "text"}}}})
+        corpus = ["jax compiles to xla for tpu execution",
+                  "tpu pods scale jax programs with xla collectives",
+                  "the cafeteria menu changes daily",
+                  "gpu kernels are written in cuda"]
+        for i, text in enumerate(corpus):
+            n.request("PUT", f"/docs/_doc/{i}", {"body": text})
+        n.request("POST", "/docs/_refresh")
+        res = n.request("POST", "/docs/_search", {"query": {
+            "more_like_this": {
+                "fields": ["body"],
+                "like": ["jax xla tpu jax xla tpu"],
+                "min_term_freq": 1, "min_doc_freq": 1,
+                "minimum_should_match": "60%"}}})
+        ids = [h["_id"] for h in res["hits"]["hits"]]
+        assert set(ids) == {"0", "1"}
+
+
+class TestDistanceFeature:
+    def test_date_distance_feature(self, node):
+        res = node.request("POST", "/shop/_search", {"query": {
+            "bool": {"must": [{"match_all": {}}],
+                     "should": [{"distance_feature": {
+                         "field": "released",
+                         "origin": "2026-07-20", "pivot": "7d"}}]}}})
+        ids = [h["_id"] for h in res["hits"]["hits"]]
+        assert ids[0] == "4"  # released exactly at origin
+        scores = [h["_score"] for h in res["hits"]["hits"]]
+        assert scores == sorted(scores, reverse=True)
+
+
+class TestRankFeature:
+    def test_saturation(self, node):
+        res = node.request("POST", "/shop/_search", {"query": {
+            "rank_feature": {"field": "popularity",
+                             "saturation": {"pivot": 10}}}})
+        by_id = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+        assert by_id["2"] == pytest.approx(50 / 60)
+        assert by_id["1"] == pytest.approx(0.5)
+        assert by_id["2"] > by_id["4"] > by_id["1"] > by_id["3"]
+
+    def test_log(self, node):
+        res = node.request("POST", "/shop/_search", {"query": {
+            "rank_feature": {"field": "popularity",
+                             "log": {"scaling_factor": 1}}}})
+        by_id = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+        assert by_id["2"] == pytest.approx(math.log(51), rel=1e-4)
+
+
+class TestGeo:
+    def test_geo_distance_filter(self, node):
+        res = node.request("POST", "/shop/_search", {"query": {
+            "bool": {"filter": [{"geo_distance": {
+                "distance": "100km",
+                "location": {"lat": 52.52, "lon": 13.405}}}]}}})
+        ids = {h["_id"] for h in res["hits"]["hits"]}
+        assert ids == {"1", "4"}  # Berlin + near-Berlin
+
+    def test_geo_distance_wider_radius(self, node):
+        res = node.request("POST", "/shop/_search", {"query": {
+            "bool": {"filter": [{"geo_distance": {
+                "distance": "1200km",
+                "location": {"lat": 52.52, "lon": 13.405}}}]}}})
+        ids = {h["_id"] for h in res["hits"]["hits"]}
+        assert ids == {"1", "2", "4"}  # + Paris (~880km)
+
+    def test_geo_bounding_box(self, node):
+        res = node.request("POST", "/shop/_search", {"query": {
+            "bool": {"filter": [{"geo_bounding_box": {"location": {
+                "top_left": {"lat": 55.0, "lon": 0.0},
+                "bottom_right": {"lat": 45.0, "lon": 15.0}}}}]}}})
+        ids = {h["_id"] for h in res["hits"]["hits"]}
+        assert ids == {"1", "2", "4"}  # Europe box excludes NYC
+
+    def test_geo_missing_field_rejected(self, node):
+        res = node.request("POST", "/shop/_search", {"query": {
+            "geo_distance": {"distance": "1km",
+                             "sales": {"lat": 0, "lon": 0}}}})
+        assert res["_status"] == 400
