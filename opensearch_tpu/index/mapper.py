@@ -31,7 +31,10 @@ from opensearch_tpu.common.errors import IllegalArgumentError, MapperParsingErro
 from opensearch_tpu.analysis import AnalysisRegistry, get_default_registry
 
 TEXT_TYPES = {"text", "match_only_text", "search_as_you_type"}
-KEYWORD_TYPES = {"keyword", "constant_keyword", "wildcard"}
+KEYWORD_TYPES = {"keyword", "constant_keyword", "wildcard",
+                 # completion fields store their suggestions as exact values;
+                 # the suggester walks the ordinal dictionary by prefix
+                 "completion", "search_as_you_type"}
 NUMERIC_TYPES = {"long", "integer", "short", "byte", "double", "float", "half_float",
                  "scaled_float", "unsigned_long",
                  # mapper-extras rank features are positive floats with doc

@@ -264,6 +264,9 @@ def execute_search(executors: List, body: Optional[dict],
         aggregations = reduce_aggs(decoded_partials)
         apply_pipelines(agg_nodes, aggregations)
         resp["aggregations"] = aggregations
+    if body.get("suggest"):
+        from opensearch_tpu.search.suggest import execute_suggest
+        resp["suggest"] = execute_suggest(executors, body["suggest"])
     if page:
         last = page[-1]
         resp["_page_cursor"] = {

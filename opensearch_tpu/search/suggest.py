@@ -1,0 +1,223 @@
+"""Suggesters: term, phrase, completion.
+
+Re-design of search/suggest/ (TermSuggester with DirectSpellChecker edit
+distance + doc-freq ranking, PhraseSuggester's per-token best correction,
+CompletionSuggester's prefix automaton). The vocabulary lives in the
+segment term dictionaries / ordinal dictionaries, so candidate generation
+is a host-side scan over sorted terms — small relative to the query phase,
+and identical in contract to the reference's suggest API.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from opensearch_tpu.common.errors import IllegalArgumentError
+
+
+def edit_distance(a: str, b: str, cap: int = 3) -> int:
+    """Damerau-Levenshtein (the reference's LuceneLevenshteinDistance is the
+    same family), capped for early exit."""
+    if abs(len(a) - len(b)) > cap:
+        return cap + 1
+    prev2: Optional[List[int]] = None
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i] + [0] * len(b)
+        for j, cb in enumerate(b, 1):
+            cost = 0 if ca == cb else 1
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+            if i > 1 and j > 1 and ca == b[j - 2] and a[i - 2] == cb:
+                cur[j] = min(cur[j], prev2[j - 2] + 1)
+        if min(cur) > cap:
+            return cap + 1
+        prev2, prev = prev, cur
+    return prev[len(b)]
+
+
+def _field_vocab(executors, field: str) -> Dict[str, int]:
+    """term → doc_freq across every segment of every target shard."""
+    vocab: Dict[str, int] = {}
+    for ex in executors:
+        for seg in ex.reader.segments:
+            for term in seg.terms_for_field(field):
+                meta = seg.get_term(field, term)
+                if meta is not None:
+                    vocab[term] = vocab.get(term, 0) + meta.doc_freq
+            ocol = seg.ordinal_dv.get(field)
+            if ocol is not None:
+                import numpy as np
+                counts = np.bincount(ocol.ords,
+                                     minlength=len(ocol.dictionary))
+                for term, c in zip(ocol.dictionary, counts):
+                    vocab[term] = vocab.get(term, 0) + int(c)
+    return vocab
+
+
+def _term_candidates(token: str, vocab: Dict[str, int], max_edits: int,
+                     prefix_length: int, size: int,
+                     include_exact: bool) -> List[dict]:
+    out = []
+    for term, freq in vocab.items():
+        if prefix_length and not term.startswith(token[:prefix_length]):
+            continue
+        if term == token and not include_exact:
+            continue
+        dist = edit_distance(token, term, cap=max_edits)
+        if dist > max_edits:
+            continue
+        score = 1.0 - dist / max(len(token), len(term), 1)
+        out.append({"text": term, "score": round(score, 6), "freq": freq})
+    out.sort(key=lambda c: (-c["score"], -c["freq"], c["text"]))
+    return out[:size]
+
+
+def term_suggest(executors, name: str, spec: dict) -> List[dict]:
+    text = spec.get("text")
+    body = spec.get("term") or {}
+    field = body.get("field")
+    if text is None or field is None:
+        raise IllegalArgumentError(
+            f"suggester [{name}] requires [text] and [term.field]")
+    max_edits = int(body.get("max_edits", 2))
+    prefix_length = int(body.get("prefix_length", 1))
+    size = int(body.get("size", 5))
+    suggest_mode = body.get("suggest_mode", "missing")
+    vocab = _field_vocab(executors, field)
+    results = []
+    offset = 0
+    for token in str(text).lower().split():
+        exists = token in vocab
+        if suggest_mode == "missing" and exists:
+            options = []
+        else:
+            options = _term_candidates(token, vocab, max_edits,
+                                       prefix_length, size,
+                                       include_exact=False)
+            if suggest_mode == "popular" and exists:
+                options = [o for o in options
+                           if o["freq"] > vocab[token]]
+        results.append({"text": token, "offset": offset,
+                        "length": len(token), "options": options})
+        offset += len(token) + 1
+    return results
+
+
+def phrase_suggest(executors, name: str, spec: dict) -> List[dict]:
+    text = spec.get("text")
+    body = spec.get("phrase") or {}
+    field = body.get("field")
+    if text is None or field is None:
+        raise IllegalArgumentError(
+            f"suggester [{name}] requires [text] and [phrase.field]")
+    max_errors = float(body.get("max_errors", 1.0))
+    size = int(body.get("size", 5))
+    vocab = _field_vocab(executors, field)
+    tokens = str(text).lower().split()
+    per_token: List[List[Tuple[str, float]]] = []
+    n_corrections = 0
+    for token in tokens:
+        if token in vocab:
+            per_token.append([(token, 1.0)])
+            continue
+        cands = _term_candidates(token, vocab, 2, 1, 3, include_exact=True)
+        if cands:
+            n_corrections += 1
+            per_token.append([(c["text"], c["score"]) for c in cands])
+        else:
+            per_token.append([(token, 0.1)])
+    allowed_errors = max_errors if max_errors >= 1 else \
+        max_errors * len(tokens)
+    options: List[dict] = []
+    if 0 < n_corrections <= allowed_errors or n_corrections == 0:
+        # beam over the top candidate combinations (best-first, width=size)
+        beams: List[Tuple[float, List[str]]] = [(1.0, [])]
+        for cands in per_token:
+            beams = sorted(
+                ((score * cscore, words + [cword])
+                 for score, words in beams for cword, cscore in cands),
+                key=lambda b: -b[0])[:size]
+        for score, words in beams:
+            phrase = " ".join(words)
+            if phrase != " ".join(tokens):
+                options.append({"text": phrase,
+                                "score": round(score, 6)})
+    return [{"text": str(text), "offset": 0, "length": len(str(text)),
+             "options": options[:size]}]
+
+
+def completion_suggest(executors, name: str, spec: dict) -> List[dict]:
+    prefix = spec.get("prefix", spec.get("text"))
+    body = spec.get("completion") or {}
+    field = body.get("field")
+    if prefix is None or field is None:
+        raise IllegalArgumentError(
+            f"suggester [{name}] requires [prefix] and [completion.field]")
+    size = int(body.get("size", 5))
+    fuzzy = body.get("fuzzy")  # {} means fuzzy-with-defaults
+    fuzzy_enabled = fuzzy is not None and fuzzy is not False
+    options = []
+    seen = set()
+    for ex in executors:
+        for seg in ex.reader.segments:
+            ocol = seg.ordinal_dv.get(field)
+            if ocol is None:
+                continue
+            for doc_id, ord_ in zip(ocol.doc_ids, ocol.ords):
+                if not seg.live[doc_id]:
+                    continue
+                value = ocol.dictionary[ord_]
+                if value in seen:
+                    continue
+                if value.lower().startswith(str(prefix).lower()):
+                    matched = True
+                    score = 1.0
+                elif fuzzy_enabled:
+                    fuzziness = int((fuzzy or {}).get("fuzziness", 1)) \
+                        if not isinstance(fuzzy, bool) else 1
+                    p = str(prefix).lower()
+                    # an edit may change the matched prefix length, so try
+                    # value prefixes of len±fuzziness and keep the best
+                    dist = min(
+                        edit_distance(p, value.lower()[:length],
+                                      cap=fuzziness)
+                        for length in range(max(1, len(p) - fuzziness),
+                                            len(p) + fuzziness + 1))
+                    matched = dist <= fuzziness
+                    score = 1.0 / (1 + dist)
+                else:
+                    matched = False
+                    score = 0.0
+                if matched:
+                    seen.add(value)
+                    options.append({
+                        "text": value, "_index": ex.reader.index_name,
+                        "_id": seg.doc_ids[int(doc_id)], "_score": score,
+                        "_source": seg.sources[int(doc_id)]})
+    options.sort(key=lambda o: (-o["_score"], o["text"]))
+    return [{"text": str(prefix), "offset": 0,
+             "length": len(str(prefix)), "options": options[:size]}]
+
+
+def execute_suggest(executors, suggest_body: dict) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    global_text = suggest_body.get("text")
+    for name, spec in suggest_body.items():
+        if name == "text":
+            continue
+        if not isinstance(spec, dict):
+            raise IllegalArgumentError(f"suggester [{name}] malformed")
+        spec = dict(spec)
+        if global_text is not None:
+            spec.setdefault("text", global_text)
+        if "term" in spec:
+            out[name] = term_suggest(executors, name, spec)
+        elif "phrase" in spec:
+            out[name] = phrase_suggest(executors, name, spec)
+        elif "completion" in spec:
+            out[name] = completion_suggest(executors, name, spec)
+        else:
+            raise IllegalArgumentError(
+                f"suggester [{name}] requires one of [term, phrase, "
+                f"completion]")
+    return out

@@ -1,0 +1,94 @@
+"""Suggester tests (modeled on SuggestSearchIT / CompletionSuggestSearchIT)."""
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    n.request("PUT", "/sugg", {"mappings": {"properties": {
+        "body": {"type": "text"},
+        "suggest": {"type": "completion"},
+    }}})
+    texts = ["the quick brown fox", "quick brown foxes jump",
+             "lazy dogs sleep", "quiet quality quarters"]
+    completions = ["Quick Start Guide", "Quickstart Tutorial",
+                   "Quality Handbook", "Advanced Topics"]
+    for i, (t, c) in enumerate(zip(texts, completions)):
+        n.request("PUT", f"/sugg/_doc/{i}", {"body": t, "suggest": c})
+    n.request("POST", "/sugg/_refresh")
+    return n
+
+
+class TestTermSuggester:
+    def test_corrects_typo(self, node):
+        res = node.request("POST", "/sugg/_search", {
+            "size": 0,
+            "suggest": {"fix": {"text": "quik",
+                                "term": {"field": "body"}}}})
+        entry = res["suggest"]["fix"][0]
+        assert entry["text"] == "quik"
+        options = [o["text"] for o in entry["options"]]
+        assert "quick" in options
+
+    def test_existing_term_no_suggestion_in_missing_mode(self, node):
+        res = node.request("POST", "/sugg/_search", {
+            "size": 0,
+            "suggest": {"fix": {"text": "quick brwn",
+                                "term": {"field": "body"}}}})
+        entries = res["suggest"]["fix"]
+        assert entries[0]["options"] == []          # "quick" exists
+        assert entries[1]["options"][0]["text"] == "brown"
+        assert entries[1]["offset"] == 6
+
+    def test_freq_reported(self, node):
+        res = node.request("POST", "/sugg/_search", {
+            "size": 0,
+            "suggest": {"fix": {"text": "quicc",
+                                "term": {"field": "body"}}}})
+        opt = res["suggest"]["fix"][0]["options"][0]
+        assert opt["text"] == "quick"
+        assert opt["freq"] == 2
+
+
+class TestPhraseSuggester:
+    def test_whole_phrase_correction(self, node):
+        res = node.request("POST", "/sugg/_search", {
+            "size": 0,
+            "suggest": {"p": {"text": "quik brown fx",
+                              "phrase": {"field": "body",
+                                         "max_errors": 2}}}})
+        options = res["suggest"]["p"][0]["options"]
+        assert options
+        assert options[0]["text"] == "quick brown fox"
+
+
+class TestCompletionSuggester:
+    def test_prefix_completion(self, node):
+        res = node.request("POST", "/sugg/_search", {
+            "size": 0,
+            "suggest": {"c": {"prefix": "quick",
+                              "completion": {"field": "suggest"}}}})
+        options = [o["text"] for o in res["suggest"]["c"][0]["options"]]
+        assert set(options) == {"Quick Start Guide", "Quickstart Tutorial"}
+        top = res["suggest"]["c"][0]["options"][0]
+        assert "_id" in top and "_source" in top
+
+    def test_fuzzy_completion(self, node):
+        res = node.request("POST", "/sugg/_search", {
+            "size": 0,
+            "suggest": {"c": {"prefix": "qick",
+                              "completion": {"field": "suggest",
+                                             "fuzzy": {}}}}})
+        options = [o["text"] for o in res["suggest"]["c"][0]["options"]]
+        assert any(o.startswith("Quick") for o in options)
+
+    def test_global_suggest_text(self, node):
+        res = node.request("POST", "/sugg/_search", {
+            "size": 0,
+            "suggest": {"text": "foxs",
+                        "t1": {"term": {"field": "body"}}}})
+        options = [o["text"] for o in res["suggest"]["t1"][0]["options"]]
+        assert "fox" in options or "foxes" in options
