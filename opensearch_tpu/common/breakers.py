@@ -1,0 +1,195 @@
+"""Circuit breakers + indexing/search backpressure.
+
+Re-design of the reference's hierarchical memory accounting
+(indices/breaker/HierarchyCircuitBreakerService.java:77 — parent real-memory
+breaker over child request/fielddata/in_flight breakers), the node-level
+indexing pressure limiter (index/IndexingPressure.java:53), and the search
+backpressure admission gate (search/backpressure/SearchBackpressureService
+.java:63, reduced to a concurrency/duress gate: the cancellation machinery
+lives in tasks.py). Budgets are HOST/HBM byte estimates, not JVM heap —
+the TPU build's scarce resources are device HBM for resident segments and
+host RAM for sources/translog.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from opensearch_tpu.common.errors import CircuitBreakingError
+
+
+class CircuitBreaker:
+    def __init__(self, name: str, limit_bytes: int, overhead: float = 1.0,
+                 parent: Optional["ParentBreaker"] = None):
+        self.name = name
+        self.limit = limit_bytes
+        self.overhead = overhead
+        self.used = 0
+        self.trip_count = 0
+        self.parent = parent
+        self._lock = threading.Lock()
+
+    def add_estimate(self, bytes_: int, label: str = "<unknown>"):
+        with self._lock:
+            new_used = self.used + bytes_
+            estimate = int(new_used * self.overhead)
+            if bytes_ > 0 and estimate > self.limit:
+                self.trip_count += 1
+                raise CircuitBreakingError(
+                    f"[{self.name}] Data too large, data for [{label}] "
+                    f"would be [{estimate}/{_human(estimate)}], which is "
+                    f"larger than the limit of "
+                    f"[{self.limit}/{_human(self.limit)}]")
+            self.used = new_used
+        if self.parent is not None and bytes_ > 0:
+            try:
+                self.parent.check(label)
+            except CircuitBreakingError:
+                with self._lock:
+                    self.used -= bytes_
+                raise
+
+    def release(self, bytes_: int):
+        with self._lock:
+            self.used = max(0, self.used - bytes_)
+
+    def stats(self) -> dict:
+        return {"limit_size_in_bytes": self.limit,
+                "limit_size": _human(self.limit),
+                "estimated_size_in_bytes": int(self.used * self.overhead),
+                "estimated_size": _human(int(self.used * self.overhead)),
+                "overhead": self.overhead,
+                "tripped": self.trip_count}
+
+
+class ParentBreaker:
+    """Total across children must stay under the parent limit."""
+
+    def __init__(self, limit_bytes: int):
+        self.limit = limit_bytes
+        self.trip_count = 0
+        self.children: Dict[str, CircuitBreaker] = {}
+
+    def check(self, label: str):
+        total = sum(c.used for c in self.children.values())
+        if total > self.limit:
+            self.trip_count += 1
+            raise CircuitBreakingError(
+                f"[parent] Data too large, data for [{label}] would be "
+                f"[{total}/{_human(total)}], which is larger than the limit "
+                f"of [{self.limit}/{_human(self.limit)}]")
+
+    def stats(self) -> dict:
+        total = sum(c.used for c in self.children.values())
+        return {"limit_size_in_bytes": self.limit,
+                "limit_size": _human(self.limit),
+                "estimated_size_in_bytes": total,
+                "estimated_size": _human(total),
+                "overhead": 1.0, "tripped": self.trip_count}
+
+
+class CircuitBreakerService:
+    """request / fielddata / in_flight_requests children under a parent —
+    the reference's default hierarchy, with HBM-oriented defaults."""
+
+    DEFAULTS = {
+        "request": 6 << 30,              # 60% of ~10G budget analog
+        "fielddata": 4 << 30,
+        "in_flight_requests": 10 << 30,
+        "accounting": 10 << 30,
+    }
+    PARENT_LIMIT = 9 << 30               # 95%-of-heap analog
+
+    def __init__(self, limits: Optional[Dict[str, int]] = None):
+        self.parent = ParentBreaker((limits or {}).get(
+            "parent", self.PARENT_LIMIT))
+        self.breakers: Dict[str, CircuitBreaker] = {}
+        for name, default in self.DEFAULTS.items():
+            limit = (limits or {}).get(name, default)
+            b = CircuitBreaker(name, limit, parent=self.parent)
+            self.breakers[name] = b
+            self.parent.children[name] = b
+
+    def breaker(self, name: str) -> CircuitBreaker:
+        return self.breakers[name]
+
+    def stats(self) -> dict:
+        out = {name: b.stats() for name, b in self.breakers.items()}
+        out["parent"] = self.parent.stats()
+        return out
+
+
+class IndexingPressure:
+    """Node-level indexing memory gate (IndexingPressure.java:53): bytes of
+    in-flight write payloads; rejects when over the limit."""
+
+    def __init__(self, limit_bytes: int = 512 << 20):
+        self.limit = limit_bytes
+        self.current = 0
+        self.total = 0
+        self.rejections = 0
+        self._lock = threading.Lock()
+
+    def acquire(self, bytes_: int):
+        with self._lock:
+            if self.current + bytes_ > self.limit:
+                self.rejections += 1
+                raise CircuitBreakingError(
+                    f"rejected execution of coordinating operation "
+                    f"[coordinating_and_primary_bytes="
+                    f"{self.current + bytes_}, "
+                    f"max_coordinating_and_primary_bytes={self.limit}]")
+            self.current += bytes_
+            self.total += bytes_
+
+    def release(self, bytes_: int):
+        with self._lock:
+            self.current = max(0, self.current - bytes_)
+
+    def stats(self) -> dict:
+        return {"memory": {"current": {
+            "coordinating_in_bytes": self.current,
+            "combined_coordinating_and_primary_in_bytes": self.current},
+            "total": {"combined_coordinating_and_primary_in_bytes":
+                      self.total,
+                      "coordinating_rejections": self.rejections}}}
+
+
+class SearchBackpressure:
+    """Admission gate: cap concurrent searches; over the cap, new searches
+    are rejected with 429 (the reference instead cancels the most expensive
+    task under node duress — same contract surface, simpler policy)."""
+
+    def __init__(self, max_concurrent: int = 100):
+        self.max_concurrent = max_concurrent
+        self.current = 0
+        self.rejections = 0
+        self.cancellations = 0
+        self._lock = threading.Lock()
+
+    def acquire(self):
+        with self._lock:
+            if self.current >= self.max_concurrent:
+                self.rejections += 1
+                raise CircuitBreakingError(
+                    f"rejected execution of search: node is under duress "
+                    f"[{self.current} >= {self.max_concurrent} concurrent "
+                    f"searches]")
+            self.current += 1
+
+    def release(self):
+        with self._lock:
+            self.current = max(0, self.current - 1)
+
+    def stats(self) -> dict:
+        return {"search_task": {"current": self.current,
+                                "rejections": self.rejections,
+                                "cancellation_count": self.cancellations}}
+
+
+def _human(n: int) -> str:
+    for unit, factor in (("gb", 1 << 30), ("mb", 1 << 20), ("kb", 1 << 10)):
+        if n >= factor:
+            return f"{n / factor:.1f}{unit}"
+    return f"{n}b"

@@ -42,8 +42,15 @@ class Node:
         self.pit_contexts: Dict[str, Any] = {}
         from opensearch_tpu.repositories import RepositoriesService
         from opensearch_tpu.datastreams import DataStreamService
+        from opensearch_tpu.common.breakers import (
+            CircuitBreakerService, IndexingPressure, SearchBackpressure)
+        from opensearch_tpu.tasks import TaskManager
         self.repositories = RepositoriesService()
         self.data_streams = DataStreamService(self)
+        self.task_manager = TaskManager()
+        self.breaker_service = CircuitBreakerService()
+        self.indexing_pressure = IndexingPressure()
+        self.search_backpressure = SearchBackpressure()
         self.gateway = None
         if data_path is not None:
             from opensearch_tpu.gateway import Gateway

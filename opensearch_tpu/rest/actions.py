@@ -77,9 +77,45 @@ def _run_search(node, index_expr: Optional[str], body: Optional[dict]) -> dict:
         from opensearch_tpu.search.percolator import execute_percolate
         k = int((body or {}).get("size", 10)) + int((body or {}).get("from", 0))
         return execute_percolate(executors, parsed, max(k, 10), body or {})
-    res = execute_search(executors, body, extra_filters=filters)
+    node.search_backpressure.acquire()
+    task = node.task_manager.register(
+        "indices:data/read/search",
+        description=f"indices[{index_expr or '_all'}]", cancellable=True)
+    try:
+        res = execute_search(executors, body, extra_filters=filters,
+                             task=task)
+    finally:
+        node.task_manager.unregister(task)
+        node.search_backpressure.release()
     res.pop("_page_cursor", None)
+    _maybe_slow_log(node, index_expr, body, res)
     return res
+
+
+_SLOW_LOGGER = None
+
+
+def _maybe_slow_log(node, index_expr, body, res):
+    """Per-index search slow log (index/SearchSlowLog.java:61): threshold
+    from the index setting search.slowlog.threshold.query.warn."""
+    global _SLOW_LOGGER
+    took_ms = res.get("took", 0)
+    for name in node.indices.resolve(index_expr, ignore_unavailable=True):
+        threshold = node.indices.get(name).settings.get(
+            "search.slowlog.threshold.query.warn")
+        if threshold is None:
+            continue
+        from opensearch_tpu.common.settings import parse_time_value
+        if took_ms >= parse_time_value(threshold, "slowlog") * 1000:
+            if _SLOW_LOGGER is None:
+                import logging
+                _SLOW_LOGGER = logging.getLogger(
+                    "opensearch_tpu.index.search.slowlog")
+            _SLOW_LOGGER.warning(
+                "[%s] took[%sms], total_hits[%s], source[%s]",
+                name, took_ms,
+                (res.get("hits", {}).get("total") or {}).get("value"),
+                body)
 
 
 # ---------------------------------------------------------------- documents
@@ -191,6 +227,14 @@ def register_document_actions(node, c):
         return {"docs": docs}
 
     def do_bulk(req):
+        payload_bytes = len(req.raw_body or b"")
+        node.indexing_pressure.acquire(payload_bytes)
+        try:
+            return _do_bulk_inner(req)
+        finally:
+            node.indexing_pressure.release(payload_bytes)
+
+    def _do_bulk_inner(req):
         ops = _ndjson_lines(req)
         default_index = req.param("index")
         # regroup NDJSON action/source pairs into the ops shape the
@@ -812,6 +856,8 @@ def register_cluster_actions(node, c):
     def do_nodes_stats(req):
         idx_stats = {n: svc.stats()
                      for n, svc in node.indices.indices.items()}
+        import resource
+        max_rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
         return {
             "_nodes": {"total": 1, "successful": 1, "failed": 0},
             "cluster_name": node.cluster_name,
@@ -825,6 +871,11 @@ def register_cluster_actions(node, c):
                     "segments": {"count": sum(s["segments"]["count"]
                                               for s in idx_stats.values())},
                 },
+                "breakers": node.breaker_service.stats(),
+                "indexing_pressure": node.indexing_pressure.stats(),
+                "search_backpressure": node.search_backpressure.stats(),
+                "process": {"mem": {
+                    "resident_in_bytes": max_rss_kb * 1024}},
             }},
         }
 
@@ -1200,6 +1251,60 @@ def register_module_actions(node, c):
     c.register("PUT", "/{index}/_clone/{target}", make_resize("clone"))
 
 
+# -------------------------------------------------------------------- tasks
+
+def register_task_actions(node, c):
+    def do_list_tasks(req):
+        tasks = node.task_manager.list_tasks(req.param("actions"))
+        return {"tasks": {f"_local:{t.task_id}": t.to_dict(node.node_id)
+                          for t in tasks}}
+
+    def do_get_task(req):
+        task_id = req.param("task_id")
+        tid = int(task_id.split(":")[-1])
+        task = node.task_manager.tasks.get(tid)
+        if task is None:
+            from opensearch_tpu.common.errors import IndexNotFoundError
+            return 404, {"error": {
+                "type": "resource_not_found_exception",
+                "reason": f"task [{task_id}] isn't running and hasn't "
+                          f"stored its results"}, "status": 404}
+        return {"completed": False, "task": task.to_dict(node.node_id)}
+
+    def do_cancel_task(req):
+        task_id = req.param("task_id")
+        tid = int(task_id.split(":")[-1])
+        ok = node.task_manager.cancel(tid)
+        tasks = {} if not ok else {
+            f"_local:{tid}":
+                node.task_manager.tasks[tid].to_dict(node.node_id)}
+        return {"nodes": {node.node_id: {"tasks": tasks}}
+                if ok else {}, "node_failures": []}
+
+    def do_cancel_matching(req):
+        cancelled = []
+        for t in node.task_manager.list_tasks(req.param("actions")):
+            if node.task_manager.cancel(t.task_id):
+                cancelled.append(t)
+        return {"nodes": {node.node_id: {
+            "tasks": {f"_local:{t.task_id}": t.to_dict(node.node_id)
+                      for t in cancelled}}}}
+
+    def cat_tasks(req):
+        rows = [[t.action, f"_local:{t.task_id}", "transport",
+                 t.start_time_ms,
+                 f"{t.to_dict()['running_time_in_nanos'] // 1000000}ms"]
+                for t in node.task_manager.list_tasks()]
+        return _cat_table(req, ["action", "task_id", "type", "start_time",
+                                "running_time"], rows)
+
+    c.register("GET", "/_tasks", do_list_tasks)
+    c.register("GET", "/_tasks/{task_id}", do_get_task)
+    c.register("POST", "/_tasks/{task_id}/_cancel", do_cancel_task)
+    c.register("POST", "/_tasks/_cancel", do_cancel_matching)
+    c.register("GET", "/_cat/tasks", cat_tasks)
+
+
 def register_all(node):
     c = node.controller
     register_cluster_actions(node, c)
@@ -1211,3 +1316,4 @@ def register_all(node):
     register_script_ingest_actions(node, c)
     register_snapshot_actions(node, c)
     register_module_actions(node, c)
+    register_task_actions(node, c)

@@ -153,13 +153,18 @@ def execute_search(executors: List, body: Optional[dict],
                    total_shards: Optional[int] = None,
                    failed_shards: int = 0,
                    extra_filters: Optional[List[Optional[dict]]] = None,
-                   cursor_tiebreak: Optional[Tuple[int, int, int]] = None) -> dict:
+                   cursor_tiebreak: Optional[Tuple[int, int, int]] = None,
+                   task=None) -> dict:
     """Run the full query-then-fetch flow over shard executors and render
     the search response. `executors` are per-shard SearchExecutors;
     `extra_filters` (aligned with executors) carry per-index alias filters;
-    `cursor_tiebreak` is the internal scroll cursor position."""
+    `cursor_tiebreak` is the internal scroll cursor position; `task` (when
+    given) is checked for cancellation between shard launches — the safe
+    points between device programs (CancellableBulkScorer analog)."""
     body = body or {}
     start = time.monotonic()
+    profiling = bool(body.get("profile", False))
+    profile_shards: List[dict] = []
     size = int(body.get("size", 10))
     from_ = int(body.get("from", 0))
     if size < 0 or from_ < 0:
@@ -184,7 +189,11 @@ def execute_search(executors: List, body: Optional[dict],
         candidates = []
         decoded_partials = []
         total = 0
+        profile_shards.clear()
         for shard_i, ex in enumerate(executors):
+            if task is not None:
+                task.check_cancelled()
+            shard_start = time.monotonic_ns()
             extra = extra_filters[shard_i] if extra_filters else None
             cands, decoded, shard_total = ex.execute_query_phase(
                 body, k_eff, extra_filter=extra)
@@ -193,6 +202,21 @@ def execute_search(executors: List, body: Optional[dict],
             candidates.extend(cands)
             decoded_partials.extend(decoded)
             total += shard_total
+            if profiling:
+                profile_shards.append({
+                    "id": f"[{ex.reader.index_name}][{shard_i}]",
+                    "searches": [{"query": [{
+                        "type": "TpuQueryPhase",
+                        "description": str(body.get("query")),
+                        "time_in_nanos":
+                            time.monotonic_ns() - shard_start,
+                        "breakdown": {
+                            "compile_and_score":
+                                time.monotonic_ns() - shard_start,
+                            "segments": len(ex.reader.segments)},
+                    }], "rewrite_time": 0, "collector": []}],
+                    "aggregations": [],
+                })
         candidates.sort(key=_compare_candidates(sort_specs))
         return candidates, decoded_partials, total
 
@@ -267,6 +291,8 @@ def execute_search(executors: List, body: Optional[dict],
     if body.get("suggest"):
         from opensearch_tpu.search.suggest import execute_suggest
         resp["suggest"] = execute_suggest(executors, body["suggest"])
+    if profiling:
+        resp["profile"] = {"shards": profile_shards}
     if page:
         last = page[-1]
         resp["_page_cursor"] = {

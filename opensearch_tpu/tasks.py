@@ -1,0 +1,129 @@
+"""Task management: registration, listing, cancellation.
+
+Re-design of tasks/TaskManager.java + CancellableTask + the list/cancel
+APIs (action/admin/cluster/node/tasks). Every REST action that can run
+long registers a task; cancellable tasks expose a flag the execution path
+checks at safe points — for device programs that means BETWEEN per-segment
+launches (the reference's CancellableBulkScorer checks between scored
+blocks; XLA programs are not interruptible mid-launch either, so the
+boundary is the same). Cancellation of a parent propagates to children
+(TaskCancellationService ban propagation, single-process form).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from opensearch_tpu.common.errors import OpenSearchTpuError, TaskCancelledError
+
+
+class Task:
+    __slots__ = ("task_id", "action", "description", "start_time_ms",
+                 "cancellable", "cancelled", "reason", "parent_task_id",
+                 "_start_monotonic")
+
+    def __init__(self, task_id: int, action: str, description: str = "",
+                 cancellable: bool = False,
+                 parent_task_id: Optional[int] = None):
+        self.task_id = task_id
+        self.action = action
+        self.description = description
+        self.start_time_ms = int(time.time() * 1000)
+        self._start_monotonic = time.monotonic()
+        self.cancellable = cancellable
+        self.cancelled = False
+        self.reason: Optional[str] = None
+        self.parent_task_id = parent_task_id
+
+    def check_cancelled(self):
+        """Call at safe points; raises if the task was cancelled
+        (CancellableTask.ensureNotCancelled)."""
+        if self.cancelled:
+            raise TaskCancelledError(
+                f"task cancelled [{self.reason or 'by user request'}]")
+
+    def to_dict(self, node_id: str = "_local") -> dict:
+        return {
+            "node": node_id,
+            "id": self.task_id,
+            "type": "transport",
+            "action": self.action,
+            "description": self.description,
+            "start_time_in_millis": self.start_time_ms,
+            "running_time_in_nanos": int(
+                (time.monotonic() - self._start_monotonic) * 1e9),
+            "cancellable": self.cancellable,
+            "cancelled": self.cancelled,
+            **({"parent_task_id": f"_local:{self.parent_task_id}"}
+               if self.parent_task_id is not None else {}),
+        }
+
+
+class TaskManager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counter = 0
+        self.tasks: Dict[int, Task] = {}
+
+    def register(self, action: str, description: str = "",
+                 cancellable: bool = False,
+                 parent_task_id: Optional[int] = None) -> Task:
+        with self._lock:
+            self._counter += 1
+            task = Task(self._counter, action, description, cancellable,
+                        parent_task_id)
+            self.tasks[task.task_id] = task
+            return task
+
+    def unregister(self, task: Task):
+        with self._lock:
+            self.tasks.pop(task.task_id, None)
+
+    def list_tasks(self, actions: Optional[str] = None) -> List[Task]:
+        with self._lock:
+            tasks = list(self.tasks.values())
+        if actions:
+            import fnmatch
+            patterns = actions.split(",")
+            tasks = [t for t in tasks
+                     if any(fnmatch.fnmatchcase(t.action, p)
+                            for p in patterns)]
+        return tasks
+
+    def cancel(self, task_id: int, reason: str = "by user request") -> bool:
+        """Cancel a task and all its descendants (ban propagation)."""
+        with self._lock:
+            task = self.tasks.get(task_id)
+            if task is None or not task.cancellable:
+                return False
+            to_cancel = [task]
+            # descendants
+            frontier = {task_id}
+            while frontier:
+                children = [t for t in self.tasks.values()
+                            if t.parent_task_id in frontier
+                            and not t.cancelled]
+                frontier = {t.task_id for t in children}
+                to_cancel.extend(children)
+            for t in to_cancel:
+                t.cancelled = True
+                t.reason = reason
+            return True
+
+
+class TaskContext:
+    """`with task_manager.task(...)` helper for REST handlers."""
+
+    def __init__(self, manager: TaskManager, action: str, description: str,
+                 cancellable: bool):
+        self.manager = manager
+        self.task = manager.register(action, description, cancellable)
+
+    def __enter__(self) -> Task:
+        return self.task
+
+    def __exit__(self, *exc):
+        self.manager.unregister(self.task)
+        return False

@@ -1,0 +1,188 @@
+"""Operability tests: tasks/cancellation, breakers, backpressure, profile,
+slow logs.
+
+Modeled on the reference suites: TasksIT / CancellableTasksIT,
+CircuitBreakerServiceIT, IndexingPressureIT, SearchBackpressureIT,
+QueryProfilerIT, SearchSlowLogTests."""
+
+import logging
+
+import pytest
+
+from opensearch_tpu.common.breakers import (
+    CircuitBreakerService, IndexingPressure, SearchBackpressure)
+from opensearch_tpu.common.errors import CircuitBreakingError
+from opensearch_tpu.node import Node
+from opensearch_tpu.tasks import TaskManager
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    n.request("PUT", "/ops", {"mappings": {"properties": {
+        "msg": {"type": "text"}, "n": {"type": "integer"}}}})
+    for i in range(10):
+        n.request("PUT", f"/ops/_doc/{i}", {"msg": f"message {i}", "n": i})
+    n.request("POST", "/ops/_refresh")
+    return n
+
+
+class TestTaskManager:
+    def test_register_list_unregister(self):
+        tm = TaskManager()
+        t1 = tm.register("indices:data/read/search", cancellable=True)
+        t2 = tm.register("cluster:monitor/health")
+        assert len(tm.list_tasks()) == 2
+        assert len(tm.list_tasks("indices:*")) == 1
+        tm.unregister(t1)
+        assert len(tm.list_tasks()) == 1
+        tm.unregister(t2)
+
+    def test_cancel_propagates_to_children(self):
+        tm = TaskManager()
+        parent = tm.register("parent", cancellable=True)
+        child = tm.register("child", cancellable=True,
+                            parent_task_id=parent.task_id)
+        grandchild = tm.register("grandchild", cancellable=True,
+                                 parent_task_id=child.task_id)
+        assert tm.cancel(parent.task_id)
+        assert parent.cancelled and child.cancelled and grandchild.cancelled
+        from opensearch_tpu.common.errors import TaskCancelledError
+        with pytest.raises(TaskCancelledError):
+            grandchild.check_cancelled()
+
+    def test_non_cancellable_refuses(self):
+        tm = TaskManager()
+        t = tm.register("fixed", cancellable=False)
+        assert tm.cancel(t.task_id) is False
+        assert not t.cancelled
+
+    def test_rest_task_api(self, node):
+        res = node.request("GET", "/_tasks")
+        assert "tasks" in res
+        res = node.request("GET", "/_tasks/_local:99999")
+        assert res["_status"] == 404
+
+
+class TestCircuitBreakers:
+    def test_child_breaker_trips(self):
+        svc = CircuitBreakerService({"request": 1000})
+        b = svc.breaker("request")
+        b.add_estimate(800, "agg-1")
+        with pytest.raises(CircuitBreakingError) as e:
+            b.add_estimate(300, "agg-2")
+        assert "Data too large" in str(e.value)
+        assert b.stats()["tripped"] == 1
+        b.release(800)
+        b.add_estimate(300, "agg-2")  # fits now
+
+    def test_parent_breaker_sums_children(self):
+        svc = CircuitBreakerService({"request": 800, "fielddata": 800,
+                                     "parent": 1000})
+        svc.breaker("request").add_estimate(700, "r")
+        with pytest.raises(CircuitBreakingError) as e:
+            svc.breaker("fielddata").add_estimate(600, "f")
+        assert "[parent]" in str(e.value)
+        # failed reservation must be rolled back
+        assert svc.breaker("fielddata").used == 0
+
+    def test_breakers_in_node_stats(self, node):
+        res = node.request("GET", "/_nodes/stats")
+        stats = next(iter(res["nodes"].values()))
+        assert "request" in stats["breakers"]
+        assert "parent" in stats["breakers"]
+        assert stats["breakers"]["request"]["tripped"] == 0
+
+
+class TestIndexingPressure:
+    def test_rejects_over_limit(self):
+        ip = IndexingPressure(limit_bytes=100)
+        ip.acquire(60)
+        with pytest.raises(CircuitBreakingError):
+            ip.acquire(60)
+        assert ip.rejections == 1
+        ip.release(60)
+        ip.acquire(60)
+
+    def test_bulk_tracked(self, node):
+        import json
+        payload = "\n".join([
+            json.dumps({"index": {"_index": "ops", "_id": "b1"}}),
+            json.dumps({"msg": "bulk doc"}),
+        ]) + "\n"
+        node.request("POST", "/_bulk", payload)
+        stats = next(iter(node.request(
+            "GET", "/_nodes/stats")["nodes"].values()))
+        total = stats["indexing_pressure"]["memory"]["total"]
+        assert total["combined_coordinating_and_primary_in_bytes"] > 0
+        # fully released after the request
+        cur = stats["indexing_pressure"]["memory"]["current"]
+        assert cur["combined_coordinating_and_primary_in_bytes"] == 0
+
+
+class TestSearchBackpressure:
+    def test_concurrency_gate(self):
+        bp = SearchBackpressure(max_concurrent=2)
+        bp.acquire()
+        bp.acquire()
+        with pytest.raises(CircuitBreakingError):
+            bp.acquire()
+        assert bp.rejections == 1
+        bp.release()
+        bp.acquire()
+
+    def test_node_rejects_when_saturated(self, node):
+        node.search_backpressure.max_concurrent = 0
+        res = node.request("POST", "/ops/_search", {})
+        assert res["_status"] == 429
+        node.search_backpressure.max_concurrent = 100
+        assert node.request("POST", "/ops/_search", {})["_status"] == 200
+        # gate fully released even across rejections
+        assert node.search_backpressure.current == 0
+
+
+class TestCancellation:
+    def test_cancelled_search_aborts(self, node):
+        from opensearch_tpu.common.errors import TaskCancelledError
+        from opensearch_tpu.search.controller import execute_search
+        task = node.task_manager.register("test-search", cancellable=True)
+        node.task_manager.cancel(task.task_id)
+        executors = [s.executor
+                     for s in node.indices.get("ops").shards]
+        with pytest.raises(TaskCancelledError):
+            execute_search(executors, {"query": {"match_all": {}}},
+                           task=task)
+
+
+class TestProfile:
+    def test_profile_breakdown(self, node):
+        res = node.request("POST", "/ops/_search", {
+            "query": {"match": {"msg": "message"}}, "profile": True})
+        shards = res["profile"]["shards"]
+        assert len(shards) == 1
+        q = shards[0]["searches"][0]["query"][0]
+        assert q["type"] == "TpuQueryPhase"
+        assert q["time_in_nanos"] > 0
+        assert q["breakdown"]["segments"] >= 1
+
+    def test_no_profile_by_default(self, node):
+        res = node.request("POST", "/ops/_search", {})
+        assert "profile" not in res
+
+
+class TestSlowLog:
+    def test_slow_log_emitted(self, node, caplog):
+        node.request("PUT", "/ops/_settings", {
+            "index": {"search.slowlog.threshold.query.warn": "0ms"}})
+        with caplog.at_level(logging.WARNING,
+                             logger="opensearch_tpu.index.search.slowlog"):
+            node.request("POST", "/ops/_search",
+                         {"query": {"match_all": {}}})
+        assert any("took[" in r.message or "took[" in r.getMessage()
+                   for r in caplog.records)
+
+    def test_no_log_without_threshold(self, node, caplog):
+        with caplog.at_level(logging.WARNING,
+                             logger="opensearch_tpu.index.search.slowlog"):
+            node.request("POST", "/ops/_search", {})
+        assert not caplog.records
