@@ -1,0 +1,140 @@
+// Native analysis hot path: the standard tokenizer.
+//
+// The reference's per-doc hot loop lives inside Lucene's Java
+// StandardTokenizer; here the indexing-side analog is the Python regex in
+// opensearch_tpu/analysis/registry.py. This C++ implementation matches that
+// regex's semantics EXACTLY for ASCII input:
+//
+//   [^\W_]+(?:['’.](?=[^\W\d_])[^\W\d_]+|[.,](?=\d)\d+)*
+//
+//   - a token starts with an alphanumeric run;
+//   - an interior apostrophe/dot followed by a letter joins a letter run
+//     (don't, U.S.A);
+//   - an interior dot/comma followed by a digit joins a digit run
+//     (3.14, 1,000).
+//
+// Non-ASCII input falls back to the Python regex (the binding checks for
+// bytes >= 0x80 before calling in), so behavior never diverges.
+//
+// Exported C ABI (ctypes, no pybind11 per the build environment):
+//   ost_tokenize_standard(text, len, max_token_length, lowercase, &n)
+//     -> malloc'd buffer of "token\tposition" lines joined by '\n'
+//        (explicit positions: over-length tokens are dropped but still
+//        consume a position, matching the Python regex path's enumerate)
+//        (caller frees via ost_free)
+//   ost_tokenize_batch(...) -> same over '\x01'-separated documents,
+//     documents separated by '\x02' in the output.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+inline bool is_alpha(unsigned char c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z');
+}
+inline bool is_digit(unsigned char c) { return c >= '0' && c <= '9'; }
+inline bool is_alnum(unsigned char c) { return is_alpha(c) || is_digit(c); }
+inline char lower(char c) {
+  return (c >= 'A' && c <= 'Z') ? char(c + 32) : c;
+}
+
+// Appends the tokens of `text` to `out`, '\n'-separated. Returns count.
+int tokenize_into(const char* text, size_t len, int max_token_length,
+                  bool lowercase, std::string& out) {
+  int count = 0;
+  int pos = 0;
+  size_t i = 0;
+  while (i < len) {
+    unsigned char c = (unsigned char)text[i];
+    if (!is_alnum(c)) {
+      ++i;
+      continue;
+    }
+    size_t start = i;
+    while (i < len && is_alnum((unsigned char)text[i])) ++i;
+    // joins: ['.](letter)+  |  [.,](digit)+
+    for (;;) {
+      if (i + 1 < len) {
+        unsigned char sep = (unsigned char)text[i];
+        unsigned char nxt = (unsigned char)text[i + 1];
+        if ((sep == '\'' || sep == '.') && is_alpha(nxt)) {
+          i += 1;
+          while (i < len && is_alpha((unsigned char)text[i])) ++i;
+          continue;
+        }
+        if ((sep == '.' || sep == ',') && is_digit(nxt)) {
+          i += 1;
+          while (i < len && is_digit((unsigned char)text[i])) ++i;
+          continue;
+        }
+      }
+      break;
+    }
+    size_t tok_len = i - start;
+    if ((int)tok_len <= max_token_length) {
+      if (count > 0) out.push_back('\n');
+      size_t base = out.size();
+      out.append(text + start, tok_len);
+      if (lowercase) {
+        for (size_t k = base; k < out.size(); ++k) out[k] = lower(out[k]);
+      }
+      out.push_back('\t');
+      out.append(std::to_string(pos));
+      ++count;
+    }
+    ++pos;  // dropped over-length tokens still consume a position
+  }
+  return count;
+}
+
+char* finish(std::string& buf) {
+  char* res = (char*)std::malloc(buf.size() + 1);
+  if (res == nullptr) return nullptr;
+  std::memcpy(res, buf.data(), buf.size());
+  res[buf.size()] = '\0';
+  return res;
+}
+
+}  // namespace
+
+extern "C" {
+
+char* ost_tokenize_standard(const char* text, int32_t len,
+                            int32_t max_token_length, int32_t lowercase,
+                            int32_t* n_tokens) {
+  std::string out;
+  out.reserve((size_t)len + 16);
+  *n_tokens = tokenize_into(text, (size_t)len, max_token_length,
+                            lowercase != 0, out);
+  return finish(out);
+}
+
+// docs separated by '\x01' in input; token groups separated by '\x02' in
+// output (tokens within a doc '\n'-separated). One FFI crossing per batch.
+char* ost_tokenize_batch(const char* docs, int32_t len,
+                         int32_t max_token_length, int32_t lowercase,
+                         int32_t* n_docs) {
+  std::string out;
+  out.reserve((size_t)len + 64);
+  int32_t count = 0;
+  size_t start = 0;
+  for (size_t i = 0; i <= (size_t)len; ++i) {
+    if (i == (size_t)len || docs[i] == '\x01') {
+      if (count > 0) out.push_back('\x02');
+      tokenize_into(docs + start, i - start, max_token_length,
+                    lowercase != 0, out);
+      ++count;
+      start = i + 1;
+    }
+  }
+  *n_docs = count;
+  return finish(out);
+}
+
+void ost_free(char* p) { std::free(p); }
+
+}  // extern "C"

@@ -43,6 +43,12 @@ _STANDARD_WORD = re.compile(
 # ---------------------------------------------------------------- tokenizers
 
 def standard_tokenizer(text: str, max_token_length: int = 255) -> List[Token]:
+    # native C++ fast path for ASCII input (native/analysis.cpp; exact
+    # same token stream, falls through on non-ASCII or missing toolchain)
+    from opensearch_tpu.analysis.native import tokenize_standard_ascii
+    native = tokenize_standard_ascii(text, max_token_length)
+    if native is not None:
+        return native
     out = []
     for pos, m in enumerate(_STANDARD_WORD.finditer(text)):
         tok = m.group(0)

@@ -1,0 +1,81 @@
+"""Native C++ analysis component tests: exact parity with the Python
+regex tokenizer, fallback behavior, and a speedup sanity check."""
+
+import random
+import re
+import string
+import time
+
+import pytest
+
+from opensearch_tpu.analysis.native import (
+    native_available, tokenize_standard_ascii)
+from opensearch_tpu.analysis.registry import _STANDARD_WORD
+
+
+def python_tokenize(text, max_token_length=255):
+    return [(m.group(0), i) for i, m in
+            enumerate(_STANDARD_WORD.finditer(text))
+            if len(m.group(0)) <= max_token_length]
+
+
+needs_native = pytest.mark.skipif(not native_available(),
+                                  reason="native toolchain unavailable")
+
+
+@needs_native
+class TestNativeTokenizerParity:
+    CASES = [
+        "The quick brown Fox jumps over 2 lazy dogs",
+        "don't U.S.A v2.0 O'Neill it's",
+        "pi is 3.14159 and 1,000,000 is a million",
+        "a.b.c x'y'z 1.2.3",
+        "trailing. dots. and, commas,",
+        "'leading quote and -dashes- under_scores",
+        "",
+        "     ",
+        "...,,,'''",
+        "x" * 300 + " ok",          # over max_token_length
+        "ends with digit join 1,",  # separator at end of input
+        "A1b2C3 mixed4alnum",
+    ]
+
+    @pytest.mark.parametrize("text", CASES)
+    def test_matches_python_regex(self, text):
+        assert tokenize_standard_ascii(text) == python_tokenize(text)
+
+    def test_randomized_parity(self):
+        rng = random.Random(42)
+        alphabet = string.ascii_letters + string.digits + " .,'_-!?"
+        for _ in range(300):
+            text = "".join(rng.choice(alphabet)
+                           for _ in range(rng.randrange(0, 120)))
+            assert tokenize_standard_ascii(text) == python_tokenize(text), \
+                repr(text)
+
+    def test_lowercase_flag(self):
+        toks = tokenize_standard_ascii("Hello WORLD", lowercase=True)
+        assert toks == [("hello", 0), ("world", 1)]
+
+    def test_non_ascii_falls_back(self):
+        assert tokenize_standard_ascii("héllo wörld") is None
+
+    def test_end_to_end_through_analyzer(self):
+        from opensearch_tpu.analysis.registry import get_default_registry
+        analyzer = get_default_registry().get("standard")
+        assert analyzer.terms("The U.S.A Doesn't sleep") == \
+            ["the", "u.s.a", "doesn't", "sleep"]
+
+    def test_speedup_over_python(self):
+        text = " ".join(f"token{i} value{i}.{i} don't" for i in range(200))
+        n = 300
+        t0 = time.perf_counter()
+        for _ in range(n):
+            tokenize_standard_ascii(text)
+        native_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(n):
+            python_tokenize(text)
+        python_s = time.perf_counter() - t0
+        # the native path must actually be faster (typically 5-20x)
+        assert native_s < python_s, (native_s, python_s)
