@@ -24,7 +24,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 N_DOCS = int(os.environ.get("BENCH_DOCS", "100000"))
 VOCAB = int(os.environ.get("BENCH_VOCAB", "20000"))
-N_QUERIES = int(os.environ.get("BENCH_QUERIES", "256"))
+N_QUERIES = int(os.environ.get("BENCH_QUERIES", "1024"))
 TOP_K = 10
 
 

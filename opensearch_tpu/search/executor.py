@@ -336,9 +336,11 @@ class SearchExecutor:
                             if n.type not in PIPELINE_TYPES]
         k_fetch = min(k + 128, 1 << 16)  # over-fetch for ties & cross-seg merge
 
-        candidates: List[_Candidate] = []
-        per_segment_decoded = []
-        total = 0
+        # phase 1: dispatch every segment's program without forcing — jax
+        # dispatch is async, so device work overlaps; phase 2 collects ALL
+        # results in ONE device_get (one transfer round trip total — on a
+        # tunneled device the round trip dominates device compute)
+        launched = []
         for seg_i, (seg, (arrays, meta)) in enumerate(
                 zip(self.reader.segments, self.reader.device)):
             if seg.num_docs == 0:
@@ -355,14 +357,19 @@ class SearchExecutor:
             for ap in agg_plans:
                 ap.flatten_inputs(flat)
             flat = jax.tree_util.tree_map(jnp.asarray, flat)
-            top_keys, top_scores, top_idx, seg_total, agg_outs = fn(
-                arrays, flat, sort_key, jnp.float32(min_score))
+            launched.append((seg_i, seg, agg_plans,
+                             fn(arrays, flat, sort_key,
+                                jnp.float32(min_score))))
+
+        fetched = jax.device_get([out for _, _, _, out in launched])
+
+        candidates: List[_Candidate] = []
+        per_segment_decoded = []
+        total = 0
+        for (seg_i, seg, agg_plans, _), outs in zip(launched, fetched):
+            top_keys, top_scores, top_idx, seg_total, agg_outs = outs
             if agg_nodes:
-                agg_outs = jax.tree_util.tree_map(np.asarray, agg_outs)
                 per_segment_decoded.append(decode_outputs(agg_plans, agg_outs))
-            top_keys = np.asarray(top_keys)
-            top_scores = np.asarray(top_scores)
-            top_idx = np.asarray(top_idx)
             total += int(seg_total)
             for key_val, score, ord_ in zip(top_keys, top_scores, top_idx):
                 if key_val == NEG_INF:
@@ -444,6 +451,18 @@ class SearchExecutor:
             # doc-asc tie-break (lax.top_k picks the lowest index) merges to
             # the exact global page for score-sorted queries
             k = max(from_ + size, 10)
+            if all(p is None or p.kind == "match_none" for p in plans):
+                # no term matched any segment: answer host-side, zero
+                # device work (the can-match pre-filter analog)
+                responses[i] = {
+                    "took": int((time.monotonic() - start) * 1000),
+                    "timed_out": False,
+                    "_shards": {"total": 1, "successful": 1, "skipped": 0,
+                                "failed": 0},
+                    "hits": {"total": {"value": 0, "relation": "eq"},
+                             "max_score": None, "hits": []},
+                }
+                continue
             struct = tuple(plan_struct(p) if p is not None else None
                            for p in plans)
             groups.setdefault((struct, min(k, 1 << 16)), []).append(i)
@@ -470,11 +489,15 @@ class SearchExecutor:
                 pending.append((idxs, seg_i, k_seg,
                                 fn(arrays, batched, min_scores)))
 
-        # phase 2: collect (vectorized — no per-candidate python objects)
+        # phase 2: collect (vectorized — no per-candidate python objects);
+        # ONE device_get for every group×segment result = one transfer
+        # round trip for the whole msearch batch
+        grouped = [i for idxs in groups.values() for i in idxs]
         per_query_segs: Dict[int, List[Tuple[int, np.ndarray, np.ndarray]]] = \
-            {e[0]: [] for e in batchable}
-        per_query_total: Dict[int, int] = {e[0]: 0 for e in batchable}
-        for idxs, seg_i, k_seg, packed in pending:
+            {i: [] for i in grouped}
+        per_query_total: Dict[int, int] = {i: 0 for i in grouped}
+        fetched = jax.device_get([packed for _, _, _, packed in pending])
+        for (idxs, seg_i, k_seg, _), packed in zip(pending, fetched):
             scores_b, idx_b, total_b = unpack_batched_result(
                 np.asarray(packed), k_seg)
             for row, i in enumerate(idxs):
