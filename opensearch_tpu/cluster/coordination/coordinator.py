@@ -100,6 +100,10 @@ class Coordinator:
     def _become_candidate(self, reason: str):
         self.mode = Mode.CANDIDATE
         self.leader = None
+        # any in-flight publication is dead once deposed: clear the slot so
+        # a later re-election can publish again (the timeout timer is bound
+        # to a version and would no longer clear it for us)
+        self._publish_in_flight = False
         self._leader_check_failures = 0
         self._election_epoch += 1
         self._schedule_election()
@@ -107,6 +111,7 @@ class Coordinator:
     def _become_leader(self):
         self.mode = Mode.LEADER
         self.leader = self.node_id
+        self._publish_in_flight = False
         self._election_epoch += 1
         self._check_failures = {}
         self._schedule_follower_checks()
@@ -118,6 +123,7 @@ class Coordinator:
             return
         self.mode = Mode.FOLLOWER
         self.leader = leader
+        self._publish_in_flight = False
         self._leader_check_failures = 0
         self._election_epoch += 1
         self._schedule_leader_check()
@@ -239,11 +245,23 @@ class Coordinator:
             return {"join": (join.source_node, join.target_node, join.term,
                              join.last_accepted_term,
                              join.last_accepted_version)}
+        if "join" in payload:
+            # a joiner's vote for the current term (JoinHelper: a join
+            # request carries an optional Join when the sender adopted our
+            # term) — recorded so reconfiguration quorums can include it.
+            source, target, term, la_term, la_version = payload["join"]
+            self._handle_incoming_join(Join(source, target, term, la_term,
+                                            la_version))
+            if self.mode == Mode.LEADER:
+                self._publish_next()
+                return {"accepted": True}
+            return {"accepted": False, "leader": self.leader}
         # plain join request: node wants into the cluster (leader side)
         if self.mode == Mode.LEADER:
             self._pending_joins.add(sender)
             self._publish_next()
-            return {"accepted": True}
+            # return our term so the joiner can send a proper join vote
+            return {"accepted": True, "term": self.coord_state.current_term}
         return {"accepted": False, "leader": self.leader}
 
     def _on_join_response(self, resp):
@@ -286,14 +304,20 @@ class Coordinator:
         new_nodes = frozenset(set(base.nodes) | self._pending_joins
                               | {self.node_id})
         data = base.data
-        for update in self._pending_values:
+        taken_values = self._pending_values
+        taken_joins = self._pending_joins
+        for update in taken_values:
             tmp = update(base.with_(nodes=new_nodes, data=data))
             data = tmp.data
             new_nodes = tmp.nodes
         self._pending_values = []
         self._pending_joins = set()
-        new_config = self._reconfigure(new_nodes,
-                                       base.last_committed_config)
+        if base.last_accepted_config != base.last_committed_config:
+            # a reconfiguration is still uncommitted: don't start another
+            # (handleClientValue would reject it) — republish same config
+            new_config = base.last_accepted_config
+        else:
+            new_config = self._reconfigure(new_nodes)
         if (new_nodes == base.nodes and data is base.data
                 and new_config == base.last_accepted_config
                 and base.term == self.coord_state.current_term
@@ -310,28 +334,59 @@ class Coordinator:
         try:
             request = self.coord_state.handle_client_value(state)
         except CoordinationStateRejectedError:
+            # keep the client updates and joins for the next publish round
+            # instead of silently dropping them
+            self._pending_values = taken_values + self._pending_values
+            self._pending_joins |= taken_joins
             return
         self._publish_in_flight = True
         self._publish(request)
 
-    def _reconfigure(self, nodes: frozenset,
-                     current: VotingConfiguration) -> VotingConfiguration:
-        """Reconfigurator: voting config = all master-eligible live nodes,
-        trimmed to an odd count (every node is master-eligible here)."""
-        members = sorted(nodes)
+    def _reconfigure(self, nodes: frozenset) -> VotingConfiguration:
+        """Reconfigurator: voting config = live nodes with a join vote
+        (Coordinator.improveConfiguration filters by hasJoinVoteFrom) plus
+        live members of the current config (stability: a node that voted for
+        a losing candidate this term keeps its seat), trimmed to an odd
+        count. The join-quorum guard in handle_client_value needs only a
+        majority of the result to have voted, which retention preserves."""
+        voted = set(self.coord_state.join_votes) | {self.node_id}
+        current = self.coord_state.last_accepted.last_accepted_config.node_ids
+        members = sorted(n for n in nodes if n in voted or n in current)
+        if not members:
+            members = [self.node_id]
         if len(members) % 2 == 0 and len(members) > 1:
-            # drop one (prefer dropping a non-leader) to keep quorum odd
-            droppable = [n for n in members if n != self.node_id]
+            # drop one to keep quorum odd: prefer a non-voted member, never
+            # the leader
+            droppable = ([n for n in members
+                          if n not in voted and n != self.node_id]
+                         or [n for n in members if n != self.node_id])
             members.remove(droppable[-1])
-        return VotingConfiguration(frozenset(members))
+        config = VotingConfiguration(frozenset(members))
+        if not config.has_quorum(voted):
+            # would fail handle_client_value's join-quorum guard: keep the
+            # existing configuration until more joins arrive
+            return self.coord_state.last_accepted.last_accepted_config
+        return config
 
     def _publish(self, request: PublishRequest):
+        """Publication.java: fan the state to every node; once a commit
+        quorum of publish acks arrives, send ApplyCommit to each node that
+        has acked (never to one that hasn't — commit must not overtake the
+        publish on a node that hasn't accepted the state yet); late acks
+        get their commit on arrival."""
         state = request.state
-        acks_needed = state.nodes
+        reached_commit: List[Optional[ApplyCommitRequest]] = [None]
 
         def on_response(peer):
             def handle(resp):
                 if resp is None or self.mode != Mode.LEADER:
+                    return
+                if resp.get("join"):
+                    # the peer adopted our term with this publish and piggy-
+                    # backed its join vote (PublishWithJoinResponse)
+                    self._handle_incoming_join(Join(*resp["join"]))
+                if reached_commit[0] is not None:
+                    self._send_commit(peer, reached_commit[0])
                     return
                 try:
                     commit = self.coord_state.handle_publish_response(
@@ -340,11 +395,13 @@ class Coordinator:
                 except CoordinationStateRejectedError:
                     return
                 if commit is not None:
-                    self._broadcast_commit(commit, state)
+                    reached_commit[0] = commit
+                    acked = set(self.coord_state.publish_votes)
+                    self._finish_publication(commit, state, acked)
             return handle
 
         payload = {"state": state}
-        for peer in sorted(acks_needed):
+        for peer in sorted(state.nodes):
             if peer == self.node_id:
                 try:
                     resp = self.coord_state.handle_publish_request(request)
@@ -356,32 +413,41 @@ class Coordinator:
                 self.transport.send(self.node_id, peer, PUBLISH_ACTION,
                                     payload, on_response(peer),
                                     lambda e: None)
-        # publication timeout: if no commit in 30s, give up leadership is
-        # handled by leader/follower checks; here just clear in-flight
         self.scheduler.schedule_delayed(
-            30_000, self._publish_timeout, "publish timeout")
+            30_000, lambda: self._publish_timeout(state.version),
+            "publish timeout")
 
-    def _publish_timeout(self):
+    def _publish_timeout(self, published_version: int):
         """Publication.java onTimeout: a publication that cannot reach a
         commit quorum within the timeout deposes the leader — this is how a
-        minority-side leader stands down after a partition."""
-        if self._publish_in_flight:
+        minority-side leader stands down after a partition. The timer is
+        bound to the publication that armed it (by version) so a stale timer
+        from an earlier, long-committed publication cannot depose a healthy
+        leader while a later publication is briefly in flight."""
+        if self._publish_in_flight and \
+                self.coord_state.last_published_version == published_version:
             self._publish_in_flight = False
             if self.mode == Mode.LEADER:
                 self._become_candidate("publication failed to commit")
 
-    def _broadcast_commit(self, commit: ApplyCommitRequest,
-                          state: ClusterState):
+    def _send_commit(self, peer: str, commit: ApplyCommitRequest):
+        if peer == self.node_id:
+            self._apply_commit(commit)
+        else:
+            self.transport.send(
+                self.node_id, peer, COMMIT_ACTION,
+                {"term": commit.term, "version": commit.version},
+                None, lambda e: None)
+
+    def _finish_publication(self, commit: ApplyCommitRequest,
+                            state: ClusterState, acked_peers: Set[str]):
+        """Commit quorum reached: deliver ApplyCommit to the peers that
+        acked the publish and release the publication slot."""
         if not self._publish_in_flight:
             return  # already committed this publication
         self._publish_in_flight = False
-        payload = {"term": commit.term, "version": commit.version}
-        for peer in sorted(state.nodes):
-            if peer == self.node_id:
-                self._apply_commit(commit)
-            else:
-                self.transport.send(self.node_id, peer, COMMIT_ACTION,
-                                    payload, None, lambda e: None)
+        for peer in sorted(acked_peers):
+            self._send_commit(peer, commit)
         # more queued work?
         if self._pending_values or self._pending_joins:
             self.scheduler.schedule_now(self._publish_next,
@@ -390,15 +456,22 @@ class Coordinator:
     def _on_publish(self, sender: str, payload: dict):
         state: ClusterState = payload["state"]
         self.known_peers |= set(state.nodes)
+        join = None
         if state.term > self.coord_state.current_term:
             # accept the newer term implicitly (like handling a StartJoin)
-            self.coord_state.handle_start_join(
+            # and hand the new leader our join vote with the response
+            join = self.coord_state.handle_start_join(
                 StartJoinRequest(source_node=sender, term=state.term))
         resp = self.coord_state.handle_publish_request(
             PublishRequest(state))
         if sender != self.node_id:
             self._become_follower(sender)
-        return {"term": resp.term, "version": resp.version}
+        out = {"term": resp.term, "version": resp.version}
+        if join is not None:
+            out["join"] = (join.source_node, join.target_node, join.term,
+                           join.last_accepted_term,
+                           join.last_accepted_version)
+        return out
 
     def _on_commit(self, sender: str, payload: dict):
         commit = ApplyCommitRequest(source_node=sender,
@@ -545,6 +618,23 @@ class Coordinator:
         def on_response(resp):
             if resp and not resp.get("accepted") and resp.get("leader"):
                 self.join_cluster(resp["leader"])
+                return
+            if resp and resp.get("accepted") and \
+                    resp.get("term", 0) > self.coord_state.current_term:
+                # adopt the leader's term and hand it our join vote so the
+                # voting configuration can grow to include this node
+                try:
+                    join = self.coord_state.handle_start_join(
+                        StartJoinRequest(source_node=via,
+                                         term=resp["term"]))
+                except CoordinationStateRejectedError:
+                    return
+                self.transport.send(
+                    self.node_id, via, JOIN_ACTION,
+                    {"join": (join.source_node, join.target_node, join.term,
+                              join.last_accepted_term,
+                              join.last_accepted_version)},
+                    None, lambda e: None)
 
         self.known_peers.add(via)
         self.transport.send(self.node_id, via, JOIN_ACTION, {},

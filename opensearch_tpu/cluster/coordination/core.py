@@ -204,6 +204,21 @@ class CoordinationState:
             raise CoordinationStateRejectedError(
                 f"incoming version {state.version} not newer than accepted "
                 f"{self.last_accepted_version}")
+        if state.last_accepted_config != \
+                self.last_accepted.last_accepted_config:
+            # reconfiguration guards (CoordinationState.handleClientValue):
+            # no new reconfiguration while one is still uncommitted, and the
+            # election's join votes must form a quorum of the new config.
+            if self.last_accepted.last_committed_config != \
+                    self.last_accepted.last_accepted_config:
+                raise CoordinationStateRejectedError(
+                    "only allow reconfiguration while not already "
+                    "reconfiguring")
+            if not state.last_accepted_config.has_quorum(
+                    set(self.join_votes)):
+                raise CoordinationStateRejectedError(
+                    "only allow reconfiguration if join votes have quorum "
+                    "for new config")
         self.last_published_version = state.version
         self.last_published_config = state.last_accepted_config
         self.publish_votes = set()
@@ -263,5 +278,12 @@ class CoordinationState:
             raise CoordinationStateRejectedError(
                 f"incoming version {commit.version} does not match last "
                 f"accepted version {self.last_accepted_version}")
+        # markLastAcceptedStateAsCommitted: a committed state's accepted
+        # voting config becomes the committed config, so quorums track the
+        # current membership rather than staying frozen at bootstrap.
+        if self.last_accepted.last_committed_config != \
+                self.last_accepted.last_accepted_config:
+            self.last_accepted = self.last_accepted.with_(
+                last_committed_config=self.last_accepted.last_accepted_config)
         self.last_commit_version = commit.version
         return self.last_accepted

@@ -412,6 +412,16 @@ class InternalEngine:
             # bitmap) and doc_meta are per-copy mutable state — clone them
             # so a later delete on this copy can't corrupt the source
             self.segments = [seg.clone_for_copy() for seg in segments]
+            # advance the id counter past every installed segment id: a
+            # fresh replica (counter≈1) adopting s000000..s00000N must not
+            # mint a builder id that collides with an installed one —
+            # flush would then skip persisting the new segment (id already
+            # in _persisted) and silently lose docs
+            for seg in self.segments:
+                suffix = seg.seg_id.lstrip("s")
+                if suffix.isdigit():
+                    self._seg_counter = max(self._seg_counter,
+                                            int(suffix) + 1)
             self.builder = SegmentBuilder(self.mapper, self._next_seg_id())
             self._builder_ords = {}
             self.version_map = {}

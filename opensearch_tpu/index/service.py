@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import secrets
 import time
+import uuid
 from typing import Any, Dict, List, Optional
 
 from opensearch_tpu.cluster.routing import generate_shard_id
@@ -46,6 +47,11 @@ class IndexService:
         settings = settings or {}
         self.index_name = index_name
         self.settings = settings
+        # index UUID (IndexMetadata.SETTING_INDEX_UUID): identifies this
+        # *incarnation* of the index — snapshot blob paths key on it so a
+        # delete+recreate under the same name can never alias stale blobs
+        self.uuid = settings.get("uuid") or uuid.uuid4().hex[:22]
+        settings.setdefault("uuid", self.uuid)
         self._script_service = script_service
         self.num_shards = int(settings.get("number_of_shards", 1))
         self.num_replicas = int(settings.get("number_of_replicas", 0))

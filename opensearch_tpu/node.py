@@ -45,7 +45,10 @@ class Node:
         from opensearch_tpu.common.breakers import (
             CircuitBreakerService, IndexingPressure, SearchBackpressure)
         from opensearch_tpu.tasks import TaskManager
-        self.repositories = RepositoriesService()
+        path_repo = self.settings.get("path.repo") or []
+        if isinstance(path_repo, str):
+            path_repo = [path_repo]
+        self.repositories = RepositoriesService(path_repo=path_repo)
         self.data_streams = DataStreamService(self)
         self.task_manager = TaskManager()
         self.breaker_service = CircuitBreakerService()

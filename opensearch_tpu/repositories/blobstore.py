@@ -9,10 +9,13 @@ repository (`fs` type, repository-url's local cousin). Layout:
     index.json                      ← RepositoryData: snapshot list
     snapshots/<name>.json           ← per-snapshot manifest (indices, shard
                                       segment ids, live masks, mappings)
-    indices/<index>/<shard>/seg_*   ← segment blobs, shared across
-                                      snapshots, deduplicated by
-                                      name+checksum (segments are immutable)
-    indices/<index>/<shard>/liv_<snap>_<seg>.npy ← per-snapshot deletes
+    indices/<uuid>/<shard>/seg_*    ← segment blobs, shared across
+                                      snapshots, keyed by index *UUID* (a
+                                      delete+recreate under the same name
+                                      gets a fresh UUID, so stale blobs can
+                                      never alias) and deduplicated by
+                                      seg_id with a metadata identity check
+    indices/<uuid>/<shard>/liv_<snap>_<seg>.npy ← per-snapshot deletes
 
 Segments being immutable makes incrementality trivial and exact: a segment
 blob is written once, ever; only liveness masks are per-snapshot.
@@ -107,6 +110,7 @@ class FsRepository:
         for index_name in index_names:
             svc = indices_svc.get(index_name)
             index_entry = {
+                "uuid": svc.uuid,
                 "mappings": svc.mapping_dict(),
                 "settings": {"number_of_shards": svc.num_shards,
                              "number_of_replicas": svc.num_replicas,
@@ -116,7 +120,7 @@ class FsRepository:
             for shard in svc.shards:
                 total_shards += 1
                 index_entry["shards"].append(
-                    self._snapshot_shard(snapshot, index_name, shard))
+                    self._snapshot_shard(snapshot, svc.uuid, shard))
             manifest["indices"][index_name] = index_entry
         manifest["state"] = "SUCCESS"
         manifest["end_time_in_millis"] = int(time.time() * 1000)
@@ -132,24 +136,42 @@ class FsRepository:
         self._write_repository_data(data)
         return manifest
 
-    def _shard_dir(self, index_name: str, shard_id: int) -> str:
-        return os.path.join(self.location, "indices", index_name,
+    def _shard_dir(self, index_uuid: str, shard_id: int) -> str:
+        return os.path.join(self.location, "indices", index_uuid,
                             str(shard_id))
 
-    def _snapshot_shard(self, snapshot: str, index_name: str, shard) -> dict:
+    def _snapshot_shard(self, snapshot: str, index_uuid: str, shard) -> dict:
         """Upload one shard: write missing segment blobs (dedup — a blob is
-        keyed by its immutable seg_id), plus this snapshot's live masks."""
+        keyed by its immutable seg_id under the index UUID, with a metadata
+        identity check), plus this snapshot's live masks."""
         shard.engine.refresh()
-        shard_dir = self._shard_dir(index_name, shard.shard_id)
+        shard_dir = self._shard_dir(index_uuid, shard.shard_id)
         blob_store = Store(shard_dir)
         seg_ids = []
         new_files = 0
         for seg in shard.engine.segments:
             seg_ids.append(seg.seg_id)
-            npz_path, _, _ = blob_store._seg_paths(seg.seg_id)
+            npz_path, meta_path, _ = blob_store._seg_paths(seg.seg_id)
             if not os.path.exists(npz_path):
                 blob_store.write_segment(seg)
                 new_files += 1
+            else:
+                # a blob of this name exists: verify it is the same segment
+                # before skipping the upload — never silently dedup against
+                # different content
+                try:
+                    with open(meta_path) as fh:
+                        existing = json.load(fh)
+                    same = (existing.get("num_docs") == seg.num_docs
+                            and existing.get("doc_ids") == seg.doc_ids)
+                except (OSError, ValueError):
+                    same = False
+                if not same:
+                    raise OpenSearchTpuError(
+                        f"repository [{self.name}] blob conflict for "
+                        f"segment [{seg.seg_id}] of index uuid "
+                        f"[{index_uuid}]: existing blob holds different "
+                        f"content")
             liv = os.path.join(shard_dir,
                                f"liv_{snapshot}_{seg.seg_id}.npy")
             np.save(liv, seg.live)
@@ -182,12 +204,15 @@ class FsRepository:
                     f"cannot restore index [{new_name}] because an open "
                     f"index with same name already exists in the cluster")
             settings = dict(entry["settings"])
+            # a restored index is a new incarnation: it must mint a fresh
+            # UUID so its future snapshots don't collide with the source's
+            settings.pop("uuid", None)
             svc = indices_svc.create_index(new_name, {
                 "settings": settings, "mappings": entry["mappings"]},
                 apply_templates=False)
             for shard_entry in entry["shards"]:
                 shard = svc.shards[shard_entry["shard_id"]]
-                shard_dir = self._shard_dir(index_name,
+                shard_dir = self._shard_dir(entry.get("uuid", index_name),
                                             shard_entry["shard_id"])
                 blob_store = Store(shard_dir)
                 segments = []
@@ -224,14 +249,14 @@ class FsRepository:
             m = self.get_manifest(name)
             for idx, entry in m["indices"].items():
                 for shard_entry in entry["shards"]:
-                    key = (idx, shard_entry["shard_id"])
+                    key = (entry.get("uuid", idx), shard_entry["shard_id"])
                     referenced.setdefault(key, set()).update(
                         shard_entry["segments"])
         for idx, entry in manifest["indices"].items():
             for shard_entry in entry["shards"]:
-                key = (idx, shard_entry["shard_id"])
+                key = (entry.get("uuid", idx), shard_entry["shard_id"])
                 keep = referenced.get(key, set())
-                shard_dir = self._shard_dir(idx, shard_entry["shard_id"])
+                shard_dir = self._shard_dir(key[0], shard_entry["shard_id"])
                 if not os.path.isdir(shard_dir):
                     continue
                 for seg_id in shard_entry["segments"]:
@@ -273,10 +298,23 @@ class FsRepository:
 
 
 class RepositoriesService:
-    """Registry of named repositories (repositories/RepositoriesService.java)."""
+    """Registry of named repositories (repositories/RepositoriesService.java).
 
-    def __init__(self):
+    `path_repo` is the FsRepository.LOCATION allowlist (`path.repo` in the
+    reference, Environment.repoFiles): a REST client may only register fs
+    repositories whose normalized location resolves under one of these
+    roots — otherwise PUT /_snapshot would let any HTTP client create
+    directories and (via snapshot-delete GC) remove files at arbitrary
+    writable paths."""
+
+    def __init__(self, path_repo: Optional[List[str]] = None):
+        self.path_repo = [os.path.realpath(p) for p in (path_repo or [])]
         self.repositories: Dict[str, FsRepository] = {}
+
+    def _location_allowed(self, location: str) -> bool:
+        resolved = os.path.realpath(location)
+        return any(resolved == root or resolved.startswith(root + os.sep)
+                   for root in self.path_repo)
 
     def put_repository(self, name: str, body: dict) -> FsRepository:
         repo_type = (body or {}).get("type")
@@ -288,6 +326,13 @@ class RepositoriesService:
         if not location:
             raise IllegalArgumentError(
                 "[fs] missing location setting")
+        if not self._location_allowed(location):
+            raise IllegalArgumentError(
+                f"location [{location}] doesn't match any of the locations "
+                f"specified by path.repo because this setting is empty"
+                if not self.path_repo else
+                f"location [{location}] doesn't match any of the locations "
+                f"specified by path.repo: {self.path_repo}")
         repo = FsRepository(name, location)
         self.repositories[name] = repo
         return repo

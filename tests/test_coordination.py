@@ -60,6 +60,19 @@ class Cluster:
             f"expected one leader, got {[c.node_id for c in leaders]}"
         return leaders[0]
 
+    def add_node(self, node_id: str, via: str):
+        """Boot a fresh (un-bootstrapped) node and have it join `via`."""
+        self.transport.register_node(node_id)
+        coord = Coordinator(node_id, self.transport, self.queue,
+                            ClusterState(),
+                            on_state_applied=self._applier(node_id))
+        self.coordinators[node_id] = coord
+        self.applied[node_id] = []
+        self.node_ids.append(node_id)
+        coord.start()
+        coord.join_cluster(via)
+        return coord
+
 
 SEEDS = [0, 1, 2, 7, 42]
 
@@ -203,6 +216,42 @@ class TestFailureRecovery:
         for c in cluster.coordinators.values():
             assert c.applied_state.version == final.applied_state.version
             assert c.applied_state.master_node == final.node_id
+
+    @pytest.mark.parametrize("seed", SEEDS[:3])
+    def test_survives_loss_of_bootstrap_majority(self, seed):
+        """Regression (round-1 advisor, high): the committed voting config
+        must follow membership via commit promotion
+        (markLastAcceptedStateAsCommitted). Grow a 3-node bootstrap cluster
+        to 5, then kill 2 of the original bootstrap nodes — a majority of
+        *current* members is alive, so the cluster must keep electing and
+        committing even though a majority of the *bootstrap* config is gone."""
+        cluster = Cluster(3, seed)
+        cluster.stabilise()
+        leader = cluster.the_leader()
+        cluster.add_node("extra-0", via=leader.node_id)
+        cluster.add_node("extra-1", via=leader.node_id)
+        cluster.stabilise(120_000)
+        state = cluster.the_leader().applied_state
+        assert {"extra-0", "extra-1"} <= set(state.nodes)
+        # voting config must have grown beyond the bootstrap trio
+        assert {"extra-0", "extra-1"} & set(
+            state.last_committed_config.node_ids), \
+            f"committed config frozen at bootstrap: {state}"
+        # kill two bootstrap nodes (possibly including the leader)
+        for nid in ["node-1", "node-2"]:
+            cluster.transport.kill_node(nid)
+            cluster.coordinators[nid].stop()
+        cluster.stabilise(240_000)
+        survivors = [c for c in cluster.coordinators.values()
+                     if cluster.transport_alive(c.node_id)]
+        new_leaders = [c for c in survivors if c.mode == Mode.LEADER]
+        assert len(new_leaders) == 1, \
+            "cluster failed to elect after losing bootstrap majority"
+        ok = new_leaders[0].submit_state_update(
+            lambda s: s.with_(data={"post-loss": True}))
+        assert ok
+        cluster.stabilise(60_000)
+        assert new_leaders[0].applied_state.data == {"post-loss": True}
 
     @pytest.mark.parametrize("seed", SEEDS[:2])
     def test_committed_states_never_diverge(self, seed):

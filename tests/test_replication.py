@@ -31,6 +31,36 @@ def group(tmp_path):
     return ShardReplicationGroup(primary, replicas)
 
 
+class TestInstallSegments:
+    def test_indexing_after_install_does_not_lose_docs(self, tmp_path):
+        """Regression (round-1 advisor, high): install_segments must advance
+        the segment id counter past the installed ids, or the next refresh
+        mints a colliding id and flush silently skips persisting it."""
+        from opensearch_tpu.index.engine import InternalEngine
+
+        mapper = MapperService({"properties": {"n": {"type": "long"}}})
+        primary = InternalEngine(mapper)
+        for i in range(3):
+            primary.index(f"p{i}", {"n": i})
+            primary.refresh()   # seals s000000..s000002
+        replica = InternalEngine(mapper, data_path=str(tmp_path / "r"))
+        replica.install_segments(primary.segments,
+                                 max_seq_no=primary.max_seq_no,
+                                 local_checkpoint=primary.local_checkpoint)
+        ids = {s.seg_id for s in replica.segments}
+        # index new docs on the recovered engine (e.g. after promotion)
+        replica.index("new0", {"n": 100})
+        new_seg = replica.refresh()
+        assert new_seg.seg_id not in ids, \
+            f"builder id {new_seg.seg_id} collides with installed ids {ids}"
+        replica.flush()
+        reopened = InternalEngine(mapper, data_path=str(tmp_path / "r"))
+        assert reopened.get("new0") is not None, \
+            "doc lost after install_segments + flush + reopen"
+        for i in range(3):
+            assert reopened.get(f"p{i}") is not None
+
+
 class TestDocumentReplication:
     def test_writes_reach_replicas(self, group):
         for i in range(5):

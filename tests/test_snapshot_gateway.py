@@ -25,8 +25,10 @@ def seed(node, index="snap-src", n=8):
 
 
 @pytest.fixture()
-def node():
-    return Node()
+def node(tmp_path):
+    # repository locations must resolve under path.repo (security: PUT
+    # /_snapshot would otherwise create/delete files at arbitrary paths)
+    return Node(settings={"path.repo": [str(tmp_path)]})
 
 
 @pytest.fixture()
@@ -49,6 +51,63 @@ class TestRepositories:
     def test_unsupported_type_rejected(self, node):
         res = node.request("PUT", "/_snapshot/bad", {"type": "s3"})
         assert res["_status"] == 400
+
+    def test_location_outside_path_repo_rejected(self, node, tmp_path):
+        """Regression (round-1 advisor, medium): an HTTP client must not be
+        able to point a repository at an arbitrary writable path."""
+        res = node.request("PUT", "/_snapshot/evil", {
+            "type": "fs", "settings": {"location": "/etc/passwd-dir"}})
+        assert res["_status"] == 400
+        # traversal out of an allowed root is also caught (normalization)
+        res = node.request("PUT", "/_snapshot/evil2", {
+            "type": "fs",
+            "settings": {"location": str(tmp_path / ".." / "esc")}})
+        assert res["_status"] == 400
+
+    def test_no_path_repo_rejects_everything(self, tmp_path):
+        bare = Node()
+        res = bare.request("PUT", "/_snapshot/r", {
+            "type": "fs", "settings": {"location": str(tmp_path)}})
+        assert res["_status"] == 400
+
+
+class TestSnapshotUuidKeying:
+    def test_recreated_index_does_not_alias_stale_blobs(self, node, repo):
+        """Regression (round-1 advisor, medium): deleting an index and
+        recreating it under the same name, then snapshotting to the same
+        repository, must not silently reuse the old incarnation's blobs."""
+        seed(node, index="reborn", n=4)
+        node.request("PUT", "/_snapshot/backup/snap-old",
+                     {"indices": "reborn"})
+        node.request("DELETE", "/reborn")
+        # same name, different content
+        node.request("PUT", "/reborn", {
+            "settings": {"number_of_shards": 2},
+            "mappings": {"properties": {"msg": {"type": "text"},
+                                        "n": {"type": "integer"}}}})
+        for i in range(3):
+            node.request("PUT", f"/reborn/_doc/new{i}",
+                         {"msg": f"fresh doc {i}", "n": 100 + i})
+        node.request("POST", "/reborn/_refresh")
+        node.request("PUT", "/_snapshot/backup/snap-new",
+                     {"indices": "reborn"})
+        node.request("DELETE", "/reborn")
+        res = node.request("POST", "/_snapshot/backup/snap-new/_restore", {})
+        assert res.get("_status", 200) == 200
+        node.request("POST", "/reborn/_refresh")
+        hits = node.request("POST", "/reborn/_search", {
+            "query": {"match_all": {}}, "size": 20})["hits"]
+        assert hits["total"]["value"] == 3
+        ids = {h["_id"] for h in hits["hits"]}
+        assert ids == {"new0", "new1", "new2"}, \
+            f"restore served stale blobs from the old incarnation: {ids}"
+        # the old incarnation restores correctly too (blobs still intact)
+        node.request("DELETE", "/reborn")
+        node.request("POST", "/_snapshot/backup/snap-old/_restore", {})
+        node.request("POST", "/reborn/_refresh")
+        hits = node.request("POST", "/reborn/_search", {
+            "query": {"match_all": {}}, "size": 20})["hits"]
+        assert hits["total"]["value"] == 4
 
 
 class TestSnapshotRestore:
