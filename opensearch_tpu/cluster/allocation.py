@@ -1,0 +1,150 @@
+"""Shard allocation: assign primaries and replicas to live nodes.
+
+Re-design of the reference allocator stack — BalancedShardsAllocator
+(cluster/routing/allocation/allocator/BalancedShardsAllocator.java:85)
+weight-balancing shard counts per node, gated by the decider chain
+(cluster/routing/allocation/decider/SameShardAllocationDecider.java — at
+most one copy of a shard per node) — collapsed into one pure function over
+the cluster-state payload. The reference's RoutingTable/ShardRouting
+object model becomes the plain-dict `routing` table carried in
+ClusterState.data (serialized by transport/serde.py):
+
+  routing[index] = [            # one entry per shard id
+    {"primary": node_id | None, # assigned primary copy
+     "primary_term": int,       # bumped on every promotion/assignment
+     "replicas": [node_id...],  # assigned replica copies
+     "active_replicas": [...]}, # recovered, in-sync copies (subset)
+  ]
+
+Promotion on primary loss picks from active_replicas — the in-sync-
+allocation-ids rule (cluster/metadata/IndexMetadata "in_sync_allocations"
++ gateway/PrimaryShardAllocator.java:80): only a copy that finished
+recovery may become primary, never a stale or initializing one.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional
+
+
+def _copy_counts(routing: Dict[str, List[dict]], live: List[str]
+                 ) -> Dict[str, int]:
+    counts = {n: 0 for n in live}
+    for shards in routing.values():
+        for entry in shards:
+            for n in [entry.get("primary")] + entry.get("replicas", []):
+                if n in counts:
+                    counts[n] += 1
+    return counts
+
+
+def _least_loaded(counts: Dict[str, int], exclude: set) -> Optional[str]:
+    candidates = [(c, n) for n, c in counts.items() if n not in exclude]
+    if not candidates:
+        return None
+    candidates.sort()
+    return candidates[0][1]
+
+
+def allocate(data: dict, live_nodes: List[str]) -> dict:
+    """Compute a new routing table for `data` given the live node set.
+
+    Pure: returns a new data dict (cluster states are immutable values).
+    Handles initial allocation, node-left cleanup, replica promotion, and
+    replica count reconciliation. Idempotent: allocating an already-
+    balanced table is a no-op (callers diff to decide whether to publish).
+    """
+    data = copy.deepcopy(data)
+    live = sorted(set(live_nodes))
+    indices: Dict[str, dict] = data.get("indices", {})
+    routing: Dict[str, List[dict]] = data.setdefault("routing", {})
+
+    # drop routing for deleted indices
+    for name in list(routing):
+        if name not in indices:
+            del routing[name]
+
+    counts = _copy_counts(routing, live)
+
+    for name, meta in indices.items():
+        settings = meta.get("settings", {})
+        num_shards = int(settings.get("number_of_shards", 1))
+        num_replicas = int(settings.get("number_of_replicas", 0))
+        shards = routing.setdefault(name, [])
+        while len(shards) < num_shards:
+            shards.append({"primary": None, "primary_term": 0,
+                           "replicas": [], "active_replicas": []})
+        for entry in shards:
+            live_set = set(live)
+            # scrub dead nodes
+            entry["replicas"] = [n for n in entry["replicas"]
+                                 if n in live_set]
+            entry["active_replicas"] = [n for n in entry["active_replicas"]
+                                        if n in live_set]
+            if entry["primary"] not in live_set:
+                entry["primary"] = None
+            # promote or assign a primary
+            if entry["primary"] is None:
+                if entry["active_replicas"]:
+                    promoted = entry["active_replicas"][0]
+                    entry["primary"] = promoted
+                    entry["replicas"] = [n for n in entry["replicas"]
+                                         if n != promoted]
+                    entry["active_replicas"] = [
+                        n for n in entry["active_replicas"] if n != promoted]
+                    entry["primary_term"] += 1
+                elif not entry["replicas"]:
+                    # no copies exist anywhere: fresh (empty) primary —
+                    # only safe when the shard has never been allocated
+                    # (term 0); otherwise wait for a copy to return
+                    if entry["primary_term"] == 0:
+                        node = _least_loaded(counts, set())
+                        if node is not None:
+                            entry["primary"] = node
+                            entry["primary_term"] = 1
+                            counts[node] += 1
+                # replicas still initializing (not active) can't be
+                # promoted — shard stays red until one activates
+            # reconcile replica count
+            holders = {entry["primary"]} | set(entry["replicas"])
+            holders.discard(None)
+            while (len(entry["replicas"]) < num_replicas
+                   and entry["primary"] is not None):
+                node = _least_loaded(counts, holders)
+                if node is None:
+                    break
+                entry["replicas"].append(node)
+                holders.add(node)
+                counts[node] += 1
+            while len(entry["replicas"]) > num_replicas:
+                dropped = entry["replicas"].pop()
+                entry["active_replicas"] = [
+                    n for n in entry["active_replicas"] if n != dropped]
+                if dropped in counts:
+                    counts[dropped] -= 1
+    return data
+
+
+def shard_copies(entry: dict) -> List[str]:
+    """All nodes holding a copy of the shard (primary first)."""
+    out = []
+    if entry.get("primary"):
+        out.append(entry["primary"])
+    out.extend(entry.get("replicas", []))
+    return out
+
+
+def health_of(data: dict) -> str:
+    """green = every copy assigned+active; yellow = all primaries active
+    but some replicas missing; red = an unassigned primary exists."""
+    status = "green"
+    for shards in (data.get("routing") or {}).values():
+        for entry in shards:
+            if entry.get("primary") is None:
+                return "red"
+            want = len(entry.get("replicas", []))
+            have = len(entry.get("active_replicas", []))
+            if have < want:
+                status = "yellow"
+    return status

@@ -1,0 +1,1043 @@
+"""ClusterNode: a full cluster member — coordination + routing + data.
+
+This is the integration layer round 1 lacked: it joins the three islands
+(Coordinator over TcpTransport, the shard engine, and the REST surface)
+into one distributed system, the way the reference wires them in
+node/Node.java:1180 (start sequence), with:
+
+  - cluster state (ClusterState.data) carrying index metadata + the
+    routing table (cluster/ClusterState.java:167 {Metadata, RoutingTable}),
+  - a state→local-shards apply loop (IndicesClusterStateService.java:120),
+  - primary-backup write replication over the transport
+    (TransportReplicationAction.java / ReplicationOperation.java:175),
+  - peer recovery over the transport (RecoverySourceHandler.java:164 —
+    segment copy + tracked-op catch-up), and
+  - scatter-gather search over the transport (TransportSearchAction.java:
+    284 → per-shard query phase → fetch phase → coordinator reduce).
+
+TPU-first notes: the data plane stays columnar — per-shard query phases
+run the jitted plan pipeline locally on each node's device and ship only
+top-k candidates + decoded agg partials (numpy) back; segments cross the
+wire once at recovery (Opaque frames), never per query.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from opensearch_tpu.cluster.allocation import allocate, health_of, shard_copies
+from opensearch_tpu.cluster.coordination.coordinator import (
+    Coordinator, Mode, bootstrap_state)
+from opensearch_tpu.cluster.coordination.core import ClusterState
+from opensearch_tpu.cluster.routing import generate_shard_id
+from opensearch_tpu.common.errors import (
+    IllegalArgumentError, IndexNotFoundError, OpenSearchTpuError)
+from opensearch_tpu.index.mapper import MapperService
+from opensearch_tpu.index.shard import IndexShard
+from opensearch_tpu.transport.serde import Opaque
+from opensearch_tpu.transport.tcp import TcpTransport
+
+# transport action names (reference: ActionModule registrations)
+SHARD_BULK_PRIMARY = "indices:data/write/shard_bulk[p]"
+SHARD_BULK_REPLICA = "indices:data/write/shard_bulk[r]"
+SHARD_QUERY = "indices:data/read/search[phase/query]"
+SHARD_FETCH = "indices:data/read/search[phase/fetch]"
+SHARD_GET = "indices:data/read/get[s]"
+SHARD_REFRESH = "indices:admin/refresh[s]"
+START_RECOVERY = "internal:index/shard/recovery/start_recovery"
+LEADER_UPDATE = "internal:cluster/leader_update"
+REGISTER_ADDR = "internal:cluster/register_address"
+
+
+class NotLeaderError(OpenSearchTpuError):
+    status = 503
+    error_type = "cluster_manager_not_discovered_exception"
+
+
+def _unwrap(value):
+    """Local (same-node) action calls skip serde, so Opaque payloads
+    arrive still wrapped; remote calls arrive decoded. Normalize."""
+    return value.value if isinstance(value, Opaque) else value
+
+
+class ClusterNode:
+    """One cluster member. Duck-types Node's handle()/request() so the
+    HTTP server and tests drive it identically; data-plane routes are
+    routed cluster-wide, the rest falls through to the local Node."""
+
+    def __init__(self, node_id: str, host: str = "127.0.0.1", port: int = 0,
+                 settings: Optional[dict] = None):
+        from opensearch_tpu.node import Node
+        self.node_id = node_id
+        self.settings = settings or {}
+        self.local = Node(node_name=node_id, settings=settings)
+        self.transport = TcpTransport(node_id, host=host, port=port)
+        self.shards: Dict[Tuple[str, int], IndexShard] = {}
+        self._mappers: Dict[str, MapperService] = {}
+        # replicas the local primary must fan ops to before they appear in
+        # active_replicas (recovery tracking window — ReplicationTracker's
+        # "tracked" allocations, index/seqno/ReplicationTracker.java:103)
+        self._tracked: Dict[Tuple[str, int], set] = {}
+        self._applied_lock = threading.Lock()
+        self._latest_state: Optional[ClusterState] = None
+        self._reconcile_scheduled = False
+        self.coordinator: Optional[Coordinator] = None
+        self._started = False
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.transport.address
+
+    def bootstrap(self, peers: Dict[str, Tuple[str, int]]):
+        """Form a new cluster from an explicit initial voting configuration
+        (cluster.initial_cluster_manager_nodes). `peers` maps node_id →
+        (host, port) for every bootstrap member including self."""
+        for nid, addr in peers.items():
+            if nid != self.node_id:
+                self.transport.add_address(nid, *addr)
+        initial = bootstrap_state(sorted(peers))
+        initial = initial.with_(data={
+            "indices": {}, "routing": {},
+            "addresses": {n: list(a) for n, a in peers.items()}})
+        self._start_coordinator(initial)
+
+    def join(self, seed: Tuple[str, int], seed_id: str):
+        """Join an existing cluster via a seed host (discovery seed_hosts).
+
+        The cluster must be able to dial us back before the leader can
+        publish state to us, so the first step hands our transport address
+        to the seed (HandshakingTransportAddressConnector's role)."""
+        self.transport.add_address(seed_id, *seed)
+        self._start_coordinator(ClusterState())
+        resp = self.transport.send_sync(
+            seed_id, REGISTER_ADDR,
+            {"node": self.node_id, "addr": list(self.address)},
+            timeout=10.0)
+        # learn the cluster's address book so a leader-redirect from the
+        # seed ("accepted": False, "leader": X) can actually be followed
+        for nid, addr in (resp.get("addresses") or {}).items():
+            if nid != self.node_id:
+                self.transport.add_address(nid, *addr)
+        self.coordinator.join_cluster(seed_id)
+
+    def _start_coordinator(self, initial: ClusterState):
+        self._register_actions()
+        self.coordinator = Coordinator(
+            self.node_id, self.transport, self.transport.scheduler, initial,
+            on_state_applied=self._on_state_applied)
+        self.coordinator.start()
+        self._started = True
+
+    def close(self):
+        self._started = False
+        if self.coordinator is not None:
+            self.coordinator.stop()
+        self.transport.close()
+        for shard in self.shards.values():
+            shard.close()
+
+    # --------------------------------------------------------- leader logic
+
+    @property
+    def is_leader(self) -> bool:
+        return (self.coordinator is not None
+                and self.coordinator.mode == Mode.LEADER)
+
+    @property
+    def state(self) -> Optional[ClusterState]:
+        if self.coordinator is None:
+            return None
+        return self.coordinator.applied_state
+
+    def _data(self) -> dict:
+        st = self.state
+        return (st.data or {}) if st is not None else {}
+
+    def _leader_id(self) -> Optional[str]:
+        if self.coordinator is None:
+            return None
+        if self.is_leader:
+            return self.node_id
+        return self.coordinator.leader
+
+    def _submit_to_leader(self, update: dict, timeout: float = 30.0) -> dict:
+        """Route a cluster-state mutation to the elected leader
+        (TransportMasterNodeAction) and wait for it to be applied
+        locally."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            leader = self._leader_id()
+            if leader is None:
+                time.sleep(0.05)
+                continue
+            if leader == self.node_id:
+                ok = self._leader_apply_update(update)
+            else:
+                try:
+                    resp = self.transport.send_sync(
+                        leader, LEADER_UPDATE, update, timeout=10.0)
+                    ok = bool(resp and resp.get("accepted"))
+                except OpenSearchTpuError:
+                    ok = False
+            if ok:
+                return {"acknowledged": True}
+            time.sleep(0.05)
+        raise NotLeaderError("timed out routing update to cluster manager")
+
+    def _leader_apply_update(self, update: dict) -> bool:
+        """Leader side: fold a typed update into cluster state and publish.
+        Runs the mutation inside submit_state_update so it composes with
+        concurrent joins/removals (MasterService single-threaded batch)."""
+        if not self.is_leader:
+            return False
+
+        def mutate(state: ClusterState) -> ClusterState:
+            data = dict(state.data or {})
+            data.setdefault("indices", {})
+            data.setdefault("routing", {})
+            data.setdefault("addresses", {})
+            kind = update["kind"]
+            if kind == "create_index":
+                name = update["name"]
+                if name in data["indices"]:
+                    raise IllegalArgumentError(
+                        f"index [{name}] already exists")
+                data["indices"] = {**data["indices"],
+                                   **{name: update["meta"]}}
+            elif kind == "delete_index":
+                data["indices"] = {k: v for k, v in data["indices"].items()
+                                   if k != update["name"]}
+            elif kind == "shard_started":
+                name, sid, node = (update["index"], update["shard"],
+                                   update["node"])
+                routing = copy_routing(data)
+                entry = routing[name][sid]
+                if node in entry["replicas"] and \
+                        node not in entry["active_replicas"]:
+                    entry["active_replicas"] = (
+                        entry["active_replicas"] + [node])
+                data["routing"] = routing
+            elif kind == "shard_failed":
+                # fail a replica out of the copy set (ReplicationOperation
+                # failShardIfNeeded): the allocator below re-adds a fresh
+                # replica assignment, which triggers re-recovery
+                name, sid, node = (update["index"], update["shard"],
+                                   update["node"])
+                routing = copy_routing(data)
+                entry = routing[name][sid]
+                entry["replicas"] = [n for n in entry["replicas"]
+                                     if n != node]
+                entry["active_replicas"] = [
+                    n for n in entry["active_replicas"] if n != node]
+                data["routing"] = routing
+            elif kind == "register_address":
+                data["addresses"] = {**data["addresses"],
+                                     **{update["node"]: update["addr"]}}
+            data = allocate(data, sorted(state.nodes))
+            return state.with_(data=data)
+
+        # coordinator methods must run on the event-loop thread
+        done = threading.Event()
+        accepted = [False]
+
+        def submit():
+            try:
+                accepted[0] = self.coordinator.submit_state_update(mutate)
+            finally:
+                done.set()
+
+        self.transport.post(submit)
+        done.wait(10.0)
+        return accepted[0]
+
+    # ----------------------------------------------------------- apply loop
+
+    def _on_state_applied(self, state: ClusterState):
+        """Runs on the transport event loop — snapshot the state and hand
+        reconciliation to the worker pool (it does recovery round-trips)."""
+        with self._applied_lock:
+            self._latest_state = state
+            if self._reconcile_scheduled:
+                return
+            self._reconcile_scheduled = True
+        self.transport._workers.submit(self._reconcile_loop)
+
+    def _reconcile_loop(self):
+        while True:
+            with self._applied_lock:
+                state = self._latest_state
+                self._latest_state = None
+                if state is None:
+                    self._reconcile_scheduled = False
+                    return
+            try:
+                self._reconcile(state)
+            except Exception:  # pragma: no cover - keep the loop alive
+                import traceback
+                traceback.print_exc()
+
+    def _reconcile(self, state: ClusterState):
+        """IndicesClusterStateService.applyClusterState analog: converge
+        local shards to the routing table."""
+        data = state.data or {}
+        indices = data.get("indices", {})
+        routing = data.get("routing", {})
+        for nid, addr in (data.get("addresses") or {}).items():
+            if nid != self.node_id:
+                self.transport.add_address(nid, *addr)
+        # leader-side reroute on membership change (AllocationService.
+        # reroute via NodeRemovalClusterStateTaskExecutor / join executor):
+        # if the routing table no longer matches the live node set, publish
+        # a re-allocation — this is what promotes replicas after a primary's
+        # node dies and re-replicates after node loss
+        if self.is_leader:
+            reallocated = allocate(data, sorted(state.nodes))
+            if reallocated != data:
+                def reroute(s: ClusterState) -> ClusterState:
+                    return s.with_(data=allocate(dict(s.data or {}),
+                                                 sorted(s.nodes)))
+                self.transport.post(
+                    lambda: self.coordinator.submit_state_update(reroute))
+        # remove shards we no longer own (or whose index is gone)
+        for (name, sid) in list(self.shards):
+            entry = (routing.get(name) or [None] * (sid + 1))[sid] \
+                if name in routing and sid < len(routing[name]) else None
+            owners = shard_copies(entry) if entry else []
+            if name not in indices or self.node_id not in owners:
+                shard = self.shards.pop((name, sid))
+                shard.close()
+                self._tracked.pop((name, sid), None)
+        # create/adjust shards we own
+        for name, shard_entries in routing.items():
+            meta = indices.get(name)
+            if meta is None:
+                continue
+            for sid, entry in enumerate(shard_entries):
+                key = (name, sid)
+                is_primary = entry.get("primary") == self.node_id
+                is_replica = self.node_id in entry.get("replicas", [])
+                if not (is_primary or is_replica):
+                    continue
+                shard = self.shards.get(key)
+                if shard is None:
+                    shard = self._create_shard(name, sid, meta, is_primary,
+                                               entry)
+                    if shard is None:
+                        continue
+                    self.shards[key] = shard
+                if is_primary and not shard.primary:
+                    # promotion (IndexShard relocated/promoted path):
+                    # bump the primary term so replica-side op dedup sees
+                    # the new reign
+                    shard.primary = True
+                    shard.engine.primary_term = entry.get("primary_term", 1)
+                elif is_replica and shard.primary:
+                    shard.primary = False
+
+    def _create_shard(self, name: str, sid: int, meta: dict,
+                      is_primary: bool, entry: dict) -> Optional[IndexShard]:
+        mapper = self._mapper_for(name, meta)
+        shard = IndexShard(sid, mapper, index_name=name,
+                           primary=is_primary,
+                           primary_term=entry.get("primary_term", 1),
+                           allocation_id=f"{name}_{sid}_{self.node_id}")
+        if not is_primary:
+            # replica: peer-recover from the primary over the transport
+            primary_node = entry.get("primary")
+            if primary_node and primary_node != self.node_id:
+                try:
+                    self._recover_from(shard, name, sid, primary_node)
+                except Exception:
+                    shard.close()
+                    return None
+        return shard
+
+    def _mapper_for(self, name: str, meta: dict) -> MapperService:
+        mapper = self._mappers.get(name)
+        if mapper is None:
+            mapper = MapperService(meta.get("mappings") or {})
+            self._mappers[name] = mapper
+        return mapper
+
+    # ------------------------------------------------------------- recovery
+
+    def _recover_from(self, shard: IndexShard, name: str, sid: int,
+                      primary_node: str):
+        """Peer recovery target side (PeerRecoveryTargetService): ask the
+        primary for its segment set, install it, then report started so
+        the leader marks this copy in-sync."""
+        resp = self.transport.send_sync(
+            primary_node, START_RECOVERY,
+            {"index": name, "shard": sid, "target": self.node_id},
+            timeout=60.0)
+        segments = _unwrap(resp["segments"])
+        shard.engine.install_segments(
+            segments, max_seq_no=resp["max_seq_no"],
+            local_checkpoint=resp["local_checkpoint"])
+        shard._sync_reader()
+        self._submit_to_leader({"kind": "shard_started", "index": name,
+                                "shard": sid, "node": self.node_id})
+
+    def _on_start_recovery(self, sender: str, payload: dict):
+        """Source side (RecoverySourceHandler.recoverToTarget): register
+        the target for op tracking FIRST (ops that arrive while the copy
+        is in flight still reach it), then ship the segment set."""
+        key = (payload["index"], payload["shard"])
+        shard = self.shards.get(key)
+        if shard is None or not shard.primary:
+            raise OpenSearchTpuError(
+                f"not primary for [{key}] on [{self.node_id}]")
+        self._tracked.setdefault(key, set()).add(payload["target"])
+        shard.engine.refresh()
+        return {"segments": Opaque(shard.engine.segments),
+                "max_seq_no": shard.engine.max_seq_no,
+                "local_checkpoint": shard.engine.local_checkpoint}
+
+    # ------------------------------------------------------- write path
+
+    def _register_actions(self):
+        t = self.transport
+        reg = t.register_handler
+        reg(self.node_id, LEADER_UPDATE,
+            lambda s, p: {"accepted": self._leader_apply_update(p)},
+            blocking=True)
+        reg(self.node_id, SHARD_BULK_PRIMARY, self._on_shard_bulk_primary,
+            blocking=True)
+        reg(self.node_id, SHARD_BULK_REPLICA, self._on_shard_bulk_replica,
+            blocking=True)
+        reg(self.node_id, SHARD_QUERY, self._on_shard_query, blocking=True)
+        reg(self.node_id, SHARD_FETCH, self._on_shard_fetch, blocking=True)
+        reg(self.node_id, SHARD_GET, self._on_shard_get, blocking=True)
+        reg(self.node_id, SHARD_REFRESH, self._on_shard_refresh,
+            blocking=True)
+        reg(self.node_id, START_RECOVERY, self._on_start_recovery,
+            blocking=True)
+        reg(self.node_id, REGISTER_ADDR, self._on_register_address,
+            blocking=True)
+
+    def _on_register_address(self, sender: str, payload: dict):
+        """Learn a joining node's transport address; propagate to the
+        leader so it lands in cluster state for every member."""
+        self.transport.add_address(payload["node"], *payload["addr"])
+        if self.is_leader:
+            self._leader_apply_update({"kind": "register_address",
+                                       "node": payload["node"],
+                                       "addr": payload["addr"]})
+        else:
+            leader = self._leader_id()
+            if leader and leader != payload["node"]:
+                try:
+                    self.transport.send_sync(leader, REGISTER_ADDR, payload,
+                                             timeout=10.0)
+                except OpenSearchTpuError:
+                    pass
+        addresses = {nid: list(a)
+                     for nid, a in self.transport._addresses.items()}
+        addresses[self.node_id] = list(self.address)
+        return {"ok": True, "addresses": addresses}
+
+    def _on_shard_bulk_primary(self, sender: str, payload: dict) -> dict:
+        """TransportShardBulkAction.performOnPrimary: execute each op on
+        the local primary, then fan the seqno'd ops to every in-sync +
+        tracked replica copy concurrently (ReplicationOperation.java:221)."""
+        name, sid = payload["index"], payload["shard"]
+        key = (name, sid)
+        shard = self.shards.get(key)
+        if shard is None or not shard.primary:
+            raise OpenSearchTpuError(
+                f"shard [{name}][{sid}] not primary on [{self.node_id}]")
+        entry = self._routing_entry(name, sid)
+        results = []
+        replica_ops = []
+        for op in payload["ops"]:
+            try:
+                if op["op"] == "delete":
+                    res = shard.delete_doc(op["id"])
+                    result = "deleted" if res.found else "not_found"
+                else:
+                    res = shard.index_doc(op["id"], op["source"],
+                                          op_type=op.get("op_type", "index"))
+                    result = "created" if res.created else "updated"
+                results.append({"id": op["id"], "result": result,
+                                "_version": res.version,
+                                "_seq_no": res.seq_no,
+                                "_primary_term": shard.engine.primary_term,
+                                "status": 201 if result == "created"
+                                else 200})
+                replica_ops.append({**op, "seq_no": res.seq_no,
+                                    "version": res.version})
+            except OpenSearchTpuError as e:
+                results.append({"id": op["id"], "error": str(e),
+                                "status": e.status})
+        # replicate to in-sync + tracked copies
+        targets = set(entry.get("active_replicas", [])) \
+            | self._tracked.get(key, set())
+        targets &= set(entry.get("replicas", []))
+        failures = []
+        threads = []
+        for target in sorted(targets):
+            def run(tgt=target):
+                try:
+                    self.transport.send_sync(
+                        tgt, SHARD_BULK_REPLICA,
+                        {"index": name, "shard": sid,
+                         "primary_term": shard.engine.primary_term,
+                         "ops": replica_ops}, timeout=30.0)
+                except Exception as e:
+                    failures.append((tgt, e))
+            th = threading.Thread(target=run, daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(35.0)
+        # a failed replica is reported to the leader so it can be failed
+        # out of the in-sync set (ReplicationOperation#onNoLongerPrimary /
+        # failShardIfNeeded analog)
+        for tgt, _ in failures:
+            try:
+                self._submit_to_leader({"kind": "shard_failed",
+                                        "index": name, "shard": sid,
+                                        "node": tgt})
+            except OpenSearchTpuError:
+                pass
+        return {"items": results}
+
+    def _on_shard_bulk_replica(self, sender: str, payload: dict) -> dict:
+        key = (payload["index"], payload["shard"])
+        shard = self.shards.get(key)
+        if shard is None:
+            raise OpenSearchTpuError(f"no shard [{key}] on [{self.node_id}]")
+        term = payload["primary_term"]
+        for op in payload["ops"]:
+            if op["op"] == "delete":
+                shard.delete_on_replica(op["id"], op["seq_no"], term,
+                                        op["version"])
+            else:
+                shard.index_on_replica(op["id"], op["source"], op["seq_no"],
+                                       term, op["version"])
+        return {"ok": True}
+
+    def _routing_entry(self, name: str, sid: int) -> dict:
+        routing = self._data().get("routing", {})
+        shards = routing.get(name)
+        if shards is None or sid >= len(shards):
+            raise IndexNotFoundError(f"no such index [{name}]")
+        return shards[sid]
+
+    def _index_meta(self, name: str) -> dict:
+        meta = self._data().get("indices", {}).get(name)
+        if meta is None:
+            raise IndexNotFoundError(f"no such index [{name}]")
+        return meta
+
+    def _num_shards(self, name: str) -> int:
+        return len(self._data().get("routing", {}).get(name) or []) or 1
+
+    def _shard_for_doc(self, name: str, doc_id: str,
+                       routing: Optional[str] = None) -> int:
+        meta = self._index_meta(name)
+        settings = meta.get("settings", {})
+        return generate_shard_id(
+            doc_id, int(settings.get("number_of_shards", 1)),
+            routing=routing)
+
+    def execute_bulk(self, ops_by_index: List[dict]) -> dict:
+        """Group ops per shard, dispatch per-shard bulks to primaries
+        (local or remote), reassemble per-item results in order."""
+        groups: Dict[Tuple[str, int], List[Tuple[int, dict]]] = {}
+        for i, op in enumerate(ops_by_index):
+            sid = self._shard_for_doc(op["index"], op["id"],
+                                      op.get("routing"))
+            groups.setdefault((op["index"], sid), []).append((i, op))
+        items: List[Optional[dict]] = [None] * len(ops_by_index)
+        errors = False
+        for (name, sid), group in groups.items():
+            entry = self._routing_entry(name, sid)
+            primary = entry.get("primary")
+            payload = {"index": name, "shard": sid,
+                       "ops": [op for _, op in group]}
+            if primary is None:
+                resp = {"items": [{"id": op["id"], "status": 503,
+                                   "error": "primary shard not active"}
+                                  for _, op in group]}
+            elif primary == self.node_id:
+                resp = self._on_shard_bulk_primary(self.node_id, payload)
+            else:
+                resp = self.transport.send_sync(primary, SHARD_BULK_PRIMARY,
+                                                payload, timeout=60.0)
+            for (i, op), item in zip(group, resp["items"]):
+                action = "delete" if op["op"] == "delete" else "index"
+                body = {"_index": name, "_id": item["id"],
+                        "status": item.get("status", 200)}
+                if "error" in item:
+                    errors = True
+                    body["error"] = {"type": "exception",
+                                     "reason": item["error"]}
+                else:
+                    body.update({"result": item["result"],
+                                 "_version": item["_version"],
+                                 "_seq_no": item["_seq_no"],
+                                 "_primary_term": item["_primary_term"],
+                                 "_shards": {"total": 1 + len(
+                                     entry.get("replicas", [])),
+                                     "successful": 1 + len(
+                                     entry.get("active_replicas", [])),
+                                     "failed": 0}})
+                items[i] = {action: body}
+        return {"took": 0, "errors": errors, "items": items}
+
+    # ------------------------------------------------------------ read path
+
+    def _on_shard_get(self, sender: str, payload: dict):
+        shard = self.shards.get((payload["index"], payload["shard"]))
+        if shard is None:
+            raise OpenSearchTpuError("shard not local")
+        res = shard.get_doc(payload["id"])
+        if res is None:
+            return {"found": False}
+        return {"found": True, "source": res.source, "version": res.version,
+                "seq_no": res.seq_no, "primary_term": res.primary_term}
+
+    def get_doc(self, name: str, doc_id: str,
+                routing: Optional[str] = None) -> dict:
+        sid = self._shard_for_doc(name, doc_id, routing)
+        entry = self._routing_entry(name, sid)
+        primary = entry.get("primary")
+        payload = {"index": name, "shard": sid, "id": doc_id}
+        if primary == self.node_id:
+            resp = self._on_shard_get(self.node_id, payload)
+        elif primary is not None:
+            resp = self.transport.send_sync(primary, SHARD_GET, payload,
+                                            timeout=30.0)
+        else:
+            raise OpenSearchTpuError("primary shard not active")
+        out = {"_index": name, "_id": doc_id, "found": resp["found"]}
+        if resp["found"]:
+            out.update({"_source": resp["source"],
+                        "_version": resp["version"],
+                        "_seq_no": resp["seq_no"],
+                        "_primary_term": resp["primary_term"]})
+        return out
+
+    def _on_shard_refresh(self, sender: str, payload: dict):
+        for sid in payload["shards"]:
+            shard = self.shards.get((payload["index"], sid))
+            if shard is not None:
+                shard.refresh()
+        return {"ok": True}
+
+    def refresh_index(self, name: str) -> dict:
+        by_node: Dict[str, List[int]] = {}
+        for sid, entry in enumerate(self._data()["routing"].get(name, [])):
+            for node in shard_copies(entry):
+                by_node.setdefault(node, []).append(sid)
+        total = 0
+        for node, sids in by_node.items():
+            payload = {"index": name, "shards": sids}
+            if node == self.node_id:
+                self._on_shard_refresh(self.node_id, payload)
+            else:
+                self.transport.send_sync(node, SHARD_REFRESH, payload,
+                                         timeout=30.0)
+            total += len(sids)
+        return {"_shards": {"total": total, "successful": total,
+                            "failed": 0}}
+
+    # ---------------------------------------------------------- search path
+
+    def _on_shard_query(self, sender: str, payload: dict):
+        """Shard-side query phase: run the local jitted pipeline, return
+        candidates + decoded agg partials (SearchService.executeQueryPhase
+        → QuerySearchResult)."""
+        name = payload["index"]
+        body = payload["body"]
+        k = payload["k"]
+        out = []
+        for sid in payload["shards"]:
+            shard = self.shards.get((name, sid))
+            if shard is None:
+                raise OpenSearchTpuError(f"shard [{name}][{sid}] not local")
+            cands, decoded, total = shard.executor.execute_query_phase(
+                body, k)
+            out.append({"shard": sid,
+                        "candidates": Opaque(
+                            [(c.score, c.seg_i, c.ord, c.sort_values)
+                             for c in cands]),
+                        "partials": Opaque(decoded),
+                        "total": total})
+        return {"results": out}
+
+    def _on_shard_fetch(self, sender: str, payload: dict):
+        """Shard-side fetch phase: render hit dicts for the winning docs
+        (SearchService.executeFetchPhase → FetchPhase.execute)."""
+        from opensearch_tpu.search import dsl
+        from opensearch_tpu.search.controller import (
+            _build_hit, _parse_sort)
+        from opensearch_tpu.search.executor import _Candidate
+
+        name, sid = payload["index"], payload["shard"]
+        body = payload["body"]
+        shard = self.shards.get((name, sid))
+        if shard is None:
+            raise OpenSearchTpuError(f"shard [{name}][{sid}] not local")
+        sort_specs = _parse_sort(body.get("sort"))
+        score_sorted = sort_specs[0][0] == "_score"
+        query_node = dsl.parse_query(body.get("query"))
+        wants_score = score_sorted or bool(body.get("track_scores"))
+        hits = []
+        for score, seg_i, ord_, sort_values in payload["docs"]:
+            c = _Candidate(score, seg_i, ord_, sort_values)
+            hit = _build_hit(shard.executor, c, body,
+                             score if wants_score else None,
+                             query_node, sort_specs, score_sorted)
+            hits.append(hit)
+        return {"hits": Opaque(hits)}
+
+    def search(self, name: str, body: Optional[dict]) -> dict:
+        """Coordinator side of query-then-fetch over the transport."""
+        from opensearch_tpu.search.aggs.parse import parse_aggs
+        from opensearch_tpu.search.aggs.pipeline import apply_pipelines
+        from opensearch_tpu.search.aggs.reduce import reduce_aggs
+        from opensearch_tpu.search.controller import (
+            _compare_candidates, _parse_sort)
+        from opensearch_tpu.search.executor import _Candidate
+
+        body = body or {}
+        start = time.monotonic()
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        sort_specs = _parse_sort(body.get("sort"))
+        score_sorted = sort_specs[0][0] == "_score"
+        wants_score = score_sorted or bool(body.get("track_scores"))
+        k = max(from_ + size, 10)
+
+        routing = self._data().get("routing", {})
+        if name not in routing:
+            raise IndexNotFoundError(f"no such index [{name}]")
+        # pick one copy per shard: primary preferred (fully consistent),
+        # else an in-sync replica (ARS slots in here later)
+        by_node: Dict[str, List[int]] = {}
+        shard_nodes: Dict[int, str] = {}
+        for sid, entry in enumerate(routing[name]):
+            node = entry.get("primary")
+            if node is None:
+                active = entry.get("active_replicas", [])
+                node = active[0] if active else None
+            if node is None:
+                raise OpenSearchTpuError(
+                    f"no active copy for shard [{name}][{sid}]")
+            by_node.setdefault(node, []).append(sid)
+            shard_nodes[sid] = node
+
+        # scatter: query phase per node (parallel)
+        all_candidates: List[_Candidate] = []
+        all_partials = []
+        total = 0
+        lock = threading.Lock()
+        errors: List[Exception] = []
+
+        def query_node_shards(node: str, sids: List[int]):
+            nonlocal total
+            payload = {"index": name, "shards": sids, "body": body, "k": k}
+            try:
+                if node == self.node_id:
+                    resp = self._on_shard_query(self.node_id, payload)
+                else:
+                    resp = self.transport.send_sync(node, SHARD_QUERY,
+                                                    payload, timeout=60.0)
+                with lock:
+                    for res in resp["results"]:
+                        for score, seg_i, ord_, sv in _unwrap(
+                                res["candidates"]):
+                            c = _Candidate(score, seg_i, ord_, sv,
+                                           shard_i=res["shard"])
+                            all_candidates.append(c)
+                        all_partials.extend(_unwrap(res["partials"]))
+                        total += res["total"]
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=query_node_shards,
+                                    args=(node, sids), daemon=True)
+                   for node, sids in by_node.items()]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(65.0)
+        if errors:
+            raise errors[0]
+
+        # coordinator reduce: global sort + page (SearchPhaseController)
+        all_candidates.sort(key=_compare_candidates(sort_specs))
+        page = all_candidates[from_:from_ + size]
+        max_score = None
+        if wants_score:
+            for c in all_candidates:
+                if max_score is None or c.score > max_score:
+                    max_score = c.score
+
+        # fetch phase: only shards owning page hits (FetchSearchPhase)
+        docs_by_shard: Dict[int, List] = {}
+        for c in page:
+            docs_by_shard.setdefault(c.shard_i, []).append(c)
+        hit_map: Dict[Tuple[int, int, int], dict] = {}
+        for sid, cands in docs_by_shard.items():
+            node = shard_nodes[sid]
+            payload = {"index": name, "shard": sid, "body": body,
+                       "docs": [(c.score, c.seg_i, c.ord, c.sort_values)
+                                for c in cands]}
+            if node == self.node_id:
+                resp = self._on_shard_fetch(self.node_id, payload)
+            else:
+                resp = self.transport.send_sync(node, SHARD_FETCH, payload,
+                                                timeout=60.0)
+            for c, hit in zip(cands, _unwrap(resp["hits"])):
+                hit_map[(c.shard_i, c.seg_i, c.ord)] = hit
+        hits = [hit_map[(c.shard_i, c.seg_i, c.ord)] for c in page]
+
+        n_shards = len(routing[name])
+        resp: dict = {
+            "took": int((time.monotonic() - start) * 1000),
+            "timed_out": False,
+            "_shards": {"total": n_shards, "successful": n_shards,
+                        "skipped": 0, "failed": 0},
+            "hits": {"total": {"value": total, "relation": "eq"},
+                     "max_score": max_score, "hits": hits},
+        }
+        agg_nodes = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        if agg_nodes:
+            aggregations = reduce_aggs(all_partials)
+            apply_pipelines(agg_nodes, aggregations)
+            resp["aggregations"] = aggregations
+        return resp
+
+    # --------------------------------------------------------- REST surface
+
+    def handle(self, method: str, path: str,
+               params: Optional[Dict[str, str]] = None, body: Any = None,
+               raw_body: Optional[bytes] = None):
+        """Cluster-routed dispatch for the data plane; everything else
+        falls through to the local single-node surface."""
+        from opensearch_tpu.rest.controller import RestResponse
+        import json as _json
+
+        if isinstance(body, (str, bytes)) and body:
+            raw = body if isinstance(body, bytes) else body.encode()
+            try:
+                parsed = _json.loads(body)
+            except (ValueError, UnicodeDecodeError):
+                parsed = None
+        else:
+            raw = raw_body
+            parsed = body
+
+        try:
+            routed = self._route(method.upper(), path.strip("/"), parsed,
+                                 raw, params or {})
+        except OpenSearchTpuError as e:
+            routed = ({"error": {"type": e.error_type, "reason": str(e)},
+                       "status": e.status}, e.status)
+        if routed is not None:
+            body_out, status = routed
+            return RestResponse(status=status, body=body_out)
+        return self.local.handle(method, path, params=params, body=parsed,
+                                 raw_body=raw)
+
+    def request(self, method: str, path: str, body: Any = None,
+                **params) -> dict:
+        resp = self.handle(method, path,
+                           params={k: str(v) for k, v in params.items()},
+                           body=body)
+        out = resp.body if isinstance(resp.body, dict) \
+            else {"_body": resp.body}
+        out = dict(out)
+        out["_status"] = resp.status
+        return out
+
+    def _route(self, method: str, path: str, body: Any, raw: Optional[bytes],
+               params: Dict[str, str]) -> Optional[Tuple[dict, int]]:
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            return None
+        # cluster admin
+        if parts[0] == "_cluster":
+            if len(parts) >= 2 and parts[1] == "health":
+                return self.cluster_health(), 200
+            if len(parts) >= 2 and parts[1] == "state":
+                return self.cluster_state_api(), 200
+            return None
+        if parts[0] == "_cat" and len(parts) > 1 and parts[1] == "shards":
+            return self._cat_shards(), 200
+        if parts[0] == "_bulk" and method == "POST":
+            return self._rest_bulk(None, raw), 200
+        if parts[0].startswith("_"):
+            return None
+        name = parts[0]
+        # index-level
+        if len(parts) == 1:
+            if method == "PUT":
+                return self.create_index(name, body or {}), 200
+            if method == "DELETE":
+                return self.delete_index(name), 200
+            return None
+        sub = parts[1]
+        if sub == "_doc" and len(parts) >= 2:
+            doc_id = parts[2] if len(parts) > 2 else None
+            if method in ("PUT", "POST") and body is not None:
+                if doc_id is None:
+                    import secrets
+                    doc_id = secrets.token_urlsafe(12)
+                res = self.execute_bulk([{"op": "index", "index": name,
+                                          "id": doc_id, "source": body,
+                                          "routing": params.get("routing")}])
+                item = res["items"][0]["index"]
+                status = item.pop("status", 200)
+                return {**item, "result": item.get("result", "created")}, \
+                    status
+            if method == "DELETE" and doc_id:
+                res = self.execute_bulk([{"op": "delete", "index": name,
+                                          "id": doc_id}])
+                item = res["items"][0]["delete"]
+                return item, item.pop("status", 200)
+            if method == "GET" and doc_id:
+                out = self.get_doc(name, doc_id,
+                                   routing=params.get("routing"))
+                return out, (200 if out["found"] else 404)
+        if sub == "_bulk" and method == "POST":
+            return self._rest_bulk(name, raw), 200
+        if sub == "_search" and method in ("GET", "POST"):
+            return self.search(name, body), 200
+        if sub == "_refresh" and method in ("POST", "GET"):
+            return self.refresh_index(name), 200
+        return None
+
+    def _rest_bulk(self, default_index: Optional[str],
+                   raw: Optional[bytes]) -> dict:
+        import json as _json
+        if not raw:
+            raise IllegalArgumentError("bulk body required")
+        lines = [ln for ln in raw.decode("utf-8").split("\n") if ln.strip()]
+        ops = []
+        i = 0
+        while i < len(lines):
+            action = _json.loads(lines[i])
+            kind = next(iter(action))
+            spec = action[kind] or {}
+            index = spec.get("_index", default_index)
+            doc_id = spec.get("_id")
+            if kind == "delete":
+                ops.append({"op": "delete", "index": index, "id": doc_id})
+                i += 1
+            else:
+                source = _json.loads(lines[i + 1])
+                if doc_id is None:
+                    import secrets
+                    doc_id = secrets.token_urlsafe(12)
+                ops.append({"op": "index", "index": index, "id": doc_id,
+                            "source": source,
+                            "op_type": "create" if kind == "create"
+                            else "index"})
+                i += 2
+        return self.execute_bulk(ops)
+
+    # ----------------------------------------------------------- admin APIs
+
+    def create_index(self, name: str, body: dict) -> dict:
+        import uuid as _uuid
+        from opensearch_tpu.indices.service import (
+            _normalize_settings, validate_index_name)
+        validate_index_name(name)
+        settings = _normalize_settings(body.get("settings"))
+        meta = {"uuid": _uuid.uuid4().hex[:22],
+                "settings": {"number_of_shards":
+                             int(settings.get("number_of_shards", 1)),
+                             "number_of_replicas":
+                             int(settings.get("number_of_replicas", 0))},
+                "mappings": body.get("mappings") or {}}
+        self._submit_to_leader({"kind": "create_index", "name": name,
+                                "meta": meta})
+        self._await(lambda: name in self._data().get("indices", {}))
+        return {"acknowledged": True, "shards_acknowledged": True,
+                "index": name}
+
+    def delete_index(self, name: str) -> dict:
+        self._index_meta(name)
+        self._submit_to_leader({"kind": "delete_index", "name": name})
+        self._await(lambda: name not in self._data().get("indices", {}))
+        return {"acknowledged": True}
+
+    def _await(self, cond, timeout: float = 30.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if cond():
+                return
+            time.sleep(0.02)
+        raise OpenSearchTpuError("timed out waiting for cluster state")
+
+    def await_health(self, status: str = "green", timeout: float = 60.0):
+        rank = {"green": 2, "yellow": 1, "red": 0}
+        self._await(lambda: rank[health_of(self._data())] >= rank[status],
+                    timeout=timeout)
+
+    def cluster_health(self) -> dict:
+        data = self._data()
+        st = self.state
+        n_nodes = len(st.nodes) if st else 0
+        active_p = active = unassigned = 0
+        for shards in (data.get("routing") or {}).values():
+            for entry in shards:
+                if entry.get("primary"):
+                    active_p += 1
+                    active += 1
+                else:
+                    unassigned += 1
+                active += len(entry.get("active_replicas", []))
+                unassigned += (len(entry.get("replicas", []))
+                               - len(entry.get("active_replicas", [])))
+        return {"cluster_name": "opensearch-tpu",
+                "status": health_of(data),
+                "timed_out": False,
+                "number_of_nodes": n_nodes,
+                "number_of_data_nodes": n_nodes,
+                "discovered_cluster_manager": self._leader_id() is not None,
+                "active_primary_shards": active_p,
+                "active_shards": active,
+                "unassigned_shards": unassigned,
+                "relocating_shards": 0, "initializing_shards": 0}
+
+    def cluster_state_api(self) -> dict:
+        st = self.state
+        data = self._data()
+        return {"cluster_manager_node": self._leader_id(),
+                "version": st.version if st else 0,
+                "nodes": {n: {"name": n} for n in (st.nodes if st else [])},
+                "metadata": {"indices": data.get("indices", {})},
+                "routing_table": data.get("routing", {})}
+
+    def _cat_shards(self) -> dict:
+        rows = []
+        for name, shards in (self._data().get("routing") or {}).items():
+            for sid, entry in enumerate(shards):
+                if entry.get("primary"):
+                    rows.append({"index": name, "shard": sid, "prirep": "p",
+                                 "state": "STARTED",
+                                 "node": entry["primary"]})
+                for r in entry.get("replicas", []):
+                    rows.append({
+                        "index": name, "shard": sid, "prirep": "r",
+                        "state": "STARTED"
+                        if r in entry.get("active_replicas", [])
+                        else "INITIALIZING", "node": r})
+        return {"_body": rows}
+
+
+def copy_routing(data: dict) -> Dict[str, List[dict]]:
+    """Deep-copy the routing table for mutation inside a state update."""
+    return {name: [dict(e, replicas=list(e["replicas"]),
+                        active_replicas=list(e["active_replicas"]))
+                   for e in shards]
+            for name, shards in (data.get("routing") or {}).items()}

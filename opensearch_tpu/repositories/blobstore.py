@@ -158,15 +158,18 @@ class FsRepository:
             else:
                 # a blob of this name exists: verify it is the same segment
                 # before skipping the upload — never silently dedup against
-                # different content
+                # different content. A missing/unreadable meta (crash
+                # between npz and meta writes) is repairable: re-upload.
                 try:
                     with open(meta_path) as fh:
                         existing = json.load(fh)
-                    same = (existing.get("num_docs") == seg.num_docs
-                            and existing.get("doc_ids") == seg.doc_ids)
                 except (OSError, ValueError):
-                    same = False
-                if not same:
+                    blob_store.write_segment(seg)
+                    new_files += 1
+                    existing = None
+                if existing is not None and not (
+                        existing.get("num_docs") == seg.num_docs
+                        and existing.get("doc_ids") == seg.doc_ids):
                     raise OpenSearchTpuError(
                         f"repository [{self.name}] blob conflict for "
                         f"segment [{seg.seg_id}] of index uuid "

@@ -8,8 +8,12 @@ around it (tcp.py) is binary."""
 
 from __future__ import annotations
 
+import base64
 import json
+import pickle
 from typing import Any, Callable, Dict
+
+import numpy as np
 
 from opensearch_tpu.cluster.coordination.core import (
     ClusterState, VotingConfiguration)
@@ -46,12 +50,48 @@ register(
         data=d["data"]))
 
 
+class Opaque:
+    """Wrapper marking a payload subtree for binary (pickle) transport —
+    segment columns, candidate lists, decoded agg partials. The analog of
+    the reference sending Lucene file chunks / InternalAggregations as raw
+    versioned bytes inside its frames: the cluster transport is a trusted,
+    same-version boundary (handshake-verified), never exposed to clients,
+    so pickle's arbitrary-code caveat is contained the same way the
+    reference's arbitrary StreamInput readers are."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any):
+        self.value = value
+
+
+# marker keys the codec itself emits — a *plain* dict from user data that
+# happens to contain one of these must be escaped, or an attacker could
+# smuggle a {"__pickle__": ...} doc body through the REST boundary and have
+# a receiving node pickle.loads attacker bytes
+_RESERVED_KEYS = frozenset(
+    {"__type__", "__pickle__", "__ndarray__", "__escaped__"})
+
+
 def to_wire(value: Any) -> Any:
     writer = _WRITERS.get(type(value))
     if writer is not None:
         return writer(value)
+    if isinstance(value, Opaque):
+        return {"__pickle__": base64.b64encode(
+            pickle.dumps(value.value, protocol=pickle.HIGHEST_PROTOCOL)
+        ).decode("ascii")}
+    if isinstance(value, np.ndarray):
+        return {"__ndarray__": base64.b64encode(
+            np.ascontiguousarray(value).tobytes()).decode("ascii"),
+            "dtype": str(value.dtype), "shape": list(value.shape)}
+    if isinstance(value, (np.integer, np.floating, np.bool_)):
+        return value.item()
     if isinstance(value, dict):
-        return {k: to_wire(v) for k, v in value.items()}
+        out = {k: to_wire(v) for k, v in value.items()}
+        if _RESERVED_KEYS & value.keys():
+            return {"__escaped__": out}
+        return out
     if isinstance(value, (list, tuple)):
         return [to_wire(v) for v in value]
     if isinstance(value, frozenset):
@@ -61,6 +101,11 @@ def to_wire(value: Any) -> Any:
 
 def from_wire(value: Any) -> Any:
     if isinstance(value, dict):
+        if "__escaped__" in value and len(value) == 1:
+            # plain user dict that collided with marker keys: restore it
+            # verbatim (recurse into values only — keys stay literal data)
+            return {k: from_wire(v)
+                    for k, v in value["__escaped__"].items()}
         type_name = value.get("__type__")
         if type_name is not None:
             reader = _READERS.get(type_name)
@@ -68,6 +113,12 @@ def from_wire(value: Any) -> Any:
                 raise ValueError(f"unknown wire type [{type_name}]")
             return reader({k: v for k, v in value.items()
                            if k != "__type__"})
+        if "__pickle__" in value:
+            return pickle.loads(base64.b64decode(value["__pickle__"]))
+        if "__ndarray__" in value:
+            return np.frombuffer(
+                base64.b64decode(value["__ndarray__"]),
+                dtype=np.dtype(value["dtype"])).reshape(value["shape"])
         return {k: from_wire(v) for k, v in value.items()}
     if isinstance(value, list):
         return [from_wire(v) for v in value]

@@ -9,10 +9,13 @@ frame = magic "OT" | u8 version | u8 flags | u64 request_id
 
 flags: bit0 = response, bit1 = error, bit2 = zlib-compressed payload.
 
-Each transport hosts ONE local node. All handler invocations and response
+Each transport hosts ONE local node. Handler invocations and response
 callbacks run on a single event-loop thread per transport — the analog of
 the reference's transport-thread discipline (transport/Transports.java
 asserts), which keeps the Coordinator single-threaded without locks.
+Handlers registered with blocking=True (data-plane actions that fan out
+sub-requests and wait) run on a worker pool instead, like the reference's
+WRITE/SEARCH threadpools (threadpool/ThreadPool.java:92).
 Version negotiation happens in a handshake request on connect
 (action "internal:tcp/handshake").
 """
@@ -24,6 +27,7 @@ import socket
 import struct
 import threading
 import zlib
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from opensearch_tpu.common.errors import NodeNotConnectedError
@@ -125,6 +129,17 @@ class TcpTransport:
     def __init__(self, node_id: str, host: str = "127.0.0.1", port: int = 0):
         self.node_id = node_id
         self.handlers: Dict[str, Callable] = {}
+        # actions whose handlers may block (fan out sub-requests and wait):
+        # they run on the worker pool, NOT the event loop — the reference
+        # equivalently runs WRITE/SEARCH handlers on named threadpools while
+        # coordination stays on the transport thread (ThreadPool.java:92)
+        self._blocking_actions: set = set()
+        self._workers = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix=f"worker-{node_id}")
+        # frames are written from the event loop AND worker threads (blocking
+        # handlers answer on the inbound socket): serialize per socket or
+        # concurrent sendall()s interleave and corrupt the frame stream
+        self._write_locks: Dict[socket.socket, threading.Lock] = {}
         self._addresses: Dict[str, Tuple[str, int]] = {}
         self._connections: Dict[str, socket.socket] = {}
         self._pending: Dict[int, Tuple[Callable, Callable]] = {}
@@ -151,9 +166,12 @@ class TcpTransport:
 
     # -------------------------------------------------------------- registry
 
-    def register_handler(self, node_id: str, action: str, handler: Callable):
+    def register_handler(self, node_id: str, action: str, handler: Callable,
+                         blocking: bool = False):
         assert node_id == self.node_id, "TcpTransport hosts one node"
         self.handlers[action] = handler
+        if blocking:
+            self._blocking_actions.add(action)
 
     def register_node(self, node_id: str):  # interface parity with the mock
         pass
@@ -199,11 +217,21 @@ class TcpTransport:
                 if flags & FLAG_RESPONSE:
                     self.post(lambda f=flags, r=request_id, p=payload:
                               self._handle_response(f, r, p))
+                elif action in self._blocking_actions:
+                    self._workers.submit(self._handle_request, conn,
+                                         request_id, action, payload)
                 else:
                     self.post(lambda c=conn, r=request_id, a=action,
                               p=payload: self._handle_request(c, r, a, p))
         except (OSError, ValueError):
             return
+
+    def _locked_write(self, sock: socket.socket, flags: int,
+                      request_id: int, action: str, payload: Any):
+        with self._lock:
+            wlock = self._write_locks.setdefault(sock, threading.Lock())
+        with wlock:
+            _write_frame(sock, flags, request_id, action, payload)
 
     def _handle_request(self, conn, request_id, action, payload):
         handler = self.handlers.get(action)
@@ -216,13 +244,14 @@ class TcpTransport:
             body = payload.get("__body__") if isinstance(payload, dict) \
                 and "__body__" in payload else payload
             response = handler(sender, body)
-            _write_frame(conn, FLAG_RESPONSE, request_id, action,
-                         response)
+            self._locked_write(conn, FLAG_RESPONSE, request_id, action,
+                               response)
         except Exception as e:
             try:
-                _write_frame(conn, FLAG_RESPONSE | FLAG_ERROR, request_id,
-                             action, {"error": type(e).__name__,
-                                      "reason": str(e)})
+                self._locked_write(conn, FLAG_RESPONSE | FLAG_ERROR,
+                                   request_id, action,
+                                   {"error": type(e).__name__,
+                                    "reason": str(e)})
             except OSError:
                 pass
 
@@ -258,22 +287,58 @@ class TcpTransport:
     def send(self, sender: str, target: str, action: str, payload: Any,
              on_response: Optional[Callable] = None,
              on_failure: Optional[Callable] = None):
+        with self._lock:
+            self._request_counter += 1
+            request_id = self._request_counter
+            if on_response or on_failure:
+                self._pending[request_id] = (on_response, on_failure)
+
         def do_send():
             try:
                 sock = self._connection_to(target)
-                with self._lock:
-                    self._request_counter += 1
-                    request_id = self._request_counter
-                    if on_response or on_failure:
-                        self._pending[request_id] = (on_response, on_failure)
                 wrapped = {"__sender__": sender, "__body__": payload}
-                _write_frame(sock, 0, request_id, action, wrapped)
+                self._locked_write(sock, 0, request_id, action, wrapped)
             except Exception as e:
                 self._connections.pop(target, None)
+                with self._lock:
+                    self._pending.pop(request_id, None)
                 if on_failure is not None:
                     on_failure(e)
 
         self.post(do_send)
+        return request_id
+
+    def send_sync(self, target: str, action: str, payload: Any,
+                  timeout: float = 30.0) -> Any:
+        """Blocking request/response — for worker-pool/data-plane callers
+        only (never call from the event loop: responses are dispatched
+        there and would deadlock). Raises on remote error or timeout."""
+        assert threading.current_thread() is not self._loop_thread, \
+            "send_sync on the transport event loop would deadlock"
+        done = threading.Event()
+        box: list = [None, None]
+
+        def ok(resp):
+            box[0] = resp
+            done.set()
+
+        def fail(err):
+            box[1] = err
+            done.set()
+
+        request_id = self.send(self.node_id, target, action, payload, ok,
+                               fail)
+        if not done.wait(timeout):
+            # drop the abandoned callback so _pending can't grow unbounded
+            # against a wedged peer (a very late response then no-ops)
+            with self._lock:
+                self._pending.pop(request_id, None)
+            raise NodeNotConnectedError(
+                f"timeout after {timeout}s awaiting [{action}] on [{target}]")
+        if box[1] is not None:
+            raise box[1] if isinstance(box[1], Exception) \
+                else NodeNotConnectedError(str(box[1]))
+        return box[0]
 
     # ------------------------------------------------------------ handshake
 
@@ -302,3 +367,4 @@ class TcpTransport:
             except OSError:
                 pass
         self._loop_queue.put(None)
+        self._workers.shutdown(wait=False, cancel_futures=True)

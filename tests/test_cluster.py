@@ -1,0 +1,253 @@
+"""Multi-node cluster integration: the round-2 "assemble the islands" test.
+
+The VERDICT round-1 acceptance scenario (modeled on the reference's
+InternalTestCluster suites — test/framework/.../test/InternalTestCluster
+.java:195 — which boot real Nodes with real loopback transports in one
+process): boot 3 ClusterNodes on loopback, create an index (2 shards,
+1 replica), bulk-index over HTTP, kill the primary-holding node, verify
+re-election + replica promotion + correct search results.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from opensearch_tpu.cluster.service import ClusterNode
+
+
+def boot_cluster(n=3):
+    nodes = {f"cn-{i}": ClusterNode(f"cn-{i}") for i in range(n)}
+    peers = {nid: node.address for nid, node in nodes.items()}
+    for node in nodes.values():
+        node.bootstrap(peers)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if any(n.is_leader for n in nodes.values()):
+            return nodes
+        time.sleep(0.05)
+    raise AssertionError("no leader elected")
+
+
+@pytest.fixture()
+def cluster():
+    nodes = boot_cluster(3)
+    yield nodes
+    for node in nodes.values():
+        node.close()
+
+
+def wait_for(cond, timeout=30, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestClusterFormation:
+    def test_three_nodes_one_leader_shared_state(self, cluster):
+        nodes = list(cluster.values())
+        leaders = [n for n in nodes if n.is_leader]
+        assert len(leaders) == 1
+        wait_for(lambda: all(n.state is not None
+                             and len(n.state.nodes) == 3 for n in nodes),
+                 msg="full membership on all nodes")
+
+    def test_create_index_allocates_across_nodes(self, cluster):
+        any_node = next(iter(cluster.values()))
+        res = any_node.request("PUT", "/dist", {
+            "settings": {"number_of_shards": 2, "number_of_replicas": 1},
+            "mappings": {"properties": {"body": {"type": "text"},
+                                        "n": {"type": "integer"}}}})
+        assert res["acknowledged"] is True
+        any_node.await_health("green", timeout=30)
+        routing = any_node._data()["routing"]["dist"]
+        assert len(routing) == 2
+        holders = set()
+        for entry in routing:
+            assert entry["primary"] is not None
+            assert len(entry["replicas"]) == 1
+            assert entry["replicas"][0] != entry["primary"]
+            assert entry["active_replicas"] == entry["replicas"]
+            holders.add(entry["primary"])
+            holders.update(entry["replicas"])
+        assert len(holders) >= 2, "all copies landed on one node"
+        # local shards actually exist where routing says they do
+        for entry_i, entry in enumerate(routing):
+            for nid in [entry["primary"]] + entry["replicas"]:
+                assert ("dist", entry_i) in cluster[nid].shards
+
+    def test_join_after_bootstrap(self, cluster):
+        extra = ClusterNode("cn-extra")
+        try:
+            seed = next(iter(cluster.values()))
+            extra.join(seed.address, seed.node_id)
+            wait_for(lambda: extra.state is not None
+                     and "cn-extra" in extra.state.nodes,
+                     msg="joiner in membership")
+        finally:
+            extra.close()
+
+
+class TestClusterDataPath:
+    def setup_index(self, cluster, replicas=1):
+        node = next(iter(cluster.values()))
+        node.request("PUT", "/docs", {
+            "settings": {"number_of_shards": 2,
+                         "number_of_replicas": replicas},
+            "mappings": {"properties": {"body": {"type": "text"},
+                                        "n": {"type": "integer"}}}})
+        node.await_health("green", timeout=30)
+        return node
+
+    def test_bulk_and_search_any_node(self, cluster):
+        node = self.setup_index(cluster)
+        lines = []
+        for i in range(20):
+            lines.append(json.dumps({"index": {"_id": f"d{i}"}}))
+            lines.append(json.dumps(
+                {"body": f"searchable event {i}", "n": i}))
+        res = node.handle("POST", "/docs/_bulk",
+                          body="\n".join(lines) + "\n")
+        assert res.status == 200 and res.body["errors"] is False
+        node.request("POST", "/docs/_refresh")
+        # search from EVERY node: scatter-gather over the transport
+        for n in cluster.values():
+            out = n.request("POST", "/docs/_search", {
+                "query": {"match": {"body": "searchable"}}, "size": 25})
+            assert out["hits"]["total"]["value"] == 20, n.node_id
+        # doc GET routed to the right shard/node from any node
+        for n in cluster.values():
+            got = n.request("GET", "/docs/_doc/d7")
+            assert got["found"] and got["_source"]["n"] == 7
+
+    def test_replicas_receive_writes(self, cluster):
+        node = self.setup_index(cluster)
+        for i in range(10):
+            node.request("PUT", f"/docs/_doc/r{i}",
+                         {"body": f"replicated {i}", "n": i})
+        routing = node._data()["routing"]["docs"]
+        for sid, entry in enumerate(routing):
+            for rnode in entry["active_replicas"]:
+                shard = cluster[rnode].shards[("docs", sid)]
+                primary = cluster[entry["primary"]].shards[("docs", sid)]
+                assert shard.engine.max_seq_no == primary.engine.max_seq_no
+
+    def test_aggregations_across_nodes(self, cluster):
+        node = self.setup_index(cluster)
+        for i in range(30):
+            node.request("PUT", f"/docs/_doc/a{i}",
+                         {"body": "tagged" if i % 3 == 0 else "plain",
+                          "n": i})
+        node.request("POST", "/docs/_refresh")
+        out = node.request("POST", "/docs/_search", {
+            "size": 0, "query": {"match_all": {}},
+            "aggs": {"total_n": {"sum": {"field": "n"}},
+                     "avg_n": {"avg": {"field": "n"}}}})
+        assert out["hits"]["total"]["value"] == 30
+        assert out["aggregations"]["total_n"]["value"] == sum(range(30))
+        assert abs(out["aggregations"]["avg_n"]["value"] - 14.5) < 1e-6
+
+
+class TestClusterFailover:
+    def test_kill_primary_node_promote_and_search(self):
+        """The VERDICT acceptance test: 3 nodes, 2 shards, 1 replica;
+        bulk over real HTTP; kill the node holding a primary; verify
+        re-election (if leader died), promotion, and correct results."""
+        from opensearch_tpu.rest.http import HttpServer
+
+        nodes = boot_cluster(3)
+        http = None
+        try:
+            any_node = next(iter(nodes.values()))
+            any_node.request("PUT", "/ft", {
+                "settings": {"number_of_shards": 2,
+                             "number_of_replicas": 1},
+                "mappings": {"properties": {"body": {"type": "text"},
+                                            "n": {"type": "integer"}}}})
+            any_node.await_health("green", timeout=30)
+
+            # bulk-index over a real HTTP socket
+            http = HttpServer(any_node, port=0)
+            http.start()
+            lines = []
+            for i in range(24):
+                lines.append(json.dumps({"index": {"_id": f"h{i}"}}))
+                lines.append(json.dumps({"body": f"failover doc {i}",
+                                         "n": i}))
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{http.port}/ft/_bulk",
+                data=("\n".join(lines) + "\n").encode(),
+                headers={"Content-Type": "application/x-ndjson"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                bulk_out = json.loads(r.read())
+            assert bulk_out["errors"] is False
+            any_node.request("POST", "/ft/_refresh")
+
+            # kill the node holding shard 0's primary (not the HTTP node)
+            routing = any_node._data()["routing"]["ft"]
+            victim_id = routing[0]["primary"]
+            if victim_id == any_node.node_id:
+                victim_id = routing[1]["primary"]
+            if victim_id == any_node.node_id:
+                victim_id = routing[0]["replicas"][0]
+            old_terms = [e["primary_term"] for e in routing]
+            victim = nodes[victim_id]
+            had_primary = [sid for sid, e in enumerate(routing)
+                           if e["primary"] == victim_id]
+            assert had_primary, "victim held no primary — test setup broken"
+            victim.close()
+
+            survivors = {nid: n for nid, n in nodes.items()
+                         if nid != victim_id}
+
+            # failure detection removes the node; allocator promotes
+            def promoted():
+                s = next(iter(survivors.values()))
+                st = s.state
+                if st is None or victim_id in st.nodes:
+                    return False
+                r = (st.data or {}).get("routing", {}).get("ft")
+                if not r:
+                    return False
+                return all(e["primary"] is not None
+                           and e["primary"] != victim_id for e in r)
+            wait_for(promoted, timeout=120,
+                     msg="replica promotion after node death")
+
+            s = next(iter(survivors.values()))
+            new_routing = s._data()["routing"]["ft"]
+            for sid in had_primary:
+                assert new_routing[sid]["primary_term"] > old_terms[sid], \
+                    "promotion must bump the primary term"
+
+            # exactly one leader among survivors (re-election if needed)
+            wait_for(lambda: sum(1 for n in survivors.values()
+                                 if n.is_leader) == 1, timeout=60,
+                     msg="single leader among survivors")
+
+            # search still returns every doc, from every survivor
+            for n in survivors.values():
+                out = n.request("POST", "/ft/_search", {
+                    "query": {"match": {"body": "failover"}}, "size": 30})
+                assert out["hits"]["total"]["value"] == 24, \
+                    f"data loss after failover via {n.node_id}"
+
+            # writes keep working after promotion
+            w = next(iter(survivors.values()))
+            res = w.request("PUT", "/ft/_doc/post-failover",
+                            {"body": "failover epilogue", "n": 99})
+            assert res["_status"] in (200, 201)
+            w.request("POST", "/ft/_refresh")
+            out = w.request("POST", "/ft/_search", {
+                "query": {"match": {"body": "epilogue"}}})
+            assert out["hits"]["total"]["value"] == 1
+        finally:
+            if http is not None:
+                http.close()
+            for n in nodes.values():
+                n.close()

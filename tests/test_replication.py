@@ -31,6 +31,31 @@ def group(tmp_path):
     return ShardReplicationGroup(primary, replicas)
 
 
+class TestSerdeSafety:
+    def test_reserved_marker_keys_in_user_data_round_trip_as_data(self):
+        """A doc body containing the codec's own marker keys must survive
+        as plain data — never be interpreted as pickle/ndarray on decode
+        (that would be RCE across the REST boundary)."""
+        from opensearch_tpu.transport import serde
+
+        evil = {"__pickle__": "AAAA", "__ndarray__": "BBBB",
+                "__type__": "cluster_state", "nested": {"__escaped__": 1}}
+        out = serde.decode(serde.encode({"doc": evil}))
+        assert out == {"doc": evil}
+
+    def test_opaque_and_ndarray_round_trip(self):
+        import numpy as np
+
+        from opensearch_tpu.transport import serde
+        from opensearch_tpu.transport.serde import Opaque
+
+        arr = np.arange(12, dtype=np.int32).reshape(3, 4)
+        payload = {"a": arr, "o": Opaque({"x": np.float32(1.5)})}
+        out = serde.decode(serde.encode(payload))
+        assert (out["a"] == arr).all()
+        assert out["o"]["x"] == np.float32(1.5)
+
+
 class TestInstallSegments:
     def test_indexing_after_install_does_not_lose_docs(self, tmp_path):
         """Regression (round-1 advisor, high): install_segments must advance
