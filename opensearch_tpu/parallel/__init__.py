@@ -9,7 +9,8 @@ shard_map, partial top-k merged with `all_gather` + `top_k`, totals with
 """
 
 from opensearch_tpu.parallel.distributed import (
-    DistributedSearcher, align_agg_plans, make_mesh, pad_stack_trees)
+    DistributedSearcher, HbmShardSet, align_agg_plans, make_mesh,
+    pad_stack_trees)
 
-__all__ = ["DistributedSearcher", "align_agg_plans", "make_mesh",
-           "pad_stack_trees"]
+__all__ = ["DistributedSearcher", "HbmShardSet", "align_agg_plans",
+           "make_mesh", "pad_stack_trees"]

