@@ -25,7 +25,7 @@ Mechanisms ported (reference anchors):
 from __future__ import annotations
 
 import enum
-from typing import Any, Callable, Dict, List, Optional, Set
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from opensearch_tpu.cluster.coordination.core import (
     ApplyCommitRequest, ClusterState, CoordinationState,
@@ -54,6 +54,22 @@ class Mode(enum.Enum):
     FOLLOWER = "FOLLOWER"
 
 
+class NotLeaderAbort(Exception):
+    """A queued/in-flight state update was aborted because this node lost
+    (or never committed) leadership — the caller should retry against the
+    current leader (reference: FailedToCommitClusterStateException /
+    NotMasterException, both retryable)."""
+
+def _safe_notify(listener, outcome) -> None:
+    """Invoke an update listener, never letting its exception escape the
+    coordinator's state machine."""
+    if listener is not None:
+        try:
+            listener(outcome)
+        except Exception:
+            pass
+
+
 class Coordinator:
     def __init__(self, node_id: str, transport, scheduler,
                  initial_state: ClusterState,
@@ -74,7 +90,17 @@ class Coordinator:
         self._leader_check_failures = 0
         self._stopped = False
         self._publish_in_flight = False
-        self._pending_values: List[Callable[[ClusterState], ClusterState]] = []
+        # (update_fn, listener) pairs; listener(None) on successful fold
+        # into a publication, listener(exc) if the update itself raised —
+        # MasterService's per-task onFailure isolation: one poison task
+        # must never wedge the queue
+        self._pending_values: List[Tuple[
+            Callable[[ClusterState], ClusterState],
+            Optional[Callable[[Optional[Exception]], None]]]] = []
+        # listeners of the publication currently in flight, acked on
+        # commit quorum / failed on publication failure or depose
+        self._inflight_listeners: List[
+            Optional[Callable[[Optional[Exception]], None]]] = []
         self._pending_joins: Set[str] = set()
 
         t = transport
@@ -104,6 +130,7 @@ class Coordinator:
         # a later re-election can publish again (the timeout timer is bound
         # to a version and would no longer clear it for us)
         self._publish_in_flight = False
+        self._fail_pending_updates(f"leader stepped down: {reason}")
         self._leader_check_failures = 0
         self._election_epoch += 1
         self._schedule_election()
@@ -124,9 +151,20 @@ class Coordinator:
         self.mode = Mode.FOLLOWER
         self.leader = leader
         self._publish_in_flight = False
+        self._fail_pending_updates(f"following [{leader}]")
         self._leader_check_failures = 0
         self._election_epoch += 1
         self._schedule_leader_check()
+
+    def _fail_pending_updates(self, reason: str):
+        """On losing leadership, every queued or in-flight client update is
+        failed to its listener (MasterService onNoLongerMaster): listeners
+        therefore fire exactly once, and callers retry against the new
+        leader instead of hanging or double-submitting."""
+        pending, self._pending_values = self._pending_values, []
+        inflight, self._inflight_listeners = self._inflight_listeners, []
+        for listener in ([l for _, l in pending] + inflight):
+            _safe_notify(listener, NotLeaderAbort(reason))
 
     # ------------------------------------------------------------ elections
 
@@ -286,12 +324,16 @@ class Coordinator:
 
     def submit_state_update(self, update: Callable[[ClusterState],
                                                    ClusterState],
+                            listener: Optional[Callable[
+                                [Optional[Exception]], None]] = None,
                             ) -> bool:
         """MasterService.submitStateUpdateTask analog: leader-only, updates
-        are queued and published in order (single-threaded batch)."""
+        are queued and published in order (single-threaded batch).
+        `listener` is invoked once with None when the update folds into a
+        publication, or with the exception if the update raised."""
         if self.mode != Mode.LEADER:
             return False
-        self._pending_values.append(update)
+        self._pending_values.append((update, listener))
         self._publish_next()
         return True
 
@@ -306,12 +348,25 @@ class Coordinator:
         data = base.data
         taken_values = self._pending_values
         taken_joins = self._pending_joins
-        for update in taken_values:
-            tmp = update(base.with_(nodes=new_nodes, data=data))
+        surviving: List = []
+        for update, listener in taken_values:
+            # isolate each task: a raising update notifies its listener and
+            # is dropped; the rest of the batch — and the leader — proceed
+            # (MasterService catches per-task exceptions the same way)
+            try:
+                tmp = update(base.with_(nodes=new_nodes, data=data))
+            except Exception as e:
+                _safe_notify(listener, e)
+                continue
             data = tmp.data
             new_nodes = tmp.nodes
+            surviving.append((update, listener))
         self._pending_values = []
         self._pending_joins = set()
+
+        def ack_applied():
+            for _, listener in surviving:
+                _safe_notify(listener, None)
         if base.last_accepted_config != base.last_committed_config:
             # a reconfiguration is still uncommitted: don't start another
             # (handleClientValue would reject it) — republish same config
@@ -322,6 +377,7 @@ class Coordinator:
                 and new_config == base.last_accepted_config
                 and base.term == self.coord_state.current_term
                 and base.master_node == self.node_id):
+            ack_applied()   # no-op updates still complete successfully
             return  # nothing to publish
         state = base.with_(
             term=self.coord_state.current_term,
@@ -334,12 +390,17 @@ class Coordinator:
         try:
             request = self.coord_state.handle_client_value(state)
         except CoordinationStateRejectedError:
-            # keep the client updates and joins for the next publish round
-            # instead of silently dropping them
-            self._pending_values = taken_values + self._pending_values
+            # keep the surviving client updates and joins for the next
+            # publish round instead of silently dropping them (the raising
+            # ones were already failed to their listeners)
+            self._pending_values = surviving + self._pending_values
             self._pending_joins |= taken_joins
             return
         self._publish_in_flight = True
+        # listeners ack at COMMIT time (_finish_publication), not here — a
+        # publication that fails its quorum must fail its listeners, or a
+        # client could hold acknowledged=true for a change that was lost
+        self._inflight_listeners = [l for _, l in surviving]
         self._publish(request)
 
     def _reconfigure(self, nodes: frozenset) -> VotingConfiguration:
@@ -428,6 +489,7 @@ class Coordinator:
                 self.coord_state.last_published_version == published_version:
             self._publish_in_flight = False
             if self.mode == Mode.LEADER:
+                # _become_candidate fails the in-flight listeners too
                 self._become_candidate("publication failed to commit")
 
     def _send_commit(self, peer: str, commit: ApplyCommitRequest):
@@ -446,6 +508,9 @@ class Coordinator:
         if not self._publish_in_flight:
             return  # already committed this publication
         self._publish_in_flight = False
+        listeners, self._inflight_listeners = self._inflight_listeners, []
+        for listener in listeners:
+            _safe_notify(listener, None)
         for peer in sorted(acked_peers):
             self._send_commit(peer, commit)
         # more queued work?

@@ -29,11 +29,13 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from opensearch_tpu.cluster.allocation import allocate, health_of, shard_copies
 from opensearch_tpu.cluster.coordination.coordinator import (
-    Coordinator, Mode, bootstrap_state)
+    Coordinator, Mode, NotLeaderAbort, bootstrap_state)
 from opensearch_tpu.cluster.coordination.core import ClusterState
 from opensearch_tpu.cluster.routing import generate_shard_id
 from opensearch_tpu.common.errors import (
-    IllegalArgumentError, IndexNotFoundError, OpenSearchTpuError)
+    IllegalArgumentError, IndexNotFoundError, OpenSearchTpuError,
+    ProcessClusterEventTimeoutError, RemoteTransportError,
+    ShardNotReadyError)
 from opensearch_tpu.index.mapper import MapperService
 from opensearch_tpu.index.shard import IndexShard
 from opensearch_tpu.transport.serde import Opaque
@@ -75,11 +77,13 @@ class ClusterNode:
         self.local = Node(node_name=node_id, settings=settings)
         self.transport = TcpTransport(node_id, host=host, port=port)
         self.shards: Dict[Tuple[str, int], IndexShard] = {}
-        self._mappers: Dict[str, MapperService] = {}
+        # keyed by (index name, index UUID) — see _mapper_for
+        self._mappers: Dict[Tuple[str, Optional[str]], MapperService] = {}
         # replicas the local primary must fan ops to before they appear in
         # active_replicas (recovery tracking window — ReplicationTracker's
         # "tracked" allocations, index/seqno/ReplicationTracker.java:103)
         self._tracked: Dict[Tuple[str, int], set] = {}
+        self._tracked_lock = threading.Lock()
         self._applied_lock = threading.Lock()
         self._latest_state: Optional[ClusterState] = None
         self._reconcile_scheduled = False
@@ -166,8 +170,11 @@ class ClusterNode:
 
     def _submit_to_leader(self, update: dict, timeout: float = 30.0) -> dict:
         """Route a cluster-state mutation to the elected leader
-        (TransportMasterNodeAction) and wait for it to be applied
-        locally."""
+        (TransportMasterNodeAction) and wait for it to be applied.
+        `timeout` bounds when new attempts may START; a single in-flight
+        attempt can extend past it (up to ~80s) because aborting mid-wait
+        would force a retry that double-enqueues a non-idempotent
+        update."""
         deadline = time.time() + timeout
         while time.time() < deadline:
             leader = self._leader_id()
@@ -178,9 +185,21 @@ class ClusterNode:
                 ok = self._leader_apply_update(update)
             else:
                 try:
+                    # timeout must exceed the leader-side worst case
+                    # (submitted.wait 10s + folded.wait 70s), or a
+                    # slow-quorum publication makes the caller retry and
+                    # double-enqueue a non-idempotent update
                     resp = self.transport.send_sync(
-                        leader, LEADER_UPDATE, update, timeout=10.0)
+                        leader, LEADER_UPDATE, update, timeout=85.0)
                     ok = bool(resp and resp.get("accepted"))
+                except RemoteTransportError as e:
+                    # the leader rejected the update itself (duplicate
+                    # create_index) or reported an unresolved publication:
+                    # neither is safe to blind-retry against a new leader
+                    if e.status < 500 or e.error_type == \
+                            ProcessClusterEventTimeoutError.error_type:
+                        raise
+                    ok = False
                 except OpenSearchTpuError:
                     ok = False
             if ok:
@@ -240,19 +259,45 @@ class ClusterNode:
             data = allocate(data, sorted(state.nodes))
             return state.with_(data=data)
 
-        # coordinator methods must run on the event-loop thread
-        done = threading.Event()
-        accepted = [False]
+        # coordinator methods must run on the event-loop thread; the
+        # listener reports the update's fold outcome so a validation
+        # failure (e.g. duplicate create_index) surfaces to the caller as
+        # the typed exception instead of wedging the publish queue
+        submitted = threading.Event()
+        folded = threading.Event()
+        outcome: list = [None, False]   # [exception, accepted]
+
+        def listener(exc):
+            outcome[0] = exc
+            folded.set()
 
         def submit():
-            try:
-                accepted[0] = self.coordinator.submit_state_update(mutate)
-            finally:
-                done.set()
+            outcome[1] = self.coordinator.submit_state_update(mutate,
+                                                              listener)
+            submitted.set()
 
         self.transport.post(submit)
-        done.wait(10.0)
-        return accepted[0]
+        if not submitted.wait(10.0) or not outcome[1]:
+            return False
+        # the listener fires exactly once: on fold failure, on commit, or
+        # via _fail_pending_updates when leadership is lost. The wait must
+        # cover an update queued BEHIND an in-flight publication (up to
+        # 30s publish timeout) plus its own publication (another 30s). If
+        # it still hasn't resolved, the update may yet commit — raising a
+        # non-retryable timeout (never returning False, which would make
+        # the caller re-enqueue a non-idempotent update) is the only safe
+        # answer (ProcessClusterEventTimeoutException semantics).
+        if not folded.wait(70.0):
+            raise ProcessClusterEventTimeoutError(
+                f"cluster state update [{update.get('kind')}] did not "
+                f"resolve within 70s")
+        if outcome[0] is not None:
+            exc = outcome[0]
+            if isinstance(exc, NotLeaderAbort):
+                return False    # retry against the new leader
+            raise exc if isinstance(exc, OpenSearchTpuError) \
+                else OpenSearchTpuError(str(exc))
+        return True
 
     # ----------------------------------------------------------- apply loop
 
@@ -310,7 +355,33 @@ class ClusterNode:
             if name not in indices or self.node_id not in owners:
                 shard = self.shards.pop((name, sid))
                 shard.close()
-                self._tracked.pop((name, sid), None)
+                with self._tracked_lock:
+                    self._tracked.pop((name, sid), None)
+        for stale in [k for k in self._mappers if k[0] not in indices]:
+            del self._mappers[stale]
+        # prune recovery tracking: drop a target once its recovery has
+        # COMPLETED (it is in active_replicas and receives ops via the
+        # in-sync set) or its node left the cluster. A target merely
+        # absent from this routing snapshot is kept — the snapshot may
+        # predate the assignment that triggered the recovery, and the
+        # write path already intersects _tracked with current replicas.
+        live_nodes = set(state.nodes)
+        with self._tracked_lock:
+            for key in list(self._tracked):
+                name, sid = key
+                entry = routing[name][sid] if name in routing \
+                    and sid < len(routing[name]) else None
+                if entry is None:
+                    if name not in indices:
+                        self._tracked.pop(key, None)
+                    continue
+                keep = {t for t in self._tracked[key]
+                        if t in live_nodes
+                        and t not in entry.get("active_replicas", [])}
+                if keep:
+                    self._tracked[key] = keep
+                else:
+                    self._tracked.pop(key, None)
         # create/adjust shards we own
         for name, shard_entries in routing.items():
             meta = indices.get(name)
@@ -323,6 +394,17 @@ class ClusterNode:
                 if not (is_primary or is_replica):
                     continue
                 shard = self.shards.get(key)
+                if shard is not None and \
+                        getattr(shard, "index_uuid", None) != meta.get("uuid"):
+                    # same name, different index: the index was deleted and
+                    # recreated between two applied states — the stale
+                    # shard (old engine + old mappings) must not masquerade
+                    # as the new index's shard (IndexMetadata UUID identity)
+                    self.shards.pop(key, None)
+                    shard.close()
+                    with self._tracked_lock:
+                        self._tracked.pop(key, None)
+                    shard = None
                 if shard is None:
                     shard = self._create_shard(name, sid, meta, is_primary,
                                                entry)
@@ -345,6 +427,7 @@ class ClusterNode:
                            primary=is_primary,
                            primary_term=entry.get("primary_term", 1),
                            allocation_id=f"{name}_{sid}_{self.node_id}")
+        shard.index_uuid = meta.get("uuid")
         if not is_primary:
             # replica: peer-recover from the primary over the transport
             primary_node = entry.get("primary")
@@ -357,10 +440,16 @@ class ClusterNode:
         return shard
 
     def _mapper_for(self, name: str, meta: dict) -> MapperService:
-        mapper = self._mappers.get(name)
+        # keyed by (name, index UUID): delete + recreate under the same
+        # name is a DIFFERENT index (reference: IndexMetadata.getIndexUUID
+        # identity), so the old mappings must not leak into the new one
+        key = (name, meta.get("uuid"))
+        mapper = self._mappers.get(key)
         if mapper is None:
+            for stale in [k for k in self._mappers if k[0] == name]:
+                del self._mappers[stale]
             mapper = MapperService(meta.get("mappings") or {})
-            self._mappers[name] = mapper
+            self._mappers[key] = mapper
         return mapper
 
     # ------------------------------------------------------------- recovery
@@ -391,7 +480,8 @@ class ClusterNode:
         if shard is None or not shard.primary:
             raise OpenSearchTpuError(
                 f"not primary for [{key}] on [{self.node_id}]")
-        self._tracked.setdefault(key, set()).add(payload["target"])
+        with self._tracked_lock:
+            self._tracked.setdefault(key, set()).add(payload["target"])
         shard.engine.refresh()
         return {"segments": Opaque(shard.engine.segments),
                 "max_seq_no": shard.engine.max_seq_no,
@@ -448,7 +538,7 @@ class ClusterNode:
         key = (name, sid)
         shard = self.shards.get(key)
         if shard is None or not shard.primary:
-            raise OpenSearchTpuError(
+            raise ShardNotReadyError(
                 f"shard [{name}][{sid}] not primary on [{self.node_id}]")
         entry = self._routing_entry(name, sid)
         results = []
@@ -474,8 +564,9 @@ class ClusterNode:
                 results.append({"id": op["id"], "error": str(e),
                                 "status": e.status})
         # replicate to in-sync + tracked copies
-        targets = set(entry.get("active_replicas", [])) \
-            | self._tracked.get(key, set())
+        with self._tracked_lock:
+            tracked = set(self._tracked.get(key, set()))
+        targets = set(entry.get("active_replicas", [])) | tracked
         targets &= set(entry.get("replicas", []))
         failures = []
         threads = []
@@ -545,6 +636,24 @@ class ClusterNode:
             doc_id, int(settings.get("number_of_shards", 1)),
             routing=routing)
 
+    def _retry_shard_op(self, attempt, timeout: float = 10.0):
+        """Run a shard-level operation, retrying while the target reports
+        ShardNotReadyError — the window where routing has been published
+        but the owning node hasn't finished creating/tearing down the
+        shard. The reference retries these through a ClusterStateObserver
+        (TransportReplicationAction retryPrimaryException); `attempt`
+        re-resolves routing on every call so a moved shard is found."""
+        deadline = time.time() + timeout
+        while True:
+            try:
+                return attempt()
+            except (ShardNotReadyError, RemoteTransportError) as e:
+                retryable = isinstance(e, ShardNotReadyError) or \
+                    e.error_type == ShardNotReadyError.error_type
+                if not retryable or time.time() >= deadline:
+                    raise
+                time.sleep(0.1)
+
     def execute_bulk(self, ops_by_index: List[dict]) -> dict:
         """Group ops per shard, dispatch per-shard bulks to primaries
         (local or remote), reassemble per-item results in order."""
@@ -556,19 +665,32 @@ class ClusterNode:
         items: List[Optional[dict]] = [None] * len(ops_by_index)
         errors = False
         for (name, sid), group in groups.items():
-            entry = self._routing_entry(name, sid)
-            primary = entry.get("primary")
             payload = {"index": name, "shard": sid,
                        "ops": [op for _, op in group]}
-            if primary is None:
-                resp = {"items": [{"id": op["id"], "status": 503,
-                                   "error": "primary shard not active"}
+
+            def dispatch(name=name, sid=sid, payload=payload):
+                entry = self._routing_entry(name, sid)
+                primary = entry.get("primary")
+                if primary is None:
+                    raise ShardNotReadyError("primary shard not active")
+                if primary == self.node_id:
+                    return entry, self._on_shard_bulk_primary(
+                        self.node_id, payload)
+                return entry, self.transport.send_sync(
+                    primary, SHARD_BULK_PRIMARY, payload, timeout=60.0)
+
+            try:
+                entry, resp = self._retry_shard_op(dispatch)
+            except OpenSearchTpuError as e:
+                try:
+                    entry = self._routing_entry(name, sid)
+                except OpenSearchTpuError:
+                    # e.g. the index was deleted mid-bulk: still report
+                    # per-item errors rather than failing the whole bulk
+                    entry = {"replicas": [], "active_replicas": []}
+                resp = {"items": [{"id": op["id"], "status": e.status,
+                                   "error": str(e) or e.error_type}
                                   for _, op in group]}
-            elif primary == self.node_id:
-                resp = self._on_shard_bulk_primary(self.node_id, payload)
-            else:
-                resp = self.transport.send_sync(primary, SHARD_BULK_PRIMARY,
-                                                payload, timeout=60.0)
             for (i, op), item in zip(group, resp["items"]):
                 action = "delete" if op["op"] == "delete" else "index"
                 body = {"_index": name, "_id": item["id"],
@@ -595,7 +717,7 @@ class ClusterNode:
     def _on_shard_get(self, sender: str, payload: dict):
         shard = self.shards.get((payload["index"], payload["shard"]))
         if shard is None:
-            raise OpenSearchTpuError("shard not local")
+            raise ShardNotReadyError("shard not local")
         res = shard.get_doc(payload["id"])
         if res is None:
             return {"found": False}
@@ -605,16 +727,19 @@ class ClusterNode:
     def get_doc(self, name: str, doc_id: str,
                 routing: Optional[str] = None) -> dict:
         sid = self._shard_for_doc(name, doc_id, routing)
-        entry = self._routing_entry(name, sid)
-        primary = entry.get("primary")
         payload = {"index": name, "shard": sid, "id": doc_id}
-        if primary == self.node_id:
-            resp = self._on_shard_get(self.node_id, payload)
-        elif primary is not None:
-            resp = self.transport.send_sync(primary, SHARD_GET, payload,
+
+        def dispatch():
+            entry = self._routing_entry(name, sid)
+            primary = entry.get("primary")
+            if primary is None:
+                raise ShardNotReadyError("primary shard not active")
+            if primary == self.node_id:
+                return self._on_shard_get(self.node_id, payload)
+            return self.transport.send_sync(primary, SHARD_GET, payload,
                                             timeout=30.0)
-        else:
-            raise OpenSearchTpuError("primary shard not active")
+
+        resp = self._retry_shard_op(dispatch)
         out = {"_index": name, "_id": doc_id, "found": resp["found"]}
         if resp["found"]:
             out.update({"_source": resp["source"],
@@ -660,7 +785,7 @@ class ClusterNode:
         for sid in payload["shards"]:
             shard = self.shards.get((name, sid))
             if shard is None:
-                raise OpenSearchTpuError(f"shard [{name}][{sid}] not local")
+                raise ShardNotReadyError(f"shard [{name}][{sid}] not local")
             cands, decoded, total = shard.executor.execute_query_phase(
                 body, k)
             out.append({"shard": sid,
@@ -683,7 +808,7 @@ class ClusterNode:
         body = payload["body"]
         shard = self.shards.get((name, sid))
         if shard is None:
-            raise OpenSearchTpuError(f"shard [{name}][{sid}] not local")
+            raise ShardNotReadyError(f"shard [{name}][{sid}] not local")
         sort_specs = _parse_sort(body.get("sort"))
         score_sorted = sort_specs[0][0] == "_score"
         query_node = dsl.parse_query(body.get("query"))
@@ -715,61 +840,89 @@ class ClusterNode:
         wants_score = score_sorted or bool(body.get("track_scores"))
         k = max(from_ + size, 10)
 
-        routing = self._data().get("routing", {})
-        if name not in routing:
-            raise IndexNotFoundError(f"no such index [{name}]")
-        # pick one copy per shard: primary preferred (fully consistent),
-        # else an in-sync replica (ARS slots in here later)
-        by_node: Dict[str, List[int]] = {}
-        shard_nodes: Dict[int, str] = {}
-        for sid, entry in enumerate(routing[name]):
-            node = entry.get("primary")
-            if node is None:
-                active = entry.get("active_replicas", [])
-                node = active[0] if active else None
-            if node is None:
-                raise OpenSearchTpuError(
-                    f"no active copy for shard [{name}][{sid}]")
-            by_node.setdefault(node, []).append(sid)
-            shard_nodes[sid] = node
+        # scatter with routing re-resolution: a shard may move or finish
+        # initializing between attempts (the ClusterStateObserver-style
+        # retry — re-grouping by node each round, unlike a node-pinned
+        # retry which would hammer a stale owner)
+        deadline = time.time() + 10.0
+        while True:
+            routing = self._data().get("routing", {})
+            if name not in routing:
+                raise IndexNotFoundError(f"no such index [{name}]")
+            # pick one copy per shard: primary preferred (fully
+            # consistent), else an in-sync replica (ARS slots in here)
+            by_node: Dict[str, List[int]] = {}
+            # the fetch phase must hit the same copy that served the query
+            # phase (candidates carry that node's segment indices)
+            shard_nodes: Dict[int, str] = {}
+            unassigned = None
+            for sid, entry in enumerate(routing[name]):
+                node = entry.get("primary")
+                if node is None:
+                    active = entry.get("active_replicas", [])
+                    node = active[0] if active else None
+                if node is None:
+                    unassigned = sid
+                    break
+                by_node.setdefault(node, []).append(sid)
+                shard_nodes[sid] = node
+            if unassigned is not None:
+                # transient failover/creation window: retry like the
+                # per-node ShardNotReadyError path below
+                if time.time() >= deadline:
+                    raise ShardNotReadyError(
+                        f"no active copy for shard [{name}][{unassigned}]")
+                time.sleep(0.1)
+                continue
 
-        # scatter: query phase per node (parallel)
-        all_candidates: List[_Candidate] = []
-        all_partials = []
-        total = 0
-        lock = threading.Lock()
-        errors: List[Exception] = []
+            # query phase per node (parallel)
+            all_candidates: List[_Candidate] = []
+            all_partials = []
+            total = 0
+            lock = threading.Lock()
+            errors: List[Exception] = []
 
-        def query_node_shards(node: str, sids: List[int]):
-            nonlocal total
-            payload = {"index": name, "shards": sids, "body": body, "k": k}
-            try:
-                if node == self.node_id:
-                    resp = self._on_shard_query(self.node_id, payload)
-                else:
-                    resp = self.transport.send_sync(node, SHARD_QUERY,
-                                                    payload, timeout=60.0)
-                with lock:
-                    for res in resp["results"]:
-                        for score, seg_i, ord_, sv in _unwrap(
-                                res["candidates"]):
-                            c = _Candidate(score, seg_i, ord_, sv,
-                                           shard_i=res["shard"])
-                            all_candidates.append(c)
-                        all_partials.extend(_unwrap(res["partials"]))
-                        total += res["total"]
-            except Exception as e:
-                errors.append(e)
+            def query_node_shards(node: str, sids: List[int]):
+                nonlocal total
+                payload = {"index": name, "shards": sids, "body": body,
+                           "k": k}
+                try:
+                    if node == self.node_id:
+                        resp = self._on_shard_query(self.node_id, payload)
+                    else:
+                        resp = self.transport.send_sync(
+                            node, SHARD_QUERY, payload, timeout=60.0)
+                    with lock:
+                        for res in resp["results"]:
+                            for score, seg_i, ord_, sv in _unwrap(
+                                    res["candidates"]):
+                                c = _Candidate(score, seg_i, ord_, sv,
+                                               shard_i=res["shard"])
+                                all_candidates.append(c)
+                            all_partials.extend(_unwrap(res["partials"]))
+                            total += res["total"]
+                except Exception as e:
+                    errors.append(e)
 
-        threads = [threading.Thread(target=query_node_shards,
-                                    args=(node, sids), daemon=True)
-                   for node, sids in by_node.items()]
-        for th in threads:
-            th.start()
-        for th in threads:
-            th.join(65.0)
-        if errors:
-            raise errors[0]
+            threads = [threading.Thread(target=query_node_shards,
+                                        args=(node, sids), daemon=True)
+                       for node, sids in by_node.items()]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(65.0)
+            if not errors:
+                break
+
+            def _retryable(e: Exception) -> bool:
+                return isinstance(e, ShardNotReadyError) or (
+                    isinstance(e, RemoteTransportError)
+                    and e.error_type == ShardNotReadyError.error_type)
+
+            hard = [e for e in errors if not _retryable(e)]
+            if hard or time.time() >= deadline:
+                raise (hard or errors)[0]
+            time.sleep(0.1)
 
         # coordinator reduce: global sort + page (SearchPhaseController)
         all_candidates.sort(key=_compare_candidates(sort_specs))

@@ -59,6 +59,37 @@ class IllegalArgumentError(OpenSearchTpuError):
     error_type = "illegal_argument_exception"
 
 
+class ProcessClusterEventTimeoutError(OpenSearchTpuError):
+    """A cluster-state update was accepted but its publication did not
+    resolve within the wait budget. NOT safely retryable: the update may
+    still commit later (reference: ProcessClusterEventTimeoutException)."""
+    status = 503
+    error_type = "process_cluster_event_timeout_exception"
+
+
+class ShardNotReadyError(OpenSearchTpuError):
+    """The routing table names this node for a shard the node has not
+    finished creating (or has just torn down) — a transient window during
+    cluster-state application. Callers retry while re-resolving routing,
+    like the reference's ClusterStateObserver-driven retries in
+    TransportReplicationAction."""
+    status = 503
+    error_type = "no_shard_available_action_exception"
+
+
+class RemoteTransportError(OpenSearchTpuError):
+    """A typed error relayed from another node over the transport: carries
+    the remote exception's error_type/status so the REST layer renders the
+    same body the originating node would have (reference:
+    RemoteTransportError wrapping in transport/InboundHandler)."""
+
+    def __init__(self, reason: str = "", error_type: str = "exception",
+                 remote_status: int = 500, **metadata):
+        super().__init__(reason, **metadata)
+        self.error_type = error_type
+        self.status = remote_status
+
+
 class ParsingError(OpenSearchTpuError):
     status = 400
     error_type = "parsing_exception"

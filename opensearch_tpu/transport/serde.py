@@ -9,6 +9,8 @@ around it (tcp.py) is binary."""
 from __future__ import annotations
 
 import base64
+import importlib
+import io
 import json
 import pickle
 from typing import Any, Callable, Dict
@@ -51,18 +53,73 @@ register(
 
 
 class Opaque:
-    """Wrapper marking a payload subtree for binary (pickle) transport —
-    segment columns, candidate lists, decoded agg partials. The analog of
-    the reference sending Lucene file chunks / InternalAggregations as raw
-    versioned bytes inside its frames: the cluster transport is a trusted,
-    same-version boundary (handshake-verified), never exposed to clients,
-    so pickle's arbitrary-code caveat is contained the same way the
-    reference's arbitrary StreamInput readers are."""
+    """Wrapper marking a payload subtree for binary transport — segment
+    columns, candidate lists, decoded agg partials. Decoding uses a
+    RESTRICTED unpickler: only the wire classes registered in
+    `_OPAQUE_ALLOWED` (plus numpy's array-reconstruction machinery) may
+    appear; any other global in the stream raises UnpicklingError before
+    anything is instantiated. This mirrors the reference's trust model —
+    InboundHandler only ever deserializes via fixed registered readers
+    (transport/InboundHandler.java), never arbitrary classes."""
 
     __slots__ = ("value",)
 
     def __init__(self, value: Any):
         self.value = value
+
+
+# (module, qualname) pairs the restricted unpickler may resolve. Kept as
+# strings so registering a class does not import its module eagerly; the
+# wire dataclasses (segments, compiled plans, agg partials) are all plain
+# data — numpy arrays, strings, ints — with no side-effecting __reduce__.
+_OPAQUE_ALLOWED = {
+    ("opensearch_tpu.index.segment", "Segment"),
+    ("opensearch_tpu.index.segment", "TermMeta"),
+    ("opensearch_tpu.index.segment", "FieldStats"),
+    ("opensearch_tpu.index.segment", "DocValuesColumn"),
+    ("opensearch_tpu.index.segment", "OrdinalsColumn"),
+    ("opensearch_tpu.index.segment", "VectorColumn"),
+    ("opensearch_tpu.ops.knn", "IVFIndex"),
+    ("opensearch_tpu.search.compile", "Plan"),
+    ("opensearch_tpu.search.aggs.engine", "AggPlan"),
+    ("opensearch_tpu.search.aggs.reduce", "Decoded"),
+    # numpy array/scalar/dtype reconstruction (module moved in numpy 2.x)
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy", "bool_"),
+    ("numpy.core.numeric", "_frombuffer"),
+    ("numpy._core.numeric", "_frombuffer"),
+    ("builtins", "complex"),
+    ("builtins", "bytearray"),
+    ("builtins", "frozenset"),
+    ("builtins", "set"),
+}
+
+
+def allow_opaque(*classes: type):
+    """Extension point: register additional wire-safe classes (plugins)."""
+    for cls in classes:
+        _OPAQUE_ALLOWED.add((cls.__module__, cls.__qualname__))
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module: str, name: str):
+        if (module, name) not in _OPAQUE_ALLOWED:
+            raise pickle.UnpicklingError(
+                f"opaque payload references disallowed global "
+                f"[{module}.{name}]")
+        obj: Any = importlib.import_module(module)
+        for part in name.split("."):
+            obj = getattr(obj, part)
+        return obj
+
+
+def _safe_loads(raw: bytes) -> Any:
+    return _RestrictedUnpickler(io.BytesIO(raw)).load()
 
 
 # marker keys the codec itself emits — a *plain* dict from user data that
@@ -114,7 +171,7 @@ def from_wire(value: Any) -> Any:
             return reader({k: v for k, v in value.items()
                            if k != "__type__"})
         if "__pickle__" in value:
-            return pickle.loads(base64.b64decode(value["__pickle__"]))
+            return _safe_loads(base64.b64decode(value["__pickle__"]))
         if "__ndarray__" in value:
             return np.frombuffer(
                 base64.b64decode(value["__ndarray__"]),

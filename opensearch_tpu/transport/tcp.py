@@ -42,6 +42,12 @@ FLAG_COMPRESSED = 4
 COMPRESS_THRESHOLD = 1024
 HEADER = struct.Struct(">2sBBQH")   # magic, version, flags, request_id, action_len
 HANDSHAKE_ACTION = "internal:tcp/handshake"
+# frame-size ceilings: segments cross the wire at recovery, so the general
+# cap is generous; before a connection has handshaken only a tiny frame is
+# admissible (a handshake fits in well under 64KB) — an unauthenticated
+# peer cannot drive large allocations or a zlib inflation bomb
+MAX_PAYLOAD = 1 << 30
+MAX_PREAUTH_PAYLOAD = 1 << 16
 
 
 def _write_frame(sock: socket.socket, flags: int, request_id: int,
@@ -67,7 +73,7 @@ def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return buf
 
 
-def _read_frame(sock: socket.socket):
+def _read_frame(sock: socket.socket, max_payload: int = MAX_PAYLOAD):
     head = _read_exact(sock, HEADER.size)
     if head is None:
         return None
@@ -78,11 +84,18 @@ def _read_frame(sock: socket.socket):
         raise ValueError(f"incompatible wire version [{version}]")
     action = _read_exact(sock, action_len).decode("utf-8")
     (payload_len,) = struct.unpack(">I", _read_exact(sock, 4))
+    if payload_len > max_payload:
+        raise ValueError(f"frame payload [{payload_len}] exceeds limit")
     body = _read_exact(sock, payload_len)
     if body is None:
         return None
     if flags & FLAG_COMPRESSED:
-        body = zlib.decompress(body)
+        # bounded inflate: a small compressed body must not be allowed to
+        # decompress into unbounded memory (zip-bomb hardening)
+        d = zlib.decompressobj()
+        body = d.decompress(body, max_payload)
+        if d.unconsumed_tail:
+            raise ValueError("decompressed frame exceeds limit")
     return flags, request_id, action, serde.decode(body)
 
 
@@ -204,27 +217,62 @@ class TcpTransport:
                 conn, _ = self._server.accept()
             except OSError:
                 return
-            threading.Thread(target=self._read_loop, args=(conn,),
-                             daemon=True).start()
+            threading.Thread(target=self._read_loop,
+                             args=(conn, False), daemon=True).start()
 
-    def _read_loop(self, conn: socket.socket):
+    def _read_loop(self, conn: socket.socket, outbound: bool = True):
+        """Frame pump for one socket. Direction discipline (the trust
+        gate the reference gets from InboundHandler's fixed readers +
+        TransportHandshaker): accepted sockets carry REQUESTS only and
+        must open with a handshake before any other action is processed
+        (and until then only a tiny frame is admitted — see
+        MAX_PREAUTH_PAYLOAD); sockets we initiated carry RESPONSES only
+        (request ids correlate with our _pending map). A frame violating
+        either rule closes the connection, so a peer that skips the
+        handshake can neither invoke handlers nor spoof a response."""
+        handshaken = False
         try:
             while not self._closed:
-                frame = _read_frame(conn)
+                frame = _read_frame(
+                    conn, MAX_PAYLOAD if (outbound or handshaken)
+                    else MAX_PREAUTH_PAYLOAD)
                 if frame is None:
                     return
                 flags, request_id, action, payload = frame
                 if flags & FLAG_RESPONSE:
+                    if not outbound:
+                        return  # response on an inbound socket: spoofing
                     self.post(lambda f=flags, r=request_id, p=payload:
                               self._handle_response(f, r, p))
-                elif action in self._blocking_actions:
+                    continue
+                if outbound:
+                    return  # peers never send requests on our sockets
+                if not handshaken:
+                    if action != HANDSHAKE_ACTION:
+                        return  # un-handshaken peer: drop the connection
+                    handshaken = True
+                if action in self._blocking_actions:
                     self._workers.submit(self._handle_request, conn,
                                          request_id, action, payload)
                 else:
                     self.post(lambda c=conn, r=request_id, a=action,
                               p=payload: self._handle_request(c, r, a, p))
-        except (OSError, ValueError):
+        except Exception:
+            # any undecodable/hostile frame (bad magic, corrupt zlib,
+            # rejected opaque payload) poisons the stream position — the
+            # only safe recovery is dropping the connection, like the
+            # reference on a corrupted inbound pipeline
             return
+        finally:
+            with self._lock:
+                self._write_locks.pop(conn, None)
+            for nid, s in list(self._connections.items()):
+                if s is conn:
+                    self._connections.pop(nid, None)
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _locked_write(self, sock: socket.socket, flags: int,
                       request_id: int, action: str, payload: Any):
@@ -247,11 +295,14 @@ class TcpTransport:
             self._locked_write(conn, FLAG_RESPONSE, request_id, action,
                                response)
         except Exception as e:
+            from opensearch_tpu.common.errors import OpenSearchTpuError
+            err = {"error": type(e).__name__, "reason": str(e)}
+            if isinstance(e, OpenSearchTpuError):
+                err["error_type"] = e.error_type
+                err["status"] = e.status
             try:
                 self._locked_write(conn, FLAG_RESPONSE | FLAG_ERROR,
-                                   request_id, action,
-                                   {"error": type(e).__name__,
-                                    "reason": str(e)})
+                                   request_id, action, err)
             except OSError:
                 pass
 
@@ -263,8 +314,16 @@ class TcpTransport:
         on_response, on_failure = callbacks
         if flags & FLAG_ERROR:
             if on_failure is not None:
-                on_failure(NodeNotConnectedError(
-                    f"remote error: {payload.get('reason', payload)}"))
+                if isinstance(payload, dict) and "error_type" in payload:
+                    from opensearch_tpu.common.errors import \
+                        RemoteTransportError
+                    on_failure(RemoteTransportError(
+                        payload.get("reason", ""),
+                        error_type=payload["error_type"],
+                        remote_status=int(payload.get("status", 500))))
+                else:
+                    on_failure(NodeNotConnectedError(
+                        f"remote error: {payload.get('reason', payload)}"))
         elif on_response is not None:
             on_response(payload)
 
@@ -280,8 +339,17 @@ class TcpTransport:
         sock = socket.create_connection(addr, timeout=5)
         sock.settimeout(None)
         self._connections[target] = sock
-        threading.Thread(target=self._read_loop, args=(sock,),
+        threading.Thread(target=self._read_loop, args=(sock, True),
                          daemon=True).start()
+        # open with a handshake frame so the peer's read loop admits the
+        # connection before any substantive frame arrives (TCP ordering
+        # guarantees it lands first); the response needs no waiter
+        with self._lock:
+            self._request_counter += 1
+            hs_id = self._request_counter
+        self._locked_write(sock, 0, hs_id, HANDSHAKE_ACTION,
+                           {"__sender__": self.node_id,
+                            "__body__": {"version": __version__}})
         return sock
 
     def send(self, sender: str, target: str, action: str, payload: Any,
@@ -361,7 +429,7 @@ class TcpTransport:
             self._server.close()
         except OSError:
             pass
-        for sock in self._connections.values():
+        for sock in list(self._connections.values()):
             try:
                 sock.close()
             except OSError:

@@ -251,3 +251,52 @@ class TestClusterFailover:
                 http.close()
             for n in nodes.values():
                 n.close()
+
+
+class TestLeaderUpdateIsolation:
+    """Round-2 advisor finding: a state update that raises (e.g. duplicate
+    create_index) must fail ONLY that update — the publish queue keeps
+    flowing (MasterService per-task onFailure isolation)."""
+
+    def test_duplicate_create_index_returns_400_and_leader_survives(
+            self, cluster):
+        node = next(iter(cluster.values()))
+        node.request("PUT", "/dupidx", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0}})
+        node.await_health("green", timeout=30)
+        res = node.handle("PUT", "/dupidx", body={
+            "settings": {"number_of_shards": 1}})
+        assert res.status == 400, res.body
+        assert "exists" in json.dumps(res.body)
+        # the leader must still publish subsequent updates
+        node.request("PUT", "/after-dup", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0}})
+        node.await_health("green", timeout=30)
+        assert "after-dup" in node._data()["indices"]
+        # and from a NON-leader node too (routed over the transport)
+        non_leader = next(n for n in cluster.values() if not n.is_leader)
+        res2 = non_leader.handle("PUT", "/dupidx", body={
+            "settings": {"number_of_shards": 1}})
+        assert res2.status == 400, res2.body
+
+    def test_delete_recreate_uses_new_mappings(self, cluster):
+        node = next(iter(cluster.values()))
+        node.request("PUT", "/remap", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+            "mappings": {"properties": {"v": {"type": "keyword"}}}})
+        node.await_health("green", timeout=30)
+        node.request("PUT", "/remap/_doc/1", {"v": "abc"})
+        node.request("DELETE", "/remap")
+        wait_for(lambda: "remap" not in node._data().get("indices", {}),
+                 msg="index deleted")
+        node.request("PUT", "/remap", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+            "mappings": {"properties": {"v": {"type": "integer"}}}})
+        node.await_health("green", timeout=30)
+        node.request("PUT", "/remap/_doc/1", {"v": 42})
+        node.request("POST", "/remap/_refresh")
+        # range query on an integer field only works with the NEW mapper;
+        # the stale keyword mapper would reject or mis-type it
+        out = node.request("POST", "/remap/_search", {
+            "query": {"range": {"v": {"gte": 40}}}})
+        assert out["hits"]["total"]["value"] == 1

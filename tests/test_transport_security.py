@@ -1,0 +1,201 @@
+"""Transport trust-boundary tests.
+
+The reference transport only ever deserializes via fixed registered readers
+(transport/InboundHandler.java) and gates connections on a version handshake
+(TransportHandshaker.java:57). These tests pin the TPU build's equivalents:
+a restricted unpickler for Opaque payloads (no arbitrary globals), inbound
+frame processing gated on a completed handshake, response frames accepted
+only on sockets we initiated, and per-socket write-lock cleanup.
+"""
+
+import base64
+import os
+import pickle
+import socket
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.transport import serde
+from opensearch_tpu.transport.tcp import (
+    HANDSHAKE_ACTION, HEADER, MAGIC, WIRE_VERSION, TcpTransport,
+    _read_frame, _write_frame)
+
+
+# ------------------------------------------------------------------ serde
+
+class _Sentinel:
+    executed = False
+
+
+def _arm(*a):
+    _Sentinel.executed = True
+    return _Sentinel()
+
+
+class TestRestrictedUnpickler:
+    def test_malicious_pickle_rejected(self, tmp_path):
+        """A __pickle__ payload whose stream references an unregistered
+        global (the classic os.system / subprocess gadget) must raise
+        before anything is instantiated."""
+        evil = {"__pickle__": base64.b64encode(
+            pickle.dumps((os.system, ("true",)))).decode("ascii")}
+        with pytest.raises(Exception) as ei:
+            serde.from_wire(evil)
+        assert "disallowed" in str(ei.value)
+
+    def test_reduce_gadget_not_executed(self):
+        class Gadget:
+            def __reduce__(self):
+                return (_arm, ())
+
+        _Sentinel.executed = False
+        evil = {"__pickle__": base64.b64encode(
+            pickle.dumps(Gadget())).decode("ascii")}
+        with pytest.raises(Exception):
+            serde.from_wire(evil)
+        assert not _Sentinel.executed
+
+    def test_legit_opaque_roundtrip(self):
+        from opensearch_tpu.index.segment import FieldStats, TermMeta
+        payload = serde.to_wire(serde.Opaque({
+            "tm": TermMeta(3, 9, 0, 2),
+            "fs": FieldStats(10, 600, 30),
+            "arr": np.arange(8, dtype=np.int32),
+            "vals": [(1.5, 0, 2, [None, 3])],
+        }))
+        out = serde.from_wire(payload)
+        assert out["tm"].doc_freq == 3
+        assert out["fs"].sum_total_term_freq == 600
+        assert np.array_equal(out["arr"], np.arange(8, dtype=np.int32))
+
+    def test_segment_roundtrip_over_wire(self):
+        from opensearch_tpu.index.mapper import MapperService
+        from opensearch_tpu.index.segment import SegmentBuilder
+        mapper = MapperService({"properties": {
+            "body": {"type": "text"}, "n": {"type": "integer"}}})
+        b = SegmentBuilder(mapper, "s0")
+        for i in range(5):
+            b.add(mapper.parse_document(f"d{i}", {"body": f"hello w{i}",
+                                                  "n": i}))
+        seg = b.seal()
+        raw = serde.encode({"seg": serde.Opaque(seg)})
+        out = serde.decode(raw)["seg"]
+        assert out.num_docs == 5
+        assert out.doc_ids == seg.doc_ids
+
+
+# ------------------------------------------------------------- handshake
+
+def _raw_frame(flags, request_id, action, payload_bytes):
+    action_b = action.encode()
+    return (HEADER.pack(MAGIC, WIRE_VERSION, flags, request_id,
+                        len(action_b)) + action_b
+            + struct.pack(">I", len(payload_bytes)) + payload_bytes)
+
+
+class TestHandshakeGate:
+    def test_unhandshaken_request_dropped(self):
+        t = TcpTransport("gate-a")
+        hits = []
+        t.register_handler("gate-a", "test/echo",
+                           lambda s, p: hits.append(p) or {"ok": True})
+        try:
+            s = socket.create_connection(t.address, timeout=5)
+            s.sendall(_raw_frame(0, 1, "test/echo",
+                                 serde.encode({"x": 1})))
+            # the node must close the connection without invoking the
+            # handler: recv returns EOF, never a response frame
+            s.settimeout(5)
+            assert s.recv(4096) == b""
+            assert hits == []
+        finally:
+            s.close()
+            t.close()
+
+    def test_handshaken_request_served(self):
+        t = TcpTransport("gate-b")
+        t.register_handler("gate-b", "test/echo", lambda s, p: {"ok": True})
+        try:
+            s = socket.create_connection(t.address, timeout=5)
+            s.sendall(_raw_frame(0, 1, HANDSHAKE_ACTION,
+                                 serde.encode({"version": "x"})))
+            s.sendall(_raw_frame(0, 2, "test/echo", serde.encode({})))
+            got = {}
+            deadline = time.time() + 5
+            s.settimeout(5)
+            while time.time() < deadline and len(got) < 2:
+                frame = _read_frame(s)
+                if frame is None:
+                    break
+                flags, rid, action, payload = frame
+                got[rid] = payload
+            assert got[2] == {"ok": True}
+        finally:
+            s.close()
+            t.close()
+
+    def test_spoofed_response_on_inbound_socket_ignored(self):
+        """A peer that merely connected must not be able to complete one
+        of our pending requests by guessing its id."""
+        t = TcpTransport("gate-c")
+        try:
+            # park a pending request toward an unknown-yet address
+            t.add_address("victim", "127.0.0.1", 1)  # nothing listens
+            s = socket.create_connection(t.address, timeout=5)
+            s.sendall(_raw_frame(0, 7, HANDSHAKE_ACTION,
+                                 serde.encode({"version": "x"})))
+            # now try to spoof a response on this inbound socket
+            from opensearch_tpu.transport.tcp import FLAG_RESPONSE
+            s.sendall(_raw_frame(FLAG_RESPONSE, 1, "whatever",
+                                 serde.encode({"pwned": True})))
+            s.settimeout(5)
+            # the transport closes the connection on the violation (the
+            # handshake response may or may not land first, depending on
+            # scheduling) — only handshake frames may ever come back
+            while True:
+                frame = _read_frame(s)
+                if frame is None:
+                    break
+                assert frame[2] == HANDSHAKE_ACTION
+        finally:
+            s.close()
+            t.close()
+
+    def test_node_to_node_rpc_still_works(self):
+        a = TcpTransport("rpc-a")
+        b = TcpTransport("rpc-b")
+        try:
+            b.register_handler("rpc-b", "test/add",
+                               lambda s, p: {"sum": p["x"] + p["y"]},
+                               blocking=True)
+            a.add_address("rpc-b", *b.address)
+            resp = a.send_sync("rpc-b", "test/add", {"x": 2, "y": 3},
+                               timeout=10)
+            assert resp["sum"] == 5
+        finally:
+            a.close()
+            b.close()
+
+    def test_write_locks_cleaned_up_on_disconnect(self):
+        t = TcpTransport("locks-a")
+        try:
+            socks = []
+            for i in range(4):
+                s = socket.create_connection(t.address, timeout=5)
+                s.sendall(_raw_frame(0, 1, HANDSHAKE_ACTION,
+                                     serde.encode({"version": "x"})))
+                socks.append(s)
+            deadline = time.time() + 5
+            while time.time() < deadline and len(t._write_locks) < 4:
+                time.sleep(0.02)
+            for s in socks:
+                s.close()
+            deadline = time.time() + 5
+            while time.time() < deadline and len(t._write_locks) > 0:
+                time.sleep(0.02)
+            assert len(t._write_locks) == 0
+        finally:
+            t.close()

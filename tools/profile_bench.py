@@ -1,0 +1,234 @@
+"""Per-phase profile of bench config 1 (BM25 match msearch batch).
+
+Round-3 verdict demanded a committed breakdown of where the 1.5s msearch
+batch goes: host prep (parse/compile/pad) vs device dispatch vs device
+compute vs transfer — plus microbenchmarks of the kernel's building blocks
+(gather+BM25, dense scatter-add, full-width top_k, and the candidate-buffer
+alternative) at the measured shapes, so the optimization attacks the real
+bottleneck. Writes PROFILE.md at the repo root.
+
+Usage:  python tools/profile_bench.py  [BENCH_DOCS=100000 BENCH_QUERIES=1024]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+RESULTS: list = []
+
+
+def log(name, seconds, note=""):
+    RESULTS.append((name, seconds, note))
+    print(f"{name:44s} {seconds * 1000:10.1f} ms  {note}", flush=True)
+
+
+def main():
+    os.environ.setdefault("BENCH_PROBE_TIMEOUTS", "300,120")
+    import bench
+    bench.ensure_backend()
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    print(f"platform: {platform}")
+
+    from opensearch_tpu.utils.demo import query_terms
+
+    t0 = time.perf_counter()
+    executor, seg = bench.build_index()
+    log("index build (host)", time.perf_counter() - t0)
+
+    queries = query_terms(bench.N_QUERIES, bench.VOCAB, seed=7,
+                          terms_per_query=2)
+    bodies = [{"query": {"match": {"body": q}}, "size": bench.TOP_K}
+              for q in queries]
+
+    # ---- end-to-end: warm + timed run (what bench.py measures)
+    t0 = time.perf_counter()
+    executor.multi_search(bodies)
+    log("msearch cold (compiles)", time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    executor.multi_search(bodies)
+    total = time.perf_counter() - t0
+    log("msearch warm TOTAL", total,
+        f"{len(bodies) / total:.0f} QPS")
+
+    # ---- dissect the warm path: host prep vs dispatch vs device vs fetch
+    from opensearch_tpu.search import dsl
+    from opensearch_tpu.search.compile import Compiler
+    from opensearch_tpu.search.executor import (_batched_runner,
+                                                unpack_batched_result)
+    from opensearch_tpu.parallel.distributed import (_tree_shapes,
+                                                     pad_stack_trees,
+                                                     plan_struct)
+
+    t0 = time.perf_counter()
+    stats = executor.reader.stats()
+    compiler = Compiler(executor.reader.mapper, stats)
+    compiled = []
+    for body in bodies:
+        node = dsl.parse_query(body["query"])
+        compiled.append(compiler.compile(
+            node, executor.reader.segments[0], executor.reader.device[0][1]))
+    log("host: parse+compile plans", time.perf_counter() - t0,
+        f"{len(bodies)} plans")
+
+    t0 = time.perf_counter()
+    structs = {}
+    for i, p in enumerate(compiled):
+        structs.setdefault(plan_struct(p), []).append(i)
+    log("host: group by struct", time.perf_counter() - t0,
+        f"{len(structs)} group(s)")
+
+    arrays, meta = executor.reader.device[0]
+    group_stats = []
+    prep = disp = 0.0
+    pending = []
+    for struct, idxs in structs.items():
+        t0 = time.perf_counter()
+        flats = [compiled[i].flatten_inputs([]) for i in idxs]
+        batched = jax.tree_util.tree_map(jnp.asarray, pad_stack_trees(flats))
+        min_scores = jnp.zeros(len(idxs), jnp.float32) - 1e38
+        prep += time.perf_counter() - t0
+        shapes = _tree_shapes(batched)
+        group_stats.append((len(idxs), shapes))
+        plan0 = compiled[idxs[0]]
+        fn = _batched_runner((plan_struct(plan0), shapes), plan0, meta,
+                             10, len(idxs))
+        t0 = time.perf_counter()
+        out = fn(arrays, batched, min_scores)
+        disp += time.perf_counter() - t0
+        pending.append(out)
+    log("host: flatten+pad+upload inputs", prep)
+    log("host: dispatch (async calls)", disp)
+    t0 = time.perf_counter()
+    for out in pending:
+        out.block_until_ready()
+    log("device: execute (block_until_ready)", time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    fetched = jax.device_get(pending)
+    log("transfer: device_get results", time.perf_counter() - t0,
+        f"{sum(np.asarray(f).nbytes for f in fetched)} B")
+
+    d_pad = int(arrays["live"].shape[0])
+    b_total = sum(b for b, _ in group_stats)
+    qb_max = 0
+    for _, shapes in group_stats:
+        for s in jax.tree_util.tree_leaves(shapes):
+            if isinstance(s, tuple) and len(s) == 2:
+                qb_max = max(qb_max, s[1])
+    print(f"\ngroups: {[(b,) for b, _ in group_stats]}  d_pad={d_pad} "
+          f"qb_max={qb_max}")
+
+    # ---- microbenchmarks at representative shapes
+    B = min(b_total, 1024)
+    QB = max(qb_max, 16)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, arrays["post_docs"].shape[0],
+                                  size=(B, QB)), dtype=jnp.int32)
+    w = jnp.asarray(rng.rand(B, QB), dtype=jnp.float32)
+
+    def timed(fn, *args, reps=3, name="", note=""):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+            jax.block_until_ready(out)
+        log(name, (time.perf_counter() - t0) / reps, note)
+
+    post_docs, post_tf = arrays["post_docs"], arrays["post_tf"]
+
+    @jax.jit
+    def k_gather(ids, w):
+        docs = post_docs[ids]                       # [B, QB, 128]
+        tfs = post_tf[ids]
+        part = w[:, :, None] * tfs / (tfs + 1.2)
+        return part.sum(axis=(1, 2))
+
+    timed(k_gather, ids, w, name="μ: gather+bm25 (no scatter)",
+          note=f"[B={B},QB={QB},128]")
+
+    @jax.jit
+    def k_scatter(ids, w):
+        docs = post_docs[ids]
+        tfs = post_tf[ids]
+        part = w[:, :, None] * tfs / (tfs + 1.2)
+        valid = docs >= 0
+        sidx = jnp.where(valid, docs, d_pad)
+
+        def one(s, p):
+            return jnp.zeros(d_pad, jnp.float32).at[s.ravel()].add(
+                p.ravel(), mode="drop")
+        return jax.vmap(one)(sidx, jnp.where(valid, part, 0.0))
+
+    timed(k_scatter, ids, w, name="μ: + dense scatter [B,d_pad]",
+          note=f"out {B}x{d_pad}")
+
+    @jax.jit
+    def k_scatter_topk(ids, w):
+        dense = k_scatter(ids, w)
+        return jax.lax.top_k(dense, 10)
+
+    timed(k_scatter_topk, ids, w, name="μ: + full-width top_k(10)")
+
+    @jax.jit
+    def k_scatter_topk2(ids, w):
+        dense = k_scatter(ids, w)
+        rows = dense.reshape(B, d_pad // 128, 128)
+        part_v, part_i = jax.lax.top_k(rows, 10)        # [B, R, 10]
+        base = (jnp.arange(d_pad // 128) * 128)[None, :, None]
+        flat_v = part_v.reshape(B, -1)
+        flat_i = (part_i + base).reshape(B, -1)
+        v, j = jax.lax.top_k(flat_v, 10)
+        return v, jnp.take_along_axis(flat_i, j, axis=1)
+
+    timed(k_scatter_topk2, ids, w, name="μ: + two-stage top_k(10)")
+
+    # candidate-buffer alternative: sort postings lanes by doc id,
+    # segment-sum duplicates, top-k over the compact buffer
+    @jax.jit
+    def k_candidates(ids, w):
+        docs = post_docs[ids].reshape(B, -1)            # [B, N]
+        tfs = post_tf[ids].reshape(B, -1)
+        part = jnp.where(docs >= 0,
+                         w.repeat(128, axis=1) * tfs / (tfs + 1.2), 0.0)
+        big = jnp.where(docs >= 0, docs, 2 ** 30)
+        sdocs, spart = jax.lax.sort([big, part], num_keys=1)
+        csum = jnp.cumsum(spart, axis=1)
+        n = sdocs.shape[1]
+        last = jnp.concatenate([sdocs[:, :-1] != sdocs[:, 1:],
+                                jnp.ones((B, 1), bool)], axis=1)
+        run_total = jnp.where(
+            last, csum - jnp.concatenate(
+                [jnp.zeros((B, 1), jnp.float32),
+                 jnp.where(last, csum, 0.0)[:, :-1]], axis=1), 0.0)
+        # (approx for the μbench: mask non-run-ends, topk over N)
+        masked = jnp.where(last & (sdocs < 2 ** 30), csum, -1e38)
+        v, j = jax.lax.top_k(masked, 10)
+        return v, jnp.take_along_axis(sdocs, j, axis=1)
+
+    timed(k_candidates, ids, w,
+          name="μ: candidate-buffer (sort+segsum+topk)",
+          note=f"N={QB * 128}")
+
+    with open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "PROFILE.md"), "w") as f:
+        f.write("# bench config 1 profile (%s)\n\n" % platform)
+        f.write("| phase | ms | note |\n|---|---|---|\n")
+        for name, sec, note in RESULTS:
+            f.write(f"| {name} | {sec * 1000:.1f} | {note} |\n")
+        f.write(f"\ngroups: {[(b,) for b, _ in group_stats]}; "
+                f"d_pad={d_pad}; qb_max={qb_max}; B={B}\n")
+    print("\nwrote PROFILE.md")
+
+
+if __name__ == "__main__":
+    main()
