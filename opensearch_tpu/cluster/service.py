@@ -85,6 +85,12 @@ class ClusterNode:
         self._tracked: Dict[Tuple[str, int], set] = {}
         self._tracked_lock = threading.Lock()
         self._applied_lock = threading.Lock()
+        # adaptive replica selection state (ResponseCollectorService.java:
+        # 59): per-node EWMA of query-phase service time + in-flight count;
+        # the routing rank is (outstanding+1) * ewma_ms, C3-style
+        self._ars: Dict[str, List[float]] = {}   # node -> [ewma_ms, outstanding]
+        self._ars_lock = threading.Lock()
+        self._ars_rr = 0
         self._latest_state: Optional[ClusterState] = None
         self._reconcile_scheduled = False
         self.coordinator: Optional[Coordinator] = None
@@ -436,8 +442,20 @@ class ClusterNode:
                     self._recover_from(shard, name, sid, primary_node)
                 except Exception:
                     shard.close()
+                    # backstop: without a re-kick the routing table keeps
+                    # naming this node and nothing ever retries — the
+                    # cluster would sit yellow forever (delayed-reroute
+                    # retry, like the reference's RetryableAction around
+                    # peer recovery)
+                    self.transport.scheduler.schedule_delayed(
+                        1000, self._kick_reconcile, "retry failed recovery")
                     return None
         return shard
+
+    def _kick_reconcile(self):
+        state = self.state
+        if state is not None and self._started:
+            self._on_state_applied(state)
 
     def _mapper_for(self, name: str, meta: dict) -> MapperService:
         # keyed by (name, index UUID): delete + recreate under the same
@@ -458,11 +476,13 @@ class ClusterNode:
                       primary_node: str):
         """Peer recovery target side (PeerRecoveryTargetService): ask the
         primary for its segment set, install it, then report started so
-        the leader marks this copy in-sync."""
-        resp = self.transport.send_sync(
+        the leader marks this copy in-sync. Retries while the primary
+        reports ShardNotReady — the replica's reconcile can apply the
+        routing state before the primary's has created its shard."""
+        resp = self._retry_shard_op(lambda: self.transport.send_sync(
             primary_node, START_RECOVERY,
             {"index": name, "shard": sid, "target": self.node_id},
-            timeout=60.0)
+            timeout=60.0))
         segments = _unwrap(resp["segments"])
         shard.engine.install_segments(
             segments, max_seq_no=resp["max_seq_no"],
@@ -478,7 +498,9 @@ class ClusterNode:
         key = (payload["index"], payload["shard"])
         shard = self.shards.get(key)
         if shard is None or not shard.primary:
-            raise OpenSearchTpuError(
+            # retryable: the target may be recovering before this node's
+            # own reconcile created the primary shard
+            raise ShardNotReadyError(
                 f"not primary for [{key}] on [{self.node_id}]")
         with self._tracked_lock:
             self._tracked.setdefault(key, set()).add(payload["target"])
@@ -492,9 +514,11 @@ class ClusterNode:
     def _register_actions(self):
         t = self.transport
         reg = t.register_handler
+        # management pool: a leader update blocks until publication commit
+        # (up to ~80s) — it must never occupy a data-plane worker slot
         reg(self.node_id, LEADER_UPDATE,
             lambda s, p: {"accepted": self._leader_apply_update(p)},
-            blocking=True)
+            blocking=True, pool="management")
         reg(self.node_id, SHARD_BULK_PRIMARY, self._on_shard_bulk_primary,
             blocking=True)
         reg(self.node_id, SHARD_BULK_REPLICA, self._on_shard_bulk_replica,
@@ -505,9 +529,9 @@ class ClusterNode:
         reg(self.node_id, SHARD_REFRESH, self._on_shard_refresh,
             blocking=True)
         reg(self.node_id, START_RECOVERY, self._on_start_recovery,
-            blocking=True)
+            blocking=True, pool="management")
         reg(self.node_id, REGISTER_ADDR, self._on_register_address,
-            blocking=True)
+            blocking=True, pool="management")
 
     def _on_register_address(self, sender: str, payload: dict):
         """Learn a joining node's transport address; propagate to the
@@ -822,6 +846,33 @@ class ClusterNode:
             hits.append(hit)
         return {"hits": Opaque(hits)}
 
+    def _select_copy(self, copies: List[str]) -> str:
+        """Adaptive replica selection (OperationRouting.java:339): rank
+        each copy by (outstanding+1) * service-time EWMA and take the
+        minimum; a round-robin starting offset spreads load while stats
+        are cold/equal. Failed copies never appear — routing drops them
+        from active_replicas before selection."""
+        if len(copies) == 1:
+            return copies[0]
+        with self._ars_lock:
+            self._ars_rr += 1
+            start = self._ars_rr % len(copies)
+            ordered = copies[start:] + copies[:start]
+            best, best_rank = ordered[0], None
+            for n in ordered:
+                ewma, outstanding = self._ars.setdefault(n, [10.0, 0])
+                rank = (outstanding + 1.0) * ewma
+                if best_rank is None or rank < best_rank:
+                    best, best_rank = n, rank
+            # decay non-winners so a copy that was never (or long ago)
+            # sampled drifts back into rotation instead of being starved
+            # by one fast measurement (ResponseCollectorService's
+            # adjustment of unselected nodes)
+            for n in ordered:
+                if n != best:
+                    self._ars[n][0] *= 0.95
+        return best
+
     def search(self, name: str, body: Optional[dict]) -> dict:
         """Coordinator side of query-then-fetch over the transport."""
         from opensearch_tpu.search.aggs.parse import parse_aggs
@@ -857,13 +908,16 @@ class ClusterNode:
             shard_nodes: Dict[int, str] = {}
             unassigned = None
             for sid, entry in enumerate(routing[name]):
-                node = entry.get("primary")
-                if node is None:
-                    active = entry.get("active_replicas", [])
-                    node = active[0] if active else None
-                if node is None:
+                copies = []
+                p = entry.get("primary")
+                if p is not None:
+                    copies.append(p)
+                copies += [n for n in entry.get("active_replicas", [])
+                           if n != p]
+                if not copies:
                     unassigned = sid
                     break
+                node = self._select_copy(copies)
                 by_node.setdefault(node, []).append(sid)
                 shard_nodes[sid] = node
             if unassigned is not None:
@@ -886,6 +940,10 @@ class ClusterNode:
                 nonlocal total
                 payload = {"index": name, "shards": sids, "body": body,
                            "k": k}
+                t0 = time.monotonic()
+                with self._ars_lock:
+                    st = self._ars.setdefault(node, [10.0, 0])
+                    st[1] += 1
                 try:
                     if node == self.node_id:
                         resp = self._on_shard_query(self.node_id, payload)
@@ -903,6 +961,12 @@ class ClusterNode:
                             total += res["total"]
                 except Exception as e:
                     errors.append(e)
+                finally:
+                    took_ms = (time.monotonic() - t0) * 1000.0
+                    with self._ars_lock:
+                        st = self._ars[node]
+                        st[0] = 0.7 * st[0] + 0.3 * took_ms
+                        st[1] = max(0, st[1] - 1)
 
             threads = [threading.Thread(target=query_node_shards,
                                         args=(node, sids), daemon=True)

@@ -27,6 +27,7 @@ segments; deletes are a liveness bitmap applied in the scoring kernels.
 from __future__ import annotations
 
 import hashlib
+import itertools
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -140,6 +141,9 @@ class VectorColumn:
     ivf: Any = None          # Optional[opensearch_tpu.ops.knn.IVFIndex]
 
 
+_SEGMENT_UID = itertools.count(1)
+
+
 class Segment:
     """A sealed, immutable columnar segment (host numpy representation)."""
 
@@ -154,6 +158,10 @@ class Segment:
                  vector_dv: Dict[str, VectorColumn],
                  positions: Optional[Dict[Tuple[str, str], List[np.ndarray]]] = None):
         self.seg_id = seg_id
+        # process-unique identity: seg_id is a per-engine counter and can
+        # repeat across indices/engines, so caches keyed on segments (e.g.
+        # the SPMD HbmShardSet residency cache) must use `uid`
+        self.uid = next(_SEGMENT_UID)
         self.num_docs = num_docs
         self.doc_ids = doc_ids              # _id per local doc ord
         self.sources = sources              # _source per local doc ord
@@ -200,9 +208,16 @@ class Segment:
         copy keeps its own .liv deletes file."""
         import copy as _copy
         clone = _copy.copy(self)
+        clone.uid = next(_SEGMENT_UID)
         clone.live = self.live.copy()
         clone.doc_meta = dict(self.doc_meta)
         return clone
+
+    def __setstate__(self, state):
+        # a segment arriving over the wire (recovery) carries the SENDER's
+        # uid; re-mint locally so process-wide uniqueness holds
+        self.__dict__.update(state)
+        self.uid = next(_SEGMENT_UID)
 
     def get_term(self, field: str, term: str) -> Optional[TermMeta]:
         return self.term_dict.get((field, term))

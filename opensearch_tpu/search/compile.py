@@ -155,7 +155,11 @@ class Compiler:
             "min_hits": _i32(min_hits),
             "boost": _f32(boost),
         }
-        return Plan("text", static=(bool(constant),), inputs=inputs)
+        # static records the distinct-term count: the candidate-buffer
+        # kernel needs the max run length (= clause terms containing a doc)
+        # to window its exact segment-sum (executor.py)
+        return Plan("text", static=(bool(constant), len(weighted_terms)),
+                    inputs=inputs)
 
     def _analyze_query_terms(self, ft: MappedFieldType, text: Any,
                              analyzer_override: Optional[str] = None) -> List[str]:

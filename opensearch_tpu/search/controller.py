@@ -190,6 +190,31 @@ def execute_search(executors: List, body: Optional[dict],
         decoded_partials = []
         total = 0
         profile_shards.clear()
+        # SPMD path: with multiple (shard, segment) rows and enough mesh
+        # devices, the query phase is ONE shard_map program with on-chip
+        # all_gather/psum merge instead of a host loop (search/spmd.py)
+        from opensearch_tpu.search import spmd
+        rows = spmd.spmd_rows(executors)
+        if spmd.eligible(executors, body, rows, sort_specs):
+            shard_start = time.monotonic_ns()
+            out = spmd.spmd_query_phase(executors, body, k_eff,
+                                        extra_filters, rows)
+            if out is not None:
+                candidates, decoded_partials, total = out
+                candidates.sort(key=_compare_candidates(sort_specs))
+                if profiling:
+                    profile_shards.append({
+                        "id": f"[{executors[0].reader.index_name}][spmd]",
+                        "searches": [{"query": [{
+                            "type": "SpmdQueryPhase",
+                            "description": str(body.get("query")),
+                            "time_in_nanos":
+                                time.monotonic_ns() - shard_start,
+                            "breakdown": {"rows": len(rows)},
+                        }], "rewrite_time": 0, "collector": []}],
+                        "aggregations": [],
+                    })
+                return candidates, decoded_partials, total
         for shard_i, ex in enumerate(executors):
             if task is not None:
                 task.check_cancelled()

@@ -167,7 +167,193 @@ def build_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
     return run
 
 
-def build_batched_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int):
+# ---------------------------------------------------- packed input envelope
+#
+# Round-3 profile (PROFILE.md): on the tunneled device the per-leaf
+# jnp.asarray uploads dominated the msearch batch (~1.4s of a ~1.0s-compute
+# run — one transfer round trip per leaf). The envelope packs every stacked
+# input leaf of a group into ONE int32 buffer host-side; the jitted program
+# slices/bitcasts the leaves back out with a static layout, so a whole
+# group costs exactly one host→device transfer regardless of leaf count.
+
+def pack_leaves(leaves: List[np.ndarray]):
+    """Concatenate i32/f32/bool leaves into one int32 buffer + layout."""
+    total = 0
+    metas = []
+    for leaf in leaves:
+        n = int(np.prod(leaf.shape)) if leaf.ndim else 1
+        metas.append((total, tuple(leaf.shape), str(leaf.dtype)))
+        total += n
+    buf = np.empty(max(total, 1), np.int32)
+    for leaf, (off, shape, dtype) in zip(leaves, metas):
+        n = int(np.prod(shape)) if shape else 1
+        flat = np.ascontiguousarray(leaf).reshape(-1)
+        if leaf.dtype == np.float32:
+            flat = flat.view(np.int32)
+        elif leaf.dtype == np.bool_:
+            flat = flat.astype(np.int32)
+        elif leaf.dtype != np.int32:
+            raise ValueError(f"unsupported envelope dtype [{leaf.dtype}]")
+        buf[off:off + n] = flat
+    return buf, tuple(metas)
+
+
+def unpack_leaves(buf, layout):
+    """Device-side inverse of pack_leaves (static layout → traced slices)."""
+    out = []
+    for off, shape, dtype in layout:
+        n = int(np.prod(shape)) if shape else 1
+        piece = jax.lax.slice(buf, (off,), (off + n,))
+        if dtype == "float32":
+            piece = jax.lax.bitcast_convert_type(piece, jnp.float32)
+        elif dtype == "bool":
+            piece = piece.astype(jnp.bool_)
+        out.append(piece.reshape(shape))
+    return out
+
+
+def _fill_value(name: str, dtype) -> Any:
+    from opensearch_tpu.parallel.distributed import _PAD_FILL
+    return _PAD_FILL.get(name, False if dtype == np.bool_ else 0)
+
+
+def stack_flat_inputs(flats: List[List[Dict[str, np.ndarray]]]):
+    """Fast batch-stack of per-query flat input trees: grows every leaf to
+    the per-position max shape (same envelope semantics as
+    parallel.distributed.pad_stack_trees) but via preallocated fills
+    instead of per-query np.pad — the host-side hot path of msearch."""
+    b = len(flats)
+    treedef = jax.tree_util.tree_structure(flats[0])
+    names = [kp[-1].key if hasattr(kp[-1], "key") else ""
+             for kp, _ in jax.tree_util.tree_flatten_with_path(flats[0])[0]]
+    per_query = [jax.tree_util.tree_leaves(f) for f in flats]
+    n_leaves = len(per_query[0])
+    stacked = []
+    for li in range(n_leaves):
+        arrs = [np.asarray(q[li]) for q in per_query]
+        a0 = arrs[0]
+        shape = tuple(max(a.shape[d] for a in arrs)
+                      for d in range(a0.ndim))
+        if all(a.shape == shape for a in arrs):
+            out = np.stack(arrs)
+        else:
+            out = np.full((b, *shape), _fill_value(names[li], a0.dtype),
+                          dtype=a0.dtype)
+            for qi, a in enumerate(arrs):
+                out[(qi, *map(slice, a.shape))] = a
+        stacked.append(out)
+    return stacked, treedef
+
+
+def _pack_row(top_scores, top_idx, total):
+    """ONE f32 row [k | k | 1] (ints bitcast) so the host fetches a single
+    array — each fetch is a full round trip on remote devices."""
+    return jnp.concatenate([
+        top_scores,
+        jax.lax.bitcast_convert_type(top_idx.astype(jnp.int32),
+                                     jnp.float32),
+        jax.lax.bitcast_convert_type(total[None].astype(jnp.int32),
+                                     jnp.float32)])
+
+
+# candidate-buffer kernel only pays off while the sorted buffer stays far
+# below the dense [d_pad] width; above this lane count the dense
+# scatter+top_k path wins (bitonic sort is O(N log^2 N))
+CANDIDATE_MAX_LANES = 1 << 14
+
+
+def build_candidate_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
+                                layout, treedef):
+    """B text queries against one segment, scored in a COMPACT candidate
+    buffer instead of a dense per-doc vector.
+
+    The round-3 verdict's block-max/WAND analog: a text clause's matches
+    are exactly the union of its terms' postings lanes, so instead of
+    scatter-adding into a [d_pad]-wide score vector and top_k-ing 131K
+    lanes per query (the round-3 kernel), the gathered [QB·128] lanes are
+    sorted by doc id, duplicate docs are segment-summed with a
+    cumsum-at-run-ends trick, and top-k runs over the small buffer. HBM
+    traffic per query drops from O(d_pad) to O(QB·128).
+
+    Correctness notes: BM25 partials are >= 0 (idf >= 0, boosts
+    non-negative), which the monotone-cumsum run-total trick relies on;
+    per-term postings list each doc once, so a doc's run length equals its
+    distinct matched terms (min_hits / operator=and semantics); top_k on
+    ties picks the lowest lane = lowest doc id, matching the dense
+    kernel's doc-ascending tie-break."""
+
+    constant = plan.static[0]
+    n_terms = plan.static[1] if len(plan.static) > 1 else 1
+
+    def one(seg, flat_inputs, min_score):
+        my = flat_inputs[0]
+        docs = seg["post_docs"][my["ids"]]            # [QB, 128]
+        tfs = seg["post_tf"][my["ids"]]
+        valid = docs >= 0
+        safe_docs = jnp.where(valid, docs, 0)
+        norm_bytes = seg["norms"][my["row"][:, None], safe_docs]
+        dl = seg["length_table"][norm_bytes]
+        b = my["b"][:, None]
+        k1 = my["k1"]
+        denom = tfs + k1 * (1.0 - b + b * dl / my["avgdl"][:, None])
+        partial = my["w"][:, None] * tfs * (k1 + 1.0) / denom
+        real = valid & (my["hit"][:, None] > 0)
+
+        n = docs.shape[0] * docs.shape[1]
+        big = jnp.int32(2 ** 30)
+        doc_key = jnp.where(real, docs, big).reshape(n)
+        part = jnp.where(real, partial, 0.0).reshape(n)
+        hit = jnp.where(real, 1, 0).astype(jnp.int32).reshape(n)
+
+        sdoc, spart, shit = jax.lax.sort([doc_key, part, hit], num_keys=1)
+        is_end = jnp.concatenate([sdoc[:-1] != sdoc[1:],
+                                  jnp.ones((1,), bool)])
+        # exact windowed segment-sum: a doc's lanes are adjacent after the
+        # sort and number at most n_terms (each term lists a doc once), so
+        # summing a fixed backward window at the run's END lane is exact —
+        # no cumsum-difference cancellation, and the left-to-right order of
+        # the (stable) sort keeps float summation deterministic
+        run_score = spart
+        run_hits = shit
+        for j in range(1, n_terms):
+            prev_doc = jnp.concatenate([jnp.full((j,), -2, sdoc.dtype),
+                                        sdoc[:-j]])
+            same = prev_doc == sdoc
+            prev_part = jnp.concatenate([jnp.zeros((j,), spart.dtype),
+                                         spart[:-j]])
+            prev_hit = jnp.concatenate([jnp.zeros((j,), shit.dtype),
+                                        shit[:-j]])
+            run_score = run_score + jnp.where(same, prev_part, 0.0)
+            run_hits = run_hits + jnp.where(same, prev_hit, 0)
+        matches = run_hits >= my["min_hits"]
+        score = jnp.full(n, my["boost"]) if constant else run_score
+        valid_end = is_end & (sdoc < big)
+        safe_end_docs = jnp.where(valid_end, sdoc, 0)
+        eligible = valid_end & matches & seg["live"][safe_end_docs] \
+            & (score >= min_score)
+        total = jnp.sum(eligible.astype(jnp.int32))
+        masked = jnp.where(eligible, score, NEG_INF)
+        k_eff = min(k, n)
+        top_scores, top_lane = jax.lax.top_k(masked, k_eff)
+        top_docs = sdoc[top_lane]
+        if k_eff < k:
+            top_scores = jnp.concatenate(
+                [top_scores, jnp.full(k - k_eff, NEG_INF)])
+            top_docs = jnp.concatenate(
+                [top_docs, jnp.zeros(k - k_eff, jnp.int32)])
+        return _pack_row(top_scores, top_docs, total)
+
+    def run(seg, packed_buf):
+        leaves = unpack_leaves(packed_buf, layout)
+        batched_flat = jax.tree_util.tree_unflatten(treedef, leaves[:-1])
+        return jax.vmap(one, in_axes=(None, 0, 0))(seg, batched_flat,
+                                                   leaves[-1])
+
+    return run
+
+
+def build_batched_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
+                              layout, treedef):
     """B same-shaped queries against one segment as ONE device program.
 
     The TPU answer to per-query launch latency: where the reference executes
@@ -185,20 +371,27 @@ def build_batched_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int):
         masked = jnp.where(eligible, scores, NEG_INF)
         k_eff = min(k, seg["live"].shape[0])
         top_scores, top_idx = jax.lax.top_k(masked, k_eff)
-        # pack into ONE f32 row [k | k | 1] (ints bitcast) so the host fetches
-        # a single array — each fetch is a full round trip on remote devices
-        return jnp.concatenate([
-            top_scores,
-            jax.lax.bitcast_convert_type(top_idx.astype(jnp.int32),
-                                         jnp.float32),
-            jax.lax.bitcast_convert_type(total[None].astype(jnp.int32),
-                                         jnp.float32)])
+        return _pack_row(top_scores, top_idx, total)
 
-    def run(seg, batched_flat, min_scores):
+    def run(seg, packed_buf):
+        leaves = unpack_leaves(packed_buf, layout)
+        batched_flat = jax.tree_util.tree_unflatten(treedef, leaves[:-1])
         return jax.vmap(one, in_axes=(None, 0, 0))(seg, batched_flat,
-                                                   min_scores)
+                                                   leaves[-1])
 
     return run
+
+
+@functools.partial(jax.jit, static_argnums=())
+def _concat_rows(outs):
+    """Column-pad + row-concat all group outputs into ONE device array, so
+    a whole msearch batch is fetched in a single transfer (on a tunneled
+    device every fetch is a full round trip — the round-3 profile showed
+    3 sequential fetches costing ~200-400ms against ~0.3ms of compute)."""
+    width = max(o.shape[1] for o in outs)
+    return jnp.concatenate(
+        [jnp.pad(o, ((0, 0), (0, width - o.shape[1]))) for o in outs],
+        axis=0)
 
 
 def unpack_batched_result(packed: np.ndarray, k_eff: int):
@@ -210,12 +403,28 @@ def unpack_batched_result(packed: np.ndarray, k_eff: int):
     return scores, idx, totals
 
 
-def _batched_runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int,
-                    batch: int):
-    key = ("batched", plan_sig, meta, k, batch)
+def _envelope_runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int,
+                     layout, treedef):
+    """Jitted group program over a packed input envelope: the candidate-
+    buffer kernel for plain text clauses within the lane budget, the dense
+    kernel otherwise."""
+    key = ("env", plan_sig, meta, k, layout, treedef)
     fn = _JIT_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(build_batched_query_phase(plan, meta, k))
+        qb128 = None
+        n_terms = plan.static[1] if plan.kind == "text" \
+            and len(plan.static) > 1 else 1 << 30
+        if plan.kind == "text" and n_terms <= 16:
+            for off, shape, dtype in layout:
+                if len(shape) == 2:     # first [B, QB] leaf
+                    qb128 = shape[1] * 128
+                    break
+        if qb128 is not None and qb128 <= CANDIDATE_MAX_LANES:
+            fn = jax.jit(build_candidate_query_phase(plan, meta, k,
+                                                     layout, treedef))
+        else:
+            fn = jax.jit(build_batched_query_phase(plan, meta, k,
+                                                   layout, treedef))
         _JIT_CACHE[key] = fn
     return fn
 
@@ -299,14 +508,31 @@ def _compare_candidates(specs):
     return functools.cmp_to_key(cmp)
 
 
+# request keys the batched envelope path fully renders; anything else
+# (highlight, collapse, rescore, aggs, ...) takes the general path
+_BATCHABLE_KEYS = frozenset({"query", "size", "from", "min_score", "sort",
+                             "_source"})
+
+
+def _msearch_batchable(body: dict) -> bool:
+    return (set(body) <= _BATCHABLE_KEYS
+            and body.get("sort") in (None, "_score", ["_score"]))
+
+
 class SearchExecutor:
     """Executes a parsed search request against one shard (query + fetch)."""
 
     def __init__(self, reader: ShardReader):
         self.reader = reader
 
-    def search(self, body: Optional[dict] = None) -> dict:
+    def search(self, body: Optional[dict] = None,
+               _direct: bool = False) -> dict:
         from opensearch_tpu.search.controller import execute_search
+        body = body or {}
+        if not _direct and _msearch_batchable(body):
+            # single searches share the batched envelope kernel (B=1): one
+            # program, one upload, and bit-identical scores with _msearch
+            return self.multi_search([body])["responses"][0]
         return execute_search([self], body)
 
     def execute_query_phase(self, body: dict, k: int,
@@ -406,16 +632,14 @@ class SearchExecutor:
         batchable: List[Tuple[int, dict, Any, int, int, float]] = []
         for i, body in enumerate(bodies):
             body = body or {}
-            simple = (not (body.get("aggs") or body.get("aggregations"))
-                      and body.get("sort") in (None, "_score", ["_score"])
-                      and not body.get("search_after"))
-            if not simple:
-                responses[i] = self.search(body)
+            if not _msearch_batchable(body):
+                responses[i] = self.search(body, _direct=True)
                 continue
             try:
                 node = dsl.parse_query(body.get("query"))
             except Exception:
-                responses[i] = self.search(body)  # surface the error uniformly
+                # surface the error uniformly via the general path
+                responses[i] = self.search(body, _direct=True)
                 continue
             size = int(body.get("size", 10))
             from_ = int(body.get("from", 0))
@@ -426,20 +650,31 @@ class SearchExecutor:
                 if body.get("min_score") is not None else NEG_INF
             batchable.append((i, body, node, size, from_, min_score))
 
-        # group by plan STRUCTURE (shape-free): the cross-query shape envelope
-        # (pad_stack_trees) grows every query's inputs to the group max, so
-        # queries whose terms landed in different postings buckets still share
-        # one vmapped program — one device round trip per group
-        from opensearch_tpu.parallel.distributed import (
-            _tree_shapes, pad_stack_trees, plan_struct)
+        # group by plan STRUCTURE + per-segment input SHAPES: shapes are
+        # already power-of-two bucketed by the compiler, so shape-keyed
+        # groups stay few while making each group's stack a plain np.stack
+        # (no padding growth) and its kernel choice (candidate vs dense)
+        # uniform — one packed upload + one device program per group
+        from opensearch_tpu.parallel.distributed import plan_struct
+
+        def _flat_shape_sig(flats):
+            # cheap stand-in for _tree_shapes on the hot path: dict
+            # insertion order is deterministic (plans are built by the
+            # same code), and dtype.num avoids numpy's slow dtype.__str__
+            return tuple(
+                None if f is None else tuple(
+                    (k2, v.shape, v.dtype.num)
+                    for d in f for k2, v in d.items())
+                for f in flats)
 
         groups: Dict[Any, List[int]] = {}
-        compiled: Dict[int, List[Plan]] = {}
+        compiled: Dict[int, List[Optional[Plan]]] = {}
+        flats_by_i: Dict[int, List[Optional[list]]] = {}
         stats = self.reader.stats()
         compiler = Compiler(self.reader.mapper, stats)
         for entry in batchable:
             i, body, node, size, from_, min_score = entry
-            plans = []
+            plans: List[Optional[Plan]] = []
             for seg, (arrays, meta) in zip(self.reader.segments,
                                            self.reader.device):
                 if seg.num_docs == 0:
@@ -465,38 +700,61 @@ class SearchExecutor:
                 continue
             struct = tuple(plan_struct(p) if p is not None else None
                            for p in plans)
-            groups.setdefault((struct, min(k, 1 << 16)), []).append(i)
+            flats = [p.flatten_inputs([]) if p is not None else None
+                     for p in plans]
+            flats_by_i[i] = flats
+            groups.setdefault((struct, _flat_shape_sig(flats),
+                               min(k, 1 << 16)), []).append(i)
 
         entry_by_i = {e[0]: e for e in batchable}
         # phase 1: dispatch every group × segment program without blocking —
-        # jax dispatch is async, so device work and tunnel transfers overlap
+        # jax dispatch is async, so device work and tunnel transfers overlap.
+        # The batch axis is padded to a power-of-two bucket (dummy rows get
+        # min_score=+inf, matching nothing) so executables are reused across
+        # varying msearch batch sizes.
         pending = []
-        for (struct, k_fetch), idxs in groups.items():
+        for (struct, shape_sig, k_fetch), idxs in groups.items():
+            b_pad = pad_bucket(len(idxs), minimum=1)
+            pad_rows = b_pad - len(idxs)
+            min_scores = np.asarray(
+                [entry_by_i[i][5] for i in idxs]
+                + [np.inf] * pad_rows, dtype=np.float32)
             for seg_i, (seg, (arrays, meta)) in enumerate(
                     zip(self.reader.segments, self.reader.device)):
                 if seg.num_docs == 0:
                     continue
-                flats = [compiled[i][seg_i].flatten_inputs([]) for i in idxs]
-                batched = jax.tree_util.tree_map(
-                    jnp.asarray, pad_stack_trees(flats))
-                min_scores = jnp.asarray(np.asarray(
-                    [entry_by_i[i][5] for i in idxs], dtype=np.float32))
+                group_flats = [flats_by_i[i][seg_i] for i in idxs]
+                group_flats += [group_flats[0]] * pad_rows
+                stacked, treedef = stack_flat_inputs(group_flats)
+                stacked.append(min_scores)
+                buf, layout = pack_leaves(stacked)
                 k_seg = min(k_fetch, pad_bucket(max(seg.num_docs, 1)))
                 plan0 = compiled[idxs[0]][seg_i]
-                fn = _batched_runner(
-                    (plan_struct(plan0), _tree_shapes(batched)),
-                    plan0, meta, k_seg, len(idxs))
+                fn = _envelope_runner(plan_struct(plan0), plan0, meta,
+                                      k_seg, layout, treedef)
                 pending.append((idxs, seg_i, k_seg,
-                                fn(arrays, batched, min_scores)))
+                                fn(arrays, jnp.asarray(buf))))
 
         # phase 2: collect (vectorized — no per-candidate python objects);
-        # ONE device_get for every group×segment result = one transfer
-        # round trip for the whole msearch batch
+        # all group×segment outputs are concatenated ON DEVICE and fetched
+        # with ONE device_get = one transfer round trip for the whole
+        # msearch batch
         grouped = [i for idxs in groups.values() for i in idxs]
         per_query_segs: Dict[int, List[Tuple[int, np.ndarray, np.ndarray]]] = \
             {i: [] for i in grouped}
         per_query_total: Dict[int, int] = {i: 0 for i in grouped}
-        fetched = jax.device_get([packed for _, _, _, packed in pending])
+        if len(pending) > 1:
+            combined = np.asarray(jax.device_get(_concat_rows(
+                tuple(packed for _, _, _, packed in pending))))
+            fetched = []
+            row = 0
+            for _, _, k_seg, packed in pending:
+                rows = packed.shape[0]
+                fetched.append(combined[row:row + rows, :2 * k_seg + 1])
+                row += rows
+        else:
+            fetched = jax.device_get(
+                [packed for _, _, _, packed in pending])
         for (idxs, seg_i, k_seg, _), packed in zip(pending, fetched):
             scores_b, idx_b, total_b = unpack_batched_result(
                 np.asarray(packed), k_seg)

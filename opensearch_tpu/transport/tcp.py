@@ -149,6 +149,14 @@ class TcpTransport:
         self._blocking_actions: set = set()
         self._workers = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix=f"worker-{node_id}")
+        # cluster-admin actions that can legitimately block for tens of
+        # seconds (leader updates awaiting publication commit, recovery
+        # segment shipping) run on their own pool so they cannot starve
+        # the data plane — the reference's MANAGEMENT/RECOVERY threadpools
+        # vs WRITE/SEARCH (threadpool/ThreadPool.java:92)
+        self._mgmt_actions: set = set()
+        self._mgmt_workers = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix=f"mgmt-{node_id}")
         # frames are written from the event loop AND worker threads (blocking
         # handlers answer on the inbound socket): serialize per socket or
         # concurrent sendall()s interleave and corrupt the frame stream
@@ -180,11 +188,13 @@ class TcpTransport:
     # -------------------------------------------------------------- registry
 
     def register_handler(self, node_id: str, action: str, handler: Callable,
-                         blocking: bool = False):
+                         blocking: bool = False, pool: str = "worker"):
         assert node_id == self.node_id, "TcpTransport hosts one node"
         self.handlers[action] = handler
         if blocking:
             self._blocking_actions.add(action)
+            if pool == "management":
+                self._mgmt_actions.add(action)
 
     def register_node(self, node_id: str):  # interface parity with the mock
         pass
@@ -251,7 +261,10 @@ class TcpTransport:
                     if action != HANDSHAKE_ACTION:
                         return  # un-handshaken peer: drop the connection
                     handshaken = True
-                if action in self._blocking_actions:
+                if action in self._mgmt_actions:
+                    self._mgmt_workers.submit(self._handle_request, conn,
+                                              request_id, action, payload)
+                elif action in self._blocking_actions:
                     self._workers.submit(self._handle_request, conn,
                                          request_id, action, payload)
                 else:
@@ -436,3 +449,4 @@ class TcpTransport:
                 pass
         self._loop_queue.put(None)
         self._workers.shutdown(wait=False, cancel_futures=True)
+        self._mgmt_workers.shutdown(wait=False, cancel_futures=True)

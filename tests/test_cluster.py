@@ -300,3 +300,66 @@ class TestLeaderUpdateIsolation:
         out = node.request("POST", "/remap/_search", {
             "query": {"range": {"v": {"gte": 40}}}})
         assert out["hits"]["total"]["value"] == 1
+
+
+class TestAdaptiveReplicaSelection:
+    """Replica read balancing (ResponseCollectorService / OperationRouting
+    ARS analog): replicas serve reads, and a failed replica drops out of
+    rotation via the routing table."""
+
+    def test_replicas_serve_reads_and_failed_copy_drops_out(self, cluster):
+        node = next(iter(cluster.values()))
+        node.request("PUT", "/ars", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 1},
+            "mappings": {"properties": {"body": {"type": "text"}}}})
+        node.await_health("green", timeout=30)
+        for i in range(12):
+            node.request("PUT", f"/ars/_doc/{i}", {"body": f"spread {i}"})
+        node.request("POST", "/ars/_refresh")
+
+        entry = node._data()["routing"]["ars"][0]
+        primary, replicas = entry["primary"], entry["active_replicas"]
+        assert len(replicas) == 1
+        served = {nid: 0 for nid in cluster}
+        for nid, n in cluster.items():
+            orig = n._on_shard_query
+
+            def wrapped(sender, payload, _nid=nid, _orig=orig):
+                served[_nid] += 1
+                return _orig(sender, payload)
+            n._on_shard_query = wrapped
+            n.transport.handlers["indices:data/read/search[phase/query]"] = \
+                wrapped
+
+        searcher = cluster[next(nid for nid in cluster
+                                if nid not in (primary, *replicas))]
+        for _ in range(16):
+            out = searcher.request("POST", "/ars/_search", {
+                "query": {"match": {"body": "spread"}}, "size": 20})
+            assert out["hits"]["total"]["value"] == 12
+        assert served[primary] > 0, "primary never served"
+        assert served[replicas[0]] > 0, "replica never served (no ARS)"
+
+        # fail the replica out of the copy set: reads must keep succeeding
+        # and only route to copies the routing table currently lists as
+        # active (the allocator re-replicates the failed copy, so it may
+        # legitimately rejoin rotation once its re-recovery completes)
+        node._submit_to_leader({"kind": "shard_failed", "index": "ars",
+                                "shard": 0, "node": replicas[0]})
+        wait_for(lambda: replicas[0] not in
+                 node._data()["routing"]["ars"][0]["active_replicas"],
+                 msg="replica failed out")
+        for _ in range(8):
+            before = dict(served)
+            entry = searcher._data()["routing"]["ars"][0]
+            legal = {entry["primary"], *entry["active_replicas"]}
+            out = searcher.request("POST", "/ars/_search", {
+                "query": {"match": {"body": "spread"}}, "size": 20})
+            assert out["hits"]["total"]["value"] == 12
+            entry_after = searcher._data()["routing"]["ars"][0]
+            legal |= {entry_after["primary"],
+                      *entry_after["active_replicas"]}
+            served_by = {nid for nid in served
+                         if served[nid] > before[nid]}
+            assert served_by <= legal, \
+                f"query served by non-active copy {served_by - legal}"

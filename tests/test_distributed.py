@@ -219,3 +219,144 @@ def test_graft_entry_compiles():
     out = jax.jit(fn)(*args)
     keys = np.asarray(out[0])
     assert keys.shape == (10,)
+
+
+class TestSpmdServingPath:
+    """VERDICT round-3 next-step 2: the SPMD program must BE the serving
+    path — a REST _search against a multi-shard index executes the
+    shard_map program, with HBM residency across queries."""
+
+    @pytest.fixture(scope="class")
+    def node(self):
+        import json
+
+        from opensearch_tpu.node import Node
+        from opensearch_tpu.utils.demo import synth_docs
+
+        node = Node()
+        node.request("PUT", "/sp", {
+            "settings": {"number_of_shards": 4},
+            "mappings": {"properties": {
+                "body": {"type": "text"}, "tag": {"type": "keyword"},
+                "views": {"type": "integer"}, "ts": {"type": "date"}}}})
+        docs = synth_docs(400, vocab_size=300, avg_len=30, seed=5)
+        lines = []
+        for i, d in enumerate(docs):
+            lines.append(json.dumps({"index": {"_id": f"d{i}"}}))
+            lines.append(json.dumps(d))
+        node.handle("POST", "/sp/_bulk", body="\n".join(lines) + "\n")
+        node.request("POST", "/sp/_refresh")
+        return node
+
+    def test_rest_search_executes_spmd_program(self, node):
+        from opensearch_tpu.search import spmd
+
+        before = spmd.SPMD_QUERIES[0]
+        out = node.request("POST", "/sp/_search", {
+            "query": {"match": {"body": "w00011 w00042"}}, "size": 10})
+        assert spmd.SPMD_QUERIES[0] == before + 1
+        assert out["hits"]["total"]["value"] > 0
+
+    def test_residency_across_queries(self, node):
+        from opensearch_tpu.parallel.distributed import TRANSFER_BYTES
+        from opensearch_tpu.search import spmd
+
+        body = {"query": {"match": {"body": "w00007"}}, "size": 5}
+        node.request("POST", "/sp/_search", body)   # builds the shard set
+        uploads = spmd.SPMD_UPLOADS[0]
+        tb0 = TRANSFER_BYTES[0]
+        for _ in range(3):
+            node.request("POST", "/sp/_search", body)
+        assert spmd.SPMD_UPLOADS[0] == uploads, "shard set rebuilt per query"
+        per_query = (TRANSFER_BYTES[0] - tb0) / 3
+        assert per_query < 1 << 16, \
+            f"per-query transfer {per_query} B suggests segment re-upload"
+
+    def test_spmd_aggs_match_host_loop(self, node):
+        from opensearch_tpu.search import spmd
+
+        body = {"size": 0, "query": {"match_all": {}},
+                "aggs": {"tags": {"terms": {"field": "tag", "size": 20}},
+                         "v": {"avg": {"field": "views"}}}}
+        before = spmd.SPMD_QUERIES[0]
+        got = node.request("POST", "/sp/_search", body)
+        assert spmd.SPMD_QUERIES[0] == before + 1
+        # host loop ground truth: force fallback by monkeypatching
+        import opensearch_tpu.search.spmd as spmd_mod
+        orig = spmd_mod.eligible
+        try:
+            spmd_mod.eligible = lambda *a, **k: False
+            want = node.request("POST", "/sp/_search", body)
+        finally:
+            spmd_mod.eligible = orig
+        assert got["aggregations"] == want["aggregations"]
+        assert got["hits"]["total"] == want["hits"]["total"]
+
+    def test_spmd_hits_match_host_loop(self, node):
+        import opensearch_tpu.search.spmd as spmd_mod
+
+        body = {"query": {"bool": {
+            "must": [{"match": {"body": "w00005 w00013"}}],
+            "filter": [{"range": {"views": {"gte": 1000}}}]}},
+            "size": 20}
+        got = node.request("POST", "/sp/_search", body)
+        orig = spmd_mod.eligible
+        try:
+            spmd_mod.eligible = lambda *a, **k: False
+            want = node.request("POST", "/sp/_search", body)
+        finally:
+            spmd_mod.eligible = orig
+        assert got["hits"]["total"] == want["hits"]["total"]
+        assert [(h["_id"], round(h["_score"], 4))
+                for h in got["hits"]["hits"]] == \
+               [(h["_id"], round(h["_score"], 4))
+                for h in want["hits"]["hits"]]
+
+
+@pytest.mark.slow
+def test_spmd_parity_100k_docs(eight_devices):
+    """>=100K-doc cross-shard parity: SPMD merged page + totals + terms agg
+    must match the host-loop execution at realistic scale."""
+    import json
+
+    import opensearch_tpu.search.spmd as spmd_mod
+    from opensearch_tpu.node import Node
+    from opensearch_tpu.search import spmd
+    from opensearch_tpu.utils.demo import build_shards
+
+    mapper, segments = build_shards(100_000, n_shards=8, vocab_size=5000,
+                                    avg_len=40, seed=21)
+    node = Node()
+    node.request("PUT", "/big", {
+        "settings": {"number_of_shards": 8},
+        "mappings": {"properties": {
+            "body": {"type": "text"}, "tag": {"type": "keyword"},
+            "views": {"type": "integer"}, "ts": {"type": "date"}}}})
+    # install the pre-built segments directly into the index's shards
+    # (bulk-indexing 100K docs through REST would dominate the test's
+    # runtime without adding coverage)
+    svc = node.indices.get("big")
+    for shard, seg in zip(svc.shards, segments):
+        shard.engine.install_segments([seg], max_seq_no=seg.num_docs,
+                                      local_checkpoint=seg.num_docs)
+        shard._sync_reader()
+
+    queries = ["w00120 w00077", "w00400 w01999", "w00033"]
+    for q in queries:
+        body = {"query": {"match": {"body": q}}, "size": 25,
+                "aggs": {"tags": {"terms": {"field": "tag"}}}}
+        before = spmd.SPMD_QUERIES[0]
+        got = node.request("POST", "/big/_search", body)
+        assert spmd.SPMD_QUERIES[0] == before + 1, "SPMD path not taken"
+        orig = spmd_mod.eligible
+        try:
+            spmd_mod.eligible = lambda *a, **k: False
+            want = node.request("POST", "/big/_search", body)
+        finally:
+            spmd_mod.eligible = orig
+        assert got["hits"]["total"] == want["hits"]["total"], q
+        assert [(h["_id"], round(h["_score"], 4))
+                for h in got["hits"]["hits"]] == \
+               [(h["_id"], round(h["_score"], 4))
+                for h in want["hits"]["hits"]], q
+        assert got["aggregations"] == want["aggregations"], q

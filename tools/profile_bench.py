@@ -60,13 +60,14 @@ def main():
     log("msearch warm TOTAL", total,
         f"{len(bodies) / total:.0f} QPS")
 
-    # ---- dissect the warm path: host prep vs dispatch vs device vs fetch
+    # ---- dissect the warm path (mirrors multi_search's envelope path)
     from opensearch_tpu.search import dsl
     from opensearch_tpu.search.compile import Compiler
-    from opensearch_tpu.search.executor import (_batched_runner,
-                                                unpack_batched_result)
+    from opensearch_tpu.search.executor import (_envelope_runner,
+                                                pack_leaves,
+                                                stack_flat_inputs)
+    from opensearch_tpu.index.segment import pad_bucket
     from opensearch_tpu.parallel.distributed import (_tree_shapes,
-                                                     pad_stack_trees,
                                                      plan_struct)
 
     t0 = time.perf_counter()
@@ -81,33 +82,44 @@ def main():
         f"{len(bodies)} plans")
 
     t0 = time.perf_counter()
-    structs = {}
+    flats_all = [p.flatten_inputs([]) for p in compiled]
+    groups = {}
     for i, p in enumerate(compiled):
-        structs.setdefault(plan_struct(p), []).append(i)
-    log("host: group by struct", time.perf_counter() - t0,
-        f"{len(structs)} group(s)")
+        groups.setdefault((plan_struct(p), _tree_shapes(flats_all[i])),
+                          []).append(i)
+    log("host: flatten+group", time.perf_counter() - t0,
+        f"{len(groups)} group(s)")
 
     arrays, meta = executor.reader.device[0]
     group_stats = []
-    prep = disp = 0.0
+    t_stack = t_pack = t_upload = t_disp = 0.0
     pending = []
-    for struct, idxs in structs.items():
+    for (struct, shapes), idxs in groups.items():
+        b_pad = pad_bucket(len(idxs), minimum=1)
         t0 = time.perf_counter()
-        flats = [compiled[i].flatten_inputs([]) for i in idxs]
-        batched = jax.tree_util.tree_map(jnp.asarray, pad_stack_trees(flats))
-        min_scores = jnp.zeros(len(idxs), jnp.float32) - 1e38
-        prep += time.perf_counter() - t0
-        shapes = _tree_shapes(batched)
-        group_stats.append((len(idxs), shapes))
+        group_flats = [flats_all[i] for i in idxs]
+        group_flats += [group_flats[0]] * (b_pad - len(idxs))
+        stacked, treedef = stack_flat_inputs(group_flats)
+        stacked.append(np.full(b_pad, -1e38, np.float32))
+        t_stack += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        buf, layout = pack_leaves(stacked)
+        t_pack += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        dev_buf = jnp.asarray(buf)
+        t_upload += time.perf_counter() - t0
         plan0 = compiled[idxs[0]]
-        fn = _batched_runner((plan_struct(plan0), shapes), plan0, meta,
-                             10, len(idxs))
+        fn = _envelope_runner(plan_struct(plan0), plan0, meta, 10,
+                              layout, treedef)
         t0 = time.perf_counter()
-        out = fn(arrays, batched, min_scores)
-        disp += time.perf_counter() - t0
-        pending.append(out)
-    log("host: flatten+pad+upload inputs", prep)
-    log("host: dispatch (async calls)", disp)
+        pending.append(fn(arrays, dev_buf))
+        t_disp += time.perf_counter() - t0
+        group_stats.append((len(idxs), b_pad, buf.nbytes))
+    log("host: stack", t_stack)
+    log("host: pack envelope", t_pack)
+    log("host: upload (asarray calls)", t_upload,
+        f"{sum(g[2] for g in group_stats)} B")
+    log("host: dispatch (async calls)", t_disp)
     t0 = time.perf_counter()
     for out in pending:
         out.block_until_ready()
@@ -118,13 +130,13 @@ def main():
         f"{sum(np.asarray(f).nbytes for f in fetched)} B")
 
     d_pad = int(arrays["live"].shape[0])
-    b_total = sum(b for b, _ in group_stats)
+    b_total = sum(b for b, _, _ in group_stats)
     qb_max = 0
-    for _, shapes in group_stats:
-        for s in jax.tree_util.tree_leaves(shapes):
-            if isinstance(s, tuple) and len(s) == 2:
-                qb_max = max(qb_max, s[1])
-    print(f"\ngroups: {[(b,) for b, _ in group_stats]}  d_pad={d_pad} "
+    for (struct, shapes), _ in groups.items():
+        for _, shp, _dt in shapes:
+            if len(shp) == 1:
+                qb_max = max(qb_max, shp[0])
+    print(f"\ngroups (n, b_pad, bytes): {group_stats}  d_pad={d_pad} "
           f"qb_max={qb_max}")
 
     # ---- microbenchmarks at representative shapes
