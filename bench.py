@@ -127,6 +127,105 @@ def numpy_baseline(seg, queries, k1=1.2, b=0.75):
     return len(queries) / dt
 
 
+def _lat_stats(lat_ms):
+    lat_ms = sorted(lat_ms)
+    return (round(lat_ms[len(lat_ms) // 2], 2),
+            round(lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * 0.99))], 2))
+
+
+def bench_aggs(mode: str):
+    """BASELINE configs 2/3: bool+filter+terms-agg (nyc_taxis-style) and
+    date_histogram+cardinality (http_logs-style) QPS @ p99, vs a vectorized
+    numpy implementation of the same aggregations (the Lucene-CPU
+    stand-in)."""
+    import jax
+    import numpy as np
+
+    platform = jax.devices()[0].platform
+    executor, seg = build_index()
+    n_q = int(os.environ.get("BENCH_AGG_QUERIES", "64"))
+    rng = np.random.RandomState(13)
+    views = np.zeros(seg.num_docs, np.int64)
+    col = seg.numeric_dv["views"]
+    views[col.doc_ids] = col.values[np.arange(len(col.doc_ids))]
+    ts_col = seg.numeric_dv["ts"]
+    ts = np.zeros(seg.num_docs, np.int64)
+    ts[ts_col.doc_ids] = ts_col.values[np.arange(len(ts_col.doc_ids))]
+    tag_col = seg.ordinal_dv["tag"]
+    tag_ord = np.zeros(seg.num_docs, np.int32)
+    tag_ord[tag_col.doc_ids] = tag_col.ords
+    tags = tag_col.dictionary
+
+    if mode == "agg_terms":
+        # distinct bounds: duplicate bodies would be served from the shard
+        # request cache and inflate QPS vs the always-recomputing baseline
+        bounds = rng.permutation(9000)[:n_q]
+        bodies = [{"size": 0,
+                   "query": {"bool": {"filter": [
+                       {"range": {"views": {"gte": int(b)}}}]}},
+                   "aggs": {"by_tag": {"terms": {"field": "tag",
+                                                 "size": 20},
+                            "aggs": {"avg_v": {"avg": {"field": "views"}}}}}}
+                  for b in bounds]
+
+        def base_one(b):
+            mask = views >= b
+            counts = np.bincount(tag_ord[mask], minlength=len(tags))
+            sums = np.bincount(tag_ord[mask], weights=views[mask],
+                               minlength=len(tags))
+            order = np.argsort(-counts)[:20]
+            return counts[order], sums[order]
+        base_args = bounds
+    else:   # date_hist
+        day = 86400_000
+        # distinct spans for the same reason as agg_terms (cache honesty);
+        # sub-day offsets keep each query body unique
+        spans = 1 + 79 * rng.permutation(n_q) / max(n_q, 1)
+        bodies = [{"size": 0,
+                   "query": {"range": {"ts": {
+                       "lt": int(1700000000000 + s * day)}}},
+                   "aggs": {"per_day": {"date_histogram": {
+                       "field": "ts", "fixed_interval": "1d"}},
+                       "uniq": {"cardinality": {"field": "tag"}}}}
+                  for s in spans]
+
+        def base_one(s):
+            mask = ts < int(1700000000000 + s * day)
+            buckets = np.unique((ts[mask] // day), return_counts=True)
+            uniq = len(np.unique(tag_ord[mask]))
+            return buckets[1][:5], uniq
+        base_args = spans
+
+    for b in bodies[:4]:
+        executor.search(b)      # warm the shape buckets
+    from opensearch_tpu.indices.request_cache import REQUEST_CACHE
+    REQUEST_CACHE.clear()       # measure execution, not cache hits
+    lat = []
+    t0 = time.perf_counter()
+    for b in bodies:
+        s0 = time.perf_counter()
+        executor.search(b)
+        lat.append((time.perf_counter() - s0) * 1000)
+    qps = n_q / (time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    for a in base_args:
+        base_one(a)
+    base_qps = n_q / (time.perf_counter() - t0)
+
+    p50, p99 = _lat_stats(lat)
+    out = {
+        "metric": f"{mode}_qps_{N_DOCS // 1000}k_docs_{platform}",
+        "value": round(qps, 2),
+        "unit": "queries/s",
+        "vs_baseline": round(qps / base_qps, 3),
+        "p50_ms": p50, "p99_ms": p99,
+    }
+    if _BACKEND_DIAG:
+        out["backend_diag"] = "; ".join(_BACKEND_DIAG)
+    print(json.dumps(out))
+
+
 def bench_knn(mode: str):
     """BASELINE configs 4/5: exact (SIFT-shaped 128-d L2) and IVF ANN
     (GloVe-shaped cosine) k-NN QPS, with recall@10 vs host brute force."""
@@ -215,6 +314,9 @@ def main():
     mode = os.environ.get("BENCH_MODE", "bm25")
     if mode in ("knn_exact", "knn_ivf"):
         bench_knn(mode)
+        return
+    if mode in ("agg_terms", "date_hist"):
+        bench_aggs(mode)
         return
 
     platform = jax.devices()[0].platform

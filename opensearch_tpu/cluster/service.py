@@ -51,6 +51,12 @@ SHARD_REFRESH = "indices:admin/refresh[s]"
 START_RECOVERY = "internal:index/shard/recovery/start_recovery"
 LEADER_UPDATE = "internal:cluster/leader_update"
 REGISTER_ADDR = "internal:cluster/register_address"
+# cross-cluster search (reference: RemoteClusterService.java:80 +
+# TransportSearchAction.java:422 ccsRemoteReduce): the remote cluster's
+# coordinator runs its own scatter + partial collection and returns
+# candidates + agg partials; the local coordinator merges
+CCS_QUERY = "indices:data/read/search[ccs/query]"
+CCS_FETCH = "indices:data/read/search[ccs/fetch]"
 
 
 class NotLeaderError(OpenSearchTpuError):
@@ -91,6 +97,9 @@ class ClusterNode:
         self._ars: Dict[str, List[float]] = {}   # node -> [ewma_ms, outstanding]
         self._ars_lock = threading.Lock()
         self._ars_rr = 0
+        # remote clusters (RemoteClusterService): alias → transport node
+        # key of the remote seed; populated via cluster.remote.*.seeds
+        self._remotes: Dict[str, str] = {}
         self._latest_state: Optional[ClusterState] = None
         self._reconcile_scheduled = False
         self.coordinator: Optional[Coordinator] = None
@@ -259,6 +268,14 @@ class ClusterNode:
                 entry["active_replicas"] = [
                     n for n in entry["active_replicas"] if n != node]
                 data["routing"] = routing
+            elif kind == "remote_clusters":
+                merged = dict(data.get("remote_clusters") or {})
+                for alias, seed in update["remotes"].items():
+                    if seed is None:
+                        merged.pop(alias, None)
+                    else:
+                        merged[alias] = seed
+                data["remote_clusters"] = merged
             elif kind == "register_address":
                 data["addresses"] = {**data["addresses"],
                                      **{update["node"]: update["addr"]}}
@@ -340,6 +357,13 @@ class ClusterNode:
         for nid, addr in (data.get("addresses") or {}).items():
             if nid != self.node_id:
                 self.transport.add_address(nid, *addr)
+        # remote-cluster registry from state: every node can coordinate CCS
+        state_remotes = data.get("remote_clusters") or {}
+        for alias, seed in state_remotes.items():
+            host, port = seed.rsplit(":", 1)
+            self.register_remote(alias, host, int(port))
+        for alias in [a for a in self._remotes if a not in state_remotes]:
+            self.remove_remote(alias)
         # leader-side reroute on membership change (AllocationService.
         # reroute via NodeRemovalClusterStateTaskExecutor / join executor):
         # if the routing table no longer matches the live node set, publish
@@ -532,6 +556,8 @@ class ClusterNode:
             blocking=True, pool="management")
         reg(self.node_id, REGISTER_ADDR, self._on_register_address,
             blocking=True, pool="management")
+        reg(self.node_id, CCS_QUERY, self._on_ccs_query, blocking=True)
+        reg(self.node_id, CCS_FETCH, self._on_ccs_fetch, blocking=True)
 
     def _on_register_address(self, sender: str, payload: dict):
         """Learn a joining node's transport address; propagate to the
@@ -873,23 +899,11 @@ class ClusterNode:
                     self._ars[n][0] *= 0.95
         return best
 
-    def search(self, name: str, body: Optional[dict]) -> dict:
-        """Coordinator side of query-then-fetch over the transport."""
-        from opensearch_tpu.search.aggs.parse import parse_aggs
-        from opensearch_tpu.search.aggs.pipeline import apply_pipelines
-        from opensearch_tpu.search.aggs.reduce import reduce_aggs
-        from opensearch_tpu.search.controller import (
-            _compare_candidates, _parse_sort)
+    def _cluster_query_phase(self, name: str, body: dict, k: int):
+        """Scatter the query phase over one copy of every shard of a local
+        index; returns (candidates, agg partials, total hits, shard→node
+        map for the fetch phase, shard count)."""
         from opensearch_tpu.search.executor import _Candidate
-
-        body = body or {}
-        start = time.monotonic()
-        size = int(body.get("size", 10))
-        from_ = int(body.get("from", 0))
-        sort_specs = _parse_sort(body.get("sort"))
-        score_sorted = sort_specs[0][0] == "_score"
-        wants_score = score_sorted or bool(body.get("track_scores"))
-        k = max(from_ + size, 10)
 
         # scatter with routing re-resolution: a shard may move or finish
         # initializing between attempts (the ClusterStateObserver-style
@@ -988,16 +1002,13 @@ class ClusterNode:
                 raise (hard or errors)[0]
             time.sleep(0.1)
 
-        # coordinator reduce: global sort + page (SearchPhaseController)
-        all_candidates.sort(key=_compare_candidates(sort_specs))
-        page = all_candidates[from_:from_ + size]
-        max_score = None
-        if wants_score:
-            for c in all_candidates:
-                if max_score is None or c.score > max_score:
-                    max_score = c.score
+        return (all_candidates, all_partials, total, shard_nodes,
+                len(routing[name]))
 
-        # fetch phase: only shards owning page hits (FetchSearchPhase)
+    def _cluster_fetch(self, name: str, body: dict, page: List,
+                       shard_nodes: Dict[int, str]) -> Dict[Tuple, dict]:
+        """Fetch phase: render hit dicts for the winning docs from the
+        copies that served them. Returns (shard, seg, ord) → hit."""
         docs_by_shard: Dict[int, List] = {}
         for c in page:
             docs_by_shard.setdefault(c.shard_i, []).append(c)
@@ -1014,9 +1025,41 @@ class ClusterNode:
                                                 timeout=60.0)
             for c, hit in zip(cands, _unwrap(resp["hits"])):
                 hit_map[(c.shard_i, c.seg_i, c.ord)] = hit
+        return hit_map
+
+    def search(self, name: str, body: Optional[dict]) -> dict:
+        """Coordinator side of query-then-fetch over the transport."""
+        from opensearch_tpu.search.aggs.parse import parse_aggs
+        from opensearch_tpu.search.aggs.pipeline import apply_pipelines
+        from opensearch_tpu.search.aggs.reduce import reduce_aggs
+        from opensearch_tpu.search.controller import (
+            _compare_candidates, _parse_sort)
+
+        body = body or {}
+        start = time.monotonic()
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        sort_specs = _parse_sort(body.get("sort"))
+        score_sorted = sort_specs[0][0] == "_score"
+        wants_score = score_sorted or bool(body.get("track_scores"))
+        k = max(from_ + size, 10)
+
+        (all_candidates, all_partials, total, shard_nodes,
+         n_shards) = self._cluster_query_phase(name, body, k)
+
+        # coordinator reduce: global sort + page (SearchPhaseController)
+        all_candidates.sort(key=_compare_candidates(sort_specs))
+        page = all_candidates[from_:from_ + size]
+        max_score = None
+        if wants_score:
+            for c in all_candidates:
+                if max_score is None or c.score > max_score:
+                    max_score = c.score
+
+        # fetch phase: only shards owning page hits (FetchSearchPhase)
+        hit_map = self._cluster_fetch(name, body, page, shard_nodes)
         hits = [hit_map[(c.shard_i, c.seg_i, c.ord)] for c in page]
 
-        n_shards = len(routing[name])
         resp: dict = {
             "took": int((time.monotonic() - start) * 1000),
             "timed_out": False,
@@ -1024,6 +1067,214 @@ class ClusterNode:
                         "skipped": 0, "failed": 0},
             "hits": {"total": {"value": total, "relation": "eq"},
                      "max_score": max_score, "hits": hits},
+        }
+        agg_nodes = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        if agg_nodes:
+            aggregations = reduce_aggs(all_partials)
+            apply_pipelines(agg_nodes, aggregations)
+            resp["aggregations"] = aggregations
+        return resp
+
+    # ------------------------------------------------- cross-cluster search
+
+    def register_remote(self, alias: str, host: str, port: int):
+        """Register a remote cluster via one seed address (the sniff
+        strategy's seed list, SniffConnectionStrategy; one seed suffices
+        because the remote coordinator fans out internally)."""
+        key = f"remote:{alias}"
+        self.transport.add_address(key, host, port)
+        self._remotes[alias] = key
+
+    def remove_remote(self, alias: str):
+        self._remotes.pop(alias, None)
+
+    def _apply_remote_settings(self, settings: dict):
+        """cluster.remote.<alias>.seeds handling for _cluster/settings:
+        the registry is published THROUGH cluster state so every
+        coordinator (and any node applying the state later) registers the
+        remote, not just the node that served the PUT."""
+        remotes = {}
+        for k, v in list(settings.items()):
+            parts = k.split(".")
+            if len(parts) == 4 and parts[0] == "cluster" \
+                    and parts[1] == "remote" and parts[3] == "seeds":
+                alias = parts[2]
+                if not v:
+                    remotes[alias] = None
+                else:
+                    remotes[alias] = v[0] if isinstance(v, list) else v
+        if remotes:
+            self._submit_to_leader({"kind": "remote_clusters",
+                                    "remotes": remotes})
+        return bool(remotes)
+
+    def _on_ccs_query(self, sender: str, payload: dict):
+        """Remote-cluster side of CCS: run this cluster's own scatter and
+        return candidates + agg partials + the shard→node map the fetch
+        call must echo back (the remote reduce half of ccsRemoteReduce)."""
+        cands, partials, total, shard_nodes, n_shards = \
+            self._cluster_query_phase(payload["index"], payload["body"],
+                                      payload["k"])
+        return {"candidates": Opaque(
+                    [(c.score, c.seg_i, c.ord, c.sort_values, c.shard_i)
+                     for c in cands]),
+                "partials": Opaque(partials),
+                "total": total,
+                "shard_nodes": {str(k): v for k, v in shard_nodes.items()},
+                "n_shards": n_shards}
+
+    def _on_ccs_fetch(self, sender: str, payload: dict):
+        from opensearch_tpu.search.executor import _Candidate
+        cands = [_Candidate(s, g, o, sv, shard_i=si)
+                 for s, g, o, sv, si in _unwrap(payload["docs"])]
+        shard_nodes = {int(k): v
+                       for k, v in payload["shard_nodes"].items()}
+        hit_map = self._cluster_fetch(payload["index"], payload["body"],
+                                      cands, shard_nodes)
+        return {"hits": Opaque(
+            [hit_map[(c.shard_i, c.seg_i, c.ord)] for c in cands])}
+
+    def search_ccs(self, expression: str, body: Optional[dict]) -> dict:
+        """Cross-cluster + multi-index search: `remote:idx,local_idx`.
+
+        Per-cluster query phases run concurrently (each remote coordinator
+        reduces its own shards first — the ccsMinimizeRoundtrips shape of
+        TransportSearchAction.java:422), then the local coordinator merges
+        candidates and aggregation partials (SearchResponseMerger.java:88)
+        and fetches page hits from their owning clusters."""
+        from opensearch_tpu.search.aggs.parse import parse_aggs
+        from opensearch_tpu.search.aggs.pipeline import apply_pipelines
+        from opensearch_tpu.search.aggs.reduce import reduce_aggs
+        from opensearch_tpu.search.controller import (
+            _compare_candidates, _parse_sort)
+        from opensearch_tpu.search.executor import _Candidate
+
+        body = body or {}
+        start = time.monotonic()
+        sort_specs = _parse_sort(body.get("sort"))
+        if list(sort_specs) != [("_score", "desc")]:
+            raise IllegalArgumentError(
+                "cross-cluster search supports _score sorting only")
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        k = max(from_ + size, 10)
+
+        targets: List[Tuple[Optional[str], str]] = []   # (alias|None, idx)
+        for part in expression.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if ":" in part:
+                alias, idx = part.split(":", 1)
+                if alias not in self._remotes:
+                    raise IllegalArgumentError(
+                        f"no such remote cluster [{alias}]")
+                targets.append((alias, idx))
+            else:
+                targets.append((None, part))
+
+        # per-cluster query phases (parallel); candidates are tagged with
+        # their target index so the fetch + rendering know the origin
+        results: Dict[int, dict] = {}
+        errors: List[Exception] = []
+        lock = threading.Lock()
+
+        def query_target(ti: int, alias: Optional[str], idx: str):
+            try:
+                if alias is None:
+                    cands, partials, total, shard_nodes, n_shards = \
+                        self._cluster_query_phase(idx, body, k)
+                    out = {"cands": cands, "partials": partials,
+                           "total": total, "shard_nodes": shard_nodes,
+                           "n_shards": n_shards}
+                else:
+                    resp = self.transport.send_sync(
+                        self._remotes[alias], CCS_QUERY,
+                        {"index": idx, "body": body, "k": k},
+                        timeout=60.0)
+                    cands = [_Candidate(s, g, o, sv, shard_i=si)
+                             for s, g, o, sv, si in
+                             _unwrap(resp["candidates"])]
+                    out = {"cands": cands,
+                           "partials": _unwrap(resp["partials"]),
+                           "total": resp["total"],
+                           "shard_nodes": resp["shard_nodes"],
+                           "n_shards": resp["n_shards"]}
+                with lock:
+                    results[ti] = out
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=query_target, args=(ti, a, i),
+                                    daemon=True)
+                   for ti, (a, i) in enumerate(targets)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(65.0)
+        if errors:
+            raise errors[0]
+        if len(results) < len(targets):
+            missing = [f"{a or '_local'}:{i}" for ti, (a, i)
+                       in enumerate(targets) if ti not in results]
+            raise OpenSearchTpuError(
+                f"cross-cluster query phase timed out for {missing}")
+
+        # merge: score desc, tie-break by target order then shard/seg/doc
+        merged: List[Tuple] = []
+        total = 0
+        n_shards = 0
+        all_partials: List = []
+        for ti in range(len(targets)):
+            out = results[ti]
+            total += out["total"]
+            n_shards += out["n_shards"]
+            all_partials.extend(out["partials"])
+            for c in out["cands"]:
+                merged.append((ti, c))
+        merged.sort(key=lambda tc: (-tc[1].score, tc[0], tc[1].shard_i,
+                                    tc[1].seg_i, tc[1].ord))
+        page = merged[from_:from_ + size]
+        max_score = max((c.score for _, c in merged), default=None)
+
+        # fetch per target cluster
+        hits_by_pos: Dict[int, dict] = {}
+        page_by_target: Dict[int, List[Tuple[int, Any]]] = {}
+        for pos, (ti, c) in enumerate(page):
+            page_by_target.setdefault(ti, []).append((pos, c))
+        for ti, entries in page_by_target.items():
+            alias, idx = targets[ti]
+            cands = [c for _, c in entries]
+            if alias is None:
+                hit_map = self._cluster_fetch(
+                    idx, body, cands, results[ti]["shard_nodes"])
+                hits = [hit_map[(c.shard_i, c.seg_i, c.ord)]
+                        for c in cands]
+            else:
+                resp = self.transport.send_sync(
+                    self._remotes[alias], CCS_FETCH,
+                    {"index": idx, "body": body,
+                     "docs": Opaque([(c.score, c.seg_i, c.ord,
+                                      c.sort_values, c.shard_i)
+                                     for c in cands]),
+                     "shard_nodes": results[ti]["shard_nodes"]},
+                    timeout=60.0)
+                hits = _unwrap(resp["hits"])
+                for h in hits:
+                    h["_index"] = f"{alias}:{h['_index']}"
+            for (pos, _), hit in zip(entries, hits):
+                hits_by_pos[pos] = hit
+
+        resp = {
+            "took": int((time.monotonic() - start) * 1000),
+            "timed_out": False,
+            "_shards": {"total": n_shards, "successful": n_shards,
+                        "skipped": 0, "failed": 0},
+            "_clusters": {"total": len(targets),
+                          "successful": len(targets), "skipped": 0},
+            "hits": {"total": {"value": total, "relation": "eq"},
+                     "max_score": max_score,
+                     "hits": [hits_by_pos[p] for p in sorted(hits_by_pos)]},
         }
         agg_nodes = parse_aggs(body.get("aggs") or body.get("aggregations"))
         if agg_nodes:
@@ -1086,6 +1337,14 @@ class ClusterNode:
                 return self.cluster_health(), 200
             if len(parts) >= 2 and parts[1] == "state":
                 return self.cluster_state_api(), 200
+            if len(parts) >= 2 and parts[1] == "settings" \
+                    and method == "PUT" and isinstance(body, dict):
+                # intercept cluster.remote.*.seeds, then fall through so
+                # the local settings registry records the values too
+                flat = {}
+                for scope in ("persistent", "transient"):
+                    flat.update(body.get(scope) or {})
+                self._apply_remote_settings(flat)
             return None
         if parts[0] == "_cat" and len(parts) > 1 and parts[1] == "shards":
             return self._cat_shards(), 200
@@ -1127,6 +1386,8 @@ class ClusterNode:
         if sub == "_bulk" and method == "POST":
             return self._rest_bulk(name, raw), 200
         if sub == "_search" and method in ("GET", "POST"):
+            if "," in name or ":" in name:
+                return self.search_ccs(name, body), 200
             return self.search(name, body), 200
         if sub == "_refresh" and method in ("POST", "GET"):
             return self.refresh_index(name), 200

@@ -19,6 +19,7 @@ from opensearch_tpu.cluster.routing import generate_shard_id
 from opensearch_tpu.common.errors import (
     DocumentMissingError, IllegalArgumentError, OpenSearchTpuError,
     VersionConflictError)
+from opensearch_tpu.analysis import AnalysisRegistry
 from opensearch_tpu.index.mapper import MapperService
 from opensearch_tpu.index.shard import IndexShard
 
@@ -75,7 +76,19 @@ class IndexService:
                 f"routing_partition_size [{self.routing_partition_size}] "
                 f"should be a positive number less than number_of_shards "
                 f"[{self.num_shards}]")
-        self.mapper = MapperService(mapping)
+        # un-flatten index.analysis.* settings back into the nested config
+        # AnalysisRegistry consumes (custom analyzers/tokenizers/filters,
+        # incl. plugin-registered ones — AnalysisModule analog)
+        analysis_cfg: dict = {}
+        for k, v in settings.items():
+            if k.startswith("analysis."):
+                parts = k.split(".")[1:]
+                d = analysis_cfg
+                for p in parts[:-1]:
+                    d = d.setdefault(p, {})
+                d[parts[-1]] = v
+        registry = AnalysisRegistry(analysis_cfg) if analysis_cfg else None
+        self.mapper = MapperService(mapping, analysis_registry=registry)
         durability = settings.get("translog.durability", "request")
         self.shards: List[IndexShard] = [
             IndexShard(i, self.mapper, index_name=index_name,

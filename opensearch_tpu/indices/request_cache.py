@@ -1,0 +1,89 @@
+"""Shard request cache: memoize shard-level query-phase results.
+
+Re-design of the reference's IndicesRequestCache (indices/
+IndicesRequestCache.java:82): the reference caches the serialized shard
+query result keyed by (reader identity, request bytes) and serves repeated
+size=0/aggregation requests without re-executing; entries die with the
+reader (refresh/merge). Here the key is (segment uids + live doc counts,
+canonical request JSON, k) — segment uids are process-unique and the live
+count changes on delete, so a refresh or delete naturally misses and old
+entries age out of the LRU instead of needing explicit invalidation hooks.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Optional, Tuple
+
+
+class RequestCache:
+    def __init__(self, max_entries: int = 256):
+        self.max_entries = max_entries
+        self._store: "OrderedDict[Any, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    _MISS = object()
+
+    def get(self, key):
+        """Cached value or RequestCache._MISS; counts a hit on success."""
+        with self._lock:
+            if key in self._store:
+                self.hits += 1
+                self._store.move_to_end(key)
+                return self._store[key]
+        return self._MISS
+
+    def put(self, key, value):
+        with self._lock:
+            self.misses += 1
+            self._store[key] = value
+            self._store.move_to_end(key)
+            while len(self._store) > self.max_entries:
+                self._store.popitem(last=False)
+
+    def get_or_compute(self, key, compute: Callable[[], Any]):
+        value = self.get(key)
+        if value is not self._MISS:
+            return value
+        value = compute()
+        self.put(key, value)
+        return value
+
+    def clear(self):
+        with self._lock:
+            self._store.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hit_count": self.hits, "miss_count": self.misses,
+                    "entries": len(self._store)}
+
+
+# node-wide shared cache (the reference's is also a single node-level
+# cache shared by all shards, indices/IndicesRequestCache.java:82)
+REQUEST_CACHE = RequestCache()
+
+
+def cache_key(segments, body: dict, k: int,
+              extra_filter: Optional[dict]) -> Optional[Tuple]:
+    """None = not cacheable (unserializable body)."""
+    try:
+        req = json.dumps(body, sort_keys=True, separators=(",", ":"))
+        extra = json.dumps(extra_filter, sort_keys=True) \
+            if extra_filter is not None else None
+    except (TypeError, ValueError):
+        return None
+    return (tuple((s.uid, s.live_doc_count) for s in segments), req, k,
+            extra)
+
+
+def cacheable(body: dict) -> bool:
+    """Default policy mirrors the reference: only size=0 requests (aggs,
+    counts) are cached; profile runs always execute."""
+    return (body.get("size", 10) == 0
+            and not body.get("profile")
+            and body.get("search_after") is None)

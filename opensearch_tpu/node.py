@@ -24,7 +24,16 @@ class Node:
     def __init__(self, node_name: str = "node-0",
                  cluster_name: str = "opensearch-tpu",
                  data_path: Optional[str] = None,
-                 settings: Optional[dict] = None):
+                 settings: Optional[dict] = None,
+                 plugins: Optional[list] = None):
+        # plugins install before any service construction so their
+        # registry contributions (analyzers, queries, processors,
+        # repository types) are visible to everything built below
+        # (reference: PluginsService is constructed first, Node.java:432)
+        if plugins:
+            from opensearch_tpu.plugins import install_plugin
+            for plugin in plugins:
+                install_plugin(plugin)
         self.node_name = node_name
         self.node_id = secrets.token_urlsafe(16)
         self.cluster_name = cluster_name

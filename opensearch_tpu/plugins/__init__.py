@@ -1,0 +1,107 @@
+"""Plugin SPI: third-party extension points consumed by the core
+registries.
+
+Re-design of the reference's plugin architecture (server/src/main/java/org/
+opensearch/plugins/ — 18 SPI interfaces such as AnalysisPlugin,
+SearchPlugin, IngestPlugin, RepositoryPlugin; EnginePlugin.java:61 is the
+north-star hook). The JVM reference discovers plugins from jars via
+classloaders (PluginsService); here a plugin is a Python object passed to
+`install_plugin` (or `Node(plugins=[...])`), and installation pushes its
+contributions into the same module-level registries the built-ins live in
+— an example plugin adds a tokenizer and a query type without touching
+core (tests/test_plugins.py).
+
+Extension points covered (reference SPI in parentheses):
+  - tokenizers / token filters / char filters   (AnalysisPlugin)
+  - query types: a parser producing a QueryNode, optionally with a
+    compiler for new node classes                (SearchPlugin#getQueries)
+  - ingest processors                            (IngestPlugin)
+  - snapshot repository types                    (RepositoryPlugin)
+  - wire-safe classes for Opaque transport       (NamedWriteable registry)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+INSTALLED: List["Plugin"] = []
+
+
+class Plugin:
+    """Subclass and override the getters for the extension points you
+    provide; every getter defaults to 'nothing'."""
+
+    name: str = "unnamed"
+
+    # ---- AnalysisPlugin
+    def get_tokenizers(self) -> Dict[str, Callable]:
+        """name -> tokenizer(text, **params) -> List[Token]"""
+        return {}
+
+    def get_token_filters(self) -> Dict[str, Callable]:
+        """name -> filter(tokens, **params) -> List[Token]"""
+        return {}
+
+    def get_char_filters(self) -> Dict[str, Callable]:
+        return {}
+
+    # ---- SearchPlugin
+    def get_queries(self) -> Dict[str, Callable]:
+        """query name -> parser(body) -> QueryNode. The node may be a
+        composition of existing DSL nodes (a rewrite macro — the common
+        case, like the reference's QueryBuilder#rewrite), or a new node
+        class registered via get_query_compilers."""
+        return {}
+
+    def get_query_compilers(self) -> Dict[type, Callable]:
+        """QueryNode class -> fn(compiler, node, seg, meta) -> Plan"""
+        return {}
+
+    # ---- IngestPlugin
+    def get_processors(self) -> Dict[str, Callable]:
+        """processor type -> factory(config) -> processor"""
+        return {}
+
+    # ---- RepositoryPlugin
+    def get_repositories(self) -> Dict[str, Callable]:
+        """repository type -> factory(name, settings) -> repository"""
+        return {}
+
+    # ---- wire registry (NamedWriteableRegistry analog)
+    def get_wire_classes(self) -> Tuple[type, ...]:
+        return ()
+
+
+def install_plugin(plugin: Plugin) -> Plugin:
+    """Push a plugin's contributions into the live registries.
+
+    Installation is process-global (the registries are module-level, like
+    the reference's node-wide modules) and idempotent by plugin name — a
+    second Node passing the same plugin does not double-register."""
+    for existing in INSTALLED:
+        if existing.name == plugin.name:
+            return existing
+    from opensearch_tpu.analysis import registry as analysis_registry
+    from opensearch_tpu.ingest import service as ingest_service
+    from opensearch_tpu.repositories import blobstore
+    from opensearch_tpu.search import compile as compile_mod
+    from opensearch_tpu.search import dsl
+    from opensearch_tpu.transport import serde
+
+    analysis_registry.TOKENIZERS.update(plugin.get_tokenizers())
+    analysis_registry.TOKEN_FILTERS.update(plugin.get_token_filters())
+    analysis_registry.CHAR_FILTERS.update(plugin.get_char_filters())
+    dsl.PLUGIN_QUERIES.update(plugin.get_queries())
+    compile_mod.PLUGIN_COMPILERS.update(plugin.get_query_compilers())
+    ingest_service.PROCESSOR_TYPES.update(plugin.get_processors())
+    blobstore.REPOSITORY_TYPES.update(plugin.get_repositories())
+    wire = plugin.get_wire_classes()
+    if wire:
+        serde.allow_opaque(*wire)
+    INSTALLED.append(plugin)
+    return plugin
+
+
+def installed_info() -> List[dict]:
+    return [{"name": p.name, "component": type(p).__name__}
+            for p in INSTALLED]

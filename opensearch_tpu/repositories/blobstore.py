@@ -300,6 +300,12 @@ class FsRepository:
                 "state": manifest["state"], "shards": shards_stats}
 
 
+# plugin repository types (RepositoryPlugin SPI — the reference's
+# repository-{s3,azure,gcs,hdfs} plugins register here):
+# type -> factory(name, settings) -> repository
+REPOSITORY_TYPES: Dict[str, "object"] = {}
+
+
 class RepositoriesService:
     """Registry of named repositories (repositories/RepositoriesService.java).
 
@@ -319,13 +325,22 @@ class RepositoriesService:
         return any(resolved == root or resolved.startswith(root + os.sep)
                    for root in self.path_repo)
 
-    def put_repository(self, name: str, body: dict) -> FsRepository:
+    def put_repository(self, name: str, body: dict):
         repo_type = (body or {}).get("type")
+        settings = body.get("settings") or {}
         if repo_type != "fs":
-            raise IllegalArgumentError(
-                f"repository type [{repo_type}] does not exist "
-                f"(supported: [fs])")
-        location = (body.get("settings") or {}).get("location")
+            factory = REPOSITORY_TYPES.get(repo_type)
+            if factory is None:
+                supported = sorted(["fs", *REPOSITORY_TYPES])
+                raise IllegalArgumentError(
+                    f"repository type [{repo_type}] does not exist "
+                    f"(supported: {supported})")
+            # plugin repository types (RepositoryPlugin SPI): the factory
+            # owns its own settings validation
+            repo = factory(name, settings)
+            self.repositories[name] = repo
+            return repo
+        location = settings.get("location")
         if not location:
             raise IllegalArgumentError(
                 "[fs] missing location setting")

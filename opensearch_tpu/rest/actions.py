@@ -51,11 +51,26 @@ def _search_targets(node, index_expr: Optional[str]):
 
 def _write_index(node, name: str) -> str:
     """Write-target resolution incl. data streams (stream → newest backing
-    index, reference: IndexAbstraction.DataStream.getWriteIndex)."""
+    index, reference: IndexAbstraction.DataStream.getWriteIndex) and
+    auto-creation of missing indices on document writes
+    (action.auto_create_index, default true — AutoCreateIndex.java)."""
     ds = node.data_streams.resolve_write_index(name)
     if ds is not None:
         return ds
-    return node.indices.write_index(name)
+    from opensearch_tpu.common.errors import IndexNotFoundError
+    try:
+        return node.indices.write_index(name)
+    except IndexNotFoundError:
+        if str(node.settings.get("action.auto_create_index",
+                                 True)).lower() == "false":
+            raise
+        from opensearch_tpu.common.errors import ResourceAlreadyExistsError
+        try:
+            node.indices.create_index(name, {})
+        except ResourceAlreadyExistsError:
+            pass    # concurrent writer won the auto-create race
+        node.persist_metadata()
+        return name
 
 
 def _expand_data_streams(node, index_expr: Optional[str]) -> Optional[str]:
@@ -332,6 +347,18 @@ def register_search_actions(node, c):
         continue_scroll, create_pit, delete_pits, delete_scrolls,
         search_with_pit, start_scroll)
 
+    def _total_as_int(resp):
+        """rest_total_hits_as_int=true renders hits.total as the bare
+        number (the pre-7.x shape the YAML suites request)."""
+        if isinstance(resp, dict):
+            hits = resp.get("hits")
+            if isinstance(hits, dict) and isinstance(hits.get("total"),
+                                                     dict):
+                hits["total"] = hits["total"].get("value", 0)
+            for sub in resp.get("responses", []):
+                _total_as_int(sub)
+        return resp
+
     def do_search(req):
         body = req.body if isinstance(req.body, dict) else {}
         body = dict(body)
@@ -350,20 +377,76 @@ def register_search_actions(node, c):
             body["_source"] = (v.split(",") if "," in v
                                else (v if v not in ("true", "false")
                                      else v == "true"))
+        includes = req.param("_source_includes")
+        excludes = req.param("_source_excludes")
+        if includes or excludes:
+            body["_source"] = {
+                **({"includes": includes.split(",")} if includes else {}),
+                **({"excludes": excludes.split(",")} if excludes else {})}
+        as_int = req.param("rest_total_hits_as_int") == "true"
         if req.param("scroll"):
-            return start_scroll(node, req.param("index"), body,
-                                req.param("scroll"))
-        if isinstance(body.get("pit"), dict):
-            return search_with_pit(node, body)
-        return _run_search(node, req.param("index"), body)
+            if int(body.get("size", 10)) == 0:
+                raise IllegalArgumentError(
+                    "[size] cannot be [0] in a scroll context")
+            if req.param("request_cache"):
+                raise IllegalArgumentError(
+                    "[request_cache] cannot be used in a scroll context")
+            out = start_scroll(node, req.param("index"), body,
+                               req.param("scroll"))
+        elif isinstance(body.get("pit"), dict):
+            out = search_with_pit(node, body)
+        else:
+            out = _run_search(node, req.param("index"), body)
+        return _total_as_int(out) if as_int else out
+
+    def do_explain(req):
+        """_explain/{id}: score explanation for one document (reference:
+        action/explain/TransportExplainAction — a single-shard query
+        constrained to the doc)."""
+        expr = req.param("index")
+        doc_id = req.param("id")
+        body = req.body or {}
+        if req.param("q") is not None:
+            query = {"query_string": {"query": req.param("q")}}
+        else:
+            if "query" not in body:
+                raise IllegalArgumentError(
+                    "[explain] request body must contain [query]")
+            query = body["query"]
+        names = node.indices.resolve(expr, allow_aliases=True)
+        if not names:
+            from opensearch_tpu.common.errors import IndexNotFoundError
+            raise IndexNotFoundError(expr)
+        if len(names) > 1:
+            # the reference rejects multi-index _explain up front
+            raise IllegalArgumentError(
+                f"Alias [{expr}] has more than one indices associated "
+                f"with it [{sorted(names)}], can't execute a single index "
+                f"op")
+        index = names[0]
+        out = _run_search(node, expr, {
+            "query": {"bool": {"must": [query],
+                               "filter": [{"ids": {"values": [doc_id]}}]}},
+            "size": 1, "explain": True})
+        hits = out["hits"]["hits"]
+        if hits:
+            return {"_index": index, "_id": doc_id, "matched": True,
+                    "explanation": hits[0].get("_explanation")}
+        exists = node.indices.get(index).shard_for(doc_id).get_doc(doc_id)
+        if exists is None:
+            return 404, {"_index": index, "_id": doc_id, "matched": False}
+        return {"_index": index, "_id": doc_id, "matched": False}
 
     def do_scroll(req):
         body = req.body or {}
         scroll_id = body.get("scroll_id", req.param("scroll_id"))
         if not scroll_id:
             raise IllegalArgumentError("scroll_id is missing")
-        return continue_scroll(node, scroll_id, body.get("scroll",
-                                                         req.param("scroll")))
+        out = continue_scroll(node, scroll_id, body.get("scroll",
+                                                        req.param("scroll")))
+        if req.param("rest_total_hits_as_int") == "true":
+            out = _total_as_int(out)
+        return out
 
     def do_delete_scroll(req):
         body = req.body or {}
@@ -459,6 +542,8 @@ def register_search_actions(node, c):
     c.register("POST", "/_msearch", do_msearch)
     c.register("GET", "/{index}/_msearch", do_msearch)
     c.register("POST", "/{index}/_msearch", do_msearch)
+    c.register("GET", "/{index}/_explain/{id}", do_explain)
+    c.register("POST", "/{index}/_explain/{id}", do_explain)
     c.register("GET", "/_search/scroll", do_scroll)
     c.register("POST", "/_search/scroll", do_scroll)
     c.register("POST", "/_search/scroll/{scroll_id}", do_scroll)
@@ -481,7 +566,30 @@ def register_indices_actions(node, c):
                 "index": name}
 
     def do_delete_index(req):
-        node.indices.delete_index(req.param("index"))
+        expr = req.param("index")
+        ignore_unavailable = req.param("ignore_unavailable") == "true"
+        # aliases may not be deleted via DELETE /{index}
+        # (IndexNameExpressionResolver forbids write ops on aliases);
+        # exclusions and wildcards delegate to the shared resolver
+        parts = [p.strip() for p in expr.split(",") if p.strip()]
+        filtered = []
+        for i, part in enumerate(parts):
+            concrete = part[1:] if part.startswith("-") and i > 0 else part
+            if concrete in node.indices.aliases:
+                if ignore_unavailable:
+                    continue
+                raise IllegalArgumentError(
+                    f"The provided expression [{concrete}] matches an "
+                    f"alias, specify the corresponding concrete indices "
+                    f"instead.")
+            filtered.append(part)
+        if not filtered:
+            return {"acknowledged": True}
+        names = node.indices.resolve(
+            ",".join(filtered), allow_aliases=False,
+            ignore_unavailable=ignore_unavailable)
+        for n in dict.fromkeys(names):
+            node.indices.delete_index(n)
         node.persist_metadata()
         return {"acknowledged": True}
 
@@ -529,7 +637,18 @@ def register_indices_actions(node, c):
 
     def do_get_settings(req):
         names = node.indices.resolve(req.param("index"))
-        return {n: {"settings": index_info(n)["settings"]} for n in names}
+        out = {n: {"settings": index_info(n)["settings"]} for n in names}
+        name_filter = req.param("name")
+        if name_filter and name_filter not in ("_all", "*"):
+            import fnmatch as _fn
+            patterns = [p[len("index."):] if p.startswith("index.") else p
+                        for p in name_filter.split(",")]
+            out = {n: {"settings": {"index": {
+                k: v for k, v in e["settings"]["index"].items()
+                if any(_fn.fnmatchcase(f"index.{k}", f"index.{p}")
+                       or _fn.fnmatchcase(k, p) for p in patterns)}}}
+                for n, e in out.items()}
+        return out
 
     def do_put_settings(req):
         from opensearch_tpu.indices.service import _normalize_settings
@@ -617,7 +736,9 @@ def register_indices_actions(node, c):
     c.register("PUT", "/{index}/_mapping", do_put_mapping)
     c.register("POST", "/{index}/_mapping", do_put_mapping)
     c.register("GET", "/_settings", do_get_settings)
+    c.register("GET", "/_settings/{name}", do_get_settings)
     c.register("GET", "/{index}/_settings", do_get_settings)
+    c.register("GET", "/{index}/_settings/{name}", do_get_settings)
     c.register("PUT", "/{index}/_settings", do_put_settings)
     c.register("PUT", "/_settings", do_put_settings)
     c.register("POST", "/_refresh", do_refresh)
@@ -667,24 +788,36 @@ def register_alias_template_actions(node, c):
 
     def do_get_alias(req):
         name_filter = req.param("name")
+        if name_filter in ("_all", "*"):
+            name_filter = None
         index_filter = req.param("index")
         names = node.indices.resolve(index_filter, allow_aliases=True) \
             if index_filter else list(node.indices.indices)
         out: Dict[str, dict] = {}
         import fnmatch as _fn
+        requested = name_filter.split(",") if name_filter else []
+        found_patterns: set = set()
         for n in names:
             aliases = {}
             for alias, meta in node.indices.alias_metadata(n).items():
-                if name_filter and not any(
-                        _fn.fnmatchcase(alias, p)
-                        for p in name_filter.split(",")):
-                    continue
+                if requested:
+                    hit = [p for p in requested
+                           if _fn.fnmatchcase(alias, p)]
+                    if not hit:
+                        continue
+                    found_patterns.update(hit)
                 aliases[alias] = meta.to_dict()
-            if aliases or not name_filter:
+            if aliases or not requested:
                 out[n] = {"aliases": aliases}
-        if name_filter and not any(v["aliases"] for v in out.values()):
-            return 404, {"error": f"alias [{name_filter}] missing",
-                         "status": 404}
+        # concrete requested names with no match → 404, but the body still
+        # carries whatever WAS found (reference GetAliasesResponse shape)
+        missing = sorted(p for p in requested
+                         if p not in found_patterns and "*" not in p)
+        if requested and missing:
+            label = (f"alias [{missing[0]}]" if len(missing) == 1
+                     else "aliases [" + ",".join(missing) + "]")
+            return 404, {"error": f"{label} missing",
+                         "status": 404, **out}
         return out
 
     def do_alias_exists(req):
@@ -854,6 +987,7 @@ def register_cluster_actions(node, c):
         }
 
     def do_nodes_stats(req):
+        from opensearch_tpu.indices.request_cache import REQUEST_CACHE
         idx_stats = {n: svc.stats()
                      for n, svc in node.indices.indices.items()}
         import resource
@@ -870,6 +1004,7 @@ def register_cluster_actions(node, c):
                                             for s in idx_stats.values())},
                     "segments": {"count": sum(s["segments"]["count"]
                                               for s in idx_stats.values())},
+                    "request_cache": REQUEST_CACHE.stats(),
                 },
                 "breakers": node.breaker_service.stats(),
                 "indexing_pressure": node.indexing_pressure.stats(),
@@ -994,11 +1129,19 @@ def register_cat_actions(node, c):
     def cat_root(req):
         paths = ["/_cat/indices", "/_cat/health", "/_cat/count",
                  "/_cat/shards", "/_cat/aliases", "/_cat/templates",
-                 "/_cat/nodes"]
+                 "/_cat/nodes", "/_cat/plugins"]
         return RestResponse(200, "=^.^=\n" + "\n".join(paths) + "\n",
                             content_type="text/plain")
 
+    def cat_plugins(req):
+        from opensearch_tpu.plugins import installed_info
+        lines = [f"{node.node_name} {p['name']} {p['component']}"
+                 for p in installed_info()]
+        return RestResponse(200, "\n".join(lines) + ("\n" if lines else ""),
+                            content_type="text/plain")
+
     c.register("GET", "/_cat", cat_root)
+    c.register("GET", "/_cat/plugins", cat_plugins)
     c.register("GET", "/_cat/indices", cat_indices)
     c.register("GET", "/_cat/indices/{index}", cat_indices)
     c.register("GET", "/_cat/health", cat_health)

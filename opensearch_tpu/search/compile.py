@@ -97,6 +97,10 @@ class ShardStats:
 
 MATCH_NONE = Plan("match_none")
 
+# plugin-registered compilers for new QueryNode classes:
+# class -> fn(compiler, node, seg, meta) -> Plan (SearchPlugin analog)
+PLUGIN_COMPILERS: Dict[type, Any] = {}
+
 
 def _match_all(boost: float) -> Plan:
     return Plan("match_all", inputs={"boost": _f32(boost)})
@@ -114,6 +118,9 @@ class Compiler:
                 meta: DeviceSegmentMeta) -> Plan:
         method = getattr(self, f"_c_{type(node).__name__}", None)
         if method is None:
+            plugin_compile = PLUGIN_COMPILERS.get(type(node))
+            if plugin_compile is not None:
+                return plugin_compile(self, node, seg, meta)
             raise QueryShardError(f"query type [{type(node).__name__}] "
                                   f"is not supported")
         return method(node, seg, meta)

@@ -623,7 +623,16 @@ def parse_query(q: Any) -> QueryNode:
                                 script_params=script.get("params", {}),
                                 boost=float(body.get("boost", 1.0)))
 
+    parser = PLUGIN_QUERIES.get(name)
+    if parser is not None:
+        return parser(body)
+
     raise ParsingError(f"unknown query [{name}]")
+
+
+# plugin-registered query parsers: name -> parser(body) -> QueryNode
+# (SearchPlugin#getQueries; populated by opensearch_tpu.plugins)
+PLUGIN_QUERIES: Dict[str, Any] = {}
 
 
 def parse_minimum_should_match(msm: Any, n_optional: int) -> int:

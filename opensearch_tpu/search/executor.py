@@ -541,8 +541,33 @@ class SearchExecutor:
         returns (candidates, per-segment decoded agg partials, total hits)
         for the coordinator to merge. `k` = from+size requested globally.
         `extra_filter` is an alias filter applied as a non-scoring clause
-        (reference: QueryShardContext filter from AliasFilter)."""
+        (reference: QueryShardContext filter from AliasFilter).
+
+        size=0 requests are served through the shard request cache
+        (IndicesRequestCache analog — indices/request_cache.py); the key
+        includes the segment identities, so refreshes/deletes miss."""
         body = body or {}
+        from opensearch_tpu.indices.request_cache import (
+            REQUEST_CACHE, cache_key, cacheable)
+        if cacheable(body):
+            base = cache_key(self.reader.segments, body, k, extra_filter)
+            key = ("shard", base) if base is not None else None
+            if key is not None:
+                def compute():
+                    cands, decoded, total = self._query_phase_uncached(
+                        body, k, extra_filter)
+                    # store candidates as plain tuples: callers mutate
+                    # _Candidate.shard_i, which must not leak between hits
+                    return ([(c.score, c.seg_i, c.ord, c.sort_values)
+                             for c in cands], decoded, total)
+                cts, decoded, total = REQUEST_CACHE.get_or_compute(
+                    key, compute)
+                return ([_Candidate(s, g, o, sv) for s, g, o, sv in cts],
+                        decoded, total)
+        return self._query_phase_uncached(body, k, extra_filter)
+
+    def _query_phase_uncached(self, body: dict, k: int,
+                              extra_filter: Optional[dict] = None):
         node = dsl.parse_query(body.get("query"))
         if extra_filter is not None:
             node = dsl.BoolQuery(must=[node],

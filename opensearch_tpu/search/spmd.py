@@ -91,8 +91,38 @@ def spmd_query_phase(executors: List, body: dict, k: int,
     host loop in controller.execute_search, or None when the compiled
     plans are not structure-uniform across rows (the program requires one
     signature; e.g. a per-segment `precomputed` host fallback)."""
-    from opensearch_tpu.parallel.distributed import plan_struct
+    from opensearch_tpu.indices.request_cache import (
+        REQUEST_CACHE, cache_key, cacheable)
     from opensearch_tpu.search.executor import _Candidate
+
+    key = None
+    if cacheable(body):
+        all_segs = [executors[s].reader.segments[g] for s, g in rows]
+        # "spmd"-tagged so it can never collide with the per-shard
+        # executor cache entries (same segments/body/k, different shape)
+        base = cache_key(all_segs, body, k,
+                         {"filters": extra_filters} if extra_filters
+                         else None)
+        key = ("spmd", base) if base is not None else None
+        if key is not None:
+            cached = REQUEST_CACHE.get(key)
+            if cached is not REQUEST_CACHE._MISS:
+                cts, decoded, total = cached
+                return ([_Candidate(s, g, o, sv, shard_i=si)
+                         for s, g, o, sv, si in cts], decoded, total)
+    out = _spmd_query_phase_raw(executors, body, k, extra_filters, rows)
+    if out is None:
+        return None     # host-loop fallback — never cached
+    if key is not None:
+        REQUEST_CACHE.put(key, out)
+    cts, decoded, total = out
+    return ([_Candidate(s, g, o, sv, shard_i=si)
+             for s, g, o, sv, si in cts], decoded, total)
+
+
+def _spmd_query_phase_raw(executors: List, body: dict, k: int,
+                          extra_filters, rows):
+    from opensearch_tpu.parallel.distributed import plan_struct
 
     node = dsl.parse_query(body.get("query"))
     min_score = float(body["min_score"]) \
@@ -153,12 +183,11 @@ def spmd_query_phase(executors: List, body: dict, k: int,
         return None
     SPMD_QUERIES[0] += 1
 
-    candidates = []
+    cand_tuples = []
     for score, row_i, ord_ in zip(keys, shard_idx, ords):
         shard_i, seg_i = rows[int(row_i)]
-        c = _Candidate(float(score), seg_i, int(ord_), [float(score)],
-                       shard_i=shard_i)
-        candidates.append(c)
+        cand_tuples.append((float(score), seg_i, int(ord_),
+                            [float(score)], shard_i))
 
     decoded = []
     if agg_nodes:
@@ -166,7 +195,7 @@ def spmd_query_phase(executors: List, body: dict, k: int,
             row_outs = jax.tree_util.tree_map(lambda o: o[r], agg_outs)
             decoded.append(decode_outputs(list(agg_plans_rows[r]),
                                           row_outs))
-    return candidates, decoded, int(total)
+    return cand_tuples, decoded, int(total)
 
 
 def _resident_shard_set(searcher, executors, rows):

@@ -1,0 +1,134 @@
+"""Cross-cluster search: two real clusters over loopback sockets, the
+`remote:index,local_index` expression, merged hits + aggregations.
+
+Reference: transport/RemoteClusterService.java:80 (remote registry),
+action/search/TransportSearchAction.java:422 (ccsRemoteReduce — each
+cluster reduces its own shards, coordinator merges) and
+SearchResponseMerger.java:88 (hit/agg merge).
+"""
+
+import time
+
+import pytest
+
+from opensearch_tpu.cluster.service import ClusterNode
+
+
+def boot(prefix, n=2):
+    nodes = {f"{prefix}-{i}": ClusterNode(f"{prefix}-{i}")
+             for i in range(n)}
+    peers = {nid: node.address for nid, node in nodes.items()}
+    for node in nodes.values():
+        node.bootstrap(peers)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if any(n.is_leader for n in nodes.values()):
+            return nodes
+        time.sleep(0.05)
+    raise AssertionError("no leader")
+
+
+@pytest.fixture(scope="module")
+def clusters():
+    local = boot("loc", 2)
+    remote = boot("rem", 2)
+    lnode = next(iter(local.values()))
+    rnode = next(iter(remote.values()))
+
+    lnode.request("PUT", "/events", {
+        "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+        "mappings": {"properties": {"msg": {"type": "text"},
+                                    "v": {"type": "integer"},
+                                    "dc": {"type": "keyword"}}}})
+    rnode.request("PUT", "/events", {
+        "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+        "mappings": {"properties": {"msg": {"type": "text"},
+                                    "v": {"type": "integer"},
+                                    "dc": {"type": "keyword"}}}})
+    for i in range(10):
+        lnode.request("PUT", f"/events/_doc/l{i}",
+                      {"msg": f"shared event local {i}", "v": i,
+                       "dc": "us"})
+        rnode.request("PUT", f"/events/_doc/r{i}",
+                      {"msg": f"shared event remote {i}", "v": 100 + i,
+                       "dc": "eu"})
+    lnode.request("POST", "/events/_refresh")
+    rnode.request("POST", "/events/_refresh")
+
+    # register the remote ONCE: the registry propagates through cluster
+    # state, so every local coordinator learns it
+    seed_host, seed_port = rnode.address
+    lnode.request("PUT", "/_cluster/settings", {
+        "persistent": {"cluster.remote.europe.seeds":
+                       [f"{seed_host}:{seed_port}"]}})
+    deadline = time.time() + 10
+    while time.time() < deadline and not all(
+            "europe" in n._remotes for n in local.values()):
+        time.sleep(0.05)
+    assert all("europe" in n._remotes for n in local.values())
+    yield local, remote
+    for n in (*local.values(), *remote.values()):
+        n.close()
+
+
+def test_ccs_merged_hits(clusters):
+    local, remote = clusters
+    lnode = next(iter(local.values()))
+    out = lnode.request("POST", "/europe:events,events/_search", {
+        "query": {"match": {"msg": "shared"}}, "size": 40})
+    assert out["hits"]["total"]["value"] == 20
+    indices = {h["_index"] for h in out["hits"]["hits"]}
+    assert indices == {"events", "europe:events"}
+    assert out["_clusters"] == {"total": 2, "successful": 2, "skipped": 0}
+    # remote hits carry their alias-qualified index and real sources
+    remote_hits = [h for h in out["hits"]["hits"]
+                   if h["_index"] == "europe:events"]
+    assert len(remote_hits) == 10
+    assert all(h["_source"]["dc"] == "eu" for h in remote_hits)
+
+
+def test_ccs_scores_merge_descending(clusters):
+    local, _ = clusters
+    lnode = next(iter(local.values()))
+    out = lnode.request("POST", "/europe:events,events/_search", {
+        "query": {"match": {"msg": "remote"}}, "size": 25})
+    # only remote docs contain "remote" — merged page is score-descending
+    assert out["hits"]["total"]["value"] == 10
+    scores = [h["_score"] for h in out["hits"]["hits"]]
+    assert scores == sorted(scores, reverse=True)
+    assert all(h["_index"] == "europe:events"
+               for h in out["hits"]["hits"])
+
+
+def test_ccs_aggregations_merge(clusters):
+    local, _ = clusters
+    lnode = next(iter(local.values()))
+    out = lnode.request("POST", "/europe:events,events/_search", {
+        "size": 0, "query": {"match_all": {}},
+        "aggs": {"dcs": {"terms": {"field": "dc"}},
+                 "sum_v": {"sum": {"field": "v"}}}})
+    assert out["hits"]["total"]["value"] == 20
+    buckets = {b["key"]: b["doc_count"]
+               for b in out["aggregations"]["dcs"]["buckets"]}
+    assert buckets == {"us": 10, "eu": 10}
+    assert out["aggregations"]["sum_v"]["value"] == \
+        sum(range(10)) + sum(range(100, 110))
+
+
+def test_ccs_remote_only_expression(clusters):
+    local, _ = clusters
+    lnode = next(iter(local.values()))
+    out = lnode.request("POST", "/europe:events/_search", {
+        "query": {"match_all": {}}, "size": 15})
+    assert out["hits"]["total"]["value"] == 10
+    assert all(h["_index"] == "europe:events"
+               for h in out["hits"]["hits"])
+
+
+def test_ccs_unknown_alias_400(clusters):
+    local, _ = clusters
+    lnode = next(iter(local.values()))
+    r = lnode.handle("POST", "/mars:events/_search",
+                     body={"query": {"match_all": {}}})
+    assert r.status == 400
+    assert "mars" in str(r.body)

@@ -1,0 +1,134 @@
+"""Plugin SPI: an example plugin adds a tokenizer, a query type, an ingest
+processor and a repository type WITHOUT touching core (reference: the 18
+SPI interfaces under server/src/main/java/org/opensearch/plugins/ —
+AnalysisPlugin, SearchPlugin, IngestPlugin, RepositoryPlugin)."""
+
+import pytest
+
+from opensearch_tpu.node import Node
+from opensearch_tpu.plugins import Plugin, install_plugin
+from opensearch_tpu.search import dsl
+
+
+# ------------------------------------------------------- example plugin
+
+def underscore_tokenizer(text, **params):
+    """Splits on underscores — not a built-in."""
+    out = []
+    pos = 0
+    for part in str(text).lower().split("_"):
+        if part:
+            out.append((part, pos))
+            pos += 1
+    return out
+
+
+def parse_match_reversed(body):
+    """A macro query: `match_reversed` matches the reversed term text —
+    composed entirely of existing DSL nodes (QueryBuilder#rewrite style)."""
+    field, value = next(iter(body.items()))
+    return dsl.TermQuery(field=field, value=str(value)[::-1])
+
+
+class StampProcessor:
+    """Minimal processor duck-typing the ingest Processor contract."""
+
+    def __init__(self, type_name, config):
+        self.type = type_name
+        self.tag = config.pop("tag", None)
+        self.on_failure = []
+        self.ignore_failure = False
+        self.field = config.get("field", "stamp")
+
+    def execute(self, ctx):
+        ctx[self.field] = "stamped"
+        return ctx
+
+
+class MemoryRepository:
+    """In-memory repository type (the s3/azure/gcs plugin analog)."""
+
+    def __init__(self, name, settings):
+        self.name = name
+        self.settings = settings
+        self.blobs = {}
+
+
+class ExamplePlugin(Plugin):
+    name = "example"
+
+    def get_tokenizers(self):
+        return {"underscore": underscore_tokenizer}
+
+    def get_queries(self):
+        return {"match_reversed": parse_match_reversed}
+
+    def get_processors(self):
+        return {"stamp": StampProcessor}
+
+    def get_repositories(self):
+        return {"memory": MemoryRepository}
+
+
+@pytest.fixture(scope="module")
+def node():
+    return Node(plugins=[ExamplePlugin()])
+
+
+def test_plugin_tokenizer_in_custom_analyzer(node):
+    node.request("PUT", "/plug", {
+        "settings": {"analysis": {"analyzer": {"under": {
+            "type": "custom", "tokenizer": "underscore"}}}},
+        "mappings": {"properties": {
+            "code": {"type": "text", "analyzer": "under"}}}})
+    node.request("PUT", "/plug/_doc/1", {"code": "Alpha_Beta_Gamma"})
+    node.request("POST", "/plug/_refresh")
+    out = node.request("POST", "/plug/_search", {
+        "query": {"match": {"code": "beta"}}})
+    assert out["hits"]["total"]["value"] == 1
+    # analyze API exercises it directly
+    toks = node.request("POST", "/_analyze", {
+        "text": "One_Two", "tokenizer": "underscore"})
+    assert [t["token"] for t in toks["tokens"]] == ["one", "two"]
+
+
+def test_plugin_query_type(node):
+    node.request("PUT", "/plugq", {"mappings": {"properties": {
+        "tag": {"type": "keyword"}}}})
+    node.request("PUT", "/plugq/_doc/1", {"tag": "abc"})
+    node.request("PUT", "/plugq/_doc/2", {"tag": "xyz"})
+    node.request("POST", "/plugq/_refresh")
+    out = node.request("POST", "/plugq/_search", {
+        "query": {"match_reversed": {"tag": "cba"}}})
+    assert out["hits"]["total"]["value"] == 1
+    assert out["hits"]["hits"][0]["_id"] == "1"
+
+
+def test_plugin_ingest_processor(node):
+    node.request("PUT", "/_ingest/pipeline/stamper",
+                 {"processors": [{"stamp": {"field": "mark"}}]})
+    node.request("PUT", "/plugi", {})
+    node.request("PUT", "/plugi/_doc/1", {"v": 1}, pipeline="stamper")
+    out = node.request("GET", "/plugi/_doc/1")
+    assert out["_source"]["mark"] == "stamped"
+
+
+def test_plugin_repository_type(node):
+    r = node.handle("PUT", "/_snapshot/mem1",
+                    body={"type": "memory", "settings": {"x": 1}})
+    assert r.status == 200, r.body
+    repo = node.repositories.get("mem1")
+    assert isinstance(repo, MemoryRepository)
+    assert repo.settings == {"x": 1}
+
+
+def test_unknown_repo_type_lists_plugins(node):
+    r = node.handle("PUT", "/_snapshot/bad", body={"type": "s3"})
+    assert r.status == 400
+    assert "memory" in str(r.body)
+
+
+def test_cat_plugins(node):
+    r = node.handle("GET", "/_cat/plugins")
+    assert r.status == 200
+    assert "example" in r.body

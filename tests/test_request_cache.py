@@ -1,0 +1,71 @@
+"""Shard request cache (IndicesRequestCache.java:82 analog): repeated
+size=0/aggregation requests are answered from cache, keyed by segment
+identity so a refresh (new segment) or delete (live-mask change) misses."""
+
+import pytest
+
+from opensearch_tpu.indices.request_cache import REQUEST_CACHE
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    n.request("PUT", "/rc", {"mappings": {"properties": {
+        "body": {"type": "text"}, "tag": {"type": "keyword"},
+        "n": {"type": "integer"}}}})
+    for i in range(40):
+        n.request("PUT", f"/rc/_doc/{i}",
+                  {"body": f"cached term {i}", "tag": f"t{i % 4}", "n": i})
+    n.request("POST", "/rc/_refresh")
+    return n
+
+
+AGG_BODY = {"size": 0, "query": {"match": {"body": "cached"}},
+            "aggs": {"tags": {"terms": {"field": "tag"}},
+                     "s": {"sum": {"field": "n"}}}}
+
+
+def test_repeated_agg_request_hits_cache(node):
+    first = node.request("POST", "/rc/_search", AGG_BODY)
+    h0 = REQUEST_CACHE.stats()["hit_count"]
+    second = node.request("POST", "/rc/_search", AGG_BODY)
+    assert REQUEST_CACHE.stats()["hit_count"] == h0 + 1
+    assert second["aggregations"] == first["aggregations"]
+    assert second["hits"]["total"] == first["hits"]["total"]
+    # stats surfaced via _nodes/stats
+    stats = node.request("GET", "/_nodes/stats")
+    rc = stats["nodes"][node.node_id]["indices"]["request_cache"]
+    assert rc["hit_count"] >= 1
+
+
+def test_sized_request_not_cached(node):
+    body = {"size": 5, "query": {"match": {"body": "cached"}}}
+    node.request("POST", "/rc/_search", body)
+    m0 = REQUEST_CACHE.stats()["miss_count"]
+    h0 = REQUEST_CACHE.stats()["hit_count"]
+    node.request("POST", "/rc/_search", body)
+    assert REQUEST_CACHE.stats()["hit_count"] == h0
+    assert REQUEST_CACHE.stats()["miss_count"] == m0
+
+
+def test_refresh_invalidates(node):
+    node.request("POST", "/rc/_search", AGG_BODY)
+    node.request("POST", "/rc/_search", AGG_BODY)   # warm hit
+    node.request("PUT", "/rc/_doc/100",
+                 {"body": "cached fresh", "tag": "t9", "n": 100})
+    node.request("POST", "/rc/_refresh")
+    out = node.request("POST", "/rc/_search", AGG_BODY)
+    # the new doc must be visible (a stale cache hit would miss it)
+    assert out["hits"]["total"]["value"] == 41
+    keys = {b["key"] for b in out["aggregations"]["tags"]["buckets"]}
+    assert "t9" in keys
+
+
+def test_delete_invalidates(node):
+    before = node.request("POST", "/rc/_search", AGG_BODY)
+    assert before["hits"]["total"]["value"] == 40
+    node.request("DELETE", "/rc/_doc/0")
+    node.request("POST", "/rc/_refresh")
+    out = node.request("POST", "/rc/_search", AGG_BODY)
+    assert out["hits"]["total"]["value"] == 39

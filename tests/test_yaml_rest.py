@@ -1,0 +1,99 @@
+"""YAML REST contract tests: execute the reference's black-box suites
+against the in-process REST surface.
+
+The reference ships 161 API specs + 329 YAML do/match suites
+(rest-api-spec/src/main/resources/rest-api-spec/) executed by
+OpenSearchClientYamlSuiteTestCase — the portable acceptance suite for any
+compatible implementation. tests/yaml_rest_runner.py reads specs + suites
+straight from the reference checkout (nothing is copied into this repo)
+and drives Node.handle.
+
+CURATED below are the suites this implementation passes COMPLETELY (every
+test section green). The remaining suites cover features that are partial
+here (closed indices, range field types, _stats metrics breadth, cat
+formatting, ...) — grow this list as the surface grows; never shrink it.
+"""
+
+import pytest
+
+import yaml_rest_runner as yr
+from opensearch_tpu.node import Node
+
+CURATED = [
+    "bulk/30_big_string.yml",
+    "bulk/50_refresh.yml",
+    "cat.aliases/30_json.yml",
+    "create/10_with_id.yml",
+    "delete/10_basic.yml",
+    "delete/11_shard_header.yml",
+    "delete/12_result.yml",
+    "delete/20_cas.yml",
+    "delete/30_routing.yml",
+    "delete/60_missing.yml",
+    "exists/70_defaults.yml",
+    "explain/10_basic.yml",
+    "get/10_basic.yml",
+    "get/15_default_values.yml",
+    "get/80_missing.yml",
+    "get_source/10_basic.yml",
+    "get_source/15_default_values.yml",
+    "get_source/40_routing.yml",
+    "index/12_result.yml",
+    "index/15_without_id.yml",
+    "index/20_optype.yml",
+    "index/30_cas.yml",
+    "indices.clone/10_basic.yml",
+    "indices.clone/20_source_mapping.yml",
+    "indices.delete_alias/10_basic.yml",
+    "indices.forcemerge/10_basic.yml",
+    "indices.get_alias/20_empty.yml",
+    "indices.get_index_template/20_get_missing.yml",
+    "indices.get_mapping/40_aliases.yml",
+    "indices.get_settings/10_basic.yml",
+    "indices.get_template/20_get_missing.yml",
+    "indices.put_settings/all_path_options.yml",
+    "indices.refresh/10_basic.yml",
+    "indices.rollover/20_max_doc_condition.yml",
+    "indices.rollover/30_max_size_condition.yml",
+    "indices.rollover/40_mapping.yml",
+    "indices.split/20_source_mapping.yml",
+    "info/10_info.yml",
+    "mlt/10_basic.yml",
+    "mlt/20_docs.yml",
+    "msearch/11_status.yml",
+    "ping/10_ping.yml",
+    "scroll/10_basic.yml",
+    "search/200_index_phrase_search.yml",
+    "search/issue4895.yml",
+    "suggest/10_basic.yml",
+    "update/10_doc.yml",
+    "update/11_shard_header.yml",
+    "update/13_legacy_doc.yml",
+    "update/16_noop.yml",
+    "update/95_require_alias.yml",
+]
+
+pytestmark = pytest.mark.skipif(
+    not yr.available(), reason="reference rest-api-spec not present")
+
+
+def _cases():
+    import os
+    for suite in CURATED:
+        path = os.path.join(yr.TEST_DIR, suite)
+        if not os.path.exists(path):
+            continue
+        setup, teardown, tests = yr.load_suite(path)
+        for name, steps in tests:
+            yield pytest.param(setup, steps,
+                               id=f"{suite}::{name}"[:120])
+
+
+@pytest.mark.parametrize("setup,steps", list(_cases()) if yr.available()
+                         else [])
+def test_yaml_suite(setup, steps):
+    node = Node()
+    try:
+        yr.run_case(node, setup, steps)
+    except yr.SkipTest as e:
+        pytest.skip(str(e))
