@@ -331,9 +331,13 @@ class InternalEngine:
             if len(self.builder):
                 new_seg = self.builder.seal()
                 # within-buffer supersession: keep only the last ord per id,
-                # and ids deleted after their last index
+                # and ids deleted after their last index. Nested child rows
+                # (doc_id None) inherit their parent row's verdict — the
+                # whole doc block lives or dies together.
                 for ord_ in range(new_seg.num_docs):
                     did = new_seg.doc_ids[ord_]
+                    if did is None:
+                        continue
                     vv = self.version_map.get(did)
                     last = self._builder_ords.get(did)
                     if last != ord_ or (vv is not None and vv.deleted):
@@ -341,6 +345,10 @@ class InternalEngine:
                     elif vv is not None:
                         new_seg.doc_meta[did] = (vv.version, vv.seq_no,
                                                  vv.primary_term)
+                if new_seg.nested_paths:
+                    child = new_seg.parent_ptr >= 0
+                    new_seg.live[child] = \
+                        new_seg.live[new_seg.parent_ptr[child]]
                 self.segments.append(new_seg)
                 self.builder = SegmentBuilder(self.mapper, self._next_seg_id())
                 self._builder_ords = {}

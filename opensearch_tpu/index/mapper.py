@@ -242,11 +242,16 @@ class ParsedField:
 
 @dataclass
 class ParsedDocument:
-    """Reference: index/mapper/ParsedDocument.java."""
+    """Reference: index/mapper/ParsedDocument.java. `children` carries one
+    (nested path, fields) entry per nested object — each becomes its own
+    row in the segment's doc block, children before the parent, exactly
+    like Lucene's block-join document ordering."""
     doc_id: str
     source: dict
     routing: Optional[str]
     fields: Dict[str, ParsedField]
+    children: List[Tuple[str, Dict[str, ParsedField]]] = \
+        dc_field(default_factory=list)
 
 
 DEFAULT_MAPPING_LIMIT = 1000  # index.mapping.total_fields.limit default
@@ -265,6 +270,13 @@ class MapperService:
         self.analysis = analysis_registry or get_default_registry()
         self.field_types: Dict[str, MappedFieldType] = {}
         self._multi_children: Dict[str, List[str]] = {}  # parent → direct sub-fields
+        # nested object paths (index/mapper/ObjectMapper nested=true): each
+        # value under such a path becomes its own segment row (doc block)
+        self.nested_paths: set = set()
+        # parent-join (modules/parent-join JoinFieldMapper): one join field
+        # per index; relations maps parent type -> [child types]
+        self.join_field: Optional[str] = None
+        self.join_relations: Dict[str, List[str]] = {}
         self.dynamic = dynamic
         self.total_fields_limit = total_fields_limit
         self._source_enabled = True
@@ -287,6 +299,21 @@ class MapperService:
                 raise MapperParsingError(f"Expected map for property [{prefix}{name}]")
             full = f"{prefix}{name}"
             sub_properties = spec.get("properties")
+            if spec.get("type") == "nested":
+                self.nested_paths.add(full)
+                self._merge_properties(f"{full}.", sub_properties or {})
+                continue
+            if spec.get("type") == "join":
+                # one join field per index (JoinFieldMapper); the relation
+                # name indexes like a keyword, the parent id goes into a
+                # hidden <field>#parent keyword column for the host join
+                self.join_field = full
+                for parent, kids in (spec.get("relations") or {}).items():
+                    self.join_relations[parent] = (
+                        kids if isinstance(kids, list) else [kids])
+                self._put_field(full, {"type": "keyword"})
+                self._put_field(f"{full}#parent", {"type": "keyword"})
+                continue
             if sub_properties is not None or spec.get("type") == "object":
                 self._merge_properties(f"{full}.", sub_properties or {})
                 continue
@@ -396,10 +423,14 @@ class MapperService:
         if not isinstance(source, dict):
             raise MapperParsingError("failed to parse: document must be an object")
         fields: Dict[str, ParsedField] = {}
-        self._parse_object("", source, fields)
-        return ParsedDocument(doc_id=doc_id, source=source, routing=routing, fields=fields)
+        children: List[Tuple[str, Dict[str, ParsedField]]] = []
+        self._parse_object("", source, fields, children)
+        return ParsedDocument(doc_id=doc_id, source=source, routing=routing,
+                              fields=fields, children=children)
 
-    def _parse_object(self, prefix: str, obj: dict, out: Dict[str, ParsedField]):
+    def _parse_object(self, prefix: str, obj: dict,
+                      out: Dict[str, ParsedField],
+                      children: Optional[List] = None):
         for key, value in obj.items():
             full = f"{prefix}{key}"
             ft = self.field_types.get(full)
@@ -407,12 +438,40 @@ class MapperService:
                 # stored-query field: kept in _source only, matched at
                 # percolate time (modules/percolator PercolatorFieldMapper)
                 continue
+            if full == self.join_field and children is not None:
+                # join value: "parent_type" or {"name": t, "parent": id}
+                if isinstance(value, dict):
+                    self._parse_value(full, value.get("name"), out)
+                    if value.get("parent") is not None:
+                        self._parse_value(f"{full}#parent",
+                                          str(value["parent"]), out)
+                else:
+                    self._parse_value(full, value, out)
+                continue
+            if full in self.nested_paths and children is not None:
+                # nested object(s): each becomes its own doc-block row;
+                # sub-fields do NOT join the parent row's fields. `children`
+                # is passed through so nested-inside-nested paths also get
+                # their own rows (each joins to the root block).
+                elems = value if isinstance(value, list) else [value]
+                for elem in elems:
+                    if elem is None:
+                        continue    # explicit null = absent, like the ref
+                    if not isinstance(elem, dict):
+                        raise MapperParsingError(
+                            f"object mapping for [{full}] tried to parse "
+                            f"field as object, but found a concrete value")
+                    child_fields: Dict[str, ParsedField] = {}
+                    self._parse_object(f"{full}.", elem, child_fields,
+                                       children)
+                    children.append((full, child_fields))
+                continue
             if isinstance(value, dict):
-                self._parse_object(f"{full}.", value, out)
+                self._parse_object(f"{full}.", value, out, children)
             elif isinstance(value, list) and value and all(
                     isinstance(v, dict) for v in value):
                 for v in value:
-                    self._parse_object(f"{full}.", v, out)
+                    self._parse_object(f"{full}.", v, out, children)
             else:
                 self._parse_value(full, value, out)
 

@@ -156,7 +156,10 @@ class Segment:
                  numeric_dv: Dict[str, DocValuesColumn],
                  ordinal_dv: Dict[str, OrdinalsColumn],
                  vector_dv: Dict[str, VectorColumn],
-                 positions: Optional[Dict[Tuple[str, str], List[np.ndarray]]] = None):
+                 positions: Optional[Dict[Tuple[str, str], List[np.ndarray]]] = None,
+                 parent_ptr: Optional[np.ndarray] = None,
+                 path_ords: Optional[np.ndarray] = None,
+                 nested_paths: Optional[List[str]] = None):
         self.seg_id = seg_id
         # process-unique identity: seg_id is a per-engine counter and can
         # repeat across indices/engines, so caches keyed on segments (e.g.
@@ -178,7 +181,18 @@ class Segment:
         # (reference: Lucene's .pos files feeding PhraseQuery's ExactPhraseMatcher)
         self.positions = positions or {}
         self.live = np.ones(num_docs, dtype=bool)  # deletes bitmap
-        self._id_to_ord = {d: i for i, d in enumerate(doc_ids)}
+        # doc-block structure (Lucene block-join layout): nested child rows
+        # sit immediately before their parent row. parent_ptr[-1 for
+        # roots]; path_ords indexes nested_paths (-1 for roots). Root-only
+        # segments get the trivial all-root encoding.
+        self.parent_ptr = parent_ptr if parent_ptr is not None \
+            else np.full(num_docs, -1, dtype=np.int32)
+        self.path_ords = path_ords if path_ords is not None \
+            else np.full(num_docs, -1, dtype=np.int32)
+        self.nested_paths = list(nested_paths or [])
+        self.root = self.parent_ptr < 0
+        self._id_to_ord = {d: i for i, d in enumerate(doc_ids)
+                           if d is not None}
         # doc_id → (version, seq_no, primary_term) — Lucene stores these as
         # per-doc fields (_version docvalue, _seq_no); here a host-side map
         # attached by the engine at seal/merge time
@@ -199,6 +213,10 @@ class Segment:
         if ord_ is None or not self.live[ord_]:
             return False
         self.live[ord_] = False
+        if self.nested_paths:
+            # the whole doc block dies with its root (Lucene deletes the
+            # child docs of a block together with the parent)
+            self.live[self.parent_ptr == ord_] = False
         return True
 
     def clone_for_copy(self) -> "Segment":
@@ -289,6 +307,12 @@ class SegmentBuilder:
         self._ordinal_raw: Dict[str, List[Tuple[int, str]]] = {}
         self._vectors: Dict[str, Dict[int, List[float]]] = {}
         self._field_stats: Dict[str, FieldStats] = {}
+        # doc-block structure (Lucene block-join layout: nested child rows
+        # precede their parent row): parent row ord per row (-1 = root) and
+        # nested-path ordinal per row (-1 = root)
+        self._parent_ptr: List[int] = []
+        self._path_ords: List[int] = []
+        self._nested_paths: List[str] = []
 
     def __len__(self):
         return len(self.doc_ids)
@@ -298,10 +322,26 @@ class SegmentBuilder:
         return len(self.doc_ids)
 
     def add(self, doc: ParsedDocument) -> int:
+        child_ords = []
+        for path, child_fields in getattr(doc, "children", ()):
+            if path not in self._nested_paths:
+                self._nested_paths.append(path)
+            child_ords.append(self._add_row(
+                None, None, child_fields,
+                path_ord=self._nested_paths.index(path)))
+        parent_ord = self._add_row(doc.doc_id, doc.source, doc.fields)
+        for c in child_ords:
+            self._parent_ptr[c] = parent_ord
+        return parent_ord
+
+    def _add_row(self, doc_id, source, fields,
+                 path_ord: int = -1) -> int:
         ord_ = len(self.doc_ids)
-        self.doc_ids.append(doc.doc_id)
-        self.sources.append(doc.source)
-        for field, pf in doc.fields.items():
+        self.doc_ids.append(doc_id)
+        self.sources.append(source)
+        self._parent_ptr.append(-1)
+        self._path_ords.append(path_ord)
+        for field, pf in fields.items():
             ft = self.mapper.get_field(field)
             if ft is None:
                 continue
@@ -429,7 +469,10 @@ class SegmentBuilder:
         return Segment(self.seg_id, n_docs, list(self.doc_ids), list(self.sources),
                        term_dict, post_docs, post_tf, norms, self._field_stats,
                        numeric_dv, ordinal_dv, vector_dv,
-                       positions=dict(self._positions))
+                       positions=dict(self._positions),
+                       parent_ptr=np.asarray(self._parent_ptr, np.int32),
+                       path_ords=np.asarray(self._path_ords, np.int32),
+                       nested_paths=list(self._nested_paths))
 
 
 def merge_segments(mapper: MapperService, segments: List[Segment],
@@ -445,7 +488,8 @@ def merge_segments(mapper: MapperService, segments: List[Segment],
     doc_meta = {}
     for seg in segments:
         for ord_ in range(seg.num_docs):
-            if not seg.live[ord_]:
+            if not seg.live[ord_] or seg.doc_ids[ord_] is None:
+                # child rows re-expand from their root's _source reparse
                 continue
             doc = mapper.parse_document(seg.doc_ids[ord_], seg.sources[ord_] or {})
             builder.add(doc)

@@ -69,12 +69,29 @@ def upload_segment(seg: Segment, to_device: bool = True):
     live = np.zeros(d_pad, dtype=bool)
     live[:seg.num_docs] = seg.live
 
+    # doc-block structure for nested queries: root mask (top-level rows —
+    # the only rows a search may return), parent row pointer, nested-path
+    # ordinal (segment.py block-join layout). Root-only segments carry the
+    # trivial encoding so all segments share one array layout.
+    root = np.zeros(d_pad, dtype=bool)
+    root[:seg.num_docs] = getattr(seg, "root",
+                                  np.ones(seg.num_docs, bool))
+    parent_ptr = np.full(d_pad, -1, dtype=np.int32)
+    parent_ptr[:seg.num_docs] = getattr(
+        seg, "parent_ptr", np.full(seg.num_docs, -1, np.int32))
+    nested_path = np.full(d_pad, -1, dtype=np.int32)
+    nested_path[:seg.num_docs] = getattr(
+        seg, "path_ords", np.full(seg.num_docs, -1, np.int32))
+
     arrays: Dict = {
         "post_docs": post_docs,
         "post_tf": post_tf,
         "norms": norms,
         "length_table": LENGTH_TABLE,
         "live": live,
+        "root": root,
+        "parent_ptr": parent_ptr,
+        "nested_path": nested_path,
         "numeric": {},
         "ordinal": {},
         "vector": {},

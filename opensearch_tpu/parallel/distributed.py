@@ -260,7 +260,8 @@ class DistributedSearcher:
             scores, matches = _eval_plan(plan, seg, flat_inputs, cursor)
             # `live` is False on padding rows (ops/device_segment.py), so no
             # per-shard num_docs mask is needed — metas stay shape-only here.
-            eligible = matches & seg["live"] & (scores >= min_score)
+            eligible = matches & seg["live"] & seg["root"] \
+                & (scores >= min_score)
             local_total = jnp.sum(eligible.astype(jnp.int32))
             masked = jnp.where(eligible, scores, NEG_INF)
             top_keys, top_idx = jax.lax.top_k(masked, k_eff)

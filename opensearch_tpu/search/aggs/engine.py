@@ -325,6 +325,24 @@ def _fmt_num(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else str(v)
 
 
+def _c_nested(node: AggNode, ctx: _Ctx) -> AggPlan:
+    """Switch the doc set to a nested path's child rows; bucket ordinals
+    follow each child's root (bucket/nested/NestedAggregator.java)."""
+    path = (node.body or {}).get("path")
+    paths = getattr(ctx.seg, "nested_paths", [])
+    path_ord = paths.index(path) if path in paths else -1
+    children = [_compile_node(c, ctx) for c in node.children]
+    return AggPlan(node.name, "nested",
+                   inputs={"path_ord": np.asarray(path_ord, np.int32)},
+                   children=children, render={"kind": "filter"})
+
+
+def _c_reverse_nested(node: AggNode, ctx: _Ctx) -> AggPlan:
+    children = [_compile_node(c, ctx) for c in node.children]
+    return AggPlan(node.name, "reverse_nested", children=children,
+                   render={"kind": "filter"})
+
+
 def _c_filter(node: AggNode, ctx: _Ctx) -> AggPlan:
     qnode = dsl.parse_query(node.body if node.body else {"match_all": {}})
     qplan = ctx.compiler.compile(qnode, ctx.seg, ctx.meta)
@@ -717,6 +735,8 @@ _COMPILERS = {
     "ip_range": _c_range,
     "filter": _c_filter,
     "filters": _c_filters,
+    "nested": _c_nested,
+    "reverse_nested": _c_reverse_nested,
     "global": _c_global,
     "missing": _c_missing,
     "min": _c_metric, "max": _c_metric, "sum": _c_metric, "avg": _c_metric,
@@ -801,6 +821,56 @@ def _eval_agg(plan: AggPlan, seg: Dict, inputs: List[Dict], cursor: List[int],
                 jnp.where(ok, eff, -1), mode="drop")
             for c in plan.children:
                 _eval_agg(c, seg, inputs, cursor, mask, child_eff, total, outs)
+        return
+
+    if kind == "nested":
+        # doc set becomes the path's child rows whose ROOT is in the
+        # current bucket set; each child inherits its root's bucket ord
+        # (bucket/nested/NestedAggregator.java)
+        pptr = seg["parent_ptr"]
+        safe_p = jnp.where(pptr >= 0, pptr, 0)
+        own = (seg["nested_path"] == my["path_ord"]) \
+            & (my["path_ord"] >= 0) & seg["live"] & (pptr >= 0) \
+            & mask[safe_p] & (parent_eff[safe_p] >= 0)
+        child_eff = jnp.where(own, parent_eff[safe_p], -1)
+        eff = jnp.where(own, child_eff, parent_card)
+        counts = jnp.zeros(parent_card, jnp.int32).at[eff].add(
+            own.astype(jnp.int32), mode="drop")
+        outs.append({"counts": counts})
+        for c in plan.children:
+            _eval_agg(c, seg, inputs, cursor, own, child_eff, parent_card,
+                      outs)
+        return
+
+    if kind == "reverse_nested":
+        # back to root rows (ReverseNestedAggregator.java): the bucket
+        # count is DISTINCT roots per bucket — dedup (bucket, root) pairs
+        # with a two-key sort + run-start flags, since one root's children
+        # may sit in several buckets
+        import jax as _jax
+        pptr = seg["parent_ptr"]
+        sel = mask & (parent_eff >= 0) & (pptr >= 0)
+        eff_k = jnp.where(sel, parent_eff, parent_card)
+        root_k = jnp.where(sel, pptr, d_pad)
+        se, sr = _jax.lax.sort([eff_k, root_k], num_keys=2)
+        first = jnp.concatenate([
+            jnp.ones((1,), bool),
+            (se[1:] != se[:-1]) | (sr[1:] != sr[:-1])])
+        valid = first & (se < parent_card)
+        counts = jnp.zeros(parent_card, jnp.int32).at[
+            jnp.where(valid, se, parent_card)].add(
+            valid.astype(jnp.int32), mode="drop")
+        outs.append({"counts": counts})
+        # sub-aggs evaluate over root rows; a root carries ONE bucket ord
+        # (the engine's dense child_eff convention — same single-bucket
+        # simplification bucket_ord applies to multi-valued fields)
+        idx = jnp.where(sel, pptr, d_pad)
+        root_eff = jnp.full(d_pad, -1, jnp.int32).at[idx].max(
+            jnp.where(sel, parent_eff, -1), mode="drop")
+        own = root_eff >= 0
+        for c in plan.children:
+            _eval_agg(c, seg, inputs, cursor, own, root_eff, parent_card,
+                      outs)
         return
 
     if kind == "filter":

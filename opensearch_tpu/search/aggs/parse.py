@@ -16,7 +16,7 @@ BUCKET_TYPES = {"terms", "histogram", "date_histogram", "range", "date_range",
                 "filter", "filters", "global", "missing", "ip_range",
                 "composite", "multi_terms", "significant_terms",
                 "auto_date_histogram", "adjacency_matrix", "geohash_grid",
-                "geotile_grid"}
+                "geotile_grid", "nested", "reverse_nested"}
 METRIC_TYPES = {"min", "max", "sum", "avg", "value_count", "stats",
                 "extended_stats", "cardinality", "percentiles",
                 "percentile_ranks", "weighted_avg", "median_absolute_deviation",

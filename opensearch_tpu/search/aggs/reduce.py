@@ -101,7 +101,7 @@ def _merge_node(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
             return _merge_filters(entries)
         return _merge_ranges(entries)
 
-    if kind in ("filter", "global", "missing"):
+    if kind in ("filter", "global", "missing", "nested", "reverse_nested"):
         count = sum(int(d.out["counts"][p]) for d, p in entries
                     if "counts" in d.out)
         result = {"doc_count": count}

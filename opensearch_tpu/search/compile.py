@@ -112,6 +112,9 @@ class Compiler:
     def __init__(self, mapper: MapperService, stats: ShardStats):
         self.mapper = mapper
         self.stats = stats
+        # per-query memo for cross-segment parent-join scans (one Compiler
+        # instance serves all segment compiles of one request)
+        self._join_cache: Dict[Any, Any] = {}
 
     # ------------------------------------------------------------ entry
     def compile(self, node: dsl.QueryNode, seg: Segment,
@@ -376,6 +379,269 @@ class Compiler:
         return Plan("precomputed", inputs={
             "scores": np.where(mask, np.float32(node.boost), np.float32(0.0)),
             "matches": mask})
+
+    # ---------------------------------------------- nested + parent-join
+
+    def _c_NestedQuery(self, node: dsl.NestedQuery, seg, meta) -> Plan:
+        """Block-join: evaluate the inner query over nested child rows and
+        join matches up to their root rows on device
+        (index/query/NestedQueryBuilder.java → Lucene
+        ToParentBlockJoinQuery)."""
+        if node.path not in self.mapper.nested_paths:
+            if node.ignore_unmapped:
+                return MATCH_NONE
+            raise QueryShardError(
+                f"[nested] failed to find nested object under path "
+                f"[{node.path}]")
+        if node.score_mode not in ("avg", "sum", "min", "max", "none"):
+            raise QueryShardError(
+                f"[nested] unknown score_mode [{node.score_mode}]")
+        inner = self.compile(node.query, seg, meta)
+        paths = getattr(seg, "nested_paths", [])
+        path_ord = paths.index(node.path) if node.path in paths else -1
+        return Plan("nested", static=(node.score_mode,),
+                    inputs={"path_ord": _i32(path_ord),
+                            "boost": _f32(node.boost)},
+                    children=[inner])
+
+    def _host_match(self, seg, node) -> np.ndarray:
+        """Host-side boolean evaluation over one segment's columns — the
+        control-plane half of the parent-join (the reference joins via
+        Lucene global ordinals; here the parent-id join runs on host and
+        the resulting doc mask enters the device program as a
+        `precomputed` plan input)."""
+        n = seg.num_docs
+
+        def postings_mask(field, terms):
+            mask = np.zeros(n, bool)
+            for t in terms:
+                tm = seg.get_term(field, str(t))
+                if tm is None:
+                    continue
+                blk = seg.post_docs[
+                    tm.start_block:tm.start_block + tm.num_blocks].ravel()
+                mask[blk[blk >= 0]] = True
+            return mask
+
+        if isinstance(node, dsl.MatchAllQuery):
+            return np.ones(n, bool)
+        if isinstance(node, dsl.MatchNoneQuery):
+            return np.zeros(n, bool)
+        if isinstance(node, dsl.IdsQuery):
+            mask = np.zeros(n, bool)
+            for d in node.values:
+                o = seg._id_to_ord.get(str(d))
+                if o is not None:
+                    mask[o] = True
+            return mask
+        if isinstance(node, (dsl.TermQuery, dsl.TermsQuery)):
+            values = [node.value] if isinstance(node, dsl.TermQuery) \
+                else list(node.values)
+            ft = self.mapper.get_field(node.field)
+            if ft is not None and (ft.is_numeric or ft.is_date
+                                   or ft.is_bool):
+                col = seg.numeric_dv.get(node.field)
+                mask = np.zeros(n, bool)
+                if col is not None:
+                    want = set()
+                    for v in values:
+                        if isinstance(v, bool) or (
+                                isinstance(v, str)
+                                and v.lower() in ("true", "false")):
+                            want.add(1.0 if str(v).lower() == "true"
+                                     else 0.0)
+                        else:
+                            try:
+                                want.add(float(v))
+                            except (TypeError, ValueError):
+                                pass
+                    sel = np.isin(col.values, list(want))
+                    mask[col.doc_ids[sel]] = True
+                return mask
+            return postings_mask(node.field, values)
+        if isinstance(node, dsl.MatchQuery):
+            ft = self.mapper.get_field(node.field)
+            if ft is None:
+                return np.zeros(n, bool)
+            terms = self._analyze_query_terms(ft, node.query, node.analyzer)
+            if not terms:
+                return np.zeros(n, bool)
+            if node.operator == "and":
+                mask = np.ones(n, bool)
+                for t in terms:
+                    mask &= postings_mask(node.field, [t])
+                return mask
+            return postings_mask(node.field, terms)
+        if isinstance(node, dsl.RangeQuery):
+            col = seg.numeric_dv.get(node.field)
+            mask = np.zeros(n, bool)
+            if col is None:
+                return mask
+            sel = np.ones(len(col.values), bool)
+            try:
+                if node.gte is not None:
+                    sel &= col.values >= float(node.gte)
+                if node.gt is not None:
+                    sel &= col.values > float(node.gt)
+                if node.lte is not None:
+                    sel &= col.values <= float(node.lte)
+                if node.lt is not None:
+                    sel &= col.values < float(node.lt)
+            except (TypeError, ValueError):
+                raise QueryShardError(
+                    "[has_child/has_parent] inner range query supports "
+                    "numeric bounds only")
+            mask[col.doc_ids[sel]] = True
+            return mask
+        if isinstance(node, dsl.ExistsQuery):
+            mask = np.zeros(n, bool)
+            col = seg.numeric_dv.get(node.field)
+            if col is not None:
+                mask |= col.exists[:n]
+            ocol = seg.ordinal_dv.get(node.field)
+            if ocol is not None:
+                mask |= ocol.exists[:n]
+            if node.field in seg.norms:
+                mask |= seg.norms[node.field][:n] > 0
+            return mask
+        if isinstance(node, dsl.BoolQuery):
+            mask = np.ones(n, bool)
+            for sub in list(node.must) + list(node.filter):
+                mask &= self._host_match(seg, sub)
+            if node.should:
+                should_count = np.zeros(n, np.int32)
+                for sub in node.should:
+                    should_count += self._host_match(seg, sub)
+                if node.minimum_should_match is not None:
+                    required = parse_minimum_should_match(
+                        node.minimum_should_match, len(node.should))
+                elif not node.must and not node.filter:
+                    required = 1
+                else:
+                    required = 0
+                if required > 0:
+                    mask &= should_count >= required
+            for sub in node.must_not:
+                mask &= ~self._host_match(seg, sub)
+            return mask
+        raise QueryShardError(
+            f"[{type(node).__name__}] is not supported inside "
+            f"has_child/has_parent (host-join path)")
+
+    def _join_info(self):
+        join = self.mapper.join_field
+        if join is None:
+            return None
+        return join, self.mapper.join_relations
+
+    def _join_columns(self, seg, join):
+        """Per-doc relation name + parent id (host strings; None = absent)."""
+        rel = [None] * seg.num_docs
+        par = [None] * seg.num_docs
+        col = seg.ordinal_dv.get(join)
+        if col is not None:
+            for d, o in zip(col.doc_ids, col.ords):
+                rel[d] = col.dictionary[o]
+        pcol = seg.ordinal_dv.get(f"{join}#parent")
+        if pcol is not None:
+            for d, o in zip(pcol.doc_ids, pcol.ords):
+                par[d] = pcol.dictionary[o]
+        return rel, par
+
+    def _precomputed(self, seg, mask: np.ndarray, boost: float) -> Plan:
+        d_pad = pad_bucket(max(seg.num_docs, 1))
+        full = np.zeros(d_pad, bool)
+        full[:seg.num_docs] = mask
+        return Plan("precomputed", inputs={
+            "scores": np.where(full, np.float32(boost), np.float32(0.0)),
+            "matches": full})
+
+    def _c_HasChildQuery(self, node: dsl.HasChildQuery, seg, meta) -> Plan:
+        info = self._join_info()
+        if info is None or not any(
+                node.type in kids
+                for kids in self.mapper.join_relations.values()):
+            if node.ignore_unmapped:
+                return MATCH_NONE
+            raise QueryShardError(
+                f"[has_child] join field has no child relation "
+                f"[{node.type}]")
+        if node.score_mode != "none":
+            raise QueryShardError(
+                "[has_child] only score_mode [none] is supported")
+        join, relations = info
+        # join across ALL shard segments: children and parents may live in
+        # different segments (same shard via routing). The cross-segment
+        # scan runs ONCE per query — compile() is called per segment with
+        # the same node object, so memoize the wanted-parent set on it.
+        cache_key = ("has_child", id(node))
+        wanted = self._join_cache.get(cache_key)
+        if wanted is None:
+            from collections import Counter
+            counts: Counter = Counter()
+            for s in self.stats.segments:
+                child_mask = self._host_match(s, node.query)
+                rel, par = self._join_columns(s, join)
+                for d in np.nonzero(child_mask & s.live[:s.num_docs])[0]:
+                    if rel[d] == node.type and par[d] is not None:
+                        counts[par[d]] += 1
+            lo = node.min_children
+            hi = node.max_children if node.max_children is not None \
+                else (1 << 60)
+            wanted = {pid for pid, c in counts.items() if lo <= c <= hi}
+            self._join_cache[cache_key] = wanted
+        parent_types = {p for p, kids in relations.items()
+                        if node.type in kids}
+        rel, _ = self._join_columns(seg, join)
+        mask = np.fromiter(
+            (rel[d] in parent_types and seg.doc_ids[d] in wanted
+             for d in range(seg.num_docs)), bool, seg.num_docs)
+        return self._precomputed(seg, mask, node.boost)
+
+    def _c_HasParentQuery(self, node: dsl.HasParentQuery, seg, meta) -> Plan:
+        info = self._join_info()
+        if info is None or node.type not in self.mapper.join_relations:
+            if node.ignore_unmapped:
+                return MATCH_NONE
+            raise QueryShardError(
+                f"[has_parent] join field has no parent relation "
+                f"[{node.type}]")
+        if node.score:
+            raise QueryShardError(
+                "[has_parent] score=true is not supported (host-join "
+                "path scores with the query boost only)")
+        join, relations = info
+        cache_key = ("has_parent", id(node))
+        wanted = self._join_cache.get(cache_key)
+        if wanted is None:
+            wanted = set()
+            for s in self.stats.segments:
+                pmask = self._host_match(s, node.query)
+                rel, _ = self._join_columns(s, join)
+                for d in np.nonzero(pmask & s.live[:s.num_docs])[0]:
+                    if rel[d] == node.type and s.doc_ids[d] is not None:
+                        wanted.add(s.doc_ids[d])
+            self._join_cache[cache_key] = wanted
+        child_types = set(relations.get(node.type, []))
+        rel, par = self._join_columns(seg, join)
+        mask = np.fromiter(
+            (rel[d] in child_types and par[d] in wanted
+             for d in range(seg.num_docs)), bool, seg.num_docs)
+        return self._precomputed(seg, mask, node.boost)
+
+    def _c_ParentIdQuery(self, node: dsl.ParentIdQuery, seg, meta) -> Plan:
+        info = self._join_info()
+        if info is None:
+            if node.ignore_unmapped:
+                return MATCH_NONE
+            raise QueryShardError("[parent_id] no join field in mappings")
+        join, _ = info
+        # pure device rewrite: relation term AND parent-id term
+        rewritten = dsl.BoolQuery(
+            filter=[dsl.TermQuery(field=join, value=node.type),
+                    dsl.TermQuery(field=f"{join}#parent", value=node.id)],
+            boost=node.boost)
+        return self.compile(rewritten, seg, meta)
 
     # ------------------------------------------------- multi-term expansion
     def _expand_terms(self, seg, meta, field: str, predicate, boost: float) -> Plan:

@@ -97,6 +97,41 @@ class ExistsQuery(QueryNode):
 
 
 @dataclass
+class NestedQuery(QueryNode):
+    """Block-join over nested doc rows (index/query/NestedQueryBuilder.java)."""
+    path: str = ""
+    query: Optional["QueryNode"] = None
+    score_mode: str = "avg"          # avg | sum | min | max | none
+    ignore_unmapped: bool = False
+
+
+@dataclass
+class HasChildQuery(QueryNode):
+    """Parent-join: parents with a matching child (modules/parent-join)."""
+    type: str = ""
+    query: Optional["QueryNode"] = None
+    score_mode: str = "none"
+    min_children: int = 1
+    max_children: Optional[int] = None
+    ignore_unmapped: bool = False
+
+
+@dataclass
+class HasParentQuery(QueryNode):
+    type: str = ""                   # parent type
+    query: Optional["QueryNode"] = None
+    score: bool = False
+    ignore_unmapped: bool = False
+
+
+@dataclass
+class ParentIdQuery(QueryNode):
+    type: str = ""                   # child type
+    id: str = ""
+    ignore_unmapped: bool = False
+
+
+@dataclass
 class IdsQuery(QueryNode):
     values: Sequence[str] = ()
 
@@ -404,6 +439,49 @@ def parse_query(q: Any) -> QueryNode:
         if "field" not in body:
             raise ParsingError("[exists] must be provided with a [field]")
         return ExistsQuery(field=body["field"], boost=float(body.get("boost", 1.0)))
+
+    if name == "nested":
+        if "path" not in body or "query" not in body:
+            raise ParsingError("[nested] requires [path] and [query]")
+        return NestedQuery(path=body["path"],
+                           query=parse_query(body["query"]),
+                           score_mode=str(body.get("score_mode", "avg")),
+                           ignore_unmapped=bool(body.get("ignore_unmapped",
+                                                         False)),
+                           boost=float(body.get("boost", 1.0)))
+
+    if name == "has_child":
+        if "type" not in body or "query" not in body:
+            raise ParsingError("[has_child] requires [type] and [query]")
+        return HasChildQuery(type=body["type"],
+                             query=parse_query(body["query"]),
+                             score_mode=str(body.get("score_mode", "none")),
+                             min_children=int(body.get("min_children", 1)),
+                             max_children=(int(body["max_children"])
+                                           if body.get("max_children")
+                                           is not None else None),
+                             ignore_unmapped=bool(
+                                 body.get("ignore_unmapped", False)),
+                             boost=float(body.get("boost", 1.0)))
+
+    if name == "has_parent":
+        if "parent_type" not in body or "query" not in body:
+            raise ParsingError(
+                "[has_parent] requires [parent_type] and [query]")
+        return HasParentQuery(type=body["parent_type"],
+                              query=parse_query(body["query"]),
+                              score=bool(body.get("score", False)),
+                              ignore_unmapped=bool(
+                                  body.get("ignore_unmapped", False)),
+                              boost=float(body.get("boost", 1.0)))
+
+    if name == "parent_id":
+        if "type" not in body or "id" not in body:
+            raise ParsingError("[parent_id] requires [type] and [id]")
+        return ParentIdQuery(type=body["type"], id=str(body["id"]),
+                             ignore_unmapped=bool(
+                                 body.get("ignore_unmapped", False)),
+                             boost=float(body.get("boost", 1.0)))
 
     if name == "ids":
         return IdsQuery(values=list(body.get("values", [])),

@@ -150,7 +150,11 @@ def build_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
         scores, matches = _eval_plan(plan, seg, flat_inputs, cursor)
         d_pad = seg["live"].shape[0]
         in_seg = jnp.arange(d_pad, dtype=jnp.int32) < meta.num_docs
-        eligible = matches & seg["live"] & in_seg & (scores >= min_score)
+        # root: only top-level rows are returnable hits — nested child rows
+        # participate in scoring solely through the `nested` plan's join
+        # (Queries.newNonNestedFilter analog)
+        eligible = matches & seg["live"] & seg["root"] & in_seg \
+            & (scores >= min_score)
         total = jnp.sum(eligible.astype(jnp.int32))
         keys = scores if sort_mode == "score" else sort_key_arr
         masked = jnp.where(eligible, keys, NEG_INF)
@@ -330,7 +334,7 @@ def build_candidate_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
         valid_end = is_end & (sdoc < big)
         safe_end_docs = jnp.where(valid_end, sdoc, 0)
         eligible = valid_end & matches & seg["live"][safe_end_docs] \
-            & (score >= min_score)
+            & seg["root"][safe_end_docs] & (score >= min_score)
         total = jnp.sum(eligible.astype(jnp.int32))
         masked = jnp.where(eligible, score, NEG_INF)
         k_eff = min(k, n)
@@ -366,7 +370,8 @@ def build_batched_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
         cursor = [0]
         scores, matches = _eval_plan(plan, seg, flat_inputs, cursor)
         in_seg = jnp.arange(seg["live"].shape[0], dtype=jnp.int32) < meta.num_docs
-        eligible = matches & seg["live"] & in_seg & (scores >= min_score)
+        eligible = matches & seg["live"] & seg["root"] & in_seg \
+            & (scores >= min_score)
         total = jnp.sum(eligible.astype(jnp.int32))
         masked = jnp.where(eligible, scores, NEG_INF)
         k_eff = min(k, seg["live"].shape[0])

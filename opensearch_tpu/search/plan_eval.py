@@ -12,6 +12,7 @@ from typing import Dict, List
 import jax.numpy as jnp
 
 from opensearch_tpu.common.errors import QueryShardError
+from opensearch_tpu.ops.topk import NEG_INF
 from opensearch_tpu.ops.bm25 import (
     ordinal_terms_match, range_match_on_ranks, score_text_clause)
 from opensearch_tpu.search.compile import Plan
@@ -95,6 +96,37 @@ def _eval_plan(plan: Plan, seg: Dict, inputs: List[Dict], cursor: List[int]):
 
     if kind == "precomputed":
         return my["scores"], my["matches"]
+
+    if kind == "nested":
+        # block-join (ToParentBlockJoinQuery analog): evaluate the inner
+        # plan over nested child rows, scatter the verdict up to each
+        # child's root row, combine child scores by score_mode
+        score_mode = plan.static[0]
+        child_scores, child_matches = _eval_plan(plan.children[0], seg,
+                                                 inputs, cursor)
+        path_ok = (seg["nested_path"] == my["path_ord"]) \
+            & (my["path_ord"] >= 0)
+        sel = child_matches & path_ok & seg["live"]
+        idx = jnp.where(sel, seg["parent_ptr"], d_pad)
+        pmatch = jnp.zeros(d_pad, jnp.bool_).at[idx].max(sel, mode="drop")
+        if score_mode == "none":
+            # reference ScoreMode.None: matches contribute score 0
+            return jnp.zeros(d_pad, jnp.float32), pmatch
+        csel = jnp.where(sel, child_scores, 0.0)
+        psum = jnp.zeros(d_pad, jnp.float32).at[idx].add(csel, mode="drop")
+        if score_mode == "sum":
+            combined = psum
+        elif score_mode == "avg":
+            cnt = jnp.zeros(d_pad, jnp.float32).at[idx].add(
+                sel.astype(jnp.float32), mode="drop")
+            combined = psum / jnp.maximum(cnt, 1.0)
+        elif score_mode == "max":
+            combined = jnp.full(d_pad, NEG_INF, jnp.float32).at[idx].max(
+                jnp.where(sel, child_scores, NEG_INF), mode="drop")
+        else:   # min
+            combined = jnp.full(d_pad, -NEG_INF, jnp.float32).at[idx].min(
+                jnp.where(sel, child_scores, -NEG_INF), mode="drop")
+        return jnp.where(pmatch, combined * my["boost"], 0.0), pmatch
 
     if kind == "num_terms":
         col = seg["numeric"][plan.static[0]]

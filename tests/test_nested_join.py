@@ -1,0 +1,208 @@
+"""Nested fields/queries (block-join) + parent-join (has_child/has_parent).
+
+Reference: index/query/NestedQueryBuilder.java (ToParentBlockJoinQuery over
+doc blocks — children stored as adjacent hidden rows before the parent) and
+modules/parent-join (join field, has_child / has_parent / parent_id).
+"""
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture()
+def nested_node():
+    n = Node()
+    n.request("PUT", "/blog", {"mappings": {"properties": {
+        "title": {"type": "text"},
+        "comments": {"type": "nested", "properties": {
+            "author": {"type": "keyword"},
+            "stars": {"type": "integer"},
+            "text": {"type": "text"}}}}}})
+    n.request("PUT", "/blog/_doc/1", {
+        "title": "jax on tpus",
+        "comments": [
+            {"author": "alice", "stars": 5, "text": "great post"},
+            {"author": "bob", "stars": 1, "text": "meh"}]})
+    n.request("PUT", "/blog/_doc/2", {
+        "title": "columnar formats",
+        "comments": [{"author": "alice", "stars": 1,
+                      "text": "needs work"}]})
+    n.request("PUT", "/blog/_doc/3", {"title": "no comments here"})
+    n.request("POST", "/blog/_refresh")
+    return n
+
+
+class TestNested:
+    def test_match_all_counts_only_roots(self, nested_node):
+        out = nested_node.request("POST", "/blog/_search",
+                                  {"query": {"match_all": {}}, "size": 10})
+        assert out["hits"]["total"]["value"] == 3
+        assert {h["_id"] for h in out["hits"]["hits"]} == {"1", "2", "3"}
+
+    def test_nested_query_joins_children_to_parents(self, nested_node):
+        out = nested_node.request("POST", "/blog/_search", {
+            "query": {"nested": {"path": "comments", "query": {
+                "term": {"comments.author": "alice"}}}}})
+        assert {h["_id"] for h in out["hits"]["hits"]} == {"1", "2"}
+
+    def test_no_cross_object_leakage(self, nested_node):
+        """THE nested semantics test: alice+stars=1 only co-occur across
+        DIFFERENT comments of doc 1 — a flat object mapping would
+        (incorrectly) match it; nested must only match doc 2."""
+        body = {"query": {"nested": {"path": "comments", "query": {
+            "bool": {"must": [
+                {"term": {"comments.author": "alice"}},
+                {"range": {"comments.stars": {"lte": 1}}}]}}}}}
+        out = nested_node.request("POST", "/blog/_search", body)
+        assert [h["_id"] for h in out["hits"]["hits"]] == ["2"]
+
+    def test_score_modes(self, nested_node):
+        def score(mode):
+            out = nested_node.request("POST", "/blog/_search", {
+                "query": {"nested": {"path": "comments",
+                                     "score_mode": mode,
+                                     "query": {"match": {
+                                         "comments.author": "alice"}}}}})
+            return {h["_id"]: h["_score"] for h in out["hits"]["hits"]}
+        s_sum = score("sum")
+        s_max = score("max")
+        s_none = score("none")
+        assert set(s_sum) == {"1", "2"}
+        assert s_none["1"] == pytest.approx(0.0)
+        assert s_sum["1"] >= s_max["1"] > 0
+
+    def test_subfield_query_without_nested_matches_nothing(self,
+                                                          nested_node):
+        out = nested_node.request("POST", "/blog/_search", {
+            "query": {"term": {"comments.author": "alice"}}})
+        assert out["hits"]["total"]["value"] == 0
+
+    def test_delete_removes_whole_block(self, nested_node):
+        nested_node.request("DELETE", "/blog/_doc/1")
+        nested_node.request("POST", "/blog/_refresh")
+        out = nested_node.request("POST", "/blog/_search", {
+            "query": {"nested": {"path": "comments", "query": {
+                "term": {"comments.author": "bob"}}}}})
+        assert out["hits"]["total"]["value"] == 0
+
+    def test_nested_inside_bool_with_parent_field(self, nested_node):
+        out = nested_node.request("POST", "/blog/_search", {
+            "query": {"bool": {
+                "must": [{"match": {"title": "jax"}}],
+                "filter": [{"nested": {"path": "comments", "query": {
+                    "range": {"comments.stars": {"gte": 5}}}}}]}}})
+        assert [h["_id"] for h in out["hits"]["hits"]] == ["1"]
+
+    def test_source_preserved(self, nested_node):
+        got = nested_node.request("GET", "/blog/_doc/1")
+        assert len(got["_source"]["comments"]) == 2
+
+
+@pytest.fixture()
+def join_node():
+    n = Node()
+    n.request("PUT", "/qa", {"mappings": {"properties": {
+        "body": {"type": "text"},
+        "votes": {"type": "integer"},
+        "relation": {"type": "join",
+                     "relations": {"question": "answer"}}}}})
+    n.request("PUT", "/qa/_doc/q1",
+              {"body": "how to shard indexes", "relation": "question"})
+    n.request("PUT", "/qa/_doc/q2",
+              {"body": "why is my query slow", "relation": "question"})
+    n.request("PUT", "/qa/_doc/a1",
+              {"body": "use routing", "votes": 3,
+               "relation": {"name": "answer", "parent": "q1"}},
+              routing="q1")
+    n.request("PUT", "/qa/_doc/a2",
+              {"body": "more shards", "votes": 1,
+               "relation": {"name": "answer", "parent": "q1"}},
+              routing="q1")
+    n.request("PUT", "/qa/_doc/a3",
+              {"body": "add a profiler", "votes": 9,
+               "relation": {"name": "answer", "parent": "q2"}},
+              routing="q2")
+    n.request("POST", "/qa/_refresh")
+    return n
+
+
+class TestParentJoin:
+    def test_has_child(self, join_node):
+        out = join_node.request("POST", "/qa/_search", {
+            "query": {"has_child": {"type": "answer", "query": {
+                "range": {"votes": {"gte": 5}}}}}})
+        assert [h["_id"] for h in out["hits"]["hits"]] == ["q2"]
+
+    def test_has_child_min_children(self, join_node):
+        out = join_node.request("POST", "/qa/_search", {
+            "query": {"has_child": {"type": "answer", "min_children": 2,
+                                    "query": {"match_all": {}}}}})
+        assert [h["_id"] for h in out["hits"]["hits"]] == ["q1"]
+
+    def test_has_parent(self, join_node):
+        out = join_node.request("POST", "/qa/_search", {
+            "query": {"has_parent": {"parent_type": "question", "query": {
+                "match": {"body": "shard"}}}}})
+        assert {h["_id"] for h in out["hits"]["hits"]} == {"a1", "a2"}
+
+    def test_parent_id(self, join_node):
+        out = join_node.request("POST", "/qa/_search", {
+            "query": {"parent_id": {"type": "answer", "id": "q1"}}})
+        assert {h["_id"] for h in out["hits"]["hits"]} == {"a1", "a2"}
+
+    def test_join_across_segments(self, join_node):
+        # a new answer lands in a LATER segment than its parent: the join
+        # must still see it (host join is shard-wide, not per-segment)
+        join_node.request("PUT", "/qa/_doc/a4",
+                          {"body": "late answer", "votes": 7,
+                           "relation": {"name": "answer", "parent": "q2"}},
+                          routing="q2")
+        join_node.request("POST", "/qa/_refresh")
+        out = join_node.request("POST", "/qa/_search", {
+            "query": {"has_child": {"type": "answer", "min_children": 2,
+                                    "query": {"match_all": {}}}}})
+        assert {h["_id"] for h in out["hits"]["hits"]} == {"q1", "q2"}
+
+    def test_relation_term_query(self, join_node):
+        out = join_node.request("POST", "/qa/_search", {
+            "query": {"term": {"relation": "question"}}, "size": 10})
+        assert out["hits"]["total"]["value"] == 2
+
+
+class TestNestedAggs:
+    def test_nested_agg_counts_children(self, nested_node):
+        out = nested_node.request("POST", "/blog/_search", {
+            "size": 0, "query": {"match_all": {}},
+            "aggs": {"c": {"nested": {"path": "comments"},
+                     "aggs": {"by_author": {"terms": {"field":
+                                            "comments.author"}},
+                              "avg_stars": {"avg": {"field":
+                                            "comments.stars"}}}}}})
+        agg = out["aggregations"]["c"]
+        assert agg["doc_count"] == 3     # 3 comment rows across 3 roots
+        buckets = {b["key"]: b["doc_count"]
+                   for b in agg["by_author"]["buckets"]}
+        assert buckets == {"alice": 2, "bob": 1}
+        assert agg["avg_stars"]["value"] == pytest.approx((5 + 1 + 1) / 3)
+
+    def test_nested_agg_respects_query(self, nested_node):
+        out = nested_node.request("POST", "/blog/_search", {
+            "size": 0, "query": {"match": {"title": "jax"}},
+            "aggs": {"c": {"nested": {"path": "comments"},
+                     "aggs": {"mx": {"max": {"field": "comments.stars"}}}}}})
+        agg = out["aggregations"]["c"]
+        assert agg["doc_count"] == 2        # only doc 1's comments
+        assert agg["mx"]["value"] == 5.0
+
+    def test_reverse_nested(self, nested_node):
+        out = nested_node.request("POST", "/blog/_search", {
+            "size": 0, "query": {"match_all": {}},
+            "aggs": {"c": {"nested": {"path": "comments"},
+                     "aggs": {"by_author": {
+                         "terms": {"field": "comments.author"},
+                         "aggs": {"roots": {"reverse_nested": {}}}}}}}})
+        by_author = out["aggregations"]["c"]["by_author"]["buckets"]
+        roots = {b["key"]: b["roots"]["doc_count"] for b in by_author}
+        # alice commented on 2 distinct posts, bob on 1
+        assert roots == {"alice": 2, "bob": 1}
