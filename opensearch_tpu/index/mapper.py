@@ -44,7 +44,15 @@ DATE_TYPES = {"date", "date_nanos"}
 VECTOR_TYPES = {"knn_vector", "dense_vector"}
 BOOL_TYPES = {"boolean"}
 IP_TYPES = {"ip"}
-RANGE_TYPES = {"integer_range", "long_range", "float_range", "double_range", "date_range"}
+RANGE_TYPES = {"integer_range", "long_range", "float_range", "double_range",
+               "date_range", "ip_range"}
+# range type -> element type of the hidden #lo / #hi bound columns
+_RANGE_ELEM = {"integer_range": "integer", "long_range": "long",
+               "float_range": "float", "double_range": "double",
+               "date_range": "date", "ip_range": "ip"}
+# inclusive-bound adjustment step for exclusive gt/lt on discrete elements
+_RANGE_STEP = {"integer": 1.0, "long": 1.0, "date": 1.0, "ip": 1.0}
+RANGE_UNBOUNDED = 1e308
 GEO_TYPES = {"geo_point"}
 
 _INT_BOUNDS = {
@@ -151,6 +159,10 @@ class MappedFieldType:
     @property
     def is_bool(self):
         return self.type in BOOL_TYPES
+
+    @property
+    def is_range(self):
+        return self.type in RANGE_TYPES
 
     @property
     def is_ip(self):
@@ -326,11 +338,18 @@ class MapperService:
     def _put_field(self, full_name: str, spec: dict):
         ftype = spec.get("type")
         known = (TEXT_TYPES | KEYWORD_TYPES | NUMERIC_TYPES | DATE_TYPES | VECTOR_TYPES
-                 | BOOL_TYPES | IP_TYPES | GEO_TYPES
+                 | BOOL_TYPES | IP_TYPES | GEO_TYPES | RANGE_TYPES
                  | {"object", "binary", "percolator"})
         if ftype not in known:
             raise MapperParsingError(
                 f"No handler for type [{ftype}] declared on field [{full_name.split('.')[-1]}]")
+        if ftype in RANGE_TYPES and not full_name.endswith(("#lo", "#hi")):
+            # hidden inclusive-bound columns back every range field
+            # (reference RangeFieldMapper encodes ranges in BinaryDocValues;
+            # two numeric columns give the same query power on device)
+            elem = _RANGE_ELEM[ftype]
+            self._put_field(f"{full_name}#lo", {"type": elem, **({"format": spec["format"]} if "format" in spec else {})})
+            self._put_field(f"{full_name}#hi", {"type": elem, **({"format": spec["format"]} if "format" in spec else {})})
         existing = self.field_types.get(full_name)
         if existing is not None and existing.type != ftype:
             raise IllegalArgumentError(
@@ -438,6 +457,9 @@ class MapperService:
                 # stored-query field: kept in _source only, matched at
                 # percolate time (modules/percolator PercolatorFieldMapper)
                 continue
+            if ft is not None and ft.is_range:
+                self._parse_range(full, ft, value, out)
+                continue
             if full == self.join_field and children is not None:
                 # join value: "parent_type" or {"name": t, "parent": id}
                 if isinstance(value, dict):
@@ -474,6 +496,46 @@ class MapperService:
                     self._parse_object(f"{full}.", v, out, children)
             else:
                 self._parse_value(full, value, out)
+
+    def _parse_range(self, name: str, ft: MappedFieldType, value: Any,
+                     out: Dict[str, ParsedField]):
+        """Range value(s) {gte/gt/lte/lt} -> inclusive bounds in the hidden
+        #lo / #hi columns (RangeFieldMapper analog); exclusive bounds shift
+        by one step on discrete elements, one ulp on floats."""
+        elem_ft = self.field_types[f"{name}#lo"]
+        step = _RANGE_STEP.get(elem_ft.type, 0.0)
+
+        def conv(v):
+            if elem_ft.is_date:
+                return float(parse_date_millis(v, elem_ft.fmt))
+            if elem_ft.is_ip:
+                return float(ip_to_long(v))
+            return elem_ft.parse_numeric(v)
+
+        lo_pf = out.setdefault(f"{name}#lo", ParsedField())
+        hi_pf = out.setdefault(f"{name}#hi", ParsedField())
+        lo_pf.numeric_values = lo_pf.numeric_values or []
+        hi_pf.numeric_values = hi_pf.numeric_values or []
+        for elem in (value if isinstance(value, list) else [value]):
+            if elem is None:
+                continue
+            if not isinstance(elem, dict):
+                raise MapperParsingError(
+                    f"error parsing field [{name}], expected an object "
+                    f"with gte/gt/lte/lt bounds")
+            lo, hi = -RANGE_UNBOUNDED, RANGE_UNBOUNDED
+            if elem.get("gte") is not None:
+                lo = conv(elem["gte"])
+            if elem.get("gt") is not None:
+                v = conv(elem["gt"])
+                lo = v + step if step else math.nextafter(v, math.inf)
+            if elem.get("lte") is not None:
+                hi = conv(elem["lte"])
+            if elem.get("lt") is not None:
+                v = conv(elem["lt"])
+                hi = v - step if step else math.nextafter(v, -math.inf)
+            lo_pf.numeric_values.append(lo)
+            hi_pf.numeric_values.append(hi)
 
     def _dynamic_map(self, name: str, value: Any):
         if self.dynamic in (False, "false", "strict"):

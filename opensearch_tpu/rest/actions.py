@@ -13,6 +13,10 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
+from opensearch_tpu.search import dsl
+
 from opensearch_tpu.common.errors import (
     IllegalArgumentError, IndexNotFoundError, OpenSearchTpuError)
 from opensearch_tpu.rest.controller import RestRequest, RestResponse
@@ -399,6 +403,136 @@ def register_search_actions(node, c):
             out = _run_search(node, req.param("index"), body)
         return _total_as_int(out) if as_int else out
 
+    def do_field_caps(req):
+        """_field_caps: per-field search/aggregation capabilities across
+        indices (reference: action/fieldcaps/TransportFieldCapabilities
+        Action — merges per-index mapper views)."""
+        expr = req.param("index")
+        names = node.indices.resolve(expr) if expr \
+            else list(node.indices.indices)
+        patterns = (req.param("fields")
+                    or (req.body or {}).get("fields") or "*")
+        if isinstance(patterns, str):
+            patterns = patterns.split(",")
+        import fnmatch as _fn
+        fields: Dict[str, dict] = {}
+        for n in names:
+            mapper = node.indices.get(n).mapper
+            for fname, ft in mapper.field_types.items():
+                if "#" in fname:
+                    continue    # hidden columns (join parent id)
+                if not any(_fn.fnmatchcase(fname, p) for p in patterns):
+                    continue
+                searchable = bool(ft.index)
+                aggregatable = bool(ft.doc_values) and not ft.is_text
+                caps = fields.setdefault(fname, {}).setdefault(
+                    ft.type, {"type": ft.type,
+                              "searchable": searchable,
+                              "aggregatable": aggregatable})
+                caps["searchable"] = caps["searchable"] or searchable
+                caps["aggregatable"] = caps["aggregatable"] or aggregatable
+        return {"indices": sorted(names), "fields": fields}
+
+    def do_termvectors(req):
+        """_termvectors: per-field term statistics for one document
+        (reference: action/termvectors/TransportTermVectorsAction). Terms,
+        freqs and positions come from the live segment postings."""
+        index = req.param("index")
+        doc_id = req.param("id")
+        names = node.indices.resolve(index, allow_aliases=True)
+        if not names:
+            from opensearch_tpu.common.errors import IndexNotFoundError
+            raise IndexNotFoundError(index)
+        svc = node.indices.get(names[0])
+        shard = svc.shard_for(doc_id, routing=req.param("routing"))
+        shard.refresh()
+        wanted = req.param("fields")
+        wanted = wanted.split(",") if wanted else None
+        found = False
+        term_vectors: Dict[str, dict] = {}
+        for seg in shard.engine.segments:
+            ord_ = seg.ord_of(doc_id)
+            if ord_ is None:
+                continue
+            found = True
+            for (field, term), tm in seg.term_dict.items():
+                if "#" in field or (wanted and field not in wanted):
+                    continue
+                ft = svc.mapper.get_field(field)
+                if ft is None or not ft.is_text:
+                    continue
+                blocks = seg.post_docs[
+                    tm.start_block:tm.start_block + tm.num_blocks].ravel()
+                hits = (blocks == ord_)
+                if not hits.any():
+                    continue
+                entry_i = int(np.nonzero(blocks == ord_)[0][0])
+                tf = int(seg.post_tf[
+                    tm.start_block:tm.start_block
+                    + tm.num_blocks].ravel()[entry_i])
+                tinfo = {"term_freq": tf, "doc_freq": tm.doc_freq,
+                         "ttf": tm.total_term_freq}
+                pos_lists = seg.positions.get((field, term))
+                if pos_lists is not None:
+                    # positions parallel the postings entries
+                    valid_i = int(np.count_nonzero(
+                        (blocks >= 0) & (np.arange(len(blocks))
+                                         < entry_i)))
+                    if valid_i < len(pos_lists):
+                        tinfo["tokens"] = [
+                            {"position": int(p)}
+                            for p in pos_lists[valid_i]]
+                fld = term_vectors.setdefault(field, {
+                    "field_statistics": {
+                        "doc_count":
+                            seg.field_stats[field].doc_count,
+                        "sum_doc_freq":
+                            seg.field_stats[field].sum_doc_freq,
+                        "sum_ttf":
+                            seg.field_stats[field].sum_total_term_freq},
+                    "terms": {}})
+                fld["terms"][term] = tinfo
+            break
+        return {"_index": names[0], "_id": doc_id, "found": found,
+                "term_vectors": term_vectors}
+
+    def do_validate_query(req):
+        """_validate/query: parse + compile the query without running it
+        (reference: action/admin/indices/validate/query)."""
+        body = req.body or {}
+        q = body.get("query", {"match_all": {}})
+        explain = req.param("explain") == "true"
+        expr = req.param("index")
+        # a missing index is a 404, not an invalid query
+        names = node.indices.resolve(expr, allow_no_indices=False) \
+            if expr else []
+        try:
+            query_node = dsl.parse_query(q)
+            for n in names:
+                svc = node.indices.get(n)
+                shard = svc.shards[0]
+                shard.refresh()
+                from opensearch_tpu.search.compile import Compiler
+                reader = shard.executor.reader
+                compiler = Compiler(reader.mapper, reader.stats())
+                for seg, (arrays, meta) in zip(reader.segments,
+                                               reader.device):
+                    compiler.compile(query_node, seg, meta)
+        except OpenSearchTpuError as e:
+            out = {"valid": False,
+                   "_shards": {"total": 1, "successful": 1, "failed": 0}}
+            if explain:
+                out["explanations"] = [{"index": expr, "valid": False,
+                                        "error": str(e)}]
+            return out
+        out = {"valid": True,
+               "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        if explain:
+            out["explanations"] = [{"index": n, "valid": True,
+                                    "explanation": str(body.get("query"))}
+                                   for n in (names or [expr])]
+        return out
+
     def do_explain(req):
         """_explain/{id}: score explanation for one document (reference:
         action/explain/TransportExplainAction — a single-shard query
@@ -544,6 +678,16 @@ def register_search_actions(node, c):
     c.register("POST", "/{index}/_msearch", do_msearch)
     c.register("GET", "/{index}/_explain/{id}", do_explain)
     c.register("POST", "/{index}/_explain/{id}", do_explain)
+    c.register("GET", "/_field_caps", do_field_caps)
+    c.register("POST", "/_field_caps", do_field_caps)
+    c.register("GET", "/{index}/_field_caps", do_field_caps)
+    c.register("POST", "/{index}/_field_caps", do_field_caps)
+    c.register("GET", "/{index}/_termvectors/{id}", do_termvectors)
+    c.register("POST", "/{index}/_termvectors/{id}", do_termvectors)
+    c.register("GET", "/_validate/query", do_validate_query)
+    c.register("POST", "/_validate/query", do_validate_query)
+    c.register("GET", "/{index}/_validate/query", do_validate_query)
+    c.register("POST", "/{index}/_validate/query", do_validate_query)
     c.register("GET", "/_search/scroll", do_scroll)
     c.register("POST", "/_search/scroll", do_scroll)
     c.register("POST", "/_search/scroll/{scroll_id}", do_scroll)
@@ -1021,8 +1165,53 @@ def register_cluster_actions(node, c):
     c.register("PUT", "/_cluster/settings", do_cluster_settings_put)
     c.register("GET", "/_cluster/stats", do_cluster_stats)
     c.register("GET", "/_cluster/state", do_cluster_state)
+    def do_hot_threads(req):
+        """_nodes/hot_threads analog (monitor/jvm/HotThreads.java): sample
+        every live Python thread's stack N times and report the hottest
+        frames by sample count — same contract, interpreter threads
+        instead of JVM threads."""
+        import sys
+        import threading
+        import time as _time
+        import traceback as _tb
+        from collections import Counter
+
+        try:
+            samples = max(1, min(int(req.param("snapshots", "3")), 10))
+            top_n = int(req.param("threads", "3"))
+        except (TypeError, ValueError):
+            raise IllegalArgumentError(
+                "snapshots/threads must be integers")
+        interval_s = 0.02
+        per_thread: Dict[int, Counter] = {}
+        names = {t.ident: t.name for t in threading.enumerate()}
+        self_tid = threading.get_ident()
+        for i in range(samples):
+            for tid, frame in sys._current_frames().items():
+                if tid == self_tid:
+                    continue    # the sampler is always on-CPU (ref
+                    # HotThreads excludes itself the same way)
+                stack = "".join(_tb.format_stack(frame, limit=8))
+                per_thread.setdefault(tid, Counter())[stack] += 1
+            if i + 1 < samples:
+                _time.sleep(interval_s)
+        lines = [f"::: {{{node.node_name}}}{{{node.node_id}}}", ""]
+        ranked = sorted(per_thread.items(),
+                        key=lambda kv: -sum(kv[1].values()))
+        for tid, stacks in ranked[:top_n]:
+            top_stack, hits = stacks.most_common(1)[0]
+            lines.append(
+                f"   {hits}/{samples} snapshots sharing following "
+                f"fragment of thread [{names.get(tid, tid)}]:")
+            lines.append(top_stack.rstrip())
+            lines.append("")
+        return RestResponse(200, "\n".join(lines) + "\n",
+                            content_type="text/plain")
+
     c.register("GET", "/_nodes", do_nodes_info)
     c.register("GET", "/_nodes/stats", do_nodes_stats)
+    c.register("GET", "/_nodes/hot_threads", do_hot_threads)
+    c.register("GET", "/_nodes/{node_id}/hot_threads", do_hot_threads)
 
 
 # --------------------------------------------------------------------- _cat

@@ -338,6 +338,12 @@ def _c_nested(node: AggNode, ctx: _Ctx) -> AggPlan:
 
 
 def _c_reverse_nested(node: AggNode, ctx: _Ctx) -> AggPlan:
+    if (node.body or {}).get("path"):
+        # intermediate-level join-back needs hierarchical parent
+        # pointers the flat block encoding doesn't keep — refuse loudly
+        raise QueryShardError(
+            "[reverse_nested] with an explicit [path] is not supported; "
+            "omit path to join back to the root level")
     children = [_compile_node(c, ctx) for c in node.children]
     return AggPlan(node.name, "reverse_nested", children=children,
                    render={"kind": "filter"})

@@ -223,6 +223,14 @@ class Compiler:
         ft = self.mapper.get_field(node.field)
         if ft is None:
             return MATCH_NONE
+        if ft.is_range:
+            # containment: lo <= v AND hi >= v over the hidden bound
+            # columns (RangeFieldMapper's point-containment query)
+            f = node.field
+            return self.compile(dsl.BoolQuery(
+                filter=[dsl.RangeQuery(field=f"{f}#lo", lte=node.value),
+                        dsl.RangeQuery(field=f"{f}#hi", gte=node.value)],
+                boost=node.boost), seg, meta)
         if ft.is_numeric or ft.is_date:
             return self._numeric_term(seg, node.field, ft, [node.value], node.boost)
         value = str(node.value)
@@ -274,6 +282,8 @@ class Compiler:
         ft = self.mapper.get_field(node.field)
         if ft is None:
             return MATCH_NONE
+        if ft.is_range:
+            return self._range_field_query(node, seg, meta)
         if ft.is_keyword:
             col = seg.ordinal_dv.get(node.field)
             if col is None:
@@ -293,9 +303,9 @@ class Compiler:
         if col is None:
             return MATCH_NONE
 
-        def bound(value, is_date_math_upper=False):
+        def bound(value, round_up=False):
             if ft.is_date and isinstance(value, str) and ("now" in value or "||" in value):
-                value = _resolve_date_math(value)
+                value = _resolve_date_math(value, round_up=round_up)
             return ft.to_comparable(value)
 
         lo_rank = 0
@@ -303,9 +313,11 @@ class Compiler:
         if node.gte is not None:
             lo_rank = int(np.searchsorted(col.unique, bound(node.gte), "left"))
         elif node.gt is not None:
-            lo_rank = int(np.searchsorted(col.unique, bound(node.gt), "right"))
+            lo_rank = int(np.searchsorted(
+                col.unique, bound(node.gt, round_up=True), "right"))
         if node.lte is not None:
-            hi_rank = int(np.searchsorted(col.unique, bound(node.lte), "right"))
+            hi_rank = int(np.searchsorted(
+                col.unique, bound(node.lte, round_up=True), "right"))
         elif node.lt is not None:
             hi_rank = int(np.searchsorted(col.unique, bound(node.lt), "left"))
         return Plan("range_num", static=(node.field,), inputs={
@@ -352,8 +364,85 @@ class Compiler:
     def _c_MatchNoneQuery(self, node, seg, meta) -> Plan:
         return MATCH_NONE
 
+    def _range_field_query(self, node: dsl.RangeQuery, seg, meta) -> Plan:
+        """Range query against a range FIELD: relation semantics over the
+        hidden bound columns (RangeFieldMapper intersects/within/contains).
+        q = [qlo, qhi] (either side optionally exclusive/unbounded),
+        doc = [lo, hi]:
+          intersects: lo <= qhi AND hi >= qlo
+          within:     lo >= qlo AND hi <= qhi
+          contains:   lo <= qlo AND hi >= qhi
+        """
+        f = node.field
+        relation = (getattr(node, "relation", None) or "intersects").lower()
+        filters = []
+        if relation == "intersects":
+            if node.lte is not None or node.lt is not None:
+                filters.append(dsl.RangeQuery(field=f"{f}#lo",
+                                              lte=node.lte, lt=node.lt))
+            if node.gte is not None or node.gt is not None:
+                filters.append(dsl.RangeQuery(field=f"{f}#hi",
+                                              gte=node.gte, gt=node.gt))
+        elif relation == "within":
+            if node.gte is not None or node.gt is not None:
+                filters.append(dsl.RangeQuery(field=f"{f}#lo",
+                                              gte=node.gte, gt=node.gt))
+            if node.lte is not None or node.lt is not None:
+                filters.append(dsl.RangeQuery(field=f"{f}#hi",
+                                              lte=node.lte, lt=node.lt))
+        elif relation == "contains":
+            # query ⊆ doc: an exclusive query bound moves one element
+            # inward before comparing against the doc's inclusive bounds
+            if node.gte is not None:
+                filters.append(dsl.RangeQuery(field=f"{f}#lo",
+                                              lte=node.gte))
+            if node.gt is not None:
+                filters.append(dsl.RangeQuery(
+                    field=f"{f}#lo",
+                    lte=self._range_elem_step(node.field, node.gt, +1)))
+            if node.lte is not None:
+                filters.append(dsl.RangeQuery(field=f"{f}#hi",
+                                              gte=node.lte))
+            if node.lt is not None:
+                filters.append(dsl.RangeQuery(
+                    field=f"{f}#hi",
+                    gte=self._range_elem_step(node.field, node.lt, -1)))
+        else:
+            raise QueryShardError(
+                f"[range] unknown relation [{relation}]")
+        if not filters:
+            filters.append(dsl.ExistsQuery(field=f"{f}#lo"))
+        return self.compile(dsl.BoolQuery(filter=filters,
+                                          boost=node.boost), seg, meta)
+
+    def _range_elem_step(self, field: str, value: Any, direction: int):
+        """Move a range-field query bound one element inward (ints/dates/
+        ips step by 1, floats by one ulp) — exclusive→inclusive for the
+        `contains` relation."""
+        import math as _math
+        from opensearch_tpu.index.mapper import (_RANGE_ELEM, ip_to_long,
+                                                 parse_date_millis)
+        ft = self.mapper.get_field(field)
+        elem = _RANGE_ELEM.get(ft.type, "double")
+        if elem == "date":
+            if isinstance(value, str) and ("now" in value
+                                           or "||" in value):
+                value = _resolve_date_math(value,
+                                           round_up=direction > 0)
+            v = float(parse_date_millis(value))
+        elif elem == "ip":
+            v = float(ip_to_long(value))
+        else:
+            v = float(value)
+        if elem in ("float", "double"):
+            return _math.nextafter(v, _math.inf * direction)
+        return v + direction
+
     def _c_ExistsQuery(self, node: dsl.ExistsQuery, seg, meta) -> Plan:
         field = node.field
+        ft = self.mapper.get_field(field)
+        if ft is not None and ft.is_range:
+            field = f"{field}#lo"   # range fields live in bound columns
         if field in seg.numeric_dv:
             return Plan("exists", static=("numeric", field),
                         inputs={"boost": _f32(node.boost)})
@@ -396,6 +485,30 @@ class Compiler:
         if node.score_mode not in ("avg", "sum", "min", "max", "none"):
             raise QueryShardError(
                 f"[nested] unknown score_mode [{node.score_mode}]")
+
+        def has_nested(n) -> bool:
+            if isinstance(n, dsl.NestedQuery):
+                return True
+            for attr in ("query", "must", "should", "must_not", "filter",
+                         "queries"):
+                sub = getattr(n, attr, None)
+                if isinstance(sub, dsl.QueryNode) and has_nested(sub):
+                    return True
+                if isinstance(sub, (list, tuple)) and any(
+                        isinstance(s, dsl.QueryNode) and has_nested(s)
+                        for s in sub):
+                    return True
+            return False
+
+        if has_nested(node.query):
+            # the flat block encoding joins every nested row straight to
+            # its root, so an outer nested cannot see an inner nested's
+            # join — refuse loudly rather than silently matching nothing;
+            # querying the deepest path directly is equivalent here
+            raise QueryShardError(
+                f"[nested] queries nested inside [nested] are not "
+                f"supported; query path [{node.path}]'s deepest nested "
+                f"path directly instead")
         inner = self.compile(node.query, seg, meta)
         paths = getattr(seg, "nested_paths", [])
         path_ord = paths.index(node.path) if node.path in paths else -1
@@ -1101,8 +1214,10 @@ class Compiler:
 
 # ------------------------------------------------------------------ helpers
 
-def _resolve_date_math(expr: str) -> Any:
-    """Minimal date-math: 'now', 'now-7d', 'now/d', '<date>||-1M/d'."""
+def _resolve_date_math(expr: str, round_up: bool = False) -> Any:
+    """Minimal date-math: 'now', 'now-7d', 'now/d', '<date>||-1M/d'.
+    `round_up` gives the END of the rounded unit (reference: gt and lte
+    bounds round up, gte and lt round down — DateMathParser.java)."""
     import datetime as _dt
     from opensearch_tpu.index.mapper import parse_date_millis
     if "||" in expr:
@@ -1120,6 +1235,8 @@ def _resolve_date_math(expr: str) -> Any:
         op, num, unit = m.groups()
         if op == "/":
             base = (base // units_ms[unit]) * units_ms[unit]
+            if round_up:
+                base += units_ms[unit] - 1
         else:
             delta = int(num or 1) * units_ms[unit]
             base = base + delta if op == "+" else base - delta
@@ -1231,7 +1348,10 @@ def _parse_query_string(query: str, default_field: str, fields: List[str],
                         simple: bool = False) -> dsl.QueryNode:
     """Minimal Lucene-syntax parser: terms, "phrases", field:term, +req, -not,
     AND/OR/NOT. Reference: lang in index/query/QueryStringQueryBuilder.java."""
-    tokens = re.findall(r'"[^"]*"|\S+', query or "")
+    # bracket ranges (field:[a TO b] / field:{a TO b}) span whitespace and
+    # must tokenize as one unit
+    tokens = re.findall(
+        r'"[^"]*"|[+\-]?[\w.*]+:[\[{][^\]}]*[\]}]|\S+', query or "")
     must: List[dsl.QueryNode] = []
     should: List[dsl.QueryNode] = []
     must_not: List[dsl.QueryNode] = []
@@ -1280,9 +1400,21 @@ def _parse_query_string(query: str, default_field: str, fields: List[str],
             req, text = True, text[1:]
         if ":" in text and not text.startswith('"'):
             fname, _, rest = text.partition(":")
-            node = (dsl.MatchPhraseQuery(field=fname, query=rest[1:-1])
-                    if rest.startswith('"') else
-                    dsl.MatchQuery(field=fname, query=rest))
+            range_m = re.fullmatch(
+                r'([\[{])\s*(\S+)\s+TO\s+(\S+)\s*([\]}])', rest,
+                flags=re.IGNORECASE)
+            if range_m:
+                lb, lo, hi, rb = range_m.groups()
+                kwargs = {}
+                if lo != "*":
+                    kwargs["gte" if lb == "[" else "gt"] = lo
+                if hi != "*":
+                    kwargs["lte" if rb == "]" else "lt"] = hi
+                node = dsl.RangeQuery(field=fname, **kwargs)
+            elif rest.startswith('"'):
+                node = dsl.MatchPhraseQuery(field=fname, query=rest[1:-1])
+            else:
+                node = dsl.MatchQuery(field=fname, query=rest)
         else:
             node = leaf(text)
         if neg:

@@ -89,6 +89,7 @@ class RangeQuery(QueryNode):
     lt: Any = None
     fmt: Optional[str] = None
     time_zone: Optional[str] = None
+    relation: Optional[str] = None   # range FIELDS: intersects|within|contains
 
 
 @dataclass
@@ -433,6 +434,7 @@ def parse_query(q: Any) -> QueryNode:
                 lt = spec["to"]
         return RangeQuery(field=field, gte=gte, gt=gt, lte=lte, lt=lt,
                           fmt=spec.get("format"), time_zone=spec.get("time_zone"),
+                          relation=spec.get("relation"),
                           boost=float(spec.get("boost", 1.0)))
 
     if name == "exists":

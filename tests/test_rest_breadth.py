@@ -1,0 +1,168 @@
+"""REST breadth: _field_caps, _termvectors, _validate/query, hot_threads.
+
+Reference: action/fieldcaps/, action/termvectors/,
+action/admin/indices/validate/query/, monitor/jvm/HotThreads.java.
+"""
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture(scope="module")
+def node():
+    n = Node()
+    n.request("PUT", "/lib", {"mappings": {"properties": {
+        "title": {"type": "text"},
+        "genre": {"type": "keyword"},
+        "year": {"type": "integer"}}}})
+    n.request("PUT", "/lib2", {"mappings": {"properties": {
+        "title": {"type": "text"},
+        "year": {"type": "long"}}}})
+    n.request("PUT", "/lib/_doc/1",
+              {"title": "the art of sharding", "genre": "tech",
+               "year": 2020})
+    n.request("POST", "/lib/_refresh")
+    return n
+
+
+class TestFieldCaps:
+    def test_all_fields(self, node):
+        out = node.request("GET", "/lib/_field_caps", fields="*")
+        assert out["indices"] == ["lib"]
+        assert out["fields"]["genre"]["keyword"]["aggregatable"] is True
+        assert out["fields"]["title"]["text"]["searchable"] is True
+        assert out["fields"]["title"]["text"]["aggregatable"] is False
+
+    def test_cross_index_type_conflict(self, node):
+        out = node.request("GET", "/lib,lib2/_field_caps", fields="year")
+        assert set(out["fields"]["year"]) == {"integer", "long"}
+
+    def test_field_pattern(self, node):
+        out = node.request("GET", "/lib/_field_caps", fields="ti*")
+        assert list(out["fields"]) == ["title"]
+
+
+class TestTermvectors:
+    def test_basic(self, node):
+        out = node.request("GET", "/lib/_termvectors/1")
+        assert out["found"] is True
+        terms = out["term_vectors"]["title"]["terms"]
+        assert set(terms) == {"the", "art", "of", "sharding"}
+        assert terms["sharding"]["term_freq"] == 1
+        assert terms["sharding"]["tokens"] == [{"position": 3}]
+        fs = out["term_vectors"]["title"]["field_statistics"]
+        assert fs["doc_count"] == 1 and fs["sum_ttf"] == 4
+
+    def test_missing_doc(self, node):
+        out = node.request("GET", "/lib/_termvectors/nope")
+        assert out["found"] is False
+
+    def test_fields_filter(self, node):
+        out = node.request("GET", "/lib/_termvectors/1", fields="title")
+        assert list(out["term_vectors"]) == ["title"]
+
+
+class TestValidateQuery:
+    def test_valid(self, node):
+        out = node.request("POST", "/lib/_validate/query",
+                           {"query": {"match": {"title": "art"}}})
+        assert out["valid"] is True
+
+    def test_invalid(self, node):
+        out = node.request("POST", "/lib/_validate/query",
+                           {"query": {"frobnicate": {"x": 1}}})
+        assert out["valid"] is False
+
+    def test_explain_lists_error(self, node):
+        out = node.request("POST", "/lib/_validate/query",
+                           {"query": {"frobnicate": {"x": 1}}},
+                           explain="true")
+        assert out["valid"] is False
+        assert "frobnicate" in out["explanations"][0]["error"]
+
+
+class TestHotThreads:
+    def test_returns_stack_samples(self, node):
+        # the sampler excludes itself, so give it a busy thread to see
+        import threading
+        import time
+
+        stop = threading.Event()
+
+        def burn():
+            while not stop.is_set():
+                sum(range(1000))
+
+        t = threading.Thread(target=burn, name="burner", daemon=True)
+        t.start()
+        try:
+            r = node.handle("GET", "/_nodes/hot_threads")
+        finally:
+            stop.set()
+            t.join(2)
+        assert r.status == 200
+        assert "snapshots sharing following fragment" in r.body
+        assert node.node_name in r.body
+        assert "burner" in r.body
+
+
+class TestRangeFields:
+    """Range field types (RangeFieldMapper): point containment via term,
+    relation semantics via range (reference: range/10_basic.yml)."""
+
+    @pytest.fixture(scope="class")
+    def rnode(self):
+        n = Node()
+        n.request("PUT", "/spans", {"mappings": {"properties": {
+            "ir": {"type": "integer_range"},
+            "dr": {"type": "date_range"}}}})
+        n.request("PUT", "/spans/_doc/1",
+                  {"ir": {"gte": 10, "lte": 20},
+                   "dr": {"gte": "2024-01-01", "lt": "2024-02-01"}})
+        n.request("PUT", "/spans/_doc/2", {"ir": {"gt": 20, "lte": 30}})
+        n.request("PUT", "/spans/_doc/3", {"ir": {"gte": 5}})
+        n.request("POST", "/spans/_refresh")
+        return n
+
+    def test_term_containment(self, rnode):
+        out = rnode.request("POST", "/spans/_search", {
+            "query": {"term": {"ir": 15}}, "size": 10})
+        assert {h["_id"] for h in out["hits"]["hits"]} == {"1", "3"}
+        out = rnode.request("POST", "/spans/_search", {
+            "query": {"term": {"ir": 21}}, "size": 10})
+        assert {h["_id"] for h in out["hits"]["hits"]} == {"2", "3"}
+
+    def test_intersects(self, rnode):
+        out = rnode.request("POST", "/spans/_search", {
+            "query": {"range": {"ir": {"gte": 18, "lte": 22}}},
+            "size": 10})
+        assert {h["_id"] for h in out["hits"]["hits"]} == {"1", "2", "3"}
+
+    def test_within(self, rnode):
+        out = rnode.request("POST", "/spans/_search", {
+            "query": {"range": {"ir": {"gte": 0, "lte": 25,
+                                       "relation": "within"}}},
+            "size": 10})
+        assert {h["_id"] for h in out["hits"]["hits"]} == {"1"}
+
+    def test_contains(self, rnode):
+        out = rnode.request("POST", "/spans/_search", {
+            "query": {"range": {"ir": {"gte": 12, "lte": 14,
+                                       "relation": "contains"}}},
+            "size": 10})
+        assert {h["_id"] for h in out["hits"]["hits"]} == {"1", "3"}
+
+    def test_date_range_field(self, rnode):
+        out = rnode.request("POST", "/spans/_search", {
+            "query": {"term": {"dr": "2024-01-15"}}, "size": 10})
+        assert [h["_id"] for h in out["hits"]["hits"]] == ["1"]
+        # lt bound is exclusive: the last ms of January is in, Feb 1 is out
+        out = rnode.request("POST", "/spans/_search", {
+            "query": {"term": {"dr": "2024-02-01"}}, "size": 10})
+        assert out["hits"]["total"]["value"] == 0
+
+    def test_exists(self, rnode):
+        out = rnode.request("POST", "/spans/_search", {
+            "query": {"exists": {"field": "dr"}}, "size": 10})
+        assert [h["_id"] for h in out["hits"]["hits"]] == ["1"]

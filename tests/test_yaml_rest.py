@@ -57,11 +57,13 @@ CURATED = [
     "indices.rollover/30_max_size_condition.yml",
     "indices.rollover/40_mapping.yml",
     "indices.split/20_source_mapping.yml",
+    "indices.validate_query/20_query_string.yml",
     "info/10_info.yml",
     "mlt/10_basic.yml",
     "mlt/20_docs.yml",
     "msearch/11_status.yml",
     "ping/10_ping.yml",
+    "range/10_basic.yml",
     "scroll/10_basic.yml",
     "search/200_index_phrase_search.yml",
     "search/issue4895.yml",
