@@ -29,6 +29,15 @@ import jax.numpy as jnp
 from opensearch_tpu.index.segment import LENGTH_TABLE, Segment, pad_bucket
 
 INT32_MAX = np.int32(2 ** 31 - 1)
+_F32_MAX = float(np.finfo(np.float32).max)
+
+
+def _to_f32_finite(values: np.ndarray) -> np.ndarray:
+    """float64 → float32 with saturation instead of overflow-to-inf: range
+    fields store an unbounded-side sentinel (mapper.RANGE_UNBOUNDED = 1e308)
+    that must stay finite on device so metric kernels over the decode tables
+    never see inf."""
+    return np.clip(values, -_F32_MAX, _F32_MAX).astype(np.float32)
 
 
 @dataclass(frozen=True)
@@ -104,7 +113,7 @@ def upload_segment(seg: Segment, to_device: bool = True):
         val_ords = np.zeros(nv_pad, dtype=np.int32)
         val_ords[:len(col.doc_ids)] = col.value_ords
         values_f32 = np.zeros(nv_pad, dtype=np.float32)
-        values_f32[:len(col.doc_ids)] = col.values.astype(np.float32)
+        values_f32[:len(col.doc_ids)] = _to_f32_finite(col.values)
         exists = np.zeros(d_pad, dtype=bool)
         exists[:seg.num_docs] = col.exists
         min_rank = np.full(d_pad, INT32_MAX, dtype=np.int32)
@@ -115,7 +124,7 @@ def upload_segment(seg: Segment, to_device: bool = True):
         # rank → value decode table (f32) for device-side metric aggregations
         u_pad = pad_bucket(max(len(col.unique), 1), minimum=8)
         unique_f32 = np.zeros(u_pad, dtype=np.float32)
-        unique_f32[:len(col.unique)] = col.unique.astype(np.float32)
+        unique_f32[:len(col.unique)] = _to_f32_finite(col.unique)
         arrays["numeric"][fname] = {
             "doc_ids": doc_ids, "val_ords": val_ords, "values_f32": values_f32,
             "exists": exists, "min_rank": min_rank, "max_rank": max_rank,

@@ -463,25 +463,22 @@ def register_search_actions(node, c):
                     continue
                 blocks = seg.post_docs[
                     tm.start_block:tm.start_block + tm.num_blocks].ravel()
-                hits = (blocks == ord_)
-                if not hits.any():
+                hits = np.nonzero(blocks == ord_)[0]
+                if not len(hits):
                     continue
-                entry_i = int(np.nonzero(blocks == ord_)[0][0])
+                # postings pad only the tail with -1, so the entry index
+                # is also the index into the parallel positions lists
+                entry_i = int(hits[0])
                 tf = int(seg.post_tf[
                     tm.start_block:tm.start_block
                     + tm.num_blocks].ravel()[entry_i])
                 tinfo = {"term_freq": tf, "doc_freq": tm.doc_freq,
                          "ttf": tm.total_term_freq}
                 pos_lists = seg.positions.get((field, term))
-                if pos_lists is not None:
-                    # positions parallel the postings entries
-                    valid_i = int(np.count_nonzero(
-                        (blocks >= 0) & (np.arange(len(blocks))
-                                         < entry_i)))
-                    if valid_i < len(pos_lists):
-                        tinfo["tokens"] = [
-                            {"position": int(p)}
-                            for p in pos_lists[valid_i]]
+                if pos_lists is not None and entry_i < len(pos_lists):
+                    tinfo["tokens"] = [
+                        {"position": int(p)}
+                        for p in pos_lists[entry_i]]
                 fld = term_vectors.setdefault(field, {
                     "field_statistics": {
                         "doc_count":
@@ -518,7 +515,10 @@ def register_search_actions(node, c):
                 for seg, (arrays, meta) in zip(reader.segments,
                                                reader.device):
                     compiler.compile(query_node, seg, meta)
-        except OpenSearchTpuError as e:
+        except (OpenSearchTpuError, ValueError, TypeError, KeyError) as e:
+            # the endpoint's contract is to REPORT invalid queries, so bad
+            # parameter types (e.g. a non-numeric boost raising ValueError
+            # inside the parser) are valid:false, never a 500
             out = {"valid": False,
                    "_shards": {"total": 1, "successful": 1, "failed": 0}}
             if explain:

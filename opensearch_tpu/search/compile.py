@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import fnmatch
 import re
-from dataclasses import dataclass, field as dc_field
+from dataclasses import dataclass, field as dc_field, fields as dc_fields
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -304,6 +304,8 @@ class Compiler:
             return MATCH_NONE
 
         def bound(value, round_up=False):
+            if node.comparable:
+                return float(value)
             if ft.is_date and isinstance(value, str) and ("now" in value or "||" in value):
                 value = _resolve_date_math(value, round_up=round_up)
             return ft.to_comparable(value)
@@ -392,20 +394,28 @@ class Compiler:
                                               lte=node.lte, lt=node.lt))
         elif relation == "contains":
             # query ⊆ doc: an exclusive query bound moves one element
-            # inward before comparing against the doc's inclusive bounds
+            # inward before comparing against the doc's inclusive bounds.
+            # All bounds are pre-converted to the bound columns' comparable
+            # domain here (comparable=True) so a date format on the range
+            # field is applied exactly once (mapper._parse_range does the
+            # same on the write path).
             if node.gte is not None:
-                filters.append(dsl.RangeQuery(field=f"{f}#lo",
-                                              lte=node.gte))
+                filters.append(dsl.RangeQuery(
+                    field=f"{f}#lo", comparable=True,
+                    lte=self._range_elem_step(node.field, node.gte, 0,
+                                              round_up=False)))
             if node.gt is not None:
                 filters.append(dsl.RangeQuery(
-                    field=f"{f}#lo",
+                    field=f"{f}#lo", comparable=True,
                     lte=self._range_elem_step(node.field, node.gt, +1)))
             if node.lte is not None:
-                filters.append(dsl.RangeQuery(field=f"{f}#hi",
-                                              gte=node.lte))
+                filters.append(dsl.RangeQuery(
+                    field=f"{f}#hi", comparable=True,
+                    gte=self._range_elem_step(node.field, node.lte, 0,
+                                              round_up=True)))
             if node.lt is not None:
                 filters.append(dsl.RangeQuery(
-                    field=f"{f}#hi",
+                    field=f"{f}#hi", comparable=True,
                     gte=self._range_elem_step(node.field, node.lt, -1)))
         else:
             raise QueryShardError(
@@ -415,25 +425,33 @@ class Compiler:
         return self.compile(dsl.BoolQuery(filter=filters,
                                           boost=node.boost), seg, meta)
 
-    def _range_elem_step(self, field: str, value: Any, direction: int):
-        """Move a range-field query bound one element inward (ints/dates/
-        ips step by 1, floats by one ulp) — exclusive→inclusive for the
+    def _range_elem_step(self, field: str, value: Any, direction: int,
+                         round_up: Optional[bool] = None):
+        """Convert a range-field query bound to the bound columns' comparable
+        (float) domain — honoring the field's date format — and move it one
+        element inward (ints/dates/ips step by 1, floats by one ulp) when the
+        bound is exclusive (direction ±1); exclusive→inclusive for the
         `contains` relation."""
         import math as _math
         from opensearch_tpu.index.mapper import (_RANGE_ELEM, ip_to_long,
                                                  parse_date_millis)
         ft = self.mapper.get_field(field)
+        elem_ft = self.mapper.get_field(f"{field}#lo")
         elem = _RANGE_ELEM.get(ft.type, "double")
         if elem == "date":
             if isinstance(value, str) and ("now" in value
                                            or "||" in value):
-                value = _resolve_date_math(value,
-                                           round_up=direction > 0)
-            v = float(parse_date_millis(value))
+                value = _resolve_date_math(
+                    value,
+                    round_up=(direction > 0) if round_up is None else round_up)
+            fmt = elem_ft.fmt if elem_ft is not None else None
+            v = float(parse_date_millis(value, fmt))
         elif elem == "ip":
             v = float(ip_to_long(value))
         else:
             v = float(value)
+        if direction == 0:
+            return v
         if elem in ("float", "double"):
             return _math.nextafter(v, _math.inf * direction)
         return v + direction
@@ -487,11 +505,13 @@ class Compiler:
                 f"[nested] unknown score_mode [{node.score_mode}]")
 
         def has_nested(n) -> bool:
+            # walk every QueryNode-valued dataclass field (not a hardcoded
+            # attribute list) so composites like boosting.positive can't
+            # smuggle a nested query past the guard
             if isinstance(n, dsl.NestedQuery):
                 return True
-            for attr in ("query", "must", "should", "must_not", "filter",
-                         "queries"):
-                sub = getattr(n, attr, None)
+            for f in dc_fields(n):
+                sub = getattr(n, f.name, None)
                 if isinstance(sub, dsl.QueryNode) and has_nested(sub):
                     return True
                 if isinstance(sub, (list, tuple)) and any(
@@ -756,6 +776,88 @@ class Compiler:
             boost=node.boost)
         return self.compile(rewritten, seg, meta)
 
+    # ----------------------------------------------------- spans / intervals
+    def _multi_term_predicate(self, node):
+        """The term-dictionary predicate of a multi-term query node, shared by
+        constant-score rewrite and span_multi/intervals expansion."""
+        if isinstance(node, dsl.PrefixQuery):
+            value = node.value.lower() if node.case_insensitive else node.value
+            if node.case_insensitive:
+                return lambda t: t.lower().startswith(value)
+            return lambda t: t.startswith(value)
+        if isinstance(node, dsl.WildcardQuery):
+            pattern = node.value.lower() if node.case_insensitive else node.value
+            if node.case_insensitive:
+                return lambda t: fnmatch.fnmatchcase(t.lower(), pattern)
+            return lambda t: fnmatch.fnmatchcase(t, pattern)
+        if isinstance(node, dsl.RegexpQuery):
+            try:
+                rx = re.compile(node.value,
+                                re.IGNORECASE if node.case_insensitive else 0)
+            except re.error as e:
+                raise ParsingError(f"invalid regexp [{node.value}]: {e}")
+            return lambda t: rx.fullmatch(t) is not None
+        if isinstance(node, dsl.FuzzyQuery):
+            max_edits = _fuzziness_to_edits(node.fuzziness, node.value)
+            prefix = node.value[:node.prefix_length]
+            return (lambda t: t.startswith(prefix)
+                    and _levenshtein_le(t, node.value, max_edits))
+        raise ParsingError(
+            f"[span_multi] unsupported inner query {type(node).__name__}")
+
+    def _span_expand(self, seg, node) -> List[str]:
+        predicate = self._multi_term_predicate(node)
+        terms = [t for t in seg.terms_for_field(node.field) if predicate(t)]
+        if len(terms) > MAX_EXPANSIONS:
+            raise QueryShardError(
+                f"field [{node.field}] expansion matches too many terms "
+                f"(> {MAX_EXPANSIONS})")
+        return terms
+
+    def _precomputed_plan(self, seg, scores: np.ndarray,
+                          matches: np.ndarray) -> Plan:
+        d_pad = pad_bucket(max(seg.num_docs, 1))
+        sc = np.zeros(d_pad, dtype=np.float32)
+        mk = np.zeros(d_pad, dtype=bool)
+        sc[:seg.num_docs] = scores
+        mk[:seg.num_docs] = matches
+        return Plan("precomputed", inputs={"scores": sc, "matches": mk})
+
+    def _span_plan(self, node, seg, meta) -> Plan:
+        from opensearch_tpu.search.spans import SpanEvaluator, score_spans
+        ev = SpanEvaluator(seg, lambda n: self._span_expand(seg, n))
+        field = ev.field_of(node)       # validates same-field clauses
+        doc_spans = ev.eval(node)
+        scores, matches = score_spans(seg, self.stats, field, doc_spans,
+                                      ev.leaf_terms, node.boost,
+                                      LENGTH_TABLE, DEFAULT_K1, DEFAULT_B)
+        return self._precomputed_plan(seg, scores, matches)
+
+    _c_SpanTermQuery = _span_plan
+    _c_SpanNearQuery = _span_plan
+    _c_SpanFirstQuery = _span_plan
+    _c_SpanOrQuery = _span_plan
+    _c_SpanNotQuery = _span_plan
+    _c_SpanContainingQuery = _span_plan
+    _c_SpanWithinQuery = _span_plan
+    _c_SpanMultiQuery = _span_plan
+    _c_FieldMaskingSpanQuery = _span_plan
+
+    def _c_IntervalsQuery(self, node: dsl.IntervalsQuery, seg, meta) -> Plan:
+        from opensearch_tpu.search.spans import IntervalEvaluator, score_spans
+        ft = self.mapper.get_field(node.field)
+        if ft is None:
+            return MATCH_NONE
+        ev = IntervalEvaluator(
+            seg, node.field,
+            analyze=lambda text, an: self._analyze_query_terms(ft, text, an),
+            expand=lambda n: self._span_expand(seg, n))
+        doc_spans = ev.eval(node.rule)
+        scores, matches = score_spans(seg, self.stats, node.field, doc_spans,
+                                      ev.leaf_terms, node.boost,
+                                      LENGTH_TABLE, DEFAULT_K1, DEFAULT_B)
+        return self._precomputed_plan(seg, scores, matches)
+
     # ------------------------------------------------- multi-term expansion
     def _expand_terms(self, seg, meta, field: str, predicate, boost: float) -> Plan:
         """Constant-score rewrite of prefix/wildcard/regexp/fuzzy, expanding
@@ -773,40 +875,12 @@ class Compiler:
                                  constant=True)
 
     def _c_PrefixQuery(self, node: dsl.PrefixQuery, seg, meta) -> Plan:
-        value = node.value.lower() if node.case_insensitive else node.value
-        return self._expand_terms(
-            seg, meta, node.field,
-            (lambda t: t.lower().startswith(value)) if node.case_insensitive
-            else (lambda t: t.startswith(value)), node.boost)
-
-    def _c_WildcardQuery(self, node: dsl.WildcardQuery, seg, meta) -> Plan:
-        pattern = node.value
-        if node.case_insensitive:
-            pattern = pattern.lower()
-            return self._expand_terms(
-                seg, meta, node.field,
-                lambda t: fnmatch.fnmatchcase(t.lower(), pattern), node.boost)
-        return self._expand_terms(
-            seg, meta, node.field,
-            lambda t: fnmatch.fnmatchcase(t, pattern), node.boost)
-
-    def _c_RegexpQuery(self, node: dsl.RegexpQuery, seg, meta) -> Plan:
-        try:
-            rx = re.compile(node.value, re.IGNORECASE if node.case_insensitive else 0)
-        except re.error as e:
-            raise ParsingError(f"invalid regexp [{node.value}]: {e}")
         return self._expand_terms(seg, meta, node.field,
-                                  lambda t: rx.fullmatch(t) is not None, node.boost)
+                                  self._multi_term_predicate(node), node.boost)
 
-    def _c_FuzzyQuery(self, node: dsl.FuzzyQuery, seg, meta) -> Plan:
-        value = node.value
-        max_edits = _fuzziness_to_edits(node.fuzziness, value)
-        prefix = value[:node.prefix_length]
-
-        def predicate(t):
-            return (t.startswith(prefix)
-                    and _levenshtein_le(t, value, max_edits))
-        return self._expand_terms(seg, meta, node.field, predicate, node.boost)
+    _c_WildcardQuery = _c_PrefixQuery
+    _c_RegexpQuery = _c_PrefixQuery
+    _c_FuzzyQuery = _c_PrefixQuery
 
     # --------------------------------------------------------- phrase (host)
     def _c_MatchPhraseQuery(self, node: dsl.MatchPhraseQuery, seg, meta) -> Plan:
@@ -822,12 +896,7 @@ class Compiler:
                                      node.boost, constant=False)
         scores, matches = phrase_eval(seg, self.stats, node.field, terms,
                                       node.slop, node.boost)
-        d_pad = pad_bucket(max(seg.num_docs, 1))
-        sc = np.zeros(d_pad, dtype=np.float32)
-        mk = np.zeros(d_pad, dtype=bool)
-        sc[:seg.num_docs] = scores
-        mk[:seg.num_docs] = matches
-        return Plan("precomputed", inputs={"scores": sc, "matches": mk})
+        return self._precomputed_plan(seg, scores, matches)
 
     def _c_MatchBoolPrefixQuery(self, node, seg, meta) -> Plan:
         ft = self.mapper.get_field(node.field)

@@ -90,6 +90,8 @@ class RangeQuery(QueryNode):
     fmt: Optional[str] = None
     time_zone: Optional[str] = None
     relation: Optional[str] = None   # range FIELDS: intersects|within|contains
+    comparable: bool = False         # internal: bounds already in the
+                                     # column's comparable (float) domain
 
 
 @dataclass
@@ -165,6 +167,72 @@ class FuzzyQuery(QueryNode):
     fuzziness: str = "AUTO"
     prefix_length: int = 0
     max_expansions: int = 50
+
+
+@dataclass
+class SpanTermQuery(QueryNode):
+    field: str = ""
+    value: str = ""
+
+
+@dataclass
+class SpanNearQuery(QueryNode):
+    clauses: Tuple[QueryNode, ...] = ()
+    slop: int = 0
+    in_order: bool = True
+
+
+@dataclass
+class SpanFirstQuery(QueryNode):
+    match: Optional[QueryNode] = None
+    end: int = 0
+
+
+@dataclass
+class SpanOrQuery(QueryNode):
+    clauses: Tuple[QueryNode, ...] = ()
+
+
+@dataclass
+class SpanNotQuery(QueryNode):
+    include: Optional[QueryNode] = None
+    exclude: Optional[QueryNode] = None
+    pre: int = 0
+    post: int = 0
+
+
+@dataclass
+class SpanContainingQuery(QueryNode):
+    big: Optional[QueryNode] = None
+    little: Optional[QueryNode] = None
+
+
+@dataclass
+class SpanWithinQuery(QueryNode):
+    big: Optional[QueryNode] = None
+    little: Optional[QueryNode] = None
+
+
+@dataclass
+class SpanMultiQuery(QueryNode):
+    match: Optional[QueryNode] = None    # prefix | wildcard | fuzzy | regexp
+
+
+@dataclass
+class FieldMaskingSpanQuery(QueryNode):
+    query: Optional[QueryNode] = None
+    field: str = ""                      # the mask field (scoring identity)
+
+
+@dataclass
+class IntervalsQuery(QueryNode):
+    field: str = ""
+    rule: Dict[str, Any] = dc_field(default_factory=dict)
+
+
+SPAN_QUERY_TYPES = (SpanTermQuery, SpanNearQuery, SpanFirstQuery, SpanOrQuery,
+                    SpanNotQuery, SpanContainingQuery, SpanWithinQuery,
+                    SpanMultiQuery, FieldMaskingSpanQuery)
 
 
 @dataclass
@@ -703,6 +771,22 @@ def parse_query(q: Any) -> QueryNode:
                                 script_params=script.get("params", {}),
                                 boost=float(body.get("boost", 1.0)))
 
+    if name in _SPAN_PARSERS:
+        return _SPAN_PARSERS[name](body)
+
+    if name == "intervals":
+        body = dict(body)
+        boost = float(body.pop("boost", 1.0))
+        if len(body) != 1:
+            raise ParsingError("[intervals] requires exactly one field")
+        field, rule = next(iter(body.items()))
+        if not isinstance(rule, dict) or len(rule) != 1:
+            raise ParsingError(
+                "[intervals] field rule must be exactly one of "
+                "[match, prefix, wildcard, fuzzy, all_of, any_of]")
+        _validate_intervals_rule(rule)
+        return IntervalsQuery(field=field, rule=rule, boost=boost)
+
     parser = PLUGIN_QUERIES.get(name)
     if parser is not None:
         return parser(body)
@@ -713,6 +797,168 @@ def parse_query(q: Any) -> QueryNode:
 # plugin-registered query parsers: name -> parser(body) -> QueryNode
 # (SearchPlugin#getQueries; populated by opensearch_tpu.plugins)
 PLUGIN_QUERIES: Dict[str, Any] = {}
+
+
+# ---------------------------------------------------------------- span family
+# Reference: the 9 Span*QueryBuilder classes in index/query/ (e.g.
+# SpanNearQueryBuilder.java, SpanTermQueryBuilder.java,
+# FieldMaskingSpanQueryBuilder.java). Same wire shapes, same validation: inner
+# clauses of compound span queries must themselves be span queries.
+
+def _parse_span(q: Any, ctx: str) -> QueryNode:
+    node = parse_query(q)
+    if not isinstance(node, SPAN_QUERY_TYPES):
+        raise ParsingError(f"[{ctx}] clauses must be span queries")
+    return node
+
+
+def _parse_span_term(body) -> QueryNode:
+    field, spec = _field_body(body, "span_term")
+    if isinstance(spec, dict):
+        return SpanTermQuery(field=field,
+                             value=str(spec.get("value", spec.get("term", ""))),
+                             boost=float(spec.get("boost", 1.0)))
+    return SpanTermQuery(field=field, value=str(spec))
+
+
+def _parse_span_near(body) -> QueryNode:
+    clauses = body.get("clauses")
+    if not isinstance(clauses, list) or not clauses:
+        raise ParsingError("span_near must include [clauses]")
+    return SpanNearQuery(
+        clauses=tuple(_parse_span(c, "span_near") for c in clauses),
+        slop=int(body.get("slop", 0)),
+        in_order=bool(body.get("in_order", True)),
+        boost=float(body.get("boost", 1.0)))
+
+
+def _parse_span_first(body) -> QueryNode:
+    if "match" not in body or "end" not in body:
+        raise ParsingError("span_first must have [match] and [end]")
+    return SpanFirstQuery(match=_parse_span(body["match"], "span_first"),
+                          end=int(body["end"]),
+                          boost=float(body.get("boost", 1.0)))
+
+
+def _parse_span_or(body) -> QueryNode:
+    clauses = body.get("clauses")
+    if not isinstance(clauses, list) or not clauses:
+        raise ParsingError("span_or must include [clauses]")
+    return SpanOrQuery(
+        clauses=tuple(_parse_span(c, "span_or") for c in clauses),
+        boost=float(body.get("boost", 1.0)))
+
+
+def _parse_span_not(body) -> QueryNode:
+    if "include" not in body or "exclude" not in body:
+        raise ParsingError("span_not must have [include] and [exclude]")
+    dist = body.get("dist")
+    pre = int(dist if dist is not None else body.get("pre", 0))
+    post = int(dist if dist is not None else body.get("post", 0))
+    return SpanNotQuery(include=_parse_span(body["include"], "span_not"),
+                        exclude=_parse_span(body["exclude"], "span_not"),
+                        pre=pre, post=post,
+                        boost=float(body.get("boost", 1.0)))
+
+
+def _parse_span_containing(body) -> QueryNode:
+    if "big" not in body or "little" not in body:
+        raise ParsingError("span_containing must have [big] and [little]")
+    return SpanContainingQuery(
+        big=_parse_span(body["big"], "span_containing"),
+        little=_parse_span(body["little"], "span_containing"),
+        boost=float(body.get("boost", 1.0)))
+
+
+def _parse_span_within(body) -> QueryNode:
+    if "big" not in body or "little" not in body:
+        raise ParsingError("span_within must have [big] and [little]")
+    return SpanWithinQuery(big=_parse_span(body["big"], "span_within"),
+                           little=_parse_span(body["little"], "span_within"),
+                           boost=float(body.get("boost", 1.0)))
+
+
+def _parse_span_multi(body) -> QueryNode:
+    match = body.get("match")
+    if match is None:
+        raise ParsingError("span_multi must have [match]")
+    inner = parse_query(match)
+    if not isinstance(inner, (PrefixQuery, WildcardQuery, FuzzyQuery,
+                              RegexpQuery)):
+        raise ParsingError(
+            "[span_multi] [match] must be a multi term query "
+            "(prefix, wildcard, fuzzy or regexp)")
+    return SpanMultiQuery(match=inner, boost=float(body.get("boost", 1.0)))
+
+
+def _parse_field_masking_span(body) -> QueryNode:
+    if "query" not in body or "field" not in body:
+        raise ParsingError("field_masking_span must have [query] and [field]")
+    return FieldMaskingSpanQuery(
+        query=_parse_span(body["query"], "field_masking_span"),
+        field=str(body["field"]),
+        boost=float(body.get("boost", 1.0)))
+
+
+_SPAN_PARSERS = {
+    "span_term": _parse_span_term,
+    "span_near": _parse_span_near,
+    "span_first": _parse_span_first,
+    "span_or": _parse_span_or,
+    "span_not": _parse_span_not,
+    "span_containing": _parse_span_containing,
+    "span_within": _parse_span_within,
+    "span_multi": _parse_span_multi,
+    "field_masking_span": _parse_field_masking_span,
+}
+
+_INTERVALS_LEAFS = ("match", "prefix", "wildcard", "fuzzy", "all_of", "any_of")
+_INTERVALS_FILTERS = ("containing", "contained_by", "not_containing",
+                      "not_contained_by", "not_overlapping", "overlapping",
+                      "before", "after")
+
+
+def _validate_intervals_rule(rule: Dict[str, Any]) -> None:
+    """Structural validation of an intervals source tree (reference:
+    index/query/IntervalQueryBuilder.java + IntervalsSourceProvider.java)."""
+    kind, spec = next(iter(rule.items()))
+    if kind not in _INTERVALS_LEAFS:
+        raise ParsingError(f"unknown intervals source [{kind}]")
+    if not isinstance(spec, dict):
+        raise ParsingError(f"[intervals] [{kind}] must be an object")
+    if kind == "match":
+        if "query" not in spec:
+            raise ParsingError("[intervals] [match] requires [query]")
+    elif kind == "prefix":
+        if "prefix" not in spec:
+            raise ParsingError("[intervals] [prefix] requires [prefix]")
+    elif kind == "wildcard":
+        if "pattern" not in spec:
+            raise ParsingError("[intervals] [wildcard] requires [pattern]")
+    elif kind == "fuzzy":
+        if "term" not in spec:
+            raise ParsingError("[intervals] [fuzzy] requires [term]")
+    elif kind in ("all_of", "any_of"):
+        subs = spec.get("intervals")
+        if not isinstance(subs, list) or not subs:
+            raise ParsingError(f"[intervals] [{kind}] requires [intervals]")
+        for sub in subs:
+            if not isinstance(sub, dict) or len(sub) != 1:
+                raise ParsingError(
+                    "[intervals] sources must have exactly one rule")
+            _validate_intervals_rule(sub)
+    filt = spec.get("filter")
+    if filt is not None:
+        if not isinstance(filt, dict) or len(filt) != 1:
+            raise ParsingError(
+                "[intervals] [filter] must have exactly one relation")
+        fkind, fspec = next(iter(filt.items()))
+        if fkind not in _INTERVALS_FILTERS:
+            raise ParsingError(f"unknown intervals filter [{fkind}]")
+        if not isinstance(fspec, dict) or len(fspec) != 1:
+            raise ParsingError(
+                "[intervals] filter source must have exactly one rule")
+        _validate_intervals_rule(fspec)
 
 
 def parse_minimum_should_match(msm: Any, n_optional: int) -> int:

@@ -1,0 +1,400 @@
+"""Host-side span and interval algebra over stored term positions.
+
+Reference: Lucene's spans package driven by the 9 Span*QueryBuilder classes
+(server/src/main/java/org/opensearch/index/query/SpanNearQueryBuilder.java et
+al.) and the minimal-interval algebra behind IntervalQueryBuilder.java /
+IntervalsSourceProvider.java.
+
+Design: positional matching is irreducibly per-document sparse work that would
+waste MXU lanes as a dense device kernel — exactly like phrase matching, it
+runs on host over the segment's (field, term) position lists and enters the
+device plan as a precomputed dense (scores, matches) pair (see
+compile.py:phrase_eval for the established pattern). A span is represented as
+``(start, end, cost)`` with ``end`` exclusive and ``cost`` the accumulated
+slop/gap penalty; sloppy frequency is ``sum(1 / (1 + cost))`` over matched
+spans, mirroring Lucene's SpanScorer sloppyFreq accumulation.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from opensearch_tpu.common.errors import ParsingError, QueryShardError
+from opensearch_tpu.search import dsl
+
+Span = Tuple[int, int, int]              # (start, end_exclusive, cost)
+DocSpans = Dict[int, List[Span]]         # doc ord -> sorted span list
+
+_UNLIMITED = 1 << 30
+
+
+def _merge(per_doc: List[DocSpans]) -> DocSpans:
+    out: DocSpans = {}
+    for ds in per_doc:
+        for doc, spans in ds.items():
+            out.setdefault(doc, []).extend(spans)
+    for doc in out:
+        out[doc].sort()
+    return out
+
+
+def _term_spans(seg, field: str, term: str) -> DocSpans:
+    plist = seg._positions_for(field, term)
+    if plist is None:
+        return {}
+    return {doc: [(int(p), int(p) + 1, 0) for p in pos]
+            for doc, pos in plist.items() if seg.live[doc]}
+
+
+def _near_ordered(children: List[DocSpans], slop: int) -> DocSpans:
+    """Ordered near: one candidate chain per first-clause span, greedily
+    extended with the minimal-end non-overlapping following span of each next
+    clause (Lucene NearSpansOrdered's advance strategy; minimal-end choice so
+    an earlier-starting long span can't shadow a shorter later one)."""
+    out: DocSpans = {}
+    docs = set(children[0].keys())
+    for ds in children[1:]:
+        docs &= set(ds.keys())
+    for doc in docs:
+        matches: List[Span] = []
+        for (s0, e0, c0) in children[0][doc]:
+            end, cost, ok = e0, c0, True
+            for ds in children[1:]:
+                best: Optional[Span] = None
+                for (s, e, c) in ds[doc]:
+                    if s >= end and (best is None
+                                     or (e, s + c) < (best[1], best[0] + best[2])):
+                        best = (s, e, c)
+                if best is None:
+                    ok = False
+                    break
+                cost += best[2] + (best[0] - end)   # gap between clauses
+                end = best[1]
+            if ok and cost <= slop:
+                matches.append((s0, end, cost))
+        if matches:
+            out[doc] = matches
+    return out
+
+
+def _near_unordered(children: List[DocSpans], slop: int) -> DocSpans:
+    """Unordered near: minimal windows containing one span per clause;
+    slop charged as window width minus the total clause width (Lucene
+    NearSpansUnordered)."""
+    out: DocSpans = {}
+    docs = set(children[0].keys())
+    for ds in children[1:]:
+        docs &= set(ds.keys())
+    for doc in docs:
+        # tag each span with its clause index, sweep minimal windows
+        tagged: List[Tuple[int, int, int, int]] = []
+        for ci, ds in enumerate(children):
+            for (s, e, c) in ds[doc]:
+                tagged.append((s, e, c, ci))
+        tagged.sort()
+        n = len(children)
+        matches: List[Span] = []
+        for i, (s0, e0, c0, ci0) in enumerate(tagged):
+            seen = {ci0: (s0, e0, c0)}
+            for j in range(i + 1, len(tagged)):
+                s, e, c, ci = tagged[j]
+                if ci not in seen:
+                    seen[ci] = (s, e, c)
+                if len(seen) == n:
+                    break
+            if len(seen) < n:
+                continue
+            w_start = min(sp[0] for sp in seen.values())
+            w_end = max(sp[1] for sp in seen.values())
+            total_len = sum(sp[1] - sp[0] for sp in seen.values())
+            inner = sum(sp[2] for sp in seen.values())
+            cost = inner + max(0, (w_end - w_start) - total_len)
+            if cost <= slop:
+                matches.append((w_start, w_end, cost))
+        if matches:
+            # dedupe identical windows produced from different anchors
+            out[doc] = sorted(set(matches))
+    return out
+
+
+def _span_not(include: DocSpans, exclude: DocSpans, pre: int,
+              post: int) -> DocSpans:
+    out: DocSpans = {}
+    for doc, spans in include.items():
+        excl = exclude.get(doc, [])
+        kept = [sp for sp in spans
+                if not any(es < sp[1] + post and ee > sp[0] - pre
+                           for (es, ee, _) in excl)]
+        if kept:
+            out[doc] = kept
+    return out
+
+
+def _span_containing(big: DocSpans, little: DocSpans) -> DocSpans:
+    out: DocSpans = {}
+    for doc, bigs in big.items():
+        littles = little.get(doc)
+        if not littles:
+            continue
+        kept = [bp for bp in bigs
+                if any(bp[0] <= ls and le <= bp[1]
+                       for (ls, le, _) in littles)]
+        if kept:
+            out[doc] = kept
+    return out
+
+
+def _span_within(big: DocSpans, little: DocSpans) -> DocSpans:
+    out: DocSpans = {}
+    for doc, littles in little.items():
+        bigs = big.get(doc)
+        if not bigs:
+            continue
+        kept = [lp for lp in littles
+                if any(bs <= lp[0] and lp[1] <= be
+                       for (bs, be, _) in bigs)]
+        if kept:
+            out[doc] = kept
+    return out
+
+
+class SpanEvaluator:
+    """Evaluates a span query tree against one segment.
+
+    ``expand`` resolves a multi-term query node (prefix/wildcard/fuzzy/regexp)
+    to the matching terms of this segment's term dictionary — supplied by the
+    compiler so expansion predicates stay in one place.
+    """
+
+    def __init__(self, seg, expand: Callable[[dsl.QueryNode], List[str]]):
+        self.seg = seg
+        self.expand = expand
+        self.leaf_terms: List[Tuple[str, str]] = []   # (field, term) for idf
+
+    def field_of(self, node: dsl.QueryNode) -> str:
+        """The effective (scoring) field of a span clause; mismatched inner
+        fields are a QueryShardError exactly like Lucene's SpanNearQuery
+        constructor check, with field_masking_span as the sanctioned bridge."""
+        if isinstance(node, dsl.FieldMaskingSpanQuery):
+            return node.field
+        if isinstance(node, (dsl.SpanTermQuery,)):
+            return node.field
+        if isinstance(node, dsl.SpanMultiQuery):
+            return node.match.field
+        if isinstance(node, dsl.SpanFirstQuery):
+            return self.field_of(node.match)
+        if isinstance(node, dsl.SpanNotQuery):
+            return self._same_field(node.include, node.exclude)
+        if isinstance(node, (dsl.SpanContainingQuery, dsl.SpanWithinQuery)):
+            return self._same_field(node.big, node.little)
+        if isinstance(node, (dsl.SpanNearQuery, dsl.SpanOrQuery)):
+            fields = {self.field_of(c) for c in node.clauses}
+            if len(fields) != 1:
+                raise QueryShardError(
+                    "Clauses must have same field")
+            return fields.pop()
+        raise ParsingError(f"not a span query: {type(node).__name__}")
+
+    def _same_field(self, a: dsl.QueryNode, b: dsl.QueryNode) -> str:
+        fa, fb = self.field_of(a), self.field_of(b)
+        if fa != fb:
+            raise QueryShardError("Clauses must have same field")
+        return fa
+
+    def eval(self, node: dsl.QueryNode) -> DocSpans:
+        if isinstance(node, dsl.SpanTermQuery):
+            self.leaf_terms.append((node.field, node.value))
+            return _term_spans(self.seg, node.field, node.value)
+        if isinstance(node, dsl.SpanMultiQuery):
+            field = node.match.field
+            terms = self.expand(node.match)
+            self.leaf_terms.extend((field, t) for t in terms)
+            return _merge([_term_spans(self.seg, field, t) for t in terms])
+        if isinstance(node, dsl.FieldMaskingSpanQuery):
+            return self.eval(node.query)
+        if isinstance(node, dsl.SpanOrQuery):
+            return _merge([self.eval(c) for c in node.clauses])
+        if isinstance(node, dsl.SpanNearQuery):
+            children = [self.eval(c) for c in node.clauses]
+            if len(children) == 1:
+                return children[0]
+            if node.in_order:
+                return _near_ordered(children, node.slop)
+            return _near_unordered(children, node.slop)
+        if isinstance(node, dsl.SpanFirstQuery):
+            inner = self.eval(node.match)
+            out = {}
+            for doc, spans in inner.items():
+                kept = [sp for sp in spans if sp[1] <= node.end]
+                if kept:
+                    out[doc] = kept
+            return out
+        if isinstance(node, dsl.SpanNotQuery):
+            include = self.eval(node.include)
+            return _span_not(include, self._eval_unscored(node.exclude),
+                             node.pre, node.post)
+        if isinstance(node, dsl.SpanContainingQuery):
+            return _span_containing(self.eval(node.big), self.eval(node.little))
+        if isinstance(node, dsl.SpanWithinQuery):
+            return _span_within(self.eval(node.big), self.eval(node.little))
+        raise ParsingError(f"not a span query: {type(node).__name__}")
+
+    def _eval_unscored(self, node: dsl.QueryNode) -> DocSpans:
+        """Evaluate a clause whose terms must NOT enter the similarity weight
+        (span_not's exclude — Lucene visits it as MUST_NOT and never folds it
+        into the sim weight)."""
+        saved = self.leaf_terms
+        self.leaf_terms = []
+        try:
+            return self.eval(node)
+        finally:
+            self.leaf_terms = saved
+
+
+# ------------------------------------------------------------------ intervals
+
+class IntervalEvaluator:
+    """Evaluates an intervals source tree (the JSON rule dict) for one field.
+
+    Interval sources share the span representation; ``all_of`` maps to
+    near (ordered or not) with ``max_gaps`` as the slop budget, ``any_of``
+    to union, ``match`` to a phrase-shaped near over the analyzed terms.
+    Filters implement the minimal-interval relations of
+    IntervalsSourceProvider.IntervalFilter.
+    """
+
+    def __init__(self, seg, field: str,
+                 analyze: Callable[[str, Optional[str]], List[str]],
+                 expand: Callable[[dsl.QueryNode], List[str]]):
+        self.seg = seg
+        self.field = field
+        self.analyze = analyze          # (text, analyzer_name) -> terms
+        self.expand = expand
+        self.leaf_terms: List[Tuple[str, str]] = []
+
+    def eval(self, rule: Dict) -> DocSpans:
+        kind, spec = next(iter(rule.items()))
+        spans = getattr(self, f"_r_{kind}")(spec)
+        filt = spec.get("filter") if isinstance(spec, dict) else None
+        if filt:
+            spans = self._apply_filter(spans, filt)
+        return spans
+
+    def _terms_spans(self, terms: List[str]) -> List[DocSpans]:
+        self.leaf_terms.extend((self.field, t) for t in terms)
+        return [_term_spans(self.seg, self.field, t) for t in terms]
+
+    def _r_match(self, spec: Dict) -> DocSpans:
+        terms = self.analyze(str(spec["query"]), spec.get("analyzer"))
+        if not terms:
+            return {}
+        children = self._terms_spans(terms)
+        if len(children) == 1:
+            return children[0]
+        max_gaps = int(spec.get("max_gaps", -1))
+        slop = _UNLIMITED if max_gaps < 0 else max_gaps
+        if bool(spec.get("ordered", False)):
+            return _near_ordered(children, slop)
+        return _near_unordered(children, slop)
+
+    def _r_any_of(self, spec: Dict) -> DocSpans:
+        return _merge([self.eval(sub) for sub in spec["intervals"]])
+
+    def _r_all_of(self, spec: Dict) -> DocSpans:
+        children = [self.eval(sub) for sub in spec["intervals"]]
+        if len(children) == 1:
+            return children[0]
+        max_gaps = int(spec.get("max_gaps", -1))
+        slop = _UNLIMITED if max_gaps < 0 else max_gaps
+        if bool(spec.get("ordered", False)):
+            return _near_ordered(children, slop)
+        return _near_unordered(children, slop)
+
+    def _r_prefix(self, spec: Dict) -> DocSpans:
+        node = dsl.PrefixQuery(field=self.field, value=str(spec["prefix"]))
+        return _merge(self._terms_spans(self.expand(node)))
+
+    def _r_wildcard(self, spec: Dict) -> DocSpans:
+        node = dsl.WildcardQuery(field=self.field, value=str(spec["pattern"]))
+        return _merge(self._terms_spans(self.expand(node)))
+
+    def _r_fuzzy(self, spec: Dict) -> DocSpans:
+        node = dsl.FuzzyQuery(field=self.field, value=str(spec["term"]),
+                              fuzziness=str(spec.get("fuzziness", "AUTO")),
+                              prefix_length=int(spec.get("prefix_length", 0)))
+        return _merge(self._terms_spans(self.expand(node)))
+
+    def _apply_filter(self, spans: DocSpans, filt: Dict) -> DocSpans:
+        relation, fspec = next(iter(filt.items()))
+        # the filter reference source positions intervals but does not score:
+        # keep its terms out of the idf sum (IntervalFilter sources are not
+        # part of the IntervalQuery's term set)
+        saved = self.leaf_terms
+        self.leaf_terms = []
+        try:
+            ref = self.eval(fspec)
+        finally:
+            self.leaf_terms = saved
+        out: DocSpans = {}
+        for doc, doc_spans in spans.items():
+            refs = ref.get(doc, [])
+            kept = [sp for sp in doc_spans
+                    if _interval_rel(sp, refs, relation)]
+            if kept:
+                out[doc] = kept
+        return out
+
+
+def _interval_rel(sp: Span, refs: List[Span], relation: str) -> bool:
+    s, e, _ = sp
+    if relation == "containing":
+        return any(s <= rs and re_ <= e for (rs, re_, _) in refs)
+    if relation == "contained_by":
+        return any(rs <= s and e <= re_ for (rs, re_, _) in refs)
+    if relation == "not_containing":
+        return not any(s <= rs and re_ <= e for (rs, re_, _) in refs)
+    if relation == "not_contained_by":
+        return not any(rs <= s and e <= re_ for (rs, re_, _) in refs)
+    if relation == "overlapping":
+        return any(rs < e and re_ > s for (rs, re_, _) in refs)
+    if relation == "not_overlapping":
+        return not any(rs < e and re_ > s for (rs, re_, _) in refs)
+    if relation == "before":
+        return any(e <= rs for (rs, re_, _) in refs)
+    if relation == "after":
+        return any(s >= re_ for (rs, re_, _) in refs)
+    raise ParsingError(f"unknown intervals filter [{relation}]")
+
+
+def score_spans(seg, stats, field: str, doc_spans: DocSpans,
+                leaf_terms: List[Tuple[str, str]], boost: float,
+                length_table: np.ndarray, k1: float, b: float
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """BM25-shaped scoring over matched spans: sloppy freq sum(1/(1+cost))
+    plugged into the same similarity the phrase path uses, idf summed over
+    the distinct leaf terms against the scoring field's statistics
+    (Lucene SpanWeight.buildSimWeight)."""
+    n = seg.num_docs
+    scores = np.zeros(n, dtype=np.float32)
+    matches = np.zeros(n, dtype=bool)
+    if not doc_spans:
+        return scores, matches
+    sum_idf = sum(stats.idf(field, t)
+                  for t in sorted({t for (_, t) in leaf_terms}))
+    dc, ttf = stats.field_stats(field)
+    avgdl = (ttf / dc) if dc else 1.0
+    norms = seg.norms.get(field)
+    for doc, spans in doc_spans.items():
+        if not seg.live[doc]:
+            continue
+        freq = sum(1.0 / (1.0 + c) for (_, _, c) in spans)
+        if freq <= 0:
+            continue
+        dl = float(length_table[norms[doc]]) if norms is not None else 1.0
+        b_eff = b if norms is not None else 0.0
+        denom = freq + k1 * (1 - b_eff + b_eff * dl / avgdl)
+        scores[doc] = boost * sum_idf * freq * (k1 + 1) / denom
+        matches[doc] = True
+    return scores, matches

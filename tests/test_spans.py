@@ -1,0 +1,276 @@
+"""Span + intervals query tests.
+
+Modeled on the reference suites: SpanNearQueryBuilderTests, SpanNotQueryIT
+(SimpleQueryStringIT's span cases), FieldMaskingSpanQueryBuilderTests and
+IntervalQueryBuilderTests — semantics asserted against hand-computed position
+matches over a tiny corpus."""
+
+import pytest
+
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    n.request("PUT", "/lib", {"mappings": {"properties": {
+        "body": {"type": "text"},
+        "alt": {"type": "text"},
+    }}})
+    docs = {
+        # positions:      0     1    2     3      4
+        "1": "quick brown fox jumps over the lazy dog",
+        "2": "quick fox jumps over brown dog",
+        "3": "the brown quick fox sleeps",
+        "4": "quick yellow dog naps over there",
+        "5": "brown bears eat quick snacks",
+    }
+    for i, body in docs.items():
+        n.request("PUT", f"/lib/_doc/{i}", {"body": body, "alt": body})
+    n.request("POST", "/lib/_refresh")
+    return n
+
+
+def ids(res):
+    return sorted(h["_id"] for h in res["hits"]["hits"])
+
+
+class TestSpanQueries:
+    def test_span_term(self, node):
+        res = node.request("POST", "/lib/_search", {"query": {
+            "span_term": {"body": "fox"}}})
+        assert ids(res) == ["1", "2", "3"]
+
+    def test_span_near_in_order_slop0(self, node):
+        # "quick ... fox" adjacent in order: doc 2 only ("quick fox");
+        # doc 1 has "quick brown fox" (1 gap), doc 3 has "quick fox" at 2,3
+        res = node.request("POST", "/lib/_search", {"query": {"span_near": {
+            "clauses": [{"span_term": {"body": "quick"}},
+                        {"span_term": {"body": "fox"}}],
+            "slop": 0, "in_order": True}}})
+        assert ids(res) == ["2", "3"]
+
+    def test_span_near_slop1(self, node):
+        res = node.request("POST", "/lib/_search", {"query": {"span_near": {
+            "clauses": [{"span_term": {"body": "quick"}},
+                        {"span_term": {"body": "fox"}}],
+            "slop": 1, "in_order": True}}})
+        assert ids(res) == ["1", "2", "3"]
+
+    def test_span_near_unordered(self, node):
+        # unordered: "fox" before "quick" also matches (doc 3: brown quick fox
+        # — ordered quick->brown needs order False)
+        res = node.request("POST", "/lib/_search", {"query": {"span_near": {
+            "clauses": [{"span_term": {"body": "brown"}},
+                        {"span_term": {"body": "quick"}}],
+            "slop": 0, "in_order": False}}})
+        # adjacent pairs in any order: doc1 (quick brown), doc3 (brown quick)
+        assert ids(res) == ["1", "3"]
+
+    def test_span_first(self, node):
+        # "brown" wholly within the first 2 positions
+        res = node.request("POST", "/lib/_search", {"query": {"span_first": {
+            "match": {"span_term": {"body": "brown"}}, "end": 2}}})
+        assert ids(res) == ["1", "3", "5"]
+
+    def test_span_or(self, node):
+        res = node.request("POST", "/lib/_search", {"query": {"span_or": {
+            "clauses": [{"span_term": {"body": "sleeps"}},
+                        {"span_term": {"body": "naps"}}]}}})
+        assert ids(res) == ["3", "4"]
+
+    def test_span_not(self, node):
+        # "quick" not immediately followed by "fox"
+        res = node.request("POST", "/lib/_search", {"query": {"span_not": {
+            "include": {"span_term": {"body": "quick"}},
+            "exclude": {"span_near": {
+                "clauses": [{"span_term": {"body": "quick"}},
+                            {"span_term": {"body": "fox"}}],
+                "slop": 0, "in_order": True}}}}})
+        # docs 2,3 have quick directly before fox — their only "quick" is
+        # inside the excluded span; docs 1 (quick brown fox), 4, 5 survive
+        assert ids(res) == ["1", "4", "5"]
+
+    def test_span_not_with_pre(self, node):
+        # exclude "quick" spans with "brown" up to 2 positions before
+        res = node.request("POST", "/lib/_search", {"query": {"span_not": {
+            "include": {"span_term": {"body": "quick"}},
+            "exclude": {"span_term": {"body": "brown"}},
+            "pre": 2, "post": 0}}})
+        # doc3: brown(1) quick(2) — excluded; doc5: brown(0) quick(3) — pre=2
+        # window [1,3) doesn't reach brown, kept
+        got = ids(res)
+        assert "3" not in got and "5" in got and "1" in got
+
+    def test_span_containing_and_within(self, node):
+        big = {"span_near": {"clauses": [
+            {"span_term": {"body": "quick"}},
+            {"span_term": {"body": "jumps"}}], "slop": 3, "in_order": True}}
+        little = {"span_term": {"body": "brown"}}
+        res = node.request("POST", "/lib/_search", {"query": {
+            "span_containing": {"big": big, "little": little}}})
+        # doc1: quick(0)..jumps(3) contains brown(1); doc2's window
+        # quick(0)..jumps(2) has no brown inside
+        assert ids(res) == ["1"]
+        res = node.request("POST", "/lib/_search", {"query": {
+            "span_within": {"big": big, "little": little}}})
+        assert ids(res) == ["1"]
+
+    def test_span_multi(self, node):
+        res = node.request("POST", "/lib/_search", {"query": {"span_near": {
+            "clauses": [{"span_term": {"body": "quick"}},
+                        {"span_multi": {"match": {
+                            "prefix": {"body": {"value": "ye"}}}}}],
+            "slop": 0, "in_order": True}}})
+        assert ids(res) == ["4"]        # quick yellow
+
+    def test_field_masking_span(self, node):
+        # combine spans from two fields via masking (positions line up since
+        # alt mirrors body)
+        res = node.request("POST", "/lib/_search", {"query": {"span_near": {
+            "clauses": [
+                {"span_term": {"body": "quick"}},
+                {"field_masking_span": {
+                    "query": {"span_term": {"alt": "fox"}},
+                    "field": "body"}}],
+            "slop": 0, "in_order": True}}})
+        assert ids(res) == ["2", "3"]
+
+    def test_mixed_fields_rejected(self, node):
+        res = node.request("POST", "/lib/_search", {"query": {"span_near": {
+            "clauses": [{"span_term": {"body": "quick"}},
+                        {"span_term": {"alt": "fox"}}],
+            "slop": 0, "in_order": True}}})
+        assert "error" in res
+
+    def test_span_not_cross_field_rejected(self, node):
+        res = node.request("POST", "/lib/_search", {"query": {"span_not": {
+            "include": {"span_term": {"body": "quick"}},
+            "exclude": {"span_term": {"alt": "brown"}}}}})
+        assert "error" in res
+
+    def test_span_not_exclude_does_not_inflate_score(self, node):
+        # the exclude clause's (rare, high-idf) term must not enter the
+        # similarity weight: score equals the plain span_term score
+        plain = node.request("POST", "/lib/_search", {"query": {
+            "span_term": {"body": "naps"}}})
+        with_not = node.request("POST", "/lib/_search", {"query": {"span_not": {
+            "include": {"span_term": {"body": "naps"}},
+            "exclude": {"span_term": {"body": "sleeps"}}}}})
+        assert with_not["hits"]["hits"][0]["_score"] == \
+            pytest.approx(plain["hits"]["hits"][0]["_score"])
+
+    def test_non_span_clause_rejected(self, node):
+        res = node.request("POST", "/lib/_search", {"query": {"span_near": {
+            "clauses": [{"term": {"body": "quick"}}],
+            "slop": 0}}})
+        assert "error" in res
+
+    def test_span_scores_rank_tighter_matches_higher(self, node):
+        res = node.request("POST", "/lib/_search", {"query": {"span_near": {
+            "clauses": [{"span_term": {"body": "quick"}},
+                        {"span_term": {"body": "fox"}}],
+            "slop": 2, "in_order": True}}})
+        hits = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+        # doc2/doc3 exact adjacency should outscore doc1's 1-gap match
+        assert hits["2"] > hits["1"]
+
+    def test_span_near_long_span_does_not_shadow_short(self, node):
+        # clause 2 is an OR whose longer alternative starts earlier than the
+        # short one; minimal-end advance must pick the short span so clause 3
+        # can still follow (greedy-first-by-start would return 0 hits)
+        node.request("PUT", "/lib/_doc/9", {"body": "alpha beta gamma delta"})
+        node.request("POST", "/lib/_refresh")
+        res = node.request("POST", "/lib/_search", {"query": {"span_near": {
+            "clauses": [
+                {"span_term": {"body": "alpha"}},
+                {"span_or": {"clauses": [
+                    {"span_near": {"clauses": [
+                        {"span_term": {"body": "beta"}},
+                        {"span_term": {"body": "delta"}}],
+                        "slop": 10, "in_order": True}},
+                    {"span_term": {"body": "gamma"}}]}},
+                {"span_term": {"body": "delta"}}],
+            "slop": 2, "in_order": True}}})
+        assert ids(res) == ["9"]
+
+    def test_span_in_bool(self, node):
+        res = node.request("POST", "/lib/_search", {"query": {"bool": {
+            "must": [{"span_term": {"body": "dog"}}],
+            "must_not": [{"span_near": {
+                "clauses": [{"span_term": {"body": "lazy"}},
+                            {"span_term": {"body": "dog"}}],
+                "slop": 0, "in_order": True}}]}}})
+        assert ids(res) == ["2", "4"]
+
+
+class TestIntervals:
+    def test_match_ordered_max_gaps(self, node):
+        res = node.request("POST", "/lib/_search", {"query": {"intervals": {
+            "body": {"match": {"query": "quick fox",
+                               "max_gaps": 0, "ordered": True}}}}})
+        assert ids(res) == ["2", "3"]
+
+    def test_match_unordered_default(self, node):
+        res = node.request("POST", "/lib/_search", {"query": {"intervals": {
+            "body": {"match": {"query": "fox quick", "max_gaps": 0}}}}})
+        # unordered adjacency: docs 2,3 (quick fox either order)
+        assert ids(res) == ["2", "3"]
+
+    def test_any_of(self, node):
+        res = node.request("POST", "/lib/_search", {"query": {"intervals": {
+            "body": {"any_of": {"intervals": [
+                {"match": {"query": "sleeps"}},
+                {"match": {"query": "naps"}}]}}}}})
+        assert ids(res) == ["3", "4"]
+
+    def test_all_of_ordered(self, node):
+        res = node.request("POST", "/lib/_search", {"query": {"intervals": {
+            "body": {"all_of": {"ordered": True, "intervals": [
+                {"match": {"query": "quick"}},
+                {"match": {"query": "dog"}}]}}}}})
+        assert ids(res) == ["1", "2", "4"]
+
+    def test_prefix_rule(self, node):
+        res = node.request("POST", "/lib/_search", {"query": {"intervals": {
+            "body": {"prefix": {"prefix": "sle"}}}}})
+        assert ids(res) == ["3"]
+
+    def test_wildcard_rule(self, node):
+        res = node.request("POST", "/lib/_search", {"query": {"intervals": {
+            "body": {"wildcard": {"pattern": "ju*s"}}}}})
+        assert ids(res) == ["1", "2"]
+
+    def test_fuzzy_rule(self, node):
+        res = node.request("POST", "/lib/_search", {"query": {"intervals": {
+            "body": {"fuzzy": {"term": "quck"}}}}})
+        assert "1" in ids(res)
+
+    def test_filter_not_containing(self, node):
+        # windows of quick..dog NOT containing "lazy"
+        res = node.request("POST", "/lib/_search", {"query": {"intervals": {
+            "body": {"all_of": {"ordered": True,
+                                "intervals": [{"match": {"query": "quick"}},
+                                              {"match": {"query": "dog"}}],
+                                "filter": {"not_containing": {
+                                    "match": {"query": "lazy"}}}}}}}})
+        assert ids(res) == ["2", "4"]
+
+    def test_filter_before(self, node):
+        # "quick" intervals appearing before some "fox" interval
+        res = node.request("POST", "/lib/_search", {"query": {"intervals": {
+            "body": {"match": {"query": "quick",
+                               "filter": {"before": {
+                                   "match": {"query": "fox"}}}}}}}})
+        assert ids(res) == ["1", "2", "3"]
+
+    def test_unknown_rule_rejected(self, node):
+        res = node.request("POST", "/lib/_search", {"query": {"intervals": {
+            "body": {"bogus": {"query": "x"}}}}})
+        assert "error" in res
+
+    def test_two_fields_rejected(self, node):
+        res = node.request("POST", "/lib/_search", {"query": {"intervals": {
+            "body": {"match": {"query": "x"}},
+            "alt": {"match": {"query": "y"}}}}})
+        assert "error" in res
