@@ -3,23 +3,32 @@
 Re-design of the reference allocator stack — BalancedShardsAllocator
 (cluster/routing/allocation/allocator/BalancedShardsAllocator.java:85)
 weight-balancing shard counts per node, gated by the decider chain
-(cluster/routing/allocation/decider/SameShardAllocationDecider.java — at
-most one copy of a shard per node) — collapsed into one pure function over
-the cluster-state payload. The reference's RoutingTable/ShardRouting
-object model becomes the plain-dict `routing` table carried in
-ClusterState.data (serialized by transport/serde.py):
+(cluster/routing/allocation/decider/*, see deciders.py) — collapsed into one
+pure function over the cluster-state payload. The reference's
+RoutingTable/ShardRouting object model becomes the plain-dict `routing`
+table carried in ClusterState.data (serialized by transport/serde.py):
 
   routing[index] = [            # one entry per shard id
     {"primary": node_id | None, # assigned primary copy
      "primary_term": int,       # bumped on every promotion/assignment
      "replicas": [node_id...],  # assigned replica copies
-     "active_replicas": [...]}, # recovered, in-sync copies (subset)
+     "active_replicas": [...],  # recovered, in-sync copies (subset)
+     "relocating": {...}?},     # in-flight move (see below)
   ]
 
 Promotion on primary loss picks from active_replicas — the in-sync-
 allocation-ids rule (cluster/metadata/IndexMetadata "in_sync_allocations"
 + gateway/PrimaryShardAllocator.java:80): only a copy that finished
 recovery may become primary, never a stale or initializing one.
+
+Relocation (rebalancing and filter-driven moves) is two-phase, exactly the
+reference's RELOCATING → recovery → handoff dance: phase 1 assigns the
+target as an extra initializing replica and records
+``entry["relocating"] = {"from": n, "to": m, "primary": bool}``; phase 2
+(a later reroute, after the target's recovery completes and `shard_started`
+lands it in active_replicas) promotes the target (for primary moves, with a
+term bump) and drops the source copy. Data is never dropped before the new
+copy is active.
 """
 
 from __future__ import annotations
@@ -27,36 +36,23 @@ from __future__ import annotations
 import copy
 from typing import Dict, List, Optional
 
-
-def _copy_counts(routing: Dict[str, List[dict]], live: List[str]
-                 ) -> Dict[str, int]:
-    counts = {n: 0 for n in live}
-    for shards in routing.values():
-        for entry in shards:
-            for n in [entry.get("primary")] + entry.get("replicas", []):
-                if n in counts:
-                    counts[n] += 1
-    return counts
-
-
-def _least_loaded(counts: Dict[str, int], exclude: set) -> Optional[str]:
-    candidates = [(c, n) for n, c in counts.items() if n not in exclude]
-    if not candidates:
-        return None
-    candidates.sort()
-    return candidates[0][1]
+from opensearch_tpu.cluster.deciders import (
+    AllocationContext, NO, THROTTLE, can_allocate, can_rebalance, can_remain)
 
 
 def allocate(data: dict, live_nodes: List[str]) -> dict:
     """Compute a new routing table for `data` given the live node set.
 
     Pure: returns a new data dict (cluster states are immutable values).
-    Handles initial allocation, node-left cleanup, replica promotion, and
-    replica count reconciliation. Idempotent: allocating an already-
-    balanced table is a no-op (callers diff to decide whether to publish).
+    Handles initial allocation, node-left cleanup, replica promotion,
+    replica count reconciliation, decider enforcement (canRemain moves),
+    relocation completion, and weight-based rebalancing. Idempotent:
+    allocating an already-balanced table is a no-op (callers diff to
+    decide whether to publish).
     """
     data = copy.deepcopy(data)
     live = sorted(set(live_nodes))
+    live_set = set(live)
     indices: Dict[str, dict] = data.get("indices", {})
     routing: Dict[str, List[dict]] = data.setdefault("routing", {})
 
@@ -65,9 +61,27 @@ def allocate(data: dict, live_nodes: List[str]) -> dict:
         if name not in indices:
             del routing[name]
 
-    counts = _copy_counts(routing, live)
+    # ---------------------------------------------------- scrub dead nodes
+    for name, shards in routing.items():
+        for entry in shards:
+            entry["replicas"] = [n for n in entry["replicas"]
+                                 if n in live_set]
+            entry["active_replicas"] = [n for n in entry["active_replicas"]
+                                        if n in live_set]
+            if entry["primary"] not in live_set:
+                entry["primary"] = None
+            rel = entry.get("relocating")
+            if rel and (rel["to"] not in entry["replicas"]
+                        or (rel.get("primary")
+                            and entry["primary"] != rel["from"])):
+                # target died, or the source primary is gone (normal
+                # promotion takes over) — abandon the move
+                entry.pop("relocating", None)
 
-    for name, meta in indices.items():
+    ctx = AllocationContext(data, live)
+
+    for name in sorted(indices):
+        meta = indices.get(name) or {}
         settings = meta.get("settings", {})
         num_shards = int(settings.get("number_of_shards", 1))
         num_replicas = int(settings.get("number_of_replicas", 0))
@@ -76,55 +90,210 @@ def allocate(data: dict, live_nodes: List[str]) -> dict:
             shards.append({"primary": None, "primary_term": 0,
                            "replicas": [], "active_replicas": []})
         for entry in shards:
-            live_set = set(live)
-            # scrub dead nodes
-            entry["replicas"] = [n for n in entry["replicas"]
-                                 if n in live_set]
-            entry["active_replicas"] = [n for n in entry["active_replicas"]
-                                        if n in live_set]
-            if entry["primary"] not in live_set:
-                entry["primary"] = None
-            # promote or assign a primary
-            if entry["primary"] is None:
-                if entry["active_replicas"]:
-                    promoted = entry["active_replicas"][0]
-                    entry["primary"] = promoted
-                    entry["replicas"] = [n for n in entry["replicas"]
-                                         if n != promoted]
-                    entry["active_replicas"] = [
-                        n for n in entry["active_replicas"] if n != promoted]
-                    entry["primary_term"] += 1
-                elif not entry["replicas"]:
-                    # no copies exist anywhere: fresh (empty) primary —
-                    # only safe when the shard has never been allocated
-                    # (term 0); otherwise wait for a copy to return
-                    if entry["primary_term"] == 0:
-                        node = _least_loaded(counts, set())
-                        if node is not None:
-                            entry["primary"] = node
-                            entry["primary_term"] = 1
-                            counts[node] += 1
-                # replicas still initializing (not active) can't be
-                # promoted — shard stays red until one activates
-            # reconcile replica count
-            holders = {entry["primary"]} | set(entry["replicas"])
-            holders.discard(None)
-            while (len(entry["replicas"]) < num_replicas
-                   and entry["primary"] is not None):
-                node = _least_loaded(counts, holders)
-                if node is None:
-                    break
-                entry["replicas"].append(node)
-                holders.add(node)
-                counts[node] += 1
-            while len(entry["replicas"]) > num_replicas:
-                dropped = entry["replicas"].pop()
-                entry["active_replicas"] = [
-                    n for n in entry["active_replicas"] if n != dropped]
-                if dropped in counts:
-                    counts[dropped] -= 1
+            _complete_relocation(ctx, name, entry)
+            # promotion BEFORE decider enforcement: a vetoed node's active
+            # replica may be the last in-sync copy of a primary-less shard —
+            # it must become primary (and then relocate copy-first), never
+            # be dropped
+            _assign_primary(ctx, name, entry)
+            _enforce_can_remain(ctx, name, entry)
+            _reconcile_replicas(ctx, name, entry, num_replicas)
+
+    _rebalance(ctx, routing)
     return data
 
+
+# ------------------------------------------------------------- per-shard ops
+
+def _complete_relocation(ctx: AllocationContext, index: str, entry: dict):
+    """Phase 2: the relocation target finished recovery — hand off."""
+    rel = entry.get("relocating")
+    if not rel:
+        return
+    target, source = rel["to"], rel["from"]
+    if target not in entry.get("active_replicas", []):
+        return                          # still recovering; keep waiting
+    if rel.get("primary"):
+        # handoff: promote the recovered target, retire the source copy
+        entry["primary"] = target
+        entry["primary_term"] = entry.get("primary_term", 0) + 1
+        entry["replicas"] = [n for n in entry["replicas"] if n != target]
+        entry["active_replicas"] = [n for n in entry["active_replicas"]
+                                    if n != target]
+        ctx.remove_copy(source, index)
+    else:
+        entry["replicas"] = [n for n in entry["replicas"] if n != source]
+        entry["active_replicas"] = [n for n in entry["active_replicas"]
+                                    if n != source]
+        ctx.remove_copy(source, index)
+    entry.pop("relocating", None)
+
+
+def _enforce_can_remain(ctx: AllocationContext, index: str, entry: dict):
+    """Move copies off nodes the deciders veto (filter changes, disk high
+    watermark): replicas drop and re-allocate; a primary relocates (copy
+    first, never drop data)."""
+    for node in list(entry.get("replicas", [])):
+        if entry.get("relocating", {}).get("to") == node:
+            continue                    # judged once its move completes
+        if entry.get("primary") is None and \
+                node in entry.get("active_replicas", []):
+            continue                    # last-copy safety: keep in-sync
+                                        # replicas while the shard is red
+        if can_remain(ctx, index, entry, node, is_primary=False).kind == NO:
+            entry["replicas"] = [n for n in entry["replicas"] if n != node]
+            entry["active_replicas"] = [n for n in entry["active_replicas"]
+                                        if n != node]
+            ctx.remove_copy(node, index)
+    primary = entry.get("primary")
+    if primary is None or entry.get("relocating"):
+        return
+    if can_remain(ctx, index, entry, primary, is_primary=True).kind != NO:
+        return
+    # prefer an immediate swap with an active replica on a permitted node
+    for candidate in entry.get("active_replicas", []):
+        if can_remain(ctx, index, entry, candidate, is_primary=True):
+            entry["primary"] = candidate
+            entry["primary_term"] = entry.get("primary_term", 0) + 1
+            entry["replicas"] = [n for n in entry["replicas"]
+                                 if n != candidate]
+            entry["active_replicas"] = [n for n in entry["active_replicas"]
+                                        if n != candidate]
+            ctx.remove_copy(primary, index)
+            return
+    # otherwise start a relocation to the best permitted node
+    target = _best_node(ctx, index, entry, is_primary=True)
+    if target is not None:
+        _start_relocation(ctx, index, entry, primary, target, primary=True)
+
+
+def _assign_primary(ctx: AllocationContext, index: str, entry: dict):
+    if entry.get("primary") is not None:
+        return
+    if entry.get("active_replicas"):
+        promoted = entry["active_replicas"][0]
+        entry["primary"] = promoted
+        entry["replicas"] = [n for n in entry["replicas"] if n != promoted]
+        entry["active_replicas"] = [n for n in entry["active_replicas"]
+                                    if n != promoted]
+        entry["primary_term"] = entry.get("primary_term", 0) + 1
+        return
+    if entry.get("replicas"):
+        # replicas still initializing (not active) can't be promoted —
+        # shard stays red until one activates
+        return
+    # no copies exist anywhere: fresh (empty) primary — only safe when the
+    # shard has never been allocated (term 0); otherwise wait for a copy
+    if entry.get("primary_term", 0) == 0:
+        node = _best_node(ctx, index, entry, is_primary=True)
+        if node is not None:
+            entry["primary"] = node
+            entry["primary_term"] = 1
+            ctx.add_copy(node, index, initializing=False)
+
+
+def _reconcile_replicas(ctx: AllocationContext, index: str, entry: dict,
+                        num_replicas: int):
+    rel = entry.get("relocating")
+    want = num_replicas + (1 if rel else 0)  # the move target is extra
+    while (len(entry["replicas"]) < want
+           and entry.get("primary") is not None):
+        node = _best_node(ctx, index, entry, is_primary=False)
+        if node is None:
+            break
+        entry["replicas"].append(node)
+        ctx.add_copy(node, index, initializing=True)
+    protected = {rel["to"]} if rel else set()
+    extra = [n for n in entry["replicas"] if n not in protected]
+    while len(entry["replicas"]) > want and extra:
+        dropped = extra.pop()
+        entry["replicas"] = [n for n in entry["replicas"] if n != dropped]
+        entry["active_replicas"] = [n for n in entry["active_replicas"]
+                                    if n != dropped]
+        ctx.remove_copy(dropped, index)
+
+
+def _best_node(ctx: AllocationContext, index: str, entry: dict,
+               is_primary: bool) -> Optional[str]:
+    """The permitted node minimizing the balance weight
+    (BalancedShardsAllocator.Balancer#weight): THROTTLE skips this pass —
+    the next reroute (every state change triggers one) retries."""
+    best, best_w = None, None
+    for node in ctx.live:
+        d = can_allocate(ctx, index, entry, node, is_primary)
+        if d.kind in (NO, THROTTLE):
+            continue
+        w = _weight(ctx, node, index)
+        if best_w is None or (w, node) < (best_w, best):
+            best, best_w = node, w
+    return best
+
+
+def _weight(ctx: AllocationContext, node: str, index: str) -> float:
+    shard_b = float(ctx.cluster_setting(
+        "cluster.routing.allocation.balance.shard", 0.45))
+    index_b = float(ctx.cluster_setting(
+        "cluster.routing.allocation.balance.index", 0.55))
+    return (shard_b * ctx.node_copies.get(node, 0)
+            + index_b * ctx.node_index_copies.get((node, index), 0))
+
+
+def _start_relocation(ctx: AllocationContext, index: str, entry: dict,
+                      source: str, target: str, primary: bool):
+    entry["relocating"] = {"from": source, "to": target, "primary": primary}
+    entry["replicas"] = entry.get("replicas", []) + [target]
+    ctx.add_copy(target, index, initializing=True)
+    # count the source as leaving so balance math sees the post-move world
+    ctx.remove_copy(source, index)
+
+
+# --------------------------------------------------------------- rebalancing
+
+def _rebalance(ctx: AllocationContext, routing: Dict[str, List[dict]]):
+    """One balancing pass: while an index's node-weight spread exceeds the
+    threshold, relocate one copy from the heaviest to the lightest permitted
+    node, up to cluster_concurrent_rebalance in-flight moves."""
+    if len(ctx.live) < 2:
+        return
+    max_moves = int(ctx.cluster_setting(
+        "cluster.routing.allocation.cluster_concurrent_rebalance", 2))
+    in_flight = sum(1 for shards in routing.values()
+                    for e in shards if e.get("relocating"))
+    threshold = float(ctx.cluster_setting(
+        "cluster.routing.allocation.balance.threshold", 1.0))
+    for index in sorted(routing):
+        while in_flight < max_moves:
+            ranked = sorted(ctx.live, key=lambda n: (_weight(ctx, n, index), n))
+            lightest, heaviest = ranked[0], ranked[-1]
+            if _weight(ctx, heaviest, index) \
+                    - _weight(ctx, lightest, index) <= threshold:
+                break
+            moved = _move_one(ctx, routing[index], index, heaviest, lightest)
+            if not moved:
+                break
+            in_flight += 1
+
+
+def _move_one(ctx: AllocationContext, shards: List[dict], index: str,
+              source: str, target: str) -> bool:
+    for entry in shards:
+        if entry.get("relocating"):
+            continue
+        is_primary = entry.get("primary") == source
+        holds = is_primary or source in entry.get("replicas", [])
+        if not holds:
+            continue
+        if not can_rebalance(ctx, moving_primary=is_primary):
+            continue
+        if not can_allocate(ctx, index, entry, target, is_primary):
+            continue
+        _start_relocation(ctx, index, entry, source, target,
+                          primary=is_primary)
+        return True
+    return False
+
+
+# ------------------------------------------------------------------- queries
 
 def shard_copies(entry: dict) -> List[str]:
     """All nodes holding a copy of the shard (primary first)."""

@@ -1,0 +1,371 @@
+"""Allocation deciders: the veto chain consulted before placing a shard copy.
+
+Re-design of the reference decider stack (the 23 classes under
+cluster/routing/allocation/decider/ — SameShardAllocationDecider.java,
+FilterAllocationDecider.java, AwarenessAllocationDecider.java,
+DiskThresholdDecider.java, ThrottlingAllocationDecider.java,
+EnableAllocationDecider.java, ShardsLimitAllocationDecider.java,
+ClusterRebalanceAllocationDecider.java,
+ConcurrentRebalanceAllocationDecider.java) as pure functions over the
+cluster-state payload dict. Each decider returns YES / NO / THROTTLE with a
+reason; the chain short-circuits on NO and downgrades to THROTTLE otherwise,
+exactly like AllocationDeciders.java's composite.
+
+Inputs come from cluster state, never from live node objects:
+  data["settings"]     flat cluster-level dynamic settings
+                       (cluster.routing.allocation.*)
+  data["node_attrs"]   node_id -> {attr: value} (node.attr.* at join time)
+  data["disk_usage"]   node_id -> used fraction 0..1 (reported by monitors;
+                       absent nodes are assumed fine, like a missing
+                       ClusterInfo in the reference)
+  meta["settings"]     index-level settings (index.routing.allocation.*)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+YES = "YES"
+NO = "NO"
+THROTTLE = "THROTTLE"
+
+
+@dataclass(frozen=True)
+class Decision:
+    kind: str
+    decider: str = ""
+    reason: str = ""
+
+    def __bool__(self) -> bool:
+        return self.kind == YES
+
+
+DECISION_YES = Decision(YES)
+
+
+class AllocationContext:
+    """Everything the deciders read, computed once per reroute pass."""
+
+    def __init__(self, data: dict, live: List[str]):
+        self.data = data
+        self.live = live
+        self.settings: Dict = data.get("settings") or {}
+        self.node_attrs: Dict[str, Dict] = data.get("node_attrs") or {}
+        self.disk_usage: Dict[str, float] = data.get("disk_usage") or {}
+        self.indices: Dict[str, dict] = data.get("indices") or {}
+        routing = data.get("routing") or {}
+        # copies per node and per (node, index); initializing recoveries
+        # per node (assigned replicas not yet active = inbound recoveries)
+        self.node_copies: Dict[str, int] = {n: 0 for n in live}
+        self.node_index_copies: Dict[tuple, int] = {}
+        self.node_recoveries: Dict[str, int] = {n: 0 for n in live}
+        for index, shards in routing.items():
+            for entry in shards:
+                for n in ([entry.get("primary")] + entry.get("replicas", [])):
+                    if n is None:
+                        continue
+                    self.node_copies[n] = self.node_copies.get(n, 0) + 1
+                    key = (n, index)
+                    self.node_index_copies[key] = \
+                        self.node_index_copies.get(key, 0) + 1
+                active = set(entry.get("active_replicas", []))
+                for n in entry.get("replicas", []):
+                    if n not in active:
+                        self.node_recoveries[n] = \
+                            self.node_recoveries.get(n, 0) + 1
+
+    def cluster_setting(self, key: str, default=None):
+        return self.settings.get(key, default)
+
+    def index_setting(self, index: str, key: str, default=None):
+        meta = self.indices.get(index) or {}
+        return (meta.get("settings") or {}).get(key, default)
+
+    def add_copy(self, node: str, index: str, initializing: bool):
+        """Account a placement made mid-pass so later decisions see it."""
+        self.node_copies[node] = self.node_copies.get(node, 0) + 1
+        key = (node, index)
+        self.node_index_copies[key] = self.node_index_copies.get(key, 0) + 1
+        if initializing:
+            self.node_recoveries[node] = self.node_recoveries.get(node, 0) + 1
+
+    def remove_copy(self, node: str, index: str):
+        self.node_copies[node] = max(0, self.node_copies.get(node, 0) - 1)
+        key = (node, index)
+        self.node_index_copies[key] = \
+            max(0, self.node_index_copies.get(key, 0) - 1)
+
+
+# ------------------------------------------------------------------ deciders
+
+def _same_shard(ctx, index, entry, node, is_primary) -> Decision:
+    """SameShardAllocationDecider: at most one copy of a shard per node."""
+    holders = set(entry.get("replicas", []))
+    if entry.get("primary"):
+        holders.add(entry["primary"])
+    if node in holders:
+        return Decision(NO, "same_shard",
+                        f"a copy of this shard is already allocated to "
+                        f"[{node}]")
+    return DECISION_YES
+
+
+def _filter_decider(ctx: AllocationContext, index: str, entry, node,
+                    is_primary) -> Decision:
+    """FilterAllocationDecider: cluster + index level include/exclude/require
+    on node name or custom attributes (flat keys like
+    index.routing.allocation.exclude.zone: "us-east")."""
+    attrs = ctx.node_attrs.get(node) or {}
+
+    def node_value(attr: str) -> Optional[str]:
+        if attr == "_name":
+            return node
+        return attrs.get(attr)
+
+    def check(settings: Dict, prefix: str, scope: str) -> Optional[Decision]:
+        for (mode, attr), csv in _filter_settings(settings, prefix):
+            values = [v.strip() for v in str(csv).split(",") if v.strip()]
+            actual = node_value(attr)
+            # empty values = the filter was cleared (the reference's
+            # "set to empty string to remove" idiom), never a veto-all
+            if mode == "require" and values and actual not in values:
+                return Decision(NO, "filter",
+                                f"node does not match {scope} require "
+                                f"filter [{attr}:{csv}]")
+            if mode == "include" and values and actual not in values:
+                return Decision(NO, "filter",
+                                f"node does not match {scope} include "
+                                f"filter [{attr}:{csv}]")
+            if mode == "exclude" and actual in values:
+                return Decision(NO, "filter",
+                                f"node matches {scope} exclude filter "
+                                f"[{attr}:{csv}]")
+        return None
+
+    # NB: Decision.__bool__ is YES-ness — compare to None for "no finding"
+    d = check(ctx.settings, "cluster.routing.allocation", "cluster")
+    if d is not None:
+        return d
+    meta_settings = (ctx.indices.get(index) or {}).get("settings") or {}
+    d = check(meta_settings, "index.routing.allocation", "index")
+    if d is not None:
+        return d
+    return DECISION_YES
+
+
+def _filter_settings(settings: Dict, prefix: str):
+    """Yield ((mode, attr), csv) for every flat filter key under prefix."""
+    for full, csv in settings.items():
+        if not isinstance(full, str) or not full.startswith(prefix + "."):
+            continue
+        rest = full[len(prefix) + 1:]
+        parts = rest.split(".", 1)
+        if len(parts) == 2 and parts[0] in ("require", "include", "exclude"):
+            yield (parts[0], parts[1]), csv
+
+
+def _awareness(ctx: AllocationContext, index: str, entry, node,
+               is_primary) -> Decision:
+    """AwarenessAllocationDecider: spread copies of a shard across the values
+    of each awareness attribute — a node may not hold a copy if doing so puts
+    more than ceil(copies / distinct_values) in its zone."""
+    attrs_csv = ctx.cluster_setting(
+        "cluster.routing.allocation.awareness.attributes", "")
+    attributes = [a.strip() for a in str(attrs_csv).split(",") if a.strip()]
+    if not attributes:
+        return DECISION_YES
+    copies = [n for n in ([entry.get("primary")]
+                          + entry.get("replicas", [])) if n]
+    total_copies = len(copies) + 1          # including the one being placed
+    for attr in attributes:
+        my_value = (ctx.node_attrs.get(node) or {}).get(attr)
+        if my_value is None:
+            continue                        # unlabeled nodes aren't gated
+        # forced values (awareness.force.zone.values) widen the divisor
+        forced = ctx.cluster_setting(
+            f"cluster.routing.allocation.awareness.force.{attr}.values", "")
+        values = {(ctx.node_attrs.get(n) or {}).get(attr)
+                  for n in ctx.live}
+        values.discard(None)
+        values.add(my_value)
+        values |= {v.strip() for v in str(forced).split(",") if v.strip()}
+        if not values:
+            continue
+        per_value = -(-total_copies // len(values))     # ceil
+        in_my_value = sum(
+            1 for n in copies
+            if (ctx.node_attrs.get(n) or {}).get(attr) == my_value)
+        if in_my_value + 1 > per_value:
+            return Decision(
+                NO, "awareness",
+                f"too many copies of the shard in [{attr}:{my_value}] "
+                f"({in_my_value + 1} > {per_value})")
+    return DECISION_YES
+
+
+def _disk_threshold(ctx: AllocationContext, index: str, entry, node,
+                    is_primary) -> Decision:
+    """DiskThresholdDecider: refuse new shards above the low watermark
+    (high watermark governs can_remain)."""
+    if str(ctx.cluster_setting(
+            "cluster.routing.allocation.disk.threshold_enabled",
+            True)).lower() in ("false", "0"):
+        return DECISION_YES
+    usage = ctx.disk_usage.get(node)
+    if usage is None:
+        return DECISION_YES
+    low = _pct(ctx.cluster_setting(
+        "cluster.routing.allocation.disk.watermark.low", "85%"))
+    if usage >= low:
+        return Decision(NO, "disk_threshold",
+                        f"node [{node}] exceeds the low watermark "
+                        f"({usage:.0%} >= {low:.0%})")
+    return DECISION_YES
+
+
+def _throttle(ctx: AllocationContext, index: str, entry, node,
+              is_primary) -> Decision:
+    """ThrottlingAllocationDecider: bound concurrent inbound recoveries per
+    node (a newly assigned replica recovers from its primary)."""
+    if is_primary:
+        return DECISION_YES         # primary (re)assignment is not a recovery
+    limit = int(ctx.cluster_setting(
+        "cluster.routing.allocation.node_concurrent_recoveries", 2))
+    if ctx.node_recoveries.get(node, 0) >= limit:
+        return Decision(THROTTLE, "throttling",
+                        f"node [{node}] already has {limit} concurrent "
+                        f"incoming recoveries")
+    return DECISION_YES
+
+
+def _enable(ctx: AllocationContext, index: str, entry, node,
+            is_primary) -> Decision:
+    """EnableAllocationDecider (allocation half)."""
+    mode = str(ctx.index_setting(
+        index, "index.routing.allocation.enable",
+        ctx.cluster_setting("cluster.routing.allocation.enable",
+                            "all"))).lower()
+    if mode == "all":
+        return DECISION_YES
+    if mode == "none":
+        return Decision(NO, "enable", "allocation is disabled")
+    if mode == "primaries" and not is_primary:
+        return Decision(NO, "enable", "replica allocation is disabled")
+    if mode == "new_primaries":
+        if not is_primary:
+            return Decision(NO, "enable", "replica allocation is disabled")
+        if entry.get("primary_term", 0) > 0:
+            return Decision(NO, "enable",
+                            "only NEW primary allocation is enabled")
+    return DECISION_YES
+
+
+def _shards_limit(ctx: AllocationContext, index: str, entry, node,
+                  is_primary) -> Decision:
+    """ShardsLimitAllocationDecider: total_shards_per_node at index and
+    cluster level."""
+    idx_limit = int(ctx.index_setting(
+        index, "index.routing.allocation.total_shards_per_node", -1))
+    if idx_limit >= 0 and \
+            ctx.node_index_copies.get((node, index), 0) >= idx_limit:
+        return Decision(NO, "shards_limit",
+                        f"node holds {idx_limit} shards of [{index}] "
+                        f"already (index.total_shards_per_node)")
+    cl_limit = int(ctx.cluster_setting(
+        "cluster.routing.allocation.total_shards_per_node", -1))
+    if cl_limit >= 0 and ctx.node_copies.get(node, 0) >= cl_limit:
+        return Decision(NO, "shards_limit",
+                        f"node holds {cl_limit} shards already "
+                        f"(cluster.total_shards_per_node)")
+    return DECISION_YES
+
+
+ALLOCATION_DECIDERS = (_enable, _same_shard, _filter_decider, _awareness,
+                       _disk_threshold, _shards_limit, _throttle)
+
+
+def can_allocate(ctx: AllocationContext, index: str, entry: dict,
+                 node: str, is_primary: bool) -> Decision:
+    """Run the chain; NO short-circuits, THROTTLE is sticky
+    (AllocationDeciders.java composite semantics)."""
+    throttled: Optional[Decision] = None
+    for decider in ALLOCATION_DECIDERS:
+        d = decider(ctx, index, entry, node, is_primary)
+        if d.kind == NO:
+            return d
+        if d.kind == THROTTLE and throttled is None:
+            throttled = d
+    # THROTTLE decisions are falsy (__bool__ is YES-ness): compare to None
+    return throttled if throttled is not None else DECISION_YES
+
+
+def can_remain(ctx: AllocationContext, index: str, entry: dict,
+               node: str, is_primary: bool) -> Decision:
+    """Whether an already-assigned copy may stay: filters and the HIGH disk
+    watermark (DiskThresholdDecider.canRemain)."""
+    d = _filter_decider(ctx, index, entry_without(entry, node), node,
+                        is_primary)
+    if d.kind == NO:
+        return d
+    if str(ctx.cluster_setting(
+            "cluster.routing.allocation.disk.threshold_enabled",
+            True)).lower() not in ("false", "0"):
+        usage = ctx.disk_usage.get(node)
+        if usage is not None:
+            high = _pct(ctx.cluster_setting(
+                "cluster.routing.allocation.disk.watermark.high", "90%"))
+            if usage >= high:
+                return Decision(NO, "disk_threshold",
+                                f"node [{node}] exceeds the high watermark "
+                                f"({usage:.0%} >= {high:.0%})")
+    return DECISION_YES
+
+
+def can_rebalance(ctx: AllocationContext, moving_primary: bool) -> Decision:
+    """EnableAllocationDecider (rebalance half) +
+    ClusterRebalanceAllocationDecider + ConcurrentRebalanceAllocationDecider.
+    Concurrent-move accounting is the caller's (moves_made counter)."""
+    mode = str(ctx.cluster_setting("cluster.routing.rebalance.enable",
+                                   "all")).lower()
+    if mode == "none":
+        return Decision(NO, "enable", "rebalancing is disabled")
+    if mode == "primaries" and not moving_primary:
+        return Decision(NO, "enable", "replica rebalancing is disabled")
+    if mode == "replicas" and moving_primary:
+        return Decision(NO, "enable", "primary rebalancing is disabled")
+    allow = str(ctx.cluster_setting(
+        "cluster.routing.allocation.allow_rebalance",
+        "indices_all_active")).lower()
+    routing = ctx.data.get("routing") or {}
+    if allow in ("indices_all_active", "indices_primaries_active"):
+        for shards in routing.values():
+            for entry in shards:
+                if entry.get("primary") is None:
+                    return Decision(NO, "cluster_rebalance",
+                                    "an unassigned primary exists")
+                if allow == "indices_all_active" and \
+                        set(entry.get("replicas", [])) != \
+                        set(entry.get("active_replicas", [])):
+                    return Decision(NO, "cluster_rebalance",
+                                    "a replica is still initializing")
+    return DECISION_YES
+
+
+def entry_without(entry: dict, node: str) -> dict:
+    """The shard entry as it would look without `node`'s copy — used by
+    can_remain so same_shard-style checks don't see the copy being judged."""
+    out = dict(entry)
+    if out.get("primary") == node:
+        out = {**out, "primary": None}
+    out["replicas"] = [n for n in entry.get("replicas", []) if n != node]
+    return out
+
+
+def _pct(value) -> float:
+    """'85%' → 0.85; numbers pass through (fractions expected)."""
+    s = str(value).strip()
+    if s.endswith("%"):
+        return float(s[:-1]) / 100.0
+    v = float(s)
+    return v / 100.0 if v > 1.0 else v

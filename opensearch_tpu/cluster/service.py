@@ -80,6 +80,12 @@ class ClusterNode:
         from opensearch_tpu.node import Node
         self.node_id = node_id
         self.settings = settings or {}
+        # node.attr.* settings become allocation-visible attributes
+        # (reference: DiscoveryNode attributes consumed by the awareness
+        # and filter deciders)
+        self.attrs = {k[len("node.attr."):]: str(v)
+                      for k, v in self.settings.items()
+                      if k.startswith("node.attr.")}
         self.local = Node(node_name=node_id, settings=settings)
         self.transport = TcpTransport(node_id, host=host, port=port)
         self.shards: Dict[Tuple[str, int], IndexShard] = {}
@@ -134,7 +140,8 @@ class ClusterNode:
         self._start_coordinator(ClusterState())
         resp = self.transport.send_sync(
             seed_id, REGISTER_ADDR,
-            {"node": self.node_id, "addr": list(self.address)},
+            {"node": self.node_id, "addr": list(self.address),
+             "attrs": self.attrs},
             timeout=10.0)
         # learn the cluster's address book so a leader-redirect from the
         # seed ("accepted": False, "leader": X) can actually be followed
@@ -279,6 +286,18 @@ class ClusterNode:
             elif kind == "register_address":
                 data["addresses"] = {**data["addresses"],
                                      **{update["node"]: update["addr"]}}
+                if update.get("attrs") is not None:
+                    data["node_attrs"] = {
+                        **(data.get("node_attrs") or {}),
+                        update["node"]: update["attrs"]}
+            elif kind == "cluster_settings":
+                merged = dict(data.get("settings") or {})
+                for k, v in update["settings"].items():
+                    if v is None:
+                        merged.pop(k, None)
+                    else:
+                        merged[k] = v
+                data["settings"] = merged
             data = allocate(data, sorted(state.nodes))
             return state.with_(data=data)
 
@@ -354,6 +373,14 @@ class ClusterNode:
         data = state.data or {}
         indices = data.get("indices", {})
         routing = data.get("routing", {})
+        # self-heal node attributes into state (bootstrap members never go
+        # through the join REGISTER_ADDR handshake); the fold is idempotent
+        if self.attrs and self.node_id in state.nodes and \
+                (data.get("node_attrs") or {}).get(self.node_id) != self.attrs:
+            self._on_register_address(
+                self.node_id, {"node": self.node_id,
+                               "addr": list(self.address),
+                               "attrs": self.attrs})
         for nid, addr in (data.get("addresses") or {}).items():
             if nid != self.node_id:
                 self.transport.add_address(nid, *addr)
@@ -566,7 +593,8 @@ class ClusterNode:
         if self.is_leader:
             self._leader_apply_update({"kind": "register_address",
                                        "node": payload["node"],
-                                       "addr": payload["addr"]})
+                                       "addr": payload["addr"],
+                                       "attrs": payload.get("attrs")})
         else:
             leader = self._leader_id()
             if leader and leader != payload["node"]:
@@ -1339,12 +1367,19 @@ class ClusterNode:
                 return self.cluster_state_api(), 200
             if len(parts) >= 2 and parts[1] == "settings" \
                     and method == "PUT" and isinstance(body, dict):
-                # intercept cluster.remote.*.seeds, then fall through so
-                # the local settings registry records the values too
+                # intercept cluster.remote.*.seeds and allocation settings
+                # (they must live in cluster state so every node's allocator
+                # sees them), then fall through so the local settings
+                # registry records the values too
                 flat = {}
                 for scope in ("persistent", "transient"):
                     flat.update(body.get(scope) or {})
                 self._apply_remote_settings(flat)
+                alloc = {k: v for k, v in flat.items()
+                         if k.startswith("cluster.routing.")}
+                if alloc:
+                    self._submit_to_leader({"kind": "cluster_settings",
+                                            "settings": alloc})
             return None
         if parts[0] == "_cat" and len(parts) > 1 and parts[1] == "shards":
             return self._cat_shards(), 200

@@ -1,0 +1,356 @@
+"""Allocation decider + rebalancing tests.
+
+Modeled on the reference suites: SameShardAllocationDeciderTests,
+FilterAllocationDeciderTests, AwarenessAllocationTests,
+DiskThresholdDeciderTests, ThrottlingAllocationTests,
+EnableAllocationDeciderTests, ShardsLimitAllocationTests, BalancedShardsAllocatorTests
+— exercised as pure functions over the cluster-state payload dict."""
+
+import pytest
+
+from opensearch_tpu.cluster.allocation import allocate, health_of, shard_copies
+
+
+def mkdata(num_shards=2, num_replicas=0, index="idx", extra_index_settings=None,
+           settings=None, node_attrs=None, disk=None):
+    idx_settings = {"number_of_shards": num_shards,
+                    "number_of_replicas": num_replicas}
+    idx_settings.update(extra_index_settings or {})
+    data = {"indices": {index: {"settings": idx_settings}}, "routing": {}}
+    if settings:
+        data["settings"] = settings
+    if node_attrs:
+        data["node_attrs"] = node_attrs
+    if disk:
+        data["disk_usage"] = disk
+    return data
+
+
+def activate_all(data):
+    """Simulate shard_started for every initializing replica."""
+    for shards in data["routing"].values():
+        for e in shards:
+            e["active_replicas"] = list(e["replicas"])
+    return data
+
+
+def nodes_used(data):
+    out = {}
+    for shards in data["routing"].values():
+        for e in shards:
+            for n in shard_copies(e):
+                out[n] = out.get(n, 0) + 1
+    return out
+
+
+class TestBasicAllocation:
+    def test_primaries_balanced_across_nodes(self):
+        data = allocate(mkdata(num_shards=4), ["n1", "n2"])
+        counts = nodes_used(data)
+        assert counts == {"n1": 2, "n2": 2}
+
+    def test_replica_never_with_its_primary(self):
+        data = allocate(mkdata(num_shards=2, num_replicas=1),
+                        ["n1", "n2"])
+        for e in data["routing"]["idx"]:
+            assert e["primary"] not in e["replicas"]
+
+    def test_unassignable_replica_stays_unassigned(self):
+        # single node: same_shard forbids the replica anywhere
+        data = allocate(mkdata(num_shards=1, num_replicas=1), ["n1"])
+        e = data["routing"]["idx"][0]
+        assert e["primary"] == "n1" and e["replicas"] == []
+
+    def test_idempotent(self):
+        data = allocate(mkdata(num_shards=3, num_replicas=1),
+                        ["n1", "n2", "n3"])
+        data = activate_all(data)
+        again = allocate(data, ["n1", "n2", "n3"])
+        assert again == data
+
+
+class TestFilterDecider:
+    def test_index_exclude_name(self):
+        data = allocate(mkdata(
+            num_shards=2,
+            extra_index_settings={
+                "index.routing.allocation.exclude._name": "n1"}),
+            ["n1", "n2"])
+        assert set(nodes_used(data)) == {"n2"}
+
+    def test_cluster_require_attr(self):
+        data = allocate(mkdata(
+            num_shards=2,
+            settings={"cluster.routing.allocation.require.box": "hot"},
+            node_attrs={"n1": {"box": "hot"}, "n2": {"box": "cold"}}),
+            ["n1", "n2"])
+        assert set(nodes_used(data)) == {"n1"}
+
+    def test_include_csv(self):
+        data = allocate(mkdata(
+            num_shards=4,
+            extra_index_settings={
+                "index.routing.allocation.include.zone": "a,b"},
+            node_attrs={"n1": {"zone": "a"}, "n2": {"zone": "b"},
+                        "n3": {"zone": "c"}}),
+            ["n1", "n2", "n3"])
+        assert "n3" not in nodes_used(data)
+
+    def test_exclude_change_moves_primary_copy_first(self):
+        # a primary on a newly excluded node relocates: new copy recovers
+        # BEFORE the source drops (two-phase, no data loss window)
+        data = allocate(mkdata(num_shards=1), ["n1", "n2"])
+        e = data["routing"]["idx"][0]
+        src = e["primary"]
+        other = "n2" if src == "n1" else "n1"
+        data["indices"]["idx"]["settings"][
+            "index.routing.allocation.exclude._name"] = src
+        moved = allocate(data, ["n1", "n2"])
+        e = moved["routing"]["idx"][0]
+        assert e["primary"] == src          # data stays until copy is ready
+        assert e["relocating"] == {"from": src, "to": other, "primary": True}
+        assert other in e["replicas"]
+        # target finishes recovery → handoff on the next reroute
+        e["active_replicas"] = [other]
+        done = allocate(moved, ["n1", "n2"])
+        e = done["routing"]["idx"][0]
+        assert e["primary"] == other and src not in shard_copies(e)
+        assert "relocating" not in e
+
+    def test_excluded_replica_drops_and_reallocates(self):
+        data = allocate(mkdata(num_shards=1, num_replicas=1),
+                        ["n1", "n2", "n3"])
+        data = activate_all(data)
+        e = data["routing"]["idx"][0]
+        rep = e["replicas"][0]
+        spare = ({"n1", "n2", "n3"} - {e["primary"], rep}).pop()
+        data["indices"]["idx"]["settings"][
+            "index.routing.allocation.exclude._name"] = rep
+        moved = allocate(data, ["n1", "n2", "n3"])
+        e = moved["routing"]["idx"][0]
+        assert e["replicas"] == [spare]
+
+
+class TestAwareness:
+    def test_copies_spread_across_zones(self):
+        data = allocate(mkdata(
+            num_shards=1, num_replicas=1,
+            settings={
+                "cluster.routing.allocation.awareness.attributes": "zone"},
+            node_attrs={"n1": {"zone": "a"}, "n2": {"zone": "a"},
+                        "n3": {"zone": "b"}}),
+            ["n1", "n2", "n3"])
+        e = data["routing"]["idx"][0]
+        zones = {{"n1": "a", "n2": "a", "n3": "b"}[n]
+                 for n in shard_copies(e)}
+        assert zones == {"a", "b"}
+
+    def test_same_zone_replica_blocked_when_forced(self):
+        # 2 copies, 2 forced zone values, both nodes in zone a: the replica
+        # may not join the primary's zone
+        data = allocate(mkdata(
+            num_shards=1, num_replicas=1,
+            settings={
+                "cluster.routing.allocation.awareness.attributes": "zone",
+                "cluster.routing.allocation.awareness.force.zone.values":
+                    "a,b"},
+            node_attrs={"n1": {"zone": "a"}, "n2": {"zone": "a"}}),
+            ["n1", "n2"])
+        e = data["routing"]["idx"][0]
+        assert e["primary"] is not None and e["replicas"] == []
+
+
+class TestDiskThreshold:
+    def test_low_watermark_blocks_new_shards(self):
+        data = allocate(mkdata(num_shards=4,
+                               disk={"n1": 0.90, "n2": 0.10}),
+                        ["n1", "n2"])
+        assert set(nodes_used(data)) == {"n2"}
+
+    def test_high_watermark_moves_copies_off(self):
+        data = allocate(mkdata(num_shards=1, num_replicas=1),
+                        ["n1", "n2", "n3"])
+        data = activate_all(data)
+        e = data["routing"]["idx"][0]
+        rep = e["replicas"][0]
+        data["disk_usage"] = {rep: 0.95}
+        moved = allocate(data, ["n1", "n2", "n3"])
+        e = moved["routing"]["idx"][0]
+        assert rep not in e["replicas"]
+
+    def test_disabled_threshold_ignores_disk(self):
+        data = allocate(mkdata(
+            num_shards=2,
+            settings={
+                "cluster.routing.allocation.disk.threshold_enabled": False},
+            disk={"n1": 0.99, "n2": 0.99}),
+            ["n1", "n2"])
+        assert sum(nodes_used(data).values()) == 2
+
+
+class TestThrottling:
+    def test_node_concurrent_recoveries(self):
+        # 6 replicas would all land on n2; only 2 may recover at once
+        data = allocate(mkdata(num_shards=6, num_replicas=1,
+                               settings={
+                                   "cluster.routing.allocation."
+                                   "node_concurrent_recoveries": 2}),
+                        ["n1", "n2"])
+        initializing = sum(
+            len(set(e["replicas"]) - set(e["active_replicas"]))
+            for e in data["routing"]["idx"])
+        assert initializing == 4        # 2 per node × 2 nodes
+
+    def test_throttled_replicas_resume_after_activation(self):
+        settings = {"cluster.routing.allocation."
+                    "node_concurrent_recoveries": 1}
+        data = allocate(mkdata(num_shards=4, num_replicas=1,
+                               settings=settings), ["n1", "n2"])
+        for _ in range(4):
+            data = activate_all(data)
+            data = allocate(data, ["n1", "n2"])
+        assert all(len(e["replicas"]) == 1
+                   for e in data["routing"]["idx"])
+
+
+class TestEnable:
+    def test_allocation_none(self):
+        data = allocate(mkdata(
+            num_shards=2,
+            settings={"cluster.routing.allocation.enable": "none"}),
+            ["n1", "n2"])
+        assert all(e["primary"] is None for e in data["routing"]["idx"])
+
+    def test_allocation_primaries_only(self):
+        data = allocate(mkdata(
+            num_shards=2, num_replicas=1,
+            settings={"cluster.routing.allocation.enable": "primaries"}),
+            ["n1", "n2"])
+        assert all(e["primary"] is not None and not e["replicas"]
+                   for e in data["routing"]["idx"])
+
+    def test_index_level_override(self):
+        data = mkdata(num_shards=1,
+                      settings={"cluster.routing.allocation.enable": "none"},
+                      extra_index_settings={
+                          "index.routing.allocation.enable": "all"})
+        out = allocate(data, ["n1"])
+        assert out["routing"]["idx"][0]["primary"] == "n1"
+
+
+class TestShardsLimit:
+    def test_index_total_shards_per_node(self):
+        data = allocate(mkdata(
+            num_shards=4,
+            extra_index_settings={
+                "index.routing.allocation.total_shards_per_node": 1}),
+            ["n1", "n2"])
+        counts = nodes_used(data)
+        assert all(v <= 1 for v in counts.values())
+        assigned = sum(1 for e in data["routing"]["idx"]
+                       if e["primary"] is not None)
+        assert assigned == 2            # 2 nodes × limit 1
+
+
+class TestRebalance:
+    def test_new_node_draws_relocations(self):
+        data = allocate(mkdata(num_shards=4), ["n1", "n2"])
+        data = activate_all(data)
+        out = allocate(data, ["n1", "n2", "n3"])
+        rels = [e["relocating"] for e in out["routing"]["idx"]
+                if e.get("relocating")]
+        assert rels and all(r["to"] == "n3" for r in rels)
+        # moves are primary relocations carried as extra replicas
+        for e in out["routing"]["idx"]:
+            if e.get("relocating"):
+                assert "n3" in e["replicas"]
+                assert e["primary"] != "n3"     # handoff not yet done
+
+    def test_relocation_completes_and_converges(self):
+        data = allocate(mkdata(num_shards=4), ["n1", "n2"])
+        data = activate_all(data)
+        data = allocate(data, ["n1", "n2", "n3"])
+        for _ in range(8):              # recover → handoff → next move
+            data = activate_all(data)
+            data = allocate(data, ["n1", "n2", "n3"])
+        counts = nodes_used(data)
+        assert counts.get("n3", 0) >= 1
+        assert max(counts.values()) - min(counts.values()) <= 1
+        assert not any(e.get("relocating")
+                       for e in data["routing"]["idx"])
+
+    def test_rebalance_disabled(self):
+        data = allocate(mkdata(num_shards=4), ["n1", "n2"])
+        data = activate_all(data)
+        data["settings"] = {"cluster.routing.rebalance.enable": "none"}
+        out = allocate(data, ["n1", "n2", "n3"])
+        assert not any(e.get("relocating") for e in out["routing"]["idx"])
+
+    def test_no_rebalance_while_replica_initializing(self):
+        # default allow_rebalance=indices_all_active
+        data = allocate(mkdata(num_shards=2, num_replicas=1),
+                        ["n1", "n2"])
+        out = allocate(data, ["n1", "n2", "n3"])
+        assert not any(e.get("relocating") for e in out["routing"]["idx"])
+
+    def test_relocation_target_death_abandons_move(self):
+        data = allocate(mkdata(num_shards=4), ["n1", "n2"])
+        data = activate_all(data)
+        data = allocate(data, ["n1", "n2", "n3"])
+        assert any(e.get("relocating") for e in data["routing"]["idx"])
+        out = allocate(data, ["n1", "n2"])      # n3 dies mid-move
+        for e in out["routing"]["idx"]:
+            assert not e.get("relocating")
+            assert "n3" not in shard_copies(e)
+            assert e["primary"] is not None     # no data lost
+
+
+class TestLastCopySafety:
+    def test_vetoed_last_active_replica_promotes_instead_of_dropping(self):
+        # primary's node died AND the operator excluded the replica's node
+        # in the same window: the replica is the last in-sync copy — it
+        # must be promoted (then relocated copy-first), never destroyed
+        data = {"indices": {"idx": {"settings": {
+                    "number_of_shards": 1, "number_of_replicas": 1,
+                    "index.routing.allocation.exclude._name": "B"}}},
+                "routing": {"idx": [{
+                    "primary": None, "primary_term": 2,
+                    "replicas": ["B"], "active_replicas": ["B"]}]}}
+        out = allocate(data, ["B", "C"])
+        e = out["routing"]["idx"][0]
+        assert e["primary"] == "B"              # promoted, data kept
+        assert e["primary_term"] == 3
+        assert e.get("relocating", {}).get("to") == "C"  # moving off B
+
+    def test_empty_require_filter_means_cleared(self):
+        # set-to-empty is the reference idiom for removing a filter; it
+        # must not veto every node
+        data = allocate(mkdata(
+            num_shards=2,
+            settings={"cluster.routing.allocation.require.box": ""}),
+            ["n1", "n2"])
+        assert sum(nodes_used(data).values()) == 2
+
+
+class TestNodeLoss:
+    def test_promotion_only_from_active(self):
+        data = allocate(mkdata(num_shards=1, num_replicas=1),
+                        ["n1", "n2"])
+        e = data["routing"]["idx"][0]
+        primary = e["primary"]
+        # replica still initializing: losing the primary leaves shard red
+        out = allocate(data, [n for n in ("n1", "n2") if n != primary])
+        assert out["routing"]["idx"][0]["primary"] is None
+        assert health_of(out) == "red"
+
+    def test_promotion_with_term_bump(self):
+        data = allocate(mkdata(num_shards=1, num_replicas=1),
+                        ["n1", "n2"])
+        data = activate_all(data)
+        e = data["routing"]["idx"][0]
+        primary, term = e["primary"], e["primary_term"]
+        survivor = "n2" if primary == "n1" else "n1"
+        out = allocate(data, [survivor])
+        e = out["routing"]["idx"][0]
+        assert e["primary"] == survivor
+        assert e["primary_term"] == term + 1

@@ -363,3 +363,58 @@ class TestAdaptiveReplicaSelection:
                          if served[nid] > before[nid]}
             assert served_by <= legal, \
                 f"query served by non-active copy {served_by - legal}"
+
+
+class TestAllocationFiltersLive:
+    """Decider settings flow through cluster state and physically move
+    shards (reference: FilterAllocationDecider + the reroute on settings
+    update in MetadataUpdateSettingsService)."""
+
+    def test_exclude_node_relocates_shards_with_data(self, cluster):
+        node = next(iter(cluster.values()))
+        node.request("PUT", "/move", {
+            "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+            "mappings": {"properties": {"body": {"type": "text"}}}})
+        node.await_health("green", timeout=30)
+        for i in range(10):
+            node.request("PUT", f"/move/_doc/m{i}",
+                         {"body": f"portable data {i}"})
+        node.request("POST", "/move/_refresh")
+        victim = node._data()["routing"]["move"][0]["primary"]
+        res = node.request("PUT", "/_cluster/settings", {"transient": {
+            "cluster.routing.allocation.exclude._name": victim}})
+        assert res["acknowledged"] is True
+
+        def moved_off():
+            routing = node._data()["routing"]["move"]
+            return all(victim not in ([e["primary"]] + e["replicas"])
+                       and e["primary"] is not None
+                       and not e.get("relocating")
+                       for e in routing)
+        wait_for(moved_off, timeout=60,
+                 msg="shards relocated off the excluded node")
+        # every document survived the copy-first relocation
+        node.request("POST", "/move/_refresh")
+        out = node.request("POST", "/move/_search", {
+            "query": {"match": {"body": "portable"}}, "size": 20})
+        assert out["hits"]["total"]["value"] == 10
+
+    def test_node_attrs_propagate_to_state(self):
+        nodes = {f"az-{i}": ClusterNode(
+            f"az-{i}", settings={"node.attr.zone": f"z{i % 2}"})
+            for i in range(2)}
+        try:
+            peers = {nid: n.address for nid, n in nodes.items()}
+            for n in nodes.values():
+                n.bootstrap(peers)
+            any_node = next(iter(nodes.values()))
+            wait_for(lambda: any(n.is_leader for n in nodes.values()),
+                     msg="leader")
+            wait_for(lambda: (any_node._data().get("node_attrs") or {})
+                     .get("az-0", {}).get("zone") == "z0"
+                     and (any_node._data().get("node_attrs") or {})
+                     .get("az-1", {}).get("zone") == "z1",
+                     msg="node attrs in cluster state")
+        finally:
+            for n in nodes.values():
+                n.close()
