@@ -136,15 +136,12 @@ def _enforce_can_remain(ctx: AllocationContext, index: str, entry: dict):
     for node in list(entry.get("replicas", [])):
         if entry.get("relocating", {}).get("to") == node:
             continue                    # judged once its move completes
-        if entry.get("primary") is None and \
-                node in entry.get("active_replicas", []):
-            continue                    # last-copy safety: keep in-sync
-                                        # replicas while the shard is red
         if can_remain(ctx, index, entry, node, is_primary=False).kind == NO:
+            was_initializing = node not in entry.get("active_replicas", [])
             entry["replicas"] = [n for n in entry["replicas"] if n != node]
             entry["active_replicas"] = [n for n in entry["active_replicas"]
                                         if n != node]
-            ctx.remove_copy(node, index)
+            ctx.remove_copy(node, index, initializing=was_initializing)
     primary = entry.get("primary")
     if primary is None or entry.get("relocating"):
         return
@@ -207,10 +204,11 @@ def _reconcile_replicas(ctx: AllocationContext, index: str, entry: dict,
     extra = [n for n in entry["replicas"] if n not in protected]
     while len(entry["replicas"]) > want and extra:
         dropped = extra.pop()
+        was_initializing = dropped not in entry.get("active_replicas", [])
         entry["replicas"] = [n for n in entry["replicas"] if n != dropped]
         entry["active_replicas"] = [n for n in entry["active_replicas"]
                                     if n != dropped]
-        ctx.remove_copy(dropped, index)
+        ctx.remove_copy(dropped, index, initializing=was_initializing)
 
 
 def _best_node(ctx: AllocationContext, index: str, entry: dict,

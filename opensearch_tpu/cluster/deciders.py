@@ -90,11 +90,15 @@ class AllocationContext:
         if initializing:
             self.node_recoveries[node] = self.node_recoveries.get(node, 0) + 1
 
-    def remove_copy(self, node: str, index: str):
+    def remove_copy(self, node: str, index: str,
+                    initializing: bool = False):
         self.node_copies[node] = max(0, self.node_copies.get(node, 0) - 1)
         key = (node, index)
         self.node_index_copies[key] = \
             max(0, self.node_index_copies.get(key, 0) - 1)
+        if initializing:
+            self.node_recoveries[node] = \
+                max(0, self.node_recoveries.get(node, 0) - 1)
 
 
 # ------------------------------------------------------------------ deciders
@@ -227,9 +231,12 @@ def _disk_threshold(ctx: AllocationContext, index: str, entry, node,
 def _throttle(ctx: AllocationContext, index: str, entry, node,
               is_primary) -> Decision:
     """ThrottlingAllocationDecider: bound concurrent inbound recoveries per
-    node (a newly assigned replica recovers from its primary)."""
-    if is_primary:
-        return DECISION_YES         # primary (re)assignment is not a recovery
+    node. Everything that lands with data transfer counts — new replicas AND
+    relocation targets (including primary moves, whose target recovers as a
+    replica first); only a fresh empty primary (no copies exist anywhere)
+    skips the gate."""
+    if is_primary and not shard_has_copies(entry):
+        return DECISION_YES         # brand-new empty shard: no recovery
     limit = int(ctx.cluster_setting(
         "cluster.routing.allocation.node_concurrent_recoveries", 2))
     if ctx.node_recoveries.get(node, 0) >= limit:
@@ -237,6 +244,10 @@ def _throttle(ctx: AllocationContext, index: str, entry, node,
                         f"node [{node}] already has {limit} concurrent "
                         f"incoming recoveries")
     return DECISION_YES
+
+
+def shard_has_copies(entry: dict) -> bool:
+    return bool(entry.get("primary") or entry.get("replicas"))
 
 
 def _enable(ctx: AllocationContext, index: str, entry, node,
@@ -344,9 +355,18 @@ def can_rebalance(ctx: AllocationContext, moving_primary: bool) -> Decision:
                 if entry.get("primary") is None:
                     return Decision(NO, "cluster_rebalance",
                                     "an unassigned primary exists")
-                if allow == "indices_all_active" and \
-                        set(entry.get("replicas", [])) != \
-                        set(entry.get("active_replicas", [])):
+                if allow != "indices_all_active":
+                    continue
+                # in-flight relocation targets don't count as initializing
+                # (the reference decider ignores relocations too, else the
+                # first move would veto all others and the concurrent-
+                # rebalance budget could never be reached)
+                initializing = (set(entry.get("replicas", []))
+                                - set(entry.get("active_replicas", [])))
+                rel = entry.get("relocating")
+                if rel:
+                    initializing.discard(rel["to"])
+                if initializing:
                     return Decision(NO, "cluster_rebalance",
                                     "a replica is still initializing")
     return DECISION_YES

@@ -1,0 +1,212 @@
+"""Persistent tasks: cluster-state-backed long-running work that survives
+node loss.
+
+Re-design of persistent/PersistentTasksClusterService.java +
+PersistentTasksNodeService.java + AllocatedPersistentTask: a task lives in
+cluster state (``data["persistent_tasks"]``), the leader assigns it to a
+live node, the owning node's reconcile loop runs the registered executor,
+and when the owner leaves the cluster the leader reassigns the task —
+bumping ``allocation_id`` so a zombie executor from the old allocation can
+never complete or update the new one (the reference's allocation-id fencing
+in PersistentTasksClusterService#completePersistentTask).
+
+State shape:
+  data["persistent_tasks"] = {
+    task_id: {"name": executor_name, "params": {...},
+              "node": node_id | None,     # current assignment
+              "allocation_id": int,        # bumped on every (re)assignment
+              "status": {...} | None},     # executor-reported progress
+  }
+
+Executors register process-wide by name; the executor callable receives
+(params, ctx) where ctx is a PersistentTaskContext with is_cancelled(),
+update_status(dict) and the owning node. Returning normally completes and
+removes the task; raising marks it failed (kept in state with the error so
+operators can see it, like the reference's failure status).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+# executor registry: name -> fn(params, ctx) -> result
+# (PersistentTasksExecutor registry built by plugins in the reference)
+PERSISTENT_EXECUTORS: Dict[str, Callable] = {}
+
+
+def register_executor(name: str, fn: Callable) -> None:
+    PERSISTENT_EXECUTORS[name] = fn
+
+
+class PersistentTaskContext:
+    """Handed to a running executor (AllocatedPersistentTask analog)."""
+
+    def __init__(self, cluster_node, task_id: str, allocation_id: int):
+        self.cluster_node = cluster_node
+        self.task_id = task_id
+        self.allocation_id = allocation_id
+        self._cancelled = threading.Event()
+
+    def is_cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def cancel(self):
+        self._cancelled.set()
+
+    def update_status(self, status: dict):
+        """Report progress into cluster state (updatePersistentTaskState);
+        fenced by allocation id — a stale executor's update is dropped."""
+        self.cluster_node._submit_to_leader({
+            "kind": "persistent_task_status", "id": self.task_id,
+            "allocation_id": self.allocation_id, "status": status})
+
+
+def assign_tasks(data: dict, live: list) -> None:
+    """Leader-side assignment pass, run inside every state fold (mutates
+    `data` in place, like the allocator): tasks on dead nodes reassign to
+    the live node with the fewest tasks, with an allocation-id bump."""
+    tasks: Dict[str, dict] = data.get("persistent_tasks") or {}
+    if not tasks:
+        return
+    live_set = set(live)
+    loads = {n: 0 for n in live}
+    for t in tasks.values():
+        if t.get("node") in loads:
+            loads[t["node"]] += 1
+    changed = False
+    new_tasks = dict(tasks)
+    for tid, t in tasks.items():
+        if t.get("failed"):
+            continue                     # kept for visibility, never re-run
+        if t.get("node") in live_set:
+            continue
+        target: Optional[str] = None
+        if loads:
+            target = min(sorted(loads), key=lambda n: loads[n])
+        nt = dict(t)
+        nt["node"] = target
+        if target is not None:
+            nt["allocation_id"] = t.get("allocation_id", 0) + 1
+            loads[target] += 1
+        new_tasks = {**new_tasks, tid: nt}
+        changed = True
+    if changed:
+        data["persistent_tasks"] = new_tasks
+
+
+def fold_update(data: dict, update: dict) -> None:
+    """Apply a persistent-task state mutation (the mutate() arms)."""
+    kind = update["kind"]
+    tasks = dict(data.get("persistent_tasks") or {})
+    if kind == "persistent_task_start":
+        tid = update["id"]
+        if tid in tasks:
+            from opensearch_tpu.common.errors import IllegalArgumentError
+            raise IllegalArgumentError(
+                f"persistent task [{tid}] already exists")
+        tasks[tid] = {"name": update["name"],
+                      "params": update.get("params") or {},
+                      "node": None, "allocation_id": 0, "status": None}
+    elif kind == "persistent_task_complete":
+        t = tasks.get(update["id"])
+        # allocation-id fencing: a reassigned task's old owner can't
+        # complete the new allocation
+        if t and t.get("allocation_id") == update["allocation_id"]:
+            if update.get("error") is not None:
+                tasks[update["id"]] = {**t, "failed": True,
+                                       "error": update["error"],
+                                       "node": None}
+            else:
+                del tasks[update["id"]]
+    elif kind == "persistent_task_status":
+        t = tasks.get(update["id"])
+        if t and t.get("allocation_id") == update["allocation_id"]:
+            tasks[update["id"]] = {**t, "status": update["status"]}
+    elif kind == "persistent_task_remove":
+        tasks.pop(update["id"], None)
+    data["persistent_tasks"] = tasks
+
+
+class PersistentTaskRunner:
+    """Node-side execution (PersistentTasksNodeService): compares the
+    state's assignments against locally running allocations, starts new
+    ones on the worker pool, cancels ones that moved away or vanished."""
+
+    def __init__(self, cluster_node):
+        self.cluster_node = cluster_node
+        self._running: Dict[str, PersistentTaskContext] = {}
+        self._reported: Dict[str, int] = {}   # task -> alloc failed as
+                                              # incapable (dedup)
+        self._lock = threading.Lock()
+
+    def reconcile(self, data: dict) -> None:
+        tasks: Dict[str, dict] = data.get("persistent_tasks") or {}
+        my_id = self.cluster_node.node_id
+        with self._lock:
+            # cancel allocations we no longer own
+            for tid, ctx in list(self._running.items()):
+                t = tasks.get(tid)
+                if (t is None or t.get("node") != my_id
+                        or t.get("allocation_id") != ctx.allocation_id):
+                    ctx.cancel()
+                    del self._running[tid]
+            # start newly assigned ones
+            for tid, t in tasks.items():
+                if t.get("node") != my_id or t.get("failed"):
+                    continue
+                if tid in self._running:
+                    continue
+                fn = PERSISTENT_EXECUTORS.get(t["name"])
+                if fn is None:
+                    # no executor in this process: fail the task visibly
+                    # instead of letting it sit assigned-but-never-running
+                    # (the reference only assigns to capable nodes; we
+                    # surface incapability as a recorded failure)
+                    alloc = t.get("allocation_id", 0)
+                    if self._reported.get(tid) != alloc:
+                        self._reported[tid] = alloc
+                        self.cluster_node.transport._workers.submit(
+                            self._report_incapable, tid, alloc, t["name"])
+                    continue
+                ctx = PersistentTaskContext(self.cluster_node, tid,
+                                            t.get("allocation_id", 0))
+                self._running[tid] = ctx
+                self.cluster_node.transport._workers.submit(
+                    self._run, fn, dict(t.get("params") or {}), ctx)
+
+    def _run(self, fn, params: dict, ctx: PersistentTaskContext):
+        error = None
+        try:
+            fn(params, ctx)
+        except Exception as e:           # executor failure -> failed status
+            error = str(e) or type(e).__name__
+        if ctx.is_cancelled():
+            return                       # moved away; the new owner reports
+        try:
+            self.cluster_node._submit_to_leader({
+                "kind": "persistent_task_complete", "id": ctx.task_id,
+                "allocation_id": ctx.allocation_id, "error": error})
+        except Exception:
+            pass                         # leader gone: reassignment follows
+
+    def _report_incapable(self, tid: str, alloc: int, name: str):
+        try:
+            self.cluster_node._submit_to_leader({
+                "kind": "persistent_task_complete", "id": tid,
+                "allocation_id": alloc,
+                "error": f"no executor registered for [{name}] on "
+                         f"[{self.cluster_node.node_id}]"})
+        except Exception:
+            self._reported.pop(tid, None)   # retry on the next reconcile
+
+    def running_ids(self):
+        with self._lock:
+            return dict((tid, c.allocation_id)
+                        for tid, c in self._running.items())
+
+    def shutdown(self):
+        with self._lock:
+            for ctx in self._running.values():
+                ctx.cancel()
+            self._running.clear()

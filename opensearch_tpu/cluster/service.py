@@ -110,6 +110,9 @@ class ClusterNode:
         self._reconcile_scheduled = False
         self.coordinator: Optional[Coordinator] = None
         self._started = False
+        # persistent tasks (PersistentTasksNodeService analog)
+        from opensearch_tpu.cluster.persistent import PersistentTaskRunner
+        self.persistent_tasks = PersistentTaskRunner(self)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -160,6 +163,7 @@ class ClusterNode:
 
     def close(self):
         self._started = False
+        self.persistent_tasks.shutdown()
         if self.coordinator is not None:
             self.coordinator.stop()
         self.transport.close()
@@ -298,7 +302,12 @@ class ClusterNode:
                     else:
                         merged[k] = v
                 data["settings"] = merged
+            elif kind.startswith("persistent_task_"):
+                from opensearch_tpu.cluster.persistent import fold_update
+                fold_update(data, update)
             data = allocate(data, sorted(state.nodes))
+            from opensearch_tpu.cluster.persistent import assign_tasks
+            assign_tasks(data, sorted(state.nodes))
             return state.with_(data=data)
 
         # coordinator methods must run on the event-loop thread; the
@@ -397,13 +406,18 @@ class ClusterNode:
         # a re-allocation — this is what promotes replicas after a primary's
         # node dies and re-replicates after node loss
         if self.is_leader:
+            from opensearch_tpu.cluster.persistent import assign_tasks
             reallocated = allocate(data, sorted(state.nodes))
+            assign_tasks(reallocated, sorted(state.nodes))
             if reallocated != data:
                 def reroute(s: ClusterState) -> ClusterState:
-                    return s.with_(data=allocate(dict(s.data or {}),
-                                                 sorted(s.nodes)))
+                    newdata = allocate(dict(s.data or {}), sorted(s.nodes))
+                    assign_tasks(newdata, sorted(s.nodes))
+                    return s.with_(data=newdata)
                 self.transport.post(
                     lambda: self.coordinator.submit_state_update(reroute))
+        # persistent tasks: start/cancel executors per the state assignments
+        self.persistent_tasks.reconcile(data)
         # remove shards we no longer own (or whose index is gone)
         for (name, sid) in list(self.shards):
             entry = (routing.get(name) or [None] * (sid + 1))[sid] \
@@ -1116,6 +1130,28 @@ class ClusterNode:
     def remove_remote(self, alias: str):
         self._remotes.pop(alias, None)
 
+    # ------------------------------------------------------ persistent tasks
+
+    def start_persistent_task(self, task_id: str, name: str,
+                              params: Optional[dict] = None) -> dict:
+        """Create a cluster-persistent task (PersistentTasksService#
+        sendStartRequest): the leader folds it into state, assigns it to a
+        live node, and reassigns on node loss."""
+        self._submit_to_leader({"kind": "persistent_task_start",
+                                "id": task_id, "name": name,
+                                "params": params or {}})
+        return {"acknowledged": True, "task_id": task_id}
+
+    def remove_persistent_task(self, task_id: str) -> dict:
+        """Cancel + remove (sendRemoveRequest): the owning node's reconcile
+        observes the removal and cancels the local executor."""
+        self._submit_to_leader({"kind": "persistent_task_remove",
+                                "id": task_id})
+        return {"acknowledged": True}
+
+    def list_persistent_tasks(self) -> dict:
+        return dict((self._data().get("persistent_tasks") or {}))
+
     def _apply_remote_settings(self, settings: dict):
         """cluster.remote.<alias>.seeds handling for _cluster/settings:
         the registry is published THROUGH cluster state so every
@@ -1527,8 +1563,14 @@ class ClusterNode:
         data = self._data()
         return {"cluster_manager_node": self._leader_id(),
                 "version": st.version if st else 0,
-                "nodes": {n: {"name": n} for n in (st.nodes if st else [])},
-                "metadata": {"indices": data.get("indices", {})},
+                "nodes": {n: {"name": n, "attributes":
+                              (data.get("node_attrs") or {}).get(n, {})}
+                          for n in (st.nodes if st else [])},
+                "metadata": {
+                    "indices": data.get("indices", {}),
+                    "persistent_tasks": {
+                        "tasks": data.get("persistent_tasks", {})},
+                    "cluster_settings": data.get("settings", {})},
                 "routing_table": data.get("routing", {})}
 
     def _cat_shards(self) -> dict:

@@ -305,6 +305,35 @@ class TestRebalance:
             assert e["primary"] is not None     # no data lost
 
 
+class TestRelocationThrottle:
+    def test_primary_drain_respects_recovery_throttle(self):
+        # excluding a node holding 5 primaries must not start 5 concurrent
+        # relocations onto one target when node_concurrent_recoveries=2
+        data = allocate(mkdata(
+            num_shards=5,
+            settings={"cluster.routing.allocation."
+                      "node_concurrent_recoveries": 2},
+            extra_index_settings={
+                "index.routing.allocation.total_shards_per_node": 5}),
+            ["n1"])
+        data["indices"]["idx"]["settings"][
+            "index.routing.allocation.exclude._name"] = "n1"
+        out = allocate(data, ["n1", "n2"])
+        moving = sum(1 for e in out["routing"]["idx"]
+                     if e.get("relocating"))
+        assert moving == 2
+
+    def test_second_rebalance_move_not_blocked_by_first(self):
+        # the first move's initializing target must not veto the second
+        # (cluster_concurrent_rebalance defaults to 2)
+        data = allocate(mkdata(num_shards=6), ["n1", "n2"])
+        data = activate_all(data)
+        out = allocate(data, ["n1", "n2", "n3"])
+        moving = sum(1 for e in out["routing"]["idx"]
+                     if e.get("relocating"))
+        assert moving == 2
+
+
 class TestLastCopySafety:
     def test_vetoed_last_active_replica_promotes_instead_of_dropping(self):
         # primary's node died AND the operator excluded the replica's node

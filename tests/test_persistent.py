@@ -1,0 +1,212 @@
+"""Persistent tasks: cluster-state-backed work that survives node loss.
+
+Modeled on the reference suites: PersistentTasksClusterServiceTests
+(assignment/reassignment), PersistentTasksNodeServiceTests (node-side
+start/cancel), PersistentTasksExecutorFullRestartIT (survival semantics)."""
+
+import time
+
+import pytest
+
+from opensearch_tpu.cluster.persistent import (
+    PERSISTENT_EXECUTORS, assign_tasks, fold_update, register_executor)
+from opensearch_tpu.cluster.service import ClusterNode
+
+
+def wait_for(cond, timeout=30, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture()
+def executors():
+    saved = dict(PERSISTENT_EXECUTORS)
+
+    def waiter(params, ctx):
+        beats = 0
+        while not ctx.is_cancelled():
+            beats += 1
+            ctx.update_status({"beats": beats})
+            time.sleep(0.05)
+
+    def oneshot(params, ctx):
+        ctx.update_status({"done": params.get("value")})
+
+    def failer(params, ctx):
+        raise RuntimeError("executor exploded")
+
+    register_executor("waiter", waiter)
+    register_executor("oneshot", oneshot)
+    register_executor("failer", failer)
+    yield
+    PERSISTENT_EXECUTORS.clear()
+    PERSISTENT_EXECUTORS.update(saved)
+
+
+def boot(n=3):
+    nodes = {f"pt-{i}": ClusterNode(f"pt-{i}") for i in range(n)}
+    peers = {nid: node.address for nid, node in nodes.items()}
+    for node in nodes.values():
+        node.bootstrap(peers)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if any(n.is_leader for n in nodes.values()):
+            return nodes
+        time.sleep(0.05)
+    raise AssertionError("no leader")
+
+
+class TestFoldAndAssign:
+    """Pure state-transition semantics, no sockets."""
+
+    def test_assign_to_least_loaded(self):
+        data = {"persistent_tasks": {
+            "a": {"name": "w", "params": {}, "node": "n1",
+                  "allocation_id": 1},
+            "b": {"name": "w", "params": {}, "node": None,
+                  "allocation_id": 0}}}
+        assign_tasks(data, ["n1", "n2"])
+        assert data["persistent_tasks"]["b"]["node"] == "n2"
+        assert data["persistent_tasks"]["b"]["allocation_id"] == 1
+
+    def test_reassign_bumps_allocation(self):
+        data = {"persistent_tasks": {
+            "a": {"name": "w", "params": {}, "node": "dead",
+                  "allocation_id": 3}}}
+        assign_tasks(data, ["n1"])
+        t = data["persistent_tasks"]["a"]
+        assert t["node"] == "n1" and t["allocation_id"] == 4
+
+    def test_stale_allocation_cannot_complete(self):
+        data = {"persistent_tasks": {
+            "a": {"name": "w", "params": {}, "node": "n2",
+                  "allocation_id": 5}}}
+        fold_update(data, {"kind": "persistent_task_complete", "id": "a",
+                           "allocation_id": 4, "error": None})
+        assert "a" in data["persistent_tasks"]    # fenced
+        fold_update(data, {"kind": "persistent_task_complete", "id": "a",
+                           "allocation_id": 5, "error": None})
+        assert "a" not in data["persistent_tasks"]
+
+    def test_failed_task_kept_with_error_and_not_reassigned(self):
+        data = {"persistent_tasks": {
+            "a": {"name": "w", "params": {}, "node": "n1",
+                  "allocation_id": 1}}}
+        fold_update(data, {"kind": "persistent_task_complete", "id": "a",
+                           "allocation_id": 1, "error": "boom"})
+        t = data["persistent_tasks"]["a"]
+        assert t["failed"] and t["error"] == "boom"
+        assign_tasks(data, ["n2"])
+        assert data["persistent_tasks"]["a"].get("node") is None
+
+    def test_duplicate_start_rejected(self):
+        from opensearch_tpu.common.errors import IllegalArgumentError
+        data = {}
+        fold_update(data, {"kind": "persistent_task_start", "id": "a",
+                           "name": "w"})
+        with pytest.raises(IllegalArgumentError):
+            fold_update(data, {"kind": "persistent_task_start", "id": "a",
+                               "name": "w"})
+
+
+class TestLiveCluster:
+    def test_task_runs_reports_status_and_survives_node_loss(self, executors):
+        nodes = boot(3)
+        try:
+            any_node = next(iter(nodes.values()))
+            any_node.start_persistent_task("t1", "waiter", {"x": 1})
+
+            def assigned_and_beating():
+                t = (any_node._data().get("persistent_tasks") or {}).get("t1")
+                return t and t.get("node") and \
+                    (t.get("status") or {}).get("beats", 0) >= 2
+            wait_for(assigned_and_beating, msg="task running with status")
+            t = any_node._data()["persistent_tasks"]["t1"]
+            owner, alloc = t["node"], t["allocation_id"]
+            assert "t1" in nodes[owner].persistent_tasks.running_ids()
+
+            # kill the owner: the leader must reassign with an alloc bump
+            survivors = {nid: n for nid, n in nodes.items() if nid != owner}
+            nodes[owner].close()
+            watcher = next(iter(survivors.values()))
+
+            def reassigned():
+                t = (watcher._data().get("persistent_tasks") or {}).get("t1")
+                return t and t.get("node") in survivors \
+                    and t["allocation_id"] > alloc
+            wait_for(reassigned, timeout=60, msg="task reassigned")
+            t = watcher._data()["persistent_tasks"]["t1"]
+
+            def running_on_new_owner():
+                return "t1" in \
+                    survivors[t["node"]].persistent_tasks.running_ids()
+            wait_for(running_on_new_owner, msg="executor on new owner")
+        finally:
+            for n in nodes.values():
+                n.close()
+
+    def test_oneshot_completes_and_leaves_state(self, executors):
+        nodes = boot(2)
+        try:
+            any_node = next(iter(nodes.values()))
+            any_node.start_persistent_task("once", "oneshot", {"value": 42})
+            wait_for(lambda: "once" not in
+                     (any_node._data().get("persistent_tasks") or {}),
+                     msg="oneshot completed and removed")
+        finally:
+            for n in nodes.values():
+                n.close()
+
+    def test_failing_executor_marks_failed(self, executors):
+        nodes = boot(2)
+        try:
+            any_node = next(iter(nodes.values()))
+            any_node.start_persistent_task("bad", "failer")
+
+            def failed():
+                t = (any_node._data().get("persistent_tasks") or {}) \
+                    .get("bad")
+                return t and t.get("failed") and "exploded" in t["error"]
+            wait_for(failed, msg="failure recorded")
+        finally:
+            for n in nodes.values():
+                n.close()
+
+    def test_unknown_executor_fails_visibly(self, executors):
+        nodes = boot(2)
+        try:
+            any_node = next(iter(nodes.values()))
+            any_node.start_persistent_task("ghost", "not_registered")
+
+            def failed():
+                t = (any_node._data().get("persistent_tasks") or {}) \
+                    .get("ghost")
+                return t and t.get("failed") \
+                    and "no executor registered" in t["error"]
+            wait_for(failed, msg="incapability recorded as failure")
+        finally:
+            for n in nodes.values():
+                n.close()
+
+    def test_remove_cancels_running_executor(self, executors):
+        nodes = boot(2)
+        try:
+            any_node = next(iter(nodes.values()))
+            any_node.start_persistent_task("t2", "waiter")
+
+            def running_somewhere():
+                return any("t2" in n.persistent_tasks.running_ids()
+                           for n in nodes.values())
+            wait_for(running_somewhere, msg="executor started")
+            any_node.remove_persistent_task("t2")
+            wait_for(lambda: not running_somewhere(),
+                     msg="executor cancelled")
+            assert "t2" not in (any_node._data()
+                                .get("persistent_tasks") or {})
+        finally:
+            for n in nodes.values():
+                n.close()
