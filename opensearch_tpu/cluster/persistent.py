@@ -151,6 +151,11 @@ class PersistentTaskRunner:
                         or t.get("allocation_id") != ctx.allocation_id):
                     ctx.cancel()
                     del self._running[tid]
+            # prune incapability dedup entries for gone/moved tasks
+            for tid in list(self._reported):
+                t = tasks.get(tid)
+                if t is None or t.get("allocation_id") != self._reported[tid]:
+                    del self._reported[tid]
             # start newly assigned ones
             for tid, t in tasks.items():
                 if t.get("node") != my_id or t.get("failed"):
@@ -181,14 +186,20 @@ class PersistentTaskRunner:
             fn(params, ctx)
         except Exception as e:           # executor failure -> failed status
             error = str(e) or type(e).__name__
-        if ctx.is_cancelled():
-            return                       # moved away; the new owner reports
-        try:
-            self.cluster_node._submit_to_leader({
-                "kind": "persistent_task_complete", "id": ctx.task_id,
-                "allocation_id": ctx.allocation_id, "error": error})
-        except Exception:
-            pass                         # leader gone: reassignment follows
+        # report completion, retrying through leader outages — without the
+        # retry a completed task whose submit raced a leaderless window
+        # would sit in state forever (the owner is alive, so reassignment
+        # never triggers). Cancellation (reassignment/removal) ends the
+        # loop: the new owner reports instead.
+        import time as _time
+        while not ctx.is_cancelled():
+            try:
+                self.cluster_node._submit_to_leader({
+                    "kind": "persistent_task_complete", "id": ctx.task_id,
+                    "allocation_id": ctx.allocation_id, "error": error})
+                return
+            except Exception:
+                _time.sleep(1.0)
 
     def _report_incapable(self, tid: str, alloc: int, name: str):
         try:

@@ -873,11 +873,29 @@ class ClusterNode:
         name = payload["index"]
         body = payload["body"]
         k = payload["k"]
-        out = []
+        from opensearch_tpu.search.canmatch import shard_can_match
+        shards = {}
         for sid in payload["shards"]:
             shard = self.shards.get((name, sid))
             if shard is None:
                 raise ShardNotReadyError(f"shard [{name}][{sid}] not local")
+            shards[sid] = shard
+        # data-node-side can-match (SearchService#canMatch): a provably
+        # empty shard skips plan compilation and the device launch. If an
+        # aggs request would skip ALL local shards, one still executes so
+        # the reduce gets properly-shaped empty agg partials.
+        skip = {sid for sid, sh in shards.items()
+                if not shard_can_match(sh.executor, body)}
+        if (body.get("aggs") or body.get("aggregations")) \
+                and skip == set(shards):
+            skip.discard(min(skip))
+        out = []
+        for sid, shard in shards.items():
+            if sid in skip:
+                out.append({"shard": sid, "candidates": Opaque([]),
+                            "partials": Opaque([]), "total": 0,
+                            "skipped": True})
+                continue
             cands, decoded, total = shard.executor.execute_query_phase(
                 body, k)
             out.append({"shard": sid,
@@ -989,11 +1007,12 @@ class ClusterNode:
             all_candidates: List[_Candidate] = []
             all_partials = []
             total = 0
+            skipped = 0
             lock = threading.Lock()
             errors: List[Exception] = []
 
             def query_node_shards(node: str, sids: List[int]):
-                nonlocal total
+                nonlocal total, skipped
                 payload = {"index": name, "shards": sids, "body": body,
                            "k": k}
                 t0 = time.monotonic()
@@ -1015,6 +1034,8 @@ class ClusterNode:
                                 all_candidates.append(c)
                             all_partials.extend(_unwrap(res["partials"]))
                             total += res["total"]
+                            if res.get("skipped"):
+                                skipped += 1
                 except Exception as e:
                     errors.append(e)
                 finally:
@@ -1045,7 +1066,7 @@ class ClusterNode:
             time.sleep(0.1)
 
         return (all_candidates, all_partials, total, shard_nodes,
-                len(routing[name]))
+                len(routing[name]), skipped)
 
     def _cluster_fetch(self, name: str, body: dict, page: List,
                        shard_nodes: Dict[int, str]) -> Dict[Tuple, dict]:
@@ -1087,7 +1108,7 @@ class ClusterNode:
         k = max(from_ + size, 10)
 
         (all_candidates, all_partials, total, shard_nodes,
-         n_shards) = self._cluster_query_phase(name, body, k)
+         n_shards, skipped) = self._cluster_query_phase(name, body, k)
 
         # coordinator reduce: global sort + page (SearchPhaseController)
         all_candidates.sort(key=_compare_candidates(sort_specs))
@@ -1106,7 +1127,7 @@ class ClusterNode:
             "took": int((time.monotonic() - start) * 1000),
             "timed_out": False,
             "_shards": {"total": n_shards, "successful": n_shards,
-                        "skipped": 0, "failed": 0},
+                        "skipped": skipped, "failed": 0},
             "hits": {"total": {"value": total, "relation": "eq"},
                      "max_score": max_score, "hits": hits},
         }
@@ -1176,7 +1197,7 @@ class ClusterNode:
         """Remote-cluster side of CCS: run this cluster's own scatter and
         return candidates + agg partials + the shard→node map the fetch
         call must echo back (the remote reduce half of ccsRemoteReduce)."""
-        cands, partials, total, shard_nodes, n_shards = \
+        cands, partials, total, shard_nodes, n_shards, skipped = \
             self._cluster_query_phase(payload["index"], payload["body"],
                                       payload["k"])
         return {"candidates": Opaque(
@@ -1185,7 +1206,7 @@ class ClusterNode:
                 "partials": Opaque(partials),
                 "total": total,
                 "shard_nodes": {str(k): v for k, v in shard_nodes.items()},
-                "n_shards": n_shards}
+                "n_shards": n_shards, "skipped": skipped}
 
     def _on_ccs_fetch(self, sender: str, payload: dict):
         from opensearch_tpu.search.executor import _Candidate
@@ -1246,11 +1267,11 @@ class ClusterNode:
         def query_target(ti: int, alias: Optional[str], idx: str):
             try:
                 if alias is None:
-                    cands, partials, total, shard_nodes, n_shards = \
-                        self._cluster_query_phase(idx, body, k)
+                    cands, partials, total, shard_nodes, n_shards, \
+                        skipped = self._cluster_query_phase(idx, body, k)
                     out = {"cands": cands, "partials": partials,
                            "total": total, "shard_nodes": shard_nodes,
-                           "n_shards": n_shards}
+                           "n_shards": n_shards, "skipped": skipped}
                 else:
                     resp = self.transport.send_sync(
                         self._remotes[alias], CCS_QUERY,
@@ -1262,6 +1283,7 @@ class ClusterNode:
                     out = {"cands": cands,
                            "partials": _unwrap(resp["partials"]),
                            "total": resp["total"],
+                           "skipped": resp.get("skipped", 0),
                            "shard_nodes": resp["shard_nodes"],
                            "n_shards": resp["n_shards"]}
                 with lock:
@@ -1288,11 +1310,13 @@ class ClusterNode:
         merged: List[Tuple] = []
         total = 0
         n_shards = 0
+        skipped = 0
         all_partials: List = []
         for ti in range(len(targets)):
             out = results[ti]
             total += out["total"]
             n_shards += out["n_shards"]
+            skipped += out.get("skipped", 0)
             all_partials.extend(out["partials"])
             for c in out["cands"]:
                 merged.append((ti, c))
@@ -1333,7 +1357,7 @@ class ClusterNode:
             "took": int((time.monotonic() - start) * 1000),
             "timed_out": False,
             "_shards": {"total": n_shards, "successful": n_shards,
-                        "skipped": 0, "failed": 0},
+                        "skipped": skipped, "failed": 0},
             "_clusters": {"total": len(targets),
                           "successful": len(targets), "skipped": 0},
             "hits": {"total": {"value": total, "relation": "eq"},

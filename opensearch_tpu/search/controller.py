@@ -185,6 +185,25 @@ def execute_search(executors: List, body: Optional[dict],
     k = max(from_ + size, 10)
     max_k = 1 << 16
 
+    # can-match pre-filter (CanMatchPreFilterSearchPhase): shards whose
+    # segment min/max metadata proves emptiness never compile or launch a
+    # device program. Computed lazily — the SPMD program batches every
+    # (shard, segment) row in one launch and never consults the flags —
+    # and cached across k-growth retries. When every shard would skip, one
+    # still executes so the response (empty agg structures, totals) is
+    # fully shaped, exactly like the reference phase.
+    from opensearch_tpu.search.canmatch import shard_can_match
+    flags_box: List = [None]
+    skipped_box = [0]
+
+    def can_match_flags():
+        if flags_box[0] is None:
+            flags = [shard_can_match(ex, body) for ex in executors]
+            if flags and not any(flags):
+                flags[0] = True
+            flags_box[0] = flags
+        return flags_box[0]
+
     def run_query_phase(k_eff):
         candidates = []
         decoded_partials = []
@@ -215,7 +234,11 @@ def execute_search(executors: List, body: Optional[dict],
                         "aggregations": [],
                     })
                 return candidates, decoded_partials, total
+        flags = can_match_flags()
+        skipped_box[0] = len(executors) - sum(flags)
         for shard_i, ex in enumerate(executors):
+            if not flags[shard_i]:
+                continue                # provably empty: skipped shard
             if task is not None:
                 task.check_cancelled()
             shard_start = time.monotonic_ns()
@@ -306,7 +329,7 @@ def execute_search(executors: List, body: Optional[dict],
         "timed_out": False,
         "_shards": {"total": n_shards,
                     "successful": n_shards - failed_shards,
-                    "skipped": 0, "failed": failed_shards},
+                    "skipped": skipped_box[0], "failed": failed_shards},
         "hits": hits_block,
     }
     if agg_nodes:

@@ -1,0 +1,133 @@
+"""Can-match shard pre-filtering tests.
+
+Modeled on the reference suites: CanMatchPreFilterSearchPhaseTests +
+SearchServiceTests#testCanMatch — shards whose segment min/max metadata
+proves emptiness are skipped (no device program) and reported in
+_shards.skipped."""
+
+import pytest
+
+from opensearch_tpu.cluster.routing import generate_shard_id
+from opensearch_tpu.node import Node
+
+
+def ids_for_shards(n_shards, per_shard):
+    """Doc ids guaranteed to land per shard under murmur3 routing."""
+    buckets = {s: [] for s in range(n_shards)}
+    i = 0
+    while any(len(b) < per_shard for b in buckets.values()):
+        sid = generate_shard_id(f"doc-{i}", n_shards)
+        if len(buckets[sid]) < per_shard:
+            buckets[sid].append(f"doc-{i}")
+        i += 1
+    return buckets
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    n.request("PUT", "/logs", {
+        "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+        "mappings": {"properties": {
+            "ts": {"type": "long"}, "level": {"type": "keyword"},
+            "msg": {"type": "text"}}}})
+    buckets = ids_for_shards(2, 4)
+    # shard 0 docs: ts in [0, 100); shard 1 docs: ts in [1000, 1100)
+    for j, did in enumerate(buckets[0]):
+        n.request("PUT", f"/logs/_doc/{did}",
+                  {"ts": j * 10, "level": "info", "msg": "shard zero row"})
+    for j, did in enumerate(buckets[1]):
+        n.request("PUT", f"/logs/_doc/{did}",
+                  {"ts": 1000 + j * 10, "level": "error",
+                   "msg": "shard one row"})
+    n.request("POST", "/logs/_refresh")
+    return n
+
+
+def search(node, query, **kw):
+    body = {"query": query, "sort": [{"ts": "asc"}]}  # field sort: host loop
+    body.update(kw)
+    return node.request("POST", "/logs/_search", body)
+
+
+class TestCanMatch:
+    def test_range_skips_non_overlapping_shard(self, node):
+        res = search(node, {"range": {"ts": {"gte": 1000}}})
+        assert res["_shards"]["skipped"] == 1
+        assert res["hits"]["total"]["value"] == 4
+
+    def test_range_matching_both_shards_skips_none(self, node):
+        res = search(node, {"range": {"ts": {"gte": 0}}})
+        assert res["_shards"]["skipped"] == 0
+        assert res["hits"]["total"]["value"] == 8
+
+    def test_range_matching_no_shard_keeps_one_executing(self, node):
+        # reference semantics: when every shard would skip, one still
+        # executes so the response is fully shaped (empty aggs, totals)
+        res = search(node, {"range": {"ts": {"gt": 5000}}})
+        assert res["_shards"]["skipped"] == 1
+        assert res["hits"]["total"]["value"] == 0
+
+    def test_keyword_term_skips_absent_shard(self, node):
+        res = search(node, {"term": {"level": "error"}})
+        assert res["_shards"]["skipped"] == 1
+        assert res["hits"]["total"]["value"] == 4
+
+    def test_text_term_skips_absent_shard(self, node):
+        res = search(node, {"term": {"msg": "zero"}})
+        assert res["_shards"]["skipped"] == 1
+        assert res["hits"]["total"]["value"] == 4
+
+    def test_bool_filter_conjunction_prunes(self, node):
+        res = search(node, {"bool": {
+            "must": [{"match": {"msg": "row"}}],
+            "filter": [{"range": {"ts": {"lt": 500}}}]}})
+        assert res["_shards"]["skipped"] == 1
+        assert res["hits"]["total"]["value"] == 4
+
+    def test_unknown_query_shapes_never_skip(self, node):
+        res = search(node, {"match": {"msg": "nonexistent_term_xyz"}})
+        assert res["_shards"]["skipped"] == 0
+        assert res["hits"]["total"]["value"] == 0
+
+    def test_aggs_from_skipped_shard_are_empty_not_wrong(self, node):
+        res = search(node, {"range": {"ts": {"gte": 1000}}},
+                     aggs={"levels": {"terms": {"field": "level"}}}, size=0)
+        buckets = res["aggregations"]["levels"]["buckets"]
+        assert buckets == [{"key": "error", "doc_count": 4}]
+
+    def test_date_field_skip(self, node):
+        node.request("PUT", "/dated", {
+            "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+            "mappings": {"properties": {"d": {"type": "date"}}}})
+        buckets = ids_for_shards(2, 2)
+        for j, did in enumerate(buckets[0]):
+            node.request("PUT", f"/dated/_doc/{did}",
+                         {"d": f"2020-01-0{j + 1}"})
+        for j, did in enumerate(buckets[1]):
+            node.request("PUT", f"/dated/_doc/{did}",
+                         {"d": f"2026-06-0{j + 1}"})
+        node.request("POST", "/dated/_refresh")
+        res = node.request("POST", "/dated/_search", {
+            "query": {"range": {"d": {"gte": "2026-01-01"}}},
+            "sort": [{"d": "asc"}]})
+        assert res["_shards"]["skipped"] == 1
+        assert res["hits"]["total"]["value"] == 2
+
+    def test_exists_skip(self, node):
+        res = search(node, {"exists": {"field": "nonexistent_field"}})
+        assert res["_shards"]["skipped"] == 1   # one kept (force-one rule)
+        assert res["hits"]["total"]["value"] == 0
+
+    def test_all_skipped_aggs_still_shaped(self, node):
+        # the forced shard produces properly-shaped empty agg structures
+        res = search(node, {"range": {"ts": {"gt": 5000}}},
+                     aggs={"levels": {"terms": {"field": "level"}}}, size=0)
+        assert res["aggregations"]["levels"]["buckets"] == []
+
+    def test_global_agg_prevents_skipping(self, node):
+        # a global agg counts ALL docs; no shard may be skipped
+        res = search(node, {"range": {"ts": {"gte": 1000}}},
+                     aggs={"everything": {"global": {}}}, size=0)
+        assert res["_shards"]["skipped"] == 0
+        assert res["aggregations"]["everything"]["doc_count"] == 8

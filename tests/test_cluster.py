@@ -418,3 +418,28 @@ class TestAllocationFiltersLive:
         finally:
             for n in nodes.values():
                 n.close()
+
+
+class TestCanMatchDistributed:
+    def test_skipped_shards_reported_over_transport(self, cluster):
+        from opensearch_tpu.cluster.routing import generate_shard_id
+        node = next(iter(cluster.values()))
+        node.request("PUT", "/cm", {
+            "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+            "mappings": {"properties": {"ts": {"type": "long"}}}})
+        node.await_health("green", timeout=30)
+        placed = {0: 0, 1: 0}
+        i = 0
+        while min(placed.values()) < 3:
+            sid = generate_shard_id(f"c{i}", 2)
+            if placed[sid] < 3:
+                base = 0 if sid == 0 else 1000
+                node.request("PUT", f"/cm/_doc/c{i}",
+                             {"ts": base + placed[sid]})
+                placed[sid] += 1
+            i += 1
+        node.request("POST", "/cm/_refresh")
+        res = node.request("POST", "/cm/_search", {
+            "query": {"range": {"ts": {"gte": 1000}}}})
+        assert res["_shards"]["skipped"] == 1
+        assert res["hits"]["total"]["value"] == 3
