@@ -1,0 +1,8 @@
+"""`python -m opensearch_tpu` — the bin/opensearch entry point."""
+
+import sys
+
+from opensearch_tpu.launcher import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,85 @@
+"""Seed-hosts discovery: resolve peer addresses and find the cluster.
+
+Re-design of discovery/SeedHostsResolver.java + PeerFinder.java +
+FileBasedSeedHostsProvider.java: a seed list names ADDRESSES
+("host:port"), not node ids — discovery dials each, handshakes to learn
+who answers (HandshakingTransportAddressConnector), and joins through the
+first responsive peer. Sources: the `discovery.seed_hosts` setting and the
+config-dir `unicast_hosts.txt` file (one host:port per line, # comments).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional, Tuple
+
+
+def parse_host(entry: str, default_port: int = 9300) -> Tuple[str, int]:
+    """host[:port] with IPv6 support: bracketed [::1]:9300 carries a port,
+    a bare multi-colon literal (::1, fe80::2) is all host."""
+    entry = entry.strip()
+    if entry.startswith("["):
+        host, _, rest = entry[1:].partition("]")
+        if rest.startswith(":"):
+            return host, int(rest[1:])
+        return host, default_port
+    if entry.count(":") == 1:
+        host, _, port = entry.partition(":")
+        return host, int(port)
+    return entry, default_port
+
+
+def seed_addresses(settings: dict,
+                   config_path: Optional[str] = None) -> List[Tuple[str, int]]:
+    """Union of the settings list and the file provider, order-preserving."""
+    out: List[Tuple[str, int]] = []
+    seen = set()
+
+    def add(entry: str):
+        try:
+            addr = parse_host(entry)
+        except ValueError:
+            return
+        if addr not in seen:
+            seen.add(addr)
+            out.append(addr)
+
+    hosts = settings.get("discovery.seed_hosts") or []
+    if isinstance(hosts, str):
+        hosts = [h for h in hosts.split(",") if h.strip()]
+    for h in hosts:
+        add(h)
+    if config_path:
+        path = os.path.join(config_path, "unicast_hosts.txt")
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.split("#", 1)[0].strip()
+                    if line:
+                        add(line)
+        except OSError:
+            pass
+    return out
+
+
+def discover_and_join(cluster_node, settings: dict,
+                      config_path: Optional[str] = None,
+                      timeout: float = 30.0) -> Optional[str]:
+    """PeerFinder's probe loop: dial every seed address, handshake, and
+    join through the first peer that answers. Returns the seed's node id,
+    or None when no peer answered within the timeout (the caller decides
+    whether that means bootstrap-a-new-cluster or keep waiting)."""
+    seeds = seed_addresses(settings, config_path)
+    if not seeds:
+        return None
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        for host, port in seeds:
+            node_id = cluster_node.transport.probe_address(
+                host, port, timeout=min(5.0, timeout))
+            if node_id is not None:
+                cluster_node.join((host, port), node_id)
+                return node_id
+        time.sleep(0.5)
+    return None

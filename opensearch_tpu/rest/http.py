@@ -71,6 +71,7 @@ class HttpServer:
     def __init__(self, node: Node, host: str = "127.0.0.1", port: int = 9200):
         handler = type("BoundHandler", (_Handler,), {"node": node})
         self.server = ThreadingHTTPServer((host, port), handler)
+        self.host = self.server.server_address[0]
         self.port = self.server.server_address[1]
         self._thread: Optional[threading.Thread] = None
 

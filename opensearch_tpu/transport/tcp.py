@@ -139,24 +139,27 @@ class TcpTransport:
     loop. Satisfies the same send/register_handler interface as the
     simulation transport, so the Coordinator runs on either."""
 
-    def __init__(self, node_id: str, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, node_id: str, host: str = "127.0.0.1", port: int = 0,
+                 threadpool=None):
+        from opensearch_tpu.common.threadpool import ThreadPool
         self.node_id = node_id
         self.handlers: Dict[str, Callable] = {}
-        # actions whose handlers may block (fan out sub-requests and wait):
-        # they run on the worker pool, NOT the event loop — the reference
-        # equivalently runs WRITE/SEARCH handlers on named threadpools while
-        # coordination stays on the transport thread (ThreadPool.java:92)
+        # the node's named-pool registry (ThreadPool.java:92); owned here
+        # when the caller doesn't inject one (tests, bare transports)
+        self.threadpool = threadpool or ThreadPool(node_name=node_id)
+        self._owns_threadpool = threadpool is None
+        # actions whose handlers may block (fan out sub-requests and wait)
+        # run on their registered named pool, NOT the event loop — the
+        # reference equivalently runs WRITE/SEARCH handlers on named
+        # threadpools while coordination stays on the transport thread.
+        # Cluster-admin actions (leader updates awaiting publication
+        # commit, recovery segment shipping) register on the management
+        # pool so they cannot starve the data plane.
         self._blocking_actions: set = set()
-        self._workers = ThreadPoolExecutor(
-            max_workers=8, thread_name_prefix=f"worker-{node_id}")
-        # cluster-admin actions that can legitimately block for tens of
-        # seconds (leader updates awaiting publication commit, recovery
-        # segment shipping) run on their own pool so they cannot starve
-        # the data plane — the reference's MANAGEMENT/RECOVERY threadpools
-        # vs WRITE/SEARCH (threadpool/ThreadPool.java:92)
-        self._mgmt_actions: set = set()
-        self._mgmt_workers = ThreadPoolExecutor(
-            max_workers=4, thread_name_prefix=f"mgmt-{node_id}")
+        self._action_pools: Dict[str, str] = {}
+        # compat views used by non-handler background submitters
+        self._workers = self.threadpool.executor("generic")
+        self._mgmt_workers = self.threadpool.executor("management")
         # frames are written from the event loop AND worker threads (blocking
         # handlers answer on the inbound socket): serialize per socket or
         # concurrent sendall()s interleave and corrupt the frame stream
@@ -188,13 +191,13 @@ class TcpTransport:
     # -------------------------------------------------------------- registry
 
     def register_handler(self, node_id: str, action: str, handler: Callable,
-                         blocking: bool = False, pool: str = "worker"):
+                         blocking: bool = False, pool: str = "write"):
         assert node_id == self.node_id, "TcpTransport hosts one node"
         self.handlers[action] = handler
         if blocking:
             self._blocking_actions.add(action)
-            if pool == "management":
-                self._mgmt_actions.add(action)
+            self._action_pools[action] = \
+                pool if pool in self.threadpool.pools else "write"
 
     def register_node(self, node_id: str):  # interface parity with the mock
         pass
@@ -261,12 +264,23 @@ class TcpTransport:
                     if action != HANDSHAKE_ACTION:
                         return  # un-handshaken peer: drop the connection
                     handshaken = True
-                if action in self._mgmt_actions:
-                    self._mgmt_workers.submit(self._handle_request, conn,
-                                              request_id, action, payload)
-                elif action in self._blocking_actions:
-                    self._workers.submit(self._handle_request, conn,
-                                         request_id, action, payload)
+                if action in self._blocking_actions:
+                    pool = self._action_pools.get(action, "write")
+                    try:
+                        self.threadpool.submit(
+                            pool, self._handle_request, conn, request_id,
+                            action, payload)
+                    except Exception as e:
+                        # pool-full rejection answers THIS request with an
+                        # error frame (429) — it must not kill the shared
+                        # connection and every other in-flight request
+                        err = {"error": type(e).__name__, "reason": str(e),
+                               "error_type": getattr(
+                                   e, "error_type",
+                                   "rejected_execution_exception"),
+                               "status": getattr(e, "status", 429)}
+                        self._locked_write(conn, FLAG_RESPONSE | FLAG_ERROR,
+                                           request_id, action, err)
                 else:
                     self.post(lambda c=conn, r=request_id, a=action,
                               p=payload: self._handle_request(c, r, a, p))
@@ -433,6 +447,37 @@ class TcpTransport:
                   {"version": __version__}, on_response,
                   on_failure or (lambda e: None))
 
+    def probe_address(self, host: str, port: int,
+                      timeout: float = 5.0) -> Optional[str]:
+        """Dial a bare address and learn who answers — the
+        HandshakingTransportAddressConnector step of seed-hosts discovery
+        (a seed list names addresses, not node ids). Registers the real
+        node id's address on success and returns it; None if nobody
+        suitable answers."""
+        probe_id = f"_probe_{host}:{port}"
+        self.add_address(probe_id, host, port)
+        try:
+            resp = self.send_sync(probe_id, HANDSHAKE_ACTION,
+                                  {"version": __version__}, timeout=timeout)
+        except Exception:
+            return None
+        finally:
+            self._addresses.pop(probe_id, None)
+            # the probe connection is keyed under the placeholder id; drop
+            # it so the real id dials a fresh, properly-keyed connection
+            with self._lock:
+                sock = self._connections.pop(probe_id, None)
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        node_id = (resp or {}).get("node_id")
+        if not node_id or node_id == self.node_id:
+            return None
+        self.add_address(node_id, host, port)
+        return node_id
+
     # --------------------------------------------------------------- close
 
     def close(self):
@@ -448,5 +493,5 @@ class TcpTransport:
             except OSError:
                 pass
         self._loop_queue.put(None)
-        self._workers.shutdown(wait=False, cancel_futures=True)
-        self._mgmt_workers.shutdown(wait=False, cancel_futures=True)
+        if self._owns_threadpool:
+            self.threadpool.shutdown()

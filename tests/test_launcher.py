@@ -1,0 +1,229 @@
+"""Launcher, seed-hosts discovery, and named threadpool tests.
+
+Modeled on the reference suites: BootstrapChecksTests, OpenSearchTests
+(CLI -E overrides), SeedHostsResolverTests / FileBasedSeedHostsProviderTests,
+and ThreadPoolTests / UpdateThreadPoolSettingsTests."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from opensearch_tpu.common.threadpool import (RejectedExecutionError,
+                                              ThreadPool)
+from opensearch_tpu.launcher import (apply_overrides, bootstrap_checks,
+                                     is_production, load_config, start_node)
+
+
+class TestConfig:
+    def test_yaml_flattening(self, tmp_path):
+        cfg = tmp_path / "opensearch.yml"
+        cfg.write_text(
+            "cluster:\n  name: demo\nnode.name: n1\n"
+            "http:\n  port: 9201\nnode.attr.zone: z1\n")
+        settings = load_config(str(cfg))
+        assert settings["cluster.name"] == "demo"
+        assert settings["node.name"] == "n1"
+        assert settings["http.port"] == 9201
+        assert settings["node.attr.zone"] == "z1"
+
+    def test_overrides_win(self, tmp_path):
+        cfg = tmp_path / "o.yml"
+        cfg.write_text("node.name: fromfile\n")
+        settings = apply_overrides(load_config(str(cfg)),
+                                   ["node.name=fromcli", "http.port=0"])
+        assert settings["node.name"] == "fromcli"
+        assert settings["http.port"] == "0"
+
+    def test_bad_override_rejected(self):
+        with pytest.raises(SystemExit):
+            apply_overrides({}, ["no_equals_sign"])
+
+    def test_missing_config_is_empty(self):
+        assert load_config("/nonexistent/opensearch.yml") == {}
+
+    def test_production_detection(self):
+        assert not is_production({})
+        assert not is_production({"http.host": "127.0.0.1"})
+        assert is_production({"network.host": "0.0.0.0"})
+
+
+class TestBootstrapChecks:
+    def test_writable_data_path_passes(self, tmp_path):
+        checks = bootstrap_checks({"path.data": str(tmp_path / "d")},
+                                  production=True)
+        by_name = {c[0]: c for c in checks}
+        assert by_name["data path is writable"][1] is True
+
+    def test_unwritable_data_path_fails(self, tmp_path):
+        ro = tmp_path / "ro"
+        ro.mkdir()
+        ro.chmod(0o500)
+        bad = str(ro / "sub")
+        checks = bootstrap_checks({"path.data": bad}, production=True)
+        by_name = {c[0]: c for c in checks}
+        if os.getuid() == 0:        # root ignores modes; check is env-bound
+            pytest.skip("running as root: permissions are not enforced")
+        assert by_name["data path is writable"][1] is False
+
+
+class TestSingleNodeLaunch:
+    def test_start_node_serves_http(self, tmp_path):
+        node, server = start_node({"node.name": "launch-1", "http.port": 0,
+                                   "path.data": str(tmp_path / "data")})
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/") as resp:
+                root = json.loads(resp.read())
+            assert root["version"]["distribution"] or root["name"]
+        finally:
+            server.close()
+
+
+class TestDiscoveryLaunch:
+    def test_bootstrap_plus_seed_join(self, tmp_path):
+        # founder bootstraps a one-node cluster; the second node finds it
+        # via discovery.seed_hosts (address only — no node id configured)
+        founder, fsrv = start_node({
+            "node.name": "seed-a", "http.port": 0,
+            "cluster.initial_cluster_manager_nodes": ["seed-a"]})
+        try:
+            deadline = time.time() + 30
+            while not founder.is_leader and time.time() < deadline:
+                time.sleep(0.05)
+            assert founder.is_leader
+            host, port = founder.address
+            joiner, jsrv = start_node({
+                "node.name": "seed-b", "http.port": 0,
+                "discovery.seed_hosts": f"{host}:{port}"})
+            try:
+                deadline = time.time() + 30
+                while time.time() < deadline:
+                    st = joiner.state
+                    if st is not None and "seed-b" in st.nodes \
+                            and "seed-a" in st.nodes:
+                        break
+                    time.sleep(0.05)
+                assert "seed-a" in joiner.state.nodes
+                assert "seed-b" in joiner.state.nodes
+            finally:
+                jsrv.close()
+                joiner.close()
+        finally:
+            fsrv.close()
+            founder.close()
+
+    def test_file_based_seed_provider(self, tmp_path):
+        from opensearch_tpu.cluster.discovery import seed_addresses
+        (tmp_path / "unicast_hosts.txt").write_text(
+            "# seeds\n10.0.0.1:9301\n10.0.0.2\n")
+        addrs = seed_addresses({"discovery.seed_hosts": "10.0.0.3:9300"},
+                               str(tmp_path))
+        assert ("10.0.0.3", 9300) in addrs
+        assert ("10.0.0.1", 9301) in addrs
+        assert ("10.0.0.2", 9300) in addrs
+
+    def test_join_without_any_seed_answer_fails(self):
+        with pytest.raises(SystemExit):
+            start_node({"node.name": "lost",
+                        "http.port": 0,
+                        "discovery.seed_hosts": "127.0.0.1:1",
+                        "discovery.join_timeout": 3},
+                       None)
+
+
+class TestThreadPools:
+    def test_named_pools_exist_with_stats(self):
+        tp = ThreadPool({}, node_name="tptest")
+        try:
+            stats = tp.stats()
+            for name in ("search", "write", "get", "management",
+                         "snapshot", "generic"):
+                assert stats[name]["threads"] >= 1
+                assert stats[name]["rejected"] == 0
+        finally:
+            tp.shutdown()
+
+    def test_size_override_from_settings(self):
+        tp = ThreadPool({"thread_pool.search.size": 3,
+                         "thread_pool.search.queue_size": 7})
+        try:
+            st = tp.stats()["search"]
+            assert st["threads"] == 3 and st["queue_size"] == 7
+        finally:
+            tp.shutdown()
+
+    def test_bounded_queue_rejects_when_full(self):
+        import threading
+        tp = ThreadPool({"thread_pool.search.size": 1,
+                         "thread_pool.search.queue_size": 1})
+        release = threading.Event()
+        try:
+            tp.submit("search", release.wait)      # occupies the thread
+            time.sleep(0.1)
+            tp.submit("search", lambda: None)      # fills the queue
+            with pytest.raises(RejectedExecutionError):
+                tp.submit("search", lambda: None)  # rejected, not blocked
+            assert tp.stats()["search"]["rejected"] == 1
+        finally:
+            release.set()
+            tp.shutdown()
+
+    def test_rest_surfaces(self):
+        from opensearch_tpu.node import Node
+        n = Node()
+        stats = n.request("GET", "/_nodes/stats")
+        node_stats = next(iter(stats["nodes"].values()))
+        assert "search" in node_stats["thread_pool"]
+        assert node_stats["os"]["mem"]["total_in_bytes"] != 0
+        assert node_stats["process"]["open_file_descriptors"] != 0
+        cat = n.request("GET", "/_cat/thread_pool")
+        text = cat.get("_raw", "")
+        assert "write" in text and "search" in text   # fixed-width table
+
+    def test_host_alias_resolution(self):
+        from opensearch_tpu.launcher import resolve_host
+        assert resolve_host("_local_") == "127.0.0.1"
+        assert resolve_host("_site_") == "0.0.0.0"
+        assert resolve_host("10.1.2.3") == "10.1.2.3"
+
+    def test_parse_host_ipv6(self):
+        from opensearch_tpu.cluster.discovery import parse_host
+        assert parse_host("[::1]:9301") == ("::1", 9301)
+        assert parse_host("::1") == ("::1", 9300)
+        assert parse_host("fe80::2") == ("fe80::2", 9300)
+        assert parse_host("10.0.0.1:9305") == ("10.0.0.1", 9305)
+
+    def test_search_pool_serves_cluster_queries(self):
+        # shard query handlers are registered on the SEARCH pool — stats
+        # must show completed search work after a distributed query
+        import time as _t
+        from opensearch_tpu.cluster.service import ClusterNode
+        nodes = {f"tp-{i}": ClusterNode(f"tp-{i}") for i in range(2)}
+        try:
+            peers = {nid: n.address for nid, n in nodes.items()}
+            for n in nodes.values():
+                n.bootstrap(peers)
+            deadline = _t.time() + 30
+            while not any(n.is_leader for n in nodes.values()):
+                assert _t.time() < deadline
+                _t.sleep(0.05)
+            any_node = next(iter(nodes.values()))
+            any_node.request("PUT", "/tpidx", {
+                "settings": {"number_of_shards": 2,
+                             "number_of_replicas": 0},
+                "mappings": {"properties": {"b": {"type": "text"}}}})
+            any_node.await_health("green", timeout=30)
+            any_node.request("PUT", "/tpidx/_doc/1", {"b": "pooled work"})
+            any_node.request("POST", "/tpidx/_refresh")
+            any_node.request("POST", "/tpidx/_search", {
+                "query": {"match": {"b": "pooled"}}})
+            completed = sum(
+                n.local.threadpool.stats()["search"]["completed"]
+                for n in nodes.values())
+            assert completed > 0
+        finally:
+            for n in nodes.values():
+                n.close()
