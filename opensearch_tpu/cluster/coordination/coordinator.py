@@ -90,6 +90,8 @@ class Coordinator:
         self._leader_check_failures = 0
         self._stopped = False
         self._publish_in_flight = False
+        # diff-vs-full publication accounting (PublishClusterStateStats)
+        self.publish_stats = {"diff": 0, "full": 0}
         # (update_fn, listener) pairs; listener(None) on successful fold
         # into a publication, listener(exc) if the update itself raised —
         # MasterService's per-task onFailure isolation: one poison task
@@ -461,7 +463,29 @@ class Coordinator:
                     self._finish_publication(commit, state, acked)
             return handle
 
-        payload = {"state": state}
+        # diff publication (PublicationTransportHandler): peers holding the
+        # previous accepted state get a delta; anyone else answers
+        # need_full and we resend the complete state
+        from opensearch_tpu.cluster.statediff import make_state_diff
+        full_payload = {"state": state}
+        prev = self.coord_state.last_accepted
+        diff_payload = None
+        if prev is not None and prev.version > 0:
+            diff_payload = {"diff": make_state_diff(prev, state)}
+
+        def wrap(peer):
+            inner = on_response(peer)
+
+            def handle(resp):
+                if resp and resp.get("need_full"):
+                    self.publish_stats["full"] += 1
+                    self.transport.send(self.node_id, peer, PUBLISH_ACTION,
+                                        full_payload, inner,
+                                        lambda e: None)
+                    return
+                inner(resp)
+            return handle
+
         for peer in sorted(state.nodes):
             if peer == self.node_id:
                 try:
@@ -470,9 +494,17 @@ class Coordinator:
                                        "version": resp.version})
                 except CoordinationStateRejectedError:
                     pass
-            else:
+            elif diff_payload is not None and peer in prev.nodes:
+                # peers absent from the previous state (fresh joiners) hold
+                # no base — a diff would just burn a need_full round trip
+                self.publish_stats["diff"] += 1
                 self.transport.send(self.node_id, peer, PUBLISH_ACTION,
-                                    payload, on_response(peer),
+                                    diff_payload, wrap(peer),
+                                    lambda e: None)
+            else:
+                self.publish_stats["full"] += 1
+                self.transport.send(self.node_id, peer, PUBLISH_ACTION,
+                                    full_payload, on_response(peer),
                                     lambda e: None)
         self.scheduler.schedule_delayed(
             30_000, lambda: self._publish_timeout(state.version),
@@ -519,7 +551,17 @@ class Coordinator:
                                         "publish queued updates")
 
     def _on_publish(self, sender: str, payload: dict):
-        state: ClusterState = payload["state"]
+        if "state" in payload:
+            state: ClusterState = payload["state"]
+        else:
+            # diff publication: reconstruct against our accepted state, or
+            # ask for the full state when the base doesn't match (fresh
+            # joiner / lagging node — IncompatibleClusterStateVersion)
+            from opensearch_tpu.cluster.statediff import apply_state_diff
+            state = apply_state_diff(self.coord_state.last_accepted,
+                                     payload["diff"])
+            if state is None:
+                return {"need_full": True}
         self.known_peers |= set(state.nodes)
         join = None
         if state.term > self.coord_state.current_term:

@@ -588,8 +588,13 @@ class ClusterNode:
         reg(self.node_id, LEADER_UPDATE,
             lambda s, p: {"accepted": self._leader_apply_update(p)},
             blocking=True, pool="management")
+        # fan-out handlers (a primary waits on replica sub-requests, CCS
+        # waits on shard queries) run on the generic pool, NOT the pool
+        # their leaf sub-requests execute on — sharing one bounded pool
+        # between waiters and waited-on is a distributed deadlock once
+        # pool-size blockers are in flight on both sides
         reg(self.node_id, SHARD_BULK_PRIMARY, self._on_shard_bulk_primary,
-            blocking=True)
+            blocking=True, pool="generic")
         reg(self.node_id, SHARD_BULK_REPLICA, self._on_shard_bulk_replica,
             blocking=True)
         reg(self.node_id, SHARD_QUERY, self._on_shard_query, blocking=True,
@@ -605,9 +610,9 @@ class ClusterNode:
         reg(self.node_id, REGISTER_ADDR, self._on_register_address,
             blocking=True, pool="management")
         reg(self.node_id, CCS_QUERY, self._on_ccs_query, blocking=True,
-            pool="search")
+            pool="generic")
         reg(self.node_id, CCS_FETCH, self._on_ccs_fetch, blocking=True,
-            pool="search")
+            pool="generic")
 
     def _on_register_address(self, sender: str, payload: dict):
         """Learn a joining node's transport address; propagate to the

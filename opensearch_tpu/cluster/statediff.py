@@ -1,0 +1,91 @@
+"""Cluster-state diff publication: ship deltas, not the world.
+
+Re-design of cluster/Diff.java + ClusterState.diff()/readDiffFrom() and
+PublicationTransportHandler: the leader serializes one diff against its
+previously-accepted state; a peer whose accepted (term, version) matches
+the diff's base applies it, anyone else (fresh joiner, lagging node)
+answers "need full" and the leader falls back to a full-state send —
+the IncompatibleClusterStateVersionException dance.
+
+The payload diff is two-level: top-level keys of ``ClusterState.data``
+(indices, routing, addresses, node_attrs, settings, persistent_tasks,
+remote_clusters, ...) diff per-key, and dict-valued entries diff one
+level deeper (per index / per node), so touching one index among
+thousands ships that index's routing row, not the whole table. The
+coordination envelope (term/version/nodes/configs) always travels in
+full — it is tiny and must never be reconstructed wrong.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from opensearch_tpu.cluster.coordination.core import ClusterState
+
+_MISSING = object()
+
+
+def diff_data(old: Optional[dict], new: Optional[dict]) -> dict:
+    """Delta from `old` to `new` payloads. Non-dict payloads (tests drive
+    the coordinator with scalar registers) replace wholesale."""
+    if not isinstance(new, dict) or not isinstance(old or {}, dict):
+        return {"replace": new}
+    old = old or {}
+    out: Dict[str, Any] = {"set": {}, "del": [], "sub": {}}
+    for k in old:
+        if k not in new:
+            out["del"].append(k)
+    for k, v in new.items():
+        ov = old.get(k, _MISSING)
+        if ov is _MISSING:
+            out["set"][k] = v
+        elif ov == v:
+            continue
+        elif isinstance(v, dict) and isinstance(ov, dict):
+            sub = {"set": {kk: vv for kk, vv in v.items()
+                           if kk not in ov or ov[kk] != vv},
+                   "del": [kk for kk in ov if kk not in v]}
+            out["sub"][k] = sub
+        else:
+            out["set"][k] = v
+    return out
+
+
+def apply_data_diff(old: Optional[dict], diff: dict):
+    if "replace" in diff:
+        return diff["replace"]
+    new = dict(old or {})
+    for k in diff.get("del", []):
+        new.pop(k, None)
+    for k, v in diff.get("set", {}).items():
+        new[k] = v
+    for k, sub in diff.get("sub", {}).items():
+        merged = dict(new.get(k) or {})
+        for kk in sub.get("del", []):
+            merged.pop(kk, None)
+        for kk, vv in sub.get("set", {}).items():
+            merged[kk] = vv
+        new[k] = merged
+    return new
+
+
+def make_state_diff(prev: ClusterState, state: ClusterState) -> dict:
+    """The publish payload for peers that hold `prev`."""
+    return {
+        # full coordination envelope, data stripped (tiny + exact)
+        "meta": state.with_(data=None),
+        "base_term": prev.term,
+        "base_version": prev.version,
+        "data": diff_data(prev.data, state.data),
+    }
+
+
+def apply_state_diff(base: ClusterState, diff: dict
+                     ) -> Optional[ClusterState]:
+    """Reconstruct the published state, or None when `base` is not what
+    the diff was computed against (caller answers need_full)."""
+    if base is None or base.term != diff["base_term"] \
+            or base.version != diff["base_version"]:
+        return None
+    meta: ClusterState = diff["meta"]
+    return meta.with_(data=apply_data_diff(base.data, diff["data"]))

@@ -60,10 +60,10 @@ def apply_overrides(settings: Dict, overrides) -> Dict:
     return out
 
 
-def bootstrap_checks(settings: Dict, production: bool) -> list:
-    """BootstrapChecks.java: a list of (name, ok, detail). In production
-    (non-loopback bind) any failure aborts startup; in dev mode failures
-    are logged as warnings only."""
+def bootstrap_checks(settings: Dict) -> list:
+    """BootstrapChecks.java: a list of (name, ok, detail). The caller
+    (main) aborts on failures in production mode — a non-loopback bind —
+    and logs them as warnings in dev mode."""
     checks = []
 
     data_path = settings.get("path.data")
@@ -199,11 +199,12 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     settings = apply_overrides(load_config(args.config), args.overrides)
-    config_dir = os.path.dirname(args.config) if args.config else None
+    config_dir = os.path.dirname(os.path.abspath(args.config)) \
+        if args.config else None
 
     production = is_production(settings)
     failures = []
-    for name, ok, detail in bootstrap_checks(settings, production):
+    for name, ok, detail in bootstrap_checks(settings):
         status = "ok" if ok else "FAILED"
         print(f"bootstrap check [{name}]: {status} ({detail})",
               file=sys.stderr)

@@ -88,21 +88,11 @@ class HttpServer:
             self._thread.join(timeout=5)
 
 
-def main():  # pragma: no cover - manual entry point (bin/opensearch analog)
-    import argparse
-    p = argparse.ArgumentParser(description="opensearch-tpu node")
-    p.add_argument("--port", type=int, default=9200)
-    p.add_argument("--host", default="127.0.0.1")
-    p.add_argument("--data-path", default=None)
-    args = p.parse_args()
-    node = Node(data_path=args.data_path)
-    server = HttpServer(node, host=args.host, port=args.port)
-    server.start()
-    print(f"opensearch-tpu listening on {args.host}:{server.port}")
-    try:
-        threading.Event().wait()
-    except KeyboardInterrupt:
-        server.close()
+def main():  # pragma: no cover - kept for back-compat; launcher supersedes
+    """Delegates to the full launcher (config file, bootstrap checks,
+    discovery) so there is exactly one entry-point behavior."""
+    from opensearch_tpu.launcher import main as launcher_main
+    raise SystemExit(launcher_main())
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -196,8 +196,8 @@ class TcpTransport:
         self.handlers[action] = handler
         if blocking:
             self._blocking_actions.add(action)
-            self._action_pools[action] = \
-                pool if pool in self.threadpool.pools else "write"
+            self.threadpool.executor(pool)   # unknown pool name: raise now
+            self._action_pools[action] = pool
 
     def register_node(self, node_id: str):  # interface parity with the mock
         pass

@@ -52,8 +52,7 @@ class TestConfig:
 
 class TestBootstrapChecks:
     def test_writable_data_path_passes(self, tmp_path):
-        checks = bootstrap_checks({"path.data": str(tmp_path / "d")},
-                                  production=True)
+        checks = bootstrap_checks({"path.data": str(tmp_path / "d")})
         by_name = {c[0]: c for c in checks}
         assert by_name["data path is writable"][1] is True
 
@@ -62,7 +61,7 @@ class TestBootstrapChecks:
         ro.mkdir()
         ro.chmod(0o500)
         bad = str(ro / "sub")
-        checks = bootstrap_checks({"path.data": bad}, production=True)
+        checks = bootstrap_checks({"path.data": bad})
         by_name = {c[0]: c for c in checks}
         if os.getuid() == 0:        # root ignores modes; check is env-bound
             pytest.skip("running as root: permissions are not enforced")
