@@ -469,9 +469,15 @@ class Coordinator:
         from opensearch_tpu.cluster.statediff import make_state_diff
         full_payload = {"state": state}
         prev = self.coord_state.last_accepted
-        diff_payload = None
-        if prev is not None and prev.version > 0:
-            diff_payload = {"diff": make_state_diff(prev, state)}
+        diff_ok = prev is not None and prev.version > 0
+        diff_box: list = [None]     # built lazily: a single-node cluster
+                                    # (or all-joiner fan-out) never pays
+                                    # the O(state) diff walk
+
+        def diff_payload():
+            if diff_box[0] is None:
+                diff_box[0] = {"diff": make_state_diff(prev, state)}
+            return diff_box[0]
 
         def wrap(peer):
             inner = on_response(peer)
@@ -494,12 +500,12 @@ class Coordinator:
                                        "version": resp.version})
                 except CoordinationStateRejectedError:
                     pass
-            elif diff_payload is not None and peer in prev.nodes:
+            elif diff_ok and peer in prev.nodes:
                 # peers absent from the previous state (fresh joiners) hold
                 # no base — a diff would just burn a need_full round trip
                 self.publish_stats["diff"] += 1
                 self.transport.send(self.node_id, peer, PUBLISH_ACTION,
-                                    diff_payload, wrap(peer),
+                                    diff_payload(), wrap(peer),
                                     lambda e: None)
             else:
                 self.publish_stats["full"] += 1

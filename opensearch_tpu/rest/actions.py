@@ -1131,6 +1131,7 @@ def register_cluster_actions(node, c):
         }
 
     def do_nodes_stats(req):
+        from opensearch_tpu.indices.query_cache import QUERY_CACHE
         from opensearch_tpu.indices.request_cache import REQUEST_CACHE
         from opensearch_tpu.monitor import (os_probe as _os_probe,
                                             process_probe as _process_probe)
@@ -1151,6 +1152,7 @@ def register_cluster_actions(node, c):
                     "segments": {"count": sum(s["segments"]["count"]
                                               for s in idx_stats.values())},
                     "request_cache": REQUEST_CACHE.stats(),
+                    "query_cache": QUERY_CACHE.stats(),
                 },
                 "breakers": node.breaker_service.stats(),
                 "indexing_pressure": node.indexing_pressure.stats(),

@@ -89,10 +89,20 @@ class HttpServer:
 
 
 def main():  # pragma: no cover - kept for back-compat; launcher supersedes
-    """Delegates to the full launcher (config file, bootstrap checks,
-    discovery) so there is exactly one entry-point behavior."""
+    """Translates the legacy --port/--host/--data-path flags into launcher
+    settings and delegates, so there is exactly one entry-point behavior."""
+    import argparse
+    p = argparse.ArgumentParser(description="opensearch-tpu node")
+    p.add_argument("--port", type=int, default=9200)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--data-path", default=None)
+    args = p.parse_args()
+    overrides = [f"http.port={args.port}", f"http.host={args.host}"]
+    if args.data_path:
+        overrides.append(f"path.data={args.data_path}")
     from opensearch_tpu.launcher import main as launcher_main
-    raise SystemExit(launcher_main())
+    raise SystemExit(launcher_main(
+        [arg for o in overrides for arg in ("-E", o)]))
 
 
 if __name__ == "__main__":  # pragma: no cover

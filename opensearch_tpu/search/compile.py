@@ -115,6 +115,10 @@ class Compiler:
         # per-query memo for cross-segment parent-join scans (one Compiler
         # instance serves all segment compiles of one request)
         self._join_cache: Dict[Any, Any] = {}
+        # filter-context cache splice (indices/query_cache.py), installed
+        # per segment by the executor; None = no caching (percolator,
+        # validate, SPMD batch path)
+        self.filter_ctx = None
 
     # ------------------------------------------------------------ entry
     def compile(self, node: dsl.QueryNode, seg: Segment,
@@ -945,9 +949,16 @@ class Compiler:
                     inputs={"msm": _i32(msm), "boost": _f32(boost)},
                     children=list(must) + list(filter) + list(should) + list(must_not))
 
+    def _compile_filter(self, node, seg, meta) -> Plan:
+        """Filter-context compilation: consults the segment filter cache
+        when the executor installed one (IndicesQueryCache splice)."""
+        if self.filter_ctx is not None:
+            return self.filter_ctx.compile_filter(self, node, seg, meta)
+        return self.compile(node, seg, meta)
+
     def _c_BoolQuery(self, node: dsl.BoolQuery, seg, meta) -> Plan:
         must = [self.compile(c, seg, meta) for c in node.must]
-        filt = [self.compile(c, seg, meta) for c in node.filter]
+        filt = [self._compile_filter(c, seg, meta) for c in node.filter]
         should = [self.compile(c, seg, meta) for c in node.should]
         must_not = [self.compile(c, seg, meta) for c in node.must_not]
         if node.minimum_should_match is not None:
@@ -959,7 +970,7 @@ class Compiler:
         return self._bool_plan(must, filt, should, must_not, msm, node.boost)
 
     def _c_ConstantScoreQuery(self, node: dsl.ConstantScoreQuery, seg, meta) -> Plan:
-        child = self.compile(node.filter, seg, meta)
+        child = self._compile_filter(node.filter, seg, meta)
         return Plan("const_score", inputs={"boost": _f32(node.boost)},
                     children=[child])
 

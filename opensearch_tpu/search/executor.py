@@ -597,11 +597,14 @@ class SearchExecutor:
         # results in ONE device_get (one transfer round trip total — on a
         # tunneled device the round trip dominates device compute)
         launched = []
+        from opensearch_tpu.indices.query_cache import FilterCacheContext
         for seg_i, (seg, (arrays, meta)) in enumerate(
                 zip(self.reader.segments, self.reader.device)):
             if seg.num_docs == 0:
                 continue
+            compiler.filter_ctx = FilterCacheContext(seg, arrays)
             plan = compiler.compile(node, seg, meta)
+            compiler.filter_ctx = None
             agg_plans = compile_aggs(device_agg_nodes, self.reader.mapper, seg,
                                      meta, compiler) if agg_nodes else []
             sort_key = _build_sort_key(arrays, primary)

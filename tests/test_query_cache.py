@@ -1,0 +1,130 @@
+"""Segment filter (query) cache tests.
+
+Modeled on the reference suites: IndicesQueryCacheTests +
+UsageTrackingQueryCachingPolicyTests — repeated filters cache their
+per-segment masks after min_uses, spliced results stay identical, deletes
+stay correct (liveness is applied outside the cached mask), and
+time-relative filters never cache."""
+
+import pytest
+
+from opensearch_tpu.indices.query_cache import (QUERY_CACHE, cacheable_node,
+                                                fingerprint)
+from opensearch_tpu.node import Node
+from opensearch_tpu.search import dsl
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache():
+    QUERY_CACHE.clear()
+    yield
+    QUERY_CACHE.clear()
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    n.request("PUT", "/qc", {"mappings": {"properties": {
+        "tag": {"type": "keyword"}, "n": {"type": "integer"},
+        "body": {"type": "text"}, "d": {"type": "date"}}}})
+    for i in range(20):
+        n.request("PUT", f"/qc/_doc/{i}", {
+            "tag": "even" if i % 2 == 0 else "odd", "n": i,
+            "body": f"document number {i}", "d": "2026-01-01"})
+    n.request("POST", "/qc/_refresh")
+    return n
+
+
+# field sort keeps these requests on the host per-segment loop — the SPMD
+# batch path requires structure-uniform plans across rows, so cached-mask
+# splicing applies to the host loop only (see indices/query_cache.py)
+FILTERED = {"query": {"bool": {
+    "must": [{"match": {"body": "document"}}],
+    "filter": [{"term": {"tag": "even"}},
+               {"range": {"n": {"gte": 4}}}]}},
+    "sort": [{"n": "asc"}], "track_scores": True,
+    "size": 20}
+
+
+class TestQueryCache:
+    def test_repeated_filter_caches_and_results_stay_identical(self, node):
+        runs = [node.request("POST", "/qc/_search", FILTERED)
+                for _ in range(4)]
+        expected = sorted(h["_id"] for h in runs[0]["hits"]["hits"])
+        assert expected == sorted(str(i) for i in range(4, 20, 2))
+        for r in runs[1:]:
+            assert sorted(h["_id"] for h in r["hits"]["hits"]) == expected
+            assert [h["_score"] for h in r["hits"]["hits"]] == \
+                [h["_score"] for h in runs[0]["hits"]["hits"]]
+        st = QUERY_CACHE.stats()
+        assert st["cache_count"] >= 1       # filled after min_uses
+        assert st["hit_count"] >= 1         # later runs spliced the mask
+
+    def test_stats_surface_in_nodes_stats(self, node):
+        for _ in range(3):
+            node.request("POST", "/qc/_search", FILTERED)
+        stats = node.request("GET", "/_nodes/stats")
+        qc = next(iter(stats["nodes"].values()))["indices"]["query_cache"]
+        assert qc["cache_count"] >= 1
+        assert qc["memory_size_in_bytes"] > 0
+
+    def test_deletes_after_caching_stay_correct(self, node):
+        for _ in range(3):
+            node.request("POST", "/qc/_search", FILTERED)
+        assert QUERY_CACHE.stats()["cache_count"] >= 1
+        node.request("DELETE", "/qc/_doc/4")      # an even, n>=4 doc
+        node.request("POST", "/qc/_refresh")      # deletes visible on refresh
+        res = node.request("POST", "/qc/_search", FILTERED)
+        ids = sorted(h["_id"] for h in res["hits"]["hits"])
+        assert "4" not in ids
+        assert ids == sorted(str(i) for i in range(6, 20, 2))
+
+    def test_now_relative_range_never_caches(self, node):
+        body = {"query": {"bool": {"filter": [
+            {"range": {"d": {"lte": "now"}}}]}}, "size": 20}
+        for _ in range(4):
+            res = node.request("POST", "/qc/_search", body)
+            assert res["hits"]["total"]["value"] == 20
+        assert QUERY_CACHE.stats()["cache_count"] == 0
+
+    def test_single_use_does_not_cache(self, node):
+        node.request("POST", "/qc/_search", FILTERED)
+        assert QUERY_CACHE.stats()["cache_count"] == 0
+
+    def test_new_segment_after_refresh_gets_its_own_entries(self, node):
+        for _ in range(3):
+            node.request("POST", "/qc/_search", FILTERED)
+        before = QUERY_CACHE.stats()["cache_count"]
+        node.request("PUT", "/qc/_doc/100", {
+            "tag": "even", "n": 100, "body": "document number 100",
+            "d": "2026-01-01"})
+        node.request("POST", "/qc/_refresh")
+        for _ in range(3):
+            res = node.request("POST", "/qc/_search", FILTERED)
+        ids = sorted(h["_id"] for h in res["hits"]["hits"])
+        assert "100" in ids
+        assert QUERY_CACHE.stats()["cache_count"] > before
+
+
+class TestCacheability:
+    def test_leaves(self):
+        assert cacheable_node(dsl.TermQuery(field="f", value="v"))
+        assert cacheable_node(dsl.RangeQuery(field="f", gte=1))
+        assert not cacheable_node(dsl.RangeQuery(field="f", gte="now-1d"))
+        assert not cacheable_node(
+            dsl.ScriptScoreQuery(query=dsl.MatchAllQuery(),
+                                 script_source="1"))
+
+    def test_compound_taints(self):
+        clean = dsl.BoolQuery(filter=[dsl.TermQuery(field="f", value="v")])
+        assert cacheable_node(clean)
+        tainted = dsl.BoolQuery(filter=[
+            dsl.RangeQuery(field="d", lte="now")])
+        assert not cacheable_node(tainted)
+
+    def test_fingerprint_distinguishes(self):
+        a = dsl.TermQuery(field="f", value="v1")
+        b = dsl.TermQuery(field="f", value="v2")
+        assert fingerprint(a) != fingerprint(b)
+        assert fingerprint(a) == fingerprint(
+            dsl.TermQuery(field="f", value="v1"))

@@ -98,6 +98,41 @@ class TestDiffPublicationLive:
             for n in nodes.values():
                 n.close()
 
+    def test_lagging_peer_need_full_resend_converges(self):
+        # exercise the riskiest protocol path end-to-end: a peer IN
+        # prev.nodes whose accepted base doesn't match the diff must answer
+        # need_full and receive (and apply) the full-state resend
+        nodes = {f"nf-{i}": ClusterNode(f"nf-{i}") for i in range(3)}
+        try:
+            peers = {nid: n.address for nid, n in nodes.items()}
+            for n in nodes.values():
+                n.bootstrap(peers)
+            wait_for(lambda: any(n.is_leader for n in nodes.values()),
+                     msg="leader")
+            any_node = next(iter(nodes.values()))
+            any_node.request("PUT", "/nf-0", {
+                "settings": {"number_of_shards": 1,
+                             "number_of_replicas": 0}})
+            any_node.await_health("green", timeout=30)
+            leader = next(n for n in nodes.values() if n.is_leader)
+            victim = next(n for n in nodes.values() if not n.is_leader)
+            # sabotage the follower's accepted base so the next diff can't
+            # apply (simulates a peer that missed/lost a publication)
+            cs = victim.coordinator.coord_state
+            cs.last_accepted = cs.last_accepted.with_(
+                version=cs.last_accepted.version - 1)
+            before_full = leader.coordinator.publish_stats["full"]
+            any_node.request("PUT", "/nf-1", {
+                "settings": {"number_of_shards": 1,
+                             "number_of_replicas": 0}})
+            wait_for(lambda: "nf-1" in
+                     (victim._data() or {}).get("indices", {}),
+                     msg="lagging peer converged via full resend")
+            assert leader.coordinator.publish_stats["full"] > before_full
+        finally:
+            for n in nodes.values():
+                n.close()
+
     def test_fresh_joiner_falls_back_to_full_state(self):
         nodes = {f"fj-{i}": ClusterNode(f"fj-{i}") for i in range(2)}
         extra = None
