@@ -79,9 +79,12 @@ def fingerprint(node) -> str:
 
 
 class QueryCache:
-    def __init__(self, max_entries: int = 256, min_uses: int = 2):
+    def __init__(self, max_entries: int = 256, min_uses: int = 2,
+                 max_bytes: int = 64 << 20):
         self.max_entries = max_entries
         self.min_uses = min_uses
+        self.max_bytes = max_bytes
+        self._bytes = 0
         self._masks: "OrderedDict[Tuple[int, str], np.ndarray]" \
             = OrderedDict()
         self._uses: "OrderedDict[Tuple[int, str], int]" = OrderedDict()
@@ -116,16 +119,25 @@ class QueryCache:
     def put(self, seg_uid: int, fp: str, mask: np.ndarray):
         key = (seg_uid, fp)
         with self._lock:
+            old = self._masks.get(key)
+            if old is not None:
+                self._bytes -= old.nbytes
             self._masks[key] = mask
+            self._bytes += mask.nbytes
             self._masks.move_to_end(key)
-            while len(self._masks) > self.max_entries:
-                self._masks.popitem(last=False)
+            # entry-count AND byte budget (indices.queries.cache.size):
+            # large segments have proportionally large masks
+            while self._masks and (len(self._masks) > self.max_entries
+                                   or self._bytes > self.max_bytes):
+                _, dropped = self._masks.popitem(last=False)
+                self._bytes -= dropped.nbytes
                 self.evictions += 1
 
     def clear(self):
         with self._lock:
             self._masks.clear()
             self._uses.clear()
+            self._bytes = 0
             self.hits = self.misses = self.evictions = 0
 
     def stats(self) -> Dict:
@@ -135,8 +147,7 @@ class QueryCache:
                 "miss_count": self.misses,
                 "cache_count": len(self._masks),
                 "evictions": self.evictions,
-                "memory_size_in_bytes": sum(m.nbytes
-                                            for m in self._masks.values()),
+                "memory_size_in_bytes": self._bytes,
             }
 
 

@@ -96,13 +96,14 @@ def main():  # pragma: no cover - kept for back-compat; launcher supersedes
     p.add_argument("--port", type=int, default=9200)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--data-path", default=None)
-    args = p.parse_args()
+    # launcher-native flags (-c/-E/...) pass through untouched
+    args, passthrough = p.parse_known_args()
     overrides = [f"http.port={args.port}", f"http.host={args.host}"]
     if args.data_path:
         overrides.append(f"path.data={args.data_path}")
     from opensearch_tpu.launcher import main as launcher_main
     raise SystemExit(launcher_main(
-        [arg for o in overrides for arg in ("-E", o)]))
+        passthrough + [arg for o in overrides for arg in ("-E", o)]))
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -34,3 +34,36 @@ def eight_devices():
     devices = jax.devices()
     assert len(devices) >= 8, f"expected >=8 virtual devices, got {devices}"
     return devices
+
+
+# ------------------------------------------------------- seeded randomization
+#
+# OpenSearchTestCase analog: every randomized test draws from a Random
+# seeded by TEST_SEED (or a fresh seed), derived per test id so one run's
+# tests are independent but fully reproducible. On failure the reproduce
+# line is appended to the report:  TEST_SEED=<seed> python -m pytest <test>
+
+import random as _random  # noqa: E402
+
+_BASE_SEED = os.environ.get("TEST_SEED") or \
+    f"{_random.SystemRandom().randrange(1 << 32):08X}"
+
+
+@pytest.fixture()
+def rnd(request):
+    derived = f"{_BASE_SEED}:{request.node.nodeid}"
+    r = _random.Random(derived)
+    request.node._test_seed = _BASE_SEED
+    return r
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    seed = getattr(item, "_test_seed", None)
+    if rep.failed and seed is not None:
+        rep.sections.append(
+            ("randomized seed",
+             f"reproduce with: TEST_SEED={seed} python -m pytest "
+             f"{item.nodeid}"))
