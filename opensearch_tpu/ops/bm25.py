@@ -32,30 +32,35 @@ def score_text_clause(seg, blk, k1):
     """Score one text clause (match / term / terms over one field family).
 
     seg: device segment dict (post_docs, post_tf, norms, length_table).
-    blk: per-block gathered inputs, all shape [QB] (power-of-two bucketed):
-      - ids:    int32 block row indices into post_docs/post_tf
-      - w:      float32 idf * boost * multiplicity for the block's term (0 pad)
-      - row:    int32 norms-stack row of the block's field (0 for padding)
-      - avgdl:  float32 average field length for the block's field (1 for padding)
-      - b:      float32 BM25 b for the block (0 for norm-less keyword fields,
+    blk: per-block gathered inputs:
+      - ids:    int32 [QB] block row indices into post_docs/post_tf
+                (power-of-two bucketed; -1 = padding lane)
+      - w:      float32 [QB] idf * boost * multiplicity for the block's term
+      - row:    int32 scalar norms-stack row of the clause's field
+      - avgdl:  float32 scalar average field length for the clause's field
+      - b:      float32 scalar BM25 b (0 for norm-less keyword fields,
                 matching Lucene's omit-norms denominator tf + k1)
-      - hit:    int32 1 for real blocks, 0 for padding
     k1: BM25 k1 (traced scalar).
+
+    Clause constants are SCALARS (one field per clause): per-lane data is
+    only (ids, w), which halves the msearch envelope bytes per query.
 
     Returns (scores f32 [Dp], hits int32 [Dp]) — hits counts distinct matched
     clause terms per doc, powering operator=and / minimum_should_match.
     """
     d_pad = seg["live"].shape[0]
-    docs = seg["post_docs"][blk["ids"]]          # [QB, 128]
-    tfs = seg["post_tf"][blk["ids"]]             # [QB, 128]
+    lane_real = blk["ids"] >= 0                  # [QB]
+    safe_ids = jnp.where(lane_real, blk["ids"], 0)
+    docs = seg["post_docs"][safe_ids]            # [QB, 128]
+    tfs = seg["post_tf"][safe_ids]               # [QB, 128]
     valid = docs >= 0
     safe_docs = jnp.where(valid, docs, 0)
-    norm_bytes = seg["norms"][blk["row"][:, None], safe_docs]     # [QB, 128]
+    norm_bytes = seg["norms"][blk["row"]][safe_docs]              # [QB, 128]
     dl = seg["length_table"][norm_bytes]
-    b = blk["b"][:, None]
-    denom = tfs + k1 * (1.0 - b + b * dl / blk["avgdl"][:, None])
+    b = blk["b"]
+    denom = tfs + k1 * (1.0 - b + b * dl / blk["avgdl"])
     partial = blk["w"][:, None] * tfs * (k1 + 1.0) / denom
-    real = valid & (blk["hit"][:, None] > 0)
+    real = valid & lane_real[:, None]
     partial = jnp.where(real, partial, 0.0)
     ones = jnp.where(real, 1, 0).astype(jnp.int32)
     # padding lanes scatter to index d_pad which is dropped (out of bounds)

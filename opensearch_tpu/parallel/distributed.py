@@ -87,6 +87,7 @@ _PAD_FILL: Dict[str, Any] = {
     "min_rank": np.int32(2 ** 31 - 1),
     "max_rank": -1,
     "avgdl": 1.0,       # divisor — must stay nonzero
+    "ids": -1,          # -1 = padding postings-block lane (no hit)
 }
 
 

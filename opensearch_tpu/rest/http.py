@@ -102,8 +102,10 @@ def main():  # pragma: no cover - kept for back-compat; launcher supersedes
     if args.data_path:
         overrides.append(f"path.data={args.data_path}")
     from opensearch_tpu.launcher import main as launcher_main
+    # legacy-flag translations FIRST: apply_overrides is last-wins, so an
+    # explicit passthrough -E must beat the argparse defaults
     raise SystemExit(launcher_main(
-        passthrough + [arg for o in overrides for arg in ("-E", o)]))
+        [arg for o in overrides for arg in ("-E", o)] + passthrough))
 
 
 if __name__ == "__main__":  # pragma: no cover

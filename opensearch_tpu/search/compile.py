@@ -71,6 +71,14 @@ class ShardStats:
     def __init__(self, segments: Sequence[Segment]):
         self.segments = list(segments)
         self._field: Dict[str, Tuple[int, int]] = {}
+        # per-(field, term) idf memo: segments are immutable post-seal, so
+        # a ShardStats bound to a segment list may cache term statistics
+        # for its lifetime (Lucene's per-reader TermStates caching)
+        self._idf: Dict[Tuple[str, str], float] = {}
+        # per-reader memo shared by compilers: analyzed query terms and
+        # compiled text-clause plans (the per-(reader, query) Weight cache
+        # analog — ContextIndexSearcher/QueryCache keep Weights per reader)
+        self.memo: Dict[Any, Any] = {}
         for seg in segments:
             for fname, st in seg.field_stats.items():
                 dc, ttf = self._field.get(fname, (0, 0))
@@ -88,11 +96,15 @@ class ShardStats:
                    if (m := seg.get_term(field, term)) is not None)
 
     def idf(self, field: str, term: str) -> float:
+        key = (field, term)
+        cached = self._idf.get(key)
+        if cached is not None:
+            return cached
         dc, _ = self.field_stats(field)
         df = self.df(field, term)
-        if df == 0:
-            return 0.0
-        return bm25_idf(dc, df)
+        value = bm25_idf(dc, df) if df else 0.0
+        self._idf[key] = value
+        return value
 
 
 MATCH_NONE = Plan("match_none")
@@ -139,12 +151,24 @@ class Compiler:
                      b: float = DEFAULT_B) -> Plan:
         """weighted_terms: (term, weight) where weight already folds idf, query
         boost and term multiplicity. min_hits: required distinct term matches."""
+        # repeated clauses (same terms against the same immutable segment)
+        # reuse their built Plan: arrays are read-only downstream (stacking
+        # and jnp.asarray copy), so sharing is safe
+        memo_key = ("tc", seg.uid, field, tuple(weighted_terms), min_hits,
+                    boost, constant, k1, b)
+        cached = self.stats.memo.get(memo_key)
+        if cached is not None:
+            return cached
         ft = self.mapper.get_field(field)
         row = meta.norm_row(field)
         has_norms = ft is not None and ft.is_text and row is not None
         b_eff = b if has_norms else 0.0
         avgdl = self.stats.avgdl(field)
-        ids, ws, rows, avs, bs, hits = [], [], [], [], [], []
+        # per-lane data is only (block id, weight); the clause constants
+        # (norms row, avgdl, b) are scalars — one field per clause — which
+        # shrinks both compile work and the msearch envelope bytes that
+        # cross the host↔device link per query
+        ids, ws = [], []
         for term, w in weighted_terms:
             tm = seg.get_term(field, term)
             if tm is None:
@@ -152,19 +176,14 @@ class Compiler:
             for blk_i in range(tm.start_block, tm.start_block + tm.num_blocks):
                 ids.append(blk_i)
                 ws.append(w)
-                rows.append(row if has_norms else 0)
-                avs.append(avgdl if avgdl > 0 else 1.0)
-                bs.append(b_eff)
-                hits.append(1)
         qb = pad_bucket(max(len(ids), 1), minimum=8)
         pad = qb - len(ids)
         inputs = {
-            "ids": _i32(ids + [0] * pad),
+            "ids": _i32(ids + [-1] * pad),    # -1 = padding lane (no hit)
             "w": _f32(ws + [0.0] * pad),
-            "row": _i32(rows + [0] * pad),
-            "avgdl": _f32(avs + [1.0] * pad),
-            "b": _f32(bs + [0.0] * pad),
-            "hit": _i32(hits + [0] * pad),
+            "row": _i32(row if has_norms else 0),
+            "avgdl": _f32(avgdl if avgdl > 0 else 1.0),
+            "b": _f32(b_eff),
             "k1": _f32(k1),
             "min_hits": _i32(min_hits),
             "boost": _f32(boost),
@@ -172,14 +191,25 @@ class Compiler:
         # static records the distinct-term count: the candidate-buffer
         # kernel needs the max run length (= clause terms containing a doc)
         # to window its exact segment-sum (executor.py)
-        return Plan("text", static=(bool(constant), len(weighted_terms)),
+        plan = Plan("text", static=(bool(constant), len(weighted_terms)),
                     inputs=inputs)
+        if len(self.stats.memo) > 8192:     # bound the per-reader memo
+            self.stats.memo.clear()
+        self.stats.memo[memo_key] = plan
+        return plan
 
     def _analyze_query_terms(self, ft: MappedFieldType, text: Any,
                              analyzer_override: Optional[str] = None) -> List[str]:
         if ft.is_text:
             name = analyzer_override or ft.search_analyzer or ft.analyzer
-            return self.mapper.analysis.get(name).terms(str(text))
+            key = ("an", name, text if isinstance(text, str) else str(text))
+            cached = self.stats.memo.get(key)
+            if cached is None:
+                cached = self.mapper.analysis.get(name).terms(str(text))
+                if len(self.stats.memo) > 8192:   # same bound as the plan
+                    self.stats.memo.clear()       # memo (shared dict)
+                self.stats.memo[key] = cached
+            return cached
         return [str(text)]
 
     def _weighted(self, field: str, terms: Sequence[str],

@@ -65,6 +65,7 @@ class ShardReader:
         self.index_name = index_name
         self.segments: List[Segment] = []
         self.device: List[Tuple[Dict, DeviceSegmentMeta]] = []
+        self._stats_cache: Optional[ShardStats] = None
         for seg in (segments or []):
             self.add_segment(seg)
 
@@ -72,12 +73,14 @@ class ShardReader:
         arrays, meta = upload_segment(seg)
         self.segments.append(seg)
         self.device.append((arrays, meta))
+        self._stats_cache = None
 
     def remove_segment(self, seg_id: str):
         for i, seg in enumerate(self.segments):
             if seg.seg_id == seg_id:
                 del self.segments[i]
                 del self.device[i]
+                self._stats_cache = None
                 return
 
     def notify_deletes(self, seg: Segment):
@@ -101,6 +104,7 @@ class ShardReader:
             else:
                 self.segments[i] = seg
                 self.device[i] = upload_segment(seg)
+            self._stats_cache = None
             return
         self.add_segment(seg)
 
@@ -109,7 +113,13 @@ class ShardReader:
         return sum(s.live_doc_count for s in self.segments)
 
     def stats(self) -> ShardStats:
-        return ShardStats(self.segments)
+        # cached while the segment list is stable: ShardStats carries the
+        # per-term idf memo, so reuse across requests is the win (deletes
+        # don't move doc_freq until merge, same as Lucene)
+        if self._stats_cache is None or \
+                self._stats_cache.segments != self.segments:
+            self._stats_cache = ShardStats(self.segments)
+        return self._stats_cache
 
 
 class PinnedReader:
@@ -136,6 +146,12 @@ class PinnedReader:
 # ------------------------------------------------------------------ execution
 
 _JIT_CACHE: Dict[Any, Any] = {}
+
+# msearch phase accounting (?profile analog for the batch path; read by
+# tools/profile_bench.py): cumulative seconds per phase
+MSEARCH_PHASES: Dict[str, float] = {
+    "parse": 0.0, "compile_group": 0.0, "stack_pack_dispatch": 0.0,
+    "device_get": 0.0, "respond": 0.0}
 
 
 def build_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
@@ -291,17 +307,19 @@ def build_candidate_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
 
     def one(seg, flat_inputs, min_score):
         my = flat_inputs[0]
-        docs = seg["post_docs"][my["ids"]]            # [QB, 128]
-        tfs = seg["post_tf"][my["ids"]]
+        lane_real = my["ids"] >= 0                    # [QB]
+        safe_ids = jnp.where(lane_real, my["ids"], 0)
+        docs = seg["post_docs"][safe_ids]             # [QB, 128]
+        tfs = seg["post_tf"][safe_ids]
         valid = docs >= 0
         safe_docs = jnp.where(valid, docs, 0)
-        norm_bytes = seg["norms"][my["row"][:, None], safe_docs]
+        norm_bytes = seg["norms"][my["row"]][safe_docs]
         dl = seg["length_table"][norm_bytes]
-        b = my["b"][:, None]
+        b = my["b"]
         k1 = my["k1"]
-        denom = tfs + k1 * (1.0 - b + b * dl / my["avgdl"][:, None])
+        denom = tfs + k1 * (1.0 - b + b * dl / my["avgdl"])
         partial = my["w"][:, None] * tfs * (k1 + 1.0) / denom
-        real = valid & (my["hit"][:, None] > 0)
+        real = valid & lane_real[:, None]
 
         n = docs.shape[0] * docs.shape[1]
         big = jnp.int32(2 ** 30)
@@ -660,6 +678,8 @@ class SearchExecutor:
         (reference: action/search/TransportMultiSearchAction fans bodies out
         concurrently; here concurrency is a batch axis on the MXU/VPU)."""
         start = time.monotonic()
+        _ph = MSEARCH_PHASES
+        _t = time.monotonic()
         responses: List[Optional[dict]] = [None] * len(bodies)
 
         batchable: List[Tuple[int, dict, Any, int, int, float]] = []
@@ -700,6 +720,7 @@ class SearchExecutor:
                     for d in f for k2, v in d.items())
                 for f in flats)
 
+        _ph["parse"] += time.monotonic() - _t; _t = time.monotonic()
         groups: Dict[Any, List[int]] = {}
         compiled: Dict[int, List[Optional[Plan]]] = {}
         flats_by_i: Dict[int, List[Optional[list]]] = {}
@@ -739,6 +760,7 @@ class SearchExecutor:
             groups.setdefault((struct, _flat_shape_sig(flats),
                                min(k, 1 << 16)), []).append(i)
 
+        _ph["compile_group"] += time.monotonic() - _t; _t = time.monotonic()
         entry_by_i = {e[0]: e for e in batchable}
         # phase 1: dispatch every group × segment program without blocking —
         # jax dispatch is async, so device work and tunnel transfers overlap.
@@ -768,6 +790,8 @@ class SearchExecutor:
                 pending.append((idxs, seg_i, k_seg,
                                 fn(arrays, jnp.asarray(buf))))
 
+        _ph["stack_pack_dispatch"] += time.monotonic() - _t
+        _t = time.monotonic()
         # phase 2: collect (vectorized — no per-candidate python objects);
         # all group×segment outputs are concatenated ON DEVICE and fetched
         # with ONE device_get = one transfer round trip for the whole
@@ -788,6 +812,7 @@ class SearchExecutor:
         else:
             fetched = jax.device_get(
                 [packed for _, _, _, packed in pending])
+        _ph["device_get"] += time.monotonic() - _t; _t = time.monotonic()
         for (idxs, seg_i, k_seg, _), packed in zip(pending, fetched):
             scores_b, idx_b, total_b = unpack_batched_result(
                 np.asarray(packed), k_seg)
@@ -805,10 +830,20 @@ class SearchExecutor:
                 valid = all_scores > NEG_INF
                 all_scores, all_ords, all_segs = (
                     all_scores[valid], all_ords[valid], all_segs[valid])
-                # score desc, then seg asc, then doc asc — mergeTopDocs order
-                order = np.lexsort((all_ords, all_segs, -all_scores))
-                page = order[from_:from_ + size]
-                max_score = float(all_scores.max()) if len(all_scores) else None
+                if len(seg_results) == 1:
+                    # the device's top_k is already score-desc with doc-asc
+                    # tie-break (candidate lanes are doc-sorted; ties pick
+                    # the lowest lane) — the single-segment page is a slice
+                    page = np.arange(from_, min(from_ + size,
+                                                len(all_scores)))
+                    max_score = float(all_scores[0]) \
+                        if len(all_scores) else None
+                else:
+                    # score desc, then seg asc, then doc asc — mergeTopDocs
+                    order = np.lexsort((all_ords, all_segs, -all_scores))
+                    page = order[from_:from_ + size]
+                    max_score = float(all_scores.max()) \
+                        if len(all_scores) else None
             else:
                 page = np.array([], dtype=np.int64)
                 all_scores = all_ords = all_segs = np.array([])
@@ -829,6 +864,7 @@ class SearchExecutor:
                 },
             }
 
+        _ph["respond"] += time.monotonic() - _t
         return {"took": int((time.monotonic() - start) * 1000),
                 "responses": responses}
 

@@ -54,11 +54,16 @@ def main():
     t0 = time.perf_counter()
     executor.multi_search(bodies)
     log("msearch cold (compiles)", time.perf_counter() - t0)
+    from opensearch_tpu.search.executor import MSEARCH_PHASES
+    for key in MSEARCH_PHASES:
+        MSEARCH_PHASES[key] = 0.0
     t0 = time.perf_counter()
     executor.multi_search(bodies)
     total = time.perf_counter() - t0
     log("msearch warm TOTAL", total,
         f"{len(bodies) / total:.0f} QPS")
+    for key, sec in MSEARCH_PHASES.items():
+        log(f"warm phase: {key}", sec)
 
     # ---- dissect the warm path (mirrors multi_search's envelope path)
     from opensearch_tpu.search import dsl
@@ -231,15 +236,18 @@ def main():
           name="μ: candidate-buffer (sort+segsum+topk)",
           note=f"N={QB * 128}")
 
+    # raw run dump goes to PROFILE_RUN.md — PROFILE.md is the curated
+    # analysis and must not be clobbered by a (possibly tunnel-degraded)
+    # ad-hoc run; tunnel RT varies 66-600ms between sessions
     with open(os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "PROFILE.md"), "w") as f:
-        f.write("# bench config 1 profile (%s)\n\n" % platform)
+            os.path.abspath(__file__))), "PROFILE_RUN.md"), "w") as f:
+        f.write("# bench config 1 profile run (%s)\n\n" % platform)
         f.write("| phase | ms | note |\n|---|---|---|\n")
         for name, sec, note in RESULTS:
             f.write(f"| {name} | {sec * 1000:.1f} | {note} |\n")
-        f.write(f"\ngroups: {[(b,) for b, _ in group_stats]}; "
+        f.write(f"\ngroups (n, b_pad, bytes): {group_stats}; "
                 f"d_pad={d_pad}; qb_max={qb_max}; B={B}\n")
-    print("\nwrote PROFILE.md")
+    print("\nwrote PROFILE_RUN.md")
 
 
 if __name__ == "__main__":
