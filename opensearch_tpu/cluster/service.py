@@ -46,6 +46,7 @@ SHARD_BULK_PRIMARY = "indices:data/write/shard_bulk[p]"
 SHARD_BULK_REPLICA = "indices:data/write/shard_bulk[r]"
 SHARD_QUERY = "indices:data/read/search[phase/query]"
 SHARD_FETCH = "indices:data/read/search[phase/fetch]"
+SHARD_DFS = "indices:data/read/search[phase/dfs]"
 SHARD_GET = "indices:data/read/get[s]"
 SHARD_REFRESH = "indices:admin/refresh[s]"
 START_RECOVERY = "internal:index/shard/recovery/start_recovery"
@@ -599,6 +600,8 @@ class ClusterNode:
             blocking=True)
         reg(self.node_id, SHARD_QUERY, self._on_shard_query, blocking=True,
             pool="search")
+        reg(self.node_id, SHARD_DFS, self._on_shard_dfs, blocking=True,
+            pool="search")
         reg(self.node_id, SHARD_FETCH, self._on_shard_fetch, blocking=True,
             pool="search")
         reg(self.node_id, SHARD_GET, self._on_shard_get, blocking=True,
@@ -738,9 +741,17 @@ class ClusterNode:
                        routing: Optional[str] = None) -> int:
         meta = self._index_meta(name)
         settings = meta.get("settings", {})
+        num_shards = int(settings.get("number_of_shards", 1))
         return generate_shard_id(
-            doc_id, int(settings.get("number_of_shards", 1)),
-            routing=routing)
+            doc_id, num_shards, routing=routing,
+            # shrink/split keep the ORIGINAL routing space; partitioned
+            # indices spread one routing value over several shards — both
+            # must match the local IndexService's routing exactly or a
+            # cluster write lands on a different shard than a local one
+            routing_num_shards=int(settings.get(
+                "number_of_routing_shards", num_shards)),
+            routing_partition_size=int(settings.get(
+                "routing_partition_size", 1)))
 
     def _retry_shard_op(self, attempt, timeout: float = 10.0):
         """Run a shard-level operation, retrying while the target reports
@@ -903,6 +914,9 @@ class ClusterNode:
         if (body.get("aggs") or body.get("aggregations")) \
                 and skip == set(shards):
             skip.discard(min(skip))
+        # DFS-pinned global stats (dfs_query_then_fetch): the coordinator
+        # merged every shard's term statistics into body["_dfs"]
+        dfs = body.get("_dfs")
         out = []
         for sid, shard in shards.items():
             if sid in skip:
@@ -910,8 +924,15 @@ class ClusterNode:
                             "partials": Opaque([]), "total": 0,
                             "skipped": True})
                 continue
+            override = None
+            if dfs:
+                from opensearch_tpu.search.compile import StaticStats
+                override = StaticStats(
+                    shard.executor.reader.stats(),
+                    {f: tuple(v) for f, v in dfs["fields"].items()},
+                    dfs["term_df"])
             cands, decoded, total = shard.executor.execute_query_phase(
-                body, k)
+                body, k, stats_override=override)
             out.append({"shard": sid,
                         "candidates": Opaque(
                             [(c.score, c.seg_i, c.ord, c.sort_values)
@@ -919,6 +940,95 @@ class ClusterNode:
                         "partials": Opaque(decoded),
                         "total": total})
         return {"results": out}
+
+    def _on_shard_dfs(self, sender: str, payload: dict):
+        """Shard-side DFS phase (DfsPhase.execute): report this node's
+        term/field statistics for the query so the coordinator can merge
+        them (dfs_query_then_fetch)."""
+        from opensearch_tpu.search import dsl
+        from opensearch_tpu.search.compile import (collect_query_term_stats,
+                                                   merge_dfs_stats)
+        name = payload["index"]
+        parts = []
+        for sid in payload["shards"]:
+            shard = self.shards.get((name, sid))
+            if shard is None:
+                raise ShardNotReadyError(f"shard [{name}][{sid}] not local")
+            reader = shard.executor.reader
+            node = dsl.parse_query(payload["body"].get("query"))
+            parts.append(collect_query_term_stats(node, reader.mapper,
+                                                  reader.stats()))
+        fields, term_df = merge_dfs_stats(parts)
+        return {"fields": {f: list(v) for f, v in fields.items()},
+                "term_df": term_df}
+
+    def _dfs_prephase(self, name: str, body: dict) -> dict:
+        """Coordinator half: fan SHARD_DFS to one copy of every shard (in
+        parallel, with the same routing re-resolution retry the query
+        phase uses), merge (SearchPhaseController#aggregateDfs), and
+        return the body with the merged stats pinned under `_dfs`."""
+        from opensearch_tpu.search.compile import merge_dfs_stats
+        deadline = time.time() + 10.0
+        while True:
+            routing = self._data().get("routing", {})
+            if name not in routing:
+                raise IndexNotFoundError(f"no such index [{name}]")
+            by_node: Dict[str, List[int]] = {}
+            unassigned = None
+            for sid, entry in enumerate(routing[name]):
+                copies = ([entry["primary"]] if entry.get("primary")
+                          else []) + list(entry.get("active_replicas", []))
+                if not copies:
+                    unassigned = sid
+                    break
+                by_node.setdefault(copies[0], []).append(sid)
+            if unassigned is not None:
+                if time.time() >= deadline:
+                    raise ShardNotReadyError(
+                        f"no active copy for shard [{name}][{unassigned}]")
+                time.sleep(0.1)
+                continue
+            parts: List = []
+            errors: List[Exception] = []
+            lock = threading.Lock()
+
+            def dfs_node(node: str, sids: List[int]):
+                payload = {"index": name, "shards": sids, "body": body}
+                try:
+                    if node == self.node_id:
+                        resp = self._on_shard_dfs(self.node_id, payload)
+                    else:
+                        resp = self.transport.send_sync(
+                            node, SHARD_DFS, payload, timeout=30.0)
+                    with lock:
+                        parts.append((
+                            {f: tuple(v)
+                             for f, v in resp["fields"].items()},
+                            resp["term_df"]))
+                except Exception as e:
+                    errors.append(e)
+
+            threads = [threading.Thread(target=dfs_node, args=(n, s),
+                                        daemon=True)
+                       for n, s in by_node.items()]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(35.0)
+            if not errors:
+                break
+            retryable = all(
+                isinstance(e, ShardNotReadyError)
+                or (isinstance(e, RemoteTransportError)
+                    and e.error_type == ShardNotReadyError.error_type)
+                for e in errors)
+            if not retryable or time.time() >= deadline:
+                raise errors[0]
+            time.sleep(0.1)
+        fields, term_df = merge_dfs_stats(parts)
+        return {**body, "_dfs": {"fields": {f: list(v)
+                                            for f, v in fields.items()},
+                                 "term_df": term_df}}
 
     def _on_shard_fetch(self, sender: str, payload: dict):
         """Shard-side fetch phase: render hit dicts for the winning docs
@@ -1120,6 +1230,9 @@ class ClusterNode:
         score_sorted = sort_specs[0][0] == "_score"
         wants_score = score_sorted or bool(body.get("track_scores"))
         k = max(from_ + size, 10)
+
+        if body.get("search_type") == "dfs_query_then_fetch":
+            body = self._dfs_prephase(name, body)
 
         (all_candidates, all_partials, total, shard_nodes,
          n_shards, skipped) = self._cluster_query_phase(name, body, k)
@@ -1495,6 +1608,9 @@ class ClusterNode:
         if sub == "_bulk" and method == "POST":
             return self._rest_bulk(name, raw), 200
         if sub == "_search" and method in ("GET", "POST"):
+            if params.get("search_type"):
+                body = {**(body or {}),
+                        "search_type": params["search_type"]}
             if "," in name or ":" in name:
                 return self.search_ccs(name, body), 200
             return self.search(name, body), 200

@@ -369,6 +369,8 @@ def register_search_actions(node, c):
         # URI-search params override/augment the body
         if req.param("q") is not None:
             body["query"] = {"query_string": {"query": req.param("q")}}
+        if req.param("search_type"):
+            body["search_type"] = req.param("search_type")
         for p in ("from", "size"):
             if req.param(p) is not None:
                 body[p] = req.int_param(p)

@@ -107,6 +107,137 @@ class ShardStats:
         return value
 
 
+class StaticStats:
+    """Term/field statistics fixed by a DFS pre-phase
+    (dfs_query_then_fetch — action/search/DfsQueryPhase.java +
+    SearchPhaseController#aggregateDfs): every shard scores with the
+    GLOBAL df/avgdl instead of shard-local values, so cross-shard scores
+    are comparable even with skewed term distributions. Unknown terms fall
+    back to the local shard statistics."""
+
+    def __init__(self, local: "ShardStats",
+                 field_stats: Dict[str, Tuple[int, int]],
+                 term_df: Dict[str, Dict[str, int]]):
+        self.segments = local.segments
+        self._local = local
+        self._fields = field_stats
+        self._term_df = term_df
+        self.memo: Dict[Any, Any] = {}       # per-request (never shared)
+
+    def field_stats(self, field: str) -> Tuple[int, int]:
+        got = self._fields.get(field)
+        return tuple(got) if got is not None else \
+            self._local.field_stats(field)
+
+    def avgdl(self, field: str) -> float:
+        dc, ttf = self.field_stats(field)
+        return (ttf / dc) if dc > 0 else 1.0
+
+    def df(self, field: str, term: str) -> int:
+        got = (self._term_df.get(field) or {}).get(term)
+        return got if got is not None else self._local.df(field, term)
+
+    def idf(self, field: str, term: str) -> float:
+        df = self.df(field, term)
+        if df == 0:
+            return 0.0
+        dc, _ = self.field_stats(field)
+        return bm25_idf(dc, df)
+
+
+def analyze_query_text(mapper: MapperService, ft, text,
+                       analyzer_override: Optional[str] = None) -> List[str]:
+    """THE analyzer-resolution chain for query text (override →
+    search_analyzer → index analyzer) — shared by the compiler and the DFS
+    term collector so both see identical terms."""
+    if ft is None:
+        return []
+    if ft.is_text:
+        name = analyzer_override or ft.search_analyzer or ft.analyzer
+        return mapper.analysis.get(name).terms(str(text))
+    return [str(text)]
+
+
+def collect_query_term_stats(node: dsl.QueryNode, mapper: MapperService,
+                             stats: ShardStats):
+    """The shard-local half of the DFS phase (DfsPhase.execute): extract
+    every (field, term) the query scores with, report this shard's df for
+    each plus the field-level (doc_count, sum_ttf). query_string /
+    simple_query_string rewrite through the same parser the compiler uses.
+    Conservative: query shapes it doesn't recognize contribute nothing
+    (they'll score with local stats, exactly like the non-DFS path)."""
+    fields: Dict[str, Tuple[int, int]] = {}
+    term_df: Dict[str, Dict[str, int]] = {}
+
+    def record(field: str, terms):
+        if not terms:
+            return
+        fields[field] = stats.field_stats(field)
+        bucket = term_df.setdefault(field, {})
+        for t in terms:
+            if t not in bucket:
+                bucket[t] = stats.df(field, t)
+
+    def analyze(field: str, text, analyzer=None):
+        return analyze_query_text(mapper, mapper.get_field(field), text,
+                                  analyzer)
+
+    def walk(n):
+        if isinstance(n, dsl.QueryStringQuery):
+            walk(_parse_query_string(n.query, n.default_field or "*",
+                                     list(n.fields), n.default_operator,
+                                     mapper))
+            return
+        if isinstance(n, dsl.SimpleQueryStringQuery):
+            walk(_parse_query_string(n.query, "*", list(n.fields),
+                                     n.default_operator, mapper,
+                                     simple=True))
+            return
+        if isinstance(n, dsl.MatchQuery) or \
+                isinstance(n, dsl.MatchBoolPrefixQuery):
+            record(n.field, analyze(n.field, n.query,
+                                    getattr(n, "analyzer", None)))
+        elif isinstance(n, dsl.MatchPhraseQuery):
+            record(n.field, analyze(n.field, n.query, n.analyzer))
+        elif isinstance(n, dsl.TermQuery):
+            record(n.field, [str(n.value)])
+        elif isinstance(n, dsl.TermsQuery):
+            record(n.field, [str(v) for v in n.values])
+        elif isinstance(n, dsl.SpanTermQuery):
+            record(n.field, [n.value])
+        elif isinstance(n, dsl.MultiMatchQuery):
+            for fspec in n.fields:
+                fname = fspec.partition("^")[0]
+                record(fname, analyze(fname, n.query))
+        for f in dc_fields(n):
+            sub = getattr(n, f.name, None)
+            if isinstance(sub, dsl.QueryNode):
+                walk(sub)
+            elif isinstance(sub, (list, tuple)):
+                for s in sub:
+                    if isinstance(s, dsl.QueryNode):
+                        walk(s)
+
+    walk(node)
+    return fields, term_df
+
+
+def merge_dfs_stats(parts):
+    """Coordinator-side aggregateDfs: sum df and field stats across the
+    per-shard contributions."""
+    fields: Dict[str, Tuple[int, int]] = {}
+    term_df: Dict[str, Dict[str, int]] = {}
+    for f_part, t_part in parts:
+        for field, (dc, ttf) in f_part.items():
+            have = fields.get(field, (0, 0))
+            fields[field] = (have[0] + dc, have[1] + ttf)
+        for field, bucket in t_part.items():
+            tgt = term_df.setdefault(field, {})
+            for term, df in bucket.items():
+                tgt[term] = tgt.get(term, 0) + df
+    return fields, term_df
+
+
 MATCH_NONE = Plan("match_none")
 
 # plugin-registered compilers for new QueryNode classes:
@@ -205,7 +336,8 @@ class Compiler:
             key = ("an", name, text if isinstance(text, str) else str(text))
             cached = self.stats.memo.get(key)
             if cached is None:
-                cached = self.mapper.analysis.get(name).terms(str(text))
+                cached = analyze_query_text(self.mapper, ft, text,
+                                            analyzer_override)
                 if len(self.stats.memo) > 8192:   # same bound as the plan
                     self.stats.memo.clear()       # memo (shared dict)
                 self.stats.memo[key] = cached
@@ -509,6 +641,24 @@ class Compiler:
             return Plan("exists", static=("norms", row),
                         inputs={"boost": _f32(node.boost)})
         return MATCH_NONE
+
+    def _c_SliceQuery(self, node: dsl.SliceQuery, seg, meta) -> Plan:
+        """Sliced scroll (search/slice/TermsSliceQuery): partition docs by
+        murmur3(_id) % max. The per-segment hash table is computed once on
+        host and memoized per (segment, max) — slices of the same scroll
+        share it — then each slice is an equality mask."""
+        from opensearch_tpu.cluster.routing import hash_routing
+        key = ("slice", seg.uid, node.max)
+        buckets = self.stats.memo.get(key)
+        if buckets is None:
+            buckets = np.asarray(
+                [hash_routing(d) % node.max if d is not None else -1
+                 for d in seg.doc_ids], dtype=np.int32)
+            self.stats.memo[key] = buckets
+        mask = buckets == int(node.id)
+        return self._precomputed_plan(
+            seg, np.where(mask, np.float32(node.boost),
+                          np.float32(0.0))[:len(mask)], mask)
 
     def _c_IdsQuery(self, node: dsl.IdsQuery, seg, meta) -> Plan:
         d_pad = pad_bucket(max(seg.num_docs, 1))

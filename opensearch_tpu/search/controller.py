@@ -185,6 +185,29 @@ def execute_search(executors: List, body: Optional[dict],
     k = max(from_ + size, 10)
     max_k = 1 << 16
 
+    # DFS query-then-fetch (DfsQueryPhase + aggregateDfs): collect every
+    # shard's term statistics for the query, merge, and pin the merged
+    # stats on every shard's compile so scores are globally comparable
+    dfs_overrides: Optional[List] = None
+    if body.get("search_type") == "dfs_query_then_fetch" and executors:
+        from opensearch_tpu.common.errors import ParsingError
+        from opensearch_tpu.search.compile import (
+            StaticStats, collect_query_term_stats, merge_dfs_stats)
+        try:
+            qnode = dsl.parse_query(body.get("query"))
+        except ParsingError:
+            qnode = None             # the normal path raises it properly
+        if qnode is not None:
+            # any OTHER failure here is a real bug and must surface — a
+            # silent fallback to shard-local stats would hand the user
+            # non-comparable scores they explicitly asked to avoid
+            parts = [collect_query_term_stats(qnode, ex.reader.mapper,
+                                              ex.reader.stats())
+                     for ex in executors]
+            fields, term_df = merge_dfs_stats(parts)
+            dfs_overrides = [StaticStats(ex.reader.stats(), fields, term_df)
+                             for ex in executors]
+
     # can-match pre-filter (CanMatchPreFilterSearchPhase): shards whose
     # segment min/max metadata proves emptiness never compile or launch a
     # device program. Computed lazily — the SPMD program batches every
@@ -244,7 +267,9 @@ def execute_search(executors: List, body: Optional[dict],
             shard_start = time.monotonic_ns()
             extra = extra_filters[shard_i] if extra_filters else None
             cands, decoded, shard_total = ex.execute_query_phase(
-                body, k_eff, extra_filter=extra)
+                body, k_eff, extra_filter=extra,
+                stats_override=dfs_overrides[shard_i]
+                if dfs_overrides else None)
             for c in cands:
                 c.shard_i = shard_i
             candidates.extend(cands)

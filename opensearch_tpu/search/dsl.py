@@ -236,6 +236,15 @@ SPAN_QUERY_TYPES = (SpanTermQuery, SpanNearQuery, SpanFirstQuery, SpanOrQuery,
 
 
 @dataclass
+class SliceQuery(QueryNode):
+    """Internal: sliced scroll partition (search/slice/SliceBuilder.java) —
+    docs whose murmur3(_id) % max == id. Injected from body["slice"], not
+    parseable from the query DSL."""
+    id: int = 0
+    max: int = 2
+
+
+@dataclass
 class BoolQuery(QueryNode):
     must: List[QueryNode] = dc_field(default_factory=list)
     filter: List[QueryNode] = dc_field(default_factory=list)

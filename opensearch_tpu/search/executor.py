@@ -559,7 +559,8 @@ class SearchExecutor:
         return execute_search([self], body)
 
     def execute_query_phase(self, body: dict, k: int,
-                            extra_filter: Optional[dict] = None):
+                            extra_filter: Optional[dict] = None,
+                            stats_override=None):
         """Per-shard query phase (SearchService.executeQueryPhase analog):
         returns (candidates, per-segment decoded agg partials, total hits)
         for the coordinator to merge. `k` = from+size requested globally.
@@ -572,13 +573,21 @@ class SearchExecutor:
         body = body or {}
         from opensearch_tpu.indices.request_cache import (
             REQUEST_CACHE, cache_key, cacheable)
+        # DFS requests never cache (the reference excludes
+        # dfs_query_then_fetch from IndicesRequestCache): the global stats
+        # live outside the shard's own segments, so a per-shard key can't
+        # see them change
+        if body.get("search_type") == "dfs_query_then_fetch" \
+                or "_dfs" in body:
+            return self._query_phase_uncached(body, k, extra_filter,
+                                              stats_override)
         if cacheable(body):
             base = cache_key(self.reader.segments, body, k, extra_filter)
             key = ("shard", base) if base is not None else None
             if key is not None:
                 def compute():
                     cands, decoded, total = self._query_phase_uncached(
-                        body, k, extra_filter)
+                        body, k, extra_filter, stats_override)
                     # store candidates as plain tuples: callers mutate
                     # _Candidate.shard_i, which must not leak between hits
                     return ([(c.score, c.seg_i, c.ord, c.sort_values)
@@ -587,14 +596,27 @@ class SearchExecutor:
                     key, compute)
                 return ([_Candidate(s, g, o, sv) for s, g, o, sv in cts],
                         decoded, total)
-        return self._query_phase_uncached(body, k, extra_filter)
+        return self._query_phase_uncached(body, k, extra_filter,
+                                          stats_override)
 
     def _query_phase_uncached(self, body: dict, k: int,
-                              extra_filter: Optional[dict] = None):
+                              extra_filter: Optional[dict] = None,
+                              stats_override=None):
         node = dsl.parse_query(body.get("query"))
         if extra_filter is not None:
             node = dsl.BoolQuery(must=[node],
                                  filter=[dsl.parse_query(extra_filter)])
+        slice_spec = body.get("slice")
+        if slice_spec is not None:
+            sid = int(slice_spec.get("id", 0))
+            smax = int(slice_spec.get("max", 0))
+            if smax < 2:
+                raise IllegalArgumentError("[slice] max must be >= 2")
+            if not 0 <= sid < smax:
+                raise IllegalArgumentError(
+                    f"[slice] id must be in [0, {smax})")
+            node = dsl.BoolQuery(must=[node],
+                                 filter=[dsl.SliceQuery(id=sid, max=smax)])
         min_score = float(body["min_score"]) if body.get("min_score") is not None \
             else NEG_INF
 
@@ -602,7 +624,10 @@ class SearchExecutor:
         score_sorted = sort_specs[0][0] == "_score"
         primary = None if score_sorted else sort_specs[0]
 
-        stats = self.reader.stats()
+        # DFS query-then-fetch: score with the coordinator-merged global
+        # statistics instead of shard-local ones (StaticStats)
+        stats = stats_override if stats_override is not None \
+            else self.reader.stats()
         compiler = Compiler(self.reader.mapper, stats)
         agg_nodes = parse_aggs(body.get("aggs") or body.get("aggregations"))
         from opensearch_tpu.search.aggs.parse import PIPELINE_TYPES

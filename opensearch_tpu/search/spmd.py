@@ -73,6 +73,10 @@ def eligible(executors: List, body: dict, rows: List[Tuple[int, int]],
         return False
     if list(sort_specs) != [("_score", "desc")]:
         return False        # field sort needs the host sort-key path
+    if body.get("search_type") == "dfs_query_then_fetch":
+        return False        # DFS pins per-shard StaticStats (host loop)
+    if body.get("slice") is not None:
+        return False        # sliced scroll injects a host-side mask plan
     if body.get("collapse") or body.get("rescore"):
         # both operate on the candidate pool AFTER the query phase and
         # need the host loop's per-shard k+128 over-fetch; the SPMD merge

@@ -443,3 +443,33 @@ class TestCanMatchDistributed:
             "query": {"range": {"ts": {"gte": 1000}}}})
         assert res["_shards"]["skipped"] == 1
         assert res["hits"]["total"]["value"] == 3
+
+
+class TestDfsDistributed:
+    def test_dfs_prephase_equalizes_scores_over_transport(self, cluster):
+        from opensearch_tpu.cluster.routing import generate_shard_id
+        node = next(iter(cluster.values()))
+        node.request("PUT", "/dskew", {
+            "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+            "mappings": {"properties": {"body": {"type": "text"}}}})
+        node.await_health("green", timeout=30)
+        buckets = {0: [], 1: []}
+        i = 0
+        while any(len(b) < 3 for b in buckets.values()):
+            sid = generate_shard_id(f"dk-{i}", 2)
+            if len(buckets[sid]) < 3:
+                buckets[sid].append(f"dk-{i}")
+            i += 1
+        for did in buckets[0]:
+            node.request("PUT", f"/dskew/_doc/{did}", {"body": "rare word"})
+        for j, did in enumerate(buckets[1]):
+            node.request("PUT", f"/dskew/_doc/{did}",
+                         {"body": "rare word" if j == 0 else "common word"})
+        node.request("POST", "/dskew/_refresh")
+        res = node.request("POST", "/dskew/_search", {
+            "query": {"match": {"body": "rare"}}, "size": 10,
+            "search_type": "dfs_query_then_fetch"})
+        scores = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+        assert scores[buckets[1][0]] == pytest.approx(
+            scores[buckets[0][0]], rel=1e-5)
+        assert res["hits"]["total"]["value"] == 4

@@ -1,0 +1,135 @@
+"""DFS query-then-fetch and sliced scroll tests.
+
+Modeled on the reference suites: SearchPhaseControllerTests#aggregateDfs /
+DfsQueryPhaseTests (global term statistics make cross-shard scores
+comparable) and SearchSliceIT (slices partition a scroll exhaustively and
+disjointly)."""
+
+import pytest
+
+from opensearch_tpu.cluster.routing import generate_shard_id
+from opensearch_tpu.node import Node
+
+
+def ids_for_shards(n_shards, per_shard):
+    buckets = {s: [] for s in range(n_shards)}
+    i = 0
+    while any(len(b) < per_shard for b in buckets.values()):
+        sid = generate_shard_id(f"sk-{i}", n_shards)
+        if len(buckets[sid]) < per_shard:
+            buckets[sid].append(f"sk-{i}")
+        i += 1
+    return buckets
+
+
+class TestDfsQueryThenFetch:
+    @pytest.fixture()
+    def skewed(self):
+        """Two shards with deliberately skewed df for 'rare': shard 0 has
+        it in every doc, shard 1 in one doc — shard-local idf then scores
+        shard-1's hit far higher than shard-0's; global (DFS) stats score
+        equal-tf docs equally."""
+        n = Node()
+        n.request("PUT", "/skew", {
+            "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+            "mappings": {"properties": {"body": {"type": "text"}}}})
+        buckets = ids_for_shards(2, 4)
+        for did in buckets[0]:
+            n.request("PUT", f"/skew/_doc/{did}", {"body": "rare word"})
+        for j, did in enumerate(buckets[1]):
+            n.request("PUT", f"/skew/_doc/{did}",
+                      {"body": "rare word" if j == 0 else "common word"})
+        n.request("POST", "/skew/_refresh")
+        return n, buckets
+
+    def test_dfs_equalizes_cross_shard_scores(self, skewed):
+        node, buckets = skewed
+        body = {"query": {"match": {"body": "rare"}}, "size": 10}
+        # local stats: the lone shard-1 hit outscores every shard-0 hit
+        plain = node.request("POST", "/skew/_search", body)
+        by_id = {h["_id"]: h["_score"] for h in plain["hits"]["hits"]}
+        lone = by_id[buckets[1][0]]
+        assert all(lone > by_id[d] + 1e-6 for d in buckets[0])
+        # DFS: same tf, same (now global) df -> identical scores
+        dfs = node.request("POST", "/skew/_search",
+                           {**body, "search_type": "dfs_query_then_fetch"})
+        scores = {h["_id"]: h["_score"] for h in dfs["hits"]["hits"]}
+        assert scores[buckets[1][0]] == pytest.approx(
+            scores[buckets[0][0]], rel=1e-5)
+        assert dfs["hits"]["total"]["value"] == \
+            plain["hits"]["total"]["value"]
+
+    def test_dfs_via_query_param(self, skewed):
+        node, buckets = skewed
+        res = node.request(
+            "POST", "/skew/_search",
+            {"query": {"match": {"body": "rare"}}, "size": 10},
+            search_type="dfs_query_then_fetch")
+        scores = {h["_id"]: h["_score"] for h in res["hits"]["hits"]}
+        assert scores[buckets[1][0]] == pytest.approx(
+            scores[buckets[0][0]], rel=1e-5)
+
+    def test_dfs_single_shard_matches_plain(self):
+        n = Node()
+        n.request("PUT", "/one", {"mappings": {"properties": {
+            "body": {"type": "text"}}}})
+        for i in range(6):
+            n.request("PUT", f"/one/_doc/{i}",
+                      {"body": f"alpha beta {'gamma' if i % 2 else ''}"})
+        n.request("POST", "/one/_refresh")
+        body = {"query": {"match": {"body": "gamma alpha"}}, "size": 10}
+        plain = n.request("POST", "/one/_search", body)
+        dfs = n.request("POST", "/one/_search",
+                        {**body, "search_type": "dfs_query_then_fetch"})
+        assert [(h["_id"], h["_score"]) for h in plain["hits"]["hits"]] == \
+            [(h["_id"], h["_score"]) for h in dfs["hits"]["hits"]]
+
+
+class TestSlicedScroll:
+    @pytest.fixture()
+    def node(self):
+        n = Node()
+        n.request("PUT", "/sl", {
+            "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+            "mappings": {"properties": {"v": {"type": "integer"}}}})
+        for i in range(40):
+            n.request("PUT", f"/sl/_doc/{i}", {"v": i})
+        n.request("POST", "/sl/_refresh")
+        return n
+
+    def test_slices_are_disjoint_and_exhaustive(self, node):
+        n_slices = 3
+        seen = []
+        for sid in range(n_slices):
+            got = set()
+            res = node.request("POST", "/sl/_search", {
+                "query": {"match_all": {}},
+                "slice": {"id": sid, "max": n_slices},
+                "size": 7, "sort": [{"v": "asc"}]}, scroll="1m")
+            while res["hits"]["hits"]:
+                got |= {h["_id"] for h in res["hits"]["hits"]}
+                res = node.request("POST", "/_search/scroll", {
+                    "scroll": "1m", "scroll_id": res["_scroll_id"]})
+            seen.append(got)
+        union = set().union(*seen)
+        assert union == {str(i) for i in range(40)}
+        for a in range(n_slices):
+            for b in range(a + 1, n_slices):
+                assert not (seen[a] & seen[b])
+
+    def test_slice_totals_sum(self, node):
+        totals = 0
+        for sid in range(4):
+            res = node.request("POST", "/sl/_search", {
+                "query": {"range": {"v": {"gte": 10}}},
+                "slice": {"id": sid, "max": 4}, "size": 0})
+            totals += res["hits"]["total"]["value"]
+        assert totals == 30
+
+    def test_slice_validation(self, node):
+        res = node.request("POST", "/sl/_search", {
+            "query": {"match_all": {}}, "slice": {"id": 5, "max": 3}})
+        assert "error" in res
+        res = node.request("POST", "/sl/_search", {
+            "query": {"match_all": {}}, "slice": {"id": 0, "max": 1}})
+        assert "error" in res
