@@ -79,8 +79,15 @@ class AllocationContext:
         return self.settings.get(key, default)
 
     def index_setting(self, index: str, key: str, default=None):
-        meta = self.indices.get(index) or {}
-        return (meta.get("settings") or {}).get(key, default)
+        """Index-level settings are stored with the `index.` prefix
+        STRIPPED by the REST normalizer (indices/service.py
+        _normalize_settings); accept both spellings."""
+        settings = (self.indices.get(index) or {}).get("settings") or {}
+        if key.startswith("index."):
+            stripped = key[len("index."):]
+            if stripped in settings:
+                return settings[stripped]
+        return settings.get(key, default)
 
     def add_copy(self, node: str, index: str, initializing: bool):
         """Account a placement made mid-pass so later decisions see it."""
@@ -152,9 +159,11 @@ def _filter_decider(ctx: AllocationContext, index: str, entry, node,
     if d is not None:
         return d
     meta_settings = (ctx.indices.get(index) or {}).get("settings") or {}
-    d = check(meta_settings, "index.routing.allocation", "index")
-    if d is not None:
-        return d
+    # the REST normalizer strips the `index.` prefix; accept both forms
+    for prefix in ("index.routing.allocation", "routing.allocation"):
+        d = check(meta_settings, prefix, "index")
+        if d is not None:
+            return d
     return DECISION_YES
 
 

@@ -307,6 +307,18 @@ class ClusterNode:
                     else:
                         merged[k] = v
                 data["settings"] = merged
+            elif kind == "update_index_settings":
+                iname = update["index"]
+                if iname in data["indices"]:
+                    meta = dict(data["indices"][iname])
+                    merged = {**(meta.get("settings") or {})}
+                    for k, v in update["settings"].items():
+                        if v is None:
+                            merged.pop(k, None)
+                        else:
+                            merged[k] = v
+                    meta["settings"] = merged
+                    data["indices"] = {**data["indices"], iname: meta}
             elif kind.startswith("persistent_task_"):
                 from opensearch_tpu.cluster.persistent import fold_update
                 fold_update(data, update)
@@ -1278,6 +1290,85 @@ class ClusterNode:
     def remove_remote(self, alias: str):
         self._remotes.pop(alias, None)
 
+    def allocation_explain(self, body: Optional[dict] = None) -> dict:
+        """_cluster/allocation/explain (ClusterAllocationExplainAction):
+        run the decider chain for one shard against every live node and
+        report each decider's verdict — the operator's why-is-this-shard-
+        where-it-is (or unassigned) tool."""
+        from opensearch_tpu.cluster.deciders import (AllocationContext,
+                                                     can_allocate)
+        body = body or {}
+        data = self._data()
+        routing = data.get("routing", {})
+        live = sorted(self.state.nodes) if self.state else []
+        index = body.get("index")
+        shard = body.get("shard")
+        want_primary = body.get("primary")
+        if index is None:
+            # no target given: explain the first unassigned copy, like the
+            # reference's findShardToExplain
+            for name, shards in routing.items():
+                for sid, e in enumerate(shards):
+                    if e.get("primary") is None:
+                        index, shard, want_primary = name, sid, True
+                        break
+                    settings = (data.get("indices", {}).get(name) or {}) \
+                        .get("settings", {})
+                    if len(e.get("replicas", [])) < int(
+                            settings.get("number_of_replicas", 0)):
+                        index, shard, want_primary = name, sid, False
+                        break
+                if index is not None:
+                    break
+            if index is None:
+                raise IllegalArgumentError(
+                    "unable to find any unassigned shards to explain")
+        if index not in routing or not (
+                0 <= int(shard or 0) < len(routing[index])):
+            raise IndexNotFoundError(f"no such shard [{index}][{shard}]")
+        shard = int(shard or 0)
+        entry = routing[index][shard]
+        want_primary = bool(want_primary if want_primary is not None
+                            else True)
+        ctx = AllocationContext(data, live)
+        decisions = []
+        for n in live:
+            d = can_allocate(ctx, index, entry, n, is_primary=want_primary)
+            row = {"node_id": n, "node_name": n,
+                   "node_decision": d.kind.lower(),
+                   "node_attributes": (data.get("node_attrs") or {})
+                   .get(n, {})}
+            if d.kind != "YES":
+                row["deciders"] = [{"decider": d.decider,
+                                    "decision": d.kind,
+                                    "explanation": d.reason}]
+            decisions.append(row)
+        assigned = entry.get("primary") if want_primary else None
+        if want_primary:
+            copy_started = entry.get("primary") is not None
+        else:
+            # a replica copy is only "started" when the DESIRED count is
+            # met — some replicas existing doesn't mean the one being
+            # explained is assigned
+            desired = int(((data.get("indices", {}).get(index) or {})
+                           .get("settings") or {})
+                          .get("number_of_replicas", 0))
+            copy_started = len(entry.get("replicas", [])) >= desired
+        out = {
+            "index": index, "shard": shard, "primary": want_primary,
+            "current_state": "started" if copy_started else "unassigned",
+            "can_allocate": (
+                "yes" if any(r["node_decision"] == "yes"
+                             for r in decisions)
+                else "throttled" if any(r["node_decision"] == "throttle"
+                                        for r in decisions)
+                else "no"),
+            "node_allocation_decisions": decisions,
+        }
+        if assigned:
+            out["current_node"] = {"id": assigned, "name": assigned}
+        return out
+
     # ------------------------------------------------------ persistent tasks
 
     def start_persistent_task(self, task_id: str, name: str,
@@ -1552,6 +1643,9 @@ class ClusterNode:
                 return self.cluster_health(), 200
             if len(parts) >= 2 and parts[1] == "state":
                 return self.cluster_state_api(), 200
+            if len(parts) >= 3 and parts[1] == "allocation" \
+                    and parts[2] == "explain":
+                return self.allocation_explain(body), 200
             if len(parts) >= 2 and parts[1] == "settings" \
                     and method == "PUT" and isinstance(body, dict):
                 # intercept cluster.remote.*.seeds and allocation settings
@@ -1570,6 +1664,29 @@ class ClusterNode:
             return None
         if parts[0] == "_cat" and len(parts) > 1 and parts[1] == "shards":
             return self._cat_shards(), 200
+        if parts[0] == "_cat" and len(parts) > 1 \
+                and parts[1] == "allocation":
+            data = self._data()
+            counts: Dict[str, int] = {n: 0 for n in
+                                      (self.state.nodes if self.state
+                                       else [])}
+            for shards in (data.get("routing") or {}).values():
+                for e in shards:
+                    for n in ([e.get("primary")] + e.get("replicas", [])):
+                        if n in counts:
+                            counts[n] += 1
+            return {"_body": [{"shards": c, "node": n}
+                              for n, c in sorted(counts.items())]}, 200
+        if parts[0] == "_cat" and len(parts) > 1 \
+                and parts[1] == "nodeattrs":
+            attrs = self._data().get("node_attrs") or {}
+            return {"_body": [{"node": n, "attr": a, "value": v}
+                              for n in sorted(attrs)
+                              for a, v in sorted(attrs[n].items())]}, 200
+        if parts[0] == "_cat" and len(parts) > 1 \
+                and parts[1] in ("cluster_manager", "master"):
+            leader = self._leader_id()
+            return {"_body": [{"id": leader, "node": leader}]}, 200
         if parts[0] == "_bulk" and method == "POST":
             return self._rest_bulk(None, raw), 200
         if parts[0].startswith("_"):
@@ -1616,6 +1733,8 @@ class ClusterNode:
             return self.search(name, body), 200
         if sub == "_refresh" and method in ("POST", "GET"):
             return self.refresh_index(name), 200
+        if sub == "_settings" and method == "PUT":
+            return self.update_index_settings(name, body or {}), 200
         return None
 
     def _rest_bulk(self, default_index: Optional[str],
@@ -1655,8 +1774,12 @@ class ClusterNode:
             _normalize_settings, validate_index_name)
         validate_index_name(name)
         settings = _normalize_settings(body.get("settings"))
+        # the WHOLE normalized settings map goes into cluster state: the
+        # allocator's deciders read index-level routing.allocation.* keys
+        # from here (dropping them silently disabled index-level filters)
         meta = {"uuid": _uuid.uuid4().hex[:22],
-                "settings": {"number_of_shards":
+                "settings": {**settings,
+                             "number_of_shards":
                              int(settings.get("number_of_shards", 1)),
                              "number_of_replicas":
                              int(settings.get("number_of_replicas", 0))},
@@ -1671,6 +1794,28 @@ class ClusterNode:
         self._index_meta(name)
         self._submit_to_leader({"kind": "delete_index", "name": name})
         self._await(lambda: name not in self._data().get("indices", {}))
+        return {"acknowledged": True}
+
+    def update_index_settings(self, name: str, body: dict) -> dict:
+        """PUT /{index}/_settings in cluster mode: dynamic settings fold
+        into the index metadata IN CLUSTER STATE (the allocator reads
+        replicas counts and routing.allocation.* filters from there, and
+        every fold ends with a reroute — MetadataUpdateSettingsService)."""
+        from opensearch_tpu.indices.service import (_normalize_settings,
+                                                    validate_dynamic_updates)
+        self._index_meta(name)                  # 404 if absent
+        updates = _normalize_settings(body or {})
+        validate_dynamic_updates(updates)
+        self._submit_to_leader({"kind": "update_index_settings",
+                                "index": name, "settings": updates})
+
+        def applied():
+            meta = self._data().get("indices", {}).get(name) or {}
+            settings = meta.get("settings") or {}
+            return all(settings.get(k) == v if v is not None
+                       else k not in settings
+                       for k, v in updates.items())
+        self._await(applied)
         return {"acknowledged": True}
 
     def _await(self, cond, timeout: float = 30.0):

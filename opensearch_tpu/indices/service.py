@@ -60,6 +60,34 @@ def _normalize_settings(settings: Optional[dict]) -> dict:
             for k, v in out.items()}
 
 
+# settings fixed at index creation (IndexMetadata.APIBlock / static scope)
+STATIC_INDEX_SETTINGS = frozenset({
+    "number_of_shards", "routing_partition_size",
+    "number_of_routing_shards"})
+
+
+def validate_dynamic_updates(updates: dict) -> None:
+    """Shared validation for PUT /{index}/_settings (single-node REST and
+    the cluster-state path): static settings are rejected, and value types
+    are checked HERE so a bad value is a 400, not a late allocator crash."""
+    bad = STATIC_INDEX_SETTINGS & set(updates)
+    if bad:
+        raise IllegalArgumentError(
+            f"Can't update non dynamic settings [{sorted(bad)}] for "
+            f"open indices")
+    replicas = updates.get("number_of_replicas")
+    if replicas is not None:
+        try:
+            value = int(replicas)
+        except (TypeError, ValueError):
+            raise IllegalArgumentError(
+                f"Failed to parse value [{replicas}] for setting "
+                f"[number_of_replicas]")
+        if value < 0:
+            raise IllegalArgumentError(
+                "Failed to parse value [number_of_replicas] must be >= 0")
+
+
 class AliasMetadata:
     __slots__ = ("name", "filter", "routing", "index_routing",
                  "search_routing", "is_write_index")

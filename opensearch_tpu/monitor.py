@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import time
+from typing import Optional
 
 _START = time.time()
 
@@ -63,6 +64,19 @@ def os_probe() -> dict:
                    "used_in_bytes": (swap_total - swap_free)
                    if swap_total >= 0 and swap_free >= 0 else -1}
     return out
+
+
+def fs_probe(path: Optional[str] = None) -> dict:
+    """FsProbe.stats(): disk totals for the data path (or cwd)."""
+    import shutil
+    try:
+        usage = shutil.disk_usage(path or ".")
+        return {"total_in_bytes": usage.total, "free_in_bytes": usage.free,
+                "available_in_bytes": usage.free,
+                "used_in_bytes": usage.used}
+    except OSError:
+        return {"total_in_bytes": -1, "free_in_bytes": -1,
+                "available_in_bytes": -1, "used_in_bytes": -1}
 
 
 def process_probe() -> dict:

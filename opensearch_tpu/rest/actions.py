@@ -797,15 +797,10 @@ def register_indices_actions(node, c):
         return out
 
     def do_put_settings(req):
-        from opensearch_tpu.indices.service import _normalize_settings
+        from opensearch_tpu.indices.service import (_normalize_settings,
+                                                    validate_dynamic_updates)
         updates = _normalize_settings(req.body or {})
-        static = {"number_of_shards", "routing_partition_size",
-                  "number_of_routing_shards"}
-        bad = static & set(updates)
-        if bad:
-            raise IllegalArgumentError(
-                f"Can't update non dynamic settings [{sorted(bad)}] for "
-                f"open indices")
+        validate_dynamic_updates(updates)
         for n in node.indices.resolve(req.param("index"),
                                       allow_no_indices=False):
             svc = node.indices.get(n)
@@ -1331,10 +1326,75 @@ def register_cat_actions(node, c):
         return _cat_table(req, ["ip", "node.role", "cluster_manager", "name"],
                           [["127.0.0.1", "dim", "*", node.node_name]])
 
+    def cat_segments(req):
+        rows = []
+        names = (node.indices.resolve(req.param("index"))
+                 if req.param("index") else list(node.indices.indices))
+        for n in names:
+            svc = node.indices.get(n)
+            for shard in svc.shards:
+                for seg in shard.executor.reader.segments:
+                    rows.append([n, shard.shard_id, seg.seg_id,
+                                 seg.live_doc_count,
+                                 seg.num_docs - seg.live_doc_count,
+                                 seg.memory_bytes(), "true",
+                                 node.node_name])
+        return _cat_table(req, ["index", "shard", "segment", "docs.count",
+                                "docs.deleted", "size", "searchable",
+                                "node"], rows)
+
+    def cat_allocation(req):
+        shards = sum(svc.num_shards
+                     for svc in node.indices.indices.values())
+        from opensearch_tpu.monitor import fs_probe
+        disk = fs_probe(getattr(node.indices, "data_path", None))
+        rows = [[shards, disk["used_in_bytes"], disk["available_in_bytes"],
+                 disk["total_in_bytes"], "127.0.0.1", node.node_name]]
+        return _cat_table(req, ["shards", "disk.used", "disk.avail",
+                                "disk.total", "ip", "node"], rows)
+
+    def cat_nodeattrs(req):
+        rows = [[node.node_name, "127.0.0.1",
+                 k[len("node.attr."):], str(v)]
+                for k, v in sorted(node.settings.items())
+                if k.startswith("node.attr.")]
+        return _cat_table(req, ["node", "host", "attr", "value"], rows)
+
+    def cat_repositories(req):
+        rows = [[name, getattr(repo, "repo_type", "fs")]
+                for name, repo in sorted(
+                    node.repositories.repositories.items())]
+        return _cat_table(req, ["id", "type"], rows)
+
+    def cat_cluster_manager(req):
+        return _cat_table(req, ["id", "host", "ip", "node"],
+                          [[node.node_id, "127.0.0.1", "127.0.0.1",
+                            node.node_name]])
+
+    def cat_pending_tasks(req):
+        return _cat_table(req, ["insertOrder", "timeInQueue", "priority",
+                                "source"], [])
+
+    def cat_recovery(req):
+        rows = []
+        names = (node.indices.resolve(req.param("index"))
+                 if req.param("index") else list(node.indices.indices))
+        for n in names:
+            svc = node.indices.get(n)
+            for shard in svc.shards:
+                rows.append([n, shard.shard_id, "0ms", "existing_store",
+                             "done", node.node_name, node.node_name])
+        return _cat_table(req, ["index", "shard", "time", "type", "stage",
+                                "source_node", "target_node"], rows)
+
     def cat_root(req):
         paths = ["/_cat/indices", "/_cat/health", "/_cat/count",
                  "/_cat/shards", "/_cat/aliases", "/_cat/templates",
-                 "/_cat/nodes", "/_cat/plugins", "/_cat/thread_pool"]
+                 "/_cat/nodes", "/_cat/plugins", "/_cat/thread_pool",
+                 "/_cat/segments", "/_cat/allocation", "/_cat/nodeattrs",
+                 "/_cat/repositories", "/_cat/cluster_manager",
+                 "/_cat/pending_tasks", "/_cat/recovery",
+                 "/_cat/snapshots", "/_cat/tasks"]
         return RestResponse(200, "=^.^=\n" + "\n".join(paths) + "\n",
                             content_type="text/plain")
 
@@ -1357,6 +1417,16 @@ def register_cat_actions(node, c):
     c.register("GET", "/_cat/aliases", cat_aliases)
     c.register("GET", "/_cat/templates", cat_templates)
     c.register("GET", "/_cat/nodes", cat_nodes)
+    c.register("GET", "/_cat/segments", cat_segments)
+    c.register("GET", "/_cat/segments/{index}", cat_segments)
+    c.register("GET", "/_cat/allocation", cat_allocation)
+    c.register("GET", "/_cat/nodeattrs", cat_nodeattrs)
+    c.register("GET", "/_cat/repositories", cat_repositories)
+    c.register("GET", "/_cat/cluster_manager", cat_cluster_manager)
+    c.register("GET", "/_cat/master", cat_cluster_manager)
+    c.register("GET", "/_cat/pending_tasks", cat_pending_tasks)
+    c.register("GET", "/_cat/recovery", cat_recovery)
+    c.register("GET", "/_cat/recovery/{index}", cat_recovery)
 
 
 # ------------------------------------------------------- scripts & ingest

@@ -654,6 +654,8 @@ class Compiler:
             buckets = np.asarray(
                 [hash_routing(d) % node.max if d is not None else -1
                  for d in seg.doc_ids], dtype=np.int32)
+            if len(self.stats.memo) > 8192:   # shared memo bound
+                self.stats.memo.clear()
             self.stats.memo[key] = buckets
         mask = buckets == int(node.id)
         return self._precomputed_plan(

@@ -166,3 +166,49 @@ class TestRangeFields:
         out = rnode.request("POST", "/spans/_search", {
             "query": {"exists": {"field": "dr"}}, "size": 10})
         assert [h["_id"] for h in out["hits"]["hits"]] == ["1"]
+
+
+class TestCatBreadth:
+    """_cat surfaces added for node-admin parity (reference:
+    rest/action/cat/RestSegmentsAction, RestAllocationAction,
+    RestNodeAttrsAction, RestRepositoriesAction, RestMasterAction,
+    RestPendingClusterTasksAction, RestCatRecoveryAction)."""
+
+    @pytest.fixture()
+    def node(self):
+        from opensearch_tpu.node import Node
+        n = Node(settings={"node.attr.zone": "zx"})
+        n.request("PUT", "/cats", {"mappings": {"properties": {
+            "t": {"type": "text"}}}})
+        n.request("PUT", "/cats/_doc/1", {"t": "hello"})
+        n.request("POST", "/cats/_refresh")
+        return n
+
+    def test_cat_segments(self, node):
+        out = node.request("GET", "/_cat/segments")["_raw"]
+        assert "cats" in out and "0" in out
+
+    def test_cat_allocation(self, node):
+        out = node.request("GET", "/_cat/allocation")["_raw"]
+        assert node.node_name in out
+
+    def test_cat_nodeattrs(self, node):
+        out = node.request("GET", "/_cat/nodeattrs")["_raw"]
+        assert "zone" in out and "zx" in out
+
+    def test_cat_cluster_manager(self, node):
+        out = node.request("GET", "/_cat/cluster_manager")["_raw"]
+        assert node.node_name in out
+        assert node.request("GET", "/_cat/master")["_raw"] == out
+
+    def test_cat_recovery_and_pending(self, node):
+        assert "cats" in node.request("GET", "/_cat/recovery")["_raw"]
+        assert node.request("GET", "/_cat/pending_tasks")["_status"] == 200
+
+    def test_cat_repositories(self, node, tmp_path):
+        import os
+        node.repositories.path_repo = [os.path.realpath(str(tmp_path))]
+        node.request("PUT", "/_snapshot/backup", {
+            "type": "fs", "settings": {"location": str(tmp_path / "r")}})
+        out = node.request("GET", "/_cat/repositories")["_raw"]
+        assert "backup" in out
