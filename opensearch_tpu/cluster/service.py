@@ -23,9 +23,12 @@ wire once at recovery (Opaque frames), never per query.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
 
 from opensearch_tpu.cluster.allocation import allocate, health_of, shard_copies
 from opensearch_tpu.cluster.coordination.coordinator import (
@@ -50,6 +53,21 @@ SHARD_DFS = "indices:data/read/search[phase/dfs]"
 SHARD_GET = "indices:data/read/get[s]"
 SHARD_REFRESH = "indices:admin/refresh[s]"
 START_RECOVERY = "internal:index/shard/recovery/start_recovery"
+RECOVERY_CHUNK = "internal:index/shard/recovery/file_chunk"
+RECOVERY_DONE = "internal:index/shard/recovery/finalize"
+RECOVERY_CHUNK_BYTES = 512 * 1024    # reference CHUNK_SIZE (512KB)
+# process-wide ops-vs-file recovery counters (recovery stats surface)
+RECOVERY_STATS: Dict[str, int] = {"ops": 0, "file": 0}
+
+
+def _parse_byte_size(value) -> float:
+    """'40mb' / '512kb' / '1gb' / bare bytes → bytes (ByteSizeValue)."""
+    s = str(value).strip().lower()
+    for suffix, mult in (("gb", 1 << 30), ("mb", 1 << 20), ("kb", 1 << 10),
+                         ("b", 1)):
+        if s.endswith(suffix):
+            return float(s[:-len(suffix)]) * mult
+    return float(s)
 LEADER_UPDATE = "internal:cluster/leader_update"
 REGISTER_ADDR = "internal:cluster/register_address"
 # cross-cluster search (reference: RemoteClusterService.java:80 +
@@ -87,6 +105,10 @@ class ClusterNode:
         self.attrs = {k[len("node.attr."):]: str(v)
                       for k, v in self.settings.items()
                       if k.startswith("node.attr.")}
+        # node-level data path (path.data): cluster shards get durable
+        # stores + translogs under it, which is what makes ops-based
+        # (sequence-number) peer recovery possible over the transport
+        self.data_path = self.settings.get("path.data")
         self.local = Node(node_name=node_id, settings=settings)
         # one named-pool registry per node, shared by the transport's
         # handler dispatch and the REST layer (ThreadPool.java:92)
@@ -117,6 +139,9 @@ class ClusterNode:
         # persistent tasks (PersistentTasksNodeService analog)
         from opensearch_tpu.cluster.persistent import PersistentTaskRunner
         self.persistent_tasks = PersistentTaskRunner(self)
+        # in-flight chunked-recovery sessions (source side): session id →
+        # serialized segment blobs awaiting chunk pulls
+        self._recovery_sessions: Dict[str, dict] = {}
 
     # ------------------------------------------------------------ lifecycle
 
@@ -470,6 +495,24 @@ class ClusterNode:
                     self._tracked[key] = keep
                 else:
                     self._tracked.pop(key, None)
+        # prune retention leases for departed copies: a dead node's lease
+        # would pin the primary translog forever (the single-node path
+        # removes its lease at recovery end; here the authoritative signal
+        # is the node leaving the cluster or the copy leaving the routing)
+        for (name, sid), shard in self.shards.items():
+            if not shard.primary:
+                continue
+            entry = routing[name][sid] if name in routing \
+                and sid < len(routing[name]) else None
+            current = set(entry.get("replicas", [])) if entry else set()
+            tracker = shard.engine.replication_tracker
+            for lease_id in list(tracker.retention_leases):
+                if not lease_id.startswith("peer_recovery/"):
+                    continue
+                target = lease_id[len("peer_recovery/"):]
+                if target not in live_nodes or \
+                        (entry is not None and target not in current):
+                    tracker.remove_lease(lease_id)
         # create/adjust shards we own
         for name, shard_entries in routing.items():
             meta = indices.get(name)
@@ -511,7 +554,13 @@ class ClusterNode:
     def _create_shard(self, name: str, sid: int, meta: dict,
                       is_primary: bool, entry: dict) -> Optional[IndexShard]:
         mapper = self._mapper_for(name, meta)
+        # per-incarnation shard path keyed by index UUID so a deleted +
+        # recreated index can never resurrect a stale store/translog
+        shard_data_path = (os.path.join(self.data_path,
+                                        meta.get("uuid") or name)
+                           if self.data_path else None)
         shard = IndexShard(sid, mapper, index_name=name,
+                           data_path=shard_data_path,
                            primary=is_primary,
                            primary_term=entry.get("primary_term", 1),
                            allocation_id=f"{name}_{sid}_{self.node_id}")
@@ -556,27 +605,84 @@ class ClusterNode:
 
     def _recover_from(self, shard: IndexShard, name: str, sid: int,
                       primary_node: str):
-        """Peer recovery target side (PeerRecoveryTargetService): ask the
-        primary for its segment set, install it, then report started so
-        the leader marks this copy in-sync. Retries while the primary
-        reports ShardNotReady — the replica's reconcile can apply the
-        routing state before the primary's has created its shard."""
+        """Peer recovery target side (PeerRecoveryTargetService): hand the
+        primary our checkpoint; if a retention lease kept the ops we're
+        missing, replay JUST those (sequence-number-based recovery), else
+        pull the segment set in throttled chunks. Retries while the
+        primary reports ShardNotReady — the replica's reconcile can apply
+        the routing state before the primary's has created its shard."""
         resp = self._retry_shard_op(lambda: self.transport.send_sync(
             primary_node, START_RECOVERY,
-            {"index": name, "shard": sid, "target": self.node_id},
+            {"index": name, "shard": sid, "target": self.node_id,
+             "local_checkpoint": shard.engine.local_checkpoint,
+             "max_seq_no": shard.engine.max_seq_no},
             timeout=60.0))
-        segments = _unwrap(resp["segments"])
-        shard.engine.install_segments(
-            segments, max_seq_no=resp["max_seq_no"],
-            local_checkpoint=resp["local_checkpoint"])
-        shard._sync_reader()
+        if resp["mode"] == "ops":
+            term = resp["primary_term"]
+            for op in _unwrap(resp["ops"]):
+                if op.op_type == "index":
+                    shard.index_on_replica(op.doc_id, op.source, op.seq_no,
+                                           term, op.version)
+                elif op.op_type == "delete":
+                    shard.delete_on_replica(op.doc_id, op.seq_no, term,
+                                            op.version)
+                # noop entries only advance the checkpoint tracker
+            # finalize refresh (RecoveryTarget#finalizeRecovery): the copy
+            # becomes an active search target, so replayed ops must be
+            # visible before the leader marks it in-sync
+            shard.refresh()
+            RECOVERY_STATS["ops"] += 1
+        else:
+            # file phase: pull each segment in rate-limited chunks
+            # (RecoverySourceHandler.phase1 + RateLimiter on
+            # indices.recovery.max_bytes_per_sec), reassemble, install
+            session = resp["session"]
+            blobs = []
+            for seg_id, nbytes in resp["manifest"]:
+                buf = bytearray()
+                while len(buf) < nbytes:
+                    chunk = self.transport.send_sync(
+                        primary_node, RECOVERY_CHUNK,
+                        {"index": name, "shard": sid, "session": session,
+                         "seg_id": seg_id, "offset": len(buf)},
+                        timeout=60.0)
+                    data = np.asarray(_unwrap(chunk["data"]),
+                                      dtype=np.uint8)
+                    if not len(data):
+                        raise OpenSearchTpuError(
+                            f"recovery chunk underrun for [{seg_id}]")
+                    buf.extend(data.tobytes())
+                blobs.append(bytes(buf))
+            from opensearch_tpu.transport import serde
+            segments = [serde.safe_pickle_loads(b) for b in blobs]
+            shard.engine.install_segments(
+                segments, max_seq_no=resp["max_seq_no"],
+                local_checkpoint=resp["local_checkpoint"])
+            shard._sync_reader()
+            RECOVERY_STATS["file"] += 1
+        self.transport.send_sync(
+            primary_node, RECOVERY_DONE,
+            {"index": name, "shard": sid, "target": self.node_id,
+             "local_checkpoint": shard.engine.local_checkpoint},
+            timeout=30.0)
         self._submit_to_leader({"kind": "shard_started", "index": name,
                                 "shard": sid, "node": self.node_id})
+
+    def _recovery_rate_limit(self) -> float:
+        """indices.recovery.max_bytes_per_sec (default 40mb) as bytes/s."""
+        for scope in ("transient", "persistent"):
+            v = self.local.cluster_settings.get(scope, {}).get(
+                "indices.recovery.max_bytes_per_sec")
+            if v is not None:
+                return _parse_byte_size(v)
+        return _parse_byte_size("40mb")
 
     def _on_start_recovery(self, sender: str, payload: dict):
         """Source side (RecoverySourceHandler.recoverToTarget): register
         the target for op tracking FIRST (ops that arrive while the copy
-        is in flight still reach it), then ship the segment set."""
+        is in flight still reach it), pin a retention lease at the
+        target's checkpoint, then answer with ops (lease held the history)
+        or a chunked-segment manifest."""
         key = (payload["index"], payload["shard"])
         shard = self.shards.get(key)
         if shard is None or not shard.primary:
@@ -584,12 +690,83 @@ class ClusterNode:
             # own reconcile created the primary shard
             raise ShardNotReadyError(
                 f"not primary for [{key}] on [{self.node_id}]")
+        target = payload["target"]
         with self._tracked_lock:
-            self._tracked.setdefault(key, set()).add(payload["target"])
-        shard.engine.refresh()
-        return {"segments": Opaque(shard.engine.segments),
-                "max_seq_no": shard.engine.max_seq_no,
-                "local_checkpoint": shard.engine.local_checkpoint}
+            self._tracked.setdefault(key, set()).add(target)
+        engine = shard.engine
+        target_ckpt = int(payload.get("local_checkpoint", -1))
+        tracker = engine.replication_tracker
+        tracker.add_lease(f"peer_recovery/{target}", target_ckpt + 1,
+                          "peer recovery")
+        # ops-based fast path: every op in (target_ckpt, max_seq_no] must
+        # still be in the translog (the lease prevents future trims; a
+        # PAST trim may already have dropped them)
+        ops = (engine.translog.read_ops(from_seq_no=target_ckpt + 1)
+               if engine.translog is not None and target_ckpt >= 0 else None)
+        if ops is not None:
+            expected = set(range(target_ckpt + 1, engine.max_seq_no + 1))
+            if expected <= {o.seq_no for o in ops}:
+                return {"mode": "ops", "ops": Opaque(ops),
+                        "primary_term": engine.primary_term}
+        engine.refresh()
+        from opensearch_tpu.transport import serde
+        # expire sessions abandoned by crashed targets (their blobs hold a
+        # full serialized copy of the shard)
+        now = time.monotonic()
+        for stale in [sid for sid, sess in self._recovery_sessions.items()
+                      if now - sess["ts"] > 900.0]:
+            del self._recovery_sessions[stale]
+        session = f"{target}/{time.monotonic_ns()}"
+        # raw restricted-codec bytes: chunks travel as uint8 arrays (one
+        # base64 layer at the frame, zlib-compressed) instead of
+        # double-encoding pickle-in-json-in-pickle
+        blobs = {s.seg_id: serde.safe_pickle_dumps(s)
+                 for s in engine.segments}
+        self._recovery_sessions[session] = {
+            "blobs": blobs, "ts": now}
+        return {"mode": "segments", "session": session,
+                "manifest": [(s.seg_id, len(blobs[s.seg_id]))
+                             for s in engine.segments],
+                "max_seq_no": engine.max_seq_no,
+                "local_checkpoint": engine.local_checkpoint}
+
+    def _on_recovery_chunk(self, sender: str, payload: dict):
+        """One rate-limited chunk of a segment blob (RecoverySourceHandler
+        sends file chunks through a RateLimiter)."""
+        session = self._recovery_sessions.get(payload["session"])
+        if session is None:
+            raise OpenSearchTpuError(
+                f"unknown recovery session [{payload['session']}]")
+        blob = session["blobs"].get(payload["seg_id"])
+        if blob is None:
+            raise OpenSearchTpuError(
+                f"unknown segment [{payload['seg_id']}] in session")
+        offset = int(payload["offset"])
+        chunk = blob[offset:offset + RECOVERY_CHUNK_BYTES]
+        # source-side throttle: sleep long enough that this chunk fits the
+        # configured bandwidth budget
+        rate = self._recovery_rate_limit()
+        if rate > 0 and chunk:
+            time.sleep(len(chunk) / rate)
+        return {"data": np.frombuffer(chunk, dtype=np.uint8)}
+
+    def _on_recovery_done(self, sender: str, payload: dict):
+        """Finalize (RecoverySourceHandler.finalizeRecovery): renew the
+        target's lease at its post-recovery checkpoint — future
+        re-recoveries of this copy can then be ops-based — and drop the
+        session blobs."""
+        key = (payload["index"], payload["shard"])
+        shard = self.shards.get(key)
+        target = payload["target"]
+        if shard is not None and shard.primary:
+            shard.engine.replication_tracker.renew_lease(
+                f"peer_recovery/{target}",
+                int(payload.get("local_checkpoint", -1)) + 1)
+        prefix = f"{target}/"
+        for sid_key in [s for s in self._recovery_sessions
+                        if s.startswith(prefix)]:
+            del self._recovery_sessions[sid_key]
+        return {"ok": True}
 
     # ------------------------------------------------------- write path
 
@@ -621,6 +798,10 @@ class ClusterNode:
         reg(self.node_id, SHARD_REFRESH, self._on_shard_refresh,
             blocking=True)
         reg(self.node_id, START_RECOVERY, self._on_start_recovery,
+            blocking=True, pool="management")
+        reg(self.node_id, RECOVERY_CHUNK, self._on_recovery_chunk,
+            blocking=True, pool="management")
+        reg(self.node_id, RECOVERY_DONE, self._on_recovery_done,
             blocking=True, pool="management")
         reg(self.node_id, REGISTER_ADDR, self._on_register_address,
             blocking=True, pool="management")
@@ -1323,10 +1504,13 @@ class ClusterNode:
             if index is None:
                 raise IllegalArgumentError(
                     "unable to find any unassigned shards to explain")
-        if index not in routing or not (
-                0 <= int(shard or 0) < len(routing[index])):
+        try:
+            shard = int(shard or 0)
+        except (TypeError, ValueError):
+            raise IllegalArgumentError(
+                f"[shard] must be an integer, got [{shard}]")
+        if index not in routing or not 0 <= shard < len(routing[index]):
             raise IndexNotFoundError(f"no such shard [{index}][{shard}]")
-        shard = int(shard or 0)
         entry = routing[index][shard]
         want_primary = bool(want_primary if want_primary is not None
                             else True)

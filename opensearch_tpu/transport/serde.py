@@ -74,6 +74,7 @@ class Opaque:
 # data — numpy arrays, strings, ints — with no side-effecting __reduce__.
 _OPAQUE_ALLOWED = {
     ("opensearch_tpu.index.segment", "Segment"),
+    ("opensearch_tpu.index.translog", "TranslogOp"),
     ("opensearch_tpu.index.segment", "TermMeta"),
     ("opensearch_tpu.index.segment", "FieldStats"),
     ("opensearch_tpu.index.segment", "DocValuesColumn"),
@@ -120,6 +121,17 @@ class _RestrictedUnpickler(pickle.Unpickler):
 
 def _safe_loads(raw: bytes) -> Any:
     return _RestrictedUnpickler(io.BytesIO(raw)).load()
+
+
+def safe_pickle_dumps(value: Any) -> bytes:
+    """Raw restricted-codec bytes for out-of-band transfer (recovery file
+    chunks): paired with safe_pickle_loads on the receiving side so the
+    same allowlist gates reassembled blobs as gates inline Opaque frames."""
+    return pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def safe_pickle_loads(raw: bytes) -> Any:
+    return _safe_loads(raw)
 
 
 # marker keys the codec itself emits — a *plain* dict from user data that
