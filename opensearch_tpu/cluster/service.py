@@ -58,16 +58,6 @@ RECOVERY_DONE = "internal:index/shard/recovery/finalize"
 RECOVERY_CHUNK_BYTES = 512 * 1024    # reference CHUNK_SIZE (512KB)
 # process-wide ops-vs-file recovery counters (recovery stats surface)
 RECOVERY_STATS: Dict[str, int] = {"ops": 0, "file": 0}
-
-
-def _parse_byte_size(value) -> float:
-    """'40mb' / '512kb' / '1gb' / bare bytes → bytes (ByteSizeValue)."""
-    s = str(value).strip().lower()
-    for suffix, mult in (("gb", 1 << 30), ("mb", 1 << 20), ("kb", 1 << 10),
-                         ("b", 1)):
-        if s.endswith(suffix):
-            return float(s[:-len(suffix)]) * mult
-    return float(s)
 LEADER_UPDATE = "internal:cluster/leader_update"
 REGISTER_ADDR = "internal:cluster/register_address"
 # cross-cluster search (reference: RemoteClusterService.java:80 +
@@ -626,7 +616,12 @@ class ClusterNode:
                 elif op.op_type == "delete":
                     shard.delete_on_replica(op.doc_id, op.seq_no, term,
                                             op.version)
-                # noop entries only advance the checkpoint tracker
+                elif op.op_type == "noop":
+                    # fill the seq-no gap or the local checkpoint stalls
+                    # below max_seq_no forever (Engine.NoOp replay)
+                    shard.engine.noop(op.seq_no, term,
+                                      getattr(op, "reason", "") or
+                                      "peer recovery replay")
             # finalize refresh (RecoveryTarget#finalizeRecovery): the copy
             # becomes an active search target, so replayed ops must be
             # visible before the leader marks it in-sync
@@ -670,12 +665,13 @@ class ClusterNode:
 
     def _recovery_rate_limit(self) -> float:
         """indices.recovery.max_bytes_per_sec (default 40mb) as bytes/s."""
+        from opensearch_tpu.common.settings import parse_byte_size
+        key = "indices.recovery.max_bytes_per_sec"
         for scope in ("transient", "persistent"):
-            v = self.local.cluster_settings.get(scope, {}).get(
-                "indices.recovery.max_bytes_per_sec")
+            v = self.local.cluster_settings.get(scope, {}).get(key)
             if v is not None:
-                return _parse_byte_size(v)
-        return _parse_byte_size("40mb")
+                return parse_byte_size(v, key)
+        return parse_byte_size("40mb", key)
 
     def _on_start_recovery(self, sender: str, payload: dict):
         """Source side (RecoverySourceHandler.recoverToTarget): register
@@ -759,9 +755,16 @@ class ClusterNode:
         shard = self.shards.get(key)
         target = payload["target"]
         if shard is not None and shard.primary:
-            shard.engine.replication_tracker.renew_lease(
-                f"peer_recovery/{target}",
-                int(payload.get("local_checkpoint", -1)) + 1)
+            # add-or-renew: a concurrent reroute may have pruned the
+            # recovery lease mid-flight; finalize must not fail a recovery
+            # that already installed its copy
+            tracker = shard.engine.replication_tracker
+            lease_id = f"peer_recovery/{target}"
+            ckpt = int(payload.get("local_checkpoint", -1)) + 1
+            if lease_id in tracker.retention_leases:
+                tracker.renew_lease(lease_id, ckpt)
+            else:
+                tracker.add_lease(lease_id, ckpt, "peer recovery")
         prefix = f"{target}/"
         for sid_key in [s for s in self._recovery_sessions
                         if s.startswith(prefix)]:

@@ -202,17 +202,22 @@ def main(argv=None) -> int:
     config_dir = os.path.dirname(os.path.abspath(args.config)) \
         if args.config else None
 
+    from opensearch_tpu.common.logging import configure_logging, get_logger
+    configure_logging(settings)
+    log = get_logger("bootstrap")
+
     production = is_production(settings)
     failures = []
     for name, ok, detail in bootstrap_checks(settings):
-        status = "ok" if ok else "FAILED"
-        print(f"bootstrap check [{name}]: {status} ({detail})",
-              file=sys.stderr)
-        if not ok:
+        if ok:
+            log.info(f"bootstrap check [{name}]: ok ({detail})")
+        else:
+            # failures must survive a raised logger.level — the operator
+            # needs to see WHICH check failed when startup aborts
+            log.error(f"bootstrap check [{name}]: FAILED ({detail})")
             failures.append(name)
     if failures and production:
-        print("bootstrap checks failed in production mode; aborting",
-              file=sys.stderr)
+        log.error("bootstrap checks failed in production mode; aborting")
         return 78
 
     node, server = start_node(settings, config_dir)

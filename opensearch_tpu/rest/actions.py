@@ -1371,6 +1371,14 @@ def register_cat_actions(node, c):
                           [[node.node_id, "127.0.0.1", "127.0.0.1",
                             node.node_name]])
 
+    def cat_master_deprecated(req):
+        from opensearch_tpu.common.logging import DEPRECATION
+        DEPRECATION.deprecate(
+            "cat_master",
+            "[GET /_cat/master] is deprecated! Use [GET "
+            "/_cat/cluster_manager] instead.")
+        return cat_cluster_manager(req)
+
     def cat_pending_tasks(req):
         return _cat_table(req, ["insertOrder", "timeInQueue", "priority",
                                 "source"], [])
@@ -1423,7 +1431,7 @@ def register_cat_actions(node, c):
     c.register("GET", "/_cat/nodeattrs", cat_nodeattrs)
     c.register("GET", "/_cat/repositories", cat_repositories)
     c.register("GET", "/_cat/cluster_manager", cat_cluster_manager)
-    c.register("GET", "/_cat/master", cat_cluster_manager)
+    c.register("GET", "/_cat/master", cat_master_deprecated)
     c.register("GET", "/_cat/pending_tasks", cat_pending_tasks)
     c.register("GET", "/_cat/recovery", cat_recovery)
     c.register("GET", "/_cat/recovery/{index}", cat_recovery)

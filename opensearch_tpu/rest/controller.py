@@ -46,6 +46,7 @@ class RestResponse:
     status: int = 200
     body: Any = None
     content_type: str = "application/json"
+    headers: Dict[str, str] = field(default_factory=dict)
 
     def json(self) -> str:
         if isinstance(self.body, str):
@@ -114,6 +115,19 @@ class RestController:
         return found, params
 
     def dispatch(self, request: RestRequest) -> RestResponse:
+        from opensearch_tpu.common.logging import DEPRECATION
+        DEPRECATION.start_request()
+        response = self._dispatch_inner(request)
+        warnings = DEPRECATION.drain_request()
+        if warnings:
+            # rest/DeprecationRestHandler: deprecations surface to the
+            # CALLER as Warning: 299 headers, not just server logs
+            # RFC 7234 §5.5: warning-values are a COMMA-separated list
+            response.headers["Warning"] = ", ".join(
+                f'299 opensearch_tpu "{w}"' for w in warnings)
+        return response
+
+    def _dispatch_inner(self, request: RestRequest) -> RestResponse:
         try:
             node, params = self._resolve(request.path)
             if node is None:

@@ -42,6 +42,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(resp.status)
         self.send_header("Content-Type", resp.content_type)
         self.send_header("Content-Length", str(len(payload)))
+        for name, value in getattr(resp, "headers", {}).items():
+            self.send_header(name, value)
         self.end_headers()
         if method != "HEAD":
             self.wfile.write(payload)

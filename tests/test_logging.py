@@ -1,0 +1,87 @@
+"""Structured logging + deprecation warning tests.
+
+Modeled on the reference suites: JsonLoggerTests (one JSON object per
+line with type/timestamp/level/component), DeprecationHttpIT (deprecated
+endpoints answer with a Warning: 299 header and log once per key)."""
+
+import json
+import logging
+
+import pytest
+
+from opensearch_tpu.common.logging import (DEPRECATION, JsonFormatter,
+                                           configure_logging, get_logger)
+from opensearch_tpu.node import Node
+
+
+class TestJsonLogging:
+    def test_json_lines_shape(self, capsys):
+        configure_logging({"logger.level": "INFO"})
+        get_logger("test.component").info("hello %s", "world",
+                                          extra={"shard": 3})
+        err = capsys.readouterr().err.strip().splitlines()[-1]
+        doc = json.loads(err)
+        assert doc["message"] == "hello world"
+        assert doc["level"] == "INFO"
+        assert doc["component"] == "opensearch_tpu.test.component"
+        assert doc["shard"] == 3
+        assert "timestamp" in doc
+
+    def test_per_logger_level_settings(self):
+        configure_logging({"logger.level": "WARNING",
+                           "logger.cluster": "DEBUG"})
+        assert get_logger("cluster").isEnabledFor(logging.DEBUG)
+        assert not get_logger("search").isEnabledFor(logging.INFO)
+        configure_logging({})     # restore defaults for other tests
+
+    def test_file_output(self, tmp_path):
+        configure_logging({"path.logs": str(tmp_path)})
+        get_logger("filetest").warning("to file")
+        configure_logging({})
+        content = (tmp_path / "opensearch_tpu.json").read_text()
+        assert json.loads(content.strip().splitlines()[-1])[
+            "message"] == "to file"
+
+    def test_exception_stacktrace(self, capsys):
+        configure_logging({})
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            get_logger("exc").exception("failed")
+        err = capsys.readouterr().err.strip().splitlines()[-1]
+        doc = json.loads(err)
+        assert "boom" in doc["stacktrace"]
+
+
+class TestDeprecationWarnings:
+    def test_cat_master_warns_in_response_header(self):
+        n = Node()
+        resp = n.handle("GET", "/_cat/master")
+        assert "Warning" in resp.headers
+        assert "deprecated" in resp.headers["Warning"]
+        assert resp.status == 200
+        # the replacement endpoint carries no warning
+        clean = n.handle("GET", "/_cat/cluster_manager")
+        assert "Warning" not in clean.headers
+
+    def test_header_survives_http(self):
+        import urllib.request
+        from opensearch_tpu.rest.http import HttpServer
+        srv = HttpServer(Node(), port=0)
+        srv.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/_cat/master") as r:
+                assert "deprecated" in r.headers.get("Warning", "")
+        finally:
+            srv.close()
+
+    def test_logged_once_per_key(self, capsys):
+        configure_logging({})
+        DEPRECATION._seen.discard("once_test")
+        DEPRECATION.start_request()
+        DEPRECATION.deprecate("once_test", "this is old")
+        DEPRECATION.deprecate("once_test", "this is old")
+        assert DEPRECATION.drain_request() == ["this is old"]
+        err = capsys.readouterr().err
+        assert err.count("this is old") == 1
