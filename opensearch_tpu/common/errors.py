@@ -34,6 +34,11 @@ class IndexNotFoundError(OpenSearchTpuError):
         self.index = index
 
 
+class ResourceNotFoundError(OpenSearchTpuError):
+    status = 404
+    error_type = "resource_not_found_exception"
+
+
 class ResourceAlreadyExistsError(OpenSearchTpuError):
     status = 400
     error_type = "resource_already_exists_exception"

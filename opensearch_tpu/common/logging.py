@@ -22,6 +22,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 _ROOT = "opensearch_tpu"
+_CONFIGURED_LOGGERS: set = set()
 
 
 class JsonFormatter(logging.Formatter):
@@ -63,8 +64,15 @@ def configure_logging(settings: Optional[dict] = None) -> None:
     <path.logs>/opensearch_tpu.json when path.logs is set."""
     settings = settings or {}
     root = logging.getLogger(_ROOT)
-    root.handlers = [h for h in root.handlers
-                     if not getattr(h, "_opensearch_tpu", False)]
+    for h in list(root.handlers):
+        if getattr(h, "_opensearch_tpu", False):
+            root.removeHandler(h)
+            h.close()
+    # reset levels a previous configuration pinned (else logger.cluster:
+    # DEBUG from one config leaks into the next)
+    for name in _CONFIGURED_LOGGERS:
+        logging.getLogger(name).setLevel(logging.NOTSET)
+    _CONFIGURED_LOGGERS.clear()
     handler = logging.StreamHandler(sys.stderr)
     handler.setFormatter(JsonFormatter())
     handler._opensearch_tpu = True
@@ -84,7 +92,9 @@ def configure_logging(settings: Optional[dict] = None) -> None:
     # exists), while test harness capture relies on records reaching it
     for key, value in settings.items():
         if key.startswith("logger.") and key != "logger.level":
-            get_logger(key[len("logger."):]).setLevel(str(value).upper())
+            child = get_logger(key[len("logger."):])
+            child.setLevel(str(value).upper())
+            _CONFIGURED_LOGGERS.add(child.name)
 
 
 class DeprecationLogger:
@@ -100,17 +110,23 @@ class DeprecationLogger:
         self.log = get_logger("deprecation")
 
     def start_request(self) -> None:
-        self._tls.warnings = []
+        # a STACK, not a single list: handlers may dispatch sub-requests
+        # (search templates do), and the inner frame must not clobber the
+        # outer request's collected warnings
+        if not hasattr(self._tls, "frames"):
+            self._tls.frames = []
+        self._tls.frames.append([])
 
     def drain_request(self) -> List[str]:
-        out = getattr(self._tls, "warnings", [])
-        self._tls.warnings = []
-        return out
+        frames = getattr(self._tls, "frames", None)
+        return frames.pop() if frames else []
 
     def deprecate(self, key: str, message: str) -> None:
-        warnings = getattr(self._tls, "warnings", None)
-        if warnings is not None and message not in warnings:
-            warnings.append(message)
+        frames = getattr(self._tls, "frames", None)
+        if frames:
+            warnings = frames[-1]
+            if message not in warnings:
+                warnings.append(message)
         with self._lock:
             if key in self._seen:
                 return

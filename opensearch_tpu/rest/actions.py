@@ -1440,6 +1440,93 @@ def register_cat_actions(node, c):
 # ------------------------------------------------------- scripts & ingest
 
 def register_script_ingest_actions(node, c):
+    def _resolve_template(body):
+        """{source | id} + params → rendered search body (search
+        templates: modules/lang-mustache RestSearchTemplateAction)."""
+        from opensearch_tpu.script.mustache import render_search_template
+        body = body or {}
+        source = body.get("source")
+        if source is None and body.get("id"):
+            ss = node.script_service.get_stored(body["id"])
+            if ss is None or ss.lang != "mustache":
+                # a stored painless script is NOT a template — treating it
+                # as one produces a misleading render error
+                from opensearch_tpu.common.errors import (
+                    ResourceNotFoundError)
+                raise ResourceNotFoundError(
+                    f"unable to find search template [{body['id']}]")
+            source = ss.source
+        if source is None:
+            raise IllegalArgumentError(
+                "template is missing [source] or [id] of a stored script")
+        return render_search_template(source, body.get("params"))
+
+    def do_search_template(req):
+        rendered = _resolve_template(req.body)
+        sub = RestRequest(method="POST",
+                          path=(f"/{req.param('index')}/_search"
+                                if req.param("index") else "/_search"),
+                          params={k: v for k, v in req.params.items()
+                                  if k not in ("index",)},
+                          body=rendered)
+        return node.controller.dispatch(sub)
+
+    def do_render_template(req):
+        body = dict(req.body or {})
+        if req.param("id") and "id" not in body:
+            body["id"] = req.param("id")
+        return {"template_output": _resolve_template(body)}
+
+    def do_msearch_template(req):
+        lines = _ndjson_lines(req)
+        if len(lines) % 2:
+            raise IllegalArgumentError(
+                "_msearch/template expects header/body line pairs")
+        # render each item independently: one bad template yields a
+        # per-item error entry, never a whole-request failure (matching
+        # do_msearch's per-item semantics)
+        entries = []          # (header, rendered) | (None, error_dict)
+        for i in range(0, len(lines), 2):
+            try:
+                entries.append((lines[i],
+                                _resolve_template(lines[i + 1])))
+            except OpenSearchTpuError as e:
+                entries.append((None, {
+                    "error": {"type": e.error_type, "reason": str(e)},
+                    "status": e.status}))
+        ndjson = []
+        for header, rendered in entries:
+            if header is not None:
+                ndjson.append(json.dumps(header))
+                ndjson.append(json.dumps(rendered))
+        responses: List[Any] = []
+        if ndjson:
+            sub = RestRequest(
+                method="POST",
+                path=(f"/{req.param('index')}/_msearch"
+                      if req.param("index") else "/_msearch"),
+                params={}, body=None,
+                raw_body=("\n".join(ndjson) + "\n").encode())
+            inner = node.controller.dispatch(sub)
+            if inner.status != 200:
+                return inner
+            responses = list(inner.body.get("responses", []))
+        out = []
+        for header, rendered in entries:
+            out.append(responses.pop(0) if header is not None else rendered)
+        return {"responses": out}
+
+    c.register("GET", "/_search/template", do_search_template)
+    c.register("POST", "/_search/template", do_search_template)
+    c.register("GET", "/{index}/_search/template", do_search_template)
+    c.register("POST", "/{index}/_search/template", do_search_template)
+    c.register("POST", "/_render/template", do_render_template)
+    c.register("GET", "/_render/template", do_render_template)
+    c.register("POST", "/_render/template/{id}", do_render_template)
+    c.register("GET", "/_render/template/{id}", do_render_template)
+    c.register("POST", "/_msearch/template", do_msearch_template)
+    c.register("POST", "/{index}/_msearch/template", do_msearch_template)
+
     def do_put_script(req):
         node.script_service.put_stored(req.param("id"), req.body or {})
         return {"acknowledged": True}

@@ -121,10 +121,14 @@ class RestController:
         warnings = DEPRECATION.drain_request()
         if warnings:
             # rest/DeprecationRestHandler: deprecations surface to the
-            # CALLER as Warning: 299 headers, not just server logs
-            # RFC 7234 §5.5: warning-values are a COMMA-separated list
-            response.headers["Warning"] = ", ".join(
-                f'299 opensearch_tpu "{w}"' for w in warnings)
+            # CALLER as Warning: 299 headers, not just server logs.
+            # RFC 7234 §5.5: warning-values are a COMMA-separated list;
+            # merge with what a nested dispatch already attached
+            rendered = ", ".join(f'299 opensearch_tpu "{w}"'
+                                 for w in warnings)
+            existing = response.headers.get("Warning")
+            response.headers["Warning"] = \
+                f"{existing}, {rendered}" if existing else rendered
         return response
 
     def _dispatch_inner(self, request: RestRequest) -> RestResponse:

@@ -1,0 +1,154 @@
+"""Mustache template rendering for search templates.
+
+Re-design of modules/lang-mustache (MustacheScriptEngine.java +
+RestSearchTemplateAction / RestRenderSearchTemplateAction): templates are
+JSON documents with mustache placeholders, rendered with the request's
+`params` and then parsed as the actual search body. Supported syntax —
+the subset the reference's search-template docs exercise:
+
+  {{var}}                plain substitution (dotted paths; dicts/lists
+                         render as JSON, which is what a JSON template
+                         needs)
+  {{#toJson}}x{{/toJson}} explicit JSON serialization of a param
+  {{#join}}x{{/join}}     comma-join of a list param
+  {{#sec}}...{{/sec}}     section: list → repeat with item context,
+                         truthy → render once, falsy → skip
+  {{^sec}}...{{/sec}}     inverted section
+  {{var}}{{^var}}d{{/var}} the documented default-value idiom works via
+                         inverted sections
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, List, Optional
+
+from opensearch_tpu.common.errors import IllegalArgumentError
+
+_TAG = re.compile(r"\{\{\s*([#/^]?)\s*([^}\s]+)\s*\}\}")
+
+
+def _lookup(context_stack: List[Any], path: str):
+    if path == ".":
+        return context_stack[-1]
+    for ctx in reversed(context_stack):
+        value: Any = ctx
+        found = True
+        for part in path.split("."):
+            if isinstance(value, dict) and part in value:
+                value = value[part]
+            else:
+                found = False
+                break
+        if found:
+            return value
+    return None
+
+
+def _stringify(value: Any) -> str:
+    if value is None:
+        return ""
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, (dict, list)):
+        return json.dumps(value)
+    return str(value)
+
+
+def render(template: str, params: Optional[dict]) -> str:
+    """Render a mustache template against `params`."""
+    tokens = _tokenize(template)
+    out: List[str] = []
+    _render_block(tokens, 0, len(tokens), [params or {}], out)
+    return "".join(out)
+
+
+def _tokenize(template: str):
+    tokens = []
+    pos = 0
+    for m in _TAG.finditer(template):
+        if m.start() > pos:
+            tokens.append(("text", template[pos:m.start()]))
+        kind, name = m.group(1), m.group(2)
+        tokens.append(({"#": "open", "/": "close", "^": "invert"}
+                       .get(kind, "var"), name))
+        pos = m.end()
+    if pos < len(template):
+        tokens.append(("text", template[pos:]))
+    return tokens
+
+
+def _find_close(tokens, start: int, name: str) -> int:
+    depth = 0
+    for i in range(start, len(tokens)):
+        kind, value = tokens[i]
+        if kind in ("open", "invert"):
+            depth += 1
+        elif kind == "close":
+            if depth == 0 and value == name:
+                return i
+            depth -= 1
+    raise IllegalArgumentError(
+        f"unclosed mustache section [{name}]")
+
+
+def _render_block(tokens, start: int, end: int, stack: List[Any],
+                  out: List[str]):
+    i = start
+    while i < end:
+        kind, value = tokens[i]
+        if kind == "text":
+            out.append(value)
+        elif kind == "var":
+            out.append(_stringify(_lookup(stack, value)))
+        elif kind == "close":
+            raise IllegalArgumentError(
+                f"unexpected mustache close tag [{value}]")
+        elif kind in ("open", "invert"):
+            close = _find_close(tokens, i + 1, value)
+            body = (i + 1, close)
+            if kind == "open" and value == "toJson":
+                # {{#toJson}}param{{/toJson}} — the body names the param
+                name = "".join(t for k, t in tokens[body[0]:body[1]]
+                               if k == "text").strip()
+                out.append(json.dumps(_lookup(stack, name)))
+            elif kind == "open" and value == "join":
+                name = "".join(t for k, t in tokens[body[0]:body[1]]
+                               if k == "text").strip()
+                items = _lookup(stack, name) or []
+                out.append(",".join(_stringify(v) for v in items))
+            else:
+                ctx = _lookup(stack, value)
+                # mustache falsiness: absent, false, empty list/string —
+                # but NOT numeric zero (mustache.java treats 0 as truthy,
+                # and the default-value idiom depends on it)
+                truthy = not (ctx is None or ctx is False or ctx == []
+                              or ctx == "")
+                if kind == "invert":
+                    if not truthy:
+                        _render_block(tokens, body[0], body[1], stack, out)
+                elif isinstance(ctx, list):
+                    for item in ctx:
+                        stack.append(item)
+                        _render_block(tokens, body[0], body[1], stack, out)
+                        stack.pop()
+                elif truthy:
+                    stack.append(ctx if isinstance(ctx, dict) else {})
+                    _render_block(tokens, body[0], body[1], stack, out)
+                    stack.pop()
+            i = close
+        i += 1
+
+
+def render_search_template(source: Any, params: Optional[dict]) -> dict:
+    """Template source (a string of templated JSON, or an already-parsed
+    dict re-serialized first, both accepted by the reference) → rendered
+    search body dict."""
+    text = source if isinstance(source, str) else json.dumps(source)
+    rendered = render(text, params)
+    try:
+        return json.loads(rendered)
+    except json.JSONDecodeError as e:
+        raise IllegalArgumentError(
+            f"rendered template is not valid JSON: {e}: {rendered[:200]}")

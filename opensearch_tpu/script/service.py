@@ -8,6 +8,7 @@ painless subset in script/painless.py.
 
 from __future__ import annotations
 
+import json
 import time
 from typing import Any, Dict, List, Optional
 
@@ -122,9 +123,16 @@ class ScriptService:
         if not isinstance(spec, dict) or "source" not in spec:
             raise IllegalArgumentError("must specify [script] with [source]")
         lang = spec.get("lang", "painless")
-        if lang != "painless":
+        if lang == "painless":
+            # compile-check at store time, like the reference
+            parse(spec["source"])
+        elif lang == "mustache":
+            # search templates: validate section structure at store time
+            from opensearch_tpu.script.mustache import render
+            render(spec["source"] if isinstance(spec["source"], str)
+                   else json.dumps(spec["source"]), {})
+        else:
             raise IllegalArgumentError(f"script_lang not supported [{lang}]")
-        parse(spec["source"])  # compile-check at store time, like the reference
         self.stored[script_id] = StoredScript(lang, spec["source"])
 
     def get_stored(self, script_id: str) -> Optional[StoredScript]:

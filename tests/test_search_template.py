@@ -1,0 +1,145 @@
+"""Search template (mustache) tests.
+
+Modeled on the reference suites: MustacheScriptEngineTests,
+SearchTemplateIT / RenderSearchTemplateIT (modules/lang-mustache)."""
+
+import pytest
+
+from opensearch_tpu.common.errors import IllegalArgumentError
+from opensearch_tpu.node import Node
+from opensearch_tpu.script.mustache import render, render_search_template
+
+
+class TestMustache:
+    def test_plain_vars(self):
+        assert render("hello {{name}}", {"name": "world"}) == "hello world"
+        assert render("n={{n}}", {"n": 42}) == "n=42"
+        assert render("b={{b}}", {"b": True}) == "b=true"
+        assert render("missing=[{{nope}}]", {}) == "missing=[]"
+
+    def test_dotted_paths(self):
+        assert render("{{a.b.c}}", {"a": {"b": {"c": "deep"}}}) == "deep"
+
+    def test_to_json(self):
+        out = render('{"terms": {{#toJson}}vals{{/toJson}}}',
+                     {"vals": ["a", "b"]})
+        assert out == '{"terms": ["a", "b"]}'
+
+    def test_join(self):
+        assert render("{{#join}}xs{{/join}}", {"xs": [1, 2, 3]}) == "1,2,3"
+
+    def test_sections_list_and_truthy(self):
+        assert render("{{#items}}[{{.}}]{{/items}}",
+                      {"items": ["x", "y"]}) == "[x][y]"
+        assert render("{{#flag}}yes{{/flag}}", {"flag": True}) == "yes"
+        assert render("{{#flag}}yes{{/flag}}", {"flag": False}) == ""
+
+    def test_inverted_default_idiom(self):
+        tpl = "{{size}}{{^size}}10{{/size}}"
+        assert render(tpl, {"size": 3}) == "3"
+        assert render(tpl, {}) == "10"
+
+    def test_unclosed_section_rejected(self):
+        with pytest.raises(IllegalArgumentError):
+            render("{{#a}}no close", {})
+
+    def test_render_search_template_parses_json(self):
+        body = render_search_template(
+            '{"query": {"match": {"f": "{{q}}"}}, "size": {{size}}}',
+            {"q": "hello", "size": 5})
+        assert body == {"query": {"match": {"f": "hello"}}, "size": 5}
+
+    def test_bad_rendered_json_rejected(self):
+        with pytest.raises(IllegalArgumentError):
+            render_search_template('{"query": {{q}}}', {})
+
+
+class TestSearchTemplateRest:
+    @pytest.fixture()
+    def node(self):
+        n = Node()
+        n.request("PUT", "/tpl", {"mappings": {"properties": {
+            "title": {"type": "text"}, "year": {"type": "integer"}}}})
+        docs = [("1", "alpha release", 2020), ("2", "beta release", 2021),
+                ("3", "gamma preview", 2022)]
+        for i, t, y in docs:
+            n.request("PUT", f"/tpl/_doc/{i}", {"title": t, "year": y})
+        n.request("POST", "/tpl/_refresh")
+        return n
+
+    def test_inline_source(self, node):
+        res = node.request("POST", "/tpl/_search/template", {
+            "source": '{"query": {"match": {"title": "{{word}}"}}}',
+            "params": {"word": "release"}})
+        assert res["hits"]["total"]["value"] == 2
+
+    def test_stored_template(self, node):
+        node.request("PUT", "/_scripts/by-year", {"script": {
+            "lang": "mustache",
+            "source": '{"query": {"range": {"year": '
+                      '{"gte": {{from}}}}}, "size": 10}'}})
+        res = node.request("POST", "/tpl/_search/template", {
+            "id": "by-year", "params": {"from": 2021}})
+        assert res["hits"]["total"]["value"] == 2
+
+    def test_missing_stored_template_404(self, node):
+        res = node.request("POST", "/tpl/_search/template", {
+            "id": "nope", "params": {}})
+        assert res.get("_status") == 404
+
+    def test_render_template(self, node):
+        res = node.request("POST", "/_render/template", {
+            "source": '{"query": {"term": {"title": "{{t}}"}}}',
+            "params": {"t": "alpha"}})
+        assert res["template_output"] == {
+            "query": {"term": {"title": "alpha"}}}
+
+    def test_render_stored_by_path(self, node):
+        node.request("PUT", "/_scripts/r1", {"script": {
+            "lang": "mustache",
+            "source": '{"size": {{n}}{{^n}}10{{/n}}}'}})
+        res = node.request("POST", "/_render/template/r1", {"params": {}})
+        assert res["template_output"] == {"size": 10}
+
+    def test_zero_param_is_truthy(self):
+        tpl = "{{size}}{{^size}}10{{/size}}"
+        assert render(tpl, {"size": 0}) == "0"
+        assert render("{{#n}}[{{n}}]{{/n}}", {"n": 0}) == "[0]"
+
+    def test_msearch_template_bad_item_is_per_item_error(self, node):
+        lines = [
+            "{}",
+            '{"source": "{\\"query\\": {\\"match\\": {\\"title\\": '
+            '\\"{{w}}\\"}}}", "params": {"w": "release"}}',
+            "{}",
+            '{"id": "missing-template", "params": {}}',
+        ]
+        res = node.handle("POST", "/tpl/_msearch/template",
+                          body="\n".join(lines) + "\n")
+        assert res.status == 200
+        r = res.body["responses"]
+        assert r[0]["hits"]["total"]["value"] == 2
+        assert r[1]["status"] == 404 and "error" in r[1]
+
+    def test_stored_painless_is_not_a_template(self, node):
+        node.request("PUT", "/_scripts/notmpl", {"script": {
+            "lang": "painless", "source": "1 + 1"}})
+        res = node.request("POST", "/tpl/_search/template",
+                           {"id": "notmpl", "params": {}})
+        assert res.get("_status") == 404
+
+    def test_msearch_template(self, node):
+        lines = [
+            "{}",
+            '{"source": "{\\"query\\": {\\"match\\": {\\"title\\": '
+            '\\"{{w}}\\"}}}", "params": {"w": "release"}}',
+            "{}",
+            '{"source": "{\\"query\\": {\\"match\\": {\\"title\\": '
+            '\\"{{w}}\\"}}}", "params": {"w": "preview"}}',
+        ]
+        res = node.handle("POST", "/tpl/_msearch/template",
+                          body="\n".join(lines) + "\n")
+        assert res.status == 200
+        totals = [r["hits"]["total"]["value"]
+                  for r in res.body["responses"]]
+        assert totals == [2, 1]
