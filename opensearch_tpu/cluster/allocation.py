@@ -291,6 +291,123 @@ def _move_one(ctx: AllocationContext, shards: List[dict], index: str,
     return False
 
 
+# -------------------------------------------------------- reroute commands
+
+def apply_reroute_command(data: dict, live: List[str], cmd: dict) -> None:
+    """One explicit _cluster/reroute command (cluster/routing/allocation/
+    command/*Command.java): move, cancel, allocate_replica,
+    allocate_empty_primary, allocate_stale_primary. Mutates data["routing"]
+    in place; the caller's allocate() pass then completes/validates the
+    result. Invalid commands raise IllegalArgumentError (HTTP 400)."""
+    from opensearch_tpu.common.errors import IllegalArgumentError
+    if not isinstance(cmd, dict) or len(cmd) != 1:
+        raise IllegalArgumentError(
+            "[reroute] each command must have exactly one verb")
+    verb, args = next(iter(cmd.items()))
+    if not isinstance(args, dict):
+        raise IllegalArgumentError(f"[reroute] [{verb}] expects an object")
+    index = args.get("index")
+    try:
+        shard = int(args.get("shard", 0))
+    except (TypeError, ValueError):
+        raise IllegalArgumentError(
+            f"[reroute] [shard] must be an integer, got "
+            f"[{args.get('shard')}]")
+    routing = data.get("routing", {})
+    if index not in routing or not 0 <= shard < len(routing[index]):
+        raise IllegalArgumentError(
+            f"[reroute] no such shard [{index}][{shard}]")
+    entry = routing[index][shard]
+    ctx = AllocationContext(data, live)
+    live_set = set(live)
+
+    def require_node(name: str):
+        node = args.get(name)
+        if not node:
+            raise IllegalArgumentError(f"[reroute] [{verb}] requires "
+                                       f"[{name}]")
+        if node not in live_set:
+            raise IllegalArgumentError(
+                f"[reroute] no such node [{node}] in the cluster")
+        return node
+
+    if verb == "move":
+        source, target = require_node("from_node"), require_node("to_node")
+        if entry.get("relocating"):
+            raise IllegalArgumentError(
+                f"[reroute] shard [{index}][{shard}] is already relocating")
+        is_primary = entry.get("primary") == source
+        if not is_primary and source not in entry.get("replicas", []):
+            raise IllegalArgumentError(
+                f"[reroute] [{source}] holds no copy of "
+                f"[{index}][{shard}]")
+        decision = can_allocate(ctx, index, entry, target, is_primary)
+        if decision.kind == NO:
+            raise IllegalArgumentError(
+                f"[reroute] cannot allocate [{index}][{shard}] to "
+                f"[{target}]: {decision.reason}")
+        _start_relocation(ctx, index, entry, source, target,
+                          primary=is_primary)
+    elif verb == "cancel":
+        node = args.get("node")
+        if not node:
+            raise IllegalArgumentError("[reroute] [cancel] requires [node]")
+        if entry.get("primary") == node:
+            if not args.get("allow_primary"):
+                raise IllegalArgumentError(
+                    "[reroute] cancelling the primary requires "
+                    "[allow_primary: true]")
+            entry["primary"] = None
+        elif node in entry.get("replicas", []):
+            entry["replicas"] = [n for n in entry["replicas"] if n != node]
+            entry["active_replicas"] = [n for n in entry["active_replicas"]
+                                        if n != node]
+            rel = entry.get("relocating")
+            if rel and node in (rel["from"], rel["to"]):
+                entry.pop("relocating", None)
+        else:
+            raise IllegalArgumentError(
+                f"[reroute] [{node}] holds no copy of [{index}][{shard}]")
+    elif verb == "allocate_replica":
+        node = require_node("node")
+        if entry.get("primary") is None:
+            raise IllegalArgumentError(
+                f"[reroute] [{index}][{shard}] has no active primary to "
+                f"recover a replica from")
+        desired = int(((data.get("indices", {}).get(index) or {})
+                       .get("settings") or {}).get("number_of_replicas", 0))
+        if len(entry.get("replicas", [])) >= desired:
+            raise IllegalArgumentError(
+                f"[reroute] all [{desired}] replica copies of "
+                f"[{index}][{shard}] are already allocated")
+        if node in shard_copies(entry):
+            raise IllegalArgumentError(
+                f"[reroute] [{node}] already holds a copy of "
+                f"[{index}][{shard}]")
+        decision = can_allocate(ctx, index, entry, node, is_primary=False)
+        if decision.kind == NO:
+            raise IllegalArgumentError(
+                f"[reroute] cannot allocate replica to [{node}]: "
+                f"{decision.reason}")
+        entry["replicas"] = entry.get("replicas", []) + [node]
+    elif verb in ("allocate_empty_primary", "allocate_stale_primary"):
+        node = require_node("node")
+        if not args.get("accept_data_loss"):
+            raise IllegalArgumentError(
+                f"[reroute] [{verb}] requires [accept_data_loss: true]")
+        if entry.get("primary") is not None:
+            raise IllegalArgumentError(
+                f"[reroute] [{index}][{shard}] already has a primary")
+        entry["primary"] = node
+        entry["primary_term"] = entry.get("primary_term", 0) + 1
+        entry["replicas"] = [n for n in entry.get("replicas", [])
+                             if n != node]
+        entry["active_replicas"] = [n for n in entry.get("active_replicas",
+                                                         []) if n != node]
+    else:
+        raise IllegalArgumentError(f"[reroute] unknown command [{verb}]")
+
+
 # ------------------------------------------------------------------- queries
 
 def shard_copies(entry: dict) -> List[str]:

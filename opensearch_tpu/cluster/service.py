@@ -322,6 +322,12 @@ class ClusterNode:
                     else:
                         merged[k] = v
                 data["settings"] = merged
+            elif kind == "reroute":
+                from opensearch_tpu.cluster.allocation import (
+                    apply_reroute_command)
+                data["routing"] = copy_routing(data)
+                for cmd in update["commands"]:
+                    apply_reroute_command(data, sorted(state.nodes), cmd)
             elif kind == "update_index_settings":
                 iname = update["index"]
                 if iname in data["indices"]:
@@ -1833,6 +1839,27 @@ class ClusterNode:
             if len(parts) >= 3 and parts[1] == "allocation" \
                     and parts[2] == "explain":
                 return self.allocation_explain(body), 200
+            if len(parts) >= 2 and parts[1] == "reroute" \
+                    and method == "POST":
+                commands = (body or {}).get("commands") or []
+                dry = str(params.get("dry_run", "false")).lower() \
+                    not in ("false", "0", "no", "")
+                if dry:
+                    # validate against a routing copy without publishing
+                    from opensearch_tpu.cluster.allocation import (
+                        apply_reroute_command)
+                    trial = dict(self._data())
+                    trial["routing"] = copy_routing(trial)
+                    live = sorted(self.state.nodes) if self.state else []
+                    for cmd in commands:
+                        apply_reroute_command(trial, live, cmd)
+                    return {"acknowledged": True, "dry_run": True}, 200
+                self._submit_to_leader({"kind": "reroute",
+                                        "commands": commands})
+                # no routing snapshot in the response: a follower's applied
+                # state may trail the leader's commit, and a stale table
+                # here would read as "the move failed"
+                return {"acknowledged": True}, 200
             if len(parts) >= 2 and parts[1] == "settings" \
                     and method == "PUT" and isinstance(body, dict):
                 # intercept cluster.remote.*.seeds and allocation settings

@@ -649,3 +649,118 @@ class TestRecoveryModes:
         finally:
             for n in nodes.values():
                 n.close()
+
+
+class TestClusterReroute:
+    def test_move_command_relocates_with_data(self, cluster):
+        node = next(iter(cluster.values()))
+        node.request("PUT", "/rr", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+            "mappings": {"properties": {"b": {"type": "text"}}}})
+        node.await_health("green", timeout=30)
+        for i in range(6):
+            node.request("PUT", f"/rr/_doc/{i}", {"b": f"moved {i}"})
+        node.request("POST", "/rr/_refresh")
+        src = node._data()["routing"]["rr"][0]["primary"]
+        dst = next(n for n in cluster if n != src)
+        res = node.request("POST", "/_cluster/reroute", {
+            "commands": [{"move": {"index": "rr", "shard": 0,
+                                   "from_node": src, "to_node": dst}}]})
+        assert res["acknowledged"] is True
+
+        def moved():
+            e = node._data()["routing"]["rr"][0]
+            return e["primary"] == dst and not e.get("relocating")
+        wait_for(moved, timeout=60, msg="manual move completed")
+        out = node.request("POST", "/rr/_search",
+                           {"query": {"match": {"b": "moved"}}, "size": 10})
+        assert out["hits"]["total"]["value"] == 6
+
+    def test_cancel_replica_and_allocate_replica(self, cluster):
+        node = next(iter(cluster.values()))
+        node.request("PUT", "/rc", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 1}})
+        node.await_health("green", timeout=30)
+        e = node._data()["routing"]["rc"][0]
+        rep = e["replicas"][0]
+        node.request("POST", "/_cluster/reroute", {
+            "commands": [{"cancel": {"index": "rc", "shard": 0,
+                                     "node": rep}}]})
+        # the allocator re-adds a replica (desired count is 1); wait for
+        # convergence to green again
+        node.await_health("green", timeout=30)
+
+    def test_invalid_command_is_400(self, cluster):
+        node = next(iter(cluster.values()))
+        node.request("PUT", "/ri", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0}})
+        node.await_health("green", timeout=30)
+        holder = node._data()["routing"]["ri"][0]["primary"]
+        other = next(n for n in cluster if n != holder)
+        res = node.request("POST", "/_cluster/reroute", {
+            "commands": [{"move": {"index": "ri", "shard": 0,
+                                   "from_node": other,
+                                   "to_node": holder}}]})
+        assert res.get("_status") == 400 or "error" in res
+        res = node.request("POST", "/_cluster/reroute", {
+            "commands": [{"bogus": {"index": "ri", "shard": 0}}]})
+        assert res.get("_status") == 400 or "error" in res
+
+    def test_unknown_node_is_400_not_silent_brick(self, cluster):
+        node = next(iter(cluster.values()))
+        node.request("PUT", "/rn", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0}})
+        node.await_health("green", timeout=30)
+        src = node._data()["routing"]["rn"][0]["primary"]
+        res = node.request("POST", "/_cluster/reroute", {
+            "commands": [{"move": {"index": "rn", "shard": 0,
+                                   "from_node": src,
+                                   "to_node": "no-such-node"}}]})
+        assert res.get("_status") == 400
+        res = node.request("POST", "/_cluster/reroute", {
+            "commands": [{"move": {"index": "rn", "shard": 0,
+                                   "to_node": src}}]})   # missing from_node
+        assert res.get("_status") == 400
+
+    def test_allocate_replica_needs_primary_and_budget(self, cluster):
+        node = next(iter(cluster.values()))
+        node.request("PUT", "/rb", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0}})
+        node.await_health("green", timeout=30)
+        holder = node._data()["routing"]["rb"][0]["primary"]
+        spare = next(n for n in cluster if n != holder)
+        # replica budget is 0: command must be rejected, not silently
+        # undone by the next reconcile pass
+        res = node.request("POST", "/_cluster/reroute", {
+            "commands": [{"allocate_replica": {
+                "index": "rb", "shard": 0, "node": spare}}]})
+        assert res.get("_status") == 400
+
+    def test_dry_run_validates_without_applying(self, cluster):
+        node = next(iter(cluster.values()))
+        node.request("PUT", "/rd", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0}})
+        node.await_health("green", timeout=30)
+        src = node._data()["routing"]["rd"][0]["primary"]
+        dst = next(n for n in cluster if n != src)
+        res = node.request("POST", "/_cluster/reroute",
+                           {"commands": [{"move": {
+                               "index": "rd", "shard": 0,
+                               "from_node": src, "to_node": dst}}]},
+                           dry_run="true")
+        assert res.get("dry_run") is True
+        import time as _t
+        _t.sleep(0.5)
+        e = node._data()["routing"]["rd"][0]
+        assert e["primary"] == src and not e.get("relocating")
+
+    def test_allocate_empty_primary_requires_data_loss_flag(self, cluster):
+        node = next(iter(cluster.values()))
+        node.request("PUT", "/rp", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0}})
+        node.await_health("green", timeout=30)
+        holder = node._data()["routing"]["rp"][0]["primary"]
+        res = node.request("POST", "/_cluster/reroute", {
+            "commands": [{"cancel": {"index": "rp", "shard": 0,
+                                     "node": holder}}]})
+        assert res.get("_status") == 400    # primary needs allow_primary
