@@ -132,6 +132,9 @@ class ClusterNode:
         # in-flight chunked-recovery sessions (source side): session id →
         # serialized segment blobs awaiting chunk pulls
         self._recovery_sessions: Dict[str, dict] = {}
+        # shards currently re-recovering an EXISTING local copy (the
+        # initializing-but-present reconcile path); guards double submits
+        self._rerecovering: set = set()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -532,12 +535,14 @@ class ClusterNode:
                     with self._tracked_lock:
                         self._tracked.pop(key, None)
                     shard = None
+                created_now = False
                 if shard is None:
                     shard = self._create_shard(name, sid, meta, is_primary,
                                                entry)
                     if shard is None:
                         continue
                     self.shards[key] = shard
+                    created_now = True
                 if is_primary and not shard.primary:
                     # promotion (IndexShard relocated/promoted path):
                     # bump the primary term so replica-side op dedup sees
@@ -546,6 +551,30 @@ class ClusterNode:
                     shard.engine.primary_term = entry.get("primary_term", 1)
                 elif is_replica and shard.primary:
                     shard.primary = False
+                if is_replica and not created_now and \
+                        self.node_id not in entry.get("active_replicas",
+                                                      []) and \
+                        entry.get("primary") and \
+                        entry["primary"] != self.node_id:
+                    # listed as INITIALIZING but the shard already exists
+                    # locally (e.g. a cancel + re-add to the same node in
+                    # one fold, or a shard_failed round trip): re-recover —
+                    # ops-based when the engine still has its state — and
+                    # report started, or the copy sits initializing forever
+                    key2 = (name, sid)
+                    if key2 not in self._rerecovering:
+                        self._rerecovering.add(key2)
+
+                        def _rerun(shard=shard, name=name, sid=sid,
+                                   primary=entry["primary"], key2=key2):
+                            try:
+                                self._recover_from(shard, name, sid,
+                                                   primary)
+                            except Exception:
+                                pass     # next reconcile retries
+                            finally:
+                                self._rerecovering.discard(key2)
+                        self.transport._workers.submit(_rerun)
 
     def _create_shard(self, name: str, sid: int, meta: dict,
                       is_primary: bool, entry: dict) -> Optional[IndexShard]:
@@ -1842,8 +1871,11 @@ class ClusterNode:
             if len(parts) >= 2 and parts[1] == "reroute" \
                     and method == "POST":
                 commands = (body or {}).get("commands") or []
-                dry = str(params.get("dry_run", "false")).lower() \
-                    not in ("false", "0", "no", "")
+                dry_value = params.get("dry_run")
+                # present-but-blank means true (RestRequest.bool_param
+                # semantics: ?dry_run with no value is an enabled flag)
+                dry = dry_value is not None and \
+                    str(dry_value).lower() not in ("false", "0", "no")
                 if dry:
                     # validate against a routing copy without publishing
                     from opensearch_tpu.cluster.allocation import (

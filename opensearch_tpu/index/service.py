@@ -144,14 +144,32 @@ class IndexService:
         (UpdateHelper semantics: detect_noop default true, upsert,
         doc_as_upsert, retry left to the caller). A caller-supplied
         if_seq_no/if_primary_term CAS is checked against the current doc."""
+        _KNOWN = {"doc", "doc_as_upsert", "script", "upsert",
+                  "scripted_upsert", "detect_noop", "_source", "lang",
+                  "if_seq_no", "if_primary_term", "fields"}
+        for key in body:
+            if key not in _KNOWN:
+                import difflib
+                guess = difflib.get_close_matches(key, sorted(_KNOWN), n=1)
+                hint = f" did you mean [{guess[0]}]?" if guess else ""
+                raise IllegalArgumentError(
+                    f"[UpdateRequest] unknown field [{key}]{hint}")
+        # CAS values may arrive in the body instead of URL params
+        # (UpdateRequest.fromXContent parses both)
+        if if_seq_no is None and body.get("if_seq_no") is not None:
+            if_seq_no = int(body["if_seq_no"])
+        if if_primary_term is None and body.get("if_primary_term") is not None:
+            if_primary_term = int(body["if_primary_term"])
         shard = self.shard_for(doc_id, routing)
         cur = shard.get_doc(doc_id)
         if "script" in body:
             return self._update_with_script(shard, doc_id, body, cur)
         if if_seq_no is not None or if_primary_term is not None:
             if cur is None:
-                raise VersionConflictError(
-                    f"[{doc_id}]: version conflict, document does not exist")
+                # a CAS against a missing doc is a 404, not a conflict
+                # (UpdateHelper prepare: DocumentMissingException wins)
+                raise DocumentMissingError(
+                    f"[{doc_id}]: document missing")
             if ((if_seq_no is not None and cur.seq_no != if_seq_no)
                     or (if_primary_term is not None
                         and cur.primary_term != if_primary_term)):

@@ -53,6 +53,25 @@ def _search_targets(node, index_expr: Optional[str]):
     return executors, filters
 
 
+def _check_require_alias(node, req) -> None:
+    """?require_alias=true: the write target must be an alias
+    (IndexRequest#requireAlias / DocWriteRequest)."""
+    if req.bool_param("require_alias") and \
+            req.param("index") not in node.indices.aliases:
+        from opensearch_tpu.common.errors import IndexNotFoundError
+        raise IndexNotFoundError(
+            f"[{req.param('index')}] is not an alias and [require_alias] "
+            f"request flag is [true]")
+
+
+def _validate_doc_id(doc_id: Optional[str]) -> None:
+    """IndexRequest.validate: ids are capped at 512 UTF-8 bytes."""
+    if doc_id is not None and len(doc_id.encode("utf-8")) > 512:
+        raise IllegalArgumentError(
+            f"id [{doc_id[:64]}...] is too long, must be no longer than "
+            f"512 bytes but was: {len(doc_id.encode('utf-8'))}")
+
+
 def _write_index(node, name: str) -> str:
     """Write-target resolution incl. data streams (stream → newest backing
     index, reference: IndexAbstraction.DataStream.getWriteIndex) and
@@ -91,6 +110,25 @@ def _run_search(node, index_expr: Optional[str], body: Optional[dict]) -> dict:
     from opensearch_tpu.search import dsl
     from opensearch_tpu.search.controller import execute_search
     executors, filters = _search_targets(node, index_expr)
+    # index.max_result_window (SearchService#validateSearchSource): deep
+    # from+size pagination must go through scroll/search_after instead
+    body_dict = body or {}
+    from_size = int(body_dict.get("from", 0) or 0) + \
+        int(body_dict.get("size", 10) or 0)
+    windows = []
+    for ex in executors:
+        svc = node.indices.indices.get(ex.reader.index_name)
+        if svc is not None:
+            windows.append(int(svc.settings.get("max_result_window",
+                                                10000)))
+    window = min(windows) if windows else 10000
+    if from_size > window:
+        raise IllegalArgumentError(
+            f"Result window is too large, from + size must be less than "
+            f"or equal to: [{window}] but was [{from_size}]. See the "
+            f"scroll api for a more efficient way to request large data "
+            f"sets. This limit can be set by changing the "
+            f"[index.max_result_window] index level setting.")
     parsed = dsl.parse_query((body or {}).get("query"))
     if isinstance(parsed, dsl.PercolateQuery):
         from opensearch_tpu.search.percolator import execute_percolate
@@ -171,9 +209,11 @@ def register_document_actions(node, c):
         return source
 
     def do_index(req):
+        _check_require_alias(node, req)
         idx = _write_index(node, req.param("index"))
         svc = node.indices.get(idx)
         doc_id = req.param("id")
+        _validate_doc_id(doc_id)
         op_type = req.param("op_type", "index")
         source = run_pipelines(svc, idx, doc_id, req.body or {},
                                req.param("pipeline"))
@@ -215,8 +255,13 @@ def register_document_actions(node, c):
         return (200 if res.get("result") == "deleted" else 404), res
 
     def do_update(req):
-        idx = node.indices.write_index(req.param("index"))
+        # update auto-creates like any document write (the reference's
+        # AutoCreateIndex covers TransportUpdateAction too — an upsert
+        # against a fresh index must not 404)
+        _check_require_alias(node, req)
+        idx = _write_index(node, req.param("index"))
         svc = node.indices.get(idx)
+        _validate_doc_id(req.param("id"))
         res = svc.update_doc(req.param("id"), req.body or {},
                              routing=req.param("routing"), **write_params(req))
         maybe_refresh(req, svc)

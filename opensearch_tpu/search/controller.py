@@ -25,7 +25,7 @@ from __future__ import annotations
 import time
 from typing import Any, List, Optional, Tuple
 
-from opensearch_tpu.common.errors import IllegalArgumentError
+from opensearch_tpu.common.errors import IllegalArgumentError, ParsingError
 from opensearch_tpu.search import dsl
 from opensearch_tpu.search.aggs.parse import PIPELINE_TYPES, parse_aggs
 from opensearch_tpu.search.aggs.pipeline import apply_pipelines
@@ -149,6 +149,27 @@ def _apply_rescore(executors, rescore_body, candidates, extra_filters):
     return candidates
 
 
+# the top-level keys SearchSourceBuilder's parser accepts — anything else
+# is a parsing error (400), e.g. a query clause pasted at the top level
+SEARCH_BODY_KEYS = frozenset({
+    "query", "from", "size", "sort", "aggs", "aggregations", "_source",
+    "fields", "stored_fields", "docvalue_fields", "script_fields",
+    "track_total_hits", "track_scores", "min_score", "search_after",
+    "highlight", "suggest", "rescore", "collapse", "post_filter",
+    "explain", "version", "seq_no_primary_term", "slice", "pit",
+    "profile", "timeout", "terminate_after", "indices_boost",
+    "runtime_mappings", "search_type", "scroll", "scroll_id", "ext",
+    "min_compatible_shard_node", "knn", "stats",
+    "_dfs",                       # internal: DFS-merged statistics
+})
+
+
+def _validate_search_body_keys(body: dict) -> None:
+    for key in body:
+        if key not in SEARCH_BODY_KEYS:
+            raise ParsingError(f"unknown key [{key}] in the search body")
+
+
 def execute_search(executors: List, body: Optional[dict],
                    total_shards: Optional[int] = None,
                    failed_shards: int = 0,
@@ -162,13 +183,15 @@ def execute_search(executors: List, body: Optional[dict],
     given) is checked for cancellation between shard launches — the safe
     points between device programs (CancellableBulkScorer analog)."""
     body = body or {}
+    _validate_search_body_keys(body)
     start = time.monotonic()
     profiling = bool(body.get("profile", False))
     profile_shards: List[dict] = []
     size = int(body.get("size", 10))
     from_ = int(body.get("from", 0))
     if size < 0 or from_ < 0:
-        raise IllegalArgumentError("[from] and [size] must be non-negative")
+        raise IllegalArgumentError("[from] parameter cannot be negative" if from_ < 0
+                else "[size] parameter cannot be negative")
 
     sort_specs = _parse_sort(body.get("sort"))
     score_sorted = sort_specs[0][0] == "_score"

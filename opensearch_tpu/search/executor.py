@@ -723,7 +723,8 @@ class SearchExecutor:
             from_ = int(body.get("from", 0))
             if size < 0 or from_ < 0:
                 raise IllegalArgumentError(
-                    "[from] and [size] must be non-negative")
+                    "[from] parameter cannot be negative" if from_ < 0
+                else "[size] parameter cannot be negative")
             min_score = float(body["min_score"]) \
                 if body.get("min_score") is not None else NEG_INF
             batchable.append((i, body, node, size, from_, min_score))

@@ -346,9 +346,10 @@ class TestAdaptiveReplicaSelection:
         # legitimately rejoin rotation once its re-recovery completes)
         node._submit_to_leader({"kind": "shard_failed", "index": "ars",
                                 "shard": 0, "node": replicas[0]})
-        wait_for(lambda: replicas[0] not in
-                 node._data()["routing"]["ars"][0]["active_replicas"],
-                 msg="replica failed out")
+        # NOTE: no wait for the failed-out state — the reconcile loop
+        # re-recovers an in-place copy so fast the transient removal may
+        # never be observable; the invariant below (reads only route to
+        # currently-active copies) is what matters
         for _ in range(8):
             before = dict(served)
             entry = searcher._data()["routing"]["ars"][0]
@@ -578,7 +579,7 @@ class TestRecoveryModes:
                 "mappings": {"properties": {"b": {"type": "text"}}}})
             for i in range(5):
                 node.request("PUT", f"/rec/_doc/a{i}", {"b": f"first {i}"})
-            node.await_health("green", timeout=30)
+            node.await_health("green", timeout=60)
             # the initial replica copy is a fresh target: file phase
             assert RECOVERY_STATS["file"] > before_file
 
@@ -687,8 +688,9 @@ class TestClusterReroute:
             "commands": [{"cancel": {"index": "rc", "shard": 0,
                                      "node": rep}}]})
         # the allocator re-adds a replica (desired count is 1); wait for
-        # convergence to green again
-        node.await_health("green", timeout=30)
+        # convergence to green again (generous: under full-suite load the
+        # re-recovery round trips slow down considerably)
+        node.await_health("green", timeout=90)
 
     def test_invalid_command_is_400(self, cluster):
         node = next(iter(cluster.values()))

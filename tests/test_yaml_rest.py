@@ -30,6 +30,7 @@ CURATED = [
     "delete/20_cas.yml",
     "delete/30_routing.yml",
     "delete/60_missing.yml",
+    "count/10_basic.yml",
     "exists/70_defaults.yml",
     "explain/10_basic.yml",
     "get/10_basic.yml",
@@ -58,6 +59,9 @@ CURATED = [
     "indices.rollover/40_mapping.yml",
     "indices.split/20_source_mapping.yml",
     "indices.validate_query/20_query_string.yml",
+    "index/10_with_id.yml",
+    "index/12_result.yml",
+    "indices.exists_template/10_basic.yml",
     "info/10_info.yml",
     "mlt/10_basic.yml",
     "mlt/20_docs.yml",
@@ -65,10 +69,13 @@ CURATED = [
     "ping/10_ping.yml",
     "range/10_basic.yml",
     "scroll/10_basic.yml",
+    "search/20_default_values.yml",
     "search/200_index_phrase_search.yml",
     "search/issue4895.yml",
     "suggest/10_basic.yml",
     "update/10_doc.yml",
+    "update/20_doc_upsert.yml",
+    "update/22_doc_as_upsert.yml",
     "update/11_shard_header.yml",
     "update/13_legacy_doc.yml",
     "update/16_noop.yml",

@@ -198,6 +198,11 @@ class YamlTestRunner:
                     raise StepFailure(
                         f"expected {catch} ({want}), got {resp.status}: "
                         f"{resp.body}")
+        elif method == "HEAD":
+            # exists-style APIs: HEAD answers a boolean, never an error —
+            # the framework exposes it as $body true/false
+            # (ClientYamlTestResponse#isError is bypassed for HEAD)
+            self.last = resp.status < 400
         elif resp.status >= 400 and not (ignore and
                                          resp.status in ignore):
             raise StepFailure(f"{method} {path} -> {resp.status}: "
