@@ -135,6 +135,9 @@ class ClusterNode:
         # shards currently re-recovering an EXISTING local copy (the
         # initializing-but-present reconcile path); guards double submits
         self._rerecovering: set = set()
+        # shards whose shard_started is submitted but not yet visible in
+        # active_replicas — skip redundant re-recoveries in that window
+        self._started_pending: set = set()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -551,9 +554,13 @@ class ClusterNode:
                     shard.engine.primary_term = entry.get("primary_term", 1)
                 elif is_replica and shard.primary:
                     shard.primary = False
+                if is_replica and \
+                        self.node_id in entry.get("active_replicas", []):
+                    self._started_pending.discard(key)
                 if is_replica and not created_now and \
                         self.node_id not in entry.get("active_replicas",
                                                       []) and \
+                        key not in self._started_pending and \
                         entry.get("primary") and \
                         entry["primary"] != self.node_id:
                     # listed as INITIALIZING but the shard already exists
@@ -571,7 +578,11 @@ class ClusterNode:
                                 self._recover_from(shard, name, sid,
                                                    primary)
                             except Exception:
-                                pass     # next reconcile retries
+                                # re-kick: without a fresh state update no
+                                # reconcile would ever retry this copy
+                                self.transport.scheduler.schedule_delayed(
+                                    1000, self._kick_reconcile,
+                                    "retry re-recovery")
                             finally:
                                 self._rerecovering.discard(key2)
                         self.transport._workers.submit(_rerun)
@@ -695,6 +706,7 @@ class ClusterNode:
             {"index": name, "shard": sid, "target": self.node_id,
              "local_checkpoint": shard.engine.local_checkpoint},
             timeout=30.0)
+        self._started_pending.add((name, sid))
         self._submit_to_leader({"kind": "shard_started", "index": name,
                                 "shard": sid, "node": self.node_id})
 

@@ -281,6 +281,7 @@ class MapperService:
                  dynamic: Any = True, total_fields_limit: int = DEFAULT_MAPPING_LIMIT):
         self.analysis = analysis_registry or get_default_registry()
         self.field_types: Dict[str, MappedFieldType] = {}
+        # see expand_field_patterns below
         self._multi_children: Dict[str, List[str]] = {}  # parent → direct sub-fields
         # nested object paths (index/mapper/ObjectMapper nested=true): each
         # value under such a path becomes its own segment row (doc block)
@@ -664,6 +665,26 @@ class MapperService:
 
     def get_field(self, name: str) -> Optional[MappedFieldType]:
         return self.field_types.get(name)
+
+    def expand_field_patterns(self, fields) -> List[str]:
+        """Wildcard field specs ("text*", "*_name^2") expand against the
+        mapping (QueryParserHelper.resolveMappingFields), skipping hidden
+        bound/join columns; boost suffixes carry to every expansion. The
+        single shared implementation for the compiler, highlighter, and
+        term collector — the hidden-field filter must never diverge."""
+        import fnmatch as _fn
+        out: List[str] = []
+        for fspec in fields:
+            fname, caret, fboost = str(fspec).partition("^")
+            if "*" not in fname:
+                out.append(fspec)
+                continue
+            for actual in self.field_types:
+                if "#" in actual:
+                    continue
+                if _fn.fnmatchcase(actual, fname):
+                    out.append(f"{actual}^{fboost}" if caret else actual)
+        return out
 
 
 def _parse_geo_point(value: Any) -> Tuple[float, float]:

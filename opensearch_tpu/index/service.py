@@ -96,6 +96,9 @@ class IndexService:
             for i in range(self.num_shards)
         ]
         self.creation_date = int(time.time() * 1000)
+        window = int(self.settings.get("max_result_window", 10000))
+        for shard in self.shards:
+            shard.executor.max_result_window = window
 
     # --------------------------------------------------------------- routing
 
@@ -162,8 +165,8 @@ class IndexService:
             if_primary_term = int(body["if_primary_term"])
         shard = self.shard_for(doc_id, routing)
         cur = shard.get_doc(doc_id)
-        if "script" in body:
-            return self._update_with_script(shard, doc_id, body, cur)
+        # CAS applies to scripted updates too — check BEFORE dispatching
+        # to the script path or a stale writer wins a lost update
         if if_seq_no is not None or if_primary_term is not None:
             if cur is None:
                 # a CAS against a missing doc is a 404, not a conflict
@@ -178,6 +181,8 @@ class IndexService:
                     f"[{if_seq_no}], primary term [{if_primary_term}]. "
                     f"current document has seqNo [{cur.seq_no}] and primary "
                     f"term [{cur.primary_term}]")
+        if "script" in body:
+            return self._update_with_script(shard, doc_id, body, cur)
         doc_patch = body.get("doc")
         if cur is None:
             if body.get("doc_as_upsert") and doc_patch is not None:

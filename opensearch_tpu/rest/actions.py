@@ -110,25 +110,6 @@ def _run_search(node, index_expr: Optional[str], body: Optional[dict]) -> dict:
     from opensearch_tpu.search import dsl
     from opensearch_tpu.search.controller import execute_search
     executors, filters = _search_targets(node, index_expr)
-    # index.max_result_window (SearchService#validateSearchSource): deep
-    # from+size pagination must go through scroll/search_after instead
-    body_dict = body or {}
-    from_size = int(body_dict.get("from", 0) or 0) + \
-        int(body_dict.get("size", 10) or 0)
-    windows = []
-    for ex in executors:
-        svc = node.indices.indices.get(ex.reader.index_name)
-        if svc is not None:
-            windows.append(int(svc.settings.get("max_result_window",
-                                                10000)))
-    window = min(windows) if windows else 10000
-    if from_size > window:
-        raise IllegalArgumentError(
-            f"Result window is too large, from + size must be less than "
-            f"or equal to: [{window}] but was [{from_size}]. See the "
-            f"scroll api for a more efficient way to request large data "
-            f"sets. This limit can be set by changing the "
-            f"[index.max_result_window] index level setting.")
     parsed = dsl.parse_query((body or {}).get("query"))
     if isinstance(parsed, dsl.PercolateQuery):
         from opensearch_tpu.search.percolator import execute_percolate
@@ -209,11 +190,13 @@ def register_document_actions(node, c):
         return source
 
     def do_index(req):
+        # validation precedes auto-create: a rejected request must not
+        # leave an empty index behind
         _check_require_alias(node, req)
-        idx = _write_index(node, req.param("index"))
-        svc = node.indices.get(idx)
         doc_id = req.param("id")
         _validate_doc_id(doc_id)
+        idx = _write_index(node, req.param("index"))
+        svc = node.indices.get(idx)
         op_type = req.param("op_type", "index")
         source = run_pipelines(svc, idx, doc_id, req.body or {},
                                req.param("pipeline"))
@@ -259,9 +242,9 @@ def register_document_actions(node, c):
         # AutoCreateIndex covers TransportUpdateAction too — an upsert
         # against a fresh index must not 404)
         _check_require_alias(node, req)
+        _validate_doc_id(req.param("id"))
         idx = _write_index(node, req.param("index"))
         svc = node.indices.get(idx)
-        _validate_doc_id(req.param("id"))
         res = svc.update_doc(req.param("id"), req.body or {},
                              routing=req.param("routing"), **write_params(req))
         maybe_refresh(req, svc)
@@ -282,7 +265,7 @@ def register_document_actions(node, c):
                 raise IllegalArgumentError("index is missing for doc")
             try:
                 svc = node.indices.get(node.indices.write_index(idx))
-                docs.append(svc.get_doc(spec["_id"],
+                docs.append(svc.get_doc(str(spec["_id"]),
                                         routing=spec.get("routing")))
             except IndexNotFoundError:
                 docs.append({"_index": idx, "_id": spec.get("_id"),
@@ -320,6 +303,10 @@ def register_document_actions(node, c):
                      **{k.lstrip("_"): v for k, v in meta.items()
                         if k in ("_index", "_id", "routing", "_routing",
                                  "if_seq_no", "if_primary_term")}}
+            if entry.get("id") is not None:
+                # JSON metadata may carry numeric ids; ids are strings
+                # everywhere downstream (routing hash, doc tables)
+                entry["id"] = str(entry["id"])
             entry.setdefault("index", default_index)
             if entry.get("index") is None:
                 raise IllegalArgumentError("bulk item missing _index")
@@ -852,6 +839,10 @@ def register_indices_actions(node, c):
             svc.settings.update(updates)
             if "number_of_replicas" in updates:
                 svc.num_replicas = int(updates["number_of_replicas"])
+            if "max_result_window" in updates:
+                for shard in svc.shards:
+                    shard.executor.max_result_window = \
+                        int(updates["max_result_window"])
         return {"acknowledged": True}
 
     def do_refresh(req):

@@ -1103,8 +1103,10 @@ class Compiler:
 
     # --------------------------------------------------------- compounds
     def _c_MultiMatchQuery(self, node: dsl.MultiMatchQuery, seg, meta) -> Plan:
-        fields = list(node.fields)
+        fields = self.mapper.expand_field_patterns(list(node.fields))
         if not fields:
+            if any("*" in f for f in node.fields):
+                return MATCH_NONE       # pattern matched no mapped field
             raise ParsingError("[multi_match] requires fields")
         subs = []
         for fspec in fields:

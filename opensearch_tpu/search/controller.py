@@ -192,6 +192,17 @@ def execute_search(executors: List, body: Optional[dict],
     if size < 0 or from_ < 0:
         raise IllegalArgumentError("[from] parameter cannot be negative" if from_ < 0
                 else "[size] parameter cannot be negative")
+    # index.max_result_window (SearchService#validateSearchSource): deep
+    # from+size pagination must use scroll/search_after-with-paging
+    window = min((getattr(ex, "max_result_window", 10000)
+                  for ex in executors), default=10000)
+    if from_ + size > window and cursor_tiebreak is None:
+        raise IllegalArgumentError(
+            f"Result window is too large, from + size must be less than "
+            f"or equal to: [{window}] but was [{from_ + size}]. See the "
+            f"scroll api for a more efficient way to request large data "
+            f"sets. This limit can be set by changing the "
+            f"[index.max_result_window] index level setting.")
 
     sort_specs = _parse_sort(body.get("sort"))
     score_sorted = sort_specs[0][0] == "_score"

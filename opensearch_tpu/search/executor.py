@@ -547,6 +547,9 @@ class SearchExecutor:
 
     def __init__(self, reader: ShardReader):
         self.reader = reader
+        # index.max_result_window (set by the owning IndexService; the
+        # default matches the reference)
+        self.max_result_window = 10000
 
     def search(self, body: Optional[dict] = None,
                _direct: bool = False) -> dict:
@@ -725,6 +728,14 @@ class SearchExecutor:
                 raise IllegalArgumentError(
                     "[from] parameter cannot be negative" if from_ < 0
                 else "[size] parameter cannot be negative")
+            if from_ + size > self.max_result_window:
+                raise IllegalArgumentError(
+                    f"Result window is too large, from + size must be "
+                    f"less than or equal to: [{self.max_result_window}] "
+                    f"but was [{from_ + size}]. See the scroll api for a "
+                    f"more efficient way to request large data sets. This "
+                    f"limit can be set by changing the "
+                    f"[index.max_result_window] index level setting.")
             min_score = float(body["min_score"]) \
                 if body.get("min_score") is not None else NEG_INF
             batchable.append((i, body, node, size, from_, min_score))

@@ -65,7 +65,7 @@ def collect_field_terms(node, mapper) -> Dict[str, List[str]]:
             add(n.field, analyze(n.field, n.query))
             return
         if isinstance(n, dsl.MultiMatchQuery):
-            for f in n.fields:
+            for f in mapper.expand_field_patterns(list(n.fields)):
                 f = f.split("^")[0]
                 add(f, analyze(f, n.query))
             return
@@ -75,7 +75,11 @@ def collect_field_terms(node, mapper) -> Dict[str, List[str]]:
         if isinstance(n, dsl.TermsQuery):
             add(n.field, [str(v) for v in n.values])
             return
-        if isinstance(n, (dsl.PrefixQuery, dsl.FuzzyQuery)):
+        if isinstance(n, dsl.PrefixQuery):
+            # trailing-* marker: highlight_text prefix-matches these
+            add(n.field, [str(n.value) + "*"])
+            return
+        if isinstance(n, dsl.FuzzyQuery):
             add(n.field, [str(n.value)])
             return
         if isinstance(n, (dsl.QueryStringQuery, dsl.SimpleQueryStringQuery)):
@@ -108,12 +112,14 @@ def highlight_text(text: str, terms: List[str], pre: str, post: str,
                    analyzer) -> List[str]:
     """Unified-highlighter analog: analyze the stored text, mark offsets of
     matching terms, cut fragments around matches."""
-    term_set = set(terms)
+    term_set = {t for t in terms if not t.endswith("*")}
+    prefixes = tuple(t[:-1] for t in terms if t.endswith("*") and len(t) > 1)
     matches: List[Tuple[int, int]] = []
     for m in _TOKEN_RE.finditer(text):
         raw = m.group(0)
         analyzed = analyzer.analyze(raw) if analyzer else [(raw.lower(), 0)]
-        if any(t in term_set for t, _ in analyzed):
+        if any(t in term_set or (prefixes and t.startswith(prefixes))
+               for t, _ in analyzed):
             matches.append((m.start(), m.end()))
     if not matches:
         return []
@@ -161,14 +167,43 @@ def build_highlights(source: Optional[dict], hl_body: dict, field_terms,
     pre = (hl_body.get("pre_tags") or ["<em>"])[0]
     post = (hl_body.get("post_tags") or ["</em>"])[0]
     out = {}
-    for field, spec in (hl_body.get("fields") or {}).items():
+    for field_spec, spec in (hl_body.get("fields") or {}).items():
         spec = spec or {}
+        # wildcard highlight fields expand to the fields the query
+        # actually matched (the reference's HighlightPhase field
+        # resolution over wildcard patterns)
+        if "*" in field_spec:
+            import fnmatch as _fn
+            targets = [f for f in field_terms
+                       if _fn.fnmatchcase(f, field_spec)]
+        else:
+            targets = [field_spec]
+        for field in targets:
+            _highlight_one(source, field, spec, hl_body, field_terms,
+                           mapper, pre, post, out)
+    return out
+
+
+def _highlight_one(source, field, spec, hl_body, field_terms, mapper,
+                   pre, post, out):
+        hq = spec.get("highlight_query") or hl_body.get("highlight_query")
+        if hq is not None:
+            # highlight with a DIFFERENT query's terms (the reference's
+            # highlight_query override, HighlightBuilder#highlightQuery)
+            try:
+                field_terms = collect_field_terms(dsl.parse_query(hq),
+                                                  mapper)
+            except Exception:
+                field_terms = {}
         terms = field_terms.get(field, [])
         if not terms:
-            continue
+            return
         value = _source_value(source, field)
+        if value is None and "." in field:
+            # multi-fields (text.fvh) read their parent's source value
+            value = _source_value(source, field.rsplit(".", 1)[0])
         if value is None:
-            continue
+            return
         ft = mapper.get_field(field)
         analyzer = None
         if ft is not None and ft.is_text:
@@ -185,7 +220,6 @@ def build_highlights(source: Optional[dict], hl_body: dict, field_terms,
             analyzer=analyzer)
         if frags:
             out[field] = frags
-    return out
 
 
 def _source_value(source: dict, path: str):

@@ -48,7 +48,9 @@ def _pin_executors(node, index_expr):
         svc = node.indices.get(name)
         alias_filter = node.indices.alias_filter(index_expr or "", name)
         for shard in svc.shards:
-            executors.append(SearchExecutor(PinnedReader(shard.executor.reader)))
+            pinned = SearchExecutor(PinnedReader(shard.executor.reader))
+            pinned.max_result_window = shard.executor.max_result_window
+            executors.append(pinned)
             filters.append(alias_filter)
     return executors, filters
 

@@ -62,6 +62,7 @@ CURATED = [
     "index/10_with_id.yml",
     "index/12_result.yml",
     "indices.exists_template/10_basic.yml",
+    "indices.update_aliases/10_basic.yml",
     "info/10_info.yml",
     "mlt/10_basic.yml",
     "mlt/20_docs.yml",
@@ -69,12 +70,14 @@ CURATED = [
     "ping/10_ping.yml",
     "range/10_basic.yml",
     "scroll/10_basic.yml",
+    "search.highlight/10_unified.yml",
     "search/20_default_values.yml",
     "search/200_index_phrase_search.yml",
     "search/issue4895.yml",
     "suggest/10_basic.yml",
     "update/10_doc.yml",
     "update/20_doc_upsert.yml",
+    "update/90_error.yml",
     "update/22_doc_as_upsert.yml",
     "update/11_shard_header.yml",
     "update/13_legacy_doc.yml",
