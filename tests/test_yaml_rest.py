@@ -21,6 +21,7 @@ from opensearch_tpu.node import Node
 
 CURATED = [
     "bulk/30_big_string.yml",
+    "bulk/80_cas.yml",
     "bulk/50_refresh.yml",
     "cat.aliases/30_json.yml",
     "create/10_with_id.yml",
@@ -31,6 +32,8 @@ CURATED = [
     "delete/30_routing.yml",
     "delete/60_missing.yml",
     "count/10_basic.yml",
+    "exists/10_basic.yml",
+    "exists/40_routing.yml",
     "exists/70_defaults.yml",
     "explain/10_basic.yml",
     "get/10_basic.yml",
@@ -60,10 +63,17 @@ CURATED = [
     "indices.split/20_source_mapping.yml",
     "indices.validate_query/20_query_string.yml",
     "index/10_with_id.yml",
+    "index/70_require_alias.yml",
     "index/12_result.yml",
+    "indices.exists/10_basic.yml",
+    "indices.exists/20_read_only_index.yml",
+    "indices.exists_alias/10_basic.yml",
     "indices.exists_template/10_basic.yml",
+    "indices.put_alias/10_basic.yml",
     "indices.update_aliases/10_basic.yml",
     "info/10_info.yml",
+    "mget/10_basic.yml",
+    "mget/17_default_index.yml",
     "mlt/10_basic.yml",
     "mlt/20_docs.yml",
     "msearch/11_status.yml",
@@ -72,10 +82,13 @@ CURATED = [
     "scroll/10_basic.yml",
     "search.highlight/10_unified.yml",
     "search/20_default_values.yml",
+    "search.aggregation/260_weighted_avg.yml",
     "search/200_index_phrase_search.yml",
     "search/issue4895.yml",
     "suggest/10_basic.yml",
     "update/10_doc.yml",
+    "update/12_result.yml",
+    "update/35_if_seq_no.yml",
     "update/20_doc_upsert.yml",
     "update/90_error.yml",
     "update/22_doc_as_upsert.yml",
