@@ -46,6 +46,16 @@ def ensure_backend():
     The probe costs one extra backend init (~20-40s on a healthy TPU); the
     bench runs once per round, so robustness wins over that overhead.
     """
+    if os.environ.get("BENCH_SKIP_PROBE") == "1":
+        _BACKEND_DIAG.append("probe skipped (parent verified backend)")
+        return
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        # parent bench already probed and fell back; children skip the
+        # (sitecustomize-pinned, possibly hung) tunnel probe entirely
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        _BACKEND_DIAG.append("forced cpu (parent fallback)")
+        return
     probe = "import jax; d=jax.devices(); print(d[0].platform)"
     timeouts = tuple(int(t) for t in os.environ.get(
         "BENCH_PROBE_TIMEOUTS", "300,120").split(","))
@@ -366,6 +376,70 @@ def main():
     if _BACKEND_DIAG:
         out["backend_diag"] = "; ".join(_BACKEND_DIAG)
     print(json.dumps(out))
+    sys.stdout.flush()
+    try:
+        # best-effort extra output: it must never break the one-line
+        # stdout + rc contract of the primary measurement
+        _run_extra_configs()
+    except Exception:
+        pass
+
+
+def _run_extra_configs():
+    """BASELINE configs 2-5 (bool+terms-agg, date_histogram+cardinality,
+    exact knn, IVF knn) run as subprocesses AFTER the primary line is out
+    (the driver's contract is one stdout JSON line; the full set lands in
+    BENCH_ALL.json, one line per config). Each child skips the backend
+    probe when this process already fell back to CPU."""
+    if os.environ.get("BENCH_SKIP_EXTRA") == "1" \
+            or os.environ.get("BENCH_MODE"):
+        return
+    import subprocess
+
+    import jax
+    child_env = dict(os.environ)
+    if jax.devices()[0].platform == "cpu":
+        # sitecustomize pins the tunnel platform regardless of env vars:
+        # children must be TOLD to skip the probe, not just handed
+        # JAX_PLATFORMS (see ensure_backend's note)
+        child_env["BENCH_FORCE_CPU"] = "1"
+    else:
+        # the parent's probe already passed: children keep the default
+        # backend without re-probing (BENCH_SKIP_PROBE)
+        child_env["BENCH_SKIP_PROBE"] = "1"
+    # children run at HALF shapes so the whole set fits a bench budget;
+    # vs_baseline stays meaningful (the baseline shrinks identically)
+    child_env.setdefault("BENCH_DOCS", "50000")
+    child_env.setdefault("BENCH_AGG_QUERIES", "32")
+    child_env.setdefault("BENCH_KNN_DOCS", "50000")
+    child_env.setdefault("BENCH_KNN_QUERIES", "64")
+    budget = float(os.environ.get("BENCH_EXTRA_BUDGET", "600"))
+    t_start = time.perf_counter()
+    records = []
+    for mode in ("agg_terms", "date_hist", "knn_exact", "knn_ivf"):
+        remaining = budget - (time.perf_counter() - t_start)
+        if remaining < 30:
+            records.append({"metric": mode, "error": "extra budget spent"})
+            continue
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env={**child_env, "BENCH_MODE": mode},
+                capture_output=True, text=True,
+                timeout=min(300, remaining))
+            lines = [ln for ln in (r.stdout or "").strip().splitlines()
+                     if ln.startswith("{")]
+            rec = (json.loads(lines[-1]) if lines else
+                   {"error": (r.stderr or "no output")[-200:]})
+            rec.setdefault("mode", mode)   # keep attribution even when a
+            records.append(rec)            # child emitted bench_error
+        except Exception as e:  # timeout/parse: record and continue
+            records.append({"mode": mode, "error": str(e)[:200]})
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_ALL.json")
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
 
 
 if __name__ == "__main__":

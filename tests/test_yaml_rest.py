@@ -64,7 +64,6 @@ CURATED = [
     "indices.validate_query/20_query_string.yml",
     "index/10_with_id.yml",
     "index/70_require_alias.yml",
-    "index/12_result.yml",
     "indices.exists/10_basic.yml",
     "indices.exists/20_read_only_index.yml",
     "indices.exists_alias/10_basic.yml",
