@@ -419,7 +419,7 @@ def _run_extra_configs():
     for mode in ("agg_terms", "date_hist", "knn_exact", "knn_ivf"):
         remaining = budget - (time.perf_counter() - t_start)
         if remaining < 30:
-            records.append({"metric": mode, "error": "extra budget spent"})
+            records.append({"mode": mode, "error": "extra budget spent"})
             continue
         try:
             r = subprocess.run(

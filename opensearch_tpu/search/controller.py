@@ -360,11 +360,16 @@ def execute_search(executors: List, body: Optional[dict],
                 max_score = c.score
 
     query_node = dsl.parse_query(body.get("query"))
+    from opensearch_tpu.search import fetch as fetch_phase
+    page_inner_specs = fetch_phase.collect_inner_hit_specs(query_node)
+    page_inner_cache: dict = {}
     hits = []
     for c in page:
         ex = executors[c.shard_i]
         hit = _build_hit(ex, c, body, c.score if wants_score else None,
-                         query_node, sort_specs, score_sorted)
+                         query_node, sort_specs, score_sorted,
+                         inner_specs=page_inner_specs,
+                         inner_cache=page_inner_cache)
         hits.append(hit)
 
     n_shards = total_shards if total_shards is not None else len(executors)
@@ -424,7 +429,7 @@ def _default_script_service():
 
 
 def _build_hit(ex, c, body, score, query_node, sort_specs,
-               score_sorted) -> dict:
+               score_sorted, inner_specs=None, inner_cache=None) -> dict:
     from opensearch_tpu.search import fetch as fetch_phase
 
     hit = ex._hit_dict(c.seg_i, c.ord, score, body)
@@ -461,4 +466,12 @@ def _build_hit(ex, c, body, score, query_node, sort_specs,
     if body.get("version"):
         hit["_version"] = getattr(seg, "versions", {}).get(c.ord, 1) \
             if hasattr(seg, "versions") else 1
+    nested_specs = inner_specs if inner_specs is not None \
+        else fetch_phase.collect_inner_hit_specs(query_node)
+    if nested_specs:
+        # request-scoped eval cache: never shared across requests (stats
+        # and segments may move between them)
+        cache = inner_cache if inner_cache is not None else {}
+        hit["inner_hits"] = fetch_phase.build_inner_hits(
+            ex, c.seg_i, c.ord, nested_specs, cache)
     return hit

@@ -106,6 +106,7 @@ class NestedQuery(QueryNode):
     query: Optional["QueryNode"] = None
     score_mode: str = "avg"          # avg | sum | min | max | none
     ignore_unmapped: bool = False
+    inner_hits: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -522,11 +523,15 @@ def parse_query(q: Any) -> QueryNode:
     if name == "nested":
         if "path" not in body or "query" not in body:
             raise ParsingError("[nested] requires [path] and [query]")
+        if body.get("inner_hits") is not None and \
+                not isinstance(body["inner_hits"], dict):
+            raise ParsingError("[inner_hits] must be an object")
         return NestedQuery(path=body["path"],
                            query=parse_query(body["query"]),
                            score_mode=str(body.get("score_mode", "avg")),
                            ignore_unmapped=bool(body.get("ignore_unmapped",
                                                          False)),
+                           inner_hits=body.get("inner_hits"),
                            boost=float(body.get("boost", 1.0)))
 
     if name == "has_child":

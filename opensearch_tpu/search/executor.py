@@ -537,9 +537,21 @@ _BATCHABLE_KEYS = frozenset({"query", "size", "from", "min_score", "sort",
                              "_source"})
 
 
+def _contains_inner_hits(obj) -> bool:
+    if isinstance(obj, dict):
+        return "inner_hits" in obj or any(_contains_inner_hits(v)
+                                          for v in obj.values())
+    if isinstance(obj, list):
+        return any(_contains_inner_hits(v) for v in obj)
+    return False
+
+
 def _msearch_batchable(body: dict) -> bool:
     return (set(body) <= _BATCHABLE_KEYS
-            and body.get("sort") in (None, "_score", ["_score"]))
+            and body.get("sort") in (None, "_score", ["_score"])
+            # inner_hits need the full fetch sub-phase pipeline, which
+            # the batched envelope's _hit_dict does not run
+            and not _contains_inner_hits(body.get("query")))
 
 
 class SearchExecutor:

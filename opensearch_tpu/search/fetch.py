@@ -324,3 +324,130 @@ def docvalue_fields(seg: Segment, ord_: int, specs: List[Any],
             if len(ords):
                 out[field] = [ocol.dictionary[o] for o in ords]
     return out
+
+
+# --------------------------------------------------------------- inner hits
+#
+# Nested inner_hits (index/query/InnerHitBuilder + fetch/subphase/
+# InnerHitsPhase): for each page hit, return the CHILD rows that matched
+# the nested query, scored and paged. The child-level plan (the nested
+# query's inner query compiled WITHOUT the root join) is evaluated once
+# per (segment, query) on device; per-hit work is then a host-side slice
+# of that dense result over the root's own child rows.
+
+_INNER_JIT: Dict[Any, Any] = {}
+
+
+def _eval_child_scores(plan, arrays):
+    import jax
+    import jax.numpy as jnp
+
+    from opensearch_tpu.search.plan_eval import _eval_plan
+    sig = ("inner_hits", plan.sig())
+    fn = _INNER_JIT.get(sig)
+    if fn is None:
+        def run(seg, flat, _plan=plan):
+            cursor = [0]
+            return _eval_plan(_plan, seg, flat, cursor)
+        fn = _INNER_JIT[sig] = jax.jit(run)
+    flat = jax.tree_util.tree_map(jnp.asarray, plan.flatten_inputs([]))
+    scores, matches = jax.device_get(fn(arrays, flat))
+    return np.asarray(scores), np.asarray(matches)
+
+
+def collect_inner_hit_specs(node) -> List[Any]:
+    """Every NestedQuery carrying an inner_hits spec in the tree."""
+    from dataclasses import fields as dc_fields
+    out: List[Any] = []
+
+    def walk(n):
+        if isinstance(n, dsl.NestedQuery) and n.inner_hits is not None:
+            out.append(n)
+        for f in dc_fields(n):
+            sub = getattr(n, f.name, None)
+            if isinstance(sub, dsl.QueryNode):
+                walk(sub)
+            elif isinstance(sub, (list, tuple)):
+                for s in sub:
+                    if isinstance(s, dsl.QueryNode):
+                        walk(s)
+
+    if node is not None:
+        walk(node)
+    return out
+
+
+def build_inner_hits(ex, seg_i: int, root_ord: int, nested_nodes,
+                     cache: Dict) -> Dict[str, dict]:
+    """inner_hits sections for one page hit. `cache` memoizes the per-
+    (segment, nested node) child evaluation across the page's hits."""
+    from opensearch_tpu.search.compile import Compiler
+    seg = ex.reader.segments[seg_i]
+    arrays, meta = ex.reader.device[seg_i]
+    out: Dict[str, dict] = {}
+    for node in nested_nodes:
+        spec = node.inner_hits or {}
+        name = spec.get("name", node.path)
+        # every REQUESTED section appears, even with zero matching
+        # children (the reference returns an empty hits array, not a
+        # missing key — clients index hit["inner_hits"][name] directly)
+        empty = {"hits": {"total": {"value": 0, "relation": "eq"},
+                          "max_score": None, "hits": []}}
+        try:
+            pord = seg.nested_paths.index(node.path)
+        except ValueError:
+            out[name] = empty           # segment has no rows on this path
+            continue
+        key = (seg.uid, repr(node.query))   # repr = stable fingerprint
+        got = cache.get(key)
+        if got is None:
+            compiler = Compiler(ex.reader.mapper, ex.reader.stats())
+            plan = compiler.compile(node.query, seg, meta)
+            if len(cache) > 256:
+                cache.clear()
+            got = cache[key] = _eval_child_scores(plan, arrays)
+        scores, matches = got
+        rows = np.nonzero((seg.parent_ptr == root_ord)
+                          & (seg.path_ords == pord) & seg.live)[0]
+        hit_rows = rows[matches[rows]] if len(rows) else rows
+        if not len(hit_rows):
+            out[name] = empty
+            continue
+        # offsets index the parent's source array in row order
+        offset_of = {int(r): i for i, r in enumerate(rows)}
+        order = np.argsort(-scores[hit_rows], kind="stable")
+        size = int(spec.get("size", 3))
+        from_ = int(spec.get("from", 0))
+        page = [int(hit_rows[j]) for j in order][from_:from_ + size]
+        src_parent = _source_value_raw(seg.sources[root_ord], node.path)
+        hits = []
+        for r in page:
+            off = offset_of[r]
+            child_src = (src_parent[off]
+                         if isinstance(src_parent, list)
+                         and off < len(src_parent) else src_parent)
+            hits.append({
+                "_index": ex.reader.index_name,
+                "_id": seg.doc_ids[root_ord],
+                "_nested": {"field": node.path, "offset": off},
+                "_score": float(scores[r]),
+                "_source": child_src,
+            })
+        out[name] = {"hits": {
+            "total": {"value": int(len(hit_rows)), "relation": "eq"},
+            "max_score": float(scores[hit_rows].max()),
+            "hits": hits,
+        }}
+    return out
+
+
+def _source_value_raw(source, path: str):
+    """Navigate dotted paths WITHOUT flattening lists (inner hits need the
+    raw nested array to index by offset)."""
+    cur = source
+    for part in path.split("."):
+        if isinstance(cur, dict) and part in cur:
+            cur = cur[part]
+        else:
+            return None
+    return cur

@@ -766,3 +766,31 @@ class TestClusterReroute:
             "commands": [{"cancel": {"index": "rp", "shard": 0,
                                      "node": holder}}]})
         assert res.get("_status") == 400    # primary needs allow_primary
+
+
+class TestInnerHitsDistributed:
+    def test_inner_hits_over_transport(self, cluster):
+        node = next(iter(cluster.values()))
+        node.request("PUT", "/nb", {
+            "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+            "mappings": {"properties": {
+                "t": {"type": "text"},
+                "cs": {"type": "nested", "properties": {
+                    "a": {"type": "keyword"},
+                    "x": {"type": "text"}}}}}})
+        node.await_health("green", timeout=30)
+        for i in range(6):
+            node.request("PUT", f"/nb/_doc/n{i}", {
+                "t": f"doc {i}",
+                "cs": [{"a": "hit", "x": "wanted term"},
+                       {"a": "miss", "x": "other stuff"}]})
+        node.request("POST", "/nb/_refresh")
+        res = node.request("POST", "/nb/_search", {"query": {"nested": {
+            "path": "cs", "query": {"match": {"cs.x": "wanted"}},
+            "inner_hits": {}}}, "size": 10})
+        assert res["hits"]["total"]["value"] == 6
+        for h in res["hits"]["hits"]:
+            ih = h["inner_hits"]["cs"]["hits"]
+            assert ih["total"]["value"] == 1
+            assert ih["hits"][0]["_source"]["a"] == "hit"
+            assert ih["hits"][0]["_nested"]["offset"] == 0

@@ -206,3 +206,66 @@ class TestNestedAggs:
         roots = {b["key"]: b["roots"]["doc_count"] for b in by_author}
         # alice commented on 2 distinct posts, bob on 1
         assert roots == {"alice": 2, "bob": 1}
+
+
+class TestInnerHits:
+    @pytest.fixture()
+    def node(self):
+        from opensearch_tpu.node import Node
+        n = Node()
+        n.request("PUT", "/blog", {"mappings": {"properties": {
+            "title": {"type": "text"},
+            "comments": {"type": "nested", "properties": {
+                "author": {"type": "keyword"},
+                "stars": {"type": "integer"},
+                "text": {"type": "text"}}}}}})
+        n.request("PUT", "/blog/_doc/1", {
+            "title": "post one",
+            "comments": [
+                {"author": "alice", "stars": 5, "text": "great post"},
+                {"author": "bob", "stars": 2, "text": "meh"},
+                {"author": "carol", "stars": 4, "text": "great insight"},
+            ]})
+        n.request("PUT", "/blog/_doc/2", {
+            "title": "post two",
+            "comments": [{"author": "bob", "stars": 5,
+                          "text": "great thread"}]})
+        n.request("POST", "/blog/_refresh")
+        return n
+
+    def test_inner_hits_returns_matching_children(self, node):
+        res = node.request("POST", "/blog/_search", {"query": {"nested": {
+            "path": "comments",
+            "query": {"match": {"comments.text": "great"}},
+            "inner_hits": {}}}})
+        assert res["hits"]["total"]["value"] == 2
+        by_id = {h["_id"]: h for h in res["hits"]["hits"]}
+        ih1 = by_id["1"]["inner_hits"]["comments"]["hits"]
+        assert ih1["total"]["value"] == 2
+        authors = {h["_source"]["author"] for h in ih1["hits"]}
+        assert authors == {"alice", "carol"}
+        for h in ih1["hits"]:
+            assert h["_nested"]["field"] == "comments"
+            assert h["_id"] == "1"
+        offs = {h["_source"]["author"]: h["_nested"]["offset"]
+                for h in ih1["hits"]}
+        assert offs == {"alice": 0, "carol": 2}
+        ih2 = by_id["2"]["inner_hits"]["comments"]["hits"]
+        assert ih2["total"]["value"] == 1
+        assert ih2["hits"][0]["_source"]["author"] == "bob"
+
+    def test_inner_hits_size_and_name(self, node):
+        res = node.request("POST", "/blog/_search", {"query": {"nested": {
+            "path": "comments",
+            "query": {"range": {"comments.stars": {"gte": 2}}},
+            "inner_hits": {"size": 1, "name": "top_comment"}}}})
+        by_id = {h["_id"]: h for h in res["hits"]["hits"]}
+        ih = by_id["1"]["inner_hits"]["top_comment"]["hits"]
+        assert ih["total"]["value"] == 3    # all matched
+        assert len(ih["hits"]) == 1        # paged to size 1
+
+    def test_no_inner_hits_key_without_request(self, node):
+        res = node.request("POST", "/blog/_search", {"query": {"nested": {
+            "path": "comments",
+            "query": {"match": {"comments.text": "great"}}}}})
+        assert all("inner_hits" not in h for h in res["hits"]["hits"])
