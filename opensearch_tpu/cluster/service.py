@@ -1290,12 +1290,20 @@ class ClusterNode:
         score_sorted = sort_specs[0][0] == "_score"
         query_node = dsl.parse_query(body.get("query"))
         wants_score = score_sorted or bool(body.get("track_scores"))
+        # page-scoped inner-hits context: spec collection and the child
+        # evaluation cache amortize over this fetch page, same as the
+        # single-node controller
+        from opensearch_tpu.search import fetch as fetch_phase
+        inner_specs = fetch_phase.collect_inner_hit_specs(query_node)
+        inner_cache: dict = {}
         hits = []
         for score, seg_i, ord_, sort_values in payload["docs"]:
             c = _Candidate(score, seg_i, ord_, sort_values)
             hit = _build_hit(shard.executor, c, body,
                              score if wants_score else None,
-                             query_node, sort_specs, score_sorted)
+                             query_node, sort_specs, score_sorted,
+                             inner_specs=inner_specs,
+                             inner_cache=inner_cache)
             hits.append(hit)
         return {"hits": Opaque(hits)}
 

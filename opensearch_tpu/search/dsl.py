@@ -118,6 +118,7 @@ class HasChildQuery(QueryNode):
     min_children: int = 1
     max_children: Optional[int] = None
     ignore_unmapped: bool = False
+    inner_hits: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -126,6 +127,7 @@ class HasParentQuery(QueryNode):
     query: Optional["QueryNode"] = None
     score: bool = False
     ignore_unmapped: bool = False
+    inner_hits: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -423,6 +425,15 @@ def _as_list(nodes) -> list:
     return [parse_query(nodes)]
 
 
+
+
+def _parse_inner_hits(body) -> Optional[Dict[str, Any]]:
+    ih = body.get("inner_hits")
+    if ih is not None and not isinstance(ih, dict):
+        raise ParsingError("[inner_hits] must be an object")
+    return ih
+
+
 def parse_query(q: Any) -> QueryNode:
     if q is None:
         return MatchAllQuery()
@@ -523,21 +534,19 @@ def parse_query(q: Any) -> QueryNode:
     if name == "nested":
         if "path" not in body or "query" not in body:
             raise ParsingError("[nested] requires [path] and [query]")
-        if body.get("inner_hits") is not None and \
-                not isinstance(body["inner_hits"], dict):
-            raise ParsingError("[inner_hits] must be an object")
         return NestedQuery(path=body["path"],
                            query=parse_query(body["query"]),
                            score_mode=str(body.get("score_mode", "avg")),
                            ignore_unmapped=bool(body.get("ignore_unmapped",
                                                          False)),
-                           inner_hits=body.get("inner_hits"),
+                           inner_hits=_parse_inner_hits(body),
                            boost=float(body.get("boost", 1.0)))
 
     if name == "has_child":
         if "type" not in body or "query" not in body:
             raise ParsingError("[has_child] requires [type] and [query]")
         return HasChildQuery(type=body["type"],
+                             inner_hits=_parse_inner_hits(body),
                              query=parse_query(body["query"]),
                              score_mode=str(body.get("score_mode", "none")),
                              min_children=int(body.get("min_children", 1)),
@@ -553,6 +562,7 @@ def parse_query(q: Any) -> QueryNode:
             raise ParsingError(
                 "[has_parent] requires [parent_type] and [query]")
         return HasParentQuery(type=body["parent_type"],
+                              inner_hits=_parse_inner_hits(body),
                               query=parse_query(body["query"]),
                               score=bool(body.get("score", False)),
                               ignore_unmapped=bool(

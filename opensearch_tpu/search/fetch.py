@@ -356,12 +356,15 @@ def _eval_child_scores(plan, arrays):
 
 
 def collect_inner_hit_specs(node) -> List[Any]:
-    """Every NestedQuery carrying an inner_hits spec in the tree."""
+    """Every nested/has_child/has_parent query carrying an inner_hits
+    spec in the tree."""
     from dataclasses import fields as dc_fields
     out: List[Any] = []
 
     def walk(n):
-        if isinstance(n, dsl.NestedQuery) and n.inner_hits is not None:
+        if isinstance(n, (dsl.NestedQuery, dsl.HasChildQuery,
+                          dsl.HasParentQuery)) and \
+                n.inner_hits is not None:
             out.append(n)
         for f in dc_fields(n):
             sub = getattr(n, f.name, None)
@@ -374,6 +377,13 @@ def collect_inner_hit_specs(node) -> List[Any]:
 
     if node is not None:
         walk(node)
+    names = [(n.inner_hits or {}).get(
+        "name", n.path if isinstance(n, dsl.NestedQuery) else n.type)
+        for n in out]
+    for name in names:
+        if names.count(name) > 1:
+            raise IllegalArgumentError(
+                f"[inner_hits] already contains an entry for key [{name}]")
     return out
 
 
@@ -386,6 +396,9 @@ def build_inner_hits(ex, seg_i: int, root_ord: int, nested_nodes,
     arrays, meta = ex.reader.device[seg_i]
     out: Dict[str, dict] = {}
     for node in nested_nodes:
+        if isinstance(node, (dsl.HasChildQuery, dsl.HasParentQuery)):
+            _join_inner_hits(ex, seg, seg_i, root_ord, node, cache, out)
+            continue
         spec = node.inner_hits or {}
         name = spec.get("name", node.path)
         # every REQUESTED section appears, even with zero matching
@@ -451,3 +464,96 @@ def _source_value_raw(source, path: str):
         else:
             return None
     return cur
+
+
+def _join_inner_hits(ex, seg, seg_i: int, root_ord: int, node, cache,
+                     out: Dict[str, dict]):
+    """has_child/has_parent inner_hits (parent-join InnerHitContextBuilder):
+    children/parents are ROOT documents related through the join field's
+    hidden parent-id column, joined host-side across the shard's segments
+    (the reference joins via global ordinals)."""
+    from opensearch_tpu.search.compile import Compiler
+    spec = node.inner_hits or {}
+    name = spec.get("name", node.type)
+    empty = {"hits": {"total": {"value": 0, "relation": "eq"},
+                      "max_score": None, "hits": []}}
+    ckey = ("join_ctx", ex.reader.index_name)
+    ctx = cache.get(ckey)
+    if ctx is None:
+        compiler = Compiler(ex.reader.mapper, ex.reader.stats())
+        info = compiler._join_info()
+        ctx = cache[ckey] = {"compiler": compiler, "info": info}
+    compiler, info = ctx["compiler"], ctx["info"]
+    if info is None:
+        out[name] = empty
+        return
+    join, _relations = info
+
+    def seg_ctx(s):
+        key = ("join_cols", s.uid)
+        got = cache.get(key)
+        if got is None:
+            got = cache[key] = compiler._join_columns(s, join)
+        return got
+
+    def match_mask(s):
+        key = ("join_match", s.uid, repr(node.query))
+        got = cache.get(key)
+        if got is None:
+            got = cache[key] = compiler._host_match(s, node.query)
+        return got
+
+    def children_by_parent():
+        """parent_id → [(segment, ord)] of matching live children —
+        computed ONCE per (shard, query) and reused across the page."""
+        key = ("join_children", repr(node.query), node.type)
+        got = cache.get(key)
+        if got is None:
+            got = {}
+            for s in ex.reader.segments:
+                rel, par = seg_ctx(s)
+                mask = match_mask(s)
+                cand = np.nonzero(mask & s.live[:s.num_docs])[0] \
+                    if len(mask) else []
+                for d in cand:
+                    d = int(d)
+                    if rel[d] == node.type and par[d] is not None:
+                        got.setdefault(par[d], []).append((s, d))
+            cache[key] = got
+        return got
+
+    doc_id = seg.doc_ids[root_ord]
+    hits = []
+    total = 0
+    if isinstance(node, dsl.HasChildQuery):
+        # this hit is the PARENT: gather its matching children
+        size = int(spec.get("size", 3))
+        from_ = int(spec.get("from", 0))
+        kids = children_by_parent().get(doc_id, [])
+        total = len(kids)
+        hits = [{"_index": ex.reader.index_name, "_id": s2.doc_ids[d],
+                 "_score": 1.0, "_source": s2.sources[d]}
+                for s2, d in kids[from_:from_ + size]]
+    else:
+        # this hit is the CHILD: resolve its single parent
+        size = int(spec.get("size", 3))
+        from_ = int(spec.get("from", 0))
+        rel, par = seg_ctx(seg)
+        parent_id = par[root_ord]
+        if parent_id is not None:
+            for s in ex.reader.segments:
+                srel, _ = seg_ctx(s)
+                ord_ = s.ord_of(parent_id)
+                if ord_ is not None and srel[ord_] == node.type \
+                        and match_mask(s)[ord_]:
+                    total = 1
+                    hits = [{"_index": ex.reader.index_name,
+                             "_id": parent_id, "_score": 1.0,
+                             "_source": s.sources[ord_]}]
+                    break
+        hits = hits[from_:from_ + size]   # paging applies here too
+    if not total:
+        out[name] = empty
+        return
+    out[name] = {"hits": {"total": {"value": total, "relation": "eq"},
+                          "max_score": 1.0, "hits": hits}}

@@ -269,3 +269,58 @@ class TestInnerHits:
             "path": "comments",
             "query": {"match": {"comments.text": "great"}}}}})
         assert all("inner_hits" not in h for h in res["hits"]["hits"])
+
+
+class TestJoinInnerHits:
+    @pytest.fixture()
+    def node(self):
+        from opensearch_tpu.node import Node
+        n = Node()
+        n.request("PUT", "/qa", {"mappings": {"properties": {
+            "jf": {"type": "join", "relations": {"question": "answer"}},
+            "title": {"type": "text"}, "body": {"type": "text"}}}})
+        n.request("PUT", "/qa/_doc/q1", {"jf": "question",
+                                         "title": "how to fly"})
+        n.request("PUT", "/qa/_doc/q2", {"jf": "question",
+                                         "title": "how to swim"})
+        for i, (q, b) in enumerate([("q1", "flap your wings"),
+                                    ("q1", "buy a ticket"),
+                                    ("q2", "kick your legs")]):
+            n.request("PUT", f"/qa/_doc/a{i}",
+                      {"jf": {"name": "answer", "parent": q},
+                       "body": b}, routing=q)
+        n.request("POST", "/qa/_refresh")
+        return n
+
+    def test_has_child_inner_hits(self, node):
+        res = node.request("POST", "/qa/_search", {"query": {"has_child": {
+            "type": "answer", "query": {"match_all": {}},
+            "inner_hits": {}}}, "size": 10})
+        assert res["hits"]["total"]["value"] == 2
+        by_id = {h["_id"]: h for h in res["hits"]["hits"]}
+        ih1 = by_id["q1"]["inner_hits"]["answer"]["hits"]
+        assert ih1["total"]["value"] == 2
+        assert {h["_id"] for h in ih1["hits"]} == {"a0", "a1"}
+        ih2 = by_id["q2"]["inner_hits"]["answer"]["hits"]
+        assert ih2["total"]["value"] == 1
+        assert ih2["hits"][0]["_source"]["body"] == "kick your legs"
+
+    def test_has_child_inner_hits_filtered(self, node):
+        res = node.request("POST", "/qa/_search", {"query": {"has_child": {
+            "type": "answer", "query": {"match": {"body": "wings"}},
+            "inner_hits": {"name": "winged"}}}, "size": 10})
+        assert [h["_id"] for h in res["hits"]["hits"]] == ["q1"]
+        ih = res["hits"]["hits"][0]["inner_hits"]["winged"]["hits"]
+        assert ih["total"]["value"] == 1
+        assert ih["hits"][0]["_id"] == "a0"
+
+    def test_has_parent_inner_hits(self, node):
+        res = node.request("POST", "/qa/_search", {"query": {"has_parent": {
+            "parent_type": "question", "query": {"match": {"title": "fly"}},
+            "inner_hits": {}}}, "size": 10})
+        ids = sorted(h["_id"] for h in res["hits"]["hits"])
+        assert ids == ["a0", "a1"]
+        for h in res["hits"]["hits"]:
+            ih = h["inner_hits"]["question"]["hits"]
+            assert ih["total"]["value"] == 1
+            assert ih["hits"][0]["_id"] == "q1"
