@@ -752,24 +752,42 @@ class SearchExecutor:
                 if body.get("min_score") is not None else NEG_INF
             batchable.append((i, body, node, size, from_, min_score))
 
-        # group by plan STRUCTURE + per-segment input SHAPES: shapes are
-        # already power-of-two bucketed by the compiler, so shape-keyed
-        # groups stay few while making each group's stack a plain np.stack
-        # (no padding growth) and its kernel choice (candidate vs dense)
-        # uniform — one packed upload + one device program per group
+        _ph["parse"] += time.monotonic() - _t
+        # ONE wave = ONE device_get for the whole batch. (A two-wave
+        # pipeline that overlaps host work with device compute was
+        # measured: on the tunneled device the second wave's extra
+        # round-trip sync costs more than the overlap saves, and on CPU
+        # the gain was ~2%. The prepare/finish split is kept for
+        # structure, not pipelining.)
+        if batchable:
+            state = self._msearch_prepare(batchable, responses, start)
+            self._msearch_finish(state, responses, start)
+        return {"took": int((time.monotonic() - start) * 1000),
+                "responses": responses}
+
+
+    def _msearch_prepare(self, batchable, responses, start):
+        """Wave half 1: compile + group + stack + pack + DISPATCH (async).
+        Returns the state _msearch_finish consumes.
+
+        Grouping is by plan STRUCTURE + per-segment input SHAPES: shapes
+        are already power-of-two bucketed by the compiler, so shape-keyed
+        groups stay few while making each group's stack a plain np.stack
+        (no padding growth) and its kernel choice (candidate vs dense)
+        uniform — one packed upload + one device program per group. The
+        shape signature uses dtype.num (numpy's dtype.__str__ is slow on
+        this path) and relies on deterministic dict insertion order."""
+        _ph = MSEARCH_PHASES
+        _t = time.monotonic()
         from opensearch_tpu.parallel.distributed import plan_struct
 
         def _flat_shape_sig(flats):
-            # cheap stand-in for _tree_shapes on the hot path: dict
-            # insertion order is deterministic (plans are built by the
-            # same code), and dtype.num avoids numpy's slow dtype.__str__
             return tuple(
                 None if f is None else tuple(
                     (k2, v.shape, v.dtype.num)
                     for d in f for k2, v in d.items())
                 for f in flats)
 
-        _ph["parse"] += time.monotonic() - _t; _t = time.monotonic()
         groups: Dict[Any, List[int]] = {}
         compiled: Dict[int, List[Optional[Plan]]] = {}
         flats_by_i: Dict[int, List[Optional[list]]] = {}
@@ -786,8 +804,8 @@ class SearchExecutor:
                 plans.append(compiler.compile(node, seg, meta))
             compiled[i] = plans
             # no tie overfetch needed: per-segment top-k by score with
-            # doc-asc tie-break (lax.top_k picks the lowest index) merges to
-            # the exact global page for score-sorted queries
+            # doc-asc tie-break (lax.top_k picks the lowest index) merges
+            # to the exact global page for score-sorted queries
             k = max(from_ + size, 10)
             if all(p is None or p.kind == "match_none" for p in plans):
                 # no term matched any segment: answer host-side, zero
@@ -809,13 +827,14 @@ class SearchExecutor:
             groups.setdefault((struct, _flat_shape_sig(flats),
                                min(k, 1 << 16)), []).append(i)
 
-        _ph["compile_group"] += time.monotonic() - _t; _t = time.monotonic()
         entry_by_i = {e[0]: e for e in batchable}
-        # phase 1: dispatch every group × segment program without blocking —
-        # jax dispatch is async, so device work and tunnel transfers overlap.
-        # The batch axis is padded to a power-of-two bucket (dummy rows get
-        # min_score=+inf, matching nothing) so executables are reused across
-        # varying msearch batch sizes.
+        _ph["compile_group"] += time.monotonic() - _t
+        _t = time.monotonic()
+        # dispatch every group × segment program without blocking — jax
+        # dispatch is async, so device work and tunnel transfers overlap.
+        # The batch axis is padded to a power-of-two bucket (dummy rows
+        # get min_score=+inf, matching nothing) so executables are reused
+        # across varying msearch batch sizes.
         pending = []
         for (struct, shape_sig, k_fetch), idxs in groups.items():
             b_pad = pad_bucket(len(idxs), minimum=1)
@@ -838,17 +857,23 @@ class SearchExecutor:
                                       k_seg, layout, treedef)
                 pending.append((idxs, seg_i, k_seg,
                                 fn(arrays, jnp.asarray(buf))))
-
         _ph["stack_pack_dispatch"] += time.monotonic() - _t
+        return {"groups": groups, "entry_by_i": entry_by_i,
+                "pending": pending}
+
+    def _msearch_finish(self, state, responses, start):
+        """Wave half 2: ONE device_get for the wave's outputs (concatenated
+        on device = one transfer round trip), then response building."""
+        _ph = MSEARCH_PHASES
         _t = time.monotonic()
-        # phase 2: collect (vectorized — no per-candidate python objects);
-        # all group×segment outputs are concatenated ON DEVICE and fetched
-        # with ONE device_get = one transfer round trip for the whole
-        # msearch batch
+        groups, entry_by_i, pending = (state["groups"], state["entry_by_i"],
+                                       state["pending"])
         grouped = [i for idxs in groups.values() for i in idxs]
         per_query_segs: Dict[int, List[Tuple[int, np.ndarray, np.ndarray]]] = \
             {i: [] for i in grouped}
         per_query_total: Dict[int, int] = {i: 0 for i in grouped}
+        if not pending:
+            return
         if len(pending) > 1:
             combined = np.asarray(jax.device_get(_concat_rows(
                 tuple(packed for _, _, _, packed in pending))))
@@ -875,20 +900,22 @@ class SearchExecutor:
                 all_scores = np.concatenate([s for _, s, _ in seg_results])
                 all_ords = np.concatenate([o for _, _, o in seg_results])
                 all_segs = np.concatenate(
-                    [np.full(len(s), si, np.int32) for si, s, _ in seg_results])
+                    [np.full(len(s), si, np.int32)
+                     for si, s, _ in seg_results])
                 valid = all_scores > NEG_INF
                 all_scores, all_ords, all_segs = (
                     all_scores[valid], all_ords[valid], all_segs[valid])
                 if len(seg_results) == 1:
-                    # the device's top_k is already score-desc with doc-asc
-                    # tie-break (candidate lanes are doc-sorted; ties pick
-                    # the lowest lane) — the single-segment page is a slice
+                    # the device's top_k is already score-desc with
+                    # doc-asc tie-break (candidate lanes are doc-sorted;
+                    # ties pick the lowest lane) — the single-segment page
+                    # is a slice
                     page = np.arange(from_, min(from_ + size,
                                                 len(all_scores)))
                     max_score = float(all_scores[0]) \
                         if len(all_scores) else None
                 else:
-                    # score desc, then seg asc, then doc asc — mergeTopDocs
+                    # score desc, seg asc, doc asc — mergeTopDocs order
                     order = np.lexsort((all_ords, all_segs, -all_scores))
                     page = order[from_:from_ + size]
                     max_score = float(all_scores.max()) \
@@ -912,10 +939,7 @@ class SearchExecutor:
                     "hits": hits,
                 },
             }
-
         _ph["respond"] += time.monotonic() - _t
-        return {"took": int((time.monotonic() - start) * 1000),
-                "responses": responses}
 
     def count(self, body: Optional[dict] = None) -> int:
         body = dict(body or {})
