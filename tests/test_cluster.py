@@ -63,7 +63,9 @@ class TestClusterFormation:
             "mappings": {"properties": {"body": {"type": "text"},
                                         "n": {"type": "integer"}}}})
         assert res["acknowledged"] is True
-        any_node.await_health("green", timeout=30)
+        # generous: under full-suite load the replica recovery round trips
+        # can take far longer than in isolation
+        any_node.await_health("green", timeout=90)
         routing = any_node._data()["routing"]["dist"]
         assert len(routing) == 2
         holders = set()
