@@ -134,8 +134,13 @@ def _enforce_can_remain(ctx: AllocationContext, index: str, entry: dict):
     watermark): replicas drop and re-allocate; a primary relocates (copy
     first, never drop data)."""
     for node in list(entry.get("replicas", [])):
-        if entry.get("relocating", {}).get("to") == node:
-            continue                    # judged once its move completes
+        rel = entry.get("relocating") or {}
+        if rel.get("to") == node or rel.get("from") == node:
+            # both endpoints of an in-flight relocation are judged once
+            # the move completes — dropping the source here would leave a
+            # stale `relocating` record that inflates the replica want
+            # count and double-removes the copy at _complete_relocation
+            continue
         if can_remain(ctx, index, entry, node, is_primary=False).kind == NO:
             was_initializing = node not in entry.get("active_replicas", [])
             entry["replicas"] = [n for n in entry["replicas"] if n != node]

@@ -171,13 +171,20 @@ class PersistentTaskRunner:
                     alloc = t.get("allocation_id", 0)
                     if self._reported.get(tid) != alloc:
                         self._reported[tid] = alloc
-                        self.cluster_node.transport._workers.submit(
+                        self.cluster_node.transport.threadpool.executor(
+                            "persistent_tasks").submit(
                             self._report_incapable, tid, alloc, t["name"])
                     continue
                 ctx = PersistentTaskContext(self.cluster_node, tid,
                                             t.get("allocation_id", 0))
                 self._running[tid] = ctx
-                self.cluster_node.transport._workers.submit(
+                # dedicated pool: task executors live for the task's
+                # lifetime, so on the generic pool they starve bulk/CCS
+                # fan-out and on the management pool they starve the
+                # LEADER_UPDATE deliveries that carry their own
+                # cancellation
+                self.cluster_node.transport.threadpool.executor(
+                    "persistent_tasks").submit(
                     self._run, fn, dict(t.get("params") or {}), ctx)
 
     def _run(self, fn, params: dict, ctx: PersistentTaskContext):

@@ -759,7 +759,12 @@ class ClusterNode:
         for stale in [sid for sid, sess in self._recovery_sessions.items()
                       if now - sess["ts"] > 900.0]:
             del self._recovery_sessions[stale]
-        session = f"{target}/{time.monotonic_ns()}"
+        # key the session by (target, index, shard): finalize of one
+        # shard's recovery must not destroy the blobs of another shard
+        # concurrently recovering from this source to the same target
+        # (allowed by node_concurrent_recoveries)
+        session = (f"{target}/{payload['index']}/{payload['shard']}"
+                   f"/{time.monotonic_ns()}")
         # raw restricted-codec bytes: chunks travel as uint8 arrays (one
         # base64 layer at the frame, zlib-compressed) instead of
         # double-encoding pickle-in-json-in-pickle
@@ -812,7 +817,7 @@ class ClusterNode:
                 tracker.renew_lease(lease_id, ckpt)
             else:
                 tracker.add_lease(lease_id, ckpt, "peer recovery")
-        prefix = f"{target}/"
+        prefix = f"{target}/{payload['index']}/{payload['shard']}/"
         for sid_key in [s for s in self._recovery_sessions
                         if s.startswith(prefix)]:
             del self._recovery_sessions[sid_key]
@@ -1334,6 +1339,21 @@ class ClusterNode:
                     self._ars[n][0] *= 0.95
         return best
 
+    def _ars_begin(self, node: str) -> None:
+        """Mark a query-phase request outstanding against [node]."""
+        with self._ars_lock:
+            st = self._ars.setdefault(node, [10.0, 0])
+            st[1] += 1
+
+    def _ars_end(self, node: str, took_ms: float) -> None:
+        """Fold one measured service time into [node]'s EWMA. A seam so
+        tests can inject deterministic timings instead of observing
+        wall-clock-dependent rotation."""
+        with self._ars_lock:
+            st = self._ars.setdefault(node, [10.0, 0])
+            st[0] = 0.7 * st[0] + 0.3 * took_ms
+            st[1] = max(0, st[1] - 1)
+
     def _cluster_query_phase(self, name: str, body: dict, k: int):
         """Scatter the query phase over one copy of every shard of a local
         index; returns (candidates, agg partials, total hits, shard→node
@@ -1391,9 +1411,7 @@ class ClusterNode:
                 payload = {"index": name, "shards": sids, "body": body,
                            "k": k}
                 t0 = time.monotonic()
-                with self._ars_lock:
-                    st = self._ars.setdefault(node, [10.0, 0])
-                    st[1] += 1
+                self._ars_begin(node)
                 try:
                     if node == self.node_id:
                         resp = self._on_shard_query(self.node_id, payload)
@@ -1414,11 +1432,7 @@ class ClusterNode:
                 except Exception as e:
                     errors.append(e)
                 finally:
-                    took_ms = (time.monotonic() - t0) * 1000.0
-                    with self._ars_lock:
-                        st = self._ars[node]
-                        st[0] = 0.7 * st[0] + 0.3 * took_ms
-                        st[1] = max(0, st[1] - 1)
+                    self._ars_end(node, (time.monotonic() - t0) * 1000.0)
 
             threads = [threading.Thread(target=query_node_shards,
                                         args=(node, sids), daemon=True)

@@ -42,6 +42,11 @@ DEFAULT_POOLS = {
     "management": (5, -1),
     "snapshot": (max(2, _cpus() // 2), -1),
     "generic": (8, -1),     # ref: scaling up to 128 threads, unbounded queue
+    # persistent-task executors run for the task's lifetime; they get a
+    # dedicated pool so they can neither starve the data-plane generic
+    # workers (bulk/CCS fan-out) nor the 5-thread management pool whose
+    # LEADER_UPDATE deliveries are how tasks get cancelled at all
+    "persistent_tasks": (4, -1),
 }
 
 

@@ -150,9 +150,18 @@ def _range_possible(seg, mapper, node: dsl.RangeQuery) -> bool:
     seg_min = float(col.unique[0])
     seg_max = float(col.unique[-1])
 
+    if ft.is_date and any(isinstance(v, str) and "now" in v
+                          for v in (node.gte, node.gt, node.lte, node.lt)
+                          if v is not None):
+        # 'now' resolves to a DIFFERENT instant here than at query
+        # execution; a shard whose max sits exactly at the moving
+        # boundary could be wrongly skipped. The reference resolves date
+        # math once per request context — we conservatively never skip
+        # on now-relative bounds instead.
+        return True
+
     def bound(value, round_up):
-        if ft.is_date and isinstance(value, str) and ("now" in value
-                                                      or "||" in value):
+        if ft.is_date and isinstance(value, str) and "||" in value:
             from opensearch_tpu.search.compile import _resolve_date_math
             value = _resolve_date_math(value, round_up=round_up)
         return ft.to_comparable(value)
