@@ -171,8 +171,10 @@ class PersistentTaskRunner:
                     alloc = t.get("allocation_id", 0)
                     if self._reported.get(tid) != alloc:
                         self._reported[tid] = alloc
-                        self.cluster_node.transport.threadpool.executor(
-                            "persistent_tasks").submit(
+                        # fire-and-forget report: NOT the persistent_tasks
+                        # pool, whose threads may all be held by lifetime-
+                        # long executors (the report would queue forever)
+                        self.cluster_node.transport._mgmt_workers.submit(
                             self._report_incapable, tid, alloc, t["name"])
                     continue
                 ctx = PersistentTaskContext(self.cluster_node, tid,

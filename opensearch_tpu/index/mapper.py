@@ -21,6 +21,7 @@ bool → boolean, object → dotted subfields, array → per-element.
 from __future__ import annotations
 
 import datetime as _dt
+import functools
 import ipaddress
 import math
 import re
@@ -99,7 +100,10 @@ def parse_date_millis(value: Any, fmt: Optional[str] = None) -> int:
                              f"[{fmt or 'strict_date_optional_time||epoch_millis'}]")
 
 
+@functools.lru_cache(maxsize=1 << 16)
 def format_date_millis(millis: int) -> str:
+    # memoized: histogram renders format the same bucket keys for every
+    # query of a dashboard workload
     dt = _dt.datetime.fromtimestamp(millis / 1000.0, tz=_dt.timezone.utc)
     return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"
 

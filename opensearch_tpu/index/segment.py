@@ -76,6 +76,28 @@ def _pad_to(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+def ident_pairs(col) -> bool:
+    """True when a doc-value column's (doc, value) pairs are the identity
+    layout (single-valued dense column: doc k <-> lane k, -1 tail). Device
+    programs then SLICE or pad per-lane results into doc space instead of
+    gathering/scattering — XLA's gather/scatter lower to scalar loops on
+    CPU and a serial path on TPU, and these ops sit on every query's hot
+    path.
+
+    Memoized on the column: sealed columns are immutable, and this is
+    called per range/terms clause compile (the O(n_pairs) scan must not
+    run per query)."""
+    cached = getattr(col, "_ident_pairs", None)
+    if cached is not None:
+        return cached
+    d = col.doc_ids
+    nv = int((d >= 0).sum())
+    out = bool(np.array_equal(d[:nv], np.arange(nv, dtype=d.dtype))
+               and (d[nv:] < 0).all())
+    col._ident_pairs = out
+    return out
+
+
 def pad_bucket(n: int, minimum: int = 128) -> int:
     """Round up to the next power-of-two bucket to bound jit recompiles."""
     size = max(minimum, 1)

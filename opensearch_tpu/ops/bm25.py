@@ -72,7 +72,27 @@ def score_text_clause(seg, blk, k1):
     return scores, hits
 
 
-def range_match_on_ranks(doc_ids, ords, lo_rank, hi_rank, d_pad):
+def _pairs_to_docs(hit, doc_ids, d_pad, ident: bool):
+    """Per-pair hit flags → per-doc bool [d_pad]. Identity pair layouts
+    (single-valued dense columns, doc k ↔ lane k) skip the scatter-max —
+    XLA scatters lower to a serial per-element loop on CPU and a slow
+    path on TPU, and this op sits on every range/terms query."""
+    if ident:
+        n = hit.shape[-1]
+        if n == d_pad:
+            return hit
+        if n < d_pad:
+            pad = jnp.zeros(d_pad - n, jnp.bool_)
+            return jnp.concatenate([hit, jnp.broadcast_to(
+                pad, hit.shape[:-1] + pad.shape)], axis=-1)
+        return hit[..., :d_pad]
+    pair_valid = doc_ids >= 0
+    scatter_idx = jnp.where(pair_valid, doc_ids, d_pad)
+    return jnp.zeros(d_pad, jnp.bool_).at[scatter_idx].max(hit, mode="drop")
+
+
+def range_match_on_ranks(doc_ids, ords, lo_rank, hi_rank, d_pad,
+                         ident: bool = False):
     """Doc matches if ANY of its values has rank in [lo_rank, hi_rank).
 
     (doc_ids, ords) are a value-pair column (doc_id -1 = padding). Rank bounds
@@ -81,11 +101,10 @@ def range_match_on_ranks(doc_ids, ords, lo_rank, hi_rank, d_pad):
     """
     pair_valid = doc_ids >= 0
     in_range = (ords >= lo_rank) & (ords < hi_rank) & pair_valid
-    scatter_idx = jnp.where(pair_valid, doc_ids, d_pad)
-    return jnp.zeros(d_pad, jnp.bool_).at[scatter_idx].max(in_range, mode="drop")
+    return _pairs_to_docs(in_range, doc_ids, d_pad, ident)
 
 
-def ordinal_terms_match(doc_ids, ords, ord_mask, d_pad):
+def ordinal_terms_match(doc_ids, ords, ord_mask, d_pad, ident: bool = False):
     """Doc matches if ANY of its ordinals is in the query's ordinal set.
 
     ord_mask: bool [card_pad] — query-side mask over the field's dictionary
@@ -93,5 +112,4 @@ def ordinal_terms_match(doc_ids, ords, ord_mask, d_pad):
     """
     pair_valid = doc_ids >= 0
     hit = ord_mask[ords] & pair_valid
-    scatter_idx = jnp.where(pair_valid, doc_ids, d_pad)
-    return jnp.zeros(d_pad, jnp.bool_).at[scatter_idx].max(hit, mode="drop")
+    return _pairs_to_docs(hit, doc_ids, d_pad, ident)

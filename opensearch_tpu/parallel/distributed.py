@@ -271,9 +271,8 @@ class DistributedSearcher:
 
             agg_outs = []
             if agg_plans:
-                root_ord = jnp.zeros(d_pad, jnp.int32)
                 eval_aggs(list(agg_plans), seg, flat_inputs, cursor, eligible,
-                          root_ord, 1, agg_outs)
+                          agg_outs)
 
             # partial reduce on ICI: gather every shard's candidates,
             # replicated top-k merge — SearchPhaseController.mergeTopDocs

@@ -6,13 +6,20 @@ collectBucket, 491 files of per-doc collector loops): instead of walking docs
 one at a time, every bucket aggregation becomes
 
     bucket_of_rank (host lookup table over the field's sorted unique values)
-    → device gather over the (doc, value-rank) pairs
-    → masked scatter-add (segment-sum) into flat [parent_card * own_card] bins
+    → a segment-STATIC per-lane bin assignment (factored bucket context)
+    → a masked binned reduction into flat [parent_card * own_card] bins
 
-and every metric aggregation a set of masked scatter reductions (sum / count /
-min / max / sum-of-squares) keyed by the parent's bucket ordinal. Nesting uses
-the classic flattened-ordinal trick (parent_ord * child_card + child_ord),
-like the reference's bucketOrd composition.
+Bucket membership is FACTORED (see eval_aggs): the bin a (doc, value) lane
+lands in is segment-static for field-driven bucketing, while every
+query-dependent condition lives in a dynamic mask. That factorization picks
+the reduction kernel (_binned_sums): bit-packed popcount for counts,
+one-hot matmul (MXU) for float sums — both of which share their static side
+across a whole vmapped _msearch query batch — and scatter-add only for
+data-dependent bins (nested joins, dedup). Metric aggregations collect only
+the partials their render needs (_METRIC_NEEDS: avg = sum+cnt, not the full
+five-reduction battery). Nesting uses the classic flattened-ordinal trick
+(parent_ord * child_card + child_ord), like the reference's bucketOrd
+composition.
 
 Approximation policy: the reference uses TDigest percentiles and HLL++
 cardinality; here both are EXACT, computed from per-bucket value-rank
@@ -30,12 +37,13 @@ from dataclasses import dataclass, field as dc_field
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from opensearch_tpu.common.errors import (
     IllegalArgumentError, ParsingError, QueryShardError)
 from opensearch_tpu.index.mapper import MapperService, format_date_millis, parse_date_millis
-from opensearch_tpu.index.segment import Segment, pad_bucket
+from opensearch_tpu.index.segment import Segment, ident_pairs, pad_bucket
 from opensearch_tpu.search import dsl
 from opensearch_tpu.search.aggs.parse import AggNode
 from opensearch_tpu.search.compile import Compiler, Plan, _resolve_date_math
@@ -44,6 +52,25 @@ from opensearch_tpu.search.plan_eval import _eval_plan
 MAX_AGG_BINS = 1 << 24  # guard for presence/histogram bitmaps
 POS_INF = np.float32(np.inf)
 NEG_INF = np.float32(-np.inf)
+
+# Binned ADD-reductions with at most this many bins run as one-hot matmuls
+# instead of scatter-adds: bin assignments are segment-static (so the
+# one-hot matrix stays unbatched under a query-batch vmap and the MXU does
+# the reduction), where XLA's scatter lowers to a serial loop on CPU and a
+# slow path on TPU. f32 accumulation is exact for counts < 2^24.
+AGG_GEMM_MAX_BINS = 256
+# ...and at most this many one-hot ELEMENTS (lanes × bins): the GEMM's
+# [n, bins] f32 operand is materialized, so an unbounded n would turn the
+# old O(n) scatter memory into gigabytes on big segments. 2^25 f32 =
+# 128 MB. The popcount path's bitmask is 32× smaller per element.
+AGG_GEMM_MAX_ELEMS = 1 << 25
+AGG_POPCOUNT_MAX_ELEMS = 1 << 30
+
+# Input arrays that are segment/node-static by construction (host-computed
+# lookup tables): their CONTENT is part of the plan signature, so a batched
+# runner may legally pass one copy for a whole same-signature group
+# (executor passes them with in_axes=None). Everything else is per-query.
+CONST_INPUT_KEYS = frozenset({"table", "doc_bucket"})
 
 # calendar interval lengths used for fixed bucketing (calendar-aware month/
 # year boundaries are generated host-side as explicit boundary arrays)
@@ -67,12 +94,31 @@ class AggPlan:
     render: Dict[str, Any] = dc_field(default_factory=dict)  # host-only
 
     def sig(self):
-        return (self.kind, self.static,
-                tuple(sorted((k, v.shape, str(v.dtype))
-                             for k, v in self.inputs.items())),
-                self.query_plan.sig() if self.query_plan is not None else None,
-                tuple(q.sig() for q in self.query_plans),
-                tuple(c.sig() for c in self.children))
+        cached = getattr(self, "_sig", None)
+        if cached is not None:
+            return cached
+        import hashlib
+
+        def leaf_sig(k, v):
+            if k in CONST_INPUT_KEYS:
+                # content hash: two queries share an executable (and the
+                # executable may close over / share ONE copy of the array)
+                # only when the table itself is identical
+                return (k, v.shape, str(v.dtype),
+                        hashlib.sha1(np.ascontiguousarray(v).tobytes())
+                        .hexdigest())
+            return (k, v.shape, str(v.dtype))
+
+        out = (self.kind, self.static,
+               tuple(sorted(leaf_sig(k, v)
+                            for k, v in self.inputs.items())),
+               self.query_plan.sig() if self.query_plan is not None else None,
+               tuple(q.sig() for q in self.query_plans),
+               tuple(c.sig() for c in self.children))
+        # plans are immutable post-compile and now shared across queries
+        # via the reader memo — hash the const tables once
+        object.__setattr__(self, "_sig", out)
+        return out
 
     def flatten_inputs(self, out):
         out.append(self.inputs)
@@ -104,6 +150,10 @@ def _num_col(ctx: _Ctx, field: str):
     return ctx.seg.numeric_dv.get(field)
 
 
+def _ident_pairs(col) -> bool:
+    return ident_pairs(col)
+
+
 def _bucket_lookup_plan(node: AggNode, ctx: _Ctx, kind: str,
                         bucket_of_rank: np.ndarray, card: int,
                         render: dict, children_card_mult: bool = True) -> AggPlan:
@@ -111,8 +161,11 @@ def _bucket_lookup_plan(node: AggNode, ctx: _Ctx, kind: str,
     table = np.full(u_pad, -1, dtype=np.int32)
     table[:len(bucket_of_rank)] = bucket_of_rank
     children = [_compile_node(c, ctx) for c in node.children]
+    col = (ctx.seg.ordinal_dv.get(node.field)
+           if kind == "bucket_ord" else _num_col(ctx, node.field))
     return AggPlan(name=node.name, kind=kind,
-                   static=(node.field, card),
+                   static=(node.field, card,
+                           col is not None and _ident_pairs(col)),
                    inputs={"table": table},
                    children=children, render=render)
 
@@ -134,7 +187,8 @@ def _c_terms(node: AggNode, ctx: _Ctx) -> AggPlan:
     if ocol is not None:
         card = max(len(ocol.dictionary), 1)
         children = [_compile_node(c, ctx) for c in node.children]
-        return AggPlan(node.name, "bucket_ord", static=(field, card),
+        return AggPlan(node.name, "bucket_ord",
+                       static=(field, card, _ident_pairs(ocol)),
                        children=children,
                        render={"keys": list(ocol.dictionary), "body": node.body,
                                "kind": "terms"})
@@ -314,7 +368,8 @@ def _c_range(node: AggNode, ctx: _Ctx) -> AggPlan:
         table = np.full(u_pad, -1, dtype=np.int32)
         table[lo:hi] = 0
         sub_plans.append(AggPlan(f"{node.name}#{i}", "bucket_num",
-                                 static=(field, 1), inputs={"table": table},
+                                 static=(field, 1, _ident_pairs(col)),
+                                 inputs={"table": table},
                                  children=[_compile_node(c, ctx)
                                            for c in node.children]))
     return AggPlan(node.name, "multi", static=(len(sub_plans),),
@@ -404,6 +459,16 @@ def _c_missing(node: AggNode, ctx: _Ctx) -> AggPlan:
 
 # ----------------------------------------------------------------- metrics
 
+# which device partials each metric render consumes (reduce._merge_metric);
+# cnt also powers the has-any-value null handling for min/max/avg
+_METRIC_NEEDS = {
+    "min": ("cnt", "min"), "max": ("cnt", "max"), "avg": ("cnt", "sum"),
+    "sum": ("cnt", "sum"), "value_count": ("cnt",),
+    "stats": ("cnt", "max", "min", "sum"),
+    "extended_stats": ("cnt", "max", "min", "sum", "sumsq"),
+}
+
+
 def _c_metric(node: AggNode, ctx: _Ctx) -> AggPlan:
     field = node.field
     if field is None:
@@ -413,9 +478,19 @@ def _c_metric(node: AggNode, ctx: _Ctx) -> AggPlan:
     if field in ctx.seg.numeric_dv:
         ft = ctx.mapper.get_field(field)
         render["is_date"] = bool(ft is not None and ft.is_date)
-        return AggPlan(node.name, "metric_num", static=(field,), render=render)
+        # collect only the partials the metric's render needs: avg wants
+        # (sum, cnt), not the full 5-reduction stats battery
+        needs = _METRIC_NEEDS.get(node.type,
+                                  ("cnt", "max", "min", "sum", "sumsq"))
+        return AggPlan(node.name, "metric_num",
+                       static=(field, needs,
+                               _ident_pairs(ctx.seg.numeric_dv[field])),
+                       render=render)
     if field in ctx.seg.ordinal_dv and node.type == "value_count":
-        return AggPlan(node.name, "count_ord", static=(field,), render=render)
+        return AggPlan(node.name, "count_ord",
+                       static=(field,
+                               _ident_pairs(ctx.seg.ordinal_dv[field])),
+                       render=render)
     return AggPlan(node.name, "empty", render=render)
 
 
@@ -427,12 +502,16 @@ def _c_cardinality(node: AggNode, ctx: _Ctx) -> AggPlan:
     if field in ctx.seg.ordinal_dv:
         card = len(ctx.seg.ordinal_dv[field].dictionary)
         render["keys"] = list(ctx.seg.ordinal_dv[field].dictionary)
-        return AggPlan(node.name, "presence_ord", static=(field, max(card, 1)),
+        return AggPlan(node.name, "presence_ord",
+                       static=(field, max(card, 1),
+                               _ident_pairs(ctx.seg.ordinal_dv[field])),
                        render=render)
     if field in ctx.seg.numeric_dv:
         u = ctx.seg.numeric_dv[field].unique
         render["values"] = u
-        return AggPlan(node.name, "presence_num", static=(field, max(len(u), 1)),
+        return AggPlan(node.name, "presence_num",
+                       static=(field, max(len(u), 1),
+                               _ident_pairs(ctx.seg.numeric_dv[field])),
                        render=render)
     return AggPlan(node.name, "empty", render=render)
 
@@ -445,7 +524,9 @@ def _c_percentiles(node: AggNode, ctx: _Ctx) -> AggPlan:
     if field in ctx.seg.numeric_dv:
         u = ctx.seg.numeric_dv[field].unique
         render["values"] = u
-        return AggPlan(node.name, "value_hist", static=(field, max(len(u), 1)),
+        return AggPlan(node.name, "value_hist",
+                       static=(field, max(len(u), 1),
+                               _ident_pairs(ctx.seg.numeric_dv[field])),
                        render=render)
     return AggPlan(node.name, "empty", render=render)
 
@@ -458,7 +539,10 @@ def _c_weighted_avg(node: AggNode, ctx: _Ctx) -> AggPlan:
         raise ParsingError("[weighted_avg] requires value.field and weight.field")
     render = {"kind": "weighted_avg", "body": node.body}
     if vf in ctx.seg.numeric_dv and wf in ctx.seg.numeric_dv:
-        return AggPlan(node.name, "weighted_avg", static=(vf, wf), render=render)
+        return AggPlan(node.name, "weighted_avg",
+                       static=(vf, wf,
+                               _ident_pairs(ctx.seg.numeric_dv[vf])),
+                       render=render)
     return AggPlan(node.name, "empty", render=render)
 
 
@@ -768,13 +852,90 @@ _COMPILERS = {
 # ---------------------------------------------------------------- device eval
 
 def eval_aggs(plans: List[AggPlan], seg: Dict, inputs: List[Dict],
-              cursor: List[int], mask, parent_eff, parent_card: int,
-              outs: List):
-    """Trace the collection program. mask: eligible docs [Dp] bool.
-    parent_eff: [Dp] int32 doc → parent bucket ordinal (-1 = none).
-    Appends each node's partial arrays dict to `outs` in traversal order."""
+              cursor: List[int], mask, outs: List):
+    """Trace the collection program. mask: eligible docs [Dp] bool (the
+    query's result set). Appends each node's partial arrays dict to
+    `outs` in traversal order.
+
+    Bucket membership is threaded as a FACTORED context (bin, pmask,
+    card, static) instead of the dense parent_eff ordinal vector of the
+    scatter design: `bin` [Dp] int32 is the parent bucket id (-1 = none)
+    and is segment-STATIC for field-driven bucketing (terms / histogram /
+    filter / missing / dense-bucket trees), while every query-dependent
+    condition accumulates in `pmask` [Dp] bool. With static bins, binned
+    add-reductions become one-hot matmuls whose one-hot matrix is shared
+    across a vmapped query batch (see _binned_sums) — the MXU path the
+    reference's per-doc collector loops can't express. Kinds whose bins
+    are genuinely data-dependent (nested joins, dedup) drop to the
+    scatter path by passing static=False."""
+    # root context sentinels: pbin=None ⇒ every doc is in bucket 0 (no
+    # per-doc gather needed), pmask=None ⇒ no accumulated dynamic parent
+    # constraint (skips a gather + AND per agg node on the hot path)
+    ctx = (None, None, 1, True)
     for plan in plans:
-        _eval_agg(plan, seg, inputs, cursor, mask, parent_eff, parent_card, outs)
+        _eval_agg(plan, seg, inputs, cursor, mask, ctx, outs)
+
+
+def _pack_bits(ok):
+    """bool [..., n] → uint32 [..., n/32] bitmask (n % 32 == 0)."""
+    x = ok.reshape(ok.shape[:-1] + (-1, 32)).astype(jnp.uint32)
+    w = jnp.left_shift(jnp.uint32(1), jnp.arange(32, dtype=jnp.uint32))
+    return (x * w).sum(-1).astype(jnp.uint32)
+
+
+def _binned_sums(bin_lanes, total: int, contribs, static_bins: bool):
+    """Per-bin Σ of each (values, out_dtype) contrib. bin_lanes: [n]
+    int32; entries outside [0, total) drop. Contribs carry the DYNAMIC
+    eligibility (ineligible lanes contribute 0); bin_lanes carries the
+    static structure.
+
+    Kernel choice, fastest first:
+    - bool contribs (bucket/count/presence — the hot shapes): bit-packed
+      popcount against static per-bin bitmasks. Exact integer counts at
+      ~1/20th the ops of the one-hot matmul; pure VPU/AVX work.
+    - float contribs with static bins: ONE [n, total] one-hot serves
+      every query of a vmapped batch, reduced as a [B, n] × [n, total]
+      matmul (the MXU path). f32 accumulation exact below 2^24.
+    - dynamic bins or many bins: scatter-add.
+    """
+    n = bin_lanes.shape[0]
+    out: List[Any] = [None] * len(contribs)
+    if static_bins and total <= AGG_GEMM_MAX_BINS:
+        bool_idx = [i for i, (v, dt) in enumerate(contribs)
+                    if v.dtype == jnp.bool_ and n % 32 == 0
+                    and n * total <= AGG_POPCOUNT_MAX_ELEMS]
+        if bool_idx:
+            binmask = (bin_lanes[None, :]
+                       == jnp.arange(total, dtype=bin_lanes.dtype)[:, None])
+            binbits = _pack_bits(binmask)            # [total, n/32] static
+            for i in bool_idx:
+                v, dt = contribs[i]
+                okbits = _pack_bits(v)               # [n/32]
+                inter = okbits[None, :] & binbits    # [total, n/32]
+                out[i] = jax.lax.population_count(inter).sum(-1).astype(dt)
+        rest = [i for i in range(len(contribs)) if out[i] is None]
+        if rest and n * total <= AGG_GEMM_MAX_ELEMS:
+            onehot = (bin_lanes[:, None]
+                      == jnp.arange(total, dtype=bin_lanes.dtype)).astype(
+                jnp.float32)
+            for i in rest:
+                v, dt = contribs[i]
+                s = v.astype(jnp.float32) @ onehot
+                out[i] = s.astype(dt)
+            return out
+        if not rest:
+            return out
+        safe = jnp.where((bin_lanes >= 0) & (bin_lanes < total),
+                         bin_lanes, total)
+        for i in rest:
+            v, dt = contribs[i]
+            out[i] = jnp.zeros(total, dt).at[safe].add(
+                v.astype(dt), mode="drop")
+        return out
+    safe = jnp.where((bin_lanes >= 0) & (bin_lanes < total),
+                     bin_lanes, total)
+    return [jnp.zeros(total, dt).at[safe].add(v.astype(dt), mode="drop")
+            for v, dt in contribs]
 
 
 def _pairs_context(seg, col, mask, parent_eff, d_pad):
@@ -786,48 +947,300 @@ def _pairs_context(seg, col, mask, parent_eff, d_pad):
     return safe_doc, ok & (parent >= 0), parent
 
 
+def _ctx_parent_eff(ctx, d_pad):
+    """Collapse the factored context back to the dense parent ordinal
+    vector ([Dp] int32, -1 = no bucket) for kinds on the scatter path."""
+    pbin, pmask, pcard, _ = ctx
+    if pbin is None and pmask is None:
+        return jnp.zeros(d_pad, jnp.int32)
+    if pbin is None:
+        return jnp.where(pmask, 0, -1)
+    if pmask is None:
+        return pbin
+    return jnp.where(pmask & (pbin >= 0), pbin, -1)
+
+
+def _take_doc(arr, safe_doc, ident: bool):
+    """arr[safe_doc], but a contiguous SLICE when the pairs layout is the
+    identity (doc k ↔ lane k): XLA gathers are scalar loops on CPU and a
+    serial path on TPU; slices vectorize. Tail lanes then carry arr[k]
+    for padding k — every consumer masks them with the static bin_ok."""
+    if ident:
+        n = safe_doc.shape[0]
+        m = arr.shape[-1]
+        if n == m:
+            return arr
+        if n < m:
+            return arr[..., :n]
+    return arr[..., safe_doc] if arr.ndim > 1 else arr[safe_doc]
+
+
+def _gather_ok(mask, pmask, safe_doc, ident: bool = False):
+    """Dynamic doc-eligibility for a pairs gather, skipping the parent
+    gather when no dynamic parent constraint exists (root sentinel)."""
+    ok = _take_doc(mask, safe_doc, ident)
+    if pmask is not None:
+        ok = ok & _take_doc(pmask, safe_doc, ident)
+    return ok
+
+
+def _and_pmask(pmask, extra):
+    return extra if pmask is None else (pmask & extra)
+
+
 def _eval_agg(plan: AggPlan, seg: Dict, inputs: List[Dict], cursor: List[int],
-              mask, parent_eff, parent_card: int, outs: List):
+              mask, ctx, outs: List):
     my = inputs[cursor[0]]
     cursor[0] += 1
     d_pad = seg["live"].shape[0]
     kind = plan.kind
+    pbin, pmask, parent_card, pstatic = ctx
 
     if kind == "empty":
         outs.append({})
+        child_ctx = (jnp.full(d_pad, -1, jnp.int32), pmask, parent_card,
+                     True)
         for c in plan.children:
-            _eval_agg(c, seg, inputs, cursor, mask,
-                      jnp.full(d_pad, -1, jnp.int32), parent_card, outs)
+            _eval_agg(c, seg, inputs, cursor, mask, child_ctx, outs)
         return
 
     if kind == "multi":
         outs.append({})
         for c in plan.children:
-            _eval_agg(c, seg, inputs, cursor, mask, parent_eff, parent_card, outs)
+            _eval_agg(c, seg, inputs, cursor, mask, ctx, outs)
         return
 
     if kind in ("bucket_ord", "bucket_num"):
-        field, card = plan.static
+        field, card, ident = plan.static
         col = seg["ordinal" if kind == "bucket_ord" else "numeric"][field]
         ords = col["ords"] if kind == "bucket_ord" else col["val_ords"]
-        safe_doc, ok, parent = _pairs_context(seg, col, mask, parent_eff, d_pad)
-        if kind == "bucket_num":
-            b = my["table"][ords]
-            ok = ok & (b >= 0)
-        else:
-            b = ords
+        doc_ids = col["doc_ids"]
+        valid = doc_ids >= 0
+        safe_doc = jnp.where(valid, doc_ids, 0)
+        b = my["table"][ords] if kind == "bucket_num" else ords
         total = parent_card * card
-        eff = jnp.where(ok, parent * card + b, total)
-        counts = jnp.zeros(total, jnp.int32).at[eff].add(
-            ok.astype(jnp.int32), mode="drop")
+        # static side: which bin each (doc, value) pair lands in
+        bin_ok = valid & (b >= 0 if kind == "bucket_num" else True)
+        base = 0
+        if pbin is not None:
+            pb = _take_doc(pbin, safe_doc, ident)
+            bin_ok = bin_ok & (pb >= 0)
+            base = pb * card
+        bin_lanes = jnp.where(bin_ok, base + b, total)
+        # dynamic side: whether the pair's doc is in the query/parent set
+        ok_dyn = _gather_ok(mask, pmask, safe_doc, ident)
+        (counts,) = _binned_sums(bin_lanes, total,
+                                 [(ok_dyn & bin_ok, jnp.int32)], pstatic)
         outs.append({"counts": counts})
         if plan.children:
-            child_eff = jnp.full(d_pad, -1, jnp.int32).at[
-                jnp.where(ok, safe_doc, d_pad)].max(
-                jnp.where(ok, eff, -1), mode="drop")
+            # dense per-doc child bucket from the STATIC pair structure
+            # (multi-valued docs keep the max bin — the engine's
+            # single-bucket simplification); dynamic membership rides the
+            # child pmask, so this scatter stays unbatched under vmap
+            child_bin = jnp.full(d_pad, -1, jnp.int32).at[
+                jnp.where(bin_ok, safe_doc, d_pad)].max(
+                jnp.where(bin_ok, bin_lanes, -1), mode="drop")
+            child_ctx = (child_bin, _and_pmask(pmask, mask), total,
+                         pstatic)
             for c in plan.children:
-                _eval_agg(c, seg, inputs, cursor, mask, child_eff, total, outs)
+                _eval_agg(c, seg, inputs, cursor, mask, child_ctx, outs)
         return
+
+    if kind == "filter":
+        scores, matches = _eval_plan(plan.query_plan, seg, inputs, cursor)
+        bin_lanes = jnp.zeros(d_pad, jnp.int32) if pbin is None \
+            else jnp.where(pbin >= 0, pbin, parent_card)
+        own_dyn = matches & mask
+        if pmask is not None:
+            own_dyn = own_dyn & pmask
+        (counts,) = _binned_sums(bin_lanes, parent_card,
+                                 [(own_dyn, jnp.int32)], pstatic)
+        outs.append({"counts": counts})
+        child_ctx = (pbin, _and_pmask(pmask, mask & matches), parent_card,
+                     pstatic)
+        for c in plan.children:
+            _eval_agg(c, seg, inputs, cursor, mask, child_ctx, outs)
+        return
+
+    if kind == "global":
+        gmask = seg["live"] & (jnp.arange(d_pad, dtype=jnp.int32)
+                               < seg["live"].shape[0])
+        # num_docs bound is enforced by live padding (padding rows are
+        # dead); the query mask is deliberately IGNORED (GlobalAggregator)
+        bin_lanes = jnp.zeros(d_pad, jnp.int32) if pbin is None \
+            else jnp.where(pbin >= 0, pbin, parent_card)
+        own_dyn = gmask if pmask is None else (gmask & pmask)
+        (counts,) = _binned_sums(bin_lanes, parent_card,
+                                 [(own_dyn, jnp.int32)], pstatic)
+        outs.append({"counts": counts})
+        for c in plan.children:
+            _eval_agg(c, seg, inputs, cursor, gmask, ctx, outs)
+        return
+
+    if kind == "missing":
+        ctype, field = plan.static
+        if ctype == "numeric":
+            exists = seg["numeric"][field]["exists"]
+        elif ctype == "ordinal":
+            exists = seg["ordinal"][field]["exists"]
+        elif ctype == "vector":
+            exists = seg["vector"][field]["exists"]
+        else:
+            exists = jnp.zeros(d_pad, jnp.bool_)
+        # field existence is segment-static: fold it into the bin side
+        miss_bin = jnp.where(exists, -1,
+                             jnp.zeros(d_pad, jnp.int32)
+                             if pbin is None else pbin)
+        bin_lanes = jnp.where(miss_bin >= 0, miss_bin, parent_card)
+        own_dyn = mask if pmask is None else (mask & pmask)
+        (counts,) = _binned_sums(bin_lanes, parent_card,
+                                 [(own_dyn, jnp.int32)], pstatic)
+        outs.append({"counts": counts})
+        child_ctx = (miss_bin, _and_pmask(pmask, mask), parent_card,
+                     pstatic)
+        for c in plan.children:
+            _eval_agg(c, seg, inputs, cursor, mask, child_ctx, outs)
+        return
+
+    if kind == "bucket_dense":
+        card, = plan.static
+        b = my["doc_bucket"]
+        total = parent_card * card
+        bin_ok = b >= 0
+        base = 0
+        if pbin is not None:
+            bin_ok = bin_ok & (pbin >= 0)
+            base = pbin * card
+        bin_lanes = jnp.where(bin_ok, base + b, total)
+        own_dyn = mask if pmask is None else (mask & pmask)
+        (counts,) = _binned_sums(bin_lanes, total,
+                                 [(own_dyn & bin_ok, jnp.int32)],
+                                 pstatic)
+        outs.append({"counts": counts})
+        child_bin = jnp.where(bin_ok, bin_lanes, -1)
+        child_ctx = (child_bin, _and_pmask(pmask, mask), total, pstatic)
+        for c in plan.children:
+            _eval_agg(c, seg, inputs, cursor, mask, child_ctx, outs)
+        return
+
+    if kind == "metric_num":
+        field, needs, ident = plan.static
+        col = seg["numeric"][field]
+        doc_ids = col["doc_ids"]
+        valid = doc_ids >= 0
+        safe_doc = jnp.where(valid, doc_ids, 0)
+        bin_ok = valid
+        pb = 0
+        if pbin is not None:
+            pb = _take_doc(pbin, safe_doc, ident)
+            bin_ok = bin_ok & (pb >= 0)
+        bin_lanes = jnp.where(bin_ok, pb, parent_card)
+        ok_dyn = _gather_ok(mask, pmask, safe_doc, ident) & bin_ok
+        v = col["values_f32"]
+        out: Dict[str, Any] = {}
+        gemm_parts = []
+        if "cnt" in needs:
+            gemm_parts.append(("cnt", ok_dyn, jnp.int32))
+        if "sum" in needs:
+            gemm_parts.append(("sum", jnp.where(ok_dyn, v, 0.0),
+                               jnp.float32))
+        if "sumsq" in needs:
+            gemm_parts.append(("sumsq", jnp.where(ok_dyn, v * v, 0.0),
+                               jnp.float32))
+        if gemm_parts:
+            sums = _binned_sums(bin_lanes, parent_card,
+                                [(c, dt) for _, c, dt in gemm_parts],
+                                pstatic)
+            for (name, _, _), s in zip(gemm_parts, sums):
+                out[name] = s
+        # min/max have no matmul form — masked scatter reductions
+        eff = jnp.where(ok_dyn, bin_lanes, parent_card)
+        if "min" in needs:
+            out["min"] = jnp.full(parent_card, POS_INF, jnp.float32).at[
+                eff].min(jnp.where(ok_dyn, v, POS_INF), mode="drop")
+        if "max" in needs:
+            out["max"] = jnp.full(parent_card, NEG_INF, jnp.float32).at[
+                eff].max(jnp.where(ok_dyn, v, NEG_INF), mode="drop")
+        outs.append(out)
+        return
+
+    if kind == "count_ord":
+        field, ident = plan.static
+        col = seg["ordinal"][field]
+        doc_ids = col["doc_ids"]
+        valid = doc_ids >= 0
+        safe_doc = jnp.where(valid, doc_ids, 0)
+        bin_ok = valid
+        pb = 0
+        if pbin is not None:
+            pb = _take_doc(pbin, safe_doc, ident)
+            bin_ok = bin_ok & (pb >= 0)
+        bin_lanes = jnp.where(bin_ok, pb, parent_card)
+        ok_dyn = _gather_ok(mask, pmask, safe_doc, ident) & bin_ok
+        (cnt,) = _binned_sums(bin_lanes, parent_card,
+                              [(ok_dyn, jnp.int32)], pstatic)
+        outs.append({"cnt": cnt})
+        return
+
+    if kind in ("presence_ord", "presence_num", "value_hist"):
+        field, card, ident = plan.static
+        col = seg["ordinal" if kind == "presence_ord" else "numeric"][field]
+        ords = col["ords"] if kind == "presence_ord" else col["val_ords"]
+        doc_ids = col["doc_ids"]
+        total = parent_card * card
+        if total > MAX_AGG_BINS:
+            raise IllegalArgumentError(
+                f"aggregation [{plan.name}] needs {total} bins "
+                f"(> {MAX_AGG_BINS}); reduce bucket count or cardinality")
+        valid = doc_ids >= 0
+        safe_doc = jnp.where(valid, doc_ids, 0)
+        bin_ok = valid
+        base = 0
+        if pbin is not None:
+            pb = _take_doc(pbin, safe_doc, ident)
+            bin_ok = bin_ok & (pb >= 0)
+            base = pb * card
+        bin_lanes = jnp.where(bin_ok, base + ords, total)
+        ok_dyn = _gather_ok(mask, pmask, safe_doc, ident) & bin_ok
+        (hist,) = _binned_sums(bin_lanes, total,
+                               [(ok_dyn, jnp.int32)], pstatic)
+        if kind == "value_hist":
+            outs.append({"hist": hist})
+        else:
+            outs.append({"present": hist > 0})
+        return
+
+    if kind == "weighted_avg":
+        vf, wf, ident = plan.static
+        vcol = seg["numeric"][vf]
+        wcol = seg["numeric"][wf]
+        doc_ids = vcol["doc_ids"]
+        valid = doc_ids >= 0
+        safe_doc = jnp.where(valid, doc_ids, 0)
+        bin_ok = valid
+        pb = 0
+        if pbin is not None:
+            pb = _take_doc(pbin, safe_doc, ident)
+            bin_ok = bin_ok & (pb >= 0)
+        bin_lanes = jnp.where(bin_ok, pb, parent_card)
+        # dense single-value weight per doc via min_rank decode
+        w_dense = wcol["unique_f32"][jnp.clip(wcol["min_rank"], 0,
+                                              wcol["unique_f32"].shape[0] - 1)]
+        w = jnp.where(wcol["exists"][safe_doc], w_dense[safe_doc], 0.0)
+        ok_dyn = (_gather_ok(mask, pmask, safe_doc, ident) & bin_ok
+                  & wcol["exists"][safe_doc])
+        v = vcol["values_f32"]
+        sum_wv, sum_w = _binned_sums(
+            bin_lanes, parent_card,
+            [(jnp.where(ok_dyn, v * w, 0.0), jnp.float32),
+             (jnp.where(ok_dyn, w, 0.0), jnp.float32)], pstatic)
+        outs.append({"sum_wv": sum_wv, "sum_w": sum_w})
+        return
+
+    # ---- scatter-path kinds: bins are data-dependent (joins, dedup) or
+    # rarely hot; they consume the dense parent ordinal vector and hand
+    # their children a dynamic (static=False) context
+    parent_eff = _ctx_parent_eff(ctx, d_pad)
 
     if kind == "nested":
         # doc set becomes the path's child rows whose ROOT is in the
@@ -843,9 +1256,10 @@ def _eval_agg(plan: AggPlan, seg: Dict, inputs: List[Dict], cursor: List[int],
         counts = jnp.zeros(parent_card, jnp.int32).at[eff].add(
             own.astype(jnp.int32), mode="drop")
         outs.append({"counts": counts})
+        child_ctx = (child_eff, jnp.ones(d_pad, jnp.bool_), parent_card,
+                     False)
         for c in plan.children:
-            _eval_agg(c, seg, inputs, cursor, own, child_eff, parent_card,
-                      outs)
+            _eval_agg(c, seg, inputs, cursor, own, child_ctx, outs)
         return
 
     if kind == "reverse_nested":
@@ -874,70 +1288,10 @@ def _eval_agg(plan: AggPlan, seg: Dict, inputs: List[Dict], cursor: List[int],
         root_eff = jnp.full(d_pad, -1, jnp.int32).at[idx].max(
             jnp.where(sel, parent_eff, -1), mode="drop")
         own = root_eff >= 0
+        child_ctx = (root_eff, jnp.ones(d_pad, jnp.bool_), parent_card,
+                     False)
         for c in plan.children:
-            _eval_agg(c, seg, inputs, cursor, own, root_eff, parent_card,
-                      outs)
-        return
-
-    if kind == "filter":
-        scores, matches = _eval_plan(plan.query_plan, seg, inputs, cursor)
-        own = matches & mask & (parent_eff >= 0)
-        eff = jnp.where(own, parent_eff, parent_card)
-        counts = jnp.zeros(parent_card, jnp.int32).at[eff].add(
-            own.astype(jnp.int32), mode="drop")
-        outs.append({"counts": counts})
-        child_eff = jnp.where(own, parent_eff, -1)
-        for c in plan.children:
-            _eval_agg(c, seg, inputs, cursor, mask, child_eff, parent_card, outs)
-        return
-
-    if kind == "global":
-        gmask = seg["live"] & (jnp.arange(d_pad, dtype=jnp.int32)
-                               < seg["live"].shape[0])
-        # num_docs bound is enforced by live padding (padding rows are dead)
-        own = gmask & (parent_eff >= 0)
-        eff = jnp.where(own, parent_eff, parent_card)
-        counts = jnp.zeros(parent_card, jnp.int32).at[eff].add(
-            own.astype(jnp.int32), mode="drop")
-        outs.append({"counts": counts})
-        child_eff = jnp.where(own, parent_eff, -1)
-        for c in plan.children:
-            _eval_agg(c, seg, inputs, cursor, gmask, child_eff, parent_card, outs)
-        return
-
-    if kind == "missing":
-        ctype, field = plan.static
-        if ctype == "numeric":
-            exists = seg["numeric"][field]["exists"]
-        elif ctype == "ordinal":
-            exists = seg["ordinal"][field]["exists"]
-        elif ctype == "vector":
-            exists = seg["vector"][field]["exists"]
-        else:
-            exists = jnp.zeros(d_pad, jnp.bool_)
-        own = mask & ~exists & (parent_eff >= 0)
-        eff = jnp.where(own, parent_eff, parent_card)
-        counts = jnp.zeros(parent_card, jnp.int32).at[eff].add(
-            own.astype(jnp.int32), mode="drop")
-        outs.append({"counts": counts})
-        child_eff = jnp.where(own, parent_eff, -1)
-        for c in plan.children:
-            _eval_agg(c, seg, inputs, cursor, mask, child_eff, parent_card, outs)
-        return
-
-    if kind == "bucket_dense":
-        card, = plan.static
-        b = my["doc_bucket"]
-        own = mask & (parent_eff >= 0) & (b >= 0)
-        total = parent_card * card
-        parent = jnp.where(parent_eff >= 0, parent_eff, 0)
-        eff = jnp.where(own, parent * card + b, total)
-        counts = jnp.zeros(total, jnp.int32).at[eff].add(
-            own.astype(jnp.int32), mode="drop")
-        outs.append({"counts": counts})
-        child_eff = jnp.where(own, eff, -1)
-        for c in plan.children:
-            _eval_agg(c, seg, inputs, cursor, mask, child_eff, total, outs)
+            _eval_agg(c, seg, inputs, cursor, own, child_ctx, outs)
         return
 
     if kind == "adjacency":
@@ -1031,74 +1385,6 @@ def _eval_agg(plan: AggPlan, seg: Dict, inputs: List[Dict], cursor: List[int],
                 .at[eff].min(jnp.where(own, lon, POS_INF), mode="drop"),
             "max_lon": jnp.full(parent_card, NEG_INF, jnp.float32)
                 .at[eff].max(jnp.where(own, lon, NEG_INF), mode="drop"),
-        })
-        return
-
-    if kind == "metric_num":
-        field, = plan.static
-        col = seg["numeric"][field]
-        safe_doc, ok, parent = _pairs_context(seg, col, mask, parent_eff, d_pad)
-        eff = jnp.where(ok, parent, parent_card)
-        v = col["values_f32"]
-        outs.append({
-            "sum": jnp.zeros(parent_card, jnp.float32).at[eff].add(
-                jnp.where(ok, v, 0.0), mode="drop"),
-            "cnt": jnp.zeros(parent_card, jnp.int32).at[eff].add(
-                ok.astype(jnp.int32), mode="drop"),
-            "min": jnp.full(parent_card, POS_INF, jnp.float32).at[eff].min(
-                jnp.where(ok, v, POS_INF), mode="drop"),
-            "max": jnp.full(parent_card, NEG_INF, jnp.float32).at[eff].max(
-                jnp.where(ok, v, NEG_INF), mode="drop"),
-            "sumsq": jnp.zeros(parent_card, jnp.float32).at[eff].add(
-                jnp.where(ok, v * v, 0.0), mode="drop"),
-        })
-        return
-
-    if kind == "count_ord":
-        field, = plan.static
-        col = seg["ordinal"][field]
-        _, ok, parent = _pairs_context(seg, col, mask, parent_eff, d_pad)
-        eff = jnp.where(ok, parent, parent_card)
-        outs.append({"cnt": jnp.zeros(parent_card, jnp.int32).at[eff].add(
-            ok.astype(jnp.int32), mode="drop")})
-        return
-
-    if kind in ("presence_ord", "presence_num", "value_hist"):
-        field, card = plan.static
-        col = seg["ordinal" if kind == "presence_ord" else "numeric"][field]
-        ords = col["ords"] if kind == "presence_ord" else col["val_ords"]
-        total = parent_card * card
-        if total > MAX_AGG_BINS:
-            raise IllegalArgumentError(
-                f"aggregation [{plan.name}] needs {total} bins "
-                f"(> {MAX_AGG_BINS}); reduce bucket count or cardinality")
-        _, ok, parent = _pairs_context(seg, col, mask, parent_eff, d_pad)
-        eff = jnp.where(ok, parent * card + ords, total)
-        if kind == "value_hist":
-            outs.append({"hist": jnp.zeros(total, jnp.int32).at[eff].add(
-                ok.astype(jnp.int32), mode="drop")})
-        else:
-            outs.append({"present": jnp.zeros(total, jnp.bool_).at[eff].max(
-                ok, mode="drop")})
-        return
-
-    if kind == "weighted_avg":
-        vf, wf = plan.static
-        vcol = seg["numeric"][vf]
-        wcol = seg["numeric"][wf]
-        safe_doc, ok, parent = _pairs_context(seg, vcol, mask, parent_eff, d_pad)
-        # dense single-value weight per doc via min_rank decode
-        w_dense = wcol["unique_f32"][jnp.clip(wcol["min_rank"], 0,
-                                              wcol["unique_f32"].shape[0] - 1)]
-        w = jnp.where(wcol["exists"][safe_doc], w_dense[safe_doc], 0.0)
-        ok = ok & wcol["exists"][safe_doc]
-        eff = jnp.where(ok, parent, parent_card)
-        v = vcol["values_f32"]
-        outs.append({
-            "sum_wv": jnp.zeros(parent_card, jnp.float32).at[eff].add(
-                jnp.where(ok, v * w, 0.0), mode="drop"),
-            "sum_w": jnp.zeros(parent_card, jnp.float32).at[eff].add(
-                jnp.where(ok, w, 0.0), mode="drop"),
         })
         return
 

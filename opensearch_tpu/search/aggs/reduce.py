@@ -251,6 +251,27 @@ def _merge_histogram(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
     min_doc_count = int(body.get("min_doc_count", 0))
     is_date = render.get("kind") == "date_histogram"
 
+    # single-segment, leaf histogram (the dashboard hot shape): render
+    # straight from the counts array — no per-bucket dict accumulation
+    if (len(entries) == 1 and not entries[0][0].children
+            and "counts" in entries[0][0].out):
+        d, p = entries[0]
+        card = d.plan.static[1]
+        keys = d.plan.render["keys"]
+        counts = np.asarray(d.out["counts"])[p * card:(p + 1) * card]
+        counts = counts[:len(keys)]
+        buckets = []
+        for k, c in zip(keys, counts):
+            c = int(c)
+            if c < min_doc_count:
+                continue
+            b: Dict[str, Any] = {"key": int(k) if is_date else k,
+                                 "doc_count": c}
+            if is_date:
+                b["key_as_string"] = format_date_millis(int(k))
+            buckets.append(b)
+        return {"buckets": buckets}
+
     acc: Dict[float, Dict[str, Any]] = {}
     for d, p in entries:
         if "counts" not in d.out:
@@ -274,16 +295,18 @@ def _merge_histogram(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
         steps = sorted({round(b - a, 9) for a, b in zip(all_keys, all_keys[1:])})
         step = steps[0] if steps else None
         if step and step > 0:
-            filled = []
-            k = all_keys[0]
+            # O(1) membership by quantized offset from the first key (the
+            # old per-candidate linear scan was O(buckets²) and dominated
+            # the date_histogram respond phase)
+            base_key = all_keys[0]
+            seen = {round((ak - base_key) / step) for ak in all_keys}
+            k = base_key
+            q = 0
             while k <= all_keys[-1] + step / 2:
-                filled.append(k)
-                k += step
-            for k in filled:
-                match = next((ak for ak in all_keys
-                              if abs(ak - k) < (step / 1e6 + 1e-9)), None)
-                if match is None:
+                if q not in seen:
                     acc[k] = {"doc_count": 0, "segments": []}
+                q += 1
+                k = base_key + q * step
             all_keys = sorted(acc.keys())
 
     first = entries[0][0]
@@ -356,13 +379,20 @@ def _merge_metric(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
     total_sumsq = 0.0
     vmin, vmax = math.inf, -math.inf
     for d, p in entries:
-        if "sum" not in d.out:
+        if not d.out:
             continue
-        total_sum += float(d.out["sum"][p])
-        total_cnt += int(d.out["cnt"][p])
-        total_sumsq += float(d.out["sumsq"][p])
-        vmin = min(vmin, float(d.out["min"][p]))
-        vmax = max(vmax, float(d.out["max"][p]))
+        # only the partials this metric's needs-set collected are present
+        # (engine._METRIC_NEEDS)
+        if "sum" in d.out:
+            total_sum += float(d.out["sum"][p])
+        if "cnt" in d.out:
+            total_cnt += int(d.out["cnt"][p])
+        if "sumsq" in d.out:
+            total_sumsq += float(d.out["sumsq"][p])
+        if "min" in d.out:
+            vmin = min(vmin, float(d.out["min"][p]))
+        if "max" in d.out:
+            vmax = max(vmax, float(d.out["max"][p]))
     has = total_cnt > 0
 
     def dateify(v):

@@ -440,7 +440,8 @@ class Compiler:
             i = int(np.searchsorted(col.unique, target))
             if i < len(col.unique) and col.unique[i] == target:
                 mask[i] = True
-        return Plan("num_terms", static=(field,),
+        from opensearch_tpu.index.segment import ident_pairs
+        return Plan("num_terms", static=(field, ident_pairs(col)),
                     inputs={"mask": mask, "boost": _f32(boost)})
 
     # --------------------------------------------------------- range
@@ -463,8 +464,10 @@ class Compiler:
                 bisect.bisect_right(col.dictionary, str(node.lte))
                 if node.lte is not None
                 else bisect.bisect_left(col.dictionary, str(node.lt)))
-            return Plan("range_ord", static=(node.field,), inputs={
-                "lo": _i32(lo), "hi": _i32(hi), "boost": _f32(node.boost)})
+            from opensearch_tpu.index.segment import ident_pairs
+            return Plan("range_ord", static=(node.field, ident_pairs(col)),
+                        inputs={"lo": _i32(lo), "hi": _i32(hi),
+                                "boost": _f32(node.boost)})
         col = seg.numeric_dv.get(node.field)
         if col is None:
             return MATCH_NONE
@@ -488,8 +491,10 @@ class Compiler:
                 col.unique, bound(node.lte, round_up=True), "right"))
         elif node.lt is not None:
             hi_rank = int(np.searchsorted(col.unique, bound(node.lt), "left"))
-        return Plan("range_num", static=(node.field,), inputs={
-            "lo": _i32(lo_rank), "hi": _i32(hi_rank), "boost": _f32(node.boost)})
+        from opensearch_tpu.index.segment import ident_pairs
+        return Plan("range_num", static=(node.field, ident_pairs(col)),
+                    inputs={"lo": _i32(lo_rank), "hi": _i32(hi_rank),
+                            "boost": _f32(node.boost)})
 
     # ---------------------------------------------------------------- knn
     def _c_KnnQuery(self, node: dsl.KnnQuery, seg, meta) -> Plan:

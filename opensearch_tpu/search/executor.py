@@ -23,6 +23,7 @@ analog of Lucene's per-(query,reader) Weight caching, but at XLA level.
 from __future__ import annotations
 
 import functools
+import json
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -179,9 +180,8 @@ def build_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
         top_scores = scores[top_idx]
         agg_outs = []
         if agg_plans:
-            root_eff = jnp.zeros(d_pad, jnp.int32)
             eval_aggs(list(agg_plans), seg, flat_inputs, cursor, eligible,
-                      root_eff, 1, agg_outs)
+                      agg_outs)
         return top_keys, top_scores, top_idx.astype(jnp.int32), total, agg_outs
 
     return run
@@ -237,11 +237,20 @@ def _fill_value(name: str, dtype) -> Any:
     return _PAD_FILL.get(name, False if dtype == np.bool_ else 0)
 
 
-def stack_flat_inputs(flats: List[List[Dict[str, np.ndarray]]]):
+def stack_flat_inputs(flats: List[List[Dict[str, np.ndarray]]],
+                      with_const: bool = False):
     """Fast batch-stack of per-query flat input trees: grows every leaf to
     the per-position max shape (same envelope semantics as
     parallel.distributed.pad_stack_trees) but via preallocated fills
-    instead of per-query np.pad — the host-side hot path of msearch."""
+    instead of per-query np.pad — the host-side hot path of msearch.
+
+    with_const: leaves named in aggs.engine.CONST_INPUT_KEYS (content-
+    hashed into the group signature, so identical across the batch) are
+    NOT stacked — one copy is packed and the runner maps them with
+    in_axes=None, keeping table lookups unbatched so the GEMM agg path's
+    one-hot matrices stay shared across the query batch. Returns
+    (stacked, treedef, axes) with axes the per-leaf vmap axis list."""
+    from opensearch_tpu.search.aggs.engine import CONST_INPUT_KEYS
     b = len(flats)
     treedef = jax.tree_util.tree_structure(flats[0])
     names = [kp[-1].key if hasattr(kp[-1], "key") else ""
@@ -249,7 +258,12 @@ def stack_flat_inputs(flats: List[List[Dict[str, np.ndarray]]]):
     per_query = [jax.tree_util.tree_leaves(f) for f in flats]
     n_leaves = len(per_query[0])
     stacked = []
+    axes: List[Optional[int]] = []
     for li in range(n_leaves):
+        if with_const and names[li] in CONST_INPUT_KEYS:
+            stacked.append(np.asarray(per_query[0][li]))
+            axes.append(None)
+            continue
         arrs = [np.asarray(q[li]) for q in per_query]
         a0 = arrs[0]
         shape = tuple(max(a.shape[d] for a in arrs)
@@ -262,7 +276,8 @@ def stack_flat_inputs(flats: List[List[Dict[str, np.ndarray]]]):
             for qi, a in enumerate(arrs):
                 out[(qi, *map(slice, a.shape))] = a
         stacked.append(out)
-    return stacked, treedef
+        axes.append(0)
+    return stacked, treedef, axes
 
 
 def _pack_row(top_scores, top_idx, total):
@@ -274,6 +289,15 @@ def _pack_row(top_scores, top_idx, total):
                                      jnp.float32),
         jax.lax.bitcast_convert_type(total[None].astype(jnp.int32),
                                      jnp.float32)])
+
+
+def _topk_or_empty(masked, k_eff: int):
+    """lax.top_k, except k=0 (size=0 agg/count queries) skips the
+    selection networks entirely — the dominant device cost for a
+    hits-free query."""
+    if k_eff == 0:
+        return (jnp.zeros((0,), jnp.float32), jnp.zeros((0,), jnp.int32))
+    return jax.lax.top_k(masked, k_eff)
 
 
 # candidate-buffer kernel only pays off while the sorted buffer stays far
@@ -393,7 +417,7 @@ def build_batched_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
         total = jnp.sum(eligible.astype(jnp.int32))
         masked = jnp.where(eligible, scores, NEG_INF)
         k_eff = min(k, seg["live"].shape[0])
-        top_scores, top_idx = jax.lax.top_k(masked, k_eff)
+        top_scores, top_idx = _topk_or_empty(masked, k_eff)
         return _pack_row(top_scores, top_idx, total)
 
     def run(seg, packed_buf):
@@ -403,6 +427,129 @@ def build_batched_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
                                                    leaves[-1])
 
     return run
+
+
+def _flatten_agg_out(out: Dict[str, Any]) -> List[Any]:
+    """Deterministic (sorted-key) leaf order for one eval_aggs output dict —
+    the device-side packer and the host-side unpacker must agree."""
+    return [out[k] for k in sorted(out)]
+
+
+def build_batched_agg_query_phase(plan: Plan, meta: DeviceSegmentMeta,
+                                  k: int, layout, treedef, axes, agg_plans):
+    """B same-shaped queries WITH aggregations as ONE device program.
+
+    Extends build_batched_query_phase with the agg collection pass
+    (eval_aggs) per query row; every agg partial array is bitcast to f32
+    and concatenated onto the packed hit row, so a whole group of agg
+    queries still fetches as ONE [B, 2k+1+W] array = one transfer round
+    trip (reference executes aggs per query per shard:
+    search/aggregations/AggregationPhase.java preProcess/execute)."""
+
+    def one(seg, flat_inputs, min_score):
+        cursor = [0]
+        scores, matches = _eval_plan(plan, seg, flat_inputs, cursor)
+        d_pad = seg["live"].shape[0]
+        in_seg = jnp.arange(d_pad, dtype=jnp.int32) < meta.num_docs
+        eligible = matches & seg["live"] & seg["root"] & in_seg \
+            & (scores >= min_score)
+        total = jnp.sum(eligible.astype(jnp.int32))
+        masked = jnp.where(eligible, scores, NEG_INF)
+        k_eff = min(k, d_pad)
+        top_scores, top_idx = _topk_or_empty(masked, k_eff)
+        agg_outs: List[dict] = []
+        eval_aggs(list(agg_plans), seg, flat_inputs, cursor, eligible,
+                  agg_outs)
+        pieces = [_pack_row(top_scores, top_idx, total)]
+        for out in agg_outs:
+            for v in _flatten_agg_out(out):
+                v = v.reshape(-1)
+                if v.dtype != jnp.float32:
+                    v = jax.lax.bitcast_convert_type(
+                        v.astype(jnp.int32), jnp.float32)
+                pieces.append(v)
+        return jnp.concatenate(pieces)
+
+    def run(seg, packed_buf):
+        leaves = unpack_leaves(packed_buf, layout)
+        batched_flat = jax.tree_util.tree_unflatten(treedef, leaves[:-1])
+        axes_tree = jax.tree_util.tree_unflatten(treedef, list(axes[:-1]))
+        return jax.vmap(one, in_axes=(None, axes_tree, 0))(
+            seg, batched_flat, leaves[-1])
+
+    return run
+
+
+def _agg_out_layout(plan: Plan, meta: DeviceSegmentMeta, agg_plans,
+                    arrays, example_flat, min_score_example):
+    """Host-side layout of one query's agg partials: for each eval_aggs
+    output dict, its sorted keys with shapes and dtypes. Computed by
+    abstract evaluation (jax.eval_shape) — no device work."""
+
+    def probe(seg, flat_inputs, min_score):
+        cursor = [0]
+        scores, matches = _eval_plan(plan, seg, flat_inputs, cursor)
+        d_pad = seg["live"].shape[0]
+        eligible = matches & seg["live"] & (scores >= min_score)
+        agg_outs: List[dict] = []
+        eval_aggs(list(agg_plans), seg, flat_inputs, cursor, eligible,
+                  agg_outs)
+        return agg_outs
+
+    shapes = jax.eval_shape(probe, arrays, example_flat, min_score_example)
+    out_layout = []
+    width = 0
+    for out in shapes:
+        entry = []
+        for key in sorted(out):
+            s = out[key]
+            n = int(np.prod(s.shape)) if s.shape else 1
+            entry.append((key, tuple(s.shape), str(s.dtype)))
+            width += n
+        out_layout.append(tuple(entry))
+    return tuple(out_layout), width
+
+
+def _decode_agg_row(row: np.ndarray, out_layout) -> List[dict]:
+    """Invert the device-side f32 packing for one query row (the agg tail
+    of a [2k+1+W] packed row) back into eval_aggs-ordered output dicts."""
+    outs = []
+    off = 0
+    for entry in out_layout:
+        d = {}
+        for key, shape, dtype in entry:
+            n = int(np.prod(shape)) if shape else 1
+            piece = row[off:off + n]
+            off += n
+            if dtype == "float32":
+                arr = piece
+            elif dtype == "bool":
+                arr = piece.view(np.int32).astype(np.bool_)
+            else:
+                arr = piece.view(np.int32)
+                if dtype != "int32":
+                    arr = arr.astype(dtype)
+            d[key] = arr.reshape(shape)
+        outs.append(d)
+    return outs
+
+
+def _agg_envelope_runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta,
+                         k: int, layout, treedef, axes, agg_sig, agg_plans,
+                         arrays, example_flat):
+    """Jitted group program for agg-bearing batches + the host layout of
+    each row's agg tail. Always the dense kernel: eval_aggs consumes the
+    dense eligible mask the candidate-buffer kernel never materializes."""
+    key = ("aggenv", plan_sig, agg_sig, meta, k, layout, treedef, axes)
+    hit = _JIT_CACHE.get(key)
+    if hit is None:
+        out_layout, width = _agg_out_layout(
+            plan, meta, agg_plans, arrays, example_flat, np.float32(0))
+        fn = jax.jit(build_batched_agg_query_phase(
+            plan, meta, k, layout, treedef, axes, agg_plans))
+        hit = (fn, out_layout, width)
+        _JIT_CACHE[key] = hit
+    return hit
 
 
 @functools.partial(jax.jit, static_argnums=())
@@ -532,9 +679,9 @@ def _compare_candidates(specs):
 
 
 # request keys the batched envelope path fully renders; anything else
-# (highlight, collapse, rescore, aggs, ...) takes the general path
+# (highlight, collapse, rescore, ...) takes the general path
 _BATCHABLE_KEYS = frozenset({"query", "size", "from", "min_score", "sort",
-                             "_source"})
+                             "_source", "aggs", "aggregations"})
 
 
 def _contains_inner_hits(obj) -> bool:
@@ -722,12 +869,31 @@ class SearchExecutor:
         _t = time.monotonic()
         responses: List[Optional[dict]] = [None] * len(bodies)
 
+        from opensearch_tpu.indices.request_cache import (
+            REQUEST_CACHE, cache_key, cacheable)
+        resp_cache_keys: Dict[int, Any] = {}
         batchable: List[Tuple[int, dict, Any, int, int, float]] = []
         for i, body in enumerate(bodies):
             body = body or {}
             if not _msearch_batchable(body):
                 responses[i] = self.search(body, _direct=True)
                 continue
+            if cacheable(body):
+                # shard request cache at RESPONSE granularity (the general
+                # path caches at query-phase granularity; size=0 agg
+                # dashboards refresh identical bodies) — a refresh/delete
+                # rotates segment uids/live counts out of the key
+                base = cache_key(self.reader.segments, body, 0, None)
+                if base is not None:
+                    key = ("msearch", base)
+                    hit = REQUEST_CACHE.get(key)
+                    if hit is not REQUEST_CACHE._MISS:
+                        resp = json.loads(hit)
+                        resp["took"] = int(
+                            (time.monotonic() - start) * 1000)
+                        responses[i] = resp
+                        continue
+                    resp_cache_keys[i] = key
             try:
                 node = dsl.parse_query(body.get("query"))
             except Exception:
@@ -761,6 +927,7 @@ class SearchExecutor:
         # structure, not pipelining.)
         if batchable:
             state = self._msearch_prepare(batchable, responses, start)
+            state["resp_cache_keys"] = resp_cache_keys
             self._msearch_finish(state, responses, start)
         return {"took": int((time.monotonic() - start) * 1000),
                 "responses": responses}
@@ -791,22 +958,66 @@ class SearchExecutor:
         groups: Dict[Any, List[int]] = {}
         compiled: Dict[int, List[Optional[Plan]]] = {}
         flats_by_i: Dict[int, List[Optional[list]]] = {}
+        agg_by_i: Dict[int, List[list]] = {}      # i -> per-seg AggPlans
+        agg_nodes_by_i: Dict[int, list] = {}      # i -> parsed AggNodes
         stats = self.reader.stats()
         compiler = Compiler(self.reader.mapper, stats)
+        from opensearch_tpu.search.aggs.parse import PIPELINE_TYPES
         for entry in batchable:
             i, body, node, size, from_, min_score = entry
+            agg_spec = body.get("aggs") or body.get("aggregations")
+            agg_nodes = parse_aggs(agg_spec)
+            device_agg_nodes = [n for n in agg_nodes
+                                if n.type not in PIPELINE_TYPES]
+            # agg plans are (agg spec, segment)-static — memoized on the
+            # reader stats like compiled text plans, so a dashboard
+            # workload of repeated agg shapes skips the per-query
+            # bucket-table recomputation (the Weight-cache analog)
+            agg_json = (json.dumps(agg_spec, sort_keys=True, default=str)
+                        if agg_nodes else None)
             plans: List[Optional[Plan]] = []
-            for seg, (arrays, meta) in zip(self.reader.segments,
-                                           self.reader.device):
-                if seg.num_docs == 0:
-                    plans.append(None)
-                    continue
-                plans.append(compiler.compile(node, seg, meta))
+            agg_plans_per_seg: List[list] = []
+            try:
+                for seg, (arrays, meta) in zip(self.reader.segments,
+                                               self.reader.device):
+                    if seg.num_docs == 0:
+                        plans.append(None)
+                        agg_plans_per_seg.append([])
+                        continue
+                    plans.append(compiler.compile(node, seg, meta))
+                    if not agg_nodes:
+                        agg_plans_per_seg.append([])
+                        continue
+                    memo_key = ("aggc", seg.uid, agg_json)
+                    aplans = stats.memo.get(memo_key)
+                    if aplans is None:
+                        aplans = compile_aggs(device_agg_nodes,
+                                              self.reader.mapper,
+                                              seg, meta, compiler)
+                        if len(stats.memo) > 8192:
+                            stats.memo.clear()
+                        stats.memo[memo_key] = aplans
+                    agg_plans_per_seg.append(aplans)
+            except Exception:
+                # an agg/query shape the batch program can't express (or a
+                # user error): the general path raises it properly
+                responses[i] = self.search(body, _direct=True)
+                continue
             compiled[i] = plans
+            if agg_nodes:
+                agg_by_i[i] = agg_plans_per_seg
+                agg_nodes_by_i[i] = agg_nodes
             # no tie overfetch needed: per-segment top-k by score with
             # doc-asc tie-break (lax.top_k picks the lowest index) merges
-            # to the exact global page for score-sorted queries
-            k = max(from_ + size, 10)
+            # to the exact global page for score-sorted queries; size=0
+            # (agg/count-only) requests skip hit selection entirely
+            k = 0 if from_ + size == 0 else max(from_ + size, 10)
+            if agg_nodes and all(p is None or p.kind == "match_none"
+                                 for p in plans):
+                # empty-match WITH aggs still owes fully-shaped empty agg
+                # structures — the general path builds those
+                responses[i] = self.search(body, _direct=True)
+                continue
             if all(p is None or p.kind == "match_none" for p in plans):
                 # no term matched any segment: answer host-side, zero
                 # device work (the can-match pre-filter analog)
@@ -821,10 +1032,20 @@ class SearchExecutor:
                 continue
             struct = tuple(plan_struct(p) if p is not None else None
                            for p in plans)
-            flats = [p.flatten_inputs([]) if p is not None else None
-                     for p in plans]
+            flats = []
+            for p, aplans in zip(plans, agg_plans_per_seg):
+                if p is None:
+                    flats.append(None)
+                    continue
+                flat = p.flatten_inputs([])
+                for ap in aplans:
+                    ap.flatten_inputs(flat)
+                flats.append(flat)
             flats_by_i[i] = flats
-            groups.setdefault((struct, _flat_shape_sig(flats),
+            agg_sig = tuple(tuple(ap.sig() for ap in aplans)
+                            for aplans in agg_plans_per_seg) \
+                if agg_nodes else None
+            groups.setdefault((struct, agg_sig, _flat_shape_sig(flats),
                                min(k, 1 << 16)), []).append(i)
 
         entry_by_i = {e[0]: e for e in batchable}
@@ -836,7 +1057,7 @@ class SearchExecutor:
         # get min_score=+inf, matching nothing) so executables are reused
         # across varying msearch batch sizes.
         pending = []
-        for (struct, shape_sig, k_fetch), idxs in groups.items():
+        for (struct, agg_sig, shape_sig, k_fetch), idxs in groups.items():
             b_pad = pad_bucket(len(idxs), minimum=1)
             pad_rows = b_pad - len(idxs)
             min_scores = np.asarray(
@@ -848,18 +1069,30 @@ class SearchExecutor:
                     continue
                 group_flats = [flats_by_i[i][seg_i] for i in idxs]
                 group_flats += [group_flats[0]] * pad_rows
-                stacked, treedef = stack_flat_inputs(group_flats)
+                stacked, treedef, axes = stack_flat_inputs(
+                    group_flats, with_const=agg_sig is not None)
                 stacked.append(min_scores)
+                axes.append(0)
                 buf, layout = pack_leaves(stacked)
                 k_seg = min(k_fetch, pad_bucket(max(seg.num_docs, 1)))
                 plan0 = compiled[idxs[0]][seg_i]
-                fn = _envelope_runner(plan_struct(plan0), plan0, meta,
-                                      k_seg, layout, treedef)
-                pending.append((idxs, seg_i, k_seg,
-                                fn(arrays, jnp.asarray(buf))))
+                if agg_sig is not None:
+                    fn, out_layout, agg_w = _agg_envelope_runner(
+                        plan_struct(plan0), plan0, meta, k_seg, layout,
+                        treedef, tuple(axes), agg_sig[seg_i],
+                        agg_by_i[idxs[0]][seg_i], arrays, group_flats[0])
+                    pending.append((idxs, seg_i, k_seg,
+                                    fn(arrays, jnp.asarray(buf)),
+                                    out_layout))
+                else:
+                    fn = _envelope_runner(plan_struct(plan0), plan0, meta,
+                                          k_seg, layout, treedef)
+                    pending.append((idxs, seg_i, k_seg,
+                                    fn(arrays, jnp.asarray(buf)), None))
         _ph["stack_pack_dispatch"] += time.monotonic() - _t
         return {"groups": groups, "entry_by_i": entry_by_i,
-                "pending": pending}
+                "pending": pending, "agg_by_i": agg_by_i,
+                "agg_nodes_by_i": agg_nodes_by_i}
 
     def _msearch_finish(self, state, responses, start):
         """Wave half 2: ONE device_get for the wave's outputs (concatenated
@@ -868,31 +1101,41 @@ class SearchExecutor:
         _t = time.monotonic()
         groups, entry_by_i, pending = (state["groups"], state["entry_by_i"],
                                        state["pending"])
+        agg_by_i = state.get("agg_by_i") or {}
+        agg_nodes_by_i = state.get("agg_nodes_by_i") or {}
         grouped = [i for idxs in groups.values() for i in idxs]
         per_query_segs: Dict[int, List[Tuple[int, np.ndarray, np.ndarray]]] = \
             {i: [] for i in grouped}
         per_query_total: Dict[int, int] = {i: 0 for i in grouped}
+        per_query_decoded: Dict[int, list] = {i: [] for i in agg_by_i}
         if not pending:
             return
         if len(pending) > 1:
             combined = np.asarray(jax.device_get(_concat_rows(
-                tuple(packed for _, _, _, packed in pending))))
+                tuple(packed for _, _, _, packed, _ in pending))))
             fetched = []
             row = 0
-            for _, _, k_seg, packed in pending:
-                rows = packed.shape[0]
-                fetched.append(combined[row:row + rows, :2 * k_seg + 1])
+            for _, _, _, packed, _ in pending:
+                rows, width = packed.shape
+                fetched.append(combined[row:row + rows, :width])
                 row += rows
         else:
             fetched = jax.device_get(
-                [packed for _, _, _, packed in pending])
+                [packed for _, _, _, packed, _ in pending])
         _ph["device_get"] += time.monotonic() - _t; _t = time.monotonic()
-        for (idxs, seg_i, k_seg, _), packed in zip(pending, fetched):
+        for (idxs, seg_i, k_seg, _, out_layout), packed in zip(pending,
+                                                               fetched):
+            packed = np.asarray(packed)
             scores_b, idx_b, total_b = unpack_batched_result(
-                np.asarray(packed), k_seg)
+                packed[:, :2 * k_seg + 1], k_seg)
             for row, i in enumerate(idxs):
                 per_query_total[i] += int(total_b[row])
                 per_query_segs[i].append((seg_i, scores_b[row], idx_b[row]))
+                if out_layout is not None:
+                    outs = _decode_agg_row(packed[row, 2 * k_seg + 1:],
+                                           out_layout)
+                    per_query_decoded[i].append(
+                        decode_outputs(agg_by_i[i][seg_i], outs))
 
         for i, seg_results in per_query_segs.items():
             _, body, _, size, from_, _ = entry_by_i[i]
@@ -939,6 +1182,22 @@ class SearchExecutor:
                     "hits": hits,
                 },
             }
+            if i in agg_by_i:
+                from opensearch_tpu.search.aggs.pipeline import \
+                    apply_pipelines
+                aggregations = reduce_aggs(per_query_decoded[i])
+                apply_pipelines(agg_nodes_by_i[i], aggregations)
+                responses[i]["aggregations"] = aggregations
+            key = state.get("resp_cache_keys", {}).get(i)
+            if key is not None:
+                # stored as JSON (the reference caches serialized shard
+                # results too) so later caller mutations can't leak in
+                from opensearch_tpu.indices.request_cache import \
+                    REQUEST_CACHE
+                try:
+                    REQUEST_CACHE.put(key, json.dumps(responses[i]))
+                except (TypeError, ValueError):
+                    pass        # unserializable value: just don't cache
         _ph["respond"] += time.monotonic() - _t
 
     def count(self, body: Optional[dict] = None) -> int:

@@ -130,20 +130,23 @@ def _eval_plan(plan: Plan, seg: Dict, inputs: List[Dict], cursor: List[int]):
 
     if kind == "num_terms":
         col = seg["numeric"][plan.static[0]]
+        ident = plan.static[1] if len(plan.static) > 1 else False
         matches = ordinal_terms_match(col["doc_ids"], col["val_ords"],
-                                      my["mask"], d_pad)
+                                      my["mask"], d_pad, ident)
         return jnp.where(matches, my["boost"], 0.0), matches
 
     if kind == "range_num":
         col = seg["numeric"][plan.static[0]]
+        ident = plan.static[1] if len(plan.static) > 1 else False
         matches = range_match_on_ranks(col["doc_ids"], col["val_ords"],
-                                       my["lo"], my["hi"], d_pad)
+                                       my["lo"], my["hi"], d_pad, ident)
         return jnp.where(matches, my["boost"], 0.0), matches
 
     if kind == "range_ord":
         col = seg["ordinal"][plan.static[0]]
+        ident = plan.static[1] if len(plan.static) > 1 else False
         matches = range_match_on_ranks(col["doc_ids"], col["ords"],
-                                       my["lo"], my["hi"], d_pad)
+                                       my["lo"], my["hi"], d_pad, ident)
         return jnp.where(matches, my["boost"], 0.0), matches
 
     if kind == "exists":

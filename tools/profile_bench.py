@@ -104,7 +104,7 @@ def main():
         t0 = time.perf_counter()
         group_flats = [flats_all[i] for i in idxs]
         group_flats += [group_flats[0]] * (b_pad - len(idxs))
-        stacked, treedef = stack_flat_inputs(group_flats)
+        stacked, treedef, _axes = stack_flat_inputs(group_flats)
         stacked.append(np.full(b_pad, -1e38, np.float32))
         t_stack += time.perf_counter() - t0
         t0 = time.perf_counter()
