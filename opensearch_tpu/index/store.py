@@ -92,6 +92,7 @@ class Store:
             if col.ivf is not None:
                 arrays[f"ivf_c::{f}"] = col.ivf.centroids
                 arrays[f"ivf_l::{f}"] = col.ivf.lists
+                arrays[f"ivf_bc::{f}"] = col.ivf.block_centroid
                 ivf_meta[f] = {"nlist": col.ivf.nlist,
                                "nprobe": col.ivf.nprobe}
         # ragged positions → flat + offsets per (field, term)
@@ -174,10 +175,20 @@ class Store:
         for f in vec_fields:
             col = VectorColumn(z[f"vec::{f}"], z[f"vec_exists::{f}"])
             if f in meta.get("ivf", {}):
-                from opensearch_tpu.ops.knn import IVFIndex
+                from opensearch_tpu.ops.knn import IVFIndex, build_ivf
                 im = meta["ivf"][f]
-                col.ivf = IVFIndex(z[f"ivf_c::{f}"], z[f"ivf_l::{f}"],
-                                   nlist=im["nlist"], nprobe=im["nprobe"])
+                if f"ivf_bc::{f}" in z.files:
+                    col.ivf = IVFIndex(z[f"ivf_c::{f}"], z[f"ivf_l::{f}"],
+                                       z[f"ivf_bc::{f}"],
+                                       nlist=im["nlist"],
+                                       nprobe=im["nprobe"])
+                else:
+                    # pre-block-layout store (no block_centroid array and
+                    # [nlist, max_len] lists): rebuild the IVF structure
+                    # from the vectors instead of mis-reading old shapes
+                    col.ivf = build_ivf(col.vectors, col.exists,
+                                        nlist=im["nlist"],
+                                        nprobe=im["nprobe"])
             vector_dv[f] = col
         term_dict = {(f, t): TermMeta(df, ttf, sb, nb)
                      for f, t, df, ttf, sb, nb in meta["term_dict"]}

@@ -150,8 +150,12 @@ def upload_segment(seg: Segment, to_device: bool = True):
         exists[:seg.num_docs] = col.exists
         entry = {"vectors": vecs, "exists": exists}
         if col.ivf is not None:
+            from opensearch_tpu.ops.knn import pack_ivf_lists
+            packed, flat_ids = pack_ivf_lists(col.vectors, col.ivf.lists)
             entry["ivf_centroids"] = col.ivf.centroids
-            entry["ivf_lists"] = col.ivf.lists
+            entry["ivf_block_centroid"] = col.ivf.block_centroid
+            entry["ivf_packed_vecs"] = packed
+            entry["ivf_packed_ids"] = flat_ids
         arrays["vector"][fname] = entry
 
     if to_device:

@@ -86,11 +86,25 @@ def knn_match_topk(scores: jnp.ndarray, eligible: jnp.ndarray,
 
 # ------------------------------------------------------------------- IVF ----
 
+# fixed block width for inverted-list storage: probing slices whole
+# blocks, so the per-query candidate count is budget · IVF_BLOCK
+# regardless of how imbalanced the clusters are (a worst-case list no
+# longer inflates every probe — the round-4 layout padded ALL lists to
+# the longest list's length, making nprobe·max_len ≈ the whole corpus)
+IVF_BLOCK = 256
+
+
 @dataclass
 class IVFIndex:
-    """Host-side IVF structure attached to a VectorColumn at seal time."""
-    centroids: np.ndarray    # [nlist, dims] float32
-    lists: np.ndarray        # [nlist, max_len] int32 doc ords, -1 padded
+    """Host-side IVF structure attached to a VectorColumn at seal time.
+
+    Lists are stored as fixed-width BLOCKS: `lists[i]` is one block of
+    IVF_BLOCK doc ords (-1 padded) owned by centroid
+    `block_centroid[i]`; a cluster with many members spans several
+    consecutive blocks."""
+    centroids: np.ndarray        # [nlist, dims] float32
+    lists: np.ndarray            # [n_blocks, IVF_BLOCK] int32, -1 padded
+    block_centroid: np.ndarray   # int32 [n_blocks] owning centroid
     nlist: int
     nprobe: int              # default probe count from the mapping
 
@@ -133,40 +147,83 @@ def build_ivf(vectors: np.ndarray, exists: np.ndarray, nlist: int,
     dn = (data ** 2).sum(axis=1, keepdims=True)
     cn = (centroids ** 2).sum(axis=1)
     assign = np.argmin(dn - 2 * dots + cn, axis=1)
-    max_len = max(int(np.bincount(assign, minlength=nlist).max()), 1)
-    # pad to a lane-friendly width
-    max_len = ((max_len + 127) // 128) * 128
-    lists = np.full((nlist, max_len), -1, dtype=np.int32)
+    blocks = []
+    block_centroid = []
     for c in range(nlist):
         members = present[assign == c]
-        lists[c, :len(members)] = members
+        # empty clusters emit NO block: an all-padding block would still
+        # win probe-budget slots whenever its centroid lands near the
+        # query, displacing blocks with real candidates
+        for off in range(0, len(members), IVF_BLOCK):
+            chunk = members[off:off + IVF_BLOCK]
+            row = np.full(IVF_BLOCK, -1, dtype=np.int32)
+            row[:len(chunk)] = chunk
+            blocks.append(row)
+            block_centroid.append(c)
+    if not blocks:          # no vectors at all: one padding block keeps
+        blocks.append(np.full(IVF_BLOCK, -1, dtype=np.int32))
+        block_centroid.append(0)        # shapes valid for the scan
+    lists = np.stack(blocks)
     if nprobe <= 0:
         nprobe = max(1, nlist // 8)
-    return IVFIndex(centroids=centroids, lists=lists, nlist=nlist,
-                    nprobe=nprobe)
+    return IVFIndex(centroids=centroids, lists=lists,
+                    block_centroid=np.asarray(block_centroid, np.int32),
+                    nlist=nlist, nprobe=nprobe)
 
 
-def ivf_knn_scores(vectors: jnp.ndarray, centroids: jnp.ndarray,
-                   lists: jnp.ndarray, query: jnp.ndarray, space: str,
+def pack_ivf_lists(vectors: np.ndarray, lists: np.ndarray):
+    """List-contiguous copies of the vector rows + their doc ords.
+
+    IVF probing gathers ~nprobe·max_len arbitrary vector rows per query;
+    XLA lowers that gather to a scalar loop on CPU and a serial path on
+    TPU, and it dominated the IVF scan. With the rows laid out list-major
+    at build time, each probed list is ONE contiguous dynamic_slice —
+    pure copies + matmul. Costs a second copy of the vector matrix
+    (inflated by list padding) in exchange."""
+    flat = lists.reshape(-1)
+    safe = np.where(flat >= 0, flat, 0)
+    packed = np.ascontiguousarray(vectors[safe].astype(np.float32))
+    packed[flat < 0] = 0.0
+    return packed, np.ascontiguousarray(flat.astype(np.int32))
+
+
+def ivf_knn_scores(packed_vecs: jnp.ndarray, packed_ids: jnp.ndarray,
+                   centroids: jnp.ndarray, block_centroid: jnp.ndarray,
+                   d: int, query: jnp.ndarray, space: str,
                    nprobe: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """IVF probe: returns (dense scores [D], candidate mask [D]).
 
-    Scores are exact for candidate docs (the nprobe nearest lists);
-    non-candidates are masked out — the standard IVF recall/compute trade."""
+    Scores are exact for candidate docs; non-candidates are masked out —
+    the standard IVF recall/compute trade. Blocks are ranked by their
+    owning centroid's distance and the best `budget` blocks are sliced
+    CONTIGUOUSLY from the packed copy (see pack_ivf_lists) — no row
+    gather, and the probe budget is independent of cluster imbalance
+    (budget ≈ nprobe · avg-blocks-per-list; a skewed list legitimately
+    consumes more of the budget because it holds more of the mass)."""
     _check_space(space)
     # centroid ranking always by L2 (clusters were built in L2 space); for
     # innerproduct/cosine the probe order still correlates (faiss does the
     # same for IVF+IP via L2-clustered coarse quantizers)
     cd = jnp.sum(centroids * centroids, axis=1) - 2.0 * (centroids @ query)
-    nprobe_eff = min(int(nprobe), int(centroids.shape[0]))
-    _, probe_ids = jax.lax.top_k(-cd, nprobe_eff)
-    cand = lists[probe_ids].reshape(-1)              # [nprobe * max_len]
-    valid = cand >= 0
-    d = vectors.shape[0]
-    cand_gather = jnp.where(valid, cand, 0)          # safe gather index
-    cand_vecs = vectors[cand_gather]                 # gather [C, dims]
+    nlist = int(centroids.shape[0])
+    n_blocks = int(block_centroid.shape[0])
+    nprobe_eff = min(int(nprobe), nlist)
+    budget = min(n_blocks,
+                 -(-nprobe_eff * n_blocks // nlist) + 1)
+    key = cd[block_centroid]                         # [n_blocks] tiny
+    _, blk_ids = jax.lax.top_k(-key, budget)
+    dims = packed_vecs.shape[1]
+    # BLOCK-level gather: each gathered element is a contiguous
+    # [IVF_BLOCK, dims] chunk (a memcpy, not the per-row scalar gather
+    # this layout exists to avoid), and the graph stays O(1) in budget
+    cand_vecs = jnp.take(packed_vecs.reshape(n_blocks, IVF_BLOCK, dims),
+                         blk_ids, axis=0).reshape(budget * IVF_BLOCK,
+                                                  dims)
+    cand = jnp.take(packed_ids.reshape(n_blocks, IVF_BLOCK),
+                    blk_ids, axis=0).reshape(budget * IVF_BLOCK)
     raw = raw_similarity(cand_vecs, query, space)
     scores01 = space_score(raw, space)
+    valid = cand >= 0
     # padding slots scatter out of bounds (dropped) — using index 0 would
     # overwrite doc ord 0's entries
     cand_scatter = jnp.where(valid, cand, d)

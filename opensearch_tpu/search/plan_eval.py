@@ -172,7 +172,8 @@ def _eval_plan(plan: Plan, seg: Dict, inputs: List[Dict], cursor: List[int]):
             eligible = eligible & fmatches
         if method == "ivf":
             scores, cand = ivf_knn_scores(
-                col["vectors"], col["ivf_centroids"], col["ivf_lists"],
+                col["ivf_packed_vecs"], col["ivf_packed_ids"],
+                col["ivf_centroids"], col["ivf_block_centroid"], d_pad,
                 my["query"], space, nprobe)
             eligible = eligible & cand
         else:
