@@ -210,11 +210,18 @@ class HbmShardSet:
 
     def __init__(self, searcher: "DistributedSearcher",
                  shard_arrays: Sequence[Dict], metas: Sequence[Any]):
-        if len(shard_arrays) != searcher.n_shards \
-                or len(metas) != searcher.n_shards:
+        if not shard_arrays or len(shard_arrays) != len(metas):
             raise ValueError(
-                f"{len(shard_arrays)} shard trees / {len(metas)} metas for "
-                f"{searcher.n_shards}-device mesh")
+                f"{len(shard_arrays)} shard trees / {len(metas)} metas")
+        n = searcher.n_shards
+        # rows pack: ceil(R / n) rows per device, padded with copies of
+        # row 0 (made inert at query time via a +inf per-row min_score)
+        rpd = -(-len(shard_arrays) // n)
+        pad = n * rpd - len(shard_arrays)
+        shard_arrays = list(shard_arrays) + [shard_arrays[0]] * pad
+        metas = list(metas) + [metas[0]] * pad
+        self.n_rows = len(shard_arrays) - pad
+        self.rows_per_dev = rpd
         self.mesh = searcher.mesh
         self.meta = canonical_meta(metas)
         stack = pad_stack_trees(shard_arrays)
@@ -244,19 +251,24 @@ class DistributedSearcher:
         self._cache: Dict[Any, Any] = {}
 
     def runner(self, cache_key, plan: Plan, meta, k: int,
-               agg_plans: Tuple = ()):
-        key = (cache_key, meta, k)
+               agg_plans: Tuple = (), rows_per_dev: int = 1,
+               sort_spec: Optional[Tuple[str, str]] = None):
+        key = (cache_key, meta, k, rows_per_dev, sort_spec)
         fn = self._cache.get(key)
         if fn is not None:
             return fn
 
         axis = self.axis
         d_pad = meta.d_pad
+        # per-row capacity is d_pad, but the MERGED result may need up to
+        # k candidates drawn from many small rows — each merge level keeps
+        # min(k, what its inputs can hold)
         k_eff = min(k, d_pad)
+        rpd = rows_per_dev
+        k_local = min(k, rpd * k_eff)
+        k_merge = min(k, self.n_shards * k_local)
 
-        def local_query_phase(seg, flat_inputs, min_score):
-            seg = _squeeze0(seg)
-            flat_inputs = _squeeze0(flat_inputs)
+        def one_row(seg, flat_inputs, min_score):
             cursor = [0]
             scores, matches = _eval_plan(plan, seg, flat_inputs, cursor)
             # `live` is False on padding rows (ops/device_segment.py), so no
@@ -264,33 +276,76 @@ class DistributedSearcher:
             eligible = matches & seg["live"] & seg["root"] \
                 & (scores >= min_score)
             local_total = jnp.sum(eligible.astype(jnp.int32))
-            masked = jnp.where(eligible, scores, NEG_INF)
+            if sort_spec is None:
+                keys = scores
+            else:
+                # numeric field sort: the merge key is the doc's decoded
+                # f32 VALUE (comparable across segments, unlike the
+                # host path's segment-local ranks); eligibility
+                # (search/spmd.py:_spmd_sort_spec) admits only columns
+                # whose values are EXACTLY f32-representable and within
+                # ±1e29, so selection matches the host path's exact-key
+                # selection; asc keys negate, a missing field sorts last
+                # (sentinel below the admitted value range but above the
+                # NEG_INF ineligibility mask), and the host re-keys the
+                # k winners with exact f64 values for the final order
+                field, order = sort_spec
+                col = seg["numeric"].get(field)
+                if col is None:
+                    # mapper declares the field but no doc in any row has
+                    # it: every doc sorts as missing
+                    keys = jnp.full(d_pad, jnp.float32(-1e30))
+                else:
+                    u = col["unique_f32"]
+                    hi = u.shape[0] - 1
+                    if order == "asc":
+                        val = u[jnp.clip(col["min_rank"], 0, hi)]
+                        keys = -val
+                    else:
+                        val = u[jnp.clip(col["max_rank"], 0, hi)]
+                        keys = val
+                    keys = jnp.where(col["exists"], keys,
+                                     jnp.float32(-1e30))
+            masked = jnp.where(eligible, keys, NEG_INF)
             top_keys, top_idx = jax.lax.top_k(masked, k_eff)
-            shard_i = jax.lax.axis_index(axis)
-            gids = shard_i * d_pad + top_idx.astype(jnp.int32)
+            top_scores = scores[top_idx]
 
             agg_outs = []
             if agg_plans:
                 eval_aggs(list(agg_plans), seg, flat_inputs, cursor, eligible,
                           agg_outs)
+            return (top_keys, top_scores, top_idx.astype(jnp.int32),
+                    local_total, agg_outs)
 
-            # partial reduce on ICI: gather every shard's candidates,
-            # replicated top-k merge — SearchPhaseController.mergeTopDocs
-            # as one collective + one sort instead of a coordinator RPC round
-            gk = jax.lax.all_gather(top_keys, axis, tiled=True)
-            gg = jax.lax.all_gather(gids, axis, tiled=True)
-            mk, mi = jax.lax.top_k(gk, k_eff)
+        def local_query_phase(seg, flat_inputs, min_scores):
+            # block shape: [rpd, ...] rows packed on this device
+            tk, ts, ti, tot, agg_outs = jax.vmap(one_row)(seg, flat_inputs,
+                                                          min_scores)
+            shard_i = jax.lax.axis_index(axis)
+            row_ids = shard_i * rpd + jnp.arange(rpd, dtype=jnp.int32)
+            gids = row_ids[:, None] * d_pad + ti            # [rpd, k]
+            # intra-device merge across packed rows, then the ICI merge:
+            # gather every device's candidates, replicated top-k —
+            # SearchPhaseController.mergeTopDocs as one collective + one
+            # sort instead of a coordinator RPC round per shard
+            lk, li = jax.lax.top_k(tk.reshape(-1), k_local)
+            lg = gids.reshape(-1)[li]
+            ls = ts.reshape(-1)[li]
+            gk = jax.lax.all_gather(lk, axis, tiled=True)
+            gg = jax.lax.all_gather(lg, axis, tiled=True)
+            gs = jax.lax.all_gather(ls, axis, tiled=True)
+            mk, mi = jax.lax.top_k(gk, k_merge)
             mg = gg[mi]
-            total = jax.lax.psum(local_total, axis)
-            agg_outs = jax.tree_util.tree_map(
-                lambda o: jnp.expand_dims(o, 0), agg_outs)
-            return mk, mg, total, agg_outs
+            ms = gs[mi]
+            total = jax.lax.psum(jnp.sum(tot), axis)
+            return mk, ms, mg, total, agg_outs
 
-        in_specs = (P(axis), P(axis), P())
+        in_specs = (P(axis), P(axis), P(axis))
         # eval_aggs appends one output dict per node in traversal order
-        # (children included), not one per top-level plan
+        # (children included), not one per top-level plan; vmapped rows
+        # keep a leading [rpd] axis that P(axis) concatenates to [R_pad]
         n_agg_outs = sum(_count_agg_nodes(a) for a in agg_plans)
-        out_specs = (P(), P(), P(), [P(axis)] * n_agg_outs)
+        out_specs = (P(), P(), P(), P(), [P(axis)] * n_agg_outs)
         fn = jax.jit(_shard_map(
             local_query_phase, mesh=self.mesh,
             in_specs=in_specs, out_specs=out_specs))
@@ -304,7 +359,8 @@ class DistributedSearcher:
 
     def search(self, shard_payloads: List[Tuple[Dict, List[Dict], Any]],
                plan: Plan, k: int, min_score: float = float(NEG_INF),
-               agg_plans: Tuple = ()):
+               agg_plans: Tuple = (),
+               sort_spec: Optional[Tuple[str, str]] = None):
         """One-shot convenience: uploads per-shard (arrays, flat_inputs,
         meta) payloads and queries them. For repeated queries over the same
         segments use build_shard_set() + search_resident() — this path pays
@@ -314,45 +370,66 @@ class DistributedSearcher:
         return self.search_resident(shard_set,
                                     [p[1] for p in shard_payloads],
                                     plan, k, min_score=min_score,
-                                    agg_plans=agg_plans)
+                                    agg_plans=agg_plans,
+                                    sort_spec=sort_spec)
 
     def search_resident(self, shard_set: HbmShardSet,
                         flat_inputs: Sequence[List[Dict]], plan: Plan,
                         k: int, min_score: float = float(NEG_INF),
-                        agg_plans: Tuple = ()):
+                        agg_plans: Tuple = (),
+                        sort_spec: Optional[Tuple[str, str]] = None):
         """Run the distributed query phase against HBM-resident segments:
         only the flat plan inputs (query constants — term ids, weights,
         range bounds) travel host→device per query.
 
-        Returns (merged_scores [k], shard_idx [k], local_ords [k], total,
-        per-shard agg partial outputs). Agg partials keep a leading shard
-        dimension; the caller decodes each shard's slice with that shard's
-        own agg plans (ordinal spaces are segment-local)."""
-        if len(flat_inputs) != self.n_shards:
+        More rows than devices pack `rows_per_dev` rows per device (an
+        inner vmap; the intra-device merge happens before the ICI
+        gather). sort_spec=(numeric_field, order) merges by decoded field
+        value instead of score.
+
+        Returns (merged_keys [<=k], scores [<=k], row_idx [<=k],
+        local_ords [<=k], total, per-row agg partial outputs). Agg
+        partials keep a leading row dimension; the caller decodes each
+        row's slice with that row's own agg plans (ordinal spaces are
+        segment-local)."""
+        if len(flat_inputs) != shard_set.n_rows:
             raise ValueError(
-                f"{len(flat_inputs)} flat-input lists for "
-                f"{self.n_shards}-device mesh")
+                f"{len(flat_inputs)} flat-input lists for a "
+                f"{shard_set.n_rows}-row shard set")
         if shard_set.mesh is not self.mesh:
             # a foreign-mesh shard set would be silently re-sharded (a full
             # segment copy) by jit on every call — exactly what residency
             # exists to prevent
             raise ValueError("shard_set was built for a different mesh")
         meta = shard_set.meta
-        flat_stack = pad_stack_trees(list(flat_inputs))
+        rpd = shard_set.rows_per_dev
+        r_pad = self.n_shards * rpd
+        pad = r_pad - len(flat_inputs)
+        flat_inputs = list(flat_inputs) + [flat_inputs[0]] * pad
+        # padding rows are neutralized by a +inf min_score: nothing is
+        # eligible, so they add no candidates, no totals, empty aggs
+        min_scores = np.full(r_pad, np.inf, np.float32)
+        min_scores[:shard_set.n_rows] = min_score
+        flat_stack = pad_stack_trees(flat_inputs)
         flat_stack = _device_put_sharded_tree(flat_stack, self.mesh,
                                               self.axis)
+        min_stack = _device_put_sharded_tree(min_scores, self.mesh,
+                                             self.axis)
         cache_key = (plan_struct(plan),
                      tuple(plan_struct(a) for a in agg_plans),
                      shard_set.shapes, _tree_shapes(flat_stack))
-        fn = self.runner(cache_key, plan, meta, k, agg_plans)
-        keys, gids, total, agg_outs = fn(shard_set.seg_stack, flat_stack,
-                                         jnp.float32(min_score))
+        fn = self.runner(cache_key, plan, meta, k, agg_plans,
+                         rows_per_dev=rpd, sort_spec=sort_spec)
+        keys, scores, gids, total, agg_outs = fn(
+            shard_set.seg_stack, flat_stack, min_stack)
         keys = np.asarray(keys)
+        scores = np.asarray(scores)
         gids = np.asarray(gids)
-        shard_idx = gids // meta.d_pad
+        row_idx = gids // meta.d_pad
         ords = gids % meta.d_pad
         valid = keys > NEG_INF / 2
-        return (keys[valid], shard_idx[valid], ords[valid], int(total),
+        return (keys[valid], scores[valid], row_idx[valid], ords[valid],
+                int(total),
                 jax.tree_util.tree_map(np.asarray, agg_outs))
 
 

@@ -18,9 +18,14 @@ the SPMD program the default executor for multi-row searches:
     action/search/AbstractSearchAsyncAction.java:264 does this as a
     coordinator RPC round per shard).
 
-Falls back to the host loop when the request shape doesn't fit (fewer rows
-than 2, more rows than devices, non-uniform plan structure across rows, or
-field-sorted requests, which need the host sort-key path).
+More rows than devices PACK: ceil(rows/devices) rows per device with an
+inner vmap and an intra-device merge before the ICI gather, so a
+16-segment index serves through an 8-chip mesh. Single-key numeric field
+sorts ride the collective merge too (decoded f32 value keys; the host
+re-keys the k winners with exact values). Falls back to the host loop
+when the request shape doesn't fit (fewer rows than 2, more rows than
+devices × SPMD_MAX_PACK, non-uniform plan structure across rows, keyword
+or multi-key sorts).
 """
 
 from __future__ import annotations
@@ -42,18 +47,22 @@ from opensearch_tpu.search.compile import Compiler
 SPMD_QUERIES = [0]
 SPMD_UPLOADS = [0]
 
-_SEARCHERS: Dict[int, Any] = {}       # n_rows -> DistributedSearcher
+_SEARCHERS: Dict[int, Any] = {}       # mesh size -> DistributedSearcher
 _SHARD_SETS: Dict[Any, Any] = {}      # residency cache (bounded)
 _MAX_SHARD_SETS = 4
+# rows pack up to this many per device before falling back to the host
+# loop (an HBM-sizing heuristic: the stacked image grows linearly)
+SPMD_MAX_PACK = 8
 
 
 def _searcher(n_rows: int):
     from opensearch_tpu.parallel.distributed import (DistributedSearcher,
                                                      make_mesh)
-    s = _SEARCHERS.get(n_rows)
+    n = min(n_rows, len(jax.devices()))
+    s = _SEARCHERS.get(n)
     if s is None:
-        s = DistributedSearcher(make_mesh(n_rows))
-        _SEARCHERS[n_rows] = s
+        s = DistributedSearcher(make_mesh(n))
+        _SEARCHERS[n] = s
     return s
 
 
@@ -67,12 +76,69 @@ def spmd_rows(executors: List) -> List[Tuple[int, int]]:
     return rows
 
 
+def _f32_sortable(col) -> bool:
+    """The SPMD merge keys sort by decoded f32 values: admit a column
+    only when every unique value is EXACTLY f32-representable (selection
+    then matches the host path's exact keys) and within the sentinel
+    range. Memoized on the immutable column. Epoch-millis dates usually
+    fail (f32 spacing ~131 s at 2e12) and take the host path."""
+    cached = getattr(col, "_f32_sortable", None)
+    if cached is None:
+        u = col.unique
+        cached = bool(
+            len(u) == 0
+            or (np.all(np.abs(u) < 1e29)
+                and np.array_equal(u.astype(np.float32).astype(np.float64),
+                                   u)))
+        col._f32_sortable = cached
+    return cached
+
+
+def _spmd_sort_spec(executors: List, sort_specs):
+    """None for score sort; (field, order) for a supported single-key
+    numeric field sort; False when the sort needs the host path."""
+    specs = list(sort_specs)
+    if specs == [("_score", "desc")]:
+        return None
+    if len(specs) != 1:
+        return False
+    field, order = specs[0]
+    if field == "_score":
+        return False
+    ft = executors[0].reader.mapper.get_field(field)
+    if ft is None or not (ft.is_numeric or ft.is_date or ft.is_bool):
+        return False        # keyword ords aren't comparable across rows
+    for ex in executors:
+        for seg in ex.reader.segments:
+            col = seg.numeric_dv.get(field)
+            if col is not None and not _f32_sortable(col):
+                return False
+    return (field, order)
+
+
+class force_host_loop:
+    """Context manager pinning searches to the host per-segment loop
+    (tests of host-loop-only behaviors: can-match skip reporting, filter
+    cache splicing; and ground-truth parity comparisons)."""
+
+    def __enter__(self):
+        global eligible
+        self._orig = eligible
+        globals()["eligible"] = lambda *a, **k: False
+        return self
+
+    def __exit__(self, *exc):
+        globals()["eligible"] = self._orig
+        return False
+
+
 def eligible(executors: List, body: dict, rows: List[Tuple[int, int]],
              sort_specs) -> bool:
-    if len(rows) < 2 or len(rows) > len(jax.devices()):
+    if len(rows) < 2 \
+            or len(rows) > len(jax.devices()) * SPMD_MAX_PACK:
         return False
-    if list(sort_specs) != [("_score", "desc")]:
-        return False        # field sort needs the host sort-key path
+    if _spmd_sort_spec(executors, sort_specs) is False:
+        return False        # keyword/multi-key sort: host sort-key path
     if body.get("search_type") == "dfs_query_then_fetch":
         return False        # DFS pins per-shard StaticStats (host loop)
     if body.get("slice") is not None:
@@ -175,23 +241,39 @@ def _spmd_query_phase_raw(executors: List, body: dict, k: int,
             ap.flatten_inputs(flat)
         flat_rows.append(flat)
 
+    from opensearch_tpu.search.executor import _parse_sort, _sort_value
+    sort_specs = _parse_sort(body.get("sort"))
+    sort_spec = _spmd_sort_spec(executors, sort_specs)
+    if sort_spec is False:
+        return None
+
     searcher = _searcher(len(rows))
     try:
         shard_set = _resident_shard_set(searcher, executors, rows)
-        keys, shard_idx, ords, total, agg_outs = searcher.search_resident(
-            shard_set, flat_rows, plans[0], k, min_score=min_score,
-            agg_plans=agg_plans_rows[0])
-    except ValueError:
+        keys, scores, row_idx, ords, total, agg_outs = \
+            searcher.search_resident(
+                shard_set, flat_rows, plans[0], k, min_score=min_score,
+                agg_plans=agg_plans_rows[0], sort_spec=sort_spec)
+    except (ValueError, KeyError):
         # e.g. a cross-index search whose rows have mismatched field
         # layouts (canonical_meta rejects them) — host loop handles it
         return None
     SPMD_QUERIES[0] += 1
 
     cand_tuples = []
-    for score, row_i, ord_ in zip(keys, shard_idx, ords):
+    for score, row_i, ord_ in zip(scores, row_idx, ords):
         shard_i, seg_i = rows[int(row_i)]
+        if sort_spec is None:
+            sort_values = [float(score)]
+        else:
+            # exact host re-key: the device merged on decoded f32 values;
+            # the final cross-candidate order uses exact column values
+            seg = executors[shard_i].reader.segments[seg_i]
+            sort_values = [float(score) if f == "_score"
+                           else _sort_value(seg, f, o, int(ord_))
+                           for f, o in sort_specs]
         cand_tuples.append((float(score), seg_i, int(ord_),
-                            [float(score)], shard_i))
+                            sort_values, shard_i))
 
     decoded = []
     if agg_nodes:

@@ -45,9 +45,13 @@ def node():
 
 
 def search(node, query, **kw):
-    body = {"query": query, "sort": [{"ts": "asc"}]}  # field sort: host loop
+    # can-match skipping is a host-loop behavior: the SPMD program scans
+    # all rows in lockstep (a skipped row saves nothing on the mesh)
+    from opensearch_tpu.search.spmd import force_host_loop
+    body = {"query": query, "sort": [{"ts": "asc"}]}
     body.update(kw)
-    return node.request("POST", "/logs/_search", body)
+    with force_host_loop():
+        return node.request("POST", "/logs/_search", body)
 
 
 class TestCanMatch:
@@ -108,9 +112,11 @@ class TestCanMatch:
             node.request("PUT", f"/dated/_doc/{did}",
                          {"d": f"2026-06-0{j + 1}"})
         node.request("POST", "/dated/_refresh")
-        res = node.request("POST", "/dated/_search", {
-            "query": {"range": {"d": {"gte": "2026-01-01"}}},
-            "sort": [{"d": "asc"}]})
+        from opensearch_tpu.search.spmd import force_host_loop
+        with force_host_loop():
+            res = node.request("POST", "/dated/_search", {
+                "query": {"range": {"d": {"gte": "2026-01-01"}}},
+                "sort": [{"d": "asc"}]})
         assert res["_shards"]["skipped"] == 1
         assert res["hits"]["total"]["value"] == 2
 

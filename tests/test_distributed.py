@@ -89,7 +89,8 @@ def test_spmd_matches_host_merge(corpus, mesh, query):
     payloads, plan, _ = _payloads(mapper, segments, query)
     searcher = DistributedSearcher(mesh)
     k = 12
-    scores, shard_idx, ords, total, _ = searcher.search(payloads, plan, k=k)
+    scores, _, shard_idx, ords, total, _ = searcher.search(payloads, plan,
+                                                           k=k)
 
     ref_scores, ref_total = _host_reference(mapper, segments, query, k)
     assert total == ref_total
@@ -135,7 +136,7 @@ def test_hbm_resident_segments_not_reuploaded_per_query(corpus, mesh):
     # parity with the one-shot path
     ref = searcher.search(payloads, plan, k=12)
     np.testing.assert_allclose(r1[0], ref[0], rtol=1e-6)
-    assert r1[3] == ref[3]
+    assert r1[4] == ref[4]
 
 
 def test_spmd_agg_partials_reduce(corpus, mesh):
@@ -145,7 +146,7 @@ def test_spmd_agg_partials_reduce(corpus, mesh):
     aggs = {"by_tag": {"terms": {"field": "tag", "size": 20}}}
     payloads, plan, per_shard_aggs = _payloads(mapper, segments, query, aggs)
     searcher = DistributedSearcher(mesh)
-    _, _, _, total, agg_outs = searcher.search(
+    _, _, _, _, total, agg_outs = searcher.search(
         payloads, plan, k=4, agg_plans=tuple(per_shard_aggs[0]))
 
     # host-side final reduce over the sharded partials (each agg output dict
@@ -180,7 +181,7 @@ def test_spmd_nested_sub_agg(corpus, mesh):
     payloads, plan, per_shard_aggs = _payloads(
         mapper, segments, {"match_all": {}}, aggs)
     searcher = DistributedSearcher(mesh)
-    _, _, _, _, agg_outs = searcher.search(
+    _, _, _, _, _, agg_outs = searcher.search(
         payloads, plan, k=4, agg_plans=tuple(per_shard_aggs[0]))
 
     from opensearch_tpu.search.aggs.reduce import decode_outputs, reduce_aggs
@@ -311,6 +312,96 @@ class TestSpmdServingPath:
                 for h in got["hits"]["hits"]] == \
                [(h["_id"], round(h["_score"], 4))
                 for h in want["hits"]["hits"]]
+
+
+class TestSpmdPackingAndFieldSort:
+    """Round-5 demands: >devices rows pack onto the mesh (no host-loop
+    cliff at n_devices), and numeric field sorts ride the collective
+    merge."""
+
+    @pytest.fixture(scope="class")
+    def node16(self):
+        import json
+
+        from opensearch_tpu.node import Node
+        from opensearch_tpu.utils.demo import synth_docs
+
+        node = Node()
+        node.request("PUT", "/pk", {
+            "settings": {"number_of_shards": 16},
+            "mappings": {"properties": {
+                "body": {"type": "text"}, "tag": {"type": "keyword"},
+                "views": {"type": "integer"}, "ts": {"type": "date"}}}})
+        docs = synth_docs(480, vocab_size=300, avg_len=30, seed=9)
+        lines = []
+        for i, d in enumerate(docs):
+            lines.append(json.dumps({"index": {"_id": f"p{i}"}}))
+            lines.append(json.dumps(d))
+        node.handle("POST", "/pk/_bulk", body="\n".join(lines) + "\n")
+        node.request("POST", "/pk/_refresh")
+        return node
+
+    def _host_loop(self, node, body):
+        from opensearch_tpu.search.spmd import force_host_loop
+        with force_host_loop():
+            return node.request("POST", "/pk/_search", body)
+
+    def test_sixteen_rows_pack_onto_eight_devices(self, node16):
+        import jax
+
+        from opensearch_tpu.search import spmd
+
+        assert len(jax.devices()) == 8
+        body = {"query": {"match": {"body": "w00004 w00019"}}, "size": 15}
+        before = spmd.SPMD_QUERIES[0]
+        got = node16.request("POST", "/pk/_search", body)
+        assert spmd.SPMD_QUERIES[0] == before + 1, \
+            "16 rows on an 8-device mesh fell back to the host loop"
+        want = self._host_loop(node16, body)
+        assert got["hits"]["total"] == want["hits"]["total"]
+        assert [(h["_id"], round(h["_score"], 4))
+                for h in got["hits"]["hits"]] == \
+               [(h["_id"], round(h["_score"], 4))
+                for h in want["hits"]["hits"]]
+
+    def test_packed_rows_aggs_match_host_loop(self, node16):
+        from opensearch_tpu.search import spmd
+
+        body = {"size": 0, "query": {"match_all": {}},
+                "aggs": {"tags": {"terms": {"field": "tag", "size": 20}},
+                         "v": {"avg": {"field": "views"}}}}
+        before = spmd.SPMD_QUERIES[0]
+        got = node16.request("POST", "/pk/_search", body)
+        assert spmd.SPMD_QUERIES[0] == before + 1
+        want = self._host_loop(node16, body)
+        assert got["aggregations"] == want["aggregations"]
+        assert got["hits"]["total"] == want["hits"]["total"]
+
+    def test_numeric_field_sort_through_spmd(self, node16):
+        from opensearch_tpu.search import spmd
+
+        for order in ("desc", "asc"):
+            body = {"query": {"match_all": {}}, "size": 20,
+                    "sort": [{"views": {"order": order}}]}
+            before = spmd.SPMD_QUERIES[0]
+            got = node16.request("POST", "/pk/_search", body)
+            assert spmd.SPMD_QUERIES[0] == before + 1, \
+                f"field sort ({order}) fell back to the host loop"
+            want = self._host_loop(node16, body)
+            assert got["hits"]["total"] == want["hits"]["total"]
+            assert [h["sort"] for h in got["hits"]["hits"]] == \
+                   [h["sort"] for h in want["hits"]["hits"]], order
+
+    def test_keyword_sort_still_host_loop(self, node16):
+        from opensearch_tpu.search import spmd
+
+        body = {"query": {"match_all": {}}, "size": 5,
+                "sort": [{"tag": {"order": "asc"}}]}
+        before = spmd.SPMD_QUERIES[0]
+        out = node16.request("POST", "/pk/_search", body)
+        assert spmd.SPMD_QUERIES[0] == before, \
+            "keyword sorts must take the host sort-key path"
+        assert out["hits"]["hits"]
 
 
 @pytest.mark.slow

@@ -21,6 +21,16 @@ def fresh_cache():
     QUERY_CACHE.clear()
 
 
+@pytest.fixture(autouse=True)
+def host_loop_only(monkeypatch):
+    # the filter cache splices cached masks on the host per-segment loop
+    # only (a precomputed mask breaks the SPMD batch's structure-uniform
+    # plans — documented round-4 decision); numeric field sorts now ride
+    # the SPMD merge, so pin these tests to the path under test
+    import opensearch_tpu.search.spmd as spmd_mod
+    monkeypatch.setattr(spmd_mod, "eligible", lambda *a, **k: False)
+
+
 @pytest.fixture()
 def node():
     n = Node()
@@ -35,9 +45,6 @@ def node():
     return n
 
 
-# field sort keeps these requests on the host per-segment loop — the SPMD
-# batch path requires structure-uniform plans across rows, so cached-mask
-# splicing applies to the host loop only (see indices/query_cache.py)
 FILTERED = {"query": {"bool": {
     "must": [{"match": {"body": "document"}}],
     "filter": [{"term": {"tag": "even"}},

@@ -1,0 +1,89 @@
+"""Config-1 (BM25 match) scaling curve: 100K / 300K / 1M docs.
+
+Writes one JSON line per scale to SCALING_raw.json: batched QPS, single-
+query p50/p99, the numpy-CSR baseline, and the per-query bytes the
+candidate kernel actually touches (posting blocks of the query's terms)
+vs what a dense scan would touch. Run on whatever backend is up; the
+driver's TPU bench covers the flagship number."""
+import json
+import os
+import sys
+import time
+
+import jax
+if os.environ.get("SCALE_FORCE_CPU") == "1":
+    jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def run_scale(n_docs: int, out):
+    from opensearch_tpu.search.executor import SearchExecutor, ShardReader
+    from opensearch_tpu.utils.demo import build_shards, query_terms
+    t0 = time.perf_counter()
+    mapper, segments = build_shards(n_docs, n_shards=1, vocab_size=20000,
+                                    avg_len=60, seed=42)
+    seg = segments[0]
+    build_s = time.perf_counter() - t0
+    reader = ShardReader(mapper, segments)
+    ex = SearchExecutor(reader)
+    queries = query_terms(1024, 20000, seed=7, terms_per_query=2)
+    bodies = [{"query": {"match": {"body": q}}, "size": 10} for q in queries]
+    ex.multi_search(bodies)                      # compile all shape buckets
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ex.multi_search(bodies)
+        times.append(time.perf_counter() - t0)
+    qps = len(bodies) / sorted(times)[1]
+    for q in queries[:32]:
+        ex.search({"query": {"match": {"body": q}}, "size": 10})
+    lat = []
+    for q in queries[:64]:
+        t0 = time.perf_counter()
+        ex.search({"query": {"match": {"body": q}}, "size": 10})
+        lat.append((time.perf_counter() - t0) * 1000)
+    lat.sort()
+    # bytes the candidate kernel touches per query: the terms' posting
+    # blocks (docs int32 + tf f32 = 8B per lane incl. padding lanes)
+    per_q_bytes = []
+    for q in queries:
+        b = 0
+        for t in q.split():
+            tm = seg.get_term("body", t)
+            if tm is not None:
+                b += tm.num_blocks * 128 * 8
+        per_q_bytes.append(b)
+    dense_bytes = seg.post_docs.shape[0] * 128 * 8
+    # numpy-CSR baseline (same scorer as bench.py)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+    base_qps = bench.numpy_baseline(seg, queries[:256])
+    rec = {
+        "n_docs": n_docs,
+        "platform": jax.devices()[0].platform,
+        "build_s": round(build_s, 1),
+        "qps_batched": round(qps, 1),
+        "p50_ms": round(lat[len(lat) // 2], 2),
+        "p99_ms": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 2),
+        "numpy_baseline_qps": round(base_qps, 1),
+        "vs_baseline": round(qps / base_qps, 3),
+        "scanned_bytes_per_query_p50": int(np.median(per_q_bytes)),
+        "scanned_bytes_per_query_max": int(max(per_q_bytes)),
+        "dense_scan_bytes": int(dense_bytes),
+        "total_postings_blocks": int(seg.post_docs.shape[0]),
+    }
+    out.write(json.dumps(rec) + "\n")
+    out.flush()
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    scales = [int(s) for s in
+              os.environ.get("SCALES", "100000,300000,1000000").split(",")]
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "SCALING_raw.json")
+    with open(path, "a") as out:
+        for n in scales:
+            run_scale(n, out)
