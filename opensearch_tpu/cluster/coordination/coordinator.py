@@ -74,8 +74,14 @@ class Coordinator:
     def __init__(self, node_id: str, transport, scheduler,
                  initial_state: ClusterState,
                  on_state_applied: Optional[Callable[[ClusterState], None]]
-                 = None):
+                 = None,
+                 health: Optional[Callable[[], bool]] = None):
         self.node_id = node_id
+        # NodeHealthService analog (monitor.FsHealthService feeds this):
+        # an unhealthy node fails its follower checks (→ 3-strike removal
+        # by the leader), refuses pre-votes, and never starts elections —
+        # reference: FsHealthService.java:74 → Coordinator's StatusInfo
+        self.health = health or (lambda: True)
         self.transport = transport
         self.scheduler = scheduler
         self.coord_state = CoordinationState(node_id, initial_state)
@@ -205,6 +211,8 @@ class Coordinator:
                     return
                 responded.add(peer)
                 if resp.get("leader") and resp["leader"] != me:
+                    if not self.health():
+                        return  # rejoining while unhealthy would flap
                     # a healthy leader exists: rejoin it instead of electing
                     self.join_cluster(resp["leader"])
                     return
@@ -231,7 +239,8 @@ class Coordinator:
     def _on_pre_vote(self, sender: str, payload: dict):
         self.known_peers.add(sender)
         would_vote = (
-            payload["term"] > self.coord_state.current_term
+            self.health()
+            and payload["term"] > self.coord_state.current_term
             and (payload["last_accepted_term"],
                  payload["last_accepted_version"])
             >= (self.coord_state.last_accepted_term,
@@ -248,6 +257,8 @@ class Coordinator:
         return {"would_vote": would_vote, "leader": healthy_leader}
 
     def _start_election(self, term: int):
+        if not self.health():
+            return      # an unhealthy node must not stand for leader
         """Send StartJoin(term) to every peer incl. ourselves — votes come
         back as joins (Coordinator.startElection:498)."""
         if term <= self.coord_state.current_term:
@@ -667,6 +678,12 @@ class Coordinator:
     def _on_follower_check(self, sender: str, payload: dict):
         """FollowersChecker.handleFollowerCheck: a check from a leader with
         a current term makes us its follower."""
+        if not self.health():
+            # FollowersChecker treats a NodeHealthCheckFailureException
+            # as an immediate-removal failure class; here it counts a
+            # strike like any other check failure
+            raise CoordinationStateRejectedError(
+                f"node [{self.node_id}] is unhealthy (fs probe failed)")
         term = payload["term"]
         if term < self.coord_state.current_term:
             raise CoordinationStateRejectedError(
@@ -722,6 +739,14 @@ class Coordinator:
         if self.mode != Mode.LEADER:
             raise CoordinationStateRejectedError(
                 f"rejecting leader check while mode is {self.mode.value}")
+        if sender not in self.applied_state.nodes:
+            # LeaderChecker's removed-node rejection: a node we removed
+            # (e.g. failed health checks) must learn it is out — its
+            # leader-check failures then turn it candidate, and its next
+            # pre-vote round rejoins via the leader hint once healthy
+            raise CoordinationStateRejectedError(
+                f"rejecting leader check from [{sender}] which is not in "
+                f"the current cluster membership")
         return {"ok": True}
 
     # -------------------------------------------------------------- joining

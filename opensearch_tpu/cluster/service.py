@@ -100,10 +100,14 @@ class ClusterNode:
         # (sequence-number) peer recovery possible over the transport
         self.data_path = self.settings.get("path.data")
         self.local = Node(node_name=node_id, settings=settings)
+        # TLS + join-secret config from node settings (transport/security)
+        from opensearch_tpu.transport.security import SecurityConfig
+        self.security = SecurityConfig(settings)
         # one named-pool registry per node, shared by the transport's
         # handler dispatch and the REST layer (ThreadPool.java:92)
         self.transport = TcpTransport(node_id, host=host, port=port,
-                                      threadpool=self.local.threadpool)
+                                      threadpool=self.local.threadpool,
+                                      security=self.security)
         self.shards: Dict[Tuple[str, int], IndexShard] = {}
         # keyed by (index name, index UUID) — see _mapper_for
         self._mappers: Dict[Tuple[str, Optional[str]], MapperService] = {}
@@ -180,14 +184,19 @@ class ClusterNode:
 
     def _start_coordinator(self, initial: ClusterState):
         self._register_actions()
+        from opensearch_tpu.monitor import FsHealthService
+        self.fs_health = FsHealthService(self.data_path).start()
         self.coordinator = Coordinator(
             self.node_id, self.transport, self.transport.scheduler, initial,
-            on_state_applied=self._on_state_applied)
+            on_state_applied=self._on_state_applied,
+            health=lambda: self.fs_health.healthy)
         self.coordinator.start()
         self._started = True
 
     def close(self):
         self._started = False
+        if getattr(self, "fs_health", None) is not None:
+            self.fs_health.stop()
         self.persistent_tasks.shutdown()
         if self.coordinator is not None:
             self.coordinator.stop()
@@ -282,6 +291,13 @@ class ClusterNode:
             elif kind == "delete_index":
                 data["indices"] = {k: v for k, v in data["indices"].items()
                                    if k != update["name"]}
+            elif kind in ("close_index", "open_index"):
+                name = update["name"]
+                if name not in data["indices"]:
+                    raise IndexNotFoundError(name)
+                meta = dict(data["indices"][name])
+                meta["closed"] = kind == "close_index"
+                data["indices"] = {**data["indices"], name: meta}
             elif kind == "shard_started":
                 name, sid, node = (update["index"], update["shard"],
                                    update["node"])
@@ -1980,6 +1996,8 @@ class ClusterNode:
                 return self.delete_index(name), 200
             return None
         sub = parts[1]
+        if sub in ("_doc", "_bulk", "_search", "_count", "_msearch"):
+            self._check_index_open(name)
         if sub == "_doc" and len(parts) >= 2:
             doc_id = parts[2] if len(parts) > 2 else None
             if method in ("PUT", "POST") and body is not None:
@@ -2015,6 +2033,10 @@ class ClusterNode:
             return self.refresh_index(name), 200
         if sub == "_settings" and method == "PUT":
             return self.update_index_settings(name, body or {}), 200
+        if sub == "_close" and method == "POST":
+            return self.close_index(name), 200
+        if sub == "_open" and method == "POST":
+            return self.open_index(name), 200
         return None
 
     def _rest_bulk(self, default_index: Optional[str],
@@ -2075,6 +2097,29 @@ class ClusterNode:
         self._submit_to_leader({"kind": "delete_index", "name": name})
         self._await(lambda: name not in self._data().get("indices", {}))
         return {"acknowledged": True}
+
+    def close_index(self, name: str) -> dict:
+        """MetadataIndexStateService.closeIndices in cluster mode: the
+        closed flag lives IN CLUSTER STATE, so every node's data plane
+        rejects reads/writes for it (unlike a node-local flag)."""
+        self._index_meta(name)
+        self._submit_to_leader({"kind": "close_index", "name": name})
+        self._await(lambda: self._data()["indices"]
+                    .get(name, {}).get("closed"))
+        return {"acknowledged": True, "shards_acknowledged": True,
+                "indices": {name: {"closed": True}}}
+
+    def open_index(self, name: str) -> dict:
+        self._index_meta(name)
+        self._submit_to_leader({"kind": "open_index", "name": name})
+        self._await(lambda: not self._data()["indices"]
+                    .get(name, {}).get("closed"))
+        return {"acknowledged": True, "shards_acknowledged": True}
+
+    def _check_index_open(self, name: str):
+        if self._data().get("indices", {}).get(name, {}).get("closed"):
+            from opensearch_tpu.common.errors import IndexClosedError
+            raise IndexClosedError(name)
 
     def update_index_settings(self, name: str, body: dict) -> dict:
         """PUT /{index}/_settings in cluster mode: dynamic settings fold

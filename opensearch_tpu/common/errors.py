@@ -34,6 +34,15 @@ class IndexNotFoundError(OpenSearchTpuError):
         self.index = index
 
 
+class IndexClosedError(OpenSearchTpuError):
+    status = 400
+    error_type = "index_closed_exception"
+
+    def __init__(self, index: str):
+        super().__init__(f"closed", index=index)
+        self.index = index
+
+
 class ResourceNotFoundError(OpenSearchTpuError):
     status = 404
     error_type = "resource_not_found_exception"

@@ -1,0 +1,239 @@
+"""Multi-format x-content: JSON / CBOR / YAML encode-decode + negotiation.
+
+Re-design of the reference's `libs/x-content` facade
+(common/xcontent/XContentFactory.java + XContentType.java): request bodies
+are decoded by Content-Type and responses encoded per the Accept header.
+JSON is the native in-process representation (all internal structures are
+plain dicts); CBOR rides a self-contained RFC 8949 subset codec below
+(no third-party CBOR library ships in this environment); YAML uses the
+bundled PyYAML. SMILE is not implemented (the reference's fourth format;
+Jackson-specific, no Python ecosystem equivalent here) — senders get 406.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Optional
+
+JSON = "application/json"
+CBOR = "application/cbor"
+YAML = "application/yaml"
+NDJSON = "application/x-ndjson"
+
+_MAJOR_UINT = 0
+_MAJOR_NEGINT = 1
+_MAJOR_BYTES = 2
+_MAJOR_TEXT = 3
+_MAJOR_ARRAY = 4
+_MAJOR_MAP = 5
+_MAJOR_SIMPLE = 7
+
+
+class CborError(ValueError):
+    pass
+
+
+# ------------------------------------------------------------------ encode
+
+def cbor_dumps(obj: Any) -> bytes:
+    out = bytearray()
+    _enc(obj, out)
+    return bytes(out)
+
+
+def _enc_head(major: int, n: int, out: bytearray):
+    if n < 24:
+        out.append((major << 5) | n)
+    elif n < 1 << 8:
+        out.append((major << 5) | 24)
+        out.append(n)
+    elif n < 1 << 16:
+        out.append((major << 5) | 25)
+        out += n.to_bytes(2, "big")
+    elif n < 1 << 32:
+        out.append((major << 5) | 26)
+        out += n.to_bytes(4, "big")
+    else:
+        out.append((major << 5) | 27)
+        out += n.to_bytes(8, "big")
+
+
+def _enc(obj: Any, out: bytearray):
+    if obj is None:
+        out.append(0xF6)
+    elif obj is True:
+        out.append(0xF5)
+    elif obj is False:
+        out.append(0xF4)
+    elif isinstance(obj, int):
+        if obj >= 0:
+            _enc_head(_MAJOR_UINT, obj, out)
+        else:
+            _enc_head(_MAJOR_NEGINT, -1 - obj, out)
+    elif isinstance(obj, float):
+        out.append(0xFB)
+        out += struct.pack(">d", obj)
+    elif isinstance(obj, str):
+        b = obj.encode("utf-8")
+        _enc_head(_MAJOR_TEXT, len(b), out)
+        out += b
+    elif isinstance(obj, (bytes, bytearray)):
+        _enc_head(_MAJOR_BYTES, len(obj), out)
+        out += obj
+    elif isinstance(obj, (list, tuple)):
+        _enc_head(_MAJOR_ARRAY, len(obj), out)
+        for v in obj:
+            _enc(v, out)
+    elif isinstance(obj, dict):
+        _enc_head(_MAJOR_MAP, len(obj), out)
+        for k, v in obj.items():
+            _enc(str(k), out)
+            _enc(v, out)
+    else:
+        raise CborError(f"cannot CBOR-encode {type(obj).__name__}")
+
+
+# ------------------------------------------------------------------ decode
+
+def cbor_loads(data: bytes) -> Any:
+    value, off = _dec(data, 0)
+    if off != len(data):
+        raise CborError(f"{len(data) - off} trailing bytes")
+    return value
+
+
+def _dec_uint(data: bytes, off: int, info: int):
+    if info < 24:
+        return info, off
+    if info == 24:
+        return data[off], off + 1
+    if info == 25:
+        return int.from_bytes(data[off:off + 2], "big"), off + 2
+    if info == 26:
+        return int.from_bytes(data[off:off + 4], "big"), off + 4
+    if info == 27:
+        return int.from_bytes(data[off:off + 8], "big"), off + 8
+    raise CborError(f"unsupported additional info {info}")
+
+
+def _dec(data: bytes, off: int):
+    if off >= len(data):
+        raise CborError("truncated")
+    ib = data[off]
+    off += 1
+    major, info = ib >> 5, ib & 0x1F
+    if major == _MAJOR_UINT:
+        return _dec_uint(data, off, info)
+    if major == _MAJOR_NEGINT:
+        n, off = _dec_uint(data, off, info)
+        return -1 - n, off
+    if major in (_MAJOR_BYTES, _MAJOR_TEXT):
+        n, off = _dec_uint(data, off, info)
+        if off + n > len(data):
+            raise CborError("truncated string")
+        raw = data[off:off + n]
+        off += n
+        return (raw.decode("utf-8") if major == _MAJOR_TEXT
+                else bytes(raw)), off
+    if major == _MAJOR_ARRAY:
+        n, off = _dec_uint(data, off, info)
+        out = []
+        for _ in range(n):
+            v, off = _dec(data, off)
+            out.append(v)
+        return out, off
+    if major == _MAJOR_MAP:
+        n, off = _dec_uint(data, off, info)
+        d = {}
+        for _ in range(n):
+            k, off = _dec(data, off)
+            v, off = _dec(data, off)
+            d[k] = v
+        return d, off
+    if major == _MAJOR_SIMPLE:
+        if info == 20:
+            return False, off
+        if info == 21:
+            return True, off
+        if info in (22, 23):
+            return None, off
+        if info == 25:          # half float
+            h = int.from_bytes(data[off:off + 2], "big")
+            return _half_to_float(h), off + 2
+        if info == 26:
+            return struct.unpack(">f", data[off:off + 4])[0], off + 4
+        if info == 27:
+            return struct.unpack(">d", data[off:off + 8])[0], off + 8
+        raise CborError(f"unsupported simple value {info}")
+    raise CborError(f"unsupported major type {major} (tags not accepted)")
+
+
+def _half_to_float(h: int) -> float:
+    sign = -1.0 if h & 0x8000 else 1.0
+    exp = (h >> 10) & 0x1F
+    frac = h & 0x3FF
+    if exp == 0:
+        return sign * frac * 2.0 ** -24
+    if exp == 31:
+        return sign * (float("inf") if frac == 0 else float("nan"))
+    return sign * (1 + frac / 1024.0) * 2.0 ** (exp - 15)
+
+
+def cbor_loads_stream(data: bytes):
+    """Decode a concatenation of CBOR values (the bulk-body framing: CBOR
+    is self-delimiting, so _bulk bodies need no newline separators —
+    reference: RestBulkAction accepts any XContentType)."""
+    out = []
+    off = 0
+    while off < len(data):
+        value, off = _dec(data, off)
+        out.append(value)
+    return out
+
+
+# -------------------------------------------------------------- negotiation
+
+def media_type(header: Optional[str]) -> Optional[str]:
+    """Normalize a Content-Type/Accept header to one of the known types."""
+    if not header:
+        return None
+    base = header.split(";")[0].strip().lower()
+    if base in (JSON, "text/json", "*/*", "application/*"):
+        return JSON
+    if base in (CBOR, "application/smile"):
+        # SMILE negotiators are told no via a CborError upstream; callers
+        # check the original header when they must distinguish
+        return CBOR if base == CBOR else None
+    if base in (YAML, "text/yaml", "application/x-yaml"):
+        return YAML
+    if base == NDJSON:
+        return NDJSON
+    return None
+
+
+def decode_body(raw: bytes, content_type: Optional[str]):
+    """Request body bytes → dict/list per Content-Type (None = undecodable;
+    JSON stays the default for absent/unknown types, matching the
+    reference's lenient fallback for clients that omit the header)."""
+    mt = media_type(content_type)
+    if mt == CBOR:
+        return cbor_loads(raw)
+    if mt == YAML:
+        import yaml
+        return yaml.safe_load(raw.decode("utf-8"))
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError:
+        return None
+
+
+def encode_body(obj: Any, accept: Optional[str]):
+    """Response object → (bytes, content-type) per the Accept header."""
+    mt = media_type(accept)
+    if mt == CBOR:
+        return cbor_dumps(obj), CBOR
+    if mt == YAML:
+        import yaml
+        return yaml.safe_dump(obj, sort_keys=False).encode("utf-8"), YAML
+    return (json.dumps(obj).encode("utf-8"), JSON)

@@ -54,6 +54,10 @@ class IndexService:
         self.uuid = settings.get("uuid") or uuid.uuid4().hex[:22]
         settings.setdefault("uuid", self.uuid)
         self._script_service = script_service
+        # index open/close lifecycle (MetadataIndexStateService analog):
+        # a closed index keeps its data and metadata but rejects every
+        # data-plane operation until reopened
+        self.closed = bool(settings.get("closed", False))
         self.num_shards = int(settings.get("number_of_shards", 1))
         self.num_replicas = int(settings.get("number_of_replicas", 0))
         self.routing_partition_size = int(
@@ -102,6 +106,12 @@ class IndexService:
 
     # --------------------------------------------------------------- routing
 
+    def check_open(self):
+        """Data-plane gate for closed indices (IndexClosedException)."""
+        if self.closed:
+            from opensearch_tpu.common.errors import IndexClosedError
+            raise IndexClosedError(self.index_name)
+
     def shard_for(self, doc_id: str, routing: Optional[str] = None) -> IndexShard:
         sid = generate_shard_id(
             doc_id, self.num_shards, routing=routing,
@@ -114,6 +124,7 @@ class IndexService:
     def index_doc(self, doc_id: Optional[str], source: dict,
                   routing: Optional[str] = None, op_type: str = "index",
                   **kw) -> dict:
+        self.check_open()
         if doc_id is None:
             doc_id = _auto_id()
             op_type = "create"
@@ -124,6 +135,7 @@ class IndexService:
 
     def get_doc(self, doc_id: str, routing: Optional[str] = None,
                 realtime: bool = True) -> dict:
+        self.check_open()
         shard = self.shard_for(doc_id, routing)
         res = shard.get_doc(doc_id, realtime=realtime)
         if res is None:
@@ -134,6 +146,7 @@ class IndexService:
 
     def delete_doc(self, doc_id: str, routing: Optional[str] = None,
                    **kw) -> dict:
+        self.check_open()
         shard = self.shard_for(doc_id, routing)
         res = shard.delete_doc(doc_id, **kw)
         return self._write_response(res, shard,
@@ -147,6 +160,7 @@ class IndexService:
         (UpdateHelper semantics: detect_noop default true, upsert,
         doc_as_upsert, retry left to the caller). A caller-supplied
         if_seq_no/if_primary_term CAS is checked against the current doc."""
+        self.check_open()
         _KNOWN = {"doc", "doc_as_upsert", "script", "upsert",
                   "scripted_upsert", "detect_noop", "_source", "lang",
                   "if_seq_no", "if_primary_term", "fields"}
@@ -252,6 +266,7 @@ class IndexService:
         return self._write_response(res, shard, "updated")
 
     def mget(self, ids: List[Any]) -> dict:
+        self.check_open()
         docs = []
         for item in ids:
             if isinstance(item, dict):
@@ -279,6 +294,7 @@ class IndexService:
         """Execute parsed bulk items: [{action, id, source, routing, ...}].
         Items are routed per doc and executed in order per shard
         (TransportShardBulkAction.performOnPrimary runs items serially)."""
+        self.check_open()
         start = time.monotonic()
         items = []
         errors = False
@@ -320,16 +336,19 @@ class IndexService:
     # ---------------------------------------------------------------- search
 
     def search(self, body: Optional[dict] = None) -> dict:
+        self.check_open()
         from opensearch_tpu.search.controller import execute_search
         return execute_search([s.executor for s in self.shards], body)
 
     def multi_search(self, bodies: List[dict]) -> dict:
+        self.check_open()
         if self.num_shards == 1:
             return self.shards[0].executor.multi_search(bodies)
         return {"took": 0,
                 "responses": [self.search(b) for b in bodies]}
 
     def count(self, body: Optional[dict] = None) -> int:
+        self.check_open()
         body = dict(body or {})
         body["size"] = 0
         body.pop("from", None)
@@ -338,14 +357,17 @@ class IndexService:
     # ------------------------------------------------------------- lifecycle
 
     def refresh(self):
+        self.check_open()
         for s in self.shards:
             s.refresh()
 
     def flush(self):
+        self.check_open()
         for s in self.shards:
             s.flush()
 
     def force_merge(self):
+        self.check_open()
         for s in self.shards:
             s.force_merge()
 

@@ -279,6 +279,32 @@ class IndicesService:
             return self.indices[name]
         raise IndexNotFoundError(name)
 
+    # -------------------------------------------------------- open / close
+
+    def close_index(self, expression: str) -> List[str]:
+        """MetadataIndexStateService.closeIndices analog: data and
+        metadata stay, every data-plane operation rejects until reopen."""
+        names = self.resolve(expression, allow_aliases=False,
+                             expand_closed=True)
+        if not names:
+            raise IndexNotFoundError(expression)
+        for name in names:
+            svc = self.indices[name]
+            svc.closed = True
+            svc.settings["closed"] = True
+        return names
+
+    def open_index(self, expression: str) -> List[str]:
+        names = self.resolve(expression, allow_aliases=False,
+                             expand_closed=True)
+        if not names:
+            raise IndexNotFoundError(expression)
+        for name in names:
+            svc = self.indices[name]
+            svc.closed = False
+            svc.settings.pop("closed", None)
+        return names
+
     def has_index(self, name: str) -> bool:
         return name in self.indices
 
@@ -369,11 +395,23 @@ class IndicesService:
 
     def resolve(self, expression: Optional[str], allow_aliases: bool = True,
                 ignore_unavailable: bool = False,
-                allow_no_indices: bool = True) -> List[str]:
+                allow_no_indices: bool = True,
+                expand_closed: bool = False) -> List[str]:
         """IndexNameExpressionResolver: wildcards, _all, commas, -exclusions,
-        alias expansion. Returns concrete index names in insertion order."""
+        alias expansion. Returns concrete index names in insertion order.
+        Wildcard/_all expansion skips CLOSED indices unless expand_closed
+        (the reference's expand_wildcards=open default); an explicitly
+        named closed index still resolves — the data-plane gate raises
+        index_closed_exception for it."""
+
+        def open_only(names):
+            if expand_closed:
+                return list(names)
+            return [n for n in names
+                    if not getattr(self.indices.get(n), "closed", False)]
+
         if expression is None or expression in ("_all", "*", ""):
-            return list(self.indices)
+            return open_only(self.indices)
         parts = (expression if isinstance(expression, list)
                  else expression.split(","))
         selected: List[str] = []
@@ -392,14 +430,14 @@ class IndicesService:
             if exclude:
                 part = part[1:]
             if part == "_all":
-                names = list(self.indices)
+                names = open_only(self.indices)
             elif "*" in part or "?" in part:
-                names = [n for n in self.indices
-                         if fnmatch.fnmatchcase(n, part)]
+                names = open_only(n for n in self.indices
+                                  if fnmatch.fnmatchcase(n, part))
                 if allow_aliases:
                     for alias, members in self.aliases.items():
                         if fnmatch.fnmatchcase(alias, part):
-                            names.extend(members)
+                            names.extend(open_only(members))
             elif part in self.indices:
                 names = [part]
             elif allow_aliases and part in self.aliases:

@@ -136,7 +136,10 @@ def start_node(settings: Dict, config_dir: Optional[str] = None):
         node = Node(node_name=node_name, settings=settings,
                     data_path=data_path)
 
-    server = HttpServer(node, host=http_host, port=http_port)
+    from opensearch_tpu.transport.security import SecurityConfig
+    security = SecurityConfig(settings)
+    server = HttpServer(node, host=http_host, port=http_port,
+                        security=security)
     server.start()
     return node, server
 

@@ -66,6 +66,63 @@ def os_probe() -> dict:
     return out
 
 
+class FsHealthService:
+    """FsHealthService.java:74 analog: periodically writes + fsyncs a probe
+    file under the data path; failures mark the node UNHEALTHY, which the
+    Coordinator consumes (fails follower checks → leader removes the node;
+    refuses pre-votes and elections). A later successful write heals."""
+
+    PROBE_FILE = ".os_temp_health_probe"
+
+    def __init__(self, path: Optional[str], interval_s: float = 5.0):
+        import tempfile
+        self.path = path or tempfile.gettempdir()
+        self.interval_s = interval_s
+        self.healthy = True
+        self._stop = False
+        self._thread = None
+
+    def probe_once(self) -> bool:
+        import os as _os
+        try:
+            # the node owns its data path; it may not exist before the
+            # first write (gateway creates it lazily)
+            _os.makedirs(self.path, exist_ok=True)
+            probe = _os.path.join(self.path, self.PROBE_FILE)
+            with open(probe, "wb") as f:
+                f.write(b"ok")
+                f.flush()
+                _os.fsync(f.fileno())
+            ok = True
+        except OSError:
+            ok = False
+        if not self._stop:
+            # a stopped service must not overwrite a pinned verdict (an
+            # in-flight probe racing stop() would re-mark healed)
+            self.healthy = ok
+        return ok
+
+    def start(self):
+        import threading as _threading
+
+        def loop():
+            import time as _time
+            while not self._stop:
+                self.probe_once()
+                _time.sleep(self.interval_s)
+
+        self.probe_once()
+        self._thread = _threading.Thread(target=loop, name="fs-health",
+                                         daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop = True
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+
+
 def fs_probe(path: Optional[str] = None) -> dict:
     """FsProbe.stats(): disk totals for the data path (or cwd)."""
     import shutil

@@ -46,6 +46,7 @@ def _search_targets(node, index_expr: Optional[str]):
     executors, filters = [], []
     for name in names:
         svc = node.indices.get(name)
+        svc.check_open()    # explicitly-named closed index: 400
         alias_filter = node.indices.alias_filter(index_expr or "", name)
         for shard in svc.shards:
             executors.append(shard.executor)
@@ -863,6 +864,15 @@ def register_indices_actions(node, c):
             node.indices.get(n).force_merge()
         return {"_shards": _shards_header(node, names)}
 
+    def do_close_index(req):
+        names = node.indices.close_index(req.param("index"))
+        return {"acknowledged": True, "shards_acknowledged": True,
+                "indices": {n: {"closed": True} for n in names}}
+
+    def do_open_index(req):
+        node.indices.open_index(req.param("index"))
+        return {"acknowledged": True, "shards_acknowledged": True}
+
     def do_stats(req):
         names = node.indices.resolve(req.param("index"))
         out_indices = {}
@@ -925,6 +935,8 @@ def register_indices_actions(node, c):
     c.register("POST", "/{index}/_flush", do_flush)
     c.register("POST", "/_forcemerge", do_forcemerge)
     c.register("POST", "/{index}/_forcemerge", do_forcemerge)
+    c.register("POST", "/{index}/_close", do_close_index)
+    c.register("POST", "/{index}/_open", do_open_index)
     c.register("GET", "/_stats", do_stats)
     c.register("GET", "/{index}/_stats", do_stats)
     c.register("GET", "/_analyze", do_analyze)

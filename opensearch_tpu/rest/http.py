@@ -30,17 +30,48 @@ class _Handler(BaseHTTPRequestHandler):
         raw = self.rfile.read(length) if length else None
         body = None
         if raw:
+            # Content-Type negotiation (libs/x-content XContentType
+            # analog): JSON / CBOR / YAML bodies all decode to the same
+            # in-process dicts
+            from opensearch_tpu.common import xcontent
+            ctype = self.headers.get("Content-Type")
             try:
-                body = json.loads(raw)
-            except json.JSONDecodeError:
+                if (xcontent.media_type(ctype) == xcontent.CBOR
+                        and parsed.path.rstrip("/").endswith("_bulk")):
+                    # bulk bodies are a self-delimiting CBOR value
+                    # stream; re-frame as NDJSON for the shared parser
+                    # (binary values render as base64, like the
+                    # reference's JSON view of binary fields)
+                    import base64
+                    raw = b"\n".join(
+                        json.dumps(v, default=lambda b:
+                                   base64.b64encode(bytes(b)).decode()
+                                   if isinstance(b, (bytes, bytearray))
+                                   else str(b)).encode("utf-8")
+                        for v in xcontent.cbor_loads_stream(raw)) + b"\n"
+                else:
+                    body = xcontent.decode_body(raw, ctype)
+            except Exception:
+                # undecodable body: surface a request-format error, not
+                # raw binary into the NDJSON parser (which would 500)
                 body = None
+                raw = None
         resp = self.node.handle(method, parsed.path, params=params,
                                 body=body, raw_body=raw)
-        payload = resp.json().encode("utf-8") \
-            if resp.content_type == "application/json" \
-            else (resp.body or "").encode("utf-8")
+        content_type = resp.content_type
+        if content_type == "application/json":
+            from opensearch_tpu.common import xcontent
+            accept = self.headers.get("Accept")
+            if xcontent.media_type(accept) in (xcontent.CBOR,
+                                               xcontent.YAML):
+                payload, content_type = xcontent.encode_body(
+                    json.loads(resp.json()), accept)
+            else:
+                payload = resp.json().encode("utf-8")
+        else:
+            payload = (resp.body or "").encode("utf-8")
         self.send_response(resp.status)
-        self.send_header("Content-Type", resp.content_type)
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(payload)))
         for name, value in getattr(resp, "headers", {}).items():
             self.send_header(name, value)
@@ -70,9 +101,30 @@ class _Handler(BaseHTTPRequestHandler):
 class HttpServer:
     """REST port 9200 analog. start() binds; close() shuts down."""
 
-    def __init__(self, node: Node, host: str = "127.0.0.1", port: int = 9200):
+    def __init__(self, node: Node, host: str = "127.0.0.1", port: int = 9200,
+                 security=None):
         handler = type("BoundHandler", (_Handler,), {"node": node})
-        self.server = ThreadingHTTPServer((host, port), handler)
+        if security is not None and security.http_tls:
+            # TLS on the REST port (reference: the security plugin's
+            # http.ssl). The LISTENING socket stays plaintext; each
+            # accepted connection wraps with do_handshake_on_connect=False
+            # so the handshake happens lazily on first read INSIDE the
+            # per-request thread — wrapping the listener would run the
+            # handshake on the accept thread, letting one stalled client
+            # block the whole REST endpoint.
+            sec = security
+
+            class _TlsServer(ThreadingHTTPServer):
+                def get_request(self):
+                    sock, addr = self.socket.accept()
+                    sock.settimeout(30)
+                    ctx = sec._http_server
+                    return (ctx.wrap_socket(
+                        sock, server_side=True,
+                        do_handshake_on_connect=False), addr)
+            self.server = _TlsServer((host, port), handler)
+        else:
+            self.server = ThreadingHTTPServer((host, port), handler)
         self.host = self.server.server_address[0]
         self.port = self.server.server_address[1]
         self._thread: Optional[threading.Thread] = None

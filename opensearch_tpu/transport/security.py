@@ -1,0 +1,134 @@
+"""Transport/HTTP security: TLS contexts + cluster join authentication.
+
+Re-designs the surface the reference gets from `libs/ssl-config`
+(org.opensearch.common.ssl.SslConfiguration and its keystore/PEM loading)
+plus the security plugin's node-to-node TLS: a small settings-driven
+config object that yields ready `ssl.SSLContext`s for
+
+- the node-to-node transport (MUTUAL TLS: both sides present certs and
+  verify against the configured CA — an unauthenticated peer cannot even
+  complete the TCP handshake, let alone join), and
+- the HTTP layer (server cert; client verification optional).
+
+Independent of (and composable with) TLS, `cluster.join.shared_secret`
+gates the transport handshake with an HMAC proof: a peer that does not
+know the secret is dropped at frame admission, before any handler runs.
+The secret is a join/authorization gate, not a confidentiality mechanism
+— on untrusted networks enable transport TLS as well (the reference's
+security plugin likewise requires node-to-node TLS for its auth).
+
+Settings (common/settings.py registry):
+  transport.ssl.enabled                 bool   (default false)
+  transport.ssl.certificate             path   (PEM cert for this node)
+  transport.ssl.key                     path   (PEM private key)
+  transport.ssl.certificate_authorities path   (PEM CA bundle)
+  http.ssl.enabled                      bool
+  http.ssl.certificate / http.ssl.key   paths
+  http.ssl.certificate_authorities      path   (set → require client certs)
+  cluster.join.shared_secret            string
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import ssl
+from typing import Any, Optional
+
+
+class SecurityConfig:
+    """Resolved TLS contexts + join secret for one node."""
+
+    def __init__(self, settings: Optional[Any] = None):
+        # accepts a plain dict or any object with .get (common/settings)
+        get = (settings.get if settings is not None
+               else lambda *_a, **_k: None)
+        self.shared_secret: Optional[str] = \
+            get("cluster.join.shared_secret") or None
+        self._transport_server: Optional[ssl.SSLContext] = None
+        self._transport_client: Optional[ssl.SSLContext] = None
+        self._http_server: Optional[ssl.SSLContext] = None
+
+        if _truthy(get("transport.ssl.enabled")):
+            cert = get("transport.ssl.certificate")
+            key = get("transport.ssl.key")
+            ca = get("transport.ssl.certificate_authorities")
+            if not (cert and key and ca):
+                raise ValueError(
+                    "transport.ssl.enabled requires certificate, key and "
+                    "certificate_authorities")
+            srv = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            srv.load_cert_chain(cert, key)
+            srv.load_verify_locations(ca)
+            srv.verify_mode = ssl.CERT_REQUIRED      # mutual TLS
+            self._transport_server = srv
+            cli = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            cli.load_cert_chain(cert, key)
+            cli.load_verify_locations(ca)
+            cli.check_hostname = False   # cluster peers dial IPs; identity
+            cli.verify_mode = ssl.CERT_REQUIRED  # comes from the CA chain
+            self._transport_client = cli
+
+        if _truthy(get("http.ssl.enabled")):
+            cert = get("http.ssl.certificate")
+            key = get("http.ssl.key")
+            if not (cert and key):
+                raise ValueError(
+                    "http.ssl.enabled requires certificate and key")
+            srv = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            srv.load_cert_chain(cert, key)
+            ca = get("http.ssl.certificate_authorities")
+            if ca:
+                srv.load_verify_locations(ca)
+                srv.verify_mode = ssl.CERT_REQUIRED
+            self._http_server = srv
+
+    # ---------------------------------------------------------- transport
+
+    @property
+    def transport_tls(self) -> bool:
+        return self._transport_server is not None
+
+    def wrap_transport_server(self, sock):
+        if self._transport_server is None:
+            return sock
+        return self._transport_server.wrap_socket(sock, server_side=True)
+
+    def wrap_transport_client(self, sock):
+        if self._transport_client is None:
+            return sock
+        return self._transport_client.wrap_socket(sock)
+
+    # --------------------------------------------------------------- http
+
+    @property
+    def http_tls(self) -> bool:
+        return self._http_server is not None
+
+    def wrap_http_server_socket(self, sock):
+        if self._http_server is None:
+            return sock
+        return self._http_server.wrap_socket(sock, server_side=True)
+
+    # --------------------------------------------------------- join proof
+
+    def join_proof(self, node_id: str) -> Optional[str]:
+        """HMAC over the joining node's id: presented in the transport
+        handshake, checked at frame admission (transport/tcp.py)."""
+        if not self.shared_secret:
+            return None
+        return hmac.new(self.shared_secret.encode(),
+                        f"join:{node_id}".encode(),
+                        hashlib.sha256).hexdigest()
+
+    def check_join_proof(self, node_id: str, proof: Optional[str]) -> bool:
+        if not self.shared_secret:
+            return True
+        want = self.join_proof(node_id)
+        return bool(proof) and hmac.compare_digest(want, str(proof))
+
+
+def _truthy(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() in ("true", "1", "yes", "on")

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import queue
 import socket
+import ssl
 import struct
 import threading
 import zlib
@@ -140,9 +141,12 @@ class TcpTransport:
     simulation transport, so the Coordinator runs on either."""
 
     def __init__(self, node_id: str, host: str = "127.0.0.1", port: int = 0,
-                 threadpool=None):
+                 threadpool=None, security=None):
         from opensearch_tpu.common.threadpool import ThreadPool
         self.node_id = node_id
+        # TLS contexts + join-proof checker (transport/security.py);
+        # None ⇒ plaintext, open admission (the default for tests)
+        self.security = security
         self.handlers: Dict[str, Callable] = {}
         # the node's named-pool registry (ThreadPool.java:92); owned here
         # when the caller doesn't inject one (tests, bare transports)
@@ -230,8 +234,25 @@ class TcpTransport:
                 conn, _ = self._server.accept()
             except OSError:
                 return
-            threading.Thread(target=self._read_loop,
-                             args=(conn, False), daemon=True).start()
+            threading.Thread(target=self._serve_inbound,
+                             args=(conn,), daemon=True).start()
+
+    def _serve_inbound(self, conn: socket.socket):
+        """Per-connection thread: TLS-wrap first (the handshake blocks,
+        so it must not run on the accept thread — a slow or hostile
+        client would stall all accepts), then pump frames. A peer
+        without a valid cert chain fails HERE, before any frame is
+        read."""
+        if self.security is not None and self.security.transport_tls:
+            try:
+                conn = self.security.wrap_transport_server(conn)
+            except (ssl.SSLError, OSError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+        self._read_loop(conn, outbound=False)
 
     def _read_loop(self, conn: socket.socket, outbound: bool = True):
         """Frame pump for one socket. Direction discipline (the trust
@@ -263,6 +284,14 @@ class TcpTransport:
                 if not handshaken:
                     if action != HANDSHAKE_ACTION:
                         return  # un-handshaken peer: drop the connection
+                    if self.security is not None:
+                        body = payload.get("__body__") or {} \
+                            if isinstance(payload, dict) else {}
+                        sender = payload.get("__sender__", "") \
+                            if isinstance(payload, dict) else ""
+                        if not self.security.check_join_proof(
+                                sender, body.get("proof")):
+                            return  # wrong/absent shared-secret proof
                     handshaken = True
                 if action in self._blocking_actions:
                     pool = self._action_pools.get(action, "write")
@@ -364,6 +393,8 @@ class TcpTransport:
         if addr is None:
             raise NodeNotConnectedError(f"unknown node [{target}]")
         sock = socket.create_connection(addr, timeout=5)
+        if self.security is not None and self.security.transport_tls:
+            sock = self.security.wrap_transport_client(sock)
         sock.settimeout(None)
         self._connections[target] = sock
         threading.Thread(target=self._read_loop, args=(sock, True),
@@ -374,9 +405,14 @@ class TcpTransport:
         with self._lock:
             self._request_counter += 1
             hs_id = self._request_counter
+        hs_body = {"version": __version__}
+        if self.security is not None:
+            proof = self.security.join_proof(self.node_id)
+            if proof is not None:
+                hs_body["proof"] = proof
         self._locked_write(sock, 0, hs_id, HANDSHAKE_ACTION,
                            {"__sender__": self.node_id,
-                            "__body__": {"version": __version__}})
+                            "__body__": hs_body})
         return sock
 
     def send(self, sender: str, target: str, action: str, payload: Any,

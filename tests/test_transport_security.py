@@ -199,3 +199,185 @@ class TestHandshakeGate:
             assert len(t._write_locks) == 0
         finally:
             t.close()
+
+
+# --------------------------------------------------------------- TLS + auth
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    """Self-signed CA + two node certs signed by it + one ROGUE cert
+    signed by a different CA (openssl CLI; no cert library shipped)."""
+    import subprocess
+
+    d = tmp_path_factory.mktemp("certs")
+
+    def run(*args):
+        subprocess.run(args, check=True, capture_output=True)
+
+    def make_ca(name):
+        run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(d / f"{name}.key"), "-out", str(d / f"{name}.pem"),
+            "-days", "1", "-subj", f"/CN={name}")
+
+    def make_cert(name, ca):
+        run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(d / f"{name}.key"), "-out", str(d / f"{name}.csr"),
+            "-subj", f"/CN={name}")
+        run("openssl", "x509", "-req", "-in", str(d / f"{name}.csr"),
+            "-CA", str(d / f"{ca}.pem"), "-CAkey", str(d / f"{ca}.key"),
+            "-CAcreateserial", "-out", str(d / f"{name}.pem"), "-days", "1")
+
+    make_ca("ca")
+    make_ca("rogue-ca")
+    for n in ("node-a", "node-b"):
+        make_cert(n, "ca")
+    make_cert("rogue", "rogue-ca")
+    return d
+
+
+def _tls_settings(certs, name):
+    return {
+        "transport.ssl.enabled": "true",
+        "transport.ssl.certificate": str(certs / f"{name}.pem"),
+        "transport.ssl.key": str(certs / f"{name}.key"),
+        "transport.ssl.certificate_authorities": str(certs / "ca.pem"),
+    }
+
+
+class TestTransportTls:
+    def test_cluster_forms_over_tls_and_serves(self, certs):
+        from opensearch_tpu.cluster.service import ClusterNode
+
+        nodes = {
+            "tls-0": ClusterNode("tls-0", settings=_tls_settings(certs, "node-a")),
+            "tls-1": ClusterNode("tls-1", settings=_tls_settings(certs, "node-b")),
+        }
+        try:
+            peers = {nid: n.address for nid, n in nodes.items()}
+            for n in nodes.values():
+                n.bootstrap(peers)
+            deadline = time.time() + 30
+            while time.time() < deadline and not any(
+                    n.is_leader for n in nodes.values()):
+                time.sleep(0.05)
+            assert any(n.is_leader for n in nodes.values())
+            node = next(iter(nodes.values()))
+            node.request("PUT", "/sec", {
+                "settings": {"number_of_shards": 1, "number_of_replicas": 1},
+                "mappings": {"properties": {"b": {"type": "text"}}}})
+            node.await_health("green", timeout=30)
+            for i in range(5):
+                node.request("PUT", f"/sec/_doc/{i}", {"b": f"tls doc {i}"})
+            node.request("POST", "/sec/_refresh")
+            out = nodes["tls-1"].request("POST", "/sec/_search", {
+                "query": {"match": {"b": "tls"}}})
+            assert out["hits"]["total"]["value"] == 5
+        finally:
+            for n in nodes.values():
+                n.close()
+
+    def test_plaintext_peer_cannot_reach_tls_cluster(self, certs):
+        from opensearch_tpu.cluster.service import ClusterNode
+
+        tls_node = ClusterNode("tls-only",
+                               settings=_tls_settings(certs, "node-a"))
+        try:
+            # raw TCP peer: sends a plaintext handshake frame at a TLS
+            # port; the server's TLS accept fails and the socket closes
+            # without a single frame being admitted
+            from opensearch_tpu.transport import tcp as t
+            sock = socket.create_connection(tls_node.address, timeout=5)
+            try:
+                t._write_frame(sock, 0, 1, t.HANDSHAKE_ACTION,
+                               {"__sender__": "intruder",
+                                "__body__": {"version": "x"}})
+                sock.settimeout(3)
+                data = sock.recv(4096)
+                assert data == b"", "TLS transport answered a plaintext peer"
+            except (ConnectionResetError, BrokenPipeError, socket.timeout):
+                pass      # equally acceptable: reset instead of EOF
+            finally:
+                sock.close()
+        finally:
+            tls_node.close()
+
+    def test_wrong_ca_cert_rejected(self, certs):
+        from opensearch_tpu.cluster.service import ClusterNode
+        from opensearch_tpu.common.errors import OpenSearchTpuError
+
+        good = ClusterNode("good", settings=_tls_settings(certs, "node-a"))
+        rogue_settings = {
+            "transport.ssl.enabled": "true",
+            "transport.ssl.certificate": str(certs / "rogue.pem"),
+            "transport.ssl.key": str(certs / "rogue.key"),
+            # the rogue trusts the real CA (it can VERIFY the server)
+            # but its own cert chains to a different CA — mutual TLS
+            # must refuse its client certificate
+            "transport.ssl.certificate_authorities": str(certs / "ca.pem"),
+        }
+        rogue = ClusterNode("rogue", settings=rogue_settings)
+        try:
+            rogue.transport.add_address("good", *good.address)
+            with pytest.raises(Exception):
+                rogue.transport.send_sync("good", "cluster:ping", {},
+                                          timeout=5)
+        finally:
+            rogue.close()
+            good.close()
+
+
+class TestSharedSecretJoinGate:
+    def test_wrong_secret_dropped_right_secret_served(self):
+        from opensearch_tpu.cluster.service import ClusterNode
+
+        srv = ClusterNode("gate", settings={
+            "cluster.join.shared_secret": "s3cret"})
+        try:
+            ok = ClusterNode("member", settings={
+                "cluster.join.shared_secret": "s3cret"})
+            bad = ClusterNode("intruder", settings={
+                "cluster.join.shared_secret": "wrong"})
+            try:
+                ok.transport.add_address("gate", *srv.address)
+                bad.transport.add_address("gate", *srv.address)
+                srv.transport.register_handler(
+                    "gate", "cluster:ping2", lambda s, p: {"pong": True})
+                assert ok.transport.send_sync(
+                    "gate", "cluster:ping2", {}, timeout=5)["pong"]
+                with pytest.raises(Exception):
+                    bad.transport.send_sync("gate", "cluster:ping2", {},
+                                            timeout=3)
+            finally:
+                ok.close()
+                bad.close()
+        finally:
+            srv.close()
+
+
+class TestHttpsEndpoint:
+    def test_https_serves_and_plain_http_fails(self, certs, tmp_path):
+        import json
+        import ssl as _ssl
+        import urllib.request
+
+        from opensearch_tpu.node import Node
+        from opensearch_tpu.rest.http import HttpServer
+        from opensearch_tpu.transport.security import SecurityConfig
+
+        sec = SecurityConfig({
+            "http.ssl.enabled": "true",
+            "http.ssl.certificate": str(certs / "node-a.pem"),
+            "http.ssl.key": str(certs / "node-a.key")})
+        srv = HttpServer(Node(), port=0, security=sec).start()
+        try:
+            ctx = _ssl.create_default_context(cafile=str(certs / "ca.pem"))
+            ctx.check_hostname = False
+            out = json.loads(urllib.request.urlopen(
+                f"https://127.0.0.1:{srv.port}/", context=ctx,
+                timeout=5).read())
+            assert "version" in out
+            with pytest.raises(Exception):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/", timeout=3)
+        finally:
+            srv.close()
