@@ -1,0 +1,266 @@
+"""Planar geometry for geo_shape fields: GeoJSON parsing + spatial
+predicates (intersects / disjoint / within / contains).
+
+Re-designs the surface of the reference's geo module
+(modules/geo/src/main/java/org/opensearch/geometry/* + Lucene's
+tessellated LatLonShape queries): shapes parse from GeoJSON, each doc
+stores its bounding box in hidden numeric columns (`field#minx` …) for
+the device-side coarse filter, and the EXACT predicate runs host-side on
+the bbox survivors with the classic computational-geometry tests below
+(ray-cast point-in-polygon with holes, segment intersection). Planar
+(equirectangular) semantics — the reference's default quadtree/BKD path
+is likewise planar per cell; great-circle edge interpolation is out of
+scope and documented.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+Point = Tuple[float, float]         # (x=lon, y=lat)
+Ring = List[Point]
+
+
+class Geometry:
+    """Normalized shape: a set of polygons (outer ring + holes), a set of
+    polylines, and a set of points — any GeoJSON type maps onto these."""
+
+    __slots__ = ("polygons", "lines", "points", "bbox")
+
+    def __init__(self, polygons: List[List[Ring]], lines: List[Ring],
+                 points: List[Point]):
+        self.polygons = polygons
+        self.lines = lines
+        self.points = points
+        xs = [p[0] for poly in polygons for ring in poly for p in ring]
+        xs += [p[0] for ln in lines for p in ln] + [p[0] for p in points]
+        ys = [p[1] for poly in polygons for ring in poly for p in ring]
+        ys += [p[1] for ln in lines for p in ln] + [p[1] for p in points]
+        if not xs:
+            raise ValueError("empty geometry")
+        self.bbox = (min(xs), min(ys), max(xs), max(ys))
+
+
+def parse_geojson(obj) -> Geometry:
+    """GeoJSON (dict) → Geometry. Supports Point, MultiPoint, LineString,
+    MultiLineString, Polygon, MultiPolygon, Envelope (the OpenSearch
+    extension: [[minx, maxy], [maxx, miny]]), GeometryCollection."""
+    if isinstance(obj, (list, tuple)) and len(obj) == 2 \
+            and all(isinstance(v, (int, float)) for v in obj):
+        return Geometry([], [], [(float(obj[0]), float(obj[1]))])
+    if not isinstance(obj, dict):
+        raise ValueError(f"cannot parse geo_shape from {type(obj).__name__}")
+    t = str(obj.get("type", "")).lower()
+    coords = obj.get("coordinates")
+
+    def pt(c) -> Point:
+        return (float(c[0]), float(c[1]))
+
+    def ring(c) -> Ring:
+        r = [pt(p) for p in c]
+        if len(r) >= 2 and r[0] == r[-1]:
+            r = r[:-1]               # drop the GeoJSON closing point
+        return r
+
+    if t == "point":
+        return Geometry([], [], [pt(coords)])
+    if t == "multipoint":
+        return Geometry([], [], [pt(c) for c in coords])
+    if t == "linestring":
+        return Geometry([], [[pt(c) for c in coords]], [])
+    if t == "multilinestring":
+        return Geometry([], [[pt(c) for c in ln] for ln in coords], [])
+    if t == "polygon":
+        return Geometry([[ring(r) for r in coords]], [], [])
+    if t == "multipolygon":
+        return Geometry([[ring(r) for r in poly] for poly in coords], [], [])
+    if t == "envelope":
+        (x1, y1), (x2, y2) = pt(coords[0]), pt(coords[1])
+        minx, maxx = min(x1, x2), max(x1, x2)
+        miny, maxy = min(y1, y2), max(y1, y2)
+        return Geometry([[[(minx, miny), (maxx, miny), (maxx, maxy),
+                           (minx, maxy)]]], [], [])
+    if t == "geometrycollection":
+        polys: List[List[Ring]] = []
+        lines: List[Ring] = []
+        points: List[Point] = []
+        for g in obj.get("geometries", []):
+            sub = parse_geojson(g)
+            polys += sub.polygons
+            lines += sub.lines
+            points += sub.points
+        return Geometry(polys, lines, points)
+    raise ValueError(f"unsupported geo_shape type [{obj.get('type')}]")
+
+
+# ------------------------------------------------------------- primitives
+
+def _point_in_ring(p: Point, r: Ring) -> bool:
+    """Ray cast; boundary points count as inside (matches Lucene's
+    CONTAINS treating boundary as contained)."""
+    x, y = p
+    inside = False
+    n = len(r)
+    for i in range(n):
+        x1, y1 = r[i]
+        x2, y2 = r[(i + 1) % n]
+        if _on_segment(p, (x1, y1), (x2, y2)):
+            return True
+        if (y1 > y) != (y2 > y):
+            xin = (x2 - x1) * (y - y1) / (y2 - y1) + x1
+            if x < xin:
+                inside = not inside
+    return inside
+
+
+def _point_in_polygon(p: Point, poly: List[Ring]) -> bool:
+    if not poly or not _point_in_ring(p, poly[0]):
+        return False
+    for hole in poly[1:]:
+        if _point_in_ring(p, hole) and not _on_ring_boundary(p, hole):
+            return False
+    return True
+
+
+def _on_ring_boundary(p: Point, r: Ring) -> bool:
+    n = len(r)
+    return any(_on_segment(p, r[i], r[(i + 1) % n]) for i in range(n))
+
+
+def _on_segment(p: Point, a: Point, b: Point, eps: float = 1e-12) -> bool:
+    cross = (b[0] - a[0]) * (p[1] - a[1]) - (b[1] - a[1]) * (p[0] - a[0])
+    if abs(cross) > eps * max(1.0, abs(b[0] - a[0]) + abs(b[1] - a[1])):
+        return False
+    return (min(a[0], b[0]) - eps <= p[0] <= max(a[0], b[0]) + eps
+            and min(a[1], b[1]) - eps <= p[1] <= max(a[1], b[1]) + eps)
+
+
+def _segments_intersect(a: Point, b: Point, c: Point, d: Point) -> bool:
+    def orient(p, q, r):
+        v = (q[0] - p[0]) * (r[1] - p[1]) - (q[1] - p[1]) * (r[0] - p[0])
+        return 0 if abs(v) < 1e-12 else (1 if v > 0 else -1)
+
+    o1, o2 = orient(a, b, c), orient(a, b, d)
+    o3, o4 = orient(c, d, a), orient(c, d, b)
+    if o1 != o2 and o3 != o4:
+        return True
+    return any((_on_segment(c, a, b), _on_segment(d, a, b),
+                _on_segment(a, c, d), _on_segment(b, c, d)))
+
+
+def _ring_edges(r: Ring):
+    n = len(r)
+    for i in range(n):
+        yield r[i], r[(i + 1) % n]
+
+
+def _line_edges(ln: Ring):
+    for i in range(len(ln) - 1):
+        yield ln[i], ln[i + 1]
+
+
+def _any_edge_cross(edges_a, edges_b) -> bool:
+    eb = list(edges_b)
+    return any(_segments_intersect(a1, a2, b1, b2)
+               for a1, a2 in edges_a for b1, b2 in eb)
+
+
+def _geom_edges(g: Geometry):
+    for poly in g.polygons:
+        for r in poly:
+            yield from _ring_edges(r)
+    for ln in g.lines:
+        yield from _line_edges(ln)
+
+
+def _point_in_geom_area(p: Point, g: Geometry) -> bool:
+    return any(_point_in_polygon(p, poly) for poly in g.polygons)
+
+
+# ------------------------------------------------------------- predicates
+
+def bbox_overlaps(a: Geometry, b: Geometry) -> bool:
+    ax1, ay1, ax2, ay2 = a.bbox
+    bx1, by1, bx2, by2 = b.bbox
+    return ax1 <= bx2 and ax2 >= bx1 and ay1 <= by2 and ay2 >= by1
+
+
+def intersects(a: Geometry, b: Geometry) -> bool:
+    if not bbox_overlaps(a, b):
+        return False
+    # any edge crossing, or any point/vertex of one inside the other's area
+    if _any_edge_cross(_geom_edges(a), _geom_edges(b)):
+        return True
+    for p in a.points:
+        if _point_in_geom_area(p, b) or _point_on_geom(p, b):
+            return True
+    for p in b.points:
+        if _point_in_geom_area(p, a) or _point_on_geom(p, a):
+            return True
+    # containment without edge crossing: test one representative vertex
+    pa = _first_vertex(a)
+    if pa is not None and _point_in_geom_area(pa, b):
+        return True
+    pb = _first_vertex(b)
+    if pb is not None and _point_in_geom_area(pb, a):
+        return True
+    return False
+
+
+def _point_on_geom(p: Point, g: Geometry) -> bool:
+    return (any(_on_segment(p, e1, e2) for e1, e2 in _geom_edges(g))
+            or any(abs(p[0] - q[0]) < 1e-12 and abs(p[1] - q[1]) < 1e-12
+                   for q in g.points))
+
+
+def _first_vertex(g: Geometry) -> Optional[Point]:
+    for poly in g.polygons:
+        if poly and poly[0]:
+            return poly[0][0]
+    for ln in g.lines:
+        if ln:
+            return ln[0]
+    return g.points[0] if g.points else None
+
+
+def within(inner: Geometry, outer: Geometry) -> bool:
+    """Every part of `inner` lies inside `outer`'s area (boundary ok)."""
+    if not outer.polygons:
+        return False
+    verts = ([p for poly in inner.polygons for r in poly for p in r]
+             + [p for ln in inner.lines for p in ln] + inner.points)
+    if not all(_point_in_geom_area(v, outer) or _point_on_geom(v, outer)
+               for v in verts):
+        return False
+    # no inner edge may cross an outer boundary edge (touching is fine —
+    # crossing detection above uses proper intersection plus endpoint
+    # touches, so re-test only PROPER crossings here)
+    for a1, a2 in _geom_edges(inner):
+        for b1, b2 in _geom_edges(outer):
+            if _proper_cross(a1, a2, b1, b2):
+                return False
+    # a hole of outer must not swallow part of inner: sample inner
+    # vertices already covers it (holes excluded by _point_in_polygon)
+    return True
+
+
+def _proper_cross(a, b, c, d) -> bool:
+    def orient(p, q, r):
+        v = (q[0] - p[0]) * (r[1] - p[1]) - (q[1] - p[1]) * (r[0] - p[0])
+        return 0 if abs(v) < 1e-12 else (1 if v > 0 else -1)
+    o1, o2 = orient(a, b, c), orient(a, b, d)
+    o3, o4 = orient(c, d, a), orient(c, d, b)
+    return o1 != o2 and o3 != o4 and 0 not in (o1, o2, o3, o4)
+
+
+def relate(doc: Geometry, query: Geometry, relation: str) -> bool:
+    """OpenSearch geo_shape relations, doc vs query shape."""
+    if relation == "intersects":
+        return intersects(doc, query)
+    if relation == "disjoint":
+        return not intersects(doc, query)
+    if relation == "within":
+        return within(doc, query)
+    if relation == "contains":
+        return within(query, doc)
+    raise ValueError(f"unknown geo_shape relation [{relation}]")

@@ -54,7 +54,7 @@ _RANGE_ELEM = {"integer_range": "integer", "long_range": "long",
 # inclusive-bound adjustment step for exclusive gt/lt on discrete elements
 _RANGE_STEP = {"integer": 1.0, "long": 1.0, "date": 1.0, "ip": 1.0}
 RANGE_UNBOUNDED = 1e308
-GEO_TYPES = {"geo_point"}
+GEO_TYPES = {"geo_point", "geo_shape"}
 
 _INT_BOUNDS = {
     "byte": (-2 ** 7, 2 ** 7 - 1),
@@ -385,6 +385,14 @@ class MapperService:
             for axis in ("lat", "lon"):
                 self.field_types[f"{full_name}.{axis}"] = MappedFieldType(
                     name=f"{full_name}.{axis}", type="double")
+        if ftype == "geo_shape":
+            # hidden bbox columns back every shape (the device-side coarse
+            # filter; exact refinement parses geometries from _source —
+            # reference contrast: AbstractShapeGeometryFieldMapper encodes
+            # a triangle tree into BKD points)
+            for corner in ("minx", "maxx", "miny", "maxy"):
+                self.field_types[f"{full_name}#{corner}"] = MappedFieldType(
+                    name=f"{full_name}#{corner}", type="double")
         self.field_types[full_name] = MappedFieldType(
             name=full_name, type=ftype,
             analyzer=analyzer,
@@ -464,6 +472,10 @@ class MapperService:
                 continue
             if ft is not None and ft.is_range:
                 self._parse_range(full, ft, value, out)
+                continue
+            if ft is not None and ft.type == "geo_shape":
+                # GeoJSON dicts must NOT fall into the object walk
+                self._parse_value(full, value, out)
                 continue
             if full == self.join_field and children is not None:
                 # join value: "parent_type" or {"name": t, "parent": id}
@@ -665,6 +677,20 @@ class MapperService:
                 lat_pf.numeric_values.append(lat)
                 lon_pf.numeric_values.append(lon)
             pf.numeric_values = nums
+        elif ft.type == "geo_shape":
+            from opensearch_tpu.common.geo import parse_geojson
+            try:
+                geom = parse_geojson(value)
+            except (ValueError, TypeError, KeyError, IndexError) as e:
+                raise MapperParsingError(
+                    f"failed to parse field [{name}] of type [geo_shape]: "
+                    f"{e}")
+            minx, miny, maxx, maxy = geom.bbox
+            pf.numeric_values = (pf.numeric_values or []) + [minx]
+            for corner, v in (("minx", minx), ("maxx", maxx),
+                              ("miny", miny), ("maxy", maxy)):
+                cpf = out.setdefault(f"{name}#{corner}", ParsedField())
+                cpf.numeric_values = (cpf.numeric_values or []) + [v]
         # binary/object: stored in _source only
 
     def get_field(self, name: str) -> Optional[MappedFieldType]:

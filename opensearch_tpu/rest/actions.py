@@ -356,6 +356,13 @@ def register_document_actions(node, c):
         if req.param("refresh") in ("true", "", "wait_for"):
             for concrete in by_index:
                 node.indices.get(concrete).refresh()
+            # BulkItemResponse reports forced_refresh per successful item
+            # when the request forced one (DocWriteResponse#forcedRefresh)
+            for item_res in responses:
+                if item_res:
+                    body = next(iter(item_res.values()))
+                    if isinstance(body, dict) and "error" not in body:
+                        body["forced_refresh"] = True
         return {"took": took, "errors": errors, "items": responses}
 
     c.register("PUT", "/{index}/_doc/{id}", do_index)
@@ -1266,8 +1273,21 @@ def register_cluster_actions(node, c):
         return RestResponse(200, "\n".join(lines) + "\n",
                             content_type="text/plain")
 
+    def do_nodes_filtered(req):
+        # node-filter paths (_nodes/data:true, _nodes/master:true, ids,
+        # names) — the single in-process node carries every role, so any
+        # role filter resolves to it; unknown ids resolve to none
+        flt = req.param("node_id") or ""
+        out = do_nodes_info(req)
+        if ":" in flt or flt in ("_all", "_local", "", node.node_id,
+                                 node.node_name):
+            return out
+        return {**out, "_nodes": {"total": 0, "successful": 0, "failed": 0},
+                "nodes": {}}
+
     c.register("GET", "/_nodes", do_nodes_info)
     c.register("GET", "/_nodes/stats", do_nodes_stats)
+    c.register("GET", "/_nodes/{node_id}", do_nodes_filtered)
     c.register("GET", "/_cat/thread_pool", do_cat_thread_pool)
     c.register("GET", "/_nodes/hot_threads", do_hot_threads)
     c.register("GET", "/_nodes/{node_id}/hot_threads", do_hot_threads)

@@ -482,9 +482,21 @@ def _c_metric(node: AggNode, ctx: _Ctx) -> AggPlan:
         # (sum, cnt), not the full 5-reduction stats battery
         needs = _METRIC_NEEDS.get(node.type,
                                   ("cnt", "max", "min", "sum", "sumsq"))
+        missing = node.body.get("missing")
         return AggPlan(node.name, "metric_num",
                        static=(field, needs,
-                               _ident_pairs(ctx.seg.numeric_dv[field])),
+                               _ident_pairs(ctx.seg.numeric_dv[field]),
+                               None if missing is None else float(missing)),
+                       render=render)
+    if node.body.get("missing") is not None and field not in \
+            ctx.seg.ordinal_dv:
+        # field absent from the whole segment but a missing substitute is
+        # given: every doc contributes the substitute (metric over a
+        # constant) — compile as metric_missing_only
+        needs = _METRIC_NEEDS.get(node.type,
+                                  ("cnt", "max", "min", "sum", "sumsq"))
+        return AggPlan(node.name, "metric_missing_only",
+                       static=(needs, float(node.body["missing"])),
                        render=render)
     if field in ctx.seg.ordinal_dv and node.type == "value_count":
         return AggPlan(node.name, "count_ord",
@@ -1123,8 +1135,41 @@ def _eval_agg(plan: AggPlan, seg: Dict, inputs: List[Dict], cursor: List[int],
             _eval_agg(c, seg, inputs, cursor, mask, child_ctx, outs)
         return
 
+    if kind == "metric_missing_only":
+        needs, missing = plan.static
+        bin_lanes = (jnp.zeros(d_pad, jnp.int32) if pbin is None
+                     else jnp.where(pbin >= 0, pbin, parent_card))
+        okm = mask if pmask is None else (mask & pmask)
+        out = {}
+        parts = []
+        if "cnt" in needs:
+            parts.append(("cnt", okm, jnp.int32))
+        if "sum" in needs:
+            parts.append(("sum", okm.astype(jnp.float32) * missing,
+                          jnp.float32))
+        if "sumsq" in needs:
+            parts.append(("sumsq",
+                          okm.astype(jnp.float32) * (missing * missing),
+                          jnp.float32))
+        if parts:
+            sums = _binned_sums(bin_lanes, parent_card,
+                                [(v, dt) for _, v, dt in parts], pstatic)
+            for (nm, _, _), v in zip(parts, sums):
+                out[nm] = v
+        eff = jnp.where(okm & (bin_lanes < parent_card), bin_lanes,
+                        parent_card)
+        if "min" in needs:
+            out["min"] = jnp.full(parent_card, POS_INF, jnp.float32).at[
+                eff].min(jnp.where(okm, missing, POS_INF), mode="drop")
+        if "max" in needs:
+            out["max"] = jnp.full(parent_card, NEG_INF, jnp.float32).at[
+                eff].max(jnp.where(okm, missing, NEG_INF), mode="drop")
+        outs.append(out)
+        return
+
     if kind == "metric_num":
-        field, needs, ident = plan.static
+        field, needs, ident, missing = (plan.static + (None,))[:4] \
+            if len(plan.static) < 4 else plan.static
         col = seg["numeric"][field]
         doc_ids = col["doc_ids"]
         valid = doc_ids >= 0
@@ -1161,6 +1206,39 @@ def _eval_agg(plan: AggPlan, seg: Dict, inputs: List[Dict], cursor: List[int],
         if "max" in needs:
             out["max"] = jnp.full(parent_card, NEG_INF, jnp.float32).at[
                 eff].max(jnp.where(ok_dyn, v, NEG_INF), mode="drop")
+        if missing is not None:
+            # docs WITHOUT the field contribute the substitute value
+            # (ValuesSourceConfig#missing) — doc-space contributions on
+            # top of the pairs-space reductions above
+            exists = col["exists"]
+            bin_m = (jnp.zeros(d_pad, jnp.int32) if pbin is None
+                     else jnp.where(pbin >= 0, pbin, parent_card))
+            okm = (mask if pmask is None else (mask & pmask)) & ~exists
+            parts = []
+            if "cnt" in needs:
+                parts.append(("cnt", okm, jnp.int32))
+            if "sum" in needs:
+                parts.append(("sum", okm.astype(jnp.float32) * missing,
+                              jnp.float32))
+            if "sumsq" in needs:
+                parts.append(("sumsq", okm.astype(jnp.float32)
+                              * (missing * missing), jnp.float32))
+            if parts:
+                sums_m = _binned_sums(bin_m, parent_card,
+                                      [(vv, dt) for _, vv, dt in parts],
+                                      pstatic)
+                for (nm, _, _), vv in zip(parts, sums_m):
+                    out[nm] = out[nm] + vv
+            eff_m = jnp.where(okm & (bin_m < parent_card), bin_m,
+                              parent_card)
+            if "min" in needs:
+                out["min"] = out["min"].at[eff_m].min(
+                    jnp.where(okm, jnp.float32(missing), POS_INF),
+                    mode="drop")
+            if "max" in needs:
+                out["max"] = out["max"].at[eff_m].max(
+                    jnp.where(okm, jnp.float32(missing), NEG_INF),
+                    mode="drop")
         outs.append(out)
         return
 

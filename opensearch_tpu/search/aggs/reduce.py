@@ -108,7 +108,7 @@ def _merge_node(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
         result.update(_merge_children(entries, lambda p: p))
         return result
 
-    if kind == "metric_num":
+    if kind in ("metric_num", "metric_missing_only"):
         return _merge_metric(entries)
 
     if kind == "count_ord":

@@ -374,6 +374,13 @@ class GeoDistanceQuery(QueryNode):
 
 
 @dataclass
+class GeoShapeQuery(QueryNode):
+    field: str = ""
+    shape: dict = None
+    relation: str = "intersects"
+
+
+@dataclass
 class GeoBoundingBoxQuery(QueryNode):
     field: str = ""
     top: float = 90.0
@@ -738,6 +745,26 @@ def parse_query(q: Any) -> QueryNode:
             scaling_factor=float(params.get("scaling_factor", 1.0)),
             exponent=float(params.get("exponent", 1.0)),
             boost=float(body.get("boost", 1.0)))
+
+    if name == "geo_shape":
+        body = dict(body)
+        boost = float(body.pop("boost", 1.0))
+        body.pop("ignore_unmapped", None)
+        if len(body) != 1:
+            raise ParsingError("[geo_shape] requires exactly one field")
+        field, spec = next(iter(body.items()))
+        spec = spec or {}
+        shape = spec.get("shape")
+        if shape is None:
+            raise ParsingError(
+                "[geo_shape] requires [shape] (indexed-shape lookups are "
+                "not supported)")
+        relation = str(spec.get("relation", "intersects")).lower()
+        if relation not in ("intersects", "disjoint", "within", "contains"):
+            raise ParsingError(
+                f"[geo_shape] unknown relation [{relation}]")
+        return GeoShapeQuery(field=field, shape=shape, relation=relation,
+                             boost=boost)
 
     if name == "geo_distance":
         body = dict(body)

@@ -1,0 +1,146 @@
+"""geo_shape field type + query (round-4 verdict missing #4).
+
+Modeled on the reference suites: modules/geo GeoShapeQueryTests /
+GeoShapeIntegrationIT — GeoJSON shapes index with hidden bbox columns
+(device coarse filter) and resolve intersects/disjoint/within/contains
+exactly host-side (common/geo.py planar predicates)."""
+
+import pytest
+
+from opensearch_tpu.common import geo as geolib
+from opensearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    n.request("PUT", "/g", {"mappings": {"properties": {
+        "region": {"type": "geo_shape"}, "name": {"type": "keyword"}}}})
+    docs = {
+        "sq": {"type": "polygon",
+               "coordinates": [[[0, 0], [10, 0], [10, 10], [0, 10],
+                                [0, 0]]]},
+        "far": {"type": "polygon",
+                "coordinates": [[[50, 50], [60, 50], [60, 60], [50, 60],
+                                 [50, 50]]]},
+        "inner": {"type": "polygon",
+                  "coordinates": [[[2, 2], [4, 2], [4, 4], [2, 4],
+                                   [2, 2]]]},
+        "pt": {"type": "point", "coordinates": [5, 5]},
+        "line": {"type": "linestring",
+                 "coordinates": [[-5, -5], [15, 15]]},
+        "env": {"type": "envelope", "coordinates": [[20, 30], [30, 20]]},
+        "donut": {"type": "polygon",
+                  "coordinates": [[[0, 30], [20, 30], [20, 50], [0, 50],
+                                   [0, 30]],
+                                  [[8, 38], [12, 38], [12, 42], [8, 42],
+                                   [8, 38]]]},
+        "multi": {"type": "multipolygon",
+                  "coordinates": [[[[100, 0], [105, 0], [105, 5],
+                                    [100, 5], [100, 0]]],
+                                  [[[110, 0], [115, 0], [115, 5],
+                                    [110, 5], [110, 0]]]]},
+    }
+    for name, shape in docs.items():
+        n.request("PUT", f"/g/_doc/{name}", {"region": shape,
+                                             "name": name})
+    n.request("POST", "/g/_refresh")
+    return n
+
+
+def hits(node, shape, relation="intersects"):
+    out = node.request("POST", "/g/_search", {
+        "size": 20,
+        "query": {"geo_shape": {"region": {"shape": shape,
+                                           "relation": relation}}}})
+    assert "hits" in out, out
+    return sorted(h["_id"] for h in out["hits"]["hits"])
+
+
+PROBE = {"type": "polygon",
+         "coordinates": [[[1, 1], [6, 1], [6, 6], [1, 6], [1, 1]]]}
+
+
+class TestGeoShapeQuery:
+    def test_intersects(self, node):
+        assert hits(node, PROBE) == ["inner", "line", "pt", "sq"]
+
+    def test_disjoint(self, node):
+        assert hits(node, PROBE, "disjoint") == ["donut", "env", "far",
+                                                 "multi"]
+
+    def test_within(self, node):
+        assert hits(node, PROBE, "within") == ["inner", "pt"]
+
+    def test_contains_point(self, node):
+        assert hits(node, {"type": "point", "coordinates": [3, 3]},
+                    "contains") == ["inner", "sq"]
+
+    def test_hole_excludes_containment(self, node):
+        assert "donut" not in hits(
+            node, {"type": "point", "coordinates": [10, 40]}, "contains")
+        assert "donut" in hits(
+            node, {"type": "point", "coordinates": [1, 31]}, "contains")
+
+    def test_multipolygon_parts_both_match(self, node):
+        probe = {"type": "envelope", "coordinates": [[102, 3], [103, 1]]}
+        assert "multi" in hits(node, probe)
+        probe2 = {"type": "envelope", "coordinates": [[112, 3], [113, 1]]}
+        assert "multi" in hits(node, probe2)
+
+    def test_bool_composition_with_term(self, node):
+        out = node.request("POST", "/g/_search", {"query": {"bool": {
+            "filter": [{"geo_shape": {"region": {"shape": PROBE}}},
+                       {"term": {"name": "sq"}}]}}})
+        assert [h["_id"] for h in out["hits"]["hits"]] == ["sq"]
+
+    def test_envelope_query_shape(self, node):
+        env = {"type": "envelope", "coordinates": [[21, 29], [29, 21]]}
+        assert hits(node, env) == ["env"]
+
+    def test_unknown_relation_and_missing_shape_error(self, node):
+        out = node.request("POST", "/g/_search", {"query": {
+            "geo_shape": {"region": {"shape": PROBE, "relation": "x"}}}})
+        assert out.get("status") == 400
+        out = node.request("POST", "/g/_search", {"query": {
+            "geo_shape": {"region": {}}}})
+        assert out.get("status") == 400
+
+    def test_bad_document_shape_rejected(self, node):
+        out = node.request("PUT", "/g/_doc/bad",
+                           {"region": {"type": "polygon"}})
+        assert out.get("status") == 400, out
+
+
+class TestGeoPredicates:
+    def test_point_in_polygon_with_hole(self):
+        donut = geolib.parse_geojson({
+            "type": "polygon",
+            "coordinates": [[[0, 0], [10, 0], [10, 10], [0, 10], [0, 0]],
+                            [[4, 4], [6, 4], [6, 6], [4, 6], [4, 4]]]})
+        pt_in = geolib.parse_geojson({"type": "point",
+                                      "coordinates": [2, 2]})
+        pt_hole = geolib.parse_geojson({"type": "point",
+                                        "coordinates": [5, 5]})
+        assert geolib.intersects(pt_in, donut)
+        assert not geolib.within(pt_hole, donut)
+
+    def test_line_crossing_polygon(self):
+        sq = geolib.parse_geojson({
+            "type": "polygon",
+            "coordinates": [[[0, 0], [10, 0], [10, 10], [0, 10], [0, 0]]]})
+        ln = geolib.parse_geojson({"type": "linestring",
+                                   "coordinates": [[-5, 5], [15, 5]]})
+        assert geolib.intersects(ln, sq)
+        assert not geolib.within(ln, sq)
+
+    def test_nested_containment_no_edge_cross(self):
+        outer = geolib.parse_geojson({
+            "type": "polygon",
+            "coordinates": [[[0, 0], [10, 0], [10, 10], [0, 10], [0, 0]]]})
+        innr = geolib.parse_geojson({
+            "type": "polygon",
+            "coordinates": [[[2, 2], [4, 2], [4, 4], [2, 4], [2, 2]]]})
+        assert geolib.intersects(outer, innr)   # containment intersects
+        assert geolib.within(innr, outer)
+        assert not geolib.within(outer, innr)

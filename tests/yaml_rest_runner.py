@@ -165,8 +165,11 @@ class YamlTestRunner:
             ignore = [ignore]
         method, path, params = resolve_call(api, args)
         if isinstance(body, list):
-            # ndjson endpoints (bulk/msearch): list of action/source docs
-            raw = "\n".join(json.dumps(item) for item in body) + "\n"
+            # ndjson endpoints (bulk/msearch): list of action/source docs;
+            # items may already BE serialized JSON lines (the framework's
+            # "list of strings" form) — pass those through untouched
+            raw = "\n".join(item if isinstance(item, str)
+                            else json.dumps(item) for item in body) + "\n"
             resp = self.node.handle(method, path, params=params, body=raw)
         elif isinstance(body, str):
             resp = self.node.handle(method, path, params=params, body=body)
@@ -218,8 +221,10 @@ class YamlTestRunner:
     def match(self, spec):
         path, expected = self._expect(spec)
         actual = _lookup(self.last, path)
-        if isinstance(expected, str) and len(expected) > 1 \
-                and expected.startswith("/") and expected.endswith("/"):
+        if isinstance(expected, str) and len(expected.strip()) > 1 \
+                and expected.strip().startswith("/") \
+                and expected.strip().endswith("/"):
+            expected = expected.strip()
             pattern = re.sub(r"\s+#.*$", "", expected[1:-1],
                              flags=re.MULTILINE)
             pattern = re.sub(r"\s+", "", pattern)
