@@ -218,7 +218,11 @@ def bench_aggs(mode: str):
         executor.multi_search(bodies)
         times.append(time.perf_counter() - t0)
     qps = n_q / sorted(times)[len(times) // 2]
-    # latency distribution: the single-search path (B=1 programs)
+    # latency distribution: the single-search path (B=1 programs). This
+    # pass is COLD-INCLUSIVE: the bodies[:4] "warmup" below is served from
+    # the request cache (the QPS runs populated it), so the first
+    # uncached body pays the B=1 executable compile INSIDE the
+    # measurement — that compile cliff is exactly what p99_ms reports.
     for b in bodies[:4]:
         executor.search(b)
     REQUEST_CACHE.clear()
@@ -228,18 +232,37 @@ def bench_aggs(mode: str):
         executor.search(b)
         lat.append((time.perf_counter() - s0) * 1000)
 
+    # executable warmup (search/warmup.py — the index-open hook run
+    # explicitly): replay every (plan-struct, shape-bucket) signature the
+    # traffic above registered, request cache bypassed, and re-measure.
+    # Warmup time is its own field — compile cost moves OFF the query
+    # path but is never hidden from the record.
+    from opensearch_tpu.search.warmup import WARMUP
+    t0 = time.perf_counter()
+    WARMUP.warm_executor(executor)
+    warmup_ms = (time.perf_counter() - t0) * 1000
+    REQUEST_CACHE.clear()
+    warm_lat = []
+    for b in bodies:
+        s0 = time.perf_counter()
+        executor.search(b)
+        warm_lat.append((time.perf_counter() - s0) * 1000)
+
     t0 = time.perf_counter()
     for a in base_args:
         base_one(a)
     base_qps = n_q / (time.perf_counter() - t0)
 
     p50, p99 = _lat_stats(lat)
+    warm_p50, warm_p99 = _lat_stats(warm_lat)
     out = {
         "metric": f"{mode}_qps_{N_DOCS // 1000}k_docs_{platform}",
         "value": round(qps, 2),
         "unit": "queries/s",
         "vs_baseline": round(qps / base_qps, 3),
         "p50_ms": p50, "p99_ms": p99,
+        "warm_p50_ms": warm_p50, "warm_p99_ms": warm_p99,
+        "warmup_ms": round(warmup_ms, 1),
     }
     if _BACKEND_DIAG:
         out["backend_diag"] = "; ".join(_BACKEND_DIAG)

@@ -239,9 +239,44 @@ def within(inner: Geometry, outer: Geometry) -> bool:
         for b1, b2 in _geom_edges(outer):
             if _proper_cross(a1, a2, b1, b2):
                 return False
-    # a hole of outer must not swallow part of inner: sample inner
-    # vertices already covers it (holes excluded by _point_in_polygon)
+    # a hole of outer must not swallow part of inner: vertex sampling
+    # misses a hole STRICTLY interior to the inner shape (no inner vertex
+    # falls in it, no edges cross — the holed-square-around-a-square
+    # case), so each hole is probed by a representative interior point:
+    # if that point lies in inner's area, part of inner is uncovered
+    for poly in outer.polygons:
+        for hole in poly[1:]:
+            if len(hole) < 3 or not _ring_bbox_overlaps(hole, inner.bbox):
+                continue
+            rep = _ring_interior_point(hole)
+            if rep is not None and _point_in_geom_area(rep, inner) \
+                    and not _point_on_geom(rep, inner):
+                return False
     return True
+
+
+def _ring_bbox_overlaps(r: Ring, bbox) -> bool:
+    x1, y1, x2, y2 = bbox
+    xs = [p[0] for p in r]
+    ys = [p[1] for p in r]
+    return (min(xs) <= x2 and max(xs) >= x1
+            and min(ys) <= y2 and max(ys) >= y1)
+
+
+def _ring_interior_point(r: Ring) -> Optional[Point]:
+    """A point strictly inside a simple ring: the vertex centroid when it
+    qualifies (convex & most concave rings), else vertex-pair midpoints."""
+    n = len(r)
+    cx = sum(p[0] for p in r) / n
+    cy = sum(p[1] for p in r) / n
+    if _point_in_ring((cx, cy), r) and not _on_ring_boundary((cx, cy), r):
+        return (cx, cy)
+    for i in range(n):
+        for j in range(i + 1, n):
+            m = ((r[i][0] + r[j][0]) / 2, (r[i][1] + r[j][1]) / 2)
+            if _point_in_ring(m, r) and not _on_ring_boundary(m, r):
+                return m
+    return None
 
 
 def _proper_cross(a, b, c, d) -> bool:

@@ -338,7 +338,8 @@ class IndexService:
     def search(self, body: Optional[dict] = None) -> dict:
         self.check_open()
         from opensearch_tpu.search.controller import execute_search
-        return execute_search([s.executor for s in self.shards], body)
+        return execute_search([s.executor for s in self.shards], body,
+                              allow_envelope=True)
 
     def multi_search(self, bodies: List[dict]) -> dict:
         self.check_open()

@@ -13,6 +13,7 @@ entries age out of the LRU instead of needing explicit invalidation hooks.
 from __future__ import annotations
 
 import json
+import re
 import threading
 from collections import OrderedDict
 from typing import Any, Callable, Optional, Tuple
@@ -81,9 +82,39 @@ def cache_key(segments, body: dict, k: int,
             extra)
 
 
+# date-math expression relative to evaluation time: "now", "now-1d",
+# "now+2h/d", ... — same family indices.query_cache.cacheable_node
+# rejects at the compiled-filter level (RangeQuery bounds containing
+# "now"). Anchored so plain values like "nowhere" don't match.
+_NOW_MATH = re.compile(r"^now([+\-/].*)?$")
+
+
+def _has_now_date_math(obj) -> bool:
+    """True if any string value anywhere under the query/agg tree is a
+    now-relative date-math expression. Walking every value (not just
+    range bounds) deliberately over-rejects: date math appears in range
+    filters, date_range agg specs, extended_bounds, distance_feature
+    origins — and a skipped cache entry only costs a recompute, where a
+    cached now-relative result is silently stale until LRU eviction."""
+    if isinstance(obj, str):
+        return bool(_NOW_MATH.match(obj))
+    if isinstance(obj, dict):
+        return any(_has_now_date_math(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return any(_has_now_date_math(v) for v in obj)
+    return False
+
+
 def cacheable(body: dict) -> bool:
     """Default policy mirrors the reference: only size=0 requests (aggs,
-    counts) are cached; profile runs always execute."""
+    counts) are cached; profile runs always execute. Bodies whose query or
+    agg tree contains now-relative date math never cache — "now" resolves
+    per evaluation, so a cached result would keep serving the resolution
+    instant of the first request (IndicesService.canCache's
+    Rewriteable.isCacheable gate in the reference)."""
     return (body.get("size", 10) == 0
             and not body.get("profile")
-            and body.get("search_after") is None)
+            and body.get("search_after") is None
+            and not _has_now_date_math(body.get("query"))
+            and not _has_now_date_math(body.get("aggs")
+                                       or body.get("aggregations")))

@@ -299,10 +299,18 @@ class IndicesService:
                              expand_closed=True)
         if not names:
             raise IndexNotFoundError(expression)
+        from opensearch_tpu.search.warmup import WARMUP
         for name in names:
             svc = self.indices[name]
             svc.closed = False
             svc.settings.pop("closed", None)
+            # index-open warmup hook: replay this index's registered
+            # query shapes so their executables compile off the query
+            # path (reference analog: IndexWarmer on a fresh reader).
+            # Budget/enablement come from the registry knobs Node sets
+            # from settings (search.warmup.budget_ms, search.warmup_on_open)
+            if WARMUP.warm_on_open:
+                WARMUP.warm_index(name, [s.executor for s in svc.shards])
         return names
 
     def has_index(self, name: str) -> bool:

@@ -72,6 +72,22 @@ class Node:
             loaded = self.gateway.load(self.indices)
             if loaded and loaded.get("cluster_settings"):
                 self.cluster_settings.update(loaded["cluster_settings"])
+        # executable warmup (search/warmup.py): load the persisted
+        # (plan-struct, shape-bucket) registry from the data dir, point
+        # jax's persistent compilation cache under it, and AOT-compile the
+        # registered executables for any gateway-restored indices BEFORE
+        # the first query can hit the cold-compile cliff
+        if data_path is not None:
+            from opensearch_tpu.search.warmup import WARMUP
+            WARMUP.configure(data_path)
+            WARMUP.default_budget_s = float(self.settings.get(
+                "search.warmup.budget_ms", 10000)) / 1000.0
+            WARMUP.warm_on_open = bool(self.settings.get(
+                "search.warmup_on_open", True))
+            if self.settings.get("search.warmup_at_start", True) \
+                    and self.indices.indices:
+                WARMUP.warm_all(self.indices,
+                                budget_s=WARMUP.default_budget_s)
         self.controller = RestController()
         from opensearch_tpu.rest.actions import register_all
         register_all(self)
@@ -81,6 +97,8 @@ class Node:
         path — pure in-memory node)."""
         if self.gateway is not None:
             self.gateway.persist(self.indices, self.cluster_settings)
+            from opensearch_tpu.search.warmup import WARMUP
+            WARMUP.flush()
 
     # ------------------------------------------------------------- dispatch
 

@@ -158,6 +158,14 @@ def align_agg_plans(per_shard: Sequence[Sequence[Any]]) -> None:
                 raise ValueError(
                     f"agg plan kinds diverge across shards: {kinds}")
             kind = kinds.pop()
+            if kind.endswith("_bits"):
+                # fused kinds close over per-segment constant bitmasks —
+                # no cross-shard alignment can make ONE traced program
+                # correct for every row; callers fall back to host loop
+                # (compile paths that trace cross-row pass
+                # allow_fused=False, so this is defense in depth)
+                raise ValueError(
+                    f"fused agg kind [{kind}] cannot align across shards")
             if kind in _CARD_KINDS:
                 card = max(p.static[1] for p in group)
                 for p in group:

@@ -122,7 +122,7 @@ def _run_search(node, index_expr: Optional[str], body: Optional[dict]) -> dict:
         description=f"indices[{index_expr or '_all'}]", cancellable=True)
     try:
         res = execute_search(executors, body, extra_filters=filters,
-                             task=task)
+                             task=task, allow_envelope=True)
     finally:
         node.task_manager.unregister(task)
         node.search_backpressure.release()
@@ -1187,6 +1187,7 @@ def register_cluster_actions(node, c):
         from opensearch_tpu.indices.request_cache import REQUEST_CACHE
         from opensearch_tpu.monitor import (os_probe as _os_probe,
                                             process_probe as _process_probe)
+        from opensearch_tpu.search.warmup import WARMUP
         idx_stats = {n: svc.stats()
                      for n, svc in node.indices.indices.items()}
         import resource
@@ -1206,6 +1207,7 @@ def register_cluster_actions(node, c):
                     "request_cache": REQUEST_CACHE.stats(),
                     "query_cache": QUERY_CACHE.stats(),
                 },
+                "search_warmup": WARMUP.stats(),
                 "breakers": node.breaker_service.stats(),
                 "indexing_pressure": node.indexing_pressure.stats(),
                 "search_backpressure": node.search_backpressure.stats(),

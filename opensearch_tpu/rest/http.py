@@ -35,6 +35,26 @@ class _Handler(BaseHTTPRequestHandler):
             # in-process dicts
             from opensearch_tpu.common import xcontent
             ctype = self.headers.get("Content-Type")
+            if ctype and xcontent.media_type(ctype) is None:
+                # declared but unrecognized media type: reject up front
+                # (RestController.dispatchRequest's 406) — decode_body
+                # would "fail open" to a None body and the raw binary
+                # would fall through into the NDJSON bulk parser
+                payload = json.dumps({
+                    "error": {
+                        "type": "not_acceptable_exception",
+                        "reason": f"Content-Type header [{ctype}] is not "
+                                  f"supported",
+                    },
+                    "status": 406,
+                }).encode("utf-8")
+                self.send_response(406)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                if method != "HEAD":
+                    self.wfile.write(payload)
+                return
             try:
                 if (xcontent.media_type(ctype) == xcontent.CBOR
                         and parsed.path.rstrip("/").endswith("_bulk")):

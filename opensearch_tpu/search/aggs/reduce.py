@@ -69,12 +69,14 @@ def _merge_node(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
     if kind == "empty":
         return _render_empty(render)
 
-    if kind in ("bucket_ord", "bucket_num"):
+    if kind in ("bucket_ord", "bucket_num", "bucket_bits"):
         rkind = render.get("kind", "terms")
         if rkind == "terms":
             return _merge_terms(entries)
         if rkind == "significant_terms":
             return _merge_significant_terms(entries)
+        if rkind in ("range", "date_range", "ip_range"):
+            return _merge_ranges_fused(entries)
         out = _merge_histogram(entries)
         if rkind == "auto_date_histogram":
             out["interval"] = render.get("interval")
@@ -115,7 +117,7 @@ def _merge_node(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
         cnt = sum(int(d.out["cnt"][p]) for d, p in entries if "cnt" in d.out)
         return {"value": cnt}
 
-    if kind in ("presence_ord", "presence_num"):
+    if kind in ("presence_ord", "presence_num", "presence_bits"):
         return _merge_cardinality(entries)
 
     if kind == "value_hist":
@@ -149,6 +151,24 @@ def _render_empty(render: dict) -> Dict[str, Any]:
         return {"doc_count_error_upper_bound": 0, "sum_other_doc_count": 0,
                 "buckets": []}
     if rkind in ("histogram", "date_histogram"):
+        body = render.get("body", {})
+        if int(body.get("min_doc_count", 0)) == 0 \
+                and body.get("extended_bounds"):
+            eb = _hist_eb_keys(render, body)
+            step = render.get("step")
+            if eb is not None and None not in eb and step:
+                lo, hi = eb
+                is_date = rkind == "date_histogram"
+                buckets = []
+                k = lo
+                while k <= hi + step / 2:
+                    b: Dict[str, Any] = {"key": int(k) if is_date else k,
+                                         "doc_count": 0}
+                    if is_date:
+                        b["key_as_string"] = format_date_millis(int(k))
+                    buckets.append(b)
+                    k += step
+                return {"buckets": buckets}
         return {"buckets": []}
     if rkind in ("range", "date_range", "ip_range"):
         specs = render.get("specs", [])
@@ -244,33 +264,90 @@ def _orderable(key):
     return (0, key) if isinstance(key, (int, float, bool)) else (1, str(key))
 
 
+def _hist_eb_keys(render: dict, body: dict):
+    """extended_bounds clamped onto the bucket-key lattice → (lo, hi) keys
+    (either side may be None). Fixed-step histograms only — calendar
+    intervals have no arithmetic lattice to extend along."""
+    eb = body.get("extended_bounds")
+    step = render.get("step")
+    if not eb or not step or render.get("calendar"):
+        return None
+
+    def conv(v):
+        if v is None:
+            return None
+        if isinstance(v, str):
+            from opensearch_tpu.index.mapper import parse_date_millis
+            from opensearch_tpu.search.compile import _resolve_date_math
+            v = _resolve_date_math(v)
+            if isinstance(v, str):
+                v = parse_date_millis(v)
+        return float(v)
+
+    shift = float(render.get("shift", 0.0))
+    lo, hi = conv(eb.get("min")), conv(eb.get("max"))
+
+    def key_of(v):
+        return math.floor((v + shift) / step) * step - shift
+
+    return ((None if lo is None else key_of(lo)),
+            (None if hi is None else key_of(hi)))
+
+
+def _trim_zero_edges(buckets: List[dict], min_doc_count: int,
+                     eb_keys) -> List[dict]:
+    """Histogram buckets exist between the min and max COLLECTED buckets
+    (plus extended_bounds) — the compiled key table spans the segment's
+    whole data range, so a query-filtered histogram must drop the
+    leading/trailing zero-count buckets outside the matched span
+    (reference: InternalHistogram.addEmptyBuckets fills between the
+    first and last non-empty bucket only)."""
+    if min_doc_count != 0 or not buckets:
+        return buckets
+    nz = [i for i, b in enumerate(buckets) if b["doc_count"] > 0]
+    lo = buckets[nz[0]]["key"] if nz else None
+    hi = buckets[nz[-1]]["key"] if nz else None
+    if eb_keys is not None:
+        eb_lo, eb_hi = eb_keys
+        if eb_lo is not None:
+            lo = eb_lo if lo is None else min(lo, eb_lo)
+        if eb_hi is not None:
+            hi = eb_hi if hi is None else max(hi, eb_hi)
+    if lo is None:
+        return []
+    return [b for b in buckets if lo <= b["key"] <= hi]
+
+
 def _merge_histogram(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
     plan = entries[0][0].plan
     render = plan.render
     body = render.get("body", {})
     min_doc_count = int(body.get("min_doc_count", 0))
     is_date = render.get("kind") == "date_histogram"
+    eb_keys = _hist_eb_keys(render, body) if body.get("extended_bounds") \
+        else None
 
     # single-segment, leaf histogram (the dashboard hot shape): render
-    # straight from the counts array — no per-bucket dict accumulation
-    if (len(entries) == 1 and not entries[0][0].children
+    # straight from the counts array — no per-bucket dict accumulation,
+    # key strings precomputed at compile (render["keys_str"])
+    if (len(entries) == 1 and not entries[0][0].children and eb_keys is None
             and "counts" in entries[0][0].out):
         d, p = entries[0]
         card = d.plan.static[1]
         keys = d.plan.render["keys"]
+        keys_str = d.plan.render.get("keys_str")
         counts = np.asarray(d.out["counts"])[p * card:(p + 1) * card]
-        counts = counts[:len(keys)]
-        buckets = []
-        for k, c in zip(keys, counts):
-            c = int(c)
-            if c < min_doc_count:
-                continue
-            b: Dict[str, Any] = {"key": int(k) if is_date else k,
-                                 "doc_count": c}
-            if is_date:
-                b["key_as_string"] = format_date_millis(int(k))
-            buckets.append(b)
-        return {"buckets": buckets}
+        counts = counts[:len(keys)].tolist()
+        if is_date:
+            if keys_str is None:
+                keys_str = [format_date_millis(int(k)) for k in keys]
+            buckets = [{"key": int(k), "doc_count": c, "key_as_string": ks}
+                       for k, ks, c in zip(keys, keys_str, counts)
+                       if c >= min_doc_count]
+        else:
+            buckets = [{"key": k, "doc_count": c}
+                       for k, c in zip(keys, counts) if c >= min_doc_count]
+        return {"buckets": _trim_zero_edges(buckets, min_doc_count, None)}
 
     acc: Dict[float, Dict[str, Any]] = {}
     for d, p in entries:
@@ -287,27 +364,38 @@ def _merge_histogram(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
             if n > 0 or True:
                 slot["segments"].append((d, p, c))
 
-    if not acc:
+    if not acc and eb_keys is None:
         return {"buckets": []}
     all_keys = sorted(acc.keys())
-    # fill gaps for min_doc_count == 0 between observed bounds (fixed step only)
-    if min_doc_count == 0 and len(all_keys) >= 2 and not render.get("calendar"):
-        steps = sorted({round(b - a, 9) for a, b in zip(all_keys, all_keys[1:])})
-        step = steps[0] if steps else None
-        if step and step > 0:
-            # O(1) membership by quantized offset from the first key (the
-            # old per-candidate linear scan was O(buckets²) and dominated
-            # the date_histogram respond phase)
-            base_key = all_keys[0]
-            seen = {round((ak - base_key) / step) for ak in all_keys}
-            k = base_key
-            q = 0
-            while k <= all_keys[-1] + step / 2:
-                if q not in seen:
-                    acc[k] = {"doc_count": 0, "segments": []}
-                q += 1
-                k = base_key + q * step
-            all_keys = sorted(acc.keys())
+    # fill gaps for min_doc_count == 0 between observed bounds (fixed step
+    # only) and out to extended_bounds when given
+    if min_doc_count == 0 and not render.get("calendar"):
+        step = render.get("step")
+        if step is None and len(all_keys) >= 2:
+            # legacy plans carry no lattice info: infer from observed keys
+            steps = sorted({round(b - a, 9)
+                            for a, b in zip(all_keys, all_keys[1:])})
+            step = steps[0] if steps and steps[0] > 0 else None
+        if step:
+            lo = all_keys[0] if all_keys else None
+            hi = all_keys[-1] if all_keys else None
+            if eb_keys is not None:
+                eb_lo, eb_hi = eb_keys
+                lo = eb_lo if lo is None else \
+                    (lo if eb_lo is None else min(lo, eb_lo))
+                hi = eb_hi if hi is None else \
+                    (hi if eb_hi is None else max(hi, eb_hi))
+            if lo is not None and hi is not None:
+                base_key = lo
+                seen = {round((ak - base_key) / step) for ak in all_keys}
+                q = 0
+                k = base_key
+                while k <= hi + step / 2:
+                    if q not in seen:
+                        acc[k] = {"doc_count": 0, "segments": []}
+                    q += 1
+                    k = base_key + q * step
+                all_keys = sorted(acc.keys())
 
     first = entries[0][0]
     buckets = []
@@ -327,7 +415,7 @@ def _merge_histogram(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
             else:
                 bucket[child.plan.name] = _render_empty(child.plan.render)
         buckets.append(bucket)
-    return {"buckets": buckets}
+    return {"buckets": _trim_zero_edges(buckets, min_doc_count, eb_keys)}
 
 
 def _merge_ranges(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
@@ -351,6 +439,35 @@ def _merge_ranges(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
             if is_date:
                 bucket["to_as_string"] = format_date_millis(int(to))
         bucket.update(_merge_children(sub_entries, lambda p: p))
+        buckets.append(bucket)
+    return {"buckets": buckets}
+
+
+def _merge_ranges_fused(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
+    """Range buckets from the fused bucket_bits kind: one counts row per
+    range spec (overlap-safe), no per-range sub-plans to walk."""
+    plan = entries[0][0].plan
+    render = plan.render
+    specs = render.get("specs", [])
+    is_date = render.get("is_date", False)
+    buckets = []
+    for i, (key, frm, to) in enumerate(specs):
+        count = 0
+        for d, p in entries:
+            if d.plan.kind == "bucket_bits" and "counts" in d.out:
+                count += int(d.out["counts"][i])
+            elif d.plan.kind == "multi" and i < len(d.children) \
+                    and "counts" in d.children[i].out:
+                count += int(d.children[i].out["counts"][p])
+        bucket: Dict[str, Any] = {"key": key, "doc_count": count}
+        if frm is not None:
+            bucket["from"] = frm
+            if is_date:
+                bucket["from_as_string"] = format_date_millis(int(frm))
+        if to is not None:
+            bucket["to"] = to
+            if is_date:
+                bucket["to_as_string"] = format_date_millis(int(to))
         buckets.append(bucket)
     return {"buckets": buckets}
 
@@ -439,19 +556,28 @@ def _merge_metric(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
 
 
 def _merge_cardinality(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
+    live = [(d, p) for d, p in entries if "present" in d.out]
+    if len(live) == 1:
+        # single segment: the presence bitmap's popcount IS the exact
+        # cardinality — no key materialization
+        d, p = live[0]
+        card = d.plan.static[1]
+        present = np.asarray(d.out["present"][p * card:(p + 1) * card])
+        n_keys = len(d.plan.render["keys"]
+                     if "keys" in d.plan.render
+                     else d.plan.render.get("values", ()))
+        return {"value": int(np.count_nonzero(present[:n_keys]))}
     distinct = set()
-    for d, p in entries:
-        if "present" not in d.out:
-            continue
+    for d, p in live:
         card = d.plan.static[1]
         present = d.out["present"][p * card:(p + 1) * card]
-        if d.plan.kind == "presence_ord":
+        if "keys" in d.plan.render:
             keys = d.plan.render["keys"]
             for c in np.nonzero(present)[0]:
                 if c < len(keys):
                     distinct.add(keys[int(c)])
         else:
-            values = d.plan.render["values"]
+            values = d.plan.render.get("values", ())
             for c in np.nonzero(present)[0]:
                 if c < len(values):
                     distinct.add(float(values[int(c)]))

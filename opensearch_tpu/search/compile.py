@@ -62,6 +62,15 @@ class Plan:
         return out
 
 
+def struct_fingerprint(obj: Any) -> str:
+    """Stable hex digest of a nested plan-struct / shape-signature tuple
+    (str/int/None leaves only — repr is deterministic across processes,
+    unlike hash() under PYTHONHASHSEED randomization). Keys the warmup
+    registry's persisted (plan-struct, shape-bucket) entries."""
+    import hashlib
+    return hashlib.sha1(repr(obj).encode("utf-8")).hexdigest()
+
+
 def _f32(x) -> np.ndarray:
     return np.asarray(x, dtype=np.float32)
 

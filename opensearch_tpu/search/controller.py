@@ -175,15 +175,31 @@ def execute_search(executors: List, body: Optional[dict],
                    failed_shards: int = 0,
                    extra_filters: Optional[List[Optional[dict]]] = None,
                    cursor_tiebreak: Optional[Tuple[int, int, int]] = None,
-                   task=None) -> dict:
+                   task=None, allow_envelope: bool = False) -> dict:
     """Run the full query-then-fetch flow over shard executors and render
     the search response. `executors` are per-shard SearchExecutors;
     `extra_filters` (aligned with executors) carry per-index alias filters;
     `cursor_tiebreak` is the internal scroll cursor position; `task` (when
     given) is checked for cancellation between shard launches — the safe
-    points between device programs (CancellableBulkScorer analog)."""
+    points between device programs (CancellableBulkScorer analog).
+    `allow_envelope` (top-level serving entry points only — REST _search,
+    IndexService.search) lets a single-shard plain request delegate to the
+    msearch envelope; scroll/reindex/CCS callers need this path's page
+    cursor and shard accounting, and the envelope's own fallback re-enters
+    here and must not loop."""
     body = body or {}
     _validate_search_body_keys(body)
+    if (allow_envelope and len(executors) == 1 and total_shards is None
+            and failed_shards == 0 and cursor_tiebreak is None
+            and not (extra_filters and extra_filters[0])):
+        from opensearch_tpu.search.executor import _msearch_batchable
+        if _msearch_batchable(body):
+            # single-shard plain score-sorted request: serve through the
+            # B=1 msearch envelope — the same executable family as
+            # dashboard batches (bit-identical scores), so the warmup
+            # registry's (plan-struct, shape-bucket) coverage extends to
+            # REST _search singles, not just _msearch
+            return executors[0].search(body)
     start = time.monotonic()
     profiling = bool(body.get("profile", False))
     profile_shards: List[dict] = []

@@ -859,11 +859,16 @@ class SearchExecutor:
             hit["_source"] = src
         return hit
 
-    def multi_search(self, bodies: List[dict]) -> dict:
+    def multi_search(self, bodies: List[dict],
+                     _bypass_request_cache: bool = False) -> dict:
         """_msearch: execute many search bodies, batching same-shaped
         score-sorted queries into single vmapped device programs per segment
         (reference: action/search/TransportMultiSearchAction fans bodies out
-        concurrently; here concurrency is a batch axis on the MXU/VPU)."""
+        concurrently; here concurrency is a batch axis on the MXU/VPU).
+
+        _bypass_request_cache: executable warmup replays must reach the
+        device even when an identical body was just served (search/warmup
+        — a cache hit would compile nothing)."""
         start = time.monotonic()
         _ph = MSEARCH_PHASES
         _t = time.monotonic()
@@ -878,20 +883,23 @@ class SearchExecutor:
             if not _msearch_batchable(body):
                 responses[i] = self.search(body, _direct=True)
                 continue
-            if cacheable(body):
-                # shard request cache at RESPONSE granularity (the general
-                # path caches at query-phase granularity; size=0 agg
-                # dashboards refresh identical bodies) — a refresh/delete
-                # rotates segment uids/live counts out of the key
+            if cacheable(body) and not _bypass_request_cache:
+                # shard request cache at QUERY-PHASE granularity: the
+                # cached value is (total, decoded partials, agg nodes) —
+                # live objects the renderers only read — and the response
+                # is rebuilt per hit, so caller mutations of a returned
+                # response can't leak back in (the old design serialized
+                # the whole response to JSON for that guarantee, which
+                # cost a full dumps per MISS on the respond hot path).
+                # A refresh/delete rotates segment uids/live counts out
+                # of the key
                 base = cache_key(self.reader.segments, body, 0, None)
                 if base is not None:
                     key = ("msearch", base)
                     hit = REQUEST_CACHE.get(key)
                     if hit is not REQUEST_CACHE._MISS:
-                        resp = json.loads(hit)
-                        resp["took"] = int(
-                            (time.monotonic() - start) * 1000)
-                        responses[i] = resp
+                        responses[i] = self._render_cached_msearch(
+                            hit, start)
                         continue
                     resp_cache_keys[i] = key
             try:
@@ -1056,10 +1064,18 @@ class SearchExecutor:
         # The batch axis is padded to a power-of-two bucket (dummy rows
         # get min_score=+inf, matching nothing) so executables are reused
         # across varying msearch batch sizes.
+        from opensearch_tpu.search.warmup import WARMUP
         pending = []
         for (struct, agg_sig, shape_sig, k_fetch), idxs in groups.items():
             b_pad = pad_bucket(len(idxs), minimum=1)
             pad_rows = b_pad - len(idxs)
+            # register this (plan-struct, shape-bucket) combination so an
+            # index-open / node-start warmup can AOT-compile its
+            # executable off the query path (a representative body replayed
+            # b_pad times reproduces exactly this group program)
+            WARMUP.record(self.reader.index_name, entry_by_i[idxs[0]][1],
+                          b_pad, (struct, agg_sig, shape_sig, k_fetch,
+                                  b_pad))
             min_scores = np.asarray(
                 [entry_by_i[i][5] for i in idxs]
                 + [np.inf] * pad_rows, dtype=np.float32)
@@ -1190,15 +1206,35 @@ class SearchExecutor:
                 responses[i]["aggregations"] = aggregations
             key = state.get("resp_cache_keys", {}).get(i)
             if key is not None:
-                # stored as JSON (the reference caches serialized shard
-                # results too) so later caller mutations can't leak in
+                # cached at query-phase granularity (totals + decoded agg
+                # partials); the response dict handed to the caller is
+                # NOT stored — _render_cached_msearch rebuilds one per hit
                 from opensearch_tpu.indices.request_cache import \
                     REQUEST_CACHE
-                try:
-                    REQUEST_CACHE.put(key, json.dumps(responses[i]))
-                except (TypeError, ValueError):
-                    pass        # unserializable value: just don't cache
+                REQUEST_CACHE.put(
+                    key, (per_query_total[i], per_query_decoded.get(i),
+                          agg_nodes_by_i.get(i)))
         _ph["respond"] += time.monotonic() - _t
+
+    def _render_cached_msearch(self, cached, start: float) -> dict:
+        """Build a fresh response from a cached (total, decoded partials,
+        agg nodes) entry — size=0 only (the cacheable() gate), so there is
+        no hits page to rebuild."""
+        total, decoded, agg_nodes = cached
+        resp: Dict[str, Any] = {
+            "took": int((time.monotonic() - start) * 1000),
+            "timed_out": False,
+            "_shards": {"total": 1, "successful": 1, "skipped": 0,
+                        "failed": 0},
+            "hits": {"total": {"value": total, "relation": "eq"},
+                     "max_score": None, "hits": []},
+        }
+        if decoded is not None and agg_nodes is not None:
+            from opensearch_tpu.search.aggs.pipeline import apply_pipelines
+            aggregations = reduce_aggs(decoded)
+            apply_pipelines(agg_nodes, aggregations)
+            resp["aggregations"] = aggregations
+        return resp
 
     def count(self, body: Optional[dict] = None) -> int:
         body = dict(body or {})

@@ -214,8 +214,13 @@ def _spmd_query_phase_raw(executors: List, body: dict, k: int,
             q = dsl.BoolQuery(must=[node],
                               filter=[dsl.parse_query(extra)])
         plan = compiler.compile(q, seg, meta)
+        # allow_fused=False: the SPMD program is traced ONCE from row 0's
+        # plans and mapped over all rows — the fused kinds close over
+        # segment-specific constant bitmasks that would wrongly apply row
+        # 0's tables everywhere, so SPMD keeps the envelope table path
         aps = tuple(compile_aggs(device_agg_nodes, ex.reader.mapper, seg,
-                                 meta, compiler)) if agg_nodes else ()
+                                 meta, compiler, allow_fused=False)) \
+            if agg_nodes else ()
         plans.append(plan)
         agg_plans_rows.append(aps)
 

@@ -8,8 +8,9 @@ quantization of doc length.
 
 from __future__ import annotations
 
+import datetime as _dt
 import math
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -18,6 +19,77 @@ from opensearch_tpu.index.segment import (
 
 K1 = 1.2
 B = 0.75
+
+
+# --------------------------------------------------- date_histogram oracle
+
+_CAL_MONTHS = {"month": 1, "M": 1, "1M": 1,
+               "quarter": 3, "q": 3, "1q": 3,
+               "year": 12, "y": 12, "1y": 12}
+
+
+def ref_date_histogram(values_ms: Sequence[int],
+                       fixed_ms: Optional[int] = None,
+                       calendar: Optional[str] = None,
+                       offset_ms: int = 0, tz_ms: int = 0,
+                       min_doc_count: int = 0,
+                       extended_bounds: Optional[Dict[str, int]] = None,
+                       ) -> Dict[int, int]:
+    """Independent date_histogram oracle: per-value key computed the
+    straightforward way (shift into offset-adjusted local time, round
+    down, shift back to UTC), gap-filled / bounds-extended the slow way.
+    Returns {utc_key_ms: doc_count} in key order."""
+    shift = tz_ms - offset_ms
+
+    def key_of(v: float) -> int:
+        if fixed_ms is not None:
+            return int(math.floor((v + shift) / fixed_ms)) * fixed_ms - shift
+        t = _dt.datetime.fromtimestamp((v + shift) / 1000.0,
+                                       tz=_dt.timezone.utc)
+        step = _CAL_MONTHS[calendar]
+        month0 = ((t.month - 1) // step) * step
+        t = t.replace(month=month0 + 1, day=1, hour=0, minute=0, second=0,
+                      microsecond=0)
+        return int(t.timestamp() * 1000) - shift
+
+    counts: Dict[int, int] = {}
+    for v in values_ms:
+        k = key_of(float(v))
+        counts[k] = counts.get(k, 0) + 1
+
+    keys = sorted(counts)
+    if min_doc_count == 0 and keys:
+        lo, hi = keys[0], keys[-1]
+        if extended_bounds:
+            if extended_bounds.get("min") is not None:
+                lo = min(lo, key_of(float(extended_bounds["min"])))
+            if extended_bounds.get("max") is not None:
+                hi = max(hi, key_of(float(extended_bounds["max"])))
+        if fixed_ms is not None:
+            k = lo
+            while k <= hi:
+                counts.setdefault(k, 0)
+                k += fixed_ms
+        else:
+            # walk calendar buckets one by one from lo
+            k = lo
+            while k < hi:
+                nxt = key_of(k + _next_bucket_step(calendar))
+                counts.setdefault(nxt, 0)
+                k = nxt
+    out = {k: counts[k] for k in sorted(counts)
+           if counts[k] >= min_doc_count}
+    return out
+
+
+def _next_bucket_step(calendar: str) -> int:
+    """A duration guaranteed to land in the NEXT calendar bucket but not
+    skip one (calendar buckets are 28-92 days for month/quarter, 365/366
+    for year)."""
+    days = {"month": 32, "M": 32, "1M": 32,
+            "quarter": 93, "q": 93, "1q": 93,
+            "year": 367, "y": 367, "1y": 367}[calendar]
+    return days * 86400_000
 
 
 class RefField:

@@ -1,0 +1,235 @@
+"""Differential tests for the fused leaf-bucketing kernel (bucket_bits /
+presence_bits): date_histogram fixed + calendar bucketing vs the pure
+Python oracle in reference_impl.ref_date_histogram, fused vs table-path
+consistency, fused range and cardinality counts.
+"""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.mapper import MapperService
+from opensearch_tpu.index.segment import SegmentBuilder
+from opensearch_tpu.search.executor import SearchExecutor, ShardReader
+
+from reference_impl import ref_date_histogram
+
+MAPPING = {"properties": {
+    "ts": {"type": "date"},
+    "tag": {"type": "keyword"},
+    "views": {"type": "integer"},
+}}
+
+BASE_TS = 1700000000000           # 2023-11-14T22:13:20Z
+DAY = 86400_000
+N_DOCS = 240
+
+
+def _docs(seed=3):
+    rng = np.random.RandomState(seed)
+    ts = BASE_TS + rng.randint(0, 200 * DAY, size=N_DOCS)
+    tags = [f"t{i}" for i in range(11)]
+    return [{"ts": int(t),
+             "tag": tags[int(rng.randint(0, len(tags)))],
+             "views": int(rng.randint(0, 500))}
+            for t in ts]
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    docs = _docs()
+    mapper = MapperService(MAPPING)
+    b = SegmentBuilder(mapper, "s0")
+    for i, d in enumerate(docs):
+        b.add(mapper.parse_document(f"d{i}", d))
+    return docs, SearchExecutor(ShardReader(mapper, [b.seal()]))
+
+
+def _engine_hist(executor, body_agg, query=None):
+    body = {"size": 0, "aggs": {"h": body_agg}}
+    if query is not None:
+        body["query"] = query
+    out = executor.search(body)["aggregations"]["h"]
+    return {b["key"]: b["doc_count"] for b in out["buckets"]}
+
+
+def _assert_fused(executor, agg_spec):
+    """The compiled plan for this leaf root agg must take the fused kind
+    (guards against the gate silently regressing to the table path)."""
+    from opensearch_tpu.search.aggs.engine import compile_aggs
+    from opensearch_tpu.search.aggs.parse import parse_aggs
+    from opensearch_tpu.search.compile import Compiler
+    reader = executor.reader
+    compiler = Compiler(reader.mapper, reader.stats())
+    plans = compile_aggs(parse_aggs({"h": agg_spec}), reader.mapper,
+                         reader.segments[0], reader.device[0][1], compiler)
+    assert plans[0].kind in ("bucket_bits", "presence_bits"), plans[0].kind
+    return plans[0]
+
+
+# ------------------------------------------------------------ fixed interval
+
+# 12h over the 200-day corpus needs 399 bins > the 256-bin fused cap, so
+# it exercises the bucket_num table fallback against the same oracle
+@pytest.mark.parametrize("interval,ms,fused", [("1d", DAY, True),
+                                               ("12h", DAY // 2, False),
+                                               ("7d", 7 * DAY, True)])
+def test_fixed_interval_matches_reference(corpus, interval, ms, fused):
+    docs, ex = corpus
+    spec = {"date_histogram": {"field": "ts", "fixed_interval": interval}}
+    if fused:
+        _assert_fused(ex, spec)
+    got = _engine_hist(ex, spec)
+    want = ref_date_histogram([d["ts"] for d in docs], fixed_ms=ms)
+    assert got == want
+
+
+def test_fixed_interval_with_query_filter(corpus):
+    docs, ex = corpus
+    cut = BASE_TS + 90 * DAY
+    spec = {"date_histogram": {"field": "ts", "fixed_interval": "1d"}}
+    got = _engine_hist(ex, spec, query={"range": {"ts": {"lt": cut}}})
+    want = ref_date_histogram([d["ts"] for d in docs if d["ts"] < cut],
+                              fixed_ms=DAY)
+    assert got == want
+
+
+def test_fixed_interval_offset(corpus):
+    docs, ex = corpus
+    spec = {"date_histogram": {"field": "ts", "fixed_interval": "1d",
+                               "offset": "3h"}}
+    _assert_fused(ex, spec)
+    got = _engine_hist(ex, spec)
+    want = ref_date_histogram([d["ts"] for d in docs], fixed_ms=DAY,
+                              offset_ms=3 * 3600_000)
+    assert got == want
+
+
+def test_fixed_interval_negative_offset_and_tz(corpus):
+    docs, ex = corpus
+    spec = {"date_histogram": {"field": "ts", "fixed_interval": "1d",
+                               "offset": "-45m", "time_zone": "+05:30"}}
+    got = _engine_hist(ex, spec)
+    want = ref_date_histogram([d["ts"] for d in docs], fixed_ms=DAY,
+                              offset_ms=-45 * 60_000,
+                              tz_ms=5 * 3600_000 + 30 * 60_000)
+    assert got == want
+
+
+def test_fixed_interval_time_zone_negative(corpus):
+    docs, ex = corpus
+    spec = {"date_histogram": {"field": "ts", "fixed_interval": "1d",
+                               "time_zone": "-08:00"}}
+    got = _engine_hist(ex, spec)
+    want = ref_date_histogram([d["ts"] for d in docs], fixed_ms=DAY,
+                              tz_ms=-8 * 3600_000)
+    assert got == want
+
+
+# -------------------------------------------------------- calendar intervals
+
+@pytest.mark.parametrize("unit", ["month", "quarter", "year"])
+def test_calendar_matches_reference(corpus, unit):
+    docs, ex = corpus
+    spec = {"date_histogram": {"field": "ts", "calendar_interval": unit}}
+    _assert_fused(ex, spec)
+    got = _engine_hist(ex, spec)
+    want = ref_date_histogram([d["ts"] for d in docs], calendar=unit)
+    assert got == want
+
+
+def test_calendar_month_with_time_zone(corpus):
+    docs, ex = corpus
+    spec = {"date_histogram": {"field": "ts", "calendar_interval": "month",
+                               "time_zone": "+02:00"}}
+    got = _engine_hist(ex, spec)
+    want = ref_date_histogram([d["ts"] for d in docs], calendar="month",
+                              tz_ms=2 * 3600_000)
+    assert got == want
+
+
+# ------------------------------------------- bounds / min_doc_count edges
+
+def test_extended_bounds_beyond_data(corpus):
+    docs, ex = corpus
+    lo = BASE_TS - 10 * DAY
+    hi = BASE_TS + 220 * DAY
+    spec = {"date_histogram": {"field": "ts", "fixed_interval": "7d",
+                               "extended_bounds": {"min": lo, "max": hi},
+                               "min_doc_count": 0}}
+    got = _engine_hist(ex, spec)
+    want = ref_date_histogram([d["ts"] for d in docs], fixed_ms=7 * DAY,
+                              extended_bounds={"min": lo, "max": hi})
+    assert got == want
+    # bounds really extended past the data on both sides
+    assert min(got) <= lo < BASE_TS
+    assert max(got) >= BASE_TS + 200 * DAY
+
+
+def test_extended_bounds_no_matching_docs(corpus):
+    _, ex = corpus
+    lo = BASE_TS + 300 * DAY
+    hi = BASE_TS + 305 * DAY
+    spec = {"date_histogram": {"field": "ts", "fixed_interval": "1d",
+                               "extended_bounds": {"min": lo, "max": hi},
+                               "min_doc_count": 0}}
+    got = _engine_hist(ex, spec,
+                       query={"range": {"ts": {"gte": lo}}})
+    # no docs match; the lattice from extended_bounds still renders
+    assert len(got) >= 6
+    assert set(got.values()) == {0}
+
+
+def test_min_doc_count_filters_empty_buckets(corpus):
+    docs, ex = corpus
+    spec = {"date_histogram": {"field": "ts", "fixed_interval": "12h",
+                               "min_doc_count": 1}}
+    got = _engine_hist(ex, spec)
+    want = ref_date_histogram([d["ts"] for d in docs], fixed_ms=DAY // 2,
+                              min_doc_count=1)
+    assert got == want
+    assert 0 not in got.values()
+
+
+# ----------------------------------------- fused vs table-path consistency
+
+def test_fused_counts_equal_table_path(corpus):
+    """Adding a sub-agg forces the bucket_num table path; its per-bucket
+    doc_counts must equal the fused leaf kernel's."""
+    docs, ex = corpus
+    leaf = _engine_hist(ex, {"date_histogram": {"field": "ts",
+                                                "fixed_interval": "1d"}})
+    with_sub = ex.search({"size": 0, "aggs": {"h": {
+        "date_histogram": {"field": "ts", "fixed_interval": "1d"},
+        "aggs": {"v": {"avg": {"field": "views"}}},
+    }}})["aggregations"]["h"]
+    table = {b["key"]: b["doc_count"] for b in with_sub["buckets"]}
+    assert leaf == table
+
+
+# --------------------------------------------------- fused range/cardinality
+
+def test_fused_range_counts(corpus):
+    docs, ex = corpus
+    spec = {"range": {"field": "views",
+                      "ranges": [{"to": 100}, {"from": 100, "to": 400},
+                                 {"from": 250}]}}   # overlapping on purpose
+    _assert_fused(ex, spec)
+    out = ex.search({"size": 0, "aggs": {"h": spec}})["aggregations"]["h"]
+    views = [d["views"] for d in docs]
+    want = [sum(v < 100 for v in views),
+            sum(100 <= v < 400 for v in views),
+            sum(v >= 250 for v in views)]
+    assert [b["doc_count"] for b in out["buckets"]] == want
+
+
+def test_fused_cardinality(corpus):
+    docs, ex = corpus
+    spec = {"cardinality": {"field": "tag"}}
+    _assert_fused(ex, spec)
+    out = ex.search({"size": 0, "aggs": {"h": spec}})["aggregations"]["h"]
+    assert out["value"] == len({d["tag"] for d in docs})
+    cut = 250
+    out = ex.search({"size": 0, "query": {"range": {"views": {"lt": cut}}},
+                     "aggs": {"h": spec}})["aggregations"]["h"]
+    assert out["value"] == len({d["tag"] for d in docs
+                                if d["views"] < cut})

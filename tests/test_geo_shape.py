@@ -134,6 +134,37 @@ class TestGeoPredicates:
         assert geolib.intersects(ln, sq)
         assert not geolib.within(ln, sq)
 
+    def test_within_hole_strictly_inside_doc_shape(self):
+        """Regression (ADVICE round 5): a hole of the QUERY polygon lying
+        strictly inside the doc shape means part of the doc is uncovered —
+        within must be False. Vertex sampling alone misses it: every doc
+        vertex is inside the outer ring, and no edges cross."""
+        doc = geolib.parse_geojson({
+            "type": "polygon",
+            "coordinates": [[[0, 0], [10, 0], [10, 10], [0, 10], [0, 0]]]})
+        holed_query = geolib.parse_geojson({
+            "type": "polygon",
+            "coordinates": [[[-5, -5], [15, -5], [15, 15], [-5, 15],
+                             [-5, -5]],
+                            [[4, 4], [6, 4], [6, 6], [4, 6], [4, 4]]]})
+        assert not geolib.within(doc, holed_query)
+        assert geolib.relate(doc, holed_query, "within") is False
+        # hole OUTSIDE the doc shape must not flip the verdict
+        clear_query = geolib.parse_geojson({
+            "type": "polygon",
+            "coordinates": [[[-5, -5], [15, -5], [15, 15], [-5, 15],
+                             [-5, -5]],
+                            [[12, 12], [14, 12], [14, 14], [12, 14],
+                             [12, 12]]]})
+        assert geolib.within(doc, clear_query)
+        # hole in the doc that exactly shadows the query's hole: the doc's
+        # area excludes it, so the query still covers the doc
+        doc_with_hole = geolib.parse_geojson({
+            "type": "polygon",
+            "coordinates": [[[0, 0], [10, 0], [10, 10], [0, 10], [0, 0]],
+                            [[3, 3], [7, 3], [7, 7], [3, 7], [3, 3]]]})
+        assert geolib.within(doc_with_hole, holed_query)
+
     def test_nested_containment_no_edge_cross(self):
         outer = geolib.parse_geojson({
             "type": "polygon",

@@ -69,3 +69,49 @@ def test_delete_invalidates(node):
     node.request("POST", "/rc/_refresh")
     out = node.request("POST", "/rc/_search", AGG_BODY)
     assert out["hits"]["total"]["value"] == 39
+
+
+def test_now_relative_date_math_never_cached():
+    """ADVICE round 5: a size=0 body whose query/agg filters contain
+    now-relative date math must not cache — "now" resolves per request,
+    so a cached entry would pin the first request's resolution instant."""
+    from opensearch_tpu.indices.request_cache import (_has_now_date_math,
+                                                      cacheable)
+    now_query = {"size": 0, "query": {"range": {"ts": {"gte": "now-1d"}}}}
+    assert not cacheable(now_query)
+    now_agg = {"size": 0, "query": {"match_all": {}},
+               "aggs": {"r": {"date_range": {
+                   "field": "ts",
+                   "ranges": [{"from": "now-5d", "to": "now"}]}}}}
+    assert not cacheable(now_agg)
+    now_filter_agg = {"size": 0, "aggs": {"recent": {
+        "filter": {"range": {"ts": {"gte": "now/d"}}},
+        "aggs": {"c": {"value_count": {"field": "ts"}}}}}}
+    assert not cacheable(now_filter_agg)
+    # rounded / offset date math forms
+    assert _has_now_date_math("now+2h/d")
+    assert _has_now_date_math({"gte": "now-30m"})
+    # plain values that merely CONTAIN "now" stay cacheable
+    still_ok = {"size": 0, "query": {"term": {"tag": "nowhere"}}}
+    assert cacheable(still_ok)
+    assert not _has_now_date_math("snow")
+    assert not _has_now_date_math(1700000000000)
+
+
+def test_now_date_math_executes_fresh_each_time(node):
+    """End-to-end: repeated now-relative msearch bodies recompute (no
+    cache hit) while the equivalent absolute-bound body caches."""
+    body = {"size": 0, "query": {"bool": {"filter": [
+        {"range": {"n": {"gte": 0}}}]}},
+        "aggs": {"c": {"value_count": {"field": "n"}}}}
+    now_body = {"size": 0, "query": {"bool": {"filter": [
+        {"range": {"n": {"gte": 0}}},
+        {"range": {"ts_missing": {"lte": "now"}}}]}},
+        "aggs": {"c": {"value_count": {"field": "n"}}}}
+    node.request("POST", "/rc/_search", now_body)
+    h0 = REQUEST_CACHE.stats()["hit_count"]
+    node.request("POST", "/rc/_search", now_body)
+    assert REQUEST_CACHE.stats()["hit_count"] == h0   # never cached
+    node.request("POST", "/rc/_search", body)
+    node.request("POST", "/rc/_search", body)
+    assert REQUEST_CACHE.stats()["hit_count"] == h0 + 1

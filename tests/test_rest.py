@@ -512,3 +512,30 @@ class TestHttpSocket:
                     "index_not_found_exception"
         finally:
             server.close()
+
+    def test_unsupported_content_type_rejected(self, node):
+        """A declared non-JSON/NDJSON Content-Type whose body can't decode
+        must 406 up front — not forward raw binary into the NDJSON bulk
+        parser (ADVICE round 5)."""
+        import urllib.request
+        from opensearch_tpu.rest.http import HttpServer
+        server = HttpServer(node, port=0).start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            for ctype in ("application/octet-stream", "application/smile",
+                          "text/garbage"):
+                req = urllib.request.Request(
+                    base + "/docs/_bulk", method="POST",
+                    data=b"\x00\x01\x02 not ndjson \xff",
+                    headers={"Content-Type": ctype})
+                try:
+                    urllib.request.urlopen(req)
+                    assert False, f"expected 406 for {ctype}"
+                except urllib.error.HTTPError as e:
+                    assert e.code == 406, (ctype, e.code)
+                    err = json.loads(e.read())
+                    assert err["error"]["type"] == \
+                        "not_acceptable_exception"
+                    assert ctype in err["error"]["reason"]
+        finally:
+            server.close()
