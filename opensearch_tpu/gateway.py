@@ -29,8 +29,19 @@ class Gateway:
 
     # --------------------------------------------------------------- persist
 
-    def persist(self, indices_svc, cluster_settings: Optional[dict] = None):
+    def persist(self, indices_svc, cluster_settings: Optional[dict] = None,
+                search_pipelines: Optional[dict] = None):
+        if search_pipelines is None:
+            # callers without pipeline context (import_dangling) must not
+            # clobber the persisted search-pipeline set
+            try:
+                with open(self._meta_path()) as f:
+                    search_pipelines = json.load(f).get(
+                        "search_pipelines") or {}
+            except (OSError, ValueError):
+                search_pipelines = {}
         meta = {
+            "search_pipelines": search_pipelines,
             "indices": {
                 name: {
                     "settings": {"number_of_shards": svc.num_shards,

@@ -174,8 +174,14 @@ class InternalEngine:
     def index(self, doc_id: str, source: dict, op_type: str = "index",
               if_seq_no: Optional[int] = None,
               if_primary_term: Optional[int] = None,
-              version: Optional[int] = None) -> EngineResult:
-        """Primary-path indexing (InternalEngine.index :845)."""
+              version: Optional[int] = None,
+              external_version: Optional[int] = None) -> EngineResult:
+        """Primary-path indexing (InternalEngine.index :845).
+        `version`/`external_version` are the same thing under both names
+        the write path uses (REST ?version=N&version_type=external): the
+        caller-assigned version that must exceed the current one."""
+        if external_version is not None:
+            version = external_version
         with self._lock:
             new_version, created = self._plan_versioning(
                 doc_id, op_type, if_seq_no, if_primary_term, version)
@@ -220,7 +226,10 @@ class InternalEngine:
 
     def delete(self, doc_id: str, if_seq_no: Optional[int] = None,
                if_primary_term: Optional[int] = None,
-               version: Optional[int] = None) -> EngineResult:
+               version: Optional[int] = None,
+               external_version: Optional[int] = None) -> EngineResult:
+        if external_version is not None:
+            version = external_version
         with self._lock:
             cur = self._current_version(doc_id)
             found = cur is not None and not cur.deleted

@@ -155,12 +155,19 @@ class IndexService:
     def update_doc(self, doc_id: str, body: dict,
                    routing: Optional[str] = None,
                    if_seq_no: Optional[int] = None,
-                   if_primary_term: Optional[int] = None) -> dict:
+                   if_primary_term: Optional[int] = None,
+                   external_version: Optional[int] = None) -> dict:
         """Partial update: realtime GET → merge → reindex with seq-no CAS
         (UpdateHelper semantics: detect_noop default true, upsert,
         doc_as_upsert, retry left to the caller). A caller-supplied
         if_seq_no/if_primary_term CAS is checked against the current doc."""
         self.check_open()
+        if external_version is not None:
+            # reference: UpdateRequest.validate rejects external versioning
+            raise IllegalArgumentError(
+                "internal versioning can not be used for optimistic "
+                "concurrency control. Please use `if_seq_no` and "
+                "`if_primary_term` instead")
         _KNOWN = {"doc", "doc_as_upsert", "script", "upsert",
                   "scripted_upsert", "detect_noop", "_source", "lang",
                   "if_seq_no", "if_primary_term", "fields"}

@@ -41,8 +41,10 @@ class Node:
         self.start_time_ms = int(time.time() * 1000)
         from opensearch_tpu.ingest.service import IngestService
         from opensearch_tpu.script.service import ScriptService
+        from opensearch_tpu.searchpipeline import SearchPipelineService
         self.script_service = ScriptService()
         self.ingest = IngestService()
+        self.search_pipelines = SearchPipelineService()
         self.indices = IndicesService(data_path=data_path,
                                       script_service=self.script_service)
         self.cluster_settings: Dict[str, Any] = {"persistent": {},
@@ -72,6 +74,8 @@ class Node:
             loaded = self.gateway.load(self.indices)
             if loaded and loaded.get("cluster_settings"):
                 self.cluster_settings.update(loaded["cluster_settings"])
+            if loaded and loaded.get("search_pipelines"):
+                self.search_pipelines.load(loaded["search_pipelines"])
         # executable warmup (search/warmup.py): load the persisted
         # (plan-struct, shape-bucket) registry from the data dir, point
         # jax's persistent compilation cache under it, and AOT-compile the
@@ -96,7 +100,9 @@ class Node:
         """Write node metadata through the gateway (no-op without a data
         path — pure in-memory node)."""
         if self.gateway is not None:
-            self.gateway.persist(self.indices, self.cluster_settings)
+            self.gateway.persist(self.indices, self.cluster_settings,
+                                 search_pipelines=self.search_pipelines
+                                 .to_dict())
             from opensearch_tpu.search.warmup import WARMUP
             WARMUP.flush()
 

@@ -84,7 +84,10 @@ def rank_eval(node, index_expr: Optional[str], body: dict) -> dict:
             raise IllegalArgumentError("evaluation request is missing [id]")
         search_body = dict(request.get("request") or {})
         search_body.setdefault("size", max(k, 10))
-        res = _run_search(node, index_expr, search_body)
+        # rank_eval grades the RAW query (reference: TransportRankEval
+        # builds its own SearchRequests — no search pipelines)
+        res = _run_search(node, index_expr, search_body,
+                          search_pipeline="_none")
         hits = res["hits"]["hits"]
         rated = _rated_map(request.get("ratings"))
         if metric_name == "precision":

@@ -107,26 +107,55 @@ def _expand_data_streams(node, index_expr: Optional[str]) -> Optional[str]:
     return ",".join(parts)
 
 
-def _run_search(node, index_expr: Optional[str], body: Optional[dict]) -> dict:
+def _search_services(node, index_expr: Optional[str]):
+    names = node.indices.resolve(_expand_data_streams(node, index_expr),
+                                 ignore_unavailable=True,
+                                 allow_no_indices=True)
+    return [node.indices.get(n) for n in names]
+
+
+def _run_search(node, index_expr: Optional[str], body: Optional[dict],
+                search_pipeline=None) -> dict:
+    """Search with the full pipeline wrap: resolve the search pipeline
+    (request param > inline body definition > the single target index's
+    `index.search.default_pipeline` setting), apply request processors,
+    execute (the pipeline's normalization-processor spec rides along for
+    hybrid queries), then apply response processors.
+    `search_pipeline="_none"` disables resolution entirely (internal
+    callers like _count that the reference serves without pipelines)."""
     from opensearch_tpu.search import dsl
     from opensearch_tpu.search.controller import execute_search
     executors, filters = _search_targets(node, index_expr)
-    parsed = dsl.parse_query((body or {}).get("query"))
+    body = dict(body or {})
+    inline = body.pop("search_pipeline", None)
+    services = _search_services(node, index_expr)
+    pipeline = node.search_pipelines.resolve(
+        search_pipeline if search_pipeline is not None else inline,
+        services)
+    ctx: Dict[str, Any] = {}
+    phase_spec = None
+    if pipeline is not None:
+        body = pipeline.process_request(body, ctx)
+        phase_spec = pipeline.phase_spec()
+    parsed = dsl.parse_query(body.get("query"))
     if isinstance(parsed, dsl.PercolateQuery):
         from opensearch_tpu.search.percolator import execute_percolate
-        k = int((body or {}).get("size", 10)) + int((body or {}).get("from", 0))
-        return execute_percolate(executors, parsed, max(k, 10), body or {})
+        k = int(body.get("size", 10)) + int(body.get("from", 0))
+        return execute_percolate(executors, parsed, max(k, 10), body)
     node.search_backpressure.acquire()
     task = node.task_manager.register(
         "indices:data/read/search",
         description=f"indices[{index_expr or '_all'}]", cancellable=True)
     try:
         res = execute_search(executors, body, extra_filters=filters,
-                             task=task, allow_envelope=True)
+                             task=task, allow_envelope=True,
+                             phase_processors=phase_spec)
     finally:
         node.task_manager.unregister(task)
         node.search_backpressure.release()
     res.pop("_page_cursor", None)
+    if pipeline is not None:
+        res = pipeline.process_response(res, ctx, targets=services)
     _maybe_slow_log(node, index_expr, body, res)
     return res
 
@@ -442,7 +471,8 @@ def register_search_actions(node, c):
         elif isinstance(body.get("pit"), dict):
             out = search_with_pit(node, body)
         else:
-            out = _run_search(node, req.param("index"), body)
+            out = _run_search(node, req.param("index"), body,
+                              search_pipeline=req.param("search_pipeline"))
         return _total_as_int(out) if as_int else out
 
     def do_field_caps(req):
@@ -603,7 +633,7 @@ def register_search_actions(node, c):
         out = _run_search(node, expr, {
             "query": {"bool": {"must": [query],
                                "filter": [{"ids": {"values": [doc_id]}}]}},
-            "size": 1, "explain": True})
+            "size": 1, "explain": True}, search_pipeline="_none")
         hits = out["hits"]["hits"]
         if hits:
             return {"_index": index, "_id": doc_id, "matched": True,
@@ -657,7 +687,8 @@ def register_search_actions(node, c):
         body.pop("from", None)
         body.pop("aggs", None)
         body.pop("aggregations", None)
-        res = _run_search(node, req.param("index"), body)
+        res = _run_search(node, req.param("index"), body,
+                          search_pipeline="_none")
         return {"count": res["hits"]["total"]["value"],
                 "_shards": res["_shards"]}
 
@@ -679,14 +710,19 @@ def register_search_actions(node, c):
         # IndexService.multi_search vmaps same-shaped queries into one
         # batched device program (capability from the SPMD _msearch work)
         exprs = {e for e, _ in pairs}
-        if len(exprs) == 1:
+        if len(exprs) == 1 and not any(
+                isinstance(b, dict) and b.get("search_pipeline")
+                for _, b in pairs):
             expr = next(iter(exprs))
             try:
                 names = node.indices.resolve(expr)
             except OpenSearchTpuError:
                 names = []
+            default_pipe = (node.indices.get(names[0]).settings.get(
+                "search.default_pipeline") if len(names) == 1 else None)
             if len(names) == 1 and \
-                    node.indices.alias_filter(expr, names[0]) is None:
+                    node.indices.alias_filter(expr, names[0]) is None and \
+                    default_pipe in (None, "_none"):
                 res = node.indices.get(names[0]).multi_search(
                     [b for _, b in pairs])
                 for r in res["responses"]:
@@ -739,6 +775,43 @@ def register_search_actions(node, c):
     c.register("POST", "/{index}/_search/point_in_time", do_create_pit)
     c.register("DELETE", "/_search/point_in_time", do_delete_pit)
     c.register("DELETE", "/_search/point_in_time/_all", do_delete_all_pits)
+
+
+# --------------------------------------------------------- search pipelines
+
+def register_search_pipeline_actions(node, c):
+    """PUT/GET/DELETE /_search/pipeline/{id} — search-pipeline CRUD
+    persisted in cluster state (reference: rest/action/search/
+    RestPutSearchPipelineAction + SearchPipelineService cluster-state
+    updates)."""
+
+    def do_put_pipeline(req):
+        node.search_pipelines.put(req.param("id"), req.body or {})
+        node.persist_metadata()
+        return {"acknowledged": True}
+
+    def do_get_pipeline(req):
+        pid = req.param("id")
+        if pid is None or pid in ("*", "_all"):
+            return {pid_: p.body
+                    for pid_, p in node.search_pipelines.pipelines.items()}
+        import fnmatch as _fn
+        matched = {pid_: p.body
+                   for pid_, p in node.search_pipelines.pipelines.items()
+                   if _fn.fnmatchcase(pid_, pid)}
+        if not matched:
+            return 404, {}
+        return matched
+
+    def do_delete_pipeline(req):
+        node.search_pipelines.delete(req.param("id"))     # 404 if missing
+        node.persist_metadata()
+        return {"acknowledged": True}
+
+    c.register("PUT", "/_search/pipeline/{id}", do_put_pipeline)
+    c.register("GET", "/_search/pipeline", do_get_pipeline)
+    c.register("GET", "/_search/pipeline/{id}", do_get_pipeline)
+    c.register("DELETE", "/_search/pipeline/{id}", do_delete_pipeline)
 
 
 # ------------------------------------------------------------ index admin
@@ -1893,6 +1966,7 @@ def register_all(node):
     register_cluster_actions(node, c)
     register_document_actions(node, c)
     register_search_actions(node, c)
+    register_search_pipeline_actions(node, c)
     register_indices_actions(node, c)
     register_alias_template_actions(node, c)
     register_cat_actions(node, c)

@@ -54,6 +54,49 @@ class RestResponse:
         return json.dumps(self.body, default=str)
 
 
+def _json_key(key: Any) -> str:
+    """Coerce a non-string mapping key exactly like json.dumps would on
+    the wire (True → "true", 1 → "1", None → "null")."""
+    if key is True:
+        return "true"
+    if key is False:
+        return "false"
+    if key is None:
+        return "null"
+    if isinstance(key, float):
+        return repr(key)
+    return str(key)
+
+
+def normalize_body_keys(obj: Any) -> Any:
+    """Coerce every mapping key in a request body to a string.
+
+    Over HTTP every body arrives as JSON text, so keys are always
+    strings; in-process callers (tests, the YAML suite runner) hand
+    Python dicts straight in, where YAML parses unquoted numeric mapping
+    keys as ints — e.g. adjacency_matrix filters named `1:`/`2:`. Mixed
+    key types then crash any sorted()/json.dumps(sort_keys=True) on the
+    query path with `TypeError: '<' not supported between instances of
+    'str' and 'int'` (a 500). Normalizing at dispatch reproduces the
+    wire contract for every handler at once. Untouched sub-trees are
+    returned as-is (no copying on the common all-string path)."""
+    if isinstance(obj, dict):
+        out = {}
+        changed = False
+        for k, v in obj.items():
+            nv = normalize_body_keys(v)
+            nk = k if isinstance(k, str) else _json_key(k)
+            changed = changed or nk is not k or nv is not v
+            out[nk] = nv
+        return out if changed else obj
+    if isinstance(obj, list):
+        new = [normalize_body_keys(v) for v in obj]
+        if any(a is not b for a, b in zip(new, obj)):
+            return new
+        return obj
+    return obj
+
+
 class _TrieNode:
     __slots__ = ("children", "param_child", "param_name", "handlers")
 
@@ -133,6 +176,7 @@ class RestController:
 
     def _dispatch_inner(self, request: RestRequest) -> RestResponse:
         try:
+            request.body = normalize_body_keys(request.body)
             node, params = self._resolve(request.path)
             if node is None:
                 return _error_response(

@@ -546,6 +546,17 @@ class Compiler:
                     inputs={"query": q, "boost": _f32(node.boost)},
                     children=children)
 
+    def _c_HybridQuery(self, node: dsl.HybridQuery, seg, meta) -> Plan:
+        """Hybrid is a TOP-LEVEL clause executed by the fused hybrid query
+        phase (search/executor.py build_hybrid_query_phase), which compiles
+        each sub-query separately so per-sub-query scores stay unmerged for
+        the normalization-processor. Reaching the generic compiler means it
+        was nested inside another clause — the reference rejects that too
+        (HybridQueryBuilder: "hybrid query must be a top-level query")."""
+        raise QueryShardError(
+            "[hybrid] query must be a top-level query and cannot be wrapped "
+            "into other queries")
+
     # --------------------------------------------------------- misc leaves
     def _c_MatchAllQuery(self, node, seg, meta) -> Plan:
         return _match_all(node.boost)
@@ -1423,6 +1434,23 @@ class Compiler:
             raise QueryShardError(
                 f"Can't load fielddata on [{node.field}] because the field "
                 f"does not exist")
+        if ft.type == "geo_point":
+            # geo origin: any geo-point wire shape ("lat,lon" / [lon, lat] /
+            # {lat, lon} / geohash); pivot is a distance ("100km").
+            # Score = boost * pivot / (pivot + haversine(doc, origin)) —
+            # reference: index/query/DistanceFeatureQueryBuilder geo branch
+            if f"{node.field}.lat" not in seg.numeric_dv:
+                return MATCH_NONE
+            from opensearch_tpu.index.mapper import _parse_geo_point
+            lat, lon = _parse_geo_point(node.origin)
+            pivot_m = dsl.parse_distance(node.pivot)
+            if pivot_m <= 0:
+                raise IllegalArgumentError(
+                    "[distance_feature] pivot distance must be positive")
+            return Plan("distance_feature_geo", static=(node.field,),
+                        inputs={"lat": _f32(lat), "lon": _f32(lon),
+                                "pivot": _f32(pivot_m),
+                                "boost": _f32(node.boost)})
         if node.field not in seg.numeric_dv:
             return MATCH_NONE
         if ft.is_date:

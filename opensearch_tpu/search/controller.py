@@ -175,7 +175,8 @@ def execute_search(executors: List, body: Optional[dict],
                    failed_shards: int = 0,
                    extra_filters: Optional[List[Optional[dict]]] = None,
                    cursor_tiebreak: Optional[Tuple[int, int, int]] = None,
-                   task=None, allow_envelope: bool = False) -> dict:
+                   task=None, allow_envelope: bool = False,
+                   phase_processors: Optional[dict] = None) -> dict:
     """Run the full query-then-fetch flow over shard executors and render
     the search response. `executors` are per-shard SearchExecutors;
     `extra_filters` (aligned with executors) carry per-index alias filters;
@@ -186,9 +187,27 @@ def execute_search(executors: List, body: Optional[dict],
     IndexService.search) lets a single-shard plain request delegate to the
     msearch envelope; scroll/reindex/CCS callers need this path's page
     cursor and shard accounting, and the envelope's own fallback re-enters
-    here and must not loop."""
+    here and must not loop. `phase_processors` is the resolved search
+    pipeline's normalization-processor spec for hybrid queries (None =
+    defaults)."""
     body = body or {}
     _validate_search_body_keys(body)
+    query_spec = body.get("query")
+    if isinstance(query_spec, dict) and "hybrid" in query_spec:
+        # hybrid dense+sparse clause: its sub-queries keep SEPARATE score
+        # channels through a fused per-shard program and merge via the
+        # search pipeline's normalization-processor at reduce
+        # (searchpipeline/hybrid.py) — the single-score paths below
+        # cannot represent it
+        if cursor_tiebreak is not None:
+            raise IllegalArgumentError(
+                "[scroll] is not supported with a [hybrid] query")
+        from opensearch_tpu.searchpipeline.hybrid import \
+            execute_hybrid_search
+        return execute_hybrid_search(
+            executors, body, phase_spec=phase_processors,
+            extra_filters=extra_filters, total_shards=total_shards,
+            failed_shards=failed_shards, task=task)
     if (allow_envelope and len(executors) == 1 and total_shards is None
             and failed_shards == 0 and cursor_tiebreak is None
             and not (extra_filters and extra_filters[0])):
@@ -480,8 +499,9 @@ def _build_hit(ex, c, body, score, query_node, sort_specs,
             hit.setdefault("fields", {})[name] = \
                 value if isinstance(value, list) else [value]
     if body.get("version"):
-        hit["_version"] = getattr(seg, "versions", {}).get(c.ord, 1) \
-            if hasattr(seg, "versions") else 1
+        # doc_meta carries the persisted (version, seq_no, primary_term)
+        meta = getattr(seg, "doc_meta", {}).get(hit["_id"])
+        hit["_version"] = meta[0] if meta else 1
     nested_specs = inner_specs if inner_specs is not None \
         else fetch_phase.collect_inner_hit_specs(query_node)
     if nested_specs:

@@ -299,6 +299,20 @@ class KnnQuery(QueryNode):
 
 
 @dataclass
+class HybridQuery(QueryNode):
+    """Hybrid dense+sparse retrieval clause (the neural-search plugin's
+    HybridQueryBuilder): N independently-scored sub-queries whose per-doc
+    scores are kept SEPARATE through the query phase and merged by the
+    search pipeline's normalization-processor at reduce. Top-level only —
+    compiling it inside another clause raises."""
+    queries: List["QueryNode"] = dc_field(default_factory=list)
+
+
+# reference: HybridQueryBuilder.MAX_NUMBER_OF_SUB_QUERIES
+MAX_HYBRID_SUB_QUERIES = 5
+
+
+@dataclass
 class ScriptScoreQuery(QueryNode):
     query: Optional[QueryNode] = None
     script_source: str = ""
@@ -658,6 +672,22 @@ def parse_query(q: Any) -> QueryNode:
                         filter=parse_query(spec["filter"]) if "filter" in spec else None,
                         nprobe=int(mp.get("nprobes", mp.get("nprobe", 0))),
                         boost=float(spec.get("boost", 1.0)))
+
+    if name == "hybrid":
+        subs = body.get("queries")
+        if not isinstance(subs, list) or not subs:
+            raise ParsingError("[hybrid] query requires a non-empty "
+                               "[queries] array")
+        if len(subs) > MAX_HYBRID_SUB_QUERIES:
+            raise ParsingError(
+                f"Number of sub-queries exceeds maximum supported by "
+                f"[hybrid] query [{MAX_HYBRID_SUB_QUERIES}]")
+        unknown = set(body) - {"queries", "boost"}
+        if unknown:
+            raise ParsingError(
+                f"[hybrid] query does not support [{sorted(unknown)[0]}]")
+        return HybridQuery(queries=[parse_query(s) for s in subs],
+                           boost=float(body.get("boost", 1.0)))
 
     if name == "function_score":
         functions = body.get("functions")

@@ -610,6 +610,150 @@ def _runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int, sort_mode: st
     return fn
 
 
+def build_hybrid_query_phase(plans, meta: DeviceSegmentMeta, k: int):
+    """The FUSED hybrid query phase for one segment: every sub-query of a
+    `hybrid` clause evaluates inside ONE jitted program (one plan-signature
+    executable, one dispatch, one fetch) instead of N sequential searches.
+
+    Per sub-query the program emits its own top-k channel PLUS the score
+    bounds the normalization-processor needs at reduce time:
+      [k scores | k doc ords | count | min | max | sum-of-squares]
+    and one trailing union total (a doc matching any sub-query counts once).
+    Bounds are computed ON DEVICE over the sub-query's selected top-k
+    window — the exact candidate set that reaches the coordinator — so the
+    merge can reconstruct GLOBAL min/max (min-of-mins / max-of-maxs) and
+    the global L2 norm (sum of per-shard sums) without a second pass over
+    candidate lists, mirroring the reference's per-shard TopDocs bounds
+    (neural-search NormalizationProcessorWorkflow over CompoundTopDocs)."""
+
+    n_sub = len(plans)
+
+    def run(seg, flat_inputs, min_score):
+        cursor = [0]
+        d_pad = seg["live"].shape[0]
+        in_seg = jnp.arange(d_pad, dtype=jnp.int32) < meta.num_docs
+        base = seg["live"] & seg["root"] & in_seg
+        union = jnp.zeros(d_pad, jnp.bool_)
+        pieces = []
+        k_eff = min(k, d_pad)
+        for i in range(n_sub):
+            scores, matches = _eval_plan(plans[i], seg, flat_inputs, cursor)
+            eligible = matches & base & (scores >= min_score)
+            union = union | eligible
+            masked = jnp.where(eligible, scores, NEG_INF)
+            top_scores, top_idx = jax.lax.top_k(masked, k_eff)
+            valid = top_scores > NEG_INF
+            cnt = jnp.sum(valid.astype(jnp.int32))
+            mn = jnp.min(jnp.where(valid, top_scores, jnp.inf))
+            mx = jnp.max(jnp.where(valid, top_scores, -jnp.inf))
+            vs = jnp.where(valid, top_scores, 0.0)
+            ssq = jnp.sum(vs * vs)
+            pieces.append(jnp.concatenate([
+                top_scores,
+                jax.lax.bitcast_convert_type(top_idx.astype(jnp.int32),
+                                             jnp.float32),
+                jax.lax.bitcast_convert_type(cnt[None], jnp.float32),
+                mn[None], mx[None], ssq[None]]))
+        total = jnp.sum(union.astype(jnp.int32))
+        pieces.append(jax.lax.bitcast_convert_type(total[None],
+                                                   jnp.float32))
+        return jnp.concatenate(pieces)
+
+    return run
+
+
+def build_batched_hybrid_query_phase(plans, meta: DeviceSegmentMeta,
+                                     k: int, layout, treedef):
+    """B same-shaped hybrid queries against one segment as ONE device
+    program: the fused multi-sub-query phase vmapped over the msearch
+    envelope's packed batch axis — a whole dashboard of hybrid queries
+    costs one upload, one program, one fetch."""
+    one = build_hybrid_query_phase(plans, meta, k)
+
+    def run(seg, packed_buf):
+        leaves = unpack_leaves(packed_buf, layout)
+        batched_flat = jax.tree_util.tree_unflatten(treedef, leaves[:-1])
+        return jax.vmap(one, in_axes=(None, 0, 0))(seg, batched_flat,
+                                                   leaves[-1])
+
+    return run
+
+
+def _batched_hybrid_runner(plans, meta: DeviceSegmentMeta, k: int,
+                           layout, treedef):
+    key = ("hybenv", tuple(p.sig() for p in plans), meta, k, layout,
+           treedef)
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(build_batched_hybrid_query_phase(plans, meta, k,
+                                                      layout, treedef))
+        _JIT_CACHE[key] = fn
+    return fn
+
+
+def _decode_hybrid_row(row: np.ndarray, k_seg: int, n_sub: int):
+    """Invert one segment's fused hybrid row: per-sub (scores, ords,
+    count, min, max, sum_sq) channels + the trailing union total."""
+    out = []
+    off = 0
+    for _ in range(n_sub):
+        scores = row[off:off + k_seg]
+        ords = row[off + k_seg:off + 2 * k_seg].view(np.int32)
+        off += 2 * k_seg
+        cnt = int(row[off:off + 1].view(np.int32)[0])
+        mn, mx, ssq = (float(row[off + 1]), float(row[off + 2]),
+                       float(row[off + 3]))
+        off += 4
+        out.append((scores, ords, cnt, mn, mx, ssq))
+    total = int(row[off:off + 1].view(np.int32)[0])
+    return out, total
+
+
+# body keys the batched hybrid envelope fully renders (weights/techniques
+# come from the pipeline spec, not the body)
+_HYBRID_BATCHABLE_KEYS = frozenset({"query", "size", "from", "min_score",
+                                    "_source", "track_total_hits"})
+
+
+def _hybrid_msearch_batchable(body: dict) -> bool:
+    return (_contains_hybrid(body.get("query"))
+            and set(body) <= _HYBRID_BATCHABLE_KEYS)
+
+
+class HybridShardResult:
+    """One shard's fused hybrid query phase output: per-sub-query candidate
+    lists + per-sub-query (min, max, sum_sq, count) bounds + union total."""
+    __slots__ = ("per_sub", "bounds", "total")
+
+    def __init__(self, per_sub, bounds, total):
+        self.per_sub = per_sub      # [sub][(score, seg_i, ord), ...]
+        self.bounds = bounds        # [sub](min, max, sum_sq, count)
+        self.total = total
+
+
+def _empty_hybrid_result(n_sub: int) -> HybridShardResult:
+    return HybridShardResult(
+        [[] for _ in range(n_sub)],
+        [[float("inf"), float("-inf"), 0.0, 0] for _ in range(n_sub)], 0)
+
+
+def _accumulate_hybrid_row(result: HybridShardResult, row: np.ndarray,
+                           seg_i: int, k_seg: int, n_sub: int) -> None:
+    channels, total = _decode_hybrid_row(row, k_seg, n_sub)
+    for i, (scores, ords, cnt, mn, mx, ssq) in enumerate(channels):
+        # top_k is score-desc with padding last: the first cnt lanes are
+        # exactly the valid candidates
+        for s, o in zip(scores[:cnt], ords[:cnt]):
+            result.per_sub[i].append((float(s), seg_i, int(o)))
+        if cnt:
+            b = result.bounds[i]
+            b[0] = min(b[0], mn)
+            b[1] = max(b[1], mx)
+            b[2] += ssq
+            b[3] += cnt
+    result.total += total
+
+
 def _build_sort_key(arrays, primary_sort) -> jnp.ndarray:
     """Dense per-doc f32 key for the device's per-segment top-k selection
     (segment-local value ranks; higher sorts first; missing → MISSING_KEY)."""
@@ -684,6 +828,13 @@ _BATCHABLE_KEYS = frozenset({"query", "size", "from", "min_score", "sort",
                              "_source", "aggs", "aggregations"})
 
 
+def _contains_hybrid(query_spec) -> bool:
+    """Top-level hybrid clause detection on the RAW body (pre-parse): the
+    batched envelope and the general host loop both hand hybrid off to the
+    fused hybrid query phase (searchpipeline/hybrid.py drives it)."""
+    return isinstance(query_spec, dict) and "hybrid" in query_spec
+
+
 def _contains_inner_hits(obj) -> bool:
     if isinstance(obj, dict):
         return "inner_hits" in obj or any(_contains_inner_hits(v)
@@ -698,7 +849,11 @@ def _msearch_batchable(body: dict) -> bool:
             and body.get("sort") in (None, "_score", ["_score"])
             # inner_hits need the full fetch sub-phase pipeline, which
             # the batched envelope's _hit_dict does not run
-            and not _contains_inner_hits(body.get("query")))
+            and not _contains_inner_hits(body.get("query"))
+            # hybrid executes through its own fused multi-sub-query
+            # program with per-sub-query score channels — the envelope's
+            # single (scores, matches) row can't carry them
+            and not _contains_hybrid(body.get("query")))
 
 
 class SearchExecutor:
@@ -846,6 +1001,86 @@ class SearchExecutor:
 
         return candidates, per_segment_decoded, total
 
+    def execute_hybrid_query_phase(self, body: dict, k: int,
+                                   extra_filter: Optional[dict] = None
+                                   ) -> "HybridShardResult":
+        """Per-shard fused hybrid query phase: ALL sub-queries of the
+        hybrid clause run as ONE device program per segment (dispatched
+        async across segments, collected with one device_get), returning
+        per-sub-query candidates + score bounds for the coordinator's
+        normalization merge (searchpipeline/hybrid.py)."""
+        node = dsl.parse_query(body.get("query"))
+        if not isinstance(node, dsl.HybridQuery):
+            raise IllegalArgumentError(
+                "execute_hybrid_query_phase requires a top-level [hybrid] "
+                "query")
+        min_score = float(body["min_score"]) \
+            if body.get("min_score") is not None else NEG_INF
+        n_sub = len(node.queries)
+        sub_nodes: List[dsl.QueryNode] = []
+        for sub in node.queries:
+            if extra_filter is not None:
+                sub = dsl.BoolQuery(must=[sub],
+                                    filter=[dsl.parse_query(extra_filter)])
+            sub_nodes.append(sub)
+        stats = self.reader.stats()
+        compiler = Compiler(self.reader.mapper, stats)
+        # per-sub-query candidate window = from+size, the reference's
+        # per-shard TopDocs size for hybrid sub-queries (no tie overfetch:
+        # no cursor path rides hybrid, and the window depth directly sets
+        # both the top_k cost and the normalization pool)
+        k_fetch = min(k, 1 << 16)
+
+        from opensearch_tpu.indices.query_cache import FilterCacheContext
+        from opensearch_tpu.search.warmup import WARMUP
+        launched = []
+        struct_parts: List[Any] = []
+        shape_parts: List[Any] = []
+        for seg_i, (seg, (arrays, meta)) in enumerate(
+                zip(self.reader.segments, self.reader.device)):
+            if seg.num_docs == 0:
+                struct_parts.append(None)
+                shape_parts.append(None)
+                continue
+            compiler.filter_ctx = FilterCacheContext(seg, arrays)
+            plans = [compiler.compile(q, seg, meta) for q in sub_nodes]
+            compiler.filter_ctx = None
+            k_seg = min(k_fetch, pad_bucket(max(seg.num_docs, 1)))
+            flat: List[Dict[str, np.ndarray]] = []
+            for p in plans:
+                p.flatten_inputs(flat)
+            struct_parts.append(tuple(p.sig() for p in plans))
+            shape_parts.append(tuple((k2, v.shape, v.dtype.num)
+                                     for d in flat for k2, v in d.items()))
+            # the B=1 envelope program: the SAME executable family as the
+            # batched _msearch hybrid path (identical layout/treedef), so
+            # single searches and batches share warmed executables
+            stacked, treedef, _axes = stack_flat_inputs([flat])
+            stacked.append(np.asarray([min_score], dtype=np.float32))
+            buf, layout = pack_leaves(stacked)
+            fn = _batched_hybrid_runner(plans, meta, k_seg, layout,
+                                        treedef)
+            launched.append((seg_i, k_seg, fn(arrays, jnp.asarray(buf))))
+        if extra_filter is None:
+            # register the fused executable's (plan-struct, shape-bucket)
+            # signature so index-open / node-start warmup AOT-compiles the
+            # hybrid program off the query path — replaying the recorded
+            # body through multi_search reproduces exactly this B=1 group
+            # (alias-filtered variants are skipped: the recorded body
+            # alone cannot reproduce their plans)
+            WARMUP.record(self.reader.index_name, body, 1,
+                          ("hybenv", tuple(struct_parts),
+                           tuple(shape_parts), k_fetch, 1))
+
+        result = _empty_hybrid_result(n_sub)
+        if launched:
+            fetched = jax.device_get([out for _, _, out in launched])
+            for (seg_i, k_seg, _), rows in zip(launched, fetched):
+                _accumulate_hybrid_row(result, np.asarray(rows)[0], seg_i,
+                                       k_seg, n_sub)
+        result.bounds = [tuple(b) for b in result.bounds]
+        return result
+
     def _hit_dict(self, seg_i: int, ord_: int, score: Optional[float],
                   body: dict) -> dict:
         """One search hit (fetch phase for a single doc) — shared by search()
@@ -878,10 +1113,17 @@ class SearchExecutor:
             REQUEST_CACHE, cache_key, cacheable)
         resp_cache_keys: Dict[int, Any] = {}
         batchable: List[Tuple[int, dict, Any, int, int, float]] = []
+        hybrid_items: List[Tuple[int, dict]] = []
         for i, body in enumerate(bodies):
             body = body or {}
             if not _msearch_batchable(body):
-                responses[i] = self.search(body, _direct=True)
+                if _hybrid_msearch_batchable(body):
+                    # hybrid bodies batch through their own envelope:
+                    # one vmapped fused multi-sub-query program per
+                    # (plan-struct, shape) group
+                    hybrid_items.append((i, body))
+                else:
+                    responses[i] = self.search(body, _direct=True)
                 continue
             if cacheable(body) and not _bypass_request_cache:
                 # shard request cache at QUERY-PHASE granularity: the
@@ -933,12 +1175,111 @@ class SearchExecutor:
         # round-trip sync costs more than the overlap saves, and on CPU
         # the gain was ~2%. The prepare/finish split is kept for
         # structure, not pipelining.)
+        if hybrid_items:
+            self._msearch_hybrid(hybrid_items, responses, start)
         if batchable:
             state = self._msearch_prepare(batchable, responses, start)
             state["resp_cache_keys"] = resp_cache_keys
             self._msearch_finish(state, responses, start)
         return {"took": int((time.monotonic() - start) * 1000),
                 "responses": responses}
+
+    def _msearch_hybrid(self, items: List[Tuple[int, dict]], responses,
+                        start: float) -> None:
+        """Batched hybrid envelope: same-structure hybrid bodies become
+        ONE vmapped fused program per (plan-struct, shape, k) group per
+        segment — per-query launch cost amortizes exactly like the plain
+        msearch envelope. Responses use the DEFAULT normalization spec
+        (pipeline-specific specs ride the REST path, where _run_search
+        executes per query with the resolved processor chain)."""
+        from opensearch_tpu.searchpipeline import hybrid as hyb
+        stats = self.reader.stats()
+        compiler = Compiler(self.reader.mapper, stats)
+        prepared: Dict[int, tuple] = {}
+        groups: Dict[Any, List[int]] = {}
+        for i, body in items:
+            try:
+                node = dsl.parse_query(body.get("query"))
+                n_sub = len(node.queries)
+                _s, _f, k = hyb.validate_hybrid_request(
+                    body, n_sub, hyb.DEFAULT_SPEC, [self])
+                k_fetch = min(k, 1 << 16)  # same window as the 1-query path
+                plans_per_seg: List[Optional[list]] = []
+                flats_per_seg: List[Optional[list]] = []
+                for seg, (arrays, meta) in zip(self.reader.segments,
+                                               self.reader.device):
+                    if seg.num_docs == 0:
+                        plans_per_seg.append(None)
+                        flats_per_seg.append(None)
+                        continue
+                    plans = [compiler.compile(q, seg, meta)
+                             for q in node.queries]
+                    flat: List[Dict[str, np.ndarray]] = []
+                    for p in plans:
+                        p.flatten_inputs(flat)
+                    plans_per_seg.append(plans)
+                    flats_per_seg.append(flat)
+            except Exception:
+                # surface errors through the general path's renderer
+                responses[i] = self.search(body, _direct=True)
+                continue
+            min_score = float(body["min_score"]) \
+                if body.get("min_score") is not None else NEG_INF
+            prepared[i] = (body, n_sub, min_score, plans_per_seg,
+                           flats_per_seg)
+            struct = tuple(
+                tuple(p.sig() for p in plans) if plans is not None
+                else None for plans in plans_per_seg)
+            shape_sig = tuple(
+                None if f is None else tuple(
+                    (k2, v.shape, v.dtype.num)
+                    for d in f for k2, v in d.items())
+                for f in flats_per_seg)
+            groups.setdefault((struct, shape_sig, k_fetch), []).append(i)
+
+        from opensearch_tpu.search.warmup import WARMUP
+        pending = []
+        for (struct, shape_sig, k_fetch), idxs in groups.items():
+            b_pad = pad_bucket(len(idxs), minimum=1)
+            pad_rows = b_pad - len(idxs)
+            WARMUP.record(self.reader.index_name, prepared[idxs[0]][0],
+                          b_pad, ("hybenv", struct, shape_sig, k_fetch,
+                                  b_pad))
+            min_scores = np.asarray(
+                [prepared[i][2] for i in idxs] + [np.inf] * pad_rows,
+                dtype=np.float32)
+            for seg_i, (seg, (arrays, meta)) in enumerate(
+                    zip(self.reader.segments, self.reader.device)):
+                if seg.num_docs == 0:
+                    continue
+                group_flats = [prepared[i][4][seg_i] for i in idxs]
+                group_flats += [group_flats[0]] * pad_rows
+                stacked, treedef, axes = stack_flat_inputs(group_flats)
+                stacked.append(min_scores)
+                buf, layout = pack_leaves(stacked)
+                k_seg = min(k_fetch, pad_bucket(max(seg.num_docs, 1)))
+                plans0 = prepared[idxs[0]][3][seg_i]
+                fn = _batched_hybrid_runner(plans0, meta, k_seg, layout,
+                                            treedef)
+                pending.append((idxs, seg_i, k_seg, len(plans0),
+                                fn(arrays, jnp.asarray(buf))))
+
+        results = {i: _empty_hybrid_result(prepared[i][1])
+                   for i in prepared}
+        if pending:
+            fetched = jax.device_get(
+                [packed for _, _, _, _, packed in pending])
+            for (idxs, seg_i, k_seg, n_sub, _), packed in zip(pending,
+                                                              fetched):
+                packed = np.asarray(packed)
+                for row_i, i in enumerate(idxs):
+                    _accumulate_hybrid_row(results[i], packed[row_i],
+                                           seg_i, k_seg, n_sub)
+        for i, result in results.items():
+            body, n_sub = prepared[i][0], prepared[i][1]
+            result.bounds = [tuple(b) for b in result.bounds]
+            responses[i] = hyb.merge_and_render(
+                [self], body, [result], hyb.DEFAULT_SPEC, start, n_sub)
 
 
     def _msearch_prepare(self, batchable, responses, start):

@@ -396,6 +396,14 @@ def _eval_plan(plan: Plan, seg: Dict, inputs: List[Dict], cursor: List[int]):
         scores = my["boost"] * my["pivot"] / (my["pivot"] + dist)
         return jnp.where(exists, scores, 0.0), exists
 
+    if kind == "distance_feature_geo":
+        field = plan.static[0]
+        lat, exists, _ = dense_numeric(seg, f"{field}.lat", d_pad)
+        lon, _, _ = dense_numeric(seg, f"{field}.lon", d_pad)
+        dist = _haversine_m(lat, lon, my["lat"], my["lon"])
+        scores = my["boost"] * my["pivot"] / (my["pivot"] + dist)
+        return jnp.where(exists, scores, 0.0), exists
+
     if kind == "rank_feature":
         field, function = plan.static
         value, exists, _ = dense_numeric(seg, field, d_pad)

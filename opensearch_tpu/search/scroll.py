@@ -67,6 +67,12 @@ def start_scroll(node, index_expr, body, keep_alive: str) -> dict:
     _purge_expired(node.scroll_contexts)
     keep_alive_s = parse_time_value(keep_alive or "1m", "scroll")
     body = dict(body or {})
+    if isinstance(body.get("query"), dict) and "hybrid" in body["query"]:
+        # hybrid pages rank by the combined normalized score, which has
+        # no stable search_after cursor — same rejection as the reference
+        from opensearch_tpu.common.errors import IllegalArgumentError
+        raise IllegalArgumentError(
+            "[scroll] is not supported with a [hybrid] query")
     body.pop("from", None)
     executors, filters = _pin_executors(node, index_expr)
     ctx = _Context(executors, filters, body, keep_alive_s)

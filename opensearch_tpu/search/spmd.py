@@ -132,8 +132,39 @@ class force_host_loop:
         return False
 
 
+def merge_hybrid_bounds(per_shard_bounds: List[List[Tuple[float, float,
+                                                          float, int]]],
+                        n_sub: int) -> List[Tuple[float, float, float,
+                                                  int]]:
+    """Reduce per-shard per-sub-query hybrid score bounds to GLOBAL
+    bounds: min-of-mins / max-of-maxs / sum-of-sum-of-squares / count —
+    the pmin/pmax/psum shape of the SPMD collective merge, applied to the
+    bounds each shard's fused hybrid program computed on device. The
+    normalization-processor (searchpipeline/hybrid.py) normalizes with
+    these global statistics at reduce, per reference semantics (the
+    neural-search processor normalizes over the union of all shards'
+    TopDocs)."""
+    out = []
+    for i in range(n_sub):
+        mn, mx, ssq, count = float("inf"), float("-inf"), 0.0, 0
+        for bounds in per_shard_bounds:
+            b_mn, b_mx, b_ssq, b_count = bounds[i]
+            if b_count:
+                mn = min(mn, b_mn)
+                mx = max(mx, b_mx)
+                ssq += b_ssq
+                count += b_count
+        out.append((mn, mx, ssq, count))
+    return out
+
+
 def eligible(executors: List, body: dict, rows: List[Tuple[int, int]],
              sort_specs) -> bool:
+    if isinstance(body.get("query"), dict) and "hybrid" in body["query"]:
+        # hybrid executes through its own fused per-shard program with
+        # per-sub-query score channels + bounds; the generic SPMD merge
+        # carries a single score channel and would collapse them
+        return False
     if len(rows) < 2 \
             or len(rows) > len(jax.devices()) * SPMD_MAX_PACK:
         return False

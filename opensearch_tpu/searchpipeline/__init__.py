@@ -1,0 +1,24 @@
+"""Search pipelines: request/response transformation + hybrid score merge.
+
+Re-design of OpenSearch 2.x's search-pipeline subsystem
+(search/pipeline/SearchPipelineService.java + the neural-search plugin's
+normalization-processor): pipelines are named chains of processors stored
+in cluster state, resolved per request from the `search_pipeline` request
+parameter or the target index's `index.search.default_pipeline` setting,
+and applied around search execution:
+
+  - request processors   (filter_query, oversample) rewrite the body;
+  - phase-results processors (normalization-processor) merge the per-
+    sub-query score channels of a `hybrid` query at reduce time;
+  - response processors  (rename_field, truncate_hits, rescore_knn)
+    rewrite the rendered response.
+
+The hybrid query phase itself is fused into one device program per
+segment (search/executor.py build_hybrid_query_phase); this package owns
+pipeline CRUD/validation (service.py), the processor implementations
+(processors.py), and the coordinator-side normalization + combination
+merge (hybrid.py).
+"""
+
+from opensearch_tpu.searchpipeline.service import (  # noqa: F401
+    SearchPipeline, SearchPipelineService)

@@ -1,0 +1,348 @@
+"""Search-pipeline processor implementations.
+
+Reference analogs: org.opensearch.search.pipeline.common.* (FilterQuery
+RequestProcessor, OversampleRequestProcessor, TruncateHitsResponseProcessor,
+RenameFieldResponseProcessor) and the neural-search plugin's
+NormalizationProcessor. Each processor validates its config at pipeline
+PUT time (bad config is a 400 on the CRUD call, never a query-time 500).
+
+Request processors receive (body, ctx) and return the transformed body;
+`ctx` is the per-request pipeline context (the reference's
+PipelineProcessingContext) that request processors write and response
+processors read — oversample records the original size there so
+truncate_hits can restore it.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+from opensearch_tpu.common.errors import IllegalArgumentError
+
+
+class Processor:
+    type_name = "_base"
+
+    def __init__(self, config: Dict[str, Any]):
+        self.tag = config.get("tag")
+        self.description = config.get("description")
+        self.ignore_failure = bool(config.get("ignore_failure", False))
+
+
+def _require(config: dict, key: str, type_name: str):
+    if config.get(key) is None:
+        raise IllegalArgumentError(
+            f"[{type_name}] required property [{key}] is missing")
+    return config[key]
+
+
+# ---------------------------------------------------------------- request
+
+class FilterQueryProcessor(Processor):
+    """Constrain every search with an additional filter clause
+    (common/FilterQueryRequestProcessor.java)."""
+    type_name = "filter_query"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.filter = _require(config, "query", self.type_name)
+        if not isinstance(self.filter, dict):
+            raise IllegalArgumentError(
+                "[filter_query] [query] must be an object")
+        from opensearch_tpu.search import dsl
+        dsl.parse_query(self.filter)       # validate at PUT time
+
+    def process_request(self, body: dict, ctx: dict) -> dict:
+        body = dict(body)
+        query = body.get("query")
+        if isinstance(query, dict) and "hybrid" in query:
+            # a hybrid clause cannot nest inside bool: filter each
+            # sub-query instead (same doc-eligibility semantics)
+            hybrid = dict(query["hybrid"])
+            hybrid["queries"] = [
+                {"bool": {"must": [sub], "filter": [self.filter]}}
+                for sub in hybrid.get("queries", [])]
+            body["query"] = {"hybrid": hybrid}
+        else:
+            must = [query] if query is not None else []
+            body["query"] = {"bool": {"must": must,
+                                      "filter": [self.filter]}}
+        return body
+
+
+class OversampleProcessor(Processor):
+    """Multiply the requested size by sample_factor so a later response
+    processor (rescore_knn, truncate_hits) works over a larger candidate
+    set (common/OversampleRequestProcessor.java). Records original_size
+    in the pipeline context."""
+    type_name = "oversample"
+
+    def __init__(self, config):
+        super().__init__(config)
+        factor = _require(config, "sample_factor", self.type_name)
+        try:
+            self.sample_factor = float(factor)
+        except (TypeError, ValueError):
+            raise IllegalArgumentError(
+                f"[oversample] [sample_factor] must be a number, got "
+                f"[{factor}]")
+        if self.sample_factor < 1.0:
+            raise IllegalArgumentError(
+                "[oversample] [sample_factor] must be >= 1.0")
+
+    def process_request(self, body: dict, ctx: dict) -> dict:
+        body = dict(body)
+        size = int(body.get("size", 10))
+        ctx["original_size"] = size
+        body["size"] = int(math.ceil(size * self.sample_factor))
+        return body
+
+
+# --------------------------------------------------------------- response
+
+class RenameFieldProcessor(Processor):
+    """Rename a _source field in every hit
+    (common/RenameFieldResponseProcessor.java)."""
+    type_name = "rename_field"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.field = str(_require(config, "field", self.type_name))
+        self.target = str(_require(config, "target_field", self.type_name))
+
+    def process_response(self, response: dict, ctx: dict,
+                         targets=None) -> dict:
+        for hit in response.get("hits", {}).get("hits", []):
+            src = hit.get("_source")
+            if isinstance(src, dict) and self.field in src:
+                src[self.target] = src.pop(self.field)
+        return response
+
+
+class TruncateHitsProcessor(Processor):
+    """Truncate the hits page to target_size — or to the original
+    pre-oversample size recorded in the pipeline context
+    (common/TruncateHitsResponseProcessor.java)."""
+    type_name = "truncate_hits"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.target_size: Optional[int] = None
+        if config.get("target_size") is not None:
+            self.target_size = int(config["target_size"])
+            if self.target_size < 0:
+                raise IllegalArgumentError(
+                    "[truncate_hits] [target_size] must be >= 0")
+
+    def process_response(self, response: dict, ctx: dict,
+                         targets=None) -> dict:
+        size = self.target_size
+        if size is None:
+            size = ctx.get("original_size")
+        if size is None:
+            raise IllegalArgumentError(
+                "[truncate_hits] has no [target_size] and no oversample "
+                "processor ran earlier in the pipeline")
+        hits = response.get("hits", {})
+        if isinstance(hits.get("hits"), list):
+            hits["hits"] = hits["hits"][:size]
+        return response
+
+
+class RescoreKnnProcessor(Processor):
+    """Exact k-NN re-score of the (oversampled) hit page: recompute each
+    hit's similarity against the stored vector and re-rank. The
+    oversample → rescore_knn → truncate_hits chain is the two-stage
+    retrieval pattern (ANN candidates, exact rerank) with the rerank math
+    identical to ops/knn.py's space scores."""
+    type_name = "rescore_knn"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.field = str(_require(config, "field", self.type_name))
+        self.query_vector = config.get("query_vector")
+        if self.query_vector is not None and \
+                not isinstance(self.query_vector, (list, tuple)):
+            raise IllegalArgumentError(
+                "[rescore_knn] [query_vector] must be an array")
+        self.space_type = str(config.get("space_type", "")) or None
+
+    def _resolve_vector(self, body: dict):
+        if self.query_vector is not None:
+            return list(self.query_vector)
+
+        def find(q):
+            if not isinstance(q, dict):
+                return None
+            knn = q.get("knn")
+            if isinstance(knn, dict) and self.field in knn:
+                return (knn[self.field] or {}).get("vector")
+            for v in q.values():
+                if isinstance(v, dict):
+                    got = find(v)
+                    if got is not None:
+                        return got
+                elif isinstance(v, list):
+                    for item in v:
+                        got = find(item)
+                        if got is not None:
+                            return got
+            return None
+
+        return find(body.get("query"))
+
+    @staticmethod
+    def _space_score(vec, q, space: str) -> float:
+        """Host (numpy) mirror of ops/knn.py's space scores — the rerank
+        page is small, so per-hit device dispatch would cost more than
+        the math."""
+        import numpy as np
+        vec = np.asarray(vec, np.float64)
+        q = np.asarray(q, np.float64)
+        if space == "l2":
+            return float(1.0 / (1.0 + ((vec - q) ** 2).sum()))
+        if space == "cosinesimil":
+            denom = max(float(np.linalg.norm(vec) * np.linalg.norm(q)),
+                        1e-30)
+            cos = float(np.clip(vec @ q / denom, -1.0, 1.0))
+            return (1.0 + cos) / 2.0
+        ip = float(vec @ q)
+        return ip + 1.0 if ip >= 0 else 1.0 / (1.0 - ip)
+
+    def process_response(self, response: dict, ctx: dict,
+                         targets=None) -> dict:
+        import numpy as np
+        query = self._resolve_vector(ctx.get("request_body") or {})
+        if query is None:
+            raise IllegalArgumentError(
+                f"[rescore_knn] no [query_vector] configured and the "
+                f"request has no knn clause on [{self.field}]")
+        q = np.asarray(query, dtype=np.float32)
+        hits = response.get("hits", {}).get("hits", [])
+        if not hits or not targets:
+            return response
+        by_index = {svc.index_name: svc for svc in targets}
+        for hit in hits:
+            svc = by_index.get(hit.get("_index"))
+            if svc is None:
+                continue
+            ft = svc.mapper.get_field(self.field)
+            space = (self.space_type
+                     or (ft.similarity_space if ft is not None
+                         and ft.is_vector else "l2"))
+            for shard in svc.shards:
+                found = False
+                for seg in shard.executor.reader.segments:
+                    ord_ = seg.ord_of(hit["_id"])
+                    col = seg.vector_dv.get(self.field)
+                    if ord_ is not None and col is not None \
+                            and col.exists[ord_]:
+                        hit["_score"] = self._space_score(
+                            col.vectors[ord_], q, space)
+                        found = True
+                        break
+                if found:
+                    break
+        hits.sort(key=lambda h: -(h.get("_score") or 0.0))
+        response["hits"]["hits"] = hits
+        if hits and hits[0].get("_score") is not None:
+            response["hits"]["max_score"] = hits[0]["_score"]
+        return response
+
+
+# ----------------------------------------------------------- phase results
+
+NORMALIZATION_TECHNIQUES = ("min_max", "l2")
+COMBINATION_TECHNIQUES = ("arithmetic_mean", "geometric_mean",
+                          "harmonic_mean")
+
+
+class NormalizationProcessor(Processor):
+    """The hybrid-score merge spec: normalization technique + weighted
+    combination technique (neural-search NormalizationProcessor). The
+    actual merge runs in searchpipeline/hybrid.py at reduce time, using
+    the global per-sub-query score bounds carried up from the fused
+    per-shard query phase."""
+    type_name = "normalization-processor"
+
+    def __init__(self, config):
+        super().__init__(config)
+        norm = config.get("normalization") or {}
+        comb = config.get("combination") or {}
+        self.normalization = str(norm.get("technique", "min_max"))
+        if self.normalization not in NORMALIZATION_TECHNIQUES:
+            raise IllegalArgumentError(
+                f"provided [normalization] technique "
+                f"[{self.normalization}] is not supported, must be one of "
+                f"{list(NORMALIZATION_TECHNIQUES)}")
+        self.combination = str(comb.get("technique", "arithmetic_mean"))
+        if self.combination not in COMBINATION_TECHNIQUES:
+            raise IllegalArgumentError(
+                f"provided [combination] technique [{self.combination}] "
+                f"is not supported, must be one of "
+                f"{list(COMBINATION_TECHNIQUES)}")
+        params = comb.get("parameters") or {}
+        self.weights: Optional[List[float]] = None
+        if params.get("weights") is not None:
+            ws = params["weights"]
+            if not isinstance(ws, (list, tuple)) or not ws:
+                raise IllegalArgumentError(
+                    "[normalization-processor] combination [weights] must "
+                    "be a non-empty array of numbers")
+            try:
+                self.weights = [float(w) for w in ws]
+            except (TypeError, ValueError):
+                raise IllegalArgumentError(
+                    "[normalization-processor] combination [weights] must "
+                    "be numbers")
+            if any(w < 0 for w in self.weights):
+                raise IllegalArgumentError(
+                    "[normalization-processor] combination [weights] must "
+                    "be non-negative")
+
+    def spec(self) -> dict:
+        return {"normalization": self.normalization,
+                "combination": self.combination,
+                "weights": self.weights}
+
+
+REQUEST_PROCESSORS = {
+    FilterQueryProcessor.type_name: FilterQueryProcessor,
+    OversampleProcessor.type_name: OversampleProcessor,
+}
+
+RESPONSE_PROCESSORS = {
+    RenameFieldProcessor.type_name: RenameFieldProcessor,
+    TruncateHitsProcessor.type_name: TruncateHitsProcessor,
+    RescoreKnnProcessor.type_name: RescoreKnnProcessor,
+}
+
+PHASE_RESULTS_PROCESSORS = {
+    NormalizationProcessor.type_name: NormalizationProcessor,
+}
+
+
+def build_processors(kind: str, specs: Any) -> List[Processor]:
+    """Parse one processor list of a pipeline body. Each entry is a
+    single-key {type: config} object (same wire shape as ingest
+    pipelines); unknown types are a 400."""
+    registry = {"request_processors": REQUEST_PROCESSORS,
+                "response_processors": RESPONSE_PROCESSORS,
+                "phase_results_processors": PHASE_RESULTS_PROCESSORS}[kind]
+    if specs is None:
+        return []
+    if not isinstance(specs, list):
+        raise IllegalArgumentError(f"[{kind}] must be an array")
+    out: List[Processor] = []
+    for spec in specs:
+        if not isinstance(spec, dict) or len(spec) != 1:
+            raise IllegalArgumentError(
+                f"[{kind}] entries must be single-key processor objects")
+        type_name, config = next(iter(spec.items()))
+        cls = registry.get(type_name)
+        if cls is None:
+            raise IllegalArgumentError(
+                f"Invalid processor type [{type_name}] in [{kind}]")
+        out.append(cls(config if isinstance(config, dict) else {}))
+    return out

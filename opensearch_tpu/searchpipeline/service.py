@@ -1,0 +1,145 @@
+"""SearchPipelineService: named pipeline CRUD + per-request resolution.
+
+Reference: search/pipeline/SearchPipelineService.java — pipelines live in
+cluster state (here: the gateway metadata document, persisted by
+Node.persist_metadata), are resolved per request from the
+`search_pipeline` request parameter, an inline pipeline object in the
+body, or the target index's `index.search.default_pipeline` setting
+("_none" disables), and wrap search execution with their processor
+chains.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from opensearch_tpu.common.errors import (
+    IllegalArgumentError, ResourceNotFoundError)
+from opensearch_tpu.searchpipeline.processors import (
+    NormalizationProcessor, build_processors)
+
+_PIPELINE_KEYS = frozenset({"request_processors", "response_processors",
+                            "phase_results_processors", "description",
+                            "version"})
+
+
+class SearchPipeline:
+    """One validated pipeline: parsed processor chains + the raw body
+    (persisted verbatim so CRUD round-trips byte-identically)."""
+
+    def __init__(self, pipeline_id: str, body: Dict[str, Any]):
+        if not isinstance(body, dict):
+            raise IllegalArgumentError("pipeline body must be an object")
+        unknown = set(body) - _PIPELINE_KEYS
+        if unknown:
+            raise IllegalArgumentError(
+                f"pipeline [{pipeline_id}] doesn't support one or more "
+                f"provided configuration parameters {sorted(unknown)}")
+        self.pipeline_id = pipeline_id
+        self.body = body
+        self.request_processors = build_processors(
+            "request_processors", body.get("request_processors"))
+        self.response_processors = build_processors(
+            "response_processors", body.get("response_processors"))
+        self.phase_results_processors = build_processors(
+            "phase_results_processors",
+            body.get("phase_results_processors"))
+
+    # ------------------------------------------------------------ execution
+
+    def process_request(self, body: dict, ctx: dict) -> dict:
+        ctx.setdefault("request_body", body)
+        for proc in self.request_processors:
+            try:
+                body = proc.process_request(body, ctx)
+            except Exception:
+                if not proc.ignore_failure:
+                    raise
+        ctx["request_body"] = body
+        return body
+
+    def process_response(self, response: dict, ctx: dict,
+                         targets=None) -> dict:
+        for proc in self.response_processors:
+            try:
+                response = proc.process_response(response, ctx, targets)
+            except Exception:
+                if not proc.ignore_failure:
+                    raise
+        return response
+
+    def phase_spec(self) -> Optional[dict]:
+        """The normalization-processor's merge spec (None = no hybrid
+        merge configured; hybrid queries then use the defaults)."""
+        for proc in self.phase_results_processors:
+            if isinstance(proc, NormalizationProcessor):
+                return proc.spec()
+        return None
+
+
+class SearchPipelineService:
+    """All named search pipelines on this node."""
+
+    def __init__(self):
+        self.pipelines: Dict[str, SearchPipeline] = {}
+
+    # ---------------------------------------------------------------- CRUD
+
+    def put(self, pipeline_id: str, body: Dict[str, Any]) -> SearchPipeline:
+        if not pipeline_id:
+            raise IllegalArgumentError("pipeline id cannot be empty")
+        pipeline = SearchPipeline(pipeline_id, body)   # validates
+        self.pipelines[pipeline_id] = pipeline
+        return pipeline
+
+    def get(self, pipeline_id: str) -> SearchPipeline:
+        pipeline = self.pipelines.get(pipeline_id)
+        if pipeline is None:
+            raise ResourceNotFoundError(
+                f"pipeline [{pipeline_id}] does not exist")
+        return pipeline
+
+    def delete(self, pipeline_id: str) -> None:
+        if pipeline_id not in self.pipelines:
+            raise ResourceNotFoundError(
+                f"pipeline [{pipeline_id}] does not exist")
+        del self.pipelines[pipeline_id]
+
+    # ----------------------------------------------------------- resolution
+
+    def resolve(self, param: Optional[Any],
+                index_services: Optional[List] = None
+                ) -> Optional[SearchPipeline]:
+        """The pipeline for one search request: explicit request pipeline
+        (name string or inline definition object) wins; otherwise, when
+        the request targets exactly ONE index, that index's
+        `index.search.default_pipeline` setting applies; "_none" disables
+        at either level (SearchPipelineService.resolvePipeline)."""
+        if param is not None:
+            if isinstance(param, dict):
+                return SearchPipeline("_ad_hoc_pipeline", param)
+            name = str(param)
+            if name == "_none":
+                return None
+            return self.get(name)
+        if index_services and len(index_services) == 1:
+            default = index_services[0].settings.get(
+                "search.default_pipeline")
+            if default and default != "_none":
+                return self.get(str(default))
+        return None
+
+    # ---------------------------------------------------------- persistence
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {pid: p.body for pid, p in self.pipelines.items()}
+
+    def load(self, data: Optional[Dict[str, Any]]) -> int:
+        loaded = 0
+        for pid, body in (data or {}).items():
+            try:
+                self.put(pid, body)
+                loaded += 1
+            except IllegalArgumentError:
+                continue    # a bad persisted entry must not block startup
+        return loaded

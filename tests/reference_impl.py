@@ -92,6 +92,92 @@ def _next_bucket_step(calendar: str) -> int:
     return days * 86400_000
 
 
+# ------------------------------------------------------ hybrid-score oracle
+
+def ref_hybrid_scores(shard_candidates: Sequence[Sequence[Dict]],
+                      normalization: str = "min_max",
+                      combination: str = "arithmetic_mean",
+                      weights: Optional[Sequence[float]] = None,
+                      ) -> Dict:
+    """Independent oracle for the hybrid normalization + combination merge
+    (neural-search ScoreNormalization/ScoreCombination semantics, computed
+    the straightforward way — no bounds carrying, no device math).
+
+    shard_candidates: per SHARD, a list over SUB-QUERIES of {doc_key:
+    score} — each shard's already-selected candidate window for that
+    sub-query (the union of shard windows is what the reference
+    normalizes over). Returns {doc_key: combined_score}.
+    """
+    n_sub = max(len(subs) for subs in shard_candidates) \
+        if shard_candidates else 0
+    ws = list(weights) if weights is not None else [1.0] * n_sub
+
+    # global per-sub-query candidate pools
+    pools: List[Dict] = [{} for _ in range(n_sub)]
+    for subs in shard_candidates:
+        for i, cands in enumerate(subs):
+            pools[i].update(cands)
+
+    normalized: List[Dict] = []
+    for i in range(n_sub):
+        pool = pools[i]
+        if normalization == "l2":
+            norm = math.sqrt(sum(s * s for s in pool.values()))
+            normalized.append({k: (s / norm if norm > 0 else 0.0)
+                               for k, s in pool.items()})
+        elif normalization == "min_max":
+            if not pool:
+                normalized.append({})
+                continue
+            mn, mx = min(pool.values()), max(pool.values())
+            out = {}
+            for k, s in pool.items():
+                if mx == mn:
+                    out[k] = 1.0          # single-value case
+                else:
+                    v = (s - mn) / (mx - mn)
+                    out[k] = 0.001 if v == 0.0 else v
+            normalized.append(out)
+        else:
+            raise ValueError(normalization)
+
+    docs = sorted({k for pool in normalized for k in pool})
+    result = {}
+    for key in docs:
+        scores = [normalized[i].get(key) for i in range(n_sub)]
+        if combination == "arithmetic_mean":
+            denom = sum(ws)
+            combined = (sum(ws[i] * (scores[i] or 0.0)
+                            for i in range(n_sub)) / denom
+                        if denom > 0 else 0.0)
+        elif combination == "geometric_mean":
+            num = denom = 0.0
+            for i in range(n_sub):
+                if scores[i] is not None and scores[i] > 0:
+                    num += ws[i] * math.log(scores[i])
+                    denom += ws[i]
+            combined = math.exp(num / denom) if denom > 0 else 0.0
+        elif combination == "harmonic_mean":
+            num = denom = 0.0
+            for i in range(n_sub):
+                if scores[i] is not None and scores[i] > 0:
+                    num += ws[i]
+                    denom += ws[i] / scores[i]
+            combined = num / denom if denom > 0 else 0.0
+        else:
+            raise ValueError(combination)
+        result[key] = combined
+    return result
+
+
+def ref_knn_l2_score(doc_vec: Sequence[float],
+                     query_vec: Sequence[float]) -> float:
+    """k-NN plugin l2 space score: 1 / (1 + squared distance)."""
+    d2 = sum((float(a) - float(b)) ** 2
+             for a, b in zip(doc_vec, query_vec))
+    return 1.0 / (1.0 + d2)
+
+
 class RefField:
     """One text field over a corpus of already-analyzed docs."""
 

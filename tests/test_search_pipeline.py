@@ -1,0 +1,445 @@
+"""Search-pipeline subsystem: CRUD + processors + hybrid BM25⊕kNN
+retrieval with normalization/combination checked against the pure-Python
+oracle (tests/reference_impl.ref_hybrid_scores), including multi-shard
+global min/max and empty-sub-query edge cases, plus the warmup-registry
+integration of the fused hybrid executable.
+"""
+
+import json
+from collections import OrderedDict
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.node import Node
+from opensearch_tpu.search.warmup import WARMUP
+from reference_impl import RefField, ref_hybrid_scores, ref_knn_l2_score
+
+DIMS = 4
+VOCAB = ["red", "fox", "dog", "cat", "blue", "runs", "sleeps", "jumps"]
+
+
+@pytest.fixture()
+def clean_warmup():
+    saved_entries, saved_memo = WARMUP._entries, WARMUP._sig_memo
+    saved_path, saved_dirty = WARMUP._path, WARMUP._dirty
+    WARMUP._entries = OrderedDict()
+    WARMUP._sig_memo = {}
+    WARMUP._path = None
+    WARMUP._dirty = False
+    yield WARMUP
+    WARMUP._entries = saved_entries
+    WARMUP._sig_memo = saved_memo
+    WARMUP._path = saved_path
+    WARMUP._dirty = saved_dirty
+
+
+def _build_corpus(node, index, n_docs=40, n_shards=2, seed=3):
+    rng = np.random.RandomState(seed)
+    node.request("PUT", f"/{index}", {
+        "settings": {"number_of_shards": n_shards},
+        "mappings": {"properties": {
+            "title": {"type": "text"},
+            "color": {"type": "keyword"},
+            "vec": {"type": "knn_vector", "dimension": DIMS,
+                    "method": {"space_type": "l2"}}}}})
+    docs = {}
+    lines = []
+    for i in range(n_docs):
+        terms = [VOCAB[t] for t in rng.randint(0, len(VOCAB),
+                                               size=rng.randint(2, 6))]
+        doc = {"title": " ".join(terms),
+               "color": ["red", "blue"][i % 2],
+               "vec": np.round(rng.rand(DIMS), 3).tolist()}
+        docs[f"d{i}"] = doc
+        lines.append(json.dumps({"index": {"_index": index,
+                                           "_id": f"d{i}"}}))
+        lines.append(json.dumps(doc))
+    r = node.request("POST", "/_bulk", "\n".join(lines) + "\n",
+                     refresh="true")
+    assert r["_status"] == 200 and not r["errors"]
+    return docs
+
+
+def _shard_partition(node, index):
+    """doc ids per shard, read from the actual shard segments (routing is
+    not under test here)."""
+    svc = node.indices.get(index)
+    out = []
+    for shard in svc.shards:
+        ids = []
+        for seg in shard.executor.reader.segments:
+            ids.extend(seg.doc_ids[o] for o in range(seg.num_docs)
+                       if seg.live[o])
+        out.append(ids)
+    return out
+
+
+def _oracle_shard_candidates(docs, shard_ids, match_terms, query_vec,
+                             knn_k, k_window=10):
+    """Per-shard [match candidates, knn candidates] for the oracle: BM25
+    scored with shard-local statistics (the executor's ShardStats scope),
+    each sub-query truncated to its per-shard top-(from+size) window
+    (score desc, doc-ord-asc tie-break — the device window), knn further
+    bounded by its own k."""
+    shard_candidates = []
+    for ids in shard_ids:
+        field = RefField([docs[d]["title"].split() for d in ids])
+        scores = field.match_scores(match_terms)
+        ranked = sorted((i for i in range(len(ids)) if scores[i] > 0),
+                        key=lambda i: (-scores[i], i))[:k_window]
+        match_c = {ids[i]: float(scores[i]) for i in ranked}
+        knn_all = [(d, ref_knn_l2_score(docs[d]["vec"], query_vec))
+                   for d in ids]
+        top = sorted(range(len(knn_all)),
+                     key=lambda j: (-knn_all[j][1], j))
+        top = top[:min(knn_k, k_window)]
+        shard_candidates.append(
+            [match_c, {knn_all[j][0]: knn_all[j][1] for j in top}])
+    return shard_candidates
+
+
+def _oracle_union_total(docs, shard_ids, match_terms, query_vec, knn_k):
+    """Expected hits.total: the union of MATCHING docs across sub-queries
+    (pre-window — totals count matches, the page counts the window):
+    match = every doc with a positive BM25 score; knn = each shard's
+    top-knn_k (the kNN clause's own match set)."""
+    matched = set()
+    for ids in shard_ids:
+        field = RefField([docs[d]["title"].split() for d in ids])
+        scores = field.match_scores(match_terms)
+        matched |= {ids[i] for i in range(len(ids)) if scores[i] > 0}
+        knn = sorted(range(len(ids)),
+                     key=lambda i: (-ref_knn_l2_score(docs[ids[i]]["vec"],
+                                                      query_vec), i))
+        matched |= {ids[i] for i in knn[:knn_k]}
+    return len(matched)
+
+
+def _oracle_order(oracle, shard_ids):
+    """Rank oracle docs the way the engine pages them: combined score
+    desc, then (shard, doc ord) asc — mergeTopDocs' tie-break."""
+    pos = {}
+    for si, ids in enumerate(shard_ids):
+        for o, d in enumerate(ids):
+            pos[d] = (si, o)
+    return sorted(oracle, key=lambda d: (-oracle[d], pos[d]))
+
+
+def _hybrid_body(match_terms, query_vec, knn_k, size=10):
+    return {"query": {"hybrid": {"queries": [
+        {"match": {"title": " ".join(match_terms)}},
+        {"knn": {"vec": {"vector": list(query_vec), "k": knn_k}}}]}},
+        "size": size}
+
+
+# ------------------------------------------------------------------- CRUD
+
+def test_pipeline_crud_and_validation():
+    node = Node()
+    body = {"description": "d",
+            "request_processors": [{"filter_query": {
+                "query": {"term": {"color": "red"}}}}],
+            "phase_results_processors": [{"normalization-processor": {
+                "normalization": {"technique": "l2"},
+                "combination": {"technique": "geometric_mean"}}}]}
+    assert node.request("PUT", "/_search/pipeline/p1",
+                        body)["_status"] == 200
+    got = node.request("GET", "/_search/pipeline/p1")
+    assert got["_status"] == 200 and got["p1"] == body
+    assert node.request("GET",
+                        "/_search/pipeline")["p1"] == body
+    assert node.request("GET",
+                        "/_search/pipeline/nope")["_status"] == 404
+    assert node.request("DELETE",
+                        "/_search/pipeline/p1")["_status"] == 200
+    assert node.request("GET",
+                        "/_search/pipeline/p1")["_status"] == 404
+    assert node.request("DELETE",
+                        "/_search/pipeline/p1")["_status"] == 404
+    # validation: unknown processor type / bad technique / bad keys → 400
+    assert node.request("PUT", "/_search/pipeline/bad", {
+        "request_processors": [{"nope": {}}]})["_status"] == 400
+    assert node.request("PUT", "/_search/pipeline/bad", {
+        "phase_results_processors": [{"normalization-processor": {
+            "normalization": {"technique": "zscore"}}}]})["_status"] == 400
+    assert node.request("PUT", "/_search/pipeline/bad", {
+        "weird_key": []})["_status"] == 400
+    assert node.request("PUT", "/_search/pipeline/bad", {
+        "request_processors": [{"oversample": {
+            "sample_factor": 0.5}}]})["_status"] == 400
+
+
+def test_pipeline_persistence_across_restart(tmp_path):
+    data = str(tmp_path / "n1")
+    node = Node(data_path=data)
+    node.request("PUT", "/_search/pipeline/keeper", {
+        "response_processors": [{"truncate_hits": {"target_size": 1}}]})
+    node2 = Node(data_path=data)
+    got = node2.request("GET", "/_search/pipeline/keeper")
+    assert got["_status"] == 200
+    assert got["keeper"]["response_processors"][0]["truncate_hits"] == \
+        {"target_size": 1}
+
+
+# -------------------------------------------------------------- processors
+
+def test_filter_query_processor():
+    node = Node()
+    _build_corpus(node, "idx", n_docs=20, n_shards=1)
+    node.request("PUT", "/_search/pipeline/reds", {
+        "request_processors": [{"filter_query": {
+            "query": {"term": {"color": "red"}}}}]})
+    plain = node.request("POST", "/idx/_search",
+                         {"query": {"match_all": {}}, "size": 50})
+    filtered = node.request("POST", "/idx/_search",
+                            {"query": {"match_all": {}}, "size": 50},
+                            search_pipeline="reds")
+    assert plain["hits"]["total"]["value"] == 20
+    assert filtered["hits"]["total"]["value"] == 10
+    assert all(h["_source"]["color"] == "red"
+               for h in filtered["hits"]["hits"])
+
+
+def test_oversample_truncate_and_rename():
+    node = Node()
+    _build_corpus(node, "idx", n_docs=20, n_shards=1)
+    node.request("PUT", "/_search/pipeline/o", {
+        "request_processors": [{"oversample": {"sample_factor": 3}}],
+        "response_processors": [
+            {"rename_field": {"field": "color",
+                              "target_field": "colour"}},
+            {"truncate_hits": {}}]})
+    res = node.request("POST", "/idx/_search",
+                       {"query": {"match_all": {}}, "size": 4},
+                       search_pipeline="o")
+    # oversampled to 12 internally, truncated back to the original 4
+    assert len(res["hits"]["hits"]) == 4
+    assert all("colour" in h["_source"] and "color" not in h["_source"]
+               for h in res["hits"]["hits"])
+    # truncate_hits without oversample context and no target_size → 400
+    node.request("PUT", "/_search/pipeline/t", {
+        "response_processors": [{"truncate_hits": {}}]})
+    res = node.request("POST", "/idx/_search",
+                       {"query": {"match_all": {}}},
+                       search_pipeline="t")
+    assert res["_status"] == 400
+
+
+def test_rescore_knn_processor():
+    node = Node()
+    docs = _build_corpus(node, "idx", n_docs=30, n_shards=1)
+    node.request("PUT", "/_search/pipeline/rk", {
+        "request_processors": [{"oversample": {"sample_factor": 3}}],
+        "response_processors": [
+            {"rescore_knn": {"field": "vec",
+                             "query_vector": [0.5, 0.5, 0.5, 0.5]}},
+            {"truncate_hits": {}}]})
+    res = node.request("POST", "/idx/_search",
+                       {"query": {"match_all": {}}, "size": 5},
+                       search_pipeline="rk")
+    assert res["_status"] == 200
+    hits = res["hits"]["hits"]
+    assert len(hits) == 5
+    # the rescore pool is the OVERSAMPLED candidate page (size 5 × 3):
+    # match_all ties on score, so the page is the first 15 docs in doc
+    # order — rescore re-ranks within that pool, not the whole corpus
+    pool = [f"d{i}" for i in range(15)]
+    expected = {d: ref_knn_l2_score(docs[d]["vec"], [0.5, 0.5, 0.5, 0.5])
+                for d in pool}
+    want_top = sorted(expected, key=lambda d: -expected[d])[:5]
+    assert [h["_id"] for h in hits] == want_top
+    for h in hits:
+        assert h["_score"] == pytest.approx(expected[h["_id"]], rel=1e-4)
+
+
+def test_default_pipeline_setting():
+    node = Node()
+    _build_corpus(node, "idx", n_docs=10, n_shards=1)
+    node.request("PUT", "/_search/pipeline/reds", {
+        "request_processors": [{"filter_query": {
+            "query": {"term": {"color": "red"}}}}]})
+    node.request("PUT", "/idx/_settings",
+                 {"index": {"search": {"default_pipeline": "reds"}}})
+    res = node.request("POST", "/idx/_search",
+                       {"query": {"match_all": {}}, "size": 50})
+    assert res["hits"]["total"]["value"] == 5
+    # ?search_pipeline=_none disables the index default
+    res = node.request("POST", "/idx/_search",
+                       {"query": {"match_all": {}}, "size": 50},
+                       search_pipeline="_none")
+    assert res["hits"]["total"]["value"] == 10
+
+
+# ------------------------------------------------- hybrid vs the oracle
+
+@pytest.mark.parametrize("normalization,combination,weights", [
+    ("min_max", "arithmetic_mean", None),
+    ("min_max", "arithmetic_mean", [0.3, 0.7]),
+    ("min_max", "geometric_mean", None),
+    ("min_max", "harmonic_mean", [0.6, 0.4]),
+    ("l2", "arithmetic_mean", [0.2, 0.8]),
+    ("l2", "geometric_mean", None),
+])
+def test_hybrid_matches_oracle_multi_shard(normalization, combination,
+                                           weights):
+    node = Node()
+    docs = _build_corpus(node, "hyb", n_docs=40, n_shards=2,
+                         seed=11)
+    spec = {"normalization": {"technique": normalization},
+            "combination": {"technique": combination}}
+    if weights is not None:
+        spec["combination"]["parameters"] = {"weights": weights}
+    node.request("PUT", "/_search/pipeline/p",
+                 {"phase_results_processors": [
+                     {"normalization-processor": spec}]})
+    match_terms = ["red", "dog"]
+    qvec = [0.9, 0.1, 0.4, 0.2]
+    knn_k = 5
+    res = node.request("POST", "/hyb/_search",
+                       _hybrid_body(match_terms, qvec, knn_k, size=10),
+                       search_pipeline="p")
+    assert res["_status"] == 200
+
+    shard_ids = _shard_partition(node, "hyb")
+    assert all(shard_ids), "expected both shards populated"
+    oracle = ref_hybrid_scores(
+        _oracle_shard_candidates(docs, shard_ids, match_terms, qvec,
+                                 knn_k),
+        normalization=normalization, combination=combination,
+        weights=weights)
+    want_order = _oracle_order(oracle, shard_ids)[:10]
+    hits = res["hits"]["hits"]
+    assert [h["_id"] for h in hits] == want_order
+    for h in hits:
+        assert h["_score"] == pytest.approx(oracle[h["_id"]], rel=2e-3,
+                                            abs=1e-5)
+    assert res["hits"]["total"]["value"] == _oracle_union_total(
+        docs, shard_ids, match_terms, qvec, knn_k)
+    assert res["hits"]["max_score"] == pytest.approx(
+        max(oracle.values()), rel=2e-3)
+
+
+def test_hybrid_empty_subquery_and_single_candidate():
+    node = Node()
+    docs = _build_corpus(node, "hyb", n_docs=12, n_shards=2, seed=7)
+    # sub-query 1 matches nothing: combination must degrade per-technique
+    body = {"query": {"hybrid": {"queries": [
+        {"match": {"title": "zebra"}},
+        {"knn": {"vec": {"vector": [0.5, 0.5, 0.5, 0.5], "k": 3}}}]}},
+        "size": 10}
+    res = node.request("POST", "/hyb/_search", body)
+    assert res["_status"] == 200
+    shard_ids = _shard_partition(node, "hyb")
+    oracle = ref_hybrid_scores(
+        _oracle_shard_candidates(docs, shard_ids, ["zebra"],
+                                 [0.5, 0.5, 0.5, 0.5], 3))
+    hits = res["hits"]["hits"]
+    assert [h["_id"] for h in hits] == _oracle_order(oracle,
+                                                     shard_ids)[:10]
+    for h in hits:
+        assert h["_score"] == pytest.approx(oracle[h["_id"]], rel=2e-3)
+    # single-candidate sub-query: min==max → normalized 1.0
+    body = {"query": {"hybrid": {"queries": [
+        {"ids": {"values": ["d0"]}},
+        {"match": {"title": "zebra"}}]}}, "size": 3}
+    res = node.request("POST", "/hyb/_search", body)
+    assert res["_status"] == 200
+    assert [h["_id"] for h in res["hits"]["hits"]] == ["d0"]
+    # arithmetic mean over (1.0, missing) with equal weights = 0.5
+    assert res["hits"]["hits"][0]["_score"] == pytest.approx(0.5)
+
+
+def test_hybrid_error_contract():
+    node = Node()
+    _build_corpus(node, "hyb", n_docs=6, n_shards=1)
+    hybrid = {"hybrid": {"queries": [{"match_all": {}}]}}
+    # nested hybrid → 400
+    res = node.request("POST", "/hyb/_search",
+                       {"query": {"bool": {"must": [hybrid]}}})
+    assert res["_status"] == 400
+    # unsupported companions → 400
+    for extra in ({"sort": [{"color": "asc"}]},
+                  {"aggs": {"c": {"terms": {"field": "color"}}}},
+                  {"search_after": [1]},
+                  {"collapse": {"field": "color"}}):
+        res = node.request("POST", "/hyb/_search",
+                           {"query": hybrid, **extra})
+        assert res["_status"] == 400, extra
+    # scroll → 400
+    res = node.request("POST", "/hyb/_search", {"query": hybrid},
+                       scroll="1m")
+    assert res["_status"] == 400
+    # empty / too many sub-queries → 400
+    res = node.request("POST", "/hyb/_search",
+                       {"query": {"hybrid": {"queries": []}}})
+    assert res["_status"] == 400
+    res = node.request("POST", "/hyb/_search", {"query": {"hybrid": {
+        "queries": [{"match_all": {}}] * 6}}})
+    assert res["_status"] == 400
+    # weights count mismatch → 400
+    node.request("PUT", "/_search/pipeline/w3", {
+        "phase_results_processors": [{"normalization-processor": {
+            "combination": {"parameters": {
+                "weights": [0.5, 0.3, 0.2]}}}}]})
+    res = node.request("POST", "/hyb/_search", {"query": {"hybrid": {
+        "queries": [{"match_all": {}}, {"match_all": {}}]}}},
+        search_pipeline="w3")
+    assert res["_status"] == 400
+
+
+def test_hybrid_filter_query_processor_filters_every_subquery():
+    node = Node()
+    _build_corpus(node, "hyb", n_docs=20, n_shards=1)
+    node.request("PUT", "/_search/pipeline/reds", {
+        "request_processors": [{"filter_query": {
+            "query": {"term": {"color": "red"}}}}]})
+    res = node.request("POST", "/hyb/_search",
+                       _hybrid_body(["red", "dog"], [0.5] * DIMS, 8,
+                                    size=20), search_pipeline="reds")
+    assert res["_status"] == 200
+    assert res["hits"]["hits"]
+    assert all(h["_source"]["color"] == "red"
+               for h in res["hits"]["hits"])
+
+
+def test_hybrid_msearch_envelope_parity():
+    """The batched hybrid envelope (_msearch with B hybrid bodies → one
+    vmapped fused program per group) must return the same pages as the
+    per-query path, and both must match the oracle."""
+    node = Node()
+    docs = _build_corpus(node, "hyb", n_docs=30, n_shards=1, seed=19)
+    bodies = [_hybrid_body(["red", "dog"], [0.5, 0.2, 0.8, 0.1], 5),
+              _hybrid_body(["fox", "cat"], [0.1, 0.9, 0.3, 0.4], 5),
+              _hybrid_body(["blue"], [0.7, 0.7, 0.1, 0.1], 4)]
+    ex = node.indices.get("hyb").shards[0].executor
+    batched = ex.multi_search([dict(b) for b in bodies])["responses"]
+    single = [ex.search(dict(b)) for b in bodies]
+    for b, s in zip(batched, single):
+        assert [(h["_id"], h["_score"]) for h in b["hits"]["hits"]] == \
+            [(h["_id"], h["_score"]) for h in s["hits"]["hits"]]
+        assert b["hits"]["total"] == s["hits"]["total"]
+    shard_ids = _shard_partition(node, "hyb")
+    oracle = ref_hybrid_scores(_oracle_shard_candidates(
+        docs, shard_ids, ["red", "dog"], [0.5, 0.2, 0.8, 0.1], 5))
+    assert [h["_id"] for h in batched[0]["hits"]["hits"]] == \
+        _oracle_order(oracle, shard_ids)[:10]
+    for h in batched[0]["hits"]["hits"]:
+        assert h["_score"] == pytest.approx(oracle[h["_id"]], rel=2e-3)
+
+
+# ------------------------------------------------------ warmup integration
+
+def test_hybrid_executable_in_warmup_registry(clean_warmup):
+    node = Node()
+    _build_corpus(node, "hyb", n_docs=16, n_shards=1, seed=5)
+    body = _hybrid_body(["red"], [0.5] * DIMS, 4)
+    assert node.request("POST", "/hyb/_search", body)["_status"] == 200
+    entries = [e for e in WARMUP.entries("hyb")
+               if "hybrid" in (e.get("body", {}).get("query") or {})]
+    assert entries, "fused hybrid executable not registered for warmup"
+    # replay compiles the same fused program (no error, counted as warmed)
+    ex = node.indices.get("hyb").shards[0].executor
+    out = WARMUP.warm_executor(ex, "hyb")
+    assert out["errors"] == 0
+    assert out["warmed"] >= 1

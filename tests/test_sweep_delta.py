@@ -1,0 +1,26 @@
+"""Tier-1 wiring of tools/sweep_delta.py: the crash-fixed YAML suites
+plus the search-pipeline suite must produce ZERO 5xx responses. Runs the
+same suite functions the standalone tool runs (and, when the reference
+checkout is present, the real YAML files of the three fixed suites)."""
+
+import importlib.util
+import os
+
+_TOOL = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "sweep_delta.py")
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("sweep_delta", _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_sweep_delta_suites_no_5xx():
+    mod = _load_tool()
+    report, failures = mod.run_all()
+    # every named suite actually ran
+    assert set(report) == set(mod.SUITES)
+    assert all(statuses for statuses in report.values())
+    assert not failures, "\n".join(failures)
