@@ -85,6 +85,43 @@ VOCAB = int(os.environ.get("BENCH_VOCAB", "20000"))
 N_QUERIES = int(os.environ.get("BENCH_QUERIES", "1024"))
 TOP_K = 10
 
+# --telemetry: enable request tracing + report the per-phase latency
+# histograms the run recorded (inside the single JSON output line).
+# Without the flag the run ASSERTS the tracer is a no-op — the <2%
+# disabled-overhead contract is checked, not assumed.
+TELEMETRY_ON = "--telemetry" in sys.argv
+
+
+def _setup_telemetry():
+    from opensearch_tpu.telemetry import TELEMETRY
+    from opensearch_tpu.telemetry.tracer import NOOP_SPAN
+    if TELEMETRY_ON:
+        TELEMETRY.enable()
+        return
+    assert TELEMETRY.tracer.start_trace("bench.noop-probe") is NOOP_SPAN, \
+        "tracer must be a no-op when telemetry is disabled"
+
+
+def _telemetry_summary():
+    """Per-phase histogram digest for the output record (None when the
+    run was not started with --telemetry)."""
+    if not TELEMETRY_ON:
+        return None
+    from opensearch_tpu.search.executor import MSEARCH_PHASES
+    from opensearch_tpu.telemetry import TELEMETRY
+    hists = TELEMETRY.metrics.to_dict()["histograms"]
+    out = {name: {"count": h["count"], "p50_ms": h["p50_ms"],
+                  "p99_ms": h["p99_ms"]}
+           for name, h in sorted(hists.items())
+           if name.startswith("search.phase.")
+           or name in ("search.took_ms", "msearch.batch_ms",
+                       "search.xla_compile_ms")}
+    # the envelope path's cumulative per-phase accounting (seconds):
+    # covers runs whose traffic is entirely batched msearch
+    out["msearch_phases_s"] = {k: round(v, 4)
+                               for k, v in MSEARCH_PHASES.items()}
+    return out
+
 
 def build_index():
     from opensearch_tpu.search.executor import SearchExecutor, ShardReader
@@ -264,6 +301,9 @@ def bench_aggs(mode: str):
         "warm_p50_ms": warm_p50, "warm_p99_ms": warm_p99,
         "warmup_ms": round(warmup_ms, 1),
     }
+    _t = _telemetry_summary()
+    if _t is not None:
+        out["telemetry"] = _t
     if _BACKEND_DIAG:
         out["backend_diag"] = "; ".join(_BACKEND_DIAG)
     print(json.dumps(out))
@@ -343,6 +383,9 @@ def bench_knn(mode: str):
         "vs_baseline": round(qps / base_qps, 3),
         "recall_at_10": round(float(np.mean(recalls)), 4),
     }
+    _t = _telemetry_summary()
+    if _t is not None:
+        out["telemetry"] = _t
     if _BACKEND_DIAG:
         out["backend_diag"] = "; ".join(_BACKEND_DIAG)
     print(json.dumps(out))
@@ -488,6 +531,9 @@ def bench_hybrid():
         "warm_p50_ms": warm_p50, "warm_p99_ms": warm_p99,
         "warmup_ms": round(warmup_ms, 1),
     }
+    _t = _telemetry_summary()
+    if _t is not None:
+        out["telemetry"] = _t
     if _BACKEND_DIAG:
         out["backend_diag"] = "; ".join(_BACKEND_DIAG)
     print(json.dumps(out))
@@ -499,6 +545,7 @@ def main():
 
     from opensearch_tpu.utils.demo import query_terms
 
+    _setup_telemetry()
     mode = os.environ.get("BENCH_MODE", "bm25")
     if mode in ("knn_exact", "knn_ivf"):
         bench_knn(mode)
@@ -554,6 +601,9 @@ def main():
         "p99_ms": round(lat_ms[min(len(lat_ms) - 1,
                                    int(len(lat_ms) * 0.99))], 2),
     }
+    _t = _telemetry_summary()
+    if _t is not None:
+        out["telemetry"] = _t
     if _BACKEND_DIAG:
         out["backend_diag"] = "; ".join(_BACKEND_DIAG)
     print(json.dumps(out))
@@ -607,7 +657,8 @@ def _run_extra_configs():
             continue
         try:
             r = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
+                [sys.executable, os.path.abspath(__file__)]
+                + (["--telemetry"] if TELEMETRY_ON else []),
                 env={**child_env, "BENCH_MODE": mode},
                 capture_output=True, text=True,
                 timeout=min(300, remaining))

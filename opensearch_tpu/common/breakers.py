@@ -172,6 +172,9 @@ class SearchBackpressure:
         with self._lock:
             if self.current >= self.max_concurrent:
                 self.rejections += 1
+                from opensearch_tpu.telemetry import TELEMETRY
+                TELEMETRY.metrics.counter(
+                    "search.backpressure_rejections").inc()
                 raise CircuitBreakingError(
                     f"rejected execution of search: node is under duress "
                     f"[{self.current} >= {self.max_concurrent} concurrent "

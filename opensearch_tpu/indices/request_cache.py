@@ -16,7 +16,14 @@ import json
 import re
 import threading
 from collections import OrderedDict
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Optional, Tuple
+
+from opensearch_tpu.telemetry import TELEMETRY
+
+# telemetry mirror of the hit/miss counters (the `telemetry` section of
+# _nodes/stats); module-level handles keep the hot path to one int add
+_CACHE_HITS = TELEMETRY.metrics.counter("request_cache.hits")
+_CACHE_MISSES = TELEMETRY.metrics.counter("request_cache.misses")
 
 
 class RequestCache:
@@ -35,24 +42,18 @@ class RequestCache:
             if key in self._store:
                 self.hits += 1
                 self._store.move_to_end(key)
+                _CACHE_HITS.inc()
                 return self._store[key]
         return self._MISS
 
     def put(self, key, value):
         with self._lock:
             self.misses += 1
+            _CACHE_MISSES.inc()
             self._store[key] = value
             self._store.move_to_end(key)
             while len(self._store) > self.max_entries:
                 self._store.popitem(last=False)
-
-    def get_or_compute(self, key, compute: Callable[[], Any]):
-        value = self.get(key)
-        if value is not self._MISS:
-            return value
-        value = compute()
-        self.put(key, value)
-        return value
 
     def clear(self):
         with self._lock:

@@ -92,6 +92,27 @@ class Node:
                     and self.indices.indices:
                 WARMUP.warm_all(self.indices,
                                 budget_s=WARMUP.default_budget_s)
+        # telemetry (opensearch_tpu/telemetry): tracing is OFF by default
+        # — the tracer is a no-op until telemetry.tracing.enabled (or a
+        # runtime POST /_telemetry/_enable) turns it on; the metrics
+        # registry is always on. JSONL trace export lands under the data
+        # dir's _state/ next to the warmup registry.
+        from opensearch_tpu.common.settings import _parse_bool
+        from opensearch_tpu.telemetry import TELEMETRY
+
+        def _tel_bool(key: str) -> bool:
+            raw = self.settings.get(key)
+            # strict boolean parse, same contract as every other boolean
+            # setting (a typo'd value fails node start, never silently
+            # disables tracing)
+            return False if raw is None else _parse_bool(raw, key)
+
+        TELEMETRY.configure(
+            data_path=data_path,
+            enabled=_tel_bool("telemetry.tracing.enabled"),
+            jsonl=_tel_bool("telemetry.tracing.jsonl"),
+            ring_size=int(self.settings.get("telemetry.tracing.ring_size",
+                                            256)))
         self.controller = RestController()
         from opensearch_tpu.rest.actions import register_all
         register_all(self)

@@ -11,6 +11,8 @@ AbstractCatAction.
 from __future__ import annotations
 
 import json
+import logging
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -20,6 +22,7 @@ from opensearch_tpu.search import dsl
 from opensearch_tpu.common.errors import (
     IllegalArgumentError, IndexNotFoundError, OpenSearchTpuError)
 from opensearch_tpu.rest.controller import RestRequest, RestResponse
+from opensearch_tpu.telemetry import TELEMETRY
 
 
 # --------------------------------------------------------------------- utils
@@ -122,68 +125,130 @@ def _run_search(node, index_expr: Optional[str], body: Optional[dict],
     execute (the pipeline's normalization-processor spec rides along for
     hybrid queries), then apply response processors.
     `search_pipeline="_none"` disables resolution entirely (internal
-    callers like _count that the reference serves without pipelines)."""
+    callers like _count that the reference serves without pipelines).
+
+    Telemetry: every request opens a root span (rest.search) that closes
+    on EVERY exit — success, error, and backpressure rejection (status
+    "rejected") — with child spans from the pipeline processors and the
+    search phases; per-phase times feed the slow log's query/fetch
+    thresholds."""
     from opensearch_tpu.search import dsl
     from opensearch_tpu.search.controller import execute_search
-    executors, filters = _search_targets(node, index_expr)
-    body = dict(body or {})
-    inline = body.pop("search_pipeline", None)
-    services = _search_services(node, index_expr)
-    pipeline = node.search_pipelines.resolve(
-        search_pipeline if search_pipeline is not None else inline,
-        services)
-    ctx: Dict[str, Any] = {}
-    phase_spec = None
-    if pipeline is not None:
-        body = pipeline.process_request(body, ctx)
-        phase_spec = pipeline.phase_spec()
-    parsed = dsl.parse_query(body.get("query"))
-    if isinstance(parsed, dsl.PercolateQuery):
-        from opensearch_tpu.search.percolator import execute_percolate
-        k = int(body.get("size", 10)) + int(body.get("from", 0))
-        return execute_percolate(executors, parsed, max(k, 10), body)
-    node.search_backpressure.acquire()
-    task = node.task_manager.register(
-        "indices:data/read/search",
-        description=f"indices[{index_expr or '_all'}]", cancellable=True)
+    tracer = TELEMETRY.tracer
+    metrics = TELEMETRY.metrics
+    root = tracer.start_trace("rest.search", index=index_expr or "_all")
+    metrics.counter("rest.search_requests").inc()
+    phase_times: Dict[str, float] = {}
+    t0 = time.perf_counter_ns()
     try:
-        res = execute_search(executors, body, extra_filters=filters,
-                             task=task, allow_envelope=True,
-                             phase_processors=phase_spec)
+        executors, filters = _search_targets(node, index_expr)
+        body = dict(body or {})
+        inline = body.pop("search_pipeline", None)
+        services = _search_services(node, index_expr)
+        pipeline = node.search_pipelines.resolve(
+            search_pipeline if search_pipeline is not None else inline,
+            services)
+        ctx: Dict[str, Any] = {}
+        phase_spec = None
+        if pipeline is not None:
+            body = pipeline.process_request(body, ctx, trace=root)
+            phase_spec = pipeline.phase_spec()
+        parsed = dsl.parse_query(body.get("query"))
+        if isinstance(parsed, dsl.PercolateQuery):
+            from opensearch_tpu.search.percolator import execute_percolate
+            k = int(body.get("size", 10)) + int(body.get("from", 0))
+            with root.child("query", path="percolate"):
+                return execute_percolate(executors, parsed, max(k, 10),
+                                         body)
+        try:
+            node.search_backpressure.acquire()
+        except OpenSearchTpuError:
+            # the span for a rejected request still closes, with its own
+            # status — rejections must be visible in traces, not lost
+            root.set_attribute("backpressure", "rejected")
+            root.end(status="rejected")
+            raise
+        task = node.task_manager.register(
+            "indices:data/read/search",
+            description=f"indices[{index_expr or '_all'}]", cancellable=True)
+        try:
+            res = execute_search(executors, body, extra_filters=filters,
+                                 task=task, allow_envelope=True,
+                                 phase_processors=phase_spec,
+                                 trace=root, phase_times=phase_times)
+        finally:
+            node.task_manager.unregister(task)
+            node.search_backpressure.release()
+        res.pop("_page_cursor", None)
+        if pipeline is not None:
+            res = pipeline.process_response(res, ctx, targets=services,
+                                            trace=root)
+        root.set_attribute("took_ms", res.get("took"))
+        _maybe_slow_log(node, index_expr, body, res, phase_times)
+        return res
+    except BaseException as e:
+        if getattr(root, "status", "ok") == "ok":
+            root.end(error=e)
+        raise
     finally:
-        node.task_manager.unregister(task)
-        node.search_backpressure.release()
-    res.pop("_page_cursor", None)
-    if pipeline is not None:
-        res = pipeline.process_response(res, ctx, targets=services)
-    _maybe_slow_log(node, index_expr, body, res)
-    return res
+        metrics.histogram("rest.search_ms").observe(
+            (time.perf_counter_ns() - t0) / 1e6)
+        tracer.finish(root)
 
 
-_SLOW_LOGGER = None
+# query/fetch phase slow-log loggers, children of the original logger
+# name so existing capture configuration keeps working
+_SLOW_LOGGERS: Dict[str, Any] = {}
+
+# level check order mirrors SearchSlowLog.java: most severe first, the
+# first threshold the phase time clears wins
+_SLOW_LOG_LEVELS = (("warn", logging.WARNING), ("info", logging.INFO),
+                    ("debug", logging.DEBUG), ("trace", 5))
 
 
-def _maybe_slow_log(node, index_expr, body, res):
-    """Per-index search slow log (index/SearchSlowLog.java:61): threshold
-    from the index setting search.slowlog.threshold.query.warn."""
-    global _SLOW_LOGGER
+def _slow_logger(phase: str):
+    logger = _SLOW_LOGGERS.get(phase)
+    if logger is None:
+        logger = logging.getLogger(
+            f"opensearch_tpu.index.search.slowlog.{phase}")
+        _SLOW_LOGGERS[phase] = logger
+    return logger
+
+
+def _maybe_slow_log(node, index_expr, body, res, phase_times=None):
+    """Per-index search slow log (index/SearchSlowLog.java:61) with full
+    reference parity: independent `query` and `fetch` phase thresholds at
+    all four levels (`search.slowlog.threshold.{query,fetch}.{warn,info,
+    debug,trace}`), each logging at the matching logger level on its own
+    phase logger. `-1` (or any negative) disables a threshold. Phase
+    times come from the request's telemetry phase breakdown; without one
+    (envelope-served requests) the query phase falls back to `took`."""
+    from opensearch_tpu.common.settings import parse_time_value
     took_ms = res.get("took", 0)
+    phase_times = phase_times or {}
+    phase_ms = {"query": phase_times.get("query", took_ms),
+                "fetch": phase_times.get("fetch", 0.0)}
+    total_hits = (res.get("hits", {}).get("total") or {}).get("value")
     for name in node.indices.resolve(index_expr, ignore_unavailable=True):
-        threshold = node.indices.get(name).settings.get(
-            "search.slowlog.threshold.query.warn")
-        if threshold is None:
-            continue
-        from opensearch_tpu.common.settings import parse_time_value
-        if took_ms >= parse_time_value(threshold, "slowlog") * 1000:
-            if _SLOW_LOGGER is None:
-                import logging
-                _SLOW_LOGGER = logging.getLogger(
-                    "opensearch_tpu.index.search.slowlog")
-            _SLOW_LOGGER.warning(
-                "[%s] took[%sms], total_hits[%s], source[%s]",
-                name, took_ms,
-                (res.get("hits", {}).get("total") or {}).get("value"),
-                body)
+        settings = node.indices.get(name).settings
+        for phase, t_ms in phase_ms.items():
+            for level, py_level in _SLOW_LOG_LEVELS:
+                threshold = settings.get(
+                    f"search.slowlog.threshold.{phase}.{level}")
+                if threshold is None:
+                    continue
+                try:
+                    threshold_s = parse_time_value(threshold, "slowlog")
+                except Exception:
+                    continue        # unparseable threshold never logs
+                if threshold_s < 0 or t_ms < threshold_s * 1000:
+                    continue
+                _slow_logger(phase).log(
+                    py_level,
+                    "[%s] took[%sms], took[%s][%.1fms], total_hits[%s], "
+                    "source[%s]",
+                    name, took_ms, phase, t_ms, total_hits, body)
+                break               # most severe matching level only
 
 
 # ---------------------------------------------------------------- documents
@@ -723,8 +788,22 @@ def register_search_actions(node, c):
             if len(names) == 1 and \
                     node.indices.alias_filter(expr, names[0]) is None and \
                     default_pipe in (None, "_none"):
-                res = node.indices.get(names[0]).multi_search(
-                    [b for _, b in pairs])
+                # one ROOT SPAN PER SUB-REQUEST even though the envelope
+                # executes the whole batch as fused device programs — the
+                # per-request accounting contract survives batching
+                spans = [TELEMETRY.tracer.start_trace(
+                    "rest.search", index=expr, msearch=True, batched=True,
+                    batch_size=len(pairs)) for _ in pairs]
+                try:
+                    res = node.indices.get(names[0]).multi_search(
+                        [b for _, b in pairs])
+                except BaseException as e:
+                    for s in spans:
+                        s.end(error=e)
+                    raise
+                finally:
+                    for s in spans:
+                        TELEMETRY.tracer.finish(s)
                 for r in res["responses"]:
                     r.setdefault("status", 200)
                 return res
@@ -1281,6 +1360,7 @@ def register_cluster_actions(node, c):
                     "query_cache": QUERY_CACHE.stats(),
                 },
                 "search_warmup": WARMUP.stats(),
+                "telemetry": TELEMETRY.stats(),
                 "breakers": node.breaker_service.stats(),
                 "indexing_pressure": node.indexing_pressure.stats(),
                 "search_backpressure": node.search_backpressure.stats(),
@@ -1907,6 +1987,42 @@ def register_module_actions(node, c):
     c.register("PUT", "/{index}/_clone/{target}", make_resize("clone"))
 
 
+# ---------------------------------------------------------------- telemetry
+
+def register_telemetry_actions(node, c):
+    """The node's observability surface (the REST face of
+    opensearch_tpu/telemetry): dump/clear the completed-trace ring buffer
+    and toggle tracing at runtime. Tracing is OFF by default
+    (`telemetry.tracing.enabled` node setting turns it on at start)."""
+
+    def do_get_traces(req):
+        size = req.int_param("size", 0)
+        return {"enabled": TELEMETRY.tracer.enabled,
+                "stats": TELEMETRY.tracer.stats(),
+                "traces": TELEMETRY.tracer.traces(size or None)}
+
+    def do_clear_traces(req):
+        TELEMETRY.tracer.clear()
+        return {"acknowledged": True}
+
+    def do_enable(req):
+        TELEMETRY.enable()
+        return {"acknowledged": True, "enabled": True}
+
+    def do_disable(req):
+        TELEMETRY.disable()
+        return {"acknowledged": True, "enabled": False}
+
+    def do_metrics(req):
+        return {"metrics": TELEMETRY.metrics.to_dict()}
+
+    c.register("GET", "/_telemetry/traces", do_get_traces)
+    c.register("POST", "/_telemetry/traces/_clear", do_clear_traces)
+    c.register("POST", "/_telemetry/_enable", do_enable)
+    c.register("POST", "/_telemetry/_disable", do_disable)
+    c.register("GET", "/_telemetry/metrics", do_metrics)
+
+
 # -------------------------------------------------------------------- tasks
 
 def register_task_actions(node, c):
@@ -1949,7 +2065,7 @@ def register_task_actions(node, c):
     def cat_tasks(req):
         rows = [[t.action, f"_local:{t.task_id}", "transport",
                  t.start_time_ms,
-                 f"{t.to_dict()['running_time_in_nanos'] // 1000000}ms"]
+                 f"{t.running_time_in_nanos() // 1000000}ms"]
                 for t in node.task_manager.list_tasks()]
         return _cat_table(req, ["action", "task_id", "type", "start_time",
                                 "running_time"], rows)
@@ -1974,3 +2090,4 @@ def register_all(node):
     register_snapshot_actions(node, c)
     register_module_actions(node, c)
     register_task_actions(node, c)
+    register_telemetry_actions(node, c)

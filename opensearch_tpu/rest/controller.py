@@ -159,8 +159,14 @@ class RestController:
 
     def dispatch(self, request: RestRequest) -> RestResponse:
         from opensearch_tpu.common.logging import DEPRECATION
+        from opensearch_tpu.telemetry import TELEMETRY
+        TELEMETRY.metrics.counter("rest.requests").inc()
         DEPRECATION.start_request()
         response = self._dispatch_inner(request)
+        if response.status >= 500:
+            TELEMETRY.metrics.counter("rest.errors_5xx").inc()
+        elif response.status >= 400:
+            TELEMETRY.metrics.counter("rest.errors_4xx").inc()
         warnings = DEPRECATION.drain_request()
         if warnings:
             # rest/DeprecationRestHandler: deprecations surface to the

@@ -21,12 +21,25 @@ from typing import Optional
 import numpy as np
 
 from opensearch_tpu.search import dsl
+from opensearch_tpu.telemetry import TELEMETRY
+
+# module-level handles: the check runs per shard per request
+_CANMATCH_CHECKS = TELEMETRY.metrics.counter("search.canmatch_checks")
+_CANMATCH_SKIPS = TELEMETRY.metrics.counter("search.canmatch_skips")
 
 
 def shard_can_match(executor, body: Optional[dict]) -> bool:
     """True if this shard might produce a hit for the request. Requests
     with a `suggest` section never skip (suggesters read the whole term
     dictionary regardless of query matches)."""
+    ok = _shard_can_match_inner(executor, body)
+    _CANMATCH_CHECKS.inc()
+    if not ok:
+        _CANMATCH_SKIPS.inc()
+    return ok
+
+
+def _shard_can_match_inner(executor, body: Optional[dict]) -> bool:
     body = body or {}
     if body.get("suggest"):
         return True

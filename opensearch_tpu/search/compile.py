@@ -28,6 +28,11 @@ from opensearch_tpu.ops.bm25 import idf as bm25_idf
 from opensearch_tpu.ops.device_segment import DeviceSegmentMeta
 from opensearch_tpu.search import dsl
 from opensearch_tpu.search.dsl import parse_minimum_should_match
+from opensearch_tpu.telemetry import TELEMETRY
+
+# module-level handle: Compiler.compile runs per (query, segment) on the
+# msearch hot path — one cached counter beats a registry lookup per call
+_PLAN_COMPILES = TELEMETRY.metrics.counter("search.plan_compiles")
 
 DEFAULT_K1 = 1.2
 DEFAULT_B = 0.75
@@ -282,6 +287,7 @@ class Compiler:
     # ------------------------------------------------------------ entry
     def compile(self, node: dsl.QueryNode, seg: Segment,
                 meta: DeviceSegmentMeta) -> Plan:
+        _PLAN_COMPILES.inc()
         method = getattr(self, f"_c_{type(node).__name__}", None)
         if method is None:
             plugin_compile = PLUGIN_COMPILERS.get(type(node))

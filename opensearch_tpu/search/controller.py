@@ -170,13 +170,47 @@ def _validate_search_body_keys(body: dict) -> None:
             raise ParsingError(f"unknown key [{key}] in the search body")
 
 
+class _PhaseTimer:
+    """Times one search phase. The ns total ALWAYS lands in the request's
+    phase dict (metrics histograms and the slow log read it — a couple of
+    perf_counter_ns calls per phase, paid whether or not tracing is on);
+    a child span opens only when the trace records (node tracing enabled
+    or a profile request), so the disabled path allocates nothing."""
+
+    __slots__ = ("name", "phases", "span", "t0", "duration_ns")
+
+    def __init__(self, trace, phases: dict, name: str, **attrs):
+        self.name = name
+        self.phases = phases
+        self.span = trace.child(name, **attrs) if trace.recording else None
+        self.duration_ns = 0
+        self.t0 = time.perf_counter_ns()
+
+    def set_attribute(self, key, value):
+        if self.span is not None:
+            self.span.set_attribute(key, value)
+
+    def __enter__(self) -> "_PhaseTimer":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.duration_ns = time.perf_counter_ns() - self.t0
+        self.phases[self.name] = self.phases.get(self.name, 0) \
+            + self.duration_ns
+        if self.span is not None:
+            self.span.end(error=exc if exc_type is not None else None)
+        return False
+
+
 def execute_search(executors: List, body: Optional[dict],
                    total_shards: Optional[int] = None,
                    failed_shards: int = 0,
                    extra_filters: Optional[List[Optional[dict]]] = None,
                    cursor_tiebreak: Optional[Tuple[int, int, int]] = None,
                    task=None, allow_envelope: bool = False,
-                   phase_processors: Optional[dict] = None) -> dict:
+                   phase_processors: Optional[dict] = None,
+                   trace=None,
+                   phase_times: Optional[dict] = None) -> dict:
     """Run the full query-then-fetch flow over shard executors and render
     the search response. `executors` are per-shard SearchExecutors;
     `extra_filters` (aligned with executors) carry per-index alias filters;
@@ -189,7 +223,14 @@ def execute_search(executors: List, body: Optional[dict],
     cursor and shard accounting, and the envelope's own fallback re-enters
     here and must not loop. `phase_processors` is the resolved search
     pipeline's normalization-processor spec for hybrid queries (None =
-    defaults)."""
+    defaults). `trace` is the request's root telemetry span (None = not
+    traced) — child spans cover parse, can_match, per-shard query with
+    device-dispatch attribution, reduce and fetch, and close on every
+    exit path. `phase_times` (pass a dict) is filled with per-phase
+    milliseconds for the caller's slow log."""
+    from opensearch_tpu.telemetry import NOOP_SPAN, TELEMETRY
+    if trace is None:
+        trace = NOOP_SPAN
     body = body or {}
     _validate_search_body_keys(body)
     query_spec = body.get("query")
@@ -204,10 +245,12 @@ def execute_search(executors: List, body: Optional[dict],
                 "[scroll] is not supported with a [hybrid] query")
         from opensearch_tpu.searchpipeline.hybrid import \
             execute_hybrid_search
-        return execute_hybrid_search(
-            executors, body, phase_spec=phase_processors,
-            extra_filters=extra_filters, total_shards=total_shards,
-            failed_shards=failed_shards, task=task)
+        trace.set_attribute("query_type", "hybrid")
+        with trace.child("query", path="hybrid_fused"):
+            return execute_hybrid_search(
+                executors, body, phase_spec=phase_processors,
+                extra_filters=extra_filters, total_shards=total_shards,
+                failed_shards=failed_shards, task=task)
     if (allow_envelope and len(executors) == 1 and total_shards is None
             and failed_shards == 0 and cursor_tiebreak is None
             and not (extra_filters and extra_filters[0])):
@@ -218,64 +261,76 @@ def execute_search(executors: List, body: Optional[dict],
             # dashboard batches (bit-identical scores), so the warmup
             # registry's (plan-struct, shape-bucket) coverage extends to
             # REST _search singles, not just _msearch
-            return executors[0].search(body)
+            with trace.child("query", path="envelope"):
+                return executors[0].search(body)
     start = time.monotonic()
+    start_ns = time.perf_counter_ns()
     profiling = bool(body.get("profile", False))
+    if profiling and not trace.recording:
+        # the profile API builds from request-scoped spans even when
+        # node-wide tracing is off; a forced trace records locally but is
+        # never retained in the tracer's ring buffer
+        trace = TELEMETRY.tracer.start_trace("search", force=True)
+    phases: dict = {}            # phase name -> accumulated ns
     profile_shards: List[dict] = []
-    size = int(body.get("size", 10))
-    from_ = int(body.get("from", 0))
-    if size < 0 or from_ < 0:
-        raise IllegalArgumentError("[from] parameter cannot be negative" if from_ < 0
-                else "[size] parameter cannot be negative")
-    # index.max_result_window (SearchService#validateSearchSource): deep
-    # from+size pagination must use scroll/search_after-with-paging
-    window = min((getattr(ex, "max_result_window", 10000)
-                  for ex in executors), default=10000)
-    if from_ + size > window and cursor_tiebreak is None:
-        raise IllegalArgumentError(
-            f"Result window is too large, from + size must be less than "
-            f"or equal to: [{window}] but was [{from_ + size}]. See the "
-            f"scroll api for a more efficient way to request large data "
-            f"sets. This limit can be set by changing the "
-            f"[index.max_result_window] index level setting.")
+    with _PhaseTimer(trace, phases, "parse"):
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        if size < 0 or from_ < 0:
+            raise IllegalArgumentError("[from] parameter cannot be negative" if from_ < 0
+                    else "[size] parameter cannot be negative")
+        # index.max_result_window (SearchService#validateSearchSource): deep
+        # from+size pagination must use scroll/search_after-with-paging
+        window = min((getattr(ex, "max_result_window", 10000)
+                      for ex in executors), default=10000)
+        if from_ + size > window and cursor_tiebreak is None:
+            raise IllegalArgumentError(
+                f"Result window is too large, from + size must be less than "
+                f"or equal to: [{window}] but was [{from_ + size}]. See the "
+                f"scroll api for a more efficient way to request large data "
+                f"sets. This limit can be set by changing the "
+                f"[index.max_result_window] index level setting.")
 
-    sort_specs = _parse_sort(body.get("sort"))
-    score_sorted = sort_specs[0][0] == "_score"
-    wants_score = score_sorted or any(f == "_score" for f, _ in sort_specs) \
-        or bool(body.get("track_scores", False))
-    agg_nodes = parse_aggs(body.get("aggs") or body.get("aggregations"))
-    after_values = body.get("search_after")
-    if after_values is not None and from_ > 0:
-        raise IllegalArgumentError(
-            "`from` parameter must be set to 0 when `search_after` is used")
-    collapse_field = (body.get("collapse") or {}).get("field")
-    track_total = body.get("track_total_hits", True)
+        sort_specs = _parse_sort(body.get("sort"))
+        score_sorted = sort_specs[0][0] == "_score"
+        wants_score = score_sorted \
+            or any(f == "_score" for f, _ in sort_specs) \
+            or bool(body.get("track_scores", False))
+        agg_nodes = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        after_values = body.get("search_after")
+        if after_values is not None and from_ > 0:
+            raise IllegalArgumentError(
+                "`from` parameter must be set to 0 when `search_after` is "
+                "used")
+        collapse_field = (body.get("collapse") or {}).get("field")
+        track_total = body.get("track_total_hits", True)
 
-    k = max(from_ + size, 10)
-    max_k = 1 << 16
+        k = max(from_ + size, 10)
+        max_k = 1 << 16
 
-    # DFS query-then-fetch (DfsQueryPhase + aggregateDfs): collect every
-    # shard's term statistics for the query, merge, and pin the merged
-    # stats on every shard's compile so scores are globally comparable
-    dfs_overrides: Optional[List] = None
-    if body.get("search_type") == "dfs_query_then_fetch" and executors:
-        from opensearch_tpu.common.errors import ParsingError
-        from opensearch_tpu.search.compile import (
-            StaticStats, collect_query_term_stats, merge_dfs_stats)
-        try:
-            qnode = dsl.parse_query(body.get("query"))
-        except ParsingError:
-            qnode = None             # the normal path raises it properly
-        if qnode is not None:
-            # any OTHER failure here is a real bug and must surface — a
-            # silent fallback to shard-local stats would hand the user
-            # non-comparable scores they explicitly asked to avoid
-            parts = [collect_query_term_stats(qnode, ex.reader.mapper,
-                                              ex.reader.stats())
-                     for ex in executors]
-            fields, term_df = merge_dfs_stats(parts)
-            dfs_overrides = [StaticStats(ex.reader.stats(), fields, term_df)
-                             for ex in executors]
+        # DFS query-then-fetch (DfsQueryPhase + aggregateDfs): collect every
+        # shard's term statistics for the query, merge, and pin the merged
+        # stats on every shard's compile so scores are globally comparable
+        dfs_overrides: Optional[List] = None
+        if body.get("search_type") == "dfs_query_then_fetch" and executors:
+            from opensearch_tpu.common.errors import ParsingError
+            from opensearch_tpu.search.compile import (
+                StaticStats, collect_query_term_stats, merge_dfs_stats)
+            try:
+                qnode = dsl.parse_query(body.get("query"))
+            except ParsingError:
+                qnode = None         # the normal path raises it properly
+            if qnode is not None:
+                # any OTHER failure here is a real bug and must surface — a
+                # silent fallback to shard-local stats would hand the user
+                # non-comparable scores they explicitly asked to avoid
+                parts = [collect_query_term_stats(qnode, ex.reader.mapper,
+                                                  ex.reader.stats())
+                         for ex in executors]
+                fields, term_df = merge_dfs_stats(parts)
+                dfs_overrides = [StaticStats(ex.reader.stats(), fields,
+                                             term_df)
+                                 for ex in executors]
 
     # can-match pre-filter (CanMatchPreFilterSearchPhase): shards whose
     # segment min/max metadata proves emptiness never compile or launch a
@@ -290,9 +345,12 @@ def execute_search(executors: List, body: Optional[dict],
 
     def can_match_flags():
         if flags_box[0] is None:
-            flags = [shard_can_match(ex, body) for ex in executors]
-            if flags and not any(flags):
-                flags[0] = True
+            with _PhaseTimer(trace, phases, "can_match") as cm:
+                flags = [shard_can_match(ex, body) for ex in executors]
+                if flags and not any(flags):
+                    flags[0] = True
+                cm.set_attribute("skipped",
+                                 len(executors) - sum(flags))
             flags_box[0] = flags
         return flags_box[0]
 
@@ -303,25 +361,33 @@ def execute_search(executors: List, body: Optional[dict],
         profile_shards.clear()
         # SPMD path: with multiple (shard, segment) rows and enough mesh
         # devices, the query phase is ONE shard_map program with on-chip
-        # all_gather/psum merge instead of a host loop (search/spmd.py)
-        from opensearch_tpu.search import spmd
-        rows = spmd.spmd_rows(executors)
-        if spmd.eligible(executors, body, rows, sort_specs):
-            shard_start = time.monotonic_ns()
-            out = spmd.spmd_query_phase(executors, body, k_eff,
-                                        extra_filters, rows)
+        # all_gather/psum merge instead of a host loop (search/spmd.py).
+        # Routing (rows + eligibility, incl. the cold module import) is
+        # accounted under can_match — it's the same shard-routing
+        # decision family
+        with _PhaseTimer(trace, phases, "can_match", op="spmd_route"):
+            from opensearch_tpu.search import spmd
+            rows = spmd.spmd_rows(executors)
+            spmd_ok = spmd.eligible(executors, body, rows, sort_specs)
+        if spmd_ok:
+            with _PhaseTimer(trace, phases, "query", path="spmd",
+                             rows=len(rows)) as qt:
+                out = spmd.spmd_query_phase(executors, body, k_eff,
+                                            extra_filters, rows)
             if out is not None:
                 candidates, decoded_partials, total = out
-                candidates.sort(key=_compare_candidates(sort_specs))
+                with _PhaseTimer(trace, phases, "reduce"):
+                    candidates.sort(key=_compare_candidates(sort_specs))
                 if profiling:
                     profile_shards.append({
                         "id": f"[{executors[0].reader.index_name}][spmd]",
+                        "_query_ns": qt.duration_ns,
                         "searches": [{"query": [{
                             "type": "SpmdQueryPhase",
                             "description": str(body.get("query")),
-                            "time_in_nanos":
-                                time.monotonic_ns() - shard_start,
-                            "breakdown": {"rows": len(rows)},
+                            "time_in_nanos": qt.duration_ns,
+                            "breakdown": {"rows": len(rows),
+                                          "segments": len(rows)},
                         }], "rewrite_time": 0, "collector": []}],
                         "aggregations": [],
                     })
@@ -333,41 +399,51 @@ def execute_search(executors: List, body: Optional[dict],
                 continue                # provably empty: skipped shard
             if task is not None:
                 task.check_cancelled()
-            shard_start = time.monotonic_ns()
             extra = extra_filters[shard_i] if extra_filters else None
-            cands, decoded, shard_total = ex.execute_query_phase(
-                body, k_eff, extra_filter=extra,
-                stats_override=dfs_overrides[shard_i]
-                if dfs_overrides else None)
+            with _PhaseTimer(trace, phases, "query",
+                             shard=shard_i) as qt:
+                cands, decoded, shard_total = ex.execute_query_phase(
+                    body, k_eff, extra_filter=extra,
+                    stats_override=dfs_overrides[shard_i]
+                    if dfs_overrides else None,
+                    trace=qt.span)
+                qt.set_attribute("candidates", len(cands))
             for c in cands:
                 c.shard_i = shard_i
             candidates.extend(cands)
             decoded_partials.extend(decoded)
             total += shard_total
             if profiling:
+                # device-dispatch attribution (compile/dispatch/collect
+                # ns, bytes_to_device, compiled) rides the span the
+                # executor annotated
+                breakdown = {"segments": len(ex.reader.segments)}
+                if qt.span is not None:
+                    breakdown.update(
+                        {k2: v for k2, v in qt.span.attributes.items()
+                         if k2 not in ("shard", "candidates")})
                 profile_shards.append({
                     "id": f"[{ex.reader.index_name}][{shard_i}]",
+                    "_query_ns": qt.duration_ns,
                     "searches": [{"query": [{
                         "type": "TpuQueryPhase",
                         "description": str(body.get("query")),
-                        "time_in_nanos":
-                            time.monotonic_ns() - shard_start,
-                        "breakdown": {
-                            "compile_and_score":
-                                time.monotonic_ns() - shard_start,
-                            "segments": len(ex.reader.segments)},
+                        "time_in_nanos": qt.duration_ns,
+                        "breakdown": breakdown,
                     }], "rewrite_time": 0, "collector": []}],
                     "aggregations": [],
                 })
-        candidates.sort(key=_compare_candidates(sort_specs))
+        with _PhaseTimer(trace, phases, "reduce"):
+            candidates.sort(key=_compare_candidates(sort_specs))
         return candidates, decoded_partials, total
 
     candidates, decoded_partials, total = run_query_phase(k)
     raw_count = len(candidates)
     if after_values is not None:
         cursor_values = after_values
-        filtered = _after_cursor(candidates, sort_specs, cursor_values,
-                                 tiebreak=cursor_tiebreak)
+        with _PhaseTimer(trace, phases, "reduce"):
+            filtered = _after_cursor(candidates, sort_specs, cursor_values,
+                                     tiebreak=cursor_tiebreak)
         # the cursor may reach past the device top-k window: grow k until
         # the page is full or every match is on host (reference avoids this
         # by filtering inside the collector; here the host drives a retry)
@@ -376,36 +452,43 @@ def execute_search(executors: List, body: Optional[dict],
             k = min(max_k, k * 4)
             candidates, decoded_partials, total = run_query_phase(k)
             raw_count = len(candidates)
-            filtered = _after_cursor(candidates, sort_specs, cursor_values,
-                                     tiebreak=cursor_tiebreak)
+            with _PhaseTimer(trace, phases, "reduce"):
+                filtered = _after_cursor(candidates, sort_specs,
+                                         cursor_values,
+                                         tiebreak=cursor_tiebreak)
         candidates = filtered
 
     if body.get("rescore") and score_sorted:
-        candidates = _apply_rescore(executors, body["rescore"], candidates,
-                                    extra_filters)
+        with _PhaseTimer(trace, phases, "reduce", op="rescore"):
+            candidates = _apply_rescore(executors, body["rescore"],
+                                        candidates, extra_filters)
     if collapse_field:
-        candidates = _apply_collapse(candidates, executors, collapse_field)
+        with _PhaseTimer(trace, phases, "reduce", op="collapse"):
+            candidates = _apply_collapse(candidates, executors,
+                                         collapse_field)
 
-    page = candidates[from_:from_ + size]
+    with _PhaseTimer(trace, phases, "reduce", op="page"):
+        page = candidates[from_:from_ + size]
+        max_score = None
+        if wants_score:
+            for c in candidates:
+                if max_score is None or c.score > max_score:
+                    max_score = c.score
 
-    max_score = None
-    if wants_score:
-        for c in candidates:
-            if max_score is None or c.score > max_score:
-                max_score = c.score
-
-    query_node = dsl.parse_query(body.get("query"))
-    from opensearch_tpu.search import fetch as fetch_phase
-    page_inner_specs = fetch_phase.collect_inner_hit_specs(query_node)
-    page_inner_cache: dict = {}
-    hits = []
-    for c in page:
-        ex = executors[c.shard_i]
-        hit = _build_hit(ex, c, body, c.score if wants_score else None,
-                         query_node, sort_specs, score_sorted,
-                         inner_specs=page_inner_specs,
-                         inner_cache=page_inner_cache)
-        hits.append(hit)
+    with _PhaseTimer(trace, phases, "fetch") as ft:
+        query_node = dsl.parse_query(body.get("query"))
+        from opensearch_tpu.search import fetch as fetch_phase
+        page_inner_specs = fetch_phase.collect_inner_hit_specs(query_node)
+        page_inner_cache: dict = {}
+        hits = []
+        for c in page:
+            ex = executors[c.shard_i]
+            hit = _build_hit(ex, c, body, c.score if wants_score else None,
+                             query_node, sort_specs, score_sorted,
+                             inner_specs=page_inner_specs,
+                             inner_cache=page_inner_cache)
+            hits.append(hit)
+        ft.set_attribute("hits", len(hits))
 
     n_shards = total_shards if total_shards is not None else len(executors)
     hits_block: dict = {"max_score": max_score, "hits": hits}
@@ -424,7 +507,7 @@ def execute_search(executors: List, body: Optional[dict],
                           **hits_block}
 
     resp = {
-        "took": int((time.monotonic() - start) * 1000),
+        "took": 0,      # placeholder: set below AFTER agg reduce/suggest
         "timed_out": False,
         "_shards": {"total": n_shards,
                     "successful": n_shards - failed_shards,
@@ -432,14 +515,49 @@ def execute_search(executors: List, body: Optional[dict],
         "hits": hits_block,
     }
     if agg_nodes:
-        aggregations = reduce_aggs(decoded_partials)
-        apply_pipelines(agg_nodes, aggregations)
+        with _PhaseTimer(trace, phases, "reduce", op="aggs"):
+            aggregations = reduce_aggs(decoded_partials)
+            apply_pipelines(agg_nodes, aggregations)
         resp["aggregations"] = aggregations
     if body.get("suggest"):
         from opensearch_tpu.search.suggest import execute_suggest
-        resp["suggest"] = execute_suggest(executors, body["suggest"])
+        with _PhaseTimer(trace, phases, "suggest"):
+            resp["suggest"] = execute_suggest(executors, body["suggest"])
+    # everything between the earlier timers and this point (hits/total
+    # block shaping, the resp literal) is response rendering — attribute
+    # it so the per-phase breakdown accounts for the whole request
+    phases["render"] = phases.get("render", 0) \
+        + (time.perf_counter_ns() - start_ns) - sum(phases.values())
+    took_f = (time.monotonic() - start) * 1000
+    resp["took"] = int(took_f)
+    m = TELEMETRY.metrics
+    m.counter("search.queries").inc()
+    m.histogram("search.took_ms").observe(took_f)
+    for phase_name, ns in phases.items():
+        m.histogram(f"search.phase.{phase_name}_ms").observe(ns / 1e6)
+    if phase_times is not None:
+        phase_times.update(
+            {phase_name: ns / 1e6 for phase_name, ns in phases.items()})
     if profiling:
-        resp["profile"] = {"shards": profile_shards}
+        # per-shard per-phase breakdown: coordinator phases (parse,
+        # can_match, reduce, fetch, render) are shared across shards,
+        # `query` is the shard's own device work — so each shard's phase
+        # sum stays ≤ the request total (and ≈ it for a single shard)
+        total_ns = time.perf_counter_ns() - start_ns
+        for entry in profile_shards:
+            q_ns = entry.pop("_query_ns", 0)
+            entry["searches"][0]["rewrite_time"] = phases.get("parse", 0)
+            entry["phases"] = {
+                "parse": phases.get("parse", 0),
+                "can_match": phases.get("can_match", 0),
+                "query": q_ns,
+                "reduce": phases.get("reduce", 0),
+                "fetch": phases.get("fetch", 0),
+                "render": phases.get("render", 0),
+            }
+        resp["profile"] = {"shards": profile_shards,
+                           "total_ns": total_ns,
+                           "phases_ns": dict(phases)}
     if page:
         last = page[-1]
         resp["_page_cursor"] = {

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import functools
 import json
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -147,6 +148,42 @@ class PinnedReader:
 # ------------------------------------------------------------------ execution
 
 _JIT_CACHE: Dict[Any, Any] = {}
+
+
+# per-THREAD compile accounting for request attribution: the XLA compile
+# happens synchronously on the dispatching thread during the wrapped
+# first call, so a thread-local is the correct request scope here (the
+# explicit-context rule exists for the msearch envelope's B>1 fan-in,
+# which this is not — one query phase runs start-to-finish on one thread)
+_THREAD_COMPILES = threading.local()
+
+
+def _note_compile(ms: float) -> None:
+    from opensearch_tpu.telemetry import TELEMETRY
+    m = TELEMETRY.metrics
+    m.counter("search.xla_cache_miss").inc()
+    m.histogram("search.xla_compile_ms").observe(ms)
+    if getattr(_THREAD_COMPILES, "active", False):
+        _THREAD_COMPILES.count += 1
+        _THREAD_COMPILES.ms += ms
+
+
+def _timed_first_call(fn):
+    """Wrap a freshly jitted group program so its FIRST invocation — where
+    jax traces, lowers and XLA-compiles synchronously before the async
+    execution dispatch — is timed and recorded as a compile event
+    (`search.xla_cache_miss` counter + `search.xla_compile_ms` histogram,
+    plus the current thread's request attribution). Only the miss
+    occurrence gets the wrapper; cache hits return the raw jitted fn, so
+    the steady state pays nothing."""
+
+    def first(*args):
+        t0 = time.perf_counter_ns()
+        out = fn(*args)
+        _note_compile((time.perf_counter_ns() - t0) / 1e6)
+        return out
+
+    return first
 
 # msearch phase accounting (?profile analog for the batch path; read by
 # tools/profile_bench.py): cumulative seconds per phase
@@ -547,8 +584,8 @@ def _agg_envelope_runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta,
             plan, meta, agg_plans, arrays, example_flat, np.float32(0))
         fn = jax.jit(build_batched_agg_query_phase(
             plan, meta, k, layout, treedef, axes, agg_plans))
-        hit = (fn, out_layout, width)
-        _JIT_CACHE[key] = hit
+        _JIT_CACHE[key] = (fn, out_layout, width)
+        hit = (_timed_first_call(fn), out_layout, width)
     return hit
 
 
@@ -596,6 +633,7 @@ def _envelope_runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int,
             fn = jax.jit(build_batched_query_phase(plan, meta, k,
                                                    layout, treedef))
         _JIT_CACHE[key] = fn
+        fn = _timed_first_call(fn)
     return fn
 
 
@@ -607,7 +645,7 @@ def _runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int, sort_mode: st
         return fn
     fn = jax.jit(build_query_phase(plan, meta, k, sort_mode, agg_plans))
     _JIT_CACHE[key] = fn
-    return fn
+    return _timed_first_call(fn)
 
 
 def build_hybrid_query_phase(plans, meta: DeviceSegmentMeta, k: int):
@@ -688,6 +726,7 @@ def _batched_hybrid_runner(plans, meta: DeviceSegmentMeta, k: int,
         fn = jax.jit(build_batched_hybrid_query_phase(plans, meta, k,
                                                       layout, treedef))
         _JIT_CACHE[key] = fn
+        fn = _timed_first_call(fn)
     return fn
 
 
@@ -877,12 +916,14 @@ class SearchExecutor:
 
     def execute_query_phase(self, body: dict, k: int,
                             extra_filter: Optional[dict] = None,
-                            stats_override=None):
+                            stats_override=None, trace=None):
         """Per-shard query phase (SearchService.executeQueryPhase analog):
         returns (candidates, per-segment decoded agg partials, total hits)
         for the coordinator to merge. `k` = from+size requested globally.
         `extra_filter` is an alias filter applied as a non-scoring clause
-        (reference: QueryShardContext filter from AliasFilter).
+        (reference: QueryShardContext filter from AliasFilter). `trace`
+        (a telemetry Span or None) collects device-dispatch attribution:
+        compile/dispatch/collect ns, bytes_to_device, XLA compile events.
 
         size=0 requests are served through the shard request cache
         (IndicesRequestCache analog — indices/request_cache.py); the key
@@ -897,28 +938,34 @@ class SearchExecutor:
         if body.get("search_type") == "dfs_query_then_fetch" \
                 or "_dfs" in body:
             return self._query_phase_uncached(body, k, extra_filter,
-                                              stats_override)
+                                              stats_override, trace)
         if cacheable(body):
             base = cache_key(self.reader.segments, body, k, extra_filter)
             key = ("shard", base) if base is not None else None
             if key is not None:
-                def compute():
-                    cands, decoded, total = self._query_phase_uncached(
-                        body, k, extra_filter, stats_override)
-                    # store candidates as plain tuples: callers mutate
-                    # _Candidate.shard_i, which must not leak between hits
-                    return ([(c.score, c.seg_i, c.ord, c.sort_values)
-                             for c in cands], decoded, total)
-                cts, decoded, total = REQUEST_CACHE.get_or_compute(
-                    key, compute)
-                return ([_Candidate(s, g, o, sv) for s, g, o, sv in cts],
-                        decoded, total)
+                hit = REQUEST_CACHE.get(key)
+                if hit is not REQUEST_CACHE._MISS:
+                    if trace is not None:
+                        trace.set_attribute("request_cache", "hit")
+                    cts, decoded, total = hit
+                    return ([_Candidate(s, g, o, sv)
+                             for s, g, o, sv in cts], decoded, total)
+                if trace is not None:
+                    trace.set_attribute("request_cache", "miss")
+                cands, decoded, total = self._query_phase_uncached(
+                    body, k, extra_filter, stats_override, trace)
+                # store candidates as plain tuples: callers mutate
+                # _Candidate.shard_i, which must not leak between hits
+                REQUEST_CACHE.put(
+                    key, ([(c.score, c.seg_i, c.ord, c.sort_values)
+                           for c in cands], decoded, total))
+                return cands, decoded, total
         return self._query_phase_uncached(body, k, extra_filter,
-                                          stats_override)
+                                          stats_override, trace)
 
     def _query_phase_uncached(self, body: dict, k: int,
                               extra_filter: Optional[dict] = None,
-                              stats_override=None):
+                              stats_override=None, trace=None):
         node = dsl.parse_query(body.get("query"))
         if extra_filter is not None:
             node = dsl.BoolQuery(must=[node],
@@ -956,17 +1003,30 @@ class SearchExecutor:
         # dispatch is async, so device work overlaps; phase 2 collects ALL
         # results in ONE device_get (one transfer round trip total — on a
         # tunneled device the round trip dominates device compute)
+        rec = trace is not None and getattr(trace, "recording", False)
+        if rec:
+            # request-scoped compile attribution via the thread-local
+            # accumulator (_note_compile) — global-counter deltas would
+            # charge this span with CONCURRENT requests' compiles
+            _THREAD_COMPILES.active = True
+            _THREAD_COMPILES.count = 0
+            _THREAD_COMPILES.ms = 0.0
+            plan_compile_ns = dispatch_ns = bytes_to_device = 0
         launched = []
         from opensearch_tpu.indices.query_cache import FilterCacheContext
         for seg_i, (seg, (arrays, meta)) in enumerate(
                 zip(self.reader.segments, self.reader.device)):
             if seg.num_docs == 0:
                 continue
+            if rec:
+                t0 = time.perf_counter_ns()
             compiler.filter_ctx = FilterCacheContext(seg, arrays)
             plan = compiler.compile(node, seg, meta)
             compiler.filter_ctx = None
             agg_plans = compile_aggs(device_agg_nodes, self.reader.mapper, seg,
                                      meta, compiler) if agg_nodes else []
+            if rec:
+                plan_compile_ns += time.perf_counter_ns() - t0
             sort_key = _build_sort_key(arrays, primary)
             fn = _runner(plan.sig(), plan, meta,
                          min(k_fetch, pad_bucket(max(seg.num_docs, 1))),
@@ -975,12 +1035,36 @@ class SearchExecutor:
             flat = plan.flatten_inputs([])
             for ap in agg_plans:
                 ap.flatten_inputs(flat)
+            if rec:
+                bytes_to_device += sum(
+                    int(np.asarray(v).nbytes)
+                    for d in flat for v in d.values())
+                t0 = time.perf_counter_ns()
             flat = jax.tree_util.tree_map(jnp.asarray, flat)
             launched.append((seg_i, seg, agg_plans,
                              fn(arrays, flat, sort_key,
                                 jnp.float32(min_score))))
+            if rec:
+                dispatch_ns += time.perf_counter_ns() - t0
 
-        fetched = jax.device_get([out for _, _, _, out in launched])
+        if rec:
+            try:
+                with trace.child("device_collect", segments=len(launched)):
+                    fetched = jax.device_get(
+                        [out for _, _, _, out in launched])
+                xla_compiles = _THREAD_COMPILES.count
+                trace.set_attribute("plan_compile_ns", plan_compile_ns)
+                trace.set_attribute("device_dispatch_ns", dispatch_ns)
+                trace.set_attribute("bytes_to_device", bytes_to_device)
+                trace.set_attribute("compiled", xla_compiles > 0)
+                if xla_compiles:
+                    trace.set_attribute("xla_compiles", xla_compiles)
+                    trace.set_attribute("compile_ms",
+                                        round(_THREAD_COMPILES.ms, 3))
+            finally:
+                _THREAD_COMPILES.active = False
+        else:
+            fetched = jax.device_get([out for _, _, _, out in launched])
 
         candidates: List[_Candidate] = []
         per_segment_decoded = []
@@ -1104,6 +1188,9 @@ class SearchExecutor:
         _bypass_request_cache: executable warmup replays must reach the
         device even when an identical body was just served (search/warmup
         — a cache hit would compile nothing)."""
+        from opensearch_tpu.telemetry import TELEMETRY
+        TELEMETRY.metrics.counter("msearch.requests").inc()
+        TELEMETRY.metrics.counter("msearch.bodies").inc(len(bodies))
         start = time.monotonic()
         _ph = MSEARCH_PHASES
         _t = time.monotonic()
@@ -1181,6 +1268,8 @@ class SearchExecutor:
             state = self._msearch_prepare(batchable, responses, start)
             state["resp_cache_keys"] = resp_cache_keys
             self._msearch_finish(state, responses, start)
+        TELEMETRY.metrics.histogram("msearch.batch_ms").observe(
+            (time.monotonic() - start) * 1000)
         return {"took": int((time.monotonic() - start) * 1000),
                 "responses": responses}
 

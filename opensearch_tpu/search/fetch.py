@@ -18,6 +18,7 @@ import numpy as np
 from opensearch_tpu.common.errors import IllegalArgumentError
 from opensearch_tpu.index.segment import Segment, smallfloat_byte4_to_int
 from opensearch_tpu.search import dsl
+from opensearch_tpu.telemetry import TELEMETRY
 
 DEFAULT_K1 = 1.2
 DEFAULT_B = 0.75
@@ -162,6 +163,7 @@ def _mark(text: str, spans: List[Tuple[int, int]], pre: str, post: str) -> str:
 
 def build_highlights(source: Optional[dict], hl_body: dict, field_terms,
                      mapper) -> dict:
+    TELEMETRY.metrics.counter("fetch.highlight_hits").inc()
     if not source:
         return {}
     pre = (hl_body.get("pre_tags") or ["<em>"])[0]
@@ -391,6 +393,7 @@ def build_inner_hits(ex, seg_i: int, root_ord: int, nested_nodes,
                      cache: Dict) -> Dict[str, dict]:
     """inner_hits sections for one page hit. `cache` memoizes the per-
     (segment, nested node) child evaluation across the page's hits."""
+    TELEMETRY.metrics.counter("fetch.inner_hits").inc()
     from opensearch_tpu.search.compile import Compiler
     seg = ex.reader.segments[seg_i]
     arrays, meta = ex.reader.device[seg_i]

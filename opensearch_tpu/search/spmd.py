@@ -214,6 +214,8 @@ def spmd_query_phase(executors: List, body: dict, k: int,
     out = _spmd_query_phase_raw(executors, body, k, extra_filters, rows)
     if out is None:
         return None     # host-loop fallback — never cached
+    from opensearch_tpu.telemetry import TELEMETRY
+    TELEMETRY.metrics.counter("search.spmd_queries").inc()
     if key is not None:
         REQUEST_CACHE.put(key, out)
     cts, decoded, total = out

@@ -244,6 +244,12 @@ class WarmupRegistry:
         self.stats_["warmed_entries"] += warmed
         self.stats_["warmup_errors"] += errors
         self.stats_["last_warmup_ms"] = round(took, 2)
+        # mirror into the telemetry registry so _nodes/stats' `telemetry`
+        # section carries warmup replays next to the compile counters
+        from opensearch_tpu.telemetry import TELEMETRY
+        TELEMETRY.metrics.counter("warmup.replays").inc(warmed)
+        TELEMETRY.metrics.counter("warmup.errors").inc(errors)
+        TELEMETRY.metrics.histogram("warmup.replay_ms").observe(took)
         return {"warmed": warmed, "errors": errors,
                 "took_ms": round(took, 2)}
 

@@ -47,25 +47,43 @@ class SearchPipeline:
 
     # ------------------------------------------------------------ execution
 
-    def process_request(self, body: dict, ctx: dict) -> dict:
+    def process_request(self, body: dict, ctx: dict, trace=None) -> dict:
+        """`trace` (a telemetry span or None): each processor runs under
+        its own child span, closed on success and failure alike."""
         ctx.setdefault("request_body", body)
+        rec = trace is not None and getattr(trace, "recording", False)
         for proc in self.request_processors:
+            span = trace.child(
+                f"pipeline.request.{proc.type_name}") if rec else None
             try:
                 body = proc.process_request(body, ctx)
-            except Exception:
+            except Exception as e:
+                if span is not None:
+                    span.end(error=e)
                 if not proc.ignore_failure:
                     raise
+            else:
+                if span is not None:
+                    span.end()
         ctx["request_body"] = body
         return body
 
     def process_response(self, response: dict, ctx: dict,
-                         targets=None) -> dict:
+                         targets=None, trace=None) -> dict:
+        rec = trace is not None and getattr(trace, "recording", False)
         for proc in self.response_processors:
+            span = trace.child(
+                f"pipeline.response.{proc.type_name}") if rec else None
             try:
                 response = proc.process_response(response, ctx, targets)
-            except Exception:
+            except Exception as e:
+                if span is not None:
+                    span.end(error=e)
                 if not proc.ignore_failure:
                     raise
+            else:
+                if span is not None:
+                    span.end()
         return response
 
     def phase_spec(self) -> Optional[dict]:

@@ -22,7 +22,7 @@ from opensearch_tpu.common.errors import OpenSearchTpuError, TaskCancelledError
 class Task:
     __slots__ = ("task_id", "action", "description", "start_time_ms",
                  "cancellable", "cancelled", "reason", "parent_task_id",
-                 "_start_monotonic")
+                 "start_nanos")
 
     def __init__(self, task_id: int, action: str, description: str = "",
                  cancellable: bool = False,
@@ -30,8 +30,11 @@ class Task:
         self.task_id = task_id
         self.action = action
         self.description = description
+        # wall-clock start for display; perf_counter_ns start for the
+        # running-time accounting (Task.java keeps the same split:
+        # startTime vs startTimeNanos)
         self.start_time_ms = int(time.time() * 1000)
-        self._start_monotonic = time.monotonic()
+        self.start_nanos = time.perf_counter_ns()
         self.cancellable = cancellable
         self.cancelled = False
         self.reason: Optional[str] = None
@@ -44,6 +47,9 @@ class Task:
             raise TaskCancelledError(
                 f"task cancelled [{self.reason or 'by user request'}]")
 
+    def running_time_in_nanos(self) -> int:
+        return time.perf_counter_ns() - self.start_nanos
+
     def to_dict(self, node_id: str = "_local") -> dict:
         return {
             "node": node_id,
@@ -52,8 +58,7 @@ class Task:
             "action": self.action,
             "description": self.description,
             "start_time_in_millis": self.start_time_ms,
-            "running_time_in_nanos": int(
-                (time.monotonic() - self._start_monotonic) * 1e9),
+            "running_time_in_nanos": self.running_time_in_nanos(),
             "cancellable": self.cancellable,
             "cancelled": self.cancelled,
             **({"parent_task_id": f"_local:{self.parent_task_id}"}

@@ -1,0 +1,72 @@
+"""Node-wide telemetry: request-scoped tracing + the metrics registry.
+
+The analog of the reference's `libs/telemetry` (TracerFactory +
+MetricsRegistry behind the OTel plugin), reduced to what a single-process
+node needs: one `TELEMETRY` singleton (the same pattern as
+`REQUEST_CACHE` / `WARMUP`) holding
+
+  - `TELEMETRY.tracer`  — request-scoped spans over the search path
+    (rest → parse → can_match → per-shard query/device dispatch →
+    reduce → fetch → pipeline processors), ring-buffered and dumpable
+    via `GET /_telemetry/traces`; OFF by default, a no-op on the hot
+    path until enabled;
+  - `TELEMETRY.metrics` — always-on named counters and fixed-bucket
+    latency histograms surfaced as the `telemetry` section of
+    `GET /_nodes/stats`.
+
+Node wires it from settings (`telemetry.tracing.enabled`,
+`telemetry.tracing.ring_size`, `telemetry.tracing.jsonl`) and the data
+dir (`_state/traces.jsonl`); tests and bench.py drive it directly.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from opensearch_tpu.telemetry.metrics import MetricsRegistry
+from opensearch_tpu.telemetry.tracer import (
+    DEFAULT_RING_SIZE, NOOP_SPAN, Span, Tracer)
+
+__all__ = ["TELEMETRY", "TelemetryService", "Span", "NOOP_SPAN",
+           "MetricsRegistry", "Tracer"]
+
+
+class TelemetryService:
+    """Tracer + metrics under one configuration surface."""
+
+    def __init__(self):
+        self.tracer = Tracer()
+        self.metrics = MetricsRegistry()
+
+    def configure(self, data_path: Optional[str] = None,
+                  enabled: bool = False, jsonl: bool = False,
+                  ring_size: int = DEFAULT_RING_SIZE) -> None:
+        """Bind to a node's settings/data dir. Called from Node.__init__;
+        re-configuration by a later Node in the same process wins (the
+        singleton is process-wide, like WARMUP)."""
+        self.tracer.enabled = bool(enabled)
+        self.tracer.resize(ring_size)
+        self.tracer.jsonl_path = None
+        if jsonl and data_path is not None:
+            state_dir = os.path.join(data_path, "_state")
+            try:
+                os.makedirs(state_dir, exist_ok=True)
+                self.tracer.jsonl_path = os.path.join(state_dir,
+                                                      "traces.jsonl")
+            except OSError:
+                pass
+
+    def enable(self) -> None:
+        self.tracer.enabled = True
+
+    def disable(self) -> None:
+        self.tracer.enabled = False
+
+    def stats(self) -> dict:
+        return {"tracing": self.tracer.stats(),
+                "metrics": self.metrics.to_dict()}
+
+
+# process-wide singleton, like REQUEST_CACHE / QUERY_CACHE / WARMUP
+TELEMETRY = TelemetryService()

@@ -1,0 +1,226 @@
+"""Request-scoped tracing: explicit-context spans over the search path.
+
+Re-design of the reference telemetry tracing layer (libs/telemetry
+TracerFactory + the spans the REST/transport interceptors open). Two
+deliberate departures, both forced by this build's execution model:
+
+- Context is a plain object passed DOWN the call chain (`trace=` params),
+  never a thread-local: the msearch envelope executes B requests inside
+  one device program on one thread, so ambient context would attribute
+  every sub-request's device work to whichever request happened to be
+  "current".
+- Spans time with `time.perf_counter_ns()` and close via context manager
+  (`with span.child("phase"):`), so failure paths — exceptions,
+  backpressure rejections — still close every opened span.
+
+When tracing is disabled (the default), `start_trace` returns a shared
+NOOP span whose every method is a constant-time no-op — the query path
+pays a couple of attribute loads, nothing else.
+
+Completed root spans land in a bounded in-memory ring buffer served by
+`GET /_telemetry/traces` and, when configured with a data dir, are
+appended as JSONL under `_state/traces.jsonl` for offline analysis
+(tools/trace_report.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+DEFAULT_RING_SIZE = 256
+
+
+class Span:
+    """One timed operation. `children` nest; attributes are flat K/V."""
+
+    __slots__ = ("name", "attributes", "children", "start_ns", "end_ns",
+                 "status", "error")
+
+    recording = True
+
+    def __init__(self, name: str, attributes: Optional[dict] = None):
+        self.name = name
+        self.attributes: Dict[str, Any] = dict(attributes) \
+            if attributes else {}
+        self.children: List["Span"] = []
+        self.start_ns = time.perf_counter_ns()
+        self.end_ns: Optional[int] = None
+        self.status = "ok"
+        self.error: Optional[str] = None
+
+    # ------------------------------------------------------------- lifecycle
+
+    def child(self, name: str, **attributes) -> "Span":
+        s = Span(name, attributes)
+        self.children.append(s)
+        return s
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def end(self, status: Optional[str] = None,
+            error: Optional[BaseException] = None) -> None:
+        if self.end_ns is None:
+            self.end_ns = time.perf_counter_ns()
+        if error is not None:
+            self.status = "error"
+            self.error = f"{type(error).__name__}: {error}"
+        if status is not None:
+            self.status = status
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.end(error=exc if exc_type is not None else None)
+        return False
+
+    # --------------------------------------------------------------- reading
+
+    def duration_ns(self) -> int:
+        end = self.end_ns if self.end_ns is not None \
+            else time.perf_counter_ns()
+        return end - self.start_ns
+
+    def to_dict(self) -> dict:
+        out: Dict[str, Any] = {
+            "name": self.name,
+            "duration_ms": round(self.duration_ns() / 1e6, 3),
+            "status": self.status,
+        }
+        if self.error is not None:
+            out["error"] = self.error
+        if self.attributes:
+            out["attributes"] = self.attributes
+        if self.children:
+            out["children"] = [c.to_dict() for c in self.children]
+        return out
+
+
+class _NoopSpan:
+    """Shared constant returned when tracing is off: absorbs the whole
+    Span API in O(1) with no allocation."""
+
+    __slots__ = ()
+    recording = False
+    children: List[Any] = []
+    attributes: Dict[str, Any] = {}
+    status = "ok"
+
+    def child(self, name: str, **attributes) -> "_NoopSpan":
+        return self
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        pass
+
+    def end(self, status=None, error=None) -> None:
+        pass
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+    def duration_ns(self) -> int:
+        return 0
+
+    def to_dict(self) -> dict:
+        return {}
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Tracer:
+    """Node-wide tracer: opens root spans, retains completed traces."""
+
+    def __init__(self, ring_size: int = DEFAULT_RING_SIZE):
+        self.enabled = False
+        self._ring: "deque[dict]" = deque(maxlen=ring_size)
+        self._lock = threading.Lock()
+        # separate lock for file appends: a slow disk must not block
+        # other threads' ring appends
+        self._io_lock = threading.Lock()
+        self.jsonl_path: Optional[str] = None
+        self.started = 0
+        self.finished = 0
+        self.export_errors = 0
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start_trace(self, name: str, force: bool = False, **attributes):
+        """Root span for one request. `force=True` returns a real span
+        even when tracing is disabled (the profile API builds its
+        response from request-scoped spans regardless of node-wide
+        tracing) — forced traces are NOT retained in the ring unless the
+        tracer is enabled."""
+        if not self.enabled and not force:
+            return NOOP_SPAN
+        if self.enabled and not force:
+            # forced (profile-only) spans are request-local and never
+            # reach finish(); counting them would make started/finished
+            # read as leaked spans
+            self.started += 1
+        return Span(name, attributes)
+
+    def finish(self, span) -> None:
+        """Close a root span and retain it (ring + optional JSONL).
+        Spans for failed/rejected requests close here too — the caller
+        sets status before finishing. No-op for NOOP spans and, when the
+        tracer is disabled, for forced (profile-only) spans."""
+        if not getattr(span, "recording", False):
+            return
+        span.end()
+        # count the finish even if tracing was disabled mid-request: the
+        # span was counted started, and started != finished is this API's
+        # leaked-span signal — it must not fire on a runtime toggle
+        with self._lock:
+            self.finished += 1
+        if not self.enabled:
+            return
+        rec = {"trace": span.to_dict(), "ts_ms": int(time.time() * 1000)}
+        with self._lock:
+            self._ring.append(rec)
+        path = self.jsonl_path
+        if path is not None:
+            line = json.dumps(rec, default=str) + "\n"
+            try:
+                # serialized append: concurrent finishers must not
+                # interleave partial lines (one json line can span
+                # multiple write() syscalls)
+                with self._io_lock, open(path, "a") as f:
+                    f.write(line)
+            except OSError:
+                self.export_errors += 1
+
+    # --------------------------------------------------------------- reading
+
+    def traces(self, size: Optional[int] = None) -> List[dict]:
+        """Most-recent-first dump of the ring buffer."""
+        with self._lock:
+            out = list(self._ring)
+        out.reverse()
+        return out[:size] if size is not None else out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def resize(self, ring_size: int) -> None:
+        with self._lock:
+            self._ring = deque(self._ring, maxlen=max(int(ring_size), 1))
+
+    def stats(self) -> dict:
+        with self._lock:
+            retained = len(self._ring)
+            maxlen = self._ring.maxlen
+        return {"enabled": self.enabled, "started": self.started,
+                "finished": self.finished, "retained": retained,
+                "ring_size": maxlen, "jsonl_path": self.jsonl_path,
+                "export_errors": self.export_errors}

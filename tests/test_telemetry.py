@@ -1,0 +1,387 @@
+"""Telemetry subsystem tests: span lifecycle, metrics registry, the
+Profile API, slow-log level parity, and task running time.
+
+Modeled on the reference suites: TracerFactoryTests /
+DefaultTracerTests (span lifecycle), MetricsRegistryTests,
+QueryProfilerIT / ProfileResponseTests (profile shape), and
+SearchSlowLogTests (level thresholds)."""
+
+import json
+import logging
+
+import pytest
+
+from opensearch_tpu.node import Node
+from opensearch_tpu.telemetry import TELEMETRY
+from opensearch_tpu.telemetry.metrics import Histogram, MetricsRegistry
+from opensearch_tpu.telemetry.tracer import NOOP_SPAN, Span, Tracer
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    n.request("PUT", "/obs", {"mappings": {"properties": {
+        "msg": {"type": "text"}, "n": {"type": "integer"}}}})
+    for i in range(20):
+        n.request("PUT", f"/obs/_doc/{i}", {"msg": f"message {i}", "n": i})
+    n.request("POST", "/obs/_refresh")
+    yield n
+    TELEMETRY.disable()
+    TELEMETRY.tracer.clear()
+
+
+def _assert_closed(span: Span):
+    assert span.end_ns is not None, f"span [{span.name}] never closed"
+    for child in span.children:
+        _assert_closed(child)
+
+
+# ------------------------------------------------------------ span lifecycle
+
+class TestSpanLifecycle:
+    def test_noop_when_disabled(self):
+        TELEMETRY.disable()
+        span = TELEMETRY.tracer.start_trace("x")
+        assert span is NOOP_SPAN
+        assert span.child("y") is NOOP_SPAN
+        with span.child("z") as s:
+            s.set_attribute("a", 1)
+        assert span.duration_ns() == 0
+
+    def test_success_path_closes_every_span(self, node):
+        from opensearch_tpu.search.controller import execute_search
+        executors = [s.executor for s in node.indices.get("obs").shards]
+        root = Span("test-root")
+        execute_search(executors, {"query": {"match": {"msg": "message"}},
+                                   "sort": [{"n": "asc"}]}, trace=root)
+        root.end()
+        _assert_closed(root)
+        names = {c.name for c in root.children}
+        assert {"parse", "can_match", "query", "reduce",
+                "fetch"} <= names
+
+    def test_exception_path_closes_every_span(self, node):
+        from opensearch_tpu.common.errors import OpenSearchTpuError
+        from opensearch_tpu.search.controller import execute_search
+        executors = [s.executor for s in node.indices.get("obs").shards]
+        root = Span("test-root")
+        with pytest.raises((OpenSearchTpuError, ValueError)):
+            # negative size raises INSIDE the parse phase span
+            execute_search(executors, {"query": {"match_all": {}},
+                                       "size": -2}, trace=root)
+        root.end(error=RuntimeError("boom"))
+        _assert_closed(root)
+        parse = [c for c in root.children if c.name == "parse"]
+        assert parse and parse[0].status == "error"
+
+    def test_rest_search_records_trace(self, node):
+        TELEMETRY.enable()
+        TELEMETRY.tracer.clear()
+        node.request("POST", "/obs/_search",
+                     {"query": {"match": {"msg": "message"}}})
+        traces = TELEMETRY.tracer.traces()
+        assert len(traces) == 1
+        root = traces[0]["trace"]
+        assert root["name"] == "rest.search"
+        assert root["status"] == "ok"
+        assert root["duration_ms"] >= 0
+
+    def test_rest_error_closes_root_with_error(self, node):
+        TELEMETRY.enable()
+        TELEMETRY.tracer.clear()
+        res = node.request("POST", "/obs/_search",
+                           {"query": {"match_all": {}}, "bogus_key": 1})
+        assert res["_status"] == 400
+        traces = TELEMETRY.tracer.traces()
+        assert len(traces) == 1
+        assert traces[0]["trace"]["status"] == "error"
+
+    def test_backpressure_rejection_closes_root(self, node):
+        TELEMETRY.enable()
+        TELEMETRY.tracer.clear()
+        node.search_backpressure.max_concurrent = 0
+        try:
+            res = node.request("POST", "/obs/_search",
+                               {"query": {"match_all": {}}})
+            assert res["_status"] == 429
+        finally:
+            node.search_backpressure.max_concurrent = 100
+        traces = TELEMETRY.tracer.traces()
+        assert len(traces) == 1
+        assert traces[0]["trace"]["status"] == "rejected"
+        rej = TELEMETRY.metrics.counter(
+            "search.backpressure_rejections").value
+        assert rej >= 1
+
+    def test_msearch_one_root_span_per_subrequest(self, node):
+        TELEMETRY.enable()
+        TELEMETRY.tracer.clear()
+        lines = []
+        for term in ("message", "0", "1"):
+            lines.append(json.dumps({"index": "obs"}))
+            lines.append(json.dumps({"query": {"match": {"msg": term}}}))
+        node.handle("POST", "/_msearch", body="\n".join(lines) + "\n")
+        traces = TELEMETRY.tracer.traces()
+        assert len(traces) == 3
+        assert all(t["trace"]["name"] == "rest.search" for t in traces)
+
+    def test_trace_ring_bounded(self):
+        tracer = Tracer(ring_size=4)
+        tracer.enabled = True
+        for i in range(10):
+            tracer.finish(tracer.start_trace(f"t{i}"))
+        assert len(tracer.traces()) == 4
+        # most recent first
+        assert tracer.traces()[0]["trace"]["name"] == "t9"
+
+
+# ------------------------------------------------------------------- metrics
+
+class TestMetrics:
+    def test_counter_and_histogram(self):
+        reg = MetricsRegistry()
+        reg.counter("c").inc()
+        reg.counter("c").inc(2)
+        assert reg.counter("c").value == 3
+        h = reg.histogram("h")
+        for v in (0.2, 0.3, 4.0, 90.0):
+            h.observe(v)
+        d = h.to_dict()
+        assert d["count"] == 4
+        assert d["min_ms"] == 0.2 and d["max_ms"] == 90.0
+        assert 0 < d["p50_ms"] <= 5.0
+        assert d["p99_ms"] <= 100.0
+
+    def test_histogram_overflow_bucket(self):
+        h = Histogram("x", buckets=(1.0, 10.0))
+        h.observe(500.0)
+        assert h.to_dict()["buckets"]["le_inf"] == 1
+        assert h.percentile(0.99) == 500.0
+
+    def test_reset_preserves_instances(self):
+        reg = MetricsRegistry()
+        c = reg.counter("keep")
+        c.inc(5)
+        reg.reset()
+        assert c.value == 0
+        c.inc()
+        assert reg.counter("keep").value == 1
+
+    def test_nodes_stats_telemetry_section(self, node):
+        node.request("POST", "/obs/_search",
+                     {"query": {"match": {"msg": "message"}}})
+        stats = node.request("GET", "/_nodes/stats")
+        entry = list(stats["nodes"].values())[0]
+        tel = entry["telemetry"]
+        assert tel["tracing"]["enabled"] is False
+        counters = tel["metrics"]["counters"]
+        assert counters.get("rest.search_requests", 0) >= 1
+        assert "request_cache.hits" in counters
+        hists = tel["metrics"]["histograms"]
+        assert hists["rest.search_ms"]["count"] >= 1
+
+    def test_xla_compile_metrics_recorded(self, node):
+        # the fixture's indexing + searches above already compiled at
+        # least one executable in this process
+        node.request("POST", "/obs/_search",
+                     {"query": {"match": {"msg": "message"}}})
+        assert TELEMETRY.metrics.counter("search.xla_cache_miss").value >= 1
+        assert TELEMETRY.metrics.histogram(
+            "search.xla_compile_ms").count >= 1
+
+    def test_node_setting_enables_tracing(self):
+        from opensearch_tpu.common.errors import SettingsError
+        try:
+            n = Node(settings={"telemetry.tracing.enabled": "true"})
+            assert TELEMETRY.tracer.enabled
+            # strict boolean parse: a typo fails node start instead of
+            # silently disabling tracing
+            with pytest.raises(SettingsError):
+                Node(settings={"telemetry.tracing.enabled": "ture"})
+        finally:
+            TELEMETRY.disable()
+
+
+# -------------------------------------------------------------- REST surface
+
+class TestTelemetryEndpoints:
+    def test_enable_disable_roundtrip(self, node):
+        assert node.request("POST", "/_telemetry/_enable")["enabled"]
+        assert TELEMETRY.tracer.enabled
+        assert not node.request("POST", "/_telemetry/_disable")["enabled"]
+        assert not TELEMETRY.tracer.enabled
+
+    def test_traces_dump_and_clear(self, node):
+        node.request("POST", "/_telemetry/_enable")
+        TELEMETRY.tracer.clear()
+        node.request("POST", "/obs/_search",
+                     {"query": {"match": {"msg": "message"}}})
+        out = node.request("GET", "/_telemetry/traces")
+        assert out["enabled"] is True
+        assert len(out["traces"]) == 1
+        assert out["traces"][0]["trace"]["name"] == "rest.search"
+        node.request("POST", "/_telemetry/traces/_clear")
+        assert node.request("GET", "/_telemetry/traces")["traces"] == []
+
+    def test_metrics_endpoint(self, node):
+        out = node.request("GET", "/_telemetry/metrics")
+        assert "counters" in out["metrics"]
+
+    def test_jsonl_export(self, tmp_path):
+        TELEMETRY.configure(data_path=str(tmp_path), enabled=True,
+                            jsonl=True)
+        try:
+            tracer = TELEMETRY.tracer
+            root = tracer.start_trace("rest.search", index="x")
+            with root.child("parse"):
+                pass
+            tracer.finish(root)
+            path = tmp_path / "_state" / "traces.jsonl"
+            lines = path.read_text().strip().splitlines()
+            assert len(lines) == 1
+            rec = json.loads(lines[0])
+            assert rec["trace"]["name"] == "rest.search"
+        finally:
+            TELEMETRY.configure()   # back to defaults (disabled, no jsonl)
+
+
+# --------------------------------------------------------------- profile API
+
+class TestProfileAPI:
+    def test_disabled_by_default(self, node):
+        res = node.request("POST", "/obs/_search",
+                           {"query": {"match": {"msg": "message"}}})
+        assert "profile" not in res
+
+    def test_profile_shape_per_shard(self, node):
+        res = node.request("POST", "/obs/_search", {
+            "query": {"match": {"msg": "message"}}, "profile": True})
+        shards = res["profile"]["shards"]
+        assert len(shards) == 1
+        for shard in shards:
+            q = shard["searches"][0]["query"][0]
+            assert q["type"] in ("TpuQueryPhase", "SpmdQueryPhase")
+            assert q["time_in_nanos"] > 0
+            assert q["breakdown"]["segments"] >= 1
+            phases = shard["phases"]
+            assert set(phases) == {"parse", "can_match", "query",
+                                   "reduce", "fetch", "render"}
+            assert all(v >= 0 for v in phases.values())
+
+    def test_profile_device_attribution(self, node):
+        res = node.request("POST", "/obs/_search", {
+            "query": {"match": {"msg": "message"}}, "profile": True})
+        bd = res["profile"]["shards"][0]["searches"][0]["query"][0][
+            "breakdown"]
+        assert "bytes_to_device" in bd
+        assert "compiled" in bd
+        assert bd["device_dispatch_ns"] >= 0
+
+    def test_phase_sum_within_took_and_covers_total(self, node):
+        body = {"query": {"match": {"msg": "message"}}, "profile": True}
+        node.request("POST", "/obs/_search", body)     # warm executables
+        res = node.request("POST", "/obs/_search", body)
+        took_ms = res["took"]
+        profile = res["profile"]
+        total_ns = profile["total_ns"]
+        for shard in profile["shards"]:
+            phase_sum_ns = sum(shard["phases"].values())
+            # ≤ took with 1 ms slack for took's integer floor
+            assert phase_sum_ns <= (took_ms + 1) * 1e6
+            # the breakdown accounts for ≥90% of the request on a warm
+            # query (single shard: coordinator + own query phases)
+            assert phase_sum_ns >= 0.9 * total_ns, \
+                f"phases {shard['phases']} cover " \
+                f"{phase_sum_ns / total_ns:.2%} of {total_ns}ns"
+
+    def test_profile_with_aggs_and_sort(self, node):
+        res = node.request("POST", "/obs/_search", {
+            "query": {"match_all": {}},
+            "sort": [{"n": "desc"}], "size": 5,
+            "aggs": {"mx": {"max": {"field": "n"}}},
+            "profile": True})
+        assert res["_status"] == 200
+        assert res["profile"]["shards"]
+        assert res["aggregations"]["mx"]["value"] == 19.0
+
+
+# ----------------------------------------------------------------- slow log
+
+class TestSlowLogParity:
+    def _search(self, node):
+        node.request("POST", "/obs/_search",
+                     {"query": {"match": {"msg": "message"}}})
+
+    def test_query_info_level(self, node, caplog):
+        node.request("PUT", "/obs/_settings", {"index": {
+            "search.slowlog.threshold.query.info": "0ms",
+            "search.slowlog.threshold.query.warn": "1h"}})
+        logger = "opensearch_tpu.index.search.slowlog.query"
+        with caplog.at_level(logging.INFO, logger=logger):
+            self._search(node)
+        records = [r for r in caplog.records if r.name == logger]
+        assert records and records[0].levelno == logging.INFO
+        assert "took[" in records[0].getMessage()
+
+    def test_fetch_phase_threshold(self, node, caplog):
+        node.request("PUT", "/obs/_settings", {"index": {
+            "search.slowlog.threshold.fetch.warn": "0ms"}})
+        logger = "opensearch_tpu.index.search.slowlog.fetch"
+        with caplog.at_level(logging.WARNING, logger=logger):
+            self._search(node)
+        records = [r for r in caplog.records if r.name == logger]
+        assert records and records[0].levelno == logging.WARNING
+        assert "took[fetch]" in records[0].getMessage()
+
+    def test_trace_level_uses_level_5(self, node, caplog):
+        node.request("PUT", "/obs/_settings", {"index": {
+            "search.slowlog.threshold.query.trace": "0ms"}})
+        logger = "opensearch_tpu.index.search.slowlog.query"
+        with caplog.at_level(5, logger=logger):
+            self._search(node)
+        records = [r for r in caplog.records if r.name == logger]
+        assert records and records[0].levelno == 5
+
+    def test_negative_threshold_disables(self, node, caplog):
+        node.request("PUT", "/obs/_settings", {"index": {
+            "search.slowlog.threshold.query.warn": "-1"}})
+        logger = "opensearch_tpu.index.search.slowlog.query"
+        with caplog.at_level(5, logger=logger):
+            self._search(node)
+        assert not [r for r in caplog.records if r.name == logger]
+
+    def test_most_severe_level_wins(self, node, caplog):
+        node.request("PUT", "/obs/_settings", {"index": {
+            "search.slowlog.threshold.query.warn": "0ms",
+            "search.slowlog.threshold.query.info": "0ms"}})
+        logger = "opensearch_tpu.index.search.slowlog.query"
+        with caplog.at_level(logging.DEBUG, logger=logger):
+            self._search(node)
+        records = [r for r in caplog.records if r.name == logger]
+        assert len(records) == 1
+        assert records[0].levelno == logging.WARNING
+
+
+# -------------------------------------------------------- tasks running time
+
+class TestTaskRunningTime:
+    def test_running_time_from_perf_counter(self):
+        import time
+        from opensearch_tpu.tasks import TaskManager
+        tm = TaskManager()
+        t = tm.register("indices:data/read/search")
+        time.sleep(0.01)
+        nanos = t.running_time_in_nanos()
+        assert nanos >= 10_000_000       # slept 10ms
+        assert t.to_dict()["running_time_in_nanos"] >= nanos
+
+    def test_cat_tasks_running_time_column(self, node):
+        task = node.task_manager.register("indices:data/read/search",
+                                          description="pinned")
+        try:
+            out = node.handle("GET", "/_cat/tasks", params={"v": ""})
+            header, row = out.body.strip().splitlines()[:2]
+            assert "running_time" in header
+            assert row.strip().endswith("ms")
+        finally:
+            node.task_manager.unregister(task)

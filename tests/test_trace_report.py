@@ -1,0 +1,115 @@
+"""Tier-1 smoke test for tools/trace_report.py: the offline per-phase
+latency report over telemetry trace dumps (JSONL export and the
+`GET /_telemetry/traces` response shape)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import trace_report  # noqa: E402
+
+
+def _trace(duration, phases):
+    return {"trace": {
+        "name": "rest.search", "duration_ms": duration, "status": "ok",
+        "children": [{"name": n, "duration_ms": d, "status": "ok"}
+                     for n, d in phases]}, "ts_ms": 1700000000000}
+
+
+@pytest.fixture()
+def jsonl_path(tmp_path):
+    path = tmp_path / "traces.jsonl"
+    with open(path, "w") as f:
+        for i in range(10):
+            f.write(json.dumps(_trace(
+                10.0 + i, [("parse", 0.5), ("query", 8.0 + i),
+                           ("fetch", 1.0)])) + "\n")
+    return str(path)
+
+
+def test_load_jsonl(jsonl_path):
+    traces = trace_report.load_traces(jsonl_path)
+    assert len(traces) == 10
+    assert traces[0]["name"] == "rest.search"
+
+
+def test_load_jsonl_skips_corrupt_lines(tmp_path):
+    """A node killed mid-append leaves a truncated tail line; the valid
+    traces must still parse."""
+    path = tmp_path / "traces.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps(_trace(3.0, [("query", 2.0)])) + "\n")
+        f.write(json.dumps(_trace(4.0, [("query", 3.0)])) + "\n")
+        f.write('{"trace": {"name": "rest.sea')       # truncated
+    traces = trace_report.load_traces(str(path))
+    assert len(traces) == 2
+
+
+def test_load_rest_response_shape(tmp_path):
+    path = tmp_path / "dump.json"
+    path.write_text(json.dumps({
+        "enabled": True,
+        "traces": [_trace(5.0, [("query", 4.0)])]}))
+    traces = trace_report.load_traces(str(path))
+    assert len(traces) == 1
+
+
+def test_phase_rows_stats(jsonl_path):
+    rows = trace_report.phase_rows(trace_report.load_traces(jsonl_path))
+    by_phase = {r["phase"]: r for r in rows}
+    assert by_phase["query"]["count"] == 10
+    assert by_phase["query"]["p50_ms"] >= 8.0
+    assert by_phase["query"]["p99_ms"] >= by_phase["query"]["p50_ms"]
+    assert by_phase["(root)"]["count"] == 10
+    assert 0 < by_phase["fetch"]["pct_of_root"] < 100
+
+    table = trace_report.render_table(rows)
+    assert "p99_ms" in table and "query" in table
+
+
+def test_cli_smoke(jsonl_path):
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(trace_report.__file__),
+                      "trace_report.py"), jsonl_path],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "10 trace(s)" in r.stdout
+    assert "(root)" in r.stdout
+
+
+def test_cli_empty_input(tmp_path):
+    path = tmp_path / "empty.jsonl"
+    path.write_text("")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(trace_report.__file__),
+                      "trace_report.py"), str(path)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1
+    assert "no traces" in r.stdout
+
+
+def test_real_export_roundtrip(tmp_path):
+    """The tracer's actual JSONL export parses through the tool."""
+    from opensearch_tpu.telemetry import TELEMETRY
+    TELEMETRY.configure(data_path=str(tmp_path), enabled=True, jsonl=True)
+    try:
+        tracer = TELEMETRY.tracer
+        root = tracer.start_trace("rest.search", index="t")
+        with root.child("parse"):
+            pass
+        with root.child("query", shard=0):
+            pass
+        tracer.finish(root)
+    finally:
+        TELEMETRY.configure()
+    path = os.path.join(str(tmp_path), "_state", "traces.jsonl")
+    rows = trace_report.phase_rows(trace_report.load_traces(path))
+    assert {r["phase"] for r in rows} == {"parse", "query", "(root)"}
