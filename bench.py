@@ -107,19 +107,31 @@ def _telemetry_summary():
     run was not started with --telemetry)."""
     if not TELEMETRY_ON:
         return None
-    from opensearch_tpu.search.executor import MSEARCH_PHASES
     from opensearch_tpu.telemetry import TELEMETRY
-    hists = TELEMETRY.metrics.to_dict()["histograms"]
+    snap = TELEMETRY.metrics.to_dict()
+    hists = snap["histograms"]
     out = {name: {"count": h["count"], "p50_ms": h["p50_ms"],
                   "p99_ms": h["p99_ms"]}
            for name, h in sorted(hists.items())
            if name.startswith("search.phase.")
            or name in ("search.took_ms", "msearch.batch_ms",
                        "search.xla_compile_ms")}
-    # the envelope path's cumulative per-phase accounting (seconds):
-    # covers runs whose traffic is entirely batched msearch
-    out["msearch_phases_s"] = {k: round(v, 4)
-                               for k, v in MSEARCH_PHASES.items()}
+    # the envelope path's cumulative per-phase accounting (seconds), now
+    # sourced from the always-on msearch.phase.* histograms (PR 5 folded
+    # the old MSEARCH_PHASES module global into the metrics registry)
+    out["msearch_phases_s"] = {
+        name[len("msearch.phase."):-len("_ms")]:
+            round(h["sum_ms"] / 1000, 4)
+        for name, h in sorted(hists.items())
+        if name.startswith("msearch.phase.")}
+    out["template_interning"] = {
+        name: snap["counters"][name]
+        for name in ("msearch.template.bundle_hits",
+                     "msearch.template.bundle_misses",
+                     "msearch.template.fallbacks",
+                     "search.plan_compiles", "search.template_binds",
+                     "search.xla_cache_miss")
+        if name in snap["counters"]}
     return out
 
 

@@ -297,11 +297,16 @@ class MapperService:
         self.dynamic = dynamic
         self.total_fields_limit = total_fields_limit
         self._source_enabled = True
+        # monotonically bumped per merge: keys compiled template skeletons
+        # (search/compile.py compile_interned), whose captured field types
+        # must not survive a mapping change
+        self.version = 0
         if mapping:
             self.merge(mapping)
 
     # ------------------------------------------------------------- mapping
     def merge(self, mapping: dict):
+        self.version += 1
         mapping = mapping.get("mappings", mapping)
         if "dynamic" in mapping:
             self.dynamic = mapping["dynamic"]

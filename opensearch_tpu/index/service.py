@@ -352,8 +352,20 @@ class IndexService:
         self.check_open()
         if self.num_shards == 1:
             return self.shards[0].executor.multi_search(bodies)
-        return {"took": 0,
-                "responses": [self.search(b) for b in bodies]}
+        # multi-shard fallback keeps the same per-item failure contract
+        # as the batched envelope: one malformed body renders an error
+        # item, siblings execute (TransportMultiSearchAction semantics)
+        from opensearch_tpu.search.executor import (
+            _item_error, _item_error_untyped)
+        responses = []
+        for b in bodies:
+            try:
+                responses.append(self.search(b))
+            except OpenSearchTpuError as e:
+                responses.append(_item_error(e))
+            except Exception as e:
+                responses.append(_item_error_untyped(e))
+        return {"took": 0, "responses": responses}
 
     def count(self, body: Optional[dict] = None) -> int:
         self.check_open()

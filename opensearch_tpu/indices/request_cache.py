@@ -71,10 +71,24 @@ REQUEST_CACHE = RequestCache()
 
 
 def cache_key(segments, body: dict, k: int,
-              extra_filter: Optional[dict]) -> Optional[Tuple]:
-    """None = not cacheable (unserializable body)."""
+              extra_filter: Optional[dict],
+              query_key: Optional[Tuple] = None) -> Optional[Tuple]:
+    """None = not cacheable (unserializable body).
+
+    `query_key` — the interned template key for body["query"]
+    (dsl.intern_query's (sig, literals)) — stands in for the query's
+    share of the canonical-JSON dump, so the msearch envelope's cacheable
+    bodies skip most of the per-query json.dumps host cost. Template keys
+    and dumped keys live in disjoint key spaces (the "tpl" tag), so the
+    two paths can't alias each other."""
     try:
-        req = json.dumps(body, sort_keys=True, separators=(",", ":"))
+        if query_key is not None:
+            rest = {k2: v for k2, v in body.items() if k2 != "query"}
+            req: Any = ("tpl", query_key,
+                        json.dumps(rest, sort_keys=True,
+                                   separators=(",", ":")))
+        else:
+            req = json.dumps(body, sort_keys=True, separators=(",", ":"))
         extra = json.dumps(extra_filter, sort_keys=True) \
             if extra_filter is not None else None
     except (TypeError, ValueError):
@@ -106,16 +120,23 @@ def _has_now_date_math(obj) -> bool:
     return False
 
 
-def cacheable(body: dict) -> bool:
+def cacheable(body: dict, query_now_safe: bool = False) -> bool:
     """Default policy mirrors the reference: only size=0 requests (aggs,
     counts) are cached; profile runs always execute. Bodies whose query or
     agg tree contains now-relative date math never cache — "now" resolves
     per evaluation, so a cached result would keep serving the resolution
     instant of the first request (IndicesService.canCache's
-    Rewriteable.isCacheable gate in the reference)."""
+    Rewriteable.isCacheable gate in the reference).
+
+    query_now_safe=True skips the query-tree walk: the caller already
+    interned the query (dsl.intern_query), which rejects now-relative
+    range bounds — the one place date math is time-dependent in the
+    shapes it admits — so re-walking the tree per query is pure host
+    cost on the warm msearch path."""
     return (body.get("size", 10) == 0
             and not body.get("profile")
             and body.get("search_after") is None
-            and not _has_now_date_math(body.get("query"))
+            and (query_now_safe
+                 or not _has_now_date_math(body.get("query")))
             and not _has_now_date_math(body.get("aggs")
                                        or body.get("aggregations")))

@@ -33,6 +33,69 @@ from opensearch_tpu.telemetry import TELEMETRY
 # module-level handle: Compiler.compile runs per (query, segment) on the
 # msearch hot path — one cached counter beats a registry lookup per call
 _PLAN_COMPILES = TELEMETRY.metrics.counter("search.plan_compiles")
+_TEMPLATE_BINDS = TELEMETRY.metrics.counter("search.template_binds")
+_MEMO_ROTATIONS = TELEMETRY.metrics.counter("search.memo_rotations")
+
+
+class RotatingMemo:
+    """Two-generation bounded memo replacing the clear-at-limit wipe.
+
+    Inserts land in the NEW generation; when NEW reaches the limit it
+    becomes OLD and a fresh NEW starts (the previous OLD generation drops
+    wholesale). Hits in OLD promote back to NEW. Steady mixed traffic
+    therefore never recompiles its whole working set at once — at worst
+    the coldest generation ages out — where the old `clear()` at 8192
+    entries caused a full recompile stampede on the next batch.
+
+    Entries carrying large host arrays (interned plan bundles hold
+    flattened device inputs) pass their size via `set(..., cost=nbytes)`:
+    the generation also rotates when its accumulated cost crosses
+    `byte_limit`, so a stream of distinct high-cardinality filters is
+    bounded in bytes, not just entry count."""
+
+    __slots__ = ("limit", "byte_limit", "_new", "_old", "_new_cost")
+    _MISS = object()
+
+    def __init__(self, limit: int = 8192, byte_limit: int = 256 << 20):
+        self.limit = limit
+        self.byte_limit = byte_limit
+        self._new: Dict[Any, Any] = {}
+        self._old: Dict[Any, Any] = {}
+        self._new_cost = 0
+
+    def get(self, key, default=None):
+        v = self._new.get(key, self._MISS)
+        if v is not self._MISS:
+            return v
+        v = self._old.get(key, self._MISS)
+        if v is not self._MISS:
+            self[key] = v          # promote (may rotate; cost re-counted
+            return v               # as 0 — an acceptable undercount)
+        return default
+
+    def set(self, key, value, cost: int = 0) -> None:
+        new = self._new
+        new[key] = value
+        self._new_cost += cost
+        if len(new) >= self.limit or self._new_cost >= self.byte_limit:
+            self._old = new
+            self._new = {}
+            self._new_cost = 0
+            _MEMO_ROTATIONS.inc()
+
+    def __setitem__(self, key, value) -> None:
+        self.set(key, value)
+
+    def __contains__(self, key) -> bool:
+        return key in self._new or key in self._old
+
+    def __len__(self) -> int:
+        return len(self._new) + len(self._old)
+
+    def clear(self) -> None:
+        self._new = {}
+        self._old = {}
+        self._new_cost = 0
 
 DEFAULT_K1 = 1.2
 DEFAULT_B = 0.75
@@ -96,10 +159,11 @@ class ShardStats:
         # a ShardStats bound to a segment list may cache term statistics
         # for its lifetime (Lucene's per-reader TermStates caching)
         self._idf: Dict[Tuple[str, str], float] = {}
-        # per-reader memo shared by compilers: analyzed query terms and
-        # compiled text-clause plans (the per-(reader, query) Weight cache
-        # analog — ContextIndexSearcher/QueryCache keep Weights per reader)
-        self.memo: Dict[Any, Any] = {}
+        # per-reader memo shared by compilers: analyzed query terms,
+        # compiled text-clause plans, template skeletons and interned
+        # plan bundles (the per-(reader, query) Weight cache analog —
+        # ContextIndexSearcher/QueryCache keep Weights per reader)
+        self.memo = RotatingMemo()
         for seg in segments:
             for fname, st in seg.field_stats.items():
                 dc, ttf = self._field.get(fname, (0, 0))
@@ -143,7 +207,7 @@ class StaticStats:
         self._local = local
         self._fields = field_stats
         self._term_df = term_df
-        self.memo: Dict[Any, Any] = {}       # per-request (never shared)
+        self.memo = RotatingMemo()           # per-request (never shared)
 
     def field_stats(self, field: str) -> Tuple[int, int]:
         got = self._fields.get(field)
@@ -261,6 +325,20 @@ def merge_dfs_stats(parts):
 
 MATCH_NONE = Plan("match_none")
 
+
+class _SkeletonUnsupported(Exception):
+    """Internal: a template sig node the skeleton binder can't handle."""
+
+
+# memoized marker for templates a segment can't skeleton-bind
+_NO_SKELETON = object()
+
+
+def _slot(cursor: list) -> int:
+    i = cursor[0]
+    cursor[0] += 1
+    return i
+
 # plugin-registered compilers for new QueryNode classes:
 # class -> fn(compiler, node, seg, meta) -> Plan (SearchPlugin analog)
 PLUGIN_COMPILERS: Dict[type, Any] = {}
@@ -296,6 +374,163 @@ class Compiler:
             raise QueryShardError(f"query type [{type(node).__name__}] "
                                   f"is not supported")
         return method(node, seg, meta)
+
+    # ------------------------------------------------- template skeletons
+    def compile_interned(self, tpl, seg: Segment,
+                         meta: DeviceSegmentMeta) -> Optional[Plan]:
+        """The (template, segment) plan-skeleton cache: a query TEMPLATE
+        (dsl.intern_query's structural signature) builds ONE binder per
+        segment that maps a literals tuple straight to a Plan — no DSL
+        node construction, no parse validation, no per-clause compile()
+        dispatch. Leaf binders route the per-query literals (analyzed
+        term ids + idf weights via the memoized _text_clause, range
+        bounds, boosts) through the same memoized helpers the generic
+        compiler uses, so the resulting plans are IDENTICAL to the
+        parse_query path's. Skeletons invalidate with the segment list
+        (ShardStats rebuild), a mapping change (mapper.version) or memo
+        rotation. Returns None when the template holds a shape this
+        binder can't skeleton-bind (caller falls back to parse+compile)."""
+        key = ("skel", seg.uid, getattr(self.mapper, "version", 0),
+               tpl.sig)
+        binder = self.stats.memo.get(key)
+        if binder is None:
+            try:
+                binder = self._build_binder(tpl.sig, seg, meta, [0])
+            except _SkeletonUnsupported:
+                binder = _NO_SKELETON
+            self.stats.memo[key] = binder
+        if binder is _NO_SKELETON:
+            return None
+        _TEMPLATE_BINDS.inc()
+        return binder(self, tpl.literals)
+
+    def _build_binder(self, sig: tuple, seg: Segment,
+                      meta: DeviceSegmentMeta, cursor: list):
+        """Recursive skeleton builder: resolves everything literal-
+        independent ONCE (field types, operator/minimum_should_match
+        arithmetic, child structure) and returns a closure
+        binder(compiler, literals) -> Plan. `cursor` assigns literal
+        slots in the same walk order dsl._intern_node appended them."""
+        from opensearch_tpu.search.dsl import unlit
+        kind = sig[0]
+
+        if kind == "match_all":
+            b = _slot(cursor)
+            return lambda c, l: _match_all(float(l[b]))
+
+        if kind == "match_none":
+            return lambda c, l: MATCH_NONE
+
+        if kind == "match":
+            _, field, operator, msm, analyzer = sig
+            q, b = _slot(cursor), _slot(cursor)
+            ft = self.mapper.get_field(field)
+            if ft is None:
+                return lambda c, l: MATCH_NONE
+            if ft.is_numeric or ft.is_date or ft.is_bool or ft.is_ip:
+                return lambda c, l: c._numeric_term(
+                    seg, field, ft, [unlit(l[q])], float(l[b]))
+            and_op = operator == "and"
+
+            def bind_match(c, l):
+                terms = c._analyze_query_terms(ft, unlit(l[q]), analyzer)
+                if not terms:
+                    return MATCH_NONE
+                boost = float(l[b])
+                weighted, n_distinct = c._weighted(field, terms, boost)
+                min_hits = n_distinct if and_op else \
+                    max(1, parse_minimum_should_match(msm, n_distinct))
+                return c._text_clause(seg, meta, field, weighted, min_hits,
+                                      boost, constant=False)
+            return bind_match
+
+        if kind == "term":
+            _, field = sig
+            v, b = _slot(cursor), _slot(cursor)
+            ft = self.mapper.get_field(field)
+            if ft is None:
+                return lambda c, l: MATCH_NONE
+            if ft.is_range:
+                # containment rewrites into a bool over the hidden bound
+                # columns — the generic compiler owns that recursion
+                return lambda c, l: c.compile(dsl.TermQuery(
+                    field=field, value=unlit(l[v]), boost=float(l[b])),
+                    seg, meta)
+            if ft.is_numeric or ft.is_date:
+                return lambda c, l: c._numeric_term(
+                    seg, field, ft, [unlit(l[v])], float(l[b]))
+            is_bool = ft.is_bool
+
+            def bind_term(c, l):
+                value = unlit(l[v])
+                value = ("true" if value in (True, "true") else "false") \
+                    if is_bool else str(value)
+                boost = float(l[b])
+                weighted, _n = c._weighted(field, [value], boost)
+                return c._text_clause(seg, meta, field, weighted, 1, boost,
+                                      constant=False)
+            return bind_term
+
+        if kind == "terms":
+            _, field = sig
+            vs, b = _slot(cursor), _slot(cursor)
+            ft = self.mapper.get_field(field)
+            if ft is None:
+                return lambda c, l: MATCH_NONE
+            if ft.is_numeric or ft.is_date:
+                return lambda c, l: c._numeric_term(
+                    seg, field, ft, [unlit(x) for x in l[vs]], float(l[b]))
+            is_bool = ft.is_bool
+
+            def bind_terms(c, l):
+                values = [("true" if unlit(x) in (True, "true") else
+                           "false") if is_bool else str(unlit(x))
+                          for x in l[vs]]
+                weighted = [(x, 1.0) for x in dict.fromkeys(values)]
+                return c._text_clause(seg, meta, field, weighted, 1,
+                                      float(l[b]), constant=True)
+            return bind_terms
+
+        if kind == "range":
+            _, field, fmt, tz = sig
+            g0, g1 = _slot(cursor), _slot(cursor)
+            g2, g3 = _slot(cursor), _slot(cursor)
+            b = _slot(cursor)
+            return lambda c, l: c._c_RangeQuery(dsl.RangeQuery(
+                field=field, gte=unlit(l[g0]), gt=unlit(l[g1]),
+                lte=unlit(l[g2]), lt=unlit(l[g3]), fmt=fmt, time_zone=tz,
+                boost=float(l[b])), seg, meta)
+
+        if kind == "exists":
+            _, field = sig
+            b = _slot(cursor)
+            return lambda c, l: c._c_ExistsQuery(
+                dsl.ExistsQuery(field=field, boost=float(l[b])), seg, meta)
+
+        if kind == "bool":
+            _, sections, msm_spec = sig
+            child_binders = [
+                [self._build_binder(s, seg, meta, cursor) for s in sec]
+                for sec in sections]
+            b = _slot(cursor)
+            n_should = len(sections[2])
+            # clause counts are structural, so minimum_should_match
+            # resolves once at skeleton build (same arithmetic as
+            # _c_BoolQuery)
+            if msm_spec is not None:
+                msm = parse_minimum_should_match(msm_spec, n_should)
+            elif n_should and not (sections[0] or sections[1]):
+                msm = 1
+            else:
+                msm = 0
+
+            def bind_bool(c, l):
+                parts = [[cb(c, l) for cb in sec] for sec in child_binders]
+                return c._bool_plan(parts[0], parts[1], parts[2],
+                                    parts[3], msm, float(l[b]))
+            return bind_bool
+
+        raise _SkeletonUnsupported(kind)
 
     # ------------------------------------------------------- text leaves
     def _text_clause(self, seg: Segment, meta: DeviceSegmentMeta, field: str,
@@ -346,9 +581,7 @@ class Compiler:
         # to window its exact segment-sum (executor.py)
         plan = Plan("text", static=(bool(constant), len(weighted_terms)),
                     inputs=inputs)
-        if len(self.stats.memo) > 8192:     # bound the per-reader memo
-            self.stats.memo.clear()
-        self.stats.memo[memo_key] = plan
+        self.stats.memo[memo_key] = plan    # RotatingMemo bounds itself
         return plan
 
     def _analyze_query_terms(self, ft: MappedFieldType, text: Any,
@@ -360,8 +593,6 @@ class Compiler:
             if cached is None:
                 cached = analyze_query_text(self.mapper, ft, text,
                                             analyzer_override)
-                if len(self.stats.memo) > 8192:   # same bound as the plan
-                    self.stats.memo.clear()       # memo (shared dict)
                 self.stats.memo[key] = cached
             return cached
         return [str(text)]
@@ -692,8 +923,6 @@ class Compiler:
             buckets = np.asarray(
                 [hash_routing(d) % node.max if d is not None else -1
                  for d in seg.doc_ids], dtype=np.int32)
-            if len(self.stats.memo) > 8192:   # shared memo bound
-                self.stats.memo.clear()
             self.stats.memo[key] = buckets
         mask = buckets == int(node.id)
         return self._precomputed_plan(

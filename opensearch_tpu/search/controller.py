@@ -262,7 +262,11 @@ def execute_search(executors: List, body: Optional[dict],
             # registry's (plan-struct, shape-bucket) coverage extends to
             # REST _search singles, not just _msearch
             with trace.child("query", path="envelope"):
-                return executors[0].search(body)
+                # straight into the envelope (search() would re-check
+                # _msearch_batchable); errors raise — the per-item error
+                # objects are an _msearch-only contract
+                return executors[0].multi_search(
+                    [body], _raise_item_errors=True)["responses"][0]
     start = time.monotonic()
     start_ns = time.perf_counter_ns()
     profiling = bool(body.get("profile", False))

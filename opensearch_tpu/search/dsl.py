@@ -1061,3 +1061,216 @@ def parse_minimum_should_match(msm: Any, n_optional: int) -> int:
     except ValueError:
         raise ParsingError(f"Invalid minimum_should_match [{msm}]")
     return max(0, min(result, n_optional))
+
+
+# ----------------------------------------------------- template interning
+#
+# Round-8 msearch-envelope lever (ISSUE 5): the warm B=1024 batch spent
+# ~34 ms re-deriving per-query plans whose STRUCTURE repeats across the
+# batch. intern_query splits a raw query body into a structural signature
+# (the query-tree shape — clause kinds, fields, operators — everything
+# that fixes the compile path) and a literals tuple (query text, term
+# values, range bounds, boosts — the per-query data). The compiler caches
+# a plan-binding skeleton per (signature, segment) and the executor
+# caches fully-compiled plan bundles per (signature, literals), making
+# the envelope's host compile cost O(unique templates), not O(B).
+
+# now-relative date math resolves at compile time: an interned plan would
+# freeze the first request's resolution instant (same family the request
+# cache rejects — indices/request_cache.py)
+_NOW_MATH = re.compile(r"^now([+\-/].*)?$")
+
+_SCALAR_TYPES = (str, int, float, bool)
+
+
+class _NotInternable(Exception):
+    """Internal: this raw query shape takes the parse_query path."""
+
+
+class QueryTemplate:
+    """Structural signature of a raw query body with literals stripped.
+
+    `sig` is a nested hashable tuple of the query-tree shape; `literals`
+    carries the stripped per-query values in deterministic walk order.
+    Non-string scalars are tagged with their type name so 1, 1.0 and True
+    (equal and hash-equal in Python) can't alias each other's plans."""
+
+    __slots__ = ("sig", "literals")
+
+    def __init__(self, sig: tuple, literals: tuple):
+        self.sig = sig
+        self.literals = literals
+
+    @property
+    def key(self):
+        return (self.sig, self.literals)
+
+
+def _lit(v):
+    """Literal wrapper: strings pass through, other scalars are tagged
+    with their type so bool/int/float values with equal hashes stay
+    distinct cache keys (str(True) != str(1) at compile time)."""
+    return v if isinstance(v, str) else (type(v).__name__, v)
+
+
+def unlit(v):
+    """Inverse of _lit (None passes through for optional range bounds)."""
+    if v is None or isinstance(v, str):
+        return v
+    return v[1]
+
+
+def _intern_scalar(v):
+    if not isinstance(v, _SCALAR_TYPES):
+        raise _NotInternable
+    # any now-relative literal declines interning, not just range bounds:
+    # a term/match value against a date(_range) field resolves "now" at
+    # compile time, so an interned plan (and the query_now_safe request
+    # cache shortcut) would freeze the first request's instant — same
+    # deliberate over-rejection as request_cache._has_now_date_math
+    if isinstance(v, str) and _NOW_MATH.match(v):
+        raise _NotInternable
+    return _lit(v)
+
+
+def _intern_node(q: Any, lits: list) -> tuple:
+    if q is None:
+        lits.append(1.0)
+        return ("match_all",)
+    if not isinstance(q, dict) or len(q) != 1:
+        raise _NotInternable
+    name, body = next(iter(q.items()))
+
+    if name == "match_all":
+        body = body or {}
+        if not isinstance(body, dict) or set(body) - {"boost"}:
+            raise _NotInternable
+        lits.append(float(body.get("boost", 1.0)))
+        return ("match_all",)
+
+    if name == "match_none":
+        if body not in (None, {}):
+            raise _NotInternable
+        return ("match_none",)
+
+    if name == "match":
+        if not isinstance(body, dict) or len(body) != 1:
+            raise _NotInternable
+        field, spec = next(iter(body.items()))
+        if not isinstance(field, str):
+            raise _NotInternable
+        if not isinstance(spec, dict):
+            spec = {"query": spec}
+        # fuzziness expands per-term plans — general path
+        if set(spec) - {"query", "operator", "minimum_should_match",
+                        "analyzer", "boost"}:
+            raise _NotInternable
+        msm = spec.get("minimum_should_match")
+        analyzer = spec.get("analyzer")
+        if not isinstance(msm, (str, int, type(None))) or \
+                not isinstance(analyzer, (str, type(None))):
+            raise _NotInternable
+        lits.append(_intern_scalar(spec.get("query")))
+        lits.append(float(spec.get("boost", 1.0)))
+        return ("match", field, str(spec.get("operator", "or")).lower(),
+                msm, analyzer)
+
+    if name == "term":
+        if not isinstance(body, dict) or len(body) != 1:
+            raise _NotInternable
+        field, spec = next(iter(body.items()))
+        if not isinstance(field, str):
+            raise _NotInternable
+        if isinstance(spec, dict):
+            # case_insensitive expands against the segment term dict —
+            # general path
+            if set(spec) - {"value", "boost"}:
+                raise _NotInternable
+            value, boost = spec.get("value"), float(spec.get("boost", 1.0))
+        else:
+            value, boost = spec, 1.0
+        lits.append(_intern_scalar(value))
+        lits.append(boost)
+        return ("term", field)
+
+    if name == "terms":
+        if not isinstance(body, dict):
+            raise _NotInternable
+        body = dict(body)
+        boost = float(body.pop("boost", 1.0))
+        if len(body) != 1:
+            raise _NotInternable
+        field, values = next(iter(body.items()))
+        if not isinstance(field, str) or \
+                not isinstance(values, (list, tuple)):
+            raise _NotInternable
+        lits.append(tuple(_intern_scalar(v) for v in values))
+        lits.append(boost)
+        return ("terms", field)
+
+    if name == "range":
+        if not isinstance(body, dict) or len(body) != 1:
+            raise _NotInternable
+        field, spec = next(iter(body.items()))
+        if not isinstance(field, str) or not isinstance(spec, dict):
+            raise _NotInternable
+        # legacy from/to and range-field relations take the general path
+        if set(spec) - {"gte", "gt", "lte", "lt", "boost", "format",
+                        "time_zone"}:
+            raise _NotInternable
+        for key in ("gte", "gt", "lte", "lt"):
+            v = spec.get(key)
+            if v is None:
+                lits.append(None)
+                continue
+            lits.append(_intern_scalar(v))
+        lits.append(float(spec.get("boost", 1.0)))
+        fmt, tz = spec.get("format"), spec.get("time_zone")
+        if not isinstance(fmt, (str, type(None))) or \
+                not isinstance(tz, (str, type(None))):
+            raise _NotInternable
+        return ("range", field, fmt, tz)
+
+    if name == "exists":
+        if not isinstance(body, dict) or set(body) - {"field", "boost"} \
+                or not isinstance(body.get("field"), str):
+            raise _NotInternable
+        lits.append(float(body.get("boost", 1.0)))
+        return ("exists", body["field"])
+
+    if name == "bool":
+        if not isinstance(body, dict) or set(body) - {
+                "must", "filter", "should", "must_not",
+                "minimum_should_match", "boost"}:
+            raise _NotInternable
+        msm = body.get("minimum_should_match")
+        if not isinstance(msm, (str, int, type(None))):
+            raise _NotInternable
+        sections = []
+        for sec in ("must", "filter", "should", "must_not"):
+            clauses = body.get(sec)
+            if clauses is None:
+                clauses = []
+            elif not isinstance(clauses, list):
+                clauses = [clauses]
+            sections.append(tuple(_intern_node(c, lits) for c in clauses))
+        lits.append(float(body.get("boost", 1.0)))
+        return ("bool", tuple(sections), msm)
+
+    raise _NotInternable
+
+
+def intern_query(q: Any) -> Optional[QueryTemplate]:
+    """Intern a raw query body: QueryTemplate (shape signature + stripped
+    literals) for the clause shapes the msearch envelope admits —
+    bool/match/term/terms/range/exists/match_all — or None when the shape
+    needs the full parser (fuzziness, case_insensitive, spans, joins,
+    now-relative date math, legacy range forms, malformed bodies, ...).
+    The extractor validates nothing beyond shape: a declined body simply
+    takes the parse_query path and surfaces that path's errors."""
+    lits: list = []
+    try:
+        sig = _intern_node(q, lits)
+    except (_NotInternable, TypeError, ValueError):
+        return None
+    return QueryTemplate(sig, tuple(lits))

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import functools
 import json
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -32,7 +33,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from opensearch_tpu.common.errors import IllegalArgumentError, QueryShardError
+from opensearch_tpu.common.errors import (
+    IllegalArgumentError, OpenSearchTpuError, QueryShardError)
 from opensearch_tpu.index.mapper import MapperService
 from opensearch_tpu.index.segment import Segment, pad_bucket
 from opensearch_tpu.ops.bm25 import (
@@ -46,6 +48,7 @@ from opensearch_tpu.search.plan_eval import _eval_plan
 from opensearch_tpu.search.aggs.engine import compile_aggs, eval_aggs
 from opensearch_tpu.search.aggs.parse import parse_aggs
 from opensearch_tpu.search.aggs.reduce import decode_outputs, reduce_aggs
+from opensearch_tpu.telemetry import TELEMETRY
 
 # sort key for eligible docs that lack the sort field: far below any real
 # rank key, far above NEG_INF (which marks ineligible docs) → fetched last
@@ -185,11 +188,129 @@ def _timed_first_call(fn):
 
     return first
 
-# msearch phase accounting (?profile analog for the batch path; read by
-# tools/profile_bench.py): cumulative seconds per phase
-MSEARCH_PHASES: Dict[str, float] = {
-    "parse": 0.0, "compile_group": 0.0, "stack_pack_dispatch": 0.0,
-    "device_get": 0.0, "respond": 0.0}
+# msearch phase accounting (?profile analog for the batch path): per-batch
+# milliseconds land in the always-on telemetry metrics registry as
+# per-phase histograms — visible on _nodes/stats, `bench.py --telemetry`
+# and tools/profile_host.py (replaces the old module-global accumulator)
+MSEARCH_PHASE_NAMES = ("parse", "compile_group", "stack_pack_dispatch",
+                       "device_get", "respond")
+_PHASE_HISTS = {name: TELEMETRY.metrics.histogram(f"msearch.phase.{name}_ms")
+                for name in MSEARCH_PHASE_NAMES}
+
+# query-template interning (ISSUE 5): repeated-structure msearch batches
+# skip parse+compile via the per-reader (template, literals) bundle memo.
+# The env switch exists for A/B parity testing (tests/
+# test_template_interning.py), not as a serving configuration.
+TEMPLATE_INTERNING = os.environ.get(
+    "OPENSEARCH_TPU_DISABLE_INTERNING") != "1"
+_BUNDLE_HITS = TELEMETRY.metrics.counter("msearch.template.bundle_hits")
+_BUNDLE_MISSES = TELEMETRY.metrics.counter("msearch.template.bundle_misses")
+_INTERN_FALLBACKS = TELEMETRY.metrics.counter("msearch.template.fallbacks")
+
+
+def _base_response(took_ms: int, total: int, max_score, hits: list) -> dict:
+    """The msearch envelope's response skeleton — shared by the batched
+    respond path, the match-none short-circuit and the request-cache
+    renderer so all three stay byte-identical."""
+    return {
+        "took": took_ms,
+        "timed_out": False,
+        "_shards": {"total": 1, "successful": 1, "skipped": 0,
+                    "failed": 0},
+        "hits": {"total": {"value": total, "relation": "eq"},
+                 "max_score": max_score, "hits": hits},
+    }
+
+
+def _item_error(e: OpenSearchTpuError) -> dict:
+    """Per-item msearch error object (reference:
+    TransportMultiSearchAction wraps each failed sub-request instead of
+    failing siblings)."""
+    return {"error": e.to_xcontent(), "status": e.status}
+
+
+# a single interned-plan bundle larger than this never enters the memo:
+# its flattened inputs would crowd out a whole generation of normal-sized
+# working-set entries for one outlier query shape
+_BUNDLE_MEMO_MAX_ENTRY_BYTES = 16 << 20
+
+
+def _bundle_nbytes(flats) -> int:
+    """Approximate host bytes retained by a memoized bundle: the flattened
+    per-segment input arrays dominate (plans/signatures are tuples)."""
+    if not flats:
+        return 0
+    return sum(getattr(v, "nbytes", 0) for f in flats if f
+               for d in f for v in d.values())
+
+
+def _item_error_untyped(e: Exception) -> dict:
+    """Per-item wrapper for exceptions with no OpenSearchTpuError typing:
+    reported as the 500-class failure it is (not relabeled 400 — a raw
+    TypeError may just as well be an internal bug as a client error)."""
+    return {"error": {"type": "exception",
+                      "reason": f"{type(e).__name__}: {e}"},
+            "status": 500}
+
+
+def _run_item_isolated(responses, i: int, raise_item_errors: bool,
+                       fn) -> None:
+    """Execute one sub-request's work under the per-item failure contract
+    (reference TransportMultiSearchAction wraps EVERY per-item exception,
+    never the envelope): typed errors render with their own status,
+    untyped ones honestly as a 500-class item; fn's non-None return value
+    becomes the item's response. raise_item_errors (the B=1 _search
+    delegation) propagates instead — error objects are an _msearch-only
+    shape."""
+    try:
+        r = fn()
+        if r is not None:
+            responses[i] = r
+    except OpenSearchTpuError as e:
+        if raise_item_errors:
+            raise
+        responses[i] = _item_error(e)
+    except Exception as e:
+        if raise_item_errors:
+            raise
+        responses[i] = _item_error_untyped(e)
+
+
+_request_cache_mod = None
+
+
+def _request_cache():
+    """Lazily bound indices.request_cache module: the indices package
+    __init__ imports a chain that leads back here (index.shard ->
+    executor), so a top-level import would be circular — and a fresh
+    function-level import per msearch sub-request is pure sys.modules
+    lookup cost on the hot parse loop."""
+    global _request_cache_mod
+    if _request_cache_mod is None:
+        from opensearch_tpu.indices import request_cache
+        _request_cache_mod = request_cache
+    return _request_cache_mod
+
+
+def _req_int(body: dict, key: str, default: int) -> int:
+    try:
+        return int(body.get(key, default))
+    except (TypeError, ValueError):
+        raise IllegalArgumentError(
+            f"Failed to parse int parameter [{key}] with value "
+            f"[{body.get(key)!r}]")
+
+
+def _req_min_score(body: dict):
+    raw = body.get("min_score")
+    if raw is None:
+        return NEG_INF
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        raise IllegalArgumentError(
+            f"Failed to parse float parameter [min_score] with value "
+            f"[{raw!r}]")
 
 
 def build_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
@@ -910,8 +1031,10 @@ class SearchExecutor:
         body = body or {}
         if not _direct and _msearch_batchable(body):
             # single searches share the batched envelope kernel (B=1): one
-            # program, one upload, and bit-identical scores with _msearch
-            return self.multi_search([body])["responses"][0]
+            # program, one upload, and bit-identical scores with _msearch;
+            # errors raise (the per-item error wrapping is _msearch-only)
+            return self.multi_search(
+                [body], _raise_item_errors=True)["responses"][0]
         return execute_search([self], body)
 
     def execute_query_phase(self, body: dict, k: int,
@@ -929,8 +1052,6 @@ class SearchExecutor:
         (IndicesRequestCache analog — indices/request_cache.py); the key
         includes the segment identities, so refreshes/deletes miss."""
         body = body or {}
-        from opensearch_tpu.indices.request_cache import (
-            REQUEST_CACHE, cache_key, cacheable)
         # DFS requests never cache (the reference excludes
         # dfs_query_then_fetch from IndicesRequestCache): the global stats
         # live outside the shard's own segments, so a per-shard key can't
@@ -939,12 +1060,14 @@ class SearchExecutor:
                 or "_dfs" in body:
             return self._query_phase_uncached(body, k, extra_filter,
                                               stats_override, trace)
-        if cacheable(body):
-            base = cache_key(self.reader.segments, body, k, extra_filter)
+        rc = _request_cache()
+        if rc.cacheable(body):
+            base = rc.cache_key(self.reader.segments, body, k,
+                                extra_filter)
             key = ("shard", base) if base is not None else None
             if key is not None:
-                hit = REQUEST_CACHE.get(key)
-                if hit is not REQUEST_CACHE._MISS:
+                hit = rc.REQUEST_CACHE.get(key)
+                if hit is not rc.REQUEST_CACHE._MISS:
                     if trace is not None:
                         trace.set_attribute("request_cache", "hit")
                     cts, decoded, total = hit
@@ -956,7 +1079,7 @@ class SearchExecutor:
                     body, k, extra_filter, stats_override, trace)
                 # store candidates as plain tuples: callers mutate
                 # _Candidate.shard_i, which must not leak between hits
-                REQUEST_CACHE.put(
+                rc.REQUEST_CACHE.put(
                     key, ([(c.score, c.seg_i, c.ord, c.sort_values)
                            for c in cands], decoded, total))
                 return cands, decoded, total
@@ -1179,83 +1302,41 @@ class SearchExecutor:
         return hit
 
     def multi_search(self, bodies: List[dict],
-                     _bypass_request_cache: bool = False) -> dict:
+                     _bypass_request_cache: bool = False,
+                     _raise_item_errors: bool = False) -> dict:
         """_msearch: execute many search bodies, batching same-shaped
         score-sorted queries into single vmapped device programs per segment
         (reference: action/search/TransportMultiSearchAction fans bodies out
         concurrently; here concurrency is a batch axis on the MXU/VPU).
 
+        A malformed sub-request (negative/non-numeric size/from/min_score,
+        unparseable query, too-deep pagination) renders as a PER-ITEM
+        error object — siblings execute normally, matching the
+        reference's per-item failure contract.
+
         _bypass_request_cache: executable warmup replays must reach the
         device even when an identical body was just served (search/warmup
-        — a cache hit would compile nothing)."""
-        from opensearch_tpu.telemetry import TELEMETRY
+        — a cache hit would compile nothing).
+        _raise_item_errors: the B=1 delegation from search() wants the
+        exception, not an error item."""
         TELEMETRY.metrics.counter("msearch.requests").inc()
         TELEMETRY.metrics.counter("msearch.bodies").inc(len(bodies))
         start = time.monotonic()
-        _ph = MSEARCH_PHASES
+        ph = dict.fromkeys(MSEARCH_PHASE_NAMES, 0.0)
         _t = time.monotonic()
         responses: List[Optional[dict]] = [None] * len(bodies)
 
-        from opensearch_tpu.indices.request_cache import (
-            REQUEST_CACHE, cache_key, cacheable)
         resp_cache_keys: Dict[int, Any] = {}
         batchable: List[Tuple[int, dict, Any, int, int, float]] = []
         hybrid_items: List[Tuple[int, dict]] = []
         for i, body in enumerate(bodies):
-            body = body or {}
-            if not _msearch_batchable(body):
-                if _hybrid_msearch_batchable(body):
-                    # hybrid bodies batch through their own envelope:
-                    # one vmapped fused multi-sub-query program per
-                    # (plan-struct, shape) group
-                    hybrid_items.append((i, body))
-                else:
-                    responses[i] = self.search(body, _direct=True)
-                continue
-            if cacheable(body) and not _bypass_request_cache:
-                # shard request cache at QUERY-PHASE granularity: the
-                # cached value is (total, decoded partials, agg nodes) —
-                # live objects the renderers only read — and the response
-                # is rebuilt per hit, so caller mutations of a returned
-                # response can't leak back in (the old design serialized
-                # the whole response to JSON for that guarantee, which
-                # cost a full dumps per MISS on the respond hot path).
-                # A refresh/delete rotates segment uids/live counts out
-                # of the key
-                base = cache_key(self.reader.segments, body, 0, None)
-                if base is not None:
-                    key = ("msearch", base)
-                    hit = REQUEST_CACHE.get(key)
-                    if hit is not REQUEST_CACHE._MISS:
-                        responses[i] = self._render_cached_msearch(
-                            hit, start)
-                        continue
-                    resp_cache_keys[i] = key
-            try:
-                node = dsl.parse_query(body.get("query"))
-            except Exception:
-                # surface the error uniformly via the general path
-                responses[i] = self.search(body, _direct=True)
-                continue
-            size = int(body.get("size", 10))
-            from_ = int(body.get("from", 0))
-            if size < 0 or from_ < 0:
-                raise IllegalArgumentError(
-                    "[from] parameter cannot be negative" if from_ < 0
-                else "[size] parameter cannot be negative")
-            if from_ + size > self.max_result_window:
-                raise IllegalArgumentError(
-                    f"Result window is too large, from + size must be "
-                    f"less than or equal to: [{self.max_result_window}] "
-                    f"but was [{from_ + size}]. See the scroll api for a "
-                    f"more efficient way to request large data sets. This "
-                    f"limit can be set by changing the "
-                    f"[index.max_result_window] index level setting.")
-            min_score = float(body["min_score"]) \
-                if body.get("min_score") is not None else NEG_INF
-            batchable.append((i, body, node, size, from_, min_score))
+            _run_item_isolated(
+                responses, i, _raise_item_errors,
+                lambda: self._msearch_parse_one(
+                    i, body or {}, responses, batchable, hybrid_items,
+                    resp_cache_keys, _bypass_request_cache, start))
 
-        _ph["parse"] += time.monotonic() - _t
+        ph["parse"] += time.monotonic() - _t
         # ONE wave = ONE device_get for the whole batch. (A two-wave
         # pipeline that overlaps host work with device compute was
         # measured: on the tunneled device the second wave's extra
@@ -1263,18 +1344,102 @@ class SearchExecutor:
         # the gain was ~2%. The prepare/finish split is kept for
         # structure, not pipelining.)
         if hybrid_items:
-            self._msearch_hybrid(hybrid_items, responses, start)
+            self._msearch_hybrid(hybrid_items, responses, start,
+                                 _raise_item_errors)
         if batchable:
-            state = self._msearch_prepare(batchable, responses, start)
+            state = self._msearch_prepare(batchable, responses, start, ph,
+                                          _raise_item_errors)
             state["resp_cache_keys"] = resp_cache_keys
-            self._msearch_finish(state, responses, start)
+            self._msearch_finish(state, responses, start, ph)
+        # parse always runs; the wave phases only get a sample when a
+        # batched wave actually executed — otherwise every all-general or
+        # all-hybrid envelope would log spurious 0-ms device_get/respond
+        # samples and drag the telemetry percentiles toward zero
+        _PHASE_HISTS["parse"].observe(ph["parse"] * 1000)
+        if batchable:
+            for name, sec in ph.items():
+                if name != "parse":
+                    _PHASE_HISTS[name].observe(sec * 1000)
         TELEMETRY.metrics.histogram("msearch.batch_ms").observe(
             (time.monotonic() - start) * 1000)
         return {"took": int((time.monotonic() - start) * 1000),
                 "responses": responses}
 
+    def _msearch_parse_one(self, i: int, body: dict, responses, batchable,
+                           hybrid_items, resp_cache_keys,
+                           bypass_request_cache: bool,
+                           start: float) -> None:
+        """One sub-request of the parse loop: route to the general path /
+        hybrid envelope / request cache, or intern + validate it into the
+        batchable list. Raises OpenSearchTpuError for malformed items —
+        multi_search converts that to a per-item error object."""
+        if not _msearch_batchable(body):
+            if _hybrid_msearch_batchable(body):
+                # hybrid bodies batch through their own envelope: one
+                # vmapped fused multi-sub-query program per
+                # (plan-struct, shape) group
+                hybrid_items.append((i, body))
+            else:
+                responses[i] = self.search(body, _direct=True)
+            return
+        # template interning: structural signature + stripped literals
+        # (dsl.intern_query); None = a shape only the full parser handles
+        tpl = dsl.intern_query(body.get("query")) if TEMPLATE_INTERNING \
+            else None
+        rc = _request_cache()
+        if rc.cacheable(body, query_now_safe=tpl is not None) \
+                and not bypass_request_cache:
+            # shard request cache at QUERY-PHASE granularity: the
+            # cached value is (total, decoded partials, agg nodes) —
+            # live objects the renderers only read — and the response
+            # is rebuilt per hit, so caller mutations of a returned
+            # response can't leak back in (the old design serialized
+            # the whole response to JSON for that guarantee, which
+            # cost a full dumps per MISS on the respond hot path).
+            # A refresh/delete rotates segment uids/live counts out
+            # of the key
+            base = rc.cache_key(self.reader.segments, body, 0, None,
+                                query_key=tpl.key if tpl is not None
+                                else None)
+            if base is not None:
+                key = ("msearch", base)
+                hit = rc.REQUEST_CACHE.get(key)
+                if hit is not rc.REQUEST_CACHE._MISS:
+                    responses[i] = self._render_cached_msearch(hit, start)
+                    return
+                resp_cache_keys[i] = key
+        if tpl is None:
+            _INTERN_FALLBACKS.inc()
+            try:
+                node: Any = dsl.parse_query(body.get("query"))
+            except OpenSearchTpuError:
+                raise
+            except Exception:
+                # surface the error uniformly via the general path
+                responses[i] = self.search(body, _direct=True)
+                return
+        else:
+            node = tpl
+        size = _req_int(body, "size", 10)
+        from_ = _req_int(body, "from", 0)
+        if size < 0 or from_ < 0:
+            raise IllegalArgumentError(
+                "[from] parameter cannot be negative" if from_ < 0
+                else "[size] parameter cannot be negative")
+        if from_ + size > self.max_result_window:
+            raise IllegalArgumentError(
+                f"Result window is too large, from + size must be "
+                f"less than or equal to: [{self.max_result_window}] "
+                f"but was [{from_ + size}]. See the scroll api for a "
+                f"more efficient way to request large data sets. This "
+                f"limit can be set by changing the "
+                f"[index.max_result_window] index level setting.")
+        min_score = _req_min_score(body)
+        batchable.append((i, body, node, size, from_, min_score))
+
     def _msearch_hybrid(self, items: List[Tuple[int, dict]], responses,
-                        start: float) -> None:
+                        start: float,
+                        raise_item_errors: bool = False) -> None:
         """Batched hybrid envelope: same-structure hybrid bodies become
         ONE vmapped fused program per (plan-struct, shape, k) group per
         segment — per-query launch cost amortizes exactly like the plain
@@ -1288,6 +1453,7 @@ class SearchExecutor:
         groups: Dict[Any, List[int]] = {}
         for i, body in items:
             try:
+                min_score = _req_min_score(body)
                 node = dsl.parse_query(body.get("query"))
                 n_sub = len(node.queries)
                 _s, _f, k = hyb.validate_hybrid_request(
@@ -1308,12 +1474,19 @@ class SearchExecutor:
                         p.flatten_inputs(flat)
                     plans_per_seg.append(plans)
                     flats_per_seg.append(flat)
-            except Exception:
-                # surface errors through the general path's renderer
-                responses[i] = self.search(body, _direct=True)
+            except OpenSearchTpuError as e:
+                # already a well-typed request error (bad min_score,
+                # invalid hybrid spec): render per item directly
+                if raise_item_errors:
+                    raise
+                responses[i] = _item_error(e)
                 continue
-            min_score = float(body["min_score"]) \
-                if body.get("min_score") is not None else NEG_INF
+            except Exception:
+                # surface errors through the general path's renderer —
+                # per item, so a malformed hybrid body can't fail siblings
+                _run_item_isolated(responses, i, raise_item_errors,
+                                   lambda: self.search(body, _direct=True))
+                continue
             prepared[i] = (body, n_sub, min_score, plans_per_seg,
                            flats_per_seg)
             struct = tuple(
@@ -1371,9 +1544,88 @@ class SearchExecutor:
                 [self], body, [result], hyb.DEFAULT_SPEC, start, n_sub)
 
 
-    def _msearch_prepare(self, batchable, responses, start):
+    def _compile_msearch_bundle(self, compiler: Compiler, stats, tpl,
+                                node, body: dict, agg_spec,
+                                agg_json: Optional[str] = None) -> tuple:
+        """Compile ONE sub-request's per-segment plans + flattened inputs
+        + grouping signatures. When `tpl` (a dsl.QueryTemplate) is given,
+        plans bind through the (template, segment) skeleton cache
+        (Compiler.compile_interned); the returned bundle is what the
+        per-(template, literals) memo stores, so a repeated body skips
+        this function entirely."""
+        from opensearch_tpu.parallel.distributed import plan_struct
+        from opensearch_tpu.search.aggs.parse import PIPELINE_TYPES
+        agg_nodes = parse_aggs(agg_spec)
+        device_agg_nodes = [n for n in agg_nodes
+                            if n.type not in PIPELINE_TYPES]
+        # agg plans are (agg spec, segment)-static — memoized on the
+        # reader stats like compiled text plans, so a dashboard workload
+        # of repeated agg shapes skips the per-query bucket-table
+        # recomputation (the Weight-cache analog)
+        if agg_nodes and agg_json is None:
+            agg_json = json.dumps(agg_spec, sort_keys=True, default=str)
+        plans: List[Optional[Plan]] = []
+        agg_plans_per_seg: List[list] = []
+        for seg, (arrays, meta) in zip(self.reader.segments,
+                                       self.reader.device):
+            if seg.num_docs == 0:
+                plans.append(None)
+                agg_plans_per_seg.append([])
+                continue
+            plan = None
+            if tpl is not None:
+                plan = compiler.compile_interned(tpl, seg, meta)
+            if plan is None:
+                if node is None:
+                    node = dsl.parse_query(body.get("query"))
+                plan = compiler.compile(node, seg, meta)
+            plans.append(plan)
+            if not agg_nodes:
+                agg_plans_per_seg.append([])
+                continue
+            memo_key = ("aggc", seg.uid, agg_json)
+            aplans = stats.memo.get(memo_key)
+            if aplans is None:
+                aplans = compile_aggs(device_agg_nodes, self.reader.mapper,
+                                      seg, meta, compiler)
+                stats.memo[memo_key] = aplans
+            agg_plans_per_seg.append(aplans)
+        all_none = all(p is None or p.kind == "match_none" for p in plans)
+        if all_none:
+            return (plans, None, None, None, None, agg_plans_per_seg,
+                    agg_nodes, True)
+        struct = tuple(plan_struct(p) if p is not None else None
+                       for p in plans)
+        flats: List[Optional[list]] = []
+        for p, aplans in zip(plans, agg_plans_per_seg):
+            if p is None:
+                flats.append(None)
+                continue
+            flat = p.flatten_inputs([])
+            for ap in aplans:
+                ap.flatten_inputs(flat)
+            flats.append(flat)
+        shape_sig = tuple(
+            None if f is None else tuple(
+                (k2, v.shape, v.dtype.num)
+                for d in f for k2, v in d.items())
+            for f in flats)
+        agg_sig = tuple(tuple(ap.sig() for ap in aplans)
+                        for aplans in agg_plans_per_seg) \
+            if agg_nodes else None
+        return (plans, flats, struct, shape_sig, agg_sig,
+                agg_plans_per_seg, agg_nodes, False)
+
+    def _msearch_prepare(self, batchable, responses, start, ph,
+                         raise_item_errors: bool = False):
         """Wave half 1: compile + group + stack + pack + DISPATCH (async).
         Returns the state _msearch_finish consumes.
+
+        Template interning makes this phase O(unique (template, literals)
+        pairs): interned bodies memoize their whole compiled bundle
+        (plans, flattened inputs, grouping signatures) on the reader
+        stats, so a warm repeated batch reduces to one memo lookup per
+        query — zero plan compiles, zero DSL walks.
 
         Grouping is by plan STRUCTURE + per-segment input SHAPES: shapes
         are already power-of-two bucketed by the compiler, so shape-keyed
@@ -1382,17 +1634,7 @@ class SearchExecutor:
         uniform — one packed upload + one device program per group. The
         shape signature uses dtype.num (numpy's dtype.__str__ is slow on
         this path) and relies on deterministic dict insertion order."""
-        _ph = MSEARCH_PHASES
         _t = time.monotonic()
-        from opensearch_tpu.parallel.distributed import plan_struct
-
-        def _flat_shape_sig(flats):
-            return tuple(
-                None if f is None else tuple(
-                    (k2, v.shape, v.dtype.num)
-                    for d in f for k2, v in d.items())
-                for f in flats)
-
         groups: Dict[Any, List[int]] = {}
         compiled: Dict[int, List[Optional[Plan]]] = {}
         flats_by_i: Dict[int, List[Optional[list]]] = {}
@@ -1400,95 +1642,86 @@ class SearchExecutor:
         agg_nodes_by_i: Dict[int, list] = {}      # i -> parsed AggNodes
         stats = self.reader.stats()
         compiler = Compiler(self.reader.mapper, stats)
-        from opensearch_tpu.search.aggs.parse import PIPELINE_TYPES
+        mapper_version = getattr(self.reader.mapper, "version", 0)
+
+        def _general_fallback(i, body):
+            # an agg/query shape the batch program can't express (or a
+            # user error): the general path raises it properly — rendered
+            # per item so one bad body can't fail siblings
+            _run_item_isolated(responses, i, raise_item_errors,
+                               lambda: self.search(body, _direct=True))
+
         for entry in batchable:
             i, body, node, size, from_, min_score = entry
+            tpl = node if isinstance(node, dsl.QueryTemplate) else None
             agg_spec = body.get("aggs") or body.get("aggregations")
-            agg_nodes = parse_aggs(agg_spec)
-            device_agg_nodes = [n for n in agg_nodes
-                                if n.type not in PIPELINE_TYPES]
-            # agg plans are (agg spec, segment)-static — memoized on the
-            # reader stats like compiled text plans, so a dashboard
-            # workload of repeated agg shapes skips the per-query
-            # bucket-table recomputation (the Weight-cache analog)
-            agg_json = (json.dumps(agg_spec, sort_keys=True, default=str)
-                        if agg_nodes else None)
-            plans: List[Optional[Plan]] = []
-            agg_plans_per_seg: List[list] = []
-            try:
-                for seg, (arrays, meta) in zip(self.reader.segments,
-                                               self.reader.device):
-                    if seg.num_docs == 0:
-                        plans.append(None)
-                        agg_plans_per_seg.append([])
-                        continue
-                    plans.append(compiler.compile(node, seg, meta))
-                    if not agg_nodes:
-                        agg_plans_per_seg.append([])
-                        continue
-                    memo_key = ("aggc", seg.uid, agg_json)
-                    aplans = stats.memo.get(memo_key)
-                    if aplans is None:
-                        aplans = compile_aggs(device_agg_nodes,
-                                              self.reader.mapper,
-                                              seg, meta, compiler)
-                        if len(stats.memo) > 8192:
-                            stats.memo.clear()
-                        stats.memo[memo_key] = aplans
-                    agg_plans_per_seg.append(aplans)
-            except Exception:
-                # an agg/query shape the batch program can't express (or a
-                # user error): the general path raises it properly
-                responses[i] = self.search(body, _direct=True)
-                continue
-            compiled[i] = plans
-            if agg_nodes:
-                agg_by_i[i] = agg_plans_per_seg
-                agg_nodes_by_i[i] = agg_nodes
+            bundle = bkey = agg_json = None
+            if tpl is not None:
+                try:
+                    agg_json = (json.dumps(agg_spec, sort_keys=True,
+                                           default=str) if agg_spec
+                                else None)
+                except Exception:
+                    # e.g. mixed-type agg keys breaking sort_keys: the
+                    # general path owns the proper error, per item
+                    _general_fallback(i, body)
+                    continue
+                bkey = ("qenv", mapper_version, tpl.sig, tpl.literals,
+                        agg_json)
+                bundle = stats.memo.get(bkey)
+            if bundle is None:
+                if tpl is not None:
+                    _BUNDLE_MISSES.inc()
+                try:
+                    bundle = self._compile_msearch_bundle(
+                        compiler, stats, tpl,
+                        None if tpl is not None else node, body, agg_spec,
+                        agg_json)
+                except Exception:
+                    _general_fallback(i, body)
+                    continue
+                if bkey is not None:
+                    # bundles hold flattened device inputs — charge their
+                    # bytes against the memo's byte budget, and keep
+                    # outliers (a single huge high-cardinality filter)
+                    # out entirely rather than letting one entry evict a
+                    # whole generation's working set
+                    cost = _bundle_nbytes(bundle[1])
+                    if cost <= _BUNDLE_MEMO_MAX_ENTRY_BYTES:
+                        stats.memo.set(bkey, bundle, cost=cost)
+            else:
+                _BUNDLE_HITS.inc()
+            (plans, flats, struct, shape_sig, agg_sig, agg_plans_per_seg,
+             agg_nodes, all_none) = bundle
             # no tie overfetch needed: per-segment top-k by score with
             # doc-asc tie-break (lax.top_k picks the lowest index) merges
             # to the exact global page for score-sorted queries; size=0
             # (agg/count-only) requests skip hit selection entirely
             k = 0 if from_ + size == 0 else max(from_ + size, 10)
-            if agg_nodes and all(p is None or p.kind == "match_none"
-                                 for p in plans):
-                # empty-match WITH aggs still owes fully-shaped empty agg
-                # structures — the general path builds those
-                responses[i] = self.search(body, _direct=True)
+            if all_none:
+                if agg_nodes:
+                    # empty-match WITH aggs still owes fully-shaped empty
+                    # agg structures — the general path builds those
+                    _general_fallback(i, body)
+                else:
+                    # no term matched any segment: answer host-side, zero
+                    # device work (the can-match pre-filter analog)
+                    responses[i] = _base_response(
+                        int((time.monotonic() - start) * 1000), 0, None,
+                        [])
                 continue
-            if all(p is None or p.kind == "match_none" for p in plans):
-                # no term matched any segment: answer host-side, zero
-                # device work (the can-match pre-filter analog)
-                responses[i] = {
-                    "took": int((time.monotonic() - start) * 1000),
-                    "timed_out": False,
-                    "_shards": {"total": 1, "successful": 1, "skipped": 0,
-                                "failed": 0},
-                    "hits": {"total": {"value": 0, "relation": "eq"},
-                             "max_score": None, "hits": []},
-                }
-                continue
-            struct = tuple(plan_struct(p) if p is not None else None
-                           for p in plans)
-            flats = []
-            for p, aplans in zip(plans, agg_plans_per_seg):
-                if p is None:
-                    flats.append(None)
-                    continue
-                flat = p.flatten_inputs([])
-                for ap in aplans:
-                    ap.flatten_inputs(flat)
-                flats.append(flat)
+            compiled[i] = plans
             flats_by_i[i] = flats
-            agg_sig = tuple(tuple(ap.sig() for ap in aplans)
-                            for aplans in agg_plans_per_seg) \
-                if agg_nodes else None
-            groups.setdefault((struct, agg_sig, _flat_shape_sig(flats),
+            if agg_nodes:
+                agg_by_i[i] = agg_plans_per_seg
+                agg_nodes_by_i[i] = agg_nodes
+            groups.setdefault((struct, agg_sig, shape_sig,
                                min(k, 1 << 16)), []).append(i)
 
         entry_by_i = {e[0]: e for e in batchable}
-        _ph["compile_group"] += time.monotonic() - _t
+        ph["compile_group"] += time.monotonic() - _t
         _t = time.monotonic()
+        from opensearch_tpu.parallel.distributed import plan_struct
         # dispatch every group × segment program without blocking — jax
         # dispatch is async, so device work and tunnel transfers overlap.
         # The batch axis is padded to a power-of-two bucket (dummy rows
@@ -1535,15 +1768,21 @@ class SearchExecutor:
                                           k_seg, layout, treedef)
                     pending.append((idxs, seg_i, k_seg,
                                     fn(arrays, jnp.asarray(buf)), None))
-        _ph["stack_pack_dispatch"] += time.monotonic() - _t
+        ph["stack_pack_dispatch"] += time.monotonic() - _t
         return {"groups": groups, "entry_by_i": entry_by_i,
                 "pending": pending, "agg_by_i": agg_by_i,
                 "agg_nodes_by_i": agg_nodes_by_i}
 
-    def _msearch_finish(self, state, responses, start):
+    def _msearch_finish(self, state, responses, start, ph):
         """Wave half 2: ONE device_get for the wave's outputs (concatenated
-        on device = one transfer round trip), then response building."""
-        _ph = MSEARCH_PHASES
+        on device = one transfer round trip), then COLUMNAR response
+        assembly: per query the hit page is sliced from the fetched
+        [B, k] score/ord arrays and converted once (`.tolist()` — Python
+        floats/ints in bulk instead of a np-scalar cast per hit), doc ids
+        and sources resolve through hoisted per-segment lists, and every
+        response shares the `_base_response` skeleton. Replaces the
+        per-query per-hit `_hit_dict` call chain that dominated the old
+        respond phase."""
         _t = time.monotonic()
         groups, entry_by_i, pending = (state["groups"], state["entry_by_i"],
                                        state["pending"])
@@ -1568,14 +1807,15 @@ class SearchExecutor:
         else:
             fetched = jax.device_get(
                 [packed for _, _, _, packed, _ in pending])
-        _ph["device_get"] += time.monotonic() - _t; _t = time.monotonic()
+        ph["device_get"] += time.monotonic() - _t; _t = time.monotonic()
         for (idxs, seg_i, k_seg, _, out_layout), packed in zip(pending,
                                                                fetched):
             packed = np.asarray(packed)
             scores_b, idx_b, total_b = unpack_batched_result(
                 packed[:, :2 * k_seg + 1], k_seg)
+            totals = total_b.tolist()
             for row, i in enumerate(idxs):
-                per_query_total[i] += int(total_b[row])
+                per_query_total[i] += totals[row]
                 per_query_segs[i].append((seg_i, scores_b[row], idx_b[row]))
                 if out_layout is not None:
                     outs = _decode_agg_row(packed[row, 2 * k_seg + 1:],
@@ -1583,82 +1823,105 @@ class SearchExecutor:
                     per_query_decoded[i].append(
                         decode_outputs(agg_by_i[i][seg_i], outs))
 
+        took_ms = int((time.monotonic() - start) * 1000)
+        segments = self.reader.segments
+        index_name = self.reader.index_name
+        resp_cache_keys = state.get("resp_cache_keys", {})
         for i, seg_results in per_query_segs.items():
-            _, body, _, size, from_, _ = entry_by_i[i]
+            entry = entry_by_i[i]
+            body, size, from_ = entry[1], entry[3], entry[4]
+            page_segs: Optional[list] = None
             if seg_results:
-                all_scores = np.concatenate([s for _, s, _ in seg_results])
-                all_ords = np.concatenate([o for _, _, o in seg_results])
-                all_segs = np.concatenate(
-                    [np.full(len(s), si, np.int32)
-                     for si, s, _ in seg_results])
-                valid = all_scores > NEG_INF
-                all_scores, all_ords, all_segs = (
-                    all_scores[valid], all_ords[valid], all_segs[valid])
                 if len(seg_results) == 1:
                     # the device's top_k is already score-desc with
                     # doc-asc tie-break (candidate lanes are doc-sorted;
-                    # ties pick the lowest lane) — the single-segment page
-                    # is a slice
-                    page = np.arange(from_, min(from_ + size,
-                                                len(all_scores)))
-                    max_score = float(all_scores[0]) \
-                        if len(all_scores) else None
+                    # ties pick the lowest lane) and padding (NEG_INF)
+                    # sorts last — the single-segment page is a slice of
+                    # the valid prefix
+                    one_seg_i, scores, ords = seg_results[0]
+                    n_valid = int((scores > NEG_INF).sum())
+                    hi = min(from_ + size, n_valid)
+                    page_scores = scores[from_:hi].tolist()
+                    page_ords = ords[from_:hi].tolist()
+                    max_score = float(scores[0]) if n_valid else None
                 else:
+                    all_scores = np.concatenate(
+                        [s for _, s, _ in seg_results])
+                    all_ords = np.concatenate(
+                        [o for _, _, o in seg_results])
+                    all_segs = np.concatenate(
+                        [np.full(len(s), si, np.int32)
+                         for si, s, _ in seg_results])
+                    valid = all_scores > NEG_INF
+                    all_scores, all_ords, all_segs = (
+                        all_scores[valid], all_ords[valid],
+                        all_segs[valid])
                     # score desc, seg asc, doc asc — mergeTopDocs order
                     order = np.lexsort((all_ords, all_segs, -all_scores))
                     page = order[from_:from_ + size]
+                    page_scores = all_scores[page].tolist()
+                    page_ords = all_ords[page].tolist()
+                    page_segs = all_segs[page].tolist()
                     max_score = float(all_scores.max()) \
                         if len(all_scores) else None
             else:
-                page = np.array([], dtype=np.int64)
-                all_scores = all_ords = all_segs = np.array([])
+                page_scores = page_ords = []
                 max_score = None
-            hits = [self._hit_dict(int(all_segs[j]), int(all_ords[j]),
-                                   float(all_scores[j]), body)
-                    for j in page]
-            responses[i] = {
-                "took": int((time.monotonic() - start) * 1000),
-                "timed_out": False,
-                "_shards": {"total": 1, "successful": 1, "skipped": 0,
-                            "failed": 0},
-                "hits": {
-                    "total": {"value": per_query_total[i],
-                              "relation": "eq"},
-                    "max_score": max_score,
-                    "hits": hits,
-                },
-            }
+            source_spec = body.get("_source", True)
+            if source_spec is True or source_spec is None:
+                hits = []
+                if page_segs is None:
+                    if page_ords:
+                        seg = segments[one_seg_i]
+                        ids, srcs = seg.doc_ids, seg.sources
+                        for o, s in zip(page_ords, page_scores):
+                            h = {"_index": index_name, "_id": ids[o],
+                                 "_score": s}
+                            src = srcs[o]
+                            if src is not None:
+                                h["_source"] = src
+                            hits.append(h)
+                else:
+                    for g, o, s in zip(page_segs, page_ords, page_scores):
+                        seg = segments[g]
+                        h = {"_index": index_name, "_id": seg.doc_ids[o],
+                             "_score": s}
+                        src = seg.sources[o]
+                        if src is not None:
+                            h["_source"] = src
+                        hits.append(h)
+            else:
+                # filtered _source: the general per-hit fetch path
+                segs_for_page = page_segs if page_segs is not None \
+                    else [one_seg_i] * len(page_ords)
+                hits = [self._hit_dict(g, o, s, body)
+                        for g, o, s in zip(segs_for_page, page_ords,
+                                           page_scores)]
+            responses[i] = _base_response(took_ms, per_query_total[i],
+                                          max_score, hits)
             if i in agg_by_i:
                 from opensearch_tpu.search.aggs.pipeline import \
                     apply_pipelines
                 aggregations = reduce_aggs(per_query_decoded[i])
                 apply_pipelines(agg_nodes_by_i[i], aggregations)
                 responses[i]["aggregations"] = aggregations
-            key = state.get("resp_cache_keys", {}).get(i)
+            key = resp_cache_keys.get(i)
             if key is not None:
                 # cached at query-phase granularity (totals + decoded agg
                 # partials); the response dict handed to the caller is
                 # NOT stored — _render_cached_msearch rebuilds one per hit
-                from opensearch_tpu.indices.request_cache import \
-                    REQUEST_CACHE
-                REQUEST_CACHE.put(
+                _request_cache().REQUEST_CACHE.put(
                     key, (per_query_total[i], per_query_decoded.get(i),
                           agg_nodes_by_i.get(i)))
-        _ph["respond"] += time.monotonic() - _t
+        ph["respond"] += time.monotonic() - _t
 
     def _render_cached_msearch(self, cached, start: float) -> dict:
         """Build a fresh response from a cached (total, decoded partials,
         agg nodes) entry — size=0 only (the cacheable() gate), so there is
         no hits page to rebuild."""
         total, decoded, agg_nodes = cached
-        resp: Dict[str, Any] = {
-            "took": int((time.monotonic() - start) * 1000),
-            "timed_out": False,
-            "_shards": {"total": 1, "successful": 1, "skipped": 0,
-                        "failed": 0},
-            "hits": {"total": {"value": total, "relation": "eq"},
-                     "max_score": None, "hits": []},
-        }
+        resp = _base_response(int((time.monotonic() - start) * 1000),
+                              total, None, [])
         if decoded is not None and agg_nodes is not None:
             from opensearch_tpu.search.aggs.pipeline import apply_pipelines
             aggregations = reduce_aggs(decoded)

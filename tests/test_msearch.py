@@ -44,12 +44,104 @@ def test_msearch_matches_search(executor):
             assert got["aggregations"] == want["aggregations"]
 
 
-def test_msearch_rejects_negative_size(executor):
+def test_msearch_malformed_item_isolated(executor):
+    """Pinned regression (ISSUE 5): a malformed single sub-request renders
+    as a PER-ITEM error object — siblings execute normally, matching the
+    reference TransportMultiSearchAction's per-item failure contract.
+    (Before the fix, one bad body raised out of the parse loop and failed
+    the WHOLE envelope.)"""
+    ok_body = {"query": {"match": {"body": "w00002"}}, "size": 3}
+    bad_bodies = [
+        {"query": {"match_all": {}}, "size": -1},
+        {"query": {"match_all": {}}, "from": -2},
+        {"query": {"match_all": {}}, "size": "not-a-number"},
+        {"query": {"match_all": {}}, "from": "nope"},
+        {"query": {"match": {"body": "w00002"}}, "min_score": "high"},
+        {"query": {"match_all": {}}, "from": 9990, "size": 100},  # window
+        {"query": {"no_such_clause": {}}},
+        # hybrid items take their own envelope — same per-item contract
+        {"query": {"hybrid": {"queries": [{"match_all": {}}]}},
+         "min_score": "high"},
+    ]
+    want = executor.search(ok_body)
+    res = executor.multi_search([bad_bodies[0], ok_body] + bad_bodies[1:])
+    assert len(res["responses"]) == len(bad_bodies) + 1
+    good = res["responses"][1]
+    assert good["hits"]["total"] == want["hits"]["total"]
+    assert [h["_id"] for h in good["hits"]["hits"]] == \
+           [h["_id"] for h in want["hits"]["hits"]]
+    for r in [res["responses"][0]] + res["responses"][2:]:
+        assert "error" in r, r
+        assert "hits" not in r
+        assert r["status"] == 400
+        assert r["error"]["type"] and r["error"]["reason"]
+
+
+def test_msearch_untyped_exception_isolated(executor):
+    """A body whose failure has no OpenSearchTpuError typing (here a raw
+    AttributeError from parse_query on a non-dict clause body) is still
+    isolated per item — reported honestly as a 500-class error object,
+    not relabeled 400, and never failing siblings."""
+    ok_body = {"query": {"match": {"body": "w00002"}}, "size": 3}
+    want = executor.search(ok_body)
+    for bad in ({"query": {"simple_query_string": 3}},
+                {"query": {"bool": {"must": [{"simple_query_string": 3}]}}},
+                {"query": {"hybrid": {"queries": [
+                    {"simple_query_string": 3}]}}}):
+        res = executor.multi_search([bad, ok_body])
+        bad_r, good = res["responses"]
+        assert "error" in bad_r and bad_r["status"] == 500, bad_r
+        assert "hits" not in bad_r
+        assert good["hits"]["total"] == want["hits"]["total"]
+    # mixed-type agg keys break the canonical json.dumps of the interned
+    # bundle key (TypeError from sort_keys) but are perfectly legal on
+    # the general path — the item must fall back and SUCCEED, matching
+    # its single-search twin, instead of failing the envelope
+    odd = {"query": {"match_all": {}}, "size": 0,
+           "aggs": {1: {"terms": {"field": "tag"}},
+                    "a": {"terms": {"field": "tag"}}}}
+    res = executor.multi_search([odd, ok_body])
+    odd_r, good = res["responses"]
+    assert odd_r["aggregations"] == executor.search(odd)["aggregations"]
+    assert good["hits"]["total"] == want["hits"]["total"]
+
+
+def test_msearch_multi_shard_item_isolated():
+    """The multi-shard IndexService.multi_search fallback (per-body
+    general search, no batched envelope) honors the same per-item
+    failure contract as the single-shard path."""
+    from opensearch_tpu.index.service import IndexService
+    svc = IndexService("ms-idx", mapping={"properties": {
+        "body": {"type": "text"}}}, settings={"number_of_shards": 2})
+    try:
+        for i in range(8):
+            svc.index_doc(str(i), {"body": f"hello w{i % 3}"})
+        svc.refresh()
+        ok_body = {"query": {"match": {"body": "w1"}}, "size": 3}
+        want = svc.search(ok_body)
+        res = svc.multi_search([
+            {"query": {"match_all": {}}, "size": -1},       # typed 400
+            ok_body,
+            {"query": {"simple_query_string": 3}},          # untyped 500
+        ])
+        bad400, good, bad500 = res["responses"]
+        assert bad400["status"] == 400 and "error" in bad400
+        assert bad500["status"] == 500 and "error" in bad500
+        assert good["hits"]["total"] == want["hits"]["total"]
+    finally:
+        svc.close()
+
+
+def test_single_search_still_raises(executor):
+    """search() (the B=1 envelope delegation) keeps the raising contract —
+    per-item error objects are an _msearch-only shape."""
     from opensearch_tpu.common.errors import IllegalArgumentError
     with pytest.raises(IllegalArgumentError):
-        executor.multi_search([{"query": {"match_all": {}}, "size": -1}])
+        executor.search({"query": {"match_all": {}}, "size": -1})
     with pytest.raises(IllegalArgumentError):
-        executor.multi_search([{"query": {"match_all": {}}, "from": -2}])
+        executor.search({"query": {"match_all": {}}, "from": -2})
+    with pytest.raises(IllegalArgumentError):
+        executor.search({"query": {"match_all": {}}, "size": "nope"})
 
 
 def test_msearch_min_score_and_from(executor):

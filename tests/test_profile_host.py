@@ -1,0 +1,35 @@
+"""Tier-1 smoke test for tools/profile_host.py (ISSUE 5 CI hook).
+
+Runs the host-cost sweep on a tiny corpus and asserts the interning
+counters move in the right direction: warm batches are served from the
+(template, literals) bundle memo (hits ≈ B × rounds, zero plan compiles,
+zero XLA compiles) and the per-phase histograms actually recorded.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+from profile_host import run_sweep
+
+
+def test_profile_host_sweep_counters_move():
+    rounds = 2
+    results = run_sweep(n_docs=400, vocab=160, batches=(1, 8), rounds=rounds,
+                        quiet=True)
+    assert set(results) == {1, 8}
+    for b, rec in results.items():
+        c = rec["counters"]
+        # warm rounds ran entirely from the bundle memo: every body a hit,
+        # nothing recompiled or re-bound
+        assert c["msearch.template.bundle_hits"] == b * rounds, (b, c)
+        assert c["msearch.template.bundle_misses"] == 0, (b, c)
+        assert c["msearch.template.fallbacks"] == 0, (b, c)
+        assert c["search.plan_compiles"] == 0, (b, c)
+        assert c["search.template_binds"] == 0, (b, c)
+        assert c["search.xla_cache_miss"] == 0, (b, c)
+        # the per-phase histograms observed once per warm batch
+        assert rec["phases"], rec
+        assert rec["warm_ms"] > 0

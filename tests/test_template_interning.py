@@ -1,0 +1,281 @@
+"""Differential parity suite for query-template interning (ISSUE 5).
+
+Three-way contract: the interned-template msearch path, the forced
+per-query parse+compile path (interning disabled), and the pure-Python
+BM25 oracle (tests/reference_impl.RefField) must agree — the first two
+BYTE-identically (modulo `took`), the oracle within float tolerance.
+Also pins the telemetry contract: a repeated identical warm batch runs
+with ZERO plan compiles and ZERO XLA compiles, and the two-generation
+memo rotation never wipes the live working set.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.search import dsl, executor as executor_mod
+from opensearch_tpu.search.compile import RotatingMemo
+from opensearch_tpu.search.executor import SearchExecutor, ShardReader
+from opensearch_tpu.telemetry import TELEMETRY
+from opensearch_tpu.utils.demo import build_shards, query_terms
+
+from reference_impl import RefField
+
+
+@pytest.fixture(scope="module")
+def executor():
+    mapper, segments = build_shards(320, n_shards=2, vocab_size=180,
+                                    avg_len=24, seed=11)
+    # two segments under ONE shard reader: exercises the cross-segment
+    # merge inside the columnar respond path
+    return SearchExecutor(ShardReader(mapper, segments))
+
+
+def _mixed_bodies():
+    """Mixed bool/match/term/range/terms batch: repeated templates with
+    varying literals, exact repeats, a size=0 agg body issued twice
+    (request-cache hit/miss interleave) and a deliberately non-power-of-
+    two batch size (padded-row edge)."""
+    qs = query_terms(6, 180, seed=3, terms_per_query=2)
+    bodies = [{"query": {"match": {"body": q}}, "size": 5} for q in qs]
+    bodies += [
+        {"query": {"match": {"body": qs[0]}}, "size": 5},   # exact repeat
+        {"query": {"bool": {"must": [{"match": {"body": qs[1]}}],
+                            "filter": [{"range": {"views": {"gte": 50}}}]}},
+         "size": 4},
+        {"query": {"bool": {"must": [{"match": {"body": qs[2]}}],
+                            "filter": [{"range": {"views": {"gte": 900}}}]}},
+         "size": 4},
+        {"query": {"term": {"tag": "cat3"}}, "size": 6},
+        {"query": {"terms": {"tag": ["cat1", "cat5"]}}, "size": 6},
+        {"query": {"range": {"views": {"gte": 100, "lt": 5000}}},
+         "size": 3, "from": 2},
+        {"query": {"match": {"body": {"query": qs[3],
+                                      "operator": "and"}}}, "size": 5},
+        {"query": {"match_all": {}}, "size": 3},
+        {"query": {"match": {"body": qs[4]}}, "size": 5, "min_score": 1.0},
+        # size=0 agg body twice: second occurrence is a request-cache hit
+        {"query": {"match_all": {}}, "size": 0,
+         "aggs": {"t": {"terms": {"field": "tag"}}}},
+        {"query": {"match_all": {}}, "size": 0,
+         "aggs": {"t": {"terms": {"field": "tag"}}}},
+    ]
+    assert len(bodies) & (len(bodies) - 1) != 0   # padded-row edge
+    return bodies
+
+
+def _sanitize(resp):
+    resp = json.loads(json.dumps(resp))
+    resp.pop("took", None)
+    return resp
+
+
+def _run(executor, bodies, interning: bool):
+    from opensearch_tpu.indices.request_cache import REQUEST_CACHE
+    REQUEST_CACHE.clear()
+    old = executor_mod.TEMPLATE_INTERNING
+    executor_mod.TEMPLATE_INTERNING = interning
+    try:
+        # twice: cold (compile/bind) + warm (memo + request-cache hits)
+        executor.multi_search([dict(b) for b in bodies])
+        return executor.multi_search([dict(b) for b in bodies])
+    finally:
+        executor_mod.TEMPLATE_INTERNING = old
+
+
+def test_interned_vs_per_query_compile_byte_identical(executor):
+    bodies = _mixed_bodies()
+    with_intern = _run(executor, bodies, True)
+    without = _run(executor, bodies, False)
+    a = [_sanitize(r) for r in with_intern["responses"]]
+    b = [_sanitize(r) for r in without["responses"]]
+    for body, ra, rb in zip(bodies, a, b):
+        assert json.dumps(ra, sort_keys=True) == \
+               json.dumps(rb, sort_keys=True), body
+
+
+def test_interned_matches_general_path(executor):
+    """Same hits/scores as the per-request general path (which re-parses
+    and re-compiles every query through execute_search)."""
+    from opensearch_tpu.indices.request_cache import REQUEST_CACHE
+    bodies = _mixed_bodies()
+    REQUEST_CACHE.clear()
+    multi = executor.multi_search([dict(b) for b in bodies])
+    for body, got in zip(bodies, multi["responses"]):
+        want = executor.search(dict(body), _direct=True)
+        assert got["hits"]["total"] == want["hits"]["total"], body
+        got_h = [(h["_id"], None if h["_score"] is None
+                  else round(h["_score"], 5)) for h in got["hits"]["hits"]]
+        want_h = [(h["_id"], None if h["_score"] is None
+                   else round(h["_score"], 5))
+                  for h in want["hits"]["hits"]]
+        assert got_h == want_h, body
+        if "aggs" in body:
+            assert got["aggregations"] == want["aggregations"]
+
+
+def test_interned_matches_reference_oracle(executor):
+    """BM25 parity vs the pure-Python oracle: shard-level stats over BOTH
+    segments, score-desc / seg-asc / doc-asc merge order."""
+    segs = executor.reader.segments
+    docs, ids = [], []
+    for seg in segs:
+        for ord_ in range(seg.num_docs):
+            src = seg.sources[ord_]
+            docs.append(src["body"].split())
+            ids.append(seg.doc_ids[ord_])
+    ref = RefField(docs)
+    for q in query_terms(5, 180, seed=21, terms_per_query=2):
+        body = {"query": {"match": {"body": q}}, "size": 8}
+        resp = executor.multi_search([body])["responses"][0]
+        expected = ref.match_scores(q.split())
+        order = sorted(range(len(docs)),
+                       key=lambda i: (-expected[i], i))
+        want = [(ids[i], expected[i]) for i in order
+                if expected[i] > 0][:8]
+        got = [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
+        assert [g[0] for g in got] == [w[0] for w in want], q
+        for (gid, gs), (wid, ws) in zip(got, want):
+            assert gs == pytest.approx(ws, rel=1e-4), (q, gid)
+        assert resp["hits"]["total"]["value"] == \
+               int(np.count_nonzero(expected))
+
+
+def test_repeated_warm_batch_zero_compiles(executor):
+    """Acceptance: a repeated identical warm batch shows 0 plan compiles
+    and 0 XLA compiles in the telemetry counters — parse+compile is fully
+    skipped via the (template, literals) bundle memo."""
+    bodies = [{"query": {"match": {"body": q}}, "size": 5}
+              for q in query_terms(7, 180, seed=5, terms_per_query=2)]
+    bodies.append({"query": {"term": {"tag": "cat2"}}, "size": 5})
+    executor.multi_search([dict(b) for b in bodies])   # warm everything
+    counters = TELEMETRY.metrics.to_dict()["counters"]
+    plan0 = counters.get("search.plan_compiles", 0)
+    xla0 = counters.get("search.xla_cache_miss", 0)
+    binds0 = counters.get("search.template_binds", 0)
+    hits0 = counters.get("msearch.template.bundle_hits", 0)
+    executor.multi_search([dict(b) for b in bodies])   # identical repeat
+    counters = TELEMETRY.metrics.to_dict()["counters"]
+    assert counters.get("search.plan_compiles", 0) == plan0
+    assert counters.get("search.xla_cache_miss", 0) == xla0
+    assert counters.get("search.template_binds", 0) == binds0
+    assert counters.get("msearch.template.bundle_hits", 0) == \
+           hits0 + len(bodies)
+
+
+def test_padded_rows_parity(executor):
+    """B=3 pads to the 4-row bucket: padding rows (min_score=+inf) must
+    not leak into any real response."""
+    qs = query_terms(3, 180, seed=9, terms_per_query=2)
+    bodies = [{"query": {"match": {"body": q}}, "size": 4} for q in qs]
+    multi = executor.multi_search(bodies)
+    for body, got in zip(bodies, multi["responses"]):
+        want = executor.search(dict(body), _direct=True)
+        assert got["hits"]["total"] == want["hits"]["total"]
+        assert [h["_id"] for h in got["hits"]["hits"]] == \
+               [h["_id"] for h in want["hits"]["hits"]]
+
+
+# ------------------------------------------------------ template signatures
+
+def test_template_sig_structure_vs_literals():
+    a = dsl.intern_query({"match": {"body": "quick fox"}})
+    b = dsl.intern_query({"match": {"body": "lazy dog"}})
+    assert a is not None and b is not None
+    assert a.sig == b.sig                 # same template ...
+    assert a.literals != b.literals       # ... different data
+    c = dsl.intern_query({"match": {"body": {"query": "quick fox",
+                                             "operator": "and"}}})
+    assert c.sig != a.sig                 # operator is structure
+    d = dsl.intern_query({"match": {"title": "quick fox"}})
+    assert d.sig != a.sig                 # field is structure
+
+
+def test_template_literal_type_disambiguation():
+    one = dsl.intern_query({"term": {"f": 1}})
+    one_f = dsl.intern_query({"term": {"f": 1.0}})
+    one_b = dsl.intern_query({"term": {"f": True}})
+    assert len({one.key, one_f.key, one_b.key}) == 3
+
+
+def test_template_rejects_non_internable_shapes():
+    assert dsl.intern_query(
+        {"range": {"ts": {"gte": "now-1d"}}}) is None      # time-dependent
+    # now-math is time-dependent in ANY literal position, not just range
+    # bounds: a term/match value against a date(_range) field resolves
+    # "now" at compile time, and query_now_safe skips the cacheable walk
+    assert dsl.intern_query({"term": {"period": "now-1d"}}) is None
+    assert dsl.intern_query({"terms": {"period": ["a", "now/d"]}}) is None
+    assert dsl.intern_query({"match": {"body": "now"}}) is None
+    assert dsl.intern_query({"bool": {"filter": [
+        {"term": {"period": "now+2h"}}]}}) is None
+    # ... but ordinary words that merely start with "now" intern fine
+    assert dsl.intern_query({"match": {"body": "nowhere"}}) is not None
+    assert dsl.intern_query(
+        {"match": {"body": {"query": "x", "fuzziness": "AUTO"}}}) is None
+    assert dsl.intern_query(
+        {"term": {"f": {"value": "x", "case_insensitive": True}}}) is None
+    assert dsl.intern_query({"fuzzy": {"f": "x"}}) is None
+    assert dsl.intern_query({"match": {}}) is None
+    # deterministic date math (no "now") is fine to intern
+    assert dsl.intern_query(
+        {"range": {"ts": {"gte": "2020-01-01||+1d"}}}) is not None
+    # bool composition of admissible shapes interns
+    assert dsl.intern_query({"bool": {
+        "must": [{"match": {"body": "x"}}],
+        "filter": [{"range": {"views": {"gte": 1}}}],
+        "must_not": [{"term": {"tag": "t"}}],
+        "should": [{"exists": {"field": "views"}}],
+        "minimum_should_match": 0}}) is not None
+
+
+# --------------------------------------------------------- memo rotation
+
+def test_rotating_memo_two_generations():
+    memo = RotatingMemo(limit=4)
+    for i in range(3):
+        memo[("k", i)] = i
+    assert len(memo) == 3
+    memo[("k", 3)] = 3            # hits the limit → rotates to OLD
+    assert all(memo.get(("k", i)) == i for i in range(4))  # still visible
+    # a hot OLD entry promotes into the new generation and survives the
+    # NEXT rotation, where the clear-at-limit design wiped everything
+    assert memo.get(("k", 0)) == 0
+    memo[("k", 4)] = 4
+    memo[("k", 5)] = 5
+    memo[("k", 6)] = 6            # second rotation drops cold gen-0 keys
+    assert memo.get(("k", 0)) == 0          # promoted → survived
+    assert memo.get(("k", 6)) == 6
+    assert ("k", 1) not in memo             # cold entry aged out
+    memo.clear()
+    assert len(memo) == 0 and memo.get(("k", 0)) is None
+
+
+def test_rotating_memo_byte_budget():
+    """Entries carrying a byte cost rotate the generation when the budget
+    is crossed, and the budget resets per generation — distinct large
+    bundles are bounded in bytes, not just entry count."""
+    memo = RotatingMemo(limit=1000, byte_limit=100)
+    memo.set("a", 1, cost=40)
+    memo.set("b", 2, cost=40)
+    assert memo.get("a") == 1 and memo.get("b") == 2
+    memo.set("c", 3, cost=40)     # 120 >= 100 → rotates
+    assert memo.get("c") == 3     # rotated generation stays readable
+    memo.set("d", 4, cost=40)
+    memo.set("e", 5, cost=40)
+    memo.set("f", 6, cost=40)     # second rotation drops cold "a"/"b"
+    assert "a" not in memo and "b" not in memo
+    assert memo.get("f") == 6
+
+
+def test_rotation_never_empties_working_set():
+    """Steady mixed traffic across a rotation boundary: the entries of
+    the current batch stay resident (no recompile stampede)."""
+    memo = RotatingMemo(limit=8)
+    for i in range(100):
+        memo[i] = i
+        assert memo.get(i) == i
+        if i >= 1:
+            # the immediately preceding insert is always still cached
+            assert memo.get(i - 1) == i - 1

@@ -1,8 +1,8 @@
 """Per-phase profile of the agg bench configs (2/3: agg_terms, date_hist).
 
 Round-6 counterpart of profile_bench.py for the aggregation path: runs the
-bench workload through the msearch envelope, reports MSEARCH_PHASES per
-config plus an ablation (query-only / each agg alone / both), and times
+bench workload through the msearch envelope, reports the telemetry
+`msearch.phase.*` histograms per config plus an ablation (query-only / each agg alone / both), and times
 the executable-warmup subsystem (cold compile vs post-warmup replay).
 Writes PROFILE_AGGS_RUN.md; PROFILE.md holds the curated analysis.
 
@@ -42,7 +42,7 @@ def main():
     spans = 1 + 79 * rng.permutation(n_q) / max(n_q, 1)
 
     from opensearch_tpu.indices.request_cache import REQUEST_CACHE
-    from opensearch_tpu.search.executor import MSEARCH_PHASES
+    from opensearch_tpu.telemetry import TELEMETRY
 
     def q(s):
         return {"range": {"ts": {"lt": int(1700000000000 + s * day)}}}
@@ -52,8 +52,7 @@ def main():
         t0 = time.perf_counter()
         executor.multi_search(bodies)
         cold = (time.perf_counter() - t0) * 1000
-        for k in MSEARCH_PHASES:
-            MSEARCH_PHASES[k] = 0.0
+        TELEMETRY.metrics.reset()
         times = []
         for _ in range(reps):
             REQUEST_CACHE.clear()
@@ -61,7 +60,11 @@ def main():
             executor.multi_search(bodies)
             times.append((time.perf_counter() - t0) * 1000)
         med = sorted(times)[reps // 2]
-        ph = {k: round(v * 1000 / reps, 2) for k, v in MSEARCH_PHASES.items()}
+        hists = TELEMETRY.metrics.to_dict()["histograms"]
+        ph = {name[len("msearch.phase."):-len("_ms")]:
+              round(h["sum_ms"] / reps, 2)
+              for name, h in sorted(hists.items())
+              if name.startswith("msearch.phase.")}
         log(f"{tag}: warm batch median", med, f"cold={cold:.0f}ms B={n_q}")
         for k, v in ph.items():
             log(f"{tag}:   phase {k}", v)

@@ -54,16 +54,21 @@ def main():
     t0 = time.perf_counter()
     executor.multi_search(bodies)
     log("msearch cold (compiles)", time.perf_counter() - t0)
-    from opensearch_tpu.search.executor import MSEARCH_PHASES
-    for key in MSEARCH_PHASES:
-        MSEARCH_PHASES[key] = 0.0
+    from opensearch_tpu.telemetry import TELEMETRY
+    TELEMETRY.metrics.reset()
     t0 = time.perf_counter()
     executor.multi_search(bodies)
     total = time.perf_counter() - t0
     log("msearch warm TOTAL", total,
         f"{len(bodies) / total:.0f} QPS")
-    for key, sec in MSEARCH_PHASES.items():
-        log(f"warm phase: {key}", sec)
+    snap = TELEMETRY.metrics.to_dict()
+    for name, h in sorted(snap["histograms"].items()):
+        if name.startswith("msearch.phase."):
+            log(f"warm phase: {name[len('msearch.phase.'):-len('_ms')]}",
+                h["sum_ms"] / 1000)
+    print("interning counters:",
+          {k: v for k, v in snap["counters"].items()
+           if "template" in k or k == "search.plan_compiles"})
 
     # ---- dissect the warm path (mirrors multi_search's envelope path)
     from opensearch_tpu.search import dsl

@@ -1,0 +1,102 @@
+"""Host-cost profile of the msearch envelope across batch sizes.
+
+ISSUE 5 tooling: sweeps B ∈ {1, 32, 1024} (configurable) over the bench's
+BM25 match workload and prints the per-phase host breakdown from the
+always-on telemetry histograms (`msearch.phase.*`), plus the
+template-interning counters — so "compile+group is O(unique templates),
+not O(B)" is a number you can watch, not a claim.
+
+Each sweep point runs the batch once COLD (executable + skeleton compile)
+and `rounds` times WARM with metrics reset in between; the warm rows are
+what steady-state serving pays. The returned dict is consumed by the
+tier-1 smoke test (tests/test_profile_host.py) on a tiny corpus, which
+asserts the interning counters move the right way (bundle hits on warm
+batches; zero plan/XLA compiles on a repeated identical batch).
+
+Usage:  python tools/profile_host.py
+        BENCH_DOCS=100000 BENCH_VOCAB=20000 python tools/profile_host.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time
+
+PHASE_ORDER = ("parse", "compile_group", "stack_pack_dispatch",
+               "device_get", "respond")
+
+COUNTERS = ("msearch.template.bundle_hits",
+            "msearch.template.bundle_misses",
+            "msearch.template.fallbacks",
+            "search.template_binds",
+            "search.plan_compiles",
+            "search.xla_cache_miss")
+
+
+def run_sweep(n_docs: int = 100_000, vocab: int = 20_000,
+              batches=(1, 32, 1024), rounds: int = 3, top_k: int = 10,
+              quiet: bool = False) -> dict:
+    from opensearch_tpu.search.executor import SearchExecutor, ShardReader
+    from opensearch_tpu.telemetry import TELEMETRY
+    from opensearch_tpu.utils.demo import build_shards, query_terms
+
+    mapper, segments = build_shards(n_docs, n_shards=1, vocab_size=vocab,
+                                    avg_len=60, seed=42)
+    executor = SearchExecutor(ShardReader(mapper, segments))
+
+    def emit(line=""):
+        if not quiet:
+            print(line, flush=True)
+
+    results = {}
+    max_b = max(batches)
+    queries = query_terms(max_b, vocab, seed=7, terms_per_query=2)
+    for b in batches:
+        bodies = [{"query": {"match": {"body": q}}, "size": top_k}
+                  for q in queries[:b]]
+        t0 = time.perf_counter()
+        executor.multi_search(bodies)               # cold: compiles
+        cold_ms = (time.perf_counter() - t0) * 1000
+        TELEMETRY.metrics.reset()
+        warm_ms = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            executor.multi_search(bodies)
+            warm_ms.append((time.perf_counter() - t0) * 1000)
+        snap = TELEMETRY.metrics.to_dict()
+        phases = {name[len("msearch.phase."):-len("_ms")]:
+                  h["sum_ms"] / rounds
+                  for name, h in snap["histograms"].items()
+                  if name.startswith("msearch.phase.")}
+        counters = {c: snap["counters"].get(c, 0) for c in COUNTERS}
+        results[b] = {"cold_ms": cold_ms,
+                      "warm_ms": sorted(warm_ms)[len(warm_ms) // 2],
+                      "phases": phases, "counters": counters}
+        emit(f"B={b:5d}  cold {cold_ms:8.1f} ms   warm "
+             f"{results[b]['warm_ms']:8.1f} ms "
+             f"({b / (results[b]['warm_ms'] / 1000):.0f} QPS)")
+        for name in PHASE_ORDER:
+            emit(f"    phase {name:20s} {phases.get(name, 0.0):8.2f} ms"
+                 f"/batch")
+        emit(f"    counters ({rounds} warm rounds): "
+             + "  ".join(f"{c.split('.')[-1]}={counters[c]}"
+                         for c in COUNTERS))
+        emit()
+    return results
+
+
+def main():
+    n_docs = int(os.environ.get("BENCH_DOCS", "100000"))
+    vocab = int(os.environ.get("BENCH_VOCAB", "20000"))
+    batches = tuple(int(x) for x in os.environ.get(
+        "PROFILE_HOST_BATCHES", "1,32,1024").split(","))
+    print(f"profile_host: docs={n_docs} vocab={vocab} batches={batches}")
+    run_sweep(n_docs=n_docs, vocab=vocab, batches=batches)
+
+
+if __name__ == "__main__":
+    main()
