@@ -91,6 +91,16 @@ TOP_K = 10
 # disabled-overhead contract is checked, not assumed.
 TELEMETRY_ON = "--telemetry" in sys.argv
 
+# --faults: smoke mode — install a 1% seeded transient-fault schedule on
+# device dispatch and run the config under it; the output line records
+# the fault/retry accounting next to p50/p99, so the p99 degradation
+# under faults is measured, not guessed. Without the flag the run
+# ASSERTS the injector's disabled fast path is a true no-op (the same
+# contract as the tracer assert above): `faults.ENABLED` must be False
+# and the hot-path guard `if faults.ENABLED:` must therefore cost one
+# module attribute load — nothing else runs.
+FAULTS_ON = "--faults" in sys.argv
+
 
 def _setup_telemetry():
     from opensearch_tpu.telemetry import TELEMETRY
@@ -100,6 +110,42 @@ def _setup_telemetry():
         return
     assert TELEMETRY.tracer.start_trace("bench.noop-probe") is NOOP_SPAN, \
         "tracer must be a no-op when telemetry is disabled"
+
+
+def _setup_faults():
+    from opensearch_tpu.common import faults
+    if not FAULTS_ON:
+        assert faults.ENABLED is False, \
+            "fault injector must be disabled for clean benches"
+        assert not faults.snapshot(), \
+            "leftover fault rules would poison the measurement"
+        return
+    # 1% per-dispatch transient blips, seeded — the bounded retry helper
+    # (common/retry.py) should absorb every one; a fire that reaches the
+    # response surfaces as a shard failure / error item in the page and
+    # the accounting below makes it visible
+    faults.install({"site": "query.dispatch", "kind": "transient",
+                    "probability": 0.01, "seed": 0})
+
+
+def _faults_summary():
+    """Fault/retry accounting for the output record (None when the run
+    was not started with --faults)."""
+    if not FAULTS_ON:
+        return None
+    from opensearch_tpu.common import faults
+    from opensearch_tpu.telemetry import TELEMETRY
+    counters = TELEMETRY.metrics.to_dict()["counters"]
+    return {"schedule": faults.snapshot(),
+            "retries": counters.get("search.retries", 0),
+            "retry_success": counters.get("search.retry_success", 0),
+            "shard_failures": counters.get("search.shard_failures", 0),
+            # the controller takes the per-shard host loop whenever
+            # injection is enabled (the fused SPMD program has no
+            # per-shard fault boundaries) — these numbers measure that
+            # path, so compare them to a clean run's host-loop numbers,
+            # not to an SPMD run
+            "query_path": "host-loop (spmd disabled under injection)"}
 
 
 def _telemetry_summary():
@@ -316,6 +362,9 @@ def bench_aggs(mode: str):
     _t = _telemetry_summary()
     if _t is not None:
         out["telemetry"] = _t
+    _f = _faults_summary()
+    if _f is not None:
+        out["faults"] = _f
     if _BACKEND_DIAG:
         out["backend_diag"] = "; ".join(_BACKEND_DIAG)
     print(json.dumps(out))
@@ -398,6 +447,9 @@ def bench_knn(mode: str):
     _t = _telemetry_summary()
     if _t is not None:
         out["telemetry"] = _t
+    _f = _faults_summary()
+    if _f is not None:
+        out["faults"] = _f
     if _BACKEND_DIAG:
         out["backend_diag"] = "; ".join(_BACKEND_DIAG)
     print(json.dumps(out))
@@ -546,6 +598,9 @@ def bench_hybrid():
     _t = _telemetry_summary()
     if _t is not None:
         out["telemetry"] = _t
+    _f = _faults_summary()
+    if _f is not None:
+        out["faults"] = _f
     if _BACKEND_DIAG:
         out["backend_diag"] = "; ".join(_BACKEND_DIAG)
     print(json.dumps(out))
@@ -558,6 +613,7 @@ def main():
     from opensearch_tpu.utils.demo import query_terms
 
     _setup_telemetry()
+    _setup_faults()
     mode = os.environ.get("BENCH_MODE", "bm25")
     if mode in ("knn_exact", "knn_ivf"):
         bench_knn(mode)
@@ -616,6 +672,9 @@ def main():
     _t = _telemetry_summary()
     if _t is not None:
         out["telemetry"] = _t
+    _f = _faults_summary()
+    if _f is not None:
+        out["faults"] = _f
     if _BACKEND_DIAG:
         out["backend_diag"] = "; ".join(_BACKEND_DIAG)
     print(json.dumps(out))
@@ -635,7 +694,8 @@ def _run_extra_configs():
     BENCH_ALL.json, one line per config). Each child skips the backend
     probe when this process already fell back to CPU."""
     if os.environ.get("BENCH_SKIP_EXTRA") == "1" \
-            or os.environ.get("BENCH_MODE"):
+            or os.environ.get("BENCH_MODE") or FAULTS_ON:
+        # --faults is a single-config smoke: no extra-config children
         return
     import subprocess
 

@@ -175,15 +175,41 @@ class SearchBackpressure:
                 from opensearch_tpu.telemetry import TELEMETRY
                 TELEMETRY.metrics.counter(
                     "search.backpressure_rejections").inc()
-                raise CircuitBreakingError(
-                    f"rejected execution of search: node is under duress "
-                    f"[{self.current} >= {self.max_concurrent} concurrent "
-                    f"searches]")
+                raise self.rejection_error()
             self.current += 1
 
     def release(self):
         with self._lock:
             self.current = max(0, self.current - 1)
+
+    def acquire_batch(self, n: int) -> int:
+        """Batch-aware admission for the _msearch envelope: admit as many
+        of `n` sub-requests as capacity allows and return that count —
+        the OVERFLOW items are rejected (counted + telemetry), not the
+        envelope. The caller renders per-item 429 error objects for the
+        tail and MUST release_batch(admitted) when done."""
+        with self._lock:
+            free = max(0, self.max_concurrent - self.current)
+            admitted = min(max(n, 0), free)
+            rejected = n - admitted
+            self.current += admitted
+            if rejected > 0:
+                self.rejections += rejected
+        if rejected > 0:
+            from opensearch_tpu.telemetry import TELEMETRY
+            TELEMETRY.metrics.counter(
+                "search.backpressure_rejections").inc(rejected)
+        return admitted
+
+    def release_batch(self, n: int):
+        with self._lock:
+            self.current = max(0, self.current - max(n, 0))
+
+    def rejection_error(self) -> CircuitBreakingError:
+        return CircuitBreakingError(
+            f"rejected execution of search: node is under duress "
+            f"[{self.current} >= {self.max_concurrent} concurrent "
+            f"searches]")
 
     def stats(self) -> dict:
         return {"search_task": {"current": self.current,

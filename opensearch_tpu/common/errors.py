@@ -130,6 +130,30 @@ class TaskCancelledError(OpenSearchTpuError):
     error_type = "task_cancelled_exception"
 
 
+class TransientFault(OpenSearchTpuError):
+    """A fault the caller may safely retry: the operation had no side
+    effects (device dispatch, cache IO, warmup replay) and the failure is
+    expected to clear (reference analog: the retryable subset of
+    OpenSearchException — ConnectTransportException,
+    NoShardAvailableActionException — that TransportReplicationAction
+    retries on). `common/retry.call_with_retry` retries ONLY this class
+    plus the JAX runtime-error allowlist."""
+    status = 503
+    error_type = "transient_fault_exception"
+
+
+def shard_failure_entry(shard_i: int, index_name: str,
+                        exc: BaseException, node_id: str = "_local") -> dict:
+    """One `_shards.failures[]` entry in the reference's shape
+    (ShardSearchFailure.toXContent: shard/index/node + nested reason)."""
+    if isinstance(exc, OpenSearchTpuError):
+        reason = exc.to_xcontent()
+    else:
+        reason = {"type": type(exc).__name__, "reason": str(exc)}
+    return {"shard": shard_i, "index": index_name, "node": node_id,
+            "reason": reason}
+
+
 class SettingsError(OpenSearchTpuError):
     status = 400
     error_type = "settings_exception"

@@ -348,17 +348,28 @@ class IndexService:
         return execute_search([s.executor for s in self.shards], body,
                               allow_envelope=True)
 
-    def multi_search(self, bodies: List[dict]) -> dict:
+    def multi_search(self, bodies: List[dict], task=None,
+                     deadline=None) -> dict:
         self.check_open()
         if self.num_shards == 1:
-            return self.shards[0].executor.multi_search(bodies)
+            return self.shards[0].executor.multi_search(
+                bodies, task=task, deadline=deadline)
         # multi-shard fallback keeps the same per-item failure contract
         # as the batched envelope: one malformed body renders an error
-        # item, siblings execute (TransportMultiSearchAction semantics)
+        # item, siblings execute (TransportMultiSearchAction semantics).
+        # Cancellation kills the envelope at item boundaries; a passed
+        # deadline renders the unlaunched tail as timed-out partials.
+        import time as _time
         from opensearch_tpu.search.executor import (
-            _item_error, _item_error_untyped)
+            _item_error, _item_error_untyped, _timed_out_item)
+        start = _time.monotonic()
         responses = []
         for b in bodies:
+            if task is not None:
+                task.check_cancelled()
+            if deadline is not None and _time.monotonic() > deadline:
+                responses.append(_timed_out_item(start))
+                continue
             try:
                 responses.append(self.search(b))
             except OpenSearchTpuError as e:

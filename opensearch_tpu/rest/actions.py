@@ -117,6 +117,19 @@ def _search_services(node, index_expr: Optional[str]):
     return [node.indices.get(n) for n in names]
 
 
+def _cluster_allow_partial(node) -> Optional[bool]:
+    """Cluster-level default for allow_partial_search_results
+    (`search.default_allow_partial_results`, dynamic; transient beats
+    persistent like every cluster setting). None = not set (the
+    controller then applies the reference default of true)."""
+    for scope in ("transient", "persistent"):
+        v = node.cluster_settings.get(scope, {}).get(
+            "search.default_allow_partial_results")
+        if v is not None:
+            return str(v).strip().lower() != "false"
+    return None
+
+
 def _run_search(node, index_expr: Optional[str], body: Optional[dict],
                 search_pipeline=None) -> dict:
     """Search with the full pipeline wrap: resolve the search pipeline
@@ -175,7 +188,8 @@ def _run_search(node, index_expr: Optional[str], body: Optional[dict],
             res = execute_search(executors, body, extra_filters=filters,
                                  task=task, allow_envelope=True,
                                  phase_processors=phase_spec,
-                                 trace=root, phase_times=phase_times)
+                                 trace=root, phase_times=phase_times,
+                                 allow_partial=_cluster_allow_partial(node))
         finally:
             node.task_manager.unregister(task)
             node.search_backpressure.release()
@@ -505,6 +519,13 @@ def register_search_actions(node, c):
             body["query"] = {"query_string": {"query": req.param("q")}}
         if req.param("search_type"):
             body["search_type"] = req.param("search_type")
+        if req.param("timeout") is not None:
+            # the long-ignored timeout param: enforced at phase
+            # boundaries by the controller (deadline checkpoints)
+            body["timeout"] = req.param("timeout")
+        if req.param("allow_partial_search_results") is not None:
+            body["allow_partial_search_results"] = req.bool_param(
+                "allow_partial_search_results", True)
         for p in ("from", "size"):
             if req.param(p) is not None:
                 body[p] = req.int_param(p)
@@ -791,17 +812,52 @@ def register_search_actions(node, c):
                 # one ROOT SPAN PER SUB-REQUEST even though the envelope
                 # executes the whole batch as fused device programs — the
                 # per-request accounting contract survives batching
+                bodies = [b for _, b in pairs]
+                # deadline parsing can 400 — do it BEFORE admission so a
+                # malformed timeout can't leak backpressure permits (and
+                # reuse the controller's parser so /_search and /_msearch
+                # reject the same value with the same error shape)
+                from opensearch_tpu.search.controller import \
+                    _parse_deadline
+                deadline = _parse_deadline(
+                    {"timeout": req.param("timeout")})
                 spans = [TELEMETRY.tracer.start_trace(
                     "rest.search", index=expr, msearch=True, batched=True,
                     batch_size=len(pairs)) for _ in pairs]
+                task = node.task_manager.register(
+                    "indices:data/read/msearch",
+                    description=f"indices[{expr}][{len(bodies)}]",
+                    cancellable=True)
+                # batch-aware admission: the backpressure gate admits as
+                # many sub-requests as capacity allows; OVERFLOW items
+                # reject with per-item 429 error objects instead of
+                # 429ing the whole envelope. Nothing may run between
+                # acquire and the try — release_batch lives in finally.
+                admitted = node.search_backpressure.acquire_batch(
+                    len(bodies))
                 try:
-                    res = node.indices.get(names[0]).multi_search(
-                        [b for _, b in pairs])
+                    if admitted == len(bodies):
+                        res = node.indices.get(names[0]).multi_search(
+                            bodies, task=task, deadline=deadline)
+                    else:
+                        from opensearch_tpu.search.executor import \
+                            _item_error
+                        res = node.indices.get(names[0]).multi_search(
+                            bodies[:admitted], task=task,
+                            deadline=deadline) if admitted else \
+                            {"took": 0, "responses": []}
+                        rejected = _item_error(
+                            node.search_backpressure.rejection_error())
+                        res["responses"].extend(
+                            dict(rejected)
+                            for _ in range(len(bodies) - admitted))
                 except BaseException as e:
                     for s in spans:
                         s.end(error=e)
                     raise
                 finally:
+                    node.task_manager.unregister(task)
+                    node.search_backpressure.release_batch(admitted)
                     for s in spans:
                         TELEMETRY.tracer.finish(s)
                 for r in res["responses"]:
@@ -1987,6 +2043,44 @@ def register_module_actions(node, c):
     c.register("PUT", "/{index}/_clone/{target}", make_resize("clone"))
 
 
+# ---------------------------------------------------------- fault injection
+
+def register_fault_actions(node, c):
+    """REST control for the deterministic fault-injection subsystem
+    (common/faults.py): POST installs seeded rules at named hot-path
+    sites, GET enumerates them with invocation/fire counts (the chaos
+    sweep's reproducibility surface), DELETE clears all rules or one
+    site's. Injection is strictly OFF (module-level flag, zero hot-path
+    overhead) unless at least one rule is installed."""
+    from opensearch_tpu.common import faults
+
+    def do_get_faults(req):
+        return {"enabled": faults.ENABLED, "sites": sorted(faults.SITES),
+                "rules": faults.snapshot()}
+
+    def do_install_fault(req):
+        body = req.body or {}
+        specs = body.get("rules") if isinstance(body.get("rules"), list) \
+            else [body]
+        if not specs:
+            raise IllegalArgumentError(
+                "fault injection requires a rule body "
+                "({site, kind, ...} or {rules: [...]})")
+        installed = [faults.install(spec) for spec in specs]
+        return {"acknowledged": True, "installed": installed,
+                "enabled": faults.ENABLED}
+
+    def do_clear_faults(req):
+        removed = faults.clear(req.param("site"))
+        return {"acknowledged": True, "removed": removed,
+                "enabled": faults.ENABLED}
+
+    c.register("GET", "/_fault_injection", do_get_faults)
+    c.register("POST", "/_fault_injection", do_install_fault)
+    c.register("DELETE", "/_fault_injection", do_clear_faults)
+    c.register("DELETE", "/_fault_injection/{site}", do_clear_faults)
+
+
 # ---------------------------------------------------------------- telemetry
 
 def register_telemetry_actions(node, c):
@@ -2091,3 +2185,4 @@ def register_all(node):
     register_module_actions(node, c)
     register_task_actions(node, c)
     register_telemetry_actions(node, c)
+    register_fault_actions(node, c)

@@ -25,7 +25,10 @@ from __future__ import annotations
 import time
 from typing import Any, List, Optional, Tuple
 
-from opensearch_tpu.common.errors import IllegalArgumentError, ParsingError
+from opensearch_tpu.common import faults
+from opensearch_tpu.common.errors import (
+    IllegalArgumentError, OpenSearchTpuError, ParsingError,
+    SearchPhaseExecutionError, TaskCancelledError, shard_failure_entry)
 from opensearch_tpu.search import dsl
 from opensearch_tpu.search.aggs.parse import PIPELINE_TYPES, parse_aggs
 from opensearch_tpu.search.aggs.pipeline import apply_pipelines
@@ -160,8 +163,38 @@ SEARCH_BODY_KEYS = frozenset({
     "profile", "timeout", "terminate_after", "indices_boost",
     "runtime_mappings", "search_type", "scroll", "scroll_id", "ext",
     "min_compatible_shard_node", "knn", "stats",
+    "allow_partial_search_results",
     "_dfs",                       # internal: DFS-merged statistics
 })
+
+
+def _parse_deadline(body: dict) -> Optional[float]:
+    """body['timeout'] ('10ms'/'1s'/bare-int millis) → monotonic
+    deadline, or None. The long-ignored param now gates phase launches."""
+    raw = body.get("timeout")
+    if raw is None:
+        return None
+    from opensearch_tpu.common.settings import parse_time_value
+    try:
+        timeout_s = parse_time_value(raw, "timeout")
+    except Exception:
+        raise IllegalArgumentError(
+            f"failed to parse [timeout] with value [{raw!r}]")
+    if timeout_s <= 0:
+        return None                 # -1 / 0 disable, reference semantics
+    return time.monotonic() + timeout_s
+
+
+def _resolve_allow_partial(body: dict, default: Optional[bool]) -> bool:
+    """allow_partial_search_results: body key > caller kwarg (REST param /
+    cluster setting `search.default_allow_partial_results`) > true (the
+    reference default)."""
+    raw = body.get("allow_partial_search_results")
+    if raw is None:
+        return True if default is None else bool(default)
+    if isinstance(raw, str):
+        return raw.strip().lower() != "false"
+    return bool(raw)
 
 
 def _validate_search_body_keys(body: dict) -> None:
@@ -210,7 +243,8 @@ def execute_search(executors: List, body: Optional[dict],
                    task=None, allow_envelope: bool = False,
                    phase_processors: Optional[dict] = None,
                    trace=None,
-                   phase_times: Optional[dict] = None) -> dict:
+                   phase_times: Optional[dict] = None,
+                   allow_partial: Optional[bool] = None) -> dict:
     """Run the full query-then-fetch flow over shard executors and render
     the search response. `executors` are per-shard SearchExecutors;
     `extra_filters` (aligned with executors) carry per-index alias filters;
@@ -227,7 +261,20 @@ def execute_search(executors: List, body: Optional[dict],
     traced) — child spans cover parse, can_match, per-shard query with
     device-dispatch attribution, reduce and fetch, and close on every
     exit path. `phase_times` (pass a dict) is filled with per-phase
-    milliseconds for the caller's slow log."""
+    milliseconds for the caller's slow log.
+
+    Partial-failure contract (reference: AbstractSearchAsyncAction's
+    per-shard onShardFailure accounting): a runtime exception in ONE
+    shard's can-match / query / fetch phase costs that shard's slice of
+    the response, not the envelope — failures render as reference-shaped
+    `_shards.failures[]` entries. `allow_partial` (body key
+    `allow_partial_search_results` > this kwarg > true) decides whether
+    a partially-failed request returns 200 or raises
+    SearchPhaseExecutionError; all shards failing always raises. The
+    `timeout` body param is enforced at phase boundaries (between shard
+    launches, before fetch): past-deadline requests stop launching new
+    shard phases and render `timed_out: true` with whatever accumulated.
+    Cancellation (`task`) is checked at the same safe points."""
     from opensearch_tpu.telemetry import NOOP_SPAN, TELEMETRY
     if trace is None:
         trace = NOOP_SPAN
@@ -250,7 +297,8 @@ def execute_search(executors: List, body: Optional[dict],
             return execute_hybrid_search(
                 executors, body, phase_spec=phase_processors,
                 extra_filters=extra_filters, total_shards=total_shards,
-                failed_shards=failed_shards, task=task)
+                failed_shards=failed_shards, task=task,
+                allow_partial=_resolve_allow_partial(body, allow_partial))
     if (allow_envelope and len(executors) == 1 and total_shards is None
             and failed_shards == 0 and cursor_tiebreak is None
             and not (extra_filters and extra_filters[0])):
@@ -266,9 +314,30 @@ def execute_search(executors: List, body: Optional[dict],
                 # _msearch_batchable); errors raise — the per-item error
                 # objects are an _msearch-only contract
                 return executors[0].multi_search(
-                    [body], _raise_item_errors=True)["responses"][0]
+                    [body], _raise_item_errors=True,
+                    task=task)["responses"][0]
     start = time.monotonic()
     start_ns = time.perf_counter_ns()
+    deadline = _parse_deadline(body)
+    allow_partial_results = _resolve_allow_partial(body, allow_partial)
+    timed_out_box = [False]
+    shard_failures: List[dict] = []     # reference-shaped failures[]
+    failed_shard_ids: set = set()       # dedupe: one entry per shard
+
+    def _deadline_passed() -> bool:
+        if deadline is not None and time.monotonic() > deadline:
+            timed_out_box[0] = True
+            return True
+        return False
+
+    def _record_failure(shard_i: int, exc: BaseException) -> None:
+        if shard_i in failed_shard_ids:
+            return
+        failed_shard_ids.add(shard_i)
+        idx = executors[shard_i].reader.index_name \
+            if 0 <= shard_i < len(executors) else "_unknown"
+        shard_failures.append(shard_failure_entry(shard_i, idx, exc))
+        TELEMETRY.metrics.counter("search.shard_failures").inc()
     profiling = bool(body.get("profile", False))
     if profiling and not trace.recording:
         # the profile API builds from request-scoped spans even when
@@ -350,7 +419,17 @@ def execute_search(executors: List, body: Optional[dict],
     def can_match_flags():
         if flags_box[0] is None:
             with _PhaseTimer(trace, phases, "can_match") as cm:
-                flags = [shard_can_match(ex, body) for ex in executors]
+                flags = []
+                for ex in executors:
+                    # a can-match failure degrades to "don't skip": the
+                    # pre-filter is an optimization, so its faults must
+                    # cost an extra shard execution, never correctness
+                    try:
+                        if faults.ENABLED:
+                            faults.fire("canmatch.shard")
+                        flags.append(shard_can_match(ex, body))
+                    except Exception:
+                        flags.append(True)
                 if flags and not any(flags):
                     flags[0] = True
                 cm.set_attribute("skipped",
@@ -363,6 +442,8 @@ def execute_search(executors: List, body: Optional[dict],
         decoded_partials = []
         total = 0
         profile_shards.clear()
+        shard_failures.clear()      # k-growth retries re-run the phase
+        failed_shard_ids.clear()
         # SPMD path: with multiple (shard, segment) rows and enough mesh
         # devices, the query phase is ONE shard_map program with on-chip
         # all_gather/psum merge instead of a host loop (search/spmd.py).
@@ -372,12 +453,26 @@ def execute_search(executors: List, body: Optional[dict],
         with _PhaseTimer(trace, phases, "can_match", op="spmd_route"):
             from opensearch_tpu.search import spmd
             rows = spmd.spmd_rows(executors)
-            spmd_ok = spmd.eligible(executors, body, rows, sort_specs)
+            # the fused all-shard SPMD program has no per-shard
+            # boundaries: a deadline can't be checked mid-program and a
+            # fault can't cost one shard's slice — deadline'd requests
+            # and fault-injection runs take the per-shard host loop,
+            # which has both checkpoints
+            spmd_ok = deadline is None and not faults.ENABLED \
+                and spmd.eligible(executors, body, rows, sort_specs)
         if spmd_ok:
             with _PhaseTimer(trace, phases, "query", path="spmd",
                              rows=len(rows)) as qt:
-                out = spmd.spmd_query_phase(executors, body, k_eff,
-                                            extra_filters, rows)
+                try:
+                    out = spmd.spmd_query_phase(executors, body, k_eff,
+                                                extra_filters, rows)
+                except TaskCancelledError:
+                    raise
+                except Exception:
+                    # the fused all-shard program failed as a unit:
+                    # degrade to the per-shard host loop below, where
+                    # failure isolation is per shard
+                    out = None
             if out is not None:
                 candidates, decoded_partials, total = out
                 with _PhaseTimer(trace, phases, "reduce"):
@@ -403,15 +498,38 @@ def execute_search(executors: List, body: Optional[dict],
                 continue                # provably empty: skipped shard
             if task is not None:
                 task.check_cancelled()
+            if _deadline_passed():
+                # budget spent: stop launching new shard phases; what
+                # accumulated so far renders with timed_out: true
+                break
             extra = extra_filters[shard_i] if extra_filters else None
-            with _PhaseTimer(trace, phases, "query",
-                             shard=shard_i) as qt:
-                cands, decoded, shard_total = ex.execute_query_phase(
-                    body, k_eff, extra_filter=extra,
-                    stats_override=dfs_overrides[shard_i]
-                    if dfs_overrides else None,
-                    trace=qt.span)
-                qt.set_attribute("candidates", len(cands))
+            try:
+                with _PhaseTimer(trace, phases, "query",
+                                 shard=shard_i) as qt:
+                    if faults.ENABLED:
+                        faults.fire("query.shard")
+                    cands, decoded, shard_total = ex.execute_query_phase(
+                        body, k_eff, extra_filter=extra,
+                        stats_override=dfs_overrides[shard_i]
+                        if dfs_overrides else None,
+                        trace=qt.span)
+                    qt.set_attribute("candidates", len(cands))
+            except TaskCancelledError:
+                raise                   # cancellation is not a failure
+            except OpenSearchTpuError as e:
+                if e.status < 500:
+                    # a 4xx is a deterministic request defect (parse /
+                    # validation), not a shard fault: every shard would
+                    # fail identically, so the request keeps its 4xx
+                    # contract instead of degrading to a partial
+                    raise
+                _record_failure(shard_i, e)
+                continue
+            except Exception as e:
+                # one shard's query fault costs that shard's slice of
+                # the response, not the request
+                _record_failure(shard_i, e)
+                continue
             for c in cands:
                 c.shard_i = shard_i
             candidates.extend(cands)
@@ -479,19 +597,46 @@ def execute_search(executors: List, body: Optional[dict],
                 if max_score is None or c.score > max_score:
                     max_score = c.score
 
+    _deadline_passed()      # the fetch-boundary timeout checkpoint:
+    # accumulated hits still render (building the page from host-side
+    # sources is cheap), but the response says timed_out
+    if task is not None:
+        task.check_cancelled()
     with _PhaseTimer(trace, phases, "fetch") as ft:
         query_node = dsl.parse_query(body.get("query"))
         from opensearch_tpu.search import fetch as fetch_phase
         page_inner_specs = fetch_phase.collect_inner_hit_specs(query_node)
         page_inner_cache: dict = {}
-        hits = []
+        built = []      # (shard_i, hit): a mid-page shard failure must
+        # drop the WHOLE shard's slice, including hits already built —
+        # per-shard accounting (one failures[] entry per shard) with
+        # per-candidate survivorship would double-count for clients that
+        # retry failed shards
         for c in page:
+            if c.shard_i in failed_shard_ids:
+                continue
             ex = executors[c.shard_i]
-            hit = _build_hit(ex, c, body, c.score if wants_score else None,
-                             query_node, sort_specs, score_sorted,
-                             inner_specs=page_inner_specs,
-                             inner_cache=page_inner_cache)
-            hits.append(hit)
+            try:
+                if faults.ENABLED:
+                    faults.fire("fetch.gather")
+                hit = _build_hit(ex, c, body,
+                                 c.score if wants_score else None,
+                                 query_node, sort_specs, score_sorted,
+                                 inner_specs=page_inner_specs,
+                                 inner_cache=page_inner_cache)
+            except OpenSearchTpuError as e:
+                if e.status < 500:
+                    raise       # deterministic request defect: keep 4xx
+                _record_failure(c.shard_i, e)
+                continue
+            except Exception as e:
+                # a fetch fault fails the shard: its page hits drop as a
+                # unit; siblings' hits still render
+                _record_failure(c.shard_i, e)
+                continue
+            built.append((c.shard_i, hit))
+        hits = [h for shard_i, h in built
+                if shard_i not in failed_shard_ids]
         ft.set_attribute("hits", len(hits))
 
     n_shards = total_shards if total_shards is not None else len(executors)
@@ -510,18 +655,47 @@ def execute_search(executors: List, body: Optional[dict],
             hits_block = {"total": {"value": total, "relation": "eq"},
                           **hits_block}
 
+    n_failed = failed_shards + len(shard_failures)
+    attempted = sum(can_match_flags()) if flags_box[0] is not None \
+        else len(executors)
+    if shard_failures and len(failed_shard_ids) >= max(attempted, 1):
+        # every shard that executed failed: no partial result exists to
+        # degrade to (reference: "all shards failed" regardless of
+        # allow_partial_search_results)
+        raise SearchPhaseExecutionError(
+            "all shards failed", phase="query", grouped=True,
+            failed_shards=list(shard_failures))
+    if shard_failures and not allow_partial_results:
+        raise SearchPhaseExecutionError(
+            "Partial shards failure", phase="query", grouped=True,
+            failed_shards=list(shard_failures))
+    shards_block: dict = {"total": n_shards,
+                          "successful": max(n_shards - n_failed, 0),
+                          "skipped": skipped_box[0], "failed": n_failed}
+    if shard_failures:
+        shards_block["failures"] = list(shard_failures)
     resp = {
         "took": 0,      # placeholder: set below AFTER agg reduce/suggest
-        "timed_out": False,
-        "_shards": {"total": n_shards,
-                    "successful": n_shards - failed_shards,
-                    "skipped": skipped_box[0], "failed": failed_shards},
+        "timed_out": timed_out_box[0],
+        "_shards": shards_block,
         "hits": hits_block,
     }
     if agg_nodes:
         with _PhaseTimer(trace, phases, "reduce", op="aggs"):
-            aggregations = reduce_aggs(decoded_partials)
-            apply_pipelines(agg_nodes, aggregations)
+            try:
+                if faults.ENABLED:
+                    faults.fire("reduce.aggs")
+                aggregations = reduce_aggs(decoded_partials)
+                apply_pipelines(agg_nodes, aggregations)
+            except OpenSearchTpuError:
+                raise               # already a clean typed error
+            except Exception as e:
+                # coordinator-level reduce has no per-shard slice to
+                # degrade to — surface a clean typed error, never a
+                # corrupt/partial agg tree
+                raise SearchPhaseExecutionError(
+                    f"failed to reduce aggregations: "
+                    f"{type(e).__name__}: {e}", phase="reduce")
         resp["aggregations"] = aggregations
     if body.get("suggest"):
         from opensearch_tpu.search.suggest import execute_suggest

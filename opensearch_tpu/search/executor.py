@@ -33,6 +33,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from opensearch_tpu.common import faults, retry
 from opensearch_tpu.common.errors import (
     IllegalArgumentError, OpenSearchTpuError, QueryShardError)
 from opensearch_tpu.index.mapper import MapperService
@@ -227,6 +228,50 @@ def _item_error(e: OpenSearchTpuError) -> dict:
     TransportMultiSearchAction wraps each failed sub-request instead of
     failing siblings)."""
     return {"error": e.to_xcontent(), "status": e.status}
+
+
+def _timed_out_item(start: float) -> dict:
+    """A sub-request the envelope's deadline expired before launching:
+    rendered as a zero-hit partial response with timed_out: true (the
+    reference's per-request timeout shape), never an error object —
+    timeout is a budget decision, not a failure."""
+    resp = _base_response(int((time.monotonic() - start) * 1000), 0,
+                          None, [])
+    resp["timed_out"] = True
+    return resp
+
+
+def _cache_get_isolated(rc, key):
+    """Request-cache read with fault-site + transient-retry wrapping; a
+    persistently failing cache degrades to a MISS (recompute), never a
+    failed query. The disabled-injector path is the bare cache call —
+    the in-memory cache itself has no transient failure modes."""
+    if not faults.ENABLED:
+        return rc.REQUEST_CACHE.get(key)
+
+    def op():
+        faults.fire("request_cache.get")
+        return rc.REQUEST_CACHE.get(key)
+    try:
+        return retry.call_with_retry(op, label="request_cache.get")
+    except Exception:
+        return rc.REQUEST_CACHE._MISS
+
+
+def _cache_put_isolated(rc, key, value) -> None:
+    """Request-cache write with the same wrapping; a failed put is
+    dropped (the entry just isn't cached)."""
+    if not faults.ENABLED:
+        rc.REQUEST_CACHE.put(key, value)
+        return
+
+    def op():
+        faults.fire("request_cache.put")
+        rc.REQUEST_CACHE.put(key, value)
+    try:
+        retry.call_with_retry(op, label="request_cache.put")
+    except Exception:
+        pass
 
 
 # a single interned-plan bundle larger than this never enters the memo:
@@ -1066,7 +1111,7 @@ class SearchExecutor:
                                 extra_filter)
             key = ("shard", base) if base is not None else None
             if key is not None:
-                hit = rc.REQUEST_CACHE.get(key)
+                hit = _cache_get_isolated(rc, key)
                 if hit is not rc.REQUEST_CACHE._MISS:
                     if trace is not None:
                         trace.set_attribute("request_cache", "hit")
@@ -1079,9 +1124,9 @@ class SearchExecutor:
                     body, k, extra_filter, stats_override, trace)
                 # store candidates as plain tuples: callers mutate
                 # _Candidate.shard_i, which must not leak between hits
-                rc.REQUEST_CACHE.put(
-                    key, ([(c.score, c.seg_i, c.ord, c.sort_values)
-                           for c in cands], decoded, total))
+                _cache_put_isolated(
+                    rc, key, ([(c.score, c.seg_i, c.ord, c.sort_values)
+                               for c in cands], decoded, total))
                 return cands, decoded, total
         return self._query_phase_uncached(body, k, extra_filter,
                                           stats_override, trace)
@@ -1164,17 +1209,32 @@ class SearchExecutor:
                     for d in flat for v in d.values())
                 t0 = time.perf_counter_ns()
             flat = jax.tree_util.tree_map(jnp.asarray, flat)
+
+            def _dispatch(fn=fn, arrays=arrays, flat=flat,
+                          sort_key=sort_key):
+                # fault site + bounded transient retry around the device
+                # call: a transient dispatch blip costs a retry, not the
+                # shard (the jitted fn is pure — re-dispatch is safe)
+                if faults.ENABLED:
+                    faults.fire("query.dispatch")
+                return fn(arrays, flat, sort_key, jnp.float32(min_score))
             launched.append((seg_i, seg, agg_plans,
-                             fn(arrays, flat, sort_key,
-                                jnp.float32(min_score))))
+                             retry.call_with_retry(
+                                 _dispatch, label="query.dispatch",
+                                 trace=trace)))
             if rec:
                 dispatch_ns += time.perf_counter_ns() - t0
+
+        def _collect():
+            if faults.ENABLED:
+                faults.fire("fetch.gather")
+            return jax.device_get([out for _, _, _, out in launched])
 
         if rec:
             try:
                 with trace.child("device_collect", segments=len(launched)):
-                    fetched = jax.device_get(
-                        [out for _, _, _, out in launched])
+                    fetched = retry.call_with_retry(
+                        _collect, label="fetch.gather", trace=trace)
                 xla_compiles = _THREAD_COMPILES.count
                 trace.set_attribute("plan_compile_ns", plan_compile_ns)
                 trace.set_attribute("device_dispatch_ns", dispatch_ns)
@@ -1187,7 +1247,7 @@ class SearchExecutor:
             finally:
                 _THREAD_COMPILES.active = False
         else:
-            fetched = jax.device_get([out for _, _, _, out in launched])
+            fetched = retry.call_with_retry(_collect, label="fetch.gather")
 
         candidates: List[_Candidate] = []
         per_segment_decoded = []
@@ -1267,7 +1327,13 @@ class SearchExecutor:
             buf, layout = pack_leaves(stacked)
             fn = _batched_hybrid_runner(plans, meta, k_seg, layout,
                                         treedef)
-            launched.append((seg_i, k_seg, fn(arrays, jnp.asarray(buf))))
+
+            def _dispatch(fn=fn, arrays=arrays, buf=buf):
+                if faults.ENABLED:
+                    faults.fire("query.dispatch")
+                return fn(arrays, jnp.asarray(buf))
+            launched.append((seg_i, k_seg, retry.call_with_retry(
+                _dispatch, label="query.dispatch")))
         if extra_filter is None:
             # register the fused executable's (plan-struct, shape-bucket)
             # signature so index-open / node-start warmup AOT-compiles the
@@ -1281,7 +1347,11 @@ class SearchExecutor:
 
         result = _empty_hybrid_result(n_sub)
         if launched:
-            fetched = jax.device_get([out for _, _, out in launched])
+            def _collect():
+                if faults.ENABLED:
+                    faults.fire("fetch.gather")
+                return jax.device_get([out for _, _, out in launched])
+            fetched = retry.call_with_retry(_collect, label="fetch.gather")
             for (seg_i, k_seg, _), rows in zip(launched, fetched):
                 _accumulate_hybrid_row(result, np.asarray(rows)[0], seg_i,
                                        k_seg, n_sub)
@@ -1303,7 +1373,8 @@ class SearchExecutor:
 
     def multi_search(self, bodies: List[dict],
                      _bypass_request_cache: bool = False,
-                     _raise_item_errors: bool = False) -> dict:
+                     _raise_item_errors: bool = False,
+                     task=None, deadline: Optional[float] = None) -> dict:
         """_msearch: execute many search bodies, batching same-shaped
         score-sorted queries into single vmapped device programs per segment
         (reference: action/search/TransportMultiSearchAction fans bodies out
@@ -1318,10 +1389,17 @@ class SearchExecutor:
         device even when an identical body was just served (search/warmup
         — a cache hit would compile nothing).
         _raise_item_errors: the B=1 delegation from search() wants the
-        exception, not an error item."""
+        exception, not an error item.
+        task / deadline: cancellation + timeout checkpoints at wave
+        boundaries — cancellation kills the whole envelope (the task IS
+        the msearch request, reference TransportMultiSearchAction task),
+        a passed deadline stops launching new waves and renders the
+        unlaunched items as zero-hit `timed_out: true` partials."""
         TELEMETRY.metrics.counter("msearch.requests").inc()
         TELEMETRY.metrics.counter("msearch.bodies").inc(len(bodies))
         start = time.monotonic()
+        if task is not None:
+            task.check_cancelled()
         ph = dict.fromkeys(MSEARCH_PHASE_NAMES, 0.0)
         _t = time.monotonic()
         responses: List[Optional[dict]] = [None] * len(bodies)
@@ -1330,6 +1408,13 @@ class SearchExecutor:
         batchable: List[Tuple[int, dict, Any, int, int, float]] = []
         hybrid_items: List[Tuple[int, dict]] = []
         for i, body in enumerate(bodies):
+            if task is not None and i % 16 == 0:
+                # general-path items execute inline here, so the parse
+                # loop is itself a sequence of safe points
+                task.check_cancelled()
+            if deadline is not None and time.monotonic() > deadline:
+                responses[i] = _timed_out_item(start)
+                continue
             _run_item_isolated(
                 responses, i, _raise_item_errors,
                 lambda: self._msearch_parse_one(
@@ -1344,12 +1429,24 @@ class SearchExecutor:
         # the gain was ~2%. The prepare/finish split is kept for
         # structure, not pipelining.)
         if hybrid_items:
-            self._msearch_hybrid(hybrid_items, responses, start,
-                                 _raise_item_errors)
+            if task is not None:
+                task.check_cancelled()
+            if deadline is not None and time.monotonic() > deadline:
+                for i, _b in hybrid_items:
+                    if responses[i] is None:
+                        responses[i] = _timed_out_item(start)
+            else:
+                self._msearch_hybrid(hybrid_items, responses, start,
+                                     _raise_item_errors)
         if batchable:
+            if task is not None:
+                task.check_cancelled()
             state = self._msearch_prepare(batchable, responses, start, ph,
-                                          _raise_item_errors)
+                                          _raise_item_errors,
+                                          deadline=deadline)
             state["resp_cache_keys"] = resp_cache_keys
+            if task is not None:
+                task.check_cancelled()
             self._msearch_finish(state, responses, start, ph)
         # parse always runs; the wave phases only get a sample when a
         # batched wave actually executed — otherwise every all-general or
@@ -1403,7 +1500,7 @@ class SearchExecutor:
                                 else None)
             if base is not None:
                 key = ("msearch", base)
-                hit = rc.REQUEST_CACHE.get(key)
+                hit = _cache_get_isolated(rc, key)
                 if hit is not rc.REQUEST_CACHE._MISS:
                     responses[i] = self._render_cached_msearch(hit, start)
                     return
@@ -1501,6 +1598,7 @@ class SearchExecutor:
 
         from opensearch_tpu.search.warmup import WARMUP
         pending = []
+        dead: set = set()
         for (struct, shape_sig, k_fetch), idxs in groups.items():
             b_pad = pad_bucket(len(idxs), minimum=1)
             pad_rows = b_pad - len(idxs)
@@ -1521,16 +1619,50 @@ class SearchExecutor:
                 buf, layout = pack_leaves(stacked)
                 k_seg = min(k_fetch, pad_bucket(max(seg.num_docs, 1)))
                 plans0 = prepared[idxs[0]][3][seg_i]
-                fn = _batched_hybrid_runner(plans0, meta, k_seg, layout,
-                                            treedef)
-                pending.append((idxs, seg_i, k_seg, len(plans0),
-                                fn(arrays, jnp.asarray(buf))))
+                try:
+                    fn = _batched_hybrid_runner(plans0, meta, k_seg,
+                                                layout, treedef)
+
+                    def _dispatch(fn=fn, arrays=arrays, buf=buf):
+                        if faults.ENABLED:
+                            faults.fire("query.dispatch")
+                        return fn(arrays, jnp.asarray(buf))
+                    out = retry.call_with_retry(_dispatch,
+                                                label="msearch.dispatch")
+                except Exception as e:
+                    if raise_item_errors:
+                        raise
+                    err = _item_error(e) \
+                        if isinstance(e, OpenSearchTpuError) \
+                        else _item_error_untyped(e)
+                    for i in idxs:
+                        responses[i] = dict(err)
+                        dead.add(i)
+                    break
+                pending.append((idxs, seg_i, k_seg, len(plans0), out))
 
         results = {i: _empty_hybrid_result(prepared[i][1])
                    for i in prepared}
         if pending:
-            fetched = jax.device_get(
-                [packed for _, _, _, _, packed in pending])
+            def _collect():
+                if faults.ENABLED:
+                    faults.fire("fetch.gather")
+                return jax.device_get(
+                    [packed for _, _, _, _, packed in pending])
+            try:
+                fetched = retry.call_with_retry(_collect,
+                                                label="fetch.gather")
+            except Exception as e:
+                if raise_item_errors:
+                    raise
+                err = _item_error(e) if isinstance(e, OpenSearchTpuError) \
+                    else _item_error_untyped(e)
+                for idxs, _s, _k, _n, _p in pending:
+                    for i in idxs:
+                        responses[i] = dict(err)
+                        dead.add(i)
+                fetched = []
+                pending = []
             for (idxs, seg_i, k_seg, n_sub, _), packed in zip(pending,
                                                               fetched):
                 packed = np.asarray(packed)
@@ -1538,6 +1670,8 @@ class SearchExecutor:
                     _accumulate_hybrid_row(results[i], packed[row_i],
                                            seg_i, k_seg, n_sub)
         for i, result in results.items():
+            if i in dead:
+                continue
             body, n_sub = prepared[i][0], prepared[i][1]
             result.bounds = [tuple(b) for b in result.bounds]
             responses[i] = hyb.merge_and_render(
@@ -1617,7 +1751,8 @@ class SearchExecutor:
                 agg_plans_per_seg, agg_nodes, False)
 
     def _msearch_prepare(self, batchable, responses, start, ph,
-                         raise_item_errors: bool = False):
+                         raise_item_errors: bool = False,
+                         deadline: Optional[float] = None):
         """Wave half 1: compile + group + stack + pack + DISPATCH (async).
         Returns the state _msearch_finish consumes.
 
@@ -1729,7 +1864,17 @@ class SearchExecutor:
         # across varying msearch batch sizes.
         from opensearch_tpu.search.warmup import WARMUP
         pending = []
+        dead: set = set()       # items already answered (error/timeout):
+        # _msearch_finish must not overwrite their responses
         for (struct, agg_sig, shape_sig, k_fetch), idxs in groups.items():
+            if deadline is not None and time.monotonic() > deadline:
+                # budget spent between waves: unlaunched groups render as
+                # zero-hit timed-out partials, launched ones still finish
+                for i in idxs:
+                    if responses[i] is None:
+                        responses[i] = _timed_out_item(start)
+                    dead.add(i)
+                continue
             b_pad = pad_bucket(len(idxs), minimum=1)
             pad_rows = b_pad - len(idxs)
             # register this (plan-struct, shape-bucket) combination so an
@@ -1755,23 +1900,44 @@ class SearchExecutor:
                 buf, layout = pack_leaves(stacked)
                 k_seg = min(k_fetch, pad_bucket(max(seg.num_docs, 1)))
                 plan0 = compiled[idxs[0]][seg_i]
-                if agg_sig is not None:
-                    fn, out_layout, agg_w = _agg_envelope_runner(
-                        plan_struct(plan0), plan0, meta, k_seg, layout,
-                        treedef, tuple(axes), agg_sig[seg_i],
-                        agg_by_i[idxs[0]][seg_i], arrays, group_flats[0])
-                    pending.append((idxs, seg_i, k_seg,
-                                    fn(arrays, jnp.asarray(buf)),
-                                    out_layout))
-                else:
-                    fn = _envelope_runner(plan_struct(plan0), plan0, meta,
-                                          k_seg, layout, treedef)
-                    pending.append((idxs, seg_i, k_seg,
-                                    fn(arrays, jnp.asarray(buf)), None))
+                try:
+                    if agg_sig is not None:
+                        fn, out_layout, agg_w = _agg_envelope_runner(
+                            plan_struct(plan0), plan0, meta, k_seg,
+                            layout, treedef, tuple(axes), agg_sig[seg_i],
+                            agg_by_i[idxs[0]][seg_i], arrays,
+                            group_flats[0])
+                    else:
+                        fn = _envelope_runner(plan_struct(plan0), plan0,
+                                              meta, k_seg, layout,
+                                              treedef)
+                        out_layout = None
+
+                    def _dispatch(fn=fn, arrays=arrays, buf=buf):
+                        if faults.ENABLED:
+                            faults.fire("query.dispatch")
+                        return fn(arrays, jnp.asarray(buf))
+                    out = retry.call_with_retry(_dispatch,
+                                                label="msearch.dispatch")
+                except Exception as e:
+                    # a runtime device fault downgrades ONLY this group's
+                    # items to per-item error objects (extending the
+                    # malformed-item machinery to runtime faults) — the
+                    # envelope and sibling groups are untouched
+                    if raise_item_errors:
+                        raise
+                    err = _item_error(e) \
+                        if isinstance(e, OpenSearchTpuError) \
+                        else _item_error_untyped(e)
+                    for i in idxs:
+                        responses[i] = dict(err)
+                        dead.add(i)
+                    break       # no point dispatching more segments
+                pending.append((idxs, seg_i, k_seg, out, out_layout))
         ph["stack_pack_dispatch"] += time.monotonic() - _t
         return {"groups": groups, "entry_by_i": entry_by_i,
                 "pending": pending, "agg_by_i": agg_by_i,
-                "agg_nodes_by_i": agg_nodes_by_i}
+                "agg_nodes_by_i": agg_nodes_by_i, "dead": dead}
 
     def _msearch_finish(self, state, responses, start, ph):
         """Wave half 2: ONE device_get for the wave's outputs (concatenated
@@ -1788,6 +1954,7 @@ class SearchExecutor:
                                        state["pending"])
         agg_by_i = state.get("agg_by_i") or {}
         agg_nodes_by_i = state.get("agg_nodes_by_i") or {}
+        dead = state.get("dead") or set()
         grouped = [i for idxs in groups.values() for i in idxs]
         per_query_segs: Dict[int, List[Tuple[int, np.ndarray, np.ndarray]]] = \
             {i: [] for i in grouped}
@@ -1795,21 +1962,52 @@ class SearchExecutor:
         per_query_decoded: Dict[int, list] = {i: [] for i in agg_by_i}
         if not pending:
             return
-        if len(pending) > 1:
-            combined = np.asarray(jax.device_get(_concat_rows(
-                tuple(packed for _, _, _, packed, _ in pending))))
-            fetched = []
-            row = 0
-            for _, _, _, packed, _ in pending:
-                rows, width = packed.shape
-                fetched.append(combined[row:row + rows, :width])
-                row += rows
-        else:
-            fetched = jax.device_get(
+
+        def _fetch_all():
+            if faults.ENABLED:
+                faults.fire("fetch.gather")
+            if len(pending) > 1:
+                combined = np.asarray(jax.device_get(_concat_rows(
+                    tuple(packed for _, _, _, packed, _ in pending))))
+                out = []
+                row = 0
+                for _, _, _, packed, _ in pending:
+                    rows, width = packed.shape
+                    out.append(combined[row:row + rows, :width])
+                    row += rows
+                return out
+            return jax.device_get(
                 [packed for _, _, _, packed, _ in pending])
+
+        try:
+            fetched = retry.call_with_retry(_fetch_all,
+                                            label="fetch.gather")
+        except Exception:
+            # the combined gather failed as a unit: fall back to one
+            # fetch per dispatched program, so a single bad program
+            # downgrades only ITS items to error objects
+            fetched = []
+            for idxs, _seg_i, _k_seg, packed, _ol in pending:
+                def _one(packed=packed):
+                    if faults.ENABLED:
+                        faults.fire("fetch.gather")
+                    return np.asarray(jax.device_get(packed))
+                try:
+                    fetched.append(retry.call_with_retry(
+                        _one, label="fetch.gather"))
+                except Exception as e:
+                    fetched.append(None)
+                    err = _item_error(e) \
+                        if isinstance(e, OpenSearchTpuError) \
+                        else _item_error_untyped(e)
+                    for i in idxs:
+                        responses[i] = dict(err)
+                        dead.add(i)
         ph["device_get"] += time.monotonic() - _t; _t = time.monotonic()
         for (idxs, seg_i, k_seg, _, out_layout), packed in zip(pending,
                                                                fetched):
+            if packed is None:
+                continue            # this program's items are dead
             packed = np.asarray(packed)
             scores_b, idx_b, total_b = unpack_batched_result(
                 packed[:, :2 * k_seg + 1], k_seg)
@@ -1828,6 +2026,8 @@ class SearchExecutor:
         index_name = self.reader.index_name
         resp_cache_keys = state.get("resp_cache_keys", {})
         for i, seg_results in per_query_segs.items():
+            if i in dead:
+                continue        # already answered (error/timeout item)
             entry = entry_by_i[i]
             body, size, from_ = entry[1], entry[3], entry[4]
             page_segs: Optional[list] = None
@@ -1910,9 +2110,10 @@ class SearchExecutor:
                 # cached at query-phase granularity (totals + decoded agg
                 # partials); the response dict handed to the caller is
                 # NOT stored — _render_cached_msearch rebuilds one per hit
-                _request_cache().REQUEST_CACHE.put(
-                    key, (per_query_total[i], per_query_decoded.get(i),
-                          agg_nodes_by_i.get(i)))
+                _cache_put_isolated(
+                    _request_cache(), key,
+                    (per_query_total[i], per_query_decoded.get(i),
+                     agg_nodes_by_i.get(i)))
         ph["respond"] += time.monotonic() - _t
 
     def _render_cached_msearch(self, cached, start: float) -> dict:

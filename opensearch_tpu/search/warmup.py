@@ -232,8 +232,19 @@ class WarmupRegistry:
                 try:
                     bodies = [entry["body"]] * max(int(entry.get(
                         "b_pad", 1)), 1)
-                    executor.multi_search(bodies,
-                                          _bypass_request_cache=True)
+
+                    def _replay(bodies=bodies):
+                        # fault site + bounded transient retry: a flaky
+                        # replay costs a retry, not the whole entry —
+                        # and a permanently failing entry costs only
+                        # itself (errors += 1), never index-open
+                        from opensearch_tpu.common import faults
+                        if faults.ENABLED:
+                            faults.fire("warmup.replay")
+                        executor.multi_search(bodies,
+                                              _bypass_request_cache=True)
+                    from opensearch_tpu.common import retry as _retry
+                    _retry.call_with_retry(_replay, label="warmup.replay")
                     warmed += 1
                 except Exception:
                     errors += 1

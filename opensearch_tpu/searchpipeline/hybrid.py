@@ -158,7 +158,8 @@ def validate_hybrid_request(body: dict, n_sub: int, spec: dict,
 def merge_and_render(executors: List, body: dict, shard_results: List,
                      spec: dict, start: float, n_sub: int,
                      total_shards: Optional[int] = None,
-                     failed_shards: int = 0) -> dict:
+                     failed_shards: int = 0,
+                     failures: Optional[List[dict]] = None) -> dict:
     """The hybrid reduce: global bounds (the collective-merge analog) →
     normalize every candidate → weighted combine → page render. Shared
     by execute_hybrid_search and the batched _msearch hybrid envelope."""
@@ -215,12 +216,16 @@ def merge_and_render(executors: List, body: dict, shard_results: List,
             hits_block = {"total": {"value": total, "relation": "eq"},
                           **hits_block}
 
+    n_failed = failed_shards + len(failures or [])
+    shards_block: Dict[str, Any] = {
+        "total": n_shards, "successful": max(n_shards - n_failed, 0),
+        "skipped": 0, "failed": n_failed}
+    if failures:
+        shards_block["failures"] = list(failures)
     return {
         "took": int((time.monotonic() - start) * 1000),
         "timed_out": False,
-        "_shards": {"total": n_shards,
-                    "successful": n_shards - failed_shards,
-                    "skipped": 0, "failed": failed_shards},
+        "_shards": shards_block,
         "hits": hits_block,
     }
 
@@ -230,13 +235,21 @@ def execute_hybrid_search(executors: List, body: dict,
                           extra_filters: Optional[List[Optional[dict]]]
                           = None,
                           total_shards: Optional[int] = None,
-                          failed_shards: int = 0, task=None) -> dict:
+                          failed_shards: int = 0, task=None,
+                          allow_partial: bool = True) -> dict:
     """Full hybrid query-then-fetch over shard executors.
 
     Per shard the FUSED program returns per-sub-query candidates + score
     bounds; the merge reduces bounds globally (spmd.merge_hybrid_bounds),
     normalizes every candidate with the global statistics, combines into
-    one score per doc, and renders the page with the standard fetch."""
+    one score per doc, and renders the page with the standard fetch.
+    A failed shard contributes an empty result + a `_shards.failures[]`
+    entry (same partial contract as the plain controller path)."""
+    from opensearch_tpu.common import faults
+    from opensearch_tpu.common.errors import (
+        SearchPhaseExecutionError, TaskCancelledError,
+        shard_failure_entry)
+    from opensearch_tpu.search.executor import _empty_hybrid_result
     start = time.monotonic()
     spec = resolve_spec(phase_spec)
     node = dsl.parse_query(body.get("query"))
@@ -247,13 +260,37 @@ def execute_hybrid_search(executors: List, body: dict,
     _size, _from, k = validate_hybrid_request(body, n_sub, spec, executors)
 
     shard_results = []
+    failures: List[dict] = []
     for shard_i, ex in enumerate(executors):
         if task is not None:
             task.check_cancelled()
         extra = extra_filters[shard_i] if extra_filters else None
-        shard_results.append(
-            ex.execute_hybrid_query_phase(body, k, extra_filter=extra))
+        try:
+            if faults.ENABLED:
+                faults.fire("query.shard")
+            shard_results.append(
+                ex.execute_hybrid_query_phase(body, k, extra_filter=extra))
+        except TaskCancelledError:
+            raise
+        except Exception as e:
+            from opensearch_tpu.common.errors import OpenSearchTpuError
+            if isinstance(e, OpenSearchTpuError) and e.status < 500:
+                # deterministic request defect (parse/validation): every
+                # shard would fail identically — keep the 4xx contract
+                raise
+            failures.append(shard_failure_entry(
+                shard_i, ex.reader.index_name, e))
+            shard_results.append(_empty_hybrid_result(n_sub))
 
+    if failures and len(failures) >= len(executors):
+        raise SearchPhaseExecutionError(
+            "all shards failed", phase="query", grouped=True,
+            failed_shards=failures)
+    if failures and not allow_partial:
+        raise SearchPhaseExecutionError(
+            "Partial shards failure", phase="query", grouped=True,
+            failed_shards=failures)
     return merge_and_render(executors, body, shard_results, spec, start,
                             n_sub, total_shards=total_shards,
-                            failed_shards=failed_shards)
+                            failed_shards=failed_shards,
+                            failures=failures)

@@ -1,0 +1,41 @@
+"""Tier-1 wiring of tools/chaos_sweep.py (the sweep_delta pattern): the
+fast subset — every fault site × {exception, transient} plus the
+timeout / msearch-isolation / hybrid scenario rows — must hold the
+fault-tolerance contract: every outcome is a differential-oracle-correct
+partial result or a clean typed error, never an uncaught 500 or a
+corrupt page. The delay rows (wall-clock, no extra coverage) stay in
+the standalone tool."""
+
+import importlib.util
+import os
+
+from opensearch_tpu.common import faults
+
+_TOOL = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "chaos_sweep.py")
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("chaos_sweep", _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_chaos_sweep_fast_subset_holds_contract():
+    mod = _load_tool()
+    try:
+        rows, violations = mod.run_sweep(fast=True)
+    finally:
+        faults.clear()      # never leak rules into sibling tests
+    assert not violations, "\n".join(violations)
+    # every site got at least its exception + transient rows
+    covered = {site for site, _, _, _ in rows}
+    assert covered == set(faults.SITES)
+    # the scenario rows ran (timeout, msearch isolation, hybrid)
+    kinds = {kind for _, kind, _, _ in rows}
+    assert "delay+timeout=10ms" in kinds
+    workloads = {w for _, _, w, _ in rows}
+    assert "msearch B=8" in workloads and "hybrid" in workloads
+    # injection must be fully torn down after the sweep
+    assert faults.ENABLED is False
