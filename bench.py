@@ -107,9 +107,19 @@ def _setup_telemetry():
     from opensearch_tpu.telemetry.tracer import NOOP_SPAN
     if TELEMETRY_ON:
         TELEMETRY.enable()
+        # transfer ledger (telemetry/ledger.py) rides the same flag: the
+        # output line gains the per-channel byte/round-trip decomposition
+        TELEMETRY.ledger.enabled = True
         return
     assert TELEMETRY.tracer.start_trace("bench.noop-probe") is NOOP_SPAN, \
         "tracer must be a no-op when telemetry is disabled"
+    # same no-op discipline for the transfer ledger: disabled means the
+    # per-request gate hands back None (one attribute load + branch on
+    # the hot path — the contract tests/test_transfer_ledger.py pins)
+    assert TELEMETRY.ledger.enabled is False, \
+        "transfer ledger must be disabled for clean benches"
+    assert TELEMETRY.ledger.scope() is None, \
+        "disabled ledger must be a no-op (scope gate must return None)"
 
 
 def _setup_faults():
@@ -178,7 +188,48 @@ def _telemetry_summary():
                      "search.plan_compiles", "search.template_binds",
                      "search.xla_cache_miss")
         if name in snap["counters"]}
+    if TELEMETRY.ledger.enabled:
+        # the full per-channel transfer decomposition: the input
+        # tools/transfer_report.py renders (and PROFILE.md records)
+        out["transfers"] = TELEMETRY.ledger.snapshot()
+        out["device_memory"] = TELEMETRY.device_memory.stats()
     return out
+
+
+def _ledger_warm_stats(runs: int, n_queries: int, warm_wall_s: float):
+    """Per-query transfer volume + estimated ledger overhead for the warm
+    timed window (ledger reset before it, so the snapshot covers exactly
+    `runs` passes over `n_queries` bodies). Overhead is estimated from
+    the measured per-record cost × records-per-run — a tunneled device's
+    25-400 ms round-trip jitter would drown a wall-clock A/B — and
+    ASSERTED under 2% of warm wall time."""
+    from opensearch_tpu.telemetry import TELEMETRY
+    from opensearch_tpu.telemetry.ledger import LedgerScope, TransferLedger
+    snap = TELEMETRY.ledger.snapshot()
+    d2h = snap["bytes_total"].get("d2h", 0)
+    records = sum(ent["transfers"] for per_dir in snap["channels"].values()
+                  for ent in per_dir.values())
+    get_calls = snap["device_get"]["calls"]
+    # per-op cost measured on a throwaway ledger (never pollutes the
+    # run's channel aggregates)
+    probe, sc = TransferLedger(), LedgerScope()
+    probe.enabled = True
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        probe.record("probe", "d2h", 1024, scope=sc)
+    per_record_s = (time.perf_counter() - t0) / n
+    t0 = time.perf_counter()
+    for _ in range(n // 10):
+        probe.note_device_get(1.0, nbytes=1024, scope=sc)
+    per_get_s = (time.perf_counter() - t0) / (n // 10)
+    est_s = (records * per_record_s + get_calls * per_get_s) / max(runs, 1)
+    pct = 100.0 * est_s / max(warm_wall_s, 1e-9)
+    assert pct < 2.0, \
+        f"ledger overhead {pct:.3f}% of warm wall time (contract: <2%)"
+    return {"bytes_fetched_per_query": round(d2h / max(runs * n_queries, 1),
+                                             1),
+            "ledger_overhead_pct": round(pct, 4)}
 
 
 def build_index():
@@ -636,17 +687,26 @@ def main():
     # batched via _msearch — one vmapped device program per signature group.
     executor.multi_search(bodies)
 
+    if TELEMETRY_ON:
+        # scope the ledger window to the warm timed runs below, so
+        # bytes_fetched_per_query divides cleanly by runs × B
+        from opensearch_tpu.telemetry import TELEMETRY
+        TELEMETRY.ledger.reset()
+
     # median of several timed runs: the tunneled device's round-trip
     # latency varies 25-400ms run to run, which would otherwise dominate
     # a single measurement
     times = []
     lat_ms = []
-    for _ in range(5):
+    n_runs = 5
+    for _ in range(n_runs):
         t0 = time.perf_counter()
         executor.multi_search(bodies)
         times.append(time.perf_counter() - t0)
     dt = sorted(times)[len(times) // 2]
     qps = len(bodies) / dt
+    ledger_stats = _ledger_warm_stats(n_runs, len(bodies), dt) \
+        if TELEMETRY_ON else None
 
     # per-query latency distribution (single-search path, B=1 programs);
     # warm the B=1 executables first — a serving node is steady-state warm
@@ -669,6 +729,8 @@ def main():
         "p99_ms": round(lat_ms[min(len(lat_ms) - 1,
                                    int(len(lat_ms) * 0.99))], 2),
     }
+    if ledger_stats is not None:
+        out.update(ledger_stats)
     _t = _telemetry_summary()
     if _t is not None:
         out["telemetry"] = _t

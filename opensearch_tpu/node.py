@@ -112,7 +112,8 @@ class Node:
             enabled=_tel_bool("telemetry.tracing.enabled"),
             jsonl=_tel_bool("telemetry.tracing.jsonl"),
             ring_size=int(self.settings.get("telemetry.tracing.ring_size",
-                                            256)))
+                                            256)),
+            transfers=_tel_bool("telemetry.transfers.enabled"))
         self.controller = RestController()
         from opensearch_tpu.rest.actions import register_all
         register_all(self)

@@ -180,6 +180,16 @@ def _tree_to_jnp(tree):
     return jnp.asarray(tree)
 
 
+def tree_nbytes(tree) -> int:
+    """Total array bytes in a device pytree (dict-of-dicts-of-arrays) —
+    `nbytes` is shape·itemsize metadata on both numpy and jax arrays, so
+    this never forces a device sync. Feeds the transfer ledger's
+    `upload.corpus` channel and the corpus-columns memory gauge."""
+    if isinstance(tree, dict):
+        return sum(tree_nbytes(v) for v in tree.values())
+    return int(getattr(tree, "nbytes", 0))
+
+
 def refresh_live(arrays: Dict, seg: Segment):
     """Re-upload just the liveness bitmap after deletes."""
     d_pad = arrays["live"].shape[0]

@@ -243,6 +243,12 @@ def _maybe_slow_log(node, index_expr, body, res, phase_times=None):
     phase_ms = {"query": phase_times.get("query", took_ms),
                 "fetch": phase_times.get("fetch", 0.0)}
     total_hits = (res.get("hits", {}).get("total") or {}).get("value")
+    # transfer attribution (telemetry/ledger.py via the request's
+    # LedgerScope): a slow query whose wall is transfer volume says so in
+    # its own log line. 0 when the ledger is off — the fields stay so
+    # line-parsers see a fixed shape.
+    bytes_fetched = int(phase_times.get("bytes_fetched", 0) or 0)
+    device_get_ms = float(phase_times.get("device_get", 0.0) or 0.0)
     for name in node.indices.resolve(index_expr, ignore_unavailable=True):
         settings = node.indices.get(name).settings
         for phase, t_ms in phase_ms.items():
@@ -260,8 +266,9 @@ def _maybe_slow_log(node, index_expr, body, res, phase_times=None):
                 _slow_logger(phase).log(
                     py_level,
                     "[%s] took[%sms], took[%s][%.1fms], total_hits[%s], "
-                    "source[%s]",
-                    name, took_ms, phase, t_ms, total_hits, body)
+                    "bytes_fetched[%s], device_get_ms[%.1f], source[%s]",
+                    name, took_ms, phase, t_ms, total_hits,
+                    bytes_fetched, device_get_ms, body)
                 break               # most severe matching level only
 
 
@@ -2110,11 +2117,37 @@ def register_telemetry_actions(node, c):
     def do_metrics(req):
         return {"metrics": TELEMETRY.metrics.to_dict()}
 
+    def do_get_transfers(req):
+        # the transfer ledger's aggregate face (telemetry/ledger.py):
+        # per-channel host↔device bytes/round-trips + the live rolling
+        # bytes-per-wave / device_get-wall percentiles, next to the
+        # device-memory gauges (the HBM analog of JVM mem stats)
+        return {"transfers": TELEMETRY.ledger.snapshot(),
+                "device_memory": TELEMETRY.device_memory.stats()}
+
+    def do_transfers_enable(req):
+        TELEMETRY.ledger.enabled = True
+        return {"acknowledged": True, "enabled": True}
+
+    def do_transfers_disable(req):
+        TELEMETRY.ledger.enabled = False
+        return {"acknowledged": True, "enabled": False}
+
+    def do_transfers_clear(req):
+        TELEMETRY.ledger.reset()
+        return {"acknowledged": True}
+
     c.register("GET", "/_telemetry/traces", do_get_traces)
     c.register("POST", "/_telemetry/traces/_clear", do_clear_traces)
     c.register("POST", "/_telemetry/_enable", do_enable)
     c.register("POST", "/_telemetry/_disable", do_disable)
     c.register("GET", "/_telemetry/metrics", do_metrics)
+    c.register("GET", "/_telemetry/transfers", do_get_transfers)
+    c.register("POST", "/_telemetry/transfers/_enable",
+               do_transfers_enable)
+    c.register("POST", "/_telemetry/transfers/_disable",
+               do_transfers_disable)
+    c.register("POST", "/_telemetry/transfers/_clear", do_transfers_clear)
 
 
 # -------------------------------------------------------------------- tasks

@@ -160,12 +160,34 @@ class _Ctx:
     fused: bool = True
 
 
+def _register_const_bytes(plans: List[AggPlan], seg: Segment) -> None:
+    """Account the fused kinds' embedded constant tables (bucket_bits /
+    presence_bits bitmask words etc.) for the device-memory gauge: they
+    are content-baked into the executable, so they occupy HBM for the
+    executable's lifetime. The per-(sig, input) byte map lives ON the
+    segment object and is summed by the executor's weak-ref reader
+    provider — lifetime tracks liveness exactly (index delete, shard
+    close, clone replacement all drop the object from the sum), with no
+    release hook to forget."""
+    table = getattr(seg, "_agg_const_bytes", None)
+    if table is None:
+        table = seg._agg_const_bytes = {}
+    for p in plans:
+        consts = getattr(p, "const_inputs", None) or {}
+        for name, arr in consts.items():
+            table[(p.sig(), name)] = int(getattr(arr, "nbytes", 0))
+        if p.children:
+            _register_const_bytes(p.children, seg)
+
+
 def compile_aggs(nodes: List[AggNode], mapper: MapperService, seg: Segment,
                  meta, compiler: Compiler,
                  allow_fused: bool = True) -> List[AggPlan]:
     ctx = _Ctx(mapper, seg, meta, compiler, pad_bucket(max(seg.num_docs, 1)),
                fused=allow_fused)
-    return [_compile_node(n, ctx, root=True) for n in nodes]
+    plans = [_compile_node(n, ctx, root=True) for n in nodes]
+    _register_const_bytes(plans, seg)
+    return plans
 
 
 def _num_col(ctx: _Ctx, field: str):

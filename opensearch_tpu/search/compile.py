@@ -36,6 +36,26 @@ _PLAN_COMPILES = TELEMETRY.metrics.counter("search.plan_compiles")
 _TEMPLATE_BINDS = TELEMETRY.metrics.counter("search.template_binds")
 _MEMO_ROTATIONS = TELEMETRY.metrics.counter("search.memo_rotations")
 
+# live RotatingMemo instances, sampled by the device-memory accounting
+# (telemetry/ledger.py): interned plan bundles hold flattened host
+# arrays destined for the device, so their retained bytes belong in the
+# memory stats next to the corpus columns. Weak refs — a dropped reader
+# takes its memo's bytes out of the gauge with no unregistration hook.
+import weakref
+
+_LIVE_MEMOS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _memo_memory_stats() -> dict:
+    memos = list(_LIVE_MEMOS)
+    return {"live_bytes": sum(m.cost_bytes for m in memos),
+            "entries": sum(len(m) for m in memos),
+            "memos": len(memos)}
+
+
+TELEMETRY.device_memory.add_provider("interned_bundles",
+                                     _memo_memory_stats)
+
 
 class RotatingMemo:
     """Two-generation bounded memo replacing the clear-at-limit wipe.
@@ -53,7 +73,8 @@ class RotatingMemo:
     `byte_limit`, so a stream of distinct high-cardinality filters is
     bounded in bytes, not just entry count."""
 
-    __slots__ = ("limit", "byte_limit", "_new", "_old", "_new_cost")
+    __slots__ = ("limit", "byte_limit", "_new", "_old", "_new_cost",
+                 "_old_cost", "__weakref__")
     _MISS = object()
 
     def __init__(self, limit: int = 8192, byte_limit: int = 256 << 20):
@@ -62,6 +83,14 @@ class RotatingMemo:
         self._new: Dict[Any, Any] = {}
         self._old: Dict[Any, Any] = {}
         self._new_cost = 0
+        self._old_cost = 0
+        _LIVE_MEMOS.add(self)
+
+    @property
+    def cost_bytes(self) -> int:
+        """Retained bytes across both generations (cost-carrying entries
+        only — promotions re-count as 0, an acceptable undercount)."""
+        return self._new_cost + self._old_cost
 
     def get(self, key, default=None):
         v = self._new.get(key, self._MISS)
@@ -79,6 +108,7 @@ class RotatingMemo:
         self._new_cost += cost
         if len(new) >= self.limit or self._new_cost >= self.byte_limit:
             self._old = new
+            self._old_cost = self._new_cost
             self._new = {}
             self._new_cost = 0
             _MEMO_ROTATIONS.inc()
@@ -96,6 +126,7 @@ class RotatingMemo:
         self._new = {}
         self._old = {}
         self._new_cost = 0
+        self._old_cost = 0
 
 DEFAULT_K1 = 1.2
 DEFAULT_B = 0.75

@@ -235,6 +235,16 @@ class _PhaseTimer:
         return False
 
 
+def _publish_scope(scope, span, phase_times: Optional[dict]) -> None:
+    """Attach a request's transfer accounting (telemetry/ledger.py
+    LedgerScope) to its span and to the caller's phase_times dict, where
+    the slow log reads the `device_get`/`bytes_fetched` fields. The
+    field set lives on LedgerScope.publish — shared with the msearch
+    envelope's own publication."""
+    if scope is not None:
+        scope.publish(span, phase_times)
+
+
 def execute_search(executors: List, body: Optional[dict],
                    total_shards: Optional[int] = None,
                    failed_shards: int = 0,
@@ -280,6 +290,14 @@ def execute_search(executors: List, body: Optional[dict],
         trace = NOOP_SPAN
     body = body or {}
     _validate_search_body_keys(body)
+    # per-request transfer accounting (telemetry/ledger.py): None unless
+    # the ledger is enabled or this request traces/profiles — the
+    # zero-overhead default. Feeds the span's bytes_to_device/
+    # bytes_fetched, the Profile API's transfers[] and the slow log's
+    # bytes_fetched/device_get fields on EVERY dispatch path (general
+    # host loop, envelope, hybrid) — the attribution used to exist only
+    # in the general path's single-branch sum.
+    req_scope = TELEMETRY.ledger.scope(trace)
     query_spec = body.get("query")
     if isinstance(query_spec, dict) and "hybrid" in query_spec:
         # hybrid dense+sparse clause: its sub-queries keep SEPARATE score
@@ -293,12 +311,15 @@ def execute_search(executors: List, body: Optional[dict],
         from opensearch_tpu.searchpipeline.hybrid import \
             execute_hybrid_search
         trace.set_attribute("query_type", "hybrid")
-        with trace.child("query", path="hybrid_fused"):
-            return execute_hybrid_search(
+        with trace.child("query", path="hybrid_fused") as hq:
+            res = execute_hybrid_search(
                 executors, body, phase_spec=phase_processors,
                 extra_filters=extra_filters, total_shards=total_shards,
                 failed_shards=failed_shards, task=task,
-                allow_partial=_resolve_allow_partial(body, allow_partial))
+                allow_partial=_resolve_allow_partial(body, allow_partial),
+                ledger_scope=req_scope)
+        _publish_scope(req_scope, hq, phase_times)
+        return res
     if (allow_envelope and len(executors) == 1 and total_shards is None
             and failed_shards == 0 and cursor_tiebreak is None
             and not (extra_filters and extra_filters[0])):
@@ -309,13 +330,15 @@ def execute_search(executors: List, body: Optional[dict],
             # dashboard batches (bit-identical scores), so the warmup
             # registry's (plan-struct, shape-bucket) coverage extends to
             # REST _search singles, not just _msearch
-            with trace.child("query", path="envelope"):
+            with trace.child("query", path="envelope") as eq:
                 # straight into the envelope (search() would re-check
                 # _msearch_batchable); errors raise — the per-item error
-                # objects are an _msearch-only contract
+                # objects are an _msearch-only contract. The envelope
+                # sets its own transfer attribution on the child span
+                # and fills phase_times for the slow log.
                 return executors[0].multi_search(
-                    [body], _raise_item_errors=True,
-                    task=task)["responses"][0]
+                    [body], _raise_item_errors=True, task=task,
+                    trace=eq, phase_times=phase_times)["responses"][0]
     start = time.monotonic()
     start_ns = time.perf_counter_ns()
     deadline = _parse_deadline(body)
@@ -344,6 +367,11 @@ def execute_search(executors: List, body: Optional[dict],
         # node-wide tracing is off; a forced trace records locally but is
         # never retained in the tracer's ring buffer
         trace = TELEMETRY.tracer.start_trace("search", force=True)
+        if req_scope is None:
+            # the scope gate ran before the forced trace existed: profile
+            # requests always account transfers (ledger.scope() treats a
+            # recording trace as opt-in)
+            req_scope = TELEMETRY.ledger.scope(trace)
     phases: dict = {}            # phase name -> accumulated ns
     profile_shards: List[dict] = []
     with _PhaseTimer(trace, phases, "parse"):
@@ -512,7 +540,7 @@ def execute_search(executors: List, body: Optional[dict],
                         body, k_eff, extra_filter=extra,
                         stats_override=dfs_overrides[shard_i]
                         if dfs_overrides else None,
-                        trace=qt.span)
+                        trace=qt.span, ledger_scope=req_scope)
                     qt.set_attribute("candidates", len(cands))
             except TaskCancelledError:
                 raise                   # cancellation is not a failure
@@ -544,6 +572,10 @@ def execute_search(executors: List, body: Optional[dict],
                     breakdown.update(
                         {k2: v for k2, v in qt.span.attributes.items()
                          if k2 not in ("shard", "candidates")})
+                # the per-transfer list is a first-class profile field,
+                # not a breakdown scalar: transfers[] per shard is the
+                # ledger's contract with the Profile API
+                shard_transfers = breakdown.pop("transfers", [])
                 profile_shards.append({
                     "id": f"[{ex.reader.index_name}][{shard_i}]",
                     "_query_ns": qt.duration_ns,
@@ -554,6 +586,7 @@ def execute_search(executors: List, body: Optional[dict],
                         "breakdown": breakdown,
                     }], "rewrite_time": 0, "collector": []}],
                     "aggregations": [],
+                    "transfers": shard_transfers,
                 })
         with _PhaseTimer(trace, phases, "reduce"):
             candidates.sort(key=_compare_candidates(sort_specs))
@@ -602,7 +635,11 @@ def execute_search(executors: List, body: Optional[dict],
     # sources is cheap), but the response says timed_out
     if task is not None:
         task.check_cancelled()
-    with _PhaseTimer(trace, phases, "fetch") as ft:
+    with _PhaseTimer(trace, phases, "fetch") as ft, \
+            TELEMETRY.ledger.ambient(req_scope):
+        # ambient binding: the fetch sub-phases (inner-hit docvalue
+        # gathers in search/fetch.py) sit too deep to plumb the scope
+        # through — they read it back via ledger.current()
         query_node = dsl.parse_query(body.get("query"))
         from opensearch_tpu.search import fetch as fetch_phase
         page_inner_specs = fetch_phase.collect_inner_hit_specs(query_node)
@@ -716,6 +753,9 @@ def execute_search(executors: List, body: Optional[dict],
     if phase_times is not None:
         phase_times.update(
             {phase_name: ns / 1e6 for phase_name, ns in phases.items()})
+    # root-span + slow-log transfer attribution for the general host-loop
+    # path (the envelope and hybrid paths publish their own above)
+    _publish_scope(req_scope, trace, phase_times)
     if profiling:
         # per-shard per-phase breakdown: coordinator phases (parse,
         # can_match, reduce, fetch, render) are shared across shards,
@@ -736,6 +776,13 @@ def execute_search(executors: List, body: Optional[dict],
         resp["profile"] = {"shards": profile_shards,
                            "total_ns": total_ns,
                            "phases_ns": dict(phases)}
+        if req_scope is not None:
+            # request-level transfer totals: the per-shard transfers[]
+            # above decompose these (telemetry/ledger.py)
+            resp["profile"]["bytes_to_device"] = req_scope.h2d_bytes
+            resp["profile"]["bytes_fetched"] = req_scope.d2h_bytes
+            resp["profile"]["device_get_ms"] = round(
+                req_scope.device_get_ms, 3)
     if page:
         last = page[-1]
         resp["_page_cursor"] = {

@@ -27,6 +27,7 @@ import json
 import os
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -41,7 +42,7 @@ from opensearch_tpu.index.segment import Segment, pad_bucket
 from opensearch_tpu.ops.bm25 import (
     ordinal_terms_match, range_match_on_ranks, score_text_clause)
 from opensearch_tpu.ops.device_segment import (
-    DeviceSegmentMeta, refresh_live, upload_segment)
+    DeviceSegmentMeta, refresh_live, tree_nbytes, upload_segment)
 from opensearch_tpu.ops.topk import NEG_INF
 from opensearch_tpu.search import dsl
 from opensearch_tpu.search.compile import Compiler, Plan, ShardStats
@@ -54,6 +55,39 @@ from opensearch_tpu.telemetry import TELEMETRY
 # sort key for eligible docs that lack the sort field: far below any real
 # rank key, far above NEG_INF (which marks ineligible docs) → fetched last
 MISSING_KEY = np.float32(-1e30)
+
+# transfer ledger + device-memory accounting (telemetry/ledger.py):
+# module-level handles — the guards on the query path are one attribute
+# load, the tracer/fault-injector no-op discipline
+_LEDGER = TELEMETRY.ledger
+_DEVMEM = TELEMETRY.device_memory
+
+# live ShardReaders, sampled by the corpus-columns memory gauge: weak
+# refs so a dropped reader (closed index, finished test) leaves the
+# gauge without an unregistration hook
+_LIVE_READERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _corpus_memory_stats() -> dict:
+    readers = list(_LIVE_READERS)
+    return {"live_bytes": sum(r.device_bytes for r in readers),
+            "segments": sum(len(r.segments) for r in readers),
+            "readers": len(readers)}
+
+
+def _agg_const_memory_stats() -> dict:
+    """Fused-agg executable constants (aggs/engine.py stashes the byte
+    map on each segment): summed over LIVE readers' segments only, so
+    index deletes, shard closes and clone replacements all leave the
+    gauge by construction."""
+    tables = [getattr(seg, "_agg_const_bytes", None)
+              for r in list(_LIVE_READERS) for seg in r.segments]
+    return {"live_bytes": sum(sum(t.values()) for t in tables if t),
+            "entries": sum(len(t) for t in tables if t)}
+
+
+_DEVMEM.add_provider("corpus_columns", _corpus_memory_stats)
+_DEVMEM.add_provider("agg_constants", _agg_const_memory_stats)
 
 
 # --------------------------------------------------------------- shard reader
@@ -72,14 +106,26 @@ class ShardReader:
         self.segments: List[Segment] = []
         self.device: List[Tuple[Dict, DeviceSegmentMeta]] = []
         self._stats_cache: Optional[ShardStats] = None
+        self._seg_bytes: Dict[str, int] = {}    # seg_id → device bytes
+        _LIVE_READERS.add(self)
         for seg in (segments or []):
             self.add_segment(seg)
+
+    @property
+    def device_bytes(self) -> int:
+        """Live device bytes held by this reader's segment images —
+        the corpus-columns slice of the device-memory stats."""
+        return sum(self._seg_bytes.values())
 
     def add_segment(self, seg: Segment):
         arrays, meta = upload_segment(seg)
         self.segments.append(seg)
         self.device.append((arrays, meta))
         self._stats_cache = None
+        nb = tree_nbytes(arrays)
+        self._seg_bytes[seg.seg_id] = nb
+        if _LEDGER.enabled:
+            _LEDGER.record("upload.corpus", "h2d", nb)
 
     def remove_segment(self, seg_id: str):
         for i, seg in enumerate(self.segments):
@@ -87,6 +133,7 @@ class ShardReader:
                 del self.segments[i]
                 del self.device[i]
                 self._stats_cache = None
+                self._seg_bytes.pop(seg_id, None)
                 return
 
     def notify_deletes(self, seg: Segment):
@@ -94,6 +141,10 @@ class ShardReader:
             if s is seg:
                 arrays, meta = self.device[i]
                 self.device[i] = (refresh_live(arrays, seg), meta)
+                if _LEDGER.enabled:
+                    # only the liveness bitmap re-uploads
+                    _LEDGER.record("upload.corpus", "h2d",
+                                   int(arrays["live"].nbytes))
 
     def update_segment(self, seg: Segment):
         """Adopt a possibly-replaced segment object with the same id
@@ -107,9 +158,16 @@ class ShardReader:
                 self.segments[i] = seg
                 arrays, meta = self.device[i]
                 self.device[i] = (refresh_live(arrays, seg), meta)
+                if _LEDGER.enabled:
+                    _LEDGER.record("upload.corpus", "h2d",
+                                   int(arrays["live"].nbytes))
             else:
                 self.segments[i] = seg
                 self.device[i] = upload_segment(seg)
+                nb = tree_nbytes(self.device[i][0])
+                self._seg_bytes[seg.seg_id] = nb
+                if _LEDGER.enabled:
+                    _LEDGER.record("upload.corpus", "h2d", nb)
             self._stats_cache = None
             return
         self.add_segment(seg)
@@ -152,6 +210,13 @@ class PinnedReader:
 # ------------------------------------------------------------------ execution
 
 _JIT_CACHE: Dict[Any, Any] = {}
+
+# executable cache size for the device-memory stats: XLA does not expose
+# per-executable HBM bytes portably, so this class reports counts (the
+# raw backend bytes land in the `hbm` block when available)
+_DEVMEM.add_provider(
+    "compiled_executables",
+    lambda: {"entries": len(_JIT_CACHE)})
 
 
 # per-THREAD compile accounting for request attribution: the XLA compile
@@ -239,6 +304,91 @@ def _timed_out_item(start: float) -> dict:
                           None, [])
     resp["timed_out"] = True
     return resp
+
+
+# ------------------------------------------------------- transfer accounting
+#
+# Channel decomposition of the device_get result layouts: bytes come from
+# array nbytes (metadata — no device sync), padding from the difference
+# against the actually-transferred buffer, so per-channel bytes always
+# sum to the transferred total (tests/test_transfer_ledger.py pins the
+# conservation property).
+
+def _ledger_unbatched_collect(scope, fetched, ms: float) -> None:
+    """One general-path collect: per segment (top_keys, top_scores,
+    top_idx, total, agg_outs) tuples fetched in one round trip."""
+    sort_b = score_b = id_b = tot_b = agg_b = 0
+    for outs in fetched:
+        top_keys, top_scores, top_idx, seg_total, agg_outs = outs
+        sort_b += int(np.asarray(top_keys).nbytes)
+        score_b += int(np.asarray(top_scores).nbytes)
+        id_b += int(np.asarray(top_idx).nbytes)
+        tot_b += int(np.asarray(seg_total).nbytes)
+        if agg_outs:
+            agg_b += sum(int(np.asarray(v).nbytes)
+                         for v in jax.tree_util.tree_leaves(agg_outs))
+    wave = _LEDGER.new_wave()
+    for channel, b in (("sort_keys", sort_b), ("scores", score_b),
+                       ("topk_ids", id_b), ("totals", tot_b),
+                       ("agg_buffers", agg_b)):
+        if b:
+            _LEDGER.record(channel, "d2h", b, wave=wave, scope=scope)
+    _LEDGER.note_device_get(
+        ms, nbytes=sort_b + score_b + id_b + tot_b + agg_b, scope=scope)
+
+
+def _ledger_packed_rows(scope, pending, fetched, actual_bytes: int,
+                        ms: float, round_trips: int) -> None:
+    """One msearch-envelope wave: [B, 2k+1+W] packed rows per program —
+    k scores, k ids, 1 total, W agg-partial floats per row. Real
+    channels count only the group's REAL rows (len(idxs)); batch-pad
+    rows and combined-fetch column padding both land in `padding` via
+    the remainder, so channel bytes sum exactly to the transferred
+    total while the decomposition reports payload, not pad."""
+    score_b = id_b = tot_b = agg_b = 0
+    for (idxs, _seg_i, k_seg, _out, _ol), packed in zip(pending, fetched):
+        if packed is None:
+            continue
+        rows = min(len(idxs), packed.shape[0])
+        width = packed.shape[1]
+        score_b += rows * k_seg * 4
+        id_b += rows * k_seg * 4
+        tot_b += rows * 4
+        agg_b += rows * max(width - 2 * k_seg - 1, 0) * 4
+    wave = _LEDGER.new_wave()
+    pad_b = max(actual_bytes - (score_b + id_b + tot_b + agg_b), 0)
+    for channel, b in (("scores", score_b), ("topk_ids", id_b),
+                       ("totals", tot_b), ("agg_buffers", agg_b),
+                       ("padding", pad_b)):
+        if b:
+            _LEDGER.record(channel, "d2h", b, wave=wave,
+                           round_trips=round_trips, scope=scope)
+    _LEDGER.note_device_get(ms, nbytes=actual_bytes, scope=scope,
+                            round_trips=round_trips)
+
+
+def _ledger_hybrid_rows(scope, programs, ms: float) -> None:
+    """One hybrid-envelope wave: per program (rows, real_rows, k_seg,
+    n_sub) of [rows, n_sub·(2k+4)+1] fused rows — per-sub scores/ids
+    plus the (count, min, max, sum_sq) bounds block and the union
+    total. Batch-pad rows (rows > real_rows) go to the `padding`
+    channel, same as the plain packed path, so the per-channel
+    decomposition reports real payload, not pad."""
+    score_b = id_b = bounds_b = tot_b = pad_b = 0
+    for rows, real_rows, k_seg, n_sub in programs:
+        score_b += real_rows * k_seg * n_sub * 4
+        id_b += real_rows * k_seg * n_sub * 4
+        bounds_b += real_rows * n_sub * 4 * 4
+        tot_b += real_rows * 4
+        pad_b += (rows - real_rows) * (n_sub * (2 * k_seg + 4) + 1) * 4
+    wave = _LEDGER.new_wave()
+    for channel, b in (("scores", score_b), ("topk_ids", id_b),
+                       ("score_bounds", bounds_b), ("totals", tot_b),
+                       ("padding", pad_b)):
+        if b:
+            _LEDGER.record(channel, "d2h", b, wave=wave, scope=scope)
+    _LEDGER.note_device_get(
+        ms, nbytes=score_b + id_b + bounds_b + tot_b + pad_b, scope=scope)
 
 
 def _cache_get_isolated(rc, key):
@@ -1084,7 +1234,8 @@ class SearchExecutor:
 
     def execute_query_phase(self, body: dict, k: int,
                             extra_filter: Optional[dict] = None,
-                            stats_override=None, trace=None):
+                            stats_override=None, trace=None,
+                            ledger_scope=None):
         """Per-shard query phase (SearchService.executeQueryPhase analog):
         returns (candidates, per-segment decoded agg partials, total hits)
         for the coordinator to merge. `k` = from+size requested globally.
@@ -1104,7 +1255,8 @@ class SearchExecutor:
         if body.get("search_type") == "dfs_query_then_fetch" \
                 or "_dfs" in body:
             return self._query_phase_uncached(body, k, extra_filter,
-                                              stats_override, trace)
+                                              stats_override, trace,
+                                              ledger_scope)
         rc = _request_cache()
         if rc.cacheable(body):
             base = rc.cache_key(self.reader.segments, body, k,
@@ -1121,7 +1273,8 @@ class SearchExecutor:
                 if trace is not None:
                     trace.set_attribute("request_cache", "miss")
                 cands, decoded, total = self._query_phase_uncached(
-                    body, k, extra_filter, stats_override, trace)
+                    body, k, extra_filter, stats_override, trace,
+                    ledger_scope)
                 # store candidates as plain tuples: callers mutate
                 # _Candidate.shard_i, which must not leak between hits
                 _cache_put_isolated(
@@ -1129,11 +1282,13 @@ class SearchExecutor:
                                for c in cands], decoded, total))
                 return cands, decoded, total
         return self._query_phase_uncached(body, k, extra_filter,
-                                          stats_override, trace)
+                                          stats_override, trace,
+                                          ledger_scope)
 
     def _query_phase_uncached(self, body: dict, k: int,
                               extra_filter: Optional[dict] = None,
-                              stats_override=None, trace=None):
+                              stats_override=None, trace=None,
+                              ledger_scope=None):
         node = dsl.parse_query(body.get("query"))
         if extra_filter is not None:
             node = dsl.BoolQuery(must=[node],
@@ -1172,6 +1327,9 @@ class SearchExecutor:
         # results in ONE device_get (one transfer round trip total — on a
         # tunneled device the round trip dominates device compute)
         rec = trace is not None and getattr(trace, "recording", False)
+        # per-shard transfer accounting (None = ledger off AND request not
+        # traced/profiled — the zero-overhead path)
+        scope = _LEDGER.scope(trace)
         if rec:
             # request-scoped compile attribution via the thread-local
             # accumulator (_note_compile) — global-counter deltas would
@@ -1179,7 +1337,7 @@ class SearchExecutor:
             _THREAD_COMPILES.active = True
             _THREAD_COMPILES.count = 0
             _THREAD_COMPILES.ms = 0.0
-            plan_compile_ns = dispatch_ns = bytes_to_device = 0
+            plan_compile_ns = dispatch_ns = 0
         launched = []
         from opensearch_tpu.indices.query_cache import FilterCacheContext
         for seg_i, (seg, (arrays, meta)) in enumerate(
@@ -1203,10 +1361,13 @@ class SearchExecutor:
             flat = plan.flatten_inputs([])
             for ap in agg_plans:
                 ap.flatten_inputs(flat)
+            if scope is not None:
+                _LEDGER.record(
+                    "upload.literals", "h2d",
+                    sum(int(np.asarray(v).nbytes)
+                        for d in flat for v in d.values()),
+                    scope=scope)
             if rec:
-                bytes_to_device += sum(
-                    int(np.asarray(v).nbytes)
-                    for d in flat for v in d.values())
                 t0 = time.perf_counter_ns()
             flat = jax.tree_util.tree_map(jnp.asarray, flat)
 
@@ -1230,24 +1391,33 @@ class SearchExecutor:
                 faults.fire("fetch.gather")
             return jax.device_get([out for _, _, _, out in launched])
 
+        t0c = time.monotonic() if scope is not None else 0.0
         if rec:
             try:
                 with trace.child("device_collect", segments=len(launched)):
                     fetched = retry.call_with_retry(
                         _collect, label="fetch.gather", trace=trace)
+            finally:
+                _THREAD_COMPILES.active = False
+        else:
+            fetched = retry.call_with_retry(_collect, label="fetch.gather")
+        if scope is not None:
+            _ledger_unbatched_collect(scope, fetched,
+                                      (time.monotonic() - t0c) * 1000)
+            if rec:
                 xla_compiles = _THREAD_COMPILES.count
                 trace.set_attribute("plan_compile_ns", plan_compile_ns)
                 trace.set_attribute("device_dispatch_ns", dispatch_ns)
-                trace.set_attribute("bytes_to_device", bytes_to_device)
+                trace.set_attribute("bytes_to_device", scope.h2d_bytes)
+                trace.set_attribute("bytes_fetched", scope.d2h_bytes)
+                trace.set_attribute("transfers", scope.to_list())
                 trace.set_attribute("compiled", xla_compiles > 0)
                 if xla_compiles:
                     trace.set_attribute("xla_compiles", xla_compiles)
                     trace.set_attribute("compile_ms",
                                         round(_THREAD_COMPILES.ms, 3))
-            finally:
-                _THREAD_COMPILES.active = False
-        else:
-            fetched = retry.call_with_retry(_collect, label="fetch.gather")
+            if ledger_scope is not None and ledger_scope is not scope:
+                ledger_scope.absorb(scope)
 
         candidates: List[_Candidate] = []
         per_segment_decoded = []
@@ -1269,13 +1439,16 @@ class SearchExecutor:
         return candidates, per_segment_decoded, total
 
     def execute_hybrid_query_phase(self, body: dict, k: int,
-                                   extra_filter: Optional[dict] = None
+                                   extra_filter: Optional[dict] = None,
+                                   ledger_scope=None
                                    ) -> "HybridShardResult":
         """Per-shard fused hybrid query phase: ALL sub-queries of the
         hybrid clause run as ONE device program per segment (dispatched
         async across segments, collected with one device_get), returning
         per-sub-query candidates + score bounds for the coordinator's
-        normalization merge (searchpipeline/hybrid.py)."""
+        normalization merge (searchpipeline/hybrid.py). `ledger_scope`
+        (telemetry/ledger.py) accumulates this shard's transfer
+        attribution for the caller's span / slow log."""
         node = dsl.parse_query(body.get("query"))
         if not isinstance(node, dsl.HybridQuery):
             raise IllegalArgumentError(
@@ -1300,6 +1473,8 @@ class SearchExecutor:
 
         from opensearch_tpu.indices.query_cache import FilterCacheContext
         from opensearch_tpu.search.warmup import WARMUP
+        scope = ledger_scope if ledger_scope is not None \
+            else _LEDGER.scope()
         launched = []
         struct_parts: List[Any] = []
         shape_parts: List[Any] = []
@@ -1334,6 +1509,11 @@ class SearchExecutor:
                 return fn(arrays, jnp.asarray(buf))
             launched.append((seg_i, k_seg, retry.call_with_retry(
                 _dispatch, label="query.dispatch")))
+            if scope is not None:
+                # after the dispatch: a failed one must not count h2d
+                # bytes that never crossed
+                _LEDGER.record("upload.literals", "h2d", buf.nbytes,
+                               scope=scope)
         if extra_filter is None:
             # register the fused executable's (plan-struct, shape-bucket)
             # signature so index-open / node-start warmup AOT-compiles the
@@ -1351,7 +1531,13 @@ class SearchExecutor:
                 if faults.ENABLED:
                     faults.fire("fetch.gather")
                 return jax.device_get([out for _, _, out in launched])
+            t0c = time.monotonic() if scope is not None else 0.0
             fetched = retry.call_with_retry(_collect, label="fetch.gather")
+            if scope is not None:
+                _ledger_hybrid_rows(
+                    scope, [(1, 1, k_seg, n_sub)
+                            for _seg_i, k_seg, _ in launched],
+                    (time.monotonic() - t0c) * 1000)
             for (seg_i, k_seg, _), rows in zip(launched, fetched):
                 _accumulate_hybrid_row(result, np.asarray(rows)[0], seg_i,
                                        k_seg, n_sub)
@@ -1374,7 +1560,9 @@ class SearchExecutor:
     def multi_search(self, bodies: List[dict],
                      _bypass_request_cache: bool = False,
                      _raise_item_errors: bool = False,
-                     task=None, deadline: Optional[float] = None) -> dict:
+                     task=None, deadline: Optional[float] = None,
+                     trace=None,
+                     phase_times: Optional[dict] = None) -> dict:
         """_msearch: execute many search bodies, batching same-shaped
         score-sorted queries into single vmapped device programs per segment
         (reference: action/search/TransportMultiSearchAction fans bodies out
@@ -1394,9 +1582,15 @@ class SearchExecutor:
         boundaries — cancellation kills the whole envelope (the task IS
         the msearch request, reference TransportMultiSearchAction task),
         a passed deadline stops launching new waves and renders the
-        unlaunched items as zero-hit `timed_out: true` partials."""
+        unlaunched items as zero-hit `timed_out: true` partials.
+        trace / phase_times: the envelope's transfer attribution —
+        bytes_to_device/bytes_fetched/transfers land on the span when it
+        records, device_get/bytes_fetched in phase_times for the
+        caller's slow log (both only when the ledger or tracing is on;
+        see telemetry/ledger.py's no-op discipline)."""
         TELEMETRY.metrics.counter("msearch.requests").inc()
         TELEMETRY.metrics.counter("msearch.bodies").inc(len(bodies))
+        scope = _LEDGER.scope(trace)
         start = time.monotonic()
         if task is not None:
             task.check_cancelled()
@@ -1437,17 +1631,31 @@ class SearchExecutor:
                         responses[i] = _timed_out_item(start)
             else:
                 self._msearch_hybrid(hybrid_items, responses, start,
-                                     _raise_item_errors)
+                                     _raise_item_errors, scope=scope)
         if batchable:
             if task is not None:
                 task.check_cancelled()
             state = self._msearch_prepare(batchable, responses, start, ph,
                                           _raise_item_errors,
-                                          deadline=deadline)
+                                          deadline=deadline, scope=scope)
             state["resp_cache_keys"] = resp_cache_keys
-            if task is not None:
-                task.check_cancelled()
-            self._msearch_finish(state, responses, start, ph)
+            # the in-flight wave-buffer gauge rises HERE (not inside
+            # prepare) and is released by _msearch_finish or — on any
+            # exception in between, e.g. the cancellation checkpoint —
+            # by the finally below: no path can strand it
+            _DEVMEM.adjust("wave_buffers",
+                           state.get("wave_buffer_bytes", 0))
+            try:
+                if task is not None:
+                    task.check_cancelled()
+                self._msearch_finish(state, responses, start, ph,
+                                     scope=scope)
+            finally:
+                # _msearch_finish zeroes this marker at its release
+                # points; whatever it never saw is released here
+                leaked = state.get("wave_buffer_bytes", 0)
+                if leaked:
+                    _DEVMEM.adjust("wave_buffers", -leaked)
         # parse always runs; the wave phases only get a sample when a
         # batched wave actually executed — otherwise every all-general or
         # all-hybrid envelope would log spurious 0-ms device_get/respond
@@ -1459,6 +1667,13 @@ class SearchExecutor:
                     _PHASE_HISTS[name].observe(sec * 1000)
         TELEMETRY.metrics.histogram("msearch.batch_ms").observe(
             (time.monotonic() - start) * 1000)
+        if scope is not None:
+            # the envelope's transfer attribution (the shared
+            # LedgerScope.publish contract): fixes the spuriously-zero
+            # bytes_to_device on envelope/hybrid-served spans (the old
+            # accounting lived only in the general path's single-branch
+            # sum)
+            scope.publish(trace, phase_times)
         return {"took": int((time.monotonic() - start) * 1000),
                 "responses": responses}
 
@@ -1536,7 +1751,8 @@ class SearchExecutor:
 
     def _msearch_hybrid(self, items: List[Tuple[int, dict]], responses,
                         start: float,
-                        raise_item_errors: bool = False) -> None:
+                        raise_item_errors: bool = False,
+                        scope=None) -> None:
         """Batched hybrid envelope: same-structure hybrid bodies become
         ONE vmapped fused program per (plan-struct, shape, k) group per
         segment — per-query launch cost amortizes exactly like the plain
@@ -1639,6 +1855,11 @@ class SearchExecutor:
                         responses[i] = dict(err)
                         dead.add(i)
                     break
+                if scope is not None:
+                    # after the dispatch: a failed one must not count
+                    # h2d bytes that never crossed
+                    _LEDGER.record("upload.literals", "h2d", buf.nbytes,
+                                   scope=scope)
                 pending.append((idxs, seg_i, k_seg, len(plans0), out))
 
         results = {i: _empty_hybrid_result(prepared[i][1])
@@ -1649,9 +1870,16 @@ class SearchExecutor:
                     faults.fire("fetch.gather")
                 return jax.device_get(
                     [packed for _, _, _, _, packed in pending])
+            t0c = time.monotonic() if scope is not None else 0.0
             try:
                 fetched = retry.call_with_retry(_collect,
                                                 label="fetch.gather")
+                if scope is not None:
+                    _ledger_hybrid_rows(
+                        scope,
+                        [(packed.shape[0], len(idxs), k_seg, n_sub)
+                         for idxs, _s, k_seg, n_sub, packed in pending],
+                        (time.monotonic() - t0c) * 1000)
             except Exception as e:
                 if raise_item_errors:
                     raise
@@ -1752,7 +1980,7 @@ class SearchExecutor:
 
     def _msearch_prepare(self, batchable, responses, start, ph,
                          raise_item_errors: bool = False,
-                         deadline: Optional[float] = None):
+                         deadline: Optional[float] = None, scope=None):
         """Wave half 1: compile + group + stack + pack + DISPATCH (async).
         Returns the state _msearch_finish consumes.
 
@@ -1864,6 +2092,8 @@ class SearchExecutor:
         # across varying msearch batch sizes.
         from opensearch_tpu.search.warmup import WARMUP
         pending = []
+        wave_buffer_bytes = 0   # in-flight packed uploads, released by
+        # _msearch_finish once the wave's results are fetched
         dead: set = set()       # items already answered (error/timeout):
         # _msearch_finish must not overwrite their responses
         for (struct, agg_sig, shape_sig, k_fetch), idxs in groups.items():
@@ -1933,13 +2163,36 @@ class SearchExecutor:
                         responses[i] = dict(err)
                         dead.add(i)
                     break       # no point dispatching more segments
+                if scope is not None:
+                    # record AFTER the dispatch succeeded: a failed
+                    # dispatch must not count h2d bytes that never
+                    # crossed (conservation). Const agg tables
+                    # (in_axes=None leaves) are a distinct channel: one
+                    # copy serves the whole batch, so their bytes scale
+                    # with groups, not with B.
+                    const_b = sum(int(a.nbytes)
+                                  for a, ax in zip(stacked, axes)
+                                  if ax is None) \
+                        if agg_sig is not None else 0
+                    if const_b:
+                        _LEDGER.record("upload.agg_constants", "h2d",
+                                       const_b, scope=scope)
+                    _LEDGER.record("upload.literals", "h2d",
+                                   buf.nbytes - const_b, scope=scope)
+                # the in-flight gauge is ALWAYS fed (an int add here; the
+                # device-memory classes are live like corpus_columns,
+                # not ledger-gated) but NOT adjusted here: multi_search
+                # raises it once from the returned total, so an
+                # exception out of this loop can never strand bytes
+                wave_buffer_bytes += buf.nbytes
                 pending.append((idxs, seg_i, k_seg, out, out_layout))
         ph["stack_pack_dispatch"] += time.monotonic() - _t
         return {"groups": groups, "entry_by_i": entry_by_i,
                 "pending": pending, "agg_by_i": agg_by_i,
-                "agg_nodes_by_i": agg_nodes_by_i, "dead": dead}
+                "agg_nodes_by_i": agg_nodes_by_i, "dead": dead,
+                "wave_buffer_bytes": wave_buffer_bytes}
 
-    def _msearch_finish(self, state, responses, start, ph):
+    def _msearch_finish(self, state, responses, start, ph, scope=None):
         """Wave half 2: ONE device_get for the wave's outputs (concatenated
         on device = one transfer round trip), then COLUMNAR response
         assembly: per query the hit page is sliced from the fetched
@@ -1960,8 +2213,23 @@ class SearchExecutor:
             {i: [] for i in grouped}
         per_query_total: Dict[int, int] = {i: 0 for i in grouped}
         per_query_decoded: Dict[int, list] = {i: [] for i in agg_by_i}
+        wave_buffer_bytes = state.get("wave_buffer_bytes", 0)
+
+        def _release_wave_buffers():
+            # zero the state marker so multi_search's finally (which
+            # covers the paths that raise before reaching a release
+            # point) never double-decrements
+            if state.get("wave_buffer_bytes", 0):
+                state["wave_buffer_bytes"] = 0
+                _DEVMEM.adjust("wave_buffers", -wave_buffer_bytes)
         if not pending:
+            _release_wave_buffers()
             return
+
+        # [actually transferred d2h bytes, round trips] — filled by the
+        # fetch closures so the ledger attributes REAL buffer sizes
+        # (combined-fetch padding included) and true round-trip counts
+        fetch_stats = [0, 0]
 
         def _fetch_all():
             if faults.ENABLED:
@@ -1969,6 +2237,8 @@ class SearchExecutor:
             if len(pending) > 1:
                 combined = np.asarray(jax.device_get(_concat_rows(
                     tuple(packed for _, _, _, packed, _ in pending))))
+                fetch_stats[0] = combined.nbytes
+                fetch_stats[1] = 1
                 out = []
                 row = 0
                 for _, _, _, packed, _ in pending:
@@ -1976,8 +2246,11 @@ class SearchExecutor:
                     out.append(combined[row:row + rows, :width])
                     row += rows
                 return out
-            return jax.device_get(
+            out = jax.device_get(
                 [packed for _, _, _, packed, _ in pending])
+            fetch_stats[0] = sum(int(np.asarray(a).nbytes) for a in out)
+            fetch_stats[1] = 1
+            return out
 
         try:
             fetched = retry.call_with_retry(_fetch_all,
@@ -1987,14 +2260,18 @@ class SearchExecutor:
             # fetch per dispatched program, so a single bad program
             # downgrades only ITS items to error objects
             fetched = []
+            fetch_stats[0] = fetch_stats[1] = 0
             for idxs, _seg_i, _k_seg, packed, _ol in pending:
                 def _one(packed=packed):
                     if faults.ENABLED:
                         faults.fire("fetch.gather")
                     return np.asarray(jax.device_get(packed))
                 try:
-                    fetched.append(retry.call_with_retry(
-                        _one, label="fetch.gather"))
+                    got = retry.call_with_retry(_one,
+                                                label="fetch.gather")
+                    fetched.append(got)
+                    fetch_stats[0] += got.nbytes
+                    fetch_stats[1] += 1
                 except Exception as e:
                     fetched.append(None)
                     err = _item_error(e) \
@@ -2003,7 +2280,12 @@ class SearchExecutor:
                     for i in idxs:
                         responses[i] = dict(err)
                         dead.add(i)
-        ph["device_get"] += time.monotonic() - _t; _t = time.monotonic()
+        collect_s = time.monotonic() - _t
+        ph["device_get"] += collect_s; _t = time.monotonic()
+        _release_wave_buffers()
+        if scope is not None:
+            _ledger_packed_rows(scope, pending, fetched, fetch_stats[0],
+                                collect_s * 1000, max(fetch_stats[1], 1))
         for (idxs, seg_i, k_seg, _, out_layout), packed in zip(pending,
                                                                fetched):
             if packed is None:

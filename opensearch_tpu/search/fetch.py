@@ -341,6 +341,8 @@ _INNER_JIT: Dict[Any, Any] = {}
 
 
 def _eval_child_scores(plan, arrays):
+    import time
+
     import jax
     import jax.numpy as jnp
 
@@ -352,9 +354,31 @@ def _eval_child_scores(plan, arrays):
             cursor = [0]
             return _eval_plan(_plan, seg, flat, cursor)
         fn = _INNER_JIT[sig] = jax.jit(run)
-    flat = jax.tree_util.tree_map(jnp.asarray, plan.flatten_inputs([]))
+    host_flat = plan.flatten_inputs([])
+    ledger = TELEMETRY.ledger
+    # scope: the request's LedgerScope, bound ambiently by the
+    # controller's fetch phase — a traced/profiled request accounts
+    # here even with the node-wide ledger off
+    scope = ledger.current()
+    accounting = ledger.enabled or scope is not None
+    if accounting:
+        ledger.record("upload.literals", "h2d",
+                      sum(int(np.asarray(v).nbytes)
+                          for d in host_flat for v in d.values()),
+                      scope=scope)
+    flat = jax.tree_util.tree_map(jnp.asarray, host_flat)
+    t0 = time.monotonic() if accounting else 0.0
     scores, matches = jax.device_get(fn(arrays, flat))
-    return np.asarray(scores), np.asarray(matches)
+    scores, matches = np.asarray(scores), np.asarray(matches)
+    if accounting:
+        # the fetch phase's one device gather (dense child scores/masks
+        # for inner_hits) — the `docvalues` channel of the ledger
+        nb = scores.nbytes + matches.nbytes
+        ledger.record("docvalues", "d2h", nb, wave=ledger.new_wave(),
+                      scope=scope)
+        ledger.note_device_get((time.monotonic() - t0) * 1000, nbytes=nb,
+                               scope=scope)
+    return scores, matches
 
 
 def collect_inner_hit_specs(node) -> List[Any]:

@@ -223,6 +223,29 @@ class WarmupRegistry:
         warmed = errors = 0
         entries = self.entries(index_name)
         self._recording = False
+        # replay transfers record under a `warmup.`-prefixed channel so
+        # the ledger's serving channels stay uncontaminated while replay
+        # traffic stays attributable (telemetry/ledger.py)
+        from opensearch_tpu.telemetry import TELEMETRY as _tel
+        with _tel.ledger.tagged("warmup"):
+            warmed, errors = self._warm_entries(executor, entries,
+                                                budget_s, t0)
+        took = (time.monotonic() - t0) * 1000
+        self.stats_["warmup_runs"] += 1
+        self.stats_["warmed_entries"] += warmed
+        self.stats_["warmup_errors"] += errors
+        self.stats_["last_warmup_ms"] = round(took, 2)
+        # mirror into the telemetry registry so _nodes/stats' `telemetry`
+        # section carries warmup replays next to the compile counters
+        _tel.metrics.counter("warmup.replays").inc(warmed)
+        _tel.metrics.counter("warmup.errors").inc(errors)
+        _tel.metrics.histogram("warmup.replay_ms").observe(took)
+        return {"warmed": warmed, "errors": errors,
+                "took_ms": round(took, 2)}
+
+    def _warm_entries(self, executor, entries, budget_s, t0):
+        """The replay loop proper; returns (warmed, errors)."""
+        warmed = errors = 0
         try:
             for entry in entries:
                 if budget_s is not None and \
@@ -250,19 +273,7 @@ class WarmupRegistry:
                     errors += 1
         finally:
             self._recording = True
-        took = (time.monotonic() - t0) * 1000
-        self.stats_["warmup_runs"] += 1
-        self.stats_["warmed_entries"] += warmed
-        self.stats_["warmup_errors"] += errors
-        self.stats_["last_warmup_ms"] = round(took, 2)
-        # mirror into the telemetry registry so _nodes/stats' `telemetry`
-        # section carries warmup replays next to the compile counters
-        from opensearch_tpu.telemetry import TELEMETRY
-        TELEMETRY.metrics.counter("warmup.replays").inc(warmed)
-        TELEMETRY.metrics.counter("warmup.errors").inc(errors)
-        TELEMETRY.metrics.histogram("warmup.replay_ms").observe(took)
-        return {"warmed": warmed, "errors": errors,
-                "took_ms": round(took, 2)}
+        return warmed, errors
 
     def warm_index(self, index_name: str, shard_executors,
                    budget_s: Optional[float] = None) -> dict:

@@ -236,7 +236,8 @@ def execute_hybrid_search(executors: List, body: dict,
                           = None,
                           total_shards: Optional[int] = None,
                           failed_shards: int = 0, task=None,
-                          allow_partial: bool = True) -> dict:
+                          allow_partial: bool = True,
+                          ledger_scope=None) -> dict:
     """Full hybrid query-then-fetch over shard executors.
 
     Per shard the FUSED program returns per-sub-query candidates + score
@@ -244,7 +245,10 @@ def execute_hybrid_search(executors: List, body: dict,
     normalizes every candidate with the global statistics, combines into
     one score per doc, and renders the page with the standard fetch.
     A failed shard contributes an empty result + a `_shards.failures[]`
-    entry (same partial contract as the plain controller path)."""
+    entry (same partial contract as the plain controller path).
+    `ledger_scope` (telemetry/ledger.py) accumulates every shard's
+    transfer attribution for the caller's span / slow log — the hybrid
+    path used to report bytes_to_device = 0."""
     from opensearch_tpu.common import faults
     from opensearch_tpu.common.errors import (
         SearchPhaseExecutionError, TaskCancelledError,
@@ -269,7 +273,8 @@ def execute_hybrid_search(executors: List, body: dict,
             if faults.ENABLED:
                 faults.fire("query.shard")
             shard_results.append(
-                ex.execute_hybrid_query_phase(body, k, extra_filter=extra))
+                ex.execute_hybrid_query_phase(body, k, extra_filter=extra,
+                                              ledger_scope=ledger_scope))
         except TaskCancelledError:
             raise
         except Exception as e:

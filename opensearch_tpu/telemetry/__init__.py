@@ -11,12 +11,22 @@ node needs: one `TELEMETRY` singleton (the same pattern as
     via `GET /_telemetry/traces`; OFF by default, a no-op on the hot
     path until enabled;
   - `TELEMETRY.metrics` — always-on named counters and fixed-bucket
-    latency histograms surfaced as the `telemetry` section of
-    `GET /_nodes/stats`.
+    latency histograms (each carrying a rolling live-percentile
+    estimator, telemetry/rolling.py) surfaced as the `telemetry`
+    section of `GET /_nodes/stats`;
+  - `TELEMETRY.ledger` — the transfer ledger (telemetry/ledger.py):
+    per-channel host↔device byte/round-trip attribution on the query
+    path, OFF by default with the tracer's no-op discipline, served by
+    `GET /_telemetry/transfers`;
+  - `TELEMETRY.device_memory` — live-bytes gauges per device-memory
+    class (corpus columns, interned bundles, in-flight wave buffers,
+    ...) plus raw backend `memory_stats()` — the HBM analog of the
+    reference's JVM mem stats on `_nodes/stats`.
 
 Node wires it from settings (`telemetry.tracing.enabled`,
-`telemetry.tracing.ring_size`, `telemetry.tracing.jsonl`) and the data
-dir (`_state/traces.jsonl`); tests and bench.py drive it directly.
+`telemetry.tracing.ring_size`, `telemetry.tracing.jsonl`,
+`telemetry.transfers.enabled`) and the data dir (`_state/traces.jsonl`);
+tests and bench.py drive it directly.
 """
 
 from __future__ import annotations
@@ -24,28 +34,37 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from opensearch_tpu.telemetry.ledger import (
+    DeviceMemoryAccounting, LedgerScope, TransferLedger)
 from opensearch_tpu.telemetry.metrics import MetricsRegistry
+from opensearch_tpu.telemetry.rolling import RollingEstimator
 from opensearch_tpu.telemetry.tracer import (
     DEFAULT_RING_SIZE, NOOP_SPAN, Span, Tracer)
 
 __all__ = ["TELEMETRY", "TelemetryService", "Span", "NOOP_SPAN",
-           "MetricsRegistry", "Tracer"]
+           "MetricsRegistry", "Tracer", "TransferLedger", "LedgerScope",
+           "DeviceMemoryAccounting", "RollingEstimator"]
 
 
 class TelemetryService:
-    """Tracer + metrics under one configuration surface."""
+    """Tracer + metrics + transfer ledger + device-memory accounting
+    under one configuration surface."""
 
     def __init__(self):
         self.tracer = Tracer()
         self.metrics = MetricsRegistry()
+        self.ledger = TransferLedger()
+        self.device_memory = DeviceMemoryAccounting()
 
     def configure(self, data_path: Optional[str] = None,
                   enabled: bool = False, jsonl: bool = False,
-                  ring_size: int = DEFAULT_RING_SIZE) -> None:
+                  ring_size: int = DEFAULT_RING_SIZE,
+                  transfers: bool = False) -> None:
         """Bind to a node's settings/data dir. Called from Node.__init__;
         re-configuration by a later Node in the same process wins (the
         singleton is process-wide, like WARMUP)."""
         self.tracer.enabled = bool(enabled)
+        self.ledger.enabled = bool(transfers)
         self.tracer.resize(ring_size)
         self.tracer.jsonl_path = None
         if jsonl and data_path is not None:
@@ -65,7 +84,9 @@ class TelemetryService:
 
     def stats(self) -> dict:
         return {"tracing": self.tracer.stats(),
-                "metrics": self.metrics.to_dict()}
+                "metrics": self.metrics.to_dict(),
+                "transfers": self.ledger.snapshot(),
+                "device_memory": self.device_memory.stats()}
 
 
 # process-wide singleton, like REQUEST_CACHE / QUERY_CACHE / WARMUP

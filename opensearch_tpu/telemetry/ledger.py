@@ -1,0 +1,332 @@
+"""Transfer ledger + device-memory accounting for the TPU query path.
+
+PROFILE.md round 8 left the warm B=1024 msearch batch ~266 ms of which
+~214 ms is one opaque `device_get` number. ROADMAP item 1 (on-device
+top-k/gather + overlapped transfers) needs to know WHICH bytes cross the
+tunnel before tearing that wall down; item 2's wave scheduler needs the
+live tail. This module is that accounting contract:
+
+- `TransferLedger` attributes every host↔device transfer on the query
+  path to a named channel (`topk_ids`, `scores`, `sort_keys`,
+  `docvalues`, `agg_buffers`, `upload.literals`, `upload.corpus`,
+  `upload.agg_constants`, `padding`, ...) with direction, bytes (from
+  array `nbytes` / shape·dtype — never an extra device sync), wave id
+  and round-trip participation. Aggregates serve
+  `GET /_telemetry/transfers` and the `telemetry` section of
+  `_nodes/stats`; per-request `LedgerScope` objects feed the Profile
+  API's `transfers[]` and the slow log's `bytes_fetched`/
+  `device_get_ms` fields.
+
+- `DeviceMemoryAccounting` is the HBM analog of the reference's JVM mem
+  stats: live-bytes gauges per channel class (corpus columns, interned
+  plan bundles, in-flight wave buffers, agg executable constants,
+  compiled-executable counts) fed by registration at the owning layer,
+  plus raw `jax.local_devices()[0].memory_stats()` where the backend
+  provides it.
+
+No-op discipline (same contract as the PR 4 tracer and the PR 6 fault
+injector, asserted by bench.py): the ledger is OFF by default and the
+hot-path guard is `LEDGER.scope(trace)` returning None — one attribute
+load and a branch, nothing else runs. Per-channel `round_trips` counts
+the transfer rounds a channel RODE (channels sharing one fused
+`device_get` each count that round); the true global round-trip count is
+`device_get.calls` in the snapshot.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
+
+from opensearch_tpu.telemetry.rolling import RollingEstimator
+
+H2D = "h2d"
+D2H = "d2h"
+
+
+class LedgerScope:
+    """Per-request transfer accumulator (explicit context, like spans:
+    the msearch envelope runs B requests on one thread, so ambient
+    context would misattribute). Entries are (channel, direction,
+    bytes, round_trips, wave) tuples."""
+
+    __slots__ = ("entries", "h2d_bytes", "d2h_bytes", "device_get_ms",
+                 "round_trips")
+
+    def __init__(self):
+        self.entries: List[Tuple[str, str, int, int, Optional[int]]] = []
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.device_get_ms = 0.0
+        self.round_trips = 0
+
+    def absorb(self, other: "LedgerScope") -> None:
+        self.entries.extend(other.entries)
+        self.h2d_bytes += other.h2d_bytes
+        self.d2h_bytes += other.d2h_bytes
+        self.device_get_ms += other.device_get_ms
+        self.round_trips += other.round_trips
+
+    def to_list(self) -> List[dict]:
+        """JSON-able per-transfer records for the Profile API."""
+        return [{"channel": c, "direction": d, "bytes": b,
+                 "round_trips": r, **({"wave": w} if w is not None else {})}
+                for c, d, b, r, w in self.entries]
+
+    def publish(self, span=None, phase_times=None) -> None:
+        """The one publication contract for a request's attribution:
+        span attributes (bytes_to_device / bytes_fetched / transfers[])
+        when the span records, and the phase_times fields the slow log
+        reads. Both the controller and the msearch envelope call THIS so
+        the two surfaces can never drift."""
+        if span is not None and getattr(span, "recording", False):
+            span.set_attribute("bytes_to_device", self.h2d_bytes)
+            span.set_attribute("bytes_fetched", self.d2h_bytes)
+            span.set_attribute("transfers", self.to_list())
+        if phase_times is not None:
+            phase_times["device_get"] = self.device_get_ms
+            phase_times["bytes_fetched"] = self.d2h_bytes
+            phase_times["bytes_to_device"] = self.h2d_bytes
+
+
+class TransferLedger:
+    """Node-wide per-channel transfer aggregates + wave accounting."""
+
+    def __init__(self):
+        self.enabled = False
+        self._lock = threading.Lock()
+        # (channel, direction) -> [transfers, round_trips, bytes]
+        self._channels: Dict[Tuple[str, str], List[int]] = {}
+        self._wave_seq = 0
+        self._device_get_calls = 0
+        self._device_get_ms = 0.0
+        # live views for the wave scheduler: bytes fetched per wave and
+        # device_get wall per wave (rolling.py — O(1) reads)
+        self.wave_bytes = RollingEstimator()
+        self.wave_ms = RollingEstimator()
+        self._tls = threading.local()
+
+    # ------------------------------------------------------------- hot path
+
+    def scope(self, trace=None) -> Optional[LedgerScope]:
+        """The per-request accounting gate: a LedgerScope when either the
+        ledger is enabled or the request's trace records (profile /
+        tracing), else None — callers guard every accounting block with
+        `if scope is not None`, so the disabled path costs one attribute
+        load and a branch."""
+        if self.enabled or (trace is not None
+                            and getattr(trace, "recording", False)):
+            return LedgerScope()
+        return None
+
+    def new_wave(self) -> Optional[int]:
+        """Next global wave id — None when the ledger is disabled (a
+        traced-only request still accounts per-request, but must not
+        advance the node-wide sequence: snapshot()'s `waves` has to stay
+        consistent with its device_get/channel counts)."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            self._wave_seq += 1
+            return self._wave_seq
+
+    def record(self, channel: str, direction: str, nbytes: int,
+               round_trips: int = 1, wave: Optional[int] = None,
+               scope: Optional[LedgerScope] = None) -> None:
+        nbytes = int(nbytes)
+        if scope is not None:
+            scope.entries.append((channel, direction, nbytes, round_trips,
+                                  wave))
+            if direction == H2D:
+                scope.h2d_bytes += nbytes
+            else:
+                scope.d2h_bytes += nbytes
+        if not self.enabled:
+            return
+        tag = getattr(self._tls, "tag", None)
+        if tag is not None:
+            channel = f"{tag}.{channel}"
+        key = (channel, direction)
+        with self._lock:
+            ent = self._channels.get(key)
+            if ent is None:
+                ent = self._channels[key] = [0, 0, 0]
+            ent[0] += 1
+            ent[1] += round_trips
+            ent[2] += nbytes
+
+    def note_device_get(self, ms: float, nbytes: Optional[int] = None,
+                        scope: Optional[LedgerScope] = None,
+                        round_trips: int = 1) -> None:
+        """One collect: wall time + fetched bytes. `round_trips` > 1 when
+        the collect degraded to per-program gathers (the msearch
+        fallback fetch) — `device_get.calls` stays the TRUE global
+        round-trip count, consistent with the channel records."""
+        if scope is not None:
+            scope.device_get_ms += ms
+            scope.round_trips += round_trips
+        if not self.enabled:
+            return
+        with self._lock:
+            self._device_get_calls += round_trips
+            self._device_get_ms += ms
+        self.wave_ms.observe(ms)
+        if nbytes:
+            self.wave_bytes.observe(float(nbytes))
+
+    @contextmanager
+    def tagged(self, tag: str):
+        """Prefix this thread's channel names (warmup replays record as
+        `warmup.upload.literals` etc. so replay traffic never pollutes
+        the serving channels)."""
+        prev = getattr(self._tls, "tag", None)
+        self._tls.tag = tag if prev is None else f"{prev}.{tag}"
+        try:
+            yield
+        finally:
+            self._tls.tag = prev
+
+    @contextmanager
+    def ambient(self, scope: Optional[LedgerScope]):
+        """Bind a request's scope to this thread for call sites too deep
+        to plumb it into (the fetch phase's inner-hit gathers). Safe
+        ONLY around single-request phases — the msearch envelope must
+        keep passing scopes explicitly (B requests share one thread)."""
+        prev = getattr(self._tls, "scope", None)
+        self._tls.scope = scope
+        try:
+            yield
+        finally:
+            self._tls.scope = prev
+
+    def current(self) -> Optional[LedgerScope]:
+        """The thread's ambient per-request scope, if a phase bound one."""
+        return getattr(self._tls, "scope", None)
+
+    # --------------------------------------------------------------- reading
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            chans = {d: {} for d in (H2D, D2H)}
+            totals = {H2D: 0, D2H: 0}
+            for (channel, direction), (n, rt, b) in sorted(
+                    self._channels.items()):
+                chans[direction][channel] = {
+                    "transfers": n, "round_trips": rt, "bytes": b}
+                totals[direction] += b
+            calls, total_ms = self._device_get_calls, self._device_get_ms
+            waves = self._wave_seq
+        return {
+            "enabled": self.enabled,
+            "waves": waves,
+            "device_get": {"calls": calls,
+                           "total_ms": round(total_ms, 3)},
+            "bytes_total": dict(totals),
+            "channels": chans,
+            "rolling": {"wave_bytes": self.wave_bytes.summary(),
+                        "wave_device_get_ms": self.wave_ms.summary()},
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._channels.clear()
+            self._wave_seq = 0
+            self._device_get_calls = 0
+            self._device_get_ms = 0.0
+        self.wave_bytes.reset()
+        self.wave_ms.reset()
+
+
+class DeviceMemoryAccounting:
+    """Live-bytes gauges per device-memory class.
+
+    Two feeding styles:
+      - register/release/adjust: the owning layer reports exact bytes
+        (in-flight wave buffers, agg executable constants);
+      - providers: a callable sampled at stats() time over live objects
+        (corpus columns via the executor's ShardReader weak-set, interned
+        bundle memos, compiled-executable counts) — nothing to release,
+        dead owners just stop being summed.
+
+    `stats()` also samples `jax.local_devices()[0].memory_stats()` where
+    the backend exposes it (TPU runtimes do; CPU returns nothing) — the
+    HBM analog of `_nodes/stats`' JVM mem block.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._registered: Dict[str, Dict[Any, int]] = {}
+        self._gauges: Dict[str, int] = {}
+        self._providers: Dict[str, Any] = {}
+
+    def register(self, cls: str, key: Any, nbytes: int) -> None:
+        with self._lock:
+            self._registered.setdefault(cls, {})[key] = int(nbytes)
+
+    def release(self, cls: str, key: Any) -> None:
+        with self._lock:
+            self._registered.get(cls, {}).pop(key, None)
+
+    def adjust(self, cls: str, delta: int) -> None:
+        """Plain up/down gauge for churny classes (in-flight buffers)."""
+        with self._lock:
+            self._gauges[cls] = max(self._gauges.get(cls, 0) + int(delta),
+                                    0)
+
+    def add_provider(self, name: str, fn) -> None:
+        """Idempotent by name: module re-imports keep the latest."""
+        with self._lock:
+            self._providers[name] = fn
+
+    def live_bytes(self, cls: str) -> int:
+        with self._lock:
+            if cls in self._gauges:
+                return self._gauges[cls]
+            return sum(self._registered.get(cls, {}).values())
+
+    def stats(self) -> dict:
+        classes: Dict[str, dict] = {}
+        with self._lock:
+            for cls, entries in self._registered.items():
+                classes[cls] = {"live_bytes": sum(entries.values()),
+                                "entries": len(entries)}
+            for cls, v in self._gauges.items():
+                classes[cls] = {"live_bytes": v}
+            providers = list(self._providers.items())
+        for name, fn in providers:
+            try:
+                classes[name] = dict(fn())
+            except Exception:
+                classes[name] = {"error": "provider failed"}
+        return {"classes": classes, "hbm": _hbm_stats()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._registered.clear()
+            self._gauges.clear()
+
+
+def _hbm_stats() -> Optional[dict]:
+    """Raw backend memory stats where available (TPU runtimes expose
+    bytes_in_use / peak_bytes_in_use etc.; CPU backends return None).
+
+    Strictly passive: a `_nodes/stats` poll must never FORCE backend
+    initialization (multi-second on the tunneled TPU, and the tunnel can
+    hang) — if jax isn't imported or no backend has been created yet,
+    report nothing and let the first real device use pay that cost."""
+    try:
+        import sys
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return None
+        from jax._src import xla_bridge
+        if not getattr(xla_bridge, "_backends", None):
+            return None
+        stats = jax.local_devices()[0].memory_stats()
+        if not stats:
+            return None
+        return {k: v for k, v in stats.items()
+                if isinstance(v, (int, float))}
+    except Exception:
+        return None

@@ -18,6 +18,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from opensearch_tpu.telemetry.rolling import RollingEstimator
+
 # default latency buckets (upper bounds, milliseconds): sub-ms resolution
 # for warmed device queries up to the multi-second compile cliff
 DEFAULT_BUCKETS_MS: Tuple[float, ...] = (
@@ -41,9 +43,15 @@ class Counter:
 
 
 class Histogram:
-    """Fixed-bucket latency histogram (values in milliseconds)."""
+    """Fixed-bucket latency histogram (values in milliseconds).
 
-    __slots__ = ("name", "buckets", "counts", "count", "sum", "min", "max")
+    Every histogram also feeds a rolling live-percentile estimator
+    (telemetry/rolling.py): the fixed buckets keep the since-start
+    distribution, `rolling` answers "what is the p99 RIGHT NOW" in O(1)
+    — the read the wave scheduler (ROADMAP item 2) budgets against."""
+
+    __slots__ = ("name", "buckets", "counts", "count", "sum", "min",
+                 "max", "rolling")
 
     def __init__(self, name: str,
                  buckets: Optional[Tuple[float, ...]] = None):
@@ -55,6 +63,7 @@ class Histogram:
         self.sum = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
+        self.rolling = RollingEstimator()
 
     def observe(self, value_ms: float) -> None:
         i = 0
@@ -68,6 +77,7 @@ class Histogram:
             self.min = value_ms
         if self.max is None or value_ms > self.max:
             self.max = value_ms
+        self.rolling.observe(value_ms)
 
     def percentile(self, p: float) -> Optional[float]:
         """Estimated p-quantile (0 < p < 1) by linear interpolation inside
@@ -87,13 +97,21 @@ class Histogram:
         return self.max
 
     def to_dict(self) -> dict:
+        live = self.rolling.summary()
         return {
             "count": self.count,
             "sum_ms": round(self.sum, 3),
             "min_ms": round(self.min, 4) if self.min is not None else None,
             "max_ms": round(self.max, 4) if self.max is not None else None,
             "p50_ms": self.percentile(0.5),
+            "p95_ms": self.percentile(0.95),
             "p99_ms": self.percentile(0.99),
+            # server-computed LIVE percentiles (exponentially decayed
+            # rolling window) — distinct from the since-start estimates
+            # above; what `GET /_telemetry/metrics` consumers and the
+            # future wave scheduler should read for "current" tail
+            "summary": {"p50_ms": live["p50"], "p95_ms": live["p95"],
+                        "p99_ms": live["p99"], "count": live["count"]},
             "buckets": {
                 **{f"le_{b:g}": c
                    for b, c in zip(self.buckets, self.counts)},
@@ -147,3 +165,4 @@ class MetricsRegistry:
                 h.sum = 0.0
                 h.min = None
                 h.max = None
+                h.rolling.reset()

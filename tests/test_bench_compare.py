@@ -1,0 +1,111 @@
+"""Tier-1 smoke test for tools/bench_compare.py: the CI tripwire that
+diffs two bench dumps and fails on a >threshold warm-p50 regression."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import bench_compare  # noqa: E402
+
+
+def _write(path, records):
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    return str(path)
+
+
+OLD = [
+    {"metric": "bm25_match_qps_100k_docs_tpu", "value": 1000,
+     "p50_ms": 5.0},
+    {"mode": "agg_terms", "metric": "agg_terms_qps_50k_docs_tpu",
+     "value": 300, "warm_p50_ms": 10.0, "p50_ms": 40.0},
+    {"mode": "hybrid", "metric": "hybrid_qps_50k_docs_64d_tpu",
+     "value": 200, "warm_p50_ms": 20.0},
+]
+
+
+def test_load_keys_by_mode_then_metric(tmp_path):
+    recs = bench_compare.load_records(_write(tmp_path / "a.json", OLD))
+    assert set(recs) == {"bm25_match_qps_100k_docs_tpu", "agg_terms",
+                         "hybrid"}
+
+
+def test_warm_p50_prefers_warm_field():
+    assert bench_compare.warm_p50({"warm_p50_ms": 10.0,
+                                   "p50_ms": 40.0}) == 10.0
+    assert bench_compare.warm_p50({"p50_ms": 5.0}) == 5.0
+    assert bench_compare.warm_p50({"value": 1}) is None
+
+
+def test_ok_within_threshold(tmp_path):
+    new = [dict(r) for r in OLD]
+    new[1] = dict(new[1], warm_p50_ms=10.9)      # +9% < 10%
+    old_p = _write(tmp_path / "old.json", OLD)
+    new_p = _write(tmp_path / "new.json", new)
+    rows, failures = bench_compare.compare(
+        bench_compare.load_records(old_p),
+        bench_compare.load_records(new_p), 10.0)
+    assert not failures
+    assert all(r["status"] in ("ok",) for r in rows)
+
+
+def test_regression_fails(tmp_path):
+    new = [dict(r) for r in OLD]
+    new[2] = dict(new[2], warm_p50_ms=25.0)      # +25% > 10%
+    rows, failures = bench_compare.compare(
+        bench_compare.load_records(_write(tmp_path / "o.json", OLD)),
+        bench_compare.load_records(_write(tmp_path / "n.json", new)),
+        10.0)
+    assert len(failures) == 1 and "hybrid" in failures[0]
+    assert [r for r in rows if r["status"] == "REGRESSION"]
+
+
+def test_one_sided_configs_never_fail(tmp_path):
+    new = OLD + [{"mode": "knn_exact", "warm_p50_ms": 1.0}]
+    rows, failures = bench_compare.compare(
+        bench_compare.load_records(_write(tmp_path / "o.json", OLD[:1])),
+        bench_compare.load_records(_write(tmp_path / "n.json", new)),
+        10.0)
+    assert not failures
+    assert {r["status"] for r in rows} <= {"ok", "new-only", "old-only"}
+
+
+def test_improvement_is_ok(tmp_path):
+    new = [dict(r, warm_p50_ms=1.0) if "warm_p50_ms" in r else dict(r)
+           for r in OLD]
+    _, failures = bench_compare.compare(
+        bench_compare.load_records(_write(tmp_path / "o.json", OLD)),
+        bench_compare.load_records(_write(tmp_path / "n.json", new)),
+        10.0)
+    assert not failures
+
+
+def test_cli_exit_codes(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tool = os.path.join(repo, "tools", "bench_compare.py")
+    old_p = _write(tmp_path / "old.json", OLD)
+    regressed = [dict(OLD[0], p50_ms=50.0)] + OLD[1:]
+    bad_p = _write(tmp_path / "bad.json", regressed)
+    ok = subprocess.run([sys.executable, tool, old_p, old_p],
+                        capture_output=True, text=True, timeout=60)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "OK" in ok.stdout
+    bad = subprocess.run([sys.executable, tool, old_p, bad_p],
+                         capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1
+    assert "REGRESSION" in bad.stdout
+    # a loosened threshold passes the same pair
+    loose = subprocess.run(
+        [sys.executable, tool, "--threshold", "2000", old_p, bad_p],
+        capture_output=True, text=True, timeout=60)
+    assert loose.returncode == 0
+    usage = subprocess.run([sys.executable, tool, old_p],
+                           capture_output=True, text=True, timeout=60)
+    assert usage.returncode == 2

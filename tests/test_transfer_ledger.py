@@ -1,0 +1,545 @@
+"""Transfer-ledger, device-memory and rolling-percentile tests.
+
+The load-bearing property is CONSERVATION: the ledger's per-channel
+device→host bytes must sum to the `nbytes` of what `jax.device_get`
+actually returned — measured here by wrapping `device_get` itself, so
+the test never trusts the ledger's own arithmetic. Also pinned: the
+bytes_to_device attribution regression (the envelope/hybrid/cached
+paths used to report 0 — ISSUE 7 satellite 1), the disabled ledger's
+no-op discipline (the PR 4 tracer contract), and the rolling
+estimator's convergence against an offline numpy percentile."""
+
+import json
+import logging
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.search.executor import SearchExecutor, ShardReader
+from opensearch_tpu.telemetry import TELEMETRY
+from opensearch_tpu.telemetry.ledger import (
+    DeviceMemoryAccounting, LedgerScope, TransferLedger)
+from opensearch_tpu.telemetry.rolling import RollingEstimator
+from opensearch_tpu.utils.demo import build_shards, query_terms
+
+N_DOCS = 400
+VOCAB = 300
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    TELEMETRY.ledger.enabled = False
+    TELEMETRY.ledger.reset()
+    yield
+    TELEMETRY.ledger.enabled = False
+    TELEMETRY.ledger.reset()
+    TELEMETRY.disable()
+    TELEMETRY.tracer.clear()
+
+
+@pytest.fixture(scope="module")
+def ex():
+    mapper, segments = build_shards(N_DOCS, n_shards=1, vocab_size=VOCAB,
+                                    avg_len=30, seed=42)
+    return SearchExecutor(ShardReader(mapper, segments))
+
+
+@pytest.fixture()
+def measured_gets(monkeypatch):
+    """Wrap jax.device_get to total the nbytes it ACTUALLY returned —
+    the ground truth the ledger must conserve against."""
+    import jax
+    orig = jax.device_get
+    total = {"bytes": 0, "calls": 0}
+
+    def wrapper(x):
+        out = orig(x)
+        total["bytes"] += sum(
+            int(getattr(leaf, "nbytes", 0))
+            for leaf in jax.tree_util.tree_leaves(out))
+        total["calls"] += 1
+        return out
+
+    monkeypatch.setattr(jax, "device_get", wrapper)
+    return total
+
+
+def _bodies(n, seed=7):
+    return [{"query": {"match": {"body": q}}, "size": 5}
+            for q in query_terms(n, VOCAB, seed=seed, terms_per_query=2)]
+
+
+def _d2h_channel_sum(snap):
+    return sum(e["bytes"] for e in snap["channels"]["d2h"].values())
+
+
+# --------------------------------------------------------------- conservation
+
+class TestConservation:
+    @pytest.mark.parametrize("b", [1, 32, 1024])
+    def test_msearch_channel_bytes_sum_to_fetched_nbytes(
+            self, ex, measured_gets, b):
+        """Per-channel d2h bytes sum to the nbytes device_get returned,
+        within 1%, for B in {1, 32, 1024} (the acceptance bound)."""
+        ex.multi_search(_bodies(b), _bypass_request_cache=True)  # warm
+        TELEMETRY.ledger.enabled = True
+        TELEMETRY.ledger.reset()
+        measured_gets["bytes"] = measured_gets["calls"] = 0
+        ex.multi_search(_bodies(b), _bypass_request_cache=True)
+        snap = TELEMETRY.ledger.snapshot()
+        assert measured_gets["bytes"] > 0
+        assert snap["bytes_total"]["d2h"] == _d2h_channel_sum(snap)
+        assert abs(snap["bytes_total"]["d2h"] - measured_gets["bytes"]) \
+            <= 0.01 * measured_gets["bytes"]
+        assert snap["device_get"]["calls"] == measured_gets["calls"]
+
+    def test_single_search_msearch_parity(self, ex):
+        """search() serves through the B=1 envelope: same body, same
+        per-channel byte attribution as multi_search([body])."""
+        from opensearch_tpu.indices.request_cache import REQUEST_CACHE
+        body = _bodies(1, seed=11)[0]
+        ex.search(dict(body))                   # warm the executables
+        TELEMETRY.ledger.enabled = True
+        TELEMETRY.ledger.reset()
+        REQUEST_CACHE.clear()
+        ex.search(dict(body))
+        single = TELEMETRY.ledger.snapshot()
+        TELEMETRY.ledger.reset()
+        REQUEST_CACHE.clear()
+        ex.multi_search([dict(body)], _bypass_request_cache=True)
+        batched = TELEMETRY.ledger.snapshot()
+        assert single["channels"]["d2h"] == batched["channels"]["d2h"]
+
+    def test_general_path_conservation(self, ex, measured_gets):
+        """Field-sorted bodies are not envelope-batchable: the general
+        host-loop path must conserve too (sort_keys channel appears)."""
+        body = {"query": {"match": {"body": query_terms(
+            1, VOCAB, seed=3)[0]}}, "size": 5,
+            "sort": [{"views": "asc"}]}
+        ex.search(dict(body))                   # warm
+        TELEMETRY.ledger.enabled = True
+        TELEMETRY.ledger.reset()
+        measured_gets["bytes"] = measured_gets["calls"] = 0
+        from opensearch_tpu.indices.request_cache import REQUEST_CACHE
+        REQUEST_CACHE.clear()
+        ex.search(dict(body))
+        snap = TELEMETRY.ledger.snapshot()
+        assert "sort_keys" in snap["channels"]["d2h"]
+        assert abs(snap["bytes_total"]["d2h"] - measured_gets["bytes"]) \
+            <= 0.01 * max(measured_gets["bytes"], 1)
+
+    def test_hybrid_path_conservation(self, ex, measured_gets):
+        qs = query_terms(2, VOCAB, seed=5)
+        body = {"query": {"hybrid": {"queries": [
+            {"match": {"body": qs[0]}}, {"match": {"body": qs[1]}}]}},
+            "size": 5}
+        ex.search(dict(body))                   # warm
+        TELEMETRY.ledger.enabled = True
+        TELEMETRY.ledger.reset()
+        measured_gets["bytes"] = measured_gets["calls"] = 0
+        ex.search(dict(body))
+        snap = TELEMETRY.ledger.snapshot()
+        assert "score_bounds" in snap["channels"]["d2h"]
+        assert abs(snap["bytes_total"]["d2h"] - measured_gets["bytes"]) \
+            <= 0.01 * max(measured_gets["bytes"], 1)
+
+    def test_msearch_pad_rows_go_to_padding_channel(self, ex,
+                                                    measured_gets):
+        """A non-bucket batch (B=3 → padded rows) keeps the real
+        channels at payload size; the pad rides `padding` — and the
+        total still conserves."""
+        ex.multi_search(_bodies(3), _bypass_request_cache=True)  # warm
+        TELEMETRY.ledger.enabled = True
+        TELEMETRY.ledger.reset()
+        measured_gets["bytes"] = measured_gets["calls"] = 0
+        ex.multi_search(_bodies(3), _bypass_request_cache=True)
+        snap = TELEMETRY.ledger.snapshot()
+        chans = snap["channels"]["d2h"]
+        assert "padding" in chans
+        # 3 real rows at the k_fetch floor of 10: scores = 3·10·4 B —
+        # NOT the padded row count
+        assert chans["scores"]["bytes"] == 3 * 10 * 4
+        assert abs(snap["bytes_total"]["d2h"] - measured_gets["bytes"]) \
+            <= 0.01 * max(measured_gets["bytes"], 1)
+
+    def test_hybrid_msearch_pad_rows_go_to_padding_channel(
+            self, ex, measured_gets):
+        """A batch-padded hybrid envelope (3 items → pad_bucket rows)
+        reports the pad rows under `padding`, not as real payload —
+        and still conserves against the transferred nbytes."""
+        qs = query_terms(6, VOCAB, seed=13)
+        bodies = [{"query": {"hybrid": {"queries": [
+            {"match": {"body": qs[i]}},
+            {"match": {"body": qs[i + 3]}}]}}, "size": 5}
+            for i in range(3)]
+        ex.multi_search([dict(b) for b in bodies])          # warm
+        TELEMETRY.ledger.enabled = True
+        TELEMETRY.ledger.reset()
+        measured_gets["bytes"] = measured_gets["calls"] = 0
+        ex.multi_search([dict(b) for b in bodies])
+        snap = TELEMETRY.ledger.snapshot()
+        assert "padding" in snap["channels"]["d2h"]
+        assert abs(snap["bytes_total"]["d2h"] - measured_gets["bytes"]) \
+            <= 0.01 * max(measured_gets["bytes"], 1)
+
+    def test_aggs_envelope_conservation(self, ex, measured_gets):
+        """Agg-carrying envelope waves route partials through the
+        agg_buffers channel and still conserve (combined-fetch padding
+        has its own channel so the sum stays exact)."""
+        bodies = [{"size": 0,
+                   "query": {"range": {"views": {"gte": i}}},
+                   "aggs": {"by_tag": {"terms": {"field": "tag",
+                                                 "size": 5}}}}
+                  for i in range(8)]
+        ex.multi_search([dict(b) for b in bodies],
+                        _bypass_request_cache=True)  # warm
+        TELEMETRY.ledger.enabled = True
+        TELEMETRY.ledger.reset()
+        measured_gets["bytes"] = measured_gets["calls"] = 0
+        ex.multi_search([dict(b) for b in bodies],
+                        _bypass_request_cache=True)
+        snap = TELEMETRY.ledger.snapshot()
+        assert "agg_buffers" in snap["channels"]["d2h"]
+        assert abs(snap["bytes_total"]["d2h"] - measured_gets["bytes"]) \
+            <= 0.01 * max(measured_gets["bytes"], 1)
+
+
+# ------------------------------------- bytes_to_device attribution regression
+
+class TestAttributionRegression:
+    """ISSUE 7 satellite 1 pin: envelope-, hybrid- and cached-path spans
+    used to report bytes_to_device = 0 (the sum lived only in the
+    general path's single branch)."""
+
+    @pytest.fixture()
+    def node(self):
+        from opensearch_tpu.node import Node
+        n = Node()
+        n.request("PUT", "/led", {"mappings": {"properties": {
+            "msg": {"type": "text"}, "n": {"type": "integer"}}}})
+        for i in range(20):
+            n.request("PUT", f"/led/_doc/{i}",
+                      {"msg": f"message {i}", "n": i})
+        n.request("POST", "/led/_refresh")
+        yield n
+
+    def _trace_attrs(self):
+        """Flatten attributes of the newest trace's span tree."""
+        traces = TELEMETRY.tracer.traces(1)
+        assert traces, "no trace recorded"
+        merged = {}
+
+        def walk(span):
+            merged.update(span.get("attributes") or {})
+            for c in span.get("children") or []:
+                walk(c)
+        walk(traces[0].get("trace", traces[0]))
+        return merged
+
+    def test_envelope_span_bytes_to_device_nonzero(self, node):
+        node.request("POST", "/led/_search",
+                     {"query": {"match": {"msg": "message"}}})  # warm
+        TELEMETRY.enable()
+        TELEMETRY.tracer.clear()
+        node.request("POST", "/led/_search",
+                     {"query": {"match": {"msg": "message"}}})
+        attrs = self._trace_attrs()
+        assert attrs.get("bytes_to_device", 0) > 0
+        assert attrs.get("bytes_fetched", 0) > 0
+        assert attrs.get("transfers"), "per-transfer list missing"
+
+    def test_hybrid_span_bytes_to_device_nonzero(self, node):
+        body = {"query": {"hybrid": {"queries": [
+            {"match": {"msg": "message"}}, {"match": {"msg": "19"}}]}}}
+        node.request("POST", "/led/_search", body)            # warm
+        TELEMETRY.enable()
+        TELEMETRY.tracer.clear()
+        node.request("POST", "/led/_search", body)
+        attrs = self._trace_attrs()
+        assert attrs.get("bytes_to_device", 0) > 0
+
+    def test_profile_transfers_per_shard(self, node):
+        res = node.request("POST", "/led/_search", {
+            "profile": True, "sort": [{"n": "asc"}],
+            "query": {"match": {"msg": "message"}}})
+        prof = res["profile"]
+        assert prof["bytes_to_device"] > 0
+        assert prof["bytes_fetched"] > 0
+        shard = prof["shards"][0]
+        assert shard["transfers"], "profile transfers[] missing"
+        chans = {t["channel"] for t in shard["transfers"]}
+        assert "upload.literals" in chans
+        assert {"direction", "bytes", "round_trips"} <= \
+            set(shard["transfers"][0])
+
+    def test_cached_render_keeps_truthful_bytes(self, ex):
+        """A fully request-cache-served envelope item renders fine and
+        reports 0 transferred bytes — truthfully (nothing crossed), not
+        spuriously: the uncached first pass reports > 0."""
+        from opensearch_tpu.indices.request_cache import REQUEST_CACHE
+        body = {"size": 0, "query": {"match_all": {}},
+                "aggs": {"t": {"terms": {"field": "tag", "size": 3}}}}
+        ex.multi_search([dict(body)])           # warm + populate cache
+        TELEMETRY.ledger.enabled = True
+        TELEMETRY.ledger.reset()
+        REQUEST_CACHE.clear()
+        r1 = ex.multi_search([dict(body)])
+        uncached = TELEMETRY.ledger.snapshot()["bytes_total"]["d2h"]
+        TELEMETRY.ledger.reset()
+        r2 = ex.multi_search([dict(body)])      # cache hit
+        cached = TELEMETRY.ledger.snapshot()["bytes_total"]["d2h"]
+        assert uncached > 0
+        assert cached == 0
+        assert r1["responses"][0]["aggregations"] == \
+            r2["responses"][0]["aggregations"]
+
+
+# ----------------------------------------------------------- no-op discipline
+
+class TestNoOpDiscipline:
+    def test_scope_gate_returns_none_when_off(self):
+        assert TELEMETRY.ledger.scope() is None
+        assert TELEMETRY.ledger.scope(trace=None) is None
+
+    def test_disabled_ledger_records_nothing(self, ex):
+        ex.multi_search(_bodies(4), _bypass_request_cache=True)
+        snap = TELEMETRY.ledger.snapshot()
+        assert snap["enabled"] is False
+        assert snap["channels"]["d2h"] == {}
+        assert snap["channels"]["h2d"] == {}
+        assert snap["device_get"]["calls"] == 0
+
+    def test_recording_trace_opts_in_without_global_aggregates(self):
+        """A profile/traced request gets a scope even with the ledger
+        off — but node-wide aggregates stay untouched (per-request
+        attribution only)."""
+        class _Rec:
+            recording = True
+        ledger = TransferLedger()
+        scope = ledger.scope(_Rec())
+        assert isinstance(scope, LedgerScope)
+        ledger.record("scores", "d2h", 128, scope=scope)
+        assert scope.d2h_bytes == 128
+        assert ledger.snapshot()["channels"]["d2h"] == {}
+
+    def test_new_wave_disabled_does_not_advance_sequence(self):
+        """A traced-only request must not bump the node-wide wave seq:
+        snapshot()'s `waves` has to stay consistent with its channels."""
+        ledger = TransferLedger()
+        assert ledger.new_wave() is None
+        assert ledger.snapshot()["waves"] == 0
+        ledger.enabled = True
+        assert ledger.new_wave() == 1
+
+    def test_ambient_scope_binding(self):
+        """The fetch phase binds the request scope ambiently; record()
+        callers read it back via current()."""
+        ledger = TransferLedger()
+        scope = LedgerScope()
+        assert ledger.current() is None
+        with ledger.ambient(scope):
+            assert ledger.current() is scope
+            ledger.record("docvalues", "d2h", 256, scope=ledger.current())
+        assert ledger.current() is None
+        assert scope.d2h_bytes == 256
+
+    def test_warmup_replays_record_under_warmup_prefix(self):
+        ledger = TransferLedger()
+        ledger.enabled = True
+        with ledger.tagged("warmup"):
+            ledger.record("upload.literals", "h2d", 64)
+        ledger.record("upload.literals", "h2d", 32)
+        chans = ledger.snapshot()["channels"]["h2d"]
+        assert chans["warmup.upload.literals"]["bytes"] == 64
+        assert chans["upload.literals"]["bytes"] == 32
+
+
+# ------------------------------------------------------------ rolling windows
+
+class TestRollingEstimator:
+    def test_convergence_vs_offline_numpy_percentile(self):
+        rng = np.random.RandomState(17)
+        samples = rng.lognormal(mean=3.0, sigma=1.0, size=20000)
+        est = RollingEstimator(half_life_s=None)
+        for s in samples:
+            est.observe(float(s))
+        for p in (50, 95, 99):
+            offline = float(np.percentile(samples, p))
+            live = est.quantile(p / 100.0)
+            assert abs(live - offline) <= 0.10 * offline, \
+                f"p{p}: rolling {live} vs offline {offline}"
+
+    def test_quantile_never_exceeds_observed_max(self):
+        est = RollingEstimator(half_life_s=None)
+        for v in (10.0, 11.0, 12.0, 1660.0):
+            est.observe(v)
+        s = est.summary()
+        assert s["p95"] <= s["max"]
+        assert s["p99"] <= s["max"]
+
+    def test_decay_forgets_old_traffic(self):
+        clock = [0.0]
+        est = RollingEstimator(half_life_s=10.0, clock=lambda: clock[0])
+        for _ in range(1000):
+            est.observe(100.0)
+        # 10 half-lives later the old burst carries ~1/1024 weight: new
+        # traffic at 1.0 dominates every quantile
+        clock[0] = 100.0
+        for _ in range(100):
+            est.observe(1.0)
+        assert est.quantile(0.5) < 5.0
+        assert est.total < 1000
+
+    def test_empty_and_reset(self):
+        est = RollingEstimator(half_life_s=None)
+        assert est.quantile(0.5) is None
+        assert est.summary()["p99"] is None
+        est.observe(5.0)
+        est.reset()
+        assert est.quantile(0.5) is None
+
+    def test_metrics_histograms_carry_live_summary(self):
+        from opensearch_tpu.telemetry.metrics import MetricsRegistry
+        reg = MetricsRegistry()
+        h = reg.histogram("test.rolling_ms")
+        for v in (1.0, 2.0, 3.0, 100.0):
+            h.observe(v)
+        d = h.to_dict()
+        assert "p95_ms" in d
+        assert set(d["summary"]) == {"p50_ms", "p95_ms", "p99_ms",
+                                     "count"}
+        assert d["summary"]["p99_ms"] is not None
+
+
+# ----------------------------------------------------- device-memory accounts
+
+class TestDeviceMemory:
+    def test_corpus_columns_gauge_tracks_reader(self, ex):
+        stats = TELEMETRY.device_memory.stats()["classes"]
+        corpus = stats.get("corpus_columns", {})
+        assert corpus.get("live_bytes", 0) > 0
+        assert corpus.get("readers", 0) >= 1
+        assert ex.reader.device_bytes > 0
+
+    def test_wave_buffers_return_to_zero(self, ex):
+        # the gauge is live even with the ledger off (device-memory
+        # classes are not ledger-gated) and drains after the wave
+        ex.multi_search(_bodies(8), _bypass_request_cache=True)
+        assert TELEMETRY.device_memory.live_bytes("wave_buffers") == 0
+
+    def test_wave_buffers_released_on_cancellation(self, ex):
+        """A cancellation at the between-prepare-and-finish checkpoint
+        must not leak the in-flight gauge forever."""
+        from opensearch_tpu.common.errors import TaskCancelledError
+        TELEMETRY.ledger.enabled = True
+
+        class _Task:
+            calls = 0
+
+            def check_cancelled(self):
+                # first checkpoint (envelope entry + pre-prepare) passes;
+                # the post-prepare checkpoint fires
+                self.calls += 1
+                if self.calls >= 3:
+                    raise TaskCancelledError("cancelled")
+        with pytest.raises(TaskCancelledError):
+            ex.multi_search(_bodies(4), _bypass_request_cache=True,
+                            task=_Task())
+        assert TELEMETRY.device_memory.live_bytes("wave_buffers") == 0
+
+    def test_agg_constants_registered(self, ex):
+        ex.search({"size": 0, "query": {"match_all": {}},
+                   "aggs": {"d": {"date_histogram": {
+                       "field": "ts", "fixed_interval": "1d"}}}})
+        classes = TELEMETRY.device_memory.stats()["classes"]
+        assert classes.get("agg_constants", {}).get("live_bytes", 0) > 0
+
+    @staticmethod
+    def _agg_const_bytes():
+        classes = TELEMETRY.device_memory.stats()["classes"]
+        return classes.get("agg_constants", {}).get("live_bytes", 0)
+
+    def test_agg_constants_released_on_segment_removal(self):
+        """Segment/index churn must not grow the agg_constants gauge
+        without bound: the byte map lives on the segment and is summed
+        over LIVE readers only, so a removed segment leaves the sum."""
+        mapper, segments = build_shards(50, n_shards=1, vocab_size=50,
+                                        avg_len=10, seed=9)
+        local = SearchExecutor(ShardReader(mapper, segments))
+        local.search({"size": 0, "query": {"match_all": {}},
+                      "aggs": {"d": {"date_histogram": {
+                          "field": "ts", "fixed_interval": "1d"}}}})
+        before = self._agg_const_bytes()
+        assert before > 0
+        local.reader.remove_segment(segments[0].seg_id)
+        assert self._agg_const_bytes() < before
+
+    def test_register_release_adjust(self):
+        mem = DeviceMemoryAccounting()
+        mem.register("x", "k1", 100)
+        mem.register("x", "k2", 50)
+        assert mem.live_bytes("x") == 150
+        mem.release("x", "k1")
+        assert mem.live_bytes("x") == 50
+        mem.adjust("gauge", 70)
+        mem.adjust("gauge", -100)       # floors at 0, never negative
+        assert mem.live_bytes("gauge") == 0
+        stats = mem.stats()
+        assert stats["classes"]["x"]["live_bytes"] == 50
+        assert "hbm" in stats
+
+
+# ------------------------------------------------------------- REST + slowlog
+
+class TestRestSurface:
+    @pytest.fixture()
+    def node(self):
+        from opensearch_tpu.node import Node
+        n = Node()
+        n.request("PUT", "/rl", {"mappings": {"properties": {
+            "msg": {"type": "text"}}}})
+        for i in range(10):
+            n.request("PUT", f"/rl/_doc/{i}", {"msg": f"word {i}"})
+        n.request("POST", "/rl/_refresh")
+        yield n
+
+    def test_transfers_endpoint_roundtrip(self, node):
+        res = node.request("POST", "/_telemetry/transfers/_enable")
+        assert res["enabled"] is True
+        node.request("POST", "/rl/_search",
+                     body={"query": {"match": {"msg": "word"}}})
+        res = node.request("GET", "/_telemetry/transfers")
+        snap = res["transfers"]
+        assert snap["enabled"] is True
+        assert snap["bytes_total"]["d2h"] > 0
+        assert "device_memory" in res
+        assert res["device_memory"]["classes"]
+        node.request("POST", "/_telemetry/transfers/_clear")
+        snap = node.request("GET", "/_telemetry/transfers")["transfers"]
+        assert snap["bytes_total"]["d2h"] == 0
+        res = node.request("POST", "/_telemetry/transfers/_disable")
+        assert res["enabled"] is False
+
+    def test_nodes_stats_carries_transfers_and_memory(self, node):
+        stats = node.request("GET", "/_nodes/stats")
+        tel = next(iter(stats["nodes"].values()))["telemetry"]
+        assert "transfers" in tel
+        assert "device_memory" in tel
+        # satellite 2: histograms carry server-computed live summaries
+        hists = tel["metrics"]["histograms"]
+        any_hist = next(iter(hists.values()))
+        assert "summary" in any_hist and "p95_ms" in any_hist
+
+    def test_slowlog_line_carries_transfer_fields(self, node, caplog):
+        node.request("POST", "/_telemetry/transfers/_enable")
+        node.request("PUT", "/rl/_settings", {"index": {
+            "search.slowlog.threshold.query.info": "0ms"}})
+        logger = "opensearch_tpu.index.search.slowlog.query"
+        with caplog.at_level(logging.INFO, logger=logger):
+            node.request("POST", "/rl/_search",
+                         body={"query": {"match": {"msg": "word"}}})
+        records = [r for r in caplog.records if r.name == logger]
+        assert records
+        msg = records[0].getMessage()
+        assert "bytes_fetched[" in msg
+        assert "device_get_ms[" in msg

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Diff two bench dumps; fail on warm-latency regression.
+
+Input: two files of bench.py output records (BENCH_*.json /
+BENCH_ALL.json style — one JSON object per line, each carrying "metric"
+or "mode" plus latency fields). Configs are matched by "mode" when
+present, else by the "metric" name with the trailing platform/shape
+suffix kept (the same config always renders the same metric string).
+
+The gate: any config whose warm p50 ("warm_p50_ms", falling back to
+"p50_ms" for configs without a warmup pass) regresses by more than
+--threshold (default 10%) fails the run with exit code 1 — the CI tripwire
+for "this PR made warm serving slower". Configs present in only one file
+are reported but never fail (bench sets grow PR over PR).
+
+    python tools/bench_compare.py BENCH_r05.json BENCH_r06.json
+    python tools/bench_compare.py --threshold 15 old.json new.json
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+WARM_KEYS = ("warm_p50_ms", "p50_ms")
+
+
+def load_records(path: str) -> Dict[str, dict]:
+    """file of JSON lines (or one JSON array) → {config key: record}."""
+    text = open(path).read().strip()
+    if not text:
+        return {}
+    records: List[dict] = []
+    if text[0] == "[":
+        records = [r for r in json.loads(text) if isinstance(r, dict)]
+    else:
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict):
+                records.append(obj)
+    out: Dict[str, dict] = {}
+    for rec in records:
+        key = rec.get("mode") or rec.get("metric")
+        if key and "error" not in rec:
+            out[str(key)] = rec      # latest record per config wins
+    return out
+
+
+def warm_p50(rec: dict) -> Optional[float]:
+    for key in WARM_KEYS:
+        v = rec.get(key)
+        if isinstance(v, (int, float)) and v > 0:
+            return float(v)
+    return None
+
+
+def compare(old: Dict[str, dict], new: Dict[str, dict],
+            threshold_pct: float) -> Tuple[List[dict], List[str]]:
+    """→ (rows, failures). A row per config in either file."""
+    rows, failures = [], []
+    for key in sorted(set(old) | set(new)):
+        o, n = old.get(key), new.get(key)
+        row = {"config": key}
+        if o is None or n is None:
+            row["status"] = "old-only" if n is None else "new-only"
+            rows.append(row)
+            continue
+        ov, nv = warm_p50(o), warm_p50(n)
+        row["old_warm_p50_ms"] = ov
+        row["new_warm_p50_ms"] = nv
+        if ov is None or nv is None:
+            row["status"] = "no-latency-field"
+            rows.append(row)
+            continue
+        delta_pct = 100.0 * (nv - ov) / ov
+        row["delta_pct"] = round(delta_pct, 1)
+        if delta_pct > threshold_pct:
+            row["status"] = "REGRESSION"
+            failures.append(
+                f"{key}: warm p50 {ov}ms -> {nv}ms "
+                f"(+{delta_pct:.1f}% > {threshold_pct:g}%)")
+        else:
+            row["status"] = "ok"
+        rows.append(row)
+    return rows, failures
+
+
+def render(rows: List[dict]) -> str:
+    headers = ["config", "old_warm_p50_ms", "new_warm_p50_ms",
+               "delta_pct", "status"]
+    table = [headers] + [[str(r.get(h, "-")) for h in headers]
+                         for r in rows]
+    widths = [max(len(row[i]) for row in table)
+              for i in range(len(headers))]
+    return "\n".join(
+        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+        for row in table)
+
+
+def main(argv: List[str]) -> int:
+    threshold = 10.0
+    args: List[str] = []
+    rest = list(argv[1:])
+    while rest:
+        a = rest.pop(0)
+        if a.startswith("--threshold"):
+            threshold = float(a.split("=", 1)[1]) if "=" in a \
+                else float(rest.pop(0))
+        else:
+            args.append(a)
+    if len(args) != 2:
+        print("usage: bench_compare.py [--threshold PCT] OLD.json NEW.json")
+        return 2
+    old, new = load_records(args[0]), load_records(args[1])
+    if not old or not new:
+        print(f"no parsable bench records in "
+              f"{args[0] if not old else args[1]}")
+        return 2
+    rows, failures = compare(old, new, threshold)
+    print(render(rows))
+    if failures:
+        print(f"\nFAIL: {len(failures)} config(s) regressed "
+              f"beyond {threshold:g}% on warm p50:")
+        for f in failures:
+            print(f"  {f}")
+        return 1
+    print(f"\nOK: no warm-p50 regression beyond {threshold:g}%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
