@@ -101,6 +101,29 @@ TELEMETRY_ON = "--telemetry" in sys.argv
 # module attribute load — nothing else runs.
 FAULTS_ON = "--faults" in sys.argv
 
+# --sanitize: install + enable the host-sync sanitizer
+# (common/sanitize.py) for the measured run — every query-path
+# device_get must execute inside a ledger-attributed region or the run
+# DIES with UnattributedSyncError. Without the flag the run ASSERTS the
+# sanitizer is fully uninstalled: `jax.device_get` must be the pristine
+# function (not even a pass-through wrapper on the hot path), the same
+# zero-overhead contract as the tracer/injector/ledger asserts above.
+SANITIZE_ON = "--sanitize" in sys.argv
+
+
+def _setup_sanitizer():
+    from opensearch_tpu.common.sanitize import SANITIZER
+    if SANITIZE_ON:
+        SANITIZER.install()
+        SANITIZER.enabled = True
+        return
+    assert SANITIZER.enabled is False and not SANITIZER.installed, \
+        "sync sanitizer must be uninstalled for clean benches"
+    import jax
+    assert not hasattr(jax.device_get, "__sanitizer_original__"), \
+        "jax.device_get must be the pristine function when the " \
+        "sanitizer is off"
+
 
 def _setup_telemetry():
     from opensearch_tpu.telemetry import TELEMETRY
@@ -665,6 +688,7 @@ def main():
 
     _setup_telemetry()
     _setup_faults()
+    _setup_sanitizer()
     mode = os.environ.get("BENCH_MODE", "bm25")
     if mode in ("knn_exact", "knn_ivf"):
         bench_knn(mode)

@@ -187,10 +187,18 @@ _MASK_JIT: Dict = {}
 
 def _eval_filter_mask(plan, arrays) -> np.ndarray:
     """Run ONLY the filter sub-plan on device and pull its match mask to
-    host. Jitted per plan signature, like the executor's query runners."""
+    host. Jitted per plan signature, like the executor's query runners.
+    The mask pull is a real query-path transfer (a cache fill riding the
+    triggering request), so it is ledger-attributed on its own channel —
+    before this it was an invisible sync the PROFILE.md decomposition
+    could not explain."""
+    import time
+
     import jax
     import jax.numpy as jnp
+
     from opensearch_tpu.search.plan_eval import _eval_plan
+    from opensearch_tpu.telemetry import TELEMETRY
 
     sig = ("filter_mask", plan.sig())
     fn = _MASK_JIT.get(sig)
@@ -199,6 +207,21 @@ def _eval_filter_mask(plan, arrays) -> np.ndarray:
             cursor = [0]
             _, matches = _eval_plan(_plan, seg, flat_inputs, cursor)
             return matches
-        fn = _MASK_JIT[sig] = jax.jit(run)
+        fn = _MASK_JIT[sig] = jax.jit(run)  # shared-state-ok: benign double-jit race; dict slot write is GIL-atomic
     flat = jax.tree_util.tree_map(jnp.asarray, plan.flatten_inputs([]))
-    return np.asarray(jax.device_get(fn(arrays, flat)))
+    ledger = TELEMETRY.ledger
+    scope = ledger.current()
+    accounting = ledger.enabled or scope is not None
+    with ledger.attributed():
+        # dispatch before the clock: a first-seen filter signature
+        # compiles synchronously inside fn(), and compile wall must not
+        # report as device_get/transfer wall
+        out = fn(arrays, flat)
+        t0 = time.monotonic() if accounting else 0.0
+        mask = np.asarray(jax.device_get(out))
+    if accounting:
+        ledger.record("filter_mask", "d2h", mask.nbytes,
+                      wave=ledger.new_wave(), scope=scope)
+        ledger.note_device_get((time.monotonic() - t0) * 1000,
+                               nbytes=mask.nbytes, scope=scope)
+    return mask

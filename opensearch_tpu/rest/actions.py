@@ -200,7 +200,7 @@ def _run_search(node, index_expr: Optional[str], body: Optional[dict],
         root.set_attribute("took_ms", res.get("took"))
         _maybe_slow_log(node, index_expr, body, res, phase_times)
         return res
-    except BaseException as e:
+    except BaseException as e:  # except-ok: span lifecycle -- closes the root span with error status, then always re-raises
         if getattr(root, "status", "ok") == "ok":
             root.end(error=e)
         raise
@@ -212,7 +212,7 @@ def _run_search(node, index_expr: Optional[str], body: Optional[dict],
 
 # query/fetch phase slow-log loggers, children of the original logger
 # name so existing capture configuration keeps working
-_SLOW_LOGGERS: Dict[str, Any] = {}
+_SLOW_LOGGERS: Dict[str, Any] = {}  # shared-state-ok: getLogger is idempotent + thread-safe; dict slot write is GIL-atomic
 
 # level check order mirrors SearchSlowLog.java: most severe first, the
 # first threshold the phase time clears wins
@@ -257,9 +257,10 @@ def _maybe_slow_log(node, index_expr, body, res, phase_times=None):
                     f"search.slowlog.threshold.{phase}.{level}")
                 if threshold is None:
                     continue
+                from opensearch_tpu.common.errors import SettingsError
                 try:
                     threshold_s = parse_time_value(threshold, "slowlog")
-                except Exception:
+                except (SettingsError, TypeError, ValueError):
                     continue        # unparseable threshold never logs
                 if threshold_s < 0 or t_ms < threshold_s * 1000:
                     continue
@@ -858,7 +859,7 @@ def register_search_actions(node, c):
                         res["responses"].extend(
                             dict(rejected)
                             for _ in range(len(bodies) - admitted))
-                except BaseException as e:
+                except BaseException as e:  # except-ok: span lifecycle -- closes every sub-request span, then always re-raises
                     for s in spans:
                         s.end(error=e)
                     raise

@@ -299,7 +299,7 @@ def _parse_time_zone(tz) -> int:
         off = ZoneInfo(s).utcoffset(
             _dt.datetime(2024, 1, 15, tzinfo=_dt.timezone.utc))
         return int(off.total_seconds() * 1000)
-    except Exception:
+    except (KeyError, ValueError, OSError, ImportError):
         raise ParsingError(f"failed to parse time zone [{tz}]")
 
 
@@ -491,7 +491,7 @@ def _c_date_histogram(node: AggNode, ctx: _Ctx) -> AggPlan:
         bounds = _calendar_boundaries(float(col.unique[0]) + shift,
                                       float(col.unique[-1]) + shift, unit)
         bounds = [b - shift for b in bounds]
-        buckets = np.searchsorted(np.asarray(bounds, dtype=np.float64),
+        buckets = np.searchsorted(np.asarray(bounds, dtype=np.float64),  # sync-ok: host -- compile-time bucket table from a Python list
                                   col.unique, side="right") - 1
         card = len(bounds) - 1
         keys = bounds[:-1]
@@ -594,7 +594,7 @@ def _c_nested(node: AggNode, ctx: _Ctx) -> AggPlan:
     path_ord = paths.index(path) if path in paths else -1
     children = [_compile_node(c, ctx) for c in node.children]
     return AggPlan(node.name, "nested",
-                   inputs={"path_ord": np.asarray(path_ord, np.int32)},
+                   inputs={"path_ord": np.asarray(path_ord, np.int32)},  # sync-ok: host -- scalar plan constant
                    children=children, render={"kind": "filter"})
 
 
@@ -940,7 +940,7 @@ def _c_significant_terms(node: AggNode, ctx: _Ctx) -> AggPlan:
             seen_pairs.add((doc, o))
             bg[o] += 1
     plan.render = {"kind": "significant_terms", "keys": list(ocol.dictionary),
-                   "body": node.body, "bg": bg.tolist(),
+                   "body": node.body, "bg": bg.tolist(),  # sync-ok: host -- bg counts are a host numpy accumulator
                    "bg_total": int(ctx.seg.num_docs)}
     return plan
 

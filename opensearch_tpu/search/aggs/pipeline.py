@@ -317,7 +317,7 @@ def _sibling_value(p: AggNode, scope: Dict[str, Any]) -> Dict[str, Any]:
         if not values:
             return {"values": {f"{float(q)}": None for q in percents}}
         import numpy as _np
-        arr = _np.asarray(sorted(values))
+        arr = _np.asarray(sorted(values))  # sync-ok: host -- coordinator reduce over host floats
         return {"values": {f"{float(q)}": float(_np.percentile(arr, q))
                            for q in percents}}
     raise IllegalArgumentError(f"unsupported pipeline aggregation [{p.type}]")

@@ -37,7 +37,7 @@ def decode_outputs(plans: List[AggPlan], outs: List[dict]) -> List[Decoded]:
     cursor = [0]
 
     def walk(plan: AggPlan) -> Decoded:
-        out = {k: np.asarray(v) for k, v in outs[cursor[0]].items()}
+        out = {k: np.asarray(v) for k, v in outs[cursor[0]].items()}  # sync-ok: host -- outputs already fetched by the collect phase
         cursor[0] += 1
         if plan.query_plan is not None:
             pass  # query plan consumed no output slots (inputs only)
@@ -336,8 +336,8 @@ def _merge_histogram(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
         card = d.plan.static[1]
         keys = d.plan.render["keys"]
         keys_str = d.plan.render.get("keys_str")
-        counts = np.asarray(d.out["counts"])[p * card:(p + 1) * card]
-        counts = counts[:len(keys)].tolist()
+        counts = np.asarray(d.out["counts"])[p * card:(p + 1) * card]  # sync-ok: host -- decoded partials are host arrays
+        counts = counts[:len(keys)].tolist()  # sync-ok: host -- decoded partials are host arrays
         if is_date:
             if keys_str is None:
                 keys_str = [format_date_millis(int(k)) for k in keys]
@@ -562,7 +562,7 @@ def _merge_cardinality(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
         # cardinality — no key materialization
         d, p = live[0]
         card = d.plan.static[1]
-        present = np.asarray(d.out["present"][p * card:(p + 1) * card])
+        present = np.asarray(d.out["present"][p * card:(p + 1) * card])  # sync-ok: host -- decoded partials are host arrays
         n_keys = len(d.plan.render["keys"]
                      if "keys" in d.plan.render
                      else d.plan.render.get("values", ()))
@@ -669,7 +669,7 @@ def _merge_composite(entries: List[Tuple[Decoded, int]],
         radices = [max(len(k), 1) for k in key_lists]
         card = int(np.prod(radices))
         base = p * card
-        nz = np.nonzero(np.asarray(counts[base:base + card]))[0]
+        nz = np.nonzero(np.asarray(counts[base:base + card]))[0]  # sync-ok: host -- decoded partials are host arrays
         for flat in nz:
             rest = int(flat)
             digits = []
@@ -745,7 +745,7 @@ def _merge_grid(entries: List[Tuple[Decoded, int]]) -> Dict[str, Any]:
         keys = d.plan.render.get("keys", [])  # per-segment key table
         card = max(len(keys), 1)
         base = p * card
-        arr = np.asarray(counts[base:base + card])
+        arr = np.asarray(counts[base:base + card])  # sync-ok: host -- decoded partials are host arrays
         for i in np.nonzero(arr)[0]:
             if i < len(keys):
                 totals[keys[i]] = totals.get(keys[i], 0) + int(arr[i])

@@ -47,8 +47,8 @@ def _shard_can_match_inner(executor, body: Optional[dict]) -> bool:
         return True      # global aggs count ALL docs regardless of query
     try:
         node = dsl.parse_query(body.get("query"))
-    except Exception:
-        return True                     # let the real path raise properly
+    except Exception:   # except-ok: canmatch is advisory -- an unparseable query degrades to "can match"; the real path raises properly
+        return True
     reader = executor.reader
     if not reader.segments:
         return False                    # no docs at all
@@ -130,7 +130,7 @@ def _term_possible(seg, mapper, field: str, value, case_insensitive) -> bool:
             return False
         try:
             v = ft.to_comparable(value)
-        except Exception:
+        except Exception:   # except-ok: canmatch is advisory -- an uncomparable value degrades to "can match"
             return True
         i = int(np.searchsorted(col.unique, v, "left"))
         return i < len(col.unique) and col.unique[i] == v
@@ -188,8 +188,8 @@ def _range_possible(seg, mapper, node: dsl.RangeQuery) -> bool:
             return False
         if node.lt is not None and bound(node.lt, False) <= seg_min:
             return False
-    except Exception:
-        return True                     # unparseable bound: let it raise
+    except Exception:   # except-ok: canmatch is advisory -- an unparseable bound degrades to "can match"
+        return True
     return True
 
 

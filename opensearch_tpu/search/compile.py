@@ -171,11 +171,11 @@ def struct_fingerprint(obj: Any) -> str:
 
 
 def _f32(x) -> np.ndarray:
-    return np.asarray(x, dtype=np.float32)
+    return np.asarray(x, dtype=np.float32)  # sync-ok: host -- plan literals are host scalars/lists
 
 
 def _i32(x) -> np.ndarray:
-    return np.asarray(x, dtype=np.int32)
+    return np.asarray(x, dtype=np.int32)  # sync-ok: host -- plan literals are host scalars/lists
 
 
 class ShardStats:
@@ -796,7 +796,7 @@ class Compiler:
         col = seg.vector_dv.get(node.field)
         if col is None:
             return MATCH_NONE
-        q = np.asarray(list(node.vector), dtype=np.float32)
+        q = np.asarray(list(node.vector), dtype=np.float32)  # sync-ok: host -- query vector from the request body
         if q.shape != (ft.dims,):
             raise IllegalArgumentError(
                 f"query vector has dimension {q.shape[0]} but field "
@@ -951,7 +951,7 @@ class Compiler:
         key = ("slice", seg.uid, node.max)
         buckets = self.stats.memo.get(key)
         if buckets is None:
-            buckets = np.asarray(
+            buckets = np.asarray(  # sync-ok: host -- slice table from host doc ids
                 [hash_routing(d) % node.max if d is not None else -1
                  for d in seg.doc_ids], dtype=np.int32)
             self.stats.memo[key] = buckets
@@ -2025,15 +2025,15 @@ def phrase_eval(seg: Segment, stats: ShardStats, field: str, terms: List[str],
             return scores, matches
     per_term = [seg._positions_for(field, t) for t in terms]
     doc_list, freq_list = [], []
-    for doc in cand.tolist():
+    for doc in cand.tolist():  # sync-ok: host -- phrase candidates are a host numpy array (positions path)
         freq = _phrase_freq([per_term[i][doc] for i in range(len(terms))],
                             slop)
         if freq > 0:
             doc_list.append(doc)
             freq_list.append(freq)
     if doc_list:
-        score_docs(np.asarray(doc_list, np.int64),
-                   np.asarray(freq_list, np.float64))
+        score_docs(np.asarray(doc_list, np.int64),  # sync-ok: host -- host Python lists
+                   np.asarray(freq_list, np.float64))  # sync-ok: host -- host Python lists
     return scores, matches
 
 

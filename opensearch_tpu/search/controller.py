@@ -175,9 +175,10 @@ def _parse_deadline(body: dict) -> Optional[float]:
     if raw is None:
         return None
     from opensearch_tpu.common.settings import parse_time_value
+    from opensearch_tpu.common.errors import SettingsError
     try:
         timeout_s = parse_time_value(raw, "timeout")
-    except Exception:
+    except (SettingsError, TypeError, ValueError):
         raise IllegalArgumentError(
             f"failed to parse [timeout] with value [{raw!r}]")
     if timeout_s <= 0:
@@ -456,7 +457,7 @@ def execute_search(executors: List, body: Optional[dict],
                         if faults.ENABLED:
                             faults.fire("canmatch.shard")
                         flags.append(shard_can_match(ex, body))
-                    except Exception:
+                    except Exception:   # except-ok: canmatch isolation -- any failure class degrades to don't-skip, never a failed query
                         flags.append(True)
                 if flags and not any(flags):
                     flags[0] = True
@@ -496,7 +497,7 @@ def execute_search(executors: List, body: Optional[dict],
                                                 extra_filters, rows)
                 except TaskCancelledError:
                     raise
-                except Exception:
+                except Exception:   # except-ok: SPMD isolation -- any failure class degrades to the per-shard host loop
                     # the fused all-shard program failed as a unit:
                     # degrade to the per-shard host loop below, where
                     # failure isolation is per shard
@@ -553,7 +554,7 @@ def execute_search(executors: List, body: Optional[dict],
                     raise
                 _record_failure(shard_i, e)
                 continue
-            except Exception as e:
+            except Exception as e:  # except-ok: per-shard isolation -- failures land in _shards.failures[], not the request
                 # one shard's query fault costs that shard's slice of
                 # the response, not the request
                 _record_failure(shard_i, e)
@@ -666,7 +667,7 @@ def execute_search(executors: List, body: Optional[dict],
                     raise       # deterministic request defect: keep 4xx
                 _record_failure(c.shard_i, e)
                 continue
-            except Exception as e:
+            except Exception as e:  # except-ok: per-shard isolation -- a fetch fault drops the shard's page hits, siblings render
                 # a fetch fault fails the shard: its page hits drop as a
                 # unit; siblings' hits still render
                 _record_failure(c.shard_i, e)
@@ -726,7 +727,7 @@ def execute_search(executors: List, body: Optional[dict],
                 apply_pipelines(agg_nodes, aggregations)
             except OpenSearchTpuError:
                 raise               # already a clean typed error
-            except Exception as e:
+            except Exception as e:  # except-ok: wraps into typed SearchPhaseExecutionError -- never a raw 500
                 # coordinator-level reduce has no per-shard slice to
                 # degrade to — surface a clean typed error, never a
                 # corrupt/partial agg tree

@@ -404,7 +404,7 @@ def _cache_get_isolated(rc, key):
         return rc.REQUEST_CACHE.get(key)
     try:
         return retry.call_with_retry(op, label="request_cache.get")
-    except Exception:
+    except Exception:   # except-ok: cache-IO isolation -- any failure class degrades to a MISS, never a failed query
         return rc.REQUEST_CACHE._MISS
 
 
@@ -420,7 +420,7 @@ def _cache_put_isolated(rc, key, value) -> None:
         rc.REQUEST_CACHE.put(key, value)
     try:
         retry.call_with_retry(op, label="request_cache.put")
-    except Exception:
+    except Exception:   # except-ok: cache-IO isolation -- a failed put just drops the entry
         pass
 
 
@@ -465,7 +465,7 @@ def _run_item_isolated(responses, i: int, raise_item_errors: bool,
         if raise_item_errors:
             raise
         responses[i] = _item_error(e)
-    except Exception as e:
+    except Exception as e:  # except-ok: per-item isolation -- untyped failures render 500-class error items, never fail siblings
         if raise_item_errors:
             raise
         responses[i] = _item_error_untyped(e)
@@ -614,10 +614,10 @@ def stack_flat_inputs(flats: List[List[Dict[str, np.ndarray]]],
     axes: List[Optional[int]] = []
     for li in range(n_leaves):
         if with_const and names[li] in CONST_INPUT_KEYS:
-            stacked.append(np.asarray(per_query[0][li]))
+            stacked.append(np.asarray(per_query[0][li]))  # sync-ok: host -- flattened plan inputs are host arrays pre-upload
             axes.append(None)
             continue
-        arrs = [np.asarray(q[li]) for q in per_query]
+        arrs = [np.asarray(q[li]) for q in per_query]  # sync-ok: host -- flattened plan inputs are host arrays pre-upload
         a0 = arrs[0]
         shape = tuple(max(a.shape[d] for a in arrs)
                       for d in range(a0.ndim))
@@ -900,7 +900,7 @@ def _agg_envelope_runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta,
             plan, meta, agg_plans, arrays, example_flat, np.float32(0))
         fn = jax.jit(build_batched_agg_query_phase(
             plan, meta, k, layout, treedef, axes, agg_plans))
-        _JIT_CACHE[key] = (fn, out_layout, width)
+        _JIT_CACHE[key] = (fn, out_layout, width)  # shared-state-ok: benign double-jit race; dict slot write is GIL-atomic
         hit = (_timed_first_call(fn), out_layout, width)
     return hit
 
@@ -948,7 +948,7 @@ def _envelope_runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int,
         else:
             fn = jax.jit(build_batched_query_phase(plan, meta, k,
                                                    layout, treedef))
-        _JIT_CACHE[key] = fn
+        _JIT_CACHE[key] = fn  # shared-state-ok: benign double-jit race; dict slot write is GIL-atomic
         fn = _timed_first_call(fn)
     return fn
 
@@ -960,7 +960,7 @@ def _runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int, sort_mode: st
     if fn is not None:
         return fn
     fn = jax.jit(build_query_phase(plan, meta, k, sort_mode, agg_plans))
-    _JIT_CACHE[key] = fn
+    _JIT_CACHE[key] = fn  # shared-state-ok: benign double-jit race; dict slot write is GIL-atomic
     return _timed_first_call(fn)
 
 
@@ -1041,7 +1041,7 @@ def _batched_hybrid_runner(plans, meta: DeviceSegmentMeta, k: int,
     if fn is None:
         fn = jax.jit(build_batched_hybrid_query_phase(plans, meta, k,
                                                       layout, treedef))
-        _JIT_CACHE[key] = fn
+        _JIT_CACHE[key] = fn  # shared-state-ok: benign double-jit race; dict slot write is GIL-atomic
         fn = _timed_first_call(fn)
     return fn
 
@@ -1392,15 +1392,18 @@ class SearchExecutor:
             return jax.device_get([out for _, _, _, out in launched])
 
         t0c = time.monotonic() if scope is not None else 0.0
-        if rec:
-            try:
-                with trace.child("device_collect", segments=len(launched)):
-                    fetched = retry.call_with_retry(
-                        _collect, label="fetch.gather", trace=trace)
-            finally:
-                _THREAD_COMPILES.active = False
-        else:
-            fetched = retry.call_with_retry(_collect, label="fetch.gather")
+        with _LEDGER.attributed(scope):
+            if rec:
+                try:
+                    with trace.child("device_collect",
+                                     segments=len(launched)):
+                        fetched = retry.call_with_retry(
+                            _collect, label="fetch.gather", trace=trace)
+                finally:
+                    _THREAD_COMPILES.active = False
+            else:
+                fetched = retry.call_with_retry(_collect,
+                                                label="fetch.gather")
         if scope is not None:
             _ledger_unbatched_collect(scope, fetched,
                                       (time.monotonic() - t0c) * 1000)
@@ -1532,7 +1535,9 @@ class SearchExecutor:
                     faults.fire("fetch.gather")
                 return jax.device_get([out for _, _, out in launched])
             t0c = time.monotonic() if scope is not None else 0.0
-            fetched = retry.call_with_retry(_collect, label="fetch.gather")
+            with _LEDGER.attributed(scope):
+                fetched = retry.call_with_retry(_collect,
+                                                label="fetch.gather")
             if scope is not None:
                 _ledger_hybrid_rows(
                     scope, [(1, 1, k_seg, n_sub)
@@ -1726,7 +1731,7 @@ class SearchExecutor:
                 node: Any = dsl.parse_query(body.get("query"))
             except OpenSearchTpuError:
                 raise
-            except Exception:
+            except Exception:  # except-ok: per-item isolation -- the general path renders the proper error object for this item
                 # surface the error uniformly via the general path
                 responses[i] = self.search(body, _direct=True)
                 return
@@ -1794,7 +1799,7 @@ class SearchExecutor:
                     raise
                 responses[i] = _item_error(e)
                 continue
-            except Exception:
+            except Exception:  # except-ok: per-item isolation -- a malformed hybrid body fails through the general path's renderer, not siblings
                 # surface errors through the general path's renderer —
                 # per item, so a malformed hybrid body can't fail siblings
                 _run_item_isolated(responses, i, raise_item_errors,
@@ -1845,7 +1850,7 @@ class SearchExecutor:
                         return fn(arrays, jnp.asarray(buf))
                     out = retry.call_with_retry(_dispatch,
                                                 label="msearch.dispatch")
-                except Exception as e:
+                except Exception as e:  # except-ok: per-item isolation -- a failed hybrid group dispatch downgrades its items to error objects
                     if raise_item_errors:
                         raise
                     err = _item_error(e) \
@@ -1872,15 +1877,16 @@ class SearchExecutor:
                     [packed for _, _, _, _, packed in pending])
             t0c = time.monotonic() if scope is not None else 0.0
             try:
-                fetched = retry.call_with_retry(_collect,
-                                                label="fetch.gather")
+                with _LEDGER.attributed(scope):
+                    fetched = retry.call_with_retry(_collect,
+                                                    label="fetch.gather")
                 if scope is not None:
                     _ledger_hybrid_rows(
                         scope,
                         [(packed.shape[0], len(idxs), k_seg, n_sub)
                          for idxs, _s, k_seg, n_sub, packed in pending],
                         (time.monotonic() - t0c) * 1000)
-            except Exception as e:
+            except Exception as e:  # except-ok: per-item isolation -- any device-fault class downgrades the wave's items to error objects, never the envelope
                 if raise_item_errors:
                     raise
                 err = _item_error(e) if isinstance(e, OpenSearchTpuError) \
@@ -2024,7 +2030,7 @@ class SearchExecutor:
                     agg_json = (json.dumps(agg_spec, sort_keys=True,
                                            default=str) if agg_spec
                                 else None)
-                except Exception:
+                except Exception:  # except-ok: per-item isolation -- e.g. mixed-type agg keys; the general path owns the typed error
                     # e.g. mixed-type agg keys breaking sort_keys: the
                     # general path owns the proper error, per item
                     _general_fallback(i, body)
@@ -2040,7 +2046,7 @@ class SearchExecutor:
                         compiler, stats, tpl,
                         None if tpl is not None else node, body, agg_spec,
                         agg_json)
-                except Exception:
+                except Exception:  # except-ok: per-item isolation -- compile failure falls back to the general path per item
                     _general_fallback(i, body)
                     continue
                 if bkey is not None:
@@ -2149,7 +2155,7 @@ class SearchExecutor:
                         return fn(arrays, jnp.asarray(buf))
                     out = retry.call_with_retry(_dispatch,
                                                 label="msearch.dispatch")
-                except Exception as e:
+                except Exception as e:  # except-ok: per-item isolation -- a runtime device fault downgrades only this group's items
                     # a runtime device fault downgrades ONLY this group's
                     # items to per-item error objects (extending the
                     # malformed-item machinery to runtime faults) — the
@@ -2252,34 +2258,35 @@ class SearchExecutor:
             fetch_stats[1] = 1
             return out
 
-        try:
-            fetched = retry.call_with_retry(_fetch_all,
-                                            label="fetch.gather")
-        except Exception:
-            # the combined gather failed as a unit: fall back to one
-            # fetch per dispatched program, so a single bad program
-            # downgrades only ITS items to error objects
-            fetched = []
-            fetch_stats[0] = fetch_stats[1] = 0
-            for idxs, _seg_i, _k_seg, packed, _ol in pending:
-                def _one(packed=packed):
-                    if faults.ENABLED:
-                        faults.fire("fetch.gather")
-                    return np.asarray(jax.device_get(packed))
-                try:
-                    got = retry.call_with_retry(_one,
+        with _LEDGER.attributed(scope):
+            try:
+                fetched = retry.call_with_retry(_fetch_all,
                                                 label="fetch.gather")
-                    fetched.append(got)
-                    fetch_stats[0] += got.nbytes
-                    fetch_stats[1] += 1
-                except Exception as e:
-                    fetched.append(None)
-                    err = _item_error(e) \
-                        if isinstance(e, OpenSearchTpuError) \
-                        else _item_error_untyped(e)
-                    for i in idxs:
-                        responses[i] = dict(err)
-                        dead.add(i)
+            except Exception:   # except-ok: combined-gather isolation -- any failure class degrades to per-program fetches below
+                # the combined gather failed as a unit: fall back to one
+                # fetch per dispatched program, so a single bad program
+                # downgrades only ITS items to error objects
+                fetched = []
+                fetch_stats[0] = fetch_stats[1] = 0
+                for idxs, _seg_i, _k_seg, packed, _ol in pending:
+                    def _one(packed=packed):
+                        if faults.ENABLED:
+                            faults.fire("fetch.gather")
+                        return np.asarray(jax.device_get(packed))
+                    try:
+                        got = retry.call_with_retry(_one,
+                                                    label="fetch.gather")
+                        fetched.append(got)
+                        fetch_stats[0] += got.nbytes
+                        fetch_stats[1] += 1
+                    except Exception as e:  # except-ok: per-item isolation -- a bad program downgrades only ITS items to error objects
+                        fetched.append(None)
+                        err = _item_error(e) \
+                            if isinstance(e, OpenSearchTpuError) \
+                            else _item_error_untyped(e)
+                        for i in idxs:
+                            responses[i] = dict(err)
+                            dead.add(i)
         collect_s = time.monotonic() - _t
         ph["device_get"] += collect_s; _t = time.monotonic()
         _release_wave_buffers()

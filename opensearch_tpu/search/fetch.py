@@ -195,7 +195,7 @@ def _highlight_one(source, field, spec, hl_body, field_terms, mapper,
             try:
                 field_terms = collect_field_terms(dsl.parse_query(hq),
                                                   mapper)
-            except Exception:
+            except Exception:   # except-ok: highlighting is best-effort -- an unparseable highlight_query just yields no fragments
                 field_terms = {}
         terms = field_terms.get(field, [])
         if not terms:
@@ -353,7 +353,7 @@ def _eval_child_scores(plan, arrays):
         def run(seg, flat, _plan=plan):
             cursor = [0]
             return _eval_plan(_plan, seg, flat, cursor)
-        fn = _INNER_JIT[sig] = jax.jit(run)
+        fn = _INNER_JIT[sig] = jax.jit(run)  # shared-state-ok: benign double-jit race; dict slot write is GIL-atomic
     host_flat = plan.flatten_inputs([])
     ledger = TELEMETRY.ledger
     # scope: the request's LedgerScope, bound ambiently by the
@@ -368,8 +368,15 @@ def _eval_child_scores(plan, arrays):
                       scope=scope)
     flat = jax.tree_util.tree_map(jnp.asarray, host_flat)
     t0 = time.monotonic() if accounting else 0.0
-    scores, matches = jax.device_get(fn(arrays, flat))
-    scores, matches = np.asarray(scores), np.asarray(matches)
+    # self-attributing region: the single-node controller binds ambient
+    # around its fetch phase, but the cluster-distributed fetch
+    # (cluster/service.py _on_shard_fetch) reaches here without it — the
+    # sync site owns its own attribution marker so every caller is
+    # covered (the sanitizer caught exactly this gap on the transport
+    # path)
+    with ledger.attributed(scope):
+        scores, matches = jax.device_get(fn(arrays, flat))
+        scores, matches = np.asarray(scores), np.asarray(matches)
     if accounting:
         # the fetch phase's one device gather (dense child scores/masks
         # for inner_hits) — the `docvalues` channel of the ledger

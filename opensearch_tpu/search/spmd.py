@@ -30,6 +30,7 @@ or multi-key sorts).
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -41,12 +42,21 @@ from opensearch_tpu.search.aggs.engine import compile_aggs
 from opensearch_tpu.search.aggs.parse import PIPELINE_TYPES, parse_aggs
 from opensearch_tpu.search.aggs.reduce import decode_outputs
 from opensearch_tpu.search.compile import Compiler
+from opensearch_tpu.telemetry import TELEMETRY
 
 # serving-path counters, asserted by tests (VERDICT round-3 next-step 2):
-# queries answered by the SPMD program / HbmShardSet rebuilds
-SPMD_QUERIES = [0]
-SPMD_UPLOADS = [0]
+# queries answered by the SPMD program / HbmShardSet rebuilds.
+# Registry-owned metrics Counters (visible in `_nodes/stats` under
+# telemetry.counters, GIL-atomic inc) — replaced the module-level
+# mutable-list counters shared-state-lint flags, the first fix the
+# item-2 async-scheduler thread-safety audit demanded.
+SPMD_QUERIES = TELEMETRY.metrics.counter("search.spmd_queries")
+SPMD_UPLOADS = TELEMETRY.metrics.counter("search.spmd_uploads")
 
+# guards the searcher/residency caches below: queries mutate them at
+# miss/evict/LRU-touch time, and the item-2 wave scheduler will run
+# those paths from concurrent request threads
+_SPMD_LOCK = threading.Lock()
 _SEARCHERS: Dict[int, Any] = {}       # mesh size -> DistributedSearcher
 _SHARD_SETS: Dict[Any, Any] = {}      # residency cache (bounded)
 _MAX_SHARD_SETS = 4
@@ -59,10 +69,11 @@ def _searcher(n_rows: int):
     from opensearch_tpu.parallel.distributed import (DistributedSearcher,
                                                      make_mesh)
     n = min(n_rows, len(jax.devices()))
-    s = _SEARCHERS.get(n)
-    if s is None:
-        s = DistributedSearcher(make_mesh(n))
-        _SEARCHERS[n] = s
+    with _SPMD_LOCK:
+        s = _SEARCHERS.get(n)
+        if s is None:
+            s = DistributedSearcher(make_mesh(n))
+            _SEARCHERS[n] = s
     return s
 
 
@@ -214,8 +225,7 @@ def spmd_query_phase(executors: List, body: dict, k: int,
     out = _spmd_query_phase_raw(executors, body, k, extra_filters, rows)
     if out is None:
         return None     # host-loop fallback — never cached
-    from opensearch_tpu.telemetry import TELEMETRY
-    TELEMETRY.metrics.counter("search.spmd_queries").inc()
+    SPMD_QUERIES.inc()
     if key is not None:
         REQUEST_CACHE.put(key, out)
     cts, decoded, total = out
@@ -296,7 +306,6 @@ def _spmd_query_phase_raw(executors: List, body: dict, k: int,
         # e.g. a cross-index search whose rows have mismatched field
         # layouts (canonical_meta rejects them) — host loop handles it
         return None
-    SPMD_QUERIES[0] += 1
 
     cand_tuples = []
     for score, row_i, ord_ in zip(scores, row_idx, ords):
@@ -332,17 +341,21 @@ def _resident_shard_set(searcher, executors, rows):
            tuple((executors[s].reader.segments[g].uid,
                   executors[s].reader.segments[g].live_doc_count)
                  for s, g in rows))
-    cached = _SHARD_SETS.get(key)
-    if cached is not None:
-        # LRU touch: FIFO eviction would evict the set most likely to be
-        # reused when >_MAX_SHARD_SETS indices are queried round-robin
-        _SHARD_SETS.pop(key)
-        _SHARD_SETS[key] = cached
-        return cached
+    with _SPMD_LOCK:
+        cached = _SHARD_SETS.get(key)
+        if cached is not None:
+            # LRU touch: FIFO eviction would evict the set most likely
+            # to be reused when >_MAX_SHARD_SETS indices are queried
+            # round-robin
+            _SHARD_SETS.pop(key)
+            _SHARD_SETS[key] = cached
+            return cached
     from opensearch_tpu.ops.device_segment import upload_segment
     # build the stacked image from HOST arrays (to_device=False): stacking
     # the readers' per-device images would first FETCH every column back
-    # from the device — a full index download per rebuild
+    # from the device — a full index download per rebuild. Built OUTSIDE
+    # the lock: a racing builder costs one duplicate upload (last insert
+    # wins), never a convoy of queries behind a segment upload.
     arrays, metas = [], []
     for s, g in rows:
         a, m = upload_segment(executors[s].reader.segments[g],
@@ -351,8 +364,9 @@ def _resident_shard_set(searcher, executors, rows):
         arrays.append(a)
         metas.append(m)
     shard_set = searcher.build_shard_set(arrays, metas)
-    SPMD_UPLOADS[0] += 1
-    if len(_SHARD_SETS) >= _MAX_SHARD_SETS:
-        _SHARD_SETS.pop(next(iter(_SHARD_SETS)))
-    _SHARD_SETS[key] = shard_set
+    SPMD_UPLOADS.inc()
+    with _SPMD_LOCK:
+        if len(_SHARD_SETS) >= _MAX_SHARD_SETS:
+            _SHARD_SETS.pop(next(iter(_SHARD_SETS)))
+        _SHARD_SETS[key] = shard_set
     return shard_set

@@ -117,7 +117,7 @@ class WarmupRegistry:
                 ("jax_persistent_cache_min_entry_size_bytes", -1)):
             try:
                 jax.config.update(name, value)
-            except Exception:
+            except Exception:   # except-ok: jax-version compatibility -- absent config names on older jax are skipped
                 pass
         self.stats_["compile_cache_dir"] = cache_dir
 
@@ -269,7 +269,7 @@ class WarmupRegistry:
                     from opensearch_tpu.common import retry as _retry
                     _retry.call_with_retry(_replay, label="warmup.replay")
                     warmed += 1
-                except Exception:
+                except Exception:   # except-ok: replay isolation -- a permanently failing entry costs only itself, never index-open
                     errors += 1
         finally:
             self._recording = True

@@ -277,7 +277,7 @@ def execute_hybrid_search(executors: List, body: dict,
                                               ledger_scope=ledger_scope))
         except TaskCancelledError:
             raise
-        except Exception as e:
+        except Exception as e:  # except-ok: per-shard isolation -- 5xx-class faults land in _shards.failures[], 4xx re-raises below
             from opensearch_tpu.common.errors import OpenSearchTpuError
             if isinstance(e, OpenSearchTpuError) and e.status < 500:
                 # deterministic request defect (parse/validation): every

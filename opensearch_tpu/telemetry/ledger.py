@@ -179,13 +179,16 @@ class TransferLedger:
     def tagged(self, tag: str):
         """Prefix this thread's channel names (warmup replays record as
         `warmup.upload.literals` etc. so replay traffic never pollutes
-        the serving channels)."""
+        the serving channels). A tagged region is attribution-marked:
+        replay syncs are ledger-owned by construction."""
         prev = getattr(self._tls, "tag", None)
         self._tls.tag = tag if prev is None else f"{prev}.{tag}"
+        self._tls.attr_depth = getattr(self._tls, "attr_depth", 0) + 1
         try:
             yield
         finally:
             self._tls.tag = prev
+            self._tls.attr_depth -= 1
 
     @contextmanager
     def ambient(self, scope: Optional[LedgerScope]):
@@ -195,10 +198,37 @@ class TransferLedger:
         keep passing scopes explicitly (B requests share one thread)."""
         prev = getattr(self._tls, "scope", None)
         self._tls.scope = scope
+        self._tls.attr_depth = getattr(self._tls, "attr_depth", 0) + 1
         try:
             yield
         finally:
             self._tls.scope = prev
+            self._tls.attr_depth -= 1
+
+    @contextmanager
+    def attributed(self, scope: Optional[LedgerScope] = None):
+        """Mark this thread as inside a ledger-attributed region — the
+        contract the sync sanitizer (common/sanitize.py) enforces: every
+        query-path `device_get` must execute under one of `attributed`/
+        `ambient`/`tagged`, i.e. inside code whose transfers the ledger
+        can explain. Unlike `ambient`, a None scope does NOT unbind an
+        outer ambient scope (the region is attributed even when this
+        request's accounting gate returned None)."""
+        tls = self._tls
+        prev = getattr(tls, "scope", None)
+        if scope is not None:
+            tls.scope = scope
+        tls.attr_depth = getattr(tls, "attr_depth", 0) + 1
+        try:
+            yield
+        finally:
+            tls.scope = prev
+            tls.attr_depth -= 1
+
+    def attribution_depth(self) -> int:
+        """How many attributed regions are active on this thread (0 =
+        a sync here is unattributed — the sanitizer's trip condition)."""
+        return getattr(self._tls, "attr_depth", 0)
 
     def current(self) -> Optional[LedgerScope]:
         """The thread's ambient per-request scope, if a phase bound one."""
@@ -297,7 +327,7 @@ class DeviceMemoryAccounting:
         for name, fn in providers:
             try:
                 classes[name] = dict(fn())
-            except Exception:
+            except Exception:   # except-ok: third-party provider callables; a stats poll must never 500 the node
                 classes[name] = {"error": "provider failed"}
         return {"classes": classes, "hbm": _hbm_stats()}
 
@@ -328,5 +358,5 @@ def _hbm_stats() -> Optional[dict]:
             return None
         return {k: v for k, v in stats.items()
                 if isinstance(v, (int, float))}
-    except Exception:
+    except Exception:   # except-ok: backend memory_stats is best-effort across jax versions; stats must degrade to None
         return None

@@ -67,3 +67,23 @@ def pytest_runtest_makereport(item, call):
             ("randomized seed",
              f"reproduce with: TEST_SEED={seed} python -m pytest "
              f"{item.nodeid}"))
+
+
+# ------------------------------------------------------- host-sync sanitizer
+#
+# ISSUE 8: the runtime counterpart of tools/lint's sync-lint. Enabled for
+# the WHOLE tier-1 run: any jax.device_get executed from inside the
+# opensearch_tpu package while no ledger-attributed region is active on
+# the calling thread raises UnattributedSyncError — a new unattributed
+# sync on the query path fails the suite the moment it runs, instead of
+# surfacing as an unexplained gap in a later profile review. Calls from
+# test/tool frames are exempt (the contract binds serving code).
+
+@pytest.fixture(scope="session", autouse=True)
+def _sync_sanitizer():
+    from opensearch_tpu.common.sanitize import SANITIZER
+    SANITIZER.install()
+    SANITIZER.enabled = True
+    yield
+    SANITIZER.enabled = False
+    SANITIZER.uninstall()

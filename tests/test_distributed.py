@@ -252,10 +252,10 @@ class TestSpmdServingPath:
     def test_rest_search_executes_spmd_program(self, node):
         from opensearch_tpu.search import spmd
 
-        before = spmd.SPMD_QUERIES[0]
+        before = spmd.SPMD_QUERIES.value
         out = node.request("POST", "/sp/_search", {
             "query": {"match": {"body": "w00011 w00042"}}, "size": 10})
-        assert spmd.SPMD_QUERIES[0] == before + 1
+        assert spmd.SPMD_QUERIES.value == before + 1
         assert out["hits"]["total"]["value"] > 0
 
     def test_residency_across_queries(self, node):
@@ -264,11 +264,11 @@ class TestSpmdServingPath:
 
         body = {"query": {"match": {"body": "w00007"}}, "size": 5}
         node.request("POST", "/sp/_search", body)   # builds the shard set
-        uploads = spmd.SPMD_UPLOADS[0]
+        uploads = spmd.SPMD_UPLOADS.value
         tb0 = TRANSFER_BYTES[0]
         for _ in range(3):
             node.request("POST", "/sp/_search", body)
-        assert spmd.SPMD_UPLOADS[0] == uploads, "shard set rebuilt per query"
+        assert spmd.SPMD_UPLOADS.value == uploads, "shard set rebuilt per query"
         per_query = (TRANSFER_BYTES[0] - tb0) / 3
         assert per_query < 1 << 16, \
             f"per-query transfer {per_query} B suggests segment re-upload"
@@ -279,9 +279,9 @@ class TestSpmdServingPath:
         body = {"size": 0, "query": {"match_all": {}},
                 "aggs": {"tags": {"terms": {"field": "tag", "size": 20}},
                          "v": {"avg": {"field": "views"}}}}
-        before = spmd.SPMD_QUERIES[0]
+        before = spmd.SPMD_QUERIES.value
         got = node.request("POST", "/sp/_search", body)
-        assert spmd.SPMD_QUERIES[0] == before + 1
+        assert spmd.SPMD_QUERIES.value == before + 1
         # host loop ground truth: force fallback by monkeypatching
         import opensearch_tpu.search.spmd as spmd_mod
         orig = spmd_mod.eligible
@@ -353,9 +353,9 @@ class TestSpmdPackingAndFieldSort:
 
         assert len(jax.devices()) == 8
         body = {"query": {"match": {"body": "w00004 w00019"}}, "size": 15}
-        before = spmd.SPMD_QUERIES[0]
+        before = spmd.SPMD_QUERIES.value
         got = node16.request("POST", "/pk/_search", body)
-        assert spmd.SPMD_QUERIES[0] == before + 1, \
+        assert spmd.SPMD_QUERIES.value == before + 1, \
             "16 rows on an 8-device mesh fell back to the host loop"
         want = self._host_loop(node16, body)
         assert got["hits"]["total"] == want["hits"]["total"]
@@ -370,9 +370,9 @@ class TestSpmdPackingAndFieldSort:
         body = {"size": 0, "query": {"match_all": {}},
                 "aggs": {"tags": {"terms": {"field": "tag", "size": 20}},
                          "v": {"avg": {"field": "views"}}}}
-        before = spmd.SPMD_QUERIES[0]
+        before = spmd.SPMD_QUERIES.value
         got = node16.request("POST", "/pk/_search", body)
-        assert spmd.SPMD_QUERIES[0] == before + 1
+        assert spmd.SPMD_QUERIES.value == before + 1
         want = self._host_loop(node16, body)
         assert got["aggregations"] == want["aggregations"]
         assert got["hits"]["total"] == want["hits"]["total"]
@@ -383,9 +383,9 @@ class TestSpmdPackingAndFieldSort:
         for order in ("desc", "asc"):
             body = {"query": {"match_all": {}}, "size": 20,
                     "sort": [{"views": {"order": order}}]}
-            before = spmd.SPMD_QUERIES[0]
+            before = spmd.SPMD_QUERIES.value
             got = node16.request("POST", "/pk/_search", body)
-            assert spmd.SPMD_QUERIES[0] == before + 1, \
+            assert spmd.SPMD_QUERIES.value == before + 1, \
                 f"field sort ({order}) fell back to the host loop"
             want = self._host_loop(node16, body)
             assert got["hits"]["total"] == want["hits"]["total"]
@@ -397,9 +397,9 @@ class TestSpmdPackingAndFieldSort:
 
         body = {"query": {"match_all": {}}, "size": 5,
                 "sort": [{"tag": {"order": "asc"}}]}
-        before = spmd.SPMD_QUERIES[0]
+        before = spmd.SPMD_QUERIES.value
         out = node16.request("POST", "/pk/_search", body)
-        assert spmd.SPMD_QUERIES[0] == before, \
+        assert spmd.SPMD_QUERIES.value == before, \
             "keyword sorts must take the host sort-key path"
         assert out["hits"]["hits"]
 
@@ -436,9 +436,9 @@ def test_spmd_parity_100k_docs(eight_devices):
     for q in queries:
         body = {"query": {"match": {"body": q}}, "size": 25,
                 "aggs": {"tags": {"terms": {"field": "tag"}}}}
-        before = spmd.SPMD_QUERIES[0]
+        before = spmd.SPMD_QUERIES.value
         got = node.request("POST", "/big/_search", body)
-        assert spmd.SPMD_QUERIES[0] == before + 1, "SPMD path not taken"
+        assert spmd.SPMD_QUERIES.value == before + 1, "SPMD path not taken"
         orig = spmd_mod.eligible
         try:
             spmd_mod.eligible = lambda *a, **k: False

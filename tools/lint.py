@@ -1,0 +1,19 @@
+#!/usr/bin/env python3
+"""CLI shim for the lint suite — the implementation lives in the
+`tools/lint/` package (which shadows this module on the import path; this
+file only exists so `python tools/lint.py` works from a checkout).
+
+Exit code is the OR of failing rules' bits:
+    1  sync-lint         2  retrace-lint      4  gate-lint
+    8  shared-state-lint 16 except-breadth
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from lint.runner import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,249 @@
+"""Shared infrastructure for the lint suite: file model, annotation
+grammar, AST helpers.
+
+Annotation grammar (one per comment, anywhere on the flagged line or any
+line of a multi-line statement):
+
+    # sync-ok: <channel>[ -- reason]       discharge a sync-lint finding;
+                                           <channel> names the ledger
+                                           channel the bytes belong to
+                                           (`host` = provably host-only
+                                           conversion, no device sync)
+    # except-ok: <reason>                  discharge an exception-breadth
+                                           finding (reason required)
+    # retrace-ok: <reason>                 discharge a retrace-lint finding
+    # shared-state-ok: <reason>            discharge a shared-state-lint
+                                           finding (on the mutation line or
+                                           on the module-level definition)
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# rule id -> exit-code bit (tools/lint.py ORs the bits of failing rules)
+RULE_BITS = {
+    "sync-lint": 1,
+    "retrace-lint": 2,
+    "gate-lint": 4,
+    "shared-state-lint": 8,
+    "except-breadth": 16,
+}
+
+# ledger channel token: lowercase dotted names, e.g. `topk_ids`,
+# `upload.literals`, `warmup.docvalues`, or the reserved `host`
+CHANNEL_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)*$")
+
+ANNOTATION_RE = re.compile(
+    r"#\s*(sync-ok|except-ok|retrace-ok|shared-state-ok)\s*:\s*(.*)")
+
+# The serving query path: the files whose sync sites, exception breadth
+# and shared mutable state the item-1/item-2 rewrites will churn. This
+# list is the lint suite's source of truth (README "Static analysis").
+QUERY_PATH_FILES = (
+    "opensearch_tpu/search/executor.py",
+    "opensearch_tpu/search/fetch.py",
+    "opensearch_tpu/search/controller.py",
+    "opensearch_tpu/search/canmatch.py",
+    "opensearch_tpu/search/spmd.py",
+    "opensearch_tpu/search/warmup.py",
+    "opensearch_tpu/search/compile.py",
+    "opensearch_tpu/search/plan_eval.py",
+    "opensearch_tpu/search/aggs/engine.py",
+    "opensearch_tpu/search/aggs/reduce.py",
+    "opensearch_tpu/search/aggs/pipeline.py",
+    "opensearch_tpu/indices/query_cache.py",
+    "opensearch_tpu/indices/request_cache.py",
+    "opensearch_tpu/parallel/distributed.py",
+    "opensearch_tpu/searchpipeline/hybrid.py",
+    "opensearch_tpu/telemetry/ledger.py",
+    "opensearch_tpu/rest/actions.py",
+)
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str           # repo-relative
+    line: int
+    message: str
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Annotation:
+    kind: str
+    value: str          # channel for sync-ok, free-text reason otherwise
+    line: int
+
+    @property
+    def channel(self) -> Optional[str]:
+        """The channel token of a sync-ok annotation (first word; the
+        rest is free-text reason), or None when malformed."""
+        tok = self.value.split()[0] if self.value.split() else ""
+        return tok if CHANNEL_RE.match(tok) else None
+
+
+class SourceFile:
+    """One parsed file: AST with parent links + per-line annotations."""
+
+    def __init__(self, abspath: str, rel: str):
+        self.abspath = abspath
+        self.rel = rel
+        with open(abspath, "r", encoding="utf-8") as f:
+            self.text = f.read()
+        self.tree = ast.parse(self.text, filename=rel)
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._lint_parent = node  # type: ignore[attr-defined]
+        self.annotations: Dict[int, List[Annotation]] = {}
+        self._scan_comments()
+
+    def _scan_comments(self) -> None:
+        try:
+            toks = tokenize.generate_tokens(
+                io.StringIO(self.text).readline)
+            for tok in toks:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = ANNOTATION_RE.search(tok.string)
+                if m:
+                    line = tok.start[0]
+                    self.annotations.setdefault(line, []).append(
+                        Annotation(m.group(1), m.group(2).strip(), line))
+        except tokenize.TokenError:
+            pass
+
+    # ------------------------------------------------------------- helpers
+
+    def annotation_for(self, node: ast.AST, kind: str
+                       ) -> Optional[Annotation]:
+        """An annotation of `kind` on any line the node spans (so the
+        comment can sit on whichever physical line of a wrapped call
+        has room)."""
+        start = getattr(node, "lineno", 0)
+        end = getattr(node, "end_lineno", start) or start
+        for line in range(start, end + 1):
+            for a in self.annotations.get(line, ()):
+                if a.kind == kind:
+                    return a
+        return None
+
+    def enclosing_functions(self, node: ast.AST) -> List[ast.AST]:
+        """Enclosing def/lambda chain, innermost first."""
+        out = []
+        cur = getattr(node, "_lint_parent", None)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                out.append(cur)
+            cur = getattr(cur, "_lint_parent", None)
+        return out
+
+    def ancestors(self, node: ast.AST) -> List[ast.AST]:
+        out = []
+        cur = getattr(node, "_lint_parent", None)
+        while cur is not None:
+            out.append(cur)
+            cur = getattr(cur, "_lint_parent", None)
+        return out
+
+
+def repo_root(start: Optional[str] = None) -> str:
+    """Walk up from `start` (default: this file) to the directory holding
+    the opensearch_tpu package."""
+    d = os.path.abspath(start or os.path.dirname(__file__))
+    while True:
+        if os.path.isdir(os.path.join(d, "opensearch_tpu")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            raise RuntimeError("repo root (opensearch_tpu/) not found")
+        d = parent
+
+
+def load_files(root: str, rels) -> List[SourceFile]:
+    out = []
+    for rel in rels:
+        p = os.path.join(root, rel)
+        if os.path.exists(p):
+            out.append(SourceFile(p, rel))
+    return out
+
+
+def package_files(root: str) -> List[str]:
+    """Every .py file under opensearch_tpu/, repo-relative."""
+    out = []
+    pkg = os.path.join(root, "opensearch_tpu")
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(os.path.relpath(os.path.join(dirpath, fn),
+                                           root))
+    return out
+
+
+def func_params(fn) -> List[str]:
+    """All parameter names of a def/lambda."""
+    a = fn.args
+    names = [p.arg for p in getattr(a, "posonlyargs", ())]
+    names += [p.arg for p in a.args]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    names += [p.arg for p in a.kwonlyargs]
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def name_of(node: ast.AST) -> str:
+    """Dotted-ish source name of an expression, best effort."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return f"{name_of(node.value)}.{node.attr}"
+    if isinstance(node, ast.Call):
+        return name_of(node.func)
+    return ""
+
+
+MUTABLE_CTORS = {"list", "dict", "set", "deque", "OrderedDict",
+                 "defaultdict", "Counter"}
+
+
+def module_mutable_globals(tree: ast.Module) -> Dict[str, int]:
+    """Module-level names bound to mutable containers -> def line."""
+    out: Dict[str, int] = {}
+    for stmt in tree.body:
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        if value is None:
+            continue
+        mutable = isinstance(value, (ast.List, ast.Dict, ast.Set,
+                                     ast.ListComp, ast.DictComp,
+                                     ast.SetComp))
+        if isinstance(value, ast.Call):
+            callee = name_of(value.func).split(".")[-1]
+            mutable = callee in MUTABLE_CTORS
+        if not mutable:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = stmt.lineno
+    return out

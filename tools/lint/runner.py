@@ -1,0 +1,93 @@
+"""Lint runner: runs every checker, renders findings, exits with the OR
+of the failing rules' bits (core.RULE_BITS) so CI can tell WHICH
+discipline broke from the exit code alone. `--json` emits a
+machine-readable report for tooling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+from . import gate_lint, retrace_lint, shared_state_lint, sync_lint
+from .core import RULE_BITS, Violation, repo_root
+
+# checker entry points; sync_lint owns two rule ids (sync-lint +
+# except-breadth share one walker)
+CHECKERS = (
+    ("sync-lint / except-breadth", sync_lint.run),
+    ("retrace-lint", retrace_lint.run),
+    ("gate-lint", gate_lint.run),
+    ("shared-state-lint", shared_state_lint.run),
+)
+
+
+def run_all(root: Optional[str] = None,
+            rules: Optional[List[str]] = None) -> List[Violation]:
+    root = root or repo_root()
+    out: List[Violation] = []
+    for _label, fn in CHECKERS:
+        out.extend(fn(root))
+    if rules:
+        out = [v for v in out if v.rule in rules]
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out
+
+
+def exit_code(violations: List[Violation]) -> int:
+    code = 0
+    for v in violations:
+        code |= RULE_BITS.get(v.rule, 32)
+    return code
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tools/lint.py",
+        description="Hot-path discipline linter (sync/retrace/gate/"
+                    "shared-state + exception breadth)")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: auto-detected)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable report on stdout")
+    p.add_argument("--rule", action="append", default=None,
+                   choices=sorted(RULE_BITS),
+                   help="run/report only this rule id (repeatable)")
+    args = p.parse_args(argv)
+
+    root = args.root or repo_root()
+    violations = run_all(root, args.rule)
+    code = exit_code(violations)
+
+    if args.as_json:
+        by_rule: Dict[str, int] = {}
+        for v in violations:
+            by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
+        print(json.dumps({
+            "root": root,
+            "violations": [v.to_dict() for v in violations],
+            "counts": by_rule,
+            "exit_code": code,
+            "rule_bits": RULE_BITS,
+        }, indent=2))
+        return code
+
+    if not violations:
+        print("lint: clean (sync-lint, except-breadth, retrace-lint, "
+              "gate-lint, shared-state-lint)")
+        return 0
+    for v in violations:
+        print(str(v))
+    counts: Dict[str, int] = {}
+    for v in violations:
+        counts[v.rule] = counts.get(v.rule, 0) + 1
+    summary = ", ".join(f"{r}: {n}" for r, n in sorted(counts.items()))
+    print(f"\nlint: {len(violations)} violation(s) ({summary}); "
+          f"exit code {code}")
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+"""shared-state-lint: module-level mutable state mutated on the query
+path must be lock-guarded or annotated — the thread-safety audit the
+ROADMAP item-2 async wave scheduler needs before concurrent requests
+share these modules.
+
+The checker collects module-level names bound to mutable containers
+(list/dict/set literals and constructors) in the query-path files, then
+flags any mutation of those names inside a function body:
+
+  - subscript/augmented assignment (`X[k] = v`, `X[0] += 1`),
+  - mutating method calls (`X.append(...)`, `X.pop(...)`, ...),
+  - rebinding via `global X`.
+
+A mutation is discharged when it happens lexically under a `with` whose
+context expression names a lock (`with _LOCK:`, `with self._lock:`), or
+when annotated `# shared-state-ok: <reason>` — on the mutation line or
+once on the module-level definition line (which blesses every mutation
+of that name; use for GIL-atomic test counters). Registry-owned state
+(metrics Counters, the warmup registry) is held behind objects with
+their own locks and is not module-level mutable state, so it never
+trips this rule — that is the pattern to migrate to.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import (QUERY_PATH_FILES, SourceFile, Violation, load_files,
+                   module_mutable_globals, name_of)
+
+RULE = "shared-state-lint"
+
+MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
+            "pop", "popitem", "clear", "remove", "discard",
+            "move_to_end", "appendleft", "popleft"}
+
+
+def _lock_guarded(sf: SourceFile, node: ast.AST) -> bool:
+    for anc in sf.ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                name = name_of(item.context_expr).lower()
+                if "lock" in name:
+                    return True
+    return False
+
+
+def _bound_locally(sf: SourceFile, node: ast.AST, name: str) -> bool:
+    """Shadowed: the name is a parameter or assigned (non-global) inside
+    an enclosing function."""
+    for fn in sf.enclosing_functions(node):
+        if isinstance(fn, ast.Lambda):
+            continue
+        from .core import func_params
+        if name in func_params(fn):
+            return True
+        for n in ast.walk(fn):
+            if isinstance(n, (ast.Assign, ast.AnnAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) \
+                    else [n.target]
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        return True
+    return False
+
+
+def check_file(sf: SourceFile) -> List[Violation]:
+    out: List[Violation] = []
+    globals_ = module_mutable_globals(sf.tree)
+    if not globals_:
+        return out
+    blessed = {name for name, line in globals_.items()
+               if any(a.kind == "shared-state-ok"
+                      for a in sf.annotations.get(line, ()))}
+
+    def _flag(node, name, how):
+        if name in blessed:
+            return
+        if sf.annotation_for(node, "shared-state-ok") is not None:
+            return
+        if _lock_guarded(sf, node):
+            return
+        if _bound_locally(sf, node, name):
+            return
+        out.append(Violation(
+            RULE, sf.rel, node.lineno,
+            f"unguarded mutation of module-level mutable [{name}] "
+            f"({how}) on the query path: guard with a lock, move it "
+            f"into a registry-owned structure (metrics counter), or "
+            f"annotate `# shared-state-ok: <reason>`"))
+
+    for node in ast.walk(sf.tree):
+        if not sf.enclosing_functions(node):
+            continue        # module-level init-time mutation is fine
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in globals_:
+                    _flag(node, t.value.id, "subscript assignment")
+                elif isinstance(t, ast.Name) and t.id in globals_:
+                    # plain rebinding only counts with a `global` decl
+                    fn = sf.enclosing_functions(node)[0]
+                    has_global = any(
+                        isinstance(n, ast.Global) and t.id in n.names
+                        for n in ast.walk(fn))
+                    if has_global:
+                        _flag(node, t.id, "global rebind")
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in MUTATORS and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in globals_:
+            _flag(node, node.func.value.id,
+                  f".{node.func.attr}() call")
+    return out
+
+
+def run(root: str) -> List[Violation]:
+    out: List[Violation] = []
+    for sf in load_files(root, QUERY_PATH_FILES):
+        out.extend(check_file(sf))
+    return out
