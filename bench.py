@@ -101,6 +101,24 @@ TELEMETRY_ON = "--telemetry" in sys.argv
 # module attribute load — nothing else runs.
 FAULTS_ON = "--faults" in sys.argv
 
+# --waves N: force the msearch wave count (the overlapped multi-wave
+# pipeline, ROADMAP item 1) for every envelope this run dispatches —
+# executor._effective_waves' platform-aware policy decides otherwise.
+# With --telemetry the run ASSERTS the ledger saw exactly N waves per
+# timed batch, so "the pipeline ran" is checked, not assumed.
+WAVES_ARG = None
+if "--waves" in sys.argv:
+    WAVES_ARG = int(sys.argv[sys.argv.index("--waves") + 1])
+
+# --ab-overlap: interleaved same-session A/B of W=1 vs W=N (N from
+# --waves, default 4) on the warm bm25 batch — alternating runs cancel
+# the box drift that makes cross-session absolutes incomparable
+# (PROFILE.md round-8 lesson). The two arms land in BENCH_AB_W1.json /
+# BENCH_AB_WN.json and tools/bench_compare.py gates the W=N arm against
+# W=1; its exit code and the measured per-batch overlap_ms ride the
+# output line as `overlap_ab`.
+AB_OVERLAP = "--ab-overlap" in sys.argv
+
 # --sanitize: install + enable the host-sync sanitizer
 # (common/sanitize.py) for the measured run — every query-path
 # device_get must execute inside a ledger-attributed region or the run
@@ -253,6 +271,71 @@ def _ledger_warm_stats(runs: int, n_queries: int, warm_wall_s: float):
     return {"bytes_fetched_per_query": round(d2h / max(runs * n_queries, 1),
                                              1),
             "ledger_overhead_pct": round(pct, 4)}
+
+
+def _ab_overlap(executor, bodies, reps: int):
+    """Interleaved W=1 vs W=N A/B on the warm batch (same session, same
+    executor, alternating runs). Returns the `overlap_ab` record and
+    writes the two arms as bench records for tools/bench_compare.py,
+    whose warm-p50 regression gate runs in-process (stdout captured —
+    the one-JSON-line contract holds)."""
+    import contextlib
+    import io
+
+    from opensearch_tpu.telemetry import TELEMETRY
+
+    n = WAVES_ARG or 4
+    w1_ms, wn_ms = [], []
+    if TELEMETRY_ON:
+        TELEMETRY.ledger.reset()
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        executor.multi_search(bodies, waves=1)
+        w1_ms.append((time.perf_counter() - t0) * 1000)
+        t0 = time.perf_counter()
+        executor.multi_search(bodies, waves=n)
+        wn_ms.append((time.perf_counter() - t0) * 1000)
+    rec = {"waves": n,
+           "w1_warm_p50_ms": round(sorted(w1_ms)[reps // 2], 2),
+           "wn_warm_p50_ms": round(sorted(wn_ms)[reps // 2], 2)}
+    rec["speedup"] = round(rec["w1_warm_p50_ms"]
+                           / max(rec["wn_warm_p50_ms"], 1e-9), 3)
+    if TELEMETRY_ON:
+        import opensearch_tpu.search.executor as executor_mod
+        snap = TELEMETRY.ledger.snapshot()
+        per_batch_waves = len(executor_mod._wave_sizes(len(bodies), n))
+        want = reps * (1 + per_batch_waves)
+        assert snap["waves"] == want, \
+            f"ledger saw {snap['waves']} waves, expected {want} " \
+            f"(reps={reps}, W={n})"
+        pipe = snap["pipeline"]
+        assert pipe["overlap_events"] == reps * (per_batch_waves - 1), \
+            f"overlap events {pipe['overlap_events']} != " \
+            f"{reps * (per_batch_waves - 1)}"
+        assert pipe["overlap_ms"] > 0, \
+            "pipelined run measured zero dispatch/collect overlap"
+        rec["overlap_ms_per_batch"] = round(
+            pipe["overlap_ms"] / reps, 2)
+    # bench_compare gate: the W=N arm must not regress warm p50 vs W=1
+    here = os.path.dirname(os.path.abspath(__file__))
+    f1 = os.path.join(here, "BENCH_AB_W1.json")
+    fn = os.path.join(here, "BENCH_AB_WN.json")
+    with open(f1, "w") as f:
+        f.write(json.dumps({"mode": "bm25_ab_overlap",
+                            "warm_p50_ms": rec["w1_warm_p50_ms"],
+                            "waves": 1}) + "\n")
+    with open(fn, "w") as f:
+        f.write(json.dumps({"mode": "bm25_ab_overlap",
+                            "warm_p50_ms": rec["wn_warm_p50_ms"],
+                            "waves": n}) + "\n")
+    sys.path.insert(0, os.path.join(here, "tools"))
+    import bench_compare
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rec["bench_compare_exit"] = bench_compare.main(
+            ["bench_compare.py", f1, fn])
+    rec["bench_compare_tail"] = buf.getvalue().strip().splitlines()[-1]
+    return rec
 
 
 def build_index():
@@ -689,6 +772,9 @@ def main():
     _setup_telemetry()
     _setup_faults()
     _setup_sanitizer()
+    if WAVES_ARG:
+        import opensearch_tpu.search.executor as executor_mod
+        executor_mod.FORCED_WAVES = WAVES_ARG
     mode = os.environ.get("BENCH_MODE", "bm25")
     if mode in ("knn_exact", "knn_ivf"):
         bench_knn(mode)
@@ -731,6 +817,18 @@ def main():
     qps = len(bodies) / dt
     ledger_stats = _ledger_warm_stats(n_runs, len(bodies), dt) \
         if TELEMETRY_ON else None
+    if TELEMETRY_ON and WAVES_ARG:
+        # the pipeline must have actually run: N waves per timed batch
+        # in the ledger, not inferred from wall deltas
+        import opensearch_tpu.search.executor as executor_mod
+        from opensearch_tpu.telemetry import TELEMETRY
+        per_batch = len(executor_mod._wave_sizes(len(bodies), WAVES_ARG))
+        got = TELEMETRY.ledger.snapshot()["waves"]
+        assert got == n_runs * per_batch, \
+            f"ledger saw {got} waves over {n_runs} timed runs, " \
+            f"expected {n_runs * per_batch} (--waves {WAVES_ARG})"
+        if ledger_stats is not None:
+            ledger_stats["waves_per_batch"] = per_batch
 
     # per-query latency distribution (single-search path, B=1 programs);
     # warm the B=1 executables first — a serving node is steady-state warm
@@ -755,6 +853,8 @@ def main():
     }
     if ledger_stats is not None:
         out.update(ledger_stats)
+    if AB_OVERLAP:
+        out["overlap_ab"] = _ab_overlap(executor, bodies, n_runs)
     _t = _telemetry_summary()
     if _t is not None:
         out["telemetry"] = _t
@@ -780,8 +880,8 @@ def _run_extra_configs():
     BENCH_ALL.json, one line per config). Each child skips the backend
     probe when this process already fell back to CPU."""
     if os.environ.get("BENCH_SKIP_EXTRA") == "1" \
-            or os.environ.get("BENCH_MODE") or FAULTS_ON:
-        # --faults is a single-config smoke: no extra-config children
+            or os.environ.get("BENCH_MODE") or FAULTS_ON or AB_OVERLAP:
+        # --faults / --ab-overlap are single-config runs: no children
         return
     import subprocess
 

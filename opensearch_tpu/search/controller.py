@@ -336,9 +336,14 @@ def execute_search(executors: List, body: Optional[dict],
                 # _msearch_batchable); errors raise — the per-item error
                 # objects are an _msearch-only contract. The envelope
                 # sets its own transfer attribution on the child span
-                # and fills phase_times for the slow log.
+                # and fills phase_times for the slow log. The request's
+                # `timeout=` rides along: the wave engine enforces it at
+                # its wave boundaries (a B=1 envelope is the degenerate
+                # single wave), rendering the timed-out shape instead of
+                # silently ignoring the budget on this path.
                 return executors[0].multi_search(
                     [body], _raise_item_errors=True, task=task,
+                    deadline=_parse_deadline(body),
                     trace=eq, phase_times=phase_times)["responses"][0]
     start = time.monotonic()
     start_ns = time.perf_counter_ns()

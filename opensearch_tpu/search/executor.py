@@ -25,6 +25,7 @@ from __future__ import annotations
 import functools
 import json
 import os
+import queue
 import threading
 import time
 import weakref
@@ -51,6 +52,7 @@ from opensearch_tpu.search.aggs.engine import compile_aggs, eval_aggs
 from opensearch_tpu.search.aggs.parse import parse_aggs
 from opensearch_tpu.search.aggs.reduce import decode_outputs, reduce_aggs
 from opensearch_tpu.telemetry import TELEMETRY
+from opensearch_tpu.telemetry.ledger import LedgerScope
 
 # sort key for eligible docs that lack the sort field: far below any real
 # rank key, far above NEG_INF (which marks ineligible docs) → fetched last
@@ -272,6 +274,209 @@ TEMPLATE_INTERNING = os.environ.get(
 _BUNDLE_HITS = TELEMETRY.metrics.counter("msearch.template.bundle_hits")
 _BUNDLE_MISSES = TELEMETRY.metrics.counter("msearch.template.bundle_misses")
 _INTERN_FALLBACKS = TELEMETRY.metrics.counter("msearch.template.fallbacks")
+
+# ------------------------------------------------------ wave-pipeline engine
+#
+# Overlapped multi-wave dispatch (ROADMAP item 1): a large msearch batch
+# splits into power-of-two-bucketed waves so wave N+1's host work
+# (intern/stack/pack/upload) and async dispatch run while wave N's
+# device_get is in flight on a collector thread. Round 7 measured
+# two-wave pipelining as a wash; PR 5 since cut the host cost 2.6× and
+# the round-9 ledger proved the wall is the dispatch-sync, not byte
+# volume — the overlap now pays (PROFILE.md round 10). Wave sizes stay
+# power-of-two buckets so the warmup registry's (plan-struct,
+# shape-bucket, b_pad) signatures are reused across wave splits.
+
+# bench --waves / tests override; 0/None = the auto policy below.
+# OPENSEARCH_TPU_MSEARCH_WAVES seeds it for whole-process A/B runs.
+try:
+    FORCED_WAVES: Optional[int] = int(os.environ.get(
+        "OPENSEARCH_TPU_MSEARCH_WAVES", "0")) or None
+except ValueError:
+    FORCED_WAVES = None
+
+# below 2× this many batchable items a split cannot win: each extra wave
+# is an extra device_get round trip, and the host work it could hide is
+# O(items in the NEXT wave)
+MSEARCH_MIN_WAVE_ITEMS = 128
+MSEARCH_MAX_WAVES = 4
+# bounded in-flight window (double buffering): at most this many waves
+# dispatched-but-uncollected, so device memory holds at most two waves
+# of input envelopes + result pages at any instant
+MSEARCH_INFLIGHT_WINDOW = 2
+
+
+# lazily probed once: overlap only pays where the collect wall is IDLE
+# host time (a real accelerator / the tunnel). On the CPU fallback the
+# "device" compute runs on the same cores as the host prepare, so
+# pipelining just contends — measured at parity-to-worse (PROFILE.md
+# round 10 re-confirms round 7's CPU number). None = not probed yet.
+_OVERLAP_CAPABLE: Optional[bool] = None
+
+
+def _overlap_capable() -> bool:
+    global _OVERLAP_CAPABLE
+    if _OVERLAP_CAPABLE is None:
+        try:
+            _OVERLAP_CAPABLE = jax.devices()[0].platform != "cpu"
+        except Exception:  # except-ok: backend probe must never fail a search; unprobeable backends serve single-wave
+            _OVERLAP_CAPABLE = False
+    return _OVERLAP_CAPABLE
+
+
+def _effective_waves(n_batchable: int) -> int:
+    """Wave-count policy for an envelope of `n_batchable` items:
+    FORCED_WAVES (bench --waves / env / tests) always wins; otherwise
+    split only when every wave keeps MSEARCH_MIN_WAVE_ITEMS rows and
+    the backend can actually overlap (see _overlap_capable)."""
+    if FORCED_WAVES:
+        return max(int(FORCED_WAVES), 1)
+    if n_batchable < 2 * MSEARCH_MIN_WAVE_ITEMS or not _overlap_capable():
+        return 1
+    return min(MSEARCH_MAX_WAVES, n_batchable // MSEARCH_MIN_WAVE_ITEMS)
+
+
+def _wave_sizes(n: int, n_waves: int) -> List[int]:
+    """Split n items into power-of-two-bucketed wave sizes (the last
+    wave takes the remainder; pad_bucket inside each wave's groups keeps
+    its executables on reused shape buckets)."""
+    if n_waves <= 1 or n <= 1:
+        return [n]
+    per = pad_bucket(-(-n // n_waves), minimum=1)
+    sizes: List[int] = []
+    left = n
+    while left > 0:
+        sizes.append(min(per, left))
+        left -= per
+    return sizes
+
+
+def _release_wave_gauges(state: Optional[dict]) -> None:
+    """Zero a wave state's `wave_buffer_bytes` marker and release the
+    device-memory gauge. Idempotent (the marker is the guard), and the
+    ONLY way any path releases it — finish halves at their fetch
+    completion, _collect_wave's finally, and the pipeline's backstop
+    all funnel here, so the release semantics live in one place."""
+    if not state:
+        return
+    leaked = state.get("wave_buffer_bytes", 0)
+    if leaked:
+        state["wave_buffer_bytes"] = 0
+        _DEVMEM.adjust("wave_buffers", -leaked)
+
+
+class _StagingPool:
+    """Double-buffered host staging for packed input envelopes.
+
+    `jnp.asarray` on the CPU backend is ZERO-COPY (the device array
+    aliases the host buffer), so a staging buffer may only be reused
+    once its wave's device_get has completed — the one point where the
+    dispatched program has provably finished reading its inputs. The
+    pipeline acquires at pack time (main thread) and releases from the
+    collector after the wave's collect (collector thread), hence the
+    lock. Exact-size free lists: steady-state waves repeat identical
+    envelope sizes, so after the first in-flight window fills, packing
+    allocates nothing per wave. (True XLA buffer donation was measured
+    unusable here: the int32 input envelope never shape/dtype-matches
+    the f32 result rows, so donate_argnums degrades to a no-op with a
+    per-dispatch warning — see README "Wave pipeline".)"""
+
+    MAX_PER_SIZE = 4            # ≥ in-flight window, double-buffered
+    MAX_BYTES = 64 << 20
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._free: Dict[int, List[np.ndarray]] = {}
+        self._bytes = 0
+
+    def acquire(self, n: int) -> np.ndarray:
+        with self._lock:
+            bufs = self._free.get(n)
+            if bufs:
+                buf = bufs.pop()
+                self._bytes -= buf.nbytes
+                return buf
+        return np.empty(n, np.int32)
+
+    def release(self, buf: np.ndarray) -> None:
+        with self._lock:
+            bufs = self._free.setdefault(int(buf.shape[0]), [])
+            if len(bufs) < self.MAX_PER_SIZE and \
+                    self._bytes + buf.nbytes <= self.MAX_BYTES:
+                bufs.append(buf)
+                self._bytes += buf.nbytes
+
+
+class _MsearchWave:
+    """One wave of the msearch pipeline: its item indices, the payload
+    the prepare half consumes, and the dispatch/collect bookkeeping the
+    overlap attribution is computed from."""
+
+    __slots__ = ("kind", "items", "payload", "state", "scope", "ph",
+                 "raise_errors", "window", "prep_t0", "prep_t1",
+                 "collect_t0", "collect_t1", "error")
+
+    def __init__(self, kind: str, items: List[int], payload,
+                 raise_errors: bool = False):
+        self.kind = kind            # "plain" | "hybrid"
+        self.items = items          # sub-request indices this wave owns
+        self.payload = payload      # batchable entries / hybrid items
+        self.state: Optional[dict] = None
+        self.scope = None           # wave-local LedgerScope (or None)
+        self.ph = dict.fromkeys(MSEARCH_PHASE_NAMES, 0.0)
+        self.raise_errors = raise_errors
+        self.window = None          # in-flight window semaphore slot
+        self.prep_t0 = self.prep_t1 = 0.0
+        self.collect_t0 = self.collect_t1 = 0.0
+        self.error: Optional[Exception] = None
+
+
+class _WaveCollector:
+    """Collector thread for the overlapped pipeline: pulls dispatched
+    waves off the queue and runs their device_get + response assembly
+    while the main thread prepares the next wave. The in-flight window
+    is a semaphore acquired BEFORE the next wave's prepare
+    (acquire_slot) and released when a wave's collect completes, so at
+    most `window` waves are device-resident at any instant."""
+
+    def __init__(self, collect_fn, window: int):
+        self._collect = collect_fn
+        # the window is enforced BEFORE prepare (acquire_slot), not at
+        # submit: a wave is device-resident from its dispatch inside
+        # prepare, so bounding the queue alone would let window+1 waves
+        # of envelopes + result pages sit on the device
+        self._window = threading.Semaphore(max(window, 1))
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._loop, name="msearch-wave-collector", daemon=True)
+        self._thread.start()
+
+    def acquire_slot(self) -> threading.Semaphore:
+        """Block until an in-flight slot frees (a prior wave's collect
+        completed); the returned semaphore is released by that wave's
+        _collect_wave finally."""
+        self._window.acquire()
+        return self._window
+
+    def submit(self, wave: _MsearchWave) -> None:
+        self._q.put(wave)
+
+    def drain(self) -> None:
+        """Flush and join — called on EVERY pipeline exit path, so a
+        cancellation or mid-flight error still collects the dispatched
+        waves and releases their buffers."""
+        self._q.put(None)
+        self._thread.join()
+
+    def _loop(self) -> None:
+        while True:
+            wave = self._q.get()
+            if wave is None:
+                return
+            # scope rides the wave record across the thread boundary;
+            # the collect callback re-binds it (sync-lint's collector-
+            # thread pattern) and attributes its own device_get region
+            self._collect(wave)
 
 
 def _base_response(took_ms: int, total: int, max_score, hits: list) -> dict:
@@ -549,15 +754,19 @@ def build_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
 # slices/bitcasts the leaves back out with a static layout, so a whole
 # group costs exactly one host→device transfer regardless of leaf count.
 
-def pack_leaves(leaves: List[np.ndarray]):
-    """Concatenate i32/f32/bool leaves into one int32 buffer + layout."""
+def pack_leaves(leaves: List[np.ndarray], pool: Optional[_StagingPool] = None):
+    """Concatenate i32/f32/bool leaves into one int32 buffer + layout.
+    `pool` (the wave pipeline's staging pool) reuses a released buffer
+    of the exact size instead of allocating — steady-state waves pack
+    into recycled memory."""
     total = 0
     metas = []
     for leaf in leaves:
         n = int(np.prod(leaf.shape)) if leaf.ndim else 1
         metas.append((total, tuple(leaf.shape), str(leaf.dtype)))
         total += n
-    buf = np.empty(max(total, 1), np.int32)
+    buf = pool.acquire(max(total, 1)) if pool is not None \
+        else np.empty(max(total, 1), np.int32)
     for leaf, (off, shape, dtype) in zip(leaves, metas):
         n = int(np.prod(shape)) if shape else 1
         flat = np.ascontiguousarray(leaf).reshape(-1)
@@ -1219,6 +1428,9 @@ class SearchExecutor:
         # index.max_result_window (set by the owning IndexService; the
         # default matches the reference)
         self.max_result_window = 10000
+        # wave-pipeline staging: recycled host envelope buffers, released
+        # only after the owning wave's collect (zero-copy-safe reuse)
+        self._staging = _StagingPool()
 
     def search(self, body: Optional[dict] = None,
                _direct: bool = False) -> dict:
@@ -1567,7 +1779,8 @@ class SearchExecutor:
                      _raise_item_errors: bool = False,
                      task=None, deadline: Optional[float] = None,
                      trace=None,
-                     phase_times: Optional[dict] = None) -> dict:
+                     phase_times: Optional[dict] = None,
+                     waves: Optional[int] = None) -> dict:
         """_msearch: execute many search bodies, batching same-shaped
         score-sorted queries into single vmapped device programs per segment
         (reference: action/search/TransportMultiSearchAction fans bodies out
@@ -1585,9 +1798,15 @@ class SearchExecutor:
         exception, not an error item.
         task / deadline: cancellation + timeout checkpoints at wave
         boundaries — cancellation kills the whole envelope (the task IS
-        the msearch request, reference TransportMultiSearchAction task),
-        a passed deadline stops launching new waves and renders the
-        unlaunched items as zero-hit `timed_out: true` partials.
+        the msearch request, reference TransportMultiSearchAction task)
+        after draining in-flight waves, a passed deadline stops
+        launching new waves and renders the unlaunched items as
+        zero-hit `timed_out: true` partials while already-dispatched
+        waves' results survive.
+        waves: explicit wave count for the overlapped pipeline (None =
+        the _effective_waves policy; warmup replays pass 1 so the
+        recorded (plan-struct, shape-bucket, b_pad) signatures
+        reproduce exactly).
         trace / phase_times: the envelope's transfer attribution —
         bytes_to_device/bytes_fetched/transfers land on the span when it
         records, device_get/bytes_fetched in phase_times for the
@@ -1621,46 +1840,45 @@ class SearchExecutor:
                     resp_cache_keys, _bypass_request_cache, start))
 
         ph["parse"] += time.monotonic() - _t
-        # ONE wave = ONE device_get for the whole batch. (A two-wave
-        # pipeline that overlaps host work with device compute was
-        # measured: on the tunneled device the second wave's extra
-        # round-trip sync costs more than the overlap saves, and on CPU
-        # the gain was ~2%. The prepare/finish split is kept for
-        # structure, not pipelining.)
+        # Overlapped multi-wave dispatch: the batchable list splits into
+        # power-of-two-bucketed waves; wave N+1's host work and async
+        # dispatch run while wave N's device_get is in flight on the
+        # collector thread (bounded in-flight window). Hybrid items ride
+        # the same engine as their own wave, and a single-wave envelope
+        # (B=1, small batches) degenerates to the inline flow — no
+        # thread. (Round 7 measured two-wave pipelining as a wash; the
+        # host cost that made it one has since dropped 2.6× (PR 5) and
+        # the round-9 ledger proved the wall is the dispatch-sync, not
+        # byte volume — see PROFILE.md round 10 for the re-measurement.)
+        wave_list: List[_MsearchWave] = []
         if hybrid_items:
-            if task is not None:
-                task.check_cancelled()
-            if deadline is not None and time.monotonic() > deadline:
-                for i, _b in hybrid_items:
-                    if responses[i] is None:
-                        responses[i] = _timed_out_item(start)
-            else:
-                self._msearch_hybrid(hybrid_items, responses, start,
-                                     _raise_item_errors, scope=scope)
+            wave_list.append(_MsearchWave(
+                "hybrid", [i for i, _b in hybrid_items], hybrid_items,
+                raise_errors=_raise_item_errors))
         if batchable:
-            if task is not None:
-                task.check_cancelled()
-            state = self._msearch_prepare(batchable, responses, start, ph,
-                                          _raise_item_errors,
-                                          deadline=deadline, scope=scope)
-            state["resp_cache_keys"] = resp_cache_keys
-            # the in-flight wave-buffer gauge rises HERE (not inside
-            # prepare) and is released by _msearch_finish or — on any
-            # exception in between, e.g. the cancellation checkpoint —
-            # by the finally below: no path can strand it
-            _DEVMEM.adjust("wave_buffers",
-                           state.get("wave_buffer_bytes", 0))
-            try:
-                if task is not None:
-                    task.check_cancelled()
-                self._msearch_finish(state, responses, start, ph,
-                                     scope=scope)
-            finally:
-                # _msearch_finish zeroes this marker at its release
-                # points; whatever it never saw is released here
-                leaked = state.get("wave_buffer_bytes", 0)
-                if leaked:
-                    _DEVMEM.adjust("wave_buffers", -leaked)
+            n_waves = _effective_waves(len(batchable)) if waves is None \
+                else max(int(waves), 1)
+            off = 0
+            for size in _wave_sizes(len(batchable), n_waves):
+                chunk = batchable[off:off + size]
+                off += size
+                wave_list.append(_MsearchWave(
+                    "plain", [e[0] for e in chunk], chunk,
+                    raise_errors=_raise_item_errors))
+        if wave_list:
+            # mixed hybrid+plain envelopes have >1 waves structurally;
+            # whether they OVERLAP still follows the wave-count policy
+            # (explicit waves>1 / FORCED_WAVES win, else the backend
+            # probe) — on the unforced CPU fallback they run
+            # inline-sequentially, exactly the old flow
+            explicit = waves if waves is not None else FORCED_WAVES
+            allow_pipeline = (int(explicit) > 1 if explicit is not None
+                              else _overlap_capable())
+            self._run_wave_pipeline(
+                wave_list, responses, start, ph, task=task,
+                deadline=deadline, scope=scope,
+                resp_cache_keys=resp_cache_keys,
+                allow_pipeline=allow_pipeline)
         # parse always runs; the wave phases only get a sample when a
         # batched wave actually executed — otherwise every all-general or
         # all-hybrid envelope would log spurious 0-ms device_get/respond
@@ -1681,6 +1899,158 @@ class SearchExecutor:
             scope.publish(trace, phase_times)
         return {"took": int((time.monotonic() - start) * 1000),
                 "responses": responses}
+
+    def _run_wave_pipeline(self, wave_list: List[_MsearchWave], responses,
+                           start: float, ph: dict, task=None,
+                           deadline: Optional[float] = None, scope=None,
+                           resp_cache_keys: Optional[dict] = None,
+                           allow_pipeline: bool = True) -> None:
+        """Drive the wave engine: prepare + async-dispatch each wave on
+        THIS thread, collect on the collector thread (bounded in-flight
+        window), and merge per-wave phase times, ledger scopes and
+        overlap attribution once everything drained.
+
+        The PR 6 checkpoints live at the wave boundaries: a cancellation
+        raises here after in-flight waves drain (their buffers release,
+        the device-memory gauge returns to baseline); a passed deadline
+        renders the unlaunched waves' items as zero-hit timed-out
+        partials while dispatched waves still finish and their results
+        survive. len(wave_list) == 1 is the degenerate W=1 pipeline —
+        fully inline, no thread — which the B=1 single-search delegation
+        and hybrid-only envelopes ride. `allow_pipeline` carries the
+        wave-count policy's verdict: a mixed hybrid+plain envelope has
+        >1 waves structurally, but must still run inline-sequentially
+        where the policy says overlap cannot pay (the CPU fallback,
+        unforced)."""
+        pipelined = len(wave_list) > 1 and allow_pipeline
+        collector = _WaveCollector(
+            lambda w: self._collect_wave(w, responses, start),
+            MSEARCH_INFLIGHT_WINDOW) if pipelined else None
+        dispatched: List[_MsearchWave] = []
+        try:
+            for wave in wave_list:
+                if task is not None:
+                    task.check_cancelled()
+                if deadline is not None and time.monotonic() > deadline:
+                    for i in wave.items:
+                        if responses[i] is None:
+                            responses[i] = _timed_out_item(start)
+                    continue
+                if collector is not None:
+                    # bounded in-flight window: block until a slot frees
+                    # BEFORE compiling/dispatching the next wave
+                    wave.window = collector.acquire_slot()
+                wave.scope = LedgerScope() if scope is not None else None
+                wave.prep_t0 = time.monotonic()
+                if wave.kind == "hybrid":
+                    wave.state = self._msearch_hybrid_prepare(
+                        wave.payload, responses, start,
+                        wave.raise_errors, scope=wave.scope)
+                else:
+                    wave.state = self._msearch_prepare(
+                        wave.payload, responses, start, wave.ph,
+                        wave.raise_errors, deadline=deadline,
+                        scope=wave.scope)
+                    wave.state["resp_cache_keys"] = resp_cache_keys or {}
+                wave.prep_t1 = time.monotonic()
+                # the in-flight gauges rise HERE (not inside prepare) so
+                # an exception out of prepare can never strand them; the
+                # collect path and the finally below are the two release
+                # points — no exit path leaks
+                _DEVMEM.adjust("wave_buffers",
+                               wave.state.get("wave_buffer_bytes", 0))
+                _LEDGER.note_wave_inflight(+1)
+                dispatched.append(wave)
+                if collector is None:
+                    if task is not None:
+                        task.check_cancelled()
+                    self._collect_wave(wave, responses, start)
+                else:
+                    collector.submit(wave)
+        finally:
+            if collector is not None:
+                collector.drain()
+            # backstop for waves whose collect never ran or died before
+            # its release points (e.g. the inline path's pre-collect
+            # cancellation checkpoint fired between dispatch and
+            # collect): after drain() every submitted wave has been
+            # collected, so an unset collect_t1 means THIS wave still
+            # owns its inflight-gauge slot and its buffers
+            for wave in dispatched:
+                _release_wave_gauges(wave.state)
+                if not wave.collect_t1:
+                    _LEDGER.note_wave_inflight(-1)
+        # merge per-wave accounting on this thread (single writer):
+        # phase times sum, wave scopes absorb into the request scope,
+        # and each wave's measured overlap — its prepare/dispatch time
+        # that ran while an earlier wave's device_get was in flight —
+        # lands in the ledger as a first-class number
+        collects: List[Tuple[float, float]] = []
+        pipeline_error: Optional[Exception] = None
+        for wave in dispatched:
+            for name, sec in wave.ph.items():
+                ph[name] += sec
+            if wave.scope is not None:
+                wave.scope.waves += 1
+            if pipelined and collects:
+                # this wave's prepare/dispatch time during which an
+                # earlier wave's device_get was in flight — the
+                # pipeline's measured win (first wave has nothing to
+                # overlap with, so it records no event)
+                overlap_s = sum(
+                    max(0.0, min(c1, wave.prep_t1)
+                        - max(c0, wave.prep_t0))
+                    for c0, c1 in collects)
+                _LEDGER.note_overlap(overlap_s * 1000.0,
+                                     scope=wave.scope)
+            if wave.collect_t1:
+                collects.append((wave.collect_t0, wave.collect_t1))
+            if wave.scope is not None and scope is not None:
+                scope.absorb(wave.scope)
+            if wave.error is not None and wave.raise_errors \
+                    and pipeline_error is None:
+                pipeline_error = wave.error
+        if pipeline_error is not None:
+            raise pipeline_error
+
+    def _collect_wave(self, wave: _MsearchWave, responses,
+                      start: float) -> None:
+        """Wave half 2, on the collector thread (or inline for W=1):
+        device_get + response assembly. `wave.scope` is the LedgerScope
+        handed across the queue/thread boundary — the finish halves
+        open their own LEDGER.attributed regions on THIS thread, so the
+        sanitizer contract holds with the collector active. An escaping
+        exception is captured per wave: the owning wave's unanswered
+        items render as error objects, sibling waves are untouched."""
+        scope = wave.scope
+        wave.collect_t0 = time.monotonic()
+        try:
+            if wave.kind == "hybrid":
+                self._msearch_hybrid_finish(wave.state, responses, start,
+                                            scope=scope)
+            else:
+                self._msearch_finish(wave.state, responses, start,
+                                     wave.ph, scope=scope)
+        except Exception as e:  # except-ok: per-wave isolation -- a collect failure downgrades only this wave's items, never siblings or the envelope
+            wave.error = e
+        finally:
+            wave.collect_t1 = time.monotonic()
+            state = wave.state or {}
+            _release_wave_gauges(state)
+            # collect done ⇒ the device program finished reading its
+            # (zero-copy-aliased) input envelope: staging is reusable
+            for buf in state.pop("staging", ()):
+                self._staging.release(buf)
+            _LEDGER.note_wave_inflight(-1)
+            if wave.window is not None:
+                wave.window.release()
+        if wave.error is not None and not wave.raise_errors:
+            err = _item_error(wave.error) \
+                if isinstance(wave.error, OpenSearchTpuError) \
+                else _item_error_untyped(wave.error)
+            for i in wave.items:
+                if responses[i] is None:
+                    responses[i] = dict(err)
 
     def _msearch_parse_one(self, i: int, body: dict, responses, batchable,
                            hybrid_items, resp_cache_keys,
@@ -1754,16 +2124,19 @@ class SearchExecutor:
         min_score = _req_min_score(body)
         batchable.append((i, body, node, size, from_, min_score))
 
-    def _msearch_hybrid(self, items: List[Tuple[int, dict]], responses,
-                        start: float,
-                        raise_item_errors: bool = False,
-                        scope=None) -> None:
-        """Batched hybrid envelope: same-structure hybrid bodies become
-        ONE vmapped fused program per (plan-struct, shape, k) group per
-        segment — per-query launch cost amortizes exactly like the plain
-        msearch envelope. Responses use the DEFAULT normalization spec
-        (pipeline-specific specs ride the REST path, where _run_search
-        executes per query with the resolved processor chain)."""
+    def _msearch_hybrid_prepare(self, items: List[Tuple[int, dict]],
+                                responses, start: float,
+                                raise_item_errors: bool = False,
+                                scope=None) -> dict:
+        """Hybrid wave half 1 (compile + group + stack + pack +
+        DISPATCH, async): same-structure hybrid bodies become ONE
+        vmapped fused program per (plan-struct, shape, k) group per
+        segment — per-query launch cost amortizes exactly like the
+        plain msearch envelope. Returns the state
+        _msearch_hybrid_finish consumes. Responses use the DEFAULT
+        normalization spec (pipeline-specific specs ride the REST path,
+        where _run_search executes per query with the resolved
+        processor chain)."""
         from opensearch_tpu.searchpipeline import hybrid as hyb
         stats = self.reader.stats()
         compiler = Compiler(self.reader.mapper, stats)
@@ -1820,6 +2193,8 @@ class SearchExecutor:
         from opensearch_tpu.search.warmup import WARMUP
         pending = []
         dead: set = set()
+        staging: List[np.ndarray] = []
+        wave_buffer_bytes = 0
         for (struct, shape_sig, k_fetch), idxs in groups.items():
             b_pad = pad_bucket(len(idxs), minimum=1)
             pad_rows = b_pad - len(idxs)
@@ -1837,7 +2212,7 @@ class SearchExecutor:
                 group_flats += [group_flats[0]] * pad_rows
                 stacked, treedef, axes = stack_flat_inputs(group_flats)
                 stacked.append(min_scores)
-                buf, layout = pack_leaves(stacked)
+                buf, layout = pack_leaves(stacked, pool=self._staging)
                 k_seg = min(k_fetch, pad_bucket(max(seg.num_docs, 1)))
                 plans0 = prepared[idxs[0]][3][seg_i]
                 try:
@@ -1865,8 +2240,23 @@ class SearchExecutor:
                     # h2d bytes that never crossed
                     _LEDGER.record("upload.literals", "h2d", buf.nbytes,
                                    scope=scope)
+                staging.append(buf)
+                wave_buffer_bytes += buf.nbytes
                 pending.append((idxs, seg_i, k_seg, len(plans0), out))
+        return {"prepared": prepared, "pending": pending, "dead": dead,
+                "raise_item_errors": raise_item_errors,
+                "staging": staging,
+                "wave_buffer_bytes": wave_buffer_bytes}
 
+    def _msearch_hybrid_finish(self, state: dict, responses,
+                               start: float, scope=None) -> None:
+        """Hybrid wave half 2: ONE device_get for the wave's fused
+        rows (run on the collector thread when the pipeline overlaps),
+        then accumulate per-sub-query channels and render through the
+        normalization merge."""
+        prepared, pending, dead = (state["prepared"], state["pending"],
+                                   state["dead"])
+        raise_item_errors = state["raise_item_errors"]
         results = {i: _empty_hybrid_result(prepared[i][1])
                    for i in prepared}
         if pending:
@@ -1897,12 +2287,15 @@ class SearchExecutor:
                         dead.add(i)
                 fetched = []
                 pending = []
+            _release_wave_gauges(state)
             for (idxs, seg_i, k_seg, n_sub, _), packed in zip(pending,
                                                               fetched):
                 packed = np.asarray(packed)
                 for row_i, i in enumerate(idxs):
                     _accumulate_hybrid_row(results[i], packed[row_i],
                                            seg_i, k_seg, n_sub)
+        _release_wave_gauges(state)
+        from opensearch_tpu.searchpipeline import hybrid as hyb
         for i, result in results.items():
             if i in dead:
                 continue
@@ -1910,7 +2303,6 @@ class SearchExecutor:
             result.bounds = [tuple(b) for b in result.bounds]
             responses[i] = hyb.merge_and_render(
                 [self], body, [result], hyb.DEFAULT_SPEC, start, n_sub)
-
 
     def _compile_msearch_bundle(self, compiler: Compiler, stats, tpl,
                                 node, body: dict, agg_spec,
@@ -2100,6 +2492,8 @@ class SearchExecutor:
         pending = []
         wave_buffer_bytes = 0   # in-flight packed uploads, released by
         # _msearch_finish once the wave's results are fetched
+        staging: List[np.ndarray] = []  # pooled envelope buffers, back
+        # to the pool once this wave's collect completes (zero-copy-safe)
         dead: set = set()       # items already answered (error/timeout):
         # _msearch_finish must not overwrite their responses
         for (struct, agg_sig, shape_sig, k_fetch), idxs in groups.items():
@@ -2133,7 +2527,7 @@ class SearchExecutor:
                     group_flats, with_const=agg_sig is not None)
                 stacked.append(min_scores)
                 axes.append(0)
-                buf, layout = pack_leaves(stacked)
+                buf, layout = pack_leaves(stacked, pool=self._staging)
                 k_seg = min(k_fetch, pad_bucket(max(seg.num_docs, 1)))
                 plan0 = compiled[idxs[0]][seg_i]
                 try:
@@ -2191,11 +2585,13 @@ class SearchExecutor:
                 # raises it once from the returned total, so an
                 # exception out of this loop can never strand bytes
                 wave_buffer_bytes += buf.nbytes
+                staging.append(buf)
                 pending.append((idxs, seg_i, k_seg, out, out_layout))
         ph["stack_pack_dispatch"] += time.monotonic() - _t
         return {"groups": groups, "entry_by_i": entry_by_i,
                 "pending": pending, "agg_by_i": agg_by_i,
                 "agg_nodes_by_i": agg_nodes_by_i, "dead": dead,
+                "staging": staging,
                 "wave_buffer_bytes": wave_buffer_bytes}
 
     def _msearch_finish(self, state, responses, start, ph, scope=None):
@@ -2219,17 +2615,8 @@ class SearchExecutor:
             {i: [] for i in grouped}
         per_query_total: Dict[int, int] = {i: 0 for i in grouped}
         per_query_decoded: Dict[int, list] = {i: [] for i in agg_by_i}
-        wave_buffer_bytes = state.get("wave_buffer_bytes", 0)
-
-        def _release_wave_buffers():
-            # zero the state marker so multi_search's finally (which
-            # covers the paths that raise before reaching a release
-            # point) never double-decrements
-            if state.get("wave_buffer_bytes", 0):
-                state["wave_buffer_bytes"] = 0
-                _DEVMEM.adjust("wave_buffers", -wave_buffer_bytes)
         if not pending:
-            _release_wave_buffers()
+            _release_wave_gauges(state)
             return
 
         # [actually transferred d2h bytes, round trips] — filled by the
@@ -2289,7 +2676,7 @@ class SearchExecutor:
                             dead.add(i)
         collect_s = time.monotonic() - _t
         ph["device_get"] += collect_s; _t = time.monotonic()
-        _release_wave_buffers()
+        _release_wave_gauges(state)
         if scope is not None:
             _ledger_packed_rows(scope, pending, fetched, fetch_stats[0],
                                 collect_s * 1000, max(fetch_stats[1], 1))

@@ -264,8 +264,14 @@ class WarmupRegistry:
                         from opensearch_tpu.common import faults
                         if faults.ENABLED:
                             faults.fire("warmup.replay")
+                        # waves=1: the recorded b_pad already reflects
+                        # any serving-time wave split, so the replay
+                        # must not re-split it — one wave reproduces
+                        # the registered (plan-struct, shape-bucket,
+                        # b_pad) executable exactly
                         executor.multi_search(bodies,
-                                              _bypass_request_cache=True)
+                                              _bypass_request_cache=True,
+                                              waves=1)
                     from opensearch_tpu.common import retry as _retry
                     _retry.call_with_retry(_replay, label="warmup.replay")
                     warmed += 1

@@ -52,7 +52,7 @@ class LedgerScope:
     bytes, round_trips, wave) tuples."""
 
     __slots__ = ("entries", "h2d_bytes", "d2h_bytes", "device_get_ms",
-                 "round_trips")
+                 "round_trips", "waves", "overlap_ms")
 
     def __init__(self):
         self.entries: List[Tuple[str, str, int, int, Optional[int]]] = []
@@ -60,6 +60,11 @@ class LedgerScope:
         self.d2h_bytes = 0
         self.device_get_ms = 0.0
         self.round_trips = 0
+        # wave-pipeline attribution: how many device waves served this
+        # request and how much of their dispatch work ran WHILE an
+        # earlier wave's device_get was in flight (the overlap win)
+        self.waves = 0
+        self.overlap_ms = 0.0
 
     def absorb(self, other: "LedgerScope") -> None:
         self.entries.extend(other.entries)
@@ -67,6 +72,8 @@ class LedgerScope:
         self.d2h_bytes += other.d2h_bytes
         self.device_get_ms += other.device_get_ms
         self.round_trips += other.round_trips
+        self.waves += other.waves
+        self.overlap_ms += other.overlap_ms
 
     def to_list(self) -> List[dict]:
         """JSON-able per-transfer records for the Profile API."""
@@ -84,10 +91,16 @@ class LedgerScope:
             span.set_attribute("bytes_to_device", self.h2d_bytes)
             span.set_attribute("bytes_fetched", self.d2h_bytes)
             span.set_attribute("transfers", self.to_list())
+            if self.waves:
+                span.set_attribute("waves", self.waves)
+                span.set_attribute("overlap_ms", round(self.overlap_ms, 3))
         if phase_times is not None:
             phase_times["device_get"] = self.device_get_ms
             phase_times["bytes_fetched"] = self.d2h_bytes
             phase_times["bytes_to_device"] = self.h2d_bytes
+            if self.waves:
+                phase_times["waves"] = self.waves
+                phase_times["overlap_ms"] = self.overlap_ms
 
 
 class TransferLedger:
@@ -101,10 +114,19 @@ class TransferLedger:
         self._wave_seq = 0
         self._device_get_calls = 0
         self._device_get_ms = 0.0
+        # wave-pipeline gauges: waves dispatched but not yet collected
+        # (live like the device-memory classes, not ledger-gated — the
+        # update is one lock acquire per WAVE, not per item) plus the
+        # measured dispatch/collect overlap the pipeline actually won
+        self._inflight_waves = 0
+        self._max_inflight_waves = 0
+        self._overlap_events = 0
+        self._overlap_ms = 0.0
         # live views for the wave scheduler: bytes fetched per wave and
         # device_get wall per wave (rolling.py — O(1) reads)
         self.wave_bytes = RollingEstimator()
         self.wave_ms = RollingEstimator()
+        self.wave_overlap_ms = RollingEstimator()
         self._tls = threading.local()
 
     # ------------------------------------------------------------- hot path
@@ -174,6 +196,35 @@ class TransferLedger:
         self.wave_ms.observe(ms)
         if nbytes:
             self.wave_bytes.observe(float(nbytes))
+
+    def note_wave_inflight(self, delta: int) -> None:
+        """In-flight wave gauge: +1 at dispatch, -1 when the wave's
+        collect completes. Live regardless of `enabled` (same contract
+        as the device-memory gauges): a `_nodes/stats` poll must see the
+        pipeline depth even when per-channel accounting is off."""
+        with self._lock:
+            self._inflight_waves = max(self._inflight_waves + delta, 0)
+            if self._inflight_waves > self._max_inflight_waves:
+                self._max_inflight_waves = self._inflight_waves
+
+    def inflight_waves(self) -> int:
+        with self._lock:
+            return self._inflight_waves
+
+    def note_overlap(self, ms: float,
+                     scope: Optional[LedgerScope] = None) -> None:
+        """One wave's measured overlap: how long its host prepare +
+        async dispatch ran while an earlier wave's device_get was in
+        flight on the collector thread — the pipeline's win as a
+        first-class number, not a wall-clock inference."""
+        if scope is not None:
+            scope.overlap_ms += ms
+        if not self.enabled:
+            return
+        with self._lock:
+            self._overlap_events += 1
+            self._overlap_ms += ms
+        self.wave_overlap_ms.observe(ms)
 
     @contextmanager
     def tagged(self, tag: str):
@@ -247,15 +298,24 @@ class TransferLedger:
                 totals[direction] += b
             calls, total_ms = self._device_get_calls, self._device_get_ms
             waves = self._wave_seq
+            pipeline = {
+                "inflight_waves": self._inflight_waves,
+                "max_inflight_waves": self._max_inflight_waves,
+                "overlap_events": self._overlap_events,
+                "overlap_ms": round(self._overlap_ms, 3),
+            }
         return {
             "enabled": self.enabled,
             "waves": waves,
+            "pipeline": pipeline,
             "device_get": {"calls": calls,
                            "total_ms": round(total_ms, 3)},
             "bytes_total": dict(totals),
             "channels": chans,
             "rolling": {"wave_bytes": self.wave_bytes.summary(),
-                        "wave_device_get_ms": self.wave_ms.summary()},
+                        "wave_device_get_ms": self.wave_ms.summary(),
+                        "wave_overlap_ms":
+                            self.wave_overlap_ms.summary()},
         }
 
     def reset(self) -> None:
@@ -264,8 +324,14 @@ class TransferLedger:
             self._wave_seq = 0
             self._device_get_calls = 0
             self._device_get_ms = 0.0
+            # the inflight gauge itself is NOT reset: waves still in
+            # flight at reset time must drain to zero, not go negative
+            self._max_inflight_waves = self._inflight_waves
+            self._overlap_events = 0
+            self._overlap_ms = 0.0
         self.wave_bytes.reset()
         self.wave_ms.reset()
+        self.wave_overlap_ms.reset()
 
 
 class DeviceMemoryAccounting:

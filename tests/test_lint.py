@@ -113,6 +113,71 @@ def test_sync_lint_nested_closure_inherits_attribution(tmp_path):
     assert sync_lint.check_file(_source(tmp_path, src)) == []
 
 
+# collector-thread pattern (the overlapped wave pipeline): a LedgerScope
+# handed across a queue/thread boundary still counts as attribution —
+# the worker re-binds the request's scope before syncing
+
+GOOD_SYNC_QUEUE_BINDING = """\
+import jax
+
+def collector_loop(q):
+    while True:
+        state, scope = q.get()      # scope crosses the thread boundary
+        if state is None:
+            return
+        fetched = jax.device_get(state)
+"""
+
+GOOD_SYNC_WAVE_ATTR_BINDING = """\
+import jax
+
+def collect_wave(wave):
+    scope = wave.scope              # re-bound from the wave record
+    return jax.device_get(wave.pending)
+"""
+
+GOOD_SYNC_SCOPE_KWARG_FORWARD = """\
+import jax
+
+def collect_wave(wave, finish):
+    finish(wave.state, scope=wave.scope)
+    return jax.device_get(wave.pending)
+"""
+
+BAD_SYNC_QUEUE_NO_SCOPE = """\
+import jax
+
+def collector_loop(q):
+    while True:
+        state = q.get()             # nothing scope-shaped crosses
+        if state is None:
+            return
+        fetched = jax.device_get(state)   # line 8: unattributed
+"""
+
+
+def test_sync_lint_accepts_queue_scope_binding(tmp_path):
+    assert sync_lint.check_file(
+        _source(tmp_path, GOOD_SYNC_QUEUE_BINDING)) == []
+
+
+def test_sync_lint_accepts_wave_attr_scope_binding(tmp_path):
+    assert sync_lint.check_file(
+        _source(tmp_path, GOOD_SYNC_WAVE_ATTR_BINDING)) == []
+
+
+def test_sync_lint_accepts_scope_kwarg_forwarding(tmp_path):
+    assert sync_lint.check_file(
+        _source(tmp_path, GOOD_SYNC_SCOPE_KWARG_FORWARD)) == []
+
+
+def test_sync_lint_flags_collector_without_scope_handoff(tmp_path):
+    vs = [v for v in sync_lint.check_file(
+        _source(tmp_path, BAD_SYNC_QUEUE_NO_SCOPE))
+        if v.rule == "sync-lint"]
+    assert len(vs) == 1 and vs[0].line == 8
+
+
 # -------------------------------------------------------------- except-breadth
 
 def test_except_breadth_flags_blanket_handler(tmp_path):

@@ -13,7 +13,14 @@ in ledger attribution:
   - it takes a `scope` / `ledger_scope` / `ledger` parameter, or
   - its body calls the TransferLedger API (`note_device_get`, or
     `record`/`scope`/`ambient`/`attributed`/`tagged`/`current`/
-    `new_wave` on a ledger-named object), or references `LedgerScope`.
+    `new_wave` on a ledger-named object), or references `LedgerScope`,
+    or
+  - it BINDS a scope-named local — `state, scope = queue.get()`,
+    `scope = wave.scope`, `for _, scope in pending:` — or passes a
+    `scope=`/`ledger_scope=` keyword onward. This is the collector-
+    thread pattern (the overlapped wave pipeline): a scope handed
+    across a queue/thread boundary still counts as attribution, since
+    the worker re-binds the request's LedgerScope before syncing.
 Nested closures inherit: a `_collect` defined inside an attributing
 function is attributed (the scope is in lexical reach).
 
@@ -55,6 +62,27 @@ def _ledger_receiver(node: ast.expr) -> bool:
     return last in LEDGER_RECEIVERS or "ledger" in last
 
 
+def _binds_scope_name(node: ast.AST) -> bool:
+    """True when an assignment/loop target binds a scope-named local —
+    the queue/thread-boundary handoff of the collector pattern."""
+    targets: List[ast.expr] = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, ast.AnnAssign):
+        targets = [node.target]
+    elif isinstance(node, ast.NamedExpr):
+        targets = [node.target]
+    elif isinstance(node, ast.For):
+        targets = [node.target]
+    elif isinstance(node, ast.withitem) and node.optional_vars is not None:
+        targets = [node.optional_vars]
+    for t in targets:
+        for leaf in ast.walk(t):
+            if isinstance(leaf, ast.Name) and leaf.id in SCOPE_PARAMS:
+                return True
+    return False
+
+
 def is_ledger_carrying(fn) -> bool:
     """Does this def/lambda carry a LedgerScope (see module docstring)?"""
     if not isinstance(fn, ast.Lambda):
@@ -67,6 +95,14 @@ def is_ledger_carrying(fn) -> bool:
             if node.attr == "note_device_get":
                 return True
             if node.attr in LEDGER_METHODS and _ledger_receiver(node.value):
+                return True
+        if _binds_scope_name(node):
+            return True
+        if isinstance(node, ast.Call):
+            # forwarding a scope keyword marks participation the same
+            # way receiving the parameter does
+            if any(kw.arg in SCOPE_PARAMS for kw in node.keywords
+                   if kw.arg is not None):
                 return True
     return False
 

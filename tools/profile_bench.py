@@ -56,6 +56,11 @@ def main():
     log("msearch cold (compiles)", time.perf_counter() - t0)
     from opensearch_tpu.telemetry import TELEMETRY
     TELEMETRY.metrics.reset()
+    # ledger ON for the whole profile: the per-stage timings below are
+    # taken via ledger-attributed device_get (the only true sync on the
+    # tunnel), so the run's channel/wave decomposition is real data
+    TELEMETRY.ledger.enabled = True
+    TELEMETRY.ledger.reset()
     t0 = time.perf_counter()
     executor.multi_search(bodies)
     total = time.perf_counter() - t0
@@ -130,14 +135,24 @@ def main():
     log("host: upload (asarray calls)", t_upload,
         f"{sum(g[2] for g in group_stats)} B")
     log("host: dispatch (async calls)", t_disp)
+    # Stage boundary measured via a LEDGER-ATTRIBUTED device_get — the
+    # only true sync point on the tunnel. The old two-stage split
+    # ("block_until_ready" then "device_get") under-measured: on the
+    # tunneled device block_until_ready can return WITHOUT a round trip,
+    # so its stage read near-zero while the next stage silently absorbed
+    # the execute wall (PROFILE.md round 10 documents the fix). One
+    # attributed fetch charges execute + transfer to one honest number,
+    # and the ledger records it like any serving-path collect.
+    ledger = TELEMETRY.ledger
     t0 = time.perf_counter()
-    for out in pending:
-        out.block_until_ready()
-    log("device: execute (block_until_ready)", time.perf_counter() - t0)
-    t0 = time.perf_counter()
-    fetched = jax.device_get(pending)
-    log("transfer: device_get results", time.perf_counter() - t0,
-        f"{sum(np.asarray(f).nbytes for f in fetched)} B")
+    with ledger.attributed():
+        fetched = jax.device_get(pending)
+    collect_s = time.perf_counter() - t0
+    fetched_b = sum(np.asarray(f).nbytes for f in fetched)
+    ledger.note_device_get(collect_s * 1000, nbytes=fetched_b)
+    log("device+transfer: attributed device_get", collect_s,
+        f"{fetched_b} B (execute+fetch; block_until_ready is not a "
+        f"tunnel barrier)")
 
     d_pad = int(arrays["live"].shape[0])
     b_total = sum(b for b, _, _ in group_stats)
@@ -158,13 +173,21 @@ def main():
     w = jnp.asarray(rng.rand(B, QB), dtype=jnp.float32)
 
     def timed(fn, *args, reps=3, name="", note=""):
+        """Microbench via ledger-attributed device_get, NOT
+        block_until_ready: on the tunnel only device_get forces the
+        round trip, so block_until_ready-timed stages read fast while
+        the wall silently moves to whoever syncs next (the round-4
+        follow-up's caveat, fixed here — PROFILE.md round 10)."""
         out = fn(*args)
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            out = fn(*args)
-            jax.block_until_ready(out)
-        log(name, (time.perf_counter() - t0) / reps, note)
+        with ledger.attributed():
+            jax.device_get(out)                 # warm (compile) pass
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = fn(*args)
+                jax.device_get(out)
+            dt = (time.perf_counter() - t0) / reps
+        ledger.note_device_get(dt * 1000)
+        log(name, dt, note)
 
     post_docs, post_tf = arrays["post_docs"], arrays["post_tf"]
 
@@ -244,14 +267,22 @@ def main():
     # raw run dump goes to PROFILE_RUN.md — PROFILE.md is the curated
     # analysis and must not be clobbered by a (possibly tunnel-degraded)
     # ad-hoc run; tunnel RT varies 66-600ms between sessions
+    lsnap = TELEMETRY.ledger.snapshot()
     with open(os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "PROFILE_RUN.md"), "w") as f:
         f.write("# bench config 1 profile run (%s)\n\n" % platform)
+        f.write("All device-stage timings are ledger-attributed "
+                "`device_get` walls — `block_until_ready` is NOT a "
+                "sync barrier on the tunnel and under-measures "
+                "(PROFILE.md round 10).\n\n")
         f.write("| phase | ms | note |\n|---|---|---|\n")
         for name, sec, note in RESULTS:
             f.write(f"| {name} | {sec * 1000:.1f} | {note} |\n")
         f.write(f"\ngroups (n, b_pad, bytes): {group_stats}; "
                 f"d_pad={d_pad}; qb_max={qb_max}; B={B}\n")
+        f.write(f"\nledger: waves={lsnap['waves']} "
+                f"device_get={lsnap['device_get']} "
+                f"pipeline={lsnap['pipeline']}\n")
     print("\nwrote PROFILE_RUN.md")
 
 

@@ -127,9 +127,17 @@ def summary_lines(snap: dict) -> List[str]:
         mbps = (d2h / 1e6) / (total_ms / 1e3)
         lines.append(f"implied d2h bandwidth: {mbps:.1f} MB/s "
                      f"({_fmt_bytes(d2h / max(calls, 1))}/round-trip)")
+    pipeline = snap.get("pipeline") or {}
+    if pipeline:
+        lines.append(
+            f"pipeline: inflight_waves={pipeline.get('inflight_waves', 0)}"
+            f" max_inflight={pipeline.get('max_inflight_waves', 0)}"
+            f" overlap={pipeline.get('overlap_ms', 0.0):.1f}ms over "
+            f"{pipeline.get('overlap_events', 0)} wave(s)")
     rolling = snap.get("rolling") or {}
     for key, label in (("wave_bytes", "bytes/wave"),
-                       ("wave_device_get_ms", "device_get ms/wave")):
+                       ("wave_device_get_ms", "device_get ms/wave"),
+                       ("wave_overlap_ms", "overlap ms/wave")):
         s = rolling.get(key)
         if s and s.get("count"):
             lines.append(
