@@ -107,13 +107,17 @@ class Node:
             # disables tracing)
             return False if raw is None else _parse_bool(raw, key)
 
+        _tail_thr = self.settings.get("telemetry.tail.threshold_ms")
         TELEMETRY.configure(
             data_path=data_path,
             enabled=_tel_bool("telemetry.tracing.enabled"),
             jsonl=_tel_bool("telemetry.tracing.jsonl"),
             ring_size=int(self.settings.get("telemetry.tracing.ring_size",
                                             256)),
-            transfers=_tel_bool("telemetry.transfers.enabled"))
+            transfers=_tel_bool("telemetry.transfers.enabled"),
+            tail=_tel_bool("telemetry.tail.enabled"),
+            tail_threshold_ms=None if _tail_thr is None
+            else float(_tail_thr))
         self.controller = RestController()
         from opensearch_tpu.rest.actions import register_all
         register_all(self)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import logging
+import sys
 import time
 from typing import Any, Dict, List, Optional
 
@@ -151,6 +152,13 @@ def _run_search(node, index_expr: Optional[str], body: Optional[dict],
     metrics = TELEMETRY.metrics
     root = tracer.start_trace("rest.search", index=index_expr or "_all")
     metrics.counter("rest.search_requests").inc()
+    # request lifecycle (telemetry/lifecycle.py): arrive is implicit at
+    # timeline construction; admit/reject bracket the backpressure gate
+    # below. None (one attribute load + branch) unless the flight
+    # recorder is enabled.
+    flight = TELEMETRY.flight
+    tl = flight.timeline()
+    tl_prev = flight.bind(tl) if tl is not None else None
     phase_times: Dict[str, float] = {}
     t0 = time.perf_counter_ns()
     try:
@@ -173,6 +181,7 @@ def _run_search(node, index_expr: Optional[str], body: Optional[dict],
             with root.child("query", path="percolate"):
                 return execute_percolate(executors, parsed, max(k, 10),
                                          body)
+        t_admit = time.monotonic() if tl is not None else 0.0
         try:
             node.search_backpressure.acquire()
         except OpenSearchTpuError:
@@ -180,7 +189,16 @@ def _run_search(node, index_expr: Optional[str], body: Optional[dict],
             # status — rejections must be visible in traces, not lost
             root.set_attribute("backpressure", "rejected")
             root.end(status="rejected")
+            if tl is not None:
+                tl.event("reject", reason="backpressure")
+                flight.complete(tl, status="rejected", span=root)
             raise
+        if tl is not None:
+            # today's gate admits or rejects immediately, so queue_wait
+            # reads ~0 — the field the item-2 wave scheduler fills with
+            # real micro-batch queue delay
+            tl.queue_wait((time.monotonic() - t_admit) * 1000)
+            tl.event("admit")
         task = node.task_manager.register(
             "indices:data/read/search",
             description=f"indices[{index_expr or '_all'}]", cancellable=True)
@@ -207,6 +225,13 @@ def _run_search(node, index_expr: Optional[str], body: Optional[dict],
     finally:
         metrics.histogram("rest.search_ms").observe(
             (time.perf_counter_ns() - t0) / 1e6)
+        if tl is not None:
+            flight.unbind(tl_prev)
+            if tl.took_ms is None:      # the reject path completed above
+                tl.event("respond")
+                flight.complete(
+                    tl, status="error" if sys.exc_info()[0] is not None
+                    else "ok", span=root)
         tracer.finish(root)
 
 
@@ -836,6 +861,13 @@ def register_search_actions(node, c):
                     "indices:data/read/msearch",
                     description=f"indices[{expr}][{len(bodies)}]",
                     cancellable=True)
+                # envelope lifecycle (telemetry/lifecycle.py): one
+                # timeline for the whole envelope — its coalesce/
+                # dispatch/collect events come from the wave engine; the
+                # admit event records the batch admission split
+                flight = TELEMETRY.flight
+                tl = flight.timeline()
+                t_admit = time.monotonic() if tl is not None else 0.0
                 # batch-aware admission: the backpressure gate admits as
                 # many sub-requests as capacity allows; OVERFLOW items
                 # reject with per-item 429 error objects instead of
@@ -843,6 +875,12 @@ def register_search_actions(node, c):
                 # acquire and the try — release_batch lives in finally.
                 admitted = node.search_backpressure.acquire_batch(
                     len(bodies))
+                tl_prev = None
+                if tl is not None:
+                    tl.queue_wait((time.monotonic() - t_admit) * 1000)
+                    tl.event("admit", admitted=admitted,
+                             rejected=len(bodies) - admitted)
+                    tl_prev = flight.bind(tl)
                 try:
                     if admitted == len(bodies):
                         res = node.indices.get(names[0]).multi_search(
@@ -866,6 +904,20 @@ def register_search_actions(node, c):
                 finally:
                     node.task_manager.unregister(task)
                     node.search_backpressure.release_batch(admitted)
+                    if tl is not None:
+                        flight.unbind(tl_prev)
+                        tl.event("respond")
+                        # the envelope's ONE timeline attaches to the
+                        # FIRST sub-request's span: the per-wave
+                        # coalesce/dispatch/collect/overlap events must
+                        # reach a trace (tools/trace_report.py's wave
+                        # pipeline table) on the real msearch path, and
+                        # duplicating the dict onto all B spans would
+                        # bloat the ring B-fold
+                        flight.complete(
+                            tl, status="error"
+                            if sys.exc_info()[0] is not None else "ok",
+                            span=spans[0] if spans else None)
                     for s in spans:
                         TELEMETRY.tracer.finish(s)
                 for r in res["responses"]:
@@ -2138,6 +2190,35 @@ def register_telemetry_actions(node, c):
         TELEMETRY.ledger.reset()
         return {"acknowledged": True}
 
+    def do_get_tail(req):
+        # the flight recorder's capture ring (telemetry/lifecycle.py):
+        # complete lifecycle timelines of requests that breached the SLO
+        # threshold or the live rolling p99 — tools/tail_report.py input
+        size = req.int_param("size", 0)
+        return {"enabled": TELEMETRY.flight.enabled,
+                "stats": TELEMETRY.flight.stats(),
+                "captured": TELEMETRY.flight.captured(size or None)}
+
+    def do_tail_enable(req):
+        thr = req.param("threshold_ms")
+        if thr is not None:
+            try:
+                TELEMETRY.flight.threshold_ms = float(thr)
+            except (TypeError, ValueError):
+                raise IllegalArgumentError(
+                    f"failed to parse [threshold_ms] with value [{thr!r}]")
+        TELEMETRY.flight.enabled = True
+        return {"acknowledged": True, "enabled": True,
+                "threshold_ms": TELEMETRY.flight.threshold_ms}
+
+    def do_tail_disable(req):
+        TELEMETRY.flight.enabled = False
+        return {"acknowledged": True, "enabled": False}
+
+    def do_tail_clear(req):
+        TELEMETRY.flight.clear()
+        return {"acknowledged": True}
+
     c.register("GET", "/_telemetry/traces", do_get_traces)
     c.register("POST", "/_telemetry/traces/_clear", do_clear_traces)
     c.register("POST", "/_telemetry/_enable", do_enable)
@@ -2149,6 +2230,10 @@ def register_telemetry_actions(node, c):
     c.register("POST", "/_telemetry/transfers/_disable",
                do_transfers_disable)
     c.register("POST", "/_telemetry/transfers/_clear", do_transfers_clear)
+    c.register("GET", "/_telemetry/tail", do_get_tail)
+    c.register("POST", "/_telemetry/tail/_enable", do_tail_enable)
+    c.register("POST", "/_telemetry/tail/_disable", do_tail_disable)
+    c.register("POST", "/_telemetry/tail/_clear", do_tail_clear)
 
 
 # -------------------------------------------------------------------- tasks

@@ -256,6 +256,52 @@ def execute_search(executors: List, body: Optional[dict],
                    trace=None,
                    phase_times: Optional[dict] = None,
                    allow_partial: Optional[bool] = None) -> dict:
+    """Lifecycle wrapper around `_execute_search_impl` (which carries
+    the full contract docstring): when the flight recorder
+    (telemetry/lifecycle.py) is enabled and no request timeline is
+    bound yet — direct callers like IndexService.search, scroll,
+    reindex, tests — this opens one (admit at entry, respond at exit,
+    complete through the recorder's capture gate). REST-served requests
+    already carry a bound timeline with real admission events; the
+    wrapper passes straight through to keep one owner per request. The
+    disabled path is one attribute load and a branch."""
+    from opensearch_tpu.telemetry import TELEMETRY
+    flight = TELEMETRY.flight
+    tl = flight.timeline() \
+        if flight.enabled and flight.current() is None else None
+    if tl is None:
+        return _execute_search_impl(
+            executors, body, total_shards, failed_shards, extra_filters,
+            cursor_tiebreak, task, allow_envelope, phase_processors,
+            trace, phase_times, allow_partial)
+    tl.event("admit")
+    prev = flight.bind(tl)
+    status = "error"
+    try:
+        res = _execute_search_impl(
+            executors, body, total_shards, failed_shards, extra_filters,
+            cursor_tiebreak, task, allow_envelope, phase_processors,
+            trace, phase_times, allow_partial)
+        status = "ok"
+        return res
+    finally:
+        flight.unbind(prev)
+        tl.event("respond")
+        flight.complete(tl, status=status, span=trace)
+
+
+def _execute_search_impl(executors: List, body: Optional[dict],
+                         total_shards: Optional[int] = None,
+                         failed_shards: int = 0,
+                         extra_filters: Optional[List[Optional[dict]]]
+                         = None,
+                         cursor_tiebreak: Optional[Tuple[int, int, int]]
+                         = None,
+                         task=None, allow_envelope: bool = False,
+                         phase_processors: Optional[dict] = None,
+                         trace=None,
+                         phase_times: Optional[dict] = None,
+                         allow_partial: Optional[bool] = None) -> dict:
     """Run the full query-then-fetch flow over shard executors and render
     the search response. `executors` are per-shard SearchExecutors;
     `extra_filters` (aligned with executors) carry per-index alias filters;
@@ -289,6 +335,14 @@ def execute_search(executors: List, body: Optional[dict],
     from opensearch_tpu.telemetry import NOOP_SPAN, TELEMETRY
     if trace is None:
         trace = NOOP_SPAN
+    if TELEMETRY.flight.enabled:
+        # lifecycle: whatever wall accumulated between the request's
+        # arrival (REST entry / wrapper) and this engine entry becomes
+        # the `route` phase — pipeline resolution, plumbing, and the
+        # GIL starvation a contended node inflicts right here
+        _tl_route = TELEMETRY.flight.current()
+        if _tl_route is not None:
+            _tl_route.route()
     body = body or {}
     _validate_search_body_keys(body)
     # per-request transfer accounting (telemetry/ledger.py): None unless
@@ -320,6 +374,10 @@ def execute_search(executors: List, body: Optional[dict],
                 allow_partial=_resolve_allow_partial(body, allow_partial),
                 ledger_scope=req_scope)
         _publish_scope(req_scope, hq, phase_times)
+        if TELEMETRY.flight.enabled:
+            tl = TELEMETRY.flight.current()
+            if tl is not None and req_scope is not None:
+                tl.merge_phases({"device_get": req_scope.device_get_ms})
         return res
     if (allow_envelope and len(executors) == 1 and total_shards is None
             and failed_shards == 0 and cursor_tiebreak is None
@@ -762,6 +820,19 @@ def execute_search(executors: List, body: Optional[dict],
     # root-span + slow-log transfer attribution for the general host-loop
     # path (the envelope and hybrid paths publish their own above)
     _publish_scope(req_scope, trace, phase_times)
+    if TELEMETRY.flight.enabled:
+        # lifecycle phase decomposition (telemetry/lifecycle.py): the
+        # request's timeline carries the same per-phase wall the metrics
+        # histograms record, so a captured slow request explains its own
+        # took. device_get is the ledger's sub-attribution of `query`
+        # (tools/tail_report.py knows not to double-count it).
+        tl = TELEMETRY.flight.current()
+        if tl is not None:
+            tl.merge_phases({name: ns / 1e6
+                             for name, ns in phases.items()})
+            if req_scope is not None:
+                tl.merge_phases({"device_get": req_scope.device_get_ms})
+            tl.mark_ready()
     if profiling:
         # per-shard per-phase breakdown: coordinator phases (parse,
         # can_match, reduce, fetch, render) are shared across shards,

@@ -63,6 +63,7 @@ MISSING_KEY = np.float32(-1e30)
 # load, the tracer/fault-injector no-op discipline
 _LEDGER = TELEMETRY.ledger
 _DEVMEM = TELEMETRY.device_memory
+_FLIGHT = TELEMETRY.flight
 
 # live ShardReaders, sampled by the corpus-columns memory gauge: weak
 # refs so a dropped reader (closed index, finished test) leaves the
@@ -414,7 +415,8 @@ class _MsearchWave:
 
     __slots__ = ("kind", "items", "payload", "state", "scope", "ph",
                  "raise_errors", "window", "prep_t0", "prep_t1",
-                 "collect_t0", "collect_t1", "error")
+                 "collect_t0", "collect_t1", "error", "index",
+                 "timeline")
 
     def __init__(self, kind: str, items: List[int], payload,
                  raise_errors: bool = False):
@@ -429,6 +431,10 @@ class _MsearchWave:
         self.prep_t0 = self.prep_t1 = 0.0
         self.collect_t0 = self.collect_t1 = 0.0
         self.error: Optional[Exception] = None
+        self.index = 0              # envelope-local wave id (0-based)
+        self.timeline = None        # request Timeline (or None) — rides
+        # the wave record across the collector-thread boundary so the
+        # collect event lands on the owning request's lifecycle
 
 
 class _WaveCollector:
@@ -1811,10 +1817,54 @@ class SearchExecutor:
         bytes_to_device/bytes_fetched/transfers land on the span when it
         records, device_get/bytes_fetched in phase_times for the
         caller's slow log (both only when the ledger or tracing is on;
-        see telemetry/ledger.py's no-op discipline)."""
+        see telemetry/ledger.py's no-op discipline).
+
+        Request lifecycle (telemetry/lifecycle.py): when the flight
+        recorder is on and no timeline is bound (direct callers —
+        bench, warmup, tests), this wrapper owns one for the envelope
+        and completes it on EVERY exit, error paths included (a
+        cancelled/faulted envelope must still be capture-eligible);
+        REST/controller-owned requests pass straight through to the
+        impl, which rides the bound timeline."""
+        if not _FLIGHT.enabled or _FLIGHT.current() is not None:
+            return self._multi_search_impl(
+                bodies, _bypass_request_cache, _raise_item_errors, task,
+                deadline, trace, phase_times, waves)
+        tl = _FLIGHT.timeline()
+        if tl is None:      # disabled race: behave as the gate said
+            return self._multi_search_impl(
+                bodies, _bypass_request_cache, _raise_item_errors, task,
+                deadline, trace, phase_times, waves)
+        tl.event("admit")
+        prev = _FLIGHT.bind(tl)
+        status = "error"
+        try:
+            res = self._multi_search_impl(
+                bodies, _bypass_request_cache, _raise_item_errors, task,
+                deadline, trace, phase_times, waves)
+            status = "ok"
+            return res
+        finally:
+            _FLIGHT.unbind(prev)
+            tl.event("respond")
+            _FLIGHT.complete(tl, status=status, span=trace)
+
+    def _multi_search_impl(self, bodies: List[dict],
+                           _bypass_request_cache: bool = False,
+                           _raise_item_errors: bool = False,
+                           task=None, deadline: Optional[float] = None,
+                           trace=None,
+                           phase_times: Optional[dict] = None,
+                           waves: Optional[int] = None) -> dict:
         TELEMETRY.metrics.counter("msearch.requests").inc()
         TELEMETRY.metrics.counter("msearch.bodies").inc(len(bodies))
         scope = _LEDGER.scope(trace)
+        # the request's lifecycle timeline, bound by whoever owns it
+        # (REST / controller / the multi_search wrapper above).
+        # Disabled: one attribute load + branch.
+        tl = _FLIGHT.current() if _FLIGHT.enabled else None
+        if tl is not None:
+            tl.route()      # arrive→envelope-entry gap becomes `route`
         start = time.monotonic()
         if task is not None:
             task.check_cancelled()
@@ -1878,7 +1928,7 @@ class SearchExecutor:
                 wave_list, responses, start, ph, task=task,
                 deadline=deadline, scope=scope,
                 resp_cache_keys=resp_cache_keys,
-                allow_pipeline=allow_pipeline)
+                allow_pipeline=allow_pipeline, timeline=tl)
         # parse always runs; the wave phases only get a sample when a
         # batched wave actually executed — otherwise every all-general or
         # all-hybrid envelope would log spurious 0-ms device_get/respond
@@ -1897,6 +1947,25 @@ class SearchExecutor:
             # accounting lived only in the general path's single-branch
             # sum)
             scope.publish(trace, phase_times)
+        if tl is not None:
+            # the envelope's phase decomposition lands on the request's
+            # lifecycle (parse/compile_group/stack_pack_dispatch/
+            # device_get/respond are disjoint, so a captured slow
+            # envelope explains its own took — tools/tail_report.py).
+            # `coordinate` is the controller's `render` catch-all
+            # analog: everything inside the envelope the five phase
+            # timers don't bracket (wave splitting, scope/gauge
+            # bookkeeping, collector handoff) — without it a slow
+            # envelope under GIL contention leaves its glue time
+            # unattributed. max(0): pipelined waves' phases overlap
+            # wall-clock, so their sum can exceed the envelope wall.
+            ph_ms = {name: sec * 1000.0 for name, sec in ph.items()}
+            glue = (time.monotonic() - start) * 1000.0 \
+                - sum(ph_ms.values())
+            if glue > 0:
+                ph_ms["coordinate"] = glue
+            tl.merge_phases(ph_ms)
+            tl.mark_ready()
         return {"took": int((time.monotonic() - start) * 1000),
                 "responses": responses}
 
@@ -1904,7 +1973,8 @@ class SearchExecutor:
                            start: float, ph: dict, task=None,
                            deadline: Optional[float] = None, scope=None,
                            resp_cache_keys: Optional[dict] = None,
-                           allow_pipeline: bool = True) -> None:
+                           allow_pipeline: bool = True,
+                           timeline=None) -> None:
         """Drive the wave engine: prepare + async-dispatch each wave on
         THIS thread, collect on the collector thread (bounded in-flight
         window), and merge per-wave phase times, ledger scopes and
@@ -1928,7 +1998,9 @@ class SearchExecutor:
             MSEARCH_INFLIGHT_WINDOW) if pipelined else None
         dispatched: List[_MsearchWave] = []
         try:
-            for wave in wave_list:
+            for wave_idx, wave in enumerate(wave_list):
+                wave.index = wave_idx
+                wave.timeline = timeline
                 if task is not None:
                     task.check_cancelled()
                 if deadline is not None and time.monotonic() > deadline:
@@ -1936,6 +2008,13 @@ class SearchExecutor:
                         if responses[i] is None:
                             responses[i] = _timed_out_item(start)
                     continue
+                if timeline is not None:
+                    # coalesce: which wave this request's items ride and
+                    # with how many co-batched siblings — the field the
+                    # item-2 scheduler fills with cross-request counts
+                    timeline.event("coalesce", wave=wave_idx,
+                                   co_batched=len(wave.items),
+                                   kind=wave.kind)
                 if collector is not None:
                     # bounded in-flight window: block until a slot frees
                     # BEFORE compiling/dispatching the next wave
@@ -1960,6 +2039,9 @@ class SearchExecutor:
                 _DEVMEM.adjust("wave_buffers",
                                wave.state.get("wave_buffer_bytes", 0))
                 _LEDGER.note_wave_inflight(+1)
+                if timeline is not None:
+                    timeline.event("dispatch", wave=wave_idx,
+                                   inflight=_LEDGER.inflight_waves())
                 dispatched.append(wave)
                 if collector is None:
                     if task is not None:
@@ -2003,6 +2085,11 @@ class SearchExecutor:
                     for c0, c1 in collects)
                 _LEDGER.note_overlap(overlap_s * 1000.0,
                                      scope=wave.scope)
+                if timeline is not None:
+                    # per-wave overlap as a lifecycle event: what
+                    # tools/trace_report.py's pipeline table reads
+                    timeline.event("overlap", wave=wave.index,
+                                   ms=round(overlap_s * 1000.0, 3))
             if wave.collect_t1:
                 collects.append((wave.collect_t0, wave.collect_t1))
             if wave.scope is not None and scope is not None:
@@ -2035,6 +2122,16 @@ class SearchExecutor:
             wave.error = e
         finally:
             wave.collect_t1 = time.monotonic()
+            if wave.timeline is not None:
+                # collect lands on the owning request's lifecycle from
+                # THIS thread (appends are GIL-atomic; the timeline is
+                # only read after the pipeline drains)
+                wave.timeline.event(
+                    "collect", wave=wave.index,
+                    ms=round((wave.collect_t1 - wave.collect_t0) * 1000,
+                             3),
+                    device_get_ms=round(wave.scope.device_get_ms, 3)
+                    if wave.scope is not None else None)
             state = wave.state or {}
             _release_wave_gauges(state)
             # collect done ⇒ the device program finished reading its

@@ -21,12 +21,18 @@ node needs: one `TELEMETRY` singleton (the same pattern as
   - `TELEMETRY.device_memory` — live-bytes gauges per device-memory
     class (corpus columns, interned bundles, in-flight wave buffers,
     ...) plus raw backend `memory_stats()` — the HBM analog of the
-    reference's JVM mem stats on `_nodes/stats`.
+    reference's JVM mem stats on `_nodes/stats`;
+  - `TELEMETRY.flight` — the request-lifecycle flight recorder
+    (telemetry/lifecycle.py): per-request arrive/admit/queue_wait/
+    coalesce/dispatch/collect/respond timelines with SLO-breach tail
+    capture, OFF by default with the same no-op gate discipline, served
+    by `GET /_telemetry/tail`.
 
 Node wires it from settings (`telemetry.tracing.enabled`,
 `telemetry.tracing.ring_size`, `telemetry.tracing.jsonl`,
-`telemetry.transfers.enabled`) and the data dir (`_state/traces.jsonl`);
-tests and bench.py drive it directly.
+`telemetry.transfers.enabled`, `telemetry.tail.enabled`,
+`telemetry.tail.threshold_ms`) and the data dir (`_state/traces.jsonl`,
+`_state/tail.jsonl`); tests and bench.py drive it directly.
 """
 
 from __future__ import annotations
@@ -36,6 +42,7 @@ from typing import Optional
 
 from opensearch_tpu.telemetry.ledger import (
     DeviceMemoryAccounting, LedgerScope, TransferLedger)
+from opensearch_tpu.telemetry.lifecycle import FlightRecorder, Timeline
 from opensearch_tpu.telemetry.metrics import MetricsRegistry
 from opensearch_tpu.telemetry.rolling import RollingEstimator
 from opensearch_tpu.telemetry.tracer import (
@@ -43,36 +50,44 @@ from opensearch_tpu.telemetry.tracer import (
 
 __all__ = ["TELEMETRY", "TelemetryService", "Span", "NOOP_SPAN",
            "MetricsRegistry", "Tracer", "TransferLedger", "LedgerScope",
-           "DeviceMemoryAccounting", "RollingEstimator"]
+           "DeviceMemoryAccounting", "RollingEstimator",
+           "FlightRecorder", "Timeline"]
 
 
 class TelemetryService:
-    """Tracer + metrics + transfer ledger + device-memory accounting
-    under one configuration surface."""
+    """Tracer + metrics + transfer ledger + device-memory accounting +
+    lifecycle flight recorder under one configuration surface."""
 
     def __init__(self):
         self.tracer = Tracer()
         self.metrics = MetricsRegistry()
         self.ledger = TransferLedger()
         self.device_memory = DeviceMemoryAccounting()
+        self.flight = FlightRecorder()
 
     def configure(self, data_path: Optional[str] = None,
                   enabled: bool = False, jsonl: bool = False,
                   ring_size: int = DEFAULT_RING_SIZE,
-                  transfers: bool = False) -> None:
+                  transfers: bool = False, tail: bool = False,
+                  tail_threshold_ms: Optional[float] = None) -> None:
         """Bind to a node's settings/data dir. Called from Node.__init__;
         re-configuration by a later Node in the same process wins (the
         singleton is process-wide, like WARMUP)."""
         self.tracer.enabled = bool(enabled)
         self.ledger.enabled = bool(transfers)
+        self.flight.enabled = bool(tail)
+        self.flight.threshold_ms = tail_threshold_ms
         self.tracer.resize(ring_size)
         self.tracer.jsonl_path = None
+        self.flight.jsonl_path = None
         if jsonl and data_path is not None:
             state_dir = os.path.join(data_path, "_state")
             try:
                 os.makedirs(state_dir, exist_ok=True)
                 self.tracer.jsonl_path = os.path.join(state_dir,
                                                       "traces.jsonl")
+                self.flight.jsonl_path = os.path.join(state_dir,
+                                                      "tail.jsonl")
             except OSError:
                 pass
 
@@ -86,7 +101,8 @@ class TelemetryService:
         return {"tracing": self.tracer.stats(),
                 "metrics": self.metrics.to_dict(),
                 "transfers": self.ledger.snapshot(),
-                "device_memory": self.device_memory.stats()}
+                "device_memory": self.device_memory.stats(),
+                "tail": self.flight.stats()}
 
 
 # process-wide singleton, like REQUEST_CACHE / QUERY_CACHE / WARMUP

@@ -1,0 +1,291 @@
+"""Request lifecycle timeline + tail-latency flight recorder.
+
+ROADMAP item 2 (cross-request dynamic batching) needs to be judged
+against numbers, and the numbers that matter under contention are
+per-request *when-did-you-wait* numbers: how long a request queued, which
+device wave it shared with how many co-batched siblings, and where the
+p99.9 outliers actually spent their wall. Nothing in the repo could see
+any of that — the tracer times phases, the ledger counts bytes, but
+neither records the request's *schedule*. This module is that contract:
+
+- `Timeline` — one request's monotonic-timestamped lifecycle events:
+  `arrive` (implicit at construction), `admit`/`reject`, `queue_wait`
+  (how long admission held the request; the future wave scheduler fills
+  this with real queue delay — today the backpressure gate admits
+  immediately, so it reads ~0), `coalesce` (wave id + co-batched
+  request count), `dispatch` (wave id + in-flight pipeline depth),
+  `collect`, `overlap` (per-wave dispatch/collect overlap, the PR 9
+  pipeline win) and `respond`. Phase milliseconds (the controller's
+  phase dict / the msearch envelope's ph map) merge in so a completed
+  timeline decomposes its own wall. Completed timelines attach to the
+  request's root span as the `lifecycle` attribute.
+
+- `FlightRecorder` — the tail-latency capture ring: a completed
+  timeline is retained when the request breached an explicit SLO
+  threshold (`threshold_ms`) or beat the LIVE rolling p99 of recent
+  takes (telemetry/rolling.py, min_samples warmup so the first requests
+  don't all self-trigger). Served by `GET /_telemetry/tail`, togglable
+  via `POST /_telemetry/tail/_enable|_disable|_clear`, optional JSONL
+  export under `_state/tail.jsonl`, rendered by tools/tail_report.py.
+
+No-op discipline (the tracer/ledger/faults contract, statically enforced
+by gate-lint's subsystem registry and asserted by bench.py): the
+recorder is OFF by default and the hot-path gate is `timeline()`
+returning None — one attribute load and a branch, nothing else runs.
+Event appends are plain list appends (GIL-atomic): a timeline is written
+by at most the request thread + the wave collector thread, and only read
+after the pipeline drained.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from opensearch_tpu.telemetry.rolling import RollingEstimator
+
+DEFAULT_TAIL_RING = 64
+
+# the lifecycle event vocabulary (README Observability documents each)
+EVENTS = ("arrive", "admit", "reject", "queue_wait", "coalesce",
+          "dispatch", "collect", "overlap", "respond")
+
+# phase_times carries non-time fields next to the millisecond ones
+# (LedgerScope.publish writes bytes/waves into the same dict the slow
+# log reads); a timeline's phase map keeps only durations
+_NON_TIME_PHASES = frozenset({"bytes_fetched", "bytes_to_device",
+                              "waves"})
+
+
+class Timeline:
+    """One request's lifecycle: monotonic event offsets + phase times.
+
+    `t_arrive` anchors every event at construction time; offsets are
+    milliseconds since arrival, so a dumped timeline reads as the
+    request's own clock. `queue_wait_ms` is a first-class field (not
+    just an event) because it is THE number the wave-scheduler's
+    admission work will be judged by."""
+
+    __slots__ = ("t_arrive", "t_ready", "events", "phases",
+                 "queue_wait_ms", "took_ms", "status")
+
+    def __init__(self):
+        self.t_arrive = time.monotonic()
+        self.t_ready: Optional[float] = None
+        # (event name, ms since arrive, extra fields or None)
+        self.events: List[Tuple[str, float, Optional[dict]]] = [
+            ("arrive", 0.0, None)]
+        self.phases: Dict[str, float] = {}
+        self.queue_wait_ms = 0.0
+        self.took_ms: Optional[float] = None
+        self.status = "ok"
+
+    def event(self, name: str, **fields) -> None:
+        self.events.append(
+            (name, round((time.monotonic() - self.t_arrive) * 1000, 3),
+             fields or None))
+
+    def queue_wait(self, ms: float) -> None:
+        """Time the request spent waiting for admission/scheduling —
+        measured by whoever held it (the backpressure gate today, the
+        wave scheduler's queue tomorrow)."""
+        self.queue_wait_ms += ms
+        self.event("queue_wait", ms=round(ms, 3))
+
+    def route(self) -> None:
+        """Attribute the so-far-unexplained arrive→now interval as the
+        `route` phase: REST glue, pipeline resolution and parse/
+        validation plumbing between a request's arrival and the phase-
+        timed engine taking over. Anchored on the arrive clock and
+        called at engine entry points (controller impl, msearch
+        envelope) — each call covers only the gap not yet explained by
+        queue_wait + recorded phases, so the calls compose and a slow
+        request's pre-engine wall (GIL starvation under concurrent
+        clients lives exactly here) stops reading as unattributed."""
+        gap = (time.monotonic() - self.t_arrive) * 1000 \
+            - self.queue_wait_ms - sum(self.phases.values())
+        if gap > 0:
+            self.phases["route"] = self.phases.get("route", 0.0) + gap
+
+    def mark_ready(self) -> None:
+        """Stamp the response-assembled instant. `complete()` turns the
+        ready→completed interval into the `handoff` phase: coordinator
+        exit glue + response processors + GIL/scheduler starvation on
+        the way out. Under N concurrent clients this is real, otherwise
+        invisible wall (a slow request can spend tens of ms here), and
+        it is measured from two clock reads, never derived as a
+        remainder."""
+        self.t_ready = time.monotonic()
+        self.event("ready")
+
+    def merge_phases(self, phase_ms: Dict[str, float]) -> None:
+        """Accumulate per-phase milliseconds (controller phase dict or
+        msearch ph map); non-duration fields riding the same dict
+        (bytes, wave counts) are dropped."""
+        for name, ms in phase_ms.items():
+            if name in _NON_TIME_PHASES:
+                continue
+            self.phases[name] = self.phases.get(name, 0.0) + float(ms)
+
+    def to_dict(self) -> dict:
+        out: Dict[str, Any] = {
+            "status": self.status,
+            "took_ms": self.took_ms,
+            "queue_wait_ms": round(self.queue_wait_ms, 3),
+            "events": [
+                {"event": name, "t_ms": t, **(fields or {})}
+                for name, t, fields in self.events],
+        }
+        if self.phases:
+            out["phases"] = {name: round(ms, 3)
+                             for name, ms in self.phases.items()}
+        return out
+
+
+class FlightRecorder:
+    """Bounded ring of slow requests' complete timelines.
+
+    Capture policy (decided at `complete()`):
+      - `threshold_ms` set and took >= it  -> trigger "threshold";
+      - otherwise, once `min_samples` takes have been observed, took
+        above the LIVE rolling p99 of recent takes -> trigger "p99".
+    The rolling estimator decays (telemetry/rolling.py), so "p99" means
+    the p99 of the last few minutes of traffic, not since node start —
+    a latency regression shows up as captures within its half-life.
+    """
+
+    def __init__(self, ring_size: int = DEFAULT_TAIL_RING):
+        self.enabled = False
+        self.threshold_ms: Optional[float] = None
+        self.p99_trigger = True
+        self.min_samples = 32
+        self.took = RollingEstimator()
+        self._ring: "deque[dict]" = deque(maxlen=ring_size)
+        self._lock = threading.Lock()
+        self._io_lock = threading.Lock()
+        self.jsonl_path: Optional[str] = None
+        self.completed = 0
+        self.events_total = 0
+        self.captures = {"threshold": 0, "p99": 0}
+        self.export_errors = 0
+        self._tls = threading.local()
+
+    # ------------------------------------------------------------- hot path
+
+    def timeline(self) -> Optional[Timeline]:
+        """The per-request gate: a Timeline when the recorder is on,
+        else None — callers guard with `if tl is not None`, so the
+        disabled query path costs one attribute load and a branch."""
+        if not self.enabled:
+            return None
+        return Timeline()
+
+    def current(self) -> Optional[Timeline]:
+        """The thread's bound request timeline, if a caller bound one."""
+        return getattr(self._tls, "timeline", None)
+
+    def bind(self, tl: Optional[Timeline]) -> Optional[Timeline]:
+        """Bind a request's timeline to this thread (the REST layer owns
+        the request; the controller/executor read it back via
+        `current()`). Returns the previous binding for `unbind`."""
+        prev = getattr(self._tls, "timeline", None)
+        self._tls.timeline = tl
+        return prev
+
+    def unbind(self, prev: Optional[Timeline]) -> None:
+        self._tls.timeline = prev
+
+    def complete(self, tl: Timeline, status: str = "ok",
+                 span=None) -> Optional[str]:
+        """Close a request's timeline: stamp took, feed the live take
+        estimator, decide capture, attach to the root span. Returns the
+        capture trigger (or None). Idempotence is the caller's job
+        (guard on `tl.took_ms is None` when two exit paths can race)."""
+        tl.status = status
+        t_done = time.monotonic()
+        tl.took_ms = round((t_done - tl.t_arrive) * 1000, 3)
+        if tl.t_ready is not None:
+            handoff = (t_done - tl.t_ready) * 1000
+            if handoff > 0:
+                tl.phases["handoff"] = \
+                    tl.phases.get("handoff", 0.0) + handoff
+        trigger = None
+        thr = self.threshold_ms
+        if thr is not None and tl.took_ms >= thr:
+            trigger = "threshold"
+        elif self.p99_trigger:
+            # trigger reads the estimator BEFORE this sample lands, so
+            # one slow request cannot raise the bar it is judged against.
+            # warmup gates on LIFETIME completions (self.completed, not
+            # the estimator's decayed total): on a sparse-traffic node
+            # the decayed mass can sit below min_samples forever, which
+            # would silence the p99 trigger exactly where an explicit
+            # threshold is least likely to be configured
+            p99 = self.took.quantile(0.99)
+            if p99 is not None and self.completed >= self.min_samples \
+                    and tl.took_ms > p99:
+                trigger = "p99"
+        self.took.observe(tl.took_ms)
+        if span is not None and getattr(span, "recording", False):
+            span.set_attribute("lifecycle", tl.to_dict())
+        rec = None
+        with self._lock:
+            self.completed += 1
+            self.events_total += len(tl.events)
+            if trigger is not None:
+                rec = {"ts_ms": int(time.time() * 1000),
+                       "trigger": trigger, **tl.to_dict()}
+                self._ring.append(rec)
+                self.captures[trigger] += 1
+        if rec is not None and self.jsonl_path is not None:
+            line = json.dumps(rec, default=str) + "\n"
+            try:
+                with self._io_lock, open(self.jsonl_path, "a") as f:
+                    f.write(line)
+            except OSError:
+                self.export_errors += 1
+        return trigger
+
+    # --------------------------------------------------------------- reading
+
+    def captured(self, size: Optional[int] = None) -> List[dict]:
+        """Most-recent-first dump of the capture ring."""
+        with self._lock:
+            out = list(self._ring)
+        out.reverse()
+        return out[:size] if size is not None else out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.completed = 0
+            self.events_total = 0
+            self.captures = {"threshold": 0, "p99": 0}
+        self.took.reset()
+
+    def resize(self, ring_size: int) -> None:
+        with self._lock:
+            self._ring = deque(self._ring, maxlen=max(int(ring_size), 1))
+
+    def stats(self) -> dict:
+        with self._lock:
+            retained = len(self._ring)
+            maxlen = self._ring.maxlen
+            completed = self.completed
+            events_total = self.events_total
+            captures = dict(self.captures)
+        return {"enabled": self.enabled,
+                "threshold_ms": self.threshold_ms,
+                "p99_trigger": self.p99_trigger,
+                "min_samples": self.min_samples,
+                "completed": completed,
+                "events_total": events_total,
+                "captured": retained,
+                "captures": captures,
+                "ring_size": maxlen,
+                "jsonl_path": self.jsonl_path,
+                "export_errors": self.export_errors,
+                "took_rolling": self.took.summary()}

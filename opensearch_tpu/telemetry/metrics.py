@@ -28,18 +28,22 @@ DEFAULT_BUCKETS_MS: Tuple[float, ...] = (
 
 
 class Counter:
-    """A monotonically increasing named count."""
+    """A monotonically increasing named count. Lock-guarded: `value +=`
+    is a read-modify-write the interpreter can interleave, and the
+    concurrent-clients serving path (bench.py --clients, ROADMAP item 2)
+    drives these from N threads — a drifting counter reads as a lost
+    request (tests/test_rolling_concurrent.py pins exactness)."""
 
-    __slots__ = ("name", "value")
+    __slots__ = ("name", "value", "_lock")
 
     def __init__(self, name: str):
         self.name = name
         self.value = 0
+        self._lock = threading.Lock()
 
     def inc(self, n: int = 1) -> None:
-        # int += under the GIL; metrics tolerate the (rare, bounded)
-        # lost-update race — same stance as RequestCache.hits
-        self.value += n
+        with self._lock:
+            self.value += n
 
 
 class Histogram:
@@ -51,7 +55,7 @@ class Histogram:
     — the read the wave scheduler (ROADMAP item 2) budgets against."""
 
     __slots__ = ("name", "buckets", "counts", "count", "sum", "min",
-                 "max", "rolling")
+                 "max", "rolling", "_lock")
 
     def __init__(self, name: str,
                  buckets: Optional[Tuple[float, ...]] = None):
@@ -64,19 +68,25 @@ class Histogram:
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self.rolling = RollingEstimator()
+        # concurrent writers (the N-client serving path) must not lose
+        # observations: count/sum are read-modify-write races unguarded.
+        # Reads (percentile/to_dict) stay lock-free — estimates tolerate
+        # a torn snapshot, the ingest path does not.
+        self._lock = threading.Lock()
 
     def observe(self, value_ms: float) -> None:
         i = 0
         n = len(self.buckets)
         while i < n and value_ms > self.buckets[i]:
             i += 1
-        self.counts[i] += 1
-        self.count += 1
-        self.sum += value_ms
-        if self.min is None or value_ms < self.min:
-            self.min = value_ms
-        if self.max is None or value_ms > self.max:
-            self.max = value_ms
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.sum += value_ms
+            if self.min is None or value_ms < self.min:
+                self.min = value_ms
+            if self.max is None or value_ms > self.max:
+                self.max = value_ms
         self.rolling.observe(value_ms)
 
     def percentile(self, p: float) -> Optional[float]:
@@ -158,11 +168,13 @@ class MetricsRegistry:
         survive a reset."""
         with self._lock:
             for c in self._counters.values():
-                c.value = 0
+                with c._lock:
+                    c.value = 0
             for h in self._histograms.values():
-                h.counts = [0] * (len(h.buckets) + 1)
-                h.count = 0
-                h.sum = 0.0
-                h.min = None
-                h.max = None
+                with h._lock:
+                    h.counts = [0] * (len(h.buckets) + 1)
+                    h.count = 0
+                    h.sum = 0.0
+                    h.min = None
+                    h.max = None
                 h.rolling.reset()

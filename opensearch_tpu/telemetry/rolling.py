@@ -21,12 +21,19 @@ Design constraints (the ROADMAP item-2 wave scheduler is the consumer):
   winning bucket); convergence against an offline numpy percentile is
   pinned in tests/test_transfer_ledger.py.
 
-Thread-safety follows the registry's stance: float increments under the
-GIL may rarely lose an update; estimates tolerate it.
+Thread-safety: `observe`/`quantile`/`reset` are lock-guarded. The old
+"lost float increments under the GIL are tolerable" stance broke once
+the decay path existed — two threads entering `_maybe_decay` in the
+same interval would BOTH scale the counts (a real distortion, not a
+lost sample), and the open-loop concurrent-clients bench (bench.py
+--clients) drives N writer threads through every estimator. The lock is
+uncontended in steady state and costs well under the per-observation
+bisect it guards (pinned by tests/test_rolling_concurrent.py).
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from bisect import bisect_left
 from typing import List, Optional, Tuple
@@ -58,7 +65,8 @@ class RollingEstimator:
     """
 
     __slots__ = ("bounds", "counts", "total", "half_life_s",
-                 "_decay_interval", "_last_decay", "max", "_clock")
+                 "_decay_interval", "_last_decay", "max", "_clock",
+                 "_lock")
 
     def __init__(self, half_life_s: Optional[float] = 300.0,
                  clock=time.monotonic):
@@ -72,6 +80,7 @@ class RollingEstimator:
         self._last_decay = clock()
         self.max: Optional[float] = None
         self._clock = clock
+        self._lock = threading.Lock()
 
     # ------------------------------------------------------------- recording
 
@@ -91,12 +100,13 @@ class RollingEstimator:
         self._last_decay = now
 
     def observe(self, value: float) -> None:
-        self._maybe_decay()
-        i = bisect_left(self.bounds, value)
-        self.counts[i] += 1.0
-        self.total += 1.0
-        if self.max is None or value > self.max:
-            self.max = value
+        with self._lock:
+            self._maybe_decay()
+            i = bisect_left(self.bounds, value)
+            self.counts[i] += 1.0
+            self.total += 1.0
+            if self.max is None or value > self.max:
+                self.max = value
 
     # --------------------------------------------------------------- reading
 
@@ -104,6 +114,10 @@ class RollingEstimator:
         """Estimated p-quantile of the decayed window; None when empty.
         Geometric interpolation inside the winning bucket; the overflow
         bucket reports the observed max."""
+        with self._lock:
+            return self._quantile_locked(p)
+
+    def _quantile_locked(self, p: float) -> Optional[float]:
         self._maybe_decay()
         total = self.total
         if total <= 0.0:
@@ -139,10 +153,11 @@ class RollingEstimator:
         }
 
     def reset(self) -> None:
-        self.counts = [0.0] * (len(self.bounds) + 1)
-        self.total = 0.0
-        self.max = None
-        self._last_decay = self._clock()
+        with self._lock:
+            self.counts = [0.0] * (len(self.bounds) + 1)
+            self.total = 0.0
+            self.max = None
+            self._last_decay = self._clock()
 
 
 def _round(v: Optional[float]) -> Optional[float]:

@@ -87,6 +87,73 @@ def test_improvement_is_ok(tmp_path):
     assert not failures
 
 
+CONC = [
+    {"mode": "bm25_openloop", "metric": "bm25_openloop_qps_100k_docs_8c_cpu",
+     "value": 400, "clients": 8, "arrival_rate": 400.0,
+     "p50_ms": 4.0, "p99_ms": 30.0, "p999_ms": 60.0,
+     "mean_queue_wait_ms": 1.5},
+]
+
+
+def test_warm_p99_field_resolution():
+    # explicit warm_p99_ms always wins
+    assert bench_compare.warm_p99({"warm_p99_ms": 12.0,
+                                   "p99_ms": 99.0}) == 12.0
+    # open-loop concurrent records (clients/arrival_rate) are warm by
+    # construction: bare p99_ms counts
+    assert bench_compare.warm_p99(CONC[0]) == 30.0
+    # cold-inclusive p99_ms on ordinary configs does NOT count
+    assert bench_compare.warm_p99({"p99_ms": 40.0}) is None
+
+
+def test_concurrent_p99_regression_fails(tmp_path):
+    new = [dict(CONC[0], p99_ms=40.0)]           # +33% tail, p50 flat
+    rows, failures = bench_compare.compare(
+        bench_compare.load_records(_write(tmp_path / "o.json", CONC)),
+        bench_compare.load_records(_write(tmp_path / "n.json", new)),
+        10.0)
+    assert len(failures) == 1 and "warm p99" in failures[0]
+    row = rows[0]
+    assert row["status"] == "REGRESSION"
+    assert row["p99_delta_pct"] > 30
+    assert row["old_warm_p99_ms"] == 30.0
+
+
+def test_warm_p99_gate_on_classic_configs(tmp_path):
+    """agg/hybrid records carrying warm_p99_ms gate on the tail too —
+    a p50-flat tail regression no longer slips through."""
+    old = [{"mode": "agg_terms", "warm_p50_ms": 10.0,
+            "warm_p99_ms": 20.0}]
+    new = [{"mode": "agg_terms", "warm_p50_ms": 10.0,
+            "warm_p99_ms": 40.0}]
+    _, failures = bench_compare.compare(
+        bench_compare.load_records(_write(tmp_path / "o.json", old)),
+        bench_compare.load_records(_write(tmp_path / "n.json", new)),
+        10.0)
+    assert len(failures) == 1 and "warm p99" in failures[0]
+
+
+def test_missing_p99_skips_tail_gate(tmp_path):
+    """Configs without a warm p99 on either side keep the p50-only
+    verdict (bench sets grow fields PR over PR)."""
+    new = [dict(r) for r in OLD]
+    rows, failures = bench_compare.compare(
+        bench_compare.load_records(_write(tmp_path / "o.json", OLD)),
+        bench_compare.load_records(_write(tmp_path / "n.json", new)),
+        10.0)
+    assert not failures
+    assert all("p99_delta_pct" not in r for r in rows)
+
+
+def test_concurrent_p99_within_threshold_ok(tmp_path):
+    new = [dict(CONC[0], p99_ms=32.0)]           # +6.7% < 10%
+    _, failures = bench_compare.compare(
+        bench_compare.load_records(_write(tmp_path / "o.json", CONC)),
+        bench_compare.load_records(_write(tmp_path / "n.json", new)),
+        10.0)
+    assert not failures
+
+
 def test_cli_exit_codes(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     tool = os.path.join(repo, "tools", "bench_compare.py")

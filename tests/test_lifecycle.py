@@ -1,0 +1,440 @@
+"""Request lifecycle timeline + flight recorder (telemetry/lifecycle.py):
+gate discipline, event emission from the controller and the wave engine,
+SLO-breach capture triggers, ring bounds, REST surface, and the span
+attachment contract (ISSUE 10)."""
+
+import json
+import threading
+
+import pytest
+
+from opensearch_tpu.telemetry import TELEMETRY
+from opensearch_tpu.telemetry.lifecycle import FlightRecorder, Timeline
+
+
+@pytest.fixture()
+def recorder():
+    """A fresh private recorder (unit tests never touch the singleton)."""
+    return FlightRecorder()
+
+
+@pytest.fixture()
+def flight_on():
+    """Enable the SINGLETON recorder in capture-all mode; restore after."""
+    fl = TELEMETRY.flight
+    fl.enabled = True
+    fl.threshold_ms = 0.0
+    fl.clear()
+    yield fl
+    fl.enabled = False
+    fl.threshold_ms = None
+    fl.clear()
+
+
+def _mk_executor(n_docs=400):
+    from opensearch_tpu.search.executor import SearchExecutor, ShardReader
+    from opensearch_tpu.utils.demo import build_shards
+    mapper, segments = build_shards(n_docs, n_shards=1, vocab_size=120,
+                                    avg_len=20, seed=3)
+    return SearchExecutor(ShardReader(mapper, segments))
+
+
+# ------------------------------------------------------------ gate discipline
+
+class TestGateDiscipline:
+    def test_disabled_timeline_gate_returns_none(self, recorder):
+        assert recorder.enabled is False
+        assert recorder.timeline() is None
+
+    def test_enabled_returns_timeline(self, recorder):
+        recorder.enabled = True
+        tl = recorder.timeline()
+        assert isinstance(tl, Timeline)
+        assert tl.events[0][0] == "arrive" and tl.events[0][1] == 0.0
+
+    def test_bind_current_unbind(self, recorder):
+        tl = Timeline()
+        assert recorder.current() is None
+        prev = recorder.bind(tl)
+        assert recorder.current() is tl
+        recorder.unbind(prev)
+        assert recorder.current() is None
+
+    def test_bind_is_per_thread(self, recorder):
+        tl = Timeline()
+        recorder.bind(tl)
+        seen = []
+        t = threading.Thread(target=lambda: seen.append(recorder.current()))
+        t.start()
+        t.join()
+        assert seen == [None]
+        recorder.unbind(None)
+
+    def test_disabled_executor_path_records_nothing(self, recorder):
+        ex = _mk_executor()
+        assert TELEMETRY.flight.enabled is False
+        ex.multi_search([{"query": {"match": {"body": "w1"}}, "size": 3}])
+        assert TELEMETRY.flight.stats()["completed"] == 0
+        assert TELEMETRY.flight.captured() == []
+
+
+# ---------------------------------------------------------------- the timeline
+
+class TestTimeline:
+    def test_event_offsets_are_monotonic(self):
+        tl = Timeline()
+        tl.event("admit")
+        tl.event("dispatch", wave=0, inflight=1)
+        offs = [t for _n, t, _f in tl.events]
+        assert offs == sorted(offs)
+        d = tl.to_dict()
+        assert d["events"][0] == {"event": "arrive", "t_ms": 0.0}
+        assert d["events"][2]["wave"] == 0
+
+    def test_queue_wait_accumulates(self):
+        tl = Timeline()
+        tl.queue_wait(2.5)
+        tl.queue_wait(1.5)
+        assert tl.queue_wait_ms == 4.0
+        assert [n for n, _t, _f in tl.events].count("queue_wait") == 2
+
+    def test_merge_phases_drops_non_time_fields(self):
+        tl = Timeline()
+        tl.merge_phases({"query": 5.0, "bytes_fetched": 9999,
+                         "bytes_to_device": 1234, "waves": 4,
+                         "device_get": 2.0})
+        assert tl.phases == {"query": 5.0, "device_get": 2.0}
+        tl.merge_phases({"query": 1.0})
+        assert tl.phases["query"] == 6.0
+
+    def test_mark_ready_feeds_handoff_phase(self, recorder):
+        recorder.enabled = True
+        tl = recorder.timeline()
+        tl.mark_ready()
+        tl.t_ready -= 0.05            # 50ms ago: a measured handoff gap
+        recorder.complete(tl)
+        assert tl.phases["handoff"] >= 50.0
+        assert any(n == "ready" for n, _t, _f in tl.events)
+
+
+# ------------------------------------------------------------ capture triggers
+
+class TestCaptureTriggers:
+    def test_threshold_trigger(self, recorder):
+        recorder.enabled = True
+        recorder.threshold_ms = 50.0
+        fast = recorder.timeline()
+        assert recorder.complete(fast) is None
+        slow = recorder.timeline()
+        slow.t_arrive -= 0.2          # simulate a 200ms request
+        assert recorder.complete(slow) == "threshold"
+        caps = recorder.captured()
+        assert len(caps) == 1 and caps[0]["trigger"] == "threshold"
+        assert caps[0]["took_ms"] >= 200.0
+
+    def test_p99_trigger_needs_min_samples(self, recorder):
+        recorder.enabled = True
+        for _ in range(5):
+            recorder.complete(recorder.timeline())
+        slow = recorder.timeline()
+        slow.t_arrive -= 0.2
+        # only 5 samples observed: the p99 trigger must stay quiet
+        assert recorder.complete(slow) is None
+
+    def test_p99_trigger_fires_after_warmup(self, recorder):
+        recorder.enabled = True
+        for _ in range(recorder.min_samples + 5):
+            recorder.complete(recorder.timeline())
+        slow = recorder.timeline()
+        slow.t_arrive -= 0.2
+        assert recorder.complete(slow) == "p99"
+        assert recorder.stats()["captures"]["p99"] == 1
+
+    def test_p99_warmup_survives_rolling_decay(self, recorder):
+        """Sparse-traffic regression: the warmup gate counts LIFETIME
+        completions, not the estimator's decayed mass — a quiet node
+        whose rolling total decayed below min_samples must still
+        capture a p99 breach."""
+        recorder.enabled = True
+        for _ in range(recorder.min_samples + 8):
+            recorder.complete(recorder.timeline())
+        # simulate a long quiet period: the decayed window mass drops
+        # far below min_samples while lifetime completions stand
+        with recorder.took._lock:
+            recorder.took.counts = [c * 0.1
+                                    for c in recorder.took.counts]
+            recorder.took.total *= 0.1
+        assert recorder.took.total < recorder.min_samples
+        slow = recorder.timeline()
+        slow.t_arrive -= 0.2
+        assert recorder.complete(slow) == "p99"
+
+    def test_ring_is_bounded_most_recent_first(self):
+        recorder = FlightRecorder(ring_size=4)
+        recorder.enabled = True
+        recorder.threshold_ms = 0.0
+        for i in range(10):
+            tl = recorder.timeline()
+            tl.event("dispatch", wave=i)
+            recorder.complete(tl)
+        caps = recorder.captured()
+        assert len(caps) == 4
+        waves = [c["events"][1]["wave"] for c in caps]
+        assert waves == [9, 8, 7, 6]
+        assert recorder.captured(2) == caps[:2]
+
+    def test_clear_resets_counters(self, recorder):
+        recorder.enabled = True
+        recorder.threshold_ms = 0.0
+        recorder.complete(recorder.timeline())
+        recorder.clear()
+        st = recorder.stats()
+        assert st["completed"] == 0 and st["captured"] == 0
+        assert st["captures"] == {"threshold": 0, "p99": 0}
+
+    def test_jsonl_export(self, tmp_path, recorder):
+        recorder.enabled = True
+        recorder.threshold_ms = 0.0
+        recorder.jsonl_path = str(tmp_path / "tail.jsonl")
+        recorder.complete(recorder.timeline())
+        lines = open(recorder.jsonl_path).read().strip().splitlines()
+        assert len(lines) == 1
+        assert json.loads(lines[0])["trigger"] == "threshold"
+
+    def test_span_attachment(self, recorder):
+        from opensearch_tpu.telemetry import NOOP_SPAN, Span
+        recorder.enabled = True
+        tl = recorder.timeline()
+        span = Span("rest.search")
+        recorder.complete(tl, span=span)
+        assert span.attributes["lifecycle"]["events"][0]["event"] \
+            == "arrive"
+        # a NOOP span absorbs the attach without recording
+        recorder.complete(recorder.timeline(), span=NOOP_SPAN)
+
+
+# --------------------------------------------------- wave-engine emission
+
+class TestWaveEngineEmission:
+    def test_envelope_timeline_events_and_phases(self, flight_on):
+        ex = _mk_executor()
+        bodies = [{"query": {"match": {"body": f"w{i % 7}"}}, "size": 3}
+                  for i in range(8)]
+        ex.multi_search(bodies)               # warm compile
+        flight_on.clear()
+        ex.multi_search(bodies, waves=2)
+        caps = flight_on.captured()
+        assert len(caps) == 1
+        rec = caps[0]
+        names = [e["event"] for e in rec["events"]]
+        assert names[0] == "arrive" and names[1] == "admit"
+        assert names[-1] == "respond"
+        assert names.count("coalesce") == 2       # two waves
+        assert names.count("dispatch") == 2
+        assert names.count("collect") == 2
+        # coalesce carries the wave id + co-batched sibling count
+        co = [e for e in rec["events"] if e["event"] == "coalesce"]
+        assert {c["wave"] for c in co} == {0, 1}
+        assert sum(c["co_batched"] for c in co) == 8
+        # dispatch carries the pipeline depth gauge
+        assert all(e["inflight"] >= 1 for e in rec["events"]
+                   if e["event"] == "dispatch")
+        # the envelope's disjoint phase decomposition rode along
+        for phase in ("parse", "device_get", "respond"):
+            assert phase in rec["phases"], rec["phases"]
+        assert rec["took_ms"] > 0
+
+    def test_controller_general_path_phases(self, flight_on):
+        from opensearch_tpu.search.controller import execute_search
+        ex = _mk_executor()
+        # a field sort is not envelope-batchable: the request takes the
+        # general per-shard host loop, whose controller phases must ride
+        body = {"query": {"match": {"body": "w1"}}, "size": 3,
+                "sort": [{"views": "asc"}]}
+        execute_search([ex], body, allow_envelope=True)
+        caps = flight_on.captured()
+        assert caps, "general-path request must complete a timeline"
+        rec = caps[0]
+        names = [e["event"] for e in rec["events"]]
+        assert names[0] == "arrive" and "admit" in names \
+            and names[-1] == "respond"
+        for phase in ("parse", "query", "reduce", "render"):
+            assert phase in rec["phases"], rec["phases"]
+
+    def test_b1_envelope_delegation_single_owner(self, flight_on):
+        from opensearch_tpu.search.controller import execute_search
+        ex = _mk_executor()
+        body = {"query": {"match": {"body": "w1"}}, "size": 3}
+        execute_search([ex], dict(body), allow_envelope=True)  # warm
+        flight_on.clear()
+        execute_search([ex], dict(body), allow_envelope=True)
+        # exactly ONE timeline for the delegated request (the controller
+        # owns it; the envelope reuses the bound one)
+        assert flight_on.stats()["completed"] == 1
+        rec = flight_on.captured()[0]
+        names = [e["event"] for e in rec["events"]]
+        assert names.count("admit") == 1
+        assert names.count("respond") == 1
+        assert names.count("coalesce") == 1       # B=1: one wave
+        assert "device_get" in rec["phases"]
+
+    def test_owned_envelope_timeline_completes_on_error(self, flight_on):
+        """A direct multi_search call that RAISES (cancellation, raised
+        item error) must still complete its owned timeline — error
+        tails are the ones worth capturing."""
+        from opensearch_tpu.common.errors import OpenSearchTpuError
+        ex = _mk_executor()
+        flight_on.clear()
+        with pytest.raises(OpenSearchTpuError):
+            # negative size raises through _raise_item_errors
+            ex.multi_search([{"query": {"match": {"body": "w1"}},
+                              "size": -3}], _raise_item_errors=True)
+        assert flight_on.stats()["completed"] == 1
+        rec = flight_on.captured()[0]
+        assert rec["status"] == "error"
+        assert rec["events"][-1]["event"] == "respond"
+
+    def test_attribution_over_90pct_warm(self, flight_on):
+        """The acceptance property: a captured warm request's phases
+        explain >=90% of its took (tools/tail_report.py attribution)."""
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import tail_report
+        from opensearch_tpu.search.controller import execute_search
+        ex = _mk_executor()
+        bodies = [{"query": {"match": {"body": f"w{i % 5}"}}, "size": 3}
+                  for i in range(6)]
+        for b in bodies:
+            execute_search([ex], dict(b), allow_envelope=True)  # warm
+        flight_on.clear()
+        for b in bodies:
+            execute_search([ex], dict(b), allow_envelope=True)
+        for rec in flight_on.captured():
+            att = tail_report.attribution(rec)
+            assert att["attr_pct"] >= 90.0, (rec, att)
+
+
+# -------------------------------------------------------------- REST surface
+
+class TestRestSurface:
+    @pytest.fixture()
+    def node(self):
+        from opensearch_tpu.node import Node
+        n = Node()
+        n.request("PUT", "/lc", {"mappings": {"properties": {
+            "msg": {"type": "text"}}}})
+        for i in range(10):
+            n.request("PUT", f"/lc/_doc/{i}", {"msg": f"word{i % 3} x"})
+        n.request("POST", "/lc/_refresh")
+        yield n
+        TELEMETRY.flight.enabled = False
+        TELEMETRY.flight.threshold_ms = None
+        TELEMETRY.flight.clear()
+
+    def test_tail_endpoints_roundtrip(self, node):
+        out = node.request("GET", "/_telemetry/tail")
+        assert out["enabled"] is False and out["captured"] == []
+        out = node.request("POST", "/_telemetry/tail/_enable",
+                           threshold_ms=0)
+        assert out["enabled"] is True and out["threshold_ms"] == 0.0
+        node.request("POST", "/lc/_search",
+                     {"query": {"match": {"msg": "word1"}}})
+        out = node.request("GET", "/_telemetry/tail")
+        assert out["stats"]["completed"] >= 1
+        assert out["captured"], "threshold 0 must capture every request"
+        rec = out["captured"][0]
+        names = [e["event"] for e in rec["events"]]
+        assert "admit" in names and "queue_wait" in names
+        assert names[-1] == "respond"
+        assert node.request("POST", "/_telemetry/tail/_clear")[
+            "acknowledged"] is True
+        assert node.request("GET", "/_telemetry/tail")["captured"] == []
+        out = node.request("POST", "/_telemetry/tail/_disable")
+        assert out["enabled"] is False
+
+    def test_tail_enable_bad_threshold_400(self, node):
+        out = node.request("POST", "/_telemetry/tail/_enable",
+                           threshold_ms="nope")
+        assert out["_status"] == 400
+
+    def test_rejected_request_captures_reject_event(self, node):
+        node.request("POST", "/_telemetry/tail/_enable", threshold_ms=0)
+        limit = node.search_backpressure.max_concurrent
+        node.search_backpressure.max_concurrent = 0
+        try:
+            out = node.request("POST", "/lc/_search",
+                               {"query": {"match_all": {}}})
+            assert out["_status"] == 429
+        finally:
+            node.search_backpressure.max_concurrent = limit
+        caps = node.request("GET", "/_telemetry/tail")["captured"]
+        rejected = [c for c in caps if c["status"] == "rejected"]
+        assert rejected
+        assert any(e["event"] == "reject" for e in rejected[0]["events"])
+
+    def test_msearch_envelope_timeline(self, node):
+        node.request("POST", "/_telemetry/tail/_enable", threshold_ms=0)
+        lines = []
+        for i in range(4):
+            lines.append(json.dumps({"index": "lc"}))
+            lines.append(json.dumps(
+                {"query": {"match": {"msg": f"word{i % 3}"}}, "size": 2}))
+        node.handle("POST", "/_msearch", body="\n".join(lines) + "\n")
+        caps = node.request("GET", "/_telemetry/tail")["captured"]
+        env = [c for c in caps
+               if any(e["event"] == "admit" and "admitted" in e
+                      for e in c["events"])]
+        assert env, "the msearch fast path must complete a timeline"
+        admit = [e for e in env[0]["events"] if e["event"] == "admit"][0]
+        assert admit["admitted"] == 4 and admit["rejected"] == 0
+
+    def test_msearch_envelope_lifecycle_reaches_trace(self, node):
+        """The production multi-wave path must land its lifecycle on a
+        retained trace: with tracing + tail both on, an msearch
+        envelope's per-wave events attach to the first sub-request's
+        span and tools/trace_report.py renders its pipeline rows."""
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import trace_report
+        TELEMETRY.enable()
+        TELEMETRY.tracer.clear()
+        node.request("POST", "/_telemetry/tail/_enable", threshold_ms=0)
+        try:
+            lines = []
+            for i in range(3):
+                lines.append(json.dumps({"index": "lc"}))
+                lines.append(json.dumps(
+                    {"query": {"match": {"msg": f"word{i % 3}"}},
+                     "size": 2}))
+            node.handle("POST", "/_msearch",
+                        body="\n".join(lines) + "\n")
+            traces = [t["trace"] for t in TELEMETRY.tracer.traces()]
+            with_lc = [t for t in traces
+                       if "lifecycle" in (t.get("attributes") or {})]
+            assert with_lc, "envelope lifecycle never reached a span"
+            rows = trace_report.pipeline_rows(with_lc)
+            assert rows and rows[0]["co_batched"] == 3
+        finally:
+            TELEMETRY.disable()
+            TELEMETRY.tracer.clear()
+
+    def test_nodes_stats_has_tail_section(self, node):
+        stats = node.request("GET", "/_nodes/stats")
+        tel = next(iter(stats["nodes"].values()))["telemetry"]
+        assert "tail" in tel
+        assert tel["tail"]["enabled"] is False
+
+    def test_node_settings_wire_threshold(self, tmp_path):
+        from opensearch_tpu.node import Node
+        n = Node(settings={"telemetry.tail.enabled": "true",
+                           "telemetry.tail.threshold_ms": "125"})
+        try:
+            assert TELEMETRY.flight.enabled is True
+            assert TELEMETRY.flight.threshold_ms == 125.0
+        finally:
+            del n
+            TELEMETRY.configure()

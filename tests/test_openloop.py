@@ -1,0 +1,101 @@
+"""Open-loop concurrent-clients harness (tools/openloop.py): Poisson
+schedule determinism, digest shape, and the coordinated-omission
+property — a stalled server must charge every request it delayed, from
+the INTENDED arrival time, not just report its own service time
+(ISSUE 10 satellite; stall injected via common/faults.py `delay`)."""
+
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import openloop  # noqa: E402
+
+from opensearch_tpu.common import faults  # noqa: E402
+
+
+def test_poisson_schedule_seeded_and_monotonic():
+    a = openloop.poisson_schedule(100, rate=50.0, seed=7)
+    b = openloop.poisson_schedule(100, rate=50.0, seed=7)
+    c = openloop.poisson_schedule(100, rate=50.0, seed=8)
+    assert a == b and a != c
+    assert a == sorted(a) and all(t > 0 for t in a)
+    # mean inter-arrival ~ 1/rate (loose: 100 exponential draws)
+    assert 0.5 / 50.0 < a[-1] / 100 < 2.0 / 50.0
+
+
+def test_poisson_schedule_rejects_bad_rate():
+    with pytest.raises(ValueError):
+        openloop.poisson_schedule(10, rate=0.0)
+
+
+def test_run_open_loop_digest_shape():
+    res = openloop.run_open_loop(
+        lambda item: time.sleep(0.001), list(range(40)),
+        clients=4, arrival_rate=400.0, seed=1)
+    assert res["n_requests"] == 40 and res["errors"] == 0
+    assert res["qps"] > 0
+    assert res["p50_ms"] <= res["p99_ms"] <= res["p999_ms"] \
+        <= res["max_ms"]
+    assert len(res["latencies_ms"]) == 40
+    assert all(lat > 0 for lat in res["latencies_ms"])
+    assert res["mean_queue_wait_ms"] >= 0
+
+
+def test_serve_errors_counted_not_raised():
+    def serve(item):
+        if item % 2:
+            raise RuntimeError("boom")
+    res = openloop.run_open_loop(serve, list(range(10)), clients=2,
+                                 arrival_rate=1000.0, seed=2)
+    assert res["errors"] == 5
+
+
+def test_explicit_schedule_length_checked():
+    with pytest.raises(ValueError):
+        openloop.run_open_loop(lambda i: None, [1, 2, 3], clients=1,
+                               schedule=[0.0, 0.1])
+
+
+def test_coordinated_omission_p99_reflects_intended_arrival():
+    """The harness property ROADMAP item 2's acceptance rests on: with a
+    single injected 400ms stall (common/faults.py `delay` at
+    query.shard, skip=5 so request #6 hits it) on a 5ms-interval
+    schedule, the requests QUEUED BEHIND the stall record latencies
+    measured from their intended arrival — hundreds of ms — while their
+    own service time stays ~1ms. A closed-loop (service-time) view would
+    hide exactly this; the recorded p99 must not."""
+    faults.clear()
+    faults.install({"site": "query.shard", "kind": "delay",
+                    "delay_ms": 400, "skip": 5, "max_fires": 1,
+                    "seed": 0})
+
+    def serve(item):
+        if faults.ENABLED:
+            faults.fire("query.shard")
+        time.sleep(0.001)
+
+    try:
+        # fixed 5ms schedule (40 requests over 200ms): the stall spans
+        # ~80 intended arrivals' worth of schedule
+        sched = [0.005 * i for i in range(40)]
+        res = openloop.run_open_loop(serve, list(range(40)), clients=1,
+                                     schedule=sched)
+    finally:
+        faults.clear()
+    assert res["errors"] == 0
+    # the stall charged the queue it created: open-loop p99 sees it
+    assert res["p99_ms"] >= 250.0, res
+    # ... while per-request service time stayed fast for nearly all
+    # requests (only the stalled one served slowly)
+    assert res["service_p50_ms"] < 50.0, res
+    stalled_behind = [lat for lat in res["latencies_ms"]
+                      if lat >= 100.0]
+    assert len(stalled_behind) >= 10, \
+        "the backlog behind the stall must be charged, not omitted"
+    # queue wait is reported separately and shows the same backlog
+    assert res["max_queue_wait_ms"] >= 250.0

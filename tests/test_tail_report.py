@@ -1,0 +1,145 @@
+"""Tier-1 smoke test for tools/tail_report.py: the "where did p99 go"
+attribution table over flight-recorder capture dumps (JSONL export and
+the `GET /_telemetry/tail` response shape)."""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import tail_report  # noqa: E402
+
+TOOL = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "tail_report.py")
+
+
+def _envelope_capture(took=100.0):
+    """A msearch-envelope-path capture: disjoint phase set incl.
+    device_get as its own phase."""
+    return {"ts_ms": 1700000000000, "trigger": "p99", "status": "ok",
+            "took_ms": took, "queue_wait_ms": 2.0,
+            "events": [{"event": "arrive", "t_ms": 0.0},
+                       {"event": "respond", "t_ms": took}],
+            "phases": {"parse": 3.0, "compile_group": 10.0,
+                       "stack_pack_dispatch": 40.0, "device_get": 30.0,
+                       "respond": 5.0, "coordinate": 4.0,
+                       "handoff": 5.0}}
+
+
+def _controller_capture():
+    """A controller-path capture: device_get NESTED inside query (the
+    ledger sub-attribution) — it must not be double-counted."""
+    return {"trigger": "threshold", "status": "ok", "took_ms": 50.0,
+            "queue_wait_ms": 1.0,
+            "events": [{"event": "arrive", "t_ms": 0.0}],
+            "phases": {"parse": 2.0, "can_match": 1.0, "query": 30.0,
+                       "reduce": 5.0, "fetch": 6.0, "render": 4.0,
+                       "device_get": 25.0, "handoff": 1.0,
+                       "bytes_fetched": 91476, "waves": 4,
+                       "overlap_ms": 12.0}}
+
+
+def test_attribution_envelope_disjoint():
+    att = tail_report.attribution(_envelope_capture())
+    # queue 2 + 3+10+40+30+5+4+5 = 99 of 100
+    assert att["attributed_ms"] == 99.0
+    assert att["attr_pct"] == 99.0
+    assert att["buckets"]["device_get"] == 30.0
+    assert att["buckets"]["compile"] == 10.0
+    assert att["buckets"]["queue"] == 2.0
+    assert att["buckets"]["respond"] == 10.0      # respond + handoff
+    assert att["device_get_nested"] is False
+
+
+def test_attribution_controller_nested_device_get():
+    att = tail_report.attribution(_controller_capture())
+    # queue 1 + parse 2 + can_match 1 + query 30 + reduce 5 + fetch 6
+    # + render 4 + handoff 1 = 50; device_get shown but NOT summed;
+    # bytes/waves/overlap_ms never counted as durations
+    assert att["attributed_ms"] == 50.0
+    assert att["attr_pct"] == 100.0
+    assert att["device_get_nested"] is True
+    assert att["buckets"]["device_get"] == 25.0
+
+
+def test_attr_pct_clamped_and_zero_took():
+    rec = _envelope_capture(took=50.0)           # phases sum > took
+    assert tail_report.attribution(rec)["attr_pct"] == 100.0
+    assert tail_report.attribution(
+        {"took_ms": 0.0, "phases": {}})["attr_pct"] == 100.0
+
+
+def test_load_jsonl_and_rest_shapes(tmp_path):
+    p1 = tmp_path / "tail.jsonl"
+    with open(p1, "w") as f:
+        for _ in range(3):
+            f.write(json.dumps(_envelope_capture()) + "\n")
+        f.write('{"trigger": "p99", "took_')       # truncated tail line
+    assert len(tail_report.load_records(str(p1))) == 3
+
+    p2 = tmp_path / "tail.json"
+    p2.write_text(json.dumps({"enabled": True,
+                              "captured": [_controller_capture()]}))
+    assert len(tail_report.load_records(str(p2))) == 1
+
+    p3 = tmp_path / "arr.json"
+    p3.write_text(json.dumps([_envelope_capture(),
+                              _controller_capture()]))
+    assert len(tail_report.load_records(str(p3))) == 2
+
+
+def test_report_rows_mark_nested_device_get():
+    rows = tail_report.report_rows([_envelope_capture(),
+                                    _controller_capture()])
+    assert rows[0]["device_get"] == "30"
+    assert rows[1]["device_get"].endswith("*")
+    table = tail_report.render_table(rows)
+    assert "attr_pct" in table and "device_get" in table
+
+
+def test_cli_exit_codes(tmp_path):
+    good = tmp_path / "good.jsonl"
+    with open(good, "w") as f:
+        f.write(json.dumps(_envelope_capture()) + "\n")
+    r = subprocess.run([sys.executable, TOOL, str(good)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "captured slow request" in r.stdout
+
+    # attribution gate: 99% attributed passes 90, fails 99.5
+    ok = subprocess.run(
+        [sys.executable, TOOL, "--assert-attribution", "90", str(good)],
+        capture_output=True, text=True, timeout=60)
+    assert ok.returncode == 0 and "OK" in ok.stdout
+    bad = subprocess.run(
+        [sys.executable, TOOL, "--assert-attribution", "99.5",
+         str(good)],
+        capture_output=True, text=True, timeout=60)
+    assert bad.returncode == 1 and "FAIL" in bad.stdout
+
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    r = subprocess.run([sys.executable, TOOL, str(empty)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 1
+    assert "no tail captures" in r.stdout
+
+
+def test_real_recorder_roundtrip(tmp_path):
+    """An actual flight-recorder JSONL export parses and attributes."""
+    from opensearch_tpu.telemetry.lifecycle import FlightRecorder
+    fr = FlightRecorder()
+    fr.enabled = True
+    fr.threshold_ms = 0.0
+    fr.jsonl_path = str(tmp_path / "tail.jsonl")
+    tl = fr.timeline()
+    tl.merge_phases({"parse": 1.0, "device_get": 2.0, "respond": 0.5})
+    tl.mark_ready()
+    fr.complete(tl)
+    recs = tail_report.load_records(fr.jsonl_path)
+    assert len(recs) == 1
+    att = tail_report.attribution(recs[0])
+    assert att["buckets"]["device_get"] == 2.0

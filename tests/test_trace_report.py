@@ -96,6 +96,78 @@ def test_cli_empty_input(tmp_path):
     assert "no traces" in r.stdout
 
 
+def _pipelined_trace():
+    """A span the wave engine annotated: PR 9 pipeline attributes +
+    the PR 10 lifecycle events carrying per-wave fields."""
+    return {"trace": {
+        "name": "rest.search", "duration_ms": 50.0, "status": "ok",
+        "attributes": {
+            "waves": 2, "overlap_ms": 7.5,
+            "lifecycle": {"took_ms": 50.0, "events": [
+                {"event": "arrive", "t_ms": 0.0},
+                {"event": "coalesce", "t_ms": 0.2, "wave": 0,
+                 "co_batched": 512, "kind": "plain"},
+                {"event": "dispatch", "t_ms": 5.0, "wave": 0,
+                 "inflight": 1},
+                {"event": "coalesce", "t_ms": 5.2, "wave": 1,
+                 "co_batched": 512, "kind": "plain"},
+                {"event": "dispatch", "t_ms": 11.0, "wave": 1,
+                 "inflight": 2},
+                {"event": "collect", "t_ms": 20.0, "wave": 0,
+                 "ms": 9.0},
+                {"event": "overlap", "t_ms": 20.1, "wave": 1,
+                 "ms": 7.5},
+                {"event": "collect", "t_ms": 30.0, "wave": 1,
+                 "ms": 8.0},
+                {"event": "respond", "t_ms": 50.0}]}},
+        "children": [{"name": "query", "duration_ms": 40.0,
+                      "status": "ok"}]}, "ts_ms": 1700000000000}
+
+
+def test_pipeline_rows_per_wave(tmp_path):
+    path = tmp_path / "pipe.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps(_pipelined_trace()) + "\n")
+        f.write(json.dumps(_trace(5.0, [("query", 4.0)])) + "\n")
+    traces = trace_report.load_traces(str(path))
+    rows = trace_report.pipeline_rows(traces)
+    # one row per wave of the pipelined trace; the plain trace adds none
+    assert len(rows) == 2
+    w0, w1 = rows
+    assert (w0["wave"], w0["co_batched"], w0["inflight_waves"]) \
+        == (0, 512, 1)
+    assert (w1["wave"], w1["inflight_waves"], w1["overlap_ms"]) \
+        == (1, 2, 7.5)
+    assert w0["collect_ms"] == 9.0 and w1["collect_ms"] == 8.0
+    table = trace_report.render_pipeline_table(rows)
+    assert "inflight_waves" in table and "overlap_ms" in table
+
+
+def test_pipeline_rows_span_attr_fallback():
+    """Traces carrying only the span-level waves/overlap_ms attributes
+    (ledger publish, no lifecycle) still get a pipeline row."""
+    trace = {"name": "rest.search", "duration_ms": 9.0,
+             "attributes": {"waves": 4, "overlap_ms": 25.5}}
+    rows = trace_report.pipeline_rows([trace])
+    assert len(rows) == 1
+    assert rows[0]["wave"] == "(all)" and rows[0]["overlap_ms"] == 25.5
+
+
+def test_cli_prints_pipeline_table(tmp_path):
+    path = tmp_path / "pipe.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps(_pipelined_trace()) + "\n")
+        f.write(json.dumps(_pipelined_trace()) + "\n")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(trace_report.__file__),
+                      "trace_report.py"), str(path)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "wave pipeline" in r.stdout
+    assert "inflight_waves" in r.stdout
+
+
 def test_real_export_roundtrip(tmp_path):
     """The tracer's actual JSONL export parses through the tool."""
     from opensearch_tpu.telemetry import TELEMETRY

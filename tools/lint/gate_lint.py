@@ -3,8 +3,9 @@ scope-gate pattern — the no-op discipline bench.py asserts dynamically,
 promoted to a static check.
 
 The contract (PR 4 tracer, PR 6 fault injector, PR 7 transfer ledger,
-this PR's sync sanitizer): a subsystem that is OFF by default costs the
-hot path ONE attribute load and a branch. Statically that means:
+PR 8 sync sanitizer, PR 10 flight recorder): a subsystem that is OFF by
+default costs the hot path ONE attribute load and a branch. Statically
+that means:
 
 1. the flag defaults to False — `self.enabled = False` in __init__ (or
    a module-level `ENABLED = False` for the faults-style module gate);
@@ -41,6 +42,8 @@ GATED_SUBSYSTEMS = (
     ("opensearch_tpu/common/faults.py", None, "ENABLED", ()),
     ("opensearch_tpu/common/sanitize.py", "SyncSanitizer", "enabled",
      ("check",)),
+    ("opensearch_tpu/telemetry/lifecycle.py", "FlightRecorder", "enabled",
+     ("timeline",)),
 )
 
 # no-op constants a disabled gate may return

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+""""Where did p99 go" — attribute captured slow requests' wall to named
+lifecycle phases.
+
+Input (auto-detected), any of:
+  - the flight recorder's JSONL export (`<data>/_state/tail.jsonl`, or
+    bench.py --clients' BENCH_CONC_TAIL_*.jsonl) — one capture record
+    per line;
+  - a saved `GET /_telemetry/tail` response ({"captured": [...]});
+  - a bare JSON array of capture records.
+
+Each record is one request's lifecycle timeline (telemetry/lifecycle.py)
+with its ledger-fed phase decomposition. The report attributes each
+capture's `took_ms` to: `queue` (queue_wait), the request's disjoint
+phase set, and an `other` remainder — and prints `attr_pct`, the share
+of the wall the named phases explain. The disjointness rule: when a
+record carries a controller-path `query` phase, `device_get` is the
+transfer ledger's SUB-attribution of `query` (shown in its own column,
+not summed); on the msearch-envelope path `device_get` is its own
+disjoint phase and counts.
+
+    python tools/tail_report.py data/_state/tail.jsonl
+    curl -s localhost:9200/_telemetry/tail | python tools/tail_report.py -
+    python tools/tail_report.py --assert-attribution 90 BENCH_CONC_TAIL_r01.jsonl
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from trace_report import _render  # noqa: E402  (shared table renderer)
+
+# fields riding a phase map that are not durations, plus overlap_ms
+# (a measured concurrency win, not a wall slice)
+NON_TIME_PHASES = frozenset({"bytes_fetched", "bytes_to_device", "waves",
+                             "overlap_ms"})
+
+# the fixed report columns; every other attributed phase folds into
+# `other` so envelope- and controller-path captures share one table
+COLUMNS = ("queue", "compile", "device_get", "respond", "other")
+
+# phases bucketed as "compile" / "respond" in the fixed columns
+# (`handoff` = measured response-ready → request-completed interval —
+# respond-path glue + scheduler starvation under contention)
+_COMPILE_PHASES = frozenset({"compile_group"})
+_RESPOND_PHASES = frozenset({"respond", "render", "handoff"})
+
+
+def load_records(path: str) -> List[dict]:
+    """Parse a tail dump ('-' = stdin) into capture-record dicts."""
+    text = sys.stdin.read() if path == "-" else open(path).read()
+    text = text.strip()
+    if not text:
+        return []
+    records: List[Any] = []
+    if text[0] == "{" and "\n" in text:
+        parsed, bad = [], 0
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            try:
+                parsed.append(json.loads(line))
+            except json.JSONDecodeError:
+                bad += 1
+        if parsed and (len(parsed) > 1 or bad):
+            if bad:
+                print(f"warning: skipped {bad} unparseable line(s)",
+                      file=sys.stderr)
+            records = parsed
+    if not records:
+        data = json.loads(text)
+        if isinstance(data, dict):
+            records = data.get("captured", [data])
+        else:
+            records = list(data)
+    return [r for r in records
+            if isinstance(r, dict) and "took_ms" in r]
+
+
+def attribution(rec: dict) -> dict:
+    """One capture's wall decomposition: per-bucket ms + attr_pct."""
+    took = float(rec.get("took_ms") or 0.0)
+    phases: Dict[str, float] = dict(rec.get("phases") or {})
+    queue = float(rec.get("queue_wait_ms") or 0.0)
+    nested_device_get = "query" in phases   # controller path: device_get
+    # is the ledger's sub-attribution of the query phase
+    buckets = {c: 0.0 for c in COLUMNS}
+    buckets["queue"] = queue
+    attributed = queue
+    device_get_sub = 0.0
+    for name, ms in phases.items():
+        if name in NON_TIME_PHASES:
+            continue
+        ms = float(ms)
+        if name == "device_get":
+            if nested_device_get:
+                device_get_sub = ms
+                continue
+            buckets["device_get"] += ms
+        elif name in _COMPILE_PHASES:
+            buckets["compile"] += ms
+        elif name in _RESPOND_PHASES:
+            buckets["respond"] += ms
+        else:
+            buckets["other"] += ms
+        attributed += ms
+    if nested_device_get:
+        buckets["device_get"] = device_get_sub   # shown, not summed
+    pct = 100.0 * attributed / took if took > 0 else 100.0
+    return {
+        "took_ms": round(took, 3),
+        "status": rec.get("status", "?"),
+        "trigger": rec.get("trigger", "?"),
+        "attributed_ms": round(attributed, 3),
+        "attr_pct": round(min(pct, 100.0), 1),
+        "buckets": {c: round(v, 3) for c, v in buckets.items()},
+        "device_get_nested": nested_device_get,
+    }
+
+
+def report_rows(records: List[dict]) -> List[dict]:
+    rows = []
+    for i, rec in enumerate(records):
+        att = attribution(rec)
+        row = {"capture": i, "trigger": att["trigger"],
+               "took_ms": att["took_ms"]}
+        for col in COLUMNS:
+            v = att["buckets"][col]
+            cell = f"{v:g}"
+            if col == "device_get" and att["device_get_nested"]:
+                cell += "*"          # sub-attribution of the query phase
+            row[col] = cell
+        row["attr_pct"] = att["attr_pct"]
+        rows.append(row)
+    return rows
+
+
+def render_table(rows: List[dict]) -> str:
+    return _render(rows, ["capture", "trigger", "took_ms", *COLUMNS,
+                          "attr_pct"])
+
+
+def main(argv: List[str]) -> int:
+    min_attr = None
+    args: List[str] = []
+    rest = list(argv[1:])
+    while rest:
+        a = rest.pop(0)
+        if a.startswith("--assert-attribution"):
+            min_attr = float(a.split("=", 1)[1]) if "=" in a \
+                else float(rest.pop(0))
+        else:
+            args.append(a)
+    path = args[0] if args else "-"
+    records = load_records(path)
+    if not records:
+        print("no tail captures found (enable the flight recorder: "
+              "POST /_telemetry/tail/_enable, then re-run traffic)")
+        return 1
+    rows = report_rows(records)
+    print(f"{len(records)} captured slow request(s)   "
+          f"(* = device_get nested inside query, not summed)")
+    print(render_table(rows))
+    attrs = [r["attr_pct"] for r in rows]
+    print(f"\nattribution: min {min(attrs):.1f}%  "
+          f"mean {sum(attrs) / len(attrs):.1f}%")
+    if min_attr is not None:
+        under = [r for r in rows if r["attr_pct"] < min_attr]
+        if under:
+            print(f"FAIL: {len(under)} capture(s) under "
+                  f"{min_attr:g}% attribution")
+            return 1
+        print(f"OK: every capture >= {min_attr:g}% attributed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -113,14 +113,65 @@ def phase_rows(traces: List[dict]) -> List[dict]:
     return rows
 
 
-def render_table(rows: List[dict]) -> str:
-    headers = ["phase", "count", "p50_ms", "p99_ms", "max_ms", "total_ms",
-               "pct_of_root"]
-    table = [headers] + [[str(r[h]) for h in headers] for r in rows]
+def pipeline_rows(traces: List[dict]) -> List[dict]:
+    """Per-wave pipeline attribution (the PR 9 `pipeline` fields): one
+    row per (trace, wave) from the span's `lifecycle` attribute
+    (telemetry/lifecycle.py — coalesce/dispatch/collect/overlap events
+    carry co_batched, inflight pipeline depth, per-wave overlap_ms),
+    falling back to the span-level `waves`/`overlap_ms` attributes
+    (LedgerScope.publish) as a single `(all)` row when no lifecycle
+    rides the trace."""
+    rows: List[dict] = []
+    for ti, trace in enumerate(traces):
+        attrs = trace.get("attributes") or {}
+        lc = attrs.get("lifecycle") or {}
+        waves: Dict[Any, dict] = {}
+        for ev in lc.get("events") or []:
+            w = ev.get("wave")
+            if w is None:
+                continue
+            row = waves.setdefault(w, {
+                "trace": ti, "wave": w, "co_batched": "-",
+                "inflight_waves": "-", "overlap_ms": "-",
+                "collect_ms": "-"})
+            name = ev.get("event")
+            if name == "coalesce":
+                row["co_batched"] = ev.get("co_batched", "-")
+            elif name == "dispatch":
+                row["inflight_waves"] = ev.get("inflight", "-")
+            elif name == "collect":
+                row["collect_ms"] = ev.get("ms", "-")
+            elif name == "overlap":
+                row["overlap_ms"] = ev.get("ms", "-")
+        if waves:
+            rows.extend(waves[w] for w in sorted(waves))
+        elif "waves" in attrs or "overlap_ms" in attrs:
+            rows.append({"trace": ti, "wave": "(all)",
+                         "co_batched": "-", "inflight_waves": "-",
+                         "overlap_ms": attrs.get("overlap_ms", "-"),
+                         "collect_ms": "-",
+                         **({"waves": attrs["waves"]}
+                            if "waves" in attrs else {})})
+    return rows
+
+
+def _render(rows: List[dict], headers: List[str]) -> str:
+    table = [headers] + [[str(r.get(h, "-")) for h in headers]
+                         for r in rows]
     widths = [max(len(row[i]) for row in table) for i in range(len(headers))]
     return "\n".join(
         "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
         for row in table)
+
+
+def render_table(rows: List[dict]) -> str:
+    return _render(rows, ["phase", "count", "p50_ms", "p99_ms", "max_ms",
+                          "total_ms", "pct_of_root"])
+
+
+def render_pipeline_table(rows: List[dict]) -> str:
+    return _render(rows, ["trace", "wave", "co_batched", "inflight_waves",
+                          "overlap_ms", "collect_ms"])
 
 
 def main(argv: List[str]) -> int:
@@ -132,6 +183,10 @@ def main(argv: List[str]) -> int:
         return 1
     print(f"{len(traces)} trace(s)")
     print(render_table(phase_rows(traces)))
+    pipe = pipeline_rows(traces)
+    if pipe:
+        print("\nwave pipeline (per-wave overlap / in-flight depth):")
+        print(render_pipeline_table(pipe))
     return 0
 
 
