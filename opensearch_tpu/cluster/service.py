@@ -1865,7 +1865,8 @@ class ClusterNode:
 
     def handle(self, method: str, path: str,
                params: Optional[Dict[str, str]] = None, body: Any = None,
-               raw_body: Optional[bytes] = None):
+               raw_body: Optional[bytes] = None,
+               headers: Optional[Dict[str, str]] = None):
         """Cluster-routed dispatch for the data plane; everything else
         falls through to the local single-node surface."""
         from opensearch_tpu.rest.controller import RestResponse
@@ -1891,7 +1892,7 @@ class ClusterNode:
             body_out, status = routed
             return RestResponse(status=status, body=body_out)
         return self.local.handle(method, path, params=params, body=parsed,
-                                 raw_body=raw)
+                                 raw_body=raw, headers=headers)
 
     def request(self, method: str, path: str, body: Any = None,
                 **params) -> dict:

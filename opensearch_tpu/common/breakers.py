@@ -156,65 +156,14 @@ class IndexingPressure:
                       "coordinating_rejections": self.rejections}}}
 
 
-class SearchBackpressure:
-    """Admission gate: cap concurrent searches; over the cap, new searches
-    are rejected with 429 (the reference instead cancels the most expensive
-    task under node duress — same contract surface, simpler policy)."""
-
-    def __init__(self, max_concurrent: int = 100):
-        self.max_concurrent = max_concurrent
-        self.current = 0
-        self.rejections = 0
-        self.cancellations = 0
-        self._lock = threading.Lock()
-
-    def acquire(self):
-        with self._lock:
-            if self.current >= self.max_concurrent:
-                self.rejections += 1
-                from opensearch_tpu.telemetry import TELEMETRY
-                TELEMETRY.metrics.counter(
-                    "search.backpressure_rejections").inc()
-                raise self.rejection_error()
-            self.current += 1
-
-    def release(self):
-        with self._lock:
-            self.current = max(0, self.current - 1)
-
-    def acquire_batch(self, n: int) -> int:
-        """Batch-aware admission for the _msearch envelope: admit as many
-        of `n` sub-requests as capacity allows and return that count —
-        the OVERFLOW items are rejected (counted + telemetry), not the
-        envelope. The caller renders per-item 429 error objects for the
-        tail and MUST release_batch(admitted) when done."""
-        with self._lock:
-            free = max(0, self.max_concurrent - self.current)
-            admitted = min(max(n, 0), free)
-            rejected = n - admitted
-            self.current += admitted
-            if rejected > 0:
-                self.rejections += rejected
-        if rejected > 0:
-            from opensearch_tpu.telemetry import TELEMETRY
-            TELEMETRY.metrics.counter(
-                "search.backpressure_rejections").inc(rejected)
-        return admitted
-
-    def release_batch(self, n: int):
-        with self._lock:
-            self.current = max(0, self.current - max(n, 0))
-
-    def rejection_error(self) -> CircuitBreakingError:
-        return CircuitBreakingError(
-            f"rejected execution of search: node is under duress "
-            f"[{self.current} >= {self.max_concurrent} concurrent "
-            f"searches]")
-
-    def stats(self) -> dict:
-        return {"search_task": {"current": self.current,
-                                "rejections": self.rejections,
-                                "cancellation_count": self.cancellations}}
+# The search admission gate moved to common/admission.py (ISSUE 11):
+# the static permit count this module carried is now the LAST stage of
+# the adaptive pipeline (tenant quota -> device-memory breaker ->
+# deadline shed -> permits), with the same acquire/release/
+# acquire_batch/release_batch/stats surface. Re-exported here so every
+# existing import path keeps working.
+from opensearch_tpu.common.admission import (  # noqa: F401
+    AdmissionController, AdmissionController as SearchBackpressure)
 
 
 def _human(n: int) -> str:

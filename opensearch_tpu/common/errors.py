@@ -125,6 +125,39 @@ class CircuitBreakingError(OpenSearchTpuError):
     error_type = "circuit_breaking_exception"
 
 
+class AdmissionRejectedError(CircuitBreakingError):
+    """A 429 from the admission controller (common/admission.py),
+    rendered in the reference's CircuitBreakingException body shape —
+    `bytes_wanted` / `bytes_limit` / `durability` — plus the structured
+    `reject_reason` (`deadline_shed` | `tenant_quota` | `breaker:<name>`
+    | `backpressure`), the tenant, and `retry_after_ms` computed from
+    the live rolling queue estimate. `headers` carries the HTTP
+    `Retry-After` the REST layer attaches on the single-search path
+    (per-item msearch 429 objects carry the same fields in-body, since
+    the envelope itself is a 200). `durability` is TRANSIENT: every
+    admission rejection clears once load drains — the retryable class,
+    exactly like the reference's backpressure trips."""
+
+    def __init__(self, reason: str = "",
+                 reject_reason: str = "backpressure",
+                 tenant: str = None,
+                 bytes_wanted: int = 0, bytes_limit: int = 0,
+                 retry_after_ms: float = 1000.0, **metadata):
+        super().__init__(
+            reason, reject_reason=reject_reason,
+            bytes_wanted=int(bytes_wanted), bytes_limit=int(bytes_limit),
+            durability="TRANSIENT",
+            retry_after_ms=round(float(retry_after_ms), 3), **metadata)
+        if tenant is not None:
+            self.metadata["tenant"] = tenant
+        self.reject_reason = reject_reason
+        self.retry_after_ms = float(retry_after_ms)
+        # HTTP Retry-After is integer seconds; never render 0 ("retry
+        # immediately") while the node is actively shedding
+        self.headers = {"Retry-After":
+                        str(max(1, int(-(-self.retry_after_ms // 1000))))}
+
+
 class TaskCancelledError(OpenSearchTpuError):
     status = 400
     error_type = "task_cancelled_exception"

@@ -66,7 +66,14 @@ class Node:
         self.threadpool = ThreadPool(self.settings, node_name=node_name)
         self.breaker_service = CircuitBreakerService()
         self.indexing_pressure = IndexingPressure()
+        # adaptive admission controller (common/admission.py): quota ->
+        # breaker -> deadline-shed -> permits; every adaptive stage OFF
+        # by default, configured from node settings here and re-applied
+        # on every PUT /_cluster/settings
+        from opensearch_tpu.common.settings import Settings as _Settings
         self.search_backpressure = SearchBackpressure()
+        self.search_backpressure.apply_settings(
+            _Settings(self.settings).as_dict())
         self.gateway = None
         if data_path is not None:
             from opensearch_tpu.gateway import Gateway
@@ -74,6 +81,7 @@ class Node:
             loaded = self.gateway.load(self.indices)
             if loaded and loaded.get("cluster_settings"):
                 self.cluster_settings.update(loaded["cluster_settings"])
+                self.apply_admission_settings()
             if loaded and loaded.get("search_pipelines"):
                 self.search_pipelines.load(loaded["search_pipelines"])
         # executable warmup (search/warmup.py): load the persisted
@@ -122,6 +130,20 @@ class Node:
         from opensearch_tpu.rest.actions import register_all
         register_all(self)
 
+    def apply_admission_settings(self):
+        """Re-apply the admission controller's settings from the live
+        cluster-settings store (persistent first, transient wins — the
+        standard precedence) on top of the node's static settings."""
+        from opensearch_tpu.common.settings import Settings
+        merged = Settings(self.settings).as_dict()
+        merged.update(
+            Settings(self.cluster_settings.get("persistent") or {})
+            .as_dict())
+        merged.update(
+            Settings(self.cluster_settings.get("transient") or {})
+            .as_dict())
+        self.search_backpressure.apply_settings(merged)
+
     def persist_metadata(self):
         """Write node metadata through the gateway (no-op without a data
         path — pure in-memory node)."""
@@ -137,7 +159,8 @@ class Node:
     def handle(self, method: str, path: str,
                params: Optional[Dict[str, str]] = None,
                body: Any = None,
-               raw_body: Optional[bytes] = None) -> RestResponse:
+               raw_body: Optional[bytes] = None,
+               headers: Optional[Dict[str, str]] = None) -> RestResponse:
         """Entry point for both the HTTP server and in-process tests."""
         if isinstance(body, (str, bytes)) and body:
             raw_body = body if isinstance(body, bytes) else body.encode()
@@ -147,7 +170,7 @@ class Node:
                 body = None
         req = RestRequest(method=method.upper(), path=path,
                           params=dict(params or {}), body=body,
-                          raw_body=raw_body)
+                          raw_body=raw_body, headers=dict(headers or {}))
         return self.controller.dispatch(req)
 
     # -------------------------------------------------- convenience client

@@ -132,7 +132,7 @@ def _cluster_allow_partial(node) -> Optional[bool]:
 
 
 def _run_search(node, index_expr: Optional[str], body: Optional[dict],
-                search_pipeline=None) -> dict:
+                search_pipeline=None, tenant: Optional[str] = None) -> dict:
     """Search with the full pipeline wrap: resolve the search pipeline
     (request param > inline body definition > the single target index's
     `index.search.default_pipeline` setting), apply request processors,
@@ -147,7 +147,8 @@ def _run_search(node, index_expr: Optional[str], body: Optional[dict],
     search phases; per-phase times feed the slow log's query/fetch
     thresholds."""
     from opensearch_tpu.search import dsl
-    from opensearch_tpu.search.controller import execute_search
+    from opensearch_tpu.search.controller import (
+        _parse_deadline, execute_search)
     tracer = TELEMETRY.tracer
     metrics = TELEMETRY.metrics
     root = tracer.start_trace("rest.search", index=index_expr or "_all")
@@ -181,28 +182,44 @@ def _run_search(node, index_expr: Optional[str], body: Optional[dict],
             with root.child("query", path="percolate"):
                 return execute_percolate(executors, parsed, max(k, 10),
                                          body)
-        t_admit = time.monotonic() if tl is not None else 0.0
-        try:
-            node.search_backpressure.acquire()
-        except OpenSearchTpuError:
-            # the span for a rejected request still closes, with its own
-            # status — rejections must be visible in traces, not lost
-            root.set_attribute("backpressure", "rejected")
-            root.end(status="rejected")
-            if tl is not None:
-                tl.event("reject", reason="backpressure")
-                flight.complete(tl, status="rejected", span=root)
-            raise
-        if tl is not None:
-            # today's gate admits or rejects immediately, so queue_wait
-            # reads ~0 — the field the item-2 wave scheduler fills with
-            # real micro-batch queue delay
-            tl.queue_wait((time.monotonic() - t_admit) * 1000)
-            tl.event("admit")
+        # admission (common/admission.py: quota -> breaker -> deadline
+        # shed -> permits). The deadline parses BEFORE admission so the
+        # shed stage can price it — and so a malformed timeout 400s
+        # without consuming a permit; the task registers before too.
+        # NOTHING runs between a successful acquire() and the try whose
+        # finally releases — the permit-leak invariant
+        # tools/chaos_sweep.py re-checks after every fault row.
+        deadline = _parse_deadline(body)
         task = node.task_manager.register(
             "indices:data/read/search",
             description=f"indices[{index_expr or '_all'}]", cancellable=True)
+        t_admit = time.monotonic() if tl is not None else 0.0
         try:
+            node.search_backpressure.acquire(tenant=tenant,
+                                             deadline=deadline)
+        except OpenSearchTpuError as rej:
+            # the span for a rejected request still closes, with its own
+            # status — rejections must be visible in traces, not lost
+            node.task_manager.unregister(task)
+            root.set_attribute("backpressure", "rejected")
+            root.end(status="rejected")
+            if tl is not None:
+                # structured reject reason + tenant: what
+                # tools/tail_report.py groups rejection captures by
+                tl.event("reject",
+                         reason=getattr(rej, "reject_reason",
+                                        "backpressure"),
+                         tenant=tenant or "_default")
+                flight.complete(tl, status="rejected", span=root)
+            raise
+        t_exec0 = time.monotonic()
+        try:
+            if tl is not None:
+                # today's gate admits or rejects immediately, so
+                # queue_wait reads ~0 — the field the item-1 wave
+                # scheduler fills with real micro-batch queue delay
+                tl.queue_wait((t_exec0 - t_admit) * 1000)
+                tl.event("admit")
             res = execute_search(executors, body, extra_filters=filters,
                                  task=task, allow_envelope=True,
                                  phase_processors=phase_spec,
@@ -210,7 +227,10 @@ def _run_search(node, index_expr: Optional[str], body: Optional[dict],
                                  allow_partial=_cluster_allow_partial(node))
         finally:
             node.task_manager.unregister(task)
-            node.search_backpressure.release()
+            # the measured service wall feeds the deadline-shed
+            # predictor's rolling estimator (common/admission.py)
+            node.search_backpressure.release(
+                service_ms=(time.monotonic() - t_exec0) * 1000.0)
         res.pop("_page_cursor", None)
         if pipeline is not None:
             res = pipeline.process_response(res, ctx, targets=services,
@@ -591,7 +611,8 @@ def register_search_actions(node, c):
             out = search_with_pit(node, body)
         else:
             out = _run_search(node, req.param("index"), body,
-                              search_pipeline=req.param("search_pipeline"))
+                              search_pipeline=req.param("search_pipeline"),
+                              tenant=req.tenant())
         return _total_as_int(out) if as_int else out
 
     def do_field_caps(req):
@@ -867,21 +888,34 @@ def register_search_actions(node, c):
                 # admit event records the batch admission split
                 flight = TELEMETRY.flight
                 tl = flight.timeline()
+                tenant = req.tenant()
                 t_admit = time.monotonic() if tl is not None else 0.0
-                # batch-aware admission: the backpressure gate admits as
-                # many sub-requests as capacity allows; OVERFLOW items
-                # reject with per-item 429 error objects instead of
-                # 429ing the whole envelope. Nothing may run between
-                # acquire and the try — release_batch lives in finally.
-                admitted = node.search_backpressure.acquire_batch(
-                    len(bodies))
+                # batch-aware admission (quota -> breaker -> deadline
+                # shed -> permits): each stage admits what fits; the
+                # OVERFLOW items reject with per-item 429 error objects
+                # carrying the FIRST clipping stage's structured reason
+                # instead of 429ing the whole envelope. NOTHING runs
+                # between acquire and the try — release_batch lives in
+                # finally (the permit-leak invariant chaos_sweep
+                # re-checks).
+                admitted, reject = \
+                    node.search_backpressure.acquire_batch_ex(
+                        len(bodies), tenant=tenant, deadline=deadline)
                 tl_prev = None
-                if tl is not None:
-                    tl.queue_wait((time.monotonic() - t_admit) * 1000)
-                    tl.event("admit", admitted=admitted,
-                             rejected=len(bodies) - admitted)
-                    tl_prev = flight.bind(tl)
+                t_exec0 = time.monotonic()
                 try:
+                    if tl is not None:
+                        tl.queue_wait((t_exec0 - t_admit) * 1000)
+                        tl.event("admit", admitted=admitted,
+                                 rejected=len(bodies) - admitted)
+                        if reject is not None:
+                            tl.event(
+                                "reject",
+                                reason=getattr(reject, "reject_reason",
+                                               "backpressure"),
+                                tenant=tenant or "_default",
+                                items=len(bodies) - admitted)
+                        tl_prev = flight.bind(tl)
                     if admitted == len(bodies):
                         res = node.indices.get(names[0]).multi_search(
                             bodies, task=task, deadline=deadline)
@@ -893,7 +927,9 @@ def register_search_actions(node, c):
                             deadline=deadline) if admitted else \
                             {"took": 0, "responses": []}
                         rejected = _item_error(
-                            node.search_backpressure.rejection_error())
+                            reject if reject is not None else
+                            node.search_backpressure.rejection_error(
+                                tenant=tenant))
                         res["responses"].extend(
                             dict(rejected)
                             for _ in range(len(bodies) - admitted))
@@ -903,7 +939,9 @@ def register_search_actions(node, c):
                     raise
                 finally:
                     node.task_manager.unregister(task)
-                    node.search_backpressure.release_batch(admitted)
+                    node.search_backpressure.release_batch(
+                        admitted,
+                        service_ms=(time.monotonic() - t_exec0) * 1000.0)
                     if tl is not None:
                         flight.unbind(tl_prev)
                         tl.event("respond")
@@ -928,7 +966,8 @@ def register_search_actions(node, c):
         took = 0
         for index_expr, body in pairs:
             try:
-                res = _run_search(node, index_expr, body)
+                res = _run_search(node, index_expr, body,
+                                  tenant=req.tenant())
                 res["status"] = 200
                 took = max(took, res.get("took", 0))
                 responses.append(res)
@@ -1384,14 +1423,29 @@ def register_cluster_actions(node, c):
 
     def do_cluster_settings_put(req):
         body = req.body or {}
+        # validate-then-commit: a malformed admission value must 400
+        # WITHOUT touching the store — a persisted bad key would 500
+        # every later settings update (the apply re-runs over the full
+        # merged map) and fail node restart from the gateway
+        from opensearch_tpu.common.admission import AdmissionController
+        from opensearch_tpu.common.settings import Settings
+        candidate = {scope: dict(node.cluster_settings[scope])
+                     for scope in ("persistent", "transient")}
         for scope in ("persistent", "transient"):
-            updates = body.get(scope) or {}
-            store = node.cluster_settings[scope]
-            for k, v in updates.items():
+            for k, v in (body.get(scope) or {}).items():
                 if v is None:
-                    store.pop(k, None)
+                    candidate[scope].pop(k, None)
                 else:
-                    store[k] = v
+                    candidate[scope][k] = v
+        merged = Settings(node.settings).as_dict()
+        merged.update(Settings(candidate["persistent"]).as_dict())
+        merged.update(Settings(candidate["transient"]).as_dict())
+        AdmissionController.parse_settings(merged)  # raises -> 400
+        node.cluster_settings["persistent"] = candidate["persistent"]
+        node.cluster_settings["transient"] = candidate["transient"]
+        # dynamic admission/quota/breaker settings take effect on the
+        # controller immediately (common/admission.py apply_settings)
+        node.apply_admission_settings()
         return {"acknowledged": True,
                 "persistent": node.cluster_settings["persistent"],
                 "transient": node.cluster_settings["transient"]}

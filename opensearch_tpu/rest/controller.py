@@ -24,9 +24,23 @@ class RestRequest:
     params: Dict[str, str] = field(default_factory=dict)
     body: Any = None          # parsed JSON (dict/list) or None
     raw_body: Optional[bytes] = None
+    headers: Dict[str, str] = field(default_factory=dict)
 
     def param(self, name: str, default=None):
         return self.params.get(name, default)
+
+    def header(self, name: str, default=None):
+        """Case-insensitive header read (HTTP header names are)."""
+        for k, v in self.headers.items():
+            if k.lower() == name.lower():
+                return v
+        return default
+
+    def tenant(self) -> Optional[str]:
+        """The request's tenant for admission quotas: `?tenant=` param
+        beats the `X-Opaque-Id` header (the reference's client-id
+        channel); None = the default tenant."""
+        return self.param("tenant") or self.header("X-Opaque-Id")
 
     def bool_param(self, name: str, default: bool = False) -> bool:
         """A present-but-blank flag (`?v`, `?include_defaults`) means true,
@@ -214,7 +228,7 @@ class RestController:
             return RestResponse(status=e.status, body={
                 "error": {"root_cause": [e.to_xcontent()], **e.to_xcontent()},
                 "status": e.status,
-            })
+            }, headers=dict(getattr(e, "headers", None) or {}))
         except Exception as e:  # unexpected: 500 with the exception chain
             return RestResponse(status=500, body={
                 "error": {

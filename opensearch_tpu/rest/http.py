@@ -77,7 +77,8 @@ class _Handler(BaseHTTPRequestHandler):
                 body = None
                 raw = None
         resp = self.node.handle(method, parsed.path, params=params,
-                                body=body, raw_body=raw)
+                                body=body, raw_body=raw,
+                                headers=dict(self.headers.items()))
         content_type = resp.content_type
         if content_type == "application/json":
             from opensearch_tpu.common import xcontent

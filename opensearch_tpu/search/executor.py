@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from opensearch_tpu.common import faults, retry
+from opensearch_tpu.common.admission import WAVE_BREAKER
 from opensearch_tpu.common.errors import (
     IllegalArgumentError, OpenSearchTpuError, QueryShardError)
 from opensearch_tpu.index.mapper import MapperService
@@ -416,7 +417,7 @@ class _MsearchWave:
     __slots__ = ("kind", "items", "payload", "state", "scope", "ph",
                  "raise_errors", "window", "prep_t0", "prep_t1",
                  "collect_t0", "collect_t1", "error", "index",
-                 "timeline")
+                 "timeline", "breaker_probe")
 
     def __init__(self, kind: str, items: List[int], payload,
                  raise_errors: bool = False):
@@ -435,6 +436,8 @@ class _MsearchWave:
         self.timeline = None        # request Timeline (or None) — rides
         # the wave record across the collector-thread boundary so the
         # collect event lands on the owning request's lifecycle
+        self.breaker_probe = False  # this wave is the device-memory
+        # breaker's single half-open probe (common/admission.py)
 
 
 class _WaveCollector:
@@ -2008,6 +2011,25 @@ class SearchExecutor:
                         if responses[i] is None:
                             responses[i] = _timed_out_item(start)
                     continue
+                breaker = WAVE_BREAKER.gate()
+                if breaker is not None:
+                    # device-memory breaker (common/admission.py): a
+                    # node whose in-flight wave buffers are over budget
+                    # sheds this WAVE as per-item 429s through the PR 6
+                    # per-item machinery — never a 5xx. Checked BEFORE
+                    # prepare so a shed wave allocates nothing; the
+                    # half-open probe's collect outcome reports back in
+                    # the merge loop below.
+                    berr, wave.breaker_probe = breaker.pre_wave(
+                        _DEVMEM.live_bytes("wave_buffers"))
+                    if berr is not None:
+                        if wave.raise_errors:
+                            raise berr
+                        item = _item_error(berr)
+                        for i in wave.items:
+                            if responses[i] is None:
+                                responses[i] = dict(item)
+                        continue
                 if timeline is not None:
                     # coalesce: which wave this request's items ride and
                     # with how many co-batched siblings — the field the
@@ -2062,6 +2084,17 @@ class SearchExecutor:
                 _release_wave_gauges(wave.state)
                 if not wave.collect_t1:
                     _LEDGER.note_wave_inflight(-1)
+            # device-memory breaker probe verdicts — in the finally so
+            # no exit path (cancellation, raised wave error, crashed
+            # prepare) can strand the breaker half-open with a probe
+            # outstanding: a clean collect closes it, anything else
+            # re-opens it
+            _dispatched_ids = {id(w) for w in dispatched}
+            for w in wave_list:
+                if w.breaker_probe:
+                    WAVE_BREAKER.on_result(
+                        id(w) in _dispatched_ids and w.error is None
+                        and bool(w.collect_t1))
         # merge per-wave accounting on this thread (single writer):
         # phase times sum, wave scopes absorb into the request scope,
         # and each wave's measured overlap — its prepare/dispatch time

@@ -234,3 +234,39 @@ class RefField:
             score = sum(self.bm25(i, t, boost) for t in terms if t in self.docs[i])
             out[i] = score
         return out
+
+
+# ------------------------------------------------------------- admission
+
+def ref_predict_queue_ms(service_ms, queue_depth):
+    """Oracle for common/admission.predict_queue_ms: the serial-queue
+    model `(depth + 1) * service`, None when no estimate exists."""
+    if service_ms is None or service_ms <= 0.0:
+        return None
+    return service_ms * (max(queue_depth, 0) + 1)
+
+
+def ref_deadline_shed(service_ms, queue_depth, budget_ms):
+    """Oracle for the shed verdict (DeadlineShedder.check, ignoring the
+    warmup/probe escapes): shed iff an estimate exists, a budget exists,
+    and the predicted queue time exceeds it."""
+    if budget_ms is None:
+        return False
+    predicted = ref_predict_queue_ms(service_ms, queue_depth)
+    return predicted is not None and predicted > budget_ms
+
+
+def ref_token_bucket(rate, burst, events):
+    """Oracle for TokenBucket.take_up_to: `events` is a sequence of
+    (at_seconds, want) pairs in nondecreasing time order; returns the
+    admitted count per event."""
+    tokens = float(burst)
+    last = 0.0
+    out = []
+    for at, want in events:
+        tokens = min(float(burst), tokens + (at - last) * rate)
+        last = at
+        got = min(int(tokens), int(want))
+        tokens -= got
+        out.append(got)
+    return out

@@ -1,0 +1,713 @@
+"""ISSUE 11: the adaptive admission controller (common/admission.py).
+
+Covers the unit contracts — deadline-shed math vs the pure-Python
+oracle, token-bucket refill + fair share across tenants, breaker
+trip/half-open/close transitions, seeded determinism — and the
+integration surfaces: the reference-shaped 429 body + Retry-After
+header on the single-search path, per-item msearch 429 objects, the
+device-memory breaker shedding waves through the per-item-error
+machinery (never a 5xx), structured lifecycle reject reasons, the
+permit-leak counter invariant, dynamic cluster-settings updates, and
+chaos-under-concurrency (seeded faults firing while open-loop clients
+fly).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from opensearch_tpu.common import faults
+from opensearch_tpu.common.admission import (
+    WAVE_BREAKER, AdmissionController, DeadlineShedder,
+    DeviceMemoryBreaker, TenantQuotas, TokenBucket, predict_queue_ms)
+from opensearch_tpu.common.errors import AdmissionRejectedError
+from opensearch_tpu.telemetry import TELEMETRY
+
+from reference_impl import (  # noqa: E402
+    ref_deadline_shed, ref_predict_queue_ms, ref_token_bucket)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_node(**settings):
+    from opensearch_tpu.node import Node
+    node = Node(settings=settings or None)
+    node.request("PUT", "/t", {
+        "settings": {"number_of_shards": 1},
+        "mappings": {"properties": {"msg": {"type": "text"}}}})
+    lines = []
+    for i in range(8):
+        lines.append(json.dumps({"index": {"_index": "t",
+                                           "_id": f"d{i}"}}))
+        lines.append(json.dumps({"msg": f"hello module {i}"}))
+    r = node.request("POST", "/_bulk", "\n".join(lines) + "\n",
+                     refresh="true")
+    assert r["_status"] == 200 and not r["errors"], r
+    return node
+
+
+SEARCH = {"query": {"match": {"msg": "hello"}}, "size": 5}
+
+
+# ------------------------------------------------- deadline-shed math
+
+
+class TestDeadlineShed:
+    def test_predictor_matches_oracle(self):
+        for svc in (None, 0.0, 0.5, 3.7, 120.0):
+            for depth in (0, 1, 7, 100):
+                assert predict_queue_ms(svc, depth) \
+                    == ref_predict_queue_ms(svc, depth)
+
+    def test_shed_verdict_matches_oracle(self):
+        sh = DeadlineShedder()
+        sh.enabled = True
+        sh.min_samples = 1
+        sh.probe_interval_s = 1e9        # no probe escape in this test
+        sh._last_probe = sh._clock()
+        # deterministic estimator: constant service time -> p50 == p95
+        for _ in range(32):
+            sh.observe(10.0)
+        q = sh.service_ms.quantile(sh.floor_quantile)
+        for depth in (0, 1, 4, 9, 50):
+            for budget in (5.0, 50.0, 200.0, 1e6):
+                got = sh.check(depth, None) if budget is None else None
+                sh.slo_ms = budget
+                got = sh.check(depth, None)
+                want = ref_deadline_shed(q, depth, budget)
+                assert (got is not None) == want, \
+                    (depth, budget, q, got)
+
+    def test_never_sheds_blind_or_before_warmup(self):
+        sh = DeadlineShedder()
+        sh.enabled = True
+        sh.slo_ms = 0.001
+        sh.probe_interval_s = 1e9
+        sh._last_probe = sh._clock()
+        assert sh.check(100, None) is None      # no samples at all
+        for _ in range(sh.min_samples - 1):
+            sh.observe(1000.0)
+        assert sh.check(100, None) is None      # below min_samples
+        sh.observe(1000.0)
+        assert sh.check(100, None) is not None  # warmed up: sheds
+
+    def test_probe_escapes_the_death_spiral(self):
+        clock = FakeClock()
+        sh = DeadlineShedder(clock=clock)
+        sh.enabled = True
+        sh.slo_ms = 10.0
+        sh.min_samples = 1
+        sh.observe(500.0)               # one poisoned cold sample
+        clock.advance(1.0)
+        assert sh.check(0, None) is None    # first verdict = probe
+        assert sh.probes == 1
+        assert sh.check(0, None) is not None    # probe slot used: shed
+        clock.advance(sh.probe_interval_s)
+        assert sh.check(0, None) is None        # next probe window
+        assert sh.probes == 2
+
+    def test_deadline_beats_slo(self):
+        sh = DeadlineShedder()
+        sh.enabled = True
+        sh.min_samples = 1
+        sh.probe_interval_s = 1e9
+        sh._last_probe = sh._clock()
+        for _ in range(8):
+            sh.observe(10.0)
+        sh.slo_ms = 1e9                  # SLO alone would never shed
+        import time as _time
+        near = _time.monotonic() + 0.001     # ~1ms budget
+        assert sh.check(10, near) is not None
+
+    def test_max_admissible_batch_math(self):
+        sh = DeadlineShedder()
+        sh.enabled = True
+        sh.min_samples = 1
+        sh.probe_interval_s = 1e9
+        sh._last_probe = sh._clock()
+        for _ in range(32):
+            sh.observe(10.0)
+        q = sh.service_ms.quantile(sh.floor_quantile)
+        # m = floor(budget/q) - depth, clamped to [0, n]
+        m = sh.max_admissible(2, 100.0, 64)
+        assert m == max(0, min(int(100.0 / q) - 2, 64))
+        assert sh.max_admissible(0, None, 7) == 7
+
+
+# ------------------------------------------------------- token buckets
+
+
+class TestTenantQuotas:
+    def test_bucket_matches_oracle(self):
+        events = [(0.0, 2), (0.0, 2), (0.5, 1), (2.0, 5), (2.0, 1),
+                  (10.0, 99)]
+        clock = FakeClock()
+        b = TokenBucket(rate=2.0, burst=4.0, clock=clock)
+        got = []
+        for at, want in events:
+            clock.t = at
+            got.append(b.take_up_to(want))
+        assert got == ref_token_bucket(2.0, 4.0, events)
+
+    def test_fair_share_across_three_tenants(self):
+        clock = FakeClock()
+        q = TenantQuotas(clock=clock)
+        q.enabled = True
+        q.configure(rate=0.0, burst=3.0)    # no refill: pure burst
+        # the hot tenant drains ITS bucket; the other two are untouched
+        for _ in range(3):
+            assert q.take_up_to("hot", 1) == (1, 0.0)
+        got, retry = q.take_up_to("hot", 1)
+        assert got == 0 and retry > 0
+        assert q.take_up_to("calm", 1)[0] == 1
+        assert q.take_up_to("idle", 2)[0] == 2
+        st = q.stats()["tenants"]
+        assert st["hot"]["admitted"] == 3 and st["hot"]["rejected"] >= 1
+        assert st["calm"]["rejected"] == 0
+        assert st["idle"]["admitted"] == 2
+
+    def test_refill_and_retry_after(self):
+        clock = FakeClock()
+        q = TenantQuotas(clock=clock)
+        q.enabled = True
+        q.configure(rate=2.0, burst=2.0)
+        assert q.take_up_to("a", 2) == (2, 0.0)
+        got, retry = q.take_up_to("a", 1)
+        assert got == 0 and retry == pytest.approx(0.5)
+        clock.advance(1.0)                  # 2 tokens back
+        assert q.take_up_to("a", 2) == (2, 0.0)
+
+    def test_per_tenant_override(self):
+        clock = FakeClock()
+        q = TenantQuotas(clock=clock)
+        q.enabled = True
+        q.configure(rate=0.0, burst=1.0)
+        q.set_tenant("vip", rate=0.0, burst=10.0)
+        assert q.take_up_to("vip", 10)[0] == 10
+        assert q.take_up_to("pleb", 10)[0] == 1
+
+    def test_settings_reapply_does_not_refill_drained_buckets(self):
+        """An UNRELATED cluster-settings update re-applies admission
+        settings; a drained tenant must stay drained — only a changed
+        default/override rebuilds buckets."""
+        clock = FakeClock()
+        q = TenantQuotas(clock=clock)
+        q.enabled = True
+        q.configure(rate=0.0, burst=3.0)
+        q.set_tenant("vip", rate=0.0, burst=5.0)
+        assert q.take_up_to("hot", 3)[0] == 3       # drained
+        assert q.take_up_to("vip", 5)[0] == 5       # drained
+        q.configure(rate=None, burst=None)          # re-apply, no change
+        q.configure(rate=0.0, burst=3.0)            # same values
+        q.set_tenant("vip", rate=0.0, burst=5.0)    # same override
+        assert q.take_up_to("hot", 1)[0] == 0
+        assert q.take_up_to("vip", 1)[0] == 0
+        q.configure(rate=0.0, burst=4.0)            # REAL change
+        assert q.take_up_to("hot", 4)[0] == 4       # rebuilt
+        assert q.take_up_to("vip", 1)[0] == 0       # override kept
+
+    def test_downstream_rejection_refunds_quota_tokens(self):
+        """A request the quota admitted but the permit stage rejected
+        never ran — its token returns, so the tenant is not starved by
+        OTHER tenants' congestion."""
+        ctrl = AdmissionController(max_concurrent=0)
+        ctrl.quotas.enabled = True
+        ctrl.quotas.configure(rate=0.0, burst=2.0)
+        for _ in range(5):      # would drain a 2-token bucket w/o refund
+            with pytest.raises(AdmissionRejectedError) as ei:
+                ctrl.acquire(tenant="a")
+            assert ei.value.reject_reason == "backpressure"
+        ctrl.max_concurrent = 10
+        assert ctrl.quotas.take_up_to("a", 2)[0] == 2   # tokens intact
+        # batch path: permits clip the batch, clipped tokens refund
+        ctrl2 = AdmissionController(max_concurrent=1)
+        ctrl2.quotas.enabled = True
+        ctrl2.quotas.configure(rate=0.0, burst=8.0)
+        admitted, err = ctrl2.acquire_batch_ex(8, tenant="b")
+        assert admitted == 1 and err is not None
+        assert ctrl2.quotas.take_up_to("b", 8)[0] == 7  # 8 - 1 held
+
+    def test_tracked_tenant_cap_bounds_memory(self):
+        clock = FakeClock()
+        q = TenantQuotas(clock=clock)
+        q.enabled = True
+        q.MAX_TRACKED_TENANTS = 4
+        q.configure(rate=0.0, burst=2.0)
+        for i in range(16):
+            q.take_up_to(f"anon-{i}", 1)
+        assert len(q._buckets) <= 4 + 1     # cap + overflow bucket
+        tenants = q.stats()["tenants"]
+        assert len(tenants) <= 4 + 1
+        # overflow tenants share one bucket: they throttle each other,
+        # never the tracked/configured tenants
+        assert q.OVERFLOW_TENANT in tenants
+
+    def test_seeded_determinism(self):
+        """Two controllers fed the same clock sequence make identical
+        decisions — admission must be reproducible for the chaos
+        harness."""
+        def run():
+            clock = FakeClock()
+            q = TenantQuotas(clock=clock)
+            q.enabled = True
+            q.configure(rate=1.5, burst=4.0)
+            out = []
+            for i in range(40):
+                clock.advance(0.1 * ((i * 7) % 5))
+                out.append(q.take_up_to(f"t{i % 3}", 1 + i % 3)[0])
+            return out
+        assert run() == run()
+
+
+# ------------------------------------------------------------ breaker
+
+
+class TestDeviceMemoryBreaker:
+    def test_trip_half_open_close_transitions(self):
+        clock = FakeClock()
+        br = DeviceMemoryBreaker(limit_bytes=100, cooldown_s=1.0,
+                                 clock=clock)
+        br.enabled = True
+        err, probe = br.pre_wave(50)
+        assert err is None and not probe and br.state == br.CLOSED
+        err, probe = br.pre_wave(150)           # over limit: trips
+        assert err is not None and br.state == br.OPEN
+        assert err.reject_reason == "breaker:wave_memory"
+        assert err.metadata["bytes_wanted"] == 150
+        assert err.metadata["bytes_limit"] == 100
+        err, _ = br.pre_wave(10)                # still cooling down
+        assert err is not None
+        assert br.blocking() is not None        # admission sheds too
+        clock.advance(1.5)
+        err, probe = br.pre_wave(10)            # cooldown over: probe
+        assert err is None and probe and br.state == br.HALF_OPEN
+        err, _ = br.pre_wave(10)                # probe in flight
+        assert err is not None
+        br.on_result(True)                      # probe succeeded
+        assert br.state == br.CLOSED
+        assert br.blocking() is None
+
+    def test_failed_probe_reopens(self):
+        clock = FakeClock()
+        br = DeviceMemoryBreaker(limit_bytes=100, cooldown_s=1.0,
+                                 clock=clock)
+        br.enabled = True
+        br.pre_wave(150)
+        clock.advance(1.5)
+        err, probe = br.pre_wave(10)
+        assert err is None and probe
+        br.on_result(False)                     # probe failed
+        assert br.state == br.OPEN
+        err, _ = br.pre_wave(10)
+        assert err is not None                  # new cooldown running
+        assert br.trip_count == 1               # a re-open, not a trip
+
+    def test_gate_off_by_default(self):
+        br = DeviceMemoryBreaker()
+        assert br.enabled is False and br.gate() is None
+        sh = DeadlineShedder()
+        assert sh.enabled is False and sh.gate() is None
+        q = TenantQuotas()
+        assert q.enabled is False and q.gate() is None
+
+    def test_wave_breaker_sheds_msearch_items_never_5xx(self):
+        """The executor-side integration: a tripped breaker turns a
+        wave's items into per-item 429s through the PR 6 machinery; a
+        half-open probe closes it again through the REAL wave engine."""
+        node = make_node()
+        lines = []
+        for _ in range(4):
+            lines.append(json.dumps({"index": "t"}))
+            lines.append(json.dumps(SEARCH))
+        ndjson = "\n".join(lines) + "\n"
+        try:
+            WAVE_BREAKER.enabled = True
+            WAVE_BREAKER.limit_bytes = -1       # any live bytes trip
+            WAVE_BREAKER.cooldown_s = 0.0
+            resp = node.handle("POST", "/_msearch", body=ndjson)
+            assert resp.status == 200
+            items = resp.body["responses"]
+            assert len(items) == 4
+            for it in items:
+                assert it["status"] == 429
+                err = it["error"]
+                assert err["type"] == "circuit_breaking_exception"
+                assert err["reject_reason"] == "breaker:wave_memory"
+                assert err["durability"] == "TRANSIENT"
+                assert "bytes_limit" in err and "retry_after_ms" in err
+            assert WAVE_BREAKER.state == WAVE_BREAKER.OPEN
+            # cooldown 0: the next envelope's first wave is the
+            # half-open probe; raise the limit so it succeeds and
+            # closes the breaker — items serve normally again
+            WAVE_BREAKER.limit_bytes = 1 << 40
+            resp = node.handle("POST", "/_msearch", body=ndjson)
+            assert resp.status == 200
+            assert all(it["status"] == 200
+                       for it in resp.body["responses"])
+            assert WAVE_BREAKER.state == WAVE_BREAKER.CLOSED
+        finally:
+            WAVE_BREAKER.enabled = False
+            WAVE_BREAKER.limit_bytes = 256 << 20
+            WAVE_BREAKER.cooldown_s = 1.0
+            WAVE_BREAKER.reset()
+
+
+# ----------------------------------------------------- REST 429 shape
+
+
+class TestRejectionShape:
+    def test_single_search_429_body_and_retry_after_header(self):
+        node = make_node()
+        node.search_backpressure.max_concurrent = 0
+        try:
+            resp = node.handle("POST", "/t/_search",
+                               body=json.dumps(SEARCH))
+        finally:
+            node.search_backpressure.max_concurrent = 100
+        assert resp.status == 429
+        assert "Retry-After" in resp.headers
+        assert int(resp.headers["Retry-After"]) >= 1
+        err = resp.body["error"]
+        assert err["type"] == "circuit_breaking_exception"
+        assert err["reject_reason"] == "backpressure"
+        assert err["durability"] == "TRANSIENT"
+        assert err["bytes_wanted"] == 1 and err["bytes_limit"] == 0
+        assert err["retry_after_ms"] >= 1.0
+        assert err["tenant"] == "_default"
+        assert node.search_backpressure.current == 0
+
+    def test_msearch_per_item_429_objects_pin_shape(self):
+        node = make_node()
+        lines = []
+        for _ in range(5):
+            lines.append(json.dumps({"index": "t"}))
+            lines.append(json.dumps(SEARCH))
+        node.search_backpressure.max_concurrent = 2
+        try:
+            resp = node.handle("POST", "/_msearch",
+                               body="\n".join(lines) + "\n",
+                               headers={"X-Opaque-Id": "dash-7"})
+        finally:
+            node.search_backpressure.max_concurrent = 100
+        assert resp.status == 200
+        items = resp.body["responses"]
+        ok = [it for it in items if it["status"] == 200]
+        rej = [it for it in items if it["status"] == 429]
+        assert len(ok) == 2 and len(rej) == 3
+        for it in rej:
+            err = it["error"]
+            assert err["type"] == "circuit_breaking_exception"
+            assert err["reject_reason"] == "backpressure"
+            assert err["durability"] == "TRANSIENT"
+            assert err["tenant"] == "dash-7"
+            assert "retry_after_ms" in err
+        assert node.search_backpressure.current == 0
+
+    def test_tenant_quota_isolation_over_rest(self):
+        node = make_node(**{"admission.quota.enabled": "true",
+                            "admission.quota.tokens_per_sec": 0.0001,
+                            "admission.quota.burst": 3})
+        hot_status = [node.handle("POST", "/t/_search",
+                                  body=json.dumps(SEARCH),
+                                  params={"tenant": "hot"}).status
+                      for _ in range(5)]
+        assert hot_status[:3] == [200, 200, 200]
+        assert hot_status[3:] == [429, 429]
+        # fair share: a different tenant still serves
+        calm = node.handle("POST", "/t/_search", body=json.dumps(SEARCH),
+                           params={"tenant": "calm"})
+        assert calm.status == 200
+        # the X-Opaque-Id header is the tenant channel too
+        opaque = node.handle("POST", "/t/_search",
+                             body=json.dumps(SEARCH),
+                             headers={"X-Opaque-Id": "svc-a"})
+        assert opaque.status == 200
+        rej = node.handle("POST", "/t/_search", body=json.dumps(SEARCH),
+                          params={"tenant": "hot"})
+        assert rej.status == 429
+        assert rej.body["error"]["reject_reason"] == "tenant_quota"
+        assert rej.body["error"]["tenant"] == "hot"
+        st = node.request("GET", "/_nodes/stats")
+        adm = st["nodes"][node.node_id]["search_backpressure"]["admission"]
+        tenants = adm["tenant_quota"]["tenants"]
+        assert tenants["hot"]["admitted"] == 3
+        assert tenants["hot"]["rejected"] == 3
+        assert tenants["calm"]["admitted"] == 1
+        assert tenants["svc-a"]["admitted"] == 1
+        assert adm["rejections_by_reason"]["tenant_quota"] == 3
+
+    def test_deadline_shed_over_rest_with_retry_after(self):
+        node = make_node(**{"admission.shed.enabled": "true"})
+        sh = node.search_backpressure.shedder
+        sh.min_samples = 1
+        sh.probe_interval_s = 1e9
+        sh._last_probe = sh._clock()
+        for _ in range(8):
+            sh.observe(50.0)        # pretend the node is slow
+        # a request that allows 10ms cannot be served behind a 50ms
+        # queue: shed at arrival with a computed Retry-After
+        resp = node.handle("POST", "/t/_search",
+                           body=json.dumps({**SEARCH, "timeout": "10ms"}))
+        assert resp.status == 429
+        err = resp.body["error"]
+        assert err["reject_reason"] == "deadline_shed"
+        assert err["retry_after_ms"] > 0
+        assert "Retry-After" in resp.headers
+        # without a deadline and no SLO setting there is no budget:
+        # the same slow node still serves unbounded requests
+        resp = node.handle("POST", "/t/_search", body=json.dumps(SEARCH))
+        assert resp.status == 200
+        assert node.search_backpressure.shedder.shed_total >= 1
+        assert node.search_backpressure.current == 0
+
+    def test_malformed_setting_400s_without_persisting(self):
+        """A bad admission value must reject BEFORE the store commits:
+        a persisted bad key would 500 every later settings update and
+        fail node restart from the gateway."""
+        node = make_node()
+        r = node.request("PUT", "/_cluster/settings", {
+            "transient": {"admission.shed.slo_ms": "fast"}})
+        assert r["_status"] == 400, r
+        assert "admission.shed.slo_ms" not in \
+            node.cluster_settings["transient"]
+        # the store stayed clean: an unrelated follow-up update works
+        r = node.request("PUT", "/_cluster/settings", {
+            "transient": {"search.backpressure.max_concurrent": 50}})
+        assert r["_status"] == 200
+        assert node.search_backpressure.max_concurrent == 50
+
+    def test_breaker_singleton_resets_per_node(self):
+        """WAVE_BREAKER is process-wide (the executor reads it): a
+        breaker-configured node must not leak its config into the next
+        default-configured node in the same process."""
+        from opensearch_tpu.node import Node
+        Node(settings={"admission.breaker.wave_memory.enabled": "true",
+                       "admission.breaker.wave_memory.limit_bytes":
+                           "1b"})
+        assert WAVE_BREAKER.enabled is True
+        assert WAVE_BREAKER.limit_bytes == 1
+        fresh = Node()
+        assert fresh.search_backpressure.wave_breaker is WAVE_BREAKER
+        assert WAVE_BREAKER.enabled is False
+        assert WAVE_BREAKER.limit_bytes == 256 << 20
+
+    def test_estimator_ignores_contended_walls(self):
+        """Only near-exclusive walls feed the predictor: contended
+        walls double-count queueing, and a cheap-traffic slice must
+        not pin the estimate and disable shedding."""
+        sh = DeadlineShedder()
+        sh.enabled = True
+        for _ in range(32):
+            sh.observe(500.0, depth=8)      # contended: discarded
+        assert sh.observed_total == 0
+        assert sh.service_ms.quantile(0.5) is None
+        for _ in range(32):
+            sh.observe(10.0, depth=1)       # near-exclusive: kept
+        assert sh.observed_total == 32
+        assert sh.predicted_ms(0) == pytest.approx(10.0, rel=0.1)
+
+    def test_breaker_blocking_reports_trip_bytes(self):
+        clock = FakeClock()
+        br = DeviceMemoryBreaker(limit_bytes=100, cooldown_s=10.0,
+                                 clock=clock)
+        br.enabled = True
+        br.pre_wave(150)                    # trips at 150 bytes
+        err = br.blocking()
+        assert err.metadata["bytes_wanted"] == 150   # not a bogus 0
+        assert "[150]" in err.reason
+
+    def test_dynamic_cluster_settings_update(self):
+        node = make_node()
+        assert node.search_backpressure.shedder.enabled is False
+        r = node.request("PUT", "/_cluster/settings", {
+            "transient": {"admission.shed.enabled": "true",
+                          "admission.shed.slo_ms": 25,
+                          "admission.quota.enabled": "true",
+                          "admission.quota.tenant.vip.tokens_per_sec":
+                              500}})
+        assert r["_status"] == 200
+        bp = node.search_backpressure
+        assert bp.shedder.enabled is True
+        assert bp.shedder.slo_ms == 25.0
+        assert bp.quotas.enabled is True
+        assert bp.quotas._overrides["vip"] == (500.0, 500.0)
+        r = node.request("PUT", "/_cluster/settings", {
+            "transient": {"admission.shed.enabled": None,
+                          "admission.quota.enabled": "false"}})
+        assert node.search_backpressure.quotas.enabled is False
+
+
+# ------------------------------------------- permits + reject lifecycle
+
+
+class TestPermitInvariant:
+    def test_malformed_timeout_400s_without_consuming_a_permit(self):
+        node = make_node()
+        bp = node.search_backpressure
+        base = (bp.admitted_total, bp.released_total)
+        resp = node.handle("POST", "/t/_search", body=json.dumps(
+            {**SEARCH, "timeout": "not-a-time"}))
+        assert resp.status == 400
+        assert (bp.admitted_total, bp.released_total) == base
+        assert bp.current == 0
+
+    def test_exception_after_admit_releases_the_permit(self):
+        node = make_node()
+        bp = node.search_backpressure
+        faults.clear()
+        # single-shard node: a query.dispatch fault fails every shard,
+        # so the typed error ESCAPES execute_search after the permit
+        # was acquired — exactly the leak window the audit closed
+        faults.install({"site": "query.dispatch", "kind": "exception",
+                        "max_fires": 1})
+        try:
+            resp = node.handle("POST", "/t/_search",
+                               body=json.dumps(SEARCH))
+        finally:
+            faults.clear()
+        assert resp.status >= 400      # the typed error surfaced
+        assert resp.body["error"].get("type"), resp.body
+        assert bp.current == 0
+        assert bp.admitted_total == bp.released_total
+
+    def test_reject_lifecycle_event_carries_reason_and_tenant(self):
+        node = make_node()
+        flight = TELEMETRY.flight
+        prev = (flight.enabled, flight.threshold_ms)
+        flight.enabled = True
+        flight.threshold_ms = 0.0      # capture every completion
+        flight.clear()
+        node.search_backpressure.max_concurrent = 0
+        try:
+            resp = node.handle("POST", "/t/_search",
+                               body=json.dumps(SEARCH),
+                               params={"tenant": "acme"})
+            assert resp.status == 429
+            captured = flight.captured()
+        finally:
+            node.search_backpressure.max_concurrent = 100
+            flight.enabled, flight.threshold_ms = prev
+            flight.clear()
+        rejects = [ev for rec in captured
+                   for ev in rec["events"] if ev["event"] == "reject"]
+        assert rejects, captured
+        assert rejects[0]["reason"] == "backpressure"
+        assert rejects[0]["tenant"] == "acme"
+        # tools/tail_report.py groups rejection captures by reason
+        import tail_report
+        groups = tail_report.rejection_groups(captured)
+        assert groups == {"backpressure[acme]": {
+            "captures": 1, "items": 1,
+            "max_took_ms": captured[0]["took_ms"]}}
+
+
+# -------------------------------------------- chaos under concurrency
+
+
+def _load_chaos_tool():
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "chaos_sweep.py")
+    spec = importlib.util.spec_from_file_location("chaos_sweep", tool)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_chaos_under_concurrency_zero_5xx_zero_leaks():
+    """Seeded faults at query.dispatch / fetch.gather fire WHILE 4
+    open-loop clients drive the REST path: zero 5xx (every fault
+    renders a partial 200 or a 429), zero serve exceptions, permits
+    back to baseline, goodput floor held."""
+    mod = _load_chaos_tool()
+    try:
+        summary, violations = mod.run_chaos_concurrent(
+            clients=4, n_requests=48, rate=300.0)
+    finally:
+        faults.clear()
+    assert violations == [], violations
+    assert summary["failed"] == 0 and summary["errors"] == 0
+    assert summary["ok"] >= int(0.9 * 48)
+
+
+# ------------------------------------------------ bench_compare shape
+
+
+class TestOverloadCompare:
+    def _curve(self, goodputs, p99s=None, slo=50.0):
+        out = []
+        for i, g in enumerate(goodputs):
+            rec = {"mode": f"bm25_overload_{i}x",
+                   "offered_rate": 100.0 * (i + 1),
+                   "goodput_qps": g, "slo_ms": slo}
+            if p99s is not None:
+                rec["admitted_p99_ms"] = p99s[i]
+            out.append(rec)
+        return {r["mode"]: r for r in out}
+
+    def test_plateau_passes(self):
+        import bench_compare
+        old = self._curve([100, 300, 310, 305], [10, 20, 40, 45])
+        new = self._curve([100, 300, 300, 290], [10, 20, 42, 44])
+        rows, failures = bench_compare.compare_overload(old, new, 10.0)
+        assert failures == []
+        assert any(r.get("past_knee") for r in rows)
+
+    def test_goodput_collapse_past_knee_fails(self):
+        import bench_compare
+        old = self._curve([100, 300, 310, 305])
+        new = self._curve([100, 300, 310, 150])     # collapses at 4x
+        rows, failures = bench_compare.compare_overload(old, new, 10.0)
+        assert any("goodput" in f for f in failures)
+
+    def test_pre_knee_dip_never_fails(self):
+        import bench_compare
+        old = self._curve([100, 200, 310, 305])
+        new = self._curve([50, 200, 310, 300])      # pre-knee box noise
+        rows, failures = bench_compare.compare_overload(old, new, 10.0)
+        assert failures == []
+
+    def test_admitted_p99_breach_fails(self):
+        import bench_compare
+        old = self._curve([100, 300, 310, 305], [10, 20, 40, 45])
+        new = self._curve([100, 300, 310, 305], [10, 20, 40, 80])
+        rows, failures = bench_compare.compare_overload(old, new, 10.0)
+        assert any("p99" in f for f in failures)
+
+    def test_non_overload_records_ignored(self):
+        import bench_compare
+        plain = {"bm25": {"mode": "bm25", "warm_p50_ms": 5.0}}
+        rows, failures = bench_compare.compare_overload(plain, plain,
+                                                        10.0)
+        assert rows == [] and failures == []
+
+    def test_warm_compare_skips_overload_records(self):
+        """Ramp points carry bare p50/p99 that are open-loop intended-
+        arrival latencies — unbounded past saturation by construction.
+        The ordinary warm gate must not double-gate them (only
+        compare_overload's goodput/admitted-p99 rules apply)."""
+        import bench_compare
+        old = {"bm25_overload_3x": {
+            "mode": "bm25_overload_3x", "offered_rate": 300.0,
+            "goodput_qps": 100.0, "clients": 16,
+            "p50_ms": 100.0, "p99_ms": 400.0}}
+        new = {"bm25_overload_3x": {
+            "mode": "bm25_overload_3x", "offered_rate": 300.0,
+            "goodput_qps": 100.0, "clients": 16,
+            "p50_ms": 4000.0, "p99_ms": 9000.0}}     # 10x "worse"
+        rows, failures = bench_compare.compare(old, new, 10.0)
+        assert failures == [] and rows == []

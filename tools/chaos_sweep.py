@@ -224,6 +224,20 @@ def _classify(resp, expect, clean, surviving_oracle, row, violations):
     return f"partial-200 failed={failed}"
 
 
+def _check_permits(node, row, violations):
+    """The permit-leak invariant (ISSUE 11): after a row quiesces, the
+    backpressure gate must be back at baseline — current == 0 and the
+    admitted/released counters equal. An exception anywhere between
+    acquire() and the release in the REST layer's finally would show up
+    here as a permanent slot leak that eventually 429s everything."""
+    bp = node.search_backpressure
+    if bp.current != 0 or bp.admitted_total != bp.released_total:
+        violations.append(
+            f"{row}: permit leak (current={bp.current}, "
+            f"admitted={bp.admitted_total}, "
+            f"released={bp.released_total})")
+
+
 def _rule(site, kind):
     spec = {"site": site, "kind": kind, "seed": 0}
     if kind == "exception":
@@ -285,10 +299,12 @@ def run_sweep(fast: bool = False):
                                         logs_shards, row, violations)
             finally:
                 faults.clear()
+            _check_permits(node, row, violations)
             rows.append((site, kind, workload, outcome))
 
     rows.extend(_scenario_rows(node, clean_search, logs_shards,
                                hyb_shards, violations, fast))
+    _check_permits(node, "scenario-rows", violations)
     faults.clear()
     return rows, violations
 
@@ -414,8 +430,124 @@ def _scenario_rows(node, clean_search, logs_shards, hyb_shards,
     return rows
 
 
+def run_chaos_concurrent(clients: int = 4, n_requests: int = 96,
+                         rate: float = 150.0, seed: int = 3,
+                         node=None):
+    """Chaos UNDER concurrency (ISSUE 11): seeded faults fire at
+    `query.dispatch` (permanent, per-shard) and `fetch.gather`
+    (transient, retry-absorbed) WHILE `clients` open-loop workers drive
+    the REST search path on a Poisson schedule — the sequential sweep
+    above proves per-row fault handling, this proves it while the
+    permit gate, the wave engine and the retry helper are all
+    contended.
+
+    The contract checked (returns (summary, violations)):
+      - zero 5xx: every completed request is a 200 (partial or full)
+        or an admission 429 — a fault under concurrency must never
+        escape as a raw error;
+      - zero serve exceptions (the in-process path never raises);
+      - zero permit leaks: the backpressure gate is back at baseline
+        after the run (counter invariant, `_check_permits`);
+      - goodput floor: >= 90% of requests complete as 200s (faults
+        cost shard slices, not requests; admission sheds only under
+        genuine pressure).
+
+    Fault schedule: STAGGERED single-fire rules (skip + max_fires=1)
+    instead of per-invocation probability draws. Same-site fire points
+    sit further apart than any one request's invocation span, so no
+    request can ever absorb more than one fire per site — at most 2 of
+    its 3 shards fail, which the partial-failure contract renders as a
+    200, NEVER the all-shards-failed 503. That makes "zero 5xx" a
+    deterministic property of the schedule under ANY thread
+    interleaving, not a probabilistic hope (a p=0.15 draw per
+    invocation measurably lands 3 fires in one request and 503s)."""
+    import json as _json
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import openloop
+
+    from opensearch_tpu.common import faults
+
+    faults.clear()
+    owns_node = node is None
+    if owns_node:
+        node = build_corpus()
+    violations: list = []
+    # warm the executables so the measured window exercises fault
+    # handling, not compiles
+    clean = node.request("POST", "/logs/_search", SEARCH_BODY)
+    assert clean["_status"] == 200, clean
+    bodies = [{**SEARCH_BODY, "size": 4 + (i % 3) * 8}
+              for i in range(n_requests)]
+    for b in bodies[:6]:
+        node.request("POST", "/logs/_search", b)
+    base_admitted = node.search_backpressure.admitted_total
+    base_released = node.search_backpressure.released_total
+
+    statuses_5xx = []
+
+    def serve(body):
+        resp = node.handle("POST", "/logs/_search",
+                           body=_json.dumps(body))
+        if resp.status >= 500:
+            statuses_5xx.append((resp.status, resp.body))
+        return resp.status
+
+    # staggered deterministic fires (see docstring): a request spends 3
+    # query.dispatch invocations (one per shard) and well under 100
+    # fetch.gather invocations (page hits), so same-site gaps of 90 /
+    # 400 guarantee one fire per site per request at most
+    for skip in (10, 100, 190):
+        faults.install({"site": "query.dispatch", "kind": "exception",
+                        "skip": skip, "max_fires": 1})
+    for skip in (50, 450, 850):
+        faults.install({"site": "fetch.gather", "kind": "transient",
+                        "skip": skip, "max_fires": 1})
+    try:
+        res = openloop.run_open_loop(serve, bodies, clients=clients,
+                                     arrival_rate=rate, seed=seed)
+    finally:
+        faults.clear()
+    if statuses_5xx:
+        violations.append(
+            f"concurrent-chaos: {len(statuses_5xx)} 5xx response(s), "
+            f"first: {str(statuses_5xx[0])[:200]}")
+    if res["errors"]:
+        violations.append(
+            f"concurrent-chaos: {res['errors']} serve exception(s)")
+    bp = node.search_backpressure
+    if bp.current != 0 or \
+            (bp.admitted_total - base_admitted) \
+            != (bp.released_total - base_released):
+        violations.append(
+            f"concurrent-chaos: permit leak (current={bp.current}, "
+            f"admitted+{bp.admitted_total - base_admitted}, "
+            f"released+{bp.released_total - base_released})")
+    if res["ok"] < 0.9 * n_requests:
+        violations.append(
+            f"concurrent-chaos: goodput floor broken "
+            f"({res['ok']}/{n_requests} 200s)")
+    summary = {"clients": clients, "n_requests": n_requests,
+               "ok": res["ok"], "rejected": res["rejected"],
+               "failed": res["failed"], "errors": res["errors"],
+               "goodput_qps": res["goodput_qps"],
+               "p99_ms": res["p99_ms"]}
+    return summary, violations
+
+
 def main():
     fast = "--fast" in sys.argv
+    if "--concurrency" in sys.argv:
+        summary, violations = run_chaos_concurrent()
+        print("chaos-under-concurrency:", json.dumps(summary))
+        if violations:
+            print(f"\n{len(violations)} contract violation(s):")
+            for v in violations:
+                print(" ", v)
+            sys.exit(1)
+        print("chaos-under-concurrency clean: zero 5xx, zero permit "
+              "leaks, goodput floor held")
+        return
     rows, violations = run_sweep(fast=fast)
     w_site = max(len(r[0]) for r in rows)
     w_kind = max(len(r[1]) for r in rows)

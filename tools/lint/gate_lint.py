@@ -44,6 +44,15 @@ GATED_SUBSYSTEMS = (
      ("check",)),
     ("opensearch_tpu/telemetry/lifecycle.py", "FlightRecorder", "enabled",
      ("timeline",)),
+    # ISSUE 11 admission stages: every adaptive stage of the admission
+    # pipeline (quota -> breaker -> deadline shed) is OFF by default —
+    # the default node keeps the static permit gate exactly
+    ("opensearch_tpu/common/admission.py", "TenantQuotas", "enabled",
+     ("gate",)),
+    ("opensearch_tpu/common/admission.py", "DeadlineShedder", "enabled",
+     ("gate",)),
+    ("opensearch_tpu/common/admission.py", "DeviceMemoryBreaker",
+     "enabled", ("gate",)),
 )
 
 # no-op constants a disabled gate may return

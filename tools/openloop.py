@@ -65,7 +65,20 @@ def run_open_loop(serve: Callable, items: Sequence, clients: int = 8,
     safe); queue_wait[i] = max(service start − intended arrival, 0);
     service[i] = completion − service start (the closed-loop-style
     number, reported so the two can be compared — the CO test asserts
-    they diverge under a stall)."""
+    they diverge under a stall).
+
+    Goodput (the overload-sweep contract, ISSUE 11): `serve` may return
+    an HTTP status int (or an object with `.status`) and each request
+    classifies as ok (< 400), **rejected** (429 — an admission shed) or
+    error (any other 4xx/5xx; raising still counts under `errors`). The
+    digest splits the percentiles: `admitted_*` are service-time
+    percentiles over OK requests only (the "admitted p99 stays bounded"
+    number — open-loop latency from intended arrival grows without
+    bound past saturation by construction, so it cannot be the SLO
+    gate), `rejected_p99_ms` is the service-time p99 of sheds (the
+    "rejected in <5 ms" check), and `goodput_qps` counts only OK
+    completions. A None return keeps the old contract: everything that
+    didn't raise is ok."""
     n = len(items)
     sched = list(schedule) if schedule is not None \
         else poisson_schedule(n, arrival_rate, seed)
@@ -74,6 +87,7 @@ def run_open_loop(serve: Callable, items: Sequence, clients: int = 8,
     lat = [0.0] * n
     qwait = [0.0] * n
     service = [0.0] * n
+    status = [0] * n            # 0 = ok-by-default (None return)
     errors = [0]
     next_i = [0]
     lock = threading.Lock()
@@ -92,8 +106,12 @@ def run_open_loop(serve: Callable, items: Sequence, clients: int = 8,
                 time.sleep(intended - now)
             t_start = time.monotonic()
             try:
-                serve(items[i])
+                out = serve(items[i])
+                st = getattr(out, "status", out)
+                if isinstance(st, int):
+                    status[i] = st
             except Exception:
+                status[i] = -1
                 with lock:
                     errors[0] += 1
             t_end = time.monotonic()
@@ -109,8 +127,14 @@ def run_open_loop(serve: Callable, items: Sequence, clients: int = 8,
     for th in threads:
         th.join()
     wall_s = time.monotonic() - t0
+    ok_i = [i for i in range(n) if 0 <= status[i] < 400]
+    rej_i = [i for i in range(n) if status[i] == 429]
+    err_i = [i for i in range(n)
+             if status[i] >= 400 and status[i] != 429]
     s_lat = sorted(lat)
     s_srv = sorted(service)
+    s_ok_srv = sorted(service[i] for i in ok_i)
+    s_rej_srv = sorted(service[i] for i in rej_i)
     return {
         "clients": max(int(clients), 1),
         "arrival_rate": arrival_rate,
@@ -126,9 +150,20 @@ def run_open_loop(serve: Callable, items: Sequence, clients: int = 8,
         "service_p50_ms": round(percentile(s_srv, 0.50), 2),
         "service_p99_ms": round(percentile(s_srv, 0.99), 2),
         "errors": errors[0],
+        # goodput split (admission-aware callers; all-ok otherwise)
+        "ok": len(ok_i),
+        "rejected": len(rej_i),
+        "failed": len(err_i),
+        "goodput_qps": round(len(ok_i) / wall_s, 2) if wall_s > 0
+        else 0.0,
+        "admitted_p50_ms": round(percentile(s_ok_srv, 0.50), 2),
+        "admitted_p99_ms": round(percentile(s_ok_srv, 0.99), 2),
+        "rejected_p50_ms": round(percentile(s_rej_srv, 0.50), 2),
+        "rejected_p99_ms": round(percentile(s_rej_srv, 0.99), 2),
         # raw per-request arrays for downstream analysis; strip before
         # serializing a bench record
         "latencies_ms": lat,
         "queue_waits_ms": qwait,
         "service_ms": service,
+        "statuses": status,
     }

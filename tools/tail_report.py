@@ -144,6 +144,37 @@ def render_table(rows: List[dict]) -> str:
                           "attr_pct"])
 
 
+def rejection_groups(records: List[dict]) -> Dict[str, dict]:
+    """Group captures that carry a `reject` lifecycle event by the
+    structured reason + tenant the admission controller stamped
+    (`deadline_shed` | `tenant_quota` | `breaker:<name>` |
+    `backpressure`, ISSUE 11). `items` sums per-item msearch rejects
+    (the event's `items` field, 1 for the single-search path);
+    `reject_ms` tracks how fast the node turned the rejections around —
+    the <5 ms shed-latency contract, eyeballable per group."""
+    groups: Dict[str, dict] = {}
+    for rec in records:
+        for ev in rec.get("events") or []:
+            if ev.get("event") != "reject":
+                continue
+            key = f"{ev.get('reason', '?')}" \
+                  f"[{ev.get('tenant', '_default')}]"
+            g = groups.setdefault(
+                key, {"captures": 0, "items": 0, "max_took_ms": 0.0})
+            g["captures"] += 1
+            g["items"] += int(ev.get("items", 1))
+            g["max_took_ms"] = max(g["max_took_ms"],
+                                   float(rec.get("took_ms") or 0.0))
+    return groups
+
+
+def render_rejections(groups: Dict[str, dict]) -> str:
+    rows = [{"reason": k, **{kk: f"{vv:g}" if kk == "max_took_ms"
+                             else vv for kk, vv in v.items()}}
+            for k, v in sorted(groups.items())]
+    return _render(rows, ["reason", "captures", "items", "max_took_ms"])
+
+
 def main(argv: List[str]) -> int:
     min_attr = None
     args: List[str] = []
@@ -165,6 +196,13 @@ def main(argv: List[str]) -> int:
     print(f"{len(records)} captured slow request(s)   "
           f"(* = device_get nested inside query, not summed)")
     print(render_table(rows))
+    groups = rejection_groups(records)
+    if groups:
+        print(f"\nrejections by reason "
+              f"({sum(g['items'] for g in groups.values())} item(s) "
+              f"across {sum(g['captures'] for g in groups.values())} "
+              f"capture(s)):")
+        print(render_rejections(groups))
     attrs = [r["attr_pct"] for r in rows]
     print(f"\nattribution: min {min(attrs):.1f}%  "
           f"mean {sum(attrs) / len(attrs):.1f}%")
