@@ -577,6 +577,43 @@ class AdmissionController:
         self.quotas = TenantQuotas(clock=clock)
         self.shedder = DeadlineShedder()
         self.wave_breaker = WAVE_BREAKER
+        # the wave scheduler's queue-depth feed (search/scheduler.py):
+        # when the scheduler is enabled, admitted requests WAIT in its
+        # bounded queue before executing, so the deadline-shed stage
+        # must price arrivals against permits-in-flight PLUS that real
+        # queue — set by Node to the scheduler's queue_depth. None =
+        # no scheduler (the PR 11 behavior exactly).
+        self.queue_depth_extra: Optional[Any] = None
+
+    def queue_depth(self) -> int:
+        """The serial-queue depth the shed predictor prices with —
+        `predict_queue_ms`'s depth term. MAX of permits in flight and
+        the wave scheduler's queued count, never their sum: a
+        scheduler-queued REST request HOLDS its permit across the
+        coalesce window, so it is already inside `current` and adding
+        the queue on top would price arrivals at ~2× the real depth
+        (exactly the over-estimate the predictor's docstring warns
+        death-spirals the shed). The max still covers direct callers
+        whose queued work holds no permit."""
+        extra = self.queue_depth_extra
+        if extra is None:
+            return self.current
+        return max(self.current, int(extra()))
+
+    def refund_unserved(self, tenant: Optional[str] = None) -> None:
+        """Refund the quota token of an ADMITTED request that a post-
+        admission stage (the wave scheduler shedding at deadline, or
+        its bounded queue rejecting) dropped before execution: the
+        request never ran, so it must not count against its tenant's
+        fair share (the TenantQuotas.refund contract, extended across
+        the coalesce window). The PERMIT needs no special handling —
+        the request thread holds it across the window and the REST
+        layer's finally releases it, which is exactly what keeps the
+        admitted_total == released_total invariant checkable for
+        scheduler-queued requests."""
+        quotas = self.quotas.gate()
+        if quotas is not None:
+            quotas.refund(tenant or DEFAULT_TENANT, 1)
 
     # ------------------------------------------------------------ rejection
 
@@ -665,7 +702,7 @@ class AdmissionController:
                 _downstream_reject(err)
         shedder = self.shedder.gate()
         if shedder is not None:
-            predicted = shedder.check(self.current, deadline)
+            predicted = shedder.check(self.queue_depth(), deadline)
             if predicted is not None:
                 _downstream_reject(self.rejection_error(
                     REASON_DEADLINE, tenant=tenant,
@@ -731,17 +768,18 @@ class AdmissionController:
                 err, m = err or berr, 0
         shedder = self.shedder.gate()
         if shedder is not None and m > 0:
+            depth = self.queue_depth()
             fit = shedder.max_admissible(
-                self.current, shedder.budget_ms(deadline), m)
+                depth, shedder.budget_ms(deadline), m)
             if fit < m:
                 self._count_reject(REASON_DEADLINE, m - fit)
                 # Retry-After = the predicted queue time for the FIRST
-                # clipped item (behind current + the fit just admitted)
-                # — the same estimate the single path reports
+                # clipped item (behind the queue + the fit just
+                # admitted) — the same estimate the single path reports
                 err = err or self.rejection_error(
                     REASON_DEADLINE, tenant=tenant,
                     retry_after_ms=shedder.predicted_ms(
-                        self.current + fit) or None)
+                        depth + fit) or None)
                 m = fit
         with self._lock:
             free = max(0, self.max_concurrent - self.current)

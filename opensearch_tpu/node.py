@@ -74,6 +74,19 @@ class Node:
         self.search_backpressure = SearchBackpressure()
         self.search_backpressure.apply_settings(
             _Settings(self.settings).as_dict())
+        # async wave scheduler (search/scheduler.py): coalesce
+        # concurrent independent searches into shared device waves. OFF
+        # by default (None-returning gate); `search.scheduler.enabled`
+        # node/dynamic cluster setting or POST /_scheduler/_enable
+        # turns it on. The admission controller prices deadline sheds
+        # against the scheduler's real queue once wired.
+        from opensearch_tpu.search.scheduler import WaveScheduler
+        self.wave_scheduler = WaveScheduler(
+            admission=self.search_backpressure)
+        self.search_backpressure.queue_depth_extra = \
+            self.wave_scheduler.queue_depth
+        self.wave_scheduler.apply_settings(
+            _Settings(self.settings).as_dict())
         self.gateway = None
         if data_path is not None:
             from opensearch_tpu.gateway import Gateway
@@ -143,6 +156,7 @@ class Node:
             Settings(self.cluster_settings.get("transient") or {})
             .as_dict())
         self.search_backpressure.apply_settings(merged)
+        self.wave_scheduler.apply_settings(merged)
 
     def persist_metadata(self):
         """Write node metadata through the gateway (no-op without a data

@@ -215,16 +215,41 @@ def _run_search(node, index_expr: Optional[str], body: Optional[dict],
         t_exec0 = time.monotonic()
         try:
             if tl is not None:
-                # today's gate admits or rejects immediately, so
-                # queue_wait reads ~0 — the field the item-1 wave
-                # scheduler fills with real micro-batch queue delay
+                # the admission gate's own wait (~0; the scheduler's
+                # coalesce window adds its REAL queue delay below)
                 tl.queue_wait((t_exec0 - t_admit) * 1000)
                 tl.event("admit")
-            res = execute_search(executors, body, extra_filters=filters,
-                                 task=task, allow_envelope=True,
-                                 phase_processors=phase_spec,
-                                 trace=root, phase_times=phase_times,
-                                 allow_partial=_cluster_allow_partial(node))
+            # wave scheduler (search/scheduler.py): an eligible plain
+            # single-index request enqueues into the coalescing queue
+            # instead of executing inline — the permit + quota token
+            # stay HELD by this blocked thread across the window (the
+            # finally below releases the permit, preserving the PR 11
+            # counter invariant), and a request the scheduler shed at
+            # deadline or rejected queue-full refunds its quota token:
+            # it never executed. Disabled: one attribute load + branch.
+            sched = node.wave_scheduler.gate()
+            if sched is not None and pipeline is None \
+                    and len(executors) == 1 \
+                    and not (filters and filters[0]) \
+                    and sched.eligible(body):
+                from opensearch_tpu.common.errors import \
+                    AdmissionRejectedError
+                try:
+                    res, _shed = sched.execute(
+                        executors[0], body, deadline=deadline,
+                        timeline=tl, tenant=tenant, task=task)
+                except AdmissionRejectedError:
+                    node.search_backpressure.refund_unserved(tenant)
+                    raise
+                if _shed:
+                    node.search_backpressure.refund_unserved(tenant)
+            else:
+                res = execute_search(
+                    executors, body, extra_filters=filters,
+                    task=task, allow_envelope=True,
+                    phase_processors=phase_spec,
+                    trace=root, phase_times=phase_times,
+                    allow_partial=_cluster_allow_partial(node))
         finally:
             node.task_manager.unregister(task)
             # the measured service wall feeds the deadline-shed
@@ -916,16 +941,54 @@ def register_search_actions(node, c):
                                 tenant=tenant or "_default",
                                 items=len(bodies) - admitted)
                         tl_prev = flight.bind(tl)
-                    if admitted == len(bodies):
-                        res = node.indices.get(names[0]).multi_search(
-                            bodies, task=task, deadline=deadline)
-                    else:
+                    svc = node.indices.get(names[0])
+                    sched = node.wave_scheduler.gate()
+                    if sched is not None and admitted \
+                            and svc.num_shards == 1 \
+                            and len(bodies) <= \
+                            sched.msearch_coalesce_max \
+                            and all(sched.eligible(b)
+                                    for b in bodies[:admitted]):
+                        # wave scheduler: the envelope's admitted items
+                        # enqueue as one unit and coalesce with
+                        # whatever OTHER requests the window collects
+                        # (cross-envelope shared waves). Permits stay
+                        # held by this thread (release_batch in the
+                        # finally); quota tokens of items the scheduler
+                        # shed at deadline — or queue-full-rejected,
+                        # rendered per-item through the PR 6 machinery
+                        # — refund: they never executed.
+                        from opensearch_tpu.common.errors import \
+                            AdmissionRejectedError
                         from opensearch_tpu.search.executor import \
                             _item_error
-                        res = node.indices.get(names[0]).multi_search(
+                        svc.check_open()
+                        try:
+                            sub, shed_n = sched.execute_many(
+                                svc.shards[0].executor,
+                                bodies[:admitted], deadline=deadline,
+                                timeline=tl, tenant=tenant, task=task)
+                        except AdmissionRejectedError as qfull:
+                            shed_n = admitted
+                            item = _item_error(qfull)
+                            sub = [dict(item) for _ in range(admitted)]
+                        for _ in range(shed_n):
+                            node.search_backpressure.refund_unserved(
+                                tenant)
+                        res = {"took": int((time.monotonic() - t_exec0)
+                                           * 1000),
+                               "responses": sub}
+                    elif admitted == len(bodies):
+                        res = svc.multi_search(
+                            bodies, task=task, deadline=deadline)
+                    else:
+                        res = svc.multi_search(
                             bodies[:admitted], task=task,
                             deadline=deadline) if admitted else \
                             {"took": 0, "responses": []}
+                    if admitted < len(bodies):
+                        from opensearch_tpu.search.executor import \
+                            _item_error
                         rejected = _item_error(
                             reject if reject is not None else
                             node.search_backpressure.rejection_error(
@@ -1441,6 +1504,8 @@ def register_cluster_actions(node, c):
         merged.update(Settings(candidate["persistent"]).as_dict())
         merged.update(Settings(candidate["transient"]).as_dict())
         AdmissionController.parse_settings(merged)  # raises -> 400
+        from opensearch_tpu.search.scheduler import WaveScheduler
+        WaveScheduler.parse_settings(merged)        # raises -> 400
         node.cluster_settings["persistent"] = candidate["persistent"]
         node.cluster_settings["transient"] = candidate["transient"]
         # dynamic admission/quota/breaker settings take effect on the
@@ -1534,6 +1599,7 @@ def register_cluster_actions(node, c):
                 "breakers": node.breaker_service.stats(),
                 "indexing_pressure": node.indexing_pressure.stats(),
                 "search_backpressure": node.search_backpressure.stats(),
+                "scheduler": node.wave_scheduler.stats(),
                 "thread_pool": node.threadpool.stats(),
                 "os": _os_probe(),
                 "process": {**_process_probe(),
@@ -2344,6 +2410,47 @@ def register_task_actions(node, c):
     c.register("GET", "/_cat/tasks", cat_tasks)
 
 
+# ---------------------------------------------------------- wave scheduler
+
+def register_scheduler_actions(node, c):
+    """The async wave scheduler's REST face (search/scheduler.py):
+    runtime enable/disable (the dynamic-cluster-setting analog for
+    operators without settings access) + the stats block. Disabling
+    drains the queue — every queued request completes first."""
+
+    def do_stats(req):
+        return {"scheduler": node.wave_scheduler.stats()}
+
+    def do_enable(req):
+        s = node.wave_scheduler
+        w = req.param("window_ms")
+        if w is not None:
+            # same validation as the cluster-settings path
+            # (parse_settings' >= 0 rule): a negative cap would clamp
+            # every window to 0 and silently disable coalescing while
+            # reporting enabled
+            try:
+                w_val = float(w)
+            except (TypeError, ValueError):
+                raise IllegalArgumentError(
+                    f"failed to parse [window_ms] with value [{w!r}]")
+            if w_val < 0:
+                raise IllegalArgumentError(
+                    f"[window_ms] must be >= 0, got [{w!r}]")
+            s.window_max_ms = w_val
+        s.set_enabled(True)
+        return {"acknowledged": True, "enabled": True,
+                "window_max_ms": s.window_max_ms}
+
+    def do_disable(req):
+        node.wave_scheduler.set_enabled(False)
+        return {"acknowledged": True, "enabled": False}
+
+    c.register("GET", "/_scheduler", do_stats)
+    c.register("POST", "/_scheduler/_enable", do_enable)
+    c.register("POST", "/_scheduler/_disable", do_disable)
+
+
 def register_all(node):
     c = node.controller
     register_cluster_actions(node, c)
@@ -2359,3 +2466,4 @@ def register_all(node):
     register_task_actions(node, c)
     register_telemetry_actions(node, c)
     register_fault_actions(node, c)
+    register_scheduler_actions(node, c)

@@ -440,6 +440,39 @@ class _MsearchWave:
         # breaker's single half-open probe (common/admission.py)
 
 
+class _TimelineFan:
+    """Fan one wave's lifecycle events out to every owning request's
+    timeline. When the wave scheduler (search/scheduler.py) packs
+    sub-requests from DIFFERENT requests into one shared wave, the
+    coalesce/dispatch/collect/overlap events must land on each
+    request's own lifecycle — `co_batched` then counts CROSS-REQUEST
+    siblings, the number the scheduler is judged by. Appends are
+    GIL-atomic and each timeline is read only after its own request
+    completes, the same contract the collector thread already rides."""
+
+    __slots__ = ("timelines",)
+
+    def __init__(self, timelines):
+        self.timelines = timelines
+
+    def event(self, name: str, **fields) -> None:
+        for tl in self.timelines:
+            tl.event(name, **fields)
+
+
+def _distinct_timelines(timelines, items=None):
+    """The identity-distinct non-None timelines of `timelines`
+    (optionally restricted to positions `items`), insertion-ordered —
+    one request's timeline appears once however many of its
+    sub-requests share the wave."""
+    seen: Dict[int, Any] = {}
+    for i in (items if items is not None else range(len(timelines))):
+        tl = timelines[i]
+        if tl is not None and id(tl) not in seen:
+            seen[id(tl)] = tl
+    return list(seen.values())
+
+
 class _WaveCollector:
     """Collector thread for the overlapped pipeline: pulls dispatched
     waves off the queue and runs their device_get + response assembly
@@ -1789,7 +1822,8 @@ class SearchExecutor:
                      task=None, deadline: Optional[float] = None,
                      trace=None,
                      phase_times: Optional[dict] = None,
-                     waves: Optional[int] = None) -> dict:
+                     waves: Optional[int] = None,
+                     timelines: Optional[list] = None) -> dict:
         """_msearch: execute many search bodies, batching same-shaped
         score-sorted queries into single vmapped device programs per segment
         (reference: action/search/TransportMultiSearchAction fans bodies out
@@ -1828,11 +1862,17 @@ class SearchExecutor:
         and completes it on EVERY exit, error paths included (a
         cancelled/faulted envelope must still be capture-eligible);
         REST/controller-owned requests pass straight through to the
-        impl, which rides the bound timeline."""
-        if not _FLIGHT.enabled or _FLIGHT.current() is not None:
+        impl, which rides the bound timeline.
+        timelines: per-body request timelines from the wave scheduler's
+        batch-of-batches entry (search/scheduler.py) — wave events fan
+        out to each owning request's lifecycle and the envelope itself
+        owns NO timeline (the foreign requests' own wrappers complete
+        theirs)."""
+        if timelines is not None or not _FLIGHT.enabled \
+                or _FLIGHT.current() is not None:
             return self._multi_search_impl(
                 bodies, _bypass_request_cache, _raise_item_errors, task,
-                deadline, trace, phase_times, waves)
+                deadline, trace, phase_times, waves, timelines)
         tl = _FLIGHT.timeline()
         if tl is None:      # disabled race: behave as the gate said
             return self._multi_search_impl(
@@ -1858,7 +1898,8 @@ class SearchExecutor:
                            task=None, deadline: Optional[float] = None,
                            trace=None,
                            phase_times: Optional[dict] = None,
-                           waves: Optional[int] = None) -> dict:
+                           waves: Optional[int] = None,
+                           timelines: Optional[list] = None) -> dict:
         TELEMETRY.metrics.counter("msearch.requests").inc()
         TELEMETRY.metrics.counter("msearch.bodies").inc(len(bodies))
         scope = _LEDGER.scope(trace)
@@ -1868,6 +1909,13 @@ class SearchExecutor:
         tl = _FLIGHT.current() if _FLIGHT.enabled else None
         if tl is not None:
             tl.route()      # arrive→envelope-entry gap becomes `route`
+        # scheduler-coalesced envelopes carry the owning requests' own
+        # timelines instead: each request's pre-envelope gap (admission
+        # glue minus its recorded queue_wait) becomes ITS `route`
+        fan_tls = _distinct_timelines(timelines) if timelines else None
+        if fan_tls:
+            for _ftl in fan_tls:
+                _ftl.route()
         start = time.monotonic()
         if task is not None:
             task.check_cancelled()
@@ -1931,7 +1979,8 @@ class SearchExecutor:
                 wave_list, responses, start, ph, task=task,
                 deadline=deadline, scope=scope,
                 resp_cache_keys=resp_cache_keys,
-                allow_pipeline=allow_pipeline, timeline=tl)
+                allow_pipeline=allow_pipeline, timeline=tl,
+                item_timelines=timelines)
         # parse always runs; the wave phases only get a sample when a
         # batched wave actually executed — otherwise every all-general or
         # all-hybrid envelope would log spurious 0-ms device_get/respond
@@ -1969,6 +2018,14 @@ class SearchExecutor:
                 ph_ms["coordinate"] = glue
             tl.merge_phases(ph_ms)
             tl.mark_ready()
+        if fan_tls:
+            # each coalesced request WAITED for the whole shared
+            # envelope, so the envelope's phase decomposition explains
+            # each request's wall: merge it into every owner (their own
+            # threads mark_ready/complete after demux)
+            ph_ms = {name: sec * 1000.0 for name, sec in ph.items()}
+            for _ftl in fan_tls:
+                _ftl.merge_phases(ph_ms)
         return {"took": int((time.monotonic() - start) * 1000),
                 "responses": responses}
 
@@ -1977,7 +2034,8 @@ class SearchExecutor:
                            deadline: Optional[float] = None, scope=None,
                            resp_cache_keys: Optional[dict] = None,
                            allow_pipeline: bool = True,
-                           timeline=None) -> None:
+                           timeline=None,
+                           item_timelines: Optional[list] = None) -> None:
         """Drive the wave engine: prepare + async-dispatch each wave on
         THIS thread, collect on the collector thread (bounded in-flight
         window), and merge per-wave phase times, ledger scopes and
@@ -2004,6 +2062,14 @@ class SearchExecutor:
             for wave_idx, wave in enumerate(wave_list):
                 wave.index = wave_idx
                 wave.timeline = timeline
+                if timeline is None and item_timelines is not None:
+                    # scheduler-coalesced wave: fan its events out to
+                    # every owning request's timeline (one per request,
+                    # however many of its items share the wave)
+                    fanned = _distinct_timelines(item_timelines,
+                                                 wave.items)
+                    if fanned:
+                        wave.timeline = _TimelineFan(fanned)
                 if task is not None:
                     task.check_cancelled()
                 if deadline is not None and time.monotonic() > deadline:
@@ -2030,13 +2096,15 @@ class SearchExecutor:
                             if responses[i] is None:
                                 responses[i] = dict(item)
                         continue
-                if timeline is not None:
+                if wave.timeline is not None:
                     # coalesce: which wave this request's items ride and
-                    # with how many co-batched siblings — the field the
-                    # item-2 scheduler fills with cross-request counts
-                    timeline.event("coalesce", wave=wave_idx,
-                                   co_batched=len(wave.items),
-                                   kind=wave.kind)
+                    # with how many co-batched siblings — fanned to
+                    # every owning request on a scheduler-coalesced
+                    # wave, where co_batched counts CROSS-REQUEST
+                    # companions
+                    wave.timeline.event("coalesce", wave=wave_idx,
+                                        co_batched=len(wave.items),
+                                        kind=wave.kind)
                 if collector is not None:
                     # bounded in-flight window: block until a slot frees
                     # BEFORE compiling/dispatching the next wave
@@ -2061,9 +2129,10 @@ class SearchExecutor:
                 _DEVMEM.adjust("wave_buffers",
                                wave.state.get("wave_buffer_bytes", 0))
                 _LEDGER.note_wave_inflight(+1)
-                if timeline is not None:
-                    timeline.event("dispatch", wave=wave_idx,
-                                   inflight=_LEDGER.inflight_waves())
+                if wave.timeline is not None:
+                    wave.timeline.event("dispatch", wave=wave_idx,
+                                        inflight=_LEDGER
+                                        .inflight_waves())
                 dispatched.append(wave)
                 if collector is None:
                     if task is not None:
@@ -2118,11 +2187,11 @@ class SearchExecutor:
                     for c0, c1 in collects)
                 _LEDGER.note_overlap(overlap_s * 1000.0,
                                      scope=wave.scope)
-                if timeline is not None:
+                if wave.timeline is not None:
                     # per-wave overlap as a lifecycle event: what
                     # tools/trace_report.py's pipeline table reads
-                    timeline.event("overlap", wave=wave.index,
-                                   ms=round(overlap_s * 1000.0, 3))
+                    wave.timeline.event("overlap", wave=wave.index,
+                                        ms=round(overlap_s * 1000.0, 3))
             if wave.collect_t1:
                 collects.append((wave.collect_t0, wave.collect_t1))
             if wave.scope is not None and scope is not None:

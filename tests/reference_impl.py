@@ -270,3 +270,27 @@ def ref_token_bucket(rate, burst, events):
         tokens -= got
         out.append(got)
     return out
+
+
+def ref_window_ms(budgets_ms, service_ms, queue_depth, arrival_gap_ms,
+                  window_max_ms):
+    """Oracle for search/scheduler.plan_window_ms: the adaptive
+    micro-batch delay window. Budget cap = the minimum over queued
+    budgets of (budget - predicted queue time) with the serial-queue
+    model (ref_predict_queue_ms), clamped to [0, window_max]; the
+    pressure term zeroes the window when the live arrival-gap estimate
+    says no companion is likely to arrive within the cap."""
+    cap = float(window_max_ms)
+    predicted = ref_predict_queue_ms(service_ms, queue_depth)
+    if predicted is None:
+        predicted = 0.0
+    for budget in budgets_ms:
+        if budget is None:
+            continue
+        cap = min(cap, budget - predicted)
+    cap = max(0.0, min(cap, float(window_max_ms)))
+    if cap <= 0.0:
+        return 0.0
+    if arrival_gap_ms is None or arrival_gap_ms > cap:
+        return 0.0
+    return cap

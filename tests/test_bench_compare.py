@@ -176,3 +176,53 @@ def test_cli_exit_codes(tmp_path):
     usage = subprocess.run([sys.executable, tool, old_p],
                            capture_output=True, text=True, timeout=60)
     assert usage.returncode == 2
+
+
+# ------------------------------------------- scheduler-mode conc shape
+
+
+CONC_OLD = {"mode": "bm25_openloop_8c_120rps", "value": 113.1,
+            "clients": 8, "arrival_rate": 120.0, "p50_ms": 3.7,
+            "p99_ms": 10.3}
+
+
+def test_openloop_qps_regression_fails(tmp_path):
+    """ISSUE 12: a conc record whose open-loop QPS drops beyond the
+    threshold under the SAME offered load fails the gate."""
+    new = dict(CONC_OLD, value=80.0)
+    rows, failures = bench_compare.compare(
+        {"bm25_openloop_8c_120rps": CONC_OLD},
+        {"bm25_openloop_8c_120rps": new}, 10.0)
+    assert failures and "open-loop QPS" in failures[0]
+    assert rows[0]["status"] == "REGRESSION"
+    assert rows[0]["qps_delta_pct"] < -10
+
+
+def test_openloop_qps_gain_ok():
+    new = dict(CONC_OLD, value=240.0, p99_ms=9.0)
+    rows, failures = bench_compare.compare(
+        {"bm25_openloop_8c_120rps": CONC_OLD},
+        {"bm25_openloop_8c_120rps": new}, 10.0)
+    assert not failures
+    assert rows[0]["qps_delta_pct"] > 100
+
+
+def test_scheduler_record_requires_observed_coalescing():
+    """A scheduler-enabled record must carry co_batched > 1 evidence
+    from the captured timelines — enabled-but-not-coalescing fails."""
+    new = dict(CONC_OLD, value=240.0,
+               scheduler={"enabled": True, "tail_co_batched_max": 1,
+                          "co_batched": {"max": 1}})
+    rows, failures = bench_compare.compare(
+        {"bm25_openloop_8c_120rps": CONC_OLD},
+        {"bm25_openloop_8c_120rps": new}, 10.0)
+    assert failures and "co_batched" in failures[0]
+    assert rows[0]["status"] == "NO-COALESCE"
+    good = dict(CONC_OLD, value=240.0,
+                scheduler={"enabled": True, "tail_co_batched_max": 5,
+                           "co_batched": {"max": 6}})
+    rows, failures = bench_compare.compare(
+        {"bm25_openloop_8c_120rps": CONC_OLD},
+        {"bm25_openloop_8c_120rps": good}, 10.0)
+    assert not failures
+    assert rows[0]["co_batched_max"] == 6

@@ -1,0 +1,548 @@
+"""Unit + integration suite for the async wave scheduler
+(opensearch_tpu/search/scheduler.py, ISSUE 12).
+
+Contracts under test:
+  - window sizing math == the pure-Python oracle
+    (tests/reference_impl.ref_window_ms) across a seeded parameter
+    sweep;
+  - scheduler-on responses are BYTE-IDENTICAL (modulo `took`) to the
+    inline path across B ∈ {1, 32, 1024}, mixed hybrid/agg items
+    included — coalescing changes when work dispatches, never what it
+    returns;
+  - compatibility grouping: different target executors never share a
+    wave; sub-requests demux back to their owners in order;
+  - a deadline that expires inside the coalesce window renders the
+    reference timed-out partial (zero hits, `timed_out: true`), is
+    counted as shed, and refunds the tenant's quota token;
+  - a cancelled task's queued request leaves the queue with its typed
+    error at the next pump; disabling the scheduler drains the queue;
+  - the bounded queue rejects over-capacity submits with the
+    structured 429 (`scheduler_queue_full`);
+  - seeded determinism: the same submission sequence through two fresh
+    schedulers produces identical grouping and identical responses;
+  - gate/no-op discipline (gate-lint's registry row, asserted on the
+    running instance) + REST/_nodes-stats/dynamic-settings wiring;
+  - chaos-under-concurrency with the scheduler COALESCING: zero 5xx,
+    zero permit leaks, queue drained (tools/chaos_sweep.py).
+"""
+
+import json
+import random
+import threading
+import time
+
+import pytest
+
+from opensearch_tpu.common.admission import AdmissionController
+from opensearch_tpu.common.errors import (
+    AdmissionRejectedError, TaskCancelledError)
+from opensearch_tpu.search.executor import SearchExecutor, ShardReader
+from opensearch_tpu.search.scheduler import (
+    WaveScheduler, plan_window_ms)
+from opensearch_tpu.telemetry import TELEMETRY
+from opensearch_tpu.utils.demo import build_shards, query_terms
+
+from reference_impl import ref_window_ms
+
+
+@pytest.fixture(scope="module")
+def executor():
+    mapper, segments = build_shards(320, n_shards=1, vocab_size=180,
+                                    avg_len=24, seed=11)
+    return SearchExecutor(ShardReader(mapper, segments))
+
+
+@pytest.fixture(scope="module")
+def executor_b():
+    mapper, segments = build_shards(200, n_shards=1, vocab_size=120,
+                                    avg_len=20, seed=23)
+    return SearchExecutor(ShardReader(mapper, segments))
+
+
+def _bodies(n, seed=3):
+    qs = query_terms(max(n, 8), 180, seed=seed, terms_per_query=2)
+    return [{"query": {"match": {"body": qs[i % len(qs)]}}, "size": 5}
+            for i in range(n)]
+
+
+def _mixed_bodies():
+    qs = query_terms(8, 180, seed=3, terms_per_query=2)
+    return [
+        {"query": {"match": {"body": qs[0]}}, "size": 5},
+        {"query": {"term": {"tag": "cat3"}}, "size": 6},
+        {"query": {"match_all": {}}, "size": 0,
+         "aggs": {"t": {"terms": {"field": "tag"}}}},
+        {"query": {"hybrid": {"queries": [
+            {"match": {"body": qs[2]}},
+            {"match": {"body": qs[3]}}]}}, "size": 5},
+        {"query": {"range": {"views": {"gte": 100}}}, "size": 3},
+    ]
+
+
+def _strip(resp):
+    resp = json.loads(json.dumps(resp))
+    resp.pop("took", None)
+    return resp
+
+
+def _inline_sched():
+    """A scheduler whose execute() dispatches synchronously on the
+    calling thread (no thread, no window) — the deterministic harness
+    for dispatch/demux semantics."""
+    return WaveScheduler(autostart=False)
+
+
+def _queued_sched(clock=time.monotonic):
+    """A scheduler that ENQUEUES but never self-dispatches: submits
+    park in the queue until an explicit pump_once() — the
+    deterministic harness for window/queue semantics."""
+    s = WaveScheduler(autostart=False, clock=clock)
+    s.enabled = True
+    s._running = True       # queue accepts; no thread ever drains
+    return s
+
+
+# ------------------------------------------------------------ gate/no-op
+
+def test_gate_discipline():
+    s = WaveScheduler()
+    assert s.enabled is False
+    assert s.gate() is None
+    assert s._thread is None
+    assert s.stats()["enabled"] is False
+    assert s.queue_depth() == 0
+
+
+# ----------------------------------------------------------- window math
+
+def test_window_math_vs_oracle_seeded_sweep():
+    rng = random.Random(7)
+    for _ in range(500):
+        budgets = [
+            None if rng.random() < 0.3
+            else rng.uniform(-5.0, 60.0)
+            for _ in range(rng.randrange(0, 6))]
+        service = None if rng.random() < 0.2 else rng.uniform(0.0, 20.0)
+        depth = rng.randrange(0, 32)
+        gap = None if rng.random() < 0.2 else rng.uniform(0.0, 20.0)
+        wmax = rng.choice([0.0, 0.5, 2.0, 8.0])
+        got = plan_window_ms(budgets, service, depth, gap, wmax)
+        want = ref_window_ms(budgets, service, depth, gap, wmax)
+        assert got == pytest.approx(want), \
+            (budgets, service, depth, gap, wmax)
+        assert 0.0 <= got <= wmax
+
+
+def test_window_idle_node_never_waits():
+    # arrival gap above the cap (or unknown) => dispatch immediately:
+    # the scheduler must add ZERO latency at low offered load
+    assert plan_window_ms([None], 2.0, 0, None, 2.0) == 0.0
+    assert plan_window_ms([None], 2.0, 0, 8.0, 2.0) == 0.0
+    # pressure + headroom => the full budgeted cap
+    assert plan_window_ms([None, 100.0], 2.0, 1, 1.0, 2.0) == 2.0
+
+
+def test_window_never_spends_budget_it_cannot_afford():
+    # predicted queue time 2ms * (4+1) = 10ms against a 11ms budget:
+    # only 1ms of window headroom survives
+    w = plan_window_ms([11.0], 2.0, 4, 0.5, 2.0)
+    assert w == pytest.approx(1.0)
+    # budget already spent by the queue => no window at all
+    assert plan_window_ms([9.0], 2.0, 4, 0.5, 2.0) == 0.0
+
+
+# ------------------------------------------------------- parity + demux
+
+@pytest.mark.parametrize("b", [1, 32, 1024])
+def test_scheduler_off_parity(executor, b):
+    """The differential pin: scheduler-dispatched responses are
+    byte-identical (modulo took) to the inline multi_search across
+    B ∈ {1, 32, 1024} — the satellite-1 acceptance."""
+    bodies = _bodies(b)
+    direct = executor.multi_search([dict(x) for x in bodies])
+    sched = _inline_sched()
+    via, shed = sched.execute_many(executor,
+                                   [dict(x) for x in bodies])
+    assert shed == 0
+    assert len(via) == b
+    for d, v in zip(direct["responses"], via):
+        assert _strip(d) == _strip(v)
+
+
+def test_demux_mixed_hybrid_agg_items(executor):
+    bodies = _mixed_bodies()
+    direct = executor.multi_search([dict(x) for x in bodies])
+    sched = _inline_sched()
+    via, _ = sched.execute_many(executor, [dict(x) for x in bodies])
+    for d, v in zip(direct["responses"], via):
+        assert _strip(d) == _strip(v)
+
+
+def test_single_execute_parity_and_error_rehydration(executor):
+    sched = _inline_sched()
+    body = _bodies(1)[0]
+    res, shed = sched.execute(executor, dict(body))
+    assert not shed
+    assert _strip(res) == _strip(
+        executor.multi_search([dict(body)])["responses"][0])
+    # malformed body: the envelope renders a per-item error object; the
+    # single path must re-raise it with the SAME payload + status the
+    # inline path's typed exception would carry
+    bad = {"query": {"match": {"body": "x"}}, "size": -3}
+    with pytest.raises(Exception) as ei:
+        sched.execute(executor, bad)
+    assert ei.value.status == 400
+    assert ei.value.to_xcontent()["type"] == \
+        "illegal_argument_exception"
+
+
+def test_grouping_by_target_never_mixes_executors(executor, executor_b):
+    """Two targets submitted into one queue: the pump dispatches one
+    shared wave PER TARGET, each demuxing to its own submitters."""
+    sched = _queued_sched()
+    bodies_a = _bodies(4, seed=3)
+    bodies_b = [{"query": {"match_all": {}}, "size": 4}]
+    out = {}
+
+    def submit(name, target, bodies):
+        out[name] = sched.execute_many(
+            target, [dict(b) for b in bodies])
+
+    t1 = threading.Thread(target=submit,
+                          args=("a", executor, bodies_a))
+    t2 = threading.Thread(target=submit,
+                          args=("b", executor_b, bodies_b))
+    t1.start(), t2.start()
+    for _ in range(200):
+        if sched.queue_depth() == len(bodies_a) + len(bodies_b):
+            break
+        time.sleep(0.005)
+    assert sched.queue_depth() == len(bodies_a) + len(bodies_b)
+    served = sched.pump_once()
+    t1.join(), t2.join()
+    assert served == len(bodies_a) + len(bodies_b)
+    assert sched.dispatches == 2        # one wave per target
+    direct_a = executor.multi_search([dict(b) for b in bodies_a])
+    direct_b = executor_b.multi_search([dict(b) for b in bodies_b])
+    for d, v in zip(direct_a["responses"], out["a"][0]):
+        assert _strip(d) == _strip(v)
+    for d, v in zip(direct_b["responses"], out["b"][0]):
+        assert _strip(d) == _strip(v)
+
+
+# ---------------------------------------------- deadline / cancel / full
+
+def test_deadline_expiry_in_window_renders_timed_out_partials(executor):
+    t = [1000.0]
+    sched = WaveScheduler(autostart=False, clock=lambda: t[0])
+    expired = t[0] - 0.001      # deadline already passed at dispatch
+    responses, shed = sched.execute_many(
+        executor, _bodies(3), deadline=expired)
+    assert shed == 3
+    assert sched.shed_deadline == 3
+    for r in responses:
+        assert r["timed_out"] is True
+        assert r["hits"]["total"]["value"] == 0
+        assert "error" not in r     # a budget decision, never an error
+
+
+def test_shed_refunds_quota_token():
+    """The satellite-4 invariant: a request the scheduler shed never
+    executed, so its tenant's token comes back (fair share survives
+    the coalesce window)."""
+    ctrl = AdmissionController()
+    ctrl.quotas.enabled = True
+    ctrl.quotas.configure(rate=0.0001, burst=2.0)   # no refill in-test
+    ctrl.acquire(tenant="t1")
+    before = ctrl.quotas.stats()["tenants"]["t1"]["tokens"]
+    ctrl.refund_unserved("t1")
+    after = ctrl.quotas.stats()["tenants"]["t1"]["tokens"]
+    assert after == pytest.approx(before + 1.0)
+    ctrl.release(service_ms=1.0)
+    assert ctrl.admitted_total == ctrl.released_total
+
+
+def test_cancelled_task_drains_at_next_pump(executor):
+    class _Cancelled:
+        def check_cancelled(self):
+            raise TaskCancelledError("task cancelled")
+
+    sched = _queued_sched()
+    errs = []
+
+    def submit():
+        try:
+            sched.execute(executor, _bodies(1)[0], task=_Cancelled())
+        except TaskCancelledError as e:
+            errs.append(e)
+
+    th = threading.Thread(target=submit)
+    th.start()
+    for _ in range(200):
+        if sched.queue_depth() == 1:
+            break
+        time.sleep(0.005)
+    sched.pump_once()
+    th.join()
+    assert len(errs) == 1
+    assert sched.cancelled == 1
+    assert sched.queue_depth() == 0
+
+
+def test_disable_drains_queue(executor):
+    """set_enabled(False) dispatches every queued request before the
+    thread exits — no stranded waiter."""
+    sched = WaveScheduler()
+    sched.set_enabled(True)
+    results = []
+
+    def submit():
+        results.append(sched.execute(executor, _bodies(1)[0])[0])
+
+    threads = [threading.Thread(target=submit) for _ in range(4)]
+    for th in threads:
+        th.start()
+    sched.set_enabled(False)
+    for th in threads:
+        th.join(timeout=10)
+    assert len(results) == 4
+    assert all(r["hits"]["total"]["value"] >= 0 for r in results)
+    assert sched.queue_depth() == 0
+    assert sched.gate() is None
+
+
+def test_bounded_queue_rejects_with_structured_429(executor):
+    sched = _queued_sched()
+    sched.max_queue = 2
+    done = []
+
+    def submit():
+        done.append(sched.execute(executor, _bodies(1)[0]))
+
+    threads = [threading.Thread(target=submit) for _ in range(2)]
+    for th in threads:
+        th.start()
+    for _ in range(200):
+        if sched.queue_depth() == 2:
+            break
+        time.sleep(0.005)
+    with pytest.raises(AdmissionRejectedError) as ei:
+        sched.execute(executor, _bodies(1)[0])
+    assert ei.value.status == 429
+    body = ei.value.to_xcontent()
+    assert body["reject_reason"] == "scheduler_queue_full"
+    assert body["bytes_limit"] == 2
+    assert "Retry-After" in ei.value.headers
+    assert sched.rejected_full == 1
+    sched.pump_once()
+    for th in threads:
+        th.join(timeout=10)
+    assert len(done) == 2
+
+
+# -------------------------------------------------------- determinism
+
+def test_seeded_determinism_same_sequence_same_waves(executor):
+    """Two fresh schedulers fed the identical submission sequence make
+    the identical decisions: same dispatch count, same co_batched
+    profile, same responses."""
+    bodies = _bodies(12, seed=9)
+
+    def run_once():
+        sched = _queued_sched(clock=lambda: 1000.0)
+        outs = [None] * 3
+        chunks = [bodies[0:4], bodies[4:8], bodies[8:12]]
+
+        def submit(i):
+            outs[i] = sched.execute_many(
+                executor, [dict(b) for b in chunks[i]])
+
+        threads = [threading.Thread(target=submit, args=(i,))
+                   for i in range(3)]
+        for th in threads:
+            th.start()
+        for _ in range(400):
+            if sched.queue_depth() == 12:
+                break
+            time.sleep(0.005)
+        assert sched.queue_depth() == 12
+        sched.pump_once()
+        for th in threads:
+            th.join(timeout=10)
+        flat = [_strip(r) for out, _ in outs for r in out]
+        return sched.dispatches, sched.co_batched_max, flat
+
+    d1, cb1, r1 = run_once()
+    d2, cb2, r2 = run_once()
+    assert (d1, cb1) == (d2, cb2) == (1, 12)
+    assert r1 == r2
+
+
+# ------------------------------------------------------- lifecycle fan
+
+def test_coalesced_wave_fans_lifecycle_events(executor):
+    """Two requests coalesced into one wave: EACH timeline carries a
+    real queue_wait plus coalesce/dispatch/collect events whose
+    co_batched counts the CROSS-REQUEST total — the number the PR 10
+    measurement contract reserved the fields for."""
+    flight = TELEMETRY.flight
+    flight.enabled = True
+    try:
+        sched = _queued_sched()
+        tls = [flight.timeline(), flight.timeline()]
+        outs = []
+
+        def submit(i):
+            outs.append(sched.execute(
+                executor, _bodies(2, seed=i)[i], timeline=tls[i]))
+
+        threads = [threading.Thread(target=submit, args=(i,))
+                   for i in range(2)]
+        for th in threads:
+            th.start()
+        for _ in range(200):
+            if sched.queue_depth() == 2:
+                break
+            time.sleep(0.005)
+        sched.pump_once()
+        for th in threads:
+            th.join(timeout=10)
+        for tl in tls:
+            d = tl.to_dict()
+            names = [e["event"] for e in d["events"]]
+            assert "queue_wait" in names
+            assert d["queue_wait_ms"] >= 0.0
+            co = [e for e in d["events"] if e["event"] == "coalesce"]
+            assert co and co[0]["co_batched"] == 2
+            assert any(e["event"] == "collect" for e in d["events"])
+            assert d.get("phases"), "envelope phases must merge in"
+    finally:
+        flight.enabled = False
+        flight.clear()
+
+
+# ------------------------------------------------- REST + node wiring
+
+@pytest.fixture(scope="module")
+def node():
+    from opensearch_tpu.node import Node
+    node = Node()
+    node.request("PUT", "/s1", {
+        "settings": {"number_of_shards": 1},
+        "mappings": {"properties": {"body": {"type": "text"}}}})
+    lines = []
+    for i in range(60):
+        lines.append(json.dumps({"index": {"_index": "s1",
+                                           "_id": f"d{i}"}}))
+        lines.append(json.dumps({"body": f"alpha beta gamma{i % 5}"}))
+    r = node.request("POST", "/_bulk", "\n".join(lines) + "\n",
+                     refresh="true")
+    assert r["_status"] == 200 and not r["errors"]
+    return node
+
+
+def test_rest_enable_disable_and_stats(node):
+    body = {"query": {"match": {"body": "alpha"}}, "size": 3}
+    off = node.request("POST", "/s1/_search", body)
+    assert off["_status"] == 200
+    r = node.request("POST", "/_scheduler/_enable")
+    assert r["enabled"] is True and node.wave_scheduler.enabled
+    try:
+        on = node.request("POST", "/s1/_search", body)
+        assert on["_status"] == 200
+        off.pop("took"), on.pop("took")
+        off.pop("_status"), on.pop("_status")
+        assert off == on        # byte-identical through REST
+        # msearch rides the queue too
+        nd = "\n".join([json.dumps({"index": "s1"}),
+                        json.dumps(body)] * 3) + "\n"
+        ms = node.request("POST", "/_msearch", nd)
+        assert ms["_status"] == 200
+        assert all(resp["status"] == 200 for resp in ms["responses"])
+        stats = node.request("GET", "/_nodes/stats")
+        sched_block = list(stats["nodes"].values())[0]["scheduler"]
+        assert sched_block["enabled"] is True
+        assert sched_block["submitted"] >= 4
+        direct = node.request("GET", "/_scheduler")["scheduler"]
+        assert direct["dispatched_waves"] >= 1
+    finally:
+        r = node.request("POST", "/_scheduler/_disable")
+        assert r["enabled"] is False
+    assert node.wave_scheduler.gate() is None
+    bp = node.search_backpressure
+    assert bp.current == 0 and bp.admitted_total == bp.released_total
+
+
+def test_dynamic_cluster_settings_roundtrip(node):
+    r = node.request("PUT", "/_cluster/settings", {
+        "transient": {"search.scheduler.enabled": "true",
+                      "search.scheduler.window_ms": 1.5,
+                      "search.scheduler.max_queue": 77}})
+    assert r["_status"] == 200
+    try:
+        assert node.wave_scheduler.enabled is True
+        assert node.wave_scheduler.window_max_ms == 1.5
+        assert node.wave_scheduler.max_queue == 77
+    finally:
+        r = node.request("PUT", "/_cluster/settings", {
+            "transient": {"search.scheduler.enabled": "false",
+                          "search.scheduler.window_ms": None,
+                          "search.scheduler.max_queue": None}})
+        assert r["_status"] == 200
+    assert node.wave_scheduler.enabled is False
+    # validate-then-commit: a malformed value 400s WITHOUT persisting
+    r = node.request("PUT", "/_cluster/settings", {
+        "transient": {"search.scheduler.window_ms": "not-a-number"}})
+    assert r["_status"] == 400
+    assert "search.scheduler.window_ms" not in \
+        node.cluster_settings["transient"]
+    # and the node still takes settings updates afterwards
+    r = node.request("PUT", "/_cluster/settings", {"transient": {}})
+    assert r["_status"] == 200
+
+
+def test_admission_prices_against_scheduler_queue():
+    """The shed stage's depth term includes the scheduler's real
+    queue: the same arrival that admits at depth 0 sheds when the
+    queue claims the budget (predict_queue_ms's serial model)."""
+    ctrl = AdmissionController()
+    ctrl.shedder.enabled = True
+    ctrl.shedder.slo_ms = 25.0
+    ctrl.shedder.min_samples = 1
+    for _ in range(4):
+        ctrl.shedder.observe(10.0)      # service p50 = 10ms
+    ctrl.acquire()                      # depth 1: predicted 20 <= 25
+    ctrl.release(service_ms=10.0)
+    ctrl.queue_depth_extra = lambda: 4  # + queued: predicted 50 > 25
+    assert ctrl.queue_depth() == 4
+    # claim the periodic estimator probe so the next would-be-shed
+    # arrival cannot ride it through (the PR 11 anti-starvation escape)
+    ctrl.shedder._last_probe = time.monotonic()
+    with pytest.raises(AdmissionRejectedError) as ei:
+        ctrl.acquire()
+    assert ei.value.reject_reason == "deadline_shed"
+    ctrl.queue_depth_extra = None
+
+
+# -------------------------------------------- chaos under concurrency
+
+def test_chaos_under_concurrency_with_scheduler_coalescing():
+    """The satellite-6 integration pin: seeded faults fire while 4
+    open-loop clients drive the single-shard index THROUGH the
+    coalescing scheduler — zero 5xx, zero serve errors, zero permit
+    leaks, the queue drained, and coalescing actually observed."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "chaos_sweep_sched", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "chaos_sweep.py"))
+    chaos = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(chaos)
+    summary, violations = chaos.run_chaos_concurrent(
+        clients=4, n_requests=96, rate=600.0, scheduler=True)
+    assert not violations, violations
+    assert summary["ok"] >= 0.9 * 96
+    assert summary["scheduler"]["dispatched_waves"] >= 1
+    assert summary["scheduler"]["co_batched_max"] >= 2, \
+        "no cross-request coalescing observed under concurrency"

@@ -143,3 +143,28 @@ def test_real_recorder_roundtrip(tmp_path):
     assert len(recs) == 1
     att = tail_report.attribution(recs[0])
     assert att["buckets"]["device_get"] == 2.0
+
+
+def test_coalesce_groups_split_shared_vs_solo():
+    """ISSUE 12: captures group by coalesce state — co_batched > 1
+    anywhere in the timeline means the request rode a shared wave."""
+    import tail_report as tr
+    records = [
+        {"took_ms": 9.0, "queue_wait_ms": 1.5, "events": [
+            {"event": "coalesce", "wave": 0, "co_batched": 4}]},
+        {"took_ms": 5.0, "queue_wait_ms": 0.0, "events": [
+            {"event": "coalesce", "wave": 0, "co_batched": 1}]},
+        {"took_ms": 12.0, "queue_wait_ms": 2.0, "events": [
+            {"event": "coalesce", "wave": 0, "co_batched": 1},
+            {"event": "coalesce", "wave": 1, "co_batched": 3}]},
+        {"took_ms": 3.0, "events": []},     # no wave: not grouped
+    ]
+    groups = tr.coalesce_groups(records)
+    assert set(groups) == {"coalesced", "solo"}
+    assert groups["coalesced"]["captures"] == 2
+    assert groups["coalesced"]["co_batched_max"] == 4
+    assert groups["solo"]["captures"] == 1
+    assert groups["solo"]["took_p50_ms"] == 5.0
+    assert groups["coalesced"]["window_wait_ms"] == 1.75
+    table = tr.render_coalesce(groups)
+    assert "coalesced" in table and "window_wait_ms" in table

@@ -185,3 +185,27 @@ def test_real_export_roundtrip(tmp_path):
     path = os.path.join(str(tmp_path), "_state", "traces.jsonl")
     rows = trace_report.phase_rows(trace_report.load_traces(path))
     assert {r["phase"] for r in rows} == {"parse", "query", "(root)"}
+
+
+def test_pipeline_rows_window_wait_column():
+    """ISSUE 12: the wave-pipeline table surfaces the request's
+    measured scheduler-queue delay (lifecycle queue_wait_ms) next to
+    co_batched on every wave row."""
+    trace = {"name": "rest.search", "duration_ms": 9.0,
+             "attributes": {"lifecycle": {
+                 "queue_wait_ms": 1.25,
+                 "events": [
+                     {"event": "queue_wait", "t_ms": 1.2, "ms": 1.25},
+                     {"event": "coalesce", "t_ms": 1.3, "wave": 0,
+                      "co_batched": 3},
+                     {"event": "collect", "t_ms": 8.0, "wave": 0,
+                      "ms": 2.0}]}}}
+    rows = trace_report.pipeline_rows([trace])
+    assert rows and rows[0]["window_wait_ms"] == 1.25
+    assert rows[0]["co_batched"] == 3
+    table = trace_report.render_pipeline_table(rows)
+    assert "window_wait_ms" in table
+    # no measured wait renders as "-"
+    trace["attributes"]["lifecycle"]["queue_wait_ms"] = 0.0
+    rows = trace_report.pipeline_rows([trace])
+    assert rows[0]["window_wait_ms"] == "-"

@@ -138,6 +138,36 @@ def compare(old: Dict[str, dict], new: Dict[str, dict],
                 failures.append(
                     f"{key}: warm p99 {o99}ms -> {n99}ms "
                     f"(+{d99:.1f}% > {threshold_pct:g}%)")
+        # open-loop concurrency records (BENCH_CONC shape, ISSUE 12):
+        # gate THROUGHPUT too — a scheduler change must not trade
+        # open-loop QPS away under the same offered load (the p99 gate
+        # above already covers the admitted tail: conc records' p99 is
+        # warm by construction) — and when the new record ran with the
+        # wave scheduler enabled, demand OBSERVED cross-request
+        # coalescing: a captured timeline with co_batched > 1, not a
+        # config flag
+        if "clients" in o or "clients" in n:
+            oq, nq = o.get("value"), n.get("value")
+            if isinstance(oq, (int, float)) and \
+                    isinstance(nq, (int, float)) and oq > 0:
+                dq = 100.0 * (nq - oq) / oq
+                row["qps_delta_pct"] = round(dq, 1)
+                if dq < -threshold_pct:
+                    status = "REGRESSION"
+                    failures.append(
+                        f"{key}: open-loop QPS {oq} -> {nq} "
+                        f"({dq:.1f}% < -{threshold_pct:g}%)")
+        n_sched = n.get("scheduler")
+        if isinstance(n_sched, dict) and n_sched.get("enabled"):
+            cb = max(int(n_sched.get("tail_co_batched_max", 0) or 0),
+                     int((n_sched.get("co_batched") or {})
+                         .get("max", 0) or 0))
+            row["co_batched_max"] = cb
+            if cb <= 1:
+                status = "NO-COALESCE"
+                failures.append(
+                    f"{key}: scheduler enabled but no captured "
+                    f"timeline shows co_batched > 1 (max {cb})")
         row["status"] = status
         rows.append(row)
     return rows, failures
@@ -234,7 +264,7 @@ def render_overload(rows: List[dict]) -> str:
 def render(rows: List[dict]) -> str:
     headers = ["config", "old_warm_p50_ms", "new_warm_p50_ms",
                "delta_pct", "old_warm_p99_ms", "new_warm_p99_ms",
-               "p99_delta_pct", "status"]
+               "p99_delta_pct", "qps_delta_pct", "status"]
     table = [headers] + [[str(r.get(h, "-")) for h in headers]
                          for r in rows]
     widths = [max(len(row[i]) for row in table)

@@ -225,17 +225,25 @@ def _classify(resp, expect, clean, surviving_oracle, row, violations):
 
 
 def _check_permits(node, row, violations):
-    """The permit-leak invariant (ISSUE 11): after a row quiesces, the
-    backpressure gate must be back at baseline — current == 0 and the
-    admitted/released counters equal. An exception anywhere between
-    acquire() and the release in the REST layer's finally would show up
-    here as a permanent slot leak that eventually 429s everything."""
+    """The permit-leak invariant (ISSUE 11, extended to scheduler-queued
+    requests in ISSUE 12): after a row quiesces, the backpressure gate
+    must be back at baseline — current == 0 and the admitted/released
+    counters equal — and the wave scheduler's queue must be EMPTY. A
+    request stranded in the coalesce queue holds its permit forever
+    (its thread blocks inside the acquire/release bracket), so a
+    non-drained queue IS a permit leak in the making; checking both
+    makes the invariant cover the window."""
     bp = node.search_backpressure
     if bp.current != 0 or bp.admitted_total != bp.released_total:
         violations.append(
             f"{row}: permit leak (current={bp.current}, "
             f"admitted={bp.admitted_total}, "
             f"released={bp.released_total})")
+    sched = getattr(node, "wave_scheduler", None)
+    if sched is not None and sched.queue_depth() != 0:
+        violations.append(
+            f"{row}: wave scheduler queue not drained "
+            f"(depth={sched.queue_depth()})")
 
 
 def _rule(site, kind):
@@ -432,7 +440,7 @@ def _scenario_rows(node, clean_search, logs_shards, hyb_shards,
 
 def run_chaos_concurrent(clients: int = 4, n_requests: int = 96,
                          rate: float = 150.0, seed: int = 3,
-                         node=None):
+                         node=None, scheduler: bool = False):
     """Chaos UNDER concurrency (ISSUE 11): seeded faults fire at
     `query.dispatch` (permanent, per-shard) and `fetch.gather`
     (transient, retry-absorbed) WHILE `clients` open-loop workers drive
@@ -473,22 +481,36 @@ def run_chaos_concurrent(clients: int = 4, n_requests: int = 96,
     if owns_node:
         node = build_corpus()
     violations: list = []
+    if scheduler:
+        # ISSUE 12: the same chaos contract with the wave scheduler
+        # COALESCING while the faults fire — per-wave fault isolation
+        # must downgrade only the owning wave's items even when those
+        # items belong to different coalesced requests, and the permit
+        # invariant must hold across the window (checked below with
+        # the queue-drained extension)
+        node.wave_scheduler.set_enabled(True)
+    # the scheduler variant drives the SINGLE-SHARD index so requests
+    # actually coalesce (the scheduler only engages there); a
+    # one-shard index has no partial-failure escape — one shard failed
+    # IS all shards failed, a legitimate 503 — so its fault schedule
+    # is transient-only: the bounded retry helper must absorb every
+    # fire inside the shared waves
+    path = "/m1/_search" if scheduler else "/logs/_search"
     # warm the executables so the measured window exercises fault
     # handling, not compiles
-    clean = node.request("POST", "/logs/_search", SEARCH_BODY)
+    clean = node.request("POST", path, SEARCH_BODY)
     assert clean["_status"] == 200, clean
     bodies = [{**SEARCH_BODY, "size": 4 + (i % 3) * 8}
               for i in range(n_requests)]
     for b in bodies[:6]:
-        node.request("POST", "/logs/_search", b)
+        node.request("POST", path, b)
     base_admitted = node.search_backpressure.admitted_total
     base_released = node.search_backpressure.released_total
 
     statuses_5xx = []
 
     def serve(body):
-        resp = node.handle("POST", "/logs/_search",
-                           body=_json.dumps(body))
+        resp = node.handle("POST", path, body=_json.dumps(body))
         if resp.status >= 500:
             statuses_5xx.append((resp.status, resp.body))
         return resp.status
@@ -498,7 +520,9 @@ def run_chaos_concurrent(clients: int = 4, n_requests: int = 96,
     # fetch.gather invocations (page hits), so same-site gaps of 90 /
     # 400 guarantee one fire per site per request at most
     for skip in (10, 100, 190):
-        faults.install({"site": "query.dispatch", "kind": "exception",
+        faults.install({"site": "query.dispatch",
+                        "kind": "transient" if scheduler
+                        else "exception",
                         "skip": skip, "max_fires": 1})
     for skip in (50, 450, 850):
         faults.install({"site": "fetch.gather", "kind": "transient",
@@ -508,6 +532,15 @@ def run_chaos_concurrent(clients: int = 4, n_requests: int = 96,
                                      arrival_rate=rate, seed=seed)
     finally:
         faults.clear()
+        if scheduler:
+            # disable drains: every queued request completes before
+            # the thread exits, so the depth check below sees 0 or a
+            # real leak
+            node.wave_scheduler.set_enabled(False)
+    if scheduler and node.wave_scheduler.queue_depth() != 0:
+        violations.append(
+            f"concurrent-chaos: scheduler queue not drained "
+            f"(depth={node.wave_scheduler.queue_depth()})")
     if statuses_5xx:
         violations.append(
             f"concurrent-chaos: {len(statuses_5xx)} 5xx response(s), "
@@ -532,6 +565,13 @@ def run_chaos_concurrent(clients: int = 4, n_requests: int = 96,
                "failed": res["failed"], "errors": res["errors"],
                "goodput_qps": res["goodput_qps"],
                "p99_ms": res["p99_ms"]}
+    if scheduler:
+        s = node.wave_scheduler.stats()
+        summary["scheduler"] = {
+            "dispatched_waves": s["dispatched_waves"],
+            "coalesced": s["coalesced"],
+            "co_batched_max": s["co_batched"]["max"],
+            "shed_deadline": s["shed_deadline"]}
     return summary, violations
 
 

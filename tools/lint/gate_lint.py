@@ -53,6 +53,11 @@ GATED_SUBSYSTEMS = (
      ("gate",)),
     ("opensearch_tpu/common/admission.py", "DeviceMemoryBreaker",
      "enabled", ("gate",)),
+    # ISSUE 12 wave scheduler: the cross-request coalescing layer is
+    # OFF by default — the default node executes every search inline,
+    # exactly the pre-scheduler path
+    ("opensearch_tpu/search/scheduler.py", "WaveScheduler", "enabled",
+     ("gate",)),
 )
 
 # no-op constants a disabled gate may return

@@ -144,6 +144,54 @@ def render_table(rows: List[dict]) -> str:
                           "attr_pct"])
 
 
+def coalesce_groups(records: List[dict]) -> Dict[str, dict]:
+    """Group tail captures by coalesce state (ISSUE 12): a capture
+    whose timeline carries any `coalesce` event with co_batched > 1
+    rode a SHARED wave (cross-request companions from the scheduler, or
+    envelope siblings); co_batched == 1 throughout is a solo dispatch.
+    The split answers the scheduler's core tail question — are the
+    slow requests the coalesced ones (window cost) or the solo ones
+    (missed coalescing)? `window_wait` is the mean queue_wait of the
+    group: the price the window charged its captures."""
+    groups: Dict[str, dict] = {}
+    for rec in records:
+        cb_max = 0
+        saw_wave = False
+        for ev in rec.get("events") or []:
+            if ev.get("event") == "coalesce":
+                saw_wave = True
+                cb_max = max(cb_max, int(ev.get("co_batched", 0) or 0))
+        if not saw_wave:
+            continue
+        key = "coalesced" if cb_max > 1 else "solo"
+        g = groups.setdefault(key, {
+            "captures": 0, "co_batched_max": 0, "took_ms": [],
+            "queue_wait_ms": []})
+        g["captures"] += 1
+        g["co_batched_max"] = max(g["co_batched_max"], cb_max)
+        g["took_ms"].append(float(rec.get("took_ms") or 0.0))
+        g["queue_wait_ms"].append(float(rec.get("queue_wait_ms") or 0.0))
+    out: Dict[str, dict] = {}
+    for key, g in groups.items():
+        took = sorted(g["took_ms"])
+        out[key] = {
+            "captures": g["captures"],
+            "co_batched_max": g["co_batched_max"],
+            "took_p50_ms": round(took[len(took) // 2], 3),
+            "took_max_ms": round(took[-1], 3),
+            "window_wait_ms": round(
+                sum(g["queue_wait_ms"]) / len(g["queue_wait_ms"]), 3),
+        }
+    return out
+
+
+def render_coalesce(groups: Dict[str, dict]) -> str:
+    rows = [{"state": k, **v} for k, v in sorted(groups.items())]
+    return _render(rows, ["state", "captures", "co_batched_max",
+                          "took_p50_ms", "took_max_ms",
+                          "window_wait_ms"])
+
+
 def rejection_groups(records: List[dict]) -> Dict[str, dict]:
     """Group captures that carry a `reject` lifecycle event by the
     structured reason + tenant the admission controller stamped
@@ -196,6 +244,10 @@ def main(argv: List[str]) -> int:
     print(f"{len(records)} captured slow request(s)   "
           f"(* = device_get nested inside query, not summed)")
     print(render_table(rows))
+    co = coalesce_groups(records)
+    if co:
+        print("\ntail by coalesce state (co_batched > 1 = shared wave):")
+        print(render_coalesce(co))
     groups = rejection_groups(records)
     if groups:
         print(f"\nrejections by reason "

@@ -125,15 +125,21 @@ def pipeline_rows(traces: List[dict]) -> List[dict]:
     for ti, trace in enumerate(traces):
         attrs = trace.get("attributes") or {}
         lc = attrs.get("lifecycle") or {}
+        # window_wait: the request's measured scheduler-queue delay
+        # (lifecycle queue_wait_ms — the coalesce window's price,
+        # ISSUE 12), shown on each of its wave rows
+        wait = lc.get("queue_wait_ms")
+        wait = wait if isinstance(wait, (int, float)) and wait > 0 \
+            else "-"
         waves: Dict[Any, dict] = {}
         for ev in lc.get("events") or []:
             w = ev.get("wave")
             if w is None:
                 continue
             row = waves.setdefault(w, {
-                "trace": ti, "wave": w, "co_batched": "-",
-                "inflight_waves": "-", "overlap_ms": "-",
-                "collect_ms": "-"})
+                "trace": ti, "wave": w, "window_wait_ms": wait,
+                "co_batched": "-", "inflight_waves": "-",
+                "overlap_ms": "-", "collect_ms": "-"})
             name = ev.get("event")
             if name == "coalesce":
                 row["co_batched"] = ev.get("co_batched", "-")
@@ -147,6 +153,7 @@ def pipeline_rows(traces: List[dict]) -> List[dict]:
             rows.extend(waves[w] for w in sorted(waves))
         elif "waves" in attrs or "overlap_ms" in attrs:
             rows.append({"trace": ti, "wave": "(all)",
+                         "window_wait_ms": wait,
                          "co_batched": "-", "inflight_waves": "-",
                          "overlap_ms": attrs.get("overlap_ms", "-"),
                          "collect_ms": "-",
@@ -170,7 +177,8 @@ def render_table(rows: List[dict]) -> str:
 
 
 def render_pipeline_table(rows: List[dict]) -> str:
-    return _render(rows, ["trace", "wave", "co_batched", "inflight_waves",
+    return _render(rows, ["trace", "wave", "window_wait_ms",
+                          "co_batched", "inflight_waves",
                           "overlap_ms", "collect_ms"])
 
 
