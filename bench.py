@@ -135,6 +135,24 @@ ARRIVAL_RATE_ARG = None
 if "--arrival-rate" in sys.argv:
     ARRIVAL_RATE_ARG = float(sys.argv[sys.argv.index("--arrival-rate") + 1])
 
+# --ingest-rate R: search/ingest interference mode (ISSUE 13): a seeded
+# open-loop indexing client (tools/openloop.py's Poisson scheduler,
+# periodic refresh + tiered merges on a REAL InternalEngine-backed
+# shard) runs concurrently with the --clients/--arrival-rate search
+# workload. Points: an ingest-off control plus BENCH_INGEST_RATES
+# (default R/2 and R) — indexing throughput vs search p50/p99, with the
+# flight recorder on so every tail capture carries its `ingest_events`
+# annotation ("did a merge cause this p99") and the churn ledger
+# attributing each refresh/merge's device cost. Records land in
+# BENCH_INTERFERENCE_r<N>.json (+ captures in
+# BENCH_INTERFERENCE_TAIL_r<N>.jsonl); tools/bench_compare.py gates
+# search-p99-at-equal-ingest-rate and ingest throughput across rounds.
+# Without the flag the run ASSERTS the ingest recorder and churn
+# ledger are no-ops (gates return None), like the tracer/ledger.
+INGEST_RATE_ARG = None
+if "--ingest-rate" in sys.argv:
+    INGEST_RATE_ARG = float(sys.argv[sys.argv.index("--ingest-rate") + 1])
+
 # --scheduler: run the open-loop mode through the async wave scheduler
 # (search/scheduler.py, ISSUE 12): concurrent clients' requests
 # coalesce into shared device waves instead of each paying a full B=1
@@ -212,6 +230,20 @@ def _setup_telemetry():
     assert TELEMETRY.flight.timeline() is None, \
         "disabled flight recorder must be a no-op (timeline gate must " \
         "return None)"
+    # and the write-path pair (ISSUE 13): ingest recorder + churn
+    # ledger join the tracer/ledger/injector/recorder discipline — the
+    # interference mode enables them itself, on its own node state
+    assert TELEMETRY.ingest.enabled is False, \
+        "ingest recorder must be disabled for clean benches"
+    assert TELEMETRY.ingest.timeline() is None \
+        and TELEMETRY.ingest.current() is None, \
+        "disabled ingest recorder must be a no-op (gates must return " \
+        "None)"
+    assert TELEMETRY.churn.enabled is False, \
+        "churn ledger must be disabled for clean benches"
+    assert TELEMETRY.churn.scope() is None \
+        and TELEMETRY.churn.current() is None, \
+        "disabled churn ledger must be a no-op (gates must return None)"
 
 
 def _setup_admission():
@@ -421,6 +453,302 @@ def _flight_overhead_pct(runs: int, warm_wall_s: float) -> float:
     assert pct < 2.0, \
         f"flight-recorder overhead {pct:.3f}% of warm wall (contract: <2%)"
     return round(pct, 4)
+
+
+def _ingest_overhead_pct(ops: int, events: int, churn_records: int,
+                         wall_s: float) -> float:
+    """Enabled write-path-instrumentation overhead over a measured
+    interference window, the analytic method of the PR 7 ledger / PR 10
+    flight gates: per-op ingest-timeline cost + per-event (event-log
+    note + churn publish) cost measured on throwaway instances × the
+    volumes the real window saw, ASSERTED under 2% of the wall."""
+    import time as _time
+
+    from opensearch_tpu.telemetry.ledger import ChurnLedger, ChurnScope
+    from opensearch_tpu.telemetry.lifecycle import (IngestEventLog,
+                                                    IngestRecorder)
+    probe = IngestRecorder()
+    probe.enabled = True
+    n = 5000
+    t0 = _time.perf_counter()
+    for _ in range(n):
+        tl = probe.timeline()
+        with probe.bound(tl):
+            tl.phase_add("version_plan", 0.01)
+            tl.phase_add("parse", 0.01)
+            tl.phase_add("translog_append", 0.01)
+        tl.event("respond")
+        probe.complete(tl, kind="op")
+    per_op_s = (_time.perf_counter() - t0) / n
+    ev_probe = IngestEventLog()
+    ch_probe = ChurnLedger()
+    ch_probe.enabled = True
+    m = 2000
+    t0 = _time.perf_counter()
+    for _ in range(m):
+        ev_probe.note("refresh", 0.0, 0.001, seg_id="s0", docs=32,
+                      live_doc_ratio=1.0, segments=4, deletes_applied=0)
+        sc = ch_probe.scope()
+        sc.note_upload("s0", 4096, True)
+        ch_probe.publish(sc, "refresh", segments_before=3,
+                         segments_after=4, docs=32, wall_ms=1.0)
+    per_event_s = (_time.perf_counter() - t0) / m
+    est_s = ops * per_op_s + max(events, churn_records) * per_event_s
+    pct = 100.0 * est_s / max(wall_s, 1e-9)
+    assert pct < 2.0, \
+        f"ingest instrumentation overhead {pct:.3f}% of the measured " \
+        f"wall (contract: <2%)"
+    return round(pct, 4)
+
+
+def bench_interference(clients: int, rate: float, base_ingest_rate: float):
+    """--ingest-rate (ISSUE 13): streaming ingest concurrent with warm
+    serving, measured. One InternalEngine-backed shard adopts the bench
+    corpus (install_segments — the segment-replication copy path), warm
+    search traffic runs open-loop at `rate` req/s from `clients`
+    threads, and a seeded open-loop indexing client (same Poisson
+    scheduler) indexes fresh docs at each point's ingest rate with a
+    refresh every BENCH_INGEST_REFRESH_EVERY ops and tiered merges as
+    segments accumulate. Points: ingest-off control + BENCH_INGEST_RATES
+    (default R/2, R). The flight recorder captures the search tail with
+    `ingest_events` annotations; the churn ledger attributes every
+    refresh/merge's device-side cost; the enabled-instrumentation
+    overhead is asserted <2% of the measured wall (analytic, PR 7/PR 10
+    method)."""
+    import threading
+
+    import jax
+
+    from opensearch_tpu.index.seqno import NO_OPS_PERFORMED
+    from opensearch_tpu.index.shard import IndexShard
+    from opensearch_tpu.search.controller import execute_search
+    from opensearch_tpu.telemetry import TELEMETRY
+    from opensearch_tpu.telemetry.lifecycle import INGEST_EVENTS
+    from opensearch_tpu.utils.demo import (build_shards, query_terms,
+                                           synth_docs)
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(here, "tools"))
+    import openloop
+    import tail_report
+
+    platform = jax.devices()[0].platform
+    n_docs = int(os.environ.get("BENCH_INGEST_DOCS", "50000"))
+    n_req = int(os.environ.get("BENCH_CONC_REQUESTS", "384"))
+    refresh_every = int(os.environ.get("BENCH_INGEST_REFRESH_EVERY",
+                                       "32"))
+    rnd = int(os.environ.get("BENCH_INTERFERENCE_ROUND", "1"))
+    rates = [float(m) for m in os.environ.get(
+        "BENCH_INGEST_RATES",
+        f"{base_ingest_rate / 2:g},{base_ingest_rate:g}").split(",")]
+
+    # a REAL write-path shard: engine + translog-less store + device
+    # reader, adopting the prebuilt corpus segment so the serving side
+    # starts warm and sealed (install_segments = the recovery/
+    # segment-replication copy path)
+    mapper, segments = build_shards(n_docs, n_shards=1,
+                                    vocab_size=VOCAB, avg_len=60,
+                                    seed=42)
+    shard = IndexShard(0, mapper, index_name="bench")
+    shard.engine.install_segments(segments,
+                                  max_seq_no=NO_OPS_PERFORMED,
+                                  local_checkpoint=NO_OPS_PERFORMED)
+    shard._sync_reader()
+    # merge pressure inside the measured window: with the default cap
+    # of 8 a short bench never merges — 4 makes "merge while queries
+    # fly" actually happen at the committed rates
+    shard.engine.merge_max_segments = int(os.environ.get(
+        "BENCH_INGEST_MERGE_MAX_SEGMENTS", "4"))
+    executor = shard.executor
+
+    queries = query_terms(max(n_req, 64), VOCAB, seed=7,
+                          terms_per_query=2)
+    bodies = [{"query": {"match": {"body": queries[i % len(queries)]}},
+               "size": TOP_K} for i in range(n_req)]
+    ingest_docs = synth_docs(int(max(rates) * (n_req / rate) * 3) + 256,
+                             VOCAB, avg_len=60, seed=97)
+
+    def serve(body):
+        execute_search([executor], dict(body), allow_envelope=True)
+
+    # warm the search executables before anything is measured
+    for b in bodies[:64]:
+        serve(b)
+    t0 = time.perf_counter()
+    for b in bodies[:128]:
+        serve(b)
+    closed_qps = 128 / (time.perf_counter() - t0)
+
+    flight = TELEMETRY.flight
+    ing = TELEMETRY.ingest
+    churn = TELEMETRY.churn
+    flight.enabled = True
+    ing.enabled = True
+    churn.enabled = True
+
+    doc_seq = [0]
+    ingested = [0]
+
+    def ingest_serve(_item):
+        # the REAL instrumented write path: one ingest timeline per op
+        # (the REST do_index flow minus the node), refresh every K ops,
+        # merge when the tier policy says so
+        i = doc_seq[0]
+        doc_seq[0] += 1
+        tl = ing.timeline()
+        try:
+            with ing.bound(tl):
+                shard.index_doc(f"ing{i}",
+                                ingest_docs[i % len(ingest_docs)])
+                if (i + 1) % refresh_every == 0:
+                    shard.refresh()
+                    shard.maybe_merge()
+        except BaseException:
+            if tl is not None:
+                ing.complete(tl, status="error", kind="op")
+            raise
+        if tl is not None:
+            tl.event("respond")
+            ing.complete(tl, status="ok", kind="op")
+        ingested[0] += 1
+
+    def run_point(ingest_rate):
+        flight.clear()
+        churn_before = churn.snapshot()["totals"]
+        events_before = INGEST_EVENTS.stats()["events"]
+        ops_before = ingested[0]
+        t_run0 = time.perf_counter()
+        ingest_res = [None]
+        ingest_thread = None
+        if ingest_rate > 0:
+            n_ingest = max(int(ingest_rate * (n_req / rate)),
+                           refresh_every)
+
+            def _ingest_loop():
+                ingest_res[0] = openloop.run_open_loop(
+                    ingest_serve, list(range(n_ingest)), clients=1,
+                    arrival_rate=ingest_rate, seed=23)
+            ingest_thread = threading.Thread(target=_ingest_loop,
+                                             daemon=True,
+                                             name="bench-ingest")
+            ingest_thread.start()
+        res = openloop.run_open_loop(serve, bodies, clients=clients,
+                                     arrival_rate=rate, seed=11)
+        if ingest_thread is not None:
+            ingest_thread.join()
+        wall_s = time.perf_counter() - t_run0
+        assert res["errors"] == 0, \
+            f"interference point i={ingest_rate} saw {res['errors']} " \
+            f"search error(s)"
+        captured = flight.captured()
+        # the acceptance join: EVERY capture carries its ingest_events
+        # annotation (empty list = write path quiet during its window)
+        missing = [c for c in captured if "ingest_events" not in c]
+        assert not missing, \
+            f"{len(missing)} capture(s) missing the ingest_events " \
+            f"annotation"
+        churn_after = churn.snapshot()["totals"]
+        churn_delta = {k: churn_after[k] - churn_before.get(k, 0)
+                       for k in churn_after}
+        events_delta = INGEST_EVENTS.stats()["events"] - events_before
+        ops_delta = ingested[0] - ops_before
+        point = {
+            "metric": f"bm25_interference_{n_docs // 1000}k_docs_"
+                      f"{clients}c_{platform}",
+            "mode": f"bm25_interference_{clients}c_{rate:g}rps_"
+                    f"i{ingest_rate:g}",
+            "value": res["qps"],
+            "unit": "queries/s",
+            "ingest_rate": ingest_rate,
+            **{k: res[k] for k in (
+                "clients", "arrival_rate", "n_requests", "duration_s",
+                "p50_ms", "p99_ms", "p999_ms", "mean_queue_wait_ms",
+                "service_p50_ms", "service_p99_ms", "errors")},
+        }
+        ir = ingest_res[0]
+        point["ingest_dps"] = round(ir["qps"], 2) if ir else 0.0
+        if ir:
+            assert ir["errors"] == 0, \
+                f"ingest client recorded {ir['errors']} error(s)"
+            point["ingest"] = {
+                "offered_rate": ingest_rate,
+                "ops": ir["n_requests"],
+                "achieved_dps": round(ir["qps"], 2),
+                "op_p50_ms": ir["service_p50_ms"],
+                "op_p99_ms": ir["service_p99_ms"],
+                "refreshes": churn_delta.get("refresh", 0),
+                "merges": churn_delta.get("merge", 0),
+            }
+        point["churn"] = churn_delta
+        ann = [c for c in captured if c.get("ingest_events")]
+        point["tail"] = {
+            "captured": len(captured),
+            "with_ingest_events": len(ann),
+            "attr_pct_min": min(
+                (tail_report.attribution(c)["attr_pct"]
+                 for c in captured), default=None),
+        }
+        point["ingest_overhead_pct"] = _ingest_overhead_pct(
+            ops_delta, events_delta, churn_delta.get("events", 0),
+            wall_s)
+        return point, captured
+
+    records = []
+    all_captures = []
+    for irate in [0.0] + rates:
+        point, captured = run_point(irate)
+        records.append(point)
+        all_captures.extend(captured)
+        # churn attribution must actually fire while ingest runs: every
+        # effective refresh/merge owes exactly one churn record joined
+        # to its engine event
+        if irate > 0:
+            assert point["churn"].get("events", 0) > 0, \
+                f"ingest point i={irate} produced no churn records"
+    for rec_ in churn.records():
+        assert rec_.get("event_id") is not None, \
+            f"churn record without an engine event join: {rec_}"
+
+    flight.enabled = False
+    ing.enabled = False
+    churn.enabled = False
+
+    tail_path = os.path.join(here,
+                             f"BENCH_INTERFERENCE_TAIL_r{rnd:02d}.jsonl")
+    with open(tail_path, "w") as f:
+        for rec_ in all_captures:
+            f.write(json.dumps(rec_) + "\n")
+    with open(os.path.join(here,
+                           f"BENCH_INTERFERENCE_r{rnd:02d}.json"),
+              "w") as f:
+        for rec_ in records:
+            f.write(json.dumps(rec_) + "\n")
+
+    control = records[0]
+    worst = max(records[1:], key=lambda r: r["p99_ms"]) \
+        if len(records) > 1 else control
+    out = {
+        "metric": f"bm25_interference_{n_docs // 1000}k_docs_"
+                  f"{clients}c_{platform}",
+        "mode": "bm25_interference_sweep",
+        "value": control["value"],
+        "unit": "queries/s",
+        "vs_baseline": round(control["value"] / max(closed_qps, 1e-9),
+                             3),
+        "closed_loop_qps": round(closed_qps, 2),
+        "control_p99_ms": control["p99_ms"],
+        "worst_ingest_p99_ms": worst["p99_ms"],
+        "p99_degradation_pct": round(
+            100.0 * (worst["p99_ms"] - control["p99_ms"])
+            / max(control["p99_ms"], 1e-9), 1),
+        "points": [{k: r.get(k) for k in (
+            "ingest_rate", "ingest_dps", "value", "p50_ms", "p99_ms",
+            "ingest_overhead_pct")} for r in records],
+        "churn_totals": TELEMETRY.churn.snapshot()["totals"],
+    }
+    if _BACKEND_DIAG:
+        out["backend_diag"] = "; ".join(_BACKEND_DIAG)
+    print(json.dumps(out))
 
 
 def _ab_overlap(executor, bodies, reps: int):
@@ -1409,6 +1737,11 @@ def main():
     if OVERLOAD_SWEEP:
         bench_overload_sweep()
         return
+    if INGEST_RATE_ARG is not None:
+        bench_interference(CLIENTS_ARG or 8,
+                           ARRIVAL_RATE_ARG or 50.0,
+                           INGEST_RATE_ARG)
+        return
     if CLIENTS_ARG:
         bench_openloop(CLIENTS_ARG, ARRIVAL_RATE_ARG or 50.0)
         return
@@ -1520,9 +1853,9 @@ def _run_extra_configs():
     probe when this process already fell back to CPU."""
     if os.environ.get("BENCH_SKIP_EXTRA") == "1" \
             or os.environ.get("BENCH_MODE") or FAULTS_ON or AB_OVERLAP \
-            or CLIENTS_ARG:
-        # --faults / --ab-overlap / --clients are single-config runs:
-        # no children
+            or CLIENTS_ARG or INGEST_RATE_ARG is not None:
+        # --faults / --ab-overlap / --clients / --ingest-rate are
+        # single-config runs: no children
         return
     import subprocess
 

@@ -22,8 +22,10 @@ Re-design of the reference InternalEngine (index/engine/InternalEngine.java):
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -34,6 +36,17 @@ from opensearch_tpu.index.seqno import (
     NO_OPS_PERFORMED, LocalCheckpointTracker, ReplicationTracker)
 from opensearch_tpu.index.store import Store
 from opensearch_tpu.index.translog import Translog, TranslogOp
+from opensearch_tpu.telemetry import INGEST_EVENTS, TELEMETRY
+
+# write-path observability handles (ISSUE 13). The metrics registry is
+# always-on by contract (one lock + a few float ops per REFRESH, never
+# per query); the ingest recorder is OFF by default and `current()`
+# tests its flag before touching thread-local state — the disabled
+# index() path costs one attribute load and a branch.
+_METRICS = TELEMETRY.metrics
+_INGEST = TELEMETRY.ingest
+
+_logger = logging.getLogger("opensearch_tpu.index.engine")
 
 
 @dataclass
@@ -89,6 +102,14 @@ class InternalEngine:
         self._pending_seal_deletes: List[str] = []
         self._dirty_live: Set[str] = set()  # segs whose live mask changed
         self._refresh_listeners: List = []
+        # the IngestEventLog record of the last effective refresh/merge
+        # (None when the last call was a no-op) — IndexShard joins its
+        # churn record against it by event_id. THREAD-LOCAL: the shard
+        # reads it on the same thread right after the call, and a
+        # concurrent refresh/merge on another thread must not null or
+        # swap the handle between an effective refresh and its read
+        # (a mispaired event_id would corrupt the churn join).
+        self._ingest_event_tls = threading.local()
         self.store: Optional[Store] = None
         self.translog: Optional[Translog] = None
         if data_path is not None:
@@ -107,6 +128,33 @@ class InternalEngine:
     def add_refresh_listener(self, fn):
         """fn(new_segment | None, deleted_from: List[Segment]) on each refresh."""
         self._refresh_listeners.append(fn)
+
+    def _notify_refresh_listeners(self, new_seg, deleted_from):
+        """Run refresh listeners isolated per listener: a raising
+        listener must not abort segment publish (the refresh already
+        happened — segments are live) nor starve later listeners of the
+        notification. Failures log typed and count on
+        `indexing.refresh_listener_failures` (ISSUE 13 satellite)."""
+        for fn in self._refresh_listeners:
+            try:
+                fn(new_seg, deleted_from)
+            except Exception as e:  # except-ok: listener isolation -- segment publish already happened; one bad listener must not abort it or starve siblings
+                _METRICS.counter(
+                    "indexing.refresh_listener_failures").inc()
+                _logger.warning(
+                    "refresh listener %r failed: %s: %s",
+                    getattr(fn, "__qualname__", fn),
+                    type(e).__name__, e)
+
+    @property
+    def last_ingest_event(self) -> Optional[dict]:
+        """This thread's last refresh/merge event record (None when the
+        last call on this thread was a no-op)."""
+        return getattr(self._ingest_event_tls, "event", None)
+
+    @last_ingest_event.setter
+    def last_ingest_event(self, ev: Optional[dict]) -> None:
+        self._ingest_event_tls.event = ev
 
     @property
     def max_seq_no(self) -> int:
@@ -182,14 +230,33 @@ class InternalEngine:
         caller-assigned version that must exceed the current one."""
         if external_version is not None:
             version = external_version
+        _METRICS.counter("indexing.ops").inc()
+        # ingest lifecycle (telemetry/lifecycle.py): the thread-bound
+        # timeline, None when the recorder is off — the disabled path
+        # pays this one call + branch per op
+        itl = _INGEST.current()
         with self._lock:
+            # ONE copy of the write sequence — the timeline checkpoints
+            # bracket it conditionally, so instrumented and plain runs
+            # execute identical engine code (the off-differential pin)
+            if itl is not None:
+                t0 = time.perf_counter()
             new_version, created = self._plan_versioning(
                 doc_id, op_type, if_seq_no, if_primary_term, version)
             seq_no = self.local_checkpoint_tracker.generate_seq_no()
+            if itl is not None:
+                t1 = time.perf_counter()
+                itl.phase_add("version_plan", (t1 - t0) * 1000)
             self._do_index(doc_id, source, seq_no, new_version)
+            if itl is not None:
+                t2 = time.perf_counter()
+                itl.phase_add("parse", (t2 - t1) * 1000)
             self._log_op(TranslogOp("index", seq_no, self.primary_term,
                                     doc_id=doc_id, source=source,
                                     version=new_version))
+            if itl is not None:
+                itl.phase_add("translog_append",
+                              (time.perf_counter() - t2) * 1000)
             self.local_checkpoint_tracker.mark_processed(seq_no)
             self._sync_own_checkpoint()
             return EngineResult(doc_id, new_version, seq_no,
@@ -321,7 +388,52 @@ class InternalEngine:
     # ------------------------------------------------------- refresh / flush
 
     def refresh(self) -> Optional[Segment]:
-        """Seal the RAM buffer; make buffered writes+deletes searchable."""
+        """Seal the RAM buffer; make buffered writes+deletes searchable.
+
+        Instrumented (ISSUE 13): always-on metrics (docs sealed,
+        segments in/out, seal wall, live-doc ratio), one IngestEventLog
+        record per effective refresh (the flight recorder joins tail
+        captures against it), and an engine-side span when tracing is
+        on. The no-op case (empty buffer, no pending deletes) records
+        nothing — a bench's per-op `refresh=true` probe must not flood
+        the event log."""
+        t0_mono = time.monotonic()
+        span = TELEMETRY.tracer.start_trace("engine.refresh")
+        try:
+            new_seg, deleted_from = self._refresh_locked()
+        except BaseException as e:  # except-ok: span lifecycle -- closes the engine span with error status, then always re-raises
+            span.end(error=e)
+            TELEMETRY.tracer.finish(span)
+            raise
+        self.last_ingest_event = None
+        if new_seg is not None or deleted_from:
+            t1_mono = time.monotonic()
+            wall_ms = (t1_mono - t0_mono) * 1000
+            docs = new_seg.num_docs if new_seg is not None else 0
+            live = new_seg.live_doc_count if new_seg is not None else 0
+            _METRICS.counter("indexing.refreshes").inc()
+            _METRICS.counter("indexing.refresh_docs").inc(docs)
+            _METRICS.histogram("indexing.refresh_ms").observe(wall_ms)
+            self.last_ingest_event = INGEST_EVENTS.note(
+                "refresh", t0_mono, t1_mono,
+                seg_id=new_seg.seg_id if new_seg is not None else None,
+                docs=docs,
+                live_doc_ratio=round(live / docs, 4) if docs else None,
+                segments=len(self.segments),
+                deletes_applied=len(deleted_from))
+            if span.recording:
+                span.set_attribute("seg_id", new_seg.seg_id
+                                   if new_seg is not None else None)
+                span.set_attribute("docs", docs)
+                span.set_attribute("deletes_applied", len(deleted_from))
+            itl = _INGEST.current()
+            if itl is not None:
+                itl.phase_add("refresh", wall_ms)
+        TELEMETRY.tracer.finish(span)
+        return new_seg
+
+    def _refresh_locked(self):
+        """The seal proper; returns (new_segment | None, deleted_from)."""
         with self._lock:
             deleted_from: List[Segment] = []
             # apply buffered deletes/updates to sealed segments' live bitmaps
@@ -362,21 +474,47 @@ class InternalEngine:
                 self.builder = SegmentBuilder(self.mapper, self._next_seg_id())
                 self._builder_ords = {}
             if new_seg is not None or deleted_from:
-                for fn in self._refresh_listeners:
-                    fn(new_seg, deleted_from)
-            return new_seg
+                self._notify_refresh_listeners(new_seg, deleted_from)
+            return new_seg, deleted_from
 
     def flush(self) -> None:
         """Refresh + durable commit point + translog roll/trim
         (InternalEngine.flush → Lucene commit analog)."""
+        t0_mono = time.monotonic()
+        span = TELEMETRY.tracer.start_trace("engine.flush")
+        try:
+            persisted = self._flush_inner()
+        except BaseException as e:  # except-ok: span lifecycle -- closes the engine span with error status, then always re-raises
+            span.end(error=e)
+            TELEMETRY.tracer.finish(span)
+            raise
+        t1_mono = time.monotonic()
+        wall_ms = (t1_mono - t0_mono) * 1000
+        _METRICS.counter("indexing.flushes").inc()
+        _METRICS.histogram("indexing.flush_ms").observe(wall_ms)
+        if persisted:
+            INGEST_EVENTS.note("flush", t0_mono, t1_mono,
+                               segments_persisted=persisted,
+                               segments=len(self.segments))
+        if span.recording:
+            span.set_attribute("segments_persisted", persisted)
+        TELEMETRY.tracer.finish(span)
+        itl = _INGEST.current()
+        if itl is not None:
+            itl.phase_add("flush", wall_ms)
+
+    def _flush_inner(self) -> int:
+        """The commit proper; returns how many segments persisted."""
         with self._lock:
             self.refresh()
             if self.store is None:
-                return
+                return 0
+            persisted = 0
             for seg in self.segments:
                 if seg.seg_id not in self._persisted:
                     self.store.write_segment(seg)
                     self._persisted.add(seg.seg_id)
+                    persisted += 1
                 elif seg.seg_id in self._dirty_live:
                     self.store.write_live_mask(seg)
             self._dirty_live.clear()
@@ -398,24 +536,55 @@ class InternalEngine:
                     self.replication_tracker.min_retained_seq_no(),
                     max_gen=tl_gen)
             self.store.cleanup_unreferenced()
+            return persisted
 
     def maybe_merge(self) -> Optional[Segment]:
         """Tiered-merge-lite (MergePolicyConfig/OpenSearchTieredMergePolicy
         analog): when sealed segments exceed the cap, merge the smallest half
         into one. Host-side rebuild; the merged segment replaces its inputs."""
+        t0_mono = time.monotonic()
+        span = TELEMETRY.tracer.start_trace("engine.merge")
         with self._lock:
+            self.last_ingest_event = None
             if len(self.segments) <= self.merge_max_segments:
+                TELEMETRY.tracer.finish(span)
                 return None
             ranked = sorted(self.segments, key=lambda s: s.num_docs)
             victims = ranked[:max(2, len(ranked) // 2)]
-            merged = merge_segments(self.mapper, victims, self._next_seg_id())
+            try:
+                merged = merge_segments(self.mapper, victims,
+                                        self._next_seg_id())
+            except BaseException as e:  # except-ok: span lifecycle -- closes the engine span with error status, then always re-raises
+                span.end(error=e)
+                TELEMETRY.tracer.finish(span)
+                raise
             victim_ids = {s.seg_id for s in victims}
             self.segments = [s for s in self.segments
                              if s.seg_id not in victim_ids]
             self.segments.append(merged)
             self._persisted -= victim_ids
-            for fn in self._refresh_listeners:
-                fn(merged, [])
+            t1_mono = time.monotonic()
+            wall_ms = (t1_mono - t0_mono) * 1000
+            docs_in = sum(s.num_docs for s in victims)
+            _METRICS.counter("indexing.merges").inc()
+            _METRICS.counter("indexing.merge_docs").inc(merged.num_docs)
+            _METRICS.histogram("indexing.merge_ms").observe(wall_ms)
+            self.last_ingest_event = INGEST_EVENTS.note(
+                "merge", t0_mono, t1_mono,
+                seg_id=merged.seg_id,
+                segments_in=len(victims),
+                docs_in=docs_in,
+                docs=merged.num_docs,
+                live_doc_ratio=round(
+                    merged.live_doc_count / merged.num_docs, 4)
+                if merged.num_docs else None,
+                segments=len(self.segments))
+            if span.recording:
+                span.set_attribute("seg_id", merged.seg_id)
+                span.set_attribute("segments_in", len(victims))
+                span.set_attribute("docs", merged.num_docs)
+            self._notify_refresh_listeners(merged, [])
+            TELEMETRY.tracer.finish(span)
             return merged
 
     def install_segments(self, segments: List[Segment], max_seq_no: int,
@@ -448,8 +617,7 @@ class InternalEngine:
             self.local_checkpoint_tracker = LocalCheckpointTracker(
                 max_seq_no=max_seq_no, local_checkpoint=local_checkpoint)
             self._sync_own_checkpoint()
-            for fn in self._refresh_listeners:
-                fn(None, [])
+            self._notify_refresh_listeners(None, [])
 
     # --------------------------------------------------------------- reopen
 

@@ -10,6 +10,8 @@ the coordinator reduce (search/controller.py).
 
 from __future__ import annotations
 
+import json
+import logging
 import secrets
 import time
 import uuid
@@ -27,6 +29,95 @@ from opensearch_tpu.index.shard import IndexShard
 def _auto_id() -> str:
     """Auto-generated doc id (reference: time-based UUID, 20 url-safe chars)."""
     return secrets.token_urlsafe(15)
+
+
+# ------------------------------------------------------- indexing slow log
+
+# child logger under the reference's name shape (IndexingSlowLog.java:
+# "index.indexing.slowlog.index") so existing capture config keeps
+# working — the search slow log's sibling (rest/actions.py)
+_INDEXING_SLOW_LOGGER = logging.getLogger(
+    "opensearch_tpu.index.indexing.slowlog.index")
+
+# most severe first: the first threshold the op time clears wins
+_INDEXING_SLOW_LEVELS = (("warn", logging.WARNING),
+                         ("info", logging.INFO),
+                         ("debug", logging.DEBUG), ("trace", 5))
+
+_SLOWLOG_THRESHOLD_KEYS = tuple(
+    f"indexing.slowlog.threshold.index.{level}"
+    for level, _ in _INDEXING_SLOW_LEVELS)
+
+
+def _slow_log_source(settings: dict, source: dict) -> str:
+    """Render the source line per reference semantics
+    (IndexingSlowLogMessage): `index.indexing.slowlog.source` is the max
+    characters to include (default 1000), `false`/`0` omits the source
+    entirely, `true` logs it whole."""
+    raw = settings.get("indexing.slowlog.source", 1000)
+    if isinstance(raw, str):
+        low = raw.strip().lower()
+        if low == "true":
+            limit = -1
+        elif low == "false":
+            limit = 0
+        else:
+            try:
+                limit = int(low)
+            except ValueError:
+                limit = 1000      # unparseable: reference default
+    elif raw is True:
+        limit = -1
+    elif raw is False:
+        limit = 0
+    else:
+        try:
+            limit = int(raw)
+        except (TypeError, ValueError):
+            limit = 1000          # null/odd types: a bad SOURCE
+            # setting must degrade like a bad threshold does, never
+            # 500 the write that tripped the slow log
+    if limit == 0:
+        return ""
+    try:
+        text = json.dumps(source, default=str)
+    except (TypeError, ValueError):
+        text = str(source)
+    if limit > 0 and len(text) > limit:
+        # reference Strings.cleanTruncate semantics: hard cut at the
+        # character budget (surrogate safety is a non-issue here)
+        text = text[:limit]
+    return text
+
+
+def _maybe_indexing_slow_log(settings: dict, index_name: str,
+                             doc_id: Optional[str], source: dict,
+                             took_ms: float) -> None:
+    """Per-index indexing slow log (reference IndexingSlowLog.java):
+    `index.indexing.slowlog.threshold.index.{warn,info,debug,trace}`
+    each log at the matching level on the shared child logger; `-1` (any
+    negative) disables a threshold; the most severe matching level wins.
+    Covers index/create ops (the reference hook, IndexingOperationListener
+    postIndex) — the paths IndexService.index_doc serves."""
+    from opensearch_tpu.common.errors import SettingsError
+    from opensearch_tpu.common.settings import parse_time_value
+    for level, py_level in _INDEXING_SLOW_LEVELS:
+        threshold = settings.get(
+            f"indexing.slowlog.threshold.index.{level}")
+        if threshold is None:
+            continue
+        try:
+            threshold_s = parse_time_value(threshold, "slowlog")
+        except (SettingsError, TypeError, ValueError):
+            continue              # unparseable threshold never logs
+        if threshold_s < 0 or took_ms < threshold_s * 1000:
+            continue
+        _INDEXING_SLOW_LOGGER.log(
+            py_level,
+            "[%s] took[%.1fms], took_millis[%d], id[%s], source[%s]",
+            index_name, took_ms, int(took_ms), doc_id,
+            _slow_log_source(settings, source))
+        break                     # most severe matching level only
 
 
 def deep_merge(base: dict, patch: dict) -> dict:
@@ -121,6 +212,12 @@ class IndexService:
 
     # ------------------------------------------------------------- doc CRUD
 
+    def _indexing_slowlog_armed(self) -> bool:
+        """One threshold configured = time every op; none = zero-cost
+        fast path (no clock reads on the write path)."""
+        s = self.settings
+        return any(s.get(k) is not None for k in _SLOWLOG_THRESHOLD_KEYS)
+
     def index_doc(self, doc_id: Optional[str], source: dict,
                   routing: Optional[str] = None, op_type: str = "index",
                   **kw) -> dict:
@@ -129,7 +226,14 @@ class IndexService:
             doc_id = _auto_id()
             op_type = "create"
         shard = self.shard_for(doc_id, routing)
-        res = shard.index_doc(doc_id, source, op_type=op_type, **kw)
+        if not self._indexing_slowlog_armed():
+            res = shard.index_doc(doc_id, source, op_type=op_type, **kw)
+        else:
+            t0 = time.monotonic()
+            res = shard.index_doc(doc_id, source, op_type=op_type, **kw)
+            _maybe_indexing_slow_log(
+                self.settings, self.index_name, doc_id, source,
+                (time.monotonic() - t0) * 1000)
         return self._write_response(res, shard,
                                     "created" if res.created else "updated")
 

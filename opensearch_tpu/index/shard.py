@@ -7,16 +7,58 @@ tracks the primary term, and keeps the search reader (ShardReader — the
 acquireSearcher analog) in sync with the engine's sealed segments: refresh
 seals the RAM buffer and uploads the new columnar segment to device HBM,
 deletes propagate to device liveness masks.
+
+Churn attribution (ISSUE 13): refresh/merge are where the write path
+touches the device — this is the layer that can see BOTH sides (the
+engine event and the reader's device uploads), so the segment-churn
+ledger (telemetry/ledger.py ChurnLedger) is fed here: each effective
+refresh/merge publishes one churn record carrying the `upload.corpus`
+bytes it re-shipped, the recompile/warmup-hit verdict per new segment,
+and how many interned RotatingMemo entries it invalidated (the whole
+ShardStats memo dies whenever the segment list changes — every skeleton
+and bundle rebuilds on the host — plus the subset keyed to removed
+(segment-uid, mapper-version) pairs).
 """
 
 from __future__ import annotations
 
 import os
+import time
 from typing import List, Optional
 
 from opensearch_tpu.index.engine import EngineResult, GetResult, InternalEngine
 from opensearch_tpu.index.mapper import MapperService
 from opensearch_tpu.search.executor import SearchExecutor, ShardReader
+from opensearch_tpu.telemetry import TELEMETRY
+
+_CHURN = TELEMETRY.churn
+
+# RotatingMemo key prefixes whose second element is a segment uid
+# (compile.py skeletons/text-clause plans/slice buckets, executor agg
+# plans, fetch join columns) — the keys a removed segment invalidates
+# by (segment-uid, mapper-version) construction
+_UID_KEYED_PREFIXES = ("skel", "tc", "aggc", "slice", "join_cols",
+                      "join_match")
+
+
+def _memo_keyed_count(cache, removed_uids) -> int:
+    """Entries in a ShardStats memo keyed to one of `removed_uids` —
+    the precisely-attributable slice of the invalidation (the wholesale
+    drop is reported separately)."""
+    if cache is None or not removed_uids:
+        return 0
+    uids = set(removed_uids)
+    n = 0
+    for key in cache.memo.keys():
+        if not isinstance(key, tuple) or len(key) < 2:
+            continue
+        if key[0] in _UID_KEYED_PREFIXES and key[1] in uids:
+            n += 1
+        elif key[0] in uids and isinstance(key[1], str):
+            # bare (uid, fingerprint) keys (fetch-phase highlight/join
+            # memos)
+            n += 1
+    return n
 
 
 class IndexShard:
@@ -66,8 +108,37 @@ class IndexShard:
     # ------------------------------------------------------------ lifecycle
 
     def refresh(self):
-        self.engine.refresh()
-        self._sync_reader()
+        scope = _CHURN.scope()
+        if scope is None:
+            self.engine.refresh()
+            self._sync_reader()
+            return
+        t0 = time.perf_counter()
+        cache = self.reader._stats_cache
+        segments_before = len(self.reader.segments)
+        new_seg = self.engine.refresh()
+        with _CHURN.bound(scope):
+            self._sync_reader()
+        if new_seg is None and not scope.upload_bytes \
+                and not scope.live_mask_bytes:
+            return                          # no-op refresh: no record
+        ev = self.engine.last_ingest_event
+        _CHURN.publish(
+            scope, "refresh",
+            segments_before=segments_before,
+            segments_after=len(self.reader.segments),
+            docs=new_seg.num_docs if new_seg is not None else 0,
+            wall_ms=(time.perf_counter() - t0) * 1000,
+            # a new segment changes the segment list, which drops the
+            # WHOLE ShardStats memo (stats() rebuild) — every interned
+            # skeleton/bundle rebuilds on the host
+            memo_entries_dropped=(
+                len(cache.memo) if cache is not None
+                and self.reader._stats_cache is not cache else 0),
+            memo_entries_keyed=0,          # refresh removes no segment
+            event_id=ev.get("event_id") if ev else None,
+            shard=f"{self.index_name}[{self.shard_id}]",
+            warmup_registered=self._warmup_registered())
 
     def flush(self):
         self.engine.flush()
@@ -78,17 +149,55 @@ class IndexShard:
         prev = self.engine.merge_max_segments
         self.engine.merge_max_segments = 1
         try:
-            while self.engine.maybe_merge() is not None:
+            while self.maybe_merge() is not None:
                 pass
         finally:
             self.engine.merge_max_segments = prev
         self._sync_reader()
 
     def maybe_merge(self):
+        scope = _CHURN.scope()
+        if scope is None:
+            merged = self.engine.maybe_merge()
+            if merged is not None:
+                self._sync_reader()
+            return merged
+        t0 = time.perf_counter()
+        cache = self.reader._stats_cache
+        before = {s.seg_id: s.uid for s in self.engine.segments}
+        segments_before = len(self.reader.segments)
         merged = self.engine.maybe_merge()
-        if merged is not None:
+        if merged is None:
+            return None
+        removed_ids = [sid for sid in before
+                       if all(s.seg_id != sid
+                              for s in self.engine.segments)]
+        removed_uids = [before[sid] for sid in removed_ids]
+        with _CHURN.bound(scope):
             self._sync_reader()
+        ev = self.engine.last_ingest_event
+        _CHURN.publish(
+            scope, "merge",
+            segments_before=segments_before,
+            segments_after=len(self.reader.segments),
+            docs=merged.num_docs,
+            wall_ms=(time.perf_counter() - t0) * 1000,
+            memo_entries_dropped=(
+                len(cache.memo) if cache is not None
+                and self.reader._stats_cache is not cache else 0),
+            memo_entries_keyed=_memo_keyed_count(cache, removed_uids),
+            removed_seg_ids=removed_ids,
+            event_id=ev.get("event_id") if ev else None,
+            shard=f"{self.index_name}[{self.shard_id}]",
+            warmup_registered=self._warmup_registered())
         return merged
+
+    def _warmup_registered(self) -> int:
+        """Warmup-registry coverage stamped on churn records: how many
+        (plan-struct, shape-bucket) entries a replay could pre-compile
+        for this index after the event."""
+        from opensearch_tpu.search.warmup import WARMUP
+        return WARMUP.registered_count(self.index_name)
 
     def _sync_reader(self):
         """Reconcile the device-resident reader with engine segments."""

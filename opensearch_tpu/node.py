@@ -138,7 +138,12 @@ class Node:
             transfers=_tel_bool("telemetry.transfers.enabled"),
             tail=_tel_bool("telemetry.tail.enabled"),
             tail_threshold_ms=None if _tail_thr is None
-            else float(_tail_thr))
+            else float(_tail_thr),
+            # write-path observability (ISSUE 13): ingest lifecycle
+            # recorder + segment-churn ledger, OFF by default like the
+            # tracer/ledger/flight gates
+            ingest=_tel_bool("telemetry.ingest.enabled"),
+            churn=_tel_bool("telemetry.churn.enabled"))
         self.controller = RestController()
         from opensearch_tpu.rest.actions import register_all
         register_all(self)

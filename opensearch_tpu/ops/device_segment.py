@@ -58,6 +58,20 @@ class DeviceSegmentMeta:
                 return r
         return None
 
+    def compile_key(self) -> tuple:
+        """Everything a compiled program closes over, seg_id EXCLUDED —
+        seg_id is pure identity metadata, never read in traced code, so
+        two segments equal on this key (plus equal runtime arg shapes)
+        share every compiled executable. Keying the executor's JIT
+        cache on this instead of the whole meta is what lets a freshly
+        refreshed segment land in an already-compiled (plan-struct,
+        shape-bucket) family instead of paying a per-segment XLA
+        recompile (ISSUE 13 / ROADMAP item 5: incremental segment
+        publish without cold recompiles)."""
+        return (self.num_docs, self.d_pad, self.nb_pad, self.norm_rows,
+                self.numeric_fields, self.ordinal_fields,
+                self.vector_fields)
+
 
 def upload_segment(seg: Segment, to_device: bool = True):
     """Build the device pytree (dict of jnp arrays) + static meta for a segment."""

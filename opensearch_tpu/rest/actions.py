@@ -346,6 +346,29 @@ def _maybe_slow_log(node, index_expr, body, res, phase_times=None):
 # ---------------------------------------------------------------- documents
 
 def register_document_actions(node, c):
+    def _run_ingest_op(req, fn):
+        """Run a single-doc write handler under an ingest lifecycle
+        timeline (telemetry/lifecycle.py IngestRecorder, ISSUE 13):
+        arrive at construction, engine phases (parse/version_plan/
+        translog_append) accumulate via the thread binding,
+        refresh_wait lands from maybe_refresh, respond on exit. The
+        disabled path costs the timeline() gate — one attribute load
+        and a branch."""
+        ing = TELEMETRY.ingest
+        tl = ing.timeline()
+        if tl is None:
+            return fn(req)
+        try:
+            with ing.bound(tl):
+                out = fn(req)
+        except BaseException:  # except-ok: timeline lifecycle -- completes the ingest timeline with error status, then always re-raises
+            tl.event("respond")
+            ing.complete(tl, status="error", kind="op")
+            raise
+        tl.event("respond")
+        ing.complete(tl, status="ok", kind="op")
+        return out
+
     def write_params(req):
         kw = {}
         if req.param("if_seq_no") is not None:
@@ -358,8 +381,21 @@ def register_document_actions(node, c):
         return kw
 
     def maybe_refresh(req, svc):
-        if req.param("refresh") in ("true", "", "wait_for"):
+        mode = req.param("refresh")
+        if mode in ("true", "", "wait_for"):
+            tl = TELEMETRY.ingest.current()
+            if tl is None:
+                svc.refresh()
+                return
+            # refresh_wait: how long THIS request blocked on making its
+            # write searchable (seal + device upload + reader sync) —
+            # `wait_for` semantics collapse to a forced refresh on the
+            # single-node build, but the wait is measured either way
+            t0 = time.monotonic()
             svc.refresh()
+            tl.event("refresh_wait",
+                     ms=round((time.monotonic() - t0) * 1000, 3),
+                     mode="wait_for" if mode == "wait_for" else "forced")
 
     def run_pipelines(svc, idx, doc_id, source, pipeline_param):
         """default_pipeline / request pipeline / final_pipeline chain
@@ -377,6 +413,9 @@ def register_document_actions(node, c):
         return source
 
     def do_index(req):
+        return _run_ingest_op(req, _do_index_inner)
+
+    def _do_index_inner(req):
         # validation precedes auto-create: a rejected request must not
         # leave an empty index behind
         _check_require_alias(node, req)
@@ -417,6 +456,9 @@ def register_document_actions(node, c):
         return 200, res.get("_source")
 
     def do_delete(req):
+        return _run_ingest_op(req, _do_delete_inner)
+
+    def _do_delete_inner(req):
         idx = node.indices.write_index(req.param("index"))
         svc = node.indices.get(idx)
         res = svc.delete_doc(req.param("id"), routing=req.param("routing"),
@@ -425,6 +467,9 @@ def register_document_actions(node, c):
         return (200 if res.get("result") == "deleted" else 404), res
 
     def do_update(req):
+        return _run_ingest_op(req, _do_update_inner)
+
+    def _do_update_inner(req):
         # update auto-creates like any document write (the reference's
         # AutoCreateIndex covers TransportUpdateAction too — an upsert
         # against a fresh index must not 404)
@@ -461,10 +506,28 @@ def register_document_actions(node, c):
         return {"docs": docs}
 
     def do_bulk(req):
+        ing = TELEMETRY.ingest
+        tl = ing.timeline(detail=False)   # bulk: phases only, no per-op
         payload_bytes = len(req.raw_body or b"")
         node.indexing_pressure.acquire(payload_bytes)
+        if tl is not None:
+            tl.event("admit", bytes=payload_bytes)
+        ops = [0]
         try:
-            return _do_bulk_inner(req)
+            if tl is None:
+                return _do_bulk_inner(req)
+            with ing.bound(tl):
+                out = _do_bulk_inner(req)
+            ops[0] = len(out.get("items") or [])
+            tl.event("respond")
+            ing.complete(tl, status="error" if out.get("errors")
+                         else "ok", kind="bulk", ops=ops[0])
+            return out
+        except BaseException:  # except-ok: timeline lifecycle -- completes the bulk ingest timeline with error status, then always re-raises
+            if tl is not None:
+                tl.event("respond")
+                ing.complete(tl, status="error", kind="bulk", ops=ops[0])
+            raise
         finally:
             node.indexing_pressure.release(payload_bytes)
 
@@ -540,8 +603,16 @@ def register_document_actions(node, c):
             for p, item_res in zip(positions, res["items"]):
                 responses[p] = item_res
         if req.param("refresh") in ("true", "", "wait_for"):
+            _tl = TELEMETRY.ingest.current()
+            _t0 = time.monotonic() if _tl is not None else 0.0
             for concrete in by_index:
                 node.indices.get(concrete).refresh()
+            if _tl is not None:
+                _tl.event(
+                    "refresh_wait",
+                    ms=round((time.monotonic() - _t0) * 1000, 3),
+                    mode="wait_for" if req.param("refresh") == "wait_for"
+                    else "forced")
             # BulkItemResponse reports forced_refresh per successful item
             # when the request forced one (DocWriteResponse#forcedRefresh)
             for item_res in responses:
@@ -2339,6 +2410,39 @@ def register_telemetry_actions(node, c):
         TELEMETRY.flight.clear()
         return {"acknowledged": True}
 
+    def do_get_ingest(req):
+        # the write path's observability face (ISSUE 13): ingest
+        # lifecycle timelines + the always-on engine event log + the
+        # segment-churn ledger's per-event device-cost attribution
+        from opensearch_tpu.telemetry.lifecycle import INGEST_EVENTS
+        size = req.int_param("size", 0)
+        return {"enabled": TELEMETRY.ingest.enabled,
+                "stats": TELEMETRY.ingest.stats(),
+                "recent": TELEMETRY.ingest.captured(size or None),
+                "events": INGEST_EVENTS.recent(size or None),
+                "churn": {**TELEMETRY.churn.snapshot(),
+                          "records": TELEMETRY.churn.records(
+                              size or None)}}
+
+    def do_ingest_enable(req):
+        # one switch for the write-path instrumentation pair: per-op
+        # timelines AND churn attribution (they are read together)
+        TELEMETRY.ingest.enabled = True
+        TELEMETRY.churn.enabled = True
+        return {"acknowledged": True, "enabled": True}
+
+    def do_ingest_disable(req):
+        TELEMETRY.ingest.enabled = False
+        TELEMETRY.churn.enabled = False
+        return {"acknowledged": True, "enabled": False}
+
+    def do_ingest_clear(req):
+        from opensearch_tpu.telemetry.lifecycle import INGEST_EVENTS
+        TELEMETRY.ingest.clear()
+        TELEMETRY.churn.reset()
+        INGEST_EVENTS.clear()
+        return {"acknowledged": True}
+
     c.register("GET", "/_telemetry/traces", do_get_traces)
     c.register("POST", "/_telemetry/traces/_clear", do_clear_traces)
     c.register("POST", "/_telemetry/_enable", do_enable)
@@ -2354,6 +2458,10 @@ def register_telemetry_actions(node, c):
     c.register("POST", "/_telemetry/tail/_enable", do_tail_enable)
     c.register("POST", "/_telemetry/tail/_disable", do_tail_disable)
     c.register("POST", "/_telemetry/tail/_clear", do_tail_clear)
+    c.register("GET", "/_telemetry/ingest", do_get_ingest)
+    c.register("POST", "/_telemetry/ingest/_enable", do_ingest_enable)
+    c.register("POST", "/_telemetry/ingest/_disable", do_ingest_disable)
+    c.register("POST", "/_telemetry/ingest/_clear", do_ingest_clear)
 
 
 # -------------------------------------------------------------------- tasks

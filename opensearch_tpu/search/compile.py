@@ -122,6 +122,19 @@ class RotatingMemo:
     def __len__(self) -> int:
         return len(self._new) + len(self._old)
 
+    def keys(self):
+        """Both generations' keys, new first (promoted duplicates
+        deduped) — the churn ledger scans these to count entries a
+        removed segment's (uid, mapper-version) keys invalidate.
+        Returns a LIST built from atomic `list(dict)` copies: the memo
+        is mutated lock-free by concurrent search threads, and a live
+        generator here would raise `dictionary changed size during
+        iteration` out of a merge (the memo tolerates racy reads by
+        design; its iteration must too)."""
+        new = list(self._new)
+        seen = set(new)
+        return new + [k for k in list(self._old) if k not in seen]
+
     def clear(self) -> None:
         self._new = {}
         self._old = {}

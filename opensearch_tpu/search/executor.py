@@ -47,7 +47,8 @@ from opensearch_tpu.ops.device_segment import (
     DeviceSegmentMeta, refresh_live, tree_nbytes, upload_segment)
 from opensearch_tpu.ops.topk import NEG_INF
 from opensearch_tpu.search import dsl
-from opensearch_tpu.search.compile import Compiler, Plan, ShardStats
+from opensearch_tpu.search.compile import (Compiler, Plan, ShardStats,
+                                           struct_fingerprint)
 from opensearch_tpu.search.plan_eval import _eval_plan
 from opensearch_tpu.search.aggs.engine import compile_aggs, eval_aggs
 from opensearch_tpu.search.aggs.parse import parse_aggs
@@ -65,6 +66,23 @@ MISSING_KEY = np.float32(-1e30)
 _LEDGER = TELEMETRY.ledger
 _DEVMEM = TELEMETRY.device_memory
 _FLIGHT = TELEMETRY.flight
+_CHURN = TELEMETRY.churn
+
+
+def _shape_sig(tree, prefix="") -> tuple:
+    """Flattened (path, shape, dtype) signature of a device pytree — the
+    shape-bucket identity that decides XLA executable reuse (plan
+    signatures embed input shapes, so two segments with identical device
+    array shapes share every compiled executable). Power-of-two padding
+    (ops/device_segment.py) makes collisions the COMMON case by design;
+    the churn ledger's recompile/warmup-hit verdict keys on this."""
+    if isinstance(tree, dict):
+        out = []
+        for k in sorted(tree):
+            out.extend(_shape_sig(tree[k], f"{prefix}{k}."))
+        return tuple(out)
+    return ((prefix, tuple(getattr(tree, "shape", ())),
+             str(getattr(tree, "dtype", ""))),)
 
 # live ShardReaders, sampled by the corpus-columns memory gauge: weak
 # refs so a dropped reader (closed index, finished test) leaves the
@@ -101,19 +119,45 @@ class ShardReader:
 
     Reference: the Engine.Searcher / ReaderContext pair pinned by
     search/SearchService.java:585 createContext.
-    """
+
+    Concurrent-publish contract (ISSUE 13, refresh/merge while queries
+    fly): `segments` and `device` are views over ONE atomically-swapped
+    `_published` pair — every mutation builds fresh lists and publishes
+    them in a single attribute store, so a search thread can never see
+    segment i paired with another segment's device arrays. Readers that
+    need the pair must take `snapshot()` ONCE (one attribute read) and
+    zip the result; reading the two properties separately can straddle
+    a publish. Writers (the refreshing/merging thread) serialize on
+    `_publish_lock`; readers stay lock-free."""
 
     def __init__(self, mapper: MapperService, segments: Optional[List[Segment]] = None,
                  index_name: str = "_index"):
         self.mapper = mapper
         self.index_name = index_name
-        self.segments: List[Segment] = []
-        self.device: List[Tuple[Dict, DeviceSegmentMeta]] = []
+        # (segments, device) published as one tuple — see class doc
+        self._published: Tuple[List[Segment],
+                               List[Tuple[Dict, DeviceSegmentMeta]]] = \
+            ([], [])
+        self._publish_lock = threading.Lock()
         self._stats_cache: Optional[ShardStats] = None
         self._seg_bytes: Dict[str, int] = {}    # seg_id → device bytes
         _LIVE_READERS.add(self)
         for seg in (segments or []):
             self.add_segment(seg)
+
+    @property
+    def segments(self) -> List[Segment]:
+        return self._published[0]
+
+    @property
+    def device(self) -> List[Tuple[Dict, DeviceSegmentMeta]]:
+        return self._published[1]
+
+    def snapshot(self) -> Tuple[List[Segment],
+                                List[Tuple[Dict, DeviceSegmentMeta]]]:
+        """One consistent (segments, device) pair — the per-request
+        anchor every query/fetch phase must zip from."""
+        return self._published
 
     @property
     def device_bytes(self) -> int:
@@ -123,56 +167,110 @@ class ShardReader:
 
     def add_segment(self, seg: Segment):
         arrays, meta = upload_segment(seg)
-        self.segments.append(seg)
-        self.device.append((arrays, meta))
-        self._stats_cache = None
         nb = tree_nbytes(arrays)
-        self._seg_bytes[seg.seg_id] = nb
+        with self._publish_lock:
+            segs, dev = self._published
+            self._published = (segs + [seg], dev + [(arrays, meta)])
+            self._seg_bytes[seg.seg_id] = nb
+            self._stats_cache = None
         if _LEDGER.enabled:
             _LEDGER.record("upload.corpus", "h2d", nb)
+        # churn attribution (ISSUE 13): the seen-shape set is fed on
+        # EVERY upload (the verdict is only honest if pre-enable uploads
+        # count); the per-event scope records only while a refresh/merge
+        # holds one bound. The signature is the TRUE executable-reuse
+        # identity: meta.compile_key() (the constants traced programs
+        # close over) + every device array's (path, shape, dtype).
+        known = _CHURN.observe_shape(struct_fingerprint(
+            (meta.compile_key(), _shape_sig(arrays))))
+        cs = _CHURN.current()
+        if cs is not None:
+            cs.note_upload(seg.seg_id, nb, known)
 
     def remove_segment(self, seg_id: str):
-        for i, seg in enumerate(self.segments):
-            if seg.seg_id == seg_id:
-                del self.segments[i]
-                del self.device[i]
-                self._stats_cache = None
-                self._seg_bytes.pop(seg_id, None)
-                return
+        with self._publish_lock:
+            segs, dev = self._published
+            for i, seg in enumerate(segs):
+                if seg.seg_id == seg_id:
+                    self._published = (segs[:i] + segs[i + 1:],
+                                       dev[:i] + dev[i + 1:])
+                    self._seg_bytes.pop(seg_id, None)
+                    self._stats_cache = None
+                    return
 
     def notify_deletes(self, seg: Segment):
-        for i, s in enumerate(self.segments):
-            if s is seg:
-                arrays, meta = self.device[i]
-                self.device[i] = (refresh_live(arrays, seg), meta)
-                if _LEDGER.enabled:
-                    # only the liveness bitmap re-uploads
-                    _LEDGER.record("upload.corpus", "h2d",
-                                   int(arrays["live"].nbytes))
+        live_nbytes = None
+        with self._publish_lock:
+            segs, dev = self._published
+            for i, s in enumerate(segs):
+                if s is seg:
+                    arrays, meta = dev[i]
+                    self._published = (
+                        segs,
+                        dev[:i] + [(refresh_live(arrays, seg), meta)]
+                        + dev[i + 1:])
+                    live_nbytes = int(arrays["live"].nbytes)
+                    break
+        if live_nbytes is not None:
+            if _LEDGER.enabled:
+                # only the liveness bitmap re-uploads
+                _LEDGER.record("upload.corpus", "h2d", live_nbytes)
+            cs = _CHURN.current()
+            if cs is not None:
+                cs.note_live_mask(live_nbytes)
 
     def update_segment(self, seg: Segment):
         """Adopt a possibly-replaced segment object with the same id
         (recovery/segment-replication installs clone_for_copy objects):
         shared immutable columns keep their device image, only the live
         mask re-uploads; a genuinely different segment re-uploads fully."""
-        for i, s in enumerate(self.segments):
+        segs = self._published[0]
+        for i, s in enumerate(segs):
             if s.seg_id != seg.seg_id:
                 continue
             if s is seg or s.post_docs is seg.post_docs:
-                self.segments[i] = seg
-                arrays, meta = self.device[i]
-                self.device[i] = (refresh_live(arrays, seg), meta)
-                if _LEDGER.enabled:
-                    _LEDGER.record("upload.corpus", "h2d",
-                                   int(arrays["live"].nbytes))
+                live_nbytes = None
+                with self._publish_lock:
+                    segs, dev = self._published
+                    for j, sj in enumerate(segs):
+                        if sj.seg_id == seg.seg_id:
+                            arrays, meta = dev[j]
+                            self._published = (
+                                segs[:j] + [seg] + segs[j + 1:],
+                                dev[:j]
+                                + [(refresh_live(arrays, seg), meta)]
+                                + dev[j + 1:])
+                            live_nbytes = int(arrays["live"].nbytes)
+                            self._stats_cache = None
+                            break
+                if live_nbytes is not None:
+                    if _LEDGER.enabled:
+                        _LEDGER.record("upload.corpus", "h2d",
+                                       live_nbytes)
+                    cs = _CHURN.current()
+                    if cs is not None:
+                        cs.note_live_mask(live_nbytes)
             else:
-                self.segments[i] = seg
-                self.device[i] = upload_segment(seg)
-                nb = tree_nbytes(self.device[i][0])
-                self._seg_bytes[seg.seg_id] = nb
+                uploaded = upload_segment(seg)
+                nb = tree_nbytes(uploaded[0])
+                with self._publish_lock:
+                    segs, dev = self._published
+                    for j, sj in enumerate(segs):
+                        if sj.seg_id == seg.seg_id:
+                            self._published = (
+                                segs[:j] + [seg] + segs[j + 1:],
+                                dev[:j] + [uploaded] + dev[j + 1:])
+                            self._seg_bytes[seg.seg_id] = nb
+                            self._stats_cache = None
+                            break
                 if _LEDGER.enabled:
                     _LEDGER.record("upload.corpus", "h2d", nb)
-            self._stats_cache = None
+                known = _CHURN.observe_shape(struct_fingerprint(
+                    (uploaded[1].compile_key(),
+                     _shape_sig(uploaded[0]))))
+                cs = _CHURN.current()
+                if cs is not None:
+                    cs.note_upload(seg.seg_id, nb, known)
             return
         self.add_segment(seg)
 
@@ -184,10 +282,25 @@ class ShardReader:
         # cached while the segment list is stable: ShardStats carries the
         # per-term idf memo, so reuse across requests is the win (deletes
         # don't move doc_freq until merge, same as Lucene)
-        if self._stats_cache is None or \
-                self._stats_cache.segments != self.segments:
-            self._stats_cache = ShardStats(self.segments)
-        return self._stats_cache
+        return self.stats_snapshot()[0]
+
+    def stats_snapshot(self) -> Tuple[ShardStats, List[Segment],
+                                      List[Tuple[Dict,
+                                                 DeviceSegmentMeta]]]:
+        """The per-request anchor under concurrent publish: a
+        (ShardStats, segments, device) triple that is mutually
+        consistent — the stats (and its interned-plan memo) were built
+        for exactly the returned segment list, and the device list is
+        its pair. Retries if a refresh publishes mid-build (rare; the
+        loop converges as soon as one read sees a stable pair)."""
+        while True:
+            pub = self._published
+            stats = self._stats_cache
+            if stats is None or stats.segments != pub[0]:
+                stats = ShardStats(pub[0])
+                self._stats_cache = stats
+            if self._published is pub:
+                return stats, pub[0], pub[1]
 
 
 class PinnedReader:
@@ -199,8 +312,11 @@ class PinnedReader:
     def __init__(self, reader: ShardReader):
         self.mapper = reader.mapper
         self.index_name = reader.index_name
-        self.segments = list(reader.segments)
-        self.device = list(reader.device)
+        # one snapshot() read: a consistent pair even while a
+        # concurrent refresh publishes
+        segments, device = reader.snapshot()
+        self.segments = list(segments)
+        self.device = list(device)
         self._stats = ShardStats(self.segments)
 
     @property
@@ -209,6 +325,13 @@ class PinnedReader:
 
     def stats(self) -> ShardStats:
         return self._stats
+
+    def snapshot(self):
+        """A pinned reader IS a snapshot: the pair never changes."""
+        return self.segments, self.device
+
+    def stats_snapshot(self):
+        return self._stats, self.segments, self.device
 
 
 # ------------------------------------------------------------------ execution
@@ -1144,7 +1267,8 @@ def _agg_envelope_runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta,
     """Jitted group program for agg-bearing batches + the host layout of
     each row's agg tail. Always the dense kernel: eval_aggs consumes the
     dense eligible mask the candidate-buffer kernel never materializes."""
-    key = ("aggenv", plan_sig, agg_sig, meta, k, layout, treedef, axes)
+    key = ("aggenv", plan_sig, agg_sig, meta.compile_key(), k, layout,
+           treedef, axes)
     hit = _JIT_CACHE.get(key)
     if hit is None:
         out_layout, width = _agg_out_layout(
@@ -1182,7 +1306,11 @@ def _envelope_runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int,
     """Jitted group program over a packed input envelope: the candidate-
     buffer kernel for plain text clauses within the lane budget, the dense
     kernel otherwise."""
-    key = ("env", plan_sig, meta, k, layout, treedef)
+    # meta.compile_key() (seg_id excluded): a refreshed segment whose
+    # shapes land in an already-compiled bucket REUSES the executable
+    # instead of paying a per-segment XLA recompile — the churn
+    # ledger's warmup_hit verdict is true by construction (ISSUE 13)
+    key = ("env", plan_sig, meta.compile_key(), k, layout, treedef)
     fn = _JIT_CACHE.get(key)
     if fn is None:
         qb128 = None
@@ -1206,7 +1334,8 @@ def _envelope_runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int,
 
 def _runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int, sort_mode: str,
             agg_plans=()):
-    key = (plan_sig, meta, k, sort_mode, tuple(a.sig() for a in agg_plans))
+    key = (plan_sig, meta.compile_key(), k, sort_mode,
+           tuple(a.sig() for a in agg_plans))
     fn = _JIT_CACHE.get(key)
     if fn is not None:
         return fn
@@ -1286,8 +1415,8 @@ def build_batched_hybrid_query_phase(plans, meta: DeviceSegmentMeta,
 
 def _batched_hybrid_runner(plans, meta: DeviceSegmentMeta, k: int,
                            layout, treedef):
-    key = ("hybenv", tuple(p.sig() for p in plans), meta, k, layout,
-           treedef)
+    key = ("hybenv", tuple(p.sig() for p in plans), meta.compile_key(),
+           k, layout, treedef)
     fn = _JIT_CACHE.get(key)
     if fn is None:
         fn = jax.jit(build_batched_hybrid_query_phase(plans, meta, k,
@@ -1567,8 +1696,14 @@ class SearchExecutor:
 
         # DFS query-then-fetch: score with the coordinator-merged global
         # statistics instead of shard-local ones (StaticStats)
-        stats = stats_override if stats_override is not None \
-            else self.reader.stats()
+        if stats_override is not None:
+            stats = stats_override
+            segments, device = self.reader.snapshot()
+        else:
+            # one consistent (stats, segments, device) anchor: a
+            # concurrent refresh publishing mid-request must not let
+            # this request pair segment i with another segment's arrays
+            stats, segments, device = self.reader.stats_snapshot()
         compiler = Compiler(self.reader.mapper, stats)
         agg_nodes = parse_aggs(body.get("aggs") or body.get("aggregations"))
         from opensearch_tpu.search.aggs.parse import PIPELINE_TYPES
@@ -1595,7 +1730,7 @@ class SearchExecutor:
         launched = []
         from opensearch_tpu.indices.query_cache import FilterCacheContext
         for seg_i, (seg, (arrays, meta)) in enumerate(
-                zip(self.reader.segments, self.reader.device)):
+                zip(segments, device)):
             if seg.num_docs == 0:
                 continue
             if rec:
@@ -1720,7 +1855,7 @@ class SearchExecutor:
                 sub = dsl.BoolQuery(must=[sub],
                                     filter=[dsl.parse_query(extra_filter)])
             sub_nodes.append(sub)
-        stats = self.reader.stats()
+        stats, segments, device = self.reader.stats_snapshot()
         compiler = Compiler(self.reader.mapper, stats)
         # per-sub-query candidate window = from+size, the reference's
         # per-shard TopDocs size for hybrid sub-queries (no tie overfetch:
@@ -1736,7 +1871,7 @@ class SearchExecutor:
         struct_parts: List[Any] = []
         shape_parts: List[Any] = []
         for seg_i, (seg, (arrays, meta)) in enumerate(
-                zip(self.reader.segments, self.reader.device)):
+                zip(segments, device)):
             if seg.num_docs == 0:
                 struct_parts.append(None)
                 shape_parts.append(None)
@@ -1804,10 +1939,13 @@ class SearchExecutor:
         return result
 
     def _hit_dict(self, seg_i: int, ord_: int, score: Optional[float],
-                  body: dict) -> dict:
+                  body: dict, segments=None) -> dict:
         """One search hit (fetch phase for a single doc) — shared by search()
-        and multi_search()."""
-        seg = self.reader.segments[seg_i]
+        and multi_search(). `segments` is the query phase's snapshot
+        list: under a concurrent refresh, `seg_i` must resolve against
+        the list the candidates were produced over, not today's."""
+        seg = (segments if segments is not None
+               else self.reader.segments)[seg_i]
         hit = {"_index": self.reader.index_name,
                "_id": seg.doc_ids[ord_],
                "_score": score}
@@ -2337,7 +2475,8 @@ class SearchExecutor:
         where _run_search executes per query with the resolved
         processor chain)."""
         from opensearch_tpu.searchpipeline import hybrid as hyb
-        stats = self.reader.stats()
+        # one consistent anchor for the hybrid wave (see _msearch_prepare)
+        stats, segments, device = self.reader.stats_snapshot()
         compiler = Compiler(self.reader.mapper, stats)
         prepared: Dict[int, tuple] = {}
         groups: Dict[Any, List[int]] = {}
@@ -2351,8 +2490,7 @@ class SearchExecutor:
                 k_fetch = min(k, 1 << 16)  # same window as the 1-query path
                 plans_per_seg: List[Optional[list]] = []
                 flats_per_seg: List[Optional[list]] = []
-                for seg, (arrays, meta) in zip(self.reader.segments,
-                                               self.reader.device):
+                for seg, (arrays, meta) in zip(segments, device):
                     if seg.num_docs == 0:
                         plans_per_seg.append(None)
                         flats_per_seg.append(None)
@@ -2404,7 +2542,7 @@ class SearchExecutor:
                 [prepared[i][2] for i in idxs] + [np.inf] * pad_rows,
                 dtype=np.float32)
             for seg_i, (seg, (arrays, meta)) in enumerate(
-                    zip(self.reader.segments, self.reader.device)):
+                    zip(segments, device)):
                 if seg.num_docs == 0:
                     continue
                 group_flats = [prepared[i][4][seg_i] for i in idxs]
@@ -2505,7 +2643,8 @@ class SearchExecutor:
 
     def _compile_msearch_bundle(self, compiler: Compiler, stats, tpl,
                                 node, body: dict, agg_spec,
-                                agg_json: Optional[str] = None) -> tuple:
+                                agg_json: Optional[str] = None,
+                                snapshot=None) -> tuple:
         """Compile ONE sub-request's per-segment plans + flattened inputs
         + grouping signatures. When `tpl` (a dsl.QueryTemplate) is given,
         plans bind through the (template, segment) skeleton cache
@@ -2525,8 +2664,9 @@ class SearchExecutor:
             agg_json = json.dumps(agg_spec, sort_keys=True, default=str)
         plans: List[Optional[Plan]] = []
         agg_plans_per_seg: List[list] = []
-        for seg, (arrays, meta) in zip(self.reader.segments,
-                                       self.reader.device):
+        segments, device = (snapshot if snapshot is not None
+                            else self.reader.snapshot())
+        for seg, (arrays, meta) in zip(segments, device):
             if seg.num_docs == 0:
                 plans.append(None)
                 agg_plans_per_seg.append([])
@@ -2600,7 +2740,10 @@ class SearchExecutor:
         flats_by_i: Dict[int, List[Optional[list]]] = {}
         agg_by_i: Dict[int, List[list]] = {}      # i -> per-seg AggPlans
         agg_nodes_by_i: Dict[int, list] = {}      # i -> parsed AggNodes
-        stats = self.reader.stats()
+        # one consistent anchor for the whole wave (prepare -> dispatch
+        # -> finish): a concurrent refresh publishing mid-wave must not
+        # re-pair seg_i between the compiled flats and the device arrays
+        stats, segments, device = self.reader.stats_snapshot()
         compiler = Compiler(self.reader.mapper, stats)
         mapper_version = getattr(self.reader.mapper, "version", 0)
 
@@ -2636,7 +2779,7 @@ class SearchExecutor:
                     bundle = self._compile_msearch_bundle(
                         compiler, stats, tpl,
                         None if tpl is not None else node, body, agg_spec,
-                        agg_json)
+                        agg_json, snapshot=(segments, device))
                 except Exception:  # except-ok: per-item isolation -- compile failure falls back to the general path per item
                     _general_fallback(i, body)
                     continue
@@ -2717,7 +2860,7 @@ class SearchExecutor:
                 [entry_by_i[i][5] for i in idxs]
                 + [np.inf] * pad_rows, dtype=np.float32)
             for seg_i, (seg, (arrays, meta)) in enumerate(
-                    zip(self.reader.segments, self.reader.device)):
+                    zip(segments, device)):
                 if seg.num_docs == 0:
                     continue
                 group_flats = [flats_by_i[i][seg_i] for i in idxs]
@@ -2791,7 +2934,10 @@ class SearchExecutor:
                 "pending": pending, "agg_by_i": agg_by_i,
                 "agg_nodes_by_i": agg_nodes_by_i, "dead": dead,
                 "staging": staging,
-                "wave_buffer_bytes": wave_buffer_bytes}
+                "wave_buffer_bytes": wave_buffer_bytes,
+                # the wave's (segments, device) anchor: finish resolves
+                # seg_i hits against THIS list, never a later publish
+                "segments": segments}
 
     def _msearch_finish(self, state, responses, start, ph, scope=None):
         """Wave half 2: ONE device_get for the wave's outputs (concatenated
@@ -2897,7 +3043,9 @@ class SearchExecutor:
                         decode_outputs(agg_by_i[i][seg_i], outs))
 
         took_ms = int((time.monotonic() - start) * 1000)
-        segments = self.reader.segments
+        segments = state.get("segments")
+        if segments is None:
+            segments = self.reader.segments
         index_name = self.reader.index_name
         resp_cache_keys = state.get("resp_cache_keys", {})
         for i, seg_results in per_query_segs.items():
@@ -2969,7 +3117,7 @@ class SearchExecutor:
                 # filtered _source: the general per-hit fetch path
                 segs_for_page = page_segs if page_segs is not None \
                     else [one_seg_i] * len(page_ords)
-                hits = [self._hit_dict(g, o, s, body)
+                hits = [self._hit_dict(g, o, s, body, segments=segments)
                         for g, o, s in zip(segs_for_page, page_ords,
                                            page_scores)]
             responses[i] = _base_response(took_ms, per_query_total[i],

@@ -215,6 +215,19 @@ class WarmupRegistry:
             return [dict(e) for e in self._entries.values()
                     if index_name is None or e.get("index") == index_name]
 
+    def registered_count(self, index_name: Optional[str] = None) -> int:
+        """Registered (plan-struct, shape-bucket) entries for an index
+        without copying bodies — the churn ledger (ISSUE 13) stamps this
+        on every refresh/merge record: a `recompile` verdict with
+        registered entries means a replay could pre-compile the new
+        shape bucket off the serving path; zero means the first query
+        pays the cliff with no warmup to ride."""
+        with self._lock:
+            if index_name is None:
+                return len(self._entries)
+            return sum(1 for e in self._entries.values()
+                       if e.get("index") == index_name)
+
     def warm_executor(self, executor, index_name: Optional[str] = None,
                       budget_s: Optional[float] = None) -> dict:
         """Replay registered entries through one shard executor. Returns

@@ -41,8 +41,11 @@ import os
 from typing import Optional
 
 from opensearch_tpu.telemetry.ledger import (
-    DeviceMemoryAccounting, LedgerScope, TransferLedger)
-from opensearch_tpu.telemetry.lifecycle import FlightRecorder, Timeline
+    ChurnLedger, ChurnScope, DeviceMemoryAccounting, LedgerScope,
+    TransferLedger)
+from opensearch_tpu.telemetry.lifecycle import (
+    INGEST_EVENTS, FlightRecorder, IngestEventLog, IngestRecorder,
+    Timeline)
 from opensearch_tpu.telemetry.metrics import MetricsRegistry
 from opensearch_tpu.telemetry.rolling import RollingEstimator
 from opensearch_tpu.telemetry.tracer import (
@@ -51,7 +54,9 @@ from opensearch_tpu.telemetry.tracer import (
 __all__ = ["TELEMETRY", "TelemetryService", "Span", "NOOP_SPAN",
            "MetricsRegistry", "Tracer", "TransferLedger", "LedgerScope",
            "DeviceMemoryAccounting", "RollingEstimator",
-           "FlightRecorder", "Timeline"]
+           "FlightRecorder", "Timeline", "IngestRecorder",
+           "IngestEventLog", "INGEST_EVENTS", "ChurnLedger",
+           "ChurnScope"]
 
 
 class TelemetryService:
@@ -64,12 +69,19 @@ class TelemetryService:
         self.ledger = TransferLedger()
         self.device_memory = DeviceMemoryAccounting()
         self.flight = FlightRecorder()
+        # write-path observability (ISSUE 13): ingest lifecycle recorder
+        # + segment-churn ledger, both OFF by default behind
+        # None-returning gates; the always-on engine event log rides the
+        # lifecycle module singleton (INGEST_EVENTS)
+        self.ingest = IngestRecorder()
+        self.churn = ChurnLedger()
 
     def configure(self, data_path: Optional[str] = None,
                   enabled: bool = False, jsonl: bool = False,
                   ring_size: int = DEFAULT_RING_SIZE,
                   transfers: bool = False, tail: bool = False,
-                  tail_threshold_ms: Optional[float] = None) -> None:
+                  tail_threshold_ms: Optional[float] = None,
+                  ingest: bool = False, churn: bool = False) -> None:
         """Bind to a node's settings/data dir. Called from Node.__init__;
         re-configuration by a later Node in the same process wins (the
         singleton is process-wide, like WARMUP)."""
@@ -77,6 +89,8 @@ class TelemetryService:
         self.ledger.enabled = bool(transfers)
         self.flight.enabled = bool(tail)
         self.flight.threshold_ms = tail_threshold_ms
+        self.ingest.enabled = bool(ingest)
+        self.churn.enabled = bool(churn)
         self.tracer.resize(ring_size)
         self.tracer.jsonl_path = None
         self.flight.jsonl_path = None
@@ -102,7 +116,11 @@ class TelemetryService:
                 "metrics": self.metrics.to_dict(),
                 "transfers": self.ledger.snapshot(),
                 "device_memory": self.device_memory.stats(),
-                "tail": self.flight.stats()}
+                "tail": self.flight.stats(),
+                # the write-path block (ISSUE 13): ingest lifecycle +
+                # engine event log + segment-churn attribution
+                "indexing": {"ingest": self.ingest.stats(),
+                             "churn": self.churn.snapshot()}}
 
 
 # process-wide singleton, like REQUEST_CACHE / QUERY_CACHE / WARMUP

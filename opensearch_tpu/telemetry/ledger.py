@@ -334,6 +334,199 @@ class TransferLedger:
         self.wave_overlap_ms.reset()
 
 
+class ChurnScope:
+    """Per-event (one refresh / one merge) accumulator for the device-
+    side consequence of a write-path event: which segment images shipped
+    (`upload.corpus` bytes), whether each new segment's device shape
+    bucket had been seen before (executable reuse) or is novel (the next
+    query over it pays an XLA compile), and live-mask-only re-uploads.
+    Filled by ShardReader while bound ambient on the refreshing thread
+    (write events run start-to-finish on one thread)."""
+
+    __slots__ = ("uploads", "upload_bytes", "live_mask_bytes")
+
+    def __init__(self):
+        # (seg_id, nbytes, shape_known)
+        self.uploads: List[Tuple[str, int, bool]] = []
+        self.upload_bytes = 0
+        self.live_mask_bytes = 0
+
+    def note_upload(self, seg_id: str, nbytes: int,
+                    shape_known: bool) -> None:
+        self.uploads.append((seg_id, int(nbytes), bool(shape_known)))
+        self.upload_bytes += int(nbytes)
+
+    def note_live_mask(self, nbytes: int) -> None:
+        self.live_mask_bytes += int(nbytes)
+
+
+# cap on the seen-shape-bucket set: device shapes are power-of-two
+# bucketed (ops/device_segment.py), so a real node sees tens of
+# buckets; the cap bounds pathological shape churn (randomized tests)
+_MAX_SEEN_SHAPES = 4096
+
+
+class ChurnLedger:
+    """Segment-churn ledger (ISSUE 13): one `churn` record per
+    refresh/merge event, attributing the *device-side* marginal cost of
+    the write path — the measurement ROADMAP item 5's incremental
+    segment publish will be judged against.
+
+    Per record: the `upload.corpus` bytes the event re-shipped, a
+    recompile/warmup-hit verdict per new segment (did its device shape
+    bucket land in an already-compiled (plan-struct, shape-bucket)
+    family, or will the first query over it pay a fresh XLA compile),
+    and how many interned RotatingMemo entries the event invalidated —
+    both the wholesale ShardStats-memo drop a segment-list change
+    causes (every skeleton + bundle recompiles on the host) and the
+    subset keyed to the removed (segment-uid, mapper-version) pairs.
+
+    No-op discipline (tracer/ledger/faults contract, gate-lint row,
+    asserted by bench.py): OFF by default, `scope()` returns None when
+    disabled. `observe_shape` alone is live regardless (the
+    inflight-wave-gauge contract): it is one lock + set-add per SEGMENT
+    UPLOAD, never per query, and the verdict is only honest if the
+    seen-set covers uploads from before the ledger was enabled."""
+
+    def __init__(self, ring_size: int = 128):
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._ring: List[dict] = []
+        self._ring_size = ring_size
+        self._seq = 0
+        self._shapes_seen: set = set()
+        self._tls = threading.local()
+        self.totals = {"events": 0, "refresh": 0, "merge": 0,
+                       "recompile_segments": 0, "warm_hit_segments": 0,
+                       "upload_bytes": 0, "live_mask_bytes": 0,
+                       "memo_entries_dropped": 0,
+                       "memo_entries_keyed": 0}
+
+    # ------------------------------------------------------------- hot path
+
+    def scope(self) -> Optional[ChurnScope]:
+        """The per-event accounting gate: a ChurnScope when the ledger
+        is enabled, else None — IndexShard guards its whole attribution
+        block with `if scope is not None`, so the disabled refresh path
+        costs one attribute load and a branch."""
+        if not self.enabled:
+            return None
+        return ChurnScope()
+
+    def current(self) -> Optional[ChurnScope]:
+        """The thread's bound churn scope (ShardReader's read). Tests
+        the flag first: the disabled segment-upload path never touches
+        thread-local state."""
+        if not self.enabled:
+            return None
+        return getattr(self._tls, "scope", None)
+
+    @contextmanager
+    def bound(self, scope: Optional[ChurnScope]):
+        prev = getattr(self._tls, "scope", None)
+        self._tls.scope = scope
+        try:
+            yield scope
+        finally:
+            self._tls.scope = prev
+
+    def observe_shape(self, shape_sig: str) -> bool:
+        """Record a segment's device shape-bucket signature; returns
+        whether it was already known. Known = some segment with
+        byte-identical device array shapes was uploaded before, i.e.
+        every executable compiled against that shape family is reusable
+        for the new segment (XLA caches per plan signature, and plan
+        signatures embed input shapes). Live regardless of `enabled`."""
+        with self._lock:
+            known = shape_sig in self._shapes_seen
+            if not known:
+                if len(self._shapes_seen) >= _MAX_SEEN_SHAPES:
+                    self._shapes_seen.clear()
+                self._shapes_seen.add(shape_sig)
+        return known
+
+    def publish(self, scope: ChurnScope, kind: str,
+                segments_before: int, segments_after: int,
+                docs: int, wall_ms: float,
+                memo_entries_dropped: int = 0,
+                memo_entries_keyed: int = 0,
+                removed_seg_ids: Optional[List[str]] = None,
+                event_id: Optional[int] = None,
+                shard: Optional[str] = None,
+                warmup_registered: Optional[int] = None) -> dict:
+        """Close one refresh/merge event's attribution into a churn
+        record. The verdict is per NEW segment: `recompile` when its
+        shape bucket was unseen at upload time, `warmup_hit` when an
+        already-compiled shape family absorbs it."""
+        recompiles = sum(1 for _, _, known in scope.uploads if not known)
+        warm_hits = sum(1 for _, _, known in scope.uploads if known)
+        rec = {
+            "kind": kind,
+            "shard": shard,
+            "segments": {"before": int(segments_before),
+                         "after": int(segments_after)},
+            "docs": int(docs),
+            "wall_ms": round(wall_ms, 3),
+            "uploads": [{"seg_id": sid, "bytes": nb,
+                         "verdict": "warmup_hit" if known
+                         else "recompile"}
+                        for sid, nb, known in scope.uploads],
+            "upload_bytes": scope.upload_bytes,
+            "live_mask_bytes": scope.live_mask_bytes,
+            "verdict": ("warmup_hit" if scope.uploads and recompiles == 0
+                        else ("recompile" if recompiles else "none")),
+            "memo_entries_dropped": int(memo_entries_dropped),
+            "memo_entries_keyed": int(memo_entries_keyed),
+        }
+        if removed_seg_ids:
+            rec["removed_segments"] = list(removed_seg_ids)
+        if event_id is not None:
+            rec["event_id"] = event_id
+        if warmup_registered is not None:
+            rec["warmup_registered"] = int(warmup_registered)
+        with self._lock:
+            self._seq += 1
+            rec["churn_id"] = self._seq
+            self._ring.append(rec)
+            if len(self._ring) > self._ring_size:
+                del self._ring[:len(self._ring) - self._ring_size]
+            t = self.totals
+            t["events"] += 1
+            t[kind] = t.get(kind, 0) + 1
+            t["recompile_segments"] += recompiles
+            t["warm_hit_segments"] += warm_hits
+            t["upload_bytes"] += scope.upload_bytes
+            t["live_mask_bytes"] += scope.live_mask_bytes
+            t["memo_entries_dropped"] += int(memo_entries_dropped)
+            t["memo_entries_keyed"] += int(memo_entries_keyed)
+        return rec
+
+    # --------------------------------------------------------------- reading
+
+    def records(self, size: Optional[int] = None) -> List[dict]:
+        """Most-recent-first churn records."""
+        with self._lock:
+            out = list(self._ring)
+        out.reverse()
+        return out[:size] if size is not None else out
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"enabled": self.enabled,
+                    "totals": dict(self.totals),
+                    "shapes_seen": len(self._shapes_seen),
+                    "retained": len(self._ring)}
+
+    def reset(self) -> None:
+        """Clear records + totals; the seen-shape set SURVIVES (clearing
+        it would turn every post-reset upload into a false `recompile`
+        verdict — shapes compiled before the reset stay compiled)."""
+        with self._lock:
+            self._ring = []
+            self._seq = 0
+            self.totals = {k: 0 for k in self.totals}
+
+
 class DeviceMemoryAccounting:
     """Live-bytes gauges per device-memory class.
 

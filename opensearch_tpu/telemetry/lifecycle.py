@@ -1,4 +1,5 @@
-"""Request lifecycle timeline + tail-latency flight recorder.
+"""Request lifecycle timeline + tail-latency flight recorder + the
+write-path ingest lifecycle (ISSUE 13).
 
 ROADMAP item 2 (cross-request dynamic batching) needs to be judged
 against numbers, and the numbers that matter under contention are
@@ -27,6 +28,27 @@ neither records the request's *schedule*. This module is that contract:
   don't all self-trigger). Served by `GET /_telemetry/tail`, togglable
   via `POST /_telemetry/tail/_enable|_disable|_clear`, optional JSONL
   export under `_state/tail.jsonl`, rendered by tools/tail_report.py.
+  Every capture carries an `ingest_events` annotation: the engine
+  refresh/merge/flush events whose wall overlapped the captured
+  request's window (empty list when the write path was quiet) — the
+  "did a merge cause this p99" join tools/tail_report.py renders.
+
+- `IngestEventLog` — the engine's write-path event log: one bounded
+  record per refresh/merge/flush (seg ids, docs, seal wall, live-doc
+  ratio) on the monotonic clock, fed by index/engine.py. Live
+  regardless of any gate (the inflight-wave-gauge contract: one lock +
+  append per REFRESH, never per op) so a tail capture can always be
+  joined against the write path that ran under it.
+
+- `IngestRecorder` — the write path's FlightRecorder analog (ISSUE 13):
+  per-op and per-bulk ingest timelines (arrive/admit/parse/
+  version_plan/translog_append/refresh_wait/respond) recorded into a
+  bounded ring with rolling took percentiles, OFF by default behind the
+  same None-returning `timeline()` gate (gate-lint registry row,
+  asserted pristine by bench.py). The engine reads the thread-bound
+  timeline via `current()` — write ops run start-to-finish on one
+  thread, so ambient context is safe here (unlike the msearch
+  envelope). Served by `GET /_telemetry/ingest`.
 
 No-op discipline (the tracer/ledger/faults contract, statically enforced
 by gate-lint's subsystem registry and asserted by bench.py): the
@@ -43,6 +65,7 @@ import json
 import threading
 import time
 from collections import deque
+from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Tuple
 
 from opensearch_tpu.telemetry.rolling import RollingEstimator
@@ -70,7 +93,7 @@ class Timeline:
     admission work will be judged by."""
 
     __slots__ = ("t_arrive", "t_ready", "events", "phases",
-                 "queue_wait_ms", "took_ms", "status")
+                 "queue_wait_ms", "took_ms", "status", "detail")
 
     def __init__(self):
         self.t_arrive = time.monotonic()
@@ -82,6 +105,11 @@ class Timeline:
         self.queue_wait_ms = 0.0
         self.took_ms: Optional[float] = None
         self.status = "ok"
+        # detail=True: producers may append per-step events in addition
+        # to phase accumulation (set for single-op ingest timelines; a
+        # 1000-op bulk accumulates phases only, or its event list would
+        # balloon to 3N tuples)
+        self.detail = False
 
     def event(self, name: str, **fields) -> None:
         self.events.append(
@@ -121,6 +149,14 @@ class Timeline:
         self.t_ready = time.monotonic()
         self.event("ready")
 
+    def phase_add(self, name: str, ms: float) -> None:
+        """Accumulate one phase's milliseconds; when `detail` is set,
+        also append a discrete event (the per-op ingest timeline shape —
+        arrive/parse/version_plan/translog_append read as a sequence)."""
+        self.phases[name] = self.phases.get(name, 0.0) + ms
+        if self.detail:
+            self.event(name, ms=round(ms, 3))
+
     def merge_phases(self, phase_ms: Dict[str, float]) -> None:
         """Accumulate per-phase milliseconds (controller phase dict or
         msearch ph map); non-duration fields riding the same dict
@@ -143,6 +179,87 @@ class Timeline:
             out["phases"] = {name: round(ms, 3)
                              for name, ms in self.phases.items()}
         return out
+
+
+class IngestEventLog:
+    """Bounded node-wide log of engine write-path events (refresh /
+    merge / flush), fed by index/engine.py on the monotonic clock.
+
+    Live regardless of any enable flag — the inflight-wave-gauge
+    contract, not the per-request gate discipline: the cost is one lock
+    acquire + deque append per REFRESH (never per op), and a
+    `_nodes/stats` poll or a tail capture must be able to join against
+    the write path that actually ran, whether or not anyone thought to
+    enable ingest telemetry first."""
+
+    def __init__(self, ring_size: int = 256):
+        self._lock = threading.Lock()
+        self._ring: "deque[dict]" = deque(maxlen=ring_size)
+        self._seq = 0
+        self.counts: Dict[str, int] = {}
+
+    def note(self, kind: str, t0_mono: float, t1_mono: float,
+             **fields) -> dict:
+        """Record one engine event; returns the stored record (the
+        engine's refresh/merge paths hand it to the churn ledger so a
+        churn record and its event share an `event_id`)."""
+        ev = {"kind": kind,
+              "t0_mono": round(t0_mono, 6),
+              "t1_mono": round(t1_mono, 6),
+              "wall_ms": round((t1_mono - t0_mono) * 1000, 3),
+              **fields}
+        with self._lock:
+            self._seq += 1
+            ev["event_id"] = self._seq
+            self._ring.append(ev)
+            self.counts[kind] = self.counts.get(kind, 0) + 1
+        return ev
+
+    def overlapping(self, t0_mono: float, t1_mono: float) -> List[dict]:
+        """Events whose wall intersects [t0, t1] on the monotonic clock
+        — the `ingest_events` annotation a flight capture carries. Event
+        times are rebased to ms offsets from t0 so the annotation reads
+        on the capture's own clock."""
+        with self._lock:
+            evs = list(self._ring)
+        out = []
+        for ev in evs:
+            if ev["t0_mono"] <= t1_mono and ev["t1_mono"] >= t0_mono:
+                rec = {k: v for k, v in ev.items()
+                       if k not in ("t0_mono", "t1_mono")}
+                rec["t_rel_ms"] = round(
+                    (ev["t0_mono"] - t0_mono) * 1000, 3)
+                out.append(rec)
+        return out
+
+    def recent(self, size: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            out = [{k: v for k, v in ev.items()
+                    if k not in ("t0_mono", "t1_mono")}
+                   for ev in self._ring]
+        out.reverse()
+        return out[:size] if size is not None else out
+
+    def events_by_id(self) -> Dict[int, dict]:
+        """{event_id: record} over the retained ring (consistency checks
+        in tests join capture annotations against this)."""
+        with self._lock:
+            return {ev["event_id"]: dict(ev) for ev in self._ring}
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"events": self._seq, "retained": len(self._ring),
+                    "by_kind": dict(self.counts)}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.counts = {}
+
+
+# node-wide write-path event log: engine feeds it, flight captures and
+# GET /_telemetry/ingest read it
+INGEST_EVENTS = IngestEventLog()
 
 
 class FlightRecorder:
@@ -232,12 +349,20 @@ class FlightRecorder:
         if span is not None and getattr(span, "recording", False):
             span.set_attribute("lifecycle", tl.to_dict())
         rec = None
+        if trigger is not None:
+            # the write-path join (ISSUE 13): every capture carries the
+            # engine refresh/merge/flush events whose wall overlapped
+            # this request's window — "did a merge cause this p99" is
+            # answerable from the capture alone (empty list = the write
+            # path was quiet). Built outside the ring lock.
+            ingest_events = INGEST_EVENTS.overlapping(tl.t_arrive, t_done)
         with self._lock:
             self.completed += 1
             self.events_total += len(tl.events)
             if trigger is not None:
                 rec = {"ts_ms": int(time.time() * 1000),
-                       "trigger": trigger, **tl.to_dict()}
+                       "trigger": trigger, **tl.to_dict(),
+                       "ingest_events": ingest_events}
                 self._ring.append(rec)
                 self.captures[trigger] += 1
         if rec is not None and self.jsonl_path is not None:
@@ -289,3 +414,123 @@ class FlightRecorder:
                 "jsonl_path": self.jsonl_path,
                 "export_errors": self.export_errors,
                 "took_rolling": self.took.summary()}
+
+
+DEFAULT_INGEST_RING = 64
+
+
+class IngestRecorder:
+    """Write-path lifecycle recorder: per-op and per-bulk ingest
+    timelines (ISSUE 13), the FlightRecorder's ingest analog.
+
+    No-op discipline (the tracer/ledger/faults contract, gate-lint
+    registry row, asserted by bench.py): OFF by default, the per-request
+    gate is `timeline()` returning None, and the engine-side ambient
+    read `current()` tests the flag BEFORE touching thread-local state —
+    the disabled write path costs one attribute load and a branch per
+    op. Binding is thread-local (`bound()`): a write op runs
+    start-to-finish on one thread, so ambient context is safe here,
+    unlike the msearch envelope's B-requests-one-thread fan-in.
+
+    Completed timelines land in a bounded ring (most recent first via
+    `captured()`) with rolling took percentiles split per kind (op vs
+    bulk) — there is no SLO trigger: ingest tails are joined against
+    search tails through INGEST_EVENTS, not captured independently."""
+
+    def __init__(self, ring_size: int = DEFAULT_INGEST_RING):
+        self.enabled = False
+        self._ring: "deque[dict]" = deque(maxlen=ring_size)
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self.took_op = RollingEstimator()
+        self.took_bulk = RollingEstimator()
+        self.completed = {"op": 0, "bulk": 0}
+        self.ops_total = 0
+        self.errors = 0
+
+    # ------------------------------------------------------------- hot path
+
+    def timeline(self, detail: bool = True) -> Optional[Timeline]:
+        """The per-request gate: a Timeline when the recorder is on,
+        else None. `detail` marks single-op timelines (discrete
+        parse/version_plan/translog_append events next to the phase
+        sums); bulk timelines pass detail=False and accumulate phases
+        only."""
+        if not self.enabled:
+            return None
+        tl = Timeline()
+        tl.detail = detail
+        return tl
+
+    def current(self) -> Optional[Timeline]:
+        """The thread's bound ingest timeline — the engine's read. Tests
+        the flag first so the disabled path never touches the TLS."""
+        if not self.enabled:
+            return None
+        return getattr(self._tls, "timeline", None)
+
+    def bind(self, tl: Optional[Timeline]) -> Optional[Timeline]:
+        prev = getattr(self._tls, "timeline", None)
+        self._tls.timeline = tl
+        return prev
+
+    def unbind(self, prev: Optional[Timeline]) -> None:
+        self._tls.timeline = prev
+
+    @contextmanager
+    def bound(self, tl: Optional[Timeline]):
+        """Bind a request's ingest timeline for the duration of the
+        engine call chain. A None timeline still binds (clears any stale
+        outer binding) — cheap, and only reached when enabled."""
+        prev = self.bind(tl)
+        try:
+            yield tl
+        finally:
+            self.unbind(prev)
+
+    def complete(self, tl: Timeline, status: str = "ok",
+                 kind: str = "op", ops: int = 1) -> None:
+        tl.status = status
+        tl.took_ms = round((time.monotonic() - tl.t_arrive) * 1000, 3)
+        (self.took_bulk if kind == "bulk" else self.took_op).observe(
+            tl.took_ms)
+        rec = {"ts_ms": int(time.time() * 1000), "kind": kind,
+               "ops": int(ops), **tl.to_dict()}
+        with self._lock:
+            self.completed[kind] = self.completed.get(kind, 0) + 1
+            self.ops_total += int(ops)
+            if status != "ok":
+                self.errors += 1
+            self._ring.append(rec)
+
+    # --------------------------------------------------------------- reading
+
+    def captured(self, size: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            out = list(self._ring)
+        out.reverse()
+        return out[:size] if size is not None else out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.completed = {"op": 0, "bulk": 0}
+            self.ops_total = 0
+            self.errors = 0
+        self.took_op.reset()
+        self.took_bulk.reset()
+
+    def stats(self) -> dict:
+        with self._lock:
+            retained = len(self._ring)
+            completed = dict(self.completed)
+            ops_total = self.ops_total
+            errors = self.errors
+        return {"enabled": self.enabled,
+                "completed": completed,
+                "ops_total": ops_total,
+                "errors": errors,
+                "retained": retained,
+                "took_op_rolling": self.took_op.summary(),
+                "took_bulk_rolling": self.took_bulk.summary(),
+                "events": INGEST_EVENTS.stats()}

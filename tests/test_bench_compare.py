@@ -226,3 +226,79 @@ def test_scheduler_record_requires_observed_coalescing():
         {"bm25_openloop_8c_120rps": good}, 10.0)
     assert not failures
     assert rows[0]["co_batched_max"] == 6
+
+
+# --------------------------------------------- interference shape (ISSUE 13)
+
+INTF_OLD = {
+    "bm25_interference_4c_120rps_i0": {
+        "mode": "bm25_interference_4c_120rps_i0", "value": 110.0,
+        "ingest_rate": 0.0, "ingest_dps": 0.0, "clients": 4,
+        "p50_ms": 4.0, "p99_ms": 10.0},
+    "bm25_interference_4c_120rps_i30": {
+        "mode": "bm25_interference_4c_120rps_i30", "value": 100.0,
+        "ingest_rate": 30.0, "ingest_dps": 28.0, "clients": 4,
+        "p50_ms": 5.0, "p99_ms": 40.0},
+}
+
+
+def test_interference_records_skip_generic_warm_gate():
+    """Interference points carry `clients` + p99 but their tail includes
+    churn-induced compile stalls — the generic 10% warm gate must not
+    judge them (their own 15% gate does)."""
+    new = {k: dict(v, p99_ms=v["p99_ms"] * 1.12)
+           for k, v in INTF_OLD.items()}
+    rows, failures = bench_compare.compare(INTF_OLD, new, 10.0)
+    assert not rows and not failures
+
+
+def test_interference_p99_regression_fails():
+    new = {k: dict(v) for k, v in INTF_OLD.items()}
+    new["bm25_interference_4c_120rps_i30"]["p99_ms"] = 50.0  # +25%
+    rows, failures = bench_compare.compare_interference(
+        INTF_OLD, new, 10.0)
+    assert failures and "equal ingest rate" in failures[0]
+    by_cfg = {r["config"]: r for r in rows}
+    assert by_cfg["bm25_interference_4c_120rps_i30"]["status"] == \
+        "P99-REGRESSION"
+    assert by_cfg["bm25_interference_4c_120rps_i0"]["status"] == "ok"
+
+
+def test_interference_p99_within_15_pct_ok():
+    new = {k: dict(v, p99_ms=v["p99_ms"] * 1.14)
+           for k, v in INTF_OLD.items()}
+    rows, failures = bench_compare.compare_interference(
+        INTF_OLD, new, 10.0)
+    assert not failures
+    assert all(r["status"] == "ok" for r in rows)
+
+
+def test_interference_ingest_throughput_regression_fails():
+    new = {k: dict(v) for k, v in INTF_OLD.items()}
+    new["bm25_interference_4c_120rps_i30"]["ingest_dps"] = 20.0  # -28%
+    rows, failures = bench_compare.compare_interference(
+        INTF_OLD, new, 10.0)
+    assert failures and "ingest throughput" in failures[0]
+    by_cfg = {r["config"]: r for r in rows}
+    assert by_cfg["bm25_interference_4c_120rps_i30"]["status"] == \
+        "INGEST-REGRESSION"
+
+
+def test_interference_one_sided_points_never_fail():
+    new = {**{k: dict(v) for k, v in INTF_OLD.items()},
+           "bm25_interference_4c_120rps_i60": {
+               "mode": "bm25_interference_4c_120rps_i60",
+               "value": 90.0, "ingest_rate": 60.0, "ingest_dps": 55.0,
+               "clients": 4, "p50_ms": 6.0, "p99_ms": 80.0}}
+    rows, failures = bench_compare.compare_interference(
+        INTF_OLD, new, 10.0)
+    assert not failures
+    assert any(r.get("status") == "new-only" for r in rows)
+
+
+def test_interference_cli_end_to_end(tmp_path):
+    old_p = _write(tmp_path / "io.json", list(INTF_OLD.values()))
+    bad = [dict(v, p99_ms=v["p99_ms"] * 2) for v in INTF_OLD.values()]
+    bad_p = _write(tmp_path / "in.json", bad)
+    assert bench_compare.main(["bench_compare.py", old_p, old_p]) == 0
+    assert bench_compare.main(["bench_compare.py", old_p, bad_p]) == 1

@@ -1,0 +1,208 @@
+"""Segment-churn ledger (ISSUE 13): per-refresh/merge churn records with
+upload.corpus attribution, RotatingMemo invalidation counts, engine-
+event joins, and the acceptance differential — the recompile/warmup-hit
+verdict must MATCH the observed XLA compile counters."""
+
+import uuid
+
+import pytest
+
+from opensearch_tpu.index.mapper import MapperService
+from opensearch_tpu.index.shard import IndexShard
+from opensearch_tpu.telemetry import TELEMETRY
+from opensearch_tpu.telemetry.lifecycle import INGEST_EVENTS
+from opensearch_tpu.telemetry.ledger import ChurnLedger, ChurnScope
+
+
+@pytest.fixture()
+def churn_on():
+    ch = TELEMETRY.churn
+    ch.enabled = True
+    ch.reset()
+    yield ch
+    ch.enabled = False
+    ch.reset()
+
+
+def _shard(field: str):
+    """A shard over a UNIQUE field name: device shape signatures embed
+    field names, so a fresh field guarantees fresh shape buckets no
+    matter what earlier tests uploaded (the seen-set is process-wide by
+    design — shapes compiled before stay compiled)."""
+    mapper = MapperService({"properties": {field: {"type": "text"}}})
+    return IndexShard(0, mapper, index_name=f"churn_{field}")
+
+
+def _xla_misses() -> int:
+    return TELEMETRY.metrics.to_dict()["counters"].get(
+        "search.xla_cache_miss", 0)
+
+
+class TestGate:
+    def test_disabled_scope_is_none(self):
+        ch = ChurnLedger()
+        assert ch.enabled is False
+        assert ch.scope() is None and ch.current() is None
+
+    def test_enabled_scope(self):
+        ch = ChurnLedger()
+        ch.enabled = True
+        sc = ch.scope()
+        assert isinstance(sc, ChurnScope)
+        with ch.bound(sc):
+            assert ch.current() is sc
+        assert ch.current() is None
+
+    def test_observe_shape_live_regardless(self):
+        ch = ChurnLedger()
+        assert ch.observe_shape("sig-a") is False
+        assert ch.observe_shape("sig-a") is True
+        assert ch.snapshot()["shapes_seen"] >= 1
+
+    def test_reset_keeps_seen_shapes(self):
+        ch = ChurnLedger()
+        ch.observe_shape("sig-keep")
+        ch.reset()
+        # shapes compiled before a reset stay compiled: still known
+        assert ch.observe_shape("sig-keep") is True
+        assert ch.snapshot()["totals"]["events"] == 0
+
+
+class TestRefreshChurnRecord:
+    def test_refresh_publishes_one_joined_record(self, churn_on):
+        shard = _shard(f"f{uuid.uuid4().hex[:8]}")
+        field = shard.reader.mapper.mapping_dict()
+        fname = next(iter(field["properties"]))
+        for i in range(3):
+            shard.index_doc(f"d{i}", {fname: f"alpha beta {i}"})
+        before = churn_on.snapshot()["totals"]["events"]
+        shard.refresh()
+        recs = churn_on.records()
+        assert churn_on.snapshot()["totals"]["events"] == before + 1
+        rec = recs[0]
+        assert rec["kind"] == "refresh"
+        assert rec["docs"] == 3
+        assert rec["segments"] == {"before": 0, "after": 1}
+        assert rec["upload_bytes"] > 0
+        assert len(rec["uploads"]) == 1
+        # joined to the engine's event log by id, kind matches
+        ev = INGEST_EVENTS.events_by_id().get(rec["event_id"])
+        assert ev is not None and ev["kind"] == "refresh"
+        assert "warmup_registered" in rec
+
+    def test_noop_refresh_publishes_nothing(self, churn_on):
+        shard = _shard(f"f{uuid.uuid4().hex[:8]}")
+        before = churn_on.snapshot()["totals"]["events"]
+        shard.refresh()
+        assert churn_on.snapshot()["totals"]["events"] == before
+
+    def test_disabled_refresh_publishes_nothing(self):
+        ch = TELEMETRY.churn
+        assert ch.enabled is False
+        shard = _shard(f"f{uuid.uuid4().hex[:8]}")
+        fname = next(iter(shard.reader.mapper.mapping_dict()
+                          ["properties"]))
+        shard.index_doc("d0", {fname: "x"})
+        before = ch.snapshot()["totals"]["events"]
+        shard.refresh()
+        assert ch.snapshot()["totals"]["events"] == before
+
+
+class TestVerdictDifferential:
+    """The acceptance pin: a forced refresh under warm serving yields
+    exactly one churn record whose recompile/warmup-hit verdict matches
+    the OBSERVED XLA compile counters on the next query."""
+
+    def test_recompile_verdict_matches_compile_counter(self, churn_on):
+        fname = f"f{uuid.uuid4().hex[:8]}"
+        shard = _shard(fname)
+        body = {"query": {"match": {fname: "alpha"}}, "size": 5}
+        # seed corpus + warm serving (compiles the first shape bucket)
+        for i in range(3):
+            shard.index_doc(f"d{i}", {fname: f"alpha beta {i}"})
+        shard.refresh()
+        shard.executor.search(dict(body))
+        churn_on.reset()
+
+        # forced refresh: 3 fresh docs -> same doc-count bucket, but the
+        # postings-block count may differ; the verdict is whatever the
+        # ledger says — the point is it must MATCH the counters
+        for i in range(3, 6):
+            shard.index_doc(f"d{i}",
+                            {fname: f"alpha gamma {i} " + "pad " * i})
+        shard.refresh()
+        recs = churn_on.records()
+        assert len(recs) == 1
+        verdict = recs[0]["verdict"]
+        assert verdict in ("recompile", "warmup_hit")
+        misses0 = _xla_misses()
+        shard.executor.search(dict(body))
+        delta = _xla_misses() - misses0
+        if verdict == "recompile":
+            assert delta > 0, \
+                "verdict said recompile but no XLA compile happened"
+        else:
+            assert delta == 0, \
+                f"verdict said warmup_hit but {delta} XLA compile(s) " \
+                f"happened"
+
+    def test_same_bucket_refresh_is_warmup_hit(self, churn_on):
+        fname = f"f{uuid.uuid4().hex[:8]}"
+        shard = _shard(fname)
+        body = {"query": {"match": {fname: "alpha"}}, "size": 5}
+        # two refreshes with IDENTICAL doc content -> identical shapes
+        for i in range(3):
+            shard.index_doc(f"a{i}", {fname: "alpha beta gamma"})
+        shard.refresh()
+        shard.executor.search(dict(body))
+        churn_on.reset()
+        for i in range(3):
+            shard.index_doc(f"b{i}", {fname: "alpha beta gamma"})
+        shard.refresh()
+        rec = churn_on.records()[0]
+        assert rec["verdict"] == "warmup_hit"
+        misses0 = _xla_misses()
+        shard.executor.search(dict(body))
+        assert _xla_misses() == misses0
+
+
+class TestMemoInvalidation:
+    def test_refresh_drops_warm_memo(self, churn_on):
+        fname = f"f{uuid.uuid4().hex[:8]}"
+        shard = _shard(fname)
+        for i in range(3):
+            shard.index_doc(f"d{i}", {fname: f"alpha beta {i}"})
+        shard.refresh()
+        # warm the interned-bundle memo (skeletons + bundles)
+        for _ in range(2):
+            shard.executor.search(
+                {"query": {"match": {fname: "alpha"}}, "size": 5})
+        assert len(shard.reader.stats().memo) > 0
+        churn_on.reset()
+        shard.index_doc("dx", {fname: "gamma"})
+        shard.refresh()
+        rec = churn_on.records()[0]
+        # the segment-list change drops the WHOLE stats memo
+        assert rec["memo_entries_dropped"] > 0
+        assert rec["memo_entries_keyed"] == 0   # refresh removes nothing
+
+    def test_merge_counts_keyed_invalidations(self, churn_on):
+        fname = f"f{uuid.uuid4().hex[:8]}"
+        shard = _shard(fname)
+        shard.engine.merge_max_segments = 2
+        for i in range(5):
+            shard.index_doc(f"d{i}", {fname: f"alpha {i}"})
+            shard.refresh()
+        # warm: skeleton/text-clause entries keyed per segment uid
+        shard.executor.search(
+            {"query": {"match": {fname: "alpha"}}, "size": 5})
+        churn_on.reset()
+        merged = shard.maybe_merge()
+        assert merged is not None
+        rec = churn_on.records()[0]
+        assert rec["kind"] == "merge"
+        assert rec["removed_segments"]
+        assert rec["memo_entries_dropped"] > 0
+        assert rec["memo_entries_keyed"] >= 1
+        ev = INGEST_EVENTS.events_by_id().get(rec["event_id"])
+        assert ev is not None and ev["kind"] == "merge"

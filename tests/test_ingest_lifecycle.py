@@ -1,0 +1,454 @@
+"""Write-path observability (ISSUE 13): ingest lifecycle recorder gate
+discipline, per-op/per-bulk timelines over REST, engine refresh/merge/
+flush metrics + event log, the flight recorder's ingest_events
+annotation, refresh-listener isolation, the indexing slow log, and the
+instrumentation-off differential (off = byte-identical indexing)."""
+
+import logging
+
+import pytest
+
+from opensearch_tpu.index.engine import InternalEngine
+from opensearch_tpu.index.mapper import MapperService
+from opensearch_tpu.node import Node
+from opensearch_tpu.telemetry import TELEMETRY
+from opensearch_tpu.telemetry.lifecycle import (
+    INGEST_EVENTS, IngestEventLog, IngestRecorder, Timeline)
+
+MAPPING = {"properties": {"body": {"type": "text"},
+                          "views": {"type": "integer"}}}
+
+
+@pytest.fixture()
+def recorder():
+    """A fresh private recorder (unit tests never touch the singleton)."""
+    return IngestRecorder()
+
+
+@pytest.fixture()
+def ingest_on():
+    """Enable the SINGLETON ingest recorder + churn ledger; restore."""
+    ing, ch = TELEMETRY.ingest, TELEMETRY.churn
+    ing.enabled = True
+    ch.enabled = True
+    ing.clear()
+    ch.reset()
+    yield ing
+    ing.enabled = False
+    ch.enabled = False
+    ing.clear()
+    ch.reset()
+
+
+def _engine(mapping=MAPPING):
+    return InternalEngine(MapperService(mapping))
+
+
+# ------------------------------------------------------------ gate discipline
+
+class TestGateDiscipline:
+    def test_disabled_gates_return_none(self, recorder):
+        assert recorder.enabled is False
+        assert recorder.timeline() is None
+        assert recorder.current() is None
+
+    def test_enabled_returns_detail_timeline(self, recorder):
+        recorder.enabled = True
+        tl = recorder.timeline()
+        assert isinstance(tl, Timeline) and tl.detail is True
+        assert recorder.timeline(detail=False).detail is False
+
+    def test_current_reads_thread_binding_only_when_enabled(self, recorder):
+        recorder.enabled = True
+        tl = recorder.timeline()
+        with recorder.bound(tl):
+            assert recorder.current() is tl
+            recorder.enabled = False
+            # disabled current() never touches the TLS
+            assert recorder.current() is None
+            recorder.enabled = True
+        assert recorder.current() is None
+
+    def test_disabled_engine_path_records_nothing(self, recorder):
+        eng = _engine()
+        eng.index("d1", {"body": "hello"})
+        assert recorder.stats()["completed"] == {"op": 0, "bulk": 0}
+
+    def test_phase_add_detail_appends_events(self):
+        tl = Timeline()
+        tl.detail = True
+        tl.phase_add("parse", 1.5)
+        tl.phase_add("parse", 0.5)
+        assert tl.phases["parse"] == 2.0
+        assert [e[0] for e in tl.events].count("parse") == 2
+        tl2 = Timeline()
+        tl2.phase_add("parse", 1.0)     # detail=False: phase only
+        assert [e[0] for e in tl2.events] == ["arrive"]
+
+
+# ------------------------------------------------------- engine instrumentation
+
+class TestEngineInstrumentation:
+    def test_op_phases_accumulate_on_bound_timeline(self, ingest_on):
+        eng = _engine()
+        tl = ingest_on.timeline()
+        with ingest_on.bound(tl):
+            eng.index("d1", {"body": "hello world"})
+        for phase in ("version_plan", "parse", "translog_append"):
+            assert phase in tl.phases, tl.phases
+        names = [e[0] for e in tl.events]
+        assert names.index("version_plan") < names.index("parse") \
+            < names.index("translog_append")
+
+    def test_refresh_metrics_and_event(self):
+        m = TELEMETRY.metrics
+        before_refreshes = m.counter("indexing.refreshes").value
+        before_events = INGEST_EVENTS.stats()["events"]
+        eng = _engine()
+        for i in range(4):
+            eng.index(f"d{i}", {"body": f"doc {i}"})
+        seg = eng.refresh()
+        assert seg is not None
+        assert m.counter("indexing.refreshes").value == \
+            before_refreshes + 1
+        assert eng.last_ingest_event is not None
+        ev = eng.last_ingest_event
+        assert ev["kind"] == "refresh" and ev["docs"] == 4
+        assert ev["seg_id"] == seg.seg_id
+        assert ev["live_doc_ratio"] == 1.0
+        assert INGEST_EVENTS.stats()["events"] == before_events + 1
+
+    def test_noop_refresh_records_no_event(self):
+        before = INGEST_EVENTS.stats()["events"]
+        eng = _engine()
+        assert eng.refresh() is None
+        assert eng.last_ingest_event is None
+        assert INGEST_EVENTS.stats()["events"] == before
+
+    def test_merge_event_counts_docs_in_out(self):
+        eng = _engine()
+        eng.merge_max_segments = 2
+        for i in range(9):
+            eng.index(f"d{i}", {"body": f"doc {i}"})
+            eng.refresh()
+        merged = eng.maybe_merge()
+        assert merged is not None
+        ev = eng.last_ingest_event
+        assert ev["kind"] == "merge"
+        assert ev["segments_in"] >= 2
+        assert ev["docs_in"] == ev["docs"]  # no deletes: all docs survive
+        assert TELEMETRY.metrics.counter("indexing.merges").value >= 1
+
+    def test_event_log_overlap_and_ids(self):
+        log = IngestEventLog(ring_size=8)
+        log.note("refresh", 10.0, 10.5, seg_id="s1", docs=3)
+        log.note("merge", 20.0, 21.0, seg_id="s2", docs=6)
+        hits = log.overlapping(10.2, 10.9)
+        assert len(hits) == 1 and hits[0]["kind"] == "refresh"
+        assert hits[0]["t_rel_ms"] == pytest.approx(-200.0)
+        assert "t0_mono" not in hits[0]
+        assert log.overlapping(11.0, 19.0) == []
+        both = log.overlapping(10.4, 20.1)
+        assert [h["kind"] for h in both] == ["refresh", "merge"]
+        by_id = log.events_by_id()
+        assert {e["kind"] for e in by_id.values()} == {"refresh",
+                                                      "merge"}
+
+
+# ---------------------------------------------------- listener isolation
+
+class TestRefreshListenerIsolation:
+    def test_raising_listener_does_not_abort_publish(self):
+        eng = _engine()
+        calls = []
+
+        def bad(seg, deleted):
+            raise RuntimeError("listener boom")
+
+        def good(seg, deleted):
+            calls.append(seg)
+
+        eng.add_refresh_listener(bad)
+        eng.add_refresh_listener(good)
+        before = TELEMETRY.metrics.counter(
+            "indexing.refresh_listener_failures").value
+        eng.index("d1", {"body": "x"})
+        seg = eng.refresh()                  # must NOT raise
+        assert seg is not None
+        assert len(eng.segments) == 1        # segment published
+        assert calls and calls[0] is seg     # later listener still ran
+        assert TELEMETRY.metrics.counter(
+            "indexing.refresh_listener_failures").value == before + 1
+
+    def test_merge_and_install_use_isolation_too(self):
+        eng = _engine()
+        eng.merge_max_segments = 2
+        eng.add_refresh_listener(
+            lambda seg, deleted: (_ for _ in ()).throw(ValueError("x")))
+        for i in range(5):
+            eng.index(f"d{i}", {"body": "x"})
+            eng.refresh()
+        assert eng.maybe_merge() is not None     # no raise
+        eng2 = _engine()
+        eng2.add_refresh_listener(
+            lambda seg, deleted: (_ for _ in ()).throw(ValueError("x")))
+        eng2.install_segments(list(eng.segments), max_seq_no=4,
+                              local_checkpoint=4)  # no raise
+        assert eng2.segments
+
+
+# ------------------------------------------------------------- REST surface
+
+class TestRestIngest:
+    @pytest.fixture()
+    def node(self):
+        n = Node()
+        n.request("PUT", "/idx", {"mappings": MAPPING})
+        return n
+
+    def test_per_op_timeline_over_rest(self, node, ingest_on):
+        r = node.request("PUT", "/idx/_doc/1", {"body": "hello"},
+                         refresh="wait_for")
+        assert r["_status"] == 201
+        recent = ingest_on.captured()
+        assert recent and recent[0]["kind"] == "op"
+        rec = recent[0]
+        for phase in ("version_plan", "parse", "translog_append"):
+            assert phase in rec["phases"]
+        names = [e["event"] for e in rec["events"]]
+        assert "refresh_wait" in names and names[-1] == "respond"
+        rw = next(e for e in rec["events"]
+                  if e["event"] == "refresh_wait")
+        assert rw["mode"] == "wait_for" and rw["ms"] >= 0
+
+    def test_bulk_timeline(self, node, ingest_on):
+        lines = []
+        for i in range(3):
+            lines.append('{"index": {"_index": "idx", "_id": "b%d"}}' % i)
+            lines.append('{"body": "doc %d"}' % i)
+        r = node.request("POST", "/_bulk", "\n".join(lines) + "\n",
+                         refresh="true")
+        assert r["_status"] == 200 and not r["errors"]
+        rec = ingest_on.captured()[0]
+        assert rec["kind"] == "bulk" and rec["ops"] == 3
+        names = [e["event"] for e in rec["events"]]
+        assert "admit" in names and "refresh_wait" in names
+        # bulk timelines accumulate phases without per-op event spam
+        assert names.count("parse") == 0
+        assert rec["phases"]["parse"] > 0
+
+    def test_ingest_endpoint_roundtrip(self, node):
+        r = node.request("POST", "/_telemetry/ingest/_enable")
+        assert r["enabled"] is True
+        try:
+            assert TELEMETRY.ingest.enabled and TELEMETRY.churn.enabled
+            node.request("PUT", "/idx/_doc/9", {"body": "x"},
+                         refresh="true")
+            out = node.request("GET", "/_telemetry/ingest")
+            assert out["enabled"] is True
+            assert out["stats"]["completed"]["op"] >= 1
+            assert any(ev["kind"] == "refresh" for ev in out["events"])
+            assert out["churn"]["totals"]["refresh"] >= 1
+            assert out["churn"]["records"]
+            node.request("POST", "/_telemetry/ingest/_clear")
+            out2 = node.request("GET", "/_telemetry/ingest")
+            assert out2["stats"]["completed"] == {"op": 0, "bulk": 0}
+            assert out2["churn"]["totals"]["events"] == 0
+        finally:
+            node.request("POST", "/_telemetry/ingest/_disable")
+        assert TELEMETRY.ingest.enabled is False
+        assert TELEMETRY.churn.enabled is False
+
+    def test_nodes_stats_indexing_block(self, node):
+        out = node.request("GET", "/_nodes/stats")
+        tel = next(iter(out["nodes"].values()))["telemetry"]
+        assert "indexing" in tel
+        assert "ingest" in tel["indexing"]
+        assert "churn" in tel["indexing"]
+        assert tel["indexing"]["ingest"]["enabled"] is False
+
+    def test_error_op_completes_timeline(self, node, ingest_on):
+        r = node.request("PUT", "/idx/_doc/1", {"body": "x"})
+        assert r["_status"] == 201
+        r = node.request("PUT", "/idx/_create/1", {"body": "y"})
+        assert r["_status"] == 409
+        rec = ingest_on.captured()[0]
+        assert rec["status"] == "error"
+
+
+# ---------------------------------------------------- flight-capture join
+
+class TestIngestEventsAnnotation:
+    def test_capture_carries_overlapping_events(self):
+        fl = TELEMETRY.flight
+        fl.enabled = True
+        fl.threshold_ms = 0.0
+        fl.clear()
+        try:
+            tl = fl.timeline()
+            eng = _engine()
+            eng.index("d1", {"body": "x"})
+            eng.refresh()                    # event inside the window
+            trigger = fl.complete(tl)
+            assert trigger == "threshold"
+            cap = fl.captured()[0]
+            assert "ingest_events" in cap
+            kinds = [e["kind"] for e in cap["ingest_events"]]
+            assert "refresh" in kinds
+            ev_ids = set(INGEST_EVENTS.events_by_id())
+            assert all(e["event_id"] in ev_ids
+                       for e in cap["ingest_events"])
+        finally:
+            fl.enabled = False
+            fl.threshold_ms = None
+            fl.clear()
+
+    def test_quiet_window_annotates_empty_list(self):
+        fl = TELEMETRY.flight
+        fl.enabled = True
+        fl.threshold_ms = 0.0
+        fl.clear()
+        try:
+            tl = fl.timeline()
+            fl.complete(tl)
+            cap = fl.captured()[0]
+            assert cap["ingest_events"] == []
+        finally:
+            fl.enabled = False
+            fl.threshold_ms = None
+            fl.clear()
+
+
+# ------------------------------------------------------- indexing slow log
+
+class TestIndexingSlowLog:
+    LOGGER = "opensearch_tpu.index.indexing.slowlog.index"
+
+    def _node(self, settings):
+        n = Node()
+        n.request("PUT", "/slow", {"mappings": MAPPING,
+                                   "settings": settings})
+        return n
+
+    def test_threshold_zero_logs(self, caplog):
+        n = self._node({"index.indexing.slowlog.threshold.index.info":
+                        "0ms"})
+        with caplog.at_level(5, logger=self.LOGGER):
+            n.request("PUT", "/slow/_doc/1", {"body": "hello"})
+        recs = [r for r in caplog.records if r.name == self.LOGGER]
+        assert len(recs) == 1 and recs[0].levelno == logging.INFO
+        assert "took[" in recs[0].getMessage()
+        assert "id[1]" in recs[0].getMessage()
+
+    def test_most_severe_wins(self, caplog):
+        n = self._node({
+            "index.indexing.slowlog.threshold.index.warn": "0ms",
+            "index.indexing.slowlog.threshold.index.info": "0ms",
+            "index.indexing.slowlog.threshold.index.trace": "0ms"})
+        with caplog.at_level(5, logger=self.LOGGER):
+            n.request("PUT", "/slow/_doc/1", {"body": "x"})
+        recs = [r for r in caplog.records if r.name == self.LOGGER]
+        assert len(recs) == 1 and recs[0].levelno == logging.WARNING
+
+    def test_negative_disables(self, caplog):
+        n = self._node({
+            "index.indexing.slowlog.threshold.index.warn": "-1",
+            "index.indexing.slowlog.threshold.index.info": "-1"})
+        with caplog.at_level(5, logger=self.LOGGER):
+            n.request("PUT", "/slow/_doc/1", {"body": "x"})
+        assert not [r for r in caplog.records if r.name == self.LOGGER]
+
+    def test_unconfigured_logs_nothing(self, caplog):
+        n = self._node({})
+        with caplog.at_level(5, logger=self.LOGGER):
+            n.request("PUT", "/slow/_doc/1", {"body": "x"})
+        assert not [r for r in caplog.records if r.name == self.LOGGER]
+
+    def test_source_truncated(self, caplog):
+        n = self._node({
+            "index.indexing.slowlog.threshold.index.info": "0ms",
+            "index.indexing.slowlog.source": "8"})
+        with caplog.at_level(5, logger=self.LOGGER):
+            n.request("PUT", "/slow/_doc/1",
+                      {"body": "a very long body " * 20})
+        msg = [r for r in caplog.records
+               if r.name == self.LOGGER][0].getMessage()
+        inner = msg.split("source[", 1)[1].rsplit("]", 1)[0]
+        assert len(inner) == 8
+
+    def test_source_false_omits(self, caplog):
+        n = self._node({
+            "index.indexing.slowlog.threshold.index.info": "0ms",
+            "index.indexing.slowlog.source": "false"})
+        with caplog.at_level(5, logger=self.LOGGER):
+            n.request("PUT", "/slow/_doc/1", {"body": "xyz"})
+        msg = [r for r in caplog.records
+               if r.name == self.LOGGER][0].getMessage()
+        assert "source[]" in msg
+
+    def test_bulk_items_log_too(self, caplog):
+        n = self._node({"index.indexing.slowlog.threshold.index.trace":
+                        "0ms"})
+        lines = ['{"index": {"_index": "slow", "_id": "b1"}}',
+                 '{"body": "x"}']
+        with caplog.at_level(5, logger=self.LOGGER):
+            n.request("POST", "/_bulk", "\n".join(lines) + "\n")
+        recs = [r for r in caplog.records if r.name == self.LOGGER]
+        assert len(recs) == 1 and recs[0].levelno == 5
+
+
+# ----------------------------------------- instrumentation-off differential
+
+class TestInstrumentationOffDifferential:
+    OPS = [("index", "d1", {"body": "alpha beta", "views": 1}),
+           ("index", "d2", {"body": "beta gamma", "views": 2}),
+           ("refresh", None, None),
+           ("index", "d1", {"body": "alpha beta updated", "views": 3}),
+           ("delete", "d2", None),
+           ("refresh", None, None),
+           ("index", "d3", {"body": "delta", "views": 4}),
+           ("flush", None, None)]
+
+    def _run(self, with_instrumentation: bool):
+        ing, ch, fl = TELEMETRY.ingest, TELEMETRY.churn, TELEMETRY.flight
+        prev = (ing.enabled, ch.enabled)
+        ing.enabled = ch.enabled = with_instrumentation
+        try:
+            eng = _engine()
+            for op, did, src in self.OPS:
+                if op == "index":
+                    tl = ing.timeline()
+                    with ing.bound(tl):
+                        eng.index(did, src)
+                    if tl is not None:
+                        ing.complete(tl)
+                elif op == "delete":
+                    eng.delete(did)
+                elif op == "refresh":
+                    eng.refresh()
+                else:
+                    eng.flush()
+            stats = eng.stats()
+            seg_bytes = [(s.seg_id, s.memory_bytes(), s.num_docs,
+                          s.live_doc_count, list(s.doc_ids))
+                         for s in eng.segments]
+            return stats, seg_bytes
+        finally:
+            ing.enabled, ch.enabled = prev
+
+    def test_off_indexing_byte_identical_to_on(self):
+        """Instrumentation must OBSERVE the write path, never steer it:
+        the same op sequence with gates on and off produces identical
+        engine stats and identical segment bytes."""
+        on_stats, on_segs = self._run(True)
+        off_stats, off_segs = self._run(False)
+        assert on_stats == off_stats
+        assert on_segs == off_segs
+
+    def test_off_run_records_nothing(self):
+        ing, ch = TELEMETRY.ingest, TELEMETRY.churn
+        ing.clear()
+        ch.reset()
+        self._run(False)
+        assert ing.stats()["completed"] == {"op": 0, "bulk": 0}
+        assert ch.snapshot()["totals"]["events"] == 0

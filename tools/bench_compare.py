@@ -38,6 +38,12 @@ WARM_KEYS = ("warm_p50_ms", "p50_ms")
 # gracefully" contract, distinct from the warm-latency threshold
 OVERLOAD_COLLAPSE_PCT = 15.0
 
+# the interference gate (ISSUE 13): at the SAME ingest rate, search p99
+# may not degrade by more than this between two rounds, and ingest
+# throughput may not drop by more than this — "serving under writes got
+# slower" and "writes under serving got slower" both fail the run
+INTERFERENCE_P99_PCT = 15.0
+
 
 def load_records(path: str) -> Dict[str, dict]:
     """file of JSON lines (or one JSON array) → {config key: record}."""
@@ -104,6 +110,12 @@ def compare(old: Dict[str, dict], new: Dict[str, dict],
             # saturation BY CONSTRUCTION and scale with each round's
             # independently measured saturation reference — gating
             # them as warm latency would fail identical builds
+            continue
+        if any(r is not None and "ingest_rate" in r for r in (o, n)):
+            # BENCH_INTERFERENCE points have their own gate
+            # (compare_interference, 15% at equal ingest rate): their
+            # p99 under concurrent ingest includes churn-induced
+            # compile stalls the generic warm gate would misread
             continue
         row = {"config": key}
         if o is None or n is None:
@@ -248,6 +260,84 @@ def compare_overload(old: Dict[str, dict], new: Dict[str, dict],
     return rows, failures
 
 
+def _interference_records(recs: Dict[str, dict]) -> Dict[str, dict]:
+    """The BENCH_INTERFERENCE shape: points carrying `ingest_rate` next
+    to search latency fields (bench.py --ingest-rate)."""
+    return {k: r for k, r in recs.items()
+            if isinstance(r.get("ingest_rate"), (int, float))
+            and isinstance(r.get("p99_ms"), (int, float))}
+
+
+def compare_interference(old: Dict[str, dict], new: Dict[str, dict],
+                         threshold_pct: float
+                         ) -> Tuple[List[dict], List[str]]:
+    """Gate two interference sweeps point-by-point at EQUAL ingest
+    rate: fail when search p99 degrades more than INTERFERENCE_P99_PCT
+    (serving under writes got slower), or when achieved ingest
+    throughput (`ingest_dps`) drops more than --threshold (writes under
+    serving got slower). Points present in only one round report but
+    never fail (rate grids grow round over round); the ingest-off
+    control gates like any other point (its ingest_dps is 0 on both
+    sides and skips the throughput gate)."""
+    o_recs = _interference_records(old)
+    n_recs = _interference_records(new)
+    rows, failures = [], []
+    if not o_recs or not n_recs:
+        return rows, failures
+    for key in sorted(set(o_recs) | set(n_recs),
+                      key=lambda k: (o_recs.get(k) or n_recs.get(k))
+                      ["ingest_rate"]):
+        o, n = o_recs.get(key), n_recs.get(key)
+        row = {"config": key,
+               "ingest_rate": (o or n)["ingest_rate"]}
+        if o is None or n is None:
+            row["status"] = "old-only" if n is None else "new-only"
+            rows.append(row)
+            continue
+        status = "ok"
+        o99, n99 = float(o["p99_ms"]), float(n["p99_ms"])
+        row["old_p99_ms"] = o99
+        row["new_p99_ms"] = n99
+        if o99 > 0:
+            d99 = 100.0 * (n99 - o99) / o99
+            row["p99_delta_pct"] = round(d99, 1)
+            if d99 > INTERFERENCE_P99_PCT:
+                status = "P99-REGRESSION"
+                failures.append(
+                    f"{key}: search p99 under ingest {o99}ms -> "
+                    f"{n99}ms (+{d99:.1f}% > "
+                    f"{INTERFERENCE_P99_PCT:g}% at equal ingest rate)")
+        od = o.get("ingest_dps")
+        nd = n.get("ingest_dps")
+        if isinstance(od, (int, float)) and isinstance(nd, (int, float)) \
+                and od > 0:
+            row["old_ingest_dps"] = od
+            row["new_ingest_dps"] = nd
+            dd = 100.0 * (nd - od) / od
+            row["ingest_delta_pct"] = round(dd, 1)
+            if dd < -threshold_pct:
+                status = "INGEST-REGRESSION"
+                failures.append(
+                    f"{key}: ingest throughput {od} -> {nd} docs/s "
+                    f"({dd:.1f}% < -{threshold_pct:g}%)")
+        row["status"] = status
+        rows.append(row)
+    return rows, failures
+
+
+def render_interference(rows: List[dict]) -> str:
+    headers = ["config", "ingest_rate", "old_p99_ms", "new_p99_ms",
+               "p99_delta_pct", "old_ingest_dps", "new_ingest_dps",
+               "ingest_delta_pct", "status"]
+    table = [headers] + [[str(r.get(h, "-")) for h in headers]
+                         for r in rows]
+    widths = [max(len(row[i]) for row in table)
+              for i in range(len(headers))]
+    return "\n".join(
+        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+        for row in table)
+
+
 def render_overload(rows: List[dict]) -> str:
     headers = ["config", "offered_rate", "old_goodput", "new_goodput",
                "goodput_delta_pct", "past_knee", "old_admitted_p99_ms",
@@ -300,6 +390,12 @@ def main(argv: List[str]) -> int:
         print("\noverload curve (goodput vs offered load):")
         print(render_overload(ov_rows))
         failures += ov_failures
+    if_rows, if_failures = compare_interference(old, new, threshold)
+    if if_rows:
+        print("\ninterference sweep (search p99 / ingest throughput "
+              "at equal ingest rate):")
+        print(render_interference(if_rows))
+        failures += if_failures
     if failures:
         print(f"\nFAIL: {len(failures)} regression(s) "
               f"(warm p50/p99 beyond {threshold:g}% / overload "

@@ -58,6 +58,14 @@ GATED_SUBSYSTEMS = (
     # exactly the pre-scheduler path
     ("opensearch_tpu/search/scheduler.py", "WaveScheduler", "enabled",
      ("gate",)),
+    # ISSUE 13 write-path observability: the ingest lifecycle recorder
+    # and the segment-churn ledger are OFF by default — the default
+    # write path pays one attribute load + branch per op (timeline/
+    # current) and per refresh (scope/current)
+    ("opensearch_tpu/telemetry/lifecycle.py", "IngestRecorder",
+     "enabled", ("timeline", "current")),
+    ("opensearch_tpu/telemetry/ledger.py", "ChurnLedger", "enabled",
+     ("scope", "current")),
 )
 
 # no-op constants a disabled gate may return

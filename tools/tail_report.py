@@ -192,6 +192,50 @@ def render_coalesce(groups: Dict[str, dict]) -> str:
                           "window_wait_ms"])
 
 
+def ingest_groups(records: List[dict]) -> Dict[str, dict]:
+    """Group tail captures by the write-path events that overlapped
+    their window (ISSUE 13): each capture's `ingest_events` annotation
+    (attached by the flight recorder from the engine event log) names
+    the refresh/merge/flush events in flight while the request ran. The
+    split answers "did a merge cause this p99" — a `merge` group with a
+    far higher took_p50 than `quiet` is the smoking gun, and
+    `events_per_capture` says how churny the overlap was."""
+    groups: Dict[str, dict] = {}
+    annotated = False
+    for rec in records:
+        evs = rec.get("ingest_events")
+        if evs is None:
+            continue            # pre-ISSUE-13 capture: no annotation
+        annotated = True
+        kinds = sorted({e.get("kind", "?") for e in evs})
+        key = "+".join(kinds) if kinds else "quiet"
+        g = groups.setdefault(key, {"captures": 0, "events": 0,
+                                    "took_ms": []})
+        g["captures"] += 1
+        g["events"] += len(evs)
+        g["took_ms"].append(float(rec.get("took_ms") or 0.0))
+    if not annotated:
+        return {}
+    out: Dict[str, dict] = {}
+    for key, g in groups.items():
+        took = sorted(g["took_ms"])
+        out[key] = {
+            "captures": g["captures"],
+            "events_per_capture": round(g["events"]
+                                        / max(g["captures"], 1), 2),
+            "took_p50_ms": round(took[len(took) // 2], 3),
+            "took_max_ms": round(took[-1], 3),
+        }
+    return out
+
+
+def render_ingest(groups: Dict[str, dict]) -> str:
+    rows = [{"ingest_overlap": k, **v} for k, v in sorted(groups.items())]
+    return _render(rows, ["ingest_overlap", "captures",
+                          "events_per_capture", "took_p50_ms",
+                          "took_max_ms"])
+
+
 def rejection_groups(records: List[dict]) -> Dict[str, dict]:
     """Group captures that carry a `reject` lifecycle event by the
     structured reason + tenant the admission controller stamped
@@ -248,6 +292,11 @@ def main(argv: List[str]) -> int:
     if co:
         print("\ntail by coalesce state (co_batched > 1 = shared wave):")
         print(render_coalesce(co))
+    ig = ingest_groups(records)
+    if ig:
+        print("\ntail by ingest overlap (write-path events in flight "
+              "during the capture window):")
+        print(render_ingest(ig))
     groups = rejection_groups(records)
     if groups:
         print(f"\nrejections by reason "
