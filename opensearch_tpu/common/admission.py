@@ -577,6 +577,15 @@ class AdmissionController:
         self.quotas = TenantQuotas(clock=clock)
         self.shedder = DeadlineShedder()
         self.wave_breaker = WAVE_BREAKER
+        # per-tenant resource USAGE (ISSUE 14) — the other side of the
+        # quota story: quotas bound what a tenant may ask for, this
+        # records what it actually consumed. Fed by the wave scheduler
+        # splitting each shared dispatch's device wall (and, ledger on,
+        # its fetched bytes) proportionally across co-batched owners.
+        # Bounded like the quota buckets: past the cap, new tenants
+        # fold into the overflow row.
+        self._usage: Dict[str, Dict[str, float]] = {}
+        self._usage_lock = threading.Lock()
         # the wave scheduler's queue-depth feed (search/scheduler.py):
         # when the scheduler is enabled, admitted requests WAIT in its
         # bounded queue before executing, so the deadline-shed stage
@@ -599,6 +608,40 @@ class AdmissionController:
         if extra is None:
             return self.current
         return max(self.current, int(extra()))
+
+    def note_usage(self, tenant: Optional[str], device_ms: float,
+                   d2h_bytes: int = 0, items: int = 1) -> None:
+        """Accumulate one request's measured resource consumption
+        (ISSUE 14): its proportional slice of a shared wave's device
+        wall (`device_share_ms`) and fetched bytes. Always-on once the
+        scheduler dispatches (one lock + dict update per ITEM per
+        wave, never per doc) — the `usage` block on `_nodes/stats`
+        admission answers "which tenant is actually eating the
+        device", the number the quota knobs are tuned against."""
+        tenant = tenant or DEFAULT_TENANT
+        with self._usage_lock:
+            u = self._usage.get(tenant)
+            if u is None:
+                if len(self._usage) >= TenantQuotas.MAX_TRACKED_TENANTS \
+                        and tenant != TenantQuotas.OVERFLOW_TENANT:
+                    tenant = TenantQuotas.OVERFLOW_TENANT
+                    u = self._usage.get(tenant)
+                if u is None:
+                    u = self._usage[tenant] = {
+                        "device_ms": 0.0, "d2h_bytes": 0, "items": 0,
+                        "waves": 0}
+            u["device_ms"] += float(device_ms)
+            u["d2h_bytes"] += int(d2h_bytes)
+            u["items"] += int(items)
+            u["waves"] += 1
+
+    def usage(self) -> Dict[str, dict]:
+        with self._usage_lock:
+            return {t: {"device_ms": round(u["device_ms"], 3),
+                        "d2h_bytes": int(u["d2h_bytes"]),
+                        "items": int(u["items"]),
+                        "waves": int(u["waves"])}
+                    for t, u in sorted(self._usage.items())}
 
     def refund_unserved(self, tenant: Optional[str] = None) -> None:
         """Refund the quota token of an ADMITTED request that a post-
@@ -921,5 +964,9 @@ class AdmissionController:
                 "tenant_quota": self.quotas.stats(),
                 "breakers": {self.wave_breaker.name:
                              self.wave_breaker.stats()},
+                # measured per-tenant consumption (ISSUE 14): the
+                # usage side of the quota story, fed by the wave
+                # scheduler's proportional device-wall split
+                "usage": self.usage(),
             },
         }

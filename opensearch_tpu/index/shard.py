@@ -81,6 +81,10 @@ class IndexShard:
             allocation_id=allocation_id
             or f"{index_name}_{shard_id}_alloc")
         self.reader = ShardReader(mapper, index_name=index_name)
+        # shard attribution for the scanned-bytes heat map
+        # (telemetry/scan.py, ISSUE 14): the reader is what the
+        # executor sees, so it carries the shard id
+        self.reader.shard_id = shard_id
         self.executor = SearchExecutor(self.reader)
         self._sync_reader()
 

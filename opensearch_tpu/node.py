@@ -143,7 +143,13 @@ class Node:
             # recorder + segment-churn ledger, OFF by default like the
             # tracer/ledger/flight gates
             ingest=_tel_bool("telemetry.ingest.enabled"),
-            churn=_tel_bool("telemetry.churn.enabled"))
+            churn=_tel_bool("telemetry.churn.enabled"),
+            # sharded-serving observability (ISSUE 14): per-device
+            # ledger + SPMD collective-phase timeline, OFF by default
+            # like every other gate (the scan counters are always-on
+            # and take no setting)
+            devices=_tel_bool("telemetry.devices.enabled"),
+            spmd_timeline=_tel_bool("telemetry.spmd_timeline.enabled"))
         self.controller = RestController()
         from opensearch_tpu.rest.actions import register_all
         register_all(self)

@@ -49,6 +49,19 @@ import dataclasses
 TRANSFER_BYTES = [0]    # shared-state-ok: test-only accounting slot; the int write is GIL-atomic and tests serialize
 
 
+def mesh_device_split(mesh: Mesh, nbytes: int):
+    """Equal per-device byte shares of a leading-axis-sharded upload
+    [(device_id, nbytes), ...], summing EXACTLY to `nbytes` (the
+    remainder lands on the first device) — the conservation invariant
+    the per-device ledger table is pinned against. Equal shares are
+    exact for this module's uploads: every stacked leading axis is
+    n_devices × rows_per_dev."""
+    devs = [int(d.id) for d in mesh.devices.flatten()]
+    share, rem = divmod(int(nbytes), len(devs))
+    return [(d, share + (rem if i == 0 else 0))
+            for i, d in enumerate(devs)]
+
+
 def _device_put_sharded_tree(tree, mesh: Mesh, axis: str,
                              channel: str = "upload.corpus"):
     """Upload a stacked host pytree to device HBM, leading axis sharded
@@ -56,7 +69,9 @@ def _device_put_sharded_tree(tree, mesh: Mesh, axis: str,
     TRANSFER_BYTES test slot and on the transfer ledger's named channel
     (`upload.corpus` for shard-set builds, `upload.literals` for
     per-query flat inputs), so the SPMD path's h2d traffic shows up in
-    `GET /_telemetry/transfers` like the host loop's does."""
+    `GET /_telemetry/transfers` like the host loop's does. When the
+    per-device ledger is on (ISSUE 14), the record carries the exact
+    per-device byte split of the sharded upload."""
     from opensearch_tpu.telemetry import TELEMETRY
     sharding = NamedSharding(mesh, P(axis))
     leaves, treedef = jax.tree_util.tree_flatten(tree)
@@ -64,7 +79,10 @@ def _device_put_sharded_tree(tree, mesh: Mesh, axis: str,
     scope = ledger.current()
     nbytes = sum(np.asarray(l).nbytes for l in leaves)
     if ledger.enabled or scope is not None:
-        ledger.record(channel, "h2d", nbytes, scope=scope)
+        splits = mesh_device_split(mesh, nbytes) \
+            if ledger.devices.enabled else None
+        ledger.record(channel, "h2d", nbytes, scope=scope,
+                      devices=splits)
     TRANSFER_BYTES[0] += nbytes
     put = [jax.device_put(np.asarray(l), sharding) for l in leaves]
     return jax.tree_util.tree_unflatten(treedef, put)
@@ -247,6 +265,17 @@ class HbmShardSet:
         self.seg_stack = _device_put_sharded_tree(
             stack, searcher.mesh, searcher.axis)
         self.shapes = _tree_shapes(self.seg_stack)
+        # per-device HBM accounting (ISSUE 14): the stacked image's
+        # exact per-device split on the device-memory gauges — released
+        # by the residency cache (search/spmd.py) at eviction
+        from opensearch_tpu.telemetry import TELEMETRY
+        self.nbytes = sum(
+            int(np.prod(v.shape)) * np.dtype(v.dtype).itemsize
+            for _, v in jax.tree_util.tree_flatten_with_path(
+                self.seg_stack)[0])
+        TELEMETRY.device_memory.register(
+            "spmd_shard_sets", id(self), self.nbytes,
+            devices=mesh_device_split(self.mesh, self.nbytes))
 
 
 class DistributedSearcher:
@@ -396,7 +425,8 @@ class DistributedSearcher:
                         flat_inputs: Sequence[List[Dict]], plan: Plan,
                         k: int, min_score: float = float(NEG_INF),
                         agg_plans: Tuple = (),
-                        sort_spec: Optional[Tuple[str, str]] = None):
+                        sort_spec: Optional[Tuple[str, str]] = None,
+                        device_scope=None):
         """Run the distributed query phase against HBM-resident segments:
         only the flat plan inputs (query constants — term ids, weights,
         range bounds) travel host→device per query.
@@ -405,6 +435,16 @@ class DistributedSearcher:
         inner vmap; the intra-device merge happens before the ICI
         gather). sort_spec=(numeric_field, order) merges by decoded field
         value instead of score.
+
+        `device_scope` (a telemetry DeviceScope or None, ISSUE 14)
+        collects the per-chip phase breakdown: flat-input upload wall,
+        per-device dispatch→replica-ready walls (blocked in device
+        order — the collective aligns chips at the merge, so the walls
+        bound each chip's partial top-k + its wait at the gather, and
+        the max−median SKEW is the straggler signal), the analytic
+        collective-merge bytes (k_local × 3 channels × 4 B over the
+        mesh — program statics, never a device sync), and the result
+        pull.
 
         Returns (merged_keys [<=k], scores [<=k], row_idx [<=k],
         local_ords [<=k], total, per-row agg partial outputs). Agg
@@ -429,6 +469,8 @@ class DistributedSearcher:
         # eligible, so they add no candidates, no totals, empty aggs
         min_scores = np.full(r_pad, np.inf, np.float32)
         min_scores[:shard_set.n_rows] = min_score
+        import time as _time
+        t_up = _time.monotonic() if device_scope is not None else 0.0
         flat_stack = pad_stack_trees(flat_inputs)
         flat_stack = _device_put_sharded_tree(flat_stack, self.mesh,
                                               self.axis,
@@ -436,6 +478,15 @@ class DistributedSearcher:
         min_stack = _device_put_sharded_tree(min_scores, self.mesh,
                                              self.axis,
                                              channel="upload.literals")
+        if device_scope is not None:
+            device_scope.devices = self.n_shards
+            device_scope.rows = shard_set.n_rows
+            device_scope.upload_ms = \
+                (_time.monotonic() - t_up) * 1000
+            device_scope.upload_bytes = sum(
+                np.asarray(v).nbytes  # sync-ok: host -- flat inputs are host leaves pre-upload
+                for flat in flat_inputs for d in flat
+                for v in d.values())
         cache_key = (plan_struct(plan),
                      tuple(plan_struct(a) for a in agg_plans),
                      shard_set.shapes, _tree_shapes(flat_stack))
@@ -444,7 +495,6 @@ class DistributedSearcher:
         # collect under an attributed region: the np.asarray conversions
         # ARE the d2h sync of the SPMD path (there is no jax.device_get
         # here), and the ledger decomposes them as its own channel
-        import time as _time
         from opensearch_tpu.telemetry import TELEMETRY
         ledger = TELEMETRY.ledger
         scope = ledger.current()
@@ -458,19 +508,76 @@ class DistributedSearcher:
             # device_get) are the collect wall
             keys, scores, gids, total, agg_outs = fn(
                 shard_set.seg_stack, flat_stack, min_stack)
-            t0 = _time.monotonic() if accounting else 0.0
+            # ONE post-dispatch clock (t0) for both the per-chip walls
+            # and note_device_get below: a cold call's synchronous XLA
+            # compile (seconds) must not read as a straggling chip, and
+            # the ledger's collect wall must measure the same interval
+            # whether or not the device gate is on — the per-chip
+            # blocks merely move wait out of the np.asarray conversions,
+            # they must not shrink the recorded d2h wall
+            t0 = _time.monotonic() \
+                if accounting or device_scope is not None else 0.0
+            t_disp = t0
+            if device_scope is not None:
+                # per-chip walls: block on each device's replica of the
+                # merged keys in device order — device d's replica is
+                # ready when ITS slice of the program (partial top-k +
+                # its side of the collective) finished. Walls of chips
+                # later in the order include any wait for earlier
+                # chips' blocks; the MAX (the straggler) is exact, so
+                # max − median remains an honest skew lower bound.
+                k_eff = min(k, meta.d_pad)
+                k_local = min(k, rpd * k_eff)
+                n = self.n_shards
+                try:
+                    shards = sorted(keys.addressable_shards,
+                                    key=lambda s: s.device.id)
+                    for sh in shards:
+                        sh.data.block_until_ready()  # sync-ok: gated device-phase capture -- the result is fetched right below anyway
+                        device_scope.partials.append(
+                            (int(sh.device.id),
+                             (_time.monotonic() - t_disp) * 1000))
+                except (AttributeError, TypeError):
+                    # backend without addressable_shards: whole-array
+                    # wall attributed to the first mesh device
+                    jax.block_until_ready(keys)  # sync-ok: gated device-phase capture -- the result is fetched right below anyway
+                    device_scope.partials.append(
+                        (int(self.mesh.devices.flatten()[0].id),
+                         (_time.monotonic() - t_disp) * 1000))
+                # analytic collective-merge accounting from program
+                # statics: each device gathers 3 channels (keys, gids,
+                # scores) × k_local × 4 B from every mesh device, plus
+                # the psum'd total
+                per_dev_payload = 3 * 4 * k_local * n + 4
+                device_scope.merge_payload_bytes = per_dev_payload * n
+                device_scope.merge_ici_bytes = \
+                    3 * 4 * k_local * n * (n - 1)
+            # the scope's pull wall starts AFTER the per-chip blocks
+            # (it isolates the host-copy cost the blocks can't absorb)
+            t_pull = _time.monotonic() if device_scope is not None \
+                else t0
             keys = np.asarray(keys)
             scores = np.asarray(scores)
             gids = np.asarray(gids)
             total = int(total)
             agg_outs = jax.tree_util.tree_map(np.asarray, agg_outs)
+        nb = keys.nbytes + scores.nbytes + gids.nbytes + 8 + sum(
+            a.nbytes for a in jax.tree_util.tree_leaves(agg_outs)) \
+            if (accounting or device_scope is not None) else 0
+        pull_dev = int(self.mesh.devices.flatten()[0].id)
         if accounting:
-            nb = keys.nbytes + scores.nbytes + gids.nbytes + 8 + sum(
-                a.nbytes for a in jax.tree_util.tree_leaves(agg_outs))
+            # the replicated result page is pulled from the first mesh
+            # device — the per-device table attributes it there
             ledger.record("spmd.results", "d2h", nb,
-                          wave=ledger.new_wave(), scope=scope)
+                          wave=ledger.new_wave(), scope=scope,
+                          devices=[(pull_dev, nb)]
+                          if ledger.devices.enabled else None)
             ledger.note_device_get((_time.monotonic() - t0) * 1000,
                                    nbytes=nb, scope=scope)
+        if device_scope is not None:
+            device_scope.pull_ms = (_time.monotonic() - t_pull) * 1000
+            device_scope.pull_bytes = nb
+            device_scope.pull_device = pull_dev
         row_idx = gids // meta.d_pad
         ords = gids % meta.d_pad
         valid = keys > NEG_INF / 2

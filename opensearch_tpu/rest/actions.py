@@ -2443,6 +2443,32 @@ def register_telemetry_actions(node, c):
         INGEST_EVENTS.clear()
         return {"acknowledged": True}
 
+    def do_get_devices(req):
+        # sharded-serving observability (ISSUE 14): per-device
+        # transfer/phase aggregates + straggler skew, next to the
+        # always-on scanned-bytes heat map (the block-max trigger
+        # metric — live regardless of any gate)
+        return {"devices": TELEMETRY.device_ledger.snapshot(),
+                "scan": TELEMETRY.scan.stats()}
+
+    def do_devices_enable(req):
+        # one switch for the sharded-serving instrumentation pair:
+        # per-device attribution AND the SPMD collective-phase
+        # timeline (they are read together in the tail reports)
+        TELEMETRY.device_ledger.enabled = True
+        TELEMETRY.spmd_timeline.enabled = True
+        return {"acknowledged": True, "enabled": True}
+
+    def do_devices_disable(req):
+        TELEMETRY.device_ledger.enabled = False
+        TELEMETRY.spmd_timeline.enabled = False
+        return {"acknowledged": True, "enabled": False}
+
+    def do_devices_clear(req):
+        TELEMETRY.device_ledger.reset()
+        TELEMETRY.scan.reset()
+        return {"acknowledged": True}
+
     c.register("GET", "/_telemetry/traces", do_get_traces)
     c.register("POST", "/_telemetry/traces/_clear", do_clear_traces)
     c.register("POST", "/_telemetry/_enable", do_enable)
@@ -2462,6 +2488,11 @@ def register_telemetry_actions(node, c):
     c.register("POST", "/_telemetry/ingest/_enable", do_ingest_enable)
     c.register("POST", "/_telemetry/ingest/_disable", do_ingest_disable)
     c.register("POST", "/_telemetry/ingest/_clear", do_ingest_clear)
+    c.register("GET", "/_telemetry/devices", do_get_devices)
+    c.register("POST", "/_telemetry/devices/_enable", do_devices_enable)
+    c.register("POST", "/_telemetry/devices/_disable",
+               do_devices_disable)
+    c.register("POST", "/_telemetry/devices/_clear", do_devices_clear)
 
 
 # -------------------------------------------------------------------- tasks

@@ -160,6 +160,13 @@ class Plan:
     static: tuple = ()
     inputs: Dict[str, np.ndarray] = dc_field(default_factory=dict)
     children: List["Plan"] = dc_field(default_factory=list)
+    # posting blocks this node's kernel gathers (text clauses: the
+    # query terms' real block lanes, padding excluded) — the always-on
+    # scanned-bytes counters (telemetry/scan.py, ISSUE 14) read it per
+    # query as blocks × 128 lanes × 8 B, the exact formula
+    # tools/scaling_bench.py evaluates offline. NOT part of sig():
+    # it is derived from the same inputs the signature already hashes.
+    scan_blocks: int = 0
 
     def sig(self):
         return (self.kind, self.static,
@@ -624,7 +631,7 @@ class Compiler:
         # kernel needs the max run length (= clause terms containing a doc)
         # to window its exact segment-sum (executor.py)
         plan = Plan("text", static=(bool(constant), len(weighted_terms)),
-                    inputs=inputs)
+                    inputs=inputs, scan_blocks=len(ids))
         self.stats.memo[memo_key] = plan    # RotatingMemo bounds itself
         return plan
 

@@ -570,7 +570,7 @@ def _execute_search_impl(executors: List, body: Optional[dict],
                 with _PhaseTimer(trace, phases, "reduce"):
                     candidates.sort(key=_compare_candidates(sort_specs))
                 if profiling:
-                    profile_shards.append({
+                    entry = {
                         "id": f"[{executors[0].reader.index_name}][spmd]",
                         "_query_ns": qt.duration_ns,
                         "searches": [{"query": [{
@@ -581,7 +581,16 @@ def _execute_search_impl(executors: List, body: Optional[dict],
                                           "segments": len(rows)},
                         }], "rewrite_time": 0, "collector": []}],
                         "aggregations": [],
-                    })
+                    }
+                    # per-device attribution (ISSUE 14): when the
+                    # device ledger captured this query, the shard
+                    # entry carries the per-chip phase breakdown —
+                    # upload / partial(device, wall) / collective
+                    # merge / result pull + straggler skew
+                    devscope = TELEMETRY.ledger.devices.take_last()
+                    if devscope is not None:
+                        entry["devices"] = devscope.to_dict()
+                    profile_shards.append(entry)
                 return candidates, decoded_partials, total
         flags = can_match_flags()
         skipped_box[0] = len(executors) - sum(flags)

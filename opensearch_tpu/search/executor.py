@@ -1032,6 +1032,19 @@ def _topk_or_empty(masked, k_eff: int):
 # scatter+top_k path wins (bitonic sort is O(N log^2 N))
 CANDIDATE_MAX_LANES = 1 << 14
 
+# the candidate-buffer kernel's exact-windowed segment sum needs the
+# distinct-term count bounded; beyond this the dense kernel serves
+CANDIDATE_MAX_TERMS = 16
+
+
+def _candidate_kernel_fits(kind: str, n_terms: int, qb_lanes: int) -> bool:
+    """THE candidate-vs-dense decision, shared by _envelope_runner
+    (which kernel compiles) and _envelope_kernel (what the scan heat
+    map records) so the telemetry's kernel-mix column can never drift
+    from the kernel that actually dispatches."""
+    return kind == "text" and n_terms <= CANDIDATE_MAX_TERMS \
+        and 0 < qb_lanes <= CANDIDATE_MAX_LANES
+
 
 def build_candidate_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
                                 layout, treedef):
@@ -1313,15 +1326,14 @@ def _envelope_runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int,
     key = ("env", plan_sig, meta.compile_key(), k, layout, treedef)
     fn = _JIT_CACHE.get(key)
     if fn is None:
-        qb128 = None
+        qb128 = 0
         n_terms = plan.static[1] if plan.kind == "text" \
             and len(plan.static) > 1 else 1 << 30
-        if plan.kind == "text" and n_terms <= 16:
-            for off, shape, dtype in layout:
-                if len(shape) == 2:     # first [B, QB] leaf
-                    qb128 = shape[1] * 128
-                    break
-        if qb128 is not None and qb128 <= CANDIDATE_MAX_LANES:
+        for off, shape, dtype in layout:
+            if len(shape) == 2:         # first [B, QB] leaf
+                qb128 = shape[1] * 128
+                break
+        if _candidate_kernel_fits(plan.kind, n_terms, qb128):
             fn = jax.jit(build_candidate_query_phase(plan, meta, k,
                                                      layout, treedef))
         else:
@@ -1330,6 +1342,53 @@ def _envelope_runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int,
         _JIT_CACHE[key] = fn  # shared-state-ok: benign double-jit race; dict slot write is GIL-atomic
         fn = _timed_first_call(fn)
     return fn
+
+
+def _envelope_kernel(plan: Plan) -> str:
+    """The kernel class _envelope_runner picks for one item's plan —
+    `candidate` (candidate-buffer kernel) or `dense` — via the SAME
+    `_candidate_kernel_fits` predicate the runner compiles with, so
+    the scan heat map's kernel mix matches what dispatches. The lane
+    count comes from the plan's `ids` input, which IS the packed
+    layout's [B, QB] leaf width (the compiler pre-buckets shapes)."""
+    n_terms = plan.static[1] if plan.kind == "text" \
+        and len(plan.static) > 1 else 1 << 30
+    ids = plan.inputs.get("ids")
+    qb128 = ids.shape[-1] * 128 if ids is not None else 0
+    return "candidate" \
+        if _candidate_kernel_fits(plan.kind, n_terms, qb128) else "dense"
+
+
+def _scan_accumulate_item(device, plans, seg_rows, per_query) -> None:
+    """Always-on scan accounting for ONE msearch item (ISSUE 14),
+    accumulated LOCALLY (plain dict adds on the wave's own state — no
+    lock, no estimator): per compiled segment plan, posting-block
+    bytes from the plan statics and — only when the dense kernel runs
+    — the O(d_pad) dense-lane bytes the candidate-buffer kernel exists
+    to avoid. `SCAN.note_batch` lands the whole wave in one flush."""
+    from opensearch_tpu.telemetry.scan import (
+        DENSE_LANE_BYTES, POSTING_BLOCK_BYTES, plan_scan_blocks)
+    q_posting = q_dense = 0
+    noted = False
+    for plan, (_, meta) in zip(plans, device):
+        if plan is None or plan.kind == "match_none":
+            continue
+        posting = plan_scan_blocks(plan) * POSTING_BLOCK_BYTES
+        kernel = _envelope_kernel(plan)
+        dense = 0 if kernel == "candidate" \
+            else meta.d_pad * DENSE_LANE_BYTES
+        row = seg_rows.get(meta.seg_id)
+        if row is None:
+            row = seg_rows[meta.seg_id] = [0, 0, 0, {}]
+        row[0] += 1
+        row[1] += posting
+        row[2] += dense
+        row[3][kernel] = row[3].get(kernel, 0) + 1
+        q_posting += posting
+        q_dense += dense
+        noted = True
+    if noted:
+        per_query.append((q_posting, q_dense))
 
 
 def _runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int, sort_mode: str,
@@ -1728,6 +1787,11 @@ class SearchExecutor:
             _THREAD_COMPILES.ms = 0.0
             plan_compile_ns = dispatch_ns = 0
         launched = []
+        from opensearch_tpu.telemetry.scan import (
+            DENSE_LANE_BYTES, POSTING_BLOCK_BYTES, SCAN,
+            plan_scan_blocks)
+        scan_shard = str(getattr(self.reader, "shard_id", 0))
+        q_posting = q_dense = 0
         from opensearch_tpu.indices.query_cache import FilterCacheContext
         for seg_i, (seg, (arrays, meta)) in enumerate(
                 zip(segments, device)):
@@ -1742,6 +1806,16 @@ class SearchExecutor:
                                      meta, compiler) if agg_nodes else []
             if rec:
                 plan_compile_ns += time.perf_counter_ns() - t0
+            # always-on scan accounting (telemetry/scan.py, ISSUE 14):
+            # this path runs the DENSE kernel (build_query_phase) —
+            # posting blocks gathered per the plan statics plus the
+            # O(d_pad) dense lanes, attributed per (shard, segment)
+            posting = plan_scan_blocks(plan) * POSTING_BLOCK_BYTES
+            dense = meta.d_pad * DENSE_LANE_BYTES
+            SCAN.note_segment(self.reader.index_name, scan_shard,
+                              meta.seg_id, posting, dense, "dense")
+            q_posting += posting
+            q_dense += dense
             sort_key = _build_sort_key(arrays, primary)
             fn = _runner(plan.sig(), plan, meta,
                          min(k_fetch, pad_bucket(max(seg.num_docs, 1))),
@@ -1774,6 +1848,9 @@ class SearchExecutor:
                                  trace=trace)))
             if rec:
                 dispatch_ns += time.perf_counter_ns() - t0
+
+        if launched:
+            SCAN.note_query(q_posting, q_dense)
 
         def _collect():
             if faults.ENABLED:
@@ -2736,6 +2813,11 @@ class SearchExecutor:
         this path) and relies on deterministic dict insertion order."""
         _t = time.monotonic()
         groups: Dict[Any, List[int]] = {}
+        # always-on scan accounting (telemetry/scan.py, ISSUE 14):
+        # per-wave LOCAL accumulators, flushed in ONE note_batch call
+        # below — the disabled-lock discipline the <2% gate demands
+        _scan_rows: Dict[Any, list] = {}
+        _scan_per_query: List = []
         compiled: Dict[int, List[Optional[Plan]]] = {}
         flats_by_i: Dict[int, List[Optional[list]]] = {}
         agg_by_i: Dict[int, List[list]] = {}      # i -> per-seg AggPlans
@@ -2820,7 +2902,19 @@ class SearchExecutor:
                 agg_nodes_by_i[i] = agg_nodes
             groups.setdefault((struct, agg_sig, shape_sig,
                                min(k, 1 << 16)), []).append(i)
+            # per-item posting/dense bytes from the compiled plans —
+            # the kernel split mirrors _envelope_runner's decision
+            # (candidate-buffer for plain text clauses within the lane
+            # budget, dense otherwise), so the heat map's kernel mix
+            # reflects what actually dispatches. One attribute read
+            # per warm (memoized) plan, no per-lane work, no lock.
+            _scan_accumulate_item(device, plans, _scan_rows,
+                                  _scan_per_query)
 
+        from opensearch_tpu.telemetry.scan import SCAN
+        SCAN.note_batch(self.reader.index_name,
+                        str(getattr(self.reader, "shard_id", 0)),
+                        _scan_rows, _scan_per_query)
         entry_by_i = {e[0]: e for e in batchable}
         ph["compile_group"] += time.monotonic() - _t
         _t = time.monotonic()

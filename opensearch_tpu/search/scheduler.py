@@ -550,10 +550,16 @@ class WaveScheduler:
         from opensearch_tpu.telemetry import TELEMETRY
         TELEMETRY.metrics.counter("scheduler.dispatches").inc()
         TELEMETRY.metrics.histogram("scheduler.co_batched").observe(n)
+        # per-tenant byte attribution rides the ledger: when it is on,
+        # the envelope fills phase_times with the wave's fetched bytes,
+        # split below proportionally like the wall (None keeps the
+        # disabled path at one attribute load + branch)
+        pt = {} if TELEMETRY.ledger.enabled else None
         t0 = time.monotonic()
         try:
             res = live[0].target.multi_search(
-                bodies, deadline=group_deadline, timelines=timelines)
+                bodies, deadline=group_deadline, timelines=timelines,
+                phase_times=pt)
             responses = res["responses"]
         except BaseException as e:  # except-ok: waiter wakeup -- a dispatch failure delivers the error to every blocked request thread instead of stranding them on the Event
             for item in live:
@@ -562,10 +568,25 @@ class WaveScheduler:
             return
         wall_ms = (time.monotonic() - t0) * 1000.0
         self.service_est.observe(wall_ms / max(n, 1))
+        wave_bytes = int(pt.get("bytes_fetched", 0)) if pt else 0
         off = 0
         for item in live:
             item.responses = responses[off:off + len(item.bodies)]
             off += len(item.bodies)
+            # per-tenant resource attribution (ISSUE 14): the shared
+            # wave's device wall (and fetched bytes) split across its
+            # co-batched owners by item count — each request's
+            # `device_share_ms` lifecycle field plus the per-tenant
+            # totals the admission `usage` block accumulates
+            n_items = len(item.bodies)
+            share_ms = wall_ms * n_items / n
+            if item.timeline is not None:
+                item.timeline.device_share(share_ms, wall_ms, n)
+            if self.admission is not None:
+                self.admission.note_usage(
+                    item.tenant, share_ms,
+                    d2h_bytes=wave_bytes * n_items // n,
+                    items=n_items)
             if item.timeline is not None:
                 # response assembled HERE: complete() turns the
                 # ready→completed interval into the `handoff` phase —

@@ -207,6 +207,12 @@ def spmd_query_phase(executors: List, body: dict, k: int,
         REQUEST_CACHE, cache_key, cacheable)
     from opensearch_tpu.search.executor import _Candidate
 
+    if TELEMETRY.ledger.devices.enabled:
+        # drop any stale thread-local device scope from an earlier
+        # query: a request-cache hit below executes nothing, and the
+        # Profile API must not inherit another query's breakdown
+        TELEMETRY.ledger.devices.take_last()
+
     key = None
     if cacheable(body):
         all_segs = [executors[s].reader.segments[g] for s, g in rows]
@@ -246,10 +252,16 @@ def _spmd_query_phase_raw(executors: List, body: dict, k: int,
     # one plan (+ agg plans) per row; all rows must share one structure
     all_stats = [ex.reader.stats() for ex in executors]
     plans, agg_plans_rows, flat_rows = [], [], []
+    row_metas = []      # per-row meta captured HERE, the one read of
+    # reader.device this query makes — the scan accounting below must
+    # not re-read the live reader after the program ran (a concurrent
+    # refresh/merge republish would mispair seg_i, or shrink the list
+    # out from under the index — the PR 13 pairing hazard)
     for shard_i, seg_i in rows:
         ex = executors[shard_i]
         seg = ex.reader.segments[seg_i]
         arrays, meta = ex.reader.device[seg_i]
+        row_metas.append(meta)
         compiler = Compiler(ex.reader.mapper, all_stats[shard_i])
         q = node
         extra = extra_filters[shard_i] if extra_filters else None
@@ -295,17 +307,64 @@ def _spmd_query_phase_raw(executors: List, body: dict, k: int,
     if sort_spec is False:
         return None
 
+    # sharded-serving observability (ISSUE 14): the per-device phase
+    # capture rides two gates — the device ledger (node-wide per-chip
+    # aggregates + straggler skew) and the SPMD timeline (fanout/
+    # partial/merge events on the request's lifecycle timeline). Either
+    # being open allocates ONE DeviceScope; both closed costs two
+    # attribute loads and branches.
+    devledger = TELEMETRY.ledger.devices
+    devscope = devledger.scope()
+    tl = None
+    if TELEMETRY.spmd_timeline.gate() is not None:
+        tl = TELEMETRY.flight.current()
+    cap = devscope
+    if cap is None and tl is not None:
+        from opensearch_tpu.telemetry import DeviceScope
+        cap = DeviceScope()
+
     searcher = _searcher(len(rows))
+    if tl is not None:
+        tl.event("fanout", devices=searcher.n_shards, rows=len(rows))
     try:
         shard_set = _resident_shard_set(searcher, executors, rows)
         keys, scores, row_idx, ords, total, agg_outs = \
             searcher.search_resident(
                 shard_set, flat_rows, plans[0], k, min_score=min_score,
-                agg_plans=agg_plans_rows[0], sort_spec=sort_spec)
+                agg_plans=agg_plans_rows[0], sort_spec=sort_spec,
+                device_scope=cap)
     except (ValueError, KeyError):
         # e.g. a cross-index search whose rows have mismatched field
         # layouts (canonical_meta rejects them) — host loop handles it
         return None
+
+    # always-on scan accounting (telemetry/scan.py): every row of the
+    # SPMD program gathers its plan's posting blocks and evaluates the
+    # dense per-doc vector — the same byte model SCALING.md priced,
+    # attributed per (index, shard, segment) and summed per query
+    from opensearch_tpu.telemetry.scan import (
+        DENSE_LANE_BYTES, POSTING_BLOCK_BYTES, SCAN, plan_scan_blocks)
+    q_posting = q_dense = 0
+    for plan_r, meta_r, (shard_i, seg_i) in zip(plans, row_metas, rows):
+        ex = executors[shard_i]
+        posting = plan_scan_blocks(plan_r) * POSTING_BLOCK_BYTES
+        dense = meta_r.d_pad * DENSE_LANE_BYTES
+        SCAN.note_segment(ex.reader.index_name, str(shard_i),
+                          meta_r.seg_id, posting, dense, "spmd")
+        q_posting += posting
+        q_dense += dense
+    SCAN.note_query(q_posting, q_dense)
+
+    if cap is not None:
+        if tl is not None:
+            for dev, wall in cap.partials:
+                tl.event("partial", device=dev, ms=round(wall, 3))
+            tl.event("merge", skew_ms=round(cap.skew_ms(), 3),
+                     straggler=cap.straggler(),
+                     ici_bytes=cap.merge_ici_bytes,
+                     pull_ms=round(cap.pull_ms, 3))
+        if devscope is not None:
+            devledger.note_query(devscope)
 
     cand_tuples = []
     for score, row_i, ord_ in zip(scores, row_idx, ords):
@@ -365,8 +424,18 @@ def _resident_shard_set(searcher, executors, rows):
         metas.append(m)
     shard_set = searcher.build_shard_set(arrays, metas)
     SPMD_UPLOADS.inc()
+    evicted = None
     with _SPMD_LOCK:
-        if len(_SHARD_SETS) >= _MAX_SHARD_SETS:
-            _SHARD_SETS.pop(next(iter(_SHARD_SETS)))
+        # a racing builder may have inserted this key already (the
+        # documented build-outside-the-lock race): replacing it must
+        # release ITS gauge too, and must not evict an unrelated entry
+        evicted = _SHARD_SETS.pop(key, None)
+        if evicted is None and len(_SHARD_SETS) >= _MAX_SHARD_SETS:
+            evicted = _SHARD_SETS.pop(next(iter(_SHARD_SETS)))
         _SHARD_SETS[key] = shard_set
+    if evicted is not None:
+        # the residency cache owns the shard set's device-memory gauge
+        # (HbmShardSet registers at build): release at eviction so the
+        # spmd_shard_sets class tracks LIVE HBM, not history
+        TELEMETRY.device_memory.release("spmd_shard_sets", id(evicted))
     return shard_set

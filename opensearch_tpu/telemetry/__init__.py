@@ -41,13 +41,14 @@ import os
 from typing import Optional
 
 from opensearch_tpu.telemetry.ledger import (
-    ChurnLedger, ChurnScope, DeviceMemoryAccounting, LedgerScope,
-    TransferLedger)
+    ChurnLedger, ChurnScope, DeviceLedger, DeviceMemoryAccounting,
+    DeviceScope, LedgerScope, TransferLedger)
 from opensearch_tpu.telemetry.lifecycle import (
     INGEST_EVENTS, FlightRecorder, IngestEventLog, IngestRecorder,
-    Timeline)
+    SpmdTimeline, Timeline)
 from opensearch_tpu.telemetry.metrics import MetricsRegistry
 from opensearch_tpu.telemetry.rolling import RollingEstimator
+from opensearch_tpu.telemetry.scan import SCAN, ScanAccounting
 from opensearch_tpu.telemetry.tracer import (
     DEFAULT_RING_SIZE, NOOP_SPAN, Span, Tracer)
 
@@ -56,7 +57,8 @@ __all__ = ["TELEMETRY", "TelemetryService", "Span", "NOOP_SPAN",
            "DeviceMemoryAccounting", "RollingEstimator",
            "FlightRecorder", "Timeline", "IngestRecorder",
            "IngestEventLog", "INGEST_EVENTS", "ChurnLedger",
-           "ChurnScope"]
+           "ChurnScope", "DeviceLedger", "DeviceScope", "SpmdTimeline",
+           "ScanAccounting", "SCAN"]
 
 
 class TelemetryService:
@@ -75,13 +77,24 @@ class TelemetryService:
         # lifecycle module singleton (INGEST_EVENTS)
         self.ingest = IngestRecorder()
         self.churn = ChurnLedger()
+        # sharded-serving observability (ISSUE 14): the per-device
+        # ledger rides the transfer ledger (its `device` dimension);
+        # the SPMD collective-phase timeline emitter is its own gate;
+        # the scan counters are ALWAYS-ON (the block-max trigger metric
+        # — inflight-wave-gauge contract, not the per-request gate
+        # discipline)
+        self.device_ledger = self.ledger.devices
+        self.spmd_timeline = SpmdTimeline()
+        self.scan = SCAN
 
     def configure(self, data_path: Optional[str] = None,
                   enabled: bool = False, jsonl: bool = False,
                   ring_size: int = DEFAULT_RING_SIZE,
                   transfers: bool = False, tail: bool = False,
                   tail_threshold_ms: Optional[float] = None,
-                  ingest: bool = False, churn: bool = False) -> None:
+                  ingest: bool = False, churn: bool = False,
+                  devices: bool = False,
+                  spmd_timeline: bool = False) -> None:
         """Bind to a node's settings/data dir. Called from Node.__init__;
         re-configuration by a later Node in the same process wins (the
         singleton is process-wide, like WARMUP)."""
@@ -91,6 +104,8 @@ class TelemetryService:
         self.flight.threshold_ms = tail_threshold_ms
         self.ingest.enabled = bool(ingest)
         self.churn.enabled = bool(churn)
+        self.device_ledger.enabled = bool(devices)
+        self.spmd_timeline.enabled = bool(spmd_timeline)
         self.tracer.resize(ring_size)
         self.tracer.jsonl_path = None
         self.flight.jsonl_path = None
@@ -120,7 +135,12 @@ class TelemetryService:
                 # the write-path block (ISSUE 13): ingest lifecycle +
                 # engine event log + segment-churn attribution
                 "indexing": {"ingest": self.ingest.stats(),
-                             "churn": self.churn.snapshot()}}
+                             "churn": self.churn.snapshot()},
+                # sharded-serving observability (ISSUE 14): per-chip
+                # attribution + the always-on scanned-bytes heat map
+                # (the block-max trigger metric, live)
+                "devices": self.device_ledger.snapshot(),
+                "scan": self.scan.stats()}
 
 
 # process-wide singleton, like REQUEST_CACHE / QUERY_CACHE / WARMUP

@@ -44,6 +44,249 @@ from opensearch_tpu.telemetry.rolling import RollingEstimator
 H2D = "h2d"
 D2H = "d2h"
 
+# the host loop and envelope path talk to exactly one device; their
+# transfers attribute to it so the per-device table always conserves
+# against the channel totals (ISSUE 14's pinned invariant)
+DEFAULT_DEVICE = 0
+
+# a query only NAMES a straggler when its per-chip skew clears this
+# floor: the per-device walls are measured by blocking replicas in
+# device order, so sub-millisecond "skew" is block-ordering noise that
+# would otherwise pin every straggler_hit on the last-blocked chip
+# (tools/bench_compare.py's skew gate uses the same 1 ms floor)
+STRAGGLER_FLOOR_MS = 1.0
+
+
+class DeviceScope:
+    """Per-query per-device accumulator for the SPMD serving path
+    (ISSUE 14): the phase breakdown FLASH-MAXSIM's IO-aware framing
+    asks for — where and when the bytes moved, per chip.
+
+    Filled by DistributedSearcher.search_resident on the request
+    thread:
+      - `upload_ms` / `upload_bytes`: the per-query flat-input upload
+        (h2d wall measured on host; bytes split per device);
+      - `partials`: [(device_id, wall_ms)] — per-chip dispatch→done
+        wall, measured by blocking on each device's replica of the
+        merged output in device order. The collective aligns chips at
+        the merge, so these walls bound each chip's partial top-k
+        compute + its wait at the gather; the SKEW (max − median) is
+        the straggler signal even when the absolute walls overlap;
+      - `merge_*`: the analytic collective-merge accounting — payload
+        gathered per device and total ICI bytes (k_local × 3 channels
+        × 4 B over the mesh), computed from program statics, never a
+        device sync;
+      - `pull_ms` / `pull_bytes` / `pull_device`: the result-page
+        fetch (the np.asarray d2h sync)."""
+
+    __slots__ = ("devices", "rows", "upload_ms", "upload_bytes",
+                 "partials", "merge_payload_bytes", "merge_ici_bytes",
+                 "pull_ms", "pull_bytes", "pull_device")
+
+    def __init__(self):
+        self.devices = 0
+        self.rows = 0
+        self.upload_ms = 0.0
+        self.upload_bytes = 0
+        self.partials: List[Tuple[int, float]] = []
+        self.merge_payload_bytes = 0
+        self.merge_ici_bytes = 0
+        self.pull_ms = 0.0
+        self.pull_bytes = 0
+        self.pull_device = DEFAULT_DEVICE
+
+    def skew_ms(self) -> float:
+        """Straggler skew: max − median per-chip wall for this query
+        (0 for a single-chip mesh — there is nobody to straggle
+        behind). LOWER median for even chip counts: the upper median
+        of two walls IS the max, which would make skew identically 0
+        on a 2-chip mesh and structurally blind its straggler gate."""
+        if len(self.partials) < 2:
+            return 0.0
+        walls = sorted(w for _, w in self.partials)
+        return walls[-1] - walls[(len(walls) - 1) // 2]
+
+    def straggler(self) -> Optional[int]:
+        """The device id with the max per-chip wall — None when fewer
+        than two chips reported OR the skew sits under
+        STRAGGLER_FLOOR_MS (naming a straggler out of block-ordering
+        noise would pin every hit on the last-blocked chip)."""
+        if len(self.partials) < 2 \
+                or self.skew_ms() < STRAGGLER_FLOOR_MS:
+            return None
+        return max(self.partials, key=lambda p: p[1])[0]
+
+    def to_dict(self) -> dict:
+        """JSON-able phase breakdown — the shape the Profile API's
+        SPMD shard entry, the timeline `merge` event and the scaling
+        bench all read."""
+        return {
+            "devices": self.devices,
+            "rows": self.rows,
+            "upload_ms": round(self.upload_ms, 3),
+            "upload_bytes": self.upload_bytes,
+            "partials": [{"device": d, "wall_ms": round(w, 3)}
+                         for d, w in self.partials],
+            "straggler_skew_ms": round(self.skew_ms(), 3),
+            "straggler": self.straggler(),
+            "collective": {
+                "payload_bytes_per_device": self.merge_payload_bytes
+                // max(self.devices, 1),
+                "payload_bytes": self.merge_payload_bytes,
+                "ici_bytes": self.merge_ici_bytes,
+            },
+            "pull_ms": round(self.pull_ms, 3),
+            "pull_bytes": self.pull_bytes,
+            "pull_device": self.pull_device,
+        }
+
+
+class DeviceLedger:
+    """Per-device attribution for sharded serving (ISSUE 14): the
+    `device` dimension on transfer records, the per-chip SPMD phase
+    aggregates, and the straggler-skew rolling estimator — the
+    measurement layer ROADMAP item 4's multi-chip scale-out is judged
+    against, surfaced as `telemetry.devices` on `_nodes/stats`.
+
+    No-op discipline (tracer/ledger/faults contract, gate-lint registry
+    row, asserted by bench.py): OFF by default, the per-query gate is
+    `scope()` returning None — the disabled SPMD path costs one
+    attribute load and a branch, and the disabled TransferLedger.record
+    path never touches the per-device table.
+
+    Conservation invariant (pinned by tests/test_device_ledger.py):
+    for every channel, the sum of per-device bytes equals the channel
+    total in TransferLedger — transfers without an explicit device
+    split attribute to DEFAULT_DEVICE (the only device the host loop
+    talks to), so nothing ever leaks out of the table."""
+
+    def __init__(self):
+        self.enabled = False
+        self._lock = threading.Lock()
+        # device id -> {channel: {"h2d": bytes, "d2h": bytes}}
+        self._transfers: Dict[int, Dict[str, Dict[str, int]]] = {}
+        # device id -> per-chip phase aggregates
+        self._phases: Dict[int, Dict[str, float]] = {}
+        self.queries = 0
+        self.collective_payload_bytes = 0
+        self.collective_ici_bytes = 0
+        self.skew = RollingEstimator()
+        self.partial_wall = RollingEstimator()
+        self._tls = threading.local()
+
+    # ------------------------------------------------------------- hot path
+
+    def scope(self) -> Optional[DeviceScope]:
+        """The per-query gate: a DeviceScope when per-device
+        attribution is on, else None — search/spmd.py guards its whole
+        capture block with `if scope is not None`."""
+        if not self.enabled:
+            return None
+        return DeviceScope()
+
+    def note_transfer(self, channel: str, direction: str,
+                      splits: List[Tuple[int, int]]) -> None:
+        """Per-device byte rows for one transfer; `splits` must sum to
+        the transfer's channel-recorded bytes (the conservation
+        invariant). Called by TransferLedger.record under the enabled
+        guard."""
+        with self._lock:
+            for dev, nbytes in splits:
+                chans = self._transfers.setdefault(int(dev), {})
+                ent = chans.get(channel)
+                if ent is None:
+                    ent = chans[channel] = {H2D: 0, D2H: 0}
+                ent[direction] += int(nbytes)
+
+    def note_query(self, scope: DeviceScope) -> None:
+        """Fold one query's DeviceScope into the node-wide per-chip
+        aggregates + the straggler estimators, and stash it as the
+        thread's `last` for the Profile API (the SPMD query phase and
+        the profile assembly run on the same request thread)."""
+        skew = scope.skew_ms()
+        straggler = scope.straggler()
+        with self._lock:
+            self.queries += 1
+            self.collective_payload_bytes += scope.merge_payload_bytes
+            self.collective_ici_bytes += scope.merge_ici_bytes
+            for dev, wall in scope.partials:
+                ph = self._phases.get(dev)
+                if ph is None:
+                    ph = self._phases[dev] = {
+                        "queries": 0, "partial_ms": 0.0,
+                        "straggler_hits": 0}
+                ph["queries"] += 1
+                ph["partial_ms"] += wall
+                if dev == straggler:
+                    ph["straggler_hits"] += 1
+        self.skew.observe(skew)
+        for _, wall in scope.partials:
+            self.partial_wall.observe(wall)
+        self._tls.last = scope
+
+    def take_last(self) -> Optional[DeviceScope]:
+        """Pop the thread's most recent query scope (profile assembly
+        reads it once; popping keeps a later request on this thread
+        from inheriting a stale breakdown)."""
+        last = getattr(self._tls, "last", None)
+        self._tls.last = None
+        return last
+
+    # --------------------------------------------------------------- reading
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            devices = {}
+            for dev in sorted(set(self._transfers) | set(self._phases)):
+                ent: Dict[str, Any] = {}
+                chans = self._transfers.get(dev)
+                if chans:
+                    ent["transfer_bytes"] = {
+                        c: dict(d) for c, d in sorted(chans.items())}
+                    ent["h2d_bytes"] = sum(d[H2D] for d in chans.values())
+                    ent["d2h_bytes"] = sum(d[D2H] for d in chans.values())
+                ph = self._phases.get(dev)
+                if ph:
+                    ent.update({"queries": int(ph["queries"]),
+                                "partial_ms":
+                                    round(ph["partial_ms"], 3),
+                                "straggler_hits":
+                                    int(ph["straggler_hits"])})
+                devices[str(dev)] = ent
+            queries = self.queries
+            payload = self.collective_payload_bytes
+            ici = self.collective_ici_bytes
+        return {
+            "enabled": self.enabled,
+            "queries": queries,
+            "devices": devices,
+            "collective": {
+                "payload_bytes_total": payload,
+                "ici_bytes_total": ici,
+                "ici_bytes_per_query":
+                    round(ici / queries, 1) if queries else 0.0,
+            },
+            "rolling": {"straggler_skew_ms": self.skew.summary(),
+                        "partial_wall_ms": self.partial_wall.summary()},
+        }
+
+    def device_bytes(self) -> Dict[int, Dict[str, Dict[str, int]]]:
+        """{device: {channel: {h2d, d2h}}} — the conservation test's
+        read side."""
+        with self._lock:
+            return {dev: {c: dict(d) for c, d in chans.items()}
+                    for dev, chans in self._transfers.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._transfers.clear()
+            self._phases.clear()
+            self.queries = 0
+            self.collective_payload_bytes = 0
+            self.collective_ici_bytes = 0
+        self.skew.reset()
+        self.partial_wall.reset()
+
 
 class LedgerScope:
     """Per-request transfer accumulator (explicit context, like spans:
@@ -108,6 +351,11 @@ class TransferLedger:
 
     def __init__(self):
         self.enabled = False
+        # per-device attribution (ISSUE 14): its own gate — a node can
+        # run channel accounting without paying the per-device table,
+        # and vice versa the device ledger implies nothing about the
+        # channel aggregates' enabled state
+        self.devices = DeviceLedger()
         self._lock = threading.Lock()
         # (channel, direction) -> [transfers, round_trips, bytes]
         self._channels: Dict[Tuple[str, str], List[int]] = {}
@@ -155,7 +403,13 @@ class TransferLedger:
 
     def record(self, channel: str, direction: str, nbytes: int,
                round_trips: int = 1, wave: Optional[int] = None,
-               scope: Optional[LedgerScope] = None) -> None:
+               scope: Optional[LedgerScope] = None,
+               devices: Optional[List[Tuple[int, int]]] = None) -> None:
+        """`devices`: optional per-device byte split [(device_id,
+        nbytes), ...] for transfers sharded over a mesh; splits must
+        sum to `nbytes` (conservation). None attributes the whole
+        transfer to DEFAULT_DEVICE when the device ledger is on — the
+        host loop and envelope path talk to exactly one device."""
         nbytes = int(nbytes)
         if scope is not None:
             scope.entries.append((channel, direction, nbytes, round_trips,
@@ -169,6 +423,11 @@ class TransferLedger:
         tag = getattr(self._tls, "tag", None)
         if tag is not None:
             channel = f"{tag}.{channel}"
+        if self.devices.enabled:
+            self.devices.note_transfer(
+                channel, direction,
+                devices if devices is not None
+                else [(DEFAULT_DEVICE, nbytes)])
         key = (channel, direction)
         with self._lock:
             ent = self._channels.get(key)
@@ -545,13 +804,22 @@ class DeviceMemoryAccounting:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._registered: Dict[str, Dict[Any, int]] = {}
+        # cls -> {key: (nbytes, per-device split or None)}
+        self._registered: Dict[str, Dict[Any, Tuple[int, Any]]] = {}
         self._gauges: Dict[str, int] = {}
         self._providers: Dict[str, Any] = {}
 
-    def register(self, cls: str, key: Any, nbytes: int) -> None:
+    def register(self, cls: str, key: Any, nbytes: int,
+                 devices: Optional[List[Tuple[int, int]]] = None) -> None:
+        """`devices`: optional per-device byte split [(device_id,
+        nbytes), ...] for allocations sharded over a mesh (ISSUE 14 —
+        the HbmShardSet's stacked image); stats() folds the splits into
+        a per-class `by_device` breakdown."""
         with self._lock:
-            self._registered.setdefault(cls, {})[key] = int(nbytes)
+            self._registered.setdefault(cls, {})[key] = (
+                int(nbytes),
+                [(int(d), int(b)) for d, b in devices]
+                if devices is not None else None)
 
     def release(self, cls: str, key: Any) -> None:
         with self._lock:
@@ -572,14 +840,25 @@ class DeviceMemoryAccounting:
         with self._lock:
             if cls in self._gauges:
                 return self._gauges[cls]
-            return sum(self._registered.get(cls, {}).values())
+            return sum(nb for nb, _ in
+                       self._registered.get(cls, {}).values())
 
     def stats(self) -> dict:
         classes: Dict[str, dict] = {}
         with self._lock:
             for cls, entries in self._registered.items():
-                classes[cls] = {"live_bytes": sum(entries.values()),
-                                "entries": len(entries)}
+                ent: Dict[str, Any] = {
+                    "live_bytes": sum(nb for nb, _ in entries.values()),
+                    "entries": len(entries)}
+                by_device: Dict[str, int] = {}
+                for nb, split in entries.values():
+                    if split:
+                        for dev, b in split:
+                            by_device[str(dev)] = \
+                                by_device.get(str(dev), 0) + b
+                if by_device:
+                    ent["by_device"] = dict(sorted(by_device.items()))
+                classes[cls] = ent
             for cls, v in self._gauges.items():
                 classes[cls] = {"live_bytes": v}
             providers = list(self._providers.items())

@@ -72,9 +72,13 @@ from opensearch_tpu.telemetry.rolling import RollingEstimator
 
 DEFAULT_TAIL_RING = 64
 
-# the lifecycle event vocabulary (README Observability documents each)
+# the lifecycle event vocabulary (README Observability documents each);
+# fanout/partial/merge are the collective-phase events the SPMD path
+# emits (ISSUE 14) — "which chip was the straggler" answered the way
+# coalesce/dispatch/collect already answer "did a merge cause this p99"
 EVENTS = ("arrive", "admit", "reject", "queue_wait", "coalesce",
-          "dispatch", "collect", "overlap", "respond")
+          "dispatch", "collect", "overlap", "respond",
+          "fanout", "partial", "merge", "device_share")
 
 # phase_times carries non-time fields next to the millisecond ones
 # (LedgerScope.publish writes bytes/waves into the same dict the slow
@@ -93,7 +97,8 @@ class Timeline:
     admission work will be judged by."""
 
     __slots__ = ("t_arrive", "t_ready", "events", "phases",
-                 "queue_wait_ms", "took_ms", "status", "detail")
+                 "queue_wait_ms", "device_share_ms", "took_ms", "status",
+                 "detail")
 
     def __init__(self):
         self.t_arrive = time.monotonic()
@@ -103,6 +108,10 @@ class Timeline:
             ("arrive", 0.0, None)]
         self.phases: Dict[str, float] = {}
         self.queue_wait_ms = 0.0
+        # this request's proportional slice of the shared wave's device
+        # wall (ISSUE 14 per-tenant attribution): filled by the wave
+        # scheduler after dispatch — wall × (own items / wave items)
+        self.device_share_ms = 0.0
         self.took_ms: Optional[float] = None
         self.status = "ok"
         # detail=True: producers may append per-step events in addition
@@ -122,6 +131,16 @@ class Timeline:
         wave scheduler's queue tomorrow)."""
         self.queue_wait_ms += ms
         self.event("queue_wait", ms=round(ms, 3))
+
+    def device_share(self, ms: float, wave_ms: float,
+                     co_batched: int) -> None:
+        """This request's proportional slice of a shared wave's device
+        wall (ISSUE 14): the scheduler splits each dispatch's wall
+        across its co-batched owners by item count — the usage-side
+        number the per-tenant accounting accumulates."""
+        self.device_share_ms += ms
+        self.event("device_share", ms=round(ms, 3),
+                   wave_ms=round(wave_ms, 3), co_batched=int(co_batched))
 
     def route(self) -> None:
         """Attribute the so-far-unexplained arrive→now interval as the
@@ -175,6 +194,8 @@ class Timeline:
                 {"event": name, "t_ms": t, **(fields or {})}
                 for name, t, fields in self.events],
         }
+        if self.device_share_ms:
+            out["device_share_ms"] = round(self.device_share_ms, 3)
         if self.phases:
             out["phases"] = {name: round(ms, 3)
                              for name, ms in self.phases.items()}
@@ -414,6 +435,34 @@ class FlightRecorder:
                 "jsonl_path": self.jsonl_path,
                 "export_errors": self.export_errors,
                 "took_rolling": self.took.summary()}
+
+
+class SpmdTimeline:
+    """The collective-phase timeline gate (ISSUE 14): when enabled, the
+    SPMD query phase (search/spmd.py) emits `fanout` (devices, rows),
+    per-device `partial` (device, wall) and `merge` (straggler skew +
+    analytic collective bytes) events onto whatever request Timeline is
+    bound — so a tail capture of an SPMD-served request answers "which
+    chip was the straggler" from the capture alone, the way it already
+    answers "did a merge cause this p99" via ingest_events.
+
+    This is a gate over EMISSION, not a recorder: the events land on
+    the FlightRecorder's per-request timelines and ride its capture
+    ring; rendering is tools/tail_report.py's per-device table.
+
+    No-op discipline (tracer/ledger/faults contract, gate-lint registry
+    row, asserted by bench.py): OFF by default, `gate()` returns None —
+    the disabled SPMD path costs one attribute load and a branch."""
+
+    def __init__(self):
+        self.enabled = False
+
+    def gate(self) -> Optional["SpmdTimeline"]:
+        """The per-query gate: None when collective-phase timeline
+        emission is off — search/spmd.py falls straight through."""
+        if not self.enabled:
+            return None
+        return self
 
 
 DEFAULT_INGEST_RING = 64

@@ -1,0 +1,231 @@
+"""Always-on scanned-bytes accounting for the query kernels (ISSUE 14).
+
+ROADMAP item 4 defers block-max (WAND) pruning behind a measured
+trigger: "add on-device block-max skipping once scanned-bytes/query
+starts dominating" (BM25S, arxiv 2407.03618). SCALING.md computed that
+number OFFLINE, once, at three corpus sizes — this module is the LIVE
+version: per-query counters for the bytes each kernel class touches,
+aggregated into a per-shard/per-segment heat map on `_nodes/stats`
+(`telemetry.scan`), so the go/no-go trigger is a standing dashboard
+number instead of an archaeology exercise.
+
+Two byte classes, matching SCALING.md's columns exactly (the committed
+acceptance: the live p50 at 100K docs must agree with the offline
+3.1 KB within 10%):
+
+- **posting bytes** (candidate-buffer kernel): the query terms' posting
+  blocks — `blocks × 128 lanes × 8 B` (docs int32 + tf f32), the same
+  formula tools/scaling_bench.py evaluates offline from term metadata.
+  Counted from `Plan.scan_blocks`, a static the compiler records at
+  plan build; per query this is one attribute read per plan node —
+  no per-lane work, no device sync.
+- **dense-lane bytes** (dense kernel): `d_pad × 9 B` per clause
+  evaluation — score f32 + hit i32 + live bool per doc lane, the
+  "~9 bytes/doc-lane" O(d_pad) HBM traffic SCALING.md's dense-kernel
+  refutation priced.
+
+Always-on discipline: this is NOT a gated subsystem — the counters are
+the trigger metric for a capacity decision, so they must be live on
+every node like the inflight-wave gauge and the engine event log. The
+budget that buys: O(plan nodes) integer adds per (query, segment) on
+the host, one dict update per segment and one rolling observe per
+query. Nothing allocates per lane, nothing syncs the device.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from opensearch_tpu.telemetry.rolling import RollingEstimator
+
+# posting block geometry (ops/device_segment.py): 128 lanes per block,
+# docs int32 + tf f32 = 8 bytes per lane
+POSTING_BLOCK_BYTES = 128 * 8
+# dense kernel per-lane traffic: score f32 + hit i32 + live bool
+DENSE_LANE_BYTES = 9
+
+# bound on distinct tracked (index, shard) rows and per-shard segment
+# rows: corpus/segment churn must not grow the map without bound — past
+# the cap, new keys fold into the overflow row
+_MAX_SHARDS = 128
+_MAX_SEGMENTS_PER_SHARD = 16
+_OVERFLOW = "_other"
+
+
+def plan_scan_blocks(plan) -> int:
+    """Total posting blocks a compiled plan tree gathers — the sum of
+    each text node's `scan_blocks` static (compile.py records it at
+    plan build). Memoized on the root plan object: plans are immutable
+    and memo-shared, so the warm path is one attribute read."""
+    cached = getattr(plan, "_scan_blocks_total", None)
+    if cached is None:
+        cached = plan.scan_blocks + sum(
+            plan_scan_blocks(c) for c in plan.children)
+        try:
+            plan._scan_blocks_total = cached
+        except AttributeError:      # frozen/slotted plan variants
+            pass
+    return cached
+
+
+class ScanAccounting:
+    """Node-wide scanned-bytes aggregates + the per-shard heat map."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.queries = 0
+        self.posting_bytes_total = 0
+        self.dense_bytes_total = 0
+        # per-query posting-bytes distribution — THE trigger metric
+        # (SCALING.md's scanned-bytes/query column, live)
+        self.per_query_posting = RollingEstimator()
+        self.per_query_dense = RollingEstimator()
+        # (index, shard) -> heat-map row
+        self._shards: Dict[Tuple[str, str], dict] = {}
+
+    # ------------------------------------------------------------- hot path
+
+    def note_segment(self, index: str, shard: str, seg_id: str,
+                     posting_bytes: int, dense_bytes: int,
+                     kernel: str) -> None:
+        """One (query, segment) execution's scan attribution. `kernel`
+        names the program class that ran: `candidate` (candidate-buffer
+        kernel), `dense` (per-doc dense vector), `spmd` (the
+        distributed program — dense per row), `hybrid`."""
+        key = (str(index), str(shard))
+        with self._lock:
+            row = self._shards.get(key)
+            if row is None:
+                if len(self._shards) >= _MAX_SHARDS:
+                    key = (_OVERFLOW, _OVERFLOW)
+                    row = self._shards.get(key)
+                if row is None:
+                    row = self._shards[key] = {
+                        "queries": 0, "posting_bytes": 0,
+                        "dense_bytes": 0, "kernels": {}, "segments": {}}
+            row["queries"] += 1
+            row["posting_bytes"] += int(posting_bytes)
+            row["dense_bytes"] += int(dense_bytes)
+            row["kernels"][kernel] = row["kernels"].get(kernel, 0) + 1
+            segs = row["segments"]
+            seg = segs.get(seg_id)
+            if seg is None:
+                if len(segs) >= _MAX_SEGMENTS_PER_SHARD:
+                    seg_id = _OVERFLOW
+                    seg = segs.get(seg_id)
+                if seg is None:
+                    seg = segs[seg_id] = {
+                        "queries": 0, "posting_bytes": 0,
+                        "dense_bytes": 0}
+            seg["queries"] += 1
+            seg["posting_bytes"] += int(posting_bytes)
+            seg["dense_bytes"] += int(dense_bytes)
+
+    def note_query(self, posting_bytes: int, dense_bytes: int) -> None:
+        """One request's total scan bytes across every segment it
+        touched — feeds the per-query distribution the block-max
+        trigger reads."""
+        with self._lock:
+            self.queries += 1
+            self.posting_bytes_total += int(posting_bytes)
+            self.dense_bytes_total += int(dense_bytes)
+        self.per_query_posting.observe(float(posting_bytes))
+        if dense_bytes:
+            self.per_query_dense.observe(float(dense_bytes))
+
+    def note_batch(self, index: str, shard: str, seg_rows: Dict,
+                   per_query: List[Tuple[int, int]]) -> None:
+        """One msearch wave's scan attribution in a single flush: the
+        envelope path accumulates per-(segment, kernel) rows and
+        per-item (posting, dense) totals LOCALLY while packing (plain
+        dict adds, no lock), then lands everything here — one lock
+        acquire per WAVE instead of two per query, which is what keeps
+        the always-on counters inside the <2% analytic overhead gate
+        at B=1024. `seg_rows`: {seg_id: [queries, posting_bytes,
+        dense_bytes, {kernel: count}]}."""
+        if not per_query:
+            return
+        key = (str(index), str(shard))
+        agg_posting = sum(p for p, _ in per_query)
+        agg_dense = sum(d for _, d in per_query)
+        with self._lock:
+            row = self._shards.get(key)
+            if row is None:
+                if len(self._shards) >= _MAX_SHARDS:
+                    key = (_OVERFLOW, _OVERFLOW)
+                    row = self._shards.get(key)
+                if row is None:
+                    row = self._shards[key] = {
+                        "queries": 0, "posting_bytes": 0,
+                        "dense_bytes": 0, "kernels": {}, "segments": {}}
+            row["queries"] += len(per_query)
+            row["posting_bytes"] += agg_posting
+            row["dense_bytes"] += agg_dense
+            segs = row["segments"]
+            for seg_id, (n, posting, dense, kernels) in seg_rows.items():
+                for kernel, cnt in kernels.items():
+                    row["kernels"][kernel] = \
+                        row["kernels"].get(kernel, 0) + cnt
+                seg = segs.get(seg_id)
+                if seg is None:
+                    if len(segs) >= _MAX_SEGMENTS_PER_SHARD:
+                        seg_id = _OVERFLOW
+                        seg = segs.get(seg_id)
+                    if seg is None:
+                        seg = segs[seg_id] = {
+                            "queries": 0, "posting_bytes": 0,
+                            "dense_bytes": 0}
+                seg["queries"] += n
+                seg["posting_bytes"] += posting
+                seg["dense_bytes"] += dense
+            self.queries += len(per_query)
+            self.posting_bytes_total += agg_posting
+            self.dense_bytes_total += agg_dense
+        for posting, dense in per_query:
+            self.per_query_posting.observe(float(posting))
+            if dense:
+                self.per_query_dense.observe(float(dense))
+
+    # --------------------------------------------------------------- reading
+
+    def stats(self) -> dict:
+        with self._lock:
+            shards = {}
+            for (index, shard), row in sorted(self._shards.items()):
+                shards[f"{index}[{shard}]"] = {
+                    "queries": row["queries"],
+                    "posting_bytes": row["posting_bytes"],
+                    "dense_bytes": row["dense_bytes"],
+                    "kernels": dict(sorted(row["kernels"].items())),
+                    "segments": {
+                        sid: dict(seg)
+                        for sid, seg in sorted(row["segments"].items())},
+                }
+            queries = self.queries
+            posting = self.posting_bytes_total
+            dense = self.dense_bytes_total
+        return {
+            "queries": queries,
+            "posting_bytes_total": posting,
+            "dense_bytes_total": dense,
+            "per_query": {
+                "posting_bytes": self.per_query_posting.summary(),
+                "dense_bytes": self.per_query_dense.summary(),
+            },
+            "shards": shards,
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self.queries = 0
+            self.posting_bytes_total = 0
+            self.dense_bytes_total = 0
+            self._shards.clear()
+        self.per_query_posting.reset()
+        self.per_query_dense.reset()
+
+
+# process-wide singleton (the TELEMETRY.scan face; module-level like
+# INGEST_EVENTS so deep call sites need no service plumbing)
+SCAN = ScanAccounting()

@@ -302,3 +302,99 @@ def test_interference_cli_end_to_end(tmp_path):
     bad_p = _write(tmp_path / "in.json", bad)
     assert bench_compare.main(["bench_compare.py", old_p, old_p]) == 0
     assert bench_compare.main(["bench_compare.py", old_p, bad_p]) == 1
+
+
+# ----------------------------------------------- SCALING_MC shape (ISSUE 14)
+
+SCALE_OLD = {
+    f"spmd_d{d}": {
+        "mode": f"spmd_d{d}", "devices": d, "value": qps,
+        "per_chip_efficiency": eff, "straggler_skew_p50_ms": 0.05,
+        "warm_p50_ms": 10.0, "warm_p99_ms": 25.0}
+    for d, qps, eff in ((1, 100.0, 1.0), (2, 170.0, 0.85),
+                        (4, 280.0, 0.7), (8, 400.0, 0.5))
+}
+
+
+def test_scaling_records_skip_generic_warm_gate():
+    new = {k: dict(v, warm_p50_ms=v["warm_p50_ms"] * 3) for k, v in
+           SCALE_OLD.items()}
+    rows, failures = bench_compare.compare(SCALE_OLD, new, 10.0)
+    assert not failures     # absolute warm latency is box-state noise
+    assert all("warm" not in (r.get("status") or "") for r in rows)
+
+
+def test_scaling_efficiency_regression_fails_at_equal_d():
+    new = {k: dict(v) for k, v in SCALE_OLD.items()}
+    new["spmd_d4"]["per_chip_efficiency"] = 0.55    # -21% at D=4
+    rows, failures = bench_compare.compare_scaling(SCALE_OLD, new, 10.0)
+    assert failures and "per-chip efficiency" in failures[0]
+    by_cfg = {r["config"]: r for r in rows}
+    assert by_cfg["spmd_d4"]["status"] == "EFFICIENCY-REGRESSION"
+
+
+def test_scaling_efficiency_within_15_pct_ok():
+    new = {k: dict(v) for k, v in SCALE_OLD.items()}
+    new["spmd_d4"]["per_chip_efficiency"] = 0.62    # -11%: within gate
+    rows, failures = bench_compare.compare_scaling(SCALE_OLD, new, 10.0)
+    assert not failures
+
+
+def test_scaling_skew_regression_fails_past_floor():
+    new = {k: dict(v) for k, v in SCALE_OLD.items()}
+    new["spmd_d8"]["straggler_skew_p50_ms"] = 3.0   # 60x, past 1ms floor
+    rows, failures = bench_compare.compare_scaling(SCALE_OLD, new, 10.0)
+    assert failures and "straggler skew" in failures[0]
+
+
+def test_scaling_subms_skew_noise_never_fails():
+    new = {k: dict(v) for k, v in SCALE_OLD.items()}
+    new["spmd_d8"]["straggler_skew_p50_ms"] = 0.4   # 8x but under 1ms
+    rows, failures = bench_compare.compare_scaling(SCALE_OLD, new, 10.0)
+    assert not failures
+
+
+def test_scaling_one_sided_points_never_fail():
+    new = {**{k: dict(v) for k, v in SCALE_OLD.items()},
+           "spmd_d16": {"mode": "spmd_d16", "devices": 16,
+                        "value": 500.0, "per_chip_efficiency": 0.3}}
+    rows, failures = bench_compare.compare_scaling(SCALE_OLD, new, 10.0)
+    assert not failures
+    assert any(r.get("status") == "new-only" for r in rows)
+
+
+def test_scaling_cli_end_to_end(tmp_path):
+    old_p = _write(tmp_path / "so.json", list(SCALE_OLD.values()))
+    bad = [dict(v, per_chip_efficiency=(v["per_chip_efficiency"] or 1)
+                * 0.5) for v in SCALE_OLD.values()]
+    bad_p = _write(tmp_path / "sn.json", bad)
+    assert bench_compare.main(["bench_compare.py", old_p, old_p]) == 0
+    assert bench_compare.main(["bench_compare.py", old_p, bad_p]) == 1
+
+
+# ----------------------------------------------- scaling_report (ISSUE 14)
+
+def test_scaling_report_smoke(tmp_path, capsys):
+    import scaling_report
+
+    recs = list(SCALE_OLD.values())
+    for r in recs:
+        r["collective_ici_bytes_per_query"] = 1440.0
+        r["scanned_bytes_per_query_p50"] = 3072.0
+        r["per_device"] = {"0": {"queries": 10, "partial_ms": 55.0,
+                                 "straggler_hits": 3, "h2d_bytes": 123}}
+    path = _write(tmp_path / "mc.json", recs)
+    assert scaling_report.main(["scaling_report.py", path]) == 0
+    out = capsys.readouterr().out
+    assert "efficiency" in out and "per-chip breakdown" in out
+    # the efficiency floor check
+    assert scaling_report.main(
+        ["scaling_report.py", "--assert-efficiency", "0.4", path]) == 0
+    assert scaling_report.main(
+        ["scaling_report.py", "--assert-efficiency", "0.9", path]) == 1
+
+
+def test_scaling_report_empty_input(tmp_path):
+    import scaling_report
+    path = _write(tmp_path / "empty.json", [])
+    assert scaling_report.main(["scaling_report.py", path]) == 1

@@ -210,6 +210,72 @@ def test_graft_dryrun_multichip(eight_devices):
     mod.dryrun_multichip(8)
 
 
+def test_dryrun_parity_bodies_4of4(eight_devices):
+    """ISSUE 14 satellite: pin the multichip dryrun's FOUR hit-bearing
+    parity cases at 4/4 (seeded, small scale, 16 rows packed 2/device).
+
+    Diagnosis of MULTICHIP_r05's committed `hit_parity=3/4`: a
+    DENOMINATOR artifact, not rank divergence — the pre-PR-8 harness
+    printed a hardcoded "/4" while its size:0 date_histogram body has
+    no hits page to compare (its strict per-body asserts all passed,
+    rc=0 — real divergence would have crashed the run). This test pins
+    the repaired contract: every hit-bearing body, INCLUDING the
+    all-scores-equal constant-score case where the page order is
+    nothing but the cross-shard tie-break, matches the host loop
+    exactly."""
+    import json
+
+    import opensearch_tpu.search.spmd as spmd_mod
+    from opensearch_tpu.node import Node
+    from opensearch_tpu.search import spmd
+    from opensearch_tpu.utils.demo import build_shards
+
+    mapper, segments = build_shards(4000, n_shards=16, vocab_size=2000,
+                                    avg_len=40, seed=3)
+    node = Node()
+    node.request("PUT", "/p44", {
+        "settings": {"number_of_shards": 16},
+        "mappings": {"properties": {
+            "body": {"type": "text"}, "tag": {"type": "keyword"},
+            "views": {"type": "integer"}, "ts": {"type": "date"}}}})
+    svc = node.indices.get("p44")
+    for shard, seg in zip(svc.shards, segments):
+        shard.engine.install_segments([seg], max_seq_no=seg.num_docs,
+                                      local_checkpoint=seg.num_docs)
+        shard._sync_reader()
+
+    bodies = [
+        {"query": {"bool": {
+            "must": [{"match": {"body": "w00120 w00077"}}],
+            "should": [{"term": {"tag": "cat1"}}]}}, "size": 8},
+        {"query": {"match": {"body": "w00400 w01999"}}, "size": 12},
+        {"query": {"match_all": {}}, "size": 10,
+         "sort": [{"views": {"order": "desc"}}]},
+        # constant-score: every hit ties, the page order IS the
+        # cross-shard tie-break (gather order vs host sort)
+        {"query": {"bool": {"filter": [
+            {"range": {"views": {"gte": 500}}}]}}, "size": 10},
+    ]
+    hit_parity = 0
+    for body in bodies:
+        before = spmd.SPMD_QUERIES.value
+        got = node.request("POST", "/p44/_search", body)
+        assert spmd.SPMD_QUERIES.value == before + 1, \
+            f"SPMD path not taken for {json.dumps(body)[:80]}"
+        with spmd_mod.force_host_loop():
+            want = node.request("POST", "/p44/_search", body)
+        assert got["hits"]["total"] == want["hits"]["total"], body
+        assert want["hits"]["hits"], \
+            f"parity body must bear hits: {json.dumps(body)[:80]}"
+        gh = [(h["_id"], h.get("sort", round(h["_score"] or 0, 4)))
+              for h in got["hits"]["hits"]]
+        wh = [(h["_id"], h.get("sort", round(h["_score"] or 0, 4)))
+              for h in want["hits"]["hits"]]
+        assert gh == wh, (body, gh[:3], wh[:3])
+        hit_parity += 1
+    assert hit_parity == 4
+
+
 def test_graft_entry_compiles():
     import importlib
     import sys

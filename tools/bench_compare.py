@@ -44,6 +44,12 @@ OVERLOAD_COLLAPSE_PCT = 15.0
 # slower" and "writes under serving got slower" both fail the run
 INTERFERENCE_P99_PCT = 15.0
 
+# the multi-chip scaling gate (ISSUE 14): at EQUAL device count D,
+# per-chip scaling efficiency QPS(D)/(D·QPS(1)) may not drop by more
+# than this between two SCALING_MC rounds — "adding chips stopped
+# paying" fails the run even when absolute QPS moved with box state
+SCALING_EFFICIENCY_PCT = 15.0
+
 
 def load_records(path: str) -> Dict[str, dict]:
     """file of JSON lines (or one JSON array) → {config key: record}."""
@@ -116,6 +122,13 @@ def compare(old: Dict[str, dict], new: Dict[str, dict],
             # (compare_interference, 15% at equal ingest rate): their
             # p99 under concurrent ingest includes churn-induced
             # compile stalls the generic warm gate would misread
+            continue
+        if any(r is not None and "devices" in r
+               and "per_chip_efficiency" in r for r in (o, n)):
+            # SCALING_MC points have their own gate (compare_scaling):
+            # per-chip EFFICIENCY is round-normalized (divided by the
+            # same round's QPS(1)), where absolute warm latency on the
+            # virtual-chip CPU box moves with box state
             continue
         row = {"config": key}
         if o is None or n is None:
@@ -325,6 +338,83 @@ def compare_interference(old: Dict[str, dict], new: Dict[str, dict],
     return rows, failures
 
 
+def _scaling_records(recs: Dict[str, dict]) -> Dict[str, dict]:
+    """The SCALING_MC shape: multi-chip points carrying `devices` next
+    to a QPS `value` (bench.py --devices)."""
+    return {k: r for k, r in recs.items()
+            if isinstance(r.get("devices"), (int, float))
+            and isinstance(r.get("value"), (int, float))}
+
+
+def compare_scaling(old: Dict[str, dict], new: Dict[str, dict],
+                    threshold_pct: float) -> Tuple[List[dict], List[str]]:
+    """Gate two multi-chip scaling curves point-by-point at EQUAL
+    device count: fail when per-chip efficiency QPS(D)/(D·QPS(1))
+    drops by more than SCALING_EFFICIENCY_PCT (the chips stopped
+    pulling their weight), or when straggler skew more than doubles
+    past --threshold over a 1 ms floor (a chip went quietly lame).
+    Single-chip points (D=1, efficiency 1.0 by construction) gate only
+    through the generic warm-latency rows; points present in only one
+    round report but never fail (device grids grow round over
+    round)."""
+    o_recs, n_recs = _scaling_records(old), _scaling_records(new)
+    rows, failures = [], []
+    if not o_recs or not n_recs:
+        return rows, failures
+    for key in sorted(set(o_recs) | set(n_recs),
+                      key=lambda k: (o_recs.get(k) or n_recs.get(k))
+                      ["devices"]):
+        o, n = o_recs.get(key), n_recs.get(key)
+        row = {"config": key, "devices": (o or n)["devices"]}
+        if o is None or n is None:
+            row["status"] = "old-only" if n is None else "new-only"
+            rows.append(row)
+            continue
+        status = "ok"
+        oe, ne = o.get("per_chip_efficiency"), n.get("per_chip_efficiency")
+        if isinstance(oe, (int, float)) and isinstance(ne, (int, float)) \
+                and oe > 0:
+            row["old_efficiency"] = oe
+            row["new_efficiency"] = ne
+            de = 100.0 * (ne - oe) / oe
+            row["efficiency_delta_pct"] = round(de, 1)
+            if de < -SCALING_EFFICIENCY_PCT:
+                status = "EFFICIENCY-REGRESSION"
+                failures.append(
+                    f"{key}: per-chip efficiency {oe} -> {ne} "
+                    f"({de:.1f}% < -{SCALING_EFFICIENCY_PCT:g}% at "
+                    f"equal D)")
+        os_, ns = o.get("straggler_skew_p50_ms"), \
+            n.get("straggler_skew_p50_ms")
+        if isinstance(os_, (int, float)) and isinstance(ns, (int, float)):
+            row["old_skew_p50_ms"] = os_
+            row["new_skew_p50_ms"] = ns
+            # floor at 1ms: sub-millisecond skews on the virtual-chip
+            # box are scheduler noise, not a lame chip
+            if ns > max(os_ * 2, 1.0) and \
+                    100.0 * (ns - os_) / max(os_, 1e-9) > threshold_pct:
+                status = "SKEW-REGRESSION"
+                failures.append(
+                    f"{key}: straggler skew p50 {os_}ms -> {ns}ms "
+                    f"(more than doubled past the 1ms floor)")
+        row["status"] = status
+        rows.append(row)
+    return rows, failures
+
+
+def render_scaling(rows: List[dict]) -> str:
+    headers = ["config", "devices", "old_efficiency", "new_efficiency",
+               "efficiency_delta_pct", "old_skew_p50_ms",
+               "new_skew_p50_ms", "status"]
+    table = [headers] + [[str(r.get(h, "-")) for h in headers]
+                         for r in rows]
+    widths = [max(len(row[i]) for row in table)
+              for i in range(len(headers))]
+    return "\n".join(
+        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+        for row in table)
+
+
 def render_interference(rows: List[dict]) -> str:
     headers = ["config", "ingest_rate", "old_p99_ms", "new_p99_ms",
                "p99_delta_pct", "old_ingest_dps", "new_ingest_dps",
@@ -396,6 +486,12 @@ def main(argv: List[str]) -> int:
               "at equal ingest rate):")
         print(render_interference(if_rows))
         failures += if_failures
+    sc_rows, sc_failures = compare_scaling(old, new, threshold)
+    if sc_rows:
+        print("\nmulti-chip scaling (per-chip efficiency / straggler "
+              "skew at equal device count):")
+        print(render_scaling(sc_rows))
+        failures += sc_failures
     if failures:
         print(f"\nFAIL: {len(failures)} regression(s) "
               f"(warm p50/p99 beyond {threshold:g}% / overload "

@@ -66,6 +66,17 @@ GATED_SUBSYSTEMS = (
      "enabled", ("timeline", "current")),
     ("opensearch_tpu/telemetry/ledger.py", "ChurnLedger", "enabled",
      ("scope", "current")),
+    # ISSUE 14 sharded-serving observability: the per-device ledger
+    # (per-chip transfer/phase attribution + straggler skew) and the
+    # SPMD collective-phase timeline emitter are OFF by default — the
+    # default SPMD query path pays one attribute load + branch per
+    # query for each. (The scan counters are deliberately ALWAYS-ON —
+    # the block-max trigger metric rides the inflight-wave-gauge
+    # contract, not the per-request gate discipline.)
+    ("opensearch_tpu/telemetry/ledger.py", "DeviceLedger", "enabled",
+     ("scope",)),
+    ("opensearch_tpu/telemetry/lifecycle.py", "SpmdTimeline", "enabled",
+     ("gate",)),
 )
 
 # no-op constants a disabled gate may return

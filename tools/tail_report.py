@@ -236,6 +236,58 @@ def render_ingest(groups: Dict[str, dict]) -> str:
                           "took_max_ms"])
 
 
+def device_groups(records: List[dict]) -> Dict[str, dict]:
+    """Group SPMD collective-phase events by device (ISSUE 14): a
+    capture whose timeline carries `partial` events was served by the
+    shard_map program with the SPMD timeline on — per device, the
+    partial-wall distribution and how often the `merge` event named it
+    the straggler. The split answers the sharded-serving tail question
+    the way coalesce_groups answers the scheduler's: is the p99 one
+    lame chip (one device owns the straggler column) or uniform load
+    (straggler hits spread evenly)?"""
+    groups: Dict[str, dict] = {}
+    skews: List[float] = []
+    for rec in records:
+        for ev in rec.get("events") or []:
+            if ev.get("event") == "partial":
+                dev = str(ev.get("device", "?"))
+                g = groups.setdefault(dev, {
+                    "partials": 0, "wall_ms": [], "straggler_hits": 0})
+                g["partials"] += 1
+                g["wall_ms"].append(float(ev.get("ms", 0.0) or 0.0))
+            elif ev.get("event") == "merge":
+                skews.append(float(ev.get("skew_ms", 0.0) or 0.0))
+                straggler = ev.get("straggler")
+                if straggler is not None:
+                    g = groups.setdefault(str(straggler), {
+                        "partials": 0, "wall_ms": [],
+                        "straggler_hits": 0})
+                    g["straggler_hits"] += 1
+    out: Dict[str, dict] = {}
+    for dev, g in groups.items():
+        walls = sorted(g["wall_ms"]) or [0.0]
+        out[dev] = {
+            "partials": g["partials"],
+            "wall_p50_ms": round(walls[len(walls) // 2], 3),
+            "wall_max_ms": round(walls[-1], 3),
+            "straggler_hits": g["straggler_hits"],
+        }
+    if out and skews:
+        skews.sort()
+        out["_skew"] = {"partials": len(skews),
+                        "wall_p50_ms": round(skews[len(skews) // 2], 3),
+                        "wall_max_ms": round(skews[-1], 3),
+                        "straggler_hits": "-"}
+    return out
+
+
+def render_devices(groups: Dict[str, dict]) -> str:
+    rows = [{"device": k, **v} for k, v in sorted(
+        groups.items(), key=lambda kv: (kv[0] == "_skew", kv[0]))]
+    return _render(rows, ["device", "partials", "wall_p50_ms",
+                          "wall_max_ms", "straggler_hits"])
+
+
 def rejection_groups(records: List[dict]) -> Dict[str, dict]:
     """Group captures that carry a `reject` lifecycle event by the
     structured reason + tenant the admission controller stamped
@@ -297,6 +349,11 @@ def main(argv: List[str]) -> int:
         print("\ntail by ingest overlap (write-path events in flight "
               "during the capture window):")
         print(render_ingest(ig))
+    dg = device_groups(records)
+    if dg:
+        print("\ntail by device (SPMD partial walls + straggler "
+              "attribution; _skew = per-query max-median):")
+        print(render_devices(dg))
     groups = rejection_groups(records)
     if groups:
         print(f"\nrejections by reason "
