@@ -176,6 +176,19 @@ SCHEDULER_ON = "--scheduler" in sys.argv
 # across rounds (collapse >15% past the knee / admitted-p99 breach).
 OVERLOAD_SWEEP = "--overload-sweep" in sys.argv
 
+# --insights (with --clients/--arrival-rate, ISSUE 15): the open-loop
+# concurrency harness with the query-insights recorder + transfer
+# ledger enabled for the measured window, over a MIXED-shape query pool
+# (>=3 distinct shape classes). The run writes INSIGHTS_r<N>.json
+# (BENCH_INSIGHTS_ROUND, default 1) with the per-shape cost table, a
+# conservation block proving per-shape totals sum to the global
+# counters (scan byte-exact, ledger byte-exact, counts ±1), the
+# analytic <2% enabled-overhead gate, and a shape-aware-vs-global
+# deadline-shed A/B on an overloaded in-process Node. Without the flag
+# every run ASSERTS the recorder and the shape-pricing gate are no-ops,
+# like the tracer/ledger/injector/flight/scheduler discipline.
+INSIGHTS_ON = "--insights" in sys.argv
+
 # --devices D1,D2,...: the multi-chip scaling-efficiency harness
 # (ISSUE 14, ROADMAP item 4's measurement layer): for each D the
 # parent spawns a child pinned to a D-device XLA host-platform mesh
@@ -276,6 +289,12 @@ def _setup_telemetry():
     assert TELEMETRY.spmd_timeline.enabled is False \
         and TELEMETRY.spmd_timeline.gate() is None, \
         "disabled SPMD timeline must be a no-op (gate must return None)"
+    # and the query-insights recorder (ISSUE 15): same discipline —
+    # the --insights mode enables it itself, for its measured window
+    assert TELEMETRY.insights.enabled is False \
+        and TELEMETRY.insights.gate() is None, \
+        "query insights must be disabled (gate must return None) for " \
+        "clean benches"
 
 
 def _setup_admission():
@@ -296,6 +315,13 @@ def _setup_admission():
     assert WAVE_BREAKER.enabled is False and WAVE_BREAKER.gate() is None, \
         "device-memory breaker must be disabled (gate must return " \
         "None) for clean benches"
+    # shape-aware shed pricing (ISSUE 15): its own gate ON TOP of the
+    # shed stage — a clean bench must never compute shape keys at
+    # admission
+    assert ctrl.shedder.shape_enabled is False \
+        and ctrl.shedder.shape_gate() is None, \
+        "shape-aware shed pricing must be disabled (shape_gate must " \
+        "return None) for clean benches"
 
 
 def _setup_scheduler():
@@ -324,7 +350,7 @@ def _scheduler_overhead_pct(n_requests: int, wall_s: float) -> float:
 
     class _NoopTarget:
         def multi_search(self, bodies, deadline=None, timelines=None,
-                         phase_times=None):
+                         phase_times=None, tenants=None):
             return {"responses": [{} for _ in bodies]}
 
     probe = WaveScheduler(autostart=False)
@@ -1109,6 +1135,331 @@ def bench_openloop(clients: int, rate: float):
         f.write(json.dumps(out) + "\n")
         for p in sweep:
             f.write(json.dumps(p) + "\n")
+    print(json.dumps(out))
+
+
+def _insights_overhead_pct(n_notes: int, wall_s: float) -> float:
+    """Enabled query-insights overhead over the measured window — the
+    same analytic method as the ledger/flight/scheduler/scan gates:
+    per-sub-request cost (shape-id render + one note) measured on a
+    throwaway recorder × the note volume, ASSERTED under 2% of the
+    wall."""
+    from opensearch_tpu.telemetry.insights import (QueryInsights,
+                                                   template_shape)
+    probe = QueryInsights()
+    probe.enabled = True
+    sig = ("match", "body", "or", None, None)
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        template_shape(sig)
+    per_shape_s = (time.perf_counter() - t0) / n
+    t0 = time.perf_counter()
+    for j in range(n):
+        probe.note(f"match:{j % 5}", took_ms=2.0, device_ms=0.5,
+                   posting_bytes=3072, dense_bytes=0, h2d_bytes=128,
+                   d2h_bytes=256, round_trips=1, co_batched=4,
+                   warm_hit=True, tenant="bench")
+    per_note_s = (time.perf_counter() - t0) / n
+    pct = 100.0 * (per_shape_s + per_note_s) * n_notes \
+        / max(wall_s, 1e-9)
+    assert pct < 2.0, \
+        f"insights overhead {pct:.3f}% of the measured wall " \
+        f"(contract: <2%)"
+    return round(pct, 4)
+
+
+def _insights_shed_ab():
+    """Shape-aware vs global-median deadline-shed pricing, A/B'd on an
+    overloaded in-process Node (the ISSUE 15 acceptance: goodput and
+    admitted-p99 no worse than global pricing).
+
+    The workload is mixed BY CONSTRUCTION — cheap repeated match_all
+    bodies (request-cache hits, sub-ms) interleave with heavy DISTINCT
+    8-term matches (real milliseconds) — exactly the regime the shape
+    gate exists for: with one global median the cheap class drags the
+    estimate down and heavy arrivals are priced as cheap (admitted,
+    then blow the SLO); per-shape medians price the heavy class with
+    its own history. Arms run interleaved (global, shape) × reps on the
+    SAME node so estimators and box state stay comparable; best goodput
+    per arm is kept (the BENCH_CONC reps discipline)."""
+    from opensearch_tpu.node import Node
+    from opensearch_tpu.utils.demo import query_terms, synth_docs
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(here, "tools"))
+    import openloop
+
+    slo_ms = float(os.environ.get("BENCH_INSIGHTS_SLO_MS", "75"))
+    clients = int(os.environ.get("BENCH_INSIGHTS_AB_CLIENTS", "8"))
+    permits = int(os.environ.get("BENCH_INSIGHTS_AB_PERMITS", "4"))
+    n_docs = int(os.environ.get("BENCH_INSIGHTS_AB_DOCS", "30000"))
+    duration_s = float(os.environ.get("BENCH_INSIGHTS_AB_SECONDS", "3"))
+    max_req = int(os.environ.get("BENCH_INSIGHTS_AB_MAX_REQ", "2000"))
+    mult = float(os.environ.get("BENCH_INSIGHTS_AB_MULT", "2.0"))
+    reps = int(os.environ.get("BENCH_INSIGHTS_AB_REPS", "2"))
+    node = Node(settings={"admission.shed.enabled": "true",
+                          "admission.shed.slo_ms": slo_ms,
+                          "search.backpressure.max_concurrent": permits})
+    node.request("PUT", "/bench_ab", {
+        "settings": {"number_of_shards": 1},
+        "mappings": {"properties": {"body": {"type": "text"}}}})
+    docs = synth_docs(n_docs, VOCAB, avg_len=60, seed=42)
+    lines = []
+    for i, d in enumerate(docs):
+        lines.append(json.dumps({"index": {"_index": "bench_ab",
+                                           "_id": f"d{i}"}}))
+        lines.append(json.dumps({"body": d["body"]}))
+    r = node.request("POST", "/_bulk", "\n".join(lines) + "\n",
+                     refresh="true")
+    assert r["_status"] == 200 and not r["errors"]
+
+    heavy_qs = query_terms(1024 + 4 * max_req, VOCAB, seed=13,
+                           terms_per_query=8)
+    hq_next = [0]
+
+    def fresh_bodies(n):
+        out = []
+        for i in range(n):
+            if i % 2 == 0:
+                # the motivating cheap class: identical bodies ride
+                # the request cache at ~0.1ms
+                out.append({"query": {"match_all": {}}, "size": 5})
+            else:
+                out.append({"query": {"match": {"body": heavy_qs[
+                    (hq_next[0] + i) % len(heavy_qs)]}}, "size": 30})
+        hq_next[0] += n
+        return out
+
+    def serve(body):
+        return node.handle("POST", "/bench_ab/_search",
+                           body=json.dumps(body)).status
+
+    for b in fresh_bodies(64):      # warm executables + estimators
+        serve(b)
+    t0 = time.perf_counter()
+    for b in fresh_bodies(128):
+        serve(b)
+    closed_qps = 128 / (time.perf_counter() - t0)
+    rate = max(closed_qps * mult, 1.0)
+    n = min(max(int(rate * duration_s), clients * 2), max_req)
+    # one unrecorded concurrent burst (thread ramp + estimator warm-in)
+    openloop.run_open_loop(serve, fresh_bodies(n), clients=clients,
+                           arrival_rate=rate, seed=10)
+
+    shedder = node.search_backpressure.shedder
+    arms = {"global": [], "shape": []}
+    for rep in range(max(reps, 1)):
+        for arm in ("global", "shape"):
+            shedder.shape_enabled = arm == "shape"
+            res = openloop.run_open_loop(
+                serve, fresh_bodies(n), clients=clients,
+                arrival_rate=rate, seed=11 + rep)
+            assert res["failed"] == 0 and res["errors"] == 0, \
+                f"shed A/B arm {arm} saw non-429 failures: {res}"
+            arms[arm].append(res)
+    shedder.shape_enabled = False
+
+    def best(rs):
+        b = max(rs, key=lambda r: r["goodput_qps"])
+        return {k: b[k] for k in (
+            "qps", "goodput_qps", "ok", "rejected", "failed",
+            "admitted_p50_ms", "admitted_p99_ms", "rejected_p50_ms",
+            "rejected_p99_ms", "mean_queue_wait_ms")}
+
+    g, s = best(arms["global"]), best(arms["shape"])
+    # the acceptance: shape pricing no worse than global-median pricing
+    # on goodput and admitted tail (generous box-noise guards; the raw
+    # numbers are committed for the real verdict)
+    assert s["goodput_qps"] >= 0.85 * g["goodput_qps"], \
+        f"shape-priced goodput {s['goodput_qps']} collapsed vs global " \
+        f"{g['goodput_qps']}"
+    assert s["admitted_p99_ms"] <= max(g["admitted_p99_ms"] * 1.25,
+                                       g["admitted_p99_ms"] + 25.0), \
+        f"shape-priced admitted p99 {s['admitted_p99_ms']}ms worse " \
+        f"than global {g['admitted_p99_ms']}ms"
+    return {"slo_ms": slo_ms, "clients": clients, "permits": permits,
+            "offered_rate": round(rate, 1),
+            "closed_loop_qps": round(closed_qps, 2),
+            "n_requests": n, "reps": reps,
+            "global": g, "shape": s,
+            "shape_pricing": shedder.stats()["shape_pricing"]}
+
+
+def bench_insights(clients: int, rate: float):
+    """--clients N --arrival-rate R --insights (ISSUE 15): the
+    open-loop concurrency harness over a MIXED-shape pool with the
+    query-insights recorder + transfer ledger on for the measured
+    window. Writes INSIGHTS_r<N>.json: the per-shape cost table (>=3
+    distinct shape classes by construction), a conservation block
+    proving per-shape totals sum to the global counters (scan
+    byte-exact, ledger byte-exact, request counts ±1), the analytic
+    enabled-overhead gate, the heavy-query top-N registries, and the
+    shape-aware-vs-global shed A/B."""
+    import jax
+
+    from opensearch_tpu.search.controller import execute_search
+    from opensearch_tpu.telemetry import TELEMETRY
+    from opensearch_tpu.telemetry.scan import SCAN
+    from opensearch_tpu.utils.demo import query_terms
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(here, "tools"))
+    import openloop
+
+    platform = jax.devices()[0].platform
+    executor, _seg = build_index()
+    n_req = int(os.environ.get("BENCH_CONC_REQUESTS", "512"))
+    rnd = int(os.environ.get("BENCH_INSIGHTS_ROUND", "1"))
+    qs = query_terms(max(n_req, 64), VOCAB, seed=7, terms_per_query=2)
+
+    # four structurally distinct shape classes (the acceptance demands
+    # >=3), all envelope-batchable, distinct literals per request
+    # within a class: the per-shape rows must come from the join, not
+    # from a degenerate single-template pool
+    def body_for(i):
+        q = qs[i % len(qs)]
+        q2 = qs[(i + 1) % len(qs)]
+        cls = i % 4
+        if cls == 0:
+            return {"query": {"match": {"body": q}}, "size": TOP_K}
+        if cls == 1:
+            return {"query": {"bool": {
+                "must": [{"match": {"body": q}}],
+                "should": [{"match": {"body": q2}}]}}, "size": TOP_K}
+        if cls == 2:
+            return {"query": {"term": {"body": q.split()[0]}},
+                    "size": TOP_K}
+        return {"query": {"match_all": {}}, "size": TOP_K}
+
+    bodies = [body_for(i) for i in range(n_req)]
+
+    def serve(body):
+        execute_search([executor], dict(body), allow_envelope=True)
+
+    for b in bodies:                # warm every shape at b_pad 1
+        serve(b)
+    k = 2                           # and the multi-item bucket sizes
+    while k <= 16:                  # the co-batch envelopes below use
+        for lo in range(0, len(bodies), k):
+            chunk = bodies[lo:lo + k]
+            if len(chunk) > 1:
+                executor.multi_search([dict(b) for b in chunk])
+        k *= 2
+    t0 = time.perf_counter()
+    for b in bodies[:128]:
+        serve(b)
+    closed_qps = 128 / (time.perf_counter() - t0)
+
+    # measured window: insights + ledger on, global counters anchored
+    ins = TELEMETRY.insights
+    ins.enabled = True
+    ins.clear()
+    TELEMETRY.ledger.enabled = True
+    TELEMETRY.ledger.reset()
+    c0 = TELEMETRY.metrics.to_dict()["counters"]
+    bodies0 = c0.get("msearch.bodies", 0)
+    p0, d0 = SCAN.posting_bytes_total, SCAN.dense_bytes_total
+    t_run0 = time.perf_counter()
+    res = openloop.run_open_loop(serve, bodies, clients=clients,
+                                 arrival_rate=rate, seed=11)
+    assert res["errors"] == 0, \
+        f"open-loop run recorded {res['errors']} serve error(s)"
+    # a few mixed B=16 envelopes inside the window: co-batched
+    # attribution (device wall / ledger bytes split across envelope
+    # siblings) lands in the committed per-shape rows
+    n_env = 0
+    for lo in range(0, min(len(bodies), 128), 16):
+        chunk = bodies[lo:lo + 16]
+        executor.multi_search([dict(b) for b in chunk])
+        n_env += len(chunk)
+    wall_s = time.perf_counter() - t_run0
+    ins.enabled = False
+    TELEMETRY.ledger.enabled = False
+    snap = ins.snapshot(top=True)
+
+    # conservation (the acceptance contract): per-shape sums == the
+    # recorder's own totals == the window deltas of the global counters
+    tot = snap["totals"]
+    shapes = snap["shapes"]
+    real_shapes = [s for s in shapes if s != "_other"]
+    assert len(real_shapes) >= 3, \
+        f"only {len(real_shapes)} shape classes recorded (need >=3)"
+    sum_count = sum(r["count"] for r in shapes.values())
+    sum_posting = sum(r["posting_bytes"] for r in shapes.values())
+    sum_dense = sum(r["dense_bytes"] for r in shapes.values())
+    sum_h2d = sum(r["h2d_bytes"] for r in shapes.values())
+    sum_d2h = sum(r["d2h_bytes"] for r in shapes.values())
+    sum_took = sum(r["took_total_ms"] for r in shapes.values())
+    assert sum_count == tot["queries"]
+    assert sum_posting == tot["posting_bytes"] \
+        and sum_dense == tot["dense_bytes"]
+    assert sum_h2d == tot["h2d_bytes"] and sum_d2h == tot["d2h_bytes"]
+    assert abs(sum_took - tot["took_total_ms"]) < 0.5
+    scan_dp = SCAN.posting_bytes_total - p0
+    scan_dd = SCAN.dense_bytes_total - d0
+    assert tot["posting_bytes"] == scan_dp \
+        and tot["dense_bytes"] == scan_dd, \
+        f"scan conservation broke: insights " \
+        f"({tot['posting_bytes']}, {tot['dense_bytes']}) vs heat map " \
+        f"({scan_dp}, {scan_dd})"
+    led = TELEMETRY.ledger.snapshot()["bytes_total"]
+    assert tot["h2d_bytes"] == led.get("h2d", 0) \
+        and tot["d2h_bytes"] == led.get("d2h", 0), \
+        f"ledger conservation broke: insights " \
+        f"({tot['h2d_bytes']}, {tot['d2h_bytes']}) vs ledger {led}"
+    c1 = TELEMETRY.metrics.to_dict()["counters"]
+    bodies_delta = c1.get("msearch.bodies", 0) - bodies0
+    assert abs(tot["queries"] - bodies_delta) <= 1, \
+        f"count conservation broke: {tot['queries']} notes vs " \
+        f"{bodies_delta} envelope bodies"
+    conservation = {
+        "shape_classes": len(real_shapes),
+        "count": {"per_shape_sum": sum_count,
+                  "msearch_bodies_delta": bodies_delta},
+        "scan": {"per_shape_posting": sum_posting,
+                 "heat_map_posting_delta": scan_dp,
+                 "per_shape_dense": sum_dense,
+                 "heat_map_dense_delta": scan_dd,
+                 "byte_exact": True},
+        "transfer": {"per_shape_h2d": sum_h2d,
+                     "ledger_h2d": led.get("h2d", 0),
+                     "per_shape_d2h": sum_d2h,
+                     "ledger_d2h": led.get("d2h", 0),
+                     "byte_exact": True},
+    }
+
+    overhead_pct = _insights_overhead_pct(tot["queries"], wall_s)
+    shed_ab = _insights_shed_ab()
+
+    res.pop("latencies_ms", None)
+    res.pop("queue_waits_ms", None)
+    res.pop("service_ms", None)
+    res.pop("statuses", None)
+    out = {
+        "metric": f"bm25_insights_{N_DOCS // 1000}k_docs_"
+                  f"{clients}c_{platform}",
+        "mode": f"bm25_insights_{clients}c_{rate:g}rps",
+        "value": res["qps"],
+        "unit": "queries/s",
+        "vs_baseline": round(res["qps"] / closed_qps, 3),
+        **{k: res[k] for k in ("clients", "arrival_rate", "n_requests",
+                               "duration_s", "p50_ms", "p99_ms",
+                               "p999_ms", "mean_queue_wait_ms",
+                               "service_p50_ms", "service_p99_ms",
+                               "errors")},
+        "closed_loop_qps": round(closed_qps, 2),
+        "co_batch_envelope_items": n_env,
+        "insights": snap,
+        "conservation": conservation,
+        "insights_overhead_pct": overhead_pct,
+        "shed_ab": shed_ab,
+    }
+    if _BACKEND_DIAG:
+        out["backend_diag"] = "; ".join(_BACKEND_DIAG)
+    with open(os.path.join(here, f"INSIGHTS_r{rnd:02d}.json"),
+              "w") as f:
+        f.write(json.dumps(out) + "\n")
     print(json.dumps(out))
 
 
@@ -2031,7 +2382,10 @@ def main():
                            INGEST_RATE_ARG)
         return
     if CLIENTS_ARG:
-        bench_openloop(CLIENTS_ARG, ARRIVAL_RATE_ARG or 50.0)
+        if INSIGHTS_ON:
+            bench_insights(CLIENTS_ARG, ARRIVAL_RATE_ARG or 50.0)
+        else:
+            bench_openloop(CLIENTS_ARG, ARRIVAL_RATE_ARG or 50.0)
         return
     mode = os.environ.get("BENCH_MODE", "bm25")
     if mode in ("knn_exact", "knn_ivf"):

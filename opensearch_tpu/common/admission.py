@@ -316,6 +316,22 @@ class DeadlineShedder:
         # traffic
         self.floor_quantile = 0.5
         self.exclusive_depth = 1
+        # shape-aware pricing (ISSUE 15): per-shape rolling service
+        # medians keyed on the query-insights shape id (telemetry/
+        # insights.py query_shape), behind its OWN off-by-default gate —
+        # a cheap `match_all` median must not price a heavy aggs
+        # arrival, and vice versa. Below shape_min_samples (or for an
+        # untracked shape / shape=None caller) pricing falls back to
+        # the global near-exclusive median, so the stage can never shed
+        # blinder than the global predictor. Bounded like the quota
+        # buckets: past the cap, new shapes fold into the overflow row.
+        self.shape_enabled = False
+        self.shape_min_samples = 8
+        self.max_tracked_shapes = 256
+        self._shape_rows: Dict[str, RollingEstimator] = {}
+        self._shape_counts: Dict[str, int] = {}
+        self.shape_hits = 0
+        self.shape_fallbacks = 0
         self._clock = clock
         self._lock = threading.Lock()
 
@@ -325,24 +341,94 @@ class DeadlineShedder:
             return None
         return self
 
-    def observe(self, service_ms: float, depth: int = 0) -> None:
+    def shape_gate(self) -> Optional["DeadlineShedder"]:
+        """The shape-pricing gate (its own flag ON TOP of the shed
+        stage's): None when shape-aware pricing is off — the REST layer
+        then never computes a shape key at admission, so the default
+        shed path costs nothing extra."""
+        if not self.shape_enabled:
+            return None
+        return self
+
+    def observe(self, service_ms: float, depth: int = 0,
+                shape: Optional[str] = None) -> None:
         """Record a measured service wall. `depth` = how many OTHER
         requests were in flight when this one released: contended
         walls are discarded (they would double-count queueing in the
         predictor — see predict_queue_ms). The estimator probes are
         admitted while everything else sheds, so they release at low
-        depth and keep this stream alive under sustained overload."""
+        depth and keep this stream alive under sustained overload.
+        `shape` (the caller's resolved shape id, shape pricing on)
+        feeds that shape's own estimator under the SAME near-exclusive
+        filter — a per-shape median of contended walls would re-import
+        exactly the double-count the global filter exists to kill."""
         if depth > self.exclusive_depth:
             return
         self.service_ms.observe(service_ms)
         with self._lock:
             self.observed_total += 1
+            if shape is not None and self.shape_enabled:
+                row = self._shape_rows.get(shape)
+                if row is None:
+                    if len(self._shape_rows) >= self.max_tracked_shapes:
+                        shape = "_other"
+                        row = self._shape_rows.get(shape)
+                    if row is None:
+                        row = self._shape_rows[shape] = \
+                            RollingEstimator()
+                self._shape_counts[shape] = \
+                    self._shape_counts.get(shape, 0) + 1
+            else:
+                row = None
+        if row is not None:
+            row.observe(service_ms)
 
-    def predicted_ms(self, queue_depth: int) -> Optional[float]:
+    def service_estimate(self, shape: Optional[str] = None) \
+            -> Optional[float]:
+        """The arrival's OWN-service term: the arriving shape's rolling
+        median once that shape has `shape_min_samples` near-exclusive
+        releases (shape pricing on), else the global median — the
+        fallback contract tests/test_insights.py pins. Counters record
+        which branch priced each call."""
+        if self.shape_enabled and shape is not None:
+            with self._lock:
+                row = self._shape_rows.get(shape)
+                warm = row is not None and \
+                    self._shape_counts.get(shape, 0) \
+                    >= self.shape_min_samples
+            if warm:
+                q = row.quantile(self.floor_quantile)
+                if q:
+                    with self._lock:
+                        self.shape_hits += 1
+                    return q
+            with self._lock:
+                self.shape_fallbacks += 1
+        return self.service_ms.quantile(self.floor_quantile)
+
+    def predicted_ms(self, queue_depth: int,
+                     shape: Optional[str] = None) -> Optional[float]:
         """The live queue-time estimate for a request arriving behind
-        `queue_depth` in-flight requests — the Retry-After basis."""
-        return predict_queue_ms(
-            self.service_ms.quantile(self.floor_quantile), queue_depth)
+        `queue_depth` in-flight requests — the Retry-After basis.
+
+        Shape pricing uses the MIXED model `global × depth + own`:
+        the queue ahead of the arrival is other requests of unknown
+        classes, so its drain time is priced with the global (mix)
+        median, while the arrival's OWN service slot is priced with
+        its shape's median. Pricing the whole queue at the arriving
+        shape's cost (`own × (depth+1)`) is measurably wrong in both
+        directions — a heavy arrival behind a queue of cache hits was
+        charged heavy × depth and shed work the node could serve
+        (goodput 327 → 120 in the A/B that caught it), and a cheap
+        arrival behind heavy in-flight work would be waved into a
+        deadline miss. A cold/unknown shape's `own` falls back to the
+        global median, collapsing to exactly the global model."""
+        base = self.service_ms.quantile(self.floor_quantile)
+        if self.shape_enabled and shape is not None:
+            own = self.service_estimate(shape)
+            if own is not None and base is not None:
+                return base * max(queue_depth, 0) + own
+        return predict_queue_ms(base, queue_depth)
 
     def budget_ms(self, deadline: Optional[float],
                   now: Optional[float] = None) -> Optional[float]:
@@ -362,17 +448,19 @@ class DeadlineShedder:
             return True
         return False
 
-    def check(self, queue_depth: int,
-              deadline: Optional[float]) -> Optional[float]:
+    def check(self, queue_depth: int, deadline: Optional[float],
+              shape: Optional[str] = None) -> Optional[float]:
         """None = admit; else the predicted queue time in ms (the shed
-        verdict + the Retry-After basis)."""
+        verdict + the Retry-After basis). `shape` routes pricing to
+        the arriving shape's own service median when shape pricing is
+        on and warm (global-median fallback otherwise)."""
         budget = self.budget_ms(deadline)
         if budget is None:
             return None
         with self._lock:
             if self.observed_total < self.min_samples:
                 return None     # never shed blind
-        predicted = self.predicted_ms(queue_depth)
+        predicted = self.predicted_ms(queue_depth, shape)
         if predicted is None or predicted <= budget:
             return None
         with self._lock:
@@ -405,12 +493,21 @@ class DeadlineShedder:
         return m
 
     def stats(self) -> dict:
+        with self._lock:
+            shape_block = {
+                "enabled": self.shape_enabled,
+                "min_samples": self.shape_min_samples,
+                "tracked": len(self._shape_rows),
+                "priced_by_shape": self.shape_hits,
+                "priced_by_global": self.shape_fallbacks,
+            }
         return {"enabled": self.enabled,
                 "slo_ms": self.slo_ms,
                 "shed_total": self.shed_total,
                 "probes": self.probes,
                 "min_samples": self.min_samples,
-                "service_ms": self.service_ms.summary()}
+                "service_ms": self.service_ms.summary(),
+                "shape_pricing": shape_block}
 
 
 class DeviceMemoryBreaker:
@@ -714,10 +811,13 @@ class AdmissionController:
     # ------------------------------------------------------------ admission
 
     def acquire(self, tenant: Optional[str] = None,
-                deadline: Optional[float] = None) -> None:
+                deadline: Optional[float] = None,
+                shape: Optional[str] = None) -> None:
         """Admit one search or raise the typed 429. Stage order is the
         documented pipeline; every adaptive stage is one attribute load
-        and a branch when disabled."""
+        and a branch when disabled. `shape` (resolved by the REST layer
+        only while the shed stage's shape_gate is on) routes deadline-
+        shed pricing to the arriving shape's own service median."""
         tenant = tenant or DEFAULT_TENANT
         quotas = self.quotas.gate()
         if quotas is not None:
@@ -745,7 +845,8 @@ class AdmissionController:
                 _downstream_reject(err)
         shedder = self.shedder.gate()
         if shedder is not None:
-            predicted = shedder.check(self.queue_depth(), deadline)
+            predicted = shedder.check(self.queue_depth(), deadline,
+                                      shape=shape)
             if predicted is not None:
                 _downstream_reject(self.rejection_error(
                     REASON_DEADLINE, tenant=tenant,
@@ -760,15 +861,18 @@ class AdmissionController:
         _downstream_reject(self.rejection_error(REASON_BACKPRESSURE,
                                                 tenant=tenant))
 
-    def release(self, service_ms: Optional[float] = None) -> None:
+    def release(self, service_ms: Optional[float] = None,
+                shape: Optional[str] = None) -> None:
         with self._lock:
             self.current = max(0, self.current - 1)
             self.released_total += 1
             depth = self.current
         if service_ms is not None and self.shedder.enabled:
             # depth AT RELEASE rides along: the shedder keeps only
-            # near-exclusive walls (contended ones double-count depth)
-            self.shedder.observe(service_ms, depth=depth)
+            # near-exclusive walls (contended ones double-count depth).
+            # `shape` feeds the per-shape estimator the shape-pricing
+            # stage reads (same near-exclusive filter).
+            self.shedder.observe(service_ms, depth=depth, shape=shape)
 
     def acquire_batch(self, n: int,
                       tenant: Optional[str] = None,
@@ -889,6 +993,10 @@ class AdmissionController:
                                    int),
             "shed_enabled": _bool("admission.shed.enabled"),
             "slo_ms": _num("admission.shed.slo_ms"),
+            "shape_enabled": _bool(
+                "admission.shed.shape_pricing.enabled"),
+            "shape_min_samples": _num(
+                "admission.shed.shape_pricing.min_samples", int),
             "quota_enabled": _bool("admission.quota.enabled"),
             "quota_rate": _num("admission.quota.tokens_per_sec"),
             "quota_burst": _num("admission.quota.burst"),
@@ -930,6 +1038,11 @@ class AdmissionController:
             self.shedder.enabled = p["shed_enabled"]
         if p["slo_ms"] is not None:
             self.shedder.slo_ms = p["slo_ms"] if p["slo_ms"] > 0 else None
+        if p["shape_enabled"] is not None:
+            self.shedder.shape_enabled = p["shape_enabled"]
+        if p["shape_min_samples"] is not None:
+            self.shedder.shape_min_samples = \
+                max(int(p["shape_min_samples"]), 1)
         if p["quota_enabled"] is not None:
             self.quotas.enabled = p["quota_enabled"]
         self.quotas.configure(rate=p["quota_rate"],

@@ -149,7 +149,11 @@ class Node:
             # like every other gate (the scan counters are always-on
             # and take no setting)
             devices=_tel_bool("telemetry.devices.enabled"),
-            spmd_timeline=_tel_bool("telemetry.spmd_timeline.enabled"))
+            spmd_timeline=_tel_bool("telemetry.spmd_timeline.enabled"),
+            # query insights (ISSUE 15): per-shape cost attribution +
+            # top-N heavy-query registry, OFF by default like every
+            # other gate (POST /_insights/_enable at runtime)
+            insights=_tel_bool("telemetry.insights.enabled"))
         self.controller = RestController()
         from opensearch_tpu.rest.actions import register_all
         register_all(self)

@@ -190,13 +190,22 @@ def _run_search(node, index_expr: Optional[str], body: Optional[dict],
         # finally releases — the permit-leak invariant
         # tools/chaos_sweep.py re-checks after every fault row.
         deadline = _parse_deadline(body)
+        # shape-aware shed pricing (ISSUE 15): resolve the query's
+        # shape id BEFORE admission — only while the shape-pricing gate
+        # is on, so the default shed path never pays the intern walk.
+        # The same id feeds the release-side per-shape service estimator.
+        shed_shape = None
+        if node.search_backpressure.shedder.shape_gate() is not None:
+            from opensearch_tpu.telemetry.insights import query_shape
+            shed_shape = query_shape(body.get("query"))[0]
         task = node.task_manager.register(
             "indices:data/read/search",
             description=f"indices[{index_expr or '_all'}]", cancellable=True)
         t_admit = time.monotonic() if tl is not None else 0.0
         try:
             node.search_backpressure.acquire(tenant=tenant,
-                                             deadline=deadline)
+                                             deadline=deadline,
+                                             shape=shed_shape)
         except OpenSearchTpuError as rej:
             # the span for a rejected request still closes, with its own
             # status — rejections must be visible in traces, not lost
@@ -213,6 +222,11 @@ def _run_search(node, index_expr: Optional[str], body: Optional[dict],
                 flight.complete(tl, status="rejected", span=root)
             raise
         t_exec0 = time.monotonic()
+        # insights tenant binding (ISSUE 15): the executor/controller
+        # note reads the request's tenant back thread-locally for the
+        # per-shape tenant breakdown (disabled = one attribute load)
+        ins = TELEMETRY.insights.gate()
+        ins_prev = ins.bind_tenant(tenant) if ins is not None else None
         try:
             if tl is not None:
                 # the admission gate's own wait (~0; the scheduler's
@@ -251,11 +265,15 @@ def _run_search(node, index_expr: Optional[str], body: Optional[dict],
                     trace=root, phase_times=phase_times,
                     allow_partial=_cluster_allow_partial(node))
         finally:
+            if ins is not None:
+                ins.unbind_tenant(ins_prev)
             node.task_manager.unregister(task)
             # the measured service wall feeds the deadline-shed
-            # predictor's rolling estimator (common/admission.py)
+            # predictor's rolling estimator (common/admission.py) —
+            # per-shape too when shape pricing resolved one
             node.search_backpressure.release(
-                service_ms=(time.monotonic() - t_exec0) * 1000.0)
+                service_ms=(time.monotonic() - t_exec0) * 1000.0,
+                shape=shed_shape)
         res.pop("_page_cursor", None)
         if pipeline is not None:
             res = pipeline.process_response(res, ctx, targets=services,
@@ -319,6 +337,12 @@ def _maybe_slow_log(node, index_expr, body, res, phase_times=None):
     # line-parsers see a fixed shape.
     bytes_fetched = int(phase_times.get("bytes_fetched", 0) or 0)
     device_get_ms = float(phase_times.get("device_get", 0.0) or 0.0)
+    # the query's shape id (ISSUE 15): the interned template signature
+    # (fallback structural hash) telemetry/insights.py groups costs by —
+    # a slow-log line joins its insights shape row without re-parsing
+    # the body. Resolved lazily: only a line that actually fires pays
+    # the intern walk.
+    shape_id = None
     for name in node.indices.resolve(index_expr, ignore_unavailable=True):
         settings = node.indices.get(name).settings
         for phase, t_ms in phase_ms.items():
@@ -334,12 +358,17 @@ def _maybe_slow_log(node, index_expr, body, res, phase_times=None):
                     continue        # unparseable threshold never logs
                 if threshold_s < 0 or t_ms < threshold_s * 1000:
                     continue
+                if shape_id is None:
+                    from opensearch_tpu.telemetry.insights import \
+                        query_shape
+                    shape_id = query_shape((body or {}).get("query"))[0]
                 _slow_logger(phase).log(
                     py_level,
                     "[%s] took[%sms], took[%s][%.1fms], total_hits[%s], "
-                    "bytes_fetched[%s], device_get_ms[%.1f], source[%s]",
+                    "bytes_fetched[%s], device_get_ms[%.1f], shape[%s], "
+                    "source[%s]",
                     name, took_ms, phase, t_ms, total_hits,
-                    bytes_fetched, device_get_ms, body)
+                    bytes_fetched, device_get_ms, shape_id, body)
                 break               # most severe matching level only
 
 
@@ -998,6 +1027,11 @@ def register_search_actions(node, c):
                     node.search_backpressure.acquire_batch_ex(
                         len(bodies), tenant=tenant, deadline=deadline)
                 tl_prev = None
+                # insights tenant binding (ISSUE 15): the envelope's
+                # per-item notes read it back thread-locally
+                ins = TELEMETRY.insights.gate()
+                ins_prev = ins.bind_tenant(tenant) \
+                    if ins is not None else None
                 t_exec0 = time.monotonic()
                 try:
                     if tl is not None:
@@ -1072,6 +1106,8 @@ def register_search_actions(node, c):
                         s.end(error=e)
                     raise
                 finally:
+                    if ins is not None:
+                        ins.unbind_tenant(ins_prev)
                     node.task_manager.unregister(task)
                     node.search_backpressure.release_batch(
                         admitted,
@@ -2443,6 +2479,37 @@ def register_telemetry_actions(node, c):
         INGEST_EVENTS.clear()
         return {"acknowledged": True}
 
+    def do_get_insights(req):
+        # query insights (ISSUE 15): per-shape cost attribution rows +
+        # the three heavy-query top-N registries — the reference Query
+        # Insights analog over the interned-template shape vocabulary
+        return {"insights": TELEMETRY.insights.snapshot(top=True)}
+
+    def do_top_queries(req):
+        from opensearch_tpu.telemetry.insights import TOP_METRICS
+        metric = req.param("metric", "latency")
+        if metric not in TOP_METRICS:
+            raise IllegalArgumentError(
+                f"unknown insights metric [{metric}] (one of "
+                f"{', '.join(TOP_METRICS)})")
+        size = req.int_param("size", 0)
+        return {"enabled": TELEMETRY.insights.enabled,
+                "metric": metric,
+                "top_queries": TELEMETRY.insights.top_queries(
+                    metric, size or None)}
+
+    def do_insights_enable(req):
+        TELEMETRY.insights.enabled = True
+        return {"acknowledged": True, "enabled": True}
+
+    def do_insights_disable(req):
+        TELEMETRY.insights.enabled = False
+        return {"acknowledged": True, "enabled": False}
+
+    def do_insights_clear(req):
+        TELEMETRY.insights.clear()
+        return {"acknowledged": True}
+
     def do_get_devices(req):
         # sharded-serving observability (ISSUE 14): per-device
         # transfer/phase aggregates + straggler skew, next to the
@@ -2493,6 +2560,11 @@ def register_telemetry_actions(node, c):
     c.register("POST", "/_telemetry/devices/_disable",
                do_devices_disable)
     c.register("POST", "/_telemetry/devices/_clear", do_devices_clear)
+    c.register("GET", "/_insights", do_get_insights)
+    c.register("GET", "/_insights/top_queries", do_top_queries)
+    c.register("POST", "/_insights/_enable", do_insights_enable)
+    c.register("POST", "/_insights/_disable", do_insights_disable)
+    c.register("POST", "/_insights/_clear", do_insights_clear)
 
 
 # -------------------------------------------------------------------- tasks

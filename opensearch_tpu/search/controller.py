@@ -236,6 +236,42 @@ class _PhaseTimer:
         return False
 
 
+def _note_controller_insights(query_spec, took_ms, req_scope) -> None:
+    """Per-shape cost note for controller-served requests (ISSUE 15):
+    the general host loop, the SPMD path and the fused hybrid branch —
+    everything the msearch envelope does NOT note itself. Shape id from
+    the interned template signature (fallback: structural hash); scan
+    bytes joined through the recorder's thread-local accumulator (the
+    query phase / SPMD path feed it the SAME bytes the heat map
+    counts); transfer bytes/round trips from the request's LedgerScope
+    when the ledger is on. Also stamps the shape onto the bound
+    lifecycle timeline so tail captures group by shape class — that
+    annotation rides the flight recorder's own gate, not insights'.
+    Both gates off = two attribute loads and branches."""
+    from opensearch_tpu.telemetry import TELEMETRY
+    ins = TELEMETRY.insights.gate()
+    tl = TELEMETRY.flight.current() if TELEMETRY.flight.enabled else None
+    if ins is None and tl is None:
+        return
+    from opensearch_tpu.telemetry.insights import query_shape
+    label, kind = query_shape(query_spec)
+    if tl is not None and tl.shape is None:
+        tl.shape = label
+    if ins is None:
+        return
+    sp, sd = ins.take_scan()
+    ins.note(
+        label, kind=kind, took_ms=float(took_ms),
+        device_ms=req_scope.device_get_ms
+        if req_scope is not None else 0.0,
+        posting_bytes=sp, dense_bytes=sd,
+        h2d_bytes=req_scope.h2d_bytes if req_scope is not None else 0,
+        d2h_bytes=req_scope.d2h_bytes if req_scope is not None else 0,
+        round_trips=req_scope.round_trips
+        if req_scope is not None else 0,
+        co_batched=1, tenant=ins.current_tenant())
+
+
 def _publish_scope(scope, span, phase_times: Optional[dict]) -> None:
     """Attach a request's transfer accounting (telemetry/ledger.py
     LedgerScope) to its span and to the caller's phase_times dict, where
@@ -353,6 +389,11 @@ def _execute_search_impl(executors: List, body: Optional[dict],
     # host loop, envelope, hybrid) — the attribution used to exist only
     # in the general path's single-branch sum.
     req_scope = TELEMETRY.ledger.scope(trace)
+    if TELEMETRY.insights.enabled:
+        # clear stale thread-local scan residue (an earlier errored
+        # request on this thread must not leak bytes into this one's
+        # per-shape join)
+        TELEMETRY.insights.take_scan()
     query_spec = body.get("query")
     if isinstance(query_spec, dict) and "hybrid" in query_spec:
         # hybrid dense+sparse clause: its sub-queries keep SEPARATE score
@@ -378,6 +419,8 @@ def _execute_search_impl(executors: List, body: Optional[dict],
             tl = TELEMETRY.flight.current()
             if tl is not None and req_scope is not None:
                 tl.merge_phases({"device_get": req_scope.device_get_ms})
+        _note_controller_insights(query_spec, res.get("took", 0),
+                                  req_scope)
         return res
     if (allow_envelope and len(executors) == 1 and total_shards is None
             and failed_shards == 0 and cursor_tiebreak is None
@@ -556,8 +599,22 @@ def _execute_search_impl(executors: List, body: Optional[dict],
             with _PhaseTimer(trace, phases, "query", path="spmd",
                              rows=len(rows)) as qt:
                 try:
-                    out = spmd.spmd_query_phase(executors, body, k_eff,
-                                                extra_filters, rows)
+                    # the SPMD path attributes its transfers to the
+                    # thread-ambient ledger scope (upload.literals /
+                    # spmd.results in parallel/distributed.py read
+                    # ledger.current()); binding the request scope here
+                    # routes them onto THIS request — the per-shape
+                    # transfer join (ISSUE 15) and the Profile/slow-log
+                    # byte fields on SPMD-served requests both need it.
+                    # Safe: the SPMD query phase is single-request.
+                    if req_scope is not None:
+                        with TELEMETRY.ledger.ambient(req_scope):
+                            out = spmd.spmd_query_phase(
+                                executors, body, k_eff, extra_filters,
+                                rows)
+                    else:
+                        out = spmd.spmd_query_phase(
+                            executors, body, k_eff, extra_filters, rows)
                 except TaskCancelledError:
                     raise
                 except Exception:   # except-ok: SPMD isolation -- any failure class degrades to the per-shard host loop
@@ -842,6 +899,9 @@ def _execute_search_impl(executors: List, body: Optional[dict],
             if req_scope is not None:
                 tl.merge_phases({"device_get": req_scope.device_get_ms})
             tl.mark_ready()
+    # per-shape cost attribution (ISSUE 15) for the general/SPMD path —
+    # after took/phases are final, before render-only bookkeeping
+    _note_controller_insights(query_spec, took_f, req_scope)
     if profiling:
         # per-shard per-phase breakdown: coordinator phases (parse,
         # can_match, reduce, fetch, render) are shared across shards,

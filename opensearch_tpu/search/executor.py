@@ -67,6 +67,22 @@ _LEDGER = TELEMETRY.ledger
 _DEVMEM = TELEMETRY.device_memory
 _FLIGHT = TELEMETRY.flight
 _CHURN = TELEMETRY.churn
+# query insights (telemetry/insights.py, ISSUE 15): per-shape cost
+# attribution — the envelope notes every completed sub-request at wave
+# merge, joined to its interned template signature / structural hash
+_INSIGHTS = TELEMETRY.insights
+
+
+def _item_shape(node, body: dict) -> Tuple[str, str]:
+    """(shape id, kind) for one envelope item: the interned template's
+    signature when the item interned (`node` is the QueryTemplate the
+    parse loop resolved — no second intern walk), else the structural
+    hash of the raw query body."""
+    from opensearch_tpu.telemetry.insights import (
+        structural_shape, template_shape)
+    if isinstance(node, dsl.QueryTemplate):
+        return template_shape(node.sig), "template"
+    return structural_shape(body.get("query")), "hash"
 
 
 def _shape_sig(tree, prefix="") -> tuple:
@@ -1851,6 +1867,12 @@ class SearchExecutor:
 
         if launched:
             SCAN.note_query(q_posting, q_dense)
+            ins = _INSIGHTS.gate()
+            if ins is not None:
+                # the per-request scan join (ISSUE 15): the SAME bytes
+                # the heat map just counted, accumulated thread-locally
+                # for the controller's per-shape note at request end
+                ins.add_scan(q_posting, q_dense)
 
         def _collect():
             if faults.ENABLED:
@@ -2038,7 +2060,8 @@ class SearchExecutor:
                      trace=None,
                      phase_times: Optional[dict] = None,
                      waves: Optional[int] = None,
-                     timelines: Optional[list] = None) -> dict:
+                     timelines: Optional[list] = None,
+                     tenants: Optional[list] = None) -> dict:
         """_msearch: execute many search bodies, batching same-shaped
         score-sorted queries into single vmapped device programs per segment
         (reference: action/search/TransportMultiSearchAction fans bodies out
@@ -2082,24 +2105,28 @@ class SearchExecutor:
         batch-of-batches entry (search/scheduler.py) — wave events fan
         out to each owning request's lifecycle and the envelope itself
         owns NO timeline (the foreign requests' own wrappers complete
-        theirs)."""
+        theirs).
+        tenants: per-body tenant ids from the scheduler (aligned with
+        `timelines`) — the insights recorder's per-shape tenant
+        breakdown reads them per item on coalesced waves; inline paths
+        ride the thread-local binding instead."""
         if timelines is not None or not _FLIGHT.enabled \
                 or _FLIGHT.current() is not None:
             return self._multi_search_impl(
                 bodies, _bypass_request_cache, _raise_item_errors, task,
-                deadline, trace, phase_times, waves, timelines)
+                deadline, trace, phase_times, waves, timelines, tenants)
         tl = _FLIGHT.timeline()
         if tl is None:      # disabled race: behave as the gate said
             return self._multi_search_impl(
                 bodies, _bypass_request_cache, _raise_item_errors, task,
-                deadline, trace, phase_times, waves)
+                deadline, trace, phase_times, waves, tenants=tenants)
         tl.event("admit")
         prev = _FLIGHT.bind(tl)
         status = "error"
         try:
             res = self._multi_search_impl(
                 bodies, _bypass_request_cache, _raise_item_errors, task,
-                deadline, trace, phase_times, waves)
+                deadline, trace, phase_times, waves, tenants=tenants)
             status = "ok"
             return res
         finally:
@@ -2114,7 +2141,8 @@ class SearchExecutor:
                            trace=None,
                            phase_times: Optional[dict] = None,
                            waves: Optional[int] = None,
-                           timelines: Optional[list] = None) -> dict:
+                           timelines: Optional[list] = None,
+                           tenants: Optional[list] = None) -> dict:
         TELEMETRY.metrics.counter("msearch.requests").inc()
         TELEMETRY.metrics.counter("msearch.bodies").inc(len(bodies))
         scope = _LEDGER.scope(trace)
@@ -2153,7 +2181,12 @@ class SearchExecutor:
                 responses, i, _raise_item_errors,
                 lambda: self._msearch_parse_one(
                     i, body or {}, responses, batchable, hybrid_items,
-                    resp_cache_keys, _bypass_request_cache, start))
+                    resp_cache_keys, _bypass_request_cache, start,
+                    # the per-item tenant rides into the cache-hit note:
+                    # on a scheduler-coalesced envelope this loop runs
+                    # on the scheduler thread, where the REST layer's
+                    # thread-local binding never reached
+                    tenant=tenants[i] if tenants is not None else None))
 
         ph["parse"] += time.monotonic() - _t
         # Overlapped multi-wave dispatch: the batchable list splits into
@@ -2195,7 +2228,7 @@ class SearchExecutor:
                 deadline=deadline, scope=scope,
                 resp_cache_keys=resp_cache_keys,
                 allow_pipeline=allow_pipeline, timeline=tl,
-                item_timelines=timelines)
+                item_timelines=timelines, item_tenants=tenants)
         # parse always runs; the wave phases only get a sample when a
         # batched wave actually executed — otherwise every all-general or
         # all-hybrid envelope would log spurious 0-ms device_get/respond
@@ -2250,7 +2283,8 @@ class SearchExecutor:
                            resp_cache_keys: Optional[dict] = None,
                            allow_pipeline: bool = True,
                            timeline=None,
-                           item_timelines: Optional[list] = None) -> None:
+                           item_timelines: Optional[list] = None,
+                           item_tenants: Optional[list] = None) -> None:
         """Drive the wave engine: prepare + async-dispatch each wave on
         THIS thread, collect on the collector thread (bounded in-flight
         window), and merge per-wave phase times, ledger scopes and
@@ -2416,6 +2450,104 @@ class SearchExecutor:
                 pipeline_error = wave.error
         if pipeline_error is not None:
             raise pipeline_error
+        self._note_wave_insights(dispatched, responses, timeline,
+                                 item_timelines, item_tenants)
+
+    def _note_wave_insights(self, dispatched: List[_MsearchWave],
+                            responses, timeline,
+                            item_timelines: Optional[list],
+                            item_tenants: Optional[list]) -> None:
+        """Per-item insights notes + timeline shape annotation at wave
+        merge (ISSUE 15): runs on the dispatching thread AFTER the
+        collector drained, so every wave's responses, phase walls and
+        ledger scope are final (single writer — no lock beyond the
+        recorder's own). Shared wave costs split across the wave's live
+        grouped items exactly as the scheduler's `device_share_ms`
+        split: the device_get wall divides evenly, ledger byte/round-
+        trip integers divide with the remainder landing on the first
+        live item so per-shape totals conserve EXACTLY against the
+        global ledger. Scan bytes were attributed per item at prepare
+        (including items a mid-envelope deadline later expired — the
+        heat map counted their compile-time scan, so the per-shape join
+        must too). Runs only when prepare built shape meta: insights or
+        flight recorder enabled."""
+        ins = _INSIGHTS.gate()
+        for wave in dispatched:
+            meta = (wave.state or {}).get("insights")
+            if not meta:
+                continue
+            dead = (wave.state or {}).get("dead") or set()
+            co = len(wave.items)
+            live = [i for i in meta
+                    if meta[i]["grouped"] and i not in dead
+                    and isinstance(responses[i], dict)
+                    and "error" not in responses[i]]
+            if not live:
+                # every grouped item errored or deadline-expired, but
+                # the wave's uploads may already have crossed (the
+                # ledger counted them): split over ALL grouped items so
+                # the per-shape byte totals still conserve exactly
+                # against the global ledger
+                live = [i for i in sorted(meta) if meta[i]["grouped"]]
+            n_live = len(live)
+            live_set = set(live)
+            # the wave's shared device wall: the finish half's measured
+            # device_get (seconds in wave.ph), the ledger's attributed
+            # wall for hybrid waves, else the collect duration
+            dev_ms = wave.ph.get("device_get", 0.0) * 1000.0
+            if not dev_ms and wave.scope is not None:
+                dev_ms = wave.scope.device_get_ms
+            if not dev_ms and wave.collect_t1:
+                dev_ms = (wave.collect_t1 - wave.collect_t0) * 1000.0
+            h2d = wave.scope.h2d_bytes if wave.scope is not None else 0
+            d2h = wave.scope.d2h_bytes if wave.scope is not None else 0
+            rts = wave.scope.round_trips if wave.scope is not None else 0
+            dev_share = dev_ms / n_live if n_live else 0.0
+            h2d_q, h2d_r = divmod(h2d, n_live) if n_live else (0, 0)
+            d2h_q, d2h_r = divmod(d2h, n_live) if n_live else (0, 0)
+            rt_q, rt_r = divmod(rts, n_live) if n_live else (0, 0)
+            rem_pending = n_live > 0
+            for i in sorted(meta):
+                m = meta[i]
+                resp = responses[i]
+                if not isinstance(resp, dict):
+                    continue        # never answered (catastrophic wave)
+                in_split = i in live_set
+                eh, ed, er = (h2d_q, d2h_q, rt_q) if in_split \
+                    else (0, 0, 0)
+                if in_split and rem_pending:
+                    eh, ed, er = eh + h2d_r, ed + d2h_r, er + rt_r
+                    rem_pending = False
+                tl_i = item_timelines[i] \
+                    if item_timelines is not None else timeline
+                if tl_i is not None and \
+                        getattr(tl_i, "shape", "") is None:
+                    # the tail-capture shape annotation ("which shape
+                    # owns the p99" — tools/tail_report.py): first
+                    # resolved item wins for a multi-item envelope's
+                    # single owned timeline; scheduler-coalesced waves
+                    # stamp each owner with its OWN item's shape
+                    tl_i.shape = m["label"]
+                if ins is None:
+                    continue
+                status = "error" if "error" in resp else "ok"
+                ins.note(
+                    m["label"], kind=m["kind"],
+                    took_ms=float(resp.get("took", 0))
+                    if status == "ok" else 0.0,
+                    device_ms=dev_share if in_split else 0.0,
+                    posting_bytes=m["posting"],
+                    dense_bytes=m["dense"],
+                    h2d_bytes=eh, d2h_bytes=ed, round_trips=er,
+                    co_batched=co,
+                    # warm=None (hybrid) = no bundle verdict exists:
+                    # count neither compiled nor warm
+                    compiled=m["warm"] is False,
+                    warm_hit=bool(m["warm"]),
+                    status=status,
+                    tenant=item_tenants[i]
+                    if item_tenants is not None
+                    else ins.current_tenant())
 
     def _collect_wave(self, wave: _MsearchWave, responses,
                       start: float) -> None:
@@ -2469,7 +2601,8 @@ class SearchExecutor:
     def _msearch_parse_one(self, i: int, body: dict, responses, batchable,
                            hybrid_items, resp_cache_keys,
                            bypass_request_cache: bool,
-                           start: float) -> None:
+                           start: float,
+                           tenant: Optional[str] = None) -> None:
         """One sub-request of the parse loop: route to the general path /
         hybrid envelope / request cache, or intern + validate it into the
         batchable list. Raises OpenSearchTpuError for malformed items —
@@ -2507,6 +2640,20 @@ class SearchExecutor:
                 hit = _cache_get_isolated(rc, key)
                 if hit is not rc.REQUEST_CACHE._MISS:
                     responses[i] = self._render_cached_msearch(hit, start)
+                    ins = _INSIGHTS.gate()
+                    if ins is not None:
+                        # a cache-served sub-request is still a
+                        # completed request of its shape: count it
+                        # (zero device/scan bytes — the scan counters
+                        # don't see cache hits either, so per-shape
+                        # totals stay byte-exact vs the heat map)
+                        label, kind = _item_shape(tpl, body)
+                        ins.note(label, kind=kind,
+                                 took_ms=float(
+                                     responses[i].get("took", 0)),
+                                 cached=True,
+                                 tenant=tenant if tenant is not None
+                                 else ins.current_tenant())
                     return
                 resp_cache_keys[i] = key
         if tpl is None:
@@ -2557,6 +2704,10 @@ class SearchExecutor:
         compiler = Compiler(self.reader.mapper, stats)
         prepared: Dict[int, tuple] = {}
         groups: Dict[Any, List[int]] = {}
+        # per-item shape meta (ISSUE 15): hybrid bodies are never
+        # internable, so their shape class is the structural hash
+        ins_items: Optional[Dict[int, dict]] = {} \
+            if (_INSIGHTS.enabled or _FLIGHT.enabled) else None
         for i, body in items:
             try:
                 min_score = _req_min_score(body)
@@ -2594,6 +2745,17 @@ class SearchExecutor:
                 continue
             prepared[i] = (body, n_sub, min_score, plans_per_seg,
                            flats_per_seg)
+            if ins_items is not None:
+                from opensearch_tpu.telemetry.insights import \
+                    structural_shape
+                # warm=None: the hybrid path has no per-item bundle
+                # memo, so a warm-vs-compiled verdict would be a guess —
+                # the note pass counts NEITHER rather than reporting
+                # compiled=True for every warm repeat
+                ins_items[i] = {
+                    "label": structural_shape(body.get("query")),
+                    "kind": "hash", "posting": 0, "dense": 0,
+                    "grouped": True, "warm": None, "interned": False}
             struct = tuple(
                 tuple(p.sig() for p in plans) if plans is not None
                 else None for plans in plans_per_seg)
@@ -2660,6 +2822,7 @@ class SearchExecutor:
         return {"prepared": prepared, "pending": pending, "dead": dead,
                 "raise_item_errors": raise_item_errors,
                 "staging": staging,
+                "insights": ins_items,
                 "wave_buffer_bytes": wave_buffer_bytes}
 
     def _msearch_hybrid_finish(self, state: dict, responses,
@@ -2818,6 +2981,13 @@ class SearchExecutor:
         # below — the disabled-lock discipline the <2% gate demands
         _scan_rows: Dict[Any, list] = {}
         _scan_per_query: List = []
+        # per-item shape meta (ISSUE 15): shape id + scan bytes + bundle
+        # verdict, read back by the wave-merge note pass. Built when the
+        # insights recorder wants cost rows OR the flight recorder wants
+        # the shape annotation on captured timelines; both gates off =
+        # one attribute load + branch, nothing allocates.
+        ins_items: Optional[Dict[int, dict]] = {} \
+            if (_INSIGHTS.enabled or _FLIGHT.enabled) else None
         compiled: Dict[int, List[Optional[Plan]]] = {}
         flats_by_i: Dict[int, List[Optional[list]]] = {}
         agg_by_i: Dict[int, List[list]] = {}      # i -> per-seg AggPlans
@@ -2854,6 +3024,7 @@ class SearchExecutor:
                 bkey = ("qenv", mapper_version, tpl.sig, tpl.literals,
                         agg_json)
                 bundle = stats.memo.get(bkey)
+            bundle_hit = bundle is not None
             if bundle is None:
                 if tpl is not None:
                     _BUNDLE_MISSES.inc()
@@ -2894,6 +3065,13 @@ class SearchExecutor:
                     responses[i] = _base_response(
                         int((time.monotonic() - start) * 1000), 0, None,
                         [])
+                    if ins_items is not None:
+                        label, kind = _item_shape(node, body)
+                        ins_items[i] = {
+                            "label": label, "kind": kind, "posting": 0,
+                            "dense": 0, "grouped": False,
+                            "warm": bundle_hit,
+                            "interned": tpl is not None}
                 continue
             compiled[i] = plans
             flats_by_i[i] = flats
@@ -2908,8 +3086,20 @@ class SearchExecutor:
             # budget, dense otherwise), so the heat map's kernel mix
             # reflects what actually dispatches. One attribute read
             # per warm (memoized) plan, no per-lane work, no lock.
+            n_scan0 = len(_scan_per_query)
             _scan_accumulate_item(device, plans, _scan_rows,
                                   _scan_per_query)
+            if ins_items is not None:
+                # the per-item scan join (ISSUE 15): the SAME tuple the
+                # always-on heat map just accumulated, so per-shape
+                # totals conserve byte-exactly against telemetry.scan
+                sp, sd = _scan_per_query[-1] \
+                    if len(_scan_per_query) > n_scan0 else (0, 0)
+                label, kind = _item_shape(node, body)
+                ins_items[i] = {"label": label, "kind": kind,
+                                "posting": sp, "dense": sd,
+                                "grouped": True, "warm": bundle_hit,
+                                "interned": tpl is not None}
 
         from opensearch_tpu.telemetry.scan import SCAN
         SCAN.note_batch(self.reader.index_name,
@@ -3029,6 +3219,8 @@ class SearchExecutor:
                 "agg_nodes_by_i": agg_nodes_by_i, "dead": dead,
                 "staging": staging,
                 "wave_buffer_bytes": wave_buffer_bytes,
+                # per-item shape meta for the insights note pass
+                "insights": ins_items,
                 # the wave's (segments, device) anchor: finish resolves
                 # seg_i hits against THIS list, never a later publish
                 "segments": segments}

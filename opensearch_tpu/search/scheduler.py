@@ -555,11 +555,20 @@ class WaveScheduler:
         # split below proportionally like the wall (None keeps the
         # disabled path at one attribute load + branch)
         pt = {} if TELEMETRY.ledger.enabled else None
+        # per-item tenants ride along for the insights recorder's
+        # per-shape tenant breakdown (ISSUE 15): the shared dispatch
+        # runs on the scheduler thread, so the REST layer's thread-local
+        # binding cannot reach it — the owning requests' tenants go per
+        # item, aligned with `timelines` (None = recorder off, one
+        # attribute load + branch)
+        tenants = [item.tenant for item in live
+                   for _ in item.bodies] \
+            if TELEMETRY.insights.enabled else None
         t0 = time.monotonic()
         try:
             res = live[0].target.multi_search(
                 bodies, deadline=group_deadline, timelines=timelines,
-                phase_times=pt)
+                phase_times=pt, tenants=tenants)
             responses = res["responses"]
         except BaseException as e:  # except-ok: waiter wakeup -- a dispatch failure delivers the error to every blocked request thread instead of stranding them on the Event
             for item in live:

@@ -354,6 +354,12 @@ def _spmd_query_phase_raw(executors: List, body: dict, k: int,
         q_posting += posting
         q_dense += dense
     SCAN.note_query(q_posting, q_dense)
+    from opensearch_tpu.telemetry import TELEMETRY as _TEL
+    _ins = _TEL.insights.gate()
+    if _ins is not None:
+        # the per-request scan join (ISSUE 15): same bytes as the heat
+        # map, thread-local, read back by the controller's shape note
+        _ins.add_scan(q_posting, q_dense)
 
     if cap is not None:
         if tl is not None:

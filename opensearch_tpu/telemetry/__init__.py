@@ -46,6 +46,7 @@ from opensearch_tpu.telemetry.ledger import (
 from opensearch_tpu.telemetry.lifecycle import (
     INGEST_EVENTS, FlightRecorder, IngestEventLog, IngestRecorder,
     SpmdTimeline, Timeline)
+from opensearch_tpu.telemetry.insights import INSIGHTS, QueryInsights
 from opensearch_tpu.telemetry.metrics import MetricsRegistry
 from opensearch_tpu.telemetry.rolling import RollingEstimator
 from opensearch_tpu.telemetry.scan import SCAN, ScanAccounting
@@ -58,7 +59,7 @@ __all__ = ["TELEMETRY", "TelemetryService", "Span", "NOOP_SPAN",
            "FlightRecorder", "Timeline", "IngestRecorder",
            "IngestEventLog", "INGEST_EVENTS", "ChurnLedger",
            "ChurnScope", "DeviceLedger", "DeviceScope", "SpmdTimeline",
-           "ScanAccounting", "SCAN"]
+           "ScanAccounting", "SCAN", "QueryInsights", "INSIGHTS"]
 
 
 class TelemetryService:
@@ -86,6 +87,11 @@ class TelemetryService:
         self.device_ledger = self.ledger.devices
         self.spmd_timeline = SpmdTimeline()
         self.scan = SCAN
+        # query insights (ISSUE 15): per-shape cost attribution + the
+        # heavy-query top-N registry, OFF by default behind a
+        # None-returning gate() — the "which queries cost what" join
+        # over interning + lifecycle + scan + ledger
+        self.insights = INSIGHTS
 
     def configure(self, data_path: Optional[str] = None,
                   enabled: bool = False, jsonl: bool = False,
@@ -94,7 +100,8 @@ class TelemetryService:
                   tail_threshold_ms: Optional[float] = None,
                   ingest: bool = False, churn: bool = False,
                   devices: bool = False,
-                  spmd_timeline: bool = False) -> None:
+                  spmd_timeline: bool = False,
+                  insights: bool = False) -> None:
         """Bind to a node's settings/data dir. Called from Node.__init__;
         re-configuration by a later Node in the same process wins (the
         singleton is process-wide, like WARMUP)."""
@@ -106,6 +113,7 @@ class TelemetryService:
         self.churn.enabled = bool(churn)
         self.device_ledger.enabled = bool(devices)
         self.spmd_timeline.enabled = bool(spmd_timeline)
+        self.insights.enabled = bool(insights)
         self.tracer.resize(ring_size)
         self.tracer.jsonl_path = None
         self.flight.jsonl_path = None
@@ -140,7 +148,10 @@ class TelemetryService:
                 # attribution + the always-on scanned-bytes heat map
                 # (the block-max trigger metric, live)
                 "devices": self.device_ledger.snapshot(),
-                "scan": self.scan.stats()}
+                "scan": self.scan.stats(),
+                # query insights (ISSUE 15): per-shape cost attribution
+                # (the top-N rings ride GET /_insights, not this block)
+                "insights": self.insights.snapshot()}
 
 
 # process-wide singleton, like REQUEST_CACHE / QUERY_CACHE / WARMUP

@@ -98,7 +98,7 @@ class Timeline:
 
     __slots__ = ("t_arrive", "t_ready", "events", "phases",
                  "queue_wait_ms", "device_share_ms", "took_ms", "status",
-                 "detail")
+                 "detail", "shape")
 
     def __init__(self):
         self.t_arrive = time.monotonic()
@@ -119,6 +119,13 @@ class Timeline:
         # 1000-op bulk accumulates phases only, or its event list would
         # balloon to 3N tuples)
         self.detail = False
+        # the request's shape class (ISSUE 15): the interned-template /
+        # structural-hash id telemetry/insights.py groups costs by —
+        # stamped by the executor/controller when they resolve it, so a
+        # tail capture answers "which shape owns this p99" the way
+        # ingest_events answers "did a merge cause it" (None = the
+        # serving path never resolved one, e.g. a rejected request)
+        self.shape: Optional[str] = None
 
     def event(self, name: str, **fields) -> None:
         self.events.append(
@@ -196,6 +203,8 @@ class Timeline:
         }
         if self.device_share_ms:
             out["device_share_ms"] = round(self.device_share_ms, 3)
+        if self.shape is not None:
+            out["shape"] = self.shape
         if self.phases:
             out["phases"] = {name: round(ms, 3)
                              for name, ms in self.phases.items()}

@@ -398,3 +398,61 @@ def test_scaling_report_empty_input(tmp_path):
     import scaling_report
     path = _write(tmp_path / "empty.json", [])
     assert scaling_report.main(["scaling_report.py", path]) == 1
+
+
+# ----------------------------------------------- query insights (ISSUE 15)
+
+def _insights_rec(p99_by_shape, count=50):
+    return {"mode": "bm25_insights_8c_120rps", "p50_ms": 1.0,
+            "p99_ms": 5.0, "clients": 8,
+            "insights": {"shapes": {
+                s: {"count": count, "p50_ms": 1.0, "p99_ms": p99}
+                for s, p99 in p99_by_shape.items()}}}
+
+
+def test_insights_records_skip_generic_warm_gate():
+    # the record's aggregate p99 moves with the shape MIX — only the
+    # per-shape gate may judge it
+    old = {"bm25_insights_8c_120rps": _insights_rec({"match:aa": 2.0})}
+    new = {"bm25_insights_8c_120rps": _insights_rec({"match:aa": 50.0})}
+    rows, failures = bench_compare.compare(old, new, 10.0)
+    assert not rows and not failures
+
+
+def test_insights_per_shape_p99_regression_fails_at_equal_key():
+    old = {"x": _insights_rec({"match:aa": 10.0, "bool:bb": 20.0})}
+    new = {"x": _insights_rec({"match:aa": 11.6, "bool:bb": 20.0})}
+    rows, failures = bench_compare.compare_insights(old, new, 10.0)
+    assert failures and "match:aa" in failures[0]
+    assert any(r["status"] == "SHAPE-REGRESSION" for r in rows)
+
+
+def test_insights_within_15_pct_ok():
+    old = {"x": _insights_rec({"match:aa": 10.0})}
+    new = {"x": _insights_rec({"match:aa": 11.4})}
+    rows, failures = bench_compare.compare_insights(old, new, 10.0)
+    assert not failures and rows[0]["status"] == "ok"
+
+
+def test_insights_one_sided_shapes_never_fail():
+    old = {"x": _insights_rec({"match:aa": 10.0})}
+    new = {"x": _insights_rec({"match:aa": 10.0, "term:cc": 500.0})}
+    rows, failures = bench_compare.compare_insights(old, new, 10.0)
+    assert not failures
+    assert any(r["status"] == "new-only" for r in rows)
+
+
+def test_insights_low_count_shapes_never_fail():
+    old = {"x": _insights_rec({"match:aa": 10.0}, count=3)}
+    new = {"x": _insights_rec({"match:aa": 99.0}, count=3)}
+    rows, failures = bench_compare.compare_insights(old, new, 10.0)
+    assert not failures and rows[0]["status"] == "low-count"
+
+
+def test_insights_cli_end_to_end(tmp_path):
+    old_p = _write(tmp_path / "i_old.json",
+                   [_insights_rec({"match:aa": 10.0})])
+    bad_p = _write(tmp_path / "i_bad.json",
+                   [_insights_rec({"match:aa": 30.0})])
+    assert bench_compare.main(["bench_compare.py", old_p, old_p]) == 0
+    assert bench_compare.main(["bench_compare.py", old_p, bad_p]) == 1

@@ -295,7 +295,7 @@ def test_scheduler_splits_wave_wall_across_tenants():
 
     class _Target:
         def multi_search(self, bodies, deadline=None, timelines=None,
-                         phase_times=None):
+                         phase_times=None, tenants=None):
             import time
             time.sleep(0.02)    # a measurable shared-wave wall
             return {"responses": [{} for _ in bodies]}

@@ -1,0 +1,655 @@
+"""Query Insights: per-shape cost attribution + shape-aware shed
+pricing (ISSUE 15).
+
+Pins the five acceptance behaviors:
+  - instrumentation-off differential: insights disabled leaves
+    responses byte-identical (modulo took) and records nothing;
+  - conservation: per-shape totals sum to the recorder's own globals
+    AND to the window deltas of the pre-existing counters — scan
+    byte-exact vs telemetry.scan, transfer byte-exact vs the ledger,
+    request counts exact vs msearch.bodies;
+  - top-N eviction determinism: under seeded concurrent load the
+    retained registry is exactly the N largest values, independent of
+    thread interleaving;
+  - co-batched attribution split: a shared envelope's device wall and
+    ledger bytes divide across its items and sum back exactly;
+  - shed-pricing fallback semantics: per-shape median only once warm,
+    global median below min_samples / for unknown shapes / gate off.
+"""
+
+import json
+import logging
+import threading
+
+import pytest
+
+from opensearch_tpu.common.admission import (AdmissionController,
+                                             DeadlineShedder)
+from opensearch_tpu.search.controller import execute_search
+from opensearch_tpu.search.executor import SearchExecutor, ShardReader
+from opensearch_tpu.telemetry import TELEMETRY
+from opensearch_tpu.telemetry.insights import (
+    INSIGHTS, QueryInsights, query_shape, structural_shape,
+    template_shape)
+from opensearch_tpu.utils.demo import build_shards, query_terms
+
+
+@pytest.fixture(scope="module")
+def executor():
+    mapper, segments = build_shards(320, n_shards=2, vocab_size=180,
+                                    avg_len=24, seed=11)
+    return SearchExecutor(ShardReader(mapper, segments))
+
+
+@pytest.fixture()
+def insights_on():
+    """Enable the recorder for one test, restore the pristine default
+    (and clear state both ways) so sibling tests keep the no-op gate."""
+    INSIGHTS.enabled = True
+    INSIGHTS.clear()
+    yield INSIGHTS
+    INSIGHTS.enabled = False
+    INSIGHTS.clear()
+
+
+def _mixed_bodies(n=12):
+    qs = query_terms(8, 180, seed=3, terms_per_query=2)
+    out = []
+    for i in range(n):
+        q = qs[i % len(qs)]
+        cls = i % 4
+        if cls == 0:
+            out.append({"query": {"match": {"body": q}}, "size": 5})
+        elif cls == 1:
+            out.append({"query": {"bool": {
+                "must": [{"match": {"body": q}}],
+                "filter": [{"range": {"views": {"gte": 50}}}]}},
+                "size": 4})
+        elif cls == 2:
+            out.append({"query": {"term": {"tag": "cat3"}}, "size": 6})
+        else:
+            out.append({"query": {"match_all": {}}, "size": 3})
+    return out
+
+
+# ------------------------------------------------------------ shape keys
+
+class TestShapeKeys:
+    def test_gate_discipline(self):
+        fresh = QueryInsights()
+        assert fresh.enabled is False
+        assert fresh.gate() is None
+        shed = DeadlineShedder()
+        assert shed.shape_enabled is False
+        assert shed.shape_gate() is None
+
+    def test_template_shapes_strip_literals(self):
+        a, ka = query_shape({"match": {"body": "alpha beta"}})
+        b, kb = query_shape({"match": {"body": "totally different"}})
+        assert ka == kb == "template"
+        assert a == b and a.startswith("match:")
+        c, _ = query_shape({"term": {"body": "alpha"}})
+        assert c != a and c.startswith("term:")
+
+    def test_structural_fallback_stable(self):
+        a, ka = query_shape({"match_phrase": {"body": "x y"}})
+        b, kb = query_shape({"match_phrase": {"body": "p q r"}})
+        assert ka == kb == "hash"
+        assert a == b and a.startswith("~match_phrase:")
+        c, _ = query_shape({"match_phrase": {"title": "x y"}})
+        assert c != a       # different field = different structure
+
+    def test_none_query_is_match_all(self):
+        label, kind = query_shape(None)
+        assert kind == "template" and label.startswith("match_all:")
+
+    def test_hash_is_process_stable(self):
+        # md5 over repr, never hash(): ids must compare equal across
+        # bench rounds (bench_compare's equal-shape-key contract)
+        sig = ("match", "body", "or", None, None)
+        assert template_shape(sig) == template_shape(sig)
+        assert structural_shape({"a": [1, 2]}) == \
+            structural_shape({"a": [3, 4]})
+
+
+# --------------------------------------------------- off differential
+
+class TestOffDifferential:
+    @staticmethod
+    def _strip(res):
+        return [{k: v for k, v in r.items() if k != "took"}
+                for r in res["responses"]]
+
+    def test_disabled_path_is_byte_identical_and_silent(self, executor):
+        bodies = _mixed_bodies()
+        assert INSIGHTS.enabled is False
+        r_off = executor.multi_search([dict(b) for b in bodies])
+        assert INSIGHTS.stats()["queries"] == 0
+        INSIGHTS.enabled = True
+        INSIGHTS.clear()
+        try:
+            r_on = executor.multi_search([dict(b) for b in bodies])
+            assert INSIGHTS.stats()["queries"] == len(bodies)
+        finally:
+            INSIGHTS.enabled = False
+            INSIGHTS.clear()
+        r_off2 = executor.multi_search([dict(b) for b in bodies])
+        assert self._strip(r_off) == self._strip(r_on) \
+            == self._strip(r_off2)
+        assert INSIGHTS.stats()["queries"] == 0
+
+
+# -------------------------------------------------------- conservation
+
+class TestConservation:
+    def test_per_shape_totals_conserve(self, executor, insights_on):
+        from opensearch_tpu.telemetry.scan import SCAN
+        bodies = _mixed_bodies()
+        # warm first so the measured window is the steady state
+        executor.multi_search([dict(b) for b in bodies])
+        execute_search([executor], {
+            "query": {"match_phrase": {"body": "alpha beta"}},
+            "size": 3})
+        insights_on.clear()
+        TELEMETRY.ledger.enabled = True
+        TELEMETRY.ledger.reset()
+        p0, d0 = SCAN.posting_bytes_total, SCAN.dense_bytes_total
+        b0 = TELEMETRY.metrics.to_dict()["counters"].get(
+            "msearch.bodies", 0)
+        try:
+            executor.multi_search([dict(b) for b in bodies])
+            # a general-path request joins through the controller note
+            execute_search([executor], {
+                "query": {"match_phrase": {"body": "alpha beta"}},
+                "size": 3})
+            snap = insights_on.snapshot()
+        finally:
+            TELEMETRY.ledger.enabled = False
+        tot, shapes = snap["totals"], snap["shapes"]
+        # >=3 distinct shape classes recorded, incl. the hash fallback
+        assert len(shapes) >= 4
+        assert any(r["kind"] == "hash" for r in shapes.values())
+        # per-shape sums == the recorder's own globals
+        assert sum(r["count"] for r in shapes.values()) \
+            == tot["queries"]
+        assert sum(r["posting_bytes"] for r in shapes.values()) \
+            == tot["posting_bytes"]
+        assert sum(r["dense_bytes"] for r in shapes.values()) \
+            == tot["dense_bytes"]
+        assert sum(r["h2d_bytes"] for r in shapes.values()) \
+            == tot["h2d_bytes"]
+        assert sum(r["d2h_bytes"] for r in shapes.values()) \
+            == tot["d2h_bytes"]
+        # byte-exact vs the always-on scan heat map
+        assert tot["posting_bytes"] == SCAN.posting_bytes_total - p0
+        assert tot["dense_bytes"] == SCAN.dense_bytes_total - d0
+        # byte-exact vs the transfer ledger's window totals
+        led = TELEMETRY.ledger.snapshot()["bytes_total"]
+        assert tot["h2d_bytes"] == led.get("h2d", 0)
+        assert tot["d2h_bytes"] == led.get("d2h", 0)
+        # counts vs the envelope body counter (±1 per the acceptance;
+        # exact here) + the controller-served request
+        b1 = TELEMETRY.metrics.to_dict()["counters"].get(
+            "msearch.bodies", 0)
+        assert tot["queries"] == (b1 - b0) + 1
+
+    def test_cache_hits_count_with_zero_scan(self, executor,
+                                             insights_on):
+        body = {"query": {"match_all": {}}, "size": 0,
+                "aggs": {"t": {"terms": {"field": "tag"}}}}
+        executor.multi_search([dict(body)])    # prime the cache
+        insights_on.clear()
+        executor.multi_search([dict(body)])    # request-cache hit
+        snap = insights_on.snapshot()
+        row = next(iter(snap["shapes"].values()))
+        assert row["cached"] == 1
+        assert row["posting_bytes"] == 0 and row["dense_bytes"] == 0
+
+
+# ---------------------------------------------------- top-N registries
+
+class TestTopN:
+    def test_eviction_determinism_under_concurrency(self, rnd):
+        ins = QueryInsights(top_n=8)
+        ins.enabled = True
+        # 4 threads × 64 seeded DISTINCT latencies: whatever the
+        # interleaving, the retained registry must be exactly the 8
+        # largest values
+        seen = set()
+        while len(seen) < 256:
+            seen.add(round(rnd.uniform(1, 1000), 3))
+        values = sorted(seen, key=lambda _: rnd.random())
+        assert len(set(values)) == len(values)
+        chunks = [values[i::4] for i in range(4)]
+
+        def worker(chunk):
+            for v in chunk:
+                ins.note("match:abc", took_ms=v, device_ms=v / 2,
+                         posting_bytes=int(v * 10))
+        threads = [threading.Thread(target=worker, args=(c,))
+                   for c in chunks]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        got = [r["took_ms"] for r in ins.top_queries("latency")]
+        assert got == sorted(values, reverse=True)[:8]
+        got_dev = [r["device_ms"] for r in ins.top_queries("device_ms")]
+        assert got_dev == [round(v / 2, 3)
+                           for v in sorted(values, reverse=True)[:8]]
+        assert ins.stats()["queries"] == 256
+
+    def test_unknown_metric_raises(self):
+        with pytest.raises(KeyError):
+            QueryInsights().top_queries("cpu")
+
+    def test_shape_overflow_folds(self):
+        ins = QueryInsights()
+        ins.enabled = True
+        for i in range(300):
+            ins.note(f"shape:{i:08x}", took_ms=1.0)
+        snap = ins.snapshot()
+        assert snap["shapes_tracked"] <= 257      # cap + overflow row
+        assert snap["totals"]["queries"] == 300
+        assert sum(r["count"] for r in snap["shapes"].values()) == 300
+
+
+# --------------------------------------------- co-batched attribution
+
+class TestCoBatchSplit:
+    def test_envelope_split_sums_back(self, executor, insights_on):
+        qs = query_terms(8, 180, seed=5, terms_per_query=2)
+        bodies = [{"query": {"match": {"body": q}}, "size": 5}
+                  for q in qs]
+        executor.multi_search([dict(b) for b in bodies])   # warm
+        insights_on.clear()
+        TELEMETRY.ledger.enabled = True
+        TELEMETRY.ledger.reset()
+        try:
+            executor.multi_search([dict(b) for b in bodies])
+        finally:
+            TELEMETRY.ledger.enabled = False
+        snap = insights_on.snapshot()
+        row = snap["shapes"][query_shape(bodies[0]["query"])[0]]
+        assert row["count"] == len(bodies)
+        # every item rode one shared wave of 8 siblings
+        assert row["co_batched_max"] == len(bodies)
+        assert row["co_batch_ratio"] == 1.0
+        # the integer byte split sums back to the ledger exactly
+        led = TELEMETRY.ledger.snapshot()["bytes_total"]
+        assert row["h2d_bytes"] == led.get("h2d", 0)
+        assert row["d2h_bytes"] == led.get("d2h", 0)
+        assert row["device_ms_total"] > 0.0
+
+    def test_scheduler_coalesced_tenants(self, executor, insights_on):
+        from opensearch_tpu.search.scheduler import WaveScheduler
+        sched = WaveScheduler(autostart=False)
+        sched.set_enabled(True)     # no thread (autostart=False):
+        # pump_once below dispatches synchronously
+        q = query_terms(1, 180, seed=6, terms_per_query=2)[0]
+        body = {"query": {"match": {"body": q}}, "size": 5}
+        executor.multi_search([dict(body)])    # warm
+        insights_on.clear()
+        results = {}
+
+        def submit(tenant):
+            results[tenant] = sched.execute(executor, dict(body),
+                                            tenant=tenant)
+        threads = [threading.Thread(target=submit, args=(t,))
+                   for t in ("acme", "globex")]
+        for t in threads:
+            t.start()
+        # both queued; one synchronous pump dispatches the shared wave
+        import time as _t
+        for _ in range(200):
+            if sched.queue_depth() >= 2:
+                break
+            _t.sleep(0.005)
+        sched.pump_once()
+        for t in threads:
+            t.join()
+        snap = insights_on.snapshot()
+        row = snap["shapes"][query_shape(body["query"])[0]]
+        assert row["count"] == 2
+        assert row["co_batched_max"] == 2
+        assert set(row["tenants"]) == {"acme", "globex"}
+
+    def test_scheduler_cache_hit_keeps_item_tenant(self, executor,
+                                                   insights_on):
+        # a request-cache-served sub-request on a scheduler-coalesced
+        # wave notes under the OWNING request's tenant, not _default:
+        # the parse loop runs on the scheduler thread where the REST
+        # layer's thread-local binding never reached (regression)
+        from opensearch_tpu.search.scheduler import WaveScheduler
+        body = {"query": {"match_all": {}}, "size": 0,
+                "aggs": {"t": {"terms": {"field": "tag"}}}}
+        executor.multi_search([dict(body)])    # prime the cache
+        insights_on.clear()
+        sched = WaveScheduler(autostart=False)
+        sched.set_enabled(True)
+        done = []
+
+        def submit():
+            done.append(sched.execute(executor, dict(body),
+                                      tenant="acme"))
+        t = threading.Thread(target=submit)
+        t.start()
+        import time as _t
+        for _ in range(200):
+            if sched.queue_depth() >= 1:
+                break
+            _t.sleep(0.005)
+        sched.pump_once()
+        t.join()
+        snap = insights_on.snapshot()
+        row = next(iter(snap["shapes"].values()))
+        assert row["cached"] == 1
+        assert row["tenants"] == {"acme": 1}
+
+
+# ------------------------------------------------- shed shape pricing
+
+class TestShedShapePricing:
+    def _warm_global(self, shed, ms=10.0, n=10):
+        for _ in range(n):
+            shed.observe(ms)
+
+    def test_fallback_below_min_samples(self):
+        shed = DeadlineShedder()
+        shed.enabled = True
+        shed.shape_enabled = True
+        shed.shape_min_samples = 4
+        self._warm_global(shed, ms=10.0)
+        # unknown / cold shape prices with the global median
+        est_cold = shed.service_estimate("match:abc")
+        assert est_cold == pytest.approx(
+            shed.service_ms.quantile(0.5))
+        assert shed.shape_fallbacks > 0
+        # feed the shape past min_samples: its OWN median takes over
+        for _ in range(4):
+            shed.observe(100.0, shape="match:abc")
+        est_warm = shed.service_estimate("match:abc")
+        assert est_warm == pytest.approx(100.0, rel=0.5)
+        assert est_warm > 5 * est_cold
+        assert shed.shape_hits > 0
+        # shape=None always prices global
+        assert shed.service_estimate(None) == pytest.approx(
+            shed.service_ms.quantile(0.5))
+
+    def test_gate_off_ignores_shape(self):
+        shed = DeadlineShedder()
+        shed.enabled = True
+        assert shed.shape_gate() is None
+        self._warm_global(shed, ms=10.0)
+        # shape observations are NOT tracked while the gate is off
+        shed.observe(500.0, shape="match:abc")
+        assert shed.stats()["shape_pricing"]["tracked"] == 0
+        assert shed.service_estimate("match:abc") == pytest.approx(
+            shed.service_ms.quantile(0.5))
+
+    def test_contended_walls_never_feed_shape_rows(self):
+        shed = DeadlineShedder()
+        shed.enabled = True
+        shed.shape_enabled = True
+        shed.observe(500.0, depth=5, shape="match:abc")
+        assert shed.stats()["shape_pricing"]["tracked"] == 0
+
+    def test_check_prices_by_shape(self):
+        shed = DeadlineShedder()
+        shed.enabled = True
+        shed.shape_enabled = True
+        shed.shape_min_samples = 4
+        shed.slo_ms = 50.0
+        shed.min_samples = 4
+        shed.probe_interval_s = 1e9     # no estimator probes: this
+        # test pins the pricing verdict, not the anti-starvation path
+        for _ in range(32):
+            shed.observe(1.0)                       # cheap global
+        for _ in range(8):
+            shed.observe(100.0, shape="heavy:1")    # heavy class
+        # the MIXED model: depth 3 prices global*3 + own — the cheap
+        # global admits an unknown arrival (~4ms), while the heavy
+        # shape's own 100ms slot busts the 50ms SLO and sheds. The
+        # queue term stays globally priced on purpose: a heavy arrival
+        # behind cache hits must not be charged heavy*depth.
+        assert shed.check(3, None, shape=None) is None
+        predicted = shed.check(3, None, shape="heavy:1")
+        assert predicted is not None and predicted > 50.0
+        # and the queue term is global, not own: predicted is own-cost
+        # dominated, far below own*(depth+1)
+        assert predicted < 100.0 * 2
+
+    def test_settings_roundtrip(self):
+        ctrl = AdmissionController()
+        ctrl.apply_settings({
+            "admission.shed.enabled": "true",
+            "admission.shed.shape_pricing.enabled": "true",
+            "admission.shed.shape_pricing.min_samples": "3"})
+        assert ctrl.shedder.shape_gate() is ctrl.shedder
+        assert ctrl.shedder.shape_min_samples == 3
+        from opensearch_tpu.common.errors import SettingsError
+        with pytest.raises(SettingsError):
+            AdmissionController.parse_settings(
+                {"admission.shed.shape_pricing.enabled": "maybe"})
+        with pytest.raises(SettingsError):
+            AdmissionController.parse_settings(
+                {"admission.shed.shape_pricing.min_samples": "many"})
+
+    def test_shape_row_overflow_folds(self):
+        shed = DeadlineShedder()
+        shed.enabled = True
+        shed.shape_enabled = True
+        shed.max_tracked_shapes = 8
+        for i in range(20):
+            shed.observe(5.0, shape=f"s:{i}")
+        assert shed.stats()["shape_pricing"]["tracked"] <= 9
+
+
+# ------------------------------------------------------------ REST face
+
+class TestRestFace:
+    @pytest.fixture()
+    def node(self):
+        from opensearch_tpu.node import Node
+        n = Node()
+        n.request("PUT", "/ins", {"mappings": {"properties": {
+            "msg": {"type": "text"}}}})
+        for i in range(20):
+            n.request("PUT", f"/ins/_doc/{i}",
+                      {"msg": f"hello message {i}"})
+        n.request("POST", "/ins/_refresh")
+        yield n
+        INSIGHTS.enabled = False
+        INSIGHTS.clear()
+
+    def test_roundtrip_and_tenant_breakdown(self, node):
+        r = node.request("POST", "/_insights/_enable")
+        assert r["_status"] == 200 and r["enabled"] is True
+        node.request("POST", "/ins/_search",
+                     {"query": {"match": {"msg": "hello"}}},
+                     tenant="acme")
+        node.request("POST", "/ins/_search",
+                     {"query": {"match": {"msg": "message"}}},
+                     tenant="globex")
+        node.request("POST", "/ins/_search",
+                     {"query": {"match_all": {}}})
+        full = node.request("GET", "/_insights")
+        shapes = full["insights"]["shapes"]
+        assert len(shapes) >= 2
+        match_row = next(r for s, r in shapes.items()
+                         if s.startswith("match:"))
+        assert match_row["count"] == 2
+        assert set(match_row["tenants"]) == {"acme", "globex"}
+        top = node.request("GET", "/_insights/top_queries",
+                           metric="latency")
+        assert top["_status"] == 200
+        assert len(top["top_queries"]) == 3
+        assert top["top_queries"][0]["took_ms"] >= \
+            top["top_queries"][-1]["took_ms"]
+        bad = node.request("GET", "/_insights/top_queries",
+                           metric="cpu")
+        assert bad["_status"] == 400
+        stats = node.request("GET", "/_nodes/stats")
+        blk = stats["nodes"][node.node_id]["telemetry"]["insights"]
+        assert blk["totals"]["queries"] == 3
+        node.request("POST", "/_insights/_clear")
+        assert node.request(
+            "GET", "/_insights")["insights"]["totals"]["queries"] == 0
+        r = node.request("POST", "/_insights/_disable")
+        assert r["enabled"] is False
+        assert INSIGHTS.gate() is None
+
+    def test_node_setting_enables(self):
+        from opensearch_tpu.node import Node
+        try:
+            Node(settings={"telemetry.insights.enabled": "true"})
+            assert INSIGHTS.enabled is True
+        finally:
+            INSIGHTS.enabled = False
+            INSIGHTS.clear()
+            Node()      # re-configure the singleton back to defaults
+
+    def test_slow_log_carries_shape_id(self, node, caplog):
+        node.request("PUT", "/ins/_settings", {"index": {
+            "search.slowlog.threshold.query.info": "0ms"}})
+        logger = "opensearch_tpu.index.search.slowlog.query"
+        with caplog.at_level(logging.INFO, logger=logger):
+            node.request("POST", "/ins/_search",
+                         {"query": {"match": {"msg": "hello"}}})
+        records = [r for r in caplog.records if r.name == logger]
+        assert records
+        msg = records[0].getMessage()
+        assert "shape[match:" in msg
+
+
+# ------------------------------------------------- tail/tool satellites
+
+class TestToolSatellites:
+    def test_timeline_shape_annotation(self, executor, insights_on):
+        flight = TELEMETRY.flight
+        q = query_terms(1, 180, seed=8, terms_per_query=2)[0]
+        body = {"query": {"match": {"body": q}}, "size": 5}
+        executor.multi_search([dict(body)])    # warm
+        flight.enabled = True
+        flight.threshold_ms = 0.0              # capture everything
+        flight.clear()
+        try:
+            executor.multi_search([dict(body)])
+            caps = flight.captured()
+        finally:
+            flight.enabled = False
+            flight.threshold_ms = None
+            flight.clear()
+        assert caps and caps[0]["shape"].startswith("match:")
+
+    def test_tail_report_groups_by_shape(self):
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import tail_report
+        records = [
+            {"took_ms": 100.0, "shape": "match:aa", "queue_wait_ms": 1},
+            {"took_ms": 10.0, "shape": "match:aa", "queue_wait_ms": 0},
+            {"took_ms": 400.0, "shape": "bool:bb", "queue_wait_ms": 2},
+            {"took_ms": 5.0},          # unshaped capture still renders
+        ]
+        groups = tail_report.shape_groups(records)
+        assert groups["match:aa"]["captures"] == 2
+        assert groups["bool:bb"]["took_max_ms"] == 400.0
+        assert groups["_unshaped"]["captures"] == 1
+        out = tail_report.render_shapes(groups)
+        assert "bool:bb" in out
+        # no shape annotations at all -> the section stays silent
+        assert tail_report.shape_groups([{"took_ms": 1.0}]) == {}
+
+    def test_insights_report_tool(self, tmp_path, capsys):
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import insights_report
+        rec = {"mode": "bm25_insights", "insights": {
+            "totals": {"queries": 30},
+            "shapes": {
+                "match:aa": {"kind": "template", "count": 20,
+                             "p50_ms": 2.0, "p99_ms": 9.0,
+                             "device_ms_total": 55.0,
+                             "posting_bytes": 4096, "dense_bytes": 0,
+                             "h2d_bytes": 100, "d2h_bytes": 200,
+                             "co_batch_ratio": 0.5, "warm_hits": 18,
+                             "compiled": 2, "cached": 0,
+                             "took_total_ms": 80.0,
+                             "tenants": {"acme": 20}},
+                "~hybrid:bb": {"kind": "hash", "count": 10,
+                               "p50_ms": 4.0, "p99_ms": 12.0,
+                               "device_ms_total": 80.0,
+                               "posting_bytes": 0, "dense_bytes": 0,
+                               "h2d_bytes": 0, "d2h_bytes": 0,
+                               "co_batch_ratio": 0.0, "warm_hits": 0,
+                               "compiled": 10, "cached": 0,
+                               "took_total_ms": 50.0,
+                               "tenants": {"_default": 10}},
+            },
+            "top": {"latency": [
+                {"shape": "~hybrid:bb", "took_ms": 12.0,
+                 "device_ms": 8.0, "scan_bytes": 0, "co_batched": 1,
+                 "tenant": "_default"}]},
+        }}
+        path = tmp_path / "INSIGHTS_test.json"
+        path.write_text(json.dumps(rec) + "\n")
+        rc = insights_report.main(["insights_report.py", str(path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        # device-ms sort: the hybrid shape leads
+        assert out.index("~hybrid:bb") < out.index("match:aa")
+        assert "top[latency]" in out
+        assert "acme" in out
+        rc = insights_report.main(
+            ["insights_report.py", "--assert-shapes", "5", str(path)])
+        assert rc == 1
+
+    def test_bench_compare_insights_gate(self):
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import bench_compare
+
+        def rec(p99_by_shape):
+            return {"mode": "bm25_insights_8c", "p50_ms": 1.0,
+                    "insights": {"shapes": {
+                        s: {"count": 50, "p50_ms": 1.0, "p99_ms": p99}
+                        for s, p99 in p99_by_shape.items()}}}
+        old = {"bm25_insights_8c": rec({"match:aa": 10.0,
+                                        "bool:bb": 20.0})}
+        # within 15% at equal shape key: ok
+        new = {"bm25_insights_8c": rec({"match:aa": 11.0,
+                                        "bool:bb": 21.0})}
+        rows, failures = bench_compare.compare_insights(old, new, 10.0)
+        assert not failures and len(rows) == 2
+        # >15% per-shape regression fails
+        new_bad = {"bm25_insights_8c": rec({"match:aa": 20.0,
+                                            "bool:bb": 21.0})}
+        rows, failures = bench_compare.compare_insights(old, new_bad,
+                                                        10.0)
+        assert failures and "match:aa" in failures[0]
+        # a shape present on one side only reports, never fails
+        new_grown = {"bm25_insights_8c": rec({"match:aa": 10.0,
+                                              "bool:bb": 20.0,
+                                              "term:cc": 99.0})}
+        rows, failures = bench_compare.compare_insights(old, new_grown,
+                                                        10.0)
+        assert not failures
+        assert any(r["status"] == "new-only" for r in rows)
+        # low-count shapes report but never fail
+        low = {"bm25_insights_8c": {"mode": "x", "insights": {"shapes": {
+            "match:aa": {"count": 3, "p50_ms": 1.0, "p99_ms": 99.0}}}}}
+        rows, failures = bench_compare.compare_insights(old, low, 10.0)
+        assert not failures
+        # the generic warm gate skips insights records entirely
+        rows, failures = bench_compare.compare(old, new_bad, 10.0)
+        assert not failures and not rows

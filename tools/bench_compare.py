@@ -50,6 +50,14 @@ INTERFERENCE_P99_PCT = 15.0
 # paying" fails the run even when absolute QPS moved with box state
 SCALING_EFFICIENCY_PCT = 15.0
 
+# the insights gate (ISSUE 15): at EQUAL shape key, a shape class's
+# warm p99 may not degrade by more than this between two INSIGHTS
+# rounds — "this query class got slower" fails the run even when the
+# overall mix shifted. Shapes need a minimal sample count on both
+# sides: a 3-request shape's p99 is one unlucky request, not a class.
+INSIGHTS_P99_PCT = 15.0
+INSIGHTS_MIN_COUNT = 20
+
 
 def load_records(path: str) -> Dict[str, dict]:
     """file of JSON lines (or one JSON array) → {config key: record}."""
@@ -129,6 +137,13 @@ def compare(old: Dict[str, dict], new: Dict[str, dict],
             # per-chip EFFICIENCY is round-normalized (divided by the
             # same round's QPS(1)), where absolute warm latency on the
             # virtual-chip CPU box moves with box state
+            continue
+        if any(r is not None and isinstance(r.get("insights"), dict)
+               and "shapes" in r["insights"] for r in (o, n)):
+            # INSIGHTS records have their own gate (compare_insights,
+            # per-shape warm p99 at equal shape key): their aggregate
+            # p99 moves with the shape MIX, which shifts legitimately
+            # round over round
             continue
         row = {"config": key}
         if o is None or n is None:
@@ -402,6 +417,79 @@ def compare_scaling(old: Dict[str, dict], new: Dict[str, dict],
     return rows, failures
 
 
+def _insights_records(recs: Dict[str, dict]) -> Dict[str, dict]:
+    """The INSIGHTS shape: records carrying an `insights` block with
+    per-shape rows (bench.py --insights)."""
+    return {k: r for k, r in recs.items()
+            if isinstance(r.get("insights"), dict)
+            and isinstance(r["insights"].get("shapes"), dict)}
+
+
+def compare_insights(old: Dict[str, dict], new: Dict[str, dict],
+                     threshold_pct: float) -> Tuple[List[dict], List[str]]:
+    """Gate two insights records shape-by-shape at EQUAL shape key:
+    fail when a shape class's warm p99 regresses by more than
+    INSIGHTS_P99_PCT. The shape id is structural (interned-template /
+    skeleton hash), so it compares stably across rounds; shapes present
+    in only one round report but never fail (workload mixes grow round
+    over round), and shapes under INSIGHTS_MIN_COUNT requests on either
+    side only report (one slow request is not a class regression).
+    `threshold_pct` is accepted for signature parity with the other
+    comparers; the per-shape bound is the class constant."""
+    del threshold_pct
+    o_all, n_all = _insights_records(old), _insights_records(new)
+    rows, failures = [], []
+    if not o_all or not n_all:
+        return rows, failures
+    for key in sorted(set(o_all) & set(n_all)):
+        o_shapes = o_all[key]["insights"]["shapes"]
+        n_shapes = n_all[key]["insights"]["shapes"]
+        for shape in sorted(set(o_shapes) | set(n_shapes)):
+            o, n = o_shapes.get(shape), n_shapes.get(shape)
+            row = {"config": key, "shape": shape}
+            if o is None or n is None:
+                row["status"] = "old-only" if n is None else "new-only"
+                rows.append(row)
+                continue
+            o99, n99 = o.get("p99_ms"), n.get("p99_ms")
+            row["old_count"] = o.get("count", 0)
+            row["new_count"] = n.get("count", 0)
+            row["old_p99_ms"] = o99
+            row["new_p99_ms"] = n99
+            status = "ok"
+            if not isinstance(o99, (int, float)) or \
+                    not isinstance(n99, (int, float)) or o99 <= 0:
+                status = "no-latency-field"
+            else:
+                d99 = 100.0 * (n99 - o99) / o99
+                row["p99_delta_pct"] = round(d99, 1)
+                small = min(row["old_count"], row["new_count"]) \
+                    < INSIGHTS_MIN_COUNT
+                if small:
+                    status = "low-count"
+                elif d99 > INSIGHTS_P99_PCT:
+                    status = "SHAPE-REGRESSION"
+                    failures.append(
+                        f"{key} shape {shape}: warm p99 {o99}ms -> "
+                        f"{n99}ms (+{d99:.1f}% > "
+                        f"{INSIGHTS_P99_PCT:g}% at equal shape key)")
+            row["status"] = status
+            rows.append(row)
+    return rows, failures
+
+
+def render_insights(rows: List[dict]) -> str:
+    headers = ["config", "shape", "old_count", "new_count",
+               "old_p99_ms", "new_p99_ms", "p99_delta_pct", "status"]
+    table = [headers] + [[str(r.get(h, "-")) for h in headers]
+                         for r in rows]
+    widths = [max(len(row[i]) for row in table)
+              for i in range(len(headers))]
+    return "\n".join(
+        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+        for row in table)
+
+
 def render_scaling(rows: List[dict]) -> str:
     headers = ["config", "devices", "old_efficiency", "new_efficiency",
                "efficiency_delta_pct", "old_skew_p50_ms",
@@ -492,6 +580,12 @@ def main(argv: List[str]) -> int:
               "skew at equal device count):")
         print(render_scaling(sc_rows))
         failures += sc_failures
+    in_rows, in_failures = compare_insights(old, new, threshold)
+    if in_rows:
+        print("\nquery insights (per-shape warm p99 at equal shape "
+              "key):")
+        print(render_insights(in_rows))
+        failures += in_failures
     if failures:
         print(f"\nFAIL: {len(failures)} regression(s) "
               f"(warm p50/p99 beyond {threshold:g}% / overload "

@@ -77,6 +77,15 @@ GATED_SUBSYSTEMS = (
      ("scope",)),
     ("opensearch_tpu/telemetry/lifecycle.py", "SpmdTimeline", "enabled",
      ("gate",)),
+    # ISSUE 15 query insights: the per-shape cost recorder is OFF by
+    # default — the default query path pays one attribute load + branch
+    # per sub-request — and the shape-aware deadline-shed pricing is a
+    # SECOND gate on the shedder (its own flag on top of `enabled`):
+    # the default shed stage never computes a shape key at admission
+    ("opensearch_tpu/telemetry/insights.py", "QueryInsights", "enabled",
+     ("gate",)),
+    ("opensearch_tpu/common/admission.py", "DeadlineShedder",
+     "shape_enabled", ("shape_gate",)),
 )
 
 # no-op constants a disabled gate may return

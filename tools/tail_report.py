@@ -192,6 +192,48 @@ def render_coalesce(groups: Dict[str, dict]) -> str:
                           "window_wait_ms"])
 
 
+def shape_groups(records: List[dict]) -> Dict[str, dict]:
+    """Group tail captures by shape class (ISSUE 15): each capture's
+    `shape` annotation (the interned-template / structural-hash id the
+    executor/controller stamped, the same key telemetry/insights.py
+    groups costs by) answers "which shape owns the p99" the way
+    `ingest_events` answers "did a merge cause it". Captures without
+    the annotation (pre-ISSUE-15 dumps, rejected requests) fold into
+    `_unshaped` so old files still render."""
+    groups: Dict[str, dict] = {}
+    annotated = False
+    for rec in records:
+        shape = rec.get("shape")
+        if shape is not None:
+            annotated = True
+        key = shape if shape is not None else "_unshaped"
+        g = groups.setdefault(key, {"captures": 0, "took_ms": [],
+                                    "queue_wait_ms": []})
+        g["captures"] += 1
+        g["took_ms"].append(float(rec.get("took_ms") or 0.0))
+        g["queue_wait_ms"].append(float(rec.get("queue_wait_ms") or 0.0))
+    if not annotated:
+        return {}
+    out: Dict[str, dict] = {}
+    for key, g in groups.items():
+        took = sorted(g["took_ms"])
+        out[key] = {
+            "captures": g["captures"],
+            "took_p50_ms": round(took[len(took) // 2], 3),
+            "took_max_ms": round(took[-1], 3),
+            "queue_wait_mean_ms": round(
+                sum(g["queue_wait_ms"]) / len(g["queue_wait_ms"]), 3),
+        }
+    return out
+
+
+def render_shapes(groups: Dict[str, dict]) -> str:
+    rows = [{"shape": k, **v} for k, v in sorted(
+        groups.items(), key=lambda kv: -kv[1]["took_max_ms"])]
+    return _render(rows, ["shape", "captures", "took_p50_ms",
+                          "took_max_ms", "queue_wait_mean_ms"])
+
+
 def ingest_groups(records: List[dict]) -> Dict[str, dict]:
     """Group tail captures by the write-path events that overlapped
     their window (ISSUE 13): each capture's `ingest_events` annotation
@@ -344,6 +386,10 @@ def main(argv: List[str]) -> int:
     if co:
         print("\ntail by coalesce state (co_batched > 1 = shared wave):")
         print(render_coalesce(co))
+    sg = shape_groups(records)
+    if sg:
+        print("\ntail by shape class (which shape owns the p99):")
+        print(render_shapes(sg))
     ig = ingest_groups(records)
     if ig:
         print("\ntail by ingest overlap (write-path events in flight "
