@@ -29,6 +29,8 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Set, Tuple
 
+import numpy as np
+
 from opensearch_tpu.common.errors import VersionConflictError
 from opensearch_tpu.index.mapper import MapperService
 from opensearch_tpu.index.segment import Segment, SegmentBuilder, merge_segments
@@ -88,6 +90,15 @@ class InternalEngine:
         self.mapper = mapper
         self.primary_term = primary_term
         self.merge_max_segments = merge_max_segments
+        # ISSUE 16 bounded merge windows: OFF by default (gate-lint row)
+        # — the default engine keeps the one-shot merge-half policy.
+        # When on, maybe_merge() runs incremental pair merges with the
+        # segment rebuild OUTSIDE the engine lock, stopping after
+        # merge_window_budget_ms so a merge never walls serving cores
+        # for the full 234-389 ms the one-shot policy pays.
+        self.merge_windowed = False
+        self.merge_window_budget_ms = 25.0
+        self._merge_active = False
         self._lock = threading.RLock()
         self._seg_counter = 0
         self._persisted: Set[str] = set()
@@ -542,6 +553,8 @@ class InternalEngine:
         """Tiered-merge-lite (MergePolicyConfig/OpenSearchTieredMergePolicy
         analog): when sealed segments exceed the cap, merge the smallest half
         into one. Host-side rebuild; the merged segment replaces its inputs."""
+        if self.merge_windowed:
+            return self._maybe_merge_windowed()
         t0_mono = time.monotonic()
         span = TELEMETRY.tracer.start_trace("engine.merge")
         with self._lock:
@@ -586,6 +599,102 @@ class InternalEngine:
             self._notify_refresh_listeners(merged, [])
             TELEMETRY.tracer.finish(span)
             return merged
+
+    def _maybe_merge_windowed(self) -> Optional[Segment]:
+        """Incremental pair merges under a wall-clock budget. Each pass
+        merges the two smallest sealed segments, rebuilding OUTSIDE the
+        engine lock (writes keep landing), then re-acquires the lock to
+        re-apply any deletes that raced the rebuild and atomically swap
+        the pair for the merged segment. At least one pass runs whenever
+        the cap is exceeded (so repeated calls converge); further passes
+        run until the budget is spent or the cap is satisfied."""
+        with self._lock:
+            self.last_ingest_event = None
+            if self._merge_active or \
+                    len(self.segments) <= self.merge_max_segments:
+                return None
+            self._merge_active = True
+        budget_s = self.merge_window_budget_ms / 1000.0
+        t_window = time.monotonic()
+        last_merged: Optional[Segment] = None
+        _METRICS.counter("indexing.merge_windows").inc()
+        try:
+            while True:
+                t0_mono = time.monotonic()
+                with self._lock:
+                    if len(self.segments) <= self.merge_max_segments:
+                        break
+                    ranked = sorted(self.segments,
+                                    key=lambda s: s.num_docs)
+                    victims = ranked[:2]
+                    # live-mask snapshot: docs dead BEFORE the rebuild
+                    # must NOT be re-applied afterwards — a superseded
+                    # doc_id (dead in one victim, re-indexed live in the
+                    # other) would have its live merged copy killed
+                    pre_live = [np.asarray(v.live[:v.num_docs],
+                                           bool).copy() for v in victims]
+                    seg_id = self._next_seg_id()
+                span = TELEMETRY.tracer.start_trace("engine.merge")
+                try:
+                    merged = merge_segments(self.mapper, victims, seg_id)
+                except BaseException as e:  # except-ok: span lifecycle -- closes the engine span with error status, then always re-raises
+                    span.end(error=e)
+                    TELEMETRY.tracer.finish(span)
+                    raise
+                with self._lock:
+                    victim_ids = {s.seg_id for s in victims}
+                    current = {s.seg_id for s in self.segments}
+                    if not victim_ids <= current:
+                        # a concurrent install/merge replaced a victim
+                        # while we rebuilt off-lock — abandon the pass
+                        TELEMETRY.tracer.finish(span)
+                        break
+                    # deletes that landed on the victims during the
+                    # off-lock rebuild: re-apply by doc_id (idempotent —
+                    # a doc the rebuild already saw dead was never
+                    # copied, so delete() is a no-op for it)
+                    for v, was_live in zip(victims, pre_live):
+                        now_dead = was_live & ~np.asarray(
+                            v.live[:v.num_docs], bool)
+                        for ord_ in np.nonzero(now_dead)[0]:
+                            did = v.doc_ids[int(ord_)]
+                            if did is not None:
+                                merged.delete(did)
+                    self.segments = [s for s in self.segments
+                                     if s.seg_id not in victim_ids]
+                    self.segments.append(merged)
+                    self._persisted -= victim_ids
+                    t1_mono = time.monotonic()
+                    wall_ms = (t1_mono - t0_mono) * 1000
+                    docs_in = sum(s.num_docs for s in victims)
+                    _METRICS.counter("indexing.merges").inc()
+                    _METRICS.counter("indexing.merge_docs").inc(
+                        merged.num_docs)
+                    _METRICS.histogram("indexing.merge_ms").observe(
+                        wall_ms)
+                    self.last_ingest_event = INGEST_EVENTS.note(
+                        "merge", t0_mono, t1_mono,
+                        seg_id=merged.seg_id,
+                        segments_in=len(victims),
+                        docs_in=docs_in,
+                        docs=merged.num_docs,
+                        live_doc_ratio=round(
+                            merged.live_doc_count / merged.num_docs, 4)
+                        if merged.num_docs else None,
+                        segments=len(self.segments))
+                    if span.recording:
+                        span.set_attribute("seg_id", merged.seg_id)
+                        span.set_attribute("segments_in", len(victims))
+                        span.set_attribute("docs", merged.num_docs)
+                    self._notify_refresh_listeners(merged, [])
+                    TELEMETRY.tracer.finish(span)
+                    last_merged = merged
+                if time.monotonic() - t_window >= budget_s:
+                    break
+        finally:
+            with self._lock:
+                self._merge_active = False
+        return last_merged
 
     def install_segments(self, segments: List[Segment], max_seq_no: int,
                          local_checkpoint: int):

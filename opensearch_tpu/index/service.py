@@ -194,6 +194,24 @@ class IndexService:
         window = int(self.settings.get("max_result_window", 10000))
         for shard in self.shards:
             shard.executor.max_result_window = window
+        # ingest-concurrent serving knobs (ISSUE 16), all OFF by
+        # default: bounded merge windows ("index.merge.windowed" +
+        # "index.merge.window_budget_ms") and segment-keyed memo carry
+        # ("index.search.memo_carry"). Strict boolean parse — a typo'd
+        # value fails index creation, never silently stays off.
+        from opensearch_tpu.common.settings import _parse_bool
+        raw_windowed = settings.get("merge.windowed")
+        raw_budget = settings.get("merge.window_budget_ms")
+        raw_carry = settings.get("search.memo_carry")
+        for shard in self.shards:
+            if raw_windowed is not None:
+                shard.engine.merge_windowed = _parse_bool(
+                    raw_windowed, "index.merge.windowed")
+            if raw_budget is not None:
+                shard.engine.merge_window_budget_ms = float(raw_budget)
+            if raw_carry is not None:
+                shard.reader.memo_carry = _parse_bool(
+                    raw_carry, "index.search.memo_carry")
 
     # --------------------------------------------------------------- routing
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import os
 import time
+from contextlib import contextmanager
 from typing import List, Optional
 
 from opensearch_tpu.index.engine import EngineResult, GetResult, InternalEngine
@@ -115,19 +116,26 @@ class IndexShard:
         scope = _CHURN.scope()
         if scope is None:
             self.engine.refresh()
-            self._sync_reader()
+            binfo = {}
+            with self._publish_barrier(binfo):
+                self._sync_reader()
+            self._carry_report()
+            self._maybe_precompile(None)
             return
         t0 = time.perf_counter()
         cache = self.reader._stats_cache
         segments_before = len(self.reader.segments)
         new_seg = self.engine.refresh()
-        with _CHURN.bound(scope):
-            self._sync_reader()
+        binfo = {}
+        with self._publish_barrier(binfo):
+            with _CHURN.bound(scope):
+                self._sync_reader()
         if new_seg is None and not scope.upload_bytes \
                 and not scope.live_mask_bytes:
             return                          # no-op refresh: no record
+        report = self._carry_report()
         ev = self.engine.last_ingest_event
-        _CHURN.publish(
+        rec = _CHURN.publish(
             scope, "refresh",
             segments_before=segments_before,
             segments_after=len(self.reader.segments),
@@ -135,14 +143,25 @@ class IndexShard:
             wall_ms=(time.perf_counter() - t0) * 1000,
             # a new segment changes the segment list, which drops the
             # WHOLE ShardStats memo (stats() rebuild) — every interned
-            # skeleton/bundle rebuilds on the host
+            # skeleton/bundle rebuilds on the host — UNLESS segment-
+            # keyed carry is on, in which case the honest invalidation
+            # count is the carry's eviction subset
             memo_entries_dropped=(
                 len(cache.memo) if cache is not None
                 and self.reader._stats_cache is not cache else 0),
             memo_entries_keyed=0,          # refresh removes no segment
+            memo_invalidations=(report["evicted"]
+                                if report is not None else None),
+            memo_entries_kept=(report["kept"]
+                               if report is not None else None),
             event_id=ev.get("event_id") if ev else None,
             shard=f"{self.index_name}[{self.shard_id}]",
             warmup_registered=self._warmup_registered())
+        if binfo.get("precompiled"):
+            _CHURN.mark_precompiled([rec["churn_id"]],
+                                    binfo["took_ms"], by="barrier")
+        else:
+            self._maybe_precompile(rec)
 
     def flush(self):
         self.engine.flush()
@@ -164,7 +183,11 @@ class IndexShard:
         if scope is None:
             merged = self.engine.maybe_merge()
             if merged is not None:
-                self._sync_reader()
+                binfo = {}
+                with self._publish_barrier(binfo):
+                    self._sync_reader()
+                self._carry_report()
+                self._maybe_precompile(None)
             return merged
         t0 = time.perf_counter()
         cache = self.reader._stats_cache
@@ -177,10 +200,13 @@ class IndexShard:
                        if all(s.seg_id != sid
                               for s in self.engine.segments)]
         removed_uids = [before[sid] for sid in removed_ids]
-        with _CHURN.bound(scope):
-            self._sync_reader()
+        binfo = {}
+        with self._publish_barrier(binfo):
+            with _CHURN.bound(scope):
+                self._sync_reader()
+        report = self._carry_report()
         ev = self.engine.last_ingest_event
-        _CHURN.publish(
+        rec = _CHURN.publish(
             scope, "merge",
             segments_before=segments_before,
             segments_after=len(self.reader.segments),
@@ -190,11 +216,76 @@ class IndexShard:
                 len(cache.memo) if cache is not None
                 and self.reader._stats_cache is not cache else 0),
             memo_entries_keyed=_memo_keyed_count(cache, removed_uids),
+            memo_invalidations=(report["evicted"]
+                                if report is not None else None),
+            memo_entries_kept=(report["kept"]
+                               if report is not None else None),
             removed_seg_ids=removed_ids,
             event_id=ev.get("event_id") if ev else None,
             shard=f"{self.index_name}[{self.shard_id}]",
             warmup_registered=self._warmup_registered())
+        if binfo.get("precompiled"):
+            _CHURN.mark_precompiled([rec["churn_id"]],
+                                    binfo["took_ms"], by="barrier")
+        else:
+            self._maybe_precompile(rec)
         return merged
+
+    @contextmanager
+    def _publish_barrier(self, out: dict):
+        """Barrier-mode publish (ISSUE 16, `search.precompile.barrier`):
+        the reader mutations inside this block build a STAGED pair; the
+        warmup registry replays against it with only this thread seeing
+        the stage; then the pair commits atomically. Serving threads
+        can never observe a segment set whose executables are not
+        compiled — recompile-on-serve is structurally zero, at the cost
+        of delaying each publish's visibility by the replay (the async
+        worker instead races the first query). No-op passthrough unless
+        both precompiler flags are on."""
+        from opensearch_tpu.search.warmup import PRECOMPILE
+        pc = PRECOMPILE.gate()
+        if pc is None or not pc.barrier:
+            yield
+            return
+        self.reader.begin_staged_publish()
+        try:
+            with self.reader.staged_visible():
+                yield
+                # replay unconditionally: shape novelty is judged against
+                # the process-wide seen-set, but compiled bundles live per
+                # executor — a globally-known shape can still be cold
+                # HERE. A warm replay costs microseconds (every JIT call
+                # cache-hits), so the gate would only save noise while
+                # risking a serve-path compile.
+                self.reader.take_novel_shapes()
+                out["took_ms"] = pc.precompile_staged(
+                    self.executor, self.index_name)
+                out["precompiled"] = True
+        finally:
+            self.reader.commit_staged_publish()
+
+    def _carry_report(self) -> Optional[dict]:
+        """Eager ShardStats rebuild when segment-keyed memo carry is on
+        (ISSUE 16): the carry pass runs here at publish time — on the
+        writing thread, off the serving path — and its kept/evicted
+        counts land on this event's churn record. With carry off this
+        is a no-op (stats rebuild stays lazy, on first search)."""
+        if not self.reader.memo_carry:
+            return None
+        return getattr(self.reader.rebuild_stats(), "carry_report", None)
+
+    def _maybe_precompile(self, rec: Optional[dict]) -> None:
+        """Hand novel device shapes from this event to the off-path
+        precompiler. One attribute load + branch while the gate is off
+        (the no-op discipline)."""
+        from opensearch_tpu.search.warmup import PRECOMPILE
+        if PRECOMPILE.gate() is None:
+            return
+        shapes = self.reader.take_novel_shapes()
+        if not shapes:
+            return
+        PRECOMPILE.request(self.executor, self.index_name, shapes,
+                           churn_id=(rec or {}).get("churn_id"))
 
     def _warmup_registered(self) -> int:
         """Warmup-registry coverage stamped on churn records: how many

@@ -87,6 +87,24 @@ class Node:
             self.wave_scheduler.queue_depth
         self.wave_scheduler.apply_settings(
             _Settings(self.settings).as_dict())
+        # off-path shape precompiler (search/warmup.py Precompiler,
+        # ISSUE 16): replays the warmup registry on a helper thread
+        # whenever a segment publish lands a novel device shape. OFF by
+        # default (None-returning gate); `search.precompile.enabled`
+        # node/dynamic cluster setting or POST /_warmup/_precompile.
+        from opensearch_tpu.search.warmup import PRECOMPILE
+        PRECOMPILE.apply_settings(_Settings(self.settings).as_dict())
+        # delta segment publish (ops/device_segment.py, ISSUE 16):
+        # module-level gate, compact-prefix host→device transfers. A
+        # node-level static setting — flipping it mid-flight would split
+        # the ledger's byte accounting across two regimes.
+        raw_delta = self.settings.get("indices.publish.delta")
+        if raw_delta is not None:
+            from opensearch_tpu.common.settings import \
+                _parse_bool as _pb
+            from opensearch_tpu.ops import device_segment as _devseg
+            _devseg.DELTA_PUBLISH = _pb(raw_delta,
+                                        "indices.publish.delta")
         self.gateway = None
         if data_path is not None:
             from opensearch_tpu.gateway import Gateway
@@ -172,6 +190,8 @@ class Node:
             .as_dict())
         self.search_backpressure.apply_settings(merged)
         self.wave_scheduler.apply_settings(merged)
+        from opensearch_tpu.search.warmup import PRECOMPILE
+        PRECOMPILE.apply_settings(merged)
 
     def persist_metadata(self):
         """Write node metadata through the gateway (no-op without a data

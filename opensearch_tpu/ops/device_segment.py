@@ -20,16 +20,27 @@ Layout:
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field as dc_field
 from typing import Dict, Optional, Tuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from opensearch_tpu.index.segment import LENGTH_TABLE, Segment, pad_bucket
 
 INT32_MAX = np.int32(2 ** 31 - 1)
 _F32_MAX = float(np.finfo(np.float32).max)
+
+# ISSUE 16 delta publish: when ON, publish_segment() ships only the
+# populated prefix of every padded column to the device and expands it
+# to the padded bucket on-chip (jnp.full + .at[].set under jit) — the
+# resident image is byte-identical to a full upload_segment(), but the
+# host→device transfer (and the churn ledger's upload.corpus bytes) is
+# proportional to real data, not the power-of-two bucket. OFF by
+# default: the default write path is exactly upload_segment().
+DELTA_PUBLISH = False
 
 
 def _to_f32_finite(values: np.ndarray) -> np.ndarray:
@@ -202,6 +213,102 @@ def tree_nbytes(tree) -> int:
     if isinstance(tree, dict):
         return sum(tree_nbytes(v) for v in tree.values())
     return int(getattr(tree, "nbytes", 0))
+
+
+def _compact_spec(seg: Segment, meta: DeviceSegmentMeta) -> Dict[tuple, tuple]:
+    """Tree-path → ((compact extents, None = full axis), pad fill) for
+    every leaf whose padded tail is a constant fill. Leaves absent from
+    the spec (length_table, ivf_* packings) transfer in full."""
+    nd = seg.num_docs
+    nb = seg.post_docs.shape[0]
+    # postings width is sized to the DOC pad bucket by the builder, but
+    # a term's doc list can never exceed num_docs — on a small segment
+    # (the refresh-churn case) the width axis is almost all fill, and
+    # it is the dominant share of the padded image
+    spec: Dict[tuple, tuple] = {
+        ("post_docs",): ((nb, nd), -1),
+        ("post_tf",): ((nb, nd), 0.0),
+        ("norms",): ((None, nd), 0),
+        ("live",): ((nd,), False),
+        ("root",): ((nd,), False),
+        ("parent_ptr",): ((nd,), -1),
+        ("nested_path",): ((nd,), -1),
+    }
+    for fname, col in seg.numeric_dv.items():
+        nv = len(col.doc_ids)
+        spec[("numeric", fname, "doc_ids")] = ((nv,), -1)
+        spec[("numeric", fname, "val_ords")] = ((nv,), 0)
+        spec[("numeric", fname, "values_f32")] = ((nv,), 0.0)
+        spec[("numeric", fname, "exists")] = ((nd,), False)
+        # minimum.at/maximum.at only touch rows < num_docs, so the
+        # padded tail keeps the initial fill
+        spec[("numeric", fname, "min_rank")] = ((nd,), int(INT32_MAX))
+        spec[("numeric", fname, "max_rank")] = ((nd,), -1)
+        spec[("numeric", fname, "unique_f32")] = ((len(col.unique),), 0.0)
+    for fname, col in seg.ordinal_dv.items():
+        nv = len(col.doc_ids)
+        spec[("ordinal", fname, "doc_ids")] = ((nv,), -1)
+        spec[("ordinal", fname, "ords")] = ((nv,), 0)
+        spec[("ordinal", fname, "exists")] = ((nd,), False)
+    for fname in seg.vector_dv:
+        spec[("vector", fname, "vectors")] = ((nd, None), 0.0)
+        spec[("vector", fname, "exists")] = ((nd,), False)
+    return spec
+
+
+@functools.lru_cache(maxsize=1024)
+def _expand_fn(compact_shape: tuple, full_shape: tuple, fill, dtype_str: str):
+    """Compiled on-device expansion: fill-pad a compact prefix block out
+    to the padded bucket shape. Cached per (shapes, fill, dtype) family —
+    compact extents are power-of-two bucketed by the caller so this stays
+    a bounded set of executables, not one per document count."""
+    def expand(x):
+        out = jnp.full(full_shape, fill, dtype=dtype_str)
+        return out.at[tuple(slice(0, s) for s in compact_shape)].set(x)
+    return jax.jit(expand)
+
+
+def _delta_tree(host, spec: Dict[tuple, tuple], transferred: list,
+                path: tuple = ()):
+    """Walk the host pytree; ship each specced leaf as its compact prefix
+    + on-device expansion, everything else in full. `transferred[0]`
+    accumulates actual host→device bytes."""
+    if isinstance(host, dict):
+        return {k: _delta_tree(v, spec, transferred, path + (k,))
+                for k, v in host.items()}
+    full = tuple(int(s) for s in host.shape)
+    entry = spec.get(path)
+    if entry is not None:
+        raw, fill = entry
+        # bucket the compact extents so the expansion executables form a
+        # bounded power-of-two family (same trick as pad_bucket itself)
+        cshape = tuple(
+            f if c is None else min(pad_bucket(max(int(c), 1), minimum=8), f)
+            for c, f in zip(raw, full))
+        if cshape != full:
+            compact = np.ascontiguousarray(
+                host[tuple(slice(0, s) for s in cshape)])
+            transferred[0] += int(compact.nbytes)
+            return _expand_fn(cshape, full, fill,
+                              str(host.dtype))(jnp.asarray(compact))
+    transferred[0] += int(host.nbytes)
+    return jnp.asarray(host)
+
+
+def publish_segment(seg: Segment, to_device: bool = True):
+    """upload_segment + transfer accounting: returns (arrays, meta,
+    transfer_nbytes). With DELTA_PUBLISH off (the default) this is
+    exactly upload_segment and the transfer equals the resident image;
+    with it on, only the populated prefixes cross the host→device link
+    and transfer_nbytes is the byte-exact compact total."""
+    if not DELTA_PUBLISH or not to_device:
+        arrays, meta = upload_segment(seg, to_device=to_device)
+        return arrays, meta, tree_nbytes(arrays)
+    host, meta = upload_segment(seg, to_device=False)
+    spec = _compact_spec(seg, meta)
+    transferred = [0]
+    arrays = _delta_tree(host, spec, transferred)
+    return arrays, meta, transferred[0]
 
 
 def refresh_live(arrays: Dict, seg: Segment):

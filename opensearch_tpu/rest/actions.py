@@ -2449,7 +2449,11 @@ def register_telemetry_actions(node, c):
     def do_get_ingest(req):
         # the write path's observability face (ISSUE 13): ingest
         # lifecycle timelines + the always-on engine event log + the
-        # segment-churn ledger's per-event device-cost attribution
+        # segment-churn ledger's per-event device-cost attribution,
+        # plus the off-path precompiler's counters (ISSUE 16) — the
+        # warm_hit/precompiled/recompile-on-serve verdict mix is read
+        # straight off this endpoint
+        from opensearch_tpu.search.warmup import PRECOMPILE
         from opensearch_tpu.telemetry.lifecycle import INGEST_EVENTS
         size = req.int_param("size", 0)
         return {"enabled": TELEMETRY.ingest.enabled,
@@ -2458,7 +2462,8 @@ def register_telemetry_actions(node, c):
                 "events": INGEST_EVENTS.recent(size or None),
                 "churn": {**TELEMETRY.churn.snapshot(),
                           "records": TELEMETRY.churn.records(
-                              size or None)}}
+                              size or None)},
+                "precompile": PRECOMPILE.stats()}
 
     def do_ingest_enable(req):
         # one switch for the write-path instrumentation pair: per-op
@@ -2478,6 +2483,28 @@ def register_telemetry_actions(node, c):
         TELEMETRY.churn.reset()
         INGEST_EVENTS.clear()
         return {"acknowledged": True}
+
+    def do_precompile(req):
+        # ISSUE 16 off-path precompilation trigger: drain anything the
+        # background worker has queued, then replay the warmup registry
+        # on this thread with the compiles attributed off-path. Works
+        # with the background gate off — an explicit POST is operator
+        # opt-in by construction.
+        from opensearch_tpu.search.warmup import PRECOMPILE
+        index = req.param("index")
+        raw_budget = req.param("budget_ms")
+        budget_s = None
+        if raw_budget is not None:
+            try:
+                budget_s = float(raw_budget) / 1000.0
+            except (TypeError, ValueError):
+                raise IllegalArgumentError(
+                    f"failed to parse [budget_ms] with value "
+                    f"[{raw_budget!r}]")
+        drained = PRECOMPILE.run_pending()
+        r = PRECOMPILE.sweep(node.indices, index, budget_s)
+        return {"acknowledged": True, **r, "drained": drained,
+                "precompile": PRECOMPILE.stats()}
 
     def do_get_insights(req):
         # query insights (ISSUE 15): per-shape cost attribution rows +
@@ -2555,6 +2582,8 @@ def register_telemetry_actions(node, c):
     c.register("POST", "/_telemetry/ingest/_enable", do_ingest_enable)
     c.register("POST", "/_telemetry/ingest/_disable", do_ingest_disable)
     c.register("POST", "/_telemetry/ingest/_clear", do_ingest_clear)
+    c.register("POST", "/_warmup/_precompile", do_precompile)
+    c.register("POST", "/{index}/_warmup/_precompile", do_precompile)
     c.register("GET", "/_telemetry/devices", do_get_devices)
     c.register("POST", "/_telemetry/devices/_enable", do_devices_enable)
     c.register("POST", "/_telemetry/devices/_disable",

@@ -102,6 +102,19 @@ class RotatingMemo:
             return v               # as 0 — an acceptable undercount)
         return default
 
+    def peek(self, key, default=None):
+        """Lookup WITHOUT promotion: the memo-carry pass (ISSUE 16) scans
+        a retiring generation from the refresh thread while serving
+        threads may still hit it — promotion would pointlessly mutate a
+        memo that is about to be unreferenced."""
+        v = self._new.get(key, self._MISS)
+        if v is not self._MISS:
+            return v
+        v = self._old.get(key, self._MISS)
+        if v is not self._MISS:
+            return v
+        return default
+
     def set(self, key, value, cost: int = 0) -> None:
         new = self._new
         new[key] = value
@@ -203,6 +216,14 @@ class ShardStats:
     scores with the same idf/avgdl — matching Lucene's per-shard
     CollectionStatistics/TermStatistics."""
 
+    # memo-carry bookkeeping (ISSUE 16): set by ShardReader._build_stats
+    # when segment-keyed carry is on — the mapper version this stats was
+    # built under (the carry precondition) and the carry pass's report
+    # ({kept, evicted, partial, by_family}), which the churn ledger
+    # publishes as `memo_invalidations`/`memo_entries_kept`
+    built_mapper_version: Optional[int] = None
+    carry_report: Optional[dict] = None
+
     def __init__(self, segments: Sequence[Segment]):
         self.segments = list(segments)
         self._field: Dict[str, Tuple[int, int]] = {}
@@ -241,6 +262,131 @@ class ShardStats:
         value = bm25_idf(dc, df) if df else 0.0
         self._idf[key] = value
         return value
+
+
+class _PartialBundle:
+    """A carried ("qenv", ...) interned msearch bundle covering only the
+    first `n_segs` segments of a pure-append segment list: its plans,
+    flattened inputs and grouping signatures are positionally valid for
+    the shared prefix, and the serving thread completes the tail (the
+    newly published segments) on first use — compiling len(segments) −
+    n_segs per-segment plans instead of rebuilding the whole bundle.
+    Stored in the memo in place of the 8-tuple; the executor's
+    _msearch_prepare dispatches on isinstance."""
+
+    __slots__ = ("bundle", "n_segs")
+
+    def __init__(self, bundle: tuple, n_segs: int):
+        self.bundle = bundle
+        self.n_segs = n_segs
+
+
+# memo families whose values are segment-keyed but stats-independent —
+# carried whenever their segment uid survives (see carry_memo)
+_CARRY_UID_FAMILIES = ("skel", "slice")
+
+
+def carry_memo(old: "ShardStats", new: "ShardStats") -> dict:
+    """Segment-keyed memo carry (ISSUE 16 tentpole b): copy the entries
+    of a retiring ShardStats memo that remain VALID for the new segment
+    list into the fresh stats' memo, replacing the wholesale drop a
+    segment-list change used to cause (~1,400 interned entries rebuilt
+    for a 32-doc refresh, PROFILE round 11).
+
+    Validity is decided per key family against the two facts a publish
+    can change: which segment uids survive, and which fields' summed
+    (doc_count, sum_total_term_freq) moved. BM25 physics make the field
+    check exact: a doc carrying field F bumps F's doc_count and ttf
+    together, so unchanged (dc, ttf) ⇒ no new/removed docs hold F ⇒
+    unchanged df for every term of F ⇒ unchanged idf and avgdl — every
+    weight an entry folded is still byte-identical.
+
+      - ("an", analyzer, text): segment- and stats-independent; carried
+        always (the caller already pinned the mapper version).
+      - ("skel", uid, ...) / ("slice", uid, ...): segment-keyed,
+        stats-independent binders — carried iff the uid survives.
+      - ("tc", uid, field, weighted_terms, ...): weights fold idf and
+        inputs embed avgdl — carried iff the uid survives AND the
+        field's (dc, ttf) is unchanged.
+      - ("aggc", uid, agg_json): compiled agg plans may embed sub-query
+        plans — carried iff the uid survives, no changed field name
+        occurs in the agg JSON, and no script participates (substring
+        checks: a false positive only widens eviction, never staleness).
+      - ("qenv", ...): whole per-segment-positional bundles — carried
+        iff the publish was a pure APPEND (the old list is an identity
+        prefix of the new one), the bundle is not the all-none
+        short-circuit form, and no changed field name occurs in the key
+        (interned template sigs name every referenced field explicitly —
+        dsl interning covers no default-field query kinds). A bundle
+        with appended tail segments is wrapped as _PartialBundle so the
+        tail compiles lazily on first use.
+      - anything else: evicted (unknown family — staleness unprovable).
+
+    Carried entries re-insert with cost 0 — the same acceptable byte
+    undercount RotatingMemo promotion already makes.
+
+    Returns the carry report {"kept", "evicted", "partial",
+    "by_family": {family: [kept, evicted]}}; `evicted` is what the
+    churn record publishes as `memo_invalidations`."""
+    old_segs, new_segs = old.segments, new.segments
+    new_uids = {s.uid for s in new_segs}
+    changed = frozenset(
+        f for f in set(old._field) | set(new._field)
+        if old._field.get(f, (0, 0)) != new._field.get(f, (0, 0)))
+    n_old = len(old_segs)
+    pure_append = (n_old > 0 and len(new_segs) >= n_old and
+                   all(a is b for a, b in zip(old_segs, new_segs)))
+    report: dict = {"kept": 0, "evicted": 0, "partial": 0,
+                    "by_family": {}}
+
+    def _tally(fam, kept):
+        row = report["by_family"].setdefault(fam or "?", [0, 0])
+        row[0 if kept else 1] += 1
+        report["kept" if kept else "evicted"] += 1
+
+    miss = RotatingMemo._MISS
+    old_memo, new_memo = old.memo, new.memo
+    for key in old_memo.keys():
+        fam = key[0] if isinstance(key, tuple) and key and \
+            isinstance(key[0], str) else None
+        keep = False
+        if fam == "an":
+            keep = True
+        elif fam in _CARRY_UID_FAMILIES or fam == "tc":
+            keep = len(key) > 2 and key[1] in new_uids and \
+                (fam != "tc" or key[2] not in changed)
+        elif fam == "aggc" and len(key) > 2 and key[1] in new_uids:
+            agg_json = key[2] or ""
+            keep = "script" not in agg_json and \
+                not any(f in agg_json for f in changed)
+        elif fam == "qenv" and pure_append:
+            rk = repr(key)
+            keep = not any(f in rk for f in changed)
+        if not keep:
+            _tally(fam, kept=False)
+            continue
+        value = old_memo.peek(key, miss)
+        if value is miss:
+            # rotated out between keys() and peek (racy by design)
+            _tally(fam, kept=False)
+            continue
+        if fam == "qenv":
+            if isinstance(value, _PartialBundle):
+                # carried earlier, never completed: its prefix is still
+                # a prefix of the (pure-append) new list
+                report["partial"] += 1
+            elif value[7]:
+                # all-none short-circuit bundle: struct/flats are None,
+                # so the tail cannot extend it — and the new segments
+                # may genuinely match. Recompile from scratch.
+                _tally(fam, kept=False)
+                continue
+            elif len(new_segs) > n_old:
+                value = _PartialBundle(value, n_old)
+                report["partial"] += 1
+        new_memo.set(key, value)
+        _tally(fam, kept=True)
+    return report
 
 
 class StaticStats:

@@ -29,6 +29,7 @@ import queue
 import threading
 import time
 import weakref
+from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -43,11 +44,13 @@ from opensearch_tpu.index.mapper import MapperService
 from opensearch_tpu.index.segment import Segment, pad_bucket
 from opensearch_tpu.ops.bm25 import (
     ordinal_terms_match, range_match_on_ranks, score_text_clause)
+from opensearch_tpu.ops import device_segment as _devseg
 from opensearch_tpu.ops.device_segment import (
     DeviceSegmentMeta, refresh_live, tree_nbytes, upload_segment)
 from opensearch_tpu.ops.topk import NEG_INF
 from opensearch_tpu.search import dsl
 from opensearch_tpu.search.compile import (Compiler, Plan, ShardStats,
+                                           _PartialBundle, carry_memo,
                                            struct_fingerprint)
 from opensearch_tpu.search.plan_eval import _eval_plan
 from opensearch_tpu.search.aggs.engine import compile_aggs, eval_aggs
@@ -83,6 +86,14 @@ def _item_shape(node, body: dict) -> Tuple[str, str]:
     if isinstance(node, dsl.QueryTemplate):
         return template_shape(node.sig), "template"
     return structural_shape(body.get("query")), "hash"
+
+
+def _live_sig(seg) -> bytes:
+    """Packed live-mask bytes — the skip key delta publish compares to
+    decide whether a refresh must re-ship a segment's liveness bitmap
+    at all (ISSUE 16 tentpole d). One packbits over num_docs bools per
+    segment per refresh, write-path only."""
+    return np.packbits(np.asarray(seg.live, dtype=bool)).tobytes()  # sync-ok: host -- seg.live is the engine's host-side bitmap
 
 
 def _shape_sig(tree, prefix="") -> tuple:
@@ -157,6 +168,39 @@ class ShardReader:
         self._publish_lock = threading.Lock()
         self._stats_cache: Optional[ShardStats] = None
         self._seg_bytes: Dict[str, int] = {}    # seg_id → device bytes
+        # segment-keyed memo carry (ISSUE 16 tentpole b, gate-lint row):
+        # OFF by default — a publish drops the whole ShardStats memo
+        # exactly as before; ON, _build_stats copies still-valid interned
+        # entries into the fresh memo (see compile.carry_memo)
+        self.memo_carry = False
+        # the retiring ShardStats a publish displaced — carry_memo's
+        # source (publish sites null _stats_cache, so without this
+        # stash the carry would never see the old memo)
+        self._carry_prev: Optional[ShardStats] = None
+        # novel device shape fingerprints accumulated by uploads since
+        # the last take_novel_shapes() — the precompiler's trigger feed.
+        # Swapped wholesale on take; a racing append into the retiring
+        # list can drop a fingerprint, which only delays (never breaks)
+        # precompilation — the warmup replay covers the whole registry.
+        self._novel_shapes: List[str] = []
+        # last-uploaded packed live mask per seg_id, kept only while
+        # delta publish is on: lets a refresh skip the per-segment
+        # live-mask re-upload when no delete touched the mask
+        self._live_sigs: Dict[str, bytes] = {}
+        # staged-publish barrier (ISSUE 16 tentpole a, barrier mode):
+        # while a publisher holds the stage, mutations build `_staged`
+        # instead of `_published`; only a thread inside staged_visible()
+        # (the precompile replay) sees the staged pair — every serving
+        # thread keeps reading the old published pair until commit, so
+        # queries never observe a segment set whose executables were
+        # not compiled yet.
+        self._staged: Optional[Tuple[List[Segment],
+                                     List[Tuple[Dict,
+                                                DeviceSegmentMeta]]]] = None
+        self._staged_stats: Optional[ShardStats] = None
+        self._staging = False
+        self._stage_tls = threading.local()
+        self._stage_lock = threading.Lock()
         _LIVE_READERS.add(self)
         for seg in (segments or []):
             self.add_segment(seg)
@@ -172,7 +216,11 @@ class ShardReader:
     def snapshot(self) -> Tuple[List[Segment],
                                 List[Tuple[Dict, DeviceSegmentMeta]]]:
         """One consistent (segments, device) pair — the per-request
-        anchor every query/fetch phase must zip from."""
+        anchor every query/fetch phase must zip from. On the barrier
+        replay thread (staged_visible) the staged pair IS the pair."""
+        if getattr(self._stage_tls, "on", False) and \
+                self._staged is not None:
+            return self._staged
         return self._published
 
     @property
@@ -181,53 +229,129 @@ class ShardReader:
         the corpus-columns slice of the device-memory stats."""
         return sum(self._seg_bytes.values())
 
+    # ------------------------------------------------- staged publish
+
+    def _cur_pair_locked(self):
+        """The pair mutations build on: the staged pair while a barrier
+        publish is open, the published pair otherwise. Caller holds
+        _publish_lock."""
+        return self._staged if self._staging else self._published
+
+    def _set_pair_locked(self, pair) -> None:
+        """Install a mutated pair: into the stage while a barrier
+        publish is open (the live published pair — and its stats cache
+        — keep serving untouched), directly into _published otherwise.
+        Caller holds _publish_lock."""
+        if self._staging:
+            self._staged = pair
+            self._staged_stats = None
+        else:
+            self._published = pair
+            self._retire_stats_locked()
+
+    def begin_staged_publish(self) -> None:
+        """Open a barrier publish: subsequent mutations land in a
+        staged copy of the published pair, invisible to serving threads
+        until commit_staged_publish(). Single-publisher: a concurrent
+        refresh/merge blocks here until the holder commits."""
+        self._stage_lock.acquire()
+        with self._publish_lock:
+            self._staged = self._published
+            self._staged_stats = self._stats_cache
+            self._staging = True
+
+    def commit_staged_publish(self) -> None:
+        """Atomically publish the staged pair (with whatever stats the
+        precompile replay built against it — its memo already holds the
+        carried + freshly-compiled bundles) and release the stage."""
+        try:
+            with self._publish_lock:
+                pair, stats = self._staged, self._staged_stats
+                self._staging = False
+                self._staged = None
+                self._staged_stats = None
+                if pair is not None and pair is not self._published:
+                    self._retire_stats_locked()
+                    self._published = pair
+                    self._stats_cache = stats
+        finally:
+            self._stage_lock.release()
+
+    @contextmanager
+    def staged_visible(self):
+        """Make the staged pair THIS thread's snapshot source — the
+        precompile replay runs its warm searches under this, compiling
+        against the exact pair the commit will publish."""
+        prev = getattr(self._stage_tls, "on", False)
+        self._stage_tls.on = True
+        try:
+            yield
+        finally:
+            self._stage_tls.on = prev
+
     def add_segment(self, seg: Segment):
-        arrays, meta = upload_segment(seg)
+        # delta publish (ISSUE 16 tentpole d): gated inside
+        # publish_segment — disabled it IS upload_segment and
+        # xfer == resident bytes, byte-for-byte the legacy accounting
+        arrays, meta, xfer = _devseg.publish_segment(seg)
         nb = tree_nbytes(arrays)
         with self._publish_lock:
-            segs, dev = self._published
-            self._published = (segs + [seg], dev + [(arrays, meta)])
+            segs, dev = self._cur_pair_locked()
+            self._set_pair_locked((segs + [seg], dev + [(arrays, meta)]))
             self._seg_bytes[seg.seg_id] = nb
-            self._stats_cache = None
+        if _devseg.DELTA_PUBLISH:
+            self._live_sigs[seg.seg_id] = _live_sig(seg)
         if _LEDGER.enabled:
-            _LEDGER.record("upload.corpus", "h2d", nb)
+            _LEDGER.record("upload.corpus", "h2d", xfer)
         # churn attribution (ISSUE 13): the seen-shape set is fed on
         # EVERY upload (the verdict is only honest if pre-enable uploads
         # count); the per-event scope records only while a refresh/merge
         # holds one bound. The signature is the TRUE executable-reuse
         # identity: meta.compile_key() (the constants traced programs
         # close over) + every device array's (path, shape, dtype).
-        known = _CHURN.observe_shape(struct_fingerprint(
-            (meta.compile_key(), _shape_sig(arrays))))
+        fp = struct_fingerprint((meta.compile_key(), _shape_sig(arrays)))
+        known = _CHURN.observe_shape(fp)
+        if not known:
+            ns = self._novel_shapes
+            ns.append(fp)
+            if len(ns) > 64:        # bounded when nothing drains it
+                del ns[:len(ns) - 64]
         cs = _CHURN.current()
         if cs is not None:
-            cs.note_upload(seg.seg_id, nb, known)
+            cs.note_upload(seg.seg_id, xfer, known)
 
     def remove_segment(self, seg_id: str):
         with self._publish_lock:
-            segs, dev = self._published
+            segs, dev = self._cur_pair_locked()
             for i, seg in enumerate(segs):
                 if seg.seg_id == seg_id:
-                    self._published = (segs[:i] + segs[i + 1:],
-                                       dev[:i] + dev[i + 1:])
+                    self._set_pair_locked((segs[:i] + segs[i + 1:],
+                                           dev[:i] + dev[i + 1:]))
                     self._seg_bytes.pop(seg_id, None)
-                    self._stats_cache = None
+                    self._live_sigs.pop(seg_id, None)
                     return
 
     def notify_deletes(self, seg: Segment):
         live_nbytes = None
         with self._publish_lock:
-            segs, dev = self._published
+            segs, dev = self._cur_pair_locked()
             for i, s in enumerate(segs):
                 if s is seg:
                     arrays, meta = dev[i]
-                    self._published = (
-                        segs,
-                        dev[:i] + [(refresh_live(arrays, seg), meta)]
-                        + dev[i + 1:])
+                    pair = (segs,
+                            dev[:i] + [(refresh_live(arrays, seg), meta)]
+                            + dev[i + 1:])
+                    # segments list unchanged → the stats cache (and its
+                    # memo) stays valid; only the device pair re-publishes
+                    if self._staging:
+                        self._staged = pair
+                    else:
+                        self._published = pair
                     live_nbytes = int(arrays["live"].nbytes)
                     break
         if live_nbytes is not None:
+            if _devseg.DELTA_PUBLISH:
+                self._live_sigs[seg.seg_id] = _live_sig(seg)
             if _LEDGER.enabled:
                 # only the liveness bitmap re-uploads
                 _LEDGER.record("upload.corpus", "h2d", live_nbytes)
@@ -240,26 +364,38 @@ class ShardReader:
         (recovery/segment-replication installs clone_for_copy objects):
         shared immutable columns keep their device image, only the live
         mask re-uploads; a genuinely different segment re-uploads fully."""
-        segs = self._published[0]
+        segs = (self._staged if self._staging else self._published)[0]
         for i, s in enumerate(segs):
             if s.seg_id != seg.seg_id:
                 continue
             if s is seg or s.post_docs is seg.post_docs:
+                if _devseg.DELTA_PUBLISH and s is seg:
+                    # delta publish (ISSUE 16 tentpole d): the reader
+                    # already holds this exact object — when the live
+                    # mask is byte-identical to the last uploaded one,
+                    # the refresh ships NOTHING for this segment (the
+                    # legacy path re-uploads every segment's mask every
+                    # refresh). The published pair and stats cache stay
+                    # untouched: nothing changed.
+                    sig = _live_sig(seg)
+                    if self._live_sigs.get(seg.seg_id) == sig:
+                        return
                 live_nbytes = None
                 with self._publish_lock:
-                    segs, dev = self._published
+                    segs, dev = self._cur_pair_locked()
                     for j, sj in enumerate(segs):
                         if sj.seg_id == seg.seg_id:
                             arrays, meta = dev[j]
-                            self._published = (
+                            self._set_pair_locked((
                                 segs[:j] + [seg] + segs[j + 1:],
                                 dev[:j]
                                 + [(refresh_live(arrays, seg), meta)]
-                                + dev[j + 1:])
+                                + dev[j + 1:]))
                             live_nbytes = int(arrays["live"].nbytes)
-                            self._stats_cache = None
                             break
                 if live_nbytes is not None:
+                    if _devseg.DELTA_PUBLISH:
+                        self._live_sigs[seg.seg_id] = _live_sig(seg)
                     if _LEDGER.enabled:
                         _LEDGER.record("upload.corpus", "h2d",
                                        live_nbytes)
@@ -267,26 +403,30 @@ class ShardReader:
                     if cs is not None:
                         cs.note_live_mask(live_nbytes)
             else:
-                uploaded = upload_segment(seg)
-                nb = tree_nbytes(uploaded[0])
+                arrays, meta, xfer = _devseg.publish_segment(seg)
+                nb = tree_nbytes(arrays)
                 with self._publish_lock:
-                    segs, dev = self._published
+                    segs, dev = self._cur_pair_locked()
                     for j, sj in enumerate(segs):
                         if sj.seg_id == seg.seg_id:
-                            self._published = (
+                            self._set_pair_locked((
                                 segs[:j] + [seg] + segs[j + 1:],
-                                dev[:j] + [uploaded] + dev[j + 1:])
+                                dev[:j] + [(arrays, meta)]
+                                + dev[j + 1:]))
                             self._seg_bytes[seg.seg_id] = nb
-                            self._stats_cache = None
                             break
+                if _devseg.DELTA_PUBLISH:
+                    self._live_sigs[seg.seg_id] = _live_sig(seg)
                 if _LEDGER.enabled:
-                    _LEDGER.record("upload.corpus", "h2d", nb)
-                known = _CHURN.observe_shape(struct_fingerprint(
-                    (uploaded[1].compile_key(),
-                     _shape_sig(uploaded[0]))))
+                    _LEDGER.record("upload.corpus", "h2d", xfer)
+                fp = struct_fingerprint((meta.compile_key(),
+                                         _shape_sig(arrays)))
+                known = _CHURN.observe_shape(fp)
+                if not known:
+                    self._novel_shapes.append(fp)
                 cs = _CHURN.current()
                 if cs is not None:
-                    cs.note_upload(seg.seg_id, nb, known)
+                    cs.note_upload(seg.seg_id, xfer, known)
             return
         self.add_segment(seg)
 
@@ -309,14 +449,68 @@ class ShardReader:
         for exactly the returned segment list, and the device list is
         its pair. Retries if a refresh publishes mid-build (rare; the
         loop converges as soon as one read sees a stable pair)."""
+        if getattr(self._stage_tls, "on", False):
+            # barrier-publish replay thread: snapshot the STAGED pair —
+            # the stats built here (memo carry + compiled bundles)
+            # become the published cache at commit
+            with self._publish_lock:
+                pair = self._staged
+                stats = self._staged_stats
+            if pair is not None:
+                if stats is None or stats.segments != pair[0]:
+                    stats = self._build_stats(pair[0])
+                    self._staged_stats = stats
+                return stats, pair[0], pair[1]
         while True:
             pub = self._published
             stats = self._stats_cache
             if stats is None or stats.segments != pub[0]:
-                stats = ShardStats(pub[0])
+                stats = self._build_stats(pub[0])
                 self._stats_cache = stats
             if self._published is pub:
                 return stats, pub[0], pub[1]
+
+    def _retire_stats_locked(self) -> None:
+        """Invalidate the stats cache on publish; with memo carry on,
+        stash the retiring stats so the next build can copy still-valid
+        interned entries out of its memo. Caller holds _publish_lock."""
+        if self.memo_carry and self._stats_cache is not None:
+            self._carry_prev = self._stats_cache
+        self._stats_cache = None
+
+    def _build_stats(self, segments: List[Segment]) -> ShardStats:
+        """Build the ShardStats for a published segment list. With memo
+        carry ON (ISSUE 16 tentpole b) the retiring cache's still-valid
+        interned entries copy into the fresh memo instead of dropping
+        wholesale — see compile.carry_memo for the per-family rules.
+        The carry copies into a FRESH RotatingMemo (never reuses the
+        old object): an in-flight query holding the old snapshot keeps
+        writing old-list-aligned bundles into the OLD memo, harmlessly."""
+        stats = ShardStats(segments)
+        stats.built_mapper_version = getattr(self.mapper, "version", 0)
+        old = self._stats_cache
+        if old is None:
+            old = self._carry_prev
+        if self.memo_carry and old is not None and \
+                getattr(old, "built_mapper_version", None) == \
+                stats.built_mapper_version:
+            stats.carry_report = carry_memo(old, stats)
+            self._carry_prev = None
+        return stats
+
+    def rebuild_stats(self) -> ShardStats:
+        """Eagerly (re)build + cache the stats for the CURRENT published
+        pair — called by the refreshing thread right after a publish so
+        the carry pass runs OFF the serving path: serving threads find a
+        warm cache instead of paying the rebuild under a query."""
+        return self.stats_snapshot()[0]
+
+    def take_novel_shapes(self) -> List[str]:
+        """Drain the novel device-shape fingerprints uploads accumulated
+        since the last take — the precompiler's per-publish trigger feed
+        (ISSUE 16 tentpole a)."""
+        shapes, self._novel_shapes = self._novel_shapes, []
+        return shapes
 
 
 class PinnedReader:
@@ -373,11 +567,38 @@ _THREAD_COMPILES = threading.local()
 def _note_compile(ms: float) -> None:
     from opensearch_tpu.telemetry import TELEMETRY
     m = TELEMETRY.metrics
-    m.counter("search.xla_cache_miss").inc()
-    m.histogram("search.xla_compile_ms").observe(ms)
+    if getattr(_THREAD_COMPILES, "offpath", False):
+        # precompiler replay thread (ISSUE 16): the compile happened
+        # OFF the serving path — it must not count as a serving-thread
+        # cache miss (the steady-state assertion is `xla_cache_miss`
+        # delta == 0 under ingest), but stays visible under its own name
+        m.counter("search.xla_compile_offpath").inc()
+        m.histogram("search.xla_compile_ms").observe(ms)
+    else:
+        m.counter("search.xla_cache_miss").inc()
+        m.histogram("search.xla_compile_ms").observe(ms)
+        # a serving thread paid the cliff: flip any pending `recompile`
+        # churn verdicts to `recompile-on-serve` (gated internally —
+        # disabled ledger costs one attribute load + branch)
+        _CHURN.note_serve_compile()
     if getattr(_THREAD_COMPILES, "active", False):
         _THREAD_COMPILES.count += 1
         _THREAD_COMPILES.ms += ms
+
+
+@contextmanager
+def offpath_compiles():
+    """Mark this thread's XLA compiles as OFF-PATH (the precompiler's
+    replay, search/warmup.py Precompiler): _note_compile routes them to
+    `search.xla_compile_offpath` instead of `search.xla_cache_miss`, so
+    background compilation never pollutes the serving-thread compile
+    counters a bench or operator watches for the first-touch cliff."""
+    prev = getattr(_THREAD_COMPILES, "offpath", False)
+    _THREAD_COMPILES.offpath = True
+    try:
+        yield
+    finally:
+        _THREAD_COMPILES.offpath = prev
 
 
 def _timed_first_call(fn):
@@ -2884,7 +3105,8 @@ class SearchExecutor:
     def _compile_msearch_bundle(self, compiler: Compiler, stats, tpl,
                                 node, body: dict, agg_spec,
                                 agg_json: Optional[str] = None,
-                                snapshot=None) -> tuple:
+                                snapshot=None,
+                                force_full: bool = False) -> tuple:
         """Compile ONE sub-request's per-segment plans + flattened inputs
         + grouping signatures. When `tpl` (a dsl.QueryTemplate) is given,
         plans bind through the (template, segment) skeleton cache
@@ -2930,7 +3152,10 @@ class SearchExecutor:
                 stats.memo[memo_key] = aplans
             agg_plans_per_seg.append(aplans)
         all_none = all(p is None or p.kind == "match_none" for p in plans)
-        if all_none:
+        if all_none and not force_full:
+            # force_full (the _PartialBundle tail-extension path) needs
+            # real struct/flats even for an all-none tail slice — the
+            # short-circuit form cannot concatenate positionally
             return (plans, None, None, None, None, agg_plans_per_seg,
                     agg_nodes, True)
         struct = tuple(plan_struct(p) if p is not None else None
@@ -2953,7 +3178,34 @@ class SearchExecutor:
                         for aplans in agg_plans_per_seg) \
             if agg_nodes else None
         return (plans, flats, struct, shape_sig, agg_sig,
-                agg_plans_per_seg, agg_nodes, False)
+                agg_plans_per_seg, agg_nodes, all_none)
+
+    def _extend_msearch_bundle(self, compiler: Compiler, stats, tpl,
+                               body: dict, agg_spec,
+                               agg_json: Optional[str],
+                               partial: _PartialBundle,
+                               snapshot) -> tuple:
+        """Complete a carried _PartialBundle (pure-append publish,
+        ISSUE 16 tentpole b): compile ONLY the appended tail segments
+        and concatenate the per-segment positional lists — a warm query
+        after a 32-doc refresh pays one tail-segment compile instead of
+        a whole-bundle rebuild. Returns the full 8-tuple for this
+        snapshot's segment list."""
+        segments, device = snapshot
+        n = partial.n_segs
+        (plans, flats, struct, shape_sig, agg_sig, agg_plans,
+         agg_nodes, _all_none) = partial.bundle
+        if n >= len(segments):
+            return partial.bundle
+        tail = self._compile_msearch_bundle(
+            compiler, stats, tpl, None, body, agg_spec, agg_json,
+            snapshot=(segments[n:], device[n:]), force_full=True)
+        (t_plans, t_flats, t_struct, t_shape, t_agg_sig, t_aggs,
+         _t_nodes, _t_all_none) = tail
+        return (plans + t_plans, flats + t_flats, struct + t_struct,
+                shape_sig + t_shape,
+                (agg_sig + t_agg_sig) if agg_sig is not None else None,
+                agg_plans + t_aggs, agg_nodes, False)
 
     def _msearch_prepare(self, batchable, responses, start, ph,
                          raise_item_errors: bool = False,
@@ -3024,6 +3276,21 @@ class SearchExecutor:
                 bkey = ("qenv", mapper_version, tpl.sig, tpl.literals,
                         agg_json)
                 bundle = stats.memo.get(bkey)
+                if isinstance(bundle, _PartialBundle):
+                    # pure-append carry (ISSUE 16): compile only the
+                    # appended tail segments, re-store the completed
+                    # bundle (two threads racing here duplicate one
+                    # tail compile, harmlessly — last store wins)
+                    try:
+                        bundle = self._extend_msearch_bundle(
+                            compiler, stats, tpl, body, agg_spec,
+                            agg_json, bundle, (segments, device))
+                    except Exception:  # except-ok: per-item isolation -- tail-compile failure falls back to the general path per item
+                        _general_fallback(i, body)
+                        continue
+                    cost = _bundle_nbytes(bundle[1])
+                    if cost <= _BUNDLE_MEMO_MAX_ENTRY_BYTES:
+                        stats.memo.set(bkey, bundle, cost=cost)
             bundle_hit = bundle is not None
             if bundle is None:
                 if tpl is not None:

@@ -32,9 +32,12 @@ import json
 import os
 import threading
 import time
+import weakref
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
+from opensearch_tpu.common.errors import SettingsError
+from opensearch_tpu.common.settings import _parse_bool
 from opensearch_tpu.search.compile import struct_fingerprint
 
 # registry bound: LRU over distinct (plan-struct, shape-bucket) sigs —
@@ -129,12 +132,17 @@ class WarmupRegistry:
         group per batch — memoized fingerprinting + LRU keep it O(dict)."""
         if not self._recording:
             return
-        sig = self._sig_memo.get(sig_material)
+        # the index is part of the identity: warm_executor filters
+        # replays by index, so a same-shaped registration from another
+        # index must create its OWN entry — deduping across indices
+        # leaves the later index with nothing to replay
+        key = (index_name, sig_material)
+        sig = self._sig_memo.get(key)
         if sig is None:
-            sig = struct_fingerprint(sig_material)
+            sig = struct_fingerprint(key)
             if len(self._sig_memo) > 4 * MAX_ENTRIES:
                 self._sig_memo.clear()
-            self._sig_memo[sig_material] = sig
+            self._sig_memo[key] = sig
         with self._lock:
             if sig in self._entries:
                 self._entries.move_to_end(sig)
@@ -352,5 +360,273 @@ class WarmupRegistry:
             self._dirty = False
 
 
-# node-wide singleton, like REQUEST_CACHE / QUERY_CACHE
+class Precompiler:
+    """Off-path shape precompilation (ISSUE 16): a background worker
+    that replays the warmup registry against a shard executor whenever
+    a segment publish lands a novel device shape bucket, so the ~400 ms
+    first-touch XLA cliff is paid on this helper thread instead of the
+    first user query over the new segment.
+
+    Flow: ShardReader collects novel shape fingerprints at upload;
+    IndexShard hands them here (request()) right after the churn record
+    publishes; the worker coalesces pending requests per index, replays
+    the registry via WARMUP.warm_executor under offpath_compiles() (so
+    the compiles count as `search.xla_compile_offpath`, not serving
+    cache misses), then flips the pending churn verdicts to
+    `precompiled` via the ledger's verdict lifecycle.
+
+    No-op discipline (gate-lint row, bench.py pristine assert): OFF by
+    default, `gate()` returns None when disabled — the refresh path
+    pays one attribute load + branch. `POST /_warmup/_precompile`
+    (sweep()) works even while disabled: it is an explicit operator
+    trigger, not the hot path."""
+
+    def __init__(self):
+        self.enabled = False
+        # barrier mode (second-level flag, like the shedder's
+        # shape_enabled): a publish STAGES the new (segments, device)
+        # pair, replays the registry against it on the publishing
+        # thread with only that thread seeing the stage, then commits —
+        # serving threads can never observe a segment set whose
+        # executables are uncompiled, so recompile-on-serve is zero by
+        # construction (async mode merely races the first query).
+        # Costs the publishing thread the replay; visibility of each
+        # refresh is delayed by the compile, exactly like a longer
+        # refresh interval.
+        self.barrier = False
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: List[dict] = []
+        self._queued_sigs: set = set()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        # one replay pass's compile budget (shared deadline across the
+        # registry, same semantics as warm_executor's budget_s)
+        self.budget_ms = 2000.0
+        self.stats_ = {"requests": 0, "runs": 0, "warmed": 0,
+                       "errors": 0, "deduped": 0, "last_run_ms": 0.0}
+
+    # ------------------------------------------------------------- gate
+
+    def gate(self):
+        """None when disabled (the no-op discipline); self when on."""
+        if not self.enabled:
+            return None
+        return self
+
+    # ---------------------------------------------------------- request
+
+    def request(self, executor, index_name: str, shapes,
+                churn_id: Optional[int] = None) -> None:
+        """Enqueue a precompile pass for `executor` covering the given
+        novel shape fingerprints. Deduplicates against already-queued
+        shapes — a burst of refreshes publishing the same shape bucket
+        costs one replay, not one per refresh."""
+        if not self.enabled:
+            return
+        with self._cv:
+            fresh = [s for s in shapes if s not in self._queued_sigs]
+            if not fresh and churn_id is None:
+                self.stats_["deduped"] += 1
+                return
+            self._queued_sigs.update(fresh)
+            self._queue.append({
+                "executor": weakref.ref(executor),
+                "index": index_name,
+                "shapes": fresh,
+                "churn_ids": [churn_id] if churn_id is not None else [],
+            })
+            self.stats_["requests"] += 1
+            self._cv.notify()
+
+    # ----------------------------------------------------------- worker
+
+    def _take_locked(self) -> Optional[dict]:
+        """Pop + coalesce every queued request for the head entry's
+        index into one batch (merged churn ids, shapes released from
+        the dedupe set). Caller holds the lock."""
+        if not self._queue:
+            return None
+        head = self._queue[0]
+        batch = {"executor": head["executor"], "index": head["index"],
+                 "churn_ids": [], "shapes": []}
+        rest = []
+        for req in self._queue:
+            if req["index"] == batch["index"]:
+                batch["churn_ids"].extend(req["churn_ids"])
+                batch["shapes"].extend(req["shapes"])
+            else:
+                rest.append(req)
+        self._queue = rest
+        for s in batch["shapes"]:
+            self._queued_sigs.discard(s)
+        return batch
+
+    def _service(self, batch: dict) -> None:
+        executor = batch["executor"]()
+        if executor is None:
+            return                        # shard closed; nothing to warm
+        from opensearch_tpu.search.executor import offpath_compiles
+        from opensearch_tpu.telemetry import TELEMETRY as _tel
+        t0 = time.monotonic()
+        try:
+            with offpath_compiles():
+                r = WARMUP.warm_executor(executor, batch["index"],
+                                         budget_s=self.budget_ms / 1000.0)
+        except Exception:   # except-ok: worker isolation -- a failing replay pass must not kill the precompile thread
+            self.stats_["errors"] += 1
+            return
+        took = (time.monotonic() - t0) * 1000
+        with self._lock:
+            self.stats_["runs"] += 1
+            self.stats_["warmed"] += r["warmed"]
+            self.stats_["errors"] += r["errors"]
+            self.stats_["last_run_ms"] = round(took, 2)
+        _tel.metrics.counter("precompile.runs").inc()
+        _tel.metrics.histogram("precompile.run_ms").observe(took)
+        if batch["churn_ids"]:
+            _tel.churn.mark_precompiled(batch["churn_ids"], took)
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stop:
+                    self._cv.wait(timeout=0.5)
+                if self._stop and not self._queue:
+                    return
+                batch = self._take_locked()
+            if batch is not None:
+                self._service(batch)
+
+    def run_pending(self) -> int:
+        """Synchronously drain the queue on the calling thread — the
+        deterministic path for tests and the REST trigger."""
+        n = 0
+        while True:
+            with self._lock:
+                batch = self._take_locked()
+            if batch is None:
+                return n
+            self._service(batch)
+            n += 1
+
+    def precompile_staged(self, executor, index_name: str) -> float:
+        """Barrier-mode replay: warm `executor` on the CALLING (i.e.
+        publishing) thread — the caller holds the reader's stage open
+        and made it thread-visible, so the compiles land against the
+        exact pair about to publish. Returns the replay wall ms."""
+        from opensearch_tpu.search.executor import offpath_compiles
+        from opensearch_tpu.telemetry import TELEMETRY as _tel
+        t0 = time.monotonic()
+        try:
+            with offpath_compiles():
+                r = WARMUP.warm_executor(executor, index_name,
+                                         budget_s=self.budget_ms / 1000.0)
+        except Exception:   # except-ok: publish isolation -- a failing replay must not abort the refresh that triggered it
+            self.stats_["errors"] += 1
+            return 0.0
+        took = (time.monotonic() - t0) * 1000
+        with self._lock:
+            self.stats_["runs"] += 1
+            self.stats_["warmed"] += r["warmed"]
+            self.stats_["errors"] += r["errors"]
+            self.stats_["last_run_ms"] = round(took, 2)
+        _tel.metrics.counter("precompile.runs").inc()
+        _tel.metrics.histogram("precompile.run_ms").observe(took)
+        return took
+
+    # ------------------------------------------------------------ sweep
+
+    def sweep(self, indices_service, index_name: Optional[str] = None,
+              budget_s: Optional[float] = None) -> dict:
+        """`POST /_warmup/_precompile`: replay the registry for one
+        index (or all) on the calling thread, compiles attributed
+        off-path. Deliberately works even while the background worker
+        is disabled — an explicit operator trigger is opt-in by
+        construction."""
+        from opensearch_tpu.search.executor import offpath_compiles
+        with offpath_compiles():
+            if index_name is None:
+                return WARMUP.warm_all(indices_service, budget_s)
+            if index_name not in indices_service.indices:
+                from opensearch_tpu.common.errors import \
+                    IndexNotFoundError
+                raise IndexNotFoundError(index_name)
+            svc = indices_service.indices[index_name]
+            return WARMUP.warm_index(
+                index_name, [s.executor for s in svc.shards], budget_s)
+
+    # --------------------------------------------------------- lifecycle
+
+    def set_enabled(self, on: bool) -> None:
+        on = bool(on)
+        if on == self.enabled:
+            return
+        if on:
+            self.enabled = True
+            self._stop = False
+            self._thread = threading.Thread(target=self._run,
+                                            name="tpu-precompile",
+                                            daemon=True)
+            self._thread.start()
+        else:
+            self.enabled = False
+            with self._cv:
+                self._stop = True
+                self._cv.notify_all()
+            t = self._thread
+            if t is not None:
+                t.join(timeout=2.0)
+            self._thread = None
+            with self._lock:
+                self._queue = []
+                self._queued_sigs.clear()
+
+    # ---------------------------------------------------------- settings
+
+    @staticmethod
+    def parse_settings(flat: dict) -> dict:
+        """Strict parse of precompiler settings (WaveScheduler idiom):
+        returns {enabled, budget_ms} with None for absent keys."""
+        def _num(key, cast):
+            if key not in flat:
+                return None
+            try:
+                return cast(flat[key])
+            except (TypeError, ValueError):
+                raise SettingsError(
+                    f"invalid value for [{key}]: [{flat[key]}]")
+        out = {"enabled": None, "budget_ms": None, "barrier": None}
+        if "search.precompile.enabled" in flat:
+            out["enabled"] = _parse_bool(
+                flat["search.precompile.enabled"],
+                "search.precompile.enabled")
+        if "search.precompile.barrier" in flat:
+            out["barrier"] = _parse_bool(
+                flat["search.precompile.barrier"],
+                "search.precompile.barrier")
+        out["budget_ms"] = _num("search.precompile.budget_ms", float)
+        return out
+
+    def apply_settings(self, flat: dict) -> None:
+        parsed = self.parse_settings(flat)
+        if parsed["budget_ms"] is not None:
+            self.budget_ms = parsed["budget_ms"]
+        if parsed["barrier"] is not None:
+            self.barrier = parsed["barrier"]
+        if parsed["enabled"] is not None:
+            self.set_enabled(parsed["enabled"])
+
+    # ------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {**self.stats_, "enabled": self.enabled,
+                    "barrier": self.barrier,
+                    "queued": len(self._queue),
+                    "budget_ms": self.budget_ms}
+
+
+# node-wide singletons, like REQUEST_CACHE / QUERY_CACHE
 WARMUP = WarmupRegistry()
+PRECOMPILE = Precompiler()

@@ -659,7 +659,11 @@ class ChurnLedger:
                        "recompile_segments": 0, "warm_hit_segments": 0,
                        "upload_bytes": 0, "live_mask_bytes": 0,
                        "memo_entries_dropped": 0,
-                       "memo_entries_keyed": 0}
+                       "memo_entries_keyed": 0,
+                       "memo_invalidations": 0,
+                       "memo_entries_kept": 0,
+                       "precompiled": 0,
+                       "recompile_on_serve": 0}
 
     # ------------------------------------------------------------- hot path
 
@@ -712,7 +716,9 @@ class ChurnLedger:
                 removed_seg_ids: Optional[List[str]] = None,
                 event_id: Optional[int] = None,
                 shard: Optional[str] = None,
-                warmup_registered: Optional[int] = None) -> dict:
+                warmup_registered: Optional[int] = None,
+                memo_invalidations: Optional[int] = None,
+                memo_entries_kept: Optional[int] = None) -> dict:
         """Close one refresh/merge event's attribution into a churn
         record. The verdict is per NEW segment: `recompile` when its
         shape bucket was unseen at upload time, `warmup_hit` when an
@@ -736,7 +742,14 @@ class ChurnLedger:
                         else ("recompile" if recompiles else "none")),
             "memo_entries_dropped": int(memo_entries_dropped),
             "memo_entries_keyed": int(memo_entries_keyed),
+            # entries actually evicted: with segment-keyed carry on this
+            # is the uid-touched subset; without it, the wholesale drop
+            "memo_invalidations": int(
+                memo_invalidations if memo_invalidations is not None
+                else memo_entries_dropped),
         }
+        if memo_entries_kept is not None:
+            rec["memo_entries_kept"] = int(memo_entries_kept)
         if removed_seg_ids:
             rec["removed_segments"] = list(removed_seg_ids)
         if event_id is not None:
@@ -758,7 +771,51 @@ class ChurnLedger:
             t["live_mask_bytes"] += scope.live_mask_bytes
             t["memo_entries_dropped"] += int(memo_entries_dropped)
             t["memo_entries_keyed"] += int(memo_entries_keyed)
+            t["memo_invalidations"] += rec["memo_invalidations"]
+            if memo_entries_kept is not None:
+                t["memo_entries_kept"] += int(memo_entries_kept)
         return rec
+
+    # ---------------------------------------------- verdict lifecycle
+    # (ISSUE 16): a `recompile` verdict is provisional — the shape was
+    # novel at upload, but WHO pays the compile is decided later. The
+    # off-path precompiler flips pending records to `precompiled`; the
+    # first serving-thread compile flips them to `recompile-on-serve`
+    # (the failure mode the acceptance criterion pins to zero).
+
+    def mark_precompiled(self, churn_ids, took_ms: float,
+                         by: str = "precompiler") -> int:
+        """Resolve pending `recompile` records for the given churn ids:
+        the precompiler absorbed their compiles off-path."""
+        if not self.enabled:
+            return 0
+        ids = set(churn_ids)
+        n = 0
+        with self._lock:
+            for rec in self._ring:
+                if rec.get("churn_id") in ids and \
+                        rec.get("verdict") == "recompile":
+                    rec["verdict"] = "precompiled"
+                    rec["precompile_ms"] = round(float(took_ms), 3)
+                    rec["precompiled_by"] = by
+                    n += 1
+            self.totals["precompiled"] += n
+        return n
+
+    def note_serve_compile(self) -> int:
+        """A serving thread just paid an XLA compile: every still-pending
+        `recompile` record escalates to `recompile-on-serve` — the write
+        path published a shape the precompiler did not cover in time."""
+        if not self.enabled:
+            return 0
+        n = 0
+        with self._lock:
+            for rec in self._ring:
+                if rec.get("verdict") == "recompile":
+                    rec["verdict"] = "recompile-on-serve"
+                    n += 1
+            self.totals["recompile_on_serve"] += n
+        return n
 
     # --------------------------------------------------------------- reading
 

@@ -1,0 +1,679 @@
+"""Ingest-concurrent serving (ISSUE 16): segment-keyed memo carry,
+off-path precompilation (async + barrier), bounded merge windows, and
+delta segment publish.
+
+The differential discipline throughout: every fix is OFF by default and
+must be BYTE-IDENTICAL to the legacy path when disabled — and when
+enabled, must return the same search results as the legacy path while
+doing strictly less work (fewer memo drops, fewer uploaded bytes, no
+serving-thread compiles)."""
+
+import json
+import os
+import sys
+import threading
+import uuid
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.mapper import MapperService
+from opensearch_tpu.index.shard import IndexShard
+from opensearch_tpu.ops import device_segment as devseg
+from opensearch_tpu.search.warmup import PRECOMPILE, Precompiler
+from opensearch_tpu.telemetry import TELEMETRY
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+MAPPING = {"properties": {"title": {"type": "text"},
+                          "body": {"type": "text"},
+                          "n": {"type": "integer"}}}
+
+
+def _shard(**kw):
+    return IndexShard(0, MapperService(MAPPING),
+                      index_name=f"ics_{uuid.uuid4().hex[:6]}", **kw)
+
+
+def _hits(executor, body):
+    """Comparable search surface: (id, score) pairs + total."""
+    res = executor.search(dict(body))
+    h = res["hits"]
+    return (h["total"]["value"],
+            [(x["_id"], round(x["_score"], 5) if x["_score"] else None)
+             for x in h["hits"]])
+
+
+QUERIES = [
+    {"query": {"match": {"title": "alpha"}}, "size": 10},
+    {"query": {"match": {"body": "gamma delta"}}, "size": 10},
+    {"query": {"bool": {"must": [{"match": {"title": "alpha"}}],
+                        "filter": [{"range": {"n": {"gte": 2}}}]}},
+     "size": 10},
+    {"query": {"match_all": {}}, "size": 5,
+     "aggs": {"mx": {"max": {"field": "n"}}}},
+]
+
+
+def _seed(shard, n=24, prefix="s"):
+    for i in range(n):
+        shard.index_doc(f"{prefix}{i}", {
+            "title": f"alpha seed {i}", "body": f"gamma delta {i}",
+            "n": i})
+    shard.refresh()
+
+
+# ---------------------------------------------------- memo carry (tentpole b)
+
+
+class TestMemoCarry:
+    def test_gate_off_by_default(self):
+        assert _shard().reader.memo_carry is False
+
+    def test_carry_results_identical_to_full_drop(self):
+        """The differential: same doc/query sequence with carry ON vs
+        OFF must return identical hits — a carried entry that should
+        have been evicted (stale idf baked into a tc bundle) would show
+        up as a score difference here."""
+        outs = []
+        for carry in (False, True):
+            shard = _shard()
+            shard.reader.memo_carry = carry
+            _seed(shard)
+            ex = shard.executor
+            base = [_hits(ex, q) for q in QUERIES]
+            # churn that TOUCHES the scored field: title's (dc, ttf)
+            # change, so carried tc entries would be stale
+            for i in range(8):
+                shard.index_doc(f"x{i}", {"title": f"alpha fresh {i}",
+                                          "body": f"other {i}",
+                                          "n": 100 + i})
+            shard.delete_doc("s3")
+            shard.refresh()
+            after = [_hits(ex, q) for q in QUERIES]
+            # pure-append churn on an untouched field next: the qenv
+            # bundle carry path (partial bundles) must also score right
+            for i in range(4):
+                shard.index_doc(f"y{i}", {"body": f"gamma echo {i}",
+                                          "n": 200 + i})
+            shard.refresh()
+            tail = [_hits(ex, q) for q in QUERIES]
+            outs.append((base, after, tail))
+        assert outs[0] == outs[1], \
+            "memo carry changed search results vs full drop"
+
+    def test_invalidations_bounded_by_touched_state(self):
+        """A publish that leaves a field's statistics untouched must
+        keep that field's interned entries: the churn record's
+        memo_invalidations is the honest eviction subset, not the
+        wholesale drop."""
+        ch = TELEMETRY.churn
+        ch.enabled = True
+        ch.reset()
+        try:
+            shard = _shard()
+            shard.reader.memo_carry = True
+            _seed(shard)
+            ex = shard.executor
+            for q in QUERIES:
+                ex.search(dict(q))
+            stats = shard.reader.stats()
+            memo_before = len(stats.memo)
+            assert memo_before > 0
+            # pure-append on `body` only: title/n stats untouched
+            for i in range(4):
+                shard.index_doc(f"b{i}", {"body": f"gamma zulu {i}"})
+            shard.refresh()
+            rec = ch.records(1)[0]
+            assert rec["memo_invalidations"] is not None
+            assert rec["memo_entries_kept"] is not None
+            assert rec["memo_invalidations"] + rec["memo_entries_kept"] \
+                == memo_before
+            # the pin: the untouched-field publish must keep MOST of the
+            # memo — and strictly more than it evicts (the wholesale
+            # drop this fix replaces kept exactly zero)
+            assert rec["memo_entries_kept"] > 0
+            assert rec["memo_invalidations"] < memo_before
+            # legacy comparison field still reports the wholesale count
+            assert rec["memo_entries_dropped"] == memo_before
+        finally:
+            ch.enabled = False
+            ch.reset()
+
+    def test_disabled_record_falls_back_to_wholesale(self):
+        """Carry OFF: memo_invalidations mirrors memo_entries_dropped
+        (the r01 semantics, byte-identical reporting)."""
+        ch = TELEMETRY.churn
+        ch.enabled = True
+        ch.reset()
+        try:
+            shard = _shard()
+            _seed(shard)
+            ex = shard.executor
+            ex.search(dict(QUERIES[0]))
+            shard.index_doc("z0", {"title": "alpha z"})
+            shard.refresh()
+            rec = ch.records(1)[0]
+            assert rec["memo_invalidations"] == \
+                rec["memo_entries_dropped"]
+            assert "memo_entries_kept" not in rec
+        finally:
+            ch.enabled = False
+            ch.reset()
+
+
+# ------------------------------------------------- precompiler (tentpole a)
+
+
+class TestPrecompiler:
+    def test_gate_off_by_default(self):
+        p = Precompiler()
+        assert p.enabled is False and p.barrier is False
+        assert p.gate() is None
+        # disabled request is a no-op: nothing queued, no thread
+        p.request(object(), "idx", ["sig"])
+        assert p.stats()["queued"] == 0 and p._thread is None
+
+    def test_async_request_flips_verdict_to_precompiled(self):
+        ch = TELEMETRY.churn
+        ch.enabled = True
+        ch.reset()
+        p = Precompiler()
+        p.enabled = True     # flag only: run_pending drains sans thread
+        try:
+            # the always-on seen-shape set survives across tests; a
+            # clean slate makes the first publish's shape NOVEL
+            ch._shapes_seen.clear()
+            shard = _shard()
+            _seed(shard)        # first publish: novel shape, registry
+            ex = shard.executor  # still empty → provisional recompile
+            rec = ch.records(1)[0]
+            assert rec["verdict"] == "recompile"
+            p.request(ex, shard.index_name,
+                      shard.reader.take_novel_shapes() or ["fp"],
+                      churn_id=rec["churn_id"])
+            assert p.run_pending() == 1
+            rec = [r for r in ch.records()
+                   if r["churn_id"] == rec["churn_id"]][0]
+            assert rec["verdict"] == "precompiled"
+            assert rec["precompiled_by"] == "precompiler"
+            assert rec["precompile_ms"] >= 0
+        finally:
+            p.enabled = False
+            ch.enabled = False
+            ch.reset()
+
+    def test_serve_compile_flips_pending_to_recompile_on_serve(self):
+        ch = TELEMETRY.churn
+        ch.enabled = True
+        ch.reset()
+        try:
+            from opensearch_tpu.search.executor import (_note_compile,
+                                                        offpath_compiles)
+            ch._shapes_seen.clear()
+            shard = _shard()
+            _seed(shard)
+            rec = ch.records(1)[0]
+            assert rec["verdict"] == "recompile"
+            # an OFF-PATH compile (the precompiler's replay) must NOT
+            # flip the pending verdict...
+            with offpath_compiles():
+                _note_compile(1.0)
+            assert ch.records(1)[0]["verdict"] == "recompile"
+            # ...but a serving-thread compile (the process-wide JIT
+            # cache may be warm in-suite, so drive the executor's
+            # compile hook directly) flips it to recompile-on-serve
+            _note_compile(1.0)
+            rec = ch.records(1)[0]
+            assert rec["verdict"] == "recompile-on-serve"
+            assert ch.snapshot()["totals"]["recompile_on_serve"] >= 1
+        finally:
+            ch.enabled = False
+            ch.reset()
+
+    def test_settings_parse_strict(self):
+        from opensearch_tpu.common.errors import SettingsError
+        p = Precompiler()
+        parsed = p.parse_settings({"search.precompile.enabled": "true",
+                                   "search.precompile.barrier": "true",
+                                   "search.precompile.budget_ms": "500"})
+        assert parsed == {"enabled": True, "barrier": True,
+                          "budget_ms": 500.0}
+        with pytest.raises(SettingsError):
+            p.parse_settings({"search.precompile.enabled": "sideways"})
+        with pytest.raises(SettingsError):
+            p.parse_settings({"search.precompile.budget_ms": "fast"})
+
+    def test_worker_thread_lifecycle(self):
+        p = Precompiler()
+        p.set_enabled(True)
+        try:
+            assert p._thread is not None and p._thread.is_alive()
+            assert p._thread.daemon
+        finally:
+            p.set_enabled(False)
+        assert p._thread is None
+        assert p.stats()["queued"] == 0
+
+
+# ------------------------------------------------ barrier mode (tentpole a)
+
+
+class TestBarrierPublish:
+    def test_staged_pair_invisible_until_commit(self):
+        shard = _shard()
+        _seed(shard, n=8)
+        reader = shard.reader
+        segs_before = list(reader.segments)
+        reader.begin_staged_publish()
+        try:
+            shard.index_doc("st0", {"title": "alpha staged"})
+            seg = shard.engine.refresh()
+            reader.add_segment(seg)
+            # serving view: unchanged; staged view: has the new segment
+            assert reader.segments == segs_before
+            assert reader.snapshot()[0] == segs_before
+            with reader.staged_visible():
+                st, segs, dev = reader.stats_snapshot()
+                assert len(segs) == len(segs_before) + 1
+                assert len(dev) == len(segs)
+                assert st.segments == segs
+        finally:
+            reader.commit_staged_publish()
+        assert len(reader.segments) == len(segs_before) + 1
+        stats, segs, dev = reader.stats_snapshot()
+        assert stats.segments == segs and len(segs) == len(dev)
+
+    def test_barrier_refresh_zero_serve_compiles(self):
+        """The committed acceptance, structurally: with barrier mode on,
+        churn verdicts land `precompiled` (by=barrier) and no serving
+        thread pays an XLA compile for a churn-published shape."""
+        ch = TELEMETRY.churn
+        ch.enabled = True
+        ch.reset()
+        PRECOMPILE.set_enabled(True)
+        PRECOMPILE.barrier = True
+        try:
+            shard = _shard()
+            _seed(shard)
+            ex = shard.executor
+            for q in QUERIES[:2]:
+                ex.search(dict(q))          # register + compile shapes
+            miss = TELEMETRY.metrics.counter("search.xla_cache_miss")
+            m0 = miss.value
+            for batch in range(3):
+                for i in range(4):
+                    shard.index_doc(f"bb{batch}_{i}",
+                                    {"title": f"alpha barrier {i}",
+                                     "n": i})
+                shard.refresh()
+                for q in QUERIES[:2]:
+                    ex.search(dict(q))
+            t = ch.snapshot()["totals"]
+            assert t["recompile_on_serve"] == 0
+            assert miss.value == m0, \
+                "a serving-thread compile slipped past the barrier"
+            by = [r.get("precompiled_by") for r in ch.records()
+                  if r["verdict"] == "precompiled"]
+            assert "barrier" in by
+        finally:
+            PRECOMPILE.set_enabled(False)
+            PRECOMPILE.barrier = False
+            ch.enabled = False
+            ch.reset()
+
+    def test_hammer_searches_never_see_torn_or_uncompiled_pairs(self):
+        """Concurrency hammer: open-loop searches while barrier-mode
+        refreshes publish. Zero errors, every response well-formed, and
+        zero serving-thread compiles after warmup."""
+        import openloop
+        PRECOMPILE.set_enabled(True)
+        PRECOMPILE.barrier = True
+        try:
+            shard = _shard()
+            _seed(shard, n=32)
+            ex = shard.executor
+            for q in QUERIES[:2]:
+                ex.search(dict(q))
+            miss = TELEMETRY.metrics.counter("search.xla_cache_miss")
+            m0 = miss.value
+            stop = threading.Event()
+            werr = []
+
+            def writer():
+                try:
+                    i = 0
+                    while not stop.is_set() and i < 96:
+                        shard.index_doc(
+                            f"h{i}", {"title": f"alpha hammer {i}",
+                                      "n": i})
+                        if (i + 1) % 8 == 0:
+                            shard.refresh()
+                            shard.maybe_merge()
+                        i += 1
+                except Exception as e:   # pragma: no cover - asserted
+                    werr.append(e)
+
+            th = threading.Thread(target=writer, daemon=True)
+            th.start()
+            try:
+                def serve(b):
+                    res = ex.search(dict(b))
+                    assert res["hits"]["total"]["value"] >= 0
+
+                res = openloop.run_open_loop(
+                    serve, [dict(QUERIES[0]) for _ in range(80)],
+                    clients=4, arrival_rate=400.0, seed=5)
+            finally:
+                stop.set()
+                th.join(timeout=30)
+            assert res["errors"] == 0
+            assert not werr, werr
+            assert miss.value == m0, \
+                "serving thread compiled under barrier-mode churn"
+            # the stage is released: a fresh publish still works
+            shard.index_doc("post", {"title": "alpha post"})
+            shard.refresh()
+            assert any(s.doc_ids and "post" in s.doc_ids
+                       for s in shard.reader.segments)
+        finally:
+            PRECOMPILE.set_enabled(False)
+            PRECOMPILE.barrier = False
+
+
+# --------------------------------------------- windowed merges (tentpole c)
+
+
+class TestWindowedMerge:
+    def test_gate_off_by_default(self):
+        assert _shard().engine.merge_windowed is False
+
+    def _fill(self, shard, batches=6, per=4):
+        for b in range(batches):
+            for i in range(per):
+                shard.index_doc(f"m{b}_{i}",
+                                {"title": f"alpha merge {b}",
+                                 "body": f"gamma {b} {i}", "n": b})
+            shard.refresh()
+
+    def test_converges_to_cap_and_results_match_legacy(self):
+        results = []
+        for windowed in (False, True):
+            shard = _shard()
+            shard.engine.merge_max_segments = 2
+            shard.engine.merge_windowed = windowed
+            shard.engine.merge_window_budget_ms = 0.0  # one pass/call
+            self._fill(shard)
+            while shard.maybe_merge() is not None:
+                pass
+            assert len(shard.engine.segments) <= 2
+            # pair merges visit segments in a different order than the
+            # legacy half-merge, so equal-score ties order (and the
+            # top-k cut among ties) differently — the contract is same
+            # doc set + same scores, not tie order: ask for every doc
+            # and compare sorted
+            qs = [dict(q, size=50) for q in QUERIES]
+            results.append([(tot, sorted(hits))
+                            for tot, hits in
+                            (_hits(shard.executor, q) for q in qs)])
+        assert results[0] == results[1], \
+            "windowed merge changed search results vs legacy merge"
+
+    def test_single_pass_per_budget_window(self):
+        shard = _shard()
+        shard.engine.merge_max_segments = 2
+        shard.engine.merge_windowed = True
+        shard.engine.merge_window_budget_ms = 0.0
+        self._fill(shard, batches=5)
+        n0 = len(shard.engine.segments)
+        assert n0 > 3
+        shard.engine.maybe_merge()
+        # budget 0 → exactly one pair merged: one fewer segment
+        assert len(shard.engine.segments) == n0 - 1
+
+    def test_deletes_during_offlock_rebuild_reapplied(self, monkeypatch):
+        """A delete landing while the pair rebuilds off-lock must be
+        re-applied to the merged segment — and a doc dead BEFORE the
+        rebuild whose live copy rides in the other victim (supersession)
+        must NOT be killed by the re-apply."""
+        from opensearch_tpu.index import engine as engine_mod
+        shard = _shard()
+        shard.engine.merge_max_segments = 1
+        shard.engine.merge_windowed = True
+        shard.engine.merge_window_budget_ms = 0.0
+        # seg A: sup (to be superseded) + racer (deleted mid-merge)
+        shard.index_doc("sup", {"title": "alpha v1", "n": 1})
+        shard.index_doc("racer", {"title": "alpha racer", "n": 2})
+        shard.refresh()
+        # seg B: the superseding live copy of sup
+        shard.index_doc("sup", {"title": "alpha v2", "n": 3})
+        shard.index_doc("keeper", {"title": "alpha keeper", "n": 4})
+        shard.refresh()
+        real_merge = engine_mod.merge_segments
+        fired = []
+
+        def racing_merge(mapper, victims, seg_id):
+            out = real_merge(mapper, victims, seg_id)
+            if not fired:
+                fired.append(True)
+                # the engine lock is NOT held here: a delete + refresh
+                # races the rebuild — refresh drains the buffered delete
+                # onto the victim's live mask while `out` already copied
+                # the doc (engine deletes only reach sealed segments at
+                # refresh, so THIS interleave is the re-apply's target)
+                shard.delete_doc("racer")
+                shard.refresh()
+            return out
+
+        monkeypatch.setattr(engine_mod, "merge_segments", racing_merge)
+        while shard.maybe_merge() is not None:
+            pass
+        assert fired, "merge never ran"
+        assert len(shard.engine.segments) == 1
+        merged = shard.engine.segments[0]
+        live = {merged.doc_ids[i] for i in range(merged.num_docs)
+                if merged.live[i]}
+        assert "racer" not in live, "mid-merge delete lost"
+        assert "sup" in live, "superseded doc's live copy was killed"
+        assert "keeper" in live
+        got = shard.get_doc("sup", realtime=False)
+        assert got is not None and got.source["title"] == "alpha v2"
+
+
+# ----------------------------------------------- delta publish (tentpole d)
+
+
+class TestDeltaPublish:
+    def test_gate_off_by_default(self):
+        assert devseg.DELTA_PUBLISH is False
+
+    def _segment(self):
+        shard = _shard()
+        for i in range(10):
+            shard.index_doc(f"d{i}", {"title": f"alpha delta {i}",
+                                      "body": f"gamma {i}", "n": i})
+        shard.delete_doc("d3")      # partial live mask
+        shard.refresh()
+        return shard.engine.segments[0]
+
+    @staticmethod
+    def _leaves(tree, path=()):
+        if isinstance(tree, dict):
+            out = {}
+            for k, v in tree.items():
+                out.update(TestDeltaPublish._leaves(v, path + (k,)))
+            return out
+        return {path: np.asarray(tree)}
+
+    def test_disabled_is_exactly_upload_segment(self):
+        seg = self._segment()
+        arrays, meta, xfer = devseg.publish_segment(seg)
+        ref, _ = devseg.upload_segment(seg)
+        assert xfer == devseg.tree_nbytes(ref)
+        a, b = self._leaves(arrays), self._leaves(ref)
+        assert a.keys() == b.keys()
+        for k in a:
+            assert np.array_equal(a[k], b[k]), k
+
+    def test_enabled_expands_to_identical_arrays(self, monkeypatch):
+        """The delta path's on-device expansion must reproduce the full
+        padded image bit-for-bit — same shapes, same fills, same data —
+        while shipping strictly fewer bytes."""
+        seg = self._segment()
+        ref, _ = devseg.upload_segment(seg)
+        monkeypatch.setattr(devseg, "DELTA_PUBLISH", True)
+        arrays, meta, xfer = devseg.publish_segment(seg)
+        a, b = self._leaves(arrays), self._leaves(ref)
+        assert a.keys() == b.keys()
+        for k in a:
+            assert a[k].shape == b[k].shape, k
+            assert a[k].dtype == b[k].dtype, k
+            assert np.array_equal(a[k], b[k]), \
+                f"delta publish corrupted {k}"
+        assert 0 < xfer < devseg.tree_nbytes(ref), \
+            "delta transfer must be smaller than the padded image"
+
+    def test_ledger_records_compact_bytes_exactly(self, monkeypatch):
+        """The churn ledger's upload accounting is byte-exact: the
+        recorded transfer equals publish_segment's compact total, not
+        the resident padded size."""
+        ch = TELEMETRY.churn
+        ch.enabled = True
+        ch.reset()
+        monkeypatch.setattr(devseg, "DELTA_PUBLISH", True)
+        try:
+            shard = _shard()
+            for i in range(10):
+                shard.index_doc(f"L{i}", {"title": f"alpha {i}", "n": i})
+            shard.refresh()
+            seg = shard.engine.segments[0]
+            # independent recomputation of the compact total (publish
+            # accounting is deterministic per segment); to_device=False
+            # deliberately bypasses the delta path, so republish for real
+            _, _, expected = devseg.publish_segment(seg)
+            _, _, padded = devseg.publish_segment(seg, to_device=False)
+            rec = ch.records(1)[0]
+            assert rec["upload_bytes"] == expected
+            assert expected < padded, \
+                "delta publish should undercut the padded image"
+            assert rec["upload_bytes"] < \
+                shard.reader.device_bytes, \
+                "compact transfer should undercut the resident image"
+        finally:
+            ch.enabled = False
+            ch.reset()
+
+    def test_unchanged_live_mask_ships_nothing_on_next_refresh(
+            self, monkeypatch):
+        ch = TELEMETRY.churn
+        ch.enabled = True
+        ch.reset()
+        monkeypatch.setattr(devseg, "DELTA_PUBLISH", True)
+        try:
+            shard = _shard()
+            _seed(shard, n=12)
+            # second refresh adds one segment; the FIRST segment's live
+            # mask is untouched → zero live-mask bytes for it
+            shard.index_doc("extra", {"title": "alpha extra"})
+            shard.refresh()
+            rec = ch.records(1)[0]
+            assert rec["live_mask_bytes"] == 0
+        finally:
+            ch.enabled = False
+            ch.reset()
+
+
+# ------------------------------------------------------------ REST surface
+
+
+class TestRestSurface:
+    @pytest.fixture()
+    def node(self):
+        from opensearch_tpu.node import Node
+        node = Node(settings={"telemetry.churn.enabled": True,
+                              "telemetry.ingest.enabled": True})
+        yield node
+        TELEMETRY.churn.enabled = False
+        TELEMETRY.churn.reset()
+        TELEMETRY.ingest.enabled = False
+        TELEMETRY.ingest.clear()
+
+    def _jb(self, r):
+        return r.body if isinstance(r.body, dict) else json.loads(r.body)
+
+    def test_precompile_endpoint_and_telemetry_readout(self, node):
+        r = node.handle("PUT", "/ri", body={
+            "mappings": {"properties": {"t": {"type": "text"}}}})
+        assert r.status == 200
+        for i in range(4):
+            node.handle("POST", f"/ri/_doc/p{i}", body={"t": f"word {i}"})
+        node.handle("POST", "/ri/_refresh")
+        node.handle("POST", "/ri/_search",
+                    body={"query": {"match": {"t": "word"}}})
+        r = node.handle("POST", "/ri/_warmup/_precompile")
+        assert r.status == 200
+        jb = self._jb(r)
+        assert jb["acknowledged"] is True
+        assert "warmed" in jb and "precompile" in jb
+        r = node.handle("GET", "/_telemetry/ingest")
+        jb = self._jb(r)
+        assert "precompile" in jb
+        assert jb["precompile"]["enabled"] is False
+        recs = jb["churn"]["records"]
+        assert recs, "churn records missing from the readout"
+        assert all("verdict" in x for x in recs)
+        t = jb["churn"]["totals"]
+        assert "precompiled" in t and "recompile_on_serve" in t
+        r = node.handle("POST", "/missing/_warmup/_precompile")
+        assert r.status == 404
+
+    def test_index_settings_wire_merge_and_carry_flags(self, node):
+        r = node.handle("PUT", "/cfg", body={
+            "settings": {"index": {"merge.windowed": True,
+                                   "merge.window_budget_ms": 7,
+                                   "search.memo_carry": True}},
+            "mappings": {"properties": {"t": {"type": "text"}}}})
+        assert r.status == 200
+        svc = node.indices.indices["cfg"]
+        assert svc.shards[0].engine.merge_windowed is True
+        assert svc.shards[0].engine.merge_window_budget_ms == 7.0
+        assert svc.shards[0].reader.memo_carry is True
+
+
+# ------------------------------------------------------- churn_report tool
+
+
+class TestChurnReportTool:
+    def test_renders_bench_artifact_and_flags_serve_compiles(
+            self, tmp_path, capsys):
+        import churn_report
+        rows = [{"churn_id": 1, "kind": "refresh", "docs": 32,
+                 "upload_bytes": 4096, "live_mask_bytes": 0,
+                 "memo_invalidations": 2, "memo_entries_kept": 9,
+                 "verdict": "precompiled", "precompile_ms": 12.5},
+                {"churn_id": 2, "kind": "merge", "docs": 64,
+                 "upload_bytes": 8192, "live_mask_bytes": 128,
+                 "memo_invalidations": 4, "memo_entries_kept": 7,
+                 "verdict": "recompile-on-serve"}]
+        p = tmp_path / "dump.json"
+        p.write_text(json.dumps(
+            {"churn": {"records": rows}, "other": 1}))
+        assert churn_report.main(["churn_report.py", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "upload_bytes" in out and "precompiled" in out
+        assert "memo_invalidations: 6" in out
+        assert "memo_entries_kept: 16" in out
+        assert "WARNING: 1 event(s)" in out
+        # bench JSONL shape: points embedding churn_records
+        p2 = tmp_path / "bench.jsonl"
+        p2.write_text("\n".join(
+            json.dumps({"mode": f"i{i}", "churn_records": [rows[0]]})
+            for i in range(2)))
+        assert churn_report.extract_records(
+            json.loads(p2.read_text().splitlines()[0]))
+        assert churn_report.main(["churn_report.py", str(p2)]) == 0
+        # no records → exit 2
+        p3 = tmp_path / "empty.json"
+        p3.write_text("{}")
+        assert churn_report.main(["churn_report.py", str(p3)]) == 2

@@ -326,6 +326,17 @@ def compare_interference(old: Dict[str, dict], new: Dict[str, dict],
         o99, n99 = float(o["p99_ms"]), float(n["p99_ms"])
         row["old_p99_ms"] = o99
         row["new_p99_ms"] = n99
+        # equal OFFERED rate is the join key, but the rounds only truly
+        # compare at equal ACHIEVED pressure — annotate the ratio of
+        # achieved docs/s so an "improvement" bought by a slower ingest
+        # client is visible in the row (and in any failure message)
+        od_, nd_ = o.get("ingest_dps"), n.get("ingest_dps")
+        pressure = ""
+        if isinstance(od_, (int, float)) and \
+                isinstance(nd_, (int, float)) and od_ > 0:
+            row["achieved_ratio"] = round(nd_ / od_, 3)
+            pressure = (f"; achieved ingest {od_:g} -> {nd_:g} docs/s "
+                        f"(x{row['achieved_ratio']:g})")
         if o99 > 0:
             d99 = 100.0 * (n99 - o99) / o99
             row["p99_delta_pct"] = round(d99, 1)
@@ -334,7 +345,8 @@ def compare_interference(old: Dict[str, dict], new: Dict[str, dict],
                 failures.append(
                     f"{key}: search p99 under ingest {o99}ms -> "
                     f"{n99}ms (+{d99:.1f}% > "
-                    f"{INTERFERENCE_P99_PCT:g}% at equal ingest rate)")
+                    f"{INTERFERENCE_P99_PCT:g}% at equal ingest rate"
+                    f"{pressure})")
         od = o.get("ingest_dps")
         nd = n.get("ingest_dps")
         if isinstance(od, (int, float)) and isinstance(nd, (int, float)) \

@@ -86,6 +86,23 @@ GATED_SUBSYSTEMS = (
      ("gate",)),
     ("opensearch_tpu/common/admission.py", "DeadlineShedder",
      "shape_enabled", ("shape_gate",)),
+    # ISSUE 16 ingest-concurrent serving: every fix is OFF by default —
+    # the default node keeps the r01 write path exactly. Precompiler:
+    # None-returning gate; memo carry / windowed merge: plain False
+    # flags branched at their single call site (stats rebuild / merge
+    # dispatch); delta publish: faults-style module flag branched in
+    # publish_segment.
+    ("opensearch_tpu/search/warmup.py", "Precompiler", "enabled",
+     ("gate",)),
+    # barrier mode is a SECOND gate on the precompiler (shape_enabled
+    # idiom): stage-and-replay-before-publish only runs when both flags
+    # are on — the default publish stays the direct atomic swap
+    ("opensearch_tpu/search/warmup.py", "Precompiler", "barrier", ()),
+    ("opensearch_tpu/search/executor.py", "ShardReader", "memo_carry",
+     ()),
+    ("opensearch_tpu/index/engine.py", "InternalEngine",
+     "merge_windowed", ()),
+    ("opensearch_tpu/ops/device_segment.py", None, "DELTA_PUBLISH", ()),
 )
 
 # no-op constants a disabled gate may return
