@@ -119,6 +119,17 @@ if "--waves" in sys.argv:
 # output line as `overlap_ab`.
 AB_OVERLAP = "--ab-overlap" in sys.argv
 
+# --ab-page: interleaved legacy vs single-round-trip result page A/B
+# (search.result_page.enabled, ISSUE 17) on the request shape the page
+# exists for — sorted + docvalue_fields, the general serving path.
+# Alternating arms on the same session/executor cancel box drift; the
+# arms land in BENCH_AB_PAGE_LEGACY.json / BENCH_AB_PAGE.json and
+# tools/bench_compare.py gates the page arm: warm p50 must not regress
+# vs legacy AND (with --telemetry) the ledger must show EXACTLY one
+# device round trip per wave — "one device_get served the response" is
+# measured, not assumed. The gate is restored to OFF afterwards.
+AB_PAGE = "--ab-page" in sys.argv
+
 # --clients N / --arrival-rate R: open-loop concurrent-clients mode
 # (ROADMAP item 2's acceptance harness, tools/openloop.py): N worker
 # threads drive the controller concurrently on a seeded Poisson arrival
@@ -978,6 +989,106 @@ def _ab_overlap(executor, bodies, reps: int):
     with contextlib.redirect_stdout(buf):
         rec["bench_compare_exit"] = bench_compare.main(
             ["bench_compare.py", f1, fn])
+    rec["bench_compare_tail"] = buf.getvalue().strip().splitlines()[-1]
+    return rec
+
+
+def _ab_page(executor, reps: int):
+    """Interleaved legacy vs result-page A/B (same session, same
+    executor, alternating runs) on sorted + docvalue_fields bodies —
+    the shape whose legacy tail pays a collect, a sort-key re-key and a
+    per-hit docvalue round trip, and whose page arm reads the whole
+    response from ONE device_get per wave. Returns the `page_ab`
+    record; the two arms land in BENCH_AB_PAGE_LEGACY.json /
+    BENCH_AB_PAGE.json and tools/bench_compare.py's page gate runs
+    in-process (stdout captured — the one-JSON-line contract holds).
+    With --telemetry each arm also runs one ledger'd pass: the page arm
+    ASSERTS round_trips_per_wave == 1 and that the bytes moved on the
+    `result_page` channel — the single-trip claim is measured here, not
+    just gated downstream."""
+    import contextlib
+    import io
+
+    import opensearch_tpu.search.executor as executor_mod
+    from opensearch_tpu.telemetry import TELEMETRY
+    from opensearch_tpu.utils.demo import query_terms
+
+    n_bodies = int(os.environ.get("BENCH_PAGE_QUERIES", "64"))
+    qs = query_terms(n_bodies, VOCAB, seed=13, terms_per_query=2)
+    bodies = [{"query": {"match": {"body": q}}, "size": TOP_K,
+               "sort": [{"views": "asc"}],
+               "docvalue_fields": ["views"]} for q in qs]
+
+    def _pass():
+        for b in bodies:
+            executor.search(dict(b))
+
+    prev_gate = executor_mod.RESULT_PAGE
+    legacy_ms, page_ms = [], []
+    arm_stats = {}
+    try:
+        for on in (False, True):      # compile both arms' executables
+            executor_mod.RESULT_PAGE = on
+            _pass()
+        for _ in range(reps):
+            executor_mod.RESULT_PAGE = False
+            t0 = time.perf_counter()
+            _pass()
+            legacy_ms.append((time.perf_counter() - t0) * 1000)
+            executor_mod.RESULT_PAGE = True
+            t0 = time.perf_counter()
+            _pass()
+            page_ms.append((time.perf_counter() - t0) * 1000)
+        if TELEMETRY_ON:
+            # one ledger'd pass per arm AFTER timing (the ledger was
+            # enabled for the main window; reset isolates each arm)
+            for label, on in (("legacy", False), ("page", True)):
+                executor_mod.RESULT_PAGE = on
+                TELEMETRY.ledger.reset()
+                _pass()
+                snap = TELEMETRY.ledger.snapshot()
+                waves = max(snap["waves"], 1)
+                arm_stats[label] = {
+                    "round_trips_per_wave": round(
+                        snap["device_get"]["calls"] / waves, 2),
+                    "d2h_bytes_per_wave": round(
+                        snap["bytes_total"]["d2h"] / waves, 1),
+                    "d2h_channels": sorted(snap["channels"]["d2h"]),
+                }
+            page = arm_stats["page"]
+            assert page["round_trips_per_wave"] == 1.0, \
+                f"page arm read {page['round_trips_per_wave']} round " \
+                f"trips per wave (the result-page contract is 1)"
+            assert "result_page" in page["d2h_channels"], \
+                "page arm moved no bytes on the result_page channel"
+    finally:
+        executor_mod.RESULT_PAGE = prev_gate
+    rec = {"bodies": n_bodies,
+           "legacy_warm_p50_ms": round(sorted(legacy_ms)[reps // 2], 2),
+           "page_warm_p50_ms": round(sorted(page_ms)[reps // 2], 2)}
+    rec["speedup"] = round(rec["legacy_warm_p50_ms"]
+                           / max(rec["page_warm_p50_ms"], 1e-9), 3)
+    if arm_stats:
+        rec["arms"] = arm_stats
+    # bench_compare gates: page arm vs legacy arm under the SAME config
+    # key — generic warm-p50 plus the page round-trip/bytes-ratio gate
+    here = os.path.dirname(os.path.abspath(__file__))
+    f_legacy = os.path.join(here, "BENCH_AB_PAGE_LEGACY.json")
+    f_page = os.path.join(here, "BENCH_AB_PAGE.json")
+    for path, label, on in ((f_legacy, "legacy", False),
+                            (f_page, "page", True)):
+        arm_rec = {"mode": "bm25_ab_page",
+                   "warm_p50_ms": rec[f"{label}_warm_p50_ms"],
+                   "bodies": n_bodies, "result_page": on}
+        arm_rec.update(arm_stats.get(label, {}))
+        with open(path, "w") as f:
+            f.write(json.dumps(arm_rec) + "\n")
+    sys.path.insert(0, os.path.join(here, "tools"))
+    import bench_compare
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rec["bench_compare_exit"] = bench_compare.main(
+            ["bench_compare.py", f_legacy, f_page])
     rec["bench_compare_tail"] = buf.getvalue().strip().splitlines()[-1]
     return rec
 
@@ -2580,6 +2691,8 @@ def main():
         out.update(ledger_stats)
     if AB_OVERLAP:
         out["overlap_ab"] = _ab_overlap(executor, bodies, n_runs)
+    if AB_PAGE:
+        out["page_ab"] = _ab_page(executor, n_runs)
     _t = _telemetry_summary()
     if _t is not None:
         out["telemetry"] = _t
@@ -2606,9 +2719,9 @@ def _run_extra_configs():
     probe when this process already fell back to CPU."""
     if os.environ.get("BENCH_SKIP_EXTRA") == "1" \
             or os.environ.get("BENCH_MODE") or FAULTS_ON or AB_OVERLAP \
-            or CLIENTS_ARG or INGEST_RATE_ARG is not None:
-        # --faults / --ab-overlap / --clients / --ingest-rate are
-        # single-config runs: no children
+            or AB_PAGE or CLIENTS_ARG or INGEST_RATE_ARG is not None:
+        # --faults / --ab-overlap / --ab-page / --clients /
+        # --ingest-rate are single-config runs: no children
         return
     import subprocess
 

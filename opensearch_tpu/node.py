@@ -105,6 +105,19 @@ class Node:
             from opensearch_tpu.ops import device_segment as _devseg
             _devseg.DELTA_PUBLISH = _pb(raw_delta,
                                         "indices.publish.delta")
+        # single-round-trip result page (search/executor.py, ISSUE 17):
+        # module-level gate, the whole result-assembly tail (cross-
+        # segment merge, sort-key extraction, fused docvalue gather)
+        # runs on device and one `device_get` lands the wave. A static
+        # node setting — flipping it mid-flight would split the ledger's
+        # round-trip accounting across two regimes.
+        raw_page = self.settings.get("search.result_page.enabled")
+        if raw_page is not None:
+            from opensearch_tpu.common.settings import \
+                _parse_bool as _pb
+            from opensearch_tpu.search import executor as _executor_mod
+            _executor_mod.RESULT_PAGE = _pb(raw_page,
+                                            "search.result_page.enabled")
         self.gateway = None
         if data_path is not None:
             from opensearch_tpu.gateway import Gateway

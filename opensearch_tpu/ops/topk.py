@@ -6,6 +6,74 @@ dense masked key vector — the selection itself lives in the executor's jitted
 program (search/executor.py _runner) so it fuses with plan evaluation.
 Lucene's tie-break contract (score desc, then doc id asc) is finished on the
 host over the over-fetched candidate set.
+
+Value-keyed merges (the SPMD collective merge in parallel/distributed.py and
+the single-round-trip result page in search/executor.py) share the helpers
+below: a cross-segment-comparable f32 merge key decoded from the column's
+rank -> value table, plus the host-side admission predicates that keep the
+f32 key selection exactly equal to the host path's f64 selection.
 """
 
+import numpy as np
+
 NEG_INF = float("-inf")
+
+# Missing-field sentinel for VALUE-keyed merges: below every admissible
+# value key (f32_sortable admits |v| < 1e29 only, so -|v| > -1e29) but
+# above the NEG_INF ineligibility mask — a doc missing the sort field
+# stays a candidate that sorts last, matching _compare_candidates'
+# missing-last semantics, while masked/padding lanes stay unselectable.
+MISSING_VALUE_KEY = -1e30
+
+
+def f32_sortable(col) -> bool:
+    """Merge keys sort by decoded f32 values: admit a column only when
+    every unique value is EXACTLY f32-representable (selection then
+    matches the host path's exact f64 keys) and within the sentinel
+    range. Memoized on the immutable column. Epoch-millis dates usually
+    fail (f32 spacing ~131 s at 2e12) and take the host path."""
+    cached = getattr(col, "_f32_sortable", None)
+    if cached is None:
+        u = col.unique
+        cached = bool(
+            len(u) == 0
+            or (np.all(np.abs(u) < 1e29)
+                and np.array_equal(u.astype(np.float32).astype(np.float64),
+                                   u)))
+        col._f32_sortable = cached
+    return cached
+
+
+def single_valued(col) -> bool:
+    """True when no doc in the column carries more than one value — the
+    admission predicate for the result page's fused docvalue gather: a
+    single min_rank per winning ordinal then reproduces the full
+    docvalue_fields output for the doc (multi-valued docs need the
+    variable-length value list and keep the host scan). Memoized on the
+    immutable column."""
+    cached = getattr(col, "_single_valued", None)
+    if cached is None:
+        cached = bool(np.unique(col.doc_ids).size == col.doc_ids.size)
+        col._single_valued = cached
+    return cached
+
+
+def value_merge_key(col, order: str, d_pad: int):
+    """Dense [d_pad] f32 cross-segment merge key for a numeric-field
+    sort, built inside a jitted program from the DEVICE column dict
+    (ops/device_segment.py layout). The key is the doc's decoded f32
+    VALUE — comparable across segments, unlike the host path's
+    segment-local ranks — negated for asc so `lax.top_k` always selects
+    descending-key; a missing field takes MISSING_VALUE_KEY (sorts last
+    but stays eligible). `col` None (segment has no column for the
+    field) keys every doc as missing."""
+    import jax.numpy as jnp
+    if col is None:
+        return jnp.full(d_pad, jnp.float32(MISSING_VALUE_KEY))
+    u = col["unique_f32"]
+    hi = u.shape[0] - 1
+    if order == "asc":
+        keys = -u[jnp.clip(col["min_rank"], 0, hi)]
+    else:
+        keys = u[jnp.clip(col["max_rank"], 0, hi)]
+    return jnp.where(col["exists"], keys, jnp.float32(MISSING_VALUE_KEY))

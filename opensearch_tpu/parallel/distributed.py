@@ -87,7 +87,7 @@ def _device_put_sharded_tree(tree, mesh: Mesh, axis: str,
     put = [jax.device_put(np.asarray(l), sharding) for l in leaves]
     return jax.tree_util.tree_unflatten(treedef, put)
 
-from opensearch_tpu.ops.topk import NEG_INF
+from opensearch_tpu.ops.topk import NEG_INF, value_merge_key
 from opensearch_tpu.search.compile import Plan
 from opensearch_tpu.search.plan_eval import _eval_plan
 from opensearch_tpu.search.aggs.engine import eval_aggs
@@ -333,27 +333,12 @@ class DistributedSearcher:
                 # (search/spmd.py:_spmd_sort_spec) admits only columns
                 # whose values are EXACTLY f32-representable and within
                 # ±1e29, so selection matches the host path's exact-key
-                # selection; asc keys negate, a missing field sorts last
-                # (sentinel below the admitted value range but above the
-                # NEG_INF ineligibility mask), and the host re-keys the
-                # k winners with exact f64 values for the final order
+                # selection; the host re-keys the k winners with exact
+                # f64 values for the final order. The key builder is
+                # shared with the result-page merge (ops/topk.py)
                 field, order = sort_spec
-                col = seg["numeric"].get(field)
-                if col is None:
-                    # mapper declares the field but no doc in any row has
-                    # it: every doc sorts as missing
-                    keys = jnp.full(d_pad, jnp.float32(-1e30))
-                else:
-                    u = col["unique_f32"]
-                    hi = u.shape[0] - 1
-                    if order == "asc":
-                        val = u[jnp.clip(col["min_rank"], 0, hi)]
-                        keys = -val
-                    else:
-                        val = u[jnp.clip(col["max_rank"], 0, hi)]
-                        keys = val
-                    keys = jnp.where(col["exists"], keys,
-                                     jnp.float32(-1e30))
+                keys = value_merge_key(seg["numeric"].get(field), order,
+                                       d_pad)
             masked = jnp.where(eligible, keys, NEG_INF)
             top_keys, top_idx = jax.lax.top_k(masked, k_eff)
             top_scores = scores[top_idx]

@@ -973,8 +973,9 @@ def _build_hit(ex, c, body, score, query_node, sort_specs,
             seg, c.ord, query_node, mapper, ex.reader.stats(),
             score if score is not None else c.score)
     if body.get("docvalue_fields"):
-        fields = fetch_phase.docvalue_fields(seg, c.ord,
-                                             body["docvalue_fields"], mapper)
+        fields = fetch_phase.docvalue_fields(
+            seg, c.ord, body["docvalue_fields"], mapper,
+            prefetched=getattr(c, "dv_page", None))
         if fields:
             hit["fields"] = fields
     if body.get("script_fields"):

@@ -47,7 +47,8 @@ from opensearch_tpu.ops.bm25 import (
 from opensearch_tpu.ops import device_segment as _devseg
 from opensearch_tpu.ops.device_segment import (
     DeviceSegmentMeta, refresh_live, tree_nbytes, upload_segment)
-from opensearch_tpu.ops.topk import NEG_INF
+from opensearch_tpu.ops.topk import (NEG_INF, f32_sortable, single_valued,
+                                     value_merge_key)
 from opensearch_tpu.search import dsl
 from opensearch_tpu.search.compile import (Compiler, Plan, ShardStats,
                                            _PartialBundle, carry_memo,
@@ -62,6 +63,17 @@ from opensearch_tpu.telemetry.ledger import LedgerScope
 # sort key for eligible docs that lack the sort field: far below any real
 # rank key, far above NEG_INF (which marks ineligible docs) → fetched last
 MISSING_KEY = np.float32(-1e30)
+
+# Single-round-trip result pages (ISSUE 17): cross-segment top-k merge,
+# on-device sort-key extraction and the fused docvalue gather assemble a
+# wave's whole response body from ONE device_get instead of the legacy
+# multi-channel host merge + per-leaf column reads. OFF by default
+# (faults-style module flag, registered in tools/lint/gate_lint.py);
+# wired from the static node setting `search.result_page.enabled`
+# (node.py) — flipping it mid-flight would split the ledger's
+# round-trip accounting across two regimes. With the flag False the
+# general path keeps the legacy collect byte-for-byte.
+RESULT_PAGE = False
 
 # transfer ledger + device-memory accounting (telemetry/ledger.py):
 # module-level handles — the guards on the query path are one attribute
@@ -944,6 +956,20 @@ def _ledger_unbatched_collect(scope, fetched, ms: float) -> None:
         ms, nbytes=sort_b + score_b + id_b + tot_b + agg_b, scope=scope)
 
 
+def _ledger_page_collect(scope, page_np, agg_fetched, ms: float) -> None:
+    """One result-page collect (RESULT_PAGE on): the packed int32 page
+    plus the per-segment agg buffers, fetched together in EXACTLY one
+    round trip — the whole wave lands in the `result_page` channel,
+    byte-exact against the transferred total (the conservation
+    invariant holds because the channel bytes ARE the fetched nbytes)."""
+    nb = int(np.asarray(page_np).nbytes)
+    nb += sum(int(np.asarray(v).nbytes)
+              for v in jax.tree_util.tree_leaves(agg_fetched))
+    wave = _LEDGER.new_wave()
+    _LEDGER.record("result_page", "d2h", nb, wave=wave, scope=scope)
+    _LEDGER.note_device_get(ms, nbytes=nb, scope=scope)
+
+
 def _ledger_packed_rows(scope, pending, fetched, actual_bytes: int,
                         ms: float, round_trips: int) -> None:
     """One msearch-envelope wave: [B, 2k+1+W] packed rows per program —
@@ -1815,9 +1841,158 @@ def _build_sort_key(arrays, primary_sort) -> jnp.ndarray:
     return jnp.full(d_pad, MISSING_KEY, jnp.float32)
 
 
+# ------------------------------------------------------ result page (ISSUE 17)
+#
+# The single-round-trip result page: a SECOND jitted program per wave
+# that (a) re-keys every segment's per-segment winners with cross-
+# segment-comparable decoded values and lax.top_k's them into ONE
+# global candidate page, (b) gathers the winners' sort-key ranks inside
+# the same program (the host's exact-value re-scan disappears — decode
+# is an O(1) unique[rank] lookup per winner), and (c) gathers each
+# fused docvalue field's rank + exists lane for the winners, so the
+# fetch phase's per-hit column reads disappear too. Everything lands in
+# one packed int32 buffer (f32 lanes bitcast, the pack_leaves idiom)
+# fetched together with the agg partials in ONE device_get.
+
+def _page_sort_mode(body: dict, sort_specs, mapper):
+    """Static page admission: ("score",) / ("field", name, order) when
+    the request's result assembly can ride the on-device merge, None for
+    the legacy host merge. Collapse/rescore post-process the candidate
+    POOL and need the full per-segment over-fetch (the page's global cut
+    would under-fill them — same reason search/spmd.py excludes them);
+    multi-key and keyword sorts keep the host path (ordinal ranks are
+    not comparable across segments)."""
+    if body.get("collapse") or body.get("rescore"):
+        return None
+    if len(sort_specs) != 1:
+        return None
+    field, order = sort_specs[0]
+    if field == "_score":
+        return ("score",)
+    ft = mapper.get_field(field)
+    if ft is None or not (ft.is_numeric or ft.is_date or ft.is_bool):
+        return None
+    return ("field", field, order)
+
+
+def _page_dv_fields(body: dict, mapper) -> tuple:
+    """The docvalue_fields specs a result page can fuse: numeric-typed
+    fields (decode is rank -> host unique[], exact f64 — dates included,
+    unlike the f32-compared SORT key). Keyword fields keep the host
+    dictionary scan; per-SEGMENT multi-valued columns fall back in
+    _page_segment_admit."""
+    out = []
+    for spec in body.get("docvalue_fields") or []:
+        field = spec["field"] if isinstance(spec, dict) else spec
+        ft = mapper.get_field(field)
+        if ft is not None and (ft.is_numeric or ft.is_date or ft.is_bool) \
+                and field not in out:
+            out.append(field)
+    return tuple(out)
+
+
+def _page_segment_admit(seg, arrays, meta, mode, dv_fields):
+    """Per-segment page admission + the device/host column refs one
+    segment contributes. None disqualifies the whole request (a sort
+    column whose values are not exactly f32-representable — selection
+    on device would diverge from the host's exact keys). Per dv field:
+    `col` (device gather + host unique[] decode), `absent` (no column —
+    decode to no-values), or `host` (multi-valued: the fetch phase's
+    host scan, with its own per-leaf round-trip accounting)."""
+    out = {"d_pad": meta.d_pad, "sort_col": None, "sort_host": None,
+           "dv_state": {}}
+    if mode[0] == "field":
+        field = mode[1]
+        host = seg.numeric_dv.get(field)
+        if host is not None and not f32_sortable(host):
+            return None
+        out["sort_col"] = arrays["numeric"].get(field)
+        out["sort_host"] = host
+    for f in dv_fields:
+        host = seg.numeric_dv.get(f)
+        dev = arrays["numeric"].get(f)
+        if host is None and f not in seg.ordinal_dv:
+            out["dv_state"][f] = ("absent", None, None)
+        elif host is not None and dev is not None and single_valued(host):
+            out["dv_state"][f] = ("col", dev, host)
+        else:
+            out["dv_state"][f] = ("host", None, None)
+    return out
+
+
+def _page_merger(sig, mode, k_page: int, stride: int, seg_statics,
+                 dv_fields):
+    """The cached jitted page-merge program (one executable per layout
+    signature, the same _JIT_CACHE + compile-event discipline as
+    _runner). Takes every segment's (keys, scores, idx, total) plus the
+    device column refs and returns ONE packed int32 page."""
+    fn = _JIT_CACHE.get(sig)
+    if fn is not None:
+        return fn
+    field_mode = mode[0] == "field"
+    order = mode[2] if field_mode else None
+
+    def run(rows):
+        keys, scores, gids = [], [], []
+        sranks, sexists = [], []
+        dv_lanes = {f: ([], []) for f in dv_fields}
+        for pos, ((k_i, d_pad, _has_sort, dv_states), row) in enumerate(
+                zip(seg_statics, rows)):
+            ti = row["idx"]
+            valid = row["keys"] != NEG_INF
+            if field_mode:
+                # re-key this segment's winners with decoded VALUES:
+                # per-segment selection by rank is order-correct inside
+                # the segment, but ranks are not comparable across
+                # segments — the value key is (ops/topk.py)
+                col = row.get("sort_col")
+                vkey = value_merge_key(col, order, d_pad)
+                keys.append(jnp.where(valid, vkey[ti], NEG_INF))
+                if col is None:
+                    sranks.append(jnp.zeros(ti.shape[0], jnp.int32))
+                    sexists.append(jnp.zeros(ti.shape[0], jnp.int32))
+                else:
+                    ra = col["min_rank"] if order == "asc" \
+                        else col["max_rank"]
+                    sranks.append(ra[ti])
+                    sexists.append(col["exists"][ti].astype(jnp.int32))
+            else:
+                keys.append(row["keys"])
+            scores.append(row["scores"])
+            gids.append(jnp.int32(pos * stride) + ti)
+            for f, state in zip(dv_fields, dv_states):
+                r_l, e_l = dv_lanes[f]
+                if state == "col":
+                    col = row["dv"][f]
+                    r_l.append(col["min_rank"][ti])
+                    e_l.append(col["exists"][ti].astype(jnp.int32))
+                else:
+                    r_l.append(jnp.zeros(ti.shape[0], jnp.int32))
+                    e_l.append(jnp.zeros(ti.shape[0], jnp.int32))
+        mk, mi = jax.lax.top_k(jnp.concatenate(keys), k_page)
+        parts = [jax.lax.bitcast_convert_type(mk, jnp.int32),
+                 jax.lax.bitcast_convert_type(
+                     jnp.concatenate(scores)[mi], jnp.int32),
+                 jnp.concatenate(gids)[mi]]
+        if field_mode:
+            parts.append(jnp.concatenate(sranks)[mi])
+            parts.append(jnp.concatenate(sexists)[mi])
+        for f in dv_fields:
+            r_l, e_l = dv_lanes[f]
+            parts.append(jnp.concatenate(r_l)[mi])
+            parts.append(jnp.concatenate(e_l)[mi])
+        parts.append(jnp.stack([row["total"] for row in rows])
+                     .astype(jnp.int32).reshape(-1))
+        return jnp.concatenate(parts)
+
+    fn = jax.jit(run)
+    _JIT_CACHE[sig] = fn  # shared-state-ok: benign double-jit race; dict slot write is GIL-atomic
+    return _timed_first_call(fn)
+
+
 class _Candidate:
     __slots__ = ("score", "seg_i", "ord", "sort_values", "shard_i",
-                 "collapse_value")
+                 "collapse_value", "dv_page")
 
     def __init__(self, score, seg_i, ord_, sort_values, shard_i=0):
         self.score = score
@@ -1825,6 +2000,10 @@ class _Candidate:
         self.ord = ord_
         self.sort_values = sort_values  # list parallel to sort specs; None = missing
         self.shard_i = shard_i          # coordinator-side shard index
+        # result-page prefetch (ISSUE 17): {field: [raw values]} decoded
+        # from the fused docvalue lanes; None = no page rode this
+        # candidate (fetch falls back to the per-leaf host scan)
+        self.dv_page = None
 
 
 def _compare_candidates(specs):
@@ -2007,6 +2186,17 @@ class SearchExecutor:
                             if n.type not in PIPELINE_TYPES]
         k_fetch = min(k + 128, 1 << 16)  # over-fetch for ties & cross-seg merge
 
+        # single-round-trip result page (RESULT_PAGE, ISSUE 17): static
+        # admission here, per-segment admission in the dispatch loop;
+        # page_rows collapses to None the moment any segment (or later
+        # the gid-packing range) disqualifies — the legacy host merge is
+        # always the fallback and stays byte-identical when gated off
+        page_mode = _page_sort_mode(body, sort_specs, self.reader.mapper) \
+            if RESULT_PAGE else None
+        page_dv = _page_dv_fields(body, self.reader.mapper) \
+            if page_mode is not None else ()
+        page_rows = [] if page_mode is not None else None
+
         # phase 1: dispatch every segment's program without forcing — jax
         # dispatch is async, so device work overlaps; phase 2 collects ALL
         # results in ONE device_get (one transfer round trip total — on a
@@ -2053,6 +2243,13 @@ class SearchExecutor:
                               meta.seg_id, posting, dense, "dense")
             q_posting += posting
             q_dense += dense
+            if page_rows is not None:
+                prow = _page_segment_admit(seg, arrays, meta, page_mode,
+                                           page_dv)
+                if prow is None:
+                    page_rows = None
+                else:
+                    page_rows.append(prow)
             sort_key = _build_sort_key(arrays, primary)
             fn = _runner(plan.sig(), plan, meta,
                          min(k_fetch, pad_bucket(max(seg.num_docs, 1))),
@@ -2095,9 +2292,21 @@ class SearchExecutor:
                 # for the controller's per-shape note at request end
                 ins.add_scan(q_posting, q_dense)
 
+        page_args = None
+        if page_rows is not None and launched:
+            page_args = self._page_build(launched, page_rows, page_mode,
+                                         page_dv, k_fetch, body)
+
         def _collect():
             if faults.ENABLED:
                 faults.fire("fetch.gather")
+            if page_args is not None:
+                # dispatch the page merger, then fetch the packed page
+                # TOGETHER with the agg partials: one device_get, one
+                # round trip for the wave's entire result assembly
+                fn, rows_arg, _lay = page_args
+                return jax.device_get(
+                    (fn(rows_arg), [o[3][4] for o in launched]))
             return jax.device_get([out for _, _, _, out in launched])
 
         t0c = time.monotonic() if scope is not None else 0.0
@@ -2114,8 +2323,12 @@ class SearchExecutor:
                 fetched = retry.call_with_retry(_collect,
                                                 label="fetch.gather")
         if scope is not None:
-            _ledger_unbatched_collect(scope, fetched,
-                                      (time.monotonic() - t0c) * 1000)
+            if page_args is not None:
+                _ledger_page_collect(scope, fetched[0], fetched[1],
+                                     (time.monotonic() - t0c) * 1000)
+            else:
+                _ledger_unbatched_collect(scope, fetched,
+                                          (time.monotonic() - t0c) * 1000)
             if rec:
                 xla_compiles = _THREAD_COMPILES.count
                 trace.set_attribute("plan_compile_ns", plan_compile_ns)
@@ -2128,12 +2341,24 @@ class SearchExecutor:
                     trace.set_attribute("xla_compiles", xla_compiles)
                     trace.set_attribute("compile_ms",
                                         round(_THREAD_COMPILES.ms, 3))
-            if ledger_scope is not None and ledger_scope is not scope:
+
+        def _absorb():
+            # absorb runs LAST: the legacy path's re-key round trip
+            # (below) must reach the caller's request scope too
+            if scope is not None and ledger_scope is not None \
+                    and ledger_scope is not scope:
                 ledger_scope.absorb(scope)
+
+        if page_args is not None:
+            out = self._decode_page(fetched, page_args, launched,
+                                    agg_nodes)
+            _absorb()
+            return out
 
         candidates: List[_Candidate] = []
         per_segment_decoded = []
         total = 0
+        t0r = time.monotonic() if scope is not None else 0.0
         for (seg_i, seg, agg_plans, _), outs in zip(launched, fetched):
             top_keys, top_scores, top_idx, seg_total, agg_outs = outs
             if agg_nodes:
@@ -2147,7 +2372,134 @@ class SearchExecutor:
                     for f, o in sort_specs]
                 candidates.append(_Candidate(float(score), seg_i, int(ord_),
                                              sort_values))
+        if scope is not None and primary is not None and candidates:
+            # round-trip attribution fix (ISSUE 17 satellite 1): the
+            # exact-value re-key above reads the sort column once per
+            # winner — served by the host mirror here (zero wire bytes,
+            # so byte conservation against the measured device_get
+            # holds) but a full gather round trip on a remote device.
+            # The result page (RESULT_PAGE) extracts these keys inside
+            # the merge program and never pays it.
+            _LEDGER.note_round_trip("sort_keys",
+                                    (time.monotonic() - t0r) * 1000,
+                                    scope=scope)
+        _absorb()
+        return candidates, per_segment_decoded, total
 
+    def _page_build(self, launched, page_rows, page_mode, page_dv,
+                    k_fetch: int, body: dict):
+        """Assemble the page merger's (jitted fn, device args, layout)
+        for one wave, or None when the gid packing cannot cover the
+        launched segments in int32 (the legacy collect takes over)."""
+        stride = max(r["d_pad"] for r in page_rows)
+        if len(launched) * stride >= (1 << 31):
+            return None
+        seg_statics, rows_arg = [], []
+        lanes = 0
+        for (seg_i, seg, agg_plans, out), prow in zip(launched, page_rows):
+            top_keys, top_scores, top_idx = out[0], out[1], out[2]
+            k_i = int(top_keys.shape[0])
+            lanes += k_i
+            dv_states = tuple(prow["dv_state"][f][0] for f in page_dv)
+            seg_statics.append((k_i, prow["d_pad"],
+                                prow["sort_col"] is not None, dv_states))
+            arg = {"keys": top_keys, "scores": top_scores, "idx": top_idx,
+                   "total": out[3]}
+            if prow["sort_col"] is not None:
+                arg["sort_col"] = prow["sort_col"]
+            dv_cols = {f: prow["dv_state"][f][1] for f in page_dv
+                       if prow["dv_state"][f][0] == "col"}
+            if dv_cols:
+                arg["dv"] = dv_cols
+            rows_arg.append(arg)
+        k_page = min(k_fetch, lanes)
+        mode_sig = page_mode if page_mode[0] == "score" \
+            else (page_mode[0], page_mode[1], page_mode[2])
+        sig = ("page", mode_sig, k_page, stride, tuple(seg_statics),
+               page_dv)
+        fn = _page_merger(sig, page_mode, k_page, stride,
+                          tuple(seg_statics), page_dv)
+        # page-shaped executables enter the warmup registry: a node
+        # restart (search/warmup.py warm_all) or a publish-triggered
+        # precompile replay (Precompiler) re-runs the body and — with
+        # the node's RESULT_PAGE gate on — reproduces exactly this
+        # merger executable off the serving path
+        from opensearch_tpu.search.warmup import WARMUP
+        WARMUP.record(self.reader.index_name, body, 1, sig)
+        lay = {"mode": page_mode, "k_page": k_page, "stride": stride,
+               "dv_fields": page_dv, "rows_meta": page_rows}
+        return fn, rows_arg, lay
+
+    def _decode_page(self, fetched, page_args, launched, agg_nodes):
+        """Host decode of one packed result page: candidates with exact
+        sort values (rank -> host unique[], f64 — no f32 precision ever
+        reaches a response) and the fused docvalue prefetch attached per
+        candidate, plus per-segment totals and decoded agg partials."""
+        packed, agg_fetched = fetched
+        _fn, _rows, lay = page_args
+        # already host-resident: the one device_get in _collect() moved
+        # (and _ledger_page_collect accounted) every byte of the page
+        buf = np.asarray(packed)  # sync-ok: result_page
+        k_page, stride = lay["k_page"], lay["stride"]
+        off = 0
+
+        def take(n):
+            nonlocal off
+            part = buf[off:off + n]
+            off += n
+            return part
+
+        mk = take(k_page).view(np.float32)
+        msc = take(k_page).view(np.float32)
+        mg = take(k_page)
+        field_mode = lay["mode"][0] == "field"
+        srank = sexists = None
+        if field_mode:
+            field, order = lay["mode"][1], lay["mode"][2]
+            srank, sexists = take(k_page), take(k_page)
+        dv_cols = [(f, take(k_page), take(k_page))
+                   for f in lay["dv_fields"]]
+        totals = take(len(launched))
+        total = int(totals.sum())
+        per_segment_decoded = []
+        if agg_nodes:
+            for (_seg_i, _seg, agg_plans, _), agg_outs in zip(
+                    launched, agg_fetched):
+                per_segment_decoded.append(
+                    decode_outputs(agg_plans, agg_outs))
+        candidates: List[_Candidate] = []
+        for j in range(k_page):
+            if mk[j] == NEG_INF:
+                continue  # ineligible / padding
+            pos, ord_ = divmod(int(mg[j]), stride)
+            seg_i, seg = launched[pos][0], launched[pos][1]
+            score = float(msc[j])
+            if field_mode:
+                if sexists[j]:
+                    # exact f64 decode (host unique[]): the f32 merge key
+                    # selected, the host table answers — same contract as
+                    # _sort_value's vals.min()/max()
+                    host = lay["rows_meta"][pos]["sort_host"]
+                    v = float(host.unique[int(srank[j])])
+                    sv = [int(v) if v.is_integer() else v]
+                else:
+                    sv = [None]
+            else:
+                sv = [score]
+            cand = _Candidate(score, seg_i, ord_, sv)
+            if dv_cols:
+                prow = lay["rows_meta"][pos]
+                dvm = {}
+                for f, ranks, exists in dv_cols:
+                    state, _dev, host = prow["dv_state"][f]
+                    if state == "host":
+                        continue  # fetch-phase host scan (own accounting)
+                    if state == "col" and exists[j]:
+                        dvm[f] = [float(host.unique[int(ranks[j])])]
+                    else:
+                        dvm[f] = []
+                cand.dv_page = dvm
+            candidates.append(cand)
         return candidates, per_segment_decoded, total
 
     def execute_hybrid_query_phase(self, body: dict, k: int,

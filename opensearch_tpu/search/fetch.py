@@ -299,30 +299,62 @@ def _term_freq(seg: Segment, field: str, term: str, ord_: int) -> float:
 
 # ----------------------------------------------------------- field retrieval
 
+def _format_numeric_dv(vals, ft) -> list:
+    """Response formatting for numeric docvalues — shared by the host
+    column scan below and the result page's fused gather (the prefetched
+    branch), so the two paths can never drift on types."""
+    if ft is not None and ft.is_date:
+        from opensearch_tpu.index.mapper import format_date_millis
+        return [format_date_millis(int(v)) for v in vals]
+    if ft is not None and (ft.is_numeric and ft.type in
+                           ("integer", "long", "short", "byte")):
+        return [int(v) for v in vals]
+    return [float(v) for v in vals]
+
+
 def docvalue_fields(seg: Segment, ord_: int, specs: List[Any],
-                    mapper) -> dict:
+                    mapper, prefetched: Optional[dict] = None) -> dict:
+    """`prefetched`: the result page's fused docvalue gather for this hit
+    ({field: [raw values]}, empty list = field missing on the doc) —
+    those fields skip the per-leaf column scan below; fields the page
+    could not fuse (multi-valued, keyword) fall through to it."""
+    import time
     out = {}
+    ledger = TELEMETRY.ledger
+    scope = ledger.current()
+    accounting = ledger.enabled or scope is not None
     for spec in specs or []:
         field = spec["field"] if isinstance(spec, dict) else spec
+        if prefetched is not None and field in prefetched:
+            vals = prefetched[field]
+            if vals:
+                out[field] = _format_numeric_dv(vals, mapper.get_field(field))
+            continue
+        t0 = time.monotonic() if accounting else 0.0
         col = seg.numeric_dv.get(field)
         if col is not None:
             mask = col.doc_ids == ord_
             vals = col.values[mask]
-            ft = mapper.get_field(field)
+            if accounting:
+                # per-leaf round-trip attribution (ISSUE 17 satellite 1):
+                # this host-mirror scan stands in for a device column
+                # fetch — one round trip per leaf on a remote device,
+                # zero wire bytes here (byte conservation stays exact)
+                ledger.note_round_trip(
+                    "docvalues", (time.monotonic() - t0) * 1000,
+                    scope=scope)
             if len(vals):
-                if ft is not None and ft.is_date:
-                    from opensearch_tpu.index.mapper import format_date_millis
-                    out[field] = [format_date_millis(int(v)) for v in vals]
-                elif ft is not None and (ft.is_numeric and ft.type in
-                                         ("integer", "long", "short", "byte")):
-                    out[field] = [int(v) for v in vals]
-                else:
-                    out[field] = [float(v) for v in vals]
+                out[field] = _format_numeric_dv(vals,
+                                                mapper.get_field(field))
             continue
         ocol = seg.ordinal_dv.get(field)
         if ocol is not None:
             mask = ocol.doc_ids == ord_
             ords = ocol.ords[mask]
+            if accounting:
+                ledger.note_round_trip(
+                    "docvalues", (time.monotonic() - t0) * 1000,
+                    scope=scope)
             if len(ords):
                 out[field] = [ocol.dictionary[o] for o in ords]
     return out

@@ -88,21 +88,14 @@ def spmd_rows(executors: List) -> List[Tuple[int, int]]:
 
 
 def _f32_sortable(col) -> bool:
-    """The SPMD merge keys sort by decoded f32 values: admit a column
-    only when every unique value is EXACTLY f32-representable (selection
-    then matches the host path's exact keys) and within the sentinel
-    range. Memoized on the immutable column. Epoch-millis dates usually
-    fail (f32 spacing ~131 s at 2e12) and take the host path."""
-    cached = getattr(col, "_f32_sortable", None)
-    if cached is None:
-        u = col.unique
-        cached = bool(
-            len(u) == 0
-            or (np.all(np.abs(u) < 1e29)
-                and np.array_equal(u.astype(np.float32).astype(np.float64),
-                                   u)))
-        col._f32_sortable = cached
-    return cached
+    """Admission predicate for value-keyed device merges — shared with
+    the result-page cross-segment merge (ops/topk.py f32_sortable): the
+    merge keys sort by decoded f32 values, so a column is admitted only
+    when every unique value is EXACTLY f32-representable and within the
+    sentinel range. Epoch-millis dates usually fail (f32 spacing ~131 s
+    at 2e12) and take the host path."""
+    from opensearch_tpu.ops.topk import f32_sortable
+    return f32_sortable(col)
 
 
 def _spmd_sort_spec(executors: List, sort_specs):

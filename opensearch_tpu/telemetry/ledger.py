@@ -8,8 +8,10 @@ live tail. This module is that accounting contract:
 
 - `TransferLedger` attributes every host↔device transfer on the query
   path to a named channel (`topk_ids`, `scores`, `sort_keys`,
-  `docvalues`, `agg_buffers`, `upload.literals`, `upload.corpus`,
-  `upload.agg_constants`, `padding`, ...) with direction, bytes (from
+  `docvalues`, `agg_buffers`, `result_page` — the single-round-trip
+  fused page when `search.result_page.enabled` is on —
+  `upload.literals`, `upload.corpus`, `upload.agg_constants`,
+  `padding`, ...) with direction, bytes (from
   array `nbytes` / shape·dtype — never an extra device sync), wave id
   and round-trip participation. Aggregates serve
   `GET /_telemetry/transfers` and the `telemetry` section of
@@ -455,6 +457,28 @@ class TransferLedger:
         self.wave_ms.observe(ms)
         if nbytes:
             self.wave_bytes.observe(float(nbytes))
+
+    def note_round_trip(self, channel: str, ms: float = 0.0,
+                        scope: Optional[LedgerScope] = None,
+                        wave: Optional[int] = None) -> None:
+        """One device round trip that moved no accountable wire bytes on
+        THIS backend: the host-mirror stand-in for a device-resident
+        column read (the legacy sort-key re-key, fetch.py's per-leaf
+        docvalue scans). Records a zero-byte channel entry — byte
+        conservation against measured `device_get` nbytes stays exact —
+        while `round_trips` and `device_get.calls` count the
+        synchronization a tunneled device would pay, which is the wall
+        the result page removes (ISSUE 17 satellite 1)."""
+        self.record(channel, D2H, 0, round_trips=1, wave=wave,
+                    scope=scope)
+        if scope is not None:
+            scope.device_get_ms += ms
+            scope.round_trips += 1
+        if not self.enabled:
+            return
+        with self._lock:
+            self._device_get_calls += 1
+            self._device_get_ms += ms
 
     def note_wave_inflight(self, delta: int) -> None:
         """In-flight wave gauge: +1 at dispatch, -1 when the wave's

@@ -456,3 +456,65 @@ def test_insights_cli_end_to_end(tmp_path):
                    [_insights_rec({"match:aa": 30.0})])
     assert bench_compare.main(["bench_compare.py", old_p, old_p]) == 0
     assert bench_compare.main(["bench_compare.py", old_p, bad_p]) == 1
+
+
+# ----------------------------------------------- result page (ISSUE 17)
+
+PAGE_LEGACY = {"bm25_ab_page": {
+    "mode": "bm25_ab_page", "warm_p50_ms": 120.0, "bodies": 64,
+    "result_page": False, "round_trips_per_wave": 7.0,
+    "d2h_bytes_per_wave": 9000.0}}
+PAGE_NEW = {"bm25_ab_page": {
+    "mode": "bm25_ab_page", "warm_p50_ms": 60.0, "bodies": 64,
+    "result_page": True, "round_trips_per_wave": 1.0,
+    "d2h_bytes_per_wave": 8600.0}}
+
+
+def test_page_single_trip_ok_with_bytes_ratio():
+    rows, failures = bench_compare.compare_page(
+        PAGE_LEGACY, PAGE_NEW, 10.0)
+    assert not failures
+    assert rows[0]["status"] == "ok"
+    assert rows[0]["bytes_ratio"] == round(8600.0 / 9000.0, 3)
+
+
+def test_page_multi_trip_fails():
+    bad = {"bm25_ab_page": dict(PAGE_NEW["bm25_ab_page"],
+                                round_trips_per_wave=3.0)}
+    rows, failures = bench_compare.compare_page(PAGE_LEGACY, bad, 10.0)
+    assert failures and "round trips" in failures[0]
+    assert rows[0]["status"] == "PAGE-MULTI-TRIP"
+
+
+def test_page_legacy_arm_never_gated_on_trips():
+    # the legacy arm reads many trips per wave BY DESIGN — only an arm
+    # claiming result_page is held to the single-trip contract
+    rows, failures = bench_compare.compare_page(
+        PAGE_NEW, PAGE_LEGACY, 10.0)
+    assert not failures
+
+
+def test_page_without_ledger_reports_not_fails():
+    arm = {"bm25_ab_page": {"mode": "bm25_ab_page", "warm_p50_ms": 60.0,
+                            "result_page": True}}
+    rows, failures = bench_compare.compare_page(PAGE_LEGACY, arm, 10.0)
+    assert not failures and rows[0]["status"] == "no-ledger"
+
+
+def test_page_warm_p50_rides_generic_gate():
+    # the page arm must not regress warm p50 vs the legacy arm — that
+    # side of the A/B is the ordinary warm gate, not compare_page
+    slow = {"bm25_ab_page": dict(PAGE_NEW["bm25_ab_page"],
+                                 warm_p50_ms=300.0)}
+    rows, failures = bench_compare.compare(PAGE_LEGACY, slow, 10.0)
+    assert failures
+
+
+def test_page_cli_end_to_end(tmp_path):
+    old_p = _write(tmp_path / "p_old.json", list(PAGE_LEGACY.values()))
+    new_p = _write(tmp_path / "p_new.json", list(PAGE_NEW.values()))
+    bad = [dict(v, round_trips_per_wave=4.0)
+           for v in PAGE_NEW.values()]
+    bad_p = _write(tmp_path / "p_bad.json", bad)
+    assert bench_compare.main(["bench_compare.py", old_p, new_p]) == 0
+    assert bench_compare.main(["bench_compare.py", old_p, bad_p]) == 1

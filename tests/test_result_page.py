@@ -1,0 +1,353 @@
+"""Single-round-trip result pages (ISSUE 17): page/legacy parity and
+round-trip accounting.
+
+The contract under test has two halves. Correctness: with
+`search.result_page.enabled` the on-device cross-segment merge +
+sort-key extraction + fused docvalue gather must return responses
+byte-identical (minus wall-clock `took`) to the legacy host merge —
+across batch sizes, wave counts, virtual-chip counts, hybrid clauses,
+aggs-only requests, faulted segments and a concurrent publish.
+Accounting: the gate ON must land a sorted+docvalue wave in EXACTLY one
+`device_get` round trip (the `result_page` channel), where the legacy
+path pays the collect + the sort-key re-key + one round trip per
+docvalue leaf; and the gate OFF must leave the legacy multi-channel
+layout byte-identical (the pristine-path assert)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.common import faults
+from opensearch_tpu.search import executor as executor_mod
+from opensearch_tpu.search.executor import SearchExecutor, ShardReader
+from opensearch_tpu.telemetry import TELEMETRY
+from opensearch_tpu.utils.demo import build_shards, query_terms
+
+N_DOCS = 400
+VOCAB = 300
+
+
+@pytest.fixture(autouse=True)
+def _gate_off_and_clean():
+    assert executor_mod.RESULT_PAGE is False
+    TELEMETRY.ledger.enabled = False
+    TELEMETRY.ledger.reset()
+    faults.clear()
+    yield
+    executor_mod.RESULT_PAGE = False
+    TELEMETRY.ledger.enabled = False
+    TELEMETRY.ledger.reset()
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def ex():
+    mapper, segments = build_shards(N_DOCS, n_shards=1, vocab_size=VOCAB,
+                                    avg_len=30, seed=42)
+    return SearchExecutor(ShardReader(mapper, segments))
+
+
+@pytest.fixture(scope="module")
+def multi_seg_ex():
+    """Several segments of different sizes — the cross-segment merge's
+    actual job (one segment degenerates to a device re-sort)."""
+    mapper, segments = build_shards(N_DOCS, n_shards=4, vocab_size=VOCAB,
+                                    avg_len=30, seed=11)
+    reader = ShardReader(mapper, segments[:1])
+    for seg in segments[1:]:
+        reader.add_segment(seg)
+    return SearchExecutor(reader)
+
+
+def _strip_took(obj):
+    if isinstance(obj, dict):
+        return {k: _strip_took(v) for k, v in obj.items() if k != "took"}
+    if isinstance(obj, list):
+        return [_strip_took(v) for v in obj]
+    return obj
+
+
+def _canon(res) -> str:
+    return json.dumps(_strip_took(res), sort_keys=True, default=str)
+
+
+def _ab(run):
+    """Run `run()` with the gate off then on; return both canonical
+    responses."""
+    executor_mod.RESULT_PAGE = False
+    legacy = _canon(run())
+    executor_mod.RESULT_PAGE = True
+    page = _canon(run())
+    executor_mod.RESULT_PAGE = False
+    return legacy, page
+
+
+SORT_DV_BODY = {"size": 5, "sort": [{"views": "asc"}],
+                "docvalue_fields": ["views", "ts"]}
+
+
+def _bodies(n, seed=7, extra=None):
+    out = []
+    for q in query_terms(n, VOCAB, seed=seed, terms_per_query=2):
+        b = {"query": {"match": {"body": q}}, **SORT_DV_BODY}
+        if extra:
+            b.update(extra)
+        out.append(b)
+    return out
+
+
+# ------------------------------------------------------------------ parity
+
+class TestParity:
+    @pytest.mark.parametrize("b,w", [(1, 1), (32, 2)])
+    def test_msearch_grid(self, ex, b, w):
+        bodies = _bodies(min(b, 16)) * (b // min(b, 16))
+        legacy, page = _ab(lambda: ex.multi_search(
+            [dict(x) for x in bodies], _bypass_request_cache=True,
+            waves=w))
+        assert legacy == page
+
+    @pytest.mark.slow
+    def test_msearch_b1024_w4(self, ex):
+        bodies = _bodies(8) * 128
+        legacy, page = _ab(lambda: ex.multi_search(
+            [dict(x) for x in bodies], _bypass_request_cache=True,
+            waves=4))
+        assert legacy == page
+
+    @pytest.mark.parametrize("sort", [
+        [{"views": "asc"}], [{"views": "desc"}],
+        [{"ts": "asc"}],                    # dates: sort not f32-exact
+        [{"views": {"order": "desc"}}],
+        ["_score"],
+        [{"absent_field": "asc"}],          # missing everywhere
+    ])
+    def test_sort_variants(self, multi_seg_ex, sort):
+        body = {"query": {"match": {"body": "w00010 w00023"}},
+                "size": 10, "sort": sort,
+                "docvalue_fields": ["views"]}
+        legacy, page = _ab(lambda: multi_seg_ex.search(dict(body)))
+        assert legacy == page
+
+    def test_keyword_docvalue_falls_back(self, multi_seg_ex):
+        """`tag` is keyword-typed: the page cannot fuse it — the fetch
+        phase's host dictionary scan must still render it identically."""
+        body = {"query": {"match": {"body": "w00010"}}, "size": 8,
+                "sort": [{"views": "desc"}],
+                "docvalue_fields": ["views", "tag"]}
+        legacy, page = _ab(lambda: multi_seg_ex.search(dict(body)))
+        assert legacy == page
+
+    def test_search_after_pages_identically(self, multi_seg_ex):
+        def run():
+            first = multi_seg_ex.search(
+                {"query": {"match": {"body": "w00010 w00023"}},
+                 "size": 3, "sort": [{"views": "asc"}, {"_id": "asc"}]})
+            body = {"query": {"match": {"body": "w00010 w00023"}},
+                    "size": 3, "sort": [{"views": "asc"}, {"_id": "asc"}],
+                    "search_after": first["hits"]["hits"][-1]["sort"]}
+            return [first, multi_seg_ex.search(body)]
+        legacy, page = _ab(run)
+        assert legacy == page
+
+    def test_hybrid_parity(self, multi_seg_ex):
+        body = {"query": {"hybrid": {"queries": [
+                    {"match": {"body": "w00010"}},
+                    {"match": {"body": "w00023"}}]}},
+                "size": 5}
+        legacy, page = _ab(lambda: multi_seg_ex.search(dict(body)))
+        assert legacy == page
+
+    def test_aggs_only_k0(self, ex):
+        body = {"query": {"match": {"body": "w00010"}}, "size": 0,
+                "aggs": {"mx": {"max": {"field": "views"}},
+                         "tags": {"terms": {"field": "tag"}}}}
+        legacy, page = _ab(lambda: ex.search(dict(body)))
+        assert legacy == page
+
+    def test_aggs_ride_the_page(self, multi_seg_ex):
+        """Aggs + sorted hits together: the agg partials are fetched in
+        the SAME device_get as the packed page."""
+        body = {"query": {"match": {"body": "w00010 w00023"}}, "size": 5,
+                "sort": [{"views": "asc"}],
+                "docvalue_fields": ["views"],
+                "aggs": {"mx": {"max": {"field": "views"}}}}
+        legacy, page = _ab(lambda: multi_seg_ex.search(dict(body)))
+        assert legacy == page
+
+    def test_faulted_segment_transient_retry(self, multi_seg_ex):
+        """A transient collect fault retries the whole page fetch — the
+        response must come out identical to the legacy arm under the
+        same injection schedule."""
+        body = {"query": {"match": {"body": "w00010 w00023"}}, "size": 5,
+                "sort": [{"views": "asc"}], "docvalue_fields": ["views"]}
+
+        def run():
+            faults.clear()
+            faults.install({"site": "fetch.gather", "kind": "transient",
+                            "max_fires": 1})
+            try:
+                return multi_seg_ex.search(dict(body))
+            finally:
+                faults.clear()
+        legacy, page = _ab(run)
+        assert legacy == page
+
+    def test_publish_race_parity(self):
+        """Memo-carry publish race (ISSUE 16's scenario): index + refresh
+        between searches with carry ON — the page path anchors on the
+        same (stats, segments, device) snapshot as the legacy path, so
+        results across the publish must match arm-for-arm."""
+        import uuid
+
+        from opensearch_tpu.index.mapper import MapperService
+        from opensearch_tpu.index.shard import IndexShard
+        mapping = {"properties": {"body": {"type": "text"},
+                                  "n": {"type": "integer"}}}
+        queries = [{"query": {"match": {"body": "gamma"}}, "size": 5,
+                    "sort": [{"n": "asc"}], "docvalue_fields": ["n"]}]
+        name = f"rp_{uuid.uuid4().hex[:6]}"
+
+        def run():
+            shard = IndexShard(0, MapperService(mapping),
+                               index_name=name)
+            shard.reader.memo_carry = True
+            for i in range(16):
+                shard.index_doc(f"s{i}", {"body": f"gamma delta {i}",
+                                          "n": i})
+            shard.refresh()
+            out = [shard.executor.search(dict(q)) for q in queries]
+            for i in range(8):
+                shard.index_doc(f"x{i}", {"body": f"gamma fresh {i}",
+                                          "n": 100 + i})
+            shard.delete_doc("s3")
+            shard.refresh()
+            out += [shard.executor.search(dict(q)) for q in queries]
+            return out
+        legacy, page = _ab(run)
+        assert legacy == page
+
+
+# ------------------------------------------------------ virtual chips (D>1)
+
+class TestMultiDevice:
+    @pytest.mark.parametrize("d", [1, 2, 4])
+    def test_spmd_route_parity(self, eight_devices, d):
+        """D shards through the controller's SPMD route: the D>1 merge
+        rides the existing collective (the shared value-key builder in
+        ops/topk.py) — the page gate must not change a byte either way."""
+        from opensearch_tpu.search.controller import execute_search
+        mapper, segments = build_shards(
+            N_DOCS, n_shards=d, vocab_size=VOCAB, avg_len=30, seed=11)
+        executors = [SearchExecutor(ShardReader(mapper, [seg]))
+                     for seg in segments]
+        body = {"query": {"match": {"body": "w00010 w00023"}}, "size": 8,
+                "sort": [{"views": "asc"}], "docvalue_fields": ["views"]}
+        legacy, page = _ab(lambda: execute_search(
+            executors, dict(body)))
+        assert legacy == page
+
+
+# ------------------------------------------------------------- accounting
+
+class TestAccounting:
+    def test_page_is_one_round_trip(self, ex):
+        """Gate ON: a sorted+docvalue_fields query = exactly ONE
+        device_get round trip, all of it in the `result_page` channel."""
+        body = dict(_bodies(1)[0])
+        executor_mod.RESULT_PAGE = True
+        ex.search(dict(body))        # warm the executables off-ledger
+        TELEMETRY.ledger.enabled = True
+        TELEMETRY.ledger.reset()
+        ex.search(dict(body))
+        snap = TELEMETRY.ledger.snapshot()
+        TELEMETRY.ledger.enabled = False
+        d2h = snap["channels"]["d2h"]
+        assert snap["device_get"]["calls"] == 1
+        assert d2h["result_page"]["round_trips"] == 1
+        assert d2h["result_page"]["bytes"] > 0
+        for legacy_chan in ("topk_ids", "scores", "sort_keys",
+                            "docvalues", "totals"):
+            assert legacy_chan not in d2h
+
+    def test_legacy_pays_three_plus_round_trips(self, ex):
+        """Gate OFF on the same body: the collect + the sort-key re-key
+        + one round trip per docvalue leaf — >= 3 (satellite 1's
+        attribution fix makes the fetch leaves visible)."""
+        body = dict(_bodies(1)[0])
+        ex.search(dict(body))
+        TELEMETRY.ledger.enabled = True
+        TELEMETRY.ledger.reset()
+        ex.search(dict(body))
+        snap = TELEMETRY.ledger.snapshot()
+        TELEMETRY.ledger.enabled = False
+        d2h = snap["channels"]["d2h"]
+        assert snap["device_get"]["calls"] >= 3
+        assert "sort_keys" in d2h
+        assert d2h["docvalues"]["round_trips"] >= 1
+        assert "result_page" not in d2h
+
+    def test_docvalue_leaf_round_trips_counted(self, ex):
+        """Satellite 1 in isolation: a score-sorted query with
+        docvalue_fields must charge one `docvalues` round trip per hit
+        leaf — zero bytes (host mirror), so byte conservation against
+        the measured device_get stays exact."""
+        body = {"query": {"match": {"body": "w00010"}}, "size": 3,
+                "docvalue_fields": ["views"]}
+        ex.search(dict(body), _direct=True)
+        TELEMETRY.ledger.enabled = True
+        TELEMETRY.ledger.reset()
+        res = ex.search(dict(body), _direct=True)
+        snap = TELEMETRY.ledger.snapshot()
+        TELEMETRY.ledger.enabled = False
+        n_hits = len(res["hits"]["hits"])
+        assert n_hits > 0
+        d2h = snap["channels"]["d2h"]
+        assert d2h["docvalues"]["round_trips"] >= n_hits
+        assert d2h["docvalues"]["bytes"] == 0
+
+    def test_page_scope_round_trips(self, ex):
+        """The per-request scope agrees with the node-wide count: one
+        round trip for the whole request when the page rides."""
+        from opensearch_tpu.telemetry.ledger import LedgerScope
+        body = dict(_bodies(1)[0])
+        executor_mod.RESULT_PAGE = True
+        ex.search(dict(body))
+        scope = LedgerScope()
+        TELEMETRY.ledger.enabled = True
+        try:
+            ex.execute_query_phase(dict(body), k=10, ledger_scope=scope)
+        finally:
+            TELEMETRY.ledger.enabled = False
+        assert scope.round_trips == 1
+        assert sum(1 for c, _, b, _, _ in scope.entries
+                   if c == "result_page" and b > 0) == 1
+
+
+# ------------------------------------------------------------ pristine path
+
+class TestPristine:
+    def test_gate_off_by_default(self):
+        assert executor_mod.RESULT_PAGE is False
+
+    def test_gate_off_channel_layout_unchanged(self, ex):
+        """The legacy multi-channel layout with the gate off: the same
+        channel names, entry-for-entry byte-identical across two runs —
+        nothing the page code added may leak into the pristine path."""
+        body = dict(_bodies(1)[0])
+        ex.search(dict(body))
+        snaps = []
+        for _ in range(2):
+            TELEMETRY.ledger.enabled = True
+            TELEMETRY.ledger.reset()
+            ex.search(dict(body))
+            snap = TELEMETRY.ledger.snapshot()
+            TELEMETRY.ledger.enabled = False
+            snaps.append({k: {"bytes": v["bytes"],
+                              "round_trips": v["round_trips"]}
+                          for k, v in snap["channels"]["d2h"].items()})
+        assert snaps[0] == snaps[1]
+        assert "result_page" not in snaps[0]
+        for chan in ("topk_ids", "scores", "sort_keys", "totals"):
+            assert chan in snaps[0]

@@ -429,6 +429,62 @@ def compare_scaling(old: Dict[str, dict], new: Dict[str, dict],
     return rows, failures
 
 
+def _page_records(recs: Dict[str, dict]) -> Dict[str, dict]:
+    """The result-page A/B shape: arm records from bench.py --ab-page
+    carrying the `result_page` arm marker (BENCH_AB_PAGE*.json)."""
+    return {k: r for k, r in recs.items() if "result_page" in r}
+
+
+def compare_page(old: Dict[str, dict], new: Dict[str, dict],
+                 threshold_pct: float) -> Tuple[List[dict], List[str]]:
+    """Gate the single-round-trip result page (ISSUE 17): any NEW-side
+    arm that ran with the page gate on must have read its whole
+    response — merged top-k, sort keys, docvalue lanes, totals, aggs —
+    in EXACTLY one device round trip per wave, or the run fails
+    (PAGE-MULTI-TRIP). The row also reports the page-bytes vs
+    legacy-bytes d2h ratio at equal config key, next to the transfer
+    gates: the page pays for its one trip by shipping every merged
+    lane as wire bytes, where the legacy tail's extra trips read
+    zero-byte host mirrors — the ratio is the measured wire price of
+    the single round trip (a few extra KB per wave), reported so a
+    future layout change that silently blows the page up is visible,
+    not gated (the warm-p50 gate is the arbiter of whether the trade
+    still pays). The warm-p50
+    side of the A/B rides the generic gate above (the two arms share a
+    config key, so the page arm is gated against the legacy arm at
+    --threshold like any round-over-round pair). Arms measured without
+    --telemetry carry no ledger fields and only report (no-ledger)."""
+    del threshold_pct
+    o_recs, n_recs = _page_records(old), _page_records(new)
+    rows, failures = [], []
+    if not n_recs:
+        return rows, failures
+    for key in sorted(n_recs):
+        o, n = o_recs.get(key), n_recs[key]
+        row = {"config": key, "result_page": bool(n.get("result_page"))}
+        status = "ok"
+        rt = n.get("round_trips_per_wave")
+        row["round_trips_per_wave"] = rt
+        if n.get("result_page"):
+            if not isinstance(rt, (int, float)):
+                status = "no-ledger"
+            elif rt != 1:
+                status = "PAGE-MULTI-TRIP"
+                failures.append(
+                    f"{key}: page arm read {rt} device round trips per "
+                    f"wave (the result-page contract is exactly 1)")
+        ob = o.get("d2h_bytes_per_wave") if o is not None else None
+        nb = n.get("d2h_bytes_per_wave")
+        if isinstance(ob, (int, float)) and ob > 0 and \
+                isinstance(nb, (int, float)):
+            row["old_d2h_bytes_per_wave"] = ob
+            row["new_d2h_bytes_per_wave"] = nb
+            row["bytes_ratio"] = round(nb / ob, 3)
+        row["status"] = status
+        rows.append(row)
+    return rows, failures
+
+
 def _insights_records(recs: Dict[str, dict]) -> Dict[str, dict]:
     """The INSIGHTS shape: records carrying an `insights` block with
     per-shape rows (bench.py --insights)."""
@@ -488,6 +544,19 @@ def compare_insights(old: Dict[str, dict], new: Dict[str, dict],
             row["status"] = status
             rows.append(row)
     return rows, failures
+
+
+def render_page(rows: List[dict]) -> str:
+    headers = ["config", "result_page", "round_trips_per_wave",
+               "old_d2h_bytes_per_wave", "new_d2h_bytes_per_wave",
+               "bytes_ratio", "status"]
+    table = [headers] + [[str(r.get(h, "-")) for h in headers]
+                         for r in rows]
+    widths = [max(len(row[i]) for row in table)
+              for i in range(len(headers))]
+    return "\n".join(
+        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+        for row in table)
 
 
 def render_insights(rows: List[dict]) -> str:
@@ -592,6 +661,12 @@ def main(argv: List[str]) -> int:
               "skew at equal device count):")
         print(render_scaling(sc_rows))
         failures += sc_failures
+    pg_rows, pg_failures = compare_page(old, new, threshold)
+    if pg_rows:
+        print("\nresult page (device round trips per wave / "
+              "page-vs-legacy d2h bytes):")
+        print(render_page(pg_rows))
+        failures += pg_failures
     in_rows, in_failures = compare_insights(old, new, threshold)
     if in_rows:
         print("\nquery insights (per-shape warm p99 at equal shape "

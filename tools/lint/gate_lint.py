@@ -103,6 +103,9 @@ GATED_SUBSYSTEMS = (
     ("opensearch_tpu/index/engine.py", "InternalEngine",
      "merge_windowed", ()),
     ("opensearch_tpu/ops/device_segment.py", None, "DELTA_PUBLISH", ()),
+    # single-round-trip result page (ISSUE 17): OFF by default — the
+    # legacy multi-channel collect is the pristine path
+    ("opensearch_tpu/search/executor.py", None, "RESULT_PAGE", ()),
 )
 
 # no-op constants a disabled gate may return
