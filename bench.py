@@ -320,6 +320,13 @@ def _setup_telemetry():
     assert _devseg.DELTA_PUBLISH is False, \
         "delta segment publish must be off for clean benches — " \
         "publish_segment must be byte-identical to upload_segment"
+    # and the late-interaction rerank gate (ISSUE 18): the device-
+    # scoring arm of rescore_maxsim is OFF by default — the pristine
+    # rerank path is the host numpy mirror (same f32 math, no device
+    # dispatch). The rerank config enables it itself, for its window.
+    from opensearch_tpu.searchpipeline import processors as _procs
+    assert _procs.MAXSIM_DEVICE_RESCORE is False, \
+        "rescore_maxsim device scoring must be off for clean benches"
 
 
 def _setup_admission():
@@ -2171,6 +2178,227 @@ def bench_knn(mode: str):
     print(json.dumps(out))
 
 
+def _pctls(ms):
+    """(p50, p99) of a latency sample, ms."""
+    s = sorted(ms)
+    return (round(s[len(s) // 2], 2),
+            round(s[min(len(s) - 1, int(len(s) * 0.99))], 2))
+
+
+def bench_maxsim(mode: str):
+    """Late-interaction configs (ISSUE 18): exact MaxSim over
+    rank_vectors token matrices (`maxsim`) and the PQ-fused ADC arm
+    (`maxsim_pq`), with recall@10 vs a host numpy brute-force MaxSim
+    baseline and cold/warm per-query p50/p99. For the PQ arm the numpy
+    baseline IS exact MaxSim, so recall_at_10 doubles as the committed
+    recall_vs_exact >= 0.95 acceptance bound."""
+    import jax
+    import numpy as np
+
+    from opensearch_tpu.index.mapper import MapperService
+    from opensearch_tpu.index.segment import SegmentBuilder
+    from opensearch_tpu.search.executor import SearchExecutor, ShardReader
+
+    platform = jax.devices()[0].platform
+    n = int(os.environ.get("BENCH_MAXSIM_DOCS", "10000"))
+    dims = int(os.environ.get("BENCH_MAXSIM_DIMS", "64"))
+    max_tokens = int(os.environ.get("BENCH_MAXSIM_TOKENS", "8"))
+    n_q = int(os.environ.get("BENCH_MAXSIM_QUERIES", "64"))
+    # the PQ arm is a FIRST PASS: ADC fetches refine_factor*10
+    # candidates and the exact rescore picks the final 10 — the same
+    # oversample → rescore_maxsim contract the serving pipeline ships
+    # (IVF's nprobes plays this role for the knn_ivf config). Raw ADC
+    # top-10 is reported next to it as recall_raw_at_10.
+    refine = int(os.environ.get("BENCH_MAXSIM_REFINE", "4")) \
+        if mode == "maxsim_pq" else 1
+    spec = {"type": "rank_vectors", "dimension": dims,
+            "max_tokens": max_tokens}
+    if mode == "maxsim_pq":
+        spec["compression"] = "pq"
+        pq_m = os.environ.get("BENCH_MAXSIM_PQ_M")
+        if pq_m:
+            spec["pq_m"] = int(pq_m)
+    mapper = MapperService({"properties": {"tok": spec}})
+    rng = np.random.RandomState(13)
+    # clustered token space (ColBERT-style embeddings are cluster-heavy
+    # — also PQ's favorable + realistic case, like the IVF corpus)
+    centers = rng.randn(128, dims).astype(np.float32) * 3
+    doc_tokens = []
+    builder = SegmentBuilder(mapper, "ms0")
+    for i in range(n):
+        nt = int(rng.randint(3, max_tokens + 1))
+        toks = (centers[rng.randint(0, 128, size=nt)]
+                + rng.randn(nt, dims).astype(np.float32) * 0.5)
+        doc_tokens.append(toks)
+        builder.add(mapper.parse_document(f"d{i}",
+                                          {"tok": toks.tolist()}))
+    ex = SearchExecutor(ShardReader(mapper, [builder.seal()]))
+
+    queries = [(centers[rng.randint(0, 128, size=4)]
+                + rng.randn(4, dims).astype(np.float32) * 0.5)
+               for _ in range(n_q)]
+    bodies = [{"query": {"maxsim": {"tok": {
+        "query_vectors": q.tolist(), "k": 10 * refine}}},
+        "size": 10 * refine} for q in queries]
+
+    def _pass():
+        ms, results = [], []
+        for b in bodies:
+            t0 = time.perf_counter()
+            results.append(ex.search(dict(b)))
+            ms.append((time.perf_counter() - t0) * 1000.0)
+        return ms, results
+
+    cold_ms, _ = _pass()        # first body pays the XLA compile
+    t0 = time.perf_counter()
+    warm_ms, results = _pass()
+    qps = n_q / (time.perf_counter() - t0)
+
+    # host numpy brute-force MaxSim (the Lucene-CPU stand-in) + recall;
+    # with refine > 1 the fetched candidates pass through the exact
+    # rescore (rescore_maxsim's f32 math) before recall is taken
+    t0 = time.perf_counter()
+    recalls, raw_recalls = [], []
+    for q, r in zip(queries, results):
+        scores = np.fromiter(
+            ((t @ q.T).max(axis=0).sum() for t in doc_tokens),
+            dtype=np.float32, count=n)
+        want = set(np.argpartition(-scores, 10)[:10].tolist())
+        fetched = [int(h["_id"][1:]) for h in r["hits"]["hits"]]
+        raw_recalls.append(len(set(fetched[:10]) & want) / 10)
+        got = set(sorted(fetched, key=lambda i: -scores[i])[:10])
+        recalls.append(len(got & want) / 10)
+    base_qps = n_q / (time.perf_counter() - t0)
+
+    cold = _pctls(cold_ms)
+    warm = _pctls(warm_ms)
+    out = {
+        "metric": f"{mode}_qps_{n // 1000}k_{dims}d_{platform}",
+        "mode": mode,
+        "value": round(qps, 2),
+        "unit": "queries/s",
+        "vs_baseline": round(qps / base_qps, 3),
+        "recall_at_10": round(float(np.mean(recalls)), 4),
+        "cold_p50_ms": cold[0], "cold_p99_ms": cold[1],
+        "warm_p50_ms": warm[0], "warm_p99_ms": warm[1],
+    }
+    if mode == "maxsim_pq":
+        out["recall_vs_exact"] = out["recall_at_10"]
+        out["refine_factor"] = refine
+        out["recall_raw_at_10"] = round(float(np.mean(raw_recalls)), 4)
+    _t = _telemetry_summary()
+    if _t is not None:
+        out["telemetry"] = _t
+    _f = _faults_summary()
+    if _f is not None:
+        out["faults"] = _f
+    if _BACKEND_DIAG:
+        out["backend_diag"] = "; ".join(_BACKEND_DIAG)
+    print(json.dumps(out))
+
+
+def bench_rerank():
+    """The full multi-stage retrieval chain (ISSUE 18): oversample →
+    BM25 candidate page → rescore_maxsim → truncate_hits through the
+    REST face, with the query-insights recorder AND the gated device
+    rescore arm on for the measured window — the pipeline body appears
+    as an insights shape class and the rerank stage as its own
+    `rerank_stage` row with device-ms attribution."""
+    import jax
+    import numpy as np
+
+    import opensearch_tpu.searchpipeline.processors as procs
+    from opensearch_tpu.node import Node
+    from opensearch_tpu.telemetry import TELEMETRY
+
+    platform = jax.devices()[0].platform
+    n = int(os.environ.get("BENCH_RERANK_DOCS", "2000"))
+    dims = int(os.environ.get("BENCH_RERANK_DIMS", "64"))
+    n_q = int(os.environ.get("BENCH_RERANK_QUERIES", "32"))
+    rng = np.random.RandomState(17)
+    centers = rng.randn(64, dims).astype(np.float32) * 3
+    vocab = ("alpha", "beta", "gamma", "delta", "epsilon", "zeta")
+    node = Node()
+    r = node.request("PUT", "/rr", {
+        "settings": {"number_of_shards": 1},
+        "mappings": {"properties": {
+            "title": {"type": "text"},
+            "tok": {"type": "rank_vectors", "dimension": dims,
+                    "max_tokens": 8}}}})
+    assert r["_status"] == 200, r
+    for i in range(n):
+        nt = int(rng.randint(3, 9))
+        toks = (centers[rng.randint(0, 64, size=nt)]
+                + rng.randn(nt, dims).astype(np.float32) * 0.5)
+        words = " ".join(vocab[j] for j in
+                         rng.randint(0, len(vocab), size=6))
+        node.request("PUT", f"/rr/_doc/d{i}",
+                     {"title": words, "tok": toks.tolist()})
+    node.request("POST", "/rr/_refresh", {})
+    qv = (centers[rng.randint(0, 64, size=4)]
+          + rng.randn(4, dims).astype(np.float32) * 0.5)
+    r = node.request("PUT", "/_search/pipeline/rr", {
+        "request_processors": [{"oversample": {"sample_factor": 3}}],
+        "response_processors": [
+            {"rescore_maxsim": {"field": "tok",
+                                "query_vectors": qv.tolist(),
+                                "model_dims": dims}},
+            {"truncate_hits": {}}]})
+    assert r["_status"] == 200, r
+    bodies = [{"query": {"match": {"title": vocab[i % len(vocab)]}},
+               "size": 10} for i in range(n_q)]
+
+    ins = TELEMETRY.insights
+    ins.enabled = True
+    ins.clear()
+    procs.MAXSIM_DEVICE_RESCORE = True
+    try:
+        def _pass():
+            ms = []
+            for b in bodies:
+                t0 = time.perf_counter()
+                res = node.request("POST", "/rr/_search", dict(b),
+                                   search_pipeline="rr")
+                ms.append((time.perf_counter() - t0) * 1000.0)
+                assert res["_status"] == 200, res
+            return ms
+
+        cold_ms = _pass()
+        t0 = time.perf_counter()
+        warm_ms = _pass()
+        qps = n_q / (time.perf_counter() - t0)
+        snap = ins.snapshot()
+    finally:
+        procs.MAXSIM_DEVICE_RESCORE = False
+        ins.enabled = False
+        ins.clear()
+
+    stage_rows = {k: v for k, v in snap["shapes"].items()
+                  if v["kind"] == "rerank_stage"}
+    assert stage_rows, "rerank stage never reached insights"
+    assert any(v["device_ms_total"] > 0 for v in stage_rows.values()), \
+        "device-gated rerank stage recorded no device ms"
+    cold = _pctls(cold_ms)
+    warm = _pctls(warm_ms)
+    out = {
+        "metric": f"rerank_qps_{n}d{dims}_{platform}",
+        "mode": "rerank",
+        "value": round(qps, 2),
+        "unit": "queries/s",
+        "vs_baseline": 1.0,
+        "cold_p50_ms": cold[0], "cold_p99_ms": cold[1],
+        "warm_p50_ms": warm[0], "warm_p99_ms": warm[1],
+        "insights": {"shapes": snap["shapes"],
+                     "totals": snap["totals"]},
+    }
+    _t = _telemetry_summary()
+    if _t is not None:
+        out["telemetry"] = _t
+    if _BACKEND_DIAG:
+        out["backend_diag"] = "; ".join(_BACKEND_DIAG)
+    print(json.dumps(out))
+
+
 def bench_hybrid():
     """Search-pipeline config: hybrid BM25 ⊕ exact-kNN retrieval with
     min_max normalization + weighted arithmetic combination, vs a numpy
@@ -2608,6 +2836,12 @@ def main():
     mode = os.environ.get("BENCH_MODE", "bm25")
     if mode in ("knn_exact", "knn_ivf"):
         bench_knn(mode)
+        return
+    if mode in ("maxsim", "maxsim_pq"):
+        bench_maxsim(mode)
+        return
+    if mode == "rerank":
+        bench_rerank()
         return
     if mode in ("agg_terms", "date_hist"):
         bench_aggs(mode)

@@ -43,6 +43,11 @@ NUMERIC_TYPES = {"long", "integer", "short", "byte", "double", "float", "half_fl
                  "rank_feature"}
 DATE_TYPES = {"date", "date_nanos"}
 VECTOR_TYPES = {"knn_vector", "dense_vector"}
+# late-interaction multi-vector fields (ColBERT-style): one [tokens, dims]
+# matrix per doc, scored by the fused MaxSim kernel (ops/maxsim.py)
+RANK_VECTOR_TYPES = {"rank_vectors"}
+RANK_VECTORS_COMPRESSION = ("none", "pq")
+DEFAULT_MAX_TOKENS = 128
 BOOL_TYPES = {"boolean"}
 IP_TYPES = {"ip"}
 RANGE_TYPES = {"integer_range", "long_range", "float_range", "double_range",
@@ -139,6 +144,9 @@ class MappedFieldType:
     knn_method: str = "exact"            # vectors: exact | ivf (HNSW → IVF on TPU)
     knn_nlist: int = 128                 # ivf: number of centroids
     knn_nprobe: int = 0                  # ivf: default probes (0 → nlist/8)
+    max_tokens: int = 0                  # rank_vectors: per-doc token cap
+    compression: str = "none"            # rank_vectors: none | pq
+    pq_m: int = 0                        # rank_vectors pq: subspace count
     ignore_above: Optional[int] = None   # keyword
     null_value: Any = None
     boost: float = 1.0
@@ -175,6 +183,10 @@ class MappedFieldType:
     @property
     def is_vector(self):
         return self.type in VECTOR_TYPES
+
+    @property
+    def is_rank_vectors(self):
+        return self.type in RANK_VECTOR_TYPES
 
     @property
     def has_ordinals(self):
@@ -254,6 +266,7 @@ class ParsedField:
     exact_values: Optional[List[str]] = None        # keyword-style exact terms
     numeric_values: Optional[List[float]] = None    # numeric/date/bool/ip doc values
     vector: Optional[List[float]] = None
+    token_vectors: Optional[List[List[float]]] = None  # rank_vectors matrix
 
 
 @dataclass
@@ -348,6 +361,7 @@ class MapperService:
     def _put_field(self, full_name: str, spec: dict):
         ftype = spec.get("type")
         known = (TEXT_TYPES | KEYWORD_TYPES | NUMERIC_TYPES | DATE_TYPES | VECTOR_TYPES
+                 | RANK_VECTOR_TYPES
                  | BOOL_TYPES | IP_TYPES | GEO_TYPES | RANGE_TYPES
                  | {"object", "binary", "percolator"})
         if ftype not in known:
@@ -369,11 +383,36 @@ class MapperService:
             raise IllegalArgumentError(
                 f"Limit of total fields [{self.total_fields_limit}] has been exceeded")
         dims = 0
-        if ftype in VECTOR_TYPES:
+        if ftype in VECTOR_TYPES or ftype in RANK_VECTOR_TYPES:
             dims = int(spec.get("dimension", spec.get("dims", 0)))
             if dims <= 0:
                 raise MapperParsingError(
                     f"dimension must be set for vector field [{full_name}]")
+        max_tokens = 0
+        compression = "none"
+        pq_m = 0
+        if ftype in RANK_VECTOR_TYPES:
+            max_tokens = int(spec.get("max_tokens", DEFAULT_MAX_TOKENS))
+            if max_tokens <= 0:
+                raise MapperParsingError(
+                    f"max_tokens must be a positive integer for "
+                    f"rank_vectors field [{full_name}]")
+            compression = str(spec.get("compression", "none"))
+            if compression not in RANK_VECTORS_COMPRESSION:
+                raise MapperParsingError(
+                    f"compression must be one of "
+                    f"{list(RANK_VECTORS_COMPRESSION)} for rank_vectors "
+                    f"field [{full_name}], got [{compression}]")
+            if compression == "pq":
+                # subspace count: explicit `pq_m` or the widest divisor
+                # giving 4-dim subvectors (falling back to scalar
+                # subspaces for odd dims)
+                pq_m = int(spec.get("pq_m",
+                                    dims // 4 if dims % 4 == 0 else dims))
+                if pq_m <= 0 or dims % pq_m != 0:
+                    raise MapperParsingError(
+                        f"pq_m [{pq_m}] must evenly divide dimension "
+                        f"[{dims}] for rank_vectors field [{full_name}]")
         analyzer = spec.get("analyzer", "standard")
         if not self.analysis.has(analyzer):
             raise MapperParsingError(
@@ -413,6 +452,9 @@ class MapperService:
             knn_nlist=int(method_params.get("nlist", 128)),
             knn_nprobe=int(method_params.get("nprobes",
                                              method_params.get("nprobe", 0))),
+            max_tokens=max_tokens,
+            compression=compression,
+            pq_m=pq_m,
             ignore_above=spec.get("ignore_above"),
             null_value=spec.get("null_value"),
             boost=float(spec.get("boost", 1.0)),
@@ -436,6 +478,12 @@ class MapperService:
             spec: dict = {"type": ft.type}
             if ft.is_vector:
                 spec["dimension"] = ft.dims
+            if ft.is_rank_vectors:
+                spec["dimension"] = ft.dims
+                spec["max_tokens"] = ft.max_tokens
+                if ft.compression != "none":
+                    spec["compression"] = ft.compression
+                    spec["pq_m"] = ft.pq_m
             if ft.fmt:
                 spec["format"] = ft.fmt
             if ft.analyzer != "standard" and ft.is_text:
@@ -649,6 +697,28 @@ class MapperService:
             nums.extend(float(ip_to_long(v)) for v in values)
             pf.numeric_values = nums
             pf.exact_values = (pf.exact_values or []) + [str(v) for v in values]
+        elif ft.is_rank_vectors:
+            # one [tokens, dims] matrix per doc: an array of per-token
+            # vectors (an empty array is a valid zero-token doc)
+            if not isinstance(value, list) or not all(
+                    isinstance(t, list) for t in values):
+                raise MapperParsingError(
+                    f"failed to parse rank_vectors field [{name}]: "
+                    f"expected an array of token vectors")
+            if len(values) > ft.max_tokens:
+                raise MapperParsingError(
+                    f"rank_vectors field [{name}] has {len(values)} token "
+                    f"vectors, more than max_tokens [{ft.max_tokens}]")
+            toks: List[List[float]] = []
+            for t in values:
+                if len(t) != ft.dims or not all(
+                        isinstance(v, (int, float)) and
+                        not isinstance(v, bool) for v in t):
+                    raise MapperParsingError(
+                        f"Vector dimension mismatch for field [{name}]: "
+                        f"expected {ft.dims}, got {len(t)}")
+                toks.append([float(v) for v in t])
+            pf.token_vectors = toks
         elif ft.is_vector:
             if isinstance(value, list) and all(isinstance(v, (int, float)) for v in value):
                 vec = [float(v) for v in value]

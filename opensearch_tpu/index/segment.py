@@ -98,6 +98,13 @@ def ident_pairs(col) -> bool:
     return out
 
 
+def token_mask_rows(token_count: np.ndarray, t_bucket: int) -> np.ndarray:
+    """Host mask of the real (non-padded) rows in a flattened [D*T, dims]
+    token block — seal-time PQ trains only on real token vectors."""
+    lanes = np.arange(t_bucket)[None, :] < token_count[:, None]
+    return lanes.reshape(-1)
+
+
 def pad_bucket(n: int, minimum: int = 128) -> int:
     """Round up to the next power-of-two bucket to bound jit recompiles."""
     size = max(minimum, 1)
@@ -163,6 +170,24 @@ class VectorColumn:
     ivf: Any = None          # Optional[opensearch_tpu.ops.knn.IVFIndex]
 
 
+@dataclass
+class RankVectorsColumn:
+    """Late-interaction multi-vector doc values (rank_vectors fields):
+    one padded [T_bucket, dims] token matrix per doc, scored by the
+    fused MaxSim kernels (ops/maxsim.py). `t_bucket` is the segment's
+    power-of-two token bucket (pad_bucket of the longest stored doc,
+    capped by the mapping's max_tokens bucket) so device executables
+    key on the bucket, not the raw token count. PQ-compressed mappings
+    additionally carry seal-trained uint8 codes + the codebook; the
+    raw f32 matrices stay host-side for rescoring and differentials."""
+    tokens: np.ndarray       # float32 [D, T_bucket, dims], padded lanes 0
+    token_count: np.ndarray  # int32 [D] real tokens per doc
+    exists: np.ndarray       # bool [D] doc has >= 1 token vector
+    t_bucket: int
+    codes: Optional[np.ndarray] = None      # uint8 [D, T_bucket, M]
+    codebook: Optional[np.ndarray] = None   # float32 [M, 256, dsub]
+
+
 _SEGMENT_UID = itertools.count(1)
 
 
@@ -181,7 +206,8 @@ class Segment:
                  positions: Optional[Dict[Tuple[str, str], List[np.ndarray]]] = None,
                  parent_ptr: Optional[np.ndarray] = None,
                  path_ords: Optional[np.ndarray] = None,
-                 nested_paths: Optional[List[str]] = None):
+                 nested_paths: Optional[List[str]] = None,
+                 rank_vectors_dv: Optional[Dict[str, RankVectorsColumn]] = None):
         self.seg_id = seg_id
         # process-unique identity: seg_id is a per-engine counter and can
         # repeat across indices/engines, so caches keyed on segments (e.g.
@@ -198,6 +224,7 @@ class Segment:
         self.numeric_dv = numeric_dv
         self.ordinal_dv = ordinal_dv
         self.vector_dv = vector_dv
+        self.rank_vectors_dv = rank_vectors_dv or {}
         # host-only term positions per (field, term), lists parallel to the
         # postings entries — consumed by the phrase-query host verifier
         # (reference: Lucene's .pos files feeding PhraseQuery's ExactPhraseMatcher)
@@ -295,6 +322,11 @@ class Segment:
                       + col.ord_hashes.nbytes)
         for col in self.vector_dv.values():
             total += col.vectors.nbytes + col.exists.nbytes
+        for col in self.rank_vectors_dv.values():
+            total += (col.tokens.nbytes + col.token_count.nbytes
+                      + col.exists.nbytes)
+            if col.codes is not None:
+                total += col.codes.nbytes + col.codebook.nbytes
         for pos_lists in self.positions.values():
             total += sum(p.nbytes for p in pos_lists)
         return total
@@ -328,6 +360,7 @@ class SegmentBuilder:
         self._numeric: Dict[str, List[Tuple[int, float]]] = {}
         self._ordinal_raw: Dict[str, List[Tuple[int, str]]] = {}
         self._vectors: Dict[str, Dict[int, List[float]]] = {}
+        self._rank_vectors: Dict[str, Dict[int, List[List[float]]]] = {}
         self._field_stats: Dict[str, FieldStats] = {}
         # doc-block structure (Lucene block-join layout: nested child rows
         # precede their parent row): parent row ord per row (-1 = root) and
@@ -401,6 +434,8 @@ class SegmentBuilder:
                     self._numeric.setdefault(field, []).append((ord_, v))
             if pf.vector is not None:
                 self._vectors.setdefault(field, {})[ord_] = pf.vector
+            if pf.token_vectors is not None:
+                self._rank_vectors.setdefault(field, {})[ord_] = pf.token_vectors
         return ord_
 
     def seal(self) -> Segment:
@@ -488,13 +523,43 @@ class SegmentBuilder:
                                     nprobe=ft.knn_nprobe)
             vector_dv[field] = col
 
+        # ---- rank_vectors: padded [D, T_bucket, dims] token matrices with
+        # token-count mask lanes; PQ mappings train their codebook at seal
+        # (the Lucene-analog moment — expensive work happens once per
+        # segment, never on the query path)
+        rank_vectors_dv: Dict[str, RankVectorsColumn] = {}
+        for field, rows in self._rank_vectors.items():
+            ft = self.mapper.get_field(field)
+            max_seen = max((len(toks) for toks in rows.values()), default=0)
+            t_bucket = min(pad_bucket(max(max_seen, 1), minimum=8),
+                           pad_bucket(ft.max_tokens, minimum=8))
+            tokens = np.zeros((n_docs, t_bucket, ft.dims), dtype=np.float32)
+            token_count = np.zeros(n_docs, dtype=np.int32)
+            exists = np.zeros(n_docs, dtype=bool)
+            for ord_, toks in rows.items():
+                nt = len(toks)
+                if nt:
+                    tokens[ord_, :nt] = np.asarray(toks, dtype=np.float32)
+                token_count[ord_] = nt
+                exists[ord_] = nt > 0
+            col = RankVectorsColumn(tokens, token_count, exists, t_bucket)
+            if ft.compression == "pq":
+                from opensearch_tpu.ops.maxsim import train_pq, encode_pq
+                flat = tokens.reshape(-1, ft.dims)
+                real = flat[token_mask_rows(token_count, t_bucket)]
+                col.codebook = train_pq(real, ft.pq_m)
+                codes = encode_pq(flat, col.codebook)
+                col.codes = codes.reshape(n_docs, t_bucket, ft.pq_m)
+            rank_vectors_dv[field] = col
+
         return Segment(self.seg_id, n_docs, list(self.doc_ids), list(self.sources),
                        term_dict, post_docs, post_tf, norms, self._field_stats,
                        numeric_dv, ordinal_dv, vector_dv,
                        positions=dict(self._positions),
                        parent_ptr=np.asarray(self._parent_ptr, np.int32),
                        path_ords=np.asarray(self._path_ords, np.int32),
-                       nested_paths=list(self._nested_paths))
+                       nested_paths=list(self._nested_paths),
+                       rank_vectors_dv=rank_vectors_dv)
 
 
 def merge_segments(mapper: MapperService, segments: List[Segment],

@@ -62,6 +62,10 @@ class DeviceSegmentMeta:
     numeric_fields: Tuple[str, ...]
     ordinal_fields: Tuple[str, ...]
     vector_fields: Tuple[str, ...]
+    # (field, token_bucket, compression) per rank_vectors field — the
+    # token bucket and storage variant are executable-shaping facts, so
+    # they live in the compile key, not just the runtime array shapes
+    rank_vector_fields: Tuple[Tuple[str, int, str], ...] = ()
 
     def norm_row(self, field: str) -> Optional[int]:
         for f, r in self.norm_rows:
@@ -81,7 +85,7 @@ class DeviceSegmentMeta:
         publish without cold recompiles)."""
         return (self.num_docs, self.d_pad, self.nb_pad, self.norm_rows,
                 self.numeric_fields, self.ordinal_fields,
-                self.vector_fields)
+                self.vector_fields, self.rank_vector_fields)
 
 
 def upload_segment(seg: Segment, to_device: bool = True):
@@ -129,6 +133,7 @@ def upload_segment(seg: Segment, to_device: bool = True):
         "numeric": {},
         "ordinal": {},
         "vector": {},
+        "rank_vectors": {},
     }
 
     for fname, col in seg.numeric_dv.items():
@@ -183,6 +188,31 @@ def upload_segment(seg: Segment, to_device: bool = True):
             entry["ivf_packed_ids"] = flat_ids
         arrays["vector"][fname] = entry
 
+    # rank_vectors (late-interaction token matrices): docs axis padded to
+    # d_pad like every dense column; the token axis keeps the segment's
+    # power-of-two bucket from seal. PQ mappings ship codes + codebook
+    # instead of the raw f32 matrices (the kernel decodes in-register).
+    rank_vector_fields = []
+    for fname, col in sorted(getattr(seg, "rank_vectors_dv", {}).items()):
+        token_count = np.zeros(d_pad, dtype=np.int32)
+        token_count[:seg.num_docs] = col.token_count
+        exists = np.zeros(d_pad, dtype=bool)
+        exists[:seg.num_docs] = col.exists
+        entry = {"token_count": token_count, "exists": exists}
+        if col.codes is not None:
+            codes = np.zeros((d_pad,) + col.codes.shape[1:], dtype=np.uint8)
+            codes[:seg.num_docs] = col.codes
+            entry["codes"] = codes
+            entry["codebook"] = col.codebook
+            compression = "pq"
+        else:
+            tokens = np.zeros((d_pad,) + col.tokens.shape[1:], dtype=np.float32)
+            tokens[:seg.num_docs] = col.tokens
+            entry["tokens"] = tokens
+            compression = "none"
+        arrays["rank_vectors"][fname] = entry
+        rank_vector_fields.append((fname, col.t_bucket, compression))
+
     if to_device:
         arrays = _tree_to_jnp(arrays)
 
@@ -195,6 +225,7 @@ def upload_segment(seg: Segment, to_device: bool = True):
         numeric_fields=tuple(sorted(seg.numeric_dv.keys())),
         ordinal_fields=tuple(sorted(seg.ordinal_dv.keys())),
         vector_fields=tuple(sorted(seg.vector_dv.keys())),
+        rank_vector_fields=tuple(rank_vector_fields),
     )
     return arrays, meta
 
@@ -253,6 +284,14 @@ def _compact_spec(seg: Segment, meta: DeviceSegmentMeta) -> Dict[tuple, tuple]:
     for fname in seg.vector_dv:
         spec[("vector", fname, "vectors")] = ((nd, None), 0.0)
         spec[("vector", fname, "exists")] = ((nd,), False)
+    for fname, col in getattr(seg, "rank_vectors_dv", {}).items():
+        spec[("rank_vectors", fname, "token_count")] = ((nd,), 0)
+        spec[("rank_vectors", fname, "exists")] = ((nd,), False)
+        if col.codes is not None:
+            spec[("rank_vectors", fname, "codes")] = ((nd, None, None), 0)
+            # codebook is query-shaped, not doc-shaped: full transfer
+        else:
+            spec[("rank_vectors", fname, "tokens")] = ((nd, None, None), 0.0)
     return spec
 
 

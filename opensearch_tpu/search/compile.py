@@ -180,6 +180,12 @@ class Plan:
     # tools/scaling_bench.py evaluates offline. NOT part of sig():
     # it is derived from the same inputs the signature already hashes.
     scan_blocks: int = 0
+    # bytes this node's kernel scans OUTSIDE the posting/dense-lane
+    # formulas — rank_vectors token matrices (maxsim: d_pad × T × dims
+    # f32, or codes + codebook for the PQ variant). Folded into the
+    # dense byte class by the executor's scan accounting. Derived like
+    # scan_blocks, so also NOT part of sig().
+    scan_extra: int = 0
 
     def sig(self):
         return (self.kind, self.static,
@@ -980,6 +986,52 @@ class Compiler:
                     inputs={"query": q, "boost": _f32(node.boost)},
                     children=children)
 
+    def _c_MaxSimQuery(self, node: dsl.MaxSimQuery, seg, meta) -> Plan:
+        """Late-interaction MaxSim leaf → fused token-matrix scan
+        (ops/maxsim.py). Like knn: per-segment top-k with `filter`
+        restricting eligibility BEFORE selection. The query token matrix
+        is padded to a power-of-two token bucket with a qmask zeroing
+        padded lanes, so executables key on (plan struct, Tq bucket,
+        segment bucket) — not the raw query token count."""
+        ft = self.mapper.get_field(node.field)
+        if ft is None or not ft.is_rank_vectors:
+            raise QueryShardError(
+                f"field [{node.field}] is not a rank_vectors field")
+        col = getattr(seg, "rank_vectors_dv", {}).get(node.field)
+        if col is None:
+            return MATCH_NONE
+        q = np.asarray([list(t) for t in node.query_vectors],
+                       dtype=np.float32)  # sync-ok: host -- query token matrix from the request body
+        if q.ndim != 2 or q.shape[1] != ft.dims:
+            got = q.shape[1] if q.ndim == 2 else "ragged"
+            raise IllegalArgumentError(
+                f"query token vectors have dimension {got} but field "
+                f"[{node.field}] expects {ft.dims}")
+        if q.shape[0] > ft.max_tokens:
+            raise IllegalArgumentError(
+                f"query has {q.shape[0]} token vectors but field "
+                f"[{node.field}] allows at most max_tokens={ft.max_tokens}")
+        tq = pad_bucket(q.shape[0], minimum=4)
+        qpad = np.zeros((tq, ft.dims), dtype=np.float32)
+        qpad[:q.shape[0]] = q
+        qmask = np.zeros(tq, dtype=np.float32)
+        qmask[:q.shape[0]] = 1.0
+        children = []
+        if node.filter is not None:
+            children.append(self.compile(node.filter, seg, meta))
+        if col.codes is not None:
+            compression = "pq"
+            scan_extra = (meta.d_pad * col.t_bucket * col.codes.shape[2]
+                          + col.codebook.nbytes)
+        else:
+            compression = "none"
+            scan_extra = meta.d_pad * col.t_bucket * ft.dims * 4
+        return Plan("maxsim",
+                    static=(node.field, int(node.k), compression),
+                    inputs={"query": qpad, "qmask": qmask,
+                            "boost": _f32(node.boost)},
+                    children=children, scan_extra=scan_extra)
+
     def _c_HybridQuery(self, node: dsl.HybridQuery, seg, meta) -> Plan:
         """Hybrid is a TOP-LEVEL clause executed by the fused hybrid query
         phase (search/executor.py build_hybrid_query_phase), which compiles
@@ -1101,6 +1153,9 @@ class Compiler:
                         inputs={"boost": _f32(node.boost)})
         if field in seg.vector_dv:
             return Plan("exists", static=("vector", field),
+                        inputs={"boost": _f32(node.boost)})
+        if field in getattr(seg, "rank_vectors_dv", {}):
+            return Plan("exists", static=("rank_vectors", field),
                         inputs={"boost": _f32(node.boost)})
         row = meta.norm_row(field)
         if row is not None:

@@ -299,6 +299,18 @@ class KnnQuery(QueryNode):
 
 
 @dataclass
+class MaxSimQuery(QueryNode):
+    """Late-interaction leaf query over a `rank_vectors` field: the query
+    brings one vector per query token and docs are scored by the fused
+    MaxSim kernel (ops/maxsim.py). Like `knn`, never interned — the body
+    carries the full token matrix, so templates would never repeat."""
+    field: str = ""
+    query_vectors: Sequence[Sequence[float]] = ()
+    k: int = 10
+    filter: Optional[QueryNode] = None
+
+
+@dataclass
 class HybridQuery(QueryNode):
     """Hybrid dense+sparse retrieval clause (the neural-search plugin's
     HybridQueryBuilder): N independently-scored sub-queries whose per-doc
@@ -672,6 +684,20 @@ def parse_query(q: Any) -> QueryNode:
                         filter=parse_query(spec["filter"]) if "filter" in spec else None,
                         nprobe=int(mp.get("nprobes", mp.get("nprobe", 0))),
                         boost=float(spec.get("boost", 1.0)))
+
+    if name == "maxsim":
+        field, spec = _field_body(body, "maxsim")
+        qv = spec.get("query_vectors")
+        if not isinstance(qv, list) or not qv \
+                or not all(isinstance(t, list) and t for t in qv):
+            raise ParsingError("[maxsim] query requires a non-empty "
+                               "[query_vectors] list of token vectors")
+        return MaxSimQuery(field=field,
+                           query_vectors=[list(t) for t in qv],
+                           k=int(spec.get("k", 10)),
+                           filter=parse_query(spec["filter"])
+                           if "filter" in spec else None,
+                           boost=float(spec.get("boost", 1.0)))
 
     if name == "hybrid":
         subs = body.get("queries")

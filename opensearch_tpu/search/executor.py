@@ -1630,7 +1630,8 @@ def _scan_accumulate_item(device, plans, seg_rows, per_query) -> None:
     — the O(d_pad) dense-lane bytes the candidate-buffer kernel exists
     to avoid. `SCAN.note_batch` lands the whole wave in one flush."""
     from opensearch_tpu.telemetry.scan import (
-        DENSE_LANE_BYTES, POSTING_BLOCK_BYTES, plan_scan_blocks)
+        DENSE_LANE_BYTES, POSTING_BLOCK_BYTES, plan_scan_blocks,
+        plan_scan_extra)
     q_posting = q_dense = 0
     noted = False
     for plan, (_, meta) in zip(plans, device):
@@ -1640,6 +1641,9 @@ def _scan_accumulate_item(device, plans, seg_rows, per_query) -> None:
         kernel = _envelope_kernel(plan)
         dense = 0 if kernel == "candidate" \
             else meta.d_pad * DENSE_LANE_BYTES
+        # rank_vectors token-matrix / PQ-code bytes (maxsim kernels)
+        # fold into the dense class — they are O(d_pad) HBM traffic
+        dense += plan_scan_extra(plan)
         row = seg_rows.get(meta.seg_id)
         if row is None:
             row = seg_rows[meta.seg_id] = [0, 0, 0, {}]
@@ -2216,7 +2220,7 @@ class SearchExecutor:
         launched = []
         from opensearch_tpu.telemetry.scan import (
             DENSE_LANE_BYTES, POSTING_BLOCK_BYTES, SCAN,
-            plan_scan_blocks)
+            plan_scan_blocks, plan_scan_extra)
         scan_shard = str(getattr(self.reader, "shard_id", 0))
         q_posting = q_dense = 0
         from opensearch_tpu.indices.query_cache import FilterCacheContext
@@ -2238,7 +2242,7 @@ class SearchExecutor:
             # posting blocks gathered per the plan statics plus the
             # O(d_pad) dense lanes, attributed per (shard, segment)
             posting = plan_scan_blocks(plan) * POSTING_BLOCK_BYTES
-            dense = meta.d_pad * DENSE_LANE_BYTES
+            dense = meta.d_pad * DENSE_LANE_BYTES + plan_scan_extra(plan)
             SCAN.note_segment(self.reader.index_name, scan_shard,
                               meta.seg_id, posting, dense, "dense")
             q_posting += posting

@@ -157,6 +157,8 @@ def _eval_plan(plan: Plan, seg: Dict, inputs: List[Dict], cursor: List[int]):
             matches = seg["ordinal"][key]["exists"]
         elif ctype == "vector":
             matches = seg["vector"][key]["exists"]
+        elif ctype == "rank_vectors":
+            matches = seg["rank_vectors"][key]["exists"]
         else:  # norms row
             matches = seg["norms"][key] > 0
         return jnp.where(matches, my["boost"], 0.0), matches
@@ -179,6 +181,25 @@ def _eval_plan(plan: Plan, seg: Dict, inputs: List[Dict], cursor: List[int]):
         else:
             scores = exact_knn_scores(col["vectors"], my["query"], space)
         scores, matches = knn_match_topk(scores, eligible, k)
+        return scores * my["boost"], matches
+
+    if kind == "maxsim":
+        from opensearch_tpu.ops.maxsim import (
+            exact_maxsim_scores, maxsim_match_topk, pq_maxsim_scores)
+        field, k, compression = plan.static
+        col = seg["rank_vectors"][field]
+        eligible = col["exists"] & seg["live"]
+        if plan.children:
+            _, fmatches = _eval_plan(plan.children[0], seg, inputs, cursor)
+            eligible = eligible & fmatches
+        if compression == "pq":
+            scores = pq_maxsim_scores(col["codes"], col["codebook"],
+                                      col["token_count"], my["query"],
+                                      my["qmask"])
+        else:
+            scores = exact_maxsim_scores(col["tokens"], col["token_count"],
+                                         my["query"], my["qmask"])
+        scores, matches = maxsim_match_topk(scores, eligible, k)
         return scores * my["boost"], matches
 
     if kind == "bool":

@@ -16,6 +16,7 @@ truncate_hits can restore it.
 from __future__ import annotations
 
 import math
+import time
 from typing import Any, Dict, List, Optional
 
 from opensearch_tpu.common.errors import IllegalArgumentError
@@ -35,6 +36,23 @@ def _require(config: dict, key: str, type_name: str):
         raise IllegalArgumentError(
             f"[{type_name}] required property [{key}] is missing")
     return config[key]
+
+
+def _model_dims(config: dict, type_name: str) -> Optional[int]:
+    """Optional [model_dims] declaration on the rescore processors: the
+    embedding width the pipeline's model produces. Validated at PUT time
+    (bad values are a 400 on the CRUD call) and re-checked against the
+    mapped field at query time — a mismatch renders a 400, never a 500."""
+    raw = config.get("model_dims")
+    if raw is None:
+        return None
+    if isinstance(raw, bool) or not isinstance(raw, int):
+        raise IllegalArgumentError(
+            f"[{type_name}] [model_dims] must be an integer, got [{raw}]")
+    if raw <= 0:
+        raise IllegalArgumentError(
+            f"[{type_name}] [model_dims] must be > 0, got [{raw}]")
+    return raw
 
 
 # ---------------------------------------------------------------- request
@@ -167,6 +185,7 @@ class RescoreKnnProcessor(Processor):
             raise IllegalArgumentError(
                 "[rescore_knn] [query_vector] must be an array")
         self.space_type = str(config.get("space_type", "")) or None
+        self.model_dims = _model_dims(config, self.type_name)
 
     def _resolve_vector(self, body: dict):
         if self.query_vector is not None:
@@ -198,8 +217,8 @@ class RescoreKnnProcessor(Processor):
         page is small, so per-hit device dispatch would cost more than
         the math."""
         import numpy as np
-        vec = np.asarray(vec, np.float64)
-        q = np.asarray(q, np.float64)
+        vec = np.asarray(vec, np.float64)  # sync-ok: host -- stored host-side column row
+        q = np.asarray(q, np.float64)  # sync-ok: host -- query vector from the request body
         if space == "l2":
             return float(1.0 / (1.0 + ((vec - q) ** 2).sum()))
         if space == "cosinesimil":
@@ -218,10 +237,26 @@ class RescoreKnnProcessor(Processor):
             raise IllegalArgumentError(
                 f"[rescore_knn] no [query_vector] configured and the "
                 f"request has no knn clause on [{self.field}]")
-        q = np.asarray(query, dtype=np.float32)
+        q = np.asarray(query, dtype=np.float32)  # sync-ok: host -- query vector from the request body
+        if self.model_dims is not None and q.shape != (self.model_dims,):
+            raise IllegalArgumentError(
+                f"[rescore_knn] query vector has dimension {q.shape[0]} "
+                f"but the processor declares model_dims="
+                f"{self.model_dims}")
         hits = response.get("hits", {}).get("hits", [])
         if not hits or not targets:
             return response
+        for svc in targets:
+            ft = svc.mapper.get_field(self.field)
+            if ft is None or not ft.is_vector:
+                raise IllegalArgumentError(
+                    f"[rescore_knn] field [{self.field}] is not a "
+                    f"knn_vector field on [{svc.index_name}]")
+            if q.shape != (ft.dims,):
+                raise IllegalArgumentError(
+                    f"[rescore_knn] query vector has dimension "
+                    f"{q.shape[0]} but field [{self.field}] expects "
+                    f"{ft.dims}")
         by_index = {svc.index_name: svc for svc in targets}
         for hit in hits:
             svc = by_index.get(hit.get("_index"))
@@ -244,6 +279,190 @@ class RescoreKnnProcessor(Processor):
                         break
                 if found:
                     break
+        hits.sort(key=lambda h: -(h.get("_score") or 0.0))
+        response["hits"]["hits"] = hits
+        if hits and hits[0].get("_score") is not None:
+            response["hits"]["max_score"] = hits[0]["_score"]
+        return response
+
+
+# ISSUE 18: OFF-by-default device-scoring arm of rescore_maxsim. The
+# pristine path scores the rerank page with the host numpy mirror (the
+# page is small — tens of hits); the gated arm batches the page's token
+# matrices through the exact MaxSim device kernel (ops/maxsim.py),
+# recording the transfer ledger channels `upload.maxsim_query` (h2d)
+# and `maxsim_scores` (d2h). Same f32 math both ways.
+MAXSIM_DEVICE_RESCORE = False
+
+
+class RescoreMaxSimProcessor(Processor):
+    """Late-interaction rerank of the (oversampled) hit page: recompute
+    each hit's MaxSim score `sum_t max_s q_t·d_s` against the stored
+    `rank_vectors` token matrix and re-rank. Completes the multi-stage
+    retrieval chain (arxiv 1707.08275): oversample → BM25/kNN candidate
+    page → MaxSim rescore → truncate_hits. PQ-compressed fields rerank
+    against the raw host-side matrices — the rerank stage is where
+    exactness is bought back after the compressed first pass."""
+    type_name = "rescore_maxsim"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.field = str(_require(config, "field", self.type_name))
+        self.query_vectors = config.get("query_vectors")
+        if self.query_vectors is not None and (
+                not isinstance(self.query_vectors, (list, tuple))
+                or not self.query_vectors
+                or not all(isinstance(t, (list, tuple)) and t
+                           for t in self.query_vectors)):
+            raise IllegalArgumentError(
+                "[rescore_maxsim] [query_vectors] must be a non-empty "
+                "array of token vectors")
+        self.model_dims = _model_dims(config, self.type_name)
+
+    def _resolve_vectors(self, body: dict):
+        if self.query_vectors is not None:
+            return [list(t) for t in self.query_vectors]
+
+        def find(q):
+            if not isinstance(q, dict):
+                return None
+            ms = q.get("maxsim")
+            if isinstance(ms, dict) and self.field in ms:
+                return (ms[self.field] or {}).get("query_vectors")
+            for v in q.values():
+                if isinstance(v, dict):
+                    got = find(v)
+                    if got is not None:
+                        return got
+                elif isinstance(v, list):
+                    for item in v:
+                        got = find(item)
+                        if got is not None:
+                            return got
+            return None
+
+        return find(body.get("query"))
+
+    @staticmethod
+    def _maxsim_score(toks, q) -> float:
+        """Host (numpy, f32) mirror of ops/maxsim.exact_maxsim_scores
+        for one doc's real (unpadded) token rows."""
+        import numpy as np
+        if toks.shape[0] == 0:
+            return 0.0
+        dots = toks.astype(np.float32) @ q.T       # [T, Tq]
+        return float(dots.max(axis=0).sum())
+
+    def _gather(self, hits, targets):
+        """Locate each hit's stored token matrix: (hit, real-token rows)
+        pairs; hits without the field keep their first-pass score."""
+        import numpy as np
+        by_index = {svc.index_name: svc for svc in targets}
+        out = []
+        for hit in hits:
+            svc = by_index.get(hit.get("_index"))
+            if svc is None:
+                continue
+            for shard in svc.shards:
+                found = False
+                for seg in shard.executor.reader.segments:
+                    ord_ = seg.ord_of(hit["_id"])
+                    col = getattr(seg, "rank_vectors_dv", {}) \
+                        .get(self.field)
+                    if ord_ is not None and col is not None \
+                            and col.exists[ord_]:
+                        nt = int(col.token_count[ord_])
+                        out.append((hit, col.tokens[ord_, :nt]))
+                        found = True
+                        break
+                if found:
+                    break
+        return out
+
+    def _score_device(self, gathered, q) -> None:
+        """Gated device arm: one batched exact-MaxSim dispatch over the
+        page's token matrices, ledger-attributed on both directions."""
+        import numpy as np
+        import jax.numpy as jnp
+        from opensearch_tpu.index.segment import pad_bucket
+        from opensearch_tpu.ops.maxsim import exact_maxsim_scores
+        from opensearch_tpu.telemetry import TELEMETRY
+        n = len(gathered)
+        t_bucket = pad_bucket(max(max(t.shape[0] for _, t in gathered), 1),
+                              minimum=8)
+        h_pad = pad_bucket(n, minimum=8)
+        tokens = np.zeros((h_pad, t_bucket, q.shape[1]), dtype=np.float32)
+        counts = np.zeros(h_pad, dtype=np.int32)
+        for i, (_, toks) in enumerate(gathered):
+            tokens[i, :toks.shape[0]] = toks
+            counts[i] = toks.shape[0]
+        qmask = np.ones(q.shape[0], dtype=np.float32)
+        TELEMETRY.ledger.record(
+            "upload.maxsim_query", "h2d",
+            int(tokens.nbytes + counts.nbytes + q.nbytes + qmask.nbytes))
+        scores_dev = exact_maxsim_scores(
+            jnp.asarray(tokens), jnp.asarray(counts),
+            jnp.asarray(q), jnp.asarray(qmask))
+        scores = np.asarray(scores_dev)  # sync-ok: maxsim_scores -- single batched rerank-page fetch
+        TELEMETRY.ledger.record("maxsim_scores", "d2h",
+                                int(scores.nbytes))
+        for i, (hit, _) in enumerate(gathered):
+            hit["_score"] = float(scores[i])
+
+    def process_response(self, response: dict, ctx: dict,
+                         targets=None) -> dict:
+        import numpy as np
+        qv = self._resolve_vectors(ctx.get("request_body") or {})
+        if qv is None:
+            raise IllegalArgumentError(
+                f"[rescore_maxsim] no [query_vectors] configured and the "
+                f"request has no maxsim clause on [{self.field}]")
+        try:
+            q = np.asarray(qv, dtype=np.float32)  # sync-ok: host -- query token matrix from the request body
+        except (TypeError, ValueError):
+            q = None
+        if q is None or q.ndim != 2:
+            raise IllegalArgumentError(
+                "[rescore_maxsim] [query_vectors] token vectors must all "
+                "have the same dimension")
+        if self.model_dims is not None and q.shape[1] != self.model_dims:
+            raise IllegalArgumentError(
+                f"[rescore_maxsim] query token vectors have dimension "
+                f"{q.shape[1]} but the processor declares model_dims="
+                f"{self.model_dims}")
+        hits = response.get("hits", {}).get("hits", [])
+        if not hits or not targets:
+            return response
+        for svc in targets:
+            ft = svc.mapper.get_field(self.field)
+            if ft is None or not getattr(ft, "is_rank_vectors", False):
+                raise IllegalArgumentError(
+                    f"[rescore_maxsim] field [{self.field}] is not a "
+                    f"rank_vectors field on [{svc.index_name}]")
+            if q.shape[1] != ft.dims:
+                raise IllegalArgumentError(
+                    f"[rescore_maxsim] query token vectors have "
+                    f"dimension {q.shape[1]} but field [{self.field}] "
+                    f"expects {ft.dims}")
+        gathered = self._gather(hits, targets)
+        if gathered:
+            t0 = time.perf_counter()
+            if MAXSIM_DEVICE_RESCORE:
+                self._score_device(gathered, q)
+            else:
+                for hit, toks in gathered:
+                    hit["_score"] = self._maxsim_score(toks, q)
+            stage_ms = (time.perf_counter() - t0) * 1000.0
+            # per-stage insights attribution (ISSUE 15 recorder): the
+            # rerank stage is its own shape class next to the retrieve
+            # stage's body shape, so the multi-stage cost budget splits
+            from opensearch_tpu.telemetry import TELEMETRY
+            ins = TELEMETRY.insights.gate()
+            if ins is not None:
+                ins.note(f"rescore_maxsim:{self.field}",
+                         kind="rerank_stage", took_ms=stage_ms,
+                         device_ms=stage_ms if MAXSIM_DEVICE_RESCORE
+                         else 0.0, co_batched=len(gathered))
         hits.sort(key=lambda h: -(h.get("_score") or 0.0))
         response["hits"]["hits"] = hits
         if hits and hits[0].get("_score") is not None:
@@ -316,6 +535,7 @@ RESPONSE_PROCESSORS = {
     RenameFieldProcessor.type_name: RenameFieldProcessor,
     TruncateHitsProcessor.type_name: TruncateHitsProcessor,
     RescoreKnnProcessor.type_name: RescoreKnnProcessor,
+    RescoreMaxSimProcessor.type_name: RescoreMaxSimProcessor,
 }
 
 PHASE_RESULTS_PROCESSORS = {

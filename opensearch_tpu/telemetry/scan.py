@@ -69,6 +69,22 @@ def plan_scan_blocks(plan) -> int:
     return cached
 
 
+def plan_scan_extra(plan) -> int:
+    """Total extra-class bytes a compiled plan tree scans — the sum of
+    each node's `scan_extra` static (rank_vectors token-matrix / PQ-code
+    bytes the maxsim kernels walk, recorded by compile.py). Memoized
+    like plan_scan_blocks; plans without the field cost one getattr."""
+    cached = getattr(plan, "_scan_extra_total", None)
+    if cached is None:
+        cached = getattr(plan, "scan_extra", 0) + sum(
+            plan_scan_extra(c) for c in plan.children)
+        try:
+            plan._scan_extra_total = cached
+        except AttributeError:      # frozen/slotted plan variants
+            pass
+    return cached
+
+
 class ScanAccounting:
     """Node-wide scanned-bytes aggregates + the per-shard heat map."""
 

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import math
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -176,6 +176,42 @@ def ref_knn_l2_score(doc_vec: Sequence[float],
     d2 = sum((float(a) - float(b)) ** 2
              for a, b in zip(doc_vec, query_vec))
     return 1.0 / (1.0 + d2)
+
+
+def ref_maxsim_scores(segment_docs: Sequence[Sequence[Optional[Sequence[Sequence[float]]]]],
+                      query_vectors: Sequence[Sequence[float]],
+                      k: int) -> List[Dict[Tuple[int, int], float]]:
+    """Pure-Python late-interaction MaxSim oracle (ISSUE 18).
+
+    `segment_docs`: per segment, per doc ord, the doc's token vectors
+    (list of [dims] lists) or None when the doc has no rank_vectors
+    value (such docs never match — the exists mask). Empty token lists
+    behave like None. `query_vectors`: [Tq][dims].
+
+    Returns one {(seg_idx, doc_ord): score} dict per segment holding
+    that segment's top-k matches, scored with numpy float32 arithmetic
+    in the same reduction order as ops/maxsim.exact_maxsim_scores
+    (token dots -> max over doc tokens -> sum over query tokens), so
+    the executor's responses agree to f32 precision. Cross-segment
+    merge is the caller's concern — exactly like the executor, where
+    ops/topk.value_merge_key handles it."""
+    import numpy as np
+    q = np.asarray(query_vectors, dtype=np.float32)
+    out: List[Dict[Tuple[int, int], float]] = []
+    for seg_idx, docs in enumerate(segment_docs):
+        scored = []
+        for ord_, toks in enumerate(docs):
+            if toks is None or len(toks) == 0:
+                continue
+            mat = np.asarray(toks, dtype=np.float32)
+            dots = mat @ q.T                       # [T, Tq], f32
+            score = np.float32(0.0)
+            for t in range(q.shape[0]):            # sum over query tokens
+                score = np.float32(score + dots[:, t].max())
+            scored.append((ord_, float(score)))
+        scored.sort(key=lambda e: (-e[1], e[0]))   # stable: ties by ord
+        out.append({(seg_idx, ord_): s for ord_, s in scored[:k]})
+    return out
 
 
 class RefField:

@@ -518,3 +518,66 @@ def test_page_cli_end_to_end(tmp_path):
     bad_p = _write(tmp_path / "p_bad.json", bad)
     assert bench_compare.main(["bench_compare.py", old_p, new_p]) == 0
     assert bench_compare.main(["bench_compare.py", old_p, bad_p]) == 1
+
+
+# ------------------------------------------- late-interaction maxsim gate
+
+
+MAXSIM_OLD = {
+    "maxsim": {"mode": "maxsim", "metric": "maxsim_qps_10k_64d_tpu",
+               "value": 600, "warm_p50_ms": 1.5, "recall_at_10": 1.0},
+    "maxsim_pq": {"mode": "maxsim_pq",
+                  "metric": "maxsim_pq_qps_10k_64d_tpu",
+                  "value": 500, "warm_p50_ms": 2.0,
+                  "recall_at_10": 0.97, "recall_vs_exact": 0.97},
+}
+
+
+def test_maxsim_recall_regression_fails():
+    worse = {k: dict(v, recall_at_10=v["recall_at_10"] - 0.05)
+             for k, v in MAXSIM_OLD.items()}
+    worse["maxsim_pq"]["recall_vs_exact"] = 0.96  # floor still clear
+    rows, failures = bench_compare.compare_maxsim(
+        MAXSIM_OLD, worse, 10.0)
+    assert failures and any("RECALL-REGRESSION" == r["status"]
+                            for r in rows)
+
+
+def test_maxsim_recall_within_drop_ok():
+    near = {k: dict(v, recall_at_10=v["recall_at_10"] - 0.01)
+            for k, v in MAXSIM_OLD.items()}
+    near["maxsim_pq"]["recall_vs_exact"] = 0.96
+    rows, failures = bench_compare.compare_maxsim(MAXSIM_OLD, near, 10.0)
+    assert not failures and all(r["status"] == "ok" for r in rows)
+
+
+def test_maxsim_pq_floor_fails_unconditionally():
+    # even vs an old round that had already slipped below the floor
+    slipped = {k: dict(v) for k, v in MAXSIM_OLD.items()}
+    slipped["maxsim_pq"].update(recall_at_10=0.90, recall_vs_exact=0.90)
+    rows, failures = bench_compare.compare_maxsim(
+        slipped, slipped, 10.0)
+    assert failures and any(r["status"] == "PQ-RECALL-FLOOR"
+                            for r in rows)
+
+
+def test_maxsim_new_only_reports_never_fails():
+    rows, failures = bench_compare.compare_maxsim({}, MAXSIM_OLD, 10.0)
+    assert not failures and all(r["status"] == "new-only" for r in rows)
+
+
+def test_maxsim_warm_latency_rides_generic_gate():
+    slow = {k: dict(v, warm_p50_ms=v["warm_p50_ms"] * 3)
+            for k, v in MAXSIM_OLD.items()}
+    rows, failures = bench_compare.compare(MAXSIM_OLD, slow, 10.0)
+    assert failures
+
+
+def test_maxsim_cli_end_to_end(tmp_path):
+    old_p = _write(tmp_path / "mx_old.json", list(MAXSIM_OLD.values()))
+    bad = [dict(v, recall_at_10=0.8, recall_vs_exact=0.8)
+           if v["mode"] == "maxsim_pq" else dict(v)
+           for v in MAXSIM_OLD.values()]
+    bad_p = _write(tmp_path / "mx_bad.json", bad)
+    assert bench_compare.main(["bench_compare.py", old_p, old_p]) == 0
+    assert bench_compare.main(["bench_compare.py", old_p, bad_p]) == 1

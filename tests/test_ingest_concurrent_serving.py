@@ -677,3 +677,68 @@ class TestChurnReportTool:
         p3 = tmp_path / "empty.json"
         p3.write_text("{}")
         assert churn_report.main(["churn_report.py", str(p3)]) == 2
+
+
+# ------------------------------------- rank_vectors churn (ISSUE 18 satellite)
+
+
+class TestRankVectorsChurn:
+    """A refresh that publishes a rank_vectors segment rides the same
+    churn-ledger + precompile contract as the lexical fields: barrier
+    mode's verdict covers the MaxSim executables too, so serving MaxSim
+    queries across churn pays zero serving-thread compiles."""
+
+    MS_MAPPING = {"properties": {
+        "title": {"type": "text"},
+        "tok": {"type": "rank_vectors", "dimension": 8, "max_tokens": 8},
+    }}
+
+    def _ms_shard(self):
+        return IndexShard(0, MapperService(self.MS_MAPPING),
+                          index_name=f"msc_{uuid.uuid4().hex[:6]}")
+
+    def _doc(self, rng, i):
+        return {"title": f"alpha seed {i}",
+                "tok": rng.randn(4, 8).round(3).tolist()}
+
+    def test_refresh_records_churn_and_barrier_covers_maxsim(self):
+        ch = TELEMETRY.churn
+        ch.enabled = True
+        ch.reset()
+        PRECOMPILE.set_enabled(True)
+        PRECOMPILE.barrier = True
+        try:
+            rng = np.random.RandomState(40)
+            shard = self._ms_shard()
+            for i in range(16):
+                shard.index_doc(f"s{i}", self._doc(rng, i))
+            shard.refresh()
+            ex = shard.executor
+            q = rng.randn(3, 8).round(3).tolist()
+            body = {"query": {"maxsim": {"tok": {
+                "query_vectors": q, "k": 5}}}, "size": 5}
+            base = _hits(ex, body)             # register + compile shape
+            assert base[0] > 0
+            miss = TELEMETRY.metrics.counter("search.xla_cache_miss")
+            m0 = miss.value
+            for batch in range(2):
+                for i in range(4):
+                    shard.index_doc(f"b{batch}_{i}",
+                                    self._doc(rng, 100 + i))
+                shard.refresh()
+                got = _hits(ex, body)
+                assert got[0] >= base[0]
+            t = ch.snapshot()["totals"]
+            assert t["recompile_on_serve"] == 0
+            assert miss.value == m0, \
+                "a MaxSim serving-thread compile slipped past the barrier"
+            recs = ch.records()
+            assert any(r["kind"] == "refresh" for r in recs)
+            by = [r.get("precompiled_by") for r in recs
+                  if r["verdict"] == "precompiled"]
+            assert "barrier" in by
+        finally:
+            PRECOMPILE.set_enabled(False)
+            PRECOMPILE.barrier = False
+            ch.enabled = False
+            ch.reset()

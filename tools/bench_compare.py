@@ -58,6 +58,13 @@ SCALING_EFFICIENCY_PCT = 15.0
 INSIGHTS_P99_PCT = 15.0
 INSIGHTS_MIN_COUNT = 20
 
+# the late-interaction gate (ISSUE 18): at EQUAL config key, MaxSim
+# recall@10 may not drop by more than this (absolute) between rounds,
+# and the PQ arm's recall-vs-exact must clear the committed floor on
+# the new side unconditionally (BENCH_MAXSIM_r01.json acceptance)
+MAXSIM_RECALL_DROP = 0.02
+MAXSIM_PQ_RECALL_FLOOR = 0.95
+
 
 def load_records(path: str) -> Dict[str, dict]:
     """file of JSON lines (or one JSON array) → {config key: record}."""
@@ -546,6 +553,74 @@ def compare_insights(old: Dict[str, dict], new: Dict[str, dict],
     return rows, failures
 
 
+def _maxsim_records(recs: Dict[str, dict]) -> Dict[str, dict]:
+    """The MaxSim shape (BENCH_MAXSIM_*.json): records carrying a
+    recall_at_10 field with a maxsim mode key."""
+    return {k: r for k, r in recs.items()
+            if r.get("mode") in ("maxsim", "maxsim_pq")
+            and isinstance(r.get("recall_at_10"), (int, float))}
+
+
+def compare_maxsim(old: Dict[str, dict], new: Dict[str, dict],
+                   threshold_pct: float) -> Tuple[List[dict], List[str]]:
+    """Gate the late-interaction tier (ISSUE 18) on RECALL, not just
+    latency (the warm p50/p99 side rides the generic gate above):
+
+    - at equal config key, recall@10 may not drop by more than
+      MAXSIM_RECALL_DROP absolute between rounds — "the kernel got
+      faster by returning worse top-k" fails the run;
+    - the PQ arm's recall_vs_exact must clear MAXSIM_PQ_RECALL_FLOOR on
+      the NEW side unconditionally (the committed acceptance bound) —
+      a quantizer regression fails even against an old round that had
+      already slipped."""
+    del threshold_pct
+    o_recs, n_recs = _maxsim_records(old), _maxsim_records(new)
+    rows, failures = [], []
+    for key in sorted(n_recs):
+        n = n_recs[key]
+        o = o_recs.get(key)
+        row = {"config": key,
+               "old_recall_at_10": o.get("recall_at_10")
+               if o is not None else None,
+               "new_recall_at_10": n["recall_at_10"]}
+        status = "ok"
+        rve = n.get("recall_vs_exact")
+        if isinstance(rve, (int, float)):
+            row["recall_vs_exact"] = rve
+            if rve < MAXSIM_PQ_RECALL_FLOOR:
+                status = "PQ-RECALL-FLOOR"
+                failures.append(
+                    f"{key}: PQ recall_vs_exact {rve} below the "
+                    f"committed floor {MAXSIM_PQ_RECALL_FLOOR}")
+        if o is not None and status == "ok":
+            drop = float(o["recall_at_10"]) - float(n["recall_at_10"])
+            row["recall_drop"] = round(drop, 4)
+            if drop > MAXSIM_RECALL_DROP:
+                status = "RECALL-REGRESSION"
+                failures.append(
+                    f"{key}: recall@10 {o['recall_at_10']} -> "
+                    f"{n['recall_at_10']} (dropped {drop:.4f} > "
+                    f"{MAXSIM_RECALL_DROP:g} at equal config key)")
+        elif o is None:
+            row["recall_drop"] = None
+        row["status"] = status if o is not None or status != "ok" \
+            else "new-only"
+        rows.append(row)
+    return rows, failures
+
+
+def render_maxsim(rows: List[dict]) -> str:
+    headers = ["config", "old_recall_at_10", "new_recall_at_10",
+               "recall_drop", "recall_vs_exact", "status"]
+    table = [headers] + [[str(r.get(h, "-")) for h in headers]
+                         for r in rows]
+    widths = [max(len(row[i]) for row in table)
+              for i in range(len(headers))]
+    return "\n".join(
+        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+        for row in table)
+
+
 def render_page(rows: List[dict]) -> str:
     headers = ["config", "result_page", "round_trips_per_wave",
                "old_d2h_bytes_per_wave", "new_d2h_bytes_per_wave",
@@ -673,6 +748,12 @@ def main(argv: List[str]) -> int:
               "key):")
         print(render_insights(in_rows))
         failures += in_failures
+    mx_rows, mx_failures = compare_maxsim(old, new, threshold)
+    if mx_rows:
+        print("\nlate-interaction maxsim (recall@10 at equal config "
+              "key / PQ recall-vs-exact floor):")
+        print(render_maxsim(mx_rows))
+        failures += mx_failures
     if failures:
         print(f"\nFAIL: {len(failures)} regression(s) "
               f"(warm p50/p99 beyond {threshold:g}% / overload "

@@ -62,6 +62,8 @@ QUERY_PATH_FILES = (
     "opensearch_tpu/indices/request_cache.py",
     "opensearch_tpu/parallel/distributed.py",
     "opensearch_tpu/searchpipeline/hybrid.py",
+    "opensearch_tpu/searchpipeline/processors.py",
+    "opensearch_tpu/ops/maxsim.py",
     "opensearch_tpu/telemetry/ledger.py",
     "opensearch_tpu/rest/actions.py",
 )

@@ -106,6 +106,11 @@ GATED_SUBSYSTEMS = (
     # single-round-trip result page (ISSUE 17): OFF by default — the
     # legacy multi-channel collect is the pristine path
     ("opensearch_tpu/search/executor.py", None, "RESULT_PAGE", ()),
+    # ISSUE 18 late-interaction rerank: the device-scoring arm of
+    # rescore_maxsim is OFF by default — the pristine rerank path is
+    # the host numpy mirror (same f32 math, no device dispatch)
+    ("opensearch_tpu/searchpipeline/processors.py", None,
+     "MAXSIM_DEVICE_RESCORE", ()),
 )
 
 # no-op constants a disabled gate may return
