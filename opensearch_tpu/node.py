@@ -159,6 +159,14 @@ class Node:
             # disables tracing)
             return False if raw is None else _parse_bool(raw, key)
 
+        def _tel_float(key: str):
+            raw = self.settings.get(key)
+            return None if raw is None else float(raw)
+
+        def _tel_int(key: str):
+            raw = self.settings.get(key)
+            return None if raw is None else int(raw)
+
         _tail_thr = self.settings.get("telemetry.tail.threshold_ms")
         TELEMETRY.configure(
             data_path=data_path,
@@ -184,7 +192,17 @@ class Node:
             # query insights (ISSUE 15): per-shape cost attribution +
             # top-N heavy-query registry, OFF by default like every
             # other gate (POST /_insights/_enable at runtime)
-            insights=_tel_bool("telemetry.insights.enabled"))
+            insights=_tel_bool("telemetry.insights.enabled"),
+            # kernel profiler (ISSUE 19): sampled per-family device
+            # walls OFF by default (the executable census is always-on
+            # and takes no setting); roofline peaks are plain floats so
+            # a TPU node states its real ridge point
+            kernels=_tel_bool("telemetry.kernels.enabled"),
+            kernels_peak_flops=_tel_float(
+                "telemetry.kernels.peak_flops"),
+            kernels_peak_bw=_tel_float("telemetry.kernels.peak_bw"),
+            kernels_sample_every=_tel_int(
+                "telemetry.kernels.sample_every"))
         self.controller = RestController()
         from opensearch_tpu.rest.actions import register_all
         register_all(self)

@@ -20,7 +20,6 @@ Layout:
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass, field as dc_field
 from typing import Dict, Optional, Tuple
 
@@ -295,16 +294,39 @@ def _compact_spec(seg: Segment, meta: DeviceSegmentMeta) -> Dict[tuple, tuple]:
     return spec
 
 
-@functools.lru_cache(maxsize=1024)
+_EXPAND_CACHE: Dict[tuple, object] = {}
+
+
 def _expand_fn(compact_shape: tuple, full_shape: tuple, fill, dtype_str: str):
     """Compiled on-device expansion: fill-pad a compact prefix block out
     to the padded bucket shape. Cached per (shapes, fill, dtype) family —
     compact extents are power-of-two bucketed by the caller so this stays
-    a bounded set of executables, not one per document count."""
+    a bounded set of executables, not one per document count.
+
+    The explicit miss/hit split (vs the old lru_cache) exists for the
+    compile-event discipline (ISSUE 19): the MISS returns the shared
+    first-call timer — so the expander's XLA compile reaches
+    `search.xla_compile_ms` / `xla_cache_miss` and the executable
+    census like every executor jit site — while hits return the raw
+    executable, paying nothing."""
+    key = (compact_shape, full_shape, fill, dtype_str)
+    fn = _EXPAND_CACHE.get(key)
+    if fn is not None:
+        return fn
+
     def expand(x):
         out = jnp.full(full_shape, fill, dtype=dtype_str)
         return out.at[tuple(slice(0, s) for s in compact_shape)].set(x)
-    return jax.jit(expand)
+
+    fn = jax.jit(expand)
+    _EXPAND_CACHE[key] = fn  # shared-state-ok: benign double-jit race; dict slot write is GIL-atomic
+    from opensearch_tpu.telemetry.kernels import timed_first_call
+    nbytes = float(np.prod(full_shape)) * np.dtype(dtype_str).itemsize \
+        if full_shape else float(np.dtype(dtype_str).itemsize)
+    return timed_first_call(
+        fn, family="expand",
+        shape="x".join(str(s) for s in full_shape) or "scalar", key=key,
+        cost=(float(np.prod(full_shape) if full_shape else 1), nbytes))
 
 
 def _delta_tree(host, spec: Dict[tuple, tuple], transferred: list,

@@ -131,8 +131,18 @@ def _kmeans(vectors: np.ndarray, nlist: int, iters: int = 10,
 
     data = jnp.asarray(vectors, dtype=jnp.float32)
     centroids = jnp.asarray(init, dtype=jnp.float32)
-    for _ in range(iters):
-        centroids = step(data, centroids)
+    # the first step call pays the XLA compile for this (shape, nlist)
+    # — routed through the shared first-call timer (ISSUE 19) so the
+    # compile reaches `search.xla_compile_ms` and the executable census
+    # like every executor jit site; the remaining iters call the raw fn
+    from opensearch_tpu.telemetry.kernels import timed_first_call
+    first = timed_first_call(
+        step, family="knn",
+        shape=f"n{data.shape[0]}/d{data.shape[1]}/c{nlist}",
+        key=("kmeans", data.shape, nlist))
+    for it in range(iters):
+        centroids = first(data, centroids) if it == 0 \
+            else step(data, centroids)
     return np.asarray(centroids)
 
 

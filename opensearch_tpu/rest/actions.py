@@ -2537,6 +2537,59 @@ def register_telemetry_actions(node, c):
         TELEMETRY.insights.clear()
         return {"acknowledged": True}
 
+    def do_get_kernels(req):
+        # kernel-level device-compute profiler (ISSUE 19): the
+        # executable census (always-on), per-family sampled device
+        # walls and the roofline table — tools/kernel_report.py input
+        return {"kernels": TELEMETRY.kernels.snapshot()}
+
+    def do_kernels_enable(req):
+        k = TELEMETRY.kernels
+        every = req.param("sample_every")
+        if every is not None:
+            try:
+                k.sample_every = max(1, int(every))
+            except (TypeError, ValueError):
+                raise IllegalArgumentError(
+                    f"failed to parse [sample_every] with value "
+                    f"[{every!r}]")
+        k.enabled = True
+        return {"acknowledged": True, "enabled": True,
+                "sample_every": k.sample_every}
+
+    def do_kernels_disable(req):
+        TELEMETRY.kernels.enabled = False
+        return {"acknowledged": True, "enabled": False}
+
+    def do_kernels_clear(req):
+        TELEMETRY.kernels.clear()
+        return {"acknowledged": True}
+
+    def do_telemetry_index(req):
+        # the gate index (ISSUE 19 satellite): every gated subsystem's
+        # enabled state + its REST face in one response — operators see
+        # which of the ten gates are on without probing each endpoint
+        from opensearch_tpu.common import faults
+        subsystems = {
+            "tracer": (TELEMETRY.tracer.enabled, "/_telemetry/traces"),
+            "transfers": (TELEMETRY.ledger.enabled,
+                          "/_telemetry/transfers"),
+            "devices": (TELEMETRY.device_ledger.enabled,
+                        "/_telemetry/devices"),
+            "tail": (TELEMETRY.flight.enabled, "/_telemetry/tail"),
+            "ingest": (TELEMETRY.ingest.enabled, "/_telemetry/ingest"),
+            "churn": (TELEMETRY.churn.enabled, "/_telemetry/ingest"),
+            "insights": (TELEMETRY.insights.enabled, "/_insights"),
+            "scheduler": (getattr(getattr(node, "wave_scheduler", None),
+                                  "enabled", False), "/_scheduler"),
+            "faults": (faults.ENABLED, "/_fault_injection"),
+            "kernels": (TELEMETRY.kernels.enabled,
+                        "/_telemetry/kernels"),
+        }
+        return {"subsystems": {
+            name: {"enabled": bool(enabled), "endpoint": ep}
+            for name, (enabled, ep) in subsystems.items()}}
+
     def do_get_devices(req):
         # sharded-serving observability (ISSUE 14): per-device
         # transfer/phase aggregates + straggler skew, next to the
@@ -2589,6 +2642,12 @@ def register_telemetry_actions(node, c):
     c.register("POST", "/_telemetry/devices/_disable",
                do_devices_disable)
     c.register("POST", "/_telemetry/devices/_clear", do_devices_clear)
+    c.register("GET", "/_telemetry", do_telemetry_index)
+    c.register("GET", "/_telemetry/kernels", do_get_kernels)
+    c.register("POST", "/_telemetry/kernels/_enable", do_kernels_enable)
+    c.register("POST", "/_telemetry/kernels/_disable",
+               do_kernels_disable)
+    c.register("POST", "/_telemetry/kernels/_clear", do_kernels_clear)
     c.register("GET", "/_insights", do_get_insights)
     c.register("GET", "/_insights/top_queries", do_top_queries)
     c.register("POST", "/_insights/_enable", do_insights_enable)

@@ -568,67 +568,71 @@ _DEVMEM.add_provider(
     lambda: {"entries": len(_JIT_CACHE)})
 
 
-# per-THREAD compile accounting for request attribution: the XLA compile
-# happens synchronously on the dispatching thread during the wrapped
-# first call, so a thread-local is the correct request scope here (the
-# explicit-context rule exists for the msearch envelope's B>1 fan-in,
-# which this is not — one query phase runs start-to-finish on one thread)
-_THREAD_COMPILES = threading.local()
+# per-THREAD compile accounting + the first-call compile timer moved to
+# telemetry/kernels.py (ISSUE 19) so the ops-layer jit sites (knn
+# k-means, delta-publish expanders) share one census wrapper without an
+# import cycle; the executor names stay as aliases — warmup.py and the
+# ingest-serving tests import them from here
+from opensearch_tpu.telemetry.kernels import (  # noqa: E402
+    THREAD_COMPILES as _THREAD_COMPILES, note_compile as _note_compile,
+    offpath_compiles, timed_first_call as _timed_first_call)
+
+# kernel profiler handle (ISSUE 19): census registration is always-on
+# (compile-time only); the sampled dispatch timer rides the gate
+_KERNELS = TELEMETRY.kernels
 
 
-def _note_compile(ms: float) -> None:
-    from opensearch_tpu.telemetry import TELEMETRY
-    m = TELEMETRY.metrics
-    if getattr(_THREAD_COMPILES, "offpath", False):
-        # precompiler replay thread (ISSUE 16): the compile happened
-        # OFF the serving path — it must not count as a serving-thread
-        # cache miss (the steady-state assertion is `xla_cache_miss`
-        # delta == 0 under ingest), but stays visible under its own name
-        m.counter("search.xla_compile_offpath").inc()
-        m.histogram("search.xla_compile_ms").observe(ms)
-    else:
-        m.counter("search.xla_cache_miss").inc()
-        m.histogram("search.xla_compile_ms").observe(ms)
-        # a serving thread paid the cliff: flip any pending `recompile`
-        # churn verdicts to `recompile-on-serve` (gated internally —
-        # disabled ledger costs one attribute load + branch)
-        _CHURN.note_serve_compile()
-    if getattr(_THREAD_COMPILES, "active", False):
-        _THREAD_COMPILES.count += 1
-        _THREAD_COMPILES.ms += ms
+def _plan_family(plan: Plan, agg_plans=()) -> str:
+    """Kernel-family label for one compiled plan tree (the census/
+    timing vocabulary, telemetry/kernels.py): vector leaves win (their
+    kernels dominate the program), then the agg envelope, then the
+    dense BM25 kernel build_query_phase lowers to."""
+    def walk(p):
+        if p.kind == "knn":
+            return "knn"
+        if p.kind == "maxsim":
+            comp = p.static[2] if len(p.static) > 2 else None
+            return "maxsim_adc" if comp == "pq" else "maxsim"
+        for c in p.children:
+            f = walk(c)
+            if f is not None:
+                return f
+        return None
+    fam = walk(plan)
+    if fam is not None:
+        return fam
+    return "agg_env" if agg_plans else "bm25_dense"
 
 
-@contextmanager
-def offpath_compiles():
-    """Mark this thread's XLA compiles as OFF-PATH (the precompiler's
-    replay, search/warmup.py Precompiler): _note_compile routes them to
-    `search.xla_compile_offpath` instead of `search.xla_cache_miss`, so
-    background compilation never pollutes the serving-thread compile
-    counters a bench or operator watches for the first-touch cliff."""
-    prev = getattr(_THREAD_COMPILES, "offpath", False)
-    _THREAD_COMPILES.offpath = True
-    try:
-        yield
-    finally:
-        _THREAD_COMPILES.offpath = prev
+def _layout_batch(layout) -> int:
+    """Batch rows of a packed envelope layout (every stacked leaf shares
+    the padded batch axis)."""
+    for _off, shape, _dt in layout:
+        if shape:
+            return int(shape[0])
+    return 0
 
 
-def _timed_first_call(fn):
-    """Wrap a freshly jitted group program so its FIRST invocation — where
-    jax traces, lowers and XLA-compiles synchronously before the async
-    execution dispatch — is timed and recorded as a compile event
-    (`search.xla_cache_miss` counter + `search.xla_compile_ms` histogram,
-    plus the current thread's request attribution). Only the miss
-    occurrence gets the wrapper; cache hits return the raw jitted fn, so
-    the steady state pays nothing."""
+def _env_shape(layout, k: int, meta) -> str:
+    """Shape-bucket string for an envelope executable: padded batch,
+    top-k and the segment's padded doc axis — the axes the compile key
+    buckets on."""
+    return f"b{_layout_batch(layout)}/k{k}/d{meta.d_pad}"
 
-    def first(*args):
-        t0 = time.perf_counter_ns()
-        out = fn(*args)
-        _note_compile((time.perf_counter_ns() - t0) / 1e6)
-        return out
 
-    return first
+def _plan_cost(plan: Plan, meta, batch: int = 1):
+    """Analytic (flops, bytes) fallback for the census when the backend
+    exposes no cost model: the scan formulas (telemetry/scan.py) give
+    the bytes the kernel touches; flops are estimated at 2 ops per f32
+    lane (one multiply-add) — coarse, but roofline-stable, and marked
+    `cost_source: analytic` so readers know the provenance."""
+    from opensearch_tpu.telemetry.scan import (
+        DENSE_LANE_BYTES, POSTING_BLOCK_BYTES, plan_scan_blocks,
+        plan_scan_extra)
+    per_row = (plan_scan_blocks(plan) * POSTING_BLOCK_BYTES
+               + meta.d_pad * DENSE_LANE_BYTES + plan_scan_extra(plan))
+    nbytes = float(per_row * max(1, batch))
+    return nbytes / 4.0 * 2.0, nbytes
 
 # msearch phase accounting (?profile analog for the batch path): per-batch
 # milliseconds land in the always-on telemetry metrics registry as
@@ -1552,7 +1556,14 @@ def _agg_envelope_runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta,
         fn = jax.jit(build_batched_agg_query_phase(
             plan, meta, k, layout, treedef, axes, agg_plans))
         _JIT_CACHE[key] = (fn, out_layout, width)  # shared-state-ok: benign double-jit race; dict slot write is GIL-atomic
-        hit = (_timed_first_call(fn), out_layout, width)
+        wrapped = _timed_first_call(
+            fn, family="agg_env", shape=_env_shape(layout, k, meta),
+            key=key, cost=_plan_cost(plan, meta, _layout_batch(layout)))
+        return (wrapped, out_layout, width)
+    kp = _KERNELS.gate()
+    if kp is not None:
+        return (kp.timed(hit[0], "agg_env", _env_shape(layout, k, meta)),
+                hit[1], hit[2])
     return hit
 
 
@@ -1596,14 +1607,23 @@ def _envelope_runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int,
             if len(shape) == 2:         # first [B, QB] leaf
                 qb128 = shape[1] * 128
                 break
-        if _candidate_kernel_fits(plan.kind, n_terms, qb128):
+        cand = _candidate_kernel_fits(plan.kind, n_terms, qb128)
+        if cand:
             fn = jax.jit(build_candidate_query_phase(plan, meta, k,
                                                      layout, treedef))
         else:
             fn = jax.jit(build_batched_query_phase(plan, meta, k,
                                                    layout, treedef))
         _JIT_CACHE[key] = fn  # shared-state-ok: benign double-jit race; dict slot write is GIL-atomic
-        fn = _timed_first_call(fn)
+        fam = "bm25_candidate" if cand else _plan_family(plan)
+        return _timed_first_call(
+            fn, family=fam, shape=_env_shape(layout, k, meta), key=key,
+            cost=_plan_cost(plan, meta, _layout_batch(layout)))
+    kp = _KERNELS.gate()
+    if kp is not None:
+        fam = "bm25_candidate" \
+            if _envelope_kernel(plan) == "candidate" else _plan_family(plan)
+        return kp.timed(fn, fam, _env_shape(layout, k, meta))
     return fn
 
 
@@ -1664,10 +1684,17 @@ def _runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int, sort_mode: st
            tuple(a.sig() for a in agg_plans))
     fn = _JIT_CACHE.get(key)
     if fn is not None:
+        kp = _KERNELS.gate()
+        if kp is not None:
+            return kp.timed(fn, _plan_family(plan, agg_plans),
+                            f"k{k}/d{meta.d_pad}/{sort_mode}")
         return fn
     fn = jax.jit(build_query_phase(plan, meta, k, sort_mode, agg_plans))
     _JIT_CACHE[key] = fn  # shared-state-ok: benign double-jit race; dict slot write is GIL-atomic
-    return _timed_first_call(fn)
+    return _timed_first_call(
+        fn, family=_plan_family(plan, agg_plans),
+        shape=f"k{k}/d{meta.d_pad}/{sort_mode}", key=key,
+        cost=_plan_cost(plan, meta))
 
 
 def build_hybrid_query_phase(plans, meta: DeviceSegmentMeta, k: int):
@@ -1748,7 +1775,15 @@ def _batched_hybrid_runner(plans, meta: DeviceSegmentMeta, k: int,
         fn = jax.jit(build_batched_hybrid_query_phase(plans, meta, k,
                                                       layout, treedef))
         _JIT_CACHE[key] = fn  # shared-state-ok: benign double-jit race; dict slot write is GIL-atomic
-        fn = _timed_first_call(fn)
+        cost = [_plan_cost(p, meta, _layout_batch(layout))
+                for p in plans]
+        return _timed_first_call(
+            fn, family="hybrid_env", shape=_env_shape(layout, k, meta),
+            key=key, cost=(sum(c[0] for c in cost),
+                           sum(c[1] for c in cost)))
+    kp = _KERNELS.gate()
+    if kp is not None:
+        return kp.timed(fn, "hybrid_env", _env_shape(layout, k, meta))
     return fn
 
 
@@ -1932,6 +1967,10 @@ def _page_merger(sig, mode, k_page: int, stride: int, seg_statics,
     device column refs and returns ONE packed int32 page."""
     fn = _JIT_CACHE.get(sig)
     if fn is not None:
+        kp = _KERNELS.gate()
+        if kp is not None:
+            return kp.timed(fn, "page_merger",
+                            f"k{k_page}/s{stride}/n{len(seg_statics)}")
         return fn
     field_mode = mode[0] == "field"
     order = mode[2] if field_mode else None
@@ -1991,7 +2030,9 @@ def _page_merger(sig, mode, k_page: int, stride: int, seg_statics,
 
     fn = jax.jit(run)
     _JIT_CACHE[sig] = fn  # shared-state-ok: benign double-jit race; dict slot write is GIL-atomic
-    return _timed_first_call(fn)
+    return _timed_first_call(
+        fn, family="page_merger",
+        shape=f"k{k_page}/s{stride}/n{len(seg_statics)}", key=sig)
 
 
 class _Candidate:
@@ -2223,6 +2264,12 @@ class SearchExecutor:
             plan_scan_blocks, plan_scan_extra)
         scan_shard = str(getattr(self.reader, "shard_id", 0))
         q_posting = q_dense = 0
+        # kernel-family attribution (ISSUE 19): resolved from the first
+        # compiled plan only when a consumer wants it — the insights
+        # per-shape breakdown, the profiler, or a recording trace (the
+        # Profile API's per-shard `kernels` entry)
+        q_family = None
+        _want_family = rec or _INSIGHTS.enabled or _KERNELS.enabled
         from opensearch_tpu.indices.query_cache import FilterCacheContext
         for seg_i, (seg, (arrays, meta)) in enumerate(
                 zip(segments, device)):
@@ -2237,6 +2284,8 @@ class SearchExecutor:
                                      meta, compiler) if agg_nodes else []
             if rec:
                 plan_compile_ns += time.perf_counter_ns() - t0
+            if _want_family and q_family is None:
+                q_family = _plan_family(plan, agg_plans)
             # always-on scan accounting (telemetry/scan.py, ISSUE 14):
             # this path runs the DENSE kernel (build_query_phase) —
             # posting blocks gathered per the plan statics plus the
@@ -2295,6 +2344,10 @@ class SearchExecutor:
                 # the heat map just counted, accumulated thread-locally
                 # for the controller's per-shape note at request end
                 ins.add_scan(q_posting, q_dense)
+                if q_family is not None:
+                    # kernel-family join (ISSUE 19): same thread-local
+                    # carry, read back by _note_controller_insights
+                    ins.add_family(q_family)
 
         page_args = None
         if page_rows is not None and launched:
@@ -2340,6 +2393,19 @@ class SearchExecutor:
                 trace.set_attribute("bytes_to_device", scope.h2d_bytes)
                 trace.set_attribute("bytes_fetched", scope.d2h_bytes)
                 trace.set_attribute("transfers", scope.to_list())
+                if q_family is not None:
+                    # Profile API per-shard kernel attribution (ISSUE
+                    # 19): the shard's device wall against the family
+                    # that owns its program (+ the page merger when the
+                    # single-round-trip page assembled the response)
+                    fams = [q_family]
+                    if page_args is not None:
+                        fams.append("page_merger")
+                    trace.set_attribute("kernels", [
+                        {"family": f,
+                         "device_ms": round(
+                             scope.device_get_ms / len(fams), 3)}
+                        for f in fams])
                 trace.set_attribute("compiled", xla_compiles > 0)
                 if xla_compiles:
                     trace.set_attribute("xla_compiles", xla_compiles)
@@ -3108,15 +3174,22 @@ class SearchExecutor:
                 if ins is None:
                     continue
                 status = "error" if "error" in resp else "ok"
+                item_dev = dev_share if in_split else 0.0
                 ins.note(
                     m["label"], kind=m["kind"],
                     took_ms=float(resp.get("took", 0))
                     if status == "ok" else 0.0,
-                    device_ms=dev_share if in_split else 0.0,
+                    device_ms=item_dev,
                     posting_bytes=m["posting"],
                     dense_bytes=m["dense"],
                     h2d_bytes=eh, d2h_bytes=ed, round_trips=er,
                     co_batched=co,
+                    # kernel-family breakdown (ISSUE 19): the item's
+                    # device-wall share against the family its group
+                    # program dispatched — the per-shape dominant-kernel
+                    # join GET /_insights/top_queries surfaces
+                    kernels={m["family"]: item_dev}
+                    if item_dev and m.get("family") else None,
                     # warm=None (hybrid) = no bundle verdict exists:
                     # count neither compiled nor warm
                     compiled=m["warm"] is False,
@@ -3332,7 +3405,8 @@ class SearchExecutor:
                 ins_items[i] = {
                     "label": structural_shape(body.get("query")),
                     "kind": "hash", "posting": 0, "dense": 0,
-                    "grouped": True, "warm": None, "interned": False}
+                    "grouped": True, "warm": None, "interned": False,
+                    "family": "hybrid_env"}
             struct = tuple(
                 tuple(p.sig() for p in plans) if plans is not None
                 else None for plans in plans_per_seg)
@@ -3719,10 +3793,22 @@ class SearchExecutor:
                 sp, sd = _scan_per_query[-1] \
                     if len(_scan_per_query) > n_scan0 else (0, 0)
                 label, kind = _item_shape(node, body)
+                plan0 = next((p for p in plans if p is not None), None)
+                fam = None
+                if plan0 is not None:
+                    # kernel family for the insights breakdown (ISSUE
+                    # 19): agg-bearing items dispatch the agg envelope;
+                    # plain items the candidate/dense kernel the runner
+                    # will pick (same predicate)
+                    fam = "agg_env" if agg_nodes else (
+                        "bm25_candidate"
+                        if _envelope_kernel(plan0) == "candidate"
+                        else _plan_family(plan0))
                 ins_items[i] = {"label": label, "kind": kind,
                                 "posting": sp, "dense": sd,
                                 "grouped": True, "warm": bundle_hit,
-                                "interned": tpl is not None}
+                                "interned": tpl is not None,
+                                "family": fam}
 
         from opensearch_tpu.telemetry.scan import SCAN
         SCAN.note_batch(self.reader.index_name,

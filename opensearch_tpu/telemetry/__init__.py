@@ -47,6 +47,7 @@ from opensearch_tpu.telemetry.lifecycle import (
     INGEST_EVENTS, FlightRecorder, IngestEventLog, IngestRecorder,
     SpmdTimeline, Timeline)
 from opensearch_tpu.telemetry.insights import INSIGHTS, QueryInsights
+from opensearch_tpu.telemetry.kernels import KERNELS, KernelProfiler
 from opensearch_tpu.telemetry.metrics import MetricsRegistry
 from opensearch_tpu.telemetry.rolling import RollingEstimator
 from opensearch_tpu.telemetry.scan import SCAN, ScanAccounting
@@ -59,7 +60,8 @@ __all__ = ["TELEMETRY", "TelemetryService", "Span", "NOOP_SPAN",
            "FlightRecorder", "Timeline", "IngestRecorder",
            "IngestEventLog", "INGEST_EVENTS", "ChurnLedger",
            "ChurnScope", "DeviceLedger", "DeviceScope", "SpmdTimeline",
-           "ScanAccounting", "SCAN", "QueryInsights", "INSIGHTS"]
+           "ScanAccounting", "SCAN", "QueryInsights", "INSIGHTS",
+           "KernelProfiler", "KERNELS"]
 
 
 class TelemetryService:
@@ -92,6 +94,10 @@ class TelemetryService:
         # None-returning gate() — the "which queries cost what" join
         # over interning + lifecycle + scan + ledger
         self.insights = INSIGHTS
+        # kernel profiler (ISSUE 19): executable census (always-on,
+        # compile-time-only writes) + gated sampled device walls +
+        # roofline classification per kernel family
+        self.kernels = KERNELS
 
     def configure(self, data_path: Optional[str] = None,
                   enabled: bool = False, jsonl: bool = False,
@@ -101,7 +107,11 @@ class TelemetryService:
                   ingest: bool = False, churn: bool = False,
                   devices: bool = False,
                   spmd_timeline: bool = False,
-                  insights: bool = False) -> None:
+                  insights: bool = False,
+                  kernels: bool = False,
+                  kernels_peak_flops: Optional[float] = None,
+                  kernels_peak_bw: Optional[float] = None,
+                  kernels_sample_every: Optional[int] = None) -> None:
         """Bind to a node's settings/data dir. Called from Node.__init__;
         re-configuration by a later Node in the same process wins (the
         singleton is process-wide, like WARMUP)."""
@@ -114,6 +124,13 @@ class TelemetryService:
         self.device_ledger.enabled = bool(devices)
         self.spmd_timeline.enabled = bool(spmd_timeline)
         self.insights.enabled = bool(insights)
+        self.kernels.enabled = bool(kernels)
+        if kernels_peak_flops is not None:
+            self.kernels.peak_flops = float(kernels_peak_flops)
+        if kernels_peak_bw is not None:
+            self.kernels.peak_bw = float(kernels_peak_bw)
+        if kernels_sample_every is not None:
+            self.kernels.sample_every = max(1, int(kernels_sample_every))
         self.tracer.resize(ring_size)
         self.tracer.jsonl_path = None
         self.flight.jsonl_path = None
@@ -151,7 +168,11 @@ class TelemetryService:
                 "scan": self.scan.stats(),
                 # query insights (ISSUE 15): per-shape cost attribution
                 # (the top-N rings ride GET /_insights, not this block)
-                "insights": self.insights.snapshot()}
+                "insights": self.insights.snapshot(),
+                # kernel profiler (ISSUE 19): executable census +
+                # per-family device-ms/roofline (compact — the full
+                # census dump rides GET /_telemetry/kernels)
+                "kernels": self.kernels.stats()}
 
 
 # process-wide singleton, like REQUEST_CACHE / QUERY_CACHE / WARMUP

@@ -85,6 +85,7 @@ def shape_rows(ins: dict, sort_key: str = "device_ms_total") \
             "warm": r.get("warm_hits", 0),
             "compiled": r.get("compiled", 0),
             "cached": r.get("cached", 0),
+            "kernel": r.get("dominant_kernel") or "-",
             "_scan_bytes": scan,
             "took_total_ms": round(float(r.get("took_total_ms", 0)), 1),
             "device_ms_total": float(r.get("device_ms_total", 0)),
@@ -97,7 +98,7 @@ def shape_rows(ins: dict, sort_key: str = "device_ms_total") \
 def render_shapes(rows: List[dict]) -> str:
     cols = ["shape", "kind", "count", "p50_ms", "p99_ms", "device_ms",
             "scan_kb", "transfer_kb", "co_batch", "warm", "compiled",
-            "cached"]
+            "cached", "kernel"]
     return _render([{c: r.get(c) for c in cols} for r in rows], cols)
 
 

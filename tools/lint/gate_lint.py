@@ -111,6 +111,13 @@ GATED_SUBSYSTEMS = (
     # the host numpy mirror (same f32 math, no device dispatch)
     ("opensearch_tpu/searchpipeline/processors.py", None,
      "MAXSIM_DEVICE_RESCORE", ()),
+    # ISSUE 19 kernel profiler: the sampled-dispatch timer is OFF by
+    # default behind a None-returning gate() — disabled, executables
+    # return UNWRAPPED (no timer closure); the executable census is
+    # always-on but writes only at compile time (never on the steady
+    # state), the inflight-wave-gauge contract, not this discipline
+    ("opensearch_tpu/telemetry/kernels.py", "KernelProfiler", "enabled",
+     ("gate",)),
 )
 
 # no-op constants a disabled gate may return
