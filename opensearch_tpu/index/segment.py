@@ -113,6 +113,69 @@ def pad_bucket(n: int, minimum: int = 128) -> int:
     return size
 
 
+# BM25 parameters the seal-time block bounds are computed against. Query-time
+# k1/b/avgdl may differ (per-request similarity overrides, shard-level vs
+# segment-level avgdl); the compiler ships a >=1 correction factor (bscale)
+# derived from these constants, so the bounds stay upper bounds under any
+# query parameters (search/compile.py:_blockmax_scale).
+SEAL_K1 = 1.2
+SEAL_B = 0.75
+
+_BOUNDS_CHUNK_ROWS = 1 << 16    # bound host memory on multi-GB postings
+
+
+def block_score_bounds(seg: "Segment") -> np.ndarray:
+    """Per-posting-block BM25 score upper bounds: max over the block's lanes
+    of tf/(tf + SEAL_K1·(1−SEAL_B+SEAL_B·dl/avgdl)), f32 [NB].
+
+    The block-max skipping invariant (BM25S / Lucene BMW analog): every
+    partial score a query can extract from block X of (field, term) is
+    ≤ w·(k1+1)·bscale·bounds[X], so blocks whose summed upper bound falls
+    below the competitive threshold provably hold no top-k docs. Fields
+    without norms score with b=0 (denominator tf + k1), matching the
+    query-side omit-norms path. Padding lanes (doc -1, tf 0) contribute 0.
+
+    Memoized on the segment: sealed postings are immutable and this scans
+    every lane once (chunked — NB can reach millions of rows at 10M docs).
+    """
+    cached = getattr(seg, "_block_bounds", None)
+    if cached is not None:
+        return cached
+    nb = seg.post_docs.shape[0]
+    bounds = np.zeros(nb, dtype=np.float32)
+    # group the term dict's contiguous block runs by field: the denominator
+    # constant c(dl) = 1−b+b·dl/avgdl is a per-field per-doc vector
+    field_rows: Dict[str, List[np.ndarray]] = {}
+    for (field, _term), tm in seg.term_dict.items():
+        if tm.num_blocks:
+            field_rows.setdefault(field, []).append(
+                np.arange(tm.start_block, tm.start_block + tm.num_blocks,
+                          dtype=np.int64))
+    for field, runs in field_rows.items():
+        norm = seg.norms.get(field)
+        stats = seg.field_stats.get(field)
+        if norm is not None and stats is not None and stats.doc_count > 0:
+            avgdl = max(stats.sum_total_term_freq / stats.doc_count, 1e-9)
+            dl = LENGTH_TABLE[norm]
+            c_doc = (1.0 - SEAL_B + SEAL_B * dl / avgdl).astype(np.float32)
+        else:
+            c_doc = None        # omit-norms field: c ≡ 1
+        rows = np.concatenate(runs)
+        for lo in range(0, len(rows), _BOUNDS_CHUNK_ROWS):
+            chunk = rows[lo:lo + _BOUNDS_CHUNK_ROWS]
+            docs = seg.post_docs[chunk]
+            tfs = seg.post_tf[chunk]
+            if c_doc is None:
+                c = np.float32(1.0)
+            else:
+                c = c_doc[np.where(docs >= 0, docs, 0)]
+            g = tfs / (tfs + np.float32(SEAL_K1) * c)
+            g[docs < 0] = 0.0
+            bounds[chunk] = g.max(axis=1)
+    seg._block_bounds = bounds
+    return bounds
+
+
 # ------------------------------------------------------------ data classes ---
 
 @dataclass

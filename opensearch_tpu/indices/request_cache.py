@@ -93,8 +93,12 @@ def cache_key(segments, body: dict, k: int,
             if extra_filter is not None else None
     except (TypeError, ValueError):
         return None
+    # the block-max gate is node state, not request state, yet it changes
+    # the cached payload (pruned totals are lower bounds, relation "gte")
+    # — a gate flip must miss, not serve the other regime's entry
+    from opensearch_tpu.ops import bm25 as _bm25
     return (tuple((s.uid, s.live_doc_count) for s in segments), req, k,
-            extra)
+            extra, _bm25.BLOCKMAX)
 
 
 # date-math expression relative to evaluation time: "now", "now-1d",

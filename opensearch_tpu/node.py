@@ -118,6 +118,18 @@ class Node:
             from opensearch_tpu.search import executor as _executor_mod
             _executor_mod.RESULT_PAGE = _pb(raw_page,
                                             "search.result_page.enabled")
+        # block-max pruning (ops/bm25.py, ISSUE 20): module-level gate;
+        # the compiler emits tid/bscale plan inputs and the candidate /
+        # SPMD kernels mask non-competitive posting blocks. OFF by
+        # default; node setting here, dynamic via PUT /_cluster/settings
+        # (apply_admission_settings re-applies it — compiled plans memo
+        # on the gate value, so a flip recompiles rather than mis-serves)
+        raw_bm = self.settings.get("search.blockmax.enabled")
+        if raw_bm is not None:
+            from opensearch_tpu.common.settings import \
+                _parse_bool as _pb
+            from opensearch_tpu.ops import bm25 as _bm25_mod
+            _bm25_mod.BLOCKMAX = _pb(raw_bm, "search.blockmax.enabled")
         self.gateway = None
         if data_path is not None:
             from opensearch_tpu.gateway import Gateway
@@ -223,6 +235,15 @@ class Node:
         self.wave_scheduler.apply_settings(merged)
         from opensearch_tpu.search.warmup import PRECOMPILE
         PRECOMPILE.apply_settings(merged)
+        # dynamic block-max gate (ISSUE 20): plan memo keys include the
+        # gate value, so flipped settings produce fresh plans/programs
+        # instead of reusing a mismatched trace
+        raw_bm = merged.get("search.blockmax.enabled")
+        if raw_bm is not None:
+            from opensearch_tpu.common.settings import _parse_bool
+            from opensearch_tpu.ops import bm25 as _bm25_mod
+            _bm25_mod.BLOCKMAX = _parse_bool(raw_bm,
+                                             "search.blockmax.enabled")
 
     def persist_metadata(self):
         """Write node metadata through the gateway (no-op without a data

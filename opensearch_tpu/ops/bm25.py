@@ -20,7 +20,30 @@ from __future__ import annotations
 
 import math
 
+import jax
 import jax.numpy as jnp
+
+# Block-max pruning (ISSUE 20, ROADMAP item 4): skip posting blocks whose
+# seal-time score upper bound cannot reach the query's competitive top-k
+# threshold — the BMW/BM25S family of impact-bounded skipping, rank-exact
+# by construction. OFF by default; flipped by the dynamic node setting
+# `search.blockmax.enabled` (see node.py), never flip inline in library code.
+BLOCKMAX = False
+
+# Phase A derives the competitive threshold from an exactly-scored slice of
+# the highest-bound blocks: top SLICE_BLOCKS blocks by upper bound are fully
+# scored (gather + sort + windowed run-sum), and the k-th best eligible doc
+# score in that slice lower-bounds the true k-th best — every block whose
+# upper bound falls below it is provably beaten.
+BLOCKMAX_SLICE_BLOCKS = 8
+# Clauses touching fewer blocks than this skip phase A entirely (static,
+# host-side admission): the slice would cover most of the postings anyway.
+BLOCKMAX_MIN_BLOCKS = 16
+
+_NEG_INF = jnp.float32(-jnp.inf)
+# min_score above this sentinel means the caller set a real floor (or this is
+# an SPMD padding row with +inf) — pruning is disabled for those rows.
+_MIN_SCORE_OFF = -1e30
 
 
 def idf(doc_count: int, doc_freq: int) -> float:
@@ -28,7 +51,88 @@ def idf(doc_count: int, doc_freq: int) -> float:
     return math.log(1.0 + (doc_count - doc_freq + 0.5) / (doc_freq + 0.5))
 
 
-def score_text_clause(seg, blk, k1):
+def blockmax_keep_mask(seg, blk, k1, n_terms, k, min_score=None):
+    """Phase A of the two-phase block-max kernel: per-block keep mask.
+
+    seg must carry the seal-time `post_bound` leaf (f32 [NBp]: per-block
+    max(tf/(tf+k1_seal*norm))). blk carries, beyond score_text_clause's
+    inputs, `tid` (int32 [QB] query-term index per lane) and `bscale`
+    (f32 scalar: host-computed ceiling on g_query/g_seal over the doc
+    lengths occurring in the segment, so sealed bounds stay upper bounds
+    under the query's own k1/b/avgdl).
+
+    n_terms, k are STATIC python ints (clause term count, top-k depth);
+    callers must statically skip phase A when k > SLICE_BLOCKS*128 or the
+    clause has fewer than BLOCKMAX_MIN_BLOCKS lanes.
+
+    Rank-exactness: ub(block X of term t) = self_ub(X) + sum_{t'!=t} tmax(t')
+    where self_ub = max(w,0)*(k1+1)*bscale*bound upper-bounds the term's
+    partial for any doc in X and tmax(t') that of any other term, so any
+    doc's full score is <= the ub of EVERY block holding one of its
+    postings. theta is the k-th best exact score of an eligible-doc subset,
+    hence <= the true k-th best; `keep = ub >= theta` therefore never drops
+    a block containing a top-k doc, and boundary ties survive strictness.
+
+    Returns (keep bool [QB], pruned int32 scalar — real lanes masked off).
+    """
+    lane_real = blk["ids"] >= 0                            # [QB]
+    safe_ids = jnp.where(lane_real, blk["ids"], 0)
+    safe_tid = jnp.where(lane_real, blk["tid"], 0)
+    w_pos = jnp.maximum(blk["w"], 0.0)
+    self_ub = (w_pos * (k1 + 1.0) * blk["bscale"]
+               * seg["post_bound"][safe_ids])
+    self_ub = jnp.where(lane_real, self_ub, 0.0)           # [QB]
+    # per-term best bound (static loop: n_terms is a compile-time fact)
+    tmax = jnp.stack([
+        jnp.max(jnp.where(lane_real & (blk["tid"] == t), self_ub, 0.0))
+        for t in range(n_terms)])                          # [T]
+    ub = self_ub + (jnp.sum(tmax) - tmax[safe_tid])        # [QB]
+
+    # --- exact-score the top-bound slice to derive theta ---
+    n_slice = min(BLOCKMAX_SLICE_BLOCKS, ub.shape[0])
+    _, sidx = jax.lax.top_k(jnp.where(lane_real, ub, _NEG_INF), n_slice)
+    s_real = lane_real[sidx]                               # [S]
+    docs = seg["post_docs"][safe_ids[sidx]]                # [S, 128]
+    tfs = seg["post_tf"][safe_ids[sidx]]
+    valid = (docs >= 0) & s_real[:, None]
+    safe_docs = jnp.where(valid, docs, 0)
+    norm_bytes = seg["norms"][blk["row"]][safe_docs]
+    dl = seg["length_table"][norm_bytes]
+    b = blk["b"]
+    denom = tfs + k1 * (1.0 - b + b * dl / blk["avgdl"])
+    partial = blk["w"][sidx][:, None] * tfs * (k1 + 1.0) / denom
+    # theta must come from truly-eligible docs only: deleted/nested docs
+    # could otherwise inflate it past the real k-th best (unsafe)
+    elig0 = valid & seg["live"][safe_docs] & seg["root"][safe_docs]
+    sentinel = jnp.int32(2 ** 31 - 1)
+    flat_docs = jnp.where(elig0, docs, sentinel).ravel()   # [S*128]
+    flat_p = jnp.where(elig0, partial, 0.0).ravel()
+    flat_h = jnp.where(elig0, 1, 0).astype(jnp.int32).ravel()
+    sdocs, sp, sh = jax.lax.sort((flat_docs, flat_p, flat_h), num_keys=1)
+    # per-doc windowed run-sum: a doc appears at most once per term
+    tot, hits = sp, sh
+    for j in range(1, n_terms):
+        same = jnp.concatenate(
+            [sdocs[j:] == sdocs[:-j], jnp.zeros(j, jnp.bool_)])
+        tot = tot + jnp.where(
+            same, jnp.concatenate([sp[j:], jnp.zeros(j, jnp.float32)]), 0.0)
+        hits = hits + jnp.where(
+            same, jnp.concatenate([sh[j:], jnp.zeros(j, jnp.int32)]), 0)
+    head = jnp.concatenate(
+        [jnp.ones(1, jnp.bool_), sdocs[1:] != sdocs[:-1]])
+    elig = head & (sdocs < sentinel) & (hits >= blk["min_hits"])
+    cand = jnp.where(elig, tot, _NEG_INF)
+    theta = jax.lax.top_k(cand, min(k, cand.shape[0]))[0][-1]
+    # fewer than k eligible slice docs -> -inf padding -> no pruning; rows
+    # with a caller-set score floor (incl. SPMD +inf padding rows) never prune
+    if min_score is not None:
+        theta = jnp.where(min_score > _MIN_SCORE_OFF, _NEG_INF, theta)
+    keep = ub >= theta
+    pruned = jnp.sum((lane_real & ~keep).astype(jnp.int32))
+    return keep, pruned
+
+
+def score_text_clause(seg, blk, k1, block_keep=None):
     """Score one text clause (match / term / terms over one field family).
 
     seg: device segment dict (post_docs, post_tf, norms, length_table).
@@ -45,11 +149,19 @@ def score_text_clause(seg, blk, k1):
     Clause constants are SCALARS (one field per clause): per-lane data is
     only (ids, w), which halves the msearch envelope bytes per query.
 
+    block_keep: optional bool [QB] phase-A mask (blockmax_keep_mask): pruned
+    lanes gather the shared row 0 instead of streaming their posting block
+    and contribute nothing downstream. Rank-exact for top-k pages; the hit
+    count (hence `total`) becomes a lower bound, mirroring Lucene BMW under
+    track_total_hits.
+
     Returns (scores f32 [Dp], hits int32 [Dp]) — hits counts distinct matched
     clause terms per doc, powering operator=and / minimum_should_match.
     """
     d_pad = seg["live"].shape[0]
     lane_real = blk["ids"] >= 0                  # [QB]
+    if block_keep is not None:
+        lane_real = lane_real & block_keep
     safe_ids = jnp.where(lane_real, blk["ids"], 0)
     docs = seg["post_docs"][safe_ids]            # [QB, 128]
     tfs = seg["post_tf"][safe_ids]               # [QB, 128]

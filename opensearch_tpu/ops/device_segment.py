@@ -27,7 +27,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from opensearch_tpu.index.segment import LENGTH_TABLE, Segment, pad_bucket
+from opensearch_tpu.index.segment import (LENGTH_TABLE, Segment,
+                                          block_score_bounds, pad_bucket)
 
 INT32_MAX = np.int32(2 ** 31 - 1)
 _F32_MAX = float(np.finfo(np.float32).max)
@@ -65,6 +66,12 @@ class DeviceSegmentMeta:
     # token bucket and storage variant are executable-shaping facts, so
     # they live in the compile key, not just the runtime array shapes
     rank_vector_fields: Tuple[Tuple[str, int, str], ...] = ()
+    # seal-time per-block score bounds leaf (ISSUE 20 block-max pruning):
+    # always present in the image ([nb_pad] f32 rides next to the block
+    # metadata, ~0.4% of the postings bytes) so flipping the query-time
+    # gate never forces a re-upload; part of the compile key because the
+    # leaf's existence shapes every traced program's input tree
+    block_bounds: bool = True
 
     def norm_row(self, field: str) -> Optional[int]:
         for f, r in self.norm_rows:
@@ -84,7 +91,8 @@ class DeviceSegmentMeta:
         publish without cold recompiles)."""
         return (self.num_docs, self.d_pad, self.nb_pad, self.norm_rows,
                 self.numeric_fields, self.ordinal_fields,
-                self.vector_fields, self.rank_vector_fields)
+                self.vector_fields, self.rank_vector_fields,
+                self.block_bounds)
 
 
 def upload_segment(seg: Segment, to_device: bool = True):
@@ -97,6 +105,10 @@ def upload_segment(seg: Segment, to_device: bool = True):
     post_docs[:nb] = seg.post_docs
     post_tf = np.zeros((nb_pad, seg.post_tf.shape[1]), dtype=np.float32)
     post_tf[:nb] = seg.post_tf
+    # seal-time per-block score upper bounds (block-max pruning, ISSUE 20):
+    # [nb_pad] f32 next to the block matrices; padding blocks bound 0
+    post_bound = np.zeros(nb_pad, dtype=np.float32)
+    post_bound[:nb] = block_score_bounds(seg)
 
     norm_fields = sorted(seg.norms.keys())
     norms = np.zeros((max(len(norm_fields), 1), d_pad), dtype=np.int32)
@@ -123,6 +135,7 @@ def upload_segment(seg: Segment, to_device: bool = True):
     arrays: Dict = {
         "post_docs": post_docs,
         "post_tf": post_tf,
+        "post_bound": post_bound,
         "norms": norms,
         "length_table": LENGTH_TABLE,
         "live": live,
@@ -258,6 +271,7 @@ def _compact_spec(seg: Segment, meta: DeviceSegmentMeta) -> Dict[tuple, tuple]:
     spec: Dict[tuple, tuple] = {
         ("post_docs",): ((nb, nd), -1),
         ("post_tf",): ((nb, nd), 0.0),
+        ("post_bound",): ((nb,), 0.0),
         ("norms",): ((None, nd), 0),
         ("live",): ((nd,), False),
         ("root",): ((nd,), False),

@@ -87,10 +87,33 @@ def _device_put_sharded_tree(tree, mesh: Mesh, axis: str,
     put = [jax.device_put(np.asarray(l), sharding) for l in leaves]
     return jax.tree_util.tree_unflatten(treedef, put)
 
+from opensearch_tpu.ops import bm25 as _bm25
+from opensearch_tpu.ops.bm25 import blockmax_keep_mask, score_text_clause
 from opensearch_tpu.ops.topk import NEG_INF, value_merge_key
 from opensearch_tpu.search.compile import Plan
 from opensearch_tpu.search.plan_eval import _eval_plan
 from opensearch_tpu.search.aggs.engine import eval_aggs
+
+
+def spmd_blockmax_admitted(plan: Plan, meta, k: int, sort_spec,
+                           agg_plans) -> bool:
+    """Block-max admission for the SPMD program (ISSUE 20): a pure
+    function of facts already in the runner cache key — plan structure
+    covers kind/static/input names (the compiler only emits "tid" when
+    the gate was on at compile time), _tree_shapes covers the block
+    count, meta carries block_bounds, and k/sort_spec/agg arity are key
+    components, so admission never needs its own key part. Only single
+    bare text clauses prune: a nested or bool context has no per-clause
+    competitive threshold, and sorts/aggs consume non-top-k docs the
+    mask would hide. Per-row pruning against the row-local k_eff
+    threshold stays rank-exact for the merged page (see one_row)."""
+    k_eff = min(k, meta.d_pad)
+    return (plan.kind == "text" and len(plan.static) > 1
+            and not plan.static[0] and "tid" in plan.inputs
+            and sort_spec is None and not agg_plans
+            and getattr(meta, "block_bounds", False)
+            and 0 < k_eff <= _bm25.BLOCKMAX_SLICE_BLOCKS * 128
+            and plan.inputs["ids"].shape[-1] >= _bm25.BLOCKMAX_MIN_BLOCKS)
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "shards") -> Mesh:
@@ -315,10 +338,31 @@ class DistributedSearcher:
         rpd = rows_per_dev
         k_local = min(k, rpd * k_eff)
         k_merge = min(k, self.n_shards * k_local)
+        bm = spmd_blockmax_admitted(plan, meta, k, sort_spec, agg_plans)
+        n_terms = plan.static[1] if bm else 0
 
         def one_row(seg, flat_inputs, min_score):
             cursor = [0]
-            scores, matches = _eval_plan(plan, seg, flat_inputs, cursor)
+            if bm:
+                # block-max fast path: identical to _eval_plan's text
+                # branch (search/plan_eval.py) except non-competitive
+                # posting blocks are masked out of the gather. Per-row
+                # pruning stays rank-exact for the merged page: a global
+                # top-k doc is beaten by fewer than k docs overall, hence
+                # by fewer than k_eff in its own row, so it survives the
+                # row-local threshold. Padding rows carry min_score=+inf,
+                # which blockmax_keep_mask treats as prune-disable.
+                cursor[0] = 1
+                my = flat_inputs[0]
+                keep, pruned = blockmax_keep_mask(
+                    seg, my, my["k1"], n_terms, k_eff, min_score)
+                scores, hits = score_text_clause(seg, my, my["k1"],
+                                                 block_keep=keep)
+                matches = hits >= my["min_hits"]
+                scores = jnp.where(matches, scores, 0.0)
+            else:
+                pruned = jnp.int32(0)
+                scores, matches = _eval_plan(plan, seg, flat_inputs, cursor)
             # `live` is False on padding rows (ops/device_segment.py), so no
             # per-shard num_docs mask is needed — metas stay shape-only here.
             eligible = matches & seg["live"] & seg["root"] \
@@ -348,12 +392,12 @@ class DistributedSearcher:
                 eval_aggs(list(agg_plans), seg, flat_inputs, cursor, eligible,
                           agg_outs)
             return (top_keys, top_scores, top_idx.astype(jnp.int32),
-                    local_total, agg_outs)
+                    local_total, pruned, agg_outs)
 
         def local_query_phase(seg, flat_inputs, min_scores):
             # block shape: [rpd, ...] rows packed on this device
-            tk, ts, ti, tot, agg_outs = jax.vmap(one_row)(seg, flat_inputs,
-                                                          min_scores)
+            tk, ts, ti, tot, prn, agg_outs = jax.vmap(one_row)(
+                seg, flat_inputs, min_scores)
             shard_i = jax.lax.axis_index(axis)
             row_ids = shard_i * rpd + jnp.arange(rpd, dtype=jnp.int32)
             gids = row_ids[:, None] * d_pad + ti            # [rpd, k]
@@ -371,14 +415,16 @@ class DistributedSearcher:
             mg = gg[mi]
             ms = gs[mi]
             total = jax.lax.psum(jnp.sum(tot), axis)
-            return mk, ms, mg, total, agg_outs
+            # per-row pruned-block counts stay sharded ([rpd] per device →
+            # [R_pad]); rows without block-max admission report 0
+            return mk, ms, mg, total, prn, agg_outs
 
         in_specs = (P(axis), P(axis), P(axis))
         # eval_aggs appends one output dict per node in traversal order
         # (children included), not one per top-level plan; vmapped rows
         # keep a leading [rpd] axis that P(axis) concatenates to [R_pad]
         n_agg_outs = sum(_count_agg_nodes(a) for a in agg_plans)
-        out_specs = (P(), P(), P(), P(), [P(axis)] * n_agg_outs)
+        out_specs = (P(), P(), P(), P(), P(axis), [P(axis)] * n_agg_outs)
         fn = jax.jit(_shard_map(
             local_query_phase, mesh=self.mesh,
             in_specs=in_specs, out_specs=out_specs))
@@ -411,7 +457,7 @@ class DistributedSearcher:
                         k: int, min_score: float = float(NEG_INF),
                         agg_plans: Tuple = (),
                         sort_spec: Optional[Tuple[str, str]] = None,
-                        device_scope=None):
+                        device_scope=None, return_pruned: bool = False):
         """Run the distributed query phase against HBM-resident segments:
         only the flat plan inputs (query constants — term ids, weights,
         range bounds) travel host→device per query.
@@ -435,7 +481,9 @@ class DistributedSearcher:
         local_ords [<=k], total, per-row agg partial outputs). Agg
         partials keep a leading row dimension; the caller decodes each
         row's slice with that row's own agg plans (ordinal spaces are
-        segment-local)."""
+        segment-local). With return_pruned=True a 7th element is
+        appended: per-row pruned posting-block counts [n_rows] (int32,
+        all zeros unless block-max pruning was admitted — ISSUE 20)."""
         if len(flat_inputs) != shard_set.n_rows:
             raise ValueError(
                 f"{len(flat_inputs)} flat-input lists for a "
@@ -491,7 +539,7 @@ class DistributedSearcher:
             # scheduler budgets against — only the conversions below
             # (which block on compute + transfer, like the executor's
             # device_get) are the collect wall
-            keys, scores, gids, total, agg_outs = fn(
+            keys, scores, gids, total, pruned_rows, agg_outs = fn(
                 shard_set.seg_stack, flat_stack, min_stack)
             # ONE post-dispatch clock (t0) for both the per-chip walls
             # and note_device_get below: a cold call's synchronous XLA
@@ -545,8 +593,10 @@ class DistributedSearcher:
             scores = np.asarray(scores)
             gids = np.asarray(gids)
             total = int(total)
+            pruned_rows = np.asarray(pruned_rows)
             agg_outs = jax.tree_util.tree_map(np.asarray, agg_outs)
-        nb = keys.nbytes + scores.nbytes + gids.nbytes + 8 + sum(
+        nb = keys.nbytes + scores.nbytes + gids.nbytes + 8 \
+            + pruned_rows.nbytes + sum(
             a.nbytes for a in jax.tree_util.tree_leaves(agg_outs)) \
             if (accounting or device_scope is not None) else 0
         pull_dev = int(self.mesh.devices.flatten()[0].id)
@@ -566,8 +616,11 @@ class DistributedSearcher:
         row_idx = gids // meta.d_pad
         ords = gids % meta.d_pad
         valid = keys > NEG_INF / 2
-        return (keys[valid], scores[valid], row_idx[valid], ords[valid],
+        base = (keys[valid], scores[valid], row_idx[valid], ords[valid],
                 total, agg_outs)
+        if return_pruned:
+            return base + (pruned_rows[:shard_set.n_rows],)
+        return base
 
 
 def canonical_meta(metas: Sequence[Any]):

@@ -23,7 +23,9 @@ import numpy as np
 from opensearch_tpu.common.errors import (
     IllegalArgumentError, ParsingError, QueryShardError)
 from opensearch_tpu.index.mapper import MapperService, MappedFieldType
-from opensearch_tpu.index.segment import LENGTH_TABLE, Segment, pad_bucket
+from opensearch_tpu.index.segment import (LENGTH_TABLE, SEAL_B, SEAL_K1,
+                                          Segment, pad_bucket)
+from opensearch_tpu.ops import bm25 as _bm25
 from opensearch_tpu.ops.bm25 import idf as bm25_idf
 from opensearch_tpu.ops.device_segment import DeviceSegmentMeta
 from opensearch_tpu.search import dsl
@@ -746,7 +748,7 @@ class Compiler:
         # reuse their built Plan: arrays are read-only downstream (stacking
         # and jnp.asarray copy), so sharing is safe
         memo_key = ("tc", seg.uid, field, tuple(weighted_terms), min_hits,
-                    boost, constant, k1, b)
+                    boost, constant, k1, b, _bm25.BLOCKMAX)
         cached = self.stats.memo.get(memo_key)
         if cached is not None:
             return cached
@@ -759,14 +761,15 @@ class Compiler:
         # (norms row, avgdl, b) are scalars — one field per clause — which
         # shrinks both compile work and the msearch envelope bytes that
         # cross the host↔device link per query
-        ids, ws = [], []
-        for term, w in weighted_terms:
+        ids, ws, tids = [], [], []
+        for t_i, (term, w) in enumerate(weighted_terms):
             tm = seg.get_term(field, term)
             if tm is None:
                 continue
             for blk_i in range(tm.start_block, tm.start_block + tm.num_blocks):
                 ids.append(blk_i)
                 ws.append(w)
+                tids.append(t_i)
         qb = pad_bucket(max(len(ids), 1), minimum=8)
         pad = qb - len(ids)
         inputs = {
@@ -779,6 +782,13 @@ class Compiler:
             "min_hits": _i32(min_hits),
             "boost": _f32(boost),
         }
+        if _bm25.BLOCKMAX:
+            # phase-A extras ride as traced inputs, NOT in the compile key:
+            # bscale is a per-segment float and must not fracture the
+            # executable sharing the churn pin depends on
+            inputs["tid"] = _i32(tids + [0] * pad)
+            inputs["bscale"] = _f32(
+                self._blockmax_scale(seg, field, k1, b_eff, avgdl))
         # static records the distinct-term count: the candidate-buffer
         # kernel needs the max run length (= clause terms containing a doc)
         # to window its exact segment-sum (executor.py)
@@ -786,6 +796,35 @@ class Compiler:
                     inputs=inputs, scan_blocks=len(ids))
         self.stats.memo[memo_key] = plan    # RotatingMemo bounds itself
         return plan
+
+    def _blockmax_scale(self, seg: Segment, field: str, k1: float,
+                        b_eff: float, avgdl: float) -> float:
+        """Ceiling on g_query/g_seal over the doc lengths actually occurring
+        in the segment's field, where g = tf/(tf + k1*c(dl)). Seal-time
+        bounds were computed under SEAL_K1/SEAL_B and the segment's own
+        avgdl; scaling by this factor keeps them upper bounds under the
+        query's (k1, b, live cross-segment avgdl). Uses (tf+A)/(tf+B) <=
+        max(1, A/B) for tf >= 0."""
+        key = ("bms", seg.uid, field, k1, b_eff, avgdl)
+        cached = self.stats.memo.get(key)
+        if cached is not None:
+            return cached
+        norm = seg.norms.get(field)
+        fstats = seg.field_stats.get(field)
+        k1_q = max(k1, 1e-9)
+        if norm is None or fstats is None or fstats.doc_count <= 0:
+            # seal used c ≡ 1 for norm-less fields; query-side b_eff is 0
+            scale = max(1.0, SEAL_K1 / k1_q)
+        else:
+            avgdl_s = max(fstats.sum_total_term_freq / fstats.doc_count, 1e-9)
+            occurring = np.flatnonzero(np.bincount(norm, minlength=256))
+            dl = LENGTH_TABLE[occurring].astype(np.float64)
+            c_s = 1.0 - SEAL_B + SEAL_B * dl / avgdl_s
+            c_q = 1.0 - b_eff + b_eff * dl / (avgdl if avgdl > 0 else 1.0)
+            ratio = (SEAL_K1 * c_s) / np.maximum(k1_q * c_q, 1e-9)
+            scale = float(max(1.0, ratio.max()))
+        self.stats.memo[key] = scale
+        return scale
 
     def _analyze_query_terms(self, ft: MappedFieldType, text: Any,
                              analyzer_override: Optional[str] = None) -> List[str]:

@@ -259,7 +259,7 @@ def _note_controller_insights(query_spec, took_ms, req_scope) -> None:
         tl.shape = label
     if ins is None:
         return
-    sp, sd = ins.take_scan()
+    sp, sd, spr = ins.take_scan()
     dev_ms = req_scope.device_get_ms if req_scope is not None else 0.0
     # kernel-family join (ISSUE 19): the families the query phase
     # recorded on this thread, each charged an even share of the
@@ -270,7 +270,7 @@ def _note_controller_insights(query_spec, took_ms, req_scope) -> None:
     ins.note(
         label, kind=kind, took_ms=float(took_ms),
         device_ms=dev_ms,
-        posting_bytes=sp, dense_bytes=sd,
+        posting_bytes=sp, dense_bytes=sd, pruned_bytes=spr,
         h2d_bytes=req_scope.h2d_bytes if req_scope is not None else 0,
         d2h_bytes=req_scope.d2h_bytes if req_scope is not None else 0,
         round_trips=req_scope.round_trips
@@ -556,6 +556,7 @@ def _execute_search_impl(executors: List, body: Optional[dict],
     from opensearch_tpu.search.canmatch import shard_can_match
     flags_box: List = [None]
     skipped_box = [0]
+    pruned_box = [0]    # SPMD block-max pruned bytes: total -> "gte"
 
     def can_match_flags():
         if flags_box[0] is None:
@@ -585,6 +586,7 @@ def _execute_search_impl(executors: List, body: Optional[dict],
         profile_shards.clear()
         shard_failures.clear()      # k-growth retries re-run the phase
         failed_shard_ids.clear()
+        pruned_box[0] = 0           # last phase run decides the relation
         # SPMD path: with multiple (shard, segment) rows and enough mesh
         # devices, the query phase is ONE shard_map program with on-chip
         # all_gather/psum merge instead of a host loop (search/spmd.py).
@@ -629,7 +631,10 @@ def _execute_search_impl(executors: List, body: Optional[dict],
                     # failure isolation is per shard
                     out = None
             if out is not None:
-                candidates, decoded_partials, total = out
+                candidates, decoded_partials, total, spmd_pruned = out
+                # block-max pruning made `total` a lower bound: the
+                # response's hits.total.relation degrades to "gte"
+                pruned_box[0] = spmd_pruned
                 with _PhaseTimer(trace, phases, "reduce"):
                     candidates.sort(key=_compare_candidates(sort_specs))
                 if profiling:
@@ -819,10 +824,14 @@ def _execute_search_impl(executors: List, body: Optional[dict],
 
     n_shards = total_shards if total_shards is not None else len(executors)
     hits_block: dict = {"max_score": max_score, "hits": hits}
+    # block-max pruning (ISSUE 20): pruned blocks' docs were never
+    # counted, so `total` is a lower bound — "eq" degrades to "gte"
+    # (the contract Lucene's BMW collector keeps via track_total_hits)
+    exact_rel = "eq" if not pruned_box[0] else "gte"
     if track_total is False:
         pass  # total omitted entirely
     elif track_total is True:
-        hits_block = {"total": {"value": total, "relation": "eq"},
+        hits_block = {"total": {"value": total, "relation": exact_rel},
                       **hits_block}
     else:
         threshold = int(track_total)
@@ -830,7 +839,7 @@ def _execute_search_impl(executors: List, body: Optional[dict],
             hits_block = {"total": {"value": threshold, "relation": "gte"},
                           **hits_block}
         else:
-            hits_block = {"total": {"value": total, "relation": "eq"},
+            hits_block = {"total": {"value": total, "relation": exact_rel},
                           **hits_block}
 
     n_failed = failed_shards + len(shard_failures)

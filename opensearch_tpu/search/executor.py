@@ -42,8 +42,10 @@ from opensearch_tpu.common.errors import (
     IllegalArgumentError, OpenSearchTpuError, QueryShardError)
 from opensearch_tpu.index.mapper import MapperService
 from opensearch_tpu.index.segment import Segment, pad_bucket
+from opensearch_tpu.ops import bm25 as _bm25
 from opensearch_tpu.ops.bm25 import (
-    ordinal_terms_match, range_match_on_ranks, score_text_clause)
+    blockmax_keep_mask, ordinal_terms_match, range_match_on_ranks,
+    score_text_clause)
 from opensearch_tpu.ops import device_segment as _devseg
 from opensearch_tpu.ops.device_segment import (
     DeviceSegmentMeta, refresh_live, tree_nbytes, upload_segment)
@@ -982,8 +984,9 @@ def _ledger_packed_rows(scope, pending, fetched, actual_bytes: int,
     rows and combined-fetch column padding both land in `padding` via
     the remainder, so channel bytes sum exactly to the transferred
     total while the decomposition reports payload, not pad."""
-    score_b = id_b = tot_b = agg_b = 0
-    for (idxs, _seg_i, k_seg, _out, _ol), packed in zip(pending, fetched):
+    score_b = id_b = tot_b = agg_b = pruned_b = 0
+    for (idxs, _seg_i, k_seg, _out, _ol, bm), packed in zip(pending,
+                                                            fetched):
         if packed is None:
             continue
         rows = min(len(idxs), packed.shape[0])
@@ -991,11 +994,17 @@ def _ledger_packed_rows(scope, pending, fetched, actual_bytes: int,
         score_b += rows * k_seg * 4
         id_b += rows * k_seg * 4
         tot_b += rows * 4
+        if bm:
+            # blockmax rows carry one trailing pruned-count lane
+            pruned_b += rows * 4
+            width -= 1
         agg_b += rows * max(width - 2 * k_seg - 1, 0) * 4
     wave = _LEDGER.new_wave()
-    pad_b = max(actual_bytes - (score_b + id_b + tot_b + agg_b), 0)
+    pad_b = max(actual_bytes
+                - (score_b + id_b + tot_b + agg_b + pruned_b), 0)
     for channel, b in (("scores", score_b), ("topk_ids", id_b),
                        ("totals", tot_b), ("agg_buffers", agg_b),
+                       ("pruned_counts", pruned_b),
                        ("padding", pad_b)):
         if b:
             _LEDGER.record(channel, "d2h", b, wave=wave,
@@ -1314,7 +1323,7 @@ def _candidate_kernel_fits(kind: str, n_terms: int, qb_lanes: int) -> bool:
 
 
 def build_candidate_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
-                                layout, treedef):
+                                layout, treedef, bm: bool = False):
     """B text queries against one segment, scored in a COMPACT candidate
     buffer instead of a dense per-doc vector.
 
@@ -1339,6 +1348,17 @@ def build_candidate_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
     def one(seg, flat_inputs, min_score):
         my = flat_inputs[0]
         lane_real = my["ids"] >= 0                    # [QB]
+        if bm:
+            # block-max phase A (ISSUE 20): per-block upper bounds vs the
+            # slice-derived competitive threshold. Non-competitive blocks
+            # are redirected to the shared row 0 by the safe_ids gather
+            # below, so they ship no postings; the mask is DATA — every
+            # shape stays static (retrace-lint clean)
+            keep, pruned = blockmax_keep_mask(
+                seg, my, my["k1"], n_terms, k, min_score)
+            lane_real = lane_real & keep
+        else:
+            pruned = jnp.int32(0)
         safe_ids = jnp.where(lane_real, my["ids"], 0)
         docs = seg["post_docs"][safe_ids]             # [QB, 128]
         tfs = seg["post_tf"][safe_ids]
@@ -1394,7 +1414,13 @@ def build_candidate_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
                 [top_scores, jnp.full(k - k_eff, NEG_INF)])
             top_docs = jnp.concatenate(
                 [top_docs, jnp.zeros(k - k_eff, jnp.int32)])
-        return _pack_row(top_scores, top_docs, total)
+        row = _pack_row(top_scores, top_docs, total)
+        if bm:
+            # phase-A popcount rides the SAME packed row the host already
+            # fetches — pruned-block accounting costs no extra round trip
+            row = jnp.concatenate([row, jax.lax.bitcast_convert_type(
+                pruned[None].astype(jnp.int32), jnp.float32)])
+        return row
 
     def run(seg, packed_buf):
         leaves = unpack_leaves(packed_buf, layout)
@@ -1403,6 +1429,25 @@ def build_candidate_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
                                                    leaves[-1])
 
     return run
+
+
+def _blockmax_admitted(plan, k: int) -> bool:
+    """STATIC admission for the two-phase block-max kernel, shared by
+    _envelope_runner (which kernel compiles) and the prepare/finish
+    halves (whether a pruned-count lane exists in the packed row) so
+    the row layout can never drift from the compiled program. A plan
+    qualifies when it was compiled with the gate ON (it carries the
+    phase-A `tid` input — the memo key includes the gate state), is a
+    plain non-constant text clause on the candidate kernel, touches
+    enough blocks to be worth a slice pass, and the slice can actually
+    cover k (theta needs a k-th exact score)."""
+    if plan is None or plan.kind != "text" or plan.static[0] \
+            or "tid" not in plan.inputs:
+        return False   # constant-score: no competitive threshold exists
+    n_blocks = plan.inputs["ids"].shape[-1]
+    return (n_blocks >= _bm25.BLOCKMAX_MIN_BLOCKS
+            and 0 < k <= _bm25.BLOCKMAX_SLICE_BLOCKS * 128
+            and _envelope_kernel(plan) == "candidate")
 
 
 def build_batched_query_phase(plan: Plan, meta: DeviceSegmentMeta, k: int,
@@ -1609,8 +1654,13 @@ def _envelope_runner(plan_sig, plan: Plan, meta: DeviceSegmentMeta, k: int,
                 break
         cand = _candidate_kernel_fits(plan.kind, n_terms, qb128)
         if cand:
-            fn = jax.jit(build_candidate_query_phase(plan, meta, k,
-                                                     layout, treedef))
+            # blockmax admission is a pure function of facts already in
+            # the JIT key: the plan's input tree (treedef gains tid/
+            # bscale only when compiled with the gate on), the layout's
+            # lane count, and k — no extra key component needed
+            fn = jax.jit(build_candidate_query_phase(
+                plan, meta, k, layout, treedef,
+                bm=_blockmax_admitted(plan, k)))
         else:
             fn = jax.jit(build_batched_query_phase(plan, meta, k,
                                                    layout, treedef))
@@ -3182,6 +3232,7 @@ class SearchExecutor:
                     device_ms=item_dev,
                     posting_bytes=m["posting"],
                     dense_bytes=m["dense"],
+                    pruned_bytes=m.get("pruned", 0),
                     h2d_bytes=eh, d2h_bytes=ed, round_trips=er,
                     co_batched=co,
                     # kernel-family breakdown (ISSUE 19): the item's
@@ -3663,6 +3714,9 @@ class SearchExecutor:
         # below — the disabled-lock discipline the <2% gate demands
         _scan_rows: Dict[Any, list] = {}
         _scan_per_query: List = []
+        # per-item STATIC posting bytes, kept for the finish half's
+        # pruned-overlay flush (effective = static - pruned per query)
+        _scan_posting_by_i: Dict[int, int] = {}
         # per-item shape meta (ISSUE 15): shape id + scan bytes + bundle
         # verdict, read back by the wave-merge note pass. Built when the
         # insights recorder wants cost rows OR the flight recorder wants
@@ -3703,8 +3757,11 @@ class SearchExecutor:
                     # general path owns the proper error, per item
                     _general_fallback(i, body)
                     continue
+                # gate in the key: bundles hold compiled plans, and a
+                # blockmax flip changes plan inputs (tid/bscale) — a
+                # stale-gate bundle would prune (or not) the wrong way
                 bkey = ("qenv", mapper_version, tpl.sig, tpl.literals,
-                        agg_json)
+                        agg_json, _bm25.BLOCKMAX)
                 bundle = stats.memo.get(bkey)
                 if isinstance(bundle, _PartialBundle):
                     # pure-append carry (ISSUE 16): compile only the
@@ -3786,6 +3843,8 @@ class SearchExecutor:
             n_scan0 = len(_scan_per_query)
             _scan_accumulate_item(device, plans, _scan_rows,
                                   _scan_per_query)
+            _scan_posting_by_i[i] = _scan_per_query[-1][0] \
+                if len(_scan_per_query) > n_scan0 else 0
             if ins_items is not None:
                 # the per-item scan join (ISSUE 15): the SAME tuple the
                 # always-on heat map just accumulated, so per-shape
@@ -3921,7 +3980,12 @@ class SearchExecutor:
                 # exception out of this loop can never strand bytes
                 wave_buffer_bytes += buf.nbytes
                 staging.append(buf)
-                pending.append((idxs, seg_i, k_seg, out, out_layout))
+                # bm: whether this program's packed rows carry the extra
+                # pruned-count lane — MUST mirror _envelope_runner's
+                # admission (same predicate on the same plan/k)
+                pending.append((idxs, seg_i, k_seg, out, out_layout,
+                                agg_sig is None
+                                and _blockmax_admitted(plan0, k_seg)))
         ph["stack_pack_dispatch"] += time.monotonic() - _t
         return {"groups": groups, "entry_by_i": entry_by_i,
                 "pending": pending, "agg_by_i": agg_by_i,
@@ -3930,6 +3994,7 @@ class SearchExecutor:
                 "wave_buffer_bytes": wave_buffer_bytes,
                 # per-item shape meta for the insights note pass
                 "insights": ins_items,
+                "scan_posting": _scan_posting_by_i,
                 # the wave's (segments, device) anchor: finish resolves
                 # seg_i hits against THIS list, never a later publish
                 "segments": segments}
@@ -3969,18 +4034,17 @@ class SearchExecutor:
                 faults.fire("fetch.gather")
             if len(pending) > 1:
                 combined = np.asarray(jax.device_get(_concat_rows(
-                    tuple(packed for _, _, _, packed, _ in pending))))
+                    tuple(p[3] for p in pending))))
                 fetch_stats[0] = combined.nbytes
                 fetch_stats[1] = 1
                 out = []
                 row = 0
-                for _, _, _, packed, _ in pending:
-                    rows, width = packed.shape
+                for p in pending:
+                    rows, width = p[3].shape
                     out.append(combined[row:row + rows, :width])
                     row += rows
                 return out
-            out = jax.device_get(
-                [packed for _, _, _, packed, _ in pending])
+            out = jax.device_get([p[3] for p in pending])
             fetch_stats[0] = sum(int(np.asarray(a).nbytes) for a in out)
             fetch_stats[1] = 1
             return out
@@ -3995,7 +4059,7 @@ class SearchExecutor:
                 # downgrades only ITS items to error objects
                 fetched = []
                 fetch_stats[0] = fetch_stats[1] = 0
-                for idxs, _seg_i, _k_seg, packed, _ol in pending:
+                for idxs, _seg_i, _k_seg, packed, _ol, _bm in pending:
                     def _one(packed=packed):
                         if faults.ENABLED:
                             faults.fire("fetch.gather")
@@ -4020,14 +4084,36 @@ class SearchExecutor:
         if scope is not None:
             _ledger_packed_rows(scope, pending, fetched, fetch_stats[0],
                                 collect_s * 1000, max(fetch_stats[1], 1))
-        for (idxs, seg_i, k_seg, _, out_layout), packed in zip(pending,
-                                                               fetched):
+        # block-max pruning overlay (ISSUE 20): phase-A popcounts decoded
+        # from the packed rows' trailing lane, flushed once per wave
+        per_query_pruned: Dict[int, int] = {}
+        seg_pruned_bytes: Dict[str, int] = {}
+        bm_items: set = set()
+        wave_segments = state.get("segments")
+        for (idxs, seg_i, k_seg, _, out_layout, bm), packed in zip(pending,
+                                                                   fetched):
             if packed is None:
                 continue            # this program's items are dead
             packed = np.asarray(packed)
             scores_b, idx_b, total_b = unpack_batched_result(
                 packed[:, :2 * k_seg + 1], k_seg)
             totals = total_b.tolist()
+            if bm:
+                from opensearch_tpu.telemetry.scan import \
+                    POSTING_BLOCK_BYTES
+                pruned_b = packed[:, 2 * k_seg + 1].copy().view(np.int32)
+                pruned_rows = pruned_b.tolist()
+                seg_total = 0
+                for row, i in enumerate(idxs):
+                    blocks = int(pruned_rows[row])
+                    per_query_pruned[i] = per_query_pruned.get(i, 0) \
+                        + blocks * POSTING_BLOCK_BYTES
+                    seg_total += blocks
+                    bm_items.add(i)
+                if wave_segments is not None and seg_total:
+                    sid = wave_segments[seg_i].seg_id
+                    seg_pruned_bytes[sid] = seg_pruned_bytes.get(sid, 0) \
+                        + seg_total * POSTING_BLOCK_BYTES
             for row, i in enumerate(idxs):
                 per_query_total[i] += totals[row]
                 per_query_segs[i].append((seg_i, scores_b[row], idx_b[row]))
@@ -4036,6 +4122,22 @@ class SearchExecutor:
                                            out_layout)
                     per_query_decoded[i].append(
                         decode_outputs(agg_by_i[i][seg_i], outs))
+        if bm_items:
+            from opensearch_tpu.telemetry.scan import SCAN
+            scan_posting = state.get("scan_posting") or {}
+            ins_meta = state.get("insights")
+            pq = []
+            for i in sorted(bm_items):
+                pruned = per_query_pruned.get(i, 0)
+                pq.append((scan_posting.get(i, 0), pruned))
+                if ins_meta is not None and i in ins_meta:
+                    # ride the existing insights join so the per-shape
+                    # effective bytes conserve against telemetry.scan
+                    ins_meta[i]["pruned"] = pruned
+            SCAN.note_pruned_batch(
+                self.reader.index_name,
+                str(getattr(self.reader, "shard_id", 0)),
+                seg_pruned_bytes, pq)
 
         took_ms = int((time.monotonic() - start) * 1000)
         segments = state.get("segments")
@@ -4117,6 +4219,12 @@ class SearchExecutor:
                                            page_scores)]
             responses[i] = _base_response(took_ms, per_query_total[i],
                                           max_score, hits)
+            if per_query_pruned.get(i):
+                # pruned blocks never reach the hit-count scatter, so the
+                # total is a lower bound — same "gte" semantics Lucene
+                # BMW reports under track_total_hits. The top-k page
+                # itself stays byte-identical (rank-exact pruning).
+                responses[i]["hits"]["total"]["relation"] = "gte"
             if i in agg_by_i:
                 from opensearch_tpu.search.aggs.pipeline import \
                     apply_pipelines

@@ -192,10 +192,13 @@ def spmd_query_phase(executors: List, body: dict, k: int,
                      rows: List[Tuple[int, int]]):
     """Distributed query phase over all (shard, segment) rows.
 
-    Returns (candidates, decoded_partials, total) shaped exactly like the
-    host loop in controller.execute_search, or None when the compiled
-    plans are not structure-uniform across rows (the program requires one
-    signature; e.g. a per-segment `precomputed` host fallback)."""
+    Returns (candidates, decoded_partials, total, pruned_bytes) — the
+    first three shaped exactly like the host loop in
+    controller.execute_search, pruned_bytes > 0 flagging that block-max
+    pruning fired (total is then a lower bound) — or None when the
+    compiled plans are not structure-uniform across rows (the program
+    requires one signature; e.g. a per-segment `precomputed` host
+    fallback)."""
     from opensearch_tpu.indices.request_cache import (
         REQUEST_CACHE, cache_key, cacheable)
     from opensearch_tpu.search.executor import _Candidate
@@ -218,18 +221,19 @@ def spmd_query_phase(executors: List, body: dict, k: int,
         if key is not None:
             cached = REQUEST_CACHE.get(key)
             if cached is not REQUEST_CACHE._MISS:
-                cts, decoded, total = cached
+                cts, decoded, total, pruned = cached
                 return ([_Candidate(s, g, o, sv, shard_i=si)
-                         for s, g, o, sv, si in cts], decoded, total)
+                         for s, g, o, sv, si in cts], decoded, total,
+                        pruned)
     out = _spmd_query_phase_raw(executors, body, k, extra_filters, rows)
     if out is None:
         return None     # host-loop fallback — never cached
     SPMD_QUERIES.inc()
     if key is not None:
         REQUEST_CACHE.put(key, out)
-    cts, decoded, total = out
+    cts, decoded, total, pruned = out
     return ([_Candidate(s, g, o, sv, shard_i=si)
-             for s, g, o, sv, si in cts], decoded, total)
+             for s, g, o, sv, si in cts], decoded, total, pruned)
 
 
 def _spmd_query_phase_raw(executors: List, body: dict, k: int,
@@ -321,11 +325,11 @@ def _spmd_query_phase_raw(executors: List, body: dict, k: int,
         tl.event("fanout", devices=searcher.n_shards, rows=len(rows))
     try:
         shard_set = _resident_shard_set(searcher, executors, rows)
-        keys, scores, row_idx, ords, total, agg_outs = \
+        keys, scores, row_idx, ords, total, agg_outs, pruned_rows = \
             searcher.search_resident(
                 shard_set, flat_rows, plans[0], k, min_score=min_score,
                 agg_plans=agg_plans_rows[0], sort_spec=sort_spec,
-                device_scope=cap)
+                device_scope=cap, return_pruned=True)
     except (ValueError, KeyError):
         # e.g. a cross-index search whose rows have mismatched field
         # layouts (canonical_meta rejects them) — host loop handles it
@@ -337,22 +341,59 @@ def _spmd_query_phase_raw(executors: List, body: dict, k: int,
     # attributed per (index, shard, segment) and summed per query
     from opensearch_tpu.telemetry.scan import (
         DENSE_LANE_BYTES, POSTING_BLOCK_BYTES, SCAN, plan_scan_blocks)
-    q_posting = q_dense = 0
-    for plan_r, meta_r, (shard_i, seg_i) in zip(plans, row_metas, rows):
+    from opensearch_tpu.parallel.distributed import spmd_blockmax_admitted
+    q_posting = q_dense = q_pruned = 0
+    pruned_by_shard: dict = {}
+    for r, (plan_r, meta_r, (shard_i, seg_i)) in enumerate(
+            zip(plans, row_metas, rows)):
         ex = executors[shard_i]
+        # heat-map shard key: the reader's REAL shard id, not the row's
+        # position in the executors list — the two diverge the moment a
+        # caller passes a sub-list (e.g. routing or a skipped shard),
+        # which used to fold shard 3's bytes into the "0" row
+        shard_key = str(getattr(ex.reader, "shard_id", shard_i))
         posting = plan_scan_blocks(plan_r) * POSTING_BLOCK_BYTES
         dense = meta_r.d_pad * DENSE_LANE_BYTES
-        SCAN.note_segment(ex.reader.index_name, str(shard_i),
+        SCAN.note_segment(ex.reader.index_name, shard_key,
                           meta_r.seg_id, posting, dense, "spmd")
         q_posting += posting
         q_dense += dense
+        # block-max pruning overlay (ISSUE 20): phase-A popcounts ride
+        # the result page as one sharded int32 per row — no extra round
+        # trip; the static accounting above stays the untouched ceiling
+        row_pruned = int(pruned_rows[r]) * POSTING_BLOCK_BYTES
+        if row_pruned:
+            grp = pruned_by_shard.setdefault(
+                (ex.reader.index_name, shard_key), {})
+            grp[meta_r.seg_id] = grp.get(meta_r.seg_id, 0) + row_pruned
+            q_pruned += row_pruned
     SCAN.note_query(q_posting, q_dense)
+    if q_pruned or spmd_blockmax_admitted(plans[0], shard_set.meta, k,
+                                          sort_spec, agg_plans_rows[0]):
+        # the fused program is ONE query: a single per_query entry (on
+        # the first shard call only) feeds the effective distribution —
+        # zero-pruned admitted queries included, so pruned/unpruned
+        # p50s compare like for like; shard/segment attribution lands
+        # per group
+        per_q = [(q_posting, q_pruned)]
+        if pruned_by_shard:
+            for (idx_name, shard_key), seg_pruned \
+                    in pruned_by_shard.items():
+                SCAN.note_pruned_batch(idx_name, shard_key, seg_pruned,
+                                       per_q)
+                per_q = []
+        else:
+            ex0 = executors[rows[0][0]]
+            SCAN.note_pruned_batch(
+                ex0.reader.index_name,
+                str(getattr(ex0.reader, "shard_id", rows[0][0])),
+                {}, per_q)
     from opensearch_tpu.telemetry import TELEMETRY as _TEL
     _ins = _TEL.insights.gate()
     if _ins is not None:
         # the per-request scan join (ISSUE 15): same bytes as the heat
         # map, thread-local, read back by the controller's shape note
-        _ins.add_scan(q_posting, q_dense)
+        _ins.add_scan(q_posting, q_dense, q_pruned)
 
     if cap is not None:
         if tl is not None:
@@ -386,7 +427,10 @@ def _spmd_query_phase_raw(executors: List, body: dict, k: int,
             row_outs = jax.tree_util.tree_map(lambda o: o[r], agg_outs)
             decoded.append(decode_outputs(list(agg_plans_rows[r]),
                                           row_outs))
-    return cand_tuples, decoded, int(total)
+    # q_pruned > 0 makes `total` a lower bound (pruned blocks' docs were
+    # never counted): the caller renders hits.total.relation = "gte",
+    # the same contract Lucene's BMW path keeps via track_total_hits
+    return cand_tuples, decoded, int(total), q_pruned
 
 
 def _resident_shard_set(searcher, executors, rows):

@@ -177,7 +177,7 @@ class _TopN:
 def _new_row(kind: str) -> dict:
     return {"kind": kind, "count": 0, "errors": 0, "cached": 0,
             "took_total_ms": 0.0, "device_ms_total": 0.0,
-            "posting_bytes": 0, "dense_bytes": 0,
+            "posting_bytes": 0, "dense_bytes": 0, "pruned_bytes": 0,
             "h2d_bytes": 0, "d2h_bytes": 0, "round_trips": 0,
             "co_batched_sum": 0, "co_batched_max": 0, "coalesced": 0,
             "compiled": 0, "warm_hits": 0,
@@ -208,6 +208,7 @@ class QueryInsights:
         self.totals = {"queries": 0, "errors": 0, "cached": 0,
                        "took_total_ms": 0.0, "device_ms_total": 0.0,
                        "posting_bytes": 0, "dense_bytes": 0,
+                       "pruned_bytes": 0,
                        "h2d_bytes": 0, "d2h_bytes": 0, "round_trips": 0}
 
     # ------------------------------------------------------------- gating
@@ -235,21 +236,28 @@ class QueryInsights:
     def current_tenant(self) -> Optional[str]:
         return getattr(self._tls, "tenant", None)
 
-    def add_scan(self, posting_bytes: int, dense_bytes: int) -> None:
+    def add_scan(self, posting_bytes: int, dense_bytes: int,
+                 pruned_bytes: int = 0) -> None:
         """Accumulate one query-phase execution's scan bytes for the
         CURRENT request (general host loop / SPMD path — the same
         numbers those paths feed telemetry.scan, so the per-shape join
-        stays byte-exact). Read-and-reset by `take_scan` at the
-        request's note point, same thread."""
+        stays byte-exact). `pruned_bytes`: posting bytes the block-max
+        phase-B mask kept out of the gathers (0 with the gate off).
+        Read-and-reset by `take_scan` at the request's note point, same
+        thread."""
         t = self._tls
         t.scan_p = getattr(t, "scan_p", 0) + int(posting_bytes)
         t.scan_d = getattr(t, "scan_d", 0) + int(dense_bytes)
+        if pruned_bytes:
+            t.scan_pr = getattr(t, "scan_pr", 0) + int(pruned_bytes)
 
-    def take_scan(self) -> Tuple[int, int]:
+    def take_scan(self) -> Tuple[int, int, int]:
         t = self._tls
-        out = (getattr(t, "scan_p", 0), getattr(t, "scan_d", 0))
+        out = (getattr(t, "scan_p", 0), getattr(t, "scan_d", 0),
+               getattr(t, "scan_pr", 0))
         t.scan_p = 0
         t.scan_d = 0
+        t.scan_pr = 0
         return out
 
     def add_family(self, family: str) -> None:
@@ -276,6 +284,7 @@ class QueryInsights:
     def note(self, shape: str, kind: str = "template",
              took_ms: float = 0.0, device_ms: float = 0.0,
              posting_bytes: int = 0, dense_bytes: int = 0,
+             pruned_bytes: int = 0,
              h2d_bytes: int = 0, d2h_bytes: int = 0,
              round_trips: int = 0, co_batched: int = 1,
              compiled: bool = False, warm_hit: bool = False,
@@ -307,6 +316,8 @@ class QueryInsights:
             row["device_ms_total"] += float(device_ms)
             row["posting_bytes"] += int(posting_bytes)
             row["dense_bytes"] += int(dense_bytes)
+            row["pruned_bytes"] = \
+                row.get("pruned_bytes", 0) + int(pruned_bytes)
             row["h2d_bytes"] += int(h2d_bytes)
             row["d2h_bytes"] += int(d2h_bytes)
             row["round_trips"] += int(round_trips)
@@ -334,6 +345,8 @@ class QueryInsights:
             self.totals["device_ms_total"] += float(device_ms)
             self.totals["posting_bytes"] += int(posting_bytes)
             self.totals["dense_bytes"] += int(dense_bytes)
+            self.totals["pruned_bytes"] = \
+                self.totals.get("pruned_bytes", 0) + int(pruned_bytes)
             self.totals["h2d_bytes"] += int(h2d_bytes)
             self.totals["d2h_bytes"] += int(d2h_bytes)
             self.totals["round_trips"] += int(round_trips)
@@ -379,6 +392,11 @@ class QueryInsights:
             "device_p50_ms": dev["p50"],
             "device_p99_ms": dev["p99"],
             "posting_bytes": row["posting_bytes"],
+            # effective = static posting minus block-max pruned bytes;
+            # equal to posting_bytes whenever the gate is off
+            "pruned_bytes": row.get("pruned_bytes", 0),
+            "effective_posting_bytes":
+                row["posting_bytes"] - row.get("pruned_bytes", 0),
             "dense_bytes": row["dense_bytes"],
             "h2d_bytes": row["h2d_bytes"],
             "d2h_bytes": row["d2h_bytes"],

@@ -93,10 +93,20 @@ class ScanAccounting:
         self.queries = 0
         self.posting_bytes_total = 0
         self.dense_bytes_total = 0
+        # block-max pruning overlay (ISSUE 20): bytes the phase-B mask
+        # kept OUT of the posting gathers. Static accounting above is
+        # untouched (Plan.scan_blocks stays the ceiling); effective
+        # bytes derive as posting - pruned at read time, so with the
+        # gate off (no note_pruned_* calls) effective == static exactly.
+        self.pruned_bytes_total = 0
+        self.pruned_queries = 0
         # per-query posting-bytes distribution — THE trigger metric
         # (SCALING.md's scanned-bytes/query column, live)
         self.per_query_posting = RollingEstimator()
         self.per_query_dense = RollingEstimator()
+        # per-query EFFECTIVE posting bytes (static - pruned), fed only
+        # by waves that ran a pruning-admitted program
+        self.per_query_effective = RollingEstimator()
         # (index, shard) -> heat-map row
         self._shards: Dict[Tuple[str, str], dict] = {}
 
@@ -203,30 +213,99 @@ class ScanAccounting:
             if dense:
                 self.per_query_dense.observe(float(dense))
 
+    def note_pruned_batch(self, index: str, shard: str,
+                          seg_pruned: Dict[str, int],
+                          per_query: List[Tuple[int, int]]) -> None:
+        """Block-max pruning overlay for one msearch wave (ISSUE 20),
+        flushed at FINISH time (the pruned counts ride the existing
+        result page — phase-A popcounts fetched with the top-k rows, no
+        extra round trip). The static note_batch accounting for the same
+        wave already landed at prepare; this call only adds the pruned
+        deltas, so effective = posting - pruned stays conservative
+        (effective <= static always, == when the gate is off).
+
+        seg_pruned: {seg_id: pruned_bytes}; per_query: [(static_posting
+        _bytes, pruned_bytes)] for every query in the wave's
+        pruning-admitted groups (pruned may be 0 — those still feed the
+        effective distribution so pruned/unpruned p50s compare like for
+        like). The shard row's pruned bytes derive from seg_pruned, not
+        per_query: the SPMD path spans shards in one query and calls
+        once per shard, with the single per_query entry on the first
+        call only."""
+        if not per_query and not seg_pruned:
+            return
+        key = (str(index), str(shard))
+        agg_pruned = sum(int(p) for p in seg_pruned.values())
+        with self._lock:
+            row = self._shards.get(key)
+            if row is None:
+                if len(self._shards) >= _MAX_SHARDS:
+                    key = (_OVERFLOW, _OVERFLOW)
+                    row = self._shards.get(key)
+                if row is None:
+                    row = self._shards[key] = {
+                        "queries": 0, "posting_bytes": 0,
+                        "dense_bytes": 0, "kernels": {}, "segments": {}}
+            row["pruned_bytes"] = row.get("pruned_bytes", 0) + agg_pruned
+            segs = row["segments"]
+            for seg_id, pruned in seg_pruned.items():
+                seg = segs.get(seg_id)
+                if seg is None:
+                    seg_id = _OVERFLOW
+                    seg = segs.get(seg_id)
+                if seg is not None:
+                    seg["pruned_bytes"] = \
+                        seg.get("pruned_bytes", 0) + int(pruned)
+            self.pruned_bytes_total += agg_pruned
+            self.pruned_queries += len(per_query)
+        for posting, pruned in per_query:
+            self.per_query_effective.observe(float(posting - pruned))
+
     # --------------------------------------------------------------- reading
 
     def stats(self) -> dict:
         with self._lock:
             shards = {}
             for (index, shard), row in sorted(self._shards.items()):
+                pruned = row.get("pruned_bytes", 0)
+                segments = {}
+                for sid, seg in sorted(row["segments"].items()):
+                    s = dict(seg)
+                    sp = s.pop("pruned_bytes", 0)
+                    s["pruned_bytes"] = sp
+                    s["effective_posting_bytes"] = s["posting_bytes"] - sp
+                    segments[sid] = s
                 shards[f"{index}[{shard}]"] = {
                     "queries": row["queries"],
                     "posting_bytes": row["posting_bytes"],
+                    # effective = static ceiling minus phase-B pruned
+                    # bytes; identical to posting_bytes when the
+                    # blockmax gate is off (conservation contract)
+                    "pruned_bytes": pruned,
+                    "effective_posting_bytes": row["posting_bytes"] - pruned,
                     "dense_bytes": row["dense_bytes"],
                     "kernels": dict(sorted(row["kernels"].items())),
-                    "segments": {
-                        sid: dict(seg)
-                        for sid, seg in sorted(row["segments"].items())},
+                    "segments": segments,
                 }
             queries = self.queries
             posting = self.posting_bytes_total
             dense = self.dense_bytes_total
+            pruned_total = self.pruned_bytes_total
+            pruned_queries = self.pruned_queries
+        # with no pruning-admitted traffic the effective distribution has
+        # no observations of its own: report the static distribution so
+        # effective == static holds byte-exactly, not vacuously
+        effective = self.per_query_effective.summary() if pruned_queries \
+            else self.per_query_posting.summary()
         return {
             "queries": queries,
             "posting_bytes_total": posting,
+            "pruned_bytes_total": pruned_total,
+            "effective_posting_bytes_total": posting - pruned_total,
             "dense_bytes_total": dense,
             "per_query": {
                 "posting_bytes": self.per_query_posting.summary(),
+                "effective_posting_bytes": effective,
                 "dense_bytes": self.per_query_dense.summary(),
             },
             "shards": shards,
@@ -237,9 +316,12 @@ class ScanAccounting:
             self.queries = 0
             self.posting_bytes_total = 0
             self.dense_bytes_total = 0
+            self.pruned_bytes_total = 0
+            self.pruned_queries = 0
             self._shards.clear()
         self.per_query_posting.reset()
         self.per_query_dense.reset()
+        self.per_query_effective.reset()
 
 
 # process-wide singleton (the TELEMETRY.scan face; module-level like

@@ -77,3 +77,153 @@ def query_terms(n_queries: int, vocab_size: int = 5000, seed: int = 7,
         ids = rng.integers(lo, hi, size=terms_per_query)
         out.append(" ".join(f"w{i:05d}" for i in ids))
     return out
+
+
+# --------------------------------------------- vectorized scale builder ----
+
+# SmallFloat encode table for vectorized norm quantization (lengths are
+# bounded by the builder's clip below, so a fixed-size table suffices)
+_SF_MAX_LEN = 1 << 16
+
+
+def _sf_table() -> np.ndarray:
+    global _SF_ENC
+    try:
+        return _SF_ENC
+    except NameError:
+        from opensearch_tpu.index.segment import smallfloat_int_to_byte4
+        _SF_ENC = np.array([smallfloat_int_to_byte4(i)
+                            for i in range(_SF_MAX_LEN)], dtype=np.uint8)
+        return _SF_ENC
+
+
+def build_shards_fast(n_docs: int, n_shards: int = 1,
+                      vocab_size: int = 20000, avg_len: int = 60,
+                      seed: int = 42, materialize_terms: int = 128,
+                      burst_tf: float = 0.0,
+                      burst_window: int = 0,
+                      burst_regions: int = 1,
+                      doc_len_cv: float = 0.0,
+                      mapper: Optional[MapperService] = None,
+                      ) -> Tuple[MapperService, List["Segment"], List[str]]:
+    """Sealed segments at 10M-doc scale without the per-doc parse loop.
+
+    `build_shards` routes every token through the mapper/SegmentBuilder
+    path — minutes at 1M docs, hours at 10M. This builder emits the SAME
+    sealed layout (sorted (field, term) keys, 128-lane blocked CSR padded
+    -1/0, SmallFloat norms, per-field stats) directly from vectorized
+    per-term sampling, materializing postings only for `materialize_terms`
+    mid-band zipf terms (the band `query_terms` draws from); every other
+    term exists only virtually, through the doc-length norms and avgdl.
+    Queries against a fast corpus must draw from the returned term list
+    (`fast_query_terms`).
+
+    Burstiness knobs (the block-max bench's prunable arm): each
+    materialized term gets one CONTIGUOUS doc-ord window per shard of
+    `burst_window` docs whose tf is raised by ~`burst_tf`, placed in one
+    of `burst_regions` shared region anchors (term rank mod regions).
+    The window must stay SMALL next to the terms' natural df — it is the
+    hot cluster (2-3 posting blocks); if it dominates df, every block is
+    a burst block and the bound distribution goes flat. Clustering in
+    doc-id space is the point — bursty postings spread uniformly over
+    doc ids put a high-tf lane in every 128-lane block, and nothing
+    prunes. SHARED regions matter just as much: a
+    multi-term query only develops a competitive threshold above the
+    common-block bounds when some docs score high on ALL its terms, which
+    is what co-located bursts (topically dense long docs — the shape real
+    corpora cluster by crawl/time locality) produce. `doc_len_cv` adds
+    lognormal doc-length variance on top of the Poisson baseline.
+
+    Returns (mapper, segments, terms) with docs round-robined over shards
+    (global _id "d{ord}" matches build_shards' layout).
+    """
+    from opensearch_tpu.index.segment import (FieldStats, Segment,
+                                              TermMeta, _pad_to)
+    mapper = mapper or MapperService(DEMO_MAPPING)
+    ranks_all = np.arange(1, vocab_size + 1, dtype=np.float64)
+    h_v = float(np.sum(1.0 / ranks_all))
+    lo, hi = max(vocab_size // 50, 1), max(vocab_size // 2, 2)
+    m = min(materialize_terms, hi - lo)
+    term_ranks = np.unique(np.linspace(lo, hi - 1, m).astype(np.int64))
+    terms = [f"w{r:05d}" for r in term_ranks]
+    sf = _sf_table()
+
+    segments: List[Segment] = []
+    for s in range(n_shards):
+        rng = np.random.default_rng(seed + 1000 * s)
+        n = n_docs // n_shards + (1 if s < n_docs % n_shards else 0)
+        lengths = np.maximum(8, rng.poisson(avg_len, n)).astype(np.int64)
+        if doc_len_cv > 0:
+            sigma = float(np.sqrt(np.log(1.0 + doc_len_cv ** 2)))
+            mult = rng.lognormal(-sigma * sigma / 2.0, sigma, n)
+            lengths = np.maximum(8, (lengths * mult).astype(np.int64))
+        wlen = min(int(burst_window), n) if burst_tf > 0 else 0
+
+        term_dict = {}
+        rows_docs: List[np.ndarray] = []
+        rows_tf: List[np.ndarray] = []
+        next_block = 0
+        sum_df = 0
+        # seal() sorts (field, term); zero-padded w-terms sort by rank
+        for rank, term in zip(term_ranks, terms):
+            p = (1.0 / float(rank)) / h_v
+            lam = avg_len * p
+            keep = rng.random(n) < (1.0 - np.exp(-lam))
+            if wlen:
+                region = int(rank) % max(burst_regions, 1)
+                w0 = int((region * 2654435761) % max(n - wlen, 1))
+                keep[w0:w0 + wlen] = True
+            ords = np.nonzero(keep)[0].astype(np.int32)
+            tf = (1.0 + rng.poisson(lam, ords.size)).astype(np.float32)
+            if wlen:
+                in_w = (ords >= w0) & (ords < w0 + wlen)
+                # high-IMPACT postings: tf raised while the doc keeps its
+                # baseline length (tag/title-style term repetition). If
+                # the burst tokens also lengthened the doc, BM25's length
+                # normalization would cancel the burst (g = tf/(tf+k1·c)
+                # with c growing ∝ tf) and the block bounds would stay
+                # flat — no impact skew, nothing for phase A to separate
+                tf = np.where(
+                    in_w, tf + rng.poisson(burst_tf, ords.size), tf)
+            df = int(ords.size)
+            if df == 0:
+                continue
+            padded = _pad_to(df, 128)
+            docs_p = np.full(padded, -1, dtype=np.int32)
+            tfs_p = np.zeros(padded, dtype=np.float32)
+            docs_p[:df] = ords
+            tfs_p[:df] = tf
+            nb = padded // 128
+            rows_docs.append(docs_p.reshape(nb, 128))
+            rows_tf.append(tfs_p.reshape(nb, 128))
+            term_dict[("body", term)] = TermMeta(
+                doc_freq=df, total_term_freq=int(tf.sum()),
+                start_block=next_block, num_blocks=nb)
+            next_block += nb
+            sum_df += df
+        post_docs = np.concatenate(rows_docs, axis=0) if rows_docs \
+            else np.full((1, 128), -1, dtype=np.int32)
+        post_tf = np.concatenate(rows_tf, axis=0) if rows_tf \
+            else np.zeros((1, 128), dtype=np.float32)
+
+        lengths = np.minimum(lengths, _SF_MAX_LEN - 1)
+        norms = {"body": sf[lengths]}
+        stats = {"body": FieldStats(
+            doc_count=n, sum_total_term_freq=int(lengths.sum()),
+            sum_doc_freq=sum_df)}
+        doc_ids = [f"d{s + i * n_shards}" for i in range(n)]
+        segments.append(Segment(
+            f"s{s}", n, doc_ids, [None] * n, term_dict,
+            post_docs, post_tf, norms, stats, {}, {}, {}))
+    return mapper, segments, terms
+
+
+def fast_query_terms(n_queries: int, terms: List[str], seed: int = 7,
+                     terms_per_query: int = 2) -> List[str]:
+    """Query strings over a fast corpus's MATERIALIZED terms only."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_queries):
+        ids = rng.integers(0, len(terms), size=terms_per_query)
+        out.append(" ".join(terms[i] for i in ids))
+    return out

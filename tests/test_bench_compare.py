@@ -651,3 +651,79 @@ def test_kernels_cli_end_to_end(tmp_path):
     bad_p = _write(tmp_path / "k_bad.json", [_kernels_rec(p50=5.0)])
     assert bench_compare.main(["bench_compare.py", old_p, old_p]) == 0
     assert bench_compare.main(["bench_compare.py", old_p, bad_p]) == 1
+
+
+# ------------------------------------------------ block-max A/B (ISSUE 20) --
+
+
+def _bmx_pair(base="spmd_1000k_d8", docs=1_000_000, off_p50=12.0,
+              on_p50=12.5, off_digest="abc123", on_digest="abc123",
+              pruned=0.29):
+    off = {"mode": base, "docs": docs, "devices": 8, "blockmax": False,
+           "warm_p50_ms": off_p50, "page_digest": off_digest}
+    on = {"mode": base + "_bmx", "docs": docs, "devices": 8,
+          "blockmax": True, "warm_p50_ms": on_p50,
+          "page_digest": on_digest, "pruned_fraction": pruned}
+    return off, on
+
+
+def test_blockmax_identical_pages_within_p50_ok():
+    new = _keyed(*_bmx_pair())
+    rows, failures = bench_compare.compare_blockmax({}, new, 10.0)
+    assert not failures
+    assert rows[0]["status"] == "ok"
+    assert rows[0]["digest_match"] is True
+
+
+def test_blockmax_page_divergence_fails():
+    new = _keyed(*_bmx_pair(on_digest="deadbeef"))
+    rows, failures = bench_compare.compare_blockmax({}, new, 10.0)
+    assert failures and rows[0]["status"] == "PAGE-DIVERGENCE"
+    assert "page digest" in failures[0]
+
+
+def test_blockmax_p50_regression_fails_at_or_below_1m():
+    new = _keyed(*_bmx_pair(off_p50=10.0, on_p50=12.0))   # +20% > 15%
+    rows, failures = bench_compare.compare_blockmax({}, new, 10.0)
+    assert failures and rows[0]["status"] == "ENABLED-OVERHEAD"
+
+
+def test_blockmax_p50_not_gated_above_1m():
+    # past the trigger scale the pruned arm trades phase-A cost for
+    # scan reduction — latency there is the scaling table's story, not
+    # this gate's
+    off, on = _bmx_pair(base="spmd_10000k_d8", docs=10_000_000,
+                        off_p50=10.0, on_p50=13.0)
+    rows, failures = bench_compare.compare_blockmax({}, _keyed(off, on),
+                                                    10.0)
+    assert not failures and rows[0]["status"] == "ok"
+    assert rows[0]["p50_delta_pct"] == 30.0
+
+
+def test_blockmax_pruned_only_reports_never_fails():
+    _, on = _bmx_pair()
+    rows, failures = bench_compare.compare_blockmax({}, _keyed(on), 10.0)
+    assert not failures and rows[0]["status"] == "pruned-only"
+
+
+def test_blockmax_old_round_pairs_never_fail():
+    old = _keyed(*_bmx_pair(on_digest="deadbeef"))
+    rows, failures = bench_compare.compare_blockmax(old, {}, 10.0)
+    assert not rows and not failures
+
+
+def test_blockmax_digest_divergence_beats_p50_status():
+    new = _keyed(*_bmx_pair(off_p50=10.0, on_p50=12.0,
+                            on_digest="deadbeef"))
+    rows, failures = bench_compare.compare_blockmax({}, new, 10.0)
+    assert rows[0]["status"] == "PAGE-DIVERGENCE"
+    assert len(failures) == 1
+
+
+def test_blockmax_cli_end_to_end(tmp_path):
+    ok_off, ok_on = _bmx_pair()
+    bad_off, bad_on = _bmx_pair(on_digest="deadbeef")
+    ok_p = _write(tmp_path / "bmx_ok.json", [ok_off, ok_on])
+    bad_p = _write(tmp_path / "bmx_bad.json", [bad_off, bad_on])
+    assert bench_compare.main(["bench_compare.py", ok_p, ok_p]) == 0
+    assert bench_compare.main(["bench_compare.py", ok_p, bad_p]) == 1

@@ -1,0 +1,438 @@
+"""Block-max pruning differential suite (ISSUE 20).
+
+The acceptance discipline: pruning must be RANK-EXACT — the top-k page
+with the gate on is byte-identical to the gate-off page on every corpus
+shape (zipf + clustered bursts, adversarial uniform-impact, deletes,
+multi-shard SPMD), while `hits.total` degrades to a "gte" lower bound
+exactly when blocks were pruned (Lucene BMW semantics). Scan accounting
+stays conservative: effective posting bytes == static bytes byte-exactly
+with the gate off, <= with it on.
+"""
+
+import random
+import uuid
+
+import pytest
+
+from opensearch_tpu.index.mapper import MapperService
+from opensearch_tpu.index.shard import IndexShard
+from opensearch_tpu.ops import bm25 as _bm25
+from opensearch_tpu.search.controller import execute_search
+from opensearch_tpu.search.executor import SearchExecutor, ShardReader
+from opensearch_tpu.telemetry import TELEMETRY
+from opensearch_tpu.telemetry.scan import SCAN
+from opensearch_tpu.utils.demo import build_shards_fast
+
+MAPPING = {"properties": {"body": {"type": "text"},
+                          "n": {"type": "integer"}}}
+
+
+@pytest.fixture(autouse=True)
+def _gate_off_pristine():
+    """Every test starts and ends with the module gate OFF (the shipped
+    default); tests flip it inside try/finally on top of this backstop."""
+    _bm25.BLOCKMAX = False
+    yield
+    _bm25.BLOCKMAX = False
+
+
+def _shard(**kw):
+    return IndexShard(0, MapperService(MAPPING),
+                      index_name=f"bmx_{uuid.uuid4().hex[:6]}", **kw)
+
+
+def _zipf_docs(n=3000, burst=60, seed=7):
+    """Zipf-ish vocab with a doc-id-CLUSTERED high-tf burst: the first
+    `burst` docs repeat w4 40 times. Clustering is load-bearing — the
+    same burst spread uniformly over doc ids puts a high-impact lane in
+    every 128-lane block and nothing prunes."""
+    rng = random.Random(seed)
+    vocab = [f"w{i}" for i in range(50)]
+    weights = [1.0 / (j + 1) for j in range(50)]
+    out = []
+    for i in range(n):
+        words = rng.choices(vocab, weights=weights, k=30)
+        if i < burst:
+            words = words + ["w4"] * 40
+        out.append(" ".join(words))
+    return out
+
+
+def _build_zipf_shard(n=3000, deleted=()):
+    shard = _shard()
+    for i, body in enumerate(_zipf_docs(n=n)):
+        shard.index_doc(f"d{i}", {"body": body, "n": i})
+    shard.refresh()
+    for d in deleted:
+        shard.delete_doc(d)
+    if deleted:
+        shard.refresh()
+    return shard
+
+
+@pytest.fixture(scope="module")
+def zipf_shard():
+    """Real-seal path: mapper-parsed docs through SegmentBuilder.seal(),
+    so post_bound comes from the production block_score_bounds pass."""
+    return _build_zipf_shard()
+
+
+@pytest.fixture(scope="module")
+def fast_ex():
+    """200K-doc fast corpus (vectorized seal layout), single shard —
+    large enough that mid-band 2-term queries clear the 16-block
+    admission floor with room to prune."""
+    mapper, segs, terms = build_shards_fast(
+        200000, n_shards=1, vocab_size=20000, avg_len=60, seed=42,
+        materialize_terms=64, burst_tf=30.0, burst_window=256,
+        doc_len_cv=0.5)
+    return SearchExecutor(ShardReader(mapper, segs)), terms
+
+
+@pytest.fixture(scope="module")
+def uniform_ex():
+    """Adversarial uniform-impact corpus: no bursts, every posting tf~1
+    — the bound distribution is flat, so phase A has (almost) nothing
+    competitive to prune and must stay exact anyway."""
+    mapper, segs, terms = build_shards_fast(
+        100000, n_shards=1, vocab_size=20000, avg_len=60, seed=9,
+        materialize_terms=64)
+    return SearchExecutor(ShardReader(mapper, segs)), terms
+
+
+def _bodies_for(terms, sizes=(10,), n_pairs=6, seed=3):
+    rng = random.Random(seed)
+    out = []
+    for size in sizes:
+        for _ in range(n_pairs):
+            a, b = rng.sample(terms, 2)
+            out.append({"query": {"match": {"body": f"{a} {b}"}},
+                        "size": size})
+    return out
+
+
+def _run(ex, bodies):
+    rs = ex.multi_search([dict(b) for b in bodies])["responses"]
+    pages = [[(h["_id"], h["_score"]) for h in r["hits"]["hits"]]
+             for r in rs]
+    totals = [(r["hits"]["total"]["value"], r["hits"]["total"]["relation"])
+              for r in rs]
+    return pages, totals
+
+
+def _ab(ex, bodies):
+    """(off_pages, off_totals, on_pages, on_totals, pruned_delta)."""
+    off_pages, off_totals = _run(ex, bodies)
+    p0 = SCAN.pruned_bytes_total
+    _bm25.BLOCKMAX = True
+    try:
+        on_pages, on_totals = _run(ex, bodies)
+    finally:
+        _bm25.BLOCKMAX = False
+    return (off_pages, off_totals, on_pages, on_totals,
+            SCAN.pruned_bytes_total - p0)
+
+
+def _check_totals(off_totals, on_totals):
+    for (ov, orel), (nv, nrel) in zip(off_totals, on_totals):
+        assert orel == "eq"
+        assert nrel in ("eq", "gte")
+        if nrel == "eq":
+            assert nv == ov          # nothing pruned -> exact count
+        else:
+            assert nv <= ov          # pruned -> lower bound
+
+
+# ------------------------------------------------------------- gate & scan
+
+
+def test_gate_off_by_default():
+    assert _bm25.BLOCKMAX is False
+
+
+def test_gate_off_effective_equals_static_byte_exact(fast_ex):
+    """Conservation contract: with the gate off the pruning overlay
+    records NOTHING — effective == static posting bytes byte-exactly at
+    every level of telemetry.scan (totals, per-query, shard, segment)."""
+    ex, terms = fast_ex
+    SCAN.reset()
+    _run(ex, _bodies_for(terms, sizes=(10, 100)))
+    st = SCAN.stats()
+    assert st["pruned_bytes_total"] == 0
+    assert st["effective_posting_bytes_total"] == st["posting_bytes_total"]
+    assert st["per_query"]["effective_posting_bytes"] == \
+        st["per_query"]["posting_bytes"]
+    for row in st["shards"].values():
+        assert row["pruned_bytes"] == 0
+        assert row["effective_posting_bytes"] == row["posting_bytes"]
+        for seg in row["segments"].values():
+            assert seg["pruned_bytes"] == 0
+            assert seg["effective_posting_bytes"] == seg["posting_bytes"]
+
+
+def test_effective_bytes_conservative_when_pruning(fast_ex):
+    """Gate on: effective <= static at every level, with a real gap."""
+    ex, terms = fast_ex
+    SCAN.reset()
+    _bm25.BLOCKMAX = True
+    try:
+        _run(ex, _bodies_for(terms))
+    finally:
+        _bm25.BLOCKMAX = False
+    st = SCAN.stats()
+    assert 0 < st["pruned_bytes_total"] <= st["posting_bytes_total"]
+    assert st["effective_posting_bytes_total"] == \
+        st["posting_bytes_total"] - st["pruned_bytes_total"]
+    for row in st["shards"].values():
+        assert 0 <= row["pruned_bytes"] <= row["posting_bytes"]
+        seg_pruned = sum(s["pruned_bytes"] for s in row["segments"].values())
+        assert seg_pruned == row["pruned_bytes"]
+
+
+# ------------------------------------------------------ page differentials
+
+
+def test_pruned_pages_byte_identical_zipf_fast(fast_ex):
+    """The tentpole differential: on the prunable corpus, k in {1, 10,
+    100}, pruned pages are byte-identical to unpruned ones while a real
+    fraction of posting bytes was skipped."""
+    ex, terms = fast_ex
+    bodies = _bodies_for(terms, sizes=(1, 10, 100))
+    off_pages, off_totals, on_pages, on_totals, pruned = _ab(ex, bodies)
+    assert on_pages == off_pages
+    assert pruned > 0
+    _check_totals(off_totals, on_totals)
+    assert any(rel == "gte" for _, rel in on_totals), \
+        "prunable corpus must actually prune (test corpus regressed)"
+
+
+def test_adversarial_uniform_impact_identity(uniform_ex):
+    """Uniform-impact corpus: flat bound distribution. Whatever little
+    phase A finds to prune, pages must not move by a byte."""
+    ex, terms = uniform_ex
+    bodies = _bodies_for(terms, sizes=(1, 10))
+    off_pages, off_totals, on_pages, on_totals, _ = _ab(ex, bodies)
+    assert on_pages == off_pages
+    _check_totals(off_totals, on_totals)
+
+
+def test_real_seal_pages_identical(zipf_shard):
+    """Same differential through the production seal (mapper parse ->
+    SegmentBuilder.seal() -> block_score_bounds) and the executor's
+    single-search envelope route (B=1 batched kernel)."""
+    ex = zipf_shard.executor
+    bodies = [{"query": {"match": {"body": q}}, "size": 10}
+              for q in ("w4", "w4 w0", "w1 w2")]
+    off_pages, off_totals, on_pages, on_totals, pruned = _ab(ex, bodies)
+    assert on_pages == off_pages
+    assert pruned > 0
+    _check_totals(off_totals, on_totals)
+    assert on_totals[0][1] == "gte", \
+        "the clustered-burst term query must prune on the sealed corpus"
+
+
+def test_deleted_docs_live_mask():
+    """Deletes compose with pruning: theta must derive from LIVE docs
+    only, and pruned pages must match unpruned ones after the burst
+    docs (the top scorers) are deleted."""
+    shard = _build_zipf_shard(
+        deleted=[f"d{i}" for i in range(0, 30)] + ["d100", "d200"])
+    ex = shard.executor
+    bodies = [{"query": {"match": {"body": q}}, "size": 10}
+              for q in ("w4", "w4 w0")]
+    off_pages, off_totals, on_pages, on_totals, _ = _ab(ex, bodies)
+    assert on_pages == off_pages
+    _check_totals(off_totals, on_totals)
+    deleted = {f"d{i}" for i in range(30)} | {"d100", "d200"}
+    for page in on_pages:
+        assert not deleted & {i for i, _ in page}
+
+
+def test_filter_composition_not_admitted(zipf_shard):
+    """bool+filter plans are NOT text-clause plans: no admission, no
+    pruned bytes, relation stays exact — and pages stay identical."""
+    ex = zipf_shard.executor
+    bodies = [{"query": {"bool": {
+        "must": [{"match": {"body": "w4 w0"}}],
+        "filter": [{"range": {"n": {"gte": 100}}}]}}, "size": 10}]
+    off_pages, off_totals, on_pages, on_totals, pruned = _ab(ex, bodies)
+    assert on_pages == off_pages
+    assert pruned == 0
+    assert on_totals == off_totals
+    assert all(rel == "eq" for _, rel in on_totals)
+
+
+def test_min_score_disables_pruning(zipf_shard):
+    """A caller-set score floor makes `total` semantically load-bearing
+    below the floor — phase A must stand down (theta -> -inf), so no
+    bytes prune and the count stays exact."""
+    ex = zipf_shard.executor
+    bodies = [{"query": {"match": {"body": "w4 w0"}}, "size": 10,
+               "min_score": 1.0}]
+    off_pages, off_totals, on_pages, on_totals, pruned = _ab(ex, bodies)
+    assert on_pages == off_pages
+    assert pruned == 0
+    assert on_totals == off_totals
+    assert all(rel == "eq" for _, rel in on_totals)
+
+
+def test_dense_single_search_unaffected(zipf_shard):
+    """The controller's single-search query phase runs the DENSE kernel
+    — no pruning exists there. Gate on must not change a byte, count a
+    pruned byte, or degrade the relation."""
+    ex = zipf_shard.executor
+    body = {"query": {"match": {"body": "w4 w0"}}, "size": 10}
+
+    def run():
+        r = ex.search(dict(body), _direct=True)
+        h = r["hits"]
+        return ([(x["_id"], x["_score"]) for x in h["hits"]],
+                (h["total"]["value"], h["total"]["relation"]))
+
+    off = run()
+    p0 = SCAN.pruned_bytes_total
+    _bm25.BLOCKMAX = True
+    try:
+        on = run()
+    finally:
+        _bm25.BLOCKMAX = False
+    assert on == off
+    assert on[1][1] == "eq"
+    assert SCAN.pruned_bytes_total == p0
+
+
+# ----------------------------------------------------------------- SPMD
+
+
+def _spmd_env(n_shards, n_docs=48000, one_reader_segments=False):
+    mapper, segs, terms = build_shards_fast(
+        n_docs, n_shards=n_shards, vocab_size=2000, avg_len=60, seed=42,
+        materialize_terms=32, burst_tf=30.0, burst_window=256,
+        doc_len_cv=0.5)
+    if one_reader_segments:
+        executors = [SearchExecutor(ShardReader(mapper, segs))]
+    else:
+        executors = [SearchExecutor(ShardReader(mapper, [s]))
+                     for s in segs]
+    rng = random.Random(11)
+    queries = [" ".join(rng.sample(terms[:6], 2)) for _ in range(4)]
+    return executors, queries
+
+
+class TestSpmd:
+    @pytest.mark.parametrize("d,one_reader", [(2, True), (2, False),
+                                              (4, False)])
+    def test_spmd_parity(self, eight_devices, d, one_reader):
+        """D (shard, segment) rows through the fused SPMD program:
+        pruned pages byte-identical, totals lower-bounded, and the
+        per-shard heat map shows pruned bytes on every admitted row."""
+        from opensearch_tpu.search import spmd
+        executors, queries = _spmd_env(d, one_reader_segments=one_reader)
+        bodies = [{"query": {"match": {"body": q}}, "size": 10}
+                  for q in queries]
+
+        def run(b):
+            r = execute_search(executors, dict(b))
+            h = r["hits"]
+            return ([(x["_id"], x["_score"]) for x in h["hits"]],
+                    (h["total"]["value"], h["total"]["relation"]))
+
+        s0 = spmd.SPMD_QUERIES.value
+        off = [run(b) for b in bodies]
+        assert spmd.SPMD_QUERIES.value > s0, \
+            "corpus must route through the SPMD path for this test"
+        SCAN.reset()
+        _bm25.BLOCKMAX = True
+        try:
+            on = [run(b) for b in bodies]
+            st = SCAN.stats()
+        finally:
+            _bm25.BLOCKMAX = False
+        for (po, to), (pn, tn) in zip(off, on):
+            assert pn == po
+            assert tn[1] in ("eq", "gte")
+            assert tn[0] <= to[0]
+            if tn[1] == "eq":
+                assert tn[0] == to[0]
+        assert st["pruned_bytes_total"] > 0
+        assert any(t[1] == "gte" for _, t in on)
+
+    def test_spmd_shard_key_regression(self, eight_devices):
+        """Satellite fix pin: the SPMD fallback scan note must key heat
+        rows by the reader's REAL shard id, not the executor's list
+        position (pre-fix, a partial executor list — e.g. after
+        can-match skips — misattributed scan bytes)."""
+        executors, queries = _spmd_env(2)
+        executors[0].reader.shard_id = 5
+        executors[1].reader.shard_id = 9
+        body = {"query": {"match": {"body": queries[0]}}, "size": 10}
+        SCAN.reset()
+        execute_search(executors, dict(body))
+        keys = set(SCAN.stats()["shards"])
+        assert {"_index[5]", "_index[9]"} <= keys, keys
+        assert not {"_index[0]", "_index[1]"} & keys, keys
+
+
+# ------------------------------------------------------------ churn pin
+
+
+class TestChurn:
+    def test_refresh_warm_serving_blockmax_on_no_recompile(self):
+        """Refresh under warm serving with blockmax ON: churn-published
+        shapes precompile off-path (barrier mode) and no serving thread
+        pays an XLA compile — the gate must not punch a hole in the
+        ingest-concurrent serving contract (ISSUE 16)."""
+        from opensearch_tpu.search.warmup import PRECOMPILE
+        ch = TELEMETRY.churn
+        ch.enabled = True
+        ch.reset()
+        PRECOMPILE.set_enabled(True)
+        PRECOMPILE.barrier = True
+        _bm25.BLOCKMAX = True
+        try:
+            shard = _build_zipf_shard(n=1500)
+            ex = shard.executor
+            bodies = [{"query": {"match": {"body": "w4 w0"}}, "size": 10},
+                      {"query": {"match": {"body": "w1 w2"}}, "size": 10}]
+            for b in bodies:
+                ex.search(dict(b))          # register + compile shapes
+            miss = TELEMETRY.metrics.counter("search.xla_cache_miss")
+            m0 = miss.value
+            for batch in range(3):
+                for i in range(4):
+                    shard.index_doc(
+                        f"ch{batch}_{i}",
+                        {"body": f"w4 w0 churn {i}", "n": 9000 + i})
+                shard.refresh()
+                for b in bodies:
+                    ex.search(dict(b))
+            t = ch.snapshot()["totals"]
+            assert t["recompile_on_serve"] == 0
+            assert miss.value == m0, \
+                "a serving-thread compile slipped past the barrier"
+        finally:
+            PRECOMPILE.set_enabled(False)
+            PRECOMPILE.barrier = False
+            ch.enabled = False
+            ch.reset()
+            _bm25.BLOCKMAX = False
+
+    def test_bounds_leaf_always_resident(self):
+        """The post_bound device leaf is NOT gated: it uploads with the
+        segment under either gate state, so flipping the gate never
+        re-uploads a resident segment (delta-publish compact spec and
+        compile_key both cover it)."""
+        for gate in (False, True):
+            _bm25.BLOCKMAX = gate
+            try:
+                shard = _shard()
+                for i in range(8):
+                    shard.index_doc(f"b{i}", {"body": f"w1 w2 {i}", "n": i})
+                shard.refresh()
+                _, _, dev = shard.reader.stats_snapshot()
+                assert dev and all(
+                    "post_bound" in d and meta.block_bounds
+                    for d, meta in dev), \
+                    f"post_bound leaf missing with gate={gate}"
+            finally:
+                _bm25.BLOCKMAX = False

@@ -65,6 +65,16 @@ INSIGHTS_MIN_COUNT = 20
 MAXSIM_RECALL_DROP = 0.02
 MAXSIM_PQ_RECALL_FLOOR = 0.95
 
+# the block-max gate (ISSUE 20): within the NEW round, the pruned arm
+# of a blockmax A/B (mode `X_bmx` next to its unpruned `X`) must carry
+# a top-k page digest IDENTICAL to the unpruned arm's — rank-exactness
+# is the pruning kernel's contract, checked in CI, never assumed — and
+# at ≤1M docs its warm p50 may not exceed the unpruned arm's by more
+# than this: below the trigger scale pruning pays little back, so the
+# A/B pins the price of serving with the gate on
+BLOCKMAX_P50_PCT = 15.0
+BLOCKMAX_P50_MAX_DOCS = 1_000_000
+
 # the kernel-profiler gate (ISSUE 19): at EQUAL bench+family key, a
 # kernel family's sampled device-wall p50 may not regress by more than
 # this between two BENCH_KERNELS rounds — "this executable family got
@@ -679,6 +689,88 @@ def compare_kernels(old: Dict[str, dict], new: Dict[str, dict],
     return rows, failures
 
 
+def _blockmax_pairs(recs: Dict[str, dict]) -> List[Tuple[str, Optional[dict], dict]]:
+    """(base key, unpruned record or None, pruned record) for every
+    pruned-arm record (`blockmax: true`, mode suffixed `_bmx`) in the
+    set. The unpruned partner is the record at the arm-neutral key —
+    matched from the full set, so harnesses that only tag the pruned
+    arm (the open-loop records) still pair."""
+    pairs = []
+    for key, on in sorted(recs.items()):
+        if not key.endswith("_bmx") or on.get("blockmax") is not True:
+            continue
+        pairs.append((key[:-4], recs.get(key[:-4]), on))
+    return pairs
+
+
+def compare_blockmax(old: Dict[str, dict], new: Dict[str, dict],
+                     threshold_pct: float) -> Tuple[List[dict], List[str]]:
+    """Gate the block-max A/B WITHIN the new round — both arms of a
+    blockmax run land in one file, keyed `X` / `X_bmx` at the same
+    (docs, devices) config:
+
+    - any top-k page-digest divergence between the arms fails: the
+      pruned page must be byte-identical to the unpruned page (totals
+      are exempt by design — the pruned arm reports lower bounds with
+      relation "gte");
+    - at ≤ BLOCKMAX_P50_MAX_DOCS docs, the pruned arm's warm p50 may
+      not exceed the unpruned arm's by more than BLOCKMAX_P50_PCT;
+    - each arm's cross-round drift rides the generic warm gate above
+      (the `_bmx` suffix keeps the arms from mis-pairing there).
+
+    The old file's pairs are context, not gates: a historical
+    divergence was that round's failure, not this one's."""
+    del threshold_pct, old
+    rows, failures = [], []
+    for base, off, on in _blockmax_pairs(new):
+        row = {"config": base, "docs": on.get("docs"),
+               "pruned_fraction": on.get("pruned_fraction")}
+        if off is None:
+            row["status"] = "pruned-only"
+            rows.append(row)
+            continue
+        status = "ok"
+        od, nd = off.get("page_digest"), on.get("page_digest")
+        row["digest_match"] = (od == nd) if od and nd else None
+        if od and nd and od != nd:
+            status = "PAGE-DIVERGENCE"
+            failures.append(
+                f"{base}: pruned arm page digest {nd} != unpruned "
+                f"{od} — block-max pruning changed a top-k page")
+        o50, n50 = warm_p50(off), warm_p50(on)
+        row["unpruned_warm_p50_ms"] = o50
+        row["pruned_warm_p50_ms"] = n50
+        docs = on.get("docs")
+        if o50 and n50:
+            d50 = 100.0 * (n50 - o50) / o50
+            row["p50_delta_pct"] = round(d50, 1)
+            if status == "ok" and isinstance(docs, int) \
+                    and docs <= BLOCKMAX_P50_MAX_DOCS \
+                    and d50 > BLOCKMAX_P50_PCT:
+                status = "ENABLED-OVERHEAD"
+                failures.append(
+                    f"{base}: pruned arm warm p50 {o50}ms -> {n50}ms "
+                    f"(+{d50:.1f}% > {BLOCKMAX_P50_PCT:g}% at "
+                    f"{docs} docs ≤ {BLOCKMAX_P50_MAX_DOCS} — the "
+                    f"gate must be ~free below the trigger scale)")
+        row["status"] = status
+        rows.append(row)
+    return rows, failures
+
+
+def render_blockmax(rows: List[dict]) -> str:
+    headers = ["config", "docs", "pruned_fraction", "digest_match",
+               "unpruned_warm_p50_ms", "pruned_warm_p50_ms",
+               "p50_delta_pct", "status"]
+    table = [headers] + [[str(r.get(h, "-")) for h in headers]
+                         for r in rows]
+    widths = [max(len(row[i]) for row in table)
+              for i in range(len(headers))]
+    return "\n".join(
+        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+        for row in table)
+
+
 def render_kernels(rows: List[dict]) -> str:
     headers = ["config", "old_calls", "new_calls", "old_p50_ms",
                "new_p50_ms", "p50_delta_pct", "bound", "status"]
@@ -842,6 +934,12 @@ def main(argv: List[str]) -> int:
               "bench+family key):")
         print(render_kernels(kr_rows))
         failures += kr_failures
+    bm_rows, bm_failures = compare_blockmax(old, new, threshold)
+    if bm_rows:
+        print("\nblock-max A/B (pruned vs unpruned arm at equal "
+              "config key — page-digest identity / ≤1M warm-p50):")
+        print(render_blockmax(bm_rows))
+        failures += bm_failures
     if failures:
         print(f"\nFAIL: {len(failures)} regression(s) "
               f"(warm p50/p99 beyond {threshold:g}% / overload "

@@ -118,6 +118,11 @@ GATED_SUBSYSTEMS = (
     # state), the inflight-wave-gauge contract, not this discipline
     ("opensearch_tpu/telemetry/kernels.py", "KernelProfiler", "enabled",
      ("gate",)),
+    # ISSUE 20 block-max pruning: OFF by default — the pristine query
+    # path compiles no tid/bscale inputs and masks nothing; the seal-
+    # time bounds leaf is always present (upload cost, not query cost)
+    # so flipping the gate never re-uploads segments
+    ("opensearch_tpu/ops/bm25.py", None, "BLOCKMAX", ()),
 )
 
 # no-op constants a disabled gate may return

@@ -4,7 +4,17 @@ Writes one JSON line per scale to SCALING_raw.json: batched QPS, single-
 query p50/p99, the numpy-CSR baseline, and the per-query bytes the
 candidate kernel actually touches (posting blocks of the query's terms)
 vs what a dense scan would touch. Run on whatever backend is up; the
-driver's TPU bench covers the flagship number."""
+driver's TPU bench covers the flagship number.
+
+SCALE_FAST=1 (ISSUE 20) swaps the per-doc builder for the vectorized
+`build_shards_fast` corpus (burst-clustered mid-band terms, queries
+drawn from the materialized band) so the curve extends to 10M docs —
+`build_shards` takes hours there; the fast seal takes seconds.
+SCALE_BLOCKMAX=1 additionally runs the pruned arm: flips the
+`search.blockmax.enabled` module gate and records the live scan
+counters' effective (post-pruning) bytes + pruned fraction next to the
+static column. The numpy baseline is skipped for fast corpora (the
+CSR scorer rebuilds per-doc structures the fast seal never makes)."""
 import json
 import os
 import sys
@@ -20,15 +30,31 @@ import numpy as np  # noqa: E402
 
 def run_scale(n_docs: int, out):
     from opensearch_tpu.search.executor import SearchExecutor, ShardReader
-    from opensearch_tpu.utils.demo import build_shards, query_terms
+    from opensearch_tpu.utils.demo import (build_shards, build_shards_fast,
+                                           fast_query_terms, query_terms)
+    fast = os.environ.get("SCALE_FAST") == "1"
+    blockmax = os.environ.get("SCALE_BLOCKMAX") == "1"
     t0 = time.perf_counter()
-    mapper, segments = build_shards(n_docs, n_shards=1, vocab_size=20000,
-                                    avg_len=60, seed=42)
+    if fast:
+        mapper, segments, fterms = build_shards_fast(
+            n_docs, n_shards=1, vocab_size=20000, avg_len=60, seed=42,
+            materialize_terms=64, burst_tf=30, burst_window=256,
+            doc_len_cv=0.5)
+    else:
+        mapper, segments = build_shards(n_docs, n_shards=1,
+                                        vocab_size=20000,
+                                        avg_len=60, seed=42)
     seg = segments[0]
     build_s = time.perf_counter() - t0
+    if blockmax:
+        from opensearch_tpu.ops import bm25 as _bm25
+        from opensearch_tpu.telemetry import TELEMETRY
+        _bm25.BLOCKMAX = True
+        TELEMETRY.scan.reset()  # per-scale counters (multi-scale runs)
     reader = ShardReader(mapper, segments)
     ex = SearchExecutor(reader)
-    queries = query_terms(1024, 20000, seed=7, terms_per_query=2)
+    queries = fast_query_terms(1024, fterms, seed=7) if fast \
+        else query_terms(1024, 20000, seed=7, terms_per_query=2)
     bodies = [{"query": {"match": {"body": q}}, "size": 10} for q in queries]
     ex.multi_search(bodies)                      # compile all shape buckets
     times = []
@@ -56,10 +82,6 @@ def run_scale(n_docs: int, out):
                 b += tm.num_blocks * 128 * 8
         per_q_bytes.append(b)
     dense_bytes = seg.post_docs.shape[0] * 128 * 8
-    # numpy-CSR baseline (same scorer as bench.py)
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    import bench
-    base_qps = bench.numpy_baseline(seg, queries[:256])
     rec = {
         "n_docs": n_docs,
         "platform": jax.devices()[0].platform,
@@ -67,13 +89,32 @@ def run_scale(n_docs: int, out):
         "qps_batched": round(qps, 1),
         "p50_ms": round(lat[len(lat) // 2], 2),
         "p99_ms": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 2),
-        "numpy_baseline_qps": round(base_qps, 1),
-        "vs_baseline": round(qps / base_qps, 3),
         "scanned_bytes_per_query_p50": int(np.median(per_q_bytes)),
         "scanned_bytes_per_query_max": int(max(per_q_bytes)),
         "dense_scan_bytes": int(dense_bytes),
         "total_postings_blocks": int(seg.post_docs.shape[0]),
     }
+    if fast:
+        rec["fast_corpus"] = True
+    else:
+        # numpy-CSR baseline (same scorer as bench.py); classic corpora
+        # only — the scorer rebuilds per-doc CSR structures the fast
+        # seal never materializes
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        import bench
+        base_qps = bench.numpy_baseline(seg, queries[:256])
+        rec["numpy_baseline_qps"] = round(base_qps, 1)
+        rec["vs_baseline"] = round(qps / base_qps, 3)
+    if blockmax:
+        from opensearch_tpu.telemetry import TELEMETRY
+        scan = TELEMETRY.scan.stats()
+        post_total = scan["posting_bytes_total"]
+        rec["blockmax"] = True
+        rec["effective_bytes_per_query_p50"] = \
+            scan["per_query"]["effective_posting_bytes"].get("p50")
+        rec["pruned_fraction"] = round(
+            scan["pruned_bytes_total"] / max(post_total, 1), 4)
     out.write(json.dumps(rec) + "\n")
     out.flush()
     print(json.dumps(rec))

@@ -60,12 +60,15 @@ def report_rows(records: List[dict]) -> List[dict]:
     for rec in records:
         if "error" in rec:
             rows.append({"devices": rec["devices"],
+                         "mode": rec.get("mode", "-"),
                          "qps": "ERROR",
                          "efficiency": "-", "skew_p50_ms": "-",
-                         "ici_bytes_q": "-", "scan_bytes_q": "-"})
+                         "ici_bytes_q": "-", "scan_bytes_q": "-",
+                         "eff_bytes_q": "-", "pruned_frac": "-"})
             continue
         rows.append({
             "devices": rec["devices"],
+            "mode": rec.get("mode", "-"),
             "qps": f"{rec.get('value', 0):g}",
             "efficiency": f"{rec['per_chip_efficiency']:g}"
             if rec.get("per_chip_efficiency") is not None else "-",
@@ -75,6 +78,15 @@ def report_rows(records: List[dict]) -> List[dict]:
             "scan_bytes_q":
                 f"{rec['scanned_bytes_per_query_p50']:.0f}"
                 if rec.get("scanned_bytes_per_query_p50") else "-",
+            # block-max overlay (ISSUE 20): the effective (post-pruning)
+            # per-query posting bytes and the pruned share — the pruned
+            # arm's payoff next to the static trigger column; unpruned
+            # rows show effective == static (the scan conservation law)
+            "eff_bytes_q":
+                f"{rec['effective_bytes_per_query_p50']:.0f}"
+                if rec.get("effective_bytes_per_query_p50") else "-",
+            "pruned_frac": f"{rec['pruned_fraction']:g}"
+            if rec.get("pruned_fraction") is not None else "-",
         })
     return rows
 
@@ -119,8 +131,9 @@ def main(argv: List[str]) -> int:
     print(f"multi-chip scaling ({path}): QPS(D) on the real SPMD "
           f"serving path, efficiency = QPS(D)/(D*QPS(1))")
     print(_render(report_rows(records),
-                  ["devices", "qps", "efficiency", "skew_p50_ms",
-                   "ici_bytes_q", "scan_bytes_q"]))
+                  ["devices", "mode", "qps", "efficiency", "skew_p50_ms",
+                   "ici_bytes_q", "scan_bytes_q", "eff_bytes_q",
+                   "pruned_frac"]))
     dev = device_rows(records)
     if dev:
         print("\nper-chip breakdown (partial wall per query, "
